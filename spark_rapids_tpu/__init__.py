@@ -1,0 +1,35 @@
+"""spark_rapids_tpu: a TPU-native columnar SQL/DataFrame accelerator.
+
+A ground-up re-design of the capabilities of NVIDIA's RAPIDS Accelerator for
+Apache Spark (reference study: SURVEY.md) for TPU hardware: columnar batches
+live in TPU HBM as capacity-bucketed JAX arrays, operator pipelines fuse into
+whole-stage XLA programs, grouping/join/sort are sort-based device kernels,
+distribution rides jax.sharding meshes with ICI collectives, and anything the
+device can't run yet falls back to CPU operators with explained reasons.
+
+Quick start::
+
+    import spark_rapids_tpu as srt
+    sess = srt.Session.get_or_create()
+    df = sess.read_parquet("lineitem.parquet")
+    from spark_rapids_tpu.sql import functions as F
+    out = (df.where((F.col("l_quantity") < 24))
+             .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
+                  .alias("revenue"))
+             .collect())
+"""
+
+import jax as _jax
+
+# SQL semantics demand exact int64 (keys, counts, micros timestamps) and
+# float64 columns.  TPU MXU compute stays f32/bf16 where we choose it
+# (kernels opt in); x64 here governs *representation* correctness.
+_jax.config.update("jax_enable_x64", True)
+
+from .sql.session import Session  # noqa: F401
+from .sql.column import Column  # noqa: F401
+from .sql import functions  # noqa: F401
+from .config import TpuConf  # noqa: F401
+from . import types  # noqa: F401
+
+__version__ = "0.1.0"

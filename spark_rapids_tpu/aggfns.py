@@ -1,0 +1,203 @@
+"""Aggregate function declarations (SUM/COUNT/MIN/MAX/AVG/FIRST/LAST...).
+
+TPU-native analog of the reference's ``GpuAggregateFunction`` hierarchy
+(org/apache/spark/sql/rapids/AggregateFunctions.scala): each function declares
+its *update* contributions, its reduction buffers, and a *finalize* step.  The
+reference maps these to cuDF group-by aggregations; here they map to masked
+XLA segment reductions (ops/groupby.py) — sort-based grouping being the
+TPU-idiomatic choice (SURVEY.md §7.3 "hash tables").
+
+An aggregate is described by parallel lists:
+  * ``buffers()``  → list of (dtype, reduce_op) with reduce_op ∈
+    {"sum", "min", "max", "first", "last"}
+  * ``update(ctx)`` → per-row contribution Values, one per buffer
+  * ``finalize(values)`` → final (data, valid) from reduced buffers
+
+Partial/merge mode (two-phase aggregation across batches or shuffle) reuses
+the same reduce_op on the buffer columns, exactly like Spark's partial/final
+agg split.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import types as T
+from .exprs import AggregateExpression, EvalContext, Expression, Value
+
+__all__ = ["Sum", "Count", "CountStar", "Min", "Max", "Average", "First", "Last",
+           "AGG_CLASSES"]
+
+
+def _ones(ctx: EvalContext):
+    return jnp.ones((ctx.capacity,), dtype=jnp.int64)
+
+
+def _valid_indicator(v: Optional[jax.Array], ctx: EvalContext) -> jax.Array:
+    if v is None:
+        return _ones(ctx)
+    return v.astype(jnp.int64)
+
+
+class Sum(AggregateExpression):
+    func = "sum"
+
+    def _resolve(self):
+        c = self.children[0].dtype
+        if c.is_integral or c.kind == T.TypeKind.BOOLEAN:
+            self.dtype = T.INT64
+        elif c.is_floating:
+            self.dtype = T.FLOAT64
+        elif c.is_decimal:
+            self.dtype = T.decimal(min(c.precision + 10, 18), c.scale)
+        else:
+            raise TypeError(f"sum of {c} not supported")
+        self.nullable = True
+
+    def buffers(self):
+        return [(self.dtype, "sum"), (T.INT64, "sum")]
+
+    def update(self, ctx) -> List[Value]:
+        d, v = self.children[0].eval(ctx)
+        d = d.astype(self.dtype.numpy_dtype)
+        if v is not None:
+            d = jnp.where(v, d, jnp.zeros_like(d))
+        return [(d, None), (_valid_indicator(v, ctx), None)]
+
+    def finalize(self, values: List[Value]) -> Value:
+        (s, _), (cnt, _) = values
+        return s, cnt > 0
+
+
+class Count(AggregateExpression):
+    func = "count"
+
+    def _resolve(self):
+        self.dtype = T.INT64
+        self.nullable = False
+
+    def buffers(self):
+        return [(T.INT64, "sum")]
+
+    def update(self, ctx):
+        _, v = self.children[0].eval(ctx)
+        return [(_valid_indicator(v, ctx), None)]
+
+    def finalize(self, values):
+        return values[0][0], None
+
+
+class CountStar(AggregateExpression):
+    func = "count(*)"
+
+    def __init__(self):
+        super().__init__(None)
+        self.dtype = T.INT64
+        self.nullable = False
+
+    def buffers(self):
+        return [(T.INT64, "sum")]
+
+    def update(self, ctx):
+        return [(_ones(ctx), None)]
+
+    def finalize(self, values):
+        return values[0][0], None
+
+
+class _MinMax(AggregateExpression):
+    reduce_op = "?"
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = True
+
+    def buffers(self):
+        return [(self.dtype, self.reduce_op), (T.INT64, "sum")]
+
+    def update(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        return [(d, v), (_valid_indicator(v, ctx), None)]
+
+    def finalize(self, values):
+        (m, _), (cnt, _) = values
+        return m, cnt > 0
+
+
+class Min(_MinMax):
+    func = "min"
+    reduce_op = "min"
+
+
+class Max(_MinMax):
+    func = "max"
+    reduce_op = "max"
+
+
+class Average(AggregateExpression):
+    """AVG: tracked as (sum, count); int/float → double, decimal → double for
+    now (the reference returns decimal(p+4,s+4); planner notes the difference)."""
+
+    func = "avg"
+
+    def _resolve(self):
+        self.dtype = T.FLOAT64
+        self.nullable = True
+
+    def buffers(self):
+        return [(T.FLOAT64, "sum"), (T.INT64, "sum")]
+
+    def update(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        src = self.children[0].dtype
+        d = d.astype(jnp.float64)
+        if src.is_decimal:
+            d = d / (10.0 ** src.scale)
+        if v is not None:
+            d = jnp.where(v, d, jnp.zeros_like(d))
+        return [(d, None), (_valid_indicator(v, ctx), None)]
+
+    def finalize(self, values):
+        (s, _), (cnt, _) = values
+        ok = cnt > 0
+        return s / jnp.where(ok, cnt, 1).astype(jnp.float64), ok
+
+
+class First(AggregateExpression):
+    func = "first"
+    reduce_choice = "first"
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.ignore_nulls = ignore_nulls
+        super().__init__(child)
+
+    def _resolve(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = True
+
+    def buffers(self):
+        # value + validity carried through first/last reduction
+        return [(self.dtype, self.reduce_choice), (T.INT64, self.reduce_choice)]
+
+    def update(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        return [(d, v), (_valid_indicator(v, ctx), None)]
+
+    def finalize(self, values):
+        (d, _), (vi, _) = values
+        return d, vi > 0
+
+    def _fp_extra(self):
+        return f"{self.func}:{self.dtype}:ign={self.ignore_nulls}"
+
+
+class Last(First):
+    func = "last"
+    reduce_choice = "last"
+
+
+AGG_CLASSES = {c.func: c for c in
+               [Sum, Count, CountStar, Min, Max, Average, First, Last]}

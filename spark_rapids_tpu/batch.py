@@ -1,0 +1,393 @@
+"""Columnar batch: the device-resident data model.
+
+TPU-native replacement for the reference's ``GpuColumnVector``/``ColumnarBatch``
+(sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java): columns
+are JAX arrays in TPU HBM instead of cuDF device buffers.  The key design
+divergence (SURVEY.md §7.3 "dynamic shapes") is that XLA wants static shapes, so:
+
+  * every device column is padded to a power-of-two *capacity bucket* —
+    executables are compiled once per (operator, bucket) and reused;
+  * a batch carries ``num_rows`` (leading valid rows; the rest is padding) and
+    an optional ``sel`` boolean *selection mask* produced by filters.  Filter
+    does no data movement at all — it just narrows the mask, which downstream
+    fused stages incorporate.  Compaction (gathering live rows to the front)
+    happens only at boundaries that need dense rows (shuffle slicing, sort,
+    join, collect).
+
+Nulls are boolean validity masks (True = valid), matching Arrow; ``valid=None``
+means "no nulls" and lets XLA skip the mask entirely.
+
+Strings are carried as host-side Arrow arrays (``HostStringColumn``) until the
+device string kernels land; the planner routes string *compute* accordingly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .types import DataType
+
+__all__ = [
+    "Schema", "Field", "DeviceColumn", "HostStringColumn", "ColumnBatch",
+    "bucket_capacity", "from_arrow", "to_arrow", "from_numpy",
+]
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+
+class Schema:
+    def __init__(self, fields: Sequence[Field]):
+        self.fields = list(fields)
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+        assert len(self._index) == len(self.fields), "duplicate column names"
+
+    @staticmethod
+    def of(*pairs: Tuple[str, DataType]) -> "Schema":
+        return Schema([Field(n, d) for n, d in pairs])
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def field(self, name: str) -> Field:
+        return self.fields[self._index[name]]
+
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __repr__(self):
+        inner = ", ".join(f"{f.name}: {f.dtype}" for f in self.fields)
+        return f"Schema({inner})"
+
+
+def bucket_capacity(n_rows: int, min_capacity: int = 1024) -> int:
+    """Smallest power-of-two >= max(n_rows, min_capacity).
+
+    Power-of-two buckets are multiples of the TPU lane width (128) and keep
+    the XLA executable cache small: one compile per (stage, bucket).
+    """
+    cap = max(int(min_capacity), 1)
+    n = max(int(n_rows), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+@dataclass
+class DeviceColumn:
+    """One column resident in device memory.
+
+    ``data`` has physical length == batch capacity.  ``valid`` is a same-length
+    boolean mask (True = non-null) or None for no-nulls.  Padding rows beyond
+    ``num_rows`` hold unspecified values; kernels must mask with the batch's
+    active-row mask before any reduction or comparison that could observe them.
+    """
+
+    dtype: DataType
+    data: jax.Array
+    valid: Optional[jax.Array] = None
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def nullable(self) -> bool:
+        return self.valid is not None
+
+    def astuple(self):
+        return (self.dtype, self.data, self.valid)
+
+
+class HostStringColumn:
+    """A string column kept on host as a pyarrow array.
+
+    Device string kernels (Arrow offsets+bytes as int tensors — SURVEY.md §7.3)
+    are staged work; until then string *data* stays host-side and string
+    compute happens on the CPU fallback path, while group-by/join on strings
+    uses device-side dictionary codes (see ops/strings.py).
+    """
+
+    dtype = T.STRING
+
+    def __init__(self, array, capacity: Optional[int] = None):
+        import pyarrow as pa
+        if isinstance(array, pa.ChunkedArray):
+            array = array.combine_chunks()
+        if not isinstance(array, pa.Array):
+            array = pa.array(array, type=pa.string())
+        if pa.types.is_large_string(array.type):
+            array = array.cast(pa.string())
+        if capacity is not None and len(array) < capacity:
+            array = pa.concat_arrays(
+                [array, pa.nulls(capacity - len(array), type=array.type)])
+        self.array = array
+
+    @property
+    def capacity(self) -> int:
+        return len(self.array)
+
+    @property
+    def nullable(self) -> bool:
+        return self.array.null_count > 0
+
+    def to_pylist(self):
+        return self.array.to_pylist()
+
+
+Column = Union[DeviceColumn, HostStringColumn]
+
+
+class ColumnBatch:
+    """A batch of rows: columns + row accounting.
+
+    Active rows are ``i < num_rows`` AND ``sel[i]`` (when ``sel`` is present).
+    ``sel`` is how filters stay fused: GpuFilterExec in the reference gathers
+    immediately (basicPhysicalOperators.scala:763); here the mask rides along
+    and XLA fuses the predicate into whatever consumes the batch.
+    """
+
+    def __init__(self, schema: Schema, columns: Sequence[Column], num_rows: int,
+                 sel: Optional[jax.Array] = None):
+        assert len(schema) == len(columns)
+        self.schema = schema
+        self.columns = list(columns)
+        self.num_rows = int(num_rows)
+        self.sel = sel
+        caps = {c.capacity for c in self.columns}
+        assert len(caps) <= 1, f"ragged column capacities {caps}"
+        self._capacity = caps.pop() if caps else bucket_capacity(num_rows)
+        assert self.num_rows <= self._capacity
+
+    # ------------------------------------------------------------------ accounting
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def has_selection(self) -> bool:
+        return self.sel is not None
+
+    def active_mask(self) -> jax.Array:
+        """Boolean [capacity] mask of live rows (device)."""
+        m = jnp.arange(self._capacity, dtype=jnp.int32) < self.num_rows
+        if self.sel is not None:
+            m = m & self.sel
+        return m
+
+    def row_count(self) -> int:
+        """Exact live-row count. Syncs with device when a selection exists."""
+        if self.sel is None:
+            return self.num_rows
+        return int(jnp.sum(self.active_mask()))
+
+    def column(self, name: str) -> Column:
+        return self.columns[self.schema.index_of(name)]
+
+    def with_columns(self, schema: Schema, columns: Sequence[Column]) -> "ColumnBatch":
+        return ColumnBatch(schema, columns, self.num_rows, self.sel)
+
+    def device_size_bytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            if isinstance(c, DeviceColumn):
+                total += c.data.size * c.data.dtype.itemsize
+                if c.valid is not None:
+                    total += c.valid.size
+        return total
+
+    def __repr__(self):
+        sel = ", sel" if self.sel is not None else ""
+        return (f"ColumnBatch(rows={self.num_rows}/{self._capacity}{sel}, "
+                f"schema={self.schema})")
+
+
+# ---------------------------------------------------------------------------------
+# Host <-> device interchange (Arrow is the host interchange format, like the
+# reference's HostColumnarToGpu.scala path).
+# ---------------------------------------------------------------------------------
+
+def _arrow_to_logical(pa_type) -> DataType:
+    import pyarrow as pa
+    if pa.types.is_boolean(pa_type):
+        return T.BOOLEAN
+    if pa.types.is_int8(pa_type):
+        return T.INT8
+    if pa.types.is_int16(pa_type):
+        return T.INT16
+    if pa.types.is_int32(pa_type):
+        return T.INT32
+    if pa.types.is_int64(pa_type):
+        return T.INT64
+    if pa.types.is_float32(pa_type):
+        return T.FLOAT32
+    if pa.types.is_float64(pa_type):
+        return T.FLOAT64
+    if pa.types.is_string(pa_type) or pa.types.is_large_string(pa_type):
+        return T.STRING
+    if pa.types.is_date32(pa_type):
+        return T.DATE
+    if pa.types.is_timestamp(pa_type):
+        return T.TIMESTAMP
+    if pa.types.is_decimal(pa_type):
+        return T.decimal(pa_type.precision, pa_type.scale)
+    raise TypeError(f"unsupported arrow type {pa_type}")
+
+
+def logical_to_arrow(dt: DataType):
+    import pyarrow as pa
+    m = {
+        T.BOOLEAN: pa.bool_(), T.INT8: pa.int8(), T.INT16: pa.int16(),
+        T.INT32: pa.int32(), T.INT64: pa.int64(), T.FLOAT32: pa.float32(),
+        T.FLOAT64: pa.float64(), T.STRING: pa.string(), T.DATE: pa.date32(),
+        T.TIMESTAMP: pa.timestamp("us"),
+    }
+    if dt.is_decimal:
+        return pa.decimal128(dt.precision, dt.scale)
+    return m[dt]
+
+
+def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
+    if arr.shape[0] == capacity:
+        return arr
+    out = np.zeros((capacity,), dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def from_arrow(table, min_capacity: int = 1024, device=None) -> ColumnBatch:
+    """Build a ColumnBatch from a pyarrow Table (one upload per column)."""
+    import pyarrow as pa
+    n = table.num_rows
+    cap = bucket_capacity(n, min_capacity)
+    fields: List[Field] = []
+    cols: List[Column] = []
+    for name, col in zip(table.column_names, table.columns):
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+        dt = _arrow_to_logical(col.type)
+        fields.append(Field(name, dt, col.null_count > 0))
+        if dt.is_string:
+            cols.append(HostStringColumn(col, capacity=cap))
+            continue
+        if dt.is_decimal:
+            if dt.precision > 18:
+                raise TypeError("decimal precision > 18 must stay on CPU")
+            # Arrow decimal128 → scaled int64 (precision <= 18 guaranteed above).
+            scaled = np.array(
+                [int(v.scaleb(dt.scale)) if v is not None else 0
+                 for v in (x.as_py() for x in col)], dtype=np.int64)
+            data = _pad_to(scaled, cap)
+            valid_np = np.asarray(col.is_valid())
+        else:
+            # null payload slots are masked by the validity array; fill them
+            # with a typed zero so integer casts are well-defined (float NaN
+            # payloads at null slots are harmless and stay put).
+            if col.null_count > 0 and not dt.is_floating:
+                col_f = col.fill_null(pa.scalar(0).cast(col.type)) if not (
+                    pa.types.is_date(col.type)
+                    or pa.types.is_timestamp(col.type)) else \
+                    col.fill_null(pa.scalar(0, type=pa.int64()).cast(col.type))
+            else:
+                col_f = col
+            np_col = col_f.to_numpy(zero_copy_only=False)
+            if dt.kind == T.TypeKind.DATE:
+                np_col = np_col.astype("datetime64[D]").astype(np.int32)
+            elif dt.kind == T.TypeKind.TIMESTAMP:
+                np_col = np_col.astype("datetime64[us]").astype(np.int64)
+            else:
+                np_col = np_col.astype(dt.numpy_dtype, copy=False)
+            data = _pad_to(np.ascontiguousarray(np_col), cap)
+            valid_np = np.asarray(col.is_valid()) if col.null_count > 0 else None
+        jdata = jax.device_put(data, device)
+        jvalid = (jax.device_put(_pad_to(valid_np, cap), device)
+                  if valid_np is not None and col.null_count > 0 else None)
+        cols.append(DeviceColumn(dt, jdata, jvalid))
+    return ColumnBatch(Schema(fields), cols, n)
+
+
+def from_numpy(data: Dict[str, np.ndarray], min_capacity: int = 1024) -> ColumnBatch:
+    """Test/bench helper: build a batch from plain numpy arrays (no nulls)."""
+    n = len(next(iter(data.values())))
+    cap = bucket_capacity(n, min_capacity)
+    fields, cols = [], []
+    np_to_logical = {
+        np.dtype(np.bool_): T.BOOLEAN, np.dtype(np.int8): T.INT8,
+        np.dtype(np.int16): T.INT16, np.dtype(np.int32): T.INT32,
+        np.dtype(np.int64): T.INT64, np.dtype(np.float32): T.FLOAT32,
+        np.dtype(np.float64): T.FLOAT64,
+    }
+    for name, arr in data.items():
+        arr = np.asarray(arr)
+        if arr.dtype.kind in ("U", "O", "S"):
+            fields.append(Field(name, T.STRING, False))
+            cols.append(HostStringColumn([str(x) for x in arr], capacity=cap))
+            continue
+        dt = np_to_logical[arr.dtype]
+        fields.append(Field(name, dt, False))
+        cols.append(DeviceColumn(dt, jnp.asarray(_pad_to(arr, cap))))
+    return ColumnBatch(Schema(fields), cols, n)
+
+
+def to_arrow(batch: ColumnBatch):
+    """Download a batch to a pyarrow Table (compacts through the selection)."""
+    import pyarrow as pa
+    mask = None
+    if batch.sel is not None:
+        mask = np.asarray(batch.active_mask())[: batch.num_rows]
+    arrays, names = [], []
+    for f, col in zip(batch.schema, batch.columns):
+        names.append(f.name)
+        if isinstance(col, HostStringColumn):
+            arr = col.array.slice(0, batch.num_rows)
+            if mask is not None:
+                arr = arr.filter(pa.array(mask))
+            arrays.append(arr)
+            continue
+        data = np.asarray(col.data)[: batch.num_rows]
+        valid = (np.asarray(col.valid)[: batch.num_rows]
+                 if col.valid is not None else None)
+        if mask is not None:
+            data = data[mask]
+            valid = valid[mask] if valid is not None else None
+        if f.dtype.kind == T.TypeKind.DATE:
+            arrays.append(pa.array(data.astype("datetime64[D]"),
+                                   type=pa.date32(),
+                                   mask=(~valid if valid is not None else None)))
+        elif f.dtype.kind == T.TypeKind.TIMESTAMP:
+            arrays.append(pa.array(data.astype("datetime64[us]"),
+                                   type=pa.timestamp("us"),
+                                   mask=(~valid if valid is not None else None)))
+        elif f.dtype.is_decimal:
+            from decimal import Decimal
+            scale = f.dtype.scale
+            vals = [None if (valid is not None and not valid[i])
+                    else Decimal(int(data[i])).scaleb(-scale)
+                    for i in range(len(data))]
+            arrays.append(pa.array(vals, type=logical_to_arrow(f.dtype)))
+        else:
+            arrays.append(pa.array(data, type=logical_to_arrow(f.dtype),
+                                   mask=(~valid if valid is not None else None)))
+    return pa.table(dict(zip(names, arrays)))

@@ -1,0 +1,259 @@
+"""Typed, self-documenting configuration registry.
+
+TPU-native analog of the reference's ``RapidsConf`` (RapidsConf.scala:120-259
+``ConfEntry``/``TypedConfBuilder``; 192 ``spark.rapids.*`` keys): every knob is
+registered once with a type, default, and doc string; ``TpuConf.help()``
+generates the user documentation from the registry
+(RapidsConf.scala:2019-2075).  Keys use the ``spark.rapids.tpu.*`` namespace.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ConfEntry", "TpuConf", "register", "ALL_ENTRIES"]
+
+
+@dataclass(frozen=True)
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    conv: Callable[[str], Any]
+    startup_only: bool = False
+    internal: bool = False
+    check: Optional[Callable[[Any], Optional[str]]] = None
+
+    def convert(self, raw: Any) -> Any:
+        if isinstance(raw, str):
+            value = self.conv(raw)
+        else:
+            value = raw
+        if self.check is not None:
+            err = self.check(value)
+            if err:
+                raise ValueError(f"invalid value {value!r} for {self.key}: {err}")
+        return value
+
+
+ALL_ENTRIES: Dict[str, ConfEntry] = {}
+
+
+def _to_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def register(key: str, default: Any, doc: str, *, conv: Callable = None,
+             startup_only: bool = False, internal: bool = False,
+             check: Callable = None) -> ConfEntry:
+    if conv is None:
+        if isinstance(default, bool):
+            conv = _to_bool
+        elif isinstance(default, int):
+            conv = int
+        elif isinstance(default, float):
+            conv = float
+        else:
+            conv = str
+    entry = ConfEntry(key, default, doc, conv, startup_only, internal, check)
+    assert key not in ALL_ENTRIES, f"duplicate conf key {key}"
+    ALL_ENTRIES[key] = entry
+    return entry
+
+
+def _one_of(*allowed: str):
+    def _check(v):
+        if v not in allowed:
+            return f"must be one of {allowed}"
+        return None
+    return _check
+
+
+# ---------------------------------------------------------------------------------
+# Registry.  Grouped to mirror the reference's config surface (docs/configs.md).
+# ---------------------------------------------------------------------------------
+
+SQL_ENABLED = register(
+    "spark.rapids.tpu.sql.enabled", True,
+    "Enable TPU acceleration of SQL/DataFrame execution. When false every "
+    "operator runs on the CPU fallback path.")
+
+SQL_MODE = register(
+    "spark.rapids.tpu.sql.mode", "executeontpu",
+    "Plugin mode: 'executeontpu' runs supported operators on the TPU; "
+    "'explainonly' plans as if a TPU were present and reports which operators "
+    "would or would not be accelerated, but executes everything on CPU.",
+    check=_one_of("executeontpu", "explainonly"))
+
+EXPLAIN = register(
+    "spark.rapids.tpu.sql.explain", "NOT_ON_TPU",
+    "Explain verbosity for plan conversion: NONE, NOT_ON_TPU (reasons for "
+    "fallbacks only), or ALL.",
+    check=_one_of("NONE", "NOT_ON_TPU", "ALL"))
+
+BATCH_SIZE_ROWS = register(
+    "spark.rapids.tpu.sql.batchSizeRows", 1 << 20,
+    "Target number of rows per columnar batch on device. Batches are padded "
+    "to the next capacity bucket so XLA executables are reused across batches.")
+
+BATCH_SIZE_BYTES = register(
+    "spark.rapids.tpu.sql.batchSizeBytes", 1 << 30,
+    "Soft target for the in-memory size of a device batch, pre-padding.")
+
+MIN_CAPACITY = register(
+    "spark.rapids.tpu.sql.minBatchCapacity", 1024,
+    "Smallest capacity bucket. Device arrays are padded to "
+    "power-of-two buckets no smaller than this, bounding executable-cache "
+    "cardinality (one compile per op-shape bucket).")
+
+CONCURRENT_TASKS = register(
+    "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
+    "Number of tasks that may hold the TPU semaphore concurrently. The TPU "
+    "has no CUDA-stream analog, so this primarily overlaps host I/O of one "
+    "task with device compute of another.")
+
+HBM_POOL_FRACTION = register(
+    "spark.rapids.tpu.memory.tpu.poolFraction", 0.9,
+    "Fraction of free TPU HBM the arena manages for batch storage; "
+    "allocations beyond it trigger spill-to-host.", startup_only=True)
+
+HOST_SPILL_LIMIT = register(
+    "spark.rapids.tpu.memory.host.spillStorageSize", 8 << 30,
+    "Bytes of host memory for spilled device batches before they overflow "
+    "to disk.")
+
+SPILL_DIR = register(
+    "spark.rapids.tpu.memory.spill.dir", "/tmp/srt_spill",
+    "Directory for the disk spill tier.")
+
+OOM_RETRY_ENABLED = register(
+    "spark.rapids.tpu.memory.retry.enabled", True,
+    "Catch device OOM inside operators, spill, and retry the work — "
+    "splitting the input batch in half when a plain retry cannot fit.")
+
+TEST_INJECT_OOM = register(
+    "spark.rapids.tpu.test.injectRetryOOM", 0,
+    "Test-only: force the next N device operations to raise a retry OOM so "
+    "suites can prove operators survive and split correctly.", internal=True)
+
+SHUFFLE_MODE = register(
+    "spark.rapids.tpu.shuffle.mode", "HOST",
+    "Shuffle transport: HOST (host-staged multithreaded shuffle, works "
+    "everywhere), ICI (XLA all-to-all collectives within a mesh for "
+    "whole-stage-resident execution), CACHE_ONLY (keep partitions resident, "
+    "single process).",
+    check=_one_of("HOST", "ICI", "CACHE_ONLY"))
+
+SHUFFLE_PARTITIONS = register(
+    "spark.rapids.tpu.sql.shuffle.partitions", 16,
+    "Default number of shuffle partitions for exchanges.")
+
+SHUFFLE_COMPRESS = register(
+    "spark.rapids.tpu.shuffle.compress", True,
+    "Compress host-staged shuffle payloads (lz4 via the native host library "
+    "when built, else zlib).")
+
+READER_THREADS = register(
+    "spark.rapids.tpu.sql.multiThreadedRead.numThreads", 8,
+    "Threads prefetching and parsing input files to host memory while the "
+    "device computes (multi-file cloud reader analog).")
+
+MAX_READER_BATCH_BYTES = register(
+    "spark.rapids.tpu.sql.reader.batchSizeBytes", 512 << 20,
+    "Soft cap on bytes of file data decoded into a single scan batch.")
+
+HASH_SUBPARTITIONS = register(
+    "spark.rapids.tpu.sql.join.subPartitions", 16,
+    "Sub-partition count used when a join build side is too large for HBM.")
+
+JOIN_OUTPUT_GROWTH = register(
+    "spark.rapids.tpu.sql.join.outputGrowthFactor", 2.0,
+    "Initial output-capacity multiple assumed for join results; overflow "
+    "triggers split-and-retry of the probe batch.")
+
+ALLOW_INCOMPAT = register(
+    "spark.rapids.tpu.sql.incompatibleOps.enabled", True,
+    "Allow operators whose results can differ from Spark CPU in corner "
+    "cases (e.g. float ordering of -0.0, timestamp parsing corners).")
+
+ANSI_ENABLED = register(
+    "spark.rapids.tpu.sql.ansi.enabled", False,
+    "ANSI mode: arithmetic overflow and invalid casts raise instead of "
+    "returning null.")
+
+CPU_FALLBACK_ENABLED = register(
+    "spark.rapids.tpu.sql.fallback.enabled", True,
+    "Execute unsupported operators on the CPU (Arrow/pandas kernels) instead "
+    "of failing the query.")
+
+METRICS_LEVEL = register(
+    "spark.rapids.tpu.sql.metrics.level", "MODERATE",
+    "Operator metric collection level: ESSENTIAL, MODERATE, DEBUG.",
+    check=_one_of("ESSENTIAL", "MODERATE", "DEBUG"))
+
+TEST_VALIDATE_EXECS = register(
+    "spark.rapids.tpu.test.validateExecsOnTpu", False,
+    "Test-only: fail if any operator in the plan falls back to CPU.",
+    internal=True)
+
+
+class TpuConf:
+    """An immutable snapshot of settings; unset keys resolve to defaults."""
+
+    _session_lock = threading.Lock()
+    _session_overrides: Dict[str, Any] = {}
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        merged = dict(TpuConf._session_overrides)
+        merged.update(settings or {})
+        self._values: Dict[str, Any] = {}
+        for k, v in merged.items():
+            entry = ALL_ENTRIES.get(k)
+            if entry is None:
+                raise KeyError(f"unknown config key {k!r}; see TpuConf.help()")
+            self._values[k] = entry.convert(v)
+
+    def get(self, entry: ConfEntry) -> Any:
+        return self._values.get(entry.key, entry.default)
+
+    def __getitem__(self, key: str) -> Any:
+        entry = ALL_ENTRIES[key]
+        return self._values.get(key, entry.default)
+
+    def with_settings(self, **kv) -> "TpuConf":
+        vals = dict(self._values)
+        vals.update(kv)
+        return TpuConf(vals)
+
+    # -- session-level mutation (Session.conf.set style) --------------------------
+    @classmethod
+    def set_session(cls, key: str, value: Any) -> None:
+        entry = ALL_ENTRIES.get(key)
+        if entry is None:
+            raise KeyError(f"unknown config key {key!r}")
+        with cls._session_lock:
+            cls._session_overrides[key] = entry.convert(value)
+
+    @classmethod
+    def unset_session(cls, key: str) -> None:
+        with cls._session_lock:
+            cls._session_overrides.pop(key, None)
+
+    @classmethod
+    def clear_session(cls) -> None:
+        with cls._session_lock:
+            cls._session_overrides.clear()
+
+    # -- documentation generation -------------------------------------------------
+    @staticmethod
+    def help(include_internal: bool = False) -> str:
+        """Markdown table of every registered key (docs generator analog)."""
+        lines = ["| Key | Default | Description |", "|---|---|---|"]
+        for key in sorted(ALL_ENTRIES):
+            e = ALL_ENTRIES[key]
+            if e.internal and not include_internal:
+                continue
+            lines.append(f"| {e.key} | {e.default} | {e.doc} |")
+        return "\n".join(lines)

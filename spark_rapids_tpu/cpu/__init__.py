@@ -1,0 +1,8 @@
+"""CPU fallback path: expression evaluation and operator execution on host
+(Arrow/pandas), used when the planner tags a node as not-runnable on TPU.
+
+The reference falls back by simply leaving Spark's own CPU operators in the
+plan (RapidsMeta.willNotWorkOnGpu); as a standalone framework we ship the CPU
+operators ourselves.  Results must match the TPU path bit-for-bit — the
+differential test oracle runs every query both ways.
+"""

@@ -1,0 +1,320 @@
+"""CPU fallback physical operators (pandas/Arrow host execution).
+
+When the planner tags a logical node as not-TPU-runnable (string compute,
+exotic types, unsupported corner), the node executes here.  Children may
+still run on TPU — the batch boundary is the host↔device transition, exactly
+like the reference's GpuColumnarToRowExec / GpuRowToColumnarExec insertions
+(GpuTransitionOverrides.scala:50-116).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..batch import ColumnBatch, Schema, from_arrow, to_arrow
+from ..exprs import bind
+from ..plan import logical as L
+from ..plan.physical import ExecContext, TpuExec
+from .eval import eval_cpu
+
+__all__ = ["CpuOpExec", "arrow_to_values", "values_to_arrow"]
+
+
+def arrow_to_values(table, schema: Schema):
+    """Arrow table → list of (numpy data, valid) pairs (dense rows)."""
+    vals = []
+    for f, col in zip(schema, table.columns):
+        arr = col.combine_chunks() if hasattr(col, "combine_chunks") else col
+        if f.dtype.is_string:
+            data = np.array(arr.to_pylist(), dtype=object)
+            valid = np.array([x is not None for x in data], dtype=bool)
+            vals.append((data, None if valid.all() else valid))
+            continue
+        import pyarrow as pa
+        valid = np.asarray(arr.is_valid()) if arr.null_count else None
+        if arr.null_count and not f.dtype.is_floating and not f.dtype.is_decimal:
+            arr = arr.fill_null(pa.scalar(0, type=pa.int64()).cast(arr.type)) \
+                if (pa.types.is_date(arr.type) or pa.types.is_timestamp(arr.type)) \
+                else arr.fill_null(pa.scalar(0).cast(arr.type))
+        np_arr = arr.to_numpy(zero_copy_only=False)
+        if f.dtype.kind == T.TypeKind.DATE:
+            np_arr = np_arr.astype("datetime64[D]").astype(np.int32)
+        elif f.dtype.kind == T.TypeKind.TIMESTAMP:
+            np_arr = np_arr.astype("datetime64[us]").astype(np.int64)
+        elif f.dtype.is_decimal:
+            np_arr = np.array([0 if x is None else int(x.scaleb(f.dtype.scale))
+                               for x in arr.to_pylist()], dtype=np.int64)
+        else:
+            np_arr = np_arr.astype(f.dtype.numpy_dtype)
+        vals.append((np.ascontiguousarray(np_arr), valid))
+    return vals
+
+
+def values_to_arrow(schema: Schema, values, n: int):
+    import pyarrow as pa
+    from ..batch import logical_to_arrow
+    arrays = []
+    for f, (data, valid) in zip(schema, values):
+        mask = None if valid is None else ~valid
+        if f.dtype.is_string:
+            pl = [None if (mask is not None and mask[i]) else data[i]
+                  for i in range(n)]
+            arrays.append(pa.array(pl, type=pa.string()))
+        elif f.dtype.kind == T.TypeKind.DATE:
+            arrays.append(pa.array(data[:n].astype("datetime64[D]"),
+                                   type=pa.date32(), mask=mask))
+        elif f.dtype.kind == T.TypeKind.TIMESTAMP:
+            arrays.append(pa.array(data[:n].astype("datetime64[us]"),
+                                   type=pa.timestamp("us"), mask=mask))
+        elif f.dtype.is_decimal:
+            from decimal import Decimal
+            pl = [None if (mask is not None and mask[i])
+                  else Decimal(int(data[i])).scaleb(-f.dtype.scale)
+                  for i in range(n)]
+            arrays.append(pa.array(pl, type=logical_to_arrow(f.dtype)))
+        else:
+            arrays.append(pa.array(data[:n], type=logical_to_arrow(f.dtype),
+                                   mask=mask))
+    return pa.table(dict(zip(schema.names(), arrays)))
+
+
+class CpuOpExec(TpuExec):
+    """Executes one logical operator on host over its children's output."""
+
+    def __init__(self, plan: L.LogicalPlan, children: List[TpuExec]):
+        super().__init__(children)
+        self.plan = plan
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.plan.schema()
+
+    def node_desc(self):
+        return f"CpuFallback[{self.plan.node_desc()}]"
+
+    def _child_table(self, ctx: ExecContext, i: int = 0):
+        import pyarrow as pa
+        tables = [to_arrow(b) for b in self.children[i].execute(ctx)]
+        if not tables:
+            sch = self.children[i].output_schema
+            from ..batch import logical_to_arrow
+            return pa.table({f.name: pa.array([], type=logical_to_arrow(f.dtype))
+                             for f in sch})
+        return pa.concat_tables(tables)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        table = self._run(ctx)
+        min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+        batch_rows = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
+        for off in range(0, max(table.num_rows, 1), batch_rows):
+            chunk = table.slice(off, min(batch_rows, table.num_rows - off)) \
+                if table.num_rows else table
+            yield from_arrow(chunk, min_capacity=min_cap, device=ctx.device)
+            if not table.num_rows:
+                break
+
+    # -- per-op host implementations ---------------------------------------------
+    def _run(self, ctx: ExecContext):
+        p = self.plan
+        if isinstance(p, L.Project):
+            return self._run_project(ctx, p)
+        if isinstance(p, L.Filter):
+            return self._run_filter(ctx, p)
+        if isinstance(p, L.Aggregate):
+            return self._run_aggregate(ctx, p)
+        if isinstance(p, L.Sort):
+            return self._run_sort(ctx, p)
+        if isinstance(p, L.Join):
+            return self._run_join(ctx, p)
+        if isinstance(p, L.Distinct):
+            return self._child_table(ctx).group_by(
+                self.children[0].output_schema.names()).aggregate([])
+        raise NotImplementedError(
+            f"CPU fallback for {type(p).__name__} not implemented")
+
+    def _run_project(self, ctx, p: L.Project):
+        in_schema = self.children[0].output_schema
+        table = self._child_table(ctx)
+        vals = arrow_to_values(table, in_schema)
+        n = table.num_rows
+        outs = []
+        for name, e in p.exprs:
+            b = bind(e, in_schema)
+            outs.append(eval_cpu(b, vals, n))
+        return values_to_arrow(p.schema(), outs, n)
+
+    def _run_filter(self, ctx, p: L.Filter):
+        import pyarrow as pa
+        in_schema = self.children[0].output_schema
+        table = self._child_table(ctx)
+        vals = arrow_to_values(table, in_schema)
+        n = table.num_rows
+        d, v = eval_cpu(bind(p.condition, in_schema), vals, n)
+        keep = d if v is None else (d & v)
+        return table.filter(pa.array(keep))
+
+    def _run_aggregate(self, ctx, p: L.Aggregate):
+        import pandas as pd
+        from .. import aggfns as A
+        from ..plan.planner import strip_alias
+        in_schema = self.children[0].output_schema
+        table = self._child_table(ctx)
+        vals = arrow_to_values(table, in_schema)
+        n = table.num_rows
+
+        key_vals = []
+        for name, e in p.group_exprs:
+            b = bind(e, in_schema)
+            key_vals.append((name, b, eval_cpu(b, vals, n)))
+        agg_specs = []
+        for name, e in p.agg_exprs:
+            b = strip_alias(bind(e, in_schema))
+            child_val = (eval_cpu(b.children[0], vals, n)
+                         if b.children else (np.ones(n), None))
+            agg_specs.append((name, b, child_val))
+
+        if not key_vals:
+            outs = [self._agg_scalar(b, cv, n) for _, b, cv in agg_specs]
+            return values_to_arrow(p.schema(), outs, 1)
+
+        # pandas group-by with nulls as a group (dropna=False)
+        df = {}
+        for name, b, (d, v) in key_vals:
+            s = pd.Series(list(d) if d.dtype == object else d)
+            if v is not None:
+                s = s.where(pd.Series(v), other=pd.NA)
+            df[name] = s
+        pdf = pd.DataFrame(df)
+        grouped = pdf.groupby(list(df.keys()), dropna=False, sort=True)
+        idx_groups = list(grouped.indices.items()) if len(df) > 1 else [
+            (k, g) for k, g in grouped.indices.items()]
+        # Build group rows deterministically
+        group_keys = list(grouped.indices.keys())
+        out_rows = len(group_keys)
+        key_outs = []
+        for ki, (name, b, (d, v)) in enumerate(key_vals):
+            kd = np.empty(out_rows, dtype=d.dtype if d.dtype == object
+                          else d.dtype)
+            kv = np.ones(out_rows, dtype=bool)
+            for gi, gk in enumerate(group_keys):
+                first_idx = grouped.indices[gk][0]
+                if v is not None and not v[first_idx]:
+                    kv[gi] = False
+                    kd[gi] = 0 if d.dtype != object else None
+                else:
+                    kd[gi] = d[first_idx]
+            key_outs.append((kd, None if kv.all() else kv))
+        agg_outs = []
+        for name, b, (cd, cv) in agg_specs:
+            od = np.zeros(out_rows, dtype=self._agg_np_dtype(b))
+            ov = np.ones(out_rows, dtype=bool)
+            for gi, gk in enumerate(group_keys):
+                idx = grouped.indices[gk]
+                val, ok = self._agg_one(b, cd, cv, idx)
+                od[gi] = val
+                ov[gi] = ok
+            agg_outs.append((od, None if ov.all() else ov))
+        return values_to_arrow(p.schema(), key_outs + agg_outs, out_rows)
+
+    @staticmethod
+    def _agg_np_dtype(b):
+        return b.dtype.numpy_dtype
+
+    @staticmethod
+    def _agg_one(b, cd, cv, idx):
+        from .. import aggfns as A
+        sel = idx if cv is None else idx[cv[idx]]
+        if isinstance(b, A.CountStar):
+            return len(idx), True
+        if isinstance(b, A.Count):
+            return len(sel), True
+        if len(sel) == 0:
+            return 0, False
+        x = cd[sel]
+        if isinstance(b, A.Sum):
+            return x.sum(), True
+        if isinstance(b, A.Min):
+            return x.min(), True
+        if isinstance(b, A.Max):
+            return x.max(), True
+        if isinstance(b, A.Average):
+            src = b.children[0].dtype
+            xf = x.astype(np.float64)
+            if src.is_decimal:
+                xf = xf / 10 ** src.scale
+            return xf.mean(), True
+        if isinstance(b, A.Last):
+            pick = idx if not b.ignore_nulls else sel
+            i = pick[-1]
+            return cd[i], (cv is None or cv[i])
+        if isinstance(b, A.First):
+            pick = idx if not b.ignore_nulls else sel
+            i = pick[0]
+            return cd[i], (cv is None or cv[i])
+        raise NotImplementedError(type(b).__name__)
+
+    def _agg_scalar(self, b, child_val, n):
+        idx = np.arange(n)
+        cd, cv = child_val
+        val, ok = self._agg_one(b, cd, cv, idx)
+        return (np.array([val], dtype=self._agg_np_dtype(b)),
+                None if ok else np.array([False]))
+
+    def _run_sort(self, ctx, p: L.Sort):
+        import pyarrow as pa
+        in_schema = self.children[0].output_schema
+        table = self._child_table(ctx)
+        vals = arrow_to_values(table, in_schema)
+        n = table.num_rows
+        # lexicographic: apply np.argsort stably from minor to major key
+        perm = np.arange(n)
+        for o in reversed(p.orders):
+            d, v = eval_cpu(bind(o.expr, in_schema), vals, n)
+            d2, v2 = d[perm], (v[perm] if v is not None else None)
+            keys = self._sort_key(d2, v2, o.ascending, o.nulls_first)
+            perm = perm[np.argsort(keys, kind="stable")]
+        return table.take(pa.array(perm))
+
+    @staticmethod
+    def _sort_key(d, v, ascending, nulls_first):
+        """Integer rank key: encodes value order, direction, null placement.
+
+        Rank-based (not value-based) so int64 precision and NaN ordering
+        (Spark: NaN sorts greater than any number) are exact.
+        """
+        n = len(d)
+        null_mask = (~v) if v is not None else np.zeros(n, dtype=bool)
+        key = np.empty(n, dtype=np.int64)
+        if d.dtype == object:  # strings
+            null_mask = null_mask | np.array([x is None for x in d], dtype=bool)
+            non_null = [i for i in range(n) if not null_mask[i]]
+            non_null.sort(key=lambda i: d[i], reverse=not ascending)
+            for rank, i in enumerate(non_null):
+                key[i] = rank
+        else:
+            order = np.argsort(d, kind="stable")  # NaN sorts last = greatest
+            rank = np.empty(n, dtype=np.int64)
+            rank[order] = np.arange(n)
+            key = rank if ascending else -rank
+        key[null_mask] = (np.iinfo(np.int64).min if nulls_first
+                          else np.iinfo(np.int64).max)
+        return key
+
+    def _run_join(self, ctx, p: L.Join):
+        import pandas as pd
+        import pyarrow as pa
+        lt = self._child_table(ctx, 0)
+        rt = self._child_table(ctx, 1)
+        how = {"inner": "inner", "left": "left", "left_outer": "left",
+               "right": "right", "right_outer": "right", "full": "outer",
+               "full_outer": "outer"}.get(p.how)
+        using = getattr(p, "using", None)
+        if how is None or using is None:
+            raise NotImplementedError(f"CPU join how={p.how}")
+        lpd, rpd = lt.to_pandas(), rt.to_pandas()
+        merged = lpd.merge(rpd, on=using, how=how,
+                           suffixes=("", "#r"))
+        return pa.Table.from_pandas(merged, preserve_index=False)

@@ -1,0 +1,39 @@
+"""Spark-compatible value formatting (Java semantics, not Python's repr)."""
+
+from __future__ import annotations
+
+import math
+
+
+def spark_double_str(x: float) -> str:
+    """Format a double the way Java's Double.toString does (Spark CAST)."""
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0.0:
+        return "-0.0" if math.copysign(1.0, x) < 0 else "0.0"
+    mag = abs(x)
+    if 1e-3 <= mag < 1e7:
+        s = repr(x)
+        if "e" in s or "E" in s:
+            s = f"{x:.17g}"
+        if "." not in s:
+            s += ".0"
+        return s
+    # scientific notation, Java style: d.dddE[-]e
+    s = f"{x:.17g}"
+    f = float(s)
+    for prec in range(1, 18):
+        s2 = f"{x:.{prec}e}"
+        if float(s2) == x:
+            s = s2
+            break
+    mant, exp = s.split("e")
+    exp_i = int(exp)
+    if "." not in mant:
+        mant += ".0"
+    mant = mant.rstrip("0")
+    if mant.endswith("."):
+        mant += "0"
+    return f"{mant}E{exp_i}"

@@ -1,0 +1,90 @@
+"""CPU string kernels + string casts (host side).
+
+Registered by node class name so cpu/eval.py stays import-cycle-free.  Device
+string kernels (Arrow offsets+bytes int tensors) are staged work; until then
+every string *computation* lands here via the planner's fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+
+Value = Tuple[np.ndarray, Optional[np.ndarray]]
+
+HANDLERS: Dict[str, Callable] = {}
+
+
+def handler(name: str):
+    def deco(fn):
+        HANDLERS[name] = fn
+        return fn
+    return deco
+
+
+def _valid_from_obj(d: np.ndarray) -> Optional[np.ndarray]:
+    mask = np.array([x is not None for x in d], dtype=bool)
+    return None if mask.all() else mask
+
+
+def cast_to_string(d, v, src: T.DataType) -> Value:
+    if src.kind == T.TypeKind.BOOLEAN:
+        out = np.array(["true" if x else "false" for x in d], dtype=object)
+    elif src.is_integral:
+        out = np.array([str(int(x)) for x in d], dtype=object)
+    elif src.is_floating:
+        from .fmt import spark_double_str
+        out = np.array([spark_double_str(float(x)) for x in d], dtype=object)
+    elif src.kind == T.TypeKind.DATE:
+        out = np.array([str(np.datetime64(int(x), "D")) for x in d], dtype=object)
+    elif src.kind == T.TypeKind.TIMESTAMP:
+        out = np.array(
+            [str(np.datetime64(int(x), "us")).replace("T", " ") for x in d],
+            dtype=object)
+    elif src.is_decimal:
+        from decimal import Decimal
+        out = np.array([str(Decimal(int(x)).scaleb(-src.scale))
+                        for x in d], dtype=object)
+    else:
+        raise NotImplementedError(f"cast {src} -> string")
+    return out, v
+
+
+def cast_from_string(d, v, dst: T.DataType) -> Value:
+    n = len(d)
+    out = np.zeros(n, dtype=dst.numpy_dtype if not dst.is_string else object)
+    ok = np.ones(n, dtype=bool)
+    for i, s in enumerate(d):
+        if s is None:
+            ok[i] = False
+            continue
+        s2 = s.strip()
+        try:
+            if dst.is_integral:
+                out[i] = int(s2)
+            elif dst.is_floating:
+                out[i] = float(s2)
+            elif dst.kind == T.TypeKind.BOOLEAN:
+                low = s2.lower()
+                if low in ("t", "true", "y", "yes", "1"):
+                    out[i] = True
+                elif low in ("f", "false", "n", "no", "0"):
+                    out[i] = False
+                else:
+                    ok[i] = False
+            elif dst.kind == T.TypeKind.DATE:
+                out[i] = np.datetime64(s2, "D").astype(np.int32)
+            elif dst.kind == T.TypeKind.TIMESTAMP:
+                out[i] = np.datetime64(s2.replace(" ", "T"), "us").astype(np.int64)
+            elif dst.is_decimal:
+                from decimal import Decimal
+                out[i] = int(Decimal(s2).scaleb(dst.scale).to_integral_value())
+            else:
+                raise NotImplementedError
+        except (ValueError, ArithmeticError):
+            ok[i] = False
+    valid = ok if v is None else (ok & v)
+    return out, (None if valid.all() else valid)

@@ -1,0 +1,783 @@
+"""Expression IR: Spark-SQL-semantics expressions that lower to JAX.
+
+TPU-native analog of the reference's ``GpuExpression`` library
+(GpuExpressions.scala:99-141 ``columnarEval``; expression files under
+org/apache/spark/sql/rapids/).  The key architectural difference: the
+reference issues one cuDF kernel per expression node, with an optional "AST"
+fusion path for joins (GpuExpressions.scala:157 ``convertToAst``).  On TPU
+*every* expression lowers into the enclosing stage's single XLA computation —
+whole-stage fusion is the default, not the exception — so the per-node
+``eval`` here returns traced ``jnp`` values, and ``jax.jit`` + XLA do the
+fusion and scheduling.
+
+Null model: a value is a pair ``(data, valid)`` where ``valid`` is a boolean
+mask or ``None`` (= all valid).  Semantics match Spark CPU: null propagation
+for arithmetic, Kleene three-valued logic for AND/OR, null (not NaN/error) for
+division by zero unless ANSI mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .types import DataType, TypeSig
+
+__all__ = [
+    "Expression", "BoundReference", "UnresolvedColumn", "Literal", "Alias",
+    "Cast", "Add", "Subtract", "Multiply", "Divide", "IntegralDivide", "Remainder",
+    "Pmod", "UnaryMinus", "Abs",
+    "EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual", "GreaterThan",
+    "GreaterThanOrEqual", "Not", "And", "Or", "In",
+    "IsNull", "IsNotNull", "IsNan", "Coalesce", "If", "CaseWhen",
+    "Value", "bind", "AggregateExpression",
+]
+
+Value = Tuple[jax.Array, Optional[jax.Array]]  # (data, valid-or-None)
+
+
+def _and_valid(a: Optional[jax.Array], b: Optional[jax.Array]) -> Optional[jax.Array]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+class Expression:
+    """Base expression node.  Subclasses set ``dtype``/``nullable`` on resolve."""
+
+    dtype: DataType = None  # set by bind()
+    nullable: bool = True
+    children: Tuple["Expression", ...] = ()
+
+    # Accelerator support signature, checked by the planner (TypeChecks.scala
+    # ExprChecks analog).  Default: common non-nested, non-string types.
+    input_sig: TypeSig = TypeSig.device_compute
+    output_sig: TypeSig = TypeSig.device_compute
+
+    def eval(self, ctx: "EvalContext") -> Value:
+        raise NotImplementedError(type(self).__name__)
+
+    # -- resolution ---------------------------------------------------------------
+    def resolved(self) -> bool:
+        return self.dtype is not None and all(c.resolved() for c in self.children)
+
+    def fingerprint(self) -> str:
+        """Stable structural key for the stage-compile cache."""
+        args = ",".join(c.fingerprint() for c in self.children)
+        extra = self._fp_extra()
+        return f"{type(self).__name__}[{extra}]({args})"
+
+    def _fp_extra(self) -> str:
+        return str(self.dtype)
+
+    def references(self) -> set:
+        out = set()
+        for c in self.children:
+            out |= c.references()
+        return out
+
+    def __repr__(self):
+        return self.fingerprint()
+
+
+class EvalContext:
+    """Carries the stage inputs during tracing.
+
+    ``arrays[i]`` is the (data, valid) pair for bound reference ordinal ``i``;
+    ``capacity`` is the padded physical length; ``active`` is the live-row mask
+    (padding + upstream filters), used by aggregates and by ops whose padding
+    lanes could misbehave (division, gathers).
+    """
+
+    def __init__(self, arrays: Sequence[Value], capacity: int,
+                 active: Optional[jax.Array] = None, ansi: bool = False):
+        self.arrays = list(arrays)
+        self.capacity = capacity
+        self.active = active
+        self.ansi = ansi
+
+
+# ---------------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------------
+
+class UnresolvedColumn(Expression):
+    """A by-name column reference produced by the DataFrame API (``col('x')``)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.children = ()
+
+    def resolved(self):
+        return False
+
+    def _fp_extra(self):
+        return self.name
+
+    def references(self):
+        return {self.name}
+
+
+class BoundReference(Expression):
+    def __init__(self, ordinal: int, dtype: DataType, nullable: bool, name: str = ""):
+        self.ordinal = ordinal
+        self.dtype = dtype
+        self.nullable = nullable
+        self.name = name
+        self.children = ()
+
+    def eval(self, ctx: EvalContext) -> Value:
+        return ctx.arrays[self.ordinal]
+
+    def _fp_extra(self):
+        return f"{self.ordinal}:{self.dtype}"
+
+
+class Literal(Expression):
+    def __init__(self, value: Any, dtype: Optional[DataType] = None):
+        self.value = value
+        self.dtype = dtype if dtype is not None else _infer_literal_type(value)
+        self.nullable = value is None
+        self.children = ()
+
+    def eval(self, ctx: EvalContext) -> Value:
+        if self.value is None:
+            data = jnp.zeros((ctx.capacity,), dtype=self.dtype.numpy_dtype)
+            return data, jnp.zeros((ctx.capacity,), dtype=jnp.bool_)
+        data = jnp.full((ctx.capacity,), physical_literal(self.value, self.dtype),
+                        dtype=self.dtype.numpy_dtype)
+        return data, None
+
+    def _fp_extra(self):
+        return f"{self.value!r}:{self.dtype}"
+
+
+def physical_literal(v: Any, dtype: DataType):
+    """Convert a python literal to its physical device representation."""
+    import datetime
+    if dtype.is_decimal:
+        from decimal import Decimal
+        if isinstance(v, Decimal):
+            return int(v.scaleb(dtype.scale).to_integral_value())
+        return int(round(float(v) * 10 ** dtype.scale))
+    if dtype.kind == T.TypeKind.DATE:
+        if isinstance(v, datetime.date):
+            return (v - datetime.date(1970, 1, 1)).days
+        return int(v)
+    if dtype.kind == T.TypeKind.TIMESTAMP:
+        if isinstance(v, datetime.datetime):
+            epoch = datetime.datetime(1970, 1, 1, tzinfo=v.tzinfo)
+            return int((v - epoch).total_seconds() * 1_000_000)
+        return int(v)
+    return v
+
+
+def _infer_literal_type(v: Any) -> DataType:
+    import datetime
+    if v is None:
+        return T.NULLTYPE
+    if isinstance(v, bool):
+        return T.BOOLEAN
+    if isinstance(v, int):
+        return T.INT32 if -(2**31) <= v < 2**31 else T.INT64
+    if isinstance(v, float):
+        return T.FLOAT64
+    if isinstance(v, str):
+        return T.STRING
+    if isinstance(v, datetime.datetime):
+        return T.TIMESTAMP
+    if isinstance(v, datetime.date):
+        return T.DATE
+    if isinstance(v, np.generic):
+        return {np.dtype(np.int32): T.INT32, np.dtype(np.int64): T.INT64,
+                np.dtype(np.float32): T.FLOAT32,
+                np.dtype(np.float64): T.FLOAT64}[v.dtype]
+    raise TypeError(f"cannot infer literal type for {v!r}")
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.name = name
+        self.dtype = child.dtype
+        self.nullable = child.nullable
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+    def _fp_extra(self):
+        return self.name
+
+
+# ---------------------------------------------------------------------------------
+# Cast (numeric subset here; the full GpuCast.scala matrix grows in ops/cast.py)
+# ---------------------------------------------------------------------------------
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: DataType, ansi: bool = False):
+        self.children = (child,)
+        self.dtype = to
+        self.nullable = child.nullable or self._can_produce_null(child.dtype, to)
+        self.ansi = ansi
+
+    @staticmethod
+    def _can_produce_null(src: DataType, dst: DataType) -> bool:
+        return src.is_string  # string->number parse failures become null
+
+    def eval(self, ctx: EvalContext) -> Value:
+        from .ops.cast import cast_value
+        data, valid = self.children[0].eval(ctx)
+        return cast_value(data, valid, self.children[0].dtype, self.dtype,
+                          ansi=self.ansi or ctx.ansi)
+
+    def _fp_extra(self):
+        return f"->{self.dtype}"
+
+
+# ---------------------------------------------------------------------------------
+# Arithmetic (reference: org/apache/spark/sql/rapids/arithmetic.scala)
+# ---------------------------------------------------------------------------------
+
+class BinaryExpression(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+        if left.resolved() and right.resolved():
+            self._resolve()
+
+    def _resolve(self):
+        l, r = self.children
+        self.dtype = self._result_type(l.dtype, r.dtype)
+        self.nullable = l.nullable or r.nullable
+
+    def _result_type(self, lt: DataType, rt: DataType) -> DataType:
+        return T.common_type(lt, rt)
+
+    def _eval_children_promoted(self, ctx) -> Tuple[jax.Array, jax.Array,
+                                                    Optional[jax.Array]]:
+        l, r = self.children
+        ld, lv = l.eval(ctx)
+        rd, rv = r.eval(ctx)
+        ct = self._operand_type()
+        np_dt = ct.numpy_dtype
+        if ld.dtype != np_dt:
+            ld = ld.astype(np_dt)
+        if rd.dtype != np_dt:
+            rd = rd.astype(np_dt)
+        return ld, rd, _and_valid(lv, rv)
+
+    def _operand_type(self) -> DataType:
+        return T.common_type(self.children[0].dtype, self.children[1].dtype)
+
+
+class Add(BinaryExpression):
+    symbol = "+"
+
+    def eval(self, ctx):
+        ld, rd, v = self._eval_children_promoted(ctx)
+        return ld + rd, v
+
+
+class Subtract(BinaryExpression):
+    symbol = "-"
+
+    def eval(self, ctx):
+        ld, rd, v = self._eval_children_promoted(ctx)
+        return ld - rd, v
+
+
+class Multiply(BinaryExpression):
+    symbol = "*"
+
+    def eval(self, ctx):
+        ld, rd, v = self._eval_children_promoted(ctx)
+        if self.dtype.is_decimal:
+            # decimal*decimal doubles the scale; rescale back (round half up).
+            ls = self.children[0].dtype.scale
+            rs = self.children[1].dtype.scale
+            drop = ls + rs - self.dtype.scale
+            prod = ld * rd
+            if drop > 0:
+                prod = _round_div(prod, 10 ** drop)
+            return prod, v
+        return ld * rd, v
+
+    def _result_type(self, lt, rt):
+        if lt.is_decimal and rt.is_decimal:
+            p = min(lt.precision + rt.precision + 1, 18)
+            s = min(lt.scale + rt.scale, p)
+            return T.decimal(p, s)
+        return T.common_type(lt, rt)
+
+
+def _round_div(x: jax.Array, d: int) -> jax.Array:
+    """Integer division rounding half away from zero (Spark decimal rounding)."""
+    sign = jnp.where(x >= 0, 1, -1)
+    return sign * ((jnp.abs(x) + d // 2) // d)
+
+
+class Divide(BinaryExpression):
+    """Spark ``/``: always floating (double) for non-decimal; null on /0."""
+    symbol = "/"
+
+    def _result_type(self, lt, rt):
+        if lt.is_decimal or rt.is_decimal:
+            return T.FLOAT64  # decimal division → double for now (planner notes it)
+        return T.FLOAT64
+
+    def _operand_type(self):
+        return T.FLOAT64
+
+    def eval(self, ctx):
+        ld, rd, v = self._eval_children_promoted(ctx)
+        zero = rd == 0
+        out = ld / jnp.where(zero, 1.0, rd)
+        valid = _and_valid(v, ~zero)
+        return out, valid
+
+
+class IntegralDivide(BinaryExpression):
+    symbol = "div"
+
+    def _result_type(self, lt, rt):
+        return T.INT64
+
+    def _operand_type(self):
+        return T.INT64
+
+    def eval(self, ctx):
+        ld, rd, v = self._eval_children_promoted(ctx)
+        zero = rd == 0
+        safe = jnp.where(zero, 1, rd)
+        q = jnp.sign(ld) * jnp.sign(safe) * (jnp.abs(ld) // jnp.abs(safe))
+        return q.astype(jnp.int64), _and_valid(v, ~zero)
+
+
+class Remainder(BinaryExpression):
+    """Spark ``%``: sign follows the dividend (C semantics), null on %0."""
+    symbol = "%"
+
+    def eval(self, ctx):
+        ld, rd, v = self._eval_children_promoted(ctx)
+        zero = rd == 0
+        safe = jnp.where(zero, 1, rd)
+        r = jnp.sign(ld) * (jnp.abs(ld) % jnp.abs(safe))
+        return r.astype(ld.dtype), _and_valid(v, ~zero)
+
+
+class Pmod(BinaryExpression):
+    symbol = "pmod"
+
+    def eval(self, ctx):
+        ld, rd, v = self._eval_children_promoted(ctx)
+        zero = rd == 0
+        safe = jnp.where(zero, 1, rd)
+        r = jnp.mod(ld, jnp.abs(safe))
+        return r.astype(ld.dtype), _and_valid(v, ~zero)
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        self.dtype = child.dtype
+        self.nullable = child.nullable
+
+    def eval(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        return -d, v
+
+
+class Abs(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        self.dtype = child.dtype
+        self.nullable = child.nullable
+
+    def eval(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        return jnp.abs(d), v
+
+
+# ---------------------------------------------------------------------------------
+# Comparisons & boolean logic (reference: predicates.scala)
+# ---------------------------------------------------------------------------------
+
+class BinaryComparison(BinaryExpression):
+    op: Callable = None
+
+    def _result_type(self, lt, rt):
+        T.common_type(lt, rt)  # raises on incomparable
+        return T.BOOLEAN
+
+    def _operand_type(self):
+        return T.common_type(self.children[0].dtype, self.children[1].dtype)
+
+    def eval(self, ctx):
+        ld, rd, v = self._eval_children_promoted(ctx)
+        return type(self).op(ld, rd), v
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+    op = staticmethod(lambda a, b: a == b)
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+    op = staticmethod(lambda a, b: a < b)
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+    op = staticmethod(lambda a, b: a <= b)
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+    op = staticmethod(lambda a, b: a > b)
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+    op = staticmethod(lambda a, b: a >= b)
+
+
+class EqualNullSafe(BinaryExpression):
+    """``<=>``: nulls compare equal; never returns null."""
+    symbol = "<=>"
+
+    def _resolve(self):
+        super()._resolve()
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def _result_type(self, lt, rt):
+        return T.BOOLEAN
+
+    def eval(self, ctx):
+        l, r = self.children
+        ld, lv = l.eval(ctx)
+        rd, rv = r.eval(ctx)
+        ct = T.common_type(l.dtype, r.dtype).numpy_dtype
+        ld, rd = ld.astype(ct), rd.astype(ct)
+        ln = jnp.zeros_like(ld, dtype=bool) if lv is None else ~lv
+        rn = jnp.zeros_like(rd, dtype=bool) if rv is None else ~rv
+        eq = (ld == rd) & ~ln & ~rn
+        return eq | (ln & rn), None
+
+
+class Not(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        self.dtype = T.BOOLEAN
+        self.nullable = child.nullable
+
+    def eval(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        return ~d, v
+
+
+class And(BinaryExpression):
+    """Kleene AND: F&null=F (predicates.scala GpuAnd)."""
+    symbol = "and"
+
+    def _result_type(self, lt, rt):
+        return T.BOOLEAN
+
+    def eval(self, ctx):
+        ld, lv = self.children[0].eval(ctx)
+        rd, rv = self.children[1].eval(ctx)
+        data = ld & rd
+        if lv is None and rv is None:
+            return data, None
+        lt = ld if lv is None else (ld & lv)   # definitely-true
+        rt_ = rd if rv is None else (rd & rv)
+        lf = (~ld) if lv is None else ((~ld) & lv)  # definitely-false
+        rf = (~rd) if rv is None else ((~rd) & rv)
+        valid = lf | rf | (lt & rt_)
+        return lt & rt_, valid
+
+
+class Or(BinaryExpression):
+    symbol = "or"
+
+    def _result_type(self, lt, rt):
+        return T.BOOLEAN
+
+    def eval(self, ctx):
+        ld, lv = self.children[0].eval(ctx)
+        rd, rv = self.children[1].eval(ctx)
+        if lv is None and rv is None:
+            return ld | rd, None
+        lt = ld if lv is None else (ld & lv)
+        rt_ = rd if rv is None else (rd & rv)
+        valid_l = jnp.ones_like(ld) if lv is None else lv
+        valid_r = jnp.ones_like(rd) if rv is None else rv
+        valid = lt | rt_ | (valid_l & valid_r)
+        return lt | rt_, valid
+
+
+class In(Expression):
+    """``col IN (literals...)`` — unrolled OR of equality tests."""
+
+    def __init__(self, child: Expression, values: Sequence[Any]):
+        self.children = (child,)
+        self.values = tuple(values)
+        self.dtype = T.BOOLEAN
+        self.nullable = child.nullable or any(v is None for v in values)
+
+    def eval(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        hit = jnp.zeros((ctx.capacity,), dtype=bool)
+        for val in self.values:
+            if val is None:
+                continue
+            lit = Literal(val, self.children[0].dtype).eval(ctx)[0]
+            hit = hit | (d == lit)
+        valid = v
+        if any(x is None for x in self.values):
+            # non-matching rows with a null in the list → null
+            miss_null = ~hit
+            valid = _and_valid(valid, ~miss_null | hit)
+        return hit, valid
+
+    def _fp_extra(self):
+        return f"{self.values!r}"
+
+
+class IsNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def eval(self, ctx):
+        _, v = self.children[0].eval(ctx)
+        if v is None:
+            return jnp.zeros((ctx.capacity,), dtype=bool), None
+        return ~v, None
+
+
+class IsNotNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def eval(self, ctx):
+        _, v = self.children[0].eval(ctx)
+        if v is None:
+            return jnp.ones((ctx.capacity,), dtype=bool), None
+        return v, None
+
+
+class IsNan(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def eval(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        nan = jnp.isnan(d) if jnp.issubdtype(d.dtype, jnp.floating) else (
+            jnp.zeros_like(d, dtype=bool))
+        if v is not None:
+            nan = nan & v
+        return nan, None
+
+
+# ---------------------------------------------------------------------------------
+# Conditionals (reference: conditionalExpressions.scala — note the reference
+# does *lazy* side evaluation; under XLA both sides trace and fuse, and
+# ``jnp.where`` selects, which is the right model for a vector machine).
+# ---------------------------------------------------------------------------------
+
+class If(Expression):
+    def __init__(self, pred: Expression, then: Expression, other: Expression):
+        self.children = (pred, then, other)
+        if then.resolved() and other.resolved():
+            self.dtype = T.common_type(then.dtype, other.dtype)
+            self.nullable = pred.nullable or then.nullable or other.nullable
+
+    def eval(self, ctx):
+        p, pv = self.children[0].eval(ctx)
+        td, tv = self.children[1].eval(ctx)
+        ed, ev = self.children[2].eval(ctx)
+        np_dt = self.dtype.numpy_dtype
+        td, ed = td.astype(np_dt), ed.astype(np_dt)
+        cond = p if pv is None else (p & pv)  # null predicate → else branch
+        data = jnp.where(cond, td, ed)
+        if tv is None and ev is None:
+            valid = None
+        else:
+            tvv = tv if tv is not None else jnp.ones_like(cond)
+            evv = ev if ev is not None else jnp.ones_like(cond)
+            valid = jnp.where(cond, tvv, evv)
+        return data, valid
+
+
+class CaseWhen(Expression):
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 otherwise: Optional[Expression] = None):
+        flat: List[Expression] = []
+        for c, v in branches:
+            flat += [c, v]
+        if otherwise is not None:
+            flat.append(otherwise)
+        self.branches = list(branches)
+        self.otherwise = otherwise
+        self.children = tuple(flat)
+        vals = [v for _, v in branches] + ([otherwise] if otherwise else [])
+        if all(v.resolved() for v in vals):
+            dt = vals[0].dtype
+            for v in vals[1:]:
+                dt = T.common_type(dt, v.dtype)
+            self.dtype = dt
+            self.nullable = (otherwise is None) or any(v.nullable for v in vals) \
+                or any(c.nullable for c, _ in branches)
+
+    def eval(self, ctx):
+        np_dt = self.dtype.numpy_dtype
+        if self.otherwise is not None:
+            data, valid = self.otherwise.eval(ctx)
+            data = data.astype(np_dt)
+        else:
+            data = jnp.zeros((ctx.capacity,), dtype=np_dt)
+            valid = jnp.zeros((ctx.capacity,), dtype=bool)
+        # Iterate branches last-to-first so the first matching branch wins.
+        out_d, out_v = data, valid
+        for cond_e, val_e in reversed(self.branches):
+            cd, cv = cond_e.eval(ctx)
+            c = cd if cv is None else (cd & cv)
+            vd, vv = val_e.eval(ctx)
+            vd = vd.astype(np_dt)
+            out_d = jnp.where(c, vd, out_d)
+            if vv is None and out_v is None:
+                out_v = None
+            else:
+                vvv = vv if vv is not None else jnp.ones_like(c)
+                ovv = out_v if out_v is not None else jnp.ones_like(c)
+                out_v = jnp.where(c, vvv, ovv)
+        return out_d, out_v
+
+
+class Coalesce(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+        if all(c.resolved() for c in children):
+            dt = children[0].dtype
+            for c in children[1:]:
+                dt = T.common_type(dt, c.dtype)
+            self.dtype = dt
+            self.nullable = all(c.nullable for c in children)
+
+    def eval(self, ctx):
+        np_dt = self.dtype.numpy_dtype
+        out_d = jnp.zeros((ctx.capacity,), dtype=np_dt)
+        out_v = jnp.zeros((ctx.capacity,), dtype=bool)
+        for c in reversed(self.children):
+            d, v = c.eval(ctx)
+            d = d.astype(np_dt)
+            if v is None:
+                out_d, out_v = d, jnp.ones((ctx.capacity,), dtype=bool)
+            else:
+                out_d = jnp.where(v, d, out_d)
+                out_v = out_v | v
+        return out_d, (None if not self.nullable else out_v)
+
+
+# ---------------------------------------------------------------------------------
+# Aggregates are *declared* here; their device implementation lives in
+# ops/groupby.py and the aggregate exec (reference: AggregateFunctions.scala).
+# ---------------------------------------------------------------------------------
+
+class AggregateExpression(Expression):
+    """Marker base: func name + child; update/merge handled by the agg exec."""
+
+    func: str = "?"
+
+    def __init__(self, child: Optional[Expression]):
+        self.children = (child,) if child is not None else ()
+        if child is not None and child.resolved():
+            self._resolve()
+
+    def _resolve(self):
+        c = self.children[0]
+        self.dtype = c.dtype
+        self.nullable = True
+
+    def _fp_extra(self):
+        return f"{self.func}:{self.dtype}"
+
+
+# ---------------------------------------------------------------------------------
+# Binding: resolve UnresolvedColumn against a schema, rebuilding the tree.
+# ---------------------------------------------------------------------------------
+
+def bind(expr: Expression, schema) -> Expression:
+    """Return a copy of ``expr`` with columns bound to ordinals and types set."""
+    from .batch import Schema  # noqa: F401  (typing only)
+    if isinstance(expr, UnresolvedColumn):
+        idx = schema.index_of(expr.name)
+        f = schema.fields[idx]
+        return BoundReference(idx, f.dtype, f.nullable, f.name)
+    if not expr.children:
+        return expr
+    new_children = tuple(bind(c, schema) for c in expr.children)
+    return _rebuild(expr, new_children)
+
+
+def _rebuild(expr: Expression, children: Tuple[Expression, ...]) -> Expression:
+    import copy
+    node = copy.copy(expr)
+    node.children = children
+    if isinstance(node, Alias):
+        node.dtype = children[0].dtype
+        node.nullable = children[0].nullable
+    elif isinstance(node, BinaryExpression):
+        node._resolve()
+    elif isinstance(node, (UnaryMinus, Abs)):
+        node.dtype = children[0].dtype
+        node.nullable = children[0].nullable
+    elif isinstance(node, (Not,)):
+        node.nullable = children[0].nullable
+    elif isinstance(node, If):
+        node.dtype = T.common_type(children[1].dtype, children[2].dtype)
+        node.nullable = any(c.nullable for c in children)
+    elif isinstance(node, CaseWhen):
+        n = len(node.branches)
+        node.branches = [(children[2 * i], children[2 * i + 1]) for i in range(n)]
+        node.otherwise = children[2 * n] if len(children) > 2 * n else None
+        vals = [v for _, v in node.branches] + (
+            [node.otherwise] if node.otherwise else [])
+        dt = vals[0].dtype
+        for v in vals[1:]:
+            dt = T.common_type(dt, v.dtype)
+        node.dtype = dt
+        node.nullable = (node.otherwise is None) or any(v.nullable for v in vals)
+    elif isinstance(node, Coalesce):
+        dt = children[0].dtype
+        for c in children[1:]:
+            dt = T.common_type(dt, c.dtype)
+        node.dtype = dt
+        node.nullable = all(c.nullable for c in children)
+    elif isinstance(node, AggregateExpression):
+        node._resolve()
+    elif isinstance(node, (IsNull, IsNotNull, IsNan)):
+        pass
+    elif isinstance(node, In):
+        node.nullable = children[0].nullable or any(
+            v is None for v in node.values)
+    elif isinstance(node, Cast):
+        node.nullable = children[0].nullable or Cast._can_produce_null(
+            children[0].dtype, node.dtype)
+    elif hasattr(node, "_rebind"):
+        node._rebind()
+    return node

@@ -1,0 +1,2 @@
+"""I/O layer: file-format readers/writers (Arrow-based host parse, device
+upload at the scan boundary — the GpuParquetScan.scala pattern)."""

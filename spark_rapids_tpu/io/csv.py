@@ -1,0 +1,46 @@
+"""CSV scan source (GpuCSVScan.scala:205 analog — host line framing + parse
+via Arrow, device upload at the scan exec)."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from ..batch import Field, Schema, _arrow_to_logical, logical_to_arrow
+
+__all__ = ["csv_source"]
+
+
+def csv_source(path, schema: Optional[Schema] = None, header: bool = True,
+               sep: str = ",", batch_rows: int = 1 << 20
+               ) -> Tuple[Schema, Callable[[], Iterator]]:
+    import pyarrow.csv as pacsv
+    from .parquet import expand_paths
+    paths = expand_paths(path) if not str(path).endswith(".csv") else (
+        expand_paths(path))
+    if not paths:
+        raise FileNotFoundError(f"no csv files match {path!r}")
+
+    convert_opts = None
+    read_opts = pacsv.ReadOptions(autogenerate_column_names=not header)
+    parse_opts = pacsv.ParseOptions(delimiter=sep)
+    if schema is not None:
+        convert_opts = pacsv.ConvertOptions(
+            column_types={f.name: logical_to_arrow(f.dtype) for f in schema})
+
+    if schema is None:
+        t = pacsv.read_csv(paths[0], read_options=read_opts,
+                           parse_options=parse_opts)
+        schema = Schema([Field(n, _arrow_to_logical(ty), True)
+                         for n, ty in zip(t.column_names, t.schema.types)])
+
+    out_schema = schema
+
+    def factory() -> Iterator:
+        for p in paths:
+            table = pacsv.read_csv(p, read_options=read_opts,
+                                   parse_options=parse_opts,
+                                   convert_options=convert_opts)
+            for off in range(0, table.num_rows, batch_rows):
+                yield table.slice(off, min(batch_rows, table.num_rows - off))
+
+    return out_schema, factory

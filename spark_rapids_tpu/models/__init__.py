@@ -1,0 +1,2 @@
+"""Benchmark workloads: TPC-H / TPC-DS-style query families and the mortgage
+ETL analog (the reference's integration_tests mortgage + NDS harness role)."""

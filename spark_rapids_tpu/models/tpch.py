@@ -1,0 +1,121 @@
+"""TPC-H-shaped data generation and queries.
+
+Deterministic, seeded lineitem generator (the datagen/ module analog —
+SURVEY.md §2.10) plus query definitions used by bench.py and the scale tests.
+Schema follows the TPC-H spec columns needed by Q1/Q6 with Spark types
+(decimal money represented as float64 here; exact-decimal variant uses
+decimal(12,2) → scaled int64 on device).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Optional
+
+import numpy as np
+
+LINEITEM_ROWS_PER_SF = 6_001_215
+
+
+def gen_lineitem(sf: float, out_dir: str, seed: int = 19920101,
+                 rows: Optional[int] = None, chunk: int = 1_000_000) -> str:
+    """Write a lineitem-shaped parquet dataset; returns the file path."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = rows if rows is not None else int(LINEITEM_ROWS_PER_SF * sf)
+    path = os.path.join(out_dir, f"lineitem_sf{sf}_{n}.parquet")
+    if os.path.exists(path):
+        return path
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    writer = None
+    base = np.datetime64("1992-01-01")
+    for off in range(0, n, chunk):
+        m = min(chunk, n - off)
+        qty = rng.integers(1, 51, m).astype(np.float64)
+        price = np.round(rng.uniform(900.0, 105000.0, m), 2)
+        disc = rng.integers(0, 11, m).astype(np.float64) / 100.0
+        tax = rng.integers(0, 9, m).astype(np.float64) / 100.0
+        ship = base + rng.integers(0, 2526, m).astype("timedelta64[D]")
+        rflag = rng.choice(np.array(["A", "N", "R"]), m)
+        status = rng.choice(np.array(["O", "F"]), m)
+        okey = rng.integers(1, max(2, n // 4), m).astype(np.int64)
+        pkey = rng.integers(1, 200_001, m).astype(np.int64)
+        skey = rng.integers(1, 10_001, m).astype(np.int64)
+        tbl = pa.table({
+            "l_orderkey": okey,
+            "l_partkey": pkey,
+            "l_suppkey": skey,
+            "l_quantity": qty,
+            "l_extendedprice": price,
+            "l_discount": disc,
+            "l_tax": tax,
+            "l_returnflag": rflag,
+            "l_linestatus": status,
+            "l_shipdate": pa.array(ship, type=pa.date32()),
+        })
+        if writer is None:
+            writer = pq.ParquetWriter(path, tbl.schema)
+        writer.write_table(tbl)
+    if writer is not None:
+        writer.close()
+    return path
+
+
+def q6(df):
+    """TPC-H Q6: scan → filter → SUM(price*discount) (BASELINE configs[0])."""
+    from ..sql import functions as F
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    return (df.where((F.col("l_shipdate") >= lo) & (F.col("l_shipdate") < hi)
+                     & (F.col("l_discount") >= 0.05)
+                     & (F.col("l_discount") <= 0.07)
+                     & (F.col("l_quantity") < 24))
+              .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
+                   .alias("revenue")))
+
+
+def q1(df, delta_days: int = 90):
+    """TPC-H Q1: the group-by/agg heavy pricing summary report."""
+    from ..sql import functions as F
+    cutoff = datetime.date(1998, 12, 1) - datetime.timedelta(days=delta_days)
+    disc_price = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    charge = disc_price * (1 + F.col("l_tax"))
+    return (df.where(F.col("l_shipdate") <= cutoff)
+              .group_by("l_returnflag", "l_linestatus")
+              .agg(F.sum(F.col("l_quantity")).alias("sum_qty"),
+                   F.sum(F.col("l_extendedprice")).alias("sum_base_price"),
+                   F.sum(disc_price).alias("sum_disc_price"),
+                   F.sum(charge).alias("sum_charge"),
+                   F.avg(F.col("l_quantity")).alias("avg_qty"),
+                   F.avg(F.col("l_extendedprice")).alias("avg_price"),
+                   F.avg(F.col("l_discount")).alias("avg_disc"),
+                   F.count_star().alias("count_order"))
+              .sort("l_returnflag", "l_linestatus"))
+
+
+def q6_pandas(pdf):
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    m = ((pdf.l_shipdate >= lo) & (pdf.l_shipdate < hi)
+         & (pdf.l_discount >= 0.05) & (pdf.l_discount <= 0.07)
+         & (pdf.l_quantity < 24))
+    return float((pdf.l_extendedprice[m] * pdf.l_discount[m]).sum())
+
+
+def q1_pandas(pdf, delta_days: int = 90):
+    cutoff = datetime.date(1998, 12, 1) - datetime.timedelta(days=delta_days)
+    sub = pdf[pdf.l_shipdate <= cutoff].copy()
+    sub["disc_price"] = sub.l_extendedprice * (1 - sub.l_discount)
+    sub["charge"] = sub.disc_price * (1 + sub.l_tax)
+    g = sub.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "size"),
+    ).reset_index().sort_values(["l_returnflag", "l_linestatus"])
+    return g

@@ -1,0 +1,7 @@
+"""Device kernel layer: JAX/XLA (and later Pallas) implementations of the
+columnar primitives the reference gets from libcudf (SURVEY.md §2.9).
+
+Everything here is shape-static and jit-safe: functions take capacity-padded
+arrays plus masks and return the same, so they trace into the enclosing
+stage's single XLA computation.
+"""

@@ -1,0 +1,165 @@
+"""Batch-level utilities: concat, compact, slice — the cuDF ``Table.concat``/
+``contiguousSplit`` analogs (used by GpuCoalesceBatches.scala and
+GpuPartitioning.scala in the reference).
+
+Concat is sync-free: capacities are static so the result shape is known
+without reading device data; the selection masks ride along.  Compaction
+(gathering live rows to the front) is the one place a device→host sync may
+happen, because the new ``num_rows`` must become a static Python int — the
+same boundary where the reference synchronizes to build output batches.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch import ColumnBatch, DeviceColumn, HostStringColumn, Schema, bucket_capacity
+
+__all__ = ["concat_batches", "compact", "slice_batch", "gather"]
+
+
+def _pad_dev(arr: jax.Array, cap: int):
+    if arr.shape[0] == cap:
+        return arr
+    return jnp.pad(arr, (0, cap - arr.shape[0]))
+
+
+def concat_batches(batches: Sequence[ColumnBatch],
+                   min_capacity: int = 1024) -> ColumnBatch:
+    """Concatenate batches (same schema) without compacting or syncing."""
+    assert batches, "cannot concat zero batches"
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    total = sum(b.capacity for b in batches)
+    cap = bucket_capacity(total, min_capacity)
+    cols = []
+    for ci, f in enumerate(schema):
+        parts = [b.columns[ci] for b in batches]
+        if isinstance(parts[0], HostStringColumn):
+            import pyarrow as pa
+            # host strings: compact each side on host (strings sync anyway)
+            arrs = []
+            for b, p in zip(batches, parts):
+                a = p.array.slice(0, b.num_rows)
+                if b.sel is not None:
+                    m = np.asarray(b.active_mask())[: b.num_rows]
+                    a = a.filter(pa.array(m))
+                arrs.append(a)
+            cat = pa.concat_arrays(arrs)
+            # host columns must align with device capacity: pad with nulls
+            if len(cat) < cap:
+                cat = pa.concat_arrays(
+                    [cat, pa.nulls(cap - len(cat), type=cat.type)])
+            cols.append(HostStringColumn(cat))
+            continue
+        data = jnp.concatenate([p.data for p in parts])
+        data = _pad_dev(data, cap)
+        if any(p.valid is not None for p in parts):
+            valid = jnp.concatenate([
+                p.valid if p.valid is not None
+                else jnp.ones((b.capacity,), dtype=bool)
+                for b, p in zip(batches, parts)])
+            valid = _pad_dev(valid, cap)
+        else:
+            valid = None
+        cols.append(DeviceColumn(f.dtype, data, valid))
+    # selection: each batch contributes its active mask at its offset
+    sels = [b.active_mask() for b in batches]
+    sel = _pad_dev(jnp.concatenate(sels), cap)
+    has_strings = any(isinstance(c, HostStringColumn) for c in cols)
+    if has_strings:
+        # host strings were compacted; device columns were not — mixed batches
+        # must compact device side too for row alignment.
+        out = ColumnBatch(schema, [c for c in cols], total, sel)
+        return compact(out, align_host_strings=True)
+    return ColumnBatch(schema, cols, total, sel)
+
+
+def gather(batch: ColumnBatch, indices: jax.Array, num_rows: int,
+           sel: Optional[jax.Array] = None) -> ColumnBatch:
+    """Row-gather into a new batch (indices beyond num_rows are padding)."""
+    cols = []
+    host_idx = None
+    for f, c in zip(batch.schema, batch.columns):
+        if isinstance(c, HostStringColumn):
+            if host_idx is None:
+                host_idx = np.asarray(indices)
+            import pyarrow as pa
+            taken = c.array.take(pa.array(np.clip(host_idx, 0, c.capacity - 1),
+                                          type=pa.int32()))
+            cols.append(HostStringColumn(taken))
+        else:
+            data = c.data[indices]
+            valid = c.valid[indices] if c.valid is not None else None
+            cols.append(DeviceColumn(f.dtype, data, valid))
+    return ColumnBatch(batch.schema, cols, num_rows, sel)
+
+
+def compact(batch: ColumnBatch, align_host_strings: bool = False) -> ColumnBatch:
+    """Gather live rows to the front; drops the selection mask.
+
+    Syncs once to learn the live-row count (static for downstream planning).
+    """
+    if batch.sel is None and not align_host_strings:
+        return batch
+    active = batch.active_mask()
+    n_live = int(jnp.sum(active))
+    # stable partition: sort by (!active) keeps live rows in order at front
+    perm = jnp.lexsort((jnp.arange(batch.capacity, dtype=jnp.int32), ~active))
+    new_cap = bucket_capacity(n_live)
+    perm_trunc = perm[:new_cap] if new_cap <= batch.capacity else jnp.pad(
+        perm, (0, new_cap - batch.capacity))
+    cols = []
+    for f, c in zip(batch.schema, batch.columns):
+        if isinstance(c, HostStringColumn):
+            if align_host_strings:
+                # already compacted during concat; just repad to new capacity
+                import pyarrow as pa
+                a = c.array.slice(0, n_live)
+                if len(a) < new_cap:
+                    a = pa.concat_arrays(
+                        [a.combine_chunks() if hasattr(a, "combine_chunks") else a,
+                         pa.nulls(new_cap - len(a), type=a.type)])
+                cols.append(HostStringColumn(a))
+            else:
+                import pyarrow as pa
+                m = np.asarray(active)
+                a = c.array.filter(pa.array(m))
+                if len(a) < new_cap:
+                    a = pa.concat_arrays([a, pa.nulls(new_cap - len(a), type=a.type)])
+                cols.append(HostStringColumn(a))
+            continue
+        data = c.data[perm_trunc]
+        valid = c.valid[perm_trunc] if c.valid is not None else None
+        cols.append(DeviceColumn(f.dtype, data, valid))
+    return ColumnBatch(batch.schema, cols, n_live)
+
+
+def slice_batch(batch: ColumnBatch, start: int, length: int) -> ColumnBatch:
+    """Static host-side slice (rows must be compact — no selection mask)."""
+    assert batch.sel is None, "slice requires a compacted batch"
+    cap = bucket_capacity(length)
+    cols = []
+    for f, c in zip(batch.schema, batch.columns):
+        if isinstance(c, HostStringColumn):
+            a = c.array.slice(start, length)
+            import pyarrow as pa
+            if len(a) < cap:
+                a = pa.concat_arrays([a.combine_chunks() if isinstance(
+                    a, pa.ChunkedArray) else a, pa.nulls(cap - len(a), type=a.type)])
+            cols.append(HostStringColumn(a))
+        else:
+            data = jax.lax.dynamic_slice_in_dim(c.data, start, min(
+                length, c.capacity - start))
+            data = _pad_dev(data, cap)
+            valid = None
+            if c.valid is not None:
+                valid = _pad_dev(jax.lax.dynamic_slice_in_dim(
+                    c.valid, start, min(length, c.capacity - start)), cap)
+            cols.append(DeviceColumn(f.dtype, data, valid))
+    return ColumnBatch(batch.schema, cols, length)

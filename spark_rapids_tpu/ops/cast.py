@@ -1,0 +1,124 @@
+"""The type-cast matrix (device subset).
+
+TPU-native analog of GpuCast.scala (reference, 1,568 LoC: every Spark
+src→dst cast incl. ANSI overflow checks).  This module covers the casts that
+lower to XLA; string-involved casts route to the CPU fallback path until the
+device string kernels land (the planner's TypeSig enforces that).
+
+Spark semantics implemented here:
+  * numeric → narrower integral: wraparound in legacy mode; ANSI raises
+    (represented as invalid rows + deferred error check).
+  * float → integral: NaN → null is *not* Spark behavior — Spark overflows to
+    Long.Min/Max etc. in legacy mode; ANSI raises.  We clamp like Spark's
+    legacy cast (float NaN → 0? No: Spark casts NaN to 0 for int casts).
+  * numeric → boolean: v != 0.
+  * date/timestamp conversions: day ↔ microsecond arithmetic, UTC.
+  * decimal rescaling with half-up rounding; overflow → null (legacy) /
+    error (ANSI).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..types import DataType
+
+Value = Tuple[jax.Array, Optional[jax.Array]]
+
+_INT_BOUNDS = {
+    T.TypeKind.INT8: (-(2 ** 7), 2 ** 7 - 1),
+    T.TypeKind.INT16: (-(2 ** 15), 2 ** 15 - 1),
+    T.TypeKind.INT32: (-(2 ** 31), 2 ** 31 - 1),
+    T.TypeKind.INT64: (-(2 ** 63), 2 ** 63 - 1),
+}
+
+MICROS_PER_DAY = 86_400_000_000
+
+
+def _and(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def cast_value(data: jax.Array, valid: Optional[jax.Array],
+               src: DataType, dst: DataType, ansi: bool = False) -> Value:
+    if src == dst:
+        return data, valid
+    if src.kind == T.TypeKind.NULL:
+        return (jnp.zeros_like(data, dtype=dst.numpy_dtype),
+                jnp.zeros(data.shape, dtype=bool))
+
+    # ---- to boolean ----------------------------------------------------------
+    if dst.kind == T.TypeKind.BOOLEAN:
+        if src.is_numeric and not src.is_decimal:
+            return data != 0, valid
+
+    # ---- numeric → numeric ---------------------------------------------------
+    if src.is_numeric and dst.is_numeric and not src.is_decimal and not dst.is_decimal:
+        if dst.is_integral and src.is_floating:
+            # Spark legacy: NaN→0, clamps at int bounds via overflow wrap? Spark
+            # actually truncates toward zero and wraps like a JVM (long) cast;
+            # match JVM: NaN→0, +-inf / out-of-range → Long.Max/Min then narrow.
+            lo, hi = _INT_BOUNDS[dst.kind]
+            d = jnp.nan_to_num(data, nan=0.0, posinf=float(hi), neginf=float(lo))
+            d = jnp.clip(jnp.trunc(d), float(lo), float(hi))
+            return d.astype(dst.numpy_dtype), valid
+        if dst.is_integral and src.is_integral:
+            # narrowing wraps (legacy); ANSI overflow → null+error row
+            out = data.astype(dst.numpy_dtype)
+            if ansi and _INT_BOUNDS[dst.kind][1] < _INT_BOUNDS[src.kind][1]:
+                lo, hi = _INT_BOUNDS[dst.kind]
+                ok = (data >= lo) & (data <= hi)
+                return out, _and(valid, ok)
+            return out, valid
+        return data.astype(dst.numpy_dtype), valid
+
+    # ---- decimal ↔ numeric ---------------------------------------------------
+    if src.is_decimal and dst.is_floating:
+        return (data.astype(dst.numpy_dtype) / (10.0 ** src.scale)), valid
+    if src.is_decimal and dst.is_integral:
+        q = data // (10 ** src.scale)
+        return q.astype(dst.numpy_dtype), valid
+    if src.is_integral and dst.is_decimal:
+        scaled = data.astype(jnp.int64) * (10 ** dst.scale)
+        max_unscaled = 10 ** dst.precision
+        ok = jnp.abs(scaled) < max_unscaled
+        return scaled, _and(valid, ok)
+    if src.is_floating and dst.is_decimal:
+        scaled = jnp.round(data * (10.0 ** dst.scale))
+        ok = jnp.isfinite(data) & (jnp.abs(scaled) < float(10 ** dst.precision))
+        return scaled.astype(jnp.int64), _and(valid, ok)
+    if src.is_decimal and dst.is_decimal:
+        dscale = dst.scale - src.scale
+        if dscale >= 0:
+            out = data * (10 ** dscale)
+        else:
+            d = 10 ** (-dscale)
+            sign = jnp.where(data >= 0, 1, -1)
+            out = sign * ((jnp.abs(data) + d // 2) // d)
+        ok = jnp.abs(out) < 10 ** dst.precision
+        return out, _and(valid, ok)
+
+    # ---- datetime ------------------------------------------------------------
+    if src.kind == T.TypeKind.DATE and dst.kind == T.TypeKind.TIMESTAMP:
+        return data.astype(jnp.int64) * MICROS_PER_DAY, valid
+    if src.kind == T.TypeKind.TIMESTAMP and dst.kind == T.TypeKind.DATE:
+        return jnp.floor_divide(data, MICROS_PER_DAY).astype(jnp.int32), valid
+    if src.kind == T.TypeKind.DATE and dst.is_integral:
+        return data.astype(dst.numpy_dtype), valid
+    if src.kind == T.TypeKind.TIMESTAMP and dst.kind == T.TypeKind.INT64:
+        return jnp.floor_divide(data, 1_000_000), valid  # seconds, Spark semantics
+    if src.is_integral and dst.kind == T.TypeKind.TIMESTAMP:
+        return data.astype(jnp.int64) * 1_000_000, valid
+    if src.kind == T.TypeKind.BOOLEAN and dst.is_numeric:
+        return data.astype(dst.numpy_dtype), valid
+
+    raise TypeError(f"device cast {src} -> {dst} not implemented "
+                    f"(planner should have routed this to CPU)")

@@ -1,0 +1,230 @@
+"""Sort-based group-by reduction kernels.
+
+The reference does hash-based group-by through cuDF (aggregate.scala:376
+``performGroupByAggregation``).  Device hash tables are a poor fit for
+XLA/TPU, so grouping here is sort-based (SURVEY.md §7.3): lexsort rows by key,
+mark segment starts where adjacent keys differ, then reduce with XLA segment
+ops.  Everything is static-shape: a batch of capacity C reduces to a batch of
+capacity C with ``n_groups`` live rows up front — no dynamic allocation, one
+compiled executable per capacity bucket.
+
+Float keys are grouped through a monotonic *sortable integer view* so that
+NaN == NaN and -0.0 == 0.0 for grouping purposes (Spark normalizes these —
+NormalizeFloatingNumbers.scala).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..types import DataType
+
+Value = Tuple[jax.Array, Optional[jax.Array]]
+
+_SENTINELS = {
+    "min": {
+        "i": lambda dt: np.iinfo(dt).max,
+        "f": lambda dt: np.inf,
+        "b": lambda dt: True,
+    },
+    "max": {
+        "i": lambda dt: np.iinfo(dt).min,
+        "f": lambda dt: -np.inf,
+        "b": lambda dt: False,
+    },
+}
+
+
+def sortable_view(data: jax.Array) -> jax.Array:
+    """Monotonic integer view of a column for sorting/grouping.
+
+    Floats map to sign-flipped integer bit patterns: total order with all
+    NaNs collapsing to one bucket at the top; -0.0 normalized to +0.0.
+    """
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        if data.dtype == jnp.float16:
+            data = data.astype(jnp.float32)
+        data = jnp.where(data == 0.0, jnp.zeros_like(data), data)  # -0.0 → +0.0
+        nan = jnp.isnan(data)
+        ibits = jnp.int32 if data.dtype == jnp.float32 else jnp.int64
+        bits = jax.lax.bitcast_convert_type(data, ibits)
+        # signed total-order key: non-negative floats keep their bits
+        # (monotonic, positive); negative floats map to MIN - bits, which is
+        # negative and increases as the float increases toward zero.
+        imin = jnp.iinfo(ibits).min
+        iview = jnp.where(bits < 0, imin - bits, bits)
+        big = jnp.iinfo(ibits).max
+        return jnp.where(nan, big, iview)  # all NaNs: one group, sorts last
+    if data.dtype == jnp.bool_:
+        return data.astype(jnp.int32)
+    return data
+
+
+def _null_order_key(valid: Optional[jax.Array], capacity: int) -> jax.Array:
+    # Grouping treats null as its own group; order nulls first (arbitrary but
+    # stable).  valid=False (null) sorts before valid=True.
+    if valid is None:
+        return jnp.ones((capacity,), dtype=jnp.int32)
+    return valid.astype(jnp.int32)
+
+
+def sort_indices_for_keys(keys: Sequence[Value], active: jax.Array,
+                          descending: Optional[Sequence[bool]] = None,
+                          nulls_first: Optional[Sequence[bool]] = None) -> jax.Array:
+    """Stable sort permutation: active rows first, ordered by keys.
+
+    ``keys`` are (data, valid) pairs; inactive (filtered/padding) rows sort to
+    the end regardless of key value.
+    """
+    capacity = active.shape[0]
+    arrays = []
+    n = len(keys)
+    desc = list(descending) if descending is not None else [False] * n
+    nf = list(nulls_first) if nulls_first is not None else [True] * n
+    # jnp.lexsort sorts by the LAST key first; build minor→major.
+    for i in reversed(range(n)):
+        data, valid = keys[i]
+        view = sortable_view(data)
+        if desc[i]:
+            view = ~view  # bitwise complement: monotonic flip without overflow
+        vkey = _null_order_key(valid, capacity)
+        # null position: null indicator 0 sorts first under ascending
+        # (nulls_first); flip the indicator for nulls_last.
+        if not nf[i]:
+            vkey = 1 - vkey
+        arrays.append(view)
+        arrays.append(vkey)
+    arrays.append(~active)  # most significant: active rows (False) first
+    return jnp.lexsort(tuple(arrays))
+
+
+def _segment_starts(sorted_keys: Sequence[Value], sorted_active: jax.Array) -> jax.Array:
+    """Boolean mask: row begins a new group (active rows only)."""
+    capacity = sorted_active.shape[0]
+    first = jnp.zeros((capacity,), dtype=bool).at[0].set(True)
+    diff = jnp.zeros((capacity,), dtype=bool)
+    for data, valid in sorted_keys:
+        view = sortable_view(data)
+        prev = jnp.roll(view, 1)
+        d = view != prev
+        if valid is not None:
+            pv = jnp.roll(valid, 1)
+            d = d | (valid != pv)
+            # two nulls are the same group regardless of payload values
+            d = jnp.where(~valid & ~pv, False, d)
+        diff = diff | d
+    starts = (first | diff) & sorted_active
+    return starts
+
+
+def _reduce_segment(data: jax.Array, valid: Optional[jax.Array], op: str,
+                    seg_ids: jax.Array, mask: jax.Array, num_segments: int,
+                    seg_start: jax.Array, seg_last: jax.Array) -> Value:
+    """Reduce one (sorted) contribution column into per-segment slots."""
+    m = mask if valid is None else (mask & valid)
+    if op == "sum":
+        contrib = jnp.where(m, data, jnp.zeros_like(data))
+        out = jax.ops.segment_sum(contrib, seg_ids, num_segments=num_segments)
+        return out, None
+    if op in ("min", "max"):
+        kind = ("f" if jnp.issubdtype(data.dtype, jnp.floating)
+                else "b" if data.dtype == jnp.bool_ else "i")
+        sentinel = _SENTINELS[op][kind](data.dtype)
+        contrib = jnp.where(m, data, jnp.full_like(data, sentinel))
+        f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        out = f(contrib, seg_ids, num_segments=num_segments)
+        return out, None
+    if op == "first":
+        pick = seg_start & mask
+        contrib = jnp.where(pick, data, jnp.zeros_like(data))
+        out = jax.ops.segment_sum(contrib, seg_ids, num_segments=num_segments)
+        v = None
+        if valid is not None:
+            vout = jax.ops.segment_sum(
+                jnp.where(pick, valid, False).astype(jnp.int32), seg_ids,
+                num_segments=num_segments)
+            v = vout > 0
+        return out, v
+    if op == "last":
+        pick = seg_last & mask
+        contrib = jnp.where(pick, data, jnp.zeros_like(data))
+        out = jax.ops.segment_sum(contrib, seg_ids, num_segments=num_segments)
+        v = None
+        if valid is not None:
+            vout = jax.ops.segment_sum(
+                jnp.where(pick, valid, False).astype(jnp.int32), seg_ids,
+                num_segments=num_segments)
+            v = vout > 0
+        return out, v
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def group_reduce(keys: List[Value], contributions: List[Tuple[Value, str]],
+                 active: jax.Array):
+    """Group rows by ``keys`` and reduce ``contributions``.
+
+    Returns (out_keys, out_values, n_groups, group_mask) where every output
+    array has the input capacity, live group rows packed at the front, and
+    ``n_groups`` is a device scalar (int32).
+    """
+    capacity = active.shape[0]
+    perm = sort_indices_for_keys(keys, active)
+    s_active = active[perm]
+    s_keys = [(d[perm], (v[perm] if v is not None else None)) for d, v in keys]
+    seg_start = _segment_starts(s_keys, s_active)
+    seg_ids = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    # Inactive rows (sorted to the end) inherit the running segment id; park
+    # them in the last slot instead so they cannot pollute a real group.
+    seg_ids = jnp.where(s_active, seg_ids, capacity - 1)
+    boundary = jnp.roll(seg_start, -1).at[-1].set(True)
+    seg_last = (boundary | jnp.roll(~s_active, -1).at[-1].set(True)) & s_active
+
+    n_groups = jnp.sum(seg_start.astype(jnp.int32))
+    out_keys: List[Value] = []
+    for (d, v), (sd, sv) in zip(keys, s_keys):
+        kd, _ = _reduce_segment(sd, None, "first", seg_ids, s_active,
+                                capacity, seg_start, seg_last)
+        if sv is not None:
+            kv, _ = _reduce_segment(sv.astype(jnp.int32), None, "first", seg_ids,
+                                    s_active, capacity, seg_start, seg_last)
+            out_keys.append((kd, kv > 0))
+        else:
+            out_keys.append((kd, None))
+    out_vals: List[Value] = []
+    for (d, v), op in contributions:
+        sd = d[perm]
+        sv = v[perm] if v is not None else None
+        out_vals.append(_reduce_segment(sd, sv, op, seg_ids, s_active,
+                                        capacity, seg_start, seg_last))
+    group_mask = jnp.arange(capacity, dtype=jnp.int32) < n_groups
+    return out_keys, out_vals, n_groups, group_mask
+
+
+def ungrouped_reduce(contributions: List[Tuple[Value, str]], active: jax.Array):
+    """Whole-batch (no keys) reduction → one scalar per contribution."""
+    outs: List[Value] = []
+    for (d, v), op in contributions:
+        m = active if v is None else (active & v)
+        if op == "sum":
+            outs.append((jnp.sum(jnp.where(m, d, jnp.zeros_like(d))), None))
+        elif op in ("min", "max"):
+            kind = ("f" if jnp.issubdtype(d.dtype, jnp.floating)
+                    else "b" if d.dtype == jnp.bool_ else "i")
+            sentinel = _SENTINELS[op][kind](d.dtype)
+            masked = jnp.where(m, d, jnp.full_like(d, sentinel))
+            outs.append(((jnp.min if op == "min" else jnp.max)(masked), None))
+        elif op == "first":
+            idx = jnp.argmax(m)  # first True
+            outs.append((d[idx], (v[idx] if v is not None else None)))
+        elif op == "last":
+            rev = m[::-1]
+            idx = d.shape[0] - 1 - jnp.argmax(rev)
+            outs.append((d[idx], (v[idx] if v is not None else None)))
+        else:
+            raise ValueError(op)
+    return outs

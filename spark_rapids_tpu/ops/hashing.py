@@ -1,0 +1,118 @@
+"""Spark-compatible Murmur3 hashing on device.
+
+The reference gets Spark-exact murmur3/xxhash64 from spark-rapids-jni
+(``Hash`` — SURVEY.md §2.9); here Murmur3_x86_32 lowers directly to XLA
+integer ops.  Used by hash partitioning (GpuHashPartitioningBase.scala) so
+rows land on the same partition a CPU Spark shuffle would pick, and by the
+``hash()``/``xxhash64`` SQL functions.
+
+Semantics (org.apache.spark.sql.catalyst.expressions.Murmur3Hash):
+  * seed 42 for partitioning;
+  * null contributes nothing — the running hash passes through unchanged;
+  * int8/int16/int32/bool/date hash as a 4-byte int;
+  * int64/timestamp hash as two 4-byte words (low, high);
+  * float/double: NaNs canonicalized, -0.0 → +0.0, then bit pattern as
+    int/long.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Value = Tuple[jax.Array, Optional[jax.Array]]
+
+_C1 = jnp.uint32(0xcc9e2d51)
+_C2 = jnp.uint32(0x1b873593)
+
+SPARK_PARTITION_SEED = 42
+
+
+def _rotl32(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl32(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(h1, 13)
+    return h1 * jnp.uint32(5) + jnp.uint32(0xe6546b64)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.uint32(length)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85ebca6b)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xc2b2ae35)
+    return h1 ^ (h1 >> 16)
+
+
+def _hash_int32(x: jax.Array, h: jax.Array) -> jax.Array:
+    return _fmix(_mix_h1(h, _mix_k1(x.astype(jnp.uint32))), 4)
+
+
+def _hash_int64(x: jax.Array, h: jax.Array) -> jax.Array:
+    u = x.astype(jnp.uint64)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (u >> 32).astype(jnp.uint32)
+    h1 = _mix_h1(h, _mix_k1(lo))
+    h1 = _mix_h1(h1, _mix_k1(hi))
+    return _fmix(h1, 8)
+
+
+def _normalize_float_bits(d: jax.Array) -> jax.Array:
+    if d.dtype == jnp.float32:
+        d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+        d = jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
+        return jax.lax.bitcast_convert_type(d, jnp.int32)
+    d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+    d = jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
+    return jax.lax.bitcast_convert_type(d, jnp.int64)
+
+
+def hash_value(data: jax.Array, valid: Optional[jax.Array],
+               running: jax.Array) -> jax.Array:
+    """Fold one column into the running per-row hash (uint32)."""
+    dt = data.dtype
+    if dt == jnp.bool_:
+        out = _hash_int32(data.astype(jnp.int32), running)
+    elif dt in (jnp.int8, jnp.int16, jnp.int32):
+        out = _hash_int32(data.astype(jnp.int32), running)
+    elif dt == jnp.int64:
+        out = _hash_int64(data, running)
+    elif dt == jnp.float32:
+        out = _hash_int32(_normalize_float_bits(data), running)
+    elif dt == jnp.float64:
+        out = _hash_int64(_normalize_float_bits(data), running)
+    elif dt == jnp.uint32:
+        out = _hash_int32(data.astype(jnp.int32), running)
+    else:
+        raise TypeError(f"no device hash for dtype {dt}")
+    if valid is not None:
+        out = jnp.where(valid, out, running)  # null: hash passes through
+    return out
+
+
+def hash_columns(keys: Sequence[Value],
+                 seed: int = SPARK_PARTITION_SEED) -> jax.Array:
+    """Row-wise Murmur3 over multiple columns (Spark HashPartitioning)."""
+    capacity = keys[0][0].shape[0]
+    h = jnp.full((capacity,), seed, dtype=jnp.uint32)
+    for data, valid in keys:
+        h = hash_value(data, valid, h)
+    return h
+
+
+def spark_partition_id(keys: Sequence[Value], n_parts: int) -> jax.Array:
+    """Spark's non-negative pmod(hash, numPartitions)."""
+    h = hash_columns(keys).astype(jnp.int32)
+    pid = h % jnp.int32(n_parts)
+    return jnp.where(pid < 0, pid + n_parts, pid)

@@ -1,0 +1,9 @@
+"""Distributed execution: device meshes, ICI collective exchange, multi-host.
+
+The reference's distributed story is the UCX shuffle (SURVEY.md §2.4/§5.8:
+RDMA active messages + bounce buffers + peer discovery).  The TPU-native
+answer: when a whole stage is resident on a mesh, a shuffle *is* an XLA
+collective (all_to_all over ICI) inside one shard_mapped program — no RPC, no
+serialization; between stages or slices, the host-staged shuffle (shuffle/
+package) plays the reference's multithreaded-mode role.
+"""

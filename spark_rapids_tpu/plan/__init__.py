@@ -1,0 +1,7 @@
+"""Planning and physical execution layers.
+
+``logical``   — DataFrame-built logical plan nodes.
+``physical``  — TpuExec operators (the Gpu*Exec analogs) executing batches.
+``overrides`` — the meta/tag/convert planner with CPU fallback + explain
+                (GpuOverrides.scala / RapidsMeta.scala analogs).
+"""

@@ -1,0 +1,211 @@
+"""Additional physical operators: sort, limit, union, range, expand.
+
+References: GpuSortExec.scala:86 (sort; the out-of-core variant :242 arrives
+with the spill framework), limit.scala (GpuLocalLimit/GpuGlobalLimit),
+basicPhysicalOperators.scala:1096 (GpuRangeExec), GpuExpandExec.scala.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..batch import ColumnBatch, DeviceColumn, Field, HostStringColumn, Schema
+from ..exprs import EvalContext, Expression
+from ..ops import batch_utils, groupby
+from .physical import ExecContext, TpuExec
+
+__all__ = ["SortExec", "LimitExec", "UnionExec", "RangeExec", "ExpandExec",
+           "plan_join"]
+
+
+class SortExec(TpuExec):
+    """Global sort: concatenate all input, sort on device, emit one batch.
+
+    The reference's in-core path (GpuSortExec.scala:86); out-of-core chunked
+    merge-sort lands with the spill framework (SURVEY.md §5.7).
+    """
+
+    def __init__(self, child: TpuExec,
+                 orders: List[Tuple[Expression, bool, bool]]):
+        super().__init__([child])
+        self.orders = orders
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return f"TpuSort [{len(self.orders)} keys]"
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        m = ctx.metric_set(self.op_id)
+        batches = list(self.children[0].execute(ctx))
+        if not batches:
+            return
+        with m.time("opTime"):
+            whole = batch_utils.compact(batch_utils.concat_batches(batches)) \
+                if len(batches) > 1 else batch_utils.compact(batches[0])
+            key_exprs = tuple(e for e, _, _ in self.orders)
+            desc = tuple(not asc for _, asc, _ in self.orders)
+            nf = tuple(n for _, _, n in self.orders)
+            arrays = tuple(
+                (c.data, c.valid) if isinstance(c, DeviceColumn) else None
+                for c in whole.columns)
+            perm = _sort_perm(key_exprs, desc, nf)(
+                arrays, jnp.int32(whole.num_rows))
+            out = batch_utils.gather(whole, perm, whole.num_rows)
+        m.add("numOutputRows", out.num_rows)
+        yield out
+
+
+@functools.lru_cache(maxsize=256)
+def _sort_perm_cached(fp: str, key_exprs, desc, nf):
+    @jax.jit
+    def f(arrays, num_rows):
+        cap = next(a[0].shape[0] for a in arrays if a is not None)
+        active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        ectx = EvalContext(list(arrays), cap, active=active)
+        keys = [e.eval(ectx) for e in key_exprs]
+        return groupby.sort_indices_for_keys(keys, active, desc, nf)
+    return f
+
+
+def _sort_perm(key_exprs, desc, nf):
+    fp = "|".join(e.fingerprint() for e in key_exprs) + str(desc) + str(nf)
+    return _sort_perm_cached(fp, key_exprs, desc, nf)
+
+
+class LimitExec(TpuExec):
+    def __init__(self, child: TpuExec, n: int, offset: int = 0):
+        super().__init__([child])
+        self.n = n
+        self.offset = offset
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return f"TpuGlobalLimit {self.n}" + (
+            f" offset {self.offset}" if self.offset else "")
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        to_skip = self.offset
+        to_take = self.n
+        for batch in self.children[0].execute(ctx):
+            if to_take <= 0:
+                break
+            b = batch_utils.compact(batch)
+            start = min(to_skip, b.num_rows)
+            to_skip -= start
+            avail = b.num_rows - start
+            if avail <= 0:
+                continue
+            take = min(avail, to_take)
+            if start > 0 or take < b.num_rows:
+                b = batch_utils.slice_batch(b, start, take)
+            to_take -= take
+            yield b
+
+
+class UnionExec(TpuExec):
+    def __init__(self, children: List[TpuExec]):
+        super().__init__(children)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        for c in self.children:
+            yield from c.execute(ctx)
+
+
+class RangeExec(TpuExec):
+    def __init__(self, start: int, end: int, step: int, batch_rows: int):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+        self._schema = Schema([Field("id", T.INT64, False)])
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def node_desc(self):
+        return f"TpuRange ({self.start}, {self.end}, {self.step})"
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        pos = 0
+        while pos < total:
+            n = min(self.batch_rows, total - pos)
+            from ..batch import bucket_capacity
+            cap = bucket_capacity(n, ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"])
+            ids = (self.start + (pos + jnp.arange(cap, dtype=jnp.int64))
+                   * self.step)
+            yield ColumnBatch(self._schema,
+                              [DeviceColumn(T.INT64, ids)], n)
+            pos += n
+
+
+class ExpandExec(TpuExec):
+    """Emit one projected batch per projection per input batch
+    (grouping sets — GpuExpandExec.scala)."""
+
+    def __init__(self, child: TpuExec, projections, out_schema: Schema):
+        super().__init__([child])
+        self.projections = projections
+        self._schema = out_schema
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        m = ctx.metric_set(self.op_id)
+
+        @functools.lru_cache(maxsize=None)
+        def proj_fn(pi: int):
+            triples = self.projections[pi]
+
+            @jax.jit
+            def f(arrays, sel, num_rows):
+                cap = next(a[0].shape[0] for a in arrays if a is not None)
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(list(arrays), cap, active=active)
+                outs = []
+                for name, e, host_src in triples:
+                    outs.append(None if e is None else e.eval(ectx))
+                return tuple(outs), active
+            return f
+
+        for batch in self.children[0].execute(ctx):
+            arrays = tuple(
+                (c.data, c.valid) if isinstance(c, DeviceColumn) else None
+                for c in batch.columns)
+            for pi in range(len(self.projections)):
+                with m.time("opTime"):
+                    outs, active = proj_fn(pi)(arrays, batch.sel,
+                                               jnp.int32(batch.num_rows))
+                    cols = []
+                    for (f_, val, (name, e, host_src)) in zip(
+                            self._schema, outs, self.projections[pi]):
+                        if val is None:
+                            cols.append(batch.columns[host_src])
+                        else:
+                            cols.append(DeviceColumn(f_.dtype, val[0], val[1]))
+                    yield ColumnBatch(self._schema, cols, batch.num_rows, active)
+
+
+def plan_join(plan, left: TpuExec, right: TpuExec, conf):
+    from .join_exec import ShuffledHashJoinExec
+    return ShuffledHashJoinExec(plan, left, right, conf)

@@ -1,0 +1,324 @@
+"""TpuOverrides: the wrap→tag→convert planner.
+
+Direct analog of the reference's planning layer:
+  * wrap: build a meta tree over the logical plan (RapidsMeta.scala —
+    SparkPlanMeta:575 / ExprMeta).
+  * tag: per-node TypeSig + capability checks accumulate human-readable
+    ``will_not_work_on_tpu`` reasons (RapidsMeta.scala:184,293).
+  * convert: supported nodes become TpuExec operators (fusing project/filter
+    chains into whole-stage XLA programs); tagged nodes fall back to the CPU
+    operators in cpu/exec.py (GpuOverrides.applyOverrides flow,
+    GpuOverrides.scala:4513-4541).
+  * explain: render per-node placement + reasons, like
+    ``spark.rapids.sql.explain=NOT_ON_GPU`` (GpuOverrides.scala:4530-4537).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .. import exprs as E
+from ..aggfns import AGG_CLASSES, AggregateExpression
+from ..config import TpuConf
+from ..exprs import BoundReference, Expression, bind
+from . import logical as L
+from .physical import AggregateExec, ScanExec, StageExec, TpuExec
+from .planner import _bind_project, strip_alias, to_physical
+
+__all__ = ["apply_overrides", "explain_plan", "NodeMeta"]
+
+
+# ---------------------------------------------------------------------------------
+# Expression tagging
+# ---------------------------------------------------------------------------------
+
+def expr_reasons(e: Expression, allow_string_passthrough: bool = True
+                 ) -> List[str]:
+    """Reasons this bound expression tree cannot lower to the device."""
+    reasons: List[str] = []
+    core = strip_alias(e)
+    if isinstance(core, BoundReference):
+        if core.dtype.is_string and not allow_string_passthrough:
+            reasons.append(
+                f"string column {core.name or core.ordinal} used in "
+                f"computation (device string kernels pending)")
+        if core.dtype.is_nested:
+            reasons.append(f"nested type {core.dtype} not supported on device")
+        return reasons
+
+    def walk(node: Expression):
+        dt = node.dtype
+        if dt is not None:
+            if dt.is_string:
+                reasons.append(
+                    f"expression {type(node).__name__} produces/consumes "
+                    f"string (device string kernels pending)")
+                return
+            if dt.is_nested:
+                reasons.append(f"nested type {dt} not supported on device")
+                return
+            if dt.is_decimal and dt.precision > 18:
+                reasons.append(
+                    f"decimal precision {dt.precision} > 18 requires "
+                    f"emulated 128-bit (pending)")
+        for c in node.children:
+            walk(c)
+
+    walk(core)
+    return reasons
+
+
+# ---------------------------------------------------------------------------------
+# Meta tree
+# ---------------------------------------------------------------------------------
+
+class NodeMeta:
+    def __init__(self, plan: L.LogicalPlan, conf: TpuConf):
+        self.plan = plan
+        self.conf = conf
+        self.children = [NodeMeta(c, conf) for c in plan.children]
+        self.reasons: List[str] = []
+        self._tagged = False
+
+    def will_not_work(self, reason: str):
+        self.reasons.append(reason)
+
+    @property
+    def on_tpu(self) -> bool:
+        return not self.reasons
+
+    # -- tagging ------------------------------------------------------------------
+    def tag(self):
+        if self._tagged:
+            return
+        self._tagged = True
+        for c in self.children:
+            c.tag()
+        if not self.conf["spark.rapids.tpu.sql.enabled"]:
+            self.will_not_work("spark.rapids.tpu.sql.enabled is false")
+            return
+        try:
+            self._tag_self()
+        except Exception as ex:  # tagging must never fail the query
+            self.will_not_work(f"tagging error: {ex}")
+
+    def _tag_self(self):
+        p = self.plan
+        if isinstance(p, L.LogicalScan):
+            return  # scans upload whatever arrow gives us
+        if isinstance(p, L.Project):
+            schema = p.children[0].schema()
+            for name, e in p.exprs:
+                b = bind(e, schema)
+                for r in expr_reasons(b):
+                    self.will_not_work(f"{name}: {r}")
+            return
+        if isinstance(p, L.Filter):
+            b = bind(p.condition, p.children[0].schema())
+            for r in expr_reasons(b, allow_string_passthrough=False):
+                self.will_not_work(f"condition: {r}")
+            return
+        if isinstance(p, L.Aggregate):
+            schema = p.children[0].schema()
+            for name, e in p.group_exprs:
+                b = bind(e, schema)
+                core = strip_alias(b)
+                if core.dtype is not None and core.dtype.is_string:
+                    self.will_not_work(
+                        f"group key {name} is string (device dictionary "
+                        f"grouping pending)")
+                else:
+                    for r in expr_reasons(b, allow_string_passthrough=False):
+                        self.will_not_work(f"group key {name}: {r}")
+            for name, e in p.agg_exprs:
+                b = strip_alias(bind(e, schema))
+                if not isinstance(b, AggregateExpression):
+                    self.will_not_work(
+                        f"aggregate {name} is not a plain aggregate call")
+                    continue
+                for c in b.children:
+                    for r in expr_reasons(c, allow_string_passthrough=False):
+                        self.will_not_work(f"aggregate {name}: {r}")
+            return
+        if isinstance(p, L.Sort):
+            schema = p.children[0].schema()
+            for o in p.orders:
+                b = bind(o.expr, schema)
+                for r in expr_reasons(b, allow_string_passthrough=False):
+                    self.will_not_work(f"sort key: {r}")
+            return
+        if isinstance(p, (L.Limit, L.Union, L.LogicalRange, L.Distinct)):
+            if isinstance(p, L.Distinct):
+                for f in p.schema():
+                    if f.dtype.is_string:
+                        self.will_not_work(
+                            f"distinct over string column {f.name} "
+                            f"(device dictionary grouping pending)")
+            return
+        if isinstance(p, L.Join):
+            schema_l = p.children[0].schema()
+            for k in p.left_keys:
+                b = bind(k, schema_l)
+                if strip_alias(b).dtype.is_string:
+                    self.will_not_work(
+                        "join key is string (device dictionary join pending)")
+            if p.how not in ("inner", "left", "left_outer", "right",
+                             "right_outer", "full", "full_outer", "semi",
+                             "anti", "left_semi", "left_anti", "cross"):
+                self.will_not_work(f"join type {p.how} not supported")
+            return
+        if isinstance(p, L.Expand):
+            schema = p.children[0].schema()
+            for proj in p.projections:
+                for name, e in proj:
+                    for r in expr_reasons(bind(e, schema)):
+                        self.will_not_work(f"{name}: {r}")
+            return
+        self.will_not_work(f"operator {type(p).__name__} has no TPU version")
+
+    # -- explain ------------------------------------------------------------------
+    def explain_lines(self, indent: int = 0, verbosity: str = "NOT_ON_TPU"
+                      ) -> List[str]:
+        mark = "*" if self.on_tpu else "!"
+        show = verbosity == "ALL" or not self.on_tpu
+        lines = []
+        if show or True:
+            lines.append("  " * indent + f"{mark} {self.plan.node_desc()}")
+        for r in self.reasons:
+            lines.append("  " * indent + f"    @{r}")
+        for c in self.children:
+            lines += c.explain_lines(indent + 1, verbosity)
+        return lines
+
+
+# ---------------------------------------------------------------------------------
+# Conversion with fusion + fallback
+# ---------------------------------------------------------------------------------
+
+def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
+    from ..cpu.exec import CpuOpExec
+    p = meta.plan
+
+    if not meta.on_tpu:
+        if not conf["spark.rapids.tpu.sql.fallback.enabled"]:
+            raise NotImplementedError(
+                f"{type(p).__name__} cannot run on TPU and CPU fallback is "
+                f"disabled: {'; '.join(meta.reasons)}")
+        if conf["spark.rapids.tpu.test.validateExecsOnTpu"]:
+            raise AssertionError(
+                f"validateExecsOnTpu: {type(p).__name__} fell back to CPU: "
+                f"{'; '.join(meta.reasons)}")
+        return CpuOpExec(p, [_convert(c, conf) for c in meta.children])
+
+    # fuse supported project/filter chains into one StageExec
+    if isinstance(p, (L.Project, L.Filter)):
+        chain: List[NodeMeta] = []
+        node = meta
+        while isinstance(node.plan, (L.Project, L.Filter)) and node.on_tpu:
+            chain.append(node)
+            node = node.children[0]
+        child_phys = _convert(node, conf)
+        schema = child_phys.output_schema
+        steps: List[Tuple[str, object]] = []
+        for nm in reversed(chain):
+            ln = nm.plan
+            if isinstance(ln, L.Filter):
+                steps.append(("filter", bind(ln.condition, schema)))
+            else:
+                triples, schema = _bind_project(ln.exprs, schema)
+                steps.append(("project", triples))
+        return StageExec(child_phys, steps, schema)
+
+    if isinstance(p, L.LogicalScan):
+        return ScanExec(p.schema(), p.source_factory, p.desc)
+
+    if isinstance(p, L.Aggregate):
+        child_phys = _convert(meta.children[0], conf)
+        schema = child_phys.output_schema
+        group_bound = [(n, bind(e, schema)) for n, e in p.group_exprs]
+        agg_bound = [(n, strip_alias(bind(e, schema))) for n, e in p.agg_exprs]
+        return AggregateExec(child_phys, group_bound, agg_bound, mode="complete")
+
+    if isinstance(p, L.Distinct):
+        child_phys = _convert(meta.children[0], conf)
+        schema = child_phys.output_schema
+        group_bound = [(f.name, BoundReference(i, f.dtype, f.nullable, f.name))
+                       for i, f in enumerate(schema)]
+        return AggregateExec(child_phys, group_bound, [], mode="complete")
+
+    if isinstance(p, L.Sort):
+        from .exec_nodes import SortExec
+        child_phys = _convert(meta.children[0], conf)
+        schema = child_phys.output_schema
+        orders = [(bind(o.expr, schema), o.ascending, o.nulls_first)
+                  for o in p.orders]
+        return SortExec(child_phys, orders)
+
+    if isinstance(p, L.Limit):
+        from .exec_nodes import LimitExec
+        return LimitExec(_convert(meta.children[0], conf), p.n, p.offset)
+
+    if isinstance(p, L.Union):
+        from .exec_nodes import UnionExec
+        return UnionExec([_convert(c, conf) for c in meta.children])
+
+    if isinstance(p, L.LogicalRange):
+        from .exec_nodes import RangeExec
+        return RangeExec(p.start, p.end, p.step,
+                         conf["spark.rapids.tpu.sql.batchSizeRows"])
+
+    if isinstance(p, L.Join):
+        from .exec_nodes import plan_join
+        left = _convert(meta.children[0], conf)
+        right = _convert(meta.children[1], conf)
+        return plan_join(p, left, right, conf)
+
+    if isinstance(p, L.Expand):
+        from .exec_nodes import ExpandExec
+        child_phys = _convert(meta.children[0], conf)
+        schema = child_phys.output_schema
+        projections = [
+            _bind_project(proj, schema)[0] for proj in p.projections]
+        return ExpandExec(child_phys, projections, p.schema())
+
+    raise NotImplementedError(f"no conversion for {type(p).__name__}")
+
+
+def apply_overrides(plan: L.LogicalPlan, conf: Optional[TpuConf] = None
+                    ) -> TpuExec:
+    conf = conf or TpuConf()
+    meta = NodeMeta(plan, conf)
+    meta.tag()
+    mode = conf["spark.rapids.tpu.sql.mode"]
+    explain = conf["spark.rapids.tpu.sql.explain"]
+    if explain != "NONE":
+        lines = meta.explain_lines(verbosity=explain)
+        not_on = [ln for ln in lines if "@" in ln or ln.lstrip().startswith("!")]
+        if explain == "ALL" or (not_on and explain == "NOT_ON_TPU"):
+            import logging
+            logging.getLogger("spark_rapids_tpu.overrides").info(
+                "plan placement:\n%s", "\n".join(lines))
+    if mode == "explainonly" or not conf["spark.rapids.tpu.sql.enabled"]:
+        from ..cpu.exec import CpuOpExec
+        # force everything to CPU, preserving the tagging report
+        def all_cpu(m: NodeMeta) -> TpuExec:
+            p = m.plan
+            if isinstance(p, L.LogicalScan):
+                return ScanExec(p.schema(), p.source_factory, p.desc)
+            if isinstance(p, L.LogicalRange):
+                from .exec_nodes import RangeExec
+                return RangeExec(p.start, p.end, p.step,
+                                 conf["spark.rapids.tpu.sql.batchSizeRows"])
+            return CpuOpExec(p, [all_cpu(c) for c in m.children])
+        return all_cpu(meta)
+    return _convert(meta, conf)
+
+
+def explain_plan(plan: L.LogicalPlan, conf: Optional[TpuConf] = None) -> str:
+    """Explain-only API (ExplainPlan.scala analog)."""
+    conf = conf or TpuConf()
+    meta = NodeMeta(plan, conf)
+    meta.tag()
+    header = ("*  = runs on TPU\n!  = falls back to CPU (reasons follow "
+              "on @-lines)\n")
+    return header + "\n".join(meta.explain_lines(verbosity="ALL"))

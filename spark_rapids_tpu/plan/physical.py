@@ -1,0 +1,575 @@
+"""Physical operators (TpuExec nodes).
+
+TPU-native analog of the reference's ``GpuExec`` operator layer
+(GpuExec.scala:348-360): every operator consumes/produces an iterator of
+:class:`ColumnBatch`.  The defining difference from the reference: a chain of
+project/filter operators does not issue per-expression kernels
+(basicPhysicalOperators.scala GpuProjectExec/GpuFilterExec) — it is *fused*
+into one jitted XLA computation per capacity bucket (``StageExec``), the
+whole-stage-codegen idea applied at the XLA level.
+
+Execution is lazy: ``execute(ctx)`` returns a generator; the driver pulls
+batches, which keeps peak HBM bounded the same way the reference's iterator
+chains do.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..batch import ColumnBatch, DeviceColumn, Field, HostStringColumn, Schema
+from ..config import TpuConf
+from ..exprs import (AggregateExpression, Alias, BoundReference, EvalContext,
+                     Expression)
+from ..ops import batch_utils, groupby
+from ..utils.metrics import MetricSet
+
+__all__ = ["ExecContext", "TpuExec", "ScanExec", "StageExec", "AggregateExec",
+           "CollectExec"]
+
+
+class ExecContext:
+    """Per-query execution context: conf + metrics + device placement."""
+
+    def __init__(self, conf: Optional[TpuConf] = None, device=None):
+        self.conf = conf or TpuConf()
+        self.device = device
+        self.metrics: Dict[str, MetricSet] = {}
+
+    def metric_set(self, op_id: str) -> MetricSet:
+        if op_id not in self.metrics:
+            self.metrics[op_id] = MetricSet(op_id)
+        return self.metrics[op_id]
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    def __init__(self, children: Sequence["TpuExec"] = ()):
+        self.children = list(children)
+        self.op_id = f"{type(self).__name__}@{id(self):x}"
+
+    @property
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        raise NotImplementedError
+
+    # -- plan display -------------------------------------------------------------
+    def node_desc(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = [("  " * indent) + ("+- " if indent else "") + self.node_desc()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------------
+# Scan: pulls pyarrow record batches from a source and uploads them.
+# ---------------------------------------------------------------------------------
+
+class ScanExec(TpuExec):
+    """Leaf scan over a host Arrow batch source (parquet/csv/... readers in
+    io/ produce the source).  Mirrors GpuFileSourceScanExec: host-side parse,
+    then upload at the device boundary (GpuParquetScan.scala readToTable)."""
+
+    def __init__(self, schema: Schema, source_factory: Callable[[], Iterator],
+                 desc: str = "source"):
+        super().__init__()
+        self._schema = schema
+        self._source_factory = source_factory
+        self.desc = desc
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def node_desc(self):
+        return f"TpuScan [{self.desc}] {self._schema.names()}"
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        from ..batch import from_arrow
+        m = ctx.metric_set(self.op_id)
+        min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+        for table in self._source_factory():
+            with m.time("scanTime"):
+                b = from_arrow(table, min_capacity=min_cap, device=ctx.device)
+            m.add("numOutputRows", b.num_rows)
+            m.add("numOutputBatches", 1)
+            yield b
+
+
+# ---------------------------------------------------------------------------------
+# Fused project/filter stage.
+# ---------------------------------------------------------------------------------
+
+_STAGE_CACHE: Dict[str, Callable] = {}
+_STAGE_CACHE_LOCK = threading.Lock()
+
+
+class StageExec(TpuExec):
+    """A fused pipeline of project and filter steps over one input.
+
+    ``steps`` is a list of ("project", [(name, expr, host_src), ...]) or
+    ("filter", pred_expr); expressions are bound against the running
+    intermediate schema.  ``host_src`` (set when expr is None) marks a host
+    string column passed through by reference.  The whole list compiles to
+    ONE XLA computation.
+    """
+
+    def __init__(self, child: TpuExec, steps: List[Tuple[str, object]],
+                 output_schema: Schema):
+        super().__init__([child])
+        self.steps = steps
+        self._schema = output_schema
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def node_desc(self):
+        kinds = "+".join(k for k, _ in self.steps)
+        return f"TpuStage [{kinds}] -> {self._schema.names()}"
+
+    # fingerprint identifies the traced program (cache key)
+    def fingerprint(self) -> str:
+        parts = []
+        for kind, payload in self.steps:
+            if kind == "project":
+                parts.append("P(" + ";".join(
+                    f"{n}={e.fingerprint() if e is not None else f'host#{src}'}"
+                    for n, e, src in payload) + ")")
+            else:
+                parts.append(f"F({payload.fingerprint()})")
+        return "|".join(parts)
+
+    def _build_fn(self, in_schema: Schema):
+        steps = self.steps
+
+        def stage_fn(arrays, sel, num_rows):
+            capacity = None
+            for a in arrays:
+                if a is not None:
+                    capacity = a[0].shape[0]
+                    break
+            active = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+            if sel is not None:
+                active = active & sel
+            cur = list(arrays)
+            for kind, payload in steps:
+                ctx = EvalContext(cur, capacity, active=active)
+                if kind == "filter":
+                    d, v = payload.eval(ctx)
+                    keep = d if v is None else (d & v)
+                    active = active & keep
+                else:
+                    nxt = []
+                    for name, e, host_src in payload:
+                        if e is None:  # host-column pass-through marker
+                            nxt.append(None)
+                        else:
+                            nxt.append(e.eval(ctx))
+                    cur = nxt
+            return tuple(cur), active
+
+        return stage_fn
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        child = self.children[0]
+        in_schema = child.output_schema
+        m = ctx.metric_set(self.op_id)
+        fp = self.fingerprint()
+        with _STAGE_CACHE_LOCK:
+            fn = _STAGE_CACHE.get(fp)
+            if fn is None:
+                fn = jax.jit(self._build_fn(in_schema))
+                _STAGE_CACHE[fp] = fn
+
+        # figure out host pass-through columns for the final projection
+        final_proj = None
+        for kind, payload in reversed(self.steps):
+            if kind == "project":
+                final_proj = payload
+                break
+
+        for batch in child.execute(ctx):
+            with m.time("opTime"):
+                arrays, host_cols = [], {}
+                for i, (f, c) in enumerate(zip(batch.schema, batch.columns)):
+                    if isinstance(c, HostStringColumn):
+                        arrays.append(None)
+                        host_cols[i] = c
+                    else:
+                        arrays.append((c.data, c.valid))
+                # device-side compute
+                out_arrays, new_sel = fn(
+                    tuple(arrays), batch.sel,
+                    jnp.int32(batch.num_rows))
+                cols: List = []
+                for oi, f in enumerate(self._schema):
+                    val = out_arrays[oi] if oi < len(out_arrays) else None
+                    if val is None:
+                        # host pass-through: the expr was a bare reference
+                        src = self._host_source_ordinal(oi)
+                        cols.append(batch.columns[src])
+                    else:
+                        data, valid = val
+                        cols.append(DeviceColumn(f.dtype, data, valid))
+                out = ColumnBatch(self._schema, cols, batch.num_rows, new_sel)
+            m.add("numOutputRows", out.num_rows)
+            m.add("numOutputBatches", 1)
+            yield out
+
+    def _host_source_ordinal(self, out_ordinal: int) -> int:
+        """Chase a host pass-through output back to its input ordinal."""
+        ord_ = out_ordinal
+        for kind, payload in reversed(self.steps):
+            if kind != "project":
+                continue
+            name, e, src = payload[ord_]
+            assert e is None and src is not None, (
+                "host column used in computed expression; planner "
+                "should have routed this stage to CPU")
+            ord_ = src
+        return ord_
+
+
+# ---------------------------------------------------------------------------------
+# Hash aggregate (sort-based on device; concat-merge across batches, like the
+# reference's GpuMergeAggregateIterator concat-merge loop aggregate.scala:711).
+# ---------------------------------------------------------------------------------
+
+class AggregateExec(TpuExec):
+    """Group-by aggregation over all input batches.
+
+    mode: "complete" (single pass), or "partial"/"final" around an exchange.
+    Buffer layout (partial output schema): [key0..kN, buf0..bufM] where each
+    aggregate contributes len(buffers()) buffer columns.
+    """
+
+    def __init__(self, child: TpuExec, group_exprs: List[Tuple[str, Expression]],
+                 agg_exprs: List[Tuple[str, AggregateExpression]],
+                 mode: str = "complete"):
+        super().__init__([child])
+        self.group_exprs = group_exprs
+        self.agg_exprs = agg_exprs
+        self.mode = mode
+        out_fields = [Field(n, e.dtype, e.nullable) for n, e in group_exprs]
+        if mode == "partial":
+            for name, agg in agg_exprs:
+                for bi, (dt, op) in enumerate(agg.buffers()):
+                    out_fields.append(Field(f"{name}#buf{bi}", dt, True))
+        else:
+            out_fields += [Field(n, a.dtype, a.nullable) for n, a in agg_exprs]
+        self._schema = Schema(out_fields)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def node_desc(self):
+        keys = [n for n, _ in self.group_exprs]
+        aggs = [f"{a.func}({n})" for n, a in self.agg_exprs]
+        return f"TpuHashAggregate [{self.mode}] keys={keys} aggs={aggs}"
+
+    # -- helpers ------------------------------------------------------------------
+    def _buffer_ops(self) -> List[str]:
+        ops = []
+        for _, agg in self.agg_exprs:
+            ops += [op for _, op in agg.buffers()]
+        return ops
+
+    def _merge_input_layout(self):
+        """When mode == 'final', inputs are already buffer columns."""
+        n_keys = len(self.group_exprs)
+        return n_keys
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        if self.group_exprs:
+            yield from self._execute_grouped(ctx)
+        else:
+            yield from self._execute_ungrouped(ctx)
+
+    # -- ungrouped ----------------------------------------------------------------
+    def _execute_ungrouped(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        child = self.children[0]
+        m = ctx.metric_set(self.op_id)
+        ops = self._buffer_ops()
+
+        if self.mode == "final":
+            update = self._final_mode_update
+        else:
+            update = self._update_contributions
+
+        @jax.jit
+        def batch_partials(arrays, sel, num_rows):
+            cap = arrays[0][0].shape[0]
+            active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+            if sel is not None:
+                active = active & sel
+            ectx = EvalContext(arrays, cap, active=active)
+            contribs = update(ectx)
+            return groupby.ungrouped_reduce(
+                [(cv, op) for cv, op in zip(contribs, ops)], active)
+
+        acc: Optional[List] = None
+        for batch in child.execute(ctx):
+            with m.time("opTime"):
+                arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
+                               else None for c in batch.columns)
+                partials = batch_partials(arrays, batch.sel,
+                                          jnp.int32(batch.num_rows))
+                acc = partials if acc is None else self._merge_scalars(
+                    acc, partials, ops)
+        if acc is None:
+            acc = self._empty_scalars()
+        out = self._finalize_scalars(acc)
+        m.add("numOutputRows", 1)
+        yield out
+
+    def _update_contributions(self, ectx: EvalContext):
+        contribs = []
+        for _, agg in self.agg_exprs:
+            contribs += agg.update(ectx)
+        return contribs
+
+    def _final_mode_update(self, ectx: EvalContext):
+        """In final mode the child columns ARE the buffers: pass them through."""
+        n_keys = len(self.group_exprs)
+        return [ectx.arrays[i] for i in range(n_keys, len(ectx.arrays))]
+
+    @staticmethod
+    def _merge_scalars(a, b, ops):
+        out = []
+        for (ad, av), (bd, bv), op in zip(a, b, ops):
+            if op == "sum":
+                out.append((ad + bd, None))
+            elif op == "min":
+                out.append((jnp.minimum(ad, bd), None))
+            elif op == "max":
+                out.append((jnp.maximum(ad, bd), None))
+            elif op == "first":
+                out.append((ad, av))
+            elif op == "last":
+                out.append((bd, bv))
+            else:
+                raise ValueError(op)
+        return out
+
+    def _empty_scalars(self):
+        outs = []
+        for _, agg in self.agg_exprs:
+            for dt, op in agg.buffers():
+                np_dt = dt.numpy_dtype
+                if op == "sum":
+                    outs.append((jnp.zeros((), dtype=np_dt), None))
+                elif op == "min":
+                    outs.append((jnp.array(
+                        groupby._SENTINELS["min"]["f" if dt.is_floating else "i"](
+                            np_dt), dtype=np_dt), None))
+                elif op == "max":
+                    outs.append((jnp.array(
+                        groupby._SENTINELS["max"]["f" if dt.is_floating else "i"](
+                            np_dt), dtype=np_dt), None))
+                else:
+                    outs.append((jnp.zeros((), dtype=np_dt),
+                                 jnp.array(False)))
+        return outs
+
+    def _finalize_scalars(self, acc) -> ColumnBatch:
+        from ..batch import bucket_capacity
+        cols: List[DeviceColumn] = []
+        i = 0
+        cap = bucket_capacity(1)
+        fields = []
+        for (name, agg) in self.agg_exprs:
+            nb = len(agg.buffers())
+            buf_vals = []
+            for (d, v) in acc[i: i + nb]:
+                bd = jnp.broadcast_to(d, (cap,))
+                bv = None if v is None else jnp.broadcast_to(v, (cap,))
+                buf_vals.append((bd, bv))
+            i += nb
+            if self.mode == "partial":
+                for bi, ((bd, bv), (dt, _)) in enumerate(
+                        zip(buf_vals, agg.buffers())):
+                    fields.append(Field(f"{name}#buf{bi}", dt, True))
+                    cols.append(DeviceColumn(dt, bd, bv))
+            else:
+                data, valid = agg.finalize(buf_vals)
+                data = jnp.broadcast_to(data, (cap,))
+                if valid is not None:
+                    valid = jnp.broadcast_to(valid, (cap,))
+                data = data.astype(agg.dtype.numpy_dtype)
+                fields.append(Field(name, agg.dtype, agg.nullable))
+                cols.append(DeviceColumn(agg.dtype, data, valid))
+        return ColumnBatch(Schema(fields), cols, 1)
+
+    # -- grouped ------------------------------------------------------------------
+    def _execute_grouped(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        child = self.children[0]
+        m = ctx.metric_set(self.op_id)
+        ops = self._buffer_ops()
+        n_keys = len(self.group_exprs)
+
+        if self.mode == "final":
+            update = self._final_mode_update
+            key_eval = self._final_mode_keys
+        else:
+            update = self._update_contributions
+            key_eval = self._key_contributions
+
+        @jax.jit
+        def batch_group(arrays, sel, num_rows):
+            cap = arrays[0][0].shape[0]
+            active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+            if sel is not None:
+                active = active & sel
+            ectx = EvalContext(arrays, cap, active=active)
+            keys = key_eval(ectx)
+            contribs = update(ectx)
+            out_keys, out_vals, n_groups, gmask = groupby.group_reduce(
+                keys, [(cv, op) for cv, op in zip(contribs, ops)], active)
+            return out_keys, out_vals, gmask
+
+        buffer_schema = self._buffer_schema()
+        pending: Optional[ColumnBatch] = None
+        for batch in child.execute(ctx):
+            with m.time("opTime"):
+                arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
+                               else None for c in batch.columns)
+                ok, ov, gmask = batch_group(arrays, batch.sel,
+                                            jnp.int32(batch.num_rows))
+                part = self._to_buffer_batch(buffer_schema, ok, ov, gmask)
+                if pending is None:
+                    pending = batch_utils.compact(part)
+                else:
+                    pending = self._merge_partials(pending, part, ops, n_keys)
+        if pending is None:
+            yield ColumnBatch(self._schema, self._empty_cols(), 0)
+            return
+        out = self._finalize_grouped(pending) if self.mode != "partial" else pending
+        m.add("numOutputRows", out.num_rows)
+        yield out
+
+    def _key_contributions(self, ectx: EvalContext):
+        return [e.eval(ectx) for _, e in self.group_exprs]
+
+    def _final_mode_keys(self, ectx: EvalContext):
+        return [ectx.arrays[i] for i in range(len(self.group_exprs))]
+
+    def _buffer_schema(self) -> Schema:
+        fields = [Field(n, e.dtype, e.nullable) for n, e in self.group_exprs]
+        for name, agg in self.agg_exprs:
+            for bi, (dt, op) in enumerate(agg.buffers()):
+                fields.append(Field(f"{name}#buf{bi}", dt, True))
+        return Schema(fields)
+
+    def _to_buffer_batch(self, schema: Schema, out_keys, out_vals,
+                         gmask) -> ColumnBatch:
+        cols: List[DeviceColumn] = []
+        for (d, v), f in zip(out_keys + out_vals, schema):
+            cols.append(DeviceColumn(f.dtype, d.astype(f.dtype.numpy_dtype), v))
+        cap = cols[0].capacity
+        return ColumnBatch(schema, cols, cap, gmask)
+
+    def _merge_partials(self, a: ColumnBatch, b: ColumnBatch, ops, n_keys):
+        """Concat partial results and re-reduce (concat-merge loop)."""
+        both = batch_utils.concat_batches([a, b])
+        arrays = tuple((c.data, c.valid) for c in both.columns)
+        merge = _merge_fn(tuple(ops), n_keys)
+        ok, ov, gmask = merge(arrays, both.sel, jnp.int32(both.num_rows))
+        merged = self._to_buffer_batch(both.schema, list(ok), list(ov), gmask)
+        return batch_utils.compact(merged)
+
+    def _finalize_grouped(self, pending: ColumnBatch) -> ColumnBatch:
+        n_keys = len(self.group_exprs)
+        arrays = tuple((c.data, c.valid) for c in pending.columns)
+
+        @jax.jit
+        def fin(arrays):
+            outs = []
+            i = n_keys
+            for name, agg in self.agg_exprs:
+                nb = len(agg.buffers())
+                data, valid = agg.finalize([arrays[i + k] for k in range(nb)])
+                outs.append((data.astype(agg.dtype.numpy_dtype), valid))
+                i += nb
+            return tuple(outs)
+
+        fin_vals = fin(arrays)
+        cols: List[DeviceColumn] = list(pending.columns[:n_keys])
+        for (name, agg), (d, v) in zip(self.agg_exprs, fin_vals):
+            cols.append(DeviceColumn(agg.dtype, d, v))
+        return ColumnBatch(self._schema, cols, pending.num_rows, pending.sel)
+
+    def _empty_cols(self):
+        cols = []
+        from ..batch import bucket_capacity
+        cap = bucket_capacity(0)
+        for f in self._schema:
+            if f.dtype.is_string:
+                import pyarrow as pa
+                cols.append(HostStringColumn(pa.nulls(cap, type=pa.string())))
+            else:
+                cols.append(DeviceColumn(
+                    f.dtype, jnp.zeros((cap,), dtype=f.dtype.numpy_dtype),
+                    jnp.zeros((cap,), dtype=bool)))
+        return cols
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def _merge_fn(ops: tuple, n_keys: int):
+    """Cached jitted merge for the concat-merge aggregation loop."""
+
+    @jax.jit
+    def merge(arrays, sel, num_rows):
+        cap = arrays[0][0].shape[0]
+        active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        if sel is not None:
+            active = active & sel
+        keys = [arrays[i] for i in range(n_keys)]
+        vals = [(arrays[n_keys + i], op) for i, op in enumerate(ops)]
+        ok, ov, n_groups, gmask = groupby.group_reduce(keys, vals, active)
+        return tuple(ok), tuple(ov), gmask
+
+    return merge
+
+
+# ---------------------------------------------------------------------------------
+# Collect: device → host Arrow (GpuBringBackToHost + GpuColumnarToRowExec analog)
+# ---------------------------------------------------------------------------------
+
+class CollectExec(TpuExec):
+    def __init__(self, child: TpuExec):
+        super().__init__([child])
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return "TpuBringBackToHost"
+
+    def collect_arrow(self, ctx: ExecContext):
+        import pyarrow as pa
+        from ..batch import to_arrow
+        tables = [to_arrow(b) for b in self.children[0].execute(ctx)]
+        if not tables:
+            return None
+        return pa.concat_tables(tables)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        yield from self.children[0].execute(ctx)

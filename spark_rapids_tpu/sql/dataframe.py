@@ -1,0 +1,199 @@
+"""DataFrame: the user-facing lazy query surface (PySpark DataFrame analog).
+
+The reference accelerates Spark's own DataFrame transparently; this framework
+is standalone, so it ships the equivalent surface.  Everything is lazy — an
+action (collect/count/to_pandas) triggers planning (overrides → physical) and
+batch execution.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .. import exprs as E
+from ..plan import logical as L
+from .column import Column, to_expr
+
+__all__ = ["DataFrame", "GroupedData"]
+
+
+def _named(c: Union[str, Column]) -> tuple:
+    if isinstance(c, str):
+        if c == "*":
+            raise ValueError("use df.select('*') via df.select(*df.columns)")
+        return (c, E.UnresolvedColumn(c))
+    return (c.name, c.expr)
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session):
+        self._plan = plan
+        self.session = session
+
+    # -- metadata -----------------------------------------------------------------
+    @property
+    def schema(self):
+        return self._plan.schema()
+
+    @property
+    def columns(self) -> List[str]:
+        return self._plan.schema().names()
+
+    def __getitem__(self, name: str) -> Column:
+        assert name in self._plan.schema(), f"no column {name!r}"
+        return Column(E.UnresolvedColumn(name))
+
+    # -- transformations ----------------------------------------------------------
+    def select(self, *cols: Union[str, Column]) -> "DataFrame":
+        exprs = [_named(c) for c in cols]
+        return DataFrame(L.Project(self._plan, exprs), self.session)
+
+    def where(self, condition: Union[Column, str]) -> "DataFrame":
+        assert not isinstance(condition, str), "SQL string filters: use sql()"
+        return DataFrame(L.Filter(self._plan, condition.expr), self.session)
+
+    filter = where
+
+    def with_column(self, name: str, c: Column) -> "DataFrame":
+        exprs = []
+        replaced = False
+        for f in self._plan.schema():
+            if f.name == name:
+                exprs.append((name, c.expr))
+                replaced = True
+            else:
+                exprs.append((f.name, E.UnresolvedColumn(f.name)))
+        if not replaced:
+            exprs.append((name, c.expr))
+        return DataFrame(L.Project(self._plan, exprs), self.session)
+
+    withColumn = with_column
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [((new if f.name == old else f.name),
+                  E.UnresolvedColumn(f.name)) for f in self._plan.schema()]
+        return DataFrame(L.Project(self._plan, exprs), self.session)
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [f.name for f in self._plan.schema() if f.name not in names]
+        return self.select(*keep)
+
+    def group_by(self, *cols: Union[str, Column]) -> "GroupedData":
+        return GroupedData(self, [_named(c) for c in cols])
+
+    groupBy = group_by
+
+    def agg(self, *cols: Column) -> "DataFrame":
+        return GroupedData(self, []).agg(*cols)
+
+    def sort(self, *cols, ascending: Optional[Union[bool, list]] = None
+             ) -> "DataFrame":
+        orders = []
+        for c in cols:
+            if isinstance(c, L.SortOrder):
+                orders.append(c)
+            elif isinstance(c, str):
+                orders.append(L.SortOrder(E.UnresolvedColumn(c)))
+            else:
+                orders.append(L.SortOrder(c.expr))
+        if ascending is not None:
+            flags = ([ascending] * len(orders)
+                     if isinstance(ascending, bool) else list(ascending))
+            orders = [L.SortOrder(o.expr, asc, None if asc else None)
+                      for o, asc in zip(orders, flags)]
+        return DataFrame(L.Sort(self._plan, orders), self.session)
+
+    orderBy = order_by = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(self._plan, n), self.session)
+
+    def offset(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(self._plan, 1 << 62, offset=n), self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self._plan, other._plan]), self.session)
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(L.Distinct(self._plan), self.session)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        if on is None:
+            raise NotImplementedError("cross join: use crossJoin")
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and all(isinstance(x, str) for x in on):
+            lk = [E.UnresolvedColumn(k) for k in on]
+            rk = [E.UnresolvedColumn(k) for k in on]
+            node = L.Join(self._plan, other._plan, lk, rk, how=how)
+            node.using = list(on)
+            return DataFrame(node, self.session)
+        raise NotImplementedError("join on expressions: pass column names")
+
+    # -- actions ------------------------------------------------------------------
+    def _executed(self):
+        return self.session._execute(self._plan)
+
+    def to_arrow(self):
+        return self._executed()
+
+    def to_pandas(self):
+        t = self._executed()
+        return t.to_pandas() if t is not None else None
+
+    toPandas = to_pandas
+
+    def collect(self) -> List[tuple]:
+        t = self._executed()
+        if t is None:
+            return []
+        cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
+        return [tuple(c[i] for c in cols) for i in range(t.num_rows)]
+
+    def count(self) -> int:
+        from . import functions as F
+        t = self.agg(F.count_star().alias("count"))._executed()
+        return t.column(0).to_pylist()[0]
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).to_pandas())
+
+    def explain(self, mode: str = "formatted") -> None:
+        print(self.explain_string())
+
+    def explain_string(self) -> str:
+        return self.session._explain(self._plan)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, group_exprs):
+        self._df = df
+        self._group_exprs = group_exprs
+
+    def agg(self, *cols: Column) -> DataFrame:
+        agg_exprs = [_named(c) for c in cols]
+        node = L.Aggregate(self._df._plan, self._group_exprs, agg_exprs)
+        return DataFrame(node, self._df.session)
+
+    def count(self) -> DataFrame:
+        from . import functions as F
+        return self.agg(F.count_star().alias("count"))
+
+    def sum(self, *names: str) -> DataFrame:
+        from . import functions as F
+        return self.agg(*[F.sum(F.col(n)).alias(f"sum({n})") for n in names])
+
+    def avg(self, *names: str) -> DataFrame:
+        from . import functions as F
+        return self.agg(*[F.avg(F.col(n)).alias(f"avg({n})") for n in names])
+
+    def min(self, *names: str) -> DataFrame:
+        from . import functions as F
+        return self.agg(*[F.min(F.col(n)).alias(f"min({n})") for n in names])
+
+    def max(self, *names: str) -> DataFrame:
+        from . import functions as F
+        return self.agg(*[F.max(F.col(n)).alias(f"max({n})") for n in names])

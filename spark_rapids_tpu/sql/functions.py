@@ -1,0 +1,122 @@
+"""pyspark.sql.functions-style builder functions."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import aggfns as A
+from .. import exprs as E
+from .. import types as T
+from .column import Column, to_expr
+
+__all__ = [
+    "col", "lit", "when", "coalesce", "isnull", "isnan", "expr_abs",
+    "sum", "count", "count_star", "min", "max", "avg", "mean", "first", "last",
+    "parse_type",
+]
+
+def col(name: str) -> Column:
+    return Column(E.UnresolvedColumn(name))
+
+
+def lit(value: Any, dtype: Optional[T.DataType] = None) -> Column:
+    return Column(E.Literal(value, dtype))
+
+
+class _WhenBuilder(Column):
+    def __init__(self, branches):
+        self._branches = branches
+        super().__init__(E.CaseWhen(branches, None))
+
+    def when(self, cond, value) -> "_WhenBuilder":
+        return _WhenBuilder(self._branches +
+                            [(to_expr(cond), to_expr(value))])
+
+    def otherwise(self, value) -> Column:
+        return Column(E.CaseWhen(self._branches, to_expr(value)))
+
+
+def when(cond, value) -> _WhenBuilder:
+    return _WhenBuilder([(to_expr(cond), to_expr(value))])
+
+
+def coalesce(*cols) -> Column:
+    return Column(E.Coalesce(*[to_expr(c) for c in cols]))
+
+
+def isnull(c) -> Column:
+    return Column(E.IsNull(to_expr(c)))
+
+
+def isnan(c) -> Column:
+    return Column(E.IsNan(to_expr(c)))
+
+
+def expr_abs(c) -> Column:
+    return Column(E.Abs(to_expr(c)))
+
+
+# -- aggregates -------------------------------------------------------------------
+
+def sum(c) -> Column:  # noqa: A001 — mirrors pyspark naming
+    return Column(A.Sum(to_expr(c)))
+
+
+def count(c) -> Column:
+    if isinstance(c, str) and c == "*":
+        return Column(A.CountStar())
+    return Column(A.Count(to_expr(c)))
+
+
+def count_star() -> Column:
+    return Column(A.CountStar())
+
+
+def min(c) -> Column:  # noqa: A001
+    return Column(A.Min(to_expr(c)))
+
+
+def max(c) -> Column:  # noqa: A001
+    return Column(A.Max(to_expr(c)))
+
+
+def avg(c) -> Column:
+    return Column(A.Average(to_expr(c)))
+
+
+mean = avg
+
+
+def first(c, ignore_nulls: bool = False) -> Column:
+    return Column(A.First(to_expr(c), ignore_nulls))
+
+
+def last(c, ignore_nulls: bool = False) -> Column:
+    return Column(A.Last(to_expr(c), ignore_nulls))
+
+
+# -- type parsing -----------------------------------------------------------------
+
+_TYPE_NAMES = {
+    "boolean": T.BOOLEAN, "bool": T.BOOLEAN,
+    "tinyint": T.INT8, "byte": T.INT8,
+    "smallint": T.INT16, "short": T.INT16,
+    "int": T.INT32, "integer": T.INT32,
+    "bigint": T.INT64, "long": T.INT64,
+    "float": T.FLOAT32, "real": T.FLOAT32,
+    "double": T.FLOAT64,
+    "string": T.STRING,
+    "date": T.DATE,
+    "timestamp": T.TIMESTAMP,
+}
+
+
+def parse_type(s: str) -> T.DataType:
+    s = s.strip().lower()
+    if s in _TYPE_NAMES:
+        return _TYPE_NAMES[s]
+    if s.startswith("decimal"):
+        inner = s[s.index("(") + 1: s.index(")")]
+        p, sc = (int(x) for x in inner.split(","))
+        return T.decimal(p, sc)
+    raise ValueError(f"unknown type name {s!r}")

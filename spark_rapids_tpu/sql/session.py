@@ -1,0 +1,125 @@
+"""Session: entry point, config holder, executor (SparkSession analog).
+
+Plays the role of the reference's plugin bootstrap (Plugin.scala:276-388):
+device discovery, config fixup, and the planning hook.  The `explain`
+machinery mirrors the plugin's "could not run on TPU because ..." output
+(GpuOverrides.scala:4530-4537).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+from ..config import TpuConf
+from ..plan import logical as L
+from ..plan.physical import CollectExec, ExecContext
+from .dataframe import DataFrame
+
+__all__ = ["Session"]
+
+
+class _RuntimeConf:
+    def __init__(self, session: "Session"):
+        self._session = session
+
+    def set(self, key: str, value) -> None:
+        self._session._settings[key] = value
+
+    def get(self, key: str):
+        if key in self._session._settings:
+            return self._session._settings[key]
+        from ..config import ALL_ENTRIES
+        return ALL_ENTRIES[key].default
+
+    def unset(self, key: str) -> None:
+        self._session._settings.pop(key, None)
+
+
+class Session:
+    """A query session bound to one device set."""
+
+    _lock = threading.Lock()
+    _active: Optional["Session"] = None
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None, device=None):
+        self._settings: Dict[str, Any] = dict(settings or {})
+        self.device = device
+        self.conf = _RuntimeConf(self)
+
+    @classmethod
+    def get_or_create(cls, settings: Optional[Dict[str, Any]] = None,
+                      device=None) -> "Session":
+        with cls._lock:
+            if cls._active is None:
+                cls._active = Session(settings, device)
+            elif settings:
+                cls._active._settings.update(settings)
+            return cls._active
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._active = None
+
+    def _tpu_conf(self) -> TpuConf:
+        return TpuConf(self._settings)
+
+    # -- data sources -------------------------------------------------------------
+    def read_parquet(self, path, columns=None) -> DataFrame:
+        from ..io.parquet import parquet_source
+        conf = self._tpu_conf()
+        schema, factory = parquet_source(
+            path, columns=columns,
+            batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"])
+        node = L.LogicalScan(schema, factory, str(path), fmt="parquet")
+        return DataFrame(node, self)
+
+    def read_csv(self, path, schema=None, header: bool = True, sep: str = ","
+                 ) -> DataFrame:
+        from ..io.csv import csv_source
+        conf = self._tpu_conf()
+        out_schema, factory = csv_source(
+            path, schema=schema, header=header, sep=sep,
+            batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"])
+        node = L.LogicalScan(out_schema, factory, str(path), fmt="csv")
+        return DataFrame(node, self)
+
+    def create_dataframe(self, data, schema=None) -> DataFrame:
+        """From a pandas DataFrame, pyarrow Table, or dict of arrays."""
+        import pyarrow as pa
+        if isinstance(data, dict):
+            table = pa.table(data)
+        elif isinstance(data, pa.Table):
+            table = data
+        else:  # pandas
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        from ..batch import _arrow_to_logical, Field, Schema
+        fields = [Field(n, _arrow_to_logical(t), True)
+                  for n, t in zip(table.column_names, table.schema.types)]
+        out_schema = Schema(fields)
+        node = L.LogicalScan(out_schema, lambda: iter([table]),
+                             "local", fmt="memory")
+        return DataFrame(node, self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1
+              ) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(L.LogicalRange(start, end, step), self)
+
+    # -- execution ----------------------------------------------------------------
+    def _plan_physical(self, plan: L.LogicalPlan):
+        from ..plan.overrides import apply_overrides
+        conf = self._tpu_conf()
+        return apply_overrides(plan, conf)
+
+    def _execute(self, plan: L.LogicalPlan):
+        conf = self._tpu_conf()
+        phys = self._plan_physical(plan)
+        ctx = ExecContext(conf, device=self.device)
+        return CollectExec(phys).collect_arrow(ctx)
+
+    def _explain(self, plan: L.LogicalPlan) -> str:
+        from ..plan.overrides import explain_plan
+        return explain_plan(plan, self._tpu_conf())

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: metrics, tracing, resource management."""

@@ -1,0 +1,77 @@
+"""Operator metrics + trace annotations.
+
+Two-tier design copied from the reference (SURVEY.md §5.1): per-operator SQL
+metrics (GpuExec.scala:49-141 ``GpuMetric`` with ESSENTIAL/MODERATE/DEBUG
+levels) and task-level counters (GpuTaskMetrics.scala).  NVTX ranges
+(NvtxWithMetrics.scala:34) become ``jax.profiler.TraceAnnotation`` so the
+ranges land in XLA/TPU profiler timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict
+
+import jax
+
+__all__ = ["MetricSet", "TaskMetrics", "trace_range"]
+
+
+class MetricSet:
+    """Named counters/timers for one operator instance."""
+
+    def __init__(self, op_id: str):
+        self.op_id = op_id
+        self.values: Dict[str, float] = defaultdict(float)
+
+    def add(self, name: str, amount: float) -> None:
+        self.values[name] += amount
+
+    @contextlib.contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        with trace_range(f"{self.op_id}:{name}"):
+            yield
+        self.values[name] += time.perf_counter() - t0
+
+    def __getitem__(self, name: str) -> float:
+        return self.values.get(name, 0.0)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v:.4g}" for k, v in sorted(self.values.items()))
+        return f"MetricSet({self.op_id}: {inner})"
+
+
+class TaskMetrics:
+    """Task-scope counters: semaphore wait, retries, spill bytes
+    (GpuTaskMetrics.scala:81-142 analog)."""
+
+    _current = None
+
+    def __init__(self):
+        self.semaphore_wait_s = 0.0
+        self.retry_count = 0
+        self.split_retry_count = 0
+        self.retry_block_s = 0.0
+        self.spill_to_host_bytes = 0
+        self.spill_to_disk_bytes = 0
+
+    @classmethod
+    def get(cls) -> "TaskMetrics":
+        if cls._current is None:
+            cls._current = TaskMetrics()
+        return cls._current
+
+    @classmethod
+    def reset(cls) -> "TaskMetrics":
+        cls._current = TaskMetrics()
+        return cls._current
+
+
+@contextlib.contextmanager
+def trace_range(name: str):
+    """Profiler trace annotation (NVTX range analog)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,51 @@
+"""Test bootstrap: force an 8-device CPU JAX platform.
+
+The driver validates multi-chip sharding on a virtual CPU mesh
+(xla_force_host_platform_device_count), so the unit suite runs on 8 virtual
+CPU devices.  A TPU plugin may already be registered at interpreter start (the
+axon sitecustomize does this); registration is harmless — what matters is
+selecting the cpu platform and setting XLA_FLAGS *before the first backend
+initialization*, which this conftest does at import time.
+
+Set SRT_TESTS_ON_TPU=1 to run the suite against the real TPU instead.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("SRT_TESTS_ON_TPU") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu", (
+        "tests must run on the CPU platform; a backend was already "
+        "initialized before conftest ran")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def session():
+    import spark_rapids_tpu as srt
+    return srt.Session.get_or_create()
+
+
+@pytest.fixture()
+def fresh_session():
+    import spark_rapids_tpu as srt
+    srt.Session.reset()
+    s = srt.Session.get_or_create()
+    yield s
+    srt.Session.reset()
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(20260729)

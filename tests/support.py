@@ -1,0 +1,195 @@
+"""Differential test oracle + seeded data generators.
+
+Port of the reference's integration-test core (SURVEY.md §4.3):
+``assert_gpu_and_cpu_are_equal_collect`` (asserts.py:560) becomes
+``assert_tpu_and_oracle_equal`` — run the query through the engine and
+compare against a pandas/pyarrow oracle; the seeded generator family
+mirrors data_gen.py:38-735.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+
+# ---------------------------------------------------------------------------------
+# Oracle comparison
+# ---------------------------------------------------------------------------------
+
+def normalize_pdf(pdf: pd.DataFrame) -> pd.DataFrame:
+    out = pdf.copy()
+    for c in out.columns:
+        if str(out[c].dtype).startswith(("Int", "UInt", "Float")):
+            out[c] = out[c].astype(object).where(out[c].notna(), None)
+    return out.reset_index(drop=True)
+
+
+def assert_rows_equal(actual_rows, expected_rows, approx_float=False,
+                      ignore_order=True):
+    def key(r):
+        return tuple((x is None, _orderable(x)) for x in r)
+    if ignore_order:
+        actual_rows = sorted(actual_rows, key=key)
+        expected_rows = sorted(expected_rows, key=key)
+    assert len(actual_rows) == len(expected_rows), (
+        f"row count {len(actual_rows)} != {len(expected_rows)}\n"
+        f"actual={actual_rows[:10]}\nexpected={expected_rows[:10]}")
+    for i, (a, e) in enumerate(zip(actual_rows, expected_rows)):
+        assert len(a) == len(e), f"row {i}: arity {len(a)} vs {len(e)}"
+        for j, (av, ev) in enumerate(zip(a, e)):
+            assert _val_eq(av, ev, approx_float), (
+                f"row {i} col {j}: {av!r} != {ev!r}\n"
+                f"actual row={a}\nexpected row={e}")
+
+
+def _orderable(x):
+    if x is None:
+        return ""
+    if isinstance(x, float) and math.isnan(x):
+        return "nan"
+    return str(x)
+
+
+def _val_eq(a, b, approx_float) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) or math.isnan(fb):
+            return math.isnan(fa) and math.isnan(fb)
+        if approx_float:
+            return math.isclose(fa, fb, rel_tol=1e-6, abs_tol=1e-9)
+        return fa == fb or math.isclose(fa, fb, rel_tol=1e-12, abs_tol=1e-12)
+    return a == b
+
+
+def pdf_rows(pdf: pd.DataFrame):
+    rows = []
+    for t in pdf.itertuples(index=False):
+        row = []
+        for x in t:
+            # pd.NA / None / NaT are SQL nulls; float NaN is a real value
+            if x is None or x is pd.NA or x is pd.NaT:
+                row.append(None)
+            elif not isinstance(x, (float, np.floating)) and pd.isna(x):
+                row.append(None)
+            else:
+                row.append(x.item() if hasattr(x, "item") else x)
+        rows.append(tuple(row))
+    return rows
+
+
+def assert_df_matches_pandas(df, expected: pd.DataFrame, approx_float=False,
+                             ignore_order=True):
+    """df: engine DataFrame; expected: pandas oracle result."""
+    actual = df.collect()
+    expected_rows = pdf_rows(expected)
+    assert_rows_equal(actual, expected_rows, approx_float, ignore_order)
+
+
+# ---------------------------------------------------------------------------------
+# Seeded generators (data_gen.py analog)
+# ---------------------------------------------------------------------------------
+
+class Gen:
+    def __init__(self, nullable=True, null_prob=0.1):
+        self.nullable = nullable
+        self.null_prob = null_prob
+
+    def generate(self, rng: np.random.Generator, n: int):
+        vals = self._gen(rng, n)
+        if self.nullable:
+            mask = rng.random(n) < self.null_prob
+            vals = [None if m else v for v, m in zip(vals, mask)]
+        return vals
+
+    def _gen(self, rng, n):
+        raise NotImplementedError
+
+
+class IntGen(Gen):
+    def __init__(self, lo=-(2 ** 31), hi=2 ** 31 - 1, dtype="int32", **kw):
+        super().__init__(**kw)
+        self.lo, self.hi, self.dtype = lo, hi, dtype
+
+    def _gen(self, rng, n):
+        return [int(x) for x in rng.integers(self.lo, self.hi, n)]
+
+
+class LongGen(IntGen):
+    def __init__(self, lo=-(2 ** 63), hi=2 ** 63 - 1, **kw):
+        super().__init__(lo, hi, "int64", **kw)
+
+
+class DoubleGen(Gen):
+    def __init__(self, special=True, **kw):
+        super().__init__(**kw)
+        self.special = special
+
+    def _gen(self, rng, n):
+        vals = list((rng.random(n) - 0.5) * 2e6)
+        if self.special and n >= 8:
+            for i, sp in enumerate([0.0, -0.0, float("nan"), float("inf"),
+                                    float("-inf"), 1e-300, -1e300, 1.5]):
+                vals[int(rng.integers(0, n))] = sp
+        return [float(v) for v in vals]
+
+
+class FloatGen(DoubleGen):
+    def _gen(self, rng, n):
+        return [float(np.float32(v)) for v in super()._gen(rng, n)]
+
+
+class BoolGen(Gen):
+    def _gen(self, rng, n):
+        return [bool(b) for b in rng.integers(0, 2, n)]
+
+
+class StringGen(Gen):
+    def __init__(self, alphabet="abcdefgXYZ 0123456789", max_len=12, **kw):
+        super().__init__(**kw)
+        self.alphabet = alphabet
+        self.max_len = max_len
+
+    def _gen(self, rng, n):
+        out = []
+        for _ in range(n):
+            ln = int(rng.integers(0, self.max_len))
+            out.append("".join(rng.choice(list(self.alphabet), ln)))
+        return out
+
+
+class DateGen(Gen):
+    def _gen(self, rng, n):
+        import datetime
+        base = datetime.date(1970, 1, 1)
+        return [base + datetime.timedelta(days=int(d))
+                for d in rng.integers(-20000, 20000, n)]
+
+
+class TimestampGen(Gen):
+    def _gen(self, rng, n):
+        import datetime
+        base = datetime.datetime(2000, 1, 1)
+        return [base + datetime.timedelta(microseconds=int(us))
+                for us in rng.integers(-10 ** 15, 10 ** 15, n)]
+
+
+def gen_table(rng, gens: dict, n: int):
+    """dict name->Gen → (pyarrow.Table, pandas oracle with nullable dtypes
+    so SQL null stays distinct from float NaN)."""
+    import pyarrow as pa
+    cols = {name: g.generate(rng, n) for name, g in gens.items()}
+    table = pa.table({k: pa.array(v) for k, v in cols.items()})
+    # Nullable dtypes for ints/bools/strings keep SQL null distinct from NaN.
+    # Floats stay plain float64: pandas' masked Float64 folds genuine NaN into
+    # NA, which breaks the oracle — so float columns in generated tables
+    # should be non-nullable (dedicated null tests build literal frames).
+    mapper = {pa.int8(): pd.Int8Dtype(), pa.int16(): pd.Int16Dtype(),
+              pa.int32(): pd.Int32Dtype(), pa.int64(): pd.Int64Dtype(),
+              pa.bool_(): pd.BooleanDtype(), pa.string(): pd.StringDtype()}
+    return table, table.to_pandas(types_mapper=mapper.get)

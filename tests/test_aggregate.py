@@ -1,0 +1,123 @@
+"""Aggregation tests (hash_aggregate_test.py analog)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from .support import (DoubleGen, IntGen, LongGen, StringGen,
+                      assert_rows_equal, gen_table, pdf_rows)
+
+
+def F():
+    from spark_rapids_tpu.sql import functions
+    return functions
+
+
+@pytest.fixture(scope="module")
+def agg_df(session, rng):
+    table, pdf = gen_table(rng, {
+        "k": IntGen(lo=0, hi=10),
+        "k2": IntGen(lo=0, hi=3, nullable=False),
+        "v": IntGen(lo=-100, hi=100),
+        "d": DoubleGen(special=False),
+    }, 400)
+    return session.create_dataframe(table), pdf
+
+
+def _oracle_grouped(pdf, keys):
+    g = pdf.groupby(keys, dropna=False)
+    exp = g.agg(s=("v", lambda x: x.sum(min_count=1)),
+                c=("v", "count"),
+                mn=("v", "min"),
+                mx=("v", "max"),
+                av=("d", "mean"),
+                n=("v", "size")).reset_index()
+    return exp
+
+
+def test_grouped_aggs_single_key(agg_df):
+    df, pdf = agg_df
+    f = F()
+    out = df.group_by("k").agg(
+        f.sum(f.col("v")).alias("s"),
+        f.count(f.col("v")).alias("c"),
+        f.min(f.col("v")).alias("mn"),
+        f.max(f.col("v")).alias("mx"),
+        f.avg(f.col("d")).alias("av"),
+        f.count_star().alias("n"),
+    ).collect()
+    exp = _oracle_grouped(pdf, ["k"])
+    assert_rows_equal(out, pdf_rows(exp), approx_float=True)
+
+
+def test_grouped_aggs_multi_key(agg_df):
+    df, pdf = agg_df
+    f = F()
+    out = df.group_by("k", "k2").agg(f.sum(f.col("v")).alias("s")).collect()
+    exp = pdf.groupby(["k", "k2"], dropna=False).agg(
+        s=("v", lambda x: x.sum(min_count=1))).reset_index()
+    assert_rows_equal(out, pdf_rows(exp))
+
+
+def test_ungrouped_aggs(agg_df):
+    df, pdf = agg_df
+    f = F()
+    out = df.agg(f.sum(f.col("v")).alias("s"),
+                 f.count(f.col("v")).alias("c"),
+                 f.min(f.col("v")).alias("mn"),
+                 f.max(f.col("v")).alias("mx"),
+                 f.count_star().alias("n")).collect()
+    assert out == [(int(pdf.v.sum()), int(pdf.v.count()),
+                    int(pdf.v.min()), int(pdf.v.max()), len(pdf))]
+
+
+def test_sum_all_null_group_is_null(session):
+    f = F()
+    df = session.create_dataframe(
+        {"k": [1, 1, 2], "v": pd.array([None, None, 5], dtype="Int64")})
+    out = sorted(df.group_by("k").agg(f.sum(f.col("v")).alias("s")).collect())
+    assert out == [(1, None), (2, 5)]
+
+
+def test_count_empty(session):
+    f = F()
+    df = session.create_dataframe({"a": [1, 2, 3]}).where(f.col("a") > 99)
+    assert df.count() == 0
+    out = df.agg(f.sum(f.col("a")).alias("s")).collect()
+    assert out == [(None,)]
+
+
+def test_avg_int_is_double(session):
+    f = F()
+    df = session.create_dataframe({"a": [1, 2], "k": [0, 0]})
+    out = df.group_by("k").agg(f.avg(f.col("a")).alias("m")).collect()
+    assert out == [(0, 1.5)]
+
+
+def test_distinct_numeric(session):
+    df = session.create_dataframe({"a": [1, 2, 2, 3, 3, 3]})
+    assert sorted(r[0] for r in df.distinct().collect()) == [1, 2, 3]
+
+
+def test_grouped_string_key_fallback(session, rng):
+    f = F()
+    table, pdf = gen_table(rng, {"s": StringGen(max_len=3, null_prob=0.2),
+                                 "v": IntGen(nullable=False, lo=0, hi=50)}, 200)
+    df = session.create_dataframe(table)
+    out = df.group_by("s").agg(f.sum(f.col("v")).alias("sv")).collect()
+    exp = pdf.groupby("s", dropna=False).agg(sv=("v", "sum")).reset_index()
+    assert_rows_equal(out, pdf_rows(exp))
+
+
+def test_float_key_nan_groups_merge(session):
+    f = F()
+    nan = float("nan")
+    df = session.create_dataframe({"k": [nan, nan, 1.0, -0.0, 0.0],
+                                   "v": [1, 2, 3, 4, 5]})
+    out = df.group_by("k").agg(f.sum(f.col("v")).alias("s")).collect()
+    by_key = {}
+    for k, s in out:
+        key = "nan" if (k is not None and np.isnan(k)) else k
+        by_key[key] = s
+    assert by_key["nan"] == 3      # NaN normalized to one group
+    assert by_key[0.0] == 9        # -0.0 and 0.0 merge

@@ -1,0 +1,169 @@
+"""Project/filter/expression tests vs pandas oracle
+(integration_tests arithmetic_ops_test.py / cmp_test.py analogs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from .support import (DoubleGen, IntGen, LongGen, BoolGen, StringGen,
+                      assert_df_matches_pandas, gen_table, pdf_rows,
+                      assert_rows_equal)
+
+
+@pytest.fixture(scope="module")
+def num_df(session, rng):
+    table, pdf = gen_table(rng, {
+        "a": IntGen(lo=-1000, hi=1000),
+        "b": IntGen(lo=-1000, hi=1000, nullable=False),
+        # float columns stay non-nullable in generated tables (see
+        # support.gen_table); dedicated literal tests cover float nulls
+        "x": DoubleGen(nullable=False, special=True),
+        "y": DoubleGen(nullable=False, special=True),
+        "l": LongGen(lo=-(2**40), hi=2**40),
+        "flag": BoolGen(),
+    }, 500)
+    return session.create_dataframe(table), pdf
+
+
+def F():
+    from spark_rapids_tpu.sql import functions
+    return functions
+
+
+def test_project_arithmetic(num_df):
+    df, pdf = num_df
+    f = F()
+    out = df.select(
+        (f.col("a") + f.col("b")).alias("s"),
+        (f.col("a") * 2).alias("d"),
+        (f.col("x") - f.col("y")).alias("diff"),
+        (-f.col("b")).alias("neg"),
+    )
+    exp = pd.DataFrame({
+        "s": pdf.a + pdf.b,
+        "d": pdf.a * 2,
+        "diff": pdf.x - pdf.y,
+        "neg": -pdf.b,
+    })
+    assert_df_matches_pandas(out, exp, ignore_order=False)
+
+
+def test_division_null_on_zero(session):
+    f = F()
+    df = session.create_dataframe({"a": [1.0, 2.0, 3.0, 4.0],
+                                   "b": [2.0, 0.0, -1.0, 0.0]})
+    out = df.select((f.col("a") / f.col("b")).alias("q")).collect()
+    assert out == [(0.5,), (None,), (-3.0,), (None,)]
+
+
+def test_remainder_sign(session):
+    f = F()
+    df = session.create_dataframe({"a": [7, -7, 7, -7], "b": [3, 3, -3, 0]})
+    out = df.select((f.col("a") % f.col("b")).alias("m")).collect()
+    assert out == [(1,), (-1,), (1,), (None,)]
+
+
+def test_comparisons_and_filter(num_df):
+    df, pdf = num_df
+    f = F()
+    out = df.where((f.col("a") > 0) & (f.col("x") < 100.0))
+    m = (pdf.a > 0) & (pdf.x < 100.0)
+    exp = pdf[m.fillna(False)]
+    assert_df_matches_pandas(out, exp)
+
+
+def test_filter_or_with_nulls(session):
+    f = F()
+    df = session.create_dataframe(
+        {"a": pd.array([1, None, 3, None], dtype="Int64"),
+         "b": pd.array([None, 2, None, 4], dtype="Int64")})
+    out = df.where((f.col("a") > 0) | (f.col("b") > 3)).collect()
+    assert sorted(r[0] is not None and r[0] or -1 for r in out) == [-1, 1, 3]
+
+
+def test_null_predicates(session):
+    f = F()
+    df = session.create_dataframe(
+        {"a": pd.array([1, None, 3], dtype="Int64")})
+    out = df.select(f.col("a").is_null().alias("n"),
+                    f.col("a").is_not_null().alias("nn")).collect()
+    assert out == [(False, True), (True, False), (False, True)]
+
+
+def test_case_when_if(session):
+    f = F()
+    df = session.create_dataframe({"a": [1, 2, 3, 4, 5]})
+    out = df.select(
+        f.when(f.col("a") < 2, "low")
+         .when(f.col("a") < 4, "mid")
+         .otherwise("high").alias("bucket")).collect()
+    assert [r[0] for r in out] == ["low", "mid", "mid", "high", "high"]
+
+
+def test_coalesce(session):
+    f = F()
+    df = session.create_dataframe(
+        {"a": pd.array([None, 2, None], dtype="Int64"),
+         "b": pd.array([10, None, None], dtype="Int64")})
+    out = df.select(f.coalesce(f.col("a"), f.col("b"), f.lit(-1)).alias("c"))
+    assert [r[0] for r in out.collect()] == [10, 2, -1]
+
+
+def test_in_and_between(session):
+    f = F()
+    df = session.create_dataframe({"a": [1, 2, 3, 4, 5]})
+    out = df.where(f.col("a").isin(2, 4)).collect()
+    assert [r[0] for r in out] == [2, 4]
+    out2 = df.where(f.col("a").between(2, 4)).collect()
+    assert [r[0] for r in out2] == [2, 3, 4]
+
+
+def test_cast_int_double_bool(session):
+    f = F()
+    df = session.create_dataframe({"a": [1, 0, -3]})
+    out = df.select(f.col("a").cast("double").alias("d"),
+                    f.col("a").cast("boolean").alias("b"),
+                    f.col("a").cast("bigint").alias("l")).collect()
+    assert out == [(1.0, True, 1), (0.0, False, 0), (-3.0, True, -3)]
+
+
+def test_cast_double_to_int_truncates(session):
+    f = F()
+    df = session.create_dataframe({"x": [1.9, -1.9, float("nan"), 2.0]})
+    out = df.select(f.col("x").cast("int").alias("i")).collect()
+    assert out == [(1,), (-1,), (0,), (2,)]
+
+
+def test_chained_project_filter_fusion(num_df):
+    df, pdf = num_df
+    f = F()
+    out = (df.select((f.col("a") + f.col("b")).alias("s"), "x")
+             .where(f.col("s") % 2 == 0)
+             .select((f.col("s") * f.col("x")).alias("sx")))
+    s = pdf.a + pdf.b
+    m = ((s % 2) == 0).fillna(False) & s.notna()
+    exp = pd.DataFrame({"sx": (s * pdf.x)[m]})
+    assert_df_matches_pandas(out, exp, approx_float=True)
+
+
+def test_string_passthrough_and_fallback(session):
+    f = F()
+    df = session.create_dataframe({"s": ["a", "b", None, "d"],
+                                   "v": [1, 2, 3, 4]})
+    out = df.select("s", (f.col("v") * 10).alias("v10")).collect()
+    assert out == [("a", 10), ("b", 20), (None, 30), ("d", 40)]
+    # string equality filter → CPU fallback path
+    out2 = df.where(f.col("s") == "b").collect()
+    assert out2 == [("b", 2)]
+
+
+def test_limit_offset(session):
+    df = session.range(100)
+    assert [r[0] for r in df.limit(5).collect()] == [0, 1, 2, 3, 4]
+
+
+def test_union_distinct(session):
+    df1 = session.create_dataframe({"a": [1, 2, 3]})
+    df2 = session.create_dataframe({"a": [3, 4]})
+    out = df1.union(df2).distinct().collect()
+    assert sorted(r[0] for r in out) == [1, 2, 3, 4]

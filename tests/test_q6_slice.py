@@ -1,0 +1,78 @@
+"""The minimum end-to-end slice: TPC-H Q6 shape over parquet
+(BASELINE.json configs[0]; SURVEY.md §7.2 step 4)."""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+
+def _make_lineitem(tmp_path, n=20000, seed=7):
+    rng = np.random.default_rng(seed)
+    tbl = pa.table({
+        "l_quantity": rng.integers(1, 51, n).astype(np.float64),
+        "l_extendedprice": (rng.random(n) * 100000).round(2),
+        "l_discount": rng.integers(0, 11, n).astype(np.float64) / 100,
+        "l_shipdate": pa.array(
+            np.datetime64("1992-01-01")
+            + rng.integers(0, 2500, n).astype("timedelta64[D]"),
+            type=pa.date32()),
+    })
+    path = str(tmp_path / "lineitem.parquet")
+    pq.write_table(tbl, path)
+    return path, tbl.to_pandas()
+
+
+def test_q6(session, tmp_path):
+    from spark_rapids_tpu.sql import functions as F
+    path, pdf = _make_lineitem(tmp_path)
+    df = session.read_parquet(path)
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    out = (df.where((F.col("l_shipdate") >= lo) & (F.col("l_shipdate") < hi)
+                    & (F.col("l_discount") >= 0.05)
+                    & (F.col("l_discount") <= 0.07)
+                    & (F.col("l_quantity") < 24))
+             .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
+                  .alias("revenue"))).collect()
+    m = ((pdf.l_shipdate >= lo) & (pdf.l_shipdate < hi)
+         & (pdf.l_discount >= 0.05) & (pdf.l_discount <= 0.07)
+         & (pdf.l_quantity < 24))
+    expected = float((pdf.l_extendedprice[m] * pdf.l_discount[m]).sum())
+    assert out[0][0] == pytest.approx(expected, rel=1e-12)
+
+
+def test_q6_multi_batch(session, tmp_path):
+    """Same query with small batches: exercises the concat-merge agg loop."""
+    from spark_rapids_tpu.sql import functions as F
+    path, pdf = _make_lineitem(tmp_path, n=30000)
+    session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 4096)
+    try:
+        df = session.read_parquet(path)
+        lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+        out = (df.where((F.col("l_shipdate") >= lo)
+                        & (F.col("l_shipdate") < hi)
+                        & (F.col("l_quantity") < 24))
+                 .group_by((F.col("l_quantity") % 3).cast("int").alias("b"))
+                 .agg(F.sum(F.col("l_extendedprice")).alias("s"),
+                      F.count_star().alias("c"))).collect()
+    finally:
+        session.conf.unset("spark.rapids.tpu.sql.batchSizeRows")
+    m = ((pdf.l_shipdate >= lo) & (pdf.l_shipdate < hi) & (pdf.l_quantity < 24))
+    sub = pdf[m]
+    exp = sub.groupby((sub.l_quantity % 3).astype("int32")).agg(
+        s=("l_extendedprice", "sum"), c=("l_quantity", "size"))
+    got = {b: (s, c) for b, s, c in out}
+    for b, row in exp.iterrows():
+        assert got[b][1] == row.c
+        assert got[b][0] == pytest.approx(row.s, rel=1e-12)
+
+
+def test_explain_shows_placement(session, tmp_path):
+    from spark_rapids_tpu.sql import functions as F
+    path, _ = _make_lineitem(tmp_path, n=1000)
+    df = session.read_parquet(path)
+    s = df.where(F.col("l_quantity") < 24).explain_string()
+    assert "runs on TPU" in s
+    assert "Scan parquet" in s
