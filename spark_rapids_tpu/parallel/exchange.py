@@ -51,14 +51,17 @@ def bucketize(pids: jax.Array, active: jax.Array, n_parts: int,
         jnp.clip(s_pid, 0, n_parts - 1)]
     ok = s_active & (within < bucket_cap)
     overflow = jnp.sum(s_active & ~ok)
-    dst_rows = jnp.where(ok, jnp.clip(s_pid, 0, n_parts - 1), n_parts - 1)
-    dst_cols = jnp.where(ok, within, bucket_cap - 1)
+    # Not-ok rows (inactive or overflow) scatter to row n_parts — out of
+    # bounds, so mode="drop" discards them.  Clamping them into a valid slot
+    # would zero live data whenever that slot is occupied (e.g. the last
+    # bucket exactly full).
+    dst_rows = jnp.where(ok, s_pid, n_parts)
+    dst_cols = jnp.where(ok, within, 0)
     out_arrays = []
     for a in arrays:
         src = a[perm]
         buf = jnp.zeros((n_parts, bucket_cap), dtype=a.dtype)
-        buf = buf.at[dst_rows, dst_cols].set(
-            jnp.where(ok, src, jnp.zeros_like(src)), mode="drop")
+        buf = buf.at[dst_rows, dst_cols].set(src, mode="drop")
         out_arrays.append(buf)
     sent_counts = jnp.minimum(counts, bucket_cap)
     return out_arrays, sent_counts, overflow
