@@ -179,16 +179,30 @@ class First(AggregateExpression):
         self.nullable = True
 
     def buffers(self):
+        if self.ignore_nulls:
+            # single buffer: the first_valid/last_valid reduction yields both
+            # the value and whether any non-null row existed
+            return [(self.dtype, f"{self.reduce_choice}_valid")]
         # value + validity carried through first/last reduction
         return [(self.dtype, self.reduce_choice), (T.INT64, self.reduce_choice)]
 
     def update(self, ctx):
         d, v = self.children[0].eval(ctx)
+        if self.ignore_nulls:
+            return [(d, v)]
         return [(d, v), (_valid_indicator(v, ctx), None)]
 
     def finalize(self, values):
-        (d, _), (vi, _) = values
-        return d, vi > 0
+        if self.ignore_nulls:
+            d, v = values[0]
+            return d, v
+        (d, _), (vi, vh) = values
+        # vi>0 = the picked row was non-null; vh (when present) = some batch
+        # actually had an active row (guards the all-filtered-input case)
+        ok = vi > 0
+        if vh is not None:
+            ok = ok & vh
+        return d, ok
 
     def _fp_extra(self):
         return f"{self.func}:{self.dtype}:ign={self.ignore_nulls}"

@@ -50,18 +50,30 @@ def eval_cpu(expr: E.Expression, arrays, n: int) -> Value:
         d, v = ev(expr.children[0])
         return _cast_cpu(d, v, expr.children[0].dtype, expr.dtype)
 
+    if isinstance(expr, E.Multiply) and expr.dtype.is_decimal:
+        l, r = expr.children
+        ld, lv = ev(l)
+        rd, rv = ev(r)
+        ls = l.dtype.scale if l.dtype.is_decimal else 0
+        rs = r.dtype.scale if r.dtype.is_decimal else 0
+        prod = ld.astype(np.int64) * rd.astype(np.int64)
+        drop = ls + rs - expr.dtype.scale
+        if drop > 0:
+            prod = _round_div(prod, 10 ** drop)
+        return prod, _and(lv, rv)
     if isinstance(expr, (E.Add, E.Subtract, E.Multiply)):
         ld, lv = ev(expr.children[0])
         rd, rv = ev(expr.children[1])
-        ct = _np_dtype(expr.dtype)
-        ld, rd = ld.astype(ct), rd.astype(ct)
+        ld = _promote_cpu(ld, expr.children[0].dtype, expr.dtype)
+        rd = _promote_cpu(rd, expr.children[1].dtype, expr.dtype)
         op = {E.Add: np.add, E.Subtract: np.subtract,
               E.Multiply: np.multiply}[type(expr)]
         return op(ld, rd), _and(lv, rv)
     if isinstance(expr, E.Divide):
         ld, lv = ev(expr.children[0])
         rd, rv = ev(expr.children[1])
-        ld, rd = ld.astype(np.float64), rd.astype(np.float64)
+        ld = _promote_cpu(ld, expr.children[0].dtype, T.FLOAT64)
+        rd = _promote_cpu(rd, expr.children[1].dtype, T.FLOAT64)
         zero = rd == 0
         out = ld / np.where(zero, 1.0, rd)
         return out, _and(_and(lv, rv), ~zero)
@@ -210,6 +222,29 @@ def _np_dtype(dt: T.DataType):
     return dt.numpy_dtype
 
 
+def _round_div(x: np.ndarray, d: int) -> np.ndarray:
+    """Integer division rounding half away from zero (Spark decimal rounding);
+    numpy twin of exprs._round_div."""
+    sign = np.where(x >= 0, 1, -1)
+    return sign * ((np.abs(x) + d // 2) // d)
+
+
+def _promote_cpu(data: np.ndarray, src: T.DataType, dst: T.DataType) -> np.ndarray:
+    """CPU mirror of exprs.promote_physical (decimal scale handling)."""
+    np_dt = _np_dtype(dst)
+    if src.is_decimal and dst.is_floating:
+        return data.astype(np_dt) / 10.0 ** src.scale
+    if src.is_decimal and dst.is_decimal:
+        if dst.scale == src.scale:
+            return data
+        if dst.scale > src.scale:
+            return data * np.int64(10 ** (dst.scale - src.scale))
+        return _round_div(data, 10 ** (src.scale - dst.scale))
+    if dst.is_decimal and not src.is_decimal:
+        return data.astype(np_dt) * np.int64(10 ** dst.scale)
+    return data.astype(np_dt) if data.dtype != np_dt else data
+
+
 def _compare(ld, rd, op, lt: T.DataType, rt: T.DataType):
     if lt.is_string or rt.is_string:
         lmask = np.array([x is not None for x in ld]) if ld.dtype == object else None
@@ -221,14 +256,14 @@ def _compare(ld, rd, op, lt: T.DataType, rt: T.DataType):
             else:
                 out[i] = bool(op(a, b))
         return out
-    ct = np.promote_types(ld.dtype, rd.dtype)
-    return op(ld.astype(ct), rd.astype(ct))
+    ct = T.common_type(lt, rt)
+    return op(_promote_cpu(ld, lt, ct), _promote_cpu(rd, rt, ct))
 
 
 def _compare_scalar(d, val, dt: T.DataType):
     if dt.is_string:
         return np.array([x == val for x in d], dtype=bool)
-    return d == val
+    return d == E.physical_literal(val, dt)
 
 
 def _cast_cpu(d, v, src: T.DataType, dst: T.DataType) -> Value:

@@ -244,6 +244,31 @@ class Cast(Expression):
 # Arithmetic (reference: org/apache/spark/sql/rapids/arithmetic.scala)
 # ---------------------------------------------------------------------------------
 
+def promote_physical(data: jax.Array, src: DataType, dst: DataType) -> jax.Array:
+    """Convert a physical device value from ``src``'s representation to
+    ``dst``'s, honoring decimal scale (decimals are scaled int64 on device).
+
+    A plain astype of a decimal's scaled int would be silently off by
+    10^scale; promotion must rescale (decimal→float divides by 10^scale,
+    decimal→decimal shifts by the scale delta, int→decimal multiplies in).
+    """
+    np_dt = dst.numpy_dtype
+    if src.is_decimal and dst.is_floating:
+        return data.astype(np_dt) / np.float64(10.0 ** src.scale).astype(np_dt)
+    if src.is_decimal and dst.is_decimal:
+        if dst.scale == src.scale:
+            return data
+        if dst.scale > src.scale:
+            return data * np.int64(10 ** (dst.scale - src.scale))
+        return _round_div(data, 10 ** (src.scale - dst.scale))
+    if dst.is_decimal and not src.is_decimal:
+        # integral (or bool) operand joining a decimal computation
+        return data.astype(np_dt) * np.int64(10 ** dst.scale)
+    if data.dtype != np_dt:
+        return data.astype(np_dt)
+    return data
+
+
 class BinaryExpression(Expression):
     symbol = "?"
 
@@ -266,11 +291,8 @@ class BinaryExpression(Expression):
         ld, lv = l.eval(ctx)
         rd, rv = r.eval(ctx)
         ct = self._operand_type()
-        np_dt = ct.numpy_dtype
-        if ld.dtype != np_dt:
-            ld = ld.astype(np_dt)
-        if rd.dtype != np_dt:
-            rd = rd.astype(np_dt)
+        ld = promote_physical(ld, l.dtype, ct)
+        rd = promote_physical(rd, r.dtype, ct)
         return ld, rd, _and_valid(lv, rv)
 
     def _operand_type(self) -> DataType:
@@ -297,19 +319,28 @@ class Multiply(BinaryExpression):
     symbol = "*"
 
     def eval(self, ctx):
-        ld, rd, v = self._eval_children_promoted(ctx)
         if self.dtype.is_decimal:
-            # decimal*decimal doubles the scale; rescale back (round half up).
-            ls = self.children[0].dtype.scale
-            rs = self.children[1].dtype.scale
+            # Evaluate operands at their OWN scales (promotion to a common
+            # scale would inflate the product scale): scaled-int product has
+            # scale ls+rs; rescale to the result scale (round half up).
+            l, r = self.children
+            ld, lv = l.eval(ctx)
+            rd, rv = r.eval(ctx)
+            ls = l.dtype.scale if l.dtype.is_decimal else 0
+            rs = r.dtype.scale if r.dtype.is_decimal else 0
+            prod = ld.astype(jnp.int64) * rd.astype(jnp.int64)
             drop = ls + rs - self.dtype.scale
-            prod = ld * rd
             if drop > 0:
                 prod = _round_div(prod, 10 ** drop)
-            return prod, v
+            return prod, _and_valid(lv, rv)
+        ld, rd, v = self._eval_children_promoted(ctx)
         return ld * rd, v
 
     def _result_type(self, lt, rt):
+        if lt.is_decimal and rt.is_integral:
+            rt = T.integral_as_decimal(rt)
+        if rt.is_decimal and lt.is_integral:
+            lt = T.integral_as_decimal(lt)
         if lt.is_decimal and rt.is_decimal:
             p = min(lt.precision + rt.precision + 1, 18)
             s = min(lt.scale + rt.scale, p)

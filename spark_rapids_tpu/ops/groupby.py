@@ -161,6 +161,22 @@ def _reduce_segment(data: jax.Array, valid: Optional[jax.Array], op: str,
                 num_segments=num_segments)
             v = vout > 0
         return out, v
+    if op in ("first_valid", "last_valid"):
+        # first/last(ignore_nulls=True): pick the first/last row in the
+        # segment that is both active and non-null (not merely the segment
+        # boundary row) via a segment min/max over row indices.
+        n = data.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        if op == "first_valid":
+            cand = jnp.where(m, idx, n)  # sentinel past the end
+            best = jax.ops.segment_min(cand, seg_ids, num_segments=num_segments)
+            has = best < n
+        else:
+            cand = jnp.where(m, idx, -1)
+            best = jax.ops.segment_max(cand, seg_ids, num_segments=num_segments)
+            has = best >= 0
+        safe = jnp.clip(best, 0, n - 1)
+        return jnp.where(has, data[safe], jnp.zeros_like(data[safe])), has
     raise ValueError(f"unknown reduce op {op}")
 
 
@@ -218,13 +234,16 @@ def ungrouped_reduce(contributions: List[Tuple[Value, str]], active: jax.Array):
             sentinel = _SENTINELS[op][kind](d.dtype)
             masked = jnp.where(m, d, jnp.full_like(d, sentinel))
             outs.append(((jnp.min if op == "min" else jnp.max)(masked), None))
-        elif op == "first":
-            idx = jnp.argmax(m)  # first True
-            outs.append((d[idx], (v[idx] if v is not None else None)))
-        elif op == "last":
-            rev = m[::-1]
-            idx = d.shape[0] - 1 - jnp.argmax(rev)
-            outs.append((d[idx], (v[idx] if v is not None else None)))
+        elif op in ("first", "last", "first_valid", "last_valid"):
+            # Validity of the partial encodes "this batch had a qualifying
+            # row" so the cross-batch merge can skip empty partials (an
+            # all-filtered batch must not win the merge with padding data).
+            has = jnp.any(m)
+            if op in ("first", "first_valid"):
+                idx = jnp.argmax(m)
+            else:
+                idx = d.shape[0] - 1 - jnp.argmax(m[::-1])
+            outs.append((jnp.where(has, d[idx], jnp.zeros_like(d[idx])), has))
         else:
             raise ValueError(op)
     return outs

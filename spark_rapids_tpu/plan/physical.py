@@ -357,10 +357,16 @@ class AggregateExec(TpuExec):
                 out.append((jnp.minimum(ad, bd), None))
             elif op == "max":
                 out.append((jnp.maximum(ad, bd), None))
-            elif op == "first":
-                out.append((ad, av))
-            elif op == "last":
-                out.append((bd, bv))
+            elif op in ("first", "first_valid"):
+                # validity channel = "partial had a qualifying row"; keep the
+                # earlier partial only when it actually saw one
+                ha = jnp.asarray(True) if av is None else av
+                hb = jnp.asarray(True) if bv is None else bv
+                out.append((jnp.where(ha, ad, bd), ha | hb))
+            elif op in ("last", "last_valid"):
+                ha = jnp.asarray(True) if av is None else av
+                hb = jnp.asarray(True) if bv is None else bv
+                out.append((jnp.where(hb, bd, ad), ha | hb))
             else:
                 raise ValueError(op)
         return out

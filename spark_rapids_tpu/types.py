@@ -158,6 +158,16 @@ def decimal(precision: int, scale: int) -> DataType:
 _INT_WIDENING = [TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64]
 
 
+# Max decimal digits an integral type can hold (Spark's DecimalType.forType).
+_INT_DECIMAL_DIGITS = {TypeKind.INT8: 3, TypeKind.INT16: 5,
+                       TypeKind.INT32: 10, TypeKind.INT64: 19}
+
+
+def integral_as_decimal(a: DataType) -> DataType:
+    """View an integral type as the narrowest decimal that can hold it."""
+    return decimal(min(_INT_DECIMAL_DIGITS[a.kind], 18), 0)
+
+
 def common_type(a: DataType, b: DataType) -> DataType:
     """Spark's findTightestCommonType subset for binary arithmetic/comparison."""
     if a == b:
@@ -175,10 +185,15 @@ def common_type(a: DataType, b: DataType) -> DataType:
         return b if b.kind == TypeKind.FLOAT64 or a.kind in _INT_WIDENING[:2] else FLOAT64
     if (b.is_integral and a.is_floating):
         return common_type(b, a)
+    if a.is_decimal and b.is_decimal:
+        # widest integral part + widest scale (Spark widerDecimalType)
+        s = max(a.scale, b.scale)
+        ip = max(a.precision - a.scale, b.precision - b.scale)
+        return decimal(min(ip + s, 18), s)
     if a.is_decimal and b.is_integral:
-        return a
+        return common_type(a, integral_as_decimal(b))
     if b.is_decimal and a.is_integral:
-        return b
+        return common_type(integral_as_decimal(a), b)
     if (a.is_decimal and b.is_floating) or (b.is_decimal and a.is_floating):
         return FLOAT64
     raise TypeError(f"no common type for {a} and {b}")

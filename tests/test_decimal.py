@@ -1,0 +1,164 @@
+"""Decimal semantics: operand promotion, mixed-type compare/divide, agg.
+
+Regression tests for the round-1 advisor finding: decimal operands were
+astype'd without rescaling, so decimal(5,2) 2.00 == 2 matched nothing and
+1.50/2 returned 75.0.  Reference semantics: GpuCast.scala / decimal rules in
+arithmetic.scala (Spark widerDecimalType promotion).
+"""
+
+from decimal import Decimal
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+
+def _df(session, rows, scale=2, precision=5):
+    t = pa.table({
+        "d": pa.array([None if r is None else Decimal(r).quantize(
+            Decimal(1).scaleb(-scale)) for r in rows],
+            type=pa.decimal128(precision, scale)),
+        "i": pa.array(list(range(len(rows))), type=pa.int32()),
+    })
+    return session.create_dataframe(t)
+
+
+class TestDecimalPromotion:
+    def test_decimal_eq_int_literal(self, session):
+        df = _df(session, ["1.00", "2.00", "2.50", None])
+        out = df.where(F.col("d") == 2).to_pandas()
+        assert list(out["i"]) == [1]
+
+    def test_decimal_lt_int_literal(self, session):
+        df = _df(session, ["1.00", "2.00", "2.50", "3.00"])
+        out = df.where(F.col("d") < 3).to_pandas()
+        assert sorted(out["i"]) == [0, 1, 2]
+
+    def test_decimal_divide_int(self, session):
+        df = _df(session, ["1.50", "3.00"])
+        out = df.select((F.col("d") / 2).alias("h")).to_pandas()
+        assert list(out["h"]) == [0.75, 1.5]
+
+    def test_decimal_divide_decimal(self, session):
+        df = _df(session, ["1.50", "3.00"])
+        out = df.select((F.col("d") / F.col("d")).alias("r")).to_pandas()
+        assert list(out["r"]) == [1.0, 1.0]
+
+    def test_mixed_scale_add(self, session):
+        t = pa.table({
+            "a": pa.array([Decimal("1.5")], type=pa.decimal128(5, 1)),
+            "b": pa.array([Decimal("0.25")], type=pa.decimal128(5, 2)),
+        })
+        df = session.create_dataframe(t)
+        out = df.select((F.col("a") + F.col("b")).alias("s")).to_pandas()
+        assert out["s"][0] == Decimal("1.75")
+
+    def test_mixed_scale_compare(self, session):
+        t = pa.table({
+            "a": pa.array([Decimal("1.5"), Decimal("2.0")],
+                          type=pa.decimal128(5, 1)),
+            "b": pa.array([Decimal("1.50"), Decimal("2.01")],
+                          type=pa.decimal128(6, 2)),
+        })
+        df = session.create_dataframe(t)
+        out = df.where(F.col("a") == F.col("b")).to_pandas()
+        assert len(out) == 1
+        assert out["a"][0] == Decimal("1.5")
+
+    def test_decimal_plus_int_column(self, session):
+        df = _df(session, ["1.00", "2.00", "3.00"])
+        out = df.select((F.col("d") + F.col("i")).alias("s")).to_pandas()
+        assert list(out["s"]) == [Decimal("1.00"), Decimal("3.00"),
+                                  Decimal("5.00")]
+
+    def test_decimal_mul_int(self, session):
+        df = _df(session, ["1.25", "2.00"])
+        out = df.select((F.col("d") * 4).alias("m")).to_pandas()
+        assert list(out["m"]) == [Decimal("5.00"), Decimal("8.00")]
+
+    def test_decimal_compare_float(self, session):
+        df = _df(session, ["1.25", "2.00"])
+        out = df.where(F.col("d") > 1.5).to_pandas()
+        assert list(out["d"]) == [Decimal("2.00")]
+
+    def test_decimal_in_list(self, session):
+        df = _df(session, ["1.00", "2.00", "3.00"])
+        out = df.where(F.col("d").isin([1, 3])).to_pandas()
+        assert sorted(out["i"]) == [0, 2]
+
+    def test_null_propagation(self, session):
+        df = _df(session, ["1.00", None])
+        out = df.select((F.col("d") + 1).alias("s")).to_pandas()
+        assert out["s"][0] == Decimal("2.00")
+        assert out["s"][1] is None
+
+
+class TestFirstLastIgnoreNulls:
+    def _df(self, session):
+        t = pa.table({
+            "k": pa.array([1, 1, 1, 2, 2, 3]),
+            "v": pa.array([None, 10, 20, None, None, 7], type=pa.int64()),
+        })
+        return session.create_dataframe(t)
+
+    def test_first_ignore_nulls(self, session):
+        df = self._df(session)
+        out = df.group_by("k").agg(
+            F.first(F.col("v"), ignore_nulls=True).alias("f")).to_pandas()
+        got = dict(zip(out["k"], out["f"]))
+        assert got[1] == 10
+        assert got[2] is None or (got[2] != got[2])  # all-null group -> null
+        assert got[3] == 7
+
+    def test_last_ignore_nulls(self, session):
+        df = self._df(session)
+        out = df.group_by("k").agg(
+            F.last(F.col("v"), ignore_nulls=True).alias("l")).to_pandas()
+        got = dict(zip(out["k"], out["l"]))
+        assert got[1] == 20
+        assert got[3] == 7
+
+    def test_first_keep_nulls(self, session):
+        df = self._df(session)
+        out = df.group_by("k").agg(
+            F.first(F.col("v")).alias("f")).to_pandas()
+        got = dict(zip(out["k"], out["f"]))
+        # first row of group 1 is null
+        assert got[1] is None or got[1] != got[1]
+        assert got[3] == 7
+
+    def test_ungrouped_first_ignore_nulls(self, session):
+        t = pa.table({"v": pa.array([None, None, 5, 9], type=pa.int64())})
+        df = session.create_dataframe(t)
+        out = df.agg(F.first(F.col("v"), ignore_nulls=True).alias("f"),
+                     F.last(F.col("v"), ignore_nulls=True).alias("l")
+                     ).to_pandas()
+        assert out["f"][0] == 5
+        assert out["l"][0] == 9
+
+    def test_first_across_batches_with_empty_batch(self, fresh_session):
+        # multi-batch input where the FIRST batch is entirely filtered out:
+        # the merge must not let the empty partial win with padding data
+        fresh_session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 4)
+        import pandas as pd
+        pdf = pd.DataFrame({
+            "k": [0, 0, 0, 0, 1, 1, 1, 1],
+            "v": [100, 101, 102, 103, 7, 8, 9, 10],
+        })
+        df = fresh_session.create_dataframe(pdf)
+        out = (df.where(F.col("k") == 1)
+                 .agg(F.first(F.col("v")).alias("f"),
+                      F.last(F.col("v")).alias("l")).to_pandas())
+        assert out["f"][0] == 7
+        assert out["l"][0] == 10
+
+    def test_first_all_rows_filtered(self, fresh_session):
+        fresh_session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 4)
+        import pandas as pd
+        pdf = pd.DataFrame({"k": [0] * 8, "v": list(range(8))})
+        df = fresh_session.create_dataframe(pdf)
+        out = (df.where(F.col("k") == 1)
+                 .agg(F.first(F.col("v")).alias("f")).to_pandas())
+        assert out["f"][0] is None or out["f"][0] != out["f"][0]
