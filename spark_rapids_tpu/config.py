@@ -158,7 +158,26 @@ SHUFFLE_COMPRESS = register(
 READER_THREADS = register(
     "spark.rapids.tpu.sql.multiThreadedRead.numThreads", 8,
     "Threads prefetching and parsing input files to host memory while the "
-    "device computes (multi-file cloud reader analog).")
+    "device computes (multi-file cloud reader analog). 0 disables prefetch.")
+
+SCAN_EXACT_FILTER = register(
+    "spark.rapids.tpu.sql.scan.exactFilterPushdown", True,
+    "Apply fully-pushable filter conjuncts on host during the scan (Arrow "
+    "C++ kernels) so filtered-out rows never pay the host→HBM upload. The "
+    "device filter still evaluates the complete condition; this is the "
+    "late-materialization analog of the reference pushing predicates into "
+    "the device decode.")
+
+FILE_CACHE_ENABLED = register(
+    "spark.rapids.tpu.sql.fileCache.enabled", False,
+    "Cache decoded Arrow tables of scanned files in host memory (keyed by "
+    "path+mtime+columns+row-groups) so repeated scans skip the parquet "
+    "decode. Analog of the reference's FileCache (filecache.md).")
+
+FILE_CACHE_MAX_BYTES = register(
+    "spark.rapids.tpu.sql.fileCache.maxBytes", 4 << 30,
+    "Byte budget for the decoded-file cache; least-recently-used files are "
+    "evicted beyond it.")
 
 MAX_READER_BATCH_BYTES = register(
     "spark.rapids.tpu.sql.reader.batchSizeBytes", 512 << 20,

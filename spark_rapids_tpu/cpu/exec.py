@@ -304,17 +304,116 @@ class CpuOpExec(TpuExec):
         return key
 
     def _run_join(self, ctx, p: L.Join):
+        """SQL-semantics host join (GpuHashJoin CPU twin).
+
+        Matches are computed as (left-row, right-row) index pairs over the
+        inner equi-join, with the residual condition applied to the *pairs*
+        (outer-join conditions affect matching, not post-filtering); outer
+        rows are then null-padded from the unmatched index sets.  pandas
+        merge alone is wrong twice over: it matches NA keys to each other
+        and cannot express per-pair residual conditions.
+        """
         import pandas as pd
         import pyarrow as pa
         lt = self._child_table(ctx, 0)
         rt = self._child_table(ctx, 1)
-        how = {"inner": "inner", "left": "left", "left_outer": "left",
-               "right": "right", "right_outer": "right", "full": "outer",
-               "full_outer": "outer"}.get(p.how)
+        how = {"left_outer": "left", "right_outer": "right",
+               "full_outer": "full", "left_semi": "semi",
+               "left_anti": "anti"}.get(p.how, p.how)
         using = getattr(p, "using", None)
-        if how is None or using is None:
-            raise NotImplementedError(f"CPU join how={p.how}")
+        if using is None and how != "cross":
+            raise NotImplementedError("CPU join requires 'using' keys")
         lpd, rpd = lt.to_pandas(), rt.to_pandas()
-        merged = lpd.merge(rpd, on=using, how=how,
-                           suffixes=("", "#r"))
-        return pa.Table.from_pandas(merged, preserve_index=False)
+        lpd = lpd.reset_index(drop=True)
+        rpd = rpd.reset_index(drop=True)
+
+        if how == "cross":
+            li = np.repeat(np.arange(len(lpd)), len(rpd))
+            ri = np.tile(np.arange(len(rpd)), len(lpd))
+        else:
+            lk = lpd[using].copy()
+            rk = rpd[using].copy()
+            lk["__li"] = np.arange(len(lpd))
+            rk["__ri"] = np.arange(len(rpd))
+            # SQL: null keys never match
+            lk = lk.dropna(subset=using)
+            rk = rk.dropna(subset=using)
+            pairs = lk.merge(rk, on=using, how="inner")
+            li = pairs["__li"].to_numpy()
+            ri = pairs["__ri"].to_numpy()
+
+        if p.condition is not None and len(li):
+            joined = pd.concat(
+                [lpd.iloc[li].reset_index(drop=True),
+                 rpd.drop(columns=using or []).iloc[ri].reset_index(drop=True)],
+                axis=1)
+            jt = pa.Table.from_pandas(joined, preserve_index=False)
+            pair_schema = self._join_pair_schema(p)
+            vals = arrow_to_values(jt, pair_schema)
+            d, v = eval_cpu(bind(p.condition, pair_schema), vals, len(joined))
+            keep = d if v is None else (d & v)
+            li, ri = li[keep], ri[keep]
+
+        if how in ("inner", "cross"):
+            return self._join_emit(p, lpd, rpd, using, li, ri, [], [])
+        if how == "semi":
+            sel = np.zeros(len(lpd), dtype=bool)
+            sel[li] = True
+            return pa.Table.from_pandas(lpd[sel], preserve_index=False)
+        if how == "anti":
+            sel = np.ones(len(lpd), dtype=bool)
+            sel[li] = False
+            return pa.Table.from_pandas(lpd[sel], preserve_index=False)
+        l_unmatched = np.setdiff1d(np.arange(len(lpd)), li) \
+            if how in ("left", "full") else np.array([], dtype=int)
+        r_unmatched = np.setdiff1d(np.arange(len(rpd)), ri) \
+            if how in ("right", "full") else np.array([], dtype=int)
+        return self._join_emit(p, lpd, rpd, using, li, ri,
+                               l_unmatched, r_unmatched)
+
+    def _join_pair_schema(self, p: L.Join) -> Schema:
+        """Schema of matched pairs (left ++ right-minus-using), all columns
+        as in the inner join, for residual condition binding."""
+        from ..batch import Field
+        l, r = p.children[0].schema(), p.children[1].schema()
+        using = set(getattr(p, "using", []) or [])
+        return Schema(list(l.fields)
+                      + [f for f in r.fields if f.name not in using])
+
+    def _join_emit(self, p, lpd, rpd, using, li, ri, l_un, r_un):
+        import pandas as pd
+        import pyarrow as pa
+        using = using or []
+        rcols = [c for c in rpd.columns if c not in using]
+        parts = []
+        core = pd.concat(
+            [lpd.iloc[li].reset_index(drop=True),
+             rpd[rcols].iloc[ri].reset_index(drop=True)], axis=1)
+        parts.append(core)
+        if len(l_un):
+            lu = lpd.iloc[l_un].reset_index(drop=True)
+            for c in rcols:
+                lu[c] = pd.Series([None] * len(lu), dtype=object)
+            parts.append(lu)
+        if len(r_un):
+            ru = rpd.iloc[r_un].reset_index(drop=True)
+            out = pd.DataFrame()
+            for c in lpd.columns:
+                # USING keys surface from the right side (coalesce semantics)
+                out[c] = ru[c] if c in using else pd.Series(
+                    [None] * len(ru), dtype=object)
+            for c in rcols:
+                out[c] = ru[c]
+            parts.append(out)
+        merged = pd.concat(parts, ignore_index=True) if len(parts) > 1 \
+            else parts[0]
+        arrays = []
+        from ..batch import logical_to_arrow
+        for f in p.schema():
+            s = merged[f.name]
+            arrays.append(pa.array(
+                [None if (x is None or (not isinstance(x, float) and
+                                        pd.isna(x))
+                          ) else x for x in s],
+                type=logical_to_arrow(f.dtype)))
+        return pa.table(dict(zip(p.schema().names(), arrays)))

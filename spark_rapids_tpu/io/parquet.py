@@ -1,23 +1,39 @@
-"""Parquet scan source.
+"""Parquet scan source with pushdown, row-group pruning, and prefetch.
 
 Reference: GpuParquetScan.scala (2,911 LoC) — host-side footer parse, row-group
-clipping by predicate, host buffer assembly, then device decode via
-``Table.readParquet``.  The TPU analog: pyarrow does the host-side parse and
-decode into Arrow host memory (replacing BOTH the footer parse and the cuDF
-device decode — there is no TPU parquet decoder, and column-major numeric
-upload is cheap), and the scan exec uploads columns to HBM.  Row-group
-pruning via parquet statistics mirrors the reference's predicate pushdown.
+clipping by predicate (GpuParquetScan.scala:655-661), host buffer assembly,
+then device decode; plus the threaded cloud reader
+(GpuMultiFileReader.scala:431) that prefetches files on a CPU pool while the
+device computes.  The TPU analog: pyarrow does the host-side parse and decode
+into Arrow host memory (there is no TPU parquet decoder and column-major
+numeric upload is cheap); this module adds the same three scan optimizations
+the reference has:
+
+  * **column pruning** — the planner pushes the plan's referenced-column set
+    into the source so unused columns are never decoded or uploaded;
+  * **predicate pushdown** — simple comparison conjuncts prune whole row
+    groups via parquet footer statistics;
+  * **prefetch** — a background thread decodes the next batch while the
+    caller uploads/computes the current one (pyarrow parallelizes the column
+    decode internally across ``numThreads``).
 """
 
 from __future__ import annotations
 
 import glob as _glob
 import os
-from typing import Callable, Iterator, List, Optional, Tuple
+import queue
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 from ..batch import Field, Schema, _arrow_to_logical
 
-__all__ = ["parquet_schema", "parquet_source", "expand_paths"]
+__all__ = ["parquet_schema", "parquet_source", "expand_paths", "ParquetSource",
+           "prune_row_groups", "Predicate"]
+
+# A pushed-down predicate conjunct: (column, op, value) with op one of
+# < <= > >= == != in isnotnull ("in" carries a list value).
+Predicate = Tuple[str, str, object]
 
 
 def expand_paths(path) -> List[str]:
@@ -46,27 +62,245 @@ def parquet_schema(paths: List[str], columns: Optional[List[str]] = None) -> Sch
     return Schema(fields)
 
 
+def _stat_keep(stats, op: str, value, num_rows: int) -> bool:
+    """Can any row in a row group with these stats satisfy (col op value)?"""
+    if op == "isnotnull":
+        return stats is None or not getattr(stats, "has_null_count", False) \
+            or stats.null_count < num_rows
+    if stats is None or not stats.has_min_max:
+        return True
+    lo, hi = stats.min, stats.max
+    try:
+        if op == "<":
+            return lo < value
+        if op == "<=":
+            return lo <= value
+        if op == ">":
+            return hi > value
+        if op == ">=":
+            return hi >= value
+        if op == "==":
+            return lo <= value <= hi
+        if op == "!=":
+            return not (lo == hi == value)
+        if op == "in":
+            return any(lo <= v <= hi for v in value if v is not None)
+    except TypeError:
+        return True  # incomparable stat/literal types: cannot prune
+    return True
+
+
+def prune_row_groups(pq_file, predicates: Sequence[Predicate]) -> List[int]:
+    """Row-group indices that may contain matching rows
+    (GpuParquetScan.scala:655-661 row-group clipping analog)."""
+    md = pq_file.metadata
+    if not predicates:
+        return list(range(md.num_row_groups))
+    name_to_idx = {md.schema.column(i).path: i
+                   for i in range(md.num_columns)}
+    keep: List[int] = []
+    for rg in range(md.num_row_groups):
+        rgm = md.row_group(rg)
+        ok = True
+        for name, op, value in predicates:
+            ci = name_to_idx.get(name)
+            if ci is None:
+                continue
+            col = rgm.column(ci)
+            stats = col.statistics if col.is_stats_set else None
+            if not _stat_keep(stats, op, value, rgm.num_rows):
+                ok = False
+                break
+        if ok:
+            keep.append(rg)
+    return keep
+
+
+def _exact_filter_mask(table, predicates: Sequence[Predicate]):
+    """Kleene-AND mask of the pushed conjuncts over a decoded host table.
+
+    Applying this before upload is the TPU analog of late materialization:
+    selective queries never pay the host→HBM transfer for rows the device
+    filter would immediately drop.  Each conjunct mirrors SQL comparison
+    semantics (null compares → null → row dropped), matching the device
+    filter that still runs downstream, so filtering here is exact, not
+    advisory.  Returns None when any conjunct cannot be applied exactly.
+    """
+    import pyarrow.compute as pc
+    mask = None
+    ops = {"<": pc.less, "<=": pc.less_equal, ">": pc.greater,
+           ">=": pc.greater_equal, "==": pc.equal}
+    for name, op, value in predicates:
+        if name not in table.column_names:
+            return None
+        col = table[name]
+        try:
+            if op in ops:
+                m = ops[op](col, value)
+            elif op == "in":
+                # null list elements only affect non-matching rows (null
+                # result), which the filter drops either way
+                import pyarrow as pa
+                vals = [v for v in value if v is not None]
+                m = pc.is_in(col, value_set=pa.array(
+                    vals, type=col.type if hasattr(col, "type") else None))
+            elif op == "isnotnull":
+                m = pc.is_valid(col)
+            else:
+                return None
+        except Exception:
+            return None  # incomparable literal/column types: skip exact path
+        mask = m if mask is None else pc.and_kleene(mask, m)
+    return mask
+
+
+class ParquetSource:
+    """A rebuildable parquet scan source.
+
+    The planner calls :meth:`with_pushdown` to narrow columns / attach
+    predicates discovered in the plan; calling the instance yields pyarrow
+    Tables (the scan exec uploads them).
+    """
+
+    fmt = "parquet"
+
+    def __init__(self, path, columns: Optional[List[str]] = None,
+                 predicates: Optional[List[Predicate]] = None,
+                 batch_rows: int = 1 << 20, num_threads: int = 8,
+                 cache_bytes: int = 0, exact_filter: bool = True,
+                 _paths: Optional[List[str]] = None):
+        self.path = path
+        self.paths = _paths if _paths is not None else expand_paths(path)
+        if not self.paths:
+            raise FileNotFoundError(f"no parquet files match {path!r}")
+        self.columns = list(columns) if columns is not None else None
+        self.predicates = list(predicates or [])
+        self.batch_rows = batch_rows
+        self.num_threads = num_threads
+        self.cache_bytes = cache_bytes
+        self.exact_filter = exact_filter
+
+    def schema(self) -> Schema:
+        return parquet_schema(self.paths, self.columns)
+
+    def with_pushdown(self, columns: Optional[List[str]],
+                      predicates: Optional[List[Predicate]]) -> "ParquetSource":
+        cols = self.columns
+        if columns is not None:
+            # preserve file order; never widen beyond the current projection
+            base = self.columns if self.columns is not None else \
+                self.schema().names()
+            cols = [c for c in base if c in set(columns)]
+        preds = self.predicates + [p for p in (predicates or [])
+                                   if p not in self.predicates]
+        return ParquetSource(self.path, cols, preds, self.batch_rows,
+                             self.num_threads, self.cache_bytes,
+                             self.exact_filter, _paths=self.paths)
+
+    def describe(self) -> str:
+        d = str(self.path)
+        if self.columns is not None:
+            d += f" cols={self.columns}"
+        if self.predicates:
+            d += f" pushdown={[(n, op) for n, op, _ in self.predicates]}"
+        return d
+
+    # -- reading ------------------------------------------------------------------
+    def _read_file(self, path: str) -> Iterator:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        cache = None
+        key = None
+        if self.cache_bytes > 0:
+            from .filecache import FileCache, get_file_cache
+            cache = get_file_cache(self.cache_bytes)
+        pf = pq.ParquetFile(path)
+        rgs = prune_row_groups(pf, self.predicates)
+        pred_key = tuple((n, op, str(v)) for n, op, v in self.predicates) \
+            if (self.exact_filter and self.predicates) else None
+        if cache is not None:
+            from .filecache import FileCache
+            key = FileCache.key_for(path, self.columns, rgs)
+            if key is not None and pred_key is not None:
+                key = key + (pred_key,)
+            if key is not None:
+                hit = cache.get(key)
+                if hit is not None:
+                    yield from hit
+                    return
+        if not rgs:
+            return
+        acc = [] if (cache is not None and key is not None) else None
+        for rb in pf.iter_batches(batch_size=self.batch_rows, row_groups=rgs,
+                                  columns=self.columns, use_threads=True):
+            t = pa.Table.from_batches([rb])
+            if self.exact_filter and self.predicates:
+                mask = _exact_filter_mask(t, self.predicates)
+                if mask is not None:
+                    t = t.filter(mask)
+                    if t.num_rows == 0:
+                        continue
+            if acc is not None:
+                acc.append(t)
+            yield t
+        if acc is not None:
+            cache.put(key, acc)
+
+    def _read_all(self) -> Iterator:
+        for p in self.paths:
+            yield from self._read_file(p)
+
+    def __call__(self) -> Iterator:
+        """Yield pyarrow Tables, decoding ahead on a prefetch thread.
+
+        The consumer may abandon the iterator mid-stream (LIMIT, errors);
+        a stop event keeps the producer from blocking forever on a full
+        queue and leaking the thread + decoded batches.
+        """
+        if self.num_threads <= 0:
+            yield from self._read_all()
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=4)
+        stop = threading.Event()
+        _END = object()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for t in self._read_all():
+                    if not _put(t):
+                        return
+                _put(_END)
+            except BaseException as ex:  # propagate to consumer
+                _put(ex)
+
+        th = threading.Thread(target=producer, daemon=True,
+                              name="srt-parquet-prefetch")
+        th.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+
 def parquet_source(path, columns: Optional[List[str]] = None,
                    batch_rows: int = 1 << 20,
                    filters=None) -> Tuple[Schema, Callable[[], Iterator]]:
-    """Returns (schema, factory); factory() yields pyarrow Tables.
-
-    ``filters`` (pyarrow filter expression) enables row-group pruning via
-    parquet statistics — predicate pushdown as in the reference's
-    row-group clipping (GpuParquetScan.scala:655-661).
-    """
-    paths = expand_paths(path)
-    if not paths:
-        raise FileNotFoundError(f"no parquet files match {path!r}")
-    schema = parquet_schema(paths, columns)
-
-    def factory() -> Iterator:
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-        for p in paths:
-            pf = pq.ParquetFile(p)
-            for rb in pf.iter_batches(batch_size=batch_rows, columns=columns,
-                                      use_threads=True):
-                yield pa.Table.from_batches([rb])
-
-    return schema, factory
+    """Back-compat helper: returns (schema, factory)."""
+    src = ParquetSource(path, columns=columns, batch_rows=batch_rows,
+                        predicates=filters)
+    return src.schema(), src

@@ -64,21 +64,21 @@ class SortExec(TpuExec):
         yield out
 
 
-@functools.lru_cache(maxsize=256)
-def _sort_perm_cached(fp: str, key_exprs, desc, nf):
-    @jax.jit
-    def f(arrays, num_rows):
-        cap = next(a[0].shape[0] for a in arrays if a is not None)
-        active = jnp.arange(cap, dtype=jnp.int32) < num_rows
-        ectx = EvalContext(list(arrays), cap, active=active)
-        keys = [e.eval(ectx) for e in key_exprs]
-        return groupby.sort_indices_for_keys(keys, active, desc, nf)
-    return f
-
-
 def _sort_perm(key_exprs, desc, nf):
+    from .physical import _cached_program
     fp = "|".join(e.fingerprint() for e in key_exprs) + str(desc) + str(nf)
-    return _sort_perm_cached(fp, key_exprs, desc, nf)
+
+    def build():
+        @jax.jit
+        def f(arrays, num_rows):
+            cap = next(a[0].shape[0] for a in arrays if a is not None)
+            active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+            ectx = EvalContext(list(arrays), cap, active=active)
+            keys = [e.eval(ectx) for e in key_exprs]
+            return groupby.sort_indices_for_keys(keys, active, desc, nf)
+        return f
+
+    return _cached_program("sort|" + fp, build)
 
 
 class LimitExec(TpuExec):
@@ -207,5 +207,5 @@ class ExpandExec(TpuExec):
 
 
 def plan_join(plan, left: TpuExec, right: TpuExec, conf):
-    from .join_exec import ShuffledHashJoinExec
-    return ShuffledHashJoinExec(plan, left, right, conf)
+    from .join_exec import SortMergeJoinExec
+    return SortMergeJoinExec(plan, left, right, conf)

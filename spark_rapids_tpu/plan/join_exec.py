@@ -1,0 +1,397 @@
+"""Device joins: sort-based equi-join for the TPU.
+
+Reference: GpuHashJoin.scala:104-383 (cuDF gather-map hash joins),
+GpuShuffledHashJoinExec.scala:90, GpuBroadcastHashJoinExecBase.scala.  Device
+hash tables are a poor fit for XLA (SURVEY §7.3 prescribes sort-based joins
+on TPU), so the algorithm here is:
+
+  1. evaluate join keys on both sides, promoted to a common type;
+  2. **union group-id encoding**: concatenate both sides' keys, sort once,
+     mark segment starts, and give every row a dense group id — equal keys on
+     either side share an id (nulls never match, as in SQL equi-join);
+  3. sort the build side by group id; for every probe row a pair of
+     ``searchsorted`` calls yields its match range [lo, hi);
+  4. semi/anti joins finish here as a selection mask (no data movement);
+     inner/outer joins compute per-row output counts, sync ONCE to learn the
+     total, and run a static-shape **expansion gather**: output slot j maps
+     to probe row ``searchsorted(cumsum(counts), j)`` and build row
+     ``perm[lo + (j - start)]``, with unmatched outer rows emitting nulls.
+
+Every compiled program is cached by structural fingerprint + shape bucket, so
+repeated joins of the same shape reuse executables (SURVEY §7.2).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..batch import (ColumnBatch, DeviceColumn, Field, HostStringColumn,
+                     Schema, bucket_capacity)
+from ..exprs import EvalContext, Expression, promote_physical
+from ..ops import batch_utils
+from ..ops.groupby import sort_indices_for_keys, _segment_starts
+from .physical import ExecContext, TpuExec, _cached_program
+
+__all__ = ["SortMergeJoinExec"]
+
+_BIG = np.int32(2**31 - 1)
+
+
+def _canon_how(how: str) -> str:
+    return {"left_outer": "left", "right_outer": "right",
+            "full_outer": "full", "left_semi": "semi",
+            "left_anti": "anti"}.get(how, how)
+
+
+class SortMergeJoinExec(TpuExec):
+    def __init__(self, plan, left: TpuExec, right: TpuExec, conf):
+        super().__init__([left, right])
+        self.plan = plan
+        self.how = _canon_how(plan.how)
+        self.condition = plan.condition
+        # single source of truth for join output shape: L.Join.schema()
+        self._schema = plan.schema()
+        self.using = list(getattr(plan, "using", []) or [])
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def node_desc(self):
+        return f"TpuSortMergeJoin [{self.how}]"
+
+    # -- helpers ------------------------------------------------------------------
+    def _bound_keys(self) -> Tuple[List[Expression], List[Expression],
+                                   List[T.DataType]]:
+        from ..exprs import bind
+        lsch = self.children[0].output_schema
+        rsch = self.children[1].output_schema
+        lk = [bind(k, lsch) for k in self.plan.left_keys]
+        rk = [bind(k, rsch) for k in self.plan.right_keys]
+        common = [T.common_type(a.dtype, b.dtype) for a, b in zip(lk, rk)]
+        return lk, rk, common
+
+    def _fingerprint(self) -> str:
+        lk, rk, ct = self._bound_keys()
+        return "|".join([self.how]
+                        + [e.fingerprint() for e in lk]
+                        + [e.fingerprint() for e in rk]
+                        + [str(c) for c in ct])
+
+    def _materialize(self, ctx: ExecContext, side: int) -> ColumnBatch:
+        batches = [batch_utils.compact(b)
+                   for b in self.children[side].execute(ctx)]
+        batches = [b for b in batches if b.num_rows > 0]
+        if not batches:
+            sch = self.children[side].output_schema
+            return _empty_batch(sch)
+        if len(batches) == 1:
+            return batches[0]
+        return batch_utils.compact(batch_utils.concat_batches(batches))
+
+    # -- execution ----------------------------------------------------------------
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        m = ctx.metric_set(self.op_id)
+        left = self._materialize(ctx, 0)
+        right = self._materialize(ctx, 1)
+        with m.time("opTime"):
+            out = self._join(left, right)
+        if self.condition is not None:
+            out = self._apply_residual(out)
+        # row_count (not num_rows): the residual/semi/anti selection mask
+        # must be reflected in the metric
+        m.add("numOutputRows", out.row_count())
+        yield out
+
+    def _apply_residual(self, batch: ColumnBatch) -> ColumnBatch:
+        """Inner-join residual condition as a post-selection (non-equi part).
+        The planner only routes inner joins with conditions here."""
+        from ..exprs import bind
+        cond = bind(self.condition, batch.schema)
+
+        def build():
+            @jax.jit
+            def f(arrays, sel, num_rows):
+                cap = next(a[0].shape[0] for a in arrays if a is not None)
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(list(arrays), cap, active=active)
+                d, v = cond.eval(ectx)
+                keep = d if v is None else (d & v)
+                return active & keep
+            return f
+
+        fn = _cached_program("join-residual|" + cond.fingerprint(), build)
+        arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
+                       else None for c in batch.columns)
+        sel = fn(arrays, batch.sel, jnp.int32(batch.num_rows))
+        return ColumnBatch(batch.schema, batch.columns, batch.num_rows, sel)
+
+    # -- the join kernel ----------------------------------------------------------
+    def _join(self, left: ColumnBatch, right: ColumnBatch) -> ColumnBatch:
+        how = self.how
+        if how == "cross":
+            return self._cross(left, right)
+        if how == "right":
+            # right join = mirrored left join with output columns re-split
+            return self._outer_join(left, right, probe_side=1)
+        if how in ("inner", "left", "full"):
+            return self._outer_join(left, right, probe_side=0)
+        if how in ("semi", "anti"):
+            return self._semi_anti(left, right)
+        raise NotImplementedError(f"join type {how}")
+
+    def _match_state(self, probe: ColumnBatch, build: ColumnBatch,
+                     probe_side: int):
+        """Compute (lo, hi, matches, build_perm) device arrays."""
+        lk, rk, common = self._bound_keys()
+        pk, bk = (lk, rk) if probe_side == 0 else (rk, lk)
+        fp = self._fingerprint() + f"|ps{probe_side}"
+
+        def build_fn():
+            @jax.jit
+            def f(p_arrays, b_arrays, n_probe, n_build):
+                p_cap = next(a[0].shape[0] for a in p_arrays if a is not None)
+                b_cap = next(a[0].shape[0] for a in b_arrays if a is not None)
+                p_active = jnp.arange(p_cap, dtype=jnp.int32) < n_probe
+                b_active = jnp.arange(b_cap, dtype=jnp.int32) < n_build
+                pctx = EvalContext(list(p_arrays), p_cap, active=p_active)
+                bctx = EvalContext(list(b_arrays), b_cap, active=b_active)
+                pkv = [e.eval(pctx) for e in pk]
+                bkv = [e.eval(bctx) for e in bk]
+                # promote to common key types, then union-encode
+                pkv = [(promote_physical(d, e.dtype, ct), v)
+                       for (d, v), e, ct in zip(pkv, pk, common)]
+                bkv = [(promote_physical(d, e.dtype, ct), v)
+                       for (d, v), e, ct in zip(bkv, bk, common)]
+                # null keys never match
+                def _ok(kvs, active):
+                    ok = active
+                    for d, v in kvs:
+                        if v is not None:
+                            ok = ok & v
+                    return ok
+                p_ok = _ok(pkv, p_active)
+                b_ok = _ok(bkv, b_active)
+                keys = [(jnp.concatenate([pd, bd]), None)
+                        for (pd, _), (bd, _) in zip(pkv, bkv)]
+                union_ok = jnp.concatenate([p_ok, b_ok])
+                perm = sort_indices_for_keys(keys, union_ok)
+                s_keys = [(d[perm], None) for d, _ in keys]
+                s_ok = union_ok[perm]
+                starts = _segment_starts(s_keys, s_ok)
+                gid_sorted = jnp.cumsum(starts.astype(jnp.int32)) - 1
+                gid = jnp.zeros((p_cap + b_cap,), dtype=jnp.int32)
+                gid = gid.at[perm].set(jnp.where(s_ok, gid_sorted, _BIG))
+                p_gid = jnp.where(p_ok, gid[:p_cap], -1)
+                b_gid = jnp.where(b_ok, gid[p_cap:], _BIG)
+                # sort build rows by gid (non-matching rows park at the end)
+                b_perm = jnp.argsort(b_gid)
+                b_gid_sorted = b_gid[b_perm]
+                lo = jnp.searchsorted(b_gid_sorted, p_gid, side="left")
+                hi = jnp.searchsorted(b_gid_sorted, p_gid, side="right")
+                matches = jnp.where(p_ok, (hi - lo).astype(jnp.int32), 0)
+                return lo.astype(jnp.int32), matches, b_perm.astype(jnp.int32)
+            return f
+
+        fn = _cached_program("join-match|" + fp, build_fn)
+        p_arrays = _dev_arrays(probe)
+        b_arrays = _dev_arrays(build)
+        return fn(p_arrays, b_arrays, jnp.int32(probe.num_rows),
+                  jnp.int32(build.num_rows))
+
+    def _semi_anti(self, left: ColumnBatch, right: ColumnBatch) -> ColumnBatch:
+        lo, matches, b_perm = self._match_state(left, right, probe_side=0)
+        active = jnp.arange(left.capacity, dtype=jnp.int32) < left.num_rows
+        sel = (matches > 0) if self.how == "semi" else (matches == 0)
+        sel = sel & active
+        return ColumnBatch(self._schema, left.columns, left.num_rows, sel)
+
+    def _outer_join(self, left: ColumnBatch, right: ColumnBatch,
+                    probe_side: int) -> ColumnBatch:
+        how = self.how
+        probe, build = (left, right) if probe_side == 0 else (right, left)
+        lo, matches, b_perm = self._match_state(probe, build, probe_side)
+        outer = how in ("left", "full", "right")
+        counts = jnp.maximum(matches, 1) if outer else matches
+        active = jnp.arange(probe.capacity, dtype=jnp.int32) < probe.num_rows
+        counts = jnp.where(active, counts, 0)
+        offsets = jnp.cumsum(counts)
+        total = int(offsets[-1])  # the one host sync (output size)
+        extra = 0
+        b_unmatched = None
+        if how == "full":
+            # build-side rows with no probe match are appended afterwards
+            b_unmatched = self._unmatched_build_mask(probe, build, lo, matches,
+                                                     b_perm)
+            extra = int(jnp.sum(b_unmatched))
+        out_cap = bucket_capacity(max(total + extra, 1))
+
+        fp = self._fingerprint() + f"|expand{probe_side}"
+
+        def build_fn():
+            @jax.jit
+            def f(offsets, lo, matches, b_perm, out_cap_arr):
+                out_cap_ = out_cap_arr.shape[0]
+                j = jnp.arange(out_cap_, dtype=jnp.int32)
+                pi = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+                pi_c = jnp.clip(pi, 0, offsets.shape[0] - 1)
+                start = jnp.where(pi_c > 0, offsets[pi_c - 1], 0)
+                k = j - start
+                matched = k < matches[pi_c]
+                bi = b_perm[jnp.clip(lo[pi_c] + k, 0, b_perm.shape[0] - 1)]
+                return pi_c, jnp.where(matched, bi, -1)
+            return f
+
+        fn = _cached_program("join-expand|" + fp, build_fn)
+        pi, bi = fn(offsets, lo, matches, b_perm,
+                    jnp.zeros((out_cap,), dtype=jnp.int8))
+
+        probe_null_ok = how in ("full",)  # probe side can be null-padded
+        p_cols = _gather_cols(probe, pi, valid_if=None)
+        b_cols = _gather_cols(build, bi, valid_if="neg_is_null")
+        if how == "full" and extra > 0:
+            p_cols, b_cols = self._append_unmatched_build(
+                probe, build, b_unmatched, p_cols, b_cols, total, out_cap)
+            total += extra
+        return self._assemble(probe, build, p_cols, b_cols, probe_side, total,
+                              out_cap)
+
+    def _unmatched_build_mask(self, probe, build, lo, matches, b_perm):
+        """Build rows matched by no probe row (for FULL outer)."""
+        fp = self._fingerprint() + "|unmatched"
+
+        def build_fn():
+            @jax.jit
+            def f(lo, matches, b_perm, n_build):
+                b_cap = b_perm.shape[0]
+                hit_sorted = jnp.zeros((b_cap,), dtype=jnp.int32)
+                # scatter-add match ranges: mark [lo, lo+matches) as hit
+                inc = jnp.zeros((b_cap + 1,), dtype=jnp.int32)
+                inc = inc.at[lo].add(jnp.where(matches > 0, 1, 0))
+                ends = jnp.clip(lo + matches, 0, b_cap)
+                inc = inc.at[ends].add(jnp.where(matches > 0, -1, 0))
+                hit_sorted = jnp.cumsum(inc[:-1]) > 0
+                hit = jnp.zeros((b_cap,), dtype=bool).at[b_perm].set(hit_sorted)
+                b_active = jnp.arange(b_cap, dtype=jnp.int32) < n_build
+                return b_active & ~hit
+            return f
+
+        fn = _cached_program("join-unmatched|" + fp, build_fn)
+        return fn(lo, matches, b_perm, jnp.int32(build.num_rows))
+
+    def _append_unmatched_build(self, probe, build, b_unmatched, p_cols,
+                                b_cols, total, out_cap):
+        """FULL outer: place unmatched build rows after the expansion rows."""
+        # destination slots total..total+extra-1 (host-side index math; the
+        # unmatched count is already synced)
+        un_idx = np.flatnonzero(np.asarray(b_unmatched))
+        dest = np.arange(total, total + len(un_idx))
+        # rebuild gather indices on host, then regather once
+        pi_full = np.array(p_cols["idx"])
+        bi_full = np.array(b_cols["idx"])
+        pi_full[dest] = -1
+        bi_full[dest] = un_idx
+        p_cols = _gather_cols(probe, jnp.asarray(pi_full),
+                              valid_if="neg_is_null")
+        b_cols = _gather_cols(build, jnp.asarray(bi_full),
+                              valid_if="neg_is_null")
+        return p_cols, b_cols
+
+    def _assemble(self, probe, build, p_cols, b_cols, probe_side, total,
+                  out_cap) -> ColumnBatch:
+        using = set(self.using)
+        if probe_side == 0:
+            lcols, lsch = p_cols, probe.schema
+            rcols, rsch = b_cols, build.schema
+        else:
+            lcols, lsch = b_cols, build.schema
+            rcols, rsch = p_cols, probe.schema
+        cols: List = []
+        for f, c in zip(lsch, lcols["cols"]):
+            # using-join key columns are coalesced across sides so unmatched
+            # right/full rows still show the key (Spark USING semantics)
+            if f.name in using and self.how in ("right", "full") \
+                    and f.name in rsch and isinstance(c, DeviceColumn):
+                rc = rcols["cols"][rsch.index_of(f.name)]
+                if isinstance(rc, DeviceColumn):
+                    lv = c.valid if c.valid is not None else \
+                        jnp.ones_like(c.data, dtype=bool)
+                    data = jnp.where(lv, c.data, rc.data)
+                    # coalesce: null only where BOTH sides are null
+                    valid = None if rc.valid is None else (lv | rc.valid)
+                    c = DeviceColumn(f.dtype, data, valid)
+            cols.append(c)
+        for f, c in zip(rsch, rcols["cols"]):
+            if f.name in using:
+                continue
+            cols.append(c)
+        return ColumnBatch(self._schema, cols, total)
+
+    def _cross(self, left: ColumnBatch, right: ColumnBatch) -> ColumnBatch:
+        n_l, n_r = left.num_rows, right.num_rows
+        total = n_l * n_r
+        out_cap = bucket_capacity(max(total, 1))
+        j = jnp.arange(out_cap, dtype=jnp.int32)
+        pi = jnp.where(j < total, j // max(n_r, 1), -1)
+        bi = jnp.where(j < total, j % max(n_r, 1), -1)
+        p_cols = _gather_cols(left, pi, valid_if="neg_is_null")
+        b_cols = _gather_cols(right, bi, valid_if="neg_is_null")
+        return self._assemble(left, right, p_cols, b_cols, 0, total, out_cap)
+
+
+# ---------------------------------------------------------------------------------
+# gather helpers
+# ---------------------------------------------------------------------------------
+
+def _dev_arrays(batch: ColumnBatch):
+    return tuple((c.data, c.valid) if isinstance(c, DeviceColumn) else None
+                 for c in batch.columns)
+
+
+def _gather_cols(batch: ColumnBatch, idx: jax.Array, valid_if: Optional[str]):
+    """Gather rows of ``batch`` by (possibly -1) indices.
+
+    valid_if="neg_is_null": idx < 0 produces a null row (outer join padding).
+    Returns {"cols": [...], "idx": idx}.
+    """
+    null_rows = (idx < 0) if valid_if == "neg_is_null" else None
+    safe = jnp.clip(idx, 0, batch.capacity - 1)
+    host_idx = None
+    out: List = []
+    for f, c in zip(batch.schema, batch.columns):
+        if isinstance(c, HostStringColumn):
+            import pyarrow as pa
+            if host_idx is None:
+                np_idx = np.asarray(idx)
+                host_idx = pa.array(
+                    [None if i < 0 or i >= batch.num_rows else int(i)
+                     for i in np_idx], type=pa.int64())
+            out.append(HostStringColumn(c.array.take(host_idx)))
+            continue
+        data = c.data[safe]
+        valid = c.valid[safe] if c.valid is not None else None
+        if null_rows is not None:
+            valid = (~null_rows) if valid is None else (valid & ~null_rows)
+        out.append(DeviceColumn(f.dtype, data, valid))
+    return {"cols": out, "idx": idx}
+
+
+def _empty_batch(schema: Schema) -> ColumnBatch:
+    cap = bucket_capacity(0)
+    cols: List = []
+    for f in schema:
+        if f.dtype.is_string:
+            import pyarrow as pa
+            cols.append(HostStringColumn(pa.nulls(cap, type=pa.string())))
+        else:
+            cols.append(DeviceColumn(
+                f.dtype, jnp.zeros((cap,), dtype=f.dtype.numpy_dtype),
+                jnp.zeros((cap,), dtype=bool)))
+    return ColumnBatch(schema, cols, 0)

@@ -142,8 +142,9 @@ class Join(LogicalPlan):
         l, r = self.children[0].schema(), self.children[1].schema()
         if self.how in ("semi", "anti", "left_semi", "left_anti"):
             return l
+        using = set(getattr(self, "using", []) or [])
         fields = list(l.fields)
-        rf = list(r.fields)
+        rf = [f for f in r.fields if f.name not in using]
         if self.how in ("left", "left_outer", "full", "full_outer"):
             rf = [Field(f.name, f.dtype, True) for f in rf]
         if self.how in ("right", "right_outer", "full", "full_outer"):

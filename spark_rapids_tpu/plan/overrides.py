@@ -157,15 +157,23 @@ class NodeMeta:
             return
         if isinstance(p, L.Join):
             schema_l = p.children[0].schema()
+            schema_r = p.children[1].schema()
             for k in p.left_keys:
                 b = bind(k, schema_l)
-                if strip_alias(b).dtype.is_string:
-                    self.will_not_work(
-                        "join key is string (device dictionary join pending)")
+                for r in expr_reasons(b, allow_string_passthrough=False):
+                    self.will_not_work(f"left join key: {r}")
+            for k in p.right_keys:
+                b = bind(k, schema_r)
+                for r in expr_reasons(b, allow_string_passthrough=False):
+                    self.will_not_work(f"right join key: {r}")
             if p.how not in ("inner", "left", "left_outer", "right",
                              "right_outer", "full", "full_outer", "semi",
                              "anti", "left_semi", "left_anti", "cross"):
                 self.will_not_work(f"join type {p.how} not supported")
+            if p.condition is not None and p.how != "inner":
+                self.will_not_work(
+                    "non-equi residual condition on outer/semi joins "
+                    "changes match semantics (CPU fallback)")
             return
         if isinstance(p, L.Expand):
             schema = p.children[0].schema()
@@ -287,6 +295,8 @@ def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
 def apply_overrides(plan: L.LogicalPlan, conf: Optional[TpuConf] = None
                     ) -> TpuExec:
     conf = conf or TpuConf()
+    from .pushdown import optimize_scans
+    plan = optimize_scans(plan)
     meta = NodeMeta(plan, conf)
     meta.tag()
     mode = conf["spark.rapids.tpu.sql.mode"]
@@ -317,6 +327,8 @@ def apply_overrides(plan: L.LogicalPlan, conf: Optional[TpuConf] = None
 def explain_plan(plan: L.LogicalPlan, conf: Optional[TpuConf] = None) -> str:
     """Explain-only API (ExplainPlan.scala analog)."""
     conf = conf or TpuConf()
+    from .pushdown import optimize_scans
+    plan = optimize_scans(plan)
     meta = NodeMeta(plan, conf)
     meta.tag()
     header = ("*  = runs on TPU\n!  = falls back to CPU (reasons follow "

@@ -112,8 +112,33 @@ class ScanExec(TpuExec):
 # Fused project/filter stage.
 # ---------------------------------------------------------------------------------
 
-_STAGE_CACHE: Dict[str, Callable] = {}
+from collections import OrderedDict
+
+_STAGE_CACHE: "OrderedDict[str, Callable]" = OrderedDict()
 _STAGE_CACHE_LOCK = threading.Lock()
+_STAGE_CACHE_MAX = 512
+
+
+def _cached_program(fp: str, build: Callable[[], Callable]) -> Callable:
+    """Process-wide jitted-program cache keyed by structural fingerprint.
+
+    jax.jit memoizes per function *object*; operators build fresh closures
+    per execution, so without this every query run would recompile (the
+    executable-cache idea from SURVEY §7.2: cache keyed by (HLO, shapes) —
+    here (fingerprint, shapes), jit handling the shapes part).  Bounded LRU:
+    fingerprints embed literal values, so parameterized query streams would
+    otherwise grow it without limit.
+    """
+    with _STAGE_CACHE_LOCK:
+        fn = _STAGE_CACHE.get(fp)
+        if fn is None:
+            fn = build()
+            _STAGE_CACHE[fp] = fn
+            while len(_STAGE_CACHE) > _STAGE_CACHE_MAX:
+                _STAGE_CACHE.popitem(last=False)
+        else:
+            _STAGE_CACHE.move_to_end(fp)
+        return fn
 
 
 class StageExec(TpuExec):
@@ -188,11 +213,8 @@ class StageExec(TpuExec):
         in_schema = child.output_schema
         m = ctx.metric_set(self.op_id)
         fp = self.fingerprint()
-        with _STAGE_CACHE_LOCK:
-            fn = _STAGE_CACHE.get(fp)
-            if fn is None:
-                fn = jax.jit(self._build_fn(in_schema))
-                _STAGE_CACHE[fp] = fn
+        fn = _cached_program(
+            "stage|" + fp, lambda: jax.jit(self._build_fn(in_schema)))
 
         # figure out host pass-through columns for the final projection
         final_proj = None
@@ -281,6 +303,14 @@ class AggregateExec(TpuExec):
         aggs = [f"{a.func}({n})" for n, a in self.agg_exprs]
         return f"TpuHashAggregate [{self.mode}] keys={keys} aggs={aggs}"
 
+    def _fingerprint(self) -> str:
+        """Structural key for the jitted-program cache: a new AggregateExec
+        for the same query shape must reuse the compiled executable."""
+        parts = [self.mode]
+        parts += [f"k:{e.fingerprint()}" for _, e in self.group_exprs]
+        parts += [f"a:{a.fingerprint()}" for _, a in self.agg_exprs]
+        return "|".join(parts)
+
     # -- helpers ------------------------------------------------------------------
     def _buffer_ops(self) -> List[str]:
         ops = []
@@ -310,16 +340,21 @@ class AggregateExec(TpuExec):
         else:
             update = self._update_contributions
 
-        @jax.jit
-        def batch_partials(arrays, sel, num_rows):
-            cap = arrays[0][0].shape[0]
-            active = jnp.arange(cap, dtype=jnp.int32) < num_rows
-            if sel is not None:
-                active = active & sel
-            ectx = EvalContext(arrays, cap, active=active)
-            contribs = update(ectx)
-            return groupby.ungrouped_reduce(
-                [(cv, op) for cv, op in zip(contribs, ops)], active)
+        def build():
+            @jax.jit
+            def batch_partials(arrays, sel, num_rows):
+                cap = arrays[0][0].shape[0]
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(arrays, cap, active=active)
+                contribs = update(ectx)
+                return groupby.ungrouped_reduce(
+                    [(cv, op) for cv, op in zip(contribs, ops)], active)
+            return batch_partials
+
+        batch_partials = _cached_program(
+            "agg-ungrouped|" + self._fingerprint(), build)
 
         acc: Optional[List] = None
         for batch in child.execute(ctx):
@@ -434,18 +469,23 @@ class AggregateExec(TpuExec):
             update = self._update_contributions
             key_eval = self._key_contributions
 
-        @jax.jit
-        def batch_group(arrays, sel, num_rows):
-            cap = arrays[0][0].shape[0]
-            active = jnp.arange(cap, dtype=jnp.int32) < num_rows
-            if sel is not None:
-                active = active & sel
-            ectx = EvalContext(arrays, cap, active=active)
-            keys = key_eval(ectx)
-            contribs = update(ectx)
-            out_keys, out_vals, n_groups, gmask = groupby.group_reduce(
-                keys, [(cv, op) for cv, op in zip(contribs, ops)], active)
-            return out_keys, out_vals, gmask
+        def build():
+            @jax.jit
+            def batch_group(arrays, sel, num_rows):
+                cap = arrays[0][0].shape[0]
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(arrays, cap, active=active)
+                keys = key_eval(ectx)
+                contribs = update(ectx)
+                out_keys, out_vals, n_groups, gmask = groupby.group_reduce(
+                    keys, [(cv, op) for cv, op in zip(contribs, ops)], active)
+                return out_keys, out_vals, gmask
+            return batch_group
+
+        batch_group = _cached_program(
+            "agg-grouped|" + self._fingerprint(), build)
 
         buffer_schema = self._buffer_schema()
         pending: Optional[ColumnBatch] = None
@@ -501,17 +541,21 @@ class AggregateExec(TpuExec):
         n_keys = len(self.group_exprs)
         arrays = tuple((c.data, c.valid) for c in pending.columns)
 
-        @jax.jit
-        def fin(arrays):
-            outs = []
-            i = n_keys
-            for name, agg in self.agg_exprs:
-                nb = len(agg.buffers())
-                data, valid = agg.finalize([arrays[i + k] for k in range(nb)])
-                outs.append((data.astype(agg.dtype.numpy_dtype), valid))
-                i += nb
-            return tuple(outs)
+        def build():
+            @jax.jit
+            def fin(arrays):
+                outs = []
+                i = n_keys
+                for name, agg in self.agg_exprs:
+                    nb = len(agg.buffers())
+                    data, valid = agg.finalize(
+                        [arrays[i + k] for k in range(nb)])
+                    outs.append((data.astype(agg.dtype.numpy_dtype), valid))
+                    i += nb
+                return tuple(outs)
+            return fin
 
+        fin = _cached_program("agg-fin|" + self._fingerprint(), build)
         fin_vals = fin(arrays)
         cols: List[DeviceColumn] = list(pending.columns[:n_keys])
         for (name, agg), (d, v) in zip(self.agg_exprs, fin_vals):

@@ -133,6 +133,12 @@ class DataFrame:
             return DataFrame(node, self.session)
         raise NotImplementedError("join on expressions: pass column names")
 
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        node = L.Join(self._plan, other._plan, [], [], how="cross")
+        return DataFrame(node, self.session)
+
+    crossJoin = cross_join
+
     # -- actions ------------------------------------------------------------------
     def _executed(self):
         return self.session._execute(self._plan)

@@ -67,12 +67,20 @@ class Session:
 
     # -- data sources -------------------------------------------------------------
     def read_parquet(self, path, columns=None) -> DataFrame:
-        from ..io.parquet import parquet_source
+        from ..io.parquet import ParquetSource
         conf = self._tpu_conf()
-        schema, factory = parquet_source(
+        cache_bytes = (
+            conf["spark.rapids.tpu.sql.fileCache.maxBytes"]
+            if conf["spark.rapids.tpu.sql.fileCache.enabled"] else 0)
+        src = ParquetSource(
             path, columns=columns,
-            batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"])
-        node = L.LogicalScan(schema, factory, str(path), fmt="parquet")
+            batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"],
+            num_threads=conf[
+                "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
+            cache_bytes=cache_bytes,
+            exact_filter=conf["spark.rapids.tpu.sql.scan.exactFilterPushdown"])
+        node = L.LogicalScan(src.schema(), src, src.describe(), fmt="parquet")
+        node.source = src
         return DataFrame(node, self)
 
     def read_csv(self, path, schema=None, header: bool = True, sep: str = ","

@@ -1,0 +1,179 @@
+"""Scan pushdown: column pruning, predicate extraction, row-group pruning,
+file cache (GpuParquetScan.scala:655-661 / GpuMultiFileReader.scala:431 /
+filecache.md analogs)."""
+
+import datetime
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.io.parquet import ParquetSource, prune_row_groups
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.pushdown import extract_predicates, optimize_scans
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture(scope="module")
+def pq_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pushdown")
+    path = str(d / "data.parquet")
+    n = 10_000
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "a": pa.array(np.arange(n, dtype=np.int64)),
+        "b": pa.array(rng.uniform(0, 1, n)),
+        "c": pa.array([f"s{i % 100}" for i in range(n)]),
+        "d": pa.array(np.arange(n, dtype=np.int32) % 500),
+    })
+    # small row groups so pruning has something to cut
+    pq.write_table(t, path, row_group_size=1000)
+    return path
+
+
+class TestColumnPruning:
+    def test_scan_narrowed_to_referenced_columns(self, session, pq_path):
+        df = session.read_parquet(pq_path)
+        plan = optimize_scans(
+            df.select((F.col("a") + 1).alias("x"))._plan)
+        scan = plan
+        while scan.children:
+            scan = scan.children[0]
+        assert scan.schema().names() == ["a"]
+
+    def test_filter_columns_survive_pruning(self, session, pq_path):
+        df = session.read_parquet(pq_path)
+        q = df.where(F.col("b") > 0.5).select("a")
+        plan = optimize_scans(q._plan)
+        scan = plan
+        while scan.children:
+            scan = scan.children[0]
+        assert set(scan.schema().names()) == {"a", "b"}
+        out = q.to_pandas()
+        assert list(out.columns) == ["a"]
+
+    def test_count_star_keeps_one_column(self, session, pq_path):
+        df = session.read_parquet(pq_path)
+        assert df.count() == 10_000
+
+    def test_agg_pruned_result_correct(self, session, pq_path):
+        df = session.read_parquet(pq_path)
+        out = df.group_by("d").agg(F.sum(F.col("a")).alias("s")).to_pandas()
+        pdf = pq.read_table(pq_path).to_pandas()
+        expect = pdf.groupby("d")["a"].sum()
+        got = dict(zip(out["d"], out["s"]))
+        assert len(got) == 500
+        assert all(got[k] == expect[k] for k in expect.index)
+
+
+class TestPredicateExtraction:
+    def test_simple_compare(self):
+        preds = extract_predicates((F.col("a") > 5).expr)
+        assert preds == [("a", ">", 5)]
+
+    def test_conjunction(self):
+        cond = ((F.col("a") > 5) & (F.col("b") <= 1.5)).expr
+        assert extract_predicates(cond) == [("a", ">", 5), ("b", "<=", 1.5)]
+
+    def test_flipped_literal(self):
+        from spark_rapids_tpu import exprs as E
+        cond = E.LessThan(E.Literal(5), E.UnresolvedColumn("a"))
+        assert extract_predicates(cond) == [("a", ">", 5)]
+
+    def test_disjunction_not_pushed(self):
+        cond = ((F.col("a") > 5) | (F.col("b") <= 1.5)).expr
+        assert extract_predicates(cond) == []
+
+    def test_in_and_isnotnull(self):
+        assert extract_predicates(F.col("a").isin([1, 2]).expr) == [
+            ("a", "in", [1, 2])]
+        assert extract_predicates(F.col("a").is_not_null().expr) == [
+            ("a", "isnotnull", None)]
+
+
+class TestRowGroupPruning:
+    def test_prunes_by_stats(self, pq_path):
+        pf = pq.ParquetFile(pq_path)
+        # column a is sorted 0..9999, 1000 rows per group
+        kept = prune_row_groups(pf, [("a", ">=", 8000)])
+        assert kept == [8, 9]
+        kept = prune_row_groups(pf, [("a", "<", 1500)])
+        assert kept == [0, 1]
+        kept = prune_row_groups(pf, [("a", "==", 4500)])
+        assert kept == [4]
+
+    def test_no_stats_match_keeps_all(self, pq_path):
+        pf = pq.ParquetFile(pq_path)
+        kept = prune_row_groups(pf, [("b", ">=", 0.0)])
+        assert len(kept) == 10
+
+    def test_contradiction_prunes_all(self, pq_path):
+        pf = pq.ParquetFile(pq_path)
+        assert prune_row_groups(pf, [("a", ">", 10**9)]) == []
+
+    def test_query_result_with_pruning(self, session, pq_path):
+        df = session.read_parquet(pq_path)
+        out = df.where(F.col("a") >= 9995).select("a").to_pandas()
+        assert sorted(out["a"]) == [9995, 9996, 9997, 9998, 9999]
+
+    def test_pruned_empty_result(self, session, pq_path):
+        df = session.read_parquet(pq_path)
+        out = df.where(F.col("a") > 10**9).select("a").to_pandas()
+        assert out is None or len(out) == 0
+
+
+class TestFileCache:
+    def test_cache_hit_same_result(self, pq_path):
+        from spark_rapids_tpu.io import filecache
+        filecache.clear_file_cache()
+        src = ParquetSource(pq_path, columns=["a"], cache_bytes=1 << 30)
+        t1 = list(src())
+        t2 = list(src())
+        assert sum(t.num_rows for t in t1) == sum(t.num_rows for t in t2)
+        cache = filecache.get_file_cache(1 << 30)
+        assert cache.hits >= 1
+
+    def test_cache_disabled_by_default(self, session, pq_path):
+        df = session.read_parquet(pq_path)
+        src = df._plan.source
+        assert src.cache_bytes == 0
+
+    def test_eviction_under_budget(self, pq_path):
+        from spark_rapids_tpu.io.filecache import FileCache
+        c = FileCache(max_bytes=100)
+        t = pa.table({"x": pa.array(np.zeros(1000))})  # 8KB > budget
+        c.put(("k",), [t])
+        assert c.get(("k",)) is None  # too big to cache
+
+    def test_mtime_invalidation(self, tmp_path):
+        path = str(tmp_path / "f.parquet")
+        pq.write_table(pa.table({"x": pa.array([1, 2, 3])}), path)
+        src = ParquetSource(path, cache_bytes=1 << 30)
+        from spark_rapids_tpu.io import filecache
+        filecache.clear_file_cache()
+        assert sum(t.num_rows for t in src()) == 3
+        pq.write_table(pa.table({"x": pa.array([1, 2, 3, 4])}), path)
+        os.utime(path, (0, 0))  # force mtime change
+        assert sum(t.num_rows for t in src()) == 4
+
+
+class TestPrefetch:
+    def test_prefetch_yields_all_batches(self, pq_path):
+        src = ParquetSource(pq_path, batch_rows=1000, num_threads=4)
+        total = sum(t.num_rows for t in src())
+        assert total == 10_000
+
+    def test_prefetch_propagates_errors(self, tmp_path):
+        path = str(tmp_path / "bad.parquet")
+        with open(path, "wb") as f:
+            f.write(b"not parquet")
+        with pytest.raises(Exception):
+            src = ParquetSource(path, num_threads=4)
+            list(src())
+
+    def test_no_prefetch_mode(self, pq_path):
+        src = ParquetSource(pq_path, batch_rows=1000, num_threads=0)
+        assert sum(t.num_rows for t in src()) == 10_000
