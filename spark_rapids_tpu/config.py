@@ -150,6 +150,13 @@ SHUFFLE_PARTITIONS = register(
     "spark.rapids.tpu.sql.shuffle.partitions", 16,
     "Default number of shuffle partitions for exchanges.")
 
+EXCHANGE_ENABLED = register(
+    "spark.rapids.tpu.sql.exchange.enabled", True,
+    "Plan grouped aggregations as partial→exchange→final and equi-joins "
+    "over hash-partitioned sides (the distributed dataflow, realized "
+    "in-process on one chip). Disable to run single-stream complete-mode "
+    "operators.")
+
 SHUFFLE_COMPRESS = register(
     "spark.rapids.tpu.shuffle.compress", True,
     "Compress host-staged shuffle payloads (lz4 via the native host library "

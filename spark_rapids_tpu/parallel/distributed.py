@@ -1,0 +1,160 @@
+"""Distributed execution of planned queries over a jax.sharding.Mesh.
+
+The reference distributes via Spark tasks + the UCX shuffle
+(RapidsShuffleInternalManagerBase.scala); the TPU-native shape is SPMD: the
+*same* partial-aggregate expression programs the single-chip planner builds
+(plan/overrides.py → AggregateExec) run per device shard under ``shard_map``,
+the shuffle is ONE ``lax.all_to_all`` over ICI (parallel/exchange.py), and
+each device finalizes its hash range.  One jitted step = scan partials +
+shuffle + final aggregate for the whole mesh.
+
+This is what the multi-chip dryrun drives: a DataFrame query is planned
+normally, the planner's partial→exchange→final aggregate tree is
+recognized, and its bound expressions are lowered into the SPMD step — the
+planner path and the distributed path share one expression compiler.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["plan_distributed_agg", "distributed_agg_collect"]
+
+
+def _find_agg_tree(phys):
+    """Locate final-agg → exchange → partial-agg in a planned query."""
+    from ..plan.exchange_exec import ShuffleExchangeExec
+    from ..plan.physical import AggregateExec
+    node = phys
+    while node is not None:
+        if isinstance(node, AggregateExec) and node.mode == "final":
+            exch = node.children[0]
+            if isinstance(exch, ShuffleExchangeExec):
+                partial = exch.children[0]
+                if isinstance(partial, AggregateExec) \
+                        and partial.mode == "partial":
+                    return node, exch, partial
+        node = node.children[0] if node.children else None
+    raise ValueError(
+        "plan has no partial->exchange->final aggregate "
+        "(is spark.rapids.tpu.sql.exchange.enabled on?)")
+
+
+def plan_distributed_agg(df, mesh, axis_name: str = "data",
+                         bucket_cap: Optional[int] = None):
+    """Compile a grouped-aggregate DataFrame query into one SPMD step.
+
+    Returns (step_fn, feed) where ``step_fn(*cols)`` is the jitted
+    shard_map program and ``feed(table)`` shards a host table's columns
+    across the mesh.  The query is planned through the normal overrides
+    path; its partial aggregate's bound expressions evaluate inside the
+    step on each device's shard.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..exprs import EvalContext
+    from .exchange import exchange_grouped_agg
+
+    conf = df.session._tpu_conf()
+    from ..plan.overrides import apply_overrides
+    phys = apply_overrides(df._plan, conf)
+    final, exch, partial = _find_agg_tree(phys)
+    scan = partial.children[0]
+    in_schema = scan.output_schema
+    ops = partial._buffer_ops()
+    n_devices = int(np.prod(mesh.devices.shape))
+
+    def step(*cols):
+        cap = cols[0].shape[0]
+        num_rows = cols[-1]
+        data_cols = cols[:-1]
+        active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        arrays = [(d, None) for d in data_cols]
+        ectx = EvalContext(arrays, cap, active=active)
+        keys = [e.eval(ectx) for _, e in partial.group_exprs]
+        contribs = partial._update_contributions(ectx)
+        bc = bucket_cap if bucket_cap is not None else cap
+        fk, fv, fmask, overflow = exchange_grouped_agg(
+            axis_name, n_devices, bc, keys,
+            list(zip(contribs, ops)), active)
+        outs = [d for d, _ in fk] + \
+               [jnp.ones_like(fmask) if v is None else v for _, v in fk] + \
+               [d for d, _ in fv] + \
+               [jnp.ones_like(fmask) if v is None else v for _, v in fv]
+        return tuple(outs) + (fmask, overflow.reshape(1))
+
+    spec_in = tuple(P(axis_name) for _ in range(len(in_schema) + 1))
+    n_out = 2 * len(partial.group_exprs) + 2 * len(ops) + 1
+    spec_out = tuple(P(axis_name) for _ in range(n_out)) + (P(axis_name),)
+    step_fn = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=spec_in,
+                                    out_specs=spec_out))
+
+    def feed(table, rows_per_device: Optional[int] = None):
+        """Shard a pyarrow table row-wise across the mesh (pad per device)."""
+        from ..cpu.exec import arrow_to_values
+        vals = arrow_to_values(table, in_schema)
+        n = table.num_rows
+        per_dev = rows_per_device or -(-n // n_devices)
+        cols = []
+        for (d, v) in vals:
+            pad = np.zeros(per_dev * n_devices, dtype=d.dtype)
+            pad[:n] = d
+            cols.append(jnp.asarray(pad))
+        counts = np.full(n_devices, per_dev, dtype=np.int32)
+        used = min(n, per_dev * n_devices)
+        full, rem = divmod(used, per_dev)
+        counts[full + (1 if rem else 0):] = 0
+        if rem:
+            counts[full] = rem
+        return tuple(cols) + (jnp.asarray(counts),)
+
+    return step_fn, feed, (final, partial, ops)
+
+
+def distributed_agg_collect(df, mesh, table, axis_name: str = "data",
+                            bucket_cap: Optional[int] = None):
+    """Run the SPMD step and finalize to host rows (driver-side collect)."""
+    import jax.numpy as jnp
+
+    step_fn, feed, (final, partial, ops) = plan_distributed_agg(
+        df, mesh, axis_name, bucket_cap)
+    args = feed(table)
+    outs = step_fn(*args)
+    overflow = int(np.sum(np.asarray(outs[-1])))
+    if overflow:
+        raise RuntimeError(f"exchange bucket overflow: {overflow} rows")
+    fmask = np.asarray(outs[-2])
+    nk = len(partial.group_exprs)
+    nb = len(ops)
+    key_data = [np.asarray(outs[i]) for i in range(nk)]
+    key_valid = [np.asarray(outs[nk + i]) for i in range(nk)]
+    buf_data = [np.asarray(outs[2 * nk + i]) for i in range(nb)]
+    buf_valid = [np.asarray(outs[2 * nk + nb + i]) for i in range(nb)]
+    sel = fmask.astype(bool)
+    rows: List[Tuple] = []
+    # finalize per aggregate on host (same finalize exprs as the planner's)
+    import jax.numpy as _jnp
+    fin_cols = []
+    i = 0
+    for name, agg in partial.agg_exprs:
+        n_bufs = len(agg.buffers())
+        vals = [(
+            _jnp.asarray(buf_data[i + k][sel]),
+            _jnp.asarray(buf_valid[i + k][sel]))
+            for k in range(n_bufs)]
+        d, v = agg.finalize(vals)
+        fin_cols.append((np.asarray(d), None if v is None else np.asarray(v)))
+        i += n_bufs
+    n_out = int(sel.sum())
+    for r in range(n_out):
+        row = []
+        for kd, kv in zip(key_data, key_valid):
+            row.append(None if not kv[sel][r] else kd[sel][r].item())
+        for d, v in fin_cols:
+            row.append(None if (v is not None and not v[r]) else d[r].item())
+        rows.append(tuple(row))
+    return rows

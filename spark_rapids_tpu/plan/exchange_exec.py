@@ -1,0 +1,107 @@
+"""Shuffle exchange: hash repartitioning as a plan operator.
+
+Reference: GpuShuffleExchangeExecBase.scala:266-383 (partitioned device
+slicing feeding the shuffle manager) + GpuHashPartitioningBase.scala.  The
+TPU redesign: partition ids are Spark-exact murmur3 (ops/hashing.py) computed
+on device; rows are re-bucketed into one output batch per partition, and
+every downstream operator (final aggregate, shuffled join) processes
+partitions independently — the same dataflow a distributed shuffle produces,
+realized in-process.  Transports (SURVEY §5.8):
+
+  * CACHE_ONLY (this module): partitions stay device-resident in one
+    process — correctness + out-of-core decomposition on a single chip;
+  * ICI (parallel/exchange.py): the same bucketize feeding one
+    ``lax.all_to_all`` across a jax Mesh for stage-resident multi-chip
+    execution (driven by parallel/distributed.py and the multichip dryrun);
+  * HOST (multi-process DCN/gRPC staging) is the planned third tier.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import ColumnBatch, DeviceColumn, HostStringColumn, Schema
+from ..exprs import EvalContext, Expression, promote_physical
+from ..ops import batch_utils
+from ..ops.hashing import spark_partition_id
+from .physical import ExecContext, TpuExec, _cached_program
+
+__all__ = ["ShuffleExchangeExec"]
+
+
+class ShuffleExchangeExec(TpuExec):
+    """Hash-repartition child output into ``n_parts`` partition batches.
+
+    Yields exactly ``n_parts`` batches, one per partition id in order —
+    downstream operators rely on that alignment (a shuffled join zips the
+    two sides' partition streams pairwise).
+    """
+
+    outputs_partitions = True
+
+    def __init__(self, child: TpuExec, key_exprs: List[Expression],
+                 n_parts: int):
+        super().__init__([child])
+        self.key_exprs = key_exprs  # bound against child.output_schema
+        self.n_parts = n_parts
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return (f"TpuShuffleExchange hashpartitioning({len(self.key_exprs)} "
+                f"keys, {self.n_parts})")
+
+    def _pid_fn(self):
+        keys = self.key_exprs
+        n_parts = self.n_parts
+        fp = f"exchange-pid|{n_parts}|" + "|".join(
+            e.fingerprint() for e in keys)
+
+        def build():
+            @jax.jit
+            def f(arrays, sel, num_rows):
+                cap = next(a[0].shape[0] for a in arrays if a is not None)
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(list(arrays), cap, active=active)
+                kvs = [e.eval(ectx) for e in keys]
+                pid = spark_partition_id(kvs, n_parts)
+                # inactive rows park at n_parts (matches no partition)
+                return jnp.where(active, pid, n_parts)
+            return f
+
+        return _cached_program(fp, build)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        m = ctx.metric_set(self.op_id)
+        pid_fn = self._pid_fn()
+        staged: List[Tuple[ColumnBatch, jax.Array]] = []
+        for batch in self.children[0].execute(ctx):
+            with m.time("opTime"):
+                arrays = tuple(
+                    (c.data, c.valid) if isinstance(c, DeviceColumn) else None
+                    for c in batch.columns)
+                pids = pid_fn(arrays, batch.sel, jnp.int32(batch.num_rows))
+            staged.append((batch, pids))
+            m.add("numInputBatches", 1)
+        for p in range(self.n_parts):
+            parts = []
+            for batch, pids in staged:
+                sel = pids == p
+                parts.append(ColumnBatch(batch.schema, batch.columns,
+                                         batch.num_rows, sel))
+            with m.time("opTime"):
+                if len(parts) == 1:
+                    out = batch_utils.compact(parts[0])
+                else:
+                    out = batch_utils.compact(
+                        batch_utils.concat_batches(parts))
+            m.add("numOutputRows", out.num_rows)
+            m.add("numOutputBatches", 1)
+            yield out

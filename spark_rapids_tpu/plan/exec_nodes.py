@@ -207,5 +207,22 @@ class ExpandExec(TpuExec):
 
 
 def plan_join(plan, left: TpuExec, right: TpuExec, conf):
-    from .join_exec import SortMergeJoinExec
+    """Shuffled join: hash-partition both sides on the (common-type-promoted)
+    join keys so each partition pair joins independently
+    (GpuShuffledHashJoinExec.scala:90 dataflow); cross joins and disabled
+    exchange fall through to the single-stream join."""
+    from ..exprs import Cast
+    from .exchange_exec import ShuffleExchangeExec
+    from .join_exec import SortMergeJoinExec, bound_join_keys
+    if (plan.how != "cross" and plan.left_keys
+            and conf["spark.rapids.tpu.sql.exchange.enabled"]):
+        lk, rk, common = bound_join_keys(plan, left.output_schema,
+                                         right.output_schema)
+
+        def promoted(keys):
+            return [k if k.dtype == ct else Cast(k, ct)
+                    for k, ct in zip(keys, common)]
+        n_parts = conf["spark.rapids.tpu.sql.shuffle.partitions"]
+        left = ShuffleExchangeExec(left, promoted(lk), n_parts)
+        right = ShuffleExchangeExec(right, promoted(rk), n_parts)
     return SortMergeJoinExec(plan, left, right, conf)

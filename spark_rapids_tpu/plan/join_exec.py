@@ -42,6 +42,21 @@ __all__ = ["SortMergeJoinExec"]
 _BIG = np.int32(2**31 - 1)
 
 
+def bound_join_keys(plan, lsch: Schema, rsch: Schema):
+    """Bind both sides' join keys and compute the per-pair common type.
+
+    THE single source of key-promotion truth: the shuffle partitioner and
+    the join kernel must hash/compare identical physical values, so both
+    call this helper (a divergence would send equal keys to different
+    partitions and silently drop matches).
+    """
+    from ..exprs import bind
+    lk = [bind(k, lsch) for k in plan.left_keys]
+    rk = [bind(k, rsch) for k in plan.right_keys]
+    common = [T.common_type(a.dtype, b.dtype) for a, b in zip(lk, rk)]
+    return lk, rk, common
+
+
 def _canon_how(how: str) -> str:
     return {"left_outer": "left", "right_outer": "right",
             "full_outer": "full", "left_semi": "semi",
@@ -68,13 +83,8 @@ class SortMergeJoinExec(TpuExec):
     # -- helpers ------------------------------------------------------------------
     def _bound_keys(self) -> Tuple[List[Expression], List[Expression],
                                    List[T.DataType]]:
-        from ..exprs import bind
-        lsch = self.children[0].output_schema
-        rsch = self.children[1].output_schema
-        lk = [bind(k, lsch) for k in self.plan.left_keys]
-        rk = [bind(k, rsch) for k in self.plan.right_keys]
-        common = [T.common_type(a.dtype, b.dtype) for a, b in zip(lk, rk)]
-        return lk, rk, common
+        return bound_join_keys(self.plan, self.children[0].output_schema,
+                               self.children[1].output_schema)
 
     def _fingerprint(self) -> str:
         lk, rk, ct = self._bound_keys()
@@ -97,8 +107,21 @@ class SortMergeJoinExec(TpuExec):
     # -- execution ----------------------------------------------------------------
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         m = ctx.metric_set(self.op_id)
+        lchild, rchild = self.children
+        if lchild.outputs_partitions and rchild.outputs_partitions:
+            # shuffled join: equal keys land in the same partition on both
+            # sides, so partition pairs join independently (bounded memory)
+            for lb, rb in zip(lchild.execute(ctx), rchild.execute(ctx)):
+                if lb.num_rows == 0 and rb.num_rows == 0:
+                    continue
+                yield self._join_pair(ctx, m, lb, rb)
+            return
         left = self._materialize(ctx, 0)
         right = self._materialize(ctx, 1)
+        yield self._join_pair(ctx, m, left, right)
+
+    def _join_pair(self, ctx, m, left: ColumnBatch,
+                   right: ColumnBatch) -> ColumnBatch:
         with m.time("opTime"):
             out = self._join(left, right)
         if self.condition is not None:
@@ -106,7 +129,7 @@ class SortMergeJoinExec(TpuExec):
         # row_count (not num_rows): the residual/semi/anti selection mask
         # must be reflected in the metric
         m.add("numOutputRows", out.row_count())
-        yield out
+        return out
 
     def _apply_residual(self, batch: ColumnBatch) -> ColumnBatch:
         """Inner-join residual condition as a post-selection (non-equi part).

@@ -203,6 +203,27 @@ class NodeMeta:
 # Conversion with fusion + fallback
 # ---------------------------------------------------------------------------------
 
+def _plan_aggregate(child_phys: TpuExec, group_bound, agg_bound,
+                    conf: TpuConf) -> TpuExec:
+    """Grouped aggregation as partial → shuffle exchange → final, the
+    reference's two-phase shape (GpuHashAggregateExec partial/final around
+    GpuShuffleExchangeExec); ungrouped aggregates reduce to one scalar and
+    need no exchange."""
+    if not group_bound or not conf["spark.rapids.tpu.sql.exchange.enabled"]:
+        return AggregateExec(child_phys, group_bound, agg_bound,
+                             mode="complete")
+    from .exchange_exec import ShuffleExchangeExec
+    partial = AggregateExec(child_phys, group_bound, agg_bound, mode="partial")
+    n_parts = conf["spark.rapids.tpu.sql.shuffle.partitions"]
+    buf_schema = partial.output_schema
+    exch_keys = [BoundReference(i, f.dtype, f.nullable, f.name)
+                 for i, f in enumerate(buf_schema.fields[:len(group_bound)])]
+    exchange = ShuffleExchangeExec(partial, exch_keys, n_parts)
+    final_keys = [(n, BoundReference(i, e.dtype, e.nullable, n))
+                  for i, (n, e) in enumerate(group_bound)]
+    return AggregateExec(exchange, final_keys, agg_bound, mode="final")
+
+
 def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
     from ..cpu.exec import CpuOpExec
     p = meta.plan
@@ -245,14 +266,14 @@ def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
         schema = child_phys.output_schema
         group_bound = [(n, bind(e, schema)) for n, e in p.group_exprs]
         agg_bound = [(n, strip_alias(bind(e, schema))) for n, e in p.agg_exprs]
-        return AggregateExec(child_phys, group_bound, agg_bound, mode="complete")
+        return _plan_aggregate(child_phys, group_bound, agg_bound, conf)
 
     if isinstance(p, L.Distinct):
         child_phys = _convert(meta.children[0], conf)
         schema = child_phys.output_schema
         group_bound = [(f.name, BoundReference(i, f.dtype, f.nullable, f.name))
                        for i, f in enumerate(schema)]
-        return AggregateExec(child_phys, group_bound, [], mode="complete")
+        return _plan_aggregate(child_phys, group_bound, [], conf)
 
     if isinstance(p, L.Sort):
         from .exec_nodes import SortExec

@@ -51,6 +51,11 @@ class ExecContext:
 class TpuExec:
     """Base physical operator."""
 
+    # True when execute() yields one batch per shuffle partition, in
+    # partition-id order (set by ShuffleExchangeExec; consumed by final
+    # aggregates and shuffled joins)
+    outputs_partitions = False
+
     def __init__(self, children: Sequence["TpuExec"] = ()):
         self.children = list(children)
         self.op_id = f"{type(self).__name__}@{id(self):x}"
@@ -488,6 +493,28 @@ class AggregateExec(TpuExec):
             "agg-grouped|" + self._fingerprint(), build)
 
         buffer_schema = self._buffer_schema()
+        if self.mode == "final" and child.outputs_partitions:
+            # a shuffle guarantees each group is confined to one partition
+            # batch: finalize per batch, no cross-batch merge (streaming)
+            any_out = False
+            for batch in child.execute(ctx):
+                with m.time("opTime"):
+                    arrays = tuple(
+                        (c.data, c.valid) if isinstance(c, DeviceColumn)
+                        else None for c in batch.columns)
+                    ok, ov, gmask = batch_group(arrays, batch.sel,
+                                                jnp.int32(batch.num_rows))
+                    part = batch_utils.compact(
+                        self._to_buffer_batch(buffer_schema, ok, ov, gmask))
+                if part.num_rows == 0:
+                    continue
+                out = self._finalize_grouped(part)
+                any_out = True
+                m.add("numOutputRows", out.num_rows)
+                yield out
+            if not any_out:
+                yield ColumnBatch(self._schema, self._empty_cols(), 0)
+            return
         pending: Optional[ColumnBatch] = None
         for batch in child.execute(ctx):
             with m.time("opTime"):

@@ -1,0 +1,154 @@
+"""Exchange in the plan: partial→exchange→final aggregation, shuffled
+joins, and the planner-path distributed collect.
+
+Reference: GpuShuffleExchangeExecBase.scala:266-383,
+GpuShuffledHashJoinExec.scala:90."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.plan.exchange_exec import ShuffleExchangeExec
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.plan.physical import AggregateExec
+from spark_rapids_tpu.sql import functions as F
+from .support import assert_rows_equal
+
+
+def _plan(df):
+    return apply_overrides(df._plan, df.session._tpu_conf())
+
+
+class TestExchangeInPlan:
+    def test_grouped_agg_is_two_phase(self, session):
+        df = session.create_dataframe({"k": [1, 2], "v": [1.0, 2.0]})
+        q = df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+        phys = _plan(q)
+        assert isinstance(phys, AggregateExec) and phys.mode == "final"
+        exch = phys.children[0]
+        assert isinstance(exch, ShuffleExchangeExec)
+        partial = exch.children[0]
+        assert isinstance(partial, AggregateExec) and partial.mode == "partial"
+        assert "TpuShuffleExchange" in phys.tree_string()
+
+    def test_join_is_shuffled(self, session):
+        l = session.create_dataframe({"k": [1], "a": [1.0]})
+        r = session.create_dataframe({"k": [1], "b": [2.0]})
+        phys = _plan(l.join(r, on="k"))
+        assert all(isinstance(c, ShuffleExchangeExec) for c in phys.children)
+
+    def test_exchange_disabled_single_stream(self, fresh_session):
+        fresh_session.conf.set("spark.rapids.tpu.sql.exchange.enabled", False)
+        df = fresh_session.create_dataframe({"k": [1, 2], "v": [1.0, 2.0]})
+        q = df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+        phys = _plan(q)
+        assert isinstance(phys, AggregateExec) and phys.mode == "complete"
+        got = q.collect()
+        assert_rows_equal(got, [(1, 1.0), (2, 2.0)])
+
+    def test_two_phase_results_match_oracle(self, fresh_session):
+        fresh_session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 128)
+        fresh_session.conf.set("spark.rapids.tpu.sql.shuffle.partitions", 7)
+        rng = np.random.default_rng(5)
+        pdf = pd.DataFrame({
+            "k": rng.integers(0, 100, 2000),
+            "v": rng.uniform(-10, 10, 2000),
+        })
+        df = fresh_session.create_dataframe(pdf)
+        got = (df.group_by("k")
+                 .agg(F.sum(F.col("v")).alias("s"),
+                      F.count_star().alias("c"),
+                      F.min(F.col("v")).alias("mn"),
+                      F.max(F.col("v")).alias("mx"),
+                      F.avg(F.col("v")).alias("a")).collect())
+        g = pdf.groupby("k")["v"]
+        expect = [(int(k), float(s), int(c), float(mn), float(mx), float(a))
+                  for k, s, c, mn, mx, a in zip(
+                      g.sum().index, g.sum(), g.count(), g.min(), g.max(),
+                      g.mean())]
+        assert_rows_equal(got, expect, approx_float=True)
+
+    def test_null_group_key_two_phase(self, session):
+        t = pa.table({"k": pa.array([1, None, None, 2], type=pa.int64()),
+                      "v": pa.array([1.0, 2.0, 3.0, 4.0])})
+        got = (session.create_dataframe(t).group_by("k")
+               .agg(F.sum(F.col("v")).alias("s")).collect())
+        assert_rows_equal(got, [(1, 1.0), (2, 4.0), (None, 5.0)])
+
+    def test_shuffled_join_many_partitions(self, fresh_session):
+        fresh_session.conf.set("spark.rapids.tpu.sql.shuffle.partitions", 5)
+        rng = np.random.default_rng(9)
+        lpd = pd.DataFrame({"k": rng.integers(0, 40, 800),
+                            "a": np.arange(800)})
+        rpd = pd.DataFrame({"k": rng.integers(0, 40, 300),
+                            "b": np.arange(300)})
+        got = fresh_session.create_dataframe(lpd).join(
+            fresh_session.create_dataframe(rpd), on="k", how="left").collect()
+        expect = lpd.merge(rpd, on="k", how="left")
+        assert len(got) == len(expect)
+        s_g = sum(r[2] for r in got if r[2] is not None)
+        assert s_g == int(expect["b"].dropna().sum())
+
+    def test_mixed_type_keys_partition_consistently(self, session):
+        # int32 vs int64 keys must hash to the same partition (promoted)
+        lt = pa.table({"k": pa.array(range(50), type=pa.int32()),
+                       "a": pa.array(range(50), type=pa.int64())})
+        rt = pa.table({"k": pa.array(range(0, 100, 2), type=pa.int64()),
+                       "b": pa.array(range(50), type=pa.int64())})
+        got = session.create_dataframe(lt).join(
+            session.create_dataframe(rt), on="k", how="inner").collect()
+        assert len(got) == 25  # even keys 0..48
+
+    def test_distinct_two_phase(self, fresh_session):
+        fresh_session.conf.set("spark.rapids.tpu.sql.batchSizeRows", 64)
+        pdf = pd.DataFrame({"k": [1, 2, 1, 3, 2, 1] * 50})
+        got = fresh_session.create_dataframe(pdf).distinct().collect()
+        assert sorted(got) == [(1,), (2,), (3,)]
+
+
+class TestDistributedPlannerPath:
+    def test_distributed_agg_matches_engine(self):
+        import jax
+        from jax.sharding import Mesh
+        from spark_rapids_tpu.parallel.distributed import (
+            distributed_agg_collect)
+        devices = jax.devices()[:4]
+        if len(devices) < 4:
+            pytest.skip("needs 4 virtual devices")
+        mesh = Mesh(np.array(devices), ("data",))
+        rng = np.random.default_rng(3)
+        rows = 4 * 512
+        table = pa.table({
+            "k": pa.array(rng.integers(0, 30, rows).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 50, rows)),
+        })
+        sess = srt.Session.get_or_create()
+        df = (sess.create_dataframe(table).group_by("k")
+              .agg(F.sum(F.col("v")).alias("s"),
+                   F.count_star().alias("c")))
+        got = distributed_agg_collect(df, mesh, table)
+        want = df.collect()
+        assert_rows_equal(got, want, approx_float=True)
+
+    def test_distributed_rejects_overflow(self):
+        import jax
+        from jax.sharding import Mesh
+        from spark_rapids_tpu.parallel.distributed import (
+            distributed_agg_collect)
+        devices = jax.devices()[:2]
+        if len(devices) < 2:
+            pytest.skip("needs 2 virtual devices")
+        mesh = Mesh(np.array(devices), ("data",))
+        rows = 2 * 256
+        table = pa.table({
+            "k": pa.array(np.arange(rows).astype(np.int64)),  # all distinct
+            "v": pa.array(np.ones(rows)),
+        })
+        sess = srt.Session.get_or_create()
+        df = (sess.create_dataframe(table).group_by("k")
+              .agg(F.sum(F.col("v")).alias("s")))
+        with pytest.raises(RuntimeError, match="overflow"):
+            # bucket_cap=8 cannot carry 256 distinct keys per device
+            distributed_agg_collect(df, mesh, table, bucket_cap=8)
