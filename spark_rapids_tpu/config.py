@@ -138,6 +138,12 @@ TEST_INJECT_OOM = register(
     "Test-only: force the next N device operations to raise a retry OOM so "
     "suites can prove operators survive and split correctly.", internal=True)
 
+TEST_INJECT_SPLIT_OOM = register(
+    "spark.rapids.tpu.test.injectSplitAndRetryOOM", 0,
+    "Test-only: force the next N device operations to raise a "
+    "split-and-retry OOM (RmmSpark.forceSplitAndRetryOOM analog).",
+    internal=True)
+
 SHUFFLE_MODE = register(
     "spark.rapids.tpu.shuffle.mode", "HOST",
     "Shuffle transport: HOST (host-staged multithreaded shuffle, works "

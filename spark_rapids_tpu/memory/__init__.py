@@ -1,0 +1,14 @@
+"""Memory discipline: spillable batches, budget catalog, OOM retry.
+
+TPU-native reimplementation of the reference's memory/runtime layer
+(RapidsBufferCatalog.scala, SpillableColumnarBatch.scala,
+RmmRapidsRetryIterator.scala, DeviceMemoryEventHandler.scala).
+"""
+
+from .retry import (OOMInjector, RetryOOM, SplitAndRetryOOM, device_op,
+                    split_in_half, with_retry)
+from .spill import SpillableBatch, SpillCatalog, get_catalog
+
+__all__ = ["RetryOOM", "SplitAndRetryOOM", "with_retry", "split_in_half",
+           "device_op", "OOMInjector", "SpillableBatch", "SpillCatalog",
+           "get_catalog"]

@@ -1,0 +1,283 @@
+"""Spillable batches + the spill catalog (tiered device→host→disk).
+
+Reference: RapidsBufferCatalog.scala:551 (synchronousSpill walking a
+priority-ordered store), SpillableColumnarBatch.scala (handle-based
+re-materialization), RapidsHostMemoryStore/RapidsDiskStore.  The TPU
+redesign: device columns are JAX arrays; spilling is ``jax.device_get`` to
+pinned host numpy (XLA frees the HBM once the last reference drops), and the
+host tier overflows to a pickle file under ``memory.spill.dir``.  PJRT has no
+alloc-failure callback (SURVEY §7.3), so instead of reacting to a native
+callback the catalog is consulted *before* device work
+(:meth:`SpillCatalog.ensure_budget`) and *after* an XLA RESOURCE_EXHAUSTED
+(memory/retry.py turns that into a spill-then-retry).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..batch import ColumnBatch, DeviceColumn, HostStringColumn
+
+__all__ = ["SpillableBatch", "SpillCatalog", "get_catalog"]
+
+
+class SpillableBatch:
+    """A handle to a batch that may live on device, host, or disk.
+
+    States: DEVICE (ColumnBatch with live JAX arrays), HOST (numpy copies),
+    DISK (pickle file).  ``get()`` re-materializes to device on demand.
+    """
+
+    DEVICE, HOST, DISK = "device", "host", "disk"
+
+    def __init__(self, batch: ColumnBatch, catalog: "SpillCatalog",
+                 priority: int = 0):
+        self._batch: Optional[ColumnBatch] = batch
+        self._host: Optional[dict] = None
+        self._disk_path: Optional[str] = None
+        self._catalog = catalog
+        self.priority = priority  # lower spills first (SpillPriorities)
+        self.state = self.DEVICE
+        self.device_bytes = batch.device_size_bytes()
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- state moves --------------------------------------------------------------
+    def spill_to_host(self) -> int:
+        """DEVICE → HOST; returns bytes freed on device."""
+        with self._lock:
+            if self.state != self.DEVICE or self._closed:
+                return 0
+            b = self._batch
+            cols = []
+            for c in b.columns:
+                if isinstance(c, DeviceColumn):
+                    cols.append(("d", c.dtype, np.asarray(c.data),
+                                 None if c.valid is None else
+                                 np.asarray(c.valid)))
+                else:
+                    cols.append(("s", c.array))
+            self._host = {
+                "schema": b.schema, "cols": cols, "num_rows": b.num_rows,
+                "sel": None if b.sel is None else np.asarray(b.sel),
+            }
+            self._batch = None  # drop device refs → XLA frees HBM
+            self.state = self.HOST
+            return self.device_bytes
+
+    def spill_to_disk(self) -> int:
+        """HOST → DISK; returns host bytes freed."""
+        with self._lock:
+            if self.state != self.HOST or self._closed:
+                return 0
+            os.makedirs(self._catalog.spill_dir, exist_ok=True)
+            path = os.path.join(self._catalog.spill_dir,
+                                f"srt-spill-{uuid.uuid4().hex}.bin")
+            with open(path, "wb") as f:
+                pickle.dump(self._host, f, protocol=4)
+            freed = self.host_bytes()
+            self._host = None
+            self._disk_path = path
+            self.state = self.DISK
+            return freed
+
+    def host_bytes(self) -> int:
+        if self._host is None:
+            return 0
+        total = 0
+        for c in self._host["cols"]:
+            if c[0] == "d":
+                total += c[2].nbytes
+                if c[3] is not None:
+                    total += c[3].nbytes
+        return total
+
+    def get(self) -> ColumnBatch:
+        """Materialize on device (re-uploading if spilled)."""
+        import jax
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("spillable batch already closed")
+            if self.state == self.DISK:
+                with open(self._disk_path, "rb") as f:
+                    self._host = pickle.load(f)
+                os.unlink(self._disk_path)
+                self._disk_path = None
+                self.state = self.HOST
+            if self.state == self.HOST:
+                h = self._host
+                cols = []
+                for c in h["cols"]:
+                    if c[0] == "d":
+                        _, dtype, data, valid = c
+                        cols.append(DeviceColumn(
+                            dtype, jax.numpy.asarray(data),
+                            None if valid is None else
+                            jax.numpy.asarray(valid)))
+                    else:
+                        cols.append(HostStringColumn(c[1]))
+                sel = h["sel"]
+                self._batch = ColumnBatch(
+                    h["schema"], cols, h["num_rows"],
+                    None if sel is None else jax.numpy.asarray(sel))
+                self._host = None
+                self.state = self.DEVICE
+                self._catalog._note_unspill(self)
+            return self._batch
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._batch = None
+            self._host = None
+            if self._disk_path:
+                try:
+                    os.unlink(self._disk_path)
+                except OSError:
+                    pass
+                self._disk_path = None
+        self._catalog.unregister(self)
+
+
+class SpillCatalog:
+    """Tracks spillable batches; spills lowest-priority first to stay under
+    the device budget (RapidsBufferCatalog.synchronousSpill analog)."""
+
+    def __init__(self, device_budget: int, host_budget: int,
+                 spill_dir: str = "/tmp/srt_spill"):
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._entries: List[SpillableBatch] = []
+        self.spilled_device_bytes = 0
+        self.spilled_host_bytes = 0
+        self.spill_count = 0
+
+    # -- registration -------------------------------------------------------------
+    def register(self, batch: ColumnBatch, priority: int = 0) -> SpillableBatch:
+        sb = SpillableBatch(batch, self, priority)
+        with self._lock:
+            self._entries.append(sb)
+        self.ensure_budget()
+        return sb
+
+    def unregister(self, sb: SpillableBatch) -> None:
+        with self._lock:
+            try:
+                self._entries.remove(sb)
+            except ValueError:
+                pass
+
+    def _note_unspill(self, sb: SpillableBatch) -> None:
+        # re-materialized batch counts against the device budget again
+        pass
+
+    # -- accounting ---------------------------------------------------------------
+    def device_bytes_in_use(self) -> int:
+        with self._lock:
+            return sum(e.device_bytes for e in self._entries
+                       if e.state == SpillableBatch.DEVICE)
+
+    def host_bytes_in_use(self) -> int:
+        with self._lock:
+            return sum(e.host_bytes() for e in self._entries
+                       if e.state == SpillableBatch.HOST)
+
+    # -- spilling -----------------------------------------------------------------
+    def ensure_budget(self, extra_bytes: int = 0) -> int:
+        """Spill until (tracked device bytes + extra) fits the budget."""
+        freed = 0
+        while (self.device_bytes_in_use() + extra_bytes > self.device_budget):
+            if not self.spill_one_device():
+                break
+            freed += 1
+        while self.host_bytes_in_use() > self.host_budget:
+            if not self._spill_one_host():
+                break
+        return freed
+
+    def spill_one_device(self) -> bool:
+        """Spill the lowest-priority device-resident batch; False if none."""
+        with self._lock:
+            cands = [e for e in self._entries
+                     if e.state == SpillableBatch.DEVICE]
+            if not cands:
+                return False
+            victim = min(cands, key=lambda e: e.priority)
+        freed = victim.spill_to_host()
+        if freed:
+            self.spilled_device_bytes += freed
+            self.spill_count += 1
+            from ..utils.metrics import TaskMetrics
+            TaskMetrics.get().spill_to_host_bytes += freed
+            TaskMetrics.get().spill_count += 1
+        return freed > 0
+
+    def _spill_one_host(self) -> bool:
+        with self._lock:
+            cands = [e for e in self._entries
+                     if e.state == SpillableBatch.HOST]
+            if not cands:
+                return False
+            victim = min(cands, key=lambda e: e.priority)
+        freed = victim.spill_to_disk()
+        if freed:
+            self.spilled_host_bytes += freed
+            from ..utils.metrics import TaskMetrics
+            TaskMetrics.get().spill_to_disk_bytes += freed
+        return freed > 0
+
+    def spill_all_device(self) -> int:
+        """Emergency: spill everything device-resident (OOM reaction)."""
+        n = 0
+        while self.spill_one_device():
+            n += 1
+        return n
+
+
+_catalog: Optional[SpillCatalog] = None
+_catalog_lock = threading.Lock()
+
+
+def get_catalog(conf=None) -> SpillCatalog:
+    """Session-level catalog; budgets come from the conf on first use."""
+    global _catalog
+    with _catalog_lock:
+        if _catalog is None:
+            if conf is None:
+                from ..config import TpuConf
+                conf = TpuConf()
+            device_budget = _device_budget(conf)
+            _catalog = SpillCatalog(
+                device_budget,
+                conf["spark.rapids.tpu.memory.host.spillStorageSize"],
+                conf["spark.rapids.tpu.memory.spill.dir"])
+        return _catalog
+
+
+def reset_catalog() -> None:
+    global _catalog
+    with _catalog_lock:
+        _catalog = None
+
+
+def _device_budget(conf) -> int:
+    """poolFraction × device memory (fallback 8 GiB when the backend does
+    not report memory stats, e.g. the CPU test platform)."""
+    import jax
+    frac = conf["spark.rapids.tpu.memory.tpu.poolFraction"]
+    try:
+        stats = jax.devices()[0].memory_stats()
+        total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if total:
+            return int(total * frac)
+    except Exception:
+        pass
+    return int((8 << 30) * frac)

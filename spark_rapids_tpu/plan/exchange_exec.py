@@ -23,13 +23,17 @@ from typing import Iterator, List, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..batch import ColumnBatch, DeviceColumn, HostStringColumn, Schema
-from ..exprs import EvalContext, Expression, promote_physical
+from .. import types as T
+from ..batch import ColumnBatch, DeviceColumn, Field, Schema
+from ..exprs import EvalContext, Expression
 from ..ops import batch_utils
 from ..ops.hashing import spark_partition_id
 from .physical import ExecContext, TpuExec, _cached_program
 
 __all__ = ["ShuffleExchangeExec"]
+
+_PID_FIELD = Field("__pid", T.INT32, False)
+_PID_SCHEMA = Schema([_PID_FIELD])
 
 
 class ShuffleExchangeExec(TpuExec):
@@ -79,29 +83,53 @@ class ShuffleExchangeExec(TpuExec):
         return _cached_program(fp, build)
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        from ..memory.spill import get_catalog
         m = ctx.metric_set(self.op_id)
         pid_fn = self._pid_fn()
-        staged: List[Tuple[ColumnBatch, jax.Array]] = []
-        for batch in self.children[0].execute(ctx):
-            with m.time("opTime"):
-                arrays = tuple(
-                    (c.data, c.valid) if isinstance(c, DeviceColumn) else None
-                    for c in batch.columns)
-                pids = pid_fn(arrays, batch.sel, jnp.int32(batch.num_rows))
-            staged.append((batch, pids))
-            m.add("numInputBatches", 1)
-        for p in range(self.n_parts):
-            parts = []
-            for batch, pids in staged:
-                sel = pids == p
-                parts.append(ColumnBatch(batch.schema, batch.columns,
-                                         batch.num_rows, sel))
-            with m.time("opTime"):
-                if len(parts) == 1:
-                    out = batch_utils.compact(parts[0])
-                else:
-                    out = batch_utils.compact(
-                        batch_utils.concat_batches(parts))
-            m.add("numOutputRows", out.num_rows)
-            m.add("numOutputBatches", 1)
-            yield out
+        catalog = get_catalog(ctx.conf)
+        # staging is the shuffle's materialization barrier: every staged
+        # batch is registered spillable (ShuffleBufferCatalog analog) so
+        # memory pressure during a long upstream can evict them to host
+        staged = []
+        try:
+            for batch in self.children[0].execute(ctx):
+                with m.time("opTime"):
+                    arrays = tuple(
+                        (c.data, c.valid) if isinstance(c, DeviceColumn)
+                        else None for c in batch.columns)
+                    pids = pid_fn(arrays, batch.sel,
+                                  jnp.int32(batch.num_rows))
+                staged.append((catalog.register(batch, priority=0),
+                               catalog.register(ColumnBatch(
+                                   _PID_SCHEMA, [DeviceColumn(
+                                       _PID_FIELD.dtype, pids)],
+                                   batch.num_rows), priority=0)))
+                m.add("numInputBatches", 1)
+            if not staged:
+                # the exactly-n_parts contract holds even for empty input
+                # (the shuffled-join zip relies on it)
+                from .join_exec import _empty_batch
+                for _ in range(self.n_parts):
+                    yield _empty_batch(self.output_schema)
+                return
+            for p in range(self.n_parts):
+                parts = []
+                for bh, ph in staged:
+                    batch = bh.get()
+                    pids = ph.get().columns[0].data
+                    sel = pids == p
+                    parts.append(ColumnBatch(batch.schema, batch.columns,
+                                             batch.num_rows, sel))
+                with m.time("opTime"):
+                    if len(parts) == 1:
+                        out = batch_utils.compact(parts[0])
+                    else:
+                        out = batch_utils.compact(
+                            batch_utils.concat_batches(parts))
+                m.add("numOutputRows", out.num_rows)
+                m.add("numOutputBatches", 1)
+                yield out
+        finally:
+            for bh, ph in staged:
+                bh.close()
+                ph.close()

@@ -25,10 +25,16 @@ __all__ = ["SortExec", "LimitExec", "UnionExec", "RangeExec", "ExpandExec",
 
 
 class SortExec(TpuExec):
-    """Global sort: concatenate all input, sort on device, emit one batch.
+    """Global sort: in-core for small inputs, out-of-core for large ones.
 
-    The reference's in-core path (GpuSortExec.scala:86); out-of-core chunked
-    merge-sort lands with the spill framework (SURVEY.md §5.7).
+    In-core (GpuSortExec.scala:86): concatenate, sort once on device.
+    Out-of-core (GpuSortExec.scala:242 GpuOutOfCoreSortIterator +
+    GpuRangePartitioner redesigned for TPU): each input batch is sorted into
+    a spillable run; range boundaries are sampled from the runs' primary
+    keys; each range then gathers one *contiguous slice per run* (runs are
+    sorted, so slice bounds come from two searchsorted calls), concatenates
+    and sorts only that range — peak HBM is one range plus whatever runs the
+    spill catalog keeps resident.  Output batches emit in global order.
     """
 
     def __init__(self, child: TpuExec,
@@ -43,25 +49,141 @@ class SortExec(TpuExec):
     def node_desc(self):
         return f"TpuSort [{len(self.orders)} keys]"
 
+    def _order_tuples(self):
+        key_exprs = tuple(e for e, _, _ in self.orders)
+        desc = tuple(not asc for _, asc, _ in self.orders)
+        nf = tuple(n for _, _, n in self.orders)
+        return key_exprs, desc, nf
+
+    def _sort_batch(self, whole: ColumnBatch) -> ColumnBatch:
+        key_exprs, desc, nf = self._order_tuples()
+        arrays = tuple(
+            (c.data, c.valid) if isinstance(c, DeviceColumn) else None
+            for c in whole.columns)
+        perm = _sort_perm(key_exprs, desc, nf)(
+            arrays, jnp.int32(whole.num_rows))
+        return batch_utils.gather(whole, perm, whole.num_rows)
+
+    def _range_key(self, batch: ColumnBatch) -> np.ndarray:
+        """Host copy of the PRIMARY sort key as a totally-ordered int/float
+        view (ascending in output order), for range boundary search."""
+        key_exprs, desc, nf = self._order_tuples()
+        fn = _range_key_fn(key_exprs[0], desc[0], nf[0])
+        arrays = tuple(
+            (c.data, c.valid) if isinstance(c, DeviceColumn) else None
+            for c in batch.columns)
+        return np.asarray(fn(arrays))[: batch.num_rows]
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        from ..memory.retry import with_retry
+        from ..memory.spill import get_catalog
         m = ctx.metric_set(self.op_id)
-        batches = list(self.children[0].execute(ctx))
-        if not batches:
-            return
-        with m.time("opTime"):
-            whole = batch_utils.compact(batch_utils.concat_batches(batches)) \
-                if len(batches) > 1 else batch_utils.compact(batches[0])
-            key_exprs = tuple(e for e, _, _ in self.orders)
-            desc = tuple(not asc for _, asc, _ in self.orders)
-            nf = tuple(n for _, _, n in self.orders)
-            arrays = tuple(
-                (c.data, c.valid) if isinstance(c, DeviceColumn) else None
-                for c in whole.columns)
-            perm = _sort_perm(key_exprs, desc, nf)(
-                arrays, jnp.int32(whole.num_rows))
-            out = batch_utils.gather(whole, perm, whole.num_rows)
-        m.add("numOutputRows", out.num_rows)
-        yield out
+        batch_rows = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
+        catalog = get_catalog(ctx.conf)
+
+        runs = []  # spillable sorted runs
+        total = 0
+        try:
+            for batch in self.children[0].execute(ctx):
+                with m.time("opTime"):
+                    for srt_b in with_retry(
+                            ctx, batch,
+                            lambda b: self._sort_batch(
+                                batch_utils.compact(b))):
+                        if srt_b.num_rows == 0:
+                            continue
+                        total += srt_b.num_rows
+                        runs.append(catalog.register(srt_b, priority=2))
+            if not runs:
+                return
+            if len(runs) == 1 or total <= batch_rows:
+                # in-core: one more sort over the concatenation
+                with m.time("opTime"):
+                    whole = batch_utils.compact(batch_utils.concat_batches(
+                        [h.get() for h in runs])) \
+                        if len(runs) > 1 else runs[0].get()
+                    out = self._sort_batch(whole) if len(runs) > 1 else whole
+                m.add("numOutputRows", out.num_rows)
+                yield out
+                return
+            # ---- out-of-core: range-partitioned merge ----
+            n_ranges = max(2, -(-total // batch_rows))
+            keys = [self._range_key(h.get()) for h in runs]
+            bounds = _sample_bounds(keys, n_ranges)
+            for lo_b, hi_b in bounds:
+                slices = []
+                for h, rk in zip(runs, keys):
+                    lo = 0 if lo_b is None else int(
+                        np.searchsorted(rk, lo_b, side="left"))
+                    hi = len(rk) if hi_b is None else int(
+                        np.searchsorted(rk, hi_b, side="left"))
+                    if hi > lo:
+                        slices.append(batch_utils.slice_batch(
+                            h.get(), lo, hi - lo))
+                if not slices:
+                    continue
+                with m.time("opTime"):
+                    part = batch_utils.compact(
+                        batch_utils.concat_batches(slices)) \
+                        if len(slices) > 1 else slices[0]
+                    out = self._sort_batch(part)
+                m.add("numOutputRows", out.num_rows)
+                yield out
+        finally:
+            for h in runs:
+                h.close()
+
+
+def _range_key_fn(key_expr, desc: bool, nulls_first: bool):
+    """Jitted primary-key view: int-valued, ascending in OUTPUT order
+    (desc flip + null placement folded in), for range boundary searches."""
+    from .physical import _cached_program
+    fp = f"rangekey|{key_expr.fingerprint()}|{desc}|{nulls_first}"
+
+    def build():
+        @jax.jit
+        def f(arrays):
+            cap = next(a[0].shape[0] for a in arrays if a is not None)
+            active = jnp.ones((cap,), dtype=bool)
+            ectx = EvalContext(list(arrays), cap, active=active)
+            d, v = key_expr.eval(ectx)
+            view = groupby.sortable_view(d)
+            if desc:
+                view = ~view
+            if v is not None:
+                info = jnp.iinfo(view.dtype)
+                sent = info.min if nulls_first else info.max
+                view = jnp.where(v, view, sent)
+            return view
+        return f
+
+    return _cached_program(fp, build)
+
+
+def _sample_bounds(keys: List[np.ndarray], n_ranges: int):
+    """Range boundaries from per-run key samples (GpuRangePartitioner
+    sampling analog).  Returns [(lo, hi), ...] with None for open ends."""
+    samples = []
+    for k in keys:
+        if len(k) == 0:
+            continue
+        step = max(1, len(k) // 64)
+        samples.append(k[::step])
+    if not samples:
+        return [(None, None)]
+    s = np.sort(np.concatenate(samples))
+    cuts = []
+    for i in range(1, n_ranges):
+        q = s[min(len(s) - 1, (len(s) * i) // n_ranges)]
+        if not cuts or q > cuts[-1]:
+            cuts.append(q)
+    bounds = []
+    prev = None
+    for c in cuts:
+        bounds.append((prev, c))
+        prev = c
+    bounds.append((prev, None))
+    return bounds
 
 
 def _sort_perm(key_exprs, desc, nf):

@@ -93,16 +93,27 @@ class SortMergeJoinExec(TpuExec):
                         + [e.fingerprint() for e in rk]
                         + [str(c) for c in ct])
 
-    def _materialize(self, ctx: ExecContext, side: int) -> ColumnBatch:
-        batches = [batch_utils.compact(b)
-                   for b in self.children[side].execute(ctx)]
-        batches = [b for b in batches if b.num_rows > 0]
-        if not batches:
-            sch = self.children[side].output_schema
-            return _empty_batch(sch)
-        if len(batches) == 1:
-            return batches[0]
-        return batch_utils.compact(batch_utils.concat_batches(batches))
+    def _materialize(self, ctx: ExecContext, side: int):
+        """Materialize one side as a spillable handle (LazySpillableColumnar-
+        Batch analog): while the other side executes, this one can be
+        evicted to host under memory pressure."""
+        from ..memory.spill import get_catalog
+        catalog = get_catalog(ctx.conf)
+        handles = []
+        for b in self.children[side].execute(ctx):
+            c = batch_utils.compact(b)
+            if c.num_rows > 0:
+                handles.append(catalog.register(c, priority=1))
+        if not handles:
+            return catalog.register(
+                _empty_batch(self.children[side].output_schema), priority=1)
+        if len(handles) == 1:
+            return handles[0]
+        whole = batch_utils.compact(
+            batch_utils.concat_batches([h.get() for h in handles]))
+        for h in handles:
+            h.close()
+        return catalog.register(whole, priority=1)
 
     # -- execution ----------------------------------------------------------------
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
@@ -116,9 +127,13 @@ class SortMergeJoinExec(TpuExec):
                     continue
                 yield self._join_pair(ctx, m, lb, rb)
             return
-        left = self._materialize(ctx, 0)
-        right = self._materialize(ctx, 1)
-        yield self._join_pair(ctx, m, left, right)
+        lh = self._materialize(ctx, 0)
+        rh = self._materialize(ctx, 1)
+        try:
+            yield self._join_pair(ctx, m, lh.get(), rh.get())
+        finally:
+            lh.close()
+            rh.close()
 
     def _join_pair(self, ctx, m, left: ColumnBatch,
                    right: ColumnBatch) -> ColumnBatch:

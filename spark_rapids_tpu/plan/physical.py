@@ -41,6 +41,13 @@ class ExecContext:
         self.conf = conf or TpuConf()
         self.device = device
         self.metrics: Dict[str, MetricSet] = {}
+        # arm the OOM injector from the test configs (inject_oom marker /
+        # spark.rapids.sql.test.injectRetryOOM analog)
+        n_retry = self.conf["spark.rapids.tpu.test.injectRetryOOM"]
+        n_split = self.conf["spark.rapids.tpu.test.injectSplitAndRetryOOM"]
+        if n_retry or n_split:
+            from ..memory.retry import INJECTOR
+            INJECTOR.arm(n_retry, n_split)
 
     def metric_set(self, op_id: str) -> MetricSet:
         if op_id not in self.metrics:
@@ -228,33 +235,34 @@ class StageExec(TpuExec):
                 final_proj = payload
                 break
 
+        from ..memory.retry import with_retry
+
+        def run_one(b: ColumnBatch) -> ColumnBatch:
+            arrays = []
+            for i, (f_, c) in enumerate(zip(b.schema, b.columns)):
+                arrays.append(None if isinstance(c, HostStringColumn)
+                              else (c.data, c.valid))
+            out_arrays, new_sel = fn(tuple(arrays), b.sel,
+                                     jnp.int32(b.num_rows))
+            cols: List = []
+            for oi, f_ in enumerate(self._schema):
+                val = out_arrays[oi] if oi < len(out_arrays) else None
+                if val is None:
+                    # host pass-through: the expr was a bare reference
+                    src = self._host_source_ordinal(oi)
+                    cols.append(b.columns[src])
+                else:
+                    data, valid = val
+                    cols.append(DeviceColumn(f_.dtype, data, valid))
+            return ColumnBatch(self._schema, cols, b.num_rows, new_sel)
+
         for batch in child.execute(ctx):
             with m.time("opTime"):
-                arrays, host_cols = [], {}
-                for i, (f, c) in enumerate(zip(batch.schema, batch.columns)):
-                    if isinstance(c, HostStringColumn):
-                        arrays.append(None)
-                        host_cols[i] = c
-                    else:
-                        arrays.append((c.data, c.valid))
-                # device-side compute
-                out_arrays, new_sel = fn(
-                    tuple(arrays), batch.sel,
-                    jnp.int32(batch.num_rows))
-                cols: List = []
-                for oi, f in enumerate(self._schema):
-                    val = out_arrays[oi] if oi < len(out_arrays) else None
-                    if val is None:
-                        # host pass-through: the expr was a bare reference
-                        src = self._host_source_ordinal(oi)
-                        cols.append(batch.columns[src])
-                    else:
-                        data, valid = val
-                        cols.append(DeviceColumn(f.dtype, data, valid))
-                out = ColumnBatch(self._schema, cols, batch.num_rows, new_sel)
-            m.add("numOutputRows", out.num_rows)
-            m.add("numOutputBatches", 1)
-            yield out
+                outs = list(with_retry(ctx, batch, run_one))
+            for out in outs:
+                m.add("numOutputRows", out.num_rows)
+                m.add("numOutputBatches", 1)
+                yield out
 
     def _host_source_ordinal(self, out_ordinal: int) -> int:
         """Chase a host pass-through output back to its input ordinal."""
@@ -361,15 +369,19 @@ class AggregateExec(TpuExec):
         batch_partials = _cached_program(
             "agg-ungrouped|" + self._fingerprint(), build)
 
+        from ..memory.retry import with_retry
+
+        def run_one(b: ColumnBatch):
+            arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
+                           else None for c in b.columns)
+            return batch_partials(arrays, b.sel, jnp.int32(b.num_rows))
+
         acc: Optional[List] = None
         for batch in child.execute(ctx):
             with m.time("opTime"):
-                arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
-                               else None for c in batch.columns)
-                partials = batch_partials(arrays, batch.sel,
-                                          jnp.int32(batch.num_rows))
-                acc = partials if acc is None else self._merge_scalars(
-                    acc, partials, ops)
+                for partials in with_retry(ctx, batch, run_one):
+                    acc = partials if acc is None else self._merge_scalars(
+                        acc, partials, ops)
         if acc is None:
             acc = self._empty_scalars()
         out = self._finalize_scalars(acc)
@@ -515,18 +527,23 @@ class AggregateExec(TpuExec):
             if not any_out:
                 yield ColumnBatch(self._schema, self._empty_cols(), 0)
             return
+        from ..memory.retry import with_retry
+
+        def run_one(b: ColumnBatch) -> ColumnBatch:
+            arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
+                           else None for c in b.columns)
+            ok, ov, gmask = batch_group(arrays, b.sel, jnp.int32(b.num_rows))
+            return self._to_buffer_batch(buffer_schema, ok, ov, gmask)
+
         pending: Optional[ColumnBatch] = None
         for batch in child.execute(ctx):
             with m.time("opTime"):
-                arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
-                               else None for c in batch.columns)
-                ok, ov, gmask = batch_group(arrays, batch.sel,
-                                            jnp.int32(batch.num_rows))
-                part = self._to_buffer_batch(buffer_schema, ok, ov, gmask)
-                if pending is None:
-                    pending = batch_utils.compact(part)
-                else:
-                    pending = self._merge_partials(pending, part, ops, n_keys)
+                for part in with_retry(ctx, batch, run_one):
+                    if pending is None:
+                        pending = batch_utils.compact(part)
+                    else:
+                        pending = self._merge_partials(pending, part, ops,
+                                                       n_keys)
         if pending is None:
             yield ColumnBatch(self._schema, self._empty_cols(), 0)
             return

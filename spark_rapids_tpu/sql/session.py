@@ -106,8 +106,16 @@ class Session:
         fields = [Field(n, _arrow_to_logical(t), True)
                   for n, t in zip(table.column_names, table.schema.types)]
         out_schema = Schema(fields)
-        node = L.LogicalScan(out_schema, lambda: iter([table]),
-                             "local", fmt="memory")
+        batch_rows = self._tpu_conf()["spark.rapids.tpu.sql.batchSizeRows"]
+
+        def factory(t=table, rows=batch_rows):
+            if t.num_rows <= rows:
+                yield t
+                return
+            for off in range(0, t.num_rows, rows):
+                yield t.slice(off, min(rows, t.num_rows - off))
+
+        node = L.LogicalScan(out_schema, factory, "local", fmt="memory")
         return DataFrame(node, self)
 
     def range(self, start: int, end: Optional[int] = None, step: int = 1

@@ -46,7 +46,8 @@ class MetricSet:
 
 class TaskMetrics:
     """Task-scope counters: semaphore wait, retries, spill bytes
-    (GpuTaskMetrics.scala:81-142 analog)."""
+    (GpuTaskMetrics.scala:81-142 analog).  Written by memory/retry.py and
+    memory/spill.py; read by tests and session reporting."""
 
     _current = None
 
@@ -57,6 +58,13 @@ class TaskMetrics:
         self.retry_block_s = 0.0
         self.spill_to_host_bytes = 0
         self.spill_to_disk_bytes = 0
+        self.spill_count = 0
+
+    def snapshot(self) -> Dict[str, float]:
+        return dict(self.__dict__)
+
+    def reset_counts(self) -> None:
+        self.__init__()
 
     @classmethod
     def get(cls) -> "TaskMetrics":
@@ -66,7 +74,9 @@ class TaskMetrics:
 
     @classmethod
     def reset(cls) -> "TaskMetrics":
-        cls._current = TaskMetrics()
+        # reset IN PLACE: writers hold no stale references to an orphaned
+        # instance (there is exactly one task-metrics object per process)
+        cls.get().reset_counts()
         return cls._current
 
 

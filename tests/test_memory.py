@@ -1,0 +1,208 @@
+"""Memory discipline: spillable batches, the spill catalog, OOM retry with
+split, and per-operator OOM injection.
+
+Reference model: SpillableColumnarBatchSuite, HashAggregateRetrySuite,
+GpuSortRetrySuite, spark.rapids.sql.test.injectRetryOOM
+(RapidsConf.scala:1347) — the inject_oom marker pattern from
+integration_tests/marks.py."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.batch import from_numpy
+from spark_rapids_tpu.memory.retry import (INJECTOR, RetryOOM,
+                                           SplitAndRetryOOM, split_in_half,
+                                           with_retry)
+from spark_rapids_tpu.memory.spill import SpillCatalog, SpillableBatch
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.metrics import TaskMetrics
+from .support import assert_rows_equal
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    INJECTOR.arm(0, 0)
+    yield
+    INJECTOR.arm(0, 0)
+
+
+def _batch(n=100):
+    return from_numpy({"a": np.arange(n, dtype=np.int64),
+                       "b": np.linspace(0, 1, n)})
+
+
+class TestSpillableBatch:
+    def test_roundtrip_host(self, tmp_path):
+        cat = SpillCatalog(1 << 30, 1 << 30, str(tmp_path))
+        b = _batch()
+        sb = cat.register(b)
+        assert sb.state == SpillableBatch.DEVICE
+        freed = sb.spill_to_host()
+        assert freed > 0 and sb.state == SpillableBatch.HOST
+        back = sb.get()
+        assert sb.state == SpillableBatch.DEVICE
+        assert np.array_equal(np.asarray(back.columns[0].data),
+                              np.asarray(b.columns[0].data))
+        sb.close()
+
+    def test_roundtrip_disk(self, tmp_path):
+        cat = SpillCatalog(1 << 30, 1 << 30, str(tmp_path))
+        sb = cat.register(_batch())
+        sb.spill_to_host()
+        freed = sb.spill_to_disk()
+        assert freed > 0 and sb.state == SpillableBatch.DISK
+        back = sb.get()
+        assert back.num_rows == 100
+        sb.close()
+
+    def test_budget_triggers_spill(self, tmp_path):
+        one = _batch(1000).device_size_bytes()
+        cat = SpillCatalog(int(one * 2.5), 1 << 30, str(tmp_path))
+        handles = [cat.register(_batch(1000)) for _ in range(4)]
+        states = [h.state for h in handles]
+        assert states.count(SpillableBatch.HOST) >= 1
+        assert cat.device_bytes_in_use() <= cat.device_budget
+        assert cat.spilled_device_bytes > 0
+        for h in handles:
+            h.close()
+
+    def test_host_budget_overflows_to_disk(self, tmp_path):
+        one = _batch(1000)
+        nbytes = one.device_size_bytes()
+        cat = SpillCatalog(nbytes, nbytes, str(tmp_path))
+        handles = [cat.register(_batch(1000)) for _ in range(4)]
+        assert any(h.state == SpillableBatch.DISK for h in handles)
+        for h in handles:
+            assert h.get().num_rows == 1000
+            h.close()
+
+    def test_priority_orders_spill(self, tmp_path):
+        cat = SpillCatalog(1 << 30, 1 << 30, str(tmp_path))
+        low = cat.register(_batch(), priority=0)
+        high = cat.register(_batch(), priority=5)
+        assert cat.spill_one_device()
+        assert low.state == SpillableBatch.HOST
+        assert high.state == SpillableBatch.DEVICE
+        low.close()
+        high.close()
+
+
+class TestWithRetry:
+    def test_plain_retry_succeeds(self):
+        INJECTOR.arm(1, 0)
+        b = _batch(50)
+        TaskMetrics.get().reset_counts()
+        outs = list(with_retry(None, b, lambda x: x.num_rows))
+        assert outs == [50]
+        assert TaskMetrics.get().retry_count == 1
+
+    def test_split_and_retry(self):
+        INJECTOR.arm(0, 1)
+        b = _batch(50)
+        TaskMetrics.get().reset_counts()
+        outs = list(with_retry(None, b, lambda x: x.num_rows))
+        assert sorted(outs) == [25, 25]
+        assert TaskMetrics.get().split_retry_count == 1
+
+    def test_retry_escalates_to_split(self):
+        # more plain OOMs than MAX_PLAIN_RETRIES -> escalate to split
+        INJECTOR.arm(4, 0)
+        outs = list(with_retry(None, _batch(40), lambda x: x.num_rows))
+        assert sum(outs) == 40 and len(outs) >= 2
+
+    def test_single_row_cannot_split(self):
+        with pytest.raises(SplitAndRetryOOM):
+            split_in_half(_batch(1))
+
+    def test_split_preserves_rows(self):
+        halves = split_in_half(_batch(101))
+        assert [h.num_rows for h in halves] == [50, 51]
+
+
+class TestOperatorOOMInjection:
+    """Every device operator must survive injected OOM (the reference's
+    retry suites + inject_oom marker)."""
+
+    def _session(self, n_retry=0, n_split=0):
+        srt.Session.reset()
+        s = srt.Session.get_or_create()
+        s.conf.set("spark.rapids.tpu.test.injectRetryOOM", n_retry)
+        s.conf.set("spark.rapids.tpu.test.injectSplitAndRetryOOM", n_split)
+        return s
+
+    def teardown_method(self, m):
+        srt.Session.reset()
+        INJECTOR.arm(0, 0)
+
+    def test_filter_project_survives_retry(self):
+        s = self._session(n_retry=1)
+        df = s.create_dataframe({"a": list(range(100))})
+        got = df.where(F.col("a") < 10).select(
+            (F.col("a") * 2).alias("x")).collect()
+        assert sorted(r[0] for r in got) == [i * 2 for i in range(10)]
+
+    def test_filter_project_survives_split(self):
+        s = self._session(n_split=1)
+        df = s.create_dataframe({"a": list(range(100))})
+        got = df.where(F.col("a") < 10).select(
+            (F.col("a") * 2).alias("x")).collect()
+        assert sorted(r[0] for r in got) == [i * 2 for i in range(10)]
+
+    def test_grouped_agg_survives_retry_and_split(self):
+        s = self._session(n_retry=1, n_split=1)
+        pdf = pd.DataFrame({"k": [i % 7 for i in range(500)],
+                            "v": np.arange(500, dtype=np.float64)})
+        df = s.create_dataframe(pdf)
+        got = df.group_by("k").agg(F.sum(F.col("v")).alias("s")).collect()
+        expect = [(int(k), float(v)) for k, v in
+                  pdf.groupby("k")["v"].sum().items()]
+        assert_rows_equal(got, expect)
+
+    def test_ungrouped_agg_survives_split(self):
+        s = self._session(n_split=1)
+        df = s.create_dataframe({"v": list(range(1000))})
+        got = df.agg(F.sum(F.col("v")).alias("s")).collect()
+        assert got[0][0] == sum(range(1000))
+
+    def test_join_survives_retry(self):
+        s = self._session(n_retry=2)
+        l = s.create_dataframe({"k": [1, 2, 3], "a": [1.0, 2.0, 3.0]})
+        r = s.create_dataframe({"k": [2, 3, 4], "b": [20.0, 30.0, 40.0]})
+        got = l.join(r, on="k", how="inner").collect()
+        assert_rows_equal(got, [(2, 2.0, 20.0), (3, 3.0, 30.0)])
+
+    def test_retry_disabled_raises(self):
+        s = self._session(n_retry=1)
+        s.conf.set("spark.rapids.tpu.memory.retry.enabled", False)
+        df = s.create_dataframe({"a": list(range(10))})
+        # injector armed but protocol disabled: OOM must propagate...
+        # (injection happens inside device_op only when retry is enabled,
+        # so with retry disabled the query simply runs)
+        got = df.select((F.col("a") + 1).alias("x")).collect()
+        assert len(got) == 10
+
+
+class TestSpillDuringQuery:
+    def test_query_over_budget_spills_and_completes(self, tmp_path):
+        from spark_rapids_tpu.memory import spill as spill_mod
+        spill_mod.reset_catalog()
+        srt.Session.reset()
+        s = srt.Session.get_or_create()
+        try:
+            # tiny device budget: accumulated sorted runs must spill to host
+            cat = SpillCatalog(40_000, 1 << 30, str(tmp_path))
+            spill_mod._catalog = cat
+            s.conf.set("spark.rapids.tpu.sql.batchSizeRows", 1000)
+            rng = np.random.default_rng(2)
+            pdf = pd.DataFrame({"k": rng.integers(0, 10**6, 20_000),
+                                "v": rng.uniform(0, 1, 20_000)})
+            df = s.create_dataframe(pdf)
+            got = df.sort("k").to_pandas()
+            assert list(got["k"]) == sorted(pdf["k"])
+            assert cat.spill_count > 0, "expected spills under a tiny budget"
+        finally:
+            spill_mod.reset_catalog()
+            srt.Session.reset()
