@@ -29,6 +29,7 @@ _jax.config.update("jax_enable_x64", True)
 from .sql.session import Session  # noqa: F401
 from .sql.column import Column  # noqa: F401
 from .sql import functions  # noqa: F401
+from .sql.window import Window  # noqa: F401
 from .config import TpuConf  # noqa: F401
 from . import types  # noqa: F401
 

@@ -132,6 +132,8 @@ class CpuOpExec(TpuExec):
         if isinstance(p, L.Distinct):
             return self._child_table(ctx).group_by(
                 self.children[0].output_schema.names()).aggregate([])
+        if isinstance(p, L.Window):
+            return self._run_window(ctx, p)
         raise NotImplementedError(
             f"CPU fallback for {type(p).__name__} not implemented")
 
@@ -302,6 +304,255 @@ class CpuOpExec(TpuExec):
         key[null_mask] = (np.iinfo(np.int64).min if nulls_first
                           else np.iinfo(np.int64).max)
         return key
+
+    def _run_window(self, ctx, p: "L.Window"):
+        """Host window evaluation mirroring WindowExec semantics.
+
+        Same sorted/segmented model as the device path (ops/window.py) with
+        numpy primitives; output is in (partition, order) sorted order like
+        the device operator and Spark's WindowExec.
+        """
+        import pandas as pd
+        import pyarrow as pa
+        from ..plan.planner import strip_alias
+        from ..windowfns import WindowExpression
+        in_schema = self.children[0].output_schema
+        table = self._child_table(ctx)
+        vals = arrow_to_values(table, in_schema)
+        n = table.num_rows
+        bound = [(name, strip_alias(bind(e, in_schema)))
+                 for name, e in p.window_exprs]
+        spec = bound[0][1].spec
+
+        # ---- sort by (partition asc nulls-first, then order spec) ----
+        perm = np.arange(n)
+        orderings = ([(e, True, True) for e in spec.partition_by]
+                     + [(o.expr, o.ascending, o.nulls_first)
+                        for o in spec.order_by])
+        for e, asc, nf in reversed(orderings):
+            d, v = eval_cpu(e, vals, n)
+            d2 = d[perm]
+            v2 = v[perm] if v is not None else None
+            keys = self._sort_key(d2, v2, asc, nf)
+            perm = perm[np.argsort(keys, kind="stable")]
+
+        def codes_for(exprs) -> np.ndarray:
+            """Per-row group codes over sorted order (nulls/NaN = own code)."""
+            if not exprs or n == 0:
+                return np.zeros(n, dtype=np.int64)
+            cols = []
+            for e in exprs:
+                d, v = eval_cpu(e, vals, n)
+                s = pd.Series(list(d[perm]) if d.dtype == object else d[perm])
+                if v is not None:
+                    s = s.where(pd.Series(v[perm]), other=pd.NA)
+                codes, _ = pd.factorize(s, use_na_sentinel=False)
+                cols.append(codes)
+            key = cols[0].astype(np.int64)
+            for c in cols[1:]:
+                key = key * (c.max() + 1 if len(c) else 1) + c
+            return key
+
+        seg_codes = codes_for(spec.partition_by)
+        peer_codes = codes_for(spec.partition_by
+                               + [o.expr for o in spec.order_by])
+        arange = np.arange(n)
+        seg_start = np.ones(n, dtype=bool)
+        seg_start[1:] = seg_codes[1:] != seg_codes[:-1]
+        peer_start = np.ones(n, dtype=bool)
+        peer_start[1:] = peer_codes[1:] != peer_codes[:-1]
+        seg_start_pos = np.maximum.accumulate(np.where(seg_start, arange, 0))
+        peer_start_pos = np.maximum.accumulate(np.where(peer_start, arange, 0))
+        seg_last = np.ones(n, dtype=bool)
+        seg_last[:-1] = seg_start[1:]
+        peer_last = np.ones(n, dtype=bool)
+        peer_last[:-1] = peer_start[1:]
+        big = n if n else 1
+        seg_end_pos = np.minimum.accumulate(
+            np.where(seg_last, arange, big)[::-1])[::-1]
+        peer_end_pos = np.minimum.accumulate(
+            np.where(peer_last, arange, big)[::-1])[::-1]
+        seg_ids = np.cumsum(seg_start) - 1 if n else np.zeros(0, dtype=int)
+
+        outs = []
+        for name, w in bound:
+            outs.append(self._window_one(
+                w, vals, n, perm, dict(
+                    arange=arange, seg_start=seg_start,
+                    seg_start_pos=seg_start_pos, seg_end_pos=seg_end_pos,
+                    peer_start=peer_start, peer_start_pos=peer_start_pos,
+                    peer_end_pos=peer_end_pos, seg_ids=seg_ids)))
+
+        sorted_tbl = table.take(pa.array(perm)) if n else table
+        win_tbl = values_to_arrow(
+            Schema([f for f in p.schema().fields[len(in_schema):]]), outs, n)
+        for i, f in enumerate(win_tbl.schema):
+            sorted_tbl = sorted_tbl.append_column(f, win_tbl.column(i))
+        return sorted_tbl
+
+    def _window_one(self, w, vals, n: int, perm, s) -> tuple:
+        import pandas as pd
+        from .. import aggfns as A
+        from .. import windowfns as WF
+        func = w.func
+        frame = w.spec.frame
+        arange, seg_ids = s["arange"], s["seg_ids"]
+        ssp, sep = s["seg_start_pos"], s["seg_end_pos"]
+        pep = s["peer_end_pos"]
+        if isinstance(func, WF.RowNumber):
+            return (arange - ssp + 1).astype(np.int32), None
+        if isinstance(func, WF.Rank):
+            return (s["peer_start_pos"] - ssp + 1).astype(np.int32), None
+        if isinstance(func, WF.DenseRank):
+            dc = np.cumsum(s["peer_start"])
+            return (dc - dc[ssp] + 1).astype(np.int32), None
+        if isinstance(func, WF.PercentRank):
+            size1 = (sep - ssp).astype(np.float64)
+            r = (s["peer_start_pos"] - ssp).astype(np.float64)
+            return np.where(size1 > 0, r / np.maximum(size1, 1), 0.0), None
+        if isinstance(func, WF.CumeDist):
+            size = (sep - ssp + 1).astype(np.float64)
+            return (pep - ssp + 1).astype(np.float64) / size, None
+        if isinstance(func, WF.NTile):
+            size = sep - ssp + 1
+            rn0 = arange - ssp
+            nt = func.n
+            base, rem = size // nt, size % nt
+            bigsz = base + 1
+            in_big = rn0 < bigsz * rem
+            tile = np.where(in_big, rn0 // np.maximum(bigsz, 1),
+                            rem + (rn0 - bigsz * rem) // np.maximum(base, 1))
+            return (tile + 1).astype(np.int32), None
+        if isinstance(func, WF.Lag):  # Lead subclasses Lag
+            d, v = eval_cpu(func.children[0], vals, n)
+            d, v = d[perm], (v[perm] if v is not None else None)
+            off = func.offset_sign * func.offset
+            src = arange - off
+            in_seg = (src >= ssp) & (src <= sep)
+            safe = np.clip(src, 0, max(n - 1, 0))
+            out = d[safe]
+            valid = in_seg if v is None else (in_seg & v[safe])
+            if len(func.children) > 1:
+                dd, dv = eval_cpu(func.children[1], vals, n)
+                out = np.where(in_seg, out, dd.astype(out.dtype)
+                               if out.dtype != object else dd)
+                valid = np.where(in_seg, valid,
+                                 np.ones(n, bool) if dv is None else dv)
+            return out, (None if valid.all() else valid)
+        assert isinstance(func, A.AggregateExpression), func
+        fname = func.func
+        if fname == "count(*)":
+            m = np.ones(n, dtype=bool)
+            return self._framed_sum_np(frame, m.astype(np.int64), s), None
+        d, v = eval_cpu(func.children[0], vals, n)
+        d, v = d[perm], (v[perm] if v is not None else None)
+        m = np.ones(n, dtype=bool) if v is None else v.copy()
+        if fname == "count":
+            return self._framed_sum_np(frame, m.astype(np.int64), s), None
+        cnt = self._framed_sum_np(frame, m.astype(np.int64), s)
+        ok = cnt > 0
+        if fname in ("sum", "avg"):
+            src_dt = func.children[0].dtype
+            if fname == "avg" or src_dt.is_floating:
+                data = d.astype(np.float64)
+                if src_dt.is_decimal:
+                    data = data / 10.0 ** src_dt.scale
+            else:
+                data = d.astype(np.int64)
+            contrib = np.where(m, data, 0)
+            tot = self._framed_sum_np(frame, contrib, s)
+            if fname == "avg":
+                return tot / np.maximum(cnt, 1), (None if ok.all() else ok)
+            return (tot.astype(func.dtype.numpy_dtype),
+                    None if ok.all() else ok)
+        if fname in ("min", "max"):
+            if not (frame.is_unbounded_both or frame.is_running):
+                return self._bounded_frame_minmax(fname, frame, d, m, s, ok,
+                                                  func.dtype.numpy_dtype)
+            ser = pd.Series(d.astype(np.float64) if d.dtype != object else d)
+            ser = ser.where(pd.Series(m), other=np.nan)
+            g = ser.groupby(seg_ids)
+            if frame.is_unbounded_both:
+                r = g.transform("min" if fname == "min" else "max")
+            else:
+                r = g.cummin() if fname == "min" else g.cummax()
+                r = pd.Series(r.to_numpy()[pep]) if frame.kind == "range" else r
+            out = r.to_numpy()
+            out = np.where(ok, np.nan_to_num(out), 0).astype(
+                func.dtype.numpy_dtype)
+            return out, (None if ok.all() else ok)
+        if fname in ("first", "last"):
+            ignore = getattr(func, "ignore_nulls", False)
+            lo_pos, hi_pos = self._frame_bounds(frame, s)
+            out = np.zeros(n, dtype=d.dtype if d.dtype != object else object)
+            okv = np.zeros(n, dtype=bool)
+            for i in range(n):
+                a, b = int(lo_pos[i]), int(hi_pos[i])
+                if b < a:
+                    continue
+                if ignore:
+                    rng = range(a, b + 1) if fname == "first" \
+                        else range(b, a - 1, -1)
+                    for j in rng:
+                        if m[j]:
+                            out[i] = d[j]
+                            okv[i] = True
+                            break
+                else:
+                    j = a if fname == "first" else b
+                    out[i] = d[j]
+                    okv[i] = bool(v is None or v[j])
+            return out, (None if okv.all() else okv)
+        raise NotImplementedError(f"CPU window aggregate {fname}")
+
+    @staticmethod
+    def _frame_bounds(frame, s):
+        """Per-row inclusive [lo_pos, hi_pos] frame bounds in sorted order."""
+        arange, ssp, sep = s["arange"], s["seg_start_pos"], s["seg_end_pos"]
+        if frame.kind == "range":
+            lo_pos = ssp  # only unbounded-preceding range frames exist here
+            hi_pos = sep if frame.hi is None else s["peer_end_pos"]
+        else:
+            lo_pos = ssp if frame.lo is None else np.maximum(
+                arange + frame.lo, ssp)
+            hi_pos = sep if frame.hi is None else np.minimum(
+                arange + frame.hi, sep)
+        return lo_pos, hi_pos
+
+    def _bounded_frame_minmax(self, fname, frame, d, m, s, ok, np_dt):
+        """Brute-force sliding min/max (the frames the device declines)."""
+        n = len(d)
+        lo_pos, hi_pos = self._frame_bounds(frame, s)
+        out = np.zeros(n, dtype=np_dt)
+        for i in range(n):
+            vals = [d[j] for j in range(int(lo_pos[i]), int(hi_pos[i]) + 1)
+                    if m[j]]
+            if vals:
+                out[i] = min(vals) if fname == "min" else max(vals)
+        return out, (None if ok.all() else ok)
+
+    @staticmethod
+    def _framed_sum_np(frame, contrib: np.ndarray, s) -> np.ndarray:
+        n = len(contrib)
+        arange, ssp, sep = s["arange"], s["seg_start_pos"], s["seg_end_pos"]
+        if n == 0:
+            return contrib
+        c = np.cumsum(contrib)
+        if frame.lo is None and frame.hi is None:
+            tot = c[sep] - c[ssp] + contrib[ssp]
+            return tot
+        if frame.lo is None and frame.hi == 0:
+            run = c - (c[ssp] - contrib[ssp])
+            if frame.kind == "range":
+                run = run[s["peer_end_pos"]]
+            return run
+        lo_pos = ssp if frame.lo is None else np.maximum(arange + frame.lo, ssp)
+        hi_pos = sep if frame.hi is None else np.minimum(arange + frame.hi, sep)
+        empty = hi_pos < lo_pos
+        lo_c = np.clip(lo_pos, 0, n - 1)
+        hi_c = np.clip(hi_pos, 0, n - 1)
+        out = c[hi_c] - c[lo_c] + contrib[lo_c]
+        return np.where(empty, 0, out)
 
     def _run_join(self, ctx, p: L.Join):
         """SQL-semantics host join (GpuHashJoin CPU twin).

@@ -113,10 +113,13 @@ def with_retry(ctx, batch: ColumnBatch, fn: Callable[[ColumnBatch], object],
         yield fn(batch)
         return
     catalog = get_catalog(ctx.conf if ctx is not None else None)
-    pending: List[ColumnBatch] = [batch]
+    # pending holds spillable HANDLES, not raw batches: a batch waiting its
+    # turn (or being retried) must be evictable, and no strong device ref may
+    # outlive the attempt or spilling it cannot actually free HBM.
+    pending = [catalog.register(batch, priority=10)]
+    del batch
     while pending:
-        cur = pending.pop(0)
-        handle = catalog.register(cur, priority=10)
+        handle = pending.pop(0)
         try:
             attempts = 0
             while True:
@@ -136,7 +139,9 @@ def with_retry(ctx, batch: ColumnBatch, fn: Callable[[ColumnBatch], object],
                         raise
                     TaskMetrics.get().split_retry_count += 1
                     halves = split(handle.get())
-                    pending = halves + pending
+                    pending = [catalog.register(h, priority=10)
+                               for h in halves] + pending
+                    del halves
                     break
         finally:
             handle.close()

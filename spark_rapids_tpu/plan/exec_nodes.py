@@ -108,7 +108,11 @@ class SortExec(TpuExec):
                 return
             # ---- out-of-core: range-partitioned merge ----
             n_ranges = max(2, -(-total // batch_rows))
-            keys = [self._range_key(h.get()) for h in runs]
+            keys = []
+            for h in runs:
+                keys.append(self._range_key(h.get()))
+                # don't let the key-sampling sweep pin every run in HBM
+                catalog.ensure_budget()
             bounds = _sample_bounds(keys, n_ranges)
             for lo_b, hi_b in bounds:
                 slices = []
@@ -126,9 +130,14 @@ class SortExec(TpuExec):
                     part = batch_utils.compact(
                         batch_utils.concat_batches(slices)) \
                         if len(slices) > 1 else slices[0]
-                    out = self._sort_batch(part)
-                m.add("numOutputRows", out.num_rows)
-                yield out
+                    del slices
+                    # plain retry only: splitting a range would interleave
+                    # the globally-ordered output
+                    outs = list(with_retry(ctx, part, self._sort_batch,
+                                           split=None))
+                for out in outs:
+                    m.add("numOutputRows", out.num_rows)
+                    yield out
         finally:
             for h in runs:
                 h.close()

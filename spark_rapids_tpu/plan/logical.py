@@ -16,7 +16,7 @@ from ..exprs import (AggregateExpression, Alias, Expression, UnresolvedColumn,
 
 __all__ = ["LogicalPlan", "LogicalScan", "Project", "Filter", "Aggregate",
            "Sort", "SortOrder", "Join", "Limit", "Union", "LogicalRange",
-           "Sample", "Expand", "Distinct"]
+           "Sample", "Expand", "Distinct", "Window"]
 
 
 class LogicalPlan:
@@ -207,6 +207,32 @@ class Sample(LogicalPlan):
 
     def schema(self) -> Schema:
         return self.children[0].schema()
+
+
+class Window(LogicalPlan):
+    """Append window-function columns (GpuWindowExec analog).
+
+    All ``window_exprs`` share one (partition_by, order_by) sort spec — the
+    DataFrame layer splits mixed-spec selections into a chain of Window nodes,
+    like Spark's ExtractWindowExpressions analysis rule.  Output schema =
+    child columns ++ window columns.
+    """
+
+    def __init__(self, child: LogicalPlan,
+                 window_exprs: List[Tuple[str, Expression]]):
+        self.children = (child,)
+        self.window_exprs = window_exprs
+
+    def schema(self) -> Schema:
+        in_schema = self.children[0].schema()
+        fields = list(in_schema.fields)
+        for name, e in self.window_exprs:
+            b = bind(e, in_schema)
+            fields.append(Field(name, b.dtype, b.nullable))
+        return Schema(fields)
+
+    def node_desc(self):
+        return f"Window [{', '.join(n for n, _ in self.window_exprs)}]"
 
 
 class Expand(LogicalPlan):

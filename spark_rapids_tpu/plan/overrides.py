@@ -182,6 +182,28 @@ class NodeMeta:
                     for r in expr_reasons(bind(e, schema)):
                         self.will_not_work(f"{name}: {r}")
             return
+        if isinstance(p, L.Window):
+            from ..windowfns import WindowExpression, device_support_reason
+            schema = p.children[0].schema()
+            for name, e in p.window_exprs:
+                b = strip_alias(bind(e, schema))
+                if not isinstance(b, WindowExpression):
+                    self.will_not_work(f"{name} is not a window expression")
+                    continue
+                r = device_support_reason(b)
+                if r:
+                    self.will_not_work(f"{name}: {r}")
+                for pe in b.spec.partition_by:
+                    for rr in expr_reasons(pe, allow_string_passthrough=False):
+                        self.will_not_work(f"{name} partition key: {rr}")
+                for o in b.spec.order_by:
+                    for rr in expr_reasons(o.expr,
+                                           allow_string_passthrough=False):
+                        self.will_not_work(f"{name} order key: {rr}")
+                for c in b.func.children:
+                    for rr in expr_reasons(c, allow_string_passthrough=False):
+                        self.will_not_work(f"{name}: {rr}")
+            return
         self.will_not_work(f"operator {type(p).__name__} has no TPU version")
 
     # -- explain ------------------------------------------------------------------
@@ -301,6 +323,14 @@ def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
         left = _convert(meta.children[0], conf)
         right = _convert(meta.children[1], conf)
         return plan_join(p, left, right, conf)
+
+    if isinstance(p, L.Window):
+        from .window_exec import WindowExec
+        child_phys = _convert(meta.children[0], conf)
+        schema = child_phys.output_schema
+        bound = [(n, strip_alias(bind(e, schema)))
+                 for n, e in p.window_exprs]
+        return WindowExec(child_phys, bound)
 
     if isinstance(p, L.Expand):
         from .exec_nodes import ExpandExec

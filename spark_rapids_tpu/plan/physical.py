@@ -45,9 +45,10 @@ class ExecContext:
         # spark.rapids.sql.test.injectRetryOOM analog)
         n_retry = self.conf["spark.rapids.tpu.test.injectRetryOOM"]
         n_split = self.conf["spark.rapids.tpu.test.injectSplitAndRetryOOM"]
-        if n_retry or n_split:
-            from ..memory.retry import INJECTOR
-            INJECTOR.arm(n_retry, n_split)
+        # arm unconditionally: a conf with no injection must CLEAR any
+        # injections a previous query armed on the process-global injector
+        from ..memory.retry import INJECTOR
+        INJECTOR.arm(n_retry, n_split)
 
     def metric_set(self, op_id: str) -> MetricSet:
         if op_id not in self.metrics:

@@ -185,6 +185,17 @@ def _walk(node: L.LogicalPlan, required: Optional[Set[str]],
         return L.Expand(_walk(node.children[0], child_req, []),
                         node.projections)
 
+    if isinstance(node, L.Window):
+        # predicates must not cross: a filter above a window would change
+        # partition contents if pushed below it
+        child_req = None
+        if required is not None:
+            wnames = {n for n, _ in node.window_exprs}
+            child_req = {c for c in required if c not in wnames}
+            child_req |= _refs(e for _, e in node.window_exprs)
+        return L.Window(_walk(node.children[0], child_req, []),
+                        node.window_exprs)
+
     if isinstance(node, L.Sample):
         return L.Sample(_walk(node.children[0], required, []),
                         node.fraction, node.seed)

@@ -3,3 +3,4 @@
 from .session import Session  # noqa: F401
 from .column import Column  # noqa: F401
 from . import functions  # noqa: F401
+from .window import Window, WindowSpec  # noqa: F401

@@ -124,6 +124,18 @@ class Column:
     def when(self, *args):
         raise TypeError("use functions.when(cond, value) to build CASE WHEN")
 
+    def over(self, spec) -> "Column":
+        """Attach a window spec: ``F.row_number().over(w)``."""
+        from ..windowfns import WindowExpression
+        from .window import WindowSpec
+        assert isinstance(spec, WindowSpec), "over() takes a WindowSpec"
+        core = self.expr
+        name = None
+        if isinstance(core, _AliasMarker):
+            name, core = core.name, core.children[0]
+        w = WindowExpression(core, spec._spec)
+        return Column(_AliasMarker(w, name) if name else w)
+
     # sort helpers
     def asc(self):
         from ..plan.logical import SortOrder

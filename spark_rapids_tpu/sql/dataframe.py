@@ -25,6 +25,56 @@ def _named(c: Union[str, Column]) -> tuple:
     return (c.name, c.expr)
 
 
+def _rewrite_windows(plan: L.LogicalPlan, exprs: List[tuple]):
+    """Pull WindowExpressions out of a projection into Window nodes
+    (Spark's ExtractWindowExpressions analysis rule analog).
+
+    Returns (new_child_plan, rewritten_exprs): each window subtree is
+    replaced by a reference to a generated ``__w{i}`` column computed by a
+    chain of L.Window nodes (one per distinct partition+order spec).
+    """
+    from ..windowfns import WindowExpression
+
+    found: List[tuple] = []  # (gen_name, wexpr)
+    by_fp = {}
+
+    def walk_replace(e: E.Expression) -> E.Expression:
+        if isinstance(e, WindowExpression):
+            fp = e.fingerprint()
+            if fp in by_fp:
+                return E.UnresolvedColumn(by_fp[fp])
+            gen = f"__w{len(found)}"
+            by_fp[fp] = gen
+            found.append((gen, e))
+            return E.UnresolvedColumn(gen)
+        if not e.children:
+            return e
+        import copy
+        new_children = tuple(walk_replace(c) for c in e.children)
+        if all(a is b for a, b in zip(new_children, e.children)):
+            return e
+        node = copy.copy(e)
+        node.children = new_children
+        return node
+
+    new_exprs = [(n, walk_replace(e)) for n, e in exprs]
+    if not found:
+        return plan, exprs
+    # group by sort spec: one Window node per distinct (partition, order)
+    groups: Dict[str, List[tuple]] = {}
+    order: List[str] = []
+    for gen, w in found:
+        key = w.spec.spec_fingerprint()
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append((gen, w))
+    child = plan
+    for key in order:
+        child = L.Window(child, groups[key])
+    return child, new_exprs
+
+
 class DataFrame:
     def __init__(self, plan: L.LogicalPlan, session):
         self._plan = plan
@@ -46,7 +96,8 @@ class DataFrame:
     # -- transformations ----------------------------------------------------------
     def select(self, *cols: Union[str, Column]) -> "DataFrame":
         exprs = [_named(c) for c in cols]
-        return DataFrame(L.Project(self._plan, exprs), self.session)
+        child, exprs = _rewrite_windows(self._plan, exprs)
+        return DataFrame(L.Project(child, exprs), self.session)
 
     def where(self, condition: Union[Column, str]) -> "DataFrame":
         assert not isinstance(condition, str), "SQL string filters: use sql()"
@@ -65,7 +116,8 @@ class DataFrame:
                 exprs.append((f.name, E.UnresolvedColumn(f.name)))
         if not replaced:
             exprs.append((name, c.expr))
-        return DataFrame(L.Project(self._plan, exprs), self.session)
+        child, exprs = _rewrite_windows(self._plan, exprs)
+        return DataFrame(L.Project(child, exprs), self.session)
 
     withColumn = with_column
 

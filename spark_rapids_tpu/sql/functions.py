@@ -12,7 +12,8 @@ from .column import Column, to_expr
 __all__ = [
     "col", "lit", "when", "coalesce", "isnull", "isnan", "expr_abs",
     "sum", "count", "count_star", "min", "max", "avg", "mean", "first", "last",
-    "parse_type",
+    "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
+    "lag", "lead", "parse_type",
 ]
 
 def col(name: str) -> Column:
@@ -109,6 +110,55 @@ _TYPE_NAMES = {
     "date": T.DATE,
     "timestamp": T.TIMESTAMP,
 }
+
+
+# -- window functions ---------------------------------------------------------------
+
+def row_number() -> Column:
+    from ..windowfns import RowNumber
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from ..windowfns import Rank
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from ..windowfns import DenseRank
+    return Column(DenseRank())
+
+
+def percent_rank() -> Column:
+    from ..windowfns import PercentRank
+    return Column(PercentRank())
+
+
+def cume_dist() -> Column:
+    from ..windowfns import CumeDist
+    return Column(CumeDist())
+
+
+def ntile(n: int) -> Column:
+    from ..windowfns import NTile
+    return Column(NTile(n))
+
+
+def _colref(c) -> E.Expression:
+    """str means a column NAME here (PySpark semantics for lag/lead)."""
+    if isinstance(c, str):
+        return E.UnresolvedColumn(c)
+    return to_expr(c)
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    from ..windowfns import Lag
+    return Column(Lag(_colref(c), offset, default))
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    from ..windowfns import Lead
+    return Column(Lead(_colref(c), offset, default))
 
 
 def parse_type(s: str) -> T.DataType:

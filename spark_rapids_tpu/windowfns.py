@@ -1,0 +1,341 @@
+"""Window expressions: specs, ranking functions, framed aggregates.
+
+Analog of the reference's GpuWindowExpression.scala / GpuWindowFunction
+hierarchy (rank family GpuWindowExpression.scala:1000+, lead/lag, framed
+aggregates).  A ``WindowExpression`` wraps a window function (a ranking
+function, lead/lag, or a plain AggregateExpression) together with its
+partition/order spec and frame; WindowExec lowers every expression sharing a
+spec through one sorted, fused XLA program (ops/window.py).
+
+Frame model: ``WindowFrame(kind, lo, hi)`` with ``kind`` in {"rows","range"},
+``lo``/``hi`` row/peer offsets relative to the current row and ``None`` for
+unbounded — ("range", None, 0) is Spark's default frame when an ORDER BY is
+present, ("rows", None, None) when not.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from . import types as T
+from .exprs import (AggregateExpression, EvalContext, Expression, Literal,
+                    Value)
+from .ops import window as W
+
+__all__ = ["WindowFrame", "WindowSpecDef", "WindowExpression",
+           "RowNumber", "Rank", "DenseRank", "PercentRank", "CumeDist",
+           "NTile", "Lag", "Lead"]
+
+
+@dataclass(frozen=True)
+class WindowFrame:
+    kind: str  # "rows" | "range"
+    lo: Optional[int]  # None = unbounded preceding
+    hi: Optional[int]  # None = unbounded following
+
+    def fingerprint(self) -> str:
+        return f"{self.kind}[{self.lo},{self.hi}]"
+
+    @property
+    def is_unbounded_both(self) -> bool:
+        return self.lo is None and self.hi is None
+
+    @property
+    def is_running(self) -> bool:
+        return self.lo is None and self.hi == 0
+
+
+class WindowSpecDef:
+    """partition_by + order_by + frame (bound or unbound expressions)."""
+
+    def __init__(self, partition_by: Sequence[Expression],
+                 order_by: Sequence,  # List[SortOrder]
+                 frame: Optional[WindowFrame] = None,
+                 frame_explicit: bool = False):
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        # an explicitly-set frame survives later order_by() calls (PySpark
+        # WindowSpec semantics); only the implicit default is recomputed
+        self.frame_explicit = frame_explicit and frame is not None
+        if frame is None:
+            frame = (WindowFrame("range", None, 0) if self.order_by
+                     else WindowFrame("rows", None, None))
+        self.frame = frame
+
+    def spec_fingerprint(self) -> str:
+        """Identity of the sort (partition+order) — exprs sharing it can share
+        one sorted pass; the frame intentionally NOT included."""
+        parts = [e.fingerprint() for e in self.partition_by]
+        ords = [f"{o.expr.fingerprint()}:{o.ascending}:{o.nulls_first}"
+                for o in self.order_by]
+        return "P(" + ",".join(parts) + ")O(" + ",".join(ords) + ")"
+
+
+class WindowFunction(Expression):
+    """Base for pure window functions (ranking family, lead/lag)."""
+
+    def window_eval(self, w: W.SortedWindowContext, ectx: EvalContext) -> Value:
+        raise NotImplementedError
+
+
+class RowNumber(WindowFunction):
+    def __init__(self):
+        self.children = ()
+        self.dtype = T.INT32
+        self.nullable = False
+
+    def window_eval(self, w, ectx):
+        return W.row_number(w), None
+
+
+class Rank(WindowFunction):
+    def __init__(self):
+        self.children = ()
+        self.dtype = T.INT32
+        self.nullable = False
+
+    def window_eval(self, w, ectx):
+        return W.rank(w), None
+
+
+class DenseRank(WindowFunction):
+    def __init__(self):
+        self.children = ()
+        self.dtype = T.INT32
+        self.nullable = False
+
+    def window_eval(self, w, ectx):
+        return W.dense_rank(w), None
+
+
+class PercentRank(WindowFunction):
+    def __init__(self):
+        self.children = ()
+        self.dtype = T.FLOAT64
+        self.nullable = False
+
+    def window_eval(self, w, ectx):
+        return W.percent_rank(w), None
+
+
+class CumeDist(WindowFunction):
+    def __init__(self):
+        self.children = ()
+        self.dtype = T.FLOAT64
+        self.nullable = False
+
+    def window_eval(self, w, ectx):
+        return W.cume_dist(w), None
+
+
+class NTile(WindowFunction):
+    def __init__(self, n: int):
+        assert n >= 1, "ntile requires n >= 1"
+        self.n = n
+        self.children = ()
+        self.dtype = T.INT32
+        self.nullable = False
+
+    def _fp_extra(self):
+        return f"n={self.n}"
+
+    def window_eval(self, w, ectx):
+        return W.ntile(w, self.n), None
+
+
+class Lag(WindowFunction):
+    offset_sign = 1
+
+    def __init__(self, child: Expression, offset: int = 1, default=None):
+        self.offset = offset
+        self.default = default
+        self.children = (child,) if default is None else (
+            child, default if isinstance(default, Expression)
+            else Literal(default))
+        if child.resolved():
+            self._rebind()
+
+    def _rebind(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = True
+
+    def _fp_extra(self):
+        return f"off={self.offset}:{self.dtype}"
+
+    def window_eval(self, w, ectx):
+        val = w.sort_value(self.children[0].eval(ectx))
+        default = None
+        if len(self.children) > 1:
+            default = self.children[1].eval(ectx)
+            default = (default[0], default[1])
+        return W.shift(w, val, self.offset_sign * self.offset, default)
+
+
+class Lead(Lag):
+    offset_sign = -1
+
+
+class WindowExpression(Expression):
+    """``func OVER spec``.  children = (func, *partition_by, *order_exprs)
+    so that bind() resolves every subtree; ``_rebind`` reassembles."""
+
+    def __init__(self, func: Expression, spec: WindowSpecDef):
+        self.func = func
+        self.spec = spec
+        self.children = ((func,) + tuple(spec.partition_by)
+                         + tuple(o.expr for o in spec.order_by))
+        if all(c.resolved() for c in self.children):
+            self._rebind()
+
+    def _rebind(self):
+        from .plan.logical import SortOrder
+        n_part = len(self.spec.partition_by)
+        self.func = self.children[0]
+        part = list(self.children[1:1 + n_part])
+        ord_exprs = list(self.children[1 + n_part:])
+        orders = [SortOrder(e, o.ascending, o.nulls_first)
+                  for e, o in zip(ord_exprs, self.spec.order_by)]
+        self.spec = WindowSpecDef(part, orders, self.spec.frame,
+                                  frame_explicit=self.spec.frame_explicit)
+        if isinstance(self.func, AggregateExpression):
+            if self.func.children and self.func.children[0].resolved():
+                self.func._resolve()
+        self.dtype = self.func.dtype
+        self.nullable = (self.func.nullable
+                         or isinstance(self.func, AggregateExpression))
+
+    def _fp_extra(self):
+        return f"{self.spec.spec_fingerprint()}:{self.spec.frame.fingerprint()}"
+
+    # -- device lowering ---------------------------------------------------------
+    def window_eval(self, w: W.SortedWindowContext, ectx: EvalContext) -> Value:
+        if isinstance(self.func, WindowFunction):
+            return self.func.window_eval(w, ectx)
+        return self._agg_window_eval(w, ectx)
+
+    def _agg_window_eval(self, w, ectx) -> Value:
+        agg = self.func
+        frame = self.spec.frame
+        fname = agg.func
+        cap = w.capacity
+        if fname == "count(*)":
+            contrib = w.active.astype(jnp.int64)
+            cnt = self._framed_sum(w, frame, contrib)
+            return cnt, None
+        d, v = w.sort_value(agg.children[0].eval(ectx))
+        m = w.active if v is None else (w.active & v)
+        if fname == "count":
+            cnt = self._framed_sum(w, frame, m.astype(jnp.int64))
+            return cnt, None
+        if fname in ("sum", "avg"):
+            src = agg.children[0].dtype
+            if fname == "avg" or src.is_floating:
+                data = d.astype(jnp.float64)
+                if src.is_decimal:
+                    data = data / (10.0 ** src.scale)
+            elif src.is_decimal:
+                data = d  # scaled int64 passes through; dtype carries scale
+            else:
+                data = d.astype(jnp.int64)
+            contrib = jnp.where(m, data, jnp.zeros_like(data))
+            s = self._framed_sum(w, frame, contrib)
+            cnt = self._framed_sum(w, frame, m.astype(jnp.int64))
+            ok = cnt > 0
+            if fname == "avg":
+                return s / jnp.where(ok, cnt, 1).astype(jnp.float64), ok
+            return s.astype(self.dtype.numpy_dtype), ok
+        if fname in ("min", "max"):
+            if frame.is_unbounded_both:
+                out = W.partition_reduce(w, d, m, fname)
+            else:  # running (validated by the planner)
+                run = W.running_minmax(w, d, m, fname)
+                if frame.kind == "range":
+                    run = run[w.peer_end_pos]
+                out = run
+            cnt = self._framed_sum(w, frame, m.astype(jnp.int64))
+            return out, cnt > 0
+        if fname in ("first", "last"):
+            return self._first_last(w, frame, fname, d, v,
+                                    getattr(agg, "ignore_nulls", False))
+        raise NotImplementedError(f"window aggregate {fname}")
+
+    def _framed_sum(self, w, frame: WindowFrame, contrib):
+        if frame.is_unbounded_both:
+            return W.partition_reduce(w, contrib, w.active, "sum")
+        if frame.is_running:
+            run = W.running_sum(w, contrib)
+            if frame.kind == "range":
+                run = run[w.peer_end_pos]
+            return run
+        return W.sliding_sum(w, contrib, frame.lo, frame.hi)
+
+    def _first_last(self, w, frame, fname, d, v, ignore_nulls):
+        m = w.active if v is None else (w.active & v)
+        if ignore_nulls:
+            idx = w.arange
+            if fname == "first":
+                cand = jnp.where(m, idx, w.capacity)
+                if frame.is_unbounded_both:
+                    best = W.partition_reduce(w, cand, w.active, "min")
+                else:
+                    best = W.running_minmax(w, cand, w.active, "min")
+                    if frame.kind == "range":
+                        best = best[w.peer_end_pos]
+                has = best < w.capacity
+            else:
+                cand = jnp.where(m, idx, -1)
+                if frame.is_unbounded_both:
+                    best = W.partition_reduce(w, cand, w.active, "max")
+                else:
+                    best = W.running_minmax(w, cand, w.active, "max")
+                    if frame.kind == "range":
+                        best = best[w.peer_end_pos]
+                has = best >= 0
+            safe = jnp.clip(best, 0, w.capacity - 1)
+            return d[safe], has
+        if fname == "first":
+            pos = w.seg_start_pos
+        elif frame.is_unbounded_both:
+            pos = w.seg_end_pos
+        elif frame.kind == "range":
+            pos = w.peer_end_pos
+        else:
+            pos = w.arange
+        out = d[pos]
+        valid = None if v is None else v[pos]
+        return out, valid
+
+
+# Planner support matrix: which (function, frame) pairs lower to the device.
+_DEVICE_AGGS = {"sum", "count", "count(*)", "min", "max", "avg", "first",
+                "last"}
+
+
+def device_support_reason(wexpr: WindowExpression) -> Optional[str]:
+    """None if this window expression lowers to the device; else a reason."""
+    func = wexpr.func
+    frame = wexpr.spec.frame
+    if isinstance(func, (Rank, DenseRank, PercentRank, CumeDist)):
+        if not wexpr.spec.order_by:
+            return f"{type(func).__name__} requires an ORDER BY"
+        return None
+    if isinstance(func, NTile):
+        if not wexpr.spec.order_by:
+            return "ntile requires an ORDER BY"
+        return None
+    if isinstance(func, (RowNumber, Lag, Lead)):
+        return None
+    if isinstance(func, AggregateExpression):
+        if func.func not in _DEVICE_AGGS:
+            return f"window aggregate {func.func} not on device"
+        if frame.is_unbounded_both or frame.is_running:
+            return None
+        if frame.kind == "rows" and func.func in (
+                "sum", "count", "count(*)", "avg"):
+            return None
+        return (f"frame {frame.fingerprint()} for {func.func} needs sliding "
+                f"min/max (CPU fallback)")
+    return f"unknown window function {type(func).__name__}"
