@@ -192,6 +192,18 @@ FILE_CACHE_MAX_BYTES = register(
     "Byte budget for the decoded-file cache; least-recently-used files are "
     "evicted beyond it.")
 
+FILE_CACHE_DEVICE_TIER = register(
+    "spark.rapids.tpu.sql.fileCache.deviceTier", True,
+    "When the file cache is enabled, additionally keep the *uploaded* device "
+    "batches of repeated identical scans resident in HBM (LRU under "
+    "fileCache.device.maxBytes), so steady-state queries skip the host→HBM "
+    "upload entirely. The ShuffleBufferCatalog keep-it-on-device idea "
+    "(RapidsShuffleInternalManagerBase.scala:897) applied to scans.")
+
+FILE_CACHE_DEVICE_MAX_BYTES = register(
+    "spark.rapids.tpu.sql.fileCache.device.maxBytes", 2 << 30,
+    "HBM byte budget for the device tier of the file cache.")
+
 MAX_READER_BATCH_BYTES = register(
     "spark.rapids.tpu.sql.reader.batchSizeBytes", 512 << 20,
     "Soft cap on bytes of file data decoded into a single scan batch.")

@@ -434,6 +434,10 @@ class CpuOpExec(TpuExec):
             valid = in_seg if v is None else (in_seg & v[safe])
             if len(func.children) > 1:
                 dd, dv = eval_cpu(func.children[1], vals, n)
+                # permute the default into sorted order too (output rows
+                # are in window-sorted order)
+                dd = dd[perm]
+                dv = dv[perm] if dv is not None else None
                 out = np.where(in_seg, out, dd.astype(out.dtype)
                                if out.dtype != object else dd)
                 valid = np.where(in_seg, valid,
@@ -469,17 +473,28 @@ class CpuOpExec(TpuExec):
             if not (frame.is_unbounded_both or frame.is_running):
                 return self._bounded_frame_minmax(fname, frame, d, m, s, ok,
                                                   func.dtype.numpy_dtype)
-            ser = pd.Series(d.astype(np.float64) if d.dtype != object else d)
-            ser = ser.where(pd.Series(m), other=np.nan)
+            # int64/decimal stay in the integer domain (pandas nullable
+            # Int64): a float64 detour corrupts values beyond 2^53
+            integral = d.dtype.kind in "iu"
+            if integral:
+                ser = pd.Series(d, dtype="Int64")
+                ser = ser.where(pd.Series(m))
+            else:
+                ser = pd.Series(d.astype(np.float64)
+                                if d.dtype != object else d)
+                ser = ser.where(pd.Series(m), other=np.nan)
             g = ser.groupby(seg_ids)
             if frame.is_unbounded_both:
                 r = g.transform("min" if fname == "min" else "max")
             else:
                 r = g.cummin() if fname == "min" else g.cummax()
-                r = pd.Series(r.to_numpy()[pep]) if frame.kind == "range" else r
-            out = r.to_numpy()
-            out = np.where(ok, np.nan_to_num(out), 0).astype(
-                func.dtype.numpy_dtype)
+                r = r.iloc[pep].reset_index(drop=True) \
+                    if frame.kind == "range" else r
+            if integral:
+                vals = r.fillna(0).to_numpy(dtype=np.int64)
+            else:
+                vals = np.nan_to_num(r.to_numpy())
+            out = np.where(ok, vals, 0).astype(func.dtype.numpy_dtype)
             return out, (None if ok.all() else ok)
         if fname in ("first", "last"):
             ignore = getattr(func, "ignore_nulls", False)
@@ -546,8 +561,7 @@ class CpuOpExec(TpuExec):
             if frame.kind == "range":
                 run = run[s["peer_end_pos"]]
             return run
-        lo_pos = ssp if frame.lo is None else np.maximum(arange + frame.lo, ssp)
-        hi_pos = sep if frame.hi is None else np.minimum(arange + frame.hi, sep)
+        lo_pos, hi_pos = CpuOpExec._frame_bounds(frame, s)
         empty = hi_pos < lo_pos
         lo_c = np.clip(lo_pos, 0, n - 1)
         hi_c = np.clip(hi_pos, 0, n - 1)

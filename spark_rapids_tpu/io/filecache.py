@@ -7,6 +7,11 @@ fetch but the host-side parquet *decode*; this cache keeps decoded Arrow
 tables keyed by (path, mtime, size, columns, row-groups) with LRU eviction
 under a byte budget, so repeated scans skip decode and go straight to the
 host→HBM upload.
+
+:class:`DeviceBatchCache` is the second tier: uploaded device batches of
+repeated identical scans stay HBM-resident.  Because those bytes are
+invisible to the spill catalog, the OOM path (memory/retry.py device_op)
+clears this tier before retrying.
 """
 
 from __future__ import annotations
@@ -16,10 +21,13 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
-__all__ = ["FileCache", "get_file_cache", "clear_file_cache"]
+__all__ = ["FileCache", "DeviceBatchCache", "get_file_cache",
+           "get_device_cache", "clear_file_cache", "clear_device_cache"]
 
 
 class FileCache:
+    """Byte-budgeted LRU of decoded Arrow tables keyed by file identity."""
+
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
         self._lock = threading.Lock()
@@ -38,6 +46,9 @@ class FileCache:
         rgs = tuple(row_groups) if row_groups is not None else None
         return (os.path.abspath(path), st.st_mtime_ns, st.st_size, cols, rgs)
 
+    def _entry_bytes(self, values: list) -> int:
+        return sum(t.nbytes for t in values)
+
     def get(self, key: tuple) -> Optional[list]:
         with self._lock:
             hit = self._entries.get(key)
@@ -48,19 +59,30 @@ class FileCache:
             self.hits += 1
             return hit[1]
 
-    def put(self, key: tuple, tables: list) -> None:
-        nbytes = sum(t.nbytes for t in tables)
+    def put(self, key: tuple, values: list) -> None:
+        nbytes = self._entry_bytes(values)
         if nbytes > self.max_bytes:
             return
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[0]
-            self._entries[key] = (nbytes, tables)
+            self._entries[key] = (nbytes, values)
             self._bytes += nbytes
-            while self._bytes > self.max_bytes and self._entries:
-                _, (sz, _tabs) = self._entries.popitem(last=False)
-                self._bytes -= sz
+            self._evict_to_budget()
+
+    def _evict_to_budget(self) -> None:
+        # caller holds self._lock
+        while self._bytes > self.max_bytes and self._entries:
+            _, (sz, _v) = self._entries.popitem(last=False)
+            self._bytes -= sz
+
+    def set_max_bytes(self, max_bytes: int) -> None:
+        """Resize in place (evict down if shrinking) instead of dropping the
+        warmed cache wholesale."""
+        with self._lock:
+            self.max_bytes = max_bytes
+            self._evict_to_budget()
 
     def clear(self) -> None:
         with self._lock:
@@ -68,7 +90,33 @@ class FileCache:
             self._bytes = 0
 
 
+class DeviceBatchCache(FileCache):
+    """LRU cache of *uploaded* scan output (device-resident ColumnBatch lists).
+
+    Second tier above :class:`FileCache`: where FileCache skips the parquet
+    decode, this skips the host→HBM upload as well, keyed by the scan's full
+    identity (source token embeds files, projection, and pushed predicates).
+    Entries are immutable by convention — every operator in this engine
+    builds new batches rather than mutating inputs — and ScanExec re-wraps
+    them on both populate and hit so callers can't perturb cached row
+    accounting.
+    """
+
+    @staticmethod
+    def _batch_bytes(b) -> int:
+        total = b.device_size_bytes()
+        for c in b.columns:
+            arr = getattr(c, "array", None)  # HostStringColumn
+            if arr is not None:
+                total += arr.nbytes
+        return total
+
+    def _entry_bytes(self, values: list) -> int:
+        return sum(self._batch_bytes(b) for b in values)
+
+
 _cache: Optional[FileCache] = None
+_device_cache: Optional[DeviceBatchCache] = None
 _cache_lock = threading.Lock()
 
 
@@ -78,17 +126,32 @@ def get_file_cache(max_bytes: int) -> FileCache:
         if _cache is None:
             _cache = FileCache(max_bytes)
         elif _cache.max_bytes != max_bytes:
-            # resize in place (evict down if shrinking) instead of dropping
-            # the warmed cache wholesale
-            with _cache._lock:
-                _cache.max_bytes = max_bytes
-                while _cache._bytes > max_bytes and _cache._entries:
-                    _, (sz, _tabs) = _cache._entries.popitem(last=False)
-                    _cache._bytes -= sz
+            _cache.set_max_bytes(max_bytes)
         return _cache
+
+
+def get_device_cache(max_bytes: int) -> DeviceBatchCache:
+    global _device_cache
+    with _cache_lock:
+        if _device_cache is None:
+            _device_cache = DeviceBatchCache(max_bytes)
+        elif _device_cache.max_bytes != max_bytes:
+            _device_cache.set_max_bytes(max_bytes)
+        return _device_cache
+
+
+def clear_device_cache() -> None:
+    """Drop all HBM-resident cached scan batches (called by the OOM-retry
+    path: these bytes are not in the spill catalog, so spilling alone cannot
+    free them)."""
+    with _cache_lock:
+        if _device_cache is not None:
+            _device_cache.clear()
 
 
 def clear_file_cache() -> None:
     with _cache_lock:
         if _cache is not None:
             _cache.clear()
+        if _device_cache is not None:
+            _device_cache.clear()

@@ -197,6 +197,20 @@ class ParquetSource:
                              self.num_threads, self.cache_bytes,
                              self.exact_filter, _paths=self.paths)
 
+    def cache_token(self) -> Optional[tuple]:
+        """Identity of this scan's output for the device-tier cache: files
+        (path+mtime+size), projection, and pushed predicates."""
+        files = []
+        for p in self.paths:
+            try:
+                st = os.stat(p)
+            except OSError:
+                return None
+            files.append((os.path.abspath(p), st.st_mtime_ns, st.st_size))
+        cols = tuple(self.columns) if self.columns is not None else None
+        preds = tuple((n, op, str(v)) for n, op, v in self.predicates)
+        return (tuple(files), cols, preds, self.batch_rows, self.exact_filter)
+
     def describe(self) -> str:
         d = str(self.path)
         if self.columns is not None:

@@ -85,6 +85,10 @@ def device_op(ctx, fn: Callable, *args):
         if _is_xla_oom(ex):
             catalog = get_catalog(ctx.conf if ctx is not None else None)
             catalog.spill_all_device()
+            # cached scan batches live outside the catalog: drop them too or
+            # the retry re-OOMs against memory spilling cannot reach
+            from ..io.filecache import clear_device_cache
+            clear_device_cache()
             raise RetryOOM(f"device OOM: {ex}") from ex
         raise
 
@@ -118,30 +122,37 @@ def with_retry(ctx, batch: ColumnBatch, fn: Callable[[ColumnBatch], object],
     # outlive the attempt or spilling it cannot actually free HBM.
     pending = [catalog.register(batch, priority=10)]
     del batch
-    while pending:
-        handle = pending.pop(0)
-        try:
-            attempts = 0
-            while True:
-                try:
-                    yield device_op(ctx, fn, handle.get())
-                    break
-                except (RetryOOM, SplitAndRetryOOM) as ex:
-                    escalate = isinstance(ex, SplitAndRetryOOM)
-                    if not escalate:
-                        attempts += 1
-                        TaskMetrics.get().retry_count += 1
-                        catalog.spill_all_device()
-                        if attempts <= MAX_PLAIN_RETRIES:
-                            continue  # plain retry (inputs restored on get)
-                        escalate = True  # retries exhausted: split
-                    if split is None:
-                        raise
-                    TaskMetrics.get().split_retry_count += 1
-                    halves = split(handle.get())
-                    pending = [catalog.register(h, priority=10)
-                               for h in halves] + pending
-                    del halves
-                    break
-        finally:
-            handle.close()
+    try:
+        while pending:
+            handle = pending.pop(0)
+            try:
+                attempts = 0
+                while True:
+                    try:
+                        yield device_op(ctx, fn, handle.get())
+                        break
+                    except (RetryOOM, SplitAndRetryOOM) as ex:
+                        escalate = isinstance(ex, SplitAndRetryOOM)
+                        if not escalate:
+                            attempts += 1
+                            TaskMetrics.get().retry_count += 1
+                            catalog.spill_all_device()
+                            if attempts <= MAX_PLAIN_RETRIES:
+                                continue  # plain retry (restored on get)
+                            escalate = True  # retries exhausted: split
+                        if split is None:
+                            raise
+                        TaskMetrics.get().split_retry_count += 1
+                        halves = split(handle.get())
+                        pending = [catalog.register(h, priority=10)
+                                   for h in halves] + pending
+                        del halves
+                        break
+            finally:
+                handle.close()
+    finally:
+        # consumer may abandon the generator mid-stream (LIMIT → GeneratorExit)
+        # or fn may raise a non-OOM error: queued handles must not stay
+        # registered or they pin memory in the catalog forever
+        for h in pending:
+            h.close()

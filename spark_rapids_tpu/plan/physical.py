@@ -110,15 +110,55 @@ class ScanExec(TpuExec):
         return f"TpuScan [{self.desc}] {self._schema.names()}"
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
-        from ..batch import from_arrow
+        from ..batch import ColumnBatch as _CB, from_arrow
         m = ctx.metric_set(self.op_id)
         min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+
+        # device-tier file cache: repeated identical scans skip decode AND
+        # upload (fileCache.deviceTier; keep-batches-resident idea from
+        # RapidsShuffleInternalManagerBase.scala:897 applied to scans)
+        dcache = None
+        dkey = None
+        if (ctx.conf["spark.rapids.tpu.sql.fileCache.enabled"]
+                and ctx.conf["spark.rapids.tpu.sql.fileCache.deviceTier"]):
+            token_fn = getattr(self._source_factory, "cache_token", None)
+            token = token_fn() if token_fn is not None else None
+            if token is not None:
+                from ..io.filecache import get_device_cache
+                dcache = get_device_cache(
+                    ctx.conf["spark.rapids.tpu.sql.fileCache.device.maxBytes"])
+                dkey = (token, min_cap, str(ctx.device))
+                hit = dcache.get(dkey)
+                if hit is not None:
+                    for b in hit:
+                        m.add("numOutputRows", b.num_rows)
+                        m.add("numOutputBatches", 1)
+                        # fresh wrapper: callers can't perturb cached state
+                        yield _CB(b.schema, b.columns, b.num_rows, b.sel)
+                    return
+
+        # the accumulator pins batches in HBM until the scan completes, so
+        # abandon it the moment the running size exceeds the cache budget —
+        # an over-budget scan must keep streaming/spilling, not OOM
+        acc = [] if dcache is not None else None
+        acc_bytes = 0
         for table in self._source_factory():
             with m.time("scanTime"):
                 b = from_arrow(table, min_capacity=min_cap, device=ctx.device)
             m.add("numOutputRows", b.num_rows)
             m.add("numOutputBatches", 1)
+            if acc is not None:
+                acc_bytes += dcache._batch_bytes(b)
+                if acc_bytes > dcache.max_bytes:
+                    acc = None
+                else:
+                    acc.append(b)
+                    # re-wrap on the populate path too: consumers must never
+                    # hold the object that sits in the cache
+                    b = _CB(b.schema, b.columns, b.num_rows, b.sel)
             yield b
+        if acc is not None:
+            dcache.put(dkey, acc)
 
 
 # ---------------------------------------------------------------------------------
@@ -244,7 +284,7 @@ class StageExec(TpuExec):
                 arrays.append(None if isinstance(c, HostStringColumn)
                               else (c.data, c.valid))
             out_arrays, new_sel = fn(tuple(arrays), b.sel,
-                                     jnp.int32(b.num_rows))
+                                     np.int32(b.num_rows))
             cols: List = []
             for oi, f_ in enumerate(self._schema):
                 val = out_arrays[oi] if oi < len(out_arrays) else None
@@ -375,14 +415,20 @@ class AggregateExec(TpuExec):
         def run_one(b: ColumnBatch):
             arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
                            else None for c in b.columns)
-            return batch_partials(arrays, b.sel, jnp.int32(b.num_rows))
+            return batch_partials(arrays, b.sel, np.int32(b.num_rows))
+
+        # merge runs as ONE jitted program per pair — never eager ops: on
+        # remote-tunneled backends each eager primitive is a full RPC
+        # round-trip (measured ~15ms), dwarfing the actual compute
+        merge_fn = _cached_program(
+            "agg-merge|" + self._fingerprint(),
+            lambda: jax.jit(lambda a, b: self._merge_scalars(a, b, ops)))
 
         acc: Optional[List] = None
         for batch in child.execute(ctx):
             with m.time("opTime"):
                 for partials in with_retry(ctx, batch, run_one):
-                    acc = partials if acc is None else self._merge_scalars(
-                        acc, partials, ops)
+                    acc = partials if acc is None else merge_fn(acc, partials)
         if acc is None:
             acc = self._empty_scalars()
         out = self._finalize_scalars(acc)
@@ -446,29 +492,50 @@ class AggregateExec(TpuExec):
 
     def _finalize_scalars(self, acc) -> ColumnBatch:
         from ..batch import bucket_capacity
-        cols: List[DeviceColumn] = []
-        i = 0
         cap = bucket_capacity(1)
+        mode = self.mode
+        agg_exprs = self.agg_exprs
+
+        def _fin(acc_):
+            """Whole finalize as one traced program (no eager primitives)."""
+            outs = []
+            i = 0
+            for (_name, agg) in agg_exprs:
+                nb = len(agg.buffers())
+                buf_vals = []
+                for (d, v) in acc_[i: i + nb]:
+                    bd = jnp.broadcast_to(d, (cap,))
+                    bv = None if v is None else jnp.broadcast_to(v, (cap,))
+                    buf_vals.append((bd, bv))
+                i += nb
+                if mode == "partial":
+                    outs.extend(buf_vals)
+                else:
+                    data, valid = agg.finalize(buf_vals)
+                    data = jnp.broadcast_to(data, (cap,))
+                    if valid is not None:
+                        valid = jnp.broadcast_to(valid, (cap,))
+                    outs.append((data.astype(agg.dtype.numpy_dtype), valid))
+            return tuple(outs)
+
+        fin = _cached_program(
+            f"agg-fin|{self.mode}|" + self._fingerprint(),
+            lambda: jax.jit(_fin))
+        res = fin(tuple(acc))
+
+        cols: List[DeviceColumn] = []
         fields = []
+        oi = 0
         for (name, agg) in self.agg_exprs:
-            nb = len(agg.buffers())
-            buf_vals = []
-            for (d, v) in acc[i: i + nb]:
-                bd = jnp.broadcast_to(d, (cap,))
-                bv = None if v is None else jnp.broadcast_to(v, (cap,))
-                buf_vals.append((bd, bv))
-            i += nb
             if self.mode == "partial":
-                for bi, ((bd, bv), (dt, _)) in enumerate(
-                        zip(buf_vals, agg.buffers())):
+                for bi, (dt, _) in enumerate(agg.buffers()):
+                    bd, bv = res[oi]
+                    oi += 1
                     fields.append(Field(f"{name}#buf{bi}", dt, True))
                     cols.append(DeviceColumn(dt, bd, bv))
             else:
-                data, valid = agg.finalize(buf_vals)
-                data = jnp.broadcast_to(data, (cap,))
-                if valid is not None:
-                    valid = jnp.broadcast_to(valid, (cap,))
-                data = data.astype(agg.dtype.numpy_dtype)
+                data, valid = res[oi]
+                oi += 1
                 fields.append(Field(name, agg.dtype, agg.nullable))
                 cols.append(DeviceColumn(agg.dtype, data, valid))
         return ColumnBatch(Schema(fields), cols, 1)
@@ -516,7 +583,7 @@ class AggregateExec(TpuExec):
                         (c.data, c.valid) if isinstance(c, DeviceColumn)
                         else None for c in batch.columns)
                     ok, ov, gmask = batch_group(arrays, batch.sel,
-                                                jnp.int32(batch.num_rows))
+                                                np.int32(batch.num_rows))
                     part = batch_utils.compact(
                         self._to_buffer_batch(buffer_schema, ok, ov, gmask))
                 if part.num_rows == 0:
@@ -533,7 +600,7 @@ class AggregateExec(TpuExec):
         def run_one(b: ColumnBatch) -> ColumnBatch:
             arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
                            else None for c in b.columns)
-            ok, ov, gmask = batch_group(arrays, b.sel, jnp.int32(b.num_rows))
+            ok, ov, gmask = batch_group(arrays, b.sel, np.int32(b.num_rows))
             return self._to_buffer_batch(buffer_schema, ok, ov, gmask)
 
         pending: Optional[ColumnBatch] = None
@@ -578,7 +645,7 @@ class AggregateExec(TpuExec):
         both = batch_utils.concat_batches([a, b])
         arrays = tuple((c.data, c.valid) for c in both.columns)
         merge = _merge_fn(tuple(ops), n_keys)
-        ok, ov, gmask = merge(arrays, both.sel, jnp.int32(both.num_rows))
+        ok, ov, gmask = merge(arrays, both.sel, np.int32(both.num_rows))
         merged = self._to_buffer_batch(both.schema, list(ok), list(ov), gmask)
         return batch_utils.compact(merged)
 

@@ -17,6 +17,7 @@ from typing import Iterator, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..batch import ColumnBatch, DeviceColumn, Field, HostStringColumn, Schema
 from ..exprs import EvalContext
@@ -80,10 +81,20 @@ class WindowExec(TpuExec):
         with m.time("opTime"):
             fn = _cached_program("window|" + self._fingerprint(),
                                  lambda: jax.jit(self._build_fn()))
-            arrays = tuple(
-                (c.data, c.valid) if isinstance(c, DeviceColumn) else None
-                for c in whole.columns)
-            perm, outs = fn(arrays, jnp.int32(whole.num_rows))
+
+            def run(b: ColumnBatch):
+                arrays = tuple(
+                    (c.data, c.valid) if isinstance(c, DeviceColumn) else None
+                    for c in b.columns)
+                return b, fn(arrays, np.int32(b.num_rows))
+
+            # retry protocol like sort/agg, but split=None: a window frame
+            # may span any row range, so halving the input would change
+            # results — spill+retry only (GpuWindowExec is likewise
+            # withRetryNoSplit).  run returns the (possibly re-materialized)
+            # batch so gather uses the same buffers the kernel saw.
+            from ..memory.retry import with_retry
+            (whole, (perm, outs)), = with_retry(ctx, whole, run, split=None)
             out = batch_utils.gather(whole, perm, whole.num_rows)
             cols = list(out.columns)
             for (name, we), (d, v) in zip(self.window_exprs, outs):

@@ -169,8 +169,9 @@ class Lag(WindowFunction):
         val = w.sort_value(self.children[0].eval(ectx))
         default = None
         if len(self.children) > 1:
-            default = self.children[1].eval(ectx)
-            default = (default[0], default[1])
+            # sort the default too: output rows are in window-sorted order,
+            # so a column-valued default must be permuted the same way
+            default = w.sort_value(self.children[1].eval(ectx))
         return W.shift(w, val, self.offset_sign * self.offset, default)
 
 

@@ -355,3 +355,58 @@ def test_window_string_partition_falls_back(session):
     exp = [(svals[i], int(pdf["x"][i]), int(exp_rn[i]))
            for i in range(len(pdf))]
     assert_rows_equal(got, exp)
+
+
+def test_lag_with_column_default(wdf):
+    """Column-valued lag default must be permuted into sorted output order
+    (regression: defaults were taken in input row order)."""
+    df, pdf = wdf
+    f, w = F(), W()
+    from spark_rapids_tpu.sql.column import Column as C
+    from spark_rapids_tpu import exprs as E
+    from spark_rapids_tpu.windowfns import Lag, WindowExpression
+    spec = w.partition_by("p").order_by("u")
+    wexpr = C(WindowExpression(
+        Lag(E.UnresolvedColumn("v"), 1, E.UnresolvedColumn("u")),
+        spec._spec))
+    got = df.select("p", "u", "v", wexpr.alias("wout")).collect()
+    sp = pdf.sort_values(["p", "u"]).reset_index()
+    exp_map = {}
+    for p in sp["p"].unique():
+        g = sp[sp["p"] == p]
+        prev_v = None
+        for _, row in g.iterrows():
+            if prev_v is None:
+                exp_map[(row["p"], row["u"])] = row["u"]  # default = u
+            else:
+                exp_map[(row["p"], row["u"])] = prev_v
+            prev_v = row["v"] if not pd.isna(row["v"]) else np.nan
+    for p_, u_, v_, wout in got:
+        exp = exp_map[(p_, u_)]
+        if isinstance(exp, float) and np.isnan(exp):
+            assert wout is None
+        else:
+            assert wout == exp, (p_, u_, wout, exp)
+
+
+def test_window_survives_injected_oom(session):
+    """Window op under injectRetryOOM=1 retries and still yields correct
+    results (GpuWindowExec withRetryNoSplit analog)."""
+    import pyarrow as pa
+    f, w = F(), W()
+    table = pa.table({
+        "p": pa.array([0, 0, 1, 1, 0, 1], type=pa.int64()),
+        "x": pa.array([3, 1, 5, 2, 6, 4], type=pa.int64()),
+    })
+    df = session.create_dataframe(table)
+    session.conf.set("spark.rapids.tpu.test.injectRetryOOM", 1)
+    try:
+        spec = w.partition_by("p").order_by("x")
+        got = df.select("p", "x", f.row_number().over(spec).alias("rn")) \
+                .collect()
+    finally:
+        session.conf.set("spark.rapids.tpu.test.injectRetryOOM", 0)
+    exp = {(0, 1): 1, (0, 3): 2, (0, 6): 3, (1, 2): 1, (1, 4): 2, (1, 5): 3}
+    assert len(got) == 6
+    for p_, x_, rn in got:
+        assert rn == exp[(p_, x_)]
