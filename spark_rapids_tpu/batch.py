@@ -23,6 +23,7 @@ device string kernels land; the planner routes string *compute* accordingly.
 
 from __future__ import annotations
 
+import datetime
 from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -306,10 +307,15 @@ def from_arrow(table, min_capacity: int = 1024, device=None) -> ColumnBatch:
             # with a typed zero so integer casts are well-defined (float NaN
             # payloads at null slots are harmless and stay put).
             if col.null_count > 0 and not dt.is_floating:
-                col_f = col.fill_null(pa.scalar(0).cast(col.type)) if not (
-                    pa.types.is_date(col.type)
-                    or pa.types.is_timestamp(col.type)) else \
-                    col.fill_null(pa.scalar(0, type=pa.int64()).cast(col.type))
+                if pa.types.is_date(col.type):
+                    zero = pa.scalar(datetime.date(1970, 1, 1),
+                                     type=col.type)
+                elif pa.types.is_timestamp(col.type):
+                    zero = pa.scalar(datetime.datetime(1970, 1, 1),
+                                     type=col.type)
+                else:
+                    zero = pa.scalar(0).cast(col.type)
+                col_f = col.fill_null(zero)
             else:
                 col_f = col
             np_col = col_f.to_numpy(zero_copy_only=False)

@@ -213,6 +213,11 @@ def eval_cpu(expr: E.Expression, arrays, n: int) -> Value:
     if handler is not None:
         return handler(expr, ev, n)
 
+    # math/datetime/string expression libraries carry their own CPU twin
+    # (same _eval_impl as the device path, numpy instead of jax.numpy)
+    if hasattr(expr, "eval_host"):
+        return expr.eval_host(ev, n)
+
     raise NotImplementedError(f"cpu eval for {type(expr).__name__}")
 
 

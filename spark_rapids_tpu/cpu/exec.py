@@ -36,9 +36,14 @@ def arrow_to_values(table, schema: Schema):
         import pyarrow as pa
         valid = np.asarray(arr.is_valid()) if arr.null_count else None
         if arr.null_count and not f.dtype.is_floating and not f.dtype.is_decimal:
-            arr = arr.fill_null(pa.scalar(0, type=pa.int64()).cast(arr.type)) \
-                if (pa.types.is_date(arr.type) or pa.types.is_timestamp(arr.type)) \
-                else arr.fill_null(pa.scalar(0).cast(arr.type))
+            import datetime as _dtm
+            if pa.types.is_date(arr.type):
+                zero = pa.scalar(_dtm.date(1970, 1, 1), type=arr.type)
+            elif pa.types.is_timestamp(arr.type):
+                zero = pa.scalar(_dtm.datetime(1970, 1, 1), type=arr.type)
+            else:
+                zero = pa.scalar(0).cast(arr.type)
+            arr = arr.fill_null(zero)
         np_arr = arr.to_numpy(zero_copy_only=False)
         if f.dtype.kind == T.TypeKind.DATE:
             np_arr = np_arr.astype("datetime64[D]").astype(np.int32)

@@ -1,0 +1,419 @@
+"""Math expression library.
+
+TPU-native analog of the reference's ``mathExpressions.scala`` (each GPU
+class dispatches one cudf unary kernel): here every function is traced with
+``jnp`` inside the fused stage program, so chained math collapses into one
+XLA computation.  Each class also carries its CPU twin (``eval_host``, used
+by the fallback operator) sharing the same ``_eval_impl`` — numpy and
+jax.numpy expose the same ufunc surface, so semantics cannot drift between
+the device path and the oracle path.
+
+Spark semantics notes (verified against Spark 3.4 behavior):
+  * sqrt(negative) = NaN (not null); log/log10/log2/log1p of a value outside
+    the domain = NULL (nullExpressions-style), matching GpuLog's
+    ``cudf.log`` + null post-mask.
+  * floor/ceil of double return LongType.
+  * round = HALF_UP, bround = HALF_EVEN (GpuBRound/GpuRound,
+    mathExpressions.scala).
+  * greatest/least skip nulls; NaN counts as the largest double.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .exprs import (Expression, Literal, Value, _and_valid, _round_div,
+                    promote_physical)
+
+__all__ = [
+    "Sqrt", "Cbrt", "Exp", "Expm1", "Log", "Log10", "Log2", "Log1p",
+    "Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh", "Tanh",
+    "ToDegrees", "ToRadians", "Signum", "Floor", "Ceil", "Round", "BRound",
+    "Pow", "Atan2", "Hypot", "Greatest", "Least",
+]
+
+
+def _to_f64_host(d: np.ndarray, src: T.DataType) -> np.ndarray:
+    if src.is_decimal:
+        return d.astype(np.float64) / 10.0 ** src.scale
+    return d.astype(np.float64)
+
+
+class UnaryMathExpression(Expression):
+    """f(child) evaluated in double, double out (GpuUnaryMathExpression)."""
+
+    func: str = None  # ufunc name shared by numpy / jax.numpy
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        if child.resolved():
+            self._rebind()
+
+    def _rebind(self):
+        self.dtype = T.FLOAT64
+        self.nullable = self.children[0].nullable or self._adds_nulls()
+
+    def _adds_nulls(self) -> bool:
+        return False
+
+    def _eval_impl(self, xp, d, v) -> Value:
+        return getattr(xp, self.func)(d), v
+
+    def eval(self, ctx) -> Value:
+        d, v = self.children[0].eval(ctx)
+        d = promote_physical(d, self.children[0].dtype, T.FLOAT64)
+        return self._eval_impl(jnp, d, v)
+
+    def eval_host(self, ev, n) -> Value:
+        d, v = ev(self.children[0])
+        with np.errstate(all="ignore"):
+            return self._eval_impl(np, _to_f64_host(d, self.children[0].dtype), v)
+
+
+class Sqrt(UnaryMathExpression):
+    func = "sqrt"  # sqrt(-x) = NaN, matching Spark
+
+
+class Cbrt(UnaryMathExpression):
+    func = "cbrt"
+
+
+class Exp(UnaryMathExpression):
+    func = "exp"
+
+
+class Expm1(UnaryMathExpression):
+    func = "expm1"
+
+
+class _DomainLog(UnaryMathExpression):
+    """Logarithms: out-of-domain input produces NULL (Spark Logarithm)."""
+
+    lower = 0.0  # domain is (lower, inf)
+
+    def _adds_nulls(self):
+        return True
+
+    def _eval_impl(self, xp, d, v):
+        ok = d > self.lower
+        safe = xp.where(ok, d, 1.0)
+        return getattr(xp, self.func)(safe), _and_valid(v, ok)
+
+
+class Log(_DomainLog):
+    func = "log"
+
+
+class Log10(_DomainLog):
+    func = "log10"
+
+
+class Log2(_DomainLog):
+    func = "log2"
+
+
+class Log1p(_DomainLog):
+    func = "log1p"
+    lower = -1.0
+
+    def _eval_impl(self, xp, d, v):
+        ok = d > self.lower
+        safe = xp.where(ok, d, 0.0)
+        return xp.log1p(safe), _and_valid(v, ok)
+
+
+class Sin(UnaryMathExpression):
+    func = "sin"
+
+
+class Cos(UnaryMathExpression):
+    func = "cos"
+
+
+class Tan(UnaryMathExpression):
+    func = "tan"
+
+
+class Asin(UnaryMathExpression):
+    func = "arcsin"
+
+
+class Acos(UnaryMathExpression):
+    func = "arccos"
+
+
+class Atan(UnaryMathExpression):
+    func = "arctan"
+
+
+class Sinh(UnaryMathExpression):
+    func = "sinh"
+
+
+class Cosh(UnaryMathExpression):
+    func = "cosh"
+
+
+class Tanh(UnaryMathExpression):
+    func = "tanh"
+
+
+class ToDegrees(UnaryMathExpression):
+    func = "degrees"
+
+
+class ToRadians(UnaryMathExpression):
+    func = "radians"
+
+
+class Signum(UnaryMathExpression):
+    func = "sign"
+
+
+class _FloorCeil(Expression):
+    """floor/ceil: double → LONG; integral passes through (GpuFloor/GpuCeil);
+    decimal(p, s) → decimal(p - s + 1, 0)."""
+
+    func: str = None
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        if child.resolved():
+            self._rebind()
+
+    def _rebind(self):
+        src = self.children[0].dtype
+        if src.is_decimal:
+            self.dtype = T.decimal(min(src.precision - src.scale + 1, 18), 0)
+        elif src.is_integral:
+            self.dtype = src
+        else:
+            self.dtype = T.INT64
+        self.nullable = self.children[0].nullable
+
+    def _eval_impl(self, xp, d, src: T.DataType):
+        if src.is_integral:
+            return d
+        if src.is_decimal:
+            scaled = 10 ** src.scale
+            if self.func == "floor":
+                return xp.floor_divide(d, scaled)
+            return -xp.floor_divide(-d, scaled)
+        return getattr(xp, self.func)(d).astype(xp.int64)
+
+    def eval(self, ctx) -> Value:
+        d, v = self.children[0].eval(ctx)
+        return self._eval_impl(jnp, d, self.children[0].dtype), v
+
+    def eval_host(self, ev, n) -> Value:
+        d, v = ev(self.children[0])
+        return self._eval_impl(np, d, self.children[0].dtype), v
+
+
+class Floor(_FloorCeil):
+    func = "floor"
+
+
+class Ceil(_FloorCeil):
+    func = "ceil"
+
+
+class _RoundBase(Expression):
+    """round(x, s): HALF_UP (Round) or HALF_EVEN (BRound).
+
+    double → double; integral with s<0 rounds to multiples of 10^-s;
+    decimal rescales exactly on the scaled-int representation.
+    """
+
+    half_even = False
+
+    def __init__(self, child: Expression, scale: int = 0):
+        self.scale_arg = int(scale)
+        self.children = (child,)
+        if child.resolved():
+            self._rebind()
+
+    def _rebind(self):
+        src = self.children[0].dtype
+        if src.is_decimal:
+            s2 = max(min(self.scale_arg, src.scale), 0)
+            ip = src.precision - src.scale
+            self.dtype = T.decimal(min(ip + s2 + 1, 18), s2)
+        else:
+            self.dtype = src if src.is_integral else T.FLOAT64
+        self.nullable = self.children[0].nullable
+
+    def _fp_extra(self):
+        return f"s={self.scale_arg}:{self.dtype}"
+
+    def _eval_impl(self, xp, d, src: T.DataType):
+        s = self.scale_arg
+        if src.is_decimal:
+            s2 = self.dtype.scale
+            if s2 >= src.scale:
+                return d * np.int64(10 ** (s2 - src.scale))
+            if self.half_even:
+                m = 10 ** (src.scale - s2)
+                q = xp.floor_divide(d, m)
+                r = d - q * m
+                half = m // 2
+                round_up = (r > half) | ((r == half) & (q % 2 != 0))
+                return q + round_up.astype(q.dtype)
+            return _round_div(d, 10 ** (src.scale - s2))
+        if src.is_integral:
+            if s >= 0:
+                return d
+            m = np.int64(10 ** (-s))
+            if self.half_even:
+                q = xp.floor_divide(d, m)
+                r = d - q * m
+                half = m // 2
+                round_up = (r > half) | ((r == half) & (q % 2 != 0))
+                return (q + round_up.astype(q.dtype)) * m
+            sign = xp.where(d >= 0, 1, -1)
+            return sign * ((xp.abs(d) + m // 2) // m) * m
+        m = 10.0 ** s
+        y = d * m
+        if self.half_even:
+            return xp.round(y) / m  # numpy/jnp round = banker's rounding
+        out = xp.where(y >= 0, xp.floor(y + 0.5), xp.ceil(y - 0.5)) / m
+        return xp.where(xp.isfinite(y), out, d)
+
+    def eval(self, ctx) -> Value:
+        d, v = self.children[0].eval(ctx)
+        return self._eval_impl(jnp, d, self.children[0].dtype), v
+
+    def eval_host(self, ev, n) -> Value:
+        d, v = ev(self.children[0])
+        with np.errstate(all="ignore"):
+            return self._eval_impl(np, d, self.children[0].dtype), v
+
+
+class Round(_RoundBase):
+    half_even = False
+
+
+class BRound(_RoundBase):
+    half_even = True
+
+
+class _BinaryMath(Expression):
+    """f(left, right) in double (GpuPow/GpuAtan2/GpuHypot)."""
+
+    func: str = None
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+        if left.resolved() and right.resolved():
+            self._rebind()
+
+    def _rebind(self):
+        self.dtype = T.FLOAT64
+        self.nullable = any(c.nullable for c in self.children)
+
+    def _eval_impl(self, xp, ld, rd):
+        return getattr(xp, self.func)(ld, rd)
+
+    def _eval_common(self, xp, pairs) -> Value:
+        (ld, lv), (rd, rv) = pairs
+        return self._eval_impl(xp, ld, rd), _and_valid(lv, rv)
+
+    def eval(self, ctx) -> Value:
+        vals = []
+        for c in self.children:
+            d, v = c.eval(ctx)
+            vals.append((promote_physical(d, c.dtype, T.FLOAT64), v))
+        return self._eval_common(jnp, vals)
+
+    def eval_host(self, ev, n) -> Value:
+        vals = []
+        for c in self.children:
+            d, v = ev(c)
+            vals.append((_to_f64_host(d, c.dtype), v))
+        with np.errstate(all="ignore"):
+            return self._eval_common(np, vals)
+
+
+class Pow(_BinaryMath):
+    func = "power"
+
+
+class Atan2(_BinaryMath):
+    func = "arctan2"
+
+
+class Hypot(_BinaryMath):
+    func = "hypot"
+
+
+class _GreatestLeast(Expression):
+    """N-ary greatest/least: nulls are skipped; NaN is the largest double
+    (GpuGreatest/GpuLeast over cudf columnar max/min with null excluded)."""
+
+    greatest = True
+
+    def __init__(self, *children: Expression):
+        assert len(children) >= 2, "greatest/least need at least 2 args"
+        self.children = tuple(children)
+        if all(c.resolved() for c in children):
+            self._rebind()
+
+    def _rebind(self):
+        dt = self.children[0].dtype
+        for c in self.children[1:]:
+            dt = T.common_type(dt, c.dtype)
+        self.dtype = dt
+        self.nullable = all(c.nullable for c in self.children)
+
+    def _pick(self, xp, ad, bd):
+        is_f = ad.dtype.kind == "f"
+        if self.greatest:
+            best = xp.maximum(ad, bd)
+            if is_f:  # NaN wins for greatest
+                best = xp.where(xp.isnan(ad) | xp.isnan(bd), xp.nan, best)
+            return best
+        best = xp.minimum(ad, bd)
+        if is_f:  # NaN loses for least (unless the other is NaN too)
+            best = xp.where(xp.isnan(ad), bd, xp.where(xp.isnan(bd), ad, best))
+        return best
+
+    def _combine(self, xp, vals) -> Value:
+        od, ov = vals[0]
+        if ov is None:
+            ov = xp.ones(od.shape[0], dtype=bool)
+        for (d, v) in vals[1:]:
+            if v is None:
+                v = xp.ones(d.shape[0], dtype=bool)
+            both = ov & v
+            picked = self._pick(xp, od, d)
+            od = xp.where(both, picked, xp.where(ov, od, d))
+            ov = ov | v
+        return od, (None if not self.nullable else ov)
+
+    def eval(self, ctx) -> Value:
+        vals = []
+        for c in self.children:
+            d, v = c.eval(ctx)
+            vals.append((promote_physical(d, c.dtype, self.dtype), v))
+        return self._combine(jnp, vals)
+
+    def eval_host(self, ev, n) -> Value:
+        from .cpu.eval import _promote_cpu
+        vals = []
+        for c in self.children:
+            d, v = ev(c)
+            vals.append((_promote_cpu(d, c.dtype, self.dtype), v))
+        return self._combine(np, vals)
+
+
+class Greatest(_GreatestLeast):
+    greatest = True
+
+
+class Least(_GreatestLeast):
+    greatest = False

@@ -121,6 +121,31 @@ class Column:
     def between(self, low, high):
         return (self >= low) & (self <= high)
 
+    # -- string predicates/helpers (pyspark Column API) ---------------------------
+    def startswith(self, prefix) -> "Column":
+        from ..stringfns import StartsWith
+        return Column(StartsWith(self.expr, to_expr(prefix)))
+
+    def endswith(self, suffix) -> "Column":
+        from ..stringfns import EndsWith
+        return Column(EndsWith(self.expr, to_expr(suffix)))
+
+    def contains(self, needle) -> "Column":
+        from ..stringfns import Contains
+        return Column(Contains(self.expr, to_expr(needle)))
+
+    def like(self, pattern: str) -> "Column":
+        from ..stringfns import Like
+        return Column(Like(self.expr, pattern))
+
+    def rlike(self, pattern: str) -> "Column":
+        from ..stringfns import RLike
+        return Column(RLike(self.expr, pattern))
+
+    def substr(self, pos, length) -> "Column":
+        from ..stringfns import Substring
+        return Column(Substring(self.expr, to_expr(pos), to_expr(length)))
+
     def when(self, *args):
         raise TypeError("use functions.when(cond, value) to build CASE WHEN")
 

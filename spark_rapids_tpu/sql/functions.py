@@ -14,6 +14,21 @@ __all__ = [
     "sum", "count", "count_star", "min", "max", "avg", "mean", "first", "last",
     "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
     "lag", "lead", "parse_type",
+    # math
+    "sqrt", "cbrt", "exp", "expm1", "log", "log10", "log2", "log1p",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "degrees", "radians", "signum", "floor", "ceil", "round", "bround",
+    "pow", "atan2", "hypot", "greatest", "least",
+    # datetime
+    "year", "month", "dayofmonth", "quarter", "dayofweek", "weekday",
+    "dayofyear", "weekofyear", "last_day", "date_add", "date_sub",
+    "datediff", "add_months", "months_between", "trunc",
+    # string
+    "length", "upper", "lower", "reverse", "initcap", "trim", "ltrim",
+    "rtrim", "substring", "concat", "concat_ws", "startswith", "endswith",
+    "contains", "like", "rlike", "regexp_extract", "regexp_replace",
+    "replace", "lpad", "rpad", "repeat", "locate", "instr",
+    "substring_index",
 ]
 
 def col(name: str) -> Column:
@@ -170,3 +185,321 @@ def parse_type(s: str) -> T.DataType:
         p, sc = (int(x) for x in inner.split(","))
         return T.decimal(p, sc)
     raise ValueError(f"unknown type name {s!r}")
+
+
+# ------------------------------------------------------------------------------------
+# Math functions (mathExpressions.scala analogs)
+# ------------------------------------------------------------------------------------
+
+def _mathmod():
+    from .. import mathfns as M
+    return M
+
+
+def sqrt(c):
+    return Column(_mathmod().Sqrt(_colref(c)))
+
+
+def cbrt(c):
+    return Column(_mathmod().Cbrt(_colref(c)))
+
+
+def exp(c):
+    return Column(_mathmod().Exp(_colref(c)))
+
+
+def expm1(c):
+    return Column(_mathmod().Expm1(_colref(c)))
+
+
+def log(c):
+    return Column(_mathmod().Log(_colref(c)))
+
+
+def log10(c):
+    return Column(_mathmod().Log10(_colref(c)))
+
+
+def log2(c):
+    return Column(_mathmod().Log2(_colref(c)))
+
+
+def log1p(c):
+    return Column(_mathmod().Log1p(_colref(c)))
+
+
+def sin(c):
+    return Column(_mathmod().Sin(_colref(c)))
+
+
+def cos(c):
+    return Column(_mathmod().Cos(_colref(c)))
+
+
+def tan(c):
+    return Column(_mathmod().Tan(_colref(c)))
+
+
+def asin(c):
+    return Column(_mathmod().Asin(_colref(c)))
+
+
+def acos(c):
+    return Column(_mathmod().Acos(_colref(c)))
+
+
+def atan(c):
+    return Column(_mathmod().Atan(_colref(c)))
+
+
+def sinh(c):
+    return Column(_mathmod().Sinh(_colref(c)))
+
+
+def cosh(c):
+    return Column(_mathmod().Cosh(_colref(c)))
+
+
+def tanh(c):
+    return Column(_mathmod().Tanh(_colref(c)))
+
+
+def degrees(c):
+    return Column(_mathmod().ToDegrees(_colref(c)))
+
+
+def radians(c):
+    return Column(_mathmod().ToRadians(_colref(c)))
+
+
+def signum(c):
+    return Column(_mathmod().Signum(_colref(c)))
+
+
+def floor(c):
+    return Column(_mathmod().Floor(_colref(c)))
+
+
+def ceil(c):
+    return Column(_mathmod().Ceil(_colref(c)))
+
+
+def round(c, scale: int = 0):  # noqa: A001
+    return Column(_mathmod().Round(_colref(c), scale))
+
+
+def bround(c, scale: int = 0):
+    return Column(_mathmod().BRound(_colref(c), scale))
+
+
+def pow(l, r):  # noqa: A001
+    return Column(_mathmod().Pow(_colref(l), _colref(r)))
+
+
+def atan2(l, r):
+    return Column(_mathmod().Atan2(_colref(l), _colref(r)))
+
+
+def hypot(l, r):
+    return Column(_mathmod().Hypot(_colref(l), _colref(r)))
+
+
+def greatest(*cols):
+    return Column(_mathmod().Greatest(*[_colref(c) for c in cols]))
+
+
+def least(*cols):
+    return Column(_mathmod().Least(*[_colref(c) for c in cols]))
+
+
+# ------------------------------------------------------------------------------------
+# Datetime functions (datetimeExpressions.scala analogs)
+# ------------------------------------------------------------------------------------
+
+def _dtmod():
+    from .. import datetimefns as D
+    return D
+
+
+def year(c):
+    return Column(_dtmod().Year(_colref(c)))
+
+
+def month(c):
+    return Column(_dtmod().Month(_colref(c)))
+
+
+def dayofmonth(c):
+    return Column(_dtmod().DayOfMonth(_colref(c)))
+
+
+def quarter(c):
+    return Column(_dtmod().Quarter(_colref(c)))
+
+
+def dayofweek(c):
+    return Column(_dtmod().DayOfWeek(_colref(c)))
+
+
+def weekday(c):
+    return Column(_dtmod().WeekDay(_colref(c)))
+
+
+def dayofyear(c):
+    return Column(_dtmod().DayOfYear(_colref(c)))
+
+
+def weekofyear(c):
+    return Column(_dtmod().WeekOfYear(_colref(c)))
+
+
+def last_day(c):
+    return Column(_dtmod().LastDay(_colref(c)))
+
+
+def date_add(c, days):
+    return Column(_dtmod().DateAdd(_colref(c), _colref(days)))
+
+
+def date_sub(c, days):
+    return Column(_dtmod().DateSub(_colref(c), _colref(days)))
+
+
+def datediff(end, start):
+    return Column(_dtmod().DateDiff(_colref(end), _colref(start)))
+
+
+def add_months(c, months):
+    return Column(_dtmod().AddMonths(_colref(c), _colref(months)))
+
+
+def months_between(end, start):
+    return Column(_dtmod().MonthsBetween(_colref(end), _colref(start)))
+
+
+def trunc(c, fmt: str):
+    return Column(_dtmod().TruncDate(_colref(c), fmt))
+
+
+# ------------------------------------------------------------------------------------
+# String functions (stringFunctions.scala analogs; CPU-evaluated — see
+# stringfns.py module docstring)
+# ------------------------------------------------------------------------------------
+
+def _strmod():
+    from .. import stringfns as S
+    return S
+
+
+def _val(v) -> E.Expression:
+    """Literal coercion for args that are plain VALUES in the pyspark
+    signature (lpad/rpad pad, locate substr, substring_index delim/count,
+    like patterns) — unlike ColumnOrName args, a str here is data."""
+    return to_expr(v)
+
+
+def length(c):
+    return Column(_strmod().Length(_colref(c)))
+
+
+def upper(c):
+    return Column(_strmod().Upper(_colref(c)))
+
+
+def lower(c):
+    return Column(_strmod().Lower(_colref(c)))
+
+
+def reverse(c):
+    return Column(_strmod().Reverse(_colref(c)))
+
+
+def initcap(c):
+    return Column(_strmod().InitCap(_colref(c)))
+
+
+def trim(c):
+    return Column(_strmod().StringTrim(_colref(c)))
+
+
+def ltrim(c):
+    return Column(_strmod().StringTrimLeft(_colref(c)))
+
+
+def rtrim(c):
+    return Column(_strmod().StringTrimRight(_colref(c)))
+
+
+def substring(c, pos, length):  # noqa: A002
+    return Column(_strmod().Substring(
+        _colref(c), _colref(pos), _colref(length)))
+
+
+def concat(*cols):
+    return Column(_strmod().Concat(*[_colref(c) for c in cols]))
+
+
+def concat_ws(sep: str, *cols):
+    return Column(_strmod().ConcatWs(sep, *[_colref(c) for c in cols]))
+
+
+def startswith(c, prefix):
+    return Column(_strmod().StartsWith(_colref(c), _colref(prefix)))
+
+
+def endswith(c, suffix):
+    return Column(_strmod().EndsWith(_colref(c), _colref(suffix)))
+
+
+def contains(c, needle):
+    return Column(_strmod().Contains(_colref(c), _colref(needle)))
+
+
+def like(c, pattern: str, escape: str = "\\"):
+    return Column(_strmod().Like(_colref(c), pattern, escape))
+
+
+def rlike(c, pattern: str):
+    return Column(_strmod().RLike(_colref(c), pattern))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1):
+    return Column(_strmod().RegExpExtract(_colref(c), pattern, idx))
+
+
+def regexp_replace(c, pattern: str, replacement: str):
+    return Column(_strmod().RegExpReplace(_colref(c), pattern, replacement))
+
+
+def replace(c, search, replacement):
+    return Column(_strmod().StringReplace(
+        _colref(c), _colref(search), _colref(replacement)))
+
+
+def lpad(c, length, pad):  # noqa: A002
+    return Column(_strmod().StringLpad(
+        _colref(c), _colref(length), _val(pad)))
+
+
+def rpad(c, length, pad):  # noqa: A002
+    return Column(_strmod().StringRpad(
+        _colref(c), _colref(length), _val(pad)))
+
+
+def repeat(c, n):
+    return Column(_strmod().StringRepeat(_colref(c), _colref(n)))
+
+
+def locate(substr, c, pos=1):
+    return Column(_strmod().StringLocate(
+        _val(substr), _colref(c), _val(pos)))
+
+
+def instr(c, substr):
+    return Column(_strmod().StringLocate(
+        _val(substr), _colref(c), _val(1)))
+
+
+def substring_index(c, delim, count):
+    return Column(_strmod().SubstringIndex(
+        _colref(c), _val(delim), _val(count)))

@@ -1,0 +1,347 @@
+"""String expression library.
+
+Analog of the reference's ``stringFunctions.scala``.  Strings live host-side
+(``HostStringColumn`` — batch.py); the planner's type walk routes any
+string-consuming expression to the CPU operator (plan/overrides.py
+``expr_reasons``), so these classes implement ``eval_host`` only.  Device
+execution of string *predicates* goes through dictionary codes
+(ops/strings.py); full device string kernels (Arrow offsets+bytes int
+tensors, SURVEY §7.3) can adopt these classes later by adding ``eval``.
+
+Null semantics: results are NULL when any input is NULL (Spark), except
+``concat_ws`` which skips NULLs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import types as T
+from .exprs import Expression, Literal, Value
+
+__all__ = [
+    "Length", "Upper", "Lower", "Reverse", "InitCap", "StringTrim",
+    "StringTrimLeft", "StringTrimRight", "Substring", "Concat", "ConcatWs",
+    "StartsWith", "EndsWith", "Contains", "Like", "RLike", "StringReplace",
+    "StringLpad", "StringRpad", "StringRepeat", "StringLocate",
+    "SubstringIndex", "RegExpExtract", "RegExpReplace",
+]
+
+
+def _obj(n: int) -> np.ndarray:
+    return np.empty(n, dtype=object)
+
+
+def _valid_of(d: np.ndarray, v: Optional[np.ndarray], n: int) -> np.ndarray:
+    """Effective validity of a string operand (object arrays may carry None
+    sentinels with v=None)."""
+    base = np.ones(n, dtype=bool) if v is None else v.copy()
+    if d.dtype == object:
+        base &= np.array([x is not None for x in d], dtype=bool)
+    return base
+
+
+class StringExpression(Expression):
+    """Base: host-only evaluation (device string kernels pending)."""
+
+    out_type: T.DataType = T.STRING
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+        if all(c.resolved() for c in children):
+            self._rebind()
+
+    def _rebind(self):
+        self.dtype = self.out_type
+        self.nullable = any(c.nullable for c in self.children) or \
+            self._adds_nulls()
+
+    def _adds_nulls(self) -> bool:
+        return False
+
+    def eval(self, ctx):
+        raise NotImplementedError(
+            f"{type(self).__name__} runs on the CPU fallback path")
+
+    # subclasses implement _apply over python values (None already filtered)
+    def _apply(self, *vals):
+        raise NotImplementedError
+
+    def eval_host(self, ev, n) -> Value:
+        evald = [ev(c) for c in self.children]
+        valid = np.ones(n, dtype=bool)
+        for (d, v), c in zip(evald, self.children):
+            if c.dtype.is_string:
+                valid &= _valid_of(d, v, n)
+            elif v is not None:
+                valid &= v
+        out_str = self.dtype.is_string
+        out = _obj(n) if out_str else np.zeros(
+            n, dtype=self.dtype.numpy_dtype)
+        for i in range(n):
+            if not valid[i]:
+                if out_str:
+                    out[i] = None
+                continue
+            r = self._apply(*[d[i] for d, _ in evald])
+            if r is None:
+                valid[i] = False
+                if out_str:
+                    out[i] = None
+            else:
+                out[i] = r
+        return out, (None if valid.all() else valid)
+
+
+class Length(StringExpression):
+    out_type = T.INT32
+
+    def _apply(self, s):
+        return len(s)
+
+
+class Upper(StringExpression):
+    def _apply(self, s):
+        return s.upper()
+
+
+class Lower(StringExpression):
+    def _apply(self, s):
+        return s.lower()
+
+
+class Reverse(StringExpression):
+    def _apply(self, s):
+        return s[::-1]
+
+
+class InitCap(StringExpression):
+    def _apply(self, s):
+        return " ".join(w[:1].upper() + w[1:].lower() if w else w
+                        for w in s.split(" "))
+
+
+class StringTrim(StringExpression):
+    def _apply(self, s):
+        return s.strip()
+
+
+class StringTrimLeft(StringExpression):
+    def _apply(self, s):
+        return s.lstrip()
+
+
+class StringTrimRight(StringExpression):
+    def _apply(self, s):
+        return s.rstrip()
+
+
+class Substring(StringExpression):
+    """substring(str, pos, len): 1-based; pos<=0 counts from the end
+    (pos=0 behaves as pos=1); negative len → empty."""
+
+    def _apply(self, s, pos, ln):
+        pos, ln = int(pos), int(ln)
+        if ln <= 0:
+            return ""
+        if pos > 0:
+            start = pos - 1
+        elif pos == 0:
+            start = 0
+        else:
+            start = max(len(s) + pos, 0)
+        return s[start: start + ln]
+
+
+class Concat(StringExpression):
+    def _apply(self, *vals):
+        return "".join(vals)
+
+
+class ConcatWs(StringExpression):
+    """concat_ws(sep, ...): NULL args are skipped, result never NULL when
+    sep is non-null."""
+
+    def __init__(self, sep: str, *children: Expression):
+        self.sep = str(sep)
+        super().__init__(*children)
+
+    def _rebind(self):
+        self.dtype = T.STRING
+        self.nullable = False
+
+    def _fp_extra(self):
+        return f"sep={self.sep!r}:{self.dtype}"
+
+    def eval_host(self, ev, n) -> Value:
+        evald = []
+        for c in self.children:
+            d, v = ev(c)
+            evald.append((d, _valid_of(d, v, n)))
+        out = _obj(n)
+        for i in range(n):
+            out[i] = self.sep.join(d[i] for d, v in evald if v[i])
+        return out, None
+
+
+class _StringPredicate(StringExpression):
+    out_type = T.BOOLEAN
+
+
+class StartsWith(_StringPredicate):
+    def _apply(self, s, p):
+        return s.startswith(p)
+
+
+class EndsWith(_StringPredicate):
+    def _apply(self, s, p):
+        return s.endswith(p)
+
+
+class Contains(_StringPredicate):
+    def _apply(self, s, p):
+        return p in s
+
+
+def like_pattern_to_regex(pattern: str, escape: str = "\\") -> str:
+    """SQL LIKE → anchored python regex (RegexParser.scala's job for cudf;
+    trivial here because LIKE has only %, _ and the escape char)."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+class Like(_StringPredicate):
+    def __init__(self, child: Expression, pattern: str, escape: str = "\\"):
+        self.pattern = str(pattern)
+        self.escape = escape
+        self._re = re.compile(like_pattern_to_regex(self.pattern, escape),
+                              re.DOTALL)
+        super().__init__(child)
+
+    def _fp_extra(self):
+        return f"like={self.pattern!r}:{self.dtype}"
+
+    def _apply(self, s):
+        return self._re.match(s) is not None
+
+
+class RLike(_StringPredicate):
+    def __init__(self, child: Expression, pattern: str):
+        self.pattern = str(pattern)
+        self._re = re.compile(self.pattern)
+        super().__init__(child)
+
+    def _fp_extra(self):
+        return f"rlike={self.pattern!r}:{self.dtype}"
+
+    def _apply(self, s):
+        return self._re.search(s) is not None
+
+
+class StringReplace(StringExpression):
+    def _apply(self, s, search, replace):
+        if search == "":
+            return s
+        return s.replace(search, replace)
+
+
+class StringLpad(StringExpression):
+    def _apply(self, s, ln, pad):
+        ln = int(ln)
+        if ln <= len(s):
+            return s[:ln]
+        if not pad:
+            return s
+        fill = (pad * ((ln - len(s)) // len(pad) + 1))[: ln - len(s)]
+        return fill + s
+
+
+class StringRpad(StringExpression):
+    def _apply(self, s, ln, pad):
+        ln = int(ln)
+        if ln <= len(s):
+            return s[:ln]
+        if not pad:
+            return s
+        fill = (pad * ((ln - len(s)) // len(pad) + 1))[: ln - len(s)]
+        return s + fill
+
+
+class StringRepeat(StringExpression):
+    def _apply(self, s, times):
+        return s * max(int(times), 0)
+
+
+class StringLocate(StringExpression):
+    """locate(substr, str, start): 1-based; 0 when not found; start<=0 → 0."""
+
+    out_type = T.INT32
+
+    def _apply(self, sub, s, start):
+        start = int(start)
+        if start <= 0:
+            return 0
+        idx = s.find(sub, start - 1)
+        return idx + 1
+
+
+class SubstringIndex(StringExpression):
+    def _apply(self, s, delim, count):
+        count = int(count)
+        if count == 0 or not delim:
+            return ""
+        parts = s.split(delim)
+        if count > 0:
+            return delim.join(parts[:count])
+        return delim.join(parts[count:])
+
+
+class RegExpExtract(StringExpression):
+    def __init__(self, child: Expression, pattern: str, idx: int = 1):
+        self.pattern = str(pattern)
+        self.idx = int(idx)
+        self._re = re.compile(self.pattern)
+        super().__init__(child)
+
+    def _fp_extra(self):
+        return f"re={self.pattern!r},{self.idx}:{self.dtype}"
+
+    def _apply(self, s):
+        m = self._re.search(s)
+        if m is None:
+            return ""
+        g = m.group(self.idx)
+        return g if g is not None else ""
+
+
+class RegExpReplace(StringExpression):
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        self.pattern = str(pattern)
+        self.replacement = str(replacement)
+        self._re = re.compile(self.pattern)
+        super().__init__(child)
+
+    def _fp_extra(self):
+        return f"re={self.pattern!r}->{self.replacement!r}:{self.dtype}"
+
+    def _apply(self, s):
+        # Spark uses Java regex $1 group refs; python re uses \1
+        repl = re.sub(r"\$(\d+)", r"\\\1", self.replacement)
+        return self._re.sub(repl, s)

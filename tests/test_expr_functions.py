@@ -1,0 +1,390 @@
+"""Math / datetime / string expression differential tests.
+
+Model: the reference's arithmetic_ops_test.py / date_time_test.py /
+string_test.py integration suites — engine results vs a pandas/python
+oracle over seeded generated data, nulls included.
+"""
+
+import datetime
+import math
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from .support import (DateGen, DoubleGen, IntGen, StringGen, assert_rows_equal,
+                      gen_table, pdf_rows)
+
+
+def F():
+    from spark_rapids_tpu.sql import functions
+    return functions
+
+
+@pytest.fixture(scope="module")
+def mdf(session, rng):
+    table, pdf = gen_table(rng, {
+        "d": DoubleGen(special=False, nullable=False),
+        "dn": DoubleGen(special=True, nullable=False),
+        "small": DoubleGen(special=False, nullable=False),
+        "i": IntGen(lo=-1000, hi=1000, dtype="int64"),
+        "pos": DoubleGen(special=False, nullable=False),
+    }, 200)
+    pdf = pdf.copy()
+    pdf["small"] = pdf["small"] / 1e6          # keep exp/trig in range
+    pdf["pos"] = np.abs(pdf["pos"]) + 0.1      # strictly positive
+    import pyarrow as pa
+    table = pa.table({
+        "d": pdf["d"], "dn": pdf["dn"], "small": pdf["small"],
+        "i": pa.array([None if v is pd.NA else int(v) for v in pdf["i"]],
+                      type=pa.int64()),
+        "pos": pdf["pos"],
+    })
+    return session.create_dataframe(table), pdf
+
+
+def _check_unary(df, pdf, col_fn, oracle_vals, approx=True):
+    got = df.select(col_fn.alias("r")).collect()
+    exp = [(v,) for v in oracle_vals]
+    assert_rows_equal(got, exp, approx_float=approx, ignore_order=False)
+
+
+class TestMath:
+    def test_sqrt_neg_is_nan(self, mdf):
+        df, pdf = mdf
+        f = F()
+        _check_unary(df, pdf, f.sqrt(f.col("d")),
+                     [math.sqrt(v) if v >= 0 else float("nan")
+                      for v in pdf["d"]])
+
+    def test_log_nonpositive_is_null(self, mdf):
+        df, pdf = mdf
+        f = F()
+        _check_unary(df, pdf, f.log(f.col("d")),
+                     [math.log(v) if v > 0 else None for v in pdf["d"]])
+        _check_unary(df, pdf, f.log10(f.col("pos")),
+                     [math.log10(v) for v in pdf["pos"]])
+
+    def test_exp_trig(self, mdf):
+        df, pdf = mdf
+        f = F()
+        _check_unary(df, pdf, f.exp(f.col("small")),
+                     [math.exp(v) for v in pdf["small"]])
+        _check_unary(df, pdf, f.sin(f.col("small")),
+                     [math.sin(v) for v in pdf["small"]])
+        _check_unary(df, pdf, f.atan(f.col("d")),
+                     [math.atan(v) for v in pdf["d"]])
+
+    def test_floor_ceil_long_result(self, mdf):
+        df, pdf = mdf
+        f = F()
+        _check_unary(df, pdf, f.floor(f.col("d")),
+                     [int(math.floor(v)) for v in pdf["d"]], approx=False)
+        _check_unary(df, pdf, f.ceil(f.col("d")),
+                     [int(math.ceil(v)) for v in pdf["d"]], approx=False)
+
+    def test_round_half_up_vs_bround_half_even(self, session):
+        f = F()
+        import pyarrow as pa
+        vals = [0.5, 1.5, 2.5, -0.5, -1.5, 2.25, 2.35, 123.456]
+        df = session.create_dataframe(pa.table({"x": vals}))
+        got = df.select(f.round(f.col("x")).alias("r"),
+                        f.bround(f.col("x")).alias("b"),
+                        f.round(f.col("x"), 1).alias("r1")).collect()
+        exp = [(1.0, 0.0, 0.5), (2.0, 2.0, 1.5), (3.0, 2.0, 2.5),
+               (-1.0, -0.0, -0.5), (-2.0, -2.0, -1.5), (2.0, 2.0, 2.3),
+               (2.0, 2.0, 2.4), (123.0, 123.0, 123.5)]
+        assert_rows_equal(got, exp, approx_float=True, ignore_order=False)
+
+    def test_round_int_negative_scale(self, session):
+        f = F()
+        import pyarrow as pa
+        df = session.create_dataframe(
+            pa.table({"x": pa.array([123, 125, -125, 4], type=pa.int64())}))
+        got = df.select(f.round(f.col("x"), -1).alias("r")).collect()
+        assert [r[0] for r in got] == [120, 130, -130, 0]
+
+    def test_pow_atan2(self, mdf):
+        df, pdf = mdf
+        f = F()
+        got = df.select(f.pow(f.col("pos"), f.lit(2.0)).alias("p"),
+                        f.atan2(f.col("small"), f.col("pos")).alias("a")
+                        ).collect()
+        exp = [(v ** 2.0, math.atan2(s, v))
+               for v, s in zip(pdf["pos"], pdf["small"])]
+        assert_rows_equal(got, exp, approx_float=True, ignore_order=False)
+
+    def test_greatest_least_skip_nulls(self, session):
+        f = F()
+        import pyarrow as pa
+        df = session.create_dataframe(pa.table({
+            "a": pa.array([1, None, None, 7], type=pa.int64()),
+            "b": pa.array([5, 2, None, 3], type=pa.int64()),
+            "c": pa.array([3, None, None, None], type=pa.int64()),
+        }))
+        got = df.select(f.greatest("a", "b", "c").alias("g"),
+                        f.least("a", "b", "c").alias("l")).collect()
+        assert got == [(5, 1), (2, 2), (None, None), (7, 3)]
+
+    def test_greatest_nan_largest(self, session):
+        f = F()
+        import pyarrow as pa
+        nan = float("nan")
+        df = session.create_dataframe(pa.table({
+            "a": pa.array([1.0, nan, 2.0]),
+            "b": pa.array([nan, nan, 1.0]),
+        }))
+        got = df.select(f.greatest("a", "b").alias("g"),
+                        f.least("a", "b").alias("l")).collect()
+        assert math.isnan(got[0][0]) and got[0][1] == 1.0
+        assert math.isnan(got[1][0]) and math.isnan(got[1][1])
+        assert got[2] == (2.0, 1.0)
+
+    def test_signum_degrees(self, mdf):
+        df, pdf = mdf
+        f = F()
+        _check_unary(df, pdf, f.signum(f.col("d")),
+                     [float(np.sign(v)) for v in pdf["d"]])
+        _check_unary(df, pdf, f.degrees(f.col("small")),
+                     [math.degrees(v) for v in pdf["small"]])
+
+
+@pytest.fixture(scope="module")
+def ddf(session, rng):
+    table, pdf = gen_table(rng, {
+        "dt": DateGen(nullable=True),
+        "n": IntGen(lo=-500, hi=500, dtype="int32"),
+    }, 300)
+    return session.create_dataframe(table), pdf
+
+
+def _dt_oracle(pdf, fn):
+    out = []
+    for v in pdf["dt"]:
+        if v is None or v is pd.NaT:
+            out.append(None)
+        else:
+            d = v.date() if hasattr(v, "date") else v
+            out.append(fn(d))
+    return out
+
+
+class TestDatetime:
+    def test_extracts(self, ddf):
+        df, pdf = ddf
+        f = F()
+        got = df.select(
+            f.year("dt").alias("y"), f.month("dt").alias("m"),
+            f.dayofmonth("dt").alias("d"), f.quarter("dt").alias("q"),
+            f.dayofweek("dt").alias("dow"), f.weekday("dt").alias("wd"),
+            f.dayofyear("dt").alias("doy"), f.weekofyear("dt").alias("woy"),
+        ).collect()
+        exp = list(zip(
+            _dt_oracle(pdf, lambda d: d.year),
+            _dt_oracle(pdf, lambda d: d.month),
+            _dt_oracle(pdf, lambda d: d.day),
+            _dt_oracle(pdf, lambda d: (d.month - 1) // 3 + 1),
+            _dt_oracle(pdf, lambda d: d.isoweekday() % 7 + 1),
+            _dt_oracle(pdf, lambda d: d.weekday()),
+            _dt_oracle(pdf, lambda d: d.timetuple().tm_yday),
+            _dt_oracle(pdf, lambda d: d.isocalendar()[1]),
+        ))
+        assert_rows_equal(got, exp, ignore_order=False)
+
+    def test_last_day_trunc(self, ddf):
+        df, pdf = ddf
+        f = F()
+        got = df.select(f.last_day("dt").alias("ld"),
+                        f.trunc("dt", "month").alias("tm"),
+                        f.trunc("dt", "year").alias("ty"),
+                        f.trunc("dt", "week").alias("tw")).collect()
+
+        def last_day(d):
+            ny, nm = (d.year + 1, 1) if d.month == 12 else (d.year, d.month + 1)
+            return datetime.date(ny, nm, 1) - datetime.timedelta(days=1)
+
+        exp = list(zip(
+            _dt_oracle(pdf, last_day),
+            _dt_oracle(pdf, lambda d: d.replace(day=1)),
+            _dt_oracle(pdf, lambda d: d.replace(month=1, day=1)),
+            _dt_oracle(pdf, lambda d: d - datetime.timedelta(days=d.weekday())),
+        ))
+        assert_rows_equal(got, exp, ignore_order=False)
+
+    def test_date_arith(self, ddf):
+        df, pdf = ddf
+        f = F()
+        got = df.select(f.date_add("dt", f.col("n")).alias("a"),
+                        f.date_sub("dt", f.col("n")).alias("s"),
+                        f.datediff("dt", f.lit(datetime.date(2000, 1, 1))
+                                   ).alias("dd")).collect()
+        epoch = datetime.date(2000, 1, 1)
+        exp = []
+        for v, n in zip(pdf["dt"], pdf["n"]):
+            if v is None or pd.isna(n):
+                a = s = None
+            else:
+                d0 = v.date() if hasattr(v, "date") else v
+                a = d0 + datetime.timedelta(days=int(n))
+                s = d0 - datetime.timedelta(days=int(n))
+            dd = None if v is None else \
+                ((v.date() if hasattr(v, "date") else v) - epoch).days
+            exp.append((a, s, dd))
+        assert_rows_equal(got, exp, ignore_order=False)
+
+    def test_add_months_clamps(self, session):
+        f = F()
+        import pyarrow as pa
+        df = session.create_dataframe(pa.table({
+            "dt": pa.array([datetime.date(2020, 1, 31),
+                            datetime.date(2020, 2, 29),
+                            datetime.date(2019, 11, 30)]),
+            "n": pa.array([1, 12, 3], type=pa.int32()),
+        }))
+        got = df.select(f.add_months("dt", f.col("n")).alias("r")).collect()
+        assert [r[0] for r in got] == [datetime.date(2020, 2, 29),
+                                      datetime.date(2021, 2, 28),
+                                      datetime.date(2020, 2, 29)]
+
+    def test_months_between(self, session):
+        f = F()
+        import pyarrow as pa
+        df = session.create_dataframe(pa.table({
+            "a": pa.array([datetime.date(2020, 3, 31),
+                           datetime.date(2020, 3, 15)]),
+            "b": pa.array([datetime.date(2020, 1, 31),
+                           datetime.date(2020, 1, 31)]),
+        }))
+        got = df.select(f.months_between("a", "b").alias("r")).collect()
+        assert got[0][0] == 2.0  # both month-relative same day
+        assert abs(got[1][0] - (2 + (15 - 31) / 31)) < 1e-8
+
+
+@pytest.fixture(scope="module")
+def sdf(session, rng):
+    table, pdf = gen_table(rng, {
+        "s": StringGen(nullable=True),
+        "t": StringGen(alphabet="abcABC", max_len=5, nullable=True),
+        "i": IntGen(lo=-3, hi=8, dtype="int32", nullable=False),
+    }, 200)
+    return session.create_dataframe(table), pdf
+
+
+def _s_oracle(pdf, fn, *cols):
+    out = []
+    for vals in zip(*[pdf[c] for c in (cols or ("s",))]):
+        if any(v is None or v is pd.NA for v in vals):
+            out.append(None)
+        else:
+            out.append(fn(*vals))
+    return out
+
+
+class TestStrings:
+    def test_basic_unary(self, sdf):
+        df, pdf = sdf
+        f = F()
+        got = df.select(f.length("s").alias("l"), f.upper("s").alias("u"),
+                        f.lower("s").alias("lo"), f.reverse("s").alias("r"),
+                        f.trim("s").alias("t")).collect()
+        exp = list(zip(
+            _s_oracle(pdf, len), _s_oracle(pdf, str.upper),
+            _s_oracle(pdf, str.lower), _s_oracle(pdf, lambda s: s[::-1]),
+            _s_oracle(pdf, str.strip)))
+        assert_rows_equal(got, exp, ignore_order=False)
+
+    def test_substring_pyspark_semantics(self, sdf):
+        df, pdf = sdf
+        f = F()
+        got = df.select(f.substring("s", 2, 3).alias("a"),
+                        f.substring("s", -2, 5).alias("b"),
+                        f.substring("s", 0, 2).alias("c")).collect()
+        exp = list(zip(
+            _s_oracle(pdf, lambda s: s[1:4]),
+            _s_oracle(pdf, lambda s: s[max(len(s) - 2, 0):][:5]),
+            _s_oracle(pdf, lambda s: s[0:2])))
+        assert_rows_equal(got, exp, ignore_order=False)
+
+    def test_concat_null_propagates(self, sdf):
+        df, pdf = sdf
+        f = F()
+        got = df.select(f.concat("s", f.lit("-"), "t").alias("c"),
+                        f.concat_ws(",", "s", "t").alias("w")).collect()
+        exp_c = _s_oracle(pdf, lambda a, b: a + "-" + b, "s", "t")
+
+        def ws(row):
+            parts = [x for x in row if not (x is None or x is pd.NA)]
+            return ",".join(parts)
+
+        exp_w = [ws((a, b)) for a, b in zip(pdf["s"], pdf["t"])]
+        assert_rows_equal(got, list(zip(exp_c, exp_w)), ignore_order=False)
+
+    def test_predicates_and_like(self, sdf):
+        df, pdf = sdf
+        f = F()
+        got = df.select(f.col("s").startswith("a").alias("sw"),
+                        f.col("s").contains("X").alias("ct"),
+                        f.col("s").like("%9%").alias("lk"),
+                        f.col("s").rlike("[0-9]{2}").alias("rl")).collect()
+        exp = list(zip(
+            _s_oracle(pdf, lambda s: s.startswith("a")),
+            _s_oracle(pdf, lambda s: "X" in s),
+            _s_oracle(pdf, lambda s: "9" in s),
+            _s_oracle(pdf, lambda s: bool(__import__("re").search(
+                "[0-9]{2}", s)))))
+        assert_rows_equal(got, exp, ignore_order=False)
+
+    def test_filter_on_string_predicate(self, sdf):
+        """String predicate as a FILTER: planner must route the whole stage
+        through the CPU operator and still match the oracle."""
+        df, pdf = sdf
+        f = F()
+        got = df.filter(f.col("s").startswith("a")).select("s").collect()
+        exp = [(s,) for s in pdf["s"]
+               if not (s is None or s is pd.NA) and s.startswith("a")]
+        assert_rows_equal(got, exp)
+
+    def test_replace_pad_repeat(self, sdf):
+        df, pdf = sdf
+        f = F()
+        got = df.select(f.replace("s", f.lit("a"), f.lit("Z")).alias("r"),
+                        f.lpad("s", 6, "*").alias("lp"),
+                        f.rpad("s", 6, "*").alias("rp")).collect()
+
+        def lpad(s):
+            return s[:6] if len(s) >= 6 else "*" * (6 - len(s)) + s
+
+        def rpad(s):
+            return s[:6] if len(s) >= 6 else s + "*" * (6 - len(s))
+
+        exp = list(zip(
+            _s_oracle(pdf, lambda s: s.replace("a", "Z")),
+            _s_oracle(pdf, lpad), _s_oracle(pdf, rpad)))
+        assert_rows_equal(got, exp, ignore_order=False)
+
+    def test_regexp_extract_replace(self, sdf):
+        df, pdf = sdf
+        f = F()
+        import re as _re
+        got = df.select(
+            f.regexp_extract("s", r"([0-9]+)", 1).alias("e"),
+            f.regexp_replace("s", r"[0-9]+", "#").alias("r")).collect()
+
+        def ext(s):
+            m = _re.search(r"([0-9]+)", s)
+            return m.group(1) if m else ""
+
+        exp = list(zip(
+            _s_oracle(pdf, ext),
+            _s_oracle(pdf, lambda s: _re.sub(r"[0-9]+", "#", s))))
+        assert_rows_equal(got, exp, ignore_order=False)
+
+    def test_locate_substring_index(self, sdf):
+        df, pdf = sdf
+        f = F()
+        got = df.select(f.instr("s", "a").alias("i"),
+                        f.substring_index("s", " ", 1).alias("si")).collect()
+        exp = list(zip(
+            _s_oracle(pdf, lambda s: s.find("a") + 1),
+            _s_oracle(pdf, lambda s: s.split(" ")[0] if " " in s else s)))
+        assert_rows_equal(got, exp, ignore_order=False)
