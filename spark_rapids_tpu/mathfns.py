@@ -44,6 +44,20 @@ def _to_f64_host(d: np.ndarray, src: T.DataType) -> np.ndarray:
     return d.astype(np.float64)
 
 
+# largest double below 2^63 (JVM double→long casts saturate; a plain astype
+# of NaN/Inf/overflow is undefined behavior that differs per backend)
+_MAX_L_F = 9.223372036854775e18
+
+
+def _double_to_long(xp, y):
+    safe = xp.where(xp.isfinite(y), xp.clip(y, -_MAX_L_F, _MAX_L_F), 0.0)
+    out = safe.astype(xp.int64)
+    out = xp.where(xp.isnan(y), 0, out)
+    out = xp.where(y == xp.inf, np.int64(2**63 - 1), out)
+    out = xp.where(y == -xp.inf, np.int64(-(2**63)), out)
+    return out
+
+
 class UnaryMathExpression(Expression):
     """f(child) evaluated in double, double out (GpuUnaryMathExpression)."""
 
@@ -92,7 +106,8 @@ class Expm1(UnaryMathExpression):
 
 
 class _DomainLog(UnaryMathExpression):
-    """Logarithms: out-of-domain input produces NULL (Spark Logarithm)."""
+    """Logarithms: input <= bound produces NULL (Spark Logarithm); NaN
+    input is NOT nulled — it flows through as NaN (JVM Math.log(NaN))."""
 
     lower = 0.0  # domain is (lower, inf)
 
@@ -100,9 +115,9 @@ class _DomainLog(UnaryMathExpression):
         return True
 
     def _eval_impl(self, xp, d, v):
-        ok = d > self.lower
-        safe = xp.where(ok, d, 1.0)
-        return getattr(xp, self.func)(safe), _and_valid(v, ok)
+        bad = d <= self.lower  # False for NaN, like the JVM comparison
+        safe = xp.where(bad, 1.0, d)
+        return getattr(xp, self.func)(safe), _and_valid(v, ~bad)
 
 
 class Log(_DomainLog):
@@ -122,9 +137,9 @@ class Log1p(_DomainLog):
     lower = -1.0
 
     def _eval_impl(self, xp, d, v):
-        ok = d > self.lower
-        safe = xp.where(ok, d, 0.0)
-        return xp.log1p(safe), _and_valid(v, ok)
+        bad = d <= self.lower
+        safe = xp.where(bad, 0.0, d)
+        return xp.log1p(safe), _and_valid(v, ~bad)
 
 
 class Sin(UnaryMathExpression):
@@ -204,7 +219,8 @@ class _FloorCeil(Expression):
             if self.func == "floor":
                 return xp.floor_divide(d, scaled)
             return -xp.floor_divide(-d, scaled)
-        return getattr(xp, self.func)(d).astype(xp.int64)
+        y = getattr(xp, self.func)(d)
+        return _double_to_long(xp, y)
 
     def eval(self, ctx) -> Value:
         d, v = self.children[0].eval(ctx)
@@ -254,17 +270,21 @@ class _RoundBase(Expression):
     def _eval_impl(self, xp, d, src: T.DataType):
         s = self.scale_arg
         if src.is_decimal:
-            s2 = self.dtype.scale
+            s2 = self.scale_arg            # requested rounding position
+            stored = self.dtype.scale      # result's stored scale (>= 0)
             if s2 >= src.scale:
-                return d * np.int64(10 ** (s2 - src.scale))
+                return d * np.int64(10 ** (stored - src.scale))
+            m = 10 ** (src.scale - s2)
             if self.half_even:
-                m = 10 ** (src.scale - s2)
                 q = xp.floor_divide(d, m)
                 r = d - q * m
                 half = m // 2
                 round_up = (r > half) | ((r == half) & (q % 2 != 0))
-                return q + round_up.astype(q.dtype)
-            return _round_div(d, 10 ** (src.scale - s2))
+                q = q + round_up.astype(q.dtype)
+            else:
+                q = _round_div(d, m)
+            # negative s2: value is a multiple of 10^-s2 at stored scale 0
+            return q * np.int64(10 ** (stored - s2))
         if src.is_integral:
             if s >= 0:
                 return d

@@ -1,0 +1,92 @@
+"""Device-side string handling via dictionary codes.
+
+TPUs have no native string type (SURVEY §7.3); the reference leans on cudf's
+device string columns.  Here string *keys* (group-by / join / distinct) are
+dictionary-encoded on host into dense int32 codes, the device operates on the
+codes (sort, segment-reduce, hash-partition — all int kernels it already
+has), and the codes decode back to strings at the output boundary.
+
+The dictionary is INCREMENTAL and query-scoped: every batch that feeds an
+operator extends the same mapping, so codes are comparable across batches,
+across the partial→exchange→final pipeline, and across the two sides of a
+join (both sides encode through one dictionary).  Code order is insertion
+order — a valid total order for equality-based operations (group-by, hash
+partition, sort-merge equality), NOT for range comparisons or ORDER BY,
+which stay on the CPU path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["StringDictionary"]
+
+
+class StringDictionary:
+    """Incremental string→int32 code mapping (query-scoped)."""
+
+    _MEMO_MAX = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._code_of: Dict[str, int] = {}
+        self._values: List[str] = []
+        # memo of already-encoded arrow arrays (keyed by object identity —
+        # arrow arrays are immutable and the memo holds the reference, so
+        # ids stay valid).  A shuffled join encodes the same staged array
+        # in the exchange (for pids) and again in the join kernel.
+        self._memo: "Dict[int, tuple]" = {}
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def encode(self, arr) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """pyarrow StringArray → (int32 codes, validity-or-None).
+
+        Null slots get code 0 with validity False.
+        """
+        import pyarrow as pa
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        hit = self._memo.get(id(arr))
+        if hit is not None and hit[0] is arr:
+            return hit[1], hit[2]
+        # per-batch arrow dictionary encode gives local codes fast (C++),
+        # then only the (small) local dictionary goes through the python map
+        denc = arr.dictionary_encode()
+        local_vals = denc.dictionary.to_pylist()
+        with self._lock:
+            remap = np.empty(max(len(local_vals), 1), dtype=np.int32)
+            for i, v in enumerate(local_vals):
+                code = self._code_of.get(v)
+                if code is None:
+                    code = len(self._values)
+                    self._code_of[v] = code
+                    self._values.append(v)
+                remap[i] = code
+        local_codes = denc.indices.to_numpy(zero_copy_only=False)
+        valid = None
+        if arr.null_count > 0:
+            valid = np.asarray(arr.is_valid())
+            local_codes = np.where(valid, local_codes, 0).astype(np.int64)
+        codes = remap[local_codes.astype(np.int64)].astype(np.int32)
+        with self._lock:
+            if len(self._memo) >= self._MEMO_MAX:
+                self._memo.clear()
+            self._memo[id(arr)] = (arr, codes, valid)
+        return codes, valid
+
+    def decode(self, codes: np.ndarray,
+               valid: Optional[np.ndarray] = None):
+        """int32 codes → pyarrow StringArray (None where invalid)."""
+        import pyarrow as pa
+        with self._lock:
+            vals = self._values
+        out = [None if (valid is not None and not valid[i])
+               else vals[int(codes[i])] if 0 <= int(codes[i]) < len(vals)
+               else None
+               for i in range(len(codes))]
+        return pa.array(out, type=pa.string())

@@ -18,9 +18,10 @@ realized in-process.  Transports (SURVEY §5.8):
 
 from __future__ import annotations
 
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from .. import types as T
@@ -47,10 +48,13 @@ class ShuffleExchangeExec(TpuExec):
     outputs_partitions = True
 
     def __init__(self, child: TpuExec, key_exprs: List[Expression],
-                 n_parts: int):
+                 n_parts: int, string_dicts: Optional[dict] = None):
         super().__init__([child])
         self.key_exprs = key_exprs  # bound against child.output_schema
         self.n_parts = n_parts
+        # key index → StringDictionary, shared with the downstream join so
+        # string keys hash via comparable codes (ops/strings.py)
+        self.string_dicts = string_dicts
 
     @property
     def output_schema(self) -> Schema:
@@ -97,8 +101,12 @@ class ShuffleExchangeExec(TpuExec):
                     arrays = tuple(
                         (c.data, c.valid) if isinstance(c, DeviceColumn)
                         else None for c in batch.columns)
+                    if self.string_dicts is not None:
+                        from .join_exec import encode_key_arrays
+                        arrays = encode_key_arrays(
+                            arrays, batch, self.key_exprs, self.string_dicts)
                     pids = pid_fn(arrays, batch.sel,
-                                  jnp.int32(batch.num_rows))
+                                  np.int32(batch.num_rows))
                 staged.append((catalog.register(batch, priority=0),
                                catalog.register(ColumnBatch(
                                    _PID_SCHEMA, [DeviceColumn(

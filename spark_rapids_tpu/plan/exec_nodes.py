@@ -345,6 +345,9 @@ def plan_join(plan, left: TpuExec, right: TpuExec, conf):
     from ..exprs import Cast
     from .exchange_exec import ShuffleExchangeExec
     from .join_exec import SortMergeJoinExec, bound_join_keys
+    # one dictionary registry per key index shared by both sides' exchanges
+    # AND the join kernel: string-key codes must be comparable everywhere
+    shared_dicts: dict = {}
     if (plan.how != "cross" and plan.left_keys
             and conf["spark.rapids.tpu.sql.exchange.enabled"]):
         lk, rk, common = bound_join_keys(plan, left.output_schema,
@@ -354,6 +357,9 @@ def plan_join(plan, left: TpuExec, right: TpuExec, conf):
             return [k if k.dtype == ct else Cast(k, ct)
                     for k, ct in zip(keys, common)]
         n_parts = conf["spark.rapids.tpu.sql.shuffle.partitions"]
-        left = ShuffleExchangeExec(left, promoted(lk), n_parts)
-        right = ShuffleExchangeExec(right, promoted(rk), n_parts)
-    return SortMergeJoinExec(plan, left, right, conf)
+        left = ShuffleExchangeExec(left, promoted(lk), n_parts,
+                                   string_dicts=shared_dicts)
+        right = ShuffleExchangeExec(right, promoted(rk), n_parts,
+                                    string_dicts=shared_dicts)
+    return SortMergeJoinExec(plan, left, right, conf,
+                             string_dicts=shared_dicts)

@@ -63,8 +63,34 @@ def _canon_how(how: str) -> str:
             "left_anti": "anti"}.get(how, how)
 
 
+def encode_key_arrays(arrays, batch: ColumnBatch, key_exprs, dicts: dict):
+    """Substitute int32 dictionary codes for string bare-column join keys.
+
+    ``dicts`` maps key INDEX → StringDictionary and is shared across both
+    join sides (and their exchanges), so codes are comparable everywhere a
+    given key is hashed or compared (ops/strings.py).
+    """
+    from ..exprs import BoundReference
+    from ..ops.strings import StringDictionary
+    from .planner import strip_alias
+    arrays = list(arrays)
+    for ki, e in enumerate(key_exprs):
+        core = strip_alias(e)
+        if isinstance(core, BoundReference) and core.dtype is not None \
+                and core.dtype.is_string:
+            col = batch.columns[core.ordinal]
+            if isinstance(col, HostStringColumn):
+                d = dicts.setdefault(ki, StringDictionary())
+                codes, valid = d.encode(col.array)
+                arrays[core.ordinal] = (
+                    jnp.asarray(codes),
+                    jnp.asarray(valid) if valid is not None else None)
+    return tuple(arrays)
+
+
 class SortMergeJoinExec(TpuExec):
-    def __init__(self, plan, left: TpuExec, right: TpuExec, conf):
+    def __init__(self, plan, left: TpuExec, right: TpuExec, conf,
+                 string_dicts: Optional[dict] = None):
         super().__init__([left, right])
         self.plan = plan
         self.how = _canon_how(plan.how)
@@ -72,6 +98,7 @@ class SortMergeJoinExec(TpuExec):
         # single source of truth for join output shape: L.Join.schema()
         self._schema = plan.schema()
         self.using = list(getattr(plan, "using", []) or [])
+        self.string_dicts = string_dicts if string_dicts is not None else {}
 
     @property
     def output_schema(self) -> Schema:
@@ -203,10 +230,13 @@ class SortMergeJoinExec(TpuExec):
                 bctx = EvalContext(list(b_arrays), b_cap, active=b_active)
                 pkv = [e.eval(pctx) for e in pk]
                 bkv = [e.eval(bctx) for e in bk]
-                # promote to common key types, then union-encode
-                pkv = [(promote_physical(d, e.dtype, ct), v)
+                # promote to common key types, then union-encode (string
+                # keys arrive as int32 dictionary codes — no promotion)
+                pkv = [(d, v) if ct.is_string
+                       else (promote_physical(d, e.dtype, ct), v)
                        for (d, v), e, ct in zip(pkv, pk, common)]
-                bkv = [(promote_physical(d, e.dtype, ct), v)
+                bkv = [(d, v) if ct.is_string
+                       else (promote_physical(d, e.dtype, ct), v)
                        for (d, v), e, ct in zip(bkv, bk, common)]
                 # null keys never match
                 def _ok(kvs, active):
@@ -241,8 +271,10 @@ class SortMergeJoinExec(TpuExec):
         fn = _cached_program("join-match|" + fp, build_fn)
         p_arrays = _dev_arrays(probe)
         b_arrays = _dev_arrays(build)
-        return fn(p_arrays, b_arrays, jnp.int32(probe.num_rows),
-                  jnp.int32(build.num_rows))
+        p_arrays = encode_key_arrays(p_arrays, probe, pk, self.string_dicts)
+        b_arrays = encode_key_arrays(b_arrays, build, bk, self.string_dicts)
+        return fn(p_arrays, b_arrays, np.int32(probe.num_rows),
+                  np.int32(build.num_rows))
 
     def _semi_anti(self, left: ColumnBatch, right: ColumnBatch) -> ColumnBatch:
         lo, matches, b_perm = self._match_state(left, right, probe_side=0)
@@ -356,15 +388,19 @@ class SortMergeJoinExec(TpuExec):
             # using-join key columns are coalesced across sides so unmatched
             # right/full rows still show the key (Spark USING semantics)
             if f.name in using and self.how in ("right", "full") \
-                    and f.name in rsch and isinstance(c, DeviceColumn):
+                    and f.name in rsch:
                 rc = rcols["cols"][rsch.index_of(f.name)]
-                if isinstance(rc, DeviceColumn):
+                if isinstance(c, DeviceColumn) and isinstance(rc, DeviceColumn):
                     lv = c.valid if c.valid is not None else \
                         jnp.ones_like(c.data, dtype=bool)
                     data = jnp.where(lv, c.data, rc.data)
                     # coalesce: null only where BOTH sides are null
                     valid = None if rc.valid is None else (lv | rc.valid)
                     c = DeviceColumn(f.dtype, data, valid)
+                elif isinstance(c, HostStringColumn) \
+                        and isinstance(rc, HostStringColumn):
+                    import pyarrow.compute as pc
+                    c = HostStringColumn(pc.coalesce(c.array, rc.array))
             cols.append(c)
         for f, c in zip(rsch, rcols["cols"]):
             if f.name in using:

@@ -124,9 +124,13 @@ class NodeMeta:
                 b = bind(e, schema)
                 core = strip_alias(b)
                 if core.dtype is not None and core.dtype.is_string:
-                    self.will_not_work(
-                        f"group key {name} is string (device dictionary "
-                        f"grouping pending)")
+                    # bare string COLUMNS group on device via dictionary
+                    # codes (ops/strings.py); computed string keys still
+                    # need device string kernels
+                    if not isinstance(core, BoundReference):
+                        self.will_not_work(
+                            f"group key {name} is a computed string "
+                            f"expression (device string kernels pending)")
                 else:
                     for r in expr_reasons(b, allow_string_passthrough=False):
                         self.will_not_work(f"group key {name}: {r}")
@@ -148,24 +152,27 @@ class NodeMeta:
                     self.will_not_work(f"sort key: {r}")
             return
         if isinstance(p, (L.Limit, L.Union, L.LogicalRange, L.Distinct)):
-            if isinstance(p, L.Distinct):
-                for f in p.schema():
-                    if f.dtype.is_string:
-                        self.will_not_work(
-                            f"distinct over string column {f.name} "
-                            f"(device dictionary grouping pending)")
+            # Distinct groups by bare column references — string columns
+            # go through dictionary codes like any group key
             return
         if isinstance(p, L.Join):
             schema_l = p.children[0].schema()
             schema_r = p.children[1].schema()
-            for k in p.left_keys:
-                b = bind(k, schema_l)
-                for r in expr_reasons(b, allow_string_passthrough=False):
-                    self.will_not_work(f"left join key: {r}")
-            for k in p.right_keys:
-                b = bind(k, schema_r)
-                for r in expr_reasons(b, allow_string_passthrough=False):
-                    self.will_not_work(f"right join key: {r}")
+            def _tag_keys(keys, schema, side):
+                for k in keys:
+                    b = bind(k, schema)
+                    core = strip_alias(b)
+                    if core.dtype is not None and core.dtype.is_string:
+                        # bare string columns join via dictionary codes
+                        if not isinstance(core, BoundReference):
+                            self.will_not_work(
+                                f"{side} join key is a computed string "
+                                f"expression (device string kernels pending)")
+                        continue
+                    for r in expr_reasons(b, allow_string_passthrough=False):
+                        self.will_not_work(f"{side} join key: {r}")
+            _tag_keys(p.left_keys, schema_l, "left")
+            _tag_keys(p.right_keys, schema_r, "right")
             if p.how not in ("inner", "left", "left_outer", "right",
                              "right_outer", "full", "full_outer", "semi",
                              "anti", "left_semi", "left_anti", "cross"):
@@ -235,7 +242,11 @@ def _plan_aggregate(child_phys: TpuExec, group_bound, agg_bound,
         return AggregateExec(child_phys, group_bound, agg_bound,
                              mode="complete")
     from .exchange_exec import ShuffleExchangeExec
-    partial = AggregateExec(child_phys, group_bound, agg_bound, mode="partial")
+    # string keys: partial and final share one dictionary registry so codes
+    # stay comparable across the exchange (ops/strings.py)
+    shared_dicts: dict = {}
+    partial = AggregateExec(child_phys, group_bound, agg_bound, mode="partial",
+                            string_dicts=shared_dicts)
     n_parts = conf["spark.rapids.tpu.sql.shuffle.partitions"]
     buf_schema = partial.output_schema
     exch_keys = [BoundReference(i, f.dtype, f.nullable, f.name)
@@ -243,7 +254,8 @@ def _plan_aggregate(child_phys: TpuExec, group_bound, agg_bound,
     exchange = ShuffleExchangeExec(partial, exch_keys, n_parts)
     final_keys = [(n, BoundReference(i, e.dtype, e.nullable, n))
                   for i, (n, e) in enumerate(group_bound)]
-    return AggregateExec(exchange, final_keys, agg_bound, mode="final")
+    return AggregateExec(exchange, final_keys, agg_bound, mode="final",
+                         string_dicts=shared_dicts)
 
 
 def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:

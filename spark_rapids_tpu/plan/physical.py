@@ -334,11 +334,15 @@ class AggregateExec(TpuExec):
 
     def __init__(self, child: TpuExec, group_exprs: List[Tuple[str, Expression]],
                  agg_exprs: List[Tuple[str, AggregateExpression]],
-                 mode: str = "complete"):
+                 mode: str = "complete", string_dicts: Optional[dict] = None):
         super().__init__([child])
         self.group_exprs = group_exprs
         self.agg_exprs = agg_exprs
         self.mode = mode
+        # group-index → StringDictionary for string-typed keys (shared with
+        # the partner partial/final exec so codes stay comparable across the
+        # exchange; see ops/strings.py)
+        self.string_dicts = string_dicts if string_dicts is not None else {}
         out_fields = [Field(n, e.dtype, e.nullable) for n, e in group_exprs]
         if mode == "partial":
             for name, agg in agg_exprs:
@@ -579,6 +583,7 @@ class AggregateExec(TpuExec):
             any_out = False
             for batch in child.execute(ctx):
                 with m.time("opTime"):
+                    batch = self._encode_string_keys(batch, ctx)
                     arrays = tuple(
                         (c.data, c.valid) if isinstance(c, DeviceColumn)
                         else None for c in batch.columns)
@@ -606,6 +611,7 @@ class AggregateExec(TpuExec):
         pending: Optional[ColumnBatch] = None
         for batch in child.execute(ctx):
             with m.time("opTime"):
+                batch = self._encode_string_keys(batch, ctx)
                 for part in with_retry(ctx, batch, run_one):
                     if pending is None:
                         pending = batch_utils.compact(part)
@@ -618,6 +624,67 @@ class AggregateExec(TpuExec):
         out = self._finalize_grouped(pending) if self.mode != "partial" else pending
         m.add("numOutputRows", out.num_rows)
         yield out
+
+    # -- string keys via dictionary codes (ops/strings.py) ------------------------
+    def _string_key_refs(self):
+        """[(group_index, child_ordinal)] of string-typed bare-column keys."""
+        from .planner import strip_alias
+        out = []
+        for gi, (_n, e) in enumerate(self.group_exprs):
+            core = strip_alias(e)
+            if isinstance(core, BoundReference) and core.dtype is not None \
+                    and core.dtype.is_string:
+                out.append((gi, core.ordinal))
+        return out
+
+    def _encode_string_keys(self, batch: ColumnBatch, ctx) -> ColumnBatch:
+        """Replace host string key columns with device int32 dictionary
+        codes (query-scoped incremental dictionary, shared with the partner
+        partial/final exec)."""
+        refs = self._string_key_refs()
+        if not refs:
+            return batch
+        from ..ops.strings import StringDictionary
+        cols = list(batch.columns)
+        changed = False
+        for gi, ordn in refs:
+            col = cols[ordn]
+            if not isinstance(col, HostStringColumn):
+                continue  # already encoded (or device data)
+            d = self.string_dicts.setdefault(gi, StringDictionary())
+            codes, valid = d.encode(col.array)
+            jcodes = jax.device_put(codes, ctx.device)
+            jvalid = (jax.device_put(valid, ctx.device)
+                      if valid is not None else None)
+            cols[ordn] = DeviceColumn(T.STRING, jcodes, jvalid)
+            changed = True
+        if not changed:
+            return batch
+        return ColumnBatch(batch.schema, cols, batch.num_rows, batch.sel)
+
+    def _decode_string_keys(self, out: ColumnBatch) -> ColumnBatch:
+        """Map coded key columns back to host strings at the output boundary
+        (one batched device_get for all coded columns)."""
+        if not self.string_dicts or self.mode == "partial":
+            return out
+        cols = list(out.columns)
+        fetch = {}
+        for gi in self.string_dicts:
+            col = cols[gi]
+            if isinstance(col, DeviceColumn):
+                fetch[("c", gi)] = col.data
+                if col.valid is not None:
+                    fetch[("v", gi)] = col.valid
+        if not fetch:
+            return out
+        host = jax.device_get(fetch)
+        for gi, d in self.string_dicts.items():
+            col = cols[gi]
+            if not isinstance(col, DeviceColumn):
+                continue
+            arr = d.decode(host[("c", gi)], host.get(("v", gi)))
+            cols[gi] = HostStringColumn(arr, capacity=out.capacity)
+        return ColumnBatch(out.schema, cols, out.num_rows, out.sel)
 
     def _key_contributions(self, ectx: EvalContext):
         return [e.eval(ectx) for _, e in self.group_exprs]
@@ -636,7 +703,12 @@ class AggregateExec(TpuExec):
                          gmask) -> ColumnBatch:
         cols: List[DeviceColumn] = []
         for (d, v), f in zip(out_keys + out_vals, schema):
-            cols.append(DeviceColumn(f.dtype, d.astype(f.dtype.numpy_dtype), v))
+            if f.dtype.is_string:
+                # dictionary codes: physical type is int32, logical STRING
+                cols.append(DeviceColumn(f.dtype, d.astype(jnp.int32), v))
+            else:
+                cols.append(DeviceColumn(f.dtype, d.astype(f.dtype.numpy_dtype),
+                                         v))
         cap = cols[0].capacity
         return ColumnBatch(schema, cols, cap, gmask)
 
@@ -672,7 +744,8 @@ class AggregateExec(TpuExec):
         cols: List[DeviceColumn] = list(pending.columns[:n_keys])
         for (name, agg), (d, v) in zip(self.agg_exprs, fin_vals):
             cols.append(DeviceColumn(agg.dtype, d, v))
-        return ColumnBatch(self._schema, cols, pending.num_rows, pending.sel)
+        out = ColumnBatch(self._schema, cols, pending.num_rows, pending.sel)
+        return self._decode_string_keys(out)
 
     def _empty_cols(self):
         cols = []

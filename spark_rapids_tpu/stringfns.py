@@ -152,8 +152,10 @@ class Substring(StringExpression):
         elif pos == 0:
             start = 0
         else:
-            start = max(len(s) + pos, 0)
-        return s[start: start + ln]
+            start = len(s) + pos  # may stay negative: Spark substringSQL
+        end = start + ln          # clamps AFTER computing the window
+        start_c, end_c = max(start, 0), max(end, 0)
+        return s[start_c:end_c] if end_c > start_c else ""
 
 
 class Concat(StringExpression):
@@ -331,17 +333,47 @@ class RegExpExtract(StringExpression):
         return g if g is not None else ""
 
 
+def _java_repl_to_py(r: str) -> str:
+    """Java-style replacement ($N group refs, \\$ literal dollar) → python
+    re template ($0 must become \\g<0>, not the NUL octal escape \\0)."""
+    out = []
+    i = 0
+    while i < len(r):
+        ch = r[i]
+        if ch == "\\" and i + 1 < len(r):
+            nxt = r[i + 1]
+            if nxt == "$":
+                out.append("$")
+            elif nxt == "\\":
+                out.append("\\\\")
+            else:
+                out.append("\\\\" + nxt)
+            i += 2
+        elif ch == "$" and i + 1 < len(r) and r[i + 1].isdigit():
+            j = i + 1
+            while j < len(r) and r[j].isdigit():
+                j += 1
+            out.append(f"\\g<{r[i + 1: j]}>")
+            i = j
+        elif ch == "\\":
+            out.append("\\\\")
+            i += 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
 class RegExpReplace(StringExpression):
     def __init__(self, child: Expression, pattern: str, replacement: str):
         self.pattern = str(pattern)
         self.replacement = str(replacement)
         self._re = re.compile(self.pattern)
+        self._repl = _java_repl_to_py(self.replacement)
         super().__init__(child)
 
     def _fp_extra(self):
         return f"re={self.pattern!r}->{self.replacement!r}:{self.dtype}"
 
     def _apply(self, s):
-        # Spark uses Java regex $1 group refs; python re uses \1
-        repl = re.sub(r"\$(\d+)", r"\\\1", self.replacement)
-        return self._re.sub(repl, s)
+        return self._re.sub(self._repl, s)

@@ -388,3 +388,60 @@ class TestStrings:
             _s_oracle(pdf, lambda s: s.find("a") + 1),
             _s_oracle(pdf, lambda s: s.split(" ")[0] if " " in s else s)))
         assert_rows_equal(got, exp, ignore_order=False)
+
+
+class TestSparkEdgeSemantics:
+    """Pinned Spark edge semantics from review findings."""
+
+    def test_log_nan_flows_through(self, session):
+        f = F()
+        import pyarrow as pa
+        nan = float("nan")
+        df = session.create_dataframe(pa.table({"x": [1.0, nan, -1.0, 0.0]}))
+        got = df.select(f.log(f.col("x")).alias("r")).collect()
+        assert got[0][0] == 0.0
+        assert math.isnan(got[1][0])  # NaN in → NaN out, NOT null
+        assert got[2][0] is None and got[3][0] is None
+
+    def test_floor_ceil_special_doubles(self, session):
+        f = F()
+        import pyarrow as pa
+        inf = float("inf")
+        df = session.create_dataframe(
+            pa.table({"x": [float("nan"), inf, -inf, 1.5]}))
+        got = df.select(f.floor(f.col("x")).alias("fl"),
+                        f.ceil(f.col("x")).alias("ce")).collect()
+        assert got[0] == (0, 0)                      # NaN → 0 (JVM cast)
+        assert got[1] == (2**63 - 1, 2**63 - 1)      # +Inf saturates
+        assert got[2] == (-(2**63), -(2**63))        # -Inf saturates
+        assert got[3] == (1, 2)
+
+    def test_substring_pos_beyond_start(self, session):
+        f = F()
+        import pyarrow as pa
+        df = session.create_dataframe(pa.table({"s": ["abcd"]}))
+        got = df.select(f.substring("s", -6, 2).alias("a"),
+                        f.substring("s", -6, 7).alias("b"),
+                        f.substring("s", -2, 5).alias("c")).collect()
+        # Spark: start=-2, end=start+len clamped after — window [-2,0) = ""
+        assert got[0] == ("", "abcd", "cd")
+
+    def test_regexp_replace_dollar_zero(self, session):
+        f = F()
+        import pyarrow as pa
+        df = session.create_dataframe(pa.table({"s": ["abc"]}))
+        got = df.select(
+            f.regexp_replace("s", "b", "$0$0").alias("r"),
+            f.regexp_replace("s", "b", r"\$1").alias("d")).collect()
+        assert got[0][0] == "abbc"   # $0 = whole match, not NUL escape
+        assert got[0][1] == "a$1c"   # \$ = literal dollar
+
+    def test_round_decimal_negative_scale(self, session):
+        f = F()
+        import pyarrow as pa
+        from decimal import Decimal
+        df = session.create_dataframe(pa.table({
+            "d": pa.array([Decimal("123.45"), Decimal("125.00"),
+                           Decimal("-125.00")], type=pa.decimal128(5, 2))}))
+        got = df.select(f.round(f.col("d"), -1).alias("r")).collect()
+        assert [str(r[0]) for r in got] == ["120", "130", "-130"]
