@@ -29,7 +29,8 @@ from . import types as T
 from .exprs import AggregateExpression, EvalContext, Expression, Value
 
 __all__ = ["Sum", "Count", "CountStar", "Min", "Max", "Average", "First", "Last",
-           "AGG_CLASSES"]
+           "VariancePop", "VarianceSamp", "StddevPop", "StddevSamp",
+           "CovarPop", "CovarSamp", "Corr", "Percentile", "AGG_CLASSES"]
 
 
 def _ones(ctx: EvalContext):
@@ -213,5 +214,198 @@ class Last(First):
     reduce_choice = "last"
 
 
+class _CentralMoment(AggregateExpression):
+    """Variance/stddev via (n, Σx, Σx²) sum buffers.
+
+    The reference merges Welford M2 partials (AggregateFunctions.scala M2);
+    M2 merging is not a plain segment-sum, so the TPU shape is the
+    sum-of-squares formulation — numerically adequate in float64 and it
+    rides the existing "sum" reduction everywhere (batch merge, exchange,
+    re-partition) with zero new machinery.
+    """
+
+    sample = False
+    sqrt = False
+
+    def _resolve(self):
+        self.dtype = T.FLOAT64
+        self.nullable = True
+
+    def buffers(self):
+        return [(T.INT64, "sum"), (T.FLOAT64, "sum"), (T.FLOAT64, "sum")]
+
+    def update(self, ctx):
+        d, v = self.children[0].eval(ctx)
+        src = self.children[0].dtype
+        x = d.astype(jnp.float64)
+        if src.is_decimal:
+            x = x / (10.0 ** src.scale)
+        if v is not None:
+            x = jnp.where(v, x, 0.0)
+        return [(_valid_indicator(v, ctx), None), (x, None), (x * x, None)]
+
+    def finalize(self, values):
+        (n, _), (sx, _), (sxx, _) = values
+        nf = n.astype(jnp.float64)
+        ok = n > 0
+        safe_n = jnp.where(ok, nf, 1.0)
+        m2 = jnp.maximum(sxx - sx * sx / safe_n, 0.0)  # clamp fp negatives
+        if self.sample:
+            # n==1 → NULL (Spark 3.1+ default, legacy.statisticalAggregate
+            # off — Spark returns NaN only under the legacy flag)
+            ok = n > 1
+            var = m2 / jnp.maximum(nf - 1.0, 1.0)
+        else:
+            var = m2 / safe_n
+        out = jnp.sqrt(var) if self.sqrt else var
+        return out, ok
+
+
+class VariancePop(_CentralMoment):
+    func = "var_pop"
+
+
+class VarianceSamp(_CentralMoment):
+    func = "var_samp"
+    sample = True
+
+
+class StddevPop(_CentralMoment):
+    func = "stddev_pop"
+    sqrt = True
+
+
+class StddevSamp(_CentralMoment):
+    func = "stddev_samp"
+    sample = True
+    sqrt = True
+
+
+class _BinaryAgg(AggregateExpression):
+    """Two-child aggregate (corr / covar family)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+        if left.resolved() and right.resolved():
+            self._resolve()
+
+    def _resolve(self):
+        self.dtype = T.FLOAT64
+        self.nullable = True
+
+    def _xy(self, ctx):
+        xd, xv = self.children[0].eval(ctx)
+        yd, yv = self.children[1].eval(ctx)
+
+        def f64(d, e):
+            d = d.astype(jnp.float64)
+            if e.dtype.is_decimal:
+                d = d / (10.0 ** e.dtype.scale)
+            return d
+
+        x, y = f64(xd, self.children[0]), f64(yd, self.children[1])
+        if xv is None and yv is None:
+            both = None
+        else:
+            both = (xv if xv is not None else jnp.ones_like(x, dtype=bool))
+            if yv is not None:
+                both = both & yv
+        if both is not None:
+            x = jnp.where(both, x, 0.0)
+            y = jnp.where(both, y, 0.0)
+        return x, y, both
+
+
+class _Covariance(_BinaryAgg):
+    """covar_pop / covar_samp via (n, Σx, Σy, Σxy)."""
+
+    sample = False
+
+    def buffers(self):
+        return [(T.INT64, "sum"), (T.FLOAT64, "sum"), (T.FLOAT64, "sum"),
+                (T.FLOAT64, "sum")]
+
+    def update(self, ctx):
+        x, y, both = self._xy(ctx)
+        ind = _valid_indicator(both, ctx)
+        return [(ind, None), (x, None), (y, None), (x * y, None)]
+
+    def finalize(self, values):
+        (n, _), (sx, _), (sy, _), (sxy, _) = values
+        nf = n.astype(jnp.float64)
+        ok = n > 0
+        safe_n = jnp.where(ok, nf, 1.0)
+        c = sxy - sx * sy / safe_n
+        if self.sample:
+            ok = n > 1  # NULL for n<2 (non-legacy Spark)
+            out = c / jnp.maximum(nf - 1.0, 1.0)
+        else:
+            out = c / safe_n
+        return out, ok
+
+
+class CovarPop(_Covariance):
+    func = "covar_pop"
+
+
+class CovarSamp(_Covariance):
+    func = "covar_samp"
+    sample = True
+
+
+class Corr(_BinaryAgg):
+    """Pearson correlation via (n, Σx, Σy, Σxy, Σx², Σy²)."""
+
+    func = "corr"
+
+    def buffers(self):
+        return [(T.INT64, "sum")] + [(T.FLOAT64, "sum")] * 5
+
+    def update(self, ctx):
+        x, y, both = self._xy(ctx)
+        ind = _valid_indicator(both, ctx)
+        return [(ind, None), (x, None), (y, None), (x * y, None),
+                (x * x, None), (y * y, None)]
+
+    def finalize(self, values):
+        (n, _), (sx, _), (sy, _), (sxy, _), (sxx, _), (syy, _) = values
+        nf = n.astype(jnp.float64)
+        ok = n > 1  # corr of <2 points is NULL (non-legacy Spark)
+        safe_n = jnp.where(n > 0, nf, 1.0)
+        cov = sxy - sx * sy / safe_n
+        vx = jnp.maximum(sxx - sx * sx / safe_n, 0.0)
+        vy = jnp.maximum(syy - sy * sy / safe_n, 0.0)
+        denom = jnp.sqrt(vx * vy)
+        out = jnp.where(denom > 0, cov / jnp.where(denom > 0, denom, 1.0),
+                        jnp.nan)
+        return out, ok
+
+
+class Percentile(AggregateExpression):
+    """Exact percentile with linear interpolation (Spark ``percentile``).
+
+    Needs every group's values materialized — not expressible as fixed
+    reduction buffers, so it runs on the CPU operator (the reference's
+    GpuApproximatePercentile uses t-digest sketches; an exact sort-based
+    device version is the planned TPU shape).
+    """
+
+    func = "percentile"
+    device_supported = False
+
+    def __init__(self, child: Expression, q: float):
+        self.q = float(q)
+        super().__init__(child)
+
+    def _resolve(self):
+        self.dtype = T.FLOAT64
+        self.nullable = True
+
+    def _fp_extra(self):
+        return f"{self.func}:{self.q}:{self.dtype}"
+
+
 AGG_CLASSES = {c.func: c for c in
-               [Sum, Count, CountStar, Min, Max, Average, First, Last]}
+               [Sum, Count, CountStar, Min, Max, Average, First, Last,
+                VariancePop, VarianceSamp, StddevPop, StddevSamp,
+                CovarPop, CovarSamp, Corr, Percentile]}

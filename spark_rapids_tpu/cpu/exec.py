@@ -139,6 +139,11 @@ class CpuOpExec(TpuExec):
                 self.children[0].output_schema.names()).aggregate([])
         if isinstance(p, L.Window):
             return self._run_window(ctx, p)
+        if isinstance(p, L.Sample):
+            t = self._child_table(ctx)
+            rng = np.random.default_rng(p.seed)
+            keep = rng.random(t.num_rows) < p.fraction
+            return t.filter(keep)
         raise NotImplementedError(
             f"CPU fallback for {type(p).__name__} not implemented")
 
@@ -179,9 +184,9 @@ class CpuOpExec(TpuExec):
         agg_specs = []
         for name, e in p.agg_exprs:
             b = strip_alias(bind(e, in_schema))
-            child_val = (eval_cpu(b.children[0], vals, n)
-                         if b.children else (np.ones(n), None))
-            agg_specs.append((name, b, child_val))
+            child_vals = ([eval_cpu(c, vals, n) for c in b.children]
+                          if b.children else [(np.ones(n), None)])
+            agg_specs.append((name, b, child_vals))
 
         if not key_vals:
             outs = [self._agg_scalar(b, cv, n) for _, b, cv in agg_specs]
@@ -215,12 +220,12 @@ class CpuOpExec(TpuExec):
                     kd[gi] = d[first_idx]
             key_outs.append((kd, None if kv.all() else kv))
         agg_outs = []
-        for name, b, (cd, cv) in agg_specs:
+        for name, b, child_vals in agg_specs:
             od = np.zeros(out_rows, dtype=self._agg_np_dtype(b))
             ov = np.ones(out_rows, dtype=bool)
             for gi, gk in enumerate(group_keys):
                 idx = grouped.indices[gk]
-                val, ok = self._agg_one(b, cd, cv, idx)
+                val, ok = self._agg_one(b, child_vals, idx)
                 od[gi] = val
                 ov[gi] = ok
             agg_outs.append((od, None if ov.all() else ov))
@@ -231,8 +236,43 @@ class CpuOpExec(TpuExec):
         return b.dtype.numpy_dtype
 
     @staticmethod
-    def _agg_one(b, cd, cv, idx):
+    def _agg_one(b, child_vals, idx):
         from .. import aggfns as A
+        cd, cv = child_vals[0]
+        if isinstance(b, A._BinaryAgg):
+            # rows where EITHER side is null are excluded (Spark corr/covar)
+            yd, yv = child_vals[1]
+            both = np.ones(len(cd), dtype=bool)
+            if cv is not None:
+                both &= cv
+            if yv is not None:
+                both &= yv
+            sel = idx[both[idx]]
+            if len(sel) == 0:
+                return 0, False
+
+            def f64(d, e):
+                d = d.astype(np.float64)
+                if e.dtype.is_decimal:
+                    d = d / 10 ** e.dtype.scale
+                return d
+
+            x = f64(cd, b.children[0])[sel]
+            y = f64(yd, b.children[1])[sel]
+            n_ = float(len(sel))
+            cov = (x * y).sum() - x.sum() * y.sum() / n_
+            if isinstance(b, A.Corr):
+                if n_ < 2:  # NULL for <2 points (non-legacy Spark)
+                    return 0, False
+                vx = max((x * x).sum() - x.sum() ** 2 / n_, 0.0)
+                vy = max((y * y).sum() - y.sum() ** 2 / n_, 0.0)
+                den = np.sqrt(vx * vy)
+                return (cov / den if den > 0 else np.nan), True
+            if b.sample:
+                if n_ < 2:  # NULL for n==1 (non-legacy Spark)
+                    return 0, False
+                return cov / (n_ - 1), True
+            return cov / n_, True
         sel = idx if cv is None else idx[cv[idx]]
         if isinstance(b, A.CountStar):
             return len(idx), True
@@ -253,6 +293,27 @@ class CpuOpExec(TpuExec):
             if src.is_decimal:
                 xf = xf / 10 ** src.scale
             return xf.mean(), True
+        if isinstance(b, A._CentralMoment):
+            src = b.children[0].dtype
+            xf = x.astype(np.float64)
+            if src.is_decimal:
+                xf = xf / 10 ** src.scale
+            n_ = float(len(xf))
+            m2 = max((xf * xf).sum() - xf.sum() ** 2 / n_, 0.0)
+            if b.sample:
+                if n_ < 2:  # NULL for n==1 (non-legacy Spark)
+                    return 0, False
+                var = m2 / (n_ - 1)
+            else:
+                var = m2 / n_
+            return (np.sqrt(var) if b.sqrt else var), True
+        if isinstance(b, A.Percentile):
+            src = b.children[0].dtype
+            xf = x.astype(np.float64)
+            if src.is_decimal:
+                xf = xf / 10 ** src.scale
+            return float(np.percentile(xf, b.q * 100.0,
+                                       method="linear")), True
         if isinstance(b, A.Last):
             pick = idx if not b.ignore_nulls else sel
             i = pick[-1]
@@ -263,10 +324,9 @@ class CpuOpExec(TpuExec):
             return cd[i], (cv is None or cv[i])
         raise NotImplementedError(type(b).__name__)
 
-    def _agg_scalar(self, b, child_val, n):
+    def _agg_scalar(self, b, child_vals, n):
         idx = np.arange(n)
-        cd, cv = child_val
-        val, ok = self._agg_one(b, cd, cv, idx)
+        val, ok = self._agg_one(b, child_vals, idx)
         return (np.array([val], dtype=self._agg_np_dtype(b)),
                 None if ok else np.array([False]))
 

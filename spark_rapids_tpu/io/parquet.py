@@ -36,17 +36,68 @@ __all__ = ["parquet_schema", "parquet_source", "expand_paths", "ParquetSource",
 Predicate = Tuple[str, str, object]
 
 
-def expand_paths(path) -> List[str]:
+def expand_paths(path, ext: str = ".parquet") -> List[str]:
     if isinstance(path, (list, tuple)):
         out: List[str] = []
         for p in path:
-            out += expand_paths(p)
+            out += expand_paths(p, ext)
         return out
     if os.path.isdir(path):
-        return sorted(_glob.glob(os.path.join(path, "*.parquet")))
+        # recursive: picks up hive-partitioned layouts (p=1/part-....parquet)
+        return sorted(_glob.glob(os.path.join(path, "**", f"*{ext}"),
+                                 recursive=True))
     if any(ch in path for ch in "*?["):
         return sorted(_glob.glob(path))
     return [path]
+
+
+def hive_partition_values(root, paths: List[str]):
+    """Infer hive-style ``key=value`` partition columns from file paths.
+
+    Returns ``(part_names, {path: {name: raw_string}})``; empty when the
+    layout is not partitioned.  Mirrors Spark's partition discovery used by
+    the reference's file scans (GpuFileSourceScanExec relies on Spark's
+    PartitioningAwareFileIndex).
+    """
+    if not isinstance(root, str) or not os.path.isdir(root):
+        return [], {}
+    rootp = os.path.abspath(root)
+    names: List[str] = []
+    per_path = {}
+    for p in paths:
+        rel = os.path.relpath(os.path.abspath(p), rootp)
+        kv = {}
+        for comp in rel.split(os.sep)[:-1]:
+            if "=" in comp:
+                k, _, v = comp.partition("=")
+                # the writer's null sentinel reads back as NULL, like Spark
+                kv[k] = None if v == "__HIVE_DEFAULT_PARTITION__" else v
+                if k not in names:
+                    names.append(k)
+        per_path[p] = kv
+    if not names:
+        return [], {}
+    return names, per_path
+
+
+def _infer_partition_type(values):
+    """Narrowest of int64/float64/string fitting every non-null value
+    (None = null sentinel or a file outside the partitioned layout)."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return "string"
+    try:
+        for v in present:
+            int(v)
+        return "int64"
+    except ValueError:
+        pass
+    try:
+        for v in present:
+            float(v)
+        return "float64"
+    except ValueError:
+        return "string"
 
 
 def parquet_schema(paths: List[str], columns: Optional[List[str]] = None) -> Schema:
@@ -173,6 +224,15 @@ class ParquetSource:
         self.paths = _paths if _paths is not None else expand_paths(path)
         if not self.paths:
             raise FileNotFoundError(f"no parquet files match {path!r}")
+        self.part_names, self._part_vals = hive_partition_values(
+            path, self.paths)
+        self._part_types = {
+            n: _infer_partition_type([self._part_vals[p].get(n)
+                                      for p in self.paths])
+            for n in self.part_names}
+        self._part_nullable = {
+            n: any(self._part_vals[p].get(n) is None for p in self.paths)
+            for n in self.part_names}
         self.columns = list(columns) if columns is not None else None
         self.predicates = list(predicates or [])
         self.batch_rows = batch_rows
@@ -181,7 +241,20 @@ class ParquetSource:
         self.exact_filter = exact_filter
 
     def schema(self) -> Schema:
-        return parquet_schema(self.paths, self.columns)
+        file_cols = None
+        if self.columns is not None:
+            file_cols = [c for c in self.columns if c not in self.part_names]
+        sch = parquet_schema(self.paths, file_cols)
+        if not self.part_names:
+            return sch
+        from .. import types as T
+        logical = {"int64": T.INT64, "float64": T.FLOAT64, "string": T.STRING}
+        fields = list(sch.fields)
+        for n in self.part_names:  # Spark appends partition cols at the end
+            if self.columns is None or n in self.columns:
+                fields.append(Field(n, logical[self._part_types[n]],
+                                    self._part_nullable[n]))
+        return Schema(fields)
 
     def with_pushdown(self, columns: Optional[List[str]],
                       predicates: Optional[List[Predicate]]) -> "ParquetSource":
@@ -220,18 +293,67 @@ class ParquetSource:
         return d
 
     # -- reading ------------------------------------------------------------------
+    def _typed_part_value(self, name: str, raw):
+        if raw is None:
+            return None
+        t = self._part_types.get(name, "string")
+        if t == "int64":
+            return int(raw)
+        if t == "float64":
+            return float(raw)
+        return raw
+
+    def _partition_match(self, path: str, preds) -> bool:
+        """File-level partition pruning: skip files whose ``key=value`` path
+        components cannot satisfy a pushed conjunct."""
+        import operator as _op
+        cmp = {"<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge,
+               "==": _op.eq, "!=": _op.ne}
+        kv = self._part_vals.get(path, {})
+        for name, op, value in preds:
+            if name not in kv:
+                continue
+            pv = self._typed_part_value(name, kv[name])
+            if pv is None:
+                # comparison/in with NULL is never true; pushed conjuncts
+                # come from real filters, so null-partition files can't match
+                return False
+            try:
+                if op == "in":
+                    if pv not in value:
+                        return False
+                elif op == "isnotnull":
+                    continue
+                elif op in cmp and not cmp[op](pv, value):
+                    return False
+            except TypeError:
+                continue
+        return True
+
     def _read_file(self, path: str) -> Iterator:
         import pyarrow as pa
         import pyarrow.parquet as pq
+        part_kv = self._part_vals.get(path, {})
+        file_preds = [p for p in self.predicates
+                      if p[0] not in self.part_names]
+        if not self._partition_match(path, self.predicates):
+            return
         cache = None
         key = None
         if self.cache_bytes > 0:
             from .filecache import FileCache, get_file_cache
             cache = get_file_cache(self.cache_bytes)
         pf = pq.ParquetFile(path)
-        rgs = prune_row_groups(pf, self.predicates)
-        pred_key = tuple((n, op, str(v)) for n, op, v in self.predicates) \
-            if (self.exact_filter and self.predicates) else None
+        rgs = prune_row_groups(pf, file_preds)
+        pred_key = tuple((n, op, str(v)) for n, op, v in file_preds) \
+            if (self.exact_filter and file_preds) else None
+        # every partition column appears in every file's output (missing in
+        # this file's path → null), keeping batch schemas concatenatable
+        part_cols = [(n, self._typed_part_value(n, part_kv.get(n)))
+                     for n in self.part_names
+                     if self.columns is None or n in self.columns]
+        file_columns = None if self.columns is None else \
+            [c for c in self.columns if c not in self.part_names]
         if cache is not None:
             from .filecache import FileCache
             key = FileCache.key_for(path, self.columns, rgs)
@@ -245,11 +367,18 @@ class ParquetSource:
         if not rgs:
             return
         acc = [] if (cache is not None and key is not None) else None
+        arrow_part = {"int64": pa.int64(), "float64": pa.float64(),
+                      "string": pa.string()}
         for rb in pf.iter_batches(batch_size=self.batch_rows, row_groups=rgs,
-                                  columns=self.columns, use_threads=True):
+                                  columns=file_columns, use_threads=True):
             t = pa.Table.from_batches([rb])
-            if self.exact_filter and self.predicates:
-                mask = _exact_filter_mask(t, self.predicates)
+            for n, v in part_cols:
+                ty = arrow_part[self._part_types[n]]
+                col = (pa.nulls(t.num_rows, type=ty) if v is None
+                       else pa.repeat(pa.scalar(v, type=ty), t.num_rows))
+                t = t.append_column(n, col)
+            if self.exact_filter and file_preds:
+                mask = _exact_filter_mask(t, file_preds)
                 if mask is not None:
                     t = t.filter(mask)
                     if t.num_rows == 0:

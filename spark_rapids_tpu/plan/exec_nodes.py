@@ -212,6 +212,90 @@ def _sort_perm(key_exprs, desc, nf):
     return _cached_program("sort|" + fp, build)
 
 
+class TopKExec(SortExec):
+    """TakeOrderedAndProject analog (limit.scala GpuTopN): a running top-k
+    kept on device.  Each input batch is sorted and clipped to k rows, then
+    merged (concat → sort → clip) into the running buffer — so peak HBM is
+    one batch plus k rows, never the whole input, and every step is a
+    static-shape XLA program.  ``offset`` rows are dropped at the end
+    (Spark's Limit-with-offset on sorted input)."""
+
+    def __init__(self, child: TpuExec,
+                 orders: List[Tuple[Expression, bool, bool]],
+                 n: int, offset: int = 0):
+        super().__init__(child, orders)
+        self.n = n
+        self.offset = offset
+
+    def node_desc(self):
+        return (f"TpuTopK {self.n} [{len(self.orders)} keys]"
+                + (f" offset {self.offset}" if self.offset else ""))
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        from ..memory.retry import with_retry
+        m = ctx.metric_set(self.op_id)
+        k = self.n + self.offset
+        top: ColumnBatch = None
+
+        def _clip(b: ColumnBatch) -> ColumnBatch:
+            return batch_utils.slice_batch(b, 0, min(k, b.num_rows)) \
+                if b.num_rows > k else b
+
+        for batch in self.children[0].execute(ctx):
+            with m.time("opTime"):
+                for srt in with_retry(
+                        ctx, batch,
+                        lambda b: _clip(self._sort_batch(
+                            batch_utils.compact(b)))):
+                    if srt.num_rows == 0:
+                        continue
+                    if top is None:
+                        top = srt
+                    else:
+                        merged = batch_utils.compact(
+                            batch_utils.concat_batches([top, srt]))
+                        top = _clip(self._sort_batch(merged))
+        if top is None:
+            return
+        take = top.num_rows - self.offset
+        if take <= 0:
+            return
+        if self.offset > 0:
+            top = batch_utils.slice_batch(top, self.offset, take)
+        m.add("numOutputRows", top.num_rows)
+        yield top
+
+
+class SampleExec(TpuExec):
+    """Bernoulli sample (GpuSampleExec, basicPhysicalOperators.scala Sample):
+    a per-row uniform draw folded into the batch's selection mask — zero
+    data movement, the mask fuses into whatever consumes the batch."""
+
+    def __init__(self, child: TpuExec, fraction: float, seed: int):
+        super().__init__([child])
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return f"TpuSample {self.fraction} seed={self.seed}"
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        m = ctx.metric_set(self.op_id)
+        for idx, batch in enumerate(self.children[0].execute(ctx)):
+            with m.time("opTime"):
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(self.seed), idx)
+                u = jax.random.uniform(key, (batch.capacity,))
+                keep = u < self.fraction
+                sel = keep if batch.sel is None else (batch.sel & keep)
+                yield ColumnBatch(batch.schema, batch.columns,
+                                  batch.num_rows, sel=sel)
+
+
 class LimitExec(TpuExec):
     def __init__(self, child: TpuExec, n: int, offset: int = 0):
         super().__init__([child])

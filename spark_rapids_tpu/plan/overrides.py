@@ -140,6 +140,11 @@ class NodeMeta:
                     self.will_not_work(
                         f"aggregate {name} is not a plain aggregate call")
                     continue
+                if not getattr(b, "device_supported", True):
+                    self.will_not_work(
+                        f"aggregate {name}: {b.func} requires materialized "
+                        f"groups (CPU only)")
+                    continue
                 for c in b.children:
                     for r in expr_reasons(c, allow_string_passthrough=False):
                         self.will_not_work(f"aggregate {name}: {r}")
@@ -151,7 +156,8 @@ class NodeMeta:
                 for r in expr_reasons(b, allow_string_passthrough=False):
                     self.will_not_work(f"sort key: {r}")
             return
-        if isinstance(p, (L.Limit, L.Union, L.LogicalRange, L.Distinct)):
+        if isinstance(p, (L.Limit, L.Union, L.LogicalRange, L.Distinct,
+                          L.Sample)):
             # Distinct groups by bare column references — string columns
             # go through dictionary codes like any group key
             return
@@ -318,8 +324,22 @@ def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
         return SortExec(child_phys, orders)
 
     if isinstance(p, L.Limit):
-        from .exec_nodes import LimitExec
-        return LimitExec(_convert(meta.children[0], conf), p.n, p.offset)
+        from .exec_nodes import LimitExec, TopKExec
+        child_meta = meta.children[0]
+        if isinstance(child_meta.plan, L.Sort) and child_meta.on_tpu:
+            # Limit(Sort) ⇒ running top-k (TakeOrderedAndProject / GpuTopN)
+            sort_plan = child_meta.plan
+            grandchild = _convert(child_meta.children[0], conf)
+            schema = grandchild.output_schema
+            orders = [(bind(o.expr, schema), o.ascending, o.nulls_first)
+                      for o in sort_plan.orders]
+            return TopKExec(grandchild, orders, p.n, p.offset)
+        return LimitExec(_convert(child_meta, conf), p.n, p.offset)
+
+    if isinstance(p, L.Sample):
+        from .exec_nodes import SampleExec
+        return SampleExec(_convert(meta.children[0], conf),
+                          p.fraction, p.seed)
 
     if isinstance(p, L.Union):
         from .exec_nodes import UnionExec

@@ -163,6 +163,14 @@ class DataFrame:
     def offset(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(self._plan, 1 << 62, offset=n), self.session)
 
+    def sample(self, fraction: float, seed: Optional[int] = None
+               ) -> "DataFrame":
+        """Bernoulli row sample without replacement (SampleExec)."""
+        if seed is None:
+            import random
+            seed = random.randint(0, 2 ** 31 - 1)
+        return DataFrame(L.Sample(self._plan, fraction, seed), self.session)
+
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(L.Union([self._plan, other._plan]), self.session)
 
@@ -192,6 +200,13 @@ class DataFrame:
     crossJoin = cross_join
 
     # -- actions ------------------------------------------------------------------
+    @property
+    def write(self):
+        """Write builder: ``df.write.mode("overwrite").parquet(path)``
+        (ColumnarOutputWriter.scala:69 analog; io/writers.py)."""
+        from ..io.writers import DataFrameWriter
+        return DataFrameWriter(self)
+
     def _executed(self):
         return self.session._execute(self._plan)
 

@@ -29,6 +29,10 @@ __all__ = [
     "contains", "like", "rlike", "regexp_extract", "regexp_replace",
     "replace", "lpad", "rpad", "repeat", "locate", "instr",
     "substring_index",
+    # statistical aggregates
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
+    "var_pop", "corr", "covar_pop", "covar_samp", "percentile",
+    "percentile_approx",
 ]
 
 def col(name: str) -> Column:
@@ -503,3 +507,51 @@ def instr(c, substr):
 def substring_index(c, delim, count):
     return Column(_strmod().SubstringIndex(
         _colref(c), _val(delim), _val(count)))
+
+
+# ------------------------------------------------------------------------------------
+# Statistical aggregates (AggregateFunctions.scala analogs)
+# ------------------------------------------------------------------------------------
+
+def stddev(c) -> Column:
+    return Column(A.StddevSamp(to_expr(_colref(c))))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Column:
+    return Column(A.StddevPop(to_expr(_colref(c))))
+
+
+def variance(c) -> Column:
+    return Column(A.VarianceSamp(to_expr(_colref(c))))
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Column:
+    return Column(A.VariancePop(to_expr(_colref(c))))
+
+
+def corr(x, y) -> Column:
+    return Column(A.Corr(_colref(x), _colref(y)))
+
+
+def covar_pop(x, y) -> Column:
+    return Column(A.CovarPop(_colref(x), _colref(y)))
+
+
+def covar_samp(x, y) -> Column:
+    return Column(A.CovarSamp(_colref(x), _colref(y)))
+
+
+def percentile(c, q: float) -> Column:
+    return Column(A.Percentile(_colref(c), q))
+
+
+def percentile_approx(c, q: float, accuracy: int = 10000) -> Column:
+    """Exact percentile stand-in (better accuracy than the reference's
+    t-digest GpuApproximatePercentile; runs on the CPU operator)."""
+    return Column(A.Percentile(_colref(c), q))

@@ -83,15 +83,31 @@ class Session:
         node.source = src
         return DataFrame(node, self)
 
+    def _file_source_df(self, cls, path, columns=None, **options) -> DataFrame:
+        conf = self._tpu_conf()
+        src = cls(path, columns=columns,
+                  batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"],
+                  num_threads=conf[
+                      "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
+                  **options)
+        node = L.LogicalScan(src.schema(), src, src.describe(), fmt=src.fmt)
+        node.source = src
+        return DataFrame(node, self)
+
     def read_csv(self, path, schema=None, header: bool = True, sep: str = ","
                  ) -> DataFrame:
-        from ..io.csv import csv_source
-        conf = self._tpu_conf()
-        out_schema, factory = csv_source(
-            path, schema=schema, header=header, sep=sep,
-            batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"])
-        node = L.LogicalScan(out_schema, factory, str(path), fmt="csv")
-        return DataFrame(node, self)
+        from ..io.sources import CsvSource
+        return self._file_source_df(CsvSource, path, schema=schema,
+                                    header=header, sep=sep)
+
+    def read_orc(self, path, columns=None) -> DataFrame:
+        from ..io.sources import OrcSource
+        return self._file_source_df(OrcSource, path, columns=columns)
+
+    def read_json(self, path, schema=None) -> DataFrame:
+        """Line-delimited JSON (Spark's default JSON source)."""
+        from ..io.sources import JsonSource
+        return self._file_source_df(JsonSource, path, schema=schema)
 
     def create_dataframe(self, data, schema=None) -> DataFrame:
         """From a pandas DataFrame, pyarrow Table, or dict of arrays."""
@@ -135,6 +151,16 @@ class Session:
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
         return CollectExec(phys).collect_arrow(ctx)
+
+    def _execute_batches(self, plan: L.LogicalPlan):
+        """Stream the result as pyarrow Tables, one per output batch —
+        the write path's entry so results never materialize wholesale."""
+        from ..batch import to_arrow
+        conf = self._tpu_conf()
+        phys = self._plan_physical(plan)
+        ctx = ExecContext(conf, device=self.device)
+        for b in phys.execute(ctx):
+            yield to_arrow(b)
 
     def _explain(self, plan: L.LogicalPlan) -> str:
         from ..plan.overrides import explain_plan

@@ -2,6 +2,7 @@
 
 import numpy as np
 import pandas as pd
+import pyarrow as pa
 import pytest
 
 from .support import (DoubleGen, IntGen, LongGen, StringGen,
@@ -121,3 +122,91 @@ def test_float_key_nan_groups_merge(session):
         by_key[key] = s
     assert by_key["nan"] == 3      # NaN normalized to one group
     assert by_key[0.0] == 9        # -0.0 and 0.0 merge
+
+
+class TestStatisticalAggregates:
+    """stddev/variance/corr/covar/percentile vs pandas (AggregateFunctions
+    .scala stat-agg family)."""
+
+    @pytest.fixture(scope="class")
+    def stat_df(self, session, rng):
+        from .support import DoubleGen, IntGen, gen_table
+        table, pdf = gen_table(rng, {
+            "g": IntGen(lo=0, hi=4, dtype="int32", nullable=False),
+            "x": DoubleGen(special=False, nullable=False),
+            "y": DoubleGen(special=False, nullable=False),
+        }, 400)
+        return session.create_dataframe(table), pdf
+
+    def test_grouped_stddev_variance(self, stat_df):
+        f = F()
+        df, pdf = stat_df
+        out = df.group_by("g").agg(
+            f.stddev(f.col("x")).alias("ss"),
+            f.stddev_pop(f.col("x")).alias("sp"),
+            f.variance(f.col("x")).alias("vs"),
+            f.var_pop(f.col("x")).alias("vp"))
+        plan = out.explain_string()
+        assert not any(ln.strip().startswith("!")
+                       for ln in plan.splitlines()[2:]), plan
+        got = {r[0]: r[1:] for r in out.collect()}
+        g = pdf.groupby("g")["x"]
+        for k in g.groups:
+            ss, sp, vs, vp = got[k]
+            import math
+            for got_v, exp_v in [(ss, g.get_group(k).std(ddof=1)),
+                                 (sp, g.get_group(k).std(ddof=0)),
+                                 (vs, g.get_group(k).var(ddof=1)),
+                                 (vp, g.get_group(k).var(ddof=0))]:
+                assert math.isclose(got_v, exp_v, rel_tol=1e-9), (k, got_v,
+                                                                 exp_v)
+
+    def test_ungrouped_corr_covar(self, stat_df):
+        f = F()
+        df, pdf = stat_df
+        got = df.agg(f.corr("x", "y").alias("c"),
+                     f.covar_pop("x", "y").alias("cp"),
+                     f.covar_samp("x", "y").alias("cs")).collect()[0]
+        exp_c = pdf["x"].corr(pdf["y"])
+        exp_cs = pdf["x"].cov(pdf["y"])
+        n = len(pdf)
+        exp_cp = exp_cs * (n - 1) / n
+        assert abs(got[0] - exp_c) < 1e-9
+        import math
+        assert math.isclose(got[1], exp_cp, rel_tol=1e-9)
+        assert math.isclose(got[2], exp_cs, rel_tol=1e-9)
+
+    def test_stddev_single_row_is_null(self, session):
+        f = F()
+        import math
+        t = pa.table({"g": pa.array([1, 1, 2], type=pa.int64()),
+                      "x": pa.array([1.0, 3.0, 5.0])})
+        df = session.create_dataframe(t)
+        got = dict(df.group_by("g").agg(
+            f.stddev(f.col("x")).alias("s")).collect())
+        assert abs(got[1] - math.sqrt(2.0)) < 1e-12
+        # n==1 → NULL (Spark 3.1+ default, legacy.statisticalAggregate off)
+        assert got[2] is None
+
+    def test_percentile_cpu_fallback(self, session, rng):
+        f = F()
+        import numpy as np
+        vals = rng.random(101).tolist()
+        df = session.create_dataframe(pa.table({"x": vals}))
+        out = df.agg(f.percentile(f.col("x"), 0.5).alias("p"))
+        plan = out.explain_string()
+        assert "CPU only" in plan  # tagged fallback, not a crash
+        got = out.collect()[0][0]
+        assert abs(got - float(np.percentile(vals, 50.0))) < 1e-12
+
+    def test_corr_with_nulls_pairwise(self, session):
+        f = F()
+        t = pa.table({
+            "x": pa.array([1.0, 2.0, None, 4.0, 5.0]),
+            "y": pa.array([2.0, None, 3.0, 8.0, 10.0]),
+        })
+        df = session.create_dataframe(t)
+        got = df.agg(f.corr("x", "y").alias("c")).collect()[0][0]
+        import pandas as pd
+        pdf = pd.DataFrame({"x": [1.0, 4.0, 5.0], "y": [2.0, 8.0, 10.0]})
+        assert abs(got - pdf["x"].corr(pdf["y"])) < 1e-12

@@ -1,0 +1,190 @@
+"""Write path: parquet/csv round trips, modes, dynamic partitioning,
+file rolling (ParquetWriterSuite / GpuFileFormatDataWriter analog)."""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from .support import DoubleGen, IntGen, StringGen, assert_rows_equal, gen_table
+
+
+def F():
+    from spark_rapids_tpu.sql import functions
+    return functions
+
+
+@pytest.fixture()
+def wdf(session, rng):
+    table, pdf = gen_table(rng, {
+        "p": IntGen(lo=0, hi=3, dtype="int32", nullable=False),
+        "s": StringGen(alphabet="abc", max_len=3, nullable=True),
+        "v": DoubleGen(special=False, nullable=False),
+    }, 300)
+    return session.create_dataframe(table), pdf
+
+
+def test_parquet_roundtrip(wdf, tmp_path, session):
+    df, pdf = wdf
+    out = str(tmp_path / "out")
+    stats = df.write.parquet(out)
+    assert stats.num_rows == len(pdf)
+    assert stats.num_files >= 1 and stats.num_bytes > 0
+    back = session.read_parquet(os.path.join(out, "*.parquet"))
+    got = back.collect()
+    exp = [(int(p), None if s is pd.NA else s, float(v))
+           for p, s, v in zip(pdf["p"], pdf["s"], pdf["v"])]
+    assert_rows_equal(got, exp)
+
+
+def test_transform_then_write(wdf, tmp_path, session):
+    f = F()
+    df, pdf = wdf
+    out = str(tmp_path / "out")
+    df.filter(f.col("v") > 0).select("p", (f.col("v") * 2).alias("w")) \
+        .write.parquet(out)
+    got = pq.read_table(os.path.join(out)).to_pandas()
+    exp = pdf[pdf["v"] > 0]
+    assert len(got) == len(exp)
+    assert abs(got["w"].sum() - 2 * exp["v"].sum()) < 1e-6
+
+
+def test_write_modes(wdf, tmp_path):
+    df, _ = wdf
+    out = str(tmp_path / "out")
+    df.write.parquet(out)
+    with pytest.raises(FileExistsError):
+        df.write.parquet(out)
+    n1 = len(glob.glob(os.path.join(out, "*.parquet")))
+    df.write.mode("append").parquet(out)
+    n2 = len(glob.glob(os.path.join(out, "*.parquet")))
+    assert n2 > n1
+    df.write.mode("overwrite").parquet(out)
+    t = pq.read_table(out)
+    assert t.num_rows == 300  # overwrite dropped the appended copy
+    df.write.mode("ignore").parquet(out)
+    assert pq.read_table(out).num_rows == 300
+
+
+def test_dynamic_partitioning(wdf, tmp_path, session):
+    df, pdf = wdf
+    out = str(tmp_path / "out")
+    stats = df.write.partitionBy("p").parquet(out)
+    dirs = sorted(os.path.basename(d) for d in glob.glob(
+        os.path.join(out, "p=*")))
+    exp_parts = sorted(f"p={v}" for v in pdf["p"].unique())
+    assert dirs == exp_parts
+    # per-partition contents hold only that partition's rows, without the
+    # partition column itself
+    for v in pdf["p"].unique():
+        t = pq.read_table(os.path.join(out, f"p={v}"))
+        assert "p" not in t.column_names
+        assert t.num_rows == int((pdf["p"] == v).sum())
+    assert stats.num_rows == len(pdf)
+
+
+def test_partitioned_read_back(wdf, tmp_path, session):
+    """Hive-style partition discovery: the partition column is recovered
+    from ``p=<v>`` path components (appended last, Spark layout), typed by
+    inference, and partition-only predicates prune whole files."""
+    f = F()
+    df, pdf = wdf
+    out = str(tmp_path / "out")
+    df.write.partitionBy("p").parquet(out)
+    back = session.read_parquet(out)
+    names = [fl.name for fl in back.schema]
+    assert names[-1] == "p"
+    got = back.select("p", "v").collect()
+    exp = [(int(p), float(v)) for p, v in zip(pdf["p"], pdf["v"])]
+    assert_rows_equal(sorted(got), sorted(exp))
+    # int-typed partition value + pruning predicate
+    some = int(pdf["p"].unique()[0])
+    got2 = back.filter(f.col("p") == some).select("v").collect()
+    assert len(got2) == int((pdf["p"] == some).sum())
+
+
+def test_partitioned_read_string_key(session, tmp_path):
+    t = pa.table({"s": pa.array(["x", "y", "x", "z"]),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0])})
+    out = str(tmp_path / "o")
+    session.create_dataframe(t).write.partitionBy("s").parquet(out)
+    f = F()
+    back = session.read_parquet(out)
+    rows = back.filter(f.col("s") != "x").collect()
+    assert sorted(rows) == [(2.0, "y"), (4.0, "z")]
+
+
+def test_partition_null_and_nan_round_trip(session, tmp_path):
+    """NULL partition values go to __HIVE_DEFAULT_PARTITION__ and read back
+    as typed nulls; NaN float keys keep their rows (NaN==NaN is false under
+    pc.equal, which previously dropped them silently)."""
+    t = pa.table({"p": pa.array([1, 2, None], type=pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0])})
+    out = str(tmp_path / "nulls")
+    session.create_dataframe(t).write.partitionBy("p").parquet(out)
+    assert os.path.isdir(os.path.join(out, "p=__HIVE_DEFAULT_PARTITION__"))
+    back = session.read_parquet(out)
+    sch = {f.name: f for f in back.schema}
+    assert str(sch["p"].dtype) == "bigint" and sch["p"].nullable
+    rows = sorted(back.collect(), key=str)
+    assert rows == [(1.0, 1), (2.0, 2), (3.0, None)]
+
+    nan = float("nan")
+    t2 = pa.table({"p": pa.array([1.0, nan, 2.0]),
+                   "v": pa.array([10.0, 20.0, 30.0])})
+    out2 = str(tmp_path / "nans")
+    stats = session.create_dataframe(t2).write.partitionBy("p").parquet(out2)
+    assert stats.num_rows == 3  # NaN row not dropped
+    vs = sorted(r[0] for r in session.read_parquet(out2).select("v").collect())
+    assert vs == [10.0, 20.0, 30.0]
+
+
+def test_mixed_layout_read(session, tmp_path):
+    """Root-level files alongside key=value subdirectories: the partition
+    column is null for the un-partitioned files and batches still concat."""
+    root = str(tmp_path / "mix")
+    os.makedirs(os.path.join(root, "p=1"))
+    pq.write_table(pa.table({"v": pa.array([1.0, 2.0])}),
+                   os.path.join(root, "root.parquet"))
+    pq.write_table(pa.table({"v": pa.array([3.0])}),
+                   os.path.join(root, "p=1", "a.parquet"))
+    rows = sorted(session.read_parquet(root).collect(), key=str)
+    assert rows == [(1.0, None), (2.0, None), (3.0, 1)]
+
+
+def test_max_records_per_file(wdf, tmp_path):
+    df, pdf = wdf
+    out = str(tmp_path / "out")
+    df.write.option("maxRecordsPerFile", 100).parquet(out)
+    files = glob.glob(os.path.join(out, "*.parquet"))
+    assert len(files) == 3  # 300 rows / 100
+    assert all(pq.read_table(f).num_rows <= 100 for f in files)
+
+
+def test_csv_roundtrip(session, tmp_path):
+    t = pa.table({"a": pa.array([1, 2, 3], type=pa.int64()),
+                  "b": pa.array([1.5, 2.5, -3.0])})
+    df = session.create_dataframe(t)
+    out = str(tmp_path / "out")
+    df.write.csv(out)
+    files = glob.glob(os.path.join(out, "*.csv"))
+    assert len(files) == 1
+    import pyarrow.csv as pacsv
+    back = pacsv.read_csv(files[0])
+    assert back.to_pydict() == t.to_pydict()
+
+
+def test_empty_result_writes_schema_file(session, tmp_path):
+    f = F()
+    t = pa.table({"a": pa.array([1, 2], type=pa.int64())})
+    df = session.create_dataframe(t).filter(f.col("a") > 100)
+    out = str(tmp_path / "out")
+    df.write.parquet(out)
+    files = glob.glob(os.path.join(out, "*.parquet"))
+    assert len(files) == 1
+    back = pq.read_table(files[0])
+    assert back.num_rows == 0 and back.column_names == ["a"]
