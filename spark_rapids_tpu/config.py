@@ -108,6 +108,13 @@ MIN_CAPACITY = register(
     "power-of-two buckets no smaller than this, bounding executable-cache "
     "cardinality (one compile per op-shape bucket).")
 
+DEVICE_PLATFORM = register(
+    "spark.rapids.tpu.device.platform", "",
+    "Force a jax platform for device selection (e.g. 'tpu', 'cpu'). "
+    "Empty = prefer tpu, else the default backend "
+    "(GpuDeviceManager.scala:150 device-acquisition analog).",
+    startup_only=True)
+
 CONCURRENT_TASKS = register(
     "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
     "Number of tasks that may hold the TPU semaphore concurrently. The TPU "

@@ -46,6 +46,24 @@ def eval_cpu(expr: E.Expression, arrays, n: int) -> Value:
         return np.full(n, v, dtype=_np_dtype(expr.dtype)), None
     if isinstance(expr, E.Alias) or type(expr).__name__ == "_AliasMarker":
         return ev(expr.children[0])
+    from ..udf import UserDefinedFunction
+    if isinstance(expr, UserDefinedFunction):
+        child_values = [ev(c) for c in expr.children]
+        if expr.device:
+            # jax-traceable fn also runs fine eagerly on host arrays
+            import jax.numpy as jnp
+            datas, valid = [], None
+            for (d, v) in child_values:
+                datas.append(jnp.asarray(d))
+                valid = _and(valid, v)
+            out = expr.fn(*datas)
+            if isinstance(out, tuple):
+                data, fv = out
+                valid = _and(valid, None if fv is None else np.asarray(fv))
+            else:
+                data = out
+            return np.asarray(data, dtype=_np_dtype(expr.dtype)), valid
+        return expr.eval_rows(child_values, n)
     if isinstance(expr, E.Cast):
         d, v = ev(expr.children[0])
         return _cast_cpu(d, v, expr.children[0].dtype, expr.dtype)

@@ -296,6 +296,38 @@ class SampleExec(TpuExec):
                                   batch.num_rows, sel=sel)
 
 
+class CacheExec(TpuExec):
+    """First run materializes the child into spillable handles owned by the
+    logical Cache node; later runs replay them (GpuInMemoryTableScanExec +
+    ParquetCachedBatchSerializer analog, device-resident instead of
+    parquet-encoded)."""
+
+    def __init__(self, child: TpuExec, cache_node):
+        super().__init__([child])
+        self.cache_node = cache_node
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return self.cache_node.node_desc()
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        from ..memory.spill import get_catalog
+        node = self.cache_node
+        with node.lock:
+            if node.materialized is None:
+                catalog = get_catalog(ctx.conf)
+                handles = []
+                for b in self.children[0].execute(ctx):
+                    handles.append(catalog.register(
+                        batch_utils.compact(b), priority=1))
+                node.materialized = handles
+        for h in node.materialized:
+            yield h.get()
+
+
 class LimitExec(TpuExec):
     def __init__(self, child: TpuExec, n: int, offset: int = 0):
         super().__init__([child])

@@ -199,6 +199,32 @@ class LogicalRange(LogicalPlan):
         return f"Range ({self.start}, {self.end}, step={self.step})"
 
 
+class Cache(LogicalPlan):
+    """df.cache() — materialized batches live in the spill catalog as
+    spillable handles (ParquetCachedBatchSerializer.scala:264 analog: the
+    reference serializes cached batches as in-memory parquet; here they
+    stay device-resident and spill to host/disk under memory pressure)."""
+
+    def __init__(self, child: LogicalPlan):
+        self.children = (child,)
+        self.materialized = None  # List[SpillableBatch] after first run
+        self.lock = __import__("threading").Lock()
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()
+
+    def unpersist(self) -> None:
+        with self.lock:
+            if self.materialized is not None:
+                for h in self.materialized:
+                    h.close()
+                self.materialized = None
+
+    def node_desc(self):
+        state = "materialized" if self.materialized else "lazy"
+        return f"InMemoryCache [{state}]"
+
+
 class Sample(LogicalPlan):
     def __init__(self, child: LogicalPlan, fraction: float, seed: int = 0):
         self.children = (child,)

@@ -47,6 +47,12 @@ def expr_reasons(e: Expression, allow_string_passthrough: bool = True
         return reasons
 
     def walk(node: Expression):
+        from ..udf import UserDefinedFunction
+        if isinstance(node, UserDefinedFunction) and not node.device:
+            reasons.append(
+                f"python UDF {node.name} is opaque to the planner "
+                f"(runs on CPU; use tpu_udf for a device implementation)")
+            return
         dt = node.dtype
         if dt is not None:
             if dt.is_string:
@@ -157,7 +163,7 @@ class NodeMeta:
                     self.will_not_work(f"sort key: {r}")
             return
         if isinstance(p, (L.Limit, L.Union, L.LogicalRange, L.Distinct,
-                          L.Sample)):
+                          L.Sample, L.Cache)):
             # Distinct groups by bare column references — string columns
             # go through dictionary codes like any group key
             return
@@ -340,6 +346,10 @@ def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
         from .exec_nodes import SampleExec
         return SampleExec(_convert(meta.children[0], conf),
                           p.fraction, p.seed)
+
+    if isinstance(p, L.Cache):
+        from .exec_nodes import CacheExec
+        return CacheExec(_convert(meta.children[0], conf), p)
 
     if isinstance(p, L.Union):
         from .exec_nodes import UnionExec

@@ -200,6 +200,12 @@ def _walk(node: L.LogicalPlan, required: Optional[Set[str]],
         return L.Sample(_walk(node.children[0], required, []),
                         node.fraction, node.seed)
 
+    if isinstance(node, L.Cache):
+        # barrier: the node is shared mutable state across queries (it owns
+        # the materialized handles), and its batches must keep the full
+        # schema — never rebuild or prune through it
+        return node
+
     if not node.children:
         return node
     # unknown operator: conservatively require everything below it

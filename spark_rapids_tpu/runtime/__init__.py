@@ -1,0 +1,6 @@
+"""Runtime layer: device manager + task semaphore (SURVEY §2.1)."""
+
+from .device import DeviceManager
+from .semaphore import TpuSemaphore
+
+__all__ = ["DeviceManager", "TpuSemaphore"]

@@ -1,0 +1,102 @@
+"""Device discovery, selection, and initialization guards.
+
+Reference: GpuDeviceManager.scala:150 (initializeGpuAndMemory — device
+acquisition, RMM pool sizing, spill-store bootstrap) and the executor
+plugin's init-time environment guards (Plugin.scala:314-388: compute
+capability check, cudf version check, fatal-error exit).  The TPU redesign:
+PJRT owns allocation, so "pool sizing" becomes computing the spill catalog's
+HBM budget from the backend's reported memory; device selection picks the
+preferred platform (tpu > real cpu) and pins all uploads to one chip.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+log = logging.getLogger("spark_rapids_tpu")
+
+__all__ = ["DeviceManager", "DeviceInfo"]
+
+
+class DeviceInfo:
+    def __init__(self, device, platform: str, memory_bytes: Optional[int]):
+        self.device = device
+        self.platform = platform
+        self.memory_bytes = memory_bytes
+
+    def __repr__(self):
+        mem = (f"{self.memory_bytes / (1 << 30):.1f} GiB"
+               if self.memory_bytes else "unknown mem")
+        return f"DeviceInfo({self.device}, {self.platform}, {mem})"
+
+
+class DeviceManager:
+    """Process-wide device acquisition + init checks (one chip per session,
+    mirroring the reference's one-GPU-per-executor model,
+    Plugin.scala:355-357)."""
+
+    _lock = threading.Lock()
+    _info: Optional[DeviceInfo] = None
+
+    @classmethod
+    def initialize(cls, conf) -> DeviceInfo:
+        with cls._lock:
+            if cls._info is not None:
+                return cls._info
+            import jax
+            requested = conf["spark.rapids.tpu.device.platform"]
+            dev = cls._select_device(jax, requested)
+            cls._check_environment(jax)
+            mem = cls._device_memory(dev)
+            cls._info = DeviceInfo(dev, dev.platform, mem)
+            frac = conf["spark.rapids.tpu.memory.tpu.poolFraction"]
+            budget = int(mem * frac) if mem else None
+            log.info("device initialized: %s (spill budget %s)",
+                     cls._info,
+                     f"{budget / (1 << 30):.1f} GiB" if budget else "default")
+            return cls._info
+
+    @staticmethod
+    def _select_device(jax, requested: str):
+        """Preferred platform order: explicit conf > tpu > anything."""
+        if requested:
+            devs = jax.devices(requested)
+            if not devs:
+                raise RuntimeError(
+                    f"no devices for requested platform {requested!r}")
+            return devs[0]
+        devs = jax.devices()
+        for d in devs:
+            if d.platform == "tpu":
+                return d
+        return devs[0]
+
+    @staticmethod
+    def _check_environment(jax) -> None:
+        """Init-time guards (Plugin.scala:323-352 analog): x64 must be on
+        (FLOAT64/INT64 column parity) or results silently degrade."""
+        if not jax.config.read("jax_enable_x64"):
+            raise RuntimeError(
+                "jax_enable_x64 is off — import spark_rapids_tpu before "
+                "touching jax, or set JAX_ENABLE_X64=1 "
+                "(64-bit columns would silently truncate)")
+
+    @staticmethod
+    def _device_memory(dev) -> Optional[int]:
+        try:
+            stats = dev.memory_stats()
+            return (stats.get("bytes_limit")
+                    or stats.get("bytes_reservable_limit"))
+        except Exception:
+            return None
+
+    @classmethod
+    def info(cls) -> Optional[DeviceInfo]:
+        return cls._info
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._info = None

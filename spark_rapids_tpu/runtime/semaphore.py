@@ -1,0 +1,49 @@
+"""Task semaphore limiting concurrent queries on the device.
+
+Reference: GpuSemaphore.scala:68-160 — ``spark.rapids.sql.concurrentGpuTasks``
+bounds how many tasks hold the device at once (1000 permits split by the
+concurrency level), with wait time surfaced in task metrics.  The TPU
+analog: there are no CUDA streams to oversubscribe, but concurrent Python
+threads submitting XLA programs still contend for HBM; the semaphore bounds
+them and records the wait in :class:`..utils.metrics.TaskMetrics`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+__all__ = ["TpuSemaphore", "get_semaphore"]
+
+
+class TpuSemaphore:
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._sem = threading.BoundedSemaphore(permits)
+
+    @contextlib.contextmanager
+    def acquire(self):
+        from ..utils.metrics import TaskMetrics
+        t0 = time.perf_counter()
+        self._sem.acquire()
+        TaskMetrics.get().semaphore_wait_s += time.perf_counter() - t0
+        try:
+            yield
+        finally:
+            self._sem.release()
+
+
+_lock = threading.Lock()
+_instance: TpuSemaphore = None
+
+
+def get_semaphore(conf) -> TpuSemaphore:
+    """Process-wide semaphore sized by concurrentTpuTasks on first use
+    (re-created if the configured concurrency changes)."""
+    global _instance
+    n = max(1, int(conf["spark.rapids.tpu.sql.concurrentTpuTasks"]))
+    with _lock:
+        if _instance is None or _instance.permits != n:
+            _instance = TpuSemaphore(n)
+        return _instance

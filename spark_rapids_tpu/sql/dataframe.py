@@ -163,6 +163,18 @@ class DataFrame:
     def offset(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(self._plan, 1 << 62, offset=n), self.session)
 
+    def cache(self) -> "DataFrame":
+        """Materialize this result in the spill catalog on first use;
+        later actions replay the cached batches (InMemoryTableScan)."""
+        return DataFrame(L.Cache(self._plan), self.session)
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        if isinstance(self._plan, L.Cache):
+            self._plan.unpersist()
+        return self
+
     def sample(self, fraction: float, seed: Optional[int] = None
                ) -> "DataFrame":
         """Bernoulli row sample without replacement (SampleExec)."""

@@ -555,3 +555,29 @@ def percentile_approx(c, q: float, accuracy: int = 10000) -> Column:
     """Exact percentile stand-in (better accuracy than the reference's
     t-digest GpuApproximatePercentile; runs on the CPU operator)."""
     return Column(A.Percentile(_colref(c), q))
+
+
+# -- user-defined functions (RapidsUDF / GpuUserDefinedFunction analogs) ----------
+def udf(fn=None, *, return_type=None, name=None):
+    """Python UDF — the enclosing operator falls back to CPU (the planner
+    tags it with an explain reason), matching the reference's treatment of
+    opaque Scala UDFs."""
+    from ..udf import udf as _udf
+    kwargs = {}
+    if return_type is not None:
+        kwargs["return_type"] = return_type
+    if name is not None:
+        kwargs["name"] = name
+    return _udf(fn, **kwargs) if fn is not None else _udf(**kwargs)
+
+
+def tpu_udf(fn=None, *, return_type=None, name=None):
+    """Device UDF (RapidsUDF analog): fn is jax-traceable over jnp arrays
+    and fuses into the stage's XLA computation."""
+    from ..udf import tpu_udf as _tpu_udf
+    kwargs = {}
+    if return_type is not None:
+        kwargs["return_type"] = return_type
+    if name is not None:
+        kwargs["name"] = name
+    return _tpu_udf(fn, **kwargs) if fn is not None else _tpu_udf(**kwargs)

@@ -44,8 +44,11 @@ class Session:
 
     def __init__(self, settings: Optional[Dict[str, Any]] = None, device=None):
         self._settings: Dict[str, Any] = dict(settings or {})
-        self.device = device
         self.conf = _RuntimeConf(self)
+        if device is None:
+            from ..runtime.device import DeviceManager
+            device = DeviceManager.initialize(self._tpu_conf()).device
+        self.device = device
 
     @classmethod
     def get_or_create(cls, settings: Optional[Dict[str, Any]] = None,
@@ -147,20 +150,24 @@ class Session:
         return apply_overrides(plan, conf)
 
     def _execute(self, plan: L.LogicalPlan):
+        from ..runtime.semaphore import get_semaphore
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
-        return CollectExec(phys).collect_arrow(ctx)
+        with get_semaphore(conf).acquire():
+            return CollectExec(phys).collect_arrow(ctx)
 
     def _execute_batches(self, plan: L.LogicalPlan):
         """Stream the result as pyarrow Tables, one per output batch —
         the write path's entry so results never materialize wholesale."""
         from ..batch import to_arrow
+        from ..runtime.semaphore import get_semaphore
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
-        for b in phys.execute(ctx):
-            yield to_arrow(b)
+        with get_semaphore(conf).acquire():
+            for b in phys.execute(ctx):
+                yield to_arrow(b)
 
     def _explain(self, plan: L.LogicalPlan) -> str:
         from ..plan.overrides import explain_plan

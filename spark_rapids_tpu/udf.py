@@ -1,0 +1,127 @@
+"""User-defined functions: device (JAX) and CPU (Python) tiers.
+
+Reference, two tiers mirrored exactly (SURVEY §2.3 UDF support):
+
+* ``RapidsUDF`` (sql-plugin/src/main/java/com/nvidia/spark/RapidsUDF.java,
+  wired via GpuUserDefinedFunction.scala) — the user supplies a *columnar*
+  implementation that runs on the accelerator.  TPU shape: the user supplies
+  a **jax-traceable** function over ``jnp`` arrays; it inlines into the
+  enclosing stage's XLA computation like any built-in expression, so a
+  device UDF costs nothing extra at runtime.
+* Plain Scala/Python UDFs — opaque functions the planner cannot translate;
+  the reference runs the enclosing project on CPU (GpuOverrides tags the
+  expression unsupported).  Same here: a Python UDF tags its node for CPU
+  fallback and evaluates row-wise with Spark's null convention (null inputs
+  are passed to the function as ``None``; a ``None`` result is null).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .exprs import Expression, Value, _and_valid
+
+__all__ = ["UserDefinedFunction", "udf", "tpu_udf"]
+
+
+class UserDefinedFunction(Expression):
+    """A named function call over child expressions.
+
+    ``device=True``: ``fn`` maps child ``jnp`` data arrays → a data array
+    (or ``(data, valid)``); it must be jax-traceable.  Null propagation:
+    unless the fn returns its own validity, any-null-in → null-out.
+    ``device=False``: ``fn`` is arbitrary Python called per row.
+    """
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: Sequence[Expression], name: Optional[str] = None,
+                 device: bool = False, nullable: bool = True):
+        self.fn = fn
+        self.children = tuple(children)
+        self.name = name or getattr(fn, "__name__", "udf")
+        self.device = device
+        self._ret = return_type
+        self._nullable = nullable
+        if all(c.resolved() for c in self.children):
+            self._resolve()
+
+    def _resolve(self):
+        self.dtype = self._ret
+        self.nullable = self._nullable
+
+    def _rebind(self):
+        self._resolve()
+
+    def _fp_extra(self):
+        # identity of the function object: same fn ⇒ same compiled stage
+        return f"{self.name}:{id(self.fn)}:{self.dtype}:{self.device}"
+
+    def eval(self, ctx) -> Value:
+        assert self.device, "CPU UDFs never reach device eval (tagged off)"
+        datas, valid = [], None
+        for c in self.children:
+            d, v = c.eval(ctx)
+            datas.append(d)
+            valid = _and_valid(valid, v)
+        out = self.fn(*datas)
+        if isinstance(out, tuple):
+            data, fn_valid = out
+            valid = _and_valid(valid, fn_valid)
+        else:
+            data = out
+        np_dt = self.dtype.numpy_dtype
+        if np_dt is not None and data.dtype != np_dt:
+            data = data.astype(np_dt)
+        return data, valid
+
+    def eval_rows(self, child_values, n: int):
+        """CPU row-wise evaluation (numpy in/out, Spark null convention)."""
+        cols = []
+        for (d, v), c in zip(child_values, self.children):
+            vals = [None if (v is not None and not v[i]) else d[i]
+                    for i in range(n)]
+            if c.dtype is not None and c.dtype.is_decimal:
+                vals = [None if x is None else x / 10 ** c.dtype.scale
+                        for x in vals]
+            cols.append(vals)
+        results = [self.fn(*row) for row in zip(*cols)]
+        valid = np.array([r is not None for r in results])
+        np_dt = self.dtype.numpy_dtype or object
+        data = np.array([0 if r is None else r for r in results],
+                        dtype=np_dt if self.dtype.numpy_dtype else object)
+        return data, (None if valid.all() else valid)
+
+
+def _wrap(fn, return_type, device, name=None):
+    from .sql.column import Column
+
+    def call(*cols):
+        exprs = [c.expr if isinstance(c, Column) else
+                 __import__("spark_rapids_tpu.exprs", fromlist=["x"])
+                 .UnresolvedColumn(c) if isinstance(c, str) else c
+                 for c in cols]
+        return Column(UserDefinedFunction(fn, return_type, exprs,
+                                          name=name, device=device))
+
+    call.__name__ = name or getattr(fn, "__name__", "udf")
+    return call
+
+
+def udf(fn=None, *, return_type: T.DataType = T.FLOAT64, name=None):
+    """Python UDF (CPU): ``@udf(return_type=T.INT64)`` or ``udf(f, ...)``.
+    The enclosing operator falls back to CPU with an explain reason."""
+    if fn is None:
+        return lambda f: _wrap(f, return_type, device=False, name=name)
+    return _wrap(fn, return_type, device=False, name=name)
+
+
+def tpu_udf(fn=None, *, return_type: T.DataType = T.FLOAT64, name=None):
+    """Device UDF (RapidsUDF analog): ``fn`` must be jax-traceable over
+    ``jnp`` arrays; it fuses into the stage's XLA computation."""
+    if fn is None:
+        return lambda f: _wrap(f, return_type, device=True, name=name)
+    return _wrap(fn, return_type, device=True, name=name)
