@@ -1,0 +1,316 @@
+// spark_rapids_tpu native companion library.
+//
+// TPU-native analog of the reference's native layer (SURVEY §2.9): the
+// pieces the reference gets from spark-rapids-jni / nvcomp that are host-side
+// here because the device side is XLA:
+//
+//   * Spark-exact murmur3 / xxhash64 batch kernels (spark-rapids-jni `Hash`;
+//     sql-plugin uses them for hash partitioning).  The JAX device kernels in
+//     ops/hashing.py stay the device path; these are the host path (shuffle
+//     writers, CPU fallback partitioning) and the cross-check oracle.
+//   * A block compression codec for spill/shuffle payloads (nvcomp LZ4
+//     analog).  LZ77-family byte codec, self-describing frames; host-side
+//     because TPU spill tiers are host RAM + disk (no GDS analog).
+//   * Spark-exact string→number casts over Arrow offsets+bytes layout
+//     (spark-rapids-jni `CastStrings` analog).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// murmur3 (x86_32, Spark seed handling) — matches
+// org.apache.spark.sql.catalyst.expressions.Murmur3HashFunction for LONG
+// columns: each long hashed as two little-endian 32-bit halves.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= 0xcc9e2d51u;
+  k1 = rotl32(k1, 15);
+  k1 *= 0x1b873593u;
+  return k1;
+}
+
+static inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  h1 = h1 * 5u + 0xe6546b64u;
+  return h1;
+}
+
+static inline uint32_t fmix(uint32_t h1, uint32_t len) {
+  h1 ^= len;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  h1 ^= h1 >> 16;
+  return h1;
+}
+
+// Hash n int64 values (Spark hashLong): seed per row from `seeds`, result
+// int32 per row.  Nulls: caller passes the previous hash as seed and skips
+// (Spark: null columns leave the running hash unchanged).
+void srt_murmur3_long(const int64_t* vals, const int32_t* seeds,
+                      int32_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t v = (uint64_t)vals[i];
+    uint32_t h1 = (uint32_t)seeds[i];
+    h1 = mix_h1(h1, mix_k1((uint32_t)(v & 0xffffffffu)));
+    h1 = mix_h1(h1, mix_k1((uint32_t)(v >> 32)));
+    out[i] = (int32_t)fmix(h1, 8);
+  }
+}
+
+// Hash n utf8 strings in Arrow layout (Spark hashUnsafeBytes over int-sized
+// chunks then tail bytes — matches Murmur3HashFunction for UTF8String).
+void srt_murmur3_utf8(const uint8_t* bytes, const int64_t* offsets,
+                      const int32_t* seeds, int32_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* p = bytes + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    uint32_t h1 = (uint32_t)seeds[i];
+    int64_t nblocks = len / 4;
+    for (int64_t b = 0; b < nblocks; ++b) {
+      uint32_t k1;
+      memcpy(&k1, p + b * 4, 4);  // little-endian load (Spark Platform.getInt)
+      h1 = mix_h1(h1, mix_k1(k1));
+    }
+    // Spark's tail: each remaining BYTE hashed as its own int (sign-extended)
+    for (int64_t b = nblocks * 4; b < len; ++b) {
+      int32_t k1 = (int8_t)p[b];
+      h1 = mix_h1(h1, mix_k1((uint32_t)k1));
+    }
+    out[i] = (int32_t)fmix(h1, (uint32_t)len);
+  }
+}
+
+// Spark's pmod partition id from a hash.
+void srt_pmod_partition(const int32_t* hashes, int32_t num_parts,
+                        int32_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t m = hashes[i] % num_parts;
+    out[i] = m < 0 ? m + num_parts : m;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// xxhash64 (Spark XxHash64Function, seed 42) for int64 values.
+// ---------------------------------------------------------------------------
+
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+// Matches Spark's XXH64.hashLong == canonical xxhash64 over the long's
+// little-endian bytes (verified vs python-xxhash).
+void srt_xxhash64_long(const int64_t* vals, const int64_t* seeds,
+                       int64_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t seed = (uint64_t)seeds[i];
+    uint64_t hash = seed + P5 + 8;
+    uint64_t k1 = (uint64_t)vals[i] * P2;
+    k1 = rotl64(k1, 31);
+    k1 *= P1;
+    hash ^= k1;
+    hash = rotl64(hash, 27) * P1 + P4;
+    hash ^= hash >> 33;
+    hash *= P2;
+    hash ^= hash >> 29;
+    hash *= P3;
+    hash ^= hash >> 32;
+    out[i] = (int64_t)hash;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Block codec (nvcomp-LZ4 analog for spill/shuffle payloads).
+// Greedy LZ77 with a 64Ki hash table; frame = varint raw_len then tokens:
+//   literal run: [len:varint][bytes]
+//   match:       [0x00][offset:varint][len-4:varint]   (min match 4)
+// A literal run never starts with 0x00 token ambiguity because literal run
+// tokens carry length+1 (so token>=1); 0 marks a match.
+// ---------------------------------------------------------------------------
+
+static inline int put_varint(uint8_t* dst, uint64_t v) {
+  int k = 0;
+  while (v >= 0x80) { dst[k++] = (uint8_t)(v | 0x80); v >>= 7; }
+  dst[k++] = (uint8_t)v;
+  return k;
+}
+
+static inline int get_varint(const uint8_t* src, uint64_t* v) {
+  int k = 0; uint64_t out = 0; int shift = 0;
+  while (true) {
+    uint8_t b = src[k++];
+    out |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  *v = out;
+  return k;
+}
+
+int64_t srt_compress_bound(int64_t n) { return n + n / 16 + 64; }
+
+// Returns compressed size, or -1 if dst too small.
+int64_t srt_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                     int64_t dst_cap) {
+  const int HBITS = 16;
+  static thread_local int64_t* table = nullptr;
+  if (!table) table = (int64_t*)malloc(sizeof(int64_t) << HBITS);
+  memset(table, 0xff, sizeof(int64_t) << HBITS);
+
+  int64_t d = 0;
+  if (d + 10 > dst_cap) return -1;
+  d += put_varint(dst + d, (uint64_t)n);
+  int64_t i = 0, lit_start = 0;
+  while (i + 4 <= n) {
+    uint32_t w;
+    memcpy(&w, src + i, 4);
+    uint32_t h = (w * 2654435761u) >> (32 - HBITS);
+    int64_t cand = table[h];
+    table[h] = i;
+    uint32_t cw;
+    if (cand >= 0 && i - cand < (1 << 20) &&
+        (memcpy(&cw, src + cand, 4), cw == w)) {
+      // flush literals
+      int64_t lit = i - lit_start;
+      if (lit > 0) {
+        if (d + 10 + lit > dst_cap) return -1;
+        d += put_varint(dst + d, (uint64_t)lit + 1);
+        memcpy(dst + d, src + lit_start, lit);
+        d += lit;
+      }
+      int64_t len = 4;
+      while (i + len < n && src[cand + len] == src[i + len]) ++len;
+      if (d + 20 > dst_cap) return -1;
+      dst[d++] = 0x00;
+      d += put_varint(dst + d, (uint64_t)(i - cand));
+      d += put_varint(dst + d, (uint64_t)(len - 4));
+      i += len;
+      lit_start = i;
+    } else {
+      ++i;
+    }
+  }
+  int64_t lit = n - lit_start;
+  if (lit > 0) {
+    if (d + 10 + lit > dst_cap) return -1;
+    d += put_varint(dst + d, (uint64_t)lit + 1);
+    memcpy(dst + d, src + lit_start, lit);
+    d += lit;
+  }
+  return d;
+}
+
+// Returns decompressed size, or -1 on malformed input / overflow.
+int64_t srt_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
+                       int64_t dst_cap) {
+  int64_t s = 0, d = 0;
+  uint64_t raw_len;
+  s += get_varint(src + s, &raw_len);
+  if ((int64_t)raw_len > dst_cap) return -1;
+  while (s < n && d < (int64_t)raw_len) {
+    uint64_t tok;
+    s += get_varint(src + s, &tok);
+    if (tok == 0) {  // match
+      uint64_t off, mlen;
+      s += get_varint(src + s, &off);
+      s += get_varint(src + s, &mlen);
+      mlen += 4;
+      if (off == 0 || (int64_t)off > d || d + (int64_t)mlen > (int64_t)raw_len)
+        return -1;
+      // byte-wise: overlapping copies are valid (run-length style)
+      for (uint64_t b = 0; b < mlen; ++b) dst[d + b] = dst[d - off + b];
+      d += mlen;
+    } else {  // literal run of (tok-1) bytes
+      uint64_t lit = tok - 1;
+      if (s + (int64_t)lit > n || d + (int64_t)lit > (int64_t)raw_len)
+        return -1;
+      memcpy(dst + d, src + s, lit);
+      s += lit;
+      d += lit;
+    }
+  }
+  return d == (int64_t)raw_len ? d : -1;
+}
+
+// ---------------------------------------------------------------------------
+// String→number casts over Arrow offsets+bytes (CastStrings analog).
+// Spark semantics: trim ASCII whitespace; invalid/overflow → null.
+// ---------------------------------------------------------------------------
+
+// out_valid[i] = 1 if parsed, 0 if null (invalid).  Input validity handled
+// by the caller (null in → null out).
+void srt_cast_string_to_long(const uint8_t* bytes, const int64_t* offsets,
+                             int64_t* out, uint8_t* out_valid, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* p = bytes + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int64_t a = 0, b = len;
+    while (a < b && (p[a] == ' ' || (p[a] >= 9 && p[a] <= 13))) ++a;
+    while (b > a && (p[b - 1] == ' ' || (p[b - 1] >= 9 && p[b - 1] <= 13)))
+      --b;
+    out_valid[i] = 0;
+    out[i] = 0;
+    if (a >= b) continue;
+    bool neg = false;
+    if (p[a] == '+' || p[a] == '-') { neg = p[a] == '-'; ++a; }
+    if (a >= b) continue;
+    uint64_t acc = 0;
+    // overflow bound: 2^63 for negatives (LONG_MIN parses), 2^63-1 else
+    uint64_t limit = neg ? 0x8000000000000000ULL : 0x7fffffffffffffffULL;
+    bool ok = true;
+    for (int64_t k = a; k < b; ++k) {
+      if (p[k] < '0' || p[k] > '9') { ok = false; break; }
+      uint64_t digit = (uint64_t)(p[k] - '0');
+      if (acc > (limit - digit) / 10) { ok = false; break; }
+      acc = acc * 10 + digit;
+    }
+    if (!ok) continue;
+    out[i] = neg ? (int64_t)(~acc + 1) : (int64_t)acc;
+    out_valid[i] = 1;
+  }
+}
+
+void srt_cast_string_to_double(const uint8_t* bytes, const int64_t* offsets,
+                               double* out, uint8_t* out_valid, int64_t n) {
+  char buf[64];
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* p = bytes + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int64_t a = 0, b = len;
+    while (a < b && (p[a] == ' ' || (p[a] >= 9 && p[a] <= 13))) ++a;
+    while (b > a && (p[b - 1] == ' ' || (p[b - 1] >= 9 && p[b - 1] <= 13)))
+      --b;
+    out_valid[i] = 0;
+    out[i] = 0.0;
+    int64_t m = b - a;
+    if (m <= 0 || m >= (int64_t)sizeof(buf)) continue;
+    memcpy(buf, p + a, m);
+    buf[m] = '\0';
+    char* end = nullptr;
+    double v = strtod(buf, &end);
+    if (end == buf + m) {
+      out[i] = v;
+      out_valid[i] = 1;
+    }
+  }
+}
+
+}  // extern "C"

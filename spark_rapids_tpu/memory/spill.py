@@ -79,8 +79,19 @@ class SpillableBatch:
             os.makedirs(self._catalog.spill_dir, exist_ok=True)
             path = os.path.join(self._catalog.spill_dir,
                                 f"srt-spill-{uuid.uuid4().hex}.bin")
+            payload = pickle.dumps(self._host, protocol=4)
+            # nvcomp-LZ4 analog: compress the disk tier via the native codec
+            from .. import native
+            comp = native.compress(payload) if self._catalog.compress_spill \
+                else None
             with open(path, "wb") as f:
-                pickle.dump(self._host, f, protocol=4)
+                if comp is not None and len(comp) < len(payload):
+                    f.write(b"SRTC")
+                    f.write(len(payload).to_bytes(8, "little"))
+                    f.write(comp)
+                else:
+                    f.write(b"SRTR")
+                    f.write(payload)
             freed = self.host_bytes()
             self._host = None
             self._disk_path = path
@@ -106,7 +117,14 @@ class SpillableBatch:
                 raise RuntimeError("spillable batch already closed")
             if self.state == self.DISK:
                 with open(self._disk_path, "rb") as f:
-                    self._host = pickle.load(f)
+                    magic = f.read(4)
+                    if magic == b"SRTC":
+                        raw_len = int.from_bytes(f.read(8), "little")
+                        from .. import native
+                        payload = native.decompress(f.read(), raw_len)
+                    else:
+                        payload = f.read()
+                    self._host = pickle.loads(payload)
                 os.unlink(self._disk_path)
                 self._disk_path = None
                 self.state = self.HOST
@@ -150,10 +168,12 @@ class SpillCatalog:
     the device budget (RapidsBufferCatalog.synchronousSpill analog)."""
 
     def __init__(self, device_budget: int, host_budget: int,
-                 spill_dir: str = "/tmp/srt_spill"):
+                 spill_dir: str = "/tmp/srt_spill",
+                 compress_spill: bool = True):
         self.device_budget = device_budget
         self.host_budget = host_budget
         self.spill_dir = spill_dir
+        self.compress_spill = compress_spill
         self._lock = threading.Lock()
         self._entries: List[SpillableBatch] = []
         self.spilled_device_bytes = 0
@@ -258,7 +278,8 @@ def get_catalog(conf=None) -> SpillCatalog:
             _catalog = SpillCatalog(
                 device_budget,
                 conf["spark.rapids.tpu.memory.host.spillStorageSize"],
-                conf["spark.rapids.tpu.memory.spill.dir"])
+                conf["spark.rapids.tpu.memory.spill.dir"],
+                compress_spill=conf["spark.rapids.tpu.shuffle.compress"])
         return _catalog
 
 
