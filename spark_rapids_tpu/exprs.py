@@ -96,11 +96,14 @@ class EvalContext:
     """
 
     def __init__(self, arrays: Sequence[Value], capacity: int,
-                 active: Optional[jax.Array] = None, ansi: bool = False):
+                 active: Optional[jax.Array] = None, ansi: bool = False,
+                 extras: Sequence[Value] = ()):
         self.arrays = list(arrays)
         self.capacity = capacity
         self.active = active
         self.ansi = ansi
+        # host-precomputed inputs (dictionary-lowered string predicates)
+        self.extras = list(extras)
 
 
 # ---------------------------------------------------------------------------------

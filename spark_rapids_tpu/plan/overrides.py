@@ -32,9 +32,14 @@ __all__ = ["apply_overrides", "explain_plan", "NodeMeta"]
 # Expression tagging
 # ---------------------------------------------------------------------------------
 
-def expr_reasons(e: Expression, allow_string_passthrough: bool = True
-                 ) -> List[str]:
-    """Reasons this bound expression tree cannot lower to the device."""
+def expr_reasons(e: Expression, allow_string_passthrough: bool = True,
+                 allow_string_preds: bool = False) -> List[str]:
+    """Reasons this bound expression tree cannot lower to the device.
+
+    ``allow_string_preds``: inside fused stages, boolean subtrees over a
+    single string column lower to host-precomputed bool columns
+    (plan/stringpred.py), so they don't disqualify the node.
+    """
     reasons: List[str] = []
     core = strip_alias(e)
     if isinstance(core, BoundReference):
@@ -48,6 +53,10 @@ def expr_reasons(e: Expression, allow_string_passthrough: bool = True
 
     def walk(node: Expression):
         from ..udf import UserDefinedFunction
+        if allow_string_preds:
+            from .stringpred import string_pred_ref
+            if string_pred_ref(node) is not None:
+                return  # lowers to a dictionary-evaluated bool column
         if isinstance(node, UserDefinedFunction) and not node.device:
             reasons.append(
                 f"python UDF {node.name} is opaque to the planner "
@@ -116,12 +125,13 @@ class NodeMeta:
             schema = p.children[0].schema()
             for name, e in p.exprs:
                 b = bind(e, schema)
-                for r in expr_reasons(b):
+                for r in expr_reasons(b, allow_string_preds=True):
                     self.will_not_work(f"{name}: {r}")
             return
         if isinstance(p, L.Filter):
             b = bind(p.condition, p.children[0].schema())
-            for r in expr_reasons(b, allow_string_passthrough=False):
+            for r in expr_reasons(b, allow_string_passthrough=False,
+                                  allow_string_preds=True):
                 self.will_not_work(f"condition: {r}")
             return
         if isinstance(p, L.Aggregate):

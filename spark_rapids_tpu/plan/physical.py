@@ -207,7 +207,9 @@ class StageExec(TpuExec):
     def __init__(self, child: TpuExec, steps: List[Tuple[str, object]],
                  output_schema: Schema):
         super().__init__([child])
-        self.steps = steps
+        from .stringpred import lower_string_predicate_steps
+        self.steps, self.host_preds = lower_string_predicate_steps(
+            steps, child.output_schema)
         self._schema = output_schema
 
     @property
@@ -233,18 +235,21 @@ class StageExec(TpuExec):
     def _build_fn(self, in_schema: Schema):
         steps = self.steps
 
-        def stage_fn(arrays, sel, num_rows):
+        def stage_fn(arrays, extras, sel, num_rows):
             capacity = None
             for a in arrays:
                 if a is not None:
                     capacity = a[0].shape[0]
                     break
+            if capacity is None and extras:
+                capacity = extras[0][0].shape[0]
             active = jnp.arange(capacity, dtype=jnp.int32) < num_rows
             if sel is not None:
                 active = active & sel
             cur = list(arrays)
             for kind, payload in steps:
-                ctx = EvalContext(cur, capacity, active=active)
+                ctx = EvalContext(cur, capacity, active=active,
+                                  extras=extras)
                 if kind == "filter":
                     d, v = payload.eval(ctx)
                     keep = d if v is None else (d & v)
@@ -283,7 +288,21 @@ class StageExec(TpuExec):
             for i, (f_, c) in enumerate(zip(b.schema, b.columns)):
                 arrays.append(None if isinstance(c, HostStringColumn)
                               else (c.data, c.valid))
-            out_arrays, new_sel = fn(tuple(arrays), b.sel,
+            extras = []
+            if self.host_preds:
+                from .stringpred import evaluate_host_pred
+                cap = b.capacity
+                for pred, in_ord in self.host_preds:
+                    col = b.columns[in_ord]
+                    data, valid = evaluate_host_pred(pred, col, b.num_rows)
+                    pad = cap - len(data)
+                    if pad > 0:
+                        data = np.concatenate(
+                            [data, np.zeros(pad, dtype=bool)])
+                        valid = np.concatenate(
+                            [valid, np.zeros(pad, dtype=bool)])
+                    extras.append((jnp.asarray(data), jnp.asarray(valid)))
+            out_arrays, new_sel = fn(tuple(arrays), tuple(extras), b.sel,
                                      np.int32(b.num_rows))
             cols: List = []
             for oi, f_ in enumerate(self._schema):

@@ -1,0 +1,191 @@
+"""Dictionary-lowered string predicates: device plans for string filters.
+
+The reference runs string predicates (LIKE, startswith, regexp …) as cuDF
+device string kernels, with a regex transpiler rejecting unsupported corners
+(RegexParser.scala:681).  The TPU redesign exploits the engine's dictionary
+architecture instead: a boolean expression whose only column input is ONE
+string column is a pure function of that string, so it can be evaluated
+**once per distinct value** on the host (arrow dictionary-encode gives the
+distincts in C++) and become a per-row boolean via a code lookup — which
+rides to the device as a plain bool column and fuses into the stage's XLA
+program.  Consequences:
+
+* every string predicate — including FULL Java-regex RLike, which the
+  reference must transpile-or-reject — runs in device plans;
+* host cost is O(distinct values), not O(rows);
+* null semantics are exact: the predicate is additionally evaluated on a
+  null input to get the null-row result (e.g. IsNull → true).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import exprs as E
+from .. import types as T
+
+__all__ = ["PrecomputedBool", "lower_string_predicate_steps",
+           "string_pred_ref", "evaluate_host_pred"]
+
+
+class PrecomputedBool(E.Expression):
+    """Placeholder for a host-precomputed boolean column: evaluates to
+    ``ctx.extras[index]`` inside the stage's XLA computation."""
+
+    def __init__(self, index: int, inner: E.Expression):
+        self.index = index
+        self.inner = inner
+        self.dtype = T.BOOLEAN
+        self.nullable = inner.nullable
+        self.children = ()
+
+    def eval(self, ctx) -> E.Value:
+        return ctx.extras[self.index]
+
+    def _fp_extra(self):
+        return f"{self.index}:{self.inner.fingerprint()}"
+
+
+def _contains_udf(e: E.Expression) -> bool:
+    from ..udf import UserDefinedFunction
+    if isinstance(e, UserDefinedFunction):
+        return True
+    return any(_contains_udf(c) for c in e.children)
+
+
+def string_pred_ref(e: E.Expression) -> Optional[int]:
+    """If ``e`` is a boolean expression whose only column inputs are ONE
+    string-typed bound reference (several occurrences allowed), return its
+    ordinal; else None.  Such a subtree is a pure function of the string
+    value and lowers to a per-distinct host evaluation."""
+    if e.dtype is not T.BOOLEAN:
+        return None
+    if _contains_udf(e):
+        return None  # UDFs may be non-deterministic; keep per-row semantics
+
+    refs: List[E.BoundReference] = []
+    saw_string = [False]
+
+    def walk(node: E.Expression) -> bool:
+        if isinstance(node, E.BoundReference):
+            refs.append(node)
+            if node.dtype is not None and node.dtype.is_string:
+                saw_string[0] = True
+            return node.dtype is not None and node.dtype.is_string
+        if node.dtype is not None and node.dtype.is_string \
+                and isinstance(node, E.Literal):
+            saw_string[0] = True
+        return all(walk(c) for c in node.children)
+
+    if not walk(e):
+        return None
+    if not saw_string[0] or not refs:
+        return None
+    ordinals = {r.ordinal for r in refs}
+    if len(ordinals) != 1:
+        return None
+    return ordinals.pop()
+
+
+def _chase_to_input(steps_before: List[Tuple[str, object]],
+                    ordinal: int) -> Optional[int]:
+    """Map an ordinal in the current step schema back to the stage input,
+    through pure host pass-throughs only."""
+    ord_ = ordinal
+    for kind, payload in reversed(steps_before):
+        if kind != "project":
+            continue
+        name, e, src = payload[ord_]
+        if e is not None or src is None:
+            return None  # computed column — not a pass-through
+        ord_ = src
+    return ord_
+
+
+def _remap_to_single_ref(e: E.Expression) -> E.Expression:
+    """Rewrite every BoundReference to ordinal 0 (the distinct-values
+    column) for host evaluation."""
+    if isinstance(e, E.BoundReference):
+        return E.BoundReference(0, e.dtype, True, e.name)
+    if not e.children:
+        return e
+    new_children = tuple(_remap_to_single_ref(c) for c in e.children)
+    return E._rebuild(e, new_children)
+
+
+def lower_string_predicate_steps(steps, in_schema):
+    """Rewrite string-predicate subtrees in stage steps to
+    :class:`PrecomputedBool` nodes.
+
+    Returns ``(new_steps, host_preds)`` where each host_preds entry is
+    ``(remapped_pred, input_ordinal)``; the stage evaluates them per batch
+    (per distinct value) and passes the bool columns as ``extras``.
+    """
+    host_preds: List[Tuple[E.Expression, int]] = []
+
+    def rewrite(e: E.Expression, steps_before):
+        ref = string_pred_ref(e)
+        if ref is not None:
+            in_ord = _chase_to_input(steps_before, ref)
+            if in_ord is not None:
+                k = len(host_preds)
+                host_preds.append((_remap_to_single_ref(e), in_ord))
+                return PrecomputedBool(k, e)
+        if not e.children:
+            return e
+        new_children = tuple(rewrite(c, steps_before) for c in e.children)
+        if all(a is b for a, b in zip(new_children, e.children)):
+            return e
+        return E._rebuild(e, new_children)
+
+    new_steps = []
+    for i, (kind, payload) in enumerate(steps):
+        before = new_steps[:i]
+        if kind == "filter":
+            new_steps.append((kind, rewrite(payload, before)))
+        else:
+            new_steps.append((kind, [
+                (n, None if e is None else rewrite(e, before), src)
+                for n, e, src in payload]))
+    return new_steps, host_preds
+
+
+def evaluate_host_pred(pred: E.Expression, column, num_rows: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Evaluate a lowered predicate over a HostStringColumn's distinct
+    values; returns per-row (bool data, bool valid) of length num_rows."""
+    import pyarrow as pa
+
+    from ..cpu.eval import eval_cpu
+
+    arr = column.array.slice(0, num_rows)
+    denc = arr.dictionary_encode()
+    dict_vals = np.array(denc.dictionary.to_pylist(), dtype=object)
+    k = len(dict_vals)
+
+    pd_, pv_ = eval_cpu(pred, [(dict_vals, None)], k) if k else \
+        (np.zeros(0, dtype=bool), None)
+    pd_ = np.asarray(pd_, dtype=bool)
+    pv_ = np.ones(k, dtype=bool) if pv_ is None else np.asarray(pv_,
+                                                                dtype=bool)
+
+    # null-input result (IsNull → true, LIKE → null, …): evaluate once on
+    # a single-null column
+    nd, nv = eval_cpu(pred, [(np.array([None], dtype=object),
+                              np.array([False]))], 1)
+    null_data = bool(np.asarray(nd, dtype=bool)[0])
+    null_valid = True if nv is None else bool(np.asarray(nv)[0])
+
+    indices = denc.indices
+    codes = np.asarray(indices.fill_null(0).to_numpy(zero_copy_only=False),
+                       dtype=np.int64)
+    is_null = np.asarray(indices.is_null().to_numpy(zero_copy_only=False))
+    if k:
+        data = np.where(is_null, null_data, pd_[codes])
+        valid = np.where(is_null, null_valid, pv_[codes])
+    else:
+        data = np.full(num_rows, null_data, dtype=bool)
+        valid = np.full(num_rows, null_valid, dtype=bool)
+    return data.astype(bool), valid.astype(bool)

@@ -105,6 +105,9 @@ class Column:
     def is_not_null(self):
         return Column(E.IsNotNull(self.expr))
 
+    isNull = is_null
+    isNotNull = is_not_null
+
     def isin(self, *values):
         vals = values[0] if len(values) == 1 and isinstance(
             values[0], (list, tuple, set)) else values
