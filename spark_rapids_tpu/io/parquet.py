@@ -219,13 +219,19 @@ class ParquetSource:
                  predicates: Optional[List[Predicate]] = None,
                  batch_rows: int = 1 << 20, num_threads: int = 8,
                  cache_bytes: int = 0, exact_filter: bool = True,
-                 _paths: Optional[List[str]] = None):
+                 _paths: Optional[List[str]] = None,
+                 partitions: Optional[tuple] = None):
         self.path = path
         self.paths = _paths if _paths is not None else expand_paths(path)
         if not self.paths:
             raise FileNotFoundError(f"no parquet files match {path!r}")
-        self.part_names, self._part_vals = hive_partition_values(
-            path, self.paths)
+        self._partitions = partitions
+        if partitions is not None:
+            # explicit per-file partition values (Delta log metadata)
+            self.part_names, self._part_vals = partitions
+        else:
+            self.part_names, self._part_vals = hive_partition_values(
+                path, self.paths)
         self._part_types = {
             n: _infer_partition_type([self._part_vals[p].get(n)
                                       for p in self.paths])
@@ -268,7 +274,8 @@ class ParquetSource:
                                    if p not in self.predicates]
         return ParquetSource(self.path, cols, preds, self.batch_rows,
                              self.num_threads, self.cache_bytes,
-                             self.exact_filter, _paths=self.paths)
+                             self.exact_filter, _paths=self.paths,
+                             partitions=self._partitions)
 
     def cache_token(self) -> Optional[tuple]:
         """Identity of this scan's output for the device-tier cache: files

@@ -150,6 +150,12 @@ class DataFrameWriter:
     def orc(self, path: str) -> WriteStats:
         return self._write("orc", path)
 
+    def delta(self, path: str) -> int:
+        """Commit to a Delta Lake table; returns the new table version."""
+        from .delta import write_delta
+        return write_delta(self._df, path, mode=self._mode,
+                           partition_by=self._partition_by)
+
     def json(self, path: str) -> WriteStats:
         return self._write("json", path)
 

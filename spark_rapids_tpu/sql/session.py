@@ -112,6 +112,24 @@ class Session:
         from ..io.sources import JsonSource
         return self._file_source_df(JsonSource, path, schema=schema)
 
+    def read_delta(self, path, version: Optional[int] = None) -> DataFrame:
+        """Delta Lake table (log replay; ``version`` = time travel)."""
+        from ..io.delta import read_delta
+        conf = self._tpu_conf()
+        cache_bytes = (
+            conf["spark.rapids.tpu.sql.fileCache.maxBytes"]
+            if conf["spark.rapids.tpu.sql.fileCache.enabled"] else 0)
+        src = read_delta(
+            path, version=version,
+            batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"],
+            num_threads=conf[
+                "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
+            cache_bytes=cache_bytes,
+            exact_filter=conf["spark.rapids.tpu.sql.scan.exactFilterPushdown"])
+        node = L.LogicalScan(src.schema(), src, src.describe(), fmt="delta")
+        node.source = src
+        return DataFrame(node, self)
+
     def create_dataframe(self, data, schema=None) -> DataFrame:
         """From a pandas DataFrame, pyarrow Table, or dict of arrays."""
         import pyarrow as pa
