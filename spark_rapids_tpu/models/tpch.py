@@ -119,3 +119,107 @@ def q1_pandas(pdf, delta_days: int = 90):
         count_order=("l_quantity", "size"),
     ).reset_index().sort_values(["l_returnflag", "l_linestatus"])
     return g
+
+
+# ---------------------------------------------------------------------------------
+# Multi-table mini-generator for the query acceptance suite (datagen analog).
+# ---------------------------------------------------------------------------------
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+           "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ",
+           "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU",
+           "CHINA", "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA",
+           "UNITED KINGDOM", "UNITED STATES"]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+TYPES = ["PROMO BRUSHED COPPER", "STANDARD POLISHED BRASS",
+         "PROMO ANODIZED TIN", "ECONOMY BURNISHED NICKEL",
+         "PROMO PLATED STEEL", "SMALL PLATED COPPER",
+         "MEDIUM BRUSHED STEEL", "LARGE ANODIZED BRASS"]
+CONTAINERS = ["SM CASE", "SM BOX", "MED BAG", "MED BOX", "LG CASE", "LG BOX"]
+
+
+def gen_tables(seed: int = 7, n_lineitem: int = 3000, n_orders: int = 800,
+               n_customers: int = 150, n_parts: int = 200, n_suppliers: int = 50):
+    """Seeded mini TPC-H database as pyarrow tables (consistent FKs)."""
+    import pyarrow as pa
+    rng = np.random.default_rng(seed)
+    base = np.datetime64("1992-01-01")
+
+    region = pa.table({
+        "r_regionkey": np.arange(len(REGIONS), dtype=np.int64),
+        "r_name": REGIONS,
+    })
+    nation = pa.table({
+        "n_nationkey": np.arange(len(NATIONS), dtype=np.int64),
+        "n_name": NATIONS,
+        "n_regionkey": rng.integers(0, len(REGIONS),
+                                    len(NATIONS)).astype(np.int64),
+    })
+    customer = pa.table({
+        "c_custkey": np.arange(1, n_customers + 1, dtype=np.int64),
+        "c_name": [f"Customer#{i:09d}" for i in range(1, n_customers + 1)],
+        "c_nationkey": rng.integers(0, len(NATIONS),
+                                    n_customers).astype(np.int64),
+        "c_mktsegment": rng.choice(np.array(SEGMENTS), n_customers),
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_customers), 2),
+    })
+    supplier = pa.table({
+        "s_suppkey": np.arange(1, n_suppliers + 1, dtype=np.int64),
+        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_suppliers + 1)],
+        "s_nationkey": rng.integers(0, len(NATIONS),
+                                    n_suppliers).astype(np.int64),
+        "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_suppliers), 2),
+    })
+    part = pa.table({
+        "p_partkey": np.arange(1, n_parts + 1, dtype=np.int64),
+        "p_name": [f"part {i} goldenrod" if i % 7 == 0 else f"part {i}"
+                   for i in range(1, n_parts + 1)],
+        "p_type": rng.choice(np.array(TYPES), n_parts),
+        "p_size": rng.integers(1, 51, n_parts).astype(np.int64),
+        "p_container": rng.choice(np.array(CONTAINERS), n_parts),
+        "p_retailprice": np.round(rng.uniform(900.0, 2000.0, n_parts), 2),
+        "p_brand": rng.choice(np.array([f"Brand#{i}{j}" for i in range(1, 6)
+                                        for j in range(1, 6)]), n_parts),
+    })
+    odate = base + rng.integers(0, 2400, n_orders).astype("timedelta64[D]")
+    orders = pa.table({
+        "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, n_customers + 1,
+                                  n_orders).astype(np.int64),
+        "o_orderstatus": rng.choice(np.array(["O", "F", "P"]), n_orders),
+        "o_totalprice": np.round(rng.uniform(800.0, 500_000.0, n_orders), 2),
+        "o_orderdate": pa.array(odate, type=pa.date32()),
+        "o_orderpriority": rng.choice(np.array(PRIORITIES), n_orders),
+        "o_shippriority": np.zeros(n_orders, dtype=np.int64),
+    })
+    okey = rng.integers(1, n_orders + 1, n_lineitem).astype(np.int64)
+    ship = base + rng.integers(0, 2526, n_lineitem).astype("timedelta64[D]")
+    commit = ship + rng.integers(-30, 60,
+                                 n_lineitem).astype("timedelta64[D]")
+    receipt = ship + rng.integers(1, 60,
+                                  n_lineitem).astype("timedelta64[D]")
+    lineitem = pa.table({
+        "l_orderkey": okey,
+        "l_partkey": rng.integers(1, n_parts + 1,
+                                  n_lineitem).astype(np.int64),
+        "l_suppkey": rng.integers(1, n_suppliers + 1,
+                                  n_lineitem).astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n_lineitem).astype(np.float64),
+        "l_extendedprice": np.round(
+            rng.uniform(900.0, 105000.0, n_lineitem), 2),
+        "l_discount": rng.integers(0, 11, n_lineitem).astype(np.float64)
+        / 100.0,
+        "l_tax": rng.integers(0, 9, n_lineitem).astype(np.float64) / 100.0,
+        "l_returnflag": rng.choice(np.array(["A", "N", "R"]), n_lineitem),
+        "l_linestatus": rng.choice(np.array(["O", "F"]), n_lineitem),
+        "l_shipdate": pa.array(ship, type=pa.date32()),
+        "l_commitdate": pa.array(commit, type=pa.date32()),
+        "l_receiptdate": pa.array(receipt, type=pa.date32()),
+        "l_shipmode": rng.choice(np.array(SHIPMODES), n_lineitem),
+    })
+    return {"region": region, "nation": nation, "customer": customer,
+            "supplier": supplier, "part": part, "orders": orders,
+            "lineitem": lineitem}

@@ -203,7 +203,15 @@ class DataFrame:
             node = L.Join(self._plan, other._plan, lk, rk, how=how)
             node.using = list(on)
             return DataFrame(node, self.session)
-        raise NotImplementedError("join on expressions: pass column names")
+        if isinstance(on, (list, tuple)) and all(
+                isinstance(x, (list, tuple)) and len(x) == 2 for x in on):
+            # [(left_col, right_col), ...] equi-join with distinct key names
+            lk = [E.UnresolvedColumn(a) for a, _ in on]
+            rk = [E.UnresolvedColumn(b) for _, b in on]
+            node = L.Join(self._plan, other._plan, lk, rk, how=how)
+            return DataFrame(node, self.session)
+        raise NotImplementedError(
+            "join on: column names or (left, right) name pairs")
 
     def cross_join(self, other: "DataFrame") -> "DataFrame":
         node = L.Join(self._plan, other._plan, [], [], how="cross")

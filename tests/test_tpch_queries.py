@@ -1,0 +1,291 @@
+"""TPC-H query acceptance suite: the engine vs pandas oracles over the
+seeded mini database (qa_nightly / NDS-style acceptance — SURVEY §4.2;
+exercises multi-joins, semi joins, string predicates, group-by, having,
+top-k in one place)."""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def F():
+    from spark_rapids_tpu.sql import functions
+    return functions
+
+
+@pytest.fixture(scope="module")
+def db(session):
+    from spark_rapids_tpu.models.tpch import gen_tables
+    tables = gen_tables()
+    dfs = {k: session.create_dataframe(t) for k, t in tables.items()}
+    pds = {k: t.to_pandas() for k, t in tables.items()}
+    return dfs, pds
+
+
+def _rows(df):
+    return df.collect()
+
+
+def _close(got, exp, places=6):
+    assert len(got) == len(exp), (len(got), len(exp))
+    for g, e in zip(got, exp):
+        assert len(g) == len(e), (g, e)
+        for a, b in zip(g, e):
+            if isinstance(b, float):
+                assert a == pytest.approx(b, rel=10 ** -places), (g, e)
+            else:
+                assert a == b, (g, e)
+
+
+def test_q3_shipping_priority(db):
+    f = F()
+    dfs, pds = db
+    seg, cutoff = "BUILDING", datetime.date(1995, 3, 15)
+    q = (dfs["customer"].filter(f.col("c_mktsegment") == seg)
+         .join(dfs["orders"], on=[("c_custkey", "o_custkey")])
+         .filter(f.col("o_orderdate") < cutoff)
+         .join(dfs["lineitem"], on=[("o_orderkey", "l_orderkey")])
+         .filter(f.col("l_shipdate") > cutoff)
+         .select("o_orderkey", "o_orderdate", "o_shippriority",
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount")))
+                 .alias("volume"))
+         .group_by("o_orderkey", "o_orderdate", "o_shippriority")
+         .agg(f.sum(f.col("volume")).alias("revenue"))
+         .sort(f.col("revenue").desc(), f.col("o_orderkey"))
+         .limit(10))
+    got = _rows(q.select("o_orderkey", "revenue"))
+
+    c = pds["customer"]; o = pds["orders"]; l = pds["lineitem"]
+    m = (c[c.c_mktsegment == seg]
+         .merge(o[o.o_orderdate < cutoff], left_on="c_custkey",
+                right_on="o_custkey")
+         .merge(l[l.l_shipdate > cutoff], left_on="o_orderkey",
+                right_on="l_orderkey"))
+    m["volume"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = (m.groupby(["o_orderkey", "o_orderdate", "o_shippriority"])
+           ["volume"].sum().reset_index()
+           .sort_values(["volume", "o_orderkey"],
+                        ascending=[False, True]).head(10))
+    _close(got, list(zip(exp.o_orderkey.astype(int), exp.volume)))
+
+
+def test_q4_order_priority_semi_join(db):
+    f = F()
+    dfs, pds = db
+    lo = datetime.date(1993, 7, 1)
+    hi = datetime.date(1993, 10, 1)
+    late = dfs["lineitem"].filter(
+        f.col("l_commitdate") < f.col("l_receiptdate"))
+    q = (dfs["orders"]
+         .filter((f.col("o_orderdate") >= lo) & (f.col("o_orderdate") < hi))
+         .join(late, on=[("o_orderkey", "l_orderkey")], how="semi")
+         .group_by("o_orderpriority")
+         .agg(f.count_star().alias("order_count"))
+         .sort("o_orderpriority"))
+    got = _rows(q)
+
+    o = pds["orders"]; l = pds["lineitem"]
+    late_keys = set(l.loc[l.l_commitdate < l.l_receiptdate, "l_orderkey"])
+    sub = o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)
+            & o.o_orderkey.isin(late_keys)]
+    exp = (sub.groupby("o_orderpriority").size().reset_index(name="n")
+           .sort_values("o_orderpriority"))
+    _close(got, list(zip(exp.o_orderpriority, exp.n.astype(int))))
+
+
+def test_q5_local_supplier_volume(db):
+    f = F()
+    dfs, pds = db
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    q = (dfs["customer"]
+         .join(dfs["orders"], on=[("c_custkey", "o_custkey")])
+         .filter((f.col("o_orderdate") >= lo) & (f.col("o_orderdate") < hi))
+         .join(dfs["lineitem"], on=[("o_orderkey", "l_orderkey")])
+         .join(dfs["supplier"], on=[("l_suppkey", "s_suppkey")])
+         .filter(f.col("c_nationkey") == f.col("s_nationkey"))
+         .join(dfs["nation"], on=[("s_nationkey", "n_nationkey")])
+         .join(dfs["region"].filter(f.col("r_name") == "ASIA"),
+               on=[("n_regionkey", "r_regionkey")])
+         .select("n_name",
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount")))
+                 .alias("volume"))
+         .group_by("n_name").agg(f.sum(f.col("volume")).alias("revenue"))
+         .sort(f.col("revenue").desc()))
+    got = _rows(q)
+
+    c, o, l, s, n, r = (pds[k] for k in
+                        ["customer", "orders", "lineitem", "supplier",
+                         "nation", "region"])
+    m = (c.merge(o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)],
+                 left_on="c_custkey", right_on="o_custkey")
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey"))
+    m = m[m.c_nationkey == m.s_nationkey]
+    m = (m.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+         .merge(r[r.r_name == "ASIA"], left_on="n_regionkey",
+                right_on="r_regionkey"))
+    m["volume"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = (m.groupby("n_name")["volume"].sum().reset_index()
+           .sort_values("volume", ascending=False))
+    _close(got, list(zip(exp.n_name, exp.volume)))
+
+
+def test_q10_returned_items(db):
+    f = F()
+    dfs, pds = db
+    lo, hi = datetime.date(1993, 10, 1), datetime.date(1994, 1, 1)
+    q = (dfs["customer"]
+         .join(dfs["orders"], on=[("c_custkey", "o_custkey")])
+         .filter((f.col("o_orderdate") >= lo) & (f.col("o_orderdate") < hi))
+         .join(dfs["lineitem"].filter(f.col("l_returnflag") == "R"),
+               on=[("o_orderkey", "l_orderkey")])
+         .select("c_custkey", "c_name", "c_acctbal",
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount")))
+                 .alias("volume"))
+         .group_by("c_custkey", "c_name", "c_acctbal")
+         .agg(f.sum(f.col("volume")).alias("revenue"))
+         .sort(f.col("revenue").desc(), f.col("c_custkey")).limit(20))
+    got = _rows(q.select("c_custkey", "revenue"))
+
+    c, o, l = pds["customer"], pds["orders"], pds["lineitem"]
+    m = (c.merge(o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)],
+                 left_on="c_custkey", right_on="o_custkey")
+         .merge(l[l.l_returnflag == "R"], left_on="o_orderkey",
+                right_on="l_orderkey"))
+    m["volume"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = (m.groupby(["c_custkey", "c_name", "c_acctbal"])["volume"]
+           .sum().reset_index()
+           .sort_values(["volume", "c_custkey"],
+                        ascending=[False, True]).head(20))
+    _close(got, list(zip(exp.c_custkey.astype(int), exp.volume)))
+
+
+def test_q12_shipmode(db):
+    f = F()
+    dfs, pds = db
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    high = f.when(f.col("o_orderpriority").isin("1-URGENT", "2-HIGH"),
+                  f.lit(1)).otherwise(f.lit(0))
+    low = f.when(~f.col("o_orderpriority").isin("1-URGENT", "2-HIGH"),
+                 f.lit(1)).otherwise(f.lit(0))
+    q = (dfs["orders"]
+         .join(dfs["lineitem"]
+               .filter(f.col("l_shipmode").isin("MAIL", "SHIP")
+                       & (f.col("l_commitdate") < f.col("l_receiptdate"))
+                       & (f.col("l_shipdate") < f.col("l_commitdate"))
+                       & (f.col("l_receiptdate") >= lo)
+                       & (f.col("l_receiptdate") < hi)),
+               on=[("o_orderkey", "l_orderkey")])
+         .select("l_shipmode", high.alias("high"), low.alias("low"))
+         .group_by("l_shipmode")
+         .agg(f.sum(f.col("high")).alias("high_line_count"),
+              f.sum(f.col("low")).alias("low_line_count"))
+         .sort("l_shipmode"))
+    got = _rows(q)
+
+    o, l = pds["orders"], pds["lineitem"]
+    sub = l[l.l_shipmode.isin(["MAIL", "SHIP"])
+            & (l.l_commitdate < l.l_receiptdate)
+            & (l.l_shipdate < l.l_commitdate)
+            & (l.l_receiptdate >= lo) & (l.l_receiptdate < hi)]
+    m = o.merge(sub, left_on="o_orderkey", right_on="l_orderkey")
+    m["high"] = m.o_orderpriority.isin(["1-URGENT", "2-HIGH"]).astype(int)
+    m["low"] = 1 - m["high"]
+    exp = (m.groupby("l_shipmode")[["high", "low"]].sum().reset_index()
+           .sort_values("l_shipmode"))
+    _close(got, list(zip(exp.l_shipmode, exp.high.astype(int),
+                         exp.low.astype(int))))
+
+
+def test_q14_promo_effect(db):
+    f = F()
+    dfs, pds = db
+    lo, hi = datetime.date(1995, 9, 1), datetime.date(1995, 10, 1)
+    vol = f.col("l_extendedprice") * (1 - f.col("l_discount"))
+    q = (dfs["lineitem"]
+         .filter((f.col("l_shipdate") >= lo) & (f.col("l_shipdate") < hi))
+         .join(dfs["part"], on=[("l_partkey", "p_partkey")])
+         .select(f.when(f.col("p_type").like("PROMO%"), vol)
+                 .otherwise(f.lit(0.0)).alias("promo"),
+                 vol.alias("total"))
+         .agg(f.sum(f.col("promo")).alias("p"),
+              f.sum(f.col("total")).alias("t")))
+    p, t = _rows(q)[0]
+
+    l, pt = pds["lineitem"], pds["part"]
+    m = (l[(l.l_shipdate >= lo) & (l.l_shipdate < hi)]
+         .merge(pt, left_on="l_partkey", right_on="p_partkey"))
+    m["vol"] = m.l_extendedprice * (1 - m.l_discount)
+    exp_p = m.loc[m.p_type.str.startswith("PROMO"), "vol"].sum()
+    exp_t = m.vol.sum()
+    assert p == pytest.approx(exp_p) and t == pytest.approx(exp_t)
+
+
+def test_q18_large_volume_customer_having(db):
+    f = F()
+    dfs, pds = db
+    big = (dfs["lineitem"].group_by("l_orderkey")
+           .agg(f.sum(f.col("l_quantity")).alias("qty"))
+           .filter(f.col("qty") > 300))  # HAVING
+    q = (dfs["orders"]
+         .join(big, on=[("o_orderkey", "l_orderkey")], how="semi")
+         .join(dfs["customer"], on=[("o_custkey", "c_custkey")])
+         .select("c_name", "o_orderkey", "o_totalprice")
+         .sort(f.col("o_totalprice").desc(), f.col("o_orderkey")).limit(10))
+    got = _rows(q.select("o_orderkey", "o_totalprice"))
+
+    o, l, c = pds["orders"], pds["lineitem"], pds["customer"]
+    qty = l.groupby("l_orderkey")["l_quantity"].sum()
+    keys = set(qty[qty > 300].index)
+    sub = o[o.o_orderkey.isin(keys)].merge(
+        c, left_on="o_custkey", right_on="c_custkey")
+    exp = sub.sort_values(["o_totalprice", "o_orderkey"],
+                          ascending=[False, True]).head(10)
+    _close(got, list(zip(exp.o_orderkey.astype(int), exp.o_totalprice)))
+
+
+def test_q19_disjunctive_predicates(db):
+    f = F()
+    dfs, pds = db
+    q = (dfs["lineitem"]
+         .join(dfs["part"], on=[("l_partkey", "p_partkey")])
+         .filter(
+             (f.col("p_container").isin("SM CASE", "SM BOX")
+              & (f.col("l_quantity") >= 1) & (f.col("l_quantity") <= 20)
+              & (f.col("p_size") <= 15))
+             | (f.col("p_container").isin("MED BAG", "MED BOX")
+                & (f.col("l_quantity") >= 10) & (f.col("l_quantity") <= 30)
+                & (f.col("p_size") <= 25)))
+         .agg(f.sum(f.col("l_extendedprice") * (1 - f.col("l_discount")))
+              .alias("revenue")))
+    got = _rows(q)[0][0]
+
+    l, pt = pds["lineitem"], pds["part"]
+    m = l.merge(pt, left_on="l_partkey", right_on="p_partkey")
+    keep = ((m.p_container.isin(["SM CASE", "SM BOX"])
+             & (m.l_quantity >= 1) & (m.l_quantity <= 20) & (m.p_size <= 15))
+            | (m.p_container.isin(["MED BAG", "MED BOX"])
+               & (m.l_quantity >= 10) & (m.l_quantity <= 30)
+               & (m.p_size <= 25)))
+    exp = (m.loc[keep, "l_extendedprice"]
+           * (1 - m.loc[keep, "l_discount"])).sum()
+    assert got == pytest.approx(exp)
+
+
+def test_q1_and_q6_on_minidb(db):
+    """The two bench queries also run against the mini DB oracles."""
+    from spark_rapids_tpu.models import tpch
+    dfs, pds = db
+    got_q6 = tpch.q6(dfs["lineitem"]).collect()[0][0]
+    exp_q6 = tpch.q6_pandas(pds["lineitem"])
+    assert (got_q6 or 0.0) == pytest.approx(exp_q6)
+    got_q1 = tpch.q1(dfs["lineitem"]).collect()
+    exp_q1 = tpch.q1_pandas(pds["lineitem"])
+    assert len(got_q1) == len(exp_q1)
+    for g, (_, e) in zip(got_q1, exp_q1.iterrows()):
+        assert g[0] == e.l_returnflag and g[1] == e.l_linestatus
+        assert g[2] == pytest.approx(e.sum_qty)
+        assert g[5] == pytest.approx(e.sum_charge)
+        assert g[9] == e.count_order
