@@ -54,9 +54,9 @@ def expr_reasons(e: Expression, allow_string_passthrough: bool = True,
     def walk(node: Expression):
         from ..udf import UserDefinedFunction
         if allow_string_preds:
-            from .stringpred import string_pred_ref
-            if string_pred_ref(node) is not None:
-                return  # lowers to a dictionary-evaluated bool column
+            from .stringpred import lowerable_kind
+            if lowerable_kind(node) is not None:
+                return  # lowers to a dictionary-evaluated host column
         if isinstance(node, UserDefinedFunction) and not node.device:
             reasons.append(
                 f"python UDF {node.name} is opaque to the planner "

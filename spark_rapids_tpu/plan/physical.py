@@ -208,7 +208,7 @@ class StageExec(TpuExec):
                  output_schema: Schema):
         super().__init__([child])
         from .stringpred import lower_string_predicate_steps
-        self.steps, self.host_preds = lower_string_predicate_steps(
+        self.steps, self.host_exprs = lower_string_predicate_steps(
             steps, child.output_schema)
         self._schema = output_schema
 
@@ -222,11 +222,16 @@ class StageExec(TpuExec):
 
     # fingerprint identifies the traced program (cache key)
     def fingerprint(self) -> str:
+        def host_fp(src):
+            if isinstance(src, tuple) and src[0] == "hc":
+                return f"hc#{self.host_exprs[src[1]][0].fingerprint()}"
+            return f"host#{src}"
+
         parts = []
         for kind, payload in self.steps:
             if kind == "project":
                 parts.append("P(" + ";".join(
-                    f"{n}={e.fingerprint() if e is not None else f'host#{src}'}"
+                    f"{n}={e.fingerprint() if e is not None else host_fp(src)}"
                     for n, e, src in payload) + ")")
             else:
                 parts.append(f"F({payload.fingerprint()})")
@@ -241,8 +246,9 @@ class StageExec(TpuExec):
                 if a is not None:
                     capacity = a[0].shape[0]
                     break
-            if capacity is None and extras:
-                capacity = extras[0][0].shape[0]
+            if capacity is None:
+                capacity = next(e[0].shape[0] for e in extras
+                                if e is not None)
             active = jnp.arange(capacity, dtype=jnp.int32) < num_rows
             if sel is not None:
                 active = active & sel
@@ -289,28 +295,49 @@ class StageExec(TpuExec):
                 arrays.append(None if isinstance(c, HostStringColumn)
                               else (c.data, c.valid))
             extras = []
-            if self.host_preds:
-                from .stringpred import evaluate_host_pred
+            host_computed = {}
+            if self.host_exprs:
+                from .stringpred import evaluate_host_expr
                 cap = b.capacity
-                for pred, in_ord in self.host_preds:
-                    col = b.columns[in_ord]
-                    data, valid = evaluate_host_pred(pred, col, b.num_rows)
+                for k, (expr, ords, kind) in enumerate(self.host_exprs):
+                    data, valid = evaluate_host_expr(
+                        expr, ords, b.columns, b.num_rows)
+                    if kind == "host":
+                        # computed string output: stays a host column
+                        import pyarrow as pa
+                        vals = [v if ok else None
+                                for v, ok in zip(data.tolist(),
+                                                 valid.tolist())]
+                        host_computed[k] = HostStringColumn(
+                            pa.array(vals, type=pa.string()), capacity=cap)
+                        extras.append(None)
+                        continue
                     pad = cap - len(data)
                     if pad > 0:
                         data = np.concatenate(
-                            [data, np.zeros(pad, dtype=bool)])
+                            [data, np.zeros(pad, dtype=data.dtype)])
                         valid = np.concatenate(
                             [valid, np.zeros(pad, dtype=bool)])
                     extras.append((jnp.asarray(data), jnp.asarray(valid)))
-            out_arrays, new_sel = fn(tuple(arrays), tuple(extras), b.sel,
-                                     np.int32(b.num_rows))
+            if all(a is None for a in arrays) and \
+                    all(e is None for e in extras):
+                # pure host-column stage (string-only projection): no XLA
+                # program to run
+                out_arrays = (None,) * len(self._schema)
+                new_sel = b.sel
+            else:
+                out_arrays, new_sel = fn(tuple(arrays), tuple(extras),
+                                         b.sel, np.int32(b.num_rows))
             cols: List = []
             for oi, f_ in enumerate(self._schema):
                 val = out_arrays[oi] if oi < len(out_arrays) else None
                 if val is None:
-                    # host pass-through: the expr was a bare reference
+                    # host column: pass-through ref or host-computed string
                     src = self._host_source_ordinal(oi)
-                    cols.append(b.columns[src])
+                    if isinstance(src, tuple) and src[0] == "hc":
+                        cols.append(host_computed[src[1]])
+                    else:
+                        cols.append(b.columns[src])
                 else:
                     data, valid = val
                     cols.append(DeviceColumn(f_.dtype, data, valid))
@@ -324,8 +351,9 @@ class StageExec(TpuExec):
                 m.add("numOutputBatches", 1)
                 yield out
 
-    def _host_source_ordinal(self, out_ordinal: int) -> int:
-        """Chase a host pass-through output back to its input ordinal."""
+    def _host_source_ordinal(self, out_ordinal: int):
+        """Chase a host output back to its input ordinal, or to an
+        ("hc", k) host-computed marker."""
         ord_ = out_ordinal
         for kind, payload in reversed(self.steps):
             if kind != "project":
@@ -334,6 +362,8 @@ class StageExec(TpuExec):
             assert e is None and src is not None, (
                 "host column used in computed expression; planner "
                 "should have routed this stage to CPU")
+            if isinstance(src, tuple) and src[0] == "hc":
+                return src
             ord_ = src
         return ord_
 

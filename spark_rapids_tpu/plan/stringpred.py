@@ -1,20 +1,24 @@
-"""Dictionary-lowered string predicates: device plans for string filters.
+"""Host-lowered string expressions: device plans for string compute.
 
-The reference runs string predicates (LIKE, startswith, regexp …) as cuDF
-device string kernels, with a regex transpiler rejecting unsupported corners
-(RegexParser.scala:681).  The TPU redesign exploits the engine's dictionary
-architecture instead: a boolean expression whose only column input is ONE
-string column is a pure function of that string, so it can be evaluated
-**once per distinct value** on the host (arrow dictionary-encode gives the
-distincts in C++) and become a per-row boolean via a code lookup — which
-rides to the device as a plain bool column and fuses into the stage's XLA
-program.  Consequences:
+The reference runs string kernels (LIKE, substring, regexp …) on cuDF device
+strings, with a regex transpiler rejecting unsupported corners
+(RegexParser.scala:681).  The TPU redesign exploits this engine's dictionary
+architecture instead: any expression whose column inputs are all STRING
+columns is a pure function of those strings, so it can run on the host —
+**once per distinct value** when it reads a single column (arrow
+dictionary-encode gives the distincts in C++), per row otherwise — and its
+result joins the stage either as
 
-* every string predicate — including FULL Java-regex RLike, which the
-  reference must transpile-or-reject — runs in device plans;
-* host cost is O(distinct values), not O(rows);
-* null semantics are exact: the predicate is additionally evaluated on a
-  null input to get the null-row result (e.g. IsNull → true).
+* a typed device column (bool/numeric outputs — predicates, length, …),
+  fused into the stage's XLA program via ``ctx.extras``; or
+* a computed host string column (string outputs — upper, concat,
+  regexp_replace, …) emitted alongside the device columns.
+
+Consequences: every string function — including FULL Java-regex RLike /
+regexp_replace, which the reference must transpile-or-reject — runs inside
+device plans; host cost is O(distinct) for the single-column case; null
+semantics are exact (the expression is additionally evaluated on a null
+input, so IsNull → true falls out).
 """
 
 from __future__ import annotations
@@ -26,18 +30,18 @@ import numpy as np
 from .. import exprs as E
 from .. import types as T
 
-__all__ = ["PrecomputedBool", "lower_string_predicate_steps",
-           "string_pred_ref", "evaluate_host_pred"]
+__all__ = ["PrecomputedCol", "PrecomputedBool", "lower_string_predicate_steps",
+           "string_pred_ref", "lowerable_kind", "evaluate_host_expr"]
 
 
-class PrecomputedBool(E.Expression):
-    """Placeholder for a host-precomputed boolean column: evaluates to
-    ``ctx.extras[index]`` inside the stage's XLA computation."""
+class PrecomputedCol(E.Expression):
+    """Placeholder for a host-precomputed column fed to the stage's XLA
+    computation as ``ctx.extras[index]``."""
 
     def __init__(self, index: int, inner: E.Expression):
         self.index = index
         self.inner = inner
-        self.dtype = T.BOOLEAN
+        self.dtype = inner.dtype
         self.nullable = inner.nullable
         self.children = ()
 
@@ -48,6 +52,25 @@ class PrecomputedBool(E.Expression):
         return f"{self.index}:{self.inner.fingerprint()}"
 
 
+# backwards-compat name (round-2 code/tests)
+PrecomputedBool = PrecomputedCol
+
+
+class _HostComputedRef(E.Expression):
+    """Marks a project output computed on host (string dtype); never
+    evaluated in the XLA program."""
+
+    def __init__(self, index: int, inner: E.Expression):
+        self.index = index
+        self.inner = inner
+        self.dtype = inner.dtype
+        self.nullable = True
+        self.children = ()
+
+    def _fp_extra(self):
+        return f"hc{self.index}:{self.inner.fingerprint()}"
+
+
 def _contains_udf(e: E.Expression) -> bool:
     from ..udf import UserDefinedFunction
     if isinstance(e, UserDefinedFunction):
@@ -55,15 +78,21 @@ def _contains_udf(e: E.Expression) -> bool:
     return any(_contains_udf(c) for c in e.children)
 
 
-def string_pred_ref(e: E.Expression) -> Optional[int]:
-    """If ``e`` is a boolean expression whose only column inputs are ONE
-    string-typed bound reference (several occurrences allowed), return its
-    ordinal; else None.  Such a subtree is a pure function of the string
-    value and lowers to a per-distinct host evaluation."""
-    if e.dtype is not T.BOOLEAN:
+def lowerable_kind(e: E.Expression) -> Optional[str]:
+    """Classify a bound subtree for host lowering.
+
+    'device' — non-string, non-nested output whose column inputs are all
+    string refs (≥1): becomes a typed extras column.
+    'host' — string output whose column inputs are all string refs:
+    becomes a computed host string column.
+    None — not lowerable (has non-string refs, UDFs, or no string at all).
+    """
+    if e.dtype is None or e.dtype.is_nested:
         return None
     if _contains_udf(e):
-        return None  # UDFs may be non-deterministic; keep per-row semantics
+        return None
+    if isinstance(e, (E.BoundReference, E.Literal)):
+        return None  # plain refs/literals pass through; nothing to lower
 
     refs: List[E.BoundReference] = []
     saw_string = [False]
@@ -73,69 +102,89 @@ def string_pred_ref(e: E.Expression) -> Optional[int]:
             refs.append(node)
             if node.dtype is not None and node.dtype.is_string:
                 saw_string[0] = True
-            return node.dtype is not None and node.dtype.is_string
-        if node.dtype is not None and node.dtype.is_string \
-                and isinstance(node, E.Literal):
+                return True
+            return False
+        if node.dtype is not None and node.dtype.is_string:
             saw_string[0] = True
         return all(walk(c) for c in node.children)
 
-    if not walk(e):
+    if not walk(e) or not saw_string[0] or not refs:
         return None
-    if not saw_string[0] or not refs:
+    return "host" if e.dtype.is_string else "device"
+
+
+def string_pred_ref(e: E.Expression) -> Optional[int]:
+    """Round-2 compat: single-ref boolean predicates only."""
+    if e.dtype is not T.BOOLEAN or lowerable_kind(e) != "device":
         return None
-    ordinals = {r.ordinal for r in refs}
-    if len(ordinals) != 1:
-        return None
-    return ordinals.pop()
+    ords = {r for r in _ref_ordinals(e)}
+    return ords.pop() if len(ords) == 1 else None
 
 
-def _chase_to_input(steps_before: List[Tuple[str, object]],
-                    ordinal: int) -> Optional[int]:
-    """Map an ordinal in the current step schema back to the stage input,
-    through pure host pass-throughs only."""
-    ord_ = ordinal
-    for kind, payload in reversed(steps_before):
-        if kind != "project":
-            continue
-        name, e, src = payload[ord_]
-        if e is not None or src is None:
-            return None  # computed column — not a pass-through
-        ord_ = src
-    return ord_
-
-
-def _remap_to_single_ref(e: E.Expression) -> E.Expression:
-    """Rewrite every BoundReference to ordinal 0 (the distinct-values
-    column) for host evaluation."""
+def _ref_ordinals(e: E.Expression) -> List[int]:
+    out = []
     if isinstance(e, E.BoundReference):
-        return E.BoundReference(0, e.dtype, True, e.name)
+        out.append(e.ordinal)
+    for c in e.children:
+        out += _ref_ordinals(c)
+    return out
+
+
+def _resolve_to_input(e: E.Expression, steps_before,
+                      host_computes) -> Optional[E.Expression]:
+    """Rewrite refs in ``e`` to STAGE-INPUT ordinals by walking earlier
+    project steps backwards (host pass-throughs), substituting earlier
+    host-computed string expressions inline."""
+    if isinstance(e, E.BoundReference):
+        ord_ = e.ordinal
+        for kind, payload in reversed(steps_before):
+            if kind != "project":
+                continue
+            name, expr, src = payload[ord_]
+            if expr is None and isinstance(src, int):
+                ord_ = src
+                continue
+            if expr is None and isinstance(src, tuple) and src[0] == "hc":
+                # earlier computed string column: inline its (already
+                # input-resolved) expression
+                return host_computes[src[1]][0]
+            return None  # device-computed column — not string-pure anyway
+        return E.BoundReference(ord_, e.dtype, True, e.name)
     if not e.children:
         return e
-    new_children = tuple(_remap_to_single_ref(c) for c in e.children)
-    return E._rebuild(e, new_children)
+    new_children = []
+    for c in e.children:
+        r = _resolve_to_input(c, steps_before, host_computes)
+        if r is None:
+            return None
+        new_children.append(r)
+    return E._rebuild(e, tuple(new_children))
 
 
 def lower_string_predicate_steps(steps, in_schema):
-    """Rewrite string-predicate subtrees in stage steps to
-    :class:`PrecomputedBool` nodes.
+    """Rewrite string-computable subtrees in stage steps.
 
-    Returns ``(new_steps, host_preds)`` where each host_preds entry is
-    ``(remapped_pred, input_ordinal)``; the stage evaluates them per batch
-    (per distinct value) and passes the bool columns as ``extras``.
+    Returns ``(new_steps, host_exprs)`` where each host_exprs entry is
+    ``(input_resolved_expr, ref_ordinals, kind)`` with kind 'device'
+    (extras column) or 'host' (computed host string output).  Project
+    payload entries for host outputs get ``host_src=("hc", k)``.
     """
-    host_preds: List[Tuple[E.Expression, int]] = []
+    host_exprs: List[Tuple[E.Expression, List[int], str]] = []
 
-    def rewrite(e: E.Expression, steps_before):
-        ref = string_pred_ref(e)
-        if ref is not None:
-            in_ord = _chase_to_input(steps_before, ref)
-            if in_ord is not None:
-                k = len(host_preds)
-                host_preds.append((_remap_to_single_ref(e), in_ord))
-                return PrecomputedBool(k, e)
+    def lower_subtree(e, steps_before) -> E.Expression:
+        kind = lowerable_kind(e)
+        if kind == "device":
+            resolved = _resolve_to_input(e, steps_before, host_exprs)
+            if resolved is not None:
+                k = len(host_exprs)
+                host_exprs.append(
+                    (resolved, sorted(set(_ref_ordinals(resolved))),
+                     "device"))
+                return PrecomputedCol(k, e)
         if not e.children:
             return e
-        new_children = tuple(rewrite(c, steps_before) for c in e.children)
+        new_children = tuple(lower_subtree(c, steps_before)
+                             for c in e.children)
         if all(a is b for a, b in zip(new_children, e.children)):
             return e
         return E._rebuild(e, new_children)
@@ -144,48 +193,100 @@ def lower_string_predicate_steps(steps, in_schema):
     for i, (kind, payload) in enumerate(steps):
         before = new_steps[:i]
         if kind == "filter":
-            new_steps.append((kind, rewrite(payload, before)))
-        else:
-            new_steps.append((kind, [
-                (n, None if e is None else rewrite(e, before), src)
-                for n, e, src in payload]))
-    return new_steps, host_preds
+            new_steps.append((kind, lower_subtree(payload, before)))
+            continue
+        out = []
+        for n, e, src in payload:
+            if e is None:
+                out.append((n, None, src))
+                continue
+            from .planner import strip_alias
+            core = strip_alias(e)
+            if core.dtype is not None and core.dtype.is_string and \
+                    lowerable_kind(core) == "host":
+                resolved = _resolve_to_input(core, before, host_exprs)
+                if resolved is not None:
+                    k = len(host_exprs)
+                    host_exprs.append(
+                        (resolved, sorted(set(_ref_ordinals(resolved))),
+                         "host"))
+                    out.append((n, None, ("hc", k)))
+                    continue
+            out.append((n, lower_subtree(e, before), src))
+        new_steps.append((kind, out))
+    return new_steps, host_exprs
 
 
-def evaluate_host_pred(pred: E.Expression, column, num_rows: int
-                       ) -> Tuple[np.ndarray, np.ndarray]:
-    """Evaluate a lowered predicate over a HostStringColumn's distinct
-    values; returns per-row (bool data, bool valid) of length num_rows."""
-    import pyarrow as pa
+# ---------------------------------------------------------------------------------
+# batch-time evaluation
+# ---------------------------------------------------------------------------------
 
+def _remap_ords(e: E.Expression, mapping) -> E.Expression:
+    if isinstance(e, E.BoundReference):
+        return E.BoundReference(mapping[e.ordinal], e.dtype, True, e.name)
+    if not e.children:
+        return e
+    return E._rebuild(e, tuple(_remap_ords(c, mapping) for c in e.children))
+
+
+def evaluate_host_expr(expr: E.Expression, ords: List[int], columns,
+                       num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Evaluate an input-resolved string-pure expression for one batch.
+
+    ``columns[o]`` must be HostStringColumn for each o in ords.  Returns
+    per-row (data, valid) numpy arrays (object-dtyped data for string
+    outputs).  Single-column expressions evaluate per DISTINCT value."""
     from ..cpu.eval import eval_cpu
 
-    arr = column.array.slice(0, num_rows)
-    denc = arr.dictionary_encode()
-    dict_vals = np.array(denc.dictionary.to_pylist(), dtype=object)
-    k = len(dict_vals)
+    remapped = _remap_ords(expr, {o: i for i, o in enumerate(ords)})
+    np_dt = None if expr.dtype.is_string else expr.dtype.numpy_dtype
 
-    pd_, pv_ = eval_cpu(pred, [(dict_vals, None)], k) if k else \
-        (np.zeros(0, dtype=bool), None)
-    pd_ = np.asarray(pd_, dtype=bool)
-    pv_ = np.ones(k, dtype=bool) if pv_ is None else np.asarray(pv_,
-                                                                dtype=bool)
+    if len(ords) == 1:
+        arr = columns[ords[0]].array.slice(0, num_rows)
+        denc = arr.dictionary_encode()
+        dict_vals = np.array(denc.dictionary.to_pylist(), dtype=object)
+        k = len(dict_vals)
+        if k:
+            pd_, pv_ = eval_cpu(remapped, [(dict_vals, None)], k)
+            pd_ = np.asarray(pd_)
+            pv_ = np.ones(k, dtype=bool) if pv_ is None else \
+                np.asarray(pv_, dtype=bool)
+        else:
+            pd_ = np.zeros(0, dtype=np_dt or object)
+            pv_ = np.zeros(0, dtype=bool)
+        nd, nv = eval_cpu(remapped, [(np.array([None], dtype=object),
+                                      np.array([False]))], 1)
+        null_data = np.asarray(nd)[0]
+        null_valid = True if nv is None else bool(np.asarray(nv)[0])
 
-    # null-input result (IsNull → true, LIKE → null, …): evaluate once on
-    # a single-null column
-    nd, nv = eval_cpu(pred, [(np.array([None], dtype=object),
-                              np.array([False]))], 1)
-    null_data = bool(np.asarray(nd, dtype=bool)[0])
-    null_valid = True if nv is None else bool(np.asarray(nv)[0])
-
-    indices = denc.indices
-    codes = np.asarray(indices.fill_null(0).to_numpy(zero_copy_only=False),
-                       dtype=np.int64)
-    is_null = np.asarray(indices.is_null().to_numpy(zero_copy_only=False))
-    if k:
-        data = np.where(is_null, null_data, pd_[codes])
-        valid = np.where(is_null, null_valid, pv_[codes])
+        indices = denc.indices
+        codes = np.asarray(
+            indices.fill_null(0).to_numpy(zero_copy_only=False),
+            dtype=np.int64)
+        is_null = np.asarray(indices.is_null().to_numpy(
+            zero_copy_only=False))
+        if k:
+            taken = pd_[codes]
+            data = np.where(is_null, null_data, taken)
+            valid = np.where(is_null, null_valid, pv_[codes])
+        else:
+            data = np.full(num_rows, null_data,
+                           dtype=object if np_dt is None else np_dt)
+            valid = np.full(num_rows, null_valid, dtype=bool)
     else:
-        data = np.full(num_rows, null_data, dtype=bool)
-        valid = np.full(num_rows, null_valid, dtype=bool)
-    return data.astype(bool), valid.astype(bool)
+        arrays = []
+        for o in ords:
+            a = columns[o].array.slice(0, num_rows)
+            vals = np.array(a.to_pylist(), dtype=object)
+            nulls = np.asarray(a.is_null().to_numpy(zero_copy_only=False))
+            arrays.append((vals, ~nulls if nulls.any() else None))
+        d, v = eval_cpu(remapped, arrays, num_rows)
+        data = np.asarray(d)
+        valid = np.ones(num_rows, dtype=bool) if v is None else \
+            np.asarray(v, dtype=bool)
+
+    if np_dt is not None and data.dtype != np_dt:
+        # object→typed (null slots carry arbitrary fill; mask via valid)
+        filled = np.array([0 if (x is None) else x for x in data.tolist()])
+        data = filled.astype(np_dt)
+    return data, valid.astype(bool)

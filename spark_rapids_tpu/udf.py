@@ -96,27 +96,45 @@ class UserDefinedFunction(Expression):
         return data, (None if valid.all() else valid)
 
 
-def _wrap(fn, return_type, device, name=None):
+def _wrap(fn, return_type, device, name=None, try_compile=True):
+    from .exprs import UnresolvedColumn
     from .sql.column import Column
 
     def call(*cols):
         exprs = [c.expr if isinstance(c, Column) else
-                 __import__("spark_rapids_tpu.exprs", fromlist=["x"])
-                 .UnresolvedColumn(c) if isinstance(c, str) else c
+                 UnresolvedColumn(c) if isinstance(c, str) else c
                  for c in cols]
-        return Column(UserDefinedFunction(fn, return_type, exprs,
-                                          name=name, device=device))
+        if not device and try_compile:
+            # udf-compiler analog: translate the Python source to an
+            # expression tree so the UDF fuses into device plans; fall back
+            # to the row-wise CPU UDF when outside the supported subset
+            from .udf_compiler import UdfCompileError, compile_udf
+            try:
+                compiled = compile_udf(fn, exprs)
+                if return_type is not None:
+                    from .exprs import Cast
+                    compiled = Cast(compiled, return_type)
+                return Column(compiled)
+            except UdfCompileError:
+                pass
+        return Column(UserDefinedFunction(
+            fn, return_type if return_type is not None else T.FLOAT64,
+            exprs, name=name, device=device))
 
     call.__name__ = name or getattr(fn, "__name__", "udf")
     return call
 
 
-def udf(fn=None, *, return_type: T.DataType = T.FLOAT64, name=None):
-    """Python UDF (CPU): ``@udf(return_type=T.INT64)`` or ``udf(f, ...)``.
-    The enclosing operator falls back to CPU with an explain reason."""
+def udf(fn=None, *, return_type: Optional[T.DataType] = None, name=None,
+        try_compile: bool = True):
+    """Python UDF: the compiler first tries to translate the function's
+    AST into a device expression tree (udf-compiler analog); otherwise it
+    runs row-wise on the CPU fallback path with an explain reason."""
     if fn is None:
-        return lambda f: _wrap(f, return_type, device=False, name=name)
-    return _wrap(fn, return_type, device=False, name=name)
+        return lambda f: _wrap(f, return_type, device=False, name=name,
+                               try_compile=try_compile)
+    return _wrap(fn, return_type, device=False, name=name,
+                 try_compile=try_compile)
 
 
 def tpu_udf(fn=None, *, return_type: T.DataType = T.FLOAT64, name=None):

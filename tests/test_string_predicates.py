@@ -122,3 +122,70 @@ def test_q14_like_shape(session):
     _assert_all_tpu(q)
     p, t = q.collect()[0]
     assert p == 90.0 and t == 150.0
+
+
+class TestHostComputedStringProjections:
+    """String-OUTPUT expressions (upper/concat/substring/regexp_replace)
+    become host-computed columns inside device plans."""
+
+    def test_upper_in_device_plan(self, session):
+        f = F()
+        df = session.create_dataframe(
+            {"s": ["ab", None, "Cd"], "v": [1.0, 2.0, 3.0]})
+        q = df.select(f.upper(f.col("s")).alias("u"), "v")
+        _assert_all_tpu(q)
+        assert q.collect() == [("AB", 1.0), (None, 2.0), ("CD", 3.0)]
+
+    def test_multi_column_concat(self, session):
+        f = F()
+        df = session.create_dataframe({"a": ["x", "y"], "b": ["1", None]})
+        q = df.select(f.concat(f.col("a"), f.col("b")).alias("c"))
+        _assert_all_tpu(q)
+        assert q.collect() == [("x1",), (None,)]
+
+    def test_filter_on_computed_string(self, session):
+        f = F()
+        df = session.create_dataframe(
+            {"s": ["apple", "apricot", "banana"], "v": [1, 2, 3]})
+        q = (df.select(f.substring(f.col("s"), 1, 2).alias("p"), "v")
+             .filter(f.col("p") == "ap").select("v"))
+        _assert_all_tpu(q)
+        assert sorted(r[0] for r in q.collect()) == [1, 2]
+
+    def test_regexp_replace_full_java_regex(self, session):
+        f = F()
+        df = session.create_dataframe({"s": ["a1b22c333", None]})
+        # backreference-free but non-trivial regex the reference's
+        # transpiler handles only partially
+        q = df.select(f.regexp_replace(
+            f.col("s"), r"(\d)\1*", "#").alias("r"))
+        _assert_all_tpu(q)
+        assert q.collect() == [("a#b#c#",), (None,)]
+
+    def test_string_fn_feeding_group_by(self, session, rng):
+        f = F()
+        from .support import StringGen, DoubleGen, gen_table
+        table, pdf = gen_table(rng, {
+            "s": StringGen(alphabet="abC", max_len=4, nullable=True),
+            "v": DoubleGen(special=False, nullable=False)}, 300)
+        df = session.create_dataframe(table)
+        q = (df.select(f.upper(f.col("s")).alias("u"), "v")
+             .group_by("u").agg(f.sum(f.col("v")).alias("sv")))
+        got = dict(q.collect())
+        import pandas as pd
+        s = pdf["s"].astype(object).where(pdf["s"].notna(), None)
+        exp = {}
+        for sv, vv in zip(s, pdf["v"]):
+            key = sv.upper() if sv is not None else None
+            exp[key] = exp.get(key, 0.0) + float(vv)
+        assert set(got) == set(exp)
+        for k in exp:
+            assert got[k] == pytest.approx(exp[k])
+
+    def test_length_of_computed_string(self, session):
+        f = F()
+        df = session.create_dataframe({"s": ["ab", "c", None]})
+        q = df.select(f.length(f.trim(f.concat(f.col("s"), f.lit("  "))))
+                      .alias("n"))
+        _assert_all_tpu(q)
+        assert [r[0] for r in q.collect()] == [2, 1, None]

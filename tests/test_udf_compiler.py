@@ -1,0 +1,112 @@
+"""UDF compiler: Python AST -> device expression trees (udf-compiler module
+analog — LambdaReflection/CatalystExpressionBuilder for JVM bytecode)."""
+
+import math
+
+import numpy as np
+import pytest
+
+
+def F():
+    from spark_rapids_tpu.sql import functions
+    return functions
+
+
+def _all_tpu(df):
+    plan = df.explain_string()
+    return not any(ln.strip().startswith("!") for ln in plan.splitlines()[2:])
+
+
+def test_arith_lambda_compiles_to_device(session):
+    f = F()
+    fn = f.udf(lambda x: x * 2 + 1)
+    df = session.create_dataframe({"x": [1.0, 2.0, None]})
+    q = df.select(fn(f.col("x")).alias("y"))
+    assert _all_tpu(q), q.explain_string()
+    assert [r[0] for r in q.collect()] == [3.0, 5.0, None]
+
+
+def test_conditional_and_null_check(session):
+    from spark_rapids_tpu import types as T
+    f = F()
+    fn = f.udf(lambda a, b: None if a is None or b is None else a * 10 + b,
+               return_type=T.INT64)
+    df = session.create_dataframe({"a": [1, 2, None], "b": [5, None, 7]})
+    q = df.select(fn(f.col("a"), f.col("b")).alias("c"))
+    assert _all_tpu(q), q.explain_string()
+    assert [r[0] for r in q.collect()] == [15, None, None]
+
+
+def test_def_function_with_branches(session):
+    f = F()
+
+    @f.udf
+    def relu6(x):
+        if x < 0:
+            return 0.0
+        if x > 6:
+            return 6.0
+        return x
+
+    df = session.create_dataframe({"x": [-2.0, 3.0, 9.0]})
+    q = df.select(relu6(f.col("x")).alias("y"))
+    assert _all_tpu(q), q.explain_string()
+    assert [r[0] for r in q.collect()] == [0.0, 3.0, 6.0]
+
+
+def test_math_whitelist_and_locals(session):
+    f = F()
+
+    @f.udf
+    def gauss(x):
+        z = (x - 1.0) / 2.0
+        return math.exp(-z * z / 2.0) / math.sqrt(2.0 * math.pi)
+
+    df = session.create_dataframe({"x": [0.0, 1.0, 2.0]})
+    q = df.select(gauss(f.col("x")).alias("g"))
+    assert _all_tpu(q), q.explain_string()
+    got = [r[0] for r in q.collect()]
+    exp = [math.exp(-(((x - 1) / 2) ** 2) / 2) / math.sqrt(2 * math.pi)
+           for x in [0.0, 1.0, 2.0]]
+    np.testing.assert_allclose(got, exp, rtol=1e-12)
+
+
+def test_closure_constant_capture(session):
+    f = F()
+    scale = 2.5
+    fn = f.udf(lambda x: x * scale)
+    df = session.create_dataframe({"x": [2.0, 4.0]})
+    q = df.select(fn(f.col("x")).alias("y"))
+    assert _all_tpu(q)
+    assert [r[0] for r in q.collect()] == [5.0, 10.0]
+
+
+def test_uncompilable_falls_back_to_cpu(session):
+    f = F()
+    fn = f.udf(lambda x: int(str(int(x))[::-1]),
+               return_type=__import__("spark_rapids_tpu").types.INT64)
+    df = session.create_dataframe({"x": [123.0, 450.0]})
+    q = df.select(fn(f.col("x")).alias("r"))
+    assert not _all_tpu(q)  # row-wise CPU UDF with explain reason
+    assert [r[0] for r in q.collect()] == [321, 54]
+
+
+def test_compile_udf_direct():
+    from spark_rapids_tpu.udf_compiler import UdfCompileError, compile_udf
+    from spark_rapids_tpu import exprs as E
+    x = E.UnresolvedColumn("x")
+    e = compile_udf(lambda x: abs(x) if x != 0 else 1.0, [x])
+    assert isinstance(e, E.If)
+    with pytest.raises(UdfCompileError):
+        compile_udf(lambda x: [x], [x])
+    with pytest.raises(UdfCompileError):
+        compile_udf(lambda x: x.upper(), [x])
+
+
+def test_min_max_in_chained_compare(session):
+    f = F()
+    fn = f.udf(lambda a, b: min(a, b) if 0 < a < 10 else max(a, b))
+    df = session.create_dataframe({"a": [5.0, 20.0], "b": [7.0, 3.0]})
+    q = df.select(fn(f.col("a"), f.col("b")).alias("y"))
+    assert _all_tpu(q)
+    assert [r[0] for r in q.collect()] == [5.0, 20.0]
