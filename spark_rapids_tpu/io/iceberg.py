@@ -1,0 +1,184 @@
+"""Apache Iceberg table read support.
+
+Reference: the Java Iceberg bridge (sql-plugin/src/main/java/com/nvidia/
+spark/rapids/iceberg/, 29 files / 5,967 LoC — GpuSparkBatchQueryScan,
+GpuMultiFileBatchReader, GpuDeleteFilter).  The reference reflects into
+iceberg-core; here the table format is read directly: version metadata JSON
+→ snapshot → manifest list (Avro, via the pure-python reader in
+``.avro``) → manifests → active data files with typed partition values —
+exposed as a :class:`..io.parquet.ParquetSource` so pushdown, partition
+pruning, and the decoded-file cache all apply.
+
+Supported: format v1/v2 metadata, snapshot selection (``snapshot_id``),
+identity partition transforms, parquet data files, existing/added manifest
+entries (status ≤ 1).  Not supported: positional/equality deletes
+(GpuDeleteFilter analog), non-identity transforms (bucket/truncate read
+back fine — they only lose file-level pruning).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["IcebergTable", "read_iceberg"]
+
+
+class IcebergTable:
+    def __init__(self, path: str, snapshot_id: Optional[int] = None):
+        self.path = path
+        self.meta_dir = os.path.join(path, "metadata")
+        if not os.path.isdir(self.meta_dir):
+            raise FileNotFoundError(f"not an Iceberg table: {path}")
+        self.metadata = self._load_metadata()
+        self.snapshot = self._pick_snapshot(snapshot_id)
+
+    # -- metadata -----------------------------------------------------------------
+    def _load_metadata(self) -> dict:
+        hint = os.path.join(self.meta_dir, "version-hint.text")
+        candidates: List[str] = []
+        if os.path.exists(hint):
+            with open(hint) as f:
+                v = f.read().strip()
+            for name in (f"v{v}.metadata.json", f"{v}.metadata.json"):
+                p = os.path.join(self.meta_dir, name)
+                if os.path.exists(p):
+                    candidates.append(p)
+        if not candidates:
+            metas = [n for n in os.listdir(self.meta_dir)
+                     if n.endswith(".metadata.json")]
+            if not metas:
+                raise FileNotFoundError(
+                    f"no .metadata.json under {self.meta_dir}")
+
+            def key(n):
+                stem = n.split(".")[0].lstrip("v")
+                num = "".join(c for c in stem.split("-")[0] if c.isdigit())
+                return (int(num) if num else 0, n)
+            candidates.append(os.path.join(self.meta_dir,
+                                           sorted(metas, key=key)[-1]))
+        with open(candidates[0]) as f:
+            return json.load(f)
+
+    def _pick_snapshot(self, snapshot_id: Optional[int]) -> Optional[dict]:
+        snaps = self.metadata.get("snapshots") or []
+        if snapshot_id is not None:
+            for s in snaps:
+                if s["snapshot-id"] == snapshot_id:
+                    return s
+            raise ValueError(f"snapshot {snapshot_id} not found")
+        cur = self.metadata.get("current-snapshot-id")
+        if cur in (None, -1):
+            return None
+        for s in snaps:
+            if s["snapshot-id"] == cur:
+                return s
+        return None
+
+    def schema_fields(self):
+        from .. import types as T
+        from ..batch import Field
+        sch = self.metadata.get("schema")
+        if sch is None:
+            sid = self.metadata.get("current-schema-id", 0)
+            sch = next(s for s in self.metadata["schemas"]
+                       if s.get("schema-id", 0) == sid)
+        out = []
+        for f in sch["fields"]:
+            out.append(Field(f["name"], _iceberg_type(f["type"]),
+                             not f.get("required", False)))
+        return out
+
+    def partition_names(self) -> List[str]:
+        specs = self.metadata.get("partition-specs")
+        if specs:
+            sid = self.metadata.get("default-spec-id", 0)
+            spec = next(s for s in specs if s.get("spec-id", 0) == sid)
+            fields = spec.get("fields", [])
+        else:
+            fields = self.metadata.get("partition-spec", [])
+        return [f["name"] for f in fields
+                if f.get("transform", "identity") == "identity"]
+
+    # -- manifests ----------------------------------------------------------------
+    def _resolve(self, location: str) -> str:
+        """Map a metadata-recorded absolute/URI path into this table dir."""
+        loc = location
+        if "://" in loc:
+            loc = loc.split("://", 1)[1]
+        base = self.metadata.get("location", "")
+        if "://" in base:
+            base = base.split("://", 1)[1]
+        if base and loc.startswith(base):
+            rel = loc[len(base):].lstrip("/")
+            return os.path.join(self.path, rel)
+        if os.path.exists(loc):
+            return loc
+        # fall back: tail-match under the table dir
+        for marker in ("/metadata/", "/data/"):
+            i = loc.find(marker)
+            if i >= 0:
+                return os.path.join(self.path, loc[i + 1:])
+        return loc
+
+    def data_files(self) -> Dict[str, Dict[str, Optional[str]]]:
+        """Active data files → {abs path: {partition name: raw value}}."""
+        from .avro import read_avro_records
+        if self.snapshot is None:
+            return {}
+        out: Dict[str, Dict[str, Optional[str]]] = {}
+        part_names = self.partition_names()
+        mlist = self._resolve(self.snapshot["manifest-list"])
+        _, manifests = read_avro_records(mlist)
+        for m in manifests:
+            mpath = self._resolve(m["manifest_path"])
+            _, entries = read_avro_records(mpath)
+            for e in entries:
+                status = e.get("status", 1)
+                if status == 2:  # DELETED
+                    out.pop(self._resolve(
+                        e["data_file"]["file_path"]), None)
+                    continue
+                df = e["data_file"]
+                if df.get("content", 0) not in (0, None):
+                    raise ValueError(
+                        "delete files (content>0) not supported")
+                fp = self._resolve(df["file_path"])
+                part = df.get("partition") or {}
+                out[fp] = {n: (None if part.get(n) is None
+                               else str(part.get(n)))
+                           for n in part_names}
+        return out
+
+    # -- scan ---------------------------------------------------------------------
+    def source(self, columns=None, **kwargs):
+        from .parquet import ParquetSource
+        files = self.data_files()
+        if not files:
+            raise FileNotFoundError(
+                f"Iceberg table {self.path} has no data files")
+        part_names = self.partition_names()
+        return ParquetSource(self.path, columns=columns,
+                             _paths=sorted(files),
+                             partitions=(part_names, files), **kwargs)
+
+
+def _iceberg_type(t):
+    from .. import types as T
+    if isinstance(t, dict):
+        raise ValueError(f"nested Iceberg type {t.get('type')} unsupported")
+    mapping = {"boolean": T.BOOLEAN, "int": T.INT32, "long": T.INT64,
+               "float": T.FLOAT32, "double": T.FLOAT64, "string": T.STRING,
+               "date": T.DATE, "timestamp": T.TIMESTAMP,
+               "timestamptz": T.TIMESTAMP}
+    if t in mapping:
+        return mapping[t]
+    if isinstance(t, str) and t.startswith("decimal("):
+        p, s = t[8:-1].split(",")
+        return T.decimal(int(p), int(s))
+    raise ValueError(f"Iceberg type {t!r} unsupported")
+
+
+def read_iceberg(path: str, snapshot_id: Optional[int] = None, **kwargs):
+    return IcebergTable(path, snapshot_id).source(**kwargs)

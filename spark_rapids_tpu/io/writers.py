@@ -60,6 +60,8 @@ class _RollingFileWriter:
             self._writer = w
         elif self.fmt == "json":
             self._writer = _JsonLinesWriter(self._path)
+        elif self.fmt == "avro":
+            self._writer = _AvroAccumWriter(self._path)
         else:
             import pyarrow.csv as pacsv
             self._writer = pacsv.CSVWriter(
@@ -113,6 +115,26 @@ class _JsonLinesWriter:
         self._fh.close()
 
 
+class _AvroAccumWriter:
+    """Accumulate then encode on close (the pure-python Avro writer builds
+    one block per file — io/avro.py)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._tables = []
+
+    def write_table(self, table) -> None:
+        self._tables.append(table)
+
+    def close(self) -> None:
+        import pyarrow as pa
+
+        from .avro import write_avro
+        t = pa.concat_tables(self._tables) if self._tables else None
+        if t is not None:
+            write_avro(t, self._path)
+
+
 class DataFrameWriter:
     """``df.write.mode(...).partitionBy(...).parquet(path)`` builder."""
 
@@ -158,6 +180,9 @@ class DataFrameWriter:
 
     def json(self, path: str) -> WriteStats:
         return self._write("json", path)
+
+    def avro(self, path: str) -> WriteStats:
+        return self._write("avro", path)
 
     # -- implementation -----------------------------------------------------------
     def _write(self, fmt: str, path: str) -> WriteStats:

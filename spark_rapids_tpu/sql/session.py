@@ -112,6 +112,39 @@ class Session:
         from ..io.sources import JsonSource
         return self._file_source_df(JsonSource, path, schema=schema)
 
+    def read_avro(self, path, columns=None) -> DataFrame:
+        from ..io.avro import AvroSource
+        return self._file_source_df(AvroSource, path, columns=columns)
+
+    def read_hive_text(self, path, schema=None, sep: str = "\x01"
+                       ) -> DataFrame:
+        """Hive LazySimpleSerDe-style delimited text
+        (GpuHiveTableScanExec / GpuHiveTextFileFormat analog)."""
+        from ..io.sources import CsvSource
+
+        class HiveTextSource(CsvSource):
+            fmt = "hivetext"
+            ext = ""
+
+        return self._file_source_df(HiveTextSource, path, schema=schema,
+                                    header=False, sep=sep)
+
+    def read_iceberg(self, path, snapshot_id: Optional[int] = None
+                     ) -> DataFrame:
+        """Apache Iceberg table (metadata/manifest replay; pure-python
+        Avro manifests — io/iceberg.py)."""
+        from ..io.iceberg import read_iceberg
+        conf = self._tpu_conf()
+        src = read_iceberg(
+            path, snapshot_id=snapshot_id,
+            batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"],
+            num_threads=conf[
+                "spark.rapids.tpu.sql.multiThreadedRead.numThreads"])
+        node = L.LogicalScan(src.schema(), src, src.describe(),
+                             fmt="iceberg")
+        node.source = src
+        return DataFrame(node, self)
+
     def read_delta(self, path, version: Optional[int] = None) -> DataFrame:
         """Delta Lake table (log replay; ``version`` = time travel)."""
         from ..io.delta import read_delta
