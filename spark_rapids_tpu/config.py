@@ -152,11 +152,13 @@ TEST_INJECT_SPLIT_OOM = register(
     internal=True)
 
 SHUFFLE_MODE = register(
-    "spark.rapids.tpu.shuffle.mode", "HOST",
-    "Shuffle transport: HOST (host-staged multithreaded shuffle, works "
-    "everywhere), ICI (XLA all-to-all collectives within a mesh for "
-    "whole-stage-resident execution), CACHE_ONLY (keep partitions resident, "
-    "single process).",
+    "spark.rapids.tpu.shuffle.mode", "CACHE_ONLY",
+    "Shuffle transport: CACHE_ONLY (partitions stay device-resident with "
+    "spillable staging — fastest in one process), HOST (multithreaded "
+    "host-staged shuffle: partition slices leave the device as compressed "
+    "Arrow IPC frames, bounding HBM to one partition — "
+    "RapidsShuffleThreadedWriter analog), ICI (XLA all-to-all collectives "
+    "within a mesh for whole-stage-resident multi-chip execution).",
     check=_one_of("HOST", "ICI", "CACHE_ONLY"))
 
 SHUFFLE_PARTITIONS = register(
@@ -308,3 +310,15 @@ class TpuConf:
                 continue
             lines.append(f"| {e.key} | {e.default} | {e.doc} |")
         return "\n".join(lines)
+
+
+CBO_ENABLED = register(
+    "spark.rapids.tpu.sql.cbo.enabled", False,
+    "Cost-based optimizer: revert device placement for plan sections whose "
+    "estimated row volume is too small to be worth device dispatch "
+    "(CostBasedOptimizer.scala analog; off by default like the reference).")
+
+CBO_MIN_DEVICE_ROWS = register(
+    "spark.rapids.tpu.sql.cbo.minDeviceRows", 1024,
+    "With CBO enabled: minimum estimated rows for a plan section to stay "
+    "on the device.")

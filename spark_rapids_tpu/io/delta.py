@@ -286,3 +286,108 @@ def _partition_values_from_rel(rel: str) -> Dict[str, Optional[str]]:
             k, _, v = comp.partition("=")
             out[k] = None if v == "__HIVE_DEFAULT_PARTITION__" else v
     return out
+
+
+# ---------------------------------------------------------------------------------
+# DELETE / UPDATE commands (GpuDeleteCommand / GpuUpdateCommand analogs)
+# ---------------------------------------------------------------------------------
+
+def delta_delete(session, path: str, condition) -> int:
+    """DELETE FROM <table> WHERE condition; returns the new version.
+
+    Copy-on-write like the reference (GpuDeleteCommand.scala): files with
+    matching rows are rewritten without them (remove+add in one commit);
+    untouched files stay as-is.
+    """
+    return _rewrite_files(session, path, condition, set_exprs=None)
+
+
+def delta_update(session, path: str, set_exprs: dict, condition=None) -> int:
+    """UPDATE <table> SET col=expr WHERE condition (GpuUpdateCommand)."""
+    return _rewrite_files(session, path, condition, set_exprs=set_exprs)
+
+
+def _rewrite_files(session, path, condition, set_exprs) -> int:
+    import pyarrow.parquet as pq
+
+    from ..sql import functions as F
+
+    table = DeltaTable(path)
+    part_cols = table.partition_columns()
+    now_ms = int(time.time() * 1000)
+    removes, adds = [], []
+    for rel, pvals in sorted(table.active.items()):
+        fpath = os.path.join(path, rel)
+        df = session.read_parquet(fpath)
+        # partition values live in the path, not the file: inject them as
+        # literal columns so conditions over partition columns work
+        for c in part_cols:
+            df = df.with_column(c, F.lit(
+                None if pvals.get(c) is None else _typed(pvals[c])))
+        cond_col = condition if condition is not None else F.lit(True)
+        n_match = df.filter(cond_col).count()
+        if n_match == 0:
+            continue  # file untouched
+        if set_exprs is None:
+            kept = df.filter(~cond_col | cond_col.is_null())
+            out_df = kept
+        else:
+            upd = df
+            for col, expr in set_exprs.items():
+                upd = upd.with_column(
+                    col, F.when(cond_col, expr).otherwise(F.col(col)))
+            out_df = upd
+        out_df = out_df.select(*[c for c in df.columns
+                                 if c not in part_cols])
+        removes.append(rel)
+        n_rows = out_df.count()
+        if n_rows > 0 or set_exprs is not None:
+            sub = os.path.dirname(rel)
+            new_name = f"part-{uuid.uuid4().hex}.parquet"
+            new_rel = os.path.join(sub, new_name) if sub else new_name
+            target_dir = os.path.dirname(os.path.join(path, new_rel))
+            os.makedirs(target_dir, exist_ok=True)
+            pq.write_table(out_df.to_arrow(), os.path.join(path, new_rel))
+            adds.append((new_rel, dict(pvals)))
+
+    if not removes:
+        return table.version  # no-op
+
+    version = table.version + 1
+    actions = []
+    for rel in removes:
+        actions.append({"remove": {"path": rel.replace(os.sep, "/"),
+                                   "deletionTimestamp": now_ms,
+                                   "dataChange": True}})
+    for rel, pvals in adds:
+        actions.append({"add": {
+            "path": rel.replace(os.sep, "/"),
+            "partitionValues": pvals,
+            "size": os.path.getsize(os.path.join(path, rel)),
+            "modificationTime": now_ms,
+            "dataChange": True}})
+    actions.append({"commitInfo": {
+        "timestamp": now_ms,
+        "operation": "DELETE" if set_exprs is None else "UPDATE",
+        "engineInfo": "spark_rapids_tpu"}})
+    log_dir = os.path.join(path, _LOG_DIR)
+    commit = os.path.join(log_dir, f"{version:020d}.json")
+    tmp = commit + f".tmp-{uuid.uuid4().hex}"
+    with open(tmp, "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+    if os.path.exists(commit):
+        os.unlink(tmp)
+        raise RuntimeError(f"concurrent Delta commit at version {version}")
+    os.rename(tmp, commit)
+    return version
+
+
+def _typed(raw: str):
+    try:
+        return int(raw)
+    except ValueError:
+        try:
+            return float(raw)
+        except ValueError:
+            return raw

@@ -47,6 +47,12 @@ class SpillableBatch:
         self.device_bytes = batch.device_size_bytes()
         self._lock = threading.Lock()
         self._closed = False
+        # leak canary (cudf MemoryCleaner analog): warn at GC time if the
+        # handle was dropped without close() — disk files would orphan
+        import weakref
+        self._leak_cell = {"closed": False}
+        weakref.finalize(self, _warn_leaked_handle, self._leak_cell,
+                         self.device_bytes)
 
     # -- state moves --------------------------------------------------------------
     def spill_to_host(self) -> int:
@@ -152,6 +158,7 @@ class SpillableBatch:
     def close(self) -> None:
         with self._lock:
             self._closed = True
+            self._leak_cell["closed"] = True
             self._batch = None
             self._host = None
             if self._disk_path:
@@ -161,6 +168,24 @@ class SpillableBatch:
                     pass
                 self._disk_path = None
         self._catalog.unregister(self)
+
+
+_SHUTTING_DOWN: List[bool] = []
+
+import atexit as _atexit
+
+_atexit.register(_SHUTTING_DOWN.append, True)
+
+
+def _warn_leaked_handle(cell: dict, device_bytes: int) -> None:
+    if _SHUTTING_DOWN:
+        return  # interpreter exit: cached frames may legitimately be live
+    if not cell.get("closed"):
+        import logging
+        logging.getLogger("spark_rapids_tpu").warning(
+            "spillable batch handle leaked (never closed; ~%d device "
+            "bytes) — a with_retry/operator is missing a close()",
+            device_bytes)
 
 
 class SpillCatalog:
@@ -194,6 +219,21 @@ class SpillCatalog:
                 self._entries.remove(sb)
             except ValueError:
                 pass
+
+    # -- leak detection (MemoryCleaner / dev/host_memory_leaks analog) ------------
+    def open_handles(self) -> int:
+        """Registered handles never closed — each pins device/host/disk
+        resources; a nonzero count at query end is a leak."""
+        with self._lock:
+            return len(self._entries)
+
+    def assert_no_leaks(self) -> None:
+        with self._lock:
+            leaked = list(self._entries)
+        if leaked:
+            states = [(e.state, e.device_bytes) for e in leaked]
+            raise AssertionError(
+                f"{len(leaked)} spillable batch handle(s) leaked: {states}")
 
     def _note_unspill(self, sb: SpillableBatch) -> None:
         # re-materialized batch counts against the device budget again

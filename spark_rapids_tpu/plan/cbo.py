@@ -1,0 +1,100 @@
+"""Cost-based optimizer: un-tag device sections not worth the transfer.
+
+Reference: CostBasedOptimizer.scala:45-64 — an optional (off-by-default)
+pass that walks the tagged meta tree and reverts GPU placement where the
+modeled GPU time + transfer overhead exceeds the CPU estimate.  The TPU
+cost structure is different — kernels are compiled (first-run compile cost
+is real but amortized), and the dominant avoidable cost on tiny inputs is
+host→HBM upload + dispatch latency — so the model here is simpler: estimate
+row counts bottom-up; device sections whose total row volume is below
+``spark.rapids.tpu.sql.cbo.minDeviceRows`` are reverted to CPU unless they
+sit under a parent that stays on device (transitions are what cost).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import logical as L
+
+__all__ = ["apply_cbo", "estimate_rows"]
+
+
+def estimate_rows(node: L.LogicalPlan) -> Optional[float]:
+    """Bottom-up row estimate; None = unknown."""
+    if isinstance(node, L.LogicalScan):
+        src = getattr(node, "source", None)
+        paths = getattr(src, "paths", None)
+        if paths:
+            try:
+                import os
+                total = sum(os.path.getsize(p) for p in paths)
+                # ~128 bytes/row for columnar parquet-ish data
+                return max(1.0, total / 128.0)
+            except OSError:
+                return None
+        factory = getattr(node, "source_factory", None)
+        for d in (getattr(factory, "__defaults__", None) or ()):
+            n = getattr(d, "num_rows", None)  # create_dataframe closure
+            if isinstance(n, int):
+                return float(n)
+        return None
+    if isinstance(node, L.LogicalRange):
+        return max(0.0, (node.end - node.start) / max(1, node.step))
+    if isinstance(node, L.Filter):
+        c = estimate_rows(node.children[0])
+        return None if c is None else c * 0.5
+    if isinstance(node, L.Limit):
+        c = estimate_rows(node.children[0])
+        return float(node.n) if c is None else min(float(node.n), c)
+    if isinstance(node, L.Aggregate):
+        c = estimate_rows(node.children[0])
+        if c is None:
+            return None
+        return 1.0 if not node.group_exprs else max(1.0, c * 0.1)
+    if isinstance(node, L.Join):
+        l = estimate_rows(node.children[0])
+        r = estimate_rows(node.children[1])
+        if l is None or r is None:
+            return None
+        return max(l, r)
+    if isinstance(node, L.Union):
+        parts = [estimate_rows(c) for c in node.children]
+        return None if any(p is None for p in parts) else sum(parts)
+    if node.children:
+        return estimate_rows(node.children[0])
+    return None
+
+
+def apply_cbo(meta, conf) -> int:
+    """Walk a tagged NodeMeta tree; revert device placement on sections
+    whose estimated volume is below the threshold.  Returns the number of
+    nodes reverted."""
+    if not conf["spark.rapids.tpu.sql.cbo.enabled"]:
+        return 0
+    min_rows = conf["spark.rapids.tpu.sql.cbo.minDeviceRows"]
+    reverted = 0
+
+    def walk(m, parent_on_tpu: bool) -> None:
+        nonlocal reverted
+        if isinstance(m.plan, (L.LogicalScan, L.Cache)):
+            # scans/caches produce device batches regardless; there is no
+            # cheaper CPU variant to revert to
+            for c in m.children:
+                walk(c, m.on_tpu)
+            return
+        if m.on_tpu and not parent_on_tpu:
+            est = estimate_rows(m.plan)
+            if est is not None and est < min_rows:
+                m.will_not_work(
+                    f"CBO: est. {est:.0f} rows < minDeviceRows "
+                    f"{min_rows} (device dispatch not worth it)")
+                reverted += 1
+                for c in m.children:
+                    walk(c, False)
+                return
+        for c in m.children:
+            walk(c, m.on_tpu)
+
+    walk(meta, False)
+    return reverted

@@ -87,6 +87,73 @@ class ShuffleExchangeExec(TpuExec):
         return _cached_program(fp, build)
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        mode = ctx.conf["spark.rapids.tpu.shuffle.mode"]
+        if mode == "HOST":
+            yield from self._execute_host(ctx)
+            return
+        yield from self._execute_device_resident(ctx)
+
+    def _execute_host(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        """Host-staged multithreaded transport: partition slices leave the
+        device as compressed Arrow IPC frames; HBM holds one partition at
+        a time (RapidsShuffleThreadedWriterBase analog)."""
+        import numpy as _np
+
+        from ..batch import from_arrow, to_arrow
+        from ..parallel.host_shuffle import HostShuffle
+        m = ctx.metric_set(self.op_id)
+        pid_fn = self._pid_fn()
+        shuffle = HostShuffle(
+            self.n_parts,
+            ctx.conf["spark.rapids.tpu.memory.spill.dir"],
+            num_threads=ctx.conf[
+                "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
+            compress=ctx.conf["spark.rapids.tpu.shuffle.compress"])
+        try:
+            for batch in self.children[0].execute(ctx):
+                with m.time("opTime"):
+                    arrays = tuple(
+                        (c.data, c.valid) if isinstance(c, DeviceColumn)
+                        else None for c in batch.columns)
+                    if self.string_dicts is not None:
+                        from .join_exec import encode_key_arrays
+                        arrays = encode_key_arrays(
+                            arrays, batch, self.key_exprs,
+                            self.string_dicts)
+                    pids = _np.asarray(pid_fn(
+                        arrays, batch.sel, np.int32(batch.num_rows)))
+                    t = to_arrow(batch_utils.compact(batch))
+                    active_pids = pids[:batch.capacity]
+                    # compact() dropped masked rows; recompute their pids
+                    # on the compacted table via a host mask gather
+                    keep = active_pids < self.n_parts
+                    row_pids = active_pids[keep][:t.num_rows]
+                for p in range(self.n_parts):
+                    sub = t.filter(row_pids == p)
+                    shuffle.write_partition(p, sub)
+                m.add("numInputBatches", 1)
+            with m.time("opTime"):
+                shuffle.finish_writes()
+            min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+            for p in range(self.n_parts):
+                tables = list(shuffle.read_partition(p))
+                with m.time("opTime"):
+                    if not tables:
+                        from .join_exec import _empty_batch
+                        out = _empty_batch(self.output_schema)
+                    else:
+                        import pyarrow as pa
+                        whole = pa.concat_tables(tables)
+                        out = from_arrow(whole, min_capacity=min_cap,
+                                         device=ctx.device)
+                m.add("numOutputRows", out.num_rows)
+                m.add("numOutputBatches", 1)
+                yield out
+        finally:
+            shuffle.close()
+
+    def _execute_device_resident(self, ctx: ExecContext
+                                 ) -> Iterator[ColumnBatch]:
         from ..memory.spill import get_catalog
         m = ctx.metric_set(self.op_id)
         pid_fn = self._pid_fn()

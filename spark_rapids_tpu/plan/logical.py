@@ -206,19 +206,36 @@ class Cache(LogicalPlan):
     stay device-resident and spill to host/disk under memory pressure)."""
 
     def __init__(self, child: LogicalPlan):
+        import threading
+        import weakref
         self.children = (child,)
-        self.materialized = None  # List[SpillableBatch] after first run
-        self.lock = __import__("threading").Lock()
+        self._cell = {"handles": None}  # shared with the GC finalizer
+        self.lock = threading.Lock()
+        # a cache dropped without unpersist() must still release its
+        # spillable handles (disk-tier files would orphan otherwise)
+        weakref.finalize(self, Cache._close_handles, self._cell)
+
+    @property
+    def materialized(self):
+        return self._cell["handles"]
+
+    @materialized.setter
+    def materialized(self, v):
+        self._cell["handles"] = v
+
+    @staticmethod
+    def _close_handles(cell) -> None:
+        handles = cell.get("handles")
+        cell["handles"] = None
+        for h in handles or ():
+            h.close()
 
     def schema(self) -> Schema:
         return self.children[0].schema()
 
     def unpersist(self) -> None:
         with self.lock:
-            if self.materialized is not None:
-                for h in self.materialized:
-                    h.close()
-                self.materialized = None
+            Cache._close_handles(self._cell)
 
     def node_desc(self):
         state = "materialized" if self.materialized else "lazy"

@@ -402,6 +402,8 @@ def apply_overrides(plan: L.LogicalPlan, conf: Optional[TpuConf] = None
     plan = optimize_scans(plan)
     meta = NodeMeta(plan, conf)
     meta.tag()
+    from .cbo import apply_cbo
+    apply_cbo(meta, conf)
     mode = conf["spark.rapids.tpu.sql.mode"]
     explain = conf["spark.rapids.tpu.sql.explain"]
     if explain != "NONE":
@@ -434,6 +436,8 @@ def explain_plan(plan: L.LogicalPlan, conf: Optional[TpuConf] = None) -> str:
     plan = optimize_scans(plan)
     meta = NodeMeta(plan, conf)
     meta.tag()
+    from .cbo import apply_cbo
+    apply_cbo(meta, conf)
     header = ("*  = runs on TPU\n!  = falls back to CPU (reasons follow "
               "on @-lines)\n")
     return header + "\n".join(meta.explain_lines(verbosity="ALL"))

@@ -437,15 +437,26 @@ class AggregateExec(TpuExec):
             yield from self._execute_ungrouped(ctx)
 
     # -- ungrouped ----------------------------------------------------------------
+    def _detached(self) -> "AggregateExec":
+        """Shallow copy with no children, for closures that outlive the
+        query in the program cache — a cached program must pin only the
+        expressions it traces, never the plan tree (operators reference
+        cache nodes, spillable handles, sources)."""
+        import copy
+        d = copy.copy(self)
+        d.children = ()
+        return d
+
     def _execute_ungrouped(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         child = self.children[0]
         m = ctx.metric_set(self.op_id)
         ops = self._buffer_ops()
+        slf = self._detached()
 
         if self.mode == "final":
-            update = self._final_mode_update
+            update = slf._final_mode_update
         else:
-            update = self._update_contributions
+            update = slf._update_contributions
 
         def build():
             @jax.jit
@@ -475,7 +486,7 @@ class AggregateExec(TpuExec):
         # round-trip (measured ~15ms), dwarfing the actual compute
         merge_fn = _cached_program(
             "agg-merge|" + self._fingerprint(),
-            lambda: jax.jit(lambda a, b: self._merge_scalars(a, b, ops)))
+            lambda: jax.jit(lambda a, b: slf._merge_scalars(a, b, ops)))
 
         acc: Optional[List] = None
         for batch in child.execute(ctx):
@@ -600,12 +611,13 @@ class AggregateExec(TpuExec):
         ops = self._buffer_ops()
         n_keys = len(self.group_exprs)
 
+        slf = self._detached()
         if self.mode == "final":
-            update = self._final_mode_update
-            key_eval = self._final_mode_keys
+            update = slf._final_mode_update
+            key_eval = slf._final_mode_keys
         else:
-            update = self._update_contributions
-            key_eval = self._key_contributions
+            update = slf._update_contributions
+            key_eval = slf._key_contributions
 
         def build():
             @jax.jit
@@ -773,13 +785,14 @@ class AggregateExec(TpuExec):
     def _finalize_grouped(self, pending: ColumnBatch) -> ColumnBatch:
         n_keys = len(self.group_exprs)
         arrays = tuple((c.data, c.valid) for c in pending.columns)
+        agg_exprs = self.agg_exprs  # don't capture self in the cached fn
 
         def build():
             @jax.jit
             def fin(arrays):
                 outs = []
                 i = n_keys
-                for name, agg in self.agg_exprs:
+                for name, agg in agg_exprs:
                     nb = len(agg.buffers())
                     data, valid = agg.finalize(
                         [arrays[i + k] for k in range(nb)])

@@ -80,3 +80,46 @@ def test_delta_mode_errors(session, tmp_path):
     v = session.create_dataframe({"x": [2]}).write.mode("ignore").delta(path)
     assert v == 0
     assert [r[0] for r in session.read_delta(path).collect()] == [1]
+
+
+def test_delta_delete(session, tmp_path):
+    from spark_rapids_tpu.io.delta import delta_delete
+    from spark_rapids_tpu.sql import functions as f
+    path = str(tmp_path / "tbl")
+    session.create_dataframe(
+        {"k": [1, 2, 3, 4], "v": [10.0, 20.0, 30.0, 40.0]}).write.delta(path)
+    v = delta_delete(session, path, f.col("k") >= 3)
+    assert v == 1
+    assert sorted(session.read_delta(path).collect()) == \
+        [(1, 10.0), (2, 20.0)]
+    # old version still fully readable
+    assert len(session.read_delta(path, version=0).collect()) == 4
+
+
+def test_delta_update(session, tmp_path):
+    from spark_rapids_tpu.io.delta import delta_update
+    from spark_rapids_tpu.sql import functions as f
+    path = str(tmp_path / "tbl")
+    session.create_dataframe(
+        {"k": [1, 2, 3], "v": [10.0, 20.0, 30.0]}).write.delta(path)
+    delta_update(session, path, {"v": f.col("v") * 100},
+                 condition=f.col("k") == 2)
+    assert sorted(session.read_delta(path).collect()) == \
+        [(1, 10.0), (2, 2000.0), (3, 30.0)]
+
+
+def test_delta_delete_partitioned_untouched_files(session, tmp_path):
+    """Files in non-matching partitions are not rewritten."""
+    import glob
+    from spark_rapids_tpu.io.delta import delta_delete
+    from spark_rapids_tpu.sql import functions as f
+    path = str(tmp_path / "tbl")
+    session.create_dataframe(
+        {"p": [1, 1, 2, 2], "v": [1.0, 2.0, 3.0, 4.0]}) \
+        .write.partitionBy("p").delta(path)
+    files_before = set(glob.glob(os.path.join(path, "p=1", "*.parquet")))
+    delta_delete(session, path, (f.col("p") == 2) & (f.col("v") > 3.0))
+    files_after = set(glob.glob(os.path.join(path, "p=1", "*.parquet")))
+    assert files_before == files_after  # p=1 untouched
+    assert sorted(session.read_delta(path).collect(), key=str) == \
+        sorted([(1.0, 1), (2.0, 1), (3.0, 2)], key=str)

@@ -1,0 +1,83 @@
+"""Host-staged multithreaded shuffle (RapidsShuffleThreadedWriter/Reader
+analog): frame files, compression, and query equivalence vs CACHE_ONLY."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+
+def F():
+    from spark_rapids_tpu.sql import functions
+    return functions
+
+
+def test_frame_roundtrip(tmp_path):
+    from spark_rapids_tpu.parallel.host_shuffle import HostShuffle
+    sh = HostShuffle(3, str(tmp_path), num_threads=2, compress=True)
+    try:
+        t1 = pa.table({"x": pa.array([1, 2, 3], type=pa.int64())})
+        t2 = pa.table({"x": pa.array([4], type=pa.int64())})
+        sh.write_partition(0, t1)
+        sh.write_partition(2, t2)
+        sh.write_partition(0, t2)
+        sh.finish_writes()
+        p0 = list(sh.read_partition(0))
+        assert sum(t.num_rows for t in p0) == 4
+        assert list(sh.read_partition(1)) == []
+        assert [t.num_rows for t in sh.read_partition(2)] == [1]
+    finally:
+        sh.close()
+    assert not os.path.exists(sh.dir)
+
+
+@pytest.mark.parametrize("mode", ["HOST", "CACHE_ONLY"])
+def test_grouped_agg_same_result_both_modes(session, rng, mode):
+    from .support import DoubleGen, IntGen, gen_table
+    f = F()
+    table, pdf = gen_table(rng, {
+        "k": IntGen(lo=0, hi=50, dtype="int64", nullable=True),
+        "v": DoubleGen(special=False, nullable=False)}, 2000)
+    session.conf.set("spark.rapids.tpu.shuffle.mode", mode)
+    try:
+        df = session.create_dataframe(table)
+        got = dict(df.group_by("k").agg(
+            f.sum(f.col("v")).alias("s")).collect())
+    finally:
+        session.conf.unset("spark.rapids.tpu.shuffle.mode")
+    import pandas as pd
+    exp = pdf.groupby("k", dropna=False)["v"].sum()
+    assert len(got) == len(exp)
+    for k, v in exp.items():
+        key = None if pd.isna(k) else int(k)
+        assert got[key] == pytest.approx(v)
+
+
+def test_join_through_host_shuffle(session):
+    f = F()
+    session.conf.set("spark.rapids.tpu.shuffle.mode", "HOST")
+    try:
+        a = session.create_dataframe(
+            {"k": list(range(100)), "x": [float(i) for i in range(100)]})
+        b = session.create_dataframe(
+            {"k": [i for i in range(0, 100, 2)],
+             "y": [float(i * 10) for i in range(0, 100, 2)]})
+        got = sorted(a.join(b, on=["k"]).select("k", "x", "y").collect())
+        assert len(got) == 50
+        assert got[0] == (0, 0.0, 0.0) and got[-1] == (98, 98.0, 980.0)
+    finally:
+        session.conf.unset("spark.rapids.tpu.shuffle.mode")
+
+
+def test_host_shuffle_with_string_values(session):
+    f = F()
+    session.conf.set("spark.rapids.tpu.shuffle.mode", "HOST")
+    try:
+        df = session.create_dataframe(
+            {"k": [1, 2, 1, 3], "s": ["a", "b", None, "c"]})
+        got = sorted(df.group_by("k").agg(
+            f.count(f.col("s")).alias("n")).collect())
+        assert got == [(1, 1), (2, 1), (3, 1)]
+    finally:
+        session.conf.unset("spark.rapids.tpu.shuffle.mode")
