@@ -405,7 +405,31 @@ class Percentile(AggregateExpression):
         return f"{self.func}:{self.q}:{self.dtype}"
 
 
+class CollectList(AggregateExpression):
+    """collect_list: group values into an ARRAY column (AggregateFunctions
+    .scala GpuCollectList).  Like Percentile it needs materialized groups —
+    runs on the CPU operator; the result rides as a host arrow list
+    column."""
+
+    func = "collect_list"
+    device_supported = False
+
+    def _resolve(self):
+        self.dtype = T.array(self.children[0].dtype)
+        self.nullable = False  # empty group → empty array, like Spark
+
+    def _fp_extra(self):
+        return f"{self.func}:{self.dtype}"
+
+
+class CollectSet(CollectList):
+    """collect_set: distinct values per group (order unspecified)."""
+
+    func = "collect_set"
+
+
 AGG_CLASSES = {c.func: c for c in
                [Sum, Count, CountStar, Min, Max, Average, First, Last,
                 VariancePop, VarianceSamp, StddevPop, StddevSamp,
-                CovarPop, CovarSamp, Corr, Percentile]}
+                CovarPop, CovarSamp, Corr, Percentile, CollectList,
+                CollectSet]}

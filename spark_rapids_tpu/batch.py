@@ -131,8 +131,6 @@ class HostStringColumn:
     uses device-side dictionary codes (see ops/strings.py).
     """
 
-    dtype = T.STRING
-
     def __init__(self, array, capacity: Optional[int] = None):
         import pyarrow as pa
         if isinstance(array, pa.ChunkedArray):
@@ -141,10 +139,16 @@ class HostStringColumn:
             array = pa.array(array, type=pa.string())
         if pa.types.is_large_string(array.type):
             array = array.cast(pa.string())
+        if pa.types.is_large_list(array.type):
+            array = array.cast(pa.list_(array.type.value_type))
         if capacity is not None and len(array) < capacity:
             array = pa.concat_arrays(
                 [array, pa.nulls(capacity - len(array), type=array.type)])
         self.array = array
+        # also carries ARRAY<...> columns (collect_list output): any arrow
+        # type with no device representation rides as a host column
+        self.dtype = T.STRING if pa.types.is_string(array.type) \
+            else _arrow_to_logical(array.type)
 
     @property
     def capacity(self) -> int:
@@ -254,6 +258,8 @@ def _arrow_to_logical(pa_type) -> DataType:
         return T.TIMESTAMP
     if pa.types.is_decimal(pa_type):
         return T.decimal(pa_type.precision, pa_type.scale)
+    if pa.types.is_list(pa_type) or pa.types.is_large_list(pa_type):
+        return T.array(_arrow_to_logical(pa_type.value_type))
     raise TypeError(f"unsupported arrow type {pa_type}")
 
 
@@ -267,6 +273,8 @@ def logical_to_arrow(dt: DataType):
     }
     if dt.is_decimal:
         return pa.decimal128(dt.precision, dt.scale)
+    if dt.kind == T.TypeKind.ARRAY:
+        return pa.list_(logical_to_arrow(dt.element))
     return m[dt]
 
 
@@ -290,7 +298,7 @@ def from_arrow(table, min_capacity: int = 1024, device=None) -> ColumnBatch:
             col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
         dt = _arrow_to_logical(col.type)
         fields.append(Field(name, dt, col.null_count > 0))
-        if dt.is_string:
+        if dt.is_string or dt.is_nested:
             cols.append(HostStringColumn(col, capacity=cap))
             continue
         if dt.is_decimal:

@@ -58,13 +58,22 @@ def arrow_to_values(table, schema: Schema):
     return vals
 
 
+def _py_scalar(v):
+    """numpy scalar → plain python (arrow list building wants natives)."""
+    return v.item() if hasattr(v, "item") else v
+
+
 def values_to_arrow(schema: Schema, values, n: int):
     import pyarrow as pa
     from ..batch import logical_to_arrow
     arrays = []
     for f, (data, valid) in zip(schema, values):
         mask = None if valid is None else ~valid
-        if f.dtype.is_string:
+        if f.dtype.is_nested:
+            pl = [None if (mask is not None and mask[i]) else data[i]
+                  for i in range(n)]
+            arrays.append(pa.array(pl, type=logical_to_arrow(f.dtype)))
+        elif f.dtype.is_string:
             pl = [None if (mask is not None and mask[i]) else data[i]
                   for i in range(n)]
             arrays.append(pa.array(pl, type=pa.string()))
@@ -139,6 +148,23 @@ class CpuOpExec(TpuExec):
                 self.children[0].output_schema.names()).aggregate([])
         if isinstance(p, L.Window):
             return self._run_window(ctx, p)
+        if isinstance(p, L.Generate):
+            t = self._child_table(ctx)
+            pdf = t.to_pandas()
+            out = pdf.explode(p.column, ignore_index=True)
+            if not p.outer:
+                # empty/null arrays explode to a NaN row; plain EXPLODE
+                # drops them (OUTER keeps them as null)
+                out = out[out[p.column].notna()].reset_index(drop=True)
+            out = out.rename(columns={p.column: p.out_name})
+            import pyarrow as pa
+            from ..batch import logical_to_arrow
+            sch = p.schema()
+            return pa.table({
+                f.name: pa.array(out[f.name],
+                                 type=logical_to_arrow(f.dtype),
+                                 from_pandas=True)
+                for f in sch})
         if isinstance(p, L.Sample):
             t = self._child_table(ctx)
             rng = np.random.default_rng(p.seed)
@@ -221,7 +247,8 @@ class CpuOpExec(TpuExec):
             key_outs.append((kd, None if kv.all() else kv))
         agg_outs = []
         for name, b, child_vals in agg_specs:
-            od = np.zeros(out_rows, dtype=self._agg_np_dtype(b))
+            od = np.empty(out_rows, dtype=object) if b.dtype.is_nested \
+                else np.zeros(out_rows, dtype=self._agg_np_dtype(b))
             ov = np.ones(out_rows, dtype=bool)
             for gi, gk in enumerate(group_keys):
                 idx = grouped.indices[gk]
@@ -233,6 +260,8 @@ class CpuOpExec(TpuExec):
 
     @staticmethod
     def _agg_np_dtype(b):
+        if b.dtype.is_nested:
+            return object  # list payloads (collect_list / collect_set)
         return b.dtype.numpy_dtype
 
     @staticmethod
@@ -307,6 +336,19 @@ class CpuOpExec(TpuExec):
             else:
                 var = m2 / n_
             return (np.sqrt(var) if b.sqrt else var), True
+        if isinstance(b, A.CollectList):
+            src = b.children[0].dtype
+            vals = cd[sel]
+            if src.is_decimal:
+                vals = vals.astype(np.float64) / 10 ** src.scale
+            pyvals = list(vals) if not isinstance(vals, list) else vals
+            if isinstance(b, A.CollectSet):
+                seen = []
+                for v in pyvals:
+                    if v not in seen:
+                        seen.append(v)
+                pyvals = seen
+            return [_py_scalar(v) for v in pyvals], True
         if isinstance(b, A.Percentile):
             src = b.children[0].dtype
             xf = x.astype(np.float64)
@@ -327,8 +369,12 @@ class CpuOpExec(TpuExec):
     def _agg_scalar(self, b, child_vals, n):
         idx = np.arange(n)
         val, ok = self._agg_one(b, child_vals, idx)
-        return (np.array([val], dtype=self._agg_np_dtype(b)),
-                None if ok else np.array([False]))
+        if b.dtype.is_nested:
+            out = np.empty(1, dtype=object)
+            out[0] = val
+        else:
+            out = np.array([val], dtype=self._agg_np_dtype(b))
+        return out, None if ok else np.array([False])
 
     def _run_sort(self, ctx, p: L.Sort):
         import pyarrow as pa

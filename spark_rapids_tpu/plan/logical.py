@@ -199,6 +199,32 @@ class LogicalRange(LogicalPlan):
         return f"Range ({self.start}, {self.end}, step={self.step})"
 
 
+class Generate(LogicalPlan):
+    """Explode an ARRAY column into one row per element
+    (GpuGenerateExec analog; ``outer`` keeps empty/null arrays as a null
+    row like OUTER EXPLODE)."""
+
+    def __init__(self, child: LogicalPlan, column: str, out_name: str,
+                 outer: bool = False):
+        self.children = (child,)
+        self.column = column
+        self.out_name = out_name
+        self.outer = outer
+
+    def schema(self) -> Schema:
+        fields = []
+        for f in self.children[0].schema():
+            if f.name == self.column:
+                fields.append(Field(self.out_name, f.dtype.element, True))
+            else:
+                fields.append(f)
+        return Schema(fields)
+
+    def node_desc(self):
+        kind = "explode_outer" if self.outer else "explode"
+        return f"Generate {kind}({self.column}) as {self.out_name}"
+
+
 class Cache(LogicalPlan):
     """df.cache() — materialized batches live in the spill catalog as
     spillable handles (ParquetCachedBatchSerializer.scala:264 analog: the

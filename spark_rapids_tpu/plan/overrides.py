@@ -172,6 +172,11 @@ class NodeMeta:
                 for r in expr_reasons(b, allow_string_passthrough=False):
                     self.will_not_work(f"sort key: {r}")
             return
+        if isinstance(p, L.Generate):
+            self.will_not_work(
+                "explode of array columns runs on CPU (no device array "
+                "representation yet)")
+            return
         if isinstance(p, (L.Limit, L.Union, L.LogicalRange, L.Distinct,
                           L.Sample, L.Cache)):
             # Distinct groups by bare column references — string columns

@@ -163,6 +163,14 @@ class DataFrame:
     def offset(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(self._plan, 1 << 62, offset=n), self.session)
 
+    def explode(self, column: str, out_name: Optional[str] = None,
+                outer: bool = False) -> "DataFrame":
+        """One row per array element of ``column`` (GenerateExec/explode);
+        ``outer`` keeps empty/null arrays as a null row."""
+        return DataFrame(L.Generate(self._plan, column,
+                                    out_name or column, outer=outer),
+                         self.session)
+
     def cache(self) -> "DataFrame":
         """Materialize this result in the spill catalog on first use;
         later actions replay the cached batches (InMemoryTableScan)."""

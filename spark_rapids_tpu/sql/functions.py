@@ -581,3 +581,13 @@ def tpu_udf(fn=None, *, return_type=None, name=None):
     if name is not None:
         kwargs["name"] = name
     return _tpu_udf(fn, **kwargs) if fn is not None else _tpu_udf(**kwargs)
+
+
+def collect_list(c) -> Column:
+    """Group values into an array (runs on the CPU operator; result rides
+    as a host arrow list column)."""
+    return Column(A.CollectList(_colref(c)))
+
+
+def collect_set(c) -> Column:
+    return Column(A.CollectSet(_colref(c)))

@@ -147,6 +147,12 @@ TIMESTAMP = DataType(TypeKind.TIMESTAMP)
 NULLTYPE = DataType(TypeKind.NULL)
 
 
+def array(element: DataType) -> DataType:
+    """ARRAY<element> — produced by collect_list/collect_set; carried as
+    host arrow list columns (no device representation)."""
+    return DataType(TypeKind.ARRAY, element=element)
+
+
 def decimal(precision: int, scale: int) -> DataType:
     if precision > 18:
         # decimal128 requires emulated wide-int kernels (SURVEY.md §7.3); the

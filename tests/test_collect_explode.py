@@ -1,0 +1,63 @@
+"""collect_list/collect_set aggregates and explode/Generate
+(GpuCollectList / GpuGenerateExec analogs; array columns ride as host
+arrow list columns)."""
+
+import pyarrow as pa
+import pytest
+
+
+def F():
+    from spark_rapids_tpu.sql import functions
+    return functions
+
+
+def test_collect_list_grouped(session):
+    f = F()
+    df = session.create_dataframe(
+        {"k": [1, 2, 1, 2, 1], "v": [10, 20, 30, 20, None]})
+    got = dict(df.group_by("k").agg(
+        f.collect_list(f.col("v")).alias("vs")).collect())
+    assert got[1] == [10, 30]  # nulls skipped, order preserved
+    assert got[2] == [20, 20]
+
+
+def test_collect_set_dedups(session):
+    f = F()
+    df = session.create_dataframe({"k": [1, 1, 1], "s": ["a", "b", "a"]})
+    got = df.group_by("k").agg(
+        f.collect_set(f.col("s")).alias("ss")).collect()
+    assert sorted(got[0][1]) == ["a", "b"]
+
+
+def test_collect_list_ungrouped_and_roundtrip(session, tmp_path):
+    f = F()
+    df = session.create_dataframe({"v": [1.5, 2.5]})
+    got = df.agg(f.collect_list(f.col("v")).alias("vs")).collect()
+    assert got == [([1.5, 2.5],)]
+
+
+def test_explode_roundtrip(session):
+    f = F()
+    df = session.create_dataframe({"k": [1, 2, 3], "v": [1, 2, 3]})
+    lists = df.group_by("k").agg(f.collect_list(f.col("v")).alias("vs"))
+    back = lists.explode("vs", out_name="v2")
+    got = sorted(back.collect())
+    assert got == [(1, 1), (2, 2), (3, 3)]
+
+
+def test_explode_from_arrow_lists(session):
+    t = pa.table({"id": pa.array([1, 2, 3], type=pa.int64()),
+                  "arr": pa.array([[10, 20], [], None],
+                                  type=pa.list_(pa.int64()))})
+    df = session.create_dataframe(t)
+    got = sorted(df.explode("arr", out_name="x").collect())
+    assert got == [(1, 10), (1, 20)]  # empty + null arrays dropped
+    outer = sorted(df.explode("arr", out_name="x", outer=True).collect(),
+                   key=str)
+    assert (2, None) in outer and (3, None) in outer and len(outer) == 4
+
+
+def test_explode_plan_reason(session):
+    t = pa.table({"arr": pa.array([[1]], type=pa.list_(pa.int64()))})
+    plan = session.create_dataframe(t).explode("arr").explain_string()
+    assert "CPU" in plan and "array" in plan
