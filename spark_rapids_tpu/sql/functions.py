@@ -591,3 +591,14 @@ def collect_list(c) -> Column:
 
 def collect_set(c) -> Column:
     return Column(A.CollectSet(_colref(c)))
+
+
+def pandas_udf(fn=None, *, return_type=None, name=None):
+    """Vectorized pandas UDF (Series -> Series) on the CPU operator."""
+    from ..udf import pandas_udf as _pudf
+    kwargs = {}
+    if return_type is not None:
+        kwargs["return_type"] = return_type
+    if name is not None:
+        kwargs["name"] = name
+    return _pudf(fn, **kwargs) if fn is not None else _pudf(**kwargs)

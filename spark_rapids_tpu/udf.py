@@ -8,6 +8,10 @@ Reference, two tiers mirrored exactly (SURVEY §2.3 UDF support):
   a **jax-traceable** function over ``jnp`` arrays; it inlines into the
   enclosing stage's XLA computation like any built-in expression, so a
   device UDF costs nothing extra at runtime.
+* Vectorized pandas UDFs (``pandas_udf``) — Series→Series functions run
+  in column batches on the CPU operator (the pandas-UDF exec family,
+  GpuArrowEvalPythonExec, minus the worker process: there is no JVM
+  boundary to escape here).
 * Plain Scala/Python UDFs — opaque functions the planner cannot translate;
   the reference runs the enclosing project on CPU (GpuOverrides tags the
   expression unsupported).  Same here: a Python UDF tags its node for CPU
@@ -25,7 +29,7 @@ import numpy as np
 from . import types as T
 from .exprs import Expression, Value, _and_valid
 
-__all__ = ["UserDefinedFunction", "udf", "tpu_udf"]
+__all__ = ["UserDefinedFunction", "udf", "tpu_udf", "pandas_udf"]
 
 
 class UserDefinedFunction(Expression):
@@ -78,8 +82,13 @@ class UserDefinedFunction(Expression):
             data = data.astype(np_dt)
         return data, valid
 
+    vectorized = False  # pandas_udf: fn maps pd.Series -> pd.Series
+
     def eval_rows(self, child_values, n: int):
-        """CPU row-wise evaluation (numpy in/out, Spark null convention)."""
+        """CPU evaluation: row-wise python, or pandas-Series-vectorized
+        (GpuArrowEvalPythonExec analog minus the worker process — the
+        'worker' is in-process since there is no JVM boundary to escape)."""
+        import pandas as pd
         cols = []
         for (d, v), c in zip(child_values, self.children):
             vals = [None if (v is not None and not v[i]) else d[i]
@@ -88,6 +97,18 @@ class UserDefinedFunction(Expression):
                 vals = [None if x is None else x / 10 ** c.dtype.scale
                         for x in vals]
             cols.append(vals)
+        if self.vectorized:
+            series = [pd.Series(c) for c in cols]
+            res = self.fn(*series)
+            if not isinstance(res, pd.Series):
+                res = pd.Series(res)
+            valid = res.notna().to_numpy()
+            np_dt = self.dtype.numpy_dtype
+            if np_dt is not None:
+                data = res.fillna(0).to_numpy().astype(np_dt)
+            else:
+                data = res.to_numpy(dtype=object)
+            return data, (None if valid.all() else valid)
         results = [self.fn(*row) for row in zip(*cols)]
         valid = np.array([r is not None for r in results])
         np_dt = self.dtype.numpy_dtype or object
@@ -96,7 +117,8 @@ class UserDefinedFunction(Expression):
         return data, (None if valid.all() else valid)
 
 
-def _wrap(fn, return_type, device, name=None, try_compile=True):
+def _wrap(fn, return_type, device, name=None, try_compile=True,
+          vectorized=False):
     from .exprs import UnresolvedColumn
     from .sql.column import Column
 
@@ -104,6 +126,12 @@ def _wrap(fn, return_type, device, name=None, try_compile=True):
         exprs = [c.expr if isinstance(c, Column) else
                  UnresolvedColumn(c) if isinstance(c, str) else c
                  for c in cols]
+        if vectorized:
+            u = UserDefinedFunction(
+                fn, return_type if return_type is not None else T.FLOAT64,
+                exprs, name=name, device=False)
+            u.vectorized = True
+            return Column(u)
         if not device and try_compile:
             # udf-compiler analog: translate the Python source to an
             # expression tree so the UDF fuses into device plans; fall back
@@ -143,3 +171,14 @@ def tpu_udf(fn=None, *, return_type: T.DataType = T.FLOAT64, name=None):
     if fn is None:
         return lambda f: _wrap(f, return_type, device=True, name=name)
     return _wrap(fn, return_type, device=True, name=name)
+
+
+def pandas_udf(fn=None, *, return_type: Optional[T.DataType] = None,
+               name=None):
+    """Vectorized pandas UDF: ``fn`` maps pandas Series → Series; runs on
+    the CPU operator in column batches (the pandas-UDF exec family analog
+    — no worker process needed without a JVM boundary)."""
+    if fn is None:
+        return lambda f: _wrap(f, return_type, device=False, name=name,
+                               vectorized=True)
+    return _wrap(fn, return_type, device=False, name=name, vectorized=True)

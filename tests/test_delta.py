@@ -123,3 +123,83 @@ def test_delta_delete_partitioned_untouched_files(session, tmp_path):
     assert files_before == files_after  # p=1 untouched
     assert sorted(session.read_delta(path).collect(), key=str) == \
         sorted([(1.0, 1), (2.0, 1), (3.0, 2)], key=str)
+
+
+def test_delta_merge_upsert(session, tmp_path):
+    from spark_rapids_tpu.io.delta import delta_merge
+    path = str(tmp_path / "tbl")
+    session.create_dataframe(
+        {"k": [1, 2, 3], "v": [10.0, 20.0, 30.0]}).write.delta(path)
+    src = session.create_dataframe({"k": [2, 4], "v": [200.0, 400.0]})
+    v = delta_merge(session, path, src, on=["k"])
+    assert v == 1
+    got = sorted(session.read_delta(path).collect())
+    assert got == [(1, 10.0), (2, 200.0), (3, 30.0), (4, 400.0)]
+    # time travel still shows the pre-merge state
+    assert sorted(session.read_delta(path, version=0).collect()) == \
+        [(1, 10.0), (2, 20.0), (3, 30.0)]
+
+
+def test_delta_merge_delete_matched(session, tmp_path):
+    from spark_rapids_tpu.io.delta import delta_merge
+    path = str(tmp_path / "tbl")
+    session.create_dataframe(
+        {"k": [1, 2, 3], "v": [10.0, 20.0, 30.0]}).write.delta(path)
+    src = session.create_dataframe({"k": [1, 3], "v": [0.0, 0.0]})
+    delta_merge(session, path, src, on=["k"], matched="delete",
+                insert_not_matched=False)
+    assert session.read_delta(path).collect() == [(2, 20.0)]
+
+
+def test_delta_merge_untouched_files_stay(session, tmp_path):
+    import glob
+    from spark_rapids_tpu.io.delta import delta_merge
+    path = str(tmp_path / "tbl")
+    session.create_dataframe(
+        {"p": [1, 1, 2, 2], "v": [1.0, 2.0, 3.0, 4.0]}) \
+        .write.partitionBy("p").delta(path)
+    before = set(glob.glob(os.path.join(path, "p=1", "*.parquet")))
+    src = session.create_dataframe({"p": [2], "v": [300.0]})
+    delta_merge(session, path, src, on=["p"], insert_not_matched=False)
+    after = set(glob.glob(os.path.join(path, "p=1", "*.parquet")))
+    assert before == after  # p=1 files untouched
+    got = sorted(session.read_delta(path).collect(), key=str)
+    # both p=2 rows matched the single source row -> both updated
+    assert got == sorted([(1.0, 1), (2.0, 1), (300.0, 2), (300.0, 2)],
+                         key=str)
+
+
+def test_delta_merge_partitioned_insert_lands_in_partition(session, tmp_path):
+    from spark_rapids_tpu.io.delta import delta_merge
+    path = str(tmp_path / "tbl")
+    session.create_dataframe(
+        {"p": [1, 2], "v": [10.0, 20.0]}).write.partitionBy("p").delta(path)
+    src = session.create_dataframe({"p": [3], "v": [30.0]})
+    delta_merge(session, path, src, on=["p"])
+    assert os.path.isdir(os.path.join(path, "p=3"))
+    got = sorted(session.read_delta(path).collect(), key=str)
+    assert got == sorted([(10.0, 1), (20.0, 2), (30.0, 3)], key=str)
+
+
+def test_delta_merge_multiple_matches_raises(session, tmp_path):
+    from spark_rapids_tpu.io.delta import delta_merge
+    path = str(tmp_path / "tbl")
+    session.create_dataframe({"k": [1], "v": [10.0]}).write.delta(path)
+    src = session.create_dataframe({"k": [1, 1], "v": [1.0, 2.0]})
+    with pytest.raises(RuntimeError, match="multiple source rows"):
+        delta_merge(session, path, src, on=["k"], insert_not_matched=False)
+
+
+def test_delta_merge_rejects_partition_update_and_missing_cols(
+        session, tmp_path):
+    from spark_rapids_tpu.io.delta import delta_merge
+    path = str(tmp_path / "tbl")
+    session.create_dataframe(
+        {"p": [1], "k": [1], "v": [10.0]}).write.partitionBy("p").delta(path)
+    src = session.create_dataframe({"k": [1], "p": [2], "v": [0.0]})
+    with pytest.raises(ValueError, match="partition column"):
+        delta_merge(session, path, src, on=["k"],
+                    matched_set={"p": "p"}, insert_not_matched=False)
+    narrow = session.create_dataframe({"k": [9], "v": [1.0]})
+    with pytest.raises(ValueError, match="missing"):
+        delta_merge(session, path, narrow, on=["k"])

@@ -78,3 +78,33 @@ def test_tpu_udf_composes_with_exprs(session):
             .select((sq(f.col("x")) + f.lit(1.0)).alias("y")) \
             .agg(f.sum(f.col("y")).alias("s"))
     assert out.collect()[0][0] == (4.0 + 1) + (9.0 + 1) + (16.0 + 1)
+
+
+def test_pandas_udf_vectorized(session):
+    import pandas as pd
+    from spark_rapids_tpu import types as T
+    f = F()
+
+    @f.pandas_udf(return_type=T.FLOAT64)
+    def zscore(s):
+        return (s - s.mean()) / s.std(ddof=0)
+
+    df = session.create_dataframe({"x": [1.0, 2.0, 3.0, 4.0]})
+    out = df.select(zscore(f.col("x")).alias("z"))
+    plan = out.explain_string()
+    assert "python UDF" in plan  # CPU with reason, like opaque UDFs
+    got = [r[0] for r in out.collect()]
+    import numpy as np
+    exp = (np.array([1, 2, 3, 4.0]) - 2.5) / np.std([1, 2, 3, 4.0])
+    np.testing.assert_allclose(got, exp, rtol=1e-12)
+
+
+def test_pandas_udf_two_series_with_nulls(session):
+    from spark_rapids_tpu import types as T
+    f = F()
+    add = f.pandas_udf(lambda a, b: a + b, return_type=T.FLOAT64)
+    df = session.create_dataframe({"a": [1.0, None, 3.0],
+                                   "b": [10.0, 20.0, None]})
+    got = [r[0] for r in df.select(add(f.col("a"), f.col("b")).alias("c"))
+           .collect()]
+    assert got == [11.0, None, None]
