@@ -289,3 +289,97 @@ def test_q1_and_q6_on_minidb(db):
         assert g[2] == pytest.approx(e.sum_qty)
         assert g[5] == pytest.approx(e.sum_charge)
         assert g[9] == e.count_order
+
+
+def test_q7_volume_shipping(db):
+    """Q7 shape: supplier/customer nation pair volumes by year."""
+    f = F()
+    dfs, pds = db
+    n1, n2 = "FRANCE", "GERMANY"
+    lo = datetime.date(1995, 1, 1)
+    hi = datetime.date(1996, 12, 31)
+    sup_n = dfs["nation"].filter(f.col("n_name").isin(n1, n2)) \
+        .select(f.col("n_nationkey").alias("sn_key"),
+                f.col("n_name").alias("supp_nation"))
+    cust_n = dfs["nation"].filter(f.col("n_name").isin(n1, n2)) \
+        .select(f.col("n_nationkey").alias("cn_key"),
+                f.col("n_name").alias("cust_nation"))
+    q = (dfs["supplier"].join(sup_n, on=[("s_nationkey", "sn_key")])
+         .join(dfs["lineitem"], on=[("s_suppkey", "l_suppkey")])
+         .filter((f.col("l_shipdate") >= lo) & (f.col("l_shipdate") <= hi))
+         .join(dfs["orders"], on=[("l_orderkey", "o_orderkey")])
+         .join(dfs["customer"], on=[("o_custkey", "c_custkey")])
+         .join(cust_n, on=[("c_nationkey", "cn_key")])
+         .filter(((f.col("supp_nation") == n1)
+                  & (f.col("cust_nation") == n2))
+                 | ((f.col("supp_nation") == n2)
+                    & (f.col("cust_nation") == n1)))
+         .select("supp_nation", "cust_nation",
+                 f.year(f.col("l_shipdate")).alias("l_year"),
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount")))
+                 .alias("volume"))
+         .group_by("supp_nation", "cust_nation", "l_year")
+         .agg(f.sum(f.col("volume")).alias("revenue"))
+         .sort("supp_nation", "cust_nation", "l_year"))
+    got = _rows(q)
+
+    s, l, o, c, n = (pds[k] for k in
+                     ["supplier", "lineitem", "orders", "customer",
+                      "nation"])
+    nn = n[n.n_name.isin([n1, n2])]
+    m = (s.merge(nn.rename(columns={"n_nationkey": "sn_key",
+                                    "n_name": "supp_nation"})[
+        ["sn_key", "supp_nation"]], left_on="s_nationkey",
+        right_on="sn_key")
+         .merge(l[(l.l_shipdate >= lo) & (l.l_shipdate <= hi)],
+                left_on="s_suppkey", right_on="l_suppkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(nn.rename(columns={"n_nationkey": "cn_key",
+                                   "n_name": "cust_nation"})[
+             ["cn_key", "cust_nation"]], left_on="c_nationkey",
+             right_on="cn_key"))
+    m = m[((m.supp_nation == n1) & (m.cust_nation == n2))
+          | ((m.supp_nation == n2) & (m.cust_nation == n1))]
+    m["l_year"] = pd.to_datetime(m.l_shipdate).dt.year
+    m["volume"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = (m.groupby(["supp_nation", "cust_nation", "l_year"])["volume"]
+           .sum().reset_index()
+           .sort_values(["supp_nation", "cust_nation", "l_year"]))
+    _close(got, [(r.supp_nation, r.cust_nation, int(r.l_year), r.volume)
+                 for r in exp.itertuples()])
+
+
+def test_q9_product_type_profit(db):
+    """Q9 shape: profit by nation and year over a 5-way join with a
+    LIKE part filter."""
+    f = F()
+    dfs, pds = db
+    q = (dfs["part"].filter(f.col("p_name").like("%goldenrod%"))
+         .join(dfs["lineitem"], on=[("p_partkey", "l_partkey")])
+         .join(dfs["supplier"], on=[("l_suppkey", "s_suppkey")])
+         .join(dfs["nation"], on=[("s_nationkey", "n_nationkey")])
+         .join(dfs["orders"], on=[("l_orderkey", "o_orderkey")])
+         .select(f.col("n_name").alias("nation"),
+                 f.year(f.col("o_orderdate")).alias("o_year"),
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount"))
+                  - f.lit(0.01) * f.col("l_quantity")).alias("amount"))
+         .group_by("nation", "o_year")
+         .agg(f.sum(f.col("amount")).alias("sum_profit"))
+         .sort("nation", f.col("o_year").desc()))
+    got = _rows(q)
+
+    pt, l, s, n, o = (pds[k] for k in
+                      ["part", "lineitem", "supplier", "nation", "orders"])
+    m = (pt[pt.p_name.str.contains("goldenrod")]
+         .merge(l, left_on="p_partkey", right_on="l_partkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey"))
+    m["o_year"] = pd.to_datetime(m.o_orderdate).dt.year
+    m["amount"] = (m.l_extendedprice * (1 - m.l_discount)
+                   - 0.01 * m.l_quantity)
+    exp = (m.groupby(["n_name", "o_year"])["amount"].sum().reset_index()
+           .sort_values(["n_name", "o_year"], ascending=[True, False]))
+    _close(got, [(r.n_name, int(r.o_year), r.amount)
+                 for r in exp.itertuples()])
