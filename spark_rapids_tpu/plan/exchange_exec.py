@@ -48,13 +48,19 @@ class ShuffleExchangeExec(TpuExec):
     outputs_partitions = True
 
     def __init__(self, child: TpuExec, key_exprs: List[Expression],
-                 n_parts: int, string_dicts: Optional[dict] = None):
+                 n_parts: int, string_dicts: Optional[dict] = None,
+                 coalesce_output: bool = False):
         super().__init__([child])
         self.key_exprs = key_exprs  # bound against child.output_schema
         self.n_parts = n_parts
         # key index → StringDictionary, shared with the downstream join so
         # string keys hash via comparable codes (ops/strings.py)
         self.string_dicts = string_dicts
+        # merge small partitions into target-size output batches (AQE
+        # coalesced shuffle read).  Only valid when the consumer needs
+        # groups-confined-to-one-batch, NOT partition alignment (final
+        # aggregate yes; shuffled-join zip no).
+        self.coalesce_output = coalesce_output
 
     @property
     def output_schema(self) -> Schema:
@@ -187,6 +193,10 @@ class ShuffleExchangeExec(TpuExec):
                 for _ in range(self.n_parts):
                     yield _empty_batch(self.output_schema)
                 return
+            batch_rows = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
+            pending: List[ColumnBatch] = []
+            pending_rows = 0
+            emitted = 0
             for p in range(self.n_parts):
                 parts = []
                 for bh, ph in staged:
@@ -201,9 +211,41 @@ class ShuffleExchangeExec(TpuExec):
                     else:
                         out = batch_utils.compact(
                             batch_utils.concat_batches(parts))
-                m.add("numOutputRows", out.num_rows)
-                m.add("numOutputBatches", 1)
-                yield out
+                if not self.coalesce_output:
+                    m.add("numOutputRows", out.num_rows)
+                    m.add("numOutputBatches", 1)
+                    yield out
+                    continue
+                # AQE coalesced shuffle read: merge small partitions into
+                # target-sized batches (whole partitions only, so groups
+                # stay confined to one output batch)
+                if out.num_rows == 0:
+                    continue
+                pending.append(out)
+                pending_rows += out.num_rows
+                if pending_rows >= batch_rows:
+                    with m.time("opTime"):
+                        merged = pending[0] if len(pending) == 1 else \
+                            batch_utils.compact(
+                                batch_utils.concat_batches(pending))
+                    pending, pending_rows = [], 0
+                    m.add("numOutputRows", merged.num_rows)
+                    m.add("numOutputBatches", 1)
+                    emitted += 1
+                    yield merged
+            if self.coalesce_output:
+                if pending:
+                    with m.time("opTime"):
+                        merged = pending[0] if len(pending) == 1 else \
+                            batch_utils.compact(
+                                batch_utils.concat_batches(pending))
+                    m.add("numOutputRows", merged.num_rows)
+                    m.add("numOutputBatches", 1)
+                    emitted += 1
+                    yield merged
+                elif emitted == 0:
+                    from .join_exec import _empty_batch
+                    yield _empty_batch(self.output_schema)
         finally:
             for bh, ph in staged:
                 bh.close()

@@ -278,7 +278,11 @@ def _plan_aggregate(child_phys: TpuExec, group_bound, agg_bound,
     buf_schema = partial.output_schema
     exch_keys = [BoundReference(i, f.dtype, f.nullable, f.name)
                  for i, f in enumerate(buf_schema.fields[:len(group_bound)])]
-    exchange = ShuffleExchangeExec(partial, exch_keys, n_parts)
+    # the final agg only needs groups confined to one batch, not partition
+    # alignment — let the exchange coalesce small partitions on read (AQE
+    # coalesced-shuffle-read analog, GpuCustomShuffleReaderExec)
+    exchange = ShuffleExchangeExec(partial, exch_keys, n_parts,
+                                   coalesce_output=True)
     final_keys = [(n, BoundReference(i, e.dtype, e.nullable, n))
                   for i, (n, e) in enumerate(group_bound)]
     return AggregateExec(exchange, final_keys, agg_bound, mode="final",
