@@ -165,8 +165,11 @@ SHUFFLE_MODE = register(
     check=_one_of("HOST", "ICI", "CACHE_ONLY"))
 
 SHUFFLE_PARTITIONS = register(
-    "spark.rapids.tpu.sql.shuffle.partitions", 16,
-    "Default number of shuffle partitions for exchanges.")
+    "spark.rapids.tpu.sql.shuffle.partitions", 8,
+    "Default number of shuffle partitions for exchanges. On one chip a "
+    "partition exists for memory decomposition, not parallelism, and every "
+    "partition costs fixed per-pass device dispatches — keep it low unless "
+    "data outgrows HBM.")
 
 EXCHANGE_ENABLED = register(
     "spark.rapids.tpu.sql.exchange.enabled", True,

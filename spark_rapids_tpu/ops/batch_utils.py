@@ -100,10 +100,14 @@ def gather(batch: ColumnBatch, indices: jax.Array, num_rows: int,
     return ColumnBatch(batch.schema, cols, num_rows, sel)
 
 
-def compact(batch: ColumnBatch, align_host_strings: bool = False) -> ColumnBatch:
+def compact(batch: ColumnBatch, align_host_strings: bool = False,
+            min_capacity: int = 1) -> ColumnBatch:
     """Gather live rows to the front; drops the selection mask.
 
     Syncs once to learn the live-row count (static for downstream planning).
+    ``min_capacity`` lets callers force a shared output bucket across many
+    compacts (e.g. one per shuffle partition) so XLA compiles the gather
+    once instead of once per row-count bucket.
     """
     if batch.sel is None and not align_host_strings:
         return batch
@@ -111,7 +115,7 @@ def compact(batch: ColumnBatch, align_host_strings: bool = False) -> ColumnBatch
     n_live = int(jnp.sum(active))
     # stable partition: sort by (!active) keeps live rows in order at front
     perm = jnp.lexsort((jnp.arange(batch.capacity, dtype=jnp.int32), ~active))
-    new_cap = bucket_capacity(n_live)
+    new_cap = bucket_capacity(max(n_live, min_capacity))
     perm_trunc = perm[:new_cap] if new_cap <= batch.capacity else jnp.pad(
         perm, (0, new_cap - batch.capacity))
     cols = []

@@ -187,6 +187,14 @@ def group_reduce(keys: List[Value], contributions: List[Tuple[Value, str]],
     Returns (out_keys, out_values, n_groups, group_mask) where every output
     array has the input capacity, live group rows packed at the front, and
     ``n_groups`` is a device scalar (int32).
+
+    TPU cost note: on this hardware a 2M-row gather or scatter pass costs
+    hundreds of ms *per pass* regardless of width, so all sum-expressible
+    reductions (sum / first / last, including key columns and validity
+    companions) are STACKED into one float64 and one int64 matrix — one
+    batched permutation gather and one batched ``segment_sum`` per family —
+    instead of one pass per column.  Only min/max and first_valid/last_valid
+    take the per-column fallback.
     """
     capacity = active.shape[0]
     perm = sort_indices_for_keys(keys, active)
@@ -201,22 +209,118 @@ def group_reduce(keys: List[Value], contributions: List[Tuple[Value, str]],
     seg_last = (boundary | jnp.roll(~s_active, -1).at[-1].set(True)) & s_active
 
     n_groups = jnp.sum(seg_start.astype(jnp.int32))
-    out_keys: List[Value] = []
-    for (d, v), (sd, sv) in zip(keys, s_keys):
-        kd, _ = _reduce_segment(sd, None, "first", seg_ids, s_active,
-                                capacity, seg_start, seg_last)
-        if sv is not None:
-            kv, _ = _reduce_segment(sv.astype(jnp.int32), None, "first", seg_ids,
-                                    s_active, capacity, seg_start, seg_last)
-            out_keys.append((kd, kv > 0))
+
+    # ---- batched sum-family machinery ------------------------------------------
+    # Stage 1: queue every column (data + validity) for ONE permutation
+    # gather per dtype family.  Stage 2: queue masked contributions for ONE
+    # segment_sum per family.  Handles are (family, index) into the results.
+    raw_f64: List[jax.Array] = []
+    raw_i64: List[jax.Array] = []
+
+    def _queue_raw(arr) -> tuple:
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            raw_f64.append(arr.astype(jnp.float64))
+            return ("f", len(raw_f64) - 1)
+        raw_i64.append(arr.astype(jnp.int64))
+        return ("i", len(raw_i64) - 1)
+
+    # queue: keys' data already sorted (s_keys); contributions raw
+    batched_specs: List = []   # one per contribution, or ("fallback", i)
+    for i, ((d, v), op) in enumerate(contributions):
+        if op in ("sum", "first", "last"):
+            batched_specs.append(
+                ("batched", op, _queue_raw(d),
+                 _queue_raw(v) if v is not None else None, d.dtype))
         else:
-            out_keys.append((kd, None))
+            batched_specs.append(("fallback", i))
+
+    sorted_cols: dict = {}
+    if raw_f64:
+        g = (raw_f64[0][perm] if len(raw_f64) == 1 else
+             jnp.stack(raw_f64, axis=1)[perm])
+        for i in range(len(raw_f64)):
+            sorted_cols[("f", i)] = g if len(raw_f64) == 1 else g[:, i]
+    if raw_i64:
+        g = (raw_i64[0][perm] if len(raw_i64) == 1 else
+             jnp.stack(raw_i64, axis=1)[perm])
+        for i in range(len(raw_i64)):
+            sorted_cols[("i", i)] = g if len(raw_i64) == 1 else g[:, i]
+
+    # stage 2: masked contributions → batched segment sums
+    sum_f64: List[jax.Array] = []
+    sum_i64: List[jax.Array] = []
+
+    def _queue_sum(contrib) -> tuple:
+        if jnp.issubdtype(contrib.dtype, jnp.floating):
+            sum_f64.append(contrib)
+            return ("f", len(sum_f64) - 1)
+        sum_i64.append(contrib.astype(jnp.int64))
+        return ("i", len(sum_i64) - 1)
+
+    pick_first = seg_start & s_active
+    pick_last = seg_last
+
+    key_handles = []
+    for (d, v), (sd, sv) in zip(keys, s_keys):
+        wide = sd.astype(jnp.float64 if jnp.issubdtype(
+            sd.dtype, jnp.floating) else jnp.int64)
+        h = _queue_sum(jnp.where(pick_first, wide, jnp.zeros_like(wide)))
+        vh = _queue_sum((pick_first & sv).astype(jnp.int64)) \
+            if sv is not None else None
+        key_handles.append((h, vh, d.dtype))
+
+    val_handles: List = []
+    for spec in batched_specs:
+        if spec[0] == "fallback":
+            val_handles.append(spec)
+            continue
+        _, op, dh, vhraw, orig_dtype = spec
+        sd = sorted_cols[dh]
+        sv = (sorted_cols[vhraw] > 0) if vhraw is not None else None
+        if op == "sum":
+            m = s_active if sv is None else (s_active & sv)
+            h = _queue_sum(jnp.where(m, sd, jnp.zeros_like(sd)))
+            val_handles.append(("batched", h, None, orig_dtype))
+        else:
+            pick = pick_first if op == "first" else pick_last
+            h = _queue_sum(jnp.where(pick, sd, jnp.zeros_like(sd)))
+            vh = _queue_sum((pick & sv).astype(jnp.int64)) \
+                if sv is not None else None
+            val_handles.append(("batched", h, vh, orig_dtype))
+
+    reduced: dict = {}
+    if sum_f64:
+        out = jax.ops.segment_sum(
+            sum_f64[0] if len(sum_f64) == 1 else
+            jnp.stack(sum_f64, axis=1), seg_ids, num_segments=capacity)
+        for i in range(len(sum_f64)):
+            reduced[("f", i)] = out if len(sum_f64) == 1 else out[:, i]
+    if sum_i64:
+        out = jax.ops.segment_sum(
+            sum_i64[0] if len(sum_i64) == 1 else
+            jnp.stack(sum_i64, axis=1), seg_ids, num_segments=capacity)
+        for i in range(len(sum_i64)):
+            reduced[("i", i)] = out if len(sum_i64) == 1 else out[:, i]
+
+    out_keys: List[Value] = []
+    for h, vh, orig_dtype in key_handles:
+        kd = reduced[h].astype(orig_dtype)
+        out_keys.append((kd, reduced[vh] > 0 if vh is not None else None))
+
     out_vals: List[Value] = []
-    for (d, v), op in contributions:
-        sd = d[perm]
-        sv = v[perm] if v is not None else None
-        out_vals.append(_reduce_segment(sd, sv, op, seg_ids, s_active,
-                                        capacity, seg_start, seg_last))
+    for i, spec in enumerate(val_handles):
+        if spec[0] == "batched":
+            _, h, vh, orig_dtype = spec
+            data = reduced[h].astype(orig_dtype)
+            out_vals.append(
+                (data, reduced[vh] > 0 if vh is not None else None))
+        else:
+            d, v = contributions[spec[1]][0]
+            op = contributions[spec[1]][1]
+            sd = d[perm]
+            sv = v[perm] if v is not None else None
+            out_vals.append(_reduce_segment(sd, sv, op, seg_ids, s_active,
+                                            capacity, seg_start, seg_last))
     group_mask = jnp.arange(capacity, dtype=jnp.int32) < n_groups
     return out_keys, out_vals, n_groups, group_mask
 

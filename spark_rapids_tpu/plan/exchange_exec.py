@@ -33,6 +33,23 @@ from .physical import ExecContext, TpuExec, _cached_program
 
 __all__ = ["ShuffleExchangeExec"]
 
+
+def _partition_ranges(counts, target_rows: int):
+    """Group whole partitions [lo, hi) into contiguous ranges of roughly
+    ``target_rows`` each; returns [(lo, hi, rows)]."""
+    ranges = []
+    lo = 0
+    acc = 0
+    n = len(counts)
+    for p in range(n):
+        acc += int(counts[p])
+        if acc >= target_rows:
+            ranges.append((lo, p + 1, acc))
+            lo, acc = p + 1, 0
+    if lo < n:
+        ranges.append((lo, n, acc))
+    return ranges
+
 _PID_FIELD = Field("__pid", T.INT32, False)
 _PID_SCHEMA = Schema([_PID_FIELD])
 
@@ -194,9 +211,53 @@ class ShuffleExchangeExec(TpuExec):
                     yield _empty_batch(self.output_schema)
                 return
             batch_rows = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
-            pending: List[ColumnBatch] = []
-            pending_rows = 0
-            emitted = 0
+            # one host fetch of per-partition row counts: every partition's
+            # compact then shares ONE output capacity bucket, so the gather
+            # program compiles once instead of once per partition size (a
+            # remote-TPU compile costs seconds; there are n_parts of them)
+            with m.time("opTime"):
+                counts = np.zeros(self.n_parts + 1, dtype=np.int64)
+                for _, ph in staged:
+                    pid_col = ph.get().columns[0]
+                    counts += np.bincount(
+                        np.asarray(pid_col.data), minlength=self.n_parts + 1
+                    )[: self.n_parts + 1]
+            shared_cap = max(1, int(counts[: self.n_parts].max(initial=0)))
+
+            if self.coalesce_output:
+                # AQE coalesced shuffle read, range form: group WHOLE
+                # partitions into count-balanced contiguous ranges and
+                # emit one compact per OUTPUT batch — a tiny shuffle (the
+                # common partial-agg case) becomes a single device gather
+                # instead of n_parts of them (each eager op is a full RPC
+                # on remote-tunneled backends)
+                ranges = _partition_ranges(counts[: self.n_parts],
+                                           batch_rows)
+                emitted = 0
+                for lo, hi, range_rows in ranges:
+                    if range_rows == 0:
+                        continue
+                    parts = []
+                    for bh, ph in staged:
+                        batch = bh.get()
+                        pids = ph.get().columns[0].data
+                        sel = (pids >= lo) & (pids < hi)
+                        parts.append(ColumnBatch(
+                            batch.schema, batch.columns, batch.num_rows,
+                            sel))
+                    with m.time("opTime"):
+                        out = batch_utils.compact(
+                            parts[0] if len(parts) == 1 else
+                            batch_utils.concat_batches(parts))
+                    m.add("numOutputRows", out.num_rows)
+                    m.add("numOutputBatches", 1)
+                    emitted += 1
+                    yield out
+                if emitted == 0:
+                    from .join_exec import _empty_batch
+                    yield _empty_batch(self.output_schema)
+                return
+
             for p in range(self.n_parts):
                 parts = []
                 for bh, ph in staged:
@@ -207,45 +268,15 @@ class ShuffleExchangeExec(TpuExec):
                                              batch.num_rows, sel))
                 with m.time("opTime"):
                     if len(parts) == 1:
-                        out = batch_utils.compact(parts[0])
+                        out = batch_utils.compact(parts[0],
+                                                  min_capacity=shared_cap)
                     else:
                         out = batch_utils.compact(
-                            batch_utils.concat_batches(parts))
-                if not self.coalesce_output:
-                    m.add("numOutputRows", out.num_rows)
-                    m.add("numOutputBatches", 1)
-                    yield out
-                    continue
-                # AQE coalesced shuffle read: merge small partitions into
-                # target-sized batches (whole partitions only, so groups
-                # stay confined to one output batch)
-                if out.num_rows == 0:
-                    continue
-                pending.append(out)
-                pending_rows += out.num_rows
-                if pending_rows >= batch_rows:
-                    with m.time("opTime"):
-                        merged = pending[0] if len(pending) == 1 else \
-                            batch_utils.compact(
-                                batch_utils.concat_batches(pending))
-                    pending, pending_rows = [], 0
-                    m.add("numOutputRows", merged.num_rows)
-                    m.add("numOutputBatches", 1)
-                    emitted += 1
-                    yield merged
-            if self.coalesce_output:
-                if pending:
-                    with m.time("opTime"):
-                        merged = pending[0] if len(pending) == 1 else \
-                            batch_utils.compact(
-                                batch_utils.concat_batches(pending))
-                    m.add("numOutputRows", merged.num_rows)
-                    m.add("numOutputBatches", 1)
-                    emitted += 1
-                    yield merged
-                elif emitted == 0:
-                    from .join_exec import _empty_batch
-                    yield _empty_batch(self.output_schema)
+                            batch_utils.concat_batches(parts),
+                            min_capacity=shared_cap)
+                m.add("numOutputRows", out.num_rows)
+                m.add("numOutputBatches", 1)
+                yield out
         finally:
             for bh, ph in staged:
                 bh.close()
