@@ -144,6 +144,19 @@ def compact(batch: ColumnBatch, align_host_strings: bool = False,
     return ColumnBatch(batch.schema, cols, n_live)
 
 
+def compact_packed(batch: ColumnBatch) -> ColumnBatch:
+    """Compact a batch whose LIVE ROWS ARE ALREADY FRONT-PACKED (the
+    selection mask is a prefix mask, e.g. group_reduce outputs): one mask
+    sum + a slice, instead of compact()'s full lexsort + gather — on this
+    hardware a 2M-row sort pass costs ~100ms."""
+    if batch.sel is None:
+        return batch
+    n_live = int(jnp.sum(batch.active_mask()))
+    sliced = ColumnBatch(batch.schema, batch.columns,
+                         min(batch.num_rows, n_live))
+    return slice_batch(sliced, 0, n_live)
+
+
 def slice_batch(batch: ColumnBatch, start: int, length: int) -> ColumnBatch:
     """Static host-side slice (rows must be compact — no selection mask)."""
     assert batch.sel is None, "slice requires a compacted batch"

@@ -650,7 +650,9 @@ class AggregateExec(TpuExec):
                         else None for c in batch.columns)
                     ok, ov, gmask = batch_group(arrays, batch.sel,
                                                 np.int32(batch.num_rows))
-                    part = batch_utils.compact(
+                    # group_reduce packs live groups at the front: a
+                    # slice-compact avoids a full sort+gather pass
+                    part = batch_utils.compact_packed(
                         self._to_buffer_batch(buffer_schema, ok, ov, gmask))
                 if part.num_rows == 0:
                     continue
@@ -675,7 +677,7 @@ class AggregateExec(TpuExec):
                 batch = self._encode_string_keys(batch, ctx)
                 for part in with_retry(ctx, batch, run_one):
                     if pending is None:
-                        pending = batch_utils.compact(part)
+                        pending = batch_utils.compact_packed(part)
                     else:
                         pending = self._merge_partials(pending, part, ops,
                                                        n_keys)
@@ -780,7 +782,7 @@ class AggregateExec(TpuExec):
         merge = _merge_fn(tuple(ops), n_keys)
         ok, ov, gmask = merge(arrays, both.sel, np.int32(both.num_rows))
         merged = self._to_buffer_batch(both.schema, list(ok), list(ov), gmask)
-        return batch_utils.compact(merged)
+        return batch_utils.compact_packed(merged)
 
     def _finalize_grouped(self, pending: ColumnBatch) -> ColumnBatch:
         n_keys = len(self.group_exprs)
