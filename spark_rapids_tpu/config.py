@@ -318,6 +318,12 @@ class TpuConf:
         return "\n".join(lines)
 
 
+XLA_CACHE_DIR = register(
+    "spark.rapids.tpu.xla.cacheDir", "~/.cache/spark_rapids_tpu/xla",
+    "Persistent XLA compilation cache directory; compiled programs survive "
+    "process restarts, fixing minutes-long cold starts on remote-tunneled "
+    "backends. Empty disables.", startup_only=True)
+
 CBO_ENABLED = register(
     "spark.rapids.tpu.sql.cbo.enabled", False,
     "Cost-based optimizer: revert device placement for plan sections whose "

@@ -46,6 +46,19 @@ class DeviceManager:
             if cls._info is not None:
                 return cls._info
             import jax
+            cache_dir = conf["spark.rapids.tpu.xla.cacheDir"]
+            if cache_dir:
+                # persistent executable cache: compiled programs survive
+                # restarts (cold compiles on tunneled backends run minutes)
+                import os
+                path = os.path.expanduser(cache_dir)
+                try:
+                    os.makedirs(path, exist_ok=True)
+                    jax.config.update("jax_compilation_cache_dir", path)
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs", 0.5)
+                except Exception as e:  # never fail init over a cache
+                    log.warning("compilation cache unavailable: %s", e)
             requested = conf["spark.rapids.tpu.device.platform"]
             dev = cls._select_device(jax, requested)
             cls._check_environment(jax)
