@@ -334,3 +334,10 @@ CBO_MIN_DEVICE_ROWS = register(
     "spark.rapids.tpu.sql.cbo.minDeviceRows", 1024,
     "With CBO enabled: minimum estimated rows for a plan section to stay "
     "on the device.")
+
+
+AGG_GRID_MAX_GROUPS = register(
+    "spark.rapids.tpu.sql.agg.gridMaxGroups", 4096,
+    "Grouped aggregation uses a dense-grid reduction (no sort, no "
+    "permutation gathers) when every group key is a dictionary-coded "
+    "string and the padded grid has at most this many slots.")

@@ -351,3 +351,129 @@ def ungrouped_reduce(contributions: List[Tuple[Value, str]], active: jax.Array):
         else:
             raise ValueError(op)
     return outs
+
+
+def grid_group_reduce(code_keys: List[Value], dims: List[int],
+                      contributions: List[Tuple[Value, str]],
+                      active: jax.Array):
+    """Dense-grid grouped reduction for small-domain integer keys.
+
+    When every group key is a bounded integer code (string dictionary
+    codes, booleans), the groups live on a dense grid of
+    ``G = prod(dim_i + 1)`` slots (one extra slot per dimension for NULL) —
+    so aggregation needs NO sort, NO permutation gather, and no
+    boundary machinery: compute a combined grid id per row and run the
+    same batched per-dtype ``segment_sum`` passes straight onto G slots,
+    then decode observed grid ids back to key columns arithmetically.
+    This is the TPU-first shape for low-cardinality GROUP BY (the sort
+    path costs a ~100ms lexsort + gathers per 2M-row batch; this path is
+    two stacked scatter passes).
+
+    Returns the same contract as :func:`group_reduce`:
+    (out_keys, out_vals, n_groups, group_mask), outputs padded to the
+    input capacity with observed groups packed at the front (ordered by
+    grid id — i.e. by key codes ascending, nulls last per dimension).
+    """
+    capacity = active.shape[0]
+    G = 1
+    for d in dims:
+        G *= (d + 1)
+
+    gid = jnp.zeros((capacity,), dtype=jnp.int32)
+    for (codes, valid), d in zip(code_keys, dims):
+        c = codes.astype(jnp.int32)
+        slot = jnp.where(valid, c, d) if valid is not None else c
+        gid = gid * (d + 1) + slot
+    gid = jnp.where(active, gid, G)  # park inactive rows
+
+    # batched per-dtype contribution sums (same trick as group_reduce)
+    f64_items: List[jax.Array] = []
+    i64_items: List[jax.Array] = []
+    handles: List = []
+    for (data, valid), op in contributions:
+        if op not in ("sum", "first", "last"):
+            raise ValueError(f"grid path cannot reduce {op}")
+        m = active if valid is None else (active & valid)
+        if op == "sum":
+            floating = jnp.issubdtype(data.dtype, jnp.floating)
+            wide = data.astype(jnp.float64 if floating else jnp.int64)
+            contrib = jnp.where(m, wide, jnp.zeros_like(wide))
+            if floating:
+                f64_items.append(contrib)
+                handles.append((("f", len(f64_items) - 1), None, data.dtype))
+            else:
+                i64_items.append(contrib)
+                handles.append((("i", len(i64_items) - 1), None, data.dtype))
+        else:
+            # first/last on an unsorted grid: pick via segment min/max of
+            # row index (rare in practice — buffers are sums)
+            n = data.shape[0]
+            idx = jnp.arange(n, dtype=jnp.int32)
+            cand = jnp.where(m, idx, n if op == "first" else -1)
+            f = jax.ops.segment_min if op == "first" else jax.ops.segment_max
+            best = f(cand, gid, num_segments=G + 1)
+            has = (best < n) if op == "first" else (best >= 0)
+            safe = jnp.clip(best, 0, n - 1)
+            handles.append((("direct",
+                            jnp.where(has[:G], data[safe][:G],
+                                      jnp.zeros_like(data[safe][:G])),
+                            has[:G]), None, data.dtype))
+
+    reduced: dict = {}
+    if f64_items:
+        out = jax.ops.segment_sum(
+            f64_items[0] if len(f64_items) == 1 else
+            jnp.stack(f64_items, axis=1), gid, num_segments=G + 1)
+        for i in range(len(f64_items)):
+            reduced[("f", i)] = (out if len(f64_items) == 1
+                                 else out[:, i])[:G]
+    if i64_items:
+        out = jax.ops.segment_sum(
+            i64_items[0] if len(i64_items) == 1 else
+            jnp.stack(i64_items, axis=1), gid, num_segments=G + 1)
+        for i in range(len(i64_items)):
+            reduced[("i", i)] = (out if len(i64_items) == 1
+                                 else out[:, i])[:G]
+
+    # observed groups: rows contributing to the grid slot
+    ones = jnp.where(active, jnp.int32(1), jnp.int32(0))
+    occupancy = jax.ops.segment_sum(ones, gid, num_segments=G + 1)[:G]
+    observed = occupancy > 0
+    n_groups = jnp.sum(observed.astype(jnp.int32))
+
+    # pack observed slots to the front (tiny G-sized argsort)
+    pack = jnp.argsort(~observed, stable=True)
+
+    def _pad(x):
+        if capacity >= G:
+            return jnp.pad(x, [(0, capacity - G)] + [(0, 0)] * (x.ndim - 1))
+        return x[:capacity]
+
+    out_vals: List[Value] = []
+    for h, _vh, orig_dtype in handles:
+        if h[0] == "direct":
+            _, data_g, has_g = h
+            out_vals.append((_pad(data_g[pack]).astype(orig_dtype),
+                             _pad(has_g[pack])))
+        else:
+            out_vals.append((_pad(reduced[h][pack]).astype(orig_dtype),
+                             None))
+
+    # decode grid ids → key code columns (arithmetic, no data pass)
+    out_keys: List[Value] = []
+    gids_packed = pack.astype(jnp.int32)
+    rem = gids_packed
+    mults = []
+    mult = 1
+    for d in reversed(dims):
+        mults.append(mult)
+        mult *= (d + 1)
+    mults = list(reversed(mults))
+    for (codes, valid), d, mlt in zip(code_keys, dims, mults):
+        slot = (rem // mlt) % (d + 1)
+        is_null = slot == d
+        out_keys.append((_pad(jnp.where(is_null, 0, slot)).astype(
+            codes.dtype), _pad(~is_null)))
+
+    group_mask = jnp.arange(capacity, dtype=jnp.int32) < n_groups
+    return out_keys, out_vals, n_groups, group_mask

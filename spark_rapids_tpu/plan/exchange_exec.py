@@ -185,8 +185,34 @@ class ShuffleExchangeExec(TpuExec):
         # batch is registered spillable (ShuffleBufferCatalog analog) so
         # memory pressure during a long upstream can evict them to host
         staged = []
+        raw = []
         try:
             for batch in self.children[0].execute(ctx):
+                raw.append(catalog.register(batch, priority=0))
+                m.add("numInputBatches", 1)
+
+            if self.coalesce_output and raw:
+                # whole shuffle fits one output batch: partitioning would
+                # only split and re-merge — skip pids entirely (the
+                # consumer needs groups-confined-to-one-batch, which a
+                # single batch satisfies trivially)
+                total = sum(h.get().num_rows for h in raw)
+                batch_rows_ = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
+                if total <= batch_rows_:
+                    with m.time("opTime"):
+                        if len(raw) == 1:
+                            out = batch_utils.compact(raw[0].get())
+                        else:
+                            out = batch_utils.compact(
+                                batch_utils.concat_batches(
+                                    [h.get() for h in raw]))
+                    m.add("numOutputRows", out.num_rows)
+                    m.add("numOutputBatches", 1)
+                    yield out
+                    return
+
+            for bh in raw:
+                batch = bh.get()
                 with m.time("opTime"):
                     arrays = tuple(
                         (c.data, c.valid) if isinstance(c, DeviceColumn)
@@ -197,12 +223,10 @@ class ShuffleExchangeExec(TpuExec):
                             arrays, batch, self.key_exprs, self.string_dicts)
                     pids = pid_fn(arrays, batch.sel,
                                   np.int32(batch.num_rows))
-                staged.append((catalog.register(batch, priority=0),
-                               catalog.register(ColumnBatch(
-                                   _PID_SCHEMA, [DeviceColumn(
-                                       _PID_FIELD.dtype, pids)],
-                                   batch.num_rows), priority=0)))
-                m.add("numInputBatches", 1)
+                staged.append((bh, catalog.register(ColumnBatch(
+                    _PID_SCHEMA, [DeviceColumn(
+                        _PID_FIELD.dtype, pids)],
+                    batch.num_rows), priority=0)))
             if not staged:
                 # the exactly-n_parts contract holds even for empty input
                 # (the shuffled-join zip relies on it)
@@ -278,6 +302,7 @@ class ShuffleExchangeExec(TpuExec):
                 m.add("numOutputBatches", 1)
                 yield out
         finally:
-            for bh, ph in staged:
-                bh.close()
+            for _bh, ph in staged:
                 ph.close()
+            for bh in raw:  # staged bh handles are members of raw
+                bh.close()

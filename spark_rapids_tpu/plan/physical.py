@@ -634,8 +634,64 @@ class AggregateExec(TpuExec):
                 return out_keys, out_vals, gmask
             return batch_group
 
-        batch_group = _cached_program(
+        sort_batch_group = _cached_program(
             "agg-grouped|" + self._fingerprint(), build)
+
+        grid_ok = (
+            len(self._string_key_refs()) == len(self.group_exprs)
+            and len(self.group_exprs) > 0
+            and all(op in ("sum", "first", "last") for op in ops))
+        grid_max = ctx.conf["spark.rapids.tpu.sql.agg.gridMaxGroups"]
+
+        def _grid_dims():
+            """Bucketed dictionary sizes, or None when the grid would be
+            too large / dictionaries unavailable."""
+            if not grid_ok:
+                return None
+            dims = []
+            G = 1
+            for gi, _ in self._string_key_refs():
+                d = self.string_dicts.get(gi) if self.string_dicts \
+                    else None
+                if d is None or len(d) == 0:
+                    return None
+                b = 1
+                while b < len(d):
+                    b <<= 1
+                dims.append(b)
+                G *= (b + 1)
+            if G > grid_max:
+                return None
+            return tuple(dims)
+
+        def _grid_program(dims):
+            def build_grid():
+                @jax.jit
+                def f(arrays, sel, num_rows):
+                    cap = arrays[0][0].shape[0]
+                    active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                    if sel is not None:
+                        active = active & sel
+                    ectx = EvalContext(arrays, cap, active=active)
+                    keys = key_eval(ectx)
+                    contribs = update(ectx)
+                    ok, ov, n_g, gmask = groupby.grid_group_reduce(
+                        keys, list(dims),
+                        [(cv, op) for cv, op in zip(contribs, ops)],
+                        active)
+                    return ok, ov, gmask
+                return f
+            return _cached_program(
+                f"agg-grid|{dims}|" + self._fingerprint(), build_grid)
+
+        def batch_group(arrays, sel, num_rows):
+            # dense-grid fast path for dictionary-coded keys (no sort, no
+            # permutation gathers — see grid_group_reduce); dims re-read
+            # per batch because dictionaries grow incrementally
+            dims = _grid_dims()
+            if dims is not None:
+                return _grid_program(dims)(arrays, sel, num_rows)
+            return sort_batch_group(arrays, sel, num_rows)
 
         buffer_schema = self._buffer_schema()
         if self.mode == "final" and child.outputs_partitions:
