@@ -45,6 +45,8 @@ class SpillableBatch:
         self.priority = priority  # lower spills first (SpillPriorities)
         self.state = self.DEVICE
         self.device_bytes = batch.device_size_bytes()
+        # stable metadata: readable without re-materializing a spilled batch
+        self.num_rows = batch.num_rows
         self._lock = threading.Lock()
         self._closed = False
         # leak canary (cudf MemoryCleaner analog): warn at GC time if the

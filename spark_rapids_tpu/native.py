@@ -21,8 +21,8 @@ import numpy as np
 
 log = logging.getLogger("spark_rapids_tpu")
 
-__all__ = ["available", "murmur3_long", "murmur3_utf8", "pmod_partition",
-           "xxhash64_long", "compress", "decompress",
+__all__ = ["available", "murmur3_int", "murmur3_long", "murmur3_utf8",
+           "pmod_partition", "xxhash64_long", "compress", "decompress",
            "cast_string_to_long", "cast_string_to_double"]
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -116,6 +116,17 @@ def murmur3_long(vals: np.ndarray, seeds) -> np.ndarray:
     h = _np_mix_h1(h, _np_mix_k1((u & 0xffffffff).astype(np.uint32)))
     h = _np_mix_h1(h, _np_mix_k1((u >> np.uint64(32)).astype(np.uint32)))
     return _np_fmix(h, 8).view(np.int32)
+
+
+def murmur3_int(vals: np.ndarray, seeds) -> np.ndarray:
+    """Spark Murmur3Hash over 4-byte values (int/short/byte/bool/date as
+    int32); matches the device fold ``ops/hashing._hash_int32``."""
+    vals = np.ascontiguousarray(vals, dtype=np.int32)
+    n = len(vals)
+    seeds = np.full(n, seeds, dtype=np.int32) if np.isscalar(seeds) \
+        else np.ascontiguousarray(seeds, dtype=np.int32)
+    h = _np_mix_h1(seeds.view(np.uint32), _np_mix_k1(vals.view(np.uint32)))
+    return _np_fmix(h, 4).view(np.int32)
 
 
 def murmur3_utf8(bytes_: np.ndarray, offsets: np.ndarray, seeds

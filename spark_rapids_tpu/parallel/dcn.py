@@ -1,0 +1,758 @@
+"""Multi-host DCN process group: rendezvous, heartbeats, peer shuffle.
+
+Reference: the UCX peer-to-peer shuffle transport
+(shuffle-plugin/src/main/scala/com/nvidia/spark/rapids/shuffle/ucx/UCX.scala:71,
+UCXShuffleTransport/UCXConnection), the transport abstraction
+(com/nvidia/spark/rapids/shuffle/RapidsShuffleTransport.scala:22-80), and the
+driver-side peer registry + heartbeats
+(RapidsShuffleHeartbeatManager.scala:50, Plugin.scala:255-274).
+
+TPU-native shape: WITHIN a slice, shuffles ride ICI as XLA collectives
+(parallel/exchange.py — one ``lax.all_to_all`` under shard_map).  BETWEEN
+hosts/slices there is no ICI, so the shuffle rides the data-center network
+the way the reference rides UCX: each process serves its map-side partition
+frames over TCP and pulls the partitions it owns from every peer.  The wire
+format is exactly the HOST transport's compressed Arrow frame-file format
+(parallel/host_shuffle.py) — a spilled shuffle file IS a DCN payload, which
+is the same file/wire duality the reference gets from its spill-store-backed
+UCX reads (RapidsCachingWriter, RapidsShuffleInternalManagerBase.scala:897).
+
+Control plane: rank 0 runs a Coordinator (the driver-side
+RapidsShuffleHeartbeatManager analog) providing rendezvous (peer discovery),
+barriers, small all-gathers, and heartbeat-based failure detection.  Data
+plane: every rank runs a peer server streaming partition frames on demand.
+
+Cross-rank hashing: partition ids are computed on the HOST with Spark-exact
+murmur3 over real values (native.murmur3_*) — NOT the device dictionary-code
+hash, whose codes are only comparable within one process (ops/strings.py).
+Host pids for numeric types match the device fold bit-for-bit (tested).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Coordinator", "ProcessGroup", "DcnShuffle", "PeerFailedError",
+           "host_partition_ids", "run_distributed_agg"]
+
+_LEN = struct.Struct("<II")  # json length, binary payload length
+_CHUNK = 1 << 20
+
+
+class PeerFailedError(RuntimeError):
+    """A peer stopped heartbeating or dropped mid-transfer."""
+
+
+# ---------------------------------------------------------------------------------
+# Message framing: length-prefixed JSON control header + optional raw payload.
+# ---------------------------------------------------------------------------------
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(_CHUNK, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send(sock: socket.socket, obj: dict, blob: bytes = b"") -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(data), len(blob)) + data + blob)
+
+
+def _recv(sock: socket.socket) -> Tuple[dict, bytes]:
+    jl, bl = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    obj = json.loads(_recv_exact(sock, jl))
+    blob = _recv_exact(sock, bl) if bl else b""
+    return obj, blob
+
+
+# ---------------------------------------------------------------------------------
+# Coordinator (rank-0 control server).
+# ---------------------------------------------------------------------------------
+
+class Coordinator:
+    """Rendezvous + barrier + all-gather + heartbeat registry.
+
+    The driver-side RapidsShuffleHeartbeatManager analog: executors register
+    on startup, discover all peers, and heartbeat so failures surface as
+    data instead of hangs.
+    """
+
+    def __init__(self, world_size: int, port: int = 0,
+                 bind_host: str = "127.0.0.1",
+                 heartbeat_timeout: float = 15.0,
+                 wait_timeout: float = 120.0):
+        self.world_size = world_size
+        self.heartbeat_timeout = heartbeat_timeout
+        self.wait_timeout = wait_timeout
+        self._cv = threading.Condition()
+        self._peers: Dict[int, Tuple[str, int]] = {}
+        self._last_seen: Dict[int, float] = {}
+        self._barriers: Dict[str, set] = {}
+        self._gathers: Dict[str, Dict[int, bytes]] = {}
+        self._released: Dict[str, int] = {}
+        self._closed = False
+        self._srv = socket.create_server((bind_host, port))
+        self.port = self._srv.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="srt-dcn-coordinator")
+        t.start()
+        self._threads.append(t)
+
+    # -- server loops -------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg, blob = _recv(conn)
+                try:
+                    reply, rblob = self._handle(msg, blob)
+                except Exception as e:  # surface to the peer, keep serving
+                    reply, rblob = {"error": str(e)}, b""
+                _send(conn, reply, rblob)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _wait_for(self, pred, what: str, rank: int = -1):
+        deadline = time.monotonic() + self.wait_timeout
+        while not pred():
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise PeerFailedError(
+                    f"timed out waiting for all ranks at {what} "
+                    f"(dead: {self._dead_locked()})")
+            self._cv.wait(timeout=min(left, 1.0))
+            if rank >= 0:
+                # a rank parked in a collective is alive by construction —
+                # keep refreshing so it can't be declared dead mid-wait
+                self._last_seen[rank] = time.monotonic()
+
+    def _dead_locked(self) -> List[int]:
+        if len(self._peers) < self.world_size:
+            return []
+        now = time.monotonic()
+        return sorted(r for r, ts in self._last_seen.items()
+                      if now - ts > self.heartbeat_timeout)
+
+    def _handle(self, msg: dict, blob: bytes) -> Tuple[dict, bytes]:
+        op = msg["op"]
+        rank = int(msg.get("rank", -1))
+        with self._cv:
+            if rank >= 0:
+                self._last_seen[rank] = time.monotonic()
+            if op == "register":
+                self._peers[rank] = (msg["host"], int(msg["port"]))
+                self._cv.notify_all()
+                self._wait_for(
+                    lambda: len(self._peers) >= self.world_size, "register",
+                    rank)
+                return {"peers": {str(r): list(hp)
+                                  for r, hp in self._peers.items()}}, b""
+            if op == "barrier":
+                tag = msg["tag"]
+                self._barriers.setdefault(tag, set()).add(rank)
+                self._cv.notify_all()
+                self._wait_for(
+                    lambda: len(self._barriers[tag]) >= self.world_size,
+                    f"barrier {tag}", rank)
+                self._release(tag, self._barriers)
+                return {"ok": True}, b""
+            if op == "allgather":
+                tag = msg["tag"]
+                self._gathers.setdefault(tag, {})[rank] = blob
+                self._cv.notify_all()
+                self._wait_for(
+                    lambda: len(self._gathers[tag]) >= self.world_size,
+                    f"allgather {tag}", rank)
+                parts = [self._gathers[tag][r]
+                         for r in range(self.world_size)]
+                self._release(tag, self._gathers)
+                return {"lens": [len(p) for p in parts]}, b"".join(parts)
+            if op == "heartbeat":
+                return {"dead": self._dead_locked()}, b""
+            raise ValueError(f"unknown coordinator op {op!r}")
+
+    def _release(self, tag: str, store: dict) -> None:
+        """Drop a barrier/gather slot once every rank has been replied to."""
+        self._released[tag] = self._released.get(tag, 0) + 1
+        if self._released[tag] >= self.world_size:
+            store.pop(tag, None)
+            self._released.pop(tag, None)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------------
+# Peer data server: streams shuffle partition frame files to whoever asks.
+# ---------------------------------------------------------------------------------
+
+class _PeerServer:
+    """RapidsShuffleServer analog: serves this process's map-side output."""
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+        self._registry: Dict[str, str] = {}  # shuffle id -> frame-file dir
+        self._lock = threading.Lock()
+        self._closed = False
+        self._srv = socket.create_server((bind_host, port))
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="srt-dcn-peer-server").start()
+
+    def register(self, shuffle_id: str, directory: str) -> None:
+        with self._lock:
+            self._registry[shuffle_id] = directory
+
+    def unregister(self, shuffle_id: str) -> None:
+        with self._lock:
+            self._registry.pop(shuffle_id, None)
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg, _ = _recv(conn)
+                if msg["op"] != "fetch":
+                    _send(conn, {"error": f"unknown op {msg['op']!r}"})
+                    continue
+                with self._lock:
+                    d = self._registry.get(msg["shuffle"])
+                if d is None:
+                    _send(conn, {"error":
+                                 f"unknown shuffle {msg['shuffle']!r}"})
+                    continue
+                path = os.path.join(d, f"part-{int(msg['part']):05d}.bin")
+                payload = b""
+                if os.path.exists(path):
+                    with open(path, "rb") as f:
+                        payload = f.read()
+                _send(conn, {"ok": True}, payload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------------
+# Process group.
+# ---------------------------------------------------------------------------------
+
+class ProcessGroup:
+    """One rank's membership in a DCN process group.
+
+    Rank 0 additionally hosts the Coordinator (pass ``coordinator=`` an
+    existing instance, or let rank 0 create one on ``coordinator_port``).
+    SPMD discipline: every rank must call barrier()/all_gather_bytes()/
+    new_shuffle_id() in the same order — tags and ids are generated from
+    symmetric counters, exactly like collective ordering over a mesh.
+    """
+
+    def __init__(self, rank: int, world_size: int,
+                 coordinator_addr: Tuple[str, int],
+                 coordinator: Optional[Coordinator] = None,
+                 listen_host: str = "127.0.0.1",
+                 advertise_host: Optional[str] = None,
+                 heartbeat_interval: float = 2.0,
+                 connect_timeout: float = 60.0):
+        self.rank = rank
+        self.world_size = world_size
+        self.coordinator = coordinator
+        self._server = _PeerServer(bind_host=listen_host)
+        self._tag_n = 0
+        self._shuffle_n = 0
+        self._dead: List[int] = []
+        self._closed = False
+        self._ctrl_lock = threading.Lock()
+        self._ctrl = self._connect(coordinator_addr, connect_timeout)
+        # heartbeats ride their own connection: a rank parked in a long
+        # barrier/allgather holds _ctrl_lock and must not starve liveness
+        self._hb_sock = self._connect(coordinator_addr, connect_timeout)
+        self._hb_lock = threading.Lock()
+        msg, _ = self._request({
+            "op": "register", "rank": rank,
+            "host": advertise_host or listen_host,
+            "port": self._server.port})
+        if "error" in msg:
+            raise PeerFailedError(f"register failed: {msg['error']}")
+        self.peers: Dict[int, Tuple[str, int]] = {
+            int(r): (h, int(p)) for r, (h, p) in msg["peers"].items()}
+        self._hb = threading.Thread(target=self._heartbeat_loop,
+                                    args=(heartbeat_interval,), daemon=True,
+                                    name=f"srt-dcn-heartbeat-{rank}")
+        self._hb.start()
+
+    @staticmethod
+    def _connect(addr: Tuple[str, int], timeout: float) -> socket.socket:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                sock = socket.create_connection(addr, timeout=timeout)
+                # waits (barrier/allgather) can far exceed the connect
+                # timeout; the coordinator bounds them with wait_timeout
+                # and replies with an error rather than letting us hang
+                sock.settimeout(None)
+                return sock
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+
+    def _request(self, obj: dict, blob: bytes = b"") -> Tuple[dict, bytes]:
+        with self._ctrl_lock:
+            _send(self._ctrl, obj, blob)
+            return _recv(self._ctrl)
+
+    # -- control-plane collectives -------------------------------------------------
+    def _next_tag(self, kind: str) -> str:
+        self._tag_n += 1
+        return f"{kind}-{self._tag_n}"
+
+    def barrier(self, tag: Optional[str] = None) -> None:
+        tag = tag or self._next_tag("barrier")
+        msg, _ = self._request({"op": "barrier", "rank": self.rank,
+                                "tag": tag})
+        if "error" in msg:
+            raise PeerFailedError(f"barrier {tag}: {msg['error']}")
+
+    def all_gather_bytes(self, blob: bytes,
+                         tag: Optional[str] = None) -> List[bytes]:
+        tag = tag or self._next_tag("allgather")
+        msg, payload = self._request(
+            {"op": "allgather", "rank": self.rank, "tag": tag}, blob)
+        if "error" in msg:
+            raise PeerFailedError(f"allgather {tag}: {msg['error']}")
+        out, pos = [], 0
+        for ln in msg["lens"]:
+            out.append(payload[pos:pos + ln])
+            pos += ln
+        return out
+
+    # -- failure detection ---------------------------------------------------------
+    def _heartbeat_loop(self, interval: float) -> None:
+        while not self._closed:
+            time.sleep(interval)
+            if self._closed:
+                return
+            try:
+                with self._hb_lock:
+                    _send(self._hb_sock, {"op": "heartbeat",
+                                          "rank": self.rank})
+                    msg, _ = _recv(self._hb_sock)
+                self._dead = [int(r) for r in msg.get("dead", [])]
+            except (ConnectionError, OSError):
+                return
+
+    @property
+    def dead_peers(self) -> List[int]:
+        return list(self._dead)
+
+    def check_peers(self) -> None:
+        dead = [r for r in self._dead if r != self.rank]
+        if dead:
+            raise PeerFailedError(f"peers stopped heartbeating: {dead}")
+
+    # -- data plane ----------------------------------------------------------------
+    def register_shuffle(self, shuffle_id: str, directory: str) -> None:
+        self._server.register(shuffle_id, directory)
+
+    def unregister_shuffle(self, shuffle_id: str) -> None:
+        self._server.unregister(shuffle_id)
+
+    def new_shuffle_id(self) -> str:
+        self._shuffle_n += 1
+        return f"shuffle-{self._shuffle_n}"
+
+    def fetch(self, rank: int, shuffle_id: str, part: int) -> bytes:
+        """Pull one partition's frame stream from a peer's map output."""
+        host, port = self.peers[rank]
+        try:
+            with socket.create_connection((host, port), timeout=60) as s:
+                _send(s, {"op": "fetch", "shuffle": shuffle_id,
+                          "part": part})
+                msg, payload = _recv(s)
+        except (ConnectionError, OSError) as e:
+            self.check_peers()  # prefer the heartbeat diagnosis if present
+            raise PeerFailedError(
+                f"fetch {shuffle_id}[{part}] from rank {rank} failed: {e}")
+        if "error" in msg:
+            raise PeerFailedError(
+                f"fetch {shuffle_id}[{part}] from rank {rank}: "
+                f"{msg['error']}")
+        return payload
+
+    def close(self) -> None:
+        self._closed = True
+        self._server.close()
+        for sock in (self._ctrl, self._hb_sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self.coordinator is not None:
+            self.coordinator.close()
+
+
+# ---------------------------------------------------------------------------------
+# DCN shuffle: map side writes HOST-transport frame files; reduce side pulls
+# its owned partitions from every peer.
+# ---------------------------------------------------------------------------------
+
+class DcnShuffle:
+    """One shuffle across the process group.
+
+    Partition ownership is ``p % world_size`` — every rank reduces an equal
+    hash range, the way each executor in the reference owns the shuffle
+    blocks it wrote and serves them to UCX peers.
+    """
+
+    def __init__(self, pg: ProcessGroup, n_parts: int, spill_dir: str,
+                 num_threads: int = 4, compress: bool = True):
+        from .host_shuffle import HostShuffle
+        self.pg = pg
+        self.n_parts = n_parts
+        self.id = pg.new_shuffle_id()
+        self.local = HostShuffle(n_parts, spill_dir,
+                                 num_threads=num_threads, compress=compress)
+        pg.register_shuffle(self.id, self.local.dir)
+
+    def write_partition(self, p: int, table) -> None:
+        self.local.write_partition(p, table)
+
+    def commit(self) -> None:
+        """Map side durable on every rank (the reduce phase's barrier)."""
+        self.local.finish_writes()
+        self.pg.check_peers()
+        self.pg.barrier()
+
+    def owner(self, p: int) -> int:
+        return p % self.pg.world_size
+
+    def my_parts(self) -> List[int]:
+        return [p for p in range(self.n_parts)
+                if self.owner(p) == self.pg.rank]
+
+    def read_partition(self, p: int) -> Iterator:
+        """Yield every rank's arrow tables for partition ``p`` (local frames
+        short-circuit to the file, like RapidsCachingReader local reads)."""
+        from .host_shuffle import iter_frames
+        for r in range(self.pg.world_size):
+            if r == self.pg.rank:
+                yield from self.local.read_partition(p)
+            else:
+                payload = self.pg.fetch(r, self.id, p)
+                if payload:
+                    yield from iter_frames(payload)
+
+    def close(self) -> None:
+        self.pg.unregister_shuffle(self.id)
+        self.local.close()
+
+
+# ---------------------------------------------------------------------------------
+# Host-side Spark-exact partition ids (cross-rank consistent for ALL types).
+# ---------------------------------------------------------------------------------
+
+def _normalize_float_bits_np(vals: np.ndarray) -> np.ndarray:
+    v = vals.copy()
+    v[v == 0.0] = 0.0        # -0.0 -> +0.0
+    v[np.isnan(v)] = np.nan  # canonical NaN bit pattern
+    return v.view(np.int32 if v.dtype == np.float32 else np.int64)
+
+
+def host_partition_ids(table, key_ordinals: List[int], schema,
+                       n_parts: int) -> np.ndarray:
+    """Murmur3 pmod partition ids over an arrow table's key columns.
+
+    Bit-for-bit the device fold (ops/hashing.hash_columns) for numeric
+    types, and hashes real utf8 bytes for strings — dictionary codes are
+    process-local and never cross the wire.  Null columns pass the running
+    hash through, matching both Spark and the device kernel.
+    """
+    from .. import native
+    n = table.num_rows
+    h = np.full(n, 42, dtype=np.int32)  # SPARK_PARTITION_SEED
+    for ordinal in key_ordinals:
+        field = schema.fields[ordinal]
+        col = table.column(ordinal).combine_chunks()
+        valid = np.ones(n, dtype=bool) if col.null_count == 0 \
+            else ~np.asarray(col.is_null())
+        dt = field.dtype
+        if dt.is_string:
+            import pyarrow as pa
+            arr = col.cast(pa.large_utf8())
+            offsets = np.asarray(arr.buffers()[1]).view(np.int64)[
+                arr.offset:arr.offset + n + 1]
+            data_buf = arr.buffers()[2]
+            bytes_ = np.frombuffer(data_buf, dtype=np.uint8) \
+                if data_buf is not None else np.zeros(0, dtype=np.uint8)
+            # offsets stay ABSOLUTE into the full data buffer — a sliced
+            # array's offsets[0] > 0 and rebasing without also slicing
+            # bytes_ would hash the wrong bytes
+            new = native.murmur3_utf8(bytes_, offsets, h)
+        else:
+            vals = _arrow_physical(col, dt, n)
+            if vals.dtype == np.int64:
+                new = native.murmur3_long(vals, h)
+            else:
+                new = native.murmur3_int(vals, h)
+        h = np.where(valid, new, h)
+    return native.pmod_partition(h, n_parts)
+
+
+def _arrow_physical(col, dt, n: int) -> np.ndarray:
+    """Arrow column -> the physical int array Spark's hash folds over.
+
+    Null slots may hold any value — the caller masks them so the running
+    hash passes through, matching the device kernel's null handling.
+    """
+    import pyarrow as pa
+    if dt.is_decimal:
+        # unscaled value as long (Spark hashes small decimals as long)
+        vals = np.zeros(n, dtype=np.int64)
+        for i, v in enumerate(col.to_pylist()):
+            if v is not None:
+                vals[i] = int(v.scaleb(dt.scale))
+        return vals
+    if dt.is_floating:
+        npv = np.ascontiguousarray(
+            col.to_numpy(zero_copy_only=False), dtype=dt.numpy_dtype)
+        return _normalize_float_bits_np(npv)
+    target = pa.int64() if dt.numpy_dtype == np.int64 else pa.int32()
+    ints = col.cast(target)
+    if ints.null_count:
+        ints = ints.fill_null(0)
+    return np.ascontiguousarray(
+        ints.to_numpy(zero_copy_only=False),
+        dtype=np.int64 if dt.numpy_dtype == np.int64 else np.int32)
+
+
+# ---------------------------------------------------------------------------------
+# Distributed grouped-aggregate runner (the planner-path DCN tier).
+# ---------------------------------------------------------------------------------
+
+class DcnExchangeExec:
+    """Exchange exec whose transport is the process group: partial-agg
+    output leaves as compressed Arrow frames, and this rank's stream is the
+    partitions it owns (GpuShuffleExchangeExecBase analog, DCN transport).
+
+    Duck-typed as a TpuExec child (execute/output_schema/node_desc) so the
+    final AggregateExec runs unchanged on top of it.
+    """
+
+    outputs_partitions = True
+
+    def __init__(self, child, key_ordinals: List[int], n_parts: int,
+                 pg: ProcessGroup, decode_batch=None):
+        self.children = [child]
+        self.key_ordinals = key_ordinals
+        self.n_parts = n_parts
+        self.pg = pg
+        # hook decoding dictionary-coded string keys back to utf8 before
+        # serialization — codes are process-local and must not cross ranks
+        self.decode_batch = decode_batch
+        self.op_id = f"DcnExchange-{id(self):x}"
+
+    @property
+    def output_schema(self):
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return (f"TpuDcnShuffleExchange hashpartitioning"
+                f"({len(self.key_ordinals)} keys, {self.n_parts}) "
+                f"world={self.pg.world_size}")
+
+    def execute(self, ctx) -> Iterator:
+        from ..batch import from_arrow, to_arrow
+        from ..ops import batch_utils
+        from ..plan.join_exec import _empty_batch
+        schema = self.output_schema
+        shuffle = DcnShuffle(
+            self.pg, self.n_parts,
+            ctx.conf["spark.rapids.tpu.memory.spill.dir"],
+            num_threads=ctx.conf[
+                "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
+            compress=ctx.conf["spark.rapids.tpu.shuffle.compress"])
+        try:
+            for batch in self.children[0].execute(ctx):
+                batch = batch_utils.compact(batch)
+                if self.decode_batch is not None:
+                    batch = self.decode_batch(batch)
+                t = to_arrow(batch)
+                if t.num_rows == 0:
+                    continue
+                pids = host_partition_ids(t, self.key_ordinals, schema,
+                                          self.n_parts)
+                for p in np.unique(pids):
+                    shuffle.write_partition(int(p), t.filter(pids == p))
+            shuffle.commit()
+            min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+            for p in shuffle.my_parts():
+                tables = list(shuffle.read_partition(p))
+                if not tables:
+                    yield _empty_batch(schema)
+                    continue
+                import pyarrow as pa
+                yield from_arrow(pa.concat_tables(tables),
+                                 min_capacity=min_cap, device=ctx.device)
+        finally:
+            shuffle.close()
+
+
+def _make_key_decoder(partial):
+    """Decode the partial aggregate's dictionary-coded string key columns
+    back to utf8 at the wire boundary (a partial-mode exec skips its own
+    output-side decode, since in-process its partner shares the dict)."""
+    def decode(batch):
+        import jax
+
+        from ..batch import ColumnBatch, DeviceColumn, HostStringColumn
+        dicts = getattr(partial, "string_dicts", None)
+        if not dicts:
+            return batch
+        cols = list(batch.columns)
+        changed = False
+        for gi, d in dicts.items():
+            col = cols[gi]
+            if isinstance(col, DeviceColumn):
+                codes = jax.device_get(col.data)
+                valid = jax.device_get(col.valid) \
+                    if col.valid is not None else None
+                cols[gi] = HostStringColumn(d.decode(codes, valid),
+                                            capacity=batch.capacity)
+                changed = True
+        if not changed:
+            return batch
+        return ColumnBatch(batch.schema, cols, batch.num_rows, batch.sel)
+    return decode
+
+
+def _key_ordinals(key_exprs) -> List[int]:
+    from ..exprs import BoundReference
+    from ..plan.planner import strip_alias
+    out = []
+    for e in key_exprs:
+        core = strip_alias(e)
+        if not isinstance(core, BoundReference):
+            raise ValueError(
+                f"DCN exchange requires bound-column keys, got {e!r}")
+        out.append(core.ordinal)
+    return out
+
+
+def run_distributed_agg(df, pg: ProcessGroup,
+                        n_parts: Optional[int] = None) -> List[tuple]:
+    """Run a grouped-aggregate DataFrame query across the process group.
+
+    SPMD contract: every rank calls this with the SAME query over ITS OWN
+    input shard (e.g. its slice of the file listing).  Partial aggregation
+    runs locally on each rank's chip, partial output shuffles over DCN by
+    Spark-exact key hash, each rank finalizes the partitions it owns, and
+    the final rows are all-gathered so every rank returns the full result.
+    Plan operators ABOVE the aggregate (sort/limit/project) re-run on the
+    gathered result, which is complete and identical on every rank.
+    """
+    import pyarrow as pa
+
+    from ..batch import to_arrow
+    from ..plan.exchange_exec import ShuffleExchangeExec
+    from ..plan.join_exec import _empty_batch
+    from ..plan.overrides import apply_overrides
+    from ..plan.physical import AggregateExec, CollectExec, ExecContext, \
+        ScanExec
+
+    conf = df.session._tpu_conf()
+    phys = apply_overrides(df._plan, conf)
+    chain = []  # operators above the final aggregate, top-down
+    node = phys
+    final = None
+    while node is not None:
+        if isinstance(node, AggregateExec) and node.mode == "final" \
+                and isinstance(node.children[0], ShuffleExchangeExec):
+            final = node
+            break
+        chain.append(node)
+        node = node.children[0] if node.children else None
+    if final is None:
+        raise ValueError(
+            "plan has no partial->exchange->final aggregate tree "
+            "(is spark.rapids.tpu.sql.exchange.enabled on?)")
+    exch = final.children[0]
+    partial = exch.children[0]
+    if n_parts is None:
+        n_parts = max(pg.world_size, exch.n_parts)
+    final.children[0] = DcnExchangeExec(
+        exch.children[0], _key_ordinals(exch.key_exprs), n_parts, pg,
+        decode_batch=_make_key_decoder(partial))
+
+    ctx = ExecContext(conf, device=df.session.device)
+    tables = [to_arrow(b) for b in final.execute(ctx)]
+    tables = [t for t in tables if t.num_rows > 0]
+    local = pa.concat_tables(tables) if tables \
+        else to_arrow(_empty_batch(final.output_schema))
+
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, local.schema) as w:
+        w.write_table(local)
+    gathered = pg.all_gather_bytes(sink.getvalue().to_pybytes())
+    parts = []
+    for payload in gathered:
+        with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
+            parts.append(r.read_all())
+    full = pa.concat_tables(parts)
+
+    if chain:
+        # replay the post-agg plan (sort/limit/...) over the gathered rows
+        chain[-1].children[0] = ScanExec(final.output_schema,
+                                         lambda: iter([full]), desc="dcn")
+        result = CollectExec(chain[0]).collect_arrow(ctx)
+    else:
+        result = full
+    if result is None or result.num_rows == 0:
+        return []
+    cols = [result.column(i).to_pylist()
+            for i in range(result.num_columns)]
+    return [tuple(c[i] for c in cols) for i in range(result.num_rows)]

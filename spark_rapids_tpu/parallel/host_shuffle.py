@@ -22,7 +22,7 @@ import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, List, Optional
 
-__all__ = ["HostShuffle"]
+__all__ = ["HostShuffle", "iter_frames"]
 
 _FRAME = struct.Struct("<cQQ")  # codec flag, compressed len, raw len
 
@@ -45,6 +45,20 @@ def _decompress(flag: bytes, data: bytes, raw_len: int) -> bytes:
     if flag == b"Z":
         return zlib.decompress(data)
     return data
+
+
+def iter_frames(data: bytes):
+    """Decode a partition frame stream (file bytes or DCN fetch payload)
+    into arrow tables — the file format IS the wire format."""
+    import pyarrow as pa
+    pos = 0
+    while pos < len(data):
+        flag, clen, rlen = _FRAME.unpack_from(data, pos)
+        pos += _FRAME.size
+        payload = _decompress(flag, data[pos:pos + clen], rlen)
+        pos += clen
+        with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
+            yield r.read_all()
 
 
 class HostShuffle:

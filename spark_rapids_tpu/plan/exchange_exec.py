@@ -195,8 +195,9 @@ class ShuffleExchangeExec(TpuExec):
                 # whole shuffle fits one output batch: partitioning would
                 # only split and re-merge — skip pids entirely (the
                 # consumer needs groups-confined-to-one-batch, which a
-                # single batch satisfies trivially)
-                total = sum(h.get().num_rows for h in raw)
+                # single batch satisfies trivially).  Handle metadata, NOT
+                # get(): probing must not unspill every staged batch.
+                total = sum(h.num_rows for h in raw)
                 batch_rows_ = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
                 if total <= batch_rows_:
                     with m.time("opTime"):

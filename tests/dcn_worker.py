@@ -1,0 +1,65 @@
+"""One rank of a DCN distributed-aggregation run (spawned by test_dcn.py).
+
+Each rank is a real separate process with its own JAX runtime, session, and
+input shard — the multi-host execution model, rehearsed on localhost.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--query", default="simple")
+    args = ap.parse_args()
+
+    # force the CPU platform the same way tests/conftest.py does — a TPU
+    # plugin registered by sitecustomize must not capture this worker
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.parallel.dcn import (Coordinator, ProcessGroup,
+                                               run_distributed_agg)
+    from spark_rapids_tpu.sql import functions as F
+
+    coord = None
+    if args.rank == 0:
+        coord = Coordinator(args.world, port=args.port)
+    pg = ProcessGroup(args.rank, args.world, ("127.0.0.1", args.port),
+                      coordinator=coord)
+    try:
+        sess = srt.Session.get_or_create()
+        df = sess.read_parquet(
+            os.path.join(args.data, f"part-{args.rank}.parquet"))
+        if args.query == "simple":
+            q = df.group_by("k", "s").agg(
+                F.sum(F.col("v")).alias("sv"),
+                F.count_star().alias("c"),
+                F.avg(F.col("w")).alias("aw"))
+        elif args.query == "topk":
+            q = (df.group_by("k")
+                 .agg(F.sum(F.col("v")).alias("sv"))
+                 .sort(F.col("sv").desc())
+                 .limit(3))
+        else:
+            raise SystemExit(f"unknown query {args.query!r}")
+        rows = run_distributed_agg(q, pg)
+        with open(f"{args.out}.{args.rank}", "w") as f:
+            json.dump(rows, f, default=str)
+        pg.barrier()  # all outputs durable before any rank exits
+    finally:
+        pg.close()
+
+
+if __name__ == "__main__":
+    main()
