@@ -298,12 +298,14 @@ def from_arrow(table, min_capacity: int = 1024, device=None) -> ColumnBatch:
             col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
         dt = _arrow_to_logical(col.type)
         fields.append(Field(name, dt, col.null_count > 0))
-        if dt.is_string or dt.is_nested:
+        if dt.is_string or dt.is_nested or \
+                (dt.is_decimal and dt.precision > 18):
+            # no device representation (decimal>18 would need emulated
+            # 128-bit) — ride as a host column; sig tagging keeps compute
+            # over these off the device
             cols.append(HostStringColumn(col, capacity=cap))
             continue
         if dt.is_decimal:
-            if dt.precision > 18:
-                raise TypeError("decimal precision > 18 must stay on CPU")
             # Arrow decimal128 → scaled int64 (precision <= 18 guaranteed above).
             scaled = np.array(
                 [int(v.scaleb(dt.scale)) if v is not None else 0

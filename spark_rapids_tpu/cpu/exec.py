@@ -50,8 +50,11 @@ def arrow_to_values(table, schema: Schema):
         elif f.dtype.kind == T.TypeKind.TIMESTAMP:
             np_arr = np_arr.astype("datetime64[us]").astype(np.int64)
         elif f.dtype.is_decimal:
+            # scaled ints; beyond 64-bit range keep python ints (object) —
+            # exact compare/sort, no overflow (decimal128 fallback tier)
+            kind = object if f.dtype.precision > 18 else np.int64
             np_arr = np.array([0 if x is None else int(x.scaleb(f.dtype.scale))
-                               for x in arr.to_pylist()], dtype=np.int64)
+                               for x in arr.to_pylist()], dtype=kind)
         else:
             np_arr = np_arr.astype(f.dtype.numpy_dtype)
         vals.append((np.ascontiguousarray(np_arr), valid))

@@ -508,6 +508,9 @@ class EqualNullSafe(BinaryExpression):
 
 
 class Not(Expression):
+    input_sig = TypeSig.BOOLEAN + TypeSig.null
+    output_sig = TypeSig.BOOLEAN
+
     def __init__(self, child: Expression):
         self.children = (child,)
         self.dtype = T.BOOLEAN
@@ -521,6 +524,8 @@ class Not(Expression):
 class And(BinaryExpression):
     """Kleene AND: F&null=F (predicates.scala GpuAnd)."""
     symbol = "and"
+    input_sig = TypeSig.BOOLEAN + TypeSig.null
+    output_sig = TypeSig.BOOLEAN
 
     def _result_type(self, lt, rt):
         return T.BOOLEAN
@@ -541,6 +546,8 @@ class And(BinaryExpression):
 
 class Or(BinaryExpression):
     symbol = "or"
+    input_sig = TypeSig.BOOLEAN + TypeSig.null
+    output_sig = TypeSig.BOOLEAN
 
     def _result_type(self, lt, rt):
         return T.BOOLEAN

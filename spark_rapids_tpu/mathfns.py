@@ -62,6 +62,8 @@ class UnaryMathExpression(Expression):
     """f(child) evaluated in double, double out (GpuUnaryMathExpression)."""
 
     func: str = None  # ufunc name shared by numpy / jax.numpy
+    input_sig = T.TypeSig.numeric + T.TypeSig.null
+    output_sig = T.TypeSig.fp
 
     def __init__(self, child: Expression):
         self.children = (child,)
@@ -194,6 +196,8 @@ class _FloorCeil(Expression):
     """floor/ceil: double → LONG; integral passes through (GpuFloor/GpuCeil);
     decimal(p, s) → decimal(p - s + 1, 0)."""
 
+    input_sig = T.TypeSig.numeric + T.TypeSig.null
+    output_sig = T.TypeSig.numeric
     func: str = None
 
     def __init__(self, child: Expression):
@@ -246,6 +250,8 @@ class _RoundBase(Expression):
     decimal rescales exactly on the scaled-int representation.
     """
 
+    input_sig = T.TypeSig.numeric + T.TypeSig.null
+    output_sig = T.TypeSig.numeric
     half_even = False
 
     def __init__(self, child: Expression, scale: int = 0):
@@ -326,6 +332,8 @@ class _BinaryMath(Expression):
     """f(left, right) in double (GpuPow/GpuAtan2/GpuHypot)."""
 
     func: str = None
+    input_sig = T.TypeSig.numeric + T.TypeSig.null
+    output_sig = T.TypeSig.fp
 
     def __init__(self, left: Expression, right: Expression):
         self.children = (left, right)

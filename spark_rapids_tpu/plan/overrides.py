@@ -43,12 +43,22 @@ def expr_reasons(e: Expression, allow_string_passthrough: bool = True,
     reasons: List[str] = []
     core = strip_alias(e)
     if isinstance(core, BoundReference):
-        if core.dtype.is_string and not allow_string_passthrough:
-            reasons.append(
-                f"string column {core.name or core.ordinal} used in "
-                f"computation (device string kernels pending)")
-        if core.dtype.is_nested:
-            reasons.append(f"nested type {core.dtype} not supported on device")
+        if core.dtype.is_string or (core.dtype.is_decimal
+                                    and core.dtype.precision > 18):
+            # rides as a host arrow column: fine to pass through a device
+            # plan untouched, unusable as a compute/key input
+            if not allow_string_passthrough:
+                reasons.append(
+                    f"host-carried column {core.name or core.ordinal} "
+                    f"({core.dtype}) used in computation")
+        else:
+            # a bare column is device data too: its sig (nested types,
+            # decimal precision, ...) gates the node exactly like a
+            # computed expression's would
+            r = core.output_sig.check(core.dtype)
+            if r is not None:
+                reasons.append(
+                    f"column {core.name or core.ordinal}: {r}")
         return reasons
 
     def walk(node: Expression):
@@ -69,14 +79,25 @@ def expr_reasons(e: Expression, allow_string_passthrough: bool = True,
                     f"expression {type(node).__name__} produces/consumes "
                     f"string (device string kernels pending)")
                 return
-            if dt.is_nested:
-                reasons.append(f"nested type {dt} not supported on device")
+            # declared support signature drives tagging (TypeChecks.scala
+            # ExprChecks model: the same sigs generate supported_ops.md)
+            r = node.output_sig.check(dt)
+            if r is not None:
+                label = (f"column {node.name or node.ordinal}"
+                         if isinstance(node, BoundReference)
+                         else type(node).__name__)
+                reasons.append(f"{label}: {r}")
                 return
-            if dt.is_decimal and dt.precision > 18:
-                reasons.append(
-                    f"decimal precision {dt.precision} > 18 requires "
-                    f"emulated 128-bit (pending)")
+        in_sig = node.input_sig
         for c in node.children:
+            cdt = getattr(c, "dtype", None)
+            if cdt is not None and not cdt.is_string:
+                r = in_sig.check(cdt)
+                if r is not None:
+                    reasons.append(
+                        f"{type(node).__name__} input "
+                        f"{getattr(c, 'name', '') or type(c).__name__}: {r}")
+                    continue  # the child's own sig reason would be redundant
             walk(c)
 
     walk(core)

@@ -39,7 +39,7 @@ def _bind_project(exprs, schema: Schema):
     for name, e in exprs:
         b = bind(e, schema)
         core = strip_alias(b)
-        if isinstance(core, BoundReference) and core.dtype.is_string:
+        if isinstance(core, BoundReference) and core.dtype.is_host_carried:
             triples.append((name, None, core.ordinal))
             fields.append(Field(name, core.dtype, core.nullable))
         else:

@@ -108,6 +108,14 @@ class DataType:
         return self.kind == TypeKind.DECIMAL
 
     @property
+    def is_host_carried(self) -> bool:
+        """True if columns of this type ride as host arrow columns in
+        device batches (no device representation: strings, nested,
+        decimal beyond 64-bit scaled-int range)."""
+        return (self.is_string or self.is_nested
+                or (self.is_decimal and self.precision > 18))
+
+    @property
     def is_nested(self) -> bool:
         return self.kind in (TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP)
 
@@ -232,6 +240,18 @@ class TypeSig:
 
     def __sub__(self, other: "TypeSig") -> "TypeSig":
         return TypeSig(self.kinds - other.kinds, self.max_decimal_precision, self.notes)
+
+    def describe(self) -> str:
+        """Compact human-readable rendering for generated docs."""
+        order = [TypeKind.BOOLEAN, TypeKind.INT8, TypeKind.INT16,
+                 TypeKind.INT32, TypeKind.INT64, TypeKind.FLOAT32,
+                 TypeKind.FLOAT64, TypeKind.DECIMAL, TypeKind.STRING,
+                 TypeKind.DATE, TypeKind.TIMESTAMP, TypeKind.NULL,
+                 TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP]
+        names = [k.value for k in order if k in self.kinds]
+        extra = [k.value for k in self.kinds
+                 if k not in order]  # pragma: no cover
+        return ", ".join(names + sorted(extra))
 
     def with_note(self, kind: TypeKind, note: str) -> "TypeSig":
         notes = dict(self.notes)
