@@ -105,6 +105,14 @@ BATCH_SIZE_BYTES = register(
     "spark.rapids.tpu.sql.batchSizeBytes", 1 << 30,
     "Soft target for the in-memory size of a device batch, pre-padding.")
 
+COALESCE_ENABLED = register(
+    "spark.rapids.tpu.sql.coalesce.enabled", True,
+    "Insert CoalesceBatches operators (GpuCoalesceBatches analog) that "
+    "merge small batches to each consumer's declared goal — TargetSize "
+    "(batchSizeRows) before aggregates/sorts, RequireSingleBatch before "
+    "windows. Amortizes per-batch dispatch (a full RPC round-trip on "
+    "tunneled backends) and XLA program reuse.")
+
 MIN_CAPACITY = register(
     "spark.rapids.tpu.sql.minBatchCapacity", 1024,
     "Smallest capacity bucket. Device arrays are padded to "

@@ -64,14 +64,22 @@ def plan_distributed_agg(df, mesh, axis_name: str = "data",
     from ..plan.physical import ScanExec, StageExec
     from .exchange import exchange_grouped_agg
 
+    from ..plan.coalesce import CoalesceBatchesExec
+
     conf = df.session._tpu_conf()
     phys = apply_overrides(df._plan, conf)
     final, exch, partial = _find_agg_tree(phys)
     below = partial.children[0]
+    # batch-granularity nodes are meaningless under shard_map (each shard
+    # is one resident array, not a batch stream) — skip them
+    while isinstance(below, CoalesceBatchesExec):
+        below = below.children[0]
     stage = None
     if isinstance(below, StageExec):
         stage = below
         below = below.children[0]
+        while isinstance(below, CoalesceBatchesExec):
+            below = below.children[0]
     if not isinstance(below, ScanExec):
         raise ValueError(
             f"distributed lowering supports scan [+ fused stage] below the "

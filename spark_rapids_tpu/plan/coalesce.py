@@ -1,0 +1,177 @@
+"""Batch coalescing goals: concat small batches before expensive operators.
+
+Reference: the CoalesceGoal algebra (GpuCoalesceBatches.scala:159-192 —
+``TargetSize``/``RequireSingleBatch`` with max-combining) and the
+GpuCoalesceBatches exec that GpuTransitionOverrides inserts in front of
+operators that pay per-batch overhead.  TPU shape: per-batch cost here is a
+full dispatch (~15ms RPC on a tunneled backend — PERF.md) plus an XLA
+program per capacity bucket, so stitching many small scan/fallback batches
+into ``batchSizeRows``-sized ones amortizes both.  Consumers DECLARE goals
+(`TpuExec.child_coalesce_goal`); the transition pass (`insert_coalesce`)
+materializes them as CoalesceBatchesExec nodes, skipping partition-aligned
+children whose batch boundaries are semantic (the shuffled-join zip).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from ..batch import ColumnBatch, Schema
+from ..ops import batch_utils
+from .physical import ExecContext, TpuExec
+
+__all__ = ["CoalesceGoal", "TargetSize", "RequireSingleBatch", "max_goal",
+           "CoalesceBatchesExec", "insert_coalesce"]
+
+
+class CoalesceGoal:
+    """Desired batch granularity for a consumer's input stream."""
+
+    def satisfied_by(self, num_rows: int, is_only: bool) -> bool:
+        raise NotImplementedError
+
+
+class TargetSize(CoalesceGoal):
+    """Batches of roughly ``rows`` rows: merge smaller, pass larger."""
+
+    def __init__(self, rows: int):
+        self.rows = int(rows)
+
+    def satisfied_by(self, num_rows, is_only):
+        return num_rows >= self.rows
+
+    def __repr__(self):
+        return f"TargetSize({self.rows})"
+
+    def __eq__(self, other):
+        return isinstance(other, TargetSize) and other.rows == self.rows
+
+
+class _RequireSingleBatch(CoalesceGoal):
+    """The whole stream in ONE batch (window/global-sort style consumers)."""
+
+    def satisfied_by(self, num_rows, is_only):
+        return is_only
+
+    def __repr__(self):
+        return "RequireSingleBatch"
+
+
+RequireSingleBatch = _RequireSingleBatch()
+
+
+def max_goal(a: Optional[CoalesceGoal], b: Optional[CoalesceGoal]
+             ) -> Optional[CoalesceGoal]:
+    """Combine goals: the stricter wins (GpuCoalesceBatches.scala maxSize
+    semantics — RequireSingleBatch dominates any TargetSize)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, _RequireSingleBatch) or isinstance(b, _RequireSingleBatch):
+        return RequireSingleBatch
+    return a if a.rows >= b.rows else b
+
+
+class CoalesceBatchesExec(TpuExec):
+    """Concatenates child batches up to a goal (GpuCoalesceBatches analog).
+
+    TargetSize: accumulate until >= rows, emit, repeat; an already-large
+    batch passes through untouched.  RequireSingleBatch: concat everything.
+    Empty input yields nothing (sources own empty-result semantics).
+    """
+
+    def __init__(self, child: TpuExec, goal: CoalesceGoal):
+        super().__init__([child])
+        self.goal = goal
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return f"TpuCoalesceBatches {self.goal!r}"
+
+    @staticmethod
+    def _live_rows(b: ColumnBatch) -> int:
+        """Rows that survive the selection mask.
+
+        A filtered batch keeps its scan-sized num_rows with a sel mask
+        (physical.py StageExec), so goal accounting must count live rows —
+        otherwise post-filter batches always look 'big enough' and the
+        classic coalesce-after-filter case never merges.  Costs one scalar
+        fetch (~one dispatch) per masked batch, repaid by every dispatch
+        the merge saves downstream.
+        """
+        if b.sel is None:
+            return b.num_rows
+        import jax
+        import jax.numpy as jnp
+        return int(jax.device_get(jnp.sum(b.active_mask())))
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        m = ctx.metric_set(self.op_id)
+        pending = []
+        pending_live = 0
+
+        def flush():
+            # multi-batch merge goes through compact()'s capacity-bucketed
+            # sort+gather programs: a sortless slice-concat would need one
+            # XLA program per (n1, n2, ...) size combination — a compile
+            # storm on remote backends, where each compile costs seconds
+            with m.time("opTime"):
+                if len(pending) == 1:
+                    out = pending[0]
+                else:
+                    out = batch_utils.compact(
+                        batch_utils.concat_batches(pending))
+            m.add("numOutputRows", out.num_rows)
+            m.add("numOutputBatches", 1)
+            return out
+
+        for b in self.children[0].execute(ctx):
+            m.add("numInputBatches", 1)
+            live = self._live_rows(b)
+            if live == 0:
+                continue
+            if b.sel is None and self.goal.satisfied_by(live, False):
+                # dense and already at goal: pass through untouched — but
+                # first flush anything smaller waiting ahead of it, so the
+                # big batch never pays a merge sort for a few stray rows
+                if pending:
+                    yield flush()
+                    pending, pending_live = [], 0
+                m.add("numOutputRows", b.num_rows)
+                m.add("numOutputBatches", 1)
+                yield b
+                continue
+            pending.append(b)
+            pending_live += live
+            if self.goal.satisfied_by(pending_live, False):
+                yield flush()
+                pending, pending_live = [], 0
+        if pending:
+            yield flush()
+
+
+def insert_coalesce(phys: TpuExec, conf) -> TpuExec:
+    """Transition pass: materialize declared consumer goals as
+    CoalesceBatchesExec nodes (GpuTransitionOverrides.scala:50 model).
+
+    Never inserted above a partition-aligned producer — those batch
+    boundaries carry meaning (one batch per partition id) that a concat
+    would destroy.
+    """
+    if not conf["spark.rapids.tpu.sql.coalesce.enabled"]:
+        return phys
+    for i, child in enumerate(list(phys.children)):
+        new_child = insert_coalesce(child, conf)
+        goal = phys.child_coalesce_goal(i, conf)
+        if goal is not None and not new_child.outputs_partitions:
+            if isinstance(new_child, CoalesceBatchesExec):
+                # stacked demands combine instead of stacking nodes
+                new_child.goal = max_goal(new_child.goal, goal)
+            else:
+                new_child = CoalesceBatchesExec(new_child, goal)
+        phys.children[i] = new_child
+    return phys

@@ -49,6 +49,11 @@ class SortExec(TpuExec):
     def node_desc(self):
         return f"TpuSort [{len(self.orders)} keys]"
 
+    def child_coalesce_goal(self, i, conf):
+        # fewer, larger sorted runs -> fewer range slices to merge
+        from .coalesce import TargetSize
+        return TargetSize(conf["spark.rapids.tpu.sql.batchSizeRows"])
+
     def _order_tuples(self):
         key_exprs = tuple(e for e, _, _ in self.orders)
         desc = tuple(not asc for _, asc, _ in self.orders)

@@ -456,7 +456,8 @@ def apply_overrides(plan: L.LogicalPlan, conf: Optional[TpuConf] = None
                                  conf["spark.rapids.tpu.sql.batchSizeRows"])
             return CpuOpExec(p, [all_cpu(c) for c in m.children])
         return all_cpu(meta)
-    return _convert(meta, conf)
+    from .coalesce import insert_coalesce
+    return insert_coalesce(_convert(meta, conf), conf)
 
 
 def explain_plan(plan: L.LogicalPlan, conf: Optional[TpuConf] = None) -> str:

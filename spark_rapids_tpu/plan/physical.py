@@ -75,6 +75,12 @@ class TpuExec:
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         raise NotImplementedError
 
+    def child_coalesce_goal(self, i: int, conf):
+        """Desired input-batch granularity for child ``i`` (CoalesceGoal),
+        or None.  The transition pass (plan/coalesce.insert_coalesce)
+        materializes non-None goals as CoalesceBatchesExec nodes."""
+        return None
+
     # -- plan display -------------------------------------------------------------
     def node_desc(self) -> str:
         return type(self).__name__
@@ -409,6 +415,18 @@ class AggregateExec(TpuExec):
         keys = [n for n, _ in self.group_exprs]
         aggs = [f"{a.func}({n})" for n, a in self.agg_exprs]
         return f"TpuHashAggregate [{self.mode}] keys={keys} aggs={aggs}"
+
+    def child_coalesce_goal(self, i, conf):
+        # grouped modes: bigger input batches -> fewer reduce/merge passes.
+        # Scalar (ungrouped) aggregates reduce each batch in one cheap pass
+        # and handle selection masks in the reduction itself — coalescing
+        # ahead of them is pure overhead (measured: Q6 warm +70%).  The
+        # final mode's exchange child is partition-aligned (skipped by the
+        # transition pass anyway).
+        from .coalesce import TargetSize
+        if self.group_exprs and self.mode in ("complete", "partial"):
+            return TargetSize(conf["spark.rapids.tpu.sql.batchSizeRows"])
+        return None
 
     def _fingerprint(self) -> str:
         """Structural key for the jitted-program cache: a new AggregateExec

@@ -49,6 +49,11 @@ class WindowExec(TpuExec):
         return (f"TpuWindow [{', '.join(n for n, _ in self.window_exprs)}] "
                 f"part={np_} order={no_}")
 
+    def child_coalesce_goal(self, i, conf):
+        # windows evaluate over the whole (sorted) input at once
+        from .coalesce import RequireSingleBatch
+        return RequireSingleBatch
+
     def _fingerprint(self) -> str:
         return "|".join(e.fingerprint() for _, e in self.window_exprs)
 

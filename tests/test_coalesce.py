@@ -1,0 +1,207 @@
+"""Coalesce goal algebra + CoalesceBatchesExec transition pass.
+
+Reference: GpuCoalesceBatches.scala:159-192 (TargetSize/RequireSingleBatch
+goal algebra) and GpuTransitionOverrides inserting coalesce nodes before
+per-batch-sensitive operators.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.plan.coalesce import (CoalesceBatchesExec,
+                                            RequireSingleBatch, TargetSize,
+                                            max_goal)
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.sql import functions as F
+
+
+def _find(phys, cls):
+    out = []
+    stack = [phys]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, cls):
+            out.append(n)
+        stack.extend(n.children)
+    return out
+
+
+class TestGoalAlgebra:
+    def test_max_goal(self):
+        assert max_goal(None, TargetSize(10)) == TargetSize(10)
+        assert max_goal(TargetSize(10), TargetSize(99)) == TargetSize(99)
+        assert max_goal(TargetSize(10), RequireSingleBatch) \
+            is RequireSingleBatch
+        assert max_goal(None, None) is None
+
+    def test_satisfied_by(self):
+        assert TargetSize(100).satisfied_by(100, False)
+        assert not TargetSize(100).satisfied_by(99, False)
+        assert RequireSingleBatch.satisfied_by(5, True)
+        assert not RequireSingleBatch.satisfied_by(5, False)
+
+
+class TestCoalesceExec:
+    def _scan(self, session, tables):
+        from spark_rapids_tpu.batch import Field, Schema, _arrow_to_logical
+        from spark_rapids_tpu.plan.physical import ScanExec
+        schema = Schema([Field(n, _arrow_to_logical(t), True)
+                         for n, t in zip(tables[0].column_names,
+                                         tables[0].schema.types)])
+        return ScanExec(schema, lambda: iter(tables), desc="test")
+
+    def _run(self, session, exec_):
+        from spark_rapids_tpu.plan.physical import ExecContext
+        ctx = ExecContext(session._tpu_conf(), device=session.device)
+        return list(exec_.execute(ctx))
+
+    def test_target_size_merges_small_batches(self, session):
+        tables = [pa.table({"v": np.arange(i * 10, i * 10 + 10)})
+                  for i in range(10)]  # 10 batches x 10 rows
+        co = CoalesceBatchesExec(self._scan(session, tables),
+                                 TargetSize(30))
+        outs = self._run(session, co)
+        assert [b.num_rows for b in outs] == [30, 30, 30, 10]
+        got = [v for b in outs
+               for v in np.asarray(b.columns[0].data)[:b.num_rows].tolist()]
+        assert got == list(range(100))
+
+    def test_large_batch_passes_through(self, session):
+        tables = [pa.table({"v": np.arange(100)}),
+                  pa.table({"v": np.arange(5)})]
+        co = CoalesceBatchesExec(self._scan(session, tables),
+                                 TargetSize(50))
+        outs = self._run(session, co)
+        assert [b.num_rows for b in outs] == [100, 5]
+
+    def test_large_batch_flushes_pending_first(self, session):
+        """A big dense batch never pays a merge sort for stray small rows
+        queued ahead of it — pending flushes, then it passes through."""
+        tables = [pa.table({"v": np.arange(10)}),
+                  pa.table({"v": np.arange(10, 110)})]
+        co = CoalesceBatchesExec(self._scan(session, tables),
+                                 TargetSize(50))
+        outs = self._run(session, co)
+        assert [b.num_rows for b in outs] == [10, 100]
+        got = [v for b in outs
+               for v in np.asarray(b.columns[0].data)[:b.num_rows].tolist()]
+        assert got == list(range(110))
+
+    def test_masked_batches_count_live_rows(self, session):
+        """Post-filter batches (big capacity, few live rows) merge by LIVE
+        count, not scan-sized num_rows."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.batch import (ColumnBatch, DeviceColumn,
+                                            Field, Schema)
+        from spark_rapids_tpu.plan.physical import TpuExec
+        schema = Schema([Field("v", T.INT64, False)])
+
+        def masked(lo, n_live, cap=64):
+            data = jnp.arange(lo, lo + cap, dtype=jnp.int64)
+            sel = jnp.arange(cap) < n_live
+            return ColumnBatch(schema, [DeviceColumn(T.INT64, data)],
+                               cap, sel)
+
+        class Src(TpuExec):
+            output_schema = schema
+
+            def execute(self, ctx):
+                yield masked(0, 5)
+                yield masked(100, 5)
+                yield masked(200, 5)
+
+        co = CoalesceBatchesExec(Src(), TargetSize(12))
+        outs = self._run(session, co)
+        # 5+5 < 12, +5 = 15 >= 12 -> one merged batch of 15 live rows
+        assert [b.num_rows for b in outs] == [15]
+        got = sorted(np.asarray(outs[0].columns[0].data)[:15].tolist())
+        assert got == list(range(0, 5)) + list(range(100, 105)) \
+            + list(range(200, 205))
+
+    def test_stacked_goals_combine(self, session):
+        from spark_rapids_tpu.plan.coalesce import insert_coalesce
+        from spark_rapids_tpu.plan.physical import ScanExec
+        scan = self._scan(session, [pa.table({"v": np.arange(5)})])
+        inner = CoalesceBatchesExec(scan, TargetSize(10))
+
+        class Outer:
+            def __init__(self, child):
+                self.children = [child]
+
+            def child_coalesce_goal(self, i, conf):
+                return RequireSingleBatch
+
+        conf = session._tpu_conf()
+        out = Outer(inner)
+        insert_coalesce(out, conf)
+        assert out.children[0] is inner
+        assert inner.goal is RequireSingleBatch
+
+    def test_require_single_batch(self, session):
+        tables = [pa.table({"v": np.arange(7)}) for _ in range(5)]
+        co = CoalesceBatchesExec(self._scan(session, tables),
+                                 RequireSingleBatch)
+        outs = self._run(session, co)
+        assert [b.num_rows for b in outs] == [35]
+
+
+class TestTransitionPass:
+    def test_agg_and_sort_get_target_goals(self, session):
+        df = session.create_dataframe({"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]})
+        q = df.group_by("k").agg(F.sum(F.col("v")).alias("s")).sort("k")
+        phys = apply_overrides(q._plan, df.session._tpu_conf())
+        cos = _find(phys, CoalesceBatchesExec)
+        # partial agg input + sort input get goals; the final agg's
+        # exchange child is partition-aligned and must NOT be coalesced
+        assert len(cos) >= 1
+        from spark_rapids_tpu.plan.exchange_exec import ShuffleExchangeExec
+        for co in cos:
+            assert not isinstance(co.children[0], ShuffleExchangeExec)
+
+    def test_window_gets_single_batch_goal(self, session):
+        from spark_rapids_tpu.sql.window import Window
+        df = session.create_dataframe({"k": [1, 1, 2], "v": [3.0, 1.0, 2.0]})
+        w = Window.partition_by("k").order_by("v")
+        q = df.select(F.col("k"), F.row_number().over(w).alias("rn"))
+        phys = apply_overrides(q._plan, df.session._tpu_conf())
+        goals = [c.goal for c in _find(phys, CoalesceBatchesExec)]
+        assert RequireSingleBatch in goals
+
+    def test_disabled_by_config(self, session):
+        import spark_rapids_tpu as srt_
+        srt_.Session.reset()
+        s = srt_.Session.get_or_create(settings={
+            "spark.rapids.tpu.sql.coalesce.enabled": False})
+        try:
+            df = s.create_dataframe({"k": [1], "v": [1.0]})
+            q = df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+            phys = apply_overrides(q._plan, s._tpu_conf())
+            assert not _find(phys, CoalesceBatchesExec)
+        finally:
+            srt_.Session.reset()
+
+    def test_many_small_files_coalesce_correct(self, tmp_path, session):
+        rng = np.random.default_rng(3)
+        frames = []
+        for i in range(6):
+            t = pa.table({"k": rng.integers(0, 5, 40),
+                          "v": rng.normal(size=40)})
+            pq.write_table(t, str(tmp_path / f"f{i}.parquet"))
+            frames.append(t)
+        whole = pa.concat_tables(frames)
+        sess = srt.Session.get_or_create()
+        df = sess.read_parquet(str(tmp_path))
+        got = sorted(df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+                     .collect())
+        import collections
+        expect = collections.defaultdict(float)
+        for k, v in zip(whole.column("k").to_pylist(),
+                        whole.column("v").to_pylist()):
+            expect[k] += v
+        for (k, s) in got:
+            assert s == pytest.approx(expect[k], rel=1e-12)
