@@ -10,9 +10,12 @@ and commit appends/overwrites as new JSON log entries.
 
 Protocol pieces implemented (delta.io spec): `metaData` (schemaString,
 partitionColumns), `add`/`remove` with partitionValues, `commitInfo`,
-`_last_checkpoint` + classic single-file parquet checkpoints, versionAsOf
-time travel; DELETE/UPDATE/MERGE commands (copy-on-write).
-Not implemented: deletion vectors, column mapping.
+`protocol` (replayed; feature-merged on DV commits), `_last_checkpoint` +
+classic single-file parquet checkpoints, versionAsOf time travel;
+DELETE/UPDATE/MERGE commands (copy-on-write); deletion vectors (read +
+merge-on-read DELETE via `deletion_vectors.py`); column mapping mode
+name/id (read + DV delete — rewrite commands reject mapped tables).
+Not implemented: generated columns, CDF, row tracking, v2 checkpoints.
 """
 
 from __future__ import annotations
@@ -71,8 +74,12 @@ class DeltaTable:
                                     f"{path}")
         self.version = -1
         self.metadata: Optional[dict] = None
+        self.protocol: Optional[dict] = None
         # file relative path → partitionValues dict (raw strings/None)
         self.active: Dict[str, Dict[str, Optional[str]]] = {}
+        # file relative path → deletionVector descriptor (protocol: the
+        # add action carries the CURRENT DV; re-adding a path replaces it)
+        self.dvs: Dict[str, dict] = {}
         self._replay(version)
 
     # -- log replay ---------------------------------------------------------------
@@ -97,13 +104,21 @@ class DeltaTable:
             return None
 
     def _apply(self, action: dict) -> None:
-        if "metaData" in action:
+        if "protocol" in action:
+            self.protocol = action["protocol"]
+        elif "metaData" in action:
             self.metadata = action["metaData"]
         elif "add" in action:
             a = action["add"]
             self.active[a["path"]] = a.get("partitionValues", {}) or {}
+            dv = a.get("deletionVector")
+            if dv:
+                self.dvs[a["path"]] = dv
+            else:
+                self.dvs.pop(a["path"], None)
         elif "remove" in action:
             self.active.pop(action["remove"]["path"], None)
+            self.dvs.pop(action["remove"]["path"], None)
 
     def _replay(self, version: Optional[int]) -> None:
         versions = self._versions_on_disk()
@@ -140,7 +155,7 @@ class DeltaTable:
         cols = t.column_names
         rows = t.to_pylist()
         for r in rows:
-            for key in ("metaData", "add", "remove"):
+            for key in ("protocol", "metaData", "add", "remove"):
                 if key in cols and r.get(key) is not None:
                     self._apply({key: r[key]})
 
@@ -152,6 +167,23 @@ class DeltaTable:
                       bool(f.get("nullable", True)))
                 for f in sch["fields"]]
 
+    def column_mapping(self) -> Dict[str, str]:
+        """physical (parquet) name → logical name, when
+        ``delta.columnMapping.mode`` is ``name``/``id`` (protocol: data
+        files and partitionValues use physical names; the schemaString
+        field metadata carries ``delta.columnMapping.physicalName``)."""
+        conf = self.metadata.get("configuration") or {}
+        if conf.get("delta.columnMapping.mode", "none") == "none":
+            return {}
+        sch = json.loads(self.metadata["schemaString"])
+        out = {}
+        for f in sch["fields"]:
+            phys = (f.get("metadata") or {}).get(
+                "delta.columnMapping.physicalName")
+            if phys and phys != f["name"]:
+                out[phys] = f["name"]
+        return out
+
     def partition_columns(self) -> List[str]:
         return list(self.metadata.get("partitionColumns") or [])
 
@@ -159,13 +191,21 @@ class DeltaTable:
     def source(self, columns=None, batch_rows: int = 1 << 20,
                num_threads: int = 8, cache_bytes: int = 0,
                exact_filter: bool = True):
+        from .deletion_vectors import read_dv
         from .parquet import ParquetSource
+        rename = self.column_mapping()
+        to_physical = {v: k for k, v in rename.items()}
         part_cols = self.partition_columns()
-        paths, per_path = [], {}
+        paths, per_path, skip_rows = [], {}, {}
         for rel, pvals in sorted(self.active.items()):
             p = os.path.join(self.path, rel)
             paths.append(p)
-            per_path[p] = {k: pvals.get(k) for k in part_cols}
+            # partitionValues keys are PHYSICAL names under column mapping
+            per_path[p] = {k: pvals.get(to_physical.get(k, k))
+                           for k in part_cols}
+            dv = self.dvs.get(rel)
+            if dv:
+                skip_rows[p] = read_dv(self.path, dv)
         if not paths:
             raise FileNotFoundError(
                 f"Delta table {self.path}@v{self.version} has no data files")
@@ -173,7 +213,8 @@ class DeltaTable:
             self.path, columns=columns, batch_rows=batch_rows,
             num_threads=num_threads, cache_bytes=cache_bytes,
             exact_filter=exact_filter, _paths=paths,
-            partitions=(part_cols, per_path))
+            partitions=(part_cols, per_path),
+            _skip_rows=skip_rows, _rename=rename)
 
 
 def read_delta(path: str, version: Optional[int] = None, **source_kwargs):
@@ -294,14 +335,102 @@ def _partition_values_from_rel(rel: str) -> Dict[str, Optional[str]]:
 # DELETE / UPDATE commands (GpuDeleteCommand / GpuUpdateCommand analogs)
 # ---------------------------------------------------------------------------------
 
-def delta_delete(session, path: str, condition) -> int:
+def _read_live_file(session, table: "DeltaTable", rel: str, fpath: str):
+    """A data file's LIVE rows as a DataFrame — rewrite paths must never
+    resurrect rows a deletion vector already removed."""
+    dv = table.dvs.get(rel)
+    if dv is None:
+        return session.read_parquet(fpath)
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from .deletion_vectors import read_dv
+    raw = pq.read_table(fpath)
+    mask = np.ones(raw.num_rows, dtype=bool)
+    mask[read_dv(table.path, dv)] = False
+    return session.create_dataframe(raw.filter(pa.array(mask)))
+
+
+def delta_delete(session, path: str, condition, use_dv: bool = False) -> int:
     """DELETE FROM <table> WHERE condition; returns the new version.
 
-    Copy-on-write like the reference (GpuDeleteCommand.scala): files with
-    matching rows are rewritten without them (remove+add in one commit);
-    untouched files stay as-is.
+    ``use_dv=False``: copy-on-write like the reference's GpuDeleteCommand
+    (files with matching rows are rewritten without them).  ``use_dv=True``:
+    merge-on-read — each touched file is re-added with a deletion vector
+    marking the matched row positions (the Databricks DV write path the
+    reference reads through GpuDeltaParquetFileFormat); no data file is
+    rewritten.
     """
+    if use_dv:
+        return _delete_with_dvs(session, path, condition)
     return _rewrite_files(session, path, condition, set_exprs=None)
+
+
+def _delete_with_dvs(session, path: str, condition) -> int:
+    import numpy as np
+
+    from ..sql import functions as F
+    from .deletion_vectors import read_dv, write_dv_file
+
+    table = DeltaTable(path)
+    part_cols = table.partition_columns()
+    rename = table.column_mapping()
+    removes, adds = [], []
+    for rel, pvals in sorted(table.active.items()):
+        fpath = os.path.join(path, rel)
+        df = session.read_parquet(fpath)
+        if rename:
+            df = df.select(*[F.col(c).alias(rename.get(c, c))
+                             for c in df.columns])
+        to_physical = {v: k for k, v in rename.items()}
+        for c in part_cols:
+            raw = pvals.get(to_physical.get(c, c))
+            df = df.with_column(
+                c, F.lit(None if raw is None else _typed(raw)))
+        mt = df.select(condition.alias("__m")).to_arrow()
+        n_raw = mt.num_rows
+        flags = np.asarray(mt.column(0).combine_chunks()
+                           .fill_null(False))  # null condition = no match
+        matched = np.flatnonzero(flags).astype(np.int64)
+        old_desc = table.dvs.get(rel)
+        old_rows = read_dv(path, old_desc) if old_desc \
+            else np.zeros(0, np.int64)
+        live_matched = np.setdiff1d(matched, old_rows)
+        if live_matched.size == 0:
+            continue
+        new_rows = np.union1d(old_rows, matched)
+        removes.append(rel)
+        if new_rows.size < n_raw:
+            # DVs are cumulative: the re-added file carries ALL its deleted
+            # positions; a fully-deleted file is simply removed
+            desc, _ = write_dv_file(path, new_rows)
+            adds.append((rel, dict(pvals), desc))
+    if not removes:
+        return table.version
+    return _commit(path, table.version + 1, "DELETE", removes, adds,
+                   protocol_action=_dv_protocol_upgrade(table))
+
+
+def _dv_protocol_upgrade(table: DeltaTable) -> Optional[dict]:
+    """Protocol action adding the deletionVectors table feature, or None if
+    already present.  A protocol action REPLACES the previous one (Delta
+    spec), so existing features must be carried over — including features
+    implied by legacy version numbers (minReaderVersion 2 = columnMapping)
+    when upgrading to the v3/v7 feature-list form.
+    """
+    proto = table.protocol or {"minReaderVersion": 1, "minWriterVersion": 2}
+    rf = set(proto.get("readerFeatures") or [])
+    wf = set(proto.get("writerFeatures") or [])
+    if "deletionVectors" in rf and "deletionVectors" in wf:
+        return None
+    if proto.get("minReaderVersion", 1) >= 2 or table.column_mapping():
+        rf.add("columnMapping")
+        wf.add("columnMapping")
+    rf.add("deletionVectors")
+    wf.add("deletionVectors")
+    return {"minReaderVersion": 3, "minWriterVersion": 7,
+            "readerFeatures": sorted(rf), "writerFeatures": sorted(wf)}
 
 
 def delta_update(session, path: str, set_exprs: dict, condition=None) -> int:
@@ -315,11 +444,16 @@ def _rewrite_files(session, path, condition, set_exprs) -> int:
     from ..sql import functions as F
 
     table = DeltaTable(path)
+    if table.column_mapping():
+        raise NotImplementedError(
+            "rewrite-based DELETE/UPDATE on a column-mapped table is not "
+            "supported (it would write logical column names into files the "
+            "mapping expects physical names in); DELETE(use_dv=True) works")
     part_cols = table.partition_columns()
     removes, adds = [], []
     for rel, pvals in sorted(table.active.items()):
         fpath = os.path.join(path, rel)
-        df = session.read_parquet(fpath)
+        df = _read_live_file(session, table, rel, fpath)
         # partition values live in the path, not the file: inject them as
         # literal columns so conditions over partition columns work
         for c in part_cols:
@@ -390,6 +524,10 @@ def delta_merge(session, path: str, source_df, on: List[str],
     from ..sql import functions as F
 
     table = DeltaTable(path)
+    if table.column_mapping():
+        raise NotImplementedError(
+            "MERGE on a column-mapped table is not supported (rewrites "
+            "would write logical column names into physically-named files)")
     part_cols = table.partition_columns()
     target_cols = [f.name for f in table.schema_fields()]
     src_cols = source_df.columns
@@ -424,7 +562,7 @@ def delta_merge(session, path: str, source_df, on: List[str],
     removes, adds = [], []
     for rel, pvals in sorted(table.active.items()):
         fpath = os.path.join(path, rel)
-        tdf = session.read_parquet(fpath)
+        tdf = _read_live_file(session, table, rel, fpath)
         for c in part_cols:
             tdf = tdf.with_column(c, F.lit(
                 None if pvals.get(c) is None else _typed(pvals[c])))
@@ -494,22 +632,29 @@ def delta_merge(session, path: str, source_df, on: List[str],
 
 
 def _commit(path: str, version: int, operation: str,
-            removes: List[str], adds) -> int:
+            removes: List[str], adds,
+            protocol_action: Optional[dict] = None) -> int:
     """Build and atomically write one Delta commit (create-once version
     file is the linearization point)."""
     now_ms = int(time.time() * 1000)
     actions = []
+    if protocol_action is not None:
+        actions.append({"protocol": protocol_action})
     for rel in removes:
         actions.append({"remove": {"path": rel.replace(os.sep, "/"),
                                    "deletionTimestamp": now_ms,
                                    "dataChange": True}})
-    for rel, pvals in adds:
-        actions.append({"add": {
+    for entry in adds:
+        rel, pvals, dv = entry if len(entry) == 3 else (*entry, None)
+        add = {
             "path": rel.replace(os.sep, "/"),
             "partitionValues": pvals,
             "size": os.path.getsize(os.path.join(path, rel)),
             "modificationTime": now_ms,
-            "dataChange": True}})
+            "dataChange": True}
+        if dv is not None:
+            add["deletionVector"] = dv
+        actions.append({"add": add})
     actions.append({"commitInfo": {"timestamp": now_ms,
                                    "operation": operation,
                                    "engineInfo": "spark_rapids_tpu"}})

@@ -26,6 +26,8 @@ import queue
 import threading
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..batch import Field, Schema, _arrow_to_logical
 
 __all__ = ["parquet_schema", "parquet_source", "expand_paths", "ParquetSource",
@@ -205,6 +207,14 @@ def _exact_filter_mask(table, predicates: Sequence[Predicate]):
     return mask
 
 
+def _dv_fingerprint(rows) -> tuple:
+    """Identity of a deletion vector for cache keys — ONE definition shared
+    by the file-cache and device-cache tiers so they can't desynchronize."""
+    import zlib
+    arr = np.ascontiguousarray(rows)
+    return (len(arr), zlib.crc32(arr.tobytes()))
+
+
 class ParquetSource:
     """A rebuildable parquet scan source.
 
@@ -220,8 +230,17 @@ class ParquetSource:
                  batch_rows: int = 1 << 20, num_threads: int = 8,
                  cache_bytes: int = 0, exact_filter: bool = True,
                  _paths: Optional[List[str]] = None,
-                 partitions: Optional[tuple] = None):
+                 partitions: Optional[tuple] = None,
+                 _skip_rows: Optional[dict] = None,
+                 _rename: Optional[dict] = None):
         self.path = path
+        # per-file deleted row indexes (Delta deletion vectors): sorted
+        # int64 positions into the file's raw row order
+        self.skip_rows = _skip_rows or {}
+        # physical (file) name -> logical name (Delta column mapping);
+        # self.columns/predicates always speak LOGICAL names
+        self.rename = _rename or {}
+        self._to_physical = {v: k for k, v in self.rename.items()}
         self.paths = _paths if _paths is not None else expand_paths(path)
         if not self.paths:
             raise FileNotFoundError(f"no parquet files match {path!r}")
@@ -249,8 +268,12 @@ class ParquetSource:
     def schema(self) -> Schema:
         file_cols = None
         if self.columns is not None:
-            file_cols = [c for c in self.columns if c not in self.part_names]
+            file_cols = [self._to_physical.get(c, c)
+                         for c in self.columns if c not in self.part_names]
         sch = parquet_schema(self.paths, file_cols)
+        if self.rename:
+            sch = Schema([Field(self.rename.get(f.name, f.name), f.dtype,
+                                f.nullable) for f in sch.fields])
         if not self.part_names:
             return sch
         from .. import types as T
@@ -275,7 +298,9 @@ class ParquetSource:
         return ParquetSource(self.path, cols, preds, self.batch_rows,
                              self.num_threads, self.cache_bytes,
                              self.exact_filter, _paths=self.paths,
-                             partitions=self._partitions)
+                             partitions=self._partitions,
+                             _skip_rows=self.skip_rows,
+                             _rename=self.rename)
 
     def cache_token(self) -> Optional[tuple]:
         """Identity of this scan's output for the device-tier cache: files
@@ -289,7 +314,11 @@ class ParquetSource:
             files.append((os.path.abspath(p), st.st_mtime_ns, st.st_size))
         cols = tuple(self.columns) if self.columns is not None else None
         preds = tuple((n, op, str(v)) for n, op, v in self.predicates)
-        return (tuple(files), cols, preds, self.batch_rows, self.exact_filter)
+        dvs = tuple(sorted((p, _dv_fingerprint(r))
+                           for p, r in self.skip_rows.items()))
+        ren = tuple(sorted(self.rename.items()))
+        return (tuple(files), cols, preds, self.batch_rows,
+                self.exact_filter, dvs, ren)
 
     def describe(self) -> str:
         d = str(self.path)
@@ -351,16 +380,24 @@ class ParquetSource:
             from .filecache import FileCache, get_file_cache
             cache = get_file_cache(self.cache_bytes)
         pf = pq.ParquetFile(path)
-        rgs = prune_row_groups(pf, file_preds)
+        skips = self.skip_rows.get(path)
+        if skips is not None and len(skips) == 0:
+            skips = None
+        phys_preds = [(self._to_physical.get(n, n), op, v)
+                      for n, op, v in file_preds]
+        rgs = prune_row_groups(pf, phys_preds)
         pred_key = tuple((n, op, str(v)) for n, op, v in file_preds) \
             if (self.exact_filter and file_preds) else None
+        if skips is not None:
+            pred_key = (pred_key or ()) + (("dv",) + _dv_fingerprint(skips),)
         # every partition column appears in every file's output (missing in
         # this file's path → null), keeping batch schemas concatenatable
         part_cols = [(n, self._typed_part_value(n, part_kv.get(n)))
                      for n in self.part_names
                      if self.columns is None or n in self.columns]
         file_columns = None if self.columns is None else \
-            [c for c in self.columns if c not in self.part_names]
+            [self._to_physical.get(c, c)
+             for c in self.columns if c not in self.part_names]
         if cache is not None:
             from .filecache import FileCache
             key = FileCache.key_for(path, self.columns, rgs)
@@ -376,9 +413,41 @@ class ParquetSource:
         acc = [] if (cache is not None and key is not None) else None
         arrow_part = {"int64": pa.int64(), "float64": pa.float64(),
                       "string": pa.string()}
-        for rb in pf.iter_batches(batch_size=self.batch_rows, row_groups=rgs,
-                                  columns=file_columns, use_threads=True):
+        if skips is None:
+            batches = ((rb, None) for rb in pf.iter_batches(
+                batch_size=self.batch_rows, row_groups=rgs,
+                columns=file_columns, use_threads=True))
+        else:
+            # DV positions index the RAW file row order; pruning survives
+            # because each kept group's start offset is in the metadata
+            group_starts = np.cumsum(
+                [0] + [pf.metadata.row_group(g).num_rows
+                       for g in range(pf.metadata.num_row_groups)])
+
+            def _dv_batches():
+                for g in rgs:
+                    off = int(group_starts[g])
+                    for rb in pf.iter_batches(
+                            batch_size=self.batch_rows, row_groups=[g],
+                            columns=file_columns, use_threads=True):
+                        yield rb, off
+                        off += rb.num_rows
+            batches = _dv_batches()
+        for rb, row_off in batches:
             t = pa.Table.from_batches([rb])
+            if skips is not None:
+                nrows = t.num_rows
+                lo = int(np.searchsorted(skips, row_off))
+                hi = int(np.searchsorted(skips, row_off + nrows))
+                if hi > lo:
+                    mask = np.ones(nrows, dtype=bool)
+                    mask[np.asarray(skips[lo:hi]) - row_off] = False
+                    t = t.filter(pa.array(mask))
+                if t.num_rows == 0:
+                    continue
+            if self.rename:
+                t = t.rename_columns(
+                    [self.rename.get(c, c) for c in t.column_names])
             for n, v in part_cols:
                 ty = arrow_part[self._part_types[n]]
                 col = (pa.nulls(t.num_rows, type=ty) if v is None

@@ -1,0 +1,263 @@
+"""Delta deletion vectors + column mapping.
+
+Reference: the Delta protocol's deletion-vector format (RoaringBitmapArray
++ Z85 descriptors + DV store framing) read by the reference through its
+delta-lake modules (GpuDeltaParquetFileFormat row filtering), and
+columnMapping mode ``name`` (physical parquet names mapped to logical).
+"""
+
+import json
+import os
+import uuid
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.io import deletion_vectors as dvs
+from spark_rapids_tpu.io.delta import (delta_delete, delta_update,
+                                       read_delta, write_delta)
+from spark_rapids_tpu.sql import functions as F
+
+
+class TestZ85:
+    def test_roundtrip(self):
+        for n in (4, 8, 16, 40):
+            data = bytes(range(n))
+            enc = dvs.z85_encode(data)
+            assert len(enc) == n // 4 * 5
+            assert dvs.z85_decode(enc) == data
+
+    def test_uuid_path(self):
+        u = uuid.uuid4()
+        rel = dvs.dv_relative_path(dvs.encode_uuid_path(u, "ab/"))
+        assert rel == f"ab/deletion_vector_{u}.bin"
+        rel2 = dvs.dv_relative_path(dvs.encode_uuid_path(u))
+        assert rel2 == f"deletion_vector_{u}.bin"
+
+
+class TestRoaring:
+    @pytest.mark.parametrize("rows", [
+        [],
+        [0],
+        [0, 1, 2, 65535, 65536, 131072],          # multiple containers
+        list(range(5000)),                         # bitmap container
+        [2**32 - 1, 2**32, 2**33 + 7],             # multiple 32-bit maps
+        list(range(0, 200000, 3)),                 # mixed array+bitmap
+    ])
+    def test_roundtrip(self, rows):
+        data = dvs.serialize_roaring_array(np.array(rows, dtype=np.int64))
+        got = dvs.deserialize_roaring_array(data)
+        np.testing.assert_array_equal(got, np.unique(rows).astype(np.int64))
+
+    def test_magic_checked(self):
+        with pytest.raises(ValueError, match="magic"):
+            dvs.deserialize_roaring_array(b"\x00" * 16)
+
+    def test_run_container_decodes(self):
+        """Hand-build a 12347-cookie bitmap with one run container —
+        real writers emit runs; our reader must accept them."""
+        import struct
+        # one container, run flag set, runs [(10, len 4)] -> 10..14
+        cookie = (12347 | (0 << 16))
+        buf = struct.pack("<i", cookie) + bytes([0b1])
+        buf += struct.pack("<HH", 0, 4)      # key 0, cardinality-1 = 4
+        buf += struct.pack("<H", 1)           # 1 run
+        buf += struct.pack("<HH", 10, 4)      # start 10, length 4
+        arr = struct.pack("<iq", dvs.MAGIC, 1) + buf
+        got = dvs.deserialize_roaring_array(arr)
+        np.testing.assert_array_equal(got, np.arange(10, 15))
+
+    def test_dv_file_roundtrip(self, tmp_path):
+        rows = np.array([1, 5, 9, 70000], dtype=np.int64)
+        desc, abs_path = dvs.write_dv_file(str(tmp_path), rows)
+        assert desc["storageType"] == "u"
+        assert desc["cardinality"] == 4
+        assert os.path.exists(abs_path)
+        got = dvs.read_dv(str(tmp_path), desc)
+        np.testing.assert_array_equal(got, rows)
+
+    def test_inline_descriptor(self, tmp_path):
+        rows = np.array([3, 4, 5], dtype=np.int64)
+        data = dvs.serialize_roaring_array(rows)
+        pad = (-len(data)) % 4
+        desc = {"storageType": "i",
+                "pathOrInlineDv": dvs.z85_encode(data + b"\x00" * pad),
+                "sizeInBytes": len(data), "cardinality": 3}
+        got = dvs.read_dv(str(tmp_path), desc)
+        np.testing.assert_array_equal(got, rows)
+
+
+class TestDeleteWithDV:
+    def _table(self, session, tmp_path, n=100):
+        path = str(tmp_path / "t")
+        df = session.create_dataframe({
+            "id": np.arange(n), "v": np.arange(n) * 1.0})
+        write_delta(df, path)
+        return path
+
+    def test_dv_delete_filters_reads(self, session, tmp_path):
+        path = self._table(session, tmp_path)
+        v = delta_delete(session, path, F.col("id") % F.lit(10) == F.lit(0),
+                         use_dv=True)
+        assert v == 1
+        got = sorted(r[0] for r in session.read_delta(path)
+                     .select("id").collect())
+        assert got == [i for i in range(100) if i % 10 != 0]
+        # the data file was NOT rewritten (merge-on-read)
+        logf = os.path.join(path, "_delta_log",
+                            f"{1:020d}.json")
+        actions = [json.loads(l) for l in open(logf) if l.strip()]
+        add = next(a["add"] for a in actions if "add" in a)
+        assert add["deletionVector"]["cardinality"] == 10
+        assert any("protocol" in a for a in actions)
+
+    def test_dv_deletes_accumulate(self, session, tmp_path):
+        path = self._table(session, tmp_path)
+        delta_delete(session, path, F.col("id") < F.lit(10), use_dv=True)
+        delta_delete(session, path, F.col("id") >= F.lit(90), use_dv=True)
+        got = sorted(r[0] for r in session.read_delta(path)
+                     .select("id").collect())
+        assert got == list(range(10, 90))
+        # second DV is cumulative over the same file
+        logf = os.path.join(path, "_delta_log", f"{2:020d}.json")
+        actions = [json.loads(l) for l in open(logf) if l.strip()]
+        add = next(a["add"] for a in actions if "add" in a)
+        assert add["deletionVector"]["cardinality"] == 20
+        # the protocol upgrade happens once, not per commit
+        assert not any("protocol" in a for a in actions)
+
+    def test_dv_multi_row_group_offsets(self, session, tmp_path):
+        """DV positions are raw-file row indexes; a multi-row-group file
+        with pruned groups must still map them correctly."""
+        path = str(tmp_path / "mrg")
+        t = pa.table({"id": np.arange(1000), "v": np.arange(1000) * 1.0})
+        os.makedirs(path)
+        pq.write_table(t, os.path.join(path, "part-0.parquet"),
+                       row_group_size=100)  # 10 row groups
+        from spark_rapids_tpu.io.delta import _commit
+        os.makedirs(os.path.join(path, "_delta_log"), exist_ok=True)
+        meta = {"metaData": {
+            "id": "m", "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps({"type": "struct", "fields": [
+                {"name": "id", "type": "long", "nullable": True,
+                 "metadata": {}},
+                {"name": "v", "type": "double", "nullable": True,
+                 "metadata": {}}]}),
+            "partitionColumns": [], "configuration": {}}}
+        with open(os.path.join(path, "_delta_log",
+                               f"{0:020d}.json"), "w") as f:
+            f.write(json.dumps(meta) + "\n")
+            f.write(json.dumps({"add": {
+                "path": "part-0.parquet", "partitionValues": {},
+                "size": 1, "modificationTime": 0,
+                "dataChange": True}}) + "\n")
+        # delete every row ending in 7
+        delta_delete(session, path, F.col("id") % F.lit(10) == F.lit(7),
+                     use_dv=True)
+        # predicate prunes to late row groups; offsets must still line up
+        got = sorted(r[0] for r in session.read_delta(path)
+                     .filter(F.col("id") >= F.lit(850)).select("id")
+                     .collect())
+        assert got == [i for i in range(850, 1000) if i % 10 != 7]
+
+    def test_time_travel_predates_dv(self, session, tmp_path):
+        path = self._table(session, tmp_path)
+        delta_delete(session, path, F.col("id") < F.lit(50), use_dv=True)
+        assert session.read_delta(path).count() == 50
+        assert session.read_delta(path, version=0).count() == 100
+
+    def test_full_file_delete_removes_file(self, session, tmp_path):
+        path = self._table(session, tmp_path, n=10)
+        delta_delete(session, path, F.lit(True), use_dv=True)
+        with pytest.raises(FileNotFoundError, match="no data files"):
+            read_delta(path)
+
+    def test_rewrite_update_respects_dv(self, session, tmp_path):
+        """UPDATE (copy-on-write) after a DV delete must not resurrect
+        DV-deleted rows."""
+        path = self._table(session, tmp_path, n=20)
+        delta_delete(session, path, F.col("id") < F.lit(5), use_dv=True)
+        delta_update(session, path, {"v": F.lit(0.0)},
+                     F.col("id") >= F.lit(15))
+        rows = sorted(session.read_delta(path).collect())
+        assert [r[0] for r in rows] == list(range(5, 20))
+        assert all(r[1] == 0.0 for r in rows if r[0] >= 15)
+
+    def test_dv_with_predicate_pushdown(self, session, tmp_path):
+        path = self._table(session, tmp_path)
+        delta_delete(session, path, F.col("id") < F.lit(30), use_dv=True)
+        got = sorted(r[0] for r in session.read_delta(path)
+                     .filter(F.col("id") < F.lit(60)).select("id").collect())
+        assert got == list(range(30, 60))
+
+
+def _write_column_mapped_table(path: str, frames):
+    """Hand-build a columnMapping=name table: parquet files use physical
+    col-<n> names; the Delta schema maps them to logical names."""
+    os.makedirs(os.path.join(path, "_delta_log"), exist_ok=True)
+    phys = {"id": "col-1a", "v": "col-2b"}
+    fields = []
+    for i, (logical, p) in enumerate(phys.items()):
+        fields.append({
+            "name": logical,
+            "type": "long" if logical == "id" else "double",
+            "nullable": True,
+            "metadata": {"delta.columnMapping.id": i + 1,
+                         "delta.columnMapping.physicalName": p}})
+    meta = {"metaData": {
+        "id": str(uuid.uuid4()),
+        "format": {"provider": "parquet", "options": {}},
+        "schemaString": json.dumps({"type": "struct", "fields": fields}),
+        "partitionColumns": [],
+        "configuration": {"delta.columnMapping.mode": "name",
+                          "delta.columnMapping.maxColumnId": "2"}}}
+    actions = [meta]
+    for i, t in enumerate(frames):
+        rel = f"part-{i:05d}.parquet"
+        pq.write_table(
+            t.rename_columns([phys[c] for c in t.column_names]),
+            os.path.join(path, rel))
+        actions.append({"add": {
+            "path": rel, "partitionValues": {},
+            "size": os.path.getsize(os.path.join(path, rel)),
+            "modificationTime": 0, "dataChange": True}})
+    with open(os.path.join(path, "_delta_log", f"{0:020d}.json"), "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+
+
+class TestColumnMapping:
+    def test_read_maps_physical_to_logical(self, session, tmp_path):
+        path = str(tmp_path / "cm")
+        _write_column_mapped_table(path, [
+            pa.table({"id": [1, 2, 3], "v": [1.0, 2.0, 3.0]}),
+            pa.table({"id": [4, 5], "v": [4.0, 5.0]})])
+        df = session.read_delta(path)
+        assert df.columns == ["id", "v"]
+        got = sorted(df.filter(F.col("id") > F.lit(2)).collect())
+        assert got == [(3, 3.0), (4, 4.0), (5, 5.0)]
+
+    def test_dv_delete_on_mapped_table(self, session, tmp_path):
+        path = str(tmp_path / "cm")
+        _write_column_mapped_table(path, [
+            pa.table({"id": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0]})])
+        delta_delete(session, path, F.col("id") <= F.lit(2), use_dv=True)
+        got = sorted(session.read_delta(path).collect())
+        assert got == [(3, 3.0), (4, 4.0)]
+        # the protocol upgrade must CARRY the columnMapping feature — a
+        # protocol action replaces the previous one wholesale
+        logf = os.path.join(path, "_delta_log", f"{1:020d}.json")
+        actions = [json.loads(l) for l in open(logf) if l.strip()]
+        proto = next(a["protocol"] for a in actions if "protocol" in a)
+        assert "columnMapping" in proto["readerFeatures"]
+        assert "deletionVectors" in proto["readerFeatures"]
+
+    def test_rewrite_on_mapped_table_rejected(self, session, tmp_path):
+        path = str(tmp_path / "cm")
+        _write_column_mapped_table(path, [
+            pa.table({"id": [1], "v": [1.0]})])
+        with pytest.raises(NotImplementedError, match="column-mapped"):
+            delta_update(session, path, {"v": F.lit(9.0)})
