@@ -239,6 +239,10 @@ def write_delta(df, path: str, mode: str = "error",
         raise FileExistsError(f"Delta table already exists at {path}")
     if exists and mode == "ignore":
         return DeltaTable(path).version
+    if exists and DeltaTable(path).column_mapping():
+        raise NotImplementedError(
+            "append/overwrite on a column-mapped table is not supported "
+            "(data files and partitionValues must use physical names)")
 
     part_by = list(partition_by or [])
     # 1. write the data files (reuse the parquet writer's partitioning)
@@ -376,6 +380,7 @@ def _delete_with_dvs(session, path: str, condition) -> int:
     table = DeltaTable(path)
     part_cols = table.partition_columns()
     rename = table.column_mapping()
+    to_physical = {v: k for k, v in rename.items()}
     removes, adds = [], []
     for rel, pvals in sorted(table.active.items()):
         fpath = os.path.join(path, rel)
@@ -383,7 +388,6 @@ def _delete_with_dvs(session, path: str, condition) -> int:
         if rename:
             df = df.select(*[F.col(c).alias(rename.get(c, c))
                              for c in df.columns])
-        to_physical = {v: k for k, v in rename.items()}
         for c in part_cols:
             raw = pvals.get(to_physical.get(c, c))
             df = df.with_column(
@@ -424,7 +428,23 @@ def _dv_protocol_upgrade(table: DeltaTable) -> Optional[dict]:
     wf = set(proto.get("writerFeatures") or [])
     if "deletionVectors" in rf and "deletionVectors" in wf:
         return None
-    if proto.get("minReaderVersion", 1) >= 2 or table.column_mapping():
+    # upgrading a legacy (version-implied) protocol to the feature-list
+    # form must enumerate every feature the old version numbers implied
+    # (Delta spec table-features upgrade rule)
+    legacy_writer = {2: ["appendOnly", "invariants"],
+                     3: ["checkConstraints"],
+                     4: ["changeDataFeed", "generatedColumns"],
+                     5: ["columnMapping"],
+                     6: ["identityColumns"]}
+    if not proto.get("writerFeatures"):
+        mwv = proto.get("minWriterVersion", 2)
+        for v, feats in legacy_writer.items():
+            if mwv >= v:
+                wf.update(feats)
+    if not proto.get("readerFeatures") and \
+            proto.get("minReaderVersion", 1) >= 2:
+        rf.add("columnMapping")
+    if table.column_mapping():
         rf.add("columnMapping")
         wf.add("columnMapping")
     rf.add("deletionVectors")

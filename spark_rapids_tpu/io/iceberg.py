@@ -10,10 +10,12 @@ exposed as a :class:`..io.parquet.ParquetSource` so pushdown, partition
 pruning, and the decoded-file cache all apply.
 
 Supported: format v1/v2 metadata, snapshot selection (``snapshot_id``),
-identity partition transforms, parquet data files, existing/added manifest
-entries (status ≤ 1).  Not supported: positional/equality deletes
-(GpuDeleteFilter analog), non-identity transforms (bucket/truncate read
-back fine — they only lose file-level pruning).
+identity partition transforms, parquet data files, existing/added/deleted
+manifest entries, v2 row-level deletes — positional (content=1, applied as
+raw-row skip positions like Delta DVs) and equality (content=2, applied as
+per-file anti filters over the equality_ids columns) with sequence-number
+scoping (GpuDeleteFilter analog).  Not supported: non-identity transforms
+(bucket/truncate read back fine — they only lose file-level pruning).
 """
 
 from __future__ import annotations
@@ -122,46 +124,120 @@ class IcebergTable:
                 return os.path.join(self.path, loc[i + 1:])
         return loc
 
-    def data_files(self) -> Dict[str, Dict[str, Optional[str]]]:
-        """Active data files → {abs path: {partition name: raw value}}."""
+    def field_names_by_id(self) -> Dict[int, str]:
+        sch = self.metadata.get("schema")
+        if sch is None:
+            sid = self.metadata.get("current-schema-id", 0)
+            sch = next(s for s in self.metadata["schemas"]
+                       if s.get("schema-id", 0) == sid)
+        return {f["id"]: f["name"] for f in sch["fields"] if "id" in f}
+
+    def _replay_manifests(self):
+        """Manifest replay ONLY (no delete-file I/O): returns
+        (data, data_seq, pos_files, eq_files)."""
         from .avro import read_avro_records
         if self.snapshot is None:
-            return {}
-        out: Dict[str, Dict[str, Optional[str]]] = {}
+            return {}, {}, [], []
         part_names = self.partition_names()
+        data: Dict[str, Dict[str, Optional[str]]] = {}
+        data_seq: Dict[str, int] = {}
+        pos_files = []  # (seq, abs delete-file path)
+        eq_files = []   # (seq, abs path, [field ids])
         mlist = self._resolve(self.snapshot["manifest-list"])
         _, manifests = read_avro_records(mlist)
         for m in manifests:
             mpath = self._resolve(m["manifest_path"])
+            m_seq = m.get("sequence_number") or 0
             _, entries = read_avro_records(mpath)
             for e in entries:
                 status = e.get("status", 1)
-                if status == 2:  # DELETED
-                    out.pop(self._resolve(
-                        e["data_file"]["file_path"]), None)
-                    continue
                 df = e["data_file"]
-                if df.get("content", 0) not in (0, None):
-                    raise ValueError(
-                        "delete files (content>0) not supported")
                 fp = self._resolve(df["file_path"])
-                part = df.get("partition") or {}
-                out[fp] = {n: (None if part.get(n) is None
-                               else str(part.get(n)))
-                           for n in part_names}
-        return out
+                if status == 2:  # DELETED entry retires the file
+                    data.pop(fp, None)
+                    continue
+                seq = e.get("sequence_number")
+                seq = m_seq if seq is None else seq
+                content = df.get("content", 0) or 0
+                if content == 0:
+                    part = df.get("partition") or {}
+                    data[fp] = {n: (None if part.get(n) is None
+                                    else str(part.get(n)))
+                                for n in part_names}
+                    data_seq[fp] = seq
+                elif content == 1:
+                    pos_files.append((seq, fp))
+                elif content == 2:
+                    eq_files.append((seq, fp,
+                                     list(df.get("equality_ids") or [])))
+                else:
+                    raise ValueError(f"unknown manifest content {content}")
+        return data, data_seq, pos_files, eq_files
+
+    def scan_files(self):
+        """(data files, positional deletes, equality deletes) with v2
+        sequence-number scoping.
+
+        Returns ``(data, pos_deletes, eq_deletes)``: data maps abs path →
+        partition values; pos_deletes maps abs data path → sorted int64
+        row positions; eq_deletes maps abs data path → [(column names,
+        set of deleted key tuples)].  Spec scoping: a positional delete
+        applies to data files with data seq <= delete seq; an equality
+        delete applies strictly older data (data seq < delete seq).
+        """
+        import numpy as np
+        import pyarrow.parquet as pq
+
+        data, data_seq, pos_files, eq_files = self._replay_manifests()
+        pos: Dict[str, "np.ndarray"] = {}
+        for seq, dfile in pos_files:
+            t = pq.read_table(dfile, columns=["file_path", "pos"])
+            paths = [self._resolve(p)
+                     for p in t.column("file_path").to_pylist()]
+            positions = t.column("pos").to_pylist()
+            by_target: Dict[str, list] = {}
+            for p, r in zip(paths, positions):
+                by_target.setdefault(p, []).append(r)
+            for p, rows in by_target.items():
+                if p in data and data_seq.get(p, 0) <= seq:
+                    prev = pos.get(p)
+                    arr = np.array(rows, dtype=np.int64)
+                    pos[p] = np.union1d(prev, arr) if prev is not None \
+                        else np.unique(arr)
+        eq: Dict[str, list] = {}
+        names_by_id = self.field_names_by_id()
+        for seq, dfile, ids in eq_files:
+            if not ids:
+                raise ValueError(f"equality delete {dfile} has no "
+                                 f"equality_ids")
+            names = tuple(names_by_id[i] for i in ids)
+            t = pq.read_table(dfile, columns=list(names))
+            keys = set(zip(*[t.column(n).to_pylist() for n in names])) \
+                if t.num_rows else set()
+            if not keys:
+                continue
+            for p, dseq in data_seq.items():
+                if p in data and dseq < seq:
+                    eq.setdefault(p, []).append((names, keys))
+        return data, pos, eq
+
+    def data_files(self) -> Dict[str, Dict[str, Optional[str]]]:
+        """Active data files → {abs path: {partition name: raw value}}.
+        Metadata-only: delete files are NOT read (scan_files does that)."""
+        return self._replay_manifests()[0]
 
     # -- scan ---------------------------------------------------------------------
     def source(self, columns=None, **kwargs):
         from .parquet import ParquetSource
-        files = self.data_files()
+        files, pos, eq = self.scan_files()
         if not files:
             raise FileNotFoundError(
                 f"Iceberg table {self.path} has no data files")
         part_names = self.partition_names()
         return ParquetSource(self.path, columns=columns,
                              _paths=sorted(files),
-                             partitions=(part_names, files), **kwargs)
+                             partitions=(part_names, files),
+                             _skip_rows=pos, _anti_rows=eq, **kwargs)
 
 
 def _iceberg_type(t):

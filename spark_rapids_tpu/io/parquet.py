@@ -215,6 +215,14 @@ def _dv_fingerprint(rows) -> tuple:
     return (len(arr), zlib.crc32(arr.tobytes()))
 
 
+def _anti_fingerprint(names, keys) -> tuple:
+    """Identity of one equality-delete group for cache keys (same
+    single-definition rule as :func:`_dv_fingerprint`)."""
+    import zlib
+    return (names, len(keys),
+            zlib.crc32(repr(sorted(keys, key=repr)).encode()))
+
+
 class ParquetSource:
     """A rebuildable parquet scan source.
 
@@ -232,11 +240,15 @@ class ParquetSource:
                  _paths: Optional[List[str]] = None,
                  partitions: Optional[tuple] = None,
                  _skip_rows: Optional[dict] = None,
-                 _rename: Optional[dict] = None):
+                 _rename: Optional[dict] = None,
+                 _anti_rows: Optional[dict] = None):
         self.path = path
-        # per-file deleted row indexes (Delta deletion vectors): sorted
-        # int64 positions into the file's raw row order
+        # per-file deleted row indexes (Delta deletion vectors / Iceberg
+        # positional deletes): sorted int64 positions into raw row order
         self.skip_rows = _skip_rows or {}
+        # per-file equality deletes (Iceberg content=2): path ->
+        # [(logical column names, set of deleted value tuples)]
+        self.anti_rows = _anti_rows or {}
         # physical (file) name -> logical name (Delta column mapping);
         # self.columns/predicates always speak LOGICAL names
         self.rename = _rename or {}
@@ -300,7 +312,8 @@ class ParquetSource:
                              self.exact_filter, _paths=self.paths,
                              partitions=self._partitions,
                              _skip_rows=self.skip_rows,
-                             _rename=self.rename)
+                             _rename=self.rename,
+                             _anti_rows=self.anti_rows)
 
     def cache_token(self) -> Optional[tuple]:
         """Identity of this scan's output for the device-tier cache: files
@@ -317,8 +330,12 @@ class ParquetSource:
         dvs = tuple(sorted((p, _dv_fingerprint(r))
                            for p, r in self.skip_rows.items()))
         ren = tuple(sorted(self.rename.items()))
+        anti = tuple(sorted(
+            (p, tuple(_anti_fingerprint(names, keys)
+                      for names, keys in groups))
+            for p, groups in self.anti_rows.items()))
         return (tuple(files), cols, preds, self.batch_rows,
-                self.exact_filter, dvs, ren)
+                self.exact_filter, dvs, ren, anti)
 
     def describe(self) -> str:
         d = str(self.path)
@@ -390,6 +407,11 @@ class ParquetSource:
             if (self.exact_filter and file_preds) else None
         if skips is not None:
             pred_key = (pred_key or ()) + (("dv",) + _dv_fingerprint(skips),)
+        anti = self.anti_rows.get(path) or []
+        if anti:
+            pred_key = (pred_key or ()) + tuple(
+                ("anti",) + _anti_fingerprint(names, keys)
+                for names, keys in anti)
         # every partition column appears in every file's output (missing in
         # this file's path → null), keeping batch schemas concatenatable
         part_cols = [(n, self._typed_part_value(n, part_kv.get(n)))
@@ -398,6 +420,16 @@ class ParquetSource:
         file_columns = None if self.columns is None else \
             [self._to_physical.get(c, c)
              for c in self.columns if c not in self.part_names]
+        # equality-delete key columns must be decoded even when the query
+        # projects them away; they are dropped again after the anti filter
+        anti_extra: List[str] = []
+        if anti and file_columns is not None:
+            projected = set(file_columns)
+            for n in sorted({n for names, _ in anti for n in names}):
+                pn = self._to_physical.get(n, n)
+                if pn not in projected:
+                    file_columns.append(pn)
+                    anti_extra.append(n)
         if cache is not None:
             from .filecache import FileCache
             key = FileCache.key_for(path, self.columns, rgs)
@@ -448,6 +480,20 @@ class ParquetSource:
             if self.rename:
                 t = t.rename_columns(
                     [self.rename.get(c, c) for c in t.column_names])
+            for names, keyset in anti:
+                # equality deletes (Iceberg content=2): drop rows whose
+                # key tuple appears in the delete set.  Host tuple probe:
+                # delete sets are small relative to data (the reference's
+                # GpuDeleteFilter builds the same anti-join semantics)
+                cols_ = [t.column(n).to_pylist() for n in names]
+                keep = [tuple(vals) not in keyset
+                        for vals in zip(*cols_)]
+                if not all(keep):
+                    t = t.filter(pa.array(keep))
+            if anti_extra:
+                t = t.drop_columns(anti_extra)
+            if t.num_rows == 0:
+                continue
             for n, v in part_cols:
                 ty = arrow_part[self._part_types[n]]
                 col = (pa.nulls(t.num_rows, type=ty) if v is None

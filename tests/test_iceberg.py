@@ -125,3 +125,142 @@ def test_iceberg_partition_pruning(session, iceberg_table):
 def test_iceberg_missing_snapshot_errors(session, iceberg_table):
     with pytest.raises(ValueError):
         session.read_iceberg(iceberg_table, snapshot_id=999)
+
+
+# ---------------------------------------------------------------------------------
+# v2 row-level deletes: positional (content=1) + equality (content=2).
+# Reference: GpuDeleteFilter (sql-plugin/.../iceberg/GpuDeleteFilter usage in
+# GpuMultiFileBatchReader.java).
+# ---------------------------------------------------------------------------------
+
+_ENTRY_V2_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "sequence_number", "type": "long"},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "record_count", "type": "long"},
+                {"name": "equality_ids",
+                 "type": {"type": "array", "items": "int"}},
+            ]}},
+    ]}
+
+
+def _enc_entries(body, rows):
+    """rows: (status, seq, content, path, count, [equality ids])."""
+    for status, seq, content, path, count, eq_ids in rows:
+        body.long(status)
+        body.long(seq)
+        body.long(content)
+        body.string(path)
+        body.string("PARQUET")
+        body.long(count)
+        if eq_ids:
+            body.long(len(eq_ids))
+            for i in eq_ids:
+                body.long(i)
+        body.long(0)  # array terminator block
+    return len(rows)
+
+
+@pytest.fixture()
+def iceberg_v2_deletes(tmp_path):
+    root = str(tmp_path / "tbl2")
+    meta = os.path.join(root, "metadata")
+    data = os.path.join(root, "data")
+    os.makedirs(meta)
+    os.makedirs(data)
+
+    f1 = os.path.join(data, "f1.parquet")
+    f2 = os.path.join(data, "f2.parquet")
+    pq.write_table(pa.table({"id": pa.array([1, 2, 3, 4], pa.int64()),
+                             "v": [1.0, 2.0, 3.0, 4.0]}), f1)
+    pq.write_table(pa.table({"id": pa.array([10, 20], pa.int64()),
+                             "v": [10.0, 20.0]}), f2)
+    # positional delete: f1 rows 0 and 2 (ids 1, 3)
+    pd = os.path.join(data, "pos-del.parquet")
+    pq.write_table(pa.table({"file_path": [f1, f1],
+                             "pos": pa.array([0, 2], pa.int64())}), pd)
+    # equality delete on id: removes id=10 (applies to older data files)
+    ed = os.path.join(data, "eq-del.parquet")
+    pq.write_table(pa.table({"id": pa.array([10], pa.int64())}), ed)
+
+    m_data = os.path.join(meta, "m-data.avro")
+    _write_avro_manual(m_data, _ENTRY_V2_SCHEMA, lambda b: _enc_entries(b, [
+        (1, 1, 0, f1, 4, []),
+        (1, 1, 0, f2, 2, []),
+    ]))
+    m_del = os.path.join(meta, "m-del.avro")
+    _write_avro_manual(m_del, _ENTRY_V2_SCHEMA, lambda b: _enc_entries(b, [
+        (1, 2, 1, pd, 2, []),
+        (1, 2, 2, ed, 1, [1]),
+    ]))
+
+    mlist_schema = {
+        "type": "record", "name": "manifest_file", "fields": [
+            {"name": "manifest_path", "type": "string"},
+            {"name": "manifest_length", "type": "long"},
+            {"name": "sequence_number", "type": "long"},
+        ]}
+
+    def enc_mlist(body):
+        for p, seq in [(m_data, 1), (m_del, 2)]:
+            body.string(p)
+            body.long(os.path.getsize(p))
+            body.long(seq)
+        return 2
+
+    mlist = os.path.join(meta, "snap-1.avro")
+    _write_avro_manual(mlist, mlist_schema, enc_mlist)
+
+    metadata = {
+        "format-version": 2,
+        "location": root,
+        "current-snapshot-id": 1,
+        "snapshots": [{"snapshot-id": 1, "manifest-list": mlist}],
+        "current-schema-id": 0,
+        "schemas": [{"schema-id": 0, "type": "struct", "fields": [
+            {"id": 1, "name": "id", "required": False, "type": "long"},
+            {"id": 2, "name": "v", "required": False, "type": "double"},
+        ]}],
+        "default-spec-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": []}],
+    }
+    with open(os.path.join(meta, "v1.metadata.json"), "w") as f:
+        json.dump(metadata, f)
+    with open(os.path.join(meta, "version-hint.text"), "w") as f:
+        f.write("1")
+    return root
+
+
+def test_iceberg_positional_and_equality_deletes(session, iceberg_v2_deletes):
+    df = session.read_iceberg(iceberg_v2_deletes)
+    got = sorted(df.collect())
+    # f1 loses ids 1 and 3 (positions 0, 2); f2 loses id 10 (equality)
+    assert got == [(2, 2.0), (4, 4.0), (20, 20.0)]
+
+
+def test_iceberg_deletes_with_projection(session, iceberg_v2_deletes):
+    """Equality-delete key columns are read even when projected away."""
+    from spark_rapids_tpu.sql import functions as f
+    df = session.read_iceberg(iceberg_v2_deletes).select("v")
+    got = sorted(r[0] for r in df.collect())
+    assert got == [2.0, 4.0, 20.0]
+
+
+def test_iceberg_sequence_scoping(session, iceberg_v2_deletes, tmp_path):
+    """An equality delete does NOT apply to data files of the same or
+    newer sequence number (spec: strictly older data only)."""
+    from spark_rapids_tpu.io.iceberg import IcebergTable
+    t = IcebergTable(iceberg_v2_deletes)
+    data, pos, eq = t.scan_files()
+    assert len(data) == 2
+    # equality delete (seq 2) applies only to seq-1 data files
+    for p in eq:
+        assert p in data
+    f1 = next(p for p in data if p.endswith("f1.parquet"))
+    import numpy as np
+    np.testing.assert_array_equal(pos[f1], [0, 2])
