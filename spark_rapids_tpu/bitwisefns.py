@@ -1,0 +1,280 @@
+"""Bitwise and hash expression library.
+
+Reference: ``bitwise.scala`` (GpuBitwiseAnd/Or/Xor/Not, GpuShiftLeft,
+GpuShiftRight, GpuShiftRightUnsigned) and the hash expressions registered
+in GpuOverrides (GpuMurmur3Hash / GpuXxHash64 via spark-rapids-jni `Hash`).
+Device path: traced jnp inside the fused stage (shifts mask the count by
+width-1 exactly like the JVM); hashes reuse the Spark-exact folds in
+``ops/hashing.py``.  Each class carries its numpy CPU twin (``eval_host``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .exprs import (BinaryExpression, Expression, Value, _and_valid,
+                    promote_physical)
+
+__all__ = ["BitwiseAnd", "BitwiseOr", "BitwiseXor", "BitwiseNot",
+           "ShiftLeft", "ShiftRight", "ShiftRightUnsigned",
+           "Murmur3Hash", "XxHash64"]
+
+_INT_SIG = T.TypeSig.integral + T.TypeSig.null
+
+
+class _BitwiseBinary(BinaryExpression):
+    input_sig = _INT_SIG
+    output_sig = T.TypeSig.integral
+    func: str = None  # shared numpy / jax.numpy ufunc name
+
+    def eval(self, ctx) -> Value:
+        ld, rd, v = self._eval_children_promoted(ctx)
+        return getattr(jnp, self.func)(ld, rd), v
+
+    def eval_host(self, ev, n) -> Value:
+        from .cpu.eval import _promote_cpu
+        l, r = self.children
+        ld, lv = ev(l)
+        rd, rv = ev(r)
+        ld = _promote_cpu(ld, l.dtype, self.dtype)
+        rd = _promote_cpu(rd, r.dtype, self.dtype)
+        return getattr(np, self.func)(ld, rd), _and_valid(lv, rv)
+
+
+class BitwiseAnd(_BitwiseBinary):
+    symbol = "&"
+    func = "bitwise_and"
+
+
+class BitwiseOr(_BitwiseBinary):
+    symbol = "|"
+    func = "bitwise_or"
+
+
+class BitwiseXor(_BitwiseBinary):
+    symbol = "^"
+    func = "bitwise_xor"
+
+
+class BitwiseNot(Expression):
+    input_sig = _INT_SIG
+    output_sig = T.TypeSig.integral
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        if child.resolved():
+            self._rebind()
+
+    def _rebind(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def eval(self, ctx) -> Value:
+        d, v = self.children[0].eval(ctx)
+        return ~d, v
+
+    def eval_host(self, ev, n) -> Value:
+        d, v = ev(self.children[0])
+        return np.invert(d), v
+
+
+class _Shift(Expression):
+    """value SHIFT amount — JVM semantics: the count is masked to the value
+    width (x << 33 on an int == x << 1), result type is the value's type
+    (int stays int, long stays long; narrower ints widen to int like Spark).
+    """
+
+    input_sig = _INT_SIG
+    output_sig = T.TypeSig.integral
+    symbol: str = "?"
+
+    def __init__(self, value: Expression, amount: Expression):
+        self.children = (value, amount)
+        if value.resolved() and amount.resolved():
+            self._rebind()
+
+    def _rebind(self):
+        vt = self.children[0].dtype
+        self.dtype = T.INT64 if vt.kind == T.TypeKind.INT64 else T.INT32
+        self.nullable = any(c.nullable for c in self.children)
+
+    def _mask(self):
+        return 63 if self.dtype.kind == T.TypeKind.INT64 else 31
+
+    def _prep(self, xp, ev_pair):
+        (vd, vv), (ad, av) = ev_pair
+        vd = vd.astype(self.dtype.numpy_dtype)
+        amt = xp.bitwise_and(ad.astype(xp.int32), self._mask())
+        return vd, amt, _and_valid(vv, av)
+
+    def eval(self, ctx) -> Value:
+        pair = [c.eval(ctx) for c in self.children]
+        vd, amt, v = self._prep(jnp, pair)
+        return self._shift(jnp, vd, amt), v
+
+    def eval_host(self, ev, n) -> Value:
+        pair = [ev(c) for c in self.children]
+        vd, amt, v = self._prep(np, pair)
+        return self._shift(np, vd, amt), v
+
+
+class ShiftLeft(_Shift):
+    symbol = "<<"
+
+    def _shift(self, xp, vd, amt):
+        return xp.left_shift(vd, amt.astype(vd.dtype))
+
+
+class ShiftRight(_Shift):
+    symbol = ">>"
+
+    def _shift(self, xp, vd, amt):  # arithmetic (sign-extending)
+        return xp.right_shift(vd, amt.astype(vd.dtype))
+
+
+class ShiftRightUnsigned(_Shift):
+    symbol = ">>>"
+
+    def _shift(self, xp, vd, amt):  # logical: shift the unsigned view
+        unsigned = xp.uint64 if vd.dtype == xp.int64 else xp.uint32
+        u = vd.view(unsigned) if xp is np else \
+            jax.lax.bitcast_convert_type(vd, unsigned)
+        out = xp.right_shift(u, amt.astype(unsigned))
+        return out.view(vd.dtype) if xp is np else \
+            jax.lax.bitcast_convert_type(out, vd.dtype)
+
+
+class _HashExpression(Expression):
+    """Variadic row hash; null columns fold the running hash through, so
+    the result itself is never null (GpuMurmur3Hash/GpuXxHash64)."""
+
+    nullable = False
+
+    def __init__(self, *children: Expression):
+        if not children:
+            raise ValueError(f"{type(self).__name__} needs >= 1 column")
+        self.children = tuple(children)
+
+    def _values(self, ctx):
+        return [c.eval(ctx) for c in self.children]
+
+    def _host_values(self, ev):
+        out = []
+        for c in self.children:
+            d, v = ev(c)
+            out.append((np.asarray(d), v, c.dtype))
+        return out
+
+
+def _utf8_arrays(d: np.ndarray, n: int):
+    """Object array of python strings -> (bytes, offsets) Arrow layout."""
+    chunks, offsets, pos = [], np.zeros(n + 1, dtype=np.int64), 0
+    for i in range(n):
+        s = d[i]
+        b = s.encode() if isinstance(s, str) else (s or b"")
+        chunks.append(b)
+        pos += len(b)
+        offsets[i + 1] = pos
+    return np.frombuffer(b"".join(chunks), dtype=np.uint8), offsets
+
+
+class Murmur3Hash(_HashExpression):
+    dtype = T.INT32
+    input_sig = T.TypeSig.device_compute  # strings hash on the CPU path
+    output_sig = T.TypeSig((T.TypeKind.INT32,))
+
+    def eval(self, ctx) -> Value:
+        from .ops.hashing import hash_columns
+        h = hash_columns(self._values(ctx), seed=42)
+        return h.astype(jnp.int32), None
+
+    def eval_host(self, ev, n) -> Value:
+        from . import native
+        h = np.full(n, 42, dtype=np.int32)
+        for d, v, dt in self._host_values(ev):
+            if dt.is_string:
+                bytes_, offsets = _utf8_arrays(d, n)
+                new = native.murmur3_utf8(bytes_, offsets, h)
+            else:
+                new = native.murmur3_fold(d, dt, h)
+            h = np.where(v, new, h) if v is not None else new
+        return h, None
+
+
+class XxHash64(_HashExpression):
+    dtype = T.INT64
+    input_sig = T.TypeSig.device_compute  # strings hash on the CPU path
+    output_sig = T.TypeSig((T.TypeKind.INT64,))
+
+    def eval(self, ctx) -> Value:
+        from .ops.hashing import xxhash64_columns
+        h = xxhash64_columns(self._values(ctx), seed=42)
+        return jax.lax.bitcast_convert_type(h, jnp.int64), None
+
+    def eval_host(self, ev, n) -> Value:
+        from . import native
+        h = np.full(n, np.uint64(42), dtype=np.uint64)
+        for d, v, dt in self._host_values(ev):
+            if dt.is_string:
+                new = np.array(
+                    [native.xxhash64_bytes(
+                        (s.encode() if isinstance(s, str) else (s or b"")),
+                        int(seed)) for s, seed in zip(d, h)],
+                    dtype=np.uint64)
+            elif dt.is_floating:
+                bits = native.normalize_float_bits(
+                    np.ascontiguousarray(d, dtype=dt.numpy_dtype))
+                if bits.dtype == np.int64:
+                    new = _np_xxhash64_long(bits.view(np.uint64), h)
+                else:
+                    new = _np_xxhash64_int(bits.view(np.uint32), h)
+            elif d.dtype in (np.dtype(np.int64), np.dtype(np.uint64)):
+                new = _np_xxhash64_long(d.view(np.uint64), h)
+            else:
+                new = _np_xxhash64_int(d.astype(np.int32).view(np.uint32), h)
+            h = np.where(v, new, h) if v is not None else new
+        return h.view(np.int64), None
+
+
+# numpy twins of ops/hashing's device folds (kept here so the CPU fallback
+# needs no jax; native.xxhash64_long only takes a scalar seed)
+_P1 = np.uint64(0x9E3779B185EBCA87)
+_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = np.uint64(0x165667B19E3779F9)
+_P4 = np.uint64(0x85EBCA77C2B2AE63)
+_P5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _np_rotl64(x, r):
+    with np.errstate(over="ignore"):
+        return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def _np_xx_avalanche(h):
+    with np.errstate(over="ignore"):
+        h = h ^ (h >> np.uint64(33))
+        h = h * _P2
+        h = h ^ (h >> np.uint64(29))
+        h = h * _P3
+        return h ^ (h >> np.uint64(32))
+
+
+def _np_xxhash64_long(x: np.ndarray, seed: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        h = seed + _P5 + np.uint64(8)
+        k1 = _np_rotl64(x * _P2, 31) * _P1
+        h = _np_rotl64(h ^ k1, 27) * _P1 + _P4
+        return _np_xx_avalanche(h)
+
+
+def _np_xxhash64_int(x: np.ndarray, seed: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        h = seed + _P5 + np.uint64(4)
+        h = h ^ (x.astype(np.uint64) * _P1)
+        h = _np_rotl64(h, 23) * _P2 + _P3
+        return _np_xx_avalanche(h)

@@ -22,7 +22,8 @@ import numpy as np
 log = logging.getLogger("spark_rapids_tpu")
 
 __all__ = ["available", "murmur3_int", "murmur3_long", "murmur3_utf8",
-           "pmod_partition", "xxhash64_long", "compress", "decompress",
+           "murmur3_fold", "normalize_float_bits", "pmod_partition",
+           "xxhash64_long", "xxhash64_bytes", "compress", "decompress",
            "cast_string_to_long", "cast_string_to_double"]
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -187,6 +188,28 @@ def _np_fmix(h1, length):
         return h1
 
 
+def normalize_float_bits(vals: np.ndarray) -> np.ndarray:
+    """-0.0 → +0.0 and NaN → canonical NaN, then the raw bit pattern —
+    the ONE host definition matching the device kernel
+    (ops/hashing._normalize_float_bits); shared by hash expressions and
+    DCN partition ids so they cannot diverge."""
+    v = vals.copy()
+    v[v == 0.0] = 0.0
+    v[np.isnan(v)] = np.nan
+    return v.view(np.int32 if v.dtype == np.float32 else np.int64)
+
+
+def murmur3_fold(vals: np.ndarray, dt, seeds) -> np.ndarray:
+    """Fold one non-string column (numpy physical values + logical dtype)
+    into running murmur3 hashes — the host twin of ops/hashing.hash_value."""
+    if dt.is_floating:
+        vals = normalize_float_bits(
+            np.ascontiguousarray(vals, dtype=dt.numpy_dtype))
+    if vals.dtype in (np.dtype(np.int64), np.dtype(np.uint64)):
+        return murmur3_long(vals.view(np.int64), seeds)
+    return murmur3_int(vals.astype(np.int32), seeds)
+
+
 def pmod_partition(hashes: np.ndarray, num_parts: int) -> np.ndarray:
     hashes = np.ascontiguousarray(hashes, dtype=np.int32)
     lib = _load()
@@ -226,6 +249,58 @@ def xxhash64_long(vals: np.ndarray, seed: int = 42) -> np.ndarray:
         h *= P3
         h ^= h >> np.uint64(32)
     return h.view(np.int64)
+
+
+_XXP = (0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9,
+        0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5)
+_M64 = (1 << 64) - 1
+
+
+def xxhash64_bytes(data: bytes, seed: int = 42) -> int:
+    """Canonical XXH64 over arbitrary bytes (Spark XxHash64 on utf8
+    strings/binary).  Pure-python ints — the CPU fallback path for string
+    hashing; verified against python-xxhash golden values in the tests."""
+    P1, P2, P3, P4, P5 = _XXP
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & _M64
+
+    def rnd(acc, inp):
+        return (rotl((acc + inp * P2) & _M64, 31) * P1) & _M64
+
+    n = len(data)
+    pos = 0
+    if n >= 32:
+        v1 = (seed + P1 + P2) & _M64
+        v2 = (seed + P2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - P1) & _M64
+        while pos + 32 <= n:
+            v1 = rnd(v1, int.from_bytes(data[pos:pos + 8], "little"))
+            v2 = rnd(v2, int.from_bytes(data[pos + 8:pos + 16], "little"))
+            v3 = rnd(v3, int.from_bytes(data[pos + 16:pos + 24], "little"))
+            v4 = rnd(v4, int.from_bytes(data[pos + 24:pos + 32], "little"))
+            pos += 32
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ rnd(0, v)) * P1 + P4) & _M64
+    else:
+        h = (seed + P5) & _M64
+    h = (h + n) & _M64
+    while pos + 8 <= n:
+        k1 = rnd(0, int.from_bytes(data[pos:pos + 8], "little"))
+        h = (rotl(h ^ k1, 27) * P1 + P4) & _M64
+        pos += 8
+    if pos + 4 <= n:
+        h = (rotl(h ^ (int.from_bytes(data[pos:pos + 4], "little") * P1)
+                  & _M64, 23) * P2 + P3) & _M64
+        pos += 4
+    while pos < n:
+        h = (rotl(h ^ (data[pos] * P5) & _M64, 11) * P1) & _M64
+        pos += 1
+    h = ((h ^ (h >> 33)) * P2) & _M64
+    h = ((h ^ (h >> 29)) * P3) & _M64
+    return h ^ (h >> 32)
 
 
 # ---------------------------------------------------------------------------------

@@ -20,12 +20,17 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 Value = Tuple[jax.Array, Optional[jax.Array]]
 
-_C1 = jnp.uint32(0xcc9e2d51)
-_C2 = jnp.uint32(0x1b873593)
+# numpy scalars, NOT jnp arrays: a module-level jnp constant is a
+# device-committed buffer that jit hoists into the executable's runtime
+# arguments, which breaks re-execution of cached stage programs (observed:
+# "Execution supplied 2 buffers but compiled program expected 7")
+_C1 = np.uint32(0xcc9e2d51)
+_C2 = np.uint32(0x1b873593)
 
 SPARK_PARTITION_SEED = 42
 
@@ -116,3 +121,79 @@ def spark_partition_id(keys: Sequence[Value], n_parts: int) -> jax.Array:
     h = hash_columns(keys).astype(jnp.int32)
     pid = h % jnp.int32(n_parts)
     return jnp.where(pid < 0, pid + n_parts, pid)
+
+
+# ---------------------------------------------------------------------------------
+# xxhash64 (Spark XxHash64Function, default seed 42) — the 4- and 8-byte
+# single-value paths of canonical XXH64, mirrored from native/srt_native.cpp
+# (which is verified against python-xxhash).
+# ---------------------------------------------------------------------------------
+
+# numpy scalars for the same buffer-hoisting reason as _C1/_C2 above
+_XP1 = np.uint64(0x9E3779B185EBCA87)
+_XP2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_XP3 = np.uint64(0x165667B19E3779F9)
+_XP4 = np.uint64(0x85EBCA77C2B2AE63)
+_XP5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r):
+    return (x << r) | (x >> (64 - r))
+
+
+def _xx_avalanche(h):
+    h = h ^ (h >> 33)
+    h = h * _XP2
+    h = h ^ (h >> 29)
+    h = h * _XP3
+    return h ^ (h >> 32)
+
+
+def _xxhash64_long(x: jax.Array, seed: jax.Array) -> jax.Array:
+    """XXH64 of one 8-byte little-endian value (uint64 in/out)."""
+    h = seed + _XP5 + jnp.uint64(8)
+    k1 = _rotl64(x * _XP2, 31) * _XP1
+    h = _rotl64(h ^ k1, 27) * _XP1 + _XP4
+    return _xx_avalanche(h)
+
+
+def _xxhash64_int(x: jax.Array, seed: jax.Array) -> jax.Array:
+    """XXH64 of one 4-byte value (uint32-widened input, uint64 in/out)."""
+    h = seed + _XP5 + jnp.uint64(4)
+    h = h ^ (x * _XP1)
+    h = _rotl64(h, 23) * _XP2 + _XP3
+    return _xx_avalanche(h)
+
+
+def xxhash64_value(data: jax.Array, valid: Optional[jax.Array],
+                   running: jax.Array) -> jax.Array:
+    """Fold one column into the running per-row xxhash64 (uint64).
+
+    Spark hashes bool/byte/short/int/date as the 4-byte path and
+    long/double/timestamp/decimal as the 8-byte path; floats normalize
+    -0.0/NaN first like the murmur3 kernel."""
+    dt = data.dtype
+    if dt in (jnp.bool_, jnp.int8, jnp.int16, jnp.int32):
+        u = data.astype(jnp.int32).astype(jnp.uint32)
+        out = _xxhash64_int(u.astype(jnp.uint64), running)
+    elif dt == jnp.float32:
+        u = _normalize_float_bits(data).astype(jnp.uint32)
+        out = _xxhash64_int(u.astype(jnp.uint64), running)
+    elif dt == jnp.int64:
+        out = _xxhash64_long(data.astype(jnp.uint64), running)
+    elif dt == jnp.float64:
+        out = _xxhash64_long(
+            _normalize_float_bits(data).astype(jnp.uint64), running)
+    else:
+        raise TypeError(f"no device xxhash64 for dtype {dt}")
+    if valid is not None:
+        out = jnp.where(valid, out, running)
+    return out
+
+
+def xxhash64_columns(keys: Sequence[Value], seed: int = 42) -> jax.Array:
+    capacity = keys[0][0].shape[0]
+    h = jnp.full((capacity,), jnp.uint64(seed), dtype=jnp.uint64)
+    for data, valid in keys:
+        h = xxhash64_value(data, valid, h)
+    return h

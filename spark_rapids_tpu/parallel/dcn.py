@@ -496,13 +496,6 @@ class DcnShuffle:
 # Host-side Spark-exact partition ids (cross-rank consistent for ALL types).
 # ---------------------------------------------------------------------------------
 
-def _normalize_float_bits_np(vals: np.ndarray) -> np.ndarray:
-    v = vals.copy()
-    v[v == 0.0] = 0.0        # -0.0 -> +0.0
-    v[np.isnan(v)] = np.nan  # canonical NaN bit pattern
-    return v.view(np.int32 if v.dtype == np.float32 else np.int64)
-
-
 def host_partition_ids(table, key_ordinals: List[int], schema,
                        n_parts: int) -> np.ndarray:
     """Murmur3 pmod partition ids over an arrow table's key columns.
@@ -534,11 +527,9 @@ def host_partition_ids(table, key_ordinals: List[int], schema,
             # bytes_ would hash the wrong bytes
             new = native.murmur3_utf8(bytes_, offsets, h)
         else:
-            vals = _arrow_physical(col, dt, n)
-            if vals.dtype == np.int64:
-                new = native.murmur3_long(vals, h)
-            else:
-                new = native.murmur3_int(vals, h)
+            # shared fold (native.murmur3_fold) so partition ids and the
+            # hash() expression can never diverge
+            new = native.murmur3_fold(_arrow_physical(col, dt, n), dt, h)
         h = np.where(valid, new, h)
     return native.pmod_partition(h, n_parts)
 
@@ -558,9 +549,9 @@ def _arrow_physical(col, dt, n: int) -> np.ndarray:
                 vals[i] = int(v.scaleb(dt.scale))
         return vals
     if dt.is_floating:
-        npv = np.ascontiguousarray(
+        # raw float values; murmur3_fold normalizes -0.0/NaN bits
+        return np.ascontiguousarray(
             col.to_numpy(zero_copy_only=False), dtype=dt.numpy_dtype)
-        return _normalize_float_bits_np(npv)
     target = pa.int64() if dt.numpy_dtype == np.int64 else pa.int32()
     ints = col.cast(target)
     if ints.null_count:

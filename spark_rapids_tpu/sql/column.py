@@ -98,6 +98,19 @@ class Column:
     def __invert__(self):
         return Column(E.Not(self.expr))
 
+    # -- bitwise (pyspark naming) -------------------------------------------------
+    def bitwiseAND(self, o):
+        from .. import bitwisefns as B
+        return Column(B.BitwiseAnd(self.expr, to_expr(o)))
+
+    def bitwiseOR(self, o):
+        from .. import bitwisefns as B
+        return Column(B.BitwiseOr(self.expr, to_expr(o)))
+
+    def bitwiseXOR(self, o):
+        from .. import bitwisefns as B
+        return Column(B.BitwiseXor(self.expr, to_expr(o)))
+
     # -- null / misc --------------------------------------------------------------
     def is_null(self):
         return Column(E.IsNull(self.expr))

@@ -33,6 +33,9 @@ __all__ = [
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
     "var_pop", "corr", "covar_pop", "covar_samp", "percentile",
     "percentile_approx",
+    # bitwise / hash
+    "bitwise_not", "bitwiseNOT", "shiftleft", "shiftright",
+    "shiftrightunsigned", "hash", "xxhash64",
 ]
 
 def col(name: str) -> Column:
@@ -602,3 +605,40 @@ def pandas_udf(fn=None, *, return_type=None, name=None):
     if name is not None:
         kwargs["name"] = name
     return _pudf(fn, **kwargs) if fn is not None else _pudf(**kwargs)
+
+
+# -- bitwise / hash ---------------------------------------------------------------
+
+def bitwise_not(c) -> Column:
+    from .. import bitwisefns as B
+    return Column(B.BitwiseNot(_colref(c)))
+
+
+bitwiseNOT = bitwise_not  # pyspark alias
+
+
+def shiftleft(c, n) -> Column:
+    from .. import bitwisefns as B
+    return Column(B.ShiftLeft(_colref(c), to_expr(n)))
+
+
+def shiftright(c, n) -> Column:
+    from .. import bitwisefns as B
+    return Column(B.ShiftRight(_colref(c), to_expr(n)))
+
+
+def shiftrightunsigned(c, n) -> Column:
+    from .. import bitwisefns as B
+    return Column(B.ShiftRightUnsigned(_colref(c), to_expr(n)))
+
+
+def hash(*cols) -> Column:  # noqa: A001 — mirrors pyspark naming
+    """Spark-exact murmur3 row hash, seed 42 (GpuMurmur3Hash)."""
+    from .. import bitwisefns as B
+    return Column(B.Murmur3Hash(*[_colref(c) for c in cols]))
+
+
+def xxhash64(*cols) -> Column:
+    """Spark-exact xxhash64 row hash, seed 42 (GpuXxHash64)."""
+    from .. import bitwisefns as B
+    return Column(B.XxHash64(*[_colref(c) for c in cols]))

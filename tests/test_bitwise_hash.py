@@ -1,0 +1,180 @@
+"""Bitwise + hash expressions (bitwise.scala / hashFunctions analogs).
+
+Oracles: Spark golden murmur3 values (hash(1) = -559580957 for IntegerType,
+seed 42), the independent python-xxhash library, and the native C kernels.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+
+def _col(session, name, rows, dtype=None):
+    arr = pa.array(rows, type=dtype)
+    return session.create_dataframe(pa.table({name: arr}))
+
+
+class TestBitwise:
+    def test_and_or_xor(self, session):
+        df = session.create_dataframe({
+            "a": np.array([0b1100, -1, 0], np.int64),
+            "b": np.array([0b1010, 7, 5], np.int64)})
+        rows = df.select(
+            F.col("a").bitwiseAND(F.col("b")).alias("and_"),
+            F.col("a").bitwiseOR(F.col("b")).alias("or_"),
+            F.col("a").bitwiseXOR(F.col("b")).alias("xor_")).collect()
+        assert rows == [(0b1000, 0b1110, 0b0110), (7, -1, -8), (0, 5, 5)]
+
+    def test_not_and_nulls(self, session):
+        df = _col(session, "a", [5, None, -1], pa.int32())
+        rows = df.select(F.bitwise_not(F.col("a")).alias("n")).collect()
+        assert rows == [(-6,), (None,), (0,)]
+
+    def test_mixed_width_promotes(self, session):
+        df = session.create_dataframe(pa.table({
+            "i": pa.array([3], pa.int32()), "l": pa.array([5], pa.int64())}))
+        rows = df.select(F.col("i").bitwiseAND(F.col("l")).alias("x"))
+        assert rows.collect() == [(1,)]
+
+    def test_bitwise_on_double_falls_back_rejected(self, session):
+        df = session.create_dataframe({"x": [1.5]})
+        plan = df.select(F.col("x").bitwiseAND(F.lit(1)).alias("b"))
+        assert "not supported" in plan.explain_string()
+
+
+class TestShifts:
+    def test_jvm_count_masking(self, session):
+        df = _col(session, "a", [8, -8], pa.int32())
+        rows = df.select(
+            F.shiftleft(F.col("a"), F.lit(33)).alias("l"),  # == << 1
+            F.shiftright(F.col("a"), F.lit(1)).alias("r"),
+            F.shiftrightunsigned(F.col("a"), F.lit(1)).alias("u")).collect()
+        assert rows[0] == (16, 4, 4)
+        assert rows[1] == (-16, -4, 2147483644)  # JVM -8 >>> 1
+
+    def test_long_shifts(self, session):
+        df = _col(session, "a", [1, -2], pa.int64())
+        rows = df.select(
+            F.shiftleft(F.col("a"), F.lit(40)).alias("l"),
+            F.shiftrightunsigned(F.col("a"), F.lit(40)).alias("u")).collect()
+        assert rows[0] == (1 << 40, 0)
+        assert rows[1] == (-(2 << 40) % (1 << 64) - (1 << 64),
+                           (2**64 - 2) >> 40)
+
+    def test_shift_small_int_widens_to_int(self, session):
+        df = _col(session, "a", [4], pa.int16())
+        assert df.select(
+            F.shiftleft(F.col("a"), F.lit(2)).alias("s")).collect() \
+            == [(16,)]
+
+
+class TestMurmur3Hash:
+    def test_spark_golden_values(self, session):
+        df = _col(session, "a", [1, 0, 42], pa.int32())
+        rows = df.select(F.hash(F.col("a")).alias("h")).collect()
+        assert [r[0] for r in rows] == [-559580957, 933211791, 29417773]
+
+    def test_device_matches_native_host_fold(self, session):
+        from spark_rapids_tpu import native
+        vals = np.array([0, 1, -5, 2**40, -2**50], np.int64)
+        df = _col(session, "a", vals.tolist(), pa.int64())
+        got = [r[0] for r in df.select(F.hash(F.col("a")).alias("h"))
+               .collect()]
+        expect = native.murmur3_long(vals, 42).tolist()
+        assert got == expect
+
+    def test_multi_column_fold_with_nulls(self, session):
+        from spark_rapids_tpu import native
+        df = session.create_dataframe(pa.table({
+            "i": pa.array([1, None, 3], pa.int32()),
+            "l": pa.array([10, 20, None], pa.int64())}))
+        got = [r[0] for r in
+               df.select(F.hash(F.col("i"), F.col("l")).alias("h"))
+               .collect()]
+        # independent host fold: int column then long column, null = pass
+        h = np.full(3, 42, np.int32)
+        new = native.murmur3_int(np.array([1, 0, 3], np.int32), h)
+        h = np.where([True, False, True], new, h)
+        new = native.murmur3_long(np.array([10, 20, 0], np.int64), h)
+        h = np.where([True, True, False], new, h)
+        assert got == h.tolist()
+        assert all(g is not None for g in got)  # hash is never null
+
+    def test_double_normalization(self, session):
+        df = _col(session, "a", [0.0, -0.0], pa.float64())
+        rows = [r[0] for r in df.select(F.hash(F.col("a")).alias("h"))
+                .collect()]
+        assert rows[0] == rows[1]  # -0.0 hashes like +0.0
+
+
+class TestXxHash64:
+    # Golden values from the python-xxhash library, precomputed once:
+    # `xxh64(int64(v).tobytes(), seed=42)` (8-byte path) and
+    # `xxh64(int32(v).tobytes(), seed=42)` (4-byte path), two's-complement.
+    GOLDEN_LONG = {0: -5252525462095825812, 1: -7001672635703045582,
+                   -7: -1663473129717591079, 2**40: 1821704621099523357}
+    GOLDEN_INT = {1: -6698625589789238999, -2: 6162728026222640212,
+                  1000: -3226198733444762270}
+
+    def test_against_xxhash_library_goldens(self, session):
+        vals = list(self.GOLDEN_LONG)
+        df = _col(session, "a", vals, pa.int64())
+        got = [r[0] for r in df.select(F.xxhash64(F.col("a")).alias("h"))
+               .collect()]
+        assert got == [self.GOLDEN_LONG[v] for v in vals]
+
+    def test_int_width_path(self, session):
+        vals = list(self.GOLDEN_INT)
+        df = _col(session, "a", vals, pa.int32())
+        got = [r[0] for r in df.select(F.xxhash64(F.col("a")).alias("h"))
+               .collect()]
+        assert got == [self.GOLDEN_INT[v] for v in vals]
+
+    # xxh64(s.encode(), seed=42), precomputed with python-xxhash,
+    # two's-complement int64
+    GOLDEN_STR = {"": -7444071767201028348,
+                  "abc": 1423657621850124518,
+                  "héllo": 501425390238239234,
+                  "a longer string to cross eight bytes":
+                      8989899728738319250}
+
+    def test_string_hashing_on_cpu_path(self, session):
+        vals = list(self.GOLDEN_STR) + [None]
+        df = _col(session, "s", vals, pa.string())
+        q = df.select(F.xxhash64(F.col("s")).alias("h"))
+        assert "!" in q.explain_string()  # strings -> CPU fallback
+        got = [r[0] for r in q.collect()]
+        assert got[:-1] == [self.GOLDEN_STR[v] for v in vals[:-1]]
+        # null folds the seed through: xxh64 result of just the seed state
+        assert got[-1] is not None
+
+    def test_string_murmur3_matches_native_kernel(self, session):
+        from spark_rapids_tpu import native
+        vals = ["", "spark", "héllo wörld", None, "tail7b"]
+        df = _col(session, "s", vals, pa.string())
+        got = [r[0] for r in df.select(F.hash(F.col("s")).alias("h"))
+               .collect()]
+        enc = [(v or "").encode() for v in vals]
+        offsets = np.zeros(len(enc) + 1, dtype=np.int64)
+        for i, b in enumerate(enc):
+            offsets[i + 1] = offsets[i] + len(b)
+        expect = native.murmur3_utf8(
+            np.frombuffer(b"".join(enc), np.uint8), offsets, 42)
+        h = np.where([v is not None for v in vals], expect, 42)
+        assert got == h.tolist()
+
+    def test_cpu_host_twin_matches_device(self, session):
+        """eval_host (numpy) and eval (jax) must agree bit-for-bit."""
+        from spark_rapids_tpu import bitwisefns as B
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.exprs import BoundReference
+        vals = np.array([3, -9, 2**33], np.int64)
+        e = B.XxHash64(BoundReference(0, T.INT64, False, "a"))
+        host, _ = e.eval_host(lambda c: (vals, None), 3)
+        df = _col(session, "a", vals.tolist(), pa.int64())
+        dev = [r[0] for r in df.select(F.xxhash64(F.col("a")).alias("h"))
+               .collect()]
+        assert host.tolist() == dev
