@@ -27,10 +27,26 @@ __all__ = ["BitwiseAnd", "BitwiseOr", "BitwiseXor", "BitwiseNot",
 _INT_SIG = T.TypeSig.integral + T.TypeSig.null
 
 
+def _require_integral(node: "Expression", *children: "Expression") -> None:
+    """Spark raises an AnalysisException for bitwise/shift over non-integral
+    operands; silently truncating a double would corrupt results."""
+    for c in children:
+        dt = c.dtype
+        if dt is not None and not (dt.is_integral
+                                   or dt.kind == T.TypeKind.NULL):
+            raise TypeError(
+                f"{type(node).__name__} requires integral operands, "
+                f"got {dt}")
+
+
 class _BitwiseBinary(BinaryExpression):
     input_sig = _INT_SIG
     output_sig = T.TypeSig.integral
     func: str = None  # shared numpy / jax.numpy ufunc name
+
+    def _resolve(self):
+        _require_integral(self, *self.children)
+        super()._resolve()
 
     def eval(self, ctx) -> Value:
         ld, rd, v = self._eval_children_promoted(ctx)
@@ -71,6 +87,7 @@ class BitwiseNot(Expression):
             self._rebind()
 
     def _rebind(self):
+        _require_integral(self, self.children[0])
         self.dtype = self.children[0].dtype
         self.nullable = self.children[0].nullable
 
@@ -99,6 +116,7 @@ class _Shift(Expression):
             self._rebind()
 
     def _rebind(self):
+        _require_integral(self, *self.children)
         vt = self.children[0].dtype
         self.dtype = T.INT64 if vt.kind == T.TypeKind.INT64 else T.INT32
         self.nullable = any(c.nullable for c in self.children)
@@ -141,12 +159,12 @@ class ShiftRightUnsigned(_Shift):
     symbol = ">>>"
 
     def _shift(self, xp, vd, amt):  # logical: shift the unsigned view
+        # astype, not bitcast: int<->uint conversion is modular (same bits)
+        # and 64-bit bitcast-convert is unimplemented in XLA's X64-rewrite
         unsigned = xp.uint64 if vd.dtype == xp.int64 else xp.uint32
-        u = vd.view(unsigned) if xp is np else \
-            jax.lax.bitcast_convert_type(vd, unsigned)
+        u = vd.view(unsigned) if xp is np else vd.astype(unsigned)
         out = xp.right_shift(u, amt.astype(unsigned))
-        return out.view(vd.dtype) if xp is np else \
-            jax.lax.bitcast_convert_type(out, vd.dtype)
+        return out.view(vd.dtype) if xp is np else out.astype(vd.dtype)
 
 
 class _HashExpression(Expression):
@@ -214,7 +232,7 @@ class XxHash64(_HashExpression):
     def eval(self, ctx) -> Value:
         from .ops.hashing import xxhash64_columns
         h = xxhash64_columns(self._values(ctx), seed=42)
-        return jax.lax.bitcast_convert_type(h, jnp.int64), None
+        return h.astype(jnp.int64), None  # modular: same bits, no bitcast
 
     def eval_host(self, ev, n) -> Value:
         from . import native

@@ -52,7 +52,13 @@ def sortable_view(data: jax.Array) -> jax.Array:
         data = jnp.where(data == 0.0, jnp.zeros_like(data), data)  # -0.0 → +0.0
         nan = jnp.isnan(data)
         ibits = jnp.int32 if data.dtype == jnp.float32 else jnp.int64
-        bits = jax.lax.bitcast_convert_type(data, ibits)
+        if data.dtype == jnp.float64:
+            # arithmetic bit extraction: NO 64-bit bitcast-convert exists
+            # in XLA's X64-rewrite pass on real TPU backends
+            from .hashing import f64_bit_pattern
+            bits = f64_bit_pattern(data)
+        else:
+            bits = jax.lax.bitcast_convert_type(data, ibits)
         # signed total-order key: non-negative floats keep their bits
         # (monotonic, positive); negative floats map to MIN - bits, which is
         # negative and increases as the float increases toward zero.

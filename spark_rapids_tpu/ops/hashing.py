@@ -64,23 +64,94 @@ def _hash_int32(x: jax.Array, h: jax.Array) -> jax.Array:
     return _fmix(_mix_h1(h, _mix_k1(x.astype(jnp.uint32))), 4)
 
 
-def _hash_int64(x: jax.Array, h: jax.Array) -> jax.Array:
-    u = x.astype(jnp.uint64)
-    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-    hi = (u >> 32).astype(jnp.uint32)
+def _hash_words(lo: jax.Array, hi: jax.Array, h: jax.Array) -> jax.Array:
     h1 = _mix_h1(h, _mix_k1(lo))
     h1 = _mix_h1(h1, _mix_k1(hi))
     return _fmix(h1, 8)
 
 
+def _hash_int64(x: jax.Array, h: jax.Array) -> jax.Array:
+    u = x.astype(jnp.uint64)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (u >> 32).astype(jnp.uint32)
+    return _hash_words(lo, hi, h)
+
+
 def _normalize_float_bits(d: jax.Array) -> jax.Array:
-    if d.dtype == jnp.float32:
-        d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
-        d = jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
-        return jax.lax.bitcast_convert_type(d, jnp.int32)
+    """float32 -> int32 bit pattern (-0.0/NaN canonicalized)."""
     d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
     d = jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
-    return jax.lax.bitcast_convert_type(d, jnp.int64)
+    return jax.lax.bitcast_convert_type(d, jnp.int32)
+
+
+def _exp2_int(k: jax.Array) -> jax.Array:
+    """Exact 2.0**k for integer-valued k arrays, |k| <= 1023 (k >= 1024
+    -> inf).  Repeated squaring of exact power-of-two constants — XLA's
+    exp2 is not correctly rounded, and one ULP of error in the scale
+    breaks bit-exact mantissa extraction."""
+    neg = k < 0
+    a = jnp.where(neg, -k, k).astype(jnp.int32)
+    p = jnp.ones(k.shape, dtype=jnp.float64)
+    for i in range(10):  # bits 0..9 cover |k| <= 1023
+        factor = float(2.0 ** (1 << i))
+        p = p * jnp.where(((a >> i) & 1) == 1, factor, 1.0)
+    p = jnp.where(a >= 1024, jnp.inf, p)
+    return jnp.where(neg, 1.0 / p, p)
+
+
+def f64_bit_pattern(d: jax.Array) -> jax.Array:
+    """IEEE-754 bit pattern of a float64 column as int64 — computed
+    ARITHMETICALLY, because XLA's X64-rewrite pass (real TPU backends)
+    implements no 64-bit bitcast-convert at all (f64->s64, f64->u32x2,
+    even jnp.frexp's internals all fail to compile).
+
+    Exactness argument: the exponent comes from floor(log2) corrected by
+    comparing against an exactly-constructed power of two (_exp2_int —
+    XLA's exp2 is not correctly rounded); dividing by an exact power of two
+    and scaling by 2^52 are exact float ops; f64->int64 conversion of an
+    integer-valued float is exact.  -0.0 maps to +0.0's bits; NaN
+    canonicalizes to 0x7FF8...; verified bit-for-bit against numpy's
+    view() over boundaries/extremes.  Subnormal magnitudes map to zero's
+    pattern: XLA backends run flush-to-zero, so every other engine op
+    (compare, sort, sum) already treats them as zero — hashing/grouping
+    them with zero is the consistent choice.
+    """
+    y = jnp.abs(d)
+    finite_pos = jnp.isfinite(y) & (y > 0)
+    ysafe = jnp.where(finite_pos, y, 1.0)
+    e = jnp.floor(jnp.log2(ysafe)).astype(jnp.int32)
+    e = jnp.clip(e, -1022, 1023)  # subnormals use the field path anyway
+    e = jnp.where(ysafe < _exp2_int(e), e - 1, e)
+    e = jnp.where(ysafe >= _exp2_int(e + 1), e + 1, e)
+    normal = e >= -1022
+    # subnormal inputs: log2 < -1022, so the clipped/corrected e can sit
+    # at the boundary; classify by VALUE instead
+    normal = ysafe >= 2.2250738585072014e-308
+    m = ysafe / _exp2_int(jnp.where(normal, e, 0))    # [1, 2) for normals
+    field_n = (m * 2.0 ** 52).astype(jnp.int64) - jnp.int64(1 << 52)
+    ssub = jnp.where(normal, 0.0, ysafe)
+    field_s = ((ssub * 2.0 ** 537) * 2.0 ** 537).astype(jnp.int64)
+    biased = jnp.where(normal, e + 1023, 0).astype(jnp.int64)
+    bits = biased * jnp.int64(1 << 52) \
+        + jnp.where(normal, field_n, field_s)
+    bits = jnp.where(jnp.isinf(y), jnp.int64(0x7FF0000000000000), bits)
+    bits = jnp.where(y == 0.0, jnp.int64(0), bits)
+    bits = jnp.where(jnp.isnan(d), jnp.int64(0x7FF8000000000000), bits)
+    # d < 0, NOT jnp.signbit: signbit's implementation bitcasts f64->s64
+    # (the very op this function exists to avoid); -0.0 is excluded by the
+    # y != 0 term regardless
+    neg = (d < 0) & (y != 0) & ~jnp.isnan(d)
+    # top bit set == adding int64 min in two's complement
+    return jnp.where(neg, bits + jnp.int64(-(2 ** 63)), bits)
+
+
+def _normalize_f64_words(d: jax.Array):
+    """float64 -> (low, high) uint32 bit-pattern words (-0.0/NaN
+    canonicalized), built from :func:`f64_bit_pattern` — no bitcast."""
+    bits = f64_bit_pattern(d)
+    lo = (bits & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (bits >> 32).astype(jnp.uint32)
+    return lo, hi
 
 
 def hash_value(data: jax.Array, valid: Optional[jax.Array],
@@ -96,7 +167,8 @@ def hash_value(data: jax.Array, valid: Optional[jax.Array],
     elif dt == jnp.float32:
         out = _hash_int32(_normalize_float_bits(data), running)
     elif dt == jnp.float64:
-        out = _hash_int64(_normalize_float_bits(data), running)
+        lo, hi = _normalize_f64_words(data)
+        out = _hash_words(lo, hi, running)
     elif dt == jnp.uint32:
         out = _hash_int32(data.astype(jnp.int32), running)
     else:
@@ -182,8 +254,8 @@ def xxhash64_value(data: jax.Array, valid: Optional[jax.Array],
     elif dt == jnp.int64:
         out = _xxhash64_long(data.astype(jnp.uint64), running)
     elif dt == jnp.float64:
-        out = _xxhash64_long(
-            _normalize_float_bits(data).astype(jnp.uint64), running)
+        u = f64_bit_pattern(data).astype(jnp.uint64)  # modular: same bits
+        out = _xxhash64_long(u, running)
     else:
         raise TypeError(f"no device xxhash64 for dtype {dt}")
     if valid is not None:

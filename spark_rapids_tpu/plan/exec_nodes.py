@@ -407,6 +407,167 @@ class RangeExec(TpuExec):
             pos += n
 
 
+class GenerateExec(TpuExec):
+    """Device explode: arrow list offsets become a parent-row gather.
+
+    Reference: GpuGenerateExec (GpuGenerateExec.scala) — cudf's explode is
+    a gather by parent row index plus the flattened child column.  Same
+    shape here: the ARRAY column rides as a host arrow column whose offsets
+    yield (a) the flattened element values, uploaded once, and (b) the
+    parent row index per output row; every other device column is gathered
+    by parent index in ONE jitted program per schema.  ``outer`` keeps
+    empty/null arrays as a single null-element row (OUTER EXPLODE).
+    """
+
+    def __init__(self, child: TpuExec, column: str, out_name: str,
+                 outer: bool, out_schema: Schema):
+        super().__init__([child])
+        self.column = column
+        self.out_name = out_name
+        self.outer = outer
+        self._schema = out_schema
+        self._ordinal = child.output_schema.index_of(column)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def node_desc(self):
+        kind = "explode_outer" if self.outer else "explode"
+        return f"TpuGenerate {kind}({self.column}) as {self.out_name}"
+
+    def _gather_fn(self, in_schema: Schema):
+        from .physical import _cached_program
+        ordinal = self._ordinal
+        dts = ",".join(f"{i}:{f.dtype}" for i, f in enumerate(in_schema)
+                       if i != ordinal)
+        fp = f"generate-gather|{ordinal}|{dts}"
+
+        def build():
+            @jax.jit
+            def f(arrays, parent):
+                out = []
+                for a in arrays:
+                    if a is None:
+                        out.append(None)
+                        continue
+                    d, v = a
+                    out.append((d[parent],
+                                None if v is None else v[parent]))
+                return tuple(out)
+            return f
+
+        return _cached_program(fp, build)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        m = ctx.metric_set(self.op_id)
+        in_schema = self.children[0].output_schema
+        elem_dt = in_schema.fields[self._ordinal].dtype.element
+        gather = self._gather_fn(in_schema)
+        from ..batch import bucket_capacity
+        for batch in self.children[0].execute(ctx):
+            with m.time("opTime"):
+                b = batch_utils.compact(batch)
+                n = b.num_rows
+                arr = b.columns[self._ordinal].array.slice(0, n)
+                arr = arr.combine_chunks() if isinstance(
+                    arr, pa.ChunkedArray) else arr
+                lens = np.asarray(pc.list_value_length(arr)
+                                  .fill_null(0)).astype(np.int64)
+                if self.outer:
+                    out_lens = np.maximum(lens, 1)
+                    # injected rows (empty/null array) carry a null element
+                    injected = lens == 0
+                else:
+                    out_lens = lens
+                    injected = None
+                total = int(out_lens.sum())
+                if total == 0:
+                    continue
+                parent_all = np.repeat(np.arange(n, dtype=np.int64),
+                                       out_lens)
+                flat = arr.flatten()  # drops null/empty lists entirely
+                elem_valid = np.ones(total, dtype=bool)
+                if injected is not None and injected.any():
+                    first_out = np.zeros(n, dtype=np.int64)
+                    first_out[1:] = np.cumsum(out_lens)[:-1]
+                    elem_valid[first_out[injected]] = False
+                vals = np.zeros(total, dtype=elem_dt.numpy_dtype)
+                slots = np.flatnonzero(elem_valid)
+                if flat.null_count:
+                    fv = ~np.asarray(flat.is_null())
+                    elem_valid[slots] = fv
+                    flat = flat.fill_null(_zero_scalar(flat.type))
+                if elem_dt.is_floating:
+                    npf = flat.to_numpy(zero_copy_only=False)
+                else:  # int/bool/date/timestamp: physical int via arrow cast
+                    width = pa.int64() \
+                        if np.dtype(elem_dt.numpy_dtype).itemsize == 8 \
+                        else pa.int32()
+                    npf = flat.cast(width).to_numpy(zero_copy_only=False)
+                vals[slots] = np.asarray(npf).astype(elem_dt.numpy_dtype)
+
+                # split oversized output into batch-size chunks: total is
+                # unbounded (sum of list lengths) and must not become one
+                # giant device allocation (GpuGenerateExec splits too)
+                batch_rows = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
+                min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+                arrays = tuple(
+                    None if isinstance(c, HostStringColumn)
+                    else (c.data, c.valid) for c in b.columns)
+                outs = []
+                for lo in range(0, total, batch_rows):
+                    hi = min(lo + batch_rows, total)
+                    m_rows = hi - lo
+                    cap = bucket_capacity(m_rows, min_cap)
+                    pad = cap - m_rows
+                    parent = parent_all[lo:hi]
+                    parent_pad = np.concatenate(
+                        [parent, np.zeros(pad, np.int64)]) if pad \
+                        else parent
+                    gathered = gather(arrays, jnp.asarray(parent_pad))
+                    cols: List = []
+                    for i, f in enumerate(self._schema):
+                        if i == self._ordinal:
+                            data = np.zeros(cap,
+                                            dtype=elem_dt.numpy_dtype)
+                            data[:m_rows] = vals[lo:hi]
+                            validp = np.zeros(cap, dtype=bool)
+                            validp[:m_rows] = elem_valid[lo:hi]
+                            cols.append(DeviceColumn(
+                                elem_dt,
+                                jax.device_put(data, ctx.device),
+                                jax.device_put(validp, ctx.device)))
+                        elif gathered[i] is None:
+                            taken = b.columns[i].array.slice(0, n).take(
+                                pa.array(parent_pad))
+                            cols.append(HostStringColumn(taken,
+                                                         capacity=cap))
+                        else:
+                            d, v = gathered[i]
+                            cols.append(DeviceColumn(
+                                in_schema.fields[i].dtype, d, v))
+                    outs.append(ColumnBatch(self._schema, cols, m_rows))
+            for out in outs:
+                m.add("numOutputRows", out.num_rows)
+                m.add("numOutputBatches", 1)
+                yield out
+
+
+def _zero_scalar(t):
+    import pyarrow as pa
+    if pa.types.is_boolean(t):
+        return pa.scalar(False, type=t)
+    if pa.types.is_date(t) or pa.types.is_timestamp(t):
+        import datetime
+        v = datetime.date(1970, 1, 1) if pa.types.is_date(t) \
+            else datetime.datetime(1970, 1, 1)
+        return pa.scalar(v, type=t)
+    return pa.scalar(0).cast(t)
+
+
 class ExpandExec(TpuExec):
     """Emit one projected batch per projection per input batch
     (grouping sets — GpuExpandExec.scala)."""

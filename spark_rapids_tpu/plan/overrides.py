@@ -194,9 +194,17 @@ class NodeMeta:
                     self.will_not_work(f"sort key: {r}")
             return
         if isinstance(p, L.Generate):
-            self.will_not_work(
-                "explode of array columns runs on CPU (no device array "
-                "representation yet)")
+            f = next((f for f in p.children[0].schema()
+                      if f.name == p.column), None)
+            if f is None or f.dtype.element is None:
+                self.will_not_work(
+                    f"explode column {p.column!r} is not an ARRAY")
+            else:
+                elem = f.dtype.element
+                if elem.is_string or elem.is_nested or elem.is_decimal:
+                    self.will_not_work(
+                        f"explode of array<{elem}> runs on CPU (elements "
+                        f"have no device representation)")
             return
         if isinstance(p, (L.Limit, L.Union, L.LogicalRange, L.Distinct,
                           L.Sample, L.Cache)):
@@ -413,6 +421,11 @@ def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
         bound = [(n, strip_alias(bind(e, schema)))
                  for n, e in p.window_exprs]
         return WindowExec(child_phys, bound)
+
+    if isinstance(p, L.Generate):
+        from .exec_nodes import GenerateExec
+        return GenerateExec(_convert(meta.children[0], conf), p.column,
+                            p.out_name, p.outer, p.schema())
 
     if isinstance(p, L.Expand):
         from .exec_nodes import ExpandExec

@@ -39,10 +39,19 @@ class TestBitwise:
         rows = df.select(F.col("i").bitwiseAND(F.col("l")).alias("x"))
         assert rows.collect() == [(1,)]
 
-    def test_bitwise_on_double_falls_back_rejected(self, session):
+    def test_bitwise_on_double_is_analysis_error(self, session):
+        """Spark rejects bitwise over non-integral operands at analysis;
+        silently truncating 1.5 would corrupt results."""
         df = session.create_dataframe({"x": [1.5]})
-        plan = df.select(F.col("x").bitwiseAND(F.lit(1)).alias("b"))
-        assert "not supported" in plan.explain_string()
+        q = df.select(F.col("x").bitwiseAND(F.lit(1)).alias("b"))
+        with pytest.raises(TypeError, match="integral"):
+            q.collect()
+
+    def test_shift_on_double_is_analysis_error(self, session):
+        df = session.create_dataframe({"x": [2.9]})
+        q = df.select(F.shiftleft(F.col("x"), F.lit(1)).alias("s"))
+        with pytest.raises(TypeError, match="integral"):
+            q.collect()
 
 
 class TestShifts:

@@ -57,7 +57,81 @@ def test_explode_from_arrow_lists(session):
     assert (2, None) in outer and (3, None) in outer and len(outer) == 4
 
 
-def test_explode_plan_reason(session):
+def test_explode_placement(session):
+    # numeric elements: device explode (offsets -> parent gather)
     t = pa.table({"arr": pa.array([[1]], type=pa.list_(pa.int64()))})
     plan = session.create_dataframe(t).explode("arr").explain_string()
-    assert "CPU" in plan and "array" in plan
+    assert "! Generate" not in plan
+    # string elements have no device representation -> CPU with a reason
+    ts = pa.table({"arr": pa.array([["a"]], type=pa.list_(pa.string()))})
+    plan_s = session.create_dataframe(ts).explode("arr").explain_string()
+    assert "runs on CPU" in plan_s
+
+
+# ---------------------------------------------------------------------------------
+# Device GenerateExec (GpuGenerateExec analog): offsets -> parent gather.
+# ---------------------------------------------------------------------------------
+
+def test_device_explode_gathers_siblings(session):
+    import numpy as np
+    t = pa.table({
+        "k": pa.array([10, 20, 30], pa.int64()),
+        "s": pa.array(["a", "b", "c"]),
+        "arr": pa.array([[1, 2], [], [3, 4, 5]], type=pa.list_(pa.int64()))})
+    df = session.create_dataframe(t).explode("arr", out_name="v")
+    assert "! Generate" not in df.explain_string()
+    rows = sorted(df.collect())
+    assert rows == [(10, "a", 1), (10, "a", 2),
+                    (30, "c", 3), (30, "c", 4), (30, "c", 5)]
+
+
+def test_device_explode_outer_and_element_nulls(session):
+    t = pa.table({
+        "k": pa.array([1, 2, 3, 4], pa.int64()),
+        "arr": pa.array([[7, None], None, [], [9]],
+                        type=pa.list_(pa.int64()))})
+    df = session.create_dataframe(t).explode("arr", out_name="v",
+                                             outer=True)
+    key = lambda r: (r[0], r[1] is None, r[1] or 0)  # noqa: E731
+    rows = sorted(df.collect(), key=key)
+    assert rows == [(1, 7), (1, None), (2, None), (3, None), (4, 9)]
+    # plain explode drops empty/null arrays but keeps null ELEMENTS
+    inner = sorted(session.create_dataframe(t)
+                   .explode("arr", out_name="v").collect(),
+                   key=lambda r: (r[0], r[1] is None, r[1] or 0))
+    assert inner == [(1, 7), (1, None), (4, 9)]
+
+
+def test_device_explode_double_elements_then_agg(session):
+    from spark_rapids_tpu.sql import functions as F
+    t = pa.table({
+        "k": pa.array([1, 1, 2], pa.int64()),
+        "arr": pa.array([[1.5, 2.5], [3.0], [10.0, 20.0]],
+                        type=pa.list_(pa.float64()))})
+    df = session.create_dataframe(t).explode("arr", out_name="v")
+    got = sorted(df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+                 .collect())
+    assert got == [(1, 7.0), (2, 30.0)]
+
+
+def test_device_explode_splits_large_output(session):
+    """Output rows (sum of list lengths) split to batchSizeRows-sized
+    device batches instead of one giant allocation."""
+    import spark_rapids_tpu as srt
+    import numpy as np
+    srt.Session.reset()
+    s = srt.Session.get_or_create(settings={
+        "spark.rapids.tpu.sql.batchSizeRows": 64})
+    try:
+        lists = [list(range(i * 10, i * 10 + 10)) for i in range(30)]
+        t = pa.table({"k": pa.array(range(30), pa.int64()),
+                      "arr": pa.array(lists, type=pa.list_(pa.int64()))})
+        df = s.create_dataframe(t).explode("arr", out_name="v")
+        rows = df.collect()
+        assert len(rows) == 300
+        got = sorted(v for _, v in rows)
+        assert got == list(range(300))
+        ks = sorted(k for k, _ in rows)
+        assert ks == sorted(np.repeat(np.arange(30), 10).tolist())
+    finally:
+        srt.Session.reset()
