@@ -286,6 +286,19 @@ def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
     return out
 
 
+def zero_scalar(t):
+    """Typed zero for null-filling an arrow column of type ``t`` — the ONE
+    definition shared by scan upload and the device explode."""
+    import pyarrow as pa
+    if pa.types.is_boolean(t):
+        return pa.scalar(False, type=t)
+    if pa.types.is_date(t):
+        return pa.scalar(datetime.date(1970, 1, 1), type=t)
+    if pa.types.is_timestamp(t):
+        return pa.scalar(datetime.datetime(1970, 1, 1), type=t)
+    return pa.scalar(0).cast(t)
+
+
 def from_arrow(table, min_capacity: int = 1024, device=None) -> ColumnBatch:
     """Build a ColumnBatch from a pyarrow Table (one upload per column)."""
     import pyarrow as pa
@@ -317,15 +330,7 @@ def from_arrow(table, min_capacity: int = 1024, device=None) -> ColumnBatch:
             # with a typed zero so integer casts are well-defined (float NaN
             # payloads at null slots are harmless and stay put).
             if col.null_count > 0 and not dt.is_floating:
-                if pa.types.is_date(col.type):
-                    zero = pa.scalar(datetime.date(1970, 1, 1),
-                                     type=col.type)
-                elif pa.types.is_timestamp(col.type):
-                    zero = pa.scalar(datetime.datetime(1970, 1, 1),
-                                     type=col.type)
-                else:
-                    zero = pa.scalar(0).cast(col.type)
-                col_f = col.fill_null(zero)
+                col_f = col.fill_null(zero_scalar(col.type))
             else:
                 col_f = col
             np_col = col_f.to_numpy(zero_copy_only=False)

@@ -154,11 +154,17 @@ class CpuOpExec(TpuExec):
         if isinstance(p, L.Generate):
             t = self._child_table(ctx)
             pdf = t.to_pandas()
-            out = pdf.explode(p.column, ignore_index=True)
+            col = pdf[p.column]
+            # classify SOURCE rows before exploding: plain EXPLODE drops
+            # rows from empty/null ARRAYS but must keep null ELEMENTS
+            # (matching Spark and the device GenerateExec)
+            def _arr_len(a):
+                return 0 if a is None else len(a)
+            no_rows = col.isna() | (col.map(_arr_len) == 0)
+            out = pdf.explode(p.column)
             if not p.outer:
-                # empty/null arrays explode to a NaN row; plain EXPLODE
-                # drops them (OUTER keeps them as null)
-                out = out[out[p.column].notna()].reset_index(drop=True)
+                out = out[~out.index.isin(pdf.index[no_rows])]
+            out = out.reset_index(drop=True)
             out = out.rename(columns={p.column: p.out_name})
             import pyarrow as pa
             from ..batch import logical_to_arrow
@@ -404,16 +410,34 @@ class CpuOpExec(TpuExec):
         n = len(d)
         null_mask = (~v) if v is not None else np.zeros(n, dtype=bool)
         key = np.empty(n, dtype=np.int64)
+        # DENSE ranks: equal values MUST share a key — per-position ranks
+        # would reverse tie order under descending negation, breaking the
+        # stable minor->major composition of multi-key sorts
         if d.dtype == object:  # strings
             null_mask = null_mask | np.array([x is None for x in d], dtype=bool)
             non_null = [i for i in range(n) if not null_mask[i]]
-            non_null.sort(key=lambda i: d[i], reverse=not ascending)
-            for rank, i in enumerate(non_null):
+            non_null.sort(key=lambda i: d[i])
+            rank = -1
+            prev = object()
+            for i in non_null:
+                if d[i] != prev:
+                    rank += 1
+                    prev = d[i]
                 key[i] = rank
+            if not ascending:
+                key[~null_mask] = -key[~null_mask]
         else:
             order = np.argsort(d, kind="stable")  # NaN sorts last = greatest
+            sv = d[order]
+            diff = np.ones(n, dtype=bool)
+            if n > 1:
+                neq = sv[1:] != sv[:-1]
+                if sv.dtype.kind == "f":  # equal NaNs are one rank group
+                    both_nan = np.isnan(sv[1:]) & np.isnan(sv[:-1])
+                    neq = neq & ~both_nan
+                diff[1:] = neq
             rank = np.empty(n, dtype=np.int64)
-            rank[order] = np.arange(n)
+            rank[order] = np.cumsum(diff) - 1
             key = rank if ascending else -rank
         key[null_mask] = (np.iinfo(np.int64).min if nulls_first
                           else np.iinfo(np.int64).max)

@@ -165,6 +165,9 @@ def gen_tables(seed: int = 7, n_lineitem: int = 3000, n_orders: int = 800,
                                     n_customers).astype(np.int64),
         "c_mktsegment": rng.choice(np.array(SEGMENTS), n_customers),
         "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_customers), 2),
+        "c_phone": [f"{rng.integers(10, 35)}-{rng.integers(100, 999)}-"
+                    f"{rng.integers(100, 999)}-{rng.integers(1000, 9999)}"
+                    for _ in range(n_customers)],
     })
     supplier = pa.table({
         "s_suppkey": np.arange(1, n_suppliers + 1, dtype=np.int64),
@@ -220,6 +223,19 @@ def gen_tables(seed: int = 7, n_lineitem: int = 3000, n_orders: int = 800,
         "l_receiptdate": pa.array(receipt, type=pa.date32()),
         "l_shipmode": rng.choice(np.array(SHIPMODES), n_lineitem),
     })
+    # partsupp: 4 suppliers per part (TPC-H shape), unique (part, supp)
+    ps_part = np.repeat(np.arange(1, n_parts + 1, dtype=np.int64), 4)
+    ps_supp = np.concatenate([
+        1 + (np.arange(4, dtype=np.int64) * 17 + p) % n_suppliers
+        for p in range(n_parts)])
+    partsupp = pa.table({
+        "ps_partkey": ps_part,
+        "ps_suppkey": ps_supp,
+        "ps_availqty": rng.integers(1, 10000,
+                                    len(ps_part)).astype(np.int64),
+        "ps_supplycost": np.round(
+            rng.uniform(1.0, 1000.0, len(ps_part)), 2),
+    })
     return {"region": region, "nation": nation, "customer": customer,
             "supplier": supplier, "part": part, "orders": orders,
-            "lineitem": lineitem}
+            "lineitem": lineitem, "partsupp": partsupp}

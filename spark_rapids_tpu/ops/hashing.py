@@ -123,9 +123,8 @@ def f64_bit_pattern(d: jax.Array) -> jax.Array:
     e = jnp.clip(e, -1022, 1023)  # subnormals use the field path anyway
     e = jnp.where(ysafe < _exp2_int(e), e - 1, e)
     e = jnp.where(ysafe >= _exp2_int(e + 1), e + 1, e)
-    normal = e >= -1022
-    # subnormal inputs: log2 < -1022, so the clipped/corrected e can sit
-    # at the boundary; classify by VALUE instead
+    # classify normal/subnormal by VALUE (the clipped/corrected exponent
+    # can sit at the boundary for subnormal inputs)
     normal = ysafe >= 2.2250738585072014e-308
     m = ysafe / _exp2_int(jnp.where(normal, e, 0))    # [1, 2) for normals
     field_n = (m * 2.0 ** 52).astype(jnp.int64) - jnp.int64(1 << 52)

@@ -497,9 +497,10 @@ class GenerateExec(TpuExec):
                 vals = np.zeros(total, dtype=elem_dt.numpy_dtype)
                 slots = np.flatnonzero(elem_valid)
                 if flat.null_count:
+                    from ..batch import zero_scalar
                     fv = ~np.asarray(flat.is_null())
                     elem_valid[slots] = fv
-                    flat = flat.fill_null(_zero_scalar(flat.type))
+                    flat = flat.fill_null(zero_scalar(flat.type))
                 if elem_dt.is_floating:
                     npf = flat.to_numpy(zero_copy_only=False)
                 else:  # int/bool/date/timestamp: physical int via arrow cast
@@ -554,18 +555,6 @@ class GenerateExec(TpuExec):
                 m.add("numOutputRows", out.num_rows)
                 m.add("numOutputBatches", 1)
                 yield out
-
-
-def _zero_scalar(t):
-    import pyarrow as pa
-    if pa.types.is_boolean(t):
-        return pa.scalar(False, type=t)
-    if pa.types.is_date(t) or pa.types.is_timestamp(t):
-        import datetime
-        v = datetime.date(1970, 1, 1) if pa.types.is_date(t) \
-            else datetime.datetime(1970, 1, 1)
-        return pa.scalar(v, type=t)
-    return pa.scalar(0).cast(t)
 
 
 class ExpandExec(TpuExec):

@@ -135,3 +135,20 @@ def test_device_explode_splits_large_output(session):
         assert ks == sorted(np.repeat(np.arange(30), 10).tolist())
     finally:
         srt.Session.reset()
+
+
+def test_cpu_explode_keeps_null_string_elements(session):
+    """String-element explode runs on the CPU path; null ELEMENTS must
+    survive (only empty/null ARRAYS drop) — matching Spark and the device
+    path's semantics for numeric elements."""
+    t = pa.table({
+        "k": pa.array([1, 2, 3], pa.int64()),
+        "arr": pa.array([["a", None, "b"], [], None],
+                        type=pa.list_(pa.string()))})
+    df = session.create_dataframe(t)
+    key = lambda r: (r[0], r[1] is None, r[1] or "")  # noqa: E731
+    got = sorted(df.explode("arr", out_name="s").collect(), key=key)
+    assert got == [(1, "a"), (1, "b"), (1, None)]
+    outer = sorted(df.explode("arr", out_name="s", outer=True).collect(),
+                   key=key)
+    assert outer == [(1, "a"), (1, "b"), (1, None), (2, None), (3, None)]
