@@ -12,4 +12,5 @@ transport analog, with the host-shuffle frame file as the wire format.
 """
 
 from .dcn import (Coordinator, DcnShuffle, PeerFailedError,  # noqa: F401
-                  ProcessGroup, run_distributed_agg)
+                  ProcessGroup, run_distributed_agg,
+                  run_distributed_query)

@@ -42,7 +42,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 __all__ = ["Coordinator", "ProcessGroup", "DcnShuffle", "PeerFailedError",
-           "host_partition_ids", "run_distributed_agg"]
+           "host_partition_ids", "run_distributed_agg",
+           "run_distributed_query"]
 
 _LEN = struct.Struct("<II")  # json length, binary payload length
 _CHUNK = 1 << 20
@@ -466,7 +467,9 @@ class DcnShuffle:
         """Map side durable on every rank (the reduce phase's barrier)."""
         self.local.finish_writes()
         self.pg.check_peers()
-        self.pg.barrier()
+        # shuffle-scoped tag: a commit barrier must never pair with some
+        # other shuffle's barrier on a rank running ahead or behind
+        self.pg.barrier(tag=f"{self.id}-commit")
 
     def owner(self, p: int) -> int:
         return p % self.pg.world_size
@@ -488,6 +491,12 @@ class DcnShuffle:
                     yield from iter_frames(payload)
 
     def close(self) -> None:
+        """Retire the shuffle: all ranks must be DONE READING before any
+        rank unregisters and deletes its frame files — a fast rank tearing
+        down early would yield 'unknown shuffle' fetch failures on slower
+        peers.  SPMD discipline: every rank closes every shuffle, in the
+        same order (generator finallys run in deterministic plan order)."""
+        self.pg.barrier(tag=f"{self.id}-close")
         self.pg.unregister_shuffle(self.id)
         self.local.close()
 
@@ -534,6 +543,48 @@ def host_partition_ids(table, key_ordinals: List[int], schema,
     return native.pmod_partition(h, n_parts)
 
 
+def _bare_ref_ordinals(key_exprs) -> Optional[List[int]]:
+    """Ordinals when every stripped key is a plain column reference,
+    else None (expression keys need the CPU evaluator)."""
+    from ..exprs import BoundReference
+    from ..plan.planner import strip_alias
+    out = []
+    for e in key_exprs:
+        core = strip_alias(e)
+        if not isinstance(core, BoundReference):
+            return None
+        out.append(core.ordinal)
+    return out
+
+
+def host_partition_ids_exprs(table, key_exprs, schema,
+                             n_parts: int) -> np.ndarray:
+    """Murmur3 pmod partition ids for arbitrary bound key EXPRESSIONS
+    (shuffled-join keys carry common-type Casts), evaluated on the host
+    with the CPU expression evaluator, then folded with the same
+    Spark-exact kernels as :func:`host_partition_ids`."""
+    from .. import native
+    from ..cpu.eval import eval_cpu
+    from ..cpu.exec import arrow_to_values
+    from ..plan.planner import strip_alias
+    n = table.num_rows
+    vals = arrow_to_values(table, schema)
+    h = np.full(n, 42, dtype=np.int32)
+    for e in key_exprs:
+        core = strip_alias(e)
+        d, v = eval_cpu(core, vals, n)
+        if core.dtype.is_string:
+            enc = [(s.encode() if isinstance(s, str) else b"") for s in d]
+            offsets = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum([len(b) for b in enc], out=offsets[1:])
+            new = native.murmur3_utf8(
+                np.frombuffer(b"".join(enc), np.uint8), offsets, h)
+        else:
+            new = native.murmur3_fold(np.asarray(d), core.dtype, h)
+        h = np.where(v, new, h) if v is not None else new
+    return native.pmod_partition(h, n_parts)
+
+
 def _arrow_physical(col, dt, n: int) -> np.ndarray:
     """Arrow column -> the physical int array Spark's hash folds over.
 
@@ -576,10 +627,10 @@ class DcnExchangeExec:
 
     outputs_partitions = True
 
-    def __init__(self, child, key_ordinals: List[int], n_parts: int,
+    def __init__(self, child, key_exprs, n_parts: int,
                  pg: ProcessGroup, decode_batch=None):
         self.children = [child]
-        self.key_ordinals = key_ordinals
+        self.key_exprs = key_exprs  # bound against child.output_schema
         self.n_parts = n_parts
         self.pg = pg
         # hook decoding dictionary-coded string keys back to utf8 before
@@ -593,7 +644,7 @@ class DcnExchangeExec:
 
     def node_desc(self):
         return (f"TpuDcnShuffleExchange hashpartitioning"
-                f"({len(self.key_ordinals)} keys, {self.n_parts}) "
+                f"({len(self.key_exprs)} keys, {self.n_parts}) "
                 f"world={self.pg.world_size}")
 
     def execute(self, ctx) -> Iterator:
@@ -615,8 +666,15 @@ class DcnExchangeExec:
                 t = to_arrow(batch)
                 if t.num_rows == 0:
                     continue
-                pids = host_partition_ids(t, self.key_ordinals, schema,
-                                          self.n_parts)
+                ords = _bare_ref_ordinals(self.key_exprs)
+                if ords is not None:
+                    # dominant case (aggregate exchanges: bare column
+                    # keys) — vectorized arrow-buffer hashing
+                    pids = host_partition_ids(t, ords, schema,
+                                              self.n_parts)
+                else:  # join keys may carry common-type Casts
+                    pids = host_partition_ids_exprs(
+                        t, self.key_exprs, schema, self.n_parts)
                 for p in np.unique(pids):
                     shuffle.write_partition(int(p), t.filter(pids == p))
             shuffle.commit()
@@ -661,69 +719,92 @@ def _make_key_decoder(partial):
     return decode
 
 
-def _key_ordinals(key_exprs) -> List[int]:
-    from ..exprs import BoundReference
-    from ..plan.planner import strip_alias
-    out = []
-    for e in key_exprs:
-        core = strip_alias(e)
-        if not isinstance(core, BoundReference):
-            raise ValueError(
-                f"DCN exchange requires bound-column keys, got {e!r}")
-        out.append(core.ordinal)
-    return out
+def _rewrite_exchanges(node, pg: ProcessGroup, n_parts: int):
+    """Replace EVERY in-process ShuffleExchangeExec in the subtree with a
+    DcnExchangeExec — a distributed plan must shuffle globally at every
+    exchange, not just the topmost one (a shard-local join below a
+    distributed aggregate would silently drop cross-rank matches)."""
+    from ..plan.exchange_exec import ShuffleExchangeExec
+    from ..plan.physical import AggregateExec
+    for i, child in enumerate(list(node.children)):
+        _rewrite_exchanges(child, pg, n_parts)
+        if isinstance(child, ShuffleExchangeExec):
+            below = child.children[0]
+            decoder = _make_key_decoder(below) \
+                if isinstance(below, AggregateExec) \
+                and below.mode == "partial" else None
+            node.children[i] = DcnExchangeExec(
+                below, child.key_exprs, n_parts, pg,
+                decode_batch=decoder)
 
 
-def run_distributed_agg(df, pg: ProcessGroup,
-                        n_parts: Optional[int] = None) -> List[tuple]:
-    """Run a grouped-aggregate DataFrame query across the process group.
+def run_distributed_query(df, pg: ProcessGroup,
+                          n_parts: Optional[int] = None) -> List[tuple]:
+    """Run a DataFrame query across the process group.
 
     SPMD contract: every rank calls this with the SAME query over ITS OWN
-    input shard (e.g. its slice of the file listing).  Partial aggregation
-    runs locally on each rank's chip, partial output shuffles over DCN by
-    Spark-exact key hash, each rank finalizes the partitions it owns, and
-    the final rows are all-gathered so every rank returns the full result.
-    Plan operators ABOVE the aggregate (sort/limit/project) re-run on the
-    gathered result, which is complete and identical on every rank.
+    input shard (e.g. its slice of the file listing).  The plan's topmost
+    exchange-consuming operator (final aggregate or shuffled join) and
+    everything below it run distributed — every in-process exchange becomes
+    a DCN shuffle by Spark-exact key hash, so each rank processes the hash
+    range it owns end to end.  The owned-range outputs are all-gathered and
+    operators ABOVE the distributed subtree (sort/limit/project) replay on
+    the gathered result, which is complete and identical on every rank.
     """
     import pyarrow as pa
 
     from ..batch import to_arrow
     from ..plan.exchange_exec import ShuffleExchangeExec
-    from ..plan.join_exec import _empty_batch
+    from ..plan.join_exec import SortMergeJoinExec, _empty_batch
     from ..plan.overrides import apply_overrides
     from ..plan.physical import AggregateExec, CollectExec, ExecContext, \
         ScanExec
 
     conf = df.session._tpu_conf()
     phys = apply_overrides(df._plan, conf)
-    chain = []  # operators above the final aggregate, top-down
+    chain = []  # operators above the distributed subtree, top-down
     node = phys
-    final = None
+    top = None
     while node is not None:
         if isinstance(node, AggregateExec) and node.mode == "final" \
                 and isinstance(node.children[0], ShuffleExchangeExec):
-            final = node
+            top = node
+            break
+        if isinstance(node, SortMergeJoinExec) and all(
+                isinstance(c, ShuffleExchangeExec) for c in node.children):
+            top = node
             break
         chain.append(node)
         node = node.children[0] if node.children else None
-    if final is None:
+    if top is None:
         raise ValueError(
-            "plan has no partial->exchange->final aggregate tree "
+            "plan has no exchange-consuming aggregate or shuffled join "
             "(is spark.rapids.tpu.sql.exchange.enabled on?)")
-    exch = final.children[0]
-    partial = exch.children[0]
     if n_parts is None:
-        n_parts = max(pg.world_size, exch.n_parts)
-    final.children[0] = DcnExchangeExec(
-        exch.children[0], _key_ordinals(exch.key_exprs), n_parts, pg,
-        decode_batch=_make_key_decoder(partial))
+        n_parts = max(pg.world_size,
+                      conf["spark.rapids.tpu.sql.shuffle.partitions"])
+    _rewrite_exchanges(top, pg, n_parts)
+
+    # every join inside the distributed subtree must sit on DCN exchanges:
+    # a non-shuffled join (cross join, keyless join, exchange disabled)
+    # would silently join only rank-local data and return complete-looking
+    # wrong answers
+    def _check(node):
+        if isinstance(node, SortMergeJoinExec) and not all(
+                isinstance(c, DcnExchangeExec) for c in node.children):
+            raise ValueError(
+                f"distributed subtree contains a non-shuffled join "
+                f"({node.node_desc()}): cross/keyless joins cannot run "
+                f"over DCN shards (broadcast is not implemented)")
+        for c in node.children:
+            _check(c)
+    _check(top)
 
     ctx = ExecContext(conf, device=df.session.device)
-    tables = [to_arrow(b) for b in final.execute(ctx)]
+    tables = [to_arrow(b) for b in top.execute(ctx)]
     tables = [t for t in tables if t.num_rows > 0]
     local = pa.concat_tables(tables) if tables \
-        else to_arrow(_empty_batch(final.output_schema))
+        else to_arrow(_empty_batch(top.output_schema))
 
     sink = pa.BufferOutputStream()
     with pa.ipc.new_stream(sink, local.schema) as w:
@@ -736,8 +817,8 @@ def run_distributed_agg(df, pg: ProcessGroup,
     full = pa.concat_tables(parts)
 
     if chain:
-        # replay the post-agg plan (sort/limit/...) over the gathered rows
-        chain[-1].children[0] = ScanExec(final.output_schema,
+        # replay the post-subtree plan (sort/limit/...) on gathered rows
+        chain[-1].children[0] = ScanExec(top.output_schema,
                                          lambda: iter([full]), desc="dcn")
         result = CollectExec(chain[0]).collect_arrow(ctx)
     else:
@@ -747,3 +828,7 @@ def run_distributed_agg(df, pg: ProcessGroup,
     cols = [result.column(i).to_pylist()
             for i in range(result.num_columns)]
     return [tuple(c[i] for c in cols) for i in range(result.num_rows)]
+
+
+# the original grouped-aggregate entry point is the same runner
+run_distributed_agg = run_distributed_query

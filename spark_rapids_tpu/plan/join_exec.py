@@ -149,10 +149,19 @@ class SortMergeJoinExec(TpuExec):
         if lchild.outputs_partitions and rchild.outputs_partitions:
             # shuffled join: equal keys land in the same partition on both
             # sides, so partition pairs join independently (bounded memory)
-            for lb, rb in zip(lchild.execute(ctx), rchild.execute(ctx)):
-                if lb.num_rows == 0 and rb.num_rows == 0:
-                    continue
-                yield self._join_pair(ctx, m, lb, rb)
+            lgen, rgen = lchild.execute(ctx), rchild.execute(ctx)
+            try:
+                for lb, rb in zip(lgen, rgen):
+                    if lb.num_rows == 0 and rb.num_rows == 0:
+                        continue
+                    yield self._join_pair(ctx, m, lb, rb)
+            finally:
+                # close BOTH sides deterministically: zip leaves the right
+                # generator suspended, and a DCN exchange's cleanup holds a
+                # collective barrier that must not wait on garbage
+                # collection to run
+                lgen.close()
+                rgen.close()
             return
         lh = self._materialize(ctx, 0)
         rh = self._materialize(ctx, 1)

@@ -51,6 +51,15 @@ def main() -> None:
                  .agg(F.sum(F.col("v")).alias("sv"))
                  .sort(F.col("sv").desc())
                  .limit(3))
+        elif args.query == "join":
+            # distributed shuffled join + aggregate: both sides sharded
+            dim = sess.read_parquet(
+                os.path.join(args.data, f"dim-{args.rank}.parquet"))
+            q = (df.join(dim, on=[("k", "dk")])
+                 .group_by("dname")
+                 .agg(F.sum(F.col("v")).alias("sv"),
+                      F.count_star().alias("c"))
+                 .sort("dname"))
         else:
             raise SystemExit(f"unknown query {args.query!r}")
         rows = run_distributed_agg(q, pg)

@@ -149,8 +149,13 @@ class TestDcnShuffle:
                     assert got.num_rows == 2 * 3
                     assert sorted(set(got.column("src").to_pylist())) == [0, 1]
                     assert set(got.column("part").to_pylist()) == {p}
-            for sh in shuffles:
-                sh.close()
+            # close is collective (barriers so no rank tears down while a
+            # peer still reads) — call it from all ranks concurrently
+            ts = [threading.Thread(target=sh.close) for sh in shuffles]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
         finally:
             _close_all(pgs)
 
@@ -288,6 +293,36 @@ class TestDistributedAggEndToEnd:
                  for k, s, sv, c, aw in rows),
                 key=lambda r: (r[0], r[1] is None, str(r[1])))
         assert norm(results[0]) == norm(expect)
+
+    def test_distributed_shuffled_join_across_processes(self, tmp_path,
+                                                        session):
+        """Both join sides sharded across ranks: cross-rank key matches
+        require every exchange (join sides AND aggregate) to shuffle over
+        DCN — a shard-local join would drop them."""
+        world = 2
+        whole = _gen_shards(tmp_path, world, n=1200, seed=23)
+        # dim table sharded so that matching keys live on DIFFERENT ranks
+        # than the fact rows (k % 2 vs round-robin): forces cross-rank flow
+        dims = []
+        for r in range(world):
+            ks = [k for k in range(37) if k % world == r]
+            t = pa.table({"dk": pa.array(ks, pa.int64()),
+                          "dname": [f"name-{k:02d}" for k in ks]})
+            pq.write_table(t, str(tmp_path / f"dim-{r}.parquet"))
+            dims.append(t)
+        results = _run_workers(tmp_path, world, "join")
+        assert results[0] == results[1]
+        sess = srt.Session.get_or_create()
+        df = sess.create_dataframe(whole)
+        dim = sess.create_dataframe(pa.concat_tables(dims))
+        expect = (df.join(dim, on=[("k", "dk")])
+                  .group_by("dname")
+                  .agg(F.sum(F.col("v")).alias("sv"),
+                       F.count_star().alias("c"))
+                  .sort("dname").collect())
+        got = [(n, round(float(sv), 6), c) for n, sv, c in results[0]]
+        want = [(n, round(float(sv), 6), c) for n, sv, c in expect]
+        assert got == want
 
     def test_post_agg_sort_limit_replays_on_gathered(self, tmp_path,
                                                      session):
