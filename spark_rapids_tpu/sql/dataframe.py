@@ -25,6 +25,63 @@ def _named(c: Union[str, Column]) -> tuple:
     return (c.name, c.expr)
 
 
+def _decompose_agg_exprs(child: L.LogicalPlan, group_exprs, agg_exprs
+                         ) -> L.LogicalPlan:
+    """Build the Aggregate node, decomposing COMPOUND aggregate expressions
+    (Spark's physical-aggregate resultExpressions split):
+    ``agg((sum(v) * 0.2).alias("lim"))`` becomes
+    ``Aggregate(__agg0=sum(v))`` + ``Project(lim=__agg0 * 0.2)``."""
+    import copy
+
+    from ..exprs import AggregateExpression
+    from ..plan.planner import strip_alias
+
+    agg_exprs = [(n, strip_alias(e)) for n, e in agg_exprs]
+    if all(isinstance(e, AggregateExpression) for _, e in agg_exprs):
+        return L.Aggregate(child, group_exprs, agg_exprs)
+
+    internal: List[tuple] = []
+    by_fp: dict = {}  # dedupe structurally identical aggregates
+
+    def rewrite(e):
+        e = strip_alias(e)
+        if isinstance(e, AggregateExpression):
+            fp = e.fingerprint()
+            name = by_fp.get(fp)
+            if name is None:
+                name = f"__agg{len(internal)}"
+                by_fp[fp] = name
+                internal.append((name, e))
+            return E.UnresolvedColumn(name)
+        if not e.children:
+            return e
+        node = copy.copy(e)
+        node.children = tuple(rewrite(c) for c in e.children)
+        return node
+
+    finals = [(name, rewrite(e)) for name, e in agg_exprs]
+    if not internal:
+        raise ValueError(
+            "agg() expressions must contain at least one aggregate "
+            "function (use select() for row-wise expressions)")
+    # every remaining column reference must resolve in the aggregate's
+    # output (a grouping column or an internal agg) — catching a stray
+    # row column HERE gives an analysis error, not a bind-time KeyError
+    group_names = {n for n, _ in group_exprs}
+    valid = group_names | {n for n, _ in internal}
+    for name, e in finals:
+        stray = {r for r in e.references() if r not in valid}
+        if stray:
+            raise ValueError(
+                f"agg() expression {name!r} references non-grouping "
+                f"column(s) {sorted(stray)}: every column must be inside "
+                f"an aggregate function or be a grouping column")
+    agg_node = L.Aggregate(child, group_exprs, internal)
+    # group columns pass through by their output names
+    proj = [(n, E.UnresolvedColumn(n)) for n, _ in group_exprs] + finals
+    return L.Project(agg_node, proj)
+
+
 def _rewrite_windows(plan: L.LogicalPlan, exprs: List[tuple]):
     """Pull WindowExpressions out of a projection into Window nodes
     (Spark's ExtractWindowExpressions analysis rule analog).
@@ -276,7 +333,7 @@ class GroupedData:
 
     def agg(self, *cols: Column) -> DataFrame:
         agg_exprs = [_named(c) for c in cols]
-        node = L.Aggregate(self._df._plan, self._group_exprs, agg_exprs)
+        node = _decompose_agg_exprs(self._df._plan, self._group_exprs, agg_exprs)
         return DataFrame(node, self._df.session)
 
     def count(self) -> DataFrame:

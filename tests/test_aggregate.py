@@ -210,3 +210,59 @@ class TestStatisticalAggregates:
         import pandas as pd
         pdf = pd.DataFrame({"x": [1.0, 4.0, 5.0], "y": [2.0, 8.0, 10.0]})
         assert abs(got - pdf["x"].corr(pdf["y"])) < 1e-12
+
+
+class TestCompoundAggExpressions:
+    """agg() with expressions OVER aggregate results (Spark's physical
+    aggregate + resultExpressions split): sum(v)*0.2, max-min, ratios."""
+
+    def test_scaled_and_ratio(self, session):
+        f = F()
+        df = session.create_dataframe({"k": [1, 1, 2], "v": [1.0, 3.0, 10.0]})
+        got = sorted(df.group_by("k").agg(
+            (f.avg(f.col("v")) * 0.2).alias("lim"),
+            f.sum(f.col("v")).alias("s"),
+            (f.sum(f.col("v")) / f.count_star()).alias("manual_avg"))
+            .collect())
+        assert got == [(1, pytest.approx(0.4), 4.0, 2.0),
+                       (2, pytest.approx(2.0), 10.0, 10.0)]
+
+    def test_ungrouped_compound(self, session):
+        f = F()
+        df = session.create_dataframe({"v": [7.0, 7.0]})
+        assert df.agg((f.sum(f.col("v")) / 7.0).alias("w")).collect() \
+            == [(2.0,)]
+
+    def test_spread(self, session):
+        f = F()
+        df = session.create_dataframe({"k": [1, 1, 2], "v": [1.0, 3.0, 10.0]})
+        got = df.group_by("k").agg(
+            (f.max(f.col("v")) - f.min(f.col("v"))).alias("spread")) \
+            .sort("k").collect()
+        assert got == [(1, 2.0), (2, 0.0)]
+
+    def test_no_aggregate_rejected(self, session):
+        f = F()
+        df = session.create_dataframe({"k": [1], "v": [1.0]})
+        with pytest.raises(ValueError, match="aggregate function"):
+            df.group_by("k").agg((f.col("v") * 2).alias("x"))
+
+    def test_duplicate_aggs_planned_once(self, session):
+        f = F()
+        from spark_rapids_tpu.plan.overrides import apply_overrides
+        df = session.create_dataframe({"k": [1, 1], "v": [1.0, 2.0]})
+        q = df.group_by("k").agg(
+            f.sum(f.col("v")).alias("s"),
+            (f.sum(f.col("v")) / f.count_star()).alias("m"))
+        agg = q._plan.children[0]
+        assert len(agg.agg_exprs) == 2  # sum deduped, count separate
+        got = q.collect()
+        assert got == [(1, 3.0, 1.5)]
+
+    def test_stray_row_column_is_analysis_error(self, session):
+        f = F()
+        df = session.create_dataframe({"k": [1], "v": [1.0]})
+        with pytest.raises(ValueError, match="non-grouping"):
+            df.group_by("k").agg(
+                f.sum(f.col("v")).alias("s"),
+                (f.col("v") + f.sum(f.col("v"))).alias("bad"))
