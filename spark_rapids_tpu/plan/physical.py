@@ -476,21 +476,47 @@ class AggregateExec(TpuExec):
         else:
             update = slf._update_contributions
 
-        def build():
-            @jax.jit
-            def batch_partials(arrays, sel, num_rows):
-                cap = arrays[0][0].shape[0]
-                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
-                if sel is not None:
-                    active = active & sel
-                ectx = EvalContext(arrays, cap, active=active)
-                contribs = update(ectx)
-                return groupby.ungrouped_reduce(
-                    [(cv, op) for cv, op in zip(contribs, ops)], active)
-            return batch_partials
+        # whole-stage scalar aggregation: fold the child filter/project
+        # stage INTO the per-batch reduction program — each dispatch is a
+        # full RPC round-trip on tunneled backends, and a scalar aggregate
+        # needs nothing from the stage but its (tiny) reduced outputs
+        fused_stage = None
+        if isinstance(child, StageExec) and not child.host_exprs:
+            fused_stage = child
+            child = fused_stage.children[0]
+            stage_fn = fused_stage._build_fn(child.output_schema)
 
-        batch_partials = _cached_program(
-            "agg-ungrouped|" + self._fingerprint(), build)
+            def build():
+                @jax.jit
+                def batch_partials(arrays, sel, num_rows):
+                    out_arrays, active = stage_fn(arrays, (), sel, num_rows)
+                    cap = next(a[0].shape[0] for a in arrays
+                               if a is not None)
+                    ectx = EvalContext(list(out_arrays), cap, active=active)
+                    contribs = update(ectx)
+                    return groupby.ungrouped_reduce(
+                        [(cv, op) for cv, op in zip(contribs, ops)], active)
+                return batch_partials
+
+            fp = ("agg-ungrouped-fused|" + fused_stage.fingerprint()
+                  + "|" + self._fingerprint())
+        else:
+            def build():
+                @jax.jit
+                def batch_partials(arrays, sel, num_rows):
+                    cap = arrays[0][0].shape[0]
+                    active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                    if sel is not None:
+                        active = active & sel
+                    ectx = EvalContext(arrays, cap, active=active)
+                    contribs = update(ectx)
+                    return groupby.ungrouped_reduce(
+                        [(cv, op) for cv, op in zip(contribs, ops)], active)
+                return batch_partials
+
+            fp = "agg-ungrouped|" + self._fingerprint()
+
+        batch_partials = _cached_program(fp, build)
 
         from ..memory.retry import with_retry
 
