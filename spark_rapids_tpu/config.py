@@ -172,6 +172,31 @@ SHUFFLE_MODE = register(
     "within a mesh for whole-stage-resident multi-chip execution).",
     check=_one_of("HOST", "ICI", "CACHE_ONLY"))
 
+ICI_DEVICES = register(
+    "spark.rapids.tpu.shuffle.ici.devices", 0,
+    "Number of mesh devices for ICI shuffle (0 = all visible devices). The "
+    "session builds a 1-D jax.sharding.Mesh over them; use "
+    "Session.set_mesh() for custom topologies.")
+
+ICI_BUCKET_ROWS = register(
+    "spark.rapids.tpu.shuffle.ici.bucketRows", 0,
+    "Per-destination send-bucket rows for an ICI all_to_all exchange "
+    "(0 = auto: the sender's full shard capacity, which can never "
+    "overflow but costs n_devices x shard HBM on the receive side). Set "
+    "explicitly at scale; overflow is detected and raised, never dropped.")
+
+ICI_JOIN_OUT_ROWS = register(
+    "spark.rapids.tpu.shuffle.ici.joinOutputRows", 0,
+    "Static per-device output capacity of an ICI shuffled join expansion "
+    "(0 = auto: probe+build shard capacities). Overflow is detected and "
+    "raised, never dropped.")
+
+ICI_FALLBACK = register(
+    "spark.rapids.tpu.shuffle.ici.fallback", False,
+    "When true, exchanges that cannot be lowered onto the mesh run on the "
+    "single-process CACHE_ONLY path (with a warning) instead of failing "
+    "the query.", conv=_to_bool)
+
 SHUFFLE_PARTITIONS = register(
     "spark.rapids.tpu.sql.shuffle.partitions", 8,
     "Default number of shuffle partitions for exchanges. On one chip a "

@@ -111,6 +111,21 @@ class ShuffleExchangeExec(TpuExec):
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         mode = ctx.conf["spark.rapids.tpu.shuffle.mode"]
+        if mode == "ICI":
+            # ICI exchanges execute inside a shard_map fragment
+            # (parallel/spmd.py), never through this iterator path.
+            # Reaching here means the fragment extraction could not lower
+            # the surrounding plan — degrade only when explicitly allowed.
+            if not ctx.conf["spark.rapids.tpu.shuffle.ici.fallback"]:
+                raise RuntimeError(
+                    "shuffle.mode=ICI: this exchange was not lowered onto "
+                    "the mesh (unsupported surrounding plan); set "
+                    "spark.rapids.tpu.shuffle.ici.fallback=true to run it "
+                    "single-process instead")
+            import logging
+            logging.getLogger("spark_rapids_tpu.spmd").warning(
+                "ICI exchange falling back to single-process CACHE_ONLY "
+                "(shuffle.ici.fallback=true)")
         if mode == "HOST":
             yield from self._execute_host(ctx)
             return

@@ -504,7 +504,8 @@ class AggregateExec(TpuExec):
             def build():
                 @jax.jit
                 def batch_partials(arrays, sel, num_rows):
-                    cap = arrays[0][0].shape[0]
+                    cap = next(a[0].shape[0] for a in arrays
+                               if a is not None)
                     active = jnp.arange(cap, dtype=jnp.int32) < num_rows
                     if sel is not None:
                         active = active & sel
@@ -666,7 +667,8 @@ class AggregateExec(TpuExec):
         def build():
             @jax.jit
             def batch_group(arrays, sel, num_rows):
-                cap = arrays[0][0].shape[0]
+                cap = next(a[0].shape[0] for a in arrays
+                           if a is not None)
                 active = jnp.arange(cap, dtype=jnp.int32) < num_rows
                 if sel is not None:
                     active = active & sel
@@ -712,7 +714,8 @@ class AggregateExec(TpuExec):
             def build_grid():
                 @jax.jit
                 def f(arrays, sel, num_rows):
-                    cap = arrays[0][0].shape[0]
+                    cap = next(a[0].shape[0] for a in arrays
+                               if a is not None)
                     active = jnp.arange(cap, dtype=jnp.int32) < num_rows
                     if sel is not None:
                         active = active & sel
@@ -935,7 +938,8 @@ def _merge_fn(ops: tuple, n_keys: int):
 
     @jax.jit
     def merge(arrays, sel, num_rows):
-        cap = arrays[0][0].shape[0]
+        cap = next(a[0].shape[0] for a in arrays
+                   if a is not None)
         active = jnp.arange(cap, dtype=jnp.int32) < num_rows
         if sel is not None:
             active = active & sel

@@ -194,11 +194,50 @@ class Session:
             start, end = 0, start
         return DataFrame(L.LogicalRange(start, end, step), self)
 
+    # -- ICI mesh -----------------------------------------------------------------
+    def set_mesh(self, mesh) -> None:
+        """Install the jax.sharding.Mesh used by shuffle.mode=ICI."""
+        self._mesh = mesh
+
+    def ici_mesh(self):
+        """The session's ICI mesh; built over the visible devices when not
+        set explicitly (shuffle.ici.devices bounds the count)."""
+        mesh = getattr(self, "_mesh", None)
+        if mesh is not None:
+            return mesh
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh
+        n = self._tpu_conf()["spark.rapids.tpu.shuffle.ici.devices"]
+        # cache keyed by the conf value so changing shuffle.ici.devices
+        # rebuilds (an explicit set_mesh always wins above)
+        auto = getattr(self, "_mesh_auto", None)
+        if auto is not None and auto[0] == n:
+            return auto[1]
+        devices = jax.devices()
+        if n:
+            if len(devices) < n:
+                raise RuntimeError(
+                    f"shuffle.ici.devices={n} but only {len(devices)} "
+                    f"devices are visible")
+            devices = devices[:n]
+        mesh = Mesh(_np.array(devices), ("data",))
+        self._mesh_auto = (n, mesh)
+        return mesh
+
     # -- execution ----------------------------------------------------------------
     def _plan_physical(self, plan: L.LogicalPlan):
         from ..plan.overrides import apply_overrides
         conf = self._tpu_conf()
         return apply_overrides(plan, conf)
+
+    def _distribute_if_ici(self, phys, ctx):
+        """shuffle.mode=ICI: run exchange-bearing fragments on the mesh,
+        return the residual plan (parallel/spmd.py)."""
+        if ctx.conf["spark.rapids.tpu.shuffle.mode"] != "ICI":
+            return phys
+        from ..parallel.spmd import distribute_plan
+        return distribute_plan(phys, ctx, self.ici_mesh())
 
     def _execute(self, plan: L.LogicalPlan):
         from ..runtime.semaphore import get_semaphore
@@ -206,6 +245,7 @@ class Session:
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
         with get_semaphore(conf).acquire():
+            phys = self._distribute_if_ici(phys, ctx)
             return CollectExec(phys).collect_arrow(ctx)
 
     def _execute_batches(self, plan: L.LogicalPlan):
@@ -217,6 +257,7 @@ class Session:
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
         with get_semaphore(conf).acquire():
+            phys = self._distribute_if_ici(phys, ctx)
             for b in phys.execute(ctx):
                 yield to_arrow(b)
 
