@@ -172,6 +172,13 @@ SHUFFLE_MODE = register(
     "within a mesh for whole-stage-resident multi-chip execution).",
     check=_one_of("HOST", "ICI", "CACHE_ONLY"))
 
+AUTO_BROADCAST_THRESHOLD = register(
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
+    "Estimated-size cutoff (bytes) under which the build side of a join is "
+    "broadcast (materialized once, never shuffled) instead of hash "
+    "partitioned; -1 disables auto selection (an explicit broadcast() "
+    "hint still applies). spark.sql.autoBroadcastJoinThreshold analog.")
+
 ICI_DEVICES = register(
     "spark.rapids.tpu.shuffle.ici.devices", 0,
     "Number of mesh devices for ICI shuffle (0 = all visible devices). The "

@@ -719,15 +719,93 @@ def _make_key_decoder(partial):
     return decode
 
 
+def _all_gather_table(pg: "ProcessGroup", table):
+    """All-gather a pyarrow table across ranks (Arrow IPC frames), concat."""
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    gathered = pg.all_gather_bytes(sink.getvalue().to_pybytes())
+    parts = []
+    for payload in gathered:
+        with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
+            parts.append(r.read_all())
+    return pa.concat_tables(parts)
+
+
+class DcnBroadcastExchangeExec:
+    """Broadcast exchange over DCN: each rank materializes its local build
+    shard, all ranks exchange them (all_gather of Arrow IPC frames), and
+    every rank joins against the complete build table.  Reference:
+    GpuBroadcastExchangeExec.scala:352 serialized-host-batch broadcast."""
+
+    outputs_broadcast = True
+
+    def __init__(self, local, pg: ProcessGroup):
+        # duck-typed like BroadcastExchangeExec: materialize() + execute()
+        from ..plan.join_exec import BroadcastExchangeExec
+        self._local = (local if isinstance(local, BroadcastExchangeExec)
+                       else BroadcastExchangeExec(local))
+        self.children = list(self._local.children)
+        self.pg = pg
+        self.op_id = f"DcnBroadcastExchange@{id(self):x}"
+
+    @property
+    def output_schema(self):
+        return self._local.output_schema
+
+    def node_desc(self):
+        return f"DcnBroadcastExchange [world={self.pg.world_size}]"
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = [("  " * indent) + ("+- " if indent else "")
+                 + self.node_desc()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def materialize(self, ctx):
+        from ..batch import from_arrow, to_arrow
+        from ..memory.spill import get_catalog
+        from ..ops import batch_utils
+        from ..plan.join_exec import _empty_batch
+        lh = self._local.materialize(ctx)
+        try:
+            local = to_arrow(batch_utils.compact(lh.get()))
+        finally:
+            lh.close()
+        full = _all_gather_table(self.pg, local)
+        catalog = get_catalog(ctx.conf)
+        if full.num_rows == 0:
+            return catalog.register(_empty_batch(self.output_schema),
+                                    priority=1)
+        min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+        return catalog.register(
+            from_arrow(full, min_capacity=min_cap, device=ctx.device),
+            priority=1)
+
+    def execute(self, ctx):
+        h = self.materialize(ctx)
+        try:
+            yield h.get()
+        finally:
+            h.close()
+
+
 def _rewrite_exchanges(node, pg: ProcessGroup, n_parts: int):
     """Replace EVERY in-process ShuffleExchangeExec in the subtree with a
     DcnExchangeExec — a distributed plan must shuffle globally at every
     exchange, not just the topmost one (a shard-local join below a
-    distributed aggregate would silently drop cross-rank matches)."""
+    distributed aggregate would silently drop cross-rank matches).
+    BroadcastExchangeExec likewise becomes an all-gather broadcast."""
     from ..plan.exchange_exec import ShuffleExchangeExec
+    from ..plan.join_exec import BroadcastExchangeExec
     from ..plan.physical import AggregateExec
     for i, child in enumerate(list(node.children)):
         _rewrite_exchanges(child, pg, n_parts)
+        if isinstance(child, BroadcastExchangeExec):
+            node.children[i] = DcnBroadcastExchangeExec(child, pg)
+            continue
         if isinstance(child, ShuffleExchangeExec):
             below = child.children[0]
             decoder = _make_key_decoder(below) \
@@ -774,6 +852,12 @@ def run_distributed_query(df, pg: ProcessGroup,
                 isinstance(c, ShuffleExchangeExec) for c in node.children):
             top = node
             break
+        from ..plan.join_exec import BroadcastJoinExec
+        if isinstance(node, BroadcastJoinExec):
+            # broadcast join: the build side all-gathers, the probe side
+            # stays rank-local — the join itself is the distributed top
+            top = node
+            break
         chain.append(node)
         node = node.children[0] if node.children else None
     if top is None:
@@ -790,12 +874,20 @@ def run_distributed_query(df, pg: ProcessGroup,
     # would silently join only rank-local data and return complete-looking
     # wrong answers
     def _check(node):
-        if isinstance(node, SortMergeJoinExec) and not all(
+        from ..plan.join_exec import BroadcastJoinExec
+        if isinstance(node, BroadcastJoinExec):
+            if not isinstance(node.children[node.build_side],
+                              DcnBroadcastExchangeExec):
+                raise ValueError(
+                    f"broadcast join build side was not rewritten to a DCN "
+                    f"broadcast exchange: {node.node_desc()}")
+        elif isinstance(node, SortMergeJoinExec) and not all(
                 isinstance(c, DcnExchangeExec) for c in node.children):
             raise ValueError(
                 f"distributed subtree contains a non-shuffled join "
                 f"({node.node_desc()}): cross/keyless joins cannot run "
-                f"over DCN shards (broadcast is not implemented)")
+                f"over DCN shards (use a broadcast hint for keyless "
+                f"small-side joins)")
         for c in node.children:
             _check(c)
     _check(top)
@@ -806,15 +898,7 @@ def run_distributed_query(df, pg: ProcessGroup,
     local = pa.concat_tables(tables) if tables \
         else to_arrow(_empty_batch(top.output_schema))
 
-    sink = pa.BufferOutputStream()
-    with pa.ipc.new_stream(sink, local.schema) as w:
-        w.write_table(local)
-    gathered = pg.all_gather_bytes(sink.getvalue().to_pybytes())
-    parts = []
-    for payload in gathered:
-        with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
-            parts.append(r.read_all())
-    full = pa.concat_tables(parts)
+    full = _all_gather_table(pg, local)
 
     if chain:
         # replay the post-subtree plan (sort/limit/...) on gathered rows

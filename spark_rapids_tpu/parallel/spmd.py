@@ -57,6 +57,9 @@ class _Leaf:
         self.schema = phys.output_schema
         self.index = index        # position in the feed argument list
         self.cap = None           # per-device rows, set after materialize
+        # replicated leaves (broadcast build sides) feed every device the
+        # full table (shard_map in_spec P() instead of P(axis))
+        self.replicated = False
 
     def resolve(self):
         assert self.cap is not None, "leaf not materialized"
@@ -423,6 +426,31 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
                        depth_has_exchange)
         return _Aggregate(node, child)
 
+    from ..plan.join_exec import BroadcastJoinExec
+    if isinstance(node, BroadcastJoinExec):
+        if node.how == "cross":
+            # nested-loop expansion has no bounded static shape; the join
+            # materializes single-process (its exchanges — none — are moot)
+            return _make_leaf(node, leaves)
+        n_leaves = len(leaves)
+        had_exch = depth_has_exchange[0]
+        try:
+            probe = _lower(node.children[1 - node.build_side], leaves, conf,
+                           n_dev, axis, depth_has_exchange)
+            # the build side rides replicated: every device holds the full
+            # (small) table, so no colocation exchange is needed at all
+            build = _make_leaf(node.children[node.build_side].children[0],
+                               leaves)
+            build.replicated = True
+        except NotLowerable:
+            del leaves[n_leaves:]
+            depth_has_exchange[0] = had_exch
+            raise
+        left, right = ((build, probe) if node.build_side == 0
+                       else (probe, build))
+        return _Join(node, left, right,
+                     conf["spark.rapids.tpu.shuffle.ici.joinOutputRows"])
+
     if isinstance(node, SortMergeJoinExec):
         if node.how == "cross":
             return _make_leaf(node, leaves)
@@ -511,9 +539,14 @@ def _materialize_leaf(leaf: _Leaf, ctx, n_dev: int, string_dict):
     from ..plan.physical import CollectExec
     table = CollectExec(leaf.phys).collect_arrow(ctx)
     rows = 0 if table is None else table.num_rows
-    cap = bucket_capacity(max(1, -(-rows // n_dev)), min_capacity=8)
+    if leaf.replicated:
+        # broadcast build side: every device receives the whole table
+        cap = bucket_capacity(max(1, rows), min_capacity=8)
+        total = cap
+    else:
+        cap = bucket_capacity(max(1, -(-rows // n_dev)), min_capacity=8)
+        total = n_dev * cap
     leaf.cap = cap
-    total = n_dev * cap
     cols = []
     for i, f in enumerate(leaf.schema):
         if rows == 0:
@@ -551,14 +584,19 @@ def _execute_fragment(lowered, leaves: List[_Leaf], ctx, mesh, axis: str):
     n_dev = int(np.prod(mesh.devices.shape))
     sdict = StringDictionary()
     feeds = []      # flat arg arrays (global)
+    feed_specs = []  # P(axis) sharded / P() replicated, aligned with feeds
     leaf_slots = []  # (n_cols,) per leaf
     for leaf in leaves:
         cols, rows = _materialize_leaf(leaf, ctx, n_dev, sdict)
+        spec = P() if leaf.replicated else P(axis)
+        n_feed = 1 if leaf.replicated else n_dev
         for d, v in cols:
             feeds.append(d)
             feeds.append(v)
-        feeds.append((np.arange(n_dev * leaf.cap, dtype=np.int64)
+            feed_specs += [spec, spec]
+        feeds.append((np.arange(n_feed * leaf.cap, dtype=np.int64)
                       < rows))
+        feed_specs.append(spec)
         leaf_slots.append(len(cols))
     lowered.resolve()
 
@@ -592,9 +630,8 @@ def _execute_fragment(lowered, leaves: List[_Leaf], ctx, mesh, axis: str):
             ov = jnp.zeros((1,), dtype=jnp.int64)
         return tuple(flat) + (active, ov)
 
-    n_args = len(feeds)
     n_out_cols = len(lowered.schema)
-    in_specs = tuple(P(axis) for _ in range(n_args))
+    in_specs = tuple(feed_specs)
     out_specs = tuple(P(axis) for _ in range(2 * n_out_cols + 1)) + (P(axis),)
     fn = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs))

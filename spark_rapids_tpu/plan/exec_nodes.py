@@ -615,10 +615,14 @@ def plan_join(plan, left: TpuExec, right: TpuExec, conf):
     exchange fall through to the single-stream join."""
     from ..exprs import Cast
     from .exchange_exec import ShuffleExchangeExec
-    from .join_exec import SortMergeJoinExec, bound_join_keys
+    from .join_exec import (SortMergeJoinExec, bound_join_keys,
+                            plan_broadcast_join)
     # one dictionary registry per key index shared by both sides' exchanges
     # AND the join kernel: string-key codes must be comparable everywhere
     shared_dicts: dict = {}
+    bc = plan_broadcast_join(plan, left, right, conf, shared_dicts)
+    if bc is not None:
+        return bc
     if (plan.how != "cross" and plan.left_keys
             and conf["spark.rapids.tpu.sql.exchange.enabled"]):
         lk, rk, common = bound_join_keys(plan, left.output_schema,

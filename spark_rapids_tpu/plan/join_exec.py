@@ -57,6 +57,29 @@ def bound_join_keys(plan, lsch: Schema, rsch: Schema):
     return lk, rk, common
 
 
+def materialize_whole(child: TpuExec, ctx: ExecContext):
+    """Materialize an operator's whole output as ONE spillable handle
+    (compact each batch, concat, register) — shared by join-side
+    materialization and broadcast exchanges."""
+    from ..memory.spill import get_catalog
+    catalog = get_catalog(ctx.conf)
+    handles = []
+    for b in child.execute(ctx):
+        c = batch_utils.compact(b)
+        if c.num_rows > 0:
+            handles.append(catalog.register(c, priority=1))
+    if not handles:
+        return catalog.register(_empty_batch(child.output_schema),
+                                priority=1)
+    if len(handles) == 1:
+        return handles[0]
+    whole = batch_utils.compact(
+        batch_utils.concat_batches([h.get() for h in handles]))
+    for h in handles:
+        h.close()
+    return catalog.register(whole, priority=1)
+
+
 def _canon_how(how: str) -> str:
     return {"left_outer": "left", "right_outer": "right",
             "full_outer": "full", "left_semi": "semi",
@@ -124,23 +147,7 @@ class SortMergeJoinExec(TpuExec):
         """Materialize one side as a spillable handle (LazySpillableColumnar-
         Batch analog): while the other side executes, this one can be
         evicted to host under memory pressure."""
-        from ..memory.spill import get_catalog
-        catalog = get_catalog(ctx.conf)
-        handles = []
-        for b in self.children[side].execute(ctx):
-            c = batch_utils.compact(b)
-            if c.num_rows > 0:
-                handles.append(catalog.register(c, priority=1))
-        if not handles:
-            return catalog.register(
-                _empty_batch(self.children[side].output_schema), priority=1)
-        if len(handles) == 1:
-            return handles[0]
-        whole = batch_utils.compact(
-            batch_utils.concat_batches([h.get() for h in handles]))
-        for h in handles:
-            h.close()
-        return catalog.register(whole, priority=1)
+        return materialize_whole(self.children[side], ctx)
 
     # -- execution ----------------------------------------------------------------
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
@@ -427,6 +434,160 @@ class SortMergeJoinExec(TpuExec):
         p_cols = _gather_cols(left, pi, valid_if="neg_is_null")
         b_cols = _gather_cols(right, bi, valid_if="neg_is_null")
         return self._assemble(left, right, p_cols, b_cols, 0, total, out_cap)
+
+
+# ---------------------------------------------------------------------------------
+# Broadcast joins
+# ---------------------------------------------------------------------------------
+
+class BroadcastExchangeExec(TpuExec):
+    """Materialize the (small) build side ONCE as a single spillable batch.
+
+    Reference: GpuBroadcastExchangeExec.scala:352 — the build side is
+    collected and shared by every task.  In-process that means one
+    materialized batch; over DCN every rank all-gathers it
+    (parallel/dcn.py); under ICI SPMD it feeds the mesh replicated
+    (parallel/spmd.py P() in_spec)."""
+
+    outputs_broadcast = True
+
+    def __init__(self, child: TpuExec):
+        super().__init__([child])
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def node_desc(self):
+        return "TpuBroadcastExchange"
+
+    def materialize(self, ctx: ExecContext):
+        """One spillable handle holding the whole child output."""
+        m = ctx.metric_set(self.op_id)
+        with m.time("buildTime"):
+            return materialize_whole(self.children[0], ctx)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        h = self.materialize(ctx)
+        try:
+            yield h.get()
+        finally:
+            h.close()
+
+
+class BroadcastJoinExec(SortMergeJoinExec):
+    """Join a streamed probe side against a broadcast build side.
+
+    Reference: GpuBroadcastHashJoinExecBase.scala (equi, gather-map per
+    probe batch), GpuBroadcastNestedLoopJoinExecBase.scala (cross).  The
+    probe side streams batch-by-batch — the big (fact) side never
+    materializes wholesale and is never shuffled; each probe batch joins
+    the resident build batch independently.  ``build_side`` must be the
+    kernel's natural build for the join type (right, except left for
+    how=right): the planner guarantees it (plan_broadcast_join)."""
+
+    def __init__(self, plan, left: TpuExec, right: TpuExec, conf,
+                 build_side: int, string_dicts: Optional[dict] = None):
+        super().__init__(plan, left, right, conf, string_dicts=string_dicts)
+        self.build_side = build_side
+        assert build_side in _legal_build_sides(self.how), \
+            f"cannot broadcast side {build_side} of a {self.how} join"
+        assert isinstance(self.children[build_side], BroadcastExchangeExec)
+
+    def _join(self, left: ColumnBatch, right: ColumnBatch) -> ColumnBatch:
+        if self.how == "inner" and self.build_side == 0:
+            # inner join is symmetric: probe the (streamed) right side so
+            # the broadcast left side is the build
+            return self._outer_join(left, right, probe_side=1)
+        return super()._join(left, right)
+
+    def node_desc(self):
+        side = "left" if self.build_side == 0 else "right"
+        kind = "NestedLoop" if self.how == "cross" else "Hash"
+        return f"TpuBroadcast{kind}Join [{self.how}] build={side}"
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        m = ctx.metric_set(self.op_id)
+        probe_side = 1 - self.build_side
+        bh = self.children[self.build_side].materialize(ctx)
+        pgen = self.children[probe_side].execute(ctx)
+        try:
+            build = bh.get()
+            for probe in pgen:
+                if probe.row_count() == 0:
+                    continue
+                # the join kernel treats every row below num_rows as live —
+                # a streamed batch may carry a selection mask from an
+                # upstream filter, so compact first (the shuffle path
+                # compacts inside the exchange)
+                if probe.sel is not None:
+                    probe = batch_utils.compact(probe)
+                    if probe.num_rows == 0:
+                        continue
+                if self.build_side == 1:
+                    yield self._join_pair(ctx, m, probe, build)
+                else:
+                    yield self._join_pair(ctx, m, build, probe)
+        finally:
+            # close the suspended probe generator deterministically: a DCN
+            # exchange below holds collective barriers in its cleanup that
+            # must not wait for garbage collection
+            pgen.close()
+            bh.close()
+
+
+def _legal_build_sides(how: str) -> tuple:
+    """Sides that may be broadcast (must not be the row-preserving side).
+    full outer never broadcasts; inner/cross are symmetric."""
+    return {"inner": (1, 0), "cross": (1, 0), "left": (1,), "semi": (1,),
+            "anti": (1,), "right": (0,), "full": ()}[how]
+
+
+def plan_broadcast_join(plan, left: TpuExec, right: TpuExec, conf,
+                        shared_dicts: dict) -> Optional[BroadcastJoinExec]:
+    """Choose a broadcast join when legal and the build side is small.
+
+    Selection mirrors the reference (GpuBroadcastHashJoinExecBase meta +
+    spark.sql.autoBroadcastJoinThreshold): an explicit ``broadcast()`` hint
+    on a legal side wins; otherwise the smallest side estimated under
+    spark.rapids.tpu.sql.autoBroadcastJoinThreshold bytes builds.  A hint
+    on a row-preserving side (e.g. the left of a left outer join) cannot
+    be honored and the join shuffles."""
+    how = _canon_how(plan.how)
+    legal = _legal_build_sides(how)
+    if not legal:
+        return None
+    hints = [bool(getattr(plan.children[i], "broadcast_hint", False))
+             for i in (0, 1)]
+    build_side = next((s for s in legal if hints[s]), None)
+    if build_side is None:
+        if any(hints):
+            return None  # hint only on an illegal side
+        threshold = conf["spark.rapids.tpu.sql.autoBroadcastJoinThreshold"]
+        if threshold < 0:
+            return None
+        ests = [_estimated_bytes(plan.children[i]) for i in (0, 1)]
+        fits = [s for s in legal
+                if ests[s] is not None and ests[s] <= threshold]
+        if not fits:
+            return None
+        build_side = min(fits, key=lambda s: ests[s])
+    if build_side == 1:
+        return BroadcastJoinExec(plan, left, BroadcastExchangeExec(right),
+                                 conf, 1, string_dicts=shared_dicts)
+    return BroadcastJoinExec(plan, BroadcastExchangeExec(left), right,
+                             conf, 0, string_dicts=shared_dicts)
+
+
+def _estimated_bytes(logical) -> Optional[float]:
+    from .cbo import estimate_rows
+    rows = estimate_rows(logical)
+    if rows is None:
+        return None
+    width = 0
+    for f in logical.schema():
+        width += 24 if f.dtype.is_string else 8
+    return rows * width
 
 
 # ---------------------------------------------------------------------------------

@@ -85,6 +85,17 @@ def _refs(exprs) -> Set[str]:
 
 def _walk(node: L.LogicalPlan, required: Optional[Set[str]],
           preds: List[Tuple[str, str, object]]) -> L.LogicalPlan:
+    out = _walk_impl(node, required, preds)
+    # rebuilt nodes must keep planner hints riding on the original
+    # (a dropped broadcast_hint silently turns a broadcast join into a
+    # shuffle)
+    if out is not node and getattr(node, "broadcast_hint", False):
+        out.broadcast_hint = True
+    return out
+
+
+def _walk_impl(node: L.LogicalPlan, required: Optional[Set[str]],
+               preds: List[Tuple[str, str, object]]) -> L.LogicalPlan:
     if isinstance(node, L.LogicalScan):
         src = getattr(node, "source", None)
         if src is None or not hasattr(src, "with_pushdown"):

@@ -278,6 +278,17 @@ class DataFrame:
         raise NotImplementedError(
             "join on: column names or (left, right) name pairs")
 
+    def hint(self, name: str, *args) -> "DataFrame":
+        """Planner hint. Supported: "broadcast" — prefer broadcasting this
+        side in joins (ResolvedHint analog; consumed by
+        plan/join_exec.plan_broadcast_join)."""
+        if name.lower() not in ("broadcast", "broadcastjoin", "mapjoin"):
+            return self  # unknown hints are ignored, as in Spark
+        import copy
+        plan = copy.copy(self._plan)
+        plan.broadcast_hint = True
+        return DataFrame(plan, self.session)
+
     def cross_join(self, other: "DataFrame") -> "DataFrame":
         node = L.Join(self._plan, other._plan, [], [], how="cross")
         return DataFrame(node, self.session)

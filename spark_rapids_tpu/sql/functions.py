@@ -10,6 +10,7 @@ from .. import types as T
 from .column import Column, to_expr
 
 __all__ = [
+    "broadcast",
     "col", "lit", "when", "coalesce", "isnull", "isnan", "expr_abs",
     "sum", "count", "count_star", "min", "max", "avg", "mean", "first", "last",
     "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
@@ -40,6 +41,12 @@ __all__ = [
 
 def col(name: str) -> Column:
     return Column(E.UnresolvedColumn(name))
+
+
+def broadcast(df):
+    """Hint that ``df`` should be broadcast in joins (pyspark
+    functions.broadcast analog; GpuBroadcastHashJoinExecBase selection)."""
+    return df.hint("broadcast")
 
 
 def lit(value: Any, dtype: Optional[T.DataType] = None) -> Column:

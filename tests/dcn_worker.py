@@ -52,10 +52,24 @@ def main() -> None:
                  .sort(F.col("sv").desc())
                  .limit(3))
         elif args.query == "join":
-            # distributed shuffled join + aggregate: both sides sharded
+            # distributed shuffled join + aggregate: both sides sharded.
+            # keep the SHUFFLED path under test (the tiny dim would
+            # otherwise auto-broadcast)
+            sess.conf.set(
+                "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", -1)
             dim = sess.read_parquet(
                 os.path.join(args.data, f"dim-{args.rank}.parquet"))
             q = (df.join(dim, on=[("k", "dk")])
+                 .group_by("dname")
+                 .agg(F.sum(F.col("v")).alias("sv"),
+                      F.count_star().alias("c"))
+                 .sort("dname"))
+        elif args.query == "bjoin":
+            # broadcast join over DCN: the sharded dim all-gathers so every
+            # rank probes its fact shard against the COMPLETE build table
+            dim = sess.read_parquet(
+                os.path.join(args.data, f"dim-{args.rank}.parquet"))
+            q = (df.join(F.broadcast(dim), on=[("k", "dk")])
                  .group_by("dname")
                  .agg(F.sum(F.col("v")).alias("sv"),
                       F.count_star().alias("c"))

@@ -1,0 +1,211 @@
+"""Broadcast joins: small build side materialized once, probe side streamed.
+
+Reference: GpuBroadcastHashJoinExecBase.scala (equi-join against a broadcast
+build), GpuBroadcastNestedLoopJoinExecBase.scala (cross),
+GpuBroadcastExchangeExec.scala:352 (the build-side collect), and the
+spark.sql.autoBroadcastJoinThreshold selection.
+
+Differential contract: every broadcast plan must match the shuffled plan's
+result exactly (threshold=-1 disables broadcast for the oracle run).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+
+THRESH = "spark.rapids.tpu.sql.autoBroadcastJoinThreshold"
+
+
+@pytest.fixture()
+def sess(fresh_session):
+    return fresh_session
+
+
+def _tables(rng, no=300, nl=3000):
+    dim = pa.table({
+        "d_key": pa.array(np.arange(no)),
+        "d_cat": pa.array([f"cat-{i % 7}" for i in range(no)]),
+    })
+    fact = pa.table({
+        "f_key": pa.array(
+            [None if i % 19 == 0 else int(v) for i, v in
+             enumerate(rng.integers(0, no + 40, nl))], type=pa.int64()),
+        "f_val": pa.array(rng.uniform(0.0, 100.0, nl)),
+    })
+    return dim, fact
+
+
+def _differential(df, sess):
+    got = df.collect()                       # broadcast plan
+    sess.conf.set(THRESH, -1)
+    want = df.collect()                      # shuffled plan
+    sess.conf.set(THRESH, 10 * 1024 * 1024)
+
+    def key(r):
+        return tuple((x is None, str(x)) for x in r)
+    got = sorted(got, key=key)
+    want = sorted(want, key=key)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        for gi, wi in zip(g, w):
+            if isinstance(wi, float) and gi is not None:
+                assert abs(gi - wi) <= 1e-9 * max(1.0, abs(wi)), (g, w)
+            else:
+                assert gi == wi, (g, w)
+    return got
+
+
+def test_auto_broadcast_small_side(sess, rng):
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    j = dfc.join(dd, [("f_key", "d_key")], "inner")
+    phys = sess._plan_physical(j._plan)
+    assert "TpuBroadcastHashJoin" in phys.tree_string()
+    assert "TpuShuffleExchange" not in phys.tree_string()
+    _differential(j, sess)
+
+
+def test_threshold_disables_auto(sess, rng):
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    sess.conf.set(THRESH, -1)
+    phys = sess._plan_physical(
+        dfc.join(dd, [("f_key", "d_key")], "inner")._plan)
+    sess.conf.set(THRESH, 10 * 1024 * 1024)
+    assert "TpuBroadcast" not in phys.tree_string()
+    assert "TpuShuffleExchange" in phys.tree_string()
+
+
+def test_hint_forces_broadcast(sess, rng):
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    sess.conf.set(THRESH, -1)  # auto off: only the hint can select it
+    j = dfc.join(F.broadcast(dd), [("f_key", "d_key")], "inner")
+    phys = sess._plan_physical(j._plan)
+    sess.conf.set(THRESH, 10 * 1024 * 1024)
+    assert "TpuBroadcastHashJoin" in phys.tree_string()
+
+
+def test_hint_survives_pushdown_rebuild(sess, rng):
+    """optimize_scans rebuilds Filter/Project nodes; the broadcast hint
+    must ride along (it previously vanished, silently shuffling)."""
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    sess.conf.set(THRESH, -1)
+    j = dfc.join(F.broadcast(dd.filter(F.col("d_key") >= 0)),
+                 [("f_key", "d_key")], "inner")
+    phys = sess._plan_physical(j._plan)
+    sess.conf.set(THRESH, 10 * 1024 * 1024)
+    assert "TpuBroadcastHashJoin" in phys.tree_string()
+
+
+def test_hint_on_left_inner_side_builds_left(sess, rng):
+    """F.broadcast(small).join(big) — the canonical pyspark ordering —
+    must broadcast the LEFT side of an inner join (sides are symmetric)."""
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    sess.conf.set(THRESH, -1)
+    j = F.broadcast(dd).join(dfc, [("d_key", "f_key")], "inner")
+    phys = sess._plan_physical(j._plan)
+    sess.conf.set(THRESH, 10 * 1024 * 1024)
+    assert "build=left" in phys.tree_string()
+    _differential(j, sess)
+
+
+def test_auto_prefers_smaller_side_inner(sess, rng):
+    """Auto selection on an inner join builds the smaller side even when
+    it is the left one."""
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    j = dd.join(dfc, [("d_key", "f_key")], "inner")  # small side on LEFT
+    phys = sess._plan_physical(j._plan)
+    assert "build=left" in phys.tree_string()
+    _differential(j, sess)
+
+
+def test_hint_on_preserved_side_falls_back(sess, rng):
+    """A left-outer join cannot broadcast its left (row-preserving) side:
+    the hint is refused and the join shuffles (as in Spark)."""
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    j = F.broadcast(dfc).join(dd, [("f_key", "d_key")], "left")
+    phys = sess._plan_physical(j._plan)
+    assert "TpuBroadcast" not in phys.tree_string()
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_broadcast_join_types_differential(sess, rng, how):
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    j = dfc.join(F.broadcast(dd), [("f_key", "d_key")], how)
+    assert "TpuBroadcast" in sess._plan_physical(j._plan).tree_string()
+    _differential(j, sess)
+
+
+def test_broadcast_right_outer(sess, rng):
+    """how=right builds the LEFT side — the broadcastable one."""
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    j = F.broadcast(dd).join(dfc, [("d_key", "f_key")], "right")
+    tree = sess._plan_physical(j._plan).tree_string()
+    assert "build=left" in tree
+    _differential(j, sess)
+
+
+def test_full_outer_never_broadcasts(sess, rng):
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    j = dfc.join(F.broadcast(dd), [("f_key", "d_key")], "full")
+    assert "TpuBroadcast" not in sess._plan_physical(j._plan).tree_string()
+
+
+def test_broadcast_nested_loop_cross(sess, rng):
+    small = pa.table({"a": pa.array([1, 2, 3])})
+    big = pa.table({"b": pa.array(np.arange(500)),
+                    "v": pa.array(rng.uniform(0, 1, 500))})
+    ds, db = sess.create_dataframe(small), sess.create_dataframe(big)
+    j = db.cross_join(ds)
+    tree = sess._plan_physical(j._plan).tree_string()
+    assert "TpuBroadcastNestedLoopJoin" in tree
+    rows = j.collect()
+    assert len(rows) == 1500
+
+
+def test_broadcast_probe_streams_in_batches(sess, rng):
+    """The probe side must NOT materialize wholesale: with a small
+    batchSizeRows the probe streams several batches, each joined against
+    the one resident build batch."""
+    dim, fact = _tables(rng, no=50, nl=4000)
+    sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 1000)
+    try:
+        dd = sess.create_dataframe(dim)
+        dfc = sess.create_dataframe(fact)
+        j = dfc.join(F.broadcast(dd), [("f_key", "d_key")], "left")
+        _differential(j, sess)
+    finally:
+        sess.conf.unset("spark.rapids.tpu.sql.batchSizeRows")
+
+
+def test_broadcast_with_agg_above(sess, rng):
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    df = (dfc.join(F.broadcast(dd), [("f_key", "d_key")], "inner")
+          .group_by("d_cat")
+          .agg(F.sum(F.col("f_val")).alias("s"),
+               F.count_star().alias("c")))
+    _differential(df, sess)
+
+
+def test_empty_build_side(sess, rng):
+    dim = pa.table({"d_key": pa.array([], type=pa.int64()),
+                    "d_cat": pa.array([], type=pa.string())})
+    _, fact = _tables(rng, nl=800)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    inner = dfc.join(F.broadcast(dd), [("f_key", "d_key")], "inner")
+    assert inner.collect() == []
+    left = dfc.join(F.broadcast(dd), [("f_key", "d_key")], "left")
+    rows = left.collect()
+    assert len(rows) == 800
+    assert all(r[-1] is None for r in rows)  # d_cat all null

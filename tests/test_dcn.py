@@ -324,6 +324,34 @@ class TestDistributedAggEndToEnd:
         want = [(n, round(float(sv), 6), c) for n, sv, c in expect]
         assert got == want
 
+    def test_broadcast_join_across_processes(self, tmp_path, session):
+        """Broadcast join over DCN: the dim table is sharded so each rank
+        holds only part of the build side — the broadcast exchange must
+        all-gather it (GpuBroadcastExchangeExec.scala:352 analog) or
+        cross-rank matches are lost."""
+        world = 2
+        whole = _gen_shards(tmp_path, world, n=1100, seed=31)
+        dims = []
+        for r in range(world):
+            ks = [k for k in range(37) if k % world == r]
+            t = pa.table({"dk": pa.array(ks, pa.int64()),
+                          "dname": [f"name-{k:02d}" for k in ks]})
+            pq.write_table(t, str(tmp_path / f"dim-{r}.parquet"))
+            dims.append(t)
+        results = _run_workers(tmp_path, world, "bjoin")
+        assert results[0] == results[1]
+        sess = srt.Session.get_or_create()
+        df = sess.create_dataframe(whole)
+        dim = sess.create_dataframe(pa.concat_tables(dims))
+        expect = (df.join(dim, on=[("k", "dk")])
+                  .group_by("dname")
+                  .agg(F.sum(F.col("v")).alias("sv"),
+                       F.count_star().alias("c"))
+                  .sort("dname").collect())
+        got = [(n, round(float(sv), 6), c) for n, sv, c in results[0]]
+        want = [(n, round(float(sv), 6), c) for n, sv, c in expect]
+        assert got == want
+
     def test_post_agg_sort_limit_replays_on_gathered(self, tmp_path,
                                                      session):
         world = 2

@@ -36,8 +36,20 @@ class TestExchangeInPlan:
     def test_join_is_shuffled(self, session):
         l = session.create_dataframe({"k": [1], "a": [1.0]})
         r = session.create_dataframe({"k": [1], "b": [2.0]})
+        # a tiny build side auto-broadcasts by default...
         phys = _plan(l.join(r, on="k"))
-        assert all(isinstance(c, ShuffleExchangeExec) for c in phys.children)
+        assert "TpuBroadcast" in phys.tree_string()
+        # ...and shuffles once broadcast selection is disabled
+        session.conf.set(
+            "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", -1)
+        try:
+            phys = _plan(l.join(r, on="k"))
+            assert all(isinstance(c, ShuffleExchangeExec)
+                       for c in phys.children)
+        finally:
+            session.conf.set(
+                "spark.rapids.tpu.sql.autoBroadcastJoinThreshold",
+                10 * 1024 * 1024)
 
     def test_exchange_disabled_single_stream(self, fresh_session):
         fresh_session.conf.set("spark.rapids.tpu.sql.exchange.enabled", False)

@@ -48,6 +48,16 @@ def sess(fresh_session):
     return fresh_session
 
 
+@pytest.fixture()
+def shuffle_only(sess):
+    """Pin the shuffled-join path: the tiny test dims would otherwise
+    auto-broadcast and bypass the all_to_all join under test."""
+    sess.conf.set("spark.rapids.tpu.sql.autoBroadcastJoinThreshold", -1)
+    yield sess
+    sess.conf.set("spark.rapids.tpu.sql.autoBroadcastJoinThreshold",
+                  10 * 1024 * 1024)
+
+
 def _tables(rng, no=400, nl=2500, null_keys=False):
     ok = np.arange(no)
     lk = rng.integers(0, no + 60, nl)  # some keys match nothing
@@ -95,7 +105,8 @@ def test_ici_string_group_keys(sess, rng):
 
 @pytest.mark.parametrize("how", ["inner", "left", "right", "full",
                                  "left_semi", "left_anti"])
-def test_ici_join_types(sess, rng, how):
+def test_ici_join_types(shuffle_only, rng, how):
+    sess = shuffle_only
     orders, items = _tables(rng, null_keys=True)
     do = sess.create_dataframe(orders)
     dl = sess.create_dataframe(items)
@@ -104,9 +115,10 @@ def test_ici_join_types(sess, rng, how):
     _assert_rows_equal(got, want)
 
 
-def test_ici_q3_shape(sess, rng):
+def test_ici_q3_shape(shuffle_only, rng):
     """join + filter + group-by + order-by: the round-2 verdict's done
     criterion for ICI (fragment = join..final-agg; sort runs above)."""
+    sess = shuffle_only
     orders, items = _tables(rng)
     do = sess.create_dataframe(orders)
     dl = sess.create_dataframe(items)
@@ -128,7 +140,8 @@ def test_ici_q3_shape(sess, rng):
         assert abs(g[1] - w[1]) <= 1e-9 * max(1.0, abs(w[1]))
 
 
-def test_ici_residual_condition_inner(sess, rng):
+def test_ici_residual_condition_inner(shuffle_only, rng):
+    sess = shuffle_only
     orders, items = _tables(rng)
     do = sess.create_dataframe(orders)
     dl = sess.create_dataframe(items)
@@ -213,11 +226,12 @@ def test_ici_exchange_never_silently_degrades(sess):
         sess.conf.set("spark.rapids.tpu.shuffle.mode", "CACHE_ONLY")
 
 
-def test_ici_host_predicate_above_join(sess, rng):
+def test_ici_host_predicate_above_join(shuffle_only, rng):
     """A host-lowered string predicate ABOVE a shuffled join: the inner
     join fragment distributes first, then the predicate runs single-process
     and the outer aggregation distributes as a second fragment — a leaf
     must never swallow an exchange-bearing subtree."""
+    sess = shuffle_only
     orders, items = _tables(rng, no=200, nl=1200)
     orders = orders.append_column(
         "o_seg", pa.array([["BUILDING", "MACHINERY"][i % 2]
@@ -239,5 +253,42 @@ def test_ici_avg_and_compound_aggs(sess, rng):
     df = (sess.create_dataframe(t).group_by("k")
           .agg((F.sum(F.col("v")) * 0.2).alias("fifth"),
                (F.max(F.col("v")) - F.min(F.col("v"))).alias("spread")))
+    got, want = _both_modes(df, sess)
+    _assert_rows_equal(got, want)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_ici_broadcast_join_types(sess, rng, how):
+    """Broadcast joins under SPMD: the build side feeds the mesh
+    replicated (P() in_spec) — no all_to_all for the join at all; the
+    aggregate above still exchanges over ICI."""
+    orders, items = _tables(rng, null_keys=True)
+    do = sess.create_dataframe(orders)
+    dl = sess.create_dataframe(items)
+    joined = dl.join(F.broadcast(do), [("l_orderkey", "o_orderkey")], how)
+    if how in ("left_semi", "left_anti"):
+        df = (joined.group_by("l_qty")
+              .agg(F.sum(F.col("l_price")).alias("rev")))
+    else:
+        df = (joined.group_by("o_custkey")
+              .agg(F.sum(F.col("l_price")).alias("rev")))
+    # the plan must actually contain a broadcast join
+    phys = sess._plan_physical(df._plan)
+    assert "TpuBroadcast" in phys.tree_string()
+    got, want = _both_modes(df, sess)
+    _assert_rows_equal(got, want)
+
+
+def test_ici_broadcast_right_outer(sess, rng):
+    """how=right broadcasts the LEFT side (the kernel's build)."""
+    orders, items = _tables(rng)
+    do = sess.create_dataframe(orders)
+    dl = sess.create_dataframe(items)
+    df = (do.hint("broadcast").join(dl, [("o_orderkey", "l_orderkey")],
+                                    "right")
+          .group_by("l_qty")
+          .agg(F.count(F.col("l_price")).alias("c")))
+    phys = sess._plan_physical(df._plan)
+    assert "build=left" in phys.tree_string()
     got, want = _both_modes(df, sess)
     _assert_rows_equal(got, want)
