@@ -83,6 +83,12 @@ class Schema:
         return f"Schema({inner})"
 
 
+def estimated_row_bytes(schema) -> int:
+    """Planning-time row width estimate (bytes): the ONE formula shared by
+    the batch byte caps and the auto-broadcast threshold."""
+    return sum(24 if f.dtype.is_string else 8 for f in schema) or 8
+
+
 def bucket_capacity(n_rows: int, min_capacity: int = 1024) -> int:
     """Smallest power-of-two >= max(n_rows, min_capacity).
 
@@ -172,7 +178,13 @@ class ColumnBatch:
     ``sel`` is how filters stay fused: GpuFilterExec in the reference gathers
     immediately (basicPhysicalOperators.scala:763); here the mask rides along
     and XLA fuses the predicate into whatever consumes the batch.
+
+    ``bound`` (optional) is a STATIC upper limit on live rows, set by
+    bounded producers (dense-grid aggregation): it lets downstream
+    compaction stay sync-free (ops/batch_utils.compact_packed).
     """
+
+    bound = None
 
     def __init__(self, schema: Schema, columns: Sequence[Column], num_rows: int,
                  sel: Optional[jax.Array] = None):

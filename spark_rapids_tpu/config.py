@@ -172,6 +172,15 @@ SHUFFLE_MODE = register(
     "within a mesh for whole-stage-resident multi-chip execution).",
     check=_one_of("HOST", "ICI", "CACHE_ONLY"))
 
+AGG_SKIP_PARTIAL_RATIO = register(
+    "spark.rapids.tpu.sql.agg.skipPartialAggRatio", 0.3,
+    "When a sampled first batch reduces to more than this fraction of its "
+    "rows (high-cardinality group-by), the partial aggregate passes rows "
+    "through to the exchange unreduced instead of sorting every batch — "
+    "a partial sort pass only pays for itself above ~3x reduction "
+    "(GpuHashAggregateExec skipAggPassReductionRatio analog). 1.0 "
+    "disables skipping.", conv=float)
+
 AUTO_BROADCAST_THRESHOLD = register(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
     "Estimated-size cutoff (bytes) under which the build side of a join is "
@@ -271,11 +280,6 @@ JOIN_OUTPUT_GROWTH = register(
     "spark.rapids.tpu.sql.join.outputGrowthFactor", 2.0,
     "Initial output-capacity multiple assumed for join results; overflow "
     "triggers split-and-retry of the probe batch.")
-
-ALLOW_INCOMPAT = register(
-    "spark.rapids.tpu.sql.incompatibleOps.enabled", True,
-    "Allow operators whose results can differ from Spark CPU in corner "
-    "cases (e.g. float ordering of -0.0, timestamp parsing corners).")
 
 ANSI_ENABLED = register(
     "spark.rapids.tpu.sql.ansi.enabled", False,

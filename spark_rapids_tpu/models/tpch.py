@@ -64,6 +64,91 @@ def gen_lineitem(sf: float, out_dir: str, seed: int = 19920101,
     return path
 
 
+def gen_orders(sf: float, out_dir: str, seed: int = 19930101,
+               rows: Optional[int] = None, chunk: int = 1_000_000) -> str:
+    """Write an orders-shaped parquet dataset whose o_orderkey domain
+    matches gen_lineitem's l_orderkey ([1, n_lineitem//4))."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n_li = int(LINEITEM_ROWS_PER_SF * sf)
+    n = rows if rows is not None else max(2, n_li // 4 - 1)
+    path = os.path.join(out_dir, f"orders_sf{sf}_{n}.parquet")
+    if os.path.exists(path):
+        return path
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    n_cust = max(2, int(150_000 * sf))
+    base = np.datetime64("1992-01-01")
+    writer = None
+    for off in range(0, n, chunk):
+        m = min(chunk, n - off)
+        okey = np.arange(off + 1, off + 1 + m, dtype=np.int64)
+        odate = base + rng.integers(0, 2406, m).astype("timedelta64[D]")
+        tbl = pa.table({
+            "o_orderkey": okey,
+            "o_custkey": rng.integers(1, n_cust, m).astype(np.int64),
+            "o_orderdate": pa.array(odate, type=pa.date32()),
+            "o_shippriority": np.zeros(m, dtype=np.int64),
+        })
+        if writer is None:
+            writer = pq.ParquetWriter(path, tbl.schema)
+        writer.write_table(tbl)
+    if writer is not None:
+        writer.close()
+    return path
+
+
+def gen_customer(sf: float, out_dir: str, seed: int = 19940101,
+                 rows: Optional[int] = None) -> str:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = rows if rows is not None else max(2, int(150_000 * sf))
+    path = os.path.join(out_dir, f"customer_sf{sf}_{n}.parquet")
+    if os.path.exists(path):
+        return path
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    tbl = pa.table({
+        "c_custkey": np.arange(1, n + 1, dtype=np.int64),
+        "c_mktsegment": rng.choice(np.array(SEGMENTS), n),
+    })
+    pq.write_table(tbl, path)
+    return path
+
+
+def q3(cust, orders, lineitem):
+    """TPC-H Q3 shipping priority: 3-way join + group-by + top-10."""
+    from ..sql import functions as F
+    cutoff = datetime.date(1995, 3, 15)
+    revenue = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    return (cust.where(F.col("c_mktsegment") == "BUILDING")
+            .join(orders, [("c_custkey", "o_custkey")])
+            .join(lineitem, [("o_orderkey", "l_orderkey")])
+            .where((F.col("o_orderdate") < cutoff)
+                   & (F.col("l_shipdate") > cutoff))
+            .group_by("l_orderkey", "o_orderdate", "o_shippriority")
+            .agg(F.sum(revenue).alias("revenue"))
+            .sort(F.col("revenue").desc(), F.col("o_orderdate"))
+            .limit(10))
+
+
+def q3_pandas(cdf, odf, ldf):
+    cutoff = datetime.date(1995, 3, 15)
+    c = cdf[cdf.c_mktsegment == "BUILDING"]
+    o = odf[odf.o_orderdate < cutoff]
+    li = ldf[ldf.l_shipdate > cutoff]
+    m = c.merge(o, left_on="c_custkey", right_on="o_custkey")
+    m = m.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    m = m.assign(revenue=m.l_extendedprice * (1 - m.l_discount))
+    g = (m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                   as_index=False)["revenue"].sum()
+         .sort_values(["revenue", "o_orderdate"],
+                      ascending=[False, True]).head(10))
+    return g
+
+
 def q6(df):
     """TPC-H Q6: scan → filter → SUM(price*discount) (BASELINE configs[0])."""
     from ..sql import functions as F

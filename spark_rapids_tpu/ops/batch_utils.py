@@ -77,7 +77,11 @@ def concat_batches(batches: Sequence[ColumnBatch],
         # must compact device side too for row alignment.
         out = ColumnBatch(schema, [c for c in cols], total, sel)
         return compact(out, align_host_strings=True)
-    return ColumnBatch(schema, cols, total, sel)
+    out = ColumnBatch(schema, cols, total, sel)
+    bounds = [getattr(b, "bound", None) for b in batches]
+    if all(x is not None for x in bounds):
+        out.bound = sum(bounds)
+    return out
 
 
 def gather(batch: ColumnBatch, indices: jax.Array, num_rows: int,
@@ -144,13 +148,37 @@ def compact(batch: ColumnBatch, align_host_strings: bool = False,
     return ColumnBatch(batch.schema, cols, n_live)
 
 
-def compact_packed(batch: ColumnBatch) -> ColumnBatch:
+def compact_packed(batch: ColumnBatch,
+                   bound: Optional[int] = None) -> ColumnBatch:
     """Compact a batch whose LIVE ROWS ARE ALREADY FRONT-PACKED (the
     selection mask is a prefix mask, e.g. group_reduce outputs): one mask
     sum + a slice, instead of compact()'s full lexsort + gather — on this
-    hardware a 2M-row sort pass costs ~100ms."""
+    hardware a 2M-row sort pass costs ~100ms.
+
+    With ``bound`` (a static upper limit on live rows, e.g. the dense-grid
+    group count), the compaction is SYNC-FREE: a static slice to the
+    bound's capacity bucket, selection mask riding along.  Every host sync
+    on the tunneled backend costs a full ~0.1-0.2s round trip, so bounded
+    operators must never pay one per batch."""
     if batch.sel is None:
         return batch
+    if bound is not None:
+        cap = bucket_capacity(min(bound, batch.capacity))
+        if cap >= batch.capacity:
+            # still bounded: downstream sync-free paths depend on it
+            batch.bound = bound
+            return batch
+        cols = []
+        for f, c in zip(batch.schema, batch.columns):
+            if isinstance(c, HostStringColumn):
+                cols.append(HostStringColumn(c.array.slice(0, cap)))
+            else:
+                valid = c.valid[:cap] if c.valid is not None else None
+                cols.append(DeviceColumn(f.dtype, c.data[:cap], valid))
+        out = ColumnBatch(batch.schema, cols, min(batch.num_rows, cap),
+                          batch.sel[:cap])
+        out.bound = bound
+        return out
     n_live = int(jnp.sum(batch.active_mask()))
     sliced = ColumnBatch(batch.schema, batch.columns,
                          min(batch.num_rows, n_live))

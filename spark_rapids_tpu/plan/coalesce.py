@@ -164,9 +164,17 @@ def insert_coalesce(phys: TpuExec, conf) -> TpuExec:
     """
     if not conf["spark.rapids.tpu.sql.coalesce.enabled"]:
         return phys
+    byte_cap = conf["spark.rapids.tpu.sql.batchSizeBytes"]
     for i, child in enumerate(list(phys.children)):
         new_child = insert_coalesce(child, conf)
         goal = phys.child_coalesce_goal(i, conf)
+        if isinstance(goal, TargetSize) and byte_cap > 0:
+            # batchSizeBytes is the byte-denominated soft cap on a device
+            # batch (the reference's ~1GiB target): clamp the row goal by
+            # the schema's estimated row width
+            from ..batch import estimated_row_bytes
+            width = estimated_row_bytes(new_child.output_schema)
+            goal = TargetSize(max(1, min(goal.rows, byte_cap // width)))
         if goal is not None and not new_child.outputs_partitions:
             if isinstance(new_child, CoalesceBatchesExec):
                 # stacked demands combine instead of stacking nodes

@@ -217,11 +217,17 @@ class ShuffleExchangeExec(TpuExec):
                 if total <= batch_rows_:
                     with m.time("opTime"):
                         if len(raw) == 1:
-                            out = batch_utils.compact(raw[0].get())
+                            out = raw[0].get()
                         else:
-                            out = batch_utils.compact(
-                                batch_utils.concat_batches(
-                                    [h.get() for h in raw]))
+                            out = batch_utils.concat_batches(
+                                [h.get() for h in raw])
+                        if out.sel is not None and \
+                                getattr(out, "bound", None) is None:
+                            # unbounded masked batch: normalize capacity
+                            # (one sync).  Bounded producers (grid aggs)
+                            # already sliced small — pass the mask through
+                            # sync-free; the consumer applies it.
+                            out = batch_utils.compact(out)
                     m.add("numOutputRows", out.num_rows)
                     m.add("numOutputBatches", 1)
                     yield out

@@ -501,6 +501,109 @@ class BroadcastJoinExec(SortMergeJoinExec):
             return self._outer_join(left, right, probe_side=1)
         return super()._join(left, right)
 
+    def _match_state(self, probe: ColumnBatch, build: ColumnBatch,
+                     probe_side: int):
+        """Broadcast fast path for single equi-keys: sort the (small)
+        resident build side ONCE, then each probe batch is two
+        ``searchsorted`` calls — no per-batch union concat + lexsort over
+        probe+build (the generic kernel's per-batch cost, which dominates
+        dim-fact joins).  Multi-key joins fall back to the union kernel."""
+        lk, rk, common = self._bound_keys()
+        if len(common) != 1:
+            return super()._match_state(probe, build, probe_side)
+        pk, bk = (lk, rk) if probe_side == 0 else (rk, lk)
+        ct = common[0]
+        np_dt = np.dtype(np.int32) if ct.is_string \
+            else np.dtype(ct.numpy_dtype)
+        floating = np.issubdtype(np_dt, np.floating)
+        if floating:
+            # floats ride as total-order int bit patterns (sign-magnitude
+            # flip) with -0.0 normalized to +0.0 and NaN canonicalized to
+            # one slot just under the sentinel — Spark's NaN==NaN join
+            # semantics via ordinary integer searchsorted
+            ik = np.dtype(np.int32) if np_dt.itemsize == 4 \
+                else np.dtype(np.int64)
+            sentinel = np.array(np.iinfo(ik).max, dtype=ik)
+        elif np.issubdtype(np_dt, np.integer):
+            ik = None
+            sentinel = np.array(np.iinfo(np_dt).max, dtype=np_dt)
+        else:  # bool / object-carried keys: keep the generic kernel
+            return super()._match_state(probe, build, probe_side)
+
+        def orderable(d):
+            if not floating:
+                return d
+            z = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+            b = jax.lax.bitcast_convert_type(z, ik)
+            mn = np.array(np.iinfo(ik).min, dtype=ik)
+            k = jnp.where(b < 0, ~b, b | mn)
+            return jnp.where(jnp.isnan(d),
+                             jnp.array(np.iinfo(ik).max - 1, dtype=ik), k)
+        fp = self._fingerprint() + f"|bfast{probe_side}"
+
+        def build_sort():
+            @jax.jit
+            def f(b_arrays, n_build):
+                b_cap = next(a[0].shape[0] for a in b_arrays
+                             if a is not None)
+                b_active = jnp.arange(b_cap, dtype=jnp.int32) < n_build
+                bctx = EvalContext(list(b_arrays), b_cap, active=b_active)
+                d, v = bk[0].eval(bctx)
+                if not ct.is_string:
+                    d = promote_physical(d, bk[0].dtype, ct)
+                d = orderable(d)
+                ok = b_active if v is None else (b_active & v)
+                n_valid = jnp.sum(ok.astype(jnp.int32))
+                # sort valid rows first (by flag, then key), then OVERWRITE
+                # the invalid tail with the sentinel so the array is
+                # globally sorted — a value sentinel alone would collide
+                # with legitimate keys equal to the dtype's max
+                perm = jnp.lexsort((d, ~ok))
+                d_sorted = jnp.where(
+                    jnp.arange(b_cap, dtype=jnp.int32) < n_valid,
+                    d[perm], sentinel)
+                return d_sorted, perm.astype(jnp.int32), n_valid
+            return f
+
+        cache = getattr(self, "_bfast_cache", None)
+        if cache is None or cache[0] != (probe_side, id(build)):
+            fn = _cached_program("bjoin-sort|" + fp, build_sort)
+            b_arrays = _dev_arrays(build)
+            b_arrays = encode_key_arrays(b_arrays, build, bk,
+                                         self.string_dicts)
+            sorted_keys, b_perm, n_valid = fn(b_arrays,
+                                              np.int32(build.num_rows))
+            cache = ((probe_side, id(build)), sorted_keys, b_perm, n_valid)
+            self._bfast_cache = cache
+        _, sorted_keys, b_perm, n_valid = cache
+
+        def build_probe():
+            @jax.jit
+            def g(p_arrays, sorted_keys, n_valid, n_probe):
+                p_cap = next(a[0].shape[0] for a in p_arrays
+                             if a is not None)
+                p_active = jnp.arange(p_cap, dtype=jnp.int32) < n_probe
+                pctx = EvalContext(list(p_arrays), p_cap, active=p_active)
+                d, v = pk[0].eval(pctx)
+                if not ct.is_string:
+                    d = promote_physical(d, pk[0].dtype, ct)
+                d = orderable(d)
+                p_ok = p_active if v is None else (p_active & v)
+                lo = jnp.searchsorted(sorted_keys, d, side="left")
+                hi = jnp.searchsorted(sorted_keys, d, side="right")
+                lo = jnp.minimum(lo, n_valid).astype(jnp.int32)
+                hi = jnp.minimum(hi, n_valid).astype(jnp.int32)
+                matches = jnp.where(p_ok, hi - lo, 0)
+                return lo, matches
+            return g
+
+        gfn = _cached_program("bjoin-probe|" + fp, build_probe)
+        p_arrays = _dev_arrays(probe)
+        p_arrays = encode_key_arrays(p_arrays, probe, pk, self.string_dicts)
+        lo, matches = gfn(p_arrays, sorted_keys, n_valid,
+                          np.int32(probe.num_rows))
+        return lo, matches, b_perm
+
     def node_desc(self):
         side = "left" if self.build_side == 0 else "right"
         kind = "NestedLoop" if self.how == "cross" else "Hash"
@@ -536,6 +639,21 @@ class BroadcastJoinExec(SortMergeJoinExec):
             bh.close()
 
 
+def _has_broadcast_hint(node) -> bool:
+    """True when the subtree carries a broadcast hint, looking through
+    row-shaping unary operators the user may have stacked above it
+    (Spark's ResolvedHint survives filters/projections the same way)."""
+    from . import logical as L
+    while node is not None:
+        if getattr(node, "broadcast_hint", False):
+            return True
+        if isinstance(node, (L.Filter, L.Project, L.Limit)) and node.children:
+            node = node.children[0]
+            continue
+        return False
+    return False
+
+
 def _legal_build_sides(how: str) -> tuple:
     """Sides that may be broadcast (must not be the row-preserving side).
     full outer never broadcasts; inner/cross are symmetric."""
@@ -557,8 +675,7 @@ def plan_broadcast_join(plan, left: TpuExec, right: TpuExec, conf,
     legal = _legal_build_sides(how)
     if not legal:
         return None
-    hints = [bool(getattr(plan.children[i], "broadcast_hint", False))
-             for i in (0, 1)]
+    hints = [_has_broadcast_hint(plan.children[i]) for i in (0, 1)]
     build_side = next((s for s in legal if hints[s]), None)
     if build_side is None:
         if any(hints):
@@ -580,14 +697,12 @@ def plan_broadcast_join(plan, left: TpuExec, right: TpuExec, conf,
 
 
 def _estimated_bytes(logical) -> Optional[float]:
+    from ..batch import estimated_row_bytes
     from .cbo import estimate_rows
     rows = estimate_rows(logical)
     if rows is None:
         return None
-    width = 0
-    for f in logical.schema():
-        width += 24 if f.dtype.is_string else 8
-    return rows * width
+    return rows * estimated_row_bytes(logical.schema())
 
 
 # ---------------------------------------------------------------------------------

@@ -23,7 +23,7 @@ from ..config import TpuConf
 from ..exprs import BoundReference, Expression, bind
 from . import logical as L
 from .physical import AggregateExec, ScanExec, StageExec, TpuExec
-from .planner import _bind_project, strip_alias, to_physical
+from .planner import _bind_project, strip_alias
 
 __all__ = ["apply_overrides", "explain_plan", "NodeMeta"]
 

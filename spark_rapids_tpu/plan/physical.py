@@ -52,7 +52,8 @@ class ExecContext:
 
     def metric_set(self, op_id: str) -> MetricSet:
         if op_id not in self.metrics:
-            self.metrics[op_id] = MetricSet(op_id)
+            self.metrics[op_id] = MetricSet(
+                op_id, level=self.conf["spark.rapids.tpu.sql.metrics.level"])
         return self.metrics[op_id]
 
 
@@ -425,6 +426,14 @@ class AggregateExec(TpuExec):
         # transition pass anyway).
         from .coalesce import TargetSize
         if self.group_exprs and self.mode in ("complete", "partial"):
+            # host string columns make coalescing a net loss twice over:
+            # the concat itself is an O(rows) host copy per run, and the
+            # fresh column objects defeat the per-column dictionary-encode
+            # cache (_encode_string_keys) — per-batch grid/group passes
+            # cost the same total device time anyway
+            if any(f.dtype.is_string
+                   for f in self.children[0].output_schema):
+                return None
             return TargetSize(conf["spark.rapids.tpu.sql.batchSizeRows"])
         return None
 
@@ -689,6 +698,17 @@ class AggregateExec(TpuExec):
             and all(op in ("sum", "first", "last") for op in ops))
         grid_max = ctx.conf["spark.rapids.tpu.sql.agg.gridMaxGroups"]
 
+        def _grid_bound():
+            """Static live-row bound of a grid-path output (None = sort
+            path, unbounded): enables sync-free bounded compaction."""
+            dims = _grid_dims()
+            if dims is None:
+                return None
+            g = 1
+            for d in dims:
+                g *= (d + 1)
+            return g
+
         def _grid_dims():
             """Bucketed dictionary sizes, or None when the grid would be
             too large / dictionaries unavailable."""
@@ -754,9 +774,11 @@ class AggregateExec(TpuExec):
                     ok, ov, gmask = batch_group(arrays, batch.sel,
                                                 np.int32(batch.num_rows))
                     # group_reduce packs live groups at the front: a
-                    # slice-compact avoids a full sort+gather pass
+                    # slice-compact avoids a full sort+gather pass, and a
+                    # grid bound makes it sync-free entirely
                     part = batch_utils.compact_packed(
-                        self._to_buffer_batch(buffer_schema, ok, ov, gmask))
+                        self._to_buffer_batch(buffer_schema, ok, ov, gmask),
+                        bound=_grid_bound())
                 if part.num_rows == 0:
                     continue
                 out = self._finalize_grouped(part)
@@ -774,22 +796,109 @@ class AggregateExec(TpuExec):
             ok, ov, gmask = batch_group(arrays, b.sel, np.int32(b.num_rows))
             return self._to_buffer_batch(buffer_schema, ok, ov, gmask)
 
+        # Adaptive skip of partial aggregation for high-cardinality keys
+        # (GpuHashAggregateExec skipAggPassReductionRatio analog): a hash
+        # sample of the first batch estimates the reduction ratio with a
+        # cheap-to-compile elementwise program; when grouping barely
+        # shrinks the data, every batch streams keys + per-row buffer
+        # contributions to the exchange unreduced — the expensive sort
+        # program never even compiles.
+        skip_ratio = ctx.conf["spark.rapids.tpu.sql.agg.skipPartialAggRatio"]
+        decide = self.mode == "partial" and skip_ratio < 1.0
+        pass_through = False
+        first = True
+
+        def build_pt():
+            @jax.jit
+            def f(arrays, sel, num_rows):
+                cap = next(a[0].shape[0] for a in arrays if a is not None)
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(arrays, cap, active=active)
+                keys = key_eval(ectx)
+                contribs = update(ectx)
+                return tuple(keys), tuple(contribs), active
+            return f
+
         pending: Optional[ColumnBatch] = None
         for batch in child.execute(ctx):
+            out_now = None
             with m.time("opTime"):
                 batch = self._encode_string_keys(batch, ctx)
-                for part in with_retry(ctx, batch, run_one):
-                    if pending is None:
-                        pending = batch_utils.compact_packed(part)
-                    else:
-                        pending = self._merge_partials(pending, part, ops,
-                                                       n_keys)
+                if decide and first:
+                    first = False
+                    ratio = self._sample_group_ratio(batch, key_eval)
+                    pass_through = ratio > skip_ratio
+                    if pass_through:
+                        m.add("skippedPartialAgg", 1)
+                if pass_through:
+                    pt = _cached_program(
+                        "agg-pt|" + self._fingerprint(), build_pt)
+                    arrays = tuple(
+                        (c.data, c.valid) if isinstance(c, DeviceColumn)
+                        else None for c in batch.columns)
+                    ks, cs, active = pt(arrays, batch.sel,
+                                        np.int32(batch.num_rows))
+                    out_now = self._to_buffer_batch(
+                        buffer_schema, list(ks), list(cs), active)
+                else:
+                    for part in with_retry(ctx, batch, run_one):
+                        gb = _grid_bound()
+                        if pending is None:
+                            pending = batch_utils.compact_packed(part,
+                                                                 bound=gb)
+                        else:
+                            pending = self._merge_partials(
+                                pending, part, ops, n_keys, bound=gb)
+            if out_now is not None:
+                m.add("numOutputRows", out_now.num_rows)
+                yield out_now
+        if pass_through:
+            return
         if pending is None:
             yield ColumnBatch(self._schema, self._empty_cols(), 0)
             return
         out = self._finalize_grouped(pending) if self.mode != "partial" else pending
         m.add("numOutputRows", out.num_rows)
         yield out
+
+    def _sample_group_ratio(self, batch: ColumnBatch, key_eval) -> float:
+        """distinct/live ratio of the group keys over a prefix sample,
+        via one murmur3 hash pass + host unique (collisions negligible for
+        a heuristic).  Costs one small fetch; the program compiles in
+        milliseconds (elementwise only)."""
+        from ..batch import bucket_capacity
+        from ..ops.hashing import hash_columns
+        srows = min(batch.num_rows, 1 << 18)
+        scap = min(bucket_capacity(srows), batch.capacity)
+
+        def build():
+            @jax.jit
+            def f(arrays, sel, num_rows):
+                cap = next(a[0].shape[0] for a in arrays if a is not None)
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(arrays, cap, active=active)
+                keys = key_eval(ectx)
+                return hash_columns(keys), active
+            return f
+
+        fn = _cached_program("agg-sample|" + self._fingerprint(), build)
+        arrays = tuple(
+            (c.data[:scap],
+             c.valid[:scap] if c.valid is not None else None)
+            if isinstance(c, DeviceColumn) else None
+            for c in batch.columns)
+        sel = batch.sel[:scap] if batch.sel is not None else None
+        h, active = fn(arrays, sel, np.int32(min(srows, scap)))
+        fetched = jax.device_get({"h": h, "a": active})
+        live = fetched["a"]
+        hv = fetched["h"][live]
+        if hv.size == 0:
+            return 0.0
+        return float(len(np.unique(hv))) / float(hv.size)
 
     # -- string keys via dictionary codes (ops/strings.py) ------------------------
     def _string_key_refs(self):
@@ -805,8 +914,15 @@ class AggregateExec(TpuExec):
 
     def _encode_string_keys(self, batch: ColumnBatch, ctx) -> ColumnBatch:
         """Replace host string key columns with device int32 dictionary
-        codes (query-scoped incremental dictionary, shared with the partner
-        partial/final exec)."""
+        codes (incremental dictionary shared with the partner partial/final
+        exec so codes stay comparable across the exchange; ops/strings.py).
+
+        Encodings are cached ON the column object (immutable, and stable
+        across query runs when the scan's decoded-file cache serves the
+        same batch), and the query ADOPTS the first cached dictionary it
+        sees — repeat queries over cached scans skip the O(rows) host
+        encode and the device upload entirely (measured: Q1 @ SF1 warm
+        partial-agg 4.5s -> sub-second)."""
         refs = self._string_key_refs()
         if not refs:
             return batch
@@ -817,11 +933,23 @@ class AggregateExec(TpuExec):
             col = cols[ordn]
             if not isinstance(col, HostStringColumn):
                 continue  # already encoded (or device data)
-            d = self.string_dicts.setdefault(gi, StringDictionary())
-            codes, valid = d.encode(col.array)
-            jcodes = jax.device_put(codes, ctx.device)
-            jvalid = (jax.device_put(valid, ctx.device)
-                      if valid is not None else None)
+            d = self.string_dicts.get(gi)
+            cached = getattr(col, "_enc_cache", None)
+            if d is None and cached is not None:
+                # adopt the column's existing dictionary for this query
+                d, jcodes, jvalid = cached
+                self.string_dicts[gi] = d
+            elif d is not None and cached is not None and cached[0] is d:
+                _, jcodes, jvalid = cached
+            else:
+                if d is None:
+                    d = StringDictionary()
+                    self.string_dicts[gi] = d
+                codes, valid = d.encode(col.array)
+                jcodes = jax.device_put(codes, ctx.device)
+                jvalid = (jax.device_put(valid, ctx.device)
+                          if valid is not None else None)
+                col._enc_cache = (d, jcodes, jvalid)
             cols[ordn] = DeviceColumn(T.STRING, jcodes, jvalid)
             changed = True
         if not changed:
@@ -878,14 +1006,21 @@ class AggregateExec(TpuExec):
         cap = cols[0].capacity
         return ColumnBatch(schema, cols, cap, gmask)
 
-    def _merge_partials(self, a: ColumnBatch, b: ColumnBatch, ops, n_keys):
-        """Concat partial results and re-reduce (concat-merge loop)."""
+    def _merge_partials(self, a: ColumnBatch, b: ColumnBatch, ops, n_keys,
+                        bound=None):
+        """Concat partial results and re-reduce (concat-merge loop).
+
+        ``b`` arrives at the INPUT batch's full capacity with live groups
+        packed at the front (group_reduce contract) — compact it first or
+        the concat+re-reduce runs over millions of dead rows per merge
+        (measured: Q1 @ SF1 spent ~3s here)."""
+        b = batch_utils.compact_packed(b, bound=bound)
         both = batch_utils.concat_batches([a, b])
         arrays = tuple((c.data, c.valid) for c in both.columns)
         merge = _merge_fn(tuple(ops), n_keys)
         ok, ov, gmask = merge(arrays, both.sel, np.int32(both.num_rows))
         merged = self._to_buffer_batch(both.schema, list(ok), list(ov), gmask)
-        return batch_utils.compact_packed(merged)
+        return batch_utils.compact_packed(merged, bound=bound)
 
     def _finalize_grouped(self, pending: ColumnBatch) -> ColumnBatch:
         n_keys = len(self.group_exprs)

@@ -1,24 +1,18 @@
-"""Logical → physical conversion (direct path).
+"""Expression binding helpers shared by the planner (overrides.py).
 
-This is the conversion half of the reference's planner
-(GpuOverrides.doConvertPlan, GpuOverrides.scala:4192): project/filter chains
-fuse into a single StageExec (whole-stage XLA program), aggregates become
-AggregateExec, scans become ScanExec.  The tagging half — TypeSig checks,
-CPU-fallback with reasons, explain — lives in overrides.py and runs before
-this conversion.
+The actual logical→physical conversion is overrides._convert
+(GpuOverrides.doConvertPlan analog, GpuOverrides.scala:4192); this module
+holds the pieces both binding-time and conversion-time code need.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from ..batch import Field, Schema
-from ..config import TpuConf
-from ..exprs import AggregateExpression, BoundReference, Expression, bind
-from . import logical as L
-from .physical import AggregateExec, ScanExec, StageExec, TpuExec
+from ..exprs import BoundReference, Expression, bind
 
-__all__ = ["to_physical", "strip_alias"]
+__all__ = ["strip_alias"]
 
 
 def strip_alias(e: Expression) -> Expression:
@@ -46,88 +40,3 @@ def _bind_project(exprs, schema: Schema):
             triples.append((name, b, None))
             fields.append(Field(name, b.dtype, b.nullable))
     return triples, Schema(fields)
-
-
-def to_physical(plan: L.LogicalPlan, conf: Optional[TpuConf] = None) -> TpuExec:
-    conf = conf or TpuConf()
-
-    if isinstance(plan, (L.Project, L.Filter)):
-        chain: List[L.LogicalPlan] = []
-        node = plan
-        while isinstance(node, (L.Project, L.Filter)):
-            chain.append(node)
-            node = node.children[0]
-        child_phys = to_physical(node, conf)
-        schema = child_phys.output_schema
-        steps: List[Tuple[str, object]] = []
-        for ln in reversed(chain):
-            if isinstance(ln, L.Filter):
-                steps.append(("filter", bind(ln.condition, schema)))
-            else:
-                triples, schema = _bind_project(ln.exprs, schema)
-                steps.append(("project", triples))
-        return StageExec(child_phys, steps, schema)
-
-    if isinstance(plan, L.LogicalScan):
-        return ScanExec(plan.schema(), plan.source_factory, plan.desc)
-
-    if isinstance(plan, L.Aggregate):
-        child_phys = to_physical(plan.children[0], conf)
-        schema = child_phys.output_schema
-        group_bound = [(n, bind(e, schema)) for n, e in plan.group_exprs]
-        agg_bound = []
-        for n, e in plan.agg_exprs:
-            b = strip_alias(bind(e, schema))
-            if not isinstance(b, AggregateExpression):
-                raise NotImplementedError(
-                    f"aggregate expression {n} must be a plain aggregate "
-                    f"function call for now (got {b.fingerprint()})")
-            agg_bound.append((n, b))
-        return AggregateExec(child_phys, group_bound, agg_bound, mode="complete")
-
-    if isinstance(plan, L.Distinct):
-        child_phys = to_physical(plan.children[0], conf)
-        schema = child_phys.output_schema
-        group_bound = [(f.name, BoundReference(i, f.dtype, f.nullable, f.name))
-                       for i, f in enumerate(schema)]
-        return AggregateExec(child_phys, group_bound, [], mode="complete")
-
-    if isinstance(plan, L.Sort):
-        from .exec_nodes import SortExec
-        child_phys = to_physical(plan.children[0], conf)
-        schema = child_phys.output_schema
-        orders = [(bind(o.expr, schema), o.ascending, o.nulls_first)
-                  for o in plan.orders]
-        return SortExec(child_phys, orders)
-
-    if isinstance(plan, L.Limit):
-        from .exec_nodes import LimitExec
-        child_phys = to_physical(plan.children[0], conf)
-        return LimitExec(child_phys, plan.n, plan.offset)
-
-    if isinstance(plan, L.Union):
-        from .exec_nodes import UnionExec
-        return UnionExec([to_physical(c, conf) for c in plan.children])
-
-    if isinstance(plan, L.LogicalRange):
-        from .exec_nodes import RangeExec
-        return RangeExec(plan.start, plan.end, plan.step,
-                         conf["spark.rapids.tpu.sql.batchSizeRows"])
-
-    if isinstance(plan, L.Join):
-        from .exec_nodes import plan_join
-        left = to_physical(plan.children[0], conf)
-        right = to_physical(plan.children[1], conf)
-        return plan_join(plan, left, right, conf)
-
-    if isinstance(plan, L.Expand):
-        from .exec_nodes import ExpandExec
-        child_phys = to_physical(plan.children[0], conf)
-        schema = child_phys.output_schema
-        projections = []
-        for proj in plan.projections:
-            triples, out_schema = _bind_project(proj, schema)
-            projections.append(triples)
-        return ExpandExec(child_phys, projections, plan.schema())
-
-    raise NotImplementedError(f"no physical plan for {type(plan).__name__}")

@@ -68,6 +68,18 @@ class Session:
     def _tpu_conf(self) -> TpuConf:
         return TpuConf(self._settings)
 
+    def _clamp_reader_rows(self, src):
+        """spark.rapids.tpu.sql.reader.batchSizeBytes: soft byte cap on one
+        scan batch, applied as a row clamp via the schema's estimated row
+        width (the source's with_pushdown rebuilds inherit it)."""
+        byte_cap = self._tpu_conf()[
+            "spark.rapids.tpu.sql.reader.batchSizeBytes"]
+        if byte_cap > 0:
+            from ..batch import estimated_row_bytes
+            width = estimated_row_bytes(src.schema())
+            src.batch_rows = max(1, min(src.batch_rows, byte_cap // width))
+        return src
+
     # -- data sources -------------------------------------------------------------
     def read_parquet(self, path, columns=None) -> DataFrame:
         from ..io.parquet import ParquetSource
@@ -82,6 +94,7 @@ class Session:
                 "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
             cache_bytes=cache_bytes,
             exact_filter=conf["spark.rapids.tpu.sql.scan.exactFilterPushdown"])
+        src = self._clamp_reader_rows(src)
         node = L.LogicalScan(src.schema(), src, src.describe(), fmt="parquet")
         node.source = src
         return DataFrame(node, self)
@@ -93,6 +106,7 @@ class Session:
                   num_threads=conf[
                       "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
                   **options)
+        src = self._clamp_reader_rows(src)
         node = L.LogicalScan(src.schema(), src, src.describe(), fmt=src.fmt)
         node.source = src
         return DataFrame(node, self)
@@ -140,6 +154,7 @@ class Session:
             batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"],
             num_threads=conf[
                 "spark.rapids.tpu.sql.multiThreadedRead.numThreads"])
+        src = self._clamp_reader_rows(src)
         node = L.LogicalScan(src.schema(), src, src.describe(),
                              fmt="iceberg")
         node.source = src
@@ -159,6 +174,7 @@ class Session:
                 "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
             cache_bytes=cache_bytes,
             exact_filter=conf["spark.rapids.tpu.sql.scan.exactFilterPushdown"])
+        src = self._clamp_reader_rows(src)
         node = L.LogicalScan(src.schema(), src, src.describe(), fmt="delta")
         node.source = src
         return DataFrame(node, self)

@@ -20,10 +20,17 @@ __all__ = ["MetricSet", "TaskMetrics", "trace_range"]
 
 
 class MetricSet:
-    """Named counters/timers for one operator instance."""
+    """Named counters/timers for one operator instance.
 
-    def __init__(self, op_id: str):
+    ``level`` mirrors spark.rapids.tpu.sql.metrics.level (GpuMetric's
+    ESSENTIAL/MODERATE/DEBUG): ESSENTIAL records counters only (timers are
+    no-ops), MODERATE (default) adds wall-clock timers, DEBUG additionally
+    emits jax profiler trace ranges so operator spans land in TPU profiles.
+    """
+
+    def __init__(self, op_id: str, level: str = "MODERATE"):
         self.op_id = op_id
+        self.level = level
         self.values: Dict[str, float] = defaultdict(float)
 
     def add(self, name: str, amount: float) -> None:
@@ -31,8 +38,14 @@ class MetricSet:
 
     @contextlib.contextmanager
     def time(self, name: str):
+        if self.level == "ESSENTIAL":
+            yield
+            return
         t0 = time.perf_counter()
-        with trace_range(f"{self.op_id}:{name}"):
+        if self.level == "DEBUG":
+            with trace_range(f"{self.op_id}:{name}"):
+                yield
+        else:
             yield
         self.values[name] += time.perf_counter() - t0
 

@@ -198,6 +198,56 @@ def test_broadcast_with_agg_above(sess, rng):
     _differential(df, sess)
 
 
+def test_fast_path_max_key_with_null_build_row(sess):
+    """A legitimate key equal to the dtype max must not collide with the
+    fast path's invalid-row sentinel (wrong-results corner found in
+    review): the null-key build row must never match, the INT64_MAX row
+    must."""
+    big = np.iinfo(np.int64).max
+    build = pa.table({"k": pa.array([None, big, 5], type=pa.int64()),
+                      "b": pa.array([100, 200, 300], type=pa.int64())})
+    probe = pa.table({"k": pa.array([big, 5, None, 7], type=pa.int64()),
+                      "a": pa.array([1, 2, 3, 4], type=pa.int64())})
+    dp = sess.create_dataframe(probe)
+    db = sess.create_dataframe(build)
+    j = dp.join(F.broadcast(db), on="k", how="left")
+    rows = sorted(j.collect(), key=lambda r: (r[1]))
+    # (k, a, b): big->200, 5->300, None->null, 7->null
+    assert rows[0][1] == 1 and rows[0][2] == 200
+    assert rows[1][1] == 2 and rows[1][2] == 300
+    assert rows[2][1] == 3 and rows[2][2] is None
+    assert rows[3][1] == 4 and rows[3][2] is None
+
+
+def test_fast_path_nan_keys(sess):
+    """NaN == NaN in join keys (Spark semantics) through the sorted-build
+    searchsorted kernel."""
+    nan = float("nan")
+    build = pa.table({"k": pa.array([nan, 2.0, -0.0]),
+                      "b": pa.array([10, 20, 30], type=pa.int64())})
+    probe = pa.table({"k": pa.array([nan, 0.0, 9.0]),
+                      "a": pa.array([1, 2, 3], type=pa.int64())})
+    j = sess.create_dataframe(probe).join(
+        F.broadcast(sess.create_dataframe(build)), on="k", how="left")
+    rows = sorted(j.collect(), key=lambda r: r[1])
+    assert rows[0][2] == 10   # NaN matched NaN
+    assert rows[1][2] == 30   # 0.0 matched -0.0
+    assert rows[2][2] is None
+
+
+def test_hint_through_filter_above(sess, rng):
+    """df.hint('broadcast').filter(...) keeps the hint (ResolvedHint
+    survives row-shaping operators in Spark)."""
+    dim, fact = _tables(rng)
+    dd, dfc = sess.create_dataframe(dim), sess.create_dataframe(fact)
+    sess.conf.set(THRESH, -1)
+    hinted = dd.hint("broadcast").filter(F.col("d_key") >= 10)
+    j = dfc.join(hinted, [("f_key", "d_key")], "inner")
+    phys = sess._plan_physical(j._plan)
+    sess.conf.set(THRESH, 10 * 1024 * 1024)
+    assert "TpuBroadcastHashJoin" in phys.tree_string()
+
+
 def test_empty_build_side(sess, rng):
     dim = pa.table({"d_key": pa.array([], type=pa.int64()),
                     "d_cat": pa.array([], type=pa.string())})
