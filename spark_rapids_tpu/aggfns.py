@@ -30,7 +30,8 @@ from .exprs import AggregateExpression, EvalContext, Expression, Value
 
 __all__ = ["Sum", "Count", "CountStar", "Min", "Max", "Average", "First", "Last",
            "VariancePop", "VarianceSamp", "StddevPop", "StddevSamp",
-           "CovarPop", "CovarSamp", "Corr", "Percentile", "AGG_CLASSES"]
+           "CovarPop", "CovarSamp", "Corr", "Percentile",
+           "ApproxPercentile", "AGG_CLASSES"]
 
 
 def _ones(ctx: EvalContext):
@@ -405,6 +406,77 @@ class Percentile(AggregateExpression):
         return f"{self.func}:{self.q}:{self.dtype}"
 
 
+class ApproxPercentile(AggregateExpression):
+    """approx_percentile via a MOMENTS SKETCH (Gan et al., SIGMOD'18):
+    buffers = [n, Σx, Σx², Σx³, Σx⁴, min, max] — every one reduces with
+    sum/min/max, so the sketch merges through the two-phase exchange
+    exactly like the reference's t-digest buffers
+    (GpuApproximatePercentile.scala).  finalize estimates the quantile
+    with a Cornish-Fisher expansion from the standardized moments,
+    clamped to the observed [min, max].  Accuracy is distributional (good
+    for smooth data), not rank-bounded like t-digest — documented in
+    supported_ops.
+    """
+
+    func = "approx_percentile"
+
+    def __init__(self, child: Expression, q: float, accuracy: int = 10000):
+        self.q = float(q)
+        self.accuracy = int(accuracy)
+        super().__init__(child)
+
+    def _resolve(self):
+        self.dtype = T.FLOAT64
+        self.nullable = True
+
+    def _fp_extra(self):
+        return f"{self.func}:{self.q}:{self.dtype}"
+
+    def buffers(self):
+        return [(T.FLOAT64, "sum"), (T.FLOAT64, "sum"), (T.FLOAT64, "sum"),
+                (T.FLOAT64, "sum"), (T.FLOAT64, "sum"),
+                (T.FLOAT64, "min"), (T.FLOAT64, "max")]
+
+    def update(self, ctx) -> List[Value]:
+        d, v = self.children[0].eval(ctx)
+        x = d.astype(jnp.float64)
+        if self.children[0].dtype.is_decimal:
+            x = x / (10.0 ** self.children[0].dtype.scale)
+        m = _valid_indicator(v, ctx)
+        mf = m.astype(jnp.float64)
+        xz = jnp.where(m, x, 0.0)
+        return [
+            (mf, None), (xz, None), (xz * xz, None),
+            (xz * xz * xz, None), (xz * xz * xz * xz, None),
+            (x, v), (x, v),
+        ]
+
+    def finalize(self, values: List[Value]) -> Value:
+        (n, _), (s1, _), (s2, _), (s3, _), (s4, _), (mn, mnv), (mx, mxv) \
+            = values
+        has = n > 0
+        nn = jnp.where(has, n, 1.0)
+        mean = s1 / nn
+        var = jnp.maximum(s2 / nn - mean * mean, 0.0)
+        sd = jnp.sqrt(var)
+        sd_safe = jnp.where(sd > 0, sd, 1.0)
+        m3 = s3 / nn - 3 * mean * s2 / nn + 2 * mean ** 3
+        m4 = (s4 / nn - 4 * mean * s3 / nn + 6 * mean ** 2 * s2 / nn
+              - 3 * mean ** 4)
+        skew = jnp.where(sd > 0, m3 / sd_safe ** 3, 0.0)
+        kurt = jnp.where(sd > 0, m4 / sd_safe ** 4 - 3.0, 0.0)
+        # Cornish-Fisher: z adjusted by skewness and excess kurtosis
+        from jax.scipy.stats import norm
+        z = norm.ppf(jnp.clip(self.q, 1e-9, 1 - 1e-9))
+        zc = (z + (z * z - 1) * skew / 6.0
+              + (z ** 3 - 3 * z) * kurt / 24.0
+              - (2 * z ** 3 - 5 * z) * (skew ** 2) / 36.0)
+        est = mean + sd * zc
+        est = jnp.clip(est, mn, mx)
+        valid = has if mnv is None else (has & mnv)
+        return est, valid
+
+
 class CollectList(AggregateExpression):
     """collect_list: group values into an ARRAY column (AggregateFunctions
     .scala GpuCollectList).  Like Percentile it needs materialized groups —
@@ -431,5 +503,6 @@ class CollectSet(CollectList):
 AGG_CLASSES = {c.func: c for c in
                [Sum, Count, CountStar, Min, Max, Average, First, Last,
                 VariancePop, VarianceSamp, StddevPop, StddevSamp,
-                CovarPop, CovarSamp, Corr, Percentile, CollectList,
+                CovarPop, CovarSamp, Corr, Percentile, ApproxPercentile,
+                CollectList,
                 CollectSet]}

@@ -272,6 +272,10 @@ def _arrow_to_logical(pa_type) -> DataType:
         return T.decimal(pa_type.precision, pa_type.scale)
     if pa.types.is_list(pa_type) or pa.types.is_large_list(pa_type):
         return T.array(_arrow_to_logical(pa_type.value_type))
+    if pa.types.is_struct(pa_type):
+        return T.struct([(pa_type.field(i).name,
+                          _arrow_to_logical(pa_type.field(i).type))
+                         for i in range(pa_type.num_fields)])
     raise TypeError(f"unsupported arrow type {pa_type}")
 
 
@@ -287,6 +291,9 @@ def logical_to_arrow(dt: DataType):
         return pa.decimal128(dt.precision, dt.scale)
     if dt.kind == T.TypeKind.ARRAY:
         return pa.list_(logical_to_arrow(dt.element))
+    if dt.kind == T.TypeKind.STRUCT:
+        return pa.struct([pa.field(n, logical_to_arrow(t))
+                          for n, t in dt.fields])
     return m[dt]
 
 

@@ -1,0 +1,571 @@
+"""Collection (ARRAY/STRUCT) and JSON expression library.
+
+Analog of the reference's ``complexTypeCreator.scala``,
+``complexTypeExtractors.scala``, ``collectionOperations.scala``,
+``GpuGetJsonObject.scala`` and ``GpuJsonToStructs.scala``.  Nested values
+live host-side in this engine (ARRAY/STRUCT columns ride as arrow host
+columns — batch.py), so these classes evaluate on the host through the
+same lowering that serves string expressions (plan/stringpred.py): inside
+fused device stages they become computed host columns or typed extras;
+outside stages the planner routes their operator to the CPU path.
+
+Null semantics follow Spark: NULL input → NULL output unless a class
+overrides (``size(NULL) = -1``, ``array_contains`` 3-valued logic,
+``array()`` keeps NULL elements).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import types as T
+from .exprs import Expression, Literal, Value
+
+__all__ = [
+    "CreateArray", "CreateStruct", "GetStructField", "GetArrayItem",
+    "ElementAt", "Size", "ArrayContains", "SortArray", "ArrayDistinct",
+    "ArrayMin", "ArrayMax", "ArrayPosition", "Slice", "Flatten",
+    "ArrayJoin", "ArrayUnion", "ArrayIntersect", "ArrayExcept",
+    "GetJsonObject", "FromJson", "ToJson",
+]
+
+
+def _obj(n: int) -> np.ndarray:
+    return np.empty(n, dtype=object)
+
+
+def _valid_of(d: np.ndarray, v: Optional[np.ndarray], n: int) -> np.ndarray:
+    base = np.ones(n, dtype=bool) if v is None else np.asarray(v, bool).copy()
+    if d.dtype == object:
+        base &= np.array([x is not None for x in d], dtype=bool)
+    return base
+
+
+def _py(x):
+    """numpy scalar → python value (arrow coercion expects plain types)."""
+    return x.item() if isinstance(x, np.generic) else x
+
+
+class CollectionExpression(Expression):
+    """Base: host-only evaluation (the output — or at least one input —
+    has no device representation)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+        if all(c.resolved() for c in children):
+            self._rebind()
+
+    def _rebind(self):
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        raise NotImplementedError(
+            f"{type(self).__name__} evaluates on the host path")
+
+    # null-safe scalar kernel: called only when every input is valid
+    def _apply(self, *vals):
+        raise NotImplementedError
+
+    def eval_host(self, ev, n) -> Value:
+        evald = [ev(c) for c in self.children]
+        valid = np.ones(n, dtype=bool)
+        for d, v in evald:
+            valid &= _valid_of(d, v, n)
+        out = _obj(n)
+        ok = valid.copy()
+        for i in range(n):
+            if not valid[i]:
+                out[i] = None
+                continue
+            r = self._apply(*[_py(d[i]) for d, _ in evald])
+            if r is None:
+                ok[i] = False
+                out[i] = None
+            else:
+                out[i] = r
+        if not self.dtype.is_host_carried:
+            dense = np.zeros(n, dtype=self.dtype.numpy_dtype)
+            for i in range(n):
+                if ok[i]:
+                    dense[i] = out[i]
+            return dense, (None if ok.all() else ok)
+        return out, (None if ok.all() else ok)
+
+    def _fp_extra(self):
+        return str(self.dtype)
+
+
+# ---------------------------------------------------------------------------------
+# creators (complexTypeCreator.scala)
+# ---------------------------------------------------------------------------------
+
+class CreateArray(CollectionExpression):
+    """array(e1, e2, ...) — keeps NULL elements; result itself non-null."""
+
+    def _rebind(self):
+        dt = self.children[0].dtype if self.children else T.STRING
+        for c in self.children[1:]:
+            dt = T.common_type(dt, c.dtype)
+        self.dtype = T.array(dt)
+        self.nullable = False
+
+    def eval_host(self, ev, n) -> Value:
+        evald = [ev(c) for c in self.children]
+        valids = [_valid_of(d, v, n) for d, v in evald]
+        out = _obj(n)
+        for i in range(n):
+            out[i] = [(_py(d[i]) if vv[i] else None)
+                      for (d, _), vv in zip(evald, valids)]
+        return out, None
+
+
+class CreateStruct(CollectionExpression):
+    """struct/named_struct: field values become a STRUCT row dict."""
+
+    def __init__(self, names: List[str], *children: Expression):
+        self.names = list(names)
+        super().__init__(*children)
+
+    def _rebind(self):
+        self.dtype = T.struct(
+            [(nm, c.dtype) for nm, c in zip(self.names, self.children)])
+        self.nullable = False
+
+    def _fp_extra(self):
+        return ",".join(self.names)
+
+    def eval_host(self, ev, n) -> Value:
+        evald = [ev(c) for c in self.children]
+        valids = [_valid_of(d, v, n) for d, v in evald]
+        out = _obj(n)
+        for i in range(n):
+            out[i] = {nm: (_py(d[i]) if vv[i] else None)
+                      for nm, (d, _), vv in zip(self.names, evald, valids)}
+        return out, None
+
+
+# ---------------------------------------------------------------------------------
+# extractors (complexTypeExtractors.scala)
+# ---------------------------------------------------------------------------------
+
+class GetStructField(CollectionExpression):
+    def __init__(self, child: Expression, field: str):
+        self.field = field
+        super().__init__(child)
+
+    def _rebind(self):
+        st = self.children[0].dtype
+        for nm, dt in (st.fields or []):
+            if nm == self.field:
+                self.dtype = dt
+                break
+        else:
+            raise ValueError(f"no field {self.field!r} in {st}")
+        self.nullable = True
+
+    def _fp_extra(self):
+        return self.field
+
+    def _apply(self, row):
+        return row.get(self.field) if isinstance(row, dict) else None
+
+
+class GetArrayItem(CollectionExpression):
+    """arr[i] — 0-based; NULL when out of bounds (non-ANSI)."""
+
+    def _rebind(self):
+        self.dtype = self.children[0].dtype.element
+        self.nullable = True
+
+    def _apply(self, arr, idx):
+        i = int(idx)
+        if i < 0 or i >= len(arr):
+            return None
+        return arr[i]
+
+
+class ElementAt(CollectionExpression):
+    """element_at(arr, i) — 1-based; negative counts from the end."""
+
+    def _rebind(self):
+        self.dtype = self.children[0].dtype.element
+        self.nullable = True
+
+    def _apply(self, arr, idx):
+        i = int(idx)
+        if i == 0 or abs(i) > len(arr):
+            return None
+        return arr[i - 1] if i > 0 else arr[i]
+
+
+class Size(CollectionExpression):
+    """size(arr) — -1 for NULL input (Spark legacy default)."""
+
+    def _rebind(self):
+        self.dtype = T.INT32
+        self.nullable = False
+
+    def eval_host(self, ev, n) -> Value:
+        d, v = ev(self.children[0])
+        valid = _valid_of(d, v, n)
+        out = np.full(n, -1, dtype=np.int32)
+        for i in range(n):
+            if valid[i]:
+                out[i] = len(d[i])
+        return out, None
+
+
+# ---------------------------------------------------------------------------------
+# collection operations (collectionOperations.scala)
+# ---------------------------------------------------------------------------------
+
+class ArrayContains(CollectionExpression):
+    """3-valued: false; true if found; NULL if not found but arr has NULLs
+    (or the search value is NULL)."""
+
+    def _rebind(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = True
+
+    def eval_host(self, ev, n) -> Value:
+        (ad, av), (vd, vv) = [ev(c) for c in self.children]
+        a_ok = _valid_of(ad, av, n)
+        v_ok = _valid_of(vd, vv, n) if vd.dtype == object else (
+            np.ones(n, bool) if vv is None else np.asarray(vv, bool))
+        out = np.zeros(n, dtype=bool)
+        ok = np.ones(n, dtype=bool)
+        for i in range(n):
+            if not a_ok[i] or not v_ok[i]:
+                ok[i] = False
+                continue
+            arr, val = ad[i], _py(vd[i])
+            if any(x is not None and x == val for x in arr):
+                out[i] = True
+            elif any(x is None for x in arr):
+                ok[i] = False
+        return out, (None if ok.all() else ok)
+
+
+class SortArray(CollectionExpression):
+    def __init__(self, child: Expression, asc: bool = True):
+        self.asc = asc
+        super().__init__(child)
+
+    def _rebind(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _fp_extra(self):
+        return str(self.asc)
+
+    def _apply(self, arr):
+        # Spark: NULLs first ascending, last descending
+        nn = sorted((x for x in arr if x is not None), reverse=not self.asc)
+        nulls = [None] * (len(arr) - len(nn))
+        return nulls + nn if self.asc else nn + nulls
+
+
+class ArrayDistinct(CollectionExpression):
+    def _rebind(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _apply(self, arr):
+        seen, out = set(), []
+        saw_null = False
+        for x in arr:
+            if x is None:
+                if not saw_null:
+                    saw_null = True
+                    out.append(None)
+            elif x not in seen:
+                seen.add(x)
+                out.append(x)
+        return out
+
+
+class ArrayMin(CollectionExpression):
+    def _rebind(self):
+        self.dtype = self.children[0].dtype.element
+        self.nullable = True
+
+    def _apply(self, arr):
+        vals = [x for x in arr if x is not None]
+        return min(vals) if vals else None
+
+
+class ArrayMax(ArrayMin):
+    def _apply(self, arr):
+        vals = [x for x in arr if x is not None]
+        return max(vals) if vals else None
+
+
+class ArrayPosition(CollectionExpression):
+    """1-based index of first match; 0 when absent (long)."""
+
+    def _rebind(self):
+        self.dtype = T.INT64
+        self.nullable = True
+
+    def _apply(self, arr, val):
+        for i, x in enumerate(arr):
+            if x is not None and x == val:
+                return i + 1
+        return 0
+
+
+class Slice(CollectionExpression):
+    """slice(arr, start, length) — 1-based; negative start from the end."""
+
+    def _rebind(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = True
+
+    def _apply(self, arr, start, length):
+        s, ln = int(start), int(length)
+        if s == 0 or ln < 0:
+            return None  # Spark raises; non-ANSI engines null out
+        i = s - 1 if s > 0 else len(arr) + s
+        if i < 0:
+            return []
+        return arr[i: i + ln]
+
+
+class Flatten(CollectionExpression):
+    def _rebind(self):
+        self.dtype = self.children[0].dtype.element
+        self.nullable = True
+
+    def _apply(self, arr):
+        out = []
+        for sub in arr:
+            if sub is None:
+                return None  # Spark: null sub-array → null result
+            out.extend(sub)
+        return out
+
+
+class ArrayJoin(CollectionExpression):
+    def __init__(self, child: Expression, delimiter: str,
+                 null_replacement: Optional[str] = None):
+        self.delimiter = delimiter
+        self.null_replacement = null_replacement
+        super().__init__(child)
+
+    def _rebind(self):
+        self.dtype = T.STRING
+        self.nullable = True
+
+    def _fp_extra(self):
+        return f"{self.delimiter!r},{self.null_replacement!r}"
+
+    def _apply(self, arr):
+        parts = []
+        for x in arr:
+            if x is None:
+                if self.null_replacement is not None:
+                    parts.append(self.null_replacement)
+            else:
+                parts.append(str(x))
+        return self.delimiter.join(parts)
+
+
+class _ArraySetOp(CollectionExpression):
+    def _rebind(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = any(c.nullable for c in self.children)
+
+
+class ArrayUnion(_ArraySetOp):
+    def _apply(self, a, b):
+        out, seen, saw_null = [], set(), False
+        for x in list(a) + list(b):
+            if x is None:
+                if not saw_null:
+                    saw_null = True
+                    out.append(None)
+            elif x not in seen:
+                seen.add(x)
+                out.append(x)
+        return out
+
+
+class ArrayIntersect(_ArraySetOp):
+    def _apply(self, a, b):
+        bs = {x for x in b if x is not None}
+        b_null = any(x is None for x in b)
+        out, seen, saw_null = [], set(), False
+        for x in a:
+            if x is None:
+                if b_null and not saw_null:
+                    saw_null = True
+                    out.append(None)
+            elif x in bs and x not in seen:
+                seen.add(x)
+                out.append(x)
+        return out
+
+
+class ArrayExcept(_ArraySetOp):
+    def _apply(self, a, b):
+        bs = {x for x in b if x is not None}
+        b_null = any(x is None for x in b)
+        out, seen, saw_null = [], set(), False
+        for x in a:
+            if x is None:
+                if not b_null and not saw_null:
+                    saw_null = True
+                    out.append(None)
+            elif x not in bs and x not in seen:
+                seen.add(x)
+                out.append(x)
+        return out
+
+
+# ---------------------------------------------------------------------------------
+# JSON (GpuGetJsonObject.scala, GpuJsonToStructs.scala)
+# ---------------------------------------------------------------------------------
+
+def _json_path_steps(path: str):
+    """Parse a $.a.b[0] JsonPath subset into access steps."""
+    if not path.startswith("$"):
+        return None
+    steps = []
+    i = 1
+    while i < len(path):
+        ch = path[i]
+        if ch == ".":
+            j = i + 1
+            while j < len(path) and path[j] not in ".[":
+                j += 1
+            if j == i + 1:
+                return None
+            steps.append(("key", path[i + 1: j]))
+            i = j
+        elif ch == "[":
+            j = path.index("]", i)
+            idx = path[i + 1: j].strip()
+            if idx == "*":
+                steps.append(("wild",))
+            else:
+                steps.append(("idx", int(idx)))
+            i = j + 1
+        else:
+            return None
+    return steps
+
+
+class GetJsonObject(CollectionExpression):
+    """get_json_object(json_str, '$.path') → string (objects/arrays are
+    re-serialized as JSON, scalars returned raw)."""
+
+    def __init__(self, child: Expression, path: str):
+        self.path = path
+        self._steps = _json_path_steps(path)
+        super().__init__(child)
+
+    def _rebind(self):
+        self.dtype = T.STRING
+        self.nullable = True
+
+    def _fp_extra(self):
+        return self.path
+
+    @staticmethod
+    def _walk(cur, steps):
+        for si, step in enumerate(steps):
+            if cur is None:
+                return None
+            if step[0] == "key":
+                if not isinstance(cur, dict):
+                    return None
+                cur = cur.get(step[1])
+            elif step[0] == "idx":
+                if not isinstance(cur, list) or step[1] >= len(cur):
+                    return None
+                cur = cur[step[1]]
+            else:  # [*]: fan out the REMAINING steps over each element
+                if not isinstance(cur, list):
+                    return None
+                rest = steps[si + 1:]
+                vals = [GetJsonObject._walk(x, rest) for x in cur]
+                vals = [x for x in vals if x is not None]
+                return vals if vals else None
+        return cur
+
+    def _apply(self, s):
+        if self._steps is None:
+            return None
+        try:
+            cur = json.loads(s)
+        except (ValueError, TypeError):
+            return None
+        cur = self._walk(cur, self._steps)
+        if cur is None:
+            return None
+        if isinstance(cur, (dict, list)):
+            return json.dumps(cur, separators=(",", ":"))
+        if isinstance(cur, bool):
+            return "true" if cur else "false"
+        return str(cur)
+
+
+def _coerce_json(value, dt: T.DataType):
+    """JSON value → typed python value per the target schema (bad shapes
+    become NULL, as Spark's PERMISSIVE mode does)."""
+    if value is None:
+        return None
+    if dt.kind == T.TypeKind.STRUCT:
+        if not isinstance(value, dict):
+            return None
+        return {nm: _coerce_json(value.get(nm), fdt)
+                for nm, fdt in (dt.fields or [])}
+    if dt.kind == T.TypeKind.ARRAY:
+        if not isinstance(value, list):
+            return None
+        return [_coerce_json(x, dt.element) for x in value]
+    try:
+        if dt.is_string:
+            return value if isinstance(value, str) \
+                else json.dumps(value, separators=(",", ":"))
+        if dt is T.BOOLEAN:
+            return value if isinstance(value, bool) else None
+        if dt.is_floating:
+            return float(value)
+        return int(value)
+    except (TypeError, ValueError):
+        return None
+
+
+class FromJson(CollectionExpression):
+    """from_json(json_str, schema) → STRUCT/ARRAY column (PERMISSIVE:
+    malformed rows become NULL)."""
+
+    def __init__(self, child: Expression, schema: T.DataType):
+        self.schema_dt = schema
+        super().__init__(child)
+
+    def _rebind(self):
+        self.dtype = self.schema_dt
+        self.nullable = True
+
+    def _fp_extra(self):
+        return str(self.schema_dt)
+
+    def _apply(self, s):
+        try:
+            return _coerce_json(json.loads(s), self.schema_dt)
+        except (ValueError, TypeError):
+            return None
+
+
+class ToJson(CollectionExpression):
+    def _rebind(self):
+        self.dtype = T.STRING
+        self.nullable = self.children[0].nullable
+
+    def _apply(self, v):
+        return json.dumps(v, separators=(",", ":"), default=str)

@@ -26,6 +26,21 @@ def _and(a, b):
     return a & b
 
 
+import threading
+
+_ANSI = threading.local()
+
+
+def set_ansi(enabled: bool) -> None:
+    """Set by CpuOpExec around fallback execution: ANSI raises on overflow
+    and invalid casts instead of nulling (GpuCast.scala ANSI analog)."""
+    _ANSI.enabled = enabled
+
+
+def ansi_enabled() -> bool:
+    return getattr(_ANSI, "enabled", False)
+
+
 def eval_cpu(expr: E.Expression, arrays, n: int) -> Value:
     """Evaluate a bound expression against dense host columns.
 
@@ -93,6 +108,11 @@ def eval_cpu(expr: E.Expression, arrays, n: int) -> Value:
         ld = _promote_cpu(ld, expr.children[0].dtype, T.FLOAT64)
         rd = _promote_cpu(rd, expr.children[1].dtype, T.FLOAT64)
         zero = rd == 0
+        if ansi_enabled():
+            live = _and(lv, rv)
+            live = np.ones(n, bool) if live is None else np.asarray(live)
+            if bool((zero & live).any()):
+                raise ArithmeticError("ANSI mode: division by zero")
         out = ld / np.where(zero, 1.0, rd)
         return out, _and(_and(lv, rv), ~zero)
     if isinstance(expr, E.Remainder):
@@ -289,6 +309,10 @@ def _compare_scalar(d, val, dt: T.DataType):
     return d == E.physical_literal(val, dt)
 
 
+def n_of(d):
+    return len(d)
+
+
 def _cast_cpu(d, v, src: T.DataType, dst: T.DataType) -> Value:
     if src == dst:
         return d, v
@@ -297,11 +321,32 @@ def _cast_cpu(d, v, src: T.DataType, dst: T.DataType) -> Value:
         return cast_to_string(d, v, src)
     if src.is_string:
         from .string_eval import cast_from_string
-        return cast_from_string(d, v, dst)
+        od, ov = cast_from_string(d, v, dst)
+        if ansi_enabled():
+            before = np.ones(n_of(d), bool) if v is None else np.asarray(v)
+            before = before & np.array([x is not None for x in d])
+            after = np.ones(n_of(d), bool) if ov is None                 else np.asarray(ov, bool)
+            if bool((before & ~after).any()):
+                raise ArithmeticError(
+                    "ANSI mode: invalid string cast to "
+                    f"{dst} (sql.ansi.enabled=true raises)")
+        return od, ov
+    if ansi_enabled() and src.is_integral and dst.is_integral:
+        info = np.iinfo(dst.numpy_dtype)
+        live = np.ones(len(d), bool) if v is None else np.asarray(v, bool)
+        if bool(((d < info.min) | (d > info.max))[live].any()):
+            raise ArithmeticError(
+                f"ANSI mode: integer overflow casting to {dst}")
     if dst.kind == T.TypeKind.BOOLEAN and src.is_numeric:
         return d != 0, v
     if src.is_floating and dst.is_integral:
         info = np.iinfo(dst.numpy_dtype)
+        if ansi_enabled():
+            live = np.ones(len(d), bool) if v is None else np.asarray(v, bool)
+            bad = np.isnan(d) | (d < float(info.min)) | (d > float(info.max))
+            if bool(bad[live].any()):
+                raise ArithmeticError(
+                    f"ANSI mode: invalid float cast to {dst}")
         x = np.nan_to_num(d, nan=0.0, posinf=float(info.max),
                           neginf=float(info.min))
         x = np.clip(np.trunc(x), float(info.min), float(info.max))

@@ -123,7 +123,12 @@ class CpuOpExec(TpuExec):
         return pa.concat_tables(tables)
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
-        table = self._run(ctx)
+        from .eval import set_ansi
+        set_ansi(ctx.conf["spark.rapids.tpu.sql.ansi.enabled"])
+        try:
+            table = self._run(ctx)
+        finally:
+            set_ansi(False)
         min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
         batch_rows = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
         for off in range(0, max(table.num_rows, 1), batch_rows):
@@ -534,6 +539,17 @@ class CpuOpExec(TpuExec):
         from .. import windowfns as WF
         func = w.func
         frame = w.spec.frame
+        if w.spec.order_by and frame.kind == "range" and not (
+                frame.lo is None and frame.hi in (None, 0)):
+            # bounded value-range frame: stash the sorted order key so
+            # _frame_bounds can resolve per-row value windows
+            o = w.spec.order_by[0]
+            od, ov = eval_cpu(o.expr, vals, n)
+            s = dict(s)
+            s["order0"] = np.asarray(od)[perm]
+            s["order0_valid"] = (None if ov is None
+                                 else np.asarray(ov, bool)[perm])
+            s["order0_asc"] = o.ascending
         arange, seg_ids = s["arange"], s["seg_ids"]
         ssp, sep = s["seg_start_pos"], s["seg_end_pos"]
         pep = s["peer_end_pos"]
@@ -663,13 +679,44 @@ class CpuOpExec(TpuExec):
         """Per-row inclusive [lo_pos, hi_pos] frame bounds in sorted order."""
         arange, ssp, sep = s["arange"], s["seg_start_pos"], s["seg_end_pos"]
         if frame.kind == "range":
-            lo_pos = ssp  # only unbounded-preceding range frames exist here
-            hi_pos = sep if frame.hi is None else s["peer_end_pos"]
-        else:
-            lo_pos = ssp if frame.lo is None else np.maximum(
-                arange + frame.lo, ssp)
-            hi_pos = sep if frame.hi is None else np.minimum(
-                arange + frame.hi, sep)
+            if frame.lo is None and frame.hi in (None, 0):
+                lo_pos = ssp
+                hi_pos = sep if frame.hi is None else s["peer_end_pos"]
+                return lo_pos, hi_pos
+            # bounded value-range: per-row scan within the partition
+            # (brute force; this is the declared CPU fallback regime).
+            # Offsets apply in ORDER direction (Spark): for a descending
+            # key "preceding" means larger values.
+            key = s["order0"]
+            kv = s.get("order0_valid")
+            sgn = 1 if s["order0_asc"] else -1
+            n = len(key)
+            lo_pos = np.empty(n, dtype=np.int64)
+            hi_pos = np.empty(n, dtype=np.int64)
+            for i in range(n):
+                a, b = int(ssp[i]), int(sep[i])
+                if kv is not None and not kv[i]:
+                    # null order key: the frame is the null peer group
+                    js = [j for j in range(a, b + 1)
+                          if kv is not None and not kv[j]]
+                else:
+                    js = []
+                    for j in range(a, b + 1):
+                        if kv is not None and not kv[j]:
+                            continue
+                        delta = (key[j] - key[i]) * sgn
+                        if (frame.lo is None or delta >= frame.lo) and \
+                                (frame.hi is None or delta <= frame.hi):
+                            js.append(j)
+                if js:
+                    lo_pos[i], hi_pos[i] = js[0], js[-1]
+                else:
+                    lo_pos[i], hi_pos[i] = 1, 0  # empty
+            return lo_pos, hi_pos
+        lo_pos = ssp if frame.lo is None else np.maximum(
+            arange + frame.lo, ssp)
+        hi_pos = sep if frame.hi is None else np.minimum(
+            arange + frame.hi, sep)
         return lo_pos, hi_pos
 
     def _bounded_frame_minmax(self, fname, frame, d, m, s, ok, np_dt):

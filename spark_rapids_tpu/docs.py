@@ -82,11 +82,12 @@ def _verify_exec_rows() -> None:
 
 
 def _expr_modules():
-    from . import (aggfns, bitwisefns, datetimefns, exprs, mathfns,
-                   stringfns, windowfns)
+    from . import (aggfns, bitwisefns, collectionfns, datetimefns, exprs,
+                   mathfns, stringfns, windowfns)
     return [("core", exprs), ("math", mathfns), ("bitwise", bitwisefns),
             ("string", stringfns), ("datetime", datetimefns),
-            ("aggregate", aggfns), ("window", windowfns)]
+            ("collection", collectionfns), ("aggregate", aggfns),
+            ("window", windowfns)]
 
 
 def _expr_rows() -> List[Tuple[str, str, str, str, str]]:

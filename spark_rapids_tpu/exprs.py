@@ -104,6 +104,19 @@ class EvalContext:
         self.ansi = ansi
         # host-precomputed inputs (dictionary-lowered string predicates)
         self.extras = list(extras)
+        # ANSI error channel: expressions append per-row error masks
+        # (overflow, invalid cast, division by zero); the enclosing stage
+        # reduces them into one flag it raises on (GpuCast.scala ANSI /
+        # SparkArithmeticException analog)
+        self.errors: list = []
+
+    def record_error(self, err, valid=None) -> None:
+        """Append a per-row ANSI error mask, confined to live valid rows."""
+        if valid is not None:
+            err = err & valid
+        if self.active is not None:
+            err = err & self.active
+        self.errors.append(err)
 
 
 # ---------------------------------------------------------------------------------
@@ -236,8 +249,13 @@ class Cast(Expression):
     def eval(self, ctx: EvalContext) -> Value:
         from .ops.cast import cast_value
         data, valid = self.children[0].eval(ctx)
-        return cast_value(data, valid, self.children[0].dtype, self.dtype,
-                          ansi=self.ansi or ctx.ansi)
+        ansi = self.ansi or ctx.ansi
+        errors = [] if ansi else None
+        out = cast_value(data, valid, self.children[0].dtype, self.dtype,
+                         ansi=ansi, errors=errors)
+        if errors:
+            ctx.record_error(errors[0], valid)
+        return out
 
     def _fp_extra(self):
         return f"->{self.dtype}"
@@ -372,6 +390,9 @@ class Divide(BinaryExpression):
     def eval(self, ctx):
         ld, rd, v = self._eval_children_promoted(ctx)
         zero = rd == 0
+        if ctx.ansi:
+            # ANSI: division by zero raises instead of nulling
+            ctx.record_error(zero, v)
         out = ld / jnp.where(zero, 1.0, rd)
         valid = _and_valid(v, ~zero)
         return out, valid

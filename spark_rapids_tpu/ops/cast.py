@@ -48,7 +48,15 @@ def _and(a, b):
 
 
 def cast_value(data: jax.Array, valid: Optional[jax.Array],
-               src: DataType, dst: DataType, ansi: bool = False) -> Value:
+               src: DataType, dst: DataType, ansi: bool = False,
+               errors: Optional[list] = None) -> Value:
+    """Device cast.  In ANSI mode, rows that legacy semantics would wrap,
+    clamp, or null append a per-row error mask to ``errors`` (the caller
+    raises; GpuCast.scala ANSI analog) and keep their validity."""
+    def _err(mask):
+        if ansi and errors is not None:
+            errors.append(mask)
+
     if src == dst:
         return data, valid
     if src.kind == T.TypeKind.NULL:
@@ -67,16 +75,16 @@ def cast_value(data: jax.Array, valid: Optional[jax.Array],
             # actually truncates toward zero and wraps like a JVM (long) cast;
             # match JVM: NaN→0, +-inf / out-of-range → Long.Max/Min then narrow.
             lo, hi = _INT_BOUNDS[dst.kind]
+            _err(jnp.isnan(data) | (data < float(lo)) | (data > float(hi)))
             d = jnp.nan_to_num(data, nan=0.0, posinf=float(hi), neginf=float(lo))
             d = jnp.clip(jnp.trunc(d), float(lo), float(hi))
             return d.astype(dst.numpy_dtype), valid
         if dst.is_integral and src.is_integral:
-            # narrowing wraps (legacy); ANSI overflow → null+error row
+            # narrowing wraps (legacy); ANSI overflow raises
             out = data.astype(dst.numpy_dtype)
             if ansi and _INT_BOUNDS[dst.kind][1] < _INT_BOUNDS[src.kind][1]:
                 lo, hi = _INT_BOUNDS[dst.kind]
-                ok = (data >= lo) & (data <= hi)
-                return out, _and(valid, ok)
+                _err((data < lo) | (data > hi))
             return out, valid
         return data.astype(dst.numpy_dtype), valid
 
@@ -90,10 +98,12 @@ def cast_value(data: jax.Array, valid: Optional[jax.Array],
         scaled = data.astype(jnp.int64) * (10 ** dst.scale)
         max_unscaled = 10 ** dst.precision
         ok = jnp.abs(scaled) < max_unscaled
+        _err(~ok)
         return scaled, _and(valid, ok)
     if src.is_floating and dst.is_decimal:
         scaled = jnp.round(data * (10.0 ** dst.scale))
         ok = jnp.isfinite(data) & (jnp.abs(scaled) < float(10 ** dst.precision))
+        _err(~ok)
         return scaled.astype(jnp.int64), _and(valid, ok)
     if src.is_decimal and dst.is_decimal:
         dscale = dst.scale - src.scale
@@ -104,6 +114,7 @@ def cast_value(data: jax.Array, valid: Optional[jax.Array],
             sign = jnp.where(data >= 0, 1, -1)
             out = sign * ((jnp.abs(data) + d // 2) // d)
         ok = jnp.abs(out) < 10 ** dst.precision
+        _err(~ok)
         return out, _and(valid, ok)
 
     # ---- datetime ------------------------------------------------------------

@@ -200,20 +200,106 @@ def partition_reduce(w: SortedWindowContext, contrib: jax.Array, m: jax.Array,
     return tot[w.seg_ids]
 
 
+def rows_positions(w: SortedWindowContext, lo: Optional[int],
+                   hi: Optional[int]):
+    """[lo_pos, hi_pos] index window of a ROWS frame, partition-clamped."""
+    i = w.arange
+    lo_pos = w.seg_start_pos if lo is None else jnp.maximum(
+        i + jnp.int32(lo), w.seg_start_pos)
+    hi_pos = w.seg_end_pos if hi is None else jnp.minimum(
+        i + jnp.int32(hi), w.seg_end_pos)
+    return lo_pos, hi_pos
+
+
+def range_positions(w: SortedWindowContext, key: jax.Array,
+                    key_valid: Optional[jax.Array],
+                    lo: Optional[int], hi: Optional[int]):
+    """[lo_pos, hi_pos] of a value-RANGE frame over a single ASCENDING
+    NULLS-FIRST int32-representable order key (int32/date), via composite
+    int64 searchsorted: composite = (segment_id << 33) | (not_null << 32)
+    | biased key — globally sorted by construction (GpuWindowExec bounded
+    range analog).  NULL-keyed rows form their own peer group (Spark
+    semantics): their frame is exactly the segment's null block."""
+    k64 = key.astype(jnp.int64)
+    bias = jnp.int64(1) << 31
+    ok = (jnp.ones_like(k64, dtype=bool) if key_valid is None
+          else key_valid)
+    seg = w.seg_ids.astype(jnp.int64) << 33
+    nn = jnp.int64(1) << 32
+    comp = seg | jnp.where(ok, nn | (k64 + bias), jnp.int64(0))
+    # inactive rows park at the top so they never enter a window
+    comp = jnp.where(w.active, comp, jnp.int64(2**62))
+    i32min, i32max = -(2**31), 2**31 - 1
+
+    def _search(delta, side):
+        tgt = jnp.clip(k64 + delta, i32min, i32max)
+        return jnp.searchsorted(comp, seg | nn | (tgt + bias),
+                                side=side).astype(jnp.int32)
+
+    lo_pos = w.seg_start_pos if lo is None else _search(lo, "left")
+    hi_pos = w.seg_end_pos if hi is None else (_search(hi, "right") - 1)
+    if key_valid is not None:
+        # null rows: frame = the null block [seg_start, last null row)
+        null_hi = (jnp.searchsorted(comp, seg | nn, side="left")
+                   .astype(jnp.int32) - 1)
+        lo_pos = jnp.where(ok, lo_pos, w.seg_start_pos)
+        hi_pos = jnp.where(ok, hi_pos, null_hi)
+    return lo_pos, hi_pos
+
+
+def positional_sum(w: SortedWindowContext, contrib: jax.Array,
+                   lo_pos: jax.Array, hi_pos: jax.Array) -> jax.Array:
+    """Sum over [lo_pos, hi_pos] via prefix-sum difference."""
+    c = jnp.cumsum(contrib, dtype=contrib.dtype)
+    empty = hi_pos < lo_pos
+    lo_c = jnp.clip(lo_pos, 0, w.capacity - 1)
+    hi_c = jnp.clip(hi_pos, 0, w.capacity - 1)
+    out = c[hi_c] - c[lo_c] + contrib[lo_c]
+    return jnp.where(empty, jnp.zeros_like(out), out)
+
+
 def sliding_sum(w: SortedWindowContext, contrib: jax.Array,
                 lo: Optional[int], hi: Optional[int]) -> jax.Array:
     """ROWS BETWEEN lo AND hi (offsets relative to current row; None=∞).
 
     Prefix-sum difference clamped to the partition bounds.
     """
-    c = jnp.cumsum(contrib, dtype=contrib.dtype)
-    i = w.arange
-    lo_pos = w.seg_start_pos if lo is None else jnp.maximum(
-        i + jnp.int32(lo), w.seg_start_pos)
-    hi_pos = w.seg_end_pos if hi is None else jnp.minimum(
-        i + jnp.int32(hi), w.seg_end_pos)
-    empty = hi_pos < lo_pos
-    lo_c = jnp.clip(lo_pos, 0, w.capacity - 1)
-    hi_c = jnp.clip(hi_pos, 0, w.capacity - 1)
-    out = c[hi_c] - c[lo_c] + contrib[lo_c]
-    return jnp.where(empty, jnp.zeros_like(out), out)
+    lo_pos, hi_pos = rows_positions(w, lo, hi)
+    return positional_sum(w, contrib, lo_pos, hi_pos)
+
+
+def _mm_sentinel(dtype, op: str):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if op == "min" else -jnp.inf, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if op == "min" else info.min, dtype=dtype)
+
+
+def sliding_minmax(w: SortedWindowContext, data: jax.Array,
+                   mask: jax.Array, lo_pos: jax.Array, hi_pos: jax.Array,
+                   max_width: int, op: str) -> jax.Array:
+    """min/max over [lo_pos, hi_pos] windows via a sparse table: log2(W)
+    doubling passes build interval minima of power-of-two widths; each row
+    answers with two overlapping lookups (van Emde Boas / sparse-table RMQ
+    — the TPU shape for GpuWindowExec's sliding min/max regime).
+    ``max_width`` must statically bound hi-lo+1 (frame constants)."""
+    sent = _mm_sentinel(data.dtype, op)
+    x = jnp.where(mask, data, sent)
+    combine = jnp.minimum if op == "min" else jnp.maximum
+    cap = w.capacity
+    levels = [x]
+    shift = 1
+    while shift < max_width:
+        prev = levels[-1]
+        shifted = jnp.concatenate(
+            [prev[shift:], jnp.full((shift,), sent, dtype=data.dtype)])
+        levels.append(combine(prev, shifted))
+        shift <<= 1
+    M = jnp.stack(levels)  # (L, cap); level k covers width 2^k
+    width = jnp.maximum(hi_pos - lo_pos + 1, 1)
+    k = jnp.floor(jnp.log2(width.astype(jnp.float64))).astype(jnp.int32)
+    k = jnp.clip(k, 0, len(levels) - 1)
+    lo_c = jnp.clip(lo_pos, 0, cap - 1)
+    r_idx = jnp.clip(hi_pos - (jnp.int32(1) << k) + 1, 0, cap - 1)
+    out = combine(M[k, lo_c], M[k, r_idx])
+    return out

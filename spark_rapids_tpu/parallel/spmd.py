@@ -415,6 +415,10 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
             # host-lowered string predicates can't trace; the subtree runs
             # single-process and its result shards across the mesh
             return _make_leaf(node, leaves)
+        if conf["spark.rapids.tpu.sql.ansi.enabled"]:
+            # the ANSI error channel is checked at StageExec boundaries;
+            # run the stage single-process so errors raise correctly
+            return _make_leaf(node, leaves)
         child = _lower(node.children[0], leaves, conf, n_dev, axis,
                        depth_has_exchange)
         return _Stage(node, child)

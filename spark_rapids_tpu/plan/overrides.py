@@ -43,8 +43,7 @@ def expr_reasons(e: Expression, allow_string_passthrough: bool = True,
     reasons: List[str] = []
     core = strip_alias(e)
     if isinstance(core, BoundReference):
-        if core.dtype.is_string or (core.dtype.is_decimal
-                                    and core.dtype.precision > 18):
+        if core.dtype.is_host_carried:
             # rides as a host arrow column: fine to pass through a device
             # plan untouched, unusable as a compute/key input
             if not allow_string_passthrough:

@@ -244,7 +244,7 @@ class StageExec(TpuExec):
                 parts.append(f"F({payload.fingerprint()})")
         return "|".join(parts)
 
-    def _build_fn(self, in_schema: Schema):
+    def _build_fn(self, in_schema: Schema, ansi: bool = False):
         steps = self.steps
 
         def stage_fn(arrays, extras, sel, num_rows):
@@ -260,9 +260,10 @@ class StageExec(TpuExec):
             if sel is not None:
                 active = active & sel
             cur = list(arrays)
+            errors = []
             for kind, payload in steps:
                 ctx = EvalContext(cur, capacity, active=active,
-                                  extras=extras)
+                                  extras=extras, ansi=ansi)
                 if kind == "filter":
                     d, v = payload.eval(ctx)
                     keep = d if v is None else (d & v)
@@ -275,7 +276,13 @@ class StageExec(TpuExec):
                         else:
                             nxt.append(e.eval(ctx))
                     cur = nxt
-            return tuple(cur), active
+                errors += ctx.errors
+            if not ansi:
+                return tuple(cur), active
+            err = jnp.zeros((), dtype=bool)
+            for e in errors:
+                err = err | jnp.any(e)
+            return tuple(cur), active, err
 
         return stage_fn
 
@@ -283,9 +290,11 @@ class StageExec(TpuExec):
         child = self.children[0]
         in_schema = child.output_schema
         m = ctx.metric_set(self.op_id)
-        fp = self.fingerprint()
+        ansi = ctx.conf["spark.rapids.tpu.sql.ansi.enabled"]
+        fp = self.fingerprint() + ("|ansi" if ansi else "")
         fn = _cached_program(
-            "stage|" + fp, lambda: jax.jit(self._build_fn(in_schema)))
+            "stage|" + fp,
+            lambda: jax.jit(self._build_fn(in_schema, ansi=ansi)))
 
         # figure out host pass-through columns for the final projection
         final_proj = None
@@ -294,6 +303,7 @@ class StageExec(TpuExec):
                 final_proj = payload
                 break
 
+        from ..cpu.eval import set_ansi
         from ..memory.retry import with_retry
 
         def run_one(b: ColumnBatch) -> ColumnBatch:
@@ -306,26 +316,38 @@ class StageExec(TpuExec):
             if self.host_exprs:
                 from .stringpred import evaluate_host_expr
                 cap = b.capacity
-                for k, (expr, ords, kind) in enumerate(self.host_exprs):
-                    data, valid = evaluate_host_expr(
-                        expr, ords, b.columns, b.num_rows)
-                    if kind == "host":
-                        # computed string output: stays a host column
-                        import pyarrow as pa
-                        vals = [v if ok else None
-                                for v, ok in zip(data.tolist(),
-                                                 valid.tolist())]
-                        host_computed[k] = HostStringColumn(
-                            pa.array(vals, type=pa.string()), capacity=cap)
-                        extras.append(None)
-                        continue
-                    pad = cap - len(data)
-                    if pad > 0:
-                        data = np.concatenate(
-                            [data, np.zeros(pad, dtype=data.dtype)])
-                        valid = np.concatenate(
-                            [valid, np.zeros(pad, dtype=bool)])
-                    extras.append((jnp.asarray(data), jnp.asarray(valid)))
+                set_ansi(ansi)
+                try:
+                    for k, (expr, ords, kind) in enumerate(self.host_exprs):
+                        data, valid = evaluate_host_expr(
+                            expr, ords, b.columns, b.num_rows)
+                        if kind == "host":
+                            # computed host-carried output (string / ARRAY
+                            # / STRUCT): arrow column of the expr type
+                            import pyarrow as pa
+
+                            from ..batch import logical_to_arrow
+                            vals = [v if ok else None
+                                    for v, ok in zip(data.tolist(),
+                                                     valid.tolist())]
+                            host_computed[k] = HostStringColumn(
+                                pa.array(vals,
+                                         type=logical_to_arrow(expr.dtype)),
+                                capacity=cap)
+                            extras.append(None)
+                            continue
+                        pad = cap - len(data)
+                        if pad > 0:
+                            data = np.concatenate(
+                                [data, np.zeros(pad, dtype=data.dtype)])
+                            valid = np.concatenate(
+                                [valid, np.zeros(pad, dtype=bool)])
+                        extras.append((jnp.asarray(data),
+                                       jnp.asarray(valid)))
+                finally:
+                    # the thread-local must never leak past this batch —
+                    # ANSI errors raise out of evaluate_host_expr
+                    set_ansi(False)
             if all(a is None for a in arrays) and \
                     all(e is None for e in extras):
                 # pure host-column stage (string-only projection): no XLA
@@ -333,8 +355,18 @@ class StageExec(TpuExec):
                 out_arrays = (None,) * len(self._schema)
                 new_sel = b.sel
             else:
-                out_arrays, new_sel = fn(tuple(arrays), tuple(extras),
-                                         b.sel, np.int32(b.num_rows))
+                outs = fn(tuple(arrays), tuple(extras),
+                          b.sel, np.int32(b.num_rows))
+                if ansi:
+                    out_arrays, new_sel, err = outs
+                    if bool(err):
+                        raise ArithmeticError(
+                            "ANSI mode: overflow, invalid cast, or "
+                            "division by zero (spark.rapids.tpu.sql."
+                            "ansi.enabled=true raises instead of "
+                            "nulling/wrapping)")
+                else:
+                    out_arrays, new_sel = outs
             cols: List = []
             for oi, f_ in enumerate(self._schema):
                 val = out_arrays[oi] if oi < len(out_arrays) else None
@@ -490,7 +522,10 @@ class AggregateExec(TpuExec):
         # full RPC round-trip on tunneled backends, and a scalar aggregate
         # needs nothing from the stage but its (tiny) reduced outputs
         fused_stage = None
-        if isinstance(child, StageExec) and not child.host_exprs:
+        if isinstance(child, StageExec) and not child.host_exprs \
+                and not ctx.conf["spark.rapids.tpu.sql.ansi.enabled"]:
+            # (under ANSI the stage runs unfused so its error channel is
+            # checked at the stage boundary)
             fused_stage = child
             child = fused_stage.children[0]
             stage_fn = fused_stage._build_fn(child.output_schema)

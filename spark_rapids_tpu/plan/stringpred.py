@@ -81,13 +81,15 @@ def _contains_udf(e: E.Expression) -> bool:
 def lowerable_kind(e: E.Expression) -> Optional[str]:
     """Classify a bound subtree for host lowering.
 
-    'device' — non-string, non-nested output whose column inputs are all
-    string refs (≥1): becomes a typed extras column.
-    'host' — string output whose column inputs are all string refs:
-    becomes a computed host string column.
-    None — not lowerable (has non-string refs, UDFs, or no string at all).
+    'device' — device-representable output whose column inputs are all
+    host-carried refs (string/nested, ≥1): becomes a typed extras column.
+    'host' — host-carried output (string, ARRAY, STRUCT): becomes a
+    computed host column.  Creators (array()/struct() over device
+    columns) qualify because their OUTPUT lives on the host regardless —
+    device refs are fetched for the evaluation.
+    None — not lowerable (device output over device refs, or UDFs).
     """
-    if e.dtype is None or e.dtype.is_nested:
+    if e.dtype is None:
         return None
     if _contains_udf(e):
         return None
@@ -95,22 +97,27 @@ def lowerable_kind(e: E.Expression) -> Optional[str]:
         return None  # plain refs/literals pass through; nothing to lower
 
     refs: List[E.BoundReference] = []
-    saw_string = [False]
+    saw_host = [False]
+    host_out = e.dtype.is_host_carried
 
     def walk(node: E.Expression) -> bool:
         if isinstance(node, E.BoundReference):
             refs.append(node)
-            if node.dtype is not None and node.dtype.is_string:
-                saw_string[0] = True
+            if node.dtype is not None and node.dtype.is_host_carried:
+                saw_host[0] = True
                 return True
-            return False
-        if node.dtype is not None and node.dtype.is_string:
-            saw_string[0] = True
+            # device-typed ref: allowed only when the overall output is
+            # host-carried anyway (creator shape)
+            return host_out
+        if node.dtype is not None and node.dtype.is_host_carried:
+            saw_host[0] = True
         return all(walk(c) for c in node.children)
 
-    if not walk(e) or not saw_string[0] or not refs:
+    if not walk(e) or not refs:
         return None
-    return "host" if e.dtype.is_string else "device"
+    if not saw_host[0] and not host_out:
+        return None
+    return "host" if host_out else "device"
 
 
 def string_pred_ref(e: E.Expression) -> Optional[int]:
@@ -202,7 +209,7 @@ def lower_string_predicate_steps(steps, in_schema):
                 continue
             from .planner import strip_alias
             core = strip_alias(e)
-            if core.dtype is not None and core.dtype.is_string and \
+            if core.dtype is not None and core.dtype.is_host_carried and \
                     lowerable_kind(core) == "host":
                 resolved = _resolve_to_input(core, before, host_exprs)
                 if resolved is not None:
@@ -236,12 +243,19 @@ def evaluate_host_expr(expr: E.Expression, ords: List[int], columns,
     ``columns[o]`` must be HostStringColumn for each o in ords.  Returns
     per-row (data, valid) numpy arrays (object-dtyped data for string
     outputs).  Single-column expressions evaluate per DISTINCT value."""
+    import pyarrow as pa
+
+    from ..batch import HostStringColumn
     from ..cpu.eval import eval_cpu
 
     remapped = _remap_ords(expr, {o: i for i, o in enumerate(ords)})
-    np_dt = None if expr.dtype.is_string else expr.dtype.numpy_dtype
+    np_dt = None if expr.dtype.is_host_carried else expr.dtype.numpy_dtype
 
-    if len(ords) == 1:
+    single_string = (
+        len(ords) == 1
+        and isinstance(columns[ords[0]], HostStringColumn)
+        and pa.types.is_string(columns[ords[0]].array.type))
+    if single_string:
         arr = columns[ords[0]].array.slice(0, num_rows)
         denc = arr.dictionary_encode()
         dict_vals = np.array(denc.dictionary.to_pylist(), dtype=object)
@@ -276,10 +290,19 @@ def evaluate_host_expr(expr: E.Expression, ords: List[int], columns,
     else:
         arrays = []
         for o in ords:
-            a = columns[o].array.slice(0, num_rows)
-            vals = np.array(a.to_pylist(), dtype=object)
-            nulls = np.asarray(a.is_null().to_numpy(zero_copy_only=False))
-            arrays.append((vals, ~nulls if nulls.any() else None))
+            col = columns[o]
+            if isinstance(col, HostStringColumn):
+                a = col.array.slice(0, num_rows)
+                vals = np.array(a.to_pylist(), dtype=object)
+                nulls = np.asarray(a.is_null().to_numpy(
+                    zero_copy_only=False))
+                arrays.append((vals, ~nulls if nulls.any() else None))
+            else:
+                # device ref feeding a host-output expression (creator
+                # shape): fetch the column
+                d_ = np.asarray(col.data)[:num_rows]
+                v_ = None if col.valid is None                     else np.asarray(col.valid)[:num_rows]
+                arrays.append((d_, v_))
         d, v = eval_cpu(remapped, arrays, num_rows)
         data = np.asarray(d)
         valid = np.ones(num_rows, dtype=bool) if v is None else \

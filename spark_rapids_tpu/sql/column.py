@@ -21,6 +21,19 @@ class Column:
     def __init__(self, expr: E.Expression):
         self.expr = expr
 
+    # -- nested access ------------------------------------------------------------
+    def getItem(self, key) -> "Column":
+        """arr[i] (0-based) or struct field by name (pyspark Column.getItem)."""
+        from .. import collectionfns as C
+        from .. import exprs as E
+        if isinstance(key, str):
+            return Column(C.GetStructField(self.expr, key))
+        return Column(C.GetArrayItem(self.expr, E.Literal(int(key))))
+
+    def getField(self, name: str) -> "Column":
+        from .. import collectionfns as C
+        return Column(C.GetStructField(self.expr, name))
+
     # -- naming -------------------------------------------------------------------
     def alias(self, name: str) -> "Column":
         return Column(_AliasMarker(self.expr, name))

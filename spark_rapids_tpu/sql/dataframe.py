@@ -337,6 +337,73 @@ class DataFrame:
         return self.session._explain(self._plan)
 
 
+class PivotedData:
+    """group_by(...).pivot(col, values): rewrites aggregates as
+    conditional aggregations, one output column per (value, agg)."""
+
+    def __init__(self, grouped: "GroupedData", column: str, values):
+        self._g = grouped
+        self._column = column
+        self._values = values
+
+    def agg(self, *cols: "Column") -> DataFrame:
+        from .. import exprs as E
+        from ..plan.planner import strip_alias
+        from .column import Column as C, _AliasMarker
+
+        def conditional(agg_expr, pv):
+            import copy
+
+            from .. import aggfns as A
+            core = strip_alias(agg_expr)
+            cond = E.EqualTo(E.UnresolvedColumn(self._column),
+                             E.Literal(pv))
+            if not core.children:
+                # count(*) has nothing to wrap: count the pivot matches
+                return A.Count(E.If(cond, E.Literal(1),
+                                    E.Literal(None, None)))
+            node = copy.copy(core)
+            node.children = tuple(
+                E.If(cond, ch, E.Literal(None, None))
+                for ch in core.children)
+            return node
+
+        def default_name(c):
+            """sum(x)-style label for an unaliased aggregate (Spark
+            naming), instead of an expression fingerprint."""
+            core = strip_alias(c.expr)
+            fn = getattr(core, "func", type(core).__name__.lower())
+            if core.children:
+                ch = core.children[0]
+                arg = getattr(ch, "name", "") or "expr"
+            else:
+                arg = ""
+            return f"{fn}({arg})"
+
+        out = []
+        for pv in self._values:  # Spark orders pivot values outermost
+            for c in cols:
+                base_name = (c.name if isinstance(c.expr, _AliasMarker)
+                             else None)
+                core = conditional(c.expr, pv)
+                name = (f"{pv}" if len(cols) == 1 and base_name is None
+                        else f"{pv}_{base_name or default_name(c)}")
+                out.append(C(core).alias(name))
+        return self._g.agg(*out)
+
+    def sum(self, name: str) -> DataFrame:
+        from . import functions as F
+        return self.agg(F.sum(F.col(name)))
+
+    def count(self) -> DataFrame:
+        from . import functions as F
+        return self.agg(F.count_star())
+
+    def first(self, name: str) -> DataFrame:
+        from . import functions as F
+        return self.agg(F.first(F.col(name)))
+
+
 class GroupedData:
     def __init__(self, df: DataFrame, group_exprs):
         self._df = df
@@ -346,6 +413,14 @@ class GroupedData:
         agg_exprs = [_named(c) for c in cols]
         node = _decompose_agg_exprs(self._df._plan, self._group_exprs, agg_exprs)
         return DataFrame(node, self._df.session)
+
+    def pivot(self, column: str, values) -> "PivotedData":
+        """Pivot on explicit values (Spark requires the explicit list for
+        GPU PivotFirst; AggregateFunctions.scala PivotFirst analog).  Each
+        (pivot value, aggregate) pair lowers to a conditional aggregate —
+        agg(when(pivot == v, child)) — so the whole pivot stays on the
+        device aggregation path."""
+        return PivotedData(self, column, list(values))
 
     def count(self) -> DataFrame:
         from . import functions as F

@@ -11,6 +11,11 @@ from .column import Column, to_expr
 
 __all__ = [
     "broadcast",
+    "array", "struct", "element_at", "size", "array_contains",
+    "sort_array", "array_distinct", "array_min", "array_max",
+    "array_position", "slice", "flatten", "array_join", "array_union",
+    "array_intersect", "array_except", "get_json_object", "from_json",
+    "to_json",
     "col", "lit", "when", "coalesce", "isnull", "isnan", "expr_abs",
     "sum", "count", "count_star", "min", "max", "avg", "mean", "first", "last",
     "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
@@ -47,6 +52,112 @@ def broadcast(df):
     """Hint that ``df`` should be broadcast in joins (pyspark
     functions.broadcast analog; GpuBroadcastHashJoinExecBase selection)."""
     return df.hint("broadcast")
+
+
+def _col(x):
+    from .column import Column as _C
+    if isinstance(x, _C):
+        return x.expr
+    return E.Literal(x)
+
+
+# -- collections / nested types (complexTypeCreator / collectionOperations) --
+
+def array(*cols) -> Column:
+    from .. import collectionfns as C
+    return Column(C.CreateArray(*[_col(c) for c in cols]))
+
+
+def struct(*cols) -> Column:
+    from .. import collectionfns as C
+    names = [getattr(c, "name", None) or f"col{i + 1}"
+             for i, c in enumerate(cols)]
+    return Column(C.CreateStruct(names, *[_col(c) for c in cols]))
+
+
+def element_at(col_, idx) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ElementAt(_col(col_), _col(idx)))
+
+
+def size(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.Size(_col(col_)))
+
+
+def array_contains(col_, value) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayContains(_col(col_), _col(value)))
+
+
+def sort_array(col_, asc: bool = True) -> Column:
+    from .. import collectionfns as C
+    return Column(C.SortArray(_col(col_), asc))
+
+
+def array_distinct(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayDistinct(_col(col_)))
+
+
+def array_min(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayMin(_col(col_)))
+
+
+def array_max(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayMax(_col(col_)))
+
+
+def array_position(col_, value) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayPosition(_col(col_), _col(value)))
+
+
+def slice(col_, start, length) -> Column:  # noqa: A001 — pyspark naming
+    from .. import collectionfns as C
+    return Column(C.Slice(_col(col_), _col(start), _col(length)))
+
+
+def flatten(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.Flatten(_col(col_)))
+
+
+def array_join(col_, delimiter: str, null_replacement=None) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayJoin(_col(col_), delimiter, null_replacement))
+
+
+def array_union(a, b) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayUnion(_col(a), _col(b)))
+
+
+def array_intersect(a, b) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayIntersect(_col(a), _col(b)))
+
+
+def array_except(a, b) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayExcept(_col(a), _col(b)))
+
+
+def get_json_object(col_, path: str) -> Column:
+    from .. import collectionfns as C
+    return Column(C.GetJsonObject(_col(col_), path))
+
+
+def from_json(col_, schema) -> Column:
+    from .. import collectionfns as C
+    return Column(C.FromJson(_col(col_), schema))
+
+
+def to_json(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ToJson(_col(col_)))
 
 
 def lit(value: Any, dtype: Optional[T.DataType] = None) -> Column:
@@ -562,9 +673,13 @@ def percentile(c, q: float) -> Column:
 
 
 def percentile_approx(c, q: float, accuracy: int = 10000) -> Column:
-    """Exact percentile stand-in (better accuracy than the reference's
-    t-digest GpuApproximatePercentile; runs on the CPU operator)."""
-    return Column(A.Percentile(_colref(c), q))
+    """Approximate percentile via a device moments sketch (mergeable
+    fixed-width buffers; GpuApproximatePercentile analog — accuracy is
+    distributional, see aggfns.ApproxPercentile)."""
+    return Column(A.ApproxPercentile(_colref(c), q, accuracy))
+
+
+approx_percentile = percentile_approx
 
 
 # -- user-defined functions (RapidsUDF / GpuUserDefinedFunction analogs) ----------

@@ -66,10 +66,6 @@ class WindowSpec:
 
     def range_between(self, start: int, end: int) -> "WindowSpec":
         lo, hi = _bound(start), _bound(end)
-        if (lo, hi) not in ((None, 0), (None, None)):
-            raise NotImplementedError(
-                "rangeBetween supports only UNBOUNDED PRECEDING..CURRENT ROW "
-                "or UNBOUNDED..UNBOUNDED (value-range frames pending)")
         frame = WindowFrame("range", lo, hi)
         return WindowSpec(WindowSpecDef(self._spec.partition_by,
                                         self._spec.order_by, frame,

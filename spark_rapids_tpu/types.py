@@ -136,6 +136,9 @@ class DataType:
             return f"decimal({self.precision},{self.scale})"
         if self.kind == TypeKind.ARRAY:
             return f"array<{self.element}>"
+        if self.kind == TypeKind.STRUCT:
+            inner = ",".join(f"{n}:{t}" for n, t in self.fields)
+            return f"struct<{inner}>"
         return self.kind.value
 
     def simple_name(self) -> str:
@@ -159,6 +162,12 @@ def array(element: DataType) -> DataType:
     """ARRAY<element> — produced by collect_list/collect_set; carried as
     host arrow list columns (no device representation)."""
     return DataType(TypeKind.ARRAY, element=element)
+
+
+def struct(fields) -> DataType:
+    """STRUCT<name: type, ...> — carried as host arrow struct columns
+    (complexTypeCreator.scala analog); ``fields`` is [(name, DataType)]."""
+    return DataType(TypeKind.STRUCT, fields=tuple(fields))
 
 
 def decimal(precision: int, scale: int) -> DataType:

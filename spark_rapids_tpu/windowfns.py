@@ -217,6 +217,23 @@ class WindowExpression(Expression):
             return self.func.window_eval(w, ectx)
         return self._agg_window_eval(w, ectx)
 
+    def _range_order_key(self, w, ectx):
+        """Sorted single ascending int32-representable order key for
+        bounded RANGE frames (gated by device_support_reason)."""
+        o = self.spec.order_by[0]
+        d, v = w.sort_value(o.expr.eval(ectx))
+        return d, v
+
+    def _bounded_positions(self, w, ectx):
+        """[lo_pos, hi_pos] for a bounded (non-running) frame, or None."""
+        frame = self.spec.frame
+        if frame.is_unbounded_both or frame.is_running:
+            return None
+        if frame.kind == "rows":
+            return W.rows_positions(w, frame.lo, frame.hi)
+        kd, kv = self._range_order_key(w, ectx)
+        return W.range_positions(w, kd, kv, frame.lo, frame.hi)
+
     def _agg_window_eval(self, w, ectx) -> Value:
         agg = self.func
         frame = self.spec.frame
@@ -224,12 +241,12 @@ class WindowExpression(Expression):
         cap = w.capacity
         if fname == "count(*)":
             contrib = w.active.astype(jnp.int64)
-            cnt = self._framed_sum(w, frame, contrib)
+            cnt = self._framed_sum(w, frame, contrib, ectx)
             return cnt, None
         d, v = w.sort_value(agg.children[0].eval(ectx))
         m = w.active if v is None else (w.active & v)
         if fname == "count":
-            cnt = self._framed_sum(w, frame, m.astype(jnp.int64))
+            cnt = self._framed_sum(w, frame, m.astype(jnp.int64), ectx)
             return cnt, None
         if fname in ("sum", "avg"):
             src = agg.children[0].dtype
@@ -242,8 +259,8 @@ class WindowExpression(Expression):
             else:
                 data = d.astype(jnp.int64)
             contrib = jnp.where(m, data, jnp.zeros_like(data))
-            s = self._framed_sum(w, frame, contrib)
-            cnt = self._framed_sum(w, frame, m.astype(jnp.int64))
+            s = self._framed_sum(w, frame, contrib, ectx)
+            cnt = self._framed_sum(w, frame, m.astype(jnp.int64), ectx)
             ok = cnt > 0
             if fname == "avg":
                 return s / jnp.where(ok, cnt, 1).astype(jnp.float64), ok
@@ -251,19 +268,27 @@ class WindowExpression(Expression):
         if fname in ("min", "max"):
             if frame.is_unbounded_both:
                 out = W.partition_reduce(w, d, m, fname)
-            else:  # running (validated by the planner)
+            elif frame.is_running:
                 run = W.running_minmax(w, d, m, fname)
                 if frame.kind == "range":
                     run = run[w.peer_end_pos]
                 out = run
-            cnt = self._framed_sum(w, frame, m.astype(jnp.int64))
+            else:
+                # bounded ROWS frame: sparse-table sliding min/max
+                # (GpuWindowExec.scala:2004 double-pass regime analog)
+                lo_pos, hi_pos = W.rows_positions(w, frame.lo, frame.hi)
+                max_width = (frame.hi - frame.lo + 1)
+                out = W.sliding_minmax(w, d, m, lo_pos, hi_pos,
+                                       max_width, fname)
+            cnt = self._framed_sum(w, frame, m.astype(jnp.int64), ectx)
             return out, cnt > 0
         if fname in ("first", "last"):
             return self._first_last(w, frame, fname, d, v,
-                                    getattr(agg, "ignore_nulls", False))
+                                    getattr(agg, "ignore_nulls", False),
+                                    ectx)
         raise NotImplementedError(f"window aggregate {fname}")
 
-    def _framed_sum(self, w, frame: WindowFrame, contrib):
+    def _framed_sum(self, w, frame: WindowFrame, contrib, ectx):
         if frame.is_unbounded_both:
             return W.partition_reduce(w, contrib, w.active, "sum")
         if frame.is_running:
@@ -271,10 +296,25 @@ class WindowExpression(Expression):
             if frame.kind == "range":
                 run = run[w.peer_end_pos]
             return run
+        if frame.kind == "range":
+            kd, kv = self._range_order_key(w, ectx)
+            lo_pos, hi_pos = W.range_positions(w, kd, kv, frame.lo,
+                                               frame.hi)
+            return W.positional_sum(w, contrib, lo_pos, hi_pos)
         return W.sliding_sum(w, contrib, frame.lo, frame.hi)
 
-    def _first_last(self, w, frame, fname, d, v, ignore_nulls):
+    def _first_last(self, w, frame, fname, d, v, ignore_nulls, ectx):
         m = w.active if v is None else (w.active & v)
+        if not ignore_nulls and not frame.is_unbounded_both \
+                and not frame.is_running:
+            # bounded frame: first/last are the frame boundary elements
+            lo_pos, hi_pos = self._bounded_positions(w, ectx)
+            empty = hi_pos < lo_pos
+            pos = jnp.clip(lo_pos if fname == "first" else hi_pos,
+                           0, w.capacity - 1)
+            out = d[pos]
+            valid = (~empty) if v is None else (v[pos] & ~empty)
+            return out, valid
         if ignore_nulls:
             idx = w.arange
             if fname == "first":
@@ -334,9 +374,39 @@ def device_support_reason(wexpr: WindowExpression) -> Optional[str]:
             return f"window aggregate {func.func} not on device"
         if frame.is_unbounded_both or frame.is_running:
             return None
-        if frame.kind == "rows" and func.func in (
-                "sum", "count", "count(*)", "avg"):
+        ignore_nulls = getattr(func, "ignore_nulls", False)
+        if frame.kind == "rows":
+            if func.func in ("sum", "count", "count(*)", "avg"):
+                return None
+            if func.func in ("min", "max"):
+                if frame.lo is not None and frame.hi is not None:
+                    return None  # sparse-table sliding min/max
+                return ("half-unbounded sliding min/max frame "
+                        "(CPU fallback)")
+            if func.func in ("first", "last") and not ignore_nulls:
+                return None
+            return (f"frame {frame.fingerprint()} for {func.func} "
+                    f"(CPU fallback)")
+        # bounded value-RANGE frame: single ascending non-nullable
+        # int32-representable order key → composite searchsorted positions
+        ob = wexpr.spec.order_by
+        if len(ob) != 1:
+            return "bounded range frame needs exactly one order key"
+        o = ob[0]
+        dt = o.expr.dtype
+        import spark_rapids_tpu.types as _T
+        ok_type = dt is not None and dt.kind in (
+            _T.TypeKind.INT8, _T.TypeKind.INT16, _T.TypeKind.INT32,
+            _T.TypeKind.DATE)
+        if not ok_type:
+            return (f"bounded range frame over {dt} order key (needs an "
+                    f"int32-representable ascending key; CPU fallback)")
+        if not o.ascending:
+            return "bounded range frame over a descending key (CPU)"
+        if not getattr(o, "nulls_first", True):
+            return "bounded range frame with NULLS LAST ordering (CPU)"
+        if func.func in ("sum", "count", "count(*)", "avg") or (
+                func.func in ("first", "last") and not ignore_nulls):
             return None
-        return (f"frame {frame.fingerprint()} for {func.func} needs sliding "
-                f"min/max (CPU fallback)")
+        return (f"bounded range frame for {func.func} (CPU fallback)")
     return f"unknown window function {type(func).__name__}"

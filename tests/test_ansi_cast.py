@@ -1,0 +1,125 @@
+"""Cast matrix + ANSI mode (GpuCast.scala / CastOpSuite analog).
+
+spark.rapids.tpu.sql.ansi.enabled=true makes overflowing casts, invalid
+string casts, and division by zero RAISE (ArithmeticError) instead of
+wrapping/clamping/nulling — via a traced per-row error channel reduced at
+each stage boundary (exprs.EvalContext.errors)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.sql import functions as F
+
+ANSI = "spark.rapids.tpu.sql.ansi.enabled"
+
+
+@pytest.fixture()
+def sess(fresh_session):
+    return fresh_session
+
+
+def _cast(df, colname, dt):
+    from spark_rapids_tpu import exprs as E
+    from spark_rapids_tpu.sql.column import Column
+    return df.select(Column(E.Cast(E.UnresolvedColumn(colname), dt))
+                     .alias("c"))
+
+
+class TestLegacyCasts:
+    def test_int_narrowing_wraps(self, sess):
+        df = sess.create_dataframe(pa.table({"x": pa.array(
+            [300, -300, 40], type=pa.int64())}))
+        rows = _cast(df, "x", T.INT8).collect()
+        assert [r[0] for r in rows] == [44, -44, 40]  # 300 % 256 etc
+
+    def test_float_to_int_clamps_nan_zero(self, sess):
+        df = sess.create_dataframe(pa.table({"x": pa.array(
+            [1.9, -1.9, float("nan"), float("inf"), -float("inf")])}))
+        rows = _cast(df, "x", T.INT32).collect()
+        assert rows[0][0] == 1 and rows[1][0] == -1
+        assert rows[2][0] == 0
+        assert rows[3][0] == 2**31 - 1 and rows[4][0] == -(2**31)
+
+    def test_divide_by_zero_nulls(self, sess):
+        df = sess.create_dataframe(pa.table({"a": [1.0, 2.0],
+                                             "b": [0.0, 2.0]}))
+        rows = df.select((F.col("a") / F.col("b")).alias("d")).collect()
+        assert rows[0][0] is None and rows[1][0] == 1.0
+
+    def test_string_to_int_invalid_nulls(self, sess):
+        df = sess.create_dataframe(pa.table({"s": ["12", "x", "7"]}))
+        rows = _cast(df, "s", T.INT32).collect()
+        assert [r[0] for r in rows] == [12, None, 7]
+
+    def test_int_to_decimal_and_rescale(self, sess):
+        df = sess.create_dataframe(pa.table({"x": pa.array(
+            [3, 12], type=pa.int64())}))
+        rows = _cast(df, "x", T.decimal(6, 2)).collect()
+        assert [float(r[0]) for r in rows] == [3.0, 12.0]
+
+
+class TestAnsiCasts:
+    def test_ansi_narrowing_overflow_raises(self, sess):
+        sess.conf.set(ANSI, True)
+        try:
+            df = sess.create_dataframe(pa.table({"x": pa.array(
+                [300], type=pa.int64())}))
+            with pytest.raises(ArithmeticError, match="ANSI"):
+                _cast(df, "x", T.INT8).collect()
+        finally:
+            sess.conf.set(ANSI, False)
+
+    def test_ansi_float_to_int_nan_raises(self, sess):
+        sess.conf.set(ANSI, True)
+        try:
+            df = sess.create_dataframe(pa.table({"x": [float("nan")]}))
+            with pytest.raises(ArithmeticError, match="ANSI"):
+                _cast(df, "x", T.INT64).collect()
+        finally:
+            sess.conf.set(ANSI, False)
+
+    def test_ansi_divide_by_zero_raises(self, sess):
+        sess.conf.set(ANSI, True)
+        try:
+            df = sess.create_dataframe(pa.table({"a": [1.0], "b": [0.0]}))
+            with pytest.raises(ArithmeticError, match="ANSI"):
+                df.select((F.col("a") / F.col("b")).alias("d")).collect()
+        finally:
+            sess.conf.set(ANSI, False)
+
+    def test_ansi_valid_casts_pass(self, sess):
+        sess.conf.set(ANSI, True)
+        try:
+            df = sess.create_dataframe(pa.table({"x": pa.array(
+                [10, -10], type=pa.int64())}))
+            rows = _cast(df, "x", T.INT8).collect()
+            assert [r[0] for r in rows] == [10, -10]
+            df2 = sess.create_dataframe(pa.table({"a": [4.0], "b": [2.0]}))
+            r2 = df2.select((F.col("a") / F.col("b")).alias("d")).collect()
+            assert r2[0][0] == 2.0
+        finally:
+            sess.conf.set(ANSI, False)
+
+    def test_ansi_invalid_string_cast_raises_cpu_path(self, sess):
+        sess.conf.set(ANSI, True)
+        try:
+            df = sess.create_dataframe(pa.table({"s": ["12", "oops"]}))
+            with pytest.raises(ArithmeticError, match="ANSI"):
+                _cast(df, "s", T.INT32).collect()
+        finally:
+            sess.conf.set(ANSI, False)
+
+    def test_ansi_rows_filtered_out_do_not_raise(self, sess):
+        """An overflowing row removed by an EARLIER filter step in the
+        same stage must not raise (the error mask is confined to live
+        rows)."""
+        sess.conf.set(ANSI, True)
+        try:
+            t = pa.table({"x": pa.array([300, 5], type=pa.int64())})
+            df = sess.create_dataframe(t).filter(F.col("x") < 100)
+            rows = _cast(df, "x", T.INT8).collect()
+            assert [r[0] for r in rows] == [5]
+        finally:
+            sess.conf.set(ANSI, False)

@@ -1,0 +1,120 @@
+"""approx_percentile (moments sketch) + pivot (conditional aggregation).
+
+Reference: GpuApproximatePercentile.scala (t-digest sketch buffers merged
+through the two-phase exchange) and AggregateFunctions.scala PivotFirst.
+Here the sketch is a moments sketch (n, Σx..Σx⁴, min, max — every buffer
+sum/min/max-reducible, so it merges through the same exchange machinery);
+pivot lowers each (value, aggregate) pair to agg(when(p == v, child)).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess(fresh_session):
+    return fresh_session
+
+
+class TestApproxPercentile:
+    def test_grouped_vs_exact_smooth(self, sess, rng):
+        n = 40000
+        t = pa.table({"k": pa.array(rng.integers(0, 5, n)),
+                      "v": pa.array(rng.normal(100.0, 15.0, n))})
+        df = (sess.create_dataframe(t).group_by("k")
+              .agg(F.percentile_approx(F.col("v"), 0.5).alias("p50"),
+                   F.percentile_approx(F.col("v"), 0.9).alias("p90")))
+        got = {r[0]: (r[1], r[2]) for r in df.collect()}
+        pdf = t.to_pandas()
+        for k, g in pdf.groupby("k"):
+            e50 = g.v.quantile(0.5)
+            e90 = g.v.quantile(0.9)
+            # distributional accuracy: within 5% of the IQR-scale spread
+            tol = 0.05 * (g.v.quantile(0.95) - g.v.quantile(0.05))
+            assert abs(got[k][0] - e50) < tol, (k, got[k][0], e50)
+            assert abs(got[k][1] - e90) < tol, (k, got[k][1], e90)
+
+    def test_ungrouped_and_bounds(self, sess, rng):
+        n = 10000
+        t = pa.table({"v": pa.array(rng.uniform(0.0, 10.0, n))})
+        df = sess.create_dataframe(t).agg(
+            F.percentile_approx(F.col("v"), 0.01).alias("lo"),
+            F.percentile_approx(F.col("v"), 0.99).alias("hi"))
+        lo, hi = df.collect()[0]
+        # estimates are clamped to the observed [min, max]
+        assert 0.0 <= lo <= 1.0
+        assert 9.0 <= hi <= 10.0
+
+    def test_merges_across_batches(self, sess, rng):
+        """Small batchSizeRows forces multi-batch partial merges: the
+        sketch buffers must combine associatively."""
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 512)
+        try:
+            n = 8000
+            t = pa.table({"k": pa.array(rng.integers(0, 3, n)),
+                          "v": pa.array(rng.normal(0.0, 1.0, n))})
+            df = (sess.create_dataframe(t).group_by("k")
+                  .agg(F.percentile_approx(F.col("v"), 0.5).alias("m")))
+            got = {r[0]: r[1] for r in df.collect()}
+            pdf = t.to_pandas()
+            for k, g in pdf.groupby("k"):
+                assert abs(got[k] - g.v.median()) < 0.15
+        finally:
+            sess.conf.unset("spark.rapids.tpu.sql.batchSizeRows")
+
+    def test_null_and_empty_groups(self, sess):
+        t = pa.table({"k": pa.array([1, 1, 2], type=pa.int64()),
+                      "v": pa.array([5.0, None, None])})
+        df = (sess.create_dataframe(t).group_by("k")
+              .agg(F.percentile_approx(F.col("v"), 0.5).alias("m")))
+        got = {r[0]: r[1] for r in df.collect()}
+        assert got[1] == 5.0
+        assert got[2] is None
+
+
+class TestPivot:
+    def test_pivot_sum(self, sess):
+        t = pa.table({"g": [1, 1, 2, 2, 2], "p": ["a", "b", "a", "a", "b"],
+                      "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+        rows = sorted(sess.create_dataframe(t).group_by("g")
+                      .pivot("p", ["a", "b"]).agg(F.sum(F.col("v")))
+                      .collect())
+        assert rows == [(1, 1.0, 2.0), (2, 7.0, 5.0)]
+
+    def test_pivot_missing_combo_is_null_or_zero(self, sess):
+        t = pa.table({"g": [1, 2], "p": ["a", "b"], "v": [1.0, 2.0]})
+        rows = sorted(sess.create_dataframe(t).group_by("g")
+                      .pivot("p", ["a", "b"]).agg(F.min(F.col("v")))
+                      .collect())
+        assert rows[0][1] == 1.0 and rows[0][2] is None
+        assert rows[1][1] is None and rows[1][2] == 2.0
+
+    def test_pivot_count_star(self, sess):
+        t = pa.table({"g": [1, 1, 1, 2], "p": ["a", "a", "b", "b"],
+                      "v": [1.0, 2.0, 3.0, 4.0]})
+        rows = sorted(sess.create_dataframe(t).group_by("g")
+                      .pivot("p", ["a", "b"]).count().collect())
+        assert rows == [(1, 2, 1), (2, 0, 1)]
+
+    def test_pivot_multiple_aggs(self, sess):
+        t = pa.table({"g": [1, 1, 2], "p": ["a", "b", "a"],
+                      "v": [1.0, 2.0, 3.0]})
+        df = (sess.create_dataframe(t).group_by("g")
+              .pivot("p", ["a", "b"])
+              .agg(F.sum(F.col("v")).alias("s"),
+                   F.count(F.col("v")).alias("c")))
+        assert df.columns == ["g", "a_s", "a_c", "b_s", "b_c"]
+        rows = sorted(df.collect())
+        assert rows[0] == (1, 1.0, 1, 2.0, 1)
+        assert rows[1] == (2, 3.0, 1, None, 0)
+
+    def test_pivot_string_values_on_strings(self, sess):
+        t = pa.table({"g": ["x", "x", "y"], "p": ["a", "b", "a"],
+                      "v": [10, 20, 30]})
+        rows = sorted(sess.create_dataframe(t).group_by("g")
+                      .pivot("p", ["a", "b"]).agg(F.sum(F.col("v")))
+                      .collect())
+        assert rows == [("x", 10, 20), ("y", 30, None)]

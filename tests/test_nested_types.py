@@ -1,0 +1,241 @@
+"""Nested types v1: ARRAY/STRUCT columns as data + collection/JSON exprs.
+
+Reference parity: complexTypeCreator.scala (array/struct creators),
+complexTypeExtractors.scala (GetArrayItem/GetStructField/ElementAt),
+collectionOperations.scala (size/sort_array/array_* ops),
+GpuGetJsonObject.scala and GpuJsonToStructs.scala (JSON expressions).
+Nested columns ride as host arrow columns; expressions evaluate through
+the host-lowering machinery (plan/stringpred.py) inside device stages.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess(fresh_session):
+    return fresh_session
+
+
+def _list_table(tmp_path):
+    t = pa.table({
+        "id": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "xs": pa.array([[3, 1, 2], [], None, [5, None, 5]],
+                       type=pa.list_(pa.int64())),
+        "v": pa.array([1.5, 2.5, 3.5, 4.5]),
+    })
+    p = os.path.join(str(tmp_path), "lists.parquet")
+    pq.write_table(t, p)
+    return p
+
+
+class TestArrayColumns:
+    def test_parquet_roundtrip_query_collect(self, sess, tmp_path):
+        p = _list_table(tmp_path)
+        df = sess.read_parquet(p)
+        rows = df.collect()
+        assert rows[0][1] == [3, 1, 2]
+        assert rows[2][1] is None
+        assert rows[3][1] == [5, None, 5]
+
+    def test_write_array_column(self, sess, tmp_path):
+        p = _list_table(tmp_path)
+        out = os.path.join(str(tmp_path), "out.parquet")
+        sess.read_parquet(p).write.parquet(out)
+        back = pq.read_table(out)
+        assert back.column("xs").to_pylist() == [[3, 1, 2], [], None,
+                                                 [5, None, 5]]
+
+    def test_size_element_at(self, sess, tmp_path):
+        df = sess.read_parquet(_list_table(tmp_path))
+        rows = df.select(
+            F.col("id"),
+            F.size(F.col("xs")).alias("n"),
+            F.element_at(F.col("xs"), F.lit(1)).alias("e1"),
+            F.element_at(F.col("xs"), F.lit(-1)).alias("em1"),
+        ).collect()
+        assert [r[1] for r in rows] == [3, 0, -1, 3]  # size(NULL) = -1
+        assert [r[2] for r in rows] == [3, None, None, 5]
+        assert [r[3] for r in rows] == [2, None, None, 5]
+
+    def test_get_item_zero_based(self, sess, tmp_path):
+        df = sess.read_parquet(_list_table(tmp_path))
+        rows = df.select(F.col("xs").getItem(0).alias("x0")).collect()
+        assert [r[0] for r in rows] == [3, None, None, 5]
+
+    def test_sort_distinct_min_max_position(self, sess, tmp_path):
+        df = sess.read_parquet(_list_table(tmp_path))
+        rows = df.select(
+            F.sort_array(F.col("xs")).alias("s"),
+            F.array_distinct(F.col("xs")).alias("d"),
+            F.array_min(F.col("xs")).alias("mn"),
+            F.array_max(F.col("xs")).alias("mx"),
+            F.array_position(F.col("xs"), F.lit(5)).alias("p"),
+        ).collect()
+        assert rows[0][0] == [1, 2, 3]
+        assert rows[3][0] == [None, 5, 5]  # nulls first ascending
+        assert rows[3][1] == [5, None]
+        assert rows[0][2] == 1 and rows[0][3] == 3
+        assert rows[1][2] is None  # empty → null min
+        assert rows[3][4] == 1
+        assert rows[0][4] == 0     # absent → 0
+
+    def test_array_contains_three_valued(self, sess):
+        t = pa.table({"xs": pa.array([[1, 2], [1, None], None],
+                                     type=pa.list_(pa.int64()))})
+        df = sess.create_dataframe(t)
+        rows = df.select(
+            F.array_contains(F.col("xs"), F.lit(2)).alias("c2")).collect()
+        assert rows[0][0] is True
+        assert rows[1][0] is None   # not found + array has null → NULL
+        assert rows[2][0] is None   # null array → NULL
+
+    def test_slice_flatten_join_setops(self, sess):
+        t = pa.table({
+            "xs": pa.array([[1, 2, 3, 4]], type=pa.list_(pa.int64())),
+            "ys": pa.array([[3, 4, 5]], type=pa.list_(pa.int64())),
+            "nested": pa.array([[[1, 2], [3]]],
+                               type=pa.list_(pa.list_(pa.int64()))),
+        })
+        df = sess.create_dataframe(t)
+        r = df.select(
+            F.slice(F.col("xs"), F.lit(2), F.lit(2)).alias("sl"),
+            F.flatten(F.col("nested")).alias("fl"),
+            F.array_join(F.col("xs"), "-").alias("j"),
+            F.array_union(F.col("xs"), F.col("ys")).alias("u"),
+            F.array_intersect(F.col("xs"), F.col("ys")).alias("i"),
+            F.array_except(F.col("xs"), F.col("ys")).alias("e"),
+        ).collect()[0]
+        assert r[0] == [2, 3]
+        assert r[1] == [1, 2, 3]
+        assert r[2] == "1-2-3-4"
+        assert r[3] == [1, 2, 3, 4, 5]
+        assert r[4] == [3, 4]
+        assert r[5] == [1, 2]
+
+    def test_creator_from_device_columns(self, sess):
+        t = pa.table({"a": [1, 2, None], "b": [10, 20, 30]})
+        df = sess.create_dataframe(t)
+        rows = df.select(F.array(F.col("a"), F.col("b")).alias("arr"),
+                         F.col("b")).collect()
+        assert rows[0][0] == [1, 10]
+        assert rows[2][0] == [None, 30]  # null element kept
+
+    def test_filter_on_size_fuses_as_extras(self, sess, tmp_path):
+        """size() is a device-typed output over a host-carried ref: it
+        lowers to a precomputed extras column inside the fused stage."""
+        df = sess.read_parquet(_list_table(tmp_path))
+        rows = df.filter(F.size(F.col("xs")) > 0).select(F.col("id")) \
+                 .collect()
+        assert [r[0] for r in rows] == [1, 4]
+
+    def test_explode_created_array(self, sess):
+        t = pa.table({"a": [1, 2], "b": [10, 20]})
+        df = sess.create_dataframe(t)
+        arr = df.select(F.col("a"),
+                        F.array(F.col("a"), F.col("b")).alias("arr"))
+        rows = arr.explode("arr", "x").select(F.col("a"), F.col("x")) \
+                  .collect()
+        assert sorted(rows) == [(1, 1), (1, 10), (2, 2), (2, 20)]
+
+    def test_collect_list_then_element_at(self, sess, rng):
+        t = pa.table({"k": pa.array([1, 1, 2, 2, 2], type=pa.int64()),
+                      "v": pa.array([5, 6, 7, 8, 9], type=pa.int64())})
+        agg = (sess.create_dataframe(t).group_by("k")
+               .agg(F.collect_list(F.col("v")).alias("vs")))
+        rows = agg.select(F.col("k"), F.size(F.col("vs")).alias("n"),
+                          F.sort_array(F.col("vs")).alias("s")).collect()
+        m = {r[0]: (r[1], r[2]) for r in rows}
+        assert m[1] == (2, [5, 6])
+        assert m[2] == (3, [7, 8, 9])
+
+
+class TestStructColumns:
+    def test_struct_create_get_field(self, sess):
+        t = pa.table({"a": [1, 2, None], "s": ["x", None, "z"]})
+        df = sess.create_dataframe(t)
+        st = df.select(F.struct(F.col("a"), F.col("s")).alias("st"))
+        rows = st.collect()
+        assert rows[0][0] == {"a": 1, "s": "x"}
+        assert rows[1][0] == {"a": 2, "s": None}
+        back = st.select(F.col("st").getField("a").alias("a"),
+                         F.col("st").getItem("s").alias("s")).collect()
+        assert back == [(1, "x"), (2, None), (None, "z")]
+
+    def test_struct_parquet_roundtrip(self, sess, tmp_path):
+        t = pa.table({
+            "id": pa.array([1, 2], type=pa.int64()),
+            "st": pa.array([{"x": 1, "y": "a"}, None],
+                           type=pa.struct([("x", pa.int64()),
+                                           ("y", pa.string())])),
+        })
+        p = os.path.join(str(tmp_path), "st.parquet")
+        pq.write_table(t, p)
+        df = sess.read_parquet(p)
+        rows = df.select(F.col("id"),
+                         F.col("st").getField("x").alias("x")).collect()
+        assert rows == [(1, 1), (2, None)]
+
+    def test_get_field_feeds_device_compute(self, sess):
+        """st.x + 1 — the extractor output is device-typed, so arithmetic
+        over it fuses into the stage via the extras path."""
+        t = pa.table({"a": [1, 2, 3], "s": ["u", "v", "w"]})
+        df = sess.create_dataframe(t)
+        st = df.select(F.struct(F.col("a"), F.col("s")).alias("st"))
+        rows = st.select(
+            (F.col("st").getField("a") + 1).alias("a1")).collect()
+        assert [r[0] for r in rows] == [2, 3, 4]
+
+
+class TestJson:
+    def test_get_json_object(self, sess):
+        t = pa.table({"j": ['{"a":1,"b":{"c":"hi"},"xs":[10,20]}',
+                            '{"a":2}', "notjson", None]})
+        df = sess.create_dataframe(t)
+        rows = df.select(
+            F.get_json_object(F.col("j"), "$.a").alias("a"),
+            F.get_json_object(F.col("j"), "$.b.c").alias("c"),
+            F.get_json_object(F.col("j"), "$.xs[1]").alias("x1"),
+            F.get_json_object(F.col("j"), "$.b").alias("b"),
+        ).collect()
+        assert rows[0] == ("1", "hi", "20", '{"c":"hi"}')
+        assert rows[1] == ("2", None, None, None)
+        assert rows[2] == (None, None, None, None)
+        assert rows[3] == (None, None, None, None)
+
+    def test_from_json_struct_and_to_json(self, sess):
+        schema = T.struct([("a", T.INT64), ("c", T.STRING)])
+        t = pa.table({"j": ['{"a":1,"c":"x"}', '{"a":"bad"}', "zzz"]})
+        df = sess.create_dataframe(t)
+        rows = df.select(F.from_json(F.col("j"), schema).alias("st")) \
+                 .collect()
+        assert rows[0][0] == {"a": 1, "c": "x"}
+        assert rows[1][0] == {"a": None, "c": None}
+        assert rows[2][0] is None
+        rows2 = df.select(F.to_json(
+            F.from_json(F.col("j"), schema)).alias("js")).collect()
+        assert rows2[0][0] == '{"a":1,"c":"x"}'
+
+    def test_get_json_object_wildcard(self, sess):
+        t = pa.table({"j": ['{"a":[{"b":1},{"b":2}]}', '{"a":[]}']})
+        df = sess.create_dataframe(t)
+        rows = df.select(
+            F.get_json_object(F.col("j"), "$.a[*].b").alias("bs")).collect()
+        assert rows[0][0] == "[1,2]"
+        assert rows[1][0] is None
+
+    def test_from_json_array_schema(self, sess):
+        schema = T.array(T.INT64)
+        t = pa.table({"j": ["[1,2,3]", "{}"]})
+        df = sess.create_dataframe(t)
+        rows = df.select(F.from_json(F.col("j"), schema).alias("xs"),
+                         ).collect()
+        assert rows[0][0] == [1, 2, 3]
+        assert rows[1][0] is None
