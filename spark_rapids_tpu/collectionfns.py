@@ -49,6 +49,23 @@ def _py(x):
     return x.item() if isinstance(x, np.generic) else x
 
 
+def _physical(val, dt: T.DataType):
+    """Logical python value → the engine's device representation
+    (decimal → scaled int, date → epoch days, timestamp → epoch micros;
+    the convention batch.from_arrow establishes)."""
+    import datetime
+    import decimal
+    if dt.is_decimal and isinstance(val, decimal.Decimal):
+        return int(val.scaleb(dt.scale))
+    if dt.kind == T.TypeKind.DATE and isinstance(val, datetime.date):
+        return (val - datetime.date(1970, 1, 1)).days
+    if dt.kind == T.TypeKind.TIMESTAMP and isinstance(val,
+                                                      datetime.datetime):
+        epoch = datetime.datetime(1970, 1, 1, tzinfo=val.tzinfo)
+        return int((val - epoch).total_seconds() * 1_000_000)
+    return val
+
+
 class CollectionExpression(Expression):
     """Base: host-only evaluation (the output — or at least one input —
     has no device representation)."""
@@ -90,7 +107,7 @@ class CollectionExpression(Expression):
             dense = np.zeros(n, dtype=self.dtype.numpy_dtype)
             for i in range(n):
                 if ok[i]:
-                    dense[i] = out[i]
+                    dense[i] = _physical(out[i], self.dtype)
             return dense, (None if ok.all() else ok)
         return out, (None if ok.all() else ok)
 

@@ -274,12 +274,9 @@ MAX_READER_BATCH_BYTES = register(
 
 HASH_SUBPARTITIONS = register(
     "spark.rapids.tpu.sql.join.subPartitions", 16,
-    "Sub-partition count used when a join build side is too large for HBM.")
-
-JOIN_OUTPUT_GROWTH = register(
-    "spark.rapids.tpu.sql.join.outputGrowthFactor", 2.0,
-    "Initial output-capacity multiple assumed for join results; overflow "
-    "triggers split-and-retry of the probe batch.")
+    "Fan-out used to re-partition an OVERSIZED shuffled-join partition "
+    "pair (combined rows above sql.batchSizeRows) by a second independent "
+    "key hash before joining (GpuSubPartitionHashJoin analog).")
 
 ANSI_ENABLED = register(
     "spark.rapids.tpu.sql.ansi.enabled", False,

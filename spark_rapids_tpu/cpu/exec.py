@@ -771,8 +771,6 @@ class CpuOpExec(TpuExec):
                "full_outer": "full", "left_semi": "semi",
                "left_anti": "anti"}.get(p.how, p.how)
         using = getattr(p, "using", None)
-        if using is None and how != "cross":
-            raise NotImplementedError("CPU join requires 'using' keys")
         lpd, rpd = lt.to_pandas(), rt.to_pandas()
         lpd = lpd.reset_index(drop=True)
         rpd = rpd.reset_index(drop=True)
@@ -780,7 +778,7 @@ class CpuOpExec(TpuExec):
         if how == "cross":
             li = np.repeat(np.arange(len(lpd)), len(rpd))
             ri = np.tile(np.arange(len(rpd)), len(lpd))
-        else:
+        elif using:
             lk = lpd[using].copy()
             rk = rpd[using].copy()
             lk["__li"] = np.arange(len(lpd))
@@ -789,6 +787,23 @@ class CpuOpExec(TpuExec):
             lk = lk.dropna(subset=using)
             rk = rk.dropna(subset=using)
             pairs = lk.merge(rk, on=using, how="inner")
+            li = pairs["__li"].to_numpy()
+            ri = pairs["__ri"].to_numpy()
+        else:
+            # pair-keyed join (distinct key names on each side)
+            lnames = [getattr(k, "name", None) for k in p.left_keys]
+            rnames = [getattr(k, "name", None) for k in p.right_keys]
+            if not all(lnames) or not all(rnames):
+                raise NotImplementedError(
+                    "CPU join requires bare column join keys")
+            lk = lpd[lnames].copy()
+            rk = rpd[rnames].copy()
+            lk["__li"] = np.arange(len(lpd))
+            rk["__ri"] = np.arange(len(rpd))
+            lk = lk.dropna(subset=lnames)
+            rk = rk.dropna(subset=rnames)
+            pairs = lk.merge(rk, left_on=lnames, right_on=rnames,
+                             how="inner")
             li = pairs["__li"].to_numpy()
             ri = pairs["__ri"].to_numpy()
 

@@ -157,9 +157,13 @@ class SortMergeJoinExec(TpuExec):
             # shuffled join: equal keys land in the same partition on both
             # sides, so partition pairs join independently (bounded memory)
             lgen, rgen = lchild.execute(ctx), rchild.execute(ctx)
+            limit = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
             try:
                 for lb, rb in zip(lgen, rgen):
                     if lb.num_rows == 0 and rb.num_rows == 0:
+                        continue
+                    if lb.num_rows + rb.num_rows > limit:
+                        yield from self._sub_partition_join(ctx, m, lb, rb)
                         continue
                     yield self._join_pair(ctx, m, lb, rb)
             finally:
@@ -178,8 +182,72 @@ class SortMergeJoinExec(TpuExec):
             lh.close()
             rh.close()
 
+    def _sub_partition_join(self, ctx, m, lb: ColumnBatch, rb: ColumnBatch
+                            ) -> Iterator[ColumnBatch]:
+        """Re-partition an OVERSIZED partition pair (a skewed/huge hash
+        bucket) into sub-pairs by a SECOND, independent key hash
+        (xxhash64, vs the exchange's murmur3) and join each sub-pair —
+        exact for every join type since equal keys still co-locate.
+        GpuSubPartitionHashJoin.scala analog; spark.rapids.tpu.sql.join.
+        subPartitions controls the fan-out."""
+        from ..ops.hashing import xxhash64_columns
+        k = max(2, ctx.conf["spark.rapids.tpu.sql.join.subPartitions"])
+        m.add("subPartitionedPairs", 1)
+        lk, rk, common = self._bound_keys()
+
+        def sub_pid_fn(keys):
+            fp = ("join-subpid|" + str(k) + "|"
+                  + "|".join(e.fingerprint() for e in keys))
+
+            def build():
+                @jax.jit
+                def f(arrays, sel, num_rows):
+                    cap = next(a[0].shape[0] for a in arrays
+                               if a is not None)
+                    active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                    if sel is not None:
+                        active = active & sel
+                    ectx = EvalContext(list(arrays), cap, active=active)
+                    kvs = [e.eval(ectx) for e in keys]
+                    kvs = [(d, v) if ct.is_string
+                           else (promote_physical(d, e.dtype, ct), v)
+                           for (d, v), e, ct in zip(kvs, keys, common)]
+                    h = xxhash64_columns(kvs)
+                    pid = (h % jnp.int64(k)).astype(jnp.int32)
+                    pid = jnp.where(pid < 0, pid + k, pid)
+                    return jnp.where(active, pid, k)
+                return f
+
+            return _cached_program(fp, build)
+
+        def split(batch, keys):
+            arrays = _dev_arrays(batch)
+            arrays = encode_key_arrays(arrays, batch, keys,
+                                       self.string_dicts)
+            pids = sub_pid_fn(keys)(arrays, batch.sel,
+                                    np.int32(batch.num_rows))
+            outs = []
+            for p in range(k):
+                sel = pids == p
+                outs.append(batch_utils.compact(ColumnBatch(
+                    batch.schema, batch.columns, batch.num_rows, sel)))
+            return outs
+
+        l_parts = split(lb, lk)
+        r_parts = split(rb, rk)
+        for lp, rp in zip(l_parts, r_parts):
+            if lp.num_rows == 0 and rp.num_rows == 0:
+                continue
+            yield self._join_pair(ctx, m, lp, rp)
+
     def _join_pair(self, ctx, m, left: ColumnBatch,
                    right: ColumnBatch) -> ColumnBatch:
+        if self.condition is not None and self.how in ("left", "semi",
+                                                       "anti"):
+            with m.time("opTime"):
+                out = self._conditioned_probe_join(left, right)
+            m.add("numOutputRows", out.row_count())
+            return out
         with m.time("opTime"):
             out = self._join(left, right)
         if self.condition is not None:
@@ -188,6 +256,103 @@ class SortMergeJoinExec(TpuExec):
         # must be reflected in the metric
         m.add("numOutputRows", out.row_count())
         return out
+
+    def _conditioned_probe_join(self, left: ColumnBatch,
+                                right: ColumnBatch) -> ColumnBatch:
+        """Residual conditions on left/semi/anti joins: the condition
+        participates in MATCHING (GpuHashJoin.scala conditional joins),
+        not post-filtering.  Shape: inner candidate expansion → evaluate
+        the condition on the pairs → per-probe surviving-match counts →
+        semi/anti select probe rows; left additionally null-pads probes
+        with zero surviving matches."""
+        from ..exprs import bind
+        how = self.how
+        lo, matches, b_perm = self._match_state(left, right, probe_side=0)
+        p_cap, b_cap = left.capacity, right.capacity
+        active = jnp.arange(p_cap, dtype=jnp.int32) < left.num_rows
+        if left.sel is not None:
+            active = active & left.sel
+        counts = jnp.where(active, matches, 0)
+        offsets = jnp.cumsum(counts)
+        total = int(offsets[-1])  # one host sync: candidate-pair count
+        out_cap = bucket_capacity(max(total, 1))
+
+        fp = self._fingerprint() + "|condexpand"
+
+        def build_fn():
+            @jax.jit
+            def f(offsets, lo, matches, b_perm, out_cap_arr):
+                out_cap_ = out_cap_arr.shape[0]
+                j = jnp.arange(out_cap_, dtype=jnp.int32)
+                pi = jnp.searchsorted(offsets, j,
+                                      side="right").astype(jnp.int32)
+                pi_c = jnp.clip(pi, 0, offsets.shape[0] - 1)
+                start = jnp.where(pi_c > 0,
+                                  offsets[jnp.clip(pi_c - 1, 0, None)], 0)
+                k = j - start
+                in_range = k < matches[pi_c]
+                bi = b_perm[jnp.clip(lo[pi_c] + k, 0,
+                                     b_perm.shape[0] - 1)]
+                return pi_c, jnp.where(in_range, bi, -1), in_range
+            return f
+
+        fn = _cached_program("join-condexpand|" + fp, build_fn)
+        pi, bi, in_range = fn(offsets, lo, matches, b_perm,
+                              jnp.zeros((out_cap,), dtype=jnp.int8))
+
+        # pair columns in (left ++ right) order for condition binding
+        combined = Schema(list(left.schema.fields)
+                          + list(right.schema.fields))
+        p_cols = _gather_cols(left, jnp.where(in_range, pi, -1),
+                              valid_if="neg_is_null")
+        b_cols = _gather_cols(right, bi, valid_if="neg_is_null")
+        pair = ColumnBatch(combined, p_cols["cols"] + b_cols["cols"],
+                           out_cap, in_range)
+        cond = bind(self.condition, combined)
+
+        def build_cond():
+            @jax.jit
+            def g(arrays, sel, pi, p_cap_arr):
+                cap = next(a[0].shape[0] for a in arrays if a is not None)
+                act = sel
+                ectx = EvalContext(list(arrays), cap, active=act)
+                d, v = cond.eval(ectx)
+                keep = d if v is None else (d & v)
+                keep = keep & act
+                surviving = jax.ops.segment_sum(
+                    keep.astype(jnp.int32), pi,
+                    num_segments=p_cap_arr.shape[0])
+                return keep, surviving
+            return g
+
+        gfn = _cached_program(
+            "join-cond|" + fp + "|" + cond.fingerprint(), build_cond)
+        arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
+                       else None for c in pair.columns)
+        keep, surviving = gfn(arrays, in_range, pi,
+                              jnp.zeros((p_cap,), dtype=jnp.int8))
+
+        if how in ("semi", "anti"):
+            sel = (surviving > 0) if how == "semi" else (surviving == 0)
+            return ColumnBatch(self._schema, left.columns, left.num_rows,
+                               sel & active)
+        # left outer: surviving pairs + null-padded unmatched probes
+        matched_out = ColumnBatch(self._schema, pair.columns, out_cap, keep)
+        from ..batch import logical_to_arrow
+        pad_cols: List = list(left.columns)
+        for f in right.schema:
+            if f.dtype.is_host_carried:
+                import pyarrow as pa
+                pad_cols.append(HostStringColumn(
+                    pa.nulls(p_cap, type=logical_to_arrow(f.dtype))))
+            else:
+                pad_cols.append(DeviceColumn(
+                    f.dtype,
+                    jnp.zeros((p_cap,), dtype=f.dtype.numpy_dtype),
+                    jnp.zeros((p_cap,), dtype=bool)))
+        padded = ColumnBatch(self._schema, pad_cols, left.num_rows,
+                             active & (surviving == 0))
+        return batch_utils.concat_batches([matched_out, padded])
 
     def _apply_residual(self, batch: ColumnBatch) -> ColumnBatch:
         """Inner-join residual condition as a post-selection (non-equi part).

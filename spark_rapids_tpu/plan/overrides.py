@@ -20,6 +20,7 @@ from typing import List, Optional, Tuple
 from .. import exprs as E
 from ..aggfns import AGG_CLASSES, AggregateExpression
 from ..config import TpuConf
+from ..batch import Schema
 from ..exprs import BoundReference, Expression, bind
 from . import logical as L
 from .physical import AggregateExec, ScanExec, StageExec, TpuExec
@@ -232,10 +233,23 @@ class NodeMeta:
                              "right_outer", "full", "full_outer", "semi",
                              "anti", "left_semi", "left_anti", "cross"):
                 self.will_not_work(f"join type {p.how} not supported")
-            if p.condition is not None and p.how != "inner":
+            cond_ok = ("inner", "left", "left_outer", "semi", "anti",
+                       "left_semi", "left_anti")
+            if p.condition is not None and p.how not in cond_ok:
                 self.will_not_work(
-                    "non-equi residual condition on outer/semi joins "
+                    "non-equi residual condition on right/full joins "
                     "changes match semantics (CPU fallback)")
+            if p.condition is not None and p.how in (
+                    "left", "left_outer") and getattr(p, "using", None):
+                self.will_not_work(
+                    "conditioned left USING join (coalesced key columns) "
+                    "runs on CPU")
+            if p.condition is not None and p.how in cond_ok:
+                schema_all = Schema(list(schema_l.fields)
+                                    + list(schema_r.fields))
+                for r in expr_reasons(bind(p.condition, schema_all),
+                                      allow_string_passthrough=False):
+                    self.will_not_work(f"join condition: {r}")
             return
         if isinstance(p, L.Expand):
             schema = p.children[0].schema()

@@ -254,7 +254,10 @@ def evaluate_host_expr(expr: E.Expression, ords: List[int], columns,
     single_string = (
         len(ords) == 1
         and isinstance(columns[ords[0]], HostStringColumn)
-        and pa.types.is_string(columns[ords[0]].array.type))
+        and pa.types.is_string(columns[ords[0]].array.type)
+        # nested outputs have list/struct null_data that cannot ride the
+        # np.where dictionary-broadcast; they take the per-row path
+        and not expr.dtype.is_nested)
     if single_string:
         arr = columns[ords[0]].array.slice(0, num_rows)
         denc = arr.dictionary_encode()

@@ -366,6 +366,10 @@ class PivotedData:
             node.children = tuple(
                 E.If(cond, ch, E.Literal(None, None))
                 for ch in core.children)
+            if hasattr(node, "ignore_nulls"):
+                # non-matching rows became NULLs: first/last must skip
+                # them or they would return the injected NULLs
+                node.ignore_nulls = True
             return node
 
         def default_name(c):

@@ -54,110 +54,106 @@ def broadcast(df):
     return df.hint("broadcast")
 
 
-def _col(x):
-    from .column import Column as _C
-    if isinstance(x, _C):
-        return x.expr
-    return E.Literal(x)
+
 
 
 # -- collections / nested types (complexTypeCreator / collectionOperations) --
 
 def array(*cols) -> Column:
     from .. import collectionfns as C
-    return Column(C.CreateArray(*[_col(c) for c in cols]))
+    return Column(C.CreateArray(*[to_expr(c) for c in cols]))
 
 
 def struct(*cols) -> Column:
     from .. import collectionfns as C
     names = [getattr(c, "name", None) or f"col{i + 1}"
              for i, c in enumerate(cols)]
-    return Column(C.CreateStruct(names, *[_col(c) for c in cols]))
+    return Column(C.CreateStruct(names, *[to_expr(c) for c in cols]))
 
 
 def element_at(col_, idx) -> Column:
     from .. import collectionfns as C
-    return Column(C.ElementAt(_col(col_), _col(idx)))
+    return Column(C.ElementAt(to_expr(col_), to_expr(idx)))
 
 
 def size(col_) -> Column:
     from .. import collectionfns as C
-    return Column(C.Size(_col(col_)))
+    return Column(C.Size(to_expr(col_)))
 
 
 def array_contains(col_, value) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayContains(_col(col_), _col(value)))
+    return Column(C.ArrayContains(to_expr(col_), to_expr(value)))
 
 
 def sort_array(col_, asc: bool = True) -> Column:
     from .. import collectionfns as C
-    return Column(C.SortArray(_col(col_), asc))
+    return Column(C.SortArray(to_expr(col_), asc))
 
 
 def array_distinct(col_) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayDistinct(_col(col_)))
+    return Column(C.ArrayDistinct(to_expr(col_)))
 
 
 def array_min(col_) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayMin(_col(col_)))
+    return Column(C.ArrayMin(to_expr(col_)))
 
 
 def array_max(col_) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayMax(_col(col_)))
+    return Column(C.ArrayMax(to_expr(col_)))
 
 
 def array_position(col_, value) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayPosition(_col(col_), _col(value)))
+    return Column(C.ArrayPosition(to_expr(col_), to_expr(value)))
 
 
 def slice(col_, start, length) -> Column:  # noqa: A001 — pyspark naming
     from .. import collectionfns as C
-    return Column(C.Slice(_col(col_), _col(start), _col(length)))
+    return Column(C.Slice(to_expr(col_), to_expr(start), to_expr(length)))
 
 
 def flatten(col_) -> Column:
     from .. import collectionfns as C
-    return Column(C.Flatten(_col(col_)))
+    return Column(C.Flatten(to_expr(col_)))
 
 
 def array_join(col_, delimiter: str, null_replacement=None) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayJoin(_col(col_), delimiter, null_replacement))
+    return Column(C.ArrayJoin(to_expr(col_), delimiter, null_replacement))
 
 
 def array_union(a, b) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayUnion(_col(a), _col(b)))
+    return Column(C.ArrayUnion(to_expr(a), to_expr(b)))
 
 
 def array_intersect(a, b) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayIntersect(_col(a), _col(b)))
+    return Column(C.ArrayIntersect(to_expr(a), to_expr(b)))
 
 
 def array_except(a, b) -> Column:
     from .. import collectionfns as C
-    return Column(C.ArrayExcept(_col(a), _col(b)))
+    return Column(C.ArrayExcept(to_expr(a), to_expr(b)))
 
 
 def get_json_object(col_, path: str) -> Column:
     from .. import collectionfns as C
-    return Column(C.GetJsonObject(_col(col_), path))
+    return Column(C.GetJsonObject(to_expr(col_), path))
 
 
 def from_json(col_, schema) -> Column:
     from .. import collectionfns as C
-    return Column(C.FromJson(_col(col_), schema))
+    return Column(C.FromJson(to_expr(col_), schema))
 
 
 def to_json(col_) -> Column:
     from .. import collectionfns as C
-    return Column(C.ToJson(_col(col_)))
+    return Column(C.ToJson(to_expr(col_)))
 
 
 def lit(value: Any, dtype: Optional[T.DataType] = None) -> Column:
@@ -673,13 +669,24 @@ def percentile(c, q: float) -> Column:
 
 
 def percentile_approx(c, q: float, accuracy: int = 10000) -> Column:
-    """Approximate percentile via a device moments sketch (mergeable
-    fixed-width buffers; GpuApproximatePercentile analog — accuracy is
-    distributional, see aggfns.ApproxPercentile)."""
-    return Column(A.ApproxPercentile(_colref(c), q, accuracy))
+    """Spark-contract approximate percentile. Defaults to the EXACT
+    percentile (rank error 0 <= n/accuracy, trivially satisfying the
+    contract; CPU-operator path). For a device-resident mergeable
+    estimator that flows through the two-phase exchange, use
+    ``moments_percentile`` (distributional accuracy, no rank bound)."""
+    return Column(A.Percentile(_colref(c), q))
 
 
 approx_percentile = percentile_approx
+
+
+def moments_percentile(c, q: float) -> Column:
+    """Device moments-sketch percentile estimate (aggfns.ApproxPercentile:
+    n, sum(x..x^4), min, max buffers — sum/min/max reducible, so the
+    sketch merges through the exchange like the reference's t-digest).
+    Accuracy is distributional (good on smooth data), NOT rank-bounded —
+    prefer percentile_approx when the Spark contract matters."""
+    return Column(A.ApproxPercentile(_colref(c), q))
 
 
 # -- user-defined functions (RapidsUDF / GpuUserDefinedFunction analogs) ----------

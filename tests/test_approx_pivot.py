@@ -20,13 +20,22 @@ def sess(fresh_session):
 
 
 class TestApproxPercentile:
+    def test_percentile_approx_rank_exact_default(self, sess, rng):
+        """percentile_approx keeps the Spark rank contract by defaulting
+        to the exact percentile — bimodal data is the case a moments
+        estimate gets wrong."""
+        t = pa.table({"v": pa.array([0.0] * 500 + [1000.0] * 500)})
+        r = sess.create_dataframe(t).agg(
+            F.percentile_approx(F.col("v"), 0.25).alias("p")).collect()
+        assert r[0][0] == 0.0
+
     def test_grouped_vs_exact_smooth(self, sess, rng):
         n = 40000
         t = pa.table({"k": pa.array(rng.integers(0, 5, n)),
                       "v": pa.array(rng.normal(100.0, 15.0, n))})
         df = (sess.create_dataframe(t).group_by("k")
-              .agg(F.percentile_approx(F.col("v"), 0.5).alias("p50"),
-                   F.percentile_approx(F.col("v"), 0.9).alias("p90")))
+              .agg(F.moments_percentile(F.col("v"), 0.5).alias("p50"),
+                   F.moments_percentile(F.col("v"), 0.9).alias("p90")))
         got = {r[0]: (r[1], r[2]) for r in df.collect()}
         pdf = t.to_pandas()
         for k, g in pdf.groupby("k"):
@@ -41,8 +50,8 @@ class TestApproxPercentile:
         n = 10000
         t = pa.table({"v": pa.array(rng.uniform(0.0, 10.0, n))})
         df = sess.create_dataframe(t).agg(
-            F.percentile_approx(F.col("v"), 0.01).alias("lo"),
-            F.percentile_approx(F.col("v"), 0.99).alias("hi"))
+            F.moments_percentile(F.col("v"), 0.01).alias("lo"),
+            F.moments_percentile(F.col("v"), 0.99).alias("hi"))
         lo, hi = df.collect()[0]
         # estimates are clamped to the observed [min, max]
         assert 0.0 <= lo <= 1.0
@@ -57,7 +66,7 @@ class TestApproxPercentile:
             t = pa.table({"k": pa.array(rng.integers(0, 3, n)),
                           "v": pa.array(rng.normal(0.0, 1.0, n))})
             df = (sess.create_dataframe(t).group_by("k")
-                  .agg(F.percentile_approx(F.col("v"), 0.5).alias("m")))
+                  .agg(F.moments_percentile(F.col("v"), 0.5).alias("m")))
             got = {r[0]: r[1] for r in df.collect()}
             pdf = t.to_pandas()
             for k, g in pdf.groupby("k"):
@@ -69,7 +78,7 @@ class TestApproxPercentile:
         t = pa.table({"k": pa.array([1, 1, 2], type=pa.int64()),
                       "v": pa.array([5.0, None, None])})
         df = (sess.create_dataframe(t).group_by("k")
-              .agg(F.percentile_approx(F.col("v"), 0.5).alias("m")))
+              .agg(F.moments_percentile(F.col("v"), 0.5).alias("m")))
         got = {r[0]: r[1] for r in df.collect()}
         assert got[1] == 5.0
         assert got[2] is None
@@ -110,6 +119,15 @@ class TestPivot:
         rows = sorted(df.collect())
         assert rows[0] == (1, 1.0, 1, 2.0, 1)
         assert rows[1] == (2, 3.0, 1, None, 0)
+
+    def test_pivot_first_skips_injected_nulls(self, sess):
+        """PivotFirst semantics: first() must return the first MATCHING
+        row's value, not the NULL injected for non-matching rows."""
+        t = pa.table({"g": [1, 1, 1], "p": ["b", "a", "a"],
+                      "v": [9.0, 1.0, 2.0]})
+        rows = (sess.create_dataframe(t).group_by("g")
+                .pivot("p", ["a", "b"]).first("v").collect())
+        assert rows == [(1, 1.0, 9.0)]
 
     def test_pivot_string_values_on_strings(self, sess):
         t = pa.table({"g": ["x", "x", "y"], "p": ["a", "b", "a"],

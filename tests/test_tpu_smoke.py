@@ -1,0 +1,76 @@
+"""On-device smoke subset (round-2 verdict weak #10).
+
+Run on the REAL chip with ``SRT_TESTS_ON_TPU=1 pytest -m tpu_smoke``;
+under the default virtual-CPU conftest these run too (they are fast), so
+the marker set can never rot.  Covers the regimes where the CPU platform
+hides real-device bugs: x64 semantics, padding/capacity buckets, grid
+aggregation, join gathers, window sorts.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.window import Window
+
+pytestmark = pytest.mark.tpu_smoke
+
+
+@pytest.fixture()
+def sess(fresh_session):
+    return fresh_session
+
+
+def test_scan_filter_agg_f64_exact(sess, rng):
+    n = 50_000
+    t = pa.table({"v": pa.array(rng.uniform(0, 1e9, n)),
+                  "d": pa.array(rng.integers(0, 11, n) / 100.0)})
+    got = (sess.create_dataframe(t)
+           .where((F.col("d") >= 0.05) & (F.col("d") <= 0.07))
+           .agg(F.sum(F.col("v") * F.col("d")).alias("s"))).collect()[0][0]
+    pdf = t.to_pandas()
+    m = (pdf.d >= 0.05) & (pdf.d <= 0.07)
+    want = float((pdf.v[m] * pdf.d[m]).sum())
+    assert abs(got - want) <= 1e-9 * abs(want)
+
+
+def test_grouped_agg_grid_and_sort_paths(sess, rng):
+    n = 20_000
+    t = pa.table({"k": pa.array([["A", "B", "C"][i % 3]
+                                 for i in range(n)]),
+                  "hk": pa.array(rng.integers(0, 5000, n)),
+                  "v": pa.array(rng.integers(-100, 100, n)
+                                .astype(np.int64))})
+    df = sess.create_dataframe(t)
+    grid = {r[0]: r[1] for r in
+            df.group_by("k").agg(F.sum(F.col("v")).alias("s")).collect()}
+    pdf = t.to_pandas()
+    for k, g in pdf.groupby("k"):
+        assert grid[k] == int(g.v.sum())
+    srt = {r[0]: r[1] for r in
+           df.group_by("hk").agg(F.count_star().alias("c")).collect()}
+    assert sum(srt.values()) == n
+
+
+def test_join_and_window(sess, rng):
+    n = 5000
+    fact = pa.table({"k": pa.array(rng.integers(0, 50, n)),
+                     "v": pa.array(rng.uniform(0, 100, n))})
+    dim = pa.table({"k": pa.array(np.arange(50, dtype=np.int64)),
+                    "w": pa.array(np.arange(50, dtype=np.int64) * 2)})
+    df = (sess.create_dataframe(fact)
+          .join(sess.create_dataframe(dim), on="k")
+          .select(F.col("k"), F.col("v"), F.col("w")))
+    rows = df.collect()
+    assert len(rows) == n
+    assert all(r[2] == r[0] * 2 for r in rows)
+    w = Window.partition_by("k").order_by("v")
+    rn = df.select(F.col("k"), F.row_number().over(w).alias("rn")).collect()
+    pdf = fact.to_pandas()
+    counts = pdf.groupby("k").size()
+    got_max = {}
+    for k, r in rn:
+        got_max[k] = max(got_max.get(k, 0), r)
+    for k, c in counts.items():
+        assert got_max[k] == c
