@@ -85,8 +85,18 @@ class Schema:
 
 def estimated_row_bytes(schema) -> int:
     """Planning-time row width estimate (bytes): the ONE formula shared by
-    the batch byte caps and the auto-broadcast threshold."""
-    return sum(24 if f.dtype.is_string else 8 for f in schema) or 8
+    the batch byte caps and the auto-broadcast threshold.
+
+    Nested (ARRAY/STRUCT/MAP) and other host-carried columns get a
+    conservative 64-byte weight so auto-broadcast sizing never drastically
+    underestimates a nested-typed build side (memory blow-up risk)."""
+    def w(f):
+        if f.dtype.is_string:
+            return 24
+        if getattr(f.dtype, "is_host_carried", False):
+            return 64  # nested types / wide decimals ride as Python objects
+        return 8
+    return sum(w(f) for f in schema) or 8
 
 
 def bucket_capacity(n_rows: int, min_capacity: int = 1024) -> int:

@@ -876,9 +876,33 @@ class CpuOpExec(TpuExec):
         from ..batch import logical_to_arrow
         for f in p.schema():
             s = merged[f.name]
-            arrays.append(pa.array(
-                [None if (x is None or (not isinstance(x, float) and
-                                        pd.isna(x))
-                          ) else x for x in s],
-                type=logical_to_arrow(f.dtype)))
+            # pandas null-padding upcasts int columns to float (values like
+            # 3 -> 3.0, nulls -> NaN); undo that per the TARGET dtype: NaN
+            # is a legitimate value only in float columns, and int-valued
+            # floats cast back so pa.array(type=int64) accepts them
+            try:
+                kind = np.dtype(f.dtype.numpy_dtype).kind
+            except (AttributeError, TypeError):  # nested/host-carried
+                kind = "O"
+
+            def conv(x):
+                if x is None:
+                    return None
+                if isinstance(x, (float, np.floating)):
+                    if x != x:  # NaN
+                        return float(x) if kind == "f" else None
+                    if kind in "iu":
+                        if abs(x) > 2**53:
+                            raise ValueError(
+                                f"int column round-tripped through float64 "
+                                f"lost precision: {x!r}")
+                        return int(x)
+                    return float(x)
+                if isinstance(x, (str, bytes, list, dict, np.ndarray)):
+                    return list(x) if isinstance(x, np.ndarray) else x
+                if pd.isna(x):
+                    return None
+                return x
+            arrays.append(pa.array([conv(x) for x in s],
+                                   type=logical_to_arrow(f.dtype)))
         return pa.table(dict(zip(p.schema().names(), arrays)))

@@ -436,6 +436,14 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
             # nested-loop expansion has no bounded static shape; the join
             # materializes single-process (its exchanges — none — are moot)
             return _make_leaf(node, leaves)
+        if node.condition is not None and node.how != "inner":
+            # non-inner residual conditions must participate in MATCHING
+            # (null-extension / semi / anti look at per-pair condition
+            # results), not post-filter the expanded output; the single-
+            # process path implements that (left/semi/anti via
+            # _conditioned_probe_join; full/right conditioned joins are
+            # tagged to CPU fallback by the overrides rule)
+            return _make_leaf(node, leaves)
         n_leaves = len(leaves)
         had_exch = depth_has_exchange[0]
         try:
@@ -457,6 +465,14 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
 
     if isinstance(node, SortMergeJoinExec):
         if node.how == "cross":
+            return _make_leaf(node, leaves)
+        if node.condition is not None and node.how != "inner":
+            # see BroadcastJoinExec above: _Join.emit's post-expansion
+            # residual filter is only correct for inner joins.  Refusing
+            # here (NotLowerable — the children hold exchanges) makes
+            # _find_fragment descend and distribute the child exchange
+            # subtrees; the join itself runs single-process through
+            # _conditioned_probe_join
             return _make_leaf(node, leaves)
         n_leaves = len(leaves)
         had_exch = depth_has_exchange[0]

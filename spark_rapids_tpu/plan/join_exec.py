@@ -684,8 +684,9 @@ class BroadcastJoinExec(SortMergeJoinExec):
         if floating:
             # floats ride as total-order int bit patterns (sign-magnitude
             # flip) with -0.0 normalized to +0.0 and NaN canonicalized to
-            # one slot just under the sentinel — Spark's NaN==NaN join
-            # semantics via ordinary integer searchsorted
+            # the all-ones image (signed -1), reachable by no non-NaN
+            # float — Spark's NaN==NaN join semantics via ordinary
+            # integer searchsorted
             ik = np.dtype(np.int32) if np_dt.itemsize == 4 \
                 else np.dtype(np.int64)
             sentinel = np.array(np.iinfo(ik).max, dtype=ik)
@@ -700,10 +701,17 @@ class BroadcastJoinExec(SortMergeJoinExec):
                 return d
             z = jnp.where(d == 0.0, jnp.zeros_like(d), d)
             b = jax.lax.bitcast_convert_type(z, ik)
+            # canonicalize every NaN bit pattern to 0x7F..F BEFORE the
+            # sign-magnitude flip: its image (all-ones, signed -1) is the
+            # image of no non-NaN float — b>=0 non-NaN tops out at +inf
+            # (0x7F80..) and b<0 maps to k>=0 — so NaN keys get a unique
+            # slot (Spark NaN==NaN) without colliding with the smallest
+            # negative denormal (whose image is max-1); `sentinel` is the
+            # same max constant — its image would require a -0.0 bit
+            # pattern, normalized away above, so the sentinel stays unique
+            b = jnp.where(jnp.isnan(d), sentinel, b)
             mn = np.array(np.iinfo(ik).min, dtype=ik)
-            k = jnp.where(b < 0, ~b, b | mn)
-            return jnp.where(jnp.isnan(d),
-                             jnp.array(np.iinfo(ik).max - 1, dtype=ik), k)
+            return jnp.where(b < 0, ~b, b | mn)
         fp = self._fingerprint() + f"|bfast{probe_side}"
 
         def build_sort():
@@ -731,6 +739,8 @@ class BroadcastJoinExec(SortMergeJoinExec):
             return f
 
         cache = getattr(self, "_bfast_cache", None)
+        # the build batch itself rides in the cache tuple so its id cannot
+        # be recycled by CPython for a different batch while cached
         if cache is None or cache[0] != (probe_side, id(build)):
             fn = _cached_program("bjoin-sort|" + fp, build_sort)
             b_arrays = _dev_arrays(build)
@@ -738,9 +748,10 @@ class BroadcastJoinExec(SortMergeJoinExec):
                                          self.string_dicts)
             sorted_keys, b_perm, n_valid = fn(b_arrays,
                                               np.int32(build.num_rows))
-            cache = ((probe_side, id(build)), sorted_keys, b_perm, n_valid)
+            cache = ((probe_side, id(build)), build, sorted_keys, b_perm,
+                     n_valid)
             self._bfast_cache = cache
-        _, sorted_keys, b_perm, n_valid = cache
+        _, _, sorted_keys, b_perm, n_valid = cache
 
         def build_probe():
             @jax.jit

@@ -292,3 +292,33 @@ def test_ici_broadcast_right_outer(sess, rng):
     assert "build=left" in phys.tree_string()
     got, want = _both_modes(df, sess)
     _assert_rows_equal(got, want)
+
+
+@pytest.mark.parametrize("how", ["left", "left_semi", "left_anti", "full"])
+def test_ici_conditioned_noninner_join(shuffle_only, rng, how):
+    """ADVICE r3 high: non-inner joins with a residual condition must NOT
+    lower onto the mesh (the post-expansion filter is inner-only
+    semantics); they run single-process via _conditioned_probe_join while
+    the child exchanges still distribute."""
+    sess = shuffle_only
+    orders, items = _tables(rng, null_keys=True)
+    do = sess.create_dataframe(orders)
+    dl = sess.create_dataframe(items)
+    joined = do.join(dl, [("o_orderkey", "l_orderkey")], how)
+    joined._plan.condition = (F.col("o_custkey") * 30.0
+                              < F.col("l_price")).expr
+    got, want = _both_modes(joined, sess)
+    _assert_rows_equal(got, want)
+
+
+@pytest.mark.parametrize("how", ["left", "left_semi", "left_anti"])
+def test_ici_conditioned_broadcast_noninner(sess, rng, how):
+    """Same contract for broadcast joins with residual conditions."""
+    orders, items = _tables(rng, null_keys=True)
+    do = sess.create_dataframe(orders)
+    dl = sess.create_dataframe(items)
+    joined = dl.join(F.broadcast(do), [("l_orderkey", "o_orderkey")], how)
+    joined._plan.condition = (F.col("o_custkey") * 30.0
+                              < F.col("l_price")).expr
+    got, want = _both_modes(joined, sess)
+    _assert_rows_equal(got, want)
