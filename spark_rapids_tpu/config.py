@@ -188,6 +188,22 @@ AUTO_BROADCAST_THRESHOLD = register(
     "partitioned; -1 disables auto selection (an explicit broadcast() "
     "hint still applies). spark.sql.autoBroadcastJoinThreshold analog.")
 
+AGG_SINGLE_PROCESS_COMPLETE = register(
+    "spark.rapids.tpu.sql.agg.singleProcessComplete", True,
+    "Under shuffle.mode=CACHE_ONLY, plan grouped aggregations as one "
+    "complete-mode pass instead of partial/exchange/final: with a single "
+    "process the exchange colocates nothing and its staging + the "
+    "partial-agg adaptivity sampling only add host round trips.")
+
+DENSE_JOIN_DOMAIN_CAP = register(
+    "spark.rapids.tpu.join.denseDomainCap", 1 << 26,
+    "Largest key domain (max_key - min_key + 1) for which a broadcast "
+    "join builds a dense direct-address lookup table (int32, one HBM "
+    "gather per probe row — the TPU-native replacement for cuDF's device "
+    "hash table, GpuHashJoin.scala:104). Above the cap, or with "
+    "duplicate build keys, the sorted searchsorted kernel is used. "
+    "0 disables the dense path.")
+
 ICI_DEVICES = register(
     "spark.rapids.tpu.shuffle.ici.devices", 0,
     "Number of mesh devices for ICI shuffle (0 = all visible devices). The "

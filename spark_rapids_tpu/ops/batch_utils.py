@@ -105,18 +105,22 @@ def gather(batch: ColumnBatch, indices: jax.Array, num_rows: int,
 
 
 def compact(batch: ColumnBatch, align_host_strings: bool = False,
-            min_capacity: int = 1) -> ColumnBatch:
+            min_capacity: int = 1,
+            n_live: Optional[int] = None) -> ColumnBatch:
     """Gather live rows to the front; drops the selection mask.
 
-    Syncs once to learn the live-row count (static for downstream planning).
-    ``min_capacity`` lets callers force a shared output bucket across many
-    compacts (e.g. one per shuffle partition) so XLA compiles the gather
-    once instead of once per row-count bucket.
+    Syncs once to learn the live-row count (static for downstream
+    planning) unless the caller already knows it and passes ``n_live``
+    (e.g. CoalesceBatchesExec batches its per-input counts into one
+    fetch).  ``min_capacity`` lets callers force a shared output bucket
+    across many compacts (e.g. one per shuffle partition) so XLA compiles
+    the gather once instead of once per row-count bucket.
     """
     if batch.sel is None and not align_host_strings:
         return batch
     active = batch.active_mask()
-    n_live = int(jnp.sum(active))
+    if n_live is None:
+        n_live = int(jnp.sum(active))
     # stable partition: sort by (!active) keeps live rows in order at front
     perm = jnp.lexsort((jnp.arange(batch.capacity, dtype=jnp.int32), ~active))
     new_cap = bucket_capacity(max(n_live, min_capacity))

@@ -839,6 +839,14 @@ def run_distributed_query(df, pg: ProcessGroup,
         ScanExec
 
     conf = df.session._tpu_conf()
+    if conf["spark.rapids.tpu.sql.agg.singleProcessComplete"]:
+        # the DCN runner distributes by REWRITING the plan's exchanges —
+        # it needs the partial->exchange->final shape the single-process
+        # collapse would remove
+        from ..config import TpuConf
+        conf = TpuConf({
+            **getattr(df.session, "_settings", {}),
+            "spark.rapids.tpu.sql.agg.singleProcessComplete": False})
     phys = apply_overrides(df._plan, conf)
     chain = []  # operators above the distributed subtree, top-down
     node = phys

@@ -228,7 +228,7 @@ class _Join:
     def emit(self, env):
         import jax.numpy as jnp
         from ..exprs import EvalContext, bind, promote_physical
-        from ..ops.groupby import _segment_starts, sort_indices_for_keys
+        from ..ops.groupby import _segment_starts, group_sort_indices
         from ..plan.join_exec import bound_join_keys
 
         join = self.join
@@ -270,7 +270,7 @@ class _Join:
         keys = [(jnp.concatenate([pd, bd]), None)
                 for (pd, _), (bd, _) in zip(pkv, bkv)]
         union_ok = jnp.concatenate([p_ok, b_ok])
-        perm = sort_indices_for_keys(keys, union_ok)
+        perm = group_sort_indices(keys, union_ok)
         s_keys = [(d[perm], None) for d, _ in keys]
         s_ok = union_ok[perm]
         starts = _segment_starts(s_keys, s_ok)

@@ -92,66 +92,95 @@ class CoalesceBatchesExec(TpuExec):
     def node_desc(self):
         return f"TpuCoalesceBatches {self.goal!r}"
 
-    @staticmethod
-    def _live_rows(b: ColumnBatch) -> int:
-        """Rows that survive the selection mask.
-
-        A filtered batch keeps its scan-sized num_rows with a sel mask
-        (physical.py StageExec), so goal accounting must count live rows —
-        otherwise post-filter batches always look 'big enough' and the
-        classic coalesce-after-filter case never merges.  Costs one scalar
-        fetch (~one dispatch) per masked batch, repaid by every dispatch
-        the merge saves downstream.
-        """
-        if b.sel is None:
-            return b.num_rows
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         import jax
         import jax.numpy as jnp
-        return int(jax.device_get(jnp.sum(b.active_mask())))
-
-    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         m = ctx.metric_set(self.op_id)
-        pending = []
-        pending_live = 0
+        # Per-batch live counts stay DEVICE scalars until a "look":
+        # every host sync on the tunneled backend costs a ~0.1-0.2 s
+        # round trip, so masked batches must never block one each (the
+        # pre-round-4 behavior).  A look resolves ALL outstanding counts
+        # in one fetch; looks trigger on accumulated CAPACITY with a
+        # doubling threshold, so a 1%-selective filter stream pays
+        # O(log n_batches) fetches yet still merges to the goal by true
+        # live count.
+        goal_rows = getattr(self.goal, "rows", None)
+        pending = []   # accumulated batches
+        lives = []     # parallel: int when known, device scalar when not
+        state = {"known": 0, "unknown_cap": 0, "cap_seen": 0,
+                 "look_at": (2 * goal_rows) if goal_rows else float("inf")}
+
+        def resolve():
+            idx = [i for i, v in enumerate(lives)
+                   if not isinstance(v, int)]
+            if idx:
+                vals = jax.device_get([lives[i] for i in idx])
+                for i, v in zip(idx, vals):
+                    lives[i] = int(v)
+            state["known"] = sum(lives)
+            state["unknown_cap"] = 0
 
         def flush():
-            # multi-batch merge goes through compact()'s capacity-bucketed
-            # sort+gather programs: a sortless slice-concat would need one
-            # XLA program per (n1, n2, ...) size combination — a compile
-            # storm on remote backends, where each compile costs seconds
             with m.time("opTime"):
-                if len(pending) == 1:
+                resolve()
+                total = state["known"]
+                if total == 0:
+                    out = None
+                elif len(pending) == 1 and pending[0].sel is None:
                     out = pending[0]
                 else:
+                    # merge through compact()'s capacity-bucketed
+                    # sort+gather programs: a sortless slice-concat would
+                    # need one XLA program per (n1, n2, ...) combination —
+                    # a compile storm on remote backends
                     out = batch_utils.compact(
-                        batch_utils.concat_batches(pending))
-            m.add("numOutputRows", out.num_rows)
-            m.add("numOutputBatches", 1)
+                        batch_utils.concat_batches(pending), n_live=total)
+            if out is not None:
+                m.add("numOutputRows", out.num_rows)
+                m.add("numOutputBatches", 1)
+            pending.clear()
+            lives.clear()
+            state.update(known=0, unknown_cap=0, cap_seen=0,
+                         look_at=(2 * goal_rows) if goal_rows
+                         else float("inf"))
             return out
 
         for b in self.children[0].execute(ctx):
             m.add("numInputBatches", 1)
-            live = self._live_rows(b)
-            if live == 0:
+            if b.num_rows == 0:
                 continue
-            if b.sel is None and self.goal.satisfied_by(live, False):
+            if b.sel is None and self.goal.satisfied_by(b.num_rows, False):
                 # dense and already at goal: pass through untouched — but
                 # first flush anything smaller waiting ahead of it, so the
                 # big batch never pays a merge sort for a few stray rows
                 if pending:
-                    yield flush()
-                    pending, pending_live = [], 0
+                    out = flush()
+                    if out is not None:
+                        yield out
                 m.add("numOutputRows", b.num_rows)
                 m.add("numOutputBatches", 1)
                 yield b
                 continue
             pending.append(b)
-            pending_live += live
-            if self.goal.satisfied_by(pending_live, False):
-                yield flush()
-                pending, pending_live = [], 0
+            state["cap_seen"] += b.num_rows
+            if b.sel is None:
+                lives.append(b.num_rows)
+                state["known"] += b.num_rows
+            else:
+                lives.append(jnp.sum(b.active_mask().astype(jnp.int32)))
+                state["unknown_cap"] += b.num_rows
+            if state["unknown_cap"] and state["cap_seen"] >= state["look_at"]:
+                resolve()
+                state["look_at"] = 2 * state["cap_seen"]
+            if state["unknown_cap"] == 0 and \
+                    self.goal.satisfied_by(state["known"], False):
+                out = flush()
+                if out is not None:
+                    yield out
         if pending:
-            yield flush()
+            out = flush()
+            if out is not None:
+                yield out
 
 
 def insert_coalesce(phys: TpuExec, conf) -> TpuExec:

@@ -34,7 +34,7 @@ from ..batch import (ColumnBatch, DeviceColumn, Field, HostStringColumn,
                      Schema, bucket_capacity)
 from ..exprs import EvalContext, Expression, promote_physical
 from ..ops import batch_utils
-from ..ops.groupby import sort_indices_for_keys, _segment_starts
+from ..ops.groupby import group_sort_indices, _segment_starts
 from .physical import ExecContext, TpuExec, _cached_program
 
 __all__ = ["SortMergeJoinExec"]
@@ -431,7 +431,7 @@ class SortMergeJoinExec(TpuExec):
                 keys = [(jnp.concatenate([pd, bd]), None)
                         for (pd, _), (bd, _) in zip(pkv, bkv)]
                 union_ok = jnp.concatenate([p_ok, b_ok])
-                perm = sort_indices_for_keys(keys, union_ok)
+                perm = group_sort_indices(keys, union_ok)
                 s_keys = [(d[perm], None) for d, _ in keys]
                 s_ok = union_ok[perm]
                 starts = _segment_starts(s_keys, s_ok)
@@ -697,21 +697,10 @@ class BroadcastJoinExec(SortMergeJoinExec):
             return super()._match_state(probe, build, probe_side)
 
         def orderable(d):
-            if not floating:
-                return d
-            z = jnp.where(d == 0.0, jnp.zeros_like(d), d)
-            b = jax.lax.bitcast_convert_type(z, ik)
-            # canonicalize every NaN bit pattern to 0x7F..F BEFORE the
-            # sign-magnitude flip: its image (all-ones, signed -1) is the
-            # image of no non-NaN float — b>=0 non-NaN tops out at +inf
-            # (0x7F80..) and b<0 maps to k>=0 — so NaN keys get a unique
-            # slot (Spark NaN==NaN) without colliding with the smallest
-            # negative denormal (whose image is max-1); `sentinel` is the
-            # same max constant — its image would require a -0.0 bit
-            # pattern, normalized away above, so the sentinel stays unique
-            b = jnp.where(jnp.isnan(d), sentinel, b)
-            mn = np.array(np.iinfo(ik).min, dtype=ik)
-            return jnp.where(b < 0, ~b, b | mn)
+            # `sentinel` (the int max) is reachable by no key image: it
+            # would require a -0.0 bit pattern, which _float_orderable
+            # normalizes away — so the invalid-tail sentinel stays unique
+            return _float_orderable(d, ik) if floating else d
         fp = self._fingerprint() + f"|bfast{probe_side}"
 
         def build_sort():
@@ -785,14 +774,266 @@ class BroadcastJoinExec(SortMergeJoinExec):
         kind = "NestedLoop" if self.how == "cross" else "Hash"
         return f"TpuBroadcast{kind}Join [{self.how}] build={side}"
 
+    # -- dense direct-address fast path -------------------------------------------
+    #
+    # The TPU-native answer to cuDF's device hash table
+    # (GpuHashJoin.scala:104 gather maps): when the single equi-key's
+    # domain (max-min+1) is bounded and build keys are unique — the
+    # dim-fact shape joins live on — build a dense int32 table mapping
+    # (key - kmin) -> build row id once, then every probe batch is ONE
+    # HBM gather + fused payload gathers in a single dispatch with ZERO
+    # host syncs: probe columns pass through untouched under a selection
+    # mask (inner/semi/anti) or stay fully live with null-extended build
+    # columns (left).  Measured on-chip: a 4M-probe searchsorted pass is
+    # ~700 ms while a 4M int32 gather is ~20 ms — this path replaces
+    # ~2 searchsorted passes + per-column expansion gathers with ~1+C
+    # gathers.
+
+    def _dense_static_ok(self) -> bool:
+        how = self.how
+        if how == "inner":
+            pass  # either build side; a residual condition post-filters
+        elif how in ("left", "semi", "anti"):
+            if self.build_side != 1 or self.condition is not None:
+                return False
+        else:
+            return False
+        lk, rk, common = self._bound_keys()
+        if len(common) != 1:
+            return False
+        return _int_key_caster(common[0]) is not None
+
+    def _dense_payload_fields(self, build: ColumnBatch):
+        """(field-index list into build.schema, or None when a needed
+        payload column is host-carried)."""
+        if self.how in ("semi", "anti"):
+            return []
+        using = set(self.using)
+        if self.build_side == 1:
+            idxs = [i for i, f in enumerate(build.schema)
+                    if f.name not in using]
+        else:
+            idxs = list(range(len(build.schema.fields)))
+        for i in idxs:
+            if not isinstance(build.columns[i], DeviceColumn):
+                return None
+        return idxs
+
+    def _dense_prefetch(self, build: ColumnBatch, conf) -> None:
+        """Dispatch the build-key stats program and start its async
+        device→host copy.  Called right after the build materializes, so
+        the round trip overlaps the probe side's host work (parquet
+        decode, upstream dispatches) instead of blocking the first probe
+        batch (~0.1-0.15 s per join on the tunneled backend)."""
+        cache = getattr(self, "_dense_cache", None)
+        if cache is not None and cache[0] == id(build):
+            return
+        pending = getattr(self, "_dense_pending", None)
+        if pending is not None:
+            if pending[0] == id(build):
+                return
+            self._dense_pending = None  # stale build: recompute
+        if not conf["spark.rapids.tpu.join.denseDomainCap"]:
+            return
+        if self._dense_payload_fields(build) is None:
+            return
+        lk, rk, common = self._bound_keys()
+        bk = rk if self.build_side == 1 else lk
+        ct = common[0]
+        ik = _int_key_caster(ct)
+        if ik is None:
+            return
+        fp = self._fingerprint() + f"|dense|bs{self.build_side}"
+
+        def build_stats():
+            @jax.jit
+            def f(b_arrays, n_build):
+                b_cap = next(a[0].shape[0] for a in b_arrays
+                             if a is not None)
+                d, ok = _eval_int_key(bk[0], b_arrays, b_cap, n_build, ct, ik)
+                big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
+                d64 = d.astype(jnp.int64)
+                kmin = jnp.min(jnp.where(ok, d64, big))
+                kmax = jnp.max(jnp.where(ok, d64, -big))
+                n_valid = jnp.sum(ok.astype(jnp.int64))
+                s = jnp.sort(jnp.where(ok, d64, big))
+                dup = jnp.sum(((s[1:] == s[:-1]) & (s[1:] != big))
+                              .astype(jnp.int64))
+                return jnp.stack([kmin, kmax, n_valid, dup])
+            return f
+
+        b_arrays = _dev_arrays(build)
+        b_arrays = encode_key_arrays(b_arrays, build, bk, self.string_dicts)
+        fn = _cached_program("bjoin-dense-stats|" + fp, build_stats)
+        stats = fn(b_arrays, np.int32(build.num_rows))
+        try:
+            stats.copy_to_host_async()
+        except AttributeError:
+            pass
+        # the batch rides in the tuple so its id cannot be recycled while
+        # the prefetch is outstanding (same discipline as _bfast_cache)
+        self._dense_pending = (id(build), build, stats, b_arrays)
+
+    def _dense_build_state(self, build: ColumnBatch, conf):
+        """Resolve (kmin, table) once per build batch; None if the dense
+        path does not apply (dup keys / unbounded domain / host payload)."""
+        cache = getattr(self, "_dense_cache", None)
+        if cache is not None and cache[0] == id(build):
+            return cache[2]
+        self._dense_prefetch(build, conf)
+        pending = getattr(self, "_dense_pending", None)
+        state = None
+        if pending is not None and pending[0] == id(build):
+            cap = conf["spark.rapids.tpu.join.denseDomainCap"]
+            payload = self._dense_payload_fields(build)
+            if payload is not None:
+                state = self._dense_build_state_impl(
+                    build, cap, payload, pending[2], pending[3])
+        self._dense_pending = None
+        self._dense_cache = (id(build), build, state)
+        return state
+
+    def _dense_build_state_impl(self, build, domain_cap, payload_idxs,
+                                stats, b_arrays):
+        lk, rk, common = self._bound_keys()
+        bk = rk if self.build_side == 1 else lk
+        ct = common[0]
+        ik = _int_key_caster(ct)
+        fp = self._fingerprint() + f"|dense|bs{self.build_side}"
+        kmin, kmax, n_valid, dup = [int(x) for x in np.asarray(stats)]
+        if n_valid == 0 or dup > 0:
+            return None
+        domain = kmax - kmin + 1
+        if domain <= 0 or domain > domain_cap:
+            return None
+        D = bucket_capacity(domain)
+
+        def build_table():
+            @jax.jit
+            def g(b_arrays, kmin_s, n_build):
+                b_cap = next(a[0].shape[0] for a in b_arrays
+                             if a is not None)
+                d, ok = _eval_int_key(bk[0], b_arrays, b_cap, n_build, ct, ik)
+                idx = jnp.where(ok, d.astype(jnp.int64) - kmin_s,
+                                jnp.int64(D))
+                return jnp.full((D,), -1, jnp.int32).at[idx].set(
+                    jnp.arange(b_cap, dtype=jnp.int32), mode="drop")
+            return g
+
+        gfn = _cached_program(f"bjoin-dense-table|{fp}|{D}", build_table)
+        table = gfn(b_arrays, jnp.int64(kmin), np.int32(build.num_rows))
+        pay = tuple((build.columns[i].data, build.columns[i].valid)
+                    for i in payload_idxs)
+        return {"table": table, "kmin": kmin, "D": D, "ct": ct, "ik": ik,
+                "payload_idxs": payload_idxs, "payload": pay}
+
+    def _dense_join_pair(self, ctx, m, probe: ColumnBatch,
+                         build: ColumnBatch):
+        state = self._dense_build_state(build, ctx.conf)
+        if state is None:
+            return None
+        how = self.how
+        lk, rk, common = self._bound_keys()
+        pk = lk if self.build_side == 1 else rk
+        ct, ik, D = state["ct"], state["ik"], state["D"]
+        has_sel = probe.sel is not None
+        fp = (self._fingerprint()
+              + f"|denseprobe|bs{self.build_side}|{how}|{D}|"
+              + f"sel{int(has_sel)}")
+
+        def build_probe():
+            @jax.jit
+            def h(p_arrays, table, payload, kmin_s, n_probe, sel):
+                p_cap = next(a[0].shape[0] for a in p_arrays
+                             if a is not None)
+                active = jnp.arange(p_cap, dtype=jnp.int32) < n_probe
+                if sel is not None:
+                    active = active & sel
+                d, ok = _eval_int_key(pk[0], p_arrays, p_cap, n_probe, ct,
+                                      ik, active=active)
+                ok = ok & active
+                idx = d.astype(jnp.int64) - kmin_s
+                in_dom = ok & (idx >= 0) & (idx < D)
+                safe = jnp.clip(idx, 0, D - 1).astype(jnp.int32)
+                bi = jnp.where(in_dom, table[safe], -1)
+                matched = bi >= 0
+                if how == "semi":
+                    return matched, ()
+                if how == "anti":
+                    return active & ~matched, ()
+                safe_bi = jnp.clip(bi, 0, None)
+                cols = []
+                for bd, bv in payload:
+                    gv = matched if bv is None else (matched & bv[safe_bi])
+                    cols.append((bd[safe_bi], gv))
+                sel_out = matched if how == "inner" else active
+                return sel_out, tuple(cols)
+            return h
+
+        fn = _cached_program(fp, build_probe)
+        p_arrays = _dev_arrays(probe)
+        p_arrays = encode_key_arrays(p_arrays, probe, pk, self.string_dicts)
+        with m.time("opTime"):
+            sel_out, pay_cols = fn(p_arrays, state["table"],
+                                   state["payload"], jnp.int64(state["kmin"]),
+                                   np.int32(probe.num_rows), probe.sel)
+        if how in ("semi", "anti"):
+            out = ColumnBatch(self._schema, probe.columns, probe.num_rows,
+                              sel_out)
+            self._dense_metrics(m, out)
+            return out
+        build_cols = {}
+        for i, (bd, bv) in zip(state["payload_idxs"], pay_cols):
+            f = build.schema.fields[i]
+            build_cols[f.name] = DeviceColumn(f.dtype, bd, bv)
+        using = set(self.using)
+        cols: List = []
+        if self.build_side == 1:
+            cols.extend(probe.columns)
+            for f in build.schema:
+                if f.name not in using:
+                    cols.append(build_cols[f.name])
+        else:
+            for f in build.schema:
+                cols.append(build_cols[f.name])
+            for f, c in zip(probe.schema, probe.columns):
+                if f.name not in using:
+                    cols.append(c)
+        out = ColumnBatch(self._schema, cols, probe.num_rows, sel_out)
+        if self.condition is not None:
+            out = self._apply_residual(out)
+        self._dense_metrics(m, out)
+        return out
+
+    @staticmethod
+    def _dense_metrics(m, out: ColumnBatch) -> None:
+        """The dense path is sync-free, so exact numOutputRows (a device
+        reduction over the selection mask) is only paid for at DEBUG
+        metric level; batch counts are always recorded."""
+        m.add("numOutputBatches", 1)
+        if m.level == "DEBUG":
+            m.add("numOutputRows", out.row_count())
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         m = ctx.metric_set(self.op_id)
         probe_side = 1 - self.build_side
         bh = self.children[self.build_side].materialize(ctx)
         pgen = self.children[probe_side].execute(ctx)
+        dense_ok = self._dense_static_ok()
         try:
             build = bh.get()
+            if dense_ok:
+                self._dense_prefetch(build, ctx.conf)
             for probe in pgen:
+                if probe.num_rows == 0:
+                    continue
+                if dense_ok:
+                    # sync-free: folds any upstream selection mask into
+                    # the probe program instead of compacting
+                    out = self._dense_join_pair(ctx, m, probe, build)
+                    if out is not None:
+                        yield out
+                        continue
                 if probe.row_count() == 0:
                     continue
                 # the join kernel treats every row below num_rows as live —
@@ -813,6 +1054,73 @@ class BroadcastJoinExec(SortMergeJoinExec):
             # must not wait for garbage collection
             pgen.close()
             bh.close()
+            # drop device-array pins (build batch, dense table, payload,
+            # sorted-key caches) so the spill catalog can reclaim the HBM
+            # while later plan stages run
+            self._dense_cache = None
+            self._dense_pending = None
+            self._bfast_cache = None
+
+
+def _float_orderable(d, ik):
+    """Total-order injective int image of a float key array: -0.0
+    normalized to +0.0, NaN canonicalized to one bit pattern whose image
+    no non-NaN float maps to, then the sign-magnitude flip.  THE single
+    implementation — the dense path and the sorted searchsorted path must
+    agree on which float keys are equal (Spark NaN==NaN, -0.0==0.0 join
+    semantics).
+
+    float64 uses the arithmetic bit extraction (hashing.f64_bit_pattern):
+    XLA's X64-rewrite pass on real TPU backends implements no 64-bit
+    bitcast-convert.  Its canonical NaN (0x7FF8..) flips to an image
+    strictly above +inf's, so the NaN slot stays unique; the int64-max
+    sentinel would require a -0.0 pattern, normalized away, so it too
+    stays unique."""
+    if d.dtype == jnp.float64:
+        from ..ops.hashing import f64_bit_pattern
+        b = f64_bit_pattern(d)  # -0.0 -> +0.0 bits, NaN -> 0x7FF8.., FTZ
+    else:
+        z = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+        b = jax.lax.bitcast_convert_type(z, ik)
+        mx = np.array(np.iinfo(ik).max, dtype=ik)
+        b = jnp.where(jnp.isnan(d), mx, b)
+    mn = np.array(np.iinfo(ik).min, dtype=ik)
+    return jnp.where(b < 0, ~b, b | mn)
+
+
+def _int_key_caster(ct) -> Optional[np.dtype]:
+    """Physical int dtype an equi-key of type ``ct`` maps into for dense
+    direct addressing (strings ride as int32 dictionary codes, floats as
+    total-order bit patterns), or None when no injective int image exists."""
+    if ct.is_string:
+        return np.dtype(np.int32)
+    try:
+        np_dt = np.dtype(ct.numpy_dtype)
+    except TypeError:
+        return None
+    if np_dt.kind in "iu":
+        return np_dt
+    if np_dt.kind == "f":
+        return np.dtype(np.int32) if np_dt.itemsize == 4 \
+            else np.dtype(np.int64)
+    return None
+
+
+def _eval_int_key(expr, arrays, cap, n_rows, ct, ik, active=None):
+    """Evaluate a bound key expression to (int image, valid mask) inside a
+    jitted program.  The float mapping matches _match_state's orderable():
+    -0.0 normalized, NaN canonicalized to the all-ones image."""
+    if active is None:
+        active = jnp.arange(cap, dtype=jnp.int32) < n_rows
+    ectx = EvalContext(list(arrays), cap, active=active)
+    d, v = expr.eval(ectx)
+    if not ct.is_string:
+        d = promote_physical(d, expr.dtype, ct)
+    ok = active if v is None else (active & v)
+    np_dt = None if ct.is_string else np.dtype(ct.numpy_dtype)
+    if np_dt is not None and np_dt.kind == "f":
+        d = _float_orderable(d, ik)
+    return d, ok
 
 
 def _has_broadcast_hint(node) -> bool:

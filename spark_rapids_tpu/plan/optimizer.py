@@ -1,0 +1,262 @@
+"""Logical rewrites that run before physical planning.
+
+``push_filters`` relocates filter conjuncts below joins (Spark's
+``PushPredicateThroughJoin`` / ``PushDownPredicates``, consumed by the
+reference's planner before GpuOverrides sees the plan).  This matters far
+more on TPU than on GPU: the join kernels are gather-bound (PERF.md law
+#2), so every probe/build row removed before the join is worth ~20 random
+accesses inside it — and a filter that lands directly above a scan also
+reaches the parquet reader's row-group pruning (pushdown.py).
+
+Join-type legality (predicate references one side only):
+  inner/cross : push to either side
+  left        : left side only (right-side pushes would change
+                null-extension)
+  right       : right side only
+  semi        : either side (a right-side filter commutes with EXISTS)
+  anti        : left side only
+  full        : nothing moves
+
+Conjuncts referencing both sides (or nondeterministic ones) stay above the
+join; equi-key equivalence additionally duplicates single-key conjuncts to
+the other side (o_orderkey < N implies l_orderkey < N under
+o_orderkey = l_orderkey) — the static sibling of dynamic partition pruning.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import exprs as E
+from . import logical as L
+
+__all__ = ["push_filters"]
+
+
+_CANON = {"left_semi": "semi", "left_anti": "anti", "leftsemi": "semi",
+          "leftanti": "anti", "left_outer": "left", "right_outer": "right",
+          "full_outer": "full", "outer": "full"}
+
+
+def _conjuncts(e: E.Expression) -> List[E.Expression]:
+    if isinstance(e, E.And):
+        return _conjuncts(e.children[0]) + _conjuncts(e.children[1])
+    return [e]
+
+
+def _and_all(conjs: List[E.Expression]) -> Optional[E.Expression]:
+    if not conjs:
+        return None
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = E.And(out, c)
+    return out
+
+
+_NONDETERMINISTIC = ("Rand", "Randn", "Uuid", "Shuffle", "PythonUDF",
+                     "MonotonicallyIncreasingID", "SparkPartitionID",
+                     "InputFileName")
+
+
+def _deterministic(e: E.Expression) -> bool:
+    if type(e).__name__ in _NONDETERMINISTIC:
+        return False
+    return all(_deterministic(c) for c in e.children)
+
+
+def _keep_hint(new: L.LogicalPlan, old: L.LogicalPlan) -> L.LogicalPlan:
+    if new is not old and getattr(old, "broadcast_hint", False):
+        new.broadcast_hint = True
+    return new
+
+
+def _wrap(child: L.LogicalPlan, conjs: List[E.Expression]) -> L.LogicalPlan:
+    cond = _and_all(conjs)
+    if cond is None:
+        return child
+    return _keep_hint(L.Filter(child, cond), child)
+
+
+def _rebuild_join(node: L.Join, left, right) -> L.Join:
+    out = L.Join(left, right, node.left_keys, node.right_keys,
+                 how=node.how, condition=node.condition)
+    if hasattr(node, "using"):
+        out.using = node.using
+    return _keep_hint(out, node)
+
+
+def _key_name(e: E.Expression) -> Optional[str]:
+    return e.name if isinstance(e, E.UnresolvedColumn) else None
+
+
+def _remap_cols(e: E.Expression, mapping: dict) -> Optional[E.Expression]:
+    """Rewrite every column reference through ``mapping`` (None if any
+    referenced column has no image)."""
+    if isinstance(e, E.UnresolvedColumn):
+        to = mapping.get(e.name)
+        return E.UnresolvedColumn(to) if to is not None else None
+    if not e.children:
+        return e
+    import copy
+    kids = []
+    for c in e.children:
+        r = _remap_cols(c, mapping)
+        if r is None:
+            return None
+        kids.append(r)
+    out = copy.copy(e)
+    out.children = tuple(kids) if isinstance(e.children, tuple) else kids
+    return out
+
+
+_RANGE_OPS = (E.LessThan, E.LessThanOrEqual, E.GreaterThan,
+              E.GreaterThanOrEqual, E.EqualTo, E.In, E.IsNotNull)
+
+
+def _mirror_key_conjunct(c: E.Expression, key_map: dict
+                         ) -> Optional[E.Expression]:
+    """If the conjunct is a simple range/set predicate referencing only
+    join-key columns, produce the mirrored predicate for the other side.
+
+    Restricted to null-intolerant shapes (comparison/IN/IsNotNull over the
+    key and literals): under key equality those hold on matching rows of
+    either side, so applying the mirror to the other side's input can only
+    drop rows that would never match."""
+    if not isinstance(c, _RANGE_OPS):
+        return None
+    refs = c.references()
+    if not refs or not refs <= set(key_map):
+        return None
+    return _remap_cols(c, key_map)
+
+
+def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Rewrite the tree, sinking filters toward scans."""
+    return _push(plan)
+
+
+def _push(node: L.LogicalPlan) -> L.LogicalPlan:
+    if isinstance(node, L.Filter):
+        return _push_filter(node)
+    if isinstance(node, L.Cache):
+        return node  # barrier: shared mutable state, never rebuilt
+    if not node.children:
+        return node
+    new_children = tuple(_push(c) for c in node.children)
+    if all(n is o for n, o in zip(new_children, node.children)):
+        return node
+    import copy
+    out = copy.copy(node)
+    out.children = new_children
+    return out
+
+
+def _push_filter(node: L.Filter) -> L.LogicalPlan:
+    out = _push_filter_impl(node)
+    # a hint on the (possibly merged) filter stack must survive on the
+    # rewritten root: _has_broadcast_hint looks down from the subtree top
+    n, hinted = node, False
+    while isinstance(n, L.Filter):
+        hinted = hinted or getattr(n, "broadcast_hint", False)
+        n = n.children[0]
+    if hinted and not getattr(out, "broadcast_hint", False):
+        out.broadcast_hint = True
+    return out
+
+
+def _push_filter_impl(node: L.Filter) -> L.LogicalPlan:
+    child = node.children[0]
+    conjs = _conjuncts(node.condition)
+    # merge stacked filters into one conjunct pool — but never merge
+    # PAST a nondeterministic filter: sinking a later deterministic
+    # conjunct below it would change which rows the nondeterministic
+    # predicate sees (Spark's PushDownPredicates stops there too)
+    while isinstance(child, L.Filter):
+        inner = _conjuncts(child.condition)
+        if not all(_deterministic(c) for c in inner):
+            break
+        conjs = conjs + inner
+        child = child.children[0]
+
+    pushable = [c for c in conjs if _deterministic(c)]
+    stuck = [c for c in conjs if not _deterministic(c)]
+
+    if isinstance(child, L.Join):
+        return _wrap(_push_filter_join(child, pushable), stuck)
+
+    if isinstance(child, L.Project):
+        # substitute through pure renames only — a conjunct referencing a
+        # computed or literal projection stays put (pushing it would
+        # duplicate and re-evaluate the expression below)
+        mapping = {}
+        for name, e in child.exprs:
+            mapping[name] = e.name if isinstance(e, E.UnresolvedColumn) \
+                else None
+        moved, kept = [], []
+        for c in pushable:
+            refs = c.references()
+            if refs and all(mapping.get(r) is not None for r in refs):
+                moved.append(_remap_cols(
+                    c, {r: mapping[r] for r in refs}))
+            else:
+                kept.append(c)
+        if moved:
+            inner = _push(L.Filter(child.children[0], _and_all(moved)))
+            new_proj = _keep_hint(L.Project(inner, child.exprs), child)
+            return _wrap(new_proj, kept + stuck)
+        return _wrap(_keep_hint(L.Project(_push(child.children[0]),
+                                          child.exprs), child),
+                     pushable + stuck)
+
+    if isinstance(child, L.Union):
+        cond = _and_all(pushable)
+        if cond is not None:
+            kids = [_push(L.Filter(c, cond)) for c in child.children]
+            return _wrap(L.Union(kids), stuck)
+        return _wrap(_push(child), stuck)
+
+    # no rewrite: recurse into the child, keep the filter in place
+    return _wrap(_push(child), conjs)
+
+
+def _push_filter_join(join: L.Join, conjs: List[E.Expression]
+                      ) -> L.LogicalPlan:
+    how = _CANON.get(join.how, join.how)
+    lnames = set(join.children[0].schema().names())
+    rnames = set(join.children[1].schema().names())
+
+    push_left_ok = how in ("inner", "cross", "left", "semi", "anti")
+    push_right_ok = how in ("inner", "cross", "right", "semi")
+
+    # key equivalence maps (simple column keys only)
+    l2r, r2l = {}, {}
+    if how in ("inner", "semi"):
+        for lk, rk in zip(join.left_keys, join.right_keys):
+            ln, rn = _key_name(lk), _key_name(rk)
+            if ln is not None and rn is not None:
+                l2r[ln] = rn
+                r2l[rn] = ln
+
+    to_left: List[E.Expression] = []
+    to_right: List[E.Expression] = []
+    stay: List[E.Expression] = []
+    for c in conjs:
+        refs = c.references()
+        if refs and refs <= lnames and push_left_ok:
+            to_left.append(c)
+            if push_right_ok:
+                m = _mirror_key_conjunct(c, l2r)
+                if m is not None:
+                    to_right.append(m)
+        elif refs and refs <= rnames and push_right_ok:
+            to_right.append(c)
+            if push_left_ok:
+                m = _mirror_key_conjunct(c, r2l)
+                if m is not None:
+                    to_left.append(m)
+        else:
+            stay.append(c)
+
+    left = _push(_wrap(join.children[0], to_left))
+    right = _push(_wrap(join.children[1], to_right))
+    return _wrap(_rebuild_join(join, left, right), stay)

@@ -310,6 +310,15 @@ def _plan_aggregate(child_phys: TpuExec, group_bound, agg_bound,
     if not group_bound or not conf["spark.rapids.tpu.sql.exchange.enabled"]:
         return AggregateExec(child_phys, group_bound, agg_bound,
                              mode="complete")
+    if conf["spark.rapids.tpu.shuffle.mode"] == "CACHE_ONLY" \
+            and conf["spark.rapids.tpu.sql.agg.singleProcessComplete"]:
+        # single-process: the partial -> exchange -> final shape exists to
+        # colocate groups across workers; with one process it is pure
+        # overhead (the round-4 sync profile measured ~0.5 s/query of
+        # partial-agg sampling + exchange staging).  ICI/HOST modes keep
+        # the two-phase shape — their exchanges do real distribution.
+        return AggregateExec(child_phys, group_bound, agg_bound,
+                             mode="complete")
     from .exchange_exec import ShuffleExchangeExec
     # string keys: partial and final share one dictionary registry so codes
     # stay comparable across the exchange (ops/strings.py)
@@ -454,7 +463,9 @@ def _convert(meta: NodeMeta, conf: TpuConf) -> TpuExec:
 def apply_overrides(plan: L.LogicalPlan, conf: Optional[TpuConf] = None
                     ) -> TpuExec:
     conf = conf or TpuConf()
+    from .optimizer import push_filters
     from .pushdown import optimize_scans
+    plan = push_filters(plan)
     plan = optimize_scans(plan)
     meta = NodeMeta(plan, conf)
     meta.tag()
@@ -489,7 +500,9 @@ def apply_overrides(plan: L.LogicalPlan, conf: Optional[TpuConf] = None
 def explain_plan(plan: L.LogicalPlan, conf: Optional[TpuConf] = None) -> str:
     """Explain-only API (ExplainPlan.scala analog)."""
     conf = conf or TpuConf()
+    from .optimizer import push_filters
     from .pushdown import optimize_scans
+    plan = push_filters(plan)
     plan = optimize_scans(plan)
     meta = NodeMeta(plan, conf)
     meta.tag()

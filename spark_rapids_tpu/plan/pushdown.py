@@ -83,6 +83,25 @@ def _refs(exprs) -> Set[str]:
     return out
 
 
+def _prune_to(node: L.LogicalPlan,
+              required: Optional[Set[str]]) -> L.LogicalPlan:
+    """Insert a column-pruning Project when a join input's schema carries
+    columns the join doesn't need.  Operators pass their whole schema
+    through, so without this a filtered dimension table drags its filter
+    column (often a host string) into the join build side — blocking the
+    device fast paths and widening every shuffle."""
+    if required is None or isinstance(node, (L.LogicalScan, L.Cache)):
+        return node
+    names = node.schema().names()
+    keep = [n for n in names if n in required]
+    if not keep or len(keep) == len(names):
+        return node
+    out = L.Project(node, [(n, E.UnresolvedColumn(n)) for n in keep])
+    if getattr(node, "broadcast_hint", False):
+        out.broadcast_hint = True
+    return out
+
+
 def _walk(node: L.LogicalPlan, required: Optional[Set[str]],
           preds: List[Tuple[str, str, object]]) -> L.LogicalPlan:
     out = _walk_impl(node, required, preds)
@@ -174,8 +193,8 @@ def _walk_impl(node: L.LogicalPlan, required: Optional[Set[str]],
                 crefs = node.condition.references()
                 lreq |= {c for c in crefs if c in lnames}
                 rreq |= {c for c in crefs if c in rnames}
-        left = _walk(node.children[0], lreq, [])
-        right = _walk(node.children[1], rreq, [])
+        left = _prune_to(_walk(node.children[0], lreq, []), lreq)
+        right = _prune_to(_walk(node.children[1], rreq, []), rreq)
         out = L.Join(left, right, node.left_keys, node.right_keys,
                      how=node.how, condition=node.condition)
         if hasattr(node, "using"):

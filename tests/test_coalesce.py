@@ -90,9 +90,11 @@ class TestCoalesceExec:
                for v in np.asarray(b.columns[0].data)[:b.num_rows].tolist()]
         assert got == list(range(110))
 
-    def test_masked_batches_count_live_rows(self, session):
-        """Post-filter batches (big capacity, few live rows) merge by LIVE
-        count, not scan-sized num_rows."""
+    def test_masked_batches_merge_and_compact(self, session):
+        """Masked batches accumulate WITHOUT per-batch host syncs: live
+        counts stay device scalars until a capacity-threshold 'look'
+        resolves them all in one fetch, and the flush compacts the merge
+        to the true live total."""
         import jax.numpy as jnp
 
         from spark_rapids_tpu import types as T
@@ -101,7 +103,7 @@ class TestCoalesceExec:
         from spark_rapids_tpu.plan.physical import TpuExec
         schema = Schema([Field("v", T.INT64, False)])
 
-        def masked(lo, n_live, cap=64):
+        def masked(lo, n_live, cap=8):
             data = jnp.arange(lo, lo + cap, dtype=jnp.int64)
             sel = jnp.arange(cap) < n_live
             return ColumnBatch(schema, [DeviceColumn(T.INT64, data)],
@@ -117,7 +119,9 @@ class TestCoalesceExec:
 
         co = CoalesceBatchesExec(Src(), TargetSize(12))
         outs = self._run(session, co)
-        # 5+5 < 12, +5 = 15 >= 12 -> one merged batch of 15 live rows
+        # look threshold = 2x goal = 24 capacity: the third batch trips
+        # it, the resolved live total (15) satisfies the goal -> ONE
+        # merged batch of 15 live rows
         assert [b.num_rows for b in outs] == [15]
         got = sorted(np.asarray(outs[0].columns[0].data)[:15].tolist())
         assert got == list(range(0, 5)) + list(range(100, 105)) \

@@ -17,6 +17,21 @@ from spark_rapids_tpu.sql import functions as F
 from .support import assert_rows_equal
 
 
+@pytest.fixture(autouse=True)
+def _two_phase_agg(monkeypatch):
+    """This module tests the exchange machinery itself: pin the
+    partial->exchange->final shape that singleProcessComplete would
+    otherwise collapse under CACHE_ONLY.  Patch the registry default so
+    every session (shared or fresh) sees it."""
+    import dataclasses
+    from spark_rapids_tpu import config
+    key = "spark.rapids.tpu.sql.agg.singleProcessComplete"
+    monkeypatch.setitem(
+        config.ALL_ENTRIES, key,
+        dataclasses.replace(config.ALL_ENTRIES[key], default=False))
+    yield
+
+
 def _plan(df):
     return apply_overrides(df._plan, df.session._tpu_conf())
 
