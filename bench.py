@@ -1,22 +1,19 @@
-"""Benchmark: TPC-H Q1 + Q3 + Q6 through the full engine vs pandas on CPU.
+"""Benchmark: the full TPC-H suite (q1..q22) + TPC-DS starters
+(q3/q42/q52/q55/q7) through the engine vs pandas on CPU, at SF1.
 
 Prints ONE JSON line:
-  {"metric": "tpch_q1_q3_q6_geomean_speedup_vs_cpu", "value": <x>,
-   "unit": "x", "vs_baseline": <x>, "q1": {...}, "q3": {...}, "q6": {...}}
+  {"metric": "tpch22_tpcds5_geomean_speedup_vs_cpu", "value": <x>,
+   "unit": "x", "vs_baseline": <x>, "q1": {...}, ..., "ds_q7": {...}}
 
-The three queries cover the engine's three regimes (round-2 verdict weak
-#6 asked for exactly this instead of Q6-only):
-  Q6 — scan → filter → scalar aggregate (the friendliest case);
-  Q1 — group-by-heavy wide aggregation (the reference's best case);
-  Q3 — broadcast + shuffled joins + high-cardinality group-by + top-k.
+The reference's headline claim is 3-7x (4x typical) end-to-end speedup
+over CPU Spark (BASELINE.md, docs/FAQ.md:107-109); ``vs_baseline`` is
+geomean-speedup / 4.0, so 1.0 means "matches the reference's typical
+multiplier".  Every query is verified against its pandas oracle
+(rel_err < 1e-6) before its timing counts.
 
-The reference's headline claim is 3-7x (4x typical) end-to-end speedup over
-CPU Spark (BASELINE.md); ``vs_baseline`` is geomean-speedup / 4.0, so 1.0
-means "matches the reference's typical multiplier".
-
-Environment knobs: SRT_BENCH_SF (scale factor, default 1.0),
-SRT_BENCH_ITERS (timed iterations, default 5), SRT_BENCH_QUERIES
-(comma list, default "q6,q1,q3").
+Environment knobs: SRT_BENCH_SF (default 1.0), SRT_BENCH_ITERS (timed
+iterations, default 3), SRT_BENCH_QUERIES (comma list; default = all 27),
+SRT_BENCH_QUERY_TIMEOUT (per-query subprocess budget, default 480 s).
 """
 
 from __future__ import annotations
@@ -27,13 +24,15 @@ import os
 import sys
 import time
 
-import numpy as np
-
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 DATA_DIR = os.path.join(REPO, ".bench_data")
 REFERENCE_TYPICAL_SPEEDUP = 4.0  # docs/FAQ.md:107-109 "4x typical"
+
+TPCH_QUERIES = [f"q{i}" for i in range(1, 23)]
+TPCDS_QUERIES = ["ds_q3", "ds_q42", "ds_q52", "ds_q55", "ds_q7"]
+ALL_QUERIES = TPCH_QUERIES + TPCDS_QUERIES
 
 
 def _time(fn, iters):
@@ -45,112 +44,58 @@ def _time(fn, iters):
     return min(ts)
 
 
-def _bench_query(name, engine_fn, cpu_fn, check_fn, iters):
+def _run_one(name: str, sf: float, iters: int) -> dict:
+    """Time one query in this process (the subprocess side)."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.models import tpcds, tpch_suite
+
+    mod = tpcds if name.startswith("ds_") else tpch_suite
+    runner, oracle = mod.QUERIES[name]
+    tables = mod.TABLES[name]
+    paths = mod.gen_db(sf, DATA_DIR)
+
+    sess = srt.Session.get_or_create(settings={
+        "spark.rapids.tpu.sql.fileCache.enabled": True,
+    })
+    dfs = {t: sess.read_parquet(paths[t]) for t in tables}
+    # pandas baseline runs fully in-memory; the engine's decoded-file
+    # cache gives it the same footing (parquet decode out of the loop)
+    import pyarrow.parquet as pq
+    pds = {t: pq.read_table(paths[t]).to_pandas() for t in tables}
+
     t0 = time.perf_counter()
-    engine_res = engine_fn()
+    engine_rows = runner(dfs)
     cold_s = time.perf_counter() - t0
-    engine_s = _time(engine_fn, iters)
-    cpu_res = cpu_fn()
-    cpu_s = _time(cpu_fn, max(1, iters // 2))
-    rel_err = check_fn(engine_res, cpu_res)
-    assert rel_err < 1e-6, f"{name} result mismatch (rel_err={rel_err})"
+    engine_s = _time(lambda: runner(dfs), iters)
+    cpu_rows = oracle(pds)
+    cpu_s = _time(lambda: oracle(pds), max(1, iters // 2))
+    rel_err = tpch_suite.rows_rel_err(engine_rows, cpu_rows)
+    assert rel_err < 1e-6, \
+        f"{name} result mismatch (rel_err={rel_err}, rows={len(engine_rows)})"
     return {
         "speedup": round(cpu_s / engine_s, 4),
         "engine_s": round(engine_s, 5),
         "engine_cold_s": round(cold_s, 5),
         "cpu_s": round(cpu_s, 5),
         "result_rel_err": rel_err,
+        "rows": len(engine_rows),
     }
 
 
 def main() -> None:
     sf = float(os.environ.get("SRT_BENCH_SF", "1.0"))
-    iters = int(os.environ.get("SRT_BENCH_ITERS", "5"))
-    which = os.environ.get("SRT_BENCH_QUERIES", "q6,q1,q3").split(",")
+    iters = int(os.environ.get("SRT_BENCH_ITERS", "3"))
+    which = [q for q in os.environ.get(
+        "SRT_BENCH_QUERIES", ",".join(ALL_QUERIES)).split(",") if q]
     if len(which) > 1:
         # isolate each query in a subprocess with its own time budget: a
         # pathological compile or regression in one query must not take
         # down the whole benchmark signal
         _run_isolated(sf, iters, which)
         return
-
-    import pyarrow.parquet as pq
-
-    import spark_rapids_tpu as srt
-    from spark_rapids_tpu.models import tpch
-
-    li_path = tpch.gen_lineitem(sf, DATA_DIR)
-
-    # the pandas baseline below runs in-memory, so give the engine the same
-    # footing: the decoded-file cache (FileCache analog) keeps the parquet
-    # decode out of the steady-state loop the way pdf does for pandas
-    sess = srt.Session.get_or_create(settings={
-        "spark.rapids.tpu.sql.fileCache.enabled": True,
-    })
-    li = sess.read_parquet(li_path)
-    lpdf = pq.read_table(li_path).to_pandas()
-    results = {}
-
-    if "q6" in which:
-        def check_q6(e, c):
-            ev, cv = e[0][0], c
-            return abs(ev - cv) / max(1.0, abs(cv))
-        results["q6"] = _bench_query(
-            "q6", lambda: tpch.q6(li).collect(),
-            lambda: tpch.q6_pandas(lpdf), check_q6, iters)
-
-    if "q1" in which:
-        def check_q1(e, c):
-            rows = sorted(e)
-            exp = list(c.itertuples(index=False))
-            if len(rows) != len(exp):
-                return 1.0
-            err = 0.0
-            for g, w in zip(rows, exp):
-                for gi, wi in zip(g[2:], tuple(w)[2:]):
-                    err = max(err, abs(float(gi) - float(wi))
-                              / max(1.0, abs(float(wi))))
-            return err
-        results["q1"] = _bench_query(
-            "q1", lambda: tpch.q1(li).collect(),
-            lambda: tpch.q1_pandas(lpdf), check_q1, iters)
-
-    if "q3" in which:
-        o_path = tpch.gen_orders(sf, DATA_DIR)
-        c_path = tpch.gen_customer(sf, DATA_DIR)
-        orders = sess.read_parquet(o_path)
-        cust = sess.read_parquet(c_path)
-        opdf = pq.read_table(o_path).to_pandas()
-        cpdf = pq.read_table(c_path).to_pandas()
-
-        def check_q3(e, c):
-            exp = list(c.itertuples(index=False))
-            if len(e) != len(exp):
-                return 1.0
-            err = 0.0
-            for g, w in zip(e, exp):
-                # compare the ranked revenue column (ties could permute
-                # the key columns; revenue ranking is the query's output)
-                err = max(err, abs(float(g[3]) - float(w.revenue))
-                          / max(1.0, abs(float(w.revenue))))
-            return err
-        results["q3"] = _bench_query(
-            "q3", lambda: tpch.q3(cust, orders, li).collect(),
-            lambda: tpch.q3_pandas(cpdf, opdf, lpdf), check_q3, iters)
-
-    speedups = [r["speedup"] for r in results.values()]
-    geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
-    out = {
-        "metric": "tpch_q1_q3_q6_geomean_speedup_vs_cpu",
-        "value": round(geomean, 4),
-        "unit": "x",
-        "vs_baseline": round(geomean / REFERENCE_TYPICAL_SPEEDUP, 4),
-        "sf": sf,
-        "rows": len(lpdf),
-        "backend": _backend(),
-        **results,
-    }
-    print(json.dumps(out))
+    name = which[0]
+    print(json.dumps({name: _run_one(name, sf, iters),
+                      "backend": _backend()}))
 
 
 def _run_isolated(sf: float, iters: int, which) -> None:
@@ -182,12 +127,13 @@ def _run_isolated(sf: float, iters: int, which) -> None:
     geomean = (math.exp(sum(math.log(s) for s in speedups) / len(speedups))
                if speedups else 0.0)
     out = {
-        "metric": "tpch_q1_q3_q6_geomean_speedup_vs_cpu",
+        "metric": "tpch22_tpcds5_geomean_speedup_vs_cpu",
         "value": round(geomean, 4),
         "unit": "x",
         "vs_baseline": round(geomean / REFERENCE_TYPICAL_SPEEDUP, 4),
         "sf": sf,
         "queries_completed": sorted(results),
+        "n_queries": len(results),
         "backend": _backend(),
         **detail,
     }

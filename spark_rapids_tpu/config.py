@@ -195,6 +195,27 @@ AGG_SINGLE_PROCESS_COMPLETE = register(
     "process the exchange colocates nothing and its staging + the "
     "partial-agg adaptivity sampling only add host round trips.")
 
+AGG_REPARTITION_BUCKETS = register(
+    "spark.rapids.tpu.sql.agg.repartitionBuckets", 64,
+    "Hash-bucket count for the aggregate re-partition fallback "
+    "(GpuMergeAggregateIterator analog): a final/complete aggregation "
+    "whose merged output outgrows batchSizeRows splits into this many "
+    "disjoint key buckets, each bounded at batchSizeRows rows (total "
+    "group capacity = buckets x batchSizeRows; overflow raises).")
+
+DPP_ENABLED = register(
+    "spark.rapids.tpu.sql.dpp.enabled", True,
+    "Dynamic partition pruning: after a broadcast join's build side "
+    "materializes, push its key range (and, when the distinct count is "
+    "small, the exact key list) into the probe-side scan as runtime "
+    "predicates for file/row-group pruning. GpuSubqueryBroadcastExec / "
+    "GpuDynamicPruningExpression analog.")
+
+DPP_MAX_IN_KEYS = register(
+    "spark.rapids.tpu.sql.dpp.maxInKeys", 10_000,
+    "Largest distinct build-key count pushed as an exact IN-list runtime "
+    "predicate; above it only the [min, max] range is pushed.")
+
 DENSE_JOIN_DOMAIN_CAP = register(
     "spark.rapids.tpu.join.denseDomainCap", 1 << 26,
     "Largest key domain (max_key - min_key + 1) for which a broadcast "

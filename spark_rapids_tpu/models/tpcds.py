@@ -1,0 +1,330 @@
+"""TPC-DS-shaped data generation and starter queries (q3, q42, q52, q55,
+q7 — the DPP-light star-join family the round-3 verdict asked for first).
+
+``gen_db(sf, out_dir)`` writes store_sales + the dimensions it references
+with consistent surrogate keys; ``QUERIES`` has the same
+(runner(dfs) -> rows, oracle(pds) -> rows) interface as
+models/tpch_suite.py so bench.py and the acceptance tests share one
+harness.  Reference: the NDS (NVIDIA Data Science) benchmark derived from
+TPC-DS that the reference plugin's perf numbers are quoted on
+(docs/benchmarks.md).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Dict, List
+
+import numpy as np
+
+# SF1 row counts (TPC-DS spec shapes, approximately)
+_STORE_SALES_PER_SF = 2_880_404
+_ITEM_PER_SF = 18_000
+
+_D_START = datetime.date(1998, 1, 1)
+_N_DATES = 6 * 365 + 2  # 1998-01-01 .. 2003-12-31
+
+
+def gen_db(sf: float, out_dir: str, chunk: int = 1_000_000
+           ) -> Dict[str, str]:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = os.path.join(out_dir, f"tpcds_sf{sf}")
+    tables = ["date_dim", "item", "customer_demographics", "promotion",
+              "store_sales"]
+    paths = {t: os.path.join(root, f"{t}.parquet") for t in tables}
+    if all(os.path.exists(p) for p in paths.values()):
+        return paths
+    os.makedirs(root, exist_ok=True)
+
+    # date_dim: one row per calendar day, d_date_sk dense from 2450815
+    sk0 = 2_450_815
+    days = np.arange(_N_DATES)
+    dates = np.datetime64(_D_START) + days.astype("timedelta64[D]")
+    as_dt = dates.astype("datetime64[D]").astype(object)
+    pq.write_table(pa.table({
+        "d_date_sk": (sk0 + days).astype(np.int64),
+        "d_date": pa.array(dates, type=pa.date32()),
+        "d_year": np.array([d.year for d in as_dt], dtype=np.int64),
+        "d_moy": np.array([d.month for d in as_dt], dtype=np.int64),
+        "d_dom": np.array([d.day for d in as_dt], dtype=np.int64),
+    }), paths["date_dim"])
+
+    n_item = max(8, int(_ITEM_PER_SF * sf))
+    rng = np.random.default_rng(2001)
+    cats = np.array(["Books", "Electronics", "Home", "Jewelry", "Men",
+                     "Music", "Shoes", "Sports", "Children", "Women"])
+    cat_id = rng.integers(1, 11, n_item).astype(np.int64)
+    brand_id = rng.integers(1001001, 10016017, n_item).astype(np.int64)
+    pq.write_table(pa.table({
+        "i_item_sk": np.arange(1, n_item + 1, dtype=np.int64),
+        "i_item_id": [f"AAAAAAAA{i:08d}" for i in range(1, n_item + 1)],
+        "i_brand_id": brand_id,
+        "i_brand": [f"brand#{b % 997}" for b in brand_id],
+        "i_category_id": cat_id,
+        "i_category": cats[cat_id - 1],
+        "i_manufact_id": rng.integers(1, 1001, n_item).astype(np.int64),
+        "i_manager_id": rng.integers(1, 101, n_item).astype(np.int64),
+        "i_current_price": np.round(rng.uniform(0.1, 300.0, n_item), 2),
+    }), paths["item"])
+
+    # customer_demographics: the fixed 1.92M-row cross product in spec;
+    # scaled down but keeping every attribute combination present
+    genders = np.array(["M", "F"])
+    marital = np.array(["M", "S", "D", "W", "U"])
+    education = np.array(["Primary", "Secondary", "College",
+                          "2 yr Degree", "4 yr Degree", "Advanced Degree",
+                          "Unknown"])
+    n_cd = max(len(genders) * len(marital) * len(education),
+               int(19_208 * max(sf, 0.01)))
+    idx = np.arange(n_cd)
+    pq.write_table(pa.table({
+        "cd_demo_sk": (idx + 1).astype(np.int64),
+        "cd_gender": genders[idx % 2],
+        "cd_marital_status": marital[(idx // 2) % 5],
+        "cd_education_status": education[(idx // 10) % 7],
+    }), paths["customer_demographics"])
+
+    n_promo = max(4, int(300 * max(sf, 0.05)))
+    rng = np.random.default_rng(2002)
+    pq.write_table(pa.table({
+        "p_promo_sk": np.arange(1, n_promo + 1, dtype=np.int64),
+        "p_channel_email": rng.choice(np.array(["Y", "N"]), n_promo,
+                                      p=[0.1, 0.9]),
+        "p_channel_event": rng.choice(np.array(["Y", "N"]), n_promo,
+                                      p=[0.1, 0.9]),
+    }), paths["promotion"])
+
+    n_ss = max(64, int(_STORE_SALES_PER_SF * sf))
+    rng = np.random.default_rng(2003)
+    import pyarrow.parquet as pq2
+    w = None
+    for off in range(0, n_ss, chunk):
+        m = min(chunk, n_ss - off)
+        qty = rng.integers(1, 101, m).astype(np.int64)
+        list_price = np.round(rng.uniform(1.0, 200.0, m), 2)
+        sales_price = np.round(list_price * rng.uniform(0.2, 1.0, m), 2)
+        t = pa.table({
+            # ~4% of fact rows carry null FK (spec allows nulls here)
+            "ss_sold_date_sk": _null_some(
+                rng, (sk0 + rng.integers(0, _N_DATES, m)).astype(np.int64)),
+            "ss_item_sk": rng.integers(1, n_item + 1, m).astype(np.int64),
+            "ss_cdemo_sk": _null_some(
+                rng, rng.integers(1, n_cd + 1, m).astype(np.int64)),
+            "ss_promo_sk": _null_some(
+                rng, rng.integers(1, n_promo + 1, m).astype(np.int64)),
+            "ss_quantity": qty,
+            "ss_list_price": list_price,
+            "ss_sales_price": sales_price,
+            "ss_ext_sales_price": np.round(sales_price * qty, 2),
+            "ss_coupon_amt": np.round(
+                rng.uniform(0, 50.0, m) * (rng.random(m) < 0.2), 2),
+        })
+        w = w or pq2.ParquetWriter(paths["store_sales"], t.schema)
+        w.write_table(t)
+    if w:
+        w.close()
+    return paths
+
+
+def _null_some(rng, arr, frac: float = 0.04):
+    import pyarrow as pa
+    mask = rng.random(len(arr)) < frac
+    return pa.array(np.where(mask, None, arr), type=pa.int64(),
+                    from_pandas=True) if mask.any() else pa.array(arr)
+
+
+def load_db(sess, sf: float, out_dir: str):
+    paths = gen_db(sf, out_dir)
+    return {t: sess.read_parquet(p) for t, p in paths.items()}
+
+
+def load_pdb(sf: float, out_dir: str):
+    import pyarrow.parquet as pq
+    paths = gen_db(sf, out_dir)
+    return {t: pq.read_table(p).to_pandas() for t, p in paths.items()}
+
+
+def _F():
+    from ..sql import functions
+    return functions
+
+
+# ---------------------------------------------------------------------------------
+# Queries — star joins over store_sales (TPC-DS q3/q42/q52/q55/q7)
+# ---------------------------------------------------------------------------------
+
+def run_q3(dfs):
+    f = _F()
+    q = (dfs["store_sales"]
+         .join(dfs["date_dim"].filter(f.col("d_moy") == 11),
+               on=[("ss_sold_date_sk", "d_date_sk")])
+         .join(dfs["item"].filter(f.col("i_manufact_id") == 128),
+               on=[("ss_item_sk", "i_item_sk")])
+         .group_by("d_year", "i_brand_id", "i_brand")
+         .agg(f.sum(f.col("ss_ext_sales_price")).alias("sum_agg"))
+         .sort("d_year", f.col("sum_agg").desc(), "i_brand_id")
+         .limit(100))
+    return q.collect()
+
+
+def pandas_q3(pds):
+    ss, d, i = pds["store_sales"], pds["date_dim"], pds["item"]
+    m = (ss.merge(d[d.d_moy == 11], left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+         .merge(i[i.i_manufact_id == 128], left_on="ss_item_sk",
+                right_on="i_item_sk"))
+    g = (m.groupby(["d_year", "i_brand_id", "i_brand"])
+         ["ss_ext_sales_price"].sum().reset_index()
+         .sort_values(["d_year", "ss_ext_sales_price", "i_brand_id"],
+                      ascending=[True, False, True]).head(100))
+    return [(int(r.d_year), int(r.i_brand_id), r.i_brand,
+             r.ss_ext_sales_price) for r in g.itertuples()]
+
+
+def _brand_month_year(dfs, year, moy, manager):
+    f = _F()
+    return (dfs["store_sales"]
+            .join(dfs["date_dim"]
+                  .filter((f.col("d_moy") == moy)
+                          & (f.col("d_year") == year)),
+                  on=[("ss_sold_date_sk", "d_date_sk")])
+            .join(dfs["item"].filter(f.col("i_manager_id") == manager),
+                  on=[("ss_item_sk", "i_item_sk")]))
+
+
+def run_q42(dfs):
+    f = _F()
+    q = (_brand_month_year(dfs, 2000, 11, 1)
+         .group_by("d_year", "i_category_id", "i_category")
+         .agg(f.sum(f.col("ss_ext_sales_price")).alias("s"))
+         .sort(f.col("s").desc(), "d_year", "i_category_id", "i_category")
+         .limit(100))
+    return q.collect()
+
+
+def pandas_q42(pds):
+    ss, d, i = pds["store_sales"], pds["date_dim"], pds["item"]
+    m = (ss.merge(d[(d.d_moy == 11) & (d.d_year == 2000)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(i[i.i_manager_id == 1], left_on="ss_item_sk",
+                right_on="i_item_sk"))
+    g = (m.groupby(["d_year", "i_category_id", "i_category"])
+         ["ss_ext_sales_price"].sum().reset_index()
+         .sort_values(["ss_ext_sales_price", "d_year", "i_category_id",
+                       "i_category"],
+                      ascending=[False, True, True, True]).head(100))
+    return [(int(r.d_year), int(r.i_category_id), r.i_category,
+             r.ss_ext_sales_price) for r in g.itertuples()]
+
+
+def run_q52(dfs):
+    f = _F()
+    q = (_brand_month_year(dfs, 2000, 11, 1)
+         .group_by("d_year", "i_brand_id", "i_brand")
+         .agg(f.sum(f.col("ss_ext_sales_price")).alias("ext_price"))
+         .sort("d_year", f.col("ext_price").desc(), "i_brand_id")
+         .limit(100))
+    return q.collect()
+
+
+def pandas_q52(pds):
+    ss, d, i = pds["store_sales"], pds["date_dim"], pds["item"]
+    m = (ss.merge(d[(d.d_moy == 11) & (d.d_year == 2000)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(i[i.i_manager_id == 1], left_on="ss_item_sk",
+                right_on="i_item_sk"))
+    g = (m.groupby(["d_year", "i_brand_id", "i_brand"])
+         ["ss_ext_sales_price"].sum().reset_index()
+         .sort_values(["d_year", "ss_ext_sales_price", "i_brand_id"],
+                      ascending=[True, False, True]).head(100))
+    return [(int(r.d_year), int(r.i_brand_id), r.i_brand,
+             r.ss_ext_sales_price) for r in g.itertuples()]
+
+
+def run_q55(dfs):
+    f = _F()
+    q = (_brand_month_year(dfs, 1999, 11, 28)
+         .group_by("i_brand_id", "i_brand")
+         .agg(f.sum(f.col("ss_ext_sales_price")).alias("ext_price"))
+         .sort(f.col("ext_price").desc(), "i_brand_id")
+         .limit(100))
+    return q.collect()
+
+
+def pandas_q55(pds):
+    ss, d, i = pds["store_sales"], pds["date_dim"], pds["item"]
+    m = (ss.merge(d[(d.d_moy == 11) & (d.d_year == 1999)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(i[i.i_manager_id == 28], left_on="ss_item_sk",
+                right_on="i_item_sk"))
+    g = (m.groupby(["i_brand_id", "i_brand"])["ss_ext_sales_price"]
+         .sum().reset_index()
+         .sort_values(["ss_ext_sales_price", "i_brand_id"],
+                      ascending=[False, True]).head(100))
+    return [(int(r.i_brand_id), r.i_brand, r.ss_ext_sales_price)
+            for r in g.itertuples()]
+
+
+def run_q7(dfs):
+    f = _F()
+    cd = dfs["customer_demographics"].filter(
+        (f.col("cd_gender") == "M") & (f.col("cd_marital_status") == "S")
+        & (f.col("cd_education_status") == "College"))
+    promo = dfs["promotion"].filter(
+        (f.col("p_channel_email") == "N")
+        | (f.col("p_channel_event") == "N"))
+    q = (dfs["store_sales"]
+         .join(cd, on=[("ss_cdemo_sk", "cd_demo_sk")])
+         .join(dfs["date_dim"].filter(f.col("d_year") == 2000),
+               on=[("ss_sold_date_sk", "d_date_sk")])
+         .join(dfs["item"], on=[("ss_item_sk", "i_item_sk")])
+         .join(promo, on=[("ss_promo_sk", "p_promo_sk")])
+         .group_by("i_item_id")
+         .agg(f.avg(f.col("ss_quantity")).alias("agg1"),
+              f.avg(f.col("ss_list_price")).alias("agg2"),
+              f.avg(f.col("ss_coupon_amt")).alias("agg3"),
+              f.avg(f.col("ss_sales_price")).alias("agg4"))
+         .sort("i_item_id").limit(100))
+    return q.collect()
+
+
+def pandas_q7(pds):
+    ss, cd, d, i, p = (pds[k] for k in
+                       ["store_sales", "customer_demographics", "date_dim",
+                        "item", "promotion"])
+    cdf = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+             & (cd.cd_education_status == "College")]
+    pf = p[(p.p_channel_email == "N") | (p.p_channel_event == "N")]
+    m = (ss.merge(cdf, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+         .merge(d[d.d_year == 2000], left_on="ss_sold_date_sk",
+                right_on="d_date_sk")
+         .merge(i, left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(pf, left_on="ss_promo_sk", right_on="p_promo_sk"))
+    g = (m.groupby("i_item_id")
+         .agg(agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+              agg3=("ss_coupon_amt", "mean"),
+              agg4=("ss_sales_price", "mean"))
+         .reset_index().sort_values("i_item_id").head(100))
+    return [(r.i_item_id, r.agg1, r.agg2, r.agg3, r.agg4)
+            for r in g.itertuples()]
+
+
+QUERIES = {
+    "ds_q3": (run_q3, pandas_q3),
+    "ds_q42": (run_q42, pandas_q42),
+    "ds_q52": (run_q52, pandas_q52),
+    "ds_q55": (run_q55, pandas_q55),
+    "ds_q7": (run_q7, pandas_q7),
+}
+
+TABLES: Dict[str, List[str]] = {
+    "ds_q3": ["store_sales", "date_dim", "item"],
+    "ds_q42": ["store_sales", "date_dim", "item"],
+    "ds_q52": ["store_sales", "date_dim", "item"],
+    "ds_q55": ["store_sales", "date_dim", "item"],
+    "ds_q7": ["store_sales", "customer_demographics", "date_dim", "item",
+              "promotion"],
+}

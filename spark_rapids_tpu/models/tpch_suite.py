@@ -1,0 +1,967 @@
+"""Full TPC-H suite at scale: data generation + all 22 queries.
+
+``gen_db(sf, out_dir)`` writes the eight TPC-H tables as parquet with
+consistent foreign keys (chunked, deterministic seeds — the datagen/
+module analog, SURVEY §2.10).  ``QUERIES`` maps q1..q22 to
+(engine runner, pandas oracle) pairs with a uniform interface:
+
+    runner(dfs: dict[str, DataFrame]) -> list[tuple]   (collect included)
+    oracle(pds: dict[str, pandas.DataFrame]) -> list[tuple]
+
+Query formulations mirror tests/test_tpch_queries*.py: scalar subqueries
+are manually decorrelated (collected literals), EXISTS/NOT EXISTS become
+semi/anti joins — the same rewrites Spark's optimizer performs before the
+reference plugin sees the plan (sql-plugin planning path).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from .tpch import CONTAINERS, NATIONS, PRIORITIES, REGIONS, SEGMENTS, \
+    SHIPMODES, TYPES
+
+D = datetime.date
+
+# SF1 row counts (TPC-H spec shapes)
+_SIZES = {
+    "lineitem": 6_001_215, "orders": 1_500_000, "customer": 150_000,
+    "part": 200_000, "partsupp": 800_000, "supplier": 10_000,
+}
+
+
+def _n(table: str, sf: float) -> int:
+    if table == "region":
+        return len(REGIONS)
+    if table == "nation":
+        return len(NATIONS)
+    return max(8, int(_SIZES[table] * sf))
+
+
+def gen_db(sf: float, out_dir: str, chunk: int = 1_000_000
+           ) -> Dict[str, str]:
+    """Write all eight tables; returns {table: parquet path}.  Idempotent
+    per (sf, out_dir)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = os.path.join(out_dir, f"tpch_sf{sf}")
+    paths = {t: os.path.join(root, f"{t}.parquet")
+             for t in ["region", "nation", "customer", "supplier", "part",
+                       "partsupp", "orders", "lineitem"]}
+    if all(os.path.exists(p) for p in paths.values()):
+        return paths
+    os.makedirs(root, exist_ok=True)
+    base = np.datetime64("1992-01-01")
+
+    rng = np.random.default_rng(1001)
+    pq.write_table(pa.table({
+        "r_regionkey": np.arange(len(REGIONS), dtype=np.int64),
+        "r_name": REGIONS,
+    }), paths["region"])
+
+    pq.write_table(pa.table({
+        "n_nationkey": np.arange(len(NATIONS), dtype=np.int64),
+        "n_name": NATIONS,
+        "n_regionkey": rng.integers(0, len(REGIONS),
+                                    len(NATIONS)).astype(np.int64),
+    }), paths["nation"])
+
+    n_cust = _n("customer", sf)
+    rng = np.random.default_rng(1002)
+    pq.write_table(pa.table({
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+        "c_nationkey": rng.integers(0, len(NATIONS),
+                                    n_cust).astype(np.int64),
+        "c_mktsegment": rng.choice(np.array(SEGMENTS), n_cust),
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2),
+        "c_phone": [f"{a}-{b}-{c}-{d}" for a, b, c, d in zip(
+            rng.integers(10, 35, n_cust), rng.integers(100, 999, n_cust),
+            rng.integers(100, 999, n_cust),
+            rng.integers(1000, 9999, n_cust))],
+    }), paths["customer"])
+
+    n_supp = _n("supplier", sf)
+    rng = np.random.default_rng(1003)
+    pq.write_table(pa.table({
+        "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
+        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+        "s_nationkey": rng.integers(0, len(NATIONS),
+                                    n_supp).astype(np.int64),
+        "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp), 2),
+    }), paths["supplier"])
+
+    n_part = _n("part", sf)
+    rng = np.random.default_rng(1004)
+    brands = np.array([f"Brand#{i}{j}" for i in range(1, 6)
+                       for j in range(1, 6)])
+    pq.write_table(pa.table({
+        "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
+        "p_name": [f"part {i} goldenrod" if i % 7 == 0 else f"part {i}"
+                   for i in range(1, n_part + 1)],
+        "p_type": rng.choice(np.array(TYPES), n_part),
+        "p_size": rng.integers(1, 51, n_part).astype(np.int64),
+        "p_container": rng.choice(np.array(CONTAINERS), n_part),
+        "p_brand": rng.choice(brands, n_part),
+    }), paths["part"])
+
+    rng = np.random.default_rng(1005)
+    ps_part = np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4)
+    ps_supp = ((ps_part - 1) * 7
+               + np.tile(np.arange(4, dtype=np.int64) * 13,
+                         n_part)) % n_supp + 1
+    # de-dup (part, supp) pairs cheaply: offset collisions by slot index
+    ps_supp = (ps_supp + np.tile(np.arange(4, dtype=np.int64),
+                                 n_part)) % n_supp + 1
+    pq.write_table(pa.table({
+        "ps_partkey": ps_part,
+        "ps_suppkey": ps_supp,
+        "ps_availqty": rng.integers(1, 10000,
+                                    len(ps_part)).astype(np.int64),
+        "ps_supplycost": np.round(rng.uniform(1.0, 1000.0,
+                                              len(ps_part)), 2),
+    }), paths["partsupp"])
+
+    n_ord = _n("orders", sf)
+    rng = np.random.default_rng(1006)
+    w = None
+    for off in range(0, n_ord, chunk):
+        m = min(chunk, n_ord - off)
+        odate = base + rng.integers(0, 2406, m).astype("timedelta64[D]")
+        t = pa.table({
+            "o_orderkey": np.arange(off + 1, off + 1 + m, dtype=np.int64),
+            "o_custkey": rng.integers(1, n_cust + 1, m).astype(np.int64),
+            "o_orderstatus": rng.choice(np.array(["O", "F", "P"]), m),
+            "o_totalprice": np.round(rng.uniform(800.0, 500_000.0, m), 2),
+            "o_orderdate": pa.array(odate, type=pa.date32()),
+            "o_orderpriority": rng.choice(np.array(PRIORITIES), m),
+            "o_shippriority": np.zeros(m, dtype=np.int64),
+        })
+        w = w or pq.ParquetWriter(paths["orders"], t.schema)
+        w.write_table(t)
+    if w:
+        w.close()
+
+    n_li = _n("lineitem", sf)
+    rng = np.random.default_rng(1007)
+    w = None
+    for off in range(0, n_li, chunk):
+        m = min(chunk, n_li - off)
+        ship = base + rng.integers(0, 2526, m).astype("timedelta64[D]")
+        commit = ship + rng.integers(-30, 60, m).astype("timedelta64[D]")
+        receipt = ship + rng.integers(1, 60, m).astype("timedelta64[D]")
+        t = pa.table({
+            "l_orderkey": rng.integers(1, n_ord + 1, m).astype(np.int64),
+            "l_partkey": rng.integers(1, n_part + 1, m).astype(np.int64),
+            "l_suppkey": rng.integers(1, n_supp + 1, m).astype(np.int64),
+            "l_quantity": rng.integers(1, 51, m).astype(np.float64),
+            "l_extendedprice": np.round(
+                rng.uniform(900.0, 105000.0, m), 2),
+            "l_discount": rng.integers(0, 11, m).astype(np.float64) / 100.0,
+            "l_tax": rng.integers(0, 9, m).astype(np.float64) / 100.0,
+            "l_returnflag": rng.choice(np.array(["A", "N", "R"]), m),
+            "l_linestatus": rng.choice(np.array(["O", "F"]), m),
+            "l_shipdate": pa.array(ship, type=pa.date32()),
+            "l_commitdate": pa.array(commit, type=pa.date32()),
+            "l_receiptdate": pa.array(receipt, type=pa.date32()),
+            "l_shipmode": rng.choice(np.array(SHIPMODES), m),
+        })
+        w = w or pq.ParquetWriter(paths["lineitem"], t.schema)
+        w.write_table(t)
+    if w:
+        w.close()
+    return paths
+
+
+def load_db(sess, sf: float, out_dir: str):
+    paths = gen_db(sf, out_dir)
+    return {t: sess.read_parquet(p) for t, p in paths.items()}
+
+
+def load_pdb(sf: float, out_dir: str):
+    import pyarrow.parquet as pq
+    paths = gen_db(sf, out_dir)
+    return {t: pq.read_table(p).to_pandas() for t, p in paths.items()}
+
+
+def _F():
+    from ..sql import functions
+    return functions
+
+
+# ---------------------------------------------------------------------------------
+# Engine runners (collect included; mirrors tests/test_tpch_queries*.py)
+# ---------------------------------------------------------------------------------
+
+def run_q1(dfs):
+    from .tpch import q1
+    return q1(dfs["lineitem"]).collect()
+
+
+def run_q2(dfs):
+    f = _F()
+    eu_sup = (dfs["supplier"]
+              .join(dfs["nation"], on=[("s_nationkey", "n_nationkey")])
+              .join(dfs["region"].filter(f.col("r_name") == "EUROPE"),
+                    on=[("n_regionkey", "r_regionkey")]))
+    ps_eu = dfs["partsupp"].join(eu_sup, on=[("ps_suppkey", "s_suppkey")])
+    min_cost = (ps_eu.group_by("ps_partkey")
+                .agg(f.min(f.col("ps_supplycost")).alias("min_cost")))
+    q = (ps_eu.join(min_cost, on=["ps_partkey"])
+         .filter(f.col("ps_supplycost") == f.col("min_cost"))
+         .join(dfs["part"].filter(f.col("p_size") == 15),
+               on=[("ps_partkey", "p_partkey")])
+         .select("s_acctbal", "s_name", "n_name", "ps_partkey",
+                 "ps_supplycost")
+         .sort(f.col("s_acctbal").desc(), "s_name", "ps_partkey")
+         .limit(100))
+    return q.collect()
+
+
+def run_q3(dfs):
+    from .tpch import q3
+    return q3(dfs["customer"], dfs["orders"], dfs["lineitem"]).collect()
+
+
+def run_q4(dfs):
+    f = _F()
+    lo, hi = D(1993, 7, 1), D(1993, 10, 1)
+    late = dfs["lineitem"].filter(
+        f.col("l_commitdate") < f.col("l_receiptdate"))
+    q = (dfs["orders"]
+         .filter((f.col("o_orderdate") >= lo) & (f.col("o_orderdate") < hi))
+         .join(late, on=[("o_orderkey", "l_orderkey")], how="semi")
+         .group_by("o_orderpriority")
+         .agg(f.count_star().alias("order_count"))
+         .sort("o_orderpriority"))
+    return q.collect()
+
+
+def run_q5(dfs):
+    f = _F()
+    lo, hi = D(1994, 1, 1), D(1995, 1, 1)
+    q = (dfs["customer"]
+         .join(dfs["orders"], on=[("c_custkey", "o_custkey")])
+         .filter((f.col("o_orderdate") >= lo) & (f.col("o_orderdate") < hi))
+         .join(dfs["lineitem"], on=[("o_orderkey", "l_orderkey")])
+         .join(dfs["supplier"], on=[("l_suppkey", "s_suppkey")])
+         .filter(f.col("c_nationkey") == f.col("s_nationkey"))
+         .join(dfs["nation"], on=[("s_nationkey", "n_nationkey")])
+         .join(dfs["region"].filter(f.col("r_name") == "ASIA"),
+               on=[("n_regionkey", "r_regionkey")])
+         .select("n_name",
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount")))
+                 .alias("volume"))
+         .group_by("n_name").agg(f.sum(f.col("volume")).alias("revenue"))
+         .sort(f.col("revenue").desc()))
+    return q.collect()
+
+
+def run_q6(dfs):
+    from .tpch import q6
+    return q6(dfs["lineitem"]).collect()
+
+
+def run_q7(dfs):
+    f = _F()
+    n1, n2 = "FRANCE", "GERMANY"
+    lo, hi = D(1995, 1, 1), D(1996, 12, 31)
+    sup_n = dfs["nation"].filter(f.col("n_name").isin(n1, n2)) \
+        .select(f.col("n_nationkey").alias("sn_key"),
+                f.col("n_name").alias("supp_nation"))
+    cust_n = dfs["nation"].filter(f.col("n_name").isin(n1, n2)) \
+        .select(f.col("n_nationkey").alias("cn_key"),
+                f.col("n_name").alias("cust_nation"))
+    q = (dfs["supplier"].join(sup_n, on=[("s_nationkey", "sn_key")])
+         .join(dfs["lineitem"], on=[("s_suppkey", "l_suppkey")])
+         .filter((f.col("l_shipdate") >= lo) & (f.col("l_shipdate") <= hi))
+         .join(dfs["orders"], on=[("l_orderkey", "o_orderkey")])
+         .join(dfs["customer"], on=[("o_custkey", "c_custkey")])
+         .join(cust_n, on=[("c_nationkey", "cn_key")])
+         .filter(((f.col("supp_nation") == n1) & (f.col("cust_nation") == n2))
+                 | ((f.col("supp_nation") == n2)
+                    & (f.col("cust_nation") == n1)))
+         .select("supp_nation", "cust_nation",
+                 f.year(f.col("l_shipdate")).alias("l_year"),
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount")))
+                 .alias("volume"))
+         .group_by("supp_nation", "cust_nation", "l_year")
+         .agg(f.sum(f.col("volume")).alias("revenue"))
+         .sort("supp_nation", "cust_nation", "l_year"))
+    return q.collect()
+
+
+def run_q8(dfs):
+    f = _F()
+    lo, hi = D(1995, 1, 1), D(1996, 12, 31)
+    n2 = dfs["nation"].select(
+        f.col("n_nationkey").alias("n2_key"),
+        f.col("n_name").alias("n2_name"))
+    q = (dfs["lineitem"]
+         .join(dfs["part"], on=[("l_partkey", "p_partkey")])
+         .join(dfs["supplier"], on=[("l_suppkey", "s_suppkey")])
+         .join(dfs["orders"], on=[("l_orderkey", "o_orderkey")])
+         .filter((f.col("o_orderdate") >= lo) & (f.col("o_orderdate") <= hi))
+         .join(dfs["customer"], on=[("o_custkey", "c_custkey")])
+         .join(dfs["nation"], on=[("c_nationkey", "n_nationkey")])
+         .join(dfs["region"].filter(f.col("r_name") == "AMERICA"),
+               on=[("n_regionkey", "r_regionkey")])
+         .join(n2, on=[("s_nationkey", "n2_key")])
+         .with_column("o_year", f.year(f.col("o_orderdate")))
+         .with_column("volume",
+                      f.col("l_extendedprice") * (1 - f.col("l_discount")))
+         .with_column("brazil_volume",
+                      f.when(f.col("n2_name") == "BRAZIL",
+                             f.col("volume")).otherwise(f.lit(0.0)))
+         .group_by("o_year")
+         .agg(f.sum(f.col("brazil_volume")).alias("bv"),
+              f.sum(f.col("volume")).alias("tv"))
+         .select("o_year", (f.col("bv") / f.col("tv")).alias("mkt_share"))
+         .sort("o_year"))
+    return q.collect()
+
+
+def run_q9(dfs):
+    f = _F()
+    q = (dfs["part"].filter(f.col("p_name").like("%goldenrod%"))
+         .join(dfs["lineitem"], on=[("p_partkey", "l_partkey")])
+         .join(dfs["supplier"], on=[("l_suppkey", "s_suppkey")])
+         .join(dfs["nation"], on=[("s_nationkey", "n_nationkey")])
+         .join(dfs["orders"], on=[("l_orderkey", "o_orderkey")])
+         .select(f.col("n_name").alias("nation"),
+                 f.year(f.col("o_orderdate")).alias("o_year"),
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount"))
+                  - f.lit(0.01) * f.col("l_quantity")).alias("amount"))
+         .group_by("nation", "o_year")
+         .agg(f.sum(f.col("amount")).alias("sum_profit"))
+         .sort("nation", f.col("o_year").desc()))
+    return q.collect()
+
+
+def run_q10(dfs):
+    f = _F()
+    lo, hi = D(1993, 10, 1), D(1994, 1, 1)
+    q = (dfs["customer"]
+         .join(dfs["orders"], on=[("c_custkey", "o_custkey")])
+         .filter((f.col("o_orderdate") >= lo) & (f.col("o_orderdate") < hi))
+         .join(dfs["lineitem"].filter(f.col("l_returnflag") == "R"),
+               on=[("o_orderkey", "l_orderkey")])
+         .select("c_custkey", "c_name", "c_acctbal",
+                 (f.col("l_extendedprice") * (1 - f.col("l_discount")))
+                 .alias("volume"))
+         .group_by("c_custkey", "c_name", "c_acctbal")
+         .agg(f.sum(f.col("volume")).alias("revenue"))
+         .sort(f.col("revenue").desc(), f.col("c_custkey")).limit(20))
+    return q.collect()
+
+
+def run_q11(dfs):
+    f = _F()
+    nat = "GERMANY"
+    ps_n = (dfs["partsupp"]
+            .join(dfs["supplier"], on=[("ps_suppkey", "s_suppkey")])
+            .join(dfs["nation"].filter(f.col("n_name") == nat),
+                  on=[("s_nationkey", "n_nationkey")])
+            .with_column("value",
+                         f.col("ps_supplycost") * f.col("ps_availqty")))
+    total = ps_n.agg(f.sum(f.col("value")).alias("t")).collect()[0][0]
+    q = (ps_n.group_by("ps_partkey")
+         .agg(f.sum(f.col("value")).alias("value"))
+         .filter(f.col("value") > f.lit((total or 0.0) * 0.0001))
+         .sort(f.col("value").desc(), "ps_partkey"))
+    return q.collect()
+
+
+def run_q12(dfs):
+    f = _F()
+    lo, hi = D(1994, 1, 1), D(1995, 1, 1)
+    high = f.when(f.col("o_orderpriority").isin("1-URGENT", "2-HIGH"),
+                  f.lit(1)).otherwise(f.lit(0))
+    low = f.when(~f.col("o_orderpriority").isin("1-URGENT", "2-HIGH"),
+                 f.lit(1)).otherwise(f.lit(0))
+    q = (dfs["orders"]
+         .join(dfs["lineitem"]
+               .filter(f.col("l_shipmode").isin("MAIL", "SHIP")
+                       & (f.col("l_commitdate") < f.col("l_receiptdate"))
+                       & (f.col("l_shipdate") < f.col("l_commitdate"))
+                       & (f.col("l_receiptdate") >= lo)
+                       & (f.col("l_receiptdate") < hi)),
+               on=[("o_orderkey", "l_orderkey")])
+         .select("l_shipmode", high.alias("high"), low.alias("low"))
+         .group_by("l_shipmode")
+         .agg(f.sum(f.col("high")).alias("high_line_count"),
+              f.sum(f.col("low")).alias("low_line_count"))
+         .sort("l_shipmode"))
+    return q.collect()
+
+
+def run_q13(dfs):
+    f = _F()
+    kept = dfs["orders"].filter(f.col("o_orderpriority") != "1-URGENT")
+    per_cust = (dfs["customer"]
+                .join(kept, on=[("c_custkey", "o_custkey")], how="left")
+                .group_by("c_custkey")
+                .agg(f.count(f.col("o_orderkey")).alias("c_count")))
+    q = (per_cust.group_by("c_count")
+         .agg(f.count_star().alias("custdist"))
+         .sort(f.col("custdist").desc(), f.col("c_count").desc()))
+    return q.collect()
+
+
+def run_q14(dfs):
+    f = _F()
+    lo, hi = D(1995, 9, 1), D(1995, 10, 1)
+    vol = f.col("l_extendedprice") * (1 - f.col("l_discount"))
+    q = (dfs["lineitem"]
+         .filter((f.col("l_shipdate") >= lo) & (f.col("l_shipdate") < hi))
+         .join(dfs["part"], on=[("l_partkey", "p_partkey")])
+         .select(f.when(f.col("p_type").like("PROMO%"), vol)
+                 .otherwise(f.lit(0.0)).alias("promo"),
+                 vol.alias("total"))
+         .agg(f.sum(f.col("promo")).alias("p"),
+              f.sum(f.col("total")).alias("t"))
+         .select((f.col("p") / f.col("t") * 100.0).alias("promo_revenue")))
+    return q.collect()
+
+
+def run_q15(dfs):
+    f = _F()
+    lo, hi = D(1996, 1, 1), D(1996, 4, 1)
+    revenue = (dfs["lineitem"]
+               .filter((f.col("l_shipdate") >= lo)
+                       & (f.col("l_shipdate") < hi))
+               .with_column("rev", f.col("l_extendedprice")
+                            * (1 - f.col("l_discount")))
+               .group_by("l_suppkey")
+               .agg(f.sum(f.col("rev")).alias("total_revenue")))
+    top = revenue.agg(f.max(f.col("total_revenue")).alias("m")) \
+        .collect()[0][0]
+    q = (dfs["supplier"]
+         .join(revenue.filter(f.col("total_revenue") == f.lit(top)),
+               on=[("s_suppkey", "l_suppkey")])
+         .select("s_suppkey", "s_name", "total_revenue")
+         .sort("s_suppkey"))
+    return q.collect()
+
+
+def run_q16(dfs):
+    f = _F()
+    bad = dfs["supplier"].filter(f.col("s_acctbal") < 0)
+    q = (dfs["partsupp"]
+         .join(bad, on=[("ps_suppkey", "s_suppkey")], how="anti")
+         .join(dfs["part"].filter((f.col("p_brand") != "Brand#45")
+                                  & (f.col("p_size").isin(1, 4, 7, 10,
+                                                          14, 23))),
+               on=[("ps_partkey", "p_partkey")])
+         .select("p_brand", "p_type", "p_size", "ps_suppkey").distinct()
+         .group_by("p_brand", "p_type", "p_size")
+         .agg(f.count_star().alias("supplier_cnt"))
+         .sort(f.col("supplier_cnt").desc(), "p_brand", "p_type", "p_size"))
+    return q.collect()
+
+
+def run_q17(dfs):
+    f = _F()
+    parts = dfs["part"].filter(f.col("p_container") == "JUMBO PKG")
+    avg_qty = (dfs["lineitem"].group_by("l_partkey")
+               .agg(f.avg(f.col("l_quantity")).alias("aq"))
+               .select(f.col("l_partkey").alias("ak"),
+                       (f.col("aq") * 0.2).alias("lim")))
+    q = (dfs["lineitem"]
+         .join(parts, on=[("l_partkey", "p_partkey")])
+         .join(avg_qty, on=[("l_partkey", "ak")])
+         .filter(f.col("l_quantity") < f.col("lim"))
+         .agg(f.sum(f.col("l_extendedprice")).alias("s"))
+         .select((f.col("s") / 7.0).alias("avg_yearly")))
+    return q.collect()
+
+
+def run_q18(dfs):
+    f = _F()
+    big = (dfs["lineitem"].group_by("l_orderkey")
+           .agg(f.sum(f.col("l_quantity")).alias("qty"))
+           .filter(f.col("qty") > 300))
+    q = (dfs["orders"]
+         .join(big, on=[("o_orderkey", "l_orderkey")], how="semi")
+         .join(dfs["customer"], on=[("o_custkey", "c_custkey")])
+         .select("c_name", "o_orderkey", "o_totalprice")
+         .sort(f.col("o_totalprice").desc(), f.col("o_orderkey")).limit(100))
+    return q.collect()
+
+
+def run_q19(dfs):
+    f = _F()
+    q = (dfs["lineitem"]
+         .join(dfs["part"], on=[("l_partkey", "p_partkey")])
+         .filter(
+             (f.col("p_container").isin("SM CASE", "SM BOX")
+              & (f.col("l_quantity") >= 1) & (f.col("l_quantity") <= 20)
+              & (f.col("p_size") <= 15))
+             | (f.col("p_container").isin("MED BAG", "MED BOX")
+                & (f.col("l_quantity") >= 10) & (f.col("l_quantity") <= 30)
+                & (f.col("p_size") <= 25)))
+         .agg(f.sum(f.col("l_extendedprice") * (1 - f.col("l_discount")))
+              .alias("revenue")))
+    return q.collect()
+
+
+def run_q20(dfs):
+    f = _F()
+    lo, hi = D(1994, 1, 1), D(1995, 1, 1)
+    shipped = (dfs["lineitem"]
+               .filter((f.col("l_shipdate") >= lo)
+                       & (f.col("l_shipdate") < hi))
+               .group_by("l_partkey", "l_suppkey")
+               .agg(f.sum(f.col("l_quantity")).alias("sq"))
+               .with_column("half_qty", f.col("sq") * 0.5))
+    forest = dfs["part"].filter(f.like(f.col("p_name"), "part 1%"))
+    excess = (dfs["partsupp"]
+              .join(forest, on=[("ps_partkey", "p_partkey")], how="semi")
+              .join(shipped.select(f.col("l_partkey").alias("pk"),
+                                   f.col("l_suppkey").alias("sk"),
+                                   "half_qty"),
+                    on=[("ps_partkey", "pk"), ("ps_suppkey", "sk")])
+              .filter(f.col("ps_availqty") > f.col("half_qty")))
+    q = (dfs["supplier"]
+         .join(excess, on=[("s_suppkey", "ps_suppkey")], how="semi")
+         .join(dfs["nation"].filter(f.col("n_name") == "CANADA"),
+               on=[("s_nationkey", "n_nationkey")])
+         .select("s_name", "s_suppkey").sort("s_name"))
+    return q.collect()
+
+
+def run_q21(dfs):
+    f = _F()
+    late = (dfs["lineitem"]
+            .filter(f.col("l_receiptdate") > f.col("l_commitdate"))
+            .select(f.col("l_orderkey").alias("late_ok"),
+                    f.col("l_suppkey").alias("late_sk")))
+    multi = (dfs["lineitem"].select("l_orderkey", "l_suppkey").distinct()
+             .group_by("l_orderkey")
+             .agg(f.count_star().alias("n_sups"))
+             .filter(f.col("n_sups") > 1)
+             .select(f.col("l_orderkey").alias("mk")))
+    multi_late = (late.distinct().group_by("late_ok")
+                  .agg(f.count_star().alias("n_late"))
+                  .filter(f.col("n_late") > 1)
+                  .select(f.col("late_ok").alias("xk")))
+    q = (late.distinct()
+         .join(dfs["orders"].filter(f.col("o_orderstatus") == "F"),
+               on=[("late_ok", "o_orderkey")], how="semi")
+         .join(multi, on=[("late_ok", "mk")], how="semi")
+         .join(multi_late, on=[("late_ok", "xk")], how="anti")
+         .join(dfs["supplier"], on=[("late_sk", "s_suppkey")])
+         .group_by("s_name")
+         .agg(f.count_star().alias("numwait"))
+         .sort(f.col("numwait").desc(), "s_name").limit(100))
+    return q.collect()
+
+
+def run_q22(dfs):
+    f = _F()
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cust = dfs["customer"].with_column(
+        "cntrycode", f.substring(f.col("c_phone"), 1, 2))
+    in_codes = cust.filter(f.col("cntrycode").isin(*codes))
+    avg_bal = in_codes.filter(f.col("c_acctbal") > 0.0) \
+        .agg(f.avg(f.col("c_acctbal")).alias("a")).collect()[0][0]
+    q = (in_codes.filter(f.col("c_acctbal") > f.lit(avg_bal))
+         .join(dfs["orders"], on=[("c_custkey", "o_custkey")], how="anti")
+         .group_by("cntrycode")
+         .agg(f.count_star().alias("numcust"),
+              f.sum(f.col("c_acctbal")).alias("totacctbal"))
+         .sort("cntrycode"))
+    return q.collect()
+
+
+# ---------------------------------------------------------------------------------
+# Pandas oracles
+# ---------------------------------------------------------------------------------
+
+def _vol(m):
+    return m.l_extendedprice * (1 - m.l_discount)
+
+
+def pandas_q1(pds):
+    from .tpch import q1_pandas
+    g = q1_pandas(pds["lineitem"])
+    return [tuple(r) for r in g.itertuples(index=False)]
+
+
+def pandas_q2(pds):
+    s, n, r, ps, p = (pds[k] for k in
+                      ["supplier", "nation", "region", "partsupp", "part"])
+    eu = (s.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+          .merge(r[r.r_name == "EUROPE"], left_on="n_regionkey",
+                 right_on="r_regionkey"))
+    pe = ps.merge(eu, left_on="ps_suppkey", right_on="s_suppkey")
+    mc = pe.groupby("ps_partkey")["ps_supplycost"].min().rename("min_cost")
+    m = pe.merge(mc, on="ps_partkey")
+    m = m[m.ps_supplycost == m.min_cost].merge(
+        p[p.p_size == 15], left_on="ps_partkey", right_on="p_partkey")
+    exp = m.sort_values(["s_acctbal", "s_name", "ps_partkey"],
+                        ascending=[False, True, True]).head(100)
+    return list(zip(exp.s_acctbal, exp.s_name, exp.n_name, exp.ps_partkey,
+                    exp.ps_supplycost))
+
+
+def pandas_q3(pds):
+    from .tpch import q3_pandas
+    g = q3_pandas(pds["customer"], pds["orders"], pds["lineitem"])
+    return [tuple(r) for r in g.itertuples(index=False)]
+
+
+def pandas_q4(pds):
+    lo, hi = D(1993, 7, 1), D(1993, 10, 1)
+    o, l = pds["orders"], pds["lineitem"]
+    late_keys = set(l.loc[l.l_commitdate < l.l_receiptdate, "l_orderkey"])
+    sub = o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)
+            & o.o_orderkey.isin(late_keys)]
+    exp = (sub.groupby("o_orderpriority").size().reset_index(name="n")
+           .sort_values("o_orderpriority"))
+    return list(zip(exp.o_orderpriority, exp.n.astype(int)))
+
+
+def pandas_q5(pds):
+    lo, hi = D(1994, 1, 1), D(1995, 1, 1)
+    c, o, l, s, n, r = (pds[k] for k in
+                        ["customer", "orders", "lineitem", "supplier",
+                         "nation", "region"])
+    m = (c.merge(o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)],
+                 left_on="c_custkey", right_on="o_custkey")
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey"))
+    m = m[m.c_nationkey == m.s_nationkey]
+    m = (m.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+         .merge(r[r.r_name == "ASIA"], left_on="n_regionkey",
+                right_on="r_regionkey"))
+    m["volume"] = _vol(m)
+    exp = (m.groupby("n_name")["volume"].sum().reset_index()
+           .sort_values("volume", ascending=False))
+    return list(zip(exp.n_name, exp.volume))
+
+
+def pandas_q6(pds):
+    from .tpch import q6_pandas
+    return [(q6_pandas(pds["lineitem"]),)]
+
+
+def pandas_q7(pds):
+    import pandas as pd
+    n1, n2 = "FRANCE", "GERMANY"
+    lo, hi = D(1995, 1, 1), D(1996, 12, 31)
+    s, l, o, c, n = (pds[k] for k in
+                     ["supplier", "lineitem", "orders", "customer",
+                      "nation"])
+    nn = n[n.n_name.isin([n1, n2])]
+    m = (s.merge(nn.rename(columns={"n_nationkey": "sn_key",
+                                    "n_name": "supp_nation"})[
+        ["sn_key", "supp_nation"]], left_on="s_nationkey",
+        right_on="sn_key")
+         .merge(l[(l.l_shipdate >= lo) & (l.l_shipdate <= hi)],
+                left_on="s_suppkey", right_on="l_suppkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(nn.rename(columns={"n_nationkey": "cn_key",
+                                   "n_name": "cust_nation"})[
+             ["cn_key", "cust_nation"]], left_on="c_nationkey",
+             right_on="cn_key"))
+    m = m[((m.supp_nation == n1) & (m.cust_nation == n2))
+          | ((m.supp_nation == n2) & (m.cust_nation == n1))]
+    m["l_year"] = pd.to_datetime(m.l_shipdate).dt.year
+    m["volume"] = _vol(m)
+    exp = (m.groupby(["supp_nation", "cust_nation", "l_year"])["volume"]
+           .sum().reset_index()
+           .sort_values(["supp_nation", "cust_nation", "l_year"]))
+    return [(r.supp_nation, r.cust_nation, int(r.l_year), r.volume)
+            for r in exp.itertuples()]
+
+
+def pandas_q8(pds):
+    import pandas as pd
+    lo, hi = D(1995, 1, 1), D(1996, 12, 31)
+    l, p, s, o, c, n, r = (pds[k] for k in
+                           ["lineitem", "part", "supplier", "orders",
+                            "customer", "nation", "region"])
+    m = (l.merge(p, left_on="l_partkey", right_on="p_partkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey"))
+    m = m[(m.o_orderdate >= lo) & (m.o_orderdate <= hi)]
+    m = (m.merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(n, left_on="c_nationkey", right_on="n_nationkey"))
+    m = m.merge(r[r.r_name == "AMERICA"], left_on="n_regionkey",
+                right_on="r_regionkey")
+    n2p = n.rename(columns={"n_nationkey": "n2_key", "n_name": "n2_name"})
+    m = m.merge(n2p[["n2_key", "n2_name"]], left_on="s_nationkey",
+                right_on="n2_key")
+    m["o_year"] = pd.to_datetime(m.o_orderdate).dt.year
+    m["volume"] = _vol(m)
+    m["bv"] = np.where(m.n2_name == "BRAZIL", m.volume, 0.0)
+    g = m.groupby("o_year").agg(bv=("bv", "sum"), tv=("volume", "sum"))
+    g["share"] = g.bv / g.tv
+    exp = g.reset_index().sort_values("o_year")
+    return list(zip(exp.o_year.astype(int), exp.share))
+
+
+def pandas_q9(pds):
+    import pandas as pd
+    pt, l, s, n, o = (pds[k] for k in
+                      ["part", "lineitem", "supplier", "nation", "orders"])
+    m = (pt[pt.p_name.str.contains("goldenrod")]
+         .merge(l, left_on="p_partkey", right_on="l_partkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey"))
+    m["o_year"] = pd.to_datetime(m.o_orderdate).dt.year
+    m["amount"] = _vol(m) - 0.01 * m.l_quantity
+    exp = (m.groupby(["n_name", "o_year"])["amount"].sum().reset_index()
+           .sort_values(["n_name", "o_year"], ascending=[True, False]))
+    return [(r.n_name, int(r.o_year), r.amount) for r in exp.itertuples()]
+
+
+def pandas_q10(pds):
+    lo, hi = D(1993, 10, 1), D(1994, 1, 1)
+    c, o, l = pds["customer"], pds["orders"], pds["lineitem"]
+    m = (c.merge(o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)],
+                 left_on="c_custkey", right_on="o_custkey")
+         .merge(l[l.l_returnflag == "R"], left_on="o_orderkey",
+                right_on="l_orderkey"))
+    m["volume"] = _vol(m)
+    exp = (m.groupby(["c_custkey", "c_name", "c_acctbal"])["volume"]
+           .sum().reset_index()
+           .sort_values(["volume", "c_custkey"],
+                        ascending=[False, True]).head(20))
+    return [(int(r.c_custkey), r.c_name, r.c_acctbal, r.volume)
+            for r in exp.itertuples()]
+
+
+def pandas_q11(pds):
+    ps, s, n = (pds[k] for k in ["partsupp", "supplier", "nation"])
+    m = (ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+         .merge(n[n.n_name == "GERMANY"], left_on="s_nationkey",
+                right_on="n_nationkey"))
+    m["value"] = m.ps_supplycost * m.ps_availqty
+    tot = m.value.sum()
+    g = m.groupby("ps_partkey")["value"].sum().reset_index()
+    exp = (g[g.value > tot * 0.0001]
+           .sort_values(["value", "ps_partkey"], ascending=[False, True]))
+    return list(zip(exp.ps_partkey.astype(int), exp.value))
+
+
+def pandas_q12(pds):
+    lo, hi = D(1994, 1, 1), D(1995, 1, 1)
+    o, l = pds["orders"], pds["lineitem"]
+    sub = l[l.l_shipmode.isin(["MAIL", "SHIP"])
+            & (l.l_commitdate < l.l_receiptdate)
+            & (l.l_shipdate < l.l_commitdate)
+            & (l.l_receiptdate >= lo) & (l.l_receiptdate < hi)]
+    m = o.merge(sub, left_on="o_orderkey", right_on="l_orderkey")
+    m["high"] = m.o_orderpriority.isin(["1-URGENT", "2-HIGH"]).astype(int)
+    m["low"] = 1 - m["high"]
+    exp = (m.groupby("l_shipmode")[["high", "low"]].sum().reset_index()
+           .sort_values("l_shipmode"))
+    return list(zip(exp.l_shipmode, exp.high.astype(int),
+                    exp.low.astype(int)))
+
+
+def pandas_q13(pds):
+    c, o = pds["customer"], pds["orders"]
+    ko = o[o.o_orderpriority != "1-URGENT"]
+    m = c.merge(ko, left_on="c_custkey", right_on="o_custkey", how="left")
+    cc = m.groupby("c_custkey")["o_orderkey"].count().reset_index(
+        name="c_count")
+    exp = (cc.groupby("c_count").size().reset_index(name="custdist")
+           .sort_values(["custdist", "c_count"], ascending=[False, False]))
+    return list(zip(exp.c_count.astype(int), exp.custdist.astype(int)))
+
+
+def pandas_q14(pds):
+    lo, hi = D(1995, 9, 1), D(1995, 10, 1)
+    l, pt = pds["lineitem"], pds["part"]
+    m = (l[(l.l_shipdate >= lo) & (l.l_shipdate < hi)]
+         .merge(pt, left_on="l_partkey", right_on="p_partkey"))
+    m["vol"] = _vol(m)
+    p = m.loc[m.p_type.str.startswith("PROMO"), "vol"].sum()
+    t = m.vol.sum()
+    return [(100.0 * p / t,)]
+
+
+def pandas_q15(pds):
+    l, s = pds["lineitem"], pds["supplier"]
+    lo, hi = D(1996, 1, 1), D(1996, 4, 1)
+    lf = l[(l.l_shipdate >= lo) & (l.l_shipdate < hi)].copy()
+    lf["rev"] = lf.l_extendedprice * (1 - lf.l_discount)
+    g = lf.groupby("l_suppkey")["rev"].sum()
+    mx = g.max()
+    winners = g[g == mx].reset_index()
+    exp = (s.merge(winners, left_on="s_suppkey", right_on="l_suppkey")
+           .sort_values("s_suppkey"))
+    return list(zip(exp.s_suppkey.astype(int), exp.s_name, exp.rev))
+
+
+def pandas_q16(pds):
+    ps, s, p = pds["partsupp"], pds["supplier"], pds["part"]
+    badk = set(s.loc[s.s_acctbal < 0, "s_suppkey"])
+    m = ps[~ps.ps_suppkey.isin(badk)].merge(
+        p[(p.p_brand != "Brand#45")
+          & p.p_size.isin([1, 4, 7, 10, 14, 23])],
+        left_on="ps_partkey", right_on="p_partkey")
+    d = m[["p_brand", "p_type", "p_size", "ps_suppkey"]].drop_duplicates()
+    exp = (d.groupby(["p_brand", "p_type", "p_size"]).size()
+           .reset_index(name="cnt")
+           .sort_values(["cnt", "p_brand", "p_type", "p_size"],
+                        ascending=[False, True, True, True]))
+    return list(zip(exp.p_brand, exp.p_type, exp.p_size.astype(int),
+                    exp.cnt.astype(int)))
+
+
+def pandas_q17(pds):
+    l, p = pds["lineitem"], pds["part"]
+    lim = (l.groupby("l_partkey")["l_quantity"].mean() * 0.2).rename("lim")
+    m = (l.merge(p[p.p_container == "JUMBO PKG"], left_on="l_partkey",
+                 right_on="p_partkey").merge(lim, on="l_partkey"))
+    m = m[m.l_quantity < m.lim]
+    return [((m.l_extendedprice.sum() / 7.0) if len(m) else None,)]
+
+
+def pandas_q18(pds):
+    o, l, c = pds["orders"], pds["lineitem"], pds["customer"]
+    qty = l.groupby("l_orderkey")["l_quantity"].sum()
+    keys = set(qty[qty > 300].index)
+    sub = o[o.o_orderkey.isin(keys)].merge(
+        c, left_on="o_custkey", right_on="c_custkey")
+    exp = sub.sort_values(["o_totalprice", "o_orderkey"],
+                          ascending=[False, True]).head(100)
+    return list(zip(exp.c_name, exp.o_orderkey.astype(int),
+                    exp.o_totalprice))
+
+
+def pandas_q19(pds):
+    l, pt = pds["lineitem"], pds["part"]
+    m = l.merge(pt, left_on="l_partkey", right_on="p_partkey")
+    keep = ((m.p_container.isin(["SM CASE", "SM BOX"])
+             & (m.l_quantity >= 1) & (m.l_quantity <= 20) & (m.p_size <= 15))
+            | (m.p_container.isin(["MED BAG", "MED BOX"])
+               & (m.l_quantity >= 10) & (m.l_quantity <= 30)
+               & (m.p_size <= 25)))
+    return [((m.loc[keep, "l_extendedprice"]
+              * (1 - m.loc[keep, "l_discount"])).sum(),)]
+
+
+def pandas_q20(pds):
+    lo, hi = D(1994, 1, 1), D(1995, 1, 1)
+    l, p, ps, s, n = (pds[k] for k in
+                      ["lineitem", "part", "partsupp", "supplier",
+                       "nation"])
+    lf = l[(l.l_shipdate >= lo) & (l.l_shipdate < hi)]
+    g = (lf.groupby(["l_partkey", "l_suppkey"])["l_quantity"].sum() * 0.5
+         ).rename("half_qty").reset_index()
+    fk = set(p.loc[p.p_name.str.startswith("part 1"), "p_partkey"])
+    m = ps[ps.ps_partkey.isin(fk)].merge(
+        g, left_on=["ps_partkey", "ps_suppkey"],
+        right_on=["l_partkey", "l_suppkey"])
+    keys = set(m.loc[m.ps_availqty > m.half_qty, "ps_suppkey"])
+    exp = (s[s.s_suppkey.isin(keys)]
+           .merge(n[n.n_name == "CANADA"], left_on="s_nationkey",
+                  right_on="n_nationkey").sort_values("s_name"))
+    return list(zip(exp.s_name, exp.s_suppkey.astype(int)))
+
+
+def pandas_q21(pds):
+    l, o, s = pds["lineitem"], pds["orders"], pds["supplier"]
+    latep = l[l.l_receiptdate > l.l_commitdate][
+        ["l_orderkey", "l_suppkey"]].drop_duplicates()
+    f_orders = set(o.loc[o.o_orderstatus == "F", "o_orderkey"])
+    n_sup = l[["l_orderkey", "l_suppkey"]].drop_duplicates() \
+        .groupby("l_orderkey").size()
+    multi_ok = set(n_sup[n_sup > 1].index)
+    n_late = latep.groupby("l_orderkey").size()
+    multi_late_ok = set(n_late[n_late > 1].index)
+    m = latep[latep.l_orderkey.isin(f_orders)
+              & latep.l_orderkey.isin(multi_ok)
+              & ~latep.l_orderkey.isin(multi_late_ok)]
+    m = m.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    exp = (m.groupby("s_name").size().reset_index(name="numwait")
+           .sort_values(["numwait", "s_name"],
+                        ascending=[False, True]).head(100))
+    return list(zip(exp.s_name, exp.numwait.astype(int)))
+
+
+def pandas_q22(pds):
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    c, o = pds["customer"], pds["orders"]
+    cc = c.copy()
+    cc["cntrycode"] = cc.c_phone.str[:2]
+    ic = cc[cc.cntrycode.isin(codes)]
+    ab = ic.loc[ic.c_acctbal > 0, "c_acctbal"].mean()
+    has_orders = set(o.o_custkey)
+    m = ic[(ic.c_acctbal > ab) & ~ic.c_custkey.isin(has_orders)]
+    exp = (m.groupby("cntrycode")
+           .agg(numcust=("c_custkey", "size"),
+                totacctbal=("c_acctbal", "sum"))
+           .reset_index().sort_values("cntrycode"))
+    return list(zip(exp.cntrycode, exp.numcust.astype(int),
+                    exp.totacctbal))
+
+
+QUERIES = {f"q{i}": (globals()[f"run_q{i}"], globals()[f"pandas_q{i}"])
+           for i in range(1, 23)}
+
+# tables each query touches (bench loads only what it needs)
+TABLES: Dict[str, List[str]] = {
+    "q1": ["lineitem"],
+    "q2": ["supplier", "nation", "region", "partsupp", "part"],
+    "q3": ["customer", "orders", "lineitem"],
+    "q4": ["orders", "lineitem"],
+    "q5": ["customer", "orders", "lineitem", "supplier", "nation",
+           "region"],
+    "q6": ["lineitem"],
+    "q7": ["supplier", "lineitem", "orders", "customer", "nation"],
+    "q8": ["lineitem", "part", "supplier", "orders", "customer", "nation",
+           "region"],
+    "q9": ["part", "lineitem", "supplier", "nation", "orders"],
+    "q10": ["customer", "orders", "lineitem"],
+    "q11": ["partsupp", "supplier", "nation"],
+    "q12": ["orders", "lineitem"],
+    "q13": ["customer", "orders"],
+    "q14": ["lineitem", "part"],
+    "q15": ["lineitem", "supplier"],
+    "q16": ["partsupp", "supplier", "part"],
+    "q17": ["lineitem", "part"],
+    "q18": ["orders", "lineitem", "customer"],
+    "q19": ["lineitem", "part"],
+    "q20": ["lineitem", "part", "partsupp", "supplier", "nation"],
+    "q21": ["lineitem", "orders", "supplier"],
+    "q22": ["customer", "orders"],
+}
+
+
+def rows_rel_err(got, want) -> float:
+    """Canonical-sorted row comparison returning the max relative error
+    over numeric cells (1.0 on any structural mismatch)."""
+    def key(r):
+        return tuple((x is None, str(type(x).__name__), x if x is not None
+                      and not isinstance(x, float) else
+                      (round(x, 6) if x is not None else 0)) for x in r)
+    if len(got) != len(want):
+        return 1.0
+    gs = sorted(got, key=key)
+    ws = sorted(want, key=key)
+    err = 0.0
+    for g, w in zip(gs, ws):
+        if len(g) != len(w):
+            return 1.0
+        for a, b in zip(g, w):
+            if a is None or b is None:
+                if not (a is None and b is None):
+                    return 1.0
+            elif isinstance(b, float):
+                err = max(err, abs(float(a) - b) / max(1.0, abs(b)))
+            elif a != b:
+                return 1.0
+    return err

@@ -1014,6 +1014,93 @@ class BroadcastJoinExec(SortMergeJoinExec):
         if m.level == "DEBUG":
             m.add("numOutputRows", out.row_count())
 
+    # -- dynamic partition pruning ------------------------------------------------
+    #
+    # GpuSubqueryBroadcastExec / GpuDynamicPruningExpression analog: the
+    # broadcast build side IS the subquery result — once it materializes,
+    # its key range (and exact key list when small) becomes a runtime
+    # predicate on the probe-side scan, reaching parquet file/row-group
+    # and hive-partition pruning before any probe row is decoded.
+
+    def _inject_dpp(self, ctx, build: ColumnBatch) -> None:
+        conf = ctx.conf
+        if not conf["spark.rapids.tpu.sql.dpp.enabled"]:
+            return
+        if self.how not in ("inner", "semi"):
+            return  # pruning probe rows would change left/right/full/anti
+        pending = getattr(self, "_dense_pending", None)
+        if pending is None or pending[0] != id(build):
+            return
+        lk, rk, common = self._bound_keys()
+        ct = common[0]
+        try:
+            kind = np.dtype(ct.numpy_dtype).kind
+        except TypeError:
+            return
+        if kind not in "iu":  # ints and dates (int32 days) only
+            return
+        probe_side = 1 - self.build_side
+        pk = (lk if self.build_side == 1 else rk)[0]
+        from .planner import strip_alias
+        from ..exprs import BoundReference
+        core = strip_alias(pk)
+        if not isinstance(core, BoundReference):
+            return
+        pname = self.children[probe_side].output_schema.names()[core.ordinal]
+        target = _scan_origin(self.children[probe_side], pname)
+        if target is None:
+            return
+        scan, scol = target
+        kmin, kmax, n_valid, dup = [int(x) for x in np.asarray(pending[2])]
+        is_date = ct.kind == T.TypeKind.DATE
+
+        def conv(v):
+            if is_date:
+                import datetime as _dt
+                return _dt.date(1970, 1, 1) + _dt.timedelta(days=int(v))
+            return int(v)
+
+        if n_valid == 0:
+            scan.runtime_predicates = [(scol, "in", [])]
+            return
+        preds = [(scol, ">=", conv(kmin)), (scol, "<=", conv(kmax))]
+        max_in = conf["spark.rapids.tpu.sql.dpp.maxInKeys"]
+        n_distinct = n_valid - dup
+        if 0 < n_distinct <= max_in:
+            vals = self._dpp_distinct_values(build, pending[3], max_in)
+            if vals is not None:
+                preds = [(scol, "in", [conv(v) for v in vals])]
+        scan.runtime_predicates = preds
+
+    def _dpp_distinct_values(self, build, b_arrays, max_in):
+        lk, rk, common = self._bound_keys()
+        bk = (rk if self.build_side == 1 else lk)
+        ct = common[0]
+        ik = _int_key_caster(ct)
+        cap = bucket_capacity(max_in)
+        fp = self._fingerprint() + f"|dppvals|bs{self.build_side}|{cap}"
+
+        def build_fn():
+            @jax.jit
+            def f(b_arrays, n_build):
+                b_cap = next(a[0].shape[0] for a in b_arrays
+                             if a is not None)
+                d, ok = _eval_int_key(bk[0], b_arrays, b_cap, n_build, ct,
+                                      ik)
+                big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
+                s = jnp.sort(jnp.where(ok, d.astype(jnp.int64), big))
+                uniq = jnp.concatenate(
+                    [jnp.ones((1,), bool), s[1:] != s[:-1]])
+                u = jnp.sort(jnp.where(uniq, s, big))
+                return u[:cap] if u.shape[0] >= cap else u
+            return f
+
+        fn = _cached_program(fp, build_fn)
+        vals = np.asarray(fn(b_arrays, np.int32(build.num_rows)))
+        big = np.iinfo(np.int64).max
+        vals = vals[vals != big]
+        return vals.tolist() if len(vals) <= max_in else None
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         m = ctx.metric_set(self.op_id)
         probe_side = 1 - self.build_side
@@ -1024,6 +1111,7 @@ class BroadcastJoinExec(SortMergeJoinExec):
             build = bh.get()
             if dense_ok:
                 self._dense_prefetch(build, ctx.conf)
+                self._inject_dpp(ctx, build)
             for probe in pgen:
                 if probe.num_rows == 0:
                     continue
@@ -1086,6 +1174,49 @@ def _float_orderable(d, ik):
         b = jnp.where(jnp.isnan(d), mx, b)
     mn = np.array(np.iinfo(ik).min, dtype=ik)
     return jnp.where(b < 0, ~b, b | mn)
+
+
+def _scan_origin(node, out_name: str):
+    """Trace an output column through Coalesce/Stage chains to the scan
+    column it passes through from, or None when any step computes it.
+    Returns (ScanExec, scan_column_name)."""
+    from .coalesce import CoalesceBatchesExec
+    from .physical import ScanExec, StageExec
+    from .planner import strip_alias
+    from ..exprs import BoundReference
+    name = out_name
+    while True:
+        if isinstance(node, CoalesceBatchesExec):
+            node = node.children[0]
+            continue
+        if isinstance(node, StageExec):
+            cur = list(node.children[0].output_schema.names())
+            maps = []  # forward per-project mapping out -> in
+            for kind, payload in node.steps:
+                if kind != "project":
+                    continue
+                mp = {}
+                new_names = []
+                for entry in payload:
+                    pname, expr = entry[0], entry[1]
+                    new_names.append(pname)
+                    if expr is None:
+                        continue  # host passthrough (strings) — not keys
+                    core = strip_alias(expr)
+                    if isinstance(core, BoundReference) \
+                            and core.ordinal < len(cur):
+                        mp[pname] = cur[core.ordinal]
+                maps.append(mp)
+                cur = new_names
+            for mp in reversed(maps):
+                name = mp.get(name)
+                if name is None:
+                    return None
+            node = node.children[0]
+            continue
+        if isinstance(node, ScanExec):
+            return (node, name) if name in node.output_schema else None
+        return None
 
 
 def _int_key_caster(ct) -> Optional[np.dtype]:

@@ -108,6 +108,10 @@ class ScanExec(TpuExec):
         self._schema = schema
         self._source_factory = source_factory
         self.desc = desc
+        # runtime predicates injected by dynamic partition pruning
+        # (plan/join_exec._inject_dpp): applied through with_pushdown at
+        # execute time so file/row-group pruning sees them
+        self.runtime_predicates = None
 
     @property
     def output_schema(self) -> Schema:
@@ -116,10 +120,17 @@ class ScanExec(TpuExec):
     def node_desc(self):
         return f"TpuScan [{self.desc}] {self._schema.names()}"
 
+    def _effective_source(self):
+        src = self._source_factory
+        if self.runtime_predicates and hasattr(src, "with_pushdown"):
+            src = src.with_pushdown(None, self.runtime_predicates)
+        return src
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         from ..batch import ColumnBatch as _CB, from_arrow
         m = ctx.metric_set(self.op_id)
         min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+        source = self._effective_source()
 
         # device-tier file cache: repeated identical scans skip decode AND
         # upload (fileCache.deviceTier; keep-batches-resident idea from
@@ -128,7 +139,7 @@ class ScanExec(TpuExec):
         dkey = None
         if (ctx.conf["spark.rapids.tpu.sql.fileCache.enabled"]
                 and ctx.conf["spark.rapids.tpu.sql.fileCache.deviceTier"]):
-            token_fn = getattr(self._source_factory, "cache_token", None)
+            token_fn = getattr(source, "cache_token", None)
             token = token_fn() if token_fn is not None else None
             if token is not None:
                 from ..io.filecache import get_device_cache
@@ -149,7 +160,7 @@ class ScanExec(TpuExec):
         # an over-budget scan must keep streaming/spilling, not OOM
         acc = [] if dcache is not None else None
         acc_bytes = 0
-        for table in self._source_factory():
+        for table in source():
             with m.time("scanTime"):
                 b = from_arrow(table, min_capacity=min_cap, device=ctx.device)
             m.add("numOutputRows", b.num_rows)
@@ -856,9 +867,19 @@ class AggregateExec(TpuExec):
                 return tuple(keys), tuple(contribs), active
             return f
 
+        # Re-partition fallback (GpuMergeAggregateIterator,
+        # aggregate.scala:711): when the merged pending output outgrows
+        # batchSizeRows, a partial agg simply EMITS it (the exchange +
+        # final agg combine duplicates), while a final/complete agg
+        # hash-splits every merged/merging batch into disjoint key
+        # buckets and finalizes per bucket — bounded peak batch size
+        # with correctness preserved (a key lives in exactly one bucket).
+        limit = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
+        buckets = None
+        bucket_over = None  # single OR-accumulated device overflow flag
         pending: Optional[ColumnBatch] = None
         for batch in child.execute(ctx):
-            out_now = None
+            out_now: List[ColumnBatch] = []
             with m.time("opTime"):
                 batch = self._encode_string_keys(batch, ctx)
                 if decide and first:
@@ -875,21 +896,63 @@ class AggregateExec(TpuExec):
                         else None for c in batch.columns)
                     ks, cs, active = pt(arrays, batch.sel,
                                         np.int32(batch.num_rows))
-                    out_now = self._to_buffer_batch(
-                        buffer_schema, list(ks), list(cs), active)
+                    out_now.append(self._to_buffer_batch(
+                        buffer_schema, list(ks), list(cs), active))
                 else:
                     for part in with_retry(ctx, batch, run_one):
                         gb = _grid_bound()
+                        if buckets is not None:
+                            pieces = self._split_by_key_hash(
+                                part, n_keys, len(buckets))
+                            for bi, piece in enumerate(pieces):
+                                buckets[bi], flag = self._merge_bucket(
+                                    buckets[bi], piece, ops, n_keys, limit)
+                                bucket_over = flag if bucket_over is None \
+                                    else (bucket_over | flag)
+                            continue
                         if pending is None:
                             pending = batch_utils.compact_packed(part,
                                                                  bound=gb)
                         else:
                             pending = self._merge_partials(
                                 pending, part, ops, n_keys, bound=gb)
-            if out_now is not None:
-                m.add("numOutputRows", out_now.num_rows)
-                yield out_now
+                        if gb is None and pending.num_rows > limit:
+                            if self.mode == "partial":
+                                out_now.append(pending)
+                                pending = None
+                            else:
+                                nb = ctx.conf[
+                                    "spark.rapids.tpu.sql.agg"
+                                    ".repartitionBuckets"]
+                                buckets = self._split_by_key_hash(
+                                    pending, n_keys, nb)
+                                m.add("aggRepartitions", 1)
+                                pending = None
+            for ob in out_now:
+                m.add("numOutputRows", ob.num_rows)
+                yield ob
         if pass_through:
+            return
+        if buckets is not None:
+            if bucket_over is not None and bool(bucket_over):
+                raise RuntimeError(
+                    "aggregate re-partition bucket overflowed "
+                    "spark.rapids.tpu.sql.batchSizeRows: raise the "
+                    "conf (extreme key skew across hash buckets)")
+            any_rows = False
+            for bp in buckets:
+                # full compact: a bucket that never merged is a pid-masked
+                # view whose live rows are NOT front-packed
+                bp = batch_utils.compact(bp)
+                if bp.num_rows == 0:
+                    continue
+                any_rows = True
+                out = self._finalize_grouped(bp) \
+                    if self.mode != "partial" else bp
+                m.add("numOutputRows", out.num_rows)
+                yield out
+            if not any_rows:
+                yield ColumnBatch(self._schema, self._empty_cols(), 0)
             return
         if pending is None:
             yield ColumnBatch(self._schema, self._empty_cols(), 0)
@@ -1046,6 +1109,48 @@ class AggregateExec(TpuExec):
                                          v))
         cap = cols[0].capacity
         return ColumnBatch(schema, cols, cap, gmask)
+
+    def _split_by_key_hash(self, batch: ColumnBatch, n_keys: int,
+                           n_buckets: int):
+        """Partition a buffer batch into disjoint key-hash buckets as
+        sel-masked views (zero copies; the merges compact)."""
+        fp = f"agg-bucket-pid|{n_keys}|{n_buckets}|" + self._fingerprint()
+
+        def build():
+            @jax.jit
+            def f(arrays, sel, num_rows):
+                from ..ops.hashing import xxhash64_columns
+                cap = next(a[0].shape[0] for a in arrays
+                           if a is not None)
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                h = xxhash64_columns(list(arrays[:n_keys]))
+                return (h % jnp.uint64(n_buckets)).astype(jnp.int32), active
+            return f
+
+        fn = _cached_program(fp, build)
+        arrays = tuple((c.data, c.valid) for c in batch.columns)
+        pid, active = fn(arrays, batch.sel, np.int32(batch.num_rows))
+        return [ColumnBatch(batch.schema, batch.columns, batch.num_rows,
+                            active & (pid == b)) for b in range(n_buckets)]
+
+    def _merge_bucket(self, a: ColumnBatch, piece: ColumnBatch, ops,
+                      n_keys, limit: int):
+        """Merge one hash bucket's pending with a piece; stays bounded at
+        ``limit`` live rows (sync-free slice) and returns a device
+        overflow flag, all flags checked ONCE at stream end."""
+        both = batch_utils.concat_batches([a, piece])
+        arrays = tuple((c.data, c.valid) for c in both.columns)
+        merge = _merge_fn(tuple(ops), n_keys)
+        ok, ov, gmask = merge(arrays, both.sel, np.int32(both.num_rows))
+        merged = self._to_buffer_batch(both.schema, list(ok), list(ov),
+                                       gmask)
+        from ..batch import bucket_capacity
+        cap = bucket_capacity(min(limit, merged.capacity))
+        over = jnp.any(gmask[cap:]) if cap < merged.capacity \
+            else jnp.zeros((), dtype=bool)
+        return batch_utils.compact_packed(merged, bound=limit), over
 
     def _merge_partials(self, a: ColumnBatch, b: ColumnBatch, ops, n_keys,
                         bound=None):

@@ -1,0 +1,253 @@
+"""Subquery resolution: scalar subqueries, IN/NOT-IN subqueries.
+
+Reference: GpuScalarSubquery / GpuSubqueryBroadcastExec
+(sql-plugin .../execution/GpuSubqueryBroadcastExec.scala) and
+GpuInSubqueryExec (spark330 shim).  Spark's optimizer rewrites
+correlated/EXISTS subqueries into joins before the plugin sees them; this
+engine does the equivalent rewrites itself, at collect() time:
+
+  * ScalarSubquery(plan)  -> execute the subplan (recursively resolving
+    its own subqueries), assert a 1x1 result, substitute a Literal.
+  * In(col, InSubqueryValues(plan)) in a Filter -> left-semi join.
+  * Not(In(col, ...)) in a Filter -> null-aware anti join: SQL NOT IN
+    returns no rows when the subquery produces any NULL, and rows with a
+    NULL probe key never qualify — both checked here, the first by
+    executing the (already materialized) subquery result.
+
+Resolution happens on the LOGICAL plan so every downstream pass
+(filter pushdown, scan pruning, physical planning) sees plain filters
+and joins.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from .. import exprs as E
+from . import logical as L
+
+__all__ = ["ScalarSubquery", "InSubqueryValues", "resolve_subqueries"]
+
+
+class ScalarSubquery(E.Expression):
+    """Placeholder for a 1x1 subquery result; replaced by a Literal
+    before planning (never evaluated directly)."""
+
+    def __init__(self, plan: L.LogicalPlan):
+        self.plan = plan
+        self.children = ()
+        f = plan.schema().fields
+        if len(f) != 1:
+            raise ValueError(
+                f"scalar subquery must produce exactly one column, "
+                f"got {len(f)}")
+        self.dtype = f[0].dtype
+        self.nullable = True
+
+    def references(self):
+        return set()
+
+    def _fp_extra(self):
+        return f"scalar@{id(self.plan)}"
+
+
+class InSubqueryValues(E.Expression):
+    """Marker carried as ``In.values`` for ``col IN (subquery)``; the
+    containing Filter is rewritten to a semi/anti join."""
+
+    def __init__(self, plan: L.LogicalPlan):
+        self.plan = plan
+        self.children = ()
+        f = plan.schema().fields
+        if len(f) != 1:
+            raise ValueError(
+                f"IN subquery must produce exactly one column, "
+                f"got {len(f)}")
+        self.dtype = f[0].dtype
+
+
+def resolve_subqueries(plan: L.LogicalPlan,
+                       collect: Callable[[L.LogicalPlan], list]
+                       ) -> L.LogicalPlan:
+    """Rewrite every subquery in ``plan``; ``collect(subplan) -> rows``
+    executes a subplan through the full engine (the session provides it)."""
+    out = _walk(plan, collect)
+    _check_no_markers(out)
+    return out
+
+
+def _check_no_markers(node: L.LogicalPlan) -> None:
+    """IN-subqueries survive only as top-level filter conjuncts; anywhere
+    else (OR branches, projections, join conditions) raise a clear error
+    instead of a TypeError deep inside In.eval."""
+    def scan(e):
+        if isinstance(e, E.In) and isinstance(getattr(e, "values", None),
+                                              InSubqueryValues):
+            raise NotImplementedError(
+                "IN (subquery) is only supported as a top-level filter "
+                "conjunct (optionally negated); rewrite OR/projection "
+                "uses with explicit joins")
+        for c in e.children:
+            scan(c)
+
+    if isinstance(node, L.Filter):
+        scan(node.condition)
+    elif isinstance(node, L.Project):
+        for _n, e in node.exprs:
+            scan(e)
+    elif isinstance(node, L.Aggregate):
+        for _n, e in list(node.group_exprs) + list(node.agg_exprs):
+            scan(e)
+    elif isinstance(node, L.Join) and node.condition is not None:
+        scan(node.condition)
+    for c in node.children:
+        _check_no_markers(c)
+
+
+def _walk(node: L.LogicalPlan, collect) -> L.LogicalPlan:
+    if isinstance(node, L.Cache):
+        return node
+    if isinstance(node, L.Filter):
+        cond = node.condition
+        if _has_in_subquery(cond):
+            return _rewrite_in_filter(node, collect)
+    new_children = tuple(_walk(c, collect) for c in node.children)
+    node = _with_children(node, new_children)
+    return _map_exprs(node, lambda e: _resolve_scalar(e, collect))
+
+
+def _with_children(node, new_children):
+    if all(n is o for n, o in zip(new_children, node.children)):
+        return node
+    import copy
+    out = copy.copy(node)
+    out.children = new_children
+    return out
+
+
+def _resolve_scalar(e: E.Expression, collect) -> E.Expression:
+    if isinstance(e, ScalarSubquery):
+        sub = resolve_subqueries(e.plan, collect)
+        rows = collect(sub)
+        if len(rows) > 1:
+            raise ValueError(
+                f"scalar subquery returned {len(rows)} rows (expected <=1)")
+        val = rows[0][0] if rows else None
+        return E.Literal(val, e.dtype)
+    if not e.children:
+        return e
+    kids = [_resolve_scalar(c, collect) for c in e.children]
+    if all(k is c for k, c in zip(kids, e.children)):
+        return e
+    import copy
+    out = copy.copy(e)
+    out.children = tuple(kids)
+    return out
+
+
+def _map_exprs(node: L.LogicalPlan, fn) -> L.LogicalPlan:
+    """Apply ``fn`` over the expression slots of a logical node."""
+    import copy
+    out = None
+
+    def _m(e):
+        nonlocal out
+        r = fn(e)
+        if r is not e and out is None:
+            out = copy.copy(node)
+        return r
+
+    if isinstance(node, L.Filter):
+        cond = _m(node.condition)
+        if out is not None:
+            out.condition = cond
+    elif isinstance(node, L.Project):
+        exprs = [(n, _m(e)) for n, e in node.exprs]
+        if out is not None:
+            out.exprs = exprs
+    elif isinstance(node, L.Aggregate):
+        g = [(n, _m(e)) for n, e in node.group_exprs]
+        a = [(n, _m(e)) for n, e in node.agg_exprs]
+        if out is not None:
+            out.group_exprs, out.agg_exprs = g, a
+    elif isinstance(node, L.Join) and node.condition is not None:
+        cond = _m(node.condition)
+        if out is not None:
+            out.condition = cond
+    return out if out is not None else node
+
+
+def _has_in_subquery(e: E.Expression) -> bool:
+    if isinstance(e, E.In) and isinstance(getattr(e, "values", None),
+                                          InSubqueryValues):
+        return True
+    if isinstance(e, E.Not) and _has_in_subquery(e.children[0]):
+        return True
+    if isinstance(e, E.And):
+        return any(_has_in_subquery(c) for c in e.children)
+    return False
+
+
+def _conjuncts(e):
+    if isinstance(e, E.And):
+        return _conjuncts(e.children[0]) + _conjuncts(e.children[1])
+    return [e]
+
+
+def _and_all(conjs):
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = E.And(out, c)
+    return out
+
+
+def _rewrite_in_filter(node: L.Filter, collect) -> L.LogicalPlan:
+    """Filter with IN-subquery conjuncts -> semi/anti joins above the
+    (recursively resolved) child, remaining conjuncts stay a Filter."""
+    child = _walk(node.children[0], collect)
+    plain: List[E.Expression] = []
+    out = child
+    for ci, c in enumerate(_conjuncts(node.condition)):
+        neg = False
+        core = c
+        if isinstance(core, E.Not) and _has_in_subquery(core.children[0]):
+            neg, core = True, core.children[0]
+        if isinstance(core, E.In) and isinstance(
+                getattr(core, "values", None), InSubqueryValues):
+            sub = resolve_subqueries(core.values.plan, collect)
+            key = core.children[0]
+            sub_name = sub.schema().fields[0].name
+            # deterministic alias (stable program fingerprints across
+            # runs) that cannot collide with outer-plan columns
+            alias = f"__in_sq{ci}_{sub_name}"
+            sub_proj = L.Project(
+                sub, [(alias, E.UnresolvedColumn(sub_name))])
+            if neg:
+                # SQL NOT IN null semantics, evaluated over ONE
+                # materialization of the subquery: empty set -> every row
+                # (even NULL keys) qualifies; any NULL in the set -> no
+                # row qualifies; else NULL keys drop and the rest
+                # anti-join (small sets inline as a literal NOT IN)
+                rows = collect(L.Distinct(sub_proj))
+                vals = [r[0] for r in rows]
+                if not vals:
+                    continue  # NOT IN (empty) is TRUE for every row
+                if any(v is None for v in vals):
+                    out = L.Filter(out, E.Literal(False))
+                    continue
+                out = L.Filter(out, E.IsNotNull(key))
+                if len(vals) <= 1024:
+                    out = L.Filter(out, E.Not(E.In(key, vals)))
+                    continue
+                j = L.Join(out, sub_proj, [key], [
+                    E.UnresolvedColumn(alias)], how="anti")
+            else:
+                j = L.Join(out, sub_proj, [key],
+                           [E.UnresolvedColumn(alias)], how="semi")
+            out = j
+        else:
+            plain.append(c)
+    if plain:
+        out = L.Filter(out, _and_all(plain))
+    return _map_exprs(out, lambda e: _resolve_scalar(e, collect)) \
+        if isinstance(out, L.Filter) else out

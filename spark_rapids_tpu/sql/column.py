@@ -139,6 +139,19 @@ class Column:
             values[0], (list, tuple, set)) else values
         return Column(E.In(self.expr, list(vals)))
 
+    def isin_subquery(self, df) -> "Column":
+        """``col IN (single-column subquery)`` — rewritten to a left-semi
+        join at collect() time (``~`` negation gives SQL NOT IN with its
+        null semantics).  GpuInSubqueryExec analog (plan/subquery.py)."""
+        from .. import types as T
+        from ..plan.subquery import InSubqueryValues
+        e = E.In.__new__(E.In)
+        e.children = (self.expr,)
+        e.values = InSubqueryValues(df._plan)
+        e.dtype = T.BOOLEAN
+        e.nullable = True
+        return Column(e)
+
     def cast(self, dtype) -> "Column":
         from ..types import DataType
         from . import functions as F

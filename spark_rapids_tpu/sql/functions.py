@@ -48,6 +48,14 @@ def col(name: str) -> Column:
     return Column(E.UnresolvedColumn(name))
 
 
+def scalar_subquery(df) -> Column:
+    """A 1x1 subquery as an expression: executed at collect() time
+    (recursively) and substituted as a literal — GpuScalarSubquery
+    analog (plan/subquery.py)."""
+    from ..plan.subquery import ScalarSubquery
+    return Column(ScalarSubquery(df._plan))
+
+
 def broadcast(df):
     """Hint that ``df`` should be broadcast in joins (pyspark
     functions.broadcast analog; GpuBroadcastHashJoinExecBase selection)."""

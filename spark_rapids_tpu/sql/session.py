@@ -255,7 +255,21 @@ class Session:
         from ..parallel.spmd import distribute_plan
         return distribute_plan(phys, ctx, self.ici_mesh())
 
+    def _collect_rows(self, plan: L.LogicalPlan):
+        """Execute a (sub)plan to host rows — the subquery resolver's
+        executor (plans passed here are already subquery-free)."""
+        t = self._execute_resolved(plan)
+        if t is None:
+            return []
+        cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
+        return [tuple(c[i] for c in cols) for i in range(t.num_rows)]
+
     def _execute(self, plan: L.LogicalPlan):
+        from ..plan.subquery import resolve_subqueries
+        plan = resolve_subqueries(plan, self._collect_rows)
+        return self._execute_resolved(plan)
+
+    def _execute_resolved(self, plan: L.LogicalPlan):
         from ..runtime.semaphore import get_semaphore
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)

@@ -266,3 +266,64 @@ class TestCompoundAggExpressions:
             df.group_by("k").agg(
                 f.sum(f.col("v")).alias("s"),
                 (f.col("v") + f.sum(f.col("v"))).alias("bad"))
+
+
+class TestAggRepartitionFallback:
+    """aggregate.scala:711 GpuMergeAggregateIterator analog: merged
+    output that outgrows batchSizeRows re-partitions by key hash into
+    bounded buckets (final/complete) or emits early (partial)."""
+
+    def _data(self, rng, n=20_000, groups=5_000):
+        import pyarrow as pa
+        return pa.table({
+            "k": pa.array(rng.integers(0, groups, n).astype(np.int64)),
+            "k2": pa.array((rng.integers(0, groups, n) * 7).astype(
+                np.int64)),
+            "v": pa.array(rng.uniform(0, 10, n)),
+        })
+
+    def test_complete_mode_bucketed(self, fresh_session, rng):
+        from spark_rapids_tpu.sql import functions as F
+        sess = fresh_session
+        t = self._data(rng)
+        pdf = t.to_pandas()
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 2048)
+        df = (sess.create_dataframe(t).group_by("k")
+              .agg(F.sum(F.col("v")).alias("s"),
+                   F.count_star().alias("c")))
+        got = dict((r[0], (r[1], r[2])) for r in df.collect())
+        want = pdf.groupby("k").agg(s=("v", "sum"), c=("v", "size"))
+        assert len(got) == len(want)
+        for k, row in want.iterrows():
+            s, c = got[int(k)]
+            assert abs(s - row.s) < 1e-9 * max(1.0, abs(row.s))
+            assert c == row.c
+
+    def test_two_phase_partial_emits_early(self, fresh_session, rng):
+        from spark_rapids_tpu.sql import functions as F
+        sess = fresh_session
+        t = self._data(rng)
+        pdf = t.to_pandas()
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 2048)
+        sess.conf.set(
+            "spark.rapids.tpu.sql.agg.singleProcessComplete", False)
+        sess.conf.set("spark.rapids.tpu.sql.agg.skipPartialAggRatio", 1.0)
+        df = (sess.create_dataframe(t).group_by("k")
+              .agg(F.min(F.col("v")).alias("mn")))
+        got = dict(df.collect())
+        want = pdf.groupby("k")["v"].min()
+        assert len(got) == len(want)
+        for k, v in want.items():
+            assert abs(got[int(k)] - v) < 1e-12
+
+    def test_multi_key_bucketed(self, fresh_session, rng):
+        from spark_rapids_tpu.sql import functions as F
+        sess = fresh_session
+        t = self._data(rng)
+        pdf = t.to_pandas()
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 1024)
+        df = (sess.create_dataframe(t).group_by("k", "k2")
+              .agg(F.sum(F.col("v")).alias("s")))
+        got = df.collect()
+        want = pdf.groupby(["k", "k2"])["v"].sum()
+        assert len(got) == len(want)

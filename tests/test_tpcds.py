@@ -1,0 +1,26 @@
+"""Differential acceptance for the TPC-DS starter queries
+(models/tpcds.py): engine vs pandas oracle through the parquet scan path
+at a tiny scale factor — same registry bench.py times at SF1."""
+
+import pytest
+
+from spark_rapids_tpu.models import tpcds
+from spark_rapids_tpu.models.tpch_suite import rows_rel_err
+
+
+@pytest.fixture(scope="module")
+def db(session, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("tpcds_tiny"))
+    dfs = tpcds.load_db(session, 0.01, out)
+    pds = tpcds.load_pdb(0.01, out)
+    return dfs, pds
+
+
+@pytest.mark.parametrize("name", sorted(tpcds.QUERIES))
+def test_tpcds_query_differential(db, name):
+    dfs, pds = db
+    runner, oracle = tpcds.QUERIES[name]
+    got = runner(dfs)
+    want = oracle(pds)
+    err = rows_rel_err(got, want)
+    assert err < 1e-6, f"{name}: rel_err={err} ({len(got)} rows)"

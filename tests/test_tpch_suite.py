@@ -1,0 +1,26 @@
+"""Differential acceptance for the full TPC-H suite module
+(models/tpch_suite.py): all 22 queries, engine vs pandas oracle, through
+the real parquet scan path at a tiny scale factor.  This is the same
+(runner, oracle) registry bench.py times at SF1."""
+
+import pytest
+
+from spark_rapids_tpu.models import tpch_suite
+
+
+@pytest.fixture(scope="module")
+def db(session, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("tpch_sf_tiny"))
+    dfs = tpch_suite.load_db(session, 0.002, out)
+    pds = tpch_suite.load_pdb(0.002, out)
+    return dfs, pds
+
+
+@pytest.mark.parametrize("name", [f"q{i}" for i in range(1, 23)])
+def test_suite_query_differential(db, name):
+    dfs, pds = db
+    runner, oracle = tpch_suite.QUERIES[name]
+    got = runner(dfs)
+    want = oracle(pds)
+    err = tpch_suite.rows_rel_err(got, want)
+    assert err < 1e-6, f"{name}: rel_err={err} ({len(got)} rows)"
