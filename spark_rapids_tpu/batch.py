@@ -286,6 +286,9 @@ def _arrow_to_logical(pa_type) -> DataType:
         return T.struct([(pa_type.field(i).name,
                           _arrow_to_logical(pa_type.field(i).type))
                          for i in range(pa_type.num_fields)])
+    if pa.types.is_map(pa_type):
+        return T.map_of(_arrow_to_logical(pa_type.key_type),
+                        _arrow_to_logical(pa_type.item_type))
     raise TypeError(f"unsupported arrow type {pa_type}")
 
 
@@ -304,6 +307,9 @@ def logical_to_arrow(dt: DataType):
     if dt.kind == T.TypeKind.STRUCT:
         return pa.struct([pa.field(n, logical_to_arrow(t))
                           for n, t in dt.fields])
+    if dt.kind == T.TypeKind.MAP:
+        return pa.map_(logical_to_arrow(dt.fields[0][1]),
+                       logical_to_arrow(dt.fields[1][1]))
     return m[dt]
 
 

@@ -205,13 +205,21 @@ class GetArrayItem(CollectionExpression):
 
 
 class ElementAt(CollectionExpression):
-    """element_at(arr, i) — 1-based; negative counts from the end."""
+    """element_at(arr, i) — 1-based; negative counts from the end.
+    element_at(map, key) — NULL when the key is absent."""
 
     def _rebind(self):
-        self.dtype = self.children[0].dtype.element
+        ct = self.children[0].dtype
+        self._is_map = ct.kind == T.TypeKind.MAP
+        self.dtype = ct.fields[1][1] if self._is_map else ct.element
         self.nullable = True
 
     def _apply(self, arr, idx):
+        if self._is_map:
+            for k, v in _map_items(arr):
+                if k == idx:
+                    return v
+            return None
         i = int(idx)
         if i == 0 or abs(i) > len(arr):
             return None
@@ -586,3 +594,703 @@ class ToJson(CollectionExpression):
 
     def _apply(self, v):
         return json.dumps(v, separators=(",", ":"), default=str)
+
+
+# ---------------------------------------------------------------------------------
+# Higher-order functions (higherOrderFunctions.scala:291 GpuArrayTransform,
+# GpuArrayFilter/Exists/ForAll/Aggregate/ZipWith).  Lambdas arrive as
+# expression trees over reserved-named variables; evaluation flattens every
+# array element in the batch into ONE dense column set and runs the body
+# once through the vectorized CPU evaluator (cpu/eval.py) — per-batch
+# vectorization instead of per-element Python.
+# ---------------------------------------------------------------------------------
+
+HOF_X = "__hof_x"
+HOF_Y = "__hof_y"
+HOF_I = "__hof_i"
+HOF_ACC = "__hof_acc"
+_HOF_VARS = (HOF_X, HOF_Y, HOF_I, HOF_ACC)
+
+
+def _from_physical(val, dt: T.DataType):
+    """CPU-eval value space → logical python value (inverse of
+    _physical)."""
+    import datetime
+    import decimal
+    if val is None:
+        return None
+    if dt.is_decimal:
+        return decimal.Decimal(int(val)).scaleb(-dt.scale)
+    if dt.kind == T.TypeKind.DATE:
+        return datetime.date(1970, 1, 1) + datetime.timedelta(
+            days=int(val))
+    if dt.kind == T.TypeKind.TIMESTAMP:
+        return (datetime.datetime(1970, 1, 1)
+                + datetime.timedelta(microseconds=int(val)))
+    return _py(val)
+
+
+def _elems_to_column(elems: list, dt: T.DataType):
+    """Element list → (data, valid) in the CPU evaluator's value space."""
+    n = len(elems)
+    valid = np.array([e is not None for e in elems], dtype=bool)
+    if dt.is_string or dt.is_nested:
+        return np.array(
+            [e if ok else None for e, ok in zip(elems, valid)],
+            dtype=object), (None if valid.all() else valid)
+    phys = [(_physical(e, dt) if ok else 0)
+            for e, ok in zip(elems, valid)]
+    data = np.asarray(phys, dtype=dt.numpy_dtype)
+    return data, (None if valid.all() else valid)
+
+
+class HigherOrderExpression(CollectionExpression):
+    """Base for lambda-bearing array expressions.
+
+    ``children`` = (array input[, extra inputs...], *outer column refs the
+    lambda body captures); the body itself is NOT a child — its reserved
+    variables would confuse the binder — and is bound lazily against a
+    synthetic schema in ``_rebind``."""
+
+    extra_inputs = 0  # non-lambda expression inputs after the array
+
+    def __init__(self, *inputs, body: Expression,
+                 finish: Optional[Expression] = None):
+        self.body = body
+        self.finish = finish
+        refs = set(body.references())
+        if finish is not None:
+            refs |= finish.references()
+        self._outer_names = sorted(r for r in refs
+                                   if r not in _HOF_VARS)
+        from .exprs import UnresolvedColumn
+        super().__init__(*inputs,
+                         *[UnresolvedColumn(r) for r in self._outer_names])
+
+    def _fp_extra(self):
+        fp = f"{self.dtype}|{self.body.fingerprint()}"
+        if self.finish is not None:
+            fp += f"|{self.finish.fingerprint()}"
+        return fp
+
+    # -- lambda plumbing ----------------------------------------------------------
+    def _lambda_schema_fields(self):
+        """[(reserved var name, dtype)] the body may reference."""
+        raise NotImplementedError
+
+    def _bind_body(self, body, lambda_fields=None):
+        from .batch import Field, Schema
+        fields = [Field(n, dt, True)
+                  for n, dt in (lambda_fields
+                                if lambda_fields is not None
+                                else self._lambda_schema_fields())]
+        n_inputs = 1 + self.extra_inputs
+        for name, c in zip(self._outer_names, self.children[n_inputs:]):
+            fields.append(Field(name, c.dtype, c.nullable))
+        from .exprs import bind
+        return bind(body, Schema(fields)), [f.name for f in fields]
+
+    def _outer_columns(self, ev):
+        n_inputs = 1 + self.extra_inputs
+        return [ev(c) for c in self.children[n_inputs:]]
+
+    def _flatten(self, d, valid, n):
+        lens = np.array([len(d[i]) if valid[i] else 0 for i in range(n)],
+                        dtype=np.int64)
+        offs = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lens, out=offs[1:])
+        elems = []
+        for i in range(n):
+            if valid[i]:
+                elems.extend(d[i])
+        return offs, elems
+
+    def _eval_flat(self, body_bound, names, columns, n_flat):
+        from .cpu.eval import eval_cpu
+        arrays = [columns[nm] for nm in names]
+        return eval_cpu(body_bound, arrays, max(n_flat, 1))
+
+
+class ArrayTransform(HigherOrderExpression):
+    """transform(arr, x -> f(x))  /  transform(arr, (x, i) -> f(x, i))."""
+
+    def _rebind(self):
+        elem = self.children[0].dtype.element
+        self._elem = elem
+        self._bound, self._names = self._bind_body(self.body)
+        self.dtype = T.array(self._bound.dtype)
+        self.nullable = self.children[0].nullable
+
+    def _lambda_schema_fields(self):
+        return [(HOF_X, self.children[0].dtype.element), (HOF_I, T.INT32)]
+
+    def eval_host(self, ev, n) -> Value:
+        ad, av = ev(self.children[0])
+        valid = _valid_of(ad, av, n)
+        offs, elems = self._flatten(ad, valid, n)
+        nf = len(elems)
+        cols = {HOF_X: _elems_to_column(elems, self._elem),
+                HOF_I: (np.concatenate(
+                    [np.arange(offs[i + 1] - offs[i], dtype=np.int32)
+                     for i in range(n)] or
+                    [np.zeros(0, np.int32)]).astype(np.int32), None)}
+        lens = np.diff(offs)
+        for name, (d, v) in zip(self._outer_names,
+                                self._outer_columns(ev)):
+            rd = np.repeat(d, lens)
+            rv = None if v is None else np.repeat(np.asarray(v, bool),
+                                                  lens)
+            cols[name] = (rd, rv)
+        rd, rv = self._eval_flat(self._bound, self._names, cols, nf)
+        out_dt = self._bound.dtype
+        out = _obj(n)
+        for i in range(n):
+            if not valid[i]:
+                out[i] = None
+                continue
+            row = []
+            for j in range(offs[i], offs[i + 1]):
+                ok = rv is None or bool(rv[j])
+                row.append(_from_physical(rd[j], out_dt) if ok else None)
+            out[i] = row
+        return out, (None if valid.all() else valid)
+
+
+class ArrayFilter(HigherOrderExpression):
+    """filter(arr, x -> pred) — keeps elements where pred is TRUE."""
+
+    def _rebind(self):
+        self._elem = self.children[0].dtype.element
+        self._bound, self._names = self._bind_body(self.body)
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _lambda_schema_fields(self):
+        return [(HOF_X, self.children[0].dtype.element), (HOF_I, T.INT32)]
+
+    def eval_host(self, ev, n) -> Value:
+        ad, av = ev(self.children[0])
+        valid = _valid_of(ad, av, n)
+        offs, elems = self._flatten(ad, valid, n)
+        lens = np.diff(offs)
+        cols = {HOF_X: _elems_to_column(elems, self._elem),
+                HOF_I: (np.concatenate(
+                    [np.arange(m, dtype=np.int32) for m in lens] or
+                    [np.zeros(0, np.int32)]).astype(np.int32), None)}
+        for name, (d, v) in zip(self._outer_names,
+                                self._outer_columns(ev)):
+            cols[name] = (np.repeat(d, lens),
+                          None if v is None else np.repeat(
+                              np.asarray(v, bool), lens))
+        rd, rv = self._eval_flat(self._bound, self._names, cols,
+                                 len(elems))
+        out = _obj(n)
+        for i in range(n):
+            if not valid[i]:
+                out[i] = None
+                continue
+            row = []
+            for k, j in enumerate(range(offs[i], offs[i + 1])):
+                ok = (rv is None or bool(rv[j])) and bool(rd[j])
+                if ok:
+                    row.append(ad[i][k])
+            out[i] = row
+        return out, (None if valid.all() else valid)
+
+
+class _ArrayPredicate(HigherOrderExpression):
+    """Shared: evaluate pred over all elements, 3-valued reduce."""
+
+    def _rebind(self):
+        self._elem = self.children[0].dtype.element
+        self._bound, self._names = self._bind_body(self.body)
+        self.dtype = T.BOOLEAN
+        self.nullable = True
+
+    def _lambda_schema_fields(self):
+        return [(HOF_X, self.children[0].dtype.element)]
+
+    def _pred_rows(self, ev, n):
+        ad, av = ev(self.children[0])
+        valid = _valid_of(ad, av, n)
+        offs, elems = self._flatten(ad, valid, n)
+        lens = np.diff(offs)
+        cols = {HOF_X: _elems_to_column(elems, self._elem)}
+        for name, (d, v) in zip(self._outer_names,
+                                self._outer_columns(ev)):
+            cols[name] = (np.repeat(d, lens),
+                          None if v is None else np.repeat(
+                              np.asarray(v, bool), lens))
+        rd, rv = self._eval_flat(self._bound, self._names, cols,
+                                 len(elems))
+        return valid, offs, rd, rv
+
+
+class ArrayExists(_ArrayPredicate):
+    """exists: TRUE if any TRUE; NULL if none TRUE but some NULL."""
+
+    def eval_host(self, ev, n) -> Value:
+        valid, offs, rd, rv = self._pred_rows(ev, n)
+        out = np.zeros(n, dtype=bool)
+        ok = valid.copy()
+        for i in range(n):
+            if not valid[i]:
+                continue
+            any_null = False
+            for j in range(offs[i], offs[i + 1]):
+                if rv is not None and not rv[j]:
+                    any_null = True
+                elif rd[j]:
+                    out[i] = True
+                    break
+            else:
+                if any_null:
+                    ok[i] = False
+        return out, (None if ok.all() else ok)
+
+
+class ArrayForAll(_ArrayPredicate):
+    """forall: FALSE if any FALSE; NULL if none FALSE but some NULL."""
+
+    def eval_host(self, ev, n) -> Value:
+        valid, offs, rd, rv = self._pred_rows(ev, n)
+        out = np.ones(n, dtype=bool)
+        ok = valid.copy()
+        for i in range(n):
+            if not valid[i]:
+                continue
+            any_null = False
+            for j in range(offs[i], offs[i + 1]):
+                if rv is not None and not rv[j]:
+                    any_null = True
+                elif not rd[j]:
+                    out[i] = False
+                    break
+            else:
+                if any_null:
+                    ok[i] = False
+        return out, (None if ok.all() else ok)
+
+
+class ArrayAggregate(HigherOrderExpression):
+    """aggregate(arr, zero, (acc, x) -> merge[, acc -> finish]) — a
+    sequential fold vectorized ACROSS ROWS (one merge evaluation per
+    element position, not per element)."""
+
+    extra_inputs = 1  # zero expression
+
+    def _rebind(self):
+        self._elem = self.children[0].dtype.element
+        zero = self.children[1]
+        self._acc_dt = zero.dtype
+        self._bound, self._names = self._bind_body(self.body)
+        self._fin = None
+        if self.finish is not None:
+            self._fin, self._fin_names = self._bind_body(
+                self.finish, lambda_fields=[(HOF_ACC, self._acc_dt)])
+            self.dtype = self._fin.dtype
+        else:
+            self.dtype = self._acc_dt
+        self.nullable = True
+
+    def _lambda_schema_fields(self):
+        return [(HOF_ACC, self._acc_dt),
+                (HOF_X, self.children[0].dtype.element)]
+
+    def eval_host(self, ev, n) -> Value:
+        ad, av = ev(self.children[0])
+        zd, zv = ev(self.children[1])
+        valid = _valid_of(ad, av, n)
+        outer = list(zip(self._outer_names, self._outer_columns(ev)))
+        acc_d = np.array(zd, copy=True)
+        acc_v = (np.ones(n, bool) if zv is None
+                 else np.asarray(zv, bool).copy())
+        max_len = max((len(ad[i]) for i in range(n) if valid[i]),
+                      default=0)
+        for k in range(max_len):
+            has = np.array([valid[i] and len(ad[i]) > k
+                            for i in range(n)])
+            if not has.any():
+                break
+            elems = [ad[i][k] if has[i] else None for i in range(n)]
+            cols = {HOF_ACC: (acc_d, acc_v),
+                    HOF_X: _elems_to_column(elems, self._elem)}
+            for name, (d, v) in outer:
+                cols[name] = (d, v)
+            rd, rv = self._eval_flat(self._bound, self._names, cols, n)
+            upd_v = np.ones(n, bool) if rv is None else np.asarray(
+                rv, bool)
+            acc_d = np.where(has, rd, acc_d) if acc_d.dtype != object \
+                else np.array([rd[i] if has[i] else acc_d[i]
+                               for i in range(n)], dtype=object)
+            acc_v = np.where(has, upd_v, acc_v)
+        if self._fin is not None:
+            cols = {HOF_ACC: (acc_d, acc_v)}
+            for name, (d, v) in outer:
+                cols[name] = (d, v)
+            acc_d, rv = self._eval_flat(self._fin, self._fin_names,
+                                        cols, n)
+            acc_v = np.ones(n, bool) if rv is None else np.asarray(
+                rv, bool)
+        ok = valid & acc_v
+        if self.dtype.is_host_carried:
+            out = _obj(n)
+            for i in range(n):
+                out[i] = _py(acc_d[i]) if ok[i] else None
+            return out, (None if ok.all() else ok)
+        dense = np.zeros(n, dtype=self.dtype.numpy_dtype)
+        for i in range(n):
+            if ok[i]:
+                dense[i] = acc_d[i]
+        return dense, (None if ok.all() else ok)
+
+
+class ZipWith(HigherOrderExpression):
+    """zip_with(a, b, (x, y) -> f) — shorter side null-padded."""
+
+    extra_inputs = 1  # the second array
+
+    def _rebind(self):
+        self._bound, self._names = self._bind_body(self.body)
+        self.dtype = T.array(self._bound.dtype)
+        self.nullable = True
+
+    def _lambda_schema_fields(self):
+        return [(HOF_X, self.children[0].dtype.element),
+                (HOF_Y, self.children[1].dtype.element)]
+
+    def eval_host(self, ev, n) -> Value:
+        (ad, av), (bd, bv) = ev(self.children[0]), ev(self.children[1])
+        va = _valid_of(ad, av, n)
+        vb = _valid_of(bd, bv, n)
+        valid = va & vb
+        lens = np.array([max(len(ad[i]), len(bd[i])) if valid[i] else 0
+                         for i in range(n)], dtype=np.int64)
+        xs, ys = [], []
+        for i in range(n):
+            if not valid[i]:
+                continue
+            a, b = ad[i], bd[i]
+            for k in range(lens[i]):
+                xs.append(a[k] if k < len(a) else None)
+                ys.append(b[k] if k < len(b) else None)
+        cols = {HOF_X: _elems_to_column(xs, self.children[0].dtype.element),
+                HOF_Y: _elems_to_column(ys, self.children[1].dtype.element)}
+        for name, (d, v) in zip(self._outer_names,
+                                self._outer_columns(ev)):
+            cols[name] = (np.repeat(d, lens),
+                          None if v is None else np.repeat(
+                              np.asarray(v, bool), lens))
+        rd, rv = self._eval_flat(self._bound, self._names, cols, len(xs))
+        out_dt = self._bound.dtype
+        out = _obj(n)
+        j = 0
+        for i in range(n):
+            if not valid[i]:
+                out[i] = None
+                continue
+            row = []
+            for _ in range(lens[i]):
+                ok = rv is None or bool(rv[j])
+                row.append(_from_physical(rd[j], out_dt) if ok else None)
+                j += 1
+            out[i] = row
+        return out, (None if valid.all() else valid)
+
+
+# ---------------------------------------------------------------------------------
+# MAP type operations (complexTypeCreator.scala:84 GpuCreateMap, map
+# extractors in complexTypeExtractors.scala, map functions in
+# collectionOperations.scala).  Maps ride host-side as arrow map columns;
+# python-space values are lists of (key, value) pairs (dicts accepted).
+# ---------------------------------------------------------------------------------
+
+def _map_items(m):
+    if m is None:
+        return None
+    if isinstance(m, dict):
+        return list(m.items())
+    return [tuple(kv) if not isinstance(kv, tuple) else kv for kv in m]
+
+
+class CreateMap(CollectionExpression):
+    """map(k1, v1, k2, v2, ...) — duplicate keys: last wins (the
+    spark.sql.mapKeyDedupPolicy=LAST_WIN behavior, applied uniformly by
+    every map constructor here); NULL keys are invalid (Spark raises).
+    Keys/values are stored in LOGICAL python space (dates as date,
+    decimals as Decimal) so maps from different constructors compare."""
+
+    def _rebind(self):
+        ks = [c.dtype for c in self.children[0::2]]
+        vs = [c.dtype for c in self.children[1::2]]
+        self._kt = ks[0] if ks else T.STRING
+        self._vt = vs[0] if vs else T.STRING
+        self.dtype = T.map_of(self._kt, self._vt)
+        self.nullable = False
+
+    def eval_host(self, ev, n) -> Value:
+        pairs = [ev(c) for c in self.children]
+        out = _obj(n)
+        for i in range(n):
+            m = {}
+            for (kd, kv), (vd, vv) in zip(pairs[0::2], pairs[1::2]):
+                k_ok = kv is None or bool(kv[i])
+                if not k_ok or (kd.dtype == object and kd[i] is None):
+                    raise ValueError("map key cannot be NULL "
+                                     "(Spark CreateMap semantics)")
+                v_ok = vv is None or bool(vv[i])
+                if vd.dtype == object and vd[i] is None:
+                    v_ok = False
+                k = _py(kd[i]) if kd.dtype == object \
+                    else _from_physical(_py(kd[i]), self._kt)
+                v = None
+                if v_ok:
+                    v = _py(vd[i]) if vd.dtype == object \
+                        else _from_physical(_py(vd[i]), self._vt)
+                m[k] = v
+            out[i] = list(m.items())
+        return out, None
+
+
+class MapKeys(CollectionExpression):
+    def _rebind(self):
+        self.dtype = T.array(self.children[0].dtype.fields[0][1])
+        self.nullable = self.children[0].nullable
+
+    def _apply(self, m):
+        return [k for k, _ in _map_items(m)]
+
+
+class MapValues(CollectionExpression):
+    def _rebind(self):
+        self.dtype = T.array(self.children[0].dtype.fields[1][1])
+        self.nullable = self.children[0].nullable
+
+    def _apply(self, m):
+        return [v for _, v in _map_items(m)]
+
+
+class MapEntries(CollectionExpression):
+    def _rebind(self):
+        kt = self.children[0].dtype.fields[0][1]
+        vt = self.children[0].dtype.fields[1][1]
+        self.dtype = T.array(T.struct([("key", kt), ("value", vt)]))
+        self.nullable = self.children[0].nullable
+
+    def _apply(self, m):
+        return [{"key": k, "value": v} for k, v in _map_items(m)]
+
+
+class MapFromArrays(CollectionExpression):
+    def _rebind(self):
+        kt = self.children[0].dtype.element
+        vt = self.children[1].dtype.element
+        self.dtype = T.map_of(kt, vt)
+        self.nullable = any(c.nullable for c in self.children)
+
+    def _apply(self, ks, vs):
+        if len(ks) != len(vs):
+            raise ValueError("map_from_arrays: length mismatch "
+                             f"({len(ks)} keys, {len(vs)} values)")
+        if any(k is None for k in ks):
+            raise ValueError("map key cannot be NULL")
+        m = {}
+        for k, v in zip(ks, vs):
+            m[k] = v
+        return list(m.items())
+
+
+class MapFromEntries(CollectionExpression):
+    def _rebind(self):
+        st = self.children[0].dtype.element
+        kt, vt = st.fields[0][1], st.fields[1][1]
+        self.dtype = T.map_of(kt, vt)
+        self.nullable = self.children[0].nullable
+
+    def _apply(self, entries):
+        m = {}
+        for e in entries:
+            if e is None:
+                raise ValueError("map_from_entries: NULL entry")
+            if isinstance(e, dict):
+                vals = list(e.values())
+                k, v = vals[0], vals[1]
+            else:
+                k, v = e[0], e[1]
+            if k is None:
+                raise ValueError("map key cannot be NULL")
+            m[k] = v
+        return list(m.items())
+
+
+class MapConcat(CollectionExpression):
+    """map_concat(m1, m2, ...) — duplicate keys: last wins."""
+
+    def _rebind(self):
+        self.dtype = self.children[0].dtype
+        self.nullable = any(c.nullable for c in self.children)
+
+    def _apply(self, *maps):
+        m = {}
+        for mm in maps:
+            for k, v in _map_items(mm):
+                m[k] = v
+        return list(m.items())
+
+
+class GetMapValue(CollectionExpression):
+    """map[key] / element_at(map, key) — NULL when absent."""
+
+    def _rebind(self):
+        self.dtype = self.children[0].dtype.fields[1][1]
+        self.nullable = True
+
+    def eval_host(self, ev, n) -> Value:
+        (md, mv), (kd, kv) = [ev(c) for c in self.children]
+        m_ok = _valid_of(md, mv, n)
+        out = _obj(n)
+        ok = np.zeros(n, dtype=bool)
+        for i in range(n):
+            if not m_ok[i] or (kv is not None and not kv[i]):
+                continue
+            key = _py(kd[i])
+            for k, v in _map_items(md[i]):
+                if k == key and v is not None:
+                    out[i] = v
+                    ok[i] = True
+                    break
+        if not self.dtype.is_host_carried:
+            dense = np.zeros(n, dtype=self.dtype.numpy_dtype)
+            for i in range(n):
+                if ok[i]:
+                    dense[i] = _physical(out[i], self.dtype)
+            return dense, ok
+        return out, ok
+
+
+class MapFilter(HigherOrderExpression):
+    """map_filter(m, (k, v) -> pred)."""
+
+    def _rebind(self):
+        self._kt = self.children[0].dtype.fields[0][1]
+        self._vt = self.children[0].dtype.fields[1][1]
+        self._bound, self._names = self._bind_body(self.body)
+        self.dtype = self.children[0].dtype
+        self.nullable = self.children[0].nullable
+
+    def _lambda_schema_fields(self):
+        return [(HOF_X, self._kt), (HOF_Y, self._vt)]
+
+    def eval_host(self, ev, n) -> Value:
+        md, mv = ev(self.children[0])
+        valid = _valid_of(md, mv, n)
+        items = [(_map_items(md[i]) if valid[i] else []) for i in range(n)]
+        lens = np.array([len(x) for x in items], dtype=np.int64)
+        ks = [k for row in items for k, _ in row]
+        vs = [v for row in items for _, v in row]
+        cols = {HOF_X: _elems_to_column(ks, self._kt),
+                HOF_Y: _elems_to_column(vs, self._vt)}
+        for name, (d, v) in zip(self._outer_names,
+                                self._outer_columns(ev)):
+            cols[name] = (np.repeat(d, lens),
+                          None if v is None else np.repeat(
+                              np.asarray(v, bool), lens))
+        rd, rv = self._eval_flat(self._bound, self._names, cols, len(ks))
+        out = _obj(n)
+        j = 0
+        for i in range(n):
+            if not valid[i]:
+                out[i] = None
+                continue
+            row = []
+            for kvp in items[i]:
+                if (rv is None or bool(rv[j])) and bool(rd[j]):
+                    row.append(kvp)
+                j += 1
+            out[i] = row
+        return out, (None if valid.all() else valid)
+
+
+class TransformKeys(MapFilter):
+    """transform_keys(m, (k, v) -> f) — result keys must be non-NULL."""
+
+    def _rebind(self):
+        self._kt = self.children[0].dtype.fields[0][1]
+        self._vt = self.children[0].dtype.fields[1][1]
+        self._bound, self._names = self._bind_body(self.body)
+        self.dtype = T.map_of(self._bound.dtype, self._vt)
+        self.nullable = self.children[0].nullable
+
+    def eval_host(self, ev, n) -> Value:
+        md, mv = ev(self.children[0])
+        valid = _valid_of(md, mv, n)
+        items = [(_map_items(md[i]) if valid[i] else []) for i in range(n)]
+        lens = np.array([len(x) for x in items], dtype=np.int64)
+        ks = [k for row in items for k, _ in row]
+        vs = [v for row in items for _, v in row]
+        cols = {HOF_X: _elems_to_column(ks, self._kt),
+                HOF_Y: _elems_to_column(vs, self._vt)}
+        for name, (d, v) in zip(self._outer_names,
+                                self._outer_columns(ev)):
+            cols[name] = (np.repeat(d, lens),
+                          None if v is None else np.repeat(
+                              np.asarray(v, bool), lens))
+        rd, rv = self._eval_flat(self._bound, self._names, cols, len(ks))
+        kdt = self._bound.dtype
+        out = _obj(n)
+        j = 0
+        for i in range(n):
+            if not valid[i]:
+                out[i] = None
+                continue
+            m = {}
+            for _k, v in items[i]:
+                if rv is not None and not rv[j]:
+                    raise ValueError("transform_keys produced a NULL key")
+                # duplicate result keys: last wins (same LAST_WIN policy
+                # as every other map constructor here)
+                m[_from_physical(rd[j], kdt)] = v
+                j += 1
+            out[i] = list(m.items())
+        return out, (None if valid.all() else valid)
+
+
+class TransformValues(MapFilter):
+    """transform_values(m, (k, v) -> f)."""
+
+    def _rebind(self):
+        self._kt = self.children[0].dtype.fields[0][1]
+        self._vt = self.children[0].dtype.fields[1][1]
+        self._bound, self._names = self._bind_body(self.body)
+        self.dtype = T.map_of(self._kt, self._bound.dtype)
+        self.nullable = self.children[0].nullable
+
+    def eval_host(self, ev, n) -> Value:
+        md, mv = ev(self.children[0])
+        valid = _valid_of(md, mv, n)
+        items = [(_map_items(md[i]) if valid[i] else []) for i in range(n)]
+        lens = np.array([len(x) for x in items], dtype=np.int64)
+        ks = [k for row in items for k, _ in row]
+        vs = [v for row in items for _, v in row]
+        cols = {HOF_X: _elems_to_column(ks, self._kt),
+                HOF_Y: _elems_to_column(vs, self._vt)}
+        for name, (d, v) in zip(self._outer_names,
+                                self._outer_columns(ev)):
+            cols[name] = (np.repeat(d, lens),
+                          None if v is None else np.repeat(
+                              np.asarray(v, bool), lens))
+        rd, rv = self._eval_flat(self._bound, self._names, cols, len(ks))
+        vdt = self._bound.dtype
+        out = _obj(n)
+        j = 0
+        for i in range(n):
+            if not valid[i]:
+                out[i] = None
+                continue
+            row = []
+            for k, _v in items[i]:
+                ok = rv is None or bool(rv[j])
+                row.append((k, _from_physical(rd[j], vdt) if ok else None))
+                j += 1
+            out[i] = row
+        return out, (None if valid.all() else valid)

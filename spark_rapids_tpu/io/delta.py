@@ -14,8 +14,11 @@ partitionColumns), `add`/`remove` with partitionValues, `commitInfo`,
 classic single-file parquet checkpoints, versionAsOf time travel;
 DELETE/UPDATE/MERGE commands (copy-on-write); deletion vectors (read +
 merge-on-read DELETE via `deletion_vectors.py`); column mapping mode
-name/id (read + DV delete — rewrite commands reject mapped tables).
-Not implemented: generated columns, CDF, row tracking, v2 checkpoints.
+name/id (read + DV delete — rewrite commands reject mapped tables);
+optimistic concurrent-writer commits with conflict detection and retry;
+Change Data Feed (write on DELETE/UPDATE, read via `table_changes`).
+Not implemented: generated columns, CDF for MERGE, row tracking, v2
+checkpoints.
 """
 
 from __future__ import annotations
@@ -27,7 +30,9 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["DeltaTable", "read_delta", "write_delta",
-           "delta_delete", "delta_update", "delta_merge"]
+           "delta_delete", "delta_update", "delta_merge", "table_changes",
+           "ConcurrentModificationError", "ConcurrentAppendError",
+           "ConcurrentDeleteError"]
 
 _LOG_DIR = "_delta_log"
 
@@ -167,6 +172,10 @@ class DeltaTable:
                       bool(f.get("nullable", True)))
                 for f in sch["fields"]]
 
+    def cdf_enabled(self) -> bool:
+        conf = (self.metadata or {}).get("configuration") or {}
+        return conf.get("delta.enableChangeDataFeed") == "true"
+
     def column_mapping(self) -> Dict[str, str]:
         """physical (parquet) name → logical name, when
         ``delta.columnMapping.mode`` is ``name``/``id`` (protocol: data
@@ -226,7 +235,8 @@ def read_delta(path: str, version: Optional[int] = None, **source_kwargs):
 # ---------------------------------------------------------------------------------
 
 def write_delta(df, path: str, mode: str = "error",
-                partition_by: Optional[List[str]] = None) -> int:
+                partition_by: Optional[List[str]] = None,
+                properties: Optional[Dict[str, str]] = None) -> int:
     """Write a DataFrame as a Delta commit; returns the new version.
 
     ``append`` adds files; ``overwrite`` adds files and removes all prior
@@ -235,11 +245,12 @@ def write_delta(df, path: str, mode: str = "error",
     exists = os.path.isdir(os.path.join(path, _LOG_DIR)) and \
         any(n.endswith(".json")
             for n in os.listdir(os.path.join(path, _LOG_DIR)))
+    prior = DeltaTable(path) if exists else None
     if exists and mode in ("error", "errorifexists"):
         raise FileExistsError(f"Delta table already exists at {path}")
     if exists and mode == "ignore":
-        return DeltaTable(path).version
-    if exists and DeltaTable(path).column_mapping():
+        return prior.version
+    if exists and prior.column_mapping():
         raise NotImplementedError(
             "append/overwrite on a column-mapped table is not supported "
             "(data files and partitionValues must use physical names)")
@@ -255,8 +266,8 @@ def write_delta(df, path: str, mode: str = "error",
     w.parquet(path)
     new_files = [p for p in _data_files(path) if p not in before]
 
-    # 2. build the commit
-    prior_version = DeltaTable(path).version if exists else -1
+    # 2. build the commit (ONE snapshot read serves the whole write)
+    prior_version = prior.version if exists else -1
     version = prior_version + 1
     now_ms = int(time.time() * 1000)
     actions = []
@@ -273,11 +284,10 @@ def write_delta(df, path: str, mode: str = "error",
             "schemaString": json.dumps(
                 {"type": "struct", "fields": fields}),
             "partitionColumns": part_by,
-            "configuration": {},
+            "configuration": dict(properties or {}),
             "createdTime": now_ms,
         }})
     if exists and mode == "overwrite":
-        prior = DeltaTable(path)
         for rel in prior.active:
             actions.append({"remove": {
                 "path": rel, "deletionTimestamp": now_ms,
@@ -300,19 +310,11 @@ def write_delta(df, path: str, mode: str = "error",
         "engineInfo": "spark_rapids_tpu",
     }})
 
-    log_dir = os.path.join(path, _LOG_DIR)
-    os.makedirs(log_dir, exist_ok=True)
-    commit = os.path.join(log_dir, f"{version:020d}.json")
-    tmp = commit + f".tmp-{uuid.uuid4().hex}"
-    with open(tmp, "w") as f:
-        for a in actions:
-            f.write(json.dumps(a) + "\n")
-    # linearization point: version files are create-once
-    if os.path.exists(commit):
-        os.unlink(tmp)
-        raise RuntimeError(f"concurrent Delta commit at version {version}")
-    os.rename(tmp, commit)
-    return version
+    my_removes = [a["remove"]["path"] for a in actions if "remove" in a]
+    # append is a blind write: it retries cleanly past concurrent
+    # appends; overwrite read the whole prior snapshot
+    return _commit_with_retry(path, prior_version, actions, my_removes,
+                              reads_table=(exists and mode == "overwrite"))
 
 
 def _data_files(path: str) -> List[str]:
@@ -382,6 +384,8 @@ def _delete_with_dvs(session, path: str, condition) -> int:
     rename = table.column_mapping()
     to_physical = {v: k for k, v in rename.items()}
     removes, adds = [], []
+    cdf = table.cdf_enabled()
+    cdc_tables = []
     for rel, pvals in sorted(table.active.items()):
         fpath = os.path.join(path, rel)
         df = session.read_parquet(fpath)
@@ -403,6 +407,16 @@ def _delete_with_dvs(session, path: str, condition) -> int:
         live_matched = np.setdiff1d(matched, old_rows)
         if live_matched.size == 0:
             continue
+        if cdf:
+            import pyarrow.parquet as _pq
+            import pyarrow as _pa
+            raw_t = _pq.read_table(fpath)
+            changed = raw_t.take(_pa.array(live_matched))
+            if rename:  # physical parquet names -> logical names
+                changed = changed.rename_columns(
+                    [rename.get(c, c) for c in changed.column_names])
+            cdc_tables.append(_with_change_type(changed, "delete", pvals,
+                                                part_cols, to_physical))
         new_rows = np.union1d(old_rows, matched)
         removes.append(rel)
         if new_rows.size < n_raw:
@@ -412,8 +426,10 @@ def _delete_with_dvs(session, path: str, condition) -> int:
             adds.append((rel, dict(pvals), desc))
     if not removes:
         return table.version
-    return _commit(path, table.version + 1, "DELETE", removes, adds,
-                   protocol_action=_dv_protocol_upgrade(table))
+    cdc_files = _write_cdc_files(path, cdc_tables)
+    return _commit(path, table.version, "DELETE", removes, adds,
+                   protocol_action=_dv_protocol_upgrade(table),
+                   cdc_files=cdc_files)
 
 
 def _dv_protocol_upgrade(table: DeltaTable) -> Optional[dict]:
@@ -471,6 +487,8 @@ def _rewrite_files(session, path, condition, set_exprs) -> int:
             "mapping expects physical names in); DELETE(use_dv=True) works")
     part_cols = table.partition_columns()
     removes, adds = [], []
+    cdf = table.cdf_enabled()
+    cdc_tables = []
     for rel, pvals in sorted(table.active.items()):
         fpath = os.path.join(path, rel)
         df = _read_live_file(session, table, rel, fpath)
@@ -483,6 +501,19 @@ def _rewrite_files(session, path, condition, set_exprs) -> int:
         n_match = df.filter(cond_col).count()
         if n_match == 0:
             continue  # file untouched
+        if cdf:
+            matched_df = df.filter(cond_col)
+            if set_exprs is None:
+                cdc_tables.append(_with_change_type(
+                    matched_df.to_arrow(), "delete"))
+            else:
+                cdc_tables.append(_with_change_type(
+                    matched_df.to_arrow(), "update_preimage"))
+                post = matched_df
+                for col, expr in set_exprs.items():
+                    post = post.with_column(col, expr)
+                cdc_tables.append(_with_change_type(
+                    post.to_arrow(), "update_postimage"))
         if set_exprs is None:
             kept = df.filter(~cond_col | cond_col.is_null())
             out_df = kept
@@ -507,9 +538,10 @@ def _rewrite_files(session, path, condition, set_exprs) -> int:
 
     if not removes:
         return table.version  # no-op
-    return _commit(path, table.version + 1,
+    cdc_files = _write_cdc_files(path, cdc_tables)
+    return _commit(path, table.version,
                    "DELETE" if set_exprs is None else "UPDATE",
-                   removes, adds)
+                   removes, adds, cdc_files=cdc_files)
 
 
 def _typed(raw: str):
@@ -648,14 +680,93 @@ def delta_merge(session, path: str, source_df, on: List[str],
     source_df.unpersist()
     if not removes and not adds:
         return table.version
-    return _commit(path, table.version + 1, "MERGE", removes, adds)
+    return _commit(path, table.version, "MERGE", removes, adds)
 
 
-def _commit(path: str, version: int, operation: str,
+class ConcurrentModificationError(RuntimeError):
+    """Another writer committed a conflicting change (Delta
+    ConcurrentModificationException family)."""
+
+
+class ConcurrentAppendError(ConcurrentModificationError):
+    """Files were added that this read-the-table operation did not see."""
+
+
+class ConcurrentDeleteError(ConcurrentModificationError):
+    """A file this operation read or removes was removed concurrently."""
+
+
+def _attempt_commit_file(log_dir: str, version: int, actions) -> bool:
+    """Atomically create-once the version file via hard link: the link
+    either fully succeeds or raises EEXIST — no exists+rename TOCTOU."""
+    commit = os.path.join(log_dir, f"{version:020d}.json")
+    tmp = commit + f".tmp-{uuid.uuid4().hex}"
+    with open(tmp, "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+    try:
+        os.link(tmp, commit)
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        os.unlink(tmp)
+
+
+def _read_commit_actions(log_dir: str, version: int) -> List[dict]:
+    with open(os.path.join(log_dir, f"{version:020d}.json")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _commit_with_retry(path: str, read_version: int, actions,
+                       my_removes: List[str], reads_table: bool,
+                       max_retries: int = 50) -> int:
+    """Optimistic transaction commit (GpuOptimisticTransaction /
+    OptimisticTransactionImpl analog): attempt at read_version+1; on
+    losing the race, check every intervening commit for conflicts —
+    metadata/protocol changes always conflict; removed files we also
+    remove (or, for read-the-table operations, ANY data change we did
+    not see) conflict; blind appends retry cleanly at the new head."""
+    log_dir = os.path.join(path, _LOG_DIR)
+    os.makedirs(log_dir, exist_ok=True)
+    version = read_version + 1
+    mine = {r.replace(os.sep, "/") for r in my_removes}
+    for _ in range(max_retries):
+        if _attempt_commit_file(log_dir, version, actions):
+            return version
+        latest = max(int(n[:-5]) for n in os.listdir(log_dir)
+                     if n.endswith(".json") and n[:-5].isdigit())
+        for v in range(version, latest + 1):
+            for a in _read_commit_actions(log_dir, v):
+                if "metaData" in a or "protocol" in a:
+                    raise ConcurrentModificationError(
+                        f"metadata/protocol changed at version {v}")
+                if "remove" in a:
+                    rp = a["remove"]["path"]
+                    if rp in mine:
+                        raise ConcurrentDeleteError(
+                            f"file {rp} was removed concurrently at "
+                            f"version {v}")
+                    if reads_table:
+                        raise ConcurrentDeleteError(
+                            f"version {v} removed {rp}, which this "
+                            f"operation read")
+                if "add" in a and reads_table \
+                        and a["add"].get("dataChange", True):
+                    raise ConcurrentAppendError(
+                        f"version {v} added {a['add']['path']}, which "
+                        f"this operation did not see")
+        version = latest + 1
+    raise ConcurrentModificationError(
+        f"gave up after {max_retries} commit attempts")
+
+
+def _commit(path: str, read_version: int, operation: str,
             removes: List[str], adds,
-            protocol_action: Optional[dict] = None) -> int:
-    """Build and atomically write one Delta commit (create-once version
-    file is the linearization point)."""
+            protocol_action: Optional[dict] = None,
+            cdc_files: Optional[list] = None) -> int:
+    """Build one Delta commit from the snapshot at ``read_version`` and
+    write it through the optimistic-retry transaction."""
     now_ms = int(time.time() * 1000)
     actions = []
     if protocol_action is not None:
@@ -675,18 +786,117 @@ def _commit(path: str, version: int, operation: str,
         if dv is not None:
             add["deletionVector"] = dv
         actions.append({"add": add})
+    for rel in (cdc_files or []):
+        actions.append({"cdc": {"path": rel.replace(os.sep, "/"),
+                                "partitionValues": {},
+                                "size": os.path.getsize(
+                                    os.path.join(path, rel)),
+                                "dataChange": False}})
     actions.append({"commitInfo": {"timestamp": now_ms,
                                    "operation": operation,
                                    "engineInfo": "spark_rapids_tpu"}})
+    # DELETE/UPDATE/MERGE read the whole table snapshot
+    return _commit_with_retry(path, read_version, actions, removes,
+                              reads_table=True)
+
+
+# ---------------------------------------------------------------------------------
+# Change Data Feed (delta.enableChangeDataFeed; the reference's
+# delta-lake CDF write path under GpuOptimisticTransaction + cdf read).
+# Change files live under _change_data/ with a _change_type column; the
+# commit carries them as `cdc` actions (dataChange=false).
+# ---------------------------------------------------------------------------------
+
+_CDC_DIR = "_change_data"
+
+
+def _with_change_type(table, change_type: str, pvals=None, part_cols=(),
+                      to_physical=None):
+    """Append the _change_type column (and any partition columns carried
+    in the path, for the DV path whose files lack them)."""
+    import pyarrow as pa
+    if pvals:
+        for c in part_cols:
+            raw = pvals.get((to_physical or {}).get(c, c))
+            if c not in table.column_names:
+                table = table.append_column(
+                    c, pa.array([raw] * table.num_rows, type=pa.string()))
+    return table.append_column(
+        "_change_type",
+        pa.array([change_type] * table.num_rows, type=pa.string()))
+
+
+def _write_cdc_files(path: str, cdc_tables) -> List[str]:
+    if not cdc_tables:
+        return []
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    os.makedirs(os.path.join(path, _CDC_DIR), exist_ok=True)
+    rel = os.path.join(_CDC_DIR, f"cdc-{uuid.uuid4().hex}.parquet")
+    whole = pa.concat_tables(cdc_tables, promote_options="default")
+    pq.write_table(whole, os.path.join(path, rel))
+    return [rel]
+
+
+def table_changes(session, path: str, starting_version: int,
+                  ending_version: Optional[int] = None):
+    """CDF read: change rows in [starting_version, ending_version] as a
+    DataFrame with _change_type and _commit_version columns.
+
+    Commits with explicit `cdc` actions serve them directly; plain
+    append commits derive inserts.  Any commit that removed data without
+    cdc files — DELETE/UPDATE with CDF off, or an overwrite WRITE —
+    raises, as does a range with cleaned-up log files."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    table = DeltaTable(path)
+    end = table.version if ending_version is None else ending_version
+    pieces = []
     log_dir = os.path.join(path, _LOG_DIR)
-    os.makedirs(log_dir, exist_ok=True)
-    commit = os.path.join(log_dir, f"{version:020d}.json")
-    tmp = commit + f".tmp-{uuid.uuid4().hex}"
-    with open(tmp, "w") as f:
-        for a in actions:
-            f.write(json.dumps(a) + "\n")
-    if os.path.exists(commit):
-        os.unlink(tmp)
-        raise RuntimeError(f"concurrent Delta commit at version {version}")
-    os.rename(tmp, commit)
-    return version
+    for v in range(starting_version, end + 1):
+        cf = os.path.join(log_dir, f"{v:020d}.json")
+        if not os.path.exists(cf):
+            raise ValueError(
+                f"change data for version {v} is no longer available "
+                f"(log file cleaned up) — the requested range cannot be "
+                f"served completely")
+        actions = _read_commit_actions(log_dir, v)
+        op = next((a["commitInfo"].get("operation") for a in actions
+                   if "commitInfo" in a), "")
+        cdcs = [a["cdc"]["path"] for a in actions if "cdc" in a]
+        if cdcs:
+            for rel in cdcs:
+                t = pq.read_table(os.path.join(path, rel))
+                pieces.append(t.append_column(
+                    "_commit_version",
+                    pa.array([v] * t.num_rows, type=pa.int64())))
+            continue
+        adds = [a["add"] for a in actions
+                if "add" in a and a["add"].get("dataChange", True)]
+        removes = [a for a in actions
+                   if "remove" in a and a["remove"].get("dataChange", True)]
+        if removes:
+            # covers DELETE/UPDATE/MERGE without CDF files AND overwrite
+            # WRITEs: serving their delete rows would need the removed
+            # files' content semantics the log alone does not carry
+            raise ValueError(
+                f"version {v} ({op}) removed data without CDF files — "
+                f"enable delta.enableChangeDataFeed before mutating")
+        for add in adds:
+            t = pq.read_table(os.path.join(path, add["path"]))
+            for k, val in (add.get("partitionValues") or {}).items():
+                if k not in t.column_names:
+                    t = t.append_column(
+                        k, pa.array([val] * t.num_rows, type=pa.string()))
+            t = t.append_column(
+                "_change_type",
+                pa.array(["insert"] * t.num_rows, type=pa.string()))
+            pieces.append(t.append_column(
+                "_commit_version",
+                pa.array([v] * t.num_rows, type=pa.int64())))
+    if not pieces:
+        raise ValueError(
+            f"no change data between versions {starting_version} and "
+            f"{end}")
+    whole = pa.concat_tables(pieces, promote_options="default")
+    return session.create_dataframe(whole)

@@ -213,38 +213,132 @@ def rows_positions(w: SortedWindowContext, lo: Optional[int],
 
 def range_positions(w: SortedWindowContext, key: jax.Array,
                     key_valid: Optional[jax.Array],
-                    lo: Optional[int], hi: Optional[int]):
-    """[lo_pos, hi_pos] of a value-RANGE frame over a single ASCENDING
-    NULLS-FIRST int32-representable order key (int32/date), via composite
-    int64 searchsorted: composite = (segment_id << 33) | (not_null << 32)
-    | biased key — globally sorted by construction (GpuWindowExec bounded
-    range analog).  NULL-keyed rows form their own peer group (Spark
-    semantics): their frame is exactly the segment's null block."""
+                    lo: Optional[int], hi: Optional[int],
+                    descending: bool = False,
+                    nulls_first: bool = True,
+                    wide: bool = False):
+    """[lo_pos, hi_pos] of a value-RANGE frame over a single order key
+    (GpuWindowExec.scala:1655 bounded range analog).
+
+    int32-representable keys (int/date) pack into ONE int64 composite —
+    (segment_id << 35) | (null_block_flag << 34) | 33-bit biased key —
+    and resolve with two native searchsorted passes; 64-bit keys
+    (bigint/timestamp, ``wide=True``) use a vectorized lexicographic
+    binary search over (segment, null-block, key) instead (no packing
+    exists for them).  Descending orders negate the key, which maps
+    Spark's desc-range semantics (PRECEDING adds) onto the ascending
+    kernel exactly.  NULL-keyed rows form their own peer group (Spark
+    semantics): their frame is exactly the segment's null block, placed
+    per ``nulls_first``."""
     k64 = key.astype(jnp.int64)
-    bias = jnp.int64(1) << 31
+    if descending:
+        k64 = -k64
     ok = (jnp.ones_like(k64, dtype=bool) if key_valid is None
           else key_valid)
-    seg = w.seg_ids.astype(jnp.int64) << 33
-    nn = jnp.int64(1) << 32
-    comp = seg | jnp.where(ok, nn | (k64 + bias), jnp.int64(0))
-    # inactive rows park at the top so they never enter a window
-    comp = jnp.where(w.active, comp, jnp.int64(2**62))
-    i32min, i32max = -(2**31), 2**31 - 1
+    # flag orders the null block to match the physical sort: nulls first
+    # -> nulls get 0 / values 1; nulls last -> values 0 / nulls 1
+    val_flag = jnp.int64(1) if nulls_first else jnp.int64(0)
+    null_flag = jnp.int64(0) if nulls_first else jnp.int64(1)
 
-    def _search(delta, side):
-        tgt = jnp.clip(k64 + delta, i32min, i32max)
-        return jnp.searchsorted(comp, seg | nn | (tgt + bias),
-                                side=side).astype(jnp.int32)
+    def _sat_add(a, delta):
+        t = a + jnp.int64(delta)
+        if delta >= 0:
+            return jnp.where(t < a, jnp.int64(2**62), t)
+        return jnp.where(t > a, jnp.int64(-(2**62)), t)
+
+    if wide:
+        seg64 = w.seg_ids.astype(jnp.int64)
+
+        def _search(delta, side):
+            tgt = _sat_add(k64, delta)
+            return _lex_searchsorted(
+                w, seg64, jnp.where(ok, val_flag, null_flag), k64,
+                seg64, jnp.full_like(seg64, val_flag), tgt, side)
+
+        def _null_edge(side):
+            return _lex_searchsorted(
+                w, seg64, jnp.where(ok, val_flag, null_flag), k64,
+                seg64, jnp.full_like(seg64, null_flag),
+                jnp.full_like(k64, -(2**62) if side == "left"
+                              else 2**62), side)
+    else:
+        bias = jnp.int64(1) << 32  # 33-bit field: holds negated int32 min
+        seg = w.seg_ids.astype(jnp.int64) << 35
+        fb = jnp.int64(1) << 34
+        comp = seg | jnp.where(ok, (val_flag << 34) | (k64 + bias),
+                               null_flag << 34)
+        # inactive rows park at the top so they never enter a window
+        comp = jnp.where(w.active, comp, jnp.int64(2**62))
+        kmin, kmax = -(2**32) + 1, (2**32) - 1
+
+        def _search(delta, side):
+            tgt = jnp.clip(_sat_add(k64, delta), kmin, kmax)
+            return jnp.searchsorted(
+                comp, seg | (val_flag << 34) | (tgt + bias),
+                side=side).astype(jnp.int32)
+
+        def _null_edge(side):
+            probe = seg | (null_flag << 34) | (
+                jnp.int64(0) if side == "left" else (fb - 1))
+            return jnp.searchsorted(comp, probe,
+                                    side=side).astype(jnp.int32)
 
     lo_pos = w.seg_start_pos if lo is None else _search(lo, "left")
     hi_pos = w.seg_end_pos if hi is None else (_search(hi, "right") - 1)
     if key_valid is not None:
-        # null rows: frame = the null block [seg_start, last null row)
-        null_hi = (jnp.searchsorted(comp, seg | nn, side="left")
-                   .astype(jnp.int32) - 1)
-        lo_pos = jnp.where(ok, lo_pos, w.seg_start_pos)
-        hi_pos = jnp.where(ok, hi_pos, null_hi)
+        if nulls_first:
+            # null block = [seg_start, first valid row)
+            if wide:
+                seg64 = w.seg_ids.astype(jnp.int64)
+                vstart = _lex_searchsorted(
+                    w, seg64, jnp.where(ok, val_flag, null_flag), k64,
+                    seg64, jnp.full_like(seg64, val_flag),
+                    jnp.full_like(k64, -(2**62)), "left")
+            else:
+                vstart = jnp.searchsorted(
+                    comp, seg | (val_flag << 34),
+                    side="left").astype(jnp.int32)
+            lo_pos = jnp.where(ok, lo_pos, w.seg_start_pos)
+            hi_pos = jnp.where(ok, hi_pos, vstart - 1)
+        else:
+            # null block = [first null row, seg_end]
+            nstart = _null_edge("left")
+            lo_pos = jnp.where(ok, lo_pos, nstart)
+            hi_pos = jnp.where(ok, hi_pos, w.seg_end_pos)
     return lo_pos, hi_pos
+
+
+def _lex_searchsorted(w: SortedWindowContext, seg, flag, key,
+                      tseg, tflag, tkey, side: str) -> jax.Array:
+    """Vectorized binary search over rows sorted by (seg, flag, key):
+    per-target insertion point, log2(capacity) gather steps."""
+    cap = w.capacity
+    steps = max(1, int(np.ceil(np.log2(max(cap, 2)))) + 1)
+    # pad compare: positions >= cap sort at +inf
+    seg_p = jnp.concatenate([seg, jnp.full((1,), 2**62, jnp.int64)])
+    flag_p = jnp.concatenate([flag, jnp.full((1,), 2**62, jnp.int64)])
+    key_p = jnp.concatenate([key, jnp.full((1,), 2**62, jnp.int64)])
+    inactive = ~w.active
+    seg_p = seg_p.at[:cap].set(jnp.where(inactive, 2**62, seg_p[:cap]))
+    lo0 = jnp.zeros_like(tkey, dtype=jnp.int32)
+    hi0 = jnp.full_like(lo0, cap)
+
+    def body(_i, state):
+        lo, hi = state
+        mid = (lo + hi) >> 1
+        ms, mf, mk = seg_p[mid], flag_p[mid], key_p[mid]
+        if side == "left":
+            less = (ms < tseg) | ((ms == tseg) & (
+                (mf < tflag) | ((mf == tflag) & (mk < tkey))))
+        else:
+            less = (ms < tseg) | ((ms == tseg) & (
+                (mf < tflag) | ((mf == tflag) & (mk <= tkey))))
+        lo = jnp.where(less, mid + 1, lo)
+        hi = jnp.where(less, hi, mid)
+        return lo, hi
+
+    lo_f, _ = jax.lax.fori_loop(0, steps, body, (lo0, hi0))
+    return lo_f.astype(jnp.int32)
 
 
 def positional_sum(w: SortedWindowContext, contrib: jax.Array,

@@ -81,7 +81,121 @@ def struct(*cols) -> Column:
 
 def element_at(col_, idx) -> Column:
     from .. import collectionfns as C
-    return Column(C.ElementAt(to_expr(col_), to_expr(idx)))
+    from .. import types as T
+    e = to_expr(col_)
+    if e.dtype is not None and e.dtype.kind == T.TypeKind.MAP:
+        return Column(C.GetMapValue(e, to_expr(idx)))
+    return Column(C.ElementAt(e, to_expr(idx)))
+
+
+def _lambda_body(fn, *var_names):
+    """Invoke a python lambda with reserved-variable Columns; returns the
+    body expression (higherOrderFunctions.scala lambda capture)."""
+    from .. import collectionfns as C
+    import inspect
+    n_args = len(inspect.signature(fn).parameters)
+    cols = [Column(E.UnresolvedColumn(v)) for v in var_names[:n_args]]
+    return to_expr(fn(*cols))
+
+
+def transform(col_, fn) -> Column:
+    """transform(arr, x -> f(x)) or (x, i) -> f(x, i)
+    (GpuArrayTransform, higherOrderFunctions.scala:291)."""
+    from .. import collectionfns as C
+    body = _lambda_body(fn, C.HOF_X, C.HOF_I)
+    return Column(C.ArrayTransform(to_expr(col_), body=body))
+
+
+def filter(col_, fn) -> Column:  # noqa: A001 — pyspark name
+    from .. import collectionfns as C
+    body = _lambda_body(fn, C.HOF_X, C.HOF_I)
+    return Column(C.ArrayFilter(to_expr(col_), body=body))
+
+
+array_filter = filter
+
+
+def exists(col_, fn) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayExists(to_expr(col_),
+                                body=_lambda_body(fn, C.HOF_X)))
+
+
+def forall(col_, fn) -> Column:
+    from .. import collectionfns as C
+    return Column(C.ArrayForAll(to_expr(col_),
+                                body=_lambda_body(fn, C.HOF_X)))
+
+
+def aggregate(col_, zero, merge, finish=None) -> Column:
+    """aggregate(arr, zero, (acc, x) -> merge[, acc -> finish])."""
+    from .. import collectionfns as C
+    body = _lambda_body(merge, C.HOF_ACC, C.HOF_X)
+    fin = _lambda_body(finish, C.HOF_ACC) if finish is not None else None
+    return Column(C.ArrayAggregate(to_expr(col_), to_expr(zero),
+                                   body=body, finish=fin))
+
+
+reduce = aggregate
+
+
+def zip_with(left, right, fn) -> Column:
+    from .. import collectionfns as C
+    body = _lambda_body(fn, C.HOF_X, C.HOF_Y)
+    return Column(C.ZipWith(to_expr(left), to_expr(right), body=body))
+
+
+def create_map(*cols) -> Column:
+    from .. import collectionfns as C
+    return Column(C.CreateMap(*[to_expr(c) for c in cols]))
+
+
+def map_keys(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.MapKeys(to_expr(col_)))
+
+
+def map_values(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.MapValues(to_expr(col_)))
+
+
+def map_entries(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.MapEntries(to_expr(col_)))
+
+
+def map_from_arrays(keys, values) -> Column:
+    from .. import collectionfns as C
+    return Column(C.MapFromArrays(to_expr(keys), to_expr(values)))
+
+
+def map_from_entries(col_) -> Column:
+    from .. import collectionfns as C
+    return Column(C.MapFromEntries(to_expr(col_)))
+
+
+def map_concat(*cols) -> Column:
+    from .. import collectionfns as C
+    return Column(C.MapConcat(*[to_expr(c) for c in cols]))
+
+
+def map_filter(col_, fn) -> Column:
+    from .. import collectionfns as C
+    body = _lambda_body(fn, C.HOF_X, C.HOF_Y)
+    return Column(C.MapFilter(to_expr(col_), body=body))
+
+
+def transform_keys(col_, fn) -> Column:
+    from .. import collectionfns as C
+    body = _lambda_body(fn, C.HOF_X, C.HOF_Y)
+    return Column(C.TransformKeys(to_expr(col_), body=body))
+
+
+def transform_values(col_, fn) -> Column:
+    from .. import collectionfns as C
+    body = _lambda_body(fn, C.HOF_X, C.HOF_Y)
+    return Column(C.TransformValues(to_expr(col_), body=body))
 
 
 def size(col_) -> Column:

@@ -139,6 +139,8 @@ class DataType:
         if self.kind == TypeKind.STRUCT:
             inner = ",".join(f"{n}:{t}" for n, t in self.fields)
             return f"struct<{inner}>"
+        if self.kind == TypeKind.MAP:
+            return f"map<{self.fields[0][1]},{self.fields[1][1]}>"
         return self.kind.value
 
     def simple_name(self) -> str:
@@ -168,6 +170,13 @@ def struct(fields) -> DataType:
     """STRUCT<name: type, ...> — carried as host arrow struct columns
     (complexTypeCreator.scala analog); ``fields`` is [(name, DataType)]."""
     return DataType(TypeKind.STRUCT, fields=tuple(fields))
+
+
+def map_of(key: DataType, value: DataType) -> DataType:
+    """MAP<key, value> — carried as host arrow map columns
+    (GpuCreateMap, complexTypeCreator.scala:84); python-space values are
+    lists of (key, value) pairs."""
+    return DataType(TypeKind.MAP, fields=(("key", key), ("value", value)))
 
 
 def decimal(precision: int, scale: int) -> DataType:

@@ -18,6 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from . import types as T
@@ -218,11 +219,16 @@ class WindowExpression(Expression):
         return self._agg_window_eval(w, ectx)
 
     def _range_order_key(self, w, ectx):
-        """Sorted single ascending int32-representable order key for
-        bounded RANGE frames (gated by device_support_reason)."""
+        """Sorted single integer order key for bounded RANGE frames
+        (gated by device_support_reason): (data, valid, descending,
+        nulls_first, wide) — wide marks 64-bit keys that need the
+        lexicographic search instead of the packed composite."""
+        import spark_rapids_tpu.types as _T
         o = self.spec.order_by[0]
         d, v = w.sort_value(o.expr.eval(ectx))
-        return d, v
+        dt = o.expr.dtype
+        wide = dt.kind in (_T.TypeKind.INT64, _T.TypeKind.TIMESTAMP)
+        return d, v, not o.ascending, getattr(o, "nulls_first", True), wide
 
     def _bounded_positions(self, w, ectx):
         """[lo_pos, hi_pos] for a bounded (non-running) frame, or None."""
@@ -231,8 +237,10 @@ class WindowExpression(Expression):
             return None
         if frame.kind == "rows":
             return W.rows_positions(w, frame.lo, frame.hi)
-        kd, kv = self._range_order_key(w, ectx)
-        return W.range_positions(w, kd, kv, frame.lo, frame.hi)
+        kd, kv, desc, nf, wide = self._range_order_key(w, ectx)
+        return W.range_positions(w, kd, kv, frame.lo, frame.hi,
+                                 descending=desc, nulls_first=nf,
+                                 wide=wide)
 
     def _agg_window_eval(self, w, ectx) -> Value:
         agg = self.func
@@ -274,10 +282,16 @@ class WindowExpression(Expression):
                     run = run[w.peer_end_pos]
                 out = run
             else:
-                # bounded ROWS frame: sparse-table sliding min/max
-                # (GpuWindowExec.scala:2004 double-pass regime analog)
-                lo_pos, hi_pos = W.rows_positions(w, frame.lo, frame.hi)
-                max_width = (frame.hi - frame.lo + 1)
+                # bounded ROWS/RANGE frame: sparse-table sliding min/max
+                # (GpuWindowExec.scala:2004/1655 regimes); range and
+                # half-unbounded widths are data-dependent, so the table
+                # builds to full capacity (log2(cap) doubling passes)
+                lo_pos, hi_pos = self._bounded_positions(w, ectx)
+                if frame.kind == "rows" and frame.lo is not None \
+                        and frame.hi is not None:
+                    max_width = frame.hi - frame.lo + 1
+                else:
+                    max_width = w.capacity
                 out = W.sliding_minmax(w, d, m, lo_pos, hi_pos,
                                        max_width, fname)
             cnt = self._framed_sum(w, frame, m.astype(jnp.int64), ectx)
@@ -297,9 +311,10 @@ class WindowExpression(Expression):
                 run = run[w.peer_end_pos]
             return run
         if frame.kind == "range":
-            kd, kv = self._range_order_key(w, ectx)
-            lo_pos, hi_pos = W.range_positions(w, kd, kv, frame.lo,
-                                               frame.hi)
+            kd, kv, desc, nf, wide = self._range_order_key(w, ectx)
+            lo_pos, hi_pos = W.range_positions(
+                w, kd, kv, frame.lo, frame.hi, descending=desc,
+                nulls_first=nf, wide=wide)
             return W.positional_sum(w, contrib, lo_pos, hi_pos)
         return W.sliding_sum(w, contrib, frame.lo, frame.hi)
 
@@ -315,6 +330,25 @@ class WindowExpression(Expression):
             out = d[pos]
             valid = (~empty) if v is None else (v[pos] & ~empty)
             return out, valid
+        if ignore_nulls and not frame.is_unbounded_both \
+                and not frame.is_running:
+            # bounded frame, ignoring nulls: first = next valid position
+            # at/after lo_pos (reverse running-min of valid indices),
+            # last = previous valid position at/before hi_pos
+            lo_pos, hi_pos = self._bounded_positions(w, ectx)
+            idx = w.arange
+            cap = w.capacity
+            if fname == "first":
+                nv = jnp.flip(jax.lax.cummin(
+                    jnp.flip(jnp.where(m, idx, cap))))
+                pos = nv[jnp.clip(lo_pos, 0, cap - 1)]
+                has = (pos <= hi_pos) & (hi_pos >= lo_pos)
+            else:
+                pv = jax.lax.cummax(jnp.where(m, idx, -1))
+                pos = pv[jnp.clip(hi_pos, 0, cap - 1)]
+                has = (pos >= lo_pos) & (hi_pos >= lo_pos)
+            safe = jnp.clip(pos, 0, cap - 1)
+            return d[safe], has
         if ignore_nulls:
             idx = w.arange
             if fname == "first":
@@ -374,21 +408,19 @@ def device_support_reason(wexpr: WindowExpression) -> Optional[str]:
             return f"window aggregate {func.func} not on device"
         if frame.is_unbounded_both or frame.is_running:
             return None
-        ignore_nulls = getattr(func, "ignore_nulls", False)
         if frame.kind == "rows":
-            if func.func in ("sum", "count", "count(*)", "avg"):
-                return None
-            if func.func in ("min", "max"):
-                if frame.lo is not None and frame.hi is not None:
-                    return None  # sparse-table sliding min/max
-                return ("half-unbounded sliding min/max frame "
-                        "(CPU fallback)")
-            if func.func in ("first", "last") and not ignore_nulls:
+            # every bounded/half-unbounded ROWS regime is on device:
+            # sum/count/avg via prefix sums, min/max via sparse-table RMQ
+            # (capacity-wide for half-unbounded), first/last via frame
+            # boundaries or valid-position scans (ignore nulls)
+            if func.func in ("sum", "count", "count(*)", "avg", "min",
+                             "max", "first", "last"):
                 return None
             return (f"frame {frame.fingerprint()} for {func.func} "
                     f"(CPU fallback)")
-        # bounded value-RANGE frame: single ascending non-nullable
-        # int32-representable order key → composite searchsorted positions
+        # bounded value-RANGE frame: single integer-representable order
+        # key -> composite searchsorted (int32/date packed, bigint/
+        # timestamp lexicographic); asc/desc and either null order
         ob = wexpr.spec.order_by
         if len(ob) != 1:
             return "bounded range frame needs exactly one order key"
@@ -397,16 +429,12 @@ def device_support_reason(wexpr: WindowExpression) -> Optional[str]:
         import spark_rapids_tpu.types as _T
         ok_type = dt is not None and dt.kind in (
             _T.TypeKind.INT8, _T.TypeKind.INT16, _T.TypeKind.INT32,
-            _T.TypeKind.DATE)
+            _T.TypeKind.DATE, _T.TypeKind.INT64, _T.TypeKind.TIMESTAMP)
         if not ok_type:
             return (f"bounded range frame over {dt} order key (needs an "
-                    f"int32-representable ascending key; CPU fallback)")
-        if not o.ascending:
-            return "bounded range frame over a descending key (CPU)"
-        if not getattr(o, "nulls_first", True):
-            return "bounded range frame with NULLS LAST ordering (CPU)"
-        if func.func in ("sum", "count", "count(*)", "avg") or (
-                func.func in ("first", "last") and not ignore_nulls):
+                    f"integer-representable key; CPU fallback)")
+        if func.func in ("sum", "count", "count(*)", "avg", "min", "max",
+                         "first", "last"):
             return None
         return (f"bounded range frame for {func.func} (CPU fallback)")
     return f"unknown window function {type(func).__name__}"

@@ -1,0 +1,210 @@
+"""Higher-order array functions (higherOrderFunctions.scala:291) and MAP
+type operations (complexTypeCreator.scala:84 GpuCreateMap,
+complexTypeExtractors.scala, collectionOperations.scala), differential
+against python oracles."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess(fresh_session):
+    return fresh_session
+
+
+def _arr_df(sess):
+    t = pa.table({
+        "a": pa.array([[1, 2, 3], [], None, [4, None, 6], [7]],
+                      type=pa.list_(pa.int64())),
+        "b": pa.array([[10, 20], [30], [40], None, [50, 60, 70]],
+                      type=pa.list_(pa.int64())),
+        "base": pa.array([100, 200, 300, 400, 500], type=pa.int64()),
+    })
+    return sess.create_dataframe(t)
+
+
+class TestHigherOrder:
+    def test_transform(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.transform(F.col("a"), lambda x: x * 2)
+                         .alias("o")).collect()]
+        assert got == [[2, 4, 6], [], None, [8, None, 12], [14]]
+
+    def test_transform_with_index(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.transform(F.col("a"), lambda x, i: x + i)
+                         .alias("o")).collect()]
+        assert got == [[1, 3, 5], [], None, [4, None, 8], [7]]
+
+    def test_transform_captures_outer_column(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.transform(F.col("a"),
+                                     lambda x: x + F.col("base"))
+                         .alias("o")).collect()]
+        assert got == [[101, 102, 103], [], None, [404, None, 406], [507]]
+
+    def test_filter(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.filter(F.col("a"), lambda x: x > 2)
+                         .alias("o")).collect()]
+        assert got == [[3], [], None, [4, 6], [7]]
+
+    def test_exists_three_valued(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.exists(F.col("a"), lambda x: x > 5)
+                         .alias("o")).collect()]
+        # row 3: [4, None, 6] -> True (6>5); row 0: all false -> False
+        assert got == [False, False, None, True, True]
+        got2 = [r[0] for r in
+                df.select(F.exists(F.col("a"), lambda x: x > 4)
+                          .alias("o")).collect()]
+        # [4, None, 6]: 6>4 True
+        assert got2[3] is True
+
+    def test_exists_null_makes_unknown(self, sess):
+        t = pa.table({"a": pa.array([[1, None, 2]],
+                                    type=pa.list_(pa.int64()))})
+        df = sess.create_dataframe(t)
+        got = df.select(F.exists(F.col("a"), lambda x: x > 5)
+                        .alias("o")).collect()
+        assert got[0][0] is None  # no TRUE, one NULL -> NULL
+
+    def test_forall(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.forall(F.col("a"), lambda x: x > 0)
+                         .alias("o")).collect()]
+        # [] -> True (vacuous); [4,None,6] -> NULL (no false, one null)
+        assert got == [True, True, None, None, True]
+
+    def test_aggregate_fold(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.aggregate(F.col("a"), F.lit(0),
+                                     lambda acc, x: acc + x)
+                         .alias("o")).collect()]
+        assert got[0] == 6 and got[1] == 0 and got[2] is None
+        assert got[4] == 7
+
+    def test_aggregate_with_finish(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.aggregate(F.col("b"), F.lit(0),
+                                     lambda acc, x: acc + x,
+                                     lambda acc: acc * 10)
+                         .alias("o")).collect()]
+        assert got == [300, 300, 400, None, 1800]
+
+    def test_zip_with(self, sess):
+        df = _arr_df(sess)
+        got = [r[0] for r in
+               df.select(F.zip_with(F.col("a"), F.col("b"),
+                                    lambda x, y: x + y)
+                         .alias("o")).collect()]
+        assert got[0] == [11, 22, None]  # b shorter: null-padded
+        assert got[1] == [None]
+        assert got[2] is None and got[3] is None
+        assert got[4] == [57, None, None]
+
+    def test_transform_strings(self, sess):
+        t = pa.table({"s": pa.array([["ab", "c"], ["de"]],
+                                    type=pa.list_(pa.string()))})
+        df = sess.create_dataframe(t)
+        got = [r[0] for r in
+               df.select(F.transform(
+                   F.col("s"), lambda x: F.upper(x)).alias("o"))
+               .collect()]
+        assert got == [["AB", "C"], ["DE"]]
+
+    def test_hof_in_filter_predicate(self, sess):
+        df = _arr_df(sess)
+        got = df.filter(F.exists(F.col("a"), lambda x: x == 7)).collect()
+        assert len(got) == 1 and got[0][2] == 500
+
+
+class TestMap:
+    def _map_df(self, sess):
+        t = pa.table({
+            "m": pa.array([[("a", 1), ("b", 2)], [], None,
+                           [("c", 3), ("d", None)]],
+                          type=pa.map_(pa.string(), pa.int64())),
+            "k": pa.array(["a", "x", "a", "d"]),
+        })
+        return sess.create_dataframe(t)
+
+    def test_map_roundtrip_and_keys_values(self, sess):
+        df = self._map_df(sess)
+        rows = df.select(F.map_keys(F.col("m")).alias("ks"),
+                         F.map_values(F.col("m")).alias("vs")).collect()
+        assert rows[0] == (["a", "b"], [1, 2])
+        assert rows[1] == ([], [])
+        assert rows[2] == (None, None)
+        assert rows[3][0] == ["c", "d"]
+
+    def test_element_at_map(self, sess):
+        df = self._map_df(sess)
+        got = [r[0] for r in
+               df.select(F.element_at(F.col("m"), F.col("k"))
+                         .alias("o")).collect()]
+        assert got == [1, None, None, None]
+
+    def test_create_map_and_concat(self, sess):
+        t = pa.table({"x": pa.array([1, 2], type=pa.int64()),
+                      "y": pa.array([10.0, 20.0])})
+        df = sess.create_dataframe(t)
+        rows = df.select(
+            F.map_concat(F.create_map(F.lit("x"), F.col("x")),
+                         F.create_map(F.lit("x"), F.col("x") + 100,
+                                      F.lit("z"), F.lit(9)))
+            .alias("m")).collect()
+        # duplicate key: last wins
+        assert dict(rows[0][0]) == {"x": 101, "z": 9}
+        assert dict(rows[1][0]) == {"x": 102, "z": 9}
+
+    def test_map_from_arrays_entries_roundtrip(self, sess):
+        t = pa.table({
+            "ks": pa.array([["p", "q"], ["r"]],
+                           type=pa.list_(pa.string())),
+            "vs": pa.array([[1, 2], [3]], type=pa.list_(pa.int64())),
+        })
+        df = sess.create_dataframe(t)
+        rows = df.select(
+            F.map_entries(F.map_from_arrays(F.col("ks"), F.col("vs")))
+            .alias("e")).collect()
+        assert rows[0][0] == [{"key": "p", "value": 1},
+                              {"key": "q", "value": 2}]
+        assert rows[1][0] == [{"key": "r", "value": 3}]
+
+    def test_map_filter_transform(self, sess):
+        df = self._map_df(sess)
+        rows = df.select(
+            F.map_filter(F.col("m"), lambda k, v: v > 1).alias("f"),
+            F.transform_values(F.col("m"),
+                               lambda k, v: v * 10).alias("tv")).collect()
+        assert dict(rows[0][0]) == {"b": 2}
+        assert dict(rows[0][1]) == {"a": 10, "b": 20}
+        assert rows[2][0] is None
+        assert dict(rows[3][1]) == {"c": 30, "d": None}
+
+    def test_transform_keys(self, sess):
+        df = self._map_df(sess)
+        rows = df.select(
+            F.transform_keys(F.col("m"),
+                             lambda k, v: F.concat(k, F.lit("!")))
+            .alias("tk")).collect()
+        assert dict(rows[0][0]) == {"a!": 1, "b!": 2}
+
+    def test_group_by_map_values_pipeline(self, sess):
+        """MAP columns survive project/filter pipelines."""
+        df = self._map_df(sess)
+        got = (df.filter(F.col("m").is_not_null())
+               .select(F.size(F.col("m")).alias("n")).collect())
+        assert [r[0] for r in got] == [2, 0, 2]

@@ -101,17 +101,133 @@ def test_bounded_range_sum_avg_count_on_device(sess, rng):
         assert abs(a_ - ws[(k, tt)] / wc[(k, tt)]) < 1e-9
 
 
-def test_bounded_range_minmax_falls_back_correctly(sess, rng):
-    """min over a bounded range frame is the declared CPU regime — the
-    fallback must produce the right answer."""
+def test_bounded_range_minmax_on_device(sess, rng):
+    """min/max over a bounded range frame: capacity-wide sparse-table RMQ
+    over composite-searchsorted positions (GpuWindowExec.scala:1655)."""
     t = _data(rng, n=150)
     w = Window.partition_by("k").order_by("t").range_between(-4, 4)
-    df = sess.create_dataframe(t).select(
-        F.col("k"), F.col("t"), F.min(F.col("v")).over(w).alias("m"))
-    rows = df.collect()
-    want = _oracle(t, "range", min, -4, 4, range_frame=True)
-    for k, tt, m in rows:
-        assert m == want[(k, tt)]
+    sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", True)
+    try:
+        df = sess.create_dataframe(t).select(
+            F.col("k"), F.col("t"), F.min(F.col("v")).over(w).alias("m"),
+            F.max(F.col("v")).over(w).alias("x"))
+        rows = df.collect()
+    finally:
+        sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", False)
+    wmin = _oracle(t, "range", min, -4, 4, range_frame=True)
+    wmax = _oracle(t, "range", max, -4, 4, range_frame=True)
+    for k, tt, m, x in rows:
+        assert m == wmin[(k, tt)] and x == wmax[(k, tt)]
+
+
+def test_half_unbounded_rows_minmax_on_device(sess, rng):
+    t = _data(rng, n=150)
+    for lo, hi in [(None, 2), (-3, None)]:
+        spec = Window.partition_by("k").order_by("t")
+        w = spec.rows_between(
+            Window.unboundedPreceding if lo is None else lo,
+            Window.unboundedFollowing if hi is None else hi)
+        sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", True)
+        try:
+            df = sess.create_dataframe(t).select(
+                F.col("k"), F.col("t"),
+                F.min(F.col("v")).over(w).alias("m"))
+            rows = df.collect()
+        finally:
+            sess.conf.set(
+                "spark.rapids.tpu.test.validateExecsOnTpu", False)
+        want = _oracle(t, "rows", min, lo if lo is not None else -10**6,
+                       hi if hi is not None else 10**6)
+        for k, tt, m in rows:
+            assert m == want[(k, tt)], (lo, hi, k, tt)
+
+
+def test_descending_range_key_on_device(sess, rng):
+    """RANGE frame over a DESCENDING key: preceding adds to the key
+    (Spark desc-range semantics), mapped onto the ascending kernel by
+    negation."""
+    t = _data(rng, n=150)
+    w = (Window.partition_by("k").order_by(F.col("t").desc())
+         .range_between(-4, 2))
+    sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", True)
+    try:
+        df = sess.create_dataframe(t).select(
+            F.col("k"), F.col("t"),
+            F.sum(F.col("v")).over(w).alias("s"),
+            F.max(F.col("v")).over(w).alias("x"))
+        rows = df.collect()
+    finally:
+        sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", False)
+    ks = t.column("k").to_pylist()
+    ts = t.column("t").to_pylist()
+    vs = t.column("v").to_pylist()
+    for k, tt, s, x in rows:
+        js = [j for j in range(len(ks))
+              if ks[j] == k and -4 <= tt - ts[j] <= 2]
+        vals = [vs[j] for j in js]
+        assert s == sum(vals) and x == max(vals), (k, tt)
+
+
+def test_int64_range_key_on_device(sess, rng):
+    """64-bit range keys take the lexicographic-search path (no packed
+    composite exists for bigint/timestamp)."""
+    n = 150
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 4, n).astype(np.int64)),
+        "t": pa.array((np.arange(n) * (1 << 33)).astype(np.int64)),
+        "v": pa.array(rng.integers(-50, 50, n).astype(np.int64)),
+    })
+    lo, hi = -(3 << 33), (2 << 33)
+    w = Window.partition_by("k").order_by("t").range_between(lo, hi)
+    sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", True)
+    try:
+        df = sess.create_dataframe(t).select(
+            F.col("k"), F.col("t"),
+            F.sum(F.col("v")).over(w).alias("s"),
+            F.min(F.col("v")).over(w).alias("m"))
+        rows = df.collect()
+    finally:
+        sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", False)
+    ks = t.column("k").to_pylist()
+    ts = t.column("t").to_pylist()
+    vs = t.column("v").to_pylist()
+    for k, tt, s, m in rows:
+        vals = [vs[j] for j in range(n)
+                if ks[j] == k and lo <= ts[j] - tt <= hi]
+        assert s == sum(vals) and m == min(vals), (k, tt)
+
+
+def test_ignore_nulls_bounded_first_last_on_device(sess, rng):
+    n = 200
+    vals = [None if i % 3 == 0 else int(v)
+            for i, v in enumerate(rng.integers(-50, 50, n))]
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 4, n).astype(np.int64)),
+        "t": pa.array(np.arange(n, dtype=np.int32)),
+        "v": pa.array(vals, type=pa.int64()),
+    })
+    w = Window.partition_by("k").order_by("t").rows_between(-3, 3)
+    sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", True)
+    try:
+        df = sess.create_dataframe(t).select(
+            F.col("k"), F.col("t"),
+            F.first(F.col("v"), ignore_nulls=True).over(w).alias("f"),
+            F.last(F.col("v"), ignore_nulls=True).over(w).alias("l"))
+        rows = df.collect()
+    finally:
+        sess.conf.set("spark.rapids.tpu.test.validateExecsOnTpu", False)
+    ks = t.column("k").to_pylist()
+    ts = t.column("t").to_pylist()
+    order = sorted(range(n), key=lambda i: (ks[i], ts[i]))
+    pos = {i: p for p, i in enumerate(order)}
+    for k, tt, f, l in rows:
+        i = next(j for j in range(n) if ks[j] == k and ts[j] == tt)
+        p = pos[i]
+        js = [order[q] for q in range(max(0, p - 3), p + 4)
+              if q < n and ks[order[q]] == k]
+        vv = [vals[j] for j in js if vals[j] is not None]
+        assert f == (vv[0] if vv else None), (k, tt)
+        assert l == (vv[-1] if vv else None), (k, tt)
 
 
 def test_asymmetric_rows_frames(sess, rng):
