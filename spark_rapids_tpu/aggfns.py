@@ -45,25 +45,53 @@ def _valid_indicator(v: Optional[jax.Array], ctx: EvalContext) -> jax.Array:
 
 
 class Sum(AggregateExpression):
+    """SUM with Spark result types: decimal(p,s) → decimal(min(38,p+10),s)
+    (TypeChecks.scala:626 DECIMAL_128, decimalExpressions.scala).  Wide
+    results (precision > 18) accumulate ON DEVICE as two int64 limbs of
+    the scaled value (lo 32 bits / hi bits sum separately, each safe for
+    2^31 rows) and reconstruct EXACTLY on the host at finalize — python
+    ints are arbitrary precision, so no 128-bit device kernel is needed;
+    overflow past the result precision raises under ANSI, else NULL."""
+
     func = "sum"
+    output_sig = (T.TypeSig.device_compute
+                  + T.TypeSig((T.TypeKind.DECIMAL,),
+                              max_decimal_precision=38))
 
     def _resolve(self):
         c = self.children[0].dtype
+        self._wide = False
         if c.is_integral or c.kind == T.TypeKind.BOOLEAN:
             self.dtype = T.INT64
         elif c.is_floating:
             self.dtype = T.FLOAT64
         elif c.is_decimal:
-            self.dtype = T.decimal(min(c.precision + 10, 18), c.scale)
+            rp = min(c.precision + 10, 38)
+            self.dtype = T.decimal(rp, c.scale)
+            self._wide = rp > 18
         else:
             raise TypeError(f"sum of {c} not supported")
         self.nullable = True
 
+    @property
+    def host_finalize(self) -> bool:
+        return getattr(self, "_wide", False)
+
     def buffers(self):
+        if getattr(self, "_wide", False):
+            return [(T.INT64, "sum"), (T.INT64, "sum"), (T.INT64, "sum")]
         return [(self.dtype, "sum"), (T.INT64, "sum")]
 
     def update(self, ctx) -> List[Value]:
         d, v = self.children[0].eval(ctx)
+        if getattr(self, "_wide", False):
+            d = d.astype(jnp.int64)  # scaled ints (input precision <= 18)
+            if v is not None:
+                d = jnp.where(v, d, jnp.zeros_like(d))
+            hi = d >> jnp.int64(32)
+            lo = d - (hi << jnp.int64(32))  # in [0, 2^32)
+            return [(lo, None), (hi, None), (_valid_indicator(v, ctx),
+                                             None)]
         d = d.astype(self.dtype.numpy_dtype)
         if v is not None:
             d = jnp.where(v, d, jnp.zeros_like(d))
@@ -72,6 +100,29 @@ class Sum(AggregateExpression):
     def finalize(self, values: List[Value]) -> Value:
         (s, _), (cnt, _) = values
         return s, cnt > 0
+
+    def finalize_host(self, buffers, n_rows: int, ansi: bool):
+        """Exact host reconstruction of wide sums: arrow decimal128.
+        Vectorized in object space — python ints are arbitrary precision,
+        so (hi << 32) + lo is exact past int64."""
+        import decimal as _dec
+
+        import numpy as np
+        import pyarrow as pa
+        lo, hi, cnt = [np.asarray(b[0][:n_rows]) for b in buffers]
+        totals = (hi.astype(object) << 32) + lo.astype(object)
+        bound = 10 ** self.dtype.precision
+        over = np.array([abs(t) >= bound for t in totals]) & (cnt > 0)
+        if ansi and over.any():
+            raise OverflowError(
+                f"sum overflowed decimal({self.dtype.precision},"
+                f"{self.dtype.scale}) (ANSI mode)")
+        scale = self.dtype.scale
+        out = [None if (cnt[i] <= 0 or over[i])
+               else _dec.Decimal(int(totals[i])).scaleb(-scale)
+               for i in range(n_rows)]
+        return pa.array(out, type=pa.decimal128(self.dtype.precision,
+                                                self.dtype.scale))
 
 
 class Count(AggregateExpression):

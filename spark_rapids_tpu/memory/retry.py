@@ -101,8 +101,14 @@ def split_in_half(batch: ColumnBatch) -> List[ColumnBatch]:
         raise SplitAndRetryOOM(
             f"cannot split a {b.num_rows}-row batch further")
     mid = b.num_rows // 2
-    return [batch_utils.slice_batch(b, 0, mid),
-            batch_utils.slice_batch(b, mid, b.num_rows - mid)]
+    halves = [batch_utils.slice_batch(b, 0, mid),
+              batch_utils.slice_batch(b, mid, b.num_rows - mid)]
+    # batch-context metadata (input_file_name) survives the split
+    origin = getattr(batch, "origin_file", None)
+    if origin is not None:
+        for h in halves:
+            h.origin_file = origin
+    return halves
 
 
 def with_retry(ctx, batch: ColumnBatch, fn: Callable[[ColumnBatch], object],

@@ -1,0 +1,95 @@
+"""Batch-context expressions: monotonically_increasing_id,
+spark_partition_id, input_file_name.
+
+Reference: GpuMonotonicallyIncreasingID / GpuSparkPartitionID
+(randomExpressions/partitioning misc) and GpuInputFileName with its
+InputFileBlockRule.scala planning constraint.  These read per-BATCH state
+(row offset, partition ordinal, originating file) that pure expressions
+cannot see, so they evaluate on the host-lowering path (plan/stringpred)
+against a thread-local batch context the stage executor sets — the same
+pattern the ANSI flag uses.
+
+Semantics mirror Spark:
+  * monotonically_increasing_id(): int64 ``(partition_id << 33) +
+    row_position`` — unique and increasing within a partition, NOT
+    consecutive (filtered slots keep their ids).
+  * spark_partition_id(): the partition ordinal (0 in a single-process
+    session; the DCN rank on multi-host runs).
+  * input_file_name(): the file backing the current batch, or '' when
+    the batch is not directly above a scan (Spark's InputFileBlockRule
+    declines those plans to the CPU; here the value degrades to '' the
+    same way it does for non-file sources).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from . import types as T
+from .exprs import Expression, Value
+
+__all__ = ["MonotonicallyIncreasingID", "SparkPartitionID",
+           "InputFileName", "batch_context", "set_batch_context"]
+
+_TL = threading.local()
+
+
+def set_batch_context(row_base: int = 0, partition_id: int = 0,
+                      file_name: str = "") -> None:
+    _TL.ctx = {"row_base": int(row_base), "partition_id": int(partition_id),
+               "file_name": file_name or ""}
+
+
+def batch_context() -> dict:
+    return getattr(_TL, "ctx", None) or {
+        "row_base": 0, "partition_id": 0, "file_name": ""}
+
+
+class BatchContextExpression(Expression):
+    """Marker base: evaluated per batch on the host path (nondeterministic
+    in Spark's sense — the optimizer must not reorder filters past them,
+    which plan/optimizer's _deterministic denylist enforces)."""
+
+    def __init__(self):
+        self.children = ()
+
+    def references(self):
+        return set()
+
+
+class MonotonicallyIncreasingID(BatchContextExpression):
+    def __init__(self):
+        super().__init__()
+        self.dtype = T.INT64
+        self.nullable = False
+
+    def eval_host(self, ev, n) -> Value:
+        c = batch_context()
+        base = (np.int64(c["partition_id"]) << np.int64(33)) \
+            + np.int64(c["row_base"])
+        return base + np.arange(n, dtype=np.int64), None
+
+
+class SparkPartitionID(BatchContextExpression):
+    def __init__(self):
+        super().__init__()
+        self.dtype = T.INT32
+        self.nullable = False
+
+    def eval_host(self, ev, n) -> Value:
+        c = batch_context()
+        return np.full(n, c["partition_id"], dtype=np.int32), None
+
+
+class InputFileName(BatchContextExpression):
+    def __init__(self):
+        super().__init__()
+        self.dtype = T.STRING
+        self.nullable = False
+
+    def eval_host(self, ev, n) -> Value:
+        c = batch_context()
+        return (np.array([c["file_name"]] * n, dtype=object),
+                np.ones(n, dtype=bool))

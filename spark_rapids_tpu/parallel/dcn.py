@@ -901,6 +901,9 @@ def run_distributed_query(df, pg: ProcessGroup,
     _check(top)
 
     ctx = ExecContext(conf, device=df.session.device)
+    # globally unique partition ordinals across ranks for
+    # spark_partition_id()/monotonically_increasing_id() (miscfns.py)
+    ctx.partition_id_base = pg.rank << 20
     tables = [to_arrow(b) for b in top.execute(ctx)]
     tables = [t for t in tables if t.num_rows > 0]
     local = pa.concat_tables(tables) if tables \

@@ -148,11 +148,14 @@ class ScanExec(TpuExec):
                 dkey = (token, min_cap, str(ctx.device))
                 hit = dcache.get(dkey)
                 if hit is not None:
+                    origin = str(getattr(source, "path", "") or "")
                     for b in hit:
                         m.add("numOutputRows", b.num_rows)
                         m.add("numOutputBatches", 1)
                         # fresh wrapper: callers can't perturb cached state
-                        yield _CB(b.schema, b.columns, b.num_rows, b.sel)
+                        out = _CB(b.schema, b.columns, b.num_rows, b.sel)
+                        out.origin_file = origin
+                        yield out
                     return
 
         # the accumulator pins batches in HBM until the scan completes, so
@@ -160,9 +163,11 @@ class ScanExec(TpuExec):
         # an over-budget scan must keep streaming/spilling, not OOM
         acc = [] if dcache is not None else None
         acc_bytes = 0
+        origin = str(getattr(source, "path", "") or "")
         for table in source():
             with m.time("scanTime"):
                 b = from_arrow(table, min_capacity=min_cap, device=ctx.device)
+            b.origin_file = origin
             m.add("numOutputRows", b.num_rows)
             m.add("numOutputBatches", 1)
             if acc is not None:
@@ -317,6 +322,16 @@ class StageExec(TpuExec):
         from ..cpu.eval import set_ansi
         from ..memory.retry import with_retry
 
+        # batch-context state for mid()/spark_partition_id()/
+        # input_file_name() (miscfns.py): per-partition row offsets when
+        # the child yields partitions, else one running stream.  The
+        # base advances inside run_one (per INVOCATION, not per input
+        # batch) so OOM split-retry halves draw disjoint id ranges —
+        # unique and increasing with gaps, which is all Spark promises.
+        partitioned = child.outputs_partitions
+        pid0 = getattr(ctx, "partition_id_base", 0)
+        bstate = {"row_base": 0, "pid": pid0}
+
         def run_one(b: ColumnBatch) -> ColumnBatch:
             arrays = []
             for i, (f_, c) in enumerate(zip(b.schema, b.columns)):
@@ -325,7 +340,14 @@ class StageExec(TpuExec):
             extras = []
             host_computed = {}
             if self.host_exprs:
+                from ..miscfns import set_batch_context
                 from .stringpred import evaluate_host_expr
+                base = bstate["row_base"]
+                bstate["row_base"] += b.num_rows
+                set_batch_context(
+                    row_base=base,
+                    partition_id=bstate["pid"],
+                    file_name=getattr(b, "origin_file", "") or "")
                 cap = b.capacity
                 set_ansi(ansi)
                 try:
@@ -396,6 +418,9 @@ class StageExec(TpuExec):
         for batch in child.execute(ctx):
             with m.time("opTime"):
                 outs = list(with_retry(ctx, batch, run_one))
+            if partitioned:
+                bstate["pid"] += 1
+                bstate["row_base"] = 0
             for out in outs:
                 m.add("numOutputRows", out.num_rows)
                 m.add("numOutputBatches", 1)
@@ -501,6 +526,7 @@ class AggregateExec(TpuExec):
         return n_keys
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        self._ansi = ctx.conf["spark.rapids.tpu.sql.ansi.enabled"]
         if self.group_exprs:
             yield from self._execute_grouped(ctx)
         else:
@@ -674,6 +700,8 @@ class AggregateExec(TpuExec):
                 i += nb
                 if mode == "partial":
                     outs.extend(buf_vals)
+                elif getattr(agg, "host_finalize", False):
+                    outs.extend(buf_vals)  # raw limbs: host reconstructs
                 else:
                     data, valid = agg.finalize(buf_vals)
                     data = jnp.broadcast_to(data, (cap,))
@@ -687,7 +715,7 @@ class AggregateExec(TpuExec):
             lambda: jax.jit(_fin))
         res = fin(tuple(acc))
 
-        cols: List[DeviceColumn] = []
+        cols: List = []
         fields = []
         oi = 0
         for (name, agg) in self.agg_exprs:
@@ -697,6 +725,18 @@ class AggregateExec(TpuExec):
                     oi += 1
                     fields.append(Field(f"{name}#buf{bi}", dt, True))
                     cols.append(DeviceColumn(dt, bd, bv))
+            elif getattr(agg, "host_finalize", False):
+                import pyarrow as pa
+                nb = len(agg.buffers())
+                bufs = res[oi: oi + nb]
+                oi += nb
+                arr = agg.finalize_host(list(bufs), 1,
+                                        getattr(self, "_ansi", False))
+                if len(arr) < cap:
+                    arr = pa.concat_arrays(
+                        [arr, pa.nulls(cap - len(arr), type=arr.type)])
+                fields.append(Field(name, agg.dtype, agg.nullable))
+                cols.append(HostStringColumn(arr))
             else:
                 data, valid = res[oi]
                 oi += 1
@@ -1180,6 +1220,9 @@ class AggregateExec(TpuExec):
                 i = n_keys
                 for name, agg in agg_exprs:
                     nb = len(agg.buffers())
+                    if getattr(agg, "host_finalize", False):
+                        i += nb
+                        continue  # finalized exactly on the host below
                     data, valid = agg.finalize(
                         [arrays[i + k] for k in range(nb)])
                     outs.append((data.astype(agg.dtype.numpy_dtype), valid))
@@ -1188,10 +1231,30 @@ class AggregateExec(TpuExec):
             return fin
 
         fin = _cached_program("agg-fin|" + self._fingerprint(), build)
-        fin_vals = fin(arrays)
-        cols: List[DeviceColumn] = list(pending.columns[:n_keys])
-        for (name, agg), (d, v) in zip(self.agg_exprs, fin_vals):
-            cols.append(DeviceColumn(agg.dtype, d, v))
+        fin_vals = list(fin(arrays))
+        cols: List = list(pending.columns[:n_keys])
+        oi = 0
+        bi = n_keys
+        for name, agg in self.agg_exprs:
+            nb = len(agg.buffers())
+            if getattr(agg, "host_finalize", False):
+                # wide-decimal (etc.) results: exact host reconstruction
+                # from the device buffer limbs into an arrow column
+                import pyarrow as pa
+                n = pending.num_rows
+                arr = agg.finalize_host(
+                    [arrays[bi + k] for k in range(nb)], n,
+                    getattr(self, "_ansi", False))
+                if len(arr) < pending.capacity:
+                    arr = pa.concat_arrays(
+                        [arr, pa.nulls(pending.capacity - len(arr),
+                                       type=arr.type)])
+                cols.append(HostStringColumn(arr))
+            else:
+                d, v = fin_vals[oi]
+                oi += 1
+                cols.append(DeviceColumn(agg.dtype, d, v))
+            bi += nb
         out = ColumnBatch(self._schema, cols, pending.num_rows, pending.sel)
         return self._decode_string_keys(out)
 

@@ -93,6 +93,11 @@ def lowerable_kind(e: E.Expression) -> Optional[str]:
         return None
     if _contains_udf(e):
         return None
+    from ..miscfns import BatchContextExpression
+    if isinstance(e, BatchContextExpression):
+        # mid()/spark_partition_id() feed the jit as typed extras;
+        # input_file_name() is a computed host string column
+        return "host" if e.dtype.is_host_carried else "device"
     if isinstance(e, (E.BoundReference, E.Literal)):
         return None  # plain refs/literals pass through; nothing to lower
 

@@ -295,6 +295,43 @@ class DataFrame:
 
     crossJoin = cross_join
 
+    def to_device_arrays(self) -> dict:
+        """Execute and return the result as DEVICE-resident jax arrays —
+        no host round trip (ColumnarRdd.scala:42-51 zero-copy ML-handoff
+        analog; the XGBoost-style consumer keeps working in HBM).
+
+        Returns ``{column: (data, valid)}`` with ``data`` a jax array of
+        the column's physical dtype (decimals as scaled ints, dates as
+        epoch days) and ``valid`` a bool mask or None.  Host-carried
+        columns (strings/nested) have no device representation and raise.
+        """
+        from ..batch import DeviceColumn
+        from ..ops import batch_utils
+        whole = self.session._execute_device(self._plan)
+        if whole is None:
+            return {f.name: None for f in self.schema}
+        out = {}
+        for f, c in zip(whole.schema, whole.columns):
+            if not isinstance(c, DeviceColumn):
+                raise TypeError(
+                    f"column {f.name!r} ({f.dtype}) is host-carried and "
+                    f"has no device representation; drop or encode it "
+                    f"before to_device_arrays()")
+            out[f.name] = (c.data[:whole.num_rows],
+                           None if c.valid is None
+                           else c.valid[:whole.num_rows])
+        return out
+
+    def to_dlpack(self) -> dict:
+        """Execute and export each device column as a DLPack capsule for
+        zero-copy handoff to other frameworks (torch/cupy-style
+        consumers; the ColumnarRdd interop surface).  jax arrays speak
+        the DLPack protocol natively (``__dlpack__``); this materializes
+        one capsule per column data/validity array."""
+        return {name: (d.__dlpack__(),
+                       None if v is None else v.__dlpack__())
+                for name, (d, v) in self.to_device_arrays().items()}
+
     # -- actions ------------------------------------------------------------------
     @property
     def write(self):

@@ -48,6 +48,25 @@ def col(name: str) -> Column:
     return Column(E.UnresolvedColumn(name))
 
 
+def monotonically_increasing_id() -> Column:
+    """int64 (partition_id << 33) + row_position — unique and
+    increasing, not consecutive (GpuMonotonicallyIncreasingID)."""
+    from ..miscfns import MonotonicallyIncreasingID
+    return Column(MonotonicallyIncreasingID())
+
+
+def spark_partition_id() -> Column:
+    from ..miscfns import SparkPartitionID
+    return Column(SparkPartitionID())
+
+
+def input_file_name() -> Column:
+    """The file backing the current batch, '' when not directly above a
+    file scan (GpuInputFileName + InputFileBlockRule degradation)."""
+    from ..miscfns import InputFileName
+    return Column(InputFileName())
+
+
 def scalar_subquery(df) -> Column:
     """A 1x1 subquery as an expression: executed at collect() time
     (recursively) and substituted as a literal — GpuScalarSubquery

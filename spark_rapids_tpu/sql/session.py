@@ -269,6 +269,29 @@ class Session:
         plan = resolve_subqueries(plan, self._collect_rows)
         return self._execute_resolved(plan)
 
+    def _execute_device(self, plan: L.LogicalPlan):
+        """Execute to ONE compacted device-resident batch (no host round
+        trip) — the zero-copy export pipeline (DataFrame.to_device_arrays).
+        Shares the same resolve/plan/distribute sequence as collect().
+        Concatenates sel-masked batches BEFORE compacting: one host sync
+        total instead of one per batch."""
+        from ..ops import batch_utils
+        from ..plan.physical import ExecContext
+        from ..plan.subquery import resolve_subqueries
+        from ..runtime.semaphore import get_semaphore
+        plan = resolve_subqueries(plan, self._collect_rows)
+        conf = self._tpu_conf()
+        phys = self._plan_physical(plan)
+        ctx = ExecContext(conf, device=self.device)
+        with get_semaphore(conf).acquire():
+            phys = self._distribute_if_ici(phys, ctx)
+            batches = [b for b in phys.execute(ctx) if b.num_rows > 0]
+            if not batches:
+                return None
+            whole = batches[0] if len(batches) == 1 else \
+                batch_utils.concat_batches(batches)
+            return batch_utils.compact(whole)
+
     def _execute_resolved(self, plan: L.LogicalPlan):
         from ..runtime.semaphore import get_semaphore
         conf = self._tpu_conf()

@@ -162,3 +162,60 @@ class TestFirstLastIgnoreNulls:
         out = (df.where(F.col("k") == 1)
                  .agg(F.first(F.col("v")).alias("f")).to_pandas())
         assert out["f"][0] is None or out["f"][0] != out["f"][0]
+
+
+class TestWideDecimalSum:
+    """SUM result precision min(38, p+10) with exact two-limb device
+    accumulation + host reconstruction (TypeChecks.scala:626 DECIMAL_128,
+    decimalExpressions.scala)."""
+
+    def _table(self, vals, precision=15, scale=2):
+        import decimal
+        return pa.table({"k": pa.array([i % 3 for i in range(len(vals))],
+                                       type=pa.int64()),
+                         "d": pa.array(
+            [None if v is None else decimal.Decimal(v) for v in vals],
+            type=pa.decimal128(precision, scale))})
+
+    def test_grouped_wide_sum_exact(self, fresh_session):
+        import decimal
+        sess = fresh_session
+        from spark_rapids_tpu.sql import functions as F
+        # values near the int64 edge: 9e12 each, 600 rows -> 5.4e15 per
+        # group (scaled x100 = 5.4e17, summed exactly through the limbs)
+        vals = ["9999999999999.99"] * 600
+        df = (sess.create_dataframe(self._table(vals))
+              .group_by("k").agg(F.sum(F.col("d")).alias("s")))
+        got = dict(df.collect())
+        each = decimal.Decimal("9999999999999.99")
+        assert got[0] == each * 200
+        assert got[1] == each * 200 and got[2] == each * 200
+
+    def test_ungrouped_wide_sum(self, fresh_session):
+        import decimal
+        sess = fresh_session
+        from spark_rapids_tpu.sql import functions as F
+        vals = ["123456789012345.67", "-0.67", None]
+        df = sess.create_dataframe(self._table(vals, precision=17)) \
+            .agg(F.sum(F.col("d")).alias("s"))
+        assert df.collect()[0][0] == decimal.Decimal("123456789012345.00")
+
+    def test_result_precision_is_spark(self, fresh_session):
+        sess = fresh_session
+        from spark_rapids_tpu.sql import functions as F
+        df = sess.create_dataframe(self._table(["1.00"])) \
+            .agg(F.sum(F.col("d")).alias("s"))
+        f = df.schema.fields[0]
+        assert f.dtype.precision == 25 and f.dtype.scale == 2  # 15+10
+
+    def test_two_phase_wide_sum(self, fresh_session):
+        import decimal
+        sess = fresh_session
+        from spark_rapids_tpu.sql import functions as F
+        sess.conf.set(
+            "spark.rapids.tpu.sql.agg.singleProcessComplete", False)
+        vals = ["8888888888888.88"] * 90
+        df = (sess.create_dataframe(self._table(vals))
+              .group_by("k").agg(F.sum(F.col("d")).alias("s")))
+        got = dict(df.collect())
+        assert got[0] == decimal.Decimal("8888888888888.88") * 30

@@ -1,0 +1,97 @@
+"""monotonically_increasing_id / spark_partition_id / input_file_name
+(GpuMonotonicallyIncreasingID, GpuSparkPartitionID, GpuInputFileName)
+and the zero-copy device export surface (ColumnarRdd.scala:42-51
+analog)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess(fresh_session):
+    return fresh_session
+
+
+class TestIdExpressions:
+    def test_mid_unique_increasing(self, sess, rng):
+        n = 5000
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 1024)
+        t = pa.table({"v": pa.array(rng.uniform(0, 1, n))})
+        rows = (sess.create_dataframe(t)
+                .select(F.monotonically_increasing_id().alias("id"),
+                        F.col("v")).collect())
+        ids = [r[0] for r in rows]
+        assert len(set(ids)) == n
+        assert ids == sorted(ids)
+
+    def test_mid_composes_with_device_exprs(self, sess, rng):
+        t = pa.table({"v": pa.array(np.arange(100, dtype=np.int64))})
+        rows = (sess.create_dataframe(t)
+                .select((F.monotonically_increasing_id() * 2
+                         + F.col("v") * 0).alias("x")).collect())
+        assert [r[0] for r in rows] == [2 * i for i in range(100)]
+
+    def test_spark_partition_id(self, sess, rng):
+        t = pa.table({"v": pa.array(np.arange(50, dtype=np.int64))})
+        rows = (sess.create_dataframe(t)
+                .select(F.spark_partition_id().alias("p")).collect())
+        assert all(r[0] == 0 for r in rows)
+
+    def test_input_file_name_over_scan(self, sess, tmp_path, rng):
+        p = str(tmp_path / "data.parquet")
+        pq.write_table(pa.table({"v": pa.array(np.arange(20))}), p)
+        rows = (sess.read_parquet(p)
+                .select(F.col("v"),
+                        F.input_file_name().alias("f")).collect())
+        assert all(r[1] == p for r in rows)
+
+    def test_input_file_name_degrades_off_scan(self, sess, rng):
+        t = pa.table({"v": pa.array(np.arange(10, dtype=np.int64))})
+        g = (sess.create_dataframe(t).group_by("v")
+             .agg(F.count_star().alias("c"))
+             .select(F.input_file_name().alias("f")))
+        assert all(r[0] == "" for r in g.collect())
+
+    def test_filter_not_pushed_past_mid(self, sess):
+        """The optimizer must not reorder filters past these
+        nondeterministic expressions."""
+        t = pa.table({"v": pa.array(np.arange(100, dtype=np.int64))})
+        df = (sess.create_dataframe(t)
+              .select(F.col("v"),
+                      F.monotonically_increasing_id().alias("id"))
+              .filter(F.col("id") < 10))
+        rows = df.collect()
+        assert sorted(r[0] for r in rows) == list(range(10))
+
+
+class TestDeviceExport:
+    def test_to_device_arrays_roundtrip(self, sess, rng):
+        import jax.numpy as jnp
+        n = 1000
+        t = pa.table({"k": pa.array(rng.integers(0, 7, n)),
+                      "v": pa.array(rng.uniform(0, 10, n))})
+        df = (sess.create_dataframe(t).group_by("k")
+              .agg(F.sum(F.col("v")).alias("s")))
+        arrs = df.to_device_arrays()
+        assert set(arrs) == {"k", "s"}
+        data, valid = arrs["s"]
+        # the arrays are live jax arrays: consume them without any host
+        # conversion in between
+        total = float(jnp.sum(data))
+        want = t.to_pandas().groupby("k")["v"].sum().sum()
+        assert abs(total - want) < 1e-9 * max(1.0, abs(want))
+
+    def test_to_device_arrays_rejects_host_columns(self, sess):
+        t = pa.table({"s": pa.array(["a", "b"])})
+        with pytest.raises(TypeError, match="host-carried"):
+            sess.create_dataframe(t).to_device_arrays()
+
+    def test_to_dlpack(self, sess, rng):
+        t = pa.table({"v": pa.array(rng.uniform(0, 1, 64))})
+        caps = sess.create_dataframe(t).to_dlpack()
+        d, v = caps["v"]
+        assert "dltensor" in repr(d) or d is not None
