@@ -825,6 +825,12 @@ class CpuOpExec(TpuExec):
             sel = np.zeros(len(lpd), dtype=bool)
             sel[li] = True
             return pa.Table.from_pandas(lpd[sel], preserve_index=False)
+        if how == "existence":
+            ex = np.zeros(len(lpd), dtype=bool)
+            ex[li] = True
+            out = lpd.copy()
+            out[p.schema().names()[-1]] = ex
+            return pa.Table.from_pandas(out, preserve_index=False)
         if how == "anti":
             sel = np.ones(len(lpd), dtype=bool)
             sel[li] = False

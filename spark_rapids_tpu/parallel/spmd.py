@@ -432,7 +432,7 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
 
     from ..plan.join_exec import BroadcastJoinExec
     if isinstance(node, BroadcastJoinExec):
-        if node.how == "cross":
+        if node.how in ("cross", "existence"):
             # nested-loop expansion has no bounded static shape; the join
             # materializes single-process (its exchanges — none — are moot)
             return _make_leaf(node, leaves)
@@ -464,7 +464,9 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
                      conf["spark.rapids.tpu.shuffle.ici.joinOutputRows"])
 
     if isinstance(node, SortMergeJoinExec):
-        if node.how == "cross":
+        if node.how in ("cross", "existence"):
+            # existence emits a match COLUMN, which _Join.emit's
+            # expansion does not model — run single-process
             return _make_leaf(node, leaves)
         if node.condition is not None and node.how != "inner":
             # see BroadcastJoinExec above: _Join.emit's post-expansion

@@ -149,6 +149,93 @@ class SortMergeJoinExec(TpuExec):
         evicted to host under memory pressure."""
         return materialize_whole(self.children[side], ctx)
 
+    def _inject_smj_filter(self, ctx, lh) -> None:
+        """Push the materialized LEFT side's key stats into the RIGHT
+        side's scan as runtime predicates.  Legal whenever right rows
+        that match no left key are never emitted (inner/left/semi/anti/
+        existence) — the exact-range/IN-list version of the reference's
+        bloom-filter join runtime filters
+        (GpuBloomFilterMightContain.scala)."""
+        conf = ctx.conf
+        if not conf["spark.rapids.tpu.sql.dpp.enabled"]:
+            return
+        lk, rk, common = self._bound_keys()
+        if len(common) != 1:
+            return
+        ct = common[0]
+        ik = _int_key_caster(ct)
+        try:
+            kind = np.dtype(ct.numpy_dtype).kind
+        except TypeError:
+            return
+        if kind not in "iu":
+            return
+        from ..exprs import BoundReference
+        from .planner import strip_alias
+        core = strip_alias(rk[0])
+        if not isinstance(core, BoundReference):
+            return
+        rname = self.children[1].output_schema.names()[core.ordinal]
+        target = _scan_origin(self.children[1], rname)
+        if target is None:
+            return
+        scan, scol = target
+        build = lh.get()
+        fp = self._fingerprint() + "|smjfilter"
+
+        def build_stats():
+            @jax.jit
+            def f(b_arrays, n_build):
+                b_cap = next(a[0].shape[0] for a in b_arrays
+                             if a is not None)
+                d, ok = _eval_int_key(lk[0], b_arrays, b_cap, n_build, ct,
+                                      ik)
+                big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
+                d64 = d.astype(jnp.int64)
+                kmin = jnp.min(jnp.where(ok, d64, big))
+                kmax = jnp.max(jnp.where(ok, d64, -big))
+                n_valid = jnp.sum(ok.astype(jnp.int64))
+                s = jnp.sort(jnp.where(ok, d64, big))
+                uniq = jnp.concatenate(
+                    [jnp.ones((1,), bool), s[1:] != s[:-1]])
+                n_distinct = jnp.sum((uniq & (s != big)).astype(jnp.int64))
+                return jnp.stack([kmin, kmax, n_valid, n_distinct])
+            return f
+
+        b_arrays = _dev_arrays(build)
+        b_arrays = encode_key_arrays(b_arrays, build, lk, self.string_dicts)
+        fn = _cached_program("smj-filter-stats|" + fp, build_stats)
+        kmin, kmax, n_valid, n_distinct = [
+            int(x) for x in np.asarray(fn(b_arrays,
+                                          np.int32(build.num_rows)))]
+        max_in = conf["spark.rapids.tpu.sql.dpp.maxInKeys"]
+        cap = bucket_capacity(max_in)
+
+        def values_fn():
+            def build_vals():
+                @jax.jit
+                def g(b_arrays, n_build):
+                    b_cap = next(a[0].shape[0] for a in b_arrays
+                                 if a is not None)
+                    d, ok = _eval_int_key(lk[0], b_arrays, b_cap, n_build,
+                                          ct, ik)
+                    big = jnp.array(np.iinfo(np.int64).max,
+                                    dtype=jnp.int64)
+                    s = jnp.sort(jnp.where(ok, d.astype(jnp.int64), big))
+                    uniq = jnp.concatenate(
+                        [jnp.ones((1,), bool), s[1:] != s[:-1]])
+                    u = jnp.sort(jnp.where(uniq, s, big))
+                    return u[:cap] if u.shape[0] >= cap else u
+                return g
+
+            gfn = _cached_program(f"smj-filter-vals|{fp}|{cap}",
+                                  build_vals)
+            vals = np.asarray(gfn(b_arrays, np.int32(build.num_rows)))
+            return vals[vals != np.iinfo(np.int64).max].tolist()
+
+        scan.runtime_predicates = _runtime_key_preds(
+            scol, ct, kmin, kmax, n_valid, n_distinct, conf, values_fn)
+
     # -- execution ----------------------------------------------------------------
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         m = ctx.metric_set(self.op_id)
@@ -175,6 +262,11 @@ class SortMergeJoinExec(TpuExec):
                 rgen.close()
             return
         lh = self._materialize(ctx, 0)
+        # runtime join filter (GpuBloomFilterMightContain analog, exact
+        # instead of probabilistic): once the left side materializes, its
+        # key range/IN-list prunes the right side's scan before it reads
+        if self.how in ("inner", "left", "semi", "anti", "existence"):
+            self._inject_smj_filter(ctx, lh)
         rh = self._materialize(ctx, 1)
         try:
             yield self._join_pair(ctx, m, lh.get(), rh.get())
@@ -243,7 +335,8 @@ class SortMergeJoinExec(TpuExec):
     def _join_pair(self, ctx, m, left: ColumnBatch,
                    right: ColumnBatch) -> ColumnBatch:
         if self.condition is not None and self.how in ("left", "semi",
-                                                       "anti"):
+                                                       "anti",
+                                                       "existence"):
             with m.time("opTime"):
                 out = self._conditioned_probe_join(left, right)
             m.add("numOutputRows", out.row_count())
@@ -336,6 +429,11 @@ class SortMergeJoinExec(TpuExec):
             sel = (surviving > 0) if how == "semi" else (surviving == 0)
             return ColumnBatch(self._schema, left.columns, left.num_rows,
                                sel & active)
+        if how == "existence":
+            exists = DeviceColumn(T.BOOLEAN, surviving > 0, None)
+            return ColumnBatch(self._schema,
+                               list(left.columns) + [exists],
+                               left.num_rows, left.sel)
         # left outer: surviving pairs + null-padded unmatched probes
         matched_out = ColumnBatch(self._schema, pair.columns, out_cap, keep)
         from ..batch import logical_to_arrow
@@ -391,7 +489,18 @@ class SortMergeJoinExec(TpuExec):
             return self._outer_join(left, right, probe_side=0)
         if how in ("semi", "anti"):
             return self._semi_anti(left, right)
+        if how == "existence":
+            return self._existence(left, right)
         raise NotImplementedError(f"join type {how}")
+
+    def _existence(self, left: ColumnBatch,
+                   right: ColumnBatch) -> ColumnBatch:
+        """ExistenceJoin (GpuHashJoin.scala ExistenceJoin handling): every
+        left row survives, plus a boolean column marking key matches."""
+        _, matches, _ = self._match_state(left, right, probe_side=0)
+        exists = DeviceColumn(T.BOOLEAN, matches > 0, None)
+        return ColumnBatch(self._schema, list(left.columns) + [exists],
+                           left.num_rows, left.sel)
 
     def _match_state(self, probe: ColumnBatch, build: ColumnBatch,
                      probe_side: int):
@@ -793,7 +902,7 @@ class BroadcastJoinExec(SortMergeJoinExec):
         how = self.how
         if how == "inner":
             pass  # either build side; a residual condition post-filters
-        elif how in ("left", "semi", "anti"):
+        elif how in ("left", "semi", "anti", "existence"):
             if self.build_side != 1 or self.condition is not None:
                 return False
         else:
@@ -806,7 +915,7 @@ class BroadcastJoinExec(SortMergeJoinExec):
     def _dense_payload_fields(self, build: ColumnBatch):
         """(field-index list into build.schema, or None when a needed
         payload column is host-carried)."""
-        if self.how in ("semi", "anti"):
+        if self.how in ("semi", "anti", "existence"):
             return []
         using = set(self.using)
         if self.build_side == 1:
@@ -961,6 +1070,8 @@ class BroadcastJoinExec(SortMergeJoinExec):
                     return matched, ()
                 if how == "anti":
                     return active & ~matched, ()
+                if how == "existence":
+                    return active, ((matched, None),)
                 safe_bi = jnp.clip(bi, 0, None)
                 cols = []
                 for bd, bv in payload:
@@ -980,6 +1091,14 @@ class BroadcastJoinExec(SortMergeJoinExec):
         if how in ("semi", "anti"):
             out = ColumnBatch(self._schema, probe.columns, probe.num_rows,
                               sel_out)
+            self._dense_metrics(m, out)
+            return out
+        if how == "existence":
+            md, _ = pay_cols[0]
+            exists = DeviceColumn(T.BOOLEAN, md, None)
+            out = ColumnBatch(self._schema,
+                              list(probe.columns) + [exists],
+                              probe.num_rows, sel_out)
             self._dense_metrics(m, out)
             return out
         build_cols = {}
@@ -1052,25 +1171,10 @@ class BroadcastJoinExec(SortMergeJoinExec):
             return
         scan, scol = target
         kmin, kmax, n_valid, dup = [int(x) for x in np.asarray(pending[2])]
-        is_date = ct.kind == T.TypeKind.DATE
-
-        def conv(v):
-            if is_date:
-                import datetime as _dt
-                return _dt.date(1970, 1, 1) + _dt.timedelta(days=int(v))
-            return int(v)
-
-        if n_valid == 0:
-            scan.runtime_predicates = [(scol, "in", [])]
-            return
-        preds = [(scol, ">=", conv(kmin)), (scol, "<=", conv(kmax))]
         max_in = conf["spark.rapids.tpu.sql.dpp.maxInKeys"]
-        n_distinct = n_valid - dup
-        if 0 < n_distinct <= max_in:
-            vals = self._dpp_distinct_values(build, pending[3], max_in)
-            if vals is not None:
-                preds = [(scol, "in", [conv(v) for v in vals])]
-        scan.runtime_predicates = preds
+        scan.runtime_predicates = _runtime_key_preds(
+            scol, ct, kmin, kmax, n_valid, n_valid - dup, conf,
+            lambda: self._dpp_distinct_values(build, pending[3], max_in))
 
     def _dpp_distinct_values(self, build, b_arrays, max_in):
         lk, rk, common = self._bound_keys()
@@ -1176,6 +1280,32 @@ def _float_orderable(d, ik):
     return jnp.where(b < 0, ~b, b | mn)
 
 
+def _runtime_key_preds(scol: str, ct, kmin: int, kmax: int,
+                       n_valid: int, n_distinct: int, conf,
+                       values_fn) -> list:
+    """Shared predicate construction for runtime join filters (DPP and
+    the SMJ bloom-filter analog): empty build short-circuits the scan,
+    small distinct sets push an exact IN-list, otherwise the key range.
+    ``values_fn() -> list`` supplies int key images lazily."""
+    is_date = ct.kind == T.TypeKind.DATE
+
+    def conv(v):
+        if is_date:
+            import datetime as _dt
+            return _dt.date(1970, 1, 1) + _dt.timedelta(days=int(v))
+        return int(v)
+
+    if n_valid == 0:
+        return [(scol, "in", [])]
+    preds = [(scol, ">=", conv(kmin)), (scol, "<=", conv(kmax))]
+    max_in = conf["spark.rapids.tpu.sql.dpp.maxInKeys"]
+    if 0 < n_distinct <= max_in and values_fn is not None:
+        vals = values_fn()
+        if vals is not None and len(vals) <= max_in:
+            preds = [(scol, "in", [conv(v) for v in vals])]
+    return preds
+
+
 def _scan_origin(node, out_name: str):
     """Trace an output column through Coalesce/Stage chains to the scan
     column it passes through from, or None when any step computes it.
@@ -1273,7 +1403,8 @@ def _legal_build_sides(how: str) -> tuple:
     """Sides that may be broadcast (must not be the row-preserving side).
     full outer never broadcasts; inner/cross are symmetric."""
     return {"inner": (1, 0), "cross": (1, 0), "left": (1,), "semi": (1,),
-            "anti": (1,), "right": (0,), "full": ()}[how]
+            "anti": (1,), "existence": (1,), "right": (0,),
+            "full": ()}[how]
 
 
 def plan_broadcast_join(plan, left: TpuExec, right: TpuExec, conf,

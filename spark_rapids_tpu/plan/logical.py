@@ -142,6 +142,13 @@ class Join(LogicalPlan):
         l, r = self.children[0].schema(), self.children[1].schema()
         if self.how in ("semi", "anti", "left_semi", "left_anti"):
             return l
+        if self.how == "existence":
+            # ExistenceJoin (Spark-internal, from IN/EXISTS inside
+            # disjunctions): left rows + a boolean match column
+            from .. import types as T
+            return Schema(list(l.fields)
+                          + [Field(getattr(self, "exists_col", "exists"),
+                                   T.BOOLEAN, False)])
         using = set(getattr(self, "using", []) or [])
         fields = list(l.fields)
         rf = [f for f in r.fields if f.name not in using]

@@ -82,6 +82,8 @@ def _rebuild_join(node: L.Join, left, right) -> L.Join:
                  how=node.how, condition=node.condition)
     if hasattr(node, "using"):
         out.using = node.using
+    if hasattr(node, "exists_col"):
+        out.exists_col = node.exists_col
     return _keep_hint(out, node)
 
 
@@ -225,7 +227,8 @@ def _push_filter_join(join: L.Join, conjs: List[E.Expression]
     lnames = set(join.children[0].schema().names())
     rnames = set(join.children[1].schema().names())
 
-    push_left_ok = how in ("inner", "cross", "left", "semi", "anti")
+    push_left_ok = how in ("inner", "cross", "left", "semi", "anti",
+                           "existence")
     push_right_ok = how in ("inner", "cross", "right", "semi")
 
     # key equivalence maps (simple column keys only)

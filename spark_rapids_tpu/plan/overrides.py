@@ -231,9 +231,11 @@ class NodeMeta:
             _tag_keys(p.right_keys, schema_r, "right")
             if p.how not in ("inner", "left", "left_outer", "right",
                              "right_outer", "full", "full_outer", "semi",
-                             "anti", "left_semi", "left_anti", "cross"):
+                             "anti", "left_semi", "left_anti", "cross",
+                             "existence"):
                 self.will_not_work(f"join type {p.how} not supported")
             cond_ok = ("inner", "left", "left_outer", "semi", "anti",
+                       "existence",
                        "left_semi", "left_anti")
             if p.condition is not None and p.how not in cond_ok:
                 self.will_not_work(

@@ -199,6 +199,8 @@ def _walk_impl(node: L.LogicalPlan, required: Optional[Set[str]],
                      how=node.how, condition=node.condition)
         if hasattr(node, "using"):
             out.using = node.using
+        if hasattr(node, "exists_col"):
+            out.exists_col = node.exists_col
         return out
 
     if isinstance(node, L.Union):

@@ -181,11 +181,40 @@ def _has_in_subquery(e: E.Expression) -> bool:
     if isinstance(e, E.In) and isinstance(getattr(e, "values", None),
                                           InSubqueryValues):
         return True
-    if isinstance(e, E.Not) and _has_in_subquery(e.children[0]):
-        return True
-    if isinstance(e, E.And):
-        return any(_has_in_subquery(c) for c in e.children)
-    return False
+    return any(_has_in_subquery(c) for c in e.children)
+
+
+def _extract_positive_markers(e: E.Expression, under_not: bool,
+                              acc: list) -> None:
+    """Collect IN-subquery markers in positive boolean context; a marker
+    under NOT inside a compound predicate has SQL NOT IN null semantics
+    an existence column cannot carry — raise instead of being wrong."""
+    if isinstance(e, E.In) and isinstance(getattr(e, "values", None),
+                                          InSubqueryValues):
+        if under_not:
+            raise NotImplementedError(
+                "negated IN (subquery) inside a compound predicate is "
+                "not supported (null semantics need null-aware "
+                "anti-join); rewrite with explicit joins")
+        acc.append(e)
+        return
+    for c in e.children:
+        _extract_positive_markers(c, under_not or isinstance(e, E.Not),
+                                  acc)
+
+
+def _substitute(e: E.Expression, mapping: dict) -> E.Expression:
+    if id(e) in mapping:
+        return mapping[id(e)]
+    if not e.children:
+        return e
+    kids = tuple(_substitute(c, mapping) for c in e.children)
+    if all(k is c for k, c in zip(kids, e.children)):
+        return e
+    import copy
+    out = copy.copy(e)
+    out.children = kids
+    return out
 
 
 def _conjuncts(e):
@@ -205,6 +234,8 @@ def _rewrite_in_filter(node: L.Filter, collect) -> L.LogicalPlan:
     """Filter with IN-subquery conjuncts -> semi/anti joins above the
     (recursively resolved) child, remaining conjuncts stay a Filter."""
     child = _walk(node.children[0], collect)
+    keep_names = [f.name for f in node.schema().fields]
+    n_existence = 0
     plain: List[E.Expression] = []
     out = child
     for ci, c in enumerate(_conjuncts(node.condition)):
@@ -245,9 +276,38 @@ def _rewrite_in_filter(node: L.Filter, collect) -> L.LogicalPlan:
                 j = L.Join(out, sub_proj, [key],
                            [E.UnresolvedColumn(alias)], how="semi")
             out = j
+        elif _has_in_subquery(c):
+            # markers inside a compound predicate (OR branches etc.):
+            # ExistenceJoin rewrite (GpuHashJoin ExistenceJoin /
+            # Spark RewritePredicateSubquery) — each positive marker
+            # becomes a boolean match column referenced by the predicate
+            markers: list = []
+            _extract_positive_markers(c, False, markers)
+            mapping = {}
+            for mk in markers:
+                sub = resolve_subqueries(mk.values.plan, collect)
+                sub_name = sub.schema().fields[0].name
+                ex_alias = f"__exists{ci}_{n_existence}"
+                n_existence += 1
+                sub_proj = L.Project(
+                    sub, [(f"__ex_key_{ex_alias}",
+                           E.UnresolvedColumn(sub_name))])
+                j = L.Join(out, sub_proj, [mk.children[0]],
+                           [E.UnresolvedColumn(f"__ex_key_{ex_alias}")],
+                           how="existence")
+                j.exists_col = ex_alias
+                out = j
+                mapping[id(mk)] = E.UnresolvedColumn(ex_alias)
+            plain.append(_substitute(c, mapping))
         else:
             plain.append(c)
     if plain:
         out = L.Filter(out, _and_all(plain))
-    return _map_exprs(out, lambda e: _resolve_scalar(e, collect)) \
-        if isinstance(out, L.Filter) else out
+        # resolve scalar subqueries in the remaining conjuncts BEFORE any
+        # Project wrap hides the Filter from the mapper
+        out = _map_exprs(out, lambda e: _resolve_scalar(e, collect))
+    if n_existence:
+        # drop the existence columns: restore the filter's schema
+        out = L.Project(out, [(n, E.UnresolvedColumn(n))
+                              for n in keep_names])
+    return out

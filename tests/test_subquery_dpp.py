@@ -188,3 +188,75 @@ class TestDPP:
         fpd = fact.to_pandas()
         want = fpd.loc[fpd.f_date.isin(dim_days), "f_val"].sum()
         assert got == pytest.approx(want)
+
+
+class TestExistenceJoin:
+    """ExistenceJoin (GpuHashJoin.scala ExistenceJoin handling): IN
+    subqueries inside disjunctions rewrite to a boolean match column."""
+
+    def test_in_subquery_inside_or(self, sess, rng):
+        t = pa.table({"k": pa.array(rng.integers(0, 40, 300)),
+                      "v": pa.array(rng.uniform(0, 1, 300))})
+        sub = sess.create_dataframe(
+            pa.table({"sk": pa.array([3, 7, 11], type=pa.int64())}))
+        df = sess.create_dataframe(t)
+        got = df.filter(F.col("k").isin_subquery(sub.select("sk"))
+                        | (F.col("v") > 0.9)).collect()
+        pdf = t.to_pandas()
+        want = pdf[pdf.k.isin([3, 7, 11]) | (pdf.v > 0.9)]
+        assert len(got) == len(want)
+        assert all(len(r) == 2 for r in got)  # exists column dropped
+
+    def test_two_in_subqueries_in_or(self, sess, rng):
+        t = pa.table({"a": pa.array(rng.integers(0, 30, 200)),
+                      "b": pa.array(rng.integers(0, 30, 200))})
+        s1 = sess.create_dataframe(
+            pa.table({"x": pa.array([1, 2], type=pa.int64())}))
+        s2 = sess.create_dataframe(
+            pa.table({"y": pa.array([25, 28], type=pa.int64())}))
+        df = sess.create_dataframe(t)
+        got = df.filter(F.col("a").isin_subquery(s1)
+                        | F.col("b").isin_subquery(s2)).collect()
+        pdf = t.to_pandas()
+        want = pdf[pdf.a.isin([1, 2]) | pdf.b.isin([25, 28])]
+        assert len(got) == len(want)
+
+    def test_negated_in_disjunction_raises(self, sess, rng):
+        t = pa.table({"k": pa.array(rng.integers(0, 10, 50))})
+        sub = sess.create_dataframe(
+            pa.table({"s": pa.array([1], type=pa.int64())}))
+        df = sess.create_dataframe(t)
+        with pytest.raises(NotImplementedError, match="negated IN"):
+            df.filter((~F.col("k").isin_subquery(sub))
+                      | (F.col("k") > 100)).collect()
+
+
+class TestSmjRuntimeFilter:
+    def test_shuffled_join_prunes_right_scan(self, sess, tmp_path, rng):
+        """The materialized left side's key stats prune the right side's
+        parquet scan (bloom-filter join runtime filter analog)."""
+        sess.conf.set("spark.rapids.tpu.sql.autoBroadcastJoinThreshold",
+                      -1)
+        try:
+            left = pa.table({
+                "lk": pa.array(rng.integers(100, 120, 500)),
+                "lv": pa.array(rng.uniform(0, 1, 500))})
+            right = pa.table({
+                "rk": pa.array(rng.integers(0, 1000, 40_000)),
+                "rv": pa.array(rng.uniform(0, 1, 40_000))})
+            rpath = str(tmp_path / "right.parquet")
+            pq.write_table(right, rpath, row_group_size=2000)
+            ldf = sess.create_dataframe(left)
+            rdf = sess.read_parquet(rpath)
+            q = (ldf.join(rdf, on=[("lk", "rk")])
+                 .agg(F.sum(F.col("rv")).alias("s"),
+                      F.count_star().alias("c")))
+            got = q.collect()[0]
+            lpd, rpd = left.to_pandas(), right.to_pandas()
+            m = lpd.merge(rpd, left_on="lk", right_on="rk")
+            assert got[1] == len(m)
+            assert got[0] == pytest.approx(m.rv.sum())
+        finally:
+            sess.conf.set(
+                "spark.rapids.tpu.sql.autoBroadcastJoinThreshold",
+                10 * 1024 * 1024)
