@@ -315,6 +315,28 @@ class ParquetSource:
                              _rename=self.rename,
                              _anti_rows=self.anti_rows)
 
+    def estimated_rows(self) -> Optional[int]:
+        """Row count from parquet footers minus positional deletes (post
+        partition-pruning file list; predicate and equality-delete
+        effects not modeled) — the planner's cardinality source
+        (CostBasedOptimizer.scala:284 statistics analog).  Memoized per
+        source; footer reads are serial, so tables with thousands of
+        remote files pay plan-time I/O here once."""
+        cached = getattr(self, "_est_rows", False)
+        if cached is not False:
+            return cached
+        try:
+            import pyarrow.parquet as pq
+            total = 0
+            for p in self.paths:
+                total += pq.ParquetFile(p).metadata.num_rows
+                # positional deletes (Delta DVs / Iceberg) are exact
+                total -= len(self.skip_rows.get(p, ()) or ())                     if getattr(self, "skip_rows", None) else 0
+        except Exception:
+            total = None
+        self._est_rows = total
+        return total
+
     def cache_token(self) -> Optional[tuple]:
         """Identity of this scan's output for the device-tier cache: files
         (path+mtime+size), projection, and pushed predicates."""

@@ -23,21 +23,25 @@ __all__ = ["apply_cbo", "estimate_rows"]
 def estimate_rows(node: L.LogicalPlan) -> Optional[float]:
     """Bottom-up row estimate; None = unknown."""
     if isinstance(node, L.LogicalScan):
-        src = getattr(node, "source", None)
+        # sources expose real statistics: parquet footer row counts,
+        # in-memory table sizes (the CostBasedOptimizer.scala:284
+        # cardinality source — no byte-size guessing, no closure
+        # introspection)
+        src = getattr(node, "source_factory", None)
+        est = getattr(src, "estimated_rows", None)
+        if est is not None:
+            n = est() if callable(est) else est
+            if n is not None:
+                return float(n)
         paths = getattr(src, "paths", None)
         if paths:
             try:
                 import os
                 total = sum(os.path.getsize(p) for p in paths)
-                # ~128 bytes/row for columnar parquet-ish data
+                # ~128 bytes/row for columnar data without footer stats
                 return max(1.0, total / 128.0)
             except OSError:
                 return None
-        factory = getattr(node, "source_factory", None)
-        for d in (getattr(factory, "__defaults__", None) or ()):
-            n = getattr(d, "num_rows", None)  # create_dataframe closure
-            if isinstance(n, int):
-                return float(n)
         return None
     if isinstance(node, L.LogicalRange):
         return max(0.0, (node.end - node.start) / max(1, node.step))

@@ -201,6 +201,7 @@ class Session:
             for off in range(0, t.num_rows, rows):
                 yield t.slice(off, min(rows, t.num_rows - off))
 
+        factory.estimated_rows = table.num_rows  # CBO/auto-broadcast stat
         node = L.LogicalScan(out_schema, factory, "local", fmt="memory")
         return DataFrame(node, self)
 
