@@ -115,6 +115,33 @@ _RANGE_OPS = (E.LessThan, E.LessThanOrEqual, E.GreaterThan,
               E.GreaterThanOrEqual, E.EqualTo, E.In, E.IsNotNull)
 
 
+def _disjuncts(e: E.Expression) -> List[E.Expression]:
+    if isinstance(e, E.Or):
+        return _disjuncts(e.children[0]) + _disjuncts(e.children[1])
+    return [e]
+
+
+def _derive_side_predicate(c: E.Expression,
+                           names: set) -> Optional[E.Expression]:
+    """From a disjunction, the OR of each branch's side-only conjuncts —
+    None when any branch has no conjunct on this side (then no side
+    condition is implied)."""
+    branches = _disjuncts(c)
+    if len(branches) < 2:
+        return None
+    per_branch = []
+    for b in branches:
+        side = [cc for cc in _conjuncts(b)
+                if cc.references() and cc.references() <= names]
+        if not side:
+            return None
+        per_branch.append(_and_all(side))
+    out = per_branch[0]
+    for p in per_branch[1:]:
+        out = E.Or(out, p)
+    return out
+
+
 def _mirror_key_conjunct(c: E.Expression, key_map: dict
                          ) -> Optional[E.Expression]:
     """If the conjunct is a simple range/set predicate referencing only
@@ -130,6 +157,40 @@ def _mirror_key_conjunct(c: E.Expression, key_map: dict
     if not refs or not refs <= set(key_map):
         return None
     return _remap_cols(c, key_map)
+
+
+_BOOL_SHAPES = (E.LessThan, E.LessThanOrEqual, E.GreaterThan,
+                E.GreaterThanOrEqual, E.EqualTo, E.In, E.IsNull,
+                E.IsNotNull, E.And, E.Or, E.Not)
+
+
+def _extract_bool_subtrees(e: E.Expression, side_names: set,
+                           host_names: set, acc: list,
+                           prefix: str) -> E.Expression:
+    """Replace maximal side-pure boolean subtrees that touch a
+    host-carried column with references to pre-computed columns
+    (appended to ``acc`` as (alias, expr)).
+
+    Why: a string predicate inside a residual join filter forces the
+    string column THROUGH the join (blocking the dense device kernels
+    and paying host gathers over the expanded output); evaluated on its
+    own side first, only a boolean crosses the join."""
+    refs = e.references()
+    if (isinstance(e, _BOOL_SHAPES) and refs
+            and refs <= side_names and refs & host_names):
+        alias = f"{prefix}{len(acc)}"
+        acc.append((alias, e))
+        return E.UnresolvedColumn(alias)
+    if not e.children or not isinstance(e, (E.And, E.Or, E.Not)):
+        return e
+    kids = tuple(_extract_bool_subtrees(c, side_names, host_names, acc,
+                                        prefix) for c in e.children)
+    if all(k is c for k, c in zip(kids, e.children)):
+        return e
+    import copy
+    out = copy.copy(e)
+    out.children = kids
+    return out
 
 
 def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
@@ -224,8 +285,10 @@ def _push_filter_impl(node: L.Filter) -> L.LogicalPlan:
 def _push_filter_join(join: L.Join, conjs: List[E.Expression]
                       ) -> L.LogicalPlan:
     how = _CANON.get(join.how, join.how)
-    lnames = set(join.children[0].schema().names())
-    rnames = set(join.children[1].schema().names())
+    lsch = join.children[0].schema()
+    rsch = join.children[1].schema()
+    lnames = set(lsch.names())
+    rnames = set(rsch.names())
 
     push_left_ok = how in ("inner", "cross", "left", "semi", "anti",
                            "existence")
@@ -258,8 +321,61 @@ def _push_filter_join(join: L.Join, conjs: List[E.Expression]
                 if m is not None:
                     to_left.append(m)
         else:
+            # OR-factoring (Spark extractPredicatesWithinOutputSet /
+            # CNF derivation): from (A1&B1)|(A2&B2) derive (A1|A2) for
+            # the side A references — a NECESSARY condition, pushed IN
+            # ADDITION to the original (which stays for exactness).
+            # TPC-H Q19's disjunctive part/lineitem predicate prunes
+            # both scans this way.
             stay.append(c)
+            if isinstance(c, E.Or):
+                if push_left_ok:
+                    d = _derive_side_predicate(c, lnames)
+                    if d is not None:
+                        to_left.append(d)
+                if push_right_ok:
+                    d = _derive_side_predicate(c, rnames)
+                    if d is not None:
+                        to_right.append(d)
 
-    left = _push(_wrap(join.children[0], to_left))
-    right = _push(_wrap(join.children[1], to_right))
-    return _wrap(_rebuild_join(join, left, right), stay)
+    # residual conjuncts that drag a host-carried (string/nested) column
+    # through the join: evaluate those side-pure boolean subtrees BEFORE
+    # the join as projected columns — only bools cross
+    l_extra: List = []
+    r_extra: List = []
+    if stay and how != "full":
+        def _host_names(sch):
+            return {f.name for f in sch.fields
+                    if getattr(f.dtype, "is_host_carried", False)}
+        # never extract on a null-SUPPLYING side: an unmatched row's
+        # original predicate sees NULL-extended column values (IsNull can
+        # be TRUE there) while the helper column itself null-extends —
+        # different 3VL results
+        lhost = _host_names(lsch) if how != "right" else set()
+        rhost = _host_names(rsch) if how != "left" else set()
+        if lhost or rhost:
+            new_stay = []
+            for si, c in enumerate(stay):
+                c2 = _extract_bool_subtrees(
+                    c, lnames, lhost, l_extra, f"__jb_l{si}_")
+                c2 = _extract_bool_subtrees(
+                    c2, rnames, rhost, r_extra, f"__jb_r{si}_")
+                new_stay.append(c2)
+            stay = new_stay
+
+    def _with_extra(child, names, extra):
+        if not extra:
+            return child
+        cols = [(n, E.UnresolvedColumn(n)) for n in names]
+        return _keep_hint(L.Project(child, cols + extra), child)
+
+    left = _push(_with_extra(_wrap(join.children[0], to_left),
+                             lsch.names(), l_extra))
+    right = _push(_with_extra(_wrap(join.children[1], to_right),
+                              rsch.names(), r_extra))
+    out = _wrap(_rebuild_join(join, left, right), stay)
+    if l_extra or r_extra:
+        # drop the helper columns: restore the join's original schema
+        keep = join.schema().names()
+        out = L.Project(out, [(n, E.UnresolvedColumn(n)) for n in keep])
+    return out
