@@ -909,12 +909,20 @@ class AggregateExec(TpuExec):
 
         # Re-partition fallback (GpuMergeAggregateIterator,
         # aggregate.scala:711): when the merged pending output outgrows
-        # batchSizeRows, a partial agg simply EMITS it (the exchange +
+        # the batch budget, a partial agg simply EMITS it (the exchange +
         # final agg combine duplicates), while a final/complete agg
         # hash-splits every merged/merging batch into disjoint key
         # buckets and finalizes per bucket — bounded peak batch size
         # with correctness preserved (a key lives in exactly one bucket).
-        limit = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
+        # The trigger is BYTE-denominated: a 3-column distinct can pend
+        # 10x more rows than a wide aggregation in the same memory, and
+        # tripping the fallback needlessly costs per-bucket merge passes
+        # (TPC-H Q21's 5.8M-group dedups were the measured victim).
+        from ..batch import estimated_row_bytes
+        width = max(1, estimated_row_bytes(buffer_schema))
+        limit = max(ctx.conf["spark.rapids.tpu.sql.batchSizeRows"],
+                    ctx.conf["spark.rapids.tpu.sql.batchSizeBytes"]
+                    // width)
         buckets = None
         bucket_over = None  # single OR-accumulated device overflow flag
         pending: Optional[ColumnBatch] = None

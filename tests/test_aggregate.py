@@ -288,6 +288,7 @@ class TestAggRepartitionFallback:
         t = self._data(rng)
         pdf = t.to_pandas()
         sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 2048)
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeBytes", 2048 * 32)
         df = (sess.create_dataframe(t).group_by("k")
               .agg(F.sum(F.col("v")).alias("s"),
                    F.count_star().alias("c")))
@@ -305,6 +306,7 @@ class TestAggRepartitionFallback:
         t = self._data(rng)
         pdf = t.to_pandas()
         sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 2048)
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeBytes", 2048 * 32)
         sess.conf.set(
             "spark.rapids.tpu.sql.agg.singleProcessComplete", False)
         sess.conf.set("spark.rapids.tpu.sql.agg.skipPartialAggRatio", 1.0)
@@ -322,6 +324,7 @@ class TestAggRepartitionFallback:
         t = self._data(rng)
         pdf = t.to_pandas()
         sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 1024)
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeBytes", 1024 * 32)
         df = (sess.create_dataframe(t).group_by("k", "k2")
               .agg(F.sum(F.col("v")).alias("s")))
         got = df.collect()
