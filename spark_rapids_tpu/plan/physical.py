@@ -914,15 +914,23 @@ class AggregateExec(TpuExec):
         # hash-splits every merged/merging batch into disjoint key
         # buckets and finalizes per bucket — bounded peak batch size
         # with correctness preserved (a key lives in exactly one bucket).
-        # The trigger is BYTE-denominated: a 3-column distinct can pend
-        # 10x more rows than a wide aggregation in the same memory, and
-        # tripping the fallback needlessly costs per-bucket merge passes
-        # (TPC-H Q21's 5.8M-group dedups were the measured victim).
-        from ..batch import estimated_row_bytes
-        width = max(1, estimated_row_bytes(buffer_schema))
-        limit = max(ctx.conf["spark.rapids.tpu.sql.batchSizeRows"],
-                    ctx.conf["spark.rapids.tpu.sql.batchSizeBytes"]
-                    // width)
+        # The trigger is BYTE-denominated over the BUFFER's physical
+        # layout (string keys ride as int32 dictionary codes): a narrow
+        # distinct can pend 10x more rows than a wide aggregation in the
+        # same memory, and tripping the fallback needlessly costs
+        # per-bucket merge passes (TPC-H Q21's 5.8M-group dedups were
+        # the measured victim); a wide buffer conversely trips EARLIER
+        # than the row cap would.
+        width = 0
+        for f_ in buffer_schema:
+            if f_.dtype.is_string:
+                width += 4  # int32 dictionary codes in buffer batches
+            elif getattr(f_.dtype, "is_host_carried", False):
+                width += 64
+            else:
+                width += np.dtype(f_.dtype.numpy_dtype).itemsize
+        limit = max(1, ctx.conf["spark.rapids.tpu.sql.batchSizeBytes"]
+                    // max(width, 1))
         buckets = None
         bucket_over = None  # single OR-accumulated device overflow flag
         pending: Optional[ColumnBatch] = None

@@ -292,7 +292,15 @@ class TestAggRepartitionFallback:
         df = (sess.create_dataframe(t).group_by("k")
               .agg(F.sum(F.col("v")).alias("s"),
                    F.count_star().alias("c")))
-        got = dict((r[0], (r[1], r[2])) for r in df.collect())
+        # pin the code path: the re-partition fallback must actually fire
+        from spark_rapids_tpu.plan.physical import CollectExec, ExecContext
+        phys = sess._plan_physical(df._plan)
+        ctx = ExecContext(sess._tpu_conf(), device=sess.device)
+        tbl = CollectExec(phys).collect_arrow(ctx)
+        assert sum(ms.values.get("aggRepartitions", 0)
+                   for ms in ctx.metrics.values()) >= 1
+        got = dict((r[0], (r[1], r[2]))
+                   for r in zip(*[c.to_pylist() for c in tbl.columns]))
         want = pdf.groupby("k").agg(s=("v", "sum"), c=("v", "size"))
         assert len(got) == len(want)
         for k, row in want.iterrows():
