@@ -203,6 +203,19 @@ AGG_REPARTITION_BUCKETS = register(
     "disjoint key buckets, each bounded at batchSizeRows rows (total "
     "group capacity = buckets x batchSizeRows; overflow raises).")
 
+PY_WORKER_ISOLATION = register(
+    "spark.rapids.tpu.python.worker.isolation", False,
+    "Run each python UDF batch in a forked worker process so a crashing "
+    "or hanging UDF raises PythonWorkerError instead of killing/wedging "
+    "the engine (python/rapids/daemon.py + PythonWorkerSemaphore "
+    "analog). Off by default: the fork + IPC round trip costs ~5-20 ms "
+    "per batch.")
+
+PY_WORKER_TIMEOUT = register(
+    "spark.rapids.tpu.python.worker.timeout", 300.0,
+    "Seconds an isolated python UDF batch may run before the worker is "
+    "killed and PythonWorkerError raised.", conv=float)
+
 DPP_ENABLED = register(
     "spark.rapids.tpu.sql.dpp.enabled", True,
     "Dynamic partition pruning: after a broadcast join's build side "

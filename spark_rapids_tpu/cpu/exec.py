@@ -124,11 +124,19 @@ class CpuOpExec(TpuExec):
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         from .eval import set_ansi
+        from ..udf import _isolation, set_isolation
+        # save/restore: nested CpuOpExec children run (and finish) inside
+        # the parent's _run, and must not reset the parent's settings
+        prev_iso = _isolation()
         set_ansi(ctx.conf["spark.rapids.tpu.sql.ansi.enabled"])
+        set_isolation(
+            ctx.conf["spark.rapids.tpu.python.worker.isolation"],
+            ctx.conf["spark.rapids.tpu.python.worker.timeout"])
         try:
             table = self._run(ctx)
         finally:
             set_ansi(False)
+            set_isolation(*prev_iso)
         min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
         batch_rows = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
         for off in range(0, max(table.num_rows, 1), batch_rows):

@@ -86,8 +86,17 @@ class UserDefinedFunction(Expression):
 
     def eval_rows(self, child_values, n: int):
         """CPU evaluation: row-wise python, or pandas-Series-vectorized
-        (GpuArrowEvalPythonExec analog minus the worker process — the
-        'worker' is in-process since there is no JVM boundary to escape)."""
+        (GpuArrowEvalPythonExec analog).  In-process by default (no JVM
+        boundary to escape); with python.worker.isolation the batch runs
+        in a forked worker so crashes/hangs cannot take the engine down
+        (python/rapids/daemon.py analog)."""
+        enabled, timeout = _isolation()
+        if enabled:
+            return _run_isolated(
+                lambda: self._eval_rows_local(child_values, n), timeout)
+        return self._eval_rows_local(child_values, n)
+
+    def _eval_rows_local(self, child_values, n: int):
         import pandas as pd
         cols = []
         for (d, v), c in zip(child_values, self.children):
@@ -182,3 +191,81 @@ def pandas_udf(fn=None, *, return_type: Optional[T.DataType] = None,
         return lambda f: _wrap(f, return_type, device=False, name=name,
                                vectorized=True)
     return _wrap(fn, return_type, device=False, name=name, vectorized=True)
+
+
+# ---------------------------------------------------------------------------------
+# Worker-process isolation (python/rapids/daemon.py + GpuArrowEvalPythonExec
+# worker analog): an opt-in mode that runs each python UDF batch in a
+# FORKED child process, so a crashing or hanging UDF surfaces as a typed
+# error instead of taking down (or wedging) the engine process.  Fork
+# inherits the function through process memory — no pickling, so lambdas
+# and closures work.  The child computes pure numpy and never touches the
+# device.
+# ---------------------------------------------------------------------------------
+
+import threading as _threading
+
+_TL = _threading.local()
+
+
+def set_isolation(enabled: bool, timeout: float) -> None:
+    """Set by the CPU operator around UDF-bearing execution
+    (spark.rapids.tpu.python.worker.* confs)."""
+    _TL.isolation = (enabled, timeout)
+
+
+def _isolation():
+    return getattr(_TL, "isolation", (False, 300.0))
+
+
+class PythonWorkerError(RuntimeError):
+    """The isolated UDF worker crashed, raised, or timed out."""
+
+
+def _run_isolated(compute, timeout: float):
+    """Run ``compute() -> (data, valid)`` in a forked child; return its
+    result or raise PythonWorkerError."""
+    import multiprocessing as mp
+    import pandas  # noqa: F401 — pre-import in the PARENT: a forked
+    # child importing pandas pays ~100s of ms per batch and can deadlock
+    # on import locks held by the engine's reader threads at fork time
+    ctx = mp.get_context("fork")
+    parent, child = ctx.Pipe(duplex=False)
+
+    def main(conn):
+        try:
+            out = compute()
+            conn.send(("ok", out))
+        except BaseException as e:  # noqa: BLE001 — report, don't die silently
+            try:
+                conn.send(("err", f"{type(e).__name__}: {e}"))
+            except Exception:
+                pass
+
+    proc = ctx.Process(target=main, args=(child,), daemon=True)
+    proc.start()
+    child.close()
+    try:
+        if not parent.poll(timeout):
+            raise PythonWorkerError(
+                f"python UDF worker timed out after {timeout}s "
+                f"(spark.rapids.tpu.python.worker.timeout)")
+        try:
+            kind, payload = parent.recv()
+        except EOFError:
+            raise PythonWorkerError(
+                f"python UDF worker died (exitcode="
+                f"{proc.exitcode if not proc.is_alive() else '?'}) — "
+                f"the engine process survives; fix the UDF") from None
+        if kind == "err":
+            raise PythonWorkerError(f"python UDF raised in worker: "
+                                    f"{payload}")
+        return payload
+    finally:
+        parent.close()
+        if proc.is_alive():
+            proc.terminate()
+        proc.join(timeout=5)
+        if proc.is_alive():  # SIGTERM caught/blocked by the UDF: escalate
+            proc.kill()
+            proc.join(timeout=5)

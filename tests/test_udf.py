@@ -108,3 +108,77 @@ def test_pandas_udf_two_series_with_nulls(session):
     got = [r[0] for r in df.select(add(f.col("a"), f.col("b")).alias("c"))
            .collect()]
     assert got == [11.0, None, None]
+
+
+class TestWorkerIsolation:
+    """python/rapids/daemon.py analog: UDF batches run in a forked
+    worker; crashes and hangs surface as PythonWorkerError while the
+    engine process survives."""
+
+    def _sess(self, fresh_session):
+        fresh_session.conf.set(
+            "spark.rapids.tpu.python.worker.isolation", True)
+        return fresh_session
+
+    def test_isolated_udf_computes(self, fresh_session):
+        sess = self._sess(fresh_session)
+        import pyarrow as pa
+        from spark_rapids_tpu.udf import udf
+        from spark_rapids_tpu import types as T
+        f = udf(lambda x: None if x is None else x * 3 + 1,
+                return_type=T.INT64, try_compile=False)
+        df = sess.create_dataframe(pa.table({"v": pa.array([1, 2, None],
+                                                           type=pa.int64())}))
+        got = [r[0] for r in df.select(f("v").alias("o")).collect()]
+        assert got == [4, 7, None]
+
+    def test_crashing_udf_is_contained(self, fresh_session):
+        sess = self._sess(fresh_session)
+        import os
+        import pyarrow as pa
+        import pytest as _pt
+        from spark_rapids_tpu.udf import PythonWorkerError, udf
+        from spark_rapids_tpu import types as T
+
+        def boom(x):
+            os._exit(42)  # hard process death, not an exception
+
+        f = udf(boom, return_type=T.INT64, try_compile=False)
+        df = sess.create_dataframe(pa.table({"v": pa.array([1, 2])}))
+        with _pt.raises(PythonWorkerError, match="died"):
+            df.select(f("v").alias("o")).collect()
+        # the engine process survives and keeps working
+        assert df.count() == 2
+
+    def test_hanging_udf_times_out(self, fresh_session):
+        sess = self._sess(fresh_session)
+        sess.conf.set("spark.rapids.tpu.python.worker.timeout", 1.0)
+        import time as _t
+        import pyarrow as pa
+        import pytest as _pt
+        from spark_rapids_tpu.udf import PythonWorkerError, udf
+        from spark_rapids_tpu import types as T
+
+        def sleepy(x):
+            _t.sleep(60)
+            return x
+
+        f = udf(sleepy, return_type=T.INT64, try_compile=False)
+        df = sess.create_dataframe(pa.table({"v": pa.array([1])}))
+        with _pt.raises(PythonWorkerError, match="timed out"):
+            df.select(f("v").alias("o")).collect()
+
+    def test_raising_udf_reports(self, fresh_session):
+        sess = self._sess(fresh_session)
+        import pyarrow as pa
+        import pytest as _pt
+        from spark_rapids_tpu.udf import PythonWorkerError, udf
+        from spark_rapids_tpu import types as T
+
+        def bad(x):
+            raise ValueError("nope")
+
+        f = udf(bad, return_type=T.INT64, try_compile=False)
+        df = sess.create_dataframe(pa.table({"v": pa.array([1])}))
+        with _pt.raises(PythonWorkerError, match="nope"):
+            df.select(f("v").alias("o")).collect()
