@@ -95,3 +95,23 @@ class TestDeviceExport:
         caps = sess.create_dataframe(t).to_dlpack()
         d, v = caps["v"]
         assert "dltensor" in repr(d) or d is not None
+
+
+class TestIdSplitRetry:
+    def test_mid_unique_under_split_retry(self, sess, rng):
+        """OOM split-and-retry halves must draw disjoint id ranges
+        (unique-and-increasing is the contract; gaps are fine)."""
+        n = 3000
+        sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 1024)
+        sess.conf.set("spark.rapids.tpu.test.injectSplitAndRetryOOM", 1)
+        try:
+            t = pa.table({"v": pa.array(np.arange(n, dtype=np.int64))})
+            rows = (sess.create_dataframe(t)
+                    .select(F.monotonically_increasing_id().alias("id"))
+                    .collect())
+        finally:
+            sess.conf.set("spark.rapids.tpu.test.injectSplitAndRetryOOM",
+                          0)
+        ids = [r[0] for r in rows]
+        assert len(ids) == n
+        assert len(set(ids)) == n
