@@ -182,11 +182,16 @@ AGG_SKIP_PARTIAL_RATIO = register(
     "disables skipping.", conv=float)
 
 AUTO_BROADCAST_THRESHOLD = register(
-    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 256 * 1024 * 1024,
     "Estimated-size cutoff (bytes) under which the build side of a join is "
     "broadcast (materialized once, never shuffled) instead of hash "
     "partitioned; -1 disables auto selection (an explicit broadcast() "
-    "hint still applies). spark.sql.autoBroadcastJoinThreshold analog.")
+    "hint still applies). spark.sql.autoBroadcastJoinThreshold analog. "
+    "The default is far above Spark's 10MB: in a single process a "
+    "broadcast build is just one materialization (which a shuffled join "
+    "pays anyway) and feeds the dense direct-address kernel; lower this "
+    "for DCN multi-host runs where the build all-gathers over the "
+    "network.")
 
 AGG_SINGLE_PROCESS_COMPLETE = register(
     "spark.rapids.tpu.sql.agg.singleProcessComplete", True,
