@@ -236,12 +236,13 @@ DPP_MAX_IN_KEYS = register(
 
 DENSE_JOIN_DOMAIN_CAP = register(
     "spark.rapids.tpu.join.denseDomainCap", 1 << 26,
-    "Largest key domain (max_key - min_key + 1) for which a broadcast "
-    "join builds a dense direct-address lookup table (int32, one HBM "
-    "gather per probe row — the TPU-native replacement for cuDF's device "
-    "hash table, GpuHashJoin.scala:104). Above the cap, or with "
-    "duplicate build keys, the sorted searchsorted kernel is used. "
-    "0 disables the dense path.")
+    "Largest key domain (max_key - min_key + 1) for which the dense "
+    "direct-address kernels engage — the TPU-native replacement for "
+    "cuDF's device hash table (GpuHashJoin.scala:104): broadcast joins "
+    "build an int32 key->row table (one HBM gather per probe row), and "
+    "single-int-key complete-mode aggregations scatter into domain-sized "
+    "accumulators (one per buffer column: budget ~cap x 8B x buffers). "
+    "Above the cap the sort-based kernels run. 0 disables both.")
 
 ICI_DEVICES = register(
     "spark.rapids.tpu.shuffle.ici.devices", 0,

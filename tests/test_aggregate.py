@@ -275,8 +275,11 @@ class TestAggRepartitionFallback:
 
     def _data(self, rng, n=20_000, groups=5_000):
         import pyarrow as pa
+        # sparse keys (stride 2^40) defeat the dense direct-address agg
+        # so these tests exercise the sort + re-partition fallback path
         return pa.table({
-            "k": pa.array(rng.integers(0, groups, n).astype(np.int64)),
+            "k": pa.array((rng.integers(0, groups, n) << 40).astype(
+                np.int64)),
             "k2": pa.array((rng.integers(0, groups, n) * 7).astype(
                 np.int64)),
             "v": pa.array(rng.uniform(0, 10, n)),
