@@ -221,6 +221,13 @@ PY_WORKER_TIMEOUT = register(
     "Seconds an isolated python UDF batch may run before the worker is "
     "killed and PythonWorkerError raised.", conv=float)
 
+AGG_DENSE_ENABLED = register(
+    "spark.rapids.tpu.sql.agg.dense.enabled", True,
+    "Enable the dense direct-address aggregation kernel (scatter into "
+    "domain-sized accumulators) for single bounded-domain int/date "
+    "group keys; the domain cap is join.denseDomainCap. Off = always "
+    "use the sort-based kernel.")
+
 DPP_ENABLED = register(
     "spark.rapids.tpu.sql.dpp.enabled", True,
     "Dynamic partition pruning: after a broadcast join's build side "

@@ -758,6 +758,8 @@ class AggregateExec(TpuExec):
     def _dense_agg_static_ok(self, ops, conf) -> bool:
         if self.mode != "complete" or len(self.group_exprs) != 1:
             return False
+        if not conf["spark.rapids.tpu.sql.agg.dense.enabled"]:
+            return False
         if not conf["spark.rapids.tpu.join.denseDomainCap"]:
             return False
         if any(op not in ("sum", "min", "max") for op in ops):
