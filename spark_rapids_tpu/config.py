@@ -241,6 +241,15 @@ DPP_MAX_IN_KEYS = register(
     "Largest distinct build-key count pushed as an exact IN-list runtime "
     "predicate; above it only the [min, max] range is pushed.")
 
+DENSE_JOIN_MIN_PROBE = register(
+    "spark.rapids.tpu.join.denseMinProbeRows", 16384,
+    "Smallest ESTIMATED probe-side row count for which a broadcast join "
+    "engages the dense direct-address machinery (build-key stats fetch, "
+    "dense table, dynamic partition pruning). Below it the sorted "
+    "kernel runs without the stats round trip — on tunneled backends "
+    "each host sync costs ~0.1-0.2 s, which a tiny probe never earns "
+    "back. 0 always engages.")
+
 DENSE_JOIN_DOMAIN_CAP = register(
     "spark.rapids.tpu.join.denseDomainCap", 1 << 26,
     "Largest key domain (max_key - min_key + 1) for which the dense "

@@ -898,8 +898,17 @@ class BroadcastJoinExec(SortMergeJoinExec):
     # ~2 searchsorted passes + per-column expansion gathers with ~1+C
     # gathers.
 
-    def _dense_static_ok(self) -> bool:
+    def _dense_static_ok(self, conf=None) -> bool:
         how = self.how
+        if conf is not None:
+            # tiny probes: the dense table's build-stats fetch costs a
+            # full host round trip that a small probe never earns back;
+            # this gate also skips DPP (a tiny probe reads few row
+            # groups to begin with) — denseMinProbeRows tunes it
+            est = getattr(self, "probe_est_rows", None)
+            min_probe = conf["spark.rapids.tpu.join.denseMinProbeRows"]
+            if est is not None and min_probe and est < min_probe:
+                return False
         if how == "inner":
             pass  # either build side; a residual condition post-filters
         elif how in ("left", "semi", "anti", "existence"):
@@ -1210,7 +1219,7 @@ class BroadcastJoinExec(SortMergeJoinExec):
         probe_side = 1 - self.build_side
         bh = self.children[self.build_side].materialize(ctx)
         pgen = self.children[probe_side].execute(ctx)
-        dense_ok = self._dense_static_ok()
+        dense_ok = self._dense_static_ok(ctx.conf)
         try:
             build = bh.get()
             if dense_ok:
@@ -1435,11 +1444,16 @@ def plan_broadcast_join(plan, left: TpuExec, right: TpuExec, conf,
         if not fits:
             return None
         build_side = min(fits, key=lambda s: ests[s])
+    from .cbo import estimate_rows
+    probe_est = estimate_rows(plan.children[1 - build_side])
     if build_side == 1:
-        return BroadcastJoinExec(plan, left, BroadcastExchangeExec(right),
-                                 conf, 1, string_dicts=shared_dicts)
-    return BroadcastJoinExec(plan, BroadcastExchangeExec(left), right,
-                             conf, 0, string_dicts=shared_dicts)
+        out = BroadcastJoinExec(plan, left, BroadcastExchangeExec(right),
+                                conf, 1, string_dicts=shared_dicts)
+    else:
+        out = BroadcastJoinExec(plan, BroadcastExchangeExec(left), right,
+                                conf, 0, string_dicts=shared_dicts)
+    out.probe_est_rows = probe_est
+    return out
 
 
 def _estimated_bytes(logical) -> Optional[float]:

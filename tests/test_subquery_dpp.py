@@ -174,7 +174,7 @@ class TestDPP:
             "f_val": pa.array(rng.uniform(0, 10, n)),
         })
         fpath = str(tmp_path / "factd.parquet")
-        pq.write_table(fact, fpath, row_group_size=1000)
+        pq.write_table(fact, fpath, row_group_size=2000)
         dim_days = [base + datetime.timedelta(days=int(d))
                     for d in range(100, 130)]
         dim = pa.table({"d_date": pa.array(dim_days, type=pa.date32())})
