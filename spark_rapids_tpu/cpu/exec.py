@@ -192,6 +192,15 @@ class CpuOpExec(TpuExec):
             rng = np.random.default_rng(p.seed)
             keep = rng.random(t.num_rows) < p.fraction
             return t.filter(keep)
+        if isinstance(p, L.Limit):
+            t = self._child_table(ctx)
+            off = getattr(p, "offset", 0) or 0
+            return t.slice(off, p.n)
+        if isinstance(p, L.Union):
+            import pyarrow as pa
+            parts = [self._child_table(ctx, i)
+                     for i in range(len(self.children))]
+            return pa.concat_tables(parts, promote_options="default")
         raise NotImplementedError(
             f"CPU fallback for {type(p).__name__} not implemented")
 
