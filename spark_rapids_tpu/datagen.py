@@ -1,0 +1,449 @@
+"""Data generation DSL + scale harness.
+
+Analog of the reference's ``datagen`` module
+(datagen/src/main/scala/org/apache/spark/sql/tests/datagen/bigDataGen.scala):
+composable per-column generators with distributions, null fractions,
+sequences, foreign keys, and nested types; table specs that generate
+pyarrow tables or write chunked multi-file parquet datasets at scale;
+deterministic under a seed (same seed → same data, any chunking).
+
+    from spark_rapids_tpu.datagen import (TableSpec, SeqGen, IntGen,
+                                          DoubleGen, StringGen, FKGen)
+    orders = TableSpec("orders", {
+        "o_id":   SeqGen(),
+        "o_cust": FKGen(parent_rows=100_000, distribution="zipf"),
+        "o_amt":  DoubleGen(lo=1.0, hi=1e4),
+        "o_tag":  StringGen(pattern="tag-[0-9]{4}"),
+    })
+    t = orders.generate(1_000_000, seed=42)         # pyarrow.Table
+    orders.write_parquet("/data/orders", 50_000_000, seed=42, files=32)
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Gen", "IntGen", "LongGen", "DoubleGen", "FloatGen", "BoolGen",
+    "StringGen", "DateGen", "TimestampGen", "DecimalGen", "ChoiceGen",
+    "SeqGen", "FKGen", "ArrayGen", "StructGen", "TableSpec",
+]
+
+
+class Gen:
+    """Base column generator: null fraction + deterministic per-chunk
+    generation.  ``generate(rng, n, base)`` gets the CHUNK's global row
+    offset so sequence-style generators chunk deterministically."""
+
+    def __init__(self, nullable: bool = True, null_prob: float = 0.1):
+        self.nullable = nullable
+        self.null_prob = null_prob
+
+    def generate(self, rng: np.random.Generator, n: int,
+                 base: int = 0):
+        """Returns (values, null_mask-or-None); values may be a numpy
+        array (vectorized generators) or a python list."""
+        vals = self._gen(rng, n, base)
+        mask = None
+        if self.nullable and self.null_prob > 0:
+            mask = rng.random(n) < self.null_prob
+            if not isinstance(vals, np.ndarray):
+                vals = [None if m else v for v, m in zip(vals, mask)]
+                mask = None
+        return vals, mask
+
+    def generate_list(self, rng, n: int, base: int = 0) -> list:
+        """Plain python list with Nones (nested-generator element use)."""
+        vals, mask = self.generate(rng, n, base)
+        if isinstance(vals, np.ndarray):
+            vals = vals.tolist()
+        if mask is not None:
+            vals = [None if m else v for v, m in zip(vals, mask)]
+        return vals
+
+    def arrow_type(self):
+        return None  # subclass-declared; None = let arrow infer
+
+    def _gen(self, rng, n, base):
+        raise NotImplementedError
+
+
+def _draw(rng, n, distribution: str, lo: int, hi: int,
+          zipf_a: float = 1.3):
+    """Integer draws under a named distribution over [lo, hi)."""
+    span = max(1, hi - lo)
+    if distribution == "uniform":
+        return rng.integers(lo, hi, n)
+    if distribution == "zipf":
+        z = rng.zipf(zipf_a, n)  # heavy-tailed skew (hot keys)
+        return lo + (z - 1) % span
+    if distribution == "normal":
+        c = (lo + hi) / 2
+        s = span / 6 or 1
+        return np.clip(rng.normal(c, s, n), lo, hi - 1).astype(np.int64)
+    raise ValueError(f"unknown distribution {distribution!r}")
+
+
+class IntGen(Gen):
+    def __init__(self, lo=-(2 ** 31), hi=2 ** 31 - 1, dtype="int32",
+                 distribution: str = "uniform", zipf_a: float = 1.3,
+                 **kw):
+        super().__init__(**kw)
+        self.lo, self.hi, self.dtype = lo, hi, dtype
+        self.distribution, self.zipf_a = distribution, zipf_a
+
+    def arrow_type(self):
+        import pyarrow as pa
+        return {"int8": pa.int8(), "int16": pa.int16(),
+                "int32": pa.int32(), "int64": pa.int64()}[self.dtype]
+
+    def _gen(self, rng, n, base):
+        np_dt = {"int8": np.int8, "int16": np.int16, "int32": np.int32,
+                 "int64": np.int64}[self.dtype]
+        return np.asarray(_draw(rng, n, self.distribution, self.lo,
+                                self.hi, self.zipf_a)).astype(np_dt)
+
+
+class LongGen(IntGen):
+    def __init__(self, lo=-(2 ** 63), hi=2 ** 63 - 1, **kw):
+        super().__init__(lo, hi, "int64", **kw)
+
+
+class SeqGen(Gen):
+    """Unique ascending keys (1-based by default): chunk-deterministic —
+    primary keys for scale tables."""
+
+    def __init__(self, start: int = 1, **kw):
+        kw.setdefault("nullable", False)
+        super().__init__(**kw)
+        self.start = start
+
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.int64()
+
+    def _gen(self, rng, n, base):
+        return np.arange(self.start + base, self.start + base + n,
+                         dtype=np.int64)
+
+
+class FKGen(Gen):
+    """Foreign keys into a parent of ``parent_rows`` (1-based SeqGen
+    keys), optionally skewed — referential integrity by construction."""
+
+    def __init__(self, parent_rows: int, distribution: str = "uniform",
+                 zipf_a: float = 1.3, **kw):
+        kw.setdefault("nullable", False)
+        super().__init__(**kw)
+        self.parent_rows = parent_rows
+        self.distribution, self.zipf_a = distribution, zipf_a
+
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.int64()
+
+    def _gen(self, rng, n, base):
+        return np.asarray(_draw(rng, n, self.distribution, 1,
+                                self.parent_rows + 1,
+                                self.zipf_a)).astype(np.int64)
+
+
+class DoubleGen(Gen):
+    def __init__(self, lo=-1e6, hi=1e6, special: bool = False, **kw):
+        super().__init__(**kw)
+        self.lo, self.hi, self.special = lo, hi, special
+
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.float64()
+
+    def _gen(self, rng, n, base):
+        vals = self.lo + rng.random(n) * (self.hi - self.lo)
+        if self.special and n >= 8:
+            for sp in (0.0, -0.0, float("nan"), float("inf"),
+                       float("-inf"), 1e-300, -1e300, 1.5):
+                vals[int(rng.integers(0, n))] = sp
+        return vals
+
+
+class FloatGen(DoubleGen):
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.float32()
+
+    def _gen(self, rng, n, base):
+        return super()._gen(rng, n, base).astype(np.float32)
+
+
+class BoolGen(Gen):
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.bool_()
+
+    def _gen(self, rng, n, base):
+        return rng.integers(0, 2, n).astype(bool)
+
+
+class ChoiceGen(Gen):
+    """Draw from a fixed value pool, optionally weighted."""
+
+    def __init__(self, values: Sequence, weights: Optional[Sequence[float]]
+                 = None, **kw):
+        super().__init__(**kw)
+        self.values = list(values)
+        self.p = None
+        if weights is not None:
+            w = np.asarray(weights, dtype=np.float64)
+            self.p = w / w.sum()
+
+    def _gen(self, rng, n, base):
+        idx = rng.choice(len(self.values), size=n, p=self.p)
+        return [self.values[i] for i in idx]
+
+
+class StringGen(Gen):
+    """Random strings from an alphabet, or from a regex-ish PATTERN
+    supporting literals, ``[set]`` char classes, and ``{n}`` / ``{m,n}``
+    repetition — the bigDataGen string-pattern idea."""
+
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.string()
+
+    def __init__(self, alphabet: str = "abcdefgXYZ 0123456789",
+                 max_len: int = 12, pattern: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        self.alphabet = alphabet
+        self.max_len = max_len
+        self.parts = self._parse(pattern) if pattern else None
+
+    @staticmethod
+    def _parse(pattern: str):
+        parts = []  # (charset, lo_reps, hi_reps)
+        i = 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if ch == "[":
+                j = pattern.index("]", i)
+                spec = pattern[i + 1: j]
+                chars = []
+                k = 0
+                while k < len(spec):
+                    if k + 2 < len(spec) and spec[k + 1] == "-":
+                        chars += [chr(c) for c in
+                                  range(ord(spec[k]), ord(spec[k + 2]) + 1)]
+                        k += 3
+                    else:
+                        chars.append(spec[k])
+                        k += 1
+                cs = "".join(chars)
+                i = j + 1
+            else:
+                cs = ch
+                i += 1
+            lo = hi = 1
+            if i < len(pattern) and pattern[i] == "{":
+                j = pattern.index("}", i)
+                body = pattern[i + 1: j]
+                if "," in body:
+                    a, b = body.split(",")
+                    lo, hi = int(a), int(b)
+                else:
+                    lo = hi = int(body)
+                i = j + 1
+            parts.append((cs, lo, hi))
+        return parts
+
+    def _gen(self, rng, n, base):
+        if self.parts is None:
+            out = []
+            for _ in range(n):
+                ln = int(rng.integers(0, self.max_len))
+                out.append("".join(rng.choice(list(self.alphabet), ln)))
+            return out
+        out = []
+        for _ in range(n):
+            s = []
+            for cs, lo, hi in self.parts:
+                reps = lo if lo == hi else int(rng.integers(lo, hi + 1))
+                for _r in range(reps):
+                    s.append(cs[int(rng.integers(0, len(cs)))])
+            out.append("".join(s))
+        return out
+
+
+class DateGen(Gen):
+    def __init__(self, lo_days=-20000, hi_days=20000, **kw):
+        super().__init__(**kw)
+        self.lo_days, self.hi_days = lo_days, hi_days
+
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.date32()
+
+    def _gen(self, rng, n, base):
+        import datetime
+        b = datetime.date(1970, 1, 1)
+        return [b + datetime.timedelta(days=int(d))
+                for d in rng.integers(self.lo_days, self.hi_days, n)]
+
+
+class TimestampGen(Gen):
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.timestamp("us")
+
+    def _gen(self, rng, n, base):
+        import datetime
+        b = datetime.datetime(2000, 1, 1)
+        return [b + datetime.timedelta(microseconds=int(us))
+                for us in rng.integers(-10 ** 15, 10 ** 15, n)]
+
+
+class DecimalGen(Gen):
+    def __init__(self, precision: int = 12, scale: int = 2, **kw):
+        super().__init__(**kw)
+        self.precision, self.scale = precision, scale
+
+    def _gen(self, rng, n, base):
+        import decimal
+        hi = 10 ** self.precision - 1
+        return [decimal.Decimal(int(v)).scaleb(-self.scale)
+                for v in rng.integers(-hi, hi, n)]
+
+    def arrow_type(self):
+        import pyarrow as pa
+        return pa.decimal128(self.precision, self.scale)
+
+
+class ArrayGen(Gen):
+    def __init__(self, element: Gen, max_len: int = 5, **kw):
+        super().__init__(**kw)
+        self.element, self.max_len = element, max_len
+
+    def arrow_type(self):
+        import pyarrow as pa
+        inner = getattr(self.element, "arrow_type", None)
+        return pa.list_(inner()) if inner else None
+
+    def _gen(self, rng, n, base):
+        lens = rng.integers(0, self.max_len + 1, n)
+        flat = self.element.generate_list(rng, int(lens.sum()), base)
+        out, i = [], 0
+        for ln in lens:
+            out.append(flat[i: i + int(ln)])
+            i += int(ln)
+        return out
+
+
+class StructGen(Gen):
+    def __init__(self, fields: Dict[str, Gen], **kw):
+        super().__init__(**kw)
+        self.fields = dict(fields)
+
+    def arrow_type(self):
+        import pyarrow as pa
+        types = {}
+        for k, g in self.fields.items():
+            at = getattr(g, "arrow_type", None)
+            if at is None:
+                return None
+            t = at()
+            if t is None:
+                return None
+            types[k] = t
+        return pa.struct([pa.field(k, t) for k, t in types.items()])
+
+    def _gen(self, rng, n, base):
+        cols = {k: g.generate_list(rng, n, base)
+                for k, g in self.fields.items()}
+        return [{k: cols[k][i] for k in cols} for i in range(n)]
+
+
+class TableSpec:
+    """A named table: column name → Gen.  ``generate`` is deterministic
+    in (seed, chunking) — every chunk derives its own child seed from
+    (seed, chunk_base), so multi-file scale-out produces the same data
+    as one shot."""
+
+    def __init__(self, name: str, columns: Dict[str, Gen]):
+        self.name = name
+        self.columns = dict(columns)
+
+    _BLOCK = 4096  # internal generation granularity
+
+    def _chunk(self, seed: int, base: int, n: int):
+        """Rows [base, base+n): generated from fixed 4096-row ALIGNED
+        blocks, each seeded by (seed, column, block index) — so any
+        chunking/file split of the same seed yields identical data."""
+        import pyarrow as pa
+        B = self._BLOCK
+        cols = {}
+        for ci, (cname, g) in enumerate(self.columns.items()):
+            pieces = []
+            b0 = base // B
+            b1 = (base + n + B - 1) // B if n else b0
+            typ = g.arrow_type()
+            for bi in range(b0, b1):
+                rng = np.random.default_rng(
+                    np.random.SeedSequence([seed, ci, bi]))
+                vals, mask = g.generate(rng, B, bi * B)
+                lo = max(base - bi * B, 0)
+                hi = min(base + n - bi * B, B)
+                if isinstance(vals, np.ndarray):
+                    pieces.append(pa.array(
+                        vals[lo:hi], type=typ,
+                        mask=None if mask is None else mask[lo:hi]))
+                else:
+                    pieces.append(pa.array(vals[lo:hi], type=typ,
+                                           from_pandas=True))
+            if not pieces:
+                pieces = [pa.array([], type=typ)]
+            cols[cname] = pa.concat_arrays(
+                [p.combine_chunks() if hasattr(p, "combine_chunks") else p
+                 for p in pieces])
+        return pa.table(cols)
+
+    def generate(self, n: int, seed: int = 0,
+                 chunk: int = 1_000_000):
+        import pyarrow as pa
+        parts = [self._chunk(seed, off, min(chunk, n - off))
+                 for off in range(0, n, chunk)] or [self._chunk(seed, 0, 0)]
+        return pa.concat_tables(parts)
+
+    def write_parquet(self, out_dir: str, n: int, seed: int = 0,
+                      files: int = 1, chunk: int = 1_000_000,
+                      row_group_size: Optional[int] = None) -> List[str]:
+        """Chunked multi-file scale writer (the scale-test harness):
+        rows split evenly across ``files``, each file streamed in
+        ``chunk``-row pieces — O(chunk) memory at any size."""
+        import os
+
+        import pyarrow.parquet as pq
+        os.makedirs(out_dir, exist_ok=True)
+        per = math.ceil(n / max(files, 1))
+        paths = []
+        done = 0
+        for fi in range(files):
+            take = min(per, n - done)
+            if take <= 0:
+                break
+            path = os.path.join(out_dir,
+                                f"{self.name}-{fi:05d}.parquet")
+            writer = None
+            off = done
+            while off < done + take:
+                m = min(chunk, done + take - off)
+                t = self._chunk(seed, off, m)
+                if writer is None:
+                    writer = pq.ParquetWriter(path, t.schema)
+                writer.write_table(t, row_group_size=row_group_size)
+                off += m
+            writer.close()
+            paths.append(path)
+            done += take
+        return paths
