@@ -922,8 +922,14 @@ class BroadcastJoinExec(SortMergeJoinExec):
         return _int_key_caster(common[0]) is not None
 
     def _dense_payload_fields(self, build: ColumnBatch):
-        """(field-index list into build.schema, or None when a needed
-        payload column is host-carried)."""
+        """Field-index list into build.schema, or None when a needed
+        payload column has no dense representation.  STRING payload
+        columns ride as dictionary codes: the build side factorizes once
+        (it is small), the probe program gathers int32 codes like any
+        device column, and assembly decodes back to a plain string
+        column — without this, one string dimension attribute (n_name,
+        c_name, p_brand...) forces the whole join onto the searchsorted
+        kernel."""
         if self.how in ("semi", "anti", "existence"):
             return []
         using = set(self.using)
@@ -933,8 +939,13 @@ class BroadcastJoinExec(SortMergeJoinExec):
         else:
             idxs = list(range(len(build.schema.fields)))
         for i in idxs:
-            if not isinstance(build.columns[i], DeviceColumn):
-                return None
+            c = build.columns[i]
+            if isinstance(c, DeviceColumn):
+                continue
+            if isinstance(c, HostStringColumn) \
+                    and build.schema.fields[i].dtype.is_string:
+                continue  # dictionary-encodable
+            return None  # nested / other host-carried: no dense form
         return idxs
 
     def _dense_prefetch(self, build: ColumnBatch, conf) -> None:
@@ -1040,10 +1051,29 @@ class BroadcastJoinExec(SortMergeJoinExec):
 
         gfn = _cached_program(f"bjoin-dense-table|{fp}|{D}", build_table)
         table = gfn(b_arrays, jnp.int64(kmin), np.int32(build.num_rows))
-        pay = tuple((build.columns[i].data, build.columns[i].valid)
-                    for i in payload_idxs)
+        pay = []
+        dicts = {}
+        for i in payload_idxs:
+            c = build.columns[i]
+            if isinstance(c, DeviceColumn):
+                pay.append((c.data, c.valid))
+                continue
+            # string payload: factorize on host (the build is small),
+            # upload int32 codes — nulls carry code 0 under a FALSE
+            # validity mask (the mask, not the code, marks null)
+            import pyarrow as pa
+            arr = c.array
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            denc = arr.dictionary_encode()
+            codes_np = denc.indices.to_numpy(zero_copy_only=False)
+            valid_np = np.asarray(denc.indices.is_valid())
+            codes_np = np.where(valid_np, codes_np, 0).astype(np.int32)
+            pay.append((jnp.asarray(codes_np), jnp.asarray(valid_np)))
+            dicts[i] = denc.dictionary
         return {"table": table, "kmin": kmin, "D": D, "ct": ct, "ik": ik,
-                "payload_idxs": payload_idxs, "payload": pay}
+                "payload_idxs": payload_idxs, "payload": tuple(pay),
+                "payload_dicts": dicts}
 
     def _dense_join_pair(self, ctx, m, probe: ColumnBatch,
                          build: ColumnBatch):
@@ -1111,9 +1141,23 @@ class BroadcastJoinExec(SortMergeJoinExec):
             self._dense_metrics(m, out)
             return out
         build_cols = {}
+        pdicts = state.get("payload_dicts") or {}
         for i, (bd, bv) in zip(state["payload_idxs"], pay_cols):
             f = build.schema.fields[i]
-            build_cols[f.name] = DeviceColumn(f.dtype, bd, bv)
+            if i in pdicts:
+                # gathered dictionary codes -> plain string column (ONE
+                # fetch + a vectorized arrow decode; still far cheaper
+                # than the searchsorted fallback this replaces)
+                import pyarrow as pa
+                codes = np.asarray(bd).astype(np.int32, copy=True)
+                invalid = ~np.asarray(bv)
+                codes[invalid] = 0
+                ind = pa.array(codes, type=pa.int32(), mask=invalid)
+                decoded = pa.DictionaryArray.from_arrays(
+                    ind, pdicts[i]).dictionary_decode()
+                build_cols[f.name] = HostStringColumn(decoded)
+            else:
+                build_cols[f.name] = DeviceColumn(f.dtype, bd, bv)
         using = set(self.using)
         cols: List = []
         if self.build_side == 1:
