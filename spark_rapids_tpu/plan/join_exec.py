@@ -943,9 +943,14 @@ class BroadcastJoinExec(SortMergeJoinExec):
             if isinstance(c, DeviceColumn):
                 continue
             if isinstance(c, HostStringColumn) \
-                    and build.schema.fields[i].dtype.is_string:
-                continue  # dictionary-encodable
-            return None  # nested / other host-carried: no dense form
+                    and build.schema.fields[i].dtype.is_string \
+                    and build.num_rows <= 4096:
+                # small-dimension string payloads (nation/region-class)
+                # ride as dictionary codes; big ones would pay a
+                # probe-length code fetch + decode that the fallback's
+                # output-length gather beats (measured on TPC-H q9/q10)
+                continue
+            return None  # nested / big-string / other host-carried
         return idxs
 
     def _dense_prefetch(self, build: ColumnBatch, conf) -> None:
