@@ -322,3 +322,18 @@ def test_ici_conditioned_broadcast_noninner(sess, rng, how):
                               < F.col("l_price")).expr
     got, want = _both_modes(joined, sess)
     _assert_rows_equal(got, want)
+
+
+def test_ici_existence_join_runs_single_process(shuffle_only, rng):
+    """Existence joins (IN-subquery inside OR) have no SPMD lowering —
+    they must run single-process under shuffle.mode=ICI with correct
+    results."""
+    sess = shuffle_only
+    orders, items = _tables(rng)
+    do = sess.create_dataframe(orders)
+    dl = sess.create_dataframe(items)
+    sub = do.filter(F.col("o_flag") == 1).select("o_orderkey")
+    df = dl.filter(F.col("l_orderkey").isin_subquery(sub)
+                   | (F.col("l_price") > 900.0))
+    got, want = _both_modes(df, sess)
+    _assert_rows_equal(got, want)
