@@ -178,6 +178,75 @@ class HostStringColumn:
         return self.array.to_pylist()
 
 
+class DictStringColumn(HostStringColumn):
+    """A string column carried as DEVICE int32 dictionary codes plus a
+    host arrow dictionary of distinct values.
+
+    The r4 engine paid for strings at every join/agg boundary: payload
+    strings either forced joins off the dense path (host gather + arrow
+    take per output batch) or were fetched+decoded eagerly.  This column
+    keeps codes on device so gathers/scatters/compacts ride the same int
+    kernels as any device column, and the decode (one counted fetch of
+    the codes) happens LAZILY — only when a consumer actually touches
+    ``.array`` (writers, string compute, final collect).
+
+    Subclasses HostStringColumn so every host-string fallback path keeps
+    working unchanged (correctness by default); fast paths special-case
+    it FIRST.  Codes are dictionary-ordered by first occurrence, valid
+    for equality ops only — range comparisons and ORDER BY must decode.
+    """
+
+    def __init__(self, codes, valid, dictionary):
+        import pyarrow as pa
+        self.codes = codes        # jax int32 [capacity]
+        self.valid = valid        # jax bool [capacity] or None
+        if isinstance(dictionary, pa.ChunkedArray):
+            dictionary = dictionary.combine_chunks()
+        self.dictionary = dictionary  # pa.StringArray of distinct values
+        self.dtype = T.STRING
+        self._decoded = None
+
+    @property
+    def capacity(self) -> int:
+        return int(self.codes.shape[0])
+
+    @property
+    def nullable(self) -> bool:
+        return self.valid is not None
+
+    @property
+    def array(self):
+        if self._decoded is None:
+            import pyarrow as pa
+            from .utils.metrics import fetch
+            if self.valid is not None:
+                codes, valid = fetch((self.codes, self.valid))
+            else:
+                codes, valid = fetch(self.codes), None
+            self._decoded = decode_dict_codes(codes, valid, self.dictionary)
+        return self._decoded
+
+    @array.setter
+    def array(self, value):  # pragma: no cover - defensive
+        self._decoded = value
+
+
+def decode_dict_codes(codes, valid, dictionary):
+    """HOST int32 codes (+validity) + arrow dictionary → plain
+    StringArray; out-of-range codes are nulls."""
+    import numpy as np
+    import pyarrow as pa
+    c = np.asarray(codes).astype(np.int64, copy=True)
+    bad = (c < 0) | (c >= len(dictionary))
+    if valid is not None:
+        bad |= ~np.asarray(valid)
+    c[bad] = 0
+    ind = pa.array(c.astype(np.int32), type=pa.int32(),
+                   mask=bad if bad.any() else None)
+    return pa.DictionaryArray.from_arrays(
+        ind, dictionary).dictionary_decode()
+
+
 Column = Union[DeviceColumn, HostStringColumn]
 
 
@@ -228,7 +297,8 @@ class ColumnBatch:
         """Exact live-row count. Syncs with device when a selection exists."""
         if self.sel is None:
             return self.num_rows
-        return int(jnp.sum(self.active_mask()))
+        from .utils.metrics import fetch_scalars
+        return fetch_scalars(jnp.sum(self.active_mask()))[0]
 
     def column(self, name: str) -> Column:
         return self.columns[self.schema.index_of(name)]
@@ -421,11 +491,22 @@ def to_arrow(batch: ColumnBatch):
     if batch.sel is not None:
         fetch[("m", -1)] = batch.active_mask()
     for i, col in enumerate(batch.columns):
-        if isinstance(col, DeviceColumn):
+        if isinstance(col, DictStringColumn):
+            if col._decoded is None:
+                # codes ride in the same single batched fetch
+                fetch[("dc", i)] = col.codes
+                if col.valid is not None:
+                    fetch[("dv", i)] = col.valid
+        elif isinstance(col, DeviceColumn):
             fetch[("d", i)] = col.data
             if col.valid is not None:
                 fetch[("v", i)] = col.valid
-    host = jax.device_get(fetch) if fetch else {}
+    from .utils.metrics import fetch as _counted_fetch
+    host = _counted_fetch(fetch) if fetch else {}
+    for i, col in enumerate(batch.columns):
+        if isinstance(col, DictStringColumn) and ("dc", i) in host:
+            col._decoded = decode_dict_codes(
+                host[("dc", i)], host.get(("dv", i)), col.dictionary)
     mask = None
     if batch.sel is not None:
         mask = host[("m", -1)][: batch.num_rows]

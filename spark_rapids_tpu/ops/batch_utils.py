@@ -17,7 +17,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..batch import ColumnBatch, DeviceColumn, HostStringColumn, Schema, bucket_capacity
+from ..batch import (ColumnBatch, DeviceColumn, DictStringColumn,
+                     HostStringColumn, Schema, bucket_capacity)
+from ..utils.metrics import fetch, fetch_scalars
 
 __all__ = ["concat_batches", "compact", "slice_batch", "gather"]
 
@@ -40,6 +42,19 @@ def concat_batches(batches: Sequence[ColumnBatch],
     cols = []
     for ci, f in enumerate(schema):
         parts = [b.columns[ci] for b in batches]
+        if all(isinstance(p, DictStringColumn) for p in parts) and \
+                all(p.dictionary is parts[0].dictionary for p in parts):
+            # shared dictionary: codes concat on device like any column
+            codes = _pad_dev(jnp.concatenate([p.codes for p in parts]), cap)
+            if any(p.valid is not None for p in parts):
+                valid = _pad_dev(jnp.concatenate([
+                    p.valid if p.valid is not None
+                    else jnp.ones((b.capacity,), dtype=bool)
+                    for b, p in zip(batches, parts)]), cap)
+            else:
+                valid = None
+            cols.append(DictStringColumn(codes, valid, parts[0].dictionary))
+            continue
         if isinstance(parts[0], HostStringColumn):
             import pyarrow as pa
             # host strings: compact each side on host (strings sync anyway)
@@ -47,7 +62,7 @@ def concat_batches(batches: Sequence[ColumnBatch],
             for b, p in zip(batches, parts):
                 a = p.array.slice(0, b.num_rows)
                 if b.sel is not None:
-                    m = np.asarray(b.active_mask())[: b.num_rows]
+                    m = fetch(b.active_mask())[: b.num_rows]
                     a = a.filter(pa.array(m))
                 arrs.append(a)
             cat = pa.concat_arrays(arrs)
@@ -71,7 +86,8 @@ def concat_batches(batches: Sequence[ColumnBatch],
     # selection: each batch contributes its active mask at its offset
     sels = [b.active_mask() for b in batches]
     sel = _pad_dev(jnp.concatenate(sels), cap)
-    has_strings = any(isinstance(c, HostStringColumn) for c in cols)
+    has_strings = any(isinstance(c, HostStringColumn)
+                      and not isinstance(c, DictStringColumn) for c in cols)
     if has_strings:
         # host strings were compacted; device columns were not — mixed batches
         # must compact device side too for row alignment.
@@ -90,9 +106,14 @@ def gather(batch: ColumnBatch, indices: jax.Array, num_rows: int,
     cols = []
     host_idx = None
     for f, c in zip(batch.schema, batch.columns):
+        if isinstance(c, DictStringColumn):
+            codes = c.codes[indices]
+            gv = c.valid[indices] if c.valid is not None else None
+            cols.append(DictStringColumn(codes, gv, c.dictionary))
+            continue
         if isinstance(c, HostStringColumn):
             if host_idx is None:
-                host_idx = np.asarray(indices)
+                host_idx = fetch(indices)
             import pyarrow as pa
             taken = c.array.take(pa.array(np.clip(host_idx, 0, c.capacity - 1),
                                           type=pa.int32()))
@@ -119,15 +140,44 @@ def compact(batch: ColumnBatch, align_host_strings: bool = False,
     if batch.sel is None and not align_host_strings:
         return batch
     active = batch.active_mask()
+    # host string columns need the mask on host anyway: ONE fetch serves
+    # both the live count and the arrow filter (two round trips before)
+    host_mask = None
+    needs_mask = (not align_host_strings) and any(
+        isinstance(c, HostStringColumn)
+        and not isinstance(c, DictStringColumn) for c in batch.columns)
     if n_live is None:
-        n_live = int(jnp.sum(active))
-    # stable partition: sort by (!active) keeps live rows in order at front
-    perm = jnp.lexsort((jnp.arange(batch.capacity, dtype=jnp.int32), ~active))
+        if needs_mask:
+            n_live_d, host_mask = fetch((jnp.sum(active), active))
+            n_live = int(n_live_d)
+        else:
+            n_live = fetch_scalars(jnp.sum(active))[0]
+    elif needs_mask:
+        host_mask = fetch(active)
+    # stable compaction WITHOUT a sort: every live row's destination is
+    # cumsum(active)-1, so one cumsum + a per-column scatter (mode=drop
+    # swallows dead rows) packs the batch.  The previous lexsort+gather
+    # cost ~0.5 s per 8M-capacity batch on this chip; scatters run at
+    # gather speed (PERF.md two-laws), so this is ~20x cheaper and
+    # compiles per capacity bucket exactly like the sort did.
     new_cap = bucket_capacity(max(n_live, min_capacity))
-    perm_trunc = perm[:new_cap] if new_cap <= batch.capacity else jnp.pad(
-        perm, (0, new_cap - batch.capacity))
+    dest = jnp.cumsum(active.astype(jnp.int32)) - 1
+    scatter_idx = jnp.where(active, dest, new_cap)
     cols = []
     for f, c in zip(batch.schema, batch.columns):
+        if isinstance(c, DictStringColumn):
+            # device codes compact like any device column (align mode
+            # included: dict columns ride the device concat, so they are
+            # NOT pre-compacted the way plain host strings are)
+            codes = jnp.zeros((new_cap,), dtype=c.codes.dtype).at[
+                scatter_idx].set(c.codes, mode="drop")
+            if c.valid is not None:
+                valid = jnp.zeros((new_cap,), dtype=bool).at[
+                    scatter_idx].set(c.valid, mode="drop")
+            else:
+                valid = None
+            cols.append(DictStringColumn(codes, valid, c.dictionary))
+            continue
         if isinstance(c, HostStringColumn):
             if align_host_strings:
                 # already compacted during concat; just repad to new capacity
@@ -140,14 +190,20 @@ def compact(batch: ColumnBatch, align_host_strings: bool = False,
                 cols.append(HostStringColumn(a))
             else:
                 import pyarrow as pa
-                m = np.asarray(active)
+                m = host_mask if host_mask is not None else fetch(active)
+                host_mask = m
                 a = c.array.filter(pa.array(m))
                 if len(a) < new_cap:
                     a = pa.concat_arrays([a, pa.nulls(new_cap - len(a), type=a.type)])
                 cols.append(HostStringColumn(a))
             continue
-        data = c.data[perm_trunc]
-        valid = c.valid[perm_trunc] if c.valid is not None else None
+        data = jnp.zeros((new_cap,) + c.data.shape[1:],
+                         dtype=c.data.dtype).at[
+            scatter_idx].set(c.data, mode="drop")
+        valid = None
+        if c.valid is not None:
+            valid = jnp.zeros((new_cap,), dtype=bool).at[
+                scatter_idx].set(c.valid, mode="drop")
         cols.append(DeviceColumn(f.dtype, data, valid))
     return ColumnBatch(batch.schema, cols, n_live)
 
@@ -183,7 +239,7 @@ def compact_packed(batch: ColumnBatch,
                           batch.sel[:cap])
         out.bound = bound
         return out
-    n_live = int(jnp.sum(batch.active_mask()))
+    n_live = fetch_scalars(jnp.sum(batch.active_mask()))[0]
     sliced = ColumnBatch(batch.schema, batch.columns,
                          min(batch.num_rows, n_live))
     return slice_batch(sliced, 0, n_live)
@@ -195,6 +251,15 @@ def slice_batch(batch: ColumnBatch, start: int, length: int) -> ColumnBatch:
     cap = bucket_capacity(length)
     cols = []
     for f, c in zip(batch.schema, batch.columns):
+        if isinstance(c, DictStringColumn):
+            codes = _pad_dev(jax.lax.dynamic_slice_in_dim(
+                c.codes, start, min(length, c.capacity - start)), cap)
+            sv = None
+            if c.valid is not None:
+                sv = _pad_dev(jax.lax.dynamic_slice_in_dim(
+                    c.valid, start, min(length, c.capacity - start)), cap)
+            cols.append(DictStringColumn(codes, sv, c.dictionary))
+            continue
         if isinstance(c, HostStringColumn):
             a = c.array.slice(start, length)
             import pyarrow as pa

@@ -43,6 +43,18 @@ class StringDictionary:
     def __len__(self) -> int:
         return len(self._values)
 
+    @classmethod
+    def from_arrow(cls, dictionary) -> "StringDictionary":
+        """Adopt an arrow dictionary (e.g. a DictStringColumn's) so the
+        column's existing int32 codes are valid under this mapping
+        verbatim — zero re-encode, zero device round trips."""
+        d = cls()
+        vals = dictionary.to_pylist()
+        d._values = [v for v in vals]
+        d._code_of = {v: i for i, v in enumerate(vals) if v is not None}
+        d._arrow_src = dictionary
+        return d
+
     def encode(self, arr) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """pyarrow StringArray → (int32 codes, validity-or-None).
 

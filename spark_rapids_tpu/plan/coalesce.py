@@ -114,7 +114,8 @@ class CoalesceBatchesExec(TpuExec):
             idx = [i for i, v in enumerate(lives)
                    if not isinstance(v, int)]
             if idx:
-                vals = jax.device_get([lives[i] for i in idx])
+                from ..utils.metrics import fetch
+                vals = fetch([lives[i] for i in idx])
                 for i, v in zip(idx, vals):
                     lives[i] = int(v)
             state["known"] = sum(lives)

@@ -158,7 +158,8 @@ class ShuffleExchangeExec(TpuExec):
                         arrays = encode_key_arrays(
                             arrays, batch, self.key_exprs,
                             self.string_dicts)
-                    pids = _np.asarray(pid_fn(
+                    from ..utils.metrics import fetch as _fetch
+                    pids = _fetch(pid_fn(
                         arrays, batch.sel, np.int32(batch.num_rows)))
                     t = to_arrow(batch_utils.compact(batch))
                     active_pids = pids[:batch.capacity]
@@ -262,11 +263,13 @@ class ShuffleExchangeExec(TpuExec):
             # program compiles once instead of once per partition size (a
             # remote-TPU compile costs seconds; there are n_parts of them)
             with m.time("opTime"):
+                from ..utils.metrics import fetch as _fetch
                 counts = np.zeros(self.n_parts + 1, dtype=np.int64)
-                for _, ph in staged:
-                    pid_col = ph.get().columns[0]
+                pid_hosts = _fetch([ph.get().columns[0].data
+                                    for _, ph in staged])
+                for pid_data in pid_hosts:
                     counts += np.bincount(
-                        np.asarray(pid_col.data), minlength=self.n_parts + 1
+                        pid_data, minlength=self.n_parts + 1
                     )[: self.n_parts + 1]
             shared_cap = max(1, int(counts[: self.n_parts].max(initial=0)))
 

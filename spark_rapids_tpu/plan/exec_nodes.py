@@ -77,7 +77,8 @@ class SortExec(TpuExec):
         arrays = tuple(
             (c.data, c.valid) if isinstance(c, DeviceColumn) else None
             for c in batch.columns)
-        return np.asarray(fn(arrays))[: batch.num_rows]
+        from ..utils.metrics import fetch as _fetch
+        return _fetch(fn(arrays))[: batch.num_rows]
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         from ..memory.retry import with_retry

@@ -28,7 +28,7 @@ from ..config import TpuConf
 from ..exprs import (AggregateExpression, Alias, BoundReference, EvalContext,
                      Expression)
 from ..ops import batch_utils, groupby
-from ..utils.metrics import MetricSet
+from ..utils.metrics import MetricSet, fetch, fetch_scalars
 
 __all__ = ["ExecContext", "TpuExec", "ScanExec", "StageExec", "AggregateExec",
            "CollectExec"]
@@ -502,6 +502,13 @@ class AggregateExec(TpuExec):
             if any(f.dtype.is_string
                    for f in self.children[0].output_schema):
                 return None
+            # dense-eligible single-int-key aggregates scatter per batch
+            # into one domain-sized accumulator: coalescing ahead of them
+            # buys nothing on-device and costs a live-count round trip +
+            # concat pass (if the dense path rejects at runtime, the sort
+            # path still merges per-batch partials correctly)
+            if self._dense_agg_static_ok(self._buffer_ops(), conf):
+                return None
             return TargetSize(conf["spark.rapids.tpu.sql.batchSizeRows"])
         return None
 
@@ -810,8 +817,8 @@ class AggregateExec(TpuExec):
                          else None for c in b.columns)
 
         sfn = _cached_program(fp + "|stats", build_stats)
-        kmin, kmax, n_valid = [int(x) for x in np.asarray(
-            sfn(arrays_of(first), first.sel, np.int32(first.num_rows)))]
+        kmin, kmax, n_valid = fetch_scalars(
+            sfn(arrays_of(first), first.sel, np.int32(first.num_rows)))
         if n_valid == 0:
             return None
         domain = kmax - kmin + 1
@@ -883,10 +890,17 @@ class AggregateExec(TpuExec):
 
         ufn = _cached_program(fp + f"|update|{D}", build_update)
 
+        # the domain [kmin, kmax] comes FROM the first batch, so its
+        # valid keys are in-domain by construction: when the first
+        # batch's key column carries no validity mask it PROVABLY
+        # leaves no leftovers, and (in the common single-batch stream)
+        # the leftover flush costs zero round trips
+        kcol = first.columns[key.ordinal]
+        key_nonnull = (isinstance(kcol, DeviceColumn)
+                       and kcol.valid is None)
+
         def run():
             import itertools
-
-            import jax as _jax
             accs = _init_acc()
             present = jnp.zeros((D,), dtype=jnp.int8)
             kmin_s = jnp.int64(kmin)
@@ -894,8 +908,10 @@ class AggregateExec(TpuExec):
             left_parts = []
 
             def flush_leftovers():
+                if not leftovers:
+                    return
                 # ONE batched fetch resolves which batches diverted rows
-                counts = _jax.device_get(
+                counts = fetch(
                     [jnp.sum(b.sel.astype(jnp.int32)) for b in leftovers])
                 for b, cnt in zip(leftovers, counts):
                     if int(cnt):
@@ -903,6 +919,7 @@ class AggregateExec(TpuExec):
                             batch_utils.compact(b)))
                 leftovers.clear()
 
+            first_batch = True
             for batch in itertools.chain([first], rest):
                 if batch.num_rows == 0:
                     continue
@@ -912,9 +929,11 @@ class AggregateExec(TpuExec):
                         np.int32(batch.num_rows), tuple(accs), present,
                         kmin_s)
                     accs = list(accs_t)
-                leftovers.append(
-                    ColumnBatch(batch.schema, batch.columns,
-                                batch.num_rows, leftover))
+                if not (first_batch and key_nonnull):
+                    leftovers.append(
+                        ColumnBatch(batch.schema, batch.columns,
+                                    batch.num_rows, leftover))
+                first_batch = False
                 if len(leftovers) >= 8:  # bound pinned input batches
                     flush_leftovers()
             m.add("aggDensePath", 1)
@@ -932,7 +951,7 @@ class AggregateExec(TpuExec):
             if left_parts:
                 m.add("numOutputRows", out.row_count())
             else:
-                m.add("numOutputRows", int(_jax.device_get(n_groups_dev)))
+                m.add_deferred("numOutputRows", n_groups_dev)
             yield out
 
         return run()
@@ -1262,8 +1281,8 @@ class AggregateExec(TpuExec):
             if isinstance(c, DeviceColumn) else None
             for c in batch.columns)
         sel = batch.sel[:scap] if batch.sel is not None else None
-        n_distinct, n_live = [int(x) for x in np.asarray(
-            fn(arrays, sel, np.int32(min(srows, scap))))]
+        n_distinct, n_live = fetch_scalars(
+            fn(arrays, sel, np.int32(min(srows, scap))))
         if n_live == 0:
             return 0.0
         return float(n_distinct) / float(n_live)
@@ -1294,6 +1313,7 @@ class AggregateExec(TpuExec):
         refs = self._string_key_refs()
         if not refs:
             return batch
+        from ..batch import DictStringColumn
         from ..ops.strings import StringDictionary
         cols = list(batch.columns)
         changed = False
@@ -1301,6 +1321,21 @@ class AggregateExec(TpuExec):
             col = cols[ordn]
             if not isinstance(col, HostStringColumn):
                 continue  # already encoded (or device data)
+            if isinstance(col, DictStringColumn):
+                # join outputs carry device dictionary codes already: adopt
+                # the dictionary (codes valid verbatim) — no host encode,
+                # no decode, no upload
+                d = self.string_dicts.get(gi)
+                if d is None or getattr(d, "_arrow_src", None) \
+                        is col.dictionary:
+                    if d is None:
+                        self.string_dicts[gi] = StringDictionary.from_arrow(
+                            col.dictionary)
+                    cols[ordn] = DeviceColumn(T.STRING, col.codes, col.valid)
+                    changed = True
+                    continue
+                # incompatible existing dictionary: decode (lazy .array)
+                # and fall through to the host re-encode below
             d = self.string_dicts.get(gi)
             cached = getattr(col, "_enc_cache", None)
             if d is None and cached is not None:
@@ -1330,16 +1365,16 @@ class AggregateExec(TpuExec):
         if not self.string_dicts or self.mode == "partial":
             return out
         cols = list(out.columns)
-        fetch = {}
+        fetch_tree = {}
         for gi in self.string_dicts:
             col = cols[gi]
             if isinstance(col, DeviceColumn):
-                fetch[("c", gi)] = col.data
+                fetch_tree[("c", gi)] = col.data
                 if col.valid is not None:
-                    fetch[("v", gi)] = col.valid
-        if not fetch:
+                    fetch_tree[("v", gi)] = col.valid
+        if not fetch_tree:
             return out
-        host = jax.device_get(fetch)
+        host = fetch(fetch_tree)
         for gi, d in self.string_dicts.items():
             col = cols[gi]
             if not isinstance(col, DeviceColumn):

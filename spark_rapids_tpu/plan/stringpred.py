@@ -308,8 +308,10 @@ def evaluate_host_expr(expr: E.Expression, ords: List[int], columns,
             else:
                 # device ref feeding a host-output expression (creator
                 # shape): fetch the column
-                d_ = np.asarray(col.data)[:num_rows]
-                v_ = None if col.valid is None                     else np.asarray(col.valid)[:num_rows]
+                from ..utils.metrics import fetch as _fetch
+                d_, v_ = _fetch((col.data, col.valid))
+                d_ = d_[:num_rows]
+                v_ = None if v_ is None else v_[:num_rows]
                 arrays.append((d_, v_))
         d, v = eval_cpu(remapped, arrays, num_rows)
         data = np.asarray(d)

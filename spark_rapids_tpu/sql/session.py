@@ -298,9 +298,18 @@ class Session:
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
+        # expose the last query's per-operator metrics + plan for
+        # debugging/profiling (sess.last_exec_context().metrics)
+        self._last_ctx = ctx
+        self._last_phys = phys
         with get_semaphore(conf).acquire():
             phys = self._distribute_if_ici(phys, ctx)
             return CollectExec(phys).collect_arrow(ctx)
+
+    def last_exec_context(self):
+        """ExecContext of the most recent collect (per-operator MetricSet
+        map keyed by op id) — the EXPLAIN-with-metrics debugging surface."""
+        return getattr(self, "_last_ctx", None)
 
     def _execute_batches(self, plan: L.LogicalPlan):
         """Stream the result as pyarrow Tables, one per output batch —

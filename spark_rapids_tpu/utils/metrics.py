@@ -16,7 +16,152 @@ from typing import Dict
 
 import jax
 
-__all__ = ["MetricSet", "TaskMetrics", "trace_range"]
+__all__ = ["MetricSet", "TaskMetrics", "QueryStats", "trace_range",
+           "fetch", "fetch_scalars", "sync_budget"]
+
+
+class QueryStats:
+    """Process-global sync/compile profile (VERDICT r4 item 2).
+
+    The reference's per-query NVTX + SQL-metric story answers "where did
+    the time go"; on a remote-tunneled TPU the two questions that matter
+    are *how many blocking device→host fetches did this query issue*
+    (each is a ~0.1-0.2 s round-trip on the tunnel) and *how many XLA
+    programs did it compile* (each is seconds).  Every blocking fetch in
+    the engine routes through :func:`fetch`/:func:`fetch_scalars`;
+    compiles are counted by a ``jax.monitoring`` listener on
+    ``/jax/core/compile/backend_compile_duration``.
+
+    ``bench.py`` snapshots this around each timed run and emits the
+    deltas in the per-query JSON.
+    """
+
+    _current: "QueryStats" = None
+    _listener_installed = False
+
+    def __init__(self):
+        self.blocking_fetches = 0
+        self.fetch_bytes = 0
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.uploads = 0
+        self.upload_bytes = 0
+
+    # -- global accessors ---------------------------------------------------
+    @classmethod
+    def get(cls) -> "QueryStats":
+        if cls._current is None:
+            cls._current = QueryStats()
+            cls._install_listener()
+        return cls._current
+
+    @classmethod
+    def _install_listener(cls):
+        if cls._listener_installed:
+            return
+        cls._listener_installed = True
+
+        def on_duration(event: str, duration: float, **kw):
+            if event == "/jax/core/compile/backend_compile_duration" \
+                    and cls._current is not None:
+                cls._current.compiles += 1
+                cls._current.compile_s += duration
+
+        jax.monitoring.register_event_duration_secs_listener(on_duration)
+
+    def snapshot(self) -> Dict[str, float]:
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self.__dict__.items()}
+
+    @classmethod
+    def reset(cls) -> "QueryStats":
+        s = cls.get()
+        s.__init__()
+        return s
+
+    @classmethod
+    def delta_since(cls, before: Dict[str, float]) -> Dict[str, float]:
+        now = cls.get().snapshot()
+        return {k: (round(now[k] - before.get(k, 0), 4)
+                    if isinstance(now[k], float)
+                    else now[k] - before.get(k, 0)) for k in now}
+
+
+import os as _os
+
+_TRACE_SYNCS = bool(_os.environ.get("SRT_SYNC_TRACE"))
+SYNC_TRACE: list = []  # [(call-site, seconds)] when SRT_SYNC_TRACE is set
+
+
+def _tree_nbytes(host) -> int:
+    import numpy as np
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(host):
+        if isinstance(leaf, np.ndarray):
+            total += leaf.nbytes
+        elif isinstance(leaf, np.generic):
+            total += leaf.nbytes
+    return total
+
+
+def fetch(tree):
+    """The engine's ONE blocking device→host transfer choke point.
+
+    Counts a single blocking round-trip regardless of how many arrays
+    ride in the tree (jax.device_get batches them into one transfer),
+    plus the bytes moved.  All hot-path syncs route through here so the
+    per-query sync profile in bench output is trustworthy.
+    """
+    s = QueryStats.get()
+    s.blocking_fetches += 1
+    if _TRACE_SYNCS:
+        import time as _t
+        import traceback
+        t0 = _t.perf_counter()
+        host = jax.device_get(tree)
+        dt = _t.perf_counter() - t0
+        site = "|".join(
+            f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
+            for f in traceback.extract_stack(limit=6)[:-1])
+        SYNC_TRACE.append((site, round(dt, 4)))
+    else:
+        host = jax.device_get(tree)
+    s.fetch_bytes += _tree_nbytes(host)
+    _check_budget()
+    return host
+
+
+def fetch_scalars(x) -> list:
+    """Fetch a small device array of scalars as a list of Python ints."""
+    import numpy as np
+    return [int(v) for v in np.ravel(fetch(x))]
+
+
+class _SyncBudget:
+    """Test-only enforcement: raise when a scope exceeds its fetch budget."""
+    limit = None
+    label = ""
+
+
+def _check_budget():
+    if _SyncBudget.limit is not None:
+        n = QueryStats.get().blocking_fetches
+        if n > _SyncBudget.limit:
+            raise AssertionError(
+                f"sync budget exceeded in {_SyncBudget.label}: "
+                f"{n} blocking fetches > limit {_SyncBudget.limit}")
+
+
+@contextlib.contextmanager
+def sync_budget(limit: int, label: str = "scope"):
+    """Enforce a blocking-fetch budget over a scope (regression tests)."""
+    QueryStats.reset()
+    _SyncBudget.limit = limit
+    _SyncBudget.label = label
+    try:
+        yield QueryStats.get()
+    finally:
+        _SyncBudget.limit = None
 
 
 class MetricSet:
@@ -32,9 +177,25 @@ class MetricSet:
         self.op_id = op_id
         self.level = level
         self.values: Dict[str, float] = defaultdict(float)
+        self._deferred: list = []  # [(name, device scalar)]
 
     def add(self, name: str, amount: float) -> None:
         self.values[name] += amount
+
+    def add_deferred(self, name: str, device_scalar) -> None:
+        """Count a device scalar WITHOUT a blocking fetch: the value is
+        resolved only when the metric is actually read.  Metrics-only
+        round trips on the tunneled backend cost ~0.1-0.2 s each — a
+        query must never pay one for a counter nobody looks at."""
+        self._deferred.append((name, device_scalar))
+
+    def _resolve(self) -> None:
+        if not self._deferred:
+            return
+        pending, self._deferred = self._deferred, []
+        vals = fetch([v for _, v in pending])
+        for (name, _), v in zip(pending, vals):
+            self.values[name] += int(v)
 
     @contextlib.contextmanager
     def time(self, name: str):
@@ -50,9 +211,11 @@ class MetricSet:
         self.values[name] += time.perf_counter() - t0
 
     def __getitem__(self, name: str) -> float:
+        self._resolve()
         return self.values.get(name, 0.0)
 
     def __repr__(self):
+        self._resolve()
         inner = ", ".join(f"{k}={v:.4g}" for k, v in sorted(self.values.items()))
         return f"MetricSet({self.op_id}: {inner})"
 
