@@ -1,8 +1,8 @@
-"""Benchmark: the full TPC-H suite (q1..q22) + TPC-DS starters
-(q3/q42/q52/q55/q7) through the engine vs pandas on CPU, at SF1.
+"""Benchmark: the full TPC-H suite (q1..q22) + 22 TPC-DS queries
+(incl. the q64/q95 shuffle-stress pair) vs pandas on CPU, at SF1.
 
 Prints ONE JSON line:
-  {"metric": "tpch22_tpcds5_geomean_speedup_vs_cpu", "value": <x>,
+  {"metric": "tpch22_tpcds22_geomean_speedup_vs_cpu", "value": <x>,
    "unit": "x", "vs_baseline": <x>, "q1": {...}, ..., "ds_q7": {...}}
 
 The reference's headline claim is 3-7x (4x typical) end-to-end speedup
@@ -31,7 +31,12 @@ DATA_DIR = os.path.join(REPO, ".bench_data")
 REFERENCE_TYPICAL_SPEEDUP = 4.0  # docs/FAQ.md:107-109 "4x typical"
 
 TPCH_QUERIES = [f"q{i}" for i in range(1, 23)]
-TPCDS_QUERIES = ["ds_q3", "ds_q42", "ds_q52", "ds_q55", "ds_q7"]
+TPCDS_QUERIES = [
+    "ds_q3", "ds_q7", "ds_q12", "ds_q13", "ds_q19", "ds_q20", "ds_q25",
+    "ds_q26", "ds_q34", "ds_q42", "ds_q46", "ds_q48", "ds_q52", "ds_q55",
+    "ds_q64", "ds_q65", "ds_q68", "ds_q73", "ds_q79", "ds_q94", "ds_q95",
+    "ds_q98",
+]
 ALL_QUERIES = TPCH_QUERIES + TPCDS_QUERIES
 
 
@@ -97,6 +102,9 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
         "compiles_cold": cold_stats["compiles"],
         "compile_s_cold": cold_stats["compile_s"],
         "compiles_warm": warm_stats["compiles"],
+        "shuffle_mb_warm": round(warm_stats["shuffle_bytes"] / 1e6, 3),
+        "shuffle_gbps_warm": round(
+            warm_stats["shuffle_bytes"] / 1e9 / engine_s, 4),
     }
 
 
@@ -145,7 +153,7 @@ def _run_isolated(sf: float, iters: int, which) -> None:
     geomean = (math.exp(sum(math.log(s) for s in speedups) / len(speedups))
                if speedups else 0.0)
     out = {
-        "metric": "tpch22_tpcds5_geomean_speedup_vs_cpu",
+        "metric": "tpch22_tpcds22_geomean_speedup_vs_cpu",
         "value": round(geomean, 4),
         "unit": "x",
         "vs_baseline": round(geomean / REFERENCE_TYPICAL_SPEEDUP, 4),

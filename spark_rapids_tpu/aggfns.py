@@ -54,6 +54,9 @@ class Sum(AggregateExpression):
     overflow past the result precision raises under ANSI, else NULL."""
 
     func = "sum"
+    input_sig = (T.TypeSig.device_compute
+                 + T.TypeSig((T.TypeKind.DECIMAL,),
+                             max_decimal_precision=38))
     output_sig = (T.TypeSig.device_compute
                   + T.TypeSig((T.TypeKind.DECIMAL,),
                               max_decimal_precision=38))
@@ -61,6 +64,7 @@ class Sum(AggregateExpression):
     def _resolve(self):
         c = self.children[0].dtype
         self._wide = False
+        self._wide_in = False
         if c.is_integral or c.kind == T.TypeKind.BOOLEAN:
             self.dtype = T.INT64
         elif c.is_floating:
@@ -69,6 +73,7 @@ class Sum(AggregateExpression):
             rp = min(c.precision + 10, 38)
             self.dtype = T.decimal(rp, c.scale)
             self._wide = rp > 18
+            self._wide_in = getattr(c, "is_wide_decimal", False)
         else:
             raise TypeError(f"sum of {c} not supported")
         self.nullable = True
@@ -78,12 +83,33 @@ class Sum(AggregateExpression):
         return getattr(self, "_wide", False)
 
     def buffers(self):
+        if getattr(self, "_wide_in", False):
+            # wide INPUT (two-limb columns): four carry-free 32-bit-chunk
+            # lanes (lo0, lo1, hi0, hi1-signed) — every lane sum is
+            # < 2^63 for up to 2^31 rows, so reconstruction at finalize
+            # is exact for ANY summable input, cancellation included
+            return [(T.INT64, "sum"), (T.INT64, "sum"), (T.INT64, "sum"),
+                    (T.INT64, "sum"), (T.INT64, "sum")]
         if getattr(self, "_wide", False):
             return [(T.INT64, "sum"), (T.INT64, "sum"), (T.INT64, "sum")]
         return [(self.dtype, "sum"), (T.INT64, "sum")]
 
     def update(self, ctx) -> List[Value]:
         d, v = self.children[0].eval(ctx)
+        if getattr(self, "_wide_in", False):
+            import jax
+            lo, hi = d[..., 0], d[..., 1]
+            if v is not None:
+                z = jnp.zeros_like(lo)
+                lo = jnp.where(v, lo, z)
+                hi = jnp.where(v, hi, z)
+            m32 = jnp.int64(0xFFFFFFFF)
+            l0 = lo & m32
+            l1 = jax.lax.shift_right_logical(lo, jnp.int64(32))
+            h0 = hi & m32
+            h1 = hi >> jnp.int64(32)  # arithmetic: keeps the sign
+            return [(l0, None), (l1, None), (h0, None), (h1, None),
+                    (_valid_indicator(v, ctx), None)]
         if getattr(self, "_wide", False):
             d = d.astype(jnp.int64)  # scaled ints (input precision <= 18)
             if v is not None:
@@ -104,13 +130,19 @@ class Sum(AggregateExpression):
     def finalize_host(self, buffers, n_rows: int, ansi: bool):
         """Exact host reconstruction of wide sums: arrow decimal128.
         Vectorized in object space — python ints are arbitrary precision,
-        so (hi << 32) + lo is exact past int64."""
+        so the limb recombination is exact past int64."""
         import decimal as _dec
 
         import numpy as np
         import pyarrow as pa
-        lo, hi, cnt = [np.asarray(b[0][:n_rows]) for b in buffers]
-        totals = (hi.astype(object) << 32) + lo.astype(object)
+        if getattr(self, "_wide_in", False):
+            l0, l1, h0, h1, cnt = [np.asarray(b[0][:n_rows])
+                                   for b in buffers]
+            totals = ((h1.astype(object) << 96) + (h0.astype(object) << 64)
+                      + (l1.astype(object) << 32) + l0.astype(object))
+        else:
+            lo, hi, cnt = [np.asarray(b[0][:n_rows]) for b in buffers]
+            totals = (hi.astype(object) << 32) + lo.astype(object)
         bound = 10 ** self.dtype.precision
         over = np.array([abs(t) >= bound for t in totals]) & (cnt > 0)
         if ansi and over.any():

@@ -386,7 +386,7 @@ def logical_to_arrow(dt: DataType):
 def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
     if arr.shape[0] == capacity:
         return arr
-    out = np.zeros((capacity,), dtype=arr.dtype)
+    out = np.zeros((capacity,) + arr.shape[1:], dtype=arr.dtype)
     out[: arr.shape[0]] = arr
     return out
 
@@ -417,14 +417,19 @@ def from_arrow(table, min_capacity: int = 1024, device=None) -> ColumnBatch:
         dt = _arrow_to_logical(col.type)
         fields.append(Field(name, dt, col.null_count > 0))
         if dt.is_string or dt.is_nested or \
-                (dt.is_decimal and dt.precision > 18):
-            # no device representation (decimal>18 would need emulated
-            # 128-bit) — ride as a host column; sig tagging keeps compute
-            # over these off the device
+                (dt.is_decimal and dt.precision > 38):
+            # no device representation (decimal>38 exceeds the emulated
+            # 128-bit limbs) — ride as a host column; sig tagging keeps
+            # compute over these off the device
             cols.append(HostStringColumn(col, capacity=cap))
             continue
-        if dt.is_decimal:
-            # Arrow decimal128 → scaled int64 (precision <= 18 guaranteed above).
+        if dt.is_wide_decimal:
+            # Arrow decimal128 → (n, 2) int64 limbs [lo, hi] of the
+            # scaled two's-complement value (emulated int128)
+            data = _pad_to(wide_decimal_limbs(col, dt.scale), cap)
+            valid_np = np.asarray(col.is_valid())
+        elif dt.is_decimal:
+            # Arrow decimal128 → scaled int64 (precision <= 18 here).
             scaled = np.array(
                 [int(v.scaleb(dt.scale)) if v is not None else 0
                  for v in (x.as_py() for x in col)], dtype=np.int64)
@@ -475,6 +480,32 @@ def from_numpy(data: Dict[str, np.ndarray], min_capacity: int = 1024) -> ColumnB
         fields.append(Field(name, dt, False))
         cols.append(DeviceColumn(dt, jnp.asarray(_pad_to(arr, cap))))
     return ColumnBatch(Schema(fields), cols, n)
+
+
+def wide_decimal_limbs(col, scale: int) -> np.ndarray:
+    """pyarrow decimal128 array → (n, 2) int64 [lo, hi] limbs of the
+    scaled value (python ints are arbitrary precision, so the split is
+    exact; nulls become zero limbs under their validity mask)."""
+    n = len(col)
+    out = np.zeros((n, 2), dtype=np.int64)
+    mask64 = (1 << 64) - 1
+    for i, x in enumerate(col):
+        v = x.as_py()
+        if v is None:
+            continue
+        u = int(v.scaleb(scale)) & ((1 << 128) - 1)
+        lo = u & mask64
+        hi = u >> 64
+        out[i, 0] = lo - (1 << 64) if lo >= (1 << 63) else lo
+        out[i, 1] = hi - (1 << 64) if hi >= (1 << 63) else hi
+    return out
+
+
+def wide_limbs_to_ints(data: np.ndarray) -> np.ndarray:
+    """(n, 2) int64 limbs → object array of exact python ints."""
+    lo = data[:, 0].astype(object) & ((1 << 64) - 1)
+    hi = data[:, 1].astype(object)
+    return (hi << 64) + lo
 
 
 def to_arrow(batch: ColumnBatch):
@@ -533,6 +564,14 @@ def to_arrow(batch: ColumnBatch):
             arrays.append(pa.array(data.astype("datetime64[us]"),
                                    type=pa.timestamp("us"),
                                    mask=(~valid if valid is not None else None)))
+        elif f.dtype.is_wide_decimal:
+            from decimal import Decimal
+            scale = f.dtype.scale
+            ints = wide_limbs_to_ints(data)
+            vals = [None if (valid is not None and not valid[i])
+                    else Decimal(int(ints[i])).scaleb(-scale)
+                    for i in range(len(data))]
+            arrays.append(pa.array(vals, type=logical_to_arrow(f.dtype)))
         elif f.dtype.is_decimal:
             from decimal import Decimal
             scale = f.dtype.scale

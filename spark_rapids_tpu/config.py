@@ -228,6 +228,28 @@ AGG_DENSE_ENABLED = register(
     "group keys; the domain cap is join.denseDomainCap. Off = always "
     "use the sort-based kernel.")
 
+AGG_DENSE_MAX_ACCUM = register(
+    "spark.rapids.tpu.sql.agg.dense.maxAccumBytes", 1_500_000_000,
+    "HBM budget for the multi-key dense aggregation's accumulators "
+    "(primary-key domain x (residual min/max/validity channels + "
+    "aggregate buffers)). Plans whose estimate exceeds it use the "
+    "sort-based kernel.", conv=int)
+
+ICI_OVERFLOW_RETRIES = register(
+    "spark.rapids.tpu.shuffle.ici.overflowRetries", 2,
+    "Transparent recovery attempts when an ICI fragment's fixed-capacity "
+    "exchange bucket or join expansion overflows: each retry re-lowers "
+    "the fragment with every static capacity scaled 4x and re-runs it "
+    "(split-retry analog for static SPMD shapes). 0 = raise immediately.",
+    conv=int)
+
+AQE_ENABLED = register(
+    "spark.rapids.tpu.sql.aqe.enabled", True,
+    "Adaptive re-planning at exchange boundaries: a shuffled join whose "
+    "staged build input is ACTUALLY under autoBroadcastJoinThreshold "
+    "flips to a broadcast join at runtime (GpuCustomShuffleReaderExec / "
+    "runtime re-plan analog). Shuffle staging is reused either way.")
+
 DPP_ENABLED = register(
     "spark.rapids.tpu.sql.dpp.enabled", True,
     "Dynamic partition pruning: after a broadcast join's build side "

@@ -141,6 +141,9 @@ class UnresolvedColumn(Expression):
 
 
 class BoundReference(Expression):
+    input_sig = TypeSig.device_compute + TypeSig.decimal128
+    output_sig = TypeSig.device_compute + TypeSig.decimal128
+
     def __init__(self, ordinal: int, dtype: DataType, nullable: bool, name: str = ""):
         self.ordinal = ordinal
         self.dtype = dtype
@@ -156,6 +159,9 @@ class BoundReference(Expression):
 
 
 class Literal(Expression):
+    input_sig = TypeSig.device_compute + TypeSig.decimal128
+    output_sig = TypeSig.device_compute + TypeSig.decimal128
+
     def __init__(self, value: Any, dtype: Optional[DataType] = None):
         self.value = value
         self.dtype = dtype if dtype is not None else _infer_literal_type(value)
@@ -163,9 +169,18 @@ class Literal(Expression):
         self.children = ()
 
     def eval(self, ctx: EvalContext) -> Value:
+        wide = getattr(self.dtype, "is_wide_decimal", False)
         if self.value is None:
-            data = jnp.zeros((ctx.capacity,), dtype=self.dtype.numpy_dtype)
+            shape = (ctx.capacity, 2) if wide else (ctx.capacity,)
+            data = jnp.zeros(shape, dtype=self.dtype.numpy_dtype)
             return data, jnp.zeros((ctx.capacity,), dtype=jnp.bool_)
+        if wide:
+            u = int(physical_literal(self.value, self.dtype)) & ((1 << 128) - 1)
+            lo, hi = u & ((1 << 64) - 1), u >> 64
+            lo = lo - (1 << 64) if lo >= (1 << 63) else lo
+            hi = hi - (1 << 64) if hi >= (1 << 63) else hi
+            row = jnp.asarray(np.array([lo, hi], dtype=np.int64))
+            return jnp.broadcast_to(row, (ctx.capacity, 2)), None
         data = jnp.full((ctx.capacity,), physical_literal(self.value, self.dtype),
                         dtype=self.dtype.numpy_dtype)
         return data, None
@@ -196,8 +211,14 @@ def physical_literal(v: Any, dtype: DataType):
 
 def _infer_literal_type(v: Any) -> DataType:
     import datetime
+    import decimal as _dec
     if v is None:
         return T.NULLTYPE
+    if isinstance(v, _dec.Decimal):
+        sign, digits, exp = v.as_tuple()
+        scale = max(0, -exp)
+        precision = max(len(digits), scale)
+        return T.decimal(min(precision, 38), scale)
     if isinstance(v, bool):
         return T.BOOLEAN
     if isinstance(v, int):
@@ -218,6 +239,9 @@ def _infer_literal_type(v: Any) -> DataType:
 
 
 class Alias(Expression):
+    input_sig = TypeSig.device_compute + TypeSig.decimal128
+    output_sig = TypeSig.device_compute + TypeSig.decimal128
+
     def __init__(self, child: Expression, name: str):
         self.children = (child,)
         self.name = name
@@ -273,7 +297,38 @@ def promote_physical(data: jax.Array, src: DataType, dst: DataType) -> jax.Array
     10^scale; promotion must rescale (decimal→float divides by 10^scale,
     decimal→decimal shifts by the scale delta, int→decimal multiplies in).
     """
+    from .ops import wide_decimal as _wd
     np_dt = dst.numpy_dtype
+    src_wide = getattr(src, "is_wide_decimal", False)
+    dst_wide = getattr(dst, "is_wide_decimal", False)
+    if dst_wide:
+        # target is two-limb int128: lift then rescale by the scale delta
+        if src_wide:
+            limbs = data
+            delta = dst.scale - src.scale
+        elif src.is_decimal:
+            limbs = _wd.from_scaled64(data)
+            delta = dst.scale - src.scale
+        else:  # integral / bool operand joining a wide computation
+            limbs = _wd.from_scaled64(data.astype(jnp.int64))
+            delta = dst.scale
+        if delta < 0:
+            raise TypeError(
+                f"wide-decimal down-scale {src} -> {dst} not supported "
+                "on device")
+        return _wd.mul_pow10(limbs, delta)
+    if src_wide and dst.is_floating:
+        # lossy by definition (like Spark's Decimal.toDouble): recombine
+        # limbs in float64 space, then unscale
+        lo, hi = data[..., 0], data[..., 1]
+        lo_f = jnp.where(lo >= 0, lo.astype(jnp.float64),
+                         lo.astype(jnp.float64) + np.float64(2.0 ** 64))
+        val = hi.astype(jnp.float64) * np.float64(2.0 ** 64) + lo_f
+        return (val / np.float64(10.0 ** src.scale)).astype(np_dt)
+    if src_wide:
+        raise TypeError(
+            f"wide-decimal narrowing {src} -> {dst} not supported on "
+            "device")
     if src.is_decimal and dst.is_floating:
         return data.astype(np_dt) / np.float64(10.0 ** src.scale).astype(np_dt)
     if src.is_decimal and dst.is_decimal:
@@ -322,17 +377,27 @@ class BinaryExpression(Expression):
 
 class Add(BinaryExpression):
     symbol = "+"
+    input_sig = TypeSig.device_compute + TypeSig.decimal128
+    output_sig = TypeSig.device_compute + TypeSig.decimal128
 
     def eval(self, ctx):
         ld, rd, v = self._eval_children_promoted(ctx)
+        if getattr(self._operand_type(), "is_wide_decimal", False):
+            from .ops import wide_decimal as _wd
+            return _wd.add(ld, rd), v
         return ld + rd, v
 
 
 class Subtract(BinaryExpression):
     symbol = "-"
+    input_sig = TypeSig.device_compute + TypeSig.decimal128
+    output_sig = TypeSig.device_compute + TypeSig.decimal128
 
     def eval(self, ctx):
         ld, rd, v = self._eval_children_promoted(ctx)
+        if getattr(self._operand_type(), "is_wide_decimal", False):
+            from .ops import wide_decimal as _wd
+            return _wd.sub(ld, rd), v
         return ld - rd, v
 
 
@@ -466,6 +531,9 @@ class Abs(Expression):
 
 class BinaryComparison(BinaryExpression):
     op: Callable = None
+    wide_op: str = None  # wide_decimal function name (limb comparisons)
+    input_sig = TypeSig.device_compute + TypeSig.decimal128
+    output_sig = TypeSig.BOOLEAN
 
     def _result_type(self, lt, rt):
         T.common_type(lt, rt)  # raises on incomparable
@@ -476,32 +544,44 @@ class BinaryComparison(BinaryExpression):
 
     def eval(self, ctx):
         ld, rd, v = self._eval_children_promoted(ctx)
+        if getattr(self._operand_type(), "is_wide_decimal", False):
+            from .ops import wide_decimal as _wd
+            name = type(self).wide_op
+            if name is None:
+                raise TypeError(
+                    f"{type(self).__name__} unsupported for decimal128")
+            return getattr(_wd, name)(ld, rd), v
         return type(self).op(ld, rd), v
 
 
 class EqualTo(BinaryComparison):
     symbol = "="
     op = staticmethod(lambda a, b: a == b)
+    wide_op = "eq"
 
 
 class LessThan(BinaryComparison):
     symbol = "<"
     op = staticmethod(lambda a, b: a < b)
+    wide_op = "lt"
 
 
 class LessThanOrEqual(BinaryComparison):
     symbol = "<="
     op = staticmethod(lambda a, b: a <= b)
+    wide_op = "le"
 
 
 class GreaterThan(BinaryComparison):
     symbol = ">"
     op = staticmethod(lambda a, b: a > b)
+    wide_op = "gt"
 
 
 class GreaterThanOrEqual(BinaryComparison):
     symbol = ">="
     op = staticmethod(lambda a, b: a >= b)
+    wide_op = "ge"
 
 
 class EqualNullSafe(BinaryExpression):

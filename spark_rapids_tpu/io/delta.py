@@ -17,7 +17,7 @@ merge-on-read DELETE via `deletion_vectors.py`); column mapping mode
 name/id (read + DV delete — rewrite commands reject mapped tables);
 optimistic concurrent-writer commits with conflict detection and retry;
 Change Data Feed (write on DELETE/UPDATE, read via `table_changes`).
-Not implemented: generated columns, CDF for MERGE, row tracking, v2
+Not implemented: generated columns, row tracking, v2
 checkpoints.
 """
 
@@ -30,7 +30,8 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["DeltaTable", "read_delta", "write_delta",
-           "delta_delete", "delta_update", "delta_merge", "table_changes",
+           "delta_delete", "delta_update", "delta_merge", "delta_zorder",
+           "table_changes",
            "ConcurrentModificationError", "ConcurrentAppendError",
            "ConcurrentDeleteError"]
 
@@ -611,6 +612,8 @@ def delta_merge(session, path: str, source_df, on: List[str],
     for old, new in ren.items():
         src_renamed = src_renamed.with_column_renamed(old, new)
 
+    cdf = table.cdf_enabled()
+    cdc_tables = []
     removes, adds = [], []
     for rel, pvals in sorted(table.active.items()):
         fpath = os.path.join(path, rel)
@@ -619,10 +622,19 @@ def delta_merge(session, path: str, source_df, on: List[str],
             tdf = tdf.with_column(c, F.lit(
                 None if pvals.get(c) is None else _typed(pvals[c])))
         pairs = [(k, k) for k in on]
-        n_match = tdf.join(source_df, on=pairs, how="semi").count()
+        if cdf:
+            # the semi-join result serves BOTH the touched-file check
+            # and the change pre-image (one execution, not two)
+            pre = (tdf.join(source_df, on=pairs, how="semi")
+                   .select(*target_cols).to_arrow())
+            n_match = pre.num_rows
+        else:
+            n_match = tdf.join(source_df, on=pairs, how="semi").count()
         if n_match == 0:
             continue
         if matched == "delete":
+            if cdf:
+                cdc_tables.append(_with_change_type(pre, "delete"))
             out_df = tdf.join(source_df, on=pairs, how="anti")
         else:
             n_target = tdf.count()
@@ -643,6 +655,14 @@ def delta_merge(session, path: str, source_df, on: List[str],
                     F.when(F.col(f"__src_{on[0]}").is_not_null(),
                            F.col(f"__src_{scol}"))
                     .otherwise(F.col(tcol)))
+            if cdf:
+                cdc_tables.append(
+                    _with_change_type(pre, "update_preimage"))
+                post = (out_df
+                        .filter(F.col(f"__src_{on[0]}").is_not_null())
+                        .select(*target_cols).to_arrow())
+                cdc_tables.append(
+                    _with_change_type(post, "update_postimage"))
         out_df = out_df.select(*[c for c in target_cols
                                  if c not in part_cols])
         removes.append(rel)
@@ -663,7 +683,11 @@ def delta_merge(session, path: str, source_df, on: List[str],
         inserts = source_df.join(
             target, on=[(k, k) for k in on], how="anti") \
             .select(*target_cols)
-        if inserts.count() > 0:
+        ins_t = inserts.to_arrow() if cdf else None
+        if cdf and ins_t.num_rows:
+            cdc_tables.append(_with_change_type(ins_t, "insert"))
+        n_ins = ins_t.num_rows if cdf else inserts.count()
+        if n_ins > 0:
             # route through the partitioned writer so inserted rows land in
             # their key=value directories with correct partitionValues
             from .writers import DataFrameWriter
@@ -680,7 +704,97 @@ def delta_merge(session, path: str, source_df, on: List[str],
     source_df.unpersist()
     if not removes and not adds:
         return table.version
-    return _commit(path, table.version, "MERGE", removes, adds)
+    return _commit(path, table.version, "MERGE", removes, adds,
+                   cdc_files=_write_cdc_files(path, cdc_tables))
+
+
+def delta_zorder(session, path: str, columns: List[str],
+                 target_file_rows: int = 1 << 20) -> int:
+    """OPTIMIZE ZORDER BY: rewrite each partition's files clustered along
+    the Morton curve of ``columns`` (zorder/ZOrderRules.scala +
+    GpuInterleaveBits analog).
+
+    Each z-column min-max normalizes to its bit budget (64 // n bits) on
+    device, the interleaved index sorts the partition, and the rows
+    rewrite in ``target_file_rows`` chunks.  The commit removes the old
+    files and adds the clustered ones with dataChange=false semantics of
+    OPTIMIZE (data identical, layout changed)."""
+    import pyarrow.parquet as pq
+
+    from ..sql import functions as F
+
+    table = DeltaTable(path)
+    if table.column_mapping():
+        raise NotImplementedError("ZORDER on column-mapped tables")
+    part_cols = table.partition_columns()
+    for c in columns:
+        if c in part_cols:
+            raise ValueError(f"cannot zorder by partition column {c!r}")
+    data_cols = [f.name for f in table.schema_fields()
+                 if f.name not in part_cols]
+
+    # group files by partition
+    by_part: Dict[tuple, list] = {}
+    for rel, pvals in sorted(table.active.items()):
+        key = tuple(sorted(pvals.items()))
+        by_part.setdefault(key, []).append((rel, pvals))
+
+    removes, adds = [], []
+    for key, rels in by_part.items():
+        if len(rels) == 0:
+            continue
+        pvals = rels[0][1]
+        dfs = [_read_live_file(session, table, rel,
+                               os.path.join(path, rel))
+               for rel, _ in rels]
+        whole = dfs[0]
+        for d in dfs[1:]:
+            whole = whole.union(d)
+        n = 64 // max(len(columns), 1)
+        span = (1 << min(n, 20)) - 1
+        # min-max normalize per partition: ONE stats pass for every
+        # z-column, then a projection; DATE stats normalize via their
+        # epoch-day ordinal
+        import datetime as _dt
+
+        def _num(v):
+            if isinstance(v, _dt.date):
+                return float((v - _dt.date(1970, 1, 1)).days)
+            return float(v)
+
+        aggs = []
+        for c in columns:
+            aggs.append(F.min(F.col(c)).alias(f"__lo_{c}"))
+            aggs.append(F.max(F.col(c)).alias(f"__hi_{c}"))
+        stats = whole.agg(*aggs).collect()[0]
+        zcols = []
+        for ci, c in enumerate(columns):
+            clo, chi = stats[2 * ci], stats[2 * ci + 1]
+            lo_n = _num(clo) if clo is not None else 0.0
+            hi_n = _num(chi) if chi is not None else 0.0
+            rng = (hi_n - lo_n) if hi_n != lo_n else 1.0
+            zcols.append(
+                (((F.col(c).cast("double") - lo_n)
+                  * (float(span) / rng))).cast("long"))
+        clustered = whole.sort(
+            F.interleave_bits(*zcols).alias("__z"))
+        t = clustered.select(*data_cols).to_arrow()
+        for rel, _ in rels:
+            removes.append(rel)
+        for off in range(0, max(t.num_rows, 1), target_file_rows):
+            chunk = t.slice(off, target_file_rows)
+            if chunk.num_rows == 0 and t.num_rows > 0:
+                continue
+            sub = os.path.dirname(rels[0][0])
+            new_name = f"part-{uuid.uuid4().hex}.parquet"
+            new_rel = os.path.join(sub, new_name) if sub else new_name
+            os.makedirs(os.path.dirname(os.path.join(path, new_rel))
+                        or path, exist_ok=True)
+            pq.write_table(chunk, os.path.join(path, new_rel))
+            adds.append((new_rel, dict(pvals)))
+    if not removes:
+        return table.version
+    return _commit(path, table.version, "OPTIMIZE", removes, adds)
 
 
 class ConcurrentModificationError(RuntimeError):

@@ -31,9 +31,14 @@ def gen_db(sf: float, out_dir: str, chunk: int = 1_000_000
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    root = os.path.join(out_dir, f"tpcds_sf{sf}")
+    # v2: full star schema (store/catalog/web channels + returns +
+    # customer/address/household dims) for the 22-query acceptance set
+    root = os.path.join(out_dir, f"tpcds_v2_sf{sf}")
     tables = ["date_dim", "item", "customer_demographics", "promotion",
-              "store_sales"]
+              "store_sales", "store", "customer", "customer_address",
+              "household_demographics", "income_band", "store_returns",
+              "catalog_sales", "catalog_returns", "web_sales",
+              "web_returns", "web_site"]
     paths = {t: os.path.join(root, f"{t}.parquet") for t in tables}
     if all(os.path.exists(p) for p in paths.values()):
         return paths
@@ -44,12 +49,19 @@ def gen_db(sf: float, out_dir: str, chunk: int = 1_000_000
     days = np.arange(_N_DATES)
     dates = np.datetime64(_D_START) + days.astype("timedelta64[D]")
     as_dt = dates.astype("datetime64[D]").astype(object)
+    years = np.array([d.year for d in as_dt], dtype=np.int64)
+    moys = np.array([d.month for d in as_dt], dtype=np.int64)
     pq.write_table(pa.table({
         "d_date_sk": (sk0 + days).astype(np.int64),
         "d_date": pa.array(dates, type=pa.date32()),
-        "d_year": np.array([d.year for d in as_dt], dtype=np.int64),
-        "d_moy": np.array([d.month for d in as_dt], dtype=np.int64),
+        "d_year": years,
+        "d_moy": moys,
         "d_dom": np.array([d.day for d in as_dt], dtype=np.int64),
+        # 1998-01 -> month_seq 1176 (spec's NDS convention); dow 0=Sunday
+        "d_month_seq": (years - 1998) * 12 + (moys - 1) + 1176,
+        "d_dow": np.array([(d.weekday() + 1) % 7 for d in as_dt],
+                          dtype=np.int64),
+        "d_qoy": (moys - 1) // 3 + 1,
     }), paths["date_dim"])
 
     n_item = max(8, int(_ITEM_PER_SF * sf))
@@ -58,6 +70,13 @@ def gen_db(sf: float, out_dir: str, chunk: int = 1_000_000
                      "Music", "Shoes", "Sports", "Children", "Women"])
     cat_id = rng.integers(1, 11, n_item).astype(np.int64)
     brand_id = rng.integers(1001001, 10016017, n_item).astype(np.int64)
+    classes = np.array(["accessories", "athletic", "birdal", "classical",
+                        "computers", "country", "dresses", "earings",
+                        "fiction", "fishing"])
+    class_id = rng.integers(1, 11, n_item).astype(np.int64)
+    colors = np.array(["papaya", "peach", "firebrick", "sienna", "slate",
+                       "chartreuse", "orchid", "salmon", "plum", "maroon",
+                       "azure", "gainsboro", "powder", "metallic"])
     pq.write_table(pa.table({
         "i_item_sk": np.arange(1, n_item + 1, dtype=np.int64),
         "i_item_id": [f"AAAAAAAA{i:08d}" for i in range(1, n_item + 1)],
@@ -65,6 +84,10 @@ def gen_db(sf: float, out_dir: str, chunk: int = 1_000_000
         "i_brand": [f"brand#{b % 997}" for b in brand_id],
         "i_category_id": cat_id,
         "i_category": cats[cat_id - 1],
+        "i_class_id": class_id,
+        "i_class": classes[class_id - 1],
+        "i_color": colors[rng.integers(0, len(colors), n_item)],
+        "i_product_name": [f"product#{i}" for i in range(1, n_item + 1)],
         "i_manufact_id": rng.integers(1, 1001, n_item).astype(np.int64),
         "i_manager_id": rng.integers(1, 101, n_item).astype(np.int64),
         "i_current_price": np.round(rng.uniform(0.1, 300.0, n_item), 2),
@@ -97,35 +120,288 @@ def gen_db(sf: float, out_dir: str, chunk: int = 1_000_000
                                       p=[0.1, 0.9]),
     }), paths["promotion"])
 
+    # ---- stores / customers / addresses / households --------------------
+    n_store = max(2, int(12 * max(sf, 0.1)))
+    rng = np.random.default_rng(2004)
+    counties = np.array(["Williamson County", "Ziebach County",
+                         "Walker County", "Daviess County",
+                         "Barrow County", "Fairfield County"])
+    cities = np.array(["Midway", "Fairview", "Cedar Grove", "Five Points",
+                       "Oak Grove", "Pleasant Hill", "Centerville",
+                       "Liberty", "Salem", "Union"])
+    states = np.array(["TN", "SD", "AL", "IN", "GA", "OH", "TX", "IL",
+                       "KY", "NM", "MI", "VA"])
+    st_city = rng.integers(0, len(cities), n_store)
+    pq.write_table(pa.table({
+        "s_store_sk": np.arange(1, n_store + 1, dtype=np.int64),
+        "s_store_id": [f"AAAAAAAA{i:08d}" for i in range(1, n_store + 1)],
+        "s_store_name": np.array(["ought", "able", "ation", "eing",
+                                  "ese", "anti", "cally", "bar"])[
+            rng.integers(0, 8, n_store)],
+        "s_city": cities[st_city],
+        "s_county": counties[rng.integers(0, len(counties), n_store)],
+        "s_state": states[rng.integers(0, len(states), n_store)],
+        "s_zip": [f"{z:05d}" for z in rng.integers(10000, 99999, n_store)],
+        "s_number_employees": rng.integers(200, 300, n_store).astype(
+            np.int64),
+        "s_gmt_offset": np.full(n_store, -5.0),
+    }), paths["store"])
+
+    n_ca = max(32, int(50_000 * sf))
+    rng = np.random.default_rng(2005)
+    pq.write_table(pa.table({
+        "ca_address_sk": np.arange(1, n_ca + 1, dtype=np.int64),
+        "ca_city": cities[rng.integers(0, len(cities), n_ca)],
+        "ca_county": counties[rng.integers(0, len(counties), n_ca)],
+        "ca_state": states[rng.integers(0, len(states), n_ca)],
+        "ca_zip": [f"{z:05d}" for z in rng.integers(10000, 99999, n_ca)],
+        "ca_country": np.array(["United States"]).repeat(n_ca),
+        "ca_gmt_offset": rng.choice(np.array([-5.0, -6.0, -7.0]), n_ca),
+    }), paths["customer_address"])
+
+    # income_band + household_demographics (spec cross product)
+    ib_low = np.arange(20, dtype=np.int64) * 10_000
+    pq.write_table(pa.table({
+        "ib_income_band_sk": np.arange(1, 21, dtype=np.int64),
+        "ib_lower_bound": ib_low + 1,
+        "ib_upper_bound": ib_low + 10_000,
+    }), paths["income_band"])
+    pots = np.array([">10000", "5001-10000", "1001-5000", "501-1000",
+                     "0-500", "Unknown"])
+    hidx = np.arange(20 * 6 * 10 * 5)
+    pq.write_table(pa.table({
+        "hd_demo_sk": (hidx + 1).astype(np.int64),
+        "hd_income_band_sk": (hidx % 20 + 1).astype(np.int64),
+        "hd_buy_potential": pots[(hidx // 20) % 6],
+        "hd_dep_count": ((hidx // 120) % 10).astype(np.int64),
+        "hd_vehicle_count": ((hidx // 1200) % 5).astype(np.int64),
+    }), paths["household_demographics"])
+    n_hd = len(hidx)
+
+    n_cust = max(64, int(100_000 * sf))
+    rng = np.random.default_rng(2006)
+    firsts = np.array(["James", "Mary", "John", "Linda", "Robert",
+                       "Barbara", "Michael", "Susan", "William", "Lisa"])
+    lasts = np.array(["Smith", "Johnson", "Brown", "Jones", "Davis",
+                      "Miller", "Wilson", "Moore", "Taylor", "Thomas"])
+    first_sale = sk0 + rng.integers(0, _N_DATES, n_cust)
+    pq.write_table(pa.table({
+        "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_customer_id": [f"AAAAAAAA{i:08d}"
+                          for i in range(1, n_cust + 1)],
+        "c_current_cdemo_sk": _null_some(
+            rng, rng.integers(1, n_cd + 1, n_cust).astype(np.int64)),
+        "c_current_hdemo_sk": _null_some(
+            rng, rng.integers(1, n_hd + 1, n_cust).astype(np.int64)),
+        "c_current_addr_sk": rng.integers(
+            1, n_ca + 1, n_cust).astype(np.int64),
+        "c_first_name": firsts[rng.integers(0, len(firsts), n_cust)],
+        "c_last_name": lasts[rng.integers(0, len(lasts), n_cust)],
+        "c_preferred_cust_flag": rng.choice(np.array(["Y", "N"]), n_cust),
+        "c_birth_country": rng.choice(
+            np.array(["UNITED STATES", "CANADA", "MEXICO"]), n_cust),
+        "c_first_sales_date_sk": _null_some(rng,
+                                            first_sale.astype(np.int64)),
+        "c_first_shipto_date_sk": _null_some(
+            rng, (first_sale + 30).astype(np.int64)),
+    }), paths["customer"])
+
+    pq.write_table(pa.table({
+        "web_site_sk": np.arange(1, 31, dtype=np.int64),
+        "web_site_id": [f"AAAAAAAA{i:08d}" for i in range(1, 31)],
+        "web_company_name": np.array(["pri", "able", "ought", "ese",
+                                      "anti", "cally"])[
+            np.arange(30) % 6],
+    }), paths["web_site"])
+
+    # ---- store_sales (+ returns tied by ticket/item) --------------------
     n_ss = max(64, int(_STORE_SALES_PER_SF * sf))
     rng = np.random.default_rng(2003)
     import pyarrow.parquet as pq2
     w = None
+    wr_ = None
+    sr_rng = np.random.default_rng(2007)
     for off in range(0, n_ss, chunk):
         m = min(chunk, n_ss - off)
         qty = rng.integers(1, 101, m).astype(np.int64)
         list_price = np.round(rng.uniform(1.0, 200.0, m), 2)
         sales_price = np.round(list_price * rng.uniform(0.2, 1.0, m), 2)
+        wholesale = np.round(list_price * rng.uniform(0.1, 0.6, m), 2)
+        item_sk = rng.integers(1, n_item + 1, m).astype(np.int64)
+        cust_sk = rng.integers(1, n_cust + 1, m).astype(np.int64)
+        ticket = (off + np.arange(m) + 1).astype(np.int64)
+        sold_sk = (sk0 + rng.integers(0, _N_DATES, m)).astype(np.int64)
+        ext_sales = np.round(sales_price * qty, 2)
+        ext_wholesale = np.round(wholesale * qty, 2)
         t = pa.table({
             # ~4% of fact rows carry null FK (spec allows nulls here)
-            "ss_sold_date_sk": _null_some(
-                rng, (sk0 + rng.integers(0, _N_DATES, m)).astype(np.int64)),
-            "ss_item_sk": rng.integers(1, n_item + 1, m).astype(np.int64),
+            "ss_sold_date_sk": _null_some(rng, sold_sk),
+            "ss_sold_time_sk": rng.integers(0, 86400, m).astype(np.int64),
+            "ss_item_sk": item_sk,
+            "ss_customer_sk": _null_some(rng, cust_sk, 0.02),
             "ss_cdemo_sk": _null_some(
                 rng, rng.integers(1, n_cd + 1, m).astype(np.int64)),
+            "ss_hdemo_sk": _null_some(
+                rng, rng.integers(1, n_hd + 1, m).astype(np.int64)),
+            "ss_addr_sk": _null_some(
+                rng, rng.integers(1, n_ca + 1, m).astype(np.int64)),
+            "ss_store_sk": _null_some(
+                rng, rng.integers(1, n_store + 1, m).astype(np.int64)),
             "ss_promo_sk": _null_some(
                 rng, rng.integers(1, n_promo + 1, m).astype(np.int64)),
+            "ss_ticket_number": ticket,
             "ss_quantity": qty,
+            "ss_wholesale_cost": wholesale,
             "ss_list_price": list_price,
             "ss_sales_price": sales_price,
-            "ss_ext_sales_price": np.round(sales_price * qty, 2),
+            "ss_ext_sales_price": ext_sales,
+            "ss_ext_wholesale_cost": ext_wholesale,
+            "ss_ext_list_price": np.round(list_price * qty, 2),
             "ss_coupon_amt": np.round(
                 rng.uniform(0, 50.0, m) * (rng.random(m) < 0.2), 2),
+            "ss_net_paid": ext_sales,
+            "ss_net_profit": np.round(ext_sales - ext_wholesale, 2),
         })
         w = w or pq2.ParquetWriter(paths["store_sales"], t.schema)
         w.write_table(t)
+        # ~10% of tickets return
+        rmask = sr_rng.random(m) < 0.10
+        ridx = np.flatnonzero(rmask)
+        rqty = sr_rng.integers(1, 1 + qty[ridx])
+        ramt = np.round(sales_price[ridx] * rqty, 2)
+        rt = pa.table({
+            "sr_returned_date_sk": (
+                sold_sk[ridx]
+                + sr_rng.integers(1, 60, len(ridx))).astype(np.int64),
+            "sr_item_sk": item_sk[ridx],
+            "sr_customer_sk": cust_sk[ridx],
+            "sr_cdemo_sk": sr_rng.integers(
+                1, n_cd + 1, len(ridx)).astype(np.int64),
+            "sr_ticket_number": ticket[ridx],
+            "sr_return_quantity": rqty.astype(np.int64),
+            "sr_return_amt": ramt,
+            "sr_net_loss": np.round(ramt * 0.1 + 5.0, 2),
+        })
+        wr_ = wr_ or pq2.ParquetWriter(paths["store_returns"], rt.schema)
+        wr_.write_table(rt)
     if w:
         w.close()
+    if wr_:
+        wr_.close()
+
+    # ---- catalog channel ------------------------------------------------
+    n_cs = max(64, int(1_441_548 * sf))
+    rng = np.random.default_rng(2008)
+    w = None
+    wr_ = None
+    for off in range(0, n_cs, chunk):
+        m = min(chunk, n_cs - off)
+        qty = rng.integers(1, 101, m).astype(np.int64)
+        list_price = np.round(rng.uniform(1.0, 300.0, m), 2)
+        sales_price = np.round(list_price * rng.uniform(0.2, 1.0, m), 2)
+        wholesale = np.round(list_price * rng.uniform(0.1, 0.6, m), 2)
+        item_sk = rng.integers(1, n_item + 1, m).astype(np.int64)
+        order = (off + np.arange(m) + 1).astype(np.int64)
+        ext_sales = np.round(sales_price * qty, 2)
+        ext_list = np.round(list_price * qty, 2)
+        t = pa.table({
+            "cs_sold_date_sk": _null_some(
+                rng, (sk0 + rng.integers(0, _N_DATES, m)).astype(
+                    np.int64)),
+            "cs_item_sk": item_sk,
+            "cs_order_number": order,
+            "cs_bill_customer_sk": rng.integers(
+                1, n_cust + 1, m).astype(np.int64),
+            "cs_bill_cdemo_sk": _null_some(
+                rng, rng.integers(1, n_cd + 1, m).astype(np.int64)),
+            "cs_promo_sk": _null_some(
+                rng, rng.integers(1, n_promo + 1, m).astype(np.int64)),
+            "cs_quantity": qty,
+            "cs_list_price": list_price,
+            "cs_sales_price": sales_price,
+            "cs_wholesale_cost": wholesale,
+            "cs_ext_sales_price": ext_sales,
+            "cs_ext_list_price": ext_list,
+            "cs_ext_wholesale_cost": np.round(wholesale * qty, 2),
+            "cs_ext_discount_amt": np.round(ext_list - ext_sales, 2),
+            "cs_coupon_amt": np.round(
+                rng.uniform(0, 50.0, m) * (rng.random(m) < 0.2), 2),
+            "cs_net_profit": np.round(
+                ext_sales - wholesale * qty, 2),
+        })
+        w = w or pq2.ParquetWriter(paths["catalog_sales"], t.schema)
+        w.write_table(t)
+        rmask = rng.random(m) < 0.10
+        ridx = np.flatnonzero(rmask)
+        ramt = np.round(sales_price[ridx]
+                        * rng.integers(1, 1 + qty[ridx]), 2)
+        third = np.round(ramt / 3.0, 2)
+        rt = pa.table({
+            "cr_item_sk": item_sk[ridx],
+            "cr_order_number": order[ridx],
+            "cr_return_amount": ramt,
+            "cr_refunded_cash": third,
+            "cr_reversed_charge": third,
+            "cr_store_credit": np.round(ramt - 2 * third, 2),
+        })
+        wr_ = wr_ or pq2.ParquetWriter(paths["catalog_returns"],
+                                       rt.schema)
+        wr_.write_table(rt)
+    if w:
+        w.close()
+    if wr_:
+        wr_.close()
+
+    # ---- web channel ----------------------------------------------------
+    n_ws = max(64, int(719_384 * sf))
+    rng = np.random.default_rng(2009)
+    w = None
+    wr_ = None
+    for off in range(0, n_ws, chunk):
+        m = min(chunk, n_ws - off)
+        qty = rng.integers(1, 101, m).astype(np.int64)
+        sales_price = np.round(rng.uniform(1.0, 300.0, m), 2)
+        ext_sales = np.round(sales_price * qty, 2)
+        sold_sk = (sk0 + rng.integers(0, _N_DATES, m)).astype(np.int64)
+        # several line items share an order; ~30% of orders ship from a
+        # second warehouse (the q94/q95 existence probe)
+        order = (off + np.arange(m)) // 3 + 1
+        t = pa.table({
+            "ws_sold_date_sk": _null_some(rng, sold_sk),
+            "ws_ship_date_sk": (sold_sk
+                                + rng.integers(1, 90, m)).astype(
+                np.int64),
+            "ws_item_sk": rng.integers(1, n_item + 1, m).astype(np.int64),
+            "ws_order_number": order.astype(np.int64),
+            "ws_bill_customer_sk": rng.integers(
+                1, n_cust + 1, m).astype(np.int64),
+            "ws_ship_addr_sk": rng.integers(
+                1, n_ca + 1, m).astype(np.int64),
+            "ws_web_site_sk": rng.integers(1, 31, m).astype(np.int64),
+            "ws_warehouse_sk": rng.integers(1, 6, m).astype(np.int64),
+            "ws_quantity": qty,
+            "ws_sales_price": sales_price,
+            "ws_ext_sales_price": ext_sales,
+            "ws_ext_ship_cost": np.round(ext_sales * 0.05, 2),
+            "ws_net_profit": np.round(ext_sales * 0.2, 2),
+        })
+        w = w or pq2.ParquetWriter(paths["web_sales"], t.schema)
+        w.write_table(t)
+        rmask = rng.random(m) < 0.05
+        ridx = np.flatnonzero(rmask)
+        rt = pa.table({
+            "wr_order_number": order[ridx].astype(np.int64),
+            "wr_item_sk": rng.integers(
+                1, n_item + 1, len(ridx)).astype(np.int64),
+            "wr_return_amt": np.round(
+                rng.uniform(1, 300, len(ridx)), 2),
+        })
+        wr_ = wr_ or pq2.ParquetWriter(paths["web_returns"], rt.schema)
+        wr_.write_table(rt)
+    if w:
+        w.close()
+    if wr_:
+        wr_.close()
     return paths
 
 
@@ -320,6 +596,12 @@ QUERIES = {
     "ds_q7": (run_q7, pandas_q7),
 }
 
+# wave 2 (q64/q95 shuffle stress + 15 more): models/tpcds_q2.py
+from .tpcds_q2 import QUERIES2 as _Q2
+from .tpcds_q2 import TABLES2 as _T2
+
+QUERIES.update(_Q2)
+
 TABLES: Dict[str, List[str]] = {
     "ds_q3": ["store_sales", "date_dim", "item"],
     "ds_q42": ["store_sales", "date_dim", "item"],
@@ -328,3 +610,4 @@ TABLES: Dict[str, List[str]] = {
     "ds_q7": ["store_sales", "customer_demographics", "date_dim", "item",
               "promotion"],
 }
+TABLES.update(_T2)

@@ -27,7 +27,8 @@ __all__ = ["concat_batches", "compact", "slice_batch", "gather"]
 def _pad_dev(arr: jax.Array, cap: int):
     if arr.shape[0] == cap:
         return arr
-    return jnp.pad(arr, (0, cap - arr.shape[0]))
+    pad = [(0, cap - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return jnp.pad(arr, pad)
 
 
 def concat_batches(batches: Sequence[ColumnBatch],

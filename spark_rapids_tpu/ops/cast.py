@@ -122,7 +122,9 @@ def cast_value(data: jax.Array, valid: Optional[jax.Array],
         return data.astype(jnp.int64) * MICROS_PER_DAY, valid
     if src.kind == T.TypeKind.TIMESTAMP and dst.kind == T.TypeKind.DATE:
         return jnp.floor_divide(data, MICROS_PER_DAY).astype(jnp.int32), valid
-    if src.kind == T.TypeKind.DATE and dst.is_integral:
+    if src.kind == T.TypeKind.DATE and dst.is_numeric:
+        # epoch-day ordinal as a number (zorder normalization uses this;
+        # Spark itself disallows date->double in SQL)
         return data.astype(dst.numpy_dtype), valid
     if src.kind == T.TypeKind.TIMESTAMP and dst.kind == T.TypeKind.INT64:
         return jnp.floor_divide(data, 1_000_000), valid  # seconds, Spark semantics

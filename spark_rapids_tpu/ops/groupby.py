@@ -95,6 +95,23 @@ def sort_indices_for_keys(keys: Sequence[Value], active: jax.Array,
     # jnp.lexsort sorts by the LAST key first; build minor→major.
     for i in reversed(range(n)):
         data, valid = keys[i]
+        if data.ndim == 2:
+            # wide-decimal limbs [lo, hi]: true 128-bit order is
+            # (hi signed, lo unsigned) lexicographic — two operands,
+            # minor (lo) appended first so lexsort treats hi as major
+            sign = jnp.int64(np.iinfo(np.int64).min)
+            lo_u = data[:, 0] ^ sign  # unsigned order as signed ints
+            hi = data[:, 1]
+            if desc[i]:
+                lo_u = ~lo_u
+                hi = ~hi
+            vkey = _null_order_key(valid, capacity)
+            if not nf[i]:
+                vkey = 1 - vkey
+            arrays.append(lo_u)
+            arrays.append(hi)
+            arrays.append(vkey)
+            continue
         view = sortable_view(data)
         if desc[i]:
             view = ~view  # bitwise complement: monotonic flip without overflow

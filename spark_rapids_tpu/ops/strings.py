@@ -91,6 +91,22 @@ class StringDictionary:
             self._memo[id(arr)] = (arr, codes, valid)
         return codes, valid
 
+    def to_arrow(self):
+        """Arrow snapshot of the dictionary values (memoized per size):
+        lets operator outputs carry DictStringColumn (device codes +
+        this snapshot) instead of eagerly fetching + decoding."""
+        import pyarrow as pa
+        with self._lock:
+            src = getattr(self, "_arrow_src", None)
+            if src is not None and len(src) == len(self._values):
+                return src
+            cached = getattr(self, "_arrow_snap", None)
+            if cached is not None and len(cached) == len(self._values):
+                return cached
+            snap = pa.array(self._values, type=pa.string())
+            self._arrow_snap = snap
+            return snap
+
     def decode(self, codes: np.ndarray,
                valid: Optional[np.ndarray] = None):
         """int32 codes → pyarrow StringArray (None where invalid)."""

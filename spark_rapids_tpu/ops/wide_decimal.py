@@ -1,0 +1,132 @@
+"""Emulated 128-bit decimal arithmetic (device decimal128).
+
+The TPU has no int128; the reference gets exact decimal128 from libcudf
+(`GpuCast.scala` cast matrix, `DecimalUtil.scala`).  Here a wide decimal
+(18 < precision <= 38) is a ``(n, 2)`` int64 limb array ``[lo, hi]`` of
+the scaled two's-complement value, and add/subtract/compare/rescale are
+built from int64 lane ops:
+
+  * add/sub: lo-lane wraparound add + unsigned-compare carry into hi;
+  * compare: signed hi compare, unsigned lo tiebreak;
+  * rescale (x 10^k): 16-bit limb schoolbook multiply — products stay
+    below 2^32 and column sums below 2^36, so every intermediate fits
+    comfortably in int64 lanes even on backends whose int64 is emulated
+    (no uint64 needed, no 64-bit bitcasts — see _float_orderable's note
+    on the TPU X64 rewrite).
+
+All ops are elementwise/static — they fuse into the surrounding XLA
+stage program like any other expression.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["from_scaled64", "add", "neg", "sub", "eq", "lt", "le", "gt",
+           "ge", "mul_pow10", "WIDE_LIMBS"]
+
+WIDE_LIMBS = 2
+_SIGN = np.int64(np.uint64(1 << 63).astype(np.int64))  # int64 min
+
+
+def _ult(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Unsigned 64-bit x < y via the sign-flip trick."""
+    return (x ^ _SIGN) < (y ^ _SIGN)
+
+
+def from_scaled64(d: jax.Array) -> jax.Array:
+    """(n,) scaled int64 -> (n, 2) [lo, hi] limbs (sign-extended)."""
+    d = d.astype(jnp.int64)
+    hi = jnp.right_shift(d, jnp.int64(63))  # arithmetic: 0 or -1
+    return jnp.stack([d, hi], axis=-1)
+
+
+def add(a: jax.Array, b: jax.Array) -> jax.Array:
+    lo = a[..., 0] + b[..., 0]  # wraps mod 2^64 (two's complement)
+    carry = _ult(lo, a[..., 0]).astype(jnp.int64)
+    hi = a[..., 1] + b[..., 1] + carry
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def neg(a: jax.Array) -> jax.Array:
+    lo = -a[..., 0]
+    hi = ~a[..., 1] + (a[..., 0] == 0).astype(jnp.int64)
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    return add(a, neg(b))
+
+
+def eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    return (a[..., 0] == b[..., 0]) & (a[..., 1] == b[..., 1])
+
+
+def lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    return (a[..., 1] < b[..., 1]) | (
+        (a[..., 1] == b[..., 1]) & _ult(a[..., 0], b[..., 0]))
+
+
+def le(a: jax.Array, b: jax.Array) -> jax.Array:
+    return lt(a, b) | eq(a, b)
+
+
+def gt(a: jax.Array, b: jax.Array) -> jax.Array:
+    return lt(b, a)
+
+
+def ge(a: jax.Array, b: jax.Array) -> jax.Array:
+    return le(b, a)
+
+
+_M16 = jnp.int64(0xFFFF)
+
+
+def _to_limbs16(a: jax.Array):
+    """(n, 2) limbs -> eight (n,) int64 lanes in [0, 2^16) (raw two's-
+    complement bits; logical shifts extract them sign-free)."""
+    out = []
+    for w in (a[..., 0], a[..., 1]):
+        for k in range(4):
+            out.append(jax.lax.shift_right_logical(
+                w, jnp.int64(16 * k)) & _M16)
+    return out
+
+
+def _from_cols16(cols):
+    """Carry-propagate eight >=0 int64 column sums (< 2^48) back into
+    (n, 2) [lo, hi] limbs, mod 2^128."""
+    carry = jnp.zeros_like(cols[0])
+    lanes = []
+    for k in range(8):
+        tot = cols[k] + carry
+        lanes.append(tot & _M16)
+        carry = jax.lax.shift_right_logical(tot, jnp.int64(16))
+    lo = (lanes[0] | (lanes[1] << 16) | (lanes[2] << 32)
+          | (lanes[3] << 48))
+    hi = (lanes[4] | (lanes[5] << 16) | (lanes[6] << 32)
+          | (lanes[7] << 48))
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def mul_pow10(a: jax.Array, k: int) -> jax.Array:
+    """a * 10^k mod 2^128 (k >= 0 static).  Exact when the true product
+    fits 128 bits — guaranteed by the result type's precision <= 38."""
+    if k == 0:
+        return a
+    m = 10 ** k
+    ml = [(m >> (16 * j)) & 0xFFFF for j in range(8)]
+    al = _to_limbs16(a)
+    cols = []
+    for c in range(8):
+        acc = None
+        for i in range(8):
+            j = c - i
+            if 0 <= j < 8 and ml[j]:
+                term = al[i] * jnp.int64(ml[j])
+                acc = term if acc is None else acc + term
+        cols.append(acc if acc is not None
+                    else jnp.zeros_like(al[0]))
+    return _from_cols16(cols)

@@ -111,13 +111,15 @@ class _Exchange:
     Partitioning is by device (pid = murmur3(keys) % n_devices), preserving
     the invariant every consumer relies on: equal keys are colocated."""
 
-    def __init__(self, exch, child, n_dev: int, axis: str, bucket_rows: int):
+    def __init__(self, exch, child, n_dev: int, axis: str, bucket_rows: int,
+                 cap_scale: int = 1):
         self.exch = exch
         self.child = child
         self.schema = exch.output_schema
         self.n_dev = n_dev
         self.axis = axis
         self._bucket_rows = bucket_rows
+        self._cap_scale = cap_scale
         self.bucket_cap = None
         self.cap = None
 
@@ -125,9 +127,10 @@ class _Exchange:
         self.child.resolve()
         # auto: a device holds at most child.cap active rows, so a bucket
         # of child.cap can never overflow (memory-heavy but always correct;
-        # set shuffle.ici.bucketRows to bound it at scale)
-        self.bucket_cap = (self._bucket_rows if self._bucket_rows > 0
-                           else self.child.cap)
+        # set shuffle.ici.bucketRows to bound it at scale).  cap_scale > 1
+        # is the overflow-retry escalation (distribute_plan).
+        self.bucket_cap = (self._bucket_rows * self._cap_scale
+                           if self._bucket_rows > 0 else self.child.cap)
         self.cap = self.n_dev * self.bucket_cap
 
     def emit(self, env):
@@ -206,12 +209,14 @@ class _Aggregate:
 class _Join:
     """Shuffled sort-merge equi-join, static shapes (local per device)."""
 
-    def __init__(self, join, left, right, out_rows: int):
+    def __init__(self, join, left, right, out_rows: int,
+                 cap_scale: int = 1):
         self.join = join
         self.left = left
         self.right = right
         self.schema = join.output_schema
         self._out_rows = out_rows
+        self._cap_scale = cap_scale
         self.cap = None
 
     def resolve(self):
@@ -223,7 +228,8 @@ class _Join:
             from ..batch import bucket_capacity
             auto = self.left.cap + self.right.cap
             self.cap = bucket_capacity(
-                self._out_rows if self._out_rows > 0 else auto)
+                (self._out_rows if self._out_rows > 0 else auto)
+                * self._cap_scale)
 
     def emit(self, env):
         import jax.numpy as jnp
@@ -389,8 +395,16 @@ class _Join:
 # Lowering (structure check + tree build share one code path)
 # ---------------------------------------------------------------------------------
 
+class ICICapacityOverflow(RuntimeError):
+    """A fixed-capacity exchange bucket or join expansion overflowed.
+    distribute_plan catches this and transparently retries the fragment
+    at the next capacity bucket (shuffle.ici.overflowRetries) before
+    surfacing it — the reference's split-retry idea (SURVEY §3.4)
+    applied to static SPMD capacities."""
+
+
 def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
-           depth_has_exchange: List[bool]):
+           depth_has_exchange: List[bool], cap_scale: int = 1):
     """Recursively lower ``node``; non-lowerable subtrees become leaves.
 
     Raises NotLowerable only for conditions that poison the whole fragment
@@ -405,10 +419,11 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
 
     if isinstance(node, ShuffleExchangeExec):
         child = _lower(node.children[0], leaves, conf, n_dev, axis,
-                       depth_has_exchange)
+                       depth_has_exchange, cap_scale)
         depth_has_exchange[0] = True
         return _Exchange(node, child, n_dev, axis,
-                         conf["spark.rapids.tpu.shuffle.ici.bucketRows"])
+                         conf["spark.rapids.tpu.shuffle.ici.bucketRows"],
+                         cap_scale)
 
     if isinstance(node, StageExec):
         if node.host_exprs:
@@ -420,14 +435,14 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
             # run the stage single-process so errors raise correctly
             return _make_leaf(node, leaves)
         child = _lower(node.children[0], leaves, conf, n_dev, axis,
-                       depth_has_exchange)
+                       depth_has_exchange, cap_scale)
         return _Stage(node, child)
 
     if isinstance(node, AggregateExec):
         if node.mode not in ("partial", "final") or not node.group_exprs:
             return _make_leaf(node, leaves)
         child = _lower(node.children[0], leaves, conf, n_dev, axis,
-                       depth_has_exchange)
+                       depth_has_exchange, cap_scale)
         return _Aggregate(node, child)
 
     from ..plan.join_exec import BroadcastJoinExec
@@ -448,7 +463,7 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
         had_exch = depth_has_exchange[0]
         try:
             probe = _lower(node.children[1 - node.build_side], leaves, conf,
-                           n_dev, axis, depth_has_exchange)
+                           n_dev, axis, depth_has_exchange, cap_scale)
             # the build side rides replicated: every device holds the full
             # (small) table, so no colocation exchange is needed at all
             build = _make_leaf(node.children[node.build_side].children[0],
@@ -461,7 +476,8 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
         left, right = ((build, probe) if node.build_side == 0
                        else (probe, build))
         return _Join(node, left, right,
-                     conf["spark.rapids.tpu.shuffle.ici.joinOutputRows"])
+                     conf["spark.rapids.tpu.shuffle.ici.joinOutputRows"],
+                     cap_scale)
 
     if isinstance(node, SortMergeJoinExec):
         if node.how in ("cross", "existence"):
@@ -479,9 +495,9 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
         n_leaves = len(leaves)
         had_exch = depth_has_exchange[0]
         left = _lower(node.children[0], leaves, conf, n_dev, axis,
-                      depth_has_exchange)
+                      depth_has_exchange, cap_scale)
         right = _lower(node.children[1], leaves, conf, n_dev, axis,
-                       depth_has_exchange)
+                       depth_has_exchange, cap_scale)
         if not (isinstance(left, _Exchange) and isinstance(right, _Exchange)):
             # a non-shuffled join (exchange disabled) has no colocation
             # guarantee per shard — materialize it whole, rolling back
@@ -490,7 +506,8 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
             depth_has_exchange[0] = had_exch
             return _make_leaf(node, leaves)
         return _Join(node, left, right,
-                     conf["spark.rapids.tpu.shuffle.ici.joinOutputRows"])
+                     conf["spark.rapids.tpu.shuffle.ici.joinOutputRows"],
+                     cap_scale)
 
     return _make_leaf(node, leaves)
 
@@ -530,20 +547,21 @@ def _contains_exchange(node) -> bool:
     return any(_contains_exchange(c) for c in node.children)
 
 
-def _find_fragment(node, conf, n_dev, axis):
+def _find_fragment(node, conf, n_dev, axis, cap_scale: int = 1):
     """Topmost node whose subtree lowers AND contains >=1 exchange.
     Returns (node, lowered_root, leaves) or None."""
     try:
         leaves: List[_Leaf] = []
         has_exch = [False]
-        lowered = _lower(node, leaves, conf, n_dev, axis, has_exch)
+        lowered = _lower(node, leaves, conf, n_dev, axis, has_exch,
+                         cap_scale)
         if has_exch[0] and not isinstance(lowered, _Leaf):
             return node, lowered, leaves
     except NotLowerable as e:
         log.info("ICI: subtree %s not lowerable: %s",
                  type(node).__name__, e)
     for c in node.children:
-        found = _find_fragment(c, conf, n_dev, axis)
+        found = _find_fragment(c, conf, n_dev, axis, cap_scale)
         if found is not None:
             return found
     return None
@@ -667,7 +685,7 @@ def _execute_fragment(lowered, leaves: List[_Leaf], ctx, mesh, axis: str):
         detail = "; ".join(
             f"{lbl}: {int(c)} rows" for lbl, c in
             zip(overflow_labels, per_stage) if c > 0)
-        raise RuntimeError(
+        raise ICICapacityOverflow(
             f"ICI fragment capacity overflow — would drop rows; raise the "
             f"named conf and retry: {detail}")
     active = outs[-2]
@@ -711,7 +729,32 @@ def distribute_plan(phys, ctx, mesh, axis: str = "data"):
         frag_node, lowered, leaves = found
         log.info("ICI: executing fragment %s over %d devices "
                  "(%d leaves)", type(frag_node).__name__, n_dev, len(leaves))
-        table = _execute_fragment(lowered, leaves, ctx, mesh, axis)
+        retries = conf["spark.rapids.tpu.shuffle.ici.overflowRetries"]
+        scale = 1
+        attempt = 0
+        while True:
+            try:
+                table = _execute_fragment(lowered, leaves, ctx, mesh, axis)
+                break
+            except ICICapacityOverflow:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                # transparent recovery: re-lower the SAME fragment with
+                # every static capacity scaled to the next bucket and
+                # re-run (split-retry analog; leaves re-materialize from
+                # their sources, which is safe — scans and captured
+                # fragment tables replay identically)
+                scale *= 4
+                log.warning(
+                    "ICI: capacity overflow, retrying fragment at "
+                    "%dx capacities (attempt %d/%d)",
+                    scale, attempt, retries)
+                refound = _find_fragment(frag_node, conf, n_dev, axis,
+                                         cap_scale=scale)
+                if refound is None or refound[0] is not frag_node:
+                    raise
+                _, lowered, leaves = refound
         schema = lowered.schema
 
         def factory(t=table):

@@ -191,6 +191,52 @@ class ShuffleExchangeExec(TpuExec):
         finally:
             shuffle.close()
 
+    def stage_input(self, ctx: "ExecContext") -> list:
+        """Materialize the input as spillable handles (the shuffle's
+        staging barrier), memoized: AQE-lite probes the ACTUAL staged
+        size here before deciding shuffle-vs-broadcast, and the normal
+        partition path reuses the same handles — the probe is never
+        wasted work (GpuCustomShuffleReaderExec stats analog)."""
+        if getattr(self, "_staged_raw", None) is not None:
+            return self._staged_raw
+        from ..memory.spill import get_catalog
+        catalog = get_catalog(ctx.conf)
+        m = ctx.metric_set(self.op_id)
+        raw = []
+        for batch in self.children[0].execute(ctx):
+            raw.append(catalog.register(batch, priority=0))
+            m.add("numInputBatches", 1)
+        self._staged_raw = raw
+        return raw
+
+    def staged_fits(self, ctx, threshold: int) -> bool:
+        """Does the staged input's LIVE byte size fit under
+        ``threshold``?  Two phases: a handle-METADATA row bound first
+        (no unspill, no sync — num_rows bounds live rows), and only
+        when the bound exceeds the threshold are selection masks
+        resolved (h.get() + ONE batched fetch) for the exact count —
+        the mis-estimated-filter case AQE exists for."""
+        import jax.numpy as jnp
+
+        from ..batch import estimated_row_bytes
+        from ..utils.metrics import fetch
+        raw = self.stage_input(ctx)
+        width = estimated_row_bytes(self.output_schema)
+        bound_rows = sum(h.num_rows for h in raw)
+        if bound_rows * width <= threshold:
+            return True
+        total_rows = 0
+        pending = []
+        for h in raw:
+            b = h.get()
+            if b.sel is None:
+                total_rows += b.num_rows
+            else:
+                pending.append(jnp.sum(b.active_mask()))
+        if pending:
+            total_rows += sum(int(x) for x in fetch(pending))
+        return total_rows * width <= threshold
+
     def _execute_device_resident(self, ctx: ExecContext
                                  ) -> Iterator[ColumnBatch]:
         from ..memory.spill import get_catalog
@@ -201,11 +247,8 @@ class ShuffleExchangeExec(TpuExec):
         # batch is registered spillable (ShuffleBufferCatalog analog) so
         # memory pressure during a long upstream can evict them to host
         staged = []
-        raw = []
+        raw = self.stage_input(ctx)
         try:
-            for batch in self.children[0].execute(ctx):
-                raw.append(catalog.register(batch, priority=0))
-                m.add("numInputBatches", 1)
 
             if self.coalesce_output and raw:
                 # whole shuffle fits one output batch: partitioning would
@@ -234,8 +277,10 @@ class ShuffleExchangeExec(TpuExec):
                     yield out
                     return
 
+            from ..utils.metrics import QueryStats
             for bh in raw:
                 batch = bh.get()
+                QueryStats.get().shuffle_bytes += batch.device_size_bytes()
                 with m.time("opTime"):
                     arrays = tuple(
                         (c.data, c.valid) if isinstance(c, DeviceColumn)

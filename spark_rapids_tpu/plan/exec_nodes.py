@@ -162,6 +162,12 @@ def _range_key_fn(key_expr, desc: bool, nulls_first: bool):
             active = jnp.ones((cap,), dtype=bool)
             ectx = EvalContext(list(arrays), cap, active=active)
             d, v = key_expr.eval(ectx)
+            if d.ndim == 2:
+                # wide decimal: the hi limb is a monotonic coarse image
+                # of the 128-bit value — valid for range partitioning
+                # (ties collapse into one range; the in-range sort is
+                # exact)
+                d = d[:, 1]
             view = groupby.sortable_view(d)
             if desc:
                 view = ~view

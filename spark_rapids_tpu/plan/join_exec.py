@@ -241,6 +241,16 @@ class SortMergeJoinExec(TpuExec):
         m = ctx.metric_set(self.op_id)
         lchild, rchild = self.children
         if lchild.outputs_partitions and rchild.outputs_partitions:
+            # AQE-lite (GpuCustomShuffleReaderExec / GpuOverrides
+            # re-plan analog): before partitioning anything, stage the
+            # smaller-estimated side and read its ACTUAL size — a
+            # mis-costed build side under the broadcast threshold flips
+            # this shuffled join to a broadcast join at runtime, and the
+            # staged handles feed whichever path wins (no wasted work)
+            flipped = self._try_runtime_broadcast(ctx, m)
+            if flipped is not None:
+                yield from flipped
+                return
             # shuffled join: equal keys land in the same partition on both
             # sides, so partition pairs join independently (bounded memory)
             lgen, rgen = lchild.execute(ctx), rchild.execute(ctx)
@@ -273,6 +283,73 @@ class SortMergeJoinExec(TpuExec):
         finally:
             lh.close()
             rh.close()
+
+    def _try_runtime_broadcast(self, ctx, m):
+        """Flip shuffle->broadcast when a staged exchange input is
+        actually under the threshold (VERDICT r4 item 7)."""
+        conf = ctx.conf
+        if not conf["spark.rapids.tpu.sql.aqe.enabled"]:
+            return None
+        if conf["spark.rapids.tpu.shuffle.mode"] != "CACHE_ONLY":
+            return None  # host/ICI transports own their staging
+        threshold = conf["spark.rapids.tpu.sql.autoBroadcastJoinThreshold"]
+        if threshold < 0 or self.condition is not None:
+            return None
+        from .exchange_exec import ShuffleExchangeExec
+        if not all(isinstance(c, ShuffleExchangeExec)
+                   for c in self.children):
+            return None
+        legal = _legal_build_sides(self.how)
+        if not legal:
+            return None
+        ests = []
+        for i in legal:
+            b = _estimated_bytes(self.plan.children[i])
+            ests.append((i, b if b is not None else float("inf")))
+        cand = min(ests, key=lambda t: t[1])[0]
+        exch = self.children[cand]
+        if not exch.staged_fits(ctx, threshold):
+            return None  # staged handles reused by the shuffle path
+        m.add("aqeShuffleToBroadcast", 1)
+        from ..batch import Schema as _S
+
+        class _StagedExec(TpuExec):
+            def __init__(self, schema, handles):
+                super().__init__()
+                self._schema = schema
+                self._handles = handles
+
+            @property
+            def output_schema(self):
+                return self._schema
+
+            def node_desc(self):
+                return "TpuAQEStagedInput"
+
+            def execute(self, _ctx):
+                for h in self._handles:
+                    yield h.get()
+
+        build = BroadcastExchangeExec(_StagedExec(
+            exch.output_schema, exch.stage_input(ctx)))
+        probe = self.children[1 - cand].children[0]
+        pair = [None, None]
+        pair[cand] = build
+        pair[1 - cand] = probe
+        bj = BroadcastJoinExec(self.plan, pair[0], pair[1], conf, cand,
+                               string_dicts=self.string_dicts)
+
+        def run():
+            try:
+                yield from bj.execute(ctx)
+            finally:
+                # the staged handles fed the broadcast path; release them
+                # (the shuffle path would have closed them itself)
+                for h in exch.stage_input(ctx):
+                    h.close()
+                exch._staged_raw = None
+
+        return run()
 
     def _sub_partition_join(self, ctx, m, lb: ColumnBatch, rb: ColumnBatch
                             ) -> Iterator[ColumnBatch]:
@@ -336,7 +413,8 @@ class SortMergeJoinExec(TpuExec):
                    right: ColumnBatch) -> ColumnBatch:
         if self.condition is not None and self.how in ("left", "semi",
                                                        "anti",
-                                                       "existence"):
+                                                       "existence",
+                                                       "right", "full"):
             with m.time("opTime"):
                 out = self._conditioned_probe_join(left, right)
             if out.sel is None:
@@ -359,12 +437,13 @@ class SortMergeJoinExec(TpuExec):
 
     def _conditioned_probe_join(self, left: ColumnBatch,
                                 right: ColumnBatch) -> ColumnBatch:
-        """Residual conditions on left/semi/anti joins: the condition
-        participates in MATCHING (GpuHashJoin.scala conditional joins),
+        """Residual conditions participate in MATCHING (GpuHashJoin.scala
+        conditional joins, all join types — GpuHashJoin.scala:104-383),
         not post-filtering.  Shape: inner candidate expansion → evaluate
-        the condition on the pairs → per-probe surviving-match counts →
-        semi/anti select probe rows; left additionally null-pads probes
-        with zero surviving matches."""
+        the condition on the pairs → per-probe (and, for right/full,
+        per-build) surviving-match counts → semi/anti select probe rows;
+        left/full null-pad probes with zero surviving matches; right/full
+        null-pad build rows with zero surviving matches."""
         from ..exprs import bind
         how = self.how
         lo, matches, b_perm = self._match_state(left, right, probe_side=0)
@@ -410,7 +489,7 @@ class SortMergeJoinExec(TpuExec):
 
         def build_cond():
             @jax.jit
-            def g(arrays, sel, pi, p_cap_arr):
+            def g(arrays, sel, pi, bi, p_cap_arr, b_cap_arr):
                 cap = next(a[0].shape[0] for a in arrays if a is not None)
                 act = sel
                 ectx = EvalContext(list(arrays), cap, active=act)
@@ -420,15 +499,21 @@ class SortMergeJoinExec(TpuExec):
                 surviving = jax.ops.segment_sum(
                     keep.astype(jnp.int32), pi,
                     num_segments=p_cap_arr.shape[0])
-                return keep, surviving
+                b_surviving = jax.ops.segment_sum(
+                    keep.astype(jnp.int32),
+                    jnp.clip(bi, 0, b_cap_arr.shape[0] - 1),
+                    num_segments=b_cap_arr.shape[0])
+                return keep, surviving, b_surviving
             return g
 
         gfn = _cached_program(
             "join-cond|" + fp + "|" + cond.fingerprint(), build_cond)
         arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
                        else None for c in pair.columns)
-        keep, surviving = gfn(arrays, in_range, pi,
-                              jnp.zeros((p_cap,), dtype=jnp.int8))
+        keep, surviving, b_surviving = gfn(
+            arrays, in_range, pi, bi,
+            jnp.zeros((p_cap,), dtype=jnp.int8),
+            jnp.zeros((b_cap,), dtype=jnp.int8))
 
         if how in ("semi", "anti"):
             sel = (surviving > 0) if how == "semi" else (surviving == 0)
@@ -439,23 +524,44 @@ class SortMergeJoinExec(TpuExec):
             return ColumnBatch(self._schema,
                                list(left.columns) + [exists],
                                left.num_rows, left.sel)
-        # left outer: surviving pairs + null-padded unmatched probes
+        # outer joins: surviving pairs + null-padded unmatched rows on
+        # each preserved side
         matched_out = ColumnBatch(self._schema, pair.columns, out_cap, keep)
         from ..batch import logical_to_arrow
-        pad_cols: List = list(left.columns)
-        for f in right.schema:
-            if f.dtype.is_host_carried:
-                import pyarrow as pa
-                pad_cols.append(HostStringColumn(
-                    pa.nulls(p_cap, type=logical_to_arrow(f.dtype))))
-            else:
-                pad_cols.append(DeviceColumn(
-                    f.dtype,
-                    jnp.zeros((p_cap,), dtype=f.dtype.numpy_dtype),
-                    jnp.zeros((p_cap,), dtype=bool)))
-        padded = ColumnBatch(self._schema, pad_cols, left.num_rows,
-                             active & (surviving == 0))
-        return batch_utils.concat_batches([matched_out, padded])
+
+        def _null_cols(schema, cap_):
+            cols: List = []
+            for f in schema:
+                if f.dtype.is_host_carried:
+                    import pyarrow as pa
+                    cols.append(HostStringColumn(
+                        pa.nulls(cap_, type=logical_to_arrow(f.dtype))))
+                else:
+                    shape = (cap_, 2) if getattr(
+                        f.dtype, "is_wide_decimal", False) else (cap_,)
+                    cols.append(DeviceColumn(
+                        f.dtype,
+                        jnp.zeros(shape, dtype=f.dtype.numpy_dtype),
+                        jnp.zeros((cap_,), dtype=bool)))
+            return cols
+
+        parts = [matched_out]
+        if how in ("left", "full"):
+            pad_cols = list(left.columns) + _null_cols(right.schema, p_cap)
+            parts.append(ColumnBatch(self._schema, pad_cols,
+                                     left.num_rows,
+                                     active & (surviving == 0)))
+        if how in ("right", "full"):
+            b_active = jnp.arange(b_cap, dtype=jnp.int32) < right.num_rows
+            if right.sel is not None:
+                b_active = b_active & right.sel
+            pad_cols = _null_cols(left.schema, b_cap) + list(right.columns)
+            parts.append(ColumnBatch(self._schema, pad_cols,
+                                     right.num_rows,
+                                     b_active & (b_surviving == 0)))
+        if len(parts) == 1:
+            return matched_out
+        return batch_utils.concat_batches(parts)
 
     def _apply_residual(self, batch: ColumnBatch) -> ColumnBatch:
         """Inner-join residual condition as a post-selection (non-equi part).

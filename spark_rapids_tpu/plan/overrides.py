@@ -168,6 +168,13 @@ class NodeMeta:
                         self.will_not_work(
                             f"group key {name} is a computed string "
                             f"expression (device string kernels pending)")
+                elif core.dtype is not None and getattr(
+                        core.dtype, "is_wide_decimal", False):
+                    # two-limb columns sort/compare on device but the
+                    # hash-grouping kernels are one-word; CPU fallback
+                    self.will_not_work(
+                        f"group key {name}: decimal128 grouping keys "
+                        "run on CPU")
                 else:
                     for r in expr_reasons(b, allow_string_passthrough=False):
                         self.will_not_work(f"group key {name}: {r}")
@@ -225,6 +232,12 @@ class NodeMeta:
                                 f"{side} join key is a computed string "
                                 f"expression (device string kernels pending)")
                         continue
+                    if core.dtype is not None and getattr(
+                            core.dtype, "is_wide_decimal", False):
+                        self.will_not_work(
+                            f"{side} join key: decimal128 join keys run "
+                            "on CPU (one-word hash kernels)")
+                        continue
                     for r in expr_reasons(b, allow_string_passthrough=False):
                         self.will_not_work(f"{side} join key: {r}")
             _tag_keys(p.left_keys, schema_l, "left")
@@ -235,16 +248,19 @@ class NodeMeta:
                              "existence"):
                 self.will_not_work(f"join type {p.how} not supported")
             cond_ok = ("inner", "left", "left_outer", "semi", "anti",
-                       "existence",
-                       "left_semi", "left_anti")
+                       "existence", "left_semi", "left_anti",
+                       "right", "right_outer", "full", "full_outer",
+                       "outer")
             if p.condition is not None and p.how not in cond_ok:
                 self.will_not_work(
-                    "non-equi residual condition on right/full joins "
-                    "changes match semantics (CPU fallback)")
+                    f"non-equi residual condition on {p.how} join "
+                    "runs on CPU")
             if p.condition is not None and p.how in (
-                    "left", "left_outer") and getattr(p, "using", None):
+                    "left", "left_outer", "right", "right_outer",
+                    "full", "full_outer", "outer") \
+                    and getattr(p, "using", None):
                 self.will_not_work(
-                    "conditioned left USING join (coalesced key columns) "
+                    "conditioned outer USING join (coalesced key columns) "
                     "runs on CPU")
             if p.condition is not None and p.how in cond_ok:
                 schema_all = Schema(list(schema_l.fields)

@@ -507,7 +507,9 @@ class AggregateExec(TpuExec):
             # buys nothing on-device and costs a live-count round trip +
             # concat pass (if the dense path rejects at runtime, the sort
             # path still merges per-batch partials correctly)
-            if self._dense_agg_static_ok(self._buffer_ops(), conf):
+            ops = self._buffer_ops()
+            if self._dense_agg_static_ok(ops, conf) \
+                    or self._dense_residual_static_ok(ops, conf):
                 return None
             return TargetSize(conf["spark.rapids.tpu.sql.batchSizeRows"])
         return None
@@ -943,18 +945,417 @@ class AggregateExec(TpuExec):
             pending = self._to_buffer_batch(
                 buffer_schema, [(key_col, None)],
                 [(a, None) for a in accs], present > 0)
+            # one tail fetch: leftover counts + group count together —
+            # n_groups then sizes a sync-free output compaction, so a
+            # sparse domain (D >> groups) doesn't inflate every
+            # downstream operator to D capacity
             n_groups_dev = jnp.sum((present > 0).astype(jnp.int64))
-            flush_leftovers()
+            left_counts, n_groups = fetch(
+                ([jnp.sum(b.sel.astype(jnp.int32)) for b in leftovers],
+                 n_groups_dev))
+            for b, cnt in zip(leftovers, left_counts):
+                if int(cnt):
+                    left_parts.append(sort_part_fn(
+                        batch_utils.compact(b)))
+            leftovers.clear()
+            n_groups = int(n_groups)
+            from ..batch import bucket_capacity as _bcap
+            if _bcap(max(n_groups, 1)) < D:
+                pending = batch_utils.compact(pending, n_live=n_groups)
             for part in left_parts:
                 pending = self._merge_partials(pending, part, ops, 1)
             out = self._finalize_grouped(pending)
             if left_parts:
                 m.add("numOutputRows", out.row_count())
             else:
-                m.add_deferred("numOutputRows", n_groups_dev)
+                m.add("numOutputRows", n_groups)
             yield out
 
         return run()
+
+    # -- dense multi-key grouping (primary key + residual keys) -------------------
+    #
+    # TPC-H/DS aggregates routinely group by (bounded int key, attributes
+    # functionally dependent on it): q3 (l_orderkey, o_orderdate,
+    # o_shippriority), q10 (c_custkey, c_name, ...), q18 (o_orderkey,
+    # c_name, ...).  The sort path pays a multi-operand device sort per
+    # batch plus concat-merge passes; here the PRIMARY key scatters into
+    # a domain-sized table exactly like the single-key dense path, and
+    # every RESIDUAL key keeps scatter-min/scatter-max channels whose
+    # equality PROVES per-slot functional dependence.  Any violated slot
+    # flips one device flag, checked once at stream end — on violation
+    # (or domain rejection) the buffered input replays through the sort
+    # path, so the rewrite is sound without planner-level constraints.
+
+    def _dense_residual_static_ok(self, ops, conf) -> bool:
+        if self.mode != "complete" or len(self.group_exprs) < 2:
+            return False
+        if not conf["spark.rapids.tpu.sql.agg.dense.enabled"]:
+            return False
+        if not conf["spark.rapids.tpu.join.denseDomainCap"]:
+            return False
+        if any(op not in ("sum", "min", "max") for op in ops):
+            return False
+        if any(getattr(agg, "host_finalize", False)
+               for _, agg in self.agg_exprs):
+            return False
+        from .planner import strip_alias
+        has_int = False
+        for _n, e in self.group_exprs:
+            core = strip_alias(e)
+            if not isinstance(core, BoundReference) or core.dtype is None:
+                return False
+            dt = core.dtype
+            if dt.is_string:
+                continue  # encoded to int32 codes before the kernel
+            if getattr(dt, "is_host_carried", False) or dt.is_nested:
+                return False
+            try:
+                kind = np.dtype(dt.numpy_dtype).kind
+            except TypeError:
+                return False
+            if kind not in "iufb":
+                return False
+            if kind in "iu":
+                has_int = True
+        return has_int
+
+    def _try_dense_grouped_multi(self, ctx, m, first, rest, ops,
+                                 update, buffer_schema, sort_part_fn):
+        """Multi-key dense aggregation; None rejects to the sort path."""
+        import itertools
+
+        from .planner import strip_alias
+        keys = [strip_alias(e) for _n, e in self.group_exprs]
+        n_keys = len(keys)
+        fp = "agg-mdense|" + self._fingerprint()
+        first = self._encode_string_keys(first, ctx)
+        # candidate primaries: int-typed keys (stats for all in ONE fetch)
+        cand = [i for i, k in enumerate(keys)
+                if not k.dtype.is_string
+                and np.dtype(k.dtype.numpy_dtype).kind in "iu"]
+
+        def build_stats():
+            @jax.jit
+            def f(arrays, sel, num_rows):
+                cap = next(a[0].shape[0] for a in arrays
+                           if a is not None)
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(arrays, cap, active=active)
+                outs = []
+                big = jnp.int64(np.iinfo(np.int64).max)
+                for i in cand:
+                    d, v = keys[i].eval(ectx)
+                    ok = active if v is None else (active & v)
+                    d64 = d.astype(jnp.int64)
+                    outs.append(jnp.stack([
+                        jnp.min(jnp.where(ok, d64, big)),
+                        jnp.max(jnp.where(ok, d64, -big)),
+                        jnp.sum(ok.astype(jnp.int64))]))
+                return jnp.stack(outs)
+            return f
+
+        def arrays_of(b):
+            return tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
+                         else None for c in b.columns)
+
+        if any(not isinstance(first.columns[k.ordinal], DeviceColumn)
+               for k in keys):
+            # un-encodable key column (string keys became device codes
+            # above, so this is a host-carried nested/decimal): sort path
+            return None
+        sfn = _cached_program(fp + "|stats", build_stats)
+        stats = fetch(sfn(arrays_of(first), first.sel,
+                          np.int32(first.num_rows)))
+        cap_conf = ctx.conf["spark.rapids.tpu.join.denseDomainCap"]
+        best = None  # (domain, cand_idx, kmin)
+        for row, i in zip(np.asarray(stats), cand):
+            kmin, kmax, n_valid = [int(x) for x in row]
+            if n_valid == 0:
+                continue
+            domain = kmax - kmin + 1
+            if domain <= 0 or domain > cap_conf:
+                continue
+            if best is None or domain < best[0]:
+                best = (domain, i, kmin)
+        if best is None:
+            return None
+        domain, pidx, kmin = best
+        primary = keys[pidx]
+        residual_idx = [i for i in range(n_keys) if i != pidx]
+        from ..batch import bucket_capacity
+        D = bucket_capacity(domain)
+        n_bufs = len(ops)
+        # HBM guardrail: accumulators are D * (residual channels + bufs)
+        est = D * (len(residual_idx) * (16 + 2) + 2 + 8 * n_bufs)
+        if est > ctx.conf["spark.rapids.tpu.sql.agg.dense.maxAccumBytes"]:
+            return None
+
+        from ..ops.groupby import _SENTINELS
+
+        def _sent_kind(np_dt):
+            return ("f" if np_dt.kind == "f"
+                    else "b" if np_dt == np.bool_ else "i")
+
+        def _res_np_dtype(k):
+            if k.dtype.is_string:
+                return np.dtype(np.int32)  # dictionary codes
+            return np.dtype(k.dtype.numpy_dtype)
+
+        def _init_acc():
+            accs = []
+            for f, op in zip(buffer_schema.fields[n_keys:], ops):
+                np_dt = np.dtype(f.dtype.numpy_dtype)
+                if op == "sum":
+                    accs.append(jnp.zeros((D,), dtype=np_dt))
+                else:
+                    sent = _SENTINELS[op][_sent_kind(np_dt)](np_dt)
+                    accs.append(jnp.full((D,), sent, dtype=np_dt))
+            return accs
+
+        def _init_res():
+            res = []
+            for i in residual_idx:
+                np_dt = _res_np_dtype(keys[i])
+                lo = _SENTINELS["min"][_sent_kind(np_dt)](np_dt)
+                hi = _SENTINELS["max"][_sent_kind(np_dt)](np_dt)
+                res.append((jnp.full((D,), lo, dtype=np_dt),   # vmin
+                            jnp.full((D,), hi, dtype=np_dt),   # vmax
+                            jnp.ones((D,), dtype=jnp.int8),    # validmin
+                            jnp.zeros((D,), dtype=jnp.int8)))  # validmax
+            return res
+
+        def build_update():
+            @jax.jit
+            def f(arrays, sel, num_rows, accs, res, present, kmin_s):
+                cap = next(a[0].shape[0] for a in arrays
+                           if a is not None)
+                active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                if sel is not None:
+                    active = active & sel
+                ectx = EvalContext(arrays, cap, active=active)
+                kd, kv = primary.eval(ectx)
+                ok = active if kv is None else (active & kv)
+                idx = kd.astype(jnp.int64) - kmin_s
+                in_dom = ok & (idx >= 0) & (idx < D)
+                sidx = jnp.where(in_dom, idx, jnp.int64(D))
+                contribs = update(ectx)
+                new_accs = []
+                for (cd, cv), acc, op in zip(contribs, accs, ops):
+                    mask = in_dom if cv is None else (in_dom & cv)
+                    if op == "sum":
+                        z = jnp.zeros((), dtype=acc.dtype)
+                        new_accs.append(acc.at[sidx].add(
+                            jnp.where(mask, cd.astype(acc.dtype), z),
+                            mode="drop"))
+                    else:
+                        np_dt = np.dtype(acc.dtype)
+                        sent = acc.dtype.type(
+                            _SENTINELS[op][_sent_kind(np_dt)](np_dt))
+                        scatter = (acc.at[sidx].min if op == "min"
+                                   else acc.at[sidx].max)
+                        new_accs.append(scatter(
+                            jnp.where(mask, cd.astype(acc.dtype), sent),
+                            mode="drop"))
+                new_res = []
+                for (vmin, vmax, dmn, dmx), ri in zip(res, residual_idx):
+                    rd, rv = keys[ri].eval(ectx)
+                    rd = rd.astype(vmin.dtype)
+                    r_ok = in_dom if rv is None else (in_dom & rv)
+                    np_dt = np.dtype(vmin.dtype)
+                    lo = vmin.dtype.type(
+                        _SENTINELS["min"][_sent_kind(np_dt)](np_dt))
+                    hi = vmin.dtype.type(
+                        _SENTINELS["max"][_sent_kind(np_dt)](np_dt))
+                    nvmin = vmin.at[sidx].min(
+                        jnp.where(r_ok, rd, lo), mode="drop")
+                    nvmax = vmax.at[sidx].max(
+                        jnp.where(r_ok, rd, hi), mode="drop")
+                    v01 = jnp.where(r_ok, jnp.int8(1), jnp.int8(0))
+                    # validmin over in-domain rows (1 outside so it
+                    # never spuriously reports a null)
+                    ndmn = dmn.at[sidx].min(
+                        jnp.where(in_dom, v01, jnp.int8(1)), mode="drop")
+                    ndmx = dmx.at[sidx].max(v01, mode="drop")
+                    new_res.append((nvmin, nvmax, ndmn, ndmx))
+                present = present.at[sidx].max(
+                    jnp.where(in_dom, jnp.int8(1), jnp.int8(0)),
+                    mode="drop")
+                leftover = active & ~in_dom
+                return tuple(new_accs), tuple(new_res), present, leftover
+            return f
+
+        ufn = _cached_program(fp + f"|update|{pidx}|{D}", build_update)
+
+        def build_violation():
+            @jax.jit
+            def f(res, present):
+                viol = jnp.zeros((), dtype=bool)
+                for (vmin, vmax, dmn, dmx) in res:
+                    has_val = dmx == 1
+                    mixed = has_val & (dmn == 0)
+                    # NaN residuals: vmin/vmax comparisons are unreliable
+                    # -> treat any NaN as a violation (sort fallback)
+                    if np.dtype(vmin.dtype).kind == "f":
+                        bad = has_val & (~(vmin == vmax) | jnp.isnan(vmin)
+                                         | jnp.isnan(vmax))
+                    else:
+                        bad = has_val & (vmin != vmax)
+                    viol = viol | jnp.any(present.astype(bool)
+                                          & (bad | mixed))
+                return viol
+            return f
+
+        vfn = _cached_program(fp + f"|viol|{pidx}|{D}", build_violation)
+
+        kcol = first.columns[primary.ordinal]
+        key_nonnull = (isinstance(kcol, DeviceColumn)
+                       and kcol.valid is None)
+
+        def run():
+            from ..memory.spill import get_catalog
+            catalog = get_catalog(ctx.conf)
+            accs = _init_acc()
+            res = _init_res()
+            present = jnp.zeros((D,), dtype=jnp.int8)
+            kmin_s = jnp.int64(kmin)
+            leftovers = []
+            left_parts = []
+            # replay buffer for the violation fallback: SPILLABLE handles
+            # (priority 1) so a long stream doesn't pin its whole input
+            # in HBM next to the D-sized accumulators
+            buffered = []
+            first_batch = True
+
+            def flush_leftovers():
+                if not leftovers:
+                    return
+                counts = fetch(
+                    [jnp.sum(b.sel.astype(jnp.int32)) for b in leftovers])
+                for b, cnt in zip(leftovers, counts):
+                    if int(cnt):
+                        left_parts.append(sort_part_fn(
+                            batch_utils.compact(b)))
+                leftovers.clear()
+
+            for batch in itertools.chain([first], rest):
+                if batch.num_rows == 0:
+                    continue
+                if not first_batch:
+                    batch = self._encode_string_keys(batch, ctx)
+                if any(not isinstance(batch.columns[k.ordinal],
+                                      DeviceColumn) for k in keys):
+                    # un-encodable key in a later batch: replay all
+                    yield from self._sort_path_replay(
+                        ctx, m,
+                        [h.get() for h in buffered] + [batch], rest, ops,
+                        sort_part_fn)
+                    for h in buffered:
+                        h.close()
+                    return
+                buffered.append(catalog.register(batch, priority=1))
+                with m.time("opTime"):
+                    accs_t, res_t, present, leftover = ufn(
+                        arrays_of(batch), batch.sel,
+                        np.int32(batch.num_rows), tuple(accs),
+                        tuple(res), present, kmin_s)
+                    accs = list(accs_t)
+                    res = list(res_t)
+                if not (first_batch and key_nonnull):
+                    leftovers.append(
+                        ColumnBatch(batch.schema, batch.columns,
+                                    batch.num_rows, leftover))
+                first_batch = False
+                if len(leftovers) >= 8:
+                    flush_leftovers()
+            # ONE end-of-stream fetch: violation flag + per-batch
+            # leftover counts + group count together
+            n_groups_dev = jnp.sum((present > 0).astype(jnp.int64))
+            tail = fetch((vfn(tuple(res), present),
+                          [jnp.sum(b.sel.astype(jnp.int32))
+                           for b in leftovers], n_groups_dev))
+            violated, left_counts, n_groups = tail
+            if bool(violated):
+                m.add("aggDenseResidualFallback", 1)
+                try:
+                    yield from self._sort_path_replay(
+                        ctx, m, (h.get() for h in buffered), None, ops,
+                        sort_part_fn)
+                finally:
+                    for h in buffered:
+                        h.close()
+                return
+            for h in buffered:
+                h.close()
+            buffered.clear()
+            for b, cnt in zip(leftovers, left_counts):
+                if int(cnt):
+                    left_parts.append(sort_part_fn(
+                        batch_utils.compact(b)))
+            leftovers.clear()
+            m.add("aggDensePath", 1)
+            # assemble the buffer batch: keys in original order
+            key_cols = []
+            for i in range(n_keys):
+                f = buffer_schema.fields[i]
+                if i == pidx:
+                    prim = (kmin + jnp.arange(D, dtype=jnp.int64))
+                    if f.dtype.is_string:
+                        key_cols.append((prim.astype(jnp.int32), None))
+                    else:
+                        key_cols.append((
+                            prim.astype(f.dtype.numpy_dtype), None))
+                else:
+                    ri = residual_idx.index(i)
+                    vmin, vmax, dmn, dmx = res[ri]
+                    key_cols.append((vmin, dmx == 1))
+            pending = self._to_buffer_batch(
+                buffer_schema, key_cols,
+                [(a, None) for a in accs], present > 0)
+            n_groups = int(n_groups)
+            from ..batch import bucket_capacity as _bcap
+            if _bcap(max(n_groups, 1)) < D:
+                # sync-free (count already fetched): don't let a sparse
+                # domain inflate downstream operators to D capacity
+                pending = batch_utils.compact(pending, n_live=n_groups)
+            for part in left_parts:
+                pending = self._merge_partials(pending, part, ops, n_keys)
+            out = self._finalize_grouped(pending)
+            if left_parts:
+                m.add("numOutputRows", out.row_count())
+            else:
+                m.add("numOutputRows", int(n_groups))
+            yield out
+
+        return run()
+
+    def _sort_path_replay(self, ctx, m, buffered, rest, ops, sort_part_fn):
+        """Violation/ineligibility fallback: run the buffered (and any
+        remaining) batches through the generic sort path."""
+        import itertools
+        n_keys = len(self.group_exprs)
+        pending = None
+        stream = buffered if rest is None else itertools.chain(
+            buffered, rest)
+        for batch in stream:
+            if batch.num_rows == 0:
+                continue
+            batch = self._encode_string_keys(batch, ctx)
+            with m.time("opTime"):
+                part = sort_part_fn(batch)
+                if pending is None:
+                    pending = batch_utils.compact_packed(part)
+                else:
+                    pending = self._merge_partials(pending, part, ops,
+                                                   n_keys)
+        if pending is None:
+            yield ColumnBatch(self._schema, self._empty_cols(), 0)
+            return
+        out = self._finalize_grouped(pending)
+        m.add("numOutputRows", out.num_rows)
+        yield out
 
     # -- grouped ------------------------------------------------------------------
     def _execute_grouped(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
@@ -1103,6 +1504,19 @@ class AggregateExec(TpuExec):
             dense = self._try_dense_grouped(ctx, m, peek, child_batches,
                                             ops, update, buffer_schema,
                                             run_one)
+            if dense is not None:
+                yield from dense
+                return
+            import itertools
+            child_batches = itertools.chain([peek], child_batches)
+        elif self._dense_residual_static_ok(ops, ctx.conf):
+            peek = next(child_batches, None)
+            if peek is None:
+                yield ColumnBatch(self._schema, self._empty_cols(), 0)
+                return
+            dense = self._try_dense_grouped_multi(
+                ctx, m, peek, child_batches, ops, update, buffer_schema,
+                run_one)
             if dense is not None:
                 yield from dense
                 return
@@ -1360,27 +1774,25 @@ class AggregateExec(TpuExec):
         return ColumnBatch(batch.schema, cols, batch.num_rows, batch.sel)
 
     def _decode_string_keys(self, out: ColumnBatch) -> ColumnBatch:
-        """Map coded key columns back to host strings at the output boundary
-        (one batched device_get for all coded columns)."""
+        """Re-type coded key columns as DictStringColumn at the output
+        boundary: codes STAY on device, the dictionary snapshot rides
+        along, and the decode fetch happens only if/when a downstream
+        consumer touches .array (collect decodes inside its one batched
+        fetch) — the r4 version paid a blocking fetch per agg here."""
         if not self.string_dicts or self.mode == "partial":
             return out
+        from ..batch import DictStringColumn
         cols = list(out.columns)
-        fetch_tree = {}
-        for gi in self.string_dicts:
-            col = cols[gi]
-            if isinstance(col, DeviceColumn):
-                fetch_tree[("c", gi)] = col.data
-                if col.valid is not None:
-                    fetch_tree[("v", gi)] = col.valid
-        if not fetch_tree:
-            return out
-        host = fetch(fetch_tree)
+        changed = False
         for gi, d in self.string_dicts.items():
             col = cols[gi]
             if not isinstance(col, DeviceColumn):
                 continue
-            arr = d.decode(host[("c", gi)], host.get(("v", gi)))
-            cols[gi] = HostStringColumn(arr, capacity=out.capacity)
+            cols[gi] = DictStringColumn(
+                col.data.astype(jnp.int32), col.valid, d.to_arrow())
+            changed = True
+        if not changed:
+            return out
         return ColumnBatch(out.schema, cols, out.num_rows, out.sel)
 
     def _key_contributions(self, ectx: EvalContext):

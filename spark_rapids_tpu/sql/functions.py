@@ -908,6 +908,14 @@ def hash(*cols) -> Column:  # noqa: A001 — mirrors pyspark naming
     return Column(B.Murmur3Hash(*[_colref(c) for c in cols]))
 
 
+def interleave_bits(*cols) -> Column:
+    """Z-order (Morton) index of integer columns — the clustering key
+    OPTIMIZE ZORDER BY sorts by (zorder/ZOrderRules.scala
+    GpuInterleaveBits analog; used by io.delta.delta_zorder)."""
+    from .. import bitwisefns as B
+    return Column(B.InterleaveBits(*[_colref(c) for c in cols]))
+
+
 def xxhash64(*cols) -> Column:
     """Spark-exact xxhash64 row hash, seed 42 (GpuXxHash64)."""
     from .. import bitwisefns as B

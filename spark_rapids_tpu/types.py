@@ -111,9 +111,18 @@ class DataType:
     def is_host_carried(self) -> bool:
         """True if columns of this type ride as host arrow columns in
         device batches (no device representation: strings, nested,
-        decimal beyond 64-bit scaled-int range)."""
+        decimal beyond emulated-128-bit range)."""
         return (self.is_string or self.is_nested
-                or (self.is_decimal and self.precision > 18))
+                or (self.is_decimal and self.precision > 38))
+
+    @property
+    def is_wide_decimal(self) -> bool:
+        """decimal with 18 < precision <= 38: device representation is a
+        (capacity, 2) int64 limb array [lo, hi] of the scaled 128-bit
+        two's-complement value (GpuCast.scala/DecimalUtil.scala analog —
+        the TPU has no int128, so add/compare/sum emulate via limbs;
+        unsupported wide ops fall back per TypeSig)."""
+        return self.is_decimal and 18 < self.precision <= 38
 
     @property
     def is_nested(self) -> bool:
@@ -180,10 +189,8 @@ def map_of(key: DataType, value: DataType) -> DataType:
 
 
 def decimal(precision: int, scale: int) -> DataType:
-    if precision > 18:
-        # decimal128 requires emulated wide-int kernels (SURVEY.md §7.3); the
-        # planner rejects >18 so those expressions fall back to CPU for now.
-        pass
+    # precision <= 18: scaled int64; 18 < p <= 38: two int64 limbs on
+    # device (add/compare/sum emulated); > 38: host-carried arrow column.
     return DataType(TypeKind.DECIMAL, precision=precision, scale=scale)
 
 
@@ -221,7 +228,9 @@ def common_type(a: DataType, b: DataType) -> DataType:
         # widest integral part + widest scale (Spark widerDecimalType)
         s = max(a.scale, b.scale)
         ip = max(a.precision - a.scale, b.precision - b.scale)
-        return decimal(min(ip + s, 18), s)
+        # Spark add/compare result precision caps at DECIMAL128's 38
+        # (two-limb device kernels handle 18 < p <= 38)
+        return decimal(min(ip + s, 38), s)
     if a.is_decimal and b.is_integral:
         return common_type(a, integral_as_decimal(b))
     if b.is_decimal and a.is_integral:
@@ -310,4 +319,6 @@ TypeSig.common = (TypeSig.numeric + TypeSig.datetime + TypeSig.BOOLEAN
                   + TypeSig.string + TypeSig.null)
 TypeSig.orderable = TypeSig.common
 TypeSig.device_compute = TypeSig.common - TypeSig.string  # strings: host kernels for now
+# opt-in for expressions with emulated two-limb decimal128 kernels
+TypeSig.decimal128 = TypeSig((TypeKind.DECIMAL,), max_decimal_precision=38)
 TypeSig.all = TypeSig.common + _sig(TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP)

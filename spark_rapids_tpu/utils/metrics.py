@@ -46,6 +46,9 @@ class QueryStats:
         self.compile_s = 0.0
         self.uploads = 0
         self.upload_bytes = 0
+        # bytes entering shuffle exchanges (device batch sizes at the
+        # staging barrier) — BASELINE.json's shuffle-GB/s metric input
+        self.shuffle_bytes = 0
 
     # -- global accessors ---------------------------------------------------
     @classmethod

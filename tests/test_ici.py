@@ -198,6 +198,31 @@ def test_ici_bucket_overflow_detected(sess, rng):
         sess.conf.set("spark.rapids.tpu.shuffle.mode", "CACHE_ONLY")
 
 
+def test_ici_bucket_overflow_transparent_recovery(sess, rng):
+    """Sibling of test_ici_bucket_overflow_detected (VERDICT r4 item 8):
+    a bucket one notch too small must NOT surface — distribute_plan
+    re-lowers the fragment at 4x capacities and the query completes with
+    answers identical to CACHE_ONLY mode."""
+    n = 4000
+    t = pa.table({"k": pa.array(rng.integers(0, 500, n)),
+                  "v": pa.array(rng.uniform(0, 1, n))})
+    df = (sess.create_dataframe(t).group_by("k")
+          .agg(F.sum(F.col("v")).alias("s")))
+    want = sorted(df.collect())
+    sess.conf.set("spark.rapids.tpu.shuffle.mode", "ICI")
+    # ~4000/8 devices = 500 rows/device; 500 distinct keys spread over
+    # 8 targets ~ 62/bucket: 32 overflows once, 128 (one 4x retry) fits
+    sess.conf.set("spark.rapids.tpu.shuffle.ici.bucketRows", 32)
+    try:
+        got = sorted(df.collect())
+    finally:
+        sess.conf.set("spark.rapids.tpu.shuffle.ici.bucketRows", 0)
+        sess.conf.set("spark.rapids.tpu.shuffle.mode", "CACHE_ONLY")
+    assert len(got) == len(want)
+    for (gk, gs), (wk, ws) in zip(got, want):
+        assert gk == wk and abs(gs - ws) < 1e-9
+
+
 def test_ici_exchange_never_silently_degrades(sess):
     """An exchange reached by the single-process executor under mode=ICI
     must raise unless shuffle.ici.fallback is set (round-2 weak #2)."""

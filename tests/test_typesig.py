@@ -57,9 +57,9 @@ class TestSigDrivenTagging:
 
 
 class TestDecimal128Tier:
-    """decimal(>18) has no device representation: it rides as a host arrow
-    column (like strings), passes through device plans, and any compute
-    over it is sig-rejected to the CPU fallback — never a crash."""
+    """decimal(18 < p <= 38) rides as DEVICE two-limb int64 columns
+    (r5, ops/wide_decimal.py): projection/sort/add/compare/sum stay on
+    device; only >38 or unsupported wide ops fall back."""
 
     def _df(self, session):
         import decimal
@@ -80,25 +80,30 @@ class TestDecimal128Tier:
         assert [str(r[0]) for r in rows[:2]] == \
             ["99999999999999999999.50", "1.25"]
 
-    def test_sort_key_falls_back_and_computes(self, session):
+    def test_sort_key_on_device(self, session):
+        # r5: decimal(38) rides as two int64 limbs — the sort contributes
+        # (hi, lo-unsigned) operands and stays ON DEVICE
         import decimal
         df = self._df(session)
         q = df.sort("x")
         plan = q.explain_string()
-        assert "host-carried column x" in plan
+        assert "host-carried column x" not in plan
         rows = q.collect()
         assert rows[0][0] is None  # nulls first (asc default)
         assert rows[1][0] == decimal.Decimal("1.25")
         assert rows[2][0] == decimal.Decimal("99999999999999999999.50")
 
-    def test_compute_rejected_once_not_twice(self, session):
+    def test_wide_plus_float_on_device(self, session):
+        # r5: decimal(38) + float promotes to float64 on device (lossy
+        # like Spark's Decimal.toDouble) instead of CPU-falling-back
         from spark_rapids_tpu.sql import functions as F
         df = self._df(session)
-        plan = df.select((F.col("x") + F.col("y")).alias("z")) \
-            .explain_string()
-        n_reasons = plan.count("decimal precision 38") \
-            + plan.count("host-carried column x")
-        assert n_reasons == 1, plan
+        q = df.select((F.col("x") + F.col("y")).alias("z"))
+        plan = q.explain_string()
+        assert "!" not in plan.splitlines()[2], plan
+        rows = q.collect()
+        assert abs(rows[1][0] - 4.25) < 1e-9
+        assert rows[2][0] is None
 
 
 class TestSigsGenerateDocs:
