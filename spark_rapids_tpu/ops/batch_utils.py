@@ -40,55 +40,77 @@ def concat_batches(batches: Sequence[ColumnBatch],
     schema = batches[0].schema
     total = sum(b.capacity for b in batches)
     cap = bucket_capacity(total, min_capacity)
-    cols = []
-    for ci, f in enumerate(schema):
+    # classify columns: device-concat (one jitted program for ALL of
+    # them + the selection mask — the eager version compiled a
+    # concatenate+pad per column per shape combination) vs host strings
+    col_kind = []
+    for ci in range(len(schema)):
         parts = [b.columns[ci] for b in batches]
         if all(isinstance(p, DictStringColumn) for p in parts) and \
                 all(p.dictionary is parts[0].dictionary for p in parts):
-            # shared dictionary: codes concat on device like any column
-            codes = _pad_dev(jnp.concatenate([p.codes for p in parts]), cap)
-            if any(p.valid is not None for p in parts):
-                valid = _pad_dev(jnp.concatenate([
-                    p.valid if p.valid is not None
-                    else jnp.ones((b.capacity,), dtype=bool)
-                    for b, p in zip(batches, parts)]), cap)
+            col_kind.append("dict")
+        elif isinstance(parts[0], HostStringColumn):
+            col_kind.append("host")
+        else:
+            col_kind.append("dev")
+    spec = []
+    feed = []
+    for bi, b in enumerate(batches):
+        entry = []
+        for ci, kind in enumerate(col_kind):
+            c = b.columns[ci]
+            if kind == "dict":
+                entry.append((c.codes, c.valid))
+                spec.append((bi, ci, c.codes.dtype.name,
+                             c.valid is not None, ()))
+            elif kind == "dev":
+                entry.append((c.data, c.valid))
+                spec.append((bi, ci, c.data.dtype.name,
+                             c.valid is not None,
+                             tuple(c.data.shape[1:])))
             else:
-                valid = None
-            cols.append(DictStringColumn(codes, valid, parts[0].dictionary))
-            continue
-        if isinstance(parts[0], HostStringColumn):
+                entry.append(None)
+        feed.append((tuple(entry), b.sel))
+    caps = tuple(b.capacity for b in batches)
+    sels_present = tuple(b.sel is not None for b in batches)
+    outs, sel = _concat_fn(caps, cap, tuple(col_kind),
+                           tuple(spec), sels_present)(
+        tuple(f[0] for f in feed), tuple(f[1] for f in feed),
+        tuple(np.int32(b.num_rows) for b in batches))
+    cols = []
+    oi = 0
+    host_masks: dict = {}  # ONE mask fetch per batch, shared by columns
+
+    def _mask_of(bi, b):
+        if bi not in host_masks:
+            host_masks[bi] = fetch(b.active_mask())[: b.num_rows]
+        return host_masks[bi]
+
+    for ci, kind in enumerate(col_kind):
+        f = schema.fields[ci]
+        if kind == "host":
             import pyarrow as pa
-            # host strings: compact each side on host (strings sync anyway)
             arrs = []
-            for b, p in zip(batches, parts):
+            for bi, b in enumerate(batches):
+                p = b.columns[ci]
                 a = p.array.slice(0, b.num_rows)
                 if b.sel is not None:
-                    m = fetch(b.active_mask())[: b.num_rows]
-                    a = a.filter(pa.array(m))
+                    a = a.filter(pa.array(_mask_of(bi, b)))
                 arrs.append(a)
             cat = pa.concat_arrays(arrs)
-            # host columns must align with device capacity: pad with nulls
             if len(cat) < cap:
                 cat = pa.concat_arrays(
                     [cat, pa.nulls(cap - len(cat), type=cat.type)])
             cols.append(HostStringColumn(cat))
             continue
-        data = jnp.concatenate([p.data for p in parts])
-        data = _pad_dev(data, cap)
-        if any(p.valid is not None for p in parts):
-            valid = jnp.concatenate([
-                p.valid if p.valid is not None
-                else jnp.ones((b.capacity,), dtype=bool)
-                for b, p in zip(batches, parts)])
-            valid = _pad_dev(valid, cap)
+        data, valid = outs[oi]
+        oi += 1
+        if kind == "dict":
+            cols.append(DictStringColumn(
+                data, valid, batches[0].columns[ci].dictionary))
         else:
-            valid = None
-        cols.append(DeviceColumn(f.dtype, data, valid))
-    # selection: each batch contributes its active mask at its offset
-    sels = [b.active_mask() for b in batches]
-    sel = _pad_dev(jnp.concatenate(sels), cap)
-    has_strings = any(isinstance(c, HostStringColumn)
-                      and not isinstance(c, DictStringColumn) for c in cols)
+            cols.append(DeviceColumn(f.dtype, data, valid))
+    has_strings = any(k == "host" for k in col_kind)
     if has_strings:
         # host strings were compacted; device columns were not — mixed batches
         # must compact device side too for row alignment.
@@ -157,31 +179,35 @@ def compact(batch: ColumnBatch, align_host_strings: bool = False,
         host_mask = fetch(active)
     # stable compaction WITHOUT a sort: every live row's destination is
     # cumsum(active)-1, so one cumsum + a per-column scatter (mode=drop
-    # swallows dead rows) packs the batch.  The previous lexsort+gather
-    # cost ~0.5 s per 8M-capacity batch on this chip; scatters run at
-    # gather speed (PERF.md two-laws), so this is ~20x cheaper and
-    # compiles per capacity bucket exactly like the sort did.
+    # swallows dead rows) packs the batch — and the WHOLE compact (all
+    # device columns) runs as ONE cached jitted program: the previous
+    # eager version compiled a tiny cumsum/where/scatter program per
+    # column per shape (a third of q13's 84 cold compiles) and paid a
+    # dispatch per op on the tunnel.
     new_cap = bucket_capacity(max(n_live, min_capacity))
-    dest = jnp.cumsum(active.astype(jnp.int32)) - 1
-    scatter_idx = jnp.where(active, dest, new_cap)
-    cols = []
-    for f, c in zip(batch.schema, batch.columns):
+    dev_inputs = []   # (data, valid) in column order, None for host cols
+    spec = []
+    for c in batch.columns:
         if isinstance(c, DictStringColumn):
-            # device codes compact like any device column (align mode
-            # included: dict columns ride the device concat, so they are
-            # NOT pre-compacted the way plain host strings are)
-            codes = jnp.zeros((new_cap,), dtype=c.codes.dtype).at[
-                scatter_idx].set(c.codes, mode="drop")
-            if c.valid is not None:
-                valid = jnp.zeros((new_cap,), dtype=bool).at[
-                    scatter_idx].set(c.valid, mode="drop")
-            else:
-                valid = None
-            cols.append(DictStringColumn(codes, valid, c.dictionary))
-            continue
-        if isinstance(c, HostStringColumn):
+            dev_inputs.append((c.codes, c.valid))
+            spec.append(("d", c.codes.dtype.name, c.valid is not None, ()))
+        elif isinstance(c, HostStringColumn):
+            dev_inputs.append(None)
+            spec.append(("h", "", False, ()))
+        else:
+            dev_inputs.append((c.data, c.valid))
+            spec.append(("d", c.data.dtype.name, c.valid is not None,
+                         tuple(c.data.shape[1:])))
+    outs = _compact_fn(batch.capacity, new_cap, tuple(spec),
+                       batch.sel is not None)(
+        tuple(dev_inputs), batch.sel, np.int32(batch.num_rows))
+    cols = []
+    oi = 0
+    for (kind, _dt, _hv, _extra), c, f in zip(spec, batch.columns,
+                                              batch.schema):
+        if kind == "h":
             if align_host_strings:
-                # already compacted during concat; just repad to new capacity
+                # already compacted during concat; repad to new capacity
                 import pyarrow as pa
                 a = c.array.slice(0, n_live)
                 if len(a) < new_cap:
@@ -198,15 +224,84 @@ def compact(batch: ColumnBatch, align_host_strings: bool = False,
                     a = pa.concat_arrays([a, pa.nulls(new_cap - len(a), type=a.type)])
                 cols.append(HostStringColumn(a))
             continue
-        data = jnp.zeros((new_cap,) + c.data.shape[1:],
-                         dtype=c.data.dtype).at[
-            scatter_idx].set(c.data, mode="drop")
-        valid = None
-        if c.valid is not None:
-            valid = jnp.zeros((new_cap,), dtype=bool).at[
-                scatter_idx].set(c.valid, mode="drop")
-        cols.append(DeviceColumn(f.dtype, data, valid))
+        data, valid = outs[oi]
+        oi += 1
+        if isinstance(c, DictStringColumn):
+            cols.append(DictStringColumn(data, valid, c.dictionary))
+        else:
+            cols.append(DeviceColumn(f.dtype, data, valid))
     return ColumnBatch(batch.schema, cols, n_live)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=512)
+def _concat_fn(caps: tuple, out_cap: int, col_kind: tuple, spec: tuple,
+               sels_present: tuple):
+    """One jitted program concatenating every device column of N
+    batches plus the combined selection mask."""
+    n_b = len(caps)
+    # (spec participates only as the lru_cache trace key)
+
+    @jax.jit
+    def f(entries, sels, num_rows_tuple):
+        actives = []
+        for bi in range(n_b):
+            a = jnp.arange(caps[bi], dtype=jnp.int32) < num_rows_tuple[bi]
+            if sels[bi] is not None:
+                a = a & sels[bi]
+            actives.append(a)
+        outs = []
+        for ci, kind in enumerate(col_kind):
+            if kind == "host":
+                continue
+            datas, valids = [], []
+            any_valid = any(
+                entries[bi][ci] is not None
+                and entries[bi][ci][1] is not None for bi in range(n_b))
+            for bi in range(n_b):
+                d, v = entries[bi][ci]
+                datas.append(d)
+                if any_valid:
+                    valids.append(v if v is not None
+                                  else jnp.ones((caps[bi],), dtype=bool))
+            data = _pad_dev(jnp.concatenate(datas), out_cap)
+            valid = _pad_dev(jnp.concatenate(valids), out_cap) \
+                if any_valid else None
+            outs.append((data, valid))
+        sel = _pad_dev(jnp.concatenate(actives), out_cap)
+        return tuple(outs), sel
+
+    return f
+
+
+@functools.lru_cache(maxsize=512)
+def _compact_fn(cap: int, new_cap: int, spec: tuple, has_sel: bool):
+    """One jitted program compacting EVERY device column of a batch."""
+
+    @jax.jit
+    def f(cols, sel, num_rows):
+        active = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        if sel is not None:
+            active = active & sel
+        dest = jnp.cumsum(active.astype(jnp.int32)) - 1
+        scatter_idx = jnp.where(active, dest, new_cap)
+        outs = []
+        for (kind, _dt, _hv, extra), dv in zip(spec, cols):
+            if kind == "h":
+                continue
+            data, valid = dv
+            od = jnp.zeros((new_cap,) + extra, dtype=data.dtype).at[
+                scatter_idx].set(data, mode="drop")
+            ov = None
+            if valid is not None:
+                ov = jnp.zeros((new_cap,), dtype=bool).at[
+                    scatter_idx].set(valid, mode="drop")
+            outs.append((od, ov))
+        return tuple(outs)
+
+    return f
 
 
 def compact_packed(batch: ColumnBatch,
@@ -247,34 +342,71 @@ def compact_packed(batch: ColumnBatch,
 
 
 def slice_batch(batch: ColumnBatch, start: int, length: int) -> ColumnBatch:
-    """Static host-side slice (rows must be compact — no selection mask)."""
+    """Device slice (rows must be compact — no selection mask): ONE
+    jitted program per (shape spec, out bucket) with the start as a
+    dynamic argument — the eager version compiled a dynamic_slice + pad
+    per column per (start, length) combination (16 of q3's 110 cold
+    compiles)."""
     assert batch.sel is None, "slice requires a compacted batch"
     cap = bucket_capacity(length)
-    cols = []
-    for f, c in zip(batch.schema, batch.columns):
+    spec = []
+    feed = []
+    for c in batch.columns:
         if isinstance(c, DictStringColumn):
-            codes = _pad_dev(jax.lax.dynamic_slice_in_dim(
-                c.codes, start, min(length, c.capacity - start)), cap)
-            sv = None
-            if c.valid is not None:
-                sv = _pad_dev(jax.lax.dynamic_slice_in_dim(
-                    c.valid, start, min(length, c.capacity - start)), cap)
-            cols.append(DictStringColumn(codes, sv, c.dictionary))
-            continue
-        if isinstance(c, HostStringColumn):
+            feed.append((c.codes, c.valid))
+            spec.append(("d", c.codes.dtype.name, c.valid is not None, ()))
+        elif isinstance(c, HostStringColumn):
+            feed.append(None)
+            spec.append(("h", "", False, ()))
+        else:
+            feed.append((c.data, c.valid))
+            spec.append(("d", c.data.dtype.name, c.valid is not None,
+                         tuple(c.data.shape[1:])))
+    outs = _slice_fn(batch.capacity, cap, tuple(spec))(
+        tuple(feed), np.int32(start))
+    cols = []
+    oi = 0
+    for (kind, _dt, _hv, _ex), c, f in zip(spec, batch.columns,
+                                           batch.schema):
+        if kind == "h":
             a = c.array.slice(start, length)
             import pyarrow as pa
             if len(a) < cap:
                 a = pa.concat_arrays([a.combine_chunks() if isinstance(
-                    a, pa.ChunkedArray) else a, pa.nulls(cap - len(a), type=a.type)])
+                    a, pa.ChunkedArray) else a,
+                    pa.nulls(cap - len(a), type=a.type)])
             cols.append(HostStringColumn(a))
+            continue
+        data, valid = outs[oi]
+        oi += 1
+        if isinstance(c, DictStringColumn):
+            cols.append(DictStringColumn(data, valid, c.dictionary))
         else:
-            data = jax.lax.dynamic_slice_in_dim(c.data, start, min(
-                length, c.capacity - start))
-            data = _pad_dev(data, cap)
-            valid = None
-            if c.valid is not None:
-                valid = _pad_dev(jax.lax.dynamic_slice_in_dim(
-                    c.valid, start, min(length, c.capacity - start)), cap)
             cols.append(DeviceColumn(f.dtype, data, valid))
     return ColumnBatch(batch.schema, cols, length)
+
+
+@functools.lru_cache(maxsize=512)
+def _slice_fn(cap: int, out_cap: int, spec: tuple):
+    """Jitted whole-batch slice: static output size, dynamic start.
+    Data pads by out_cap first so dynamic_slice never clamps the start
+    (a clamped start would bleed garbage into live rows)."""
+
+    @jax.jit
+    def f(cols, start):
+        outs = []
+        for (kind, _dt, _hv, extra), dv in zip(spec, cols):
+            if kind == "h":
+                continue
+            data, valid = dv
+            pad = [(0, out_cap)] + [(0, 0)] * (data.ndim - 1)
+            d = jax.lax.dynamic_slice_in_dim(
+                jnp.pad(data, pad), start, out_cap)
+            v = None
+            if valid is not None:
+                v = jax.lax.dynamic_slice_in_dim(
+                    jnp.pad(valid, (0, out_cap)), start, out_cap)
+            outs.append((d, v))
+        return tuple(outs)
+
+    return f

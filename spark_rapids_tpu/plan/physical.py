@@ -1036,6 +1036,8 @@ class AggregateExec(TpuExec):
                 and np.dtype(k.dtype.numpy_dtype).kind in "iu"]
 
         def build_stats():
+            from ..ops.hashing import xxhash64_columns
+
             @jax.jit
             def f(arrays, sel, num_rows):
                 cap = next(a[0].shape[0] for a in arrays
@@ -1046,15 +1048,44 @@ class AggregateExec(TpuExec):
                 ectx = EvalContext(arrays, cap, active=active)
                 outs = []
                 big = jnp.int64(np.iinfo(np.int64).max)
+                kvs = []
                 for i in cand:
                     d, v = keys[i].eval(ectx)
+                    kvs.append((i, d, v))
                     ok = active if v is None else (active & v)
                     d64 = d.astype(jnp.int64)
                     outs.append(jnp.stack([
                         jnp.min(jnp.where(ok, d64, big)),
                         jnp.max(jnp.where(ok, d64, -big)),
                         jnp.sum(ok.astype(jnp.int64))]))
-                return jnp.stack(outs)
+                # sampled functional-dependence probe: distinct(all keys)
+                # vs distinct(each primary candidate) over a prefix — if
+                # the full key is strictly finer than the candidate, the
+                # residuals are NOT dependent and the dense path would
+                # only violate + replay (q21's DISTINCT was the victim)
+                scap = min(cap, 1 << 18)
+                s_active = active[:scap]
+
+                def _nd(h):
+                    sh = jnp.sort(jnp.where(s_active, h.astype(jnp.int64),
+                                            big))
+                    first = jnp.concatenate(
+                        [jnp.ones((1,), bool), sh[1:] != sh[:-1]])
+                    return jnp.sum((first & (sh != big)).astype(jnp.int64))
+
+                # 64-bit hashes: at 2^18-row samples a 32-bit hash
+                # loses a coin-flip's worth of distincts to collisions,
+                # which would spuriously reject dependent keys
+                all_kv = [e.eval(ectx) for e in keys]
+                h_all = xxhash64_columns(
+                    [(d[:scap], None if v is None else v[:scap])
+                     for d, v in all_kv])
+                nd = [_nd(h_all)]
+                for i, d, v in kvs:
+                    h_c = xxhash64_columns(
+                        [(d[:scap], None if v is None else v[:scap])])
+                    nd.append(_nd(h_c))
+                return jnp.stack(outs), jnp.stack(nd)
             return f
 
         def arrays_of(b):
@@ -1067,8 +1098,10 @@ class AggregateExec(TpuExec):
             # above, so this is a host-carried nested/decimal): sort path
             return None
         sfn = _cached_program(fp + "|stats", build_stats)
-        stats = fetch(sfn(arrays_of(first), first.sel,
-                          np.int32(first.num_rows)))
+        stats, nd = fetch(sfn(arrays_of(first), first.sel,
+                              np.int32(first.num_rows)))
+        nd_all = int(nd[0])
+        nd_by_cand = {i: int(nd[1 + k]) for k, i in enumerate(cand)}
         cap_conf = ctx.conf["spark.rapids.tpu.join.denseDomainCap"]
         best = None  # (domain, cand_idx, kmin)
         for row, i in zip(np.asarray(stats), cand):
@@ -1077,6 +1110,10 @@ class AggregateExec(TpuExec):
                 continue
             domain = kmax - kmin + 1
             if domain <= 0 or domain > cap_conf:
+                continue
+            if nd_all > nd_by_cand[i]:
+                # sampled full-key cardinality strictly exceeds this
+                # candidate's: residuals not functionally dependent
                 continue
             if best is None or domain < best[0]:
                 best = (domain, i, kmin)

@@ -384,13 +384,44 @@ class TestDenseResidualAgg:
         np.testing.assert_allclose(got["bal"], want["bal"])
         np.testing.assert_allclose(got["s"], want["s"], rtol=1e-9)
 
-    def test_violated_residuals_fall_back(self, fresh_session, rng):
+    def test_independent_residuals_rejected_upfront(self, fresh_session,
+                                                     rng):
+        """Clearly-independent residuals never enter the dense path at
+        all: the sampled distinct-count probe rejects them in the same
+        stats fetch (q21's DISTINCT was the replay victim)."""
         import pyarrow as pa
+        from spark_rapids_tpu.plan.physical import (CollectExec,
+                                                    ExecContext)
+        from spark_rapids_tpu.sql import functions as F
         sess = fresh_session
         n, groups = 20_000, 500
         k = rng.integers(0, groups, n).astype(np.int64)
-        # NOT functionally dependent: every row gets its own residual
         r2 = rng.integers(0, 50, n).astype(np.int64)
+        t = pa.table({"k": k, "r2": r2, "v": rng.uniform(0, 10, n)})
+        df = (sess.create_dataframe(t).group_by("k", "r2")
+              .agg(F.sum(F.col("v")).alias("s")))
+        phys = sess._plan_physical(df._plan)
+        ctx = ExecContext(sess._tpu_conf(), device=sess.device)
+        tbl = CollectExec(phys).collect_arrow(ctx)
+        for ms in ctx.metrics.values():
+            assert ms.values.get("aggDensePath", 0) == 0
+            assert ms.values.get("aggDenseResidualFallback", 0) == 0
+        want = (t.to_pandas().groupby(["k", "r2"])
+                .agg(s=("v", "sum")).reset_index())
+        assert tbl.num_rows == len(want)
+
+    def test_violated_residuals_fall_back(self, fresh_session, rng):
+        import pyarrow as pa
+        sess = fresh_session
+        # dependent within the 2^18-row sample prefix, violated after:
+        # the upfront probe passes, the end-of-stream consistency check
+        # catches it, and the buffered input replays through the sort
+        # path with exact results
+        n, groups = 300_000, 500
+        k = rng.integers(0, groups, n).astype(np.int64)
+        r2 = (k * 3).astype(np.int64)
+        r2[(1 << 18) + 100:] = rng.integers(
+            10_000, 10_050, n - (1 << 18) - 100)
         t = pa.table({"k": k, "r2": r2, "v": rng.uniform(0, 10, n)})
         out = self._run(sess, t, ["k", "r2"],
                         "aggDenseResidualFallback")
