@@ -704,13 +704,15 @@ def _pd_web_ship_base(pds):
 
 def _multi_wh_orders(dfs, f):
     """Orders shipping from more than one warehouse (ws1/ws2 self-join
-    shape of the official q94/q95 EXISTS)."""
+    shape of the official q94/q95 EXISTS).  Cached: both consumers
+    (the EXISTS semi and the wr semi) reuse one materialization, the
+    WITH-clause semantics of the official query."""
     per = (dfs["web_sales"]
            .group_by(f.col("ws_order_number").alias("mw_order"))
            .agg(f.min(f.col("ws_warehouse_sk")).alias("wh_min"),
                 f.max(f.col("ws_warehouse_sk")).alias("wh_max")))
     return per.filter(f.col("wh_min") != f.col("wh_max")) \
-        .select("mw_order")
+        .select("mw_order").cache()
 
 
 def run_q94(dfs):
@@ -782,8 +784,10 @@ _Q64_COLORS = ["papaya", "firebrick", "azure", "salmon", "plum",
                "chartreuse"]
 
 
-def _q64_cross_sales(dfs, f, year):
-    # cs_ui: catalog items whose sales beat 2x their refunds
+def _q64_cs_ui(dfs, f):
+    """cs_ui CTE: catalog items whose sales beat 2x their refunds —
+    computed ONCE and cached; both year slices of cross_sales reuse it
+    (the official query's WITH clause)."""
     cs_r = (dfs["catalog_sales"]
             .join(dfs["catalog_returns"],
                   on=[("cs_item_sk", "cr_item_sk"),
@@ -793,8 +797,11 @@ def _q64_cross_sales(dfs, f, year):
                  f.sum(f.col("cr_refunded_cash")
                        + f.col("cr_reversed_charge")
                        + f.col("cr_store_credit")).alias("refund")))
-    cs_ui = cs_r.filter(f.col("sale") > f.col("refund") * 2.0) \
-        .select("ui_item_sk")
+    return cs_r.filter(f.col("sale") > f.col("refund") * 2.0) \
+        .select("ui_item_sk").cache()
+
+
+def _q64_cross_sales(dfs, f, year, cs_ui):
     item = dfs["item"].filter(
         f.col("i_color").isin(_Q64_COLORS)
         & f.col("i_current_price").between(35.0, 45.0))
@@ -823,8 +830,9 @@ def _q64_cross_sales(dfs, f, year):
 
 def run_q64(dfs):
     f = _F()
-    cs1 = _q64_cross_sales(dfs, f, 1999)
-    cs2 = _q64_cross_sales(dfs, f, 2000)
+    cs_ui = _q64_cs_ui(dfs, f)
+    cs1 = _q64_cross_sales(dfs, f, 1999, cs_ui)
+    cs2 = _q64_cross_sales(dfs, f, 2000, cs_ui)
     cs2 = cs2.select(
         f.col("ss_item_sk").alias("item2"),
         f.col("s_store_name").alias("store2"),
