@@ -764,7 +764,10 @@ class DcnBroadcastExchangeExec:
             lines.append(c.tree_string(indent + 1))
         return "\n".join(lines)
 
-    def materialize(self, ctx):
+    def materialize(self, ctx, compact: bool = True):
+        # ``compact`` is accepted for BroadcastExchangeExec interface
+        # parity (the dense-join caller passes it); the DCN all-gather
+        # serializes through arrow, which compacts regardless
         from ..batch import from_arrow, to_arrow
         from ..memory.spill import get_catalog
         from ..ops import batch_utils

@@ -58,16 +58,22 @@ def bound_join_keys(plan, lsch: Schema, rsch: Schema):
     return lk, rk, common
 
 
-def materialize_whole(child: TpuExec, ctx: ExecContext):
+def materialize_whole(child: TpuExec, ctx: ExecContext,
+                      compact: bool = True):
     """Materialize an operator's whole output as ONE spillable handle
     (compact each batch, concat, register) — shared by join-side
-    materialization and broadcast exchanges."""
+    materialization and broadcast exchanges.  ``compact=False`` keeps
+    selection masks (SYNC-FREE): the dense-join build programs fold the
+    mask in, so the live-count round trip is paid only if the dense
+    path rejects."""
     from ..memory.spill import get_catalog
     catalog = get_catalog(ctx.conf)
     handles = []
     for b in child.execute(ctx):
-        c = batch_utils.compact(b)
-        if c.num_rows > 0:
+        c = batch_utils.compact(b) if compact else b
+        if compact and c.num_rows == 0:
+            continue
+        if c.capacity > 0:
             handles.append(catalog.register(c, priority=1))
     if not handles:
         return catalog.register(_empty_batch(child.output_schema),
@@ -847,11 +853,14 @@ class BroadcastExchangeExec(TpuExec):
     def node_desc(self):
         return "TpuBroadcastExchange"
 
-    def materialize(self, ctx: ExecContext):
-        """One spillable handle holding the whole child output."""
+    def materialize(self, ctx: ExecContext, compact: bool = True):
+        """One spillable handle holding the whole child output.
+        ``compact=False`` (the dense-join path) defers the live-count
+        sync until/unless the dense build rejects."""
         m = ctx.metric_set(self.op_id)
         with m.time("buildTime"):
-            return materialize_whole(self.children[0], ctx)
+            return materialize_whole(self.children[0], ctx,
+                                     compact=compact)
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         h = self.materialize(ctx)
@@ -1101,10 +1110,14 @@ class BroadcastJoinExec(SortMergeJoinExec):
 
         def build_stats():
             @jax.jit
-            def f(b_arrays, n_build):
+            def f(b_arrays, sel, n_build):
                 b_cap = next(a[0].shape[0] for a in b_arrays
                              if a is not None)
-                d, ok = _eval_int_key(bk[0], b_arrays, b_cap, n_build, ct, ik)
+                active = jnp.arange(b_cap, dtype=jnp.int32) < n_build
+                if sel is not None:
+                    active = active & sel
+                d, ok = _eval_int_key(bk[0], b_arrays, b_cap, n_build,
+                                      ct, ik, active=active)
                 big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
                 d64 = d.astype(jnp.int64)
                 kmin = jnp.min(jnp.where(ok, d64, big))
@@ -1125,7 +1138,7 @@ class BroadcastJoinExec(SortMergeJoinExec):
         b_arrays = _dev_arrays(build)
         b_arrays = encode_key_arrays(b_arrays, build, bk, self.string_dicts)
         fn = _cached_program(f"bjoin-dense-stats|{vcap}|" + fp, build_stats)
-        stats = fn(b_arrays, np.int32(build.num_rows))
+        stats = fn(b_arrays, build.sel, np.int32(build.num_rows))
         try:
             stats.copy_to_host_async()
         except AttributeError:
@@ -1179,10 +1192,14 @@ class BroadcastJoinExec(SortMergeJoinExec):
 
         def build_table():
             @jax.jit
-            def g(b_arrays, kmin_s, n_build):
+            def g(b_arrays, sel, kmin_s, n_build):
                 b_cap = next(a[0].shape[0] for a in b_arrays
                              if a is not None)
-                d, ok = _eval_int_key(bk[0], b_arrays, b_cap, n_build, ct, ik)
+                active = jnp.arange(b_cap, dtype=jnp.int32) < n_build
+                if sel is not None:
+                    active = active & sel
+                d, ok = _eval_int_key(bk[0], b_arrays, b_cap, n_build,
+                                      ct, ik, active=active)
                 idx = jnp.where(ok, d.astype(jnp.int64) - kmin_s,
                                 jnp.int64(D))
                 return jnp.full((D,), -1, jnp.int32).at[idx].set(
@@ -1190,7 +1207,8 @@ class BroadcastJoinExec(SortMergeJoinExec):
             return g
 
         gfn = _cached_program(f"bjoin-dense-table|{fp}|{D}", build_table)
-        table = gfn(b_arrays, jnp.int64(kmin), np.int32(build.num_rows))
+        table = gfn(b_arrays, build.sel, jnp.int64(kmin),
+                    np.int32(build.num_rows))
         pay = []
         dicts = {}
         for i in payload_idxs:
@@ -1371,9 +1389,12 @@ class BroadcastJoinExec(SortMergeJoinExec):
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         m = ctx.metric_set(self.op_id)
         probe_side = 1 - self.build_side
-        bh = self.children[self.build_side].materialize(ctx)
-        pgen = self.children[probe_side].execute(ctx)
         dense_ok = self._dense_static_ok(ctx.conf)
+        # dense builds keep the selection mask (the build programs fold
+        # it in): the live-count round trip is paid only on fallback
+        bh = self.children[self.build_side].materialize(
+            ctx, compact=not dense_ok)
+        pgen = self.children[probe_side].execute(ctx)
         try:
             build = bh.get()
             if dense_ok:
@@ -1389,6 +1410,14 @@ class BroadcastJoinExec(SortMergeJoinExec):
                     if out is not None:
                         yield out
                         continue
+                    # dense rejected at runtime: the sorted kernels need
+                    # a compacted build — pay the sync once
+                    if build.sel is not None:
+                        build = batch_utils.compact(build)
+                        dense_ok = False
+                        if build.num_rows == 0 and self.how in (
+                                "inner", "semi"):
+                            return
                 # the join kernel treats every row below num_rows as live —
                 # a streamed batch may carry a selection mask from an
                 # upstream filter, so compact first (the shuffle path

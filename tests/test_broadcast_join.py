@@ -259,3 +259,52 @@ def test_empty_build_side(sess, rng):
     rows = left.collect()
     assert len(rows) == 800
     assert all(r[-1] is None for r in rows)  # d_cat all null
+
+
+class TestMaskedBuildFallback:
+    """r5: broadcast builds keep their selection mask for the dense
+    path; when the dense build REJECTS at runtime (duplicate keys) the
+    masked build compacts exactly once and the sorted kernel's results
+    stay exact — and an all-masked inner build short-circuits empty."""
+
+    def test_dup_key_masked_build_falls_back_exact(self, sess, rng):
+        import numpy as np
+        import pyarrow as pa
+        from spark_rapids_tpu.sql import functions as F
+        n_b, n_p = 5000, 20000
+        bt = pa.table({
+            # duplicate keys -> dense build state rejects (dup > 0)
+            "k2": pa.array(rng.integers(0, 500, n_b).astype(np.int64)),
+            "w": pa.array(rng.uniform(0, 1, n_b)),
+            "flag": pa.array(rng.integers(0, 2, n_b).astype(np.int64)),
+        })
+        pt = pa.table({
+            "k": pa.array(rng.integers(0, 500, n_p).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 1, n_p)),
+        })
+        # the filter above the broadcast leaves a selection mask
+        small = sess.create_dataframe(bt).filter(F.col("flag") == 1)
+        big = sess.create_dataframe(pt)
+        q = (big.join(F.broadcast(small), on=[("k", "k2")])
+             .agg(F.sum(F.col("v") * F.col("w")).alias("s")))
+        (got,), = q.collect()
+        bp, pp = bt.to_pandas(), pt.to_pandas()
+        m = pp.merge(bp[bp.flag == 1], left_on="k", right_on="k2")
+        assert abs(got - (m.v * m.w).sum()) < 1e-6
+
+    def test_all_masked_inner_build_short_circuits(self, sess, rng):
+        import numpy as np
+        import pyarrow as pa
+        from spark_rapids_tpu.sql import functions as F
+        bt = pa.table({
+            "k2": pa.array(rng.integers(0, 50, 500).astype(np.int64)),
+            "w": pa.array(rng.uniform(0, 1, 500)),
+        })
+        pt = pa.table({
+            "k": pa.array(rng.integers(0, 50, 2000).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 1, 2000)),
+        })
+        small = sess.create_dataframe(bt).filter(F.col("w") < -1.0)
+        big = sess.create_dataframe(pt)
+        q = big.join(F.broadcast(small), on=[("k", "k2")])
+        assert q.collect() == []
