@@ -374,6 +374,71 @@ class DataFrame:
         return self.session._explain(self._plan)
 
 
+def _split_count_distinct(agg_exprs):
+    """Partition (name, expr) aggregates into (count-distinct items,
+    plain items), or None when no count_distinct is present."""
+    from .functions import _CountDistinctMarker
+    from ..plan.planner import strip_alias
+    cds, plain = [], []
+    for n, e in agg_exprs:
+        core = strip_alias(e)
+        if isinstance(core, _CountDistinctMarker):
+            cds.append((n, list(core.children)))
+        else:
+            plain.append((n, e))
+    if not cds:
+        return None
+    return cds, plain
+
+
+def _plan_count_distinct(df, group_exprs, cds, plain, order):
+    """count(DISTINCT ...) lowering: one dedup aggregation + count per
+    distinct set, joined back to the plain aggregates on the group keys
+    (Spark's RewriteDistinctAggregates, single-join form).  Groupless
+    aggregates join via a constant key."""
+    from . import functions as F
+
+    sess = df.session
+    keys = [n for n, _ in group_exprs]
+    groupless = not keys
+    if groupless:
+        # constant grouping key, dropped at the end
+        df = df.with_column("__cd_k", F.lit(1))
+        group_exprs = group_exprs + [
+            ("__cd_k", E.UnresolvedColumn("__cd_k"))]
+        keys = ["__cd_k"]
+
+    parts = []
+    if plain:
+        node = _decompose_agg_exprs(df._plan, group_exprs, plain)
+        parts.append(DataFrame(node, sess))
+    for idx, (name, cols) in enumerate(cds):
+        # marker children are already expressions
+        dcols = [(f"__cd{idx}_{i}", c) for i, c in enumerate(cols)]
+        dedup_groups = group_exprs + [(n_, e_) for n_, e_ in dcols]
+        dedup = DataFrame(
+            _decompose_agg_exprs(df._plan, dedup_groups, []), sess)
+        # count rows whose EVERY distinct column is non-null (Spark
+        # count(distinct) semantics)
+        cond = None
+        for n_, _ in dcols:
+            c_ = F.col(n_).is_not_null()
+            cond = c_ if cond is None else (cond & c_)
+        cnt = (dedup.group_by(*keys)
+               .agg(F.sum(F.when(cond, F.lit(1)).otherwise(
+                   F.lit(0))).alias(name)))
+        parts.append(cnt)
+    out = parts[0]
+    for p_ in parts[1:]:
+        renamed = p_
+        for k in keys:
+            renamed = renamed.with_column_renamed(k, f"__r_{k}")
+        out = out.join(renamed, on=[(k, f"__r_{k}") for k in keys])
+    # restore output column order: keys then aggregates AS WRITTEN
+    names = ([] if groupless else list(keys)) + list(order)
+    return out.select(*names)
+
+
 class PivotedData:
     """group_by(...).pivot(col, values): rewrites aggregates as
     conditional aggregations, one output column per (value, agg)."""
@@ -452,6 +517,11 @@ class GroupedData:
 
     def agg(self, *cols: Column) -> DataFrame:
         agg_exprs = [_named(c) for c in cols]
+        cd = _split_count_distinct(agg_exprs)
+        if cd is not None:
+            return _plan_count_distinct(self._df, self._group_exprs,
+                                        *cd,
+                                        order=[n for n, _ in agg_exprs])
         node = _decompose_agg_exprs(self._df._plan, self._group_exprs, agg_exprs)
         return DataFrame(node, self._df.session)
 

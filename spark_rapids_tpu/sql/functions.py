@@ -346,6 +346,30 @@ def count(c) -> Column:
     return Column(A.Count(to_expr(c)))
 
 
+def count_distinct(c, *more) -> Column:
+    """count(DISTINCT cols): rewritten by the DataFrame layer into a
+    dedup aggregation + count (Spark's two-phase distinct-aggregate
+    lowering; joins back to the plain aggregates when mixed)."""
+    cols = [to_expr(x) for x in (c,) + tuple(more)]
+    return Column(_CountDistinctMarker(cols))
+
+
+countDistinct = None  # assigned below (pyspark-compatible alias)
+
+
+class _CountDistinctMarker(E.Expression):
+    """Pseudo-aggregate consumed by DataFrame.agg/GroupedData.agg."""
+
+    def __init__(self, cols):
+        self.children = tuple(cols)
+        from .. import types as T
+        self.dtype = T.INT64
+        self.nullable = False
+
+    def _fp_extra(self):
+        return "count_distinct"
+
+
 def count_star() -> Column:
     return Column(A.CountStar())
 
@@ -920,3 +944,6 @@ def xxhash64(*cols) -> Column:
     """Spark-exact xxhash64 row hash, seed 42 (GpuXxHash64)."""
     from .. import bitwisefns as B
     return Column(B.XxHash64(*[_colref(c) for c in cols]))
+
+
+countDistinct = count_distinct  # pyspark alias
