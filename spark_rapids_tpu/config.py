@@ -243,6 +243,13 @@ ICI_OVERFLOW_RETRIES = register(
     "(split-retry analog for static SPMD shapes). 0 = raise immediately.",
     conv=int)
 
+PATH_REPLACEMENT = register(
+    "spark.rapids.tpu.io.pathReplacementRules", "",
+    "Comma list of 'prefix=>replacement' pairs applied to reader paths "
+    "(first match wins): redirect remote object-store URIs to a local "
+    "cache mount the way the reference rewrites s3:// to alluxio:// "
+    "(AlluxioUtils.scala pathsToReplace analog). Empty disables.")
+
 AQE_ENABLED = register(
     "spark.rapids.tpu.sql.aqe.enabled", True,
     "Adaptive re-planning at exchange boundaries: a shuffled join whose "

@@ -81,8 +81,28 @@ class Session:
         return src
 
     # -- data sources -------------------------------------------------------------
+    def _replace_path(self, path):
+        """Remote-storage path redirection (AlluxioUtils.scala:37-74
+        analog): `spark.rapids.tpu.io.pathReplacementRules` is a comma
+        list of `prefix=>replacement` pairs applied to every reader
+        path — the reference rewrites s3://bucket/... to an
+        alluxio://mount/... cache mount the same way."""
+        rules = self._tpu_conf()[
+            "spark.rapids.tpu.io.pathReplacementRules"]
+        if not rules or not isinstance(path, str):
+            return path
+        for rule in rules.split(","):
+            rule = rule.strip()
+            if "=>" not in rule:
+                continue
+            pre, repl = rule.split("=>", 1)
+            if path.startswith(pre):
+                return repl + path[len(pre):]
+        return path
+
     def read_parquet(self, path, columns=None) -> DataFrame:
         from ..io.parquet import ParquetSource
+        path = self._replace_path(path)
         conf = self._tpu_conf()
         cache_bytes = (
             conf["spark.rapids.tpu.sql.fileCache.maxBytes"]
@@ -100,6 +120,7 @@ class Session:
         return DataFrame(node, self)
 
     def _file_source_df(self, cls, path, columns=None, **options) -> DataFrame:
+        path = self._replace_path(path)
         conf = self._tpu_conf()
         src = cls(path, columns=columns,
                   batch_rows=conf["spark.rapids.tpu.sql.batchSizeRows"],

@@ -80,3 +80,30 @@ def test_multi_file_csv(session, tmp_path):
             fh.write(f"{i},{i * 1.5}\n")
     got = sorted(session.read_csv(p).collect())
     assert got == [(0, 0.0), (1, 1.5), (2, 3.0)]
+
+
+class TestPathReplacement:
+    """Remote-storage redirection (AlluxioUtils.scala analog): reader
+    paths matching a configured prefix rewrite to the replacement
+    mount before any filesystem access."""
+
+    def test_prefix_rewrites_to_local_mount(self, fresh_session,
+                                            tmp_path, rng):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        sess = fresh_session
+        mount = tmp_path / "mount" / "bucket"
+        mount.mkdir(parents=True)
+        t = pa.table({"a": np.arange(10, dtype=np.int64)})
+        pq.write_table(t, str(mount / "f.parquet"))
+        sess.conf.set(
+            "spark.rapids.tpu.io.pathReplacementRules",
+            f"s3://bucket=>{tmp_path}/mount/bucket,"
+            f"gs://other=>/nonexistent")
+        try:
+            got = sess.read_parquet("s3://bucket/f.parquet").collect()
+        finally:
+            sess.conf.set(
+                "spark.rapids.tpu.io.pathReplacementRules", "")
+        assert [r[0] for r in got] == list(range(10))
