@@ -123,6 +123,7 @@ class SortMergeJoinExec(TpuExec):
                  string_dicts: Optional[dict] = None):
         super().__init__([left, right])
         self.plan = plan
+        self._conf = conf
         self.how = _canon_how(plan.how)
         self.condition = plan.condition
         # single source of truth for join output shape: L.Join.schema()
@@ -926,6 +927,11 @@ class BroadcastJoinExec(SortMergeJoinExec):
         else:  # bool / object-carried keys: keep the generic kernel
             return super()._match_state(probe, build, probe_side)
 
+        csr = self._csr_match_state(probe, build, probe_side, pk, bk,
+                                    ct)
+        if csr is not None:
+            return csr
+
         def orderable(d):
             # `sentinel` (the int max) is reachable by no key image: it
             # would require a -0.0 bit pattern, which _float_orderable
@@ -996,6 +1002,91 @@ class BroadcastJoinExec(SortMergeJoinExec):
         p_arrays = _dev_arrays(probe)
         p_arrays = encode_key_arrays(p_arrays, probe, pk, self.string_dicts)
         lo, matches = gfn(p_arrays, sorted_keys, n_valid,
+                          np.int32(probe.num_rows))
+        return lo, matches, b_perm
+
+    def _csr_match_state(self, probe, build, probe_side, pk, bk, ct):
+        """Dense CSR matching for DUPLICATE-keyed builds: counts/starts
+        direct-address tables + one stable build sort, so every probe
+        batch is TWO gathers — no per-batch sort, no searchsorted (the
+        gather wall).  Produces the same (lo, matches, b_perm) contract
+        as the sorted path; requires the dense-stats prefetch (bounded
+        int domain) to have run.  cuDF-hash-table analog for the
+        multi-row-per-key case (GpuHashJoin.scala gather maps)."""
+        tagged = getattr(self, "_dense_stats_host", None)
+        conf = getattr(self, "_conf", None)
+        if tagged is None or conf is None:
+            return None
+        st_id, st_side, stats = tagged
+        # the stats MUST describe this build batch on this side — never
+        # trust distant gating for table sizing (silent-corruption trap)
+        if st_id != id(build) or st_side != (1 - probe_side):
+            return None
+        ik = _int_key_caster(ct)
+        if ik is None:
+            return None
+        kmin, kmax, n_valid, _dup = [int(x) for x in stats[:4]]
+        if n_valid == 0:
+            return None
+        domain = kmax - kmin + 1
+        if domain <= 0 \
+                or domain > conf["spark.rapids.tpu.join.denseDomainCap"]:
+            return None
+        D = bucket_capacity(domain)
+        fp = self._fingerprint() + f"|csr{probe_side}|{D}"
+
+        def build_csr():
+            @jax.jit
+            def f(b_arrays, sel, kmin_s, n_build):
+                b_cap = next(a[0].shape[0] for a in b_arrays
+                             if a is not None)
+                idx_raw, ok, _ = _dense_key_slot(
+                    bk[0], b_arrays, b_cap, n_build, ct, ik, kmin_s, D,
+                    sel)
+                idx = jnp.where(ok, idx_raw, jnp.int64(D))
+                counts = jnp.zeros((D,), jnp.int32).at[idx].add(
+                    1, mode="drop")
+                starts = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int32),
+                     jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+                # stable grouping of build rows by key slot (one-time)
+                perm = jnp.lexsort(
+                    (jnp.arange(b_cap, dtype=jnp.int32), idx))
+                return counts, starts, perm.astype(jnp.int32)
+            return f
+
+        cache = getattr(self, "_csr_cache", None)
+        if cache is None or cache[0] != (probe_side, id(build)):
+            fn = _cached_program("bjoin-csr|" + fp, build_csr)
+            b_arrays = _dev_arrays(build)
+            b_arrays = encode_key_arrays(b_arrays, build, bk,
+                                         self.string_dicts)
+            counts, starts, b_perm = fn(b_arrays, build.sel,
+                                        jnp.int64(kmin),
+                                        np.int32(build.num_rows))
+            cache = ((probe_side, id(build)), build, counts, starts,
+                     b_perm)
+            self._csr_cache = cache
+        _, _, counts, starts, b_perm = cache
+
+        def build_probe():
+            @jax.jit
+            def g(p_arrays, counts, starts, kmin_s, n_probe):
+                p_cap = next(a[0].shape[0] for a in p_arrays
+                             if a is not None)
+                idx, _ok, in_dom = _dense_key_slot(
+                    pk[0], p_arrays, p_cap, n_probe, ct, ik, kmin_s, D)
+                safe = jnp.clip(idx, 0, D - 1).astype(jnp.int32)
+                matches = jnp.where(in_dom, counts[safe], 0)
+                lo = jnp.where(in_dom, starts[safe], 0)
+                return lo, matches
+            return g
+
+        gfn = _cached_program("bjoin-csrprobe|" + fp, build_probe)
+        p_arrays = _dev_arrays(probe)
+        p_arrays = encode_key_arrays(p_arrays, probe, pk,
+                                     self.string_dicts)
+        lo, matches = gfn(p_arrays, counts, starts, jnp.int64(kmin),
                           np.int32(probe.num_rows))
         return lo, matches, b_perm
 
@@ -1090,8 +1181,6 @@ class BroadcastJoinExec(SortMergeJoinExec):
             self._dense_pending = None  # stale build: recompute
         if not conf["spark.rapids.tpu.join.denseDomainCap"]:
             return
-        if self._dense_payload_fields(build) is None:
-            return
         lk, rk, common = self._bound_keys()
         bk = rk if self.build_side == 1 else lk
         ct = common[0]
@@ -1166,10 +1255,15 @@ class BroadcastJoinExec(SortMergeJoinExec):
         state = None
         if pending is not None and pending[0] == id(build):
             cap = conf["spark.rapids.tpu.join.denseDomainCap"]
+            # stats survive for the CSR match path, tagged with the
+            # batch identity + side (valid for the compacted build too:
+            # same live rows — execute() re-tags after compaction)
+            self._dense_stats_host = (id(build), self.build_side,
+                                      self._pending_host(pending))
             payload = self._dense_payload_fields(build)
             if payload is not None:
                 state = self._dense_build_state_impl(
-                    build, cap, payload, self._pending_host(pending),
+                    build, cap, payload, self._dense_stats_host[2],
                     pending[3])
         self._dense_pending = None
         self._dense_cache = (id(build), build, state)
@@ -1411,9 +1505,15 @@ class BroadcastJoinExec(SortMergeJoinExec):
                         yield out
                         continue
                     # dense rejected at runtime: the sorted kernels need
-                    # a compacted build — pay the sync once
+                    # a compacted build — pay the sync once, and re-tag
+                    # the surviving stats to the compacted twin
                     if build.sel is not None:
+                        old_build = build
                         build = batch_utils.compact(build)
+                        st = getattr(self, "_dense_stats_host", None)
+                        if st is not None and st[0] == id(old_build):
+                            self._dense_stats_host = (id(build), st[1],
+                                                      st[2])
                         dense_ok = False
                         if build.num_rows == 0 and self.how in (
                                 "inner", "semi"):
@@ -1443,6 +1543,8 @@ class BroadcastJoinExec(SortMergeJoinExec):
             self._dense_cache = None
             self._dense_pending = None
             self._bfast_cache = None
+            self._csr_cache = None
+            self._dense_stats_host = None
 
 
 def _expand_rows(offsets, counts, out_cap: int):
@@ -1598,6 +1700,24 @@ def _eval_int_key(expr, arrays, cap, n_rows, ct, ik, active=None):
     if np_dt is not None and np_dt.kind == "f":
         d = _float_orderable(d, ik)
     return d, ok
+
+
+def _dense_key_slot(expr, arrays, cap, n_rows, ct, ik, kmin_s, D,
+                    sel=None):
+    """THE shared mask-and-index idiom of every dense kernel: fold the
+    selection mask into the active set, evaluate the int key image, and
+    produce (slot index, valid mask, in-domain mask).  Build kernels
+    scatter with `where(ok, idx, D)` + mode=drop; probe kernels gather
+    with `clip(idx)` guarded by in_dom.  One definition so a fix to key
+    imaging or null folding can never diverge across paths."""
+    active = jnp.arange(cap, dtype=jnp.int32) < n_rows
+    if sel is not None:
+        active = active & sel
+    d, ok = _eval_int_key(expr, arrays, cap, n_rows, ct, ik,
+                          active=active)
+    idx = d.astype(jnp.int64) - kmin_s
+    in_dom = ok & (idx >= 0) & (idx < D)
+    return idx, ok, in_dom
 
 
 def _has_broadcast_hint(node) -> bool:
