@@ -12,7 +12,7 @@ multiplier".  Every query is verified against its pandas oracle
 (rel_err < 1e-6) before its timing counts.
 
 Environment knobs: SRT_BENCH_SF (default 1.0), SRT_BENCH_ITERS (timed
-iterations, default 3), SRT_BENCH_QUERIES (comma list; default = all 27),
+iterations, default 3), SRT_BENCH_QUERIES (comma list; default = all 44),
 SRT_BENCH_QUERY_TIMEOUT (per-query subprocess budget, default 480 s).
 """
 
