@@ -13,7 +13,15 @@ multiplier".  Every query is verified against its pandas oracle
 
 Environment knobs: SRT_BENCH_SF (default 1.0), SRT_BENCH_ITERS (timed
 iterations, default 3), SRT_BENCH_QUERIES (comma list; default = all 44),
-SRT_BENCH_QUERY_TIMEOUT (per-query subprocess budget, default 480 s).
+SRT_BENCH_QUERY_TIMEOUT (per-query subprocess budget, default 300 s),
+SRT_BENCH_WALL_BUDGET (whole-run wall-clock budget, default 820 s —
+queries that don't fit are reported as skipped, never killed mid-print),
+SRT_BENCH_PIPELINE_DEPTH (sets spark.rapids.tpu.sql.pipeline.depth for
+the engine run; 0 = serial baseline for overlap A/B).
+
+The aggregate JSON line is re-printed after EVERY query (flush=True), so
+a driver that kills the run on a timeout still finds the latest complete
+snapshot on the last stdout line.
 """
 
 from __future__ import annotations
@@ -59,9 +67,13 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
     tables = mod.TABLES[name]
     paths = mod.gen_db(sf, DATA_DIR)
 
-    sess = srt.Session.get_or_create(settings={
+    settings = {
         "spark.rapids.tpu.sql.fileCache.enabled": True,
-    })
+    }
+    depth_env = os.environ.get("SRT_BENCH_PIPELINE_DEPTH")
+    if depth_env is not None:
+        settings["spark.rapids.tpu.sql.pipeline.depth"] = int(depth_env)
+    sess = srt.Session.get_or_create(settings=settings)
     dfs = {t: sess.read_parquet(paths[t]) for t in tables}
     # pandas baseline runs fully in-memory; the engine's decoded-file
     # cache gives it the same footing (parquet decode out of the loop)
@@ -79,7 +91,7 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
     warm_stats = QueryStats.delta_since(warm0)
     # per warm iteration: the sync profile of ONE steady-state run
     for k in warm_stats:
-        warm_stats[k] = round(warm_stats[k] / iters, 2)
+        warm_stats[k] = round(warm_stats[k] / iters, 4)
     # cpu baseline: warm the OS/page cache with one untimed run, then
     # best-of-N — the same statistic as engine_s, so the ratio compares
     # like with like (PERF.md r4: cache-state swings of 2-3x made
@@ -98,7 +110,17 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
         "rows": len(engine_rows),
         # sync/compile profile (VERDICT r4 item 2): warm = per-iteration
         "syncs_warm": warm_stats["blocking_fetches"],
+        "asyncs_warm": warm_stats["async_fetches"],
         "fetch_mb_warm": round(warm_stats["fetch_bytes"] / 1e6, 3),
+        # pipeline profile (round 6): time the pull loop blocked on a
+        # staged batch vs the staging work overlapped behind dispatch,
+        # plus the attributable D2H stall — overlap_s > 0 means the chip
+        # computed while the host decoded/uploaded
+        "h2d_wait_s": warm_stats["h2d_wait_s"],
+        "overlap_s": round(max(0.0, warm_stats["pipeline_stage_s"]
+                               - warm_stats["h2d_wait_s"]), 4),
+        "fetch_wait_s": warm_stats["fetch_wait_s"],
+        "donated_warm": warm_stats["donated_batches"],
         "compiles_cold": cold_stats["compiles"],
         "compile_s_cold": cold_stats["compile_s"],
         "compiles_warm": warm_stats["compiles"],
@@ -124,18 +146,45 @@ def main() -> None:
                       "backend": _backend()}))
 
 
+def _assemble(sf: float, results: dict, detail: dict) -> dict:
+    speedups = list(results.values())
+    geomean = (math.exp(sum(math.log(s) for s in speedups) / len(speedups))
+               if speedups else 0.0)
+    return {
+        "metric": "tpch22_tpcds22_geomean_speedup_vs_cpu",
+        "value": round(geomean, 4),
+        "unit": "x",
+        "vs_baseline": round(geomean / REFERENCE_TYPICAL_SPEEDUP, 4),
+        "sf": sf,
+        "queries_completed": sorted(results),
+        "n_queries": len(results),
+        "backend": _backend(),
+        **detail,
+    }
+
+
 def _run_isolated(sf: float, iters: int, which) -> None:
     import subprocess
-    budget = int(os.environ.get("SRT_BENCH_QUERY_TIMEOUT", "480"))
+    budget = int(os.environ.get("SRT_BENCH_QUERY_TIMEOUT", "300"))
+    # whole-run wall budget (BENCH_r05 was rc=124 with an empty tail: the
+    # DRIVER's timeout killed us before a single line printed): stop
+    # launching new queries in time to always emit the aggregate line
+    wall = float(os.environ.get("SRT_BENCH_WALL_BUDGET", "820"))
+    t_start = time.monotonic()
     results = {}
     detail = {}
     for q in which:
+        remaining = wall - (time.monotonic() - t_start)
+        if remaining < 15:
+            detail[q] = {"error": "skipped: wall budget exhausted"}
+            continue
+        q_budget = max(15, min(budget, int(remaining)))
         env = dict(os.environ)
         env["SRT_BENCH_QUERIES"] = q
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True, timeout=budget)
+                capture_output=True, text=True, timeout=q_budget)
             out_lines = proc.stdout.strip().splitlines() \
                 if proc.stdout else []
             line = out_lines[-1] if out_lines else ""
@@ -148,22 +197,11 @@ def _run_isolated(sf: float, iters: int, which) -> None:
                              proc.stderr.strip().splitlines()[-1][:200]
                              if proc.stderr.strip() else "no output"}
         except subprocess.TimeoutExpired:
-            detail[q] = {"error": f"timeout after {budget}s"}
-    speedups = list(results.values())
-    geomean = (math.exp(sum(math.log(s) for s in speedups) / len(speedups))
-               if speedups else 0.0)
-    out = {
-        "metric": "tpch22_tpcds22_geomean_speedup_vs_cpu",
-        "value": round(geomean, 4),
-        "unit": "x",
-        "vs_baseline": round(geomean / REFERENCE_TYPICAL_SPEEDUP, 4),
-        "sf": sf,
-        "queries_completed": sorted(results),
-        "n_queries": len(results),
-        "backend": _backend(),
-        **detail,
-    }
-    print(json.dumps(out))
+            detail[q] = {"error": f"timeout after {q_budget}s"}
+        # flush the aggregate after EVERY query: a killed run still
+        # leaves the latest complete snapshot as the last stdout line
+        print(json.dumps(_assemble(sf, results, detail)), flush=True)
+    print(json.dumps(_assemble(sf, results, detail)), flush=True)
 
 
 def _backend() -> str:

@@ -36,7 +36,8 @@ from .types import DataType
 
 __all__ = [
     "Schema", "Field", "DeviceColumn", "HostStringColumn", "ColumnBatch",
-    "bucket_capacity", "from_arrow", "to_arrow", "from_numpy",
+    "bucket_capacity", "from_arrow", "to_arrow", "to_arrow_async",
+    "from_numpy",
 ]
 
 
@@ -261,9 +262,16 @@ class ColumnBatch:
     ``bound`` (optional) is a STATIC upper limit on live rows, set by
     bounded producers (dense-grid aggregation): it lets downstream
     compaction stay sync-free (ops/batch_utils.compact_packed).
+
+    ``donatable`` marks a batch whose device buffers have exactly ONE
+    consumer: a fused stage program may donate them to XLA (HBM reuse).
+    Producers of fresh single-consumer uploads set it True; anything
+    that creates a second reference (spill registration, the device-tier
+    file cache) clears it — see SpillableBatch.__init__ and ScanExec.
     """
 
     bound = None
+    donatable = False
 
     def __init__(self, schema: Schema, columns: Sequence[Column], num_rows: int,
                  sel: Optional[jax.Array] = None):
@@ -508,14 +516,9 @@ def wide_limbs_to_ints(data: np.ndarray) -> np.ndarray:
     return (hi << 64) + lo
 
 
-def to_arrow(batch: ColumnBatch):
-    """Download a batch to a pyarrow Table (compacts through the selection).
-
-    All device arrays are fetched in ONE ``jax.device_get`` call: on
-    remote-tunneled backends each transfer is a full RPC round-trip
-    (measured ~40ms), so per-column ``np.asarray`` would dominate collect.
-    """
-    import pyarrow as pa
+def _to_arrow_tree(batch: ColumnBatch) -> dict:
+    """The device arrays one batched D2H transfer must move to realize
+    this batch as an arrow table — shared by the sync and async paths."""
     # keys are column ordinals, not names — names may collide with the
     # reserved mask/validity keys ("#buf0"-style generated names exist)
     fetch = {}
@@ -532,8 +535,43 @@ def to_arrow(batch: ColumnBatch):
             fetch[("d", i)] = col.data
             if col.valid is not None:
                 fetch[("v", i)] = col.valid
+    return fetch
+
+
+def to_arrow(batch: ColumnBatch):
+    """Download a batch to a pyarrow Table (compacts through the selection).
+
+    All device arrays are fetched in ONE ``jax.device_get`` call: on
+    remote-tunneled backends each transfer is a full RPC round-trip
+    (measured ~40ms), so per-column ``np.asarray`` would dominate collect.
+    """
+    fetch = _to_arrow_tree(batch)
     from .utils.metrics import fetch as _counted_fetch
     host = _counted_fetch(fetch) if fetch else {}
+    return _to_arrow_finish(batch, host)
+
+
+def to_arrow_async(batch: ColumnBatch):
+    """Start the batch's D2H transfer NOW; return a zero-arg finisher.
+
+    The copy runs behind the dispatch front (utils.metrics.fetch_async),
+    so the next batch's XLA programs dispatch while this one's bytes move
+    — the finisher blocks only on whatever is still in flight.  The
+    finisher pins the batch's device buffers until called; CollectExec
+    bounds how many are outstanding by the pipeline depth.
+    """
+    fetch = _to_arrow_tree(batch)
+    from .utils.metrics import fetch_async as _afetch
+    fut = _afetch(fetch) if fetch else None
+
+    def finish():
+        return _to_arrow_finish(batch, fut.result() if fut is not None
+                                else {})
+    return finish
+
+
+def _to_arrow_finish(batch: ColumnBatch, host: dict):
+    import pyarrow as pa
     for i, col in enumerate(batch.columns):
         if isinstance(col, DictStringColumn) and ("dc", i) in host:
             col._decoded = decode_dict_codes(

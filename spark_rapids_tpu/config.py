@@ -132,6 +132,30 @@ CONCURRENT_TASKS = register(
     "has no CUDA-stream analog, so this primarily overlaps host I/O of one "
     "task with device compute of another.")
 
+PIPELINE_DEPTH = register(
+    "spark.rapids.tpu.sql.pipeline.depth", 2,
+    "Bounded depth of the async execution pipeline: scans and fused "
+    "stages keep up to this many input batches staged ahead of the "
+    "consumer (batch N+1's Arrow decode + host→device upload overlaps "
+    "batch N's XLA dispatch), and collect resolves up to this many "
+    "device→host fetches behind the dispatch front. 0 restores the "
+    "fully serial pull loop (exact round-4 semantics; the debugging "
+    "escape hatch). On the CPU backend the DEFAULT resolves to 0 "
+    "(staging and compute share the same cores there, so overlap is "
+    "contention, not latency hiding); setting the key explicitly "
+    "always wins.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+PIPELINE_DONATION = register(
+    "spark.rapids.tpu.sql.pipeline.donation", True,
+    "Donate the input device buffers of fused stage programs to XLA "
+    "(jax.jit donate_argnums) so the output reuses the input's HBM — "
+    "steady-state churn drops and the spill budget sees real headroom. "
+    "Only single-consumer batches are donated (never cached or "
+    "spill-registered ones), and a donated batch cannot be replayed by "
+    "the OOM retry path: disable this when debugging OOM-heavy "
+    "workloads. No-op on the CPU backend (XLA ignores donation there).")
+
 HBM_POOL_FRACTION = register(
     "spark.rapids.tpu.memory.tpu.poolFraction", 0.9,
     "Fraction of free TPU HBM the arena manages for batch storage; "
@@ -418,6 +442,13 @@ class TpuConf:
 
     def get(self, entry: ConfEntry) -> Any:
         return self._values.get(entry.key, entry.default)
+
+    def is_set(self, key: str) -> bool:
+        """True when the key was explicitly set (session override or
+        per-query settings) rather than resolving to its default —
+        lets backend-aware defaults yield to an operator's explicit
+        choice (runtime/pipeline.effective_depth)."""
+        return key in self._values
 
     def __getitem__(self, key: str) -> Any:
         entry = ALL_ENTRIES[key]

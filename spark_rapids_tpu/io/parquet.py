@@ -537,9 +537,12 @@ class ParquetSource:
         for p in self.paths:
             yield from self._read_file(p)
 
-    def __call__(self) -> Iterator:
+    def __call__(self, prefetch_depth: int = 4) -> Iterator:
         """Yield pyarrow Tables, decoding ahead on a prefetch thread.
 
+        ``prefetch_depth`` bounds the decoded-but-unconsumed tables; the
+        scan exec sizes it from ``sql.pipeline.depth`` so the decode pool
+        keeps the upload stage fed without pinning unbounded host memory.
         The consumer may abandon the iterator mid-stream (LIMIT, errors);
         a stop event keeps the producer from blocking forever on a full
         queue and leaking the thread + decoded batches.
@@ -547,7 +550,7 @@ class ParquetSource:
         if self.num_threads <= 0:
             yield from self._read_all()
             return
-        q: "queue.Queue" = queue.Queue(maxsize=4)
+        q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch_depth))
         stop = threading.Event()
         _END = object()
 

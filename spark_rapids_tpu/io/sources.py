@@ -99,12 +99,13 @@ class FileSource:
         for p in self.paths:
             yield from self._read_file(p)
 
-    def __call__(self) -> Iterator:
+    def __call__(self, prefetch_depth: int = 4) -> Iterator:
         if self.num_threads <= 0 or len(self.paths) <= 1:
             yield from self._read_all()
             return
-        # prefetch next file's decode while the device consumes the current
-        q: "queue.Queue" = queue.Queue(maxsize=4)
+        # prefetch next file's decode while the device consumes the
+        # current; depth sized by the scan from sql.pipeline.depth
+        q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch_depth))
         stop = threading.Event()
         _END = object()
 

@@ -48,6 +48,12 @@ class OOMInjector:
             self.remaining = n_retry
             self.split_remaining = n_split
 
+    def armed(self) -> bool:
+        """True while injected OOMs are pending: buffer donation must not
+        engage (a donated batch cannot be replayed by the retry loop)."""
+        with self._lock:
+            return self.remaining > 0 or self.split_remaining > 0
+
     def maybe_raise(self) -> None:
         with self._lock:
             if self.remaining > 0:

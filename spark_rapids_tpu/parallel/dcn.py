@@ -696,9 +696,8 @@ def _make_key_decoder(partial):
     back to utf8 at the wire boundary (a partial-mode exec skips its own
     output-side decode, since in-process its partner shares the dict)."""
     def decode(batch):
-        import jax
-
         from ..batch import ColumnBatch, DeviceColumn, HostStringColumn
+        from ..utils.metrics import fetch
         dicts = getattr(partial, "string_dicts", None)
         if not dicts:
             return batch
@@ -707,9 +706,12 @@ def _make_key_decoder(partial):
         for gi, d in dicts.items():
             col = cols[gi]
             if isinstance(col, DeviceColumn):
-                codes = jax.device_get(col.data)
-                valid = jax.device_get(col.valid) \
-                    if col.valid is not None else None
+                # ONE counted transfer through the metrics choke point
+                # (raw device_get here would dodge the sync profile)
+                if col.valid is not None:
+                    codes, valid = fetch((col.data, col.valid))
+                else:
+                    codes, valid = fetch(col.data), None
                 cols[gi] = HostStringColumn(d.decode(codes, valid),
                                             capacity=batch.capacity)
                 changed = True

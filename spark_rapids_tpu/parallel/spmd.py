@@ -673,8 +673,9 @@ def _execute_fragment(lowered, leaves: List[_Leaf], ctx, mesh, axis: str):
     n_out_cols = len(lowered.schema)
     in_specs = tuple(feed_specs)
     out_specs = tuple(P(axis) for _ in range(2 * n_out_cols + 1)) + (P(axis),)
-    fn = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=in_specs,
-                               out_specs=out_specs))
+    from . import shard_map_fn
+    fn = jax.jit(shard_map_fn()(step, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs))
     outs = fn(*feeds)
     ov = np.asarray(outs[-1])
     if ov.sum() > 0:

@@ -87,10 +87,14 @@ class SortExec(TpuExec):
         batch_rows = ctx.conf["spark.rapids.tpu.sql.batchSizeRows"]
         catalog = get_catalog(ctx.conf)
 
+        from ..runtime.pipeline import effective_depth, pipeline_batches
         runs = []  # spillable sorted runs
         total = 0
         try:
-            for batch in self.children[0].execute(ctx):
+            # upstream decode/upload stages ahead while this run-sort's
+            # XLA programs are in flight (depth 0 = serial)
+            for batch in pipeline_batches(self.children[0].execute(ctx),
+                                          effective_depth(ctx)):
                 with m.time("opTime"):
                     for srt_b in with_retry(
                             ctx, batch,
@@ -245,6 +249,7 @@ class TopKExec(SortExec):
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         from ..memory.retry import with_retry
+        from ..runtime.pipeline import effective_depth, pipeline_batches
         m = ctx.metric_set(self.op_id)
         k = self.n + self.offset
         top: ColumnBatch = None
@@ -253,7 +258,8 @@ class TopKExec(SortExec):
             return batch_utils.slice_batch(b, 0, min(k, b.num_rows)) \
                 if b.num_rows > k else b
 
-        for batch in self.children[0].execute(ctx):
+        for batch in pipeline_batches(self.children[0].execute(ctx),
+                                      effective_depth(ctx)):
             with m.time("opTime"):
                 for srt in with_retry(
                         ctx, batch,

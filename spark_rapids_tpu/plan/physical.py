@@ -28,7 +28,7 @@ from ..config import TpuConf
 from ..exprs import (AggregateExpression, Alias, BoundReference, EvalContext,
                      Expression)
 from ..ops import batch_utils, groupby
-from ..utils.metrics import MetricSet, fetch, fetch_scalars
+from ..utils.metrics import MetricSet, fetch, fetch_scalars, prestage
 
 __all__ = ["ExecContext", "TpuExec", "ScanExec", "StageExec", "AggregateExec",
            "CollectExec"]
@@ -164,9 +164,24 @@ class ScanExec(TpuExec):
         acc = [] if dcache is not None else None
         acc_bytes = 0
         origin = str(getattr(source, "path", "") or "")
-        for table in source():
+
+        from ..runtime.pipeline import effective_depth, pipeline_map
+        depth = effective_depth(ctx)
+
+        def _upload(table):
+            # staged on the pipeline worker: batch N+1's Arrow→numpy
+            # conversion and device_put run while batch N's XLA program
+            # is in flight (depth 0 = the old serial loop)
             with m.time("scanTime"):
-                b = from_arrow(table, min_capacity=min_cap, device=ctx.device)
+                return from_arrow(table, min_capacity=min_cap,
+                                  device=ctx.device)
+
+        try:
+            # size the decode-prefetch queue to keep the upload stage fed
+            tables = source(prefetch_depth=max(4, 2 * depth))
+        except TypeError:  # plain-callable sources (tests, exchanges)
+            tables = source()
+        for b in pipeline_map(tables, _upload, depth):
             b.origin_file = origin
             m.add("numOutputRows", b.num_rows)
             m.add("numOutputBatches", 1)
@@ -174,11 +189,17 @@ class ScanExec(TpuExec):
                 acc_bytes += dcache._batch_bytes(b)
                 if acc_bytes > dcache.max_bytes:
                     acc = None
+                    b.donatable = True  # won't be cached after all
                 else:
                     acc.append(b)
                     # re-wrap on the populate path too: consumers must never
-                    # hold the object that sits in the cache
+                    # hold the object that sits in the cache (the wrapper
+                    # also stays non-donatable: its arrays ARE the cache's)
                     b = _CB(b.schema, b.columns, b.num_rows, b.sel)
+            else:
+                # fresh upload with exactly one consumer: fused stages may
+                # donate these buffers back to XLA
+                b.donatable = True
             yield b
         if acc is not None:
             dcache.put(dkey, acc)
@@ -311,6 +332,20 @@ class StageExec(TpuExec):
         fn = _cached_program(
             "stage|" + fp,
             lambda: jax.jit(self._build_fn(in_schema, ansi=ansi)))
+        # donation variant: single-consumer input batches hand their HBM
+        # to XLA (output reuses input buffers → steady-state churn drops).
+        # A separate cached executable — the donating and non-donating
+        # programs coexist because cached/spilled batches must never
+        # donate (see ColumnBatch.donatable).
+        from ..runtime.pipeline import (donation_supported, effective_depth,
+                                        pipeline_batches)
+        fn_donate = None
+        if ctx.conf["spark.rapids.tpu.sql.pipeline.donation"] \
+                and donation_supported():
+            fn_donate = _cached_program(
+                "stage-donate|" + fp,
+                lambda: jax.jit(self._build_fn(in_schema, ansi=ansi),
+                                donate_argnums=(0, 1, 2)))
 
         # figure out host pass-through columns for the final projection
         final_proj = None
@@ -320,7 +355,7 @@ class StageExec(TpuExec):
                 break
 
         from ..cpu.eval import set_ansi
-        from ..memory.retry import with_retry
+        from ..memory.retry import INJECTOR, with_retry
 
         # batch-context state for mid()/spark_partition_id()/
         # input_file_name() (miscfns.py): per-partition row offsets when
@@ -381,6 +416,7 @@ class StageExec(TpuExec):
                     # the thread-local must never leak past this batch —
                     # ANSI errors raise out of evaluate_host_expr
                     set_ansi(False)
+            fresh_output = False
             if all(a is None for a in arrays) and \
                     all(e is None for e in extras):
                 # pure host-column stage (string-only projection): no XLA
@@ -388,8 +424,20 @@ class StageExec(TpuExec):
                 out_arrays = (None,) * len(self._schema)
                 new_sel = b.sel
             else:
-                outs = fn(tuple(arrays), tuple(extras),
-                          b.sel, np.int32(b.num_rows))
+                use_fn = fn
+                if fn_donate is not None and b.donatable \
+                        and not INJECTOR.armed():
+                    # this program consumes the input buffers; the batch
+                    # is dead to every later reference (incl. an OOM
+                    # replay — donation is gated off while injection is
+                    # armed, and the conf documents the real-OOM caveat)
+                    b.donatable = False
+                    use_fn = fn_donate
+                    from ..utils.metrics import QueryStats
+                    QueryStats.get().donated_batches += 1
+                fresh_output = True
+                outs = use_fn(tuple(arrays), tuple(extras),
+                              b.sel, np.int32(b.num_rows))
                 if ansi:
                     out_arrays, new_sel, err = outs
                     if bool(err):
@@ -413,9 +461,17 @@ class StageExec(TpuExec):
                 else:
                     data, valid = val
                     cols.append(DeviceColumn(f_.dtype, data, valid))
-            return ColumnBatch(self._schema, cols, b.num_rows, new_sel)
+            out = ColumnBatch(self._schema, cols, b.num_rows, new_sel)
+            # device outputs are fresh program results (single consumer);
+            # the pure-host path shares the input's sel, so it inherits
+            out.donatable = fresh_output or getattr(b, "donatable", False)
+            return out
 
-        for batch in child.execute(ctx):
+        # pull the child up to `depth` batches ahead: its host decode +
+        # upload (and any upstream dispatch) overlaps this stage's XLA
+        # programs (depth 0 = the old lockstep pull loop)
+        for batch in pipeline_batches(child.execute(ctx),
+                                      effective_depth(ctx)):
             with m.time("opTime"):
                 outs = list(with_retry(ctx, batch, run_one))
             if partitioned:
@@ -623,8 +679,13 @@ class AggregateExec(TpuExec):
             "agg-merge|" + self._fingerprint(),
             lambda: jax.jit(lambda a, b: slf._merge_scalars(a, b, ops)))
 
+        from ..runtime.pipeline import effective_depth, pipeline_batches
         acc: Optional[List] = None
-        for batch in child.execute(ctx):
+        # scan decode/upload of batch N+1 overlaps this reduction's
+        # dispatch (the fused path consumes the scan directly, so this
+        # is its only pipelining point)
+        for batch in pipeline_batches(child.execute(ctx),
+                                      effective_depth(ctx)):
             with m.time("opTime"):
                 for partials in with_retry(ctx, batch, run_one):
                     acc = partials if acc is None else merge_fn(acc, partials)
@@ -906,16 +967,18 @@ class AggregateExec(TpuExec):
             accs = _init_acc()
             present = jnp.zeros((D,), dtype=jnp.int8)
             kmin_s = jnp.int64(kmin)
-            leftovers = []  # bounded: flushed every few batches
+            # [(sel-masked view, count scalar)]: the count's D2H copy is
+            # prestaged at append time, so the flush/tail fetch finds the
+            # bytes already en route instead of stalling the loop
+            leftovers = []
             left_parts = []
 
             def flush_leftovers():
                 if not leftovers:
                     return
                 # ONE batched fetch resolves which batches diverted rows
-                counts = fetch(
-                    [jnp.sum(b.sel.astype(jnp.int32)) for b in leftovers])
-                for b, cnt in zip(leftovers, counts):
+                counts = fetch([c for _, c in leftovers])
+                for (b, _), cnt in zip(leftovers, counts):
                     if int(cnt):
                         left_parts.append(sort_part_fn(
                             batch_utils.compact(b)))
@@ -932,9 +995,10 @@ class AggregateExec(TpuExec):
                         kmin_s)
                     accs = list(accs_t)
                 if not (first_batch and key_nonnull):
-                    leftovers.append(
+                    leftovers.append((
                         ColumnBatch(batch.schema, batch.columns,
-                                    batch.num_rows, leftover))
+                                    batch.num_rows, leftover),
+                        prestage(jnp.sum(leftover.astype(jnp.int32)))))
                 first_batch = False
                 if len(leftovers) >= 8:  # bound pinned input batches
                     flush_leftovers()
@@ -951,9 +1015,8 @@ class AggregateExec(TpuExec):
             # downstream operator to D capacity
             n_groups_dev = jnp.sum((present > 0).astype(jnp.int64))
             left_counts, n_groups = fetch(
-                ([jnp.sum(b.sel.astype(jnp.int32)) for b in leftovers],
-                 n_groups_dev))
-            for b, cnt in zip(leftovers, left_counts):
+                ([c for _, c in leftovers], n_groups_dev))
+            for (b, _), cnt in zip(leftovers, left_counts):
                 if int(cnt):
                     left_parts.append(sort_part_fn(
                         batch_utils.compact(b)))
@@ -1269,9 +1332,8 @@ class AggregateExec(TpuExec):
             def flush_leftovers():
                 if not leftovers:
                     return
-                counts = fetch(
-                    [jnp.sum(b.sel.astype(jnp.int32)) for b in leftovers])
-                for b, cnt in zip(leftovers, counts):
+                counts = fetch([c for _, c in leftovers])
+                for (b, _), cnt in zip(leftovers, counts):
                     if int(cnt):
                         left_parts.append(sort_part_fn(
                             batch_utils.compact(b)))
@@ -1301,9 +1363,12 @@ class AggregateExec(TpuExec):
                     accs = list(accs_t)
                     res = list(res_t)
                 if not (first_batch and key_nonnull):
-                    leftovers.append(
+                    # count prestaged: its D2H copy overlaps the next
+                    # batch's dispatch instead of stalling the tail fetch
+                    leftovers.append((
                         ColumnBatch(batch.schema, batch.columns,
-                                    batch.num_rows, leftover))
+                                    batch.num_rows, leftover),
+                        prestage(jnp.sum(leftover.astype(jnp.int32)))))
                 first_batch = False
                 if len(leftovers) >= 8:
                     flush_leftovers()
@@ -1311,8 +1376,7 @@ class AggregateExec(TpuExec):
             # leftover counts + group count together
             n_groups_dev = jnp.sum((present > 0).astype(jnp.int64))
             tail = fetch((vfn(tuple(res), present),
-                          [jnp.sum(b.sel.astype(jnp.int32))
-                           for b in leftovers], n_groups_dev))
+                          [c for _, c in leftovers], n_groups_dev))
             violated, left_counts, n_groups = tail
             if bool(violated):
                 m.add("aggDenseResidualFallback", 1)
@@ -1327,7 +1391,7 @@ class AggregateExec(TpuExec):
             for h in buffered:
                 h.close()
             buffered.clear()
-            for b, cnt in zip(leftovers, left_counts):
+            for (b, _), cnt in zip(leftovers, left_counts):
                 if int(cnt):
                     left_parts.append(sort_part_fn(
                         batch_utils.compact(b)))
@@ -1500,8 +1564,11 @@ class AggregateExec(TpuExec):
         if self.mode == "final" and child.outputs_partitions:
             # a shuffle guarantees each group is confined to one partition
             # batch: finalize per batch, no cross-batch merge (streaming)
+            from ..runtime.pipeline import (effective_depth,
+                                            pipeline_batches)
             any_out = False
-            for batch in child.execute(ctx):
+            for batch in pipeline_batches(child.execute(ctx),
+                                          effective_depth(ctx)):
                 with m.time("opTime"):
                     batch = self._encode_string_keys(batch, ctx)
                     arrays = tuple(
@@ -1525,6 +1592,7 @@ class AggregateExec(TpuExec):
                 yield ColumnBatch(self._schema, self._empty_cols(), 0)
             return
         from ..memory.retry import with_retry
+        from ..runtime.pipeline import effective_depth, pipeline_batches
 
         def run_one(b: ColumnBatch) -> ColumnBatch:
             arrays = tuple((c.data, c.valid) if isinstance(c, DeviceColumn)
@@ -1532,7 +1600,10 @@ class AggregateExec(TpuExec):
             ok, ov, gmask = batch_group(arrays, b.sel, np.int32(b.num_rows))
             return self._to_buffer_batch(buffer_schema, ok, ov, gmask)
 
-        child_batches = child.execute(ctx)
+        # pull the child ahead: upstream host work overlaps the per-batch
+        # group/scatter programs (the dense paths' `rest` stream included)
+        child_batches = pipeline_batches(child.execute(ctx),
+                                         effective_depth(ctx))
         if self._dense_agg_static_ok(ops, ctx.conf):
             peek = next(child_batches, None)
             if peek is None:
@@ -2020,8 +2091,23 @@ class CollectExec(TpuExec):
 
     def collect_arrow(self, ctx: ExecContext):
         import pyarrow as pa
-        from ..batch import to_arrow
-        tables = [to_arrow(b) for b in self.children[0].execute(ctx)]
+        from ..batch import to_arrow, to_arrow_async
+        from ..runtime.pipeline import effective_depth
+        depth = effective_depth(ctx)
+        if depth <= 0:
+            tables = [to_arrow(b) for b in self.children[0].execute(ctx)]
+        else:
+            # async D2H: batch N's fetch rides behind batch N+1's
+            # dispatch; at most `depth` fetches (each pinning its device
+            # batch) are outstanding, so peak HBM stays bounded
+            from collections import deque
+            pending: "deque" = deque()
+            tables = []
+            for b in self.children[0].execute(ctx):
+                pending.append(to_arrow_async(b))
+                while len(pending) > depth:
+                    tables.append(pending.popleft()())
+            tables.extend(f() for f in pending)
         if not tables:
             return None
         return pa.concat_tables(tables)

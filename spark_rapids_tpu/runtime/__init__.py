@@ -1,6 +1,9 @@
-"""Runtime layer: device manager + task semaphore (SURVEY §2.1)."""
+"""Runtime layer: device manager + task semaphore + async pipeline
+(SURVEY §2.1)."""
 
 from .device import DeviceManager
+from .pipeline import pipeline_batches, pipeline_map
 from .semaphore import TpuSemaphore
 
-__all__ = ["DeviceManager", "TpuSemaphore"]
+__all__ = ["DeviceManager", "TpuSemaphore", "pipeline_map",
+           "pipeline_batches"]

@@ -1,0 +1,158 @@
+"""Bounded-depth execution pipeline: overlap host work with XLA dispatch.
+
+The engine's pull loop was strictly serial: ``ScanExec`` decodes a pyarrow
+batch, blocks in ``jax.device_put``, runs the stage program, and only then
+starts decoding the next batch — so the chip idles during every decode and
+H2D transfer (PERF.md attributes ~0.1-0.2 s per host round trip on the
+tunneled backend).  This module is the latency-hiding primitive the
+operator layer threads through (the Theseus overlap-data-movement-with-
+compute idea, PAPERS.md, realized inside one process):
+
+  * a single worker thread drives the upstream iterator AHEAD of the
+    consumer, staging up to ``depth`` batches (decode + ``device_put``
+    for a scan; the whole child pull for a stage), so batch N+1's host
+    work overlaps batch N's XLA program;
+  * depth is a hard bound: a slot is reserved BEFORE the next item is
+    produced, so at most ``depth`` staged batches are ever live — HBM
+    stays bounded exactly like the serial iterator chain;
+  * ``depth == 0`` reproduces today's serial pull loop byte-for-byte
+    (the debugging escape hatch; ``spark.rapids.tpu.sql.pipeline.depth``).
+
+Wait/overlap accounting lands in :class:`..utils.metrics.QueryStats`
+(``h2d_wait_s`` = consumer blocked on a staged batch, ``pipeline_stage_s``
+= worker busy time); ``bench.py`` derives the per-query ``overlap_s``
+column from the two.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Iterator, TypeVar
+
+__all__ = ["pipeline_map", "pipeline_batches", "effective_depth",
+           "donation_supported"]
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+_END = object()
+
+
+_DEPTH_KEY = "spark.rapids.tpu.sql.pipeline.depth"
+
+
+def effective_depth(ctx) -> int:
+    """The pipeline depth this execution should use.
+
+    OOM-injection tests force ``0``: the injector arms "the next N device
+    ops" process-globally, and two threads racing for those ops would make
+    the injection point nondeterministic.
+
+    On the CPU backend the DEFAULT also resolves to ``0``: staging and
+    "device" programs run on the same cores there, so overlap is pure
+    contention (measured: q13 warm 61→157 ms on the 8-virtual-device
+    mesh) — the depth only hides latency when host and device are
+    different silicon.  An explicitly-set depth always wins (tests and
+    ``SRT_BENCH_PIPELINE_DEPTH`` A/Bs set it on purpose).
+    """
+    conf = ctx.conf
+    if conf["spark.rapids.tpu.test.injectRetryOOM"] \
+            or conf["spark.rapids.tpu.test.injectSplitAndRetryOOM"]:
+        return 0
+    if not conf.is_set(_DEPTH_KEY):
+        import jax
+        if jax.default_backend() == "cpu":
+            return 0
+    return conf[_DEPTH_KEY]
+
+
+def donation_supported() -> bool:
+    """XLA buffer donation is a no-op (with a warning) on the CPU backend;
+    only engage it where the runtime actually reuses the HBM."""
+    import jax
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
+                 depth: int) -> Iterator[U]:
+    """Yield ``fn(item)`` for each upstream item, staging up to ``depth``
+    results ahead of the consumer on a worker thread.
+
+    ``depth <= 0`` degrades to the plain serial loop.  Upstream exceptions
+    surface at the consumer's next pull; abandoning the iterator (LIMIT,
+    errors) stops the worker and closes the upstream generator without
+    leaking the thread or its staged batches.
+    """
+    if depth <= 0:
+        for item in src:
+            yield fn(item)
+        return
+
+    from ..utils.metrics import QueryStats
+
+    slots = threading.Semaphore(depth)
+    q: "queue.Queue" = queue.Queue()
+    stop = threading.Event()
+    it = iter(src)
+
+    def worker():
+        try:
+            while True:
+                # reserve a slot BEFORE producing: at most `depth` staged
+                # items are ever live (queue + the one being produced)
+                while not slots.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    q.put(_END)
+                    return
+                out = fn(item)
+                QueryStats.get().pipeline_stage_s += \
+                    time.perf_counter() - t0
+                q.put(out)
+        except BaseException as e:  # surfaced on the consumer side
+            q.put(e)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except BaseException:
+                    pass
+
+    th = threading.Thread(target=worker, daemon=True,
+                          name="srt-pipeline-stage")
+    th.start()
+    try:
+        pending_release = False
+        while True:
+            if pending_release:
+                # the previous item's slot frees only once the consumer
+                # comes back for more: staged batches + the one in the
+                # consumer's hands never exceed `depth` (strict HBM bound)
+                slots.release()
+            t0 = time.perf_counter()
+            item = q.get()
+            QueryStats.get().h2d_wait_s += time.perf_counter() - t0
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            pending_release = True
+            yield item
+    finally:
+        stop.set()
+
+
+def pipeline_batches(batches: Iterable[T], depth: int) -> Iterator[T]:
+    """Pull an operator's child iterator up to ``depth`` batches ahead:
+    the child's host decode/upload/dispatch runs on the worker thread
+    while the consumer's XLA program is in flight."""
+    return pipeline_map(batches, lambda b: b, depth)

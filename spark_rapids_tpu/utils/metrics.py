@@ -17,7 +17,8 @@ from typing import Dict
 import jax
 
 __all__ = ["MetricSet", "TaskMetrics", "QueryStats", "trace_range",
-           "fetch", "fetch_scalars", "sync_budget"]
+           "fetch", "fetch_async", "fetch_scalars", "prestage",
+           "sync_budget", "FetchFuture"]
 
 
 class QueryStats:
@@ -41,7 +42,15 @@ class QueryStats:
 
     def __init__(self):
         self.blocking_fetches = 0
+        # device→host fetches resolved through a FetchFuture: the copy
+        # runs behind the dispatch front, so these do NOT count against
+        # the blocking-fetch budget (they are still traced and byte- and
+        # wait-accounted)
+        self.async_fetches = 0
         self.fetch_bytes = 0
+        # wall-clock the engine spent BLOCKED inside jax.device_get
+        # (sync + async-resolve combined): the attributable D2H stall
+        self.fetch_wait_s = 0.0
         self.compiles = 0
         self.compile_s = 0.0
         self.uploads = 0
@@ -49,6 +58,14 @@ class QueryStats:
         # bytes entering shuffle exchanges (device batch sizes at the
         # staging barrier) — BASELINE.json's shuffle-GB/s metric input
         self.shuffle_bytes = 0
+        # execution-pipeline accounting (runtime/pipeline.py): time the
+        # consumer blocked waiting on a staged batch vs time the worker
+        # spent staging — bench derives overlap_s = stage - wait
+        self.h2d_wait_s = 0.0
+        self.pipeline_stage_s = 0.0
+        # input batches whose device buffers were donated to a fused
+        # stage program (HBM reuse; plan/physical.StageExec)
+        self.donated_batches = 0
 
     # -- global accessors ---------------------------------------------------
     @classmethod
@@ -107,6 +124,31 @@ def _tree_nbytes(host) -> int:
     return total
 
 
+def _call_site(extra_frames: int = 0) -> str:
+    import traceback
+    drop = 2 + extra_frames  # _call_site + the helper that asked for it
+    return "|".join(
+        f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
+        for f in traceback.extract_stack(limit=6 + drop)[:-drop])
+
+
+def _resolve_tree(tree, site=None, tag: str = ""):
+    """The ONE ``jax.device_get`` call site for sync AND async fetches:
+    times the wait (``fetch_wait_s``), accounts bytes, and — under
+    SRT_SYNC_TRACE — appends the attributed call site to SYNC_TRACE."""
+    s = QueryStats.get()
+    t0 = time.perf_counter()
+    host = jax.device_get(tree)
+    dt = time.perf_counter() - t0
+    s.fetch_wait_s += dt
+    s.fetch_bytes += _tree_nbytes(host)
+    if _TRACE_SYNCS:
+        if site is None:
+            site = _call_site(extra_frames=1)
+        SYNC_TRACE.append(((tag + site) if tag else site, round(dt, 4)))
+    return host
+
+
 def fetch(tree):
     """The engine's ONE blocking device→host transfer choke point.
 
@@ -117,21 +159,67 @@ def fetch(tree):
     """
     s = QueryStats.get()
     s.blocking_fetches += 1
-    if _TRACE_SYNCS:
-        import time as _t
-        import traceback
-        t0 = _t.perf_counter()
-        host = jax.device_get(tree)
-        dt = _t.perf_counter() - t0
-        site = "|".join(
-            f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
-            for f in traceback.extract_stack(limit=6)[:-1])
-        SYNC_TRACE.append((site, round(dt, 4)))
-    else:
-        host = jax.device_get(tree)
-    s.fetch_bytes += _tree_nbytes(host)
+    host = _resolve_tree(tree, site=_call_site() if _TRACE_SYNCS else None)
     _check_budget()
     return host
+
+
+def _start_copies(tree) -> None:
+    for leaf in jax.tree_util.tree_leaves(tree):
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:
+                pass  # a hint only; the blocking get still works
+
+
+class FetchFuture:
+    """A device→host fetch whose copy is already in flight.
+
+    ``result()`` blocks only for whatever part of the transfer has not
+    finished yet — on the tunneled backend the copy overlaps the next
+    batch's dispatch instead of stalling the pull loop.  Resolution
+    routes through the same accounting as :func:`fetch` (bytes, wait
+    time, SRT_SYNC_TRACE site) but counts as an *async* fetch, excluded
+    from the blocking-fetch budget.
+    """
+
+    __slots__ = ("_tree", "_site", "_host", "_done")
+
+    def __init__(self, tree, site=None):
+        self._tree = tree
+        self._site = site
+        self._host = None
+        self._done = False
+
+    def result(self):
+        if not self._done:
+            self._host = _resolve_tree(self._tree, site=self._site,
+                                       tag="async|")
+            self._tree = None  # drop device refs once resolved
+            self._done = True
+        return self._host
+
+
+def fetch_async(tree) -> FetchFuture:
+    """Start a device→host transfer WITHOUT blocking: kicks off
+    ``copy_to_host_async`` on every device leaf and returns a
+    :class:`FetchFuture`.  Deferred metrics and collect's tail fetches
+    ride this so the copy overlaps the next batch's dispatch."""
+    s = QueryStats.get()
+    s.async_fetches += 1
+    site = _call_site() if _TRACE_SYNCS else None
+    _start_copies(tree)
+    return FetchFuture(tree, site)
+
+
+def prestage(tree):
+    """Fire-and-forget ``copy_to_host_async``: no counters, no future —
+    a later :func:`fetch` of the same arrays finds the data already en
+    route, shrinking its blocking wait.  Returns ``tree`` unchanged."""
+    _start_copies(tree)
+    return tree
 
 
 def fetch_scalars(x) -> list:
@@ -186,19 +274,21 @@ class MetricSet:
         self.values[name] += amount
 
     def add_deferred(self, name: str, device_scalar) -> None:
-        """Count a device scalar WITHOUT a blocking fetch: the value is
-        resolved only when the metric is actually read.  Metrics-only
-        round trips on the tunneled backend cost ~0.1-0.2 s each — a
-        query must never pay one for a counter nobody looks at."""
-        self._deferred.append((name, device_scalar))
+        """Count a device scalar WITHOUT a blocking fetch: the D2H copy
+        starts immediately (async, behind the dispatch front) and the
+        value is resolved only when the metric is actually read.
+        Metrics-only round trips on the tunneled backend cost ~0.1-0.2 s
+        each — a query must never pay one for a counter nobody looks
+        at, and a counter somebody does look at should already be on
+        the host by then."""
+        self._deferred.append((name, fetch_async(device_scalar)))
 
     def _resolve(self) -> None:
         if not self._deferred:
             return
         pending, self._deferred = self._deferred, []
-        vals = fetch([v for _, v in pending])
-        for (name, _), v in zip(pending, vals):
-            self.values[name] += int(v)
+        for name, fut in pending:
+            self.values[name] += int(fut.result())
 
     @contextlib.contextmanager
     def time(self, name: str):

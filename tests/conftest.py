@@ -49,3 +49,17 @@ def fresh_session():
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(20260729)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Collection-time lint: a raw jax.device_get / np.asarray(<col>.data)
+    in the operator layer dodges the metrics choke point and silently
+    corrupts the sync profile — fail the run before any test executes."""
+    from tools.check_blocking_fetch import check
+    violations = check()
+    if violations:
+        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
+                          for rel, ln, src in violations)
+        raise pytest.UsageError(
+            "raw device->host transfers outside utils.metrics.fetch/"
+            f"fetch_async (tools/check_blocking_fetch.py):\n{lines}")

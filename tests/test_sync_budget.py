@@ -6,16 +6,23 @@ chip), so the per-operator budgets below are the engine's latency
 contract: a change that adds a fetch to the join/agg/collect hot path
 fails here before it ships as a 2x suite regression.
 
+Async fetches (``utils.metrics.fetch_async``: the D2H copy rides behind
+the dispatch front) are EXCLUDED from the blocking budget but still
+traced and byte/wait-accounted through the same choke point — the
+budget measures stalls, not transfers.
+
 Reference analog: the sync discipline that GpuExec operators get from
 cuDF's stream-ordered batching (SURVEY.md §3.2); here the budget is
 explicit because remote-TPU round trips are ~1000x costlier than a
 local cudaMemcpy.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 import spark_rapids_tpu as srt
+from spark_rapids_tpu.utils import metrics as M
 from spark_rapids_tpu.utils.metrics import QueryStats, sync_budget
 
 
@@ -37,12 +44,31 @@ def _frame(sess, n, seed, **cols):
 
 
 def test_scan_filter_agg_collect_budget(sess):
-    """Q6-shape (scan→filter→scalar agg→collect): <= 2 blocking fetches."""
+    """Q6-shape (scan→filter→scalar agg→collect): <= 2 *blocking*
+    fetches; the collect tail may additionally ride async."""
     df = _frame(sess, 4096, 1, a=("int", 100), b=("f", None))
     q = df.filter(srt.functions.col("a") < 50).agg(
         srt.functions.sum(srt.functions.col("b")).alias("s"))
-    with sync_budget(2, "scan-filter-agg"):
+    with sync_budget(2, "scan-filter-agg") as s:
         q.collect()
+    assert s.blocking_fetches <= 2
+    # every transfer — blocking or async — is still byte-accounted
+    assert s.fetch_bytes > 0
+
+
+def test_scan_agg_budget_holds_under_pipeline(sess):
+    """The async pipeline must not ADD blocking fetches: the same plan
+    holds the same budget at depth 0 (serial) and depth 2."""
+    f = srt.functions
+    df = _frame(sess, 4096, 7, a=("int", 100), b=("f", None))
+    q = df.filter(f.col("a") < 50).agg(f.sum(f.col("b")).alias("s"))
+    for depth in (0, 2):
+        sess.conf.set("spark.rapids.tpu.sql.pipeline.depth", depth)
+        try:
+            with sync_budget(2, f"scan-filter-agg@depth{depth}"):
+                q.collect()
+        finally:
+            sess.conf.unset("spark.rapids.tpu.sql.pipeline.depth")
 
 
 def test_join_agg_sort_budget(sess):
@@ -61,10 +87,50 @@ def test_join_agg_sort_budget(sess):
 
 
 def test_counters_track_fetches(sess):
-    """QueryStats counts fetches and bytes for a collect."""
+    """QueryStats counts transfers and bytes for a collect — the tail
+    fetch may be blocking (depth 0) or async (pipelined), but it is
+    never unaccounted."""
     df = _frame(sess, 1024, 4, a=("int", 10))
     QueryStats.reset()
     df.collect()
     s = QueryStats.get()
-    assert s.blocking_fetches >= 1
+    assert s.blocking_fetches + s.async_fetches >= 1
     assert s.fetch_bytes > 0
+
+
+def test_async_fetch_excluded_from_budget_but_traced(monkeypatch):
+    """fetch_async resolves outside the blocking budget yet through the
+    same accounting: bytes, wait time, and SRT_SYNC_TRACE attribution."""
+    monkeypatch.setattr(M, "_TRACE_SYNCS", True)
+    M.SYNC_TRACE.clear()
+    with sync_budget(0, "async-only"):  # zero BLOCKING fetches allowed
+        fut = M.fetch_async(jnp.arange(1024, dtype=jnp.int64))
+        vals = fut.result()
+        assert vals.shape == (1024,)
+        assert vals[-1] == 1023
+    s = QueryStats.get()
+    assert s.blocking_fetches == 0
+    assert s.async_fetches == 1
+    assert s.fetch_bytes >= 1024 * 8
+    assert s.fetch_wait_s >= 0.0
+    # traced with the async tag and the fetch_async call site
+    assert len(M.SYNC_TRACE) == 1
+    site, _dt = M.SYNC_TRACE[0]
+    assert site.startswith("async|")
+    assert "test_sync_budget" in site
+    # resolving twice must not double-count
+    fut.result()
+    assert QueryStats.get().async_fetches == 1
+
+
+def test_deferred_metrics_do_not_block(sess):
+    """Deferred operator metrics resolve via the async path: reading
+    them after a query adds no blocking fetch."""
+    from spark_rapids_tpu.utils.metrics import MetricSet
+    QueryStats.reset()
+    m = MetricSet("op@test")
+    m.add_deferred("numOutputRows", jnp.sum(jnp.arange(10)))
+    before = QueryStats.get().blocking_fetches
+    assert m["numOutputRows"] == 45
+    assert QueryStats.get().blocking_fetches == before
+    assert QueryStats.get().async_fetches >= 1

@@ -1,0 +1,81 @@
+"""Lint: no raw device→host transfers outside the metrics choke point.
+
+Every blocking fetch in the operator layer must route through
+``utils.metrics.fetch`` / ``fetch_async`` so the per-query sync profile
+(bench ``syncs_warm`` / ``fetch_wait_s``) and the sync-budget tests stay
+trustworthy.  This check greps the operator layer (``plan/``, ``ops/``,
+``parallel/``) for the two ways a transfer sneaks past the choke point:
+
+  * ``jax.device_get(...)`` — the raw blocking get;
+  * ``np.asarray(<col>.data / .valid / .codes)`` — an implicit D2H of a
+    DeviceColumn's arrays.
+
+Run standalone (``python tools/check_blocking_fetch.py``, exit 1 on
+violations) or let the test suite run it: tests/conftest.py invokes
+:func:`check` at collection time, so a stray fetch fails the run before
+a single test executes.
+
+Lines carrying an explicit ``# choke-point-ok`` comment are exempt (for
+a future host-side boundary that is provably not a device transfer).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_rapids_tpu")
+
+# the operator layer: everything that runs inside a query's pull loop
+OPERATOR_DIRS = ("plan", "ops", "parallel")
+
+_RAW_GET = re.compile(r"\bjax\.device_get\s*\(")
+# np.asarray over a device column's arrays (col.data / c.valid / .codes):
+# an implicit blocking transfer the sync profile would never see
+_ASARRAY_DEVICE = re.compile(
+    r"\bnp\.asarray\(\s*[A-Za-z_][\w\.]*\.(data|valid|codes)\b")
+_EXEMPT = "# choke-point-ok"
+
+
+def check(root: str = PKG) -> List[Tuple[str, int, str]]:
+    """Return [(relpath, lineno, line)] violations in the operator layer."""
+    violations: List[Tuple[str, int, str]] = []
+    for sub in OPERATOR_DIRS:
+        base = os.path.join(root, sub)
+        for dirpath, _dirs, files in os.walk(base):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path, encoding="utf-8") as f:
+                    for lineno, line in enumerate(f, 1):
+                        if _EXEMPT in line:
+                            continue
+                        if _RAW_GET.search(line) \
+                                or _ASARRAY_DEVICE.search(line):
+                            violations.append(
+                                (os.path.relpath(path, root), lineno,
+                                 line.strip()))
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if not violations:
+        print("check_blocking_fetch: operator layer clean")
+        return 0
+    print("check_blocking_fetch: raw device->host transfers outside "
+          "utils.metrics.fetch/fetch_async:", file=sys.stderr)
+    for rel, lineno, line in violations:
+        print(f"  spark_rapids_tpu/{rel}:{lineno}: {line}", file=sys.stderr)
+    print("route these through utils.metrics.fetch (blocking) or "
+          "fetch_async (overlapped) so they count in the sync profile.",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
