@@ -17,7 +17,10 @@ SRT_BENCH_QUERY_TIMEOUT (per-query subprocess budget, default 300 s),
 SRT_BENCH_WALL_BUDGET (whole-run wall-clock budget, default 820 s —
 queries that don't fit are reported as skipped, never killed mid-print),
 SRT_BENCH_PIPELINE_DEPTH (sets spark.rapids.tpu.sql.pipeline.depth for
-the engine run; 0 = serial baseline for overlap A/B).
+the engine run; 0 = serial baseline for overlap A/B),
+SRT_BENCH_TRACE_DIR (enables spark.rapids.tpu.sql.trace.enabled and
+writes one Chrome-trace JSON per query — <query>.trace.json, the last
+warm iteration's span tree — for Perfetto / tools/trace_report.py).
 
 The aggregate JSON line is re-printed after EVERY query (flush=True), so
 a driver that kills the run on a timeout still finds the latest complete
@@ -73,6 +76,11 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
     depth_env = os.environ.get("SRT_BENCH_PIPELINE_DEPTH")
     if depth_env is not None:
         settings["spark.rapids.tpu.sql.pipeline.depth"] = int(depth_env)
+    # SRT_BENCH_TRACE_DIR: record a structured query trace and dump one
+    # Chrome-trace JSON per query (tools/trace_report.py reads them)
+    trace_dir = os.environ.get("SRT_BENCH_TRACE_DIR")
+    if trace_dir:
+        settings["spark.rapids.tpu.sql.trace.enabled"] = True
     sess = srt.Session.get_or_create(settings=settings)
     dfs = {t: sess.read_parquet(paths[t]) for t in tables}
     # pandas baseline runs fully in-memory; the engine's decoded-file
@@ -89,6 +97,12 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
     warm0 = QueryStats.get().snapshot()
     engine_s = _time(lambda: runner(dfs), iters)
     warm_stats = QueryStats.delta_since(warm0)
+    if trace_dir:
+        # one trace per query: the last warm iteration's span tree
+        os.makedirs(trace_dir, exist_ok=True)
+        tr = sess.last_trace()
+        if tr is not None:
+            tr.write(os.path.join(trace_dir, f"{name}.trace.json"))
     # per warm iteration: the sync profile of ONE steady-state run
     for k in warm_stats:
         warm_stats[k] = round(warm_stats[k] / iters, 4)

@@ -418,6 +418,30 @@ METRICS_LEVEL = register(
     "Operator metric collection level: ESSENTIAL, MODERATE, DEBUG.",
     check=_one_of("ESSENTIAL", "MODERATE", "DEBUG"))
 
+TRACE_ENABLED = register(
+    "spark.rapids.tpu.sql.trace.enabled", False,
+    "Record a structured query trace: one span per physical plan "
+    "operator (mirroring the plan tree) with child phase spans for "
+    "decode, H2D staging, dispatch, pipeline wait, and D2H fetch, plus "
+    "compile and shuffle events — the attribution spine behind "
+    "df.explain('profiled'), Session.last_trace(), and the Chrome-trace "
+    "export (tools/trace_report.py). Off by default; the disabled path "
+    "is a single context-variable read per event site.")
+
+TRACE_DIR = register(
+    "spark.rapids.tpu.sql.trace.dir", "",
+    "When set (and sql.trace.enabled=true), write one Chrome-trace-event "
+    "JSON file per executed query into this directory (loads in Perfetto "
+    "or chrome://tracing; bench.py points it at SRT_BENCH_TRACE_DIR). "
+    "Empty disables the auto-dump — traces stay available in-process via "
+    "Session.last_trace().")
+
+TRACE_MAX_EVENTS = register(
+    "spark.rapids.tpu.sql.trace.maxEvents", 100_000,
+    "Hard cap on recorded trace events per query; events beyond it are "
+    "counted (otherData.dropped_events in the export) but not stored, "
+    "bounding trace memory for long streaming queries.", conv=int)
+
 TEST_VALIDATE_EXECS = register(
     "spark.rapids.tpu.test.validateExecsOnTpu", False,
     "Test-only: fail if any operator in the plan falls back to CPU.",

@@ -563,16 +563,33 @@ class ParquetSource:
                     continue
             return False
 
+        import contextvars
+
+        from ..utils import tracing
+        cctx = contextvars.copy_context()
+
         def producer():
             try:
-                for t in self._read_all():
+                it = self._read_all()
+                while True:
+                    # each decoded table is a "decode" span on this
+                    # thread's trace lane (the host phase of the scan)
+                    with tracing.span(None, "decode", "io") as sp:
+                        t = next(it, None)
+                        if t is not None:
+                            sp.set(rows=t.num_rows)
+                    if t is None:
+                        break
                     if not _put(t):
                         return
                 _put(_END)
             except BaseException as ex:  # propagate to consumer
                 _put(ex)
 
-        th = threading.Thread(target=producer, daemon=True,
+        # the producer runs in a COPY of the caller's context: its spans
+        # and stats land in the calling query's trace/scope
+        th = threading.Thread(target=lambda: cctx.run(producer),
+                              daemon=True,
                               name="srt-parquet-prefetch")
         th.start()
         try:

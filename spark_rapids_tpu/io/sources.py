@@ -109,9 +109,21 @@ class FileSource:
         stop = threading.Event()
         _END = object()
 
+        import contextvars
+
+        from ..utils import tracing
+        cctx = contextvars.copy_context()
+
         def producer():
             try:
-                for t in self._read_all():
+                it = self._read_all()
+                while True:
+                    with tracing.span(None, "decode", "io") as sp:
+                        t = next(it, None)
+                        if t is not None:
+                            sp.set(rows=t.num_rows)
+                    if t is None:
+                        break
                     while not stop.is_set():
                         try:
                             q.put(t, timeout=0.1)
@@ -124,7 +136,9 @@ class FileSource:
             except BaseException as e:  # surfaced on the consumer side
                 q.put(e)
 
-        th = threading.Thread(target=producer, daemon=True)
+        # copied context: decode spans join the calling query's trace
+        th = threading.Thread(target=lambda: cctx.run(producer),
+                              daemon=True)
         th.start()
         try:
             while True:

@@ -83,27 +83,35 @@ class HostShuffle:
     # -- write side ---------------------------------------------------------------
     def write_partition(self, p: int, table) -> None:
         """Queue an arrow table for partition ``p`` (serialized +
-        compressed on the pool)."""
+        compressed on the pool).  The task runs in a copy of the caller's
+        context so its spans join the caller's query trace."""
         if table.num_rows == 0:
             return
-        self._pending.append(self._pool.submit(self._do_write, p, table))
+        import contextvars
+        cctx = contextvars.copy_context()
+        self._pending.append(
+            self._pool.submit(cctx.run, self._do_write, p, table))
 
     def _do_write(self, p: int, table) -> None:
         import pyarrow as pa
-        sink = pa.BufferOutputStream()
-        with pa.ipc.new_stream(sink, table.schema) as w:
-            w.write_table(table)
-        payload = sink.getvalue().to_pybytes()
-        if self.compress:
-            flag, data = _compress(payload)
-        else:
-            flag, data = b"R", payload
-        with self._locks[p]:
-            with open(self._paths[p], "ab") as f:
-                f.write(_FRAME.pack(flag, len(data), len(payload)))
-                f.write(data)
-        self.bytes_written += len(data)
-        self.rows_written += table.num_rows
+
+        from ..utils import tracing
+        with tracing.span(None, "shuffle:write", "shuffle") as sp:
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, table.schema) as w:
+                w.write_table(table)
+            payload = sink.getvalue().to_pybytes()
+            if self.compress:
+                flag, data = _compress(payload)
+            else:
+                flag, data = b"R", payload
+            with self._locks[p]:
+                with open(self._paths[p], "ab") as f:
+                    f.write(_FRAME.pack(flag, len(data), len(payload)))
+                    f.write(data)
+            self.bytes_written += len(data)
+            self.rows_written += table.num_rows
+            sp.set(partition=p, bytes=len(data), rows=table.num_rows)
 
     def finish_writes(self) -> None:
         """Barrier: all queued serializations durable (map side done)."""
@@ -115,6 +123,8 @@ class HostShuffle:
     def read_partition(self, p: int) -> Iterator:
         """Yield the arrow tables written to partition ``p``."""
         import pyarrow as pa
+
+        from ..utils import tracing
         path = self._paths[p]
         if not os.path.exists(path):
             return
@@ -123,10 +133,13 @@ class HostShuffle:
                 header = f.read(_FRAME.size)
                 if not header:
                     break
-                flag, clen, rlen = _FRAME.unpack(header)
-                payload = _decompress(flag, f.read(clen), rlen)
-                with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
-                    yield r.read_all()
+                with tracing.span(None, "shuffle:read", "shuffle") as sp:
+                    flag, clen, rlen = _FRAME.unpack(header)
+                    payload = _decompress(flag, f.read(clen), rlen)
+                    with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
+                        table = r.read_all()
+                    sp.set(partition=p, bytes=clen, rows=table.num_rows)
+                yield table
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

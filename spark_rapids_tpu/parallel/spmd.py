@@ -735,7 +735,13 @@ def distribute_plan(phys, ctx, mesh, axis: str = "data"):
         attempt = 0
         while True:
             try:
-                table = _execute_fragment(lowered, leaves, ctx, mesh, axis)
+                from ..utils import tracing
+                with tracing.span(frag_node.op_id, "ici:fragment",
+                                  "ici") as sp:
+                    table = _execute_fragment(lowered, leaves, ctx, mesh,
+                                              axis)
+                    sp.set(devices=n_dev, leaves=len(leaves),
+                           rows=table.num_rows)
                 break
             except ICICapacityOverflow:
                 attempt += 1

@@ -277,10 +277,14 @@ class ShuffleExchangeExec(TpuExec):
                     yield out
                     return
 
+            from ..utils import tracing
             from ..utils.metrics import QueryStats
             for bh in raw:
                 batch = bh.get()
-                QueryStats.get().shuffle_bytes += batch.device_size_bytes()
+                nbytes = batch.device_size_bytes()
+                QueryStats.get().shuffle_bytes += nbytes
+                tracing.mark(self.op_id, "shuffle:stage", "shuffle",
+                             bytes=nbytes, rows=batch.num_rows)
                 with m.time("opTime"):
                     arrays = tuple(
                         (c.data, c.valid) if isinstance(c, DeviceColumn)

@@ -94,7 +94,8 @@ class SortExec(TpuExec):
             # upstream decode/upload stages ahead while this run-sort's
             # XLA programs are in flight (depth 0 = serial)
             for batch in pipeline_batches(self.children[0].execute(ctx),
-                                          effective_depth(ctx)):
+                                          effective_depth(ctx),
+                                          label=self.op_id):
                 with m.time("opTime"):
                     for srt_b in with_retry(
                             ctx, batch,
@@ -259,7 +260,8 @@ class TopKExec(SortExec):
                 if b.num_rows > k else b
 
         for batch in pipeline_batches(self.children[0].execute(ctx),
-                                      effective_depth(ctx)):
+                                      effective_depth(ctx),
+                                      label=self.op_id):
             with m.time("opTime"):
                 for srt in with_retry(
                         ctx, batch,

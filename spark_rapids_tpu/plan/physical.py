@@ -57,6 +57,28 @@ class ExecContext:
         return self.metrics[op_id]
 
 
+def _instrument_execute(fn):
+    """Wrap a subclass's ``execute`` with the span layer: every batch pull
+    is timed on the thread it runs on (utils/tracing.instrument_batches),
+    recording uniform rows/batches/bytes/time per operator — the profiled
+    EXPLAIN and trace-export surface.  Applied at class-definition time by
+    ``TpuExec.__init_subclass__`` so no operator can opt out."""
+    import functools
+
+    from ..utils import tracing
+
+    @functools.wraps(fn)
+    def execute(self, ctx, *args, **kwargs):
+        it = fn(self, ctx, *args, **kwargs)
+        m = ctx.metric_set(self.op_id) if isinstance(ctx, ExecContext) \
+            else None
+        return tracing.instrument_batches(self.op_id, type(self).__name__,
+                                          m, it)
+
+    execute._span_instrumented = True
+    return execute
+
+
 class TpuExec:
     """Base physical operator."""
 
@@ -64,6 +86,12 @@ class TpuExec:
     # partition-id order (set by ShuffleExchangeExec; consumed by final
     # aggregates and shuffled joins)
     outputs_partitions = False
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        fn = cls.__dict__.get("execute")
+        if fn is not None and not getattr(fn, "_span_instrumented", False):
+            cls.execute = _instrument_execute(fn)
 
     def __init__(self, children: Sequence["TpuExec"] = ()):
         self.children = list(children)
@@ -181,7 +209,7 @@ class ScanExec(TpuExec):
             tables = source(prefetch_depth=max(4, 2 * depth))
         except TypeError:  # plain-callable sources (tests, exchanges)
             tables = source()
-        for b in pipeline_map(tables, _upload, depth):
+        for b in pipeline_map(tables, _upload, depth, label=self.op_id):
             b.origin_file = origin
             m.add("numOutputRows", b.num_rows)
             m.add("numOutputBatches", 1)
@@ -471,7 +499,8 @@ class StageExec(TpuExec):
         # upload (and any upstream dispatch) overlaps this stage's XLA
         # programs (depth 0 = the old lockstep pull loop)
         for batch in pipeline_batches(child.execute(ctx),
-                                      effective_depth(ctx)):
+                                      effective_depth(ctx),
+                                      label=self.op_id):
             with m.time("opTime"):
                 outs = list(with_retry(ctx, batch, run_one))
             if partitioned:
@@ -685,7 +714,8 @@ class AggregateExec(TpuExec):
         # dispatch (the fused path consumes the scan directly, so this
         # is its only pipelining point)
         for batch in pipeline_batches(child.execute(ctx),
-                                      effective_depth(ctx)):
+                                      effective_depth(ctx),
+                                      label=self.op_id):
             with m.time("opTime"):
                 for partials in with_retry(ctx, batch, run_one):
                     acc = partials if acc is None else merge_fn(acc, partials)
@@ -1568,7 +1598,8 @@ class AggregateExec(TpuExec):
                                             pipeline_batches)
             any_out = False
             for batch in pipeline_batches(child.execute(ctx),
-                                          effective_depth(ctx)):
+                                          effective_depth(ctx),
+                                          label=self.op_id):
                 with m.time("opTime"):
                     batch = self._encode_string_keys(batch, ctx)
                     arrays = tuple(
@@ -1603,7 +1634,8 @@ class AggregateExec(TpuExec):
         # pull the child ahead: upstream host work overlaps the per-batch
         # group/scatter programs (the dense paths' `rest` stream included)
         child_batches = pipeline_batches(child.execute(ctx),
-                                         effective_depth(ctx))
+                                         effective_depth(ctx),
+                                         label=self.op_id)
         if self._dense_agg_static_ok(ops, ctx.conf):
             peek = next(child_batches, None)
             if peek is None:

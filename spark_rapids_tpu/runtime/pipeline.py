@@ -76,7 +76,7 @@ def donation_supported() -> bool:
 
 
 def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
-                 depth: int) -> Iterator[U]:
+                 depth: int, label: str = None) -> Iterator[U]:
     """Yield ``fn(item)`` for each upstream item, staging up to ``depth``
     results ahead of the consumer on a worker thread.
 
@@ -84,18 +84,28 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
     surface at the consumer's next pull; abandoning the iterator (LIMIT,
     errors) stops the worker and closes the upstream generator without
     leaking the thread or its staged batches.
+
+    ``label`` names the consuming operator (its ``op_id``) so the stage/
+    wait intervals land in the query trace as that operator's pipeline
+    phases.  The worker runs in a COPY of the caller's context: it writes
+    into the caller's query-scoped QueryStats and its spans join the
+    caller's active trace.
     """
     if depth <= 0:
         for item in src:
             yield fn(item)
         return
 
+    import contextvars
+
+    from ..utils import tracing
     from ..utils.metrics import QueryStats
 
     slots = threading.Semaphore(depth)
     q: "queue.Queue" = queue.Queue()
     stop = threading.Event()
     it = iter(src)
+    cctx = contextvars.copy_context()
 
     def worker():
         try:
@@ -114,8 +124,9 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
                     q.put(_END)
                     return
                 out = fn(item)
-                QueryStats.get().pipeline_stage_s += \
-                    time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                QueryStats.get().pipeline_stage_s += dt
+                tracing.record(label, "pipeline:stage", "pipeline", t0, dt)
                 q.put(out)
         except BaseException as e:  # surfaced on the consumer side
             q.put(e)
@@ -127,7 +138,7 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
                 except BaseException:
                     pass
 
-    th = threading.Thread(target=worker, daemon=True,
+    th = threading.Thread(target=lambda: cctx.run(worker), daemon=True,
                           name="srt-pipeline-stage")
     th.start()
     try:
@@ -140,7 +151,9 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
                 slots.release()
             t0 = time.perf_counter()
             item = q.get()
-            QueryStats.get().h2d_wait_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            QueryStats.get().h2d_wait_s += dt
+            tracing.record(label, "pipeline:wait", "pipeline", t0, dt)
             if item is _END:
                 return
             if isinstance(item, BaseException):
@@ -151,8 +164,9 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
         stop.set()
 
 
-def pipeline_batches(batches: Iterable[T], depth: int) -> Iterator[T]:
+def pipeline_batches(batches: Iterable[T], depth: int,
+                     label: str = None) -> Iterator[T]:
     """Pull an operator's child iterator up to ``depth`` batches ahead:
     the child's host decode/upload/dispatch runs on the worker thread
     while the consumer's XLA program is in flight."""
-    return pipeline_map(batches, lambda b: b, depth)
+    return pipeline_map(batches, lambda b: b, depth, label=label)

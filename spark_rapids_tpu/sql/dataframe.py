@@ -368,10 +368,22 @@ class DataFrame:
         print(self.limit(n).to_pandas())
 
     def explain(self, mode: str = "formatted") -> None:
-        print(self.explain_string())
+        """Print the plan.  ``mode="profiled"`` EXECUTES the query and
+        re-renders the physical tree annotated with every operator's
+        accumulated metrics (rows/batches/bytes/time), the SQL-UI
+        per-operator metrics view analog."""
+        if mode == "profiled":
+            print(self.explain_profiled())
+        else:
+            print(self.explain_string())
 
     def explain_string(self) -> str:
         return self.session._explain(self._plan)
+
+    def explain_profiled(self) -> str:
+        """Execute this query and return the physical plan tree annotated
+        with each operator's accumulated metrics."""
+        return self.session._explain_profiled(self._plan)
 
 
 def _split_count_distinct(agg_exprs):

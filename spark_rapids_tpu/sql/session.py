@@ -291,6 +291,56 @@ class Session:
         plan = resolve_subqueries(plan, self._collect_rows)
         return self._execute_resolved(plan)
 
+    # -- query tracing ------------------------------------------------------------
+    _query_seq = 0
+
+    def _trace_scope(self, conf):
+        """The per-query observability scope: query-scoped QueryStats
+        (contextvars — concurrent queries never cross-account) plus, when
+        ``sql.trace.enabled``, an active QueryTrace for the span tree."""
+        from ..utils import tracing
+        Session._query_seq += 1
+        label = f"query-{Session._query_seq:04d}"
+        return tracing.query_trace(
+            label,
+            enabled=conf["spark.rapids.tpu.sql.trace.enabled"],
+            max_events=conf["spark.rapids.tpu.sql.trace.maxEvents"])
+
+    def _finish_trace(self, tr, ctx, stats) -> None:
+        if tr is None:
+            return
+        tr.finish(metrics=ctx.metrics, stats=stats.snapshot())
+        self._last_trace = tr
+        conf = ctx.conf
+        trace_dir = conf["spark.rapids.tpu.sql.trace.dir"]
+        if trace_dir:
+            import os
+            os.makedirs(trace_dir, exist_ok=True)
+            tr.write(os.path.join(trace_dir, f"{tr.label}.trace.json"))
+
+    def last_trace(self):
+        """The QueryTrace of the most recent traced execution (None until
+        a query runs with spark.rapids.tpu.sql.trace.enabled=true)."""
+        return getattr(self, "_last_trace", None)
+
+    def profiled_explain(self) -> str:
+        """The most recent query's physical plan re-rendered with each
+        operator's accumulated metrics (rows/batches/bytes/time + the
+        operator's own counters) — the SQL-UI metrics view analog."""
+        from ..utils import tracing
+        phys = getattr(self, "_last_phys", None)
+        ctx = getattr(self, "_last_ctx", None)
+        if phys is None or ctx is None:
+            return "<no query has executed in this session>"
+        return tracing.render_profiled(phys, ctx.metrics)
+
+    def _explain_profiled(self, plan: L.LogicalPlan) -> str:
+        """Execute the plan, then render the profiled physical tree
+        (df.explain('profiled'))."""
+        self._execute(plan)
+        return self.profiled_explain()
+
+    # -- execution entry points ---------------------------------------------------
     def _execute_device(self, plan: L.LogicalPlan):
         """Execute to ONE compacted device-resident batch (no host round
         trip) — the zero-copy export pipeline (DataFrame.to_device_arrays).
@@ -301,31 +351,46 @@ class Session:
         from ..plan.physical import ExecContext
         from ..plan.subquery import resolve_subqueries
         from ..runtime.semaphore import get_semaphore
+        from ..utils.metrics import QueryStats
         plan = resolve_subqueries(plan, self._collect_rows)
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
-        with get_semaphore(conf).acquire():
-            phys = self._distribute_if_ici(phys, ctx)
-            batches = [b for b in phys.execute(ctx) if b.num_rows > 0]
-            if not batches:
-                return None
-            whole = batches[0] if len(batches) == 1 else \
-                batch_utils.concat_batches(batches)
-            return batch_utils.compact(whole)
+        with QueryStats.scoped() as stats, self._trace_scope(conf) as tr:
+            with get_semaphore(conf).acquire():
+                phys = self._distribute_if_ici(phys, ctx)
+                if tr is not None:
+                    tr.register_plan(phys)
+                batches = [b for b in phys.execute(ctx) if b.num_rows > 0]
+                if not batches:
+                    out = None
+                else:
+                    whole = batches[0] if len(batches) == 1 else \
+                        batch_utils.concat_batches(batches)
+                    out = batch_utils.compact(whole)
+            self._finish_trace(tr, ctx, stats)
+            return out
 
     def _execute_resolved(self, plan: L.LogicalPlan):
         from ..runtime.semaphore import get_semaphore
+        from ..utils.metrics import QueryStats
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
         # expose the last query's per-operator metrics + plan for
-        # debugging/profiling (sess.last_exec_context().metrics)
+        # debugging/profiling (sess.last_exec_context().metrics,
+        # sess.profiled_explain())
         self._last_ctx = ctx
         self._last_phys = phys
-        with get_semaphore(conf).acquire():
-            phys = self._distribute_if_ici(phys, ctx)
-            return CollectExec(phys).collect_arrow(ctx)
+        with QueryStats.scoped() as stats, self._trace_scope(conf) as tr:
+            with get_semaphore(conf).acquire():
+                phys = self._distribute_if_ici(phys, ctx)
+                self._last_phys = phys
+                if tr is not None:
+                    tr.register_plan(phys)
+                out = CollectExec(phys).collect_arrow(ctx)
+            self._finish_trace(tr, ctx, stats)
+            return out
 
     def last_exec_context(self):
         """ExecContext of the most recent collect (per-operator MetricSet
@@ -337,13 +402,18 @@ class Session:
         the write path's entry so results never materialize wholesale."""
         from ..batch import to_arrow
         from ..runtime.semaphore import get_semaphore
+        from ..utils.metrics import QueryStats
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
-        with get_semaphore(conf).acquire():
-            phys = self._distribute_if_ici(phys, ctx)
-            for b in phys.execute(ctx):
-                yield to_arrow(b)
+        with QueryStats.scoped() as stats, self._trace_scope(conf) as tr:
+            with get_semaphore(conf).acquire():
+                phys = self._distribute_if_ici(phys, ctx)
+                if tr is not None:
+                    tr.register_plan(phys)
+                for b in phys.execute(ctx):
+                    yield to_arrow(b)
+            self._finish_trace(tr, ctx, stats)
 
     def _explain(self, plan: L.LogicalPlan) -> str:
         from ..plan.overrides import explain_plan

@@ -10,19 +10,31 @@ ranges land in XLA/TPU profiler timelines.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import time
 from collections import defaultdict
 from typing import Dict
 
 import jax
 
+from . import tracing
+
 __all__ = ["MetricSet", "TaskMetrics", "QueryStats", "trace_range",
            "fetch", "fetch_async", "fetch_scalars", "prestage",
            "sync_budget", "FetchFuture"]
 
 
+# the stack of query-scoped QueryStats instances for this context;
+# contextvars (not a process global) so two concurrent queries — or a
+# bench run alongside a test — never cross-account fetches/compiles.
+# Worker threads (runtime/pipeline, io prefetch) run in a copied context
+# and therefore write into their query's scope.
+_STATS_STACK: "contextvars.ContextVar[tuple]" = \
+    contextvars.ContextVar("srt_query_stats", default=())
+
+
 class QueryStats:
-    """Process-global sync/compile profile (VERDICT r4 item 2).
+    """Sync/compile profile (VERDICT r4 item 2), query-scoped.
 
     The reference's per-query NVTX + SQL-metric story answers "where did
     the time go"; on a remote-tunneled TPU the two questions that matter
@@ -35,9 +47,16 @@ class QueryStats:
 
     ``bench.py`` snapshots this around each timed run and emits the
     deltas in the per-query JSON.
+
+    Scoping: :meth:`get` resolves the innermost active :meth:`scoped`
+    instance (the running query's), falling back to the process-level
+    aggregate.  When a scope exits, its counts fold into the enclosing
+    scope — ultimately the process aggregate, which therefore keeps the
+    cumulative totals existing callers (bench deltas, sync-budget tests)
+    rely on.
     """
 
-    _current: "QueryStats" = None
+    _process: "QueryStats" = None
     _listener_installed = False
 
     def __init__(self):
@@ -67,13 +86,59 @@ class QueryStats:
         # stage program (HBM reuse; plan/physical.StageExec)
         self.donated_batches = 0
 
-    # -- global accessors ---------------------------------------------------
+    # -- accessors ----------------------------------------------------------
     @classmethod
     def get(cls) -> "QueryStats":
-        if cls._current is None:
-            cls._current = QueryStats()
+        """The stats of the innermost active query scope, or the process
+        aggregate when no scope is active."""
+        stack = _STATS_STACK.get()
+        if stack:
+            return stack[-1]
+        return cls.process()
+
+    @classmethod
+    def process(cls) -> "QueryStats":
+        """The process-level aggregate (backward-compatible totals)."""
+        if cls._process is None:
+            cls._process = QueryStats()
             cls._install_listener()
-        return cls._current
+        return cls._process
+
+    @classmethod
+    @contextlib.contextmanager
+    def scoped(cls):
+        """Open a query-scoped stats instance for this context.  Yields
+        the fresh instance; on exit its counts fold into the enclosing
+        scope (ultimately the process aggregate)."""
+        cls.process()  # aggregate + compile listener exist first
+        s = QueryStats()
+        tok = _STATS_STACK.set(_STATS_STACK.get() + (s,))
+        try:
+            yield s
+        finally:
+            try:
+                _STATS_STACK.reset(tok)
+            except ValueError:
+                # interleaved streaming executions can violate token
+                # LIFO (generator-held scopes): drop just this entry
+                _STATS_STACK.set(tuple(
+                    x for x in _STATS_STACK.get() if x is not s))
+            cls.get()._absorb(s)
+
+    def _absorb(self, other: "QueryStats") -> None:
+        for k, v in other.__dict__.items():
+            setattr(self, k, getattr(self, k, 0) + v)
+
+    @classmethod
+    def total_blocking_fetches(cls) -> int:
+        """Cumulative blocking fetches across the process aggregate AND
+        every open scope — the sync-budget denominator (a budget spanning
+        multiple queries must see fetches already folded out of their
+        scopes plus the in-flight scope's)."""
+        n = cls.process().blocking_fetches
+        for s in _STATS_STACK.get():
+            n += s.blocking_fetches
+        return n
 
     @classmethod
     def _install_listener(cls):
@@ -82,10 +147,12 @@ class QueryStats:
         cls._listener_installed = True
 
         def on_duration(event: str, duration: float, **kw):
-            if event == "/jax/core/compile/backend_compile_duration" \
-                    and cls._current is not None:
-                cls._current.compiles += 1
-                cls._current.compile_s += duration
+            if event == "/jax/core/compile/backend_compile_duration":
+                s = cls.get()
+                s.compiles += 1
+                s.compile_s += duration
+                tracing.record(None, "compile", "compile",
+                               time.perf_counter() - duration, duration)
 
         jax.monitoring.register_event_duration_secs_listener(on_duration)
 
@@ -111,6 +178,23 @@ import os as _os
 
 _TRACE_SYNCS = bool(_os.environ.get("SRT_SYNC_TRACE"))
 SYNC_TRACE: list = []  # [(call-site, seconds)] when SRT_SYNC_TRACE is set
+# hard cap on the debug list: a long bench/serve run under SRT_SYNC_TRACE
+# must not grow host memory without bound — entries beyond the cap are
+# counted, not stored (sync_trace_dropped()).
+SYNC_TRACE_MAX = int(_os.environ.get("SRT_SYNC_TRACE_MAX", "10000"))
+_SYNC_TRACE_DROPPED = [0]
+
+
+def sync_trace_dropped() -> int:
+    """Entries dropped from SYNC_TRACE after it hit SYNC_TRACE_MAX."""
+    return _SYNC_TRACE_DROPPED[0]
+
+
+def _sync_trace_append(entry) -> None:
+    if len(SYNC_TRACE) < SYNC_TRACE_MAX:
+        SYNC_TRACE.append(entry)
+    else:
+        _SYNC_TRACE_DROPPED[0] += 1
 
 
 def _tree_nbytes(host) -> int:
@@ -140,12 +224,15 @@ def _resolve_tree(tree, site=None, tag: str = ""):
     t0 = time.perf_counter()
     host = jax.device_get(tree)
     dt = time.perf_counter() - t0
+    nbytes = _tree_nbytes(host)
     s.fetch_wait_s += dt
-    s.fetch_bytes += _tree_nbytes(host)
+    s.fetch_bytes += nbytes
+    tracing.record(None, "fetch", "fetch", t0, dt,
+                   bytes=nbytes, blocking=not tag)
     if _TRACE_SYNCS:
         if site is None:
             site = _call_site(extra_frames=1)
-        SYNC_TRACE.append(((tag + site) if tag else site, round(dt, 4)))
+        _sync_trace_append(((tag + site) if tag else site, round(dt, 4)))
     return host
 
 
@@ -236,7 +323,9 @@ class _SyncBudget:
 
 def _check_budget():
     if _SyncBudget.limit is not None:
-        n = QueryStats.get().blocking_fetches
+        # cumulative across the process aggregate + open query scopes: a
+        # budget wrapping several queries keeps counting across them
+        n = QueryStats.total_blocking_fetches()
         if n > _SyncBudget.limit:
             raise AssertionError(
                 f"sync budget exceeded in {_SyncBudget.label}: "
@@ -292,6 +381,11 @@ class MetricSet:
 
     @contextlib.contextmanager
     def time(self, name: str):
+        """Time a named phase of this operator.  This is the span API for
+        exec-node timing (tools/check_span_timing.py rejects raw clock
+        reads in the operator layer): the measurement lands in the metric
+        value AND — when a query trace is active — as a phase span under
+        the operator (decode/H2D/dispatch/fetch attribution)."""
         if self.level == "ESSENTIAL":
             yield
             return
@@ -301,7 +395,9 @@ class MetricSet:
                 yield
         else:
             yield
-        self.values[name] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.values[name] += dt
+        tracing.record(self.op_id, name, "phase", t0, dt)
 
     def __getitem__(self, name: str) -> float:
         self._resolve()

@@ -1,0 +1,402 @@
+"""Query-scoped structured tracing: per-operator span trees.
+
+The reference answers "where did the time go" with per-operator
+``GpuMetric``s rendered in the Spark SQL UI plus NVTX ranges on the GPU
+profiler timeline (SURVEY.md §5.1).  This module is the port's version of
+that two-tier story, rebuilt for an engine whose wall time is a weave of
+overlapped decode / H2D staging / dispatch / D2H phases (runtime/pipeline):
+
+  * one **operator span** per physical plan node (keyed by the node's
+    ``op_id``), forming a tree that mirrors the plan — every batch pull
+    through an operator is timed and recorded on the thread it ran on;
+  * **phase spans** under each operator for the engine's data-movement
+    phases: decode (io layer), H2D staging (``scanTime``), dispatch
+    (``opTime``), pipeline stage/wait (runtime/pipeline), and D2H fetch
+    (utils/metrics ``fetch``/``fetch_async``) — today's ``trace_range``
+    and ``QueryStats`` accounting absorbed into span attributes;
+  * a **Chrome-trace-event JSON exporter** (loads in Perfetto /
+    ``chrome://tracing``) so a query's overlap structure is visually
+    inspectable, plus a ``spanTree`` extension key carrying the
+    plan-shaped tree with per-operator accumulated metrics.
+
+Everything is contextvar-scoped: two concurrent queries trace
+independently, and the pipeline/io worker threads join their query's
+trace by running in a copied context.  When no trace is active every
+entry point is a single ContextVar read returning a no-op — the
+tracing-off path adds no allocation to the pull loop.
+
+This module is the ONE place exec-node timing may read the clock;
+``tools/check_span_timing.py`` rejects raw ``time.perf_counter()`` in the
+plan/parallel layers so attribution cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+__all__ = ["QueryTrace", "active", "query_trace", "span", "record", "mark",
+           "instrument_batches", "render_profiled", "NULL_SPAN"]
+
+_pc = time.perf_counter
+
+_ACTIVE: "contextvars.ContextVar[Optional[QueryTrace]]" = \
+    contextvars.ContextVar("srt_active_trace", default=None)
+
+DEFAULT_MAX_EVENTS = 100_000
+
+
+class _NullSpan:
+    """No-op span: the tracing-off fast path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """A live timed span; records one event on exit."""
+
+    __slots__ = ("_op", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, op_id, name, cat):
+        self._op = op_id
+        self._name = name
+        self._cat = cat
+        self._args = None
+
+    def set(self, **attrs):
+        if self._args is None:
+            self._args = {}
+        self._args.update(attrs)
+        return self
+
+    def __enter__(self):
+        self._t0 = _pc()
+        return self
+
+    def __exit__(self, *exc):
+        tr = _ACTIVE.get()
+        if tr is not None:
+            tr.add_event(self._op, self._name, self._cat, self._t0,
+                         _pc() - self._t0, self._args)
+        return False
+
+
+class QueryTrace:
+    """The span tree + flat event log of one query execution.
+
+    Operator structure comes from :meth:`register_plan` (one span node per
+    physical plan node, children mirroring the plan); timed events arrive
+    through :meth:`add_event` from any thread.  ``finish`` folds the
+    query's accumulated per-operator :class:`..utils.metrics.MetricSet`
+    values and the query-scoped ``QueryStats`` snapshot into the tree.
+    """
+
+    def __init__(self, label: str, max_events: int = DEFAULT_MAX_EVENTS):
+        self.label = label
+        self.t0 = _pc()
+        self.wall_start = time.time()
+        self.t_end: Optional[float] = None
+        self.max_events = max_events
+        self.dropped = 0
+        # flat event log: (op_id, name, cat, rel_t0_s, dur_s, tid, args)
+        self.events: List[tuple] = []
+        self.ops: Dict[str, dict] = {}
+        self.roots: List[dict] = []
+        self.attrs: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._tids: Dict[int, tuple] = {}  # thread ident -> (tid, name)
+
+    # -- structure ----------------------------------------------------------------
+    def register_plan(self, root) -> None:
+        """Build the span tree from a physical plan: one node per operator,
+        children mirroring the plan tree."""
+        def walk(node, parent):
+            entry = {"op_id": node.op_id, "name": type(node).__name__,
+                     "desc": node.node_desc(), "children": [],
+                     "metrics": {}}
+            self.ops[node.op_id] = entry
+            (self.roots if parent is None
+             else parent["children"]).append(entry)
+            for c in getattr(node, "children", ()):
+                walk(c, entry)
+        walk(root, None)
+
+    def _ensure_op(self, op_id: str, name: str) -> dict:
+        """Late registration for operators created at runtime (AQE
+        re-plans, staged join inputs): they attach at the root, flagged."""
+        entry = self.ops.get(op_id)
+        if entry is None:
+            entry = {"op_id": op_id, "name": name, "desc": name,
+                     "children": [], "metrics": {}, "runtime": True}
+            with self._lock:
+                self.ops[op_id] = entry
+                self.roots.append(entry)
+        return entry
+
+    # -- events -------------------------------------------------------------------
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        e = self._tids.get(ident)
+        if e is None:
+            with self._lock:
+                e = self._tids.get(ident)
+                if e is None:
+                    e = (len(self._tids) + 1,
+                         threading.current_thread().name)
+                    self._tids[ident] = e
+        return e[0]
+
+    def add_event(self, op_id, name, cat, t0, dur, args=None) -> None:
+        if len(self.events) >= self.max_events:
+            self.dropped += 1
+            return
+        self.events.append((op_id, name, cat, max(0.0, t0 - self.t0),
+                            max(0.0, dur), self._tid(), args))
+
+    # -- lifecycle ----------------------------------------------------------------
+    @property
+    def duration_s(self) -> float:
+        return (self.t_end if self.t_end is not None else _pc()) - self.t0
+
+    def finish(self, metrics: Optional[dict] = None,
+               stats: Optional[dict] = None) -> None:
+        """Close the clock and absorb the query's accumulated accounting:
+        per-operator MetricSet values become span attributes; the
+        query-scoped QueryStats snapshot becomes root attributes."""
+        if self.t_end is None:
+            self.t_end = _pc()
+        if stats:
+            self.attrs.update(stats)
+        for op_id, mset in (metrics or {}).items():
+            entry = self._ensure_op(op_id, op_id.split("@", 1)[0])
+            try:
+                mset._resolve()  # deferred device counters land on host
+            except Exception:
+                pass
+            entry["metrics"].update(
+                {k: (round(v, 6) if isinstance(v, float) else v)
+                 for k, v in mset.values.items()})
+
+    # -- export -------------------------------------------------------------------
+    def to_chrome(self) -> dict:
+        """Chrome trace event format (Perfetto / chrome://tracing), with a
+        ``spanTree`` extension key carrying the plan-shaped span tree."""
+        pid = 1
+        evs: List[dict] = [
+            {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+             "args": {"name": f"spark_rapids_tpu {self.label}"}},
+            {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
+             "args": {"name": "query"}},
+        ]
+        for tid, tname in sorted(self._tids.values()):
+            evs.append({"ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_name", "args": {"name": tname}})
+        evs.append({"ph": "X", "pid": pid, "tid": 0, "name": self.label,
+                    "cat": "query", "ts": 0.0,
+                    "dur": round(self.duration_s * 1e6, 1),
+                    "args": dict(sorted(self.attrs.items()))})
+        for op_id, name, cat, ts, dur, tid, args in self.events:
+            a = {"op": op_id} if op_id else {}
+            if args:
+                a.update(args)
+            evs.append({"ph": "X", "pid": pid, "tid": tid, "name": name,
+                        "cat": cat, "ts": round(ts * 1e6, 1),
+                        "dur": round(dur * 1e6, 1), "args": a})
+        return {
+            "traceEvents": evs,
+            "displayTimeUnit": "ms",
+            "otherData": {"label": self.label,
+                          "dropped_events": self.dropped,
+                          "wall_s": round(self.duration_s, 6),
+                          "wall_start_epoch_s": round(self.wall_start, 3)},
+            "spanTree": self.roots,
+        }
+
+    def write(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f)
+        return path
+
+
+# ---------------------------------------------------------------------------------
+# Module-level API: the engine's one tracing entry surface.
+# ---------------------------------------------------------------------------------
+
+def active() -> Optional[QueryTrace]:
+    return _ACTIVE.get()
+
+
+@contextlib.contextmanager
+def query_trace(label: str, enabled: bool = True,
+                max_events: int = DEFAULT_MAX_EVENTS):
+    """Activate a query trace for the scope (contextvar-carried, so worker
+    threads running a copied context join it).  ``enabled=False`` — or an
+    already-active trace (a nested sub-execution) — yields None and the
+    scope is a pure pass-through."""
+    if not enabled or _ACTIVE.get() is not None:
+        yield None
+        return
+    tr = QueryTrace(label, max_events=max_events)
+    tok = _ACTIVE.set(tr)
+    try:
+        yield tr
+    finally:
+        try:
+            _ACTIVE.reset(tok)
+        except ValueError:
+            # interleaved streaming executions can violate token LIFO
+            # (generator-held scopes); clearing is the safe fallback
+            _ACTIVE.set(None)
+        if tr.t_end is None:
+            tr.t_end = _pc()
+
+
+def span(op_id: Optional[str], name: str, cat: str = "phase"):
+    """A timed span context manager, attributed to ``op_id`` (None for
+    query-level work).  Returns the shared no-op span when no trace is
+    active — the off path is one ContextVar read."""
+    if _ACTIVE.get() is None:
+        return NULL_SPAN
+    return _Span(op_id, name, cat)
+
+
+def record(op_id: Optional[str], name: str, cat: str, t0: float,
+           dur: float, **args) -> None:
+    """Record an already-measured interval (perf_counter timebase) —
+    for call sites that must time regardless of tracing (QueryStats
+    accounting) and should not read the clock twice."""
+    tr = _ACTIVE.get()
+    if tr is not None:
+        tr.add_event(op_id, name, cat, t0, dur, args or None)
+
+
+def mark(op_id: Optional[str], name: str, cat: str = "mark",
+         **args) -> None:
+    """Record an instant event (zero duration) with attributes."""
+    tr = _ACTIVE.get()
+    if tr is not None:
+        tr.add_event(op_id, name, cat, _pc(), 0.0, args or None)
+
+
+# ---------------------------------------------------------------------------------
+# Operator instrumentation: every TpuExec.execute is routed through here
+# (plan/physical.py wraps subclasses at class-definition time).
+# ---------------------------------------------------------------------------------
+
+def instrument_batches(op_id: str, op_name: str, metrics,
+                       it: Iterator) -> Iterator:
+    """Wrap an operator's batch iterator: each pull is timed on the thread
+    it runs on (operator span when a trace is active) and uniform
+    ``outputRows`` / ``outputBatches`` / ``outputBytes`` / ``produceTimeS``
+    counters accumulate into the operator's MetricSet — the profiled
+    EXPLAIN surface, populated for EVERY operator with no opt-out."""
+    try:
+        while True:
+            t0 = _pc()
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            dt = _pc() - t0
+            rows = getattr(b, "num_rows", 0)
+            if metrics is not None:
+                v = metrics.values
+                v["outputRows"] += rows
+                v["outputBatches"] += 1
+                size_fn = getattr(b, "device_size_bytes", None)
+                if size_fn is not None:
+                    v["outputBytes"] += size_fn()
+                v["produceTimeS"] += dt
+            tr = _ACTIVE.get()
+            if tr is not None:
+                tr.add_event(op_id, op_name, "operator", t0, dt,
+                             {"rows": rows})
+            yield b
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
+
+
+# ---------------------------------------------------------------------------------
+# Profiled EXPLAIN: the plan tree re-rendered with accumulated metrics
+# (the reference's SQL-UI per-operator metrics view analog).
+# ---------------------------------------------------------------------------------
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _fmt_metric(name: str, v) -> str:
+    if isinstance(v, float):
+        if name.lower().endswith(("time", "times", "_s", "wait_s")) \
+                or "Time" in name:
+            return f"{v * 1e3:.1f}ms"
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render_profiled(root, metrics: Dict[str, object]) -> str:
+    """Render the physical plan tree annotated with each operator's
+    accumulated metrics.  Every node gets a metrics line — rows, bytes,
+    batches and wall time come from the span instrumentation, followed by
+    the operator's own counters/timers."""
+    lines: List[str] = []
+    seen = set()
+
+    def node_metrics_line(op_id: str) -> str:
+        mset = metrics.get(op_id)
+        if mset is None:
+            return "rows=0 batches=0 bytes=0B time=0.0ms (not executed)"
+        try:
+            mset._resolve()
+        except Exception:
+            pass
+        v = dict(mset.values)
+        rows = int(v.pop("outputRows", 0))
+        batches = int(v.pop("outputBatches", 0))
+        nbytes = v.pop("outputBytes", 0.0)
+        t = v.pop("produceTimeS", 0.0)
+        head = (f"rows={rows} batches={batches} "
+                f"bytes={_fmt_bytes(nbytes)} time={t * 1e3:.1f}ms")
+        rest = " ".join(f"{k}={_fmt_metric(k, val)}"
+                        for k, val in sorted(v.items()))
+        return head + ((" | " + rest) if rest else "")
+
+    def walk(node, indent):
+        seen.add(node.op_id)
+        pad = "  " * indent
+        lines.append(pad + ("+- " if indent else "") + node.node_desc())
+        lines.append(pad + ("|    " if indent else "  ")
+                     + node_metrics_line(node.op_id))
+        for c in node.children:
+            walk(c, indent + 1)
+
+    walk(root, 0)
+    extras = [op for op in metrics if op not in seen]
+    if extras:
+        lines.append("runtime operators (created during execution):")
+        for op in sorted(extras):
+            lines.append(f"  {op}: {node_metrics_line(op)}")
+    return "\n".join(lines)

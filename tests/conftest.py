@@ -52,9 +52,12 @@ def rng():
 
 
 def pytest_collection_modifyitems(config, items):
-    """Collection-time lint: a raw jax.device_get / np.asarray(<col>.data)
-    in the operator layer dodges the metrics choke point and silently
-    corrupts the sync profile — fail the run before any test executes."""
+    """Collection-time lints: (a) a raw jax.device_get / np.asarray(
+    <col>.data) in the operator layer dodges the metrics choke point and
+    silently corrupts the sync profile; (b) a raw clock read in the
+    exec-node layer bypasses the span API, so profiled EXPLAIN and the
+    trace export silently lose that time — fail the run before any test
+    executes."""
     from tools.check_blocking_fetch import check
     violations = check()
     if violations:
@@ -63,3 +66,12 @@ def pytest_collection_modifyitems(config, items):
         raise pytest.UsageError(
             "raw device->host transfers outside utils.metrics.fetch/"
             f"fetch_async (tools/check_blocking_fetch.py):\n{lines}")
+    from tools.check_span_timing import check as check_timing
+    violations = check_timing()
+    if violations:
+        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
+                          for rel, ln, src in violations)
+        raise pytest.UsageError(
+            "raw clock reads bypassing the span API — use MetricSet.time"
+            " or utils.tracing.span (tools/check_span_timing.py):\n"
+            f"{lines}")

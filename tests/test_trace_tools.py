@@ -1,0 +1,160 @@
+"""tools/trace_report.py + tools/bench_compare.py + span-timing lint."""
+
+import json
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+from tools import bench_compare, trace_report
+from tools.check_span_timing import check as check_span_timing
+
+
+@pytest.fixture()
+def sess():
+    s = srt.Session.get_or_create()
+    yield s
+    s.conf.unset("spark.rapids.tpu.sql.trace.enabled")
+
+
+def _trace_file(sess, tmp_path):
+    rng = np.random.default_rng(3)
+    df = sess.create_dataframe({"k": rng.integers(0, 50, 30000),
+                                "v": rng.random(30000)})
+    q = (df.where(F.col("v") > 0.2)
+         .group_by((F.col("k") % 7).cast("int").alias("g"))
+         .agg(F.sum(F.col("v")).alias("s")))
+    sess.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+    try:
+        q.collect()
+    finally:
+        sess.conf.unset("spark.rapids.tpu.sql.trace.enabled")
+    path = str(tmp_path / "q.trace.json")
+    sess.last_trace().write(path)
+    return path
+
+
+# ---------------------------------------------------------------------------------
+# trace_report
+# ---------------------------------------------------------------------------------
+
+def test_trace_report_hot_operators_and_overlap(sess, tmp_path):
+    path = _trace_file(sess, tmp_path)
+    a = trace_report.analyze(trace_report.load(path))
+    assert a["wall_s"] > 0
+    assert a["operators"], "no per-operator rows"
+    # per-operator self time is positive and sums to <= ~wall (nesting
+    # subtracts children; on the serial CPU path nothing double-counts)
+    assert a["self_total_s"] > 0
+    assert a["self_total_s"] <= a["wall_s"] * 1.1
+    # self-time accounts for the bulk of the query wall time
+    assert a["self_coverage"] > 0.5
+    assert a["blocking_fetches"] >= 1
+    assert 0 < a["overlap_ratio"] <= 4.0
+    out = trace_report.format_report(a)
+    assert "hot operators" in out
+    assert "blocking fetches:" in out
+    assert "overlap:" in out
+    assert "TpuScan" in out or "ScanExec" in out
+
+
+def test_trace_report_main(sess, tmp_path, capsys):
+    path = _trace_file(sess, tmp_path)
+    assert trace_report.main([path]) == 0
+    assert "hot operators" in capsys.readouterr().out
+    assert trace_report.main([]) == 2
+
+
+# ---------------------------------------------------------------------------------
+# bench_compare
+# ---------------------------------------------------------------------------------
+
+def _bench(value, **queries):
+    agg = {"metric": "tpch22_tpcds22_geomean_speedup_vs_cpu",
+           "value": value, "unit": "x"}
+    agg.update(queries)
+    return agg
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def test_bench_compare_ok(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", _bench(
+        4.0, q1={"engine_s": 1.0}, q6={"engine_s": 0.5}))
+    new = _write(tmp_path, "new.json", _bench(
+        4.1, q1={"engine_s": 1.05}, q6={"engine_s": 0.45}))
+    assert bench_compare.main([old, new]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_bench_compare_query_regression(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", _bench(4.0, q1={"engine_s": 1.0}))
+    new = _write(tmp_path, "new.json", _bench(4.0, q1={"engine_s": 1.5}))
+    assert bench_compare.main([old, new]) == 1
+    assert "REGRESSION" in capsys.readouterr().err
+
+
+def test_bench_compare_aggregate_regression(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", _bench(4.0, q1={"engine_s": 1.0}))
+    new = _write(tmp_path, "new.json", _bench(3.0, q1={"engine_s": 1.0}))
+    assert bench_compare.main([old, new]) == 1
+    err = capsys.readouterr().err
+    assert "aggregate geomean" in err
+
+
+def test_bench_compare_errored_query_is_regression(tmp_path):
+    old = _write(tmp_path, "old.json", _bench(4.0, q1={"engine_s": 1.0}))
+    new = _write(tmp_path, "new.json", _bench(
+        4.0, q1={"error": "timeout after 300s"}))
+    assert bench_compare.main([old, new]) == 1
+
+
+def test_bench_compare_thresholds_and_driver_wrapper(tmp_path):
+    # 30% slower passes with a 50% threshold
+    old = _write(tmp_path, "old.json", _bench(4.0, q1={"engine_s": 1.0}))
+    new_obj = _bench(4.0, q1={"engine_s": 1.3})
+    new = _write(tmp_path, "new.json", new_obj)
+    assert bench_compare.main(
+        [old, new, "--max-query-regress-pct", "50"]) == 0
+    # the BENCH_r0N driver capture shape: {"parsed": {...}} and
+    # {"tail": "...\n<json line>"}
+    wrapped = _write(tmp_path, "wrapped.json",
+                     {"rc": 0, "parsed": new_obj})
+    tail = _write(tmp_path, "tail.json",
+                  {"rc": 124, "parsed": None,
+                   "tail": "noise\n" + json.dumps(new_obj)})
+    assert bench_compare.main(
+        [old, wrapped, "--max-query-regress-pct", "50"]) == 0
+    assert bench_compare.main(
+        [old, tail, "--max-query-regress-pct", "50"]) == 0
+
+
+def test_bench_compare_bad_file(tmp_path):
+    bad = _write(tmp_path, "bad.json", {"nothing": True})
+    ok = _write(tmp_path, "ok.json", _bench(4.0))
+    assert bench_compare.main([bad, ok]) == 2
+
+
+# ---------------------------------------------------------------------------------
+# span-timing lint
+# ---------------------------------------------------------------------------------
+
+def test_span_timing_lint_clean_and_detects(tmp_path):
+    assert check_span_timing() == []
+    # a synthetic violation is caught
+    pkg = tmp_path / "pkg"
+    (pkg / "plan").mkdir(parents=True)
+    (pkg / "parallel").mkdir()
+    (pkg / "plan" / "bad.py").write_text(
+        "import time\n"
+        "t0 = time.perf_counter()\n"
+        "ok = time.monotonic()  # span-api-ok\n")
+    violations = check_span_timing(str(pkg))
+    assert len(violations) == 1
+    assert violations[0][1] == 2

@@ -1,0 +1,77 @@
+"""Lint: exec-node timing must go through the span API.
+
+The query trace (utils/tracing.py) is the engine's single attribution
+spine: every timed interval in the operator layer must come from
+``MetricSet.time(...)``, ``tracing.span(...)``, or ``tracing.record``
+with a clock value the span layer handed out — otherwise profiled
+EXPLAIN and the Chrome-trace export silently lose that time and the
+per-operator story rots.  This check greps the exec-node layer
+(``plan/``, ``parallel/``) for raw clock reads:
+
+  * ``time.perf_counter()`` / ``time.monotonic()`` / ``time.time()``
+
+Infrastructure that IS the span layer lives in ``utils/`` and
+``runtime/`` and may read the clock; the io layer's decode threads time
+through ``tracing.span``.  Lines carrying an explicit ``# span-api-ok``
+comment are exempt (for a provably non-timing use, e.g. a seed).
+
+Run standalone (``python tools/check_span_timing.py``, exit 1 on
+violations) or let the test suite run it: tests/conftest.py invokes
+:func:`check` at collection time alongside check_blocking_fetch.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_rapids_tpu")
+
+# the exec-node layer: operators and the distributed drivers
+TIMED_DIRS = ("plan", "parallel")
+
+_RAW_CLOCK = re.compile(r"\btime\.(?:perf_counter|monotonic|time)\s*\(")
+_EXEMPT = "# span-api-ok"
+
+
+def check(root: str = PKG) -> List[Tuple[str, int, str]]:
+    """Return [(relpath, lineno, line)] raw clock reads in the layer."""
+    violations: List[Tuple[str, int, str]] = []
+    for sub in TIMED_DIRS:
+        base = os.path.join(root, sub)
+        for dirpath, _dirs, files in os.walk(base):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path, encoding="utf-8") as f:
+                    for lineno, line in enumerate(f, 1):
+                        if _EXEMPT in line:
+                            continue
+                        if _RAW_CLOCK.search(line):
+                            violations.append(
+                                (os.path.relpath(path, root), lineno,
+                                 line.strip()))
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if not violations:
+        print("check_span_timing: exec-node layer clean")
+        return 0
+    print("check_span_timing: raw clock reads in the exec-node layer "
+          "bypass the span API:", file=sys.stderr)
+    for rel, lineno, line in violations:
+        print(f"  spark_rapids_tpu/{rel}:{lineno}: {line}", file=sys.stderr)
+    print("time operator work through MetricSet.time(...) or "
+          "utils.tracing.span(...) so it lands in profiled EXPLAIN and "
+          "the trace export.", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

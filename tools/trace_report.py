@@ -1,0 +1,193 @@
+"""Summarize a query trace file (Chrome trace events + spanTree).
+
+Reads a trace written by the engine (``spark.rapids.tpu.sql.trace.dir``,
+``SRT_BENCH_TRACE_DIR``, or ``Session.last_trace().write(...)``) and
+prints:
+
+  * the hot-operator table: per-operator SELF time (operator interval
+    minus nested child-operator intervals on the same thread), total
+    time, rows, and batches — self time sums to ~query wall time on a
+    serial (depth-0) run;
+  * the blocking-fetch count and attributable D2H wait;
+  * the overlap ratio: thread-busy time over wall time (1.0 = fully
+    serial; >1 means the pipeline actually overlapped host and device
+    work).
+
+Usage: ``python tools/trace_report.py TRACE.json [TRACE2.json ...]``
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _op_meta(span_tree: List[dict]) -> Dict[str, dict]:
+    """Flatten the spanTree into op_id -> {name, desc, metrics, depth}."""
+    out: Dict[str, dict] = {}
+
+    def walk(node, depth):
+        out[node["op_id"]] = {"name": node.get("name", node["op_id"]),
+                              "desc": node.get("desc", ""),
+                              "metrics": node.get("metrics", {}),
+                              "depth": depth}
+        for c in node.get("children", ()):
+            walk(c, depth + 1)
+
+    for root in span_tree or ():
+        walk(root, 0)
+    return out
+
+
+def analyze(data: dict) -> dict:
+    """Compute the report's numbers from a loaded trace dict."""
+    events = data.get("traceEvents", [])
+    xs = [e for e in events if e.get("ph") == "X"]
+    query = next((e for e in xs if e.get("cat") == "query"), None)
+    wall_us = (query or {}).get("dur", 0.0) or max(
+        (e["ts"] + e["dur"] for e in xs), default=0.0)
+
+    ops = _op_meta(data.get("spanTree", []))
+    per_op: Dict[str, dict] = {}
+
+    def op_entry(op_id):
+        e = per_op.get(op_id)
+        if e is None:
+            meta = ops.get(op_id, {})
+            e = per_op[op_id] = {
+                "op": op_id, "name": meta.get("name", op_id),
+                "desc": meta.get("desc", ""),
+                "metrics": meta.get("metrics", {}),
+                "self_us": 0.0, "total_us": 0.0}
+        return e
+
+    # self time: per thread, nest the operator intervals by containment;
+    # an interval's self time is its duration minus its immediate
+    # children's durations (the classic flame-graph subtraction)
+    op_events = [e for e in xs if e.get("cat") == "operator"]
+    by_tid: Dict[int, list] = {}
+    for e in op_events:
+        by_tid.setdefault(e.get("tid", 0), []).append(e)
+    for tid, evs in by_tid.items():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: list = []  # (end_us, event, child_us accumulator ref)
+        for e in evs:
+            end = e["ts"] + e["dur"]
+            while stack and e["ts"] >= stack[-1][0] - 1e-9:
+                stack.pop()
+            if stack:
+                stack[-1][1]["_child_us"] = \
+                    stack[-1][1].get("_child_us", 0.0) + e["dur"]
+            stack.append((end, e))
+        for e in evs:
+            op = e.get("args", {}).get("op")
+            if not op:
+                continue
+            ent = op_entry(op)
+            ent["total_us"] += e["dur"]
+            ent["self_us"] += max(0.0, e["dur"] - e.pop("_child_us", 0.0))
+
+    # busy time per thread (union of operator+io+shuffle intervals) for
+    # the overlap ratio
+    busy_us = 0.0
+    work = [e for e in xs
+            if e.get("cat") in ("operator", "io", "shuffle", "ici")]
+    by_tid_work: Dict[int, list] = {}
+    for e in work:
+        by_tid_work.setdefault(e.get("tid", 0), []).append(e)
+    for evs in by_tid_work.values():
+        ivs = sorted((e["ts"], e["ts"] + e["dur"]) for e in evs)
+        cur_s, cur_e = None, None
+        for s, t in ivs:
+            if cur_s is None:
+                cur_s, cur_e = s, t
+            elif s <= cur_e:
+                cur_e = max(cur_e, t)
+            else:
+                busy_us += cur_e - cur_s
+                cur_s, cur_e = s, t
+        if cur_s is not None:
+            busy_us += cur_e - cur_s
+
+    fetch_events = [e for e in xs if e.get("cat") == "fetch"]
+    blocking = [e for e in fetch_events
+                if e.get("args", {}).get("blocking")]
+    fetch_wait_us = sum(e["dur"] for e in fetch_events)
+    compiles = [e for e in xs if e.get("cat") == "compile"]
+    qargs = (query or {}).get("args", {})
+
+    self_total_us = sum(e["self_us"] for e in per_op.values())
+    return {
+        "label": data.get("otherData", {}).get("label", "?"),
+        "wall_s": wall_us / 1e6,
+        "n_events": len(xs),
+        "dropped": data.get("otherData", {}).get("dropped_events", 0),
+        "operators": sorted(per_op.values(),
+                            key=lambda e: -e["self_us"]),
+        "op_depth": {op: m.get("depth", 0) for op, m in ops.items()},
+        "self_total_s": self_total_us / 1e6,
+        "busy_s": busy_us / 1e6,
+        "overlap_ratio": (busy_us / wall_us) if wall_us else 0.0,
+        "self_coverage": (self_total_us / wall_us) if wall_us else 0.0,
+        "blocking_fetches": int(qargs.get("blocking_fetches",
+                                          len(blocking))),
+        "async_fetches": int(qargs.get("async_fetches",
+                                       len(fetch_events) - len(blocking))),
+        "fetch_wait_s": fetch_wait_us / 1e6,
+        "compiles": int(qargs.get("compiles", len(compiles))),
+        "compile_s": float(qargs.get("compile_s",
+                                     sum(e["dur"] for e in compiles) / 1e6)),
+        "threads": len(by_tid_work),
+    }
+
+
+def format_report(a: dict) -> str:
+    lines = [
+        f"query {a['label']}: wall={a['wall_s'] * 1e3:.1f}ms  "
+        f"events={a['n_events']} (dropped={a['dropped']})",
+        "",
+        "hot operators (self time):",
+        f"  {'self_ms':>9} {'total_ms':>9} {'rows':>10} "
+        f"{'batches':>8}  operator",
+    ]
+    for ent in a["operators"]:
+        m = ent["metrics"]
+        lines.append(
+            f"  {ent['self_us'] / 1e3:>9.1f} {ent['total_us'] / 1e3:>9.1f} "
+            f"{int(m.get('outputRows', 0)):>10} "
+            f"{int(m.get('outputBatches', 0)):>8}  {ent['desc'] or ent['name']}")
+    lines += [
+        "",
+        f"blocking fetches: {a['blocking_fetches']}  "
+        f"async: {a['async_fetches']}  "
+        f"fetch wait: {a['fetch_wait_s'] * 1e3:.1f}ms",
+        f"compiles: {a['compiles']}  "
+        f"compile time: {a['compile_s'] * 1e3:.1f}ms",
+        f"overlap: busy={a['busy_s'] * 1e3:.1f}ms over {a['threads']} "
+        f"thread(s), wall={a['wall_s'] * 1e3:.1f}ms, "
+        f"ratio={a['overlap_ratio']:.2f}",
+        f"self-time coverage: {a['self_total_s'] * 1e3:.1f}ms = "
+        f"{a['self_coverage'] * 100:.0f}% of wall",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    for path in argv:
+        print(format_report(analyze(load(path))))
+        if len(argv) > 1:
+            print("-" * 72)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
