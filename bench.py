@@ -20,7 +20,14 @@ SRT_BENCH_PIPELINE_DEPTH (sets spark.rapids.tpu.sql.pipeline.depth for
 the engine run; 0 = serial baseline for overlap A/B),
 SRT_BENCH_TRACE_DIR (enables spark.rapids.tpu.sql.trace.enabled and
 writes one Chrome-trace JSON per query — <query>.trace.json, the last
-warm iteration's span tree — for Perfetto / tools/trace_report.py).
+warm iteration's span tree — for Perfetto / tools/trace_report.py),
+SRT_BENCH_CONCURRENCY=N (N>1: replay the suite with N queries in flight
+through the query service and report p50/p95 service latency + aggregate
+throughput next to the serial numbers from the same warm state; results
+are verified equal to the serial run and per-query QueryStats must
+reconcile with the process aggregate.  Defaults to the TPC-H 22; with
+SRT_BENCH_TRACE_DIR also writes a merged concurrent.trace.json whose
+per-query sections + contention summary tools/trace_report.py renders).
 
 The aggregate JSON line is re-printed after EVERY query (flush=True), so
 a driver that kills the run on a timeout still finds the latest complete
@@ -144,9 +151,126 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
     }
 
 
+def _run_concurrent(sf: float, conc: int, which) -> None:
+    """SRT_BENCH_CONCURRENCY=N: replay the suite with N queries in
+    flight through the query service (service/scheduler.py) and print
+    ONE JSON line with p50/p95 service latency + aggregate throughput
+    NEXT TO the serial numbers from the same process/warm state.
+
+    Verifies the concurrent results match the serial run exactly and
+    that per-query QueryStats sums reconcile with the process aggregate
+    (zero cross-query accounting bleed).
+    """
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.models import tpcds, tpch_suite
+    from spark_rapids_tpu.utils.metrics import QueryStats
+
+    settings = {
+        "spark.rapids.tpu.sql.fileCache.enabled": True,
+        "spark.rapids.tpu.sql.scheduler.maxConcurrent": conc,
+        "spark.rapids.tpu.sql.concurrentTpuTasks": conc,
+    }
+    trace_dir = os.environ.get("SRT_BENCH_TRACE_DIR")
+    if trace_dir:
+        settings["spark.rapids.tpu.sql.trace.enabled"] = True
+    sess = srt.Session.get_or_create(settings=settings)
+
+    runners = {}
+    for name in which:
+        mod = tpcds if name.startswith("ds_") else tpch_suite
+        runner, _oracle = mod.QUERIES[name]
+        tables = mod.TABLES[name]
+        paths = mod.gen_db(sf, DATA_DIR)
+        dfs = {t: sess.read_parquet(paths[t]) for t in tables}
+        runners[name] = (runner, dfs)
+
+    # warm pass: compiles + decoded-file cache out of both timed passes
+    for name, (runner, dfs) in runners.items():
+        runner(dfs)
+
+    # serial pass: the reference numbers the concurrent pass must beat
+    serial_rows, serial_s = {}, {}
+    t0 = time.perf_counter()
+    for name, (runner, dfs) in runners.items():
+        q0 = time.perf_counter()
+        serial_rows[name] = runner(dfs)
+        serial_s[name] = round(time.perf_counter() - q0, 5)
+    serial_wall = time.perf_counter() - t0
+
+    # concurrent pass: submit everything, let admission control pace it
+    stats0 = QueryStats.get().snapshot()
+    handles = {}
+    t0 = time.perf_counter()
+    for name, (runner, dfs) in runners.items():
+        handles[name] = sess.submit(
+            (lambda r=runner, d=dfs: r(d)), label=name)
+    conc_rows, errors = {}, {}
+    for name, h in handles.items():
+        try:
+            conc_rows[name] = h.result(timeout=600)
+        except BaseException as e:
+            errors[name] = f"{type(e).__name__}: {e}"[:200]
+    conc_wall = time.perf_counter() - t0
+    delta = QueryStats.delta_since(stats0)
+
+    results_match = not errors and all(
+        tpch_suite.rows_rel_err(conc_rows[n], serial_rows[n]) < 1e-6
+        for n in which)
+    # per-query scopes fold into the process aggregate: the sums must
+    # reconcile exactly or accounting bled across queries
+    sums = {k: sum((h.stats or {}).get(k, 0) for h in handles.values())
+            for k in ("blocking_fetches", "async_fetches", "fetch_bytes")}
+    reconciled = all(abs(sums[k] - delta.get(k, 0)) < 1e-6 for k in sums)
+
+    lat = sorted(h.latency_s or 0.0 for h in handles.values())
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))], 5)
+
+    if trace_dir:
+        from spark_rapids_tpu.utils import tracing
+        os.makedirs(trace_dir, exist_ok=True)
+        tracing.write_merged(
+            [h.trace() for h in handles.values()],
+            os.path.join(trace_dir, "concurrent.trace.json"))
+    print(json.dumps({
+        "metric": "tpch_concurrent_throughput",
+        "concurrency": conc,
+        "sf": sf,
+        "n_queries": len(which),
+        "backend": _backend(),
+        "serial_wall_s": round(serial_wall, 5),
+        "concurrent_wall_s": round(conc_wall, 5),
+        "serial_qps": round(len(which) / serial_wall, 4),
+        "throughput_qps": round(len(which) / conc_wall, 4),
+        "speedup_vs_serial": round(serial_wall / conc_wall, 4),
+        "latency_p50_s": pct(0.50),
+        "latency_p95_s": pct(0.95),
+        "queue_wait_max_s": round(max(
+            h.queue_wait_s for h in handles.values()), 5),
+        "results_match": results_match,
+        "stats_reconciled": reconciled,
+        "errors": errors,
+        "per_query": {n: {
+            "serial_s": serial_s[n],
+            "latency_s": round(handles[n].latency_s or 0.0, 5),
+            "queue_wait_s": round(handles[n].queue_wait_s, 5),
+            "status": handles[n].status,
+        } for n in which},
+    }), flush=True)
+
+
 def main() -> None:
     sf = float(os.environ.get("SRT_BENCH_SF", "1.0"))
     iters = int(os.environ.get("SRT_BENCH_ITERS", "3"))
+    conc = int(os.environ.get("SRT_BENCH_CONCURRENCY", "0") or 0)
+    if conc > 1:
+        # concurrency mode defaults to the TPC-H suite (the service
+        # replay the scheduler was built for); SRT_BENCH_QUERIES narrows
+        which = [q for q in os.environ.get(
+            "SRT_BENCH_QUERIES", ",".join(TPCH_QUERIES)).split(",") if q]
+        _run_concurrent(sf, conc, which)
+        return
     which = [q for q in os.environ.get(
         "SRT_BENCH_QUERIES", ",".join(ALL_QUERIES)).split(",") if q]
     if len(which) > 1:

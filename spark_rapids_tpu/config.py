@@ -130,7 +130,58 @@ CONCURRENT_TASKS = register(
     "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
     "Number of tasks that may hold the TPU semaphore concurrently. The TPU "
     "has no CUDA-stream analog, so this primarily overlaps host I/O of one "
-    "task with device compute of another.")
+    "task with device compute of another. Reconfigurable at runtime: the "
+    "process semaphore resizes in place, so in-flight holders and blocked "
+    "waiters survive the change.")
+
+SCHED_MAX_CONCURRENT = register(
+    "spark.rapids.tpu.sql.scheduler.maxConcurrent", 2,
+    "Queries the service scheduler (service/scheduler.py) runs "
+    "concurrently. Each admitted query still takes a concurrentTpuTasks "
+    "semaphore permit, so the effective device concurrency is "
+    "min(maxConcurrent, concurrentTpuTasks); raising only this knob "
+    "queues the excess at the semaphore (cancellable, wait traced).",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SCHED_QUEUE_DEPTH = register(
+    "spark.rapids.tpu.sql.scheduler.queueDepth", 32,
+    "Bound on queries WAITING in the scheduler's admission queue. "
+    "Submissions beyond it are shed immediately with a typed "
+    "QueryRejected error — the overload answer is an error the caller "
+    "can retry with backoff, never an unbounded queue.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+SCHED_DEFAULT_PRIORITY = register(
+    "spark.rapids.tpu.sql.scheduler.defaultPriority", 0,
+    "Priority assigned when submit() passes none. Higher runs first; "
+    "entries at equal priority are ordered weighted-fair by tenant "
+    "virtual time (accumulated service / weight).")
+
+SCHED_DEADLINE_MS = register(
+    "spark.rapids.tpu.sql.scheduler.deadlineMs", 0,
+    "Default per-query deadline in milliseconds (0 = none). Applies to "
+    "scheduler submissions without an explicit deadline AND to "
+    "synchronous collect() calls; expiry cancels the query "
+    "cooperatively at the next batch boundary "
+    "(QueryDeadlineExceeded), releasing semaphore permits, pipeline "
+    "slots, and spill handles.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+DCN_HEARTBEAT_TIMEOUT = register(
+    "spark.rapids.tpu.dcn.heartbeatTimeout", 15.0,
+    "Seconds without a heartbeat before the DCN coordinator declares a "
+    "rank dead (parallel/dcn.py). Service deployments on congested "
+    "networks raise this to ride out GC/transfer pauses; lowering it "
+    "surfaces real failures faster.", conv=float,
+    check=lambda v: None if v > 0 else "must be > 0")
+
+DCN_WAIT_TIMEOUT = register(
+    "spark.rapids.tpu.dcn.waitTimeout", 120.0,
+    "Seconds the DCN coordinator holds a barrier/allgather before "
+    "failing it with PeerFailedError (parallel/dcn.py). Must exceed the "
+    "longest legitimate inter-rank skew (e.g. one rank's cold XLA "
+    "compile); bounds how long a lost peer can hang the world.",
+    conv=float, check=lambda v: None if v > 0 else "must be > 0")
 
 PIPELINE_DEPTH = register(
     "spark.rapids.tpu.sql.pipeline.depth", 2,

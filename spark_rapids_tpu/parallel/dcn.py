@@ -93,8 +93,19 @@ class Coordinator:
 
     def __init__(self, world_size: int, port: int = 0,
                  bind_host: str = "127.0.0.1",
-                 heartbeat_timeout: float = 15.0,
-                 wait_timeout: float = 120.0):
+                 heartbeat_timeout: Optional[float] = None,
+                 wait_timeout: Optional[float] = None):
+        # None = resolve from the registered confs (session overrides
+        # apply), so service deployments tune liveness without code:
+        # spark.rapids.tpu.dcn.{heartbeatTimeout,waitTimeout}
+        if heartbeat_timeout is None or wait_timeout is None:
+            from ..config import TpuConf
+            conf = TpuConf()
+            if heartbeat_timeout is None:
+                heartbeat_timeout = conf[
+                    "spark.rapids.tpu.dcn.heartbeatTimeout"]
+            if wait_timeout is None:
+                wait_timeout = conf["spark.rapids.tpu.dcn.waitTimeout"]
         self.world_size = world_size
         self.heartbeat_timeout = heartbeat_timeout
         self.wait_timeout = wait_timeout
@@ -108,7 +119,7 @@ class Coordinator:
         self._srv = socket.create_server((bind_host, port))
         self.port = self._srv.getsockname()[1]
         self._threads: List[threading.Thread] = []
-        t = threading.Thread(target=self._accept_loop, daemon=True,
+        t = threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime control plane, not per-query work)
                              name="srt-dcn-coordinator")
         t.start()
         self._threads.append(t)
@@ -120,7 +131,7 @@ class Coordinator:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
-            t = threading.Thread(target=self._serve, args=(conn,),
+            t = threading.Thread(target=self._serve, args=(conn,),  # ctx-ok (control-plane connection handler)
                                  daemon=True)
             t.start()
             self._threads.append(t)
@@ -226,7 +237,7 @@ class _PeerServer:
         self._closed = False
         self._srv = socket.create_server((bind_host, port))
         self.port = self._srv.getsockname()[1]
-        threading.Thread(target=self._accept_loop, daemon=True,
+        threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime data-plane server)
                          name="srt-dcn-peer-server").start()
 
     def register(self, shuffle_id: str, directory: str) -> None:
@@ -243,7 +254,7 @@ class _PeerServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
+            threading.Thread(target=self._serve, args=(conn,),  # ctx-ok (data-plane connection handler)
                              daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
@@ -321,7 +332,7 @@ class ProcessGroup:
             raise PeerFailedError(f"register failed: {msg['error']}")
         self.peers: Dict[int, Tuple[str, int]] = {
             int(r): (h, int(p)) for r, (h, p) in msg["peers"].items()}
-        self._hb = threading.Thread(target=self._heartbeat_loop,
+        self._hb = threading.Thread(target=self._heartbeat_loop,  # ctx-ok (rank-lifetime liveness thread)
                                     args=(heartbeat_interval,), daemon=True,
                                     name=f"srt-dcn-heartbeat-{rank}")
         self._hb.start()

@@ -75,7 +75,7 @@ class HostShuffle:
         self._paths = [os.path.join(self.dir, f"part-{p:05d}.bin")
                        for p in range(n_parts)]
         self._locks = [threading.Lock() for _ in range(n_parts)]
-        self._pool = ThreadPoolExecutor(max_workers=max(1, num_threads))
+        self._pool = ThreadPoolExecutor(max_workers=max(1, num_threads))  # ctx-ok (tasks run via copy_context in write_partition)
         self._pending: List = []
         self.bytes_written = 0
         self.rows_written = 0
@@ -124,12 +124,15 @@ class HostShuffle:
         """Yield the arrow tables written to partition ``p``."""
         import pyarrow as pa
 
+        from ..service import cancel
         from ..utils import tracing
         path = self._paths[p]
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
             while True:
+                cancel.check()  # frame boundary: stop re-reading an
+                # aborted query's shuffle files
                 header = f.read(_FRAME.size)
                 if not header:
                     break

@@ -174,7 +174,9 @@ class ShuffleExchangeExec(TpuExec):
             with m.time("opTime"):
                 shuffle.finish_writes()
             min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+            from ..service import cancel as _cancel
             for p in range(self.n_parts):
+                _cancel.check()  # shuffle reader batch boundary
                 tables = list(shuffle.read_partition(p))
                 with m.time("opTime"):
                     if not tables:
@@ -200,12 +202,21 @@ class ShuffleExchangeExec(TpuExec):
         if getattr(self, "_staged_raw", None) is not None:
             return self._staged_raw
         from ..memory.spill import get_catalog
+        from ..service import cancel
         catalog = get_catalog(ctx.conf)
         m = ctx.metric_set(self.op_id)
         raw = []
-        for batch in self.children[0].execute(ctx):
-            raw.append(catalog.register(batch, priority=0))
-            m.add("numInputBatches", 1)
+        try:
+            for batch in self.children[0].execute(ctx):
+                cancel.check()  # abort staging at a batch boundary
+                raw.append(catalog.register(batch, priority=0))
+                m.add("numInputBatches", 1)
+        except BaseException:
+            # a cancelled/failed staging pass must not leak the handles
+            # it already registered (assert_no_leaks after an abort)
+            for h in raw:
+                h.close()
+            raise
         self._staged_raw = raw
         return raw
 
@@ -356,7 +367,9 @@ class ShuffleExchangeExec(TpuExec):
                     yield _empty_batch(self.output_schema)
                 return
 
+            from ..service import cancel as _cancel
             for p in range(self.n_parts):
+                _cancel.check()  # shuffle reader batch boundary
                 parts = []
                 for bh, ph in staged:
                     batch = bh.get()

@@ -209,7 +209,9 @@ class ScanExec(TpuExec):
             tables = source(prefetch_depth=max(4, 2 * depth))
         except TypeError:  # plain-callable sources (tests, exchanges)
             tables = source()
+        from ..service import cancel
         for b in pipeline_map(tables, _upload, depth, label=self.op_id):
+            cancel.check()  # a cancelled query stops decoding/uploading
             b.origin_file = origin
             m.add("numOutputRows", b.num_rows)
             m.add("numOutputBatches", 1)
@@ -2125,9 +2127,13 @@ class CollectExec(TpuExec):
         import pyarrow as pa
         from ..batch import to_arrow, to_arrow_async
         from ..runtime.pipeline import effective_depth
+        from ..service import cancel
         depth = effective_depth(ctx)
         if depth <= 0:
-            tables = [to_arrow(b) for b in self.children[0].execute(ctx)]
+            tables = []
+            for b in self.children[0].execute(ctx):
+                cancel.check()
+                tables.append(to_arrow(b))
         else:
             # async D2H: batch N's fetch rides behind batch N+1's
             # dispatch; at most `depth` fetches (each pinning its device
@@ -2136,6 +2142,7 @@ class CollectExec(TpuExec):
             pending: "deque" = deque()
             tables = []
             for b in self.children[0].execute(ctx):
+                cancel.check()
                 pending.append(to_arrow_async(b))
                 while len(pending) > depth:
                     tables.append(pending.popleft()())

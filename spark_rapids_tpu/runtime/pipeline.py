@@ -38,6 +38,51 @@ T = TypeVar("T")
 U = TypeVar("U")
 
 _END = object()
+_CANCELLED = object()
+
+
+class _Slots:
+    """Event-driven bounded-slot gate for the staging worker.
+
+    Replaces the old 0.1 s ``Semaphore.acquire(timeout=)`` poll loop:
+    the worker blocks on a condition that the consumer's release, the
+    consumer's teardown (``stop``), or the query's cancellation waker
+    notifies — an aborted query frees its staging thread immediately
+    instead of holding it for up to 100 ms per slot.
+    """
+
+    def __init__(self, depth: int):
+        self._cv = threading.Condition()
+        self._free = depth
+        self._stopped = False
+
+    def acquire(self, ctl) -> bool:
+        """Block until a slot frees; False when the pipeline stopped or
+        the query was cancelled (the worker exits either way)."""
+        with self._cv:
+            while True:
+                if self._stopped:
+                    return False
+                if ctl is not None and ctl.cancelled.is_set():
+                    return False
+                if self._free > 0:
+                    self._free -= 1
+                    return True
+                self._cv.wait()
+
+    def release(self) -> None:
+        with self._cv:
+            self._free += 1
+            self._cv.notify_all()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    def notify(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
 
 
 _DEPTH_KEY = "spark.rapids.tpu.sql.pipeline.depth"
@@ -91,8 +136,10 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
     into the caller's query-scoped QueryStats and its spans join the
     caller's active trace.
     """
+    from ..service import cancel
     if depth <= 0:
         for item in src:
+            cancel.check()
             yield fn(item)
         return
 
@@ -101,22 +148,25 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
     from ..utils import tracing
     from ..utils.metrics import QueryStats
 
-    slots = threading.Semaphore(depth)
+    slots = _Slots(depth)
     q: "queue.Queue" = queue.Queue()
-    stop = threading.Event()
     it = iter(src)
     cctx = contextvars.copy_context()
+    ctl = cancel.current()
+    # cancellation wakes BOTH sides event-driven: the worker blocked on
+    # a slot (slots re-checks the flag) and the consumer blocked on the
+    # staged-batch queue (the sentinel makes q.get return immediately)
+    waker_tok = ctl.add_waker(
+        lambda: (slots.notify(), q.put(_CANCELLED))) if ctl is not None \
+        else None
 
     def worker():
         try:
             while True:
                 # reserve a slot BEFORE producing: at most `depth` staged
                 # items are ever live (queue + the one being produced)
-                while not slots.acquire(timeout=0.1):
-                    if stop.is_set():
-                        return
-                if stop.is_set():
-                    return
+                if not slots.acquire(ctl):
+                    return  # stopped or cancelled
                 t0 = time.perf_counter()
                 try:
                     item = next(it)
@@ -156,12 +206,17 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
             tracing.record(label, "pipeline:wait", "pipeline", t0, dt)
             if item is _END:
                 return
+            if item is _CANCELLED:
+                cancel.check()  # raises QueryCancelled/DeadlineExceeded
+                continue        # spurious (already-handled) wake
             if isinstance(item, BaseException):
                 raise item
             pending_release = True
             yield item
     finally:
-        stop.set()
+        slots.stop()
+        if waker_tok is not None:
+            ctl.remove_waker(waker_tok)
 
 
 def pipeline_batches(batches: Iterable[T], depth: int,

@@ -1,0 +1,26 @@
+"""Query service subsystem: scheduler, admission control, deadlines,
+cancellation.
+
+``cancel`` (stdlib-only; safe to import from anywhere, including the
+tracing hot path) carries the per-query cooperative cancellation/
+deadline control; ``scheduler`` provides the admission-controlled
+concurrent executor (:class:`QueryScheduler` / :class:`QueryHandle`).
+The scheduler module is imported lazily so importing the package (which
+the batch-boundary checkpoint does transitively) stays dependency-free.
+"""
+
+from __future__ import annotations
+
+from .cancel import (QueryCancelled, QueryControl,  # noqa: F401
+                     QueryDeadlineExceeded, check, current, scope)
+
+__all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryControl",
+           "QueryRejected", "QueryScheduler", "QueryHandle",
+           "check", "current", "scope", "cancel"]
+
+
+def __getattr__(name):
+    if name in ("QueryRejected", "QueryScheduler", "QueryHandle"):
+        from . import scheduler
+        return getattr(scheduler, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

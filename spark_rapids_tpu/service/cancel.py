@@ -1,0 +1,220 @@
+"""Cooperative query cancellation and deadlines (contextvar-scoped).
+
+The reference plugin kills a task by letting Spark's task-failure
+machinery unwind the executor thread; this engine has no task framework,
+so cancellation is *cooperative*: a per-query :class:`QueryControl`
+travels in a :mod:`contextvars` variable (worker threads — pipeline
+staging, io prefetch, shuffle pools — run in copied contexts and
+therefore see their query's control), and the engine checks it at every
+batch boundary (``utils/tracing.instrument_batches`` wraps every
+``TpuExec.execute``; ``runtime/pipeline.py`` and the shuffle readers
+check explicitly).  A cancelled query unwinds through the ordinary
+exception path, so operator ``finally`` blocks close spill handles, the
+semaphore context manager releases its permits, and the pipeline drains
+its staged slots — ``SpillCatalog.assert_no_leaks`` passes after an
+aborted query.
+
+Deadlines are cancellations the clock issues: entering a control's
+:func:`scope` arms a ``threading.Timer`` that calls ``cancel()`` at the
+deadline, so blocked waits (semaphore, pipeline slots, staged-batch
+queues) are woken *event-driven* through the registered wakers instead
+of polling the clock.  ``check()`` also compares the clock directly as
+a belt-and-braces fallback for the window before the timer fires.
+
+This module is intentionally stdlib-only (no jax, no package imports):
+``utils/tracing`` reads it on every batch pull and must not create an
+import cycle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryControl",
+           "current", "check", "scope"]
+
+_pc = time.perf_counter
+
+
+class QueryCancelled(RuntimeError):
+    """The query was cancelled; raised at the next batch boundary."""
+
+
+class QueryDeadlineExceeded(QueryCancelled):
+    """The query ran past its deadline — a cancellation issued by the
+    clock (``collect(timeout=)``, ``Session.submit(deadline_s=)``, or
+    ``spark.rapids.tpu.sql.scheduler.deadlineMs``)."""
+
+
+_CONTROL: "contextvars.ContextVar[Optional[QueryControl]]" = \
+    contextvars.ContextVar("srt_query_control", default=None)
+
+
+class QueryControl:
+    """One query's cancellation flag, deadline, and scheduler metadata.
+
+    Thread-safe; shared by every thread executing the query (they run in
+    copies of the submitting context).  Blocking waits that must wake on
+    cancellation register a *waker* callback (:meth:`add_waker`) —
+    ``cancel()`` fires every registered waker after setting the flag, so
+    no wait loop needs a polling timeout.
+    """
+
+    def __init__(self, label: str = "query",
+                 deadline_s: Optional[float] = None, priority: int = 0,
+                 tenant: str = "default", weight: float = 1.0):
+        self.label = label
+        self.priority = priority
+        self.tenant = tenant
+        self.weight = max(1e-6, weight)
+        # absolute perf_counter deadline (None = no deadline)
+        self.deadline = None if deadline_s is None else _pc() + deadline_s
+        self.cancelled = threading.Event()
+        self.reason: Optional[str] = None
+        self._deadline_hit = False
+        self._wakers: Dict[int, Callable[[], None]] = {}
+        self._n_wakers = 0
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        # scheduler accounting, folded into the query trace by the
+        # session (sql/session._note_scheduler) and into QueryStats by
+        # the scheduler worker
+        self.enqueued_t: Optional[float] = None
+        self.admitted_t: Optional[float] = None
+        self.queue_wait_s = 0.0
+        # the QueryTrace of the execution (captured by the session so a
+        # QueryHandle can expose it after completion)
+        self.trace = None
+
+    # -- deadline -----------------------------------------------------------------
+    def remaining(self) -> Optional[float]:
+        """Seconds until the deadline (may be negative), or None."""
+        if self.deadline is None:
+            return None
+        return self.deadline - _pc()
+
+    def _arm(self) -> None:
+        rem = self.remaining()
+        if rem is None or self._timer is not None:
+            return
+        t = threading.Timer(
+            max(0.0, rem),
+            lambda: self.cancel(
+                f"deadline exceeded for {self.label}", deadline=True))
+        t.daemon = True
+        self._timer = t
+        t.start()
+
+    def _disarm(self) -> None:
+        t, self._timer = self._timer, None
+        if t is not None:
+            t.cancel()
+
+    # -- cancellation -------------------------------------------------------------
+    def cancel(self, reason: str = "query cancelled", *,
+               deadline: bool = False) -> bool:
+        """Request cooperative cancellation.  Returns False when the
+        query was already cancelled.  Fires every registered waker so
+        blocked waits re-check immediately."""
+        with self._lock:
+            if self.cancelled.is_set():
+                return False
+            self.reason = reason
+            self._deadline_hit = deadline
+            self.cancelled.set()
+            wakers = list(self._wakers.values())
+        for w in wakers:
+            try:
+                w()
+            except Exception:
+                pass
+        return True
+
+    def add_waker(self, fn: Callable[[], None]) -> int:
+        """Register ``fn`` to fire on cancellation (wake a blocked wait);
+        fires immediately when already cancelled.  Returns a token for
+        :meth:`remove_waker`."""
+        with self._lock:
+            self._n_wakers += 1
+            tok = self._n_wakers
+            self._wakers[tok] = fn
+            already = self.cancelled.is_set()
+        if already:
+            try:
+                fn()
+            except Exception:
+                pass
+        return tok
+
+    def remove_waker(self, tok: int) -> None:
+        with self._lock:
+            self._wakers.pop(tok, None)
+
+    # -- status -------------------------------------------------------------------
+    @property
+    def status(self) -> str:
+        """'ok' | 'cancelled' | 'deadline' — the trace's span status."""
+        if not self.cancelled.is_set():
+            return "ok"
+        return "deadline" if self._deadline_hit else "cancelled"
+
+    def check(self) -> None:
+        """Raise at a batch boundary when cancelled or past deadline."""
+        if self.cancelled.is_set():
+            self.raise_()
+        d = self.deadline
+        if d is not None and _pc() > d:
+            # fallback for the window before the timer fires
+            self.cancel(f"deadline exceeded for {self.label}",
+                        deadline=True)
+            self.raise_()
+
+    def raise_(self) -> None:
+        if self._deadline_hit:
+            raise QueryDeadlineExceeded(
+                self.reason or f"deadline exceeded for {self.label}")
+        raise QueryCancelled(self.reason or "query cancelled")
+
+
+# ---------------------------------------------------------------------------------
+# Module-level API: the one surface the engine's batch boundaries read.
+# ---------------------------------------------------------------------------------
+
+def current() -> Optional[QueryControl]:
+    """The running query's control, or None outside any control scope."""
+    return _CONTROL.get()
+
+
+def check() -> None:
+    """The batch-boundary checkpoint: one ContextVar read when no
+    control is installed; raises :class:`QueryCancelled` /
+    :class:`QueryDeadlineExceeded` when the query should stop."""
+    c = _CONTROL.get()
+    if c is not None:
+        c.check()
+
+
+@contextlib.contextmanager
+def scope(control: Optional[QueryControl]):
+    """Install ``control`` for the scope (contextvar-carried, so worker
+    threads running copied contexts inherit it) and arm its deadline
+    timer.  ``None`` is a pure pass-through."""
+    if control is None:
+        yield None
+        return
+    tok = _CONTROL.set(control)
+    control._arm()
+    try:
+        yield control
+    finally:
+        control._disarm()
+        try:
+            _CONTROL.reset(tok)
+        except ValueError:
+            # generator-held scopes can violate token LIFO; clearing is
+            # the safe fallback (mirrors tracing.query_trace)
+            _CONTROL.set(None)

@@ -1,0 +1,420 @@
+"""Concurrent query service: scheduler, admission control, shedding.
+
+The ROADMAP north star is a service "serving heavy traffic"; until this
+module every query ran synchronously on its caller's thread with the
+semaphore permit count as the only concurrency primitive.  The
+:class:`QueryScheduler` is the missing service layer:
+
+  * **async submit** — ``submit()`` returns a :class:`QueryHandle`
+    wrapping a ``concurrent.futures.Future``; callers overlap many
+    queries against one shared device;
+  * **bounded admission queue** — at most
+    ``spark.rapids.tpu.sql.scheduler.queueDepth`` queries wait; beyond
+    it ``submit()`` *sheds* with a typed :class:`QueryRejected` (the
+    overload answer is an error the caller can retry, not an unbounded
+    queue that melts the host);
+  * **priority + weighted-fair ordering** — the dispatcher pops the
+    highest-priority entry; within a priority level, tenants are
+    ordered by virtual time (accumulated service / weight), so one
+    chatty tenant cannot starve the rest;
+  * **memory-aware admission** — a query starts only when a semaphore
+    permit is free AND ``SpillCatalog.ensure_budget`` can make device
+    headroom, so concurrent queries degrade to *spilling* instead of
+    RESOURCE_EXHAUSTED storms;
+  * **deadlines + cancellation** — every query carries a
+    :class:`..service.cancel.QueryControl`; ``handle.cancel()`` (or the
+    deadline timer) aborts it cooperatively at the next batch boundary,
+    releasing permits, pipeline slots, and spill handles.
+
+Each admitted query runs on its own worker thread in a COPY of the
+submitter's context (per-query ``QueryStats`` scope + trace + control
+all live in contextvars), so concurrent queries never cross-account —
+the groundwork PR 2 laid.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import contextvars
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .cancel import (QueryCancelled, QueryControl, QueryDeadlineExceeded,
+                     scope as control_scope)
+
+__all__ = ["QueryRejected", "QueryHandle", "QueryScheduler"]
+
+_pc = time.perf_counter
+
+
+class QueryRejected(RuntimeError):
+    """Admission queue full — the scheduler shed this query at submit().
+
+    The service-overload contract: callers see a typed, immediate error
+    (retry with backoff / route elsewhere) instead of unbounded queueing.
+    """
+
+
+class _Entry:
+    __slots__ = ("seq", "label", "fn", "control", "future", "cctx",
+                 "status", "stats", "submitted_t", "started_t",
+                 "finished_t")
+
+    def __init__(self, seq: int, label: str, fn: Callable,
+                 control: QueryControl):
+        self.seq = seq
+        self.label = label
+        self.fn = fn
+        self.control = control
+        self.future: "concurrent.futures.Future" = \
+            concurrent.futures.Future()
+        # the submitter's context: the worker runs a COPY so the query's
+        # stats/trace/control contextvars are isolated per query
+        self.cctx = contextvars.copy_context()
+        self.status = "queued"
+        self.stats: Optional[Dict[str, float]] = None
+        self.submitted_t = _pc()
+        self.started_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+
+
+class QueryHandle:
+    """The caller's view of one submitted query."""
+
+    def __init__(self, scheduler: "QueryScheduler", entry: _Entry):
+        self._sched = scheduler
+        self._entry = entry
+
+    # -- future surface -----------------------------------------------------------
+    @property
+    def future(self) -> "concurrent.futures.Future":
+        return self._entry.future
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the query result; re-raises the query's failure
+        (:class:`QueryCancelled` / :class:`QueryDeadlineExceeded` for an
+        aborted query)."""
+        return self._entry.future.result(timeout=timeout)
+
+    def done(self) -> bool:
+        return self._entry.future.done()
+
+    # -- control ------------------------------------------------------------------
+    def cancel(self, reason: str = "cancelled by caller") -> bool:
+        """Cancel the query: a queued entry is removed immediately; a
+        running one aborts cooperatively at its next batch boundary.
+        False once the query already finished."""
+        return self._sched._cancel(self._entry, reason)
+
+    # -- introspection ------------------------------------------------------------
+    @property
+    def label(self) -> str:
+        return self._entry.label
+
+    @property
+    def priority(self) -> int:
+        return self._entry.control.priority
+
+    @property
+    def status(self) -> str:
+        """queued | running | done | failed | cancelled | deadline"""
+        return self._entry.status
+
+    @property
+    def stats(self) -> Optional[Dict[str, float]]:
+        """The query-scoped QueryStats snapshot (after completion) —
+        per-query sums reconcile with the process aggregate because the
+        scope folds into it on exit."""
+        return self._entry.stats
+
+    @property
+    def queue_wait_s(self) -> float:
+        return self._entry.control.queue_wait_s
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        """submit→finish wall seconds (the service latency, queue wait
+        included); None while in flight."""
+        e = self._entry
+        if e.finished_t is None:
+            return None
+        return e.finished_t - e.submitted_t
+
+    def trace(self):
+        """The query's QueryTrace when tracing was enabled (captured via
+        the control), else None."""
+        return self._entry.control.trace
+
+
+class QueryScheduler:
+    """Admission-controlled concurrent query executor for one session.
+
+    Confs (read at submit/dispatch time, so runtime ``conf.set`` applies):
+      * ``spark.rapids.tpu.sql.scheduler.maxConcurrent`` — in-flight cap
+      * ``spark.rapids.tpu.sql.scheduler.queueDepth`` — waiting cap
+        (beyond it submit() sheds with :class:`QueryRejected`)
+      * ``spark.rapids.tpu.sql.scheduler.defaultPriority`` — priority
+        when submit() passes none
+      * ``spark.rapids.tpu.sql.scheduler.deadlineMs`` — default deadline
+        (0 = none)
+    """
+
+    def __init__(self, session=None, settings: Optional[dict] = None):
+        self._session = session
+        self._settings = dict(settings or {})
+        self._cv = threading.Condition()
+        self._queue: List[_Entry] = []
+        self._running: set = set()
+        self._vtime: Dict[str, float] = {}  # tenant -> virtual time
+        self._seq = 0
+        self._closed = False
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.cancelled = 0
+        self._sem_listener_installed = False
+        # dispatcher: pops admissible entries and starts worker threads;
+        # queries themselves run in per-query copied contexts
+        self._dispatcher = threading.Thread(  # ctx-ok (scheduler control thread; queries run via entry.cctx.run)
+            target=self._dispatch_loop, daemon=True,
+            name="srt-scheduler-dispatch")
+        self._dispatcher.start()
+
+    # -- conf ---------------------------------------------------------------------
+    def _conf(self):
+        if self._session is not None:
+            conf = self._session._tpu_conf()
+        else:
+            from ..config import TpuConf
+            conf = TpuConf()
+        if self._settings:
+            return conf.with_settings(**self._settings)
+        return conf
+
+    # -- submission ---------------------------------------------------------------
+    def submit(self, query, *, priority: Optional[int] = None,
+               deadline_s: Optional[float] = None, tenant: str = "default",
+               weight: float = 1.0, label: Optional[str] = None
+               ) -> QueryHandle:
+        """Enqueue ``query`` — a DataFrame (its ``collect()`` runs) or a
+        zero-arg callable — and return a :class:`QueryHandle`.
+
+        Raises :class:`QueryRejected` when the scheduler is closed or
+        the admission queue is at ``queueDepth`` (overload shedding).
+        """
+        conf = self._conf()
+        if priority is None:
+            priority = conf["spark.rapids.tpu.sql.scheduler.defaultPriority"]
+        if deadline_s is None:
+            dl_ms = conf["spark.rapids.tpu.sql.scheduler.deadlineMs"]
+            deadline_s = dl_ms / 1000.0 if dl_ms > 0 else None
+        depth = conf["spark.rapids.tpu.sql.scheduler.queueDepth"]
+        if callable(query):
+            fn = query
+        elif hasattr(query, "collect"):
+            fn = query.collect
+        else:
+            raise TypeError(
+                f"submit() takes a DataFrame or a zero-arg callable, "
+                f"not {type(query).__name__}")
+        with self._cv:
+            if self._closed:
+                raise QueryRejected("scheduler is closed")
+            if len(self._queue) >= max(0, depth):
+                self.rejected += 1
+                raise QueryRejected(
+                    f"admission queue full ({len(self._queue)} queued >= "
+                    f"queueDepth={depth}); retry later or raise "
+                    f"spark.rapids.tpu.sql.scheduler.queueDepth")
+            self._seq += 1
+            label = label or f"submit-{self._seq:04d}"
+            control = QueryControl(label=label, deadline_s=deadline_s,
+                                   priority=priority, tenant=tenant,
+                                   weight=weight)
+            control.enqueued_t = _pc()
+            entry = _Entry(self._seq, label, fn, control)
+            self._queue.append(entry)
+            self.submitted += 1
+            self._cv.notify_all()
+        return QueryHandle(self, entry)
+
+    # -- ordering -----------------------------------------------------------------
+    def _key(self, e: _Entry):
+        # higher priority first; within a priority level weighted-fair
+        # by tenant virtual time; FIFO as the final tiebreak
+        return (-e.control.priority,
+                self._vtime.get(e.control.tenant, 0.0), e.seq)
+
+    def _pop_locked(self) -> Optional[_Entry]:
+        if not self._queue:
+            return None
+        e = min(self._queue, key=self._key)
+        self._queue.remove(e)
+        return e
+
+    # -- admission ----------------------------------------------------------------
+    def _admissible(self, conf) -> bool:
+        """Permits + memory headroom: start a query only when the
+        semaphore has a free permit and the spill catalog can make
+        device headroom (spilling staged batches if needed) — overload
+        degrades to spill, never to a RESOURCE_EXHAUSTED storm."""
+        from ..memory.spill import get_catalog
+        from ..runtime.semaphore import get_semaphore
+        sem = get_semaphore(conf)
+        if not self._sem_listener_installed:
+            # a released permit is a dispatch opportunity: wake the
+            # dispatcher instead of polling
+            sem.add_release_listener(self._wake)
+            self._sem_listener_installed = True
+        if sem.available() <= 0:
+            return False
+        try:
+            catalog = get_catalog(conf)
+            catalog.ensure_budget()
+            return catalog.device_bytes_in_use() <= catalog.device_budget
+        except Exception:
+            # no initialized backend yet (pure-callable schedulers in
+            # tests): admission falls back to permits only
+            return True
+
+    def _wake(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- dispatch -----------------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            conf = None
+            with self._cv:
+                while not self._closed and (
+                        not self._queue
+                        or len(self._running) >= self._max_concurrent()):
+                    self._cv.wait(timeout=1.0)
+                if self._closed:
+                    return
+            # admission probes (catalog spilling) run OUTSIDE the lock
+            conf = self._conf()
+            if not self._admissible(conf):
+                with self._cv:
+                    if self._closed:
+                        return
+                    # completion/permit-release notifies sooner; the
+                    # timeout is only a backstop against missed wakeups
+                    self._cv.wait(timeout=0.25)
+                continue
+            with self._cv:
+                if self._closed:
+                    return
+                if not self._queue \
+                        or len(self._running) >= self._max_concurrent():
+                    continue
+                entry = self._pop_locked()
+                if entry is None:
+                    continue
+                self._running.add(entry)
+                entry.status = "running"
+            th = threading.Thread(target=entry.cctx.run,
+                                  args=(self._run_entry, entry),
+                                  daemon=True,
+                                  name=f"srt-query-{entry.label}")
+            th.start()
+
+    def _max_concurrent(self) -> int:
+        return max(1, self._conf()[
+            "spark.rapids.tpu.sql.scheduler.maxConcurrent"])
+
+    # -- execution ----------------------------------------------------------------
+    def _run_entry(self, e: _Entry) -> None:
+        from ..utils.metrics import QueryStats
+        e.started_t = _pc()
+        ctl = e.control
+        ctl.admitted_t = e.started_t
+        ctl.queue_wait_s = max(0.0, e.started_t - (ctl.enqueued_t
+                                                   or e.started_t))
+        status, result, error = "done", None, None
+        with QueryStats.scoped() as stats:
+            stats.queue_wait_s += ctl.queue_wait_s
+            try:
+                with control_scope(ctl):
+                    result = e.fn()
+            except QueryDeadlineExceeded as exc:
+                status, error = "deadline", exc
+            except QueryCancelled as exc:
+                status, error = "cancelled", exc
+            except BaseException as exc:
+                status, error = "failed", exc
+            e.stats = stats.snapshot()
+        self._finish(e, status, result, error)
+
+    def _finish(self, e: _Entry, status: str, result, error) -> None:
+        e.finished_t = _pc()
+        e.status = status
+        served = e.finished_t - (e.started_t or e.finished_t)
+        with self._cv:
+            self._running.discard(e)
+            t = e.control.tenant
+            self._vtime[t] = self._vtime.get(t, 0.0) \
+                + served / e.control.weight
+            self.completed += 1
+            if status in ("cancelled", "deadline"):
+                self.cancelled += 1
+            self._cv.notify_all()
+        if error is not None:
+            e.future.set_exception(error)
+        else:
+            e.future.set_result(result)
+
+    # -- cancellation -------------------------------------------------------------
+    def _cancel(self, e: _Entry, reason: str) -> bool:
+        with self._cv:
+            if e in self._queue:
+                self._queue.remove(e)
+                e.status = "cancelled"
+                e.finished_t = _pc()
+                self.cancelled += 1
+                self._cv.notify_all()
+                e.future.set_exception(QueryCancelled(reason))
+                return True
+        if e.future.done():
+            return False
+        # running: cooperative — the next batch boundary raises, the
+        # worker unwinds (releasing permits/slots/handles), _finish runs
+        return e.control.cancel(reason)
+
+    # -- introspection / lifecycle ------------------------------------------------
+    def queued(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def running(self) -> int:
+        with self._cv:
+            return len(self._running)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._cv:
+            return {"queued": len(self._queue),
+                    "running": len(self._running),
+                    "submitted": self.submitted,
+                    "completed": self.completed,
+                    "rejected": self.rejected,
+                    "cancelled": self.cancelled}
+
+    def close(self, cancel_running: bool = True) -> None:
+        """Shut down: shed the queue, optionally cancel in-flight
+        queries, and stop the dispatcher."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            queued, self._queue = self._queue, []
+            running = list(self._running)
+            self._cv.notify_all()
+        for e in queued:
+            e.status = "cancelled"
+            e.finished_t = _pc()
+            e.future.set_exception(QueryCancelled("scheduler closed"))
+        if cancel_running:
+            for e in running:
+                e.control.cancel("scheduler closed")
+        self._dispatcher.join(timeout=2.0)

@@ -352,12 +352,30 @@ class DataFrame:
 
     toPandas = to_pandas
 
-    def collect(self) -> List[tuple]:
-        t = self._executed()
+    def collect(self, timeout: Optional[float] = None) -> List[tuple]:
+        """Execute and fetch all rows.  ``timeout`` (seconds) installs a
+        per-query deadline: execution aborts cooperatively at the next
+        batch boundary with
+        :class:`..service.cancel.QueryDeadlineExceeded`, releasing its
+        semaphore permits, pipeline slots, and spill handles."""
+        if timeout is not None:
+            from ..service import cancel
+            with cancel.scope(cancel.QueryControl(label="collect",
+                                                  deadline_s=timeout)):
+                t = self._executed()
+        else:
+            t = self._executed()
         if t is None:
             return []
         cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
         return [tuple(c[i] for c in cols) for i in range(t.num_rows)]
+
+    def submit(self, **kw):
+        """Async execution through the session's query scheduler:
+        ``df.submit(priority=, deadline_s=, tenant=)`` returns a
+        :class:`..service.scheduler.QueryHandle` whose ``result()`` is
+        this DataFrame's ``collect()`` output."""
+        return self.session.submit(self, **kw)
 
     def count(self) -> int:
         from . import functions as F

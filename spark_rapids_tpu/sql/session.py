@@ -8,6 +8,7 @@ machinery mirrors the plugin's "could not run on TPU because ..." output
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Dict, Iterable, Optional
 
@@ -63,6 +64,13 @@ class Session:
     @classmethod
     def reset(cls) -> None:
         with cls._lock:
+            if cls._active is not None:
+                sched = getattr(cls._active, "_scheduler", None)
+                if sched is not None:
+                    sched.close()
+                    # a later submit() on a still-held reference lazily
+                    # rebuilds instead of hitting a closed scheduler
+                    cls._active._scheduler = None
             cls._active = None
 
     def _tpu_conf(self) -> TpuConf:
@@ -291,6 +299,52 @@ class Session:
         plan = resolve_subqueries(plan, self._collect_rows)
         return self._execute_resolved(plan)
 
+    # -- query service ------------------------------------------------------------
+    def scheduler(self):
+        """The session's lazily-created :class:`..service.scheduler.
+        QueryScheduler` (admission-controlled concurrent execution)."""
+        sched = getattr(self, "_scheduler", None)
+        if sched is None:
+            with Session._lock:
+                sched = getattr(self, "_scheduler", None)
+                if sched is None:
+                    from ..service.scheduler import QueryScheduler
+                    sched = self._scheduler = QueryScheduler(self)
+        return sched
+
+    def submit(self, df, *, priority: Optional[int] = None,
+               deadline_s: Optional[float] = None, tenant: str = "default",
+               weight: float = 1.0, label: Optional[str] = None):
+        """Submit a query for ASYNC execution through the session's
+        scheduler; returns a :class:`..service.scheduler.QueryHandle`
+        (future + cancel + per-query stats).  Sheds with
+        :class:`..service.scheduler.QueryRejected` when the admission
+        queue is full."""
+        return self.scheduler().submit(
+            df, priority=priority, deadline_s=deadline_s, tenant=tenant,
+            weight=weight, label=label)
+
+    @contextlib.contextmanager
+    def _control_scope(self, conf):
+        """Install a per-query cancellation/deadline control unless the
+        caller (scheduler worker, ``collect(timeout=)``) already did.
+        ``scheduler.deadlineMs`` > 0 gives synchronous queries a default
+        deadline; otherwise the scope is a pass-through (the engine's
+        batch-boundary checks cost one ContextVar read)."""
+        from ..service import cancel
+        existing = cancel.current()
+        if existing is not None:
+            yield existing
+            return
+        dl_ms = conf["spark.rapids.tpu.sql.scheduler.deadlineMs"]
+        if dl_ms <= 0:
+            yield None
+            return
+        ctl = cancel.QueryControl(label="session-query",
+                                  deadline_s=dl_ms / 1000.0)
+        with cancel.scope(ctl) as c:
+            yield c
+
     # -- query tracing ------------------------------------------------------------
     _query_seq = 0
 
@@ -298,13 +352,55 @@ class Session:
         """The per-query observability scope: query-scoped QueryStats
         (contextvars — concurrent queries never cross-account) plus, when
         ``sql.trace.enabled``, an active QueryTrace for the span tree."""
+        from ..service import cancel
         from ..utils import tracing
-        Session._query_seq += 1
-        label = f"query-{Session._query_seq:04d}"
+        with Session._lock:
+            Session._query_seq += 1
+            label = f"query-{Session._query_seq:04d}"
+        ctl = cancel.current()
+        if ctl is not None and ctl.label:
+            label = f"{label}[{ctl.label}]"
         return tracing.query_trace(
             label,
             enabled=conf["spark.rapids.tpu.sql.trace.enabled"],
             max_events=conf["spark.rapids.tpu.sql.trace.maxEvents"])
+
+    def _note_scheduler(self, tr) -> None:
+        """Fold the scheduler's per-query accounting into the trace:
+        a ``scheduler:queue_wait`` span (rendered at the head of the
+        timeline) plus scheduler attrs on the query's root event — the
+        Perfetto export shows where a query waited before running."""
+        from ..service import cancel
+        ctl = cancel.current()
+        if ctl is None:
+            return
+        if tr is not None:
+            ctl.trace = tr  # QueryHandle.trace() surfaces it post-hoc
+        if ctl.enqueued_t is None or tr is None:
+            return
+        from ..utils import tracing
+        tracing.record(None, "scheduler:queue_wait", "scheduler",
+                       ctl.enqueued_t, ctl.queue_wait_s,
+                       priority=ctl.priority, tenant=ctl.tenant)
+        tr.attrs.update({
+            "scheduler_label": ctl.label,
+            "priority": ctl.priority,
+            "tenant": ctl.tenant,
+            "queue_wait_s": round(ctl.queue_wait_s, 6)})
+
+    @staticmethod
+    def _trace_status(tr, exc: BaseException) -> None:
+        """Map the exception that ended execution onto the trace's span
+        status, so an aborted query's trace ends 'cancelled'."""
+        if tr is None or isinstance(exc, GeneratorExit):
+            return  # an abandoned stream (LIMIT) is not a failure
+        from ..service import cancel
+        if isinstance(exc, cancel.QueryDeadlineExceeded):
+            tr.set_status("deadline")
+        elif isinstance(exc, cancel.QueryCancelled):
+            tr.set_status("cancelled")
+        else:
+            tr.set_status("error")
 
     def _finish_trace(self, tr, ctx, stats) -> None:
         if tr is None:
@@ -356,19 +452,29 @@ class Session:
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
-        with QueryStats.scoped() as stats, self._trace_scope(conf) as tr:
-            with get_semaphore(conf).acquire():
-                phys = self._distribute_if_ici(phys, ctx)
-                if tr is not None:
-                    tr.register_plan(phys)
-                batches = [b for b in phys.execute(ctx) if b.num_rows > 0]
-                if not batches:
-                    out = None
-                else:
-                    whole = batches[0] if len(batches) == 1 else \
-                        batch_utils.concat_batches(batches)
-                    out = batch_utils.compact(whole)
-            self._finish_trace(tr, ctx, stats)
+        with QueryStats.scoped() as stats, self._control_scope(conf), \
+                self._trace_scope(conf) as tr:
+            try:
+                with get_semaphore(conf).acquire():
+                    phys = self._distribute_if_ici(phys, ctx)
+                    if tr is not None:
+                        tr.register_plan(phys)
+                    self._note_scheduler(tr)
+                    batches = [b for b in phys.execute(ctx)
+                               if b.num_rows > 0]
+                    if not batches:
+                        out = None
+                    else:
+                        whole = batches[0] if len(batches) == 1 else \
+                            batch_utils.concat_batches(batches)
+                        out = batch_utils.compact(whole)
+            except BaseException as e:
+                self._trace_status(tr, e)
+                raise
+            finally:
+                # the trace finishes (and auto-dumps) even for an
+                # aborted query, carrying its cancelled/deadline status
+                self._finish_trace(tr, ctx, stats)
             return out
 
     def _execute_resolved(self, plan: L.LogicalPlan):
@@ -382,14 +488,21 @@ class Session:
         # sess.profiled_explain())
         self._last_ctx = ctx
         self._last_phys = phys
-        with QueryStats.scoped() as stats, self._trace_scope(conf) as tr:
-            with get_semaphore(conf).acquire():
-                phys = self._distribute_if_ici(phys, ctx)
-                self._last_phys = phys
-                if tr is not None:
-                    tr.register_plan(phys)
-                out = CollectExec(phys).collect_arrow(ctx)
-            self._finish_trace(tr, ctx, stats)
+        with QueryStats.scoped() as stats, self._control_scope(conf), \
+                self._trace_scope(conf) as tr:
+            try:
+                with get_semaphore(conf).acquire():
+                    phys = self._distribute_if_ici(phys, ctx)
+                    self._last_phys = phys
+                    if tr is not None:
+                        tr.register_plan(phys)
+                    self._note_scheduler(tr)
+                    out = CollectExec(phys).collect_arrow(ctx)
+            except BaseException as e:
+                self._trace_status(tr, e)
+                raise
+            finally:
+                self._finish_trace(tr, ctx, stats)
             return out
 
     def last_exec_context(self):
@@ -406,14 +519,21 @@ class Session:
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
-        with QueryStats.scoped() as stats, self._trace_scope(conf) as tr:
-            with get_semaphore(conf).acquire():
-                phys = self._distribute_if_ici(phys, ctx)
-                if tr is not None:
-                    tr.register_plan(phys)
-                for b in phys.execute(ctx):
-                    yield to_arrow(b)
-            self._finish_trace(tr, ctx, stats)
+        with QueryStats.scoped() as stats, self._control_scope(conf), \
+                self._trace_scope(conf) as tr:
+            try:
+                with get_semaphore(conf).acquire():
+                    phys = self._distribute_if_ici(phys, ctx)
+                    if tr is not None:
+                        tr.register_plan(phys)
+                    self._note_scheduler(tr)
+                    for b in phys.execute(ctx):
+                        yield to_arrow(b)
+            except BaseException as e:
+                self._trace_status(tr, e)
+                raise
+            finally:
+                self._finish_trace(tr, ctx, stats)
 
     def _explain(self, plan: L.LogicalPlan) -> str:
         from ..plan.overrides import explain_plan

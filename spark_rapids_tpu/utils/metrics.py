@@ -85,6 +85,11 @@ class QueryStats:
         # input batches whose device buffers were donated to a fused
         # stage program (HBM reuse; plan/physical.StageExec)
         self.donated_batches = 0
+        # wall-clock this query waited in the service admission queue
+        # before starting (service/scheduler.py writes it; 0 for
+        # synchronous queries) — the bench concurrency mode derives
+        # service latency = queue wait + execution
+        self.queue_wait_s = 0.0
 
     # -- accessors ----------------------------------------------------------
     @classmethod

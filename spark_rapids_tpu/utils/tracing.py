@@ -39,8 +39,11 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
+from ..service import cancel as _cancel
+
 __all__ = ["QueryTrace", "active", "query_trace", "span", "record", "mark",
-           "instrument_batches", "render_profiled", "NULL_SPAN"]
+           "instrument_batches", "render_profiled", "NULL_SPAN",
+           "merge_chrome", "write_merged"]
 
 _pc = time.perf_counter
 
@@ -112,6 +115,10 @@ class QueryTrace:
         self.t0 = _pc()
         self.wall_start = time.time()
         self.t_end: Optional[float] = None
+        # span status of the whole query: 'ok' | 'cancelled' |
+        # 'deadline' | 'error' — the session sets it from the exception
+        # that ended execution, so an aborted query's trace says so
+        self.status = "ok"
         self.max_events = max_events
         self.dropped = 0
         # flat event log: (op_id, name, cat, rel_t0_s, dur_s, tid, args)
@@ -174,6 +181,9 @@ class QueryTrace:
     def duration_s(self) -> float:
         return (self.t_end if self.t_end is not None else _pc()) - self.t0
 
+    def set_status(self, status: str) -> None:
+        self.status = status
+
     def finish(self, metrics: Optional[dict] = None,
                stats: Optional[dict] = None) -> None:
         """Close the clock and absorb the query's accumulated accounting:
@@ -207,10 +217,12 @@ class QueryTrace:
         for tid, tname in sorted(self._tids.values()):
             evs.append({"ph": "M", "pid": pid, "tid": tid,
                         "name": "thread_name", "args": {"name": tname}})
+        qargs = dict(sorted(self.attrs.items()))
+        qargs["status"] = self.status
         evs.append({"ph": "X", "pid": pid, "tid": 0, "name": self.label,
                     "cat": "query", "ts": 0.0,
                     "dur": round(self.duration_s * 1e6, 1),
-                    "args": dict(sorted(self.attrs.items()))})
+                    "args": qargs})
         for op_id, name, cat, ts, dur, tid, args in self.events:
             a = {"op": op_id} if op_id else {}
             if args:
@@ -222,6 +234,7 @@ class QueryTrace:
             "traceEvents": evs,
             "displayTimeUnit": "ms",
             "otherData": {"label": self.label,
+                          "status": self.status,
                           "dropped_events": self.dropped,
                           "wall_s": round(self.duration_s, 6),
                           "wall_start_epoch_s": round(self.wall_start, 3)},
@@ -232,6 +245,48 @@ class QueryTrace:
         with open(path, "w") as f:
             json.dump(self.to_chrome(), f)
         return path
+
+
+def merge_chrome(traces) -> dict:
+    """Merge several queries' traces into ONE Chrome-trace dict: each
+    query becomes its own pid, with event timestamps offset to a common
+    epoch so concurrent queries genuinely overlap on the Perfetto
+    timeline.  The per-query plan-shaped trees ride in a ``spanTrees``
+    list (``tools/trace_report.py`` renders per-query sections plus a
+    contention summary from this form)."""
+    traces = [t for t in traces if t is not None]
+    if not traces:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "otherData": {"label": "merged", "queries": 0},
+                "spanTrees": []}
+    epoch = min(t.wall_start for t in traces)
+    evs: List[dict] = []
+    span_trees: List[dict] = []
+    for i, tr in enumerate(sorted(traces, key=lambda t: t.wall_start), 1):
+        sub = tr.to_chrome()
+        off = round((tr.wall_start - epoch) * 1e6, 1)
+        for e in sub["traceEvents"]:
+            e = dict(e)
+            e["pid"] = i
+            if e.get("ph") == "X":
+                e["ts"] = round(e["ts"] + off, 1)
+            evs.append(e)
+        span_trees.append({"label": tr.label, "pid": i,
+                           "status": tr.status,
+                           "start_offset_s": round(tr.wall_start - epoch, 6),
+                           "wall_s": round(tr.duration_s, 6),
+                           "dropped_events": tr.dropped,
+                           "roots": sub["spanTree"]})
+    return {"traceEvents": evs, "displayTimeUnit": "ms",
+            "otherData": {"label": "merged", "queries": len(span_trees),
+                          "wall_start_epoch_s": round(epoch, 3)},
+            "spanTrees": span_trees}
+
+
+def write_merged(traces, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(merge_chrome(traces), f)
+    return path
 
 
 # ---------------------------------------------------------------------------------
@@ -308,6 +363,12 @@ def instrument_batches(op_id: str, op_name: str, metrics,
     EXPLAIN surface, populated for EVERY operator with no opt-out."""
     try:
         while True:
+            # the engine's universal cancellation checkpoint: every
+            # batch pull through every operator passes here, so a
+            # cancelled/expired query aborts at the next batch boundary
+            # on whatever thread is driving it (one ContextVar read when
+            # no control is installed)
+            _cancel.check()
             t0 = _pc()
             try:
                 b = next(it)

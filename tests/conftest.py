@@ -75,3 +75,14 @@ def pytest_collection_modifyitems(config, items):
             "raw clock reads bypassing the span API — use MetricSet.time"
             " or utils.tracing.span (tools/check_span_timing.py):\n"
             f"{lines}")
+    # (c) a worker thread created without joining the query's
+    # contextvars escapes per-query stats/trace/cancellation
+    from tools.check_ctx_threads import check as check_threads
+    violations = check_threads()
+    if violations:
+        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
+                          for rel, ln, src in violations)
+        raise pytest.UsageError(
+            "threads that don't join query contextvars — run work via "
+            "contextvars.copy_context() or mark '# ctx-ok' "
+            f"(tools/check_ctx_threads.py):\n{lines}")

@@ -1,0 +1,389 @@
+"""Query service tests: scheduler, admission control, deadlines,
+cancellation (spark_rapids_tpu/service/).
+
+The contracts under test:
+  (a) concurrent TPC-H slices return correct, ISOLATED results — and
+      per-query QueryStats sums reconcile with the process aggregate
+      (zero cross-query accounting bleed);
+  (b) priority ordering is honored; a full admission queue sheds with a
+      typed QueryRejected;
+  (c) cancellation mid-pipeline leaks no spill handles or semaphore
+      permits (SpillCatalog.assert_no_leaks) and the trace ends with a
+      cancelled span status;
+  (d) deadline expiry aborts a long scan (collect(timeout=) and the
+      scheduler.deadlineMs conf).
+"""
+
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.service import (QueryCancelled, QueryControl,
+                                      QueryDeadlineExceeded, QueryRejected,
+                                      QueryScheduler)
+from spark_rapids_tpu.sql import functions as F
+
+SLICE = ["q1", "q3", "q6", "q13"]
+
+
+@pytest.fixture(scope="module")
+def tpch(session, tmp_path_factory):
+    from spark_rapids_tpu.models import tpch_suite
+    out = str(tmp_path_factory.mktemp("tpch_sched"))
+    return tpch_suite.load_db(session, 0.002, out)
+
+
+def _slow_df(sess, n_batches=100, rows=512, delay=0.02):
+    """A DataFrame over a scan whose decode is slow — cancellation and
+    deadlines land mid-scan at a batch boundary."""
+    from spark_rapids_tpu.batch import Field, Schema, _arrow_to_logical
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.sql.dataframe import DataFrame
+    tbl = pa.table({"k": [0], "v": [0.0]})
+    schema = Schema([Field(n, _arrow_to_logical(t), True)
+                     for n, t in zip(tbl.column_names, tbl.schema.types)])
+
+    def factory():
+        for _ in range(n_batches):
+            time.sleep(delay)
+            yield pa.table({"k": [j % 7 for j in range(rows)],
+                            "v": [float(j) for j in range(rows)]})
+
+    node = L.LogicalScan(schema, factory, "slow-source", fmt="memory")
+    return DataFrame(node, sess)
+
+
+# ---------------------------------------------------------------------------
+# (a) concurrent correctness + isolation
+# ---------------------------------------------------------------------------
+
+def test_concurrent_tpch_isolated(session, tpch):
+    from spark_rapids_tpu.models import tpch_suite
+    from spark_rapids_tpu.utils.metrics import QueryStats
+    serial = {}
+    for name in SLICE:
+        runner, _ = tpch_suite.QUERIES[name]
+        serial[name] = runner(tpch)
+    session.conf.set("spark.rapids.tpu.sql.scheduler.maxConcurrent", 3)
+    try:
+        stats0 = QueryStats.get().snapshot()
+        handles = {
+            name: session.submit(
+                (lambda r=tpch_suite.QUERIES[name][0]: r(tpch)),
+                label=name)
+            for name in SLICE}
+        results = {n: h.result(timeout=120) for n, h in handles.items()}
+        delta = QueryStats.delta_since(stats0)
+    finally:
+        session.conf.unset("spark.rapids.tpu.sql.scheduler.maxConcurrent")
+    for name in SLICE:
+        assert handles[name].status == "done"
+        assert tpch_suite.rows_rel_err(results[name], serial[name]) < 1e-6, \
+            f"{name} diverged under concurrency"
+    # per-query scopes fold into the process aggregate: the sums must
+    # reconcile exactly or accounting bled across queries
+    for key in ("blocking_fetches", "async_fetches", "fetch_bytes"):
+        total = sum(h.stats[key] for h in handles.values())
+        assert total == delta[key], \
+            f"{key}: per-query sum {total} != process delta {delta[key]}"
+    for h in handles.values():
+        assert h.latency_s is not None and h.latency_s >= 0
+        assert h.stats["queue_wait_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# (b) priority ordering + overload shedding
+# ---------------------------------------------------------------------------
+
+def test_priority_ordering():
+    sched = QueryScheduler(settings={
+        "spark.rapids.tpu.sql.scheduler.maxConcurrent": 1,
+        "spark.rapids.tpu.sql.scheduler.queueDepth": 8})
+    try:
+        gate = threading.Event()
+        order = []
+        blocker = sched.submit(lambda: gate.wait(10), label="blocker")
+        while sched.running() == 0:
+            time.sleep(0.005)
+        lo = sched.submit(lambda: order.append("lo"), priority=0)
+        hi = sched.submit(lambda: order.append("hi"), priority=5)
+        gate.set()
+        blocker.result(10)
+        lo.result(10)
+        hi.result(10)
+        assert order == ["hi", "lo"], \
+            f"priority ordering violated: {order}"
+    finally:
+        sched.close()
+
+
+def test_queue_full_sheds_with_queryrejected():
+    sched = QueryScheduler(settings={
+        "spark.rapids.tpu.sql.scheduler.maxConcurrent": 1,
+        "spark.rapids.tpu.sql.scheduler.queueDepth": 1})
+    try:
+        gate = threading.Event()
+        blocker = sched.submit(lambda: gate.wait(10), label="blocker")
+        while sched.running() == 0:
+            time.sleep(0.005)
+        queued = sched.submit(lambda: "q", label="queued")
+        with pytest.raises(QueryRejected, match="queue full"):
+            sched.submit(lambda: "shed", label="shed")
+        assert sched.snapshot()["rejected"] == 1
+        gate.set()
+        assert queued.result(10) == "q"
+        blocker.result(10)
+    finally:
+        sched.close()
+
+
+def test_weighted_fair_tenants():
+    """At equal priority, the tenant with LESS accumulated service (per
+    unit weight) dispatches first."""
+    sched = QueryScheduler(settings={
+        "spark.rapids.tpu.sql.scheduler.maxConcurrent": 1})
+    try:
+        gate = threading.Event()
+        order = []
+        blocker = sched.submit(lambda: gate.wait(10), tenant="greedy")
+        while sched.running() == 0:
+            time.sleep(0.005)
+        # pre-charge 'greedy' with virtual time, as if it had already
+        # consumed service
+        with sched._cv:
+            sched._vtime["greedy"] = 10.0
+        a = sched.submit(lambda: order.append("greedy"), tenant="greedy")
+        b = sched.submit(lambda: order.append("fresh"), tenant="fresh")
+        gate.set()
+        blocker.result(10)
+        a.result(10)
+        b.result(10)
+        assert order == ["fresh", "greedy"]
+    finally:
+        sched.close()
+
+
+def test_cancel_queued_entry():
+    sched = QueryScheduler(settings={
+        "spark.rapids.tpu.sql.scheduler.maxConcurrent": 1})
+    try:
+        gate = threading.Event()
+        blocker = sched.submit(lambda: gate.wait(10))
+        while sched.running() == 0:
+            time.sleep(0.005)
+        queued = sched.submit(lambda: "never")
+        assert queued.cancel("test") is True
+        assert queued.status == "cancelled"
+        with pytest.raises(QueryCancelled):
+            queued.result(5)
+        gate.set()
+        blocker.result(10)
+    finally:
+        sched.close()
+
+
+def test_closed_scheduler_rejects():
+    sched = QueryScheduler()
+    sched.close()
+    with pytest.raises(QueryRejected, match="closed"):
+        sched.submit(lambda: 1)
+
+
+# ---------------------------------------------------------------------------
+# (c) cancellation mid-pipeline: no leaked permits/handles, trace status
+# ---------------------------------------------------------------------------
+
+def test_cancel_mid_query_releases_everything(session):
+    from spark_rapids_tpu.memory.spill import get_catalog
+    from spark_rapids_tpu.runtime.semaphore import get_semaphore
+    session.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+    # force partial/exchange/final aggregation so the exchange registers
+    # spillable staging handles the abort must release
+    session.conf.set("spark.rapids.tpu.sql.agg.singleProcessComplete",
+                     False)
+    try:
+        df = _slow_df(session)
+        agg = df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+        h = session.submit(agg, label="to-cancel")
+        deadline = time.time() + 10
+        while h.status == "queued" and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)  # let it get into the scan
+        assert h.cancel("test cancellation") is True
+        with pytest.raises(QueryCancelled):
+            h.result(timeout=30)
+        assert h.status == "cancelled"
+        conf = session._tpu_conf()
+        catalog = get_catalog(conf)
+        catalog.assert_no_leaks()
+        sem = get_semaphore(conf)
+        assert sem.available() == sem.permits, \
+            "cancelled query leaked semaphore permits"
+        tr = h.trace()
+        assert tr is not None and tr.status == "cancelled"
+        assert tr.to_chrome()["otherData"]["status"] == "cancelled"
+    finally:
+        session.conf.unset("spark.rapids.tpu.sql.trace.enabled")
+        session.conf.unset(
+            "spark.rapids.tpu.sql.agg.singleProcessComplete")
+
+
+# ---------------------------------------------------------------------------
+# (d) deadlines abort a long scan
+# ---------------------------------------------------------------------------
+
+def test_collect_timeout_aborts_long_scan(session):
+    from spark_rapids_tpu.memory.spill import get_catalog
+    df = _slow_df(session)
+    t0 = time.time()
+    with pytest.raises(QueryDeadlineExceeded):
+        df.collect(timeout=0.3)
+    # cooperative: lands at the next batch boundary, far before the
+    # ~2 s the full scan would take
+    assert time.time() - t0 < 1.5
+    get_catalog(session._tpu_conf()).assert_no_leaks()
+
+
+def test_conf_deadline_aborts(session):
+    session.conf.set("spark.rapids.tpu.sql.scheduler.deadlineMs", 300)
+    try:
+        df = _slow_df(session)
+        with pytest.raises(QueryDeadlineExceeded):
+            df.collect()
+    finally:
+        session.conf.unset("spark.rapids.tpu.sql.scheduler.deadlineMs")
+
+
+def test_deadline_trace_status(session):
+    session.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+    try:
+        df = _slow_df(session)
+        with pytest.raises(QueryDeadlineExceeded):
+            df.collect(timeout=0.3)
+        tr = session.last_trace()
+        assert tr is not None and tr.status == "deadline"
+    finally:
+        session.conf.unset("spark.rapids.tpu.sql.trace.enabled")
+
+
+def test_scheduler_deadline_status(session):
+    df = _slow_df(session)
+    h = session.submit(df, deadline_s=0.3, label="deadline-query")
+    with pytest.raises(QueryDeadlineExceeded):
+        h.result(timeout=30)
+    assert h.status == "deadline"
+
+
+# ---------------------------------------------------------------------------
+# control primitives
+# ---------------------------------------------------------------------------
+
+def test_query_control_wakers_and_check():
+    from spark_rapids_tpu.service import cancel
+    ctl = QueryControl(label="t")
+    fired = []
+    tok = ctl.add_waker(lambda: fired.append(1))
+    assert ctl.status == "ok"
+    ctl.check()  # no-op while live
+    assert ctl.cancel("stop") is True
+    assert fired == [1]
+    assert ctl.cancel("again") is False  # idempotent
+    assert ctl.status == "cancelled"
+    with pytest.raises(QueryCancelled):
+        ctl.check()
+    ctl.remove_waker(tok)
+    # a waker added after cancellation fires immediately
+    late = []
+    ctl.add_waker(lambda: late.append(1))
+    assert late == [1]
+    # module-level check is a no-op outside any scope
+    cancel.check()
+    with cancel.scope(ctl):
+        with pytest.raises(QueryCancelled):
+            cancel.check()
+
+
+def test_deadline_timer_fires_wakers():
+    ev = threading.Event()
+    ctl = QueryControl(label="t", deadline_s=0.15)
+    ctl.add_waker(ev.set)
+    from spark_rapids_tpu.service import cancel
+    with cancel.scope(ctl):
+        assert ev.wait(2.0), "deadline timer never fired the waker"
+        assert ctl.status == "deadline"
+        with pytest.raises(QueryDeadlineExceeded):
+            ctl.check()
+
+
+def test_semaphore_resize_in_place(session):
+    from spark_rapids_tpu.runtime.semaphore import get_semaphore
+    conf = session._tpu_conf()
+    sem = get_semaphore(conf)
+    base = sem.permits
+    try:
+        sem2 = get_semaphore(conf.with_settings(
+            **{"spark.rapids.tpu.sql.concurrentTpuTasks": base + 2}))
+        assert sem2 is sem, "resize must keep the same instance"
+        assert sem.permits == base + 2
+        assert sem.available() == base + 2
+    finally:
+        get_semaphore(conf.with_settings(
+            **{"spark.rapids.tpu.sql.concurrentTpuTasks": base}))
+        assert sem.permits == base
+
+
+def test_semaphore_acquire_cancellable():
+    """A thread blocked on the semaphore aborts the moment its query is
+    cancelled — event-driven, no poll interval."""
+    from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+    from spark_rapids_tpu.service import cancel
+    sem = TpuSemaphore(1)
+    ctl = QueryControl(label="blocked")
+    errs = []
+
+    def holder():
+        with sem.acquire():
+            release.wait(10)
+
+    def blocked():
+        with cancel.scope(ctl):
+            try:
+                with sem.acquire():
+                    pass
+            except QueryCancelled as e:
+                errs.append(e)
+
+    release = threading.Event()
+    th = threading.Thread(target=holder)
+    th.start()
+    while sem.available() > 0:
+        time.sleep(0.005)
+    tb = threading.Thread(target=blocked)
+    tb.start()
+    time.sleep(0.1)
+    ctl.cancel("stop waiting")
+    tb.join(timeout=2.0)
+    assert not tb.is_alive(), "cancelled acquire stayed blocked"
+    assert len(errs) == 1
+    release.set()
+    th.join(timeout=2.0)
+    assert sem.available() == 1
+
+
+def test_scheduler_queue_wait_in_trace(session):
+    session.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+    try:
+        df = session.range(1000)
+        h = session.submit(df, label="traced")
+        h.result(timeout=30)
+        tr = h.trace()
+        assert tr is not None
+        assert tr.attrs.get("scheduler_label") == "traced"
+        assert "queue_wait_s" in tr.attrs
+        names = {e[1] for e in tr.events}
+        assert "scheduler:queue_wait" in names
+    finally:
+        session.conf.unset("spark.rapids.tpu.sql.trace.enabled")

@@ -67,6 +67,51 @@ def test_trace_report_main(sess, tmp_path, capsys):
     assert trace_report.main([]) == 2
 
 
+def test_trace_report_merged_concurrent(sess, tmp_path, capsys):
+    """A merged multi-query trace renders per-query sections plus a
+    contention summary instead of assuming one serial query."""
+    from spark_rapids_tpu.utils import tracing
+    sess.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+    try:
+        rng = np.random.default_rng(7)
+        df = sess.create_dataframe({"k": rng.integers(0, 20, 10000),
+                                    "v": rng.random(10000)})
+        q = df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
+        handles = [sess.submit(q, label=f"conc-{i}") for i in range(3)]
+        for h in handles:
+            h.result(timeout=60)
+    finally:
+        sess.conf.unset("spark.rapids.tpu.sql.trace.enabled")
+    traces = [h.trace() for h in handles]
+    assert all(t is not None for t in traces)
+    path = str(tmp_path / "merged.trace.json")
+    tracing.write_merged(traces, path)
+    data = trace_report.load(path)
+    # one pid + spanTrees entry per query
+    assert len(data["spanTrees"]) == 3
+    assert {st["pid"] for st in data["spanTrees"]} == {1, 2, 3}
+    subs, span_trees = trace_report.split_queries(data)
+    assert len(subs) == 3 and span_trees is not None
+    for sub in subs:
+        a = trace_report.analyze(sub)
+        assert a["wall_s"] > 0
+        assert a["operators"], "per-query section lost its operators"
+    c = trace_report.contention(span_trees)
+    assert c["queries"] == 3
+    assert c["span_s"] > 0
+    assert c["sum_walls_s"] >= c["span_s"] * 0.99
+    assert 1 <= c["peak_concurrency"] <= 3
+    assert c["statuses"] == {"ok": 3}
+    assert trace_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "contention summary (3 concurrent queries)" in out
+    assert "aggregate throughput" in out
+    # a single-query trace still renders the old way
+    single = _trace_file(sess, tmp_path)
+    subs1, st1 = trace_report.split_queries(trace_report.load(single))
+    assert len(subs1) == 1 and st1 is None
+
+
 # ---------------------------------------------------------------------------------
 # bench_compare
 # ---------------------------------------------------------------------------------

@@ -1,0 +1,85 @@
+"""Lint: worker threads must join the query's contextvars.
+
+Per-query accounting (``QueryStats.scoped``), tracing
+(``utils/tracing``), and cooperative cancellation (``service/cancel``)
+all travel in contextvars.  A ``threading.Thread`` or
+``ThreadPoolExecutor`` whose work does NOT run under
+``contextvars.copy_context()`` silently escapes all three: its fetches
+cross-account into the process aggregate, its spans vanish from the
+query trace, and — worst — it keeps running after the query is
+cancelled.  This check greps ``spark_rapids_tpu/`` for thread/pool
+creation sites and requires each to either:
+
+  * visibly run its work through a copied context — ``copy_context`` /
+    ``cctx.run`` (or any ``*ctx.run``) within a few lines of the
+    creation site (the shared traced-pool idiom: capture
+    ``contextvars.copy_context()`` and submit ``cctx.run(fn, ...)``); or
+  * carry an explicit ``# ctx-ok (<why>)`` comment for provably
+    non-query infrastructure (DCN control-plane servers, heartbeats,
+    the scheduler's own dispatcher).
+
+Run standalone (``python tools/check_ctx_threads.py``, exit 1 on
+violations) or let the test suite run it: tests/conftest.py invokes
+:func:`check` at collection time alongside the fetch and span lints.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_rapids_tpu")
+
+_CREATE = re.compile(r"\bthreading\.Thread\s*\(|\bThreadPoolExecutor\s*\(")
+# evidence the work joins a copied context: the idiom captures
+# contextvars.copy_context() and runs the target through <name>ctx.run
+_CTX_JOIN = re.compile(r"copy_context|ctx\.run\b")
+_EXEMPT = "# ctx-ok"
+_WINDOW = 3  # lines of context around the creation site
+
+
+def check(root: str = PKG) -> List[Tuple[str, int, str]]:
+    """Return [(relpath, lineno, line)] thread creations that neither
+    join a copied context nor carry a ``# ctx-ok`` exemption."""
+    violations: List[Tuple[str, int, str]] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+            for i, line in enumerate(lines):
+                if not _CREATE.search(line):
+                    continue
+                lo = max(0, i - _WINDOW)
+                hi = min(len(lines), i + _WINDOW + 1)
+                window = "".join(lines[lo:hi])
+                if _EXEMPT in window or _CTX_JOIN.search(window):
+                    continue
+                violations.append(
+                    (os.path.relpath(path, root), i + 1, line.strip()))
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if not violations:
+        print("check_ctx_threads: all worker threads join query contexts")
+        return 0
+    print("check_ctx_threads: threads created without joining the "
+          "query's contextvars (stats/trace/cancellation would escape "
+          "per-query accounting):", file=sys.stderr)
+    for rel, lineno, line in violations:
+        print(f"  spark_rapids_tpu/{rel}:{lineno}: {line}", file=sys.stderr)
+    print("run the work via contextvars.copy_context() "
+          "(cctx.run(fn, ...)), or mark provably non-query "
+          "infrastructure with '# ctx-ok (<why>)'.", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,9 @@
 """Summarize a query trace file (Chrome trace events + spanTree).
 
 Reads a trace written by the engine (``spark.rapids.tpu.sql.trace.dir``,
-``SRT_BENCH_TRACE_DIR``, or ``Session.last_trace().write(...)``) and
-prints:
+``SRT_BENCH_TRACE_DIR``, ``Session.last_trace().write(...)``, or a
+MERGED multi-query trace from ``utils.tracing.write_merged`` — the
+bench concurrency mode and the query service emit those) and prints:
 
   * the hot-operator table: per-operator SELF time (operator interval
     minus nested child-operator intervals on the same thread), total
@@ -12,6 +13,11 @@ prints:
   * the overlap ratio: thread-busy time over wall time (1.0 = fully
     serial; >1 means the pipeline actually overlapped host and device
     work).
+
+A trace containing several overlapping query span trees (the merged
+``spanTrees`` form, one pid per query) renders one section per query
+plus a **contention summary**: the span of the whole batch, per-query
+concurrency overlap, peak concurrency, and aggregate throughput.
 
 Usage: ``python tools/trace_report.py TRACE.json [TRACE2.json ...]``
 """
@@ -45,8 +51,74 @@ def _op_meta(span_tree: List[dict]) -> Dict[str, dict]:
     return out
 
 
+def split_queries(data: dict):
+    """Decompose a trace into per-query sub-traces.
+
+    A single-query trace (the ``spanTree`` form) passes through as-is.
+    A merged multi-query trace (``spanTrees``: one entry and one pid per
+    query, overlapping timestamps) splits by pid; the second return
+    value carries the merged metadata for the contention summary.
+    """
+    span_trees = data.get("spanTrees")
+    if not span_trees:
+        return [data], None
+    by_pid: Dict[int, list] = {}
+    for e in data.get("traceEvents", []):
+        by_pid.setdefault(e.get("pid", 1), []).append(e)
+    subs = []
+    for st in span_trees:
+        pid = st.get("pid", 1)
+        subs.append({
+            "traceEvents": by_pid.get(pid, []),
+            "spanTree": st.get("roots", []),
+            "otherData": {"label": st.get("label", f"pid-{pid}"),
+                          "status": st.get("status", "ok"),
+                          "dropped_events": st.get("dropped_events", 0)},
+        })
+    return subs, span_trees
+
+
+def contention(span_trees: List[dict]) -> dict:
+    """Cross-query contention numbers for a merged trace: where queries
+    overlapped, how deep the concurrency went, and the batch throughput."""
+    ivs = sorted((st.get("start_offset_s", 0.0),
+                  st.get("start_offset_s", 0.0) + st.get("wall_s", 0.0))
+                 for st in span_trees)
+    marks = sorted({t for iv in ivs for t in iv})
+    overlap_s = 0.0
+    busy_s = 0.0
+    peak = 0
+    for lo, hi in zip(marks, marks[1:]):
+        n = sum(1 for s, t in ivs if s <= lo and t >= hi)
+        peak = max(peak, n)
+        if n >= 1:
+            busy_s += hi - lo
+        if n >= 2:
+            overlap_s += hi - lo
+    span_s = (max(t for _, t in ivs) - min(s for s, _ in ivs)) \
+        if ivs else 0.0
+    sum_walls = sum(t - s for s, t in ivs)
+    statuses: Dict[str, int] = {}
+    for st in span_trees:
+        s = st.get("status", "ok")
+        statuses[s] = statuses.get(s, 0) + 1
+    return {
+        "queries": len(span_trees),
+        "span_s": span_s,
+        "sum_walls_s": sum_walls,
+        "overlap_s": overlap_s,
+        "busy_s": busy_s,
+        "peak_concurrency": peak,
+        # >1 means the service genuinely ran queries side by side
+        "concurrency_ratio": (sum_walls / span_s) if span_s else 0.0,
+        "throughput_qps": (len(span_trees) / span_s) if span_s else 0.0,
+        "statuses": statuses,
+    }
+
+
 def analyze(data: dict) -> dict:
-    """Compute the report's numbers from a loaded trace dict."""
+    """Compute the report's numbers from a loaded (single-query) trace
+    dict."""
     events = data.get("traceEvents", [])
     xs = [e for e in events if e.get("ph") == "X"]
     query = next((e for e in xs if e.get("cat") == "query"), None)
@@ -125,6 +197,8 @@ def analyze(data: dict) -> dict:
     self_total_us = sum(e["self_us"] for e in per_op.values())
     return {
         "label": data.get("otherData", {}).get("label", "?"),
+        "status": data.get("otherData", {}).get(
+            "status", qargs.get("status", "ok")),
         "wall_s": wall_us / 1e6,
         "n_events": len(xs),
         "dropped": data.get("otherData", {}).get("dropped_events", 0),
@@ -148,9 +222,11 @@ def analyze(data: dict) -> dict:
 
 
 def format_report(a: dict) -> str:
+    status = f"  status={a['status']}" if a.get("status", "ok") != "ok" \
+        else ""
     lines = [
         f"query {a['label']}: wall={a['wall_s'] * 1e3:.1f}ms  "
-        f"events={a['n_events']} (dropped={a['dropped']})",
+        f"events={a['n_events']} (dropped={a['dropped']}){status}",
         "",
         "hot operators (self time):",
         f"  {'self_ms':>9} {'total_ms':>9} {'rows':>10} "
@@ -178,12 +254,36 @@ def format_report(a: dict) -> str:
     return "\n".join(lines)
 
 
+def format_contention(c: dict) -> str:
+    stat = " ".join(f"{k}={v}" for k, v in sorted(c["statuses"].items()))
+    return "\n".join([
+        f"contention summary ({c['queries']} concurrent queries):",
+        f"  batch span: {c['span_s'] * 1e3:.1f}ms  "
+        f"sum of walls: {c['sum_walls_s'] * 1e3:.1f}ms  "
+        f"(concurrency ratio {c['concurrency_ratio']:.2f})",
+        f"  >=2 queries in flight for {c['overlap_s'] * 1e3:.1f}ms  "
+        f"peak concurrency: {c['peak_concurrency']}",
+        f"  aggregate throughput: {c['throughput_qps']:.2f} queries/s",
+        f"  statuses: {stat}",
+    ])
+
+
+def report_file(data: dict) -> str:
+    """Render one trace file: a single-query report, or per-query
+    sections + a contention summary for a merged multi-query trace."""
+    subs, span_trees = split_queries(data)
+    parts = [format_report(analyze(s)) for s in subs]
+    if span_trees:
+        parts.append(format_contention(contention(span_trees)))
+    return ("\n" + "- " * 36 + "\n").join(parts)
+
+
 def main(argv: List[str]) -> int:
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     for path in argv:
-        print(format_report(analyze(load(path))))
+        print(report_file(load(path)))
         if len(argv) > 1:
             print("-" * 72)
     return 0
