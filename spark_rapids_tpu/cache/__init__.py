@@ -1,0 +1,25 @@
+"""Cross-query device caching (scan tier + broadcast-build tier).
+
+See :mod:`.device_cache` for the architecture and ``docs/caching.md``
+for the operator story.  Key derivation lives in :mod:`.keys` — the
+ONLY place cache keys may be constructed (``tools/check_cache_keys.py``).
+"""
+
+from .device_cache import (CachedBuildHandle, CacheEntry, QueryCache,
+                           batch_bytes, clear_query_cache, get_query_cache,
+                           invalidate_path)
+from .keys import CacheKey, broadcast_key, plan_fingerprint, scan_key
+
+__all__ = [
+    "QueryCache", "CacheEntry", "CachedBuildHandle", "CacheKey",
+    "get_query_cache", "clear_query_cache", "invalidate_path",
+    "batch_bytes", "scan_key", "broadcast_key", "plan_fingerprint",
+]
+
+
+def cache_enabled(conf, tier: str) -> bool:
+    """One gate for every call site: the cache engages only when both the
+    master switch and the tier switch are on."""
+    if not conf["spark.rapids.tpu.sql.cache.enabled"]:
+        return False
+    return conf[f"spark.rapids.tpu.sql.cache.{tier}.enabled"]

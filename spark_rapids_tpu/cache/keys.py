@@ -1,0 +1,129 @@
+"""THE central cache-key derivation for the cross-query device cache.
+
+Every insertion into (and lookup against) :class:`.device_cache.QueryCache`
+must present a :class:`CacheKey` built HERE — :mod:`tools.check_cache_keys`
+rejects ``CacheKey(...)`` constructions anywhere else and inline-literal
+keys at the cache API call sites.  One derivation site means the identity
+rules (what makes two scans "the same data", what invalidates on a write)
+can never silently diverge between the scan tier, the broadcast tier, and
+the invalidation hooks — the same single-definition discipline as
+``io/parquet._dv_fingerprint``.
+
+Scan identity composes the SOURCE's own ``cache_token()`` (files with
+mtime+size, projection, pushed predicates, deletion vectors, renames —
+``io/parquet.ParquetSource.cache_token``; ``io/sources.FileSource`` grew
+the same contract) with the upload shape (capacity bucket floor, device).
+Broadcast identity is a structural fingerprint of the build subtree:
+scan leaves contribute their source tokens, fused stages their expression
+fingerprints; any operator without a stable identity makes the subtree
+uncacheable (conservative — a wrong hit would be silent corruption).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+__all__ = ["CacheKey", "scan_key", "broadcast_key", "plan_fingerprint"]
+
+
+@dataclass(frozen=True)
+class CacheKey:
+    """Identity of one cache entry.
+
+    ``tier`` is "scan" or "broadcast".  ``base`` is everything identity-
+    relevant EXCEPT the projection; ``cols`` is the projection (scan tier;
+    ``None`` = all columns), kept separate so a superset-projection entry
+    can serve a narrower scan by slicing instead of re-uploading.
+    ``paths`` carries the absolute source file paths for prefix
+    invalidation (``io/writers`` / Delta commits).
+    """
+
+    tier: str
+    base: tuple
+    cols: Optional[Tuple[str, ...]] = None
+    paths: Tuple[str, ...] = ()
+
+    def group(self) -> tuple:
+        """Entries sharing a group differ only by projection."""
+        return (self.tier, self.base)
+
+
+def scan_key(source, min_capacity: int, device) -> Optional[CacheKey]:
+    """Key for a ScanExec's uploaded output, or None when the source has
+    no stable identity (in-memory frames, exchange-fed pseudo-sources)."""
+    token_fn = getattr(source, "cache_token", None)
+    if token_fn is None:
+        return None
+    token = token_fn()
+    if token is None:
+        return None
+    # ParquetSource/FileSource token layout: (files, cols, preds, ...rest)
+    files, cols = token[0], token[1]
+    rest = token[2:]
+    paths = tuple(f[0] for f in files)
+    base = (getattr(source, "fmt", "file"), files, rest,
+            int(min_capacity), str(device))
+    return CacheKey("scan", base,
+                    cols=tuple(cols) if cols is not None else None,
+                    paths=paths)
+
+
+def broadcast_key(build_child, compact: bool, device) -> Optional[CacheKey]:
+    """Key for a broadcast exchange's materialized build side: the build
+    subtree's structural fingerprint + the output schema + the
+    materialization mode (``compact=False`` keeps selection masks for the
+    dense-join kernels, so the two modes cache separately)."""
+    fp = plan_fingerprint(build_child)
+    if fp is None:
+        return None
+    fingerprint, paths = fp
+    schema = build_child.output_schema
+    sig = tuple((f.name, str(f.dtype), f.nullable) for f in schema)
+    base = (fingerprint, sig, bool(compact), str(device))
+    return CacheKey("broadcast", base, paths=paths)
+
+
+def plan_fingerprint(node):
+    """Structural identity of a physical subtree, or None when any
+    operator in it has no stable identity.  Returns (fingerprint tuple,
+    source paths for invalidation)."""
+    from ..plan.coalesce import CoalesceBatchesExec
+    from ..plan.physical import ScanExec, StageExec
+
+    if isinstance(node, ScanExec):
+        # DPP-narrowed scans are per-query state; with_pushdown folds the
+        # runtime predicates into the token so they key distinctly
+        token_fn = getattr(node._effective_source(), "cache_token", None)
+        token = token_fn() if token_fn is not None else None
+        if token is None:
+            return None
+        paths = tuple(f[0] for f in token[0])
+        return ("scan", token), paths
+    if isinstance(node, StageExec):
+        if node.host_exprs:
+            # host-evaluated expressions may read per-batch context
+            # (input_file_name, partition id): not provably pure
+            return None
+        child = plan_fingerprint(node.children[0])
+        if child is None:
+            return None
+        return ("stage", node.fingerprint(), child[0]), child[1]
+    if isinstance(node, CoalesceBatchesExec):
+        child = plan_fingerprint(node.children[0])
+        if child is None:
+            return None
+        return ("coalesce", node.node_desc(), child[0]), child[1]
+    return None
+
+
+def path_covers(key: CacheKey, prefix: str) -> bool:
+    """True when any of the key's source files lives under ``prefix`` —
+    the invalidation predicate (write hooks pass the table/directory
+    path; keys carry absolute file paths)."""
+    pre = os.path.abspath(prefix)
+    for p in key.paths:
+        if p == pre or p.startswith(pre + os.sep):
+            return True
+    return False

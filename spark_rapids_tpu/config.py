@@ -444,6 +444,51 @@ FILE_CACHE_DEVICE_MAX_BYTES = register(
     "spark.rapids.tpu.sql.fileCache.device.maxBytes", 2 << 30,
     "HBM byte budget for the device tier of the file cache.")
 
+CACHE_ENABLED = register(
+    "spark.rapids.tpu.sql.cache.enabled", False,
+    "Master switch for the CROSS-QUERY device cache "
+    "(spark_rapids_tpu/cache/): uploaded scan batches and materialized "
+    "broadcast build sides stay HBM-resident across queries, keyed by "
+    "source fingerprint (files+mtime+size, projection, pushed filters) "
+    "so a write invalidates. Cached bytes are registered with the spill "
+    "catalog at a priority BELOW live query state — memory pressure "
+    "demotes cold cache entries to host/disk before touching a running "
+    "query, never OOMs it. The concurrent-service replay (bench "
+    "SRT_BENCH_CONCURRENCY) is the headline beneficiary: tenants "
+    "replaying the same tables skip decode, H2D upload, and broadcast "
+    "hash-build entirely.")
+
+CACHE_MAX_BYTES = register(
+    "spark.rapids.tpu.sql.cache.maxBytes", 2 << 30,
+    "Byte budget for the cross-query cache (device + host-string bytes "
+    "of cached batches). Least-recently-used entries not held by a "
+    "running query are dropped beyond it; entries a query currently "
+    "holds are never dropped (refcounted).")
+
+CACHE_SCAN_ENABLED = register(
+    "spark.rapids.tpu.sql.cache.scan.enabled", True,
+    "With sql.cache.enabled: cache uploaded scan output per (source "
+    "fingerprint, projection, pushed predicates). A hit skips parquet "
+    "decode AND the host->HBM upload; a scan projecting a SUBSET of a "
+    "cached entry's columns slices the cached batches instead of "
+    "re-uploading (partial hit).")
+
+CACHE_BROADCAST_ENABLED = register(
+    "spark.rapids.tpu.sql.cache.broadcast.enabled", True,
+    "With sql.cache.enabled: share materialized broadcast build sides "
+    "across queries via refcounted handles, keyed by the build "
+    "subtree's structural fingerprint (scan tokens + stage expression "
+    "fingerprints). Cached builds carry their probed dense-join key "
+    "stats, so a reuse hit also skips the build's blocking stats "
+    "fetches (~2 host round trips per join on tunneled backends).")
+
+CACHE_TTL_MS = register(
+    "spark.rapids.tpu.sql.cache.ttlMs", 0,
+    "Milliseconds a cross-query cache entry stays servable (0 = no "
+    "TTL). Source-fingerprint keys already invalidate on file "
+    "mtime/size changes and the write paths invalidate eagerly; the "
+    "TTL bounds staleness for external writers the engine cannot see.")
+
 MAX_READER_BATCH_BYTES = register(
     "spark.rapids.tpu.sql.reader.batchSizeBytes", 512 << 20,
     "Soft cap on bytes of file data decoded into a single scan batch.")

@@ -314,8 +314,16 @@ def write_delta(df, path: str, mode: str = "error",
     my_removes = [a["remove"]["path"] for a in actions if "remove" in a]
     # append is a blind write: it retries cleanly past concurrent
     # appends; overwrite read the whole prior snapshot
-    return _commit_with_retry(path, prior_version, actions, my_removes,
-                              reads_table=(exists and mode == "overwrite"))
+    version = _commit_with_retry(path, prior_version, actions, my_removes,
+                                 reads_table=(exists and
+                                              mode == "overwrite"))
+    # the commit changed the table's visible file set: drop every
+    # cross-query cache entry sourced from it (the data-file write
+    # already invalidated the directory, but the COMMIT is what makes
+    # new files visible — invalidate again after it lands)
+    from ..cache import invalidate_path
+    invalidate_path(path)
+    return version
 
 
 def _data_files(path: str) -> List[str]:

@@ -155,3 +155,7 @@ def clear_file_cache() -> None:
             _cache.clear()
         if _device_cache is not None:
             _device_cache.clear()
+    # the cross-query cache (spark_rapids_tpu/cache/) composes ABOVE this
+    # host tier — "drop every cached scan" should mean both layers
+    from ..cache import clear_query_cache
+    clear_query_cache()

@@ -74,6 +74,24 @@ class FileSource:
             d += f" pushdown={[(n, op) for n, op, _ in self.predicates]}"
         return d
 
+    def cache_token(self) -> Optional[tuple]:
+        """Identity of this scan's output for the cross-query device
+        cache — same (files, cols, preds, ...) layout as
+        :meth:`..io.parquet.ParquetSource.cache_token` so
+        ``cache/keys.scan_key`` composes either source uniformly."""
+        files = []
+        for p in self.paths:
+            try:
+                st = os.stat(p)
+            except OSError:
+                return None
+            files.append((os.path.abspath(p), st.st_mtime_ns, st.st_size))
+        cols = tuple(self.columns) if self.columns is not None else None
+        preds = tuple((n, op, str(v)) for n, op, v in self.predicates)
+        opts = tuple(sorted((k, repr(v)) for k, v in self.options.items()))
+        return (tuple(files), cols, preds, self.batch_rows, self.fmt,
+                opts)
+
     # -- format hooks -------------------------------------------------------------
     def _file_schema(self, path: str) -> Schema:
         t = self._load_table(path)

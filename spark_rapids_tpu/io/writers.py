@@ -266,6 +266,14 @@ class DataFrameWriter:
         finally:
             for w in writers.values():
                 w.close()
+            # the table changed under any reader: drop cross-query cache
+            # entries sourced from it (overwrite AND append — an appended
+            # file widens the file set, so old entries are stale).  The
+            # mtime-keyed host/device file caches self-invalidate, but
+            # eager invalidation frees their memory and closes the
+            # mtime-granularity race for immediate re-reads.
+            from ..cache import invalidate_path
+            invalidate_path(path)
         if stats.num_files == 0 and not part_cols:
             # empty result: still emit one empty file so readers see a schema
             schema = out_schema

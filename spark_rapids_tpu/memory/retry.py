@@ -95,6 +95,11 @@ def device_op(ctx, fn: Callable, *args):
             # the retry re-OOMs against memory spilling cannot reach
             from ..io.filecache import clear_device_cache
             clear_device_cache()
+            # the cross-query cache IS catalog-registered (its device
+            # bytes just spilled to host above); dropping unpinned
+            # entries additionally frees the host copies before retry
+            from ..cache import get_query_cache
+            get_query_cache().drop_unpinned()
             raise RetryOOM(f"device OOM: {ex}") from ex
         raise
 

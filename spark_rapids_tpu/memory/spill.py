@@ -24,7 +24,18 @@ import numpy as np
 
 from ..batch import ColumnBatch, DeviceColumn, HostStringColumn
 
-__all__ = ["SpillableBatch", "SpillCatalog", "get_catalog"]
+__all__ = ["SpillableBatch", "SpillCatalog", "get_catalog",
+           "PRIORITY_CACHE", "PRIORITY_LIVE", "PRIORITY_RUNS",
+           "PRIORITY_RETRY"]
+
+# Spill priority classes (LOWER spills first — SpillPriorities analog).
+# The cross-query cache registers at PRIORITY_CACHE, strictly below every
+# live-query registration, so ensure_budget always demotes cold cache
+# entries to host before touching a running query's state.
+PRIORITY_CACHE = 0   # spark_rapids_tpu/cache/ entries (cold, rebuildable)
+PRIORITY_LIVE = 1    # materialized join sides, broadcasts, df.cache()
+PRIORITY_RUNS = 2    # out-of-core sort runs
+PRIORITY_RETRY = 10  # batches inside a with_retry attempt (hottest)
 
 
 class SpillableBatch:
@@ -160,6 +171,16 @@ class SpillableBatch:
                 self._catalog._note_unspill(self)
             return self._batch
 
+    def mark_long_lived(self) -> None:
+        """Quiet the GC leak canary for handles owned by a process-
+        lifetime structure (the cross-query cache): they legitimately
+        outlive queries and whole sessions, and their owner closes them
+        on eviction/invalidation/clear — a finalizer-time warning for
+        a still-cached entry at interpreter exit is noise, not a leak.
+        ``SpillCatalog.assert_no_leaks`` still counts them (tests drop
+        the cache before asserting)."""
+        self._leak_cell["long_lived"] = True
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -185,6 +206,8 @@ _atexit.register(_SHUTTING_DOWN.append, True)
 def _warn_leaked_handle(cell: dict, device_bytes: int) -> None:
     if _SHUTTING_DOWN:
         return  # interpreter exit: cached frames may legitimately be live
+    if cell.get("long_lived"):
+        return  # cache-owned handle: closed by eviction/clear, not GC
     if not cell.get("closed"):
         import logging
         logging.getLogger("spark_rapids_tpu").warning(

@@ -857,8 +857,29 @@ class BroadcastExchangeExec(TpuExec):
     def materialize(self, ctx: ExecContext, compact: bool = True):
         """One spillable handle holding the whole child output.
         ``compact=False`` (the dense-join path) defers the live-count
-        sync until/unless the dense build rejects."""
+        sync until/unless the dense build rejects.
+
+        With the cross-query cache's broadcast tier enabled, the
+        materialized build is shared across queries via a refcounted
+        :class:`..cache.CachedBuildHandle` — a hit skips the whole
+        build (decode, upload, concat) and, because cached entries
+        carry their probed dense-key stats, the dense join's blocking
+        stats fetches too."""
         m = ctx.metric_set(self.op_id)
+        from ..cache import cache_enabled
+        if cache_enabled(ctx.conf, "broadcast"):
+            from ..cache import broadcast_key, get_query_cache
+            key = broadcast_key(self.children[0], compact, ctx.device)
+            if key is not None:
+                qcache = get_query_cache(ctx.conf)
+                hit = qcache.lookup_broadcast(key, op_id=self.op_id)
+                if hit is not None:
+                    m.add("cacheHitBuilds", 1)
+                    return hit
+                with m.time("buildTime"):
+                    h = materialize_whole(self.children[0], ctx,
+                                          compact=compact)
+                return qcache.insert_broadcast(key, h, op_id=self.op_id)
         with m.time("buildTime"):
             return materialize_whole(self.children[0], ctx,
                                      compact=compact)
@@ -1197,6 +1218,23 @@ class BroadcastJoinExec(SortMergeJoinExec):
         vcap = bucket_capacity(
             conf["spark.rapids.tpu.sql.dpp.maxInKeys"] + 1)
 
+        # broadcast-reuse fast path: a cached build carries the probed
+        # stats from the query that first ran this join shape — the
+        # stats program is not even dispatched, and the later
+        # _pending_host resolution finds the host copy already present
+        # (zero blocking fetches on the hit path)
+        skey = ("dense-stats", fp, vcap)
+        self._dense_stats_key = skey
+        ent = getattr(self, "_cache_entry", None)
+        if ent is not None:
+            host = ent.get_stat(skey)
+            if host is not None:
+                b_arrays = encode_key_arrays(_dev_arrays(build), build,
+                                             bk, self.string_dicts)
+                self._dense_pending = [id(build), build, None, b_arrays,
+                                       host]
+                return
+
         def build_stats():
             @jax.jit
             def f(b_arrays, sel, n_build):
@@ -1238,10 +1276,16 @@ class BroadcastJoinExec(SortMergeJoinExec):
         # round trip between them
         self._dense_pending = [id(build), build, stats, b_arrays, None]
 
-    @staticmethod
-    def _pending_host(pending):
+    def _pending_host(self, pending):
         if pending[4] is None:
             pending[4] = fetch(pending[2])
+            # a cache-resident build remembers its probed stats: the
+            # NEXT query reusing this build skips the dispatch and this
+            # blocking fetch entirely (see _dense_prefetch)
+            ent = getattr(self, "_cache_entry", None)
+            skey = getattr(self, "_dense_stats_key", None)
+            if ent is not None and skey is not None:
+                ent.put_stat(skey, pending[4])
         return pending[4]
 
     def _dense_build_state(self, build: ColumnBatch, conf):
@@ -1488,6 +1532,9 @@ class BroadcastJoinExec(SortMergeJoinExec):
         # it in): the live-count round trip is paid only on fallback
         bh = self.children[self.build_side].materialize(
             ctx, compact=not dense_ok)
+        # broadcast-tier cache hit: the entry rides along so the dense
+        # prefetch can reuse (and deposit) probed build stats
+        self._cache_entry = getattr(bh, "cache_entry", None)
         pgen = self.children[probe_side].execute(ctx)
         try:
             build = bh.get()
@@ -1545,6 +1592,7 @@ class BroadcastJoinExec(SortMergeJoinExec):
             self._bfast_cache = None
             self._csr_cache = None
             self._dense_stats_host = None
+            self._cache_entry = None
 
 
 def _expand_rows(offsets, counts, out_cap: int):

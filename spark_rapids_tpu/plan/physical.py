@@ -160,12 +160,46 @@ class ScanExec(TpuExec):
         min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
         source = self._effective_source()
 
+        # cross-query device cache (spark_rapids_tpu/cache/): a hit skips
+        # decode AND upload across QUERIES, not just reruns of this plan;
+        # a cached superset projection serves narrower scans by slicing.
+        # When engaged it supersedes the per-scan fileCache device tier
+        # below (the host decoded-file cache still composes on misses).
+        from ..cache import cache_enabled
+        qcache = None
+        qkey = None
+        if cache_enabled(ctx.conf, "scan"):
+            from ..cache import get_query_cache, scan_key
+            qkey = scan_key(source, min_cap, ctx.device)
+            if qkey is not None:
+                qcache = get_query_cache(ctx.conf)
+                hit = qcache.lookup_scan(qkey, self._schema,
+                                         op_id=self.op_id)
+                if hit is not None:
+                    entry, batches = hit
+                    origin = str(getattr(source, "path", "") or "")
+                    m.add("cacheHitBatches", len(batches))
+                    try:
+                        for b in batches:
+                            from ..service import cancel as _cancel
+                            _cancel.check()
+                            b.origin_file = origin
+                            m.add("numOutputRows", b.num_rows)
+                            m.add("numOutputBatches", 1)
+                            yield b
+                    finally:
+                        # released even when the consumer abandons the
+                        # stream (LIMIT) — the entry stays evictable
+                        qcache.release(entry)
+                    return
+
         # device-tier file cache: repeated identical scans skip decode AND
         # upload (fileCache.deviceTier; keep-batches-resident idea from
         # RapidsShuffleInternalManagerBase.scala:897 applied to scans)
         dcache = None
         dkey = None
-        if (ctx.conf["spark.rapids.tpu.sql.fileCache.enabled"]
+        if (qcache is None
+                and ctx.conf["spark.rapids.tpu.sql.fileCache.enabled"]
                 and ctx.conf["spark.rapids.tpu.sql.fileCache.deviceTier"]):
             token_fn = getattr(source, "cache_token", None)
             token = token_fn() if token_fn is not None else None
@@ -189,7 +223,10 @@ class ScanExec(TpuExec):
         # the accumulator pins batches in HBM until the scan completes, so
         # abandon it the moment the running size exceeds the cache budget —
         # an over-budget scan must keep streaming/spilling, not OOM
-        acc = [] if dcache is not None else None
+        from ..cache import batch_bytes as _cb_bytes
+        acc = [] if (dcache is not None or qcache is not None) else None
+        acc_cap = qcache.max_bytes if qcache is not None else \
+            (dcache.max_bytes if dcache is not None else 0)
         acc_bytes = 0
         origin = str(getattr(source, "path", "") or "")
 
@@ -216,8 +253,8 @@ class ScanExec(TpuExec):
             m.add("numOutputRows", b.num_rows)
             m.add("numOutputBatches", 1)
             if acc is not None:
-                acc_bytes += dcache._batch_bytes(b)
-                if acc_bytes > dcache.max_bytes:
+                acc_bytes += _cb_bytes(b)
+                if acc_bytes > acc_cap:
                     acc = None
                     b.donatable = True  # won't be cached after all
                 else:
@@ -232,7 +269,11 @@ class ScanExec(TpuExec):
                 b.donatable = True
             yield b
         if acc is not None:
-            dcache.put(dkey, acc)
+            if qcache is not None:
+                qcache.insert_scan(qkey, acc, op_id=self.op_id,
+                                   conf=ctx.conf)
+            else:
+                dcache.put(dkey, acc)
 
 
 # ---------------------------------------------------------------------------------

@@ -272,6 +272,15 @@ class QueryScheduler:
         try:
             catalog = get_catalog(conf)
             catalog.ensure_budget()
+            if catalog.device_bytes_in_use() <= catalog.device_budget:
+                return True
+            # still over budget: shed the cross-query cache's cold
+            # entries (its device bytes already demoted to host via the
+            # spill priority order; this frees the host copies too) and
+            # re-check — admission degrades the CACHE, never the query
+            from ..cache import get_query_cache
+            if get_query_cache(conf).drop_unpinned():
+                catalog.ensure_budget()
             return catalog.device_bytes_in_use() <= catalog.device_budget
         except Exception:
             # no initialized backend yet (pure-callable schedulers in

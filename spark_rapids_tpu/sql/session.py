@@ -72,6 +72,18 @@ class Session:
                     # rebuilds instead of hitting a closed scheduler
                     cls._active._scheduler = None
             cls._active = None
+        # the cross-query cache outlives queries, not sessions: a reset
+        # closes its spill-registered handles so the next session's
+        # leak/budget accounting starts clean
+        from ..cache import clear_query_cache
+        clear_query_cache()
+
+    def query_cache(self):
+        """The process-wide cross-query device cache (scan batches +
+        broadcast builds), sized from this session's conf —
+        ``sess.query_cache().snapshot()`` is the operator surface."""
+        from ..cache import get_query_cache
+        return get_query_cache(self._tpu_conf())
 
     def _tpu_conf(self) -> TpuConf:
         return TpuConf(self._settings)

@@ -90,6 +90,15 @@ class QueryStats:
         # synchronous queries) — the bench concurrency mode derives
         # service latency = queue wait + execution
         self.queue_wait_s = 0.0
+        # cross-query device cache (spark_rapids_tpu/cache/): lookups
+        # against the scan + broadcast tiers, bytes served from cache
+        # instead of decode+upload, and entries dropped (budget/TTL/
+        # invalidation) — bench's cache_hits_warm / cache_mb_saved
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_hit_bytes = 0
+        self.cache_evictions = 0
+        self.cache_evict_bytes = 0
 
     # -- accessors ----------------------------------------------------------
     @classmethod

@@ -86,3 +86,14 @@ def pytest_collection_modifyitems(config, items):
             "threads that don't join query contextvars — run work via "
             "contextvars.copy_context() or mark '# ctx-ok' "
             f"(tools/check_ctx_threads.py):\n{lines}")
+    # (d) cross-query cache keys built anywhere but cache/keys.py would
+    # let the identity rules diverge between tiers — silent wrong-data
+    # hits, the worst failure mode a cache has
+    from tools.check_cache_keys import check as check_keys
+    violations = check_keys()
+    if violations:
+        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
+                          for rel, ln, src in violations)
+        raise pytest.UsageError(
+            "ad-hoc cache keys — derive them via cache.keys.scan_key / "
+            f"broadcast_key (tools/check_cache_keys.py):\n{lines}")

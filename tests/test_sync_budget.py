@@ -123,6 +123,37 @@ def test_async_fetch_excluded_from_budget_but_traced(monkeypatch):
     assert QueryStats.get().async_fetches == 1
 
 
+def test_warm_cache_scan_agg_budget(sess, tmp_path):
+    """Warm cross-query cache, Q6 shape (parquet scan→filter→scalar
+    agg→collect): the hit path serves device-resident batches, so the
+    ONLY blocking fetch is the collect tail — 0 before it."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.cache import clear_query_cache, get_query_cache
+    f = srt.functions
+    rng = np.random.default_rng(13)
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+        "a": rng.integers(0, 100, 4096).astype(np.int64),
+        "b": rng.random(4096)}), preserve_index=False), path)
+    sess.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+    clear_query_cache()
+    try:
+        df = sess.read_parquet(path)
+        q = df.filter(f.col("a") < 50).agg(f.sum(f.col("b")).alias("s"))
+        warm = q.collect()  # populate pass
+        with sync_budget(1, "warm-cache-scan-agg") as s:
+            got = q.collect()
+        assert got == warm
+        assert s.blocking_fetches <= 1  # the collect tail, nothing else
+        assert get_query_cache().hits >= 1
+    finally:
+        sess.conf.unset("spark.rapids.tpu.sql.cache.enabled")
+        clear_query_cache()
+
+
 def test_deferred_metrics_do_not_block(sess):
     """Deferred operator metrics resolve via the async path: reading
     them after a query adds no blocking fetch."""

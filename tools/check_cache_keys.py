@@ -1,0 +1,79 @@
+"""Lint: cross-query cache keys must come from the central helper.
+
+The cache's correctness hangs on ONE identity rule: two lookups hit the
+same entry iff their data is interchangeable (same files+mtime+size,
+projection, pushed predicates, deletion vectors...).  That rule lives in
+``spark_rapids_tpu/cache/keys.py`` and nowhere else.  This check rejects
+the two ways an ad-hoc key could sneak in:
+
+  * a ``CacheKey(...)`` construction outside ``cache/keys.py`` — every
+    key must be derived by ``scan_key`` / ``broadcast_key``, which embed
+    the fingerprint rules;
+  * an inline literal (tuple/list/string) passed as the key argument of
+    the cache API (``lookup_scan`` / ``insert_scan`` /
+    ``lookup_broadcast`` / ``insert_broadcast`` / ``invalidate_path`` is
+    exempt — it takes a path, not a key).
+
+Run standalone (``python tools/check_cache_keys.py``, exit 1 on
+violations) or let the suite run it: tests/conftest.py invokes
+:func:`check` at collection time alongside the blocking-fetch / span /
+ctx-thread lints.  Lines carrying ``# cache-key-ok`` are exempt (tests
+exercising the key machinery itself).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_rapids_tpu")
+
+KEYS_MODULE = os.path.join("cache", "keys.py")
+
+_CONSTRUCT = re.compile(r"\bCacheKey\s*\(")
+# cache API call with an inline literal first argument: .lookup_scan((...,
+# .insert_scan([..., .lookup_broadcast("...
+_LITERAL_KEY = re.compile(
+    r"\.(lookup_scan|insert_scan|lookup_broadcast|insert_broadcast)"
+    r"\(\s*[\(\[\"']")
+_EXEMPT = "# cache-key-ok"
+
+
+def check(root: str = PKG) -> List[Tuple[str, int, str]]:
+    """Return [(relpath, lineno, line)] violations in the package."""
+    violations: List[Tuple[str, int, str]] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _EXEMPT in line:
+                        continue
+                    if _CONSTRUCT.search(line) and rel != KEYS_MODULE:
+                        violations.append((rel, lineno, line.strip()))
+                    elif _LITERAL_KEY.search(line):
+                        violations.append((rel, lineno, line.strip()))
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if not violations:
+        print("check_cache_keys: all cache keys derive from "
+              "cache/keys.py helpers")
+        return 0
+    print("check_cache_keys: ad-hoc cache keys (derive them via "
+          "cache.keys.scan_key / broadcast_key):", file=sys.stderr)
+    for rel, lineno, line in violations:
+        print(f"  spark_rapids_tpu/{rel}:{lineno}: {line}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,6 +187,14 @@ def analyze(data: dict) -> dict:
         if cur_s is not None:
             busy_us += cur_e - cur_s
 
+    # cross-query cache events (cat "cache": cache:hit / cache:miss /
+    # cache:evict marks with tier+bytes attrs); the QueryStats snapshot
+    # on the query's root event is authoritative when present
+    cache_events = [e for e in xs if e.get("cat") == "cache"]
+
+    def _cname(n):
+        return sum(1 for e in cache_events if e.get("name") == n)
+
     fetch_events = [e for e in xs if e.get("cat") == "fetch"]
     blocking = [e for e in fetch_events
                 if e.get("args", {}).get("blocking")]
@@ -218,6 +226,14 @@ def analyze(data: dict) -> dict:
         "compile_s": float(qargs.get("compile_s",
                                      sum(e["dur"] for e in compiles) / 1e6)),
         "threads": len(by_tid_work),
+        "cache_hits": int(qargs.get("cache_hits", _cname("cache:hit"))),
+        "cache_misses": int(qargs.get("cache_misses",
+                                      _cname("cache:miss"))),
+        "cache_evictions": int(qargs.get("cache_evictions",
+                                         _cname("cache:evict"))),
+        "cache_bytes_saved": int(qargs.get("cache_hit_bytes", sum(
+            e.get("args", {}).get("bytes", 0) for e in cache_events
+            if e.get("name") == "cache:hit"))),
     }
 
 
@@ -251,6 +267,14 @@ def format_report(a: dict) -> str:
         f"self-time coverage: {a['self_total_s'] * 1e3:.1f}ms = "
         f"{a['self_coverage'] * 100:.0f}% of wall",
     ]
+    # cache summary only when the query touched the cross-query cache
+    looked = a.get("cache_hits", 0) + a.get("cache_misses", 0)
+    if looked or a.get("cache_evictions", 0):
+        ratio = (a["cache_hits"] / looked) if looked else 0.0
+        lines.append(
+            f"cache: hits={a['cache_hits']} misses={a['cache_misses']} "
+            f"evictions={a['cache_evictions']} hit_ratio={ratio:.2f} "
+            f"saved={a['cache_bytes_saved'] / 1e6:.1f}MB")
     return "\n".join(lines)
 
 
