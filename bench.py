@@ -32,7 +32,14 @@ throughput next to the serial numbers from the same warm state; results
 are verified equal to the serial run and per-query QueryStats must
 reconcile with the process aggregate.  Defaults to the TPC-H 22; with
 SRT_BENCH_TRACE_DIR also writes a merged concurrent.trace.json whose
-per-query sections + contention summary tools/trace_report.py renders).
+per-query sections + contention summary tools/trace_report.py renders),
+SRT_BENCH_FAULT_RATE=R (chaos knob: after the clean numbers, replay the
+timed pass with spark.rapids.tpu.faults.inject.rate=R — every injection
+point fails with probability R, seeded so runs replay — and report the
+under-fault throughput/latency NEXT TO the clean numbers plus the
+transient_retries / fragments_recomputed / degraded_batches /
+retry_backoff_s recovery columns; results are still verified against
+the oracle, so the line also proves recovery preserves answers).
 
 The aggregate JSON line is re-printed after EVERY query (flush=True), so
 a driver that kills the run on a timeout still finds the latest complete
@@ -132,7 +139,41 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
     rel_err = tpch_suite.rows_rel_err(engine_rows, cpu_rows)
     assert rel_err < 1e-6, \
         f"{name} result mismatch (rel_err={rel_err}, rows={len(engine_rows)})"
+    # chaos pass: same query under probabilistic fault injection — the
+    # recovery framework (faults/) must keep the answer identical while
+    # the recovery columns show what it cost
+    fault_rate = float(os.environ.get("SRT_BENCH_FAULT_RATE", "0") or 0)
+    faulted = {}
+    if fault_rate > 0:
+        sess.conf.set("spark.rapids.tpu.faults.inject.rate", fault_rate)
+        sess.conf.set("spark.rapids.tpu.faults.inject.seed", 20260804)
+        try:
+            f0 = QueryStats.get().snapshot()
+            faulted_rows = runner(dfs)
+            faulted_s = _time(lambda: runner(dfs), iters)
+            f_stats = QueryStats.delta_since(f0)
+            per_iter = 1 + iters  # verify run + timed iterations
+            faulted = {
+                "fault_rate": fault_rate,
+                "engine_s_faulted": round(faulted_s, 5),
+                "faulted_slowdown": round(faulted_s / engine_s, 4),
+                "faulted_rel_err": tpch_suite.rows_rel_err(
+                    faulted_rows, cpu_rows),
+                "faults_injected": f_stats["faults_injected"],
+                "transient_retries": f_stats["transient_retries"],
+                "fragments_recomputed": f_stats["fragments_recomputed"],
+                "degraded_batches": f_stats["degraded_batches"],
+                "retry_backoff_s": round(
+                    f_stats["retry_backoff_s"] / per_iter, 4),
+            }
+            assert faulted["faulted_rel_err"] < 1e-6, \
+                f"{name} result mismatch UNDER FAULTS " \
+                f"(rel_err={faulted['faulted_rel_err']})"
+        finally:
+            sess.conf.unset("spark.rapids.tpu.faults.inject.rate")
+            sess.conf.unset("spark.rapids.tpu.faults.inject.seed")
     return {
+        **faulted,
         "speedup": round(cpu_s / engine_s, 4),
         "engine_s": round(engine_s, 5),
         "engine_cold_s": round(cold_s, 5),
@@ -271,8 +312,43 @@ def _run_concurrent(sf: float, conc: int, which) -> None:
 
     lat = sorted(h.latency_s or 0.0 for h in handles.values())
 
-    def pct(p):
-        return round(lat[min(len(lat) - 1, int(p * len(lat)))], 5)
+    def pct(p, ls=None):
+        ls = lat if ls is None else ls
+        return round(ls[min(len(ls) - 1, int(p * len(ls)))], 5)
+
+    # chaos replay: the same concurrent batch under probabilistic fault
+    # injection — service throughput/p95 under faults lands NEXT TO the
+    # clean numbers, with the recovery columns showing what it cost
+    fault_rate = float(os.environ.get("SRT_BENCH_FAULT_RATE", "0") or 0)
+    faulted = {}
+    if fault_rate > 0:
+        sess.conf.set("spark.rapids.tpu.faults.inject.rate", fault_rate)
+        sess.conf.set("spark.rapids.tpu.faults.inject.seed", 20260804)
+        try:
+            f0 = QueryStats.get().snapshot()
+            f_rows, f_errs, f_wall, f_handles = _concurrent_pass()
+            f_delta = QueryStats.delta_since(f0)
+            f_lat = sorted(h.latency_s or 0.0
+                           for h in f_handles.values())
+            faulted = {
+                "fault_rate": fault_rate,
+                "concurrent_wall_s_faulted": round(f_wall, 5),
+                "throughput_qps_faulted": round(len(which) / f_wall, 4),
+                "latency_p95_s_faulted": pct(0.95, f_lat),
+                "results_match_faulted": not f_errs and all(
+                    tpch_suite.rows_rel_err(f_rows[n], serial_rows[n])
+                    < 1e-6 for n in which),
+                "faulted_errors": f_errs,
+                "faults_injected": f_delta.get("faults_injected", 0),
+                "transient_retries": f_delta.get("transient_retries", 0),
+                "fragments_recomputed": f_delta.get(
+                    "fragments_recomputed", 0),
+                "degraded_batches": f_delta.get("degraded_batches", 0),
+                "retry_backoff_s": f_delta.get("retry_backoff_s", 0.0),
+            }
+        finally:
+            sess.conf.unset("spark.rapids.tpu.faults.inject.rate")
+            sess.conf.unset("spark.rapids.tpu.faults.inject.seed")
 
     if trace_dir:
         from spark_rapids_tpu.utils import tracing
@@ -306,6 +382,7 @@ def _run_concurrent(sf: float, conc: int, which) -> None:
         "results_match": results_match,
         "stats_reconciled": reconciled,
         "errors": errors,
+        **faulted,
         "per_query": {n: {
             "serial_s": serial_s[n],
             "latency_s": round(handles[n].latency_s or 0.0, 5),

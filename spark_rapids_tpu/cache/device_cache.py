@@ -50,6 +50,10 @@ __all__ = ["QueryCache", "CacheEntry", "CachedBuildHandle",
 from ..memory.spill import PRIORITY_CACHE as CACHE_PRIORITY
 
 
+def _reraise(ex: BaseException):
+    raise ex
+
+
 def batch_bytes(b) -> int:
     """Device + host-arrow footprint of one batch (budget accounting)."""
     total = b.device_size_bytes()
@@ -209,6 +213,28 @@ class QueryCache:
         QueryStats.get().cache_misses += 1
         tracing.mark(op_id, "cache:miss", "cache", tier=tier)
 
+    def _check_faults(self, op_id, tier: str) -> bool:
+        """``cache.lookup`` injection point.  A transient fault in the
+        cache tier must never fail the query: with recovery enabled the
+        lookup degrades to a MISS (the caller recomputes; the entry is
+        untouched and serves the next lookup).  With recovery disabled
+        (fail-fast debugging) the typed QueryFaulted propagates.
+        Returns False when the lookup should report a miss."""
+        from ..faults.injector import INJECTOR
+        from ..faults.recovery import (TransientFault, recovery_enabled,
+                                       transient_retry)
+        try:
+            INJECTOR.maybe_raise("cache.lookup", desc=tier)
+        except TransientFault as ex:
+            if not recovery_enabled():
+                # route through the retry driver with retries exhausted
+                # so the failure carries the standard typed history
+                transient_retry(None, "cache.lookup",
+                                _reraise, ex, desc=tier)
+            self._miss(op_id, tier)
+            return False
+        return True
+
     # -- scan tier ----------------------------------------------------------------
     def lookup_scan(self, key: CacheKey, schema,
                     op_id: Optional[str] = None
@@ -219,6 +245,8 @@ class QueryCache:
         the caller MUST :meth:`release` the entry (use try/finally; the
         consumer may abandon the batch stream mid-way)."""
         from ..batch import ColumnBatch
+        if not self._check_faults(op_id, "scan"):
+            return None
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None and self._expired(entry):
@@ -270,15 +298,31 @@ class QueryCache:
         registered spillable at :data:`CACHE_PRIORITY`; over-budget
         inserts evict LRU unpinned entries first and give up (returning
         None) when the value alone exceeds the budget."""
+        from ..faults.recovery import TransientFault
         from ..memory.spill import get_catalog
         nbytes = sum(batch_bytes(b) for b in batches)
         if nbytes > self.max_bytes or not batches:
             return None
         catalog = get_catalog(conf)
-        handles = [catalog.register(b, priority=CACHE_PRIORITY)
-                   for b in batches]
-        for h in handles:
-            h.mark_long_lived()
+        handles: list = []
+        try:
+            from ..faults.injector import INJECTOR
+            for b in batches:
+                INJECTOR.maybe_raise("cache.lookup", desc="scan-fill")
+                h = catalog.register(b, priority=CACHE_PRIORITY)
+                handles.append(h)
+                h.mark_long_lived()
+        except BaseException as ex:
+            # a faulted fill NEVER leaves a poisoned (half-registered)
+            # entry: close what was registered and either skip caching
+            # (transient — the query proceeds uncached) or re-raise
+            for h in handles:
+                h.close()
+            if isinstance(ex, TransientFault):
+                tracing.mark(op_id, "cache:fill-abandoned", "cache",
+                             tier="scan")
+                return None
+            raise
         entry = CacheEntry(key, handles, nbytes)
         with self._lock:
             existing = self._entries.get(key)
@@ -296,6 +340,8 @@ class QueryCache:
     def lookup_broadcast(self, key: CacheKey,
                          op_id: Optional[str] = None
                          ) -> Optional[CachedBuildHandle]:
+        if not self._check_faults(op_id, "broadcast"):
+            return None
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None and self._expired(entry):
@@ -317,8 +363,19 @@ class QueryCache:
         gets a refcounted :class:`CachedBuildHandle` in exchange.  When
         the build exceeds the budget the handle is returned unwrapped —
         the query owns it exactly as before the cache existed."""
+        from ..faults.injector import INJECTOR
+        from ..faults.recovery import TransientFault
         nbytes = getattr(handle, "device_bytes", 0)
         if nbytes > self.max_bytes:
+            return handle
+        try:
+            INJECTOR.maybe_raise("cache.lookup", desc="broadcast-fill")
+        except TransientFault:
+            # faulted fill: the query keeps sole ownership of its build
+            # handle exactly as before the cache existed — no entry is
+            # indexed, nothing is poisoned
+            tracing.mark(op_id, "cache:fill-abandoned", "cache",
+                         tier="broadcast")
             return handle
         handle.priority = CACHE_PRIORITY
         handle.mark_long_lived()

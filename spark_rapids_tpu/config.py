@@ -543,6 +543,92 @@ TEST_VALIDATE_EXECS = register(
     "Test-only: fail if any operator in the plan falls back to CPU.",
     internal=True)
 
+FAULTS_RECOVERY_ENABLED = register(
+    "spark.rapids.tpu.faults.recovery.enabled", True,
+    "Master switch for transient-failure recovery (spark_rapids_tpu/"
+    "faults/): I/O reads, shuffle-fragment pulls, and DCN traffic retry "
+    "with exponential backoff + jitter; repeated device-op failure "
+    "degrades the batch to the CPU path. When false every transient "
+    "fault immediately fails the query with a typed QueryFaulted "
+    "carrying the fault history (the fail-fast debugging mode).")
+
+FAULTS_MAX_RETRIES = register(
+    "spark.rapids.tpu.faults.maxRetries", 3,
+    "Attempts per faulting call site before transient_retry gives up "
+    "with QueryFaulted. Each retry also draws down the per-query "
+    "faults.retryBudget.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+FAULTS_RETRY_BUDGET = register(
+    "spark.rapids.tpu.faults.retryBudget", 64,
+    "Per-query cap on transient retries across ALL fault points (the "
+    "storm brake: a query riding a failing disk or a flapping peer must "
+    "fail typed, not spin forever). Exhaustion raises QueryFaulted with "
+    "the accumulated fault history.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+FAULTS_BACKOFF_BASE_MS = register(
+    "spark.rapids.tpu.faults.backoff.baseMs", 25.0,
+    "First-retry backoff in milliseconds; attempt N sleeps "
+    "min(maxMs, baseMs * multiplier^(N-1)) scaled by a seeded jitter "
+    "factor in [0.5, 1.0]. Also paces DCN connect retries and the "
+    "coordinator's barrier re-check cadence (parallel/dcn.py).",
+    conv=float, check=lambda v: None if v >= 0 else "must be >= 0")
+
+FAULTS_BACKOFF_MAX_MS = register(
+    "spark.rapids.tpu.faults.backoff.maxMs", 2000.0,
+    "Ceiling on a single transient-retry backoff sleep in milliseconds.",
+    conv=float, check=lambda v: None if v > 0 else "must be > 0")
+
+FAULTS_BACKOFF_MULTIPLIER = register(
+    "spark.rapids.tpu.faults.backoff.multiplier", 2.0,
+    "Exponential growth factor between consecutive backoff sleeps.",
+    conv=float, check=lambda v: None if v >= 1 else "must be >= 1")
+
+FAULTS_DEVICE_RETRIES = register(
+    "spark.rapids.tpu.faults.device.retries", 2,
+    "Re-dispatch attempts for a device op failing with a transient "
+    "(non-OOM) runtime error before the batch degrades to the CPU "
+    "fallback path (faults.degrade.enabled) or the query fails typed. "
+    "OOM keeps its own spill-and-retry protocol (memory/retry.py).",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+FAULTS_DEGRADE_ENABLED = register(
+    "spark.rapids.tpu.faults.degrade.enabled", True,
+    "After device-op retries exhaust, run that batch through the "
+    "operator's cpu/ fallback instead of failing the query — marked "
+    "degraded:cpu in the trace and counted in QueryStats."
+    " Disable to surface persistent device faults as QueryFaulted.")
+
+FAULTS_INJECT_SCHEDULE = register(
+    "spark.rapids.tpu.faults.inject.schedule", "",
+    "Deterministic fault-injection schedule: comma list of "
+    "'point:N[:K]' entries — fail invocations N..N+K-1 (1-based) at "
+    "the named point (io.read, io.write, shuffle.fragment, "
+    "dcn.heartbeat, device.op, cache.lookup). Counters reset per "
+    "query. Empty disables. The chaos differential suite proves "
+    "results under a schedule equal the fault-free run.")
+
+FAULTS_INJECT_RATE = register(
+    "spark.rapids.tpu.faults.inject.rate", 0.0,
+    "Probabilistic chaos-injection rate in [0, 1): every invocation at "
+    "the selected points (faults.inject.points) fails with this "
+    "probability, drawn from a generator seeded by faults.inject.seed "
+    "so runs replay exactly. bench.py exposes it as "
+    "SRT_BENCH_FAULT_RATE.", conv=float,
+    check=lambda v: None if 0.0 <= v < 1.0 else "must be in [0, 1)")
+
+FAULTS_INJECT_POINTS = register(
+    "spark.rapids.tpu.faults.inject.points", "",
+    "Comma list restricting rate-based injection to these points "
+    "(empty = all six registered points). Deterministic schedule "
+    "entries name their points explicitly.")
+
+FAULTS_INJECT_SEED = register(
+    "spark.rapids.tpu.faults.inject.seed", 0,
+    "Seed for the injection RNG (probabilistic rate draws AND the "
+    "retry backoff jitter), making chaos runs reproducible.")
+
 
 class TpuConf:
     """An immutable snapshot of settings; unset keys resolve to defaults."""

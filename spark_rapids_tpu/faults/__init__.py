@@ -1,0 +1,37 @@
+"""Unified fault injection + transient-failure recovery.
+
+The OOM story (memory/retry.py) covers exactly one fault class; a
+concurrent query service with a shared device cache dies on every OTHER
+transient fault — a flaky read, a lost shuffle fragment, a DCN hiccup —
+because there is no Spark task framework underneath to re-execute the
+work.  This package is that missing resilience layer, split in two:
+
+  * :mod:`.injector` — the ONE place faults enter the engine on purpose:
+    a seeded, conf-driven :class:`FaultInjector` with six named injection
+    points (``io.read``, ``io.write``, ``shuffle.fragment``,
+    ``dcn.heartbeat``, ``device.op``, ``cache.lookup``), supporting
+    deterministic schedules ("fail the Nth op at point P") and
+    probabilistic rates for chaos runs;
+  * :mod:`.recovery` — the typed recovery layer every transient-fault
+    call site routes through: :func:`transient_retry` (exponential
+    backoff + jitter + per-query retry budgets), :func:`device_guard`
+    (bounded device retries, then graceful degradation to the ``cpu/``
+    path for that batch), and the terminal :class:`QueryFaulted` carrying
+    the full fault history.
+
+``tools/check_fault_paths.py`` enforces that transient-error retry loops
+outside this package use the framework (or carry ``# fault-ok``), so
+ad-hoc sleeps and swallowed exceptions cannot silently reappear.
+"""
+
+from .injector import INJECTOR, FaultInjector, InjectedFault, POINTS
+from .recovery import (FaultRecord, QueryFaulted, TransientFault,
+                       backoff_delays, budget_scope, device_guard,
+                       recovery_enabled, transient_retry)
+
+__all__ = [
+    "INJECTOR", "FaultInjector", "InjectedFault", "POINTS",
+    "TransientFault", "QueryFaulted", "FaultRecord",
+    "transient_retry", "device_guard", "budget_scope",
+    "backoff_delays", "recovery_enabled",
+]

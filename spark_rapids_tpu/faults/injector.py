@@ -1,0 +1,181 @@
+"""Seeded, conf-driven fault injector with named injection points.
+
+Generalizes the OOM-only ``memory/retry.OOMInjector`` (which stays, for
+the RetryOOM/SplitAndRetryOOM protocol) into one injector for every
+transient fault class the engine recovers from.  Each registered point
+is a place a real deployment loses work: a flaky object-store read, a
+mid-write disk error, a lost shuffle fragment, a dropped DCN heartbeat,
+a device op failing with a non-OOM XLA error, a cache tier timing out.
+
+Two modes, composable:
+
+  * **deterministic schedule** — ``"io.read:2"`` fails the 2nd
+    invocation at ``io.read``; ``"device.op:1:3"`` fails invocations
+    1..3 (the repeated-failure shape that drives CPU degradation).
+    Re-arming (every :class:`..plan.physical.ExecContext`, mirroring the
+    OOM injector) resets the per-point invocation counters, so a
+    schedule means "the Nth op of each query".
+  * **probabilistic rate** — every invocation at the selected points
+    fails with probability ``rate``, drawn from a ``random.Random``
+    seeded by ``faults.inject.seed`` so chaos runs replay exactly.
+
+Injection raises :class:`InjectedFault` (a
+:class:`..faults.recovery.TransientFault`), which the recovery layer
+retries/degrades exactly like the real fault it stands in for.  Every
+injection lands a ``fault:injected`` trace mark and a
+``QueryStats.faults_injected`` count; per-point cumulative totals
+survive re-arming so multi-query chaos suites can assert coverage.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Tuple
+
+from .recovery import TransientFault
+
+__all__ = ["POINTS", "InjectedFault", "FaultInjector", "INJECTOR"]
+
+# The registry of injection points.  Adding a point means adding the
+# matching recovery path and a docs/robustness.md row — the leak suite
+# parametrizes over this tuple, so an unrecovered point fails tests.
+POINTS = ("io.read", "io.write", "shuffle.fragment", "dcn.heartbeat",
+          "device.op", "cache.lookup")
+
+
+class InjectedFault(TransientFault):
+    """A synthetic transient fault raised at an injection point."""
+
+
+def _parse_schedule(spec: str) -> Dict[str, List[Tuple[int, int]]]:
+    """``"point:N[:K]"`` comma list → {point: [(first_n, count)]}: fail
+    invocations ``first_n .. first_n+count-1`` (1-based) at ``point``."""
+    out: Dict[str, List[Tuple[int, int]]] = {}
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"bad fault schedule entry {item!r} (want point:N[:K])")
+        point = parts[0].strip()
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown injection point {point!r}; registered: {POINTS}")
+        n = int(parts[1])
+        k = int(parts[2]) if len(parts) == 3 else 1
+        if n < 1 or k < 1:
+            raise ValueError(f"bad fault schedule entry {item!r}: "
+                             f"N and K must be >= 1")
+        out.setdefault(point, []).append((n, k))
+    return out
+
+
+class FaultInjector:
+    """Process-global injector consulted by every registered point.
+
+    Armed from the faults confs at each :class:`ExecContext` creation
+    (like the OOM injector, an unarmed conf CLEARS previous arming —
+    and, being process-global, deterministic schedules are only
+    meaningful for one query at a time; chaos rate mode is the
+    concurrent-safe mode).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sched: Dict[str, List[Tuple[int, int]]] = {}
+        self._rate = 0.0
+        self._rate_points: Tuple[str, ...] = POINTS
+        self._rng = random.Random(0)
+        self._counts: Dict[str, int] = {}
+        # cumulative per-point injections: survives re-arming (chaos
+        # suites assert coverage across several queries), reset only by
+        # reset_totals()
+        self.injected_total: Dict[str, int] = {p: 0 for p in POINTS}
+
+    # -- arming -------------------------------------------------------------------
+    def arm(self, schedule: str = "", rate: float = 0.0,
+            points: str = "", seed: int = 0) -> None:
+        sched = _parse_schedule(schedule)
+        sel = tuple(p.strip() for p in points.split(",") if p.strip()) \
+            if points else POINTS
+        for p in sel:
+            if p not in POINTS:
+                raise ValueError(
+                    f"unknown injection point {p!r}; registered: {POINTS}")
+        with self._lock:
+            self._sched = sched
+            self._rate = max(0.0, float(rate))
+            self._rate_points = sel
+            self._rng = random.Random(seed or 0)
+            self._counts = {}
+
+    def arm_from_conf(self, conf) -> None:
+        self.arm(
+            schedule=conf["spark.rapids.tpu.faults.inject.schedule"],
+            rate=conf["spark.rapids.tpu.faults.inject.rate"],
+            points=conf["spark.rapids.tpu.faults.inject.points"],
+            seed=conf["spark.rapids.tpu.faults.inject.seed"])
+
+    # -- state --------------------------------------------------------------------
+    def armed(self) -> bool:
+        """True while any injection (schedule or rate) can fire — buffer
+        donation must not engage (a donated batch cannot be replayed by
+        the retry/degradation paths)."""
+        with self._lock:
+            return bool(self._sched) or self._rate > 0.0
+
+    def deterministic_armed(self) -> bool:
+        """True while a deterministic schedule is armed: the pipeline
+        runs serially (depth 0) so "the Nth op at P" is well-defined —
+        the same determinism contract as the OOM injector."""
+        with self._lock:
+            return bool(self._sched)
+
+    def jitter(self) -> float:
+        """A seeded jitter factor in [0.5, 1.0] for the backoff sleeps
+        (deterministic under a seeded chaos run)."""
+        with self._lock:
+            return 0.5 + 0.5 * self._rng.random()
+
+    # -- the injection check --------------------------------------------------------
+    def maybe_raise(self, point: str, desc: str = "") -> None:
+        """Count one invocation at ``point``; raise :class:`InjectedFault`
+        when the schedule or the chaos rate selects it."""
+        with self._lock:
+            if not self._sched and self._rate <= 0.0:
+                return
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            fire = any(first <= n < first + count
+                       for first, count in self._sched.get(point, ()))
+            if not fire and self._rate > 0.0 and point in self._rate_points:
+                fire = self._rng.random() < self._rate
+            if not fire:
+                return
+            self.injected_total[point] += 1
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        QueryStats.get().faults_injected += 1
+        tracing.mark(None, "fault:injected", "fault", point=point, n=n,
+                     desc=desc)
+        raise InjectedFault(
+            f"injected fault at {point} (invocation {n}"
+            + (f", {desc}" if desc else "") + ")", point=point)
+
+    # -- introspection --------------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"schedule": {p: list(v) for p, v in self._sched.items()},
+                    "rate": self._rate,
+                    "counts": dict(self._counts),
+                    "injected_total": dict(self.injected_total)}
+
+    def reset_totals(self) -> None:
+        with self._lock:
+            self.injected_total = {p: 0 for p in POINTS}
+
+
+INJECTOR = FaultInjector()

@@ -418,7 +418,13 @@ class ParquetSource:
         if self.cache_bytes > 0:
             from .filecache import FileCache, get_file_cache
             cache = get_file_cache(self.cache_bytes)
-        pf = pq.ParquetFile(path)
+        # io.read injection/recovery point: the file open + footer parse
+        # is where flaky storage surfaces (EIO, dropped NFS/object-store
+        # connections) — transient failures retry with backoff; a
+        # missing file is NOT transient and raises straight through
+        from ..faults.recovery import transient_retry
+        pf = transient_retry(None, "io.read", pq.ParquetFile, path,
+                             desc=path)
         skips = self.skip_rows.get(path)
         if skips is not None and len(skips) == 0:
             skips = None

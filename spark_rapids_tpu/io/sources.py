@@ -103,7 +103,12 @@ class FileSource:
 
     # -- reading ------------------------------------------------------------------
     def _read_file(self, path: str) -> Iterator:
-        t = self._load_table(path)
+        # io.read injection/recovery point (same contract as
+        # ParquetSource._read_file): the whole-file host parse retries
+        # transient storage failures with backoff
+        from ..faults.recovery import transient_retry
+        t = transient_retry(None, "io.read", self._load_table, path,
+                            desc=path)
         if self.columns is not None:
             t = t.select([c for c in self.columns if c in t.column_names])
         if self.predicates:

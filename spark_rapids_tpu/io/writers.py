@@ -29,7 +29,15 @@ class WriteStats:
 
 
 class _RollingFileWriter:
-    """One output stream per partition directory, rolled at max_records."""
+    """One output stream per partition directory, rolled at max_records.
+
+    Writes are ATOMIC per file: the stream targets a ``.inprogress``
+    temp path and only a successful :meth:`close` renames it to the
+    final ``part-*.{fmt}`` name — an injected (or real) mid-write fault
+    can never leave a partial file visible to subsequent scans or the
+    cache write-invalidation hooks; :meth:`close` with ``abort=True``
+    deletes the temp instead of publishing it.
+    """
 
     def __init__(self, fmt: str, directory: str, schema, max_records: int,
                  stats: WriteStats, csv_header: bool = True):
@@ -41,6 +49,7 @@ class _RollingFileWriter:
         self.csv_header = csv_header
         self._writer = None
         self._path = None
+        self._tmp = None
         self._rows_in_file = 0
         self._seq = 0
 
@@ -48,29 +57,34 @@ class _RollingFileWriter:
         os.makedirs(self.dir, exist_ok=True)
         name = f"part-{self._seq:05d}-{uuid.uuid4().hex[:12]}.{self.fmt}"
         self._path = os.path.join(self.dir, name)
+        self._tmp = self._path + ".inprogress"
         self._seq += 1
         self._rows_in_file = 0
         if self.fmt == "parquet":
             import pyarrow.parquet as pq
-            self._writer = pq.ParquetWriter(self._path, self.schema)
+            self._writer = pq.ParquetWriter(self._tmp, self.schema)
         elif self.fmt == "orc":
             from pyarrow import orc
-            w = orc.ORCWriter(self._path)
+            w = orc.ORCWriter(self._tmp)
             w.write_table = w.write  # align with the parquet writer surface
             self._writer = w
         elif self.fmt == "json":
-            self._writer = _JsonLinesWriter(self._path)
+            self._writer = _JsonLinesWriter(self._tmp)
         elif self.fmt == "avro":
-            self._writer = _AvroAccumWriter(self._path)
+            self._writer = _AvroAccumWriter(self._tmp)
         else:
             import pyarrow.csv as pacsv
             self._writer = pacsv.CSVWriter(
-                self._path, self.schema,
+                self._tmp, self.schema,
                 write_options=pacsv.WriteOptions(
                     include_header=self.csv_header))
         self.stats.num_files += 1
 
+    def _write_chunk(self, chunk) -> None:
+        self._writer.write_table(chunk)
+
     def write(self, table) -> None:
+        from ..faults.recovery import transient_retry
         offset = 0
         n = table.num_rows
         while offset < n:
@@ -80,17 +94,33 @@ class _RollingFileWriter:
                     if self.max_records > 0 else n - offset)
             take = min(room, n - offset)
             chunk = table.slice(offset, take)
-            self._writer.write_table(chunk)
+            # io.write injection/recovery point: an INJECTED fault fires
+            # before the stream write and retries safely; a real write
+            # error is not retried in place (a re-run could duplicate
+            # rows mid-stream) — it propagates, and atomicity above
+            # guarantees the partial file is never published
+            transient_retry(None, "io.write", self._write_chunk, chunk,
+                            desc=self._path or self.dir)
             self._rows_in_file += take
             self.stats.num_rows += take
             offset += take
             if self.max_records > 0 and self._rows_in_file >= self.max_records:
                 self.close()
 
-    def close(self) -> None:
+    def close(self, abort: bool = False) -> None:
         if self._writer is not None:
-            self._writer.close()
-            self._writer = None
+            try:
+                self._writer.close()
+            finally:
+                self._writer = None
+            if abort:
+                try:
+                    os.unlink(self._tmp)
+                except OSError:
+                    pass
+                return
+            # publish: the rename is the commit point
+            os.replace(self._tmp, self._path)
             try:
                 self.stats.num_bytes += os.path.getsize(self._path)
             except OSError:
@@ -205,6 +235,7 @@ class DataFrameWriter:
         part_cols = self._partition_by
 
         out_schema = None
+        ok = False
         try:
             for table in self._df.session._execute_batches(self._df._plan):
                 if table.num_rows == 0:
@@ -263,9 +294,13 @@ class DataFrameWriter:
                             csv_header)
                         stats.partitions.append("/".join(parts))
                     w.write(sub)
+            ok = True
         finally:
+            # on failure the in-progress temp files are deleted, never
+            # renamed into place: rolled (already-committed) files stay,
+            # but no partial file becomes visible to a scan
             for w in writers.values():
-                w.close()
+                w.close(abort=not ok)
             # the table changed under any reader: drop cross-query cache
             # entries sourced from it (overwrite AND append — an appended
             # file widens the file set, so old entries are stale).  The

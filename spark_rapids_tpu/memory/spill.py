@@ -367,6 +367,6 @@ def _device_budget(conf) -> int:
         total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
         if total:
             return int(total * frac)
-    except Exception:
+    except Exception:  # fault-ok (backend reports no memory stats; use fallback)
         pass
     return int((8 << 30) * frac)

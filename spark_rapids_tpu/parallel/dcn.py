@@ -41,6 +41,9 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from ..faults.recovery import TransientFault, backoff_delays, \
+    transient_retry
+
 __all__ = ["Coordinator", "ProcessGroup", "DcnShuffle", "PeerFailedError",
            "host_partition_ids", "run_distributed_agg",
            "run_distributed_query"]
@@ -49,8 +52,10 @@ _LEN = struct.Struct("<II")  # json length, binary payload length
 _CHUNK = 1 << 20
 
 
-class PeerFailedError(RuntimeError):
-    """A peer stopped heartbeating or dropped mid-transfer."""
+class PeerFailedError(TransientFault):
+    """A peer stopped heartbeating or dropped mid-transfer.  A
+    :class:`..faults.recovery.TransientFault`: fragment fetches that hit
+    it re-pull with backoff before the query fails typed."""
 
 
 # ---------------------------------------------------------------------------------
@@ -98,14 +103,16 @@ class Coordinator:
         # None = resolve from the registered confs (session overrides
         # apply), so service deployments tune liveness without code:
         # spark.rapids.tpu.dcn.{heartbeatTimeout,waitTimeout}
-        if heartbeat_timeout is None or wait_timeout is None:
-            from ..config import TpuConf
-            conf = TpuConf()
-            if heartbeat_timeout is None:
-                heartbeat_timeout = conf[
-                    "spark.rapids.tpu.dcn.heartbeatTimeout"]
-            if wait_timeout is None:
-                wait_timeout = conf["spark.rapids.tpu.dcn.waitTimeout"]
+        from ..config import TpuConf
+        conf = TpuConf()
+        if heartbeat_timeout is None:
+            heartbeat_timeout = conf[
+                "spark.rapids.tpu.dcn.heartbeatTimeout"]
+        if wait_timeout is None:
+            wait_timeout = conf["spark.rapids.tpu.dcn.waitTimeout"]
+        # backoff parameters for the barrier/allgather re-check cadence
+        # (spark.rapids.tpu.faults.backoff.*)
+        self._conf = conf
         self.world_size = world_size
         self.heartbeat_timeout = heartbeat_timeout
         self.wait_timeout = wait_timeout
@@ -152,13 +159,17 @@ class Coordinator:
 
     def _wait_for(self, pred, what: str, rank: int = -1):
         deadline = time.monotonic() + self.wait_timeout  # span-api-ok (timeout, not timing)
+        # re-check cadence grows on the registered backoff curve
+        # (faults.backoff.*) instead of a fixed 1 s poll: short stalls
+        # resolve fast, long barriers stop burning wakeups
+        delays = backoff_delays(self._conf)
         while not pred():
             left = deadline - time.monotonic()  # span-api-ok (timeout, not timing)
             if left <= 0:
                 raise PeerFailedError(
                     f"timed out waiting for all ranks at {what} "
                     f"(dead: {self._dead_locked()})")
-            self._cv.wait(timeout=min(left, 1.0))
+            self._cv.wait(timeout=min(left, max(0.01, next(delays))))
             if rank >= 0:
                 # a rank parked in a collective is alive by construction —
                 # keep refreshing so it can't be declared dead mid-wait
@@ -339,19 +350,20 @@ class ProcessGroup:
 
     @staticmethod
     def _connect(addr: Tuple[str, int], timeout: float) -> socket.socket:
-        deadline = time.monotonic() + timeout  # span-api-ok (timeout, not timing)
-        while True:
-            try:
-                sock = socket.create_connection(addr, timeout=timeout)
-                # waits (barrier/allgather) can far exceed the connect
-                # timeout; the coordinator bounds them with wait_timeout
-                # and replies with an error rather than letting us hang
-                sock.settimeout(None)
-                return sock
-            except OSError:
-                if time.monotonic() > deadline:  # span-api-ok (timeout, not timing)
-                    raise
-                time.sleep(0.1)
+        def _dial() -> socket.socket:
+            sock = socket.create_connection(addr, timeout=timeout)
+            # waits (barrier/allgather) can far exceed the connect
+            # timeout; the coordinator bounds them with wait_timeout
+            # and replies with an error rather than letting us hang
+            sock.settimeout(None)
+            return sock
+
+        # connect retries ride the fault framework: exponential backoff
+        # + jitter (faults.backoff.*) replaces the old fixed 0.1 s poll,
+        # bounded by the connect deadline instead of an attempt count
+        return transient_retry(None, "dcn.heartbeat", _dial,
+                               desc=f"connect {addr[0]}:{addr[1]}",
+                               deadline_s=timeout)
 
     def _request(self, obj: dict, blob: bytes = b"") -> Tuple[dict, bytes]:
         with self._ctrl_lock:
@@ -384,18 +396,29 @@ class ProcessGroup:
         return out
 
     # -- failure detection ---------------------------------------------------------
+    def _heartbeat_once(self) -> dict:
+        with self._hb_lock:
+            _send(self._hb_sock, {"op": "heartbeat", "rank": self.rank})
+            msg, _ = _recv(self._hb_sock)
+        return msg
+
     def _heartbeat_loop(self, interval: float) -> None:
+        from ..faults.recovery import QueryFaulted
         while not self._closed:
             time.sleep(interval)
             if self._closed:
                 return
             try:
-                with self._hb_lock:
-                    _send(self._hb_sock, {"op": "heartbeat",
-                                          "rank": self.rank})
-                    msg, _ = _recv(self._hb_sock)
+                # dcn.heartbeat injection/recovery point: a dropped
+                # heartbeat retries with exponential backoff + jitter
+                # before this rank gives up on liveness reporting (the
+                # coordinator's heartbeat_timeout is the authority on
+                # actual death)
+                msg = transient_retry(None, "dcn.heartbeat",
+                                      self._heartbeat_once,
+                                      desc=f"rank-{self.rank}")
                 self._dead = [int(r) for r in msg.get("dead", [])]
-            except (ConnectionError, OSError):
+            except (QueryFaulted, ConnectionError, OSError):
                 return
 
     @property
@@ -491,13 +514,30 @@ class DcnShuffle:
 
     def read_partition(self, p: int) -> Iterator:
         """Yield every rank's arrow tables for partition ``p`` (local frames
-        short-circuit to the file, like RapidsCachingReader local reads)."""
+        short-circuit to the file, like RapidsCachingReader local reads).
+
+        Fragment recovery: a failed pull — local frame decode or remote
+        peer fetch — re-pulls that rank's fragment from the producing
+        rank's durable map output with backoff (``shuffle.fragment``
+        point; successful re-pulls count ``fragments_recomputed``)
+        instead of failing the query.  A peer that is genuinely gone
+        exhausts the retries and surfaces the typed failure.
+        """
         from .host_shuffle import iter_frames
         for r in range(self.pg.world_size):
             if r == self.pg.rank:
-                yield from self.local.read_partition(p)
+                tables = transient_retry(
+                    None, "shuffle.fragment",
+                    lambda p=p: list(self.local.read_partition(p)),
+                    desc=f"local part-{p:05d}",
+                    recover_counter="fragments_recomputed")
+                yield from tables
             else:
-                payload = self.pg.fetch(r, self.id, p)
+                payload = transient_retry(
+                    None, "shuffle.fragment", self.pg.fetch,
+                    r, self.id, p,
+                    desc=f"rank-{r} part-{p:05d}",
+                    recover_counter="fragments_recomputed")
                 if payload:
                     yield from iter_frames(payload)
 

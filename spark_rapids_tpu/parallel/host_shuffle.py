@@ -121,9 +121,17 @@ class HostShuffle:
 
     # -- read side ----------------------------------------------------------------
     def read_partition(self, p: int) -> Iterator:
-        """Yield the arrow tables written to partition ``p``."""
+        """Yield the arrow tables written to partition ``p``.
+
+        Each frame decode is a ``shuffle.fragment`` injection point: a
+        transient failure raises out of the generator and the CONSUMER
+        (plan/exchange_exec, parallel/dcn) re-pulls the whole partition
+        from these durable map-side frame files — the in-process analog
+        of recomputing a lost fragment from its producing stage.
+        """
         import pyarrow as pa
 
+        from ..faults.injector import INJECTOR
         from ..service import cancel
         from ..utils import tracing
         path = self._paths[p]
@@ -137,6 +145,8 @@ class HostShuffle:
                 if not header:
                     break
                 with tracing.span(None, "shuffle:read", "shuffle") as sp:
+                    INJECTOR.maybe_raise("shuffle.fragment",
+                                         desc=f"part-{p:05d}")
                     flag, clen, rlen = _FRAME.unpack(header)
                     payload = _decompress(flag, f.read(clen), rlen)
                     with pa.ipc.open_stream(pa.py_buffer(payload)) as r:

@@ -174,10 +174,19 @@ class ShuffleExchangeExec(TpuExec):
             with m.time("opTime"):
                 shuffle.finish_writes()
             min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
+            from ..faults.recovery import transient_retry
             from ..service import cancel as _cancel
             for p in range(self.n_parts):
                 _cancel.check()  # shuffle reader batch boundary
-                tables = list(shuffle.read_partition(p))
+                # a lost/failed fragment re-pulls the partition from the
+                # producing stage's durable frame files (lineage
+                # recompute) instead of failing the query; a successful
+                # re-pull after a fault counts fragments_recomputed
+                tables = transient_retry(
+                    ctx, "shuffle.fragment",
+                    lambda p=p: list(shuffle.read_partition(p)),
+                    desc=f"part-{p:05d}",
+                    recover_counter="fragments_recomputed")
                 with m.time("opTime"):
                     if not tables:
                         from .join_exec import _empty_batch

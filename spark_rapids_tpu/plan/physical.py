@@ -49,6 +49,11 @@ class ExecContext:
         # injections a previous query armed on the process-global injector
         from ..memory.retry import INJECTOR
         INJECTOR.arm(n_retry, n_split)
+        # same contract for the unified fault injector (faults/): the
+        # spark.rapids.tpu.faults.inject.* confs arm per query, and an
+        # unarmed conf clears the previous query's schedule/rate
+        from ..faults.injector import INJECTOR as FAULT_INJECTOR
+        FAULT_INJECTOR.arm_from_conf(self.conf)
 
     def metric_set(self, op_id: str) -> MetricSet:
         if op_id not in self.metrics:
@@ -426,6 +431,8 @@ class StageExec(TpuExec):
                 break
 
         from ..cpu.eval import set_ansi
+        from ..faults.injector import INJECTOR as FAULT_INJECTOR
+        from ..faults.recovery import device_guard
         from ..memory.retry import INJECTOR, with_retry
 
         # batch-context state for mid()/spark_partition_id()/
@@ -487,26 +494,52 @@ class StageExec(TpuExec):
                     # the thread-local must never leak past this batch —
                     # ANSI errors raise out of evaluate_host_expr
                     set_ansi(False)
-            fresh_output = False
+            def _assemble(out_arrays, new_sel, fresh_output):
+                cols: List = []
+                for oi, f_ in enumerate(self._schema):
+                    val = out_arrays[oi] if oi < len(out_arrays) else None
+                    if val is None:
+                        # host column: pass-through ref or host-computed
+                        # string
+                        src = self._host_source_ordinal(oi)
+                        if isinstance(src, tuple) and src[0] == "hc":
+                            cols.append(host_computed[src[1]])
+                        else:
+                            cols.append(b.columns[src])
+                    else:
+                        data, valid = val
+                        cols.append(DeviceColumn(f_.dtype, data, valid))
+                out = ColumnBatch(self._schema, cols, b.num_rows, new_sel)
+                # device outputs are fresh program results (single
+                # consumer); the pure-host path shares the input's sel,
+                # so it inherits
+                out.donatable = fresh_output \
+                    or getattr(b, "donatable", False)
+                return out
+
             if all(a is None for a in arrays) and \
                     all(e is None for e in extras):
                 # pure host-column stage (string-only projection): no XLA
                 # program to run
-                out_arrays = (None,) * len(self._schema)
-                new_sel = b.sel
-            else:
-                use_fn = fn
-                if fn_donate is not None and b.donatable \
-                        and not INJECTOR.armed():
-                    # this program consumes the input buffers; the batch
-                    # is dead to every later reference (incl. an OOM
-                    # replay — donation is gated off while injection is
-                    # armed, and the conf documents the real-OOM caveat)
-                    b.donatable = False
-                    use_fn = fn_donate
-                    from ..utils.metrics import QueryStats
-                    QueryStats.get().donated_batches += 1
-                fresh_output = True
+                return _assemble((None,) * len(self._schema), b.sel,
+                                 fresh_output=False)
+            use_fn = fn
+            donated = False
+            if fn_donate is not None and b.donatable \
+                    and not INJECTOR.armed() \
+                    and not FAULT_INJECTOR.armed():
+                # this program consumes the input buffers; the batch
+                # is dead to every later reference (incl. an OOM
+                # replay or a transient re-dispatch — donation is gated
+                # off while either injector is armed, and the conf
+                # documents the real-OOM caveat)
+                b.donatable = False
+                use_fn = fn_donate
+                donated = True
+                from ..utils.metrics import QueryStats
+                QueryStats.get().donated_batches += 1
+
+            def _device_result():
                 outs = use_fn(tuple(arrays), tuple(extras),
                               b.sel, np.int32(b.num_rows))
                 if ansi:
@@ -519,24 +552,23 @@ class StageExec(TpuExec):
                             "nulling/wrapping)")
                 else:
                     out_arrays, new_sel = outs
-            cols: List = []
-            for oi, f_ in enumerate(self._schema):
-                val = out_arrays[oi] if oi < len(out_arrays) else None
-                if val is None:
-                    # host column: pass-through ref or host-computed string
-                    src = self._host_source_ordinal(oi)
-                    if isinstance(src, tuple) and src[0] == "hc":
-                        cols.append(host_computed[src[1]])
-                    else:
-                        cols.append(b.columns[src])
-                else:
-                    data, valid = val
-                    cols.append(DeviceColumn(f_.dtype, data, valid))
-            out = ColumnBatch(self._schema, cols, b.num_rows, new_sel)
-            # device outputs are fresh program results (single consumer);
-            # the pure-host path shares the input's sel, so it inherits
-            out.donatable = fresh_output or getattr(b, "donatable", False)
-            return out
+                return _assemble(out_arrays, new_sel, fresh_output=True)
+
+            if donated:
+                # donated inputs are consumed by the program: they can
+                # be neither replayed by a transient re-dispatch nor
+                # handed to the CPU fallback — run unguarded (donation
+                # never engages while an injector is armed)
+                return _device_result()
+            # device.op guard: transient (non-OOM) runtime failures
+            # re-dispatch with backoff, then this batch degrades to the
+            # host expression evaluator (cpu/eval) when the stage has no
+            # host-lowered exprs and ANSI error masking is off (the CPU
+            # path cannot scope ANSI errors to active rows)
+            cpu_fb = None if (self.host_exprs or ansi) \
+                else (lambda: self._cpu_batch(b, ctx))
+            return device_guard(ctx, self.op_id, _device_result,
+                                cpu_fallback=cpu_fb)
 
         # pull the child up to `depth` batches ahead: its host decode +
         # upload (and any upstream dispatch) overlaps this stage's XLA
@@ -569,6 +601,49 @@ class StageExec(TpuExec):
                 return src
             ord_ = src
         return ord_
+
+    def _cpu_batch(self, b: ColumnBatch, ctx: ExecContext) -> ColumnBatch:
+        """Graceful-degradation path (faults/recovery.device_guard): run
+        THIS batch through the host expression evaluator when the
+        device op keeps failing transiently — same project/filter
+        semantics as the XLA program, evaluated by cpu/eval over the
+        fetched rows.  Only engaged for stages without host-lowered
+        exprs and with ANSI off (see execute); the result re-uploads so
+        downstream operators are unaffected."""
+        import pyarrow as pa
+
+        from ..batch import from_arrow, to_arrow
+        from ..cpu.eval import eval_cpu
+        from ..cpu.exec import arrow_to_values, values_to_arrow
+        from ..ops import batch_utils
+        t = to_arrow(batch_utils.compact(b))
+        n = t.num_rows
+        cur = arrow_to_values(t, self.children[0].output_schema)
+        active = np.ones(n, dtype=bool)
+        for kind, payload in self.steps:
+            if kind == "filter":
+                d, v = eval_cpu(payload, cur, n)
+                keep = np.asarray(d, dtype=bool)
+                if v is not None:
+                    keep = keep & np.asarray(v, dtype=bool)
+                active &= keep
+            else:
+                nxt = []
+                for _name, e, src in payload:
+                    nxt.append(cur[src] if e is None
+                               else eval_cpu(e, cur, n))
+                cur = nxt
+        out_t = values_to_arrow(self._schema, cur, n)
+        if not active.all():
+            out_t = out_t.filter(pa.array(active))
+        out = from_arrow(
+            out_t,
+            min_capacity=ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"],
+            device=ctx.device)
+        origin = getattr(b, "origin_file", None)
+        if origin is not None:
+            out.origin_file = origin
+        return out
 
 
 # ---------------------------------------------------------------------------------

@@ -106,6 +106,13 @@ def effective_depth(ctx) -> int:
     if conf["spark.rapids.tpu.test.injectRetryOOM"] \
             or conf["spark.rapids.tpu.test.injectSplitAndRetryOOM"]:
         return 0
+    # deterministic fault schedules ("fail the Nth op at P") need the
+    # same serial-execution guarantee: staged workers racing for the
+    # per-point invocation counters would make the injection point
+    # nondeterministic.  Probabilistic chaos rates keep the pipeline.
+    from ..faults.injector import INJECTOR as FAULT_INJECTOR
+    if FAULT_INJECTOR.deterministic_armed():
+        return 0
     if not conf.is_set(_DEPTH_KEY):
         import jax
         if jax.default_backend() == "cpu":
@@ -185,7 +192,7 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
             if close is not None:
                 try:
                     close()
-                except BaseException:
+                except BaseException:  # fault-ok (teardown of an already-failed upstream)
                     pass
 
     th = threading.Thread(target=lambda: cctx.run(worker), daemon=True,

@@ -107,7 +107,7 @@ class TpuSemaphore:
             for fn in listeners:
                 try:
                     fn()
-                except Exception:
+                except Exception:  # fault-ok (listener callback; release must proceed)
                     pass
 
 

@@ -16,11 +16,16 @@ from .cancel import (QueryCancelled, QueryControl,  # noqa: F401
 
 __all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryControl",
            "QueryRejected", "QueryScheduler", "QueryHandle",
-           "check", "current", "scope", "cancel"]
+           "QueryFaulted", "check", "current", "scope", "cancel"]
 
 
 def __getattr__(name):
     if name in ("QueryRejected", "QueryScheduler", "QueryHandle"):
         from . import scheduler
         return getattr(scheduler, name)
+    if name == "QueryFaulted":
+        # the service surface re-exports the typed terminal failure a
+        # handle's result() raises when fault recovery exhausts
+        from ..faults.recovery import QueryFaulted
+        return QueryFaulted
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -130,7 +130,7 @@ class QueryControl:
         for w in wakers:
             try:
                 w()
-            except Exception:
+            except Exception:  # fault-ok (waker callback; cancellation must proceed)
                 pass
         return True
 
@@ -146,7 +146,7 @@ class QueryControl:
         if already:
             try:
                 fn()
-            except Exception:
+            except Exception:  # fault-ok (waker callback; registration must proceed)
                 pass
         return tok
 

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..faults.recovery import QueryFaulted
 from .cancel import (QueryCancelled, QueryControl, QueryDeadlineExceeded,
                      scope as control_scope)
 
@@ -118,7 +119,10 @@ class QueryHandle:
 
     @property
     def status(self) -> str:
-        """queued | running | done | failed | cancelled | deadline"""
+        """queued | running | done | failed | faulted | cancelled |
+        deadline (``faulted`` = transient-fault recovery exhausted; the
+        :class:`..faults.recovery.QueryFaulted` from :meth:`result`
+        carries the fault history)"""
         return self._entry.status
 
     @property
@@ -351,6 +355,12 @@ class QueryScheduler:
                 status, error = "deadline", exc
             except QueryCancelled as exc:
                 status, error = "cancelled", exc
+            except QueryFaulted as exc:
+                # transient-fault recovery exhausted: the typed failure
+                # (fault history attached) becomes its own terminal
+                # status; the unwind above already released the permit,
+                # pipeline slots, and spill handles
+                status, error = "faulted", exc
             except BaseException as exc:
                 status, error = "failed", exc
             e.stats = stats.snapshot()

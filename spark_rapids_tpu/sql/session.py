@@ -357,6 +357,15 @@ class Session:
         with cancel.scope(ctl) as c:
             yield c
 
+    def _fault_scope(self, conf):
+        """Per-query transient-fault scope: the retry budget
+        (``spark.rapids.tpu.faults.retryBudget``) plus the conf the
+        recovery layer's conf-less call sites (io sources, shuffle
+        readers) resolve backoff parameters from.  Worker threads run
+        copied contexts, so the whole query draws one budget."""
+        from ..faults.recovery import budget_scope
+        return budget_scope(conf)
+
     # -- query tracing ------------------------------------------------------------
     _query_seq = 0
 
@@ -403,11 +412,15 @@ class Session:
     @staticmethod
     def _trace_status(tr, exc: BaseException) -> None:
         """Map the exception that ended execution onto the trace's span
-        status, so an aborted query's trace ends 'cancelled'."""
+        status, so an aborted query's trace ends 'cancelled' (and a
+        query whose transient-fault recovery exhausted ends 'faulted')."""
         if tr is None or isinstance(exc, GeneratorExit):
             return  # an abandoned stream (LIMIT) is not a failure
+        from ..faults.recovery import QueryFaulted
         from ..service import cancel
-        if isinstance(exc, cancel.QueryDeadlineExceeded):
+        if isinstance(exc, QueryFaulted):
+            tr.set_status("faulted")
+        elif isinstance(exc, cancel.QueryDeadlineExceeded):
             tr.set_status("deadline")
         elif isinstance(exc, cancel.QueryCancelled):
             tr.set_status("cancelled")
@@ -417,6 +430,12 @@ class Session:
     def _finish_trace(self, tr, ctx, stats) -> None:
         if tr is None:
             return
+        if tr.status == "ok" and stats.degraded_batches:
+            # the query finished, but some batches ran the CPU
+            # degradation path after device-op retries exhausted — an
+            # accurate trace says so (the degraded:cpu marks carry the
+            # per-operator detail)
+            tr.set_status("degraded")
         tr.finish(metrics=ctx.metrics, stats=stats.snapshot())
         self._last_trace = tr
         conf = ctx.conf
@@ -464,8 +483,8 @@ class Session:
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
-        with QueryStats.scoped() as stats, self._control_scope(conf), \
-                self._trace_scope(conf) as tr:
+        with QueryStats.scoped() as stats, self._fault_scope(conf), \
+                self._control_scope(conf), self._trace_scope(conf) as tr:
             try:
                 with get_semaphore(conf).acquire():
                     phys = self._distribute_if_ici(phys, ctx)
@@ -500,8 +519,8 @@ class Session:
         # sess.profiled_explain())
         self._last_ctx = ctx
         self._last_phys = phys
-        with QueryStats.scoped() as stats, self._control_scope(conf), \
-                self._trace_scope(conf) as tr:
+        with QueryStats.scoped() as stats, self._fault_scope(conf), \
+                self._control_scope(conf), self._trace_scope(conf) as tr:
             try:
                 with get_semaphore(conf).acquire():
                     phys = self._distribute_if_ici(phys, ctx)
@@ -531,8 +550,8 @@ class Session:
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
         ctx = ExecContext(conf, device=self.device)
-        with QueryStats.scoped() as stats, self._control_scope(conf), \
-                self._trace_scope(conf) as tr:
+        with QueryStats.scoped() as stats, self._fault_scope(conf), \
+                self._control_scope(conf), self._trace_scope(conf) as tr:
             try:
                 with get_semaphore(conf).acquire():
                     phys = self._distribute_if_ici(phys, ctx)

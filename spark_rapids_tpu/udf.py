@@ -239,7 +239,7 @@ def _run_isolated(compute, timeout: float):
         except BaseException as e:  # noqa: BLE001 — report, don't die silently
             try:
                 conn.send(("err", f"{type(e).__name__}: {e}"))
-            except Exception:
+            except Exception:  # fault-ok (worker death reporting; pipe may be gone)
                 pass
 
     proc = ctx.Process(target=main, args=(child,), daemon=True)

@@ -99,6 +99,18 @@ class QueryStats:
         self.cache_hit_bytes = 0
         self.cache_evictions = 0
         self.cache_evict_bytes = 0
+        # transient-fault framework (spark_rapids_tpu/faults/): faults
+        # the injector fired, retries the recovery layer issued (and the
+        # wall-clock spent backing off), shuffle fragments re-pulled
+        # from their producing stage after a fault, and batches that
+        # degraded to the cpu/ path after device-op retries exhausted —
+        # bench's SRT_BENCH_FAULT_RATE columns and the trace_report
+        # fault-summary line read these
+        self.faults_injected = 0
+        self.transient_retries = 0
+        self.retry_backoff_s = 0.0
+        self.fragments_recomputed = 0
+        self.degraded_batches = 0
 
     # -- accessors ----------------------------------------------------------
     @classmethod
@@ -271,8 +283,8 @@ def _start_copies(tree) -> None:
         if start is not None:
             try:
                 start()
-            except Exception:
-                pass  # a hint only; the blocking get still works
+            except Exception:  # fault-ok (async-copy hint only; the blocking get still works)
+                pass
 
 
 class FetchFuture:

@@ -197,7 +197,7 @@ class QueryTrace:
             entry = self._ensure_op(op_id, op_id.split("@", 1)[0])
             try:
                 mset._resolve()  # deferred device counters land on host
-            except Exception:
+            except Exception:  # fault-ok (best-effort metrics on a dead backend)
                 pass
             entry["metrics"].update(
                 {k: (round(v, 6) if isinstance(v, float) else v)
@@ -432,7 +432,7 @@ def render_profiled(root, metrics: Dict[str, object]) -> str:
             return "rows=0 batches=0 bytes=0B time=0.0ms (not executed)"
         try:
             mset._resolve()
-        except Exception:
+        except Exception:  # fault-ok (best-effort metrics on a dead backend)
             pass
         v = dict(mset.values)
         rows = int(v.pop("outputRows", 0))

@@ -97,3 +97,15 @@ def pytest_collection_modifyitems(config, items):
         raise pytest.UsageError(
             "ad-hoc cache keys — derive them via cache.keys.scan_key / "
             f"broadcast_key (tools/check_cache_keys.py):\n{lines}")
+    # (e) a bare `except Exception: pass` swallows the transient faults
+    # the recovery framework exists to retry/account, and a hand-rolled
+    # sleep-after-except retry loop dodges backoff, budgets, and stats
+    from tools.check_fault_paths import check as check_faults
+    violations = check_faults()
+    if violations:
+        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
+                          for rel, ln, src in violations)
+        raise pytest.UsageError(
+            "swallowed faults / ad-hoc retry loops — use faults.recovery."
+            "transient_retry or mark '# fault-ok' "
+            f"(tools/check_fault_paths.py):\n{lines}")

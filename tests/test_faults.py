@@ -1,0 +1,705 @@
+"""Unified fault-injection framework + transient-failure recovery
+(spark_rapids_tpu/faults/): injector semantics, retry/backoff/budget,
+per-layer recovery (io.read, io.write, shuffle.fragment, dcn.heartbeat,
+device.op, cache.lookup), graceful CPU degradation, leak hygiene under
+faults, and the chaos differential — results under a seeded fault
+schedule must equal the fault-free run with every handle released.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.cache import clear_query_cache, get_query_cache
+from spark_rapids_tpu.config import ALL_ENTRIES, TpuConf
+from spark_rapids_tpu.faults import (INJECTOR, FaultInjector, InjectedFault,
+                                     POINTS, QueryFaulted, TransientFault,
+                                     budget_scope, transient_retry)
+from spark_rapids_tpu.memory.spill import get_catalog
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.metrics import QueryStats
+
+FAST_BACKOFF = {
+    "spark.rapids.tpu.faults.backoff.baseMs": 1.0,
+    "spark.rapids.tpu.faults.backoff.maxMs": 8.0,
+}
+
+
+@pytest.fixture()
+def faults_session(session):
+    """Session with fast backoff; every faults.* key restored after."""
+    keys = [k for k in ALL_ENTRIES if k.startswith("spark.rapids.tpu.faults.")]
+    for k, v in FAST_BACKOFF.items():
+        session.conf.set(k, v)
+    yield session
+    for k in keys:
+        session.conf.unset(k)
+    for k in ("spark.rapids.tpu.sql.cache.enabled",
+              "spark.rapids.tpu.shuffle.mode",
+              "spark.rapids.tpu.sql.trace.enabled"):
+        session.conf.unset(k)
+    INJECTOR.arm()  # clear any armed schedule/rate
+    clear_query_cache()
+
+
+def _write_pq(tmp_path, name, pdf):
+    path = str(tmp_path / name)
+    pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False), path)
+    return path
+
+
+def _frame(n=3000, seed=11):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "a": np.arange(n, dtype=np.int64),
+        "b": rng.random(n),
+        "k": rng.integers(0, 12, n).astype(np.int64),
+    })
+
+
+def _agg_rows(sess, path):
+    df = sess.read_parquet(path)
+    return sorted(df.filter(F.col("b") < 0.7).group_by("k").agg(
+        F.sum(F.col("a")).alias("s"),
+        F.count(F.col("b")).alias("c")).collect())
+
+
+# ---------------------------------------------------------------------------
+# Injector semantics.
+# ---------------------------------------------------------------------------
+
+class TestInjector:
+    def test_schedule_fires_exactly_nth(self):
+        inj = FaultInjector()
+        inj.arm(schedule="io.read:3")
+        fired = []
+        for i in range(1, 6):
+            try:
+                inj.maybe_raise("io.read")
+            except InjectedFault:
+                fired.append(i)
+        assert fired == [3]
+        assert inj.injected_total["io.read"] == 1
+
+    def test_schedule_range_and_multiple_points(self):
+        inj = FaultInjector()
+        inj.arm(schedule="device.op:2:3, io.write:1")
+        dev = []
+        for i in range(1, 7):
+            try:
+                inj.maybe_raise("device.op")
+            except InjectedFault:
+                dev.append(i)
+        assert dev == [2, 3, 4]
+        with pytest.raises(InjectedFault):
+            inj.maybe_raise("io.write")
+        inj.maybe_raise("io.write")  # only the 1st fires
+
+    def test_unknown_point_rejected(self):
+        inj = FaultInjector()
+        with pytest.raises(ValueError, match="unknown injection point"):
+            inj.arm(schedule="io.reed:1")
+        with pytest.raises(ValueError):
+            inj.arm(rate=0.1, points="nope")
+
+    def test_rate_seeded_reproducible(self):
+        def pattern():
+            inj = FaultInjector()
+            inj.arm(rate=0.5, seed=42)
+            out = []
+            for _ in range(32):
+                try:
+                    inj.maybe_raise("io.read")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        p1, p2 = pattern(), pattern()
+        assert p1 == p2
+        assert 0 < sum(p1) < 32
+
+    def test_rate_restricted_to_points(self):
+        inj = FaultInjector()
+        inj.arm(rate=0.999999, points="io.read", seed=1)
+        with pytest.raises(InjectedFault):
+            inj.maybe_raise("io.read")
+        inj.maybe_raise("device.op")  # not selected: never fires
+
+    def test_rearm_clears(self):
+        inj = FaultInjector()
+        inj.arm(schedule="io.read:1")
+        inj.arm()  # the no-injection conf of the next query clears
+        inj.maybe_raise("io.read")
+        assert not inj.armed()
+
+
+# ---------------------------------------------------------------------------
+# Retry driver: backoff, budget, typed exhaustion.
+# ---------------------------------------------------------------------------
+
+class TestTransientRetry:
+    def conf(self, **kv):
+        return TpuConf({**FAST_BACKOFF, **kv})
+
+    def test_recovers_and_accounts(self):
+        conf = self.conf()
+        INJECTOR.arm(schedule="io.read:1:2")
+        s0 = QueryStats.get().snapshot()
+        calls = []
+        with budget_scope(conf) as budget:
+            out = transient_retry(conf, "io.read",
+                                  lambda: calls.append(1) or "v")
+        assert out == "v" and len(calls) == 1  # 2 injected, 1 real call
+        d = QueryStats.delta_since(s0)
+        assert d["transient_retries"] == 2
+        assert d["faults_injected"] == 2
+        assert d["retry_backoff_s"] > 0
+        assert [r.attempt for r in budget.history] == [1, 2]
+        assert all(r.point == "io.read" for r in budget.history)
+        INJECTOR.arm()
+
+    def test_backoff_grows_exponentially(self):
+        conf = self.conf(**{
+            "spark.rapids.tpu.faults.backoff.baseMs": 2.0,
+            "spark.rapids.tpu.faults.backoff.maxMs": 1000.0,
+            "spark.rapids.tpu.faults.backoff.multiplier": 4.0})
+        INJECTOR.arm(schedule="io.read:1:3", seed=9)
+        with budget_scope(conf) as budget:
+            transient_retry(conf, "io.read", lambda: None)
+        INJECTOR.arm()
+        b = [r.backoff_s for r in budget.history]
+        assert len(b) == 3
+        # jitter is in [0.5, 1.0]: attempt N+1's floor beats attempt N's
+        # ceiling at multiplier 4
+        assert b[1] > b[0] and b[2] > b[1]
+
+    def test_max_retries_exhaustion(self):
+        conf = self.conf(**{"spark.rapids.tpu.faults.maxRetries": 2})
+        INJECTOR.arm(schedule="io.read:1:99")
+        with budget_scope(conf):
+            with pytest.raises(QueryFaulted) as ei:
+                transient_retry(conf, "io.read", lambda: None)
+        INJECTOR.arm()
+        assert ei.value.point == "io.read"
+        assert len(ei.value.history) == 3  # 2 retries + the terminal fault
+
+    def test_budget_exhaustion(self):
+        conf = self.conf(**{"spark.rapids.tpu.faults.retryBudget": 0})
+        INJECTOR.arm(schedule="io.read:1")
+        with budget_scope(conf):
+            with pytest.raises(QueryFaulted):
+                transient_retry(conf, "io.read", lambda: None)
+        INJECTOR.arm()
+
+    def test_recovery_disabled_fails_fast(self):
+        conf = self.conf(**{
+            "spark.rapids.tpu.faults.recovery.enabled": False})
+        INJECTOR.arm(schedule="io.read:1")
+        with pytest.raises(QueryFaulted):
+            transient_retry(conf, "io.read", lambda: None)
+        INJECTOR.arm()
+
+    def test_non_retryable_passthrough(self):
+        def missing():
+            raise FileNotFoundError("gone")
+
+        with pytest.raises(FileNotFoundError):
+            transient_retry(self.conf(), "io.read", missing)
+
+    def test_real_transient_oserror_retried(self):
+        conf = self.conf()
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise OSError("EIO: device hiccup")
+            return state["n"]
+
+        assert transient_retry(conf, "io.read", flaky) == 2
+
+    def test_io_write_only_injected_retry(self):
+        """A real write error is NOT retried in place (it could
+        duplicate rows mid-stream); only injected faults are."""
+        def bad_write():
+            raise OSError("disk full")
+
+        with pytest.raises(OSError):
+            transient_retry(self.conf(), "io.write", bad_write)
+
+
+# ---------------------------------------------------------------------------
+# io.read through a real scan.
+# ---------------------------------------------------------------------------
+
+class TestIoRead:
+    def test_fault_recovers_query(self, faults_session, tmp_path):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame())
+        clean = _agg_rows(s, path)
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule", "io.read:1")
+        before = QueryStats.get().snapshot()
+        assert _agg_rows(s, path) == clean
+        d = QueryStats.delta_since(before)
+        assert d["faults_injected"] >= 1
+        assert d["transient_retries"] >= 1
+        get_catalog().assert_no_leaks()
+
+    def test_fault_without_recovery_is_typed(self, faults_session,
+                                             tmp_path):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame())
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "io.read:1:999")
+        s.conf.set("spark.rapids.tpu.faults.recovery.enabled", False)
+        with pytest.raises(QueryFaulted) as ei:
+            _agg_rows(s, path)
+        assert ei.value.point == "io.read"
+        assert ei.value.history  # fault history rides the exception
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# device.op: bounded re-dispatch, then CPU degradation.
+# ---------------------------------------------------------------------------
+
+class TestDeviceOp:
+    def test_fault_retries_then_succeeds(self, faults_session, tmp_path):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame())
+        clean = _agg_rows(s, path)
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "device.op:1")
+        before = QueryStats.get().snapshot()
+        assert _agg_rows(s, path) == clean
+        d = QueryStats.delta_since(before)
+        assert d["transient_retries"] >= 1
+        assert d["degraded_batches"] == 0
+
+    def test_repeated_fault_degrades_to_cpu(self, faults_session,
+                                            tmp_path):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=1500))
+        clean = _agg_rows(s, path)
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "device.op:1:9")
+        s.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+        before = QueryStats.get().snapshot()
+        assert _agg_rows(s, path) == clean
+        d = QueryStats.delta_since(before)
+        assert d["degraded_batches"] >= 1
+        tr = s.last_trace()
+        assert tr is not None and tr.status == "degraded"
+        marks = [e[1] for e in tr.events]
+        assert "degraded:cpu" in marks
+        get_catalog().assert_no_leaks()
+
+    def test_degrade_disabled_faults_typed(self, faults_session, tmp_path):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=800))
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "device.op:1:99")
+        s.conf.set("spark.rapids.tpu.faults.degrade.enabled", False)
+        with pytest.raises(QueryFaulted) as ei:
+            _agg_rows(s, path)
+        assert ei.value.point == "device.op"
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# shuffle.fragment: recompute from the producing stage's durable output.
+# ---------------------------------------------------------------------------
+
+class TestShuffleFragment:
+    def test_host_shuffle_unit_injection(self, tmp_path):
+        from spark_rapids_tpu.parallel.host_shuffle import HostShuffle
+        conf = TpuConf(FAST_BACKOFF)
+        sh = HostShuffle(2, str(tmp_path), num_threads=1)
+        try:
+            sh.write_partition(0, pa.table({"x": [1, 2, 3]}))
+            sh.write_partition(0, pa.table({"x": [4]}))
+            sh.finish_writes()
+            INJECTOR.arm(schedule="shuffle.fragment:1")
+            s0 = QueryStats.get().snapshot()
+            tables = transient_retry(
+                conf, "shuffle.fragment",
+                lambda: list(sh.read_partition(0)),
+                recover_counter="fragments_recomputed")
+            assert sum(t.num_rows for t in tables) == 4
+            d = QueryStats.delta_since(s0)
+            assert d["fragments_recomputed"] == 1
+            assert d["transient_retries"] == 1
+        finally:
+            INJECTOR.arm()
+            sh.close()
+
+    def test_exchange_fragment_recovers_query(self, faults_session, rng):
+        s = faults_session
+        pdf = _frame(n=2500, seed=5)
+        table = pa.Table.from_pandas(pdf, preserve_index=False)
+        s.conf.set("spark.rapids.tpu.shuffle.mode", "HOST")
+        df = s.create_dataframe(table)
+
+        def run():
+            return sorted(df.group_by("k").agg(
+                F.sum(F.col("a")).alias("s")).collect())
+
+        clean = run()
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "shuffle.fragment:1")
+        before = QueryStats.get().snapshot()
+        assert run() == clean
+        d = QueryStats.delta_since(before)
+        assert d["faults_injected"] >= 1
+        assert d["fragments_recomputed"] >= 1
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# cache.lookup: degrade to miss, never a poisoned entry.
+# ---------------------------------------------------------------------------
+
+class TestCacheLookup:
+    def test_fault_degrades_to_miss_then_hits(self, faults_session,
+                                              tmp_path):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame())
+        s.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+        clear_query_cache()
+        clean = _agg_rows(s, path)  # populates the cache
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "cache.lookup:1")
+        before = QueryStats.get().snapshot()
+        assert _agg_rows(s, path) == clean  # faulted lookup -> recompute
+        d = QueryStats.delta_since(before)
+        assert d["cache_misses"] >= 1
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        before = QueryStats.get().snapshot()
+        assert _agg_rows(s, path) == clean
+        assert QueryStats.delta_since(before)["cache_hits"] >= 1
+        clear_query_cache()
+        get_catalog().assert_no_leaks()
+
+    def test_faulted_fill_leaves_no_poisoned_entry(self, faults_session,
+                                                   tmp_path):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=900, seed=2))
+        s.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+        clear_query_cache()
+        # invocation 1 = the lookup (miss, clean); invocation 2 = the
+        # first fill registration -> the fill is abandoned, not poisoned
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "cache.lookup:2")
+        clean = _agg_rows(s, path)
+        cache = get_query_cache()
+        assert cache.entry_count() == 0  # abandoned fill indexed nothing
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        assert _agg_rows(s, path) == clean  # clean populate
+        assert cache.entry_count() >= 1
+        assert _agg_rows(s, path) == clean  # served from cache
+        clear_query_cache()
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# io.write: atomic temp+rename; injected faults retry, aborts clean up.
+# ---------------------------------------------------------------------------
+
+class TestIoWrite:
+    def test_injected_fault_retries_write(self, faults_session, tmp_path):
+        s = faults_session
+        src = _write_pq(tmp_path, "src.parquet", _frame(n=600, seed=3))
+        out = str(tmp_path / "out")
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule", "io.write:1")
+        before = QueryStats.get().snapshot()
+        stats = s.read_parquet(src).write.mode("overwrite").parquet(out)
+        assert QueryStats.delta_since(before)["transient_retries"] >= 1
+        assert stats.num_rows == 600
+        files = os.listdir(out)
+        assert files and not [f for f in files if "inprogress" in f]
+        back = pq.read_table(out).to_pandas().sort_values("a")
+        assert len(back) == 600
+
+    def test_abort_leaves_no_partial_file(self, faults_session, tmp_path):
+        s = faults_session
+        src = _write_pq(tmp_path, "src.parquet", _frame(n=600, seed=4))
+        out = str(tmp_path / "out_fail")
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "io.write:1:999")
+        s.conf.set("spark.rapids.tpu.faults.recovery.enabled", False)
+        with pytest.raises(QueryFaulted) as ei:
+            s.read_parquet(src).write.mode("overwrite").parquet(out)
+        assert ei.value.point == "io.write"
+        # an injected mid-write fault never leaves a partial file
+        # visible: the temp was deleted, nothing was renamed into place
+        leftovers = [f for f in os.listdir(out)] if os.path.exists(out) \
+            else []
+        assert not [f for f in leftovers if f.endswith(".parquet")]
+        assert not [f for f in leftovers if "inprogress" in f]
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# dcn.heartbeat: connect + heartbeat retries via the framework.
+# ---------------------------------------------------------------------------
+
+class TestDcnHeartbeat:
+    def test_connect_retries_injected_fault(self):
+        from spark_rapids_tpu.parallel.dcn import Coordinator, ProcessGroup
+        for k, v in FAST_BACKOFF.items():
+            TpuConf.set_session(k, v)
+        coord = Coordinator(1)
+        try:
+            INJECTOR.arm(schedule="dcn.heartbeat:1")
+            s0 = QueryStats.get().snapshot()
+            pg = ProcessGroup(0, 1, ("127.0.0.1", coord.port),
+                              coordinator=coord)
+            assert QueryStats.delta_since(s0)["transient_retries"] >= 1
+            pg.close()
+        finally:
+            INJECTOR.arm()
+            coord.close()
+            for k in FAST_BACKOFF:
+                TpuConf.unset_session(k)
+
+    def test_connect_faults_typed_without_recovery(self):
+        from spark_rapids_tpu.parallel.dcn import Coordinator, ProcessGroup
+        TpuConf.set_session("spark.rapids.tpu.faults.recovery.enabled",
+                            False)
+        coord = Coordinator(1)
+        try:
+            INJECTOR.arm(schedule="dcn.heartbeat:1:999")
+            with pytest.raises(QueryFaulted) as ei:
+                ProcessGroup(0, 1, ("127.0.0.1", coord.port),
+                             coordinator=coord)
+            assert ei.value.point == "dcn.heartbeat"
+        finally:
+            INJECTOR.arm()
+            coord.close()
+            TpuConf.unset_session("spark.rapids.tpu.faults.recovery.enabled")
+        get_catalog().assert_no_leaks()
+
+    def test_peer_failed_error_is_transient(self):
+        from spark_rapids_tpu.parallel.dcn import PeerFailedError
+        assert issubclass(PeerFailedError, TransientFault)
+
+
+# ---------------------------------------------------------------------------
+# Leak hygiene: one persistent fault at every in-query injection point,
+# recovery disabled -> typed QueryFaulted, permits released, no leaked
+# handles, and a FINISHED trace carrying the 'faulted' status.
+# ---------------------------------------------------------------------------
+
+IN_QUERY_POINTS = [
+    ("io.read", {}),
+    ("device.op", {"spark.rapids.tpu.faults.degrade.enabled": False}),
+    ("shuffle.fragment", {"spark.rapids.tpu.shuffle.mode": "HOST"}),
+    ("cache.lookup", {"spark.rapids.tpu.sql.cache.enabled": True}),
+]
+
+
+class TestLeakHygiene:
+    @pytest.mark.parametrize("point,extra",
+                             IN_QUERY_POINTS, ids=[p for p, _ in
+                                                   IN_QUERY_POINTS])
+    def test_faulted_query_releases_everything(self, faults_session,
+                                               tmp_path, point, extra):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=1200, seed=8))
+        for k, v in extra.items():
+            s.conf.set(k, v)
+        clear_query_cache()
+        s.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+        s.conf.set("spark.rapids.tpu.faults.recovery.enabled", False)
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   f"{point}:1:9999")
+        sched = s.scheduler()
+        handle = s.submit(
+            lambda: _agg_rows(s, path), label=f"faulted-{point}")
+        with pytest.raises(QueryFaulted) as ei:
+            handle.result(timeout=120)
+        assert ei.value.point == point
+        assert handle.status == "faulted"
+        assert sched.running() == 0  # permit + slot released
+        tr = handle.trace()
+        assert tr is not None and tr.t_end is not None
+        assert tr.status == "faulted"  # the trace FINISHED, accurately
+        clear_query_cache()
+        get_catalog().assert_no_leaks()
+        # the released permit admits the next (clean) query
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        s.conf.unset("spark.rapids.tpu.faults.recovery.enabled")
+        assert len(_agg_rows(s, path)) > 0
+        for k in extra:
+            s.conf.unset(k)
+        clear_query_cache()
+        get_catalog().assert_no_leaks()
+
+    def test_faulted_write_releases_everything(self, faults_session,
+                                               tmp_path):
+        s = faults_session
+        src = _write_pq(tmp_path, "src.parquet", _frame(n=500, seed=9))
+        out = str(tmp_path / "w")
+        s.conf.set("spark.rapids.tpu.faults.recovery.enabled", False)
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "io.write:1:9999")
+        handle = s.submit(lambda: s.read_parquet(src).write
+                          .mode("overwrite").parquet(out),
+                          label="faulted-write")
+        with pytest.raises(QueryFaulted):
+            handle.result(timeout=120)
+        assert handle.status == "faulted"
+        assert s.scheduler().running() == 0
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# Chaos differential (the acceptance gate): >=1 fault at each of the six
+# injection points under a seeded schedule; results identical to the
+# fault-free run; zero leaked handles; accurate trace statuses.
+# ---------------------------------------------------------------------------
+
+class TestChaosDifferential:
+    def test_seeded_schedule_differential(self, faults_session, tmp_path):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=4000, seed=13))
+        out = str(tmp_path / "chaos_out")
+        s.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+        s.conf.set("spark.rapids.tpu.shuffle.mode", "HOST")
+        s.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+        clear_query_cache()
+
+        def run_all():
+            rows = _agg_rows(s, path)
+            res = s.read_parquet(path).filter(F.col("b") < 0.7)
+            res.write.mode("overwrite").parquet(out)
+            back = sorted(pq.read_table(out).to_pandas()["a"].tolist())
+            return rows, back
+
+        clean_rows, clean_back = run_all()
+        INJECTOR.reset_totals()
+        before = QueryStats.get().snapshot()
+        s.conf.set(
+            "spark.rapids.tpu.faults.inject.schedule",
+            "io.read:1,device.op:1,cache.lookup:1,"
+            "shuffle.fragment:1,io.write:1")
+        s.conf.set("spark.rapids.tpu.faults.inject.seed", 7)
+        faulted_rows, faulted_back = run_all()
+        # identical results under faults
+        assert faulted_rows == clean_rows
+        assert faulted_back == clean_back
+        # the dcn leg of the schedule: a mini process group riding the
+        # same injection point (no ExecContext re-arms here)
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        from spark_rapids_tpu.parallel.dcn import Coordinator, ProcessGroup
+        INJECTOR.arm(schedule="dcn.heartbeat:1")
+        coord = Coordinator(1)
+        try:
+            pg = ProcessGroup(0, 1, ("127.0.0.1", coord.port),
+                              coordinator=coord)
+            pg.barrier()
+            pg.close()
+        finally:
+            INJECTOR.arm()
+            coord.close()
+        # >=1 injected fault at EVERY registered point
+        totals = INJECTOR.snapshot()["injected_total"]
+        for p in POINTS:
+            assert totals[p] >= 1, f"point {p} never fired: {totals}"
+        d = QueryStats.delta_since(before)
+        assert d["transient_retries"] >= 4
+        assert d["retry_backoff_s"] > 0
+        # every trace finished with an accurate status
+        tr = s.last_trace()
+        assert tr is not None and tr.status in ("ok", "degraded")
+        # zero spill-handle leaks once the (legitimately long-lived)
+        # cache entries are dropped
+        clear_query_cache()
+        get_catalog().assert_no_leaks()
+        sched = getattr(s, "_scheduler", None)
+        if sched is not None:
+            assert sched.running() == 0
+
+    def test_seeded_rate_chaos(self, faults_session, tmp_path):
+        """Probabilistic chaos (the SRT_BENCH_FAULT_RATE shape): a
+        seeded rate over every point still yields the fault-free
+        answer."""
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=2500, seed=21))
+        s.conf.set("spark.rapids.tpu.shuffle.mode", "HOST")
+        clean = _agg_rows(s, path)
+        s.conf.set("spark.rapids.tpu.faults.inject.rate", 0.15)
+        s.conf.set("spark.rapids.tpu.faults.inject.seed", 123)
+        before = QueryStats.get().snapshot()
+        for _ in range(3):
+            assert _agg_rows(s, path) == clean
+        assert QueryStats.delta_since(before)["faults_injected"] >= 1
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# Lint + conf registration satellites.
+# ---------------------------------------------------------------------------
+
+class TestSatellites:
+    def test_faults_confs_registered(self):
+        for key in ("spark.rapids.tpu.faults.backoff.baseMs",
+                    "spark.rapids.tpu.faults.backoff.maxMs",
+                    "spark.rapids.tpu.faults.backoff.multiplier",
+                    "spark.rapids.tpu.faults.retryBudget",
+                    "spark.rapids.tpu.faults.maxRetries",
+                    "spark.rapids.tpu.faults.recovery.enabled",
+                    "spark.rapids.tpu.faults.inject.schedule",
+                    "spark.rapids.tpu.faults.inject.rate"):
+            assert key in ALL_ENTRIES
+        assert "faults.backoff.baseMs" in TpuConf.help()
+
+    def test_check_fault_paths_lint(self, tmp_path):
+        from tools.check_fault_paths import check
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            "import time\n"
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        pass\n"
+            "def r():\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return g()\n"
+            "        except OSError:\n"
+            "            time.sleep(0.1)\n")
+        (pkg / "ok.py").write_text(
+            "import time\n"
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:  # fault-ok (best effort)\n"
+            "        pass\n"
+            "def r():\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return g()\n"
+            "        except OSError:\n"
+            "            time.sleep(0.1)  # fault-ok (bootstrap)\n")
+        violations = check(str(pkg))
+        files = sorted({rel for rel, _, _ in violations})
+        assert files == ["bad.py"]
+        kinds = sorted(line.rsplit("[", 1)[1] for _, _, line in violations)
+        assert kinds == ["ad-hoc retry loop]", "swallowed fault]"]
+
+    def test_engine_tree_is_lint_clean(self):
+        from tools.check_fault_paths import check
+        assert check() == []
+
+    def test_query_faulted_exported_from_service(self):
+        from spark_rapids_tpu.service import QueryFaulted as QF
+        assert QF is QueryFaulted

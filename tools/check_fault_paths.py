@@ -1,0 +1,105 @@
+"""Lint: fault handling must be visible and routed through the framework.
+
+Two rules over ``spark_rapids_tpu/``:
+
+  1. **No silently swallowed faults** — a bare ``except Exception:`` /
+     ``except BaseException:`` whose body is ``pass`` hides the exact
+     transient failures the recovery layer exists to retry, classify,
+     and account.  Legitimate best-effort sites (waker callbacks,
+     metrics hints) carry ``# fault-ok (<reason>)`` on the except line.
+
+  2. **No ad-hoc transient retry loops** — a ``time.sleep(...)`` within
+     a few lines after an ``except`` catching transient error types
+     (OSError / ConnectionError / TimeoutError / Exception) is a
+     hand-rolled retry loop: it bypasses the exponential backoff,
+     jitter, per-query retry budget, and QueryStats/trace accounting in
+     ``faults/recovery.transient_retry``.  Files under ``faults/`` ARE
+     the framework and are exempt; anything else needs ``# fault-ok``
+     on the sleep line.
+
+Run standalone (``python tools/check_fault_paths.py``, exit 1 on
+violations) or let the suite run it: tests/conftest.py invokes
+:func:`check` at collection time.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_rapids_tpu")
+
+_BARE_EXCEPT = re.compile(r"^\s*except\s+(Exception|BaseException)\s*:")
+_SLEEP = re.compile(r"\btime\.sleep\s*\(")
+_TRANSIENT_EXCEPT = re.compile(
+    r"^\s*except\b.*\b(OSError|ConnectionError|TimeoutError|"
+    r"InterruptedError|Exception)\b")
+_EXEMPT = "# fault-ok"
+# how many lines after an except a sleep still reads as its retry path
+_RETRY_WINDOW = 8
+
+
+def _is_pass_body(lines: List[str], idx: int) -> bool:
+    """Does the suite opened at ``lines[idx]`` begin with ``pass``?"""
+    for nxt in lines[idx + 1:idx + 3]:
+        stripped = nxt.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        return stripped == "pass" or stripped.startswith("pass ") \
+            or stripped.startswith("pass#")
+    return False
+
+
+def check(root: str = PKG) -> List[Tuple[str, int, str]]:
+    """Return [(relpath, lineno, line)] violations in the package."""
+    violations: List[Tuple[str, int, str]] = []
+    for dirpath, _dirs, files in os.walk(root):
+        in_framework = os.path.basename(dirpath) == "faults" or \
+            os.sep + "faults" + os.sep in dirpath + os.sep
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+            last_transient_except = -10**9
+            for lineno, line in enumerate(lines, 1):
+                if _EXEMPT in line:
+                    continue
+                if _BARE_EXCEPT.search(line) \
+                        and _is_pass_body(lines, lineno - 1) \
+                        and not any(_EXEMPT in nxt for nxt in
+                                    lines[lineno:lineno + 2]):
+                    violations.append(
+                        (os.path.relpath(path, root), lineno,
+                         line.strip() + "  [swallowed fault]"))
+                if _TRANSIENT_EXCEPT.search(line):
+                    last_transient_except = lineno
+                if not in_framework and _SLEEP.search(line) \
+                        and lineno - last_transient_except <= _RETRY_WINDOW:
+                    violations.append(
+                        (os.path.relpath(path, root), lineno,
+                         line.strip() + "  [ad-hoc retry loop]"))
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if not violations:
+        print("check_fault_paths: fault handling clean")
+        return 0
+    print("check_fault_paths: swallowed faults / ad-hoc transient retry "
+          "loops outside faults/:", file=sys.stderr)
+    for rel, lineno, line in violations:
+        print(f"  spark_rapids_tpu/{rel}:{lineno}: {line}", file=sys.stderr)
+    print("route retries through faults.recovery.transient_retry (backoff"
+          " + budget + accounting) or mark the line '# fault-ok (<why>)'.",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

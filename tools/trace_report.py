@@ -195,6 +195,14 @@ def analyze(data: dict) -> dict:
     def _cname(n):
         return sum(1 for e in cache_events if e.get("name") == n)
 
+    # fault-framework events (cat "fault": fault:injected /
+    # retry:attempt / degraded:cpu marks); the QueryStats snapshot on
+    # the root event is authoritative when present
+    fault_events = [e for e in xs if e.get("cat") == "fault"]
+
+    def _fname(n):
+        return sum(1 for e in fault_events if e.get("name") == n)
+
     fetch_events = [e for e in xs if e.get("cat") == "fetch"]
     blocking = [e for e in fetch_events
                 if e.get("args", {}).get("blocking")]
@@ -234,6 +242,14 @@ def analyze(data: dict) -> dict:
         "cache_bytes_saved": int(qargs.get("cache_hit_bytes", sum(
             e.get("args", {}).get("bytes", 0) for e in cache_events
             if e.get("name") == "cache:hit"))),
+        "faults_injected": int(qargs.get("faults_injected",
+                                         _fname("fault:injected"))),
+        "transient_retries": int(qargs.get("transient_retries",
+                                           _fname("retry:attempt"))),
+        "fragments_recomputed": int(qargs.get("fragments_recomputed", 0)),
+        "degraded_batches": int(qargs.get("degraded_batches",
+                                          _fname("degraded:cpu"))),
+        "retry_backoff_s": float(qargs.get("retry_backoff_s", 0.0)),
     }
 
 
@@ -275,6 +291,17 @@ def format_report(a: dict) -> str:
             f"cache: hits={a['cache_hits']} misses={a['cache_misses']} "
             f"evictions={a['cache_evictions']} hit_ratio={ratio:.2f} "
             f"saved={a['cache_bytes_saved'] / 1e6:.1f}MB")
+    # fault summary only when the query saw the fault framework act
+    touched = (a.get("faults_injected", 0) + a.get("transient_retries", 0)
+               + a.get("fragments_recomputed", 0)
+               + a.get("degraded_batches", 0))
+    if touched:
+        lines.append(
+            f"faults: injected={a['faults_injected']} "
+            f"retries={a['transient_retries']} "
+            f"recomputed={a['fragments_recomputed']} "
+            f"degraded={a['degraded_batches']} "
+            f"backoff={a['retry_backoff_s'] * 1e3:.1f}ms")
     return "\n".join(lines)
 
 
