@@ -39,7 +39,14 @@ point fails with probability R, seeded so runs replay — and report the
 under-fault throughput/latency NEXT TO the clean numbers plus the
 transient_retries / fragments_recomputed / degraded_batches /
 retry_backoff_s recovery columns; results are still verified against
-the oracle, so the line also proves recovery preserves answers).
+the oracle, so the line also proves recovery preserves answers),
+SRT_BENCH_KILL_PEER=1 (killed-peer drill: a world=2 DcnShuffle over
+thread ranks commits on both sides, then rank 1 dies SILENTLY
+mid-reduce — the drill prints a dcn_killed_peer_recovery JSON line with
+kill_recovery_s (heartbeat detection + durable remote re-pulls + orphan
+adoption, end to end), peers_lost / fragments_recomputed_remote /
+partitions_reowned, and rows_recovered_complete, ahead of the suite
+numbers; SRT_BENCH_KILL_PEER_HB tunes the detection horizon).
 
 The aggregate JSON line is re-printed after EVERY query (flush=True), so
 a driver that kills the run on a timeout still finds the latest complete
@@ -392,10 +399,95 @@ def _run_concurrent(sf: float, conc: int, which) -> None:
     }), flush=True)
 
 
+def _killed_peer_drill() -> dict:
+    """SRT_BENCH_KILL_PEER=1: a compact killed-peer recovery drill over
+    thread ranks (world=2 DcnShuffle, both sides commit, rank 1 dies
+    SILENTLY mid-reduce).  Reports the wall clock from kill to a fully
+    recovered read — detection (heartbeat timeout) + durable remote
+    re-pulls + orphan adoption — next to the recovery counters, so the
+    bench line makes 'bounded recovery time' a printed number."""
+    import tempfile
+    import threading
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.parallel.dcn import (Coordinator, DcnShuffle,
+                                               ProcessGroup)
+    from spark_rapids_tpu.utils.metrics import QueryStats
+    hb_timeout = float(os.environ.get("SRT_BENCH_KILL_PEER_HB", "1.0"))
+    TpuConf.set_session("spark.rapids.tpu.dcn.heartbeatTimeout",
+                        hb_timeout)
+    world, n_parts = 2, 8
+    tmp = tempfile.mkdtemp(prefix="srt_kill_drill_")
+    coord = Coordinator(world, heartbeat_timeout=hb_timeout,
+                        wait_timeout=60.0)
+    pgs = [None] * world
+    try:
+        def mk(r):
+            pgs[r] = ProcessGroup(
+                r, world, ("127.0.0.1", coord.port),
+                coordinator=coord if r == 0 else None,
+                heartbeat_interval=0.1)
+
+        ts = [threading.Thread(target=mk, args=(r,)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        shuffles = [DcnShuffle(pg, n_parts, os.path.join(tmp, f"r{pg.rank}"))
+                    for pg in pgs]
+        for rank, sh in enumerate(shuffles):
+            for p in range(n_parts):
+                sh.write_partition(p, pa.table(
+                    {"r": [rank] * 64, "p": [p] * 64,
+                     "v": list(range(64))}))
+        ts = [threading.Thread(target=sh.commit) for sh in shuffles]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        before = QueryStats.get().snapshot()
+        t0 = time.monotonic()
+        # rank 1 dies silently mid-shuffle: detection is heartbeat-only
+        pgs[1]._closed = True
+        pgs[1]._server.freeze()
+        rows = 0
+        for p in shuffles[0].my_parts():
+            rows += sum(t_.num_rows for t_ in shuffles[0].read_partition(p))
+        for p in shuffles[0].adopt_orphans():
+            rows += sum(t_.num_rows for t_ in shuffles[0].read_partition(p))
+        recovery_s = time.monotonic() - t0
+        d = QueryStats.delta_since(before)
+        complete = rows == world * n_parts * 64
+        shuffles[0].close()
+        return {
+            "metric": "dcn_killed_peer_recovery",
+            "kill_mode": "silent",
+            "heartbeat_timeout_s": hb_timeout,
+            "kill_recovery_s": round(recovery_s, 4),
+            "rows_recovered_complete": complete,
+            "peers_lost": d.get("peers_lost", 0),
+            "fragments_recomputed_remote":
+                d.get("fragments_recomputed_remote", 0),
+            "partitions_reowned": d.get("partitions_reowned", 0),
+            "transient_retries": d.get("transient_retries", 0),
+        }
+    finally:
+        for pg in pgs:
+            if pg is not None:
+                pg.close()
+        TpuConf.unset_session("spark.rapids.tpu.dcn.heartbeatTimeout")
+
+
 def main() -> None:
     sf = float(os.environ.get("SRT_BENCH_SF", "1.0"))
     iters = int(os.environ.get("SRT_BENCH_ITERS", "3"))
     conc = int(os.environ.get("SRT_BENCH_CONCURRENCY", "0") or 0)
+    if os.environ.get("SRT_BENCH_KILL_PEER", "0") == "1":
+        # killed-peer recovery columns ride their own JSON line ahead of
+        # the suite numbers (and are NOT re-run by per-query subprocesses)
+        print(json.dumps(_killed_peer_drill()), flush=True)
     if conc > 1:
         # concurrency mode defaults to the TPC-H suite (the service
         # replay the scheduler was built for); SRT_BENCH_QUERIES narrows
@@ -451,6 +543,7 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         q_budget = max(15, min(budget, int(remaining)))
         env = dict(os.environ)
         env["SRT_BENCH_QUERIES"] = q
+        env.pop("SRT_BENCH_KILL_PEER", None)  # drill ran once, up top
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,

@@ -605,9 +605,12 @@ FAULTS_INJECT_SCHEDULE = register(
     "Deterministic fault-injection schedule: comma list of "
     "'point:N[:K]' entries — fail invocations N..N+K-1 (1-based) at "
     "the named point (io.read, io.write, shuffle.fragment, "
-    "dcn.heartbeat, device.op, cache.lookup). Counters reset per "
-    "query. Empty disables. The chaos differential suite proves "
-    "results under a schedule equal the fault-free run.")
+    "dcn.heartbeat, device.op, cache.lookup, dcn.peer_kill). Counters "
+    "reset per query. Empty disables. The chaos differential suite "
+    "proves results under a schedule equal the fault-free run; "
+    "dcn.peer_kill:N kills THIS rank at its Nth shuffle op "
+    "(dcn.kill.mode selects silent heartbeat stop vs hard exit), "
+    "driving the killed-peer differential.")
 
 FAULTS_INJECT_RATE = register(
     "spark.rapids.tpu.faults.inject.rate", 0.0,
@@ -628,6 +631,40 @@ FAULTS_INJECT_SEED = register(
     "spark.rapids.tpu.faults.inject.seed", 0,
     "Seed for the injection RNG (probabilistic rate draws AND the "
     "retry backoff jitter), making chaos runs reproducible.")
+
+FAULTS_RESUBMIT_MAX = register(
+    "spark.rapids.tpu.faults.resubmit.max", 1,
+    "Times the scheduler automatically RESUBMITS a query that failed "
+    "permanent-at-this-placement (QueryFaulted with resubmittable=True "
+    "— a DCN peer the coordinator declared dead, a lost coordinator). "
+    "The faulted attempt's trace finishes with a 'resubmitted' status "
+    "linked to the retry; the retry re-enters the admission queue and "
+    "runs against the surviving membership. 0 disables resubmission "
+    "(the typed QueryFaulted surfaces to the caller on the first "
+    "permanent failure).",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+DCN_EPOCH_FENCING = register(
+    "spark.rapids.tpu.dcn.epoch.fencing", True,
+    "Fence DCN control frames and peer fetches with the cluster epoch: "
+    "the coordinator bumps the epoch whenever it declares a rank dead "
+    "or admits a restarted rank under a fresh incarnation, and rejects "
+    "stale-epoch/stale-incarnation messages so a zombie rank cannot "
+    "resurrect with stale shuffle state (parallel/dcn.py). Live ranks "
+    "resync transparently from the rejection reply; disabling restores "
+    "the pre-epoch wire behavior (debugging escape hatch).")
+
+DCN_KILL_MODE = register(
+    "spark.rapids.tpu.dcn.kill.mode", "silent",
+    "How the dcn.peer_kill injection point kills this rank (chaos "
+    "testing only): 'silent' stops heartbeating and FREEZES the peer "
+    "server (sockets stay open, requests are never answered) so death "
+    "is only visible through failure detection — the worst case; "
+    "'hard' exits the process immediately (os._exit), the "
+    "crashed-executor shape. Meaningful only with a dcn.peer_kill "
+    "entry armed in faults.inject.schedule.",
+    check=lambda v: None if v in ("silent", "hard")
+    else "must be 'silent' or 'hard'")
 
 
 class TpuConf:

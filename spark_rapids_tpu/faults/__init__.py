@@ -25,13 +25,13 @@ ad-hoc sleeps and swallowed exceptions cannot silently reappear.
 """
 
 from .injector import INJECTOR, FaultInjector, InjectedFault, POINTS
-from .recovery import (FaultRecord, QueryFaulted, TransientFault,
-                       backoff_delays, budget_scope, device_guard,
-                       recovery_enabled, transient_retry)
+from .recovery import (FaultRecord, PermanentFault, QueryFaulted,
+                       TransientFault, backoff_delays, budget_scope,
+                       device_guard, recovery_enabled, transient_retry)
 
 __all__ = [
     "INJECTOR", "FaultInjector", "InjectedFault", "POINTS",
-    "TransientFault", "QueryFaulted", "FaultRecord",
+    "TransientFault", "PermanentFault", "QueryFaulted", "FaultRecord",
     "transient_retry", "device_guard", "budget_scope",
     "backoff_delays", "recovery_enabled",
 ]

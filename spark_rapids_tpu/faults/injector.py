@@ -40,8 +40,13 @@ __all__ = ["POINTS", "InjectedFault", "FaultInjector", "INJECTOR"]
 # The registry of injection points.  Adding a point means adding the
 # matching recovery path and a docs/robustness.md row — the leak suite
 # parametrizes over this tuple, so an unrecovered point fails tests.
+# ``dcn.peer_kill`` is special: it does not stand in for a recoverable
+# fault but for PEER DEATH — the DCN layer catches the injected fault
+# and kills the rank (silent heartbeat stop, or a hard process kill
+# under spark.rapids.tpu.dcn.kill.mode=hard), driving the killed-peer
+# chaos differential deterministically ("kill rank R after N ops").
 POINTS = ("io.read", "io.write", "shuffle.fragment", "dcn.heartbeat",
-          "device.op", "cache.lookup")
+          "device.op", "cache.lookup", "dcn.peer_kill")
 
 
 class InjectedFault(TransientFault):

@@ -39,14 +39,34 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
-__all__ = ["TransientFault", "QueryFaulted", "FaultRecord",
-           "transient_retry", "device_guard", "budget_scope",
-           "backoff_delays", "recovery_enabled", "RETRYABLE"]
+__all__ = ["TransientFault", "PermanentFault", "QueryFaulted",
+           "FaultRecord", "transient_retry", "device_guard",
+           "budget_scope", "backoff_delays", "recovery_enabled",
+           "RETRYABLE"]
 
 
 class TransientFault(RuntimeError):
     """A recoverable data-movement failure (base of injected faults;
     ``parallel.dcn.PeerFailedError`` subclasses it too)."""
+
+    def __init__(self, message: str, point: Optional[str] = None):
+        super().__init__(message)
+        self.point = point
+
+
+class PermanentFault(RuntimeError):
+    """A failure that will not heal at this placement: a peer the
+    coordinator has *declared dead*, or a coordinator whose socket
+    closed.  :func:`transient_retry` fast-fails on these — raising
+    :class:`QueryFaulted` with ``resubmittable=True`` immediately
+    instead of riding the exponential-backoff budget against a rank
+    that will never come back.  The scheduler may then RESUBMIT the
+    whole query against the surviving membership
+    (``spark.rapids.tpu.faults.resubmit.max``).
+
+    May be mixed into a :class:`TransientFault` subclass (see
+    ``parallel.dcn.PeerLostError``): the permanent classification wins.
+    """
 
     def __init__(self, message: str, point: Optional[str] = None):
         super().__init__(message)
@@ -66,13 +86,21 @@ class FaultRecord:
 
 class QueryFaulted(RuntimeError):
     """Transient-fault recovery exhausted (or disabled): the query fails
-    typed, carrying the full per-query fault history for diagnosis."""
+    typed, carrying the full per-query fault history for diagnosis.
+
+    ``resubmittable=True`` marks a *permanent-at-this-placement*
+    failure (:class:`PermanentFault` — e.g. a declared-dead DCN peer):
+    re-running the SAME query against the surviving membership can
+    succeed, so the scheduler may resubmit it
+    (``spark.rapids.tpu.faults.resubmit.max``)."""
 
     def __init__(self, point: str, message: str,
-                 history: Optional[List[FaultRecord]] = None):
+                 history: Optional[List[FaultRecord]] = None,
+                 resubmittable: bool = False):
         super().__init__(message)
         self.point = point
         self.history = list(history or [])
+        self.resubmittable = resubmittable
 
 
 # Per-point transient classification.  FileNotFoundError is deliberately
@@ -208,14 +236,17 @@ def _note_fault(point: str, attempt: int, ex: BaseException,
     return rec
 
 
-def _faulted(point: str, ex: BaseException, attempt: int) -> QueryFaulted:
+def _faulted(point: str, ex: BaseException, attempt: int,
+             resubmittable: bool = False) -> QueryFaulted:
     history = fault_history()
+    what = ("permanent at this placement"
+            if resubmittable else "transient-fault recovery exhausted")
     return QueryFaulted(
         point,
-        f"transient-fault recovery exhausted at {point} after "
+        f"{what} at {point} after "
         f"{attempt} attempt(s): {type(ex).__name__}: {ex} "
         f"({len(history)} fault(s) this query)",
-        history=history)
+        history=history, resubmittable=resubmittable)
 
 
 def transient_retry(ctx, point: str, fn: Callable, *args,
@@ -253,8 +284,20 @@ def transient_retry(ctx, point: str, fn: Callable, *args,
                 s = QueryStats.get()
                 setattr(s, recover_counter,
                         getattr(s, recover_counter, 0) + 1)
+                tracing.mark(None, "recovered", "fault", point=point,
+                             attempts=attempt + 1, counter=recover_counter,
+                             desc=desc)
             return out
-        except classes as ex:
+        except (PermanentFault,) + tuple(classes) as ex:
+            if isinstance(ex, PermanentFault):
+                # permanent at this placement (declared-dead peer, lost
+                # coordinator): backing off cannot help — fail typed NOW
+                # without drawing down the retry budget, flagged so the
+                # scheduler may resubmit against surviving membership
+                attempt += 1
+                _note_fault(point, attempt, ex)
+                raise _faulted(point, ex, attempt,
+                               resubmittable=True) from ex
             if isinstance(ex, _NON_RETRYABLE) \
                     and not isinstance(ex, TransientFault):
                 raise

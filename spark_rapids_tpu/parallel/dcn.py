@@ -26,6 +26,19 @@ Cross-rank hashing: partition ids are computed on the HOST with Spark-exact
 murmur3 over real values (native.murmur3_*) — NOT the device dictionary-code
 hash, whose codes are only comparable within one process (ops/strings.py).
 Host pids for numeric types match the device fold bit-for-bit (tested).
+
+Failure survival (docs/robustness.md "Distributed failures"): membership
+is EPOCH-FENCED — the Coordinator bumps a cluster epoch whenever it
+declares a rank dead or admits a restarted rank under a fresh
+incarnation, collectives complete over the alive membership, and stale
+epoch/incarnation frames are rejected so a zombie cannot resurrect with
+stale shuffle state.  A committed rank's death during the reduce is a
+data-movement event, not a query failure: its fragments re-pull from the
+durable map output it published at commit, and its owned partitions are
+re-owned across the shrunk group (DcnShuffle.adopt_orphans).  Deaths the
+data plane cannot heal (pre-commit, broadcast build shards, lost
+coordinator) fast-fail typed as PermanentFaults, which the scheduler may
+resubmit against the surviving membership.
 """
 
 from __future__ import annotations
@@ -41,12 +54,12 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from ..faults.recovery import TransientFault, backoff_delays, \
-    transient_retry
+from ..faults.recovery import PermanentFault, TransientFault, \
+    backoff_delays, transient_retry
 
 __all__ = ["Coordinator", "ProcessGroup", "DcnShuffle", "PeerFailedError",
-           "host_partition_ids", "run_distributed_agg",
-           "run_distributed_query"]
+           "PeerLostError", "CoordinatorLostError", "host_partition_ids",
+           "run_distributed_agg", "run_distributed_query"]
 
 _LEN = struct.Struct("<II")  # json length, binary payload length
 _CHUNK = 1 << 20
@@ -56,6 +69,26 @@ class PeerFailedError(TransientFault):
     """A peer stopped heartbeating or dropped mid-transfer.  A
     :class:`..faults.recovery.TransientFault`: fragment fetches that hit
     it re-pull with backoff before the query fails typed."""
+
+
+class PeerLostError(PermanentFault, PeerFailedError):
+    """A peer the coordinator has DECLARED dead (or this rank fenced
+    out of the group).  Still a :class:`PeerFailedError` for callers
+    that diagnose peer failure generically, but a
+    :class:`..faults.recovery.PermanentFault` first: ``transient_retry``
+    fast-fails instead of riding the backoff budget against a rank that
+    will never come back, and the resulting ``QueryFaulted`` carries
+    ``resubmittable=True`` so the scheduler may resubmit the query
+    against the surviving membership."""
+
+
+class CoordinatorLostError(PermanentFault):
+    """The coordinator's socket closed or its process died.  Detected
+    promptly (a closed socket fails the in-flight request) instead of
+    hanging until ``dcn.waitTimeout``.  There is no coordinator
+    failover — full coordinator HA is out of scope (docs/robustness.md
+    documents the limitation); the scheduler's resubmission policy is
+    the recovery path once a new group is formed."""
 
 
 # ---------------------------------------------------------------------------------
@@ -89,11 +122,25 @@ def _recv(sock: socket.socket) -> Tuple[dict, bytes]:
 # ---------------------------------------------------------------------------------
 
 class Coordinator:
-    """Rendezvous + barrier + all-gather + heartbeat registry.
+    """Rendezvous + barrier + all-gather + heartbeat registry, with
+    EPOCH-FENCED membership.
 
     The driver-side RapidsShuffleHeartbeatManager analog: executors register
     on startup, discover all peers, and heartbeat so failures surface as
     data instead of hangs.
+
+    Membership protocol: the coordinator DECLARES a rank dead when its
+    heartbeats stop for ``heartbeatTimeout`` seconds, bumping the
+    cluster **epoch**.  A declared rank stays dead (resuming heartbeats
+    does not resurrect it) until it re-registers, which assigns it a
+    fresh **incarnation** and bumps the epoch again — so a restarted
+    rank rejoins under a fresh identity and frames from its previous
+    life are rejected as stale.  Collectives complete with the ALIVE
+    membership (a dead peer shrinks the group instead of hanging the
+    world until ``waitTimeout``), and every reply carries the epoch +
+    declared-dead list so survivors converge on one membership view;
+    barrier/allgather replies use a per-tag snapshot taken when the
+    collective completes, so all participants see the SAME view.
     """
 
     def __init__(self, world_size: int, port: int = 0,
@@ -113,6 +160,7 @@ class Coordinator:
         # backoff parameters for the barrier/allgather re-check cadence
         # (spark.rapids.tpu.faults.backoff.*)
         self._conf = conf
+        self._fencing = conf["spark.rapids.tpu.dcn.epoch.fencing"]
         self.world_size = world_size
         self.heartbeat_timeout = heartbeat_timeout
         self.wait_timeout = wait_timeout
@@ -122,14 +170,31 @@ class Coordinator:
         self._barriers: Dict[str, set] = {}
         self._gathers: Dict[str, Dict[int, bytes]] = {}
         self._released: Dict[str, int] = {}
+        # epoch-fenced membership: cluster epoch, rank -> epoch at which
+        # it was declared dead, rank -> current incarnation, and per-tag
+        # membership snapshots fixed when a collective completes
+        self._epoch = 0
+        self._declared: Dict[int, int] = {}
+        self._inc: Dict[int, int] = {}
+        self._meta: Dict[str, dict] = {}
         self._closed = False
         self._srv = socket.create_server((bind_host, port))
         self.port = self._srv.getsockname()[1]
         self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
         t = threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime control plane, not per-query work)
                              name="srt-dcn-coordinator")
         t.start()
         self._threads.append(t)
+
+    @property
+    def epoch(self) -> int:
+        with self._cv:
+            return self._epoch
+
+    def declared_dead(self) -> List[int]:
+        with self._cv:
+            return sorted(self._declared)
 
     # -- server loops -------------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -138,6 +203,7 @@ class Coordinator:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            self._conns.append(conn)
             t = threading.Thread(target=self._serve, args=(conn,),  # ctx-ok (control-plane connection handler)
                                  daemon=True)
             t.start()
@@ -168,71 +234,168 @@ class Coordinator:
             if left <= 0:
                 raise PeerFailedError(
                     f"timed out waiting for all ranks at {what} "
-                    f"(dead: {self._dead_locked()})")
+                    f"(dead: {sorted(self._declared)})")
             self._cv.wait(timeout=min(left, max(0.01, next(delays))))
+            # declare deaths observed while parked, so preds counting
+            # ALIVE participants unblock when a peer dies mid-collective
+            self._declare_locked()
             if rank >= 0:
                 # a rank parked in a collective is alive by construction —
                 # keep refreshing so it can't be declared dead mid-wait
                 self._last_seen[rank] = time.monotonic()  # span-api-ok (timeout, not timing)
 
-    def _dead_locked(self) -> List[int]:
+    def _declare_locked(self) -> None:
+        """Declare ranks whose heartbeats stopped: each new death bumps
+        the cluster epoch.  A declared rank stays dead — resuming
+        heartbeats does not resurrect it; only re-registering (under a
+        fresh incarnation) does."""
         if len(self._peers) < self.world_size:
-            return []
+            return  # rendezvous grace: nobody is late before discovery
         now = time.monotonic()  # span-api-ok (timeout, not timing)
-        return sorted(r for r, ts in self._last_seen.items()
-                      if now - ts > self.heartbeat_timeout)
+        newly = [r for r, ts in self._last_seen.items()
+                 if now - ts > self.heartbeat_timeout
+                 and r not in self._declared]
+        for r in sorted(newly):
+            self._epoch += 1
+            self._declared[r] = self._epoch
+        if newly:
+            self._cv.notify_all()
+
+    def _alive_needed_locked(self) -> int:
+        return max(1, self.world_size - len(self._declared))
+
+    def _arrived_alive_locked(self, joined) -> int:
+        return len([r for r in joined if r not in self._declared])
+
+    def _meta_locked(self, tag: str) -> dict:
+        """The membership snapshot fixed when collective ``tag``
+        completed — every participant's reply carries the SAME view."""
+        m = self._meta.get(tag)
+        if m is None:
+            m = {"epoch": self._epoch, "dead": sorted(self._declared)}
+            self._meta[tag] = m
+        return m
+
+    def _fence_locked(self, op: str, rank: int,
+                      msg: dict) -> Optional[dict]:
+        """Reject frames from stale incarnations, declared-dead ranks,
+        and (for collectives) stale epochs.  Returns the rejection
+        reply, or None when the frame passes the fence."""
+        if not self._fencing or rank < 0:
+            return None
+        inc = int(msg.get("inc", 0))
+        if inc != self._inc.get(rank, 0):
+            return {"error": f"stale incarnation {inc} for rank {rank} "
+                             f"(current {self._inc.get(rank, 0)}): "
+                             f"re-register", "fenced": True,
+                    "epoch": self._epoch}
+        if rank in self._declared:
+            return {"error": f"rank {rank} was declared dead at epoch "
+                             f"{self._declared[rank]}; re-register "
+                             f"under a fresh incarnation",
+                    "fenced": True, "epoch": self._epoch}
+        if op in ("barrier", "allgather") \
+                and int(msg.get("epoch", 0)) < self._epoch:
+            # collective waits carry the epoch: a participant behind the
+            # current membership view must resync (the reply carries the
+            # fresh epoch + dead list) before joining
+            return {"error": f"stale epoch {msg.get('epoch', 0)} < "
+                             f"{self._epoch} at {op}",
+                    "stale_epoch": True, "epoch": self._epoch,
+                    "dead": sorted(self._declared)}
+        return None
 
     def _handle(self, msg: dict, blob: bytes) -> Tuple[dict, bytes]:
         op = msg["op"]
         rank = int(msg.get("rank", -1))
         with self._cv:
-            if rank >= 0:
-                self._last_seen[rank] = time.monotonic()  # span-api-ok (timeout, not timing)
+            self._declare_locked()
             if op == "register":
+                if rank in self._declared or rank in self._peers:
+                    # a restarted rank rejoins under a FRESH identity:
+                    # new incarnation + epoch bump, so frames from its
+                    # previous life are rejected as stale instead of
+                    # resurrecting with stale shuffle state
+                    self._inc[rank] = self._inc.get(rank, 0) + 1
+                    self._declared.pop(rank, None)
+                    self._epoch += 1
                 self._peers[rank] = (msg["host"], int(msg["port"]))
+                self._last_seen[rank] = time.monotonic()  # span-api-ok (timeout, not timing)
                 self._cv.notify_all()
                 self._wait_for(
                     lambda: len(self._peers) >= self.world_size, "register",
                     rank)
                 return {"peers": {str(r): list(hp)
-                                  for r, hp in self._peers.items()}}, b""
+                                  for r, hp in self._peers.items()},
+                        "inc": self._inc.get(rank, 0),
+                        "epoch": self._epoch,
+                        "dead": sorted(self._declared)}, b""
+            rejected = self._fence_locked(op, rank, msg)
+            if rejected is not None:
+                return rejected, b""
+            if rank >= 0:
+                self._last_seen[rank] = time.monotonic()  # span-api-ok (timeout, not timing)
             if op == "barrier":
                 tag = msg["tag"]
-                self._barriers.setdefault(tag, set()).add(rank)
+                joined = self._barriers.setdefault(tag, set())
+                joined.add(rank)
                 self._cv.notify_all()
                 self._wait_for(
-                    lambda: len(self._barriers[tag]) >= self.world_size,
+                    lambda: self._arrived_alive_locked(self._barriers[tag])
+                    >= self._alive_needed_locked(),
                     f"barrier {tag}", rank)
+                meta = self._meta_locked(tag)
                 self._release(tag, self._barriers)
-                return {"ok": True}, b""
+                return {"ok": True, **meta}, b""
             if op == "allgather":
                 tag = msg["tag"]
                 self._gathers.setdefault(tag, {})[rank] = blob
                 self._cv.notify_all()
                 self._wait_for(
-                    lambda: len(self._gathers[tag]) >= self.world_size,
+                    lambda: self._arrived_alive_locked(self._gathers[tag])
+                    >= self._alive_needed_locked(),
                     f"allgather {tag}", rank)
-                parts = [self._gathers[tag][r]
-                         for r in range(self.world_size)]
+                meta = self._meta_locked(tag)
+                ranks = sorted(self._gathers[tag])
+                parts = [self._gathers[tag][r] for r in ranks]
                 self._release(tag, self._gathers)
-                return {"lens": [len(p) for p in parts]}, b"".join(parts)
+                return {"lens": [len(p) for p in parts],
+                        "ranks": ranks, **meta}, b"".join(parts)
             if op == "heartbeat":
-                return {"dead": self._dead_locked()}, b""
+                return {"dead": sorted(self._declared),
+                        "epoch": self._epoch}, b""
+            if op == "members":
+                return {"dead": sorted(self._declared),
+                        "epoch": self._epoch,
+                        "peers": sorted(self._peers)}, b""
             raise ValueError(f"unknown coordinator op {op!r}")
 
     def _release(self, tag: str, store: dict) -> None:
-        """Drop a barrier/gather slot once every rank has been replied to."""
+        """Drop a barrier/gather slot once every ALIVE rank has been
+        replied to (a dead participant is never replied to)."""
         self._released[tag] = self._released.get(tag, 0) + 1
-        if self._released[tag] >= self.world_size:
+        if self._released[tag] >= self._alive_needed_locked():
             store.pop(tag, None)
             self._released.pop(tag, None)
+            self._meta.pop(tag, None)
 
     def close(self) -> None:
+        """Shut down: the listening socket AND every accepted control
+        connection close, so parked ranks detect coordinator death
+        PROMPTLY (a typed CoordinatorLostError on their in-flight
+        request) instead of hanging until waitTimeout."""
         self._closed = True
         try:
             self._srv.close()
         except OSError:
             pass
+        with self._cv:
+            self._cv.notify_all()
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------------
@@ -240,12 +403,24 @@ class Coordinator:
 # ---------------------------------------------------------------------------------
 
 class _PeerServer:
-    """RapidsShuffleServer analog: serves this process's map-side output."""
+    """RapidsShuffleServer analog: serves this process's map-side output.
+
+    Fetch frames carry the requester's cluster epoch; a requester behind
+    this rank's membership view (``self.epoch``, kept current by the
+    owning :class:`ProcessGroup`) is rejected with ``stale_epoch`` — a
+    zombie rank fenced out of the group cannot keep pulling shuffle
+    state.  ``freeze()`` simulates silent death: the socket stays open
+    but requests are never answered (detection only through heartbeat
+    timeout — the worst-case failure shape the chaos suite drives)."""
 
     def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
         self._registry: Dict[str, str] = {}  # shuffle id -> frame-file dir
         self._lock = threading.Lock()
         self._closed = False
+        self._frozen = False
+        self._held: List[socket.socket] = []  # frozen conns, kept open
+        self.epoch = 0
+        self.fencing = True
         self._srv = socket.create_server((bind_host, port))
         self.port = self._srv.getsockname()[1]
         threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime data-plane server)
@@ -259,24 +434,48 @@ class _PeerServer:
         with self._lock:
             self._registry.pop(shuffle_id, None)
 
+    def freeze(self) -> None:
+        """Silent-death simulation: stop answering (and keep the peers'
+        in-flight connections open so they time out instead of failing
+        fast) without closing the listening socket."""
+        with self._lock:
+            self._frozen = True
+
     def _accept_loop(self) -> None:
         while not self._closed:
             try:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            with self._lock:
+                if self._frozen:
+                    self._held.append(conn)  # accepted, never answered
+                    continue
             threading.Thread(target=self._serve, args=(conn,),  # ctx-ok (data-plane connection handler)
                              daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        keep_open = False
         try:
             while True:
                 msg, _ = _recv(conn)
+                with self._lock:
+                    if self._frozen:
+                        # silent death mid-request: never answer, hold
+                        # the socket open so the peer sees a timeout
+                        self._held.append(conn)
+                        keep_open = True
+                        return
+                    d = self._registry.get(msg.get("shuffle"))
                 if msg["op"] != "fetch":
                     _send(conn, {"error": f"unknown op {msg['op']!r}"})
                     continue
-                with self._lock:
-                    d = self._registry.get(msg["shuffle"])
+                if self.fencing \
+                        and int(msg.get("epoch", self.epoch)) < self.epoch:
+                    _send(conn, {"error":
+                                 f"stale epoch {msg.get('epoch')} < "
+                                 f"{self.epoch}", "stale_epoch": True})
+                    continue
                 if d is None:
                     _send(conn, {"error":
                                  f"unknown shuffle {msg['shuffle']!r}"})
@@ -290,7 +489,8 @@ class _PeerServer:
         except (ConnectionError, OSError):
             pass
         finally:
-            conn.close()
+            if not keep_open:
+                conn.close()
 
     def close(self) -> None:
         self._closed = True
@@ -321,14 +521,35 @@ class ProcessGroup:
                  advertise_host: Optional[str] = None,
                  heartbeat_interval: float = 2.0,
                  connect_timeout: float = 60.0):
+        from ..config import TpuConf
+        conf = TpuConf()
         self.rank = rank
         self.world_size = world_size
         self.coordinator = coordinator
+        self.coordinator_addr = coordinator_addr
         self._server = _PeerServer(bind_host=listen_host)
+        self._server.fencing = conf["spark.rapids.tpu.dcn.epoch.fencing"]
         self._tag_n = 0
         self._shuffle_n = 0
         self._dead: List[int] = []
         self._closed = False
+        # epoch-fenced membership state: the cluster epoch (monotonic,
+        # absorbed from every coordinator reply), this rank's
+        # incarnation (assigned at register; bumps on re-register),
+        # ranks whose data loss has been COVERED by a shuffle adoption
+        # (so later commits don't re-fail on an already-recovered
+        # death), and the epoch of the last adoption sync (the final
+        # result gather compares against it)
+        self.epoch = 0
+        self.inc = 0
+        self.covered_dead: set = set()
+        self.last_adopt_epoch = 0
+        self.coordinator_lost = False
+        self.fenced = False
+        # silent peers are detected through fetch timeouts bounded by
+        # the liveness horizon, not a fixed 60 s socket timeout
+        self._fetch_timeout = max(
+            2.0, float(conf["spark.rapids.tpu.dcn.heartbeatTimeout"]))
         self._ctrl_lock = threading.Lock()
         self._ctrl = self._connect(coordinator_addr, connect_timeout)
         # heartbeats ride their own connection: a rank parked in a long
@@ -336,11 +557,12 @@ class ProcessGroup:
         self._hb_sock = self._connect(coordinator_addr, connect_timeout)
         self._hb_lock = threading.Lock()
         msg, _ = self._request({
-            "op": "register", "rank": rank,
+            "op": "register",
             "host": advertise_host or listen_host,
             "port": self._server.port})
         if "error" in msg:
             raise PeerFailedError(f"register failed: {msg['error']}")
+        self.inc = int(msg.get("inc", 0))
         self.peers: Dict[int, Tuple[str, int]] = {
             int(r): (h, int(p)) for r, (h, p) in msg["peers"].items()}
         self._hb = threading.Thread(target=self._heartbeat_loop,  # ctx-ok (rank-lifetime liveness thread)
@@ -365,41 +587,118 @@ class ProcessGroup:
                                desc=f"connect {addr[0]}:{addr[1]}",
                                deadline_s=timeout)
 
-    def _request(self, obj: dict, blob: bytes = b"") -> Tuple[dict, bytes]:
-        with self._ctrl_lock:
-            _send(self._ctrl, obj, blob)
-            return _recv(self._ctrl)
+    def _absorb_membership(self, msg: dict) -> None:
+        """Fold a coordinator reply's membership view into this rank's:
+        the epoch is monotonic, and declared-dead ranks stay dead until
+        a re-register bumps the epoch past our view."""
+        e = int(msg.get("epoch", 0))
+        if e > self.epoch:
+            self.epoch = e
+            self._server.epoch = e
+        if "dead" in msg:
+            self._dead = sorted(set(self._dead)
+                                | {int(r) for r in msg["dead"]})
+
+    def _request(self, obj: dict, blob: bytes = b"",
+                 _retried: bool = False) -> Tuple[dict, bytes]:
+        framed = {**obj, "rank": self.rank, "epoch": self.epoch,
+                  "inc": self.inc}
+        try:
+            with self._ctrl_lock:
+                _send(self._ctrl, framed, blob)
+                msg, payload = _recv(self._ctrl)
+        except (ConnectionError, OSError) as e:
+            # a closed coordinator socket surfaces typed and PROMPTLY —
+            # not as a hang until waitTimeout (no coordinator failover:
+            # docs/robustness.md documents the limitation)
+            self.coordinator_lost = True
+            raise CoordinatorLostError(
+                f"coordinator at {self.coordinator_addr[0]}:"
+                f"{self.coordinator_addr[1]} unreachable during "
+                f"{obj.get('op')!r}: {type(e).__name__}: {e}") from e
+        self._absorb_membership(msg)
+        if msg.get("stale_epoch") and not _retried:
+            # our epoch lagged a membership change: resync (absorbed
+            # above) and re-send the same frame once at the new epoch
+            return self._request(obj, blob, _retried=True)
+        if msg.get("fenced"):
+            self.fenced = True
+            raise PeerLostError(
+                f"rank {self.rank} fenced out of the group: "
+                f"{msg.get('error')}")
+        return msg, payload
 
     # -- control-plane collectives -------------------------------------------------
     def _next_tag(self, kind: str) -> str:
         self._tag_n += 1
         return f"{kind}-{self._tag_n}"
 
-    def barrier(self, tag: Optional[str] = None) -> None:
+    def barrier(self, tag: Optional[str] = None,
+                allow_shrunk: bool = False) -> Tuple[int, List[int]]:
+        """Collective barrier.  Completes over the ALIVE membership; the
+        reply's (epoch, declared-dead) snapshot is identical for every
+        participant.  With ``allow_shrunk=False`` (default) a non-empty
+        dead list raises :class:`PeerLostError` — callers that can
+        recover across the shrunk group opt in explicitly."""
         tag = tag or self._next_tag("barrier")
-        msg, _ = self._request({"op": "barrier", "rank": self.rank,
-                                "tag": tag})
+        msg, _ = self._request({"op": "barrier", "tag": tag})
         if "error" in msg:
             raise PeerFailedError(f"barrier {tag}: {msg['error']}")
+        dead = [int(r) for r in msg.get("dead", [])]
+        if dead and not allow_shrunk:
+            raise PeerLostError(
+                f"barrier {tag}: peers declared dead: {dead} "
+                f"(epoch {msg.get('epoch', self.epoch)})")
+        return int(msg.get("epoch", self.epoch)), dead
+
+    def all_gather_map(self, blob: bytes, tag: Optional[str] = None,
+                       allow_shrunk: bool = False
+                       ) -> Tuple[Dict[int, bytes], int, List[int]]:
+        """All-gather returning {rank: payload} over the contributors
+        plus the (epoch, dead) membership snapshot fixed when the
+        collective completed."""
+        tag = tag or self._next_tag("allgather")
+        msg, payload = self._request({"op": "allgather", "tag": tag}, blob)
+        if "error" in msg:
+            raise PeerFailedError(f"allgather {tag}: {msg['error']}")
+        dead = [int(r) for r in msg.get("dead", [])]
+        if dead and not allow_shrunk:
+            raise PeerLostError(
+                f"allgather {tag}: peers declared dead: {dead} "
+                f"(epoch {msg.get('epoch', self.epoch)})")
+        ranks = [int(r) for r in
+                 msg.get("ranks", range(len(msg["lens"])))]
+        out: Dict[int, bytes] = {}
+        pos = 0
+        for r, ln in zip(ranks, msg["lens"]):
+            out[r] = payload[pos:pos + ln]
+            pos += ln
+        return out, int(msg.get("epoch", self.epoch)), dead
 
     def all_gather_bytes(self, blob: bytes,
                          tag: Optional[str] = None) -> List[bytes]:
-        tag = tag or self._next_tag("allgather")
-        msg, payload = self._request(
-            {"op": "allgather", "rank": self.rank, "tag": tag}, blob)
-        if "error" in msg:
-            raise PeerFailedError(f"allgather {tag}: {msg['error']}")
-        out, pos = [], 0
-        for ln in msg["lens"]:
-            out.append(payload[pos:pos + ln])
-            pos += ln
-        return out
+        by_rank, _, _ = self.all_gather_map(blob, tag=tag)
+        return [by_rank[r] for r in sorted(by_rank)]
+
+    def member_sync(self, tag: str) -> Tuple[int, List[int]]:
+        """Collectively agree on the membership view: every surviving
+        participant receives the SAME (epoch, declared-dead) snapshot —
+        the agreement orphan adoption re-owns partitions against."""
+        _, epoch, dead = self.all_gather_map(b"", tag=tag,
+                                             allow_shrunk=True)
+        return epoch, dead
 
     # -- failure detection ---------------------------------------------------------
     def _heartbeat_once(self) -> dict:
         with self._hb_lock:
-            _send(self._hb_sock, {"op": "heartbeat", "rank": self.rank})
+            _send(self._hb_sock, {"op": "heartbeat", "rank": self.rank,
+                                  "epoch": self.epoch, "inc": self.inc})
             msg, _ = _recv(self._hb_sock)
+        if msg.get("fenced"):
+            self.fenced = True
+            raise PeerLostError(
+                f"rank {self.rank} fenced: {msg.get('error')}")
+        self._absorb_membership(msg)
         return msg
 
     def _heartbeat_loop(self, interval: float) -> None:
@@ -414,21 +713,74 @@ class ProcessGroup:
                 # before this rank gives up on liveness reporting (the
                 # coordinator's heartbeat_timeout is the authority on
                 # actual death)
-                msg = transient_retry(None, "dcn.heartbeat",
-                                      self._heartbeat_once,
-                                      desc=f"rank-{self.rank}")
-                self._dead = [int(r) for r in msg.get("dead", [])]
-            except (QueryFaulted, ConnectionError, OSError):
+                transient_retry(None, "dcn.heartbeat",
+                                self._heartbeat_once,
+                                desc=f"rank-{self.rank}")
+            except QueryFaulted as qf:
+                if not getattr(qf, "resubmittable", False):
+                    # transient retries exhausted against a socket that
+                    # never answered: the coordinator is gone
+                    self.coordinator_lost = True
+                return
+            except (ConnectionError, OSError):
+                self.coordinator_lost = True
                 return
 
     @property
     def dead_peers(self) -> List[int]:
         return list(self._dead)
 
+    def alive_members(self) -> List[int]:
+        return [r for r in range(self.world_size) if r not in self._dead]
+
+    def is_alive(self) -> bool:
+        return not (self._closed or self.coordinator_lost or self.fenced)
+
     def check_peers(self) -> None:
+        if self.coordinator_lost:
+            raise CoordinatorLostError(
+                "coordinator connection lost (no failover; see "
+                "docs/robustness.md)")
         dead = [r for r in self._dead if r != self.rank]
         if dead:
-            raise PeerFailedError(f"peers stopped heartbeating: {dead}")
+            raise PeerLostError(f"peers stopped heartbeating: {dead} "
+                                f"(epoch {self.epoch})")
+
+    # -- chaos: deterministic peer kill --------------------------------------------
+    def note_op(self, desc: str = "") -> None:
+        """The ``dcn.peer_kill`` injection point: counted once per
+        shuffle op on this rank; when the armed schedule selects the
+        op, THIS RANK DIES — either silently (heartbeats stop, the peer
+        server freezes; death is visible only through failure
+        detection) or hard (``os._exit``), per
+        ``spark.rapids.tpu.dcn.kill.mode``."""
+        from ..faults.injector import INJECTOR, InjectedFault
+        try:
+            INJECTOR.maybe_raise("dcn.peer_kill",
+                                 desc=desc or f"rank-{self.rank}")
+        except InjectedFault:
+            self.die()
+
+    def die(self, mode: Optional[str] = None) -> None:
+        """Kill this rank (chaos testing).  ``hard`` exits the process;
+        ``silent`` stops heartbeating and freezes the peer server, then
+        raises :class:`PeerLostError` so the rank's own query unwinds —
+        the harness (tests/dcn_worker.py) decides whether the zombie
+        process lingers."""
+        if mode is None:
+            from ..config import TpuConf
+            mode = TpuConf()["spark.rapids.tpu.dcn.kill.mode"]
+        if mode == "hard":
+            os._exit(137)
+        self._closed = True  # stops the heartbeat loop
+        self._server.freeze()
+        for sock in (self._ctrl, self._hb_sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        raise PeerLostError(
+            f"rank {self.rank} killed by dcn.peer_kill (silent)")
 
     # -- data plane ----------------------------------------------------------------
     def register_shuffle(self, shuffle_id: str, directory: str) -> None:
@@ -442,17 +794,38 @@ class ProcessGroup:
         return f"shuffle-{self._shuffle_n}"
 
     def fetch(self, rank: int, shuffle_id: str, part: int) -> bytes:
-        """Pull one partition's frame stream from a peer's map output."""
+        """Pull one partition's frame stream from a peer's map output.
+
+        A rank the coordinator has DECLARED dead fast-fails with
+        :class:`PeerLostError` — retrying against it cannot help and
+        must not burn the backoff budget; the caller re-pulls the
+        fragment from the dead rank's durable map output instead."""
+        if rank in self._dead:
+            raise PeerLostError(
+                f"fetch {shuffle_id}[{part}]: rank {rank} declared dead "
+                f"(epoch {self.epoch}); re-pull from durable map output")
         host, port = self.peers[rank]
         try:
-            with socket.create_connection((host, port), timeout=60) as s:
+            with socket.create_connection(
+                    (host, port), timeout=self._fetch_timeout) as s:
                 _send(s, {"op": "fetch", "shuffle": shuffle_id,
-                          "part": part})
+                          "part": part, "epoch": self.epoch})
                 msg, payload = _recv(s)
         except (ConnectionError, OSError) as e:
             self.check_peers()  # prefer the heartbeat diagnosis if present
             raise PeerFailedError(
                 f"fetch {shuffle_id}[{part}] from rank {rank} failed: {e}")
+        if msg.get("stale_epoch"):
+            # our membership view lagged the serving rank's: refresh it
+            # before the retry curve re-fetches at the current epoch
+            try:
+                self._heartbeat_once()
+            except (PeerFailedError, ConnectionError, OSError):
+                self.check_peers()
+                raise
+            raise PeerFailedError(
+                f"fetch {shuffle_id}[{part}] from rank {rank}: "
+                f"{msg['error']} (membership resynced)")
         if "error" in msg:
             raise PeerFailedError(
                 f"fetch {shuffle_id}[{part}] from rank {rank}: "
@@ -479,9 +852,21 @@ class ProcessGroup:
 class DcnShuffle:
     """One shuffle across the process group.
 
-    Partition ownership is ``p % world_size`` — every rank reduces an equal
-    hash range, the way each executor in the reference owns the shuffle
+    Partition ownership is ``committed[p % len(committed)]`` over the
+    ranks whose map output COMMITTED — every rank reduces an equal hash
+    range, the way each executor in the reference owns the shuffle
     blocks it wrote and serves them to UCX peers.
+
+    Distributed fragment recovery: commit is a membership-carrying
+    all-gather in which each rank publishes the durable location of its
+    map output.  When a committed rank dies during the reduce, its
+    fragments are re-pulled from that durable map output (in this
+    rehearsal the shared filesystem; in a deployment, the durable
+    shuffle store) — ``fragments_recomputed_remote`` — and its OWNED
+    partitions are re-owned deterministically across the shrunk group
+    (:meth:`adopt_orphans`).  Only a rank dying BEFORE its map output
+    commits loses data no survivor can recover; that fails typed and
+    resubmittable.
     """
 
     def __init__(self, pg: ProcessGroup, n_parts: int, spill_dir: str,
@@ -492,39 +877,75 @@ class DcnShuffle:
         self.id = pg.new_shuffle_id()
         self.local = HostShuffle(n_parts, spill_dir,
                                  num_threads=num_threads, compress=compress)
+        self.committed: Optional[List[int]] = None
+        self.peer_dirs: Dict[int, str] = {}
         pg.register_shuffle(self.id, self.local.dir)
 
     def write_partition(self, p: int, table) -> None:
         self.local.write_partition(p, table)
 
     def commit(self) -> None:
-        """Map side durable on every rank (the reduce phase's barrier)."""
+        """Map side durable on every rank (the reduce phase's barrier).
+
+        The commit collective doubles as the shuffle's MEMBERSHIP
+        agreement: every contributor publishes its durable map-output
+        directory, and the coordinator's completion snapshot fixes the
+        same contributor/dead view on every survivor.  A rank declared
+        dead that never contributed lost its input shard with it —
+        unrecoverable here, so that fails typed (and resubmittable)
+        unless an earlier shuffle's adoption already covered the loss.
+        """
+        from ..utils import tracing
         self.local.finish_writes()
-        self.pg.check_peers()
-        # shuffle-scoped tag: a commit barrier must never pair with some
-        # other shuffle's barrier on a rank running ahead or behind
-        self.pg.barrier(tag=f"{self.id}-commit")
+        # shuffle-scoped tag: a commit gather must never pair with some
+        # other shuffle's collective on a rank running ahead or behind
+        payload = json.dumps({"dir": self.local.dir}).encode()
+        by_rank, epoch, dead = self.pg.all_gather_map(
+            payload, tag=f"{self.id}-commit", allow_shrunk=True)
+        self.peer_dirs = {r: json.loads(b.decode())["dir"]
+                          for r, b in by_rank.items() if b}
+        lost_inputs = set(dead) - self.pg.covered_dead - set(by_rank)
+        if lost_inputs:
+            tracing.mark(None, "peer:lost", "fault",
+                         ranks=sorted(lost_inputs), epoch=epoch,
+                         shuffle=self.id, recoverable=False)
+            raise PeerLostError(
+                f"rank(s) {sorted(lost_inputs)} died before committing "
+                f"map output for {self.id} (epoch {epoch}): their input "
+                f"contribution is lost at this placement")
+        # a contributor that died right after publishing still committed
+        # a COMPLETE map output: readers re-pull it durably and adopt
+        # its owned partitions
+        self.committed = sorted(by_rank)
+
+    def _members(self) -> List[int]:
+        return self.committed if self.committed is not None \
+            else list(range(self.pg.world_size))
 
     def owner(self, p: int) -> int:
-        return p % self.pg.world_size
+        members = self._members()
+        return members[p % len(members)]
 
     def my_parts(self) -> List[int]:
         return [p for p in range(self.n_parts)
                 if self.owner(p) == self.pg.rank]
 
     def read_partition(self, p: int) -> Iterator:
-        """Yield every rank's arrow tables for partition ``p`` (local frames
-        short-circuit to the file, like RapidsCachingReader local reads).
+        """Yield every committed rank's arrow tables for partition ``p``
+        (local frames short-circuit to the file, like RapidsCachingReader
+        local reads).
 
-        Fragment recovery: a failed pull — local frame decode or remote
-        peer fetch — re-pulls that rank's fragment from the producing
-        rank's durable map output with backoff (``shuffle.fragment``
-        point; successful re-pulls count ``fragments_recomputed``)
-        instead of failing the query.  A peer that is genuinely gone
-        exhausts the retries and surfaces the typed failure.
+        Fragment recovery, two tiers: a failed pull — local frame decode
+        or remote peer fetch — re-pulls that rank's fragment from its
+        durable map output with backoff (``shuffle.fragment`` point;
+        successful re-pulls count ``fragments_recomputed``).  A peer the
+        coordinator DECLARED dead fast-fails the fetch instead of riding
+        the backoff budget, and its fragment is re-pulled from the DEAD
+        rank's durable map output (``fragments_recomputed_remote``) —
+        peer loss is a data-movement event, not a query failure.
         """
-        from .host_shuffle import iter_frames
-        for r in range(self.pg.world_size):
+        self.pg.note_op(f"read {self.id} part-{p:05d}")
+        for r in self._members():
             if r == self.pg.rank:
                 tables = transient_retry(
                     None, "shuffle.fragment",
@@ -533,23 +954,103 @@ class DcnShuffle:
                     recover_counter="fragments_recomputed")
                 yield from tables
             else:
-                payload = transient_retry(
-                    None, "shuffle.fragment", self.pg.fetch,
-                    r, self.id, p,
-                    desc=f"rank-{r} part-{p:05d}",
-                    recover_counter="fragments_recomputed")
-                if payload:
-                    yield from iter_frames(payload)
+                yield from self._remote_fragment(r, p)
+
+    def _remote_fragment(self, r: int, p: int) -> Iterator:
+        from ..faults.recovery import QueryFaulted
+        from .host_shuffle import iter_frames
+        try:
+            payload = transient_retry(
+                None, "shuffle.fragment", self.pg.fetch,
+                r, self.id, p,
+                desc=f"rank-{r} part-{p:05d}",
+                recover_counter="fragments_recomputed")
+        except QueryFaulted as ex:
+            # the producing rank is gone — declared dead (fast-fail) or
+            # unreachable until retries exhausted: recover the fragment
+            # from its durable map output instead of failing the query
+            payload = self._durable_pull(r, p, ex)
+        if payload:
+            yield from iter_frames(payload)
+
+    def _durable_pull(self, r: int, p: int,
+                      cause: BaseException) -> bytes:
+        """Re-pull rank ``r``'s fragment of partition ``p`` from the
+        durable map output it published at commit (shared filesystem in
+        this rehearsal; the durable shuffle store in a deployment)."""
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        d = self.peer_dirs.get(r)
+        if d is None:
+            raise PeerLostError(
+                f"no durable map output registered for rank {r} in "
+                f"{self.id}; fragment part-{p:05d} unrecoverable "
+                f"({cause})") from cause
+
+        def _read() -> bytes:
+            if not os.path.isdir(d):
+                raise PeerLostError(
+                    f"durable map output {d} for rank {r} vanished")
+            path = os.path.join(d, f"part-{p:05d}.bin")
+            if not os.path.exists(path):
+                return b""  # the rank wrote nothing to this partition
+            with open(path, "rb") as f:
+                return f.read()
+
+        payload = transient_retry(None, "shuffle.fragment", _read,
+                                  desc=f"durable rank-{r} part-{p:05d}")
+        QueryStats.get().fragments_recomputed_remote += 1
+        tracing.mark(None, "fragment:remote_repull", "fault",
+                     rank=r, part=p, shuffle=self.id, bytes=len(payload))
+        return payload
+
+    def adopt_orphans(self) -> List[int]:
+        """After reading this rank's own partitions: collectively agree
+        on the membership view, and deterministically RE-OWN partitions
+        whose owner died after commit across the surviving ranks.
+        Returns the partitions THIS rank adopted (the caller reads and
+        yields them like its own)."""
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        epoch, dead = self.pg.member_sync(f"{self.id}-adopt")
+        self.pg.last_adopt_epoch = epoch
+        lost = [r for r in self._members() if r in dead]
+        if not lost:
+            return []
+        survivors = [r for r in self._members() if r not in dead]
+        if not survivors:
+            raise PeerLostError(
+                f"all ranks of {self.id} declared dead (epoch {epoch})")
+        orphans = [p for p in range(self.n_parts) if self.owner(p) in lost]
+        stats = QueryStats.get()
+        stats.peers_lost += len(
+            [r for r in lost if r not in self.pg.covered_dead])
+        self.pg.covered_dead.update(lost)
+        mine = [p for i, p in enumerate(orphans)
+                if survivors[i % len(survivors)] == self.pg.rank]
+        stats.partitions_reowned += len(mine)
+        tracing.mark(None, "peer:lost", "fault", ranks=lost, epoch=epoch,
+                     shuffle=self.id, orphans=len(orphans),
+                     adopted=len(mine))
+        return mine
 
     def close(self) -> None:
         """Retire the shuffle: all ranks must be DONE READING before any
         rank unregisters and deletes its frame files — a fast rank tearing
         down early would yield 'unknown shuffle' fetch failures on slower
         peers.  SPMD discipline: every rank closes every shuffle, in the
-        same order (generator finallys run in deterministic plan order)."""
-        self.pg.barrier(tag=f"{self.id}-close")
-        self.pg.unregister_shuffle(self.id)
-        self.local.close()
+        same order (generator finallys run in deterministic plan order).
+        A killed/fenced rank skips the collective (the survivors'
+        barrier completes over the alive membership) and — critically —
+        LEAVES its frame files on disk: they are the durable map output
+        the survivors re-pull its fragments from."""
+        if self.pg.is_alive():
+            self.pg.barrier(tag=f"{self.id}-close", allow_shrunk=True)
+            self.pg.unregister_shuffle(self.id)
+            self.local.close()
+        else:
+            self.pg.unregister_shuffle(self.id)
+            self.local.close(delete=False)
 
 
 # ---------------------------------------------------------------------------------
@@ -679,7 +1180,7 @@ class DcnExchangeExec:
     outputs_partitions = True
 
     def __init__(self, child, key_exprs, n_parts: int,
-                 pg: ProcessGroup, decode_batch=None):
+                 pg: ProcessGroup, decode_batch=None, adopt: bool = True):
         self.children = [child]
         self.key_exprs = key_exprs  # bound against child.output_schema
         self.n_parts = n_parts
@@ -687,6 +1188,14 @@ class DcnExchangeExec:
         # hook decoding dictionary-coded string keys back to utf8 before
         # serialization — codes are process-local and must not cross ranks
         self.decode_batch = decode_batch
+        # orphan adoption re-owns a dead rank's partitions across the
+        # survivors.  SAFE for aggregate exchanges (partition batches
+        # are position-independent); DISABLED for shuffled-join children
+        # — the join zips the two sides' partition streams pairwise, and
+        # a death landing between the two sides' adoption syncs could
+        # misalign the zip.  A join-shuffle death instead surfaces typed
+        # (resubmittable) at the result gather's covered-dead check.
+        self.adopt = adopt
         self.op_id = f"DcnExchange-{id(self):x}"
 
     @property
@@ -709,6 +1218,18 @@ class DcnExchangeExec:
             num_threads=ctx.conf[
                 "spark.rapids.tpu.sql.multiThreadedRead.numThreads"],
             compress=ctx.conf["spark.rapids.tpu.shuffle.compress"])
+
+        def _partition_batch(p: int):
+            tables = list(shuffle.read_partition(p))
+            if not tables:
+                return _empty_batch(schema)
+            import pyarrow as pa
+            return from_arrow(
+                pa.concat_tables(tables),
+                min_capacity=ctx.conf[
+                    "spark.rapids.tpu.sql.minBatchCapacity"],
+                device=ctx.device)
+
         try:
             for batch in self.children[0].execute(ctx):
                 batch = batch_utils.compact(batch)
@@ -729,15 +1250,15 @@ class DcnExchangeExec:
                 for p in np.unique(pids):
                     shuffle.write_partition(int(p), t.filter(pids == p))
             shuffle.commit()
-            min_cap = ctx.conf["spark.rapids.tpu.sql.minBatchCapacity"]
             for p in shuffle.my_parts():
-                tables = list(shuffle.read_partition(p))
-                if not tables:
-                    yield _empty_batch(schema)
-                    continue
-                import pyarrow as pa
-                yield from_arrow(pa.concat_tables(tables),
-                                 min_capacity=min_cap, device=ctx.device)
+                yield _partition_batch(p)
+            if self.adopt:
+                # distributed fragment recovery: partitions owned by a
+                # rank that died after commit are re-owned across the
+                # shrunk group (dead producers' fragments re-pull from
+                # durable map output inside read_partition)
+                for p in shuffle.adopt_orphans():
+                    yield _partition_batch(p)
         finally:
             shuffle.close()
 
@@ -772,17 +1293,36 @@ def _make_key_decoder(partial):
     return decode
 
 
-def _all_gather_table(pg: "ProcessGroup", table):
-    """All-gather a pyarrow table across ranks (Arrow IPC frames), concat."""
+def _all_gather_table(pg: "ProcessGroup", table, what: str = "gather",
+                      covered_ok: bool = True):
+    """All-gather a pyarrow table across ranks (Arrow IPC frames), concat.
+
+    Completes over the ALIVE membership.  A dead peer that contributed
+    before dying loses nothing; one that never contributed makes the
+    gathered result silently incomplete UNLESS its loss was covered by
+    a shuffle adoption below (``covered_ok=True``, the final result
+    gather: survivors' outputs already include the adopted partitions).
+    Broadcast build gathers pass ``covered_ok=False`` — a dead rank's
+    build-side shard cannot be recovered by adoption — so incomplete
+    data raises typed (and resubmittable) instead of joining wrong."""
     import pyarrow as pa
     sink = pa.BufferOutputStream()
     with pa.ipc.new_stream(sink, table.schema) as w:
         w.write_table(table)
-    gathered = pg.all_gather_bytes(sink.getvalue().to_pybytes())
+    by_rank, epoch, dead = pg.all_gather_map(
+        sink.getvalue().to_pybytes(), allow_shrunk=True)
+    missing = set(dead) - set(by_rank)
+    if covered_ok:
+        missing -= pg.covered_dead
+    if missing:
+        raise PeerLostError(
+            f"{what}: rank(s) {sorted(missing)} died holding "
+            f"un-recovered state (epoch {epoch}); resubmit against the "
+            f"surviving membership")
     parts = []
-    for payload in gathered:
-        with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
-            parts.append(r.read_all())
+    for r in sorted(by_rank):
+        with pa.ipc.open_stream(pa.py_buffer(by_rank[r])) as rd:
+            parts.append(rd.read_all())
     return pa.concat_tables(parts)
 
 
@@ -830,7 +1370,12 @@ class DcnBroadcastExchangeExec:
             local = to_arrow(batch_utils.compact(lh.get()))
         finally:
             lh.close()
-        full = _all_gather_table(self.pg, local)
+        # a dead rank's build-side shard is unrecoverable here (no
+        # durable map output to re-pull) — covered_ok=False makes the
+        # incomplete build fail typed instead of joining wrong
+        full = _all_gather_table(self.pg, local,
+                                 what=f"broadcast build {self.op_id}",
+                                 covered_ok=False)
         catalog = get_catalog(ctx.conf)
         if full.num_rows == 0:
             return catalog.register(_empty_batch(self.output_schema),
@@ -863,13 +1408,17 @@ def _rewrite_exchanges(node, pg: ProcessGroup, n_parts: int):
             node.children[i] = DcnBroadcastExchangeExec(child, pg)
             continue
         if isinstance(child, ShuffleExchangeExec):
+            from ..plan.join_exec import SortMergeJoinExec
             below = child.children[0]
             decoder = _make_key_decoder(below) \
                 if isinstance(below, AggregateExec) \
                 and below.mode == "partial" else None
             node.children[i] = DcnExchangeExec(
                 below, child.key_exprs, n_parts, pg,
-                decode_batch=decoder)
+                decode_batch=decoder,
+                # join children zip partition streams pairwise: orphan
+                # adoption stays off there (see DcnExchangeExec.adopt)
+                adopt=not isinstance(node, SortMergeJoinExec))
 
 
 def run_distributed_query(df, pg: ProcessGroup,
@@ -965,7 +1514,10 @@ def run_distributed_query(df, pg: ProcessGroup,
     local = pa.concat_tables(tables) if tables \
         else to_arrow(_empty_batch(top.output_schema))
 
-    full = _all_gather_table(pg, local)
+    # completes over the ALIVE membership; a rank that died holding
+    # reduce output no adoption covered makes the result incomplete —
+    # that raises typed/resubmittable inside instead of returning wrong
+    full = _all_gather_table(pg, local, what="result gather")
 
     if chain:
         # replay the post-subtree plan (sort/limit/...) on gathered rows

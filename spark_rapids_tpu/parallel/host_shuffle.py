@@ -154,8 +154,15 @@ class HostShuffle:
                     sp.set(partition=p, bytes=clen, rows=table.num_rows)
                 yield table
 
-    def close(self) -> None:
+    def close(self, delete: bool = True) -> None:
+        """Shut the writer pool down and (by default) delete the frame
+        files.  ``delete=False`` keeps them: a killed DCN rank's map
+        output is DURABLE state its surviving peers re-pull fragments
+        from (parallel/dcn.py), so its unwind must not take the data
+        down with it."""
         self._pool.shutdown(wait=False)
+        if not delete:
+            return
         for p in self._paths:
             try:
                 os.unlink(p)

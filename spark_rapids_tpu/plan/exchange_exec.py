@@ -13,7 +13,13 @@ realized in-process.  Transports (SURVEY §5.8):
   * ICI (parallel/exchange.py): the same bucketize feeding one
     ``lax.all_to_all`` across a jax Mesh for stage-resident multi-chip
     execution (driven by parallel/distributed.py and the multichip dryrun);
-  * HOST (multi-process DCN/gRPC staging) is the planned third tier.
+  * HOST (``_execute_host`` below): partition slices leave the device as
+    compressed Arrow frame files — the same frame files the DCN tier
+    (parallel/dcn.py DcnExchangeExec) serves to peers, with the same
+    durable-map-output fragment recovery underneath (a lost fragment
+    re-pulls from the frame files; across processes, a DEAD peer's
+    fragments re-pull from the durable map output it published at
+    commit).
 """
 
 from __future__ import annotations
@@ -181,11 +187,12 @@ class ShuffleExchangeExec(TpuExec):
                 # a lost/failed fragment re-pulls the partition from the
                 # producing stage's durable frame files (lineage
                 # recompute) instead of failing the query; a successful
-                # re-pull after a fault counts fragments_recomputed
+                # re-pull after a fault counts fragments_recomputed and
+                # lands a 'recovered' trace mark attributed to this op
                 tables = transient_retry(
                     ctx, "shuffle.fragment",
                     lambda p=p: list(shuffle.read_partition(p)),
-                    desc=f"part-{p:05d}",
+                    desc=f"{self.op_id} part-{p:05d}",
                     recover_counter="fragments_recomputed")
                 with m.time("opTime"):
                     if not tables:

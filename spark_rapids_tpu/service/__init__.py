@@ -16,16 +16,18 @@ from .cancel import (QueryCancelled, QueryControl,  # noqa: F401
 
 __all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryControl",
            "QueryRejected", "QueryScheduler", "QueryHandle",
-           "QueryFaulted", "check", "current", "scope", "cancel"]
+           "QueryFaulted", "PermanentFault", "check", "current", "scope",
+           "cancel"]
 
 
 def __getattr__(name):
     if name in ("QueryRejected", "QueryScheduler", "QueryHandle"):
         from . import scheduler
         return getattr(scheduler, name)
-    if name == "QueryFaulted":
+    if name in ("QueryFaulted", "PermanentFault"):
         # the service surface re-exports the typed terminal failure a
-        # handle's result() raises when fault recovery exhausts
-        from ..faults.recovery import QueryFaulted
-        return QueryFaulted
+        # handle's result() raises when fault recovery exhausts, and the
+        # permanent-at-this-placement marker that makes it resubmittable
+        from ..faults import recovery
+        return getattr(recovery, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

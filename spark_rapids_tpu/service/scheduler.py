@@ -24,7 +24,15 @@ semaphore permit count as the only concurrency primitive.  The
   * **deadlines + cancellation** — every query carries a
     :class:`..service.cancel.QueryControl`; ``handle.cancel()`` (or the
     deadline timer) aborts it cooperatively at the next batch boundary,
-    releasing permits, pipeline slots, and spill handles.
+    releasing permits, pipeline slots, and spill handles;
+  * **automatic resubmission** — a query failing
+    *permanent-at-this-placement* (``QueryFaulted`` with
+    ``resubmittable=True``: a DCN peer the coordinator declared dead, a
+    lost coordinator) is requeued up to
+    ``spark.rapids.tpu.faults.resubmit.max`` times against the
+    surviving membership; the faulted attempt's trace finishes with a
+    ``resubmitted`` status linked to the retry, and the caller's handle
+    resolves with the final attempt's outcome.
 
 Each admitted query runs on its own worker thread in a COPY of the
 submitter's context (per-query ``QueryStats`` scope + trace + control
@@ -60,10 +68,11 @@ class QueryRejected(RuntimeError):
 class _Entry:
     __slots__ = ("seq", "label", "fn", "control", "future", "cctx",
                  "status", "stats", "submitted_t", "started_t",
-                 "finished_t")
+                 "finished_t", "deadline_s", "resubmits", "attempts")
 
     def __init__(self, seq: int, label: str, fn: Callable,
-                 control: QueryControl):
+                 control: QueryControl,
+                 deadline_s: Optional[float] = None):
         self.seq = seq
         self.label = label
         self.fn = fn
@@ -78,6 +87,13 @@ class _Entry:
         self.submitted_t = _pc()
         self.started_t: Optional[float] = None
         self.finished_t: Optional[float] = None
+        # resubmission lineage: the original deadline_s (each attempt
+        # gets a fresh full deadline), attempts so far, and per-attempt
+        # records {label, status, trace} — QueryHandle.attempts exposes
+        # the faulted→resubmitted→done chain
+        self.deadline_s = deadline_s
+        self.resubmits = 0
+        self.attempts: List[Dict] = []
 
 
 class QueryHandle:
@@ -119,11 +135,26 @@ class QueryHandle:
 
     @property
     def status(self) -> str:
-        """queued | running | done | failed | faulted | cancelled |
-        deadline (``faulted`` = transient-fault recovery exhausted; the
-        :class:`..faults.recovery.QueryFaulted` from :meth:`result`
-        carries the fault history)"""
+        """queued | running | resubmitted | done | failed | faulted |
+        cancelled | deadline (``faulted`` = transient-fault recovery
+        exhausted — the :class:`..faults.recovery.QueryFaulted` from
+        :meth:`result` carries the fault history; ``resubmitted`` = a
+        permanent-at-this-placement failure was requeued and a fresh
+        attempt is pending/running)"""
         return self._entry.status
+
+    @property
+    def resubmits(self) -> int:
+        """Automatic resubmissions so far (permanent-at-this-placement
+        failures requeued under spark.rapids.tpu.faults.resubmit.max)."""
+        return self._entry.resubmits
+
+    @property
+    def attempts(self) -> List[Dict]:
+        """Per-attempt lineage records ({label, status, trace}) for
+        every FINISHED prior attempt; the current/last attempt is the
+        handle itself.  Empty when the query never resubmitted."""
+        return list(self._entry.attempts)
 
     @property
     def stats(self) -> Optional[Dict[str, float]]:
@@ -177,6 +208,7 @@ class QueryScheduler:
         self.completed = 0
         self.rejected = 0
         self.cancelled = 0
+        self.resubmitted = 0
         self._sem_listener_installed = False
         # dispatcher: pops admissible entries and starts worker threads;
         # queries themselves run in per-query copied contexts
@@ -237,7 +269,8 @@ class QueryScheduler:
                                    priority=priority, tenant=tenant,
                                    weight=weight)
             control.enqueued_t = _pc()
-            entry = _Entry(self._seq, label, fn, control)
+            entry = _Entry(self._seq, label, fn, control,
+                           deadline_s=deadline_s)
             self._queue.append(entry)
             self.submitted += 1
             self._cv.notify_all()
@@ -339,6 +372,7 @@ class QueryScheduler:
 
     # -- execution ----------------------------------------------------------------
     def _run_entry(self, e: _Entry) -> None:
+        from ..faults.recovery import PermanentFault
         from ..utils.metrics import QueryStats
         e.started_t = _pc()
         ctl = e.control
@@ -355,16 +389,80 @@ class QueryScheduler:
                 status, error = "deadline", exc
             except QueryCancelled as exc:
                 status, error = "cancelled", exc
-            except QueryFaulted as exc:
-                # transient-fault recovery exhausted: the typed failure
-                # (fault history attached) becomes its own terminal
+            except (QueryFaulted, PermanentFault) as exc:
+                # transient-fault recovery exhausted (or a raw permanent
+                # fault): the typed failure becomes its own terminal
                 # status; the unwind above already released the permit,
-                # pipeline slots, and spill handles
+                # pipeline slots, and spill handles — which is exactly
+                # what makes an automatic RESUBMISSION safe when the
+                # failure is permanent-at-this-placement
                 status, error = "faulted", exc
             except BaseException as exc:
                 status, error = "failed", exc
             e.stats = stats.snapshot()
+        if status == "faulted" and self._maybe_resubmit(e, error):
+            return  # the future stays pending; a fresh attempt is queued
         self._finish(e, status, result, error)
+
+    def _resubmittable(self, exc: BaseException) -> bool:
+        from ..faults.recovery import PermanentFault
+        return isinstance(exc, PermanentFault) \
+            or bool(getattr(exc, "resubmittable", False))
+
+    def _maybe_resubmit(self, e: _Entry, exc: BaseException) -> bool:
+        """Requeue a query whose failure is permanent-at-this-placement
+        (a declared-dead peer) for a fresh attempt against the surviving
+        membership, up to ``spark.rapids.tpu.faults.resubmit.max`` times.
+
+        The faulted attempt's trace is FINISHED with a ``resubmitted``
+        status linked to the retry label; permits/slots/handles were
+        already released by the ordinary unwind, so the retry re-enters
+        admission like any other query.  Returns True when requeued (the
+        caller's future stays pending and resolves with the retry's
+        outcome)."""
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        if not self._resubmittable(exc):
+            return False
+        limit = self._conf()["spark.rapids.tpu.faults.resubmit.max"]
+        if e.resubmits >= max(0, limit):
+            return False
+        retry_label = f"{e.label}~r{e.resubmits + 1}"
+        tr = e.control.trace
+        if tr is not None:
+            # the faulted attempt's trace ends accurately: resubmitted,
+            # linked forward to the retry (the retry links back)
+            tr.set_status("resubmitted")
+            tr.attrs["resubmitted_to"] = retry_label
+            tr.attrs["resubmit_reason"] = str(exc)
+        e.attempts.append({"label": e.control.label,
+                           "status": "resubmitted", "trace": tr})
+        ctl = e.control
+        with self._cv:
+            if self._closed:
+                return False
+            # the faulted attempt's unwind released its permit; free the
+            # running slot too, then requeue through normal admission
+            self._running.discard(e)
+            t = ctl.tenant
+            self._vtime[t] = self._vtime.get(t, 0.0) \
+                + (_pc() - (e.started_t or _pc())) / ctl.weight
+            e.resubmits += 1
+            self.resubmitted += 1
+            e.control = QueryControl(
+                label=retry_label, deadline_s=e.deadline_s,
+                priority=ctl.priority, tenant=ctl.tenant,
+                weight=ctl.weight)
+            e.control.resubmit_of = ctl.label
+            e.control.enqueued_t = _pc()
+            e.status = "resubmitted"
+            self._queue.append(e)
+            self._cv.notify_all()
+        QueryStats.get().queries_resubmitted += 1
+        tracing.mark(None, "query:resubmitted", "fault",
+                     label=e.label, retry=retry_label,
+                     attempt=e.resubmits, reason=type(exc).__name__)
+        return True
 
     def _finish(self, e: _Entry, status: str, result, error) -> None:
         e.finished_t = _pc()
@@ -417,7 +515,8 @@ class QueryScheduler:
                     "submitted": self.submitted,
                     "completed": self.completed,
                     "rejected": self.rejected,
-                    "cancelled": self.cancelled}
+                    "cancelled": self.cancelled,
+                    "resubmitted": self.resubmitted}
 
     def close(self, cancel_running: bool = True) -> None:
         """Shut down: shed the queue, optionally cancel in-flight

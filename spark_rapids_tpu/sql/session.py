@@ -408,6 +408,13 @@ class Session:
             "priority": ctl.priority,
             "tenant": ctl.tenant,
             "queue_wait_s": round(ctl.queue_wait_s, 6)})
+        resubmit_of = getattr(ctl, "resubmit_of", None)
+        if resubmit_of:
+            # a scheduler-resubmitted attempt links BACK to the faulted
+            # attempt it retries (whose trace links forward via
+            # resubmitted_to) — the faulted→resubmitted→done lineage is
+            # walkable from either end
+            tr.attrs["resubmit_of"] = resubmit_of
 
     @staticmethod
     def _trace_status(tr, exc: BaseException) -> None:

@@ -111,6 +111,18 @@ class QueryStats:
         self.retry_backoff_s = 0.0
         self.fragments_recomputed = 0
         self.degraded_batches = 0
+        # distributed failure survival (parallel/dcn.py + service/
+        # scheduler.py): peers the coordinator declared dead while this
+        # query ran, shuffle fragments re-pulled from a DEAD peer's
+        # durable map output (the cross-peer generalization of
+        # fragments_recomputed), reduce partitions re-owned across the
+        # shrunk group, and whole-query scheduler resubmissions after a
+        # permanent-at-this-placement failure — the trace_report peer
+        # summary and bench's SRT_BENCH_KILL_PEER columns read these
+        self.peers_lost = 0
+        self.fragments_recomputed_remote = 0
+        self.partitions_reowned = 0
+        self.queries_resubmitted = 0
 
     # -- accessors ----------------------------------------------------------
     @classmethod

@@ -115,9 +115,11 @@ class QueryTrace:
         self.t0 = _pc()
         self.wall_start = time.time()
         self.t_end: Optional[float] = None
-        # span status of the whole query: 'ok' | 'cancelled' |
-        # 'deadline' | 'error' — the session sets it from the exception
-        # that ended execution, so an aborted query's trace says so
+        # span status of the whole query: 'ok' | 'degraded' |
+        # 'cancelled' | 'deadline' | 'faulted' | 'resubmitted' | 'error'
+        # — the session sets it from the exception that ended execution
+        # (and the scheduler promotes 'faulted' to 'resubmitted' when it
+        # requeues the query), so an aborted query's trace says so
         self.status = "ok"
         self.max_events = max_events
         self.dropped = 0

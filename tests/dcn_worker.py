@@ -1,13 +1,24 @@
-"""One rank of a DCN distributed-aggregation run (spawned by test_dcn.py).
+"""One rank of a DCN distributed-aggregation run (spawned by test_dcn.py
+and the killed-peer chaos suite in test_dcn_failures.py).
 
 Each rank is a real separate process with its own JAX runtime, session, and
 input shard — the multi-host execution model, rehearsed on localhost.
+
+Chaos knobs: ``--kill-rank R --kill-after N`` arms the ``dcn.peer_kill``
+injection point on rank R only — the rank dies at its Nth reduce-side
+shuffle op (mid-shuffle, after its map output committed).
+``--kill-mode silent`` stops heartbeating and freezes the peer server,
+then LINGERS as a zombie (death is visible to survivors only through
+failure detection — the worst case); ``--kill-mode hard`` exits the
+process immediately.  ``--hb-interval/--hb-timeout/--wait-timeout``
+shrink the liveness horizon so recovery-time bounds are testable.
 """
 
 import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -20,6 +31,13 @@ def main() -> None:
     ap.add_argument("--data", required=True)
     ap.add_argument("--out", required=True)
     ap.add_argument("--query", default="simple")
+    ap.add_argument("--kill-rank", type=int, default=-1)
+    ap.add_argument("--kill-after", type=int, default=1)
+    ap.add_argument("--kill-mode", default="silent",
+                    choices=["silent", "hard"])
+    ap.add_argument("--hb-interval", type=float, default=2.0)
+    ap.add_argument("--hb-timeout", type=float, default=None)
+    ap.add_argument("--wait-timeout", type=float, default=None)
     args = ap.parse_args()
 
     # force the CPU platform the same way tests/conftest.py does — a TPU
@@ -28,17 +46,35 @@ def main() -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")
     import spark_rapids_tpu as srt
-    from spark_rapids_tpu.parallel.dcn import (Coordinator, ProcessGroup,
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.parallel.dcn import (Coordinator,
+                                               CoordinatorLostError,
+                                               PeerLostError, ProcessGroup,
                                                run_distributed_agg)
     from spark_rapids_tpu.sql import functions as F
+
+    if args.hb_timeout is not None:
+        TpuConf.set_session("spark.rapids.tpu.dcn.heartbeatTimeout",
+                            args.hb_timeout)
+    if args.wait_timeout is not None:
+        TpuConf.set_session("spark.rapids.tpu.dcn.waitTimeout",
+                            args.wait_timeout)
 
     coord = None
     if args.rank == 0:
         coord = Coordinator(args.world, port=args.port)
     pg = ProcessGroup(args.rank, args.world, ("127.0.0.1", args.port),
-                      coordinator=coord)
+                      coordinator=coord,
+                      heartbeat_interval=args.hb_interval)
     try:
         sess = srt.Session.get_or_create()
+        if args.kill_rank == args.rank:
+            # deterministic peer kill: THIS rank dies at its Nth
+            # reduce-side shuffle op (the dcn.peer_kill injection point;
+            # re-armed from conf at every ExecContext like any schedule)
+            sess.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                          f"dcn.peer_kill:{args.kill_after}")
+            sess.conf.set("spark.rapids.tpu.dcn.kill.mode", args.kill_mode)
         df = sess.read_parquet(
             os.path.join(args.data, f"part-{args.rank}.parquet"))
         if args.query == "simple":
@@ -76,10 +112,37 @@ def main() -> None:
                  .sort("dname"))
         else:
             raise SystemExit(f"unknown query {args.query!r}")
-        rows = run_distributed_agg(q, pg)
+        try:
+            rows = run_distributed_agg(q, pg)
+        except PeerLostError:
+            if args.kill_rank == args.rank and args.kill_mode == "silent":
+                # silently-killed rank: linger as a zombie (heartbeats
+                # stopped, peer server frozen) so survivors must detect
+                # the death through the liveness machinery, never
+                # through this process exiting.  The test reaps us.
+                time.sleep(300)  # fault-ok (simulated wedged rank, not a retry)
+                os._exit(143)
+            raise
         with open(f"{args.out}.{args.rank}", "w") as f:
             json.dump(rows, f, default=str)
-        pg.barrier()  # all outputs durable before any rank exits
+        # recovery accounting rides a sidecar so the chaos suite can
+        # assert WHERE the survival came from (remote re-pulls, re-owned
+        # partitions) without changing the result-file contract
+        from spark_rapids_tpu.utils.metrics import QueryStats
+        snap = QueryStats.process().snapshot()
+        with open(f"{args.out}.stats.{args.rank}", "w") as f:
+            json.dump({k: snap[k] for k in
+                       ("peers_lost", "fragments_recomputed",
+                        "fragments_recomputed_remote",
+                        "partitions_reowned", "transient_retries")}, f)
+        try:
+            pg.barrier(allow_shrunk=True)  # outputs durable before exit
+        except (PeerLostError, CoordinatorLostError):
+            # best-effort exit sync: our own output file is already
+            # durable; a peer that exited (closing the rank-0
+            # coordinator) or died during this last barrier cannot
+            # invalidate it
+            pass
     finally:
         pg.close()
 

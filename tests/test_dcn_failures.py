@@ -1,0 +1,669 @@
+"""Distributed failure survival (ISSUE 6): epoch-fenced membership,
+cross-peer fragment recovery from durable map output, dead-peer
+fast-fail, coordinator-loss detection, and scheduler resubmission.
+
+The multi-process killed-peer chaos differential (@slow) kills a real
+rank mid-shuffle (``dcn.peer_kill``, silent and hard modes) and asserts
+the survivors' result is identical to the fault-free run; the tier-1
+single-process simulation drives the same recovery machinery —
+declaration, durable re-pull, orphan adoption — over thread ranks.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.config import ALL_ENTRIES, TpuConf
+from spark_rapids_tpu.faults import (INJECTOR, PermanentFault, QueryFaulted,
+                                     TransientFault, budget_scope,
+                                     transient_retry)
+from spark_rapids_tpu.memory.spill import get_catalog
+from spark_rapids_tpu.parallel.dcn import (Coordinator, CoordinatorLostError,
+                                           DcnShuffle, PeerFailedError,
+                                           PeerLostError, ProcessGroup)
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.metrics import QueryStats
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST = {
+    "spark.rapids.tpu.faults.backoff.baseMs": 1.0,
+    "spark.rapids.tpu.faults.backoff.maxMs": 10.0,
+}
+
+
+@pytest.fixture()
+def fast_backoff():
+    for k, v in FAST.items():
+        TpuConf.set_session(k, v)
+    yield
+    for k in FAST:
+        TpuConf.unset_session(k)
+    INJECTOR.arm()
+
+
+def _make_group(world, hb_timeout=0.5, wait_timeout=8.0, interval=0.1):
+    coord = Coordinator(world, heartbeat_timeout=hb_timeout,
+                        wait_timeout=wait_timeout)
+    pgs = [None] * world
+    errs = []
+
+    def mk(r):
+        try:
+            pgs[r] = ProcessGroup(r, world, ("127.0.0.1", coord.port),
+                                  coordinator=coord if r == 0 else None,
+                                  heartbeat_interval=interval)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=mk, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs, errs
+    return coord, pgs
+
+
+def _silently_kill(pg):
+    """Thread-rank analog of a silent peer death: heartbeats stop and
+    the peer server freezes (open socket, no answers)."""
+    pg._closed = True
+    pg._server.freeze()
+
+
+def _wait_declared(observer, rank, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if rank in observer.dead_peers:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"rank {rank} never declared dead (dead={observer.dead_peers})")
+
+
+# ---------------------------------------------------------------------------
+# Epoch-fenced membership.
+# ---------------------------------------------------------------------------
+
+class TestEpochFencing:
+    def test_declared_death_bumps_epoch(self, fast_backoff):
+        coord, pgs = _make_group(2)
+        try:
+            assert coord.epoch == 0
+            _silently_kill(pgs[1])
+            _wait_declared(pgs[0], 1)
+            assert coord.epoch >= 1
+            assert coord.declared_dead() == [1]
+            # survivors absorbed the bumped epoch through heartbeats
+            deadline = time.monotonic() + 5
+            while pgs[0].epoch < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert pgs[0].epoch >= 1
+        finally:
+            for pg in pgs:
+                pg.close()
+
+    def test_stale_epoch_collective_resyncs_transparently(self,
+                                                          fast_backoff):
+        """A live rank whose epoch lags a membership change is rejected
+        with stale_epoch and resyncs on the retry — collectives carry
+        the epoch without wedging survivors."""
+        coord, pgs = _make_group(3)
+        try:
+            _silently_kill(pgs[2])
+            _wait_declared(pgs[0], 2)
+            # force rank 1's view stale (as if it had not heartbeated
+            # since the bump), then run a collective: the coordinator
+            # rejects the stale frame, the reply resyncs, retry joins
+            pgs[1].epoch = 0
+            pgs[1]._server.epoch = 0
+            outs = [None, None]
+
+            def gather(i):
+                outs[i] = pgs[i].all_gather_map(
+                    f"p{i}".encode(), tag="fence-test",
+                    allow_shrunk=True)
+
+            ts = [threading.Thread(target=gather, args=(i,))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=20)
+            assert outs[0] is not None and outs[1] is not None
+            by_rank, epoch, dead = outs[1]
+            assert dead == [2] and epoch >= 1
+            assert sorted(by_rank) == [0, 1]
+            assert pgs[1].epoch >= 1  # resynced by the rejection
+        finally:
+            for pg in pgs:
+                pg.close()
+
+    def test_restarted_rank_gets_fresh_identity(self, fast_backoff):
+        """A restarted rank re-registers under a fresh incarnation (epoch
+        bumps again); frames from its previous life are rejected typed
+        instead of resurrecting with stale shuffle state."""
+        coord, pgs = _make_group(2)
+        reborn = None
+        try:
+            old = pgs[1]
+            assert old.inc == 0
+            _silently_kill(old)
+            _wait_declared(pgs[0], 1)
+            e_death = coord.epoch
+            reborn = ProcessGroup(1, 2, ("127.0.0.1", coord.port),
+                                  heartbeat_interval=0.1)
+            assert reborn.inc == 1  # fresh identity
+            assert coord.epoch > e_death  # rejoin bumped the epoch
+            # the ZOMBIE's old-incarnation frame is rejected typed
+            with pytest.raises(PeerLostError, match="stale incarnation"):
+                old.barrier(tag="zombie-barrier")
+            # the reborn rank participates normally
+            outs = [None, None]
+
+            def go(i, pg):
+                outs[i] = pg.barrier(tag="rejoin-barrier",
+                                     allow_shrunk=True)
+
+            ts = [threading.Thread(target=go, args=(0, pgs[0])),
+                  threading.Thread(target=go, args=(1, reborn))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=20)
+            assert outs[0] is not None and outs[1] is not None
+        finally:
+            if reborn is not None:
+                reborn.close()
+            for pg in pgs:
+                pg.close()
+
+    def test_stale_epoch_fetch_rejected_by_peer_server(self, fast_backoff,
+                                                       tmp_path):
+        """Data-plane fencing: a fetch carrying an older epoch than the
+        serving rank's membership view is rejected — a zombie cannot
+        keep pulling shuffle state."""
+        coord, pgs = _make_group(2)
+        try:
+            sh = DcnShuffle(pgs[0], 2, str(tmp_path / "r0"))
+            sh.local.write_partition(0, pa.table({"x": [1, 2]}))
+            sh.local.finish_writes()
+            pgs[0]._server.epoch = 3  # rank 0 has seen epoch 3
+            pgs[1].epoch = 1          # rank 1's view is stale
+            with pytest.raises(PeerFailedError, match="stale epoch"):
+                pgs[1].fetch(0, sh.id, 0)
+            pgs[1].epoch = 3          # resynced: the fetch serves
+            assert pgs[1].fetch(0, sh.id, 0)
+            sh.local.close()
+        finally:
+            for pg in pgs:
+                pg.close()
+
+
+# ---------------------------------------------------------------------------
+# Dead-peer fast-fail (satellite: no backoff budget burned on a corpse).
+# ---------------------------------------------------------------------------
+
+class TestDeadPeerFastFail:
+    def test_types(self):
+        assert issubclass(PeerLostError, PeerFailedError)
+        assert issubclass(PeerLostError, PermanentFault)
+        assert issubclass(CoordinatorLostError, PermanentFault)
+        assert not issubclass(CoordinatorLostError, TransientFault)
+
+    def test_permanent_fault_fast_fails_typed(self, fast_backoff):
+        conf = TpuConf(FAST)
+        calls = []
+
+        def dead_fetch():
+            calls.append(1)
+            raise PeerLostError("rank 1 declared dead")
+
+        with budget_scope(conf) as budget:
+            start_budget = budget.remaining
+            t0 = time.monotonic()
+            with pytest.raises(QueryFaulted) as ei:
+                transient_retry(conf, "shuffle.fragment", dead_fetch,
+                                desc="rank-1 part-00000")
+            elapsed = time.monotonic() - t0
+        # ONE attempt, no backoff sleeps, budget untouched, typed +
+        # resubmittable — the exact opposite of riding the retry curve
+        assert len(calls) == 1
+        assert ei.value.resubmittable is True
+        assert budget.remaining == start_budget
+        assert elapsed < 0.5
+        assert "permanent at this placement" in str(ei.value)
+
+    def test_transient_peer_error_still_retries(self, fast_backoff):
+        conf = TpuConf(FAST)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise PeerFailedError("connection hiccup")
+            return "ok"
+
+        assert transient_retry(conf, "shuffle.fragment", flaky) == "ok"
+        assert len(calls) == 2  # hiccups keep the backoff path
+
+    def test_check_peers_raises_peer_lost(self, fast_backoff):
+        coord, pgs = _make_group(2)
+        try:
+            _silently_kill(pgs[1])
+            _wait_declared(pgs[0], 1)
+            with pytest.raises(PeerLostError):
+                pgs[0].check_peers()
+            with pytest.raises(PeerLostError):
+                pgs[0].fetch(1, "shuffle-1", 0)
+        finally:
+            for pg in pgs:
+                pg.close()
+
+
+# ---------------------------------------------------------------------------
+# Coordinator loss: typed, prompt (satellite; HA stays out of scope).
+# ---------------------------------------------------------------------------
+
+class TestCoordinatorLost:
+    def test_closed_coordinator_fails_requests_promptly(self,
+                                                        fast_backoff):
+        coord, pgs = _make_group(1, wait_timeout=60.0)
+        pg = pgs[0]
+        try:
+            coord.close()
+            t0 = time.monotonic()
+            with pytest.raises(CoordinatorLostError):
+                pg.barrier(tag="after-death")
+            # typed and PROMPT: nowhere near the 60 s waitTimeout
+            assert time.monotonic() - t0 < 5.0
+            assert pg.coordinator_lost
+            with pytest.raises(CoordinatorLostError):
+                pg.check_peers()
+        finally:
+            pg.close()
+
+    def test_heartbeat_loop_flags_lost_coordinator(self, fast_backoff):
+        coord, pgs = _make_group(1)
+        pg = pgs[0]
+        try:
+            coord.close()
+            deadline = time.monotonic() + 10
+            while not pg.coordinator_lost and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert pg.coordinator_lost
+        finally:
+            pg.close()
+
+
+# ---------------------------------------------------------------------------
+# Cross-peer fragment recovery + orphan adoption (tier-1 single-process
+# simulation of the killed-peer chaos run, over thread ranks).
+# ---------------------------------------------------------------------------
+
+class TestKilledPeerSimulation:
+    def test_durable_repull_and_adoption(self, fast_backoff, tmp_path):
+        world, n_parts = 2, 4
+        coord, pgs = _make_group(world, hb_timeout=0.6)
+        shuffles = []
+        try:
+            shuffles = [DcnShuffle(pg, n_parts, str(tmp_path / f"r{pg.rank}"))
+                        for pg in pgs]
+            for rank, sh in enumerate(shuffles):
+                for p in range(n_parts):
+                    sh.write_partition(p, pa.table(
+                        {"src": [rank] * 3, "part": [p] * 3,
+                         "v": list(range(3))}))
+            ts = [threading.Thread(target=sh.commit) for sh in shuffles]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            assert shuffles[0].committed == [0, 1]
+            assert sorted(shuffles[0].peer_dirs) == [0, 1]
+
+            # rank 1 dies SILENTLY mid-shuffle (map output durable)
+            _silently_kill(pgs[1])
+
+            s0 = QueryStats.get().snapshot()
+            rows = []
+            # rank 0 reads its own partitions: rank 1's fragments come
+            # back from the dead rank's DURABLE map output once the
+            # fetch path gives up on the frozen server
+            for p in shuffles[0].my_parts():
+                for t_ in shuffles[0].read_partition(p):
+                    rows.append(t_)
+            # ... then adopts the dead rank's partitions
+            adopted = shuffles[0].adopt_orphans()
+            assert adopted == [p for p in range(n_parts) if p % 2 == 1]
+            for p in adopted:
+                for t_ in shuffles[0].read_partition(p):
+                    rows.append(t_)
+            got = pa.concat_tables(rows)
+            # every row both ranks wrote is accounted for exactly once
+            assert got.num_rows == world * n_parts * 3
+            by = sorted(zip(got.column("src").to_pylist(),
+                            got.column("part").to_pylist()))
+            assert by == sorted((r, p) for r in range(world)
+                                for p in range(n_parts)
+                                for _ in range(3))
+            d = QueryStats.delta_since(s0)
+            assert d["fragments_recomputed_remote"] >= 1
+            assert d["partitions_reowned"] == len(adopted)
+            assert d["peers_lost"] == 1
+            assert 1 in pgs[0].covered_dead
+            shuffles[0].close()
+            shuffles = []
+        finally:
+            for sh in shuffles:
+                sh.local.close()
+            for pg in pgs:
+                pg.close()
+
+    def test_precommit_death_fails_typed_resubmittable(self, fast_backoff,
+                                                       tmp_path):
+        """A rank dying BEFORE its map output commits loses its input
+        contribution — commit fails typed + resubmittable, never
+        silently wrong."""
+        world = 2
+        coord, pgs = _make_group(world, hb_timeout=0.5)
+        try:
+            shuffles = [DcnShuffle(pg, 2, str(tmp_path / f"r{pg.rank}"))
+                        for pg in pgs]
+            shuffles[0].write_partition(0, pa.table({"x": [1]}))
+            _silently_kill(pgs[1])  # dies without committing
+            _wait_declared(pgs[0], 1)
+            with pytest.raises(PeerLostError, match="before committing"):
+                shuffles[0].commit()
+            # the typed failure rides the fast-fail protocol end to end
+            with pytest.raises(QueryFaulted) as ei:
+                transient_retry(TpuConf(FAST), "shuffle.fragment",
+                                shuffles[0].commit)
+            assert ei.value.resubmittable
+            for sh in shuffles:
+                sh.local.close()
+        finally:
+            for pg in pgs:
+                pg.close()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler resubmission: faulted -> resubmitted -> done lineage.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def resubmit_session(session):
+    keys = [k for k in ALL_ENTRIES
+            if k.startswith(("spark.rapids.tpu.faults.",
+                             "spark.rapids.tpu.sql.trace."))]
+    for k, v in FAST.items():
+        session.conf.set(k, v)
+    session.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+    yield session
+    for k in keys:
+        session.conf.unset(k)
+    INJECTOR.arm()
+
+
+def _rows(sess, table):
+    df = sess.create_dataframe(table)
+    return sorted(df.group_by("k").agg(
+        F.sum(F.col("v")).alias("s")).collect())
+
+
+class TestSchedulerResubmission:
+    def _flaky_query(self, sess, table, fail_times=1):
+        state = {"calls": 0}
+
+        def run():
+            out = _rows(sess, table)  # a real traced attempt
+            state["calls"] += 1
+            if state["calls"] <= fail_times:
+                # the shape a dead peer produces: a PermanentFault
+                # surfaced through the fast-fail protocol
+                transient_retry(None, "shuffle.fragment", lambda: (
+                    _ for _ in ()).throw(
+                        PeerLostError("rank 1 declared dead")))
+            return out
+
+        return run, state
+
+    def test_faulted_resubmitted_done_lineage(self, resubmit_session):
+        s = resubmit_session
+        table = pa.table({"k": [1, 2, 1, 2], "v": [1.0, 2.0, 3.0, 4.0]})
+        expect = _rows(s, table)
+        run, state = self._flaky_query(s, table, fail_times=1)
+        before = QueryStats.get().snapshot()
+        sched = s.scheduler()
+        base = sched.snapshot()["resubmitted"]
+        handle = s.submit(run, label="killed-peer-query")
+        assert handle.result(timeout=120) == expect
+        # lineage: the faulted attempt was resubmitted, the retry ran to
+        # done; the caller's one handle resolved with the final outcome
+        assert handle.status == "done"
+        assert handle.resubmits == 1
+        assert state["calls"] == 2
+        assert sched.snapshot()["resubmitted"] == base + 1
+        assert sched.running() == 0
+        # the faulted attempt's trace FINISHED with status 'resubmitted'
+        # linked forward; the retry's trace links back
+        attempts = handle.attempts
+        assert len(attempts) == 1
+        tr0 = attempts[0]["trace"]
+        assert tr0 is not None and tr0.t_end is not None
+        assert tr0.status == "resubmitted"
+        assert tr0.attrs["resubmitted_to"] == "killed-peer-query~r1"
+        tr1 = handle.trace()
+        assert tr1 is not None
+        assert tr1.attrs.get("resubmit_of") == "killed-peer-query"
+        assert tr1.status == "ok"
+        # stats reconciled: both attempts folded into the process
+        # aggregate; the resubmission itself is counted
+        d = QueryStats.delta_since(before)
+        assert d["queries_resubmitted"] == 1
+        get_catalog().assert_no_leaks()
+
+    def test_resubmit_budget_exhausts_to_faulted(self, resubmit_session):
+        s = resubmit_session
+        table = pa.table({"k": [1], "v": [1.0]})
+        run, state = self._flaky_query(s, table, fail_times=99)
+        handle = s.submit(run, label="always-dead")
+        with pytest.raises(QueryFaulted) as ei:
+            handle.result(timeout=120)
+        assert handle.status == "faulted"
+        assert ei.value.resubmittable
+        # default resubmit.max=1: one retry, then the typed failure
+        assert handle.resubmits == 1
+        assert state["calls"] == 2
+        get_catalog().assert_no_leaks()
+
+    def test_resubmit_disabled(self, resubmit_session):
+        s = resubmit_session
+        s.conf.set("spark.rapids.tpu.faults.resubmit.max", 0)
+        table = pa.table({"k": [1], "v": [1.0]})
+        run, state = self._flaky_query(s, table, fail_times=1)
+        handle = s.submit(run, label="no-resubmit")
+        with pytest.raises(QueryFaulted):
+            handle.result(timeout=120)
+        assert handle.status == "faulted"
+        assert handle.resubmits == 0
+        assert state["calls"] == 1
+
+    def test_ordinary_faults_not_resubmitted(self, resubmit_session):
+        """Transient exhaustion (NOT permanent-at-this-placement) keeps
+        its faulted status — resubmission is reserved for failures a new
+        placement can heal."""
+        s = resubmit_session
+
+        def run():
+            transient_retry(TpuConf(FAST), "io.read", lambda: (
+                _ for _ in ()).throw(OSError("EIO forever")))
+
+        handle = s.submit(run, label="transient-exhaustion")
+        with pytest.raises(QueryFaulted) as ei:
+            handle.result(timeout=120)
+        assert not ei.value.resubmittable
+        assert handle.resubmits == 0
+        assert handle.status == "faulted"
+
+
+# ---------------------------------------------------------------------------
+# Multi-process killed-peer chaos differential (the acceptance gate).
+# ---------------------------------------------------------------------------
+
+def _gen_shards(tmp_path, world, n=3000, seed=7):
+    import numpy as np
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(seed)
+    tables = []
+    for r in range(world):
+        t = pa.table({
+            "k": rng.integers(0, 37, n),
+            "s": pa.array([["red", "green", "blue", None][i]
+                           for i in rng.integers(0, 4, n)]),
+            "v": rng.normal(size=n).round(3),
+            "w": rng.normal(size=n).round(3),
+        })
+        pq.write_table(t, str(tmp_path / f"part-{r}.parquet"))
+        tables.append(t)
+    return pa.concat_tables(tables)
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_workers(tmp_path, world, query, kill_rank=-1, kill_mode="silent",
+                   kill_after=1):
+    port = _free_port()
+    out = str(tmp_path / "result")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    for r in range(world):
+        cmd = [sys.executable, os.path.join(REPO, "tests", "dcn_worker.py"),
+               "--rank", str(r), "--world", str(world), "--port", str(port),
+               "--data", str(tmp_path), "--out", out, "--query", query,
+               "--hb-interval", "0.2", "--hb-timeout", "2.0",
+               "--wait-timeout", "60"]
+        if kill_rank >= 0:
+            cmd += ["--kill-rank", str(kill_rank),
+                    "--kill-after", str(kill_after),
+                    "--kill-mode", kill_mode]
+        procs.append(subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    return procs, out
+
+
+@pytest.mark.slow
+class TestKilledPeerChaosDifferential:
+    @pytest.mark.parametrize("kill_mode", ["silent", "hard"])
+    def test_killed_peer_mid_shuffle_differential(self, tmp_path, session,
+                                                  kill_mode):
+        """Kill rank 2 of 3 mid-shuffle: survivors complete with results
+        IDENTICAL to the fault-free run, recovery accounting shows the
+        remote re-pulls + re-owned partitions, and recovery time stays
+        bounded by the liveness horizon, not the waitTimeout."""
+        world, kill_rank = 3, 2
+        whole = _gen_shards(tmp_path, world)
+
+        # fault-free oracle #1: the single-process engine over all shards
+        sess = srt.Session.get_or_create()
+        df = sess.create_dataframe(whole)
+        expect = (df.group_by("k", "s")
+                  .agg(F.sum(F.col("v")).alias("sv"),
+                       F.count_star().alias("c"),
+                       F.avg(F.col("w")).alias("aw")).collect())
+
+        # fault-free oracle #2: the SAME distributed engine with no kill
+        # (the differential's exact baseline — float combine order
+        # matches, so killed-run results must be IDENTICAL, unrounded)
+        procs, out0 = _spawn_workers(tmp_path, world, "simple")
+        for p in procs:
+            log = p.communicate(timeout=300)[0].decode()
+            assert p.returncode == 0, f"baseline worker:\n{log[-4000:]}"
+        with open(f"{out0}.0") as f:
+            baseline = json.load(f)
+        for r in range(world):
+            for suffix in ("", "stats."):
+                try:
+                    os.remove(f"{out0}.{suffix}{r}"
+                              if suffix else f"{out0}.{r}")
+                except OSError:
+                    pass
+
+        t0 = time.monotonic()
+        procs, out = _spawn_workers(tmp_path, world, "simple",
+                                    kill_rank=kill_rank,
+                                    kill_mode=kill_mode)
+        survivors = [p for r, p in enumerate(procs) if r != kill_rank]
+        logs = {}
+        for r, p in enumerate(procs):
+            if r == kill_rank:
+                continue
+            logs[r] = p.communicate(timeout=300)[0].decode()
+        elapsed = time.monotonic() - t0
+        # the killed rank: hard mode exited already; silent mode lingers
+        # as a zombie — reap it
+        killed = procs[kill_rank]
+        try:
+            killed.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            killed.kill()
+            killed.communicate(timeout=30)
+        for r, p in enumerate(procs):
+            if r != kill_rank:
+                assert p.returncode == 0, \
+                    f"survivor {r} failed:\n{logs[r][-4000:]}"
+
+        results = {}
+        stats = {}
+        for r in range(world):
+            if r == kill_rank:
+                assert not os.path.exists(f"{out}.{r}")
+                continue
+            with open(f"{out}.{r}") as f:
+                results[r] = json.load(f)
+            with open(f"{out}.stats.{r}") as f:
+                stats[r] = json.load(f)
+        survivors_r = sorted(results)
+        # every survivor returned the full, identical result
+        assert results[survivors_r[0]] == results[survivors_r[1]]
+
+        def key(r):
+            return (r[0], r[1] is None, str(r[1]))
+
+        def norm(rows, nd):
+            return sorted(
+                ((k, s, round(float(sv), nd), c, round(float(aw), nd))
+                 for k, s, sv, c, aw in rows), key=key)
+        # THE differential: killed peer -> answers IDENTICAL (exact, no
+        # rounding) to the fault-free distributed run — the adopted
+        # partitions' fragments combine in the same order the dead rank
+        # would have combined them
+        got = sorted(results[survivors_r[0]], key=key)
+        assert got == sorted(baseline, key=key)
+        # sanity vs the single-process oracle (float combine order
+        # differs across engines -> coarse rounding)
+        assert norm(results[survivors_r[0]], 4) == norm(expect, 4)
+        # recovery is attributable: the dead rank's fragments were
+        # re-pulled from durable map output and its partitions re-owned
+        total = {k: sum(s[k] for s in stats.values())
+                 for k in stats[survivors_r[0]]}
+        assert total["peers_lost"] >= 1
+        assert total["fragments_recomputed_remote"] >= 1
+        assert total["partitions_reowned"] >= 1
+        # bounded recovery: well under the 60 s waitTimeout path the old
+        # code would have burned per collective
+        assert elapsed < 240, f"recovery took {elapsed:.0f}s"

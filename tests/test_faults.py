@@ -594,16 +594,24 @@ class TestChaosDifferential:
         # identical results under faults
         assert faulted_rows == clean_rows
         assert faulted_back == clean_back
-        # the dcn leg of the schedule: a mini process group riding the
-        # same injection point (no ExecContext re-arms here)
+        # the dcn legs of the schedule: a mini process group riding the
+        # same injection points (no ExecContext re-arms here).
+        # dcn.heartbeat exercises the transient connect retry;
+        # dcn.peer_kill kills the rank (silent mode: heartbeats stop,
+        # peer server freezes, the rank's own query unwinds typed)
         s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
-        from spark_rapids_tpu.parallel.dcn import Coordinator, ProcessGroup
+        from spark_rapids_tpu.parallel.dcn import (Coordinator,
+                                                   PeerLostError,
+                                                   ProcessGroup)
         INJECTOR.arm(schedule="dcn.heartbeat:1")
         coord = Coordinator(1)
         try:
             pg = ProcessGroup(0, 1, ("127.0.0.1", coord.port),
                               coordinator=coord)
             pg.barrier()
+            INJECTOR.arm(schedule="dcn.peer_kill:1")
+            with pytest.raises(PeerLostError, match="killed"):
+                pg.note_op()
             pg.close()
         finally:
             INJECTOR.arm()

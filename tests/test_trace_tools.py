@@ -67,6 +67,27 @@ def test_trace_report_main(sess, tmp_path, capsys):
     assert trace_report.main([]) == 2
 
 
+def test_trace_report_peer_fault_summary(sess, tmp_path):
+    """A query that survived distributed failures gets a peers: line
+    (QueryStats snapshot on the root event is authoritative); clean
+    queries don't."""
+    path = _trace_file(sess, tmp_path)
+    data = trace_report.load(path)
+    assert "peers:" not in trace_report.format_report(
+        trace_report.analyze(data))
+    for e in data["traceEvents"]:
+        if e.get("cat") == "query":
+            e.setdefault("args", {}).update({
+                "peers_lost": 1, "fragments_recomputed_remote": 8,
+                "partitions_reowned": 4, "queries_resubmitted": 1})
+    a = trace_report.analyze(data)
+    assert a["peers_lost"] == 1
+    assert a["fragments_recomputed_remote"] == 8
+    out = trace_report.format_report(a)
+    assert ("peers: lost=1 remote_recomputed=8 reowned=4 "
+            "resubmissions=1") in out
+
+
 def test_trace_report_merged_concurrent(sess, tmp_path, capsys):
     """A merged multi-query trace renders per-query sections plus a
     contention summary instead of assuming one serial query."""

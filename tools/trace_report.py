@@ -250,6 +250,18 @@ def analyze(data: dict) -> dict:
         "degraded_batches": int(qargs.get("degraded_batches",
                                           _fname("degraded:cpu"))),
         "retry_backoff_s": float(qargs.get("retry_backoff_s", 0.0)),
+        # distributed failure survival (peer:lost /
+        # fragment:remote_repull / query:resubmitted marks; QueryStats
+        # snapshot on the root event authoritative when present)
+        "peers_lost": int(qargs.get("peers_lost", _fname("peer:lost"))),
+        "fragments_recomputed_remote": int(qargs.get(
+            "fragments_recomputed_remote",
+            _fname("fragment:remote_repull"))),
+        "partitions_reowned": int(qargs.get("partitions_reowned", sum(
+            e.get("args", {}).get("adopted", 0) for e in fault_events
+            if e.get("name") == "peer:lost"))),
+        "queries_resubmitted": int(qargs.get(
+            "queries_resubmitted", _fname("query:resubmitted"))),
     }
 
 
@@ -302,6 +314,18 @@ def format_report(a: dict) -> str:
             f"recomputed={a['fragments_recomputed']} "
             f"degraded={a['degraded_batches']} "
             f"backoff={a['retry_backoff_s'] * 1e3:.1f}ms")
+    # peer-fault summary only when the query survived distributed
+    # failures (a killed peer, remote fragment recovery, resubmission)
+    peer = (a.get("peers_lost", 0)
+            + a.get("fragments_recomputed_remote", 0)
+            + a.get("partitions_reowned", 0)
+            + a.get("queries_resubmitted", 0))
+    if peer:
+        lines.append(
+            f"peers: lost={a['peers_lost']} "
+            f"remote_recomputed={a['fragments_recomputed_remote']} "
+            f"reowned={a['partitions_reowned']} "
+            f"resubmissions={a['queries_resubmitted']}")
     return "\n".join(lines)
 
 
