@@ -33,6 +33,12 @@ are verified equal to the serial run and per-query QueryStats must
 reconcile with the process aggregate.  Defaults to the TPC-H 22; with
 SRT_BENCH_TRACE_DIR also writes a merged concurrent.trace.json whose
 per-query sections + contention summary tools/trace_report.py renders),
+SRT_BENCH_GRAY_RATE=R (gray-chaos knob: replay the timed pass with
+seeded SILENT CORRUPTION at the shuffle/spill/cache byte paths —
+integrity detection + recovery columns (integrity_failures,
+fragments_hedged, re-pulls) land next to the clean numbers, results
+still oracle-verified).
+
 SRT_BENCH_FAULT_RATE=R (chaos knob: after the clean numbers, replay the
 timed pass with spark.rapids.tpu.faults.inject.rate=R — every injection
 point fails with probability R, seeded so runs replay — and report the
@@ -179,8 +185,45 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
         finally:
             sess.conf.unset("spark.rapids.tpu.faults.inject.rate")
             sess.conf.unset("spark.rapids.tpu.faults.inject.seed")
+    # gray-chaos pass: the same query under seeded GRAY injection
+    # (silent corruption at the shuffle/spill/cache byte paths) — the
+    # integrity layer must catch every flipped bit and route it into
+    # recovery with the answer still oracle-identical; the recovery
+    # columns show what the detection + re-pull cost
+    gray_rate = float(os.environ.get("SRT_BENCH_GRAY_RATE", "0") or 0)
+    gray = {}
+    if gray_rate > 0:
+        sess.conf.set("spark.rapids.tpu.faults.inject.rate", gray_rate)
+        sess.conf.set("spark.rapids.tpu.faults.inject.points",
+                      "shuffle.corrupt,spill.corrupt,cache.corrupt")
+        sess.conf.set("spark.rapids.tpu.faults.inject.seed", 20260804)
+        try:
+            g0 = QueryStats.get().snapshot()
+            gray_rows = runner(dfs)
+            gray_s = _time(lambda: runner(dfs), iters)
+            g_stats = QueryStats.delta_since(g0)
+            gray = {
+                "gray_rate": gray_rate,
+                "engine_s_gray": round(gray_s, 5),
+                "gray_slowdown": round(gray_s / engine_s, 4),
+                "gray_rel_err": tpch_suite.rows_rel_err(
+                    gray_rows, cpu_rows),
+                "integrity_failures": g_stats["integrity_failures"],
+                "fragments_hedged": g_stats["fragments_hedged"],
+                "gray_fragments_recomputed":
+                    g_stats["fragments_recomputed"],
+                "gray_cache_misses": g_stats["cache_misses"],
+            }
+            assert gray["gray_rel_err"] < 1e-6, \
+                f"{name} result mismatch UNDER GRAY FAULTS " \
+                f"(rel_err={gray['gray_rel_err']})"
+        finally:
+            sess.conf.unset("spark.rapids.tpu.faults.inject.rate")
+            sess.conf.unset("spark.rapids.tpu.faults.inject.points")
+            sess.conf.unset("spark.rapids.tpu.faults.inject.seed")
     return {
         **faulted,
+        **gray,
         "speedup": round(cpu_s / engine_s, 4),
         "engine_s": round(engine_s, 5),
         "engine_cold_s": round(cold_s, 5),

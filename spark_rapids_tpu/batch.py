@@ -565,7 +565,7 @@ def to_arrow_async(batch: ColumnBatch):
     fut = _afetch(fetch) if fetch else None
 
     def finish():
-        return _to_arrow_finish(batch, fut.result() if fut is not None
+        return _to_arrow_finish(batch, fut.result() if fut is not None  # wait-ok (async D2H already in flight; an in-query wedge is the watchdog's to reclaim)
                                 else {})
     return finish
 

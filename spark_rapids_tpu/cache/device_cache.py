@@ -121,7 +121,23 @@ class CachedBuildHandle:
         self._closed = False
 
     def get(self):
-        return self.cache_entry.handles[0].get()
+        from ..faults.integrity import IntegrityFault
+        from ..faults.recovery import QueryFaulted
+        try:
+            return self.cache_entry.handles[0].get()
+        except IntegrityFault as ex:
+            # a spilled build entry whose crc failed at
+            # re-materialization: drop it so no FUTURE lookup hits it,
+            # then fail this query typed + resubmittable — the retry
+            # misses and rebuilds from source.  (A lazy hit cannot
+            # degrade to a miss: the join already holds this handle.)
+            with self._cache._lock:
+                if not self.cache_entry.dead:
+                    self._cache._drop(self.cache_entry, "integrity")
+            raise QueryFaulted(
+                "cache", f"cached broadcast build is corrupt ({ex}); "
+                f"entry dropped — a resubmission rebuilds from source",
+                resubmittable=True) from ex
 
     def close(self) -> None:
         if not self._closed:
@@ -267,6 +283,11 @@ class QueryCache:
                 return None
             entry.refs += 1  # pin across the (unlocked) materialization
         try:
+            from ..faults import integrity
+            from ..faults.injector import INJECTOR
+            if INJECTOR.maybe_fire("cache.corrupt", desc="scan"):
+                integrity.fail(f"cache scan entry {key.group()}",
+                               point="cache")
             spilled = any(h.state != h.DEVICE for h in entry.handles)
             names = list(key.cols) if key.cols is not None else None
             out: list = []
@@ -282,6 +303,17 @@ class QueryCache:
                 # accounting, and donatable stays False (shared arrays)
                 out.append(ColumnBatch(schema, cols, b.num_rows, b.sel))
                 served += batch_bytes(out[-1])
+        except integrity.IntegrityFault:
+            # corrupt cache entry (injected, or a spilled copy whose crc
+            # failed at re-materialization): DROP it and serve a MISS —
+            # the caller recomputes from source; a poisoned hit is the
+            # one outcome a cache must never produce
+            with self._lock:
+                entry.refs -= 1
+                if not entry.dead:
+                    self._drop(entry, "integrity")
+            self._miss(op_id, "scan")
+            return None
         except BaseException:
             self.release(entry)
             raise
@@ -347,6 +379,20 @@ class QueryCache:
             if entry is not None and self._expired(entry):
                 self._drop(entry, "ttl")
                 entry = None
+            if entry is not None:
+                from ..faults.injector import INJECTOR
+                if INJECTOR.maybe_fire("cache.corrupt", desc="broadcast"):
+                    # injected corrupt build entry: drop-and-miss (the
+                    # query materializes its own build — never a
+                    # poisoned join side)
+                    self._drop(entry, "integrity")
+                    entry = None
+                    from ..faults import integrity
+                    try:
+                        integrity.fail(f"cache broadcast entry "
+                                       f"{key.group()}", point="cache")
+                    except integrity.IntegrityFault:
+                        pass  # accounted; serve the miss below
             if entry is None:
                 self._miss(op_id, "broadcast")
                 return None

@@ -605,7 +605,10 @@ FAULTS_INJECT_SCHEDULE = register(
     "Deterministic fault-injection schedule: comma list of "
     "'point:N[:K]' entries — fail invocations N..N+K-1 (1-based) at "
     "the named point (io.read, io.write, shuffle.fragment, "
-    "dcn.heartbeat, device.op, cache.lookup, dcn.peer_kill). Counters "
+    "dcn.heartbeat, device.op, cache.lookup, dcn.peer_kill, plus the "
+    "gray points shuffle.corrupt, spill.corrupt, cache.corrupt, "
+    "device.hang, dcn.slow_peer — gray points corrupt/wedge/delay "
+    "instead of raising). Counters "
     "reset per query. Empty disables. The chaos differential suite "
     "proves results under a schedule equal the fault-free run; "
     "dcn.peer_kill:N kills THIS rank at its Nth shuffle op "
@@ -624,13 +627,71 @@ FAULTS_INJECT_RATE = register(
 FAULTS_INJECT_POINTS = register(
     "spark.rapids.tpu.faults.inject.points", "",
     "Comma list restricting rate-based injection to these points "
-    "(empty = all six registered points). Deterministic schedule "
-    "entries name their points explicitly.")
+    "(empty = every registered point, gray ones included). "
+    "Deterministic schedule entries name their points explicitly.")
 
 FAULTS_INJECT_SEED = register(
     "spark.rapids.tpu.faults.inject.seed", 0,
     "Seed for the injection RNG (probabilistic rate draws AND the "
     "retry backoff jitter), making chaos runs reproducible.")
+
+FAULTS_INTEGRITY_ENABLED = register(
+    "spark.rapids.tpu.faults.integrity.enabled", True,
+    "Verify the checksum stamped on every durable byte path — spill "
+    "files, host-shuffle frames and durable map output, DCN fragment "
+    "transfers, and atomic-writer output sidecars (faults/integrity"
+    ".py). A mismatch is a typed IntegrityFault converted into the "
+    "existing recovery vocabulary: corrupt shuffle fragment -> re-pull "
+    "from durable map output, corrupt cache entry -> drop-and-miss, "
+    "corrupt spill file backing live state -> QueryFaulted "
+    "(resubmittable). Stamping itself is always on (one crc32 over "
+    "bytes already in motion); this gates only verification.")
+
+FAULTS_WATCHDOG_ENABLED = register(
+    "spark.rapids.tpu.faults.watchdog.enabled", True,
+    "Per-query progress watchdog for scheduler-run queries (service/"
+    "watchdog.py): fed by the batch-pull checkpoints every operator "
+    "already passes, it escalates a query making no progress for "
+    "faults.watchdog.stallMs — stack-dump mark in the trace, then "
+    "cooperative cancel, then faulted(resubmittable) with the running "
+    "slot and semaphore permit reclaimed — so a hung D2H fetch or "
+    "wedged DCN wait can never strand a scheduler permit forever.")
+
+FAULTS_WATCHDOG_STALL_MS = register(
+    "spark.rapids.tpu.faults.watchdog.stallMs", 30000.0,
+    "How long an admitted query may go without producing a batch (or "
+    "passing any batch-pull checkpoint) before the watchdog declares "
+    "it stalled and escalates. The floor is one slow-but-honest batch; "
+    "detection lands within stallMs + one watchdog poll.",
+    conv=float, check=lambda v: None if v > 0 else "must be > 0")
+
+FAULTS_HEDGE_ENABLED = register(
+    "spark.rapids.tpu.faults.hedge.enabled", True,
+    "Hedge DCN shuffle-fragment fetches against slow peers (parallel/"
+    "dcn.py): per-peer response times are tracked, a peer whose "
+    "replies exceed faults.hedge.quantileMs is declared SLOW (distinct "
+    "from declared-dead), and a fetch still pending at the hedge "
+    "horizon starts a parallel read of the peer's durable map output — "
+    "first result wins, the loser is abandoned (fragments_hedged).")
+
+FAULTS_HEDGE_QUANTILE_MS = register(
+    "spark.rapids.tpu.faults.hedge.quantileMs", 1000.0,
+    "Hedge horizon in milliseconds: a remote fragment fetch still "
+    "pending after this long races a durable-map-output read; a peer "
+    "answering slower than this is declared slow and subsequent "
+    "fetches hedge immediately. Tune toward a high quantile of the "
+    "observed fetch latency (the classic tail-at-scale hedge).",
+    conv=float, check=lambda v: None if v > 0 else "must be > 0")
+
+FAULTS_DCN_GC_ORPHAN_FRAMES_MS = register(
+    "spark.rapids.tpu.faults.dcn.gcOrphanFramesMs", 600000.0,
+    "Age threshold for sweeping orphaned shuffle frame directories "
+    "from the spill dir when a new DCN shuffle starts. Killed ranks "
+    "deliberately leave their frame files behind (they are the durable "
+    "map output survivors re-pull), so chaos runs accumulate them; "
+    "the sweep removes shuffle-* dirs untouched for this long. "
+    "0 disables.", conv=float,
+    check=lambda v: None if v >= 0 else "must be >= 0")
 
 FAULTS_RESUBMIT_MAX = register(
     "spark.rapids.tpu.faults.resubmit.max", 1,

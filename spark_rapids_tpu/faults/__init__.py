@@ -7,11 +7,17 @@ because there is no Spark task framework underneath to re-execute the
 work.  This package is that missing resilience layer, split in two:
 
   * :mod:`.injector` — the ONE place faults enter the engine on purpose:
-    a seeded, conf-driven :class:`FaultInjector` with six named injection
-    points (``io.read``, ``io.write``, ``shuffle.fragment``,
-    ``dcn.heartbeat``, ``device.op``, ``cache.lookup``), supporting
-    deterministic schedules ("fail the Nth op at point P") and
-    probabilistic rates for chaos runs;
+    a seeded, conf-driven :class:`FaultInjector` with named injection
+    points (see :data:`POINTS`) covering fail-stop faults (``io.read``,
+    ``io.write``, ``shuffle.fragment``, ``dcn.heartbeat``,
+    ``device.op``, ``cache.lookup``, ``dcn.peer_kill``) AND gray ones
+    (``shuffle.corrupt``, ``spill.corrupt``, ``cache.corrupt``,
+    ``device.hang``, ``dcn.slow_peer``), supporting deterministic
+    schedules ("fail the Nth op at point P") and probabilistic rates
+    for chaos runs;
+  * :mod:`.integrity` — checksums stamped on every durable byte path
+    (spill files, shuffle frames, DCN fragments, writer output) with
+    verification failures converted into the recovery vocabulary below;
   * :mod:`.recovery` — the typed recovery layer every transient-fault
     call site routes through: :func:`transient_retry` (exponential
     backoff + jitter + per-query retry budgets), :func:`device_guard`
@@ -25,13 +31,16 @@ ad-hoc sleeps and swallowed exceptions cannot silently reappear.
 """
 
 from .injector import INJECTOR, FaultInjector, InjectedFault, POINTS
+from .integrity import IntegrityFault, checksum, verify
 from .recovery import (FaultRecord, PermanentFault, QueryFaulted,
                        TransientFault, backoff_delays, budget_scope,
-                       device_guard, recovery_enabled, transient_retry)
+                       check_disk_full, device_guard, recovery_enabled,
+                       transient_retry)
 
 __all__ = [
     "INJECTOR", "FaultInjector", "InjectedFault", "POINTS",
     "TransientFault", "PermanentFault", "QueryFaulted", "FaultRecord",
+    "IntegrityFault", "checksum", "verify",
     "transient_retry", "device_guard", "budget_scope",
-    "backoff_delays", "recovery_enabled",
+    "backoff_delays", "recovery_enabled", "check_disk_full",
 ]

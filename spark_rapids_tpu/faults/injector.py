@@ -45,8 +45,22 @@ __all__ = ["POINTS", "InjectedFault", "FaultInjector", "INJECTOR"]
 # and kills the rank (silent heartbeat stop, or a hard process kill
 # under spark.rapids.tpu.dcn.kill.mode=hard), driving the killed-peer
 # chaos differential deterministically ("kill rank R after N ops").
+#
+# The GRAY points (ISSUE 7) do not raise at all — call sites consult
+# :meth:`FaultInjector.maybe_fire` and ACT the gray failure out:
+#   * ``shuffle.corrupt`` / ``spill.corrupt`` — flip one bit in the
+#     payload so the integrity layer (faults/integrity.py) must catch
+#     it and route recovery;
+#   * ``cache.corrupt`` — treat the found cache entry as corrupt
+#     (drop-and-miss, never a poisoned hit);
+#   * ``device.hang`` — wedge the dispatch until cancelled (the
+#     watchdog's prey: no batch progress, no exception);
+#   * ``dcn.slow_peer`` — the peer server answers, but late (the
+#     straggler-hedging prey: slow is not dead).
 POINTS = ("io.read", "io.write", "shuffle.fragment", "dcn.heartbeat",
-          "device.op", "cache.lookup", "dcn.peer_kill")
+          "device.op", "cache.lookup", "dcn.peer_kill",
+          "shuffle.corrupt", "spill.corrupt", "cache.corrupt",
+          "device.hang", "dcn.slow_peer")
 
 
 class InjectedFault(TransientFault):
@@ -146,12 +160,14 @@ class FaultInjector:
             return 0.5 + 0.5 * self._rng.random()
 
     # -- the injection check --------------------------------------------------------
-    def maybe_raise(self, point: str, desc: str = "") -> None:
-        """Count one invocation at ``point``; raise :class:`InjectedFault`
-        when the schedule or the chaos rate selects it."""
+    def _select(self, point: str) -> int:
+        """Count one invocation at ``point``; return the (1-based)
+        invocation number when the schedule or chaos rate selects it,
+        else 0.  Accounting (stats + trace mark) is the caller's —
+        through :meth:`maybe_raise` or :meth:`maybe_fire`."""
         with self._lock:
             if not self._sched and self._rate <= 0.0:
-                return
+                return 0
             n = self._counts.get(point, 0) + 1
             self._counts[point] = n
             fire = any(first <= n < first + count
@@ -159,16 +175,38 @@ class FaultInjector:
             if not fire and self._rate > 0.0 and point in self._rate_points:
                 fire = self._rng.random() < self._rate
             if not fire:
-                return
+                return 0
             self.injected_total[point] += 1
+            return n
+
+    def _account(self, point: str, n: int, desc: str) -> None:
         from ..utils import tracing
         from ..utils.metrics import QueryStats
         QueryStats.get().faults_injected += 1
         tracing.mark(None, "fault:injected", "fault", point=point, n=n,
                      desc=desc)
+
+    def maybe_raise(self, point: str, desc: str = "") -> None:
+        """Count one invocation at ``point``; raise :class:`InjectedFault`
+        when the schedule or the chaos rate selects it."""
+        n = self._select(point)
+        if not n:
+            return
+        self._account(point, n, desc)
         raise InjectedFault(
             f"injected fault at {point} (invocation {n}"
             + (f", {desc}" if desc else "") + ")", point=point)
+
+    def maybe_fire(self, point: str, desc: str = "") -> bool:
+        """The GRAY-point check: count one invocation and return True
+        when selected — the call site then ACTS the failure out
+        (corrupt the payload, wedge the dispatch, delay the reply)
+        instead of raising, because gray failures don't raise."""
+        n = self._select(point)
+        if not n:
+            return False
+        self._account(point, n, desc)
+        return True
 
     # -- introspection --------------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:

@@ -1,0 +1,201 @@
+"""End-to-end data integrity: checksums on every durable byte path.
+
+Theseus (PAPERS.md) treats data movement as the first-class axis of a
+distributed accelerator engine; the gray-failure corollary is that
+every movement edge can silently corrupt bytes — disk bit-rot under a
+spill file, a torn shuffle frame, a flipped bit on the wire during a
+DCN fragment transfer.  Fail-stop recovery (PR 5/6) never notices: the
+bytes arrive, they are just *wrong*.
+
+This module is the one place checksums are computed and verified:
+
+  * :func:`checksum` — crc32c when the native wheel is present, else
+    stdlib ``zlib.crc32`` (same 32-bit width, same call sites — the
+    algorithm is an implementation detail, the *stamp* is the contract);
+  * :func:`verify` — compares and, on mismatch, counts
+    ``QueryStats.integrity_failures``, lands an ``integrity:fault``
+    trace mark, and raises :class:`IntegrityFault`;
+  * sidecar helpers (:func:`write_sidecar` / :func:`verify_file`) for
+    whole output files the atomic writers publish (the Hadoop
+    ``.file.crc`` idiom — dot-prefixed, so file listings and pyarrow
+    dataset discovery skip them).
+
+:class:`IntegrityFault` IS-A :class:`..faults.recovery.TransientFault`,
+which is the design's load-bearing move: a verification failure is
+*converted into the already-built recovery vocabulary* instead of
+growing a new one —
+
+  * corrupt shuffle frame / DCN fragment → the surrounding
+    ``transient_retry(point="shuffle.fragment")`` re-pulls from the
+    durable map output (``fragments_recomputed``);
+  * corrupt cache entry → the cache drops it and reports a MISS
+    (recompute; never a poisoned hit);
+  * corrupt spill file backing live query state → no durable copy
+    exists, so it fails typed ``QueryFaulted(resubmittable=True)``
+    (permanent at this placement — a resubmission recomputes);
+  * corrupt written file detected at scan → ``io.read`` retries, then
+    typed exhaustion.
+
+Stamping is always on (one crc32 over bytes already being moved);
+VERIFICATION is gated by ``spark.rapids.tpu.faults.integrity.enabled``
+so a corrupted-but-tolerable forensic read stays possible.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Optional
+
+from .recovery import TransientFault, _resolve_conf
+
+__all__ = ["IntegrityFault", "checksum", "verify", "enabled", "flip",
+           "sidecar_path", "write_sidecar", "verify_file", "CRC_IMPL"]
+
+try:  # the native wheel, when the image carries it (never required)
+    import google_crc32c as _crc32c_mod
+
+    def _crc(data) -> int:
+        return _crc32c_mod.value(bytes(data))
+
+    CRC_IMPL = "crc32c"
+except Exception:  # fault-ok (optional dependency probe; zlib is the contract's floor)
+    def _crc(data) -> int:
+        return zlib.crc32(data) & 0xFFFFFFFF
+
+    CRC_IMPL = "zlib-crc32"
+
+
+class IntegrityFault(TransientFault):
+    """Bytes came back different from what was stamped.  A
+    :class:`TransientFault` so existing retry/re-pull drivers treat a
+    corrupt frame exactly like a lost one; sites with no durable copy
+    to re-pull convert it to a typed, resubmittable ``QueryFaulted``."""
+
+    def __init__(self, message: str, point: Optional[str] = None,
+                 expected: int = 0, actual: int = 0):
+        super().__init__(message, point=point)
+        self.expected = expected
+        self.actual = actual
+
+
+def checksum(data) -> int:
+    """32-bit checksum of ``data`` (bytes/memoryview/bytearray)."""
+    return _crc(data)
+
+
+def enabled(conf=None) -> bool:
+    """Is verification on?  Resolves the running query's conf through
+    the fault budget scope like the rest of the recovery layer."""
+    return _resolve_conf(conf)["spark.rapids.tpu.faults.integrity.enabled"]
+
+
+def verify(data, expected: int, what: str,
+           point: str = "integrity", conf=None) -> None:
+    """Verify ``data`` against its stamped checksum; a mismatch counts
+    ``integrity_failures``, marks the trace, and raises
+    :class:`IntegrityFault`.  ``expected=0`` (an unstamped legacy frame)
+    and verification-disabled confs pass through."""
+    if not expected or not enabled(conf):
+        return
+    actual = _crc(data)
+    if actual == expected:
+        return
+    from ..utils import tracing
+    from ..utils.metrics import QueryStats
+    QueryStats.get().integrity_failures += 1
+    tracing.mark(None, "integrity:fault", "fault", point=point, what=what,
+                 expected=expected, actual=actual, bytes=len(data))
+    raise IntegrityFault(
+        f"integrity check failed for {what}: stamped crc {expected:#010x}"
+        f" != computed {actual:#010x} over {len(data)} byte(s)",
+        point=point, expected=expected, actual=actual)
+
+
+def fail(what: str, point: str = "integrity") -> None:
+    """Report a corruption detected by means other than a direct crc
+    compare (an injected corrupt cache entry, a structural mismatch):
+    same accounting as :func:`verify`, then :class:`IntegrityFault`."""
+    from ..utils import tracing
+    from ..utils.metrics import QueryStats
+    QueryStats.get().integrity_failures += 1
+    tracing.mark(None, "integrity:fault", "fault", point=point, what=what)
+    raise IntegrityFault(f"integrity check failed for {what}",
+                         point=point)
+
+
+def flip(data: bytes) -> bytes:
+    """Corrupt one bit (chaos injection helper for the ``*.corrupt``
+    points): the smallest gray fault a checksum must catch."""
+    if not data:
+        return data
+    b = bytearray(data)
+    b[len(b) // 2] ^= 0x01
+    return bytes(b)
+
+
+# ---------------------------------------------------------------------------------
+# Whole-file sidecars (atomic writer output).
+# ---------------------------------------------------------------------------------
+
+def sidecar_path(path: str) -> str:
+    """Hadoop-idiom checksum sidecar: dot-prefixed (file listings and
+    pyarrow dataset discovery skip it), next to the data file."""
+    d, name = os.path.split(path)
+    return os.path.join(d, f".{name}.crc")
+
+
+def file_checksum(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_sidecar(data_path: str, final_path: Optional[str] = None) -> int:
+    """Stamp ``data_path``'s checksum into a sidecar named for
+    ``final_path`` (the atomic writers checksum the ``.inprogress`` temp
+    but publish under the final name).  Returns the crc."""
+    crc = file_checksum(data_path)
+    side = sidecar_path(final_path or data_path)
+    with open(side, "w") as f:
+        f.write(f"{crc:#010x} {os.path.getsize(data_path)}\n")
+    return crc
+
+
+def verify_file(path: str, conf=None) -> None:
+    """Verify a data file against its sidecar when one exists (files
+    written by anything other than this engine's writers have none and
+    pass through untouched)."""
+    if not enabled(conf):
+        return
+    side = sidecar_path(path)
+    try:
+        with open(side) as f:
+            stamped = int(f.read().split()[0], 16)
+    except (OSError, ValueError, IndexError):
+        return  # no (or unreadable) sidecar: nothing was stamped
+    actual = file_checksum(path)
+    if actual == stamped:
+        return
+    from ..utils import tracing
+    from ..utils.metrics import QueryStats
+    QueryStats.get().integrity_failures += 1
+    tracing.mark(None, "integrity:fault", "fault", point="io.read",
+                 what=path, expected=stamped, actual=actual)
+    raise IntegrityFault(
+        f"integrity check failed for {path}: sidecar crc {stamped:#010x}"
+        f" != computed {actual:#010x}", point="io.read",
+        expected=stamped, actual=actual)
+
+
+def remove_sidecar(path: str) -> None:
+    """Drop the sidecar with its data file (overwrite/cleanup paths)."""
+    try:
+        os.unlink(sidecar_path(path))
+    except OSError:
+        pass

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import errno
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
@@ -42,7 +43,7 @@ from typing import Callable, List, Optional, Tuple
 __all__ = ["TransientFault", "PermanentFault", "QueryFaulted",
            "FaultRecord", "transient_retry", "device_guard",
            "budget_scope", "backoff_delays", "recovery_enabled",
-           "RETRYABLE"]
+           "check_disk_full", "RETRYABLE"]
 
 
 class TransientFault(RuntimeError):
@@ -122,6 +123,23 @@ RETRYABLE = {
 
 _NON_RETRYABLE = (FileNotFoundError,)
 
+# disk-full errnos: a FULL disk does not heal on the retry-backoff
+# curve — the spill/write paths type it PermanentFault so the query
+# fast-fails resubmittable (a different placement may have room)
+# instead of burning the per-query retry budget against ENOSPC
+_DISK_FULL_ERRNOS = (errno.ENOSPC, getattr(errno, "EDQUOT", errno.ENOSPC))
+
+
+def check_disk_full(ex: BaseException, point: str) -> None:
+    """Re-raise an ENOSPC/EDQUOT ``OSError`` as a typed
+    :class:`PermanentFault` (the spill and atomic-writer paths call
+    this from their except blocks).  Any other exception passes
+    through untouched for the caller's own handling."""
+    if isinstance(ex, OSError) and ex.errno in _DISK_FULL_ERRNOS:
+        raise PermanentFault(
+            f"disk full at {point}: {ex} — fast-failing resubmittable "
+            f"instead of retrying against a full disk", point=point) from ex
+
 
 # ---------------------------------------------------------------------------------
 # Per-query retry budget (contextvar-scoped; worker threads run copied
@@ -190,12 +208,15 @@ def recovery_enabled(ctx=None) -> bool:
 
 def _backoff_s(conf, attempt: int) -> float:
     """Capped exponential backoff with seeded jitter for ``attempt``
-    (1-based)."""
+    (1-based).  The exponent is clamped: a long-lived wait loop riding
+    this curve (the coordinator's barrier re-check cadence) can reach
+    attempt counts where ``mult ** attempt`` overflows float range —
+    past ~64 doublings the result is beyond any cap regardless."""
     from .injector import INJECTOR
     base = conf["spark.rapids.tpu.faults.backoff.baseMs"]
     cap = conf["spark.rapids.tpu.faults.backoff.maxMs"]
     mult = conf["spark.rapids.tpu.faults.backoff.multiplier"]
-    raw = min(cap, base * (mult ** max(0, attempt - 1)))
+    raw = min(cap, base * (mult ** min(64, max(0, attempt - 1))))
     return (raw / 1000.0) * INJECTOR.jitter()
 
 
@@ -325,6 +346,27 @@ def transient_retry(ctx, point: str, fn: Callable, *args,
             _sleep(delay)
 
 
+def _simulate_hang(conf, op_id: str) -> None:
+    """The ``device.hang`` gray injection: wedge this dispatch the way a
+    hung D2H fetch or a stuck XLA program would — no exception, no batch
+    progress.  Under a query control the hang holds until the watchdog's
+    cooperative cancel (or the caller's own) wakes it and raises; with
+    no control installed it self-bounds at 2× the watchdog stall window
+    so an unscheduled chaos run cannot wedge forever.
+    """
+    from ..service import cancel
+    from ..utils import tracing
+    tracing.mark(op_id, "device:hang", "fault", point="device.hang")
+    limit_s = max(0.05,
+                  conf["spark.rapids.tpu.faults.watchdog.stallMs"] / 500.0)
+    ctl = cancel.current()
+    if ctl is not None:
+        if ctl.cancelled.wait(timeout=limit_s * 20):
+            ctl.raise_()  # the watchdog (or caller) reclaimed the query
+        return  # pathological: no cancel ever arrived — un-wedge
+    time.sleep(limit_s)
+
+
 # ---------------------------------------------------------------------------------
 # Device-op guard: bounded retries, then degrade to the CPU path.
 # ---------------------------------------------------------------------------------
@@ -366,6 +408,11 @@ def device_guard(ctx, op_id: str, fn: Callable,
     attempt = 0
     while True:
         try:
+            if INJECTOR.maybe_fire("device.hang", desc=op_id):
+                # gray failure: the dispatch WEDGES instead of raising —
+                # the per-query watchdog (service/watchdog.py) is the
+                # layer that must notice the stalled batch cadence
+                _simulate_hang(conf, op_id)
             INJECTOR.maybe_raise("device.op", desc=op_id)
             return fn()
         except BaseException as ex:

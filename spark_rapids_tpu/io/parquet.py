@@ -421,10 +421,18 @@ class ParquetSource:
         # io.read injection/recovery point: the file open + footer parse
         # is where flaky storage surfaces (EIO, dropped NFS/object-store
         # connections) — transient failures retry with backoff; a
-        # missing file is NOT transient and raises straight through
+        # missing file is NOT transient and raises straight through.
+        # Files this engine's writers published carry a crc sidecar:
+        # verify INSIDE the retry scope, so a transiently corrupt read
+        # re-reads and a persistently corrupt file exhausts typed.
+        from ..faults import integrity
         from ..faults.recovery import transient_retry
-        pf = transient_retry(None, "io.read", pq.ParquetFile, path,
-                             desc=path)
+
+        def _verified_open(p=path):
+            integrity.verify_file(p)
+            return pq.ParquetFile(p)
+
+        pf = transient_retry(None, "io.read", _verified_open, desc=path)
         skips = self.skip_rows.get(path)
         if skips is not None and len(skips) == 0:
             skips = None

@@ -105,10 +105,17 @@ class FileSource:
     def _read_file(self, path: str) -> Iterator:
         # io.read injection/recovery point (same contract as
         # ParquetSource._read_file): the whole-file host parse retries
-        # transient storage failures with backoff
+        # transient storage failures with backoff; files our writers
+        # published are crc-verified against their sidecar inside the
+        # retry scope
+        from ..faults import integrity
         from ..faults.recovery import transient_retry
-        t = transient_retry(None, "io.read", self._load_table, path,
-                            desc=path)
+
+        def _verified_load(p=path):
+            integrity.verify_file(p)
+            return self._load_table(p)
+
+        t = transient_retry(None, "io.read", _verified_load, desc=path)
         if self.columns is not None:
             t = t.select([c for c in self.columns if c in t.column_names])
         if self.predicates:

@@ -98,9 +98,17 @@ class _RollingFileWriter:
             # before the stream write and retries safely; a real write
             # error is not retried in place (a re-run could duplicate
             # rows mid-stream) — it propagates, and atomicity above
-            # guarantees the partial file is never published
-            transient_retry(None, "io.write", self._write_chunk, chunk,
-                            desc=self._path or self.dir)
+            # guarantees the partial file is never published.  A FULL
+            # disk is typed PermanentFault: retrying against ENOSPC
+            # cannot help, so the query fast-fails resubmittable
+            # instead of burning the retry-backoff budget.
+            try:
+                transient_retry(None, "io.write", self._write_chunk,
+                                chunk, desc=self._path or self.dir)
+            except OSError as ex:
+                from ..faults.recovery import check_disk_full
+                check_disk_full(ex, "io.write")
+                raise
             self._rows_in_file += take
             self.stats.num_rows += take
             offset += take
@@ -110,7 +118,16 @@ class _RollingFileWriter:
     def close(self, abort: bool = False) -> None:
         if self._writer is not None:
             try:
-                self._writer.close()
+                try:
+                    self._writer.close()
+                except OSError as ex:
+                    # a full disk at flush/footer time is permanent at
+                    # this placement — type it so the query fast-fails
+                    # resubmittable (the abort path below still runs
+                    # through the caller's unwind)
+                    from ..faults.recovery import check_disk_full
+                    check_disk_full(ex, "io.write")
+                    raise
             finally:
                 self._writer = None
             if abort:
@@ -119,6 +136,13 @@ class _RollingFileWriter:
                 except OSError:
                     pass
                 return
+            # stamp BEFORE the rename: the crc sidecar (Hadoop .crc
+            # idiom, dot-prefixed so listings skip it) makes the
+            # published file's bytes verifiable at every future scan —
+            # the last durable byte path silent corruption could hide on
+            from ..faults import integrity
+            if integrity.enabled():
+                integrity.write_sidecar(self._tmp, self._path)
             # publish: the rename is the commit point
             os.replace(self._tmp, self._path)
             try:

@@ -94,7 +94,13 @@ class SpillableBatch:
             return self.device_bytes
 
     def spill_to_disk(self) -> int:
-        """HOST → DISK; returns host bytes freed."""
+        """HOST → DISK; returns host bytes freed.
+
+        The stored bytes are crc-stamped (``faults/integrity.py``) so a
+        corrupted spill file is CAUGHT at re-materialization instead of
+        silently feeding wrong data back into the query; a full disk
+        types ``PermanentFault`` (fast-fail resubmittable) rather than
+        burning the retry-backoff budget against ENOSPC."""
         with self._lock:
             if self.state != self.HOST or self._closed:
                 return 0
@@ -104,16 +110,29 @@ class SpillableBatch:
             payload = pickle.dumps(self._host, protocol=4)
             # nvcomp-LZ4 analog: compress the disk tier via the native codec
             from .. import native
+            from ..faults import integrity
+            from ..faults.recovery import check_disk_full
             comp = native.compress(payload) if self._catalog.compress_spill \
                 else None
-            with open(path, "wb") as f:
-                if comp is not None and len(comp) < len(payload):
-                    f.write(b"SRTC")
-                    f.write(len(payload).to_bytes(8, "little"))
-                    f.write(comp)
-                else:
-                    f.write(b"SRTR")
-                    f.write(payload)
+            try:
+                with open(path, "wb") as f:
+                    if comp is not None and len(comp) < len(payload):
+                        stored = comp
+                        f.write(b"SRTC")
+                        f.write(len(payload).to_bytes(8, "little"))
+                    else:
+                        stored = payload
+                        f.write(b"SRTR")
+                    f.write(integrity.checksum(stored)
+                            .to_bytes(4, "little"))
+                    f.write(stored)
+            except OSError as ex:
+                try:
+                    os.unlink(path)  # never leave a torn spill file
+                except OSError:
+                    pass
+                check_disk_full(ex, "spill")
+                raise
             freed = self.host_bytes()
             self._host = None
             self._disk_path = path
@@ -132,21 +151,56 @@ class SpillableBatch:
         return total
 
     def get(self) -> ColumnBatch:
-        """Materialize on device (re-uploading if spilled)."""
+        """Materialize on device (re-uploading if spilled).
+
+        A disk-tier read verifies the crc stamped at spill time.  A
+        mismatch on a CACHE-owned handle raises
+        :class:`..faults.integrity.IntegrityFault` — the cache drops
+        the entry and serves a MISS (recompute, never poison).  For a
+        handle backing LIVE query state there is no durable copy to
+        re-pull, so it fails typed ``QueryFaulted(resubmittable=True)``
+        (permanent at this placement: a resubmission recomputes from
+        source)."""
         import jax
         with self._lock:
             if self._closed:
                 raise RuntimeError("spillable batch already closed")
             if self.state == self.DISK:
+                from ..faults import integrity
+                from ..faults.injector import INJECTOR
                 with open(self._disk_path, "rb") as f:
                     magic = f.read(4)
-                    if magic == b"SRTC":
-                        raw_len = int.from_bytes(f.read(8), "little")
-                        from .. import native
-                        payload = native.decompress(f.read(), raw_len)
-                    else:
-                        payload = f.read()
-                    self._host = pickle.loads(payload)
+                    raw_len = int.from_bytes(f.read(8), "little") \
+                        if magic == b"SRTC" else 0
+                    crc = int.from_bytes(f.read(4), "little")
+                    stored = f.read()
+                if INJECTOR.maybe_fire("spill.corrupt",
+                                       desc=self._disk_path):
+                    stored = integrity.flip(stored)
+                try:
+                    integrity.verify(stored, crc,
+                                     what=f"spill file {self._disk_path}",
+                                     point="spill")
+                except integrity.IntegrityFault as ex:
+                    # cache-owned handles (mark_long_lived — set ONLY by
+                    # the cross-query cache) propagate IntegrityFault:
+                    # the cache drops the entry and serves a MISS.
+                    # (Priority can't discriminate: PRIORITY_CACHE == 0
+                    # is also the default live registration.)
+                    if not self._leak_cell.get("long_lived"):
+                        from ..faults.recovery import QueryFaulted
+                        raise QueryFaulted(
+                            "spill",
+                            f"spill file backing live query state is "
+                            f"corrupt ({ex}); no durable copy exists at "
+                            f"this placement", resubmittable=True) from ex
+                    raise  # cache-owned: the cache drops + misses
+                if magic == b"SRTC":
+                    from .. import native
+                    payload = native.decompress(stored, raw_len)
+                else:
+                    payload = stored
+                self._host = pickle.loads(payload)
                 os.unlink(self._disk_path)
                 self._disk_path = None
                 self.state = self.HOST

@@ -39,6 +39,16 @@ re-owned across the shrunk group (DcnShuffle.adopt_orphans).  Deaths the
 data plane cannot heal (pre-commit, broadcast build shards, lost
 coordinator) fast-fail typed as PermanentFaults, which the scheduler may
 resubmit against the surviving membership.
+
+Gray failures (docs/robustness.md "Gray failures"): every frame stream
+is crc-stamped at write and verified at every decode — local read, peer
+fetch, durable re-pull — so silently corrupted bytes surface as typed
+IntegrityFaults the SAME re-pull machinery heals; and a peer that is
+SLOW rather than dead is detected by per-peer response-time tracking
+(ProcessGroup.note_response) and hedged: a fragment fetch still pending
+at faults.hedge.quantileMs races a read of the peer's durable map
+output, first result wins (DcnShuffle._hedged_fetch,
+``fragments_hedged``).
 """
 
 from __future__ import annotations
@@ -98,7 +108,7 @@ class CoordinatorLostError(PermanentFault):
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(min(_CHUNK, n - len(buf)))
+        chunk = sock.recv(min(_CHUNK, n - len(buf)))  # wait-ok (fetch sockets carry a liveness-horizon timeout; control waits are bounded by coordinator waitTimeout replies and close() on death)
         if not chunk:
             raise ConnectionError("peer closed connection")
         buf += chunk
@@ -421,6 +431,13 @@ class _PeerServer:
         self._held: List[socket.socket] = []  # frozen conns, kept open
         self.epoch = 0
         self.fencing = True
+        # the dcn.slow_peer gray injection: when armed and selected, a
+        # fetch is answered LATE by this much (straggler simulation —
+        # slow is not dead: heartbeats keep flowing, replies arrive
+        # eventually).  Set by the owning ProcessGroup from
+        # faults.hedge.quantileMs (3x the hedge horizon, so a hedged
+        # reader provably beats the straggler).
+        self.slow_inject_s = 3.0
         self._srv = socket.create_server((bind_host, port))
         self.port = self._srv.getsockname()[1]
         threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime data-plane server)
@@ -470,6 +487,13 @@ class _PeerServer:
                 if msg["op"] != "fetch":
                     _send(conn, {"error": f"unknown op {msg['op']!r}"})
                     continue
+                from ..faults.injector import INJECTOR
+                if INJECTOR.maybe_fire("dcn.slow_peer",
+                                       desc=f"part-{msg.get('part')}"):
+                    # gray straggler: answer, but late — detection is
+                    # the requester's hedging problem, not a heartbeat
+                    # timeout (this rank is alive and will reply)
+                    time.sleep(self.slow_inject_s)
                 if self.fencing \
                         and int(msg.get("epoch", self.epoch)) < self.epoch:
                     _send(conn, {"error":
@@ -550,6 +574,19 @@ class ProcessGroup:
         # the liveness horizon, not a fixed 60 s socket timeout
         self._fetch_timeout = max(
             2.0, float(conf["spark.rapids.tpu.dcn.heartbeatTimeout"]))
+        # straggler detection (distinct from death): per-peer response
+        # times feed a declare-SLOW state — a slow peer's fragment
+        # fetches hedge against its durable map output immediately
+        # instead of waiting out the hedge horizon again.  Slow is
+        # recoverable: a fast reply clears the flag (a dead peer never
+        # replies, so the states cannot alias).
+        self.hedge_enabled = conf["spark.rapids.tpu.faults.hedge.enabled"]
+        self.hedge_s = conf[
+            "spark.rapids.tpu.faults.hedge.quantileMs"] / 1000.0
+        self.slow_peers: set = set()
+        self._rt_lock = threading.Lock()
+        self._peer_rt: Dict[int, float] = {}  # rank -> last response s
+        self._server.slow_inject_s = max(0.05, 3.0 * self.hedge_s)
         self._ctrl_lock = threading.Lock()
         self._ctrl = self._connect(coordinator_addr, connect_timeout)
         # heartbeats ride their own connection: a rank parked in a long
@@ -793,18 +830,52 @@ class ProcessGroup:
         self._shuffle_n += 1
         return f"shuffle-{self._shuffle_n}"
 
+    def note_response(self, rank: int, seconds: float) -> None:
+        """Fold one observed fetch response time into the straggler
+        detector: slower than the hedge horizon declares the peer SLOW
+        (``peer:slow`` mark, subsequent fetches hedge immediately); a
+        fast reply clears it — slow, unlike dead, is recoverable."""
+        with self._rt_lock:
+            self._peer_rt[rank] = seconds
+            if seconds * 1000.0 > self.hedge_s * 1000.0:
+                if rank not in self.slow_peers:
+                    self.slow_peers.add(rank)
+                    newly_slow = True
+                else:
+                    newly_slow = False
+            else:
+                self.slow_peers.discard(rank)
+                newly_slow = False
+        if newly_slow:
+            from ..utils import tracing
+            tracing.mark(None, "peer:slow", "fault", rank=rank,
+                         response_ms=round(seconds * 1e3, 1),
+                         hedge_ms=round(self.hedge_s * 1e3, 1))
+
+    def peer_response_s(self, rank: int) -> Optional[float]:
+        with self._rt_lock:
+            return self._peer_rt.get(rank)
+
     def fetch(self, rank: int, shuffle_id: str, part: int) -> bytes:
         """Pull one partition's frame stream from a peer's map output.
 
         A rank the coordinator has DECLARED dead fast-fails with
         :class:`PeerLostError` — retrying against it cannot help and
         must not burn the backoff budget; the caller re-pulls the
-        fragment from the dead rank's durable map output instead."""
+        fragment from the dead rank's durable map output instead.
+
+        The returned frame stream is crc-verified HERE, inside the
+        caller's retry scope, so bytes corrupted on the wire re-fetch
+        (``shuffle.corrupt`` injection flips a bit in the received
+        payload).  Response time feeds :meth:`note_response` — the
+        straggler detector behind fragment hedging.
+        """
         if rank in self._dead:
             raise PeerLostError(
                 f"fetch {shuffle_id}[{part}]: rank {rank} declared dead "
                 f"(epoch {self.epoch}); re-pull from durable map output")
         host, port = self.peers[rank]
+        t0 = time.monotonic()  # span-api-ok (straggler detection, not span timing)
         try:
             with socket.create_connection(
                     (host, port), timeout=self._fetch_timeout) as s:
@@ -815,6 +886,7 @@ class ProcessGroup:
             self.check_peers()  # prefer the heartbeat diagnosis if present
             raise PeerFailedError(
                 f"fetch {shuffle_id}[{part}] from rank {rank} failed: {e}")
+        self.note_response(rank, time.monotonic() - t0)  # span-api-ok (straggler detection)
         if msg.get("stale_epoch"):
             # our membership view lagged the serving rank's: refresh it
             # before the retry curve re-fetches at the current epoch
@@ -830,7 +902,14 @@ class ProcessGroup:
             raise PeerFailedError(
                 f"fetch {shuffle_id}[{part}] from rank {rank}: "
                 f"{msg['error']}")
-        return payload
+        from ..faults import integrity
+        from ..faults.injector import INJECTOR
+        from .host_shuffle import verify_stream
+        if INJECTOR.maybe_fire("shuffle.corrupt",
+                               desc=f"dcn rank-{rank} part-{part:05d}"):
+            payload = integrity.flip(payload)
+        return verify_stream(
+            payload, what=f"dcn {shuffle_id}[{part}] from rank {rank}")
 
     def close(self) -> None:
         self._closed = True
@@ -871,10 +950,17 @@ class DcnShuffle:
 
     def __init__(self, pg: ProcessGroup, n_parts: int, spill_dir: str,
                  num_threads: int = 4, compress: bool = True):
-        from .host_shuffle import HostShuffle
+        from ..config import TpuConf
+        from .host_shuffle import HostShuffle, gc_orphan_frames
         self.pg = pg
         self.n_parts = n_parts
         self.id = pg.new_shuffle_id()
+        # a NEW shuffle is the safe moment to sweep frame dirs orphaned
+        # by killed ranks in PREVIOUS runs (close(delete=False) keeps
+        # them on purpose — they are durable map output while the run
+        # lives; across chaos runs they are garbage)
+        gc_orphan_frames(spill_dir, TpuConf()[
+            "spark.rapids.tpu.faults.dcn.gcOrphanFramesMs"])
         self.local = HostShuffle(n_parts, spill_dir,
                                  num_threads=num_threads, compress=compress)
         self.committed: Optional[List[int]] = None
@@ -959,19 +1045,117 @@ class DcnShuffle:
     def _remote_fragment(self, r: int, p: int) -> Iterator:
         from ..faults.recovery import QueryFaulted
         from .host_shuffle import iter_frames
-        try:
-            payload = transient_retry(
-                None, "shuffle.fragment", self.pg.fetch,
-                r, self.id, p,
-                desc=f"rank-{r} part-{p:05d}",
-                recover_counter="fragments_recomputed")
-        except QueryFaulted as ex:
-            # the producing rank is gone — declared dead (fast-fail) or
-            # unreachable until retries exhausted: recover the fragment
-            # from its durable map output instead of failing the query
-            payload = self._durable_pull(r, p, ex)
+        if self.pg.hedge_enabled and r not in self.pg._dead \
+                and self.peer_dirs.get(r) is not None:
+            payload = self._hedged_fetch(r, p)
+        else:
+            try:
+                payload = transient_retry(
+                    None, "shuffle.fragment", self.pg.fetch,
+                    r, self.id, p,
+                    desc=f"rank-{r} part-{p:05d}",
+                    recover_counter="fragments_recomputed")
+            except QueryFaulted as ex:
+                # the producing rank is gone — declared dead (fast-fail)
+                # or unreachable until retries exhausted: recover the
+                # fragment from its durable map output instead of
+                # failing the query
+                payload = self._durable_pull(r, p, ex)
         if payload:
             yield from iter_frames(payload)
+
+    def _hedged_fetch(self, r: int, p: int) -> bytes:
+        """Straggler-hedged fragment pull (the tail-at-scale hedge):
+        start the peer fetch; if it is still pending at the hedge
+        horizon — immediately, for a peer already declared SLOW — race
+        it against a read of the peer's durable map output.  First
+        result wins; the loser is abandoned (the fetch socket's
+        liveness-horizon timeout bounds it).  A hedge that fires counts
+        ``fragments_hedged`` whatever side wins — the metric is "the
+        tail was long enough to pay for a second leg"."""
+        import contextvars
+
+        from ..faults.recovery import QueryFaulted
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        done = threading.Event()
+        box: Dict[str, object] = {}
+
+        def _do_fetch() -> None:
+            try:
+                box["v"] = transient_retry(
+                    None, "shuffle.fragment", self.pg.fetch,
+                    r, self.id, p,
+                    desc=f"rank-{r} part-{p:05d}",
+                    recover_counter="fragments_recomputed")
+            except BaseException as ex:
+                box["e"] = ex
+            finally:
+                done.set()
+
+        cctx = contextvars.copy_context()
+        threading.Thread(target=cctx.run, args=(_do_fetch,), daemon=True,
+                         name=f"srt-dcn-fetch-r{r}-p{p}").start()
+        hedge_s = 0.0 if r in self.pg.slow_peers else self.pg.hedge_s
+        if not done.wait(timeout=hedge_s):
+            # the peer is straggling: declare it slow and hedge against
+            # the durable map output it published at commit
+            self.pg.note_response(r, max(self.pg.hedge_s * 1.001,
+                                         hedge_s))
+            QueryStats.get().fragments_hedged += 1
+            tracing.mark(None, "fragment:hedged", "fault", rank=r,
+                         part=p, shuffle=self.id,
+                         hedge_ms=round(hedge_s * 1e3, 1))
+            try:
+                payload = self._read_durable(r, p)
+            except QueryFaulted:
+                # the durable leg failed (store hiccup): fall back to
+                # whatever the fetch leg eventually produces
+                payload = None
+            if payload is not None:
+                if done.is_set() and "v" in box:
+                    # photo finish: the fetch landed while the durable
+                    # read ran — both are byte-identical by commit
+                    # contract, first one out the door wins
+                    return box["v"]  # type: ignore[return-value]
+                return payload
+            # hedge lost both ways: wait the fetch leg out, bounded by
+            # the liveness horizon plus the retry curve it rides
+            done.wait(timeout=self.pg._fetch_timeout * 4)
+        if "v" in box:
+            return box["v"]  # type: ignore[return-value]
+        ex = box.get("e")
+        if isinstance(ex, QueryFaulted):
+            return self._durable_pull(r, p, ex)
+        if isinstance(ex, BaseException):
+            raise ex
+        # the fetch leg never finished inside any bound: treat the peer
+        # as failed-at-this-placement and recover durably
+        return self._durable_pull(
+            r, p, PeerFailedError(
+                f"fetch {self.id}[{p}] from rank {r} timed out past "
+                f"the hedge and liveness horizons"))
+
+    def _read_durable(self, r: int, p: int) -> bytes:
+        """One crc-verified read of rank ``r``'s durable map output for
+        partition ``p`` (retry-wrapped; raises QueryFaulted typed on
+        exhaustion)."""
+        from .host_shuffle import verify_stream
+        d = self.peer_dirs[r]
+
+        def _read() -> bytes:
+            if not os.path.isdir(d):
+                raise PeerLostError(
+                    f"durable map output {d} for rank {r} vanished")
+            path = os.path.join(d, f"part-{p:05d}.bin")
+            if not os.path.exists(path):
+                return b""  # the rank wrote nothing to this partition
+            with open(path, "rb") as f:
+                return verify_stream(
+                    f.read(), what=f"durable rank-{r} part-{p:05d}")
+
+        return transient_retry(None, "shuffle.fragment", _read,
+                               desc=f"durable rank-{r} part-{p:05d}")
 
     def _durable_pull(self, r: int, p: int,
                       cause: BaseException) -> bytes:
@@ -986,19 +1170,7 @@ class DcnShuffle:
                 f"no durable map output registered for rank {r} in "
                 f"{self.id}; fragment part-{p:05d} unrecoverable "
                 f"({cause})") from cause
-
-        def _read() -> bytes:
-            if not os.path.isdir(d):
-                raise PeerLostError(
-                    f"durable map output {d} for rank {r} vanished")
-            path = os.path.join(d, f"part-{p:05d}.bin")
-            if not os.path.exists(path):
-                return b""  # the rank wrote nothing to this partition
-            with open(path, "rb") as f:
-                return f.read()
-
-        payload = transient_retry(None, "shuffle.fragment", _read,
-                                  desc=f"durable rank-{r} part-{p:05d}")
+        payload = self._read_durable(r, p)
         QueryStats.get().fragments_recomputed_remote += 1
         tracing.mark(None, "fragment:remote_repull", "fault",
                      rank=r, part=p, shuffle=self.id, bytes=len(payload))

@@ -17,14 +17,21 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import uuid
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, List, Optional
 
-__all__ = ["HostShuffle", "iter_frames"]
+__all__ = ["HostShuffle", "iter_frames", "verify_stream",
+           "gc_orphan_frames"]
 
-_FRAME = struct.Struct("<cQQ")  # codec flag, compressed len, raw len
+# codec flag, compressed len, raw len, crc32 of the stored payload —
+# the checksum is stamped at write and verified on EVERY decode (file
+# read, DCN fetch, durable re-pull): silent corruption on disk or the
+# wire surfaces as a typed IntegrityFault the fragment-recovery paths
+# already know how to heal (re-pull from durable map output)
+_FRAME = struct.Struct("<cQQI")
 
 
 def _compress(payload: bytes):
@@ -49,16 +56,83 @@ def _decompress(flag: bytes, data: bytes, raw_len: int) -> bytes:
 
 def iter_frames(data: bytes):
     """Decode a partition frame stream (file bytes or DCN fetch payload)
-    into arrow tables — the file format IS the wire format."""
+    into arrow tables — the file format IS the wire format.  Every
+    frame's stored bytes are verified against the stamped crc before
+    decompression."""
     import pyarrow as pa
+
+    from ..faults import integrity
     pos = 0
     while pos < len(data):
-        flag, clen, rlen = _FRAME.unpack_from(data, pos)
+        flag, clen, rlen, crc = _FRAME.unpack_from(data, pos)
         pos += _FRAME.size
-        payload = _decompress(flag, data[pos:pos + clen], rlen)
+        stored = data[pos:pos + clen]
+        integrity.verify(stored, crc, what=f"shuffle frame @{pos}",
+                         point="shuffle.fragment")
+        payload = _decompress(flag, stored, rlen)
         pos += clen
         with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
             yield r.read_all()
+
+
+def verify_stream(data: bytes, what: str = "frame stream") -> bytes:
+    """Walk a frame stream verifying each frame's crc WITHOUT
+    decompressing or decoding — the cheap receive-side check the DCN
+    fetch and durable re-pull paths run inside their retry scope, so a
+    corrupt payload re-fetches instead of failing the query.  Returns
+    ``data`` so call sites can verify-and-pass-through."""
+    from ..faults import integrity
+    pos = 0
+    i = 0
+    while pos < len(data):
+        flag, clen, rlen, crc = _FRAME.unpack_from(data, pos)
+        pos += _FRAME.size
+        integrity.verify(data[pos:pos + clen], crc,
+                         what=f"{what} frame {i}",
+                         point="shuffle.fragment")
+        pos += clen
+        i += 1
+    return data
+
+
+def gc_orphan_frames(spill_dir: str, older_than_ms: float) -> int:
+    """Sweep orphaned ``shuffle-*`` frame directories older than the
+    threshold.  Killed ranks deliberately leave their frame files
+    behind (``HostShuffle.close(delete=False)`` — they are the durable
+    map output survivors re-pull), so chaos runs accumulate them; the
+    DCN layer runs this sweep when a NEW shuffle starts
+    (``spark.rapids.tpu.faults.dcn.gcOrphanFramesMs``).  The age gate
+    keeps a LIVE shuffle's directory (recently written) safe even on a
+    spill dir shared across ranks.  Returns directories removed."""
+    import shutil
+    if older_than_ms <= 0:
+        return 0
+    try:
+        names = os.listdir(spill_dir)
+    except OSError:
+        return 0
+    removed = 0
+    now = time.time()  # span-api-ok (file mtime age, not span timing)
+    for name in names:
+        if not name.startswith("shuffle-"):
+            continue
+        path = os.path.join(spill_dir, name)
+        try:
+            if not os.path.isdir(path):
+                continue
+            mtime = max([os.path.getmtime(path)] + [
+                os.path.getmtime(os.path.join(path, f))
+                for f in os.listdir(path)])
+        except OSError:
+            continue  # racing another sweep/teardown: skip
+        if (now - mtime) * 1000.0 > older_than_ms:
+            shutil.rmtree(path, ignore_errors=True)
+            removed += 1
+    if removed:
+        from ..utils import tracing
+        tracing.mark(None, "shuffle:gc_orphans", "shuffle",
+                     removed=removed, dir=spill_dir)
+    return removed
 
 
 class HostShuffle:
@@ -105,9 +179,12 @@ class HostShuffle:
                 flag, data = _compress(payload)
             else:
                 flag, data = b"R", payload
+            from ..faults import integrity
+            crc = integrity.checksum(data)
             with self._locks[p]:
                 with open(self._paths[p], "ab") as f:
-                    f.write(_FRAME.pack(flag, len(data), len(payload)))
+                    f.write(_FRAME.pack(flag, len(data), len(payload),
+                                        crc))
                     f.write(data)
             self.bytes_written += len(data)
             self.rows_written += table.num_rows
@@ -116,7 +193,7 @@ class HostShuffle:
     def finish_writes(self) -> None:
         """Barrier: all queued serializations durable (map side done)."""
         for fut in self._pending:
-            fut.result()  # surfaces worker exceptions
+            fut.result()  # wait-ok (local-disk writer pool; an in-query wedge is the watchdog's to reclaim)
         self._pending.clear()
 
     # -- read side ----------------------------------------------------------------
@@ -128,9 +205,17 @@ class HostShuffle:
         (plan/exchange_exec, parallel/dcn) re-pulls the whole partition
         from these durable map-side frame files — the in-process analog
         of recomputing a lost fragment from its producing stage.
+
+        Gray path: each frame's stored bytes are verified against the
+        crc stamped at write (``shuffle.corrupt`` injection flips a bit
+        in the read buffer) — a mismatch raises
+        :class:`..faults.integrity.IntegrityFault`, a TransientFault,
+        so the same consumer re-pull heals silent corruption exactly
+        like a lost frame.
         """
         import pyarrow as pa
 
+        from ..faults import integrity
         from ..faults.injector import INJECTOR
         from ..service import cancel
         from ..utils import tracing
@@ -147,8 +232,15 @@ class HostShuffle:
                 with tracing.span(None, "shuffle:read", "shuffle") as sp:
                     INJECTOR.maybe_raise("shuffle.fragment",
                                          desc=f"part-{p:05d}")
-                    flag, clen, rlen = _FRAME.unpack(header)
-                    payload = _decompress(flag, f.read(clen), rlen)
+                    flag, clen, rlen, crc = _FRAME.unpack(header)
+                    stored = f.read(clen)
+                    if INJECTOR.maybe_fire("shuffle.corrupt",
+                                           desc=f"part-{p:05d}"):
+                        stored = integrity.flip(stored)
+                    integrity.verify(stored, crc,
+                                     what=f"part-{p:05d} frame",
+                                     point="shuffle.fragment")
+                    payload = _decompress(flag, stored, rlen)
                     with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
                         table = r.read_all()
                     sp.set(partition=p, bytes=clen, rows=table.num_rows)

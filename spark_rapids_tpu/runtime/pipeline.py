@@ -68,7 +68,7 @@ class _Slots:
                 if self._free > 0:
                     self._free -= 1
                     return True
-                self._cv.wait()
+                self._cv.wait()  # wait-ok (release/stop/cancel-waker notify wake this slot gate)
 
     def release(self) -> None:
         with self._cv:

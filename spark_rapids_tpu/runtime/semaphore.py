@@ -66,6 +66,22 @@ class TpuSemaphore:
             if fn not in self._release_listeners:
                 self._release_listeners.append(fn)
 
+    def forfeit(self) -> None:
+        """Reclaim a permit held by an abandoned (wedged) worker — the
+        watchdog's stage-3 escape hatch.  Counted as a release so
+        waiters and the dispatcher wake; if the zombie thread later
+        unwinds and releases for real, the release path clamps at zero
+        so the permit cannot double-count."""
+        with self._cv:
+            self._in_use = max(0, self._in_use - 1)
+            self._cv.notify_all()
+            listeners = list(self._release_listeners)
+        for fn in listeners:
+            try:
+                fn()
+            except Exception:  # fault-ok (listener callback; reclaim must proceed)
+                pass
+
     def _notify(self) -> None:
         with self._cv:
             self._cv.notify_all()
@@ -87,7 +103,7 @@ class TpuSemaphore:
                 while self._in_use >= self._permits:
                     if ctl is not None:
                         ctl.check()
-                    self._cv.wait()
+                    self._cv.wait()  # wait-ok (cancellation waker + resize/release notify wake this)
                 if ctl is not None:
                     ctl.check()
                 self._in_use += 1
@@ -101,7 +117,9 @@ class TpuSemaphore:
             yield
         finally:
             with self._cv:
-                self._in_use -= 1
+                # clamp: a watchdog forfeit may have reclaimed this
+                # permit already (the holder was declared wedged)
+                self._in_use = max(0, self._in_use - 1)
                 self._cv.notify_all()
                 listeners = list(self._release_listeners)
             for fn in listeners:

@@ -12,10 +12,12 @@ the batch-boundary checkpoint does transitively) stays dependency-free.
 from __future__ import annotations
 
 from .cancel import (QueryCancelled, QueryControl,  # noqa: F401
-                     QueryDeadlineExceeded, check, current, scope)
+                     QueryDeadlineExceeded, QueryStalled, check, current,
+                     scope)
 
-__all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryControl",
-           "QueryRejected", "QueryScheduler", "QueryHandle",
+__all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryStalled",
+           "QueryControl", "QueryRejected", "QueryScheduler",
+           "QueryHandle", "QueryWatchdog",
            "QueryFaulted", "PermanentFault", "check", "current", "scope",
            "cancel"]
 
@@ -24,6 +26,9 @@ def __getattr__(name):
     if name in ("QueryRejected", "QueryScheduler", "QueryHandle"):
         from . import scheduler
         return getattr(scheduler, name)
+    if name == "QueryWatchdog":
+        from . import watchdog
+        return watchdog.QueryWatchdog
     if name in ("QueryFaulted", "PermanentFault"):
         # the service surface re-exports the typed terminal failure a
         # handle's result() raises when fault recovery exhausts, and the

@@ -34,8 +34,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-__all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryControl",
-           "current", "check", "scope"]
+__all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryStalled",
+           "QueryControl", "current", "check", "scope"]
 
 _pc = time.perf_counter
 
@@ -48,6 +48,16 @@ class QueryDeadlineExceeded(QueryCancelled):
     """The query ran past its deadline — a cancellation issued by the
     clock (``collect(timeout=)``, ``Session.submit(deadline_s=)``, or
     ``spark.rapids.tpu.sql.scheduler.deadlineMs``)."""
+
+
+class QueryStalled(QueryCancelled):
+    """The per-query watchdog (service/watchdog.py) declared this query
+    stalled — no batch-pull progress for ``faults.watchdog.stallMs`` —
+    and issued a cooperative cancel.  Still a :class:`QueryCancelled`
+    so every abort-path cleanup (permit release, pipeline drain, spill
+    handle close) behaves identically; the scheduler converts it to a
+    typed ``QueryFaulted(resubmittable=True)`` because a hang, unlike a
+    user cancel, is a gray FAILURE a fresh attempt may well outrun."""
 
 
 _CONTROL: "contextvars.ContextVar[Optional[QueryControl]]" = \
@@ -76,6 +86,16 @@ class QueryControl:
         self.cancelled = threading.Event()
         self.reason: Optional[str] = None
         self._deadline_hit = False
+        self._stalled = False
+        # last batch-pull checkpoint (perf_counter): every operator pull
+        # stamps this through module-level check() — the watchdog's
+        # progress signal.  Wait loops call the METHOD check() and do
+        # not stamp (a blocked wait is not progress).  ``progress_seen``
+        # flips on the first stamp: until then the watchdog applies a
+        # cold-start grace multiple (planning + XLA compilation
+        # legitimately run long before the first batch exists).
+        self.progress_t = _pc()
+        self.progress_seen = False
         self._wakers: Dict[int, Callable[[], None]] = {}
         self._n_wakers = 0
         self._lock = threading.Lock()
@@ -116,15 +136,19 @@ class QueryControl:
 
     # -- cancellation -------------------------------------------------------------
     def cancel(self, reason: str = "query cancelled", *,
-               deadline: bool = False) -> bool:
+               deadline: bool = False, stalled: bool = False) -> bool:
         """Request cooperative cancellation.  Returns False when the
         query was already cancelled.  Fires every registered waker so
-        blocked waits re-check immediately."""
+        blocked waits re-check immediately.  ``stalled=True`` is the
+        watchdog's flavor: the unwind raises :class:`QueryStalled` so
+        the scheduler can finish the query ``faulted(resubmittable)``
+        instead of ``cancelled``."""
         with self._lock:
             if self.cancelled.is_set():
                 return False
             self.reason = reason
             self._deadline_hit = deadline
+            self._stalled = stalled
             self.cancelled.set()
             wakers = list(self._wakers.values())
         for w in wakers:
@@ -157,9 +181,12 @@ class QueryControl:
     # -- status -------------------------------------------------------------------
     @property
     def status(self) -> str:
-        """'ok' | 'cancelled' | 'deadline' — the trace's span status."""
+        """'ok' | 'cancelled' | 'deadline' | 'stalled' — the trace's
+        span status."""
         if not self.cancelled.is_set():
             return "ok"
+        if self._stalled:
+            return "stalled"
         return "deadline" if self._deadline_hit else "cancelled"
 
     def check(self) -> None:
@@ -173,7 +200,19 @@ class QueryControl:
                         deadline=True)
             self.raise_()
 
+    def note_progress(self) -> None:
+        """Stamp a progress checkpoint (the watchdog's liveness
+        signal) — two attribute stores, no lock.  Called by the
+        batch-pull checkpoint and by compile-completion events (a query
+        grinding through a sequence of XLA compiles is slow, not
+        hung)."""
+        self.progress_t = _pc()
+        self.progress_seen = True
+
     def raise_(self) -> None:
+        if self._stalled:
+            raise QueryStalled(
+                self.reason or f"watchdog declared {self.label} stalled")
         if self._deadline_hit:
             raise QueryDeadlineExceeded(
                 self.reason or f"deadline exceeded for {self.label}")
@@ -192,10 +231,16 @@ def current() -> Optional[QueryControl]:
 def check() -> None:
     """The batch-boundary checkpoint: one ContextVar read when no
     control is installed; raises :class:`QueryCancelled` /
-    :class:`QueryDeadlineExceeded` when the query should stop."""
+    :class:`QueryDeadlineExceeded` when the query should stop.  A pass
+    here is also the query's PROGRESS heartbeat — the per-query
+    watchdog reads ``progress_t`` to tell a slow batch from a hung
+    one.  (Wait loops call the QueryControl.check METHOD directly and
+    therefore never count blocked spinning as progress.)"""
     c = _CONTROL.get()
     if c is not None:
         c.check()
+        c.progress_t = _pc()
+        c.progress_seen = True
 
 
 @contextlib.contextmanager

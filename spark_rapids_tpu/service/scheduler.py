@@ -50,7 +50,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..faults.recovery import QueryFaulted
 from .cancel import (QueryCancelled, QueryControl, QueryDeadlineExceeded,
-                     scope as control_scope)
+                     QueryStalled, scope as control_scope)
 
 __all__ = ["QueryRejected", "QueryHandle", "QueryScheduler"]
 
@@ -68,7 +68,8 @@ class QueryRejected(RuntimeError):
 class _Entry:
     __slots__ = ("seq", "label", "fn", "control", "future", "cctx",
                  "status", "stats", "submitted_t", "started_t",
-                 "finished_t", "deadline_s", "resubmits", "attempts")
+                 "finished_t", "deadline_s", "resubmits", "attempts",
+                 "worker_ident")
 
     def __init__(self, seq: int, label: str, fn: Callable,
                  control: QueryControl,
@@ -94,6 +95,9 @@ class _Entry:
         self.deadline_s = deadline_s
         self.resubmits = 0
         self.attempts: List[Dict] = []
+        # the worker thread's ident (set at _run_entry): the watchdog's
+        # handle for live stack dumps of a stalled query
+        self.worker_ident: Optional[int] = None
 
 
 class QueryHandle:
@@ -216,6 +220,12 @@ class QueryScheduler:
             target=self._dispatch_loop, daemon=True,
             name="srt-scheduler-dispatch")
         self._dispatcher.start()
+        # per-query progress watchdog (service/watchdog.py): a hung
+        # query — no batch-pull checkpoint for faults.watchdog.stallMs —
+        # is escalated (stack-dump mark -> cooperative cancel ->
+        # faulted(resubmittable)) so it can never strand a permit
+        from .watchdog import QueryWatchdog
+        self._watchdog = QueryWatchdog(self)
 
     # -- conf ---------------------------------------------------------------------
     def _conf(self):
@@ -375,6 +385,7 @@ class QueryScheduler:
         from ..faults.recovery import PermanentFault
         from ..utils.metrics import QueryStats
         e.started_t = _pc()
+        e.worker_ident = threading.get_ident()
         ctl = e.control
         ctl.admitted_t = e.started_t
         ctl.queue_wait_s = max(0.0, e.started_t - (ctl.enqueued_t
@@ -385,6 +396,15 @@ class QueryScheduler:
             try:
                 with control_scope(ctl):
                     result = e.fn()
+            except QueryStalled as exc:
+                # the watchdog's cooperative cancel landed: a hang is a
+                # gray FAILURE, not a user cancel — finish typed and
+                # resubmittable (a fresh attempt may outrun the hang);
+                # the unwind above already released permits/slots/handles
+                status = "faulted"
+                error = QueryFaulted("watchdog", str(exc),
+                                     resubmittable=True)
+                error.__cause__ = exc
             except QueryDeadlineExceeded as exc:
                 status, error = "deadline", exc
             except QueryCancelled as exc:
@@ -465,6 +485,10 @@ class QueryScheduler:
         return True
 
     def _finish(self, e: _Entry, status: str, result, error) -> None:
+        if e.future.done():
+            # the watchdog force-finished this entry while its worker
+            # was wedged; the zombie's late unwind must not double-set
+            return
         e.finished_t = _pc()
         e.status = status
         served = e.finished_t - (e.started_t or e.finished_t)
@@ -481,6 +505,22 @@ class QueryScheduler:
             e.future.set_exception(error)
         else:
             e.future.set_result(result)
+
+    def _force_finish(self, e: _Entry, error: BaseException) -> None:
+        """Watchdog stage-3 reclaim: the worker is wedged in native code
+        and will not unwind — resolve the caller's future typed and
+        free the running slot so admission keeps flowing.  The zombie
+        thread (daemon) is abandoned; its eventual late ``_finish`` is
+        a guarded no-op."""
+        with self._cv:
+            if e.future.done():
+                return
+            self._running.discard(e)
+            e.status = "faulted"
+            e.finished_t = _pc()
+            self.completed += 1
+            self._cv.notify_all()
+        e.future.set_exception(error)
 
     # -- cancellation -------------------------------------------------------------
     def _cancel(self, e: _Entry, reason: str) -> bool:
@@ -535,4 +575,5 @@ class QueryScheduler:
         if cancel_running:
             for e in running:
                 e.control.cancel("scheduler closed")
+        self._watchdog.close()
         self._dispatcher.join(timeout=2.0)

@@ -427,6 +427,11 @@ class Session:
         from ..service import cancel
         if isinstance(exc, QueryFaulted):
             tr.set_status("faulted")
+        elif isinstance(exc, cancel.QueryStalled):
+            # the watchdog's cooperative cancel: a hang is a gray
+            # FAILURE (the scheduler finishes it faulted/resubmittable),
+            # so the trace says faulted, not cancelled
+            tr.set_status("faulted")
         elif isinstance(exc, cancel.QueryDeadlineExceeded):
             tr.set_status("deadline")
         elif isinstance(exc, cancel.QueryCancelled):

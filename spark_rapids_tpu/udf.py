@@ -251,7 +251,7 @@ def _run_isolated(compute, timeout: float):
                 f"python UDF worker timed out after {timeout}s "
                 f"(spark.rapids.tpu.python.worker.timeout)")
         try:
-            kind, payload = parent.recv()
+            kind, payload = parent.recv()  # wait-ok (bounded by the poll(timeout) just above)
         except EOFError:
             raise PythonWorkerError(
                 f"python UDF worker died (exitcode="

@@ -123,6 +123,17 @@ class QueryStats:
         self.fragments_recomputed_remote = 0
         self.partitions_reowned = 0
         self.queries_resubmitted = 0
+        # gray-failure survival (faults/integrity.py, service/watchdog
+        # .py, parallel/dcn.py hedging): checksum verifications that
+        # FAILED (each one a silent-corruption event caught and routed
+        # into recovery), slow-peer fragment fetches hedged against the
+        # durable map output (first result wins), and queries the
+        # watchdog declared stalled — the trace_report integrity:/
+        # stalls: lines and bench's SRT_BENCH_GRAY_RATE columns read
+        # these
+        self.integrity_failures = 0
+        self.fragments_hedged = 0
+        self.stalls_detected = 0
 
     # -- accessors ----------------------------------------------------------
     @classmethod
@@ -191,6 +202,13 @@ class QueryStats:
                 s.compile_s += duration
                 tracing.record(None, "compile", "compile",
                                time.perf_counter() - duration, duration)
+                # a finished compile is PROGRESS: the watchdog must not
+                # mistake a query grinding through a compile sequence
+                # for a hung one
+                from ..service import cancel as _cancel
+                ctl = _cancel.current()
+                if ctl is not None:
+                    ctl.note_progress()
 
         jax.monitoring.register_event_duration_secs_listener(on_duration)
 
@@ -415,7 +433,7 @@ class MetricSet:
             return
         pending, self._deferred = self._deferred, []
         for name, fut in pending:
-            self.values[name] += int(fut.result())
+            self.values[name] += int(fut.result())  # wait-ok (deferred metric; the copy is already behind the dispatch front)
 
     @contextlib.contextmanager
     def time(self, name: str):

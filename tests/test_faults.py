@@ -560,9 +560,139 @@ class TestLeakHygiene:
 
 
 # ---------------------------------------------------------------------------
-# Chaos differential (the acceptance gate): >=1 fault at each of the six
-# injection points under a seeded schedule; results identical to the
-# fault-free run; zero leaked handles; accurate trace statuses.
+# Gray-point leak hygiene: the five gray injectors (corruption x3, hang,
+# slow peer) each drive their full detection+recovery path with every
+# handle released — corruption either heals (drop-and-miss / re-pull) or
+# fails typed+resubmittable; a hang is reclaimed by the watchdog; a slow
+# peer is answered late, never hung on.
+# ---------------------------------------------------------------------------
+
+GRAY_POINTS = ["shuffle.corrupt", "spill.corrupt", "cache.corrupt",
+               "device.hang", "dcn.slow_peer"]
+
+
+class TestGrayLeakHygiene:
+    @pytest.mark.parametrize("point", GRAY_POINTS)
+    def test_gray_point_releases_everything(self, faults_session,
+                                            tmp_path, point):
+        s = faults_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=1500, seed=17))
+        clear_query_cache()
+        clean = _agg_rows(s, path)
+        before = QueryStats.get().snapshot()
+        if point == "shuffle.corrupt":
+            # persistent corruption + recovery disabled: the very first
+            # integrity failure surfaces typed through the scheduler
+            s.conf.set("spark.rapids.tpu.shuffle.mode", "HOST")
+            s.conf.set("spark.rapids.tpu.faults.recovery.enabled", False)
+            s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                       f"{point}:1:9999")
+            handle = s.submit(lambda: _agg_rows(s, path),
+                              label=f"gray-{point}")
+            with pytest.raises(QueryFaulted) as ei:
+                handle.result(timeout=120)
+            assert ei.value.point == "shuffle.fragment"
+            assert handle.status == "faulted"
+            assert s.scheduler().running() == 0
+            d = QueryStats.delta_since(before)
+            assert d["integrity_failures"] >= 1
+        elif point == "spill.corrupt":
+            # a corrupted spill file backing live state: typed AND
+            # resubmittable; the handle still closes clean
+            import jax.numpy as jnp
+
+            from spark_rapids_tpu import types as T
+            from spark_rapids_tpu.batch import (ColumnBatch, DeviceColumn,
+                                                Field, Schema)
+            cat = get_catalog()
+            h = cat.register(ColumnBatch(
+                Schema([Field("x", T.INT64, False)]),
+                [DeviceColumn(T.INT64, jnp.arange(16))], 16))
+            h.spill_to_host()
+            h.spill_to_disk()
+            INJECTOR.arm(schedule=f"{point}:1:9999")
+            with pytest.raises(QueryFaulted) as ei:
+                h.get()
+            assert ei.value.resubmittable
+            INJECTOR.arm()
+            h.close()
+            assert QueryStats.delta_since(before)[
+                "integrity_failures"] >= 1
+        elif point == "cache.corrupt":
+            # a corrupt cache entry NEVER fails the query: the lookup
+            # drops it and serves a miss; results stay identical even
+            # under persistent corruption
+            s.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+            clear_query_cache()
+            assert _agg_rows(s, path) == clean  # populate
+            s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                       f"{point}:1:9999")
+            assert _agg_rows(s, path) == clean  # drop-and-miss
+            d = QueryStats.delta_since(before)
+            assert d["integrity_failures"] >= 1
+            assert d["cache_misses"] >= 1
+            s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+            clear_query_cache()
+        elif point == "device.hang":
+            # a wedged dispatch: the watchdog reclaims the query — typed
+            # faulted(resubmittable), permit released, trace FINISHED
+            s.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+            s.conf.set("spark.rapids.tpu.faults.watchdog.stallMs", 250.0)
+            s.conf.set("spark.rapids.tpu.faults.resubmit.max", 0)
+            s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                       f"{point}:1")
+            handle = s.submit(lambda: _agg_rows(s, path),
+                              label=f"gray-{point}")
+            with pytest.raises(QueryFaulted) as ei:
+                handle.result(timeout=60)
+            assert ei.value.resubmittable
+            assert handle.status == "faulted"
+            assert s.scheduler().running() == 0
+            tr = handle.trace()
+            assert tr is not None and tr.t_end is not None
+            assert tr.status == "faulted"
+            assert "watchdog:stall" in [e[1] for e in tr.events]
+        else:  # dcn.slow_peer
+            # a straggling peer server answers late; the fetch still
+            # completes and nothing hangs or leaks
+            from spark_rapids_tpu.config import TpuConf
+            from spark_rapids_tpu.parallel.dcn import (Coordinator,
+                                                       DcnShuffle,
+                                                       ProcessGroup)
+            TpuConf.set_session(
+                "spark.rapids.tpu.faults.hedge.quantileMs", 40.0)
+            coord = Coordinator(1)
+            try:
+                pg = ProcessGroup(0, 1, ("127.0.0.1", coord.port),
+                                  coordinator=coord)
+                sh = DcnShuffle(pg, 1, str(tmp_path / "slowpeer"))
+                sh.write_partition(0, pa.table({"x": [1, 2, 3]}))
+                sh.local.finish_writes()
+                INJECTOR.arm(schedule=f"{point}:1")
+                assert pg.fetch(0, sh.id, 0)
+                INJECTOR.arm()
+                pg.unregister_shuffle(sh.id)
+                sh.local.close()
+                pg.close()
+            finally:
+                INJECTOR.arm()
+                coord.close()
+                TpuConf.unset_session(
+                    "spark.rapids.tpu.faults.hedge.quantileMs")
+        # common epilogue: a clean query still runs, nothing leaked
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        s.conf.unset("spark.rapids.tpu.faults.recovery.enabled")
+        s.conf.unset("spark.rapids.tpu.shuffle.mode")
+        assert _agg_rows(s, path) == clean
+        clear_query_cache()
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# Chaos differential (the acceptance gate): >=1 fault at EVERY registered
+# injection point — fail-stop AND gray — under a seeded schedule; results
+# identical to the fault-free run; zero leaked handles; accurate trace
+# statuses.
 # ---------------------------------------------------------------------------
 
 class TestChaosDifferential:
@@ -585,22 +715,76 @@ class TestChaosDifferential:
         clean_rows, clean_back = run_all()
         INJECTOR.reset_totals()
         before = QueryStats.get().snapshot()
+        # fail-stop AND gray in one schedule: shuffle.corrupt flips a
+        # bit in a host-shuffle frame (integrity verify -> re-pull heals
+        # it inside the same query)
         s.conf.set(
             "spark.rapids.tpu.faults.inject.schedule",
             "io.read:1,device.op:1,cache.lookup:1,"
-            "shuffle.fragment:1,io.write:1")
+            "shuffle.fragment:1,io.write:1,shuffle.corrupt:1")
         s.conf.set("spark.rapids.tpu.faults.inject.seed", 7)
         faulted_rows, faulted_back = run_all()
         # identical results under faults
         assert faulted_rows == clean_rows
         assert faulted_back == clean_back
+
+        # cache.corrupt leg: its own schedule (cache.lookup:1 above
+        # degrades every query's FIRST lookup to a miss before the
+        # entry is ever found, so the corrupt check needs a clean
+        # lookup): the poisoned entry is dropped, the query recomputes
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "cache.corrupt:1")
+        assert _agg_rows(s, path) == clean_rows
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        # every recovered query's trace finished with an accurate status
+        # (checked before the hang leg below, whose trace is accurately
+        # 'faulted')
+        tr = s.last_trace()
+        assert tr is not None and tr.status in ("ok", "degraded")
+
+        # device.hang leg: a wedged dispatch is reclaimed by the
+        # watchdog — faulted(resubmittable), permit released
+        s.conf.set("spark.rapids.tpu.faults.watchdog.stallMs", 250.0)
+        s.conf.set("spark.rapids.tpu.faults.resubmit.max", 0)
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "device.hang:1")
+        handle = s.submit(lambda: _agg_rows(s, path), label="chaos-hang")
+        with pytest.raises(QueryFaulted) as ei:
+            handle.result(timeout=60)
+        assert ei.value.resubmittable
+        assert s.scheduler().running() == 0
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        s.conf.unset("spark.rapids.tpu.faults.watchdog.stallMs")
+        s.conf.unset("spark.rapids.tpu.faults.resubmit.max")
+
+        # spill.corrupt leg: a corrupted spill file backing live state
+        # fails typed + resubmittable (no durable copy at this placement)
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.batch import (ColumnBatch, DeviceColumn,
+                                            Field, Schema)
+        cat = get_catalog()
+        h = cat.register(ColumnBatch(
+            Schema([Field("x", T.INT64, False)]),
+            [DeviceColumn(T.INT64, jnp.arange(8))], 8))
+        h.spill_to_host()
+        h.spill_to_disk()
+        INJECTOR.arm(schedule="spill.corrupt:1")
+        with pytest.raises(QueryFaulted) as ei:
+            h.get()
+        assert ei.value.resubmittable
+        INJECTOR.arm()
+        h.close()
+
         # the dcn legs of the schedule: a mini process group riding the
         # same injection points (no ExecContext re-arms here).
         # dcn.heartbeat exercises the transient connect retry;
-        # dcn.peer_kill kills the rank (silent mode: heartbeats stop,
-        # peer server freezes, the rank's own query unwinds typed)
-        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
-        from spark_rapids_tpu.parallel.dcn import (Coordinator,
+        # dcn.slow_peer delays a peer-server fetch reply (slow, not
+        # dead); dcn.peer_kill kills the rank (silent mode: heartbeats
+        # stop, peer server freezes, the rank's own query unwinds typed)
+        s.conf.set("spark.rapids.tpu.faults.hedge.quantileMs", 40.0)
+        from spark_rapids_tpu.parallel.dcn import (Coordinator, DcnShuffle,
                                                    PeerLostError,
                                                    ProcessGroup)
         INJECTOR.arm(schedule="dcn.heartbeat:1")
@@ -609,6 +793,13 @@ class TestChaosDifferential:
             pg = ProcessGroup(0, 1, ("127.0.0.1", coord.port),
                               coordinator=coord)
             pg.barrier()
+            sh = DcnShuffle(pg, 1, str(tmp_path / "dcn_chaos"))
+            sh.write_partition(0, pa.table({"x": [1, 2]}))
+            sh.local.finish_writes()
+            INJECTOR.arm(schedule="dcn.slow_peer:1")
+            assert pg.fetch(0, sh.id, 0)  # answered, just late
+            pg.unregister_shuffle(sh.id)
+            sh.local.close()
             INJECTOR.arm(schedule="dcn.peer_kill:1")
             with pytest.raises(PeerLostError, match="killed"):
                 pg.note_op()
@@ -623,9 +814,12 @@ class TestChaosDifferential:
         d = QueryStats.delta_since(before)
         assert d["transient_retries"] >= 4
         assert d["retry_backoff_s"] > 0
-        # every trace finished with an accurate status
-        tr = s.last_trace()
-        assert tr is not None and tr.status in ("ok", "degraded")
+        # gray detection is attributable: corruption was CAUGHT, the
+        # watchdog saw the hang
+        assert d["integrity_failures"] >= 2  # shuffle + cache (+ spill)
+        assert d["fragments_recomputed"] >= 1
+        # the stall landed on the process aggregate (watchdog thread)
+        assert QueryStats.process().stalls_detected >= 1
         # zero spill-handle leaks once the (legitimately long-lived)
         # cache entries are dropped
         clear_query_cache()
@@ -703,6 +897,45 @@ class TestSatellites:
         assert files == ["bad.py"]
         kinds = sorted(line.rsplit("[", 1)[1] for _, _, line in violations)
         assert kinds == ["ad-hoc retry loop]", "swallowed fault]"]
+
+    def test_check_fault_paths_unbounded_wait_rule(self, tmp_path):
+        """Rule 3: no-timeout waits/results/recvs are flagged outside
+        faults/ and service/; # wait-ok exempts; timeouts pass."""
+        from tools.check_fault_paths import check
+        pkg = tmp_path / "pkg"
+        (pkg / "service").mkdir(parents=True)
+        (pkg / "bad_wait.py").write_text(
+            "def f(cv, fut, sock):\n"
+            "    cv.wait()\n"
+            "    fut.result()\n"
+            "    sock.recv(4096)\n")
+        (pkg / "ok_wait.py").write_text(
+            "def f(cv, fut, sock):\n"
+            "    cv.wait(timeout=1.0)\n"
+            "    fut.result(timeout=5)\n"
+            "    cv.wait()  # wait-ok (waker wakes this)\n"
+            "    sock.recv(4096)  # wait-ok (socket timeout set at connect)\n")
+        (pkg / "service" / "waiter.py").write_text(
+            "def f(cv):\n"
+            "    cv.wait()\n")  # service/ is the waiting layer: exempt
+        violations = check(str(pkg))
+        files = sorted({rel for rel, _, _ in violations})
+        assert files == ["bad_wait.py"]
+        assert len(violations) == 3
+        assert all("[unbounded wait]" in line
+                   for _, _, line in violations)
+
+    def test_gray_points_registered(self):
+        for p in ("shuffle.corrupt", "spill.corrupt", "cache.corrupt",
+                  "device.hang", "dcn.slow_peer"):
+            assert p in POINTS
+        for key in ("spark.rapids.tpu.faults.integrity.enabled",
+                    "spark.rapids.tpu.faults.watchdog.enabled",
+                    "spark.rapids.tpu.faults.watchdog.stallMs",
+                    "spark.rapids.tpu.faults.hedge.enabled",
+                    "spark.rapids.tpu.faults.hedge.quantileMs",
+                    "spark.rapids.tpu.faults.dcn.gcOrphanFramesMs"):
+            assert key in ALL_ENTRIES
 
     def test_engine_tree_is_lint_clean(self):
         from tools.check_fault_paths import check

@@ -1,0 +1,689 @@
+"""Gray-failure survival (ISSUE 7): end-to-end data integrity
+(checksums on every durable byte path), the per-query hang watchdog,
+and straggler hedging for DCN fragment fetches.
+
+The mixed chaos differential at the bottom is the acceptance gate:
+seeded GRAY faults (corruption) combined with a FAIL-STOP peer kill on
+a thread-rank world must still produce results identical to the
+fault-free run, with recovery attributable and zero leaked handles.
+"""
+
+import errno
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.config import ALL_ENTRIES, TpuConf
+from spark_rapids_tpu.faults import (INJECTOR, IntegrityFault,
+                                     PermanentFault, QueryFaulted,
+                                     check_disk_full)
+from spark_rapids_tpu.faults import integrity
+from spark_rapids_tpu.memory.spill import get_catalog
+from spark_rapids_tpu.parallel.host_shuffle import (HostShuffle,
+                                                    gc_orphan_frames,
+                                                    iter_frames,
+                                                    verify_stream)
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.metrics import QueryStats
+
+FAST = {
+    "spark.rapids.tpu.faults.backoff.baseMs": 1.0,
+    "spark.rapids.tpu.faults.backoff.maxMs": 10.0,
+}
+
+
+@pytest.fixture()
+def gray_session(session):
+    keys = [k for k in ALL_ENTRIES
+            if k.startswith(("spark.rapids.tpu.faults.",
+                             "spark.rapids.tpu.sql.trace.",
+                             "spark.rapids.tpu.shuffle.",
+                             "spark.rapids.tpu.sql.cache."))]
+    for k, v in FAST.items():
+        session.conf.set(k, v)
+    yield session
+    for k in keys:
+        session.conf.unset(k)
+    INJECTOR.arm()
+    from spark_rapids_tpu.cache import clear_query_cache
+    clear_query_cache()
+
+
+@pytest.fixture()
+def fast_backoff():
+    for k, v in FAST.items():
+        TpuConf.set_session(k, v)
+    yield
+    for k in FAST:
+        TpuConf.unset_session(k)
+    INJECTOR.arm()
+
+
+def _frame(n=2000, seed=3):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "a": np.arange(n, dtype=np.int64),
+        "b": rng.random(n),
+        "k": rng.integers(0, 9, n).astype(np.int64),
+    })
+
+
+def _write_pq(tmp_path, name, pdf):
+    path = str(tmp_path / name)
+    pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False), path)
+    return path
+
+
+def _agg_rows(sess, path):
+    df = sess.read_parquet(path)
+    return sorted(df.filter(F.col("b") < 0.7).group_by("k").agg(
+        F.sum(F.col("a")).alias("s")).collect())
+
+
+# ---------------------------------------------------------------------------
+# Integrity primitives.
+# ---------------------------------------------------------------------------
+
+class TestIntegrityUnit:
+    def test_checksum_stable_and_sensitive(self):
+        data = b"the quick brown fox" * 100
+        c = integrity.checksum(data)
+        assert c == integrity.checksum(data)
+        assert c != integrity.checksum(integrity.flip(data))
+
+    def test_verify_mismatch_typed_and_counted(self):
+        data = b"payload bytes"
+        crc = integrity.checksum(data)
+        integrity.verify(data, crc, what="unit")  # clean passes
+        s0 = QueryStats.get().snapshot()
+        with pytest.raises(IntegrityFault) as ei:
+            integrity.verify(integrity.flip(data), crc, what="unit",
+                             point="shuffle.fragment")
+        assert ei.value.point == "shuffle.fragment"
+        assert QueryStats.delta_since(s0)["integrity_failures"] == 1
+
+    def test_verify_disabled_passes_through(self):
+        conf = TpuConf({
+            "spark.rapids.tpu.faults.integrity.enabled": False})
+        integrity.verify(b"anything", 12345, what="unit", conf=conf)
+
+    def test_integrity_fault_is_transient(self):
+        from spark_rapids_tpu.faults import TransientFault
+        assert issubclass(IntegrityFault, TransientFault)
+
+    def test_file_sidecar_roundtrip(self, tmp_path):
+        p = str(tmp_path / "data.bin")
+        with open(p, "wb") as f:
+            f.write(b"x" * 4096)
+        integrity.write_sidecar(p)
+        side = integrity.sidecar_path(p)
+        assert os.path.basename(side).startswith(".")
+        integrity.verify_file(p)  # clean
+        with open(p, "r+b") as f:
+            f.seek(100)
+            f.write(b"Y")
+        with pytest.raises(IntegrityFault):
+            integrity.verify_file(p)
+        integrity.remove_sidecar(p)
+        integrity.verify_file(p)  # no sidecar: nothing stamped
+
+
+# ---------------------------------------------------------------------------
+# Shuffle frame integrity: file AND wire format.
+# ---------------------------------------------------------------------------
+
+class TestFrameIntegrity:
+    def test_corrupt_frame_detected_and_healed(self, tmp_path):
+        from spark_rapids_tpu.faults import transient_retry
+        conf = TpuConf(FAST)
+        sh = HostShuffle(1, str(tmp_path), num_threads=1)
+        try:
+            sh.write_partition(0, pa.table({"x": list(range(50))}))
+            sh.finish_writes()
+            clean = [t.to_pydict() for t in sh.read_partition(0)]
+            INJECTOR.arm(schedule="shuffle.corrupt:1")
+            s0 = QueryStats.get().snapshot()
+            tables = transient_retry(
+                conf, "shuffle.fragment",
+                lambda: list(sh.read_partition(0)),
+                recover_counter="fragments_recomputed")
+            d = QueryStats.delta_since(s0)
+            assert [t.to_pydict() for t in tables] == clean
+            assert d["integrity_failures"] >= 1
+            assert d["fragments_recomputed"] == 1
+        finally:
+            INJECTOR.arm()
+            sh.close()
+
+    def test_stream_verify_catches_wire_corruption(self, tmp_path):
+        sh = HostShuffle(1, str(tmp_path), num_threads=1)
+        try:
+            sh.write_partition(0, pa.table({"x": [1, 2, 3]}))
+            sh.finish_writes()
+            with open(sh._paths[0], "rb") as f:
+                raw = f.read()
+            verify_stream(raw)  # the file bytes ARE the wire payload
+            assert sum(t.num_rows for t in iter_frames(raw)) == 3
+            bad = bytearray(raw)
+            bad[len(bad) // 2] ^= 0x01
+            with pytest.raises(IntegrityFault):
+                verify_stream(bytes(bad))
+        finally:
+            sh.close()
+
+
+# ---------------------------------------------------------------------------
+# Written-file integrity: sidecars stamped at the atomic commit point,
+# verified at scan.
+# ---------------------------------------------------------------------------
+
+class TestWriterIntegrity:
+    def test_sidecar_stamped_and_hidden(self, gray_session, tmp_path):
+        s = gray_session
+        src = _write_pq(tmp_path, "src.parquet", _frame(n=400))
+        out = str(tmp_path / "out")
+        s.read_parquet(src).write.mode("overwrite").parquet(out)
+        files = os.listdir(out)
+        sidecars = [f for f in files if f.endswith(".crc")]
+        assert sidecars and all(f.startswith(".") for f in sidecars)
+        # listings skip dot-files: read-back sees only the data
+        back = s.read_parquet(out).collect()
+        assert len(back) == 400
+
+    def test_corrupt_published_file_fails_typed(self, gray_session,
+                                                tmp_path):
+        s = gray_session
+        src = _write_pq(tmp_path, "src.parquet", _frame(n=400, seed=5))
+        out = str(tmp_path / "out2")
+        s.read_parquet(src).write.mode("overwrite").parquet(out)
+        data_file = [f for f in os.listdir(out)
+                     if f.endswith(".parquet")][0]
+        p = os.path.join(out, data_file)
+        with open(p, "r+b") as f:
+            f.seek(128)
+            b = f.read(1)
+            f.seek(128)
+            f.write(bytes([b[0] ^ 1]))
+        s.conf.set("spark.rapids.tpu.faults.recovery.enabled", False)
+        with pytest.raises(QueryFaulted) as ei:
+            s.read_parquet(out).collect()
+        assert ei.value.point == "io.read"
+        s.conf.unset("spark.rapids.tpu.faults.recovery.enabled")
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC: disk-full is permanent at this placement, not a retry loop.
+# ---------------------------------------------------------------------------
+
+class TestDiskFull:
+    def test_check_disk_full_types_enospc(self):
+        with pytest.raises(PermanentFault, match="disk full"):
+            check_disk_full(OSError(errno.ENOSPC, "No space left"),
+                            "io.write")
+        # other OSErrors pass through untouched
+        check_disk_full(OSError(errno.EIO, "io error"), "io.write")
+
+    def test_writer_enospc_fast_fails_resubmittable(self, gray_session,
+                                                    tmp_path,
+                                                    monkeypatch):
+        from spark_rapids_tpu.io.writers import _RollingFileWriter
+        s = gray_session
+        src = _write_pq(tmp_path, "src.parquet", _frame(n=300, seed=7))
+        out = str(tmp_path / "full")
+
+        def _no_space(self, chunk):
+            raise OSError(errno.ENOSPC, "No space left on device")
+
+        monkeypatch.setattr(_RollingFileWriter, "_write_chunk", _no_space)
+        t0 = time.monotonic()
+        with pytest.raises(PermanentFault, match="disk full"):
+            s.read_parquet(src).write.mode("overwrite").parquet(out)
+        # fast-fail: no backoff curve was ridden against a full disk
+        assert time.monotonic() - t0 < 2.0
+        # atomicity held: nothing was published
+        leftovers = os.listdir(out) if os.path.exists(out) else []
+        assert not [f for f in leftovers if f.endswith(".parquet")]
+        get_catalog().assert_no_leaks()
+
+    def test_spill_enospc_types_permanent(self, tmp_path, monkeypatch):
+        import builtins
+
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.batch import (ColumnBatch, DeviceColumn,
+                                            Field, Schema)
+        from spark_rapids_tpu.memory.spill import SpillCatalog
+        cat = SpillCatalog(1 << 30, 1 << 30,
+                           spill_dir=str(tmp_path / "spill"))
+        h = cat.register(ColumnBatch(
+            Schema([Field("x", T.INT64, False)]),
+            [DeviceColumn(T.INT64, jnp.arange(4))], 4))
+        h.spill_to_host()
+        real_open = builtins.open
+
+        def failing_open(path, mode="r", *a, **kw):
+            if "wb" in mode and "srt-spill" in str(path):
+                raise OSError(errno.ENOSPC, "No space left on device")
+            return real_open(path, mode, *a, **kw)
+
+        monkeypatch.setattr(builtins, "open", failing_open)
+        with pytest.raises(PermanentFault, match="disk full"):
+            h.spill_to_disk()
+        monkeypatch.undo()
+        # the handle survives (still HOST) and closes clean
+        assert h.state == h.HOST
+        h.close()
+        cat.assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog: stalls detected within the window, no false positives on
+# slow-but-alive queries.
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_hung_query_reclaimed_within_bound(self, gray_session,
+                                               tmp_path):
+        s = gray_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=2500, seed=9))
+        clean = _agg_rows(s, path)  # warm: compiles out of the window
+        stall_ms = 300.0
+        s.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+        s.conf.set("spark.rapids.tpu.faults.watchdog.stallMs", stall_ms)
+        s.conf.set("spark.rapids.tpu.faults.resubmit.max", 0)
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "device.hang:1")
+        t0 = time.monotonic()
+        h = s.submit(lambda: _agg_rows(s, path), label="wd-hang")
+        with pytest.raises(QueryFaulted) as ei:
+            h.result(timeout=60)
+        elapsed = time.monotonic() - t0
+        assert ei.value.resubmittable
+        # reclaimed within stallMs + one poll + one batch, not minutes
+        # (generous 10x bound to keep CI timing-safe)
+        assert elapsed < (stall_ms / 1000.0) * 10
+        assert h.status == "faulted"
+        assert s.scheduler().running() == 0
+        tr = h.trace()
+        assert tr is not None and tr.status == "faulted"
+        stall_marks = [e for e in tr.events if e[1] == "watchdog:stall"]
+        assert stall_marks, "stack-dump mark missing"
+        assert "stack" in (stall_marks[0][6] or {})
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        s.conf.unset("spark.rapids.tpu.faults.watchdog.stallMs")
+        s.conf.unset("spark.rapids.tpu.faults.resubmit.max")
+        assert _agg_rows(s, path) == clean  # permit was released
+        get_catalog().assert_no_leaks()
+
+    def test_hung_query_resubmitted_then_exhausts(self, gray_session,
+                                                  tmp_path):
+        s = gray_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=1200, seed=11))
+        _agg_rows(s, path)
+        s.conf.set("spark.rapids.tpu.faults.watchdog.stallMs", 250.0)
+        s.conf.set("spark.rapids.tpu.faults.resubmit.max", 1)
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "device.hang:1")
+        h = s.submit(lambda: _agg_rows(s, path), label="wd-resubmit")
+        with pytest.raises(QueryFaulted):
+            h.result(timeout=90)
+        # the hang re-armed on the retry: faulted -> resubmitted ->
+        # faulted, lineage preserved on the one handle
+        assert h.resubmits == 1
+        assert [a["status"] for a in h.attempts] == ["resubmitted"]
+        s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        s.conf.unset("spark.rapids.tpu.faults.watchdog.stallMs")
+        s.conf.unset("spark.rapids.tpu.faults.resubmit.max")
+        get_catalog().assert_no_leaks()
+
+    def test_slow_but_alive_query_not_reclaimed(self, gray_session,
+                                                tmp_path):
+        """Batches keep flowing, each under the window: progress stamps
+        hold the watchdog off no matter how long the query runs."""
+        s = gray_session
+        path = _write_pq(tmp_path, "t.parquet", _frame(n=2000, seed=13))
+        clean = _agg_rows(s, path)
+        s.conf.set("spark.rapids.tpu.faults.watchdog.stallMs", 400.0)
+
+        def slow_query():
+            # batch boundaries pass the checkpoint between sleeps
+            rows = _agg_rows(s, path)
+            for _ in range(4):
+                time.sleep(0.15)  # fault-ok (test pacing, not a retry)
+                from spark_rapids_tpu.service import cancel
+                cancel.check()
+            return rows
+
+        h = s.submit(slow_query, label="wd-slow")
+        assert h.result(timeout=60) == clean
+        assert h.status == "done"
+        s.conf.unset("spark.rapids.tpu.faults.watchdog.stallMs")
+
+    def test_progress_stamped_at_batch_checkpoint(self):
+        from spark_rapids_tpu.service import cancel
+        ctl = cancel.QueryControl(label="unit")
+        assert not ctl.progress_seen
+        with cancel.scope(ctl):
+            t0 = ctl.progress_t
+            time.sleep(0.01)  # fault-ok (test pacing)
+            cancel.check()
+        assert ctl.progress_seen and ctl.progress_t > t0
+
+    def test_stalled_cancel_raises_query_stalled(self):
+        from spark_rapids_tpu.service import cancel
+        ctl = cancel.QueryControl(label="unit")
+        ctl.cancel("watchdog says stop", stalled=True)
+        assert ctl.status == "stalled"
+        with pytest.raises(cancel.QueryStalled):
+            ctl.raise_()
+
+    def test_semaphore_forfeit_clamps(self):
+        from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+        sem = TpuSemaphore(2)
+        with sem.acquire():
+            assert sem.available() == 1
+            sem.forfeit()  # watchdog reclaims the wedged holder
+            assert sem.available() == 2
+        # the zombie's real release clamped at zero in-use: no
+        # phantom third permit
+        assert sem.available() == 2
+
+
+# ---------------------------------------------------------------------------
+# Straggler hedging (thread-rank DCN world).
+# ---------------------------------------------------------------------------
+
+def _make_group(world, hb_timeout=3.0, spills=None):
+    from spark_rapids_tpu.parallel.dcn import Coordinator, ProcessGroup
+    coord = Coordinator(world, heartbeat_timeout=hb_timeout,
+                        wait_timeout=20.0)
+    pgs = [None] * world
+
+    def mk(r):
+        pgs[r] = ProcessGroup(r, world, ("127.0.0.1", coord.port),
+                              coordinator=coord if r == 0 else None,
+                              heartbeat_interval=0.15)
+
+    ts = [threading.Thread(target=mk, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert all(pg is not None for pg in pgs)
+    return coord, pgs
+
+
+def _commit_all(shuffles):
+    ts = [threading.Thread(target=sh.commit) for sh in shuffles]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+
+
+def _close_all(shuffles):
+    ts = [threading.Thread(target=sh.close) for sh in shuffles]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+
+
+class TestStragglerHedging:
+    def test_slow_peer_hedged_against_durable(self, fast_backoff,
+                                              tmp_path):
+        TpuConf.set_session("spark.rapids.tpu.faults.hedge.quantileMs",
+                            80.0)
+        try:
+            from spark_rapids_tpu.parallel.dcn import DcnShuffle
+            world, n_parts = 2, 4
+            coord, pgs = _make_group(world)
+            shuffles = [DcnShuffle(pg, n_parts,
+                                   str(tmp_path / f"r{pg.rank}"))
+                        for pg in pgs]
+            for rank, sh in enumerate(shuffles):
+                for p in range(n_parts):
+                    sh.write_partition(p, pa.table(
+                        {"src": [rank] * 3, "v": list(range(3))}))
+            _commit_all(shuffles)
+            assert shuffles[0].committed == [0, 1]
+            # rank 1's server answers the next fetch LATE (3x the hedge
+            # horizon): the hedge must beat it via durable map output
+            INJECTOR.arm(schedule="dcn.slow_peer:1")
+            s0 = QueryStats.get().snapshot()
+            t0 = time.monotonic()
+            rows = list(shuffles[0].read_partition(0))
+            elapsed = time.monotonic() - t0
+            INJECTOR.arm()
+            assert sum(t.num_rows for t in rows) == world * 3
+            d = QueryStats.delta_since(s0)
+            assert d["fragments_hedged"] >= 1
+            # first-result-wins: well under the straggler's delay
+            assert elapsed < pgs[1]._server.slow_inject_s
+            assert 1 in pgs[0].slow_peers  # declared SLOW, not dead
+            assert 1 not in pgs[0].dead_peers
+            # a fast reply clears the slow state (recoverable, unlike
+            # declared-dead): read a partition with the injector off —
+            # the immediate hedge races a now-fast fetch; either side
+            # winning still notes the response
+            list(shuffles[0].read_partition(2))
+            _close_all(shuffles)
+            for pg in pgs:
+                pg.close()
+        finally:
+            TpuConf.unset_session(
+                "spark.rapids.tpu.faults.hedge.quantileMs")
+        get_catalog().assert_no_leaks()
+
+    def test_hedge_disabled_keeps_plain_path(self, fast_backoff,
+                                             tmp_path):
+        TpuConf.set_session("spark.rapids.tpu.faults.hedge.enabled",
+                            False)
+        try:
+            from spark_rapids_tpu.parallel.dcn import DcnShuffle
+            coord, pgs = _make_group(2)
+            shuffles = [DcnShuffle(pg, 2, str(tmp_path / f"r{pg.rank}"))
+                        for pg in pgs]
+            for rank, sh in enumerate(shuffles):
+                for p in range(2):
+                    sh.write_partition(p, pa.table({"src": [rank]}))
+            _commit_all(shuffles)
+            s0 = QueryStats.get().snapshot()
+            rows = list(shuffles[0].read_partition(0))
+            assert sum(t.num_rows for t in rows) == 2
+            assert QueryStats.delta_since(s0)["fragments_hedged"] == 0
+            _close_all(shuffles)
+            for pg in pgs:
+                pg.close()
+        finally:
+            TpuConf.unset_session("spark.rapids.tpu.faults.hedge.enabled")
+
+
+# ---------------------------------------------------------------------------
+# Orphan frame GC (the close(delete=False) leftovers from PR 6).
+# ---------------------------------------------------------------------------
+
+class TestOrphanFrameGc:
+    def test_sweep_removes_old_keeps_fresh(self, tmp_path):
+        spill = str(tmp_path)
+        old = tmp_path / "shuffle-deadbeef0001"
+        old.mkdir()
+        (old / "part-00000.bin").write_bytes(b"stale")
+        os.utime(old / "part-00000.bin", (1, 1))
+        os.utime(old, (1, 1))
+        fresh = tmp_path / "shuffle-cafebabe0002"
+        fresh.mkdir()
+        (fresh / "part-00000.bin").write_bytes(b"live")
+        other = tmp_path / "not-a-shuffle"
+        other.mkdir()
+        assert gc_orphan_frames(spill, 60_000) == 1
+        assert not old.exists()
+        assert fresh.exists() and other.exists()
+        # disabled sweep is a no-op
+        os.utime(fresh, (1, 1))
+        assert gc_orphan_frames(spill, 0) == 0
+        assert fresh.exists()
+
+    def test_new_dcn_shuffle_triggers_sweep(self, fast_backoff,
+                                            tmp_path):
+        spill = tmp_path / "spill"
+        spill.mkdir()
+        orphan = spill / "shuffle-00000000dead"
+        orphan.mkdir()
+        (orphan / "part-00000.bin").write_bytes(b"orphan")
+        os.utime(orphan / "part-00000.bin", (1, 1))
+        os.utime(orphan, (1, 1))
+        TpuConf.set_session(
+            "spark.rapids.tpu.faults.dcn.gcOrphanFramesMs", 60_000.0)
+        try:
+            from spark_rapids_tpu.parallel.dcn import DcnShuffle
+            coord, pgs = _make_group(1)
+            sh = DcnShuffle(pgs[0], 1, str(spill))
+            assert not orphan.exists()  # swept at shuffle start
+            assert os.path.isdir(sh.local.dir)  # the live dir is fine
+            pgs[0].unregister_shuffle(sh.id)
+            sh.local.close()
+            pgs[0].close()
+        finally:
+            TpuConf.unset_session(
+                "spark.rapids.tpu.faults.dcn.gcOrphanFramesMs")
+
+
+# ---------------------------------------------------------------------------
+# The mixed chaos differential: gray + fail-stop together.
+# ---------------------------------------------------------------------------
+
+class TestMixedChaosDifferential:
+    def test_corrupt_fragment_plus_killed_peer(self, fast_backoff,
+                                               tmp_path):
+        """World=3: rank 2 dies silently mid-shuffle while a surviving
+        peer's fragment stream corrupts — survivors' combined result is
+        IDENTICAL to the fault-free run, recovery attributable, no
+        leaks."""
+        from spark_rapids_tpu.parallel.dcn import DcnShuffle
+        world, n_parts = 3, 6
+        coord, pgs = _make_group(world, hb_timeout=0.6)
+        shuffles = []
+        try:
+            shuffles = [DcnShuffle(pg, n_parts,
+                                   str(tmp_path / f"r{pg.rank}"))
+                        for pg in pgs]
+            for rank, sh in enumerate(shuffles):
+                for p in range(n_parts):
+                    sh.write_partition(p, pa.table(
+                        {"src": [rank] * 2, "part": [p] * 2,
+                         "v": [0, 1]}))
+            _commit_all(shuffles)
+            assert shuffles[0].committed == [0, 1, 2]
+
+            # fail-stop leg: rank 2 dies silently (map output durable)
+            pgs[2]._closed = True
+            pgs[2]._server.freeze()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not (
+                    2 in pgs[0].dead_peers and 2 in pgs[1].dead_peers):
+                time.sleep(0.05)  # fault-ok (test poll, not a retry)
+            assert 2 in pgs[0].dead_peers and 2 in pgs[1].dead_peers
+
+            # gray leg: the first surviving frame read corrupts
+            INJECTOR.arm(schedule="shuffle.corrupt:1")
+            s0 = QueryStats.get().snapshot()
+            results = {}
+
+            def read_all(rank):
+                sh = shuffles[rank]
+                rows = []
+                for p in sh.my_parts():
+                    rows.extend(sh.read_partition(p))
+                for p in sh.adopt_orphans():
+                    rows.extend(sh.read_partition(p))
+                results[rank] = rows
+
+            ts = [threading.Thread(target=read_all, args=(r,))
+                  for r in (0, 1)]
+            t0 = time.monotonic()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            wall = time.monotonic() - t0
+            INJECTOR.arm()
+            assert set(results) == {0, 1}
+            got = pa.concat_tables(results[0] + results[1])
+            # every row all three ranks wrote, exactly once across the
+            # two survivors — byte-identical to the fault-free pattern
+            assert got.num_rows == world * n_parts * 2
+            by = sorted(zip(got.column("src").to_pylist(),
+                            got.column("part").to_pylist()))
+            assert by == sorted((r, p) for r in range(world)
+                                for p in range(n_parts)
+                                for _ in range(2))
+            d = QueryStats.delta_since(s0)
+            # both failure modes were DETECTED and healed
+            assert d["integrity_failures"] >= 1          # gray
+            assert d["fragments_recomputed"] >= 1        # corrupt re-pull
+            assert d["fragments_recomputed_remote"] >= 1  # dead re-pull
+            assert d["partitions_reowned"] >= 1           # adoption
+            assert wall < 30  # bounded, nowhere near waitTimeout
+            # survivors retire the shuffle collectively (the close
+            # barrier completes over the ALIVE membership)
+            _close_all(shuffles[:2])
+            shuffles = [shuffles[2]]
+        finally:
+            for sh in shuffles:
+                sh.local.close()
+            for pg in pgs:
+                pg.close()
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# trace_report: integrity:/stalls: summary lines.
+# ---------------------------------------------------------------------------
+
+class TestTraceReportGray:
+    def test_summary_lines_render(self):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        from tools.trace_report import analyze, format_report
+        data = {
+            "traceEvents": [
+                {"ph": "X", "cat": "query", "name": "q", "ts": 0.0,
+                 "dur": 1000.0, "pid": 1, "tid": 0,
+                 "args": {"integrity_failures": 2, "fragments_hedged": 1,
+                          "stalls_detected": 1}},
+                {"ph": "X", "cat": "fault", "name": "peer:slow",
+                 "ts": 1.0, "dur": 0.0, "pid": 1, "tid": 1,
+                 "args": {"rank": 1}},
+            ],
+            "spanTree": [],
+            "otherData": {"label": "gray-q", "status": "ok"},
+        }
+        a = analyze(data)
+        assert a["integrity_failures"] == 2
+        assert a["fragments_hedged"] == 1
+        assert a["stalls_detected"] == 1
+        assert a["peers_slow"] == 1
+        report = format_report(a)
+        assert "integrity: failures=2 hedged=1 slow_peers=1" in report
+        assert "stalls: detected=1" in report
+
+    def test_clean_trace_omits_gray_lines(self):
+        from tools.trace_report import analyze, format_report
+        data = {"traceEvents": [
+            {"ph": "X", "cat": "query", "name": "q", "ts": 0.0,
+             "dur": 100.0, "pid": 1, "tid": 0, "args": {}}],
+            "spanTree": [], "otherData": {"label": "clean"}}
+        report = format_report(analyze(data))
+        assert "integrity:" not in report
+        assert "stalls:" not in report

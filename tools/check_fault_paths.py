@@ -1,6 +1,6 @@
 """Lint: fault handling must be visible and routed through the framework.
 
-Two rules over ``spark_rapids_tpu/``:
+Three rules over ``spark_rapids_tpu/``:
 
   1. **No silently swallowed faults** — a bare ``except Exception:`` /
      ``except BaseException:`` whose body is ``pass`` hides the exact
@@ -16,6 +16,17 @@ Two rules over ``spark_rapids_tpu/``:
      ``faults/recovery.transient_retry``.  Files under ``faults/`` ARE
      the framework and are exempt; anything else needs ``# fault-ok``
      on the sleep line.
+
+  3. **No unbounded blocking waits** — a no-timeout ``Condition.wait()``
+     / ``Event.wait()``, a no-timeout ``Future.result()``, or a raw
+     socket/pipe ``recv(...)`` is exactly where a gray failure (a peer
+     that is slow-not-dead, a wedged native call) turns into a hang no
+     exception ever reports.  Outside ``faults/`` and ``service/`` (the
+     layers whose JOB is waiting — the watchdog, backoff sleeps,
+     cancellation gates), every such wait must either carry a timeout
+     or a ``# wait-ok (<why this wait is bounded/woken>)`` annotation
+     naming the mechanism that bounds it (a cancellation waker, a
+     socket timeout set elsewhere, a prior poll(timeout)).
 
 Run standalone (``python tools/check_fault_paths.py``, exit 1 on
 violations) or let the suite run it: tests/conftest.py invokes
@@ -38,6 +49,11 @@ _TRANSIENT_EXCEPT = re.compile(
     r"^\s*except\b.*\b(OSError|ConnectionError|TimeoutError|"
     r"InterruptedError|Exception)\b")
 _EXEMPT = "# fault-ok"
+# rule 3: empty-arg .wait() / .result() (no timeout) and any .recv(
+# (boundedness lives in socket state the line can't show — annotate)
+_UNBOUNDED_WAIT = re.compile(
+    r"(\.wait\(\s*\)|\.result\(\s*\)|\.recv\s*\()")
+_WAIT_EXEMPT = "# wait-ok"
 # how many lines after an except a sleep still reads as its retry path
 _RETRY_WINDOW = 8
 
@@ -57,8 +73,13 @@ def check(root: str = PKG) -> List[Tuple[str, int, str]]:
     """Return [(relpath, lineno, line)] violations in the package."""
     violations: List[Tuple[str, int, str]] = []
     for dirpath, _dirs, files in os.walk(root):
-        in_framework = os.path.basename(dirpath) == "faults" or \
-            os.sep + "faults" + os.sep in dirpath + os.sep
+        rel_dir = (os.sep + os.path.relpath(dirpath, root) + os.sep)
+        in_framework = os.sep + "faults" + os.sep in rel_dir
+        # service/ is the waiting layer by design (watchdog scans,
+        # cancellation gates, dispatcher parks): rule 3 exempts it
+        # alongside faults/
+        wait_exempt_dir = in_framework \
+            or os.sep + "service" + os.sep in rel_dir
         for fname in sorted(files):
             if not fname.endswith(".py"):
                 continue
@@ -67,6 +88,12 @@ def check(root: str = PKG) -> List[Tuple[str, int, str]]:
                 lines = f.read().splitlines()
             last_transient_except = -10**9
             for lineno, line in enumerate(lines, 1):
+                if not wait_exempt_dir and _UNBOUNDED_WAIT.search(line) \
+                        and _WAIT_EXEMPT not in line \
+                        and not line.lstrip().startswith("#"):
+                    violations.append(
+                        (os.path.relpath(path, root), lineno,
+                         line.strip() + "  [unbounded wait]"))
                 if _EXEMPT in line:
                     continue
                 if _BARE_EXCEPT.search(line) \
@@ -92,11 +119,14 @@ def main() -> int:
         print("check_fault_paths: fault handling clean")
         return 0
     print("check_fault_paths: swallowed faults / ad-hoc transient retry "
-          "loops outside faults/:", file=sys.stderr)
+          "loops / unbounded blocking waits outside faults/ and "
+          "service/:", file=sys.stderr)
     for rel, lineno, line in violations:
         print(f"  spark_rapids_tpu/{rel}:{lineno}: {line}", file=sys.stderr)
     print("route retries through faults.recovery.transient_retry (backoff"
-          " + budget + accounting) or mark the line '# fault-ok (<why>)'.",
+          " + budget + accounting) or mark the line '# fault-ok (<why>)';"
+          " give blocking waits a timeout or mark the line "
+          "'# wait-ok (<what bounds/wakes this wait>)'.",
           file=sys.stderr)
     return 1
 

@@ -262,6 +262,17 @@ def analyze(data: dict) -> dict:
             if e.get("name") == "peer:lost"))),
         "queries_resubmitted": int(qargs.get(
             "queries_resubmitted", _fname("query:resubmitted"))),
+        # gray-failure survival (integrity:fault / fragment:hedged /
+        # peer:slow / watchdog:stall marks; QueryStats snapshot on the
+        # root event authoritative when present)
+        "integrity_failures": int(qargs.get("integrity_failures",
+                                            _fname("integrity:fault"))),
+        "fragments_hedged": int(qargs.get("fragments_hedged",
+                                          _fname("fragment:hedged"))),
+        "peers_slow": _fname("peer:slow"),
+        "stalls_detected": int(qargs.get("stalls_detected",
+                                         _fname("watchdog:stall"))),
+        "watchdog_reclaims": _fname("watchdog:reclaim"),
     }
 
 
@@ -326,6 +337,20 @@ def format_report(a: dict) -> str:
             f"remote_recomputed={a['fragments_recomputed_remote']} "
             f"reowned={a['partitions_reowned']} "
             f"resubmissions={a['queries_resubmitted']}")
+    # gray-failure summary only when corruption was caught or a
+    # straggler was hedged
+    gray = (a.get("integrity_failures", 0) + a.get("fragments_hedged", 0)
+            + a.get("peers_slow", 0))
+    if gray:
+        lines.append(
+            f"integrity: failures={a['integrity_failures']} "
+            f"hedged={a['fragments_hedged']} "
+            f"slow_peers={a['peers_slow']}")
+    # stall summary only when the watchdog acted on this query
+    if a.get("stalls_detected", 0) or a.get("watchdog_reclaims", 0):
+        lines.append(
+            f"stalls: detected={a['stalls_detected']} "
+            f"reclaims={a['watchdog_reclaims']} (watchdog)")
     return "\n".join(lines)
 
 
