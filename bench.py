@@ -46,6 +46,14 @@ under-fault throughput/latency NEXT TO the clean numbers plus the
 transient_retries / fragments_recomputed / degraded_batches /
 retry_backoff_s recovery columns; results are still verified against
 the oracle, so the line also proves recovery preserves answers),
+SRT_BENCH_LOADGEN=1 (serving-traffic proxy: run the sustained-load
+harness — tools/loadgen.py — ahead of the suite and emit its JSON line:
+wire queries over TCP through the network SQL front door with a
+zipf-skewed tenant mix, prepared-statement plan-cache A/B, seeded
+server.conn connection drops, disk spooling, oracle verification, and
+p50/p95/p99 + SLO-violation reporting; SRT_LOADGEN_QUERIES /
+SRT_LOADGEN_CONNECTIONS / SRT_LOADGEN_FAULT_RATE / SRT_LOADGEN_SEED
+parameterize it, and SRT_BENCH_QUERIES="" makes the run loadgen-only),
 SRT_BENCH_KILL_PEER=1 (killed-peer drill: a world=2 DcnShuffle over
 thread ranks commits on both sides, then rank 1 dies SILENTLY
 mid-reduce — the drill prints a dcn_killed_peer_recovery JSON line with
@@ -531,6 +539,16 @@ def main() -> None:
         # killed-peer recovery columns ride their own JSON line ahead of
         # the suite numbers (and are NOT re-run by per-query subprocesses)
         print(json.dumps(_killed_peer_drill()), flush=True)
+    if os.environ.get("SRT_BENCH_LOADGEN", "0") == "1":
+        # serving-traffic proxy: drive the sustained-load harness
+        # (tools/loadgen.py — wire queries over TCP through the network
+        # front door: admission + quotas + prepared plan cache + spool +
+        # seeded server.conn faults, oracle-verified) and emit its JSON
+        # line ahead of the suite numbers.  SRT_LOADGEN_* env knobs
+        # (QUERIES / CONNECTIONS / FAULT_RATE / SEED) parameterize it.
+        print(json.dumps(_loadgen_drill()), flush=True)
+        if os.environ.get("SRT_BENCH_QUERIES", None) == "":
+            return  # loadgen-only invocation
     if conc > 1:
         # concurrency mode defaults to the TPC-H suite (the service
         # replay the scheduler was built for); SRT_BENCH_QUERIES narrows
@@ -587,6 +605,7 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         env = dict(os.environ)
         env["SRT_BENCH_QUERIES"] = q
         env.pop("SRT_BENCH_KILL_PEER", None)  # drill ran once, up top
+        env.pop("SRT_BENCH_LOADGEN", None)    # ditto the loadgen drill
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
@@ -608,6 +627,34 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         # leaves the latest complete snapshot as the last stdout line
         print(json.dumps(_assemble(sf, results, detail)), flush=True)
     print(json.dumps(_assemble(sf, results, detail)), flush=True)
+
+
+def _loadgen_drill() -> dict:
+    """Run the sustained-load harness in-process and return its report
+    (a fresh Session is NOT required — loadgen drives the current one's
+    scheduler through a real TCP front door)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import argparse
+
+    import loadgen as _lg
+    args = argparse.Namespace(
+        queries=int(os.environ.get("SRT_LOADGEN_QUERIES", "1000")),
+        connections=int(os.environ.get("SRT_LOADGEN_CONNECTIONS", "8")),
+        tenants=8, rows=200_000, prepared_frac=0.5,
+        fault_rate=float(os.environ.get("SRT_LOADGEN_FAULT_RATE",
+                                        "0.02")),
+        slow_frac=0.05, slo_ms=2000.0,
+        seed=int(os.environ.get("SRT_LOADGEN_SEED", "42")),
+        tenant_quotas="*=16", serial_ab=20, timeout=600.0,
+        no_verify=False)
+    try:
+        return _lg.run(args)
+    finally:
+        # loadgen tuned session confs (batch size, cache) for the wire
+        # workload: a fresh session keeps the suite numbers untainted
+        import spark_rapids_tpu as _srt
+        _srt.Session.reset()
 
 
 def _backend() -> str:

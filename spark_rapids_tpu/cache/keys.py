@@ -25,7 +25,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
-__all__ = ["CacheKey", "scan_key", "broadcast_key", "plan_fingerprint"]
+__all__ = ["CacheKey", "scan_key", "broadcast_key", "plan_fingerprint",
+           "statement_fingerprint"]
 
 
 @dataclass(frozen=True)
@@ -116,6 +117,24 @@ def plan_fingerprint(node):
             return None
         return ("coalesce", node.node_desc(), child[0]), child[1]
     return None
+
+
+def statement_fingerprint(spec) -> str:
+    """Identity of a prepared statement: sha256 over the CANONICAL JSON
+    of its wire query spec (sorted keys, no whitespace variance).
+
+    Lives here beside the other cache-key derivations so the identity
+    rule has one home: two clients sending byte-different but
+    structurally identical specs share one plan-cache entry, and
+    parameter slots (``["param", i, type]``) are structural — the bound
+    values never enter the key (they bind at execution, exprs.ParamExpr).
+    The server's prepared-statement cache (server/prepared.py) is the
+    only consumer."""
+    import hashlib
+    import json
+    canon = json.dumps(spec, sort_keys=True, separators=(",", ":"),
+                       default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()[:32]
 
 
 def path_covers(key: CacheKey, prefix: str) -> bool:

@@ -728,6 +728,87 @@ DCN_KILL_MODE = register(
     else "must be 'silent' or 'hard'")
 
 
+SERVER_HOST = register(
+    "spark.rapids.tpu.server.host", "127.0.0.1",
+    "Bind address for the network SQL front door (server/endpoint.py): a "
+    "length-prefixed, crc-stamped Arrow IPC streaming endpoint in front "
+    "of the query scheduler (Arrow Flight SQL analog). Loopback by "
+    "default; bind 0.0.0.0 only behind real network auth.")
+
+SERVER_PORT = register(
+    "spark.rapids.tpu.server.port", 0,
+    "TCP port for the SQL front door. 0 picks an ephemeral port "
+    "(SqlFrontDoor.port reports it — the test/loadgen mode).",
+    check=lambda v: None if 0 <= v < 65536 else "must be in [0, 65536)")
+
+SERVER_MAX_CONNECTIONS = register(
+    "spark.rapids.tpu.server.maxConnections", 32,
+    "Concurrent client connections the front door serves. Connections "
+    "beyond it are answered with a typed REJECTED wire error and closed "
+    "— the same shed-don't-queue overload contract as the scheduler's "
+    "admission queue.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_AUTH_TOKEN = register(
+    "spark.rapids.tpu.server.authToken", "",
+    "Shared-secret auth hook for the front door: when set, a client's "
+    "HELLO must present the same token or the connection fails typed "
+    "(UNAUTHENTICATED) and closes. Empty = open (loopback/dev mode). "
+    "The hook is deliberately minimal — per-tenant identity rides the "
+    "HELLO tenant field onto the scheduler's weighted-fair tenants.")
+
+SERVER_TENANT_QUOTAS = register(
+    "spark.rapids.tpu.server.tenantQuotas", "",
+    "Comma list of 'tenant=N' caps on a tenant's in-flight wire queries "
+    "('*=N' sets the default for unlisted tenants; empty/0 = unlimited). "
+    "A query over quota is shed at the protocol layer with a typed "
+    "QUOTA_EXCEEDED wire error BEFORE touching the scheduler — overload "
+    "degrades to a retryable error the client sees immediately, never a "
+    "hang.")
+
+SERVER_IDLE_TIMEOUT = register(
+    "spark.rapids.tpu.server.idleTimeout", 300.0,
+    "Seconds a connection may sit idle (no request frame) before the "
+    "server closes it — the bound on every server-side socket recv, so "
+    "a wedged or vanished client can never pin a connection slot "
+    "forever.", conv=float,
+    check=lambda v: None if v > 0 else "must be > 0")
+
+SERVER_PREPARED_ENABLED = register(
+    "spark.rapids.tpu.server.preparedCache.enabled", True,
+    "Enable the prepared-statement plan cache (server/prepared.py): "
+    "PREPARE parses the query spec and runs logical+physical planning "
+    "ONCE; EXECUTE re-runs the cached physical tree with freshly bound "
+    "parameter values (exprs.ParamExpr) — the single biggest lever for "
+    "small interactive queries, which otherwise pay full planning per "
+    "submit. Disabled, PREPARE still works but replans per execution "
+    "(the A/B debugging mode).")
+
+SERVER_PREPARED_MAX_ENTRIES = register(
+    "spark.rapids.tpu.server.preparedCache.maxEntries", 64,
+    "Statements the prepared-statement plan cache holds (LRU beyond it; "
+    "entries are keyed by the spec's structural fingerprint from "
+    "cache/keys.statement_fingerprint and SHARED across connections, so "
+    "a fleet of clients preparing the same template hits one entry).",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_SPOOL_DIR = register(
+    "spark.rapids.tpu.server.spool.dir", "",
+    "Directory for disk-backed result spooling (server/spool.py). A "
+    "result stream beyond spool.memoryBytes (a large collect, or a "
+    "client reading slower than the device produces) overflows to a "
+    "crc-framed spool file here instead of growing host memory; the "
+    "producer never blocks on the client, so the semaphore permit is "
+    "released as soon as the query finishes computing. Empty = "
+    "<memory.spill.dir>/server_spool.")
+
+SERVER_SPOOL_MEMORY_BYTES = register(
+    "spark.rapids.tpu.server.spool.memoryBytes", 32 << 20,
+    "In-memory buffer per result stream before frames overflow to the "
+    "disk spool.", conv=int,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+
 class TpuConf:
     """An immutable snapshot of settings; unset keys resolve to defaults."""
 

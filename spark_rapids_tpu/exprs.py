@@ -18,6 +18,8 @@ division by zero unless ANSI mode.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -29,6 +31,7 @@ from .types import DataType, TypeSig
 
 __all__ = [
     "Expression", "BoundReference", "UnresolvedColumn", "Literal", "Alias",
+    "ParamExpr", "bind_params",
     "Cast", "Add", "Subtract", "Multiply", "Divide", "IntegralDivide", "Remainder",
     "Pmod", "UnaryMinus", "Abs",
     "EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual", "GreaterThan",
@@ -236,6 +239,80 @@ def _infer_literal_type(v: Any) -> DataType:
                 np.dtype(np.float32): T.FLOAT32,
                 np.dtype(np.float64): T.FLOAT64}[v.dtype]
     raise TypeError(f"cannot infer literal type for {v!r}")
+
+
+# ---------------------------------------------------------------------------------
+# Prepared-statement parameters.  A ParamExpr is a literal-shaped leaf whose
+# VALUE is resolved from a contextvar at evaluation time, not baked at plan
+# time — the prepared-statement plan cache (server/prepared.py) plans a query
+# once and re-executes the same physical tree under different bindings.
+# Deliberately NOT a Literal subclass: plan-time literal consumers (filter
+# pushdown in plan/pushdown.py, scan cache tokens) must skip parameters, or a
+# prepare-time value would be baked into pushed predicates and silently
+# mis-prune later executions.  The value DOES enter the expression
+# fingerprint, so each distinct binding compiles (and caches) its own stage
+# program — exactly like the equivalent inline literal.
+# ---------------------------------------------------------------------------------
+
+_PARAM_BINDINGS: "contextvars.ContextVar[Optional[Tuple[Any, ...]]]" = \
+    contextvars.ContextVar("srt_param_bindings", default=None)
+
+
+@contextlib.contextmanager
+def bind_params(values: Sequence[Any]):
+    """Scope a tuple of prepared-statement parameter values; ParamExpr
+    leaves in any plan executed inside resolve against it.  Scheduler
+    workers run copied contexts, so a binding installed inside the
+    submitted callable stays isolated per query."""
+    tok = _PARAM_BINDINGS.set(tuple(values))
+    try:
+        yield
+    finally:
+        _PARAM_BINDINGS.reset(tok)
+
+
+class ParamExpr(Expression):
+    """Placeholder for prepared-statement parameter ``index`` with a
+    DECLARED type (the spec carries it — planning needs the dtype before
+    any value exists)."""
+
+    input_sig = TypeSig.device_compute + TypeSig.decimal128
+    output_sig = TypeSig.device_compute + TypeSig.decimal128
+
+    def __init__(self, index: int, dtype: DataType):
+        self.index = int(index)
+        self.dtype = dtype
+        self.nullable = True
+        self.children = ()
+
+    @property
+    def value(self) -> Any:
+        vals = _PARAM_BINDINGS.get()
+        if vals is None:
+            raise RuntimeError(
+                f"parameter ?{self.index} evaluated outside bind_params() "
+                f"— prepared statements execute through "
+                f"server/prepared.py, which installs the binding scope")
+        if self.index >= len(vals):
+            raise RuntimeError(
+                f"parameter ?{self.index} unbound: only {len(vals)} "
+                f"values supplied")
+        return vals[self.index]
+
+    def eval(self, ctx: "EvalContext") -> Value:
+        # delegate to Literal for the physical encoding (decimal scaling,
+        # epoch conversion, null broadcast) — one literal lowering
+        return Literal(self.value, self.dtype).eval(ctx)
+
+    def _fp_extra(self):
+        # BOUND, the value keys the program cache: distinct bindings are
+        # distinct programs, identical re-bindings reuse the executable.
+        # UNBOUND (plan-time explain/node_desc rendering), stay
+        # structural — `?i` — like the SQL placeholder it is.
+        vals = _PARAM_BINDINGS.get()
+        if vals is None or self.index >= len(vals):
+            return f"?{self.index}:{self.dtype}"
+        return f"?{self.index}={vals[self.index]!r}:{self.dtype}"
 
 
 class Alias(Expression):

@@ -56,11 +56,17 @@ __all__ = ["POINTS", "InjectedFault", "FaultInjector", "INJECTOR"]
 #   * ``device.hang`` — wedge the dispatch until cancelled (the
 #     watchdog's prey: no batch progress, no exception);
 #   * ``dcn.slow_peer`` — the peer server answers, but late (the
-#     straggler-hedging prey: slow is not dead).
+#     straggler-hedging prey: slow is not dead);
+#   * ``server.conn`` — the network front door's client drops
+#     mid-result-stream (server/endpoint.py consults maybe_fire at each
+#     BATCH send and ACTS the drop out: closes the connection and
+#     unwinds through the real disconnect path — cooperative cancel,
+#     permit + quota + spool release; the leak-hygiene and loadgen
+#     suites assert zero residue).
 POINTS = ("io.read", "io.write", "shuffle.fragment", "dcn.heartbeat",
           "device.op", "cache.lookup", "dcn.peer_kill",
           "shuffle.corrupt", "spill.corrupt", "cache.corrupt",
-          "device.hang", "dcn.slow_peer")
+          "device.hang", "dcn.slow_peer", "server.conn")
 
 
 class InjectedFault(TransientFault):
@@ -108,6 +114,7 @@ class FaultInjector:
         self._rate = 0.0
         self._rate_points: Tuple[str, ...] = POINTS
         self._rng = random.Random(0)
+        self._armed_args = None  # last arm() arguments (see arm())
         self._counts: Dict[str, int] = {}
         # cumulative per-point injections: survives re-arming (chaos
         # suites assert coverage across several queries), reset only by
@@ -124,11 +131,21 @@ class FaultInjector:
             if p not in POINTS:
                 raise ValueError(
                     f"unknown injection point {p!r}; registered: {POINTS}")
+        args = (schedule, float(rate), sel, seed)
         with self._lock:
             self._sched = sched
             self._rate = max(0.0, float(rate))
             self._rate_points = sel
-            self._rng = random.Random(seed or 0)
+            # Re-arming with IDENTICAL arguments (every ExecContext of a
+            # chaos run re-arms from the same confs) preserves the RNG
+            # stream: rate mode stays a true seeded rate across queries.
+            # Re-seeding on every query would collapse "rate" into a
+            # fixed threshold over the first few draws of one sequence —
+            # all-or-nothing per send position instead of probabilistic.
+            # Any changed argument reseeds, so runs still replay exactly.
+            if args != self._armed_args:
+                self._rng = random.Random(seed or 0)
+                self._armed_args = args
             self._counts = {}
 
     def arm_from_conf(self, conf) -> None:

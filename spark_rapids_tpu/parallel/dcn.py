@@ -210,7 +210,7 @@ class Coordinator:
     def _accept_loop(self) -> None:
         while not self._closed:
             try:
-                conn, _ = self._srv.accept()
+                conn, _ = self._srv.accept()  # wait-ok (close() closes the listening socket -> OSError exits the loop)
             except OSError:
                 return
             self._conns.append(conn)
@@ -461,7 +461,7 @@ class _PeerServer:
     def _accept_loop(self) -> None:
         while not self._closed:
             try:
-                conn, _ = self._srv.accept()
+                conn, _ = self._srv.accept()  # wait-ok (close() closes the listening socket -> OSError exits the loop)
             except OSError:
                 return
             with self._lock:

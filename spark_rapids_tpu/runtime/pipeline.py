@@ -232,3 +232,34 @@ def pipeline_batches(batches: Iterable[T], depth: int,
     the child's host decode/upload/dispatch runs on the worker thread
     while the consumer's XLA program is in flight."""
     return pipeline_map(batches, lambda b: b, depth, label=label)
+
+
+def stream_arrow(ctx, batches) -> "Iterator":
+    """Yield pyarrow tables from a stream of device batches with up to
+    ``pipeline.depth`` D2H fetches resolving BEHIND the dispatch front —
+    the fetch→wire handoff: batch N's device→host copy overlaps batch
+    N+1's dispatch, so a network consumer (server/endpoint.py result
+    streaming) puts Arrow IPC frames on the wire as fetches complete
+    instead of collect-then-ship.  Depth 0 degrades to the serial
+    fetch-per-batch loop (the CollectExec.collect_arrow discipline,
+    applied to incremental consumers).  Cancellation is checked at every
+    batch boundary; abandoning the generator drains nothing (pending
+    fetch futures resolve on close)."""
+    from collections import deque
+
+    from ..batch import to_arrow, to_arrow_async
+    from ..service import cancel
+    depth = effective_depth(ctx)
+    if depth <= 0:
+        for b in batches:
+            cancel.check()
+            yield to_arrow(b)
+        return
+    pending: "deque" = deque()
+    for b in batches:
+        cancel.check()
+        pending.append(to_arrow_async(b))
+        while len(pending) > depth:
+            yield pending.popleft()()
+    while pending:
+        yield pending.popleft()()

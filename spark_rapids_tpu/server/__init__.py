@@ -1,0 +1,38 @@
+"""Network SQL front door: Arrow IPC streaming endpoint + prepared
+statements + tenant quotas in front of the query scheduler.
+
+The engine's north star is a service; until this package it was
+reachable only via in-process Python.  ``SqlFrontDoor`` is the wire:
+
+  * :mod:`.protocol` — length-prefixed, crc-stamped frames (the
+    host-shuffle frame discipline) carrying JSON control messages and
+    raw Arrow IPC result batches, with TYPED error frames for every
+    shed/failure mode;
+  * :mod:`.spec` — the JSON query DSL compiled server-side against a
+    registered-table catalog (Flight SQL shape);
+  * :mod:`.prepared` — the prepared-statement plan cache: parse/plan
+    once at PREPARE, re-execute the cached physical tree with freshly
+    bound parameters at EXECUTE (``exprs.ParamExpr``);
+  * :mod:`.session` — auth hook + per-tenant in-flight quotas (typed
+    QUOTA_EXCEEDED shedding in front of the scheduler's admission);
+  * :mod:`.spool` — disk-backed result spooling so slow clients and
+    large collects never pin device-side resources;
+  * :mod:`.endpoint` — the TCP server tying it together;
+  * :mod:`.client` — the reference client (tests + tools/loadgen.py).
+
+See docs/serving.md for the protocol and operations guide.
+"""
+
+from .client import ResultSet, WireClient
+from .endpoint import SqlFrontDoor
+from .prepared import PreparedCache, PreparedStatement
+from .protocol import ProtocolError, WireError
+from .session import ClientSession, TenantQuotas
+from .spec import BadSpec, compile_spec
+from .spool import ResultStream
+
+__all__ = [
+    "SqlFrontDoor", "WireClient", "ResultSet", "WireError",
+    "ProtocolError", "BadSpec", "compile_spec", "PreparedCache",
+    "PreparedStatement", "ClientSession", "TenantQuotas", "ResultStream",
+]

@@ -1,0 +1,158 @@
+"""Minimal wire client for the SQL front door.
+
+Speaks :mod:`.protocol` over one TCP connection: HELLO/auth, ad-hoc
+SUBMIT, PREPARE/EXECUTE prepared statements, cancel-by-id, STATUS.
+Results arrive as a stream of Arrow IPC batches; :meth:`WireClient.query`
+collects them, :meth:`WireClient.query_stream` yields them
+incrementally (the shape a slow consumer uses — the server spools
+behind it).  Used by :mod:`tests.test_server` and ``tools/loadgen.py``;
+it is deliberately synchronous and single-connection — fleet behavior
+comes from running many of them.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, Iterator, List, Optional
+
+from . import protocol as P
+from .protocol import WireError
+
+__all__ = ["WireClient", "ResultSet"]
+
+
+class ResultSet:
+    """A collected wire result: schema, pyarrow tables, END stats."""
+
+    __slots__ = ("query_id", "schema", "tables", "stats", "prepared")
+
+    def __init__(self, query_id, schema, tables, stats, prepared):
+        self.query_id = query_id
+        self.schema = schema
+        self.tables = tables
+        self.stats = stats
+        self.prepared = prepared
+
+    def table(self):
+        """One concatenated pyarrow table (None for an empty result)."""
+        import pyarrow as pa
+        return pa.concat_tables(self.tables) if self.tables else None
+
+    def rows(self) -> List[tuple]:
+        """Rows as python tuples — directly comparable with
+        ``DataFrame.collect()`` (the in-process oracle)."""
+        t = self.table()
+        if t is None:
+            return []
+        cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
+        return [tuple(c[i] for c in cols) for i in range(t.num_rows)]
+
+
+class WireClient:
+    """One connection to a :class:`..server.endpoint.SqlFrontDoor`."""
+
+    def __init__(self, host: str, port: int, tenant: str = "default",
+                 token: str = "", weight: float = 1.0,
+                 timeout: float = 120.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        # small request frames answered promptly: Nagle + delayed-ACK
+        # would add ~40ms to every round trip
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.session_id: Optional[str] = None
+        P.send_frame(self._sock, P.REQ_HELLO, P.pack_json(
+            {"token": token, "tenant": tenant, "weight": weight}))
+        _, payload = P.recv_frame(self._sock, expect=(P.RSP_WELCOME,))
+        self.session_id = P.unpack_json(payload)["session_id"]
+
+    # -- statements ---------------------------------------------------------------
+    def prepare(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """PREPARE: returns {statement_id, param_types, cached, plan_ms,
+        schema}."""
+        P.send_frame(self._sock, P.REQ_PREPARE,
+                     P.pack_json({"spec": spec}))
+        _, payload = P.recv_frame(self._sock, expect=(P.RSP_PREPARED,))
+        return P.unpack_json(payload)
+
+    def execute(self, statement_id: str, params: Optional[list] = None,
+                **kw) -> ResultSet:
+        """EXECUTE a prepared statement with bound parameter values."""
+        req = {"statement_id": statement_id, "params": params or []}
+        req.update(kw)
+        P.send_frame(self._sock, P.REQ_EXECUTE, P.pack_json(req))
+        return self._collect_result()
+
+    def query(self, spec: Dict[str, Any], params: Optional[list] = None,
+              **kw) -> ResultSet:
+        """Ad-hoc SUBMIT (plans server-side per execution)."""
+        req = {"spec": spec, "params": params or []}
+        req.update(kw)
+        P.send_frame(self._sock, P.REQ_SUBMIT, P.pack_json(req))
+        return self._collect_result()
+
+    def query_stream(self, spec: Dict[str, Any],
+                     params: Optional[list] = None, **kw
+                     ) -> Iterator:
+        """SUBMIT yielding ('meta'|'batch'|'end', value) incrementally —
+        a deliberately slow consumer of this iterator exercises the
+        server's disk spool."""
+        req = {"spec": spec, "params": params or []}
+        req.update(kw)
+        P.send_frame(self._sock, P.REQ_SUBMIT, P.pack_json(req))
+        ftype, payload = P.recv_frame(self._sock, expect=(P.RSP_META,))
+        yield "meta", P.unpack_json(payload)
+        while True:
+            ftype, payload = P.recv_frame(
+                self._sock, expect=(P.RSP_BATCH, P.RSP_END))
+            if ftype == P.RSP_END:
+                yield "end", P.unpack_json(payload)
+                return
+            yield "batch", _read_ipc(payload)
+
+    def _collect_result(self) -> ResultSet:
+        ftype, payload = P.recv_frame(self._sock, expect=(P.RSP_META,))
+        meta = P.unpack_json(payload)
+        tables = []
+        while True:
+            ftype, payload = P.recv_frame(
+                self._sock, expect=(P.RSP_BATCH, P.RSP_END))
+            if ftype == P.RSP_END:
+                end = P.unpack_json(payload)
+                return ResultSet(meta["query_id"], meta["schema"],
+                                 tables, end, end.get("prepared", False))
+            tables.append(_read_ipc(payload))
+
+    # -- control ------------------------------------------------------------------
+    def cancel(self, query_id: str) -> bool:
+        P.send_frame(self._sock, P.REQ_CANCEL,
+                     P.pack_json({"query_id": query_id}))
+        _, payload = P.recv_frame(self._sock, expect=(P.RSP_CANCELLED,))
+        return bool(P.unpack_json(payload)["cancelled"])
+
+    def status(self) -> Dict[str, Any]:
+        P.send_frame(self._sock, P.REQ_STATUS)
+        _, payload = P.recv_frame(self._sock, expect=(P.RSP_STATUS,))
+        return P.unpack_json(payload)
+
+    def close(self) -> None:
+        try:
+            P.send_frame(self._sock, P.REQ_BYE)
+            P.recv_frame(self._sock, expect=(P.RSP_BYE,))
+        except (OSError, WireError, P.ProtocolError):
+            pass  # fault-ok (best-effort goodbye; the server reaps dead connections either way)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "WireClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _read_ipc(payload: bytes):
+    import pyarrow as pa
+    with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
+        return r.read_all()

@@ -1,0 +1,573 @@
+"""The network SQL front door: a TCP Arrow-IPC streaming endpoint in
+front of the query scheduler.
+
+``SqlFrontDoor`` binds ``spark.rapids.tpu.server.{host,port}`` and
+serves the :mod:`.protocol` frame protocol: clients HELLO (auth +
+tenant), then SUBMIT ad-hoc specs or PREPARE/EXECUTE prepared
+statements; results stream back one Arrow IPC ``BATCH`` frame per
+device batch as its D2H fetch completes (``Session`` streaming entry
+points riding :func:`..runtime.pipeline.stream_arrow`), with
+disk-backed spooling (:mod:`.spool`) so a slow client never pins the
+device.  Every query runs through the session's
+:class:`..service.scheduler.QueryScheduler` — admission control,
+weighted-fair tenants, deadlines, cancellation, watchdog, and
+resubmission all apply to wire traffic exactly as to in-process
+queries; what the wire adds is typed OVERLOAD shedding (connection cap,
+tenant quotas, admission rejection → error frames the client can retry)
+and the ``server.conn`` failure mode: a client that drops mid-stream
+triggers cooperative cancel and full resource release (permits, quota,
+spool, registry) at the server.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from . import protocol as P
+from .prepared import PreparedCache
+from .protocol import WireError
+from .session import ClientSession, TenantQuotas, authenticate
+from .spec import BadSpec, coerce_params, compile_spec
+from .spool import ResultStream, gc_orphan_spools
+
+__all__ = ["SqlFrontDoor"]
+
+_pc = time.perf_counter
+_query_ids = itertools.count(1)
+
+
+def _ipc_bytes(table) -> bytes:
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _schema_json(schema) -> list:
+    return [[f.name, str(f.dtype), bool(f.nullable)] for f in schema]
+
+
+class _WireQuery:
+    """Registry entry for one in-flight wire query (cancel-by-id and
+    disconnect cleanup address it)."""
+
+    __slots__ = ("query_id", "handle", "stream", "tenant", "label")
+
+    def __init__(self, query_id, handle, stream, tenant, label):
+        self.query_id = query_id
+        self.handle = handle
+        self.stream = stream
+        self.tenant = tenant
+        self.label = label
+
+
+class SqlFrontDoor:
+    """One session's network endpoint.  ``start()`` binds and serves;
+    ``close()`` cancels in-flight wire queries and tears down."""
+
+    def __init__(self, session, settings: Optional[dict] = None):
+        self._session = session
+        self._settings = dict(settings or {})
+        conf = self._conf()
+        self._tables: Dict[str, Any] = {}
+        self.prepared = PreparedCache()
+        self.quotas = TenantQuotas(
+            conf["spark.rapids.tpu.server.tenantQuotas"])
+        self._lock = threading.Lock()
+        self._queries: Dict[str, _WireQuery] = {}
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_ids = itertools.count(1)
+        self._srv: Optional[socket.socket] = None
+        self._accept_th: Optional[threading.Thread] = None
+        self._closed = False
+        # lifetime counters (STATUS + the loadgen report read these)
+        self.connections_total = 0
+        self.connections_rejected = 0
+        self.queries_total = 0
+        self.conn_lost = 0
+        self.streamed_bytes = 0
+        self.spooled_bytes = 0
+
+    # -- lifecycle ----------------------------------------------------------------
+    def _conf(self):
+        conf = self._session._tpu_conf()
+        if self._settings:
+            conf = conf.with_settings(**self._settings)
+        return conf
+
+    def _spool_dir(self, conf) -> str:
+        import os
+        d = conf["spark.rapids.tpu.server.spool.dir"]
+        if not d:
+            d = os.path.join(conf["spark.rapids.tpu.memory.spill.dir"],
+                             "server_spool")
+        return d
+
+    def register_table(self, name: str, df_or_factory) -> None:
+        """Expose a DataFrame (or zero-arg factory) to wire clients
+        under ``name`` — the server-side catalog (Flight SQL shape)."""
+        self._tables[name] = df_or_factory
+
+    def start(self) -> "SqlFrontDoor":
+        conf = self._conf()
+        gc_orphan_spools(self._spool_dir(conf))
+        host = conf["spark.rapids.tpu.server.host"]
+        port = conf["spark.rapids.tpu.server.port"]
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.5)  # bounds accept(); close() is prompt
+        self._accept_th = threading.Thread(  # ctx-ok (accept loop; per-query contexts are the scheduler's)
+            target=self._accept_loop, daemon=True,
+            name="srt-server-accept")
+        self._accept_th.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        assert self._srv is not None, "start() first"
+        return self._srv.getsockname()[1]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = list(self._conns.values())
+            queries = list(self._queries.values())
+        for q in queries:
+            q.handle.cancel("server closing")
+            q.stream.close()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        if self._accept_th is not None:
+            self._accept_th.join(timeout=2.0)
+
+    # -- accept -------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        conf = self._conf()
+        max_conns = conf["spark.rapids.tpu.server.maxConnections"]
+        while not self._closed:
+            try:
+                conn, addr = self._srv.accept()  # wait-ok (listener carries settimeout(0.5) set in start())
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # closed
+            self.connections_total += 1
+            with self._lock:
+                if self._closed or len(self._conns) >= max_conns:
+                    over = True
+                else:
+                    over = False
+                    cid = next(self._conn_ids)
+                    self._conns[cid] = conn
+            if over:
+                self.connections_rejected += 1
+                try:
+                    P.send_frame(conn, P.RSP_ERROR, WireError(
+                        "REJECTED",
+                        f"connection cap reached "
+                        f"(maxConnections={max_conns}); retry later"
+                    ).to_payload())
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            th = threading.Thread(  # ctx-ok (connection handler; per-query contexts are the scheduler's)
+                target=self._handle_conn, args=(cid, conn, addr),
+                daemon=True, name=f"srt-server-conn-{cid}")
+            th.start()
+
+    # -- connection handler -------------------------------------------------------
+    def _handle_conn(self, cid: int, conn: socket.socket, addr) -> None:
+        conf = self._conf()
+        conn.settimeout(conf["spark.rapids.tpu.server.idleTimeout"])
+        # request/response over small frames: Nagle + delayed-ACK turns
+        # every META→BATCH→END sequence into ~40ms stalls — disable it
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        csess: Optional[ClientSession] = None
+        conn_stmts: Dict[str, dict] = {}  # fingerprint -> spec (re-plan fallback)
+        try:
+            ftype, payload = P.recv_frame(conn, expect=(P.REQ_HELLO,))
+            hello = P.unpack_json(payload)
+            authenticate(conf, hello.get("token", ""))
+            csess = ClientSession(tenant=hello.get("tenant", "default"),
+                                  weight=hello.get("weight", 1.0),
+                                  peer=f"{addr[0]}:{addr[1]}")
+            P.send_frame(conn, P.RSP_WELCOME, P.pack_json(
+                {"session_id": csess.session_id, "tenant": csess.tenant,
+                 "protocol": 1}))
+            while not self._closed:
+                ftype, payload = P.recv_frame(conn)
+                if ftype == P.REQ_BYE:
+                    P.send_frame(conn, P.RSP_BYE)
+                    return
+                if ftype == P.REQ_STATUS:
+                    P.send_frame(conn, P.RSP_STATUS,
+                                 P.pack_json(self.snapshot()))
+                    continue
+                if ftype == P.REQ_CANCEL:
+                    req = P.unpack_json(payload)
+                    ok = self._cancel_query(req.get("query_id", ""))
+                    P.send_frame(conn, P.RSP_CANCELLED,
+                                 P.pack_json({"cancelled": ok}))
+                    continue
+                try:
+                    if ftype == P.REQ_PREPARE:
+                        req = P.unpack_json(payload)
+                        self._do_prepare(conn, req, conn_stmts)
+                    elif ftype in (P.REQ_SUBMIT, P.REQ_EXECUTE):
+                        req = P.unpack_json(payload)
+                        self._do_query(conn, csess, ftype, req,
+                                       conn_stmts)
+                    else:
+                        raise WireError("BAD_REQUEST",
+                                        f"unexpected frame {ftype!r}")
+                except BadSpec as e:
+                    # the client's mistake, answered typed — the
+                    # CONNECTION survives it (only transport breakage
+                    # tears a connection down)
+                    self._try_error(conn, WireError("BAD_REQUEST",
+                                                    str(e)))
+                except WireError as e:
+                    self._try_error(conn, e)
+        except WireError as e:
+            self._try_error(conn, e)
+        except (P.ProtocolError, ConnectionError, socket.timeout, OSError):
+            # the client vanished (or the byte stream broke, or the
+            # server.conn injector simulated exactly that): cooperative
+            # cancel + full release already ran in _client_gone for any
+            # query this connection owned mid-stream
+            pass  # fault-ok (client-gone is the expected teardown path; queries were cancelled in _client_gone)
+        except BadSpec as e:
+            self._try_error(conn, WireError("BAD_REQUEST", str(e)))
+        finally:
+            with self._lock:
+                self._conns.pop(cid, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _try_error(self, conn, err: WireError) -> None:
+        try:
+            P.send_frame(conn, P.RSP_ERROR, err.to_payload())
+        except OSError:
+            pass
+
+    # -- prepare ------------------------------------------------------------------
+    def _do_prepare(self, conn, req: dict, conn_stmts: Dict[str, dict]
+                    ) -> None:
+        spec = req.get("spec")
+        if not isinstance(spec, dict):
+            raise WireError("BAD_REQUEST", "prepare needs a spec object")
+        conf = self._conf()
+        try:
+            stmt, cached = self.prepared.prepare(
+                self._session, spec, self._tables, conf)
+        except BadSpec as e:
+            raise WireError("BAD_REQUEST", str(e))
+        conn_stmts[stmt.fingerprint] = spec
+        P.send_frame(conn, P.RSP_PREPARED, P.pack_json(
+            {"statement_id": stmt.fingerprint,
+             "param_types": stmt.param_types,
+             "cached": cached,
+             "plan_ms": round(stmt.plan_s * 1e3, 3),
+             "schema": _schema_json(stmt.schema)}))
+
+    # -- query execution ----------------------------------------------------------
+    def _do_query(self, conn, csess: ClientSession, ftype, req: dict,
+                  conn_stmts: Dict[str, dict]) -> None:
+        """SUBMIT (fresh spec) or EXECUTE (prepared).  Streams META,
+        BATCH*, END on success; raises WireError for typed failures the
+        handler answers with one ERROR frame."""
+        conf = self._conf()
+        params = req.get("params") or []
+        prepared_run = False
+        plan_saved_ms = 0.0
+        if ftype == P.REQ_EXECUTE:
+            fp = req.get("statement_id", "")
+            stmt = self.prepared.get(fp)
+            if stmt is not None \
+                    and conf["spark.rapids.tpu.server.preparedCache.enabled"]:
+                # THE fast path: planning already paid at PREPARE time
+                values = coerce_params(params, stmt.param_types)
+                phys = stmt.clone_for_run()
+                schema = stmt.schema
+                prepared_run = True
+                plan_saved_ms = stmt.plan_s * 1e3
+                run = self._planned_runner(phys, values)
+            else:
+                spec = conn_stmts.get(fp)
+                if spec is None:
+                    raise WireError(
+                        "NOT_FOUND",
+                        f"unknown statement {fp!r} (prepare it on this "
+                        f"connection, or the cache evicted it)")
+                df, ptypes = compile_spec(spec, self._tables)
+                values = coerce_params(params, ptypes)
+                schema = df._plan.schema()
+                run = self._plan_runner(df, values)
+        else:
+            spec = req.get("spec")
+            if not isinstance(spec, dict):
+                raise WireError("BAD_REQUEST", "submit needs a spec object")
+            df, ptypes = compile_spec(spec, self._tables)
+            values = coerce_params(params, ptypes)
+            schema = df._plan.schema()
+            run = self._plan_runner(df, values)
+
+        label = req.get("label") or f"wire-{next(_query_ids):06d}"
+        query_id = f"{csess.session_id}/{label}"
+        deadline_ms = req.get("deadline_ms") or 0
+        stream = ResultStream(query_id,
+                              conf["spark.rapids.tpu.server.spool.memoryBytes"],
+                              self._spool_dir(conf))
+
+        self.quotas.acquire(csess.tenant)  # typed QUOTA_EXCEEDED
+        try:
+            wq = self._submit(csess, label, query_id, run, stream,
+                              req, deadline_ms)
+        except BaseException:
+            self.quotas.release(csess.tenant)
+            stream.close()
+            raise
+        try:
+            self._stream_result(conn, wq, schema, prepared_run,
+                                plan_saved_ms)
+        except (ConnectionError, socket.timeout, OSError,
+                P.ProtocolError):
+            # mid-stream client drop (real, or server.conn-injected):
+            # cancel cooperatively, release everything, re-raise so the
+            # handler closes the connection
+            self._client_gone(wq)
+            raise
+        finally:
+            self._finish_query(wq, csess.tenant)
+
+    def _planned_runner(self, phys, values) -> Callable:
+        """The prepared fast path's worker body: bind parameters, stream
+        the CLONED planned tree — no logical planning, no overrides."""
+        from ..exprs import bind_params
+        session = self._session
+
+        def run(stream: ResultStream) -> int:
+            rows = 0
+            with bind_params(values):
+                for table in session._execute_planned_stream(phys):
+                    rows += table.num_rows
+                    if not stream.put(_ipc_bytes(table)):
+                        self._producer_abandon()
+                    tracing_progress()
+            return rows
+
+        return run
+
+    def _plan_runner(self, df, values) -> Callable:
+        """Fresh-submit worker body: full planning inside the query
+        scope (its cost is visible in the query's latency — exactly what
+        the prepared path eliminates)."""
+        from ..exprs import bind_params
+        session = self._session
+
+        def run(stream: ResultStream) -> int:
+            rows = 0
+            with bind_params(values):
+                for table in session._stream_plan(df._plan):
+                    rows += table.num_rows
+                    if not stream.put(_ipc_bytes(table)):
+                        self._producer_abandon()
+                    tracing_progress()
+            return rows
+
+        return run
+
+    @staticmethod
+    def _producer_abandon():
+        """The consumer closed the stream (client gone): stop producing
+        NOW — cooperative cancel is already in flight, this makes the
+        unwind deterministic at the current batch boundary."""
+        from ..service.cancel import QueryCancelled
+        from ..service import cancel
+        cancel.check()  # prefer the control's typed reason when set
+        raise QueryCancelled("client disconnected mid-stream")
+
+    def _submit(self, csess, label, query_id, run, stream, req,
+                deadline_ms) -> _WireQuery:
+        from ..service.scheduler import QueryRejected
+
+        def work():
+            # runs on the scheduler worker in a copied context: stats/
+            # trace/cancel are query-scoped; server attrs ride the
+            # control into the trace root (Session._note_scheduler)
+            try:
+                rows = run(stream)
+            except BaseException as e:
+                # the consumer must never wait out a silent producer
+                # death: every exit finishes or fails the stream, THEN
+                # the scheduler's ordinary unwind/typing applies
+                stream.fail(e)
+                raise
+            stream.finish({"rows": rows})
+            return rows
+
+        try:
+            handle = self._session.submit(
+                work,
+                priority=req.get("priority"),
+                deadline_s=(deadline_ms / 1e3) if deadline_ms else None,
+                tenant=csess.tenant, weight=csess.weight, label=label)
+        except QueryRejected as e:
+            raise WireError("REJECTED", str(e))
+        handle._entry.control.server_attrs = {
+            "connection": csess.session_id, "peer": csess.peer,
+            "wire_query": query_id,
+            "prepared": bool(req.get("statement_id"))}
+        self.queries_total += 1
+        wq = _WireQuery(query_id, handle, stream, csess.tenant, label)
+        with self._lock:
+            self._queries[query_id] = wq
+        return wq
+
+    def _stream_result(self, conn, wq: _WireQuery, schema,
+                       prepared_run: bool, plan_saved_ms: float) -> None:
+        """Connection-thread side: META, BATCH frames as the producer
+        lands them (each send a ``server.conn`` injection point and a
+        ``server:stream_write`` span in the query's trace), then END."""
+        from ..faults.injector import INJECTOR
+        from ..faults.recovery import QueryFaulted
+        from ..service.cancel import (QueryCancelled,
+                                      QueryDeadlineExceeded)
+        t_first = None
+        sent = 0
+        P.send_frame(conn, P.RSP_META, P.pack_json(
+            {"query_id": wq.query_id, "schema": _schema_json(schema),
+             "prepared": prepared_run}))
+        try:
+            for payload in wq.stream.frames():
+                if INJECTOR.maybe_fire("server.conn",
+                                       desc=wq.query_id):
+                    # act the drop out: the client is "gone" — close our
+                    # side and unwind exactly like a real disconnect
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    raise ConnectionError(
+                        "server.conn fault injected: client dropped "
+                        "mid-stream")
+                t0 = _pc()
+                n = P.send_frame(conn, P.RSP_BATCH, payload)
+                if t_first is None:
+                    t_first = _pc()
+                sent += n
+                self.streamed_bytes += n
+                tr = wq.handle.trace()
+                if tr is not None:
+                    tr.add_event(None, "server:stream_write", "server",
+                                 t0, _pc() - t0,
+                                 {"bytes": n, "query": wq.query_id})
+        except BaseException as e:
+            # the producer failed (stream.frames re-raises its error):
+            # answer TYPED; anything unmapped is either a transport
+            # failure (re-raise: the caller treats it as client-gone) or
+            # the server's own bug (INTERNAL)
+            if isinstance(e, (ConnectionError, socket.timeout, OSError,
+                              P.ProtocolError)):
+                raise
+            if isinstance(e, QueryFaulted):
+                code, detail = "FAULTED", getattr(e, "point", "") or ""
+            elif isinstance(e, QueryDeadlineExceeded):
+                code, detail = "DEADLINE", ""
+            elif isinstance(e, QueryCancelled):
+                code, detail = "CANCELLED", ""
+            else:
+                code, detail = "INTERNAL", type(e).__name__
+            self._try_error(conn, WireError(code, str(e), detail=detail))
+            return
+        self.spooled_bytes += wq.stream.spooled_bytes
+        # the producer finished; the handle resolves imminently
+        try:
+            wq.handle.result(timeout=30.0)
+            status = wq.handle.status
+        except BaseException:
+            status = wq.handle.status
+        P.send_frame(conn, P.RSP_END, P.pack_json(
+            {"query_id": wq.query_id, "status": status,
+             "rows": wq.stream.stats.get("rows", 0),
+             "batches": wq.stream.frames_total,
+             "stream_bytes": wq.stream.bytes_total,
+             "spooled_bytes": wq.stream.spooled_bytes,
+             "prepared": prepared_run,
+             "plan_saved_ms": round(plan_saved_ms, 3),
+             "queue_wait_ms": round(wq.handle.queue_wait_s * 1e3, 3),
+             "latency_ms": round((wq.handle.latency_s or 0.0) * 1e3, 3),
+             "stats": wq.handle.stats or {}}))
+
+    # -- cleanup ------------------------------------------------------------------
+    def _client_gone(self, wq: _WireQuery) -> None:
+        """A connection died with a query in flight: cancel it
+        cooperatively (the worker also stops at its next stream.put) and
+        release the spool.  Quota release is in _finish_query's caller
+        path; permits/slots/handles release through the ordinary
+        scheduler unwind — the leak-hygiene tests assert all of it."""
+        self.conn_lost += 1
+        wq.handle.cancel("client disconnected")
+        wq.stream.close()
+
+    def _finish_query(self, wq: _WireQuery, tenant: str) -> None:
+        self.quotas.release(tenant)
+        wq.stream.close()
+        with self._lock:
+            self._queries.pop(wq.query_id, None)
+
+    def _cancel_query(self, query_id: str) -> bool:
+        with self._lock:
+            wq = self._queries.get(query_id)
+        if wq is None:
+            return False
+        return wq.handle.cancel("cancelled over the wire")
+
+    # -- introspection ------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        sched = self._session.scheduler()
+        with self._lock:
+            running = len(self._queries)
+            conns = len(self._conns)
+        return {
+            "connections": conns,
+            "connections_total": self.connections_total,
+            "connections_rejected": self.connections_rejected,
+            "queries_total": self.queries_total,
+            "queries_inflight": running,
+            "conn_lost": self.conn_lost,
+            "streamed_bytes": self.streamed_bytes,
+            "spooled_bytes": self.spooled_bytes,
+            "scheduler": sched.snapshot(),
+            "prepared": self.prepared.snapshot(),
+        }
+
+
+def tracing_progress() -> None:
+    """Stamp watchdog progress from the producer loop: a query steadily
+    streaming a huge result is NOT stalled even if no operator batch
+    boundary is crossed for a while (spool writes are progress)."""
+    from ..service import cancel
+    ctl = cancel.current()
+    if ctl is not None:
+        ctl.note_progress()

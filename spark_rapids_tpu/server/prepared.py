@@ -1,0 +1,153 @@
+"""Prepared-statement plan cache: parse/plan once, re-execute with
+bound literals.
+
+Before this cache every wire submit paid the full planning stack —
+spec parse, logical plan build, optimizer, overrides conversion,
+coalesce insertion — per execution.  For the small interactive queries
+the Presto-with-GPUs paper profiles, that planning overhead rivals the
+execution itself; PREPARE moves it off the hot path:
+
+  * **identity** — :func:`..cache.keys.statement_fingerprint` over the
+    spec's canonical JSON; parameter slots (``["param", i, type]``) are
+    structural, so the cache is shared across connections and bound
+    values never enter the key;
+  * **plan once** — PREPARE compiles the spec and runs logical+physical
+    planning a single time, recording the planning seconds it will save
+    every subsequent EXECUTE (``stmt.plan_s``, surfaced in the wire
+    stats so clients can see what the cache buys);
+  * **re-execute with bound literals** — EXECUTE clones the physical
+    tree (:func:`clone_plan` — a shallow structural copy isolating
+    per-run node state like DPP's ``runtime_predicates``), installs the
+    values via :func:`..exprs.bind_params`, and streams it through
+    ``Session._execute_planned_stream``.  ``ParamExpr`` leaves resolve
+    the live values at trace time, and their fingerprints key the
+    stage-program cache, so identical re-bindings also reuse the XLA
+    executables.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["PreparedStatement", "PreparedCache", "clone_plan"]
+
+_pc = time.perf_counter
+
+
+def clone_plan(node):
+    """Shallow structural copy of a physical tree for one execution.
+
+    Exec nodes carry per-RUN mutable state (``ScanExec.runtime_predicates``
+    written by DPP at execute time); re-running a cached template object
+    directly would let concurrent executions race on it, and a stale DPP
+    predicate from one binding could silently mis-prune another.  The
+    clone shares everything immutable (sources, expressions, compiled-
+    program cache keys) and resets the per-run fields."""
+    import copy
+    new = copy.copy(node)
+    new.children = [clone_plan(c) for c in node.children]
+    if hasattr(new, "runtime_predicates"):
+        new.runtime_predicates = None
+    return new
+
+
+class PreparedStatement:
+    """One cached, re-executable planned statement."""
+
+    __slots__ = ("fingerprint", "spec", "param_types", "phys", "schema",
+                 "plan_s", "created_t", "last_used_t", "executions")
+
+    def __init__(self, fingerprint: str, spec: dict,
+                 param_types: List[str], phys, schema, plan_s: float):
+        self.fingerprint = fingerprint
+        self.spec = spec
+        self.param_types = param_types
+        self.phys = phys            # the planned template — clone per run
+        self.schema = schema        # engine Schema of the output
+        self.plan_s = plan_s        # planning seconds EXECUTE skips
+        self.created_t = _pc()
+        self.last_used_t = self.created_t
+        self.executions = 0
+
+    def clone_for_run(self):
+        """A per-execution physical tree (see :func:`clone_plan`)."""
+        self.executions += 1
+        self.last_used_t = _pc()
+        return clone_plan(self.phys)
+
+
+class PreparedCache:
+    """LRU plan cache keyed by statement fingerprint, shared across the
+    front door's connections.  Confs: ``server.preparedCache.enabled``
+    (off = plan per execution, the A/B mode) and
+    ``server.preparedCache.maxEntries``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stmts: Dict[str, PreparedStatement] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.plan_s_saved = 0.0  # planning seconds EXECUTE hits skipped
+
+    def prepare(self, session, spec: dict, tables: Dict[str, Any],
+                conf) -> Tuple[PreparedStatement, bool]:
+        """Return (statement, was_cached).  Planning runs OUTSIDE the
+        lock — concurrent first-preparers may both plan, last insert
+        wins (cheap, and never blocks the cache on a slow plan)."""
+        from ..cache.keys import statement_fingerprint
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        from .spec import compile_spec
+        enabled = conf["spark.rapids.tpu.server.preparedCache.enabled"]
+        fp = statement_fingerprint(spec)
+        if enabled:
+            with self._lock:
+                stmt = self._stmts.get(fp)
+                if stmt is not None:
+                    self.hits += 1
+                    self.plan_s_saved += stmt.plan_s
+                    stmt.last_used_t = _pc()
+                    QueryStats.get().prepared_hits += 1
+                    tracing.mark(None, "server:prepared_hit", "server",
+                                 fingerprint=fp[:8])
+                    return stmt, True
+        t0 = _pc()
+        df, param_types = compile_spec(spec, tables)
+        phys = session._plan_physical(df._plan)
+        plan_s = _pc() - t0
+        stmt = PreparedStatement(fp, spec, param_types, phys,
+                                 df._plan.schema(), plan_s)
+        QueryStats.get().prepared_misses += 1
+        self.misses += 1
+        if not enabled:
+            return stmt, False
+        cap = conf["spark.rapids.tpu.server.preparedCache.maxEntries"]
+        with self._lock:
+            self._stmts[fp] = stmt
+            while len(self._stmts) > max(1, cap):
+                coldest = min(self._stmts.values(),
+                              key=lambda s: s.last_used_t)
+                del self._stmts[coldest.fingerprint]
+                self.evictions += 1
+        return stmt, False
+
+    def get(self, fingerprint: str) -> Optional[PreparedStatement]:
+        with self._lock:
+            return self._stmts.get(fingerprint)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"entries": len(self._stmts),
+                    "hits": self.hits,
+                    "misses": self.misses,
+                    "evictions": self.evictions,
+                    "hit_rate": (self.hits / total) if total else 0.0,
+                    "plan_s_saved": round(self.plan_s_saved, 4)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._stmts.clear()

@@ -1,0 +1,175 @@
+"""Wire protocol for the network SQL front door.
+
+Length-prefixed, crc-stamped frames over TCP — the same frame discipline
+as :mod:`..parallel.host_shuffle` (stamp at send, verify on EVERY
+decode), applied to a request/response SQL protocol in the Arrow Flight
+SQL shape: control frames carry canonical JSON, result batches carry raw
+Arrow IPC stream bytes, and results STREAM — one ``BATCH`` frame per
+device batch as its D2H fetch completes, never collect-then-ship.
+
+One connection speaks sequential request→response(s); a response to a
+query request is ``META`` (schema + query id), zero or more ``BATCH``
+frames, then exactly one of ``END`` (stats) or ``ERROR``.  Cancellation
+of an in-flight query is addressed BY ID from any connection (the META
+frame delivers the id before the first batch).
+
+Every failure the service can shed is a TYPED wire error the client can
+dispatch on (the overload answer is an error, never a hang):
+
+  ================  =====================================================
+  code              meaning
+  ================  =====================================================
+  UNAUTHENTICATED   HELLO token did not match ``server.authToken``
+  BAD_REQUEST       malformed frame / spec / parameter binding
+  REJECTED          scheduler admission queue full, or connection cap hit
+  QUOTA_EXCEEDED    tenant over its ``server.tenantQuotas`` in-flight cap
+  CANCELLED         query cancelled (caller, or client disconnect)
+  DEADLINE          per-query deadline expired
+  FAULTED           fault recovery exhausted (QueryFaulted — typed, with
+                    the fault point in ``detail``)
+  NOT_FOUND         unknown statement/query id
+  INTERNAL          anything else (the server's bug, not the client's)
+  ================  =====================================================
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "FRAME", "MAX_FRAME_BYTES", "WireError", "ProtocolError",
+    "send_frame", "recv_frame", "pack_json", "unpack_json",
+    # request frame types
+    "REQ_HELLO", "REQ_SUBMIT", "REQ_PREPARE", "REQ_EXECUTE", "REQ_CANCEL",
+    "REQ_STATUS", "REQ_BYE",
+    # response frame types
+    "RSP_WELCOME", "RSP_META", "RSP_BATCH", "RSP_END", "RSP_ERROR",
+    "RSP_PREPARED", "RSP_CANCELLED", "RSP_STATUS", "RSP_BYE",
+]
+
+# type byte, payload length, crc32 of the payload — stamped at send,
+# verified on every receive (a corrupt control frame is BAD_REQUEST /
+# ProtocolError, never a mis-parse)
+FRAME = struct.Struct("<cQI")
+
+# sanity bound on one frame: a corrupt length header must fail fast, not
+# allocate unbounded host memory (result batches are device-batch sized,
+# far below this)
+MAX_FRAME_BYTES = 1 << 31
+
+REQ_HELLO = b"h"
+REQ_SUBMIT = b"q"
+REQ_PREPARE = b"p"
+REQ_EXECUTE = b"e"
+REQ_CANCEL = b"c"
+REQ_STATUS = b"s"
+REQ_BYE = b"x"
+
+RSP_WELCOME = b"W"
+RSP_META = b"M"
+RSP_BATCH = b"B"
+RSP_END = b"Z"
+RSP_ERROR = b"E"
+RSP_PREPARED = b"P"
+RSP_CANCELLED = b"C"
+RSP_STATUS = b"S"
+RSP_BYE = b"X"
+
+_REQUEST_TYPES = (REQ_HELLO, REQ_SUBMIT, REQ_PREPARE, REQ_EXECUTE,
+                  REQ_CANCEL, REQ_STATUS, REQ_BYE)
+_RESPONSE_TYPES = (RSP_WELCOME, RSP_META, RSP_BATCH, RSP_END, RSP_ERROR,
+                   RSP_PREPARED, RSP_CANCELLED, RSP_STATUS, RSP_BYE)
+
+
+class ProtocolError(RuntimeError):
+    """The byte stream itself is broken (bad magic, crc mismatch,
+    oversized frame, truncated header) — the connection is unusable and
+    both sides close it."""
+
+
+class WireError(RuntimeError):
+    """A typed application-level error frame (either direction)."""
+
+    def __init__(self, code: str, message: str, detail: str = ""):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+        self.detail = detail
+
+    def to_payload(self) -> bytes:
+        return pack_json({"code": self.code, "message": self.message,
+                          "detail": self.detail})
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "WireError":
+        d = unpack_json(payload)
+        return cls(d.get("code", "INTERNAL"), d.get("message", ""),
+                   d.get("detail", ""))
+
+
+def pack_json(obj: Dict[str, Any]) -> bytes:
+    """Canonical JSON payload bytes for a control frame."""
+    return json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def unpack_json(payload: bytes) -> Dict[str, Any]:
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError("BAD_REQUEST", f"malformed JSON payload: {e}")
+    if not isinstance(obj, dict):
+        raise WireError("BAD_REQUEST", "control payload must be an object")
+    return obj
+
+
+def send_frame(sock: socket.socket, ftype: bytes, payload: bytes = b""
+               ) -> int:
+    """Stamp and send one frame; returns bytes written to the socket."""
+    from ..faults import integrity
+    crc = integrity.checksum(payload)
+    header = FRAME.pack(ftype, len(payload), crc)
+    sock.sendall(header + payload)
+    return len(header) + len(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))  # wait-ok (every front-door socket carries a settimeout: idleTimeout server-side, client request timeout client-side)
+        if not chunk:
+            raise ConnectionError(
+                f"peer closed mid-frame ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket,
+               expect: Optional[Tuple[bytes, ...]] = None
+               ) -> Tuple[bytes, bytes]:
+    """Receive one frame, verifying length sanity and the payload crc.
+
+    ``expect`` optionally restricts acceptable frame types; an ERROR
+    frame is ALWAYS accepted and raised as its typed :class:`WireError`
+    so callers dispatch on one exception shape.
+    """
+    header = _recv_exact(sock, FRAME.size)
+    ftype, length, crc = FRAME.unpack(header)
+    if ftype not in _REQUEST_TYPES and ftype not in _RESPONSE_TYPES:
+        raise ProtocolError(f"unknown frame type {ftype!r}")
+    if length > MAX_FRAME_BYTES:
+        raise ProtocolError(f"frame length {length} exceeds cap")
+    payload = _recv_exact(sock, length) if length else b""
+    from ..faults import integrity
+    if integrity.checksum(payload) != crc:
+        raise ProtocolError(
+            f"crc mismatch on {ftype!r} frame ({length} bytes)")
+    if ftype == RSP_ERROR:
+        raise WireError.from_payload(payload)
+    if expect is not None and ftype not in expect:
+        raise ProtocolError(
+            f"unexpected frame {ftype!r} (wanted one of {expect})")
+    return ftype, payload

@@ -1,0 +1,102 @@
+"""Client sessions, auth hook, and per-tenant admission quotas.
+
+The scheduler already orders admitted queries weighted-fair by tenant;
+what the WIRE adds is the layer in front of it: who is this connection
+(auth), which tenant does its work bill to, and how much of the service
+may that tenant hold IN FLIGHT at once.  Quota shedding happens at the
+protocol layer — a tenant over its cap gets a typed ``QUOTA_EXCEEDED``
+error immediately, before the query touches the scheduler's queue — so
+one chatty tenant's overload is its own problem, not a queue the whole
+fleet waits behind.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional
+
+from .protocol import WireError
+
+__all__ = ["ClientSession", "TenantQuotas", "authenticate"]
+
+_session_ids = itertools.count(1)
+
+
+def authenticate(conf, token: str) -> None:
+    """The auth hook: ``spark.rapids.tpu.server.authToken`` set means
+    every HELLO must present it.  Raises a typed UNAUTHENTICATED wire
+    error (never reveals whether a token exists server-side)."""
+    expected = conf["spark.rapids.tpu.server.authToken"]
+    if expected and token != expected:
+        raise WireError("UNAUTHENTICATED", "bad or missing auth token")
+
+
+class TenantQuotas:
+    """Per-tenant in-flight wire-query caps.
+
+    Parsed from ``spark.rapids.tpu.server.tenantQuotas`` — a comma list
+    of ``tenant=N`` entries, ``*=N`` the default for unlisted tenants,
+    0 / absent = unlimited.  ``acquire`` raises typed QUOTA_EXCEEDED;
+    ``release`` MUST run on every outcome (the endpoint's finally)."""
+
+    def __init__(self, spec: str = ""):
+        self._lock = threading.Lock()
+        self._caps: Dict[str, int] = {}
+        self._default = 0
+        self._inflight: Dict[str, int] = {}
+        for item in (spec or "").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(
+                    f"bad tenantQuotas entry {item!r} (want tenant=N)")
+            name, n = item.rsplit("=", 1)
+            cap = int(n)
+            if name.strip() == "*":
+                self._default = cap
+            else:
+                self._caps[name.strip()] = cap
+
+    def cap_for(self, tenant: str) -> int:
+        return self._caps.get(tenant, self._default)
+
+    def acquire(self, tenant: str) -> None:
+        with self._lock:
+            cap = self.cap_for(tenant)
+            cur = self._inflight.get(tenant, 0)
+            if cap > 0 and cur >= cap:
+                raise WireError(
+                    "QUOTA_EXCEEDED",
+                    f"tenant {tenant!r} at its in-flight cap ({cap}); "
+                    f"retry after a query completes",
+                    detail=f"inflight={cur}")
+            self._inflight[tenant] = cur + 1
+
+    def release(self, tenant: str) -> None:
+        with self._lock:
+            cur = self._inflight.get(tenant, 0)
+            # clamp: a double-release must never mint quota
+            self._inflight[tenant] = max(0, cur - 1)
+
+    def inflight(self, tenant: Optional[str] = None) -> int:
+        with self._lock:
+            if tenant is not None:
+                return self._inflight.get(tenant, 0)
+            return sum(self._inflight.values())
+
+
+class ClientSession:
+    """One authenticated connection's identity: session id, tenant, and
+    scheduler weight (HELLO may suggest a weight; the scheduler's
+    weighted-fair ordering consumes it)."""
+
+    __slots__ = ("session_id", "tenant", "weight", "peer")
+
+    def __init__(self, tenant: str = "default", weight: float = 1.0,
+                 peer: str = ""):
+        self.session_id = f"s-{next(_session_ids):05d}"
+        self.tenant = str(tenant) or "default"
+        self.weight = max(0.001, float(weight))
+        self.peer = peer

@@ -1,0 +1,291 @@
+"""The wire query DSL: a JSON spec compiled to the DataFrame algebra.
+
+The engine has no SQL text parser; what travels over the wire is a small
+canonical JSON description of a relational pipeline over SERVER-side
+registered tables — the Flight SQL catalog shape: clients name tables,
+the server owns the data.  A spec is::
+
+    {"table": "orders",
+     "ops": [
+       {"op": "filter",  "expr": [">", ["col", "o_amt"],
+                                       ["param", 0, "double"]]},
+       {"op": "join",    "table": "customers",
+                         "on": [["o_cust", "c_id"]], "how": "inner"},
+       {"op": "agg",     "group": ["c_region"],
+                         "aggs": [["n", "count", "*"],
+                                  ["total", "sum", ["col", "o_amt"]]]},
+       {"op": "sort",    "keys": [["total", false]]},
+       {"op": "limit",   "n": 10}]}
+
+Expressions are s-expression lists: ``["col", name]``, ``["lit", v]`` /
+``["lit", v, type]``, ``["param", i, type]`` (a prepared-statement slot
+— see :mod:`..exprs` ``ParamExpr``), binary ``+ - * / > >= < <= == !=
+and or``, unary ``not isnull isnotnull``, and ``["in", e, [v, ...]]``.
+
+The CANONICAL form of the spec (sorted-key JSON) is the statement
+identity: :func:`..cache.keys.statement_fingerprint` keys the prepared
+plan cache with it, so parameter slots are structural and bound values
+never enter the key.
+
+Parameters are restricted to device-computable scalar types (numeric /
+bool / date / timestamp): string predicates lower through host
+dictionary evaluation at PLAN time, which would bake a prepare-time
+value.  String *literals* are fine — they are genuinely constant.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from .. import exprs as E
+from .. import types as T
+
+__all__ = ["BadSpec", "compile_spec", "param_types_of", "coerce_params",
+           "TYPE_NAMES"]
+
+
+class BadSpec(ValueError):
+    """Malformed query spec — surfaces as a BAD_REQUEST wire error."""
+
+
+TYPE_NAMES: Dict[str, "T.DataType"] = {
+    "bool": T.BOOLEAN,
+    "int": T.INT32,
+    "long": T.INT64,
+    "float": T.FLOAT32,
+    "double": T.FLOAT64,
+    "string": T.STRING,
+    "date": T.DATE,
+    "timestamp": T.TIMESTAMP,
+}
+
+# types a ["param", i, type] slot may declare (no "string": see module doc)
+_PARAM_TYPES = {k: v for k, v in TYPE_NAMES.items() if k != "string"}
+
+_BINARY = {
+    "+": E.Add, "-": E.Subtract, "*": E.Multiply, "/": E.Divide,
+    ">": E.GreaterThan, ">=": E.GreaterThanOrEqual,
+    "<": E.LessThan, "<=": E.LessThanOrEqual,
+    "==": E.EqualTo, "and": E.And, "or": E.Or,
+}
+
+_AGGS = ("count", "sum", "avg", "min", "max")
+
+
+def _expr(e, params: Dict[int, str]) -> E.Expression:
+    """Compile one s-expression list into an Expression, recording each
+    parameter slot's declared type in ``params`` (consistency-checked)."""
+    if not isinstance(e, (list, tuple)) or not e:
+        raise BadSpec(f"expression must be a non-empty list, got {e!r}")
+    head = e[0]
+    if head == "col":
+        if len(e) != 2 or not isinstance(e[1], str):
+            raise BadSpec(f"bad col expression {e!r}")
+        return E.UnresolvedColumn(e[1])
+    if head == "lit":
+        if len(e) == 2:
+            return E.Literal(e[1])
+        if len(e) == 3:
+            dt = TYPE_NAMES.get(e[2])
+            if dt is None:
+                raise BadSpec(f"unknown literal type {e[2]!r}")
+            return E.Literal(e[1], dt)
+        raise BadSpec(f"bad lit expression {e!r}")
+    if head == "param":
+        if len(e) != 3 or not isinstance(e[1], int):
+            raise BadSpec(
+                f"bad param expression {e!r} (want ['param', i, type])")
+        idx, tname = e[1], e[2]
+        dt = _PARAM_TYPES.get(tname)
+        if dt is None:
+            raise BadSpec(
+                f"param type {tname!r} not allowed (one of "
+                f"{sorted(_PARAM_TYPES)}; strings are not parameterizable)")
+        seen = params.get(idx)
+        if seen is not None and seen != tname:
+            raise BadSpec(
+                f"param {idx} declared as both {seen!r} and {tname!r}")
+        params[idx] = tname
+        return E.ParamExpr(idx, dt)
+    if head == "not":
+        if len(e) != 2:
+            raise BadSpec(f"bad not expression {e!r}")
+        return E.Not(_expr(e[1], params))
+    if head == "isnull":
+        return E.IsNull(_expr(e[1], params))
+    if head == "isnotnull":
+        return E.IsNotNull(_expr(e[1], params))
+    if head == "in":
+        if len(e) != 3 or not isinstance(e[2], (list, tuple)):
+            raise BadSpec(f"bad in expression {e!r}")
+        return E.In(_expr(e[1], params), list(e[2]))
+    if head == "!=":
+        if len(e) != 3:
+            raise BadSpec(f"bad != expression {e!r}")
+        return E.Not(E.EqualTo(_expr(e[1], params), _expr(e[2], params)))
+    cls = _BINARY.get(head)
+    if cls is not None:
+        if len(e) != 3:
+            raise BadSpec(f"operator {head!r} takes 2 operands, got {e!r}")
+        return cls(_expr(e[1], params), _expr(e[2], params))
+    raise BadSpec(f"unknown expression operator {head!r}")
+
+
+def _agg_column(name: str, fn: str, arg, params: Dict[int, str]):
+    from ..sql import functions as F
+    from ..sql.column import Column
+    if fn not in _AGGS:
+        raise BadSpec(f"unknown aggregate {fn!r} (one of {_AGGS})")
+    if fn == "count" and arg == "*":
+        return F.count_star().alias(name)
+    col = Column(_expr(arg, params))
+    return getattr(F, fn)(col).alias(name)
+
+
+def _resolve_table(name, tables):
+    if not isinstance(name, str) or name not in tables:
+        raise BadSpec(
+            f"unknown table {name!r} (registered: {sorted(tables)})")
+    df = tables[name]
+    return df() if callable(df) else df
+
+
+def compile_spec(spec: Dict[str, Any], tables: Dict[str, Any]
+                 ) -> Tuple[Any, List[str]]:
+    """Compile a wire spec against the server's table registry.
+
+    ``tables`` maps name → DataFrame or zero-arg DataFrame factory.
+    Returns ``(DataFrame, param_types)`` where ``param_types[i]`` names
+    parameter ``i``'s declared type — contiguity is enforced so EXECUTE
+    can validate bindings positionally.
+    """
+    if not isinstance(spec, dict):
+        raise BadSpec("spec must be a JSON object")
+    params: Dict[int, str] = {}
+    df = _resolve_table(spec.get("table"), tables)
+    from ..sql.column import Column
+    for i, op in enumerate(spec.get("ops", []) or []):
+        if not isinstance(op, dict) or "op" not in op:
+            raise BadSpec(f"ops[{i}] must be an object with an 'op' key")
+        kind = op["op"]
+        if kind == "filter":
+            df = df.where(Column(_expr(op.get("expr"), params)))
+        elif kind == "project":
+            cols = op.get("cols")
+            if not isinstance(cols, (list, tuple)) or not cols:
+                raise BadSpec(f"ops[{i}]: project needs cols")
+            out = []
+            for c in cols:
+                if not (isinstance(c, (list, tuple)) and len(c) == 2
+                        and isinstance(c[0], str)):
+                    raise BadSpec(f"ops[{i}]: bad projection {c!r}")
+                out.append(Column(_expr(c[1], params)).alias(c[0]))
+            df = df.select(*out)
+        elif kind == "agg":
+            aggs = op.get("aggs")
+            if not isinstance(aggs, (list, tuple)) or not aggs:
+                raise BadSpec(f"ops[{i}]: agg needs aggs")
+            cols = []
+            for a in aggs:
+                if not (isinstance(a, (list, tuple)) and len(a) == 3):
+                    raise BadSpec(f"ops[{i}]: bad aggregate {a!r}")
+                cols.append(_agg_column(a[0], a[1], a[2], params))
+            group = op.get("group") or []
+            if group:
+                df = df.group_by(*group).agg(*cols)
+            else:
+                df = df.agg(*cols)
+        elif kind == "sort":
+            keys = op.get("keys")
+            if not isinstance(keys, (list, tuple)) or not keys:
+                raise BadSpec(f"ops[{i}]: sort needs keys")
+            names = []
+            asc = []
+            for k in keys:
+                if not (isinstance(k, (list, tuple)) and len(k) == 2):
+                    raise BadSpec(f"ops[{i}]: bad sort key {k!r}")
+                names.append(k[0])
+                asc.append(bool(k[1]))
+            df = df.sort(*names, ascending=asc)
+        elif kind == "limit":
+            n = op.get("n")
+            if not isinstance(n, int) or n < 0:
+                raise BadSpec(f"ops[{i}]: limit needs n >= 0")
+            df = df.limit(n)
+        elif kind == "join":
+            other = _resolve_table(op.get("table"), tables)
+            on = op.get("on")
+            if not isinstance(on, (list, tuple)) or not on:
+                raise BadSpec(f"ops[{i}]: join needs on pairs")
+            pairs = []
+            for p in on:
+                if isinstance(p, str):
+                    pairs.append((p, p))
+                elif isinstance(p, (list, tuple)) and len(p) == 2:
+                    pairs.append((p[0], p[1]))
+                else:
+                    raise BadSpec(f"ops[{i}]: bad join key {p!r}")
+            df = df.join(other, on=pairs, how=op.get("how", "inner"))
+        else:
+            raise BadSpec(f"ops[{i}]: unknown op {kind!r}")
+    if params:
+        missing = [i for i in range(max(params) + 1) if i not in params]
+        if missing:
+            raise BadSpec(f"param indices must be contiguous from 0; "
+                          f"missing {missing}")
+    return df, [params[i] for i in range(len(params))]
+
+
+def param_types_of(spec: Dict[str, Any]) -> List[str]:
+    """The declared parameter types of a spec without a table registry
+    (walks expressions only) — PREPARE-side validation for specs whose
+    tables resolve later."""
+    params: Dict[int, str] = {}
+
+    def walk(node):
+        if isinstance(node, dict):
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, (list, tuple)):
+            if node and node[0] == "param":
+                _expr(node, params)
+            else:
+                for v in node:
+                    walk(v)
+
+    walk(spec)
+    if params:
+        missing = [i for i in range(max(params) + 1) if i not in params]
+        if missing:
+            raise BadSpec(f"param indices must be contiguous from 0; "
+                          f"missing {missing}")
+    return [params[i] for i in range(len(params))]
+
+
+def coerce_params(values: List[Any], param_types: List[str]) -> Tuple:
+    """Validate + coerce EXECUTE bindings against the declared types.
+    JSON carries numbers and strings; dates/timestamps arrive as epoch
+    ints (the Literal physical encodings)."""
+    if values is None:
+        values = []
+    if len(values) != len(param_types):
+        raise BadSpec(f"statement takes {len(param_types)} parameters, "
+                      f"got {len(values)}")
+    out = []
+    for i, (v, tname) in enumerate(zip(values, param_types)):
+        if v is None:
+            out.append(None)
+            continue
+        try:
+            if tname in ("int", "long", "date", "timestamp"):
+                out.append(int(v))
+            elif tname in ("float", "double"):
+                out.append(float(v))
+            elif tname == "bool":
+                out.append(bool(v))
+            else:
+                raise BadSpec(f"unhandled param type {tname!r}")
+        except (TypeError, ValueError):
+            raise BadSpec(
+                f"param {i} ({tname}) cannot coerce value {v!r}")
+    return tuple(out)

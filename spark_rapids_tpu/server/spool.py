@@ -1,0 +1,209 @@
+"""Disk-backed result streaming: the producer never waits on the client.
+
+A wire query's producer (the scheduler worker holding the semaphore
+permit) and its consumer (the connection thread writing the socket) run
+at different speeds: a slow client, or a collect bigger than host
+memory wants to buffer, must not pin device-side resources.  The
+:class:`ResultStream` between them is a bounded in-memory FIFO that
+OVERFLOWS TO DISK: once buffered bytes exceed
+``spark.rapids.tpu.server.spool.memoryBytes``, every subsequent frame
+appends to a crc-framed spool file (the host-shuffle frame discipline:
+stamp at write, verify at read) and the producer keeps streaming at
+device speed.  The permit releases when the query finishes computing,
+not when the client finishes reading.
+
+Spool files live under ``server.spool.dir`` with an ``.inprogress``
+suffix for their whole life — they are transient (consumed and deleted
+within the query), and the suffix is the atomic-writer convention that
+lets :func:`gc_orphan_spools` sweep leftovers from crashed servers
+without ever racing a publish rename.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, Iterator, Optional
+
+__all__ = ["ResultStream", "gc_orphan_spools"]
+
+# payload length + crc32 per spooled frame (verified on read-back)
+_SFRAME = struct.Struct("<QI")
+
+
+class ResultStream:
+    """Ordered byte-frame stream from one producer to one consumer.
+
+    Producer calls :meth:`put` per Arrow IPC payload, then
+    :meth:`finish` (or :meth:`fail`); the consumer iterates
+    :meth:`frames`.  ``put`` NEVER blocks on the consumer — memory up to
+    the budget, disk beyond it.  :meth:`close` (consumer side, e.g. on
+    client disconnect) makes further puts return False so the producer
+    can stop early alongside the cooperative cancel."""
+
+    def __init__(self, label: str, memory_bytes: int, spool_dir: str):
+        self.label = label
+        self._budget = max(0, int(memory_bytes))
+        self._spool_dir = spool_dir
+        self._cv = threading.Condition()
+        self._mem: "deque[bytes]" = deque()
+        self._mem_bytes = 0
+        self._spool_path: Optional[str] = None
+        self._spool_f = None
+        self._spooled = 0           # frames committed to the spool file
+        self._spool_read = 0        # frames the consumer consumed from it
+        self._read_f = None
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self.stats: Dict = {}
+        self.frames_total = 0
+        self.bytes_total = 0
+        self.spooled_bytes = 0
+
+    # -- producer side ------------------------------------------------------------
+    def put(self, payload: bytes) -> bool:
+        """Queue one frame; False once the consumer closed the stream
+        (client gone) — the producer should stop early."""
+        from ..faults import integrity
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        with self._cv:
+            if self._closed or self._done:
+                # done covers a failed stream whose query was resubmitted:
+                # the retry's frames have no reader — stop it early too
+                return False
+            self.frames_total += 1
+            self.bytes_total += len(payload)
+            QueryStats.get().server_stream_bytes += len(payload)
+            if self._spool_f is None \
+                    and self._mem_bytes + len(payload) <= self._budget:
+                self._mem.append(payload)
+                self._mem_bytes += len(payload)
+                self._cv.notify_all()
+                return True
+            if self._spool_f is None:
+                os.makedirs(self._spool_dir, exist_ok=True)
+                self._spool_path = os.path.join(
+                    self._spool_dir,
+                    f"spool-{uuid.uuid4().hex[:12]}.bin.inprogress")
+                self._spool_f = open(self._spool_path, "wb")
+                tracing.mark(None, "server:spool_start", "server",
+                             label=self.label, buffered=self._mem_bytes)
+            crc = integrity.checksum(payload)
+            self._spool_f.write(_SFRAME.pack(len(payload), crc))
+            self._spool_f.write(payload)
+            self._spool_f.flush()
+            self._spooled += 1
+            self.spooled_bytes += len(payload)
+            QueryStats.get().server_spooled_bytes += len(payload)
+            self._cv.notify_all()
+            return True
+
+    def finish(self, stats: Optional[Dict] = None) -> None:
+        with self._cv:
+            self.stats = dict(stats or {})
+            self._done = True
+            self._cv.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cv:
+            self._error = exc
+            self._done = True
+            self._cv.notify_all()
+
+    # -- consumer side ------------------------------------------------------------
+    def _next_locked(self):
+        """One frame if available (memory first — it is strictly older
+        than anything spooled), else None."""
+        if self._mem:
+            payload = self._mem.popleft()
+            self._mem_bytes -= len(payload)
+            return payload
+        if self._spool_read < self._spooled:
+            from ..faults import integrity
+            if self._read_f is None:
+                self._read_f = open(self._spool_path, "rb")
+            header = self._read_f.read(_SFRAME.size)
+            length, crc = _SFRAME.unpack(header)
+            payload = self._read_f.read(length)
+            if integrity.checksum(payload) != crc:
+                raise RuntimeError(
+                    f"result spool corrupt (frame {self._spool_read} of "
+                    f"{self.label})")
+            self._spool_read += 1
+            return payload
+        return None
+
+    def frames(self, poll_s: float = 0.25) -> Iterator[bytes]:
+        """Yield frames in production order until the producer finishes;
+        re-raises the producer's failure.  The wait is a bounded poll —
+        the producer's put/finish/fail notifies sooner."""
+        while True:
+            with self._cv:
+                payload = self._next_locked()
+                if payload is None:
+                    if self._error is not None:
+                        raise self._error
+                    if self._done:
+                        return
+                    self._cv.wait(timeout=poll_s)
+                    continue
+            yield payload
+
+    def close(self) -> None:
+        """Tear down (consumer side): further puts return False, the
+        spool file is deleted.  Idempotent; always runs in the
+        connection handler's finally."""
+        with self._cv:
+            self._closed = True
+            self._done = True
+            for f in (self._spool_f, self._read_f):
+                try:
+                    if f is not None:
+                        f.close()
+                except OSError:
+                    pass
+            self._spool_f = self._read_f = None
+            self._mem.clear()
+            self._mem_bytes = 0
+            path, self._spool_path = self._spool_path, None
+            self._cv.notify_all()
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    @property
+    def spooled(self) -> bool:
+        return self.spooled_bytes > 0
+
+
+def gc_orphan_spools(spool_dir: str, older_than_ms: float = 600000.0
+                     ) -> int:
+    """Sweep ``spool-*.inprogress`` files older than the threshold — a
+    crashed server's leftovers (live streams touch their file on every
+    overflow write).  Runs at front-door start."""
+    removed = 0
+    try:
+        names = os.listdir(spool_dir)
+    except OSError:
+        return 0
+    now = time.time()  # span-api-ok (file mtime age, not span timing)
+    for name in names:
+        if not (name.startswith("spool-")
+                and name.endswith(".inprogress")):
+            continue
+        path = os.path.join(spool_dir, name)
+        try:
+            if (now - os.path.getmtime(path)) * 1000.0 > older_than_ms:
+                os.unlink(path)
+                removed += 1
+        except OSError:
+            continue  # racing another sweep: skip
+    return removed

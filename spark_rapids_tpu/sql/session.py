@@ -408,6 +408,12 @@ class Session:
             "priority": ctl.priority,
             "tenant": ctl.tenant,
             "queue_wait_s": round(ctl.queue_wait_s, 6)})
+        server_attrs = getattr(ctl, "server_attrs", None)
+        if server_attrs:
+            # a wire query's root span carries its connection identity
+            # (server/endpoint.py sets these at submit): the trace is
+            # attributable to a tenant AND a connection end to end
+            tr.attrs.update(server_attrs)
         resubmit_of = getattr(ctl, "resubmit_of", None)
         if resubmit_of:
             # a scheduler-resubmitted attempt links BACK to the faulted
@@ -556,11 +562,33 @@ class Session:
     def _execute_batches(self, plan: L.LogicalPlan):
         """Stream the result as pyarrow Tables, one per output batch —
         the write path's entry so results never materialize wholesale."""
-        from ..batch import to_arrow
-        from ..runtime.semaphore import get_semaphore
-        from ..utils.metrics import QueryStats
         conf = self._tpu_conf()
         phys = self._plan_physical(plan)
+        return self._execute_planned_stream(phys, conf)
+
+    def _stream_plan(self, plan: L.LogicalPlan):
+        """Plan + stream a logical plan (subqueries resolved) — the
+        network front door's FRESH-submit path (server/endpoint.py):
+        result batches reach the consumer as their D2H fetches complete
+        instead of after a wholesale collect."""
+        from ..plan.subquery import resolve_subqueries
+        plan = resolve_subqueries(plan, self._collect_rows)
+        return self._execute_batches(plan)
+
+    def _execute_planned_stream(self, phys, conf=None):
+        """Stream pyarrow tables from an ALREADY-PLANNED physical tree,
+        under the full per-query scope stack (stats/fault/control/trace +
+        semaphore).  Logical planning and overrides are SKIPPED — this is
+        the prepared-statement fast path (server/prepared.py plans once,
+        clones the tree per execution, and re-runs it here with freshly
+        bound parameters).  D2H fetches ride the async pipeline depth
+        (runtime/pipeline.stream_arrow), so incremental consumers — the
+        wire, the write path — see batch N while batch N+1 dispatches."""
+        from ..runtime.pipeline import stream_arrow
+        from ..runtime.semaphore import get_semaphore
+        from ..utils.metrics import QueryStats
+        if conf is None:
+            conf = self._tpu_conf()
         ctx = ExecContext(conf, device=self.device)
         with QueryStats.scoped() as stats, self._fault_scope(conf), \
                 self._control_scope(conf), self._trace_scope(conf) as tr:
@@ -570,8 +598,8 @@ class Session:
                     if tr is not None:
                         tr.register_plan(phys)
                     self._note_scheduler(tr)
-                    for b in phys.execute(ctx):
-                        yield to_arrow(b)
+                    for t in stream_arrow(ctx, phys.execute(ctx)):
+                        yield t
             except BaseException as e:
                 self._trace_status(tr, e)
                 raise

@@ -134,6 +134,17 @@ class QueryStats:
         self.integrity_failures = 0
         self.fragments_hedged = 0
         self.stalls_detected = 0
+        # network front door (spark_rapids_tpu/server/): Arrow IPC bytes
+        # a wire query produced for its result stream, bytes of those
+        # that overflowed to the disk spool (slow client / large
+        # collect), and prepared-statement plan-cache hits/misses
+        # (PREPARE-time; hits skip the full planning stack at EXECUTE) —
+        # the trace_report server: line and the loadgen report read
+        # these
+        self.server_stream_bytes = 0
+        self.server_spooled_bytes = 0
+        self.prepared_hits = 0
+        self.prepared_misses = 0
 
     # -- accessors ----------------------------------------------------------
     @classmethod

@@ -7,6 +7,7 @@ schedule must equal the fault-free run with every handle released.
 """
 
 import os
+import time
 
 import numpy as np
 import pandas as pd
@@ -807,6 +808,34 @@ class TestChaosDifferential:
         finally:
             INJECTOR.arm()
             coord.close()
+        # server.conn leg: the network front door's client drops
+        # mid-result-stream (injected at the BATCH send) — the wire
+        # query cancels cooperatively, the permit and the wire-query
+        # registry entry release, and a fresh connection still serves
+        from spark_rapids_tpu.server import SqlFrontDoor, WireClient
+        door = SqlFrontDoor(s).start()
+        door.register_table(
+            "t", lambda: s.read_parquet(path))
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "server.conn:1")
+        try:
+            c = WireClient("127.0.0.1", door.port)
+            with pytest.raises((ConnectionError, OSError)):
+                c.query({"table": "t", "ops": []})
+            s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and (
+                    s.scheduler().running()
+                    or door.snapshot()["queries_inflight"]):
+                time.sleep(0.05)
+            assert s.scheduler().running() == 0
+            assert door.snapshot()["queries_inflight"] == 0
+            with WireClient("127.0.0.1", door.port) as c2:
+                assert c2.query({"table": "t", "ops": []}).rows()
+        finally:
+            door.close()
+            s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+
         # >=1 injected fault at EVERY registered point
         totals = INJECTOR.snapshot()["injected_total"]
         for p in POINTS:
@@ -838,10 +867,17 @@ class TestChaosDifferential:
         clean = _agg_rows(s, path)
         s.conf.set("spark.rapids.tpu.faults.inject.rate", 0.15)
         s.conf.set("spark.rapids.tpu.faults.inject.seed", 123)
+        # rate mode is a TRUE rate (the injector preserves its RNG
+        # stream across identical per-query re-arms), so at 0.15 a call
+        # site can draw several consecutive faults; headroom above the
+        # default 3 keeps per-site exhaustion odds negligible (0.15^7)
+        # while every recovery path still exercises
+        s.conf.set("spark.rapids.tpu.faults.maxRetries", 6)
         before = QueryStats.get().snapshot()
         for _ in range(3):
             assert _agg_rows(s, path) == clean
         assert QueryStats.delta_since(before)["faults_injected"] >= 1
+        s.conf.unset("spark.rapids.tpu.faults.maxRetries")
         get_catalog().assert_no_leaks()
 
 

@@ -1,0 +1,718 @@
+"""Network SQL front door: protocol round-trip, prepared statements,
+tenant quotas, disconnect cleanup, spooling, stats reconciliation.
+
+Covers the ISSUE 8 acceptance surface: prepared re-execution identical
+to fresh submits, typed wire errors for every shed, mid-stream client
+disconnect releasing every resource (the PR 7 leak-hygiene discipline
+extended to the wire), spooled large results matching in-memory
+collects, and concurrent clients whose per-query stats reconcile with
+the process aggregate.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.config import ALL_ENTRIES, TpuConf
+from spark_rapids_tpu.memory.spill import get_catalog
+from spark_rapids_tpu.server import (BadSpec, ProtocolError, SqlFrontDoor,
+                                     TenantQuotas, WireClient, WireError)
+from spark_rapids_tpu.server import protocol as P
+from spark_rapids_tpu.server.spec import compile_spec
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.metrics import QueryStats
+
+N_ROWS = 20_000
+BATCH_ROWS = 4_000  # multi-batch results: N_ROWS/BATCH_ROWS frames
+
+
+def _norm(rows):
+    out = []
+    for r in rows:
+        out.append(tuple(round(v, 5) if isinstance(v, float) else v
+                         for v in r))
+    return sorted(out, key=repr)
+
+
+@pytest.fixture(scope="module")
+def wire(session, tmp_path_factory):
+    """One started front door over a parquet-backed table (so scan
+    pushdown is real) and an in-memory table."""
+    s = session
+    d = tmp_path_factory.mktemp("server_data")
+    rng = np.random.default_rng(20260804)
+    t = pa.table({
+        "k": rng.integers(0, 40, N_ROWS).astype("int64"),
+        "q": rng.integers(1, 50, N_ROWS).astype("int32"),
+        "v": rng.random(N_ROWS) * 1000.0,
+    })
+    path = str(d / "orders.parquet")
+    pq.write_table(t, path)
+    mem = pa.table({"c": np.arange(1, 2001, dtype="int64"),
+                    "seg": rng.integers(0, 5, 2000).astype("int32")})
+    s.conf.set("spark.rapids.tpu.sql.batchSizeRows", BATCH_ROWS)
+    door = SqlFrontDoor(s).start()
+    tables = {"orders": lambda: s.read_parquet(path),
+              "mem": lambda: s.create_dataframe(mem)}
+    for name, f in tables.items():
+        door.register_table(name, f)
+    yield s, door, tables
+    door.close()
+    s.conf.unset("spark.rapids.tpu.sql.batchSizeRows")
+
+
+AGG_SPEC = {"table": "orders",
+            "ops": [
+                {"op": "filter",
+                 "expr": [">", ["col", "v"], ["param", 0, "double"]]},
+                {"op": "agg", "group": ["k"],
+                 "aggs": [["n", "count", "*"],
+                          ["s", "sum", ["col", "v"]]]},
+                {"op": "sort", "keys": [["k", True]]}]}
+
+SCAN_SPEC = {"table": "orders",
+             "ops": [{"op": "filter",
+                      "expr": [">", ["col", "v"], ["lit", 5.0]]}]}
+
+
+def _oracle_agg(s, tables, threshold):
+    df = tables["orders"]()
+    return _norm(df.where(F.col("v") > F.lit(threshold))
+                 .group_by("k")
+                 .agg(F.count_star().alias("n"),
+                      F.sum(F.col("v")).alias("s"))
+                 .sort("k").collect())
+
+
+# ---------------------------------------------------------------------------
+# Protocol layer
+# ---------------------------------------------------------------------------
+
+class TestProtocol:
+    def test_frame_roundtrip(self):
+        a, b = socket.socketpair()
+        a.settimeout(5)
+        b.settimeout(5)
+        try:
+            payload = P.pack_json({"x": 1, "s": "été"})
+            P.send_frame(a, P.REQ_SUBMIT, payload)
+            ftype, got = P.recv_frame(b)
+            assert ftype == P.REQ_SUBMIT
+            assert P.unpack_json(got) == {"x": 1, "s": "été"}
+        finally:
+            a.close()
+            b.close()
+
+    def test_crc_mismatch_is_protocol_error(self):
+        a, b = socket.socketpair()
+        a.settimeout(5)
+        b.settimeout(5)
+        try:
+            payload = b"hello-world-payload"
+            from spark_rapids_tpu.faults import integrity
+            header = P.FRAME.pack(P.RSP_BATCH, len(payload),
+                                  integrity.checksum(payload) ^ 0xFF)
+            a.sendall(header + payload)
+            with pytest.raises(ProtocolError, match="crc"):
+                P.recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_unknown_type_and_oversize_rejected(self):
+        a, b = socket.socketpair()
+        a.settimeout(5)
+        b.settimeout(5)
+        try:
+            a.sendall(P.FRAME.pack(b"?", 0, 0))
+            with pytest.raises(ProtocolError, match="unknown frame"):
+                P.recv_frame(b)
+            a.sendall(P.FRAME.pack(P.RSP_BATCH, P.MAX_FRAME_BYTES + 1, 0))
+            with pytest.raises(ProtocolError, match="exceeds cap"):
+                P.recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_error_frame_raises_typed(self):
+        a, b = socket.socketpair()
+        a.settimeout(5)
+        b.settimeout(5)
+        try:
+            P.send_frame(a, P.RSP_ERROR, WireError(
+                "QUOTA_EXCEEDED", "tenant over cap",
+                detail="inflight=4").to_payload())
+            with pytest.raises(WireError) as ei:
+                P.recv_frame(b)
+            assert ei.value.code == "QUOTA_EXCEEDED"
+            assert ei.value.detail == "inflight=4"
+        finally:
+            a.close()
+            b.close()
+
+    def test_statement_fingerprint_canonical(self):
+        from spark_rapids_tpu.cache.keys import statement_fingerprint
+        a = {"table": "t", "ops": [{"op": "limit", "n": 5}]}
+        b = {"ops": [{"n": 5, "op": "limit"}], "table": "t"}
+        assert statement_fingerprint(a) == statement_fingerprint(b)
+        c = {"table": "t", "ops": [{"op": "limit", "n": 6}]}
+        assert statement_fingerprint(a) != statement_fingerprint(c)
+
+
+# ---------------------------------------------------------------------------
+# Spec compiler
+# ---------------------------------------------------------------------------
+
+class TestSpecCompiler:
+    def test_bad_specs_typed(self, wire):
+        s, door, tables = wire
+        with pytest.raises(BadSpec, match="unknown table"):
+            compile_spec({"table": "nope", "ops": []}, tables)
+        with pytest.raises(BadSpec, match="unknown op"):
+            compile_spec({"table": "orders",
+                          "ops": [{"op": "frobnicate"}]}, tables)
+        with pytest.raises(BadSpec, match="not allowed"):
+            compile_spec({"table": "orders", "ops": [
+                {"op": "filter",
+                 "expr": ["==", ["col", "k"],
+                          ["param", 0, "string"]]}]}, tables)
+        with pytest.raises(BadSpec, match="contiguous"):
+            compile_spec({"table": "orders", "ops": [
+                {"op": "filter",
+                 "expr": [">", ["col", "v"],
+                          ["param", 1, "double"]]}]}, tables)
+
+    def test_param_types_collected(self, wire):
+        s, door, tables = wire
+        df, ptypes = compile_spec(AGG_SPEC, tables)
+        assert ptypes == ["double"]
+        assert df.columns == ["k", "n", "s"]
+
+
+# ---------------------------------------------------------------------------
+# Fresh submits over the wire
+# ---------------------------------------------------------------------------
+
+class TestWireQueries:
+    def test_submit_matches_oracle(self, wire):
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port, tenant="t1") as c:
+            r = c.query(AGG_SPEC, params=[300.0])
+            assert _norm(r.rows()) == _oracle_agg(s, tables, 300.0)
+            assert r.stats["status"] == "done"
+            assert r.stats["batches"] >= 1
+            assert not r.prepared
+
+    def test_empty_result_keeps_schema(self, wire):
+        s, door, tables = wire
+        spec = {"table": "orders",
+                "ops": [{"op": "filter",
+                         "expr": [">", ["col", "v"], ["lit", 1e12]]}]}
+        with WireClient("127.0.0.1", door.port) as c:
+            r = c.query(spec)
+            assert r.rows() == []
+            assert [f[0] for f in r.schema] == ["k", "q", "v"]
+
+    def test_multi_batch_streaming(self, wire):
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port) as c:
+            kinds = []
+            total = 0
+            for kind, val in c.query_stream(SCAN_SPEC):
+                kinds.append(kind)
+                if kind == "batch":
+                    total += val.num_rows
+            assert kinds[0] == "meta" and kinds[-1] == "end"
+            assert kinds.count("batch") > 1  # streamed, not one blob
+            oracle = tables["orders"]().where(
+                F.col("v") > F.lit(5.0)).count()
+            assert total == oracle
+
+    def test_bad_request_typed_on_wire(self, wire):
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port) as c:
+            with pytest.raises(WireError) as ei:
+                c.query({"table": "nope", "ops": []})
+            assert ei.value.code == "BAD_REQUEST"
+            # the connection survives a bad request
+            assert c.query(AGG_SPEC, params=[990.0]).stats[
+                "status"] == "done"
+
+    def test_auth_token(self, session):
+        s = session
+        door = SqlFrontDoor(s, settings={
+            "spark.rapids.tpu.server.authToken": "sesame"}).start()
+        try:
+            with pytest.raises(WireError) as ei:
+                WireClient("127.0.0.1", door.port, token="wrong")
+            assert ei.value.code == "UNAUTHENTICATED"
+            c = WireClient("127.0.0.1", door.port, token="sesame")
+            assert c.session_id
+            c.close()
+        finally:
+            door.close()
+
+    def test_connection_cap_sheds_typed(self, session):
+        s = session
+        door = SqlFrontDoor(s, settings={
+            "spark.rapids.tpu.server.maxConnections": 1}).start()
+        try:
+            c1 = WireClient("127.0.0.1", door.port)
+            with pytest.raises(WireError) as ei:
+                WireClient("127.0.0.1", door.port)
+            assert ei.value.code == "REJECTED"
+            c1.close()
+        finally:
+            door.close()
+
+    def test_deadline_typed_on_wire(self, wire):
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port) as c:
+            with pytest.raises(WireError) as ei:
+                c.query(AGG_SPEC, params=[1.0], deadline_ms=1)
+            assert ei.value.code in ("DEADLINE", "CANCELLED")
+        assert s.scheduler().running() == 0
+
+
+# ---------------------------------------------------------------------------
+# Prepared statements
+# ---------------------------------------------------------------------------
+
+class TestPrepared:
+    def test_prepared_identical_to_fresh(self, wire):
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port) as c:
+            fresh = c.query(AGG_SPEC, params=[250.0])
+            p = c.prepare(AGG_SPEC)
+            assert p["param_types"] == ["double"]
+            r = c.execute(p["statement_id"], [250.0])
+            assert r.prepared  # the plan-cache fast path actually ran
+            assert _norm(r.rows()) == _norm(fresh.rows())
+            assert _norm(r.rows()) == _oracle_agg(s, tables, 250.0)
+
+    def test_rebind_never_bakes_pushdown(self, wire):
+        """Re-executing with different bound params must re-filter from
+        scratch — a prepare-time value baked into scan pushdown would
+        silently mis-prune (the ParamExpr-is-not-a-Literal contract)."""
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port) as c:
+            sid = c.prepare(AGG_SPEC)["statement_id"]
+            lo = c.execute(sid, [10.0])     # nearly all rows pass
+            hi = c.execute(sid, [950.0])    # few rows pass
+            again = c.execute(sid, [10.0])  # back to wide — not pruned
+            assert _norm(lo.rows()) == _oracle_agg(s, tables, 10.0)
+            assert _norm(hi.rows()) == _oracle_agg(s, tables, 950.0)
+            assert _norm(again.rows()) == _norm(lo.rows())
+            assert sum(r[1] for r in lo.rows()) \
+                > sum(r[1] for r in hi.rows())
+
+    def test_statement_shared_across_connections(self, wire):
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port) as a, \
+                WireClient("127.0.0.1", door.port) as b:
+            pa_ = a.prepare(AGG_SPEC)
+            pb = b.prepare(AGG_SPEC)
+            assert pa_["statement_id"] == pb["statement_id"]
+            assert pb["cached"]  # second preparer hit the shared cache
+            r = b.execute(pa_["statement_id"], [500.0])
+            assert _norm(r.rows()) == _oracle_agg(s, tables, 500.0)
+
+    def test_unknown_statement_not_found(self, wire):
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port) as c:
+            with pytest.raises(WireError) as ei:
+                c.execute("deadbeef" * 4, [1.0])
+            assert ei.value.code == "NOT_FOUND"
+
+    def test_wrong_arity_bad_request(self, wire):
+        s, door, tables = wire
+        with WireClient("127.0.0.1", door.port) as c:
+            sid = c.prepare(AGG_SPEC)["statement_id"]
+            with pytest.raises(WireError) as ei:
+                c.execute(sid, [1.0, 2.0])
+            assert ei.value.code == "BAD_REQUEST"
+
+    def test_eviction_falls_back_to_replan(self, session, wire):
+        """A statement evicted by the LRU still executes (replanned from
+        the connection's recorded spec) — slower, never wrong."""
+        s, door, tables = wire
+        d2 = SqlFrontDoor(s, settings={
+            "spark.rapids.tpu.server.preparedCache.maxEntries": 1}).start()
+        for name, f in tables.items():
+            d2.register_table(name, f)
+        try:
+            with WireClient("127.0.0.1", d2.port) as c:
+                sid1 = c.prepare(AGG_SPEC)["statement_id"]
+                other = {"table": "mem", "ops": [
+                    {"op": "filter",
+                     "expr": ["<", ["col", "c"],
+                              ["param", 0, "long"]]}]}
+                c.prepare(other)  # evicts sid1 (maxEntries=1)
+                r = c.execute(sid1, [400.0])
+                assert not r.prepared  # replan fallback, flagged honest
+                assert _norm(r.rows()) == _oracle_agg(s, tables, 400.0)
+        finally:
+            d2.close()
+
+    def test_disabled_cache_still_correct(self, session, wire):
+        s, door, tables = wire
+        d2 = SqlFrontDoor(s, settings={
+            "spark.rapids.tpu.server.preparedCache.enabled": False}).start()
+        d2.register_table("orders", tables["orders"])
+        try:
+            with WireClient("127.0.0.1", d2.port) as c:
+                sid = c.prepare(AGG_SPEC)["statement_id"]
+                r = c.execute(sid, [600.0])
+                assert not r.prepared  # A/B mode: replans per execution
+                assert _norm(r.rows()) == _oracle_agg(s, tables, 600.0)
+        finally:
+            d2.close()
+
+
+# ---------------------------------------------------------------------------
+# Quotas
+# ---------------------------------------------------------------------------
+
+class TestQuotas:
+    def test_quota_parsing_and_clamp(self):
+        q = TenantQuotas("acme=2, other=5 ,*=3")
+        assert q.cap_for("acme") == 2
+        assert q.cap_for("other") == 5
+        assert q.cap_for("anyone") == 3
+        q.release("acme")  # release-before-acquire never mints quota
+        q.acquire("acme")
+        q.acquire("acme")
+        with pytest.raises(WireError) as ei:
+            q.acquire("acme")
+        assert ei.value.code == "QUOTA_EXCEEDED"
+        q.release("acme")
+        q.acquire("acme")  # freed slot admits again
+        with pytest.raises(ValueError):
+            TenantQuotas("garbage")
+
+    def test_quota_rejection_typed_on_wire(self, session, wire):
+        s, door, tables = wire
+        d2 = SqlFrontDoor(s, settings={
+            "spark.rapids.tpu.server.tenantQuotas": "capped=1"}).start()
+        d2.register_table("orders", tables["orders"])
+        try:
+            d2.quotas.acquire("capped")  # hold the only slot
+            with WireClient("127.0.0.1", d2.port, tenant="capped") as c:
+                with pytest.raises(WireError) as ei:
+                    c.query(SCAN_SPEC)
+                assert ei.value.code == "QUOTA_EXCEEDED"
+                d2.quotas.release("capped")
+                assert c.query(AGG_SPEC, params=[990.0]).stats[
+                    "status"] == "done"
+            assert d2.quotas.inflight() == 0
+        finally:
+            d2.close()
+
+
+# ---------------------------------------------------------------------------
+# Spooling
+# ---------------------------------------------------------------------------
+
+class TestSpool:
+    def test_spooled_large_result_matches_memory(self, session, wire):
+        """A result far beyond the in-memory budget spools to disk and
+        still matches the all-in-memory collect, and the spool file is
+        gone afterwards."""
+        import os
+        s, door, tables = wire
+        d2 = SqlFrontDoor(s, settings={
+            "spark.rapids.tpu.server.spool.memoryBytes": 2048}).start()
+        d2.register_table("orders", tables["orders"])
+        spool_dir = d2._spool_dir(d2._conf())
+        try:
+            with WireClient("127.0.0.1", d2.port) as c:
+                r = c.query(SCAN_SPEC)
+                assert r.stats["spooled_bytes"] > 0
+                oracle = _norm(tables["orders"]().where(
+                    F.col("v") > F.lit(5.0)).collect())
+                assert _norm(r.rows()) == oracle
+            assert not [f for f in os.listdir(spool_dir)
+                        if f.startswith("spool-")]
+        finally:
+            d2.close()
+
+    def test_slow_reader_spools_and_matches(self, session, wire):
+        s, door, tables = wire
+        d2 = SqlFrontDoor(s, settings={
+            "spark.rapids.tpu.server.spool.memoryBytes": 2048}).start()
+        d2.register_table("orders", tables["orders"])
+        try:
+            with WireClient("127.0.0.1", d2.port) as c:
+                total = 0
+                for kind, val in c.query_stream(SCAN_SPEC):
+                    if kind == "batch":
+                        time.sleep(0.02)  # deliberately slow consumer
+                        total += val.num_rows
+                    elif kind == "end":
+                        end = val
+                assert total == tables["orders"]().where(
+                    F.col("v") > F.lit(5.0)).count()
+                assert end["spooled_bytes"] > 0
+        finally:
+            d2.close()
+
+    def test_result_stream_unit(self, tmp_path):
+        from spark_rapids_tpu.server.spool import ResultStream
+        st = ResultStream("u", memory_bytes=16, spool_dir=str(tmp_path))
+        frames = [b"a" * 10, b"b" * 10, b"c" * 30, b"d" * 5]
+        for f in frames:
+            assert st.put(f)
+        st.finish({"rows": 4})
+        assert st.spooled  # overflowed the 16-byte budget
+        assert list(st.frames()) == frames  # order preserved across tiers
+        st.close()
+        assert not st.put(b"late")  # closed stream refuses frames
+
+    def test_gc_orphan_spools(self, tmp_path):
+        import os
+        from spark_rapids_tpu.server.spool import gc_orphan_spools
+        p = tmp_path / "spool-dead00000000.bin.inprogress"
+        p.write_bytes(b"x")
+        old = time.time() - 3600
+        os.utime(p, (old, old))
+        fresh = tmp_path / "spool-live00000000.bin.inprogress"
+        fresh.write_bytes(b"y")
+        assert gc_orphan_spools(str(tmp_path), older_than_ms=60000) == 1
+        assert fresh.exists() and not p.exists()
+
+
+# ---------------------------------------------------------------------------
+# Disconnect cleanup — the PR 7 leak-hygiene discipline on the wire
+# ---------------------------------------------------------------------------
+
+def _await_clean(s, door, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if s.scheduler().running() == 0 \
+                and door.snapshot()["queries_inflight"] == 0:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestDisconnectCleanup:
+    @pytest.mark.parametrize("mode", ["client_close", "injected_drop"])
+    def test_midstream_disconnect_releases_everything(self, wire, mode):
+        s, door, tables = wire
+        before = s.scheduler().snapshot()
+        if mode == "client_close":
+            c = WireClient("127.0.0.1", door.port)
+            it = c.query_stream(SCAN_SPEC)
+            assert next(it)[0] == "meta"
+            assert next(it)[0] == "batch"
+            c._sock.close()  # vanish mid-stream, no goodbye
+        else:
+            s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                       "server.conn:2")
+            try:
+                c = WireClient("127.0.0.1", door.port)
+                with pytest.raises((ConnectionError, OSError)):
+                    c.query(SCAN_SPEC)
+            finally:
+                s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+        assert _await_clean(s, door), "query/permit not released"
+        assert door.quotas.inflight() == 0
+        get_catalog().assert_no_leaks()
+        # the service still serves: a fresh connection completes a query
+        with WireClient("127.0.0.1", door.port) as c2:
+            assert c2.query(AGG_SPEC, params=[990.0]).stats[
+                "status"] == "done"
+
+    def test_cancel_by_id_from_other_connection(self, wire):
+        s, door, tables = wire
+        s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                   "device.hang:1")
+        s.conf.set("spark.rapids.tpu.faults.watchdog.enabled", False)
+        try:
+            a = WireClient("127.0.0.1", door.port)
+            it = a.query_stream(SCAN_SPEC)
+            kind, meta = next(it)
+            assert kind == "meta"
+            with WireClient("127.0.0.1", door.port) as b:
+                deadline = time.monotonic() + 10
+                cancelled = False
+                while time.monotonic() < deadline and not cancelled:
+                    cancelled = b.cancel(meta["query_id"])
+                    if not cancelled:
+                        time.sleep(0.05)
+                assert cancelled
+            with pytest.raises(WireError) as ei:
+                for _ in it:
+                    pass
+            assert ei.value.code == "CANCELLED"
+            a.close()
+        finally:
+            s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
+            s.conf.unset("spark.rapids.tpu.faults.watchdog.enabled")
+        assert _await_clean(s, door)
+        get_catalog().assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# Concurrent clients + stats reconciliation
+# ---------------------------------------------------------------------------
+
+class TestConcurrentClients:
+    def test_stats_reconcile(self, wire):
+        """Per-query stats from the wire sum to the process-aggregate
+        delta — concurrent wire queries never cross-account."""
+        s, door, tables = wire
+        n_threads, per_thread = 4, 3
+        before = QueryStats.process().snapshot()
+        results = []
+        errors = []
+
+        def client_run(i):
+            try:
+                with WireClient("127.0.0.1", door.port,
+                                tenant=f"t{i}") as c:
+                    for j in range(per_thread):
+                        r = c.query(AGG_SPEC, params=[200.0 + i * 10])
+                        results.append(r)
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=client_run, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors
+        assert len(results) == n_threads * per_thread
+        assert _await_clean(s, door)
+        delta = QueryStats.delta_since(before)
+        per_query_sum = sum(r.stats["stats"]["server_stream_bytes"]
+                            for r in results)
+        assert per_query_sum > 0
+        assert delta["server_stream_bytes"] >= per_query_sum
+        wire_bytes = sum(r.stats["stream_bytes"] for r in results)
+        assert wire_bytes == per_query_sum  # END frames match the scopes
+        for r in results:
+            assert r.stats["status"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# Trace integration
+# ---------------------------------------------------------------------------
+
+class TestTraceIntegration:
+    def test_wire_query_trace_attrs_and_report(self, wire):
+        s, door, tables = wire
+        s.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+        try:
+            with WireClient("127.0.0.1", door.port, tenant="traced") as c:
+                sid = c.prepare(AGG_SPEC)["statement_id"]
+                r = c.execute(sid, [100.0])
+                assert r.stats["status"] == "done"
+            deadline = time.monotonic() + 5
+            tr = None
+            while time.monotonic() < deadline:
+                tr = s.last_trace()
+                if tr is not None and tr.t_end is not None \
+                        and tr.attrs.get("tenant") == "traced":
+                    break
+                time.sleep(0.05)
+            assert tr is not None and tr.attrs.get("tenant") == "traced"
+            assert tr.attrs.get("connection", "").startswith("s-")
+            assert tr.attrs.get("prepared") is True
+            assert "queue_wait_s" in tr.attrs
+            names = [e[1] for e in tr.events]
+            assert "scheduler:queue_wait" in names
+            assert "server:stream_write" in names
+            # the report grows a server: line
+            import sys as _sys
+            _sys.path.insert(0, "tools")
+            from trace_report import analyze, format_report
+            rep = format_report(analyze(tr.to_chrome()))
+            assert "server:" in rep
+            assert "prepared=yes" in rep
+        finally:
+            s.conf.unset("spark.rapids.tpu.sql.trace.enabled")
+
+
+# ---------------------------------------------------------------------------
+# Satellites: confs, injector point, lint rule, docs
+# ---------------------------------------------------------------------------
+
+class TestSatellites:
+    def test_server_confs_registered(self):
+        for key in ("spark.rapids.tpu.server.host",
+                    "spark.rapids.tpu.server.port",
+                    "spark.rapids.tpu.server.maxConnections",
+                    "spark.rapids.tpu.server.authToken",
+                    "spark.rapids.tpu.server.tenantQuotas",
+                    "spark.rapids.tpu.server.idleTimeout",
+                    "spark.rapids.tpu.server.preparedCache.enabled",
+                    "spark.rapids.tpu.server.preparedCache.maxEntries",
+                    "spark.rapids.tpu.server.spool.dir",
+                    "spark.rapids.tpu.server.spool.memoryBytes"):
+            assert key in ALL_ENTRIES
+        assert "server.preparedCache.enabled" in TpuConf.help()
+
+    def test_server_conn_point_registered(self):
+        from spark_rapids_tpu.faults.injector import POINTS
+        assert "server.conn" in POINTS
+
+    def test_lint_flags_unbounded_accept(self, tmp_path):
+        from tools.check_fault_paths import check
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "srv.py").write_text(
+            "def f(srv):\n"
+            "    conn, _ = srv.accept()\n")
+        (pkg / "ok.py").write_text(
+            "def f(srv):\n"
+            "    conn, _ = srv.accept()  # wait-ok (settimeout at bind)\n")
+        violations = check(str(pkg))
+        assert [v[0] for v in violations] == ["srv.py"]
+        assert "[unbounded wait]" in violations[0][2]
+
+    def test_docs_linked(self):
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        serving = open(os.path.join(root, "docs", "serving.md")).read()
+        assert "Prepared statements" in serving
+        assert "server.conn" in serving
+        assert "serving.md" in open(
+            os.path.join(root, "docs", "concurrency.md")).read()
+        assert "serving.md" in open(
+            os.path.join(root, "README.md")).read()
+        cfg = open(os.path.join(root, "docs", "configs.md")).read()
+        assert "spark.rapids.tpu.server.preparedCache.maxEntries" in cfg
+
+
+# ---------------------------------------------------------------------------
+# The sustained-load harness, small edition (the full run is the
+# acceptance gate: tools/loadgen.py --queries 1000 --connections 8)
+# ---------------------------------------------------------------------------
+
+class TestLoadgenSmall:
+    def test_loadgen_small_run(self, fresh_session):
+        import argparse
+        import sys as _sys
+        _sys.path.insert(0, "tools")
+        import loadgen
+        args = argparse.Namespace(
+            queries=30, connections=4, tenants=4, rows=20_000,
+            prepared_frac=0.5, fault_rate=0.05, slow_frac=0.25,
+            slo_ms=5000.0, seed=11, tenant_quotas="*=8", serial_ab=3,
+            timeout=300.0, no_verify=False)
+        report = loadgen.run(args)
+        assert report["queries_completed"] == 30
+        assert report["mismatches"] == 0
+        assert report["leaks"] == []
+        assert report["p50_ms"] > 0 and report["p99_ms"] >= \
+            report["p95_ms"] >= report["p50_ms"]
+        assert report["prepared"]["hits"] > 0
+        assert set(report["serial_ab"]) == set(loadgen.templates())

@@ -19,14 +19,17 @@ Three rules over ``spark_rapids_tpu/``:
 
   3. **No unbounded blocking waits** — a no-timeout ``Condition.wait()``
      / ``Event.wait()``, a no-timeout ``Future.result()``, or a raw
-     socket/pipe ``recv(...)`` is exactly where a gray failure (a peer
-     that is slow-not-dead, a wedged native call) turns into a hang no
-     exception ever reports.  Outside ``faults/`` and ``service/`` (the
-     layers whose JOB is waiting — the watchdog, backoff sleeps,
-     cancellation gates), every such wait must either carry a timeout
-     or a ``# wait-ok (<why this wait is bounded/woken>)`` annotation
-     naming the mechanism that bounds it (a cancellation waker, a
-     socket timeout set elsewhere, a prior poll(timeout)).
+     socket/pipe ``recv(...)`` / ``accept(...)`` is exactly where a
+     gray failure (a peer that is slow-not-dead, a wedged native call)
+     turns into a hang no exception ever reports.  Outside ``faults/``
+     and ``service/`` (the layers whose JOB is waiting — the watchdog,
+     backoff sleeps, cancellation gates), every such wait must either
+     carry a timeout or a ``# wait-ok (<why this wait is bounded/woken>)``
+     annotation naming the mechanism that bounds it (a cancellation
+     waker, a socket timeout set elsewhere, a prior poll(timeout)).
+     The ``server/`` package is deliberately COVERED, not exempted:
+     its accept loop and every connection recv carry settimeouts
+     (idleTimeout), and the lint keeps it that way.
 
 Run standalone (``python tools/check_fault_paths.py``, exit 1 on
 violations) or let the suite run it: tests/conftest.py invokes
@@ -49,10 +52,12 @@ _TRANSIENT_EXCEPT = re.compile(
     r"^\s*except\b.*\b(OSError|ConnectionError|TimeoutError|"
     r"InterruptedError|Exception)\b")
 _EXEMPT = "# fault-ok"
-# rule 3: empty-arg .wait() / .result() (no timeout) and any .recv(
-# (boundedness lives in socket state the line can't show — annotate)
+# rule 3: empty-arg .wait() / .result() (no timeout), any .recv( and —
+# since the server/ package brought listening sockets into the tree —
+# any .accept( (boundedness lives in socket state the line can't show:
+# annotate with the mechanism, e.g. the settimeout set at bind/connect)
 _UNBOUNDED_WAIT = re.compile(
-    r"(\.wait\(\s*\)|\.result\(\s*\)|\.recv\s*\()")
+    r"(\.wait\(\s*\)|\.result\(\s*\)|\.recv\s*\(|\.accept\s*\()")
 _WAIT_EXEMPT = "# wait-ok"
 # how many lines after an except a sleep still reads as its retry path
 _RETRY_WINDOW = 8

@@ -1,0 +1,536 @@
+"""Sustained-load harness for the network SQL front door.
+
+The service's first honest "millions of users" proxy: thousands of wire
+queries from a zipf-skewed tenant mix driven through TCP connections
+against an in-process :class:`spark_rapids_tpu.server.SqlFrontDoor`,
+exercising admission control, tenant quotas, the prepared-statement plan
+cache, result spooling, seeded ``server.conn`` connection faults, and
+cancellation TOGETHER — with every result checked against the in-process
+oracle and every latency recorded.
+
+Reports (JSON line + human summary): p50/p95/p99 latency, throughput,
+SLO violations, prepared-vs-fresh latency (the plan-cache win), prepared
+hit rate, shed/retry counts — and FAILS (exit 1) on any result mismatch
+or leaked permit/handle/quota.
+
+Usage::
+
+    python tools/loadgen.py [--queries 1000] [--connections 8]
+        [--tenants 8] [--rows 200000] [--prepared-frac 0.5]
+        [--fault-rate 0.02] [--slow-frac 0.05] [--slo-ms 2000]
+        [--seed 42] [--json PATH]
+
+Environment fallbacks (the bench hook): SRT_LOADGEN_QUERIES,
+SRT_LOADGEN_CONNECTIONS, SRT_LOADGEN_FAULT_RATE, SRT_LOADGEN_SEED.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_pc = time.perf_counter
+
+
+# ---------------------------------------------------------------------------------
+# Workload: tables + parameterized query templates
+# ---------------------------------------------------------------------------------
+
+def build_tables(rows: int, seed: int):
+    """orders (zipf-skewed customer FK — the hot-key shape) + customers."""
+    from spark_rapids_tpu.datagen import (DoubleGen, FKGen, IntGen, SeqGen,
+                                          TableSpec)
+    n_cust = max(1000, rows // 20)
+    orders = TableSpec("orders", {
+        "o_id": SeqGen(),
+        "o_cust": FKGen(parent_rows=n_cust, distribution="zipf",
+                        nullable=False),
+        "o_qty": IntGen(lo=1, hi=50, nullable=False),
+        "o_amt": DoubleGen(lo=1.0, hi=1000.0, nullable=False),
+    })
+    customers = TableSpec("customers", {
+        "c_id": SeqGen(),
+        "c_seg": IntGen(lo=0, hi=8, nullable=False),
+    })
+    return (orders.generate(rows, seed=seed),
+            customers.generate(n_cust, seed=seed + 1))
+
+
+# template name -> (spec, param pools); pools are small so hot parameter
+# values repeat (the interactive-fleet shape the prepared cache + stage
+# program cache both exploit)
+def templates() -> Dict[str, Tuple[dict, List[list]]]:
+    return {
+        "seg_rollup": (
+            {"table": "orders",
+             "ops": [
+                 {"op": "filter",
+                  "expr": [">", ["col", "o_amt"],
+                           ["param", 0, "double"]]},
+                 {"op": "join", "table": "customers",
+                  "on": [["o_cust", "c_id"]], "how": "inner"},
+                 {"op": "agg", "group": ["c_seg"],
+                  "aggs": [["n", "count", "*"],
+                           ["total", "sum", ["col", "o_amt"]]]},
+                 {"op": "sort", "keys": [["c_seg", True]]}]},
+            [[50.0], [100.0], [250.0], [500.0], [900.0]]),
+        "hot_orders": (
+            {"table": "orders",
+             "ops": [
+                 {"op": "filter",
+                  "expr": ["and",
+                           [">", ["col", "o_amt"],
+                            ["param", 0, "double"]],
+                           ["<", ["col", "o_qty"],
+                            ["param", 1, "int"]]]},
+                 {"op": "agg", "group": ["o_cust"],
+                  "aggs": [["n", "count", "*"],
+                           ["amt", "sum", ["col", "o_amt"]]]},
+                 {"op": "sort", "keys": [["amt", False], ["o_cust", True]]},
+                 {"op": "limit", "n": 20}]},
+            [[200.0, 25], [500.0, 10], [800.0, 40], [300.0, 30]]),
+        "scan_band": (
+            {"table": "orders",
+             "ops": [
+                 {"op": "filter",
+                  "expr": ["and",
+                           [">=", ["col", "o_amt"],
+                            ["param", 0, "double"]],
+                           ["<", ["col", "o_amt"],
+                            ["param", 1, "double"]]]},
+                 {"op": "agg", "group": [],
+                  "aggs": [["n", "count", "*"],
+                           ["lo", "min", ["col", "o_amt"]],
+                           ["hi", "max", ["col", "o_amt"]]]}]},
+            [[10.0, 20.0], [400.0, 420.0], [990.0, 999.0]]),
+        # THE small interactive query (the Presto-paper shape the
+        # prepared cache targets): a point filter on a small table —
+        # execution is a few ms, so per-query planning overhead is a
+        # visible fraction and its elimination a visible win
+        "point_lookup": (
+            {"table": "customers",
+             "ops": [
+                 {"op": "filter",
+                  "expr": ["==", ["col", "c_id"],
+                           ["param", 0, "long"]]}]},
+            [[17], [123], [999], [5], [2048]]),
+    }
+
+
+def _norm_rows(rows: List[tuple]) -> List[tuple]:
+    out = []
+    for r in rows:
+        out.append(tuple(round(v, 5) if isinstance(v, float) else v
+                         for v in r))
+    return sorted(out, key=repr)
+
+
+class Oracle:
+    """In-process ground truth, computed once per (template, params)."""
+
+    def __init__(self, session, tables):
+        self._session = session
+        self._tables = tables
+        self._lock = threading.Lock()
+        self._cache: Dict[str, List[tuple]] = {}
+
+    def expected(self, name: str, spec: dict, params: list) -> List[tuple]:
+        key = f"{name}|{params!r}"
+        with self._lock:
+            rows = self._cache.get(key)
+        if rows is not None:
+            return rows
+        from spark_rapids_tpu.exprs import bind_params
+        from spark_rapids_tpu.server.spec import (coerce_params,
+                                                  compile_spec)
+        df, ptypes = compile_spec(spec, self._tables)
+        with bind_params(coerce_params(params, ptypes)):
+            rows = _norm_rows(df.collect())
+        with self._lock:
+            self._cache[key] = rows
+        return rows
+
+
+# ---------------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------------
+
+class Counters:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: List[Tuple[str, bool, float]] = []  # (tmpl, prepared, ms)
+        self.mismatches = 0
+        self.errors: Dict[str, int] = {}
+        self.conn_drops = 0
+        self.retries = 0
+        self.slow_streams = 0
+
+    def record(self, tmpl: str, prepared: bool, ms: float) -> None:
+        with self.lock:
+            self.latencies.append((tmpl, prepared, ms))
+
+    def error(self, kind: str) -> None:
+        with self.lock:
+            self.errors[kind] = self.errors.get(kind, 0) + 1
+
+
+def _pct(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    i = min(len(s) - 1, int(round(q * (len(s) - 1))))
+    return s[i]
+
+
+def _worker(wid: int, host: str, port: int, tenant: str, n_queries: int,
+            seed: int, prepared_frac: float, slow: bool, ctr: Counters,
+            oracle: Optional[Oracle], next_q, stop: threading.Event
+            ) -> None:
+    import numpy as np
+
+    from spark_rapids_tpu.server import WireClient, WireError
+    rng = np.random.default_rng(seed + wid)
+    tmpls = templates()
+    names = sorted(tmpls)
+    client = None
+    prepared_ids: Dict[str, str] = {}
+
+    def connect():
+        nonlocal client, prepared_ids
+        client = WireClient(host, port, tenant=tenant, timeout=120.0)
+        prepared_ids = {}
+
+    def attempt(name: str, spec: dict, params: list, use_prepared: bool):
+        """One wire execution; returns (normalized rows, prepared_run,
+        latency_ms).  Statement preparation happens OUTSIDE the timed
+        window — PREPARE is paid once per template, EXECUTE is the
+        steady-state cost being measured."""
+        if slow and name == "scan_band":
+            # a deliberately slow reader: exercises the disk spool
+            with ctr.lock:
+                ctr.slow_streams += 1
+            t0 = _pc()
+            rows = []
+            for kind, val in client.query_stream(spec, params=params):
+                if kind == "batch":
+                    time.sleep(0.05)
+                    rows.append(val)
+            return _collect_rows(rows), False, (_pc() - t0) * 1e3
+        if use_prepared:
+            sid = prepared_ids.get(name)
+            if sid is None:
+                sid = client.prepare(spec)["statement_id"]
+                prepared_ids[name] = sid
+            t0 = _pc()
+            rs = client.execute(sid, params)
+        else:
+            t0 = _pc()
+            rs = client.query(spec, params=params)
+        return _norm_rows(rs.rows()), rs.prepared, (_pc() - t0) * 1e3
+
+    connect()
+    while not stop.is_set():
+        qi = next_q()
+        if qi is None:
+            break
+        name = names[int(rng.integers(len(names)))]
+        spec, pools = tmpls[name]
+        params = list(pools[int(rng.integers(len(pools)))])
+        use_prepared = rng.random() < prepared_frac
+        # a shed/dropped query is RETRIED (the fleet behavior: typed
+        # overload errors and dropped connections are both retryable);
+        # only the successful attempt's latency is recorded
+        for attempt_i in range(6):
+            try:
+                res_rows, prepared_run, ms = attempt(
+                    name, spec, params, use_prepared)
+                ctr.record(name, prepared_run, ms)
+                if oracle is not None:
+                    exp = oracle.expected(name, spec, params)
+                    if exp != res_rows:
+                        with ctr.lock:
+                            ctr.mismatches += 1
+                        print(f"[loadgen] MISMATCH {name} "
+                              f"params={params} expected {len(exp)} "
+                              f"rows got {len(res_rows)}",
+                              file=sys.stderr)
+                break
+            except WireError as e:
+                ctr.error(e.code)
+                if e.code not in ("REJECTED", "QUOTA_EXCEEDED"):
+                    break  # typed query failure: counted, not retried
+                with ctr.lock:
+                    ctr.retries += 1
+                time.sleep(0.02 * (attempt_i + 1))  # fault-ok (paced retry after a TYPED shed reply, not an exception-swallowing loop)
+            except (ConnectionError, OSError):
+                # dropped connection (seeded server.conn fault or a real
+                # break): reconnect and retry — the fleet behavior
+                with ctr.lock:
+                    ctr.conn_drops += 1
+                    ctr.retries += 1
+                try:
+                    client.close()
+                except Exception:  # fault-ok (the socket is already dead)
+                    pass
+                try:
+                    connect()
+                except OSError:
+                    ctr.error("RECONNECT_FAILED")
+                    return
+    try:
+        client.close()
+    except Exception:  # fault-ok (best-effort goodbye at drain)
+        pass
+
+
+def _collect_rows(tables) -> List[tuple]:
+    rows: List[tuple] = []
+    for t in tables:
+        cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
+        rows.extend(tuple(c[i] for c in cols) for i in range(t.num_rows))
+    return _norm_rows(rows)
+
+
+def run(args) -> dict:
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.memory.spill import get_catalog
+    from spark_rapids_tpu.server import SqlFrontDoor
+
+    sess = srt.Session.get_or_create()
+    sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 50_000)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.maxConcurrent", 4)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.queueDepth", 256)
+    # the realistic serving configuration: the cross-query device cache
+    # (PR 4) keeps hot scans resident, so repeated wire queries measure
+    # the service path, not redundant uploads
+    sess.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+    if args.fault_rate > 0:
+        # seeded chaos on the wire only: connection drops mid-stream
+        # (rate mode — concurrent-safe, replayable under the seed)
+        sess.conf.set("spark.rapids.tpu.faults.inject.rate",
+                      args.fault_rate)
+        sess.conf.set("spark.rapids.tpu.faults.inject.points",
+                      "server.conn")
+        sess.conf.set("spark.rapids.tpu.faults.inject.seed", args.seed)
+
+    orders, customers = build_tables(args.rows, args.seed)
+    tables = {"orders": lambda: sess.create_dataframe(orders),
+              "customers": lambda: sess.create_dataframe(customers)}
+
+    door = SqlFrontDoor(sess, settings={
+        "spark.rapids.tpu.server.tenantQuotas": args.tenant_quotas,
+        "spark.rapids.tpu.server.spool.memoryBytes": 1 << 20,
+    }).start()
+    for name, factory in tables.items():
+        door.register_table(name, factory)
+
+    oracle = Oracle(sess, tables) if not args.no_verify else None
+    ctr = Counters()
+    # zipf-skewed tenant assignment: tenant-1 is hot, the tail is cold
+    rng = np.random.default_rng(args.seed)
+    z = np.clip(rng.zipf(1.5, args.connections), 1, args.tenants)
+    tenants = [f"tenant-{int(v)}" for v in z]
+
+    remaining = [args.queries]
+    rem_lock = threading.Lock()
+
+    def next_q():
+        with rem_lock:
+            if remaining[0] <= 0:
+                return None
+            remaining[0] -= 1
+            return remaining[0]
+
+    stop = threading.Event()
+    n_slow = max(0, int(round(args.slow_frac * args.connections)))
+    threads = []
+    t_start = _pc()
+    for i in range(args.connections):
+        th = threading.Thread(
+            target=_worker,
+            args=(i, "127.0.0.1", door.port, tenants[i], args.queries,
+                  args.seed, args.prepared_frac, i < n_slow, ctr, oracle,
+                  next_q, stop),
+            daemon=True, name=f"loadgen-{i}")
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=args.timeout)
+    stop.set()
+    wall_s = _pc() - t_start
+
+    # serial prepared-vs-fresh A/B: one quiet connection, alternating
+    # EXECUTE and SUBMIT per template after warmup — the clean
+    # measurement of what plan-once buys, free of queueing noise (and
+    # of chaos: the wire-fault injection disarms first)
+    if args.fault_rate > 0:
+        sess.conf.unset("spark.rapids.tpu.faults.inject.rate")
+        sess.conf.unset("spark.rapids.tpu.faults.inject.points")
+        sess.conf.unset("spark.rapids.tpu.faults.inject.seed")
+    serial_ab = {}
+    if args.serial_ab > 0:
+        from spark_rapids_tpu.server import WireClient
+        ab = WireClient("127.0.0.1", door.port, tenant="ab")
+        for name, (spec, pools) in sorted(templates().items()):
+            params = list(pools[0])
+            sid = ab.prepare(spec)["statement_id"]
+            for _ in range(3):
+                ab.execute(sid, params)
+                ab.query(spec, params=params)
+            f, pr = [], []
+            for _ in range(args.serial_ab):
+                t0 = _pc()
+                ab.query(spec, params=params)
+                f.append((_pc() - t0) * 1e3)
+                t0 = _pc()
+                ab.execute(sid, params)
+                pr.append((_pc() - t0) * 1e3)
+            serial_ab[name] = {
+                "fresh_p50_ms": round(_pct(f, 0.5), 3),
+                "prepared_p50_ms": round(_pct(pr, 0.5), 3),
+                "speedup": round(_pct(f, 0.5) / max(1e-9, _pct(pr, 0.5)),
+                                 3)}
+        ab.close()
+
+    # drain + leak audit: every permit, wire query, quota slot, and
+    # spill handle must be back
+    deadline = time.time() + 30
+    while time.time() < deadline and (
+            sess.scheduler().running() or
+            door.snapshot()["queries_inflight"]):
+        time.sleep(0.1)
+    snap = door.snapshot()
+    leaks = []
+    if sess.scheduler().running() != 0:
+        leaks.append(f"scheduler running={sess.scheduler().running()}")
+    if snap["queries_inflight"] != 0:
+        leaks.append(f"wire queries inflight={snap['queries_inflight']}")
+    if door.quotas.inflight() != 0:
+        leaks.append(f"tenant quota inflight={door.quotas.inflight()}")
+    door.close()
+    try:
+        get_catalog().assert_no_leaks()
+    except AssertionError as e:
+        leaks.append(f"spill handles: {e}")
+
+    lats = [ms for _, _, ms in ctr.latencies]
+
+    def _warm(vals: List[float]) -> List[float]:
+        # drop each group's cold head (first XLA compiles of a fresh
+        # param value, first touches of the scan) so the prepared-vs-
+        # fresh comparison measures the steady state the plan cache
+        # exists for
+        return vals[min(3, len(vals) // 4):]
+
+    fresh, prep = [], []
+    per_tmpl = {}
+    for name in sorted(templates()):
+        f = _warm([ms for t, p, ms in ctr.latencies
+                   if t == name and not p])
+        pr = _warm([ms for t, p, ms in ctr.latencies if t == name and p])
+        fresh += f
+        prep += pr
+        per_tmpl[name] = {
+            "fresh_p50_ms": round(_pct(f, 0.5), 2),
+            "prepared_p50_ms": round(_pct(pr, 0.5), 2),
+            "fresh_n": len(f), "prepared_n": len(pr)}
+    report = {
+        "loadgen": 1,
+        "queries_completed": len(lats),
+        "queries_requested": args.queries,
+        "connections": args.connections,
+        "tenants": sorted(set(tenants)),
+        "wall_s": round(wall_s, 2),
+        "throughput_qps": round(len(lats) / wall_s, 2) if wall_s else 0,
+        "p50_ms": round(_pct(lats, 0.5), 2),
+        "p95_ms": round(_pct(lats, 0.95), 2),
+        "p99_ms": round(_pct(lats, 0.99), 2),
+        "slo_ms": args.slo_ms,
+        "slo_violations": sum(1 for v in lats if v > args.slo_ms),
+        "fresh_p50_ms": round(_pct(fresh, 0.5), 2),
+        "prepared_p50_ms": round(_pct(prep, 0.5), 2),
+        "per_template": per_tmpl,
+        "serial_ab": serial_ab,
+        "prepared": snap["prepared"],
+        "mismatches": ctr.mismatches,
+        "typed_errors": ctr.errors,
+        "conn_drops_client": ctr.conn_drops,
+        "conn_lost_server": snap["conn_lost"],
+        "retries": ctr.retries,
+        "slow_streams": ctr.slow_streams,
+        "spooled_bytes": snap["spooled_bytes"],
+        "streamed_bytes": snap["streamed_bytes"],
+        "scheduler": snap["scheduler"],
+        "leaks": leaks,
+        "verified": oracle is not None,
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    env = os.environ
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--queries", type=int,
+                    default=int(env.get("SRT_LOADGEN_QUERIES", "1000")))
+    ap.add_argument("--connections", type=int,
+                    default=int(env.get("SRT_LOADGEN_CONNECTIONS", "8")))
+    ap.add_argument("--tenants", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--prepared-frac", type=float, default=0.5)
+    ap.add_argument("--fault-rate", type=float,
+                    default=float(env.get("SRT_LOADGEN_FAULT_RATE",
+                                          "0.02")))
+    ap.add_argument("--slow-frac", type=float, default=0.05)
+    ap.add_argument("--slo-ms", type=float, default=2000.0)
+    ap.add_argument("--seed", type=int,
+                    default=int(env.get("SRT_LOADGEN_SEED", "42")))
+    ap.add_argument("--tenant-quotas", default="*=16")
+    ap.add_argument("--serial-ab", type=int, default=20)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--no-verify", action="store_true")
+    ap.add_argument("--json", default="")
+    args = ap.parse_args(argv)
+
+    report = run(args)
+    line = json.dumps(report, sort_keys=True)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    ok = (not report["leaks"] and report["mismatches"] == 0
+          and report["queries_completed"] >= args.queries)
+    speedup = (report["fresh_p50_ms"] / report["prepared_p50_ms"]
+               if report["prepared_p50_ms"] else 0.0)
+    print(f"[loadgen] {report['queries_completed']} queries over "
+          f"{report['connections']} conns in {report['wall_s']}s "
+          f"({report['throughput_qps']} qps)  "
+          f"p50={report['p50_ms']}ms p95={report['p95_ms']}ms "
+          f"p99={report['p99_ms']}ms  "
+          f"slo_violations={report['slo_violations']}",
+          file=sys.stderr)
+    print(f"[loadgen] prepared p50 {report['prepared_p50_ms']}ms vs "
+          f"fresh p50 {report['fresh_p50_ms']}ms "
+          f"({speedup:.2f}x under load), hit_rate="
+          f"{report['prepared']['hit_rate']:.2f}  "
+          f"drops={report['conn_drops_client']} "
+          f"retries={report['retries']}  "
+          f"mismatches={report['mismatches']}  "
+          f"leaks={report['leaks'] or 'none'}", file=sys.stderr)
+    for name, ab in sorted(report.get("serial_ab", {}).items()):
+        print(f"[loadgen]   serial A/B {name}: prepared "
+              f"{ab['prepared_p50_ms']}ms vs fresh {ab['fresh_p50_ms']}ms"
+              f" ({ab['speedup']:.2f}x)", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -203,6 +203,12 @@ def analyze(data: dict) -> dict:
     def _fname(n):
         return sum(1 for e in fault_events if e.get("name") == n)
 
+    # network-front-door events (cat "server")
+    server_events = [e for e in xs if e.get("cat") == "server"]
+
+    def _fname_cat(evs, n):
+        return sum(1 for e in evs if e.get("name") == n)
+
     fetch_events = [e for e in xs if e.get("cat") == "fetch"]
     blocking = [e for e in fetch_events
                 if e.get("args", {}).get("blocking")]
@@ -273,6 +279,26 @@ def analyze(data: dict) -> dict:
         "stalls_detected": int(qargs.get("stalls_detected",
                                          _fname("watchdog:stall"))),
         "watchdog_reclaims": _fname("watchdog:reclaim"),
+        # network front door (cat "server": server:stream_write spans
+        # from the connection thread, server:spool_start /
+        # server:prepared_hit marks; QueryStats snapshot on the root
+        # event authoritative when present)
+        "server_stream_bytes": int(qargs.get(
+            "server_stream_bytes",
+            sum(e.get("args", {}).get("bytes", 0) for e in server_events
+                if e.get("name") == "server:stream_write"))),
+        "server_spooled_bytes": int(qargs.get("server_spooled_bytes", 0)),
+        "server_writes": sum(1 for e in server_events
+                             if e.get("name") == "server:stream_write"),
+        "server_write_s": sum(
+            e.get("dur", 0.0) for e in server_events
+            if e.get("name") == "server:stream_write") / 1e6,
+        "server_connection": qargs.get("connection", ""),
+        "server_prepared": bool(qargs.get("prepared", False)),
+        "prepared_hits": int(qargs.get("prepared_hits",
+                                       _fname_cat(server_events,
+                                                  "server:prepared_hit"))),
+        "prepared_misses": int(qargs.get("prepared_misses", 0)),
     }
 
 
@@ -351,6 +377,23 @@ def format_report(a: dict) -> str:
         lines.append(
             f"stalls: detected={a['stalls_detected']} "
             f"reclaims={a['watchdog_reclaims']} (watchdog)")
+    # server summary only when the query arrived over the wire (stream
+    # writes / spool / prepared-cache traffic)
+    srv = (a.get("server_stream_bytes", 0) + a.get("server_writes", 0)
+           + a.get("prepared_hits", 0) + a.get("prepared_misses", 0))
+    if srv or a.get("server_prepared"):
+        looked = a.get("prepared_hits", 0) + a.get("prepared_misses", 0)
+        rate_part = (f" prepared_hit_rate="
+                     f"{a['prepared_hits'] / looked:.2f}") if looked else ""
+        conn = a.get("server_connection", "")
+        lines.append(
+            f"server: streamed={a['server_stream_bytes'] / 1e6:.1f}MB "
+            f"in {a['server_writes']} writes "
+            f"({a['server_write_s'] * 1e3:.1f}ms on the wire) "
+            f"spooled={a['server_spooled_bytes'] / 1e6:.1f}MB "
+            f"prepared={'yes' if a.get('server_prepared') else 'no'}"
+            + rate_part
+            + (f" connection={conn}" if conn else ""))
     return "\n".join(lines)
 
 
