@@ -26,7 +26,7 @@ Entries are held through :class:`..memory.spill.SpillableBatch` handles,
 which pin ``ColumnBatch.donatable=False`` (a fused stage must never
 donate a cached buffer to XLA) and re-materialize transparently after a
 spill demotion.  All lookups/insertions key through
-:mod:`.keys` (``tools/check_cache_keys.py`` enforces it).
+:mod:`.keys` (the srtlint ``cache-keys`` pass enforces it).
 """
 
 from __future__ import annotations

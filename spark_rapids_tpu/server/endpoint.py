@@ -340,25 +340,30 @@ class SqlFrontDoor:
                               self._spool_dir(conf))
 
         self.quotas.acquire(csess.tenant)  # typed QUOTA_EXCEEDED
+        # one finally covers every exit edge from here on: a failed
+        # submit, a client drop mid-stream, and the ordinary end all
+        # release the quota slot and close the stream exactly once
+        # (srtlint release-paths keeps it that way)
+        wq = None
         try:
             wq = self._submit(csess, label, query_id, run, stream,
                               req, deadline_ms)
-        except BaseException:
-            self.quotas.release(csess.tenant)
-            stream.close()
-            raise
-        try:
-            self._stream_result(conn, wq, schema, prepared_run,
-                                plan_saved_ms)
-        except (ConnectionError, socket.timeout, OSError,
-                P.ProtocolError):
-            # mid-stream client drop (real, or server.conn-injected):
-            # cancel cooperatively, release everything, re-raise so the
-            # handler closes the connection
-            self._client_gone(wq)
-            raise
+            try:
+                self._stream_result(conn, wq, schema, prepared_run,
+                                    plan_saved_ms)
+            except (ConnectionError, socket.timeout, OSError,
+                    P.ProtocolError):
+                # mid-stream client drop (real, or server.conn-
+                # injected): cancel cooperatively, re-raise so the
+                # handler closes the connection
+                self._client_gone(wq)
+                raise
         finally:
-            self._finish_query(wq, csess.tenant)
+            if wq is None:
+                self.quotas.release(csess.tenant)
+                stream.close()
+            else:
+                self._finish_query(wq, csess.tenant)
 
     def _planned_runner(self, phys, values) -> Callable:
         """The prepared fast path's worker body: bind parameters, stream

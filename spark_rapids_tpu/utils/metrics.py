@@ -449,7 +449,7 @@ class MetricSet:
     @contextlib.contextmanager
     def time(self, name: str):
         """Time a named phase of this operator.  This is the span API for
-        exec-node timing (tools/check_span_timing.py rejects raw clock
+        exec-node timing (the srtlint span-timing pass rejects raw clock
         reads in the operator layer): the measurement lands in the metric
         value AND — when a query trace is active — as a phase span under
         the operator (decode/H2D/dispatch/fetch attribution)."""

@@ -26,7 +26,7 @@ entry point is a single ContextVar read returning a no-op — the
 tracing-off path adds no allocation to the pull loop.
 
 This module is the ONE place exec-node timing may read the clock;
-``tools/check_span_timing.py`` rejects raw ``time.perf_counter()`` in the
+srtlint's ``span-timing`` pass rejects raw ``time.perf_counter()`` in the
 plan/parallel layers so attribution cannot silently rot.
 """
 

@@ -52,60 +52,20 @@ def rng():
 
 
 def pytest_collection_modifyitems(config, items):
-    """Collection-time lints: (a) a raw jax.device_get / np.asarray(
-    <col>.data) in the operator layer dodges the metrics choke point and
-    silently corrupts the sync profile; (b) a raw clock read in the
-    exec-node layer bypasses the span API, so profiled EXPLAIN and the
-    trace export silently lose that time — fail the run before any test
-    executes."""
-    from tools.check_blocking_fetch import check
-    violations = check()
-    if violations:
-        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
-                          for rel, ln, src in violations)
+    """Collection-time static analysis: ONE cached srtlint scan
+    (tools/srtlint — AST engine, eight passes over a single shared
+    parse) replaces the five regex lints that each re-read the whole
+    tree here.  The scan is memoized on an mtime+size snapshot of the
+    tree, so an unchanged tree re-verifies in milliseconds; any
+    unsuppressed finding fails the run before a single test executes.
+    Rule docs: python -m tools.srtlint --explain <rule>, or
+    docs/static_analysis.md."""
+    from tools.srtlint import run_for_pytest
+    report = run_for_pytest()
+    if report.failing:
+        lines = "\n".join(
+            f"  {f.path}:{f.line}: [{f.rule}] {f.message}"
+            for f in report.failing)
         raise pytest.UsageError(
-            "raw device->host transfers outside utils.metrics.fetch/"
-            f"fetch_async (tools/check_blocking_fetch.py):\n{lines}")
-    from tools.check_span_timing import check as check_timing
-    violations = check_timing()
-    if violations:
-        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
-                          for rel, ln, src in violations)
-        raise pytest.UsageError(
-            "raw clock reads bypassing the span API — use MetricSet.time"
-            " or utils.tracing.span (tools/check_span_timing.py):\n"
-            f"{lines}")
-    # (c) a worker thread created without joining the query's
-    # contextvars escapes per-query stats/trace/cancellation
-    from tools.check_ctx_threads import check as check_threads
-    violations = check_threads()
-    if violations:
-        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
-                          for rel, ln, src in violations)
-        raise pytest.UsageError(
-            "threads that don't join query contextvars — run work via "
-            "contextvars.copy_context() or mark '# ctx-ok' "
-            f"(tools/check_ctx_threads.py):\n{lines}")
-    # (d) cross-query cache keys built anywhere but cache/keys.py would
-    # let the identity rules diverge between tiers — silent wrong-data
-    # hits, the worst failure mode a cache has
-    from tools.check_cache_keys import check as check_keys
-    violations = check_keys()
-    if violations:
-        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
-                          for rel, ln, src in violations)
-        raise pytest.UsageError(
-            "ad-hoc cache keys — derive them via cache.keys.scan_key / "
-            f"broadcast_key (tools/check_cache_keys.py):\n{lines}")
-    # (e) a bare `except Exception: pass` swallows the transient faults
-    # the recovery framework exists to retry/account, and a hand-rolled
-    # sleep-after-except retry loop dodges backoff, budgets, and stats
-    from tools.check_fault_paths import check as check_faults
-    violations = check_faults()
-    if violations:
-        lines = "\n".join(f"  spark_rapids_tpu/{rel}:{ln}: {src}"
-                          for rel, ln, src in violations)
-        raise pytest.UsageError(
-            "swallowed faults / ad-hoc retry loops — use faults.recovery."
-            "transient_retry or mark '# fault-ok' "
-            f"(tools/check_fault_paths.py):\n{lines}")
+            "srtlint found invariant violations (python -m tools.srtlint"
+            f" --explain <rule> for the contract):\n{lines}")

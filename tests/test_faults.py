@@ -898,9 +898,9 @@ class TestSatellites:
             assert key in ALL_ENTRIES
         assert "faults.backoff.baseMs" in TpuConf.help()
 
-    def test_check_fault_paths_lint(self, tmp_path):
-        from tools.check_fault_paths import check
-        pkg = tmp_path / "pkg"
+    def test_fault_paths_lint(self, tmp_path):
+        from tools.srtlint.engine import run as lint_run
+        pkg = tmp_path / "spark_rapids_tpu"
         pkg.mkdir()
         (pkg / "bad.py").write_text(
             "import time\n"
@@ -928,17 +928,21 @@ class TestSatellites:
             "            return g()\n"
             "        except OSError:\n"
             "            time.sleep(0.1)  # fault-ok (bootstrap)\n")
-        violations = check(str(pkg))
-        files = sorted({rel for rel, _, _ in violations})
-        assert files == ["bad.py"]
-        kinds = sorted(line.rsplit("[", 1)[1] for _, _, line in violations)
-        assert kinds == ["ad-hoc retry loop]", "swallowed fault]"]
+        report = lint_run(str(tmp_path), roots=("spark_rapids_tpu",),
+                          rules=["fault-paths"])
+        files = sorted({f.path for f in report.failing})
+        assert files == ["spark_rapids_tpu/bad.py"]
+        msgs = sorted(f.message for f in report.failing)
+        assert "swallowing" in msgs[0] or "swallowing" in msgs[1]
+        assert any("retry" in m for m in msgs)
+        assert len(report.suppressed) == 2
 
-    def test_check_fault_paths_unbounded_wait_rule(self, tmp_path):
+    def test_fault_paths_unbounded_wait_rule(self, tmp_path):
         """Rule 3: no-timeout waits/results/recvs are flagged outside
-        faults/ and service/; # wait-ok exempts; timeouts pass."""
-        from tools.check_fault_paths import check
-        pkg = tmp_path / "pkg"
+        faults/ and service/; # wait-ok (<reason>) exempts; timeouts
+        pass."""
+        from tools.srtlint.engine import run as lint_run
+        pkg = tmp_path / "spark_rapids_tpu"
         (pkg / "service").mkdir(parents=True)
         (pkg / "bad_wait.py").write_text(
             "def f(cv, fut, sock):\n"
@@ -954,12 +958,13 @@ class TestSatellites:
         (pkg / "service" / "waiter.py").write_text(
             "def f(cv):\n"
             "    cv.wait()\n")  # service/ is the waiting layer: exempt
-        violations = check(str(pkg))
-        files = sorted({rel for rel, _, _ in violations})
-        assert files == ["bad_wait.py"]
-        assert len(violations) == 3
-        assert all("[unbounded wait]" in line
-                   for _, _, line in violations)
+        report = lint_run(str(tmp_path), roots=("spark_rapids_tpu",),
+                          rules=["fault-paths"])
+        files = sorted({f.path for f in report.failing})
+        assert files == ["spark_rapids_tpu/bad_wait.py"]
+        assert len(report.failing) == 3
+        assert all("unbounded blocking" in f.message
+                   for f in report.failing)
 
     def test_gray_points_registered(self):
         for p in ("shuffle.corrupt", "spill.corrupt", "cache.corrupt",
@@ -974,8 +979,10 @@ class TestSatellites:
             assert key in ALL_ENTRIES
 
     def test_engine_tree_is_lint_clean(self):
-        from tools.check_fault_paths import check
-        assert check() == []
+        from tools.srtlint import run_for_pytest
+        report = run_for_pytest()
+        assert [f for f in report.failing
+                if f.rule == "fault-paths"] == []
 
     def test_query_faulted_exported_from_service(self):
         from spark_rapids_tpu.service import QueryFaulted as QF

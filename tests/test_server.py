@@ -665,8 +665,8 @@ class TestSatellites:
         assert "server.conn" in POINTS
 
     def test_lint_flags_unbounded_accept(self, tmp_path):
-        from tools.check_fault_paths import check
-        pkg = tmp_path / "pkg"
+        from tools.srtlint.engine import run as lint_run
+        pkg = tmp_path / "spark_rapids_tpu"
         pkg.mkdir()
         (pkg / "srv.py").write_text(
             "def f(srv):\n"
@@ -674,9 +674,12 @@ class TestSatellites:
         (pkg / "ok.py").write_text(
             "def f(srv):\n"
             "    conn, _ = srv.accept()  # wait-ok (settimeout at bind)\n")
-        violations = check(str(pkg))
-        assert [v[0] for v in violations] == ["srv.py"]
-        assert "[unbounded wait]" in violations[0][2]
+        report = lint_run(str(tmp_path), roots=("spark_rapids_tpu",),
+                          rules=["fault-paths"])
+        assert [f.path for f in report.failing] \
+            == ["spark_rapids_tpu/srv.py"]
+        assert "unbounded blocking .accept()" in \
+            report.failing[0].message
 
     def test_docs_linked(self):
         import os

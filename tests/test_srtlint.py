@@ -1,0 +1,473 @@
+"""tools/srtlint — the unified AST static analysis engine.
+
+Covers, per pass: detection on fixture snippets (including the
+defect classes the retired regex scanners provably missed), reasoned
+suppression, and the baseline workflow; plus the engine surfaces
+(CLI, JSON, explain, mtime-keyed cache) and the acceptance gates:
+the real tree is clean and a full run fits the collection wall budget.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tools.srtlint import engine
+from tools.srtlint.engine import run as lint_run
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files):
+    """Write {relpath: source} under a fixture spark_rapids_tpu/."""
+    for rel, src in files.items():
+        p = tmp_path / "spark_rapids_tpu" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+def _lint(tmp_path, files, rules):
+    return lint_run(_tree(tmp_path, files),
+                    roots=("spark_rapids_tpu",), rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# ported passes: the regex scanners' false-negative classes are caught
+# ---------------------------------------------------------------------------
+
+class TestBlockingFetch:
+    def test_aliased_device_get_regex_false_negative(self, tmp_path):
+        """`from jax import device_get as dg` dodged the old
+        `jax.device_get(` line regex entirely."""
+        report = _lint(tmp_path, {"plan/bad.py": (
+            "from jax import device_get as dg\n"
+            "def f(x):\n"
+            "    return dg(x)\n")}, ["blocking-fetch"])
+        assert [f.line for f in report.failing] == [3]
+        assert "choke point" in report.failing[0].message
+
+    def test_multiline_asarray_and_suppression(self, tmp_path):
+        """A call spanning lines (regex saw only line 1) + a reasoned
+        legacy marker anywhere on the statement suppresses."""
+        report = _lint(tmp_path, {"ops/bad.py": (
+            "import numpy as np\n"
+            "def f(col):\n"
+            "    return np.asarray(\n"
+            "        col.data)\n"
+            "def g(col):\n"
+            "    return np.asarray(\n"
+            "        col.codes)  # choke-point-ok (host column; no device buffer)\n")},
+            ["blocking-fetch"])
+        assert [f.line for f in report.failing] == [3]
+        assert len(report.suppressed) == 1
+
+    def test_outside_operator_layer_ignored(self, tmp_path):
+        report = _lint(tmp_path, {"io/x.py": (
+            "import jax\n"
+            "def f(x):\n"
+            "    return jax.device_get(x)\n")}, ["blocking-fetch"])
+        assert report.failing == []
+
+
+class TestSpanTiming:
+    def test_aliased_clock_import(self, tmp_path):
+        """`from time import perf_counter` was invisible to the
+        `time.perf_counter(` regex."""
+        report = _lint(tmp_path, {"parallel/bad.py": (
+            "from time import perf_counter as pc\n"
+            "t0 = pc()\n")}, ["span-timing"])
+        assert [f.line for f in report.failing] == [2]
+
+
+class TestCtxThreads:
+    def test_evidence_beyond_regex_window(self, tmp_path):
+        """copy_context evidence 5+ lines from the creation site was a
+        false POSITIVE for the ±3-line regex window; the AST pass
+        scopes evidence to the enclosing function."""
+        src = (
+            "import contextvars, threading\n"
+            "def spawn(fn):\n"
+            "    cctx = contextvars.copy_context()\n"
+            "    a = 1\n"
+            "    b = 2\n"
+            "    c = 3\n"
+            "    d = 4\n"
+            "    th = threading.Thread(target=lambda: cctx.run(fn))\n"
+            "    th.start()\n")
+        report = _lint(tmp_path, {"runtime/pool.py": src},
+                       ["ctx-threads"])
+        assert report.failing == []
+
+    def test_detect_and_reasoned_suppress(self, tmp_path):
+        report = _lint(tmp_path, {"runtime/bad.py": (
+            "import threading\n"
+            "def spawn(fn):\n"
+            "    threading.Thread(target=fn).start()\n"
+            "def ok(fn):\n"
+            "    threading.Thread(target=fn).start()  # ctx-ok (process-lifetime control plane)\n")},
+            ["ctx-threads"])
+        assert [f.line for f in report.failing] == [3]
+        assert len(report.suppressed) == 1
+
+
+class TestCacheKeys:
+    def test_aliased_constructor_and_multiline_literal(self, tmp_path):
+        """Both regex false-negative classes: an aliased CacheKey
+        import and a literal key split across lines."""
+        report = _lint(tmp_path, {"plan/bad.py": (
+            "from ..cache.keys import CacheKey as CK\n"
+            "def f(cache, schema):\n"
+            "    k = CK('scan', (), None, None)\n"
+            "    return cache.lookup_scan(\n"
+            "        ('adhoc',\n"
+            "         'tuple'), schema)\n")}, ["cache-keys"])
+        assert sorted(f.line for f in report.failing) == [3, 4]
+
+    def test_keys_module_itself_exempt(self, tmp_path):
+        report = _lint(tmp_path, {"cache/keys.py": (
+            "class CacheKey:\n"
+            "    pass\n"
+            "def scan_key():\n"
+            "    return CacheKey()\n")}, ["cache-keys"])
+        assert report.failing == []
+
+
+class TestFaultPaths:
+    def test_multiline_except_sleep_pair(self, tmp_path):
+        """A sleep 10 lines into the handler suite: past the regex
+        scanner's 8-line window, inside the AST handler scope."""
+        filler = "".join(f"        x{i} = {i}\n" for i in range(10))
+        report = _lint(tmp_path, {"io/bad.py": (
+            "import time\n"
+            "def r():\n"
+            "    try:\n"
+            "        return g()\n"
+            "    except OSError:\n"
+            + filler +
+            "        time.sleep(0.1)\n")}, ["fault-paths"])
+        assert len(report.failing) == 1
+        assert "ad-hoc retry" in report.failing[0].message
+        assert report.failing[0].line == 16
+
+    def test_swallowed_fault_marker_on_pass_line(self, tmp_path):
+        report = _lint(tmp_path, {"io/x.py": (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        pass  # fault-ok (best-effort hint)\n"
+            "    try:\n"
+            "        g()\n"
+            "    except BaseException:\n"
+            "        pass\n")}, ["fault-paths"])
+        assert [f.line for f in report.failing] == [8]
+        assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# new passes
+# ---------------------------------------------------------------------------
+
+class TestReleasePaths:
+    def test_leaked_handle_detected(self, tmp_path):
+        report = _lint(tmp_path, {"plan/bad.py": (
+            "def f(catalog, b):\n"
+            "    h = catalog.register(b)\n"
+            "    h.get()\n")}, ["release-paths"])
+        assert len(report.failing) == 1
+        assert "never released" in report.failing[0].message
+
+    def test_straight_line_release_flagged(self, tmp_path):
+        report = _lint(tmp_path, {"plan/bad.py": (
+            "def f(catalog, b):\n"
+            "    h = catalog.register(b)\n"
+            "    work(h)\n"
+            "    h.close()\n")}, ["release-paths"])
+        assert len(report.failing) == 1
+        assert "straight-line" in report.failing[0].message
+
+    def test_finally_release_clean(self, tmp_path):
+        report = _lint(tmp_path, {"plan/ok.py": (
+            "def f(catalog, b):\n"
+            "    h = catalog.register(b)\n"
+            "    try:\n"
+            "        work(h)\n"
+            "    finally:\n"
+            "        h.close()\n")}, ["release-paths"])
+        assert report.failing == []
+
+    def test_exit_edge_between_acquire_and_finally(self, tmp_path):
+        """CFG-lite: a return between acquisition and its protecting
+        try/finally is a leak edge."""
+        report = _lint(tmp_path, {"plan/bad.py": (
+            "def f(catalog, b, flag):\n"
+            "    h = catalog.register(b)\n"
+            "    if flag:\n"
+            "        return None\n"
+            "    try:\n"
+            "        return work(h)\n"
+            "    finally:\n"
+            "        h.close()\n")}, ["release-paths"])
+        assert len(report.failing) == 1
+        assert report.failing[0].line == 4
+        assert "leaks" in report.failing[0].message
+
+    def test_escape_and_with_are_clean(self, tmp_path):
+        report = _lint(tmp_path, {"plan/ok.py": (
+            "def f(catalog, b, out):\n"
+            "    h = catalog.register(b)\n"
+            "    out.append(h)\n"
+            "def g(sem):\n"
+            "    with sem.acquire():\n"
+            "        pass\n"
+            "def r(cache, key):\n"
+            "    hit = cache.lookup_broadcast(key)\n"
+            "    return hit\n")}, ["release-paths"])
+        assert report.failing == []
+
+    def test_paired_void_quota(self, tmp_path):
+        report = _lint(tmp_path, {"server/bad.py": (
+            "def f(quotas, tenant):\n"
+            "    quotas.acquire(tenant)\n"
+            "    work()\n"
+            "    quotas.release(tenant)\n"
+            "def ok(quotas, tenant):\n"
+            "    quotas.acquire(tenant)\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        quotas.release(tenant)\n")}, ["release-paths"])
+        assert [f.line for f in report.failing] == [2]
+        assert "finally" in report.failing[0].message
+
+
+class TestLockDiscipline:
+    def test_blocking_under_lock(self, tmp_path):
+        report = _lint(tmp_path, {"service/bad.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self, sock):\n"
+            "        with self._lock:\n"
+            "            sock.recv(4096)\n")}, ["lock-discipline"])
+        assert len(report.failing) == 1
+        assert "sock.recv" in report.failing[0].message
+
+    def test_cv_self_wait_not_flagged(self, tmp_path):
+        report = _lint(tmp_path, {"service/ok.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._cv = threading.Condition()\n"
+            "    def f(self):\n"
+            "        with self._cv:\n"
+            "            self._cv.wait()\n")}, ["lock-discipline"])
+        assert report.failing == []
+
+    def test_blocking_through_helper(self, tmp_path):
+        """Interprocedural summary: the blocking call hides one level
+        down in a same-module helper."""
+        report = _lint(tmp_path, {"service/bad.py": (
+            "import threading\n"
+            "def _pull(sock):\n"
+            "    return sock.recv(4096)\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self, sock):\n"
+            "        with self._lock:\n"
+            "            return _pull(sock)\n")}, ["lock-discipline"])
+        assert len(report.failing) == 1
+        assert "reaches blocking" in report.failing[0].message
+
+    def test_lock_order_cycle(self, tmp_path):
+        report = _lint(tmp_path, {"cache/bad.py": (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def ab(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def ba(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n")}, ["lock-discipline"])
+        cyc = [f for f in report.failing if "cycle" in f.message]
+        assert len(cyc) == 2  # one per participating edge
+        assert "one global order" in cyc[0].message
+
+    def test_consistent_order_no_cycle(self, tmp_path):
+        report = _lint(tmp_path, {"cache/ok.py": (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def ab(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def ab2(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n")}, ["lock-discipline"])
+        assert report.failing == []
+
+
+_CONF_FIXTURE = {
+    "config.py": (
+        "def register(key, default, doc, **kw):\n"
+        "    return key\n"
+        "A = register('spark.rapids.tpu.a', 1, 'used and documented')\n"
+        "B = register('spark.rapids.tpu.b', 1, 'internal',\n"
+        "             internal=True)\n"
+        "ORPHAN = register('spark.rapids.tpu.orphan', 1, 'dead')\n"),
+    "user.py": (
+        "from .config import B\n"
+        "def f(conf, tier):\n"
+        "    x = conf['spark.rapids.tpu.a']\n"
+        "    y = conf['spark.rapids.tpu.nope']\n"
+        "    z = conf[f'spark.rapids.tpu.{tier}.enabled']\n"
+        "    return x, y, z, B\n"),
+}
+
+
+class TestConfRegistry:
+    def _run(self, tmp_path, docs: str):
+        root = _tree(tmp_path, _CONF_FIXTURE)
+        os.makedirs(os.path.join(root, "docs"), exist_ok=True)
+        with open(os.path.join(root, "docs", "configs.md"), "w") as f:
+            f.write(docs)
+        return lint_run(root, roots=("spark_rapids_tpu",),
+                        rules=["conf-registry"])
+
+    def test_unknown_dynamic_orphan_and_docs(self, tmp_path):
+        report = self._run(
+            tmp_path,
+            "| spark.rapids.tpu.a | 1 | doc |\n"
+            "| spark.rapids.tpu.orphan | 1 | doc |\n"
+            "| spark.rapids.tpu.stale | 1 | doc |\n")
+        msgs = sorted(f.message for f in report.failing)
+        assert any("'spark.rapids.tpu.nope' is not registered" in m
+                   for m in msgs)
+        assert any("f-string" in m for m in msgs)
+        assert any("'spark.rapids.tpu.orphan' is orphaned" in m
+                   for m in msgs)
+        assert any("no longer registered" in m for m in msgs)
+        # the internal key B needs no docs entry and is referenced
+        assert not any("'spark.rapids.tpu.b'" in m for m in msgs)
+
+    def test_missing_doc_entry(self, tmp_path):
+        report = self._run(tmp_path,
+                           "| spark.rapids.tpu.orphan | 1 | doc |\n")
+        assert any("missing from docs/configs.md" in f.message
+                   and "'spark.rapids.tpu.a'" in f.message
+                   for f in report.failing)
+
+
+# ---------------------------------------------------------------------------
+# engine: suppression hygiene, baseline workflow, cache, CLI
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_srtlint_ignore_syntax_and_reason_required(self, tmp_path):
+        report = _lint(tmp_path, {"plan/x.py": (
+            "import jax\n"
+            "a = jax.device_get(1)  # srtlint: ignore[blocking-fetch] (test seed, not a device value)\n"
+            "b = jax.device_get(2)  # srtlint: ignore[blocking-fetch]\n")},
+            ["blocking-fetch"])
+        assert [f.line for f in report.failing] == [3]
+        assert "no reason" in report.failing[0].message
+        assert [f.line for f in report.suppressed] == [2]
+        assert "test seed" in report.suppressed[0].suppress_reason
+
+    def test_baseline_workflow(self, tmp_path):
+        files = {"plan/bad.py": ("import jax\n"
+                                 "a = jax.device_get(1)\n")}
+        root = _tree(tmp_path, files)
+        bl = str(tmp_path / "baseline.json")
+        report = lint_run(root, roots=("spark_rapids_tpu",),
+                          rules=["blocking-fetch"], baseline_path=bl)
+        assert len(report.failing) == 1
+        engine.write_baseline(report.failing, bl)
+        again = lint_run(root, roots=("spark_rapids_tpu",),
+                         rules=["blocking-fetch"], baseline_path=bl)
+        assert again.failing == []
+        assert len(again.baselined) == 1
+        # line drift does not invalidate the baseline entry
+        files = {"plan/bad.py": ("import jax\n# pushed down\n"
+                                 "a = jax.device_get(1)\n")}
+        root = _tree(tmp_path, files)
+        moved = lint_run(root, roots=("spark_rapids_tpu",),
+                         rules=["blocking-fetch"], baseline_path=bl)
+        assert moved.failing == []
+        assert len(moved.baselined) == 1
+
+    def test_cli_exit_codes_and_json(self, tmp_path, capsys):
+        root = _tree(tmp_path, {"plan/bad.py": (
+            "import jax\na = jax.device_get(1)\n")})
+        assert engine.main(["--repo", root, "--json"]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["counts"]["failing"] == 1
+        root2 = _tree(tmp_path / "clean", {"plan/ok.py": "x = 1\n"})
+        assert engine.main(["--repo", root2]) == 0
+        assert engine.main(["--explain", "lock-discipline"]) == 0
+        assert "lock-acquisition graph" in capsys.readouterr().out
+        assert engine.main(["--explain", "nope"]) == 2
+
+    def test_explain_covers_all_eight_rules(self):
+        rules = engine.available_rules()
+        assert rules == ["blocking-fetch", "span-timing", "ctx-threads",
+                         "cache-keys", "fault-paths", "release-paths",
+                         "lock-discipline", "conf-registry"]
+        for r in rules:
+            assert r in engine.explain_rule(r)
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        report = _lint(tmp_path, {"plan/broken.py": "def f(:\n"},
+                       ["blocking-fetch"])
+        assert [f.rule for f in report.failing] == ["parse-error"]
+
+
+class TestRealTree:
+    def test_full_tree_clean_and_within_wall_budget(self):
+        """Acceptance: all eight passes over the real tree, zero
+        unsuppressed findings, every suppression reasoned, inside a
+        collection-time wall budget."""
+        t0 = time.perf_counter()
+        report = engine.run(REPO)
+        wall = time.perf_counter() - t0
+        assert report.failing == [], \
+            "\n".join(f"{f.path}:{f.line}: [{f.rule}] {f.message}"
+                      for f in report.failing)
+        assert report.files > 100
+        assert all(f.suppress_reason for f in report.suppressed)
+        assert set(report.pass_timings) == set(engine.available_rules())
+        assert wall < 30.0, f"full scan took {wall:.1f}s"
+
+    def test_conftest_entry_point_caches(self):
+        """The mtime-keyed cache: a second call with an unchanged tree
+        must come back from the memo in far under the five regex
+        scanners' combined walk time."""
+        from tools.srtlint import run_for_pytest
+        first = run_for_pytest()
+        t0 = time.perf_counter()
+        second = run_for_pytest()
+        cached_wall = time.perf_counter() - t0
+        assert second.failing == first.failing == []
+        assert cached_wall < 1.0
+
+    def test_registry_docs_in_sync(self):
+        """conf-registry's docs cross-check holds on the real tree —
+        docs/configs.md matches TpuConf.help() exactly."""
+        from spark_rapids_tpu.config import TpuConf
+        with open(os.path.join(REPO, "docs", "configs.md")) as f:
+            doc = f.read()
+        for line in TpuConf.help().splitlines():
+            assert line in doc

@@ -9,7 +9,7 @@ import spark_rapids_tpu as srt
 from spark_rapids_tpu.sql import functions as F
 
 from tools import bench_compare, trace_report
-from tools.check_span_timing import check as check_span_timing
+from tools.srtlint.engine import run as srtlint_run
 
 
 @pytest.fixture()
@@ -212,15 +212,22 @@ def test_bench_compare_bad_file(tmp_path):
 # ---------------------------------------------------------------------------------
 
 def test_span_timing_lint_clean_and_detects(tmp_path):
-    assert check_span_timing() == []
-    # a synthetic violation is caught
-    pkg = tmp_path / "pkg"
+    from tools.srtlint import run_for_pytest
+    assert [f for f in run_for_pytest().failing
+            if f.rule == "span-timing"] == []
+    # a synthetic violation is caught; a REASONED marker suppresses,
+    # a bare marker does not (every suppression must say why)
+    pkg = tmp_path / "spark_rapids_tpu"
     (pkg / "plan").mkdir(parents=True)
     (pkg / "parallel").mkdir()
     (pkg / "plan" / "bad.py").write_text(
         "import time\n"
         "t0 = time.perf_counter()\n"
-        "ok = time.monotonic()  # span-api-ok\n")
-    violations = check_span_timing(str(pkg))
-    assert len(violations) == 1
-    assert violations[0][1] == 2
+        "ok = time.monotonic()  # span-api-ok (a seed, not timing)\n"
+        "t1 = time.time()  # span-api-ok\n")
+    report = srtlint_run(str(tmp_path), roots=("spark_rapids_tpu",),
+                         rules=["span-timing"])
+    assert sorted(f.line for f in report.failing) == [2, 4]
+    assert "no reason" in [f for f in report.failing
+                           if f.line == 4][0].message
+    assert [f.line for f in report.suppressed] == [3]

@@ -1,0 +1,43 @@
+"""srtlint: the unified AST-based static analysis engine.
+
+Replaces the five standalone line-regex scanners (``tools/check_*.py``,
+removed) with ONE engine that parses ``spark_rapids_tpu/`` + ``tools/``
+once into ASTs — import/alias resolution, per-line comment maps, and a
+per-function CFG-lite (:mod:`.cfg`) ride on the shared parse — and runs
+all eight passes over the shared tree:
+
+  ================  ==============================================
+  rule              invariant
+  ================  ==============================================
+  blocking-fetch    D2H transfers route through utils.metrics.fetch
+  span-timing       exec-node timing goes through the span API
+  ctx-threads       worker threads join the query's contextvars
+  cache-keys        cache keys derive from cache/keys.py only
+  fault-paths       no swallowed faults / ad-hoc retries / unbounded waits
+  release-paths     every permit/handle/quota/spool acquisition is
+                    released via finally/with on all exit edges
+  lock-discipline   no blocking call under a lock; no acquisition-
+                    order cycles in the lock graph
+  conf-registry     every spark.rapids.tpu.* literal resolves through
+                    config.py registration and docs/configs.md
+  ================  ==============================================
+
+Suppression is ``# srtlint: ignore[rule] (<reason>)`` on any line the
+flagged statement spans; the legacy ``# fault-ok`` / ``# wait-ok`` /
+``# ctx-ok`` / ``# span-api-ok`` / ``# choke-point-ok`` /
+``# cache-key-ok`` markers keep working.  EVERY suppression must carry
+a parenthesised reason — a bare marker does not suppress.  Accepted
+legacy findings can also live in ``tools/srtlint/baseline.json``
+(checked in; ``--update-baseline`` regenerates it).
+
+Entry points: ``python -m tools.srtlint`` (CLI, exit 1 on findings,
+``--json`` / ``--explain RULE``), :func:`run` (programmatic), and
+:func:`run_for_pytest` — the single mtime-keyed cached scan
+tests/conftest.py invokes at collection time.
+"""
+
+from .engine import (Finding, LintReport, available_rules, explain_rule,
+                     run, run_for_pytest)
+
+__all__ = ["Finding", "LintReport", "run", "run_for_pytest",
+           "available_rules", "explain_rule"]

@@ -1,0 +1,13 @@
+"""CLI: ``python -m tools.srtlint`` — exit 1 on unsuppressed findings.
+
+See ``--help`` for flags (``--json``, ``--explain RULE``, ``--rules``,
+``--update-baseline``, ``--verbose``) and docs/static_analysis.md for
+the rule catalog and suppression/baseline workflow.
+"""
+
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""CFG-lite: per-function reachability helpers over the shared AST.
+
+srtlint does not build a full control-flow graph; the invariants it
+checks are *structural* ("a release must sit on a ``finally``/``with``
+edge"), so what the passes need is a small vocabulary of reachability
+questions answered from the AST + parent links:
+
+  * which ``try`` suites protect a statement (their ``finally`` runs on
+    every exit edge out of it);
+  * which explicit exit edges (``return`` / ``raise``) leave a function
+    between two program points without crossing a protecting
+    ``finally``;
+  * scope-limited walks that do not descend into nested functions.
+
+That is deliberately lighter than a dataflow engine — but unlike the
+line-regex scanners it is *statement-accurate*: multiline statements,
+decorated/async functions, and arbitrarily deep nesting all resolve.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node``'s subtree WITHOUT entering nested function/lambda
+    scopes (a handle acquired here but released in a nested closure is
+    a different lifetime — passes must not conflate the two)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _SCOPE_BARRIERS):
+            continue
+        yield child
+        yield from walk_scope(child)
+
+
+def ancestors(sf, node: ast.AST) -> Iterator[ast.AST]:
+    cur = sf.parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = sf.parents.get(cur)
+
+
+def try_field_of(try_node: ast.Try, child: ast.AST) -> Optional[str]:
+    """Which field of ``try_node`` contains ``child`` directly."""
+    for fieldname in ("body", "orelse", "finalbody"):
+        if child in getattr(try_node, fieldname):
+            return fieldname
+    if child in try_node.handlers:
+        return "handlers"
+    return None
+
+
+def _try_region(sf, try_node: ast.Try, node: ast.AST) -> Optional[str]:
+    """Region of ``try_node`` that (transitively) holds ``node``."""
+    cur = node
+    for parent in ancestors(sf, node):
+        if parent is try_node:
+            return try_field_of(try_node, cur)
+        cur = parent
+    return None
+
+
+def in_finalbody(sf, node: ast.AST) -> Optional[ast.Try]:
+    """The nearest ``try`` whose ``finally`` suite holds ``node``."""
+    for t in ancestors(sf, node):
+        if isinstance(t, ast.Try) and _try_region(sf, t, node) \
+                == "finalbody":
+            return t
+    return None
+
+
+def protecting_trys(sf, node: ast.AST) -> List[ast.Try]:
+    """Every ``try`` whose try/except/else region holds ``node`` — an
+    exception raised at ``node`` runs each of their ``finally`` suites
+    (innermost first)."""
+    out: List[ast.Try] = []
+    for t in ancestors(sf, node):
+        if isinstance(t, ast.Try) and _try_region(sf, t, node) \
+                in ("body", "handlers", "orelse"):
+            out.append(t)
+    return out
+
+
+def suite_of(sf, stmt: ast.AST) -> Tuple[Optional[ast.AST], List[ast.AST]]:
+    """(parent node, suite list) holding ``stmt`` directly."""
+    parent = sf.parents.get(stmt)
+    if parent is None:
+        return None, []
+    for fieldname, value in ast.iter_fields(parent):
+        if isinstance(value, list) and stmt in value:
+            return parent, value
+    return parent, []
+
+
+def following_finally_try(sf, stmt: ast.AST) -> Optional[ast.Try]:
+    """A ``try``-with-``finally`` that FOLLOWS ``stmt`` in the same
+    suite — the ``h = acquire()`` / ``try: ... finally: h.close()``
+    idiom.  Returns the nearest one."""
+    _, suite = suite_of(sf, stmt)
+    if not suite:
+        return None
+    seen = False
+    for s in suite:
+        if s is stmt:
+            seen = True
+            continue
+        if seen and isinstance(s, ast.Try) and s.finalbody:
+            return s
+    return None
+
+
+def exits_between(sf, fn: ast.AST, start: ast.AST,
+                  covered: List[ast.Try]) -> List[ast.AST]:
+    """Explicit exit edges (``return`` / ``raise``) in ``fn`` lexically
+    after ``start`` that are NOT inside any of the ``covered`` try
+    regions — each is an edge where a ``finally`` in ``covered`` would
+    not run, i.e. a path on which a pending release is skipped."""
+    start_line = getattr(start, "lineno", 0)
+    out: List[ast.AST] = []
+    for node in walk_scope(fn):
+        if not isinstance(node, (ast.Return, ast.Raise)):
+            continue
+        if getattr(node, "lineno", 0) <= start_line:
+            continue
+        if any(_try_region(sf, t, node) in ("body", "handlers", "orelse")
+               or in_finalbody(sf, node) is t for t in covered):
+            continue
+        out.append(node)
+    return out
+
+
+def enclosing_class(sf, node: ast.AST) -> Optional[ast.ClassDef]:
+    for parent in ancestors(sf, node):
+        if isinstance(parent, ast.ClassDef):
+            return parent
+    return None

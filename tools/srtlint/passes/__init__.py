@@ -1,0 +1,7 @@
+"""srtlint passes — one module per rule, all walking the shared
+:class:`..engine.LintTree`.
+
+Each pass exports ``RULE`` (the id used in suppressions / --rules /
+--explain), ``TITLE`` (one line), ``EXPLAIN`` (the --explain text), and
+``run(tree) -> List[Finding]``.
+"""

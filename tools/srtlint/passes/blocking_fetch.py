@@ -1,0 +1,57 @@
+"""blocking-fetch: D2H transfers must route through the metrics choke
+point (AST port of the retired tools/check_blocking_fetch.py)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+RULE = "blocking-fetch"
+TITLE = ("no raw device->host transfers outside utils.metrics.fetch/"
+         "fetch_async in the operator layer")
+EXPLAIN = """
+Every blocking fetch in the operator layer (plan/, ops/, parallel/)
+must route through ``utils.metrics.fetch`` / ``fetch_async`` so the
+per-query sync profile (bench ``syncs_warm`` / ``fetch_wait_s``) and
+the sync-budget tests stay trustworthy.  Two shapes sneak past the
+choke point:
+
+  * ``jax.device_get(...)`` — the raw blocking get.  Resolved through
+    the import table, so ``from jax import device_get as dg`` (which
+    the old regex scanner missed) is caught too;
+  * ``np.asarray(<col>.data / .valid / .codes)`` — an implicit D2H of
+    a DeviceColumn's arrays, however numpy was imported and however
+    many lines the call spans.
+
+Suppress with ``# choke-point-ok (<why this is not a device
+transfer>)`` or ``# srtlint: ignore[blocking-fetch] (<why>)``.
+"""
+
+OPERATOR_DIRS = ("plan", "ops", "parallel")
+_COL_ATTRS = {"data", "valid", "codes"}
+
+
+def run(tree) -> List:
+    findings = []
+    for sf in tree.files:
+        if not tree.in_dirs(sf, OPERATOR_DIRS):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = sf.call_qualname(node)
+            if q == "jax.device_get":
+                findings.append(tree.finding(
+                    sf, node, RULE,
+                    "raw jax.device_get bypasses the metrics choke "
+                    "point — use utils.metrics.fetch / fetch_async"))
+            elif q in ("numpy.asarray", "np.asarray") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Attribute) \
+                        and arg.attr in _COL_ATTRS:
+                    findings.append(tree.finding(
+                        sf, node, RULE,
+                        f"np.asarray(...{arg.attr}) is an implicit "
+                        "blocking D2H transfer the sync profile never "
+                        "sees — use utils.metrics.fetch"))
+    return findings

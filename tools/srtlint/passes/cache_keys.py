@@ -1,0 +1,59 @@
+"""cache-keys: cross-query cache keys derive from cache/keys.py only
+(AST port of the retired tools/check_cache_keys.py)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+RULE = "cache-keys"
+TITLE = "cache keys are constructed only in cache/keys.py"
+EXPLAIN = """
+The cross-query cache's correctness hangs on ONE identity rule — two
+lookups hit the same entry iff their data is interchangeable — and
+that rule lives in ``spark_rapids_tpu/cache/keys.py`` and nowhere
+else.  Two shapes of ad-hoc key are rejected:
+
+  * a ``CacheKey(...)`` construction outside ``cache/keys.py`` (alias-
+    resolved: ``from ..cache.keys import CacheKey as CK`` is caught);
+  * an inline literal (tuple/list/str/dict) as the key argument of the
+    cache API (``lookup_scan`` / ``insert_scan`` / ``lookup_broadcast``
+    / ``insert_broadcast``) — statement-accurate, so a multiline
+    literal the old line regex missed is caught.
+
+Suppress with ``# cache-key-ok (<why — e.g. a test of the key
+machinery itself>)`` or ``# srtlint: ignore[cache-keys] (<why>)``.
+"""
+
+KEYS_MODULE = "spark_rapids_tpu/cache/keys.py"
+_API = {"lookup_scan", "insert_scan", "lookup_broadcast",
+        "insert_broadcast"}
+_LITERALS = (ast.Tuple, ast.List, ast.Dict)
+
+
+def run(tree) -> List:
+    findings = []
+    for sf in tree.package_files():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = sf.call_qualname(node)
+            if q and (q == "CacheKey" or q.endswith(".CacheKey")) \
+                    and sf.rel != KEYS_MODULE:
+                findings.append(tree.finding(
+                    sf, node, RULE,
+                    "CacheKey constructed outside cache/keys.py — "
+                    "derive keys via cache.keys.scan_key / "
+                    "broadcast_key"))
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _API and node.args:
+                arg = node.args[0]
+                if isinstance(arg, _LITERALS) or (
+                        isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    findings.append(tree.finding(
+                        sf, node, RULE,
+                        f"inline literal passed as the {node.func.attr} "
+                        "key — derive it via cache.keys helpers"))
+    return findings

@@ -1,0 +1,174 @@
+"""conf-registry: every spark.rapids.tpu.* conf resolves through the
+config.py registry and docs/configs.md, with no orphans."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set
+
+RULE = "conf-registry"
+TITLE = ("spark.rapids.tpu.* literals are registered, documented, and "
+         "none are orphaned")
+EXPLAIN = """
+The conf registry (config.py ``register(...)``) is the single source
+of truth for every ``spark.rapids.tpu.*`` key: type, default, doc.
+This pass closes the regenerate-docs-by-hand gap with four checks:
+
+  1. **unknown key** — a full-key string literal anywhere in the tree
+     that is not registered (a typo'd conf read fails at runtime with
+     KeyError; this fails at lint time);
+  2. **dynamic key** — a conf key assembled at runtime (f-string /
+     concatenation / %-format on a ``spark.rapids.tpu.`` prefix) is
+     unresolvable against the registry — spell the full key per
+     branch;
+  3. **undocumented** — a registered non-internal key missing from
+     ``docs/configs.md`` (regenerate it via ``TpuConf.help()``), and
+     conversely a documented key that is no longer registered (stale
+     docs);
+  4. **orphaned registration** — a registered key whose literal never
+     appears outside config.py AND whose ``ConfEntry`` variable is
+     never referenced: dead configuration surface.
+
+Suppress with ``# srtlint: ignore[conf-registry] (<why>)``.
+"""
+
+_FULL_KEY = re.compile(r"^spark\.rapids\.tpu\.[A-Za-z0-9_.]*[A-Za-z0-9_]$")
+_PREFIX = "spark.rapids.tpu."
+_DOC_KEY = re.compile(r"spark\.rapids\.tpu\.[A-Za-z0-9_.]*[A-Za-z0-9_]")
+CONFIG_MODULE = "spark_rapids_tpu/config.py"
+DOCS_REL = "docs/configs.md"
+
+
+class _Registration:
+    __slots__ = ("key", "node", "var", "internal")
+
+    def __init__(self, key, node, var, internal):
+        self.key = key
+        self.node = node
+        self.var = var
+        self.internal = internal
+
+
+def _collect_registrations(sf) -> Dict[str, _Registration]:
+    regs: Dict[str, _Registration] = {}
+    for node in ast.walk(sf.tree):
+        call = None
+        var = None
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            call = node.value
+            if len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                var = node.targets[0].id
+        elif isinstance(node, ast.Expr) \
+                and isinstance(node.value, ast.Call):
+            call = node.value
+        if call is None or not isinstance(call.func, ast.Name) \
+                or call.func.id != "register" or not call.args:
+            continue
+        key_node = call.args[0]
+        if not (isinstance(key_node, ast.Constant)
+                and isinstance(key_node.value, str)):
+            continue
+        internal = any(
+            kw.arg == "internal" and isinstance(kw.value, ast.Constant)
+            and bool(kw.value.value) for kw in call.keywords)
+        regs[key_node.value] = _Registration(
+            key_node.value, call, var, internal)
+    return regs
+
+
+def run(tree) -> List:
+    findings: List = []
+    config_sf = next((sf for sf in tree.files
+                      if sf.rel == CONFIG_MODULE), None)
+    if config_sf is None:
+        return findings
+    regs = _collect_registrations(config_sf)
+    registered = set(regs)
+
+    used_keys: Set[str] = set()
+    referenced_vars: Set[str] = set()
+    for sf in tree.files:
+        in_config = sf.rel == CONFIG_MODULE
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                v = node.value
+                if _FULL_KEY.match(v):
+                    if not in_config:
+                        used_keys.add(v)
+                        if v not in registered:
+                            findings.append(tree.finding(
+                                sf, node, RULE,
+                                f"conf key {v!r} is not registered in "
+                                f"config.py — register it (or fix the "
+                                f"typo)"))
+                elif v.startswith(_PREFIX) and v.endswith("."):
+                    # a key prefix feeding dynamic assembly
+                    parent = sf.parents.get(node)
+                    if isinstance(parent, (ast.JoinedStr, ast.BinOp)) \
+                            or (isinstance(parent, ast.Attribute)
+                                and parent.attr in ("format", "join")):
+                        findings.append(tree.finding(
+                            sf, node, RULE,
+                            "conf key assembled dynamically from "
+                            f"prefix {v!r} — unresolvable against the "
+                            "registry; spell the full key per branch"))
+            elif isinstance(node, ast.JoinedStr):
+                for part in node.values:
+                    if isinstance(part, ast.Constant) \
+                            and isinstance(part.value, str) \
+                            and part.value.startswith(_PREFIX):
+                        findings.append(tree.finding(
+                            sf, node, RULE,
+                            "conf key assembled in an f-string — "
+                            "unresolvable against the registry; spell "
+                            "the full key per branch"))
+                        break
+            elif isinstance(node, ast.Name) and not in_config:
+                referenced_vars.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                referenced_vars.add(node.attr)
+
+    # docs cross-check
+    docs_path = os.path.join(tree.repo, DOCS_REL)
+    try:
+        with open(docs_path, encoding="utf-8") as f:
+            doc_lines = f.read().splitlines()
+    except OSError:
+        doc_lines = []
+    documented: Dict[str, int] = {}
+    for i, line in enumerate(doc_lines, 1):
+        for m in _DOC_KEY.finditer(line):
+            documented.setdefault(m.group(0), i)
+
+    for key, reg in sorted(regs.items()):
+        if not reg.internal and key not in documented:
+            findings.append(tree.finding(
+                config_sf, reg.node, RULE,
+                f"registered key {key!r} is missing from "
+                f"{DOCS_REL} — regenerate the doc from "
+                f"TpuConf.help()"))
+        if key not in used_keys and (reg.var is None
+                                     or reg.var not in referenced_vars):
+            findings.append(tree.finding(
+                config_sf, reg.node, RULE,
+                f"registration {key!r} is orphaned — its literal is "
+                f"never read and its ConfEntry "
+                f"{reg.var or '<anonymous>'} is never referenced; "
+                f"delete it or wire it up"))
+
+    for key, line in sorted(documented.items()):
+        if _FULL_KEY.match(key) and key not in registered:
+            f = tree.finding(
+                config_sf, config_sf.tree, RULE,
+                f"{DOCS_REL}:{line}: documents {key!r} which is no "
+                f"longer registered — regenerate the doc")
+            f.path = DOCS_REL
+            f.line = line
+            f.snippet = doc_lines[line - 1].strip()[:120]
+            findings.append(f)
+    return findings

@@ -1,0 +1,68 @@
+"""ctx-threads: worker threads must join the query's contextvars (AST
+port of the retired tools/check_ctx_threads.py)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import cfg
+
+RULE = "ctx-threads"
+TITLE = "threads/pools must run work through a copied query context"
+EXPLAIN = """
+Per-query accounting (``QueryStats.scoped``), tracing, and cooperative
+cancellation all travel in contextvars.  A ``threading.Thread`` or
+``ThreadPoolExecutor`` whose work does NOT run under
+``contextvars.copy_context()`` escapes all three: its fetches
+cross-account into the process aggregate, its spans vanish from the
+query trace, and it keeps running after the query is cancelled.
+
+Each creation site must either show the copied-context idiom inside
+the SAME enclosing function (a ``copy_context`` reference, or a
+``<name>ctx.run`` target such as ``entry.cctx.run``) — the old scanner
+only looked ±3 source lines, so evidence past that window produced
+false positives and a thread created 4 lines below its pool's
+``copy_context`` produced false negatives — or carry ``# ctx-ok
+(<why this is provably non-query infrastructure>)`` /
+``# srtlint: ignore[ctx-threads] (<why>)``.
+"""
+
+_CREATORS = {"threading.Thread", "concurrent.futures.ThreadPoolExecutor",
+             "ThreadPoolExecutor"}
+
+
+def _has_ctx_evidence(sf, scope: ast.AST) -> bool:
+    for node in cfg.walk_scope(scope):
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            q = sf.qualname(node)
+            if not q:
+                continue
+            if "copy_context" in q:
+                return True
+            parts = q.split(".")
+            if len(parts) >= 2 and parts[-1] == "run" \
+                    and parts[-2].endswith("ctx"):
+                return True
+    return False
+
+
+def run(tree) -> List:
+    findings = []
+    for sf in tree.package_files():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = sf.call_qualname(node)
+            if q not in _CREATORS:
+                continue
+            scope: Optional[ast.AST] = sf.enclosing_function(node)
+            if scope is not None and _has_ctx_evidence(sf, scope):
+                continue
+            findings.append(tree.finding(
+                sf, node, RULE,
+                "thread/pool created without joining the query's "
+                "contextvars — run the work via contextvars."
+                "copy_context() (cctx.run(fn, ...)) or mark provably "
+                "non-query infrastructure '# ctx-ok (<why>)'"))
+    return findings

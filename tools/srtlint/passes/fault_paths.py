@@ -1,0 +1,112 @@
+"""fault-paths: fault handling must be visible and routed through the
+framework (AST port of the retired tools/check_fault_paths.py)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import cfg
+
+RULE = "fault-paths"
+TITLE = ("no swallowed faults, ad-hoc transient retries, or unbounded "
+         "blocking waits")
+EXPLAIN = """
+Three rules over ``spark_rapids_tpu/``:
+
+  1. **No silently swallowed faults** — an ``except Exception:`` /
+     ``except BaseException:`` whose body is ``pass`` hides the
+     transient failures the recovery layer exists to retry, classify,
+     and account.  Annotate legitimate best-effort sites ``# fault-ok
+     (<reason>)`` on the except or pass line.
+
+  2. **No ad-hoc transient retry loops** — a ``time.sleep(...)``
+     ANYWHERE inside an ``except`` suite catching transient types
+     (OSError / ConnectionError / TimeoutError / InterruptedError /
+     Exception, alone or in a tuple) is a hand-rolled retry that
+     bypasses ``faults.recovery.transient_retry``'s backoff, jitter,
+     per-query budget, and accounting.  The old scanner only looked 8
+     lines past the ``except`` line, so a sleep deeper inside a
+     multiline handler escaped it; the AST pass covers the whole
+     handler suite.  ``faults/`` IS the framework and is exempt.
+
+  3. **No unbounded blocking waits** — a no-timeout ``.wait()`` /
+     ``.result()``, or any ``.recv(`` / ``.accept(`` outside
+     ``faults/`` and ``service/`` (the layers whose JOB is waiting) is
+     where a gray failure turns into a hang.  Annotate with
+     ``# wait-ok (<what bounds/wakes this wait>)`` naming the bounding
+     mechanism.
+
+``# srtlint: ignore[fault-paths] (<why>)`` also suppresses any of the
+three shapes.
+"""
+
+_TRANSIENT = {"OSError", "ConnectionError", "TimeoutError",
+              "InterruptedError", "Exception"}
+_SWALLOW = {"Exception", "BaseException"}
+_WAIT_ATTRS = {"wait", "result"}
+_ALWAYS_FLAG_ATTRS = {"recv", "accept"}
+
+
+def _names_in(type_node) -> set:
+    out = set()
+    if type_node is None:
+        return out
+    for n in ast.walk(type_node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def run(tree) -> List:
+    findings = []
+    for sf in tree.package_files():
+        in_framework = sf.rel.startswith("spark_rapids_tpu/faults/")
+        wait_exempt = in_framework \
+            or sf.rel.startswith("spark_rapids_tpu/service/")
+        for node in ast.walk(sf.tree):
+            # rule 1: except Exception/BaseException: pass
+            if isinstance(node, ast.ExceptHandler):
+                if _names_in(node.type) & _SWALLOW \
+                        and len(node.body) == 1 \
+                        and isinstance(node.body[0], ast.Pass):
+                    findings.append(tree.finding(
+                        sf, node, RULE,
+                        "bare except swallowing faults — let the "
+                        "recovery framework see them, or mark "
+                        "'# fault-ok (<why best-effort>)'",
+                        extra_nodes=node.body))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            # rule 2: time.sleep anywhere inside a transient handler
+            if not in_framework \
+                    and sf.call_qualname(node) == "time.sleep":
+                for anc in cfg.ancestors(sf, node):
+                    if isinstance(anc, ast.ExceptHandler) \
+                            and _names_in(anc.type) & _TRANSIENT:
+                        findings.append(tree.finding(
+                            sf, node, RULE,
+                            "sleep inside a transient except suite is "
+                            "an ad-hoc retry loop — route it through "
+                            "faults.recovery.transient_retry (backoff "
+                            "+ budget + accounting) or mark "
+                            "'# fault-ok (<why>)'"))
+                        break
+                continue
+            # rule 3: unbounded waits
+            if wait_exempt or not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            unbounded = (attr in _WAIT_ATTRS
+                         and not node.args and not node.keywords) \
+                or attr in _ALWAYS_FLAG_ATTRS
+            if unbounded:
+                findings.append(tree.finding(
+                    sf, node, RULE,
+                    f"unbounded blocking .{attr}() — give it a "
+                    "timeout or mark '# wait-ok (<what bounds/wakes "
+                    "this wait>)'"))
+    return findings

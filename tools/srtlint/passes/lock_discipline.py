@@ -1,0 +1,315 @@
+"""lock-discipline: the lock-acquisition graph — no blocking call under
+a lock, no acquisition-order cycles."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import cfg
+
+RULE = "lock-discipline"
+TITLE = ("no blocking call while a lock is held; the lock-acquisition "
+         "graph is acyclic")
+EXPLAIN = """
+Builds the lock-acquisition graph across ``service/``, ``runtime/``,
+``cache/``, ``parallel/``, ``server/``, and ``memory/``:
+
+  * **lock identities** come from ``threading.Lock() / RLock() /
+    Condition()`` assignments — ``self._lock = threading.Lock()``
+    inside class ``C`` of module ``M`` is the lock ``M.C._lock``;
+    module-level locks are ``M._name``;
+  * **acquisitions** are ``with self._lock:`` blocks.  Holding lock A
+    while entering ``with B:`` adds the edge A->B; calls to same-class
+    methods and same-module functions are summarized to a fixpoint, so
+    an edge through a helper (``with A: self._drop(...)`` where
+    ``_drop`` takes B) is found too;
+  * **cycles** in the resulting graph are deadlock schedules — every
+    edge participating in a cycle is reported;
+  * **blocking calls under a lock** — ``.wait()`` (except the
+    condition variable being held, whose wait RELEASES it),
+    ``.result()``, socket ``send/sendall/recv/accept/connect``,
+    ``time.sleep``, ``fetch``, and ``transient_retry`` — directly or
+    through a same-module helper — stall every other thread needing
+    that lock for the full wait.
+
+Suppress with ``# srtlint: ignore[lock-discipline] (<why this blocking
+call / ordering is safe>)``.
+"""
+
+LOCK_DIRS = ("service", "runtime", "cache", "parallel", "server",
+             "memory")
+_LOCK_CTORS = {"threading.Lock", "threading.RLock",
+               "threading.Condition"}
+_BLOCKING_ATTRS = {"wait", "result", "recv", "accept", "send",
+                   "sendall", "connect"}
+_BLOCKING_QUALS = {"time.sleep"}
+_BLOCKING_NAMES = {"transient_retry", "fetch"}
+
+FuncKey = Tuple[str, Optional[str], str]  # (module rel, class, name)
+
+
+class _ModuleIndex:
+    """Per-module lock definitions and function lookup tables."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        self.locks: Set[str] = set()       # lock ids defined here
+        self.attr_locks: Dict[Tuple[Optional[str], str], str] = {}
+        self.funcs: Dict[FuncKey, ast.AST] = {}
+        self.rlocks: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                q = sf.call_qualname(node.value)
+                if q in _LOCK_CTORS:
+                    for tgt in node.targets:
+                        self._add_lock(tgt, node, q)
+            elif isinstance(node, cfg.FuncNode):
+                klass = cfg.enclosing_class(sf, node)
+                self.funcs[(sf.rel, klass.name if klass else None,
+                            node.name)] = node
+
+    def _add_lock(self, tgt, node, ctor) -> None:
+        sf = self.sf
+        if isinstance(tgt, ast.Attribute) \
+                and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id in ("self", "cls"):
+            klass = cfg.enclosing_class(sf, node)
+            cname = klass.name if klass else None
+            lock_id = f"{sf.rel}::{cname}.{tgt.attr}"
+            self.attr_locks[(cname, tgt.attr)] = lock_id
+        elif isinstance(tgt, ast.Name):
+            lock_id = f"{sf.rel}::{tgt.id}"
+            self.attr_locks[(None, tgt.id)] = lock_id
+        else:
+            return
+        self.locks.add(lock_id)
+        if ctor == "threading.RLock":
+            self.rlocks.add(lock_id)
+
+    def lock_of(self, expr, klass: Optional[str]) -> Optional[str]:
+        """Lock id for a with-item context expr, else None."""
+        sf = self.sf
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id in ("self", "cls"):
+            return self.attr_locks.get((klass, expr.attr))
+        if isinstance(expr, ast.Name):
+            return self.attr_locks.get((None, expr.id))
+        return None
+
+
+def _blocking_desc(sf, call: ast.Call, held_exprs: Set[str]
+                   ) -> Optional[str]:
+    """Description when ``call`` is intrinsically blocking (the held
+    condition variable's own wait is excluded — it releases the lock)."""
+    q = sf.call_qualname(call)
+    if q in _BLOCKING_QUALS:
+        return q
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "wait" \
+                and (sf.qualname(func.value) or "?") in held_exprs:
+            return None  # cv.wait() releases the held cv
+        if func.attr in _BLOCKING_ATTRS:
+            recv = sf.qualname(func.value) or "<expr>"
+            return f"{recv}.{func.attr}"
+        if func.attr in _BLOCKING_NAMES:
+            return func.attr
+    elif isinstance(func, ast.Name) and func.id in _BLOCKING_NAMES:
+        return func.id
+    return None
+
+
+def _callee_key(sf, call: ast.Call, klass: Optional[str]
+                ) -> Optional[FuncKey]:
+    func = call.func
+    if isinstance(func, ast.Attribute) \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id in ("self", "cls"):
+        return (sf.rel, klass, func.attr)
+    if isinstance(func, ast.Name):
+        return (sf.rel, None, func.id)
+    return None
+
+
+class _FuncFacts:
+    __slots__ = ("acquired", "blocking", "calls")
+
+    def __init__(self):
+        self.acquired: Set[str] = set()    # locks this func may take
+        self.blocking: Set[str] = set()    # blocking descs inside
+        self.calls: Set[FuncKey] = set()   # same-module callees
+
+
+def _collect_func(idx: _ModuleIndex, fn, klass: Optional[str]
+                  ) -> _FuncFacts:
+    facts = _FuncFacts()
+    sf = idx.sf
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, cfg._SCOPE_BARRIERS):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    lid = idx.lock_of(item.context_expr, klass)
+                    if lid:
+                        facts.acquired.add(lid)
+            elif isinstance(child, ast.Call):
+                own_cv_wait = (
+                    isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "wait"
+                    and idx.lock_of(child.func.value, klass) is not None)
+                # waiting on a condition variable this module owns
+                # RELEASES it — the helper-splits-the-CV-idiom shape
+                # (Coordinator._wait_for) is not a lock-held block
+                desc = None if own_cv_wait \
+                    else _blocking_desc(sf, child, set())
+                if desc:
+                    facts.blocking.add(desc)
+                key = _callee_key(sf, child, klass)
+                if key and key in idx.funcs:
+                    facts.calls.add(key)
+            visit(child)
+
+    visit(fn)
+    return facts
+
+
+def run(tree) -> List:
+    findings: List = []
+    indexes: Dict[str, _ModuleIndex] = {}
+    facts: Dict[FuncKey, _FuncFacts] = {}
+    fn_nodes: Dict[FuncKey, Tuple] = {}
+    scanned = [sf for sf in tree.package_files()
+               if tree.in_dirs(sf, LOCK_DIRS)]
+    for sf in scanned:
+        idx = _ModuleIndex(sf)
+        indexes[sf.rel] = idx
+        for key, fn in idx.funcs.items():
+            facts[key] = _collect_func(idx, fn, key[1])
+            fn_nodes[key] = (sf, fn)
+
+    # fixpoint: propagate acquired-lock and blocking summaries through
+    # same-module calls so edges/blocking through helpers are seen
+    changed = True
+    while changed:
+        changed = False
+        for key, f in facts.items():
+            for callee in f.calls:
+                cf = facts.get(callee)
+                if cf is None:
+                    continue
+                if not cf.acquired <= f.acquired:
+                    f.acquired |= cf.acquired
+                    changed = True
+                for b in cf.blocking:
+                    tagged = f"{b} (via {callee[2]})" \
+                        if "(via" not in b else b
+                    if tagged not in f.blocking:
+                        f.blocking.add(tagged)
+                        changed = True
+
+    # walk every function again with a held-lock stack, emitting
+    # blocking-under-lock findings and collecting A->B edges
+    edges: Dict[Tuple[str, str], Tuple] = {}
+
+    def walk(sf, idx, klass, node, held: List[Tuple[str, str]]):
+        """held: [(lock_id, context-expr qualname)]"""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, cfg._SCOPE_BARRIERS):
+                continue
+            pushed = 0
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    lid = idx.lock_of(item.context_expr, klass)
+                    if lid:
+                        for outer, _ in held:
+                            if outer != lid:
+                                edges.setdefault((outer, lid),
+                                                 (sf, child))
+                        held.append(
+                            (lid, sf.qualname(item.context_expr)
+                             or "?"))
+                        pushed += 1
+            elif isinstance(child, ast.Call) and held:
+                held_exprs = {expr for _, expr in held}
+                desc = _blocking_desc(sf, child, held_exprs)
+                if desc:
+                    findings.append(tree.finding(
+                        sf, child, RULE,
+                        f"blocking call {desc} while holding "
+                        f"{_pretty(held[-1][0])} stalls every thread "
+                        f"needing that lock — move it outside the "
+                        f"critical section"))
+                key = _callee_key(sf, child, klass)
+                cf = facts.get(key) if key else None
+                if cf is not None:
+                    held_ids = [h for h, _ in held]
+                    for lid in cf.acquired:
+                        for outer in held_ids:
+                            if outer != lid:
+                                edges.setdefault((outer, lid),
+                                                 (sf, child))
+                    for b in sorted(cf.blocking):
+                        findings.append(tree.finding(
+                            sf, child, RULE,
+                            f"call to {key[2]}() while holding "
+                            f"{_pretty(held[-1][0])} reaches blocking "
+                            f"{b} — the lock is held across the "
+                            f"wait"))
+            walk(sf, idx, klass, child, held)
+            for _ in range(pushed):
+                held.pop()
+
+    for key, (sf, fn) in fn_nodes.items():
+        walk(sf, indexes[sf.rel], key[1], fn, [])
+
+    # cycles: DFS over the collected edge graph
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    for cyc in _cycles(graph):
+        pairs = list(zip(cyc, cyc[1:] + cyc[:1]))
+        for (a, b) in pairs:
+            site = edges.get((a, b))
+            if site is None:
+                continue
+            sf, node = site
+            findings.append(tree.finding(
+                sf, node, RULE,
+                "lock-order cycle: "
+                + " -> ".join(_pretty(x) for x in cyc + [cyc[0]])
+                + " — acquire these locks in one global order"))
+    return findings
+
+
+def _pretty(lock_id: str) -> str:
+    rel, name = lock_id.split("::", 1)
+    mod = rel.rsplit("/", 1)[-1].removesuffix(".py")
+    return f"{mod}.{name}".replace("None.", "")
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles via DFS (the graph is tiny)."""
+    out: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            visited: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                canon = tuple(sorted(path))
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    out.append(list(path))
+            elif nxt not in visited and nxt > start:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return out

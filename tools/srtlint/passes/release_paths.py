@@ -1,0 +1,254 @@
+"""release-paths: every resource acquisition is released on all exit
+edges (finally / context manager), or ownership visibly escapes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .. import cfg
+
+RULE = "release-paths"
+TITLE = ("permits, spill handles, cached-build refs, quota slots, and "
+         "spool streams release on every exit edge")
+EXPLAIN = """
+PRs 5-8 made "every acquisition is released on all exit paths" a
+load-bearing correctness property, enforced only dynamically by the
+leak-audit tests.  This pass checks it statically, using the repo's
+own acquire/release vocabulary:
+
+  * ``TpuSemaphore.acquire()`` (runtime/semaphore.py) — a context
+    manager: use ``with``;
+  * ``SpillCatalog.register(...)`` (memory/spill.py) -> a
+    ``SpillableBatch`` handle that must be ``close()``d;
+  * ``QueryCache.lookup_broadcast / insert_broadcast``
+    (cache/device_cache.py) -> a refcounted ``CachedBuildHandle``
+    (``close()``), and ``lookup_scan`` -> an entry released via
+    ``cache.release(entry)``;
+  * ``TenantQuotas.acquire(tenant)`` (server/session.py) — a paired
+    void call: the matching ``release(tenant)`` MUST sit in a
+    ``finally``;
+  * ``ResultStream(...)`` (server/spool.py) — ``close()`` always runs
+    in the owner's ``finally``.
+
+For a tracked acquisition the pass accepts, in order: a ``with``
+statement; visible ownership transfer (the handle is returned,
+yielded, stored into a container/attribute, or passed to another
+call); or a release sited in a ``finally`` suite protecting the
+acquisition — either the acquisition sits inside that ``try`` or the
+``try`` follows it in the same suite.  CFG-lite reachability then
+reports any explicit ``return`` / ``raise`` edge between acquisition
+and protection where the release is skipped.
+
+Suppress with ``# srtlint: ignore[release-paths] (<who releases this
+and on which path>)``.
+"""
+
+# method name -> release method names expected on the bound handle
+HANDLE_METHODS: Dict[str, Set[str]] = {
+    "register": {"close"},
+    "lookup_broadcast": {"close", "release"},
+    "insert_broadcast": {"close"},
+    "lookup_scan": {"release"},
+    "acquire": {"release", "close", "__exit__"},
+}
+# constructors whose instances are resources
+HANDLE_CTORS: Dict[str, Set[str]] = {
+    "ResultStream": {"close"},
+}
+# void paired calls: obj.acquire(args) needs obj.release(...) in a finally
+PAIRED_VOID = {"acquire": "release"}
+# calls that release by ARGUMENT: cache.release(entry)
+RELEASE_BY_ARG = {"release", "close", "unregister"}
+# receivers whose .register() is not a resource acquisition
+_NON_RESOURCE_REGISTER_RECV = {"atexit", "weakref"}
+
+
+def _call_kind(sf, call: ast.Call) -> Optional[Set[str]]:
+    """Release-method set when ``call`` is an acquisition, else None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in HANDLE_METHODS:
+            recv = sf.qualname(func.value) or ""
+            if recv.split(".")[0] in _NON_RESOURCE_REGISTER_RECV:
+                return None
+            return HANDLE_METHODS[func.attr]
+        return None
+    if isinstance(func, ast.Name):
+        q = sf.qualname(func) or func.id
+        last = q.rsplit(".", 1)[-1]
+        if last in HANDLE_CTORS:
+            return HANDLE_CTORS[last]
+    return None
+
+
+def _is_release_site(sf, node: ast.Call, name: str,
+                     methods: Set[str]) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in methods and isinstance(func.value, ast.Name) \
+                and func.value.id == name:
+            return True  # h.close()
+        if func.attr in RELEASE_BY_ARG:
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True  # cache.release(entry)
+    return False
+
+
+def _escapes(sf, fn, name: str, after_line: int,
+             release_sites: List[ast.AST]) -> bool:
+    """Ownership visibly transfers: returned/yielded/stored/passed on."""
+    release_calls = set(map(id, release_sites))
+    for node in cfg.walk_scope(fn):
+        if getattr(node, "lineno", 0) < after_line:
+            continue
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = node.value
+            if v is not None and any(
+                    isinstance(n, ast.Name) and n.id == name
+                    for n in ast.walk(v)):
+                return True
+        elif isinstance(node, ast.Assign):
+            uses = any(isinstance(n, ast.Name) and n.id == name
+                       for n in ast.walk(node.value))
+            if uses:
+                return True  # aliased / stored: tracked under that name
+        elif isinstance(node, ast.Call) and id(node) not in release_calls:
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                for n in ast.walk(arg):
+                    if isinstance(n, ast.Name) and n.id == name:
+                        return True  # handed to another owner
+    return False
+
+
+def _protected_release(sf, acquire_stmt, release_site) -> Optional[ast.Try]:
+    """The try whose ``finally`` holds ``release_site`` AND protects
+    the acquisition (acquisition inside its body, or the try follows
+    the acquisition in the same suite)."""
+    t = cfg.in_finalbody(sf, release_site)
+    if t is None:
+        return None
+    if t in cfg.protecting_trys(sf, acquire_stmt):
+        return t
+    if cfg.following_finally_try(sf, acquire_stmt) is t:
+        return t
+    # acquisition in a suite ABOVE the try (e.g. inside `with`): accept
+    # any ancestor chain where the try's suite follows the acquisition
+    return None
+
+
+def _check_tracked(tree, sf, fn, stmt: ast.Assign, call: ast.Call,
+                   name: str, methods: Set[str], findings: List) -> None:
+    releases = [n for n in cfg.walk_scope(fn)
+                if isinstance(n, ast.Call)
+                and _is_release_site(sf, n, name, methods)]
+    if not releases:
+        if _escapes(sf, fn, name, stmt.lineno + 1, releases):
+            return
+        findings.append(tree.finding(
+            sf, call, RULE,
+            f"'{name}' acquired here is never released in this "
+            f"function and never escapes — release it in a finally, "
+            f"or transfer ownership explicitly"))
+        return
+    protecting = [t for r in releases
+                  for t in [_protected_release(sf, stmt, r)]
+                  if t is not None]
+    if not protecting:
+        plain = [r for r in releases
+                 if not any(isinstance(a, ast.excepthandler)
+                            for a in cfg.ancestors(sf, r))]
+        if not plain:
+            # released only inside except handlers: the error path is
+            # covered; the success path must visibly transfer
+            # ownership (the fill-abandon idiom: close what was
+            # half-built on fault, hand the rest to the new owner)
+            if _escapes(sf, fn, name, stmt.lineno + 1, releases):
+                return
+            findings.append(tree.finding(
+                sf, call, RULE,
+                f"'{name}' is released only on the error path and "
+                f"never escapes — the success path leaks it"))
+            return
+        # a function that releases the handle itself OWNS it — a
+        # non-finally release is a leak-on-exception, not a transfer
+        findings.append(tree.finding(
+            sf, call, RULE,
+            f"'{name}' is released only on the straight-line path — "
+            f"an exception between acquire and release leaks it; move "
+            f"the release into a finally (or use a context manager)"))
+        return
+    # CFG-lite: explicit exits between acquisition and protection that
+    # dodge every protecting finally
+    leaks = cfg.exits_between(sf, fn, stmt, protecting)
+    for edge in leaks:
+        kind = "return" if isinstance(edge, ast.Return) else "raise"
+        findings.append(tree.finding(
+            sf, edge, RULE,
+            f"{kind} on line {edge.lineno} exits between the "
+            f"acquisition of '{name}' (line {stmt.lineno}) and its "
+            f"protecting finally — this edge leaks the resource"))
+
+
+def _check_paired_void(tree, sf, fn, call: ast.Call,
+                       findings: List) -> None:
+    recv = sf.qualname(call.func.value)
+    if recv is None:
+        return
+    release_name = PAIRED_VOID[call.func.attr]
+    stmt = sf.statement_of(call)
+    releases = [
+        n for n in cfg.walk_scope(fn)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == release_name
+        and sf.qualname(n.func.value) == recv
+        and getattr(n, "lineno", 0) > call.lineno]
+    if not releases:
+        findings.append(tree.finding(
+            sf, call, RULE,
+            f"{recv}.acquire() has no matching {recv}."
+            f"{release_name}() in this function — release on every "
+            f"outcome in a finally"))
+        return
+    if not any(_protected_release(sf, stmt, r) for r in releases):
+        findings.append(tree.finding(
+            sf, call, RULE,
+            f"{recv}.{release_name}() runs only on the straight-line "
+            f"path after this acquire — move it into a finally so "
+            f"every exit edge releases"))
+
+
+def run(tree) -> List:
+    findings: List = []
+    for sf in tree.package_files():
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, cfg.FuncNode):
+                continue
+            for node in cfg.walk_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                stmt = sf.statement_of(node)
+                # `with X.acquire():` / `with ResultStream(...) as s:`
+                # is the discipline — nothing to check
+                if isinstance(stmt, (ast.With, ast.AsyncWith)) and any(
+                        item.context_expr is node
+                        for item in stmt.items):
+                    continue
+                methods = _call_kind(sf, node)
+                if methods is None:
+                    continue
+                if isinstance(stmt, ast.Assign) \
+                        and stmt.value is node \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    _check_tracked(tree, sf, fn, stmt, node,
+                                   stmt.targets[0].id, methods,
+                                   findings)
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in PAIRED_VOID \
+                        and isinstance(stmt, (ast.Expr, ast.If)):
+                    _check_paired_void(tree, sf, fn, node, findings)
+    return findings

@@ -1,0 +1,43 @@
+"""span-timing: exec-node timing goes through the span API (AST port
+of the retired tools/check_span_timing.py)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+RULE = "span-timing"
+TITLE = "no raw clock reads in the exec-node layer (plan/, parallel/)"
+EXPLAIN = """
+The query trace (utils/tracing.py) is the engine's single attribution
+spine: every timed interval in the exec-node layer must come from
+``MetricSet.time(...)``, ``tracing.span(...)``, or ``tracing.record``
+with a span-layer clock value — a raw ``time.perf_counter()`` /
+``time.monotonic()`` / ``time.time()`` in plan/ or parallel/ silently
+drops that interval from profiled EXPLAIN and the Chrome-trace export.
+
+The pass resolves aliases (``from time import perf_counter``,
+``import time as t``) that the old regex scanner missed.
+
+Suppress with ``# span-api-ok (<provably non-timing use>)`` or
+``# srtlint: ignore[span-timing] (<why>)``.
+"""
+
+TIMED_DIRS = ("plan", "parallel")
+_CLOCKS = {"time.perf_counter", "time.monotonic", "time.time"}
+
+
+def run(tree) -> List:
+    findings = []
+    for sf in tree.files:
+        if not tree.in_dirs(sf, TIMED_DIRS):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and sf.call_qualname(node) in _CLOCKS:
+                findings.append(tree.finding(
+                    sf, node, RULE,
+                    "raw clock read bypasses the span API — time "
+                    "operator work via MetricSet.time or "
+                    "utils.tracing.span"))
+    return findings
