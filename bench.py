@@ -54,6 +54,15 @@ server.conn connection drops, disk spooling, oracle verification, and
 p50/p95/p99 + SLO-violation reporting; SRT_LOADGEN_QUERIES /
 SRT_LOADGEN_CONNECTIONS / SRT_LOADGEN_FAULT_RATE / SRT_LOADGEN_SEED
 parameterize it, and SRT_BENCH_QUERIES="" makes the run loadgen-only),
+SRT_BENCH_SOAK=1 (zero-downtime drill: a short scripted rolling-restart
+soak via tools/loadgen.py --soak — a 2-door front-door fleet under
+sustained zipf load, each door gracefully drained (GOAWAY naming its
+sibling) and restarted in place, ONE coordinator kill + failover
+mid-run (thread-rank world=3, silent freeze), and quota churn — every
+result oracle-verified, drain leak audits between phases, emitted as a
+soak_rolling_restart JSON line ahead of the suite numbers;
+SRT_SOAK_DURATION_S caps the duration at <=120 s, SRT_BENCH_QUERIES=""
+makes the run soak-only),
 SRT_BENCH_KILL_PEER=1 (killed-peer drill: a world=2 DcnShuffle over
 thread ranks commits on both sides, then rank 1 dies SILENTLY
 mid-reduce — the drill prints a dcn_killed_peer_recovery JSON line with
@@ -539,6 +548,13 @@ def main() -> None:
         # killed-peer recovery columns ride their own JSON line ahead of
         # the suite numbers (and are NOT re-run by per-query subprocesses)
         print(json.dumps(_killed_peer_drill()), flush=True)
+    if os.environ.get("SRT_BENCH_SOAK", "0") == "1":
+        # zero-downtime drill: rolling front-door restarts + one
+        # coordinator failover under sustained load, oracle-verified,
+        # ahead of the suite numbers (<=120 s, SRT_SOAK_DURATION_S)
+        print(json.dumps(_soak_drill()), flush=True)
+        if os.environ.get("SRT_BENCH_QUERIES", None) == "":
+            return  # soak-only invocation
     if os.environ.get("SRT_BENCH_LOADGEN", "0") == "1":
         # serving-traffic proxy: drive the sustained-load harness
         # (tools/loadgen.py — wire queries over TCP through the network
@@ -606,6 +622,7 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         env["SRT_BENCH_QUERIES"] = q
         env.pop("SRT_BENCH_KILL_PEER", None)  # drill ran once, up top
         env.pop("SRT_BENCH_LOADGEN", None)    # ditto the loadgen drill
+        env.pop("SRT_BENCH_SOAK", None)       # ditto the soak drill
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
@@ -627,6 +644,38 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         # leaves the latest complete snapshot as the last stdout line
         print(json.dumps(_assemble(sf, results, detail)), flush=True)
     print(json.dumps(_assemble(sf, results, detail)), flush=True)
+
+
+def _soak_drill() -> dict:
+    """SRT_BENCH_SOAK=1: a short (<=120 s) scripted rolling-restart
+    soak via tools/loadgen.py --soak — a fleet of front doors under
+    sustained zipf load, each door drain+GOAWAY+restarted in place, one
+    coordinator kill + failover mid-run, quota churn — emitted as a
+    ``soak_rolling_restart`` JSON line so the trajectory file tracks
+    zero-downtime operations (queries completed, restarts survived,
+    coordinator failovers, mismatches, leaks, per-tenant p99s)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import argparse
+
+    import loadgen as _lg
+    duration = min(120.0, float(os.environ.get("SRT_SOAK_DURATION_S",
+                                               "45")))
+    args = argparse.Namespace(
+        queries=0, connections=6, tenants=8, rows=60_000,
+        prepared_frac=0.5, fault_rate=0.0, slow_frac=0.15,
+        slo_ms=2000.0,
+        seed=int(os.environ.get("SRT_LOADGEN_SEED", "42")),
+        tenant_quotas="*=16", serial_ab=0, timeout=600.0,
+        no_verify=False, soak=True, soak_duration_s=duration, doors=2,
+        drain_deadline_s=10.0)
+    try:
+        rep = _lg.run_soak(args)
+        rep["metric"] = "soak_rolling_restart"
+        return rep
+    finally:
+        import spark_rapids_tpu as _srt
+        _srt.Session.reset()
 
 
 def _loadgen_drill() -> dict:

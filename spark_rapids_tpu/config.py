@@ -715,6 +715,23 @@ DCN_EPOCH_FENCING = register(
     "resync transparently from the rejection reply; disabling restores "
     "the pre-epoch wire behavior (debugging escape hatch).")
 
+DCN_COORDINATOR_STANDBY = register(
+    "spark.rapids.tpu.dcn.coordinator.standby", True,
+    "Stream the coordinator's membership journal (epoch, incarnations, "
+    "declared-dead set, replayable snapshots of recently completed "
+    "barriers/gathers — including the shuffle commit gathers that carry "
+    "every rank's durable map-output dir) to a STANDBY on the "
+    "next-lowest alive rank, write-ahead of collective replies, and "
+    "fail over to that deterministic successor on coordinator loss: "
+    "survivors re-dial the standby's peer server (which serves control "
+    "ops from the restored journal after promoting), resync the epoch, "
+    "and re-send the in-flight collective — completed tags replay "
+    "byte-identically. Coordinator loss is then permanent "
+    "(CoordinatorUnrecoverableError, resubmittable) only when no "
+    "successor exists (world <= 1 survivor) or takeover never "
+    "completes. Disabling restores the coordinator-as-single-point-of-"
+    "failure behavior (debugging escape hatch).")
+
 DCN_KILL_MODE = register(
     "spark.rapids.tpu.dcn.kill.mode", "silent",
     "How the dcn.peer_kill injection point kills this rank (chaos "
@@ -807,6 +824,27 @@ SERVER_SPOOL_MEMORY_BYTES = register(
     "In-memory buffer per result stream before frames overflow to the "
     "disk spool.", conv=int,
     check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVER_DRAIN_DEADLINE_MS = register(
+    "spark.rapids.tpu.server.drain.deadlineMs", 30000.0,
+    "Graceful-drain deadline (ms) for planned maintenance: how long "
+    "SqlFrontDoor.drain()/QueryScheduler.drain() let in-flight queries "
+    "finish after admission stops before cancelling the stragglers "
+    "AS-RESUBMITTABLE (typed QueryFaulted(resubmittable) the caller "
+    "re-routes to a sibling). Admission stops immediately either way; "
+    "the deadline only bounds how long running work may ride out the "
+    "restart.", conv=float,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVER_DRAIN_SIBLINGS = register(
+    "spark.rapids.tpu.server.drain.siblings", "",
+    "Comma list of 'host:port' sibling front doors advertised in the "
+    "GOAWAY control frame during a drain, so a WireClient reconnects "
+    "and retries idempotently against a live endpoint instead of "
+    "failing. Empty = the GOAWAY names no siblings (clients retry "
+    "their own endpoint after the restart). SqlFrontDoor.drain() may "
+    "also be passed an explicit sibling list (the rolling-restart "
+    "driver's mode, where the surviving fleet is known).")
 
 
 class TpuConf:

@@ -63,10 +63,16 @@ __all__ = ["POINTS", "InjectedFault", "FaultInjector", "INJECTOR"]
 #     unwinds through the real disconnect path — cooperative cancel,
 #     permit + quota + spool release; the leak-hygiene and loadgen
 #     suites assert zero residue).
+#   * ``dcn.coordinator_kill`` — like ``dcn.peer_kill`` but the rank
+#     that dies is HOSTING the coordinator: silent mode freezes the
+#     coordinator too (control requests are received and never
+#     answered), driving the coordinator-failover chaos differential;
+#     hard mode exits the hosting process.
 POINTS = ("io.read", "io.write", "shuffle.fragment", "dcn.heartbeat",
           "device.op", "cache.lookup", "dcn.peer_kill",
           "shuffle.corrupt", "spill.corrupt", "cache.corrupt",
-          "device.hang", "dcn.slow_peer", "server.conn")
+          "device.hang", "dcn.slow_peer", "server.conn",
+          "dcn.coordinator_kill")
 
 
 class InjectedFault(TransientFault):

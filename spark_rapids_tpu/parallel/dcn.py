@@ -36,9 +36,26 @@ stale shuffle state.  A committed rank's death during the reduce is a
 data-movement event, not a query failure: its fragments re-pull from the
 durable map output it published at commit, and its owned partitions are
 re-owned across the shrunk group (DcnShuffle.adopt_orphans).  Deaths the
-data plane cannot heal (pre-commit, broadcast build shards, lost
-coordinator) fast-fail typed as PermanentFaults, which the scheduler may
-resubmit against the surviving membership.
+data plane cannot heal (pre-commit, broadcast build shards) fast-fail
+typed as PermanentFaults, which the scheduler may resubmit against the
+surviving membership.
+
+Coordinator failover (docs/robustness.md "Coordinator failover &
+planned maintenance"): the coordinator streams a MEMBERSHIP JOURNAL —
+epoch, incarnations, declared-dead set, and the replayable snapshots of
+recently completed barriers/gathers (which include every shuffle's
+commit gather, i.e. the durable map-output registry) — to a standby on
+the next-lowest alive rank, write-ahead of the collective replies.  On
+coordinator loss every rank re-dials the DETERMINISTIC successor (that
+same next-lowest alive rank, whose peer server starts serving control
+ops from the restored journal), resyncs its epoch, and re-sends the
+in-flight collective; completed tags replay byte-identically from the
+journal so survivors that already consumed a reply never have to
+re-join.  Coordinator loss is therefore a :class:`TransientFault`
+(:class:`CoordinatorLostError`) whenever a successor exists, and stays
+permanent (:class:`CoordinatorUnrecoverableError`) only in the
+no-standby case — world <= 1 survivor, standby disabled, or a takeover
+that never completes.
 
 Gray failures (docs/robustness.md "Gray failures"): every frame stream
 is crc-stamped at write and verified at every decode — local read, peer
@@ -68,7 +85,8 @@ from ..faults.recovery import PermanentFault, TransientFault, \
     backoff_delays, transient_retry
 
 __all__ = ["Coordinator", "ProcessGroup", "DcnShuffle", "PeerFailedError",
-           "PeerLostError", "CoordinatorLostError", "host_partition_ids",
+           "PeerLostError", "CoordinatorLostError",
+           "CoordinatorUnrecoverableError", "host_partition_ids",
            "run_distributed_agg", "run_distributed_query"]
 
 _LEN = struct.Struct("<II")  # json length, binary payload length
@@ -92,13 +110,28 @@ class PeerLostError(PermanentFault, PeerFailedError):
     against the surviving membership."""
 
 
-class CoordinatorLostError(PermanentFault):
-    """The coordinator's socket closed or its process died.  Detected
-    promptly (a closed socket fails the in-flight request) instead of
-    hanging until ``dcn.waitTimeout``.  There is no coordinator
-    failover — full coordinator HA is out of scope (docs/robustness.md
-    documents the limitation); the scheduler's resubmission policy is
-    the recovery path once a new group is formed."""
+class CoordinatorLostError(TransientFault):
+    """The coordinator's socket closed or its process stopped answering.
+    Detected promptly (a closed socket fails the in-flight request; the
+    heartbeat socket carries a recv timeout so a FROZEN coordinator
+    surfaces within a liveness horizon) — and no longer terminal by
+    itself: the :class:`ProcessGroup` fails over to the deterministic
+    successor (the next-lowest alive rank, which has been receiving the
+    membership journal) and re-sends the in-flight request there.  The
+    transient flavor is raised only when a successor exists but this
+    request's bounded re-dial window expired — the retry vocabulary
+    applies.  When NO successor can exist, the permanent subclass
+    :class:`CoordinatorUnrecoverableError` is raised instead."""
+
+
+class CoordinatorUnrecoverableError(CoordinatorLostError, PermanentFault):
+    """Coordinator lost with no standby to fail over to: world <= 1
+    survivor, ``spark.rapids.tpu.dcn.coordinator.standby`` disabled, or
+    a successor that never completed takeover.  A
+    :class:`..faults.recovery.PermanentFault` first (the classification
+    wins over the transient base): ``transient_retry`` fast-fails typed
+    and resubmittable, and the scheduler may resubmit once a new group
+    forms."""
 
 
 # ---------------------------------------------------------------------------------
@@ -118,6 +151,23 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _send(sock: socket.socket, obj: dict, blob: bytes = b"") -> None:
     data = json.dumps(obj).encode()
     sock.sendall(_LEN.pack(len(data), len(blob)) + data + blob)
+
+
+def _shutdown_close(sock: Optional[socket.socket]) -> None:
+    """Close a socket ANOTHER thread may be blocked in ``recv`` on:
+    plain ``close()`` does not wake a parked reader (the kernel recv
+    keeps waiting on the orphaned fd) — ``shutdown`` does, surfacing a
+    prompt ConnectionError instead of a silent hang."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 def _recv(sock: socket.socket) -> Tuple[dict, bytes]:
@@ -153,10 +203,16 @@ class Coordinator:
     collective completes, so all participants see the SAME view.
     """
 
+    # completed-collective snapshots retained for failover replay: a
+    # survivor whose reply was lost with the old coordinator re-sends
+    # the tag and the successor answers byte-identically from here
+    JOURNAL_COMPLETED_MAX = 64
+
     def __init__(self, world_size: int, port: int = 0,
                  bind_host: str = "127.0.0.1",
                  heartbeat_timeout: Optional[float] = None,
-                 wait_timeout: Optional[float] = None):
+                 wait_timeout: Optional[float] = None,
+                 rank: int = 0, listen: bool = True):
         # None = resolve from the registered confs (session overrides
         # apply), so service deployments tune liveness without code:
         # spark.rapids.tpu.dcn.{heartbeatTimeout,waitTimeout}
@@ -171,7 +227,10 @@ class Coordinator:
         # (spark.rapids.tpu.faults.backoff.*)
         self._conf = conf
         self._fencing = conf["spark.rapids.tpu.dcn.epoch.fencing"]
+        self._standby_enabled = conf[
+            "spark.rapids.tpu.dcn.coordinator.standby"]
         self.world_size = world_size
+        self.rank = rank  # the rank HOSTING this coordinator
         self.heartbeat_timeout = heartbeat_timeout
         self.wait_timeout = wait_timeout
         self._cv = threading.Condition()
@@ -187,15 +246,40 @@ class Coordinator:
         self._declared: Dict[int, int] = {}
         self._inc: Dict[int, int] = {}
         self._meta: Dict[str, dict] = {}
+        # the membership journal: bounded buffer of completed-collective
+        # records (tag -> replayable reply) plus a version/pushed pair
+        # driving the write-ahead replication to the standby
+        self._completed: Dict[str, dict] = {}
+        self._completed_order: List[str] = []
+        self._version = 0
+        self._pushed = 0
+        self._push_sock: Optional[socket.socket] = None
+        self._push_rank: Optional[int] = None
+        self.standby_rank: Optional[int] = None
+        self._frozen = False
         self._closed = False
-        self._srv = socket.create_server((bind_host, port))
-        self.port = self._srv.getsockname()[1]
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
-        t = threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime control plane, not per-query work)
-                             name="srt-dcn-coordinator")
-        t.start()
-        self._threads.append(t)
+        if listen:
+            self._srv: Optional[socket.socket] = \
+                socket.create_server((bind_host, port))
+            # bounds accept(): a close() from another thread cannot wake
+            # a parked accept, so the loop polls the closed flag instead
+            self._srv.settimeout(0.5)
+            self.port = self._srv.getsockname()[1]
+            t = threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime control plane, not per-query work)
+                                 name="srt-dcn-coordinator")
+            t.start()
+            self._threads.append(t)
+        else:
+            # promoted standby: control ops arrive through the hosting
+            # rank's peer server (_PeerServer.attach_coordinator)
+            self._srv = None
+            self.port = -1
+        pt = threading.Thread(target=self._push_loop, daemon=True,  # ctx-ok (process-lifetime journal replication, not per-query work)
+                              name="srt-dcn-journal-push")
+        pt.start()
+        self._threads.append(pt)
 
     @property
     def epoch(self) -> int:
@@ -210,7 +294,9 @@ class Coordinator:
     def _accept_loop(self) -> None:
         while not self._closed:
             try:
-                conn, _ = self._srv.accept()  # wait-ok (close() closes the listening socket -> OSError exits the loop)
+                conn, _ = self._srv.accept()  # wait-ok (listener carries settimeout(0.5); the loop re-checks the closed flag each wakeup)
+            except socket.timeout:
+                continue
             except OSError:
                 return
             self._conns.append(conn)
@@ -220,9 +306,17 @@ class Coordinator:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
+        keep_open = False
         try:
             while True:
                 msg, blob = _recv(conn)
+                if self._frozen:
+                    # silent coordinator death: the request is received
+                    # and never answered; the socket stays open so peers
+                    # only learn through liveness timeouts (the worst
+                    # case the chaos suite drives)
+                    keep_open = True
+                    return
                 try:
                     reply, rblob = self._handle(msg, blob)
                 except Exception as e:  # surface to the peer, keep serving
@@ -231,7 +325,17 @@ class Coordinator:
         except (ConnectionError, OSError):
             pass
         finally:
-            conn.close()
+            if not keep_open:
+                conn.close()
+
+    def freeze(self) -> None:
+        """Silent-death simulation (``dcn.coordinator_kill`` silent
+        mode): stop answering and stop pushing the journal, but keep
+        every socket open — detection is purely the peers' liveness
+        machinery."""
+        with self._cv:
+            self._frozen = True
+            self._cv.notify_all()
 
     def _wait_for(self, pred, what: str, rank: int = -1):
         deadline = time.monotonic() + self.wait_timeout  # span-api-ok (timeout, not timing)
@@ -240,6 +344,9 @@ class Coordinator:
         # resolve fast, long barriers stop burning wakeups
         delays = backoff_delays(self._conf)
         while not pred():
+            if self._closed:
+                raise PeerFailedError(
+                    f"coordinator closed while waiting at {what}")
             left = deadline - time.monotonic()  # span-api-ok (timeout, not timing)
             if left <= 0:
                 raise PeerFailedError(
@@ -269,6 +376,7 @@ class Coordinator:
             self._epoch += 1
             self._declared[r] = self._epoch
         if newly:
+            self._version += 1  # membership change: journal the new view
             self._cv.notify_all()
 
     def _alive_needed_locked(self) -> int:
@@ -277,14 +385,167 @@ class Coordinator:
     def _arrived_alive_locked(self, joined) -> int:
         return len([r for r in joined if r not in self._declared])
 
-    def _meta_locked(self, tag: str) -> dict:
-        """The membership snapshot fixed when collective ``tag``
-        completed — every participant's reply carries the SAME view."""
-        m = self._meta.get(tag)
-        if m is None:
-            m = {"epoch": self._epoch, "dead": sorted(self._declared)}
-            self._meta[tag] = m
-        return m
+    def _complete_locked(self, tag: str, kind: str) -> dict:
+        """Fix the membership snapshot for completed collective ``tag``
+        and JOURNAL a replayable record of its reply — every
+        participant, including one re-sending after a coordinator
+        failover, gets the SAME view and payload bytes."""
+        rec = self._completed.get(tag)
+        if rec is not None:
+            return rec
+        import base64
+        meta = self._meta.get(tag)
+        if meta is None:
+            meta = {"epoch": self._epoch, "dead": sorted(self._declared)}
+            self._meta[tag] = meta
+        rec = {"tag": tag, "kind": kind, "meta": meta}
+        if kind == "allgather":
+            g = self._gathers.get(tag, {})
+            rec["ranks"] = sorted(g)
+            rec["parts"] = [base64.b64encode(g[r]).decode("ascii")
+                            for r in sorted(g)]
+        self._completed[tag] = rec
+        self._completed_order.append(tag)
+        while len(self._completed_order) > self.JOURNAL_COMPLETED_MAX:
+            old = self._completed_order.pop(0)
+            self._completed.pop(old, None)
+        self._version += 1
+        rec["ver"] = self._version
+        self._cv.notify_all()  # wake the journal pusher
+        return rec
+
+    def _standby_locked(self) -> Optional[int]:
+        """The journal's destination AND the deterministic successor:
+        the next-lowest alive rank that is not hosting this
+        coordinator."""
+        alive = [r for r in sorted(self._peers)
+                 if r != self.rank and r not in self._declared]
+        return alive[0] if alive else None
+
+    def _journal_locked(self) -> dict:
+        return {
+            "epoch": self._epoch,
+            "declared": {str(r): e for r, e in self._declared.items()},
+            "inc": {str(r): i for r, i in self._inc.items()},
+            "peers": {str(r): list(hp) for r, hp in self._peers.items()},
+            "completed": [self._completed[t] for t in self._completed_order
+                          if t in self._completed],
+            "coord_rank": self.rank,
+            "heartbeat_timeout": self.heartbeat_timeout,
+            "wait_timeout": self.wait_timeout,
+        }
+
+    def _await_push_locked(self, rec: dict) -> None:
+        """WRITE-AHEAD replication: hold a completed collective's
+        replies until the journal version that recorded it reached the
+        standby (bounded).  The ordering closes the lost-reply window:
+        a record is on the standby before ANY rank consumes its reply,
+        or no rank consumed one and the collective simply re-forms at
+        the successor.  A broken/absent standby bounds the wait —
+        availability over perfect durability, documented."""
+        ver = rec.get("ver", 0)
+        if not self._standby_enabled or ver <= 0:
+            return
+        deadline = time.monotonic() + min(  # span-api-ok (timeout, not timing)
+            2.0, max(0.2, self.heartbeat_timeout))
+        while (self._pushed < ver and not self._closed
+               and self._standby_locked() is not None
+               and time.monotonic() < deadline):  # span-api-ok (timeout, not timing)
+            self._cv.wait(timeout=0.05)
+
+    # -- journal replication -------------------------------------------------------
+    def _push_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._closed and not self._frozen \
+                        and (self._pushed >= self._version
+                             or not self._standby_enabled):
+                    self._cv.wait(timeout=0.5)
+                if self._closed or self._frozen:
+                    return
+                ver = self._version
+                standby = self._standby_locked()
+                blob = json.dumps(self._journal_locked()).encode() \
+                    if standby is not None else b""
+            if blob:
+                self._push_once(standby, blob)  # blocking IO off the lock
+            with self._cv:
+                self._pushed = max(self._pushed, ver)
+                self.standby_rank = standby
+                self._cv.notify_all()
+
+    def _push_once(self, standby: int, blob: bytes) -> bool:
+        """One journal push to the standby's peer server (cached
+        connection; one fresh re-dial).  Failure is tolerated — the
+        standby may itself be dying; the next version retries, and
+        `_await_push_locked` bounds how long replies can wait on it."""
+        for fresh in (False, True):
+            sock = self._push_sock
+            try:
+                if sock is None or self._push_rank != standby or fresh:
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    host, port = self._peers[standby]
+                    sock = socket.create_connection((host, port),
+                                                    timeout=2.0)
+                    sock.settimeout(2.0)
+                    self._push_sock, self._push_rank = sock, standby
+                _send(sock, {"op": "journal"}, blob)
+                msg, _ = _recv(sock)
+                if msg.get("ok"):
+                    return True
+            except (ConnectionError, OSError, ValueError):
+                try:
+                    if sock is not None:
+                        sock.close()
+                except OSError:
+                    pass
+                self._push_sock = None
+        return False
+
+    def restore(self, journal: Optional[dict],
+                presume_dead: Tuple[int, ...] = ()) -> "Coordinator":
+        """Adopt a replicated membership journal (successor takeover):
+        membership, incarnations, liveness timeouts, and the completed-
+        collective replay buffer come back; every alive rank's liveness
+        clock resets to NOW (nobody is declared dead for failing to
+        heartbeat at a coordinator that did not exist yet); ranks in
+        ``presume_dead`` (the old coordinator's host) are declared
+        immediately, bumping the epoch."""
+        with self._cv:
+            j = journal or {}
+            self._epoch = max(self._epoch, int(j.get("epoch", 0)))
+            self._declared = {int(r): int(e)
+                              for r, e in (j.get("declared") or {}).items()}
+            self._inc = {int(r): int(i)
+                         for r, i in (j.get("inc") or {}).items()}
+            self._peers = {int(r): (h, int(p))
+                           for r, hp in (j.get("peers") or {}).items()
+                           for h, p in [hp]}
+            for rec in j.get("completed") or []:
+                tag = rec.get("tag")
+                if tag and tag not in self._completed:
+                    rec = dict(rec)
+                    rec["ver"] = 0  # replicated once already: replayable now
+                    self._completed[tag] = rec
+                    self._completed_order.append(tag)
+            if j.get("heartbeat_timeout") is not None:
+                self.heartbeat_timeout = float(j["heartbeat_timeout"])
+            if j.get("wait_timeout") is not None:
+                self.wait_timeout = float(j["wait_timeout"])
+            for r in presume_dead:
+                if r not in self._declared:
+                    self._epoch += 1
+                    self._declared[r] = self._epoch
+            now = time.monotonic()  # span-api-ok (liveness clock, not timing)
+            self._last_seen = {r: now for r in self._peers
+                               if r not in self._declared}
+            self._version += 1
+            self._cv.notify_all()
+        return self
 
     def _fence_locked(self, op: str, rank: int,
                       msg: dict) -> Optional[dict]:
@@ -331,6 +592,7 @@ class Coordinator:
                     self._epoch += 1
                 self._peers[rank] = (msg["host"], int(msg["port"]))
                 self._last_seen[rank] = time.monotonic()  # span-api-ok (timeout, not timing)
+                self._version += 1  # peer map change: journal it
                 self._cv.notify_all()
                 self._wait_for(
                     lambda: len(self._peers) >= self.world_size, "register",
@@ -347,30 +609,39 @@ class Coordinator:
                 self._last_seen[rank] = time.monotonic()  # span-api-ok (timeout, not timing)
             if op == "barrier":
                 tag = msg["tag"]
-                joined = self._barriers.setdefault(tag, set())
-                joined.add(rank)
-                self._cv.notify_all()
-                self._wait_for(
-                    lambda: self._arrived_alive_locked(self._barriers[tag])
-                    >= self._alive_needed_locked(),
-                    f"barrier {tag}", rank)
-                meta = self._meta_locked(tag)
-                self._release(tag, self._barriers)
-                return {"ok": True, **meta}, b""
+                rec = self._completed.get(tag)
+                if rec is None:
+                    joined = self._barriers.setdefault(tag, set())
+                    joined.add(rank)
+                    self._cv.notify_all()
+                    self._wait_for(
+                        lambda: self._arrived_alive_locked(
+                            self._barriers[tag])
+                        >= self._alive_needed_locked(),
+                        f"barrier {tag}", rank)
+                    rec = self._complete_locked(tag, "barrier")
+                    self._release(tag, self._barriers)
+                self._await_push_locked(rec)
+                return {"ok": True, **rec["meta"]}, b""
             if op == "allgather":
+                import base64
                 tag = msg["tag"]
-                self._gathers.setdefault(tag, {})[rank] = blob
-                self._cv.notify_all()
-                self._wait_for(
-                    lambda: self._arrived_alive_locked(self._gathers[tag])
-                    >= self._alive_needed_locked(),
-                    f"allgather {tag}", rank)
-                meta = self._meta_locked(tag)
-                ranks = sorted(self._gathers[tag])
-                parts = [self._gathers[tag][r] for r in ranks]
-                self._release(tag, self._gathers)
+                rec = self._completed.get(tag)
+                if rec is None:
+                    self._gathers.setdefault(tag, {})[rank] = blob
+                    self._cv.notify_all()
+                    self._wait_for(
+                        lambda: self._arrived_alive_locked(
+                            self._gathers[tag])
+                        >= self._alive_needed_locked(),
+                        f"allgather {tag}", rank)
+                    rec = self._complete_locked(tag, "allgather")
+                    self._release(tag, self._gathers)
+                self._await_push_locked(rec)
+                parts = [base64.b64decode(p) for p in rec["parts"]]
                 return {"lens": [len(p) for p in parts],
-                        "ranks": ranks, **meta}, b"".join(parts)
+                        "ranks": rec["ranks"],
+                        **rec["meta"]}, b"".join(parts)
             if op == "heartbeat":
                 return {"dead": sorted(self._declared),
                         "epoch": self._epoch}, b""
@@ -395,22 +666,28 @@ class Coordinator:
         PROMPTLY (a typed CoordinatorLostError on their in-flight
         request) instead of hanging until waitTimeout."""
         self._closed = True
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
         with self._cv:
             self._cv.notify_all()
         for conn in self._conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            # shutdown wakes the serve thread parked in recv (a plain
+            # close would leave it blocked until its peer disconnects)
+            _shutdown_close(conn)
+        _shutdown_close(self._push_sock)
+        for t in self._threads:
+            t.join(timeout=2.0)
 
 
 # ---------------------------------------------------------------------------------
 # Peer data server: streams shuffle partition frame files to whoever asks.
 # ---------------------------------------------------------------------------------
+
+_COORD_OPS = ("register", "barrier", "allgather", "heartbeat", "members")
+
 
 class _PeerServer:
     """RapidsShuffleServer analog: serves this process's map-side output.
@@ -421,7 +698,16 @@ class _PeerServer:
     zombie rank fenced out of the group cannot keep pulling shuffle
     state.  ``freeze()`` simulates silent death: the socket stays open
     but requests are never answered (detection only through heartbeat
-    timeout — the worst-case failure shape the chaos suite drives)."""
+    timeout — the worst-case failure shape the chaos suite drives).
+
+    Coordinator failover rides this server: the rank-0 coordinator
+    pushes its membership journal here (op ``journal``, held for a
+    possible promotion), and after ``attach_coordinator`` — the hosting
+    rank promoted itself the deterministic successor — control ops
+    (:data:`_COORD_OPS`) are served from the attached coordinator over
+    each requester's own connection.  Before promotion they answer
+    ``not_coordinator`` so a peer re-dialing early retries on backoff
+    instead of mis-parsing."""
 
     def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
         self._registry: Dict[str, str] = {}  # shuffle id -> frame-file dir
@@ -431,6 +717,11 @@ class _PeerServer:
         self._held: List[socket.socket] = []  # frozen conns, kept open
         self.epoch = 0
         self.fencing = True
+        # coordinator-failover state: the journal the coordinator pushed
+        # here (this rank is the standby) and, after promotion, the
+        # coordinator this server fronts
+        self.journal: Optional[dict] = None
+        self.coordinator: Optional["Coordinator"] = None
         # the dcn.slow_peer gray injection: when armed and selected, a
         # fetch is answered LATE by this much (straggler simulation —
         # slow is not dead: heartbeats keep flowing, replies arrive
@@ -439,9 +730,21 @@ class _PeerServer:
         # reader provably beats the straggler).
         self.slow_inject_s = 3.0
         self._srv = socket.create_server((bind_host, port))
+        # bounds accept() so close() joins stay prompt (see Coordinator)
+        self._srv.settimeout(0.5)
         self.port = self._srv.getsockname()[1]
-        threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime data-plane server)
-                         name="srt-dcn-peer-server").start()
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True,  # ctx-ok (process-lifetime data-plane server)
+                             name="srt-dcn-peer-server")
+        t.start()
+        self._threads.append(t)
+
+    def attach_coordinator(self, coord: "Coordinator") -> None:
+        """Promotion: this rank is now the coordinator — control ops on
+        every (new or existing) connection route to ``coord``."""
+        with self._lock:
+            self.coordinator = coord
 
     def register(self, shuffle_id: str, directory: str) -> None:
         with self._lock:
@@ -461,21 +764,26 @@ class _PeerServer:
     def _accept_loop(self) -> None:
         while not self._closed:
             try:
-                conn, _ = self._srv.accept()  # wait-ok (close() closes the listening socket -> OSError exits the loop)
+                conn, _ = self._srv.accept()  # wait-ok (listener carries settimeout(0.5); the loop re-checks the closed flag each wakeup)
+            except socket.timeout:
+                continue
             except OSError:
                 return
             with self._lock:
                 if self._frozen:
                     self._held.append(conn)  # accepted, never answered
                     continue
-            threading.Thread(target=self._serve, args=(conn,),  # ctx-ok (data-plane connection handler)
-                             daemon=True).start()
+                self._conns.append(conn)
+            t = threading.Thread(target=self._serve, args=(conn,),  # ctx-ok (data-plane connection handler)
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
         keep_open = False
         try:
             while True:
-                msg, _ = _recv(conn)
+                msg, blob = _recv(conn)
                 with self._lock:
                     if self._frozen:
                         # silent death mid-request: never answer, hold
@@ -484,7 +792,38 @@ class _PeerServer:
                         keep_open = True
                         return
                     d = self._registry.get(msg.get("shuffle"))
-                if msg["op"] != "fetch":
+                    coord = self.coordinator
+                op = msg.get("op")
+                if op == "journal":
+                    # the coordinator streaming its membership journal
+                    # to this rank (the standby): hold the latest copy
+                    # for a possible promotion
+                    try:
+                        j = json.loads(blob.decode()) if blob else None
+                    except ValueError as e:
+                        _send(conn, {"error": f"bad journal: {e}"})
+                        continue
+                    with self._lock:
+                        self.journal = j
+                    _send(conn, {"ok": True})
+                    continue
+                if op in _COORD_OPS:
+                    if coord is None:
+                        _send(conn, {"error":
+                                     f"this rank is not the coordinator "
+                                     f"(op {op!r})",
+                                     "not_coordinator": True})
+                        continue
+                    # control ops may PARK (barrier waits) — each
+                    # requester holds its own connection/thread, exactly
+                    # like the standalone coordinator server
+                    try:
+                        reply, rblob = coord._handle(msg, blob)
+                    except Exception as e:
+                        reply, rblob = {"error": str(e)}, b""
+                    _send(conn, reply, rblob)
+                    continue
+                if op != "fetch":
                     _send(conn, {"error": f"unknown op {msg['op']!r}"})
                     continue
                 from ..faults.injector import INJECTOR
@@ -522,6 +861,14 @@ class _PeerServer:
             self._srv.close()
         except OSError:
             pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            # shutdown+close wakes parked serve threads: joins stay prompt
+            _shutdown_close(c)
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
 
 
 # ---------------------------------------------------------------------------------
@@ -572,8 +919,9 @@ class ProcessGroup:
         self.fenced = False
         # silent peers are detected through fetch timeouts bounded by
         # the liveness horizon, not a fixed 60 s socket timeout
-        self._fetch_timeout = max(
-            2.0, float(conf["spark.rapids.tpu.dcn.heartbeatTimeout"]))
+        self._hb_timeout = float(
+            conf["spark.rapids.tpu.dcn.heartbeatTimeout"])
+        self._fetch_timeout = max(2.0, self._hb_timeout)
         # straggler detection (distinct from death): per-peer response
         # times feed a declare-SLOW state — a slow peer's fragment
         # fetches hedge against its durable map output immediately
@@ -587,11 +935,26 @@ class ProcessGroup:
         self._rt_lock = threading.Lock()
         self._peer_rt: Dict[int, float] = {}  # rank -> last response s
         self._server.slow_inject_s = max(0.05, 3.0 * self.hedge_s)
+        # coordinator failover: which rank hosts the coordinator (rank 0
+        # by convention at rendezvous), whether the standby/failover
+        # protocol is on, and a generation counter so concurrent
+        # failure observers run exactly ONE takeover between them
+        self.coord_rank = 0
+        self._standby_enabled = conf[
+            "spark.rapids.tpu.dcn.coordinator.standby"]
+        self._fo_lock = threading.Lock()
+        self._fo_gen = 0
+        # heartbeat replies are always prompt, so the hb socket carries
+        # a recv timeout — a FROZEN (silently dead) coordinator surfaces
+        # as a liveness failure here instead of hanging forever
+        self._hb_recv_timeout = max(1.0, float(
+            conf["spark.rapids.tpu.dcn.heartbeatTimeout"]))
         self._ctrl_lock = threading.Lock()
         self._ctrl = self._connect(coordinator_addr, connect_timeout)
         # heartbeats ride their own connection: a rank parked in a long
         # barrier/allgather holds _ctrl_lock and must not starve liveness
         self._hb_sock = self._connect(coordinator_addr, connect_timeout)
+        self._hb_sock.settimeout(self._hb_recv_timeout)
         self._hb_lock = threading.Lock()
         msg, _ = self._request({
             "op": "register",
@@ -638,32 +1001,217 @@ class ProcessGroup:
 
     def _request(self, obj: dict, blob: bytes = b"",
                  _retried: bool = False) -> Tuple[dict, bytes]:
-        framed = {**obj, "rank": self.rank, "epoch": self.epoch,
-                  "inc": self.inc}
-        try:
-            with self._ctrl_lock:
-                _send(self._ctrl, framed, blob)  # srtlint: ignore[lock-discipline] (the ctrl lock IS the request/reply serializer for this socket; no other lock nests under it)
-                msg, payload = _recv(self._ctrl)  # srtlint: ignore[lock-discipline] (reply waits are bounded by the coordinator's waitTimeout replies and close()-on-death, never another lock)
-        except (ConnectionError, OSError) as e:
-            # a closed coordinator socket surfaces typed and PROMPTLY —
-            # not as a hang until waitTimeout (no coordinator failover:
-            # docs/robustness.md documents the limitation)
-            self.coordinator_lost = True
-            raise CoordinatorLostError(
-                f"coordinator at {self.coordinator_addr[0]}:"
-                f"{self.coordinator_addr[1]} unreachable during "
-                f"{obj.get('op')!r}: {type(e).__name__}: {e}") from e
-        self._absorb_membership(msg)
-        if msg.get("stale_epoch") and not _retried:
-            # our epoch lagged a membership change: resync (absorbed
-            # above) and re-send the same frame once at the new epoch
-            return self._request(obj, blob, _retried=True)
-        if msg.get("fenced"):
-            self.fenced = True
-            raise PeerLostError(
-                f"rank {self.rank} fenced out of the group: "
-                f"{msg.get('error')}")
-        return msg, payload
+        failovers = 0
+        while True:
+            framed = {**obj, "rank": self.rank, "epoch": self.epoch,
+                      "inc": self.inc}
+            gen = self._fo_gen
+            try:
+                with self._ctrl_lock:
+                    _send(self._ctrl, framed, blob)  # srtlint: ignore[lock-discipline] (the ctrl lock IS the request/reply serializer for this socket; no other lock nests under it)
+                    msg, payload = _recv(self._ctrl)  # srtlint: ignore[lock-discipline] (reply waits are bounded by the coordinator's waitTimeout replies and close()-on-death, never another lock)
+            except (ConnectionError, OSError) as e:
+                # coordinator gone: fail over to the deterministic
+                # successor (raises CoordinatorUnrecoverableError —
+                # typed, permanent — when no standby can exist) and
+                # re-send this same frame there; completed collectives
+                # replay from the successor's journal, in-flight ones
+                # re-form as every survivor re-sends
+                failovers += 1
+                if failovers > self.world_size + 1:
+                    raise CoordinatorLostError(
+                        f"coordinator unreachable during "
+                        f"{obj.get('op')!r} after {failovers - 1} "
+                        f"failover attempt(s): {type(e).__name__}: {e}"
+                    ) from e
+                self._failover(gen, e)
+                continue
+            if msg.get("not_coordinator"):
+                # raced a successor that has not promoted yet (should
+                # be rare — _failover probes before switching): treat
+                # as a connection-level failure and re-run failover
+                failovers += 1
+                if failovers > self.world_size + 1:
+                    raise CoordinatorLostError(
+                        f"successor never took over during "
+                        f"{obj.get('op')!r}")
+                self._failover(gen, PeerFailedError(
+                    f"rank at {self.coordinator_addr} is not the "
+                    f"coordinator"))
+                continue
+            self._absorb_membership(msg)
+            if msg.get("stale_epoch") and not _retried:
+                # our epoch lagged a membership change: resync (absorbed
+                # above) and re-send the same frame once at the new epoch
+                return self._request(obj, blob, _retried=True)
+            if msg.get("fenced"):
+                self.fenced = True
+                raise PeerLostError(
+                    f"rank {self.rank} fenced out of the group: "
+                    f"{msg.get('error')}")
+            return msg, payload
+
+    # -- coordinator failover ------------------------------------------------------
+    def _successor_locked(self) -> Optional[int]:
+        """The deterministic successor: the next-lowest alive rank —
+        excluding every declared-dead rank AND the rank hosting the
+        coordinator we just lost.  The same rule the old coordinator
+        used to pick its journal standby, so the successor is the rank
+        that HAS the journal."""
+        gone = set(self._dead) | {self.coord_rank}
+        for r in sorted(self.peers):
+            if r not in gone:
+                return r
+        return None
+
+    def _failover(self, observed_gen: int, cause: BaseException) -> None:
+        """Re-dial the deterministic successor coordinator and resync.
+
+        Exactly one observer of a coordinator failure performs the
+        takeover switch (the generation counter dedups concurrent
+        observers — a heartbeat thread and a parked collective both see
+        the dead socket).  When the successor is THIS rank, it promotes
+        first: a Coordinator restored from the journal the old one
+        streamed here attaches to the peer server.  Raises
+        :class:`CoordinatorUnrecoverableError` (typed, permanent,
+        resubmittable) when no successor can exist — world <= 1
+        survivor, standby disabled — or takeover never completes within
+        the promote window."""
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        with self._fo_lock:
+            if self._fo_gen != observed_gen:
+                return  # another observer already switched; just retry
+            if self._closed or self.fenced:
+                raise CoordinatorUnrecoverableError(
+                    f"rank {self.rank} closed/fenced during coordinator "
+                    f"failover: {cause}") from cause
+            if not self._standby_enabled:
+                self.coordinator_lost = True
+                raise CoordinatorUnrecoverableError(
+                    f"coordinator at {self.coordinator_addr[0]}:"
+                    f"{self.coordinator_addr[1]} lost and "
+                    f"dcn.coordinator.standby is disabled: "
+                    f"{type(cause).__name__}: {cause}") from cause
+            succ = self._successor_locked()
+            if succ is None:
+                self.coordinator_lost = True
+                raise CoordinatorUnrecoverableError(
+                    f"coordinator at {self.coordinator_addr[0]}:"
+                    f"{self.coordinator_addr[1]} lost with no standby "
+                    f"(world <= 1 survivor; dead={self._dead}): "
+                    f"{type(cause).__name__}: {cause}") from cause
+            old_coord = self.coord_rank
+            if succ == self.rank:
+                self._promote_locked(old_coord)
+            addr = tuple(self.peers[succ])
+            ctrl = self._dial_successor(addr, succ, cause)  # srtlint: ignore[lock-discipline] (the failover lock IS the takeover serializer: every other observer of the dead coordinator must park until the successor dial completes; nothing else ever nests under it)
+            try:
+                hb = socket.create_connection(
+                    addr, timeout=self._fetch_timeout)
+                hb.settimeout(self._hb_recv_timeout)
+            except OSError as e:
+                try:
+                    ctrl.close()
+                except OSError:
+                    pass
+                self.coordinator_lost = True
+                raise CoordinatorUnrecoverableError(
+                    f"successor rank {succ} unreachable for the "
+                    f"heartbeat dial: {e}") from cause
+            old_ctrl, old_hb = self._ctrl, self._hb_sock
+            self._ctrl, self._hb_sock = ctrl, hb
+            self.coordinator_addr = addr
+            self.coord_rank = succ
+            # the old coordinator's rank is gone with it: treat its data
+            # plane as dead so fetches fast-fail to durable re-pulls
+            self._dead = sorted(set(self._dead) | {old_coord})
+            self._fo_gen += 1
+        QueryStats.get().coordinator_failovers += 1
+        tracing.mark(None, "coordinator:failover", "fault",
+                     successor=succ, old=old_coord, epoch=self.epoch,
+                     promoted=succ == self.rank)
+        # shutdown+close wakes any thread still parked in recv on the
+        # OLD sockets; it re-enters _failover, sees the advanced
+        # generation, and re-sends on the new one
+        for s in (old_ctrl, old_hb):
+            _shutdown_close(s)
+
+    def _dial_successor(self, addr, succ: int,
+                        cause: BaseException) -> socket.socket:
+        """Dial + probe the successor until it serves coordinator ops
+        (it may not have detected the death yet), bounded by the
+        promote window; absorbs the probe reply's membership view."""
+        deadline = time.monotonic() + max(5.0, 4 * self._fetch_timeout)  # span-api-ok (timeout, not timing)
+        delays = backoff_delays(None)
+        while True:
+            ctrl = None
+            try:
+                ctrl = socket.create_connection(
+                    addr, timeout=self._fetch_timeout)
+                ctrl.settimeout(self._fetch_timeout)
+                _send(ctrl, {"op": "members", "rank": self.rank,
+                             "epoch": self.epoch, "inc": self.inc})
+                msg, _ = _recv(ctrl)
+                if msg.get("not_coordinator"):
+                    raise ConnectionError(
+                        f"rank {succ} has not promoted yet")
+                if msg.get("fenced"):
+                    self.fenced = True
+                    try:
+                        ctrl.close()
+                    except OSError:
+                        pass
+                    raise PeerLostError(
+                        f"rank {self.rank} fenced by the successor "
+                        f"coordinator: {msg.get('error')}")
+                self._absorb_membership(msg)
+                ctrl.settimeout(None)  # collective parks are legitimate
+                return ctrl
+            except (ConnectionError, socket.timeout, OSError) as e:
+                if ctrl is not None:
+                    try:
+                        ctrl.close()
+                    except OSError:
+                        pass
+                if time.monotonic() > deadline:  # span-api-ok (timeout, not timing)
+                    self.coordinator_lost = True
+                    raise CoordinatorUnrecoverableError(
+                        f"successor rank {succ} did not take over "
+                        f"within the promote window: "
+                        f"{type(e).__name__}: {e}") from cause
+                time.sleep(min(0.5, next(delays)))  # fault-ok (bounded re-dial cadence inside the failover driver itself)
+
+    def _promote_locked(self, old_coord: int) -> None:
+        """THIS rank is the deterministic successor: build a Coordinator
+        from the journal the old one streamed here (or from this rank's
+        own membership view when no journal ever arrived) and serve
+        control ops through the peer server."""
+        from ..utils import tracing
+        journal = self._server.journal
+        coord = Coordinator(self.world_size, rank=self.rank,
+                            listen=False,
+                            heartbeat_timeout=self._hb_timeout)
+        coord.restore(journal or self._own_journal(),
+                      presume_dead=(old_coord,))
+        self._server.attach_coordinator(coord)
+        self.coordinator = coord  # close() tears it down with the rank
+        tracing.mark(None, "coordinator:promoted", "fault",
+                     rank=self.rank, old=old_coord, epoch=coord.epoch,
+                     from_journal=journal is not None)
+
+    def _own_journal(self) -> dict:
+        """Fallback journal from this rank's own membership view (the
+        old coordinator died before its first push): no completed-tag
+        replay buffer, incarnations default to 0 — honest degradation,
+        documented in docs/robustness.md."""
+        return {"epoch": self.epoch,
+                "declared": {str(r): self.epoch for r in self._dead},
+                "inc": {str(self.rank): self.inc},
+                "peers": {str(r): list(hp)
+                          for r, hp in self.peers.items()},
+                "completed": [],
+                "heartbeat_timeout": self._hb_timeout}
 
     # -- control-plane collectives -------------------------------------------------
     def _next_tag(self, kind: str) -> str:
@@ -744,24 +1292,51 @@ class ProcessGroup:
             time.sleep(interval)
             if self._closed:
                 return
+            gen = self._fo_gen
             try:
                 # dcn.heartbeat injection/recovery point: a dropped
                 # heartbeat retries with exponential backoff + jitter
                 # before this rank gives up on liveness reporting (the
                 # coordinator's heartbeat_timeout is the authority on
-                # actual death)
+                # actual death).  A reply TIMEOUT is excluded from the
+                # retryable classes: heartbeat replies are prompt by
+                # contract, so one missing the liveness horizon is
+                # already the silent-freeze signature — it fails over
+                # immediately instead of burning retries against a
+                # coordinator that will never answer
                 transient_retry(None, "dcn.heartbeat",
                                 self._heartbeat_once,
-                                desc=f"rank-{self.rank}")
+                                desc=f"rank-{self.rank}",
+                                retryable=(TransientFault,
+                                           ConnectionError,
+                                           InterruptedError))
             except QueryFaulted as qf:
-                if not getattr(qf, "resubmittable", False):
-                    # transient retries exhausted against a socket that
-                    # never answered: the coordinator is gone
-                    self.coordinator_lost = True
-                return
-            except (ConnectionError, OSError):
-                self.coordinator_lost = True
-                return
+                if getattr(qf, "resubmittable", False):
+                    return  # fenced: this rank is out of the group
+                # transient retries exhausted against a socket that
+                # never answered (or timed out — a frozen coordinator):
+                # the heartbeat thread is usually the FIRST observer of
+                # coordinator death, so it drives the failover (which
+                # also closes the old ctrl socket, waking any collective
+                # parked on it into its own failover retry)
+                if not self._failover_quiet(gen, qf):
+                    return
+            except (ConnectionError, OSError) as e:
+                if not self._failover_quiet(gen, e):
+                    return
+
+    def _failover_quiet(self, gen: int, cause: BaseException) -> bool:
+        """Heartbeat-thread failover driver: True when the group has a
+        live coordinator again (keep heartbeating), False when this
+        rank is done (no successor, fenced, or closed)."""
+        try:
+            self._failover(gen, cause)
+            return True
+        except CoordinatorLostError:
+            self.coordinator_lost = True
+            return False
+        except (PeerFailedError, ConnectionError, OSError):
+            return False
 
     @property
     def dead_peers(self) -> List[int]:
@@ -775,8 +1350,10 @@ class ProcessGroup:
 
     def check_peers(self) -> None:
         if self.coordinator_lost:
-            raise CoordinatorLostError(
-                "coordinator connection lost (no failover; see "
+            # set only when failover already failed: no successor
+            # existed (or takeover never completed) — permanent here
+            raise CoordinatorUnrecoverableError(
+                "coordinator lost and failover found no standby (see "
                 "docs/robustness.md)")
         dead = [r for r in self._dead if r != self.rank]
         if dead:
@@ -785,18 +1362,27 @@ class ProcessGroup:
 
     # -- chaos: deterministic peer kill --------------------------------------------
     def note_op(self, desc: str = "") -> None:
-        """The ``dcn.peer_kill`` injection point: counted once per
-        shuffle op on this rank; when the armed schedule selects the
-        op, THIS RANK DIES — either silently (heartbeats stop, the peer
-        server freezes; death is visible only through failure
-        detection) or hard (``os._exit``), per
-        ``spark.rapids.tpu.dcn.kill.mode``."""
+        """The ``dcn.peer_kill`` / ``dcn.coordinator_kill`` injection
+        points: counted once per shuffle op on this rank.  When the
+        armed schedule selects the op at ``dcn.peer_kill``, THIS RANK
+        DIES — silently (heartbeats stop, the peer server freezes;
+        death is visible only through failure detection) or hard
+        (``os._exit``), per ``spark.rapids.tpu.dcn.kill.mode``.  At
+        ``dcn.coordinator_kill`` the COORDINATOR this rank hosts dies
+        with it (silent mode additionally freezes the coordinator so
+        control requests hang instead of failing fast — the worst-case
+        shape coordinator failover must survive)."""
         from ..faults.injector import INJECTOR, InjectedFault
         try:
             INJECTOR.maybe_raise("dcn.peer_kill",
                                  desc=desc or f"rank-{self.rank}")
         except InjectedFault:
             self.die()
+        try:
+            INJECTOR.maybe_raise("dcn.coordinator_kill",
+                                 desc=desc or f"rank-{self.rank}")
+        except InjectedFault:
+            self.die_coordinator()
 
     def die(self, mode: Optional[str] = None) -> None:
         """Kill this rank (chaos testing).  ``hard`` exits the process;
@@ -812,12 +1398,32 @@ class ProcessGroup:
         self._closed = True  # stops the heartbeat loop
         self._server.freeze()
         for sock in (self._ctrl, self._hb_sock):
-            try:
-                sock.close()
-            except OSError:
-                pass
+            _shutdown_close(sock)
         raise PeerLostError(
             f"rank {self.rank} killed by dcn.peer_kill (silent)")
+
+    def die_coordinator(self, mode: Optional[str] = None) -> None:
+        """Kill the coordinator this rank hosts along with the rank
+        itself (chaos testing).  ``hard`` exits the process — the
+        crashed-coordinator-host shape; ``silent`` FREEZES the
+        coordinator (requests are received and never answered, sockets
+        stay open — survivors detect only through heartbeat-reply
+        timeouts) plus the ordinary silent rank death, then raises
+        :class:`PeerLostError` so this rank's own query unwinds."""
+        if mode is None:
+            from ..config import TpuConf
+            mode = TpuConf()["spark.rapids.tpu.dcn.kill.mode"]
+        if mode == "hard":
+            os._exit(137)
+        if self.coordinator is not None:
+            self.coordinator.freeze()
+        self._closed = True  # stops the heartbeat loop
+        self._server.freeze()
+        for sock in (self._ctrl, self._hb_sock):
+            _shutdown_close(sock)
+        raise PeerLostError(
+            f"rank {self.rank} killed its coordinator by "
+            f"dcn.coordinator_kill (silent)")
 
     # -- data plane ----------------------------------------------------------------
     def register_shuffle(self, shuffle_id: str, directory: str) -> None:
@@ -915,12 +1521,10 @@ class ProcessGroup:
         self._closed = True
         self._server.close()
         for sock in (self._ctrl, self._hb_sock):
-            try:
-                sock.close()
-            except OSError:
-                pass
+            _shutdown_close(sock)
         if self.coordinator is not None:
             self.coordinator.close()
+        self._hb.join(timeout=2.0)
 
 
 # ---------------------------------------------------------------------------------
@@ -1094,7 +1698,7 @@ class DcnShuffle:
                 done.set()
 
         cctx = contextvars.copy_context()
-        threading.Thread(target=cctx.run, args=(_do_fetch,), daemon=True,
+        threading.Thread(target=cctx.run, args=(_do_fetch,), daemon=True,  # srtlint: ignore[shutdown-paths] (the hedge LOSER is abandoned by design — its socket carries the liveness-horizon timeout that bounds its lifetime; joining it would serialize the hedge)
                          name=f"srt-dcn-fetch-r{r}-p{p}").start()
         hedge_s = 0.0 if r in self.pg.slow_peers else self.pg.hedge_s
         if not done.wait(timeout=hedge_s):

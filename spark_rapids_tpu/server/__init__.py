@@ -26,13 +26,14 @@ See docs/serving.md for the protocol and operations guide.
 from .client import ResultSet, WireClient
 from .endpoint import SqlFrontDoor
 from .prepared import PreparedCache, PreparedStatement
-from .protocol import ProtocolError, WireError
+from .protocol import ProtocolError, ServerDraining, WireError
 from .session import ClientSession, TenantQuotas
 from .spec import BadSpec, compile_spec
 from .spool import ResultStream
 
 __all__ = [
     "SqlFrontDoor", "WireClient", "ResultSet", "WireError",
+    "ServerDraining",
     "ProtocolError", "BadSpec", "compile_spec", "PreparedCache",
     "PreparedStatement", "ClientSession", "TenantQuotas", "ResultStream",
 ]

@@ -8,17 +8,29 @@ incrementally (the shape a slow consumer uses — the server spools
 behind it).  Used by :mod:`tests.test_server` and ``tools/loadgen.py``;
 it is deliberately synchronous and single-connection — fleet behavior
 comes from running many of them.
-"""
+
+Rolling-restart survival: a draining front door answers new query
+requests with a GOAWAY frame naming its sibling endpoints
+(:class:`.protocol.ServerDraining`).  The client reconnects to a
+sibling (advertised first, then any configured ``siblings``, the
+drained endpoint last — it may be back after the restart) and RETRIES
+the request idempotently; prepared statements re-prepare from the spec
+the client remembers, and the structural statement fingerprint means
+the sibling hands back the very same statement id."""
 
 from __future__ import annotations
 
 import socket
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from . import protocol as P
-from .protocol import WireError
+from .protocol import ServerDraining, WireError
 
 __all__ = ["WireClient", "ResultSet"]
+
+# attempts across GOAWAYs per request: initial + one per fleet hop is
+# plenty (a whole fleet draining at once is an outage, not a restart)
+_GOAWAY_RETRIES = 3
 
 
 class ResultSet:
@@ -53,53 +65,148 @@ class WireClient:
 
     def __init__(self, host: str, port: int, tenant: str = "default",
                  token: str = "", weight: float = 1.0,
-                 timeout: float = 120.0):
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout)
+                 timeout: float = 120.0,
+                 siblings: Optional[list] = None):
+        self._hello = {"token": token, "tenant": tenant, "weight": weight}
+        self._timeout = timeout
+        self._addrs: List[Tuple[str, int]] = [(host, int(port))] + [
+            (str(h), int(p)) for h, p in (siblings or [])]
+        self.addr: Tuple[str, int] = self._addrs[0]
+        # statement_id -> spec, so a prepared statement survives a
+        # failover by re-PREPARING on the sibling (the structural
+        # fingerprint guarantees the same id comes back)
+        self._stmts: Dict[str, Dict[str, Any]] = {}
+        self.goaways_survived = 0
+        self.session_id: Optional[str] = None
+        self._sock: Optional[socket.socket] = None
+        self._connect(self.addr)
+
+    def _connect(self, addr: Tuple[str, int]) -> None:
+        sock = socket.create_connection(addr, timeout=self._timeout)
         # small request frames answered promptly: Nagle + delayed-ACK
         # would add ~40ms to every round trip
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.session_id: Optional[str] = None
-        P.send_frame(self._sock, P.REQ_HELLO, P.pack_json(
-            {"token": token, "tenant": tenant, "weight": weight}))
-        _, payload = P.recv_frame(self._sock, expect=(P.RSP_WELCOME,))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        P.send_frame(sock, P.REQ_HELLO, P.pack_json(self._hello))
+        _, payload = P.recv_frame(sock, expect=(P.RSP_WELCOME,))
+        self._sock = sock
+        self.addr = addr
         self.session_id = P.unpack_json(payload)["session_id"]
+
+    def _failover(self, exc: ServerDraining) -> None:
+        """GOAWAY handling: reconnect to a live endpoint — the siblings
+        the GOAWAY advertised first, then any configured fallbacks, the
+        drained endpoint itself LAST (it may be back after the
+        restart) — and let the caller retry idempotently."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        candidates: List[Tuple[str, int]] = []
+        for a in (list(exc.siblings)
+                  + [a for a in self._addrs if a != self.addr]
+                  + [self.addr]):
+            a = (str(a[0]), int(a[1]))
+            if a not in candidates:
+                candidates.append(a)
+        last: BaseException = exc
+        for addr in candidates:
+            try:
+                self._connect(addr)
+                self.goaways_survived += 1
+                return
+            except (ServerDraining, WireError, P.ProtocolError,
+                    OSError) as e:
+                last = e
+        raise exc from last
 
     # -- statements ---------------------------------------------------------------
     def prepare(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         """PREPARE: returns {statement_id, param_types, cached, plan_ms,
         schema}."""
-        P.send_frame(self._sock, P.REQ_PREPARE,
-                     P.pack_json({"spec": spec}))
-        _, payload = P.recv_frame(self._sock, expect=(P.RSP_PREPARED,))
-        return P.unpack_json(payload)
+        for _ in range(_GOAWAY_RETRIES):
+            try:
+                P.send_frame(self._sock, P.REQ_PREPARE,
+                             P.pack_json({"spec": spec}))
+                _, payload = P.recv_frame(self._sock,
+                                          expect=(P.RSP_PREPARED,))
+                info = P.unpack_json(payload)
+                self._stmts[info["statement_id"]] = spec
+                return info
+            except ServerDraining as e:
+                self._failover(e)
+        raise WireError("DRAINING", "prepare kept landing on draining "
+                                    "endpoints")
 
     def execute(self, statement_id: str, params: Optional[list] = None,
                 **kw) -> ResultSet:
-        """EXECUTE a prepared statement with bound parameter values."""
+        """EXECUTE a prepared statement with bound parameter values.
+        Survives a draining endpoint: reconnects to a sibling,
+        re-prepares from the remembered spec (same structural
+        fingerprint → same id), retries."""
         req = {"statement_id": statement_id, "params": params or []}
         req.update(kw)
-        P.send_frame(self._sock, P.REQ_EXECUTE, P.pack_json(req))
-        return self._collect_result()
+        for _ in range(_GOAWAY_RETRIES):
+            try:
+                P.send_frame(self._sock, P.REQ_EXECUTE, P.pack_json(req))
+                return self._collect_result()
+            except ServerDraining as e:
+                self._failover(e)
+                spec = self._stmts.get(statement_id)
+                if spec is not None:
+                    # the sibling may never have seen this statement:
+                    # re-prepare (fingerprint-stable, so the id the
+                    # caller holds keeps working)
+                    self.prepare(spec)
+            except WireError as e:
+                # a restarted (or different) door with a fresh prepared
+                # cache answers NOT_FOUND for a statement this client
+                # prepared in the door's previous life: re-prepare from
+                # the remembered spec and retry — same fingerprint,
+                # same id
+                if e.code != "NOT_FOUND" \
+                        or statement_id not in self._stmts:
+                    raise
+                self.prepare(self._stmts[statement_id])
+        raise WireError("DRAINING", "execute kept landing on draining "
+                                    "endpoints")
 
     def query(self, spec: Dict[str, Any], params: Optional[list] = None,
               **kw) -> ResultSet:
-        """Ad-hoc SUBMIT (plans server-side per execution)."""
+        """Ad-hoc SUBMIT (plans server-side per execution).  Retries
+        idempotently through a GOAWAY."""
         req = {"spec": spec, "params": params or []}
         req.update(kw)
-        P.send_frame(self._sock, P.REQ_SUBMIT, P.pack_json(req))
-        return self._collect_result()
+        for _ in range(_GOAWAY_RETRIES):
+            try:
+                P.send_frame(self._sock, P.REQ_SUBMIT, P.pack_json(req))
+                return self._collect_result()
+            except ServerDraining as e:
+                self._failover(e)
+        raise WireError("DRAINING", "query kept landing on draining "
+                                    "endpoints")
 
     def query_stream(self, spec: Dict[str, Any],
                      params: Optional[list] = None, **kw
                      ) -> Iterator:
         """SUBMIT yielding ('meta'|'batch'|'end', value) incrementally —
         a deliberately slow consumer of this iterator exercises the
-        server's disk spool."""
+        server's disk spool.  A GOAWAY can only arrive in place of META
+        (the server drains at request boundaries): the client fails
+        over and re-submits before the first yield."""
         req = {"spec": spec, "params": params or []}
         req.update(kw)
-        P.send_frame(self._sock, P.REQ_SUBMIT, P.pack_json(req))
-        ftype, payload = P.recv_frame(self._sock, expect=(P.RSP_META,))
+        for attempt in range(_GOAWAY_RETRIES):
+            try:
+                P.send_frame(self._sock, P.REQ_SUBMIT, P.pack_json(req))
+                ftype, payload = P.recv_frame(self._sock,
+                                              expect=(P.RSP_META,))
+                break
+            except ServerDraining as e:
+                if attempt == _GOAWAY_RETRIES - 1:
+                    raise WireError("DRAINING",
+                                    "query_stream kept landing on "
+                                    "draining endpoints")
+                self._failover(e)
         yield "meta", P.unpack_json(payload)
         while True:
             ftype, payload = P.recv_frame(

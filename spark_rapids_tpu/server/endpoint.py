@@ -81,10 +81,16 @@ class SqlFrontDoor:
         self._lock = threading.Lock()
         self._queries: Dict[str, _WireQuery] = {}
         self._conns: Dict[int, socket.socket] = {}
+        self._conn_threads: Dict[int, threading.Thread] = {}
         self._conn_ids = itertools.count(1)
         self._srv: Optional[socket.socket] = None
         self._accept_th: Optional[threading.Thread] = None
         self._closed = False
+        # graceful drain (planned restart): once set, new connections
+        # and new query requests are answered with a GOAWAY frame
+        # naming the sibling endpoints; in-flight streams finish first
+        self._draining = False
+        self._siblings: list = []
         # lifetime counters (STATUS + the loadgen report read these)
         self.connections_total = 0
         self.connections_rejected = 0
@@ -92,6 +98,7 @@ class SqlFrontDoor:
         self.conn_lost = 0
         self.streamed_bytes = 0
         self.spooled_bytes = 0
+        self.goaways_sent = 0
 
     # -- lifecycle ----------------------------------------------------------------
     def _conf(self):
@@ -131,6 +138,76 @@ class SqlFrontDoor:
         assert self._srv is not None, "start() first"
         return self._srv.getsockname()[1]
 
+    def begin_drain(self, siblings: Optional[list] = None) -> None:
+        """Phase 1 of a graceful drain: flip into DRAINING — new
+        connections and new query requests are answered with a GOAWAY
+        frame naming ``siblings`` (conf
+        ``spark.rapids.tpu.server.drain.siblings`` when not given);
+        in-flight streams keep going.  :meth:`drain` completes the
+        shutdown."""
+        if siblings is None:
+            siblings = _parse_siblings(self._conf()[
+                "spark.rapids.tpu.server.drain.siblings"])
+        with self._lock:
+            self._draining = True
+            self._siblings = [(str(h), int(p)) for h, p in siblings]
+
+    def drain(self, deadline_s: Optional[float] = None,
+              siblings: Optional[list] = None,
+              linger_s: float = 0.0) -> Dict[str, Any]:
+        """Graceful drain for a rolling restart: stop accepting (new
+        connections AND new query requests get a GOAWAY frame naming
+        ``siblings`` so clients reconnect + retry idempotently), let
+        in-flight wire queries FINISH STREAMING — spools included —
+        until the deadline, cancel stragglers as-resubmittable (the
+        ``drain`` cancel flavor: typed, the client re-routes), linger
+        ``linger_s`` so idle clients' next request still gets a clean
+        GOAWAY instead of a dead socket, then close with the full
+        leak-hygiene guarantees (permits, quota slots, spool files,
+        spill handles, threads — the ``TestDrainCleanup`` suite audits
+        all of it).  Returns a drain report for the restart driver."""
+        conf = self._conf()
+        if deadline_s is None:
+            deadline_s = conf[
+                "spark.rapids.tpu.server.drain.deadlineMs"] / 1000.0
+        self.begin_drain(siblings)
+        deadline = _pc() + max(0.0, deadline_s)
+        while _pc() < deadline:
+            with self._lock:
+                if not self._queries:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            stragglers = list(self._queries.values())
+        for wq in stragglers:
+            # cancel-as-resubmittable: the worker unwinds QueryDrained,
+            # the scheduler finishes it 'drained' typed+resubmittable,
+            # and _do_query's finally releases quota + spool exactly
+            # like any other exit
+            wq.handle._entry.control.cancel(
+                f"front door draining: {wq.query_id} outlived the "
+                f"drain deadline; resubmit against a sibling",
+                drain=True)
+        grace = _pc() + max(2.0, deadline_s * 0.25)
+        while _pc() < grace:
+            with self._lock:
+                if not self._queries:
+                    break
+            time.sleep(0.05)
+        with self._lock:
+            leftover = len(self._queries)
+        if linger_s > 0:
+            # the GOAWAY window: clients parked between requests learn
+            # about the restart from a typed frame, not a dead socket
+            time.sleep(linger_s)
+        report = {"drained": True,
+                  "in_flight_cancelled": len(stragglers),
+                  "in_flight_leftover": leftover,
+                  "goaways_sent": self.goaways_sent,
+                  "siblings": list(self._siblings)}
+        self.close()
+        return report
+
     def close(self) -> None:
         with self._lock:
             if self._closed:
@@ -138,6 +215,7 @@ class SqlFrontDoor:
             self._closed = True
             conns = list(self._conns.values())
             queries = list(self._queries.values())
+            threads = list(self._conn_threads.values())
         for q in queries:
             q.handle.cancel("server closing")
             q.stream.close()
@@ -153,6 +231,9 @@ class SqlFrontDoor:
                 pass
         if self._accept_th is not None:
             self._accept_th.join(timeout=2.0)
+        for th in threads:
+            if th is not threading.current_thread():
+                th.join(timeout=2.0)
 
     # -- accept -------------------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -167,12 +248,24 @@ class SqlFrontDoor:
                 return  # closed
             self.connections_total += 1
             with self._lock:
-                if self._closed or len(self._conns) >= max_conns:
+                draining = self._draining
+                if self._closed or draining \
+                        or len(self._conns) >= max_conns:
                     over = True
                 else:
                     over = False
                     cid = next(self._conn_ids)
                     self._conns[cid] = conn
+            if draining:
+                # a draining door refuses new connections with GOAWAY —
+                # the reply NAMES the live siblings, so the client's
+                # very first retry lands somewhere useful
+                self._send_goaway(conn)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             if over:
                 self.connections_rejected += 1
                 try:
@@ -191,6 +284,8 @@ class SqlFrontDoor:
             th = threading.Thread(  # ctx-ok (connection handler; per-query contexts are the scheduler's)
                 target=self._handle_conn, args=(cid, conn, addr),
                 daemon=True, name=f"srt-server-conn-{cid}")
+            with self._lock:
+                self._conn_threads[cid] = th
             th.start()
 
     # -- connection handler -------------------------------------------------------
@@ -227,6 +322,16 @@ class SqlFrontDoor:
                     P.send_frame(conn, P.RSP_CANCELLED,
                                  P.pack_json({"cancelled": ok}))
                     continue
+                if self._draining and ftype in (P.REQ_SUBMIT,
+                                               P.REQ_PREPARE,
+                                               P.REQ_EXECUTE):
+                    # GOAWAY: no new work on a draining door — the
+                    # frame names the siblings and the connection
+                    # closes (control frames above kept serving; any
+                    # in-flight stream already finished, since this
+                    # protocol is sequential per connection)
+                    self._send_goaway(conn)
+                    return
                 try:
                     if ftype == P.REQ_PREPARE:
                         req = P.unpack_json(payload)
@@ -259,10 +364,21 @@ class SqlFrontDoor:
         finally:
             with self._lock:
                 self._conns.pop(cid, None)
+                self._conn_threads.pop(cid, None)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _send_goaway(self, conn) -> None:
+        with self._lock:
+            siblings = list(self._siblings)
+        try:
+            P.send_frame(conn, P.RSP_GOAWAY, P.goaway_payload(
+                "server draining for planned restart", siblings))
+            self.goaways_sent += 1
+        except OSError:
+            pass
 
     def _try_error(self, conn, err: WireError) -> None:
         try:
@@ -443,6 +559,12 @@ class SqlFrontDoor:
             "connection": csess.session_id, "peer": csess.peer,
             "wire_query": query_id,
             "prepared": bool(req.get("statement_id"))}
+        # a query shed before its worker ever runs (drain/close) would
+        # otherwise leave the connection thread polling a stream nobody
+        # finishes: resolve-with-exception fails the stream too
+        handle.future.add_done_callback(
+            lambda fut: (fut.exception() is not None
+                         and stream.fail_if_open(fut.exception())))
         self.queries_total += 1
         wq = _WireQuery(query_id, handle, stream, csess.tenant, label)
         with self._lock:
@@ -495,8 +617,16 @@ class SqlFrontDoor:
             if isinstance(e, (ConnectionError, socket.timeout, OSError,
                               P.ProtocolError)):
                 raise
+            from ..service.cancel import QueryDrained
             if isinstance(e, QueryFaulted):
-                code, detail = "FAULTED", getattr(e, "point", "") or ""
+                code = ("DRAINING" if getattr(e, "point", "") == "drain"
+                        else "FAULTED")
+                detail = getattr(e, "point", "") or ""
+            elif isinstance(e, QueryDrained):
+                # drained mid-stream: typed so the client re-routes the
+                # SAME query to a sibling instead of treating it as a
+                # user cancel
+                code, detail = "DRAINING", "resubmit against a sibling"
             elif isinstance(e, QueryDeadlineExceeded):
                 code, detail = "DEADLINE", ""
             elif isinstance(e, QueryCancelled):
@@ -561,11 +691,25 @@ class SqlFrontDoor:
             "queries_total": self.queries_total,
             "queries_inflight": running,
             "conn_lost": self.conn_lost,
+            "draining": self._draining,
+            "goaways_sent": self.goaways_sent,
             "streamed_bytes": self.streamed_bytes,
             "spooled_bytes": self.spooled_bytes,
             "scheduler": sched.snapshot(),
             "prepared": self.prepared.snapshot(),
         }
+
+
+def _parse_siblings(spec: str) -> list:
+    """``"host:port,host:port"`` → [(host, port), ...]."""
+    out = []
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        host, _, port = item.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
 
 
 def tracing_progress() -> None:

@@ -41,13 +41,16 @@ from typing import Any, Dict, Optional, Tuple
 
 __all__ = [
     "FRAME", "MAX_FRAME_BYTES", "WireError", "ProtocolError",
+    "ServerDraining",
     "send_frame", "recv_frame", "pack_json", "unpack_json",
+    "goaway_payload",
     # request frame types
     "REQ_HELLO", "REQ_SUBMIT", "REQ_PREPARE", "REQ_EXECUTE", "REQ_CANCEL",
     "REQ_STATUS", "REQ_BYE",
     # response frame types
     "RSP_WELCOME", "RSP_META", "RSP_BATCH", "RSP_END", "RSP_ERROR",
     "RSP_PREPARED", "RSP_CANCELLED", "RSP_STATUS", "RSP_BYE",
+    "RSP_GOAWAY",
 ]
 
 # type byte, payload length, crc32 of the payload — stamped at send,
@@ -77,11 +80,18 @@ RSP_PREPARED = b"P"
 RSP_CANCELLED = b"C"
 RSP_STATUS = b"S"
 RSP_BYE = b"X"
+# GOAWAY (the HTTP/2 shape): the server is DRAINING for a planned
+# restart — it names sibling endpoints and will accept no new queries
+# on this connection; in-flight streams finish first.  recv_frame
+# raises it typed (ServerDraining) so WireClient reconnects to a
+# sibling and retries idempotently.
+RSP_GOAWAY = b"G"
 
 _REQUEST_TYPES = (REQ_HELLO, REQ_SUBMIT, REQ_PREPARE, REQ_EXECUTE,
                   REQ_CANCEL, REQ_STATUS, REQ_BYE)
 _RESPONSE_TYPES = (RSP_WELCOME, RSP_META, RSP_BATCH, RSP_END, RSP_ERROR,
-                   RSP_PREPARED, RSP_CANCELLED, RSP_STATUS, RSP_BYE)
+                   RSP_PREPARED, RSP_CANCELLED, RSP_STATUS, RSP_BYE,
+                   RSP_GOAWAY)
 
 
 class ProtocolError(RuntimeError):
@@ -108,6 +118,23 @@ class WireError(RuntimeError):
         d = unpack_json(payload)
         return cls(d.get("code", "INTERNAL"), d.get("message", ""),
                    d.get("detail", ""))
+
+
+class ServerDraining(WireError):
+    """A GOAWAY frame: the server is draining for a planned restart.
+    Carries the sibling endpoints it advertised — ``[[host, port],
+    ...]`` — so the client can reconnect and retry idempotently.  A
+    :class:`WireError` (code ``DRAINING``) so generic typed-error
+    handlers treat an un-retried GOAWAY like any other shed."""
+
+    def __init__(self, message: str, siblings=None):
+        super().__init__("DRAINING", message)
+        self.siblings = [(str(h), int(p)) for h, p in (siblings or [])]
+
+
+def goaway_payload(reason: str, siblings) -> bytes:
+    return pack_json({"reason": reason,
+                      "siblings": [[h, int(p)] for h, p in siblings]})
 
 
 def pack_json(obj: Dict[str, Any]) -> bytes:
@@ -169,6 +196,10 @@ def recv_frame(sock: socket.socket,
             f"crc mismatch on {ftype!r} frame ({length} bytes)")
     if ftype == RSP_ERROR:
         raise WireError.from_payload(payload)
+    if ftype == RSP_GOAWAY:
+        d = unpack_json(payload)
+        raise ServerDraining(d.get("reason", "server draining"),
+                             siblings=d.get("siblings") or [])
     if expect is not None and ftype not in expect:
         raise ProtocolError(
             f"unexpected frame {ftype!r} (wanted one of {expect})")

@@ -45,6 +45,12 @@ class TenantQuotas:
         self._caps: Dict[str, int] = {}
         self._default = 0
         self._inflight: Dict[str, int] = {}
+        self.reconfigure(spec)
+
+    @staticmethod
+    def _parse(spec: str):
+        caps: Dict[str, int] = {}
+        default = 0
         for item in (spec or "").split(","):
             item = item.strip()
             if not item:
@@ -55,9 +61,21 @@ class TenantQuotas:
             name, n = item.rsplit("=", 1)
             cap = int(n)
             if name.strip() == "*":
-                self._default = cap
+                default = cap
             else:
-                self._caps[name.strip()] = cap
+                caps[name.strip()] = cap
+        return caps, default
+
+    def reconfigure(self, spec: str) -> None:
+        """Replace the caps IN PLACE (quota churn under live traffic —
+        the loadgen soak's shape).  In-flight accounting is preserved:
+        a tenant over a newly-lowered cap simply admits nothing new
+        until its in-flight work completes; release() keeps balancing
+        slots acquired under the old caps."""
+        caps, default = self._parse(spec)
+        with self._lock:
+            self._caps = caps
+            self._default = default
 
     def cap_for(self, tenant: str) -> int:
         return self._caps.get(tenant, self._default)

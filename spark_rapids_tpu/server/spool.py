@@ -116,6 +116,19 @@ class ResultStream:
             self._done = True
             self._cv.notify_all()
 
+    def fail_if_open(self, exc: BaseException) -> None:
+        """Fail the stream only if the producer never finished it — the
+        endpoint's handle-resolution hook uses this so a query shed
+        BEFORE its worker ran (a draining scheduler) still wakes the
+        consumer with the typed failure instead of leaving it polling
+        a stream nobody will ever finish."""
+        with self._cv:
+            if self._done or self._closed:
+                return
+            self._error = exc
+            self._done = True
+            self._cv.notify_all()
+
     # -- consumer side ------------------------------------------------------------
     def _next_locked(self):
         """One frame if available (memory first — it is strictly older

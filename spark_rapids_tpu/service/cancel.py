@@ -35,7 +35,7 @@ import time
 from typing import Callable, Dict, Optional
 
 __all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryStalled",
-           "QueryControl", "current", "check", "scope"]
+           "QueryDrained", "QueryControl", "current", "check", "scope"]
 
 _pc = time.perf_counter
 
@@ -58,6 +58,16 @@ class QueryStalled(QueryCancelled):
     handle close) behaves identically; the scheduler converts it to a
     typed ``QueryFaulted(resubmittable=True)`` because a hang, unlike a
     user cancel, is a gray FAILURE a fresh attempt may well outrun."""
+
+
+class QueryDrained(QueryCancelled):
+    """The scheduler is DRAINING (planned maintenance / rolling
+    restart) and this query outlived the drain deadline.  Still a
+    :class:`QueryCancelled` so every abort-path cleanup behaves
+    identically; the scheduler converts it to a typed
+    ``QueryFaulted(resubmittable=True)`` — unlike a user cancel, a
+    drained query is expected to be RESUBMITTED verbatim against a
+    sibling (or the restarted service)."""
 
 
 _CONTROL: "contextvars.ContextVar[Optional[QueryControl]]" = \
@@ -87,6 +97,7 @@ class QueryControl:
         self.reason: Optional[str] = None
         self._deadline_hit = False
         self._stalled = False
+        self._drained = False
         # last batch-pull checkpoint (perf_counter): every operator pull
         # stamps this through module-level check() — the watchdog's
         # progress signal.  Wait loops call the METHOD check() and do
@@ -136,19 +147,24 @@ class QueryControl:
 
     # -- cancellation -------------------------------------------------------------
     def cancel(self, reason: str = "query cancelled", *,
-               deadline: bool = False, stalled: bool = False) -> bool:
+               deadline: bool = False, stalled: bool = False,
+               drain: bool = False) -> bool:
         """Request cooperative cancellation.  Returns False when the
         query was already cancelled.  Fires every registered waker so
         blocked waits re-check immediately.  ``stalled=True`` is the
         watchdog's flavor: the unwind raises :class:`QueryStalled` so
         the scheduler can finish the query ``faulted(resubmittable)``
-        instead of ``cancelled``."""
+        instead of ``cancelled``.  ``drain=True`` is the graceful-drain
+        flavor: the unwind raises :class:`QueryDrained` and the
+        scheduler finishes the query ``drained`` with a typed
+        resubmittable failure the caller re-routes."""
         with self._lock:
             if self.cancelled.is_set():
                 return False
             self.reason = reason
             self._deadline_hit = deadline
             self._stalled = stalled
+            self._drained = drain
             self.cancelled.set()
             wakers = list(self._wakers.values())
         for w in wakers:
@@ -181,10 +197,12 @@ class QueryControl:
     # -- status -------------------------------------------------------------------
     @property
     def status(self) -> str:
-        """'ok' | 'cancelled' | 'deadline' | 'stalled' — the trace's
-        span status."""
+        """'ok' | 'cancelled' | 'deadline' | 'stalled' | 'drained' —
+        the trace's span status."""
         if not self.cancelled.is_set():
             return "ok"
+        if self._drained:
+            return "drained"
         if self._stalled:
             return "stalled"
         return "deadline" if self._deadline_hit else "cancelled"
@@ -210,6 +228,10 @@ class QueryControl:
         self.progress_seen = True
 
     def raise_(self) -> None:
+        if self._drained:
+            raise QueryDrained(
+                self.reason or f"{self.label} drained (service "
+                f"shutting down); resubmit against a sibling")
         if self._stalled:
             raise QueryStalled(
                 self.reason or f"watchdog declared {self.label} stalled")

@@ -50,7 +50,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..faults.recovery import QueryFaulted
 from .cancel import (QueryCancelled, QueryControl, QueryDeadlineExceeded,
-                     QueryStalled, scope as control_scope)
+                     QueryDrained, QueryStalled, scope as control_scope)
 
 __all__ = ["QueryRejected", "QueryHandle", "QueryScheduler"]
 
@@ -69,7 +69,7 @@ class _Entry:
     __slots__ = ("seq", "label", "fn", "control", "future", "cctx",
                  "status", "stats", "submitted_t", "started_t",
                  "finished_t", "deadline_s", "resubmits", "attempts",
-                 "worker_ident")
+                 "worker_ident", "thread")
 
     def __init__(self, seq: int, label: str, fn: Callable,
                  control: QueryControl,
@@ -96,8 +96,10 @@ class _Entry:
         self.resubmits = 0
         self.attempts: List[Dict] = []
         # the worker thread's ident (set at _run_entry): the watchdog's
-        # handle for live stack dumps of a stalled query
+        # handle for live stack dumps of a stalled query; the thread
+        # object itself is what drain()/close() join (with a timeout)
         self.worker_ident: Optional[int] = None
+        self.thread: Optional[threading.Thread] = None
 
 
 class QueryHandle:
@@ -140,11 +142,13 @@ class QueryHandle:
     @property
     def status(self) -> str:
         """queued | running | resubmitted | done | failed | faulted |
-        cancelled | deadline (``faulted`` = transient-fault recovery
-        exhausted — the :class:`..faults.recovery.QueryFaulted` from
-        :meth:`result` carries the fault history; ``resubmitted`` = a
-        permanent-at-this-placement failure was requeued and a fresh
-        attempt is pending/running)"""
+        cancelled | deadline | drained (``faulted`` = transient-fault
+        recovery exhausted — the :class:`..faults.recovery.QueryFaulted`
+        from :meth:`result` carries the fault history; ``resubmitted`` =
+        a permanent-at-this-placement failure was requeued and a fresh
+        attempt is pending/running; ``drained`` = the scheduler drained
+        for planned maintenance — the typed failure is resubmittable
+        and the retry belongs on a sibling)"""
         return self._entry.status
 
     @property
@@ -208,11 +212,13 @@ class QueryScheduler:
         self._vtime: Dict[str, float] = {}  # tenant -> virtual time
         self._seq = 0
         self._closed = False
+        self._draining = False
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
         self.cancelled = 0
         self.resubmitted = 0
+        self.drained = 0
         self._sem_listener_installed = False
         # dispatcher: pops admissible entries and starts worker threads;
         # queries themselves run in per-query copied contexts
@@ -267,6 +273,15 @@ class QueryScheduler:
         with self._cv:
             if self._closed:
                 raise QueryRejected("scheduler is closed")
+            if self._draining:
+                # admission stops FIRST during a graceful drain: the
+                # shed is typed so callers re-route to a sibling (or
+                # retry after the restart) instead of queueing behind a
+                # service that is leaving
+                self.rejected += 1
+                raise QueryRejected(
+                    "scheduler is draining (planned shutdown); "
+                    "resubmit against a sibling or retry after restart")
             if len(self._queue) >= max(0, depth):
                 self.rejected += 1
                 raise QueryRejected(
@@ -374,6 +389,7 @@ class QueryScheduler:
                                   args=(self._run_entry, entry),
                                   daemon=True,
                                   name=f"srt-query-{entry.label}")
+            entry.thread = th  # drain()/close() join it (timeout-bounded)
             th.start()
 
     def _max_concurrent(self) -> int:
@@ -403,6 +419,14 @@ class QueryScheduler:
                 # the unwind above already released permits/slots/handles
                 status = "faulted"
                 error = QueryFaulted("watchdog", str(exc),
+                                     resubmittable=True)
+                error.__cause__ = exc
+            except QueryDrained as exc:
+                # graceful drain caught this query past the deadline: it
+                # was healthy, the service is leaving — finish typed and
+                # resubmittable so the caller re-routes verbatim
+                status = "drained"
+                error = QueryFaulted("drain", str(exc),
                                      resubmittable=True)
                 error.__cause__ = exc
             except QueryDeadlineExceeded as exc:
@@ -443,6 +467,11 @@ class QueryScheduler:
         from ..utils import tracing
         from ..utils.metrics import QueryStats
         if not self._resubmittable(exc):
+            return False
+        if self._draining:
+            # a draining scheduler must not requeue work into itself —
+            # the typed resubmittable failure surfaces to the caller,
+            # whose retry belongs on a sibling
             return False
         limit = self._conf()["spark.rapids.tpu.faults.resubmit.max"]
         if e.resubmits >= max(0, limit):
@@ -500,6 +529,8 @@ class QueryScheduler:
             self.completed += 1
             if status in ("cancelled", "deadline"):
                 self.cancelled += 1
+            if status == "drained":
+                self.drained += 1
             self._cv.notify_all()
         if error is not None:
             e.future.set_exception(error)
@@ -556,7 +587,89 @@ class QueryScheduler:
                     "completed": self.completed,
                     "rejected": self.rejected,
                     "cancelled": self.cancelled,
-                    "resubmitted": self.resubmitted}
+                    "resubmitted": self.resubmitted,
+                    "drained": self.drained,
+                    "draining": self._draining}
+
+    # -- graceful drain ------------------------------------------------------------
+    def drain(self, deadline_s: Optional[float] = None) -> Dict[str, int]:
+        """Graceful drain for planned maintenance / rolling restart.
+
+        Three phases, in order: (1) admission STOPS — ``submit()``
+        sheds typed (:class:`QueryRejected`) and queued-but-unstarted
+        entries finish immediately as ``drained`` with a typed
+        resubmittable :class:`..faults.recovery.QueryFaulted`; (2)
+        RUNNING queries get until ``deadline_s`` (default
+        ``spark.rapids.tpu.server.drain.deadlineMs``) to finish
+        normally; (3) stragglers are cancelled-as-resubmittable (the
+        ``drain`` cancel flavor: unwind releases permits/slots/handles
+        exactly like any abort, the trace finishes ``drained``, the
+        caller's failure is typed + resubmittable).  Worker threads are
+        joined (timeout-bounded) so a drained scheduler leaves no
+        execution behind.  The scheduler stays OPEN but draining —
+        :meth:`resume` re-admits (the rolling-restart rehearsal), or
+        :meth:`close` finishes the shutdown."""
+        if deadline_s is None:
+            deadline_s = self._conf()[
+                "spark.rapids.tpu.server.drain.deadlineMs"] / 1000.0
+        with self._cv:
+            already = self._draining
+            self._draining = True
+            queued, self._queue = self._queue, []
+            self._cv.notify_all()
+        shed = 0
+        for e in queued:
+            e.status = "drained"
+            e.finished_t = _pc()
+            with self._cv:
+                self.drained += 1
+            tr = e.control.trace
+            if tr is not None and tr.t_end is None:
+                tr.set_status("drained")
+                tr.finish()
+            e.future.set_exception(QueryFaulted(
+                "drain", f"{e.label} shed before starting: scheduler "
+                f"draining; resubmit against a sibling",
+                resubmittable=True))
+            shed += 1
+        deadline = _pc() + max(0.0, deadline_s)
+        finished_in_time = 0
+        with self._cv:
+            baseline = len(self._running)
+            while self._running and _pc() < deadline:
+                self._cv.wait(timeout=min(
+                    0.25, max(0.01, deadline - _pc())))
+            stragglers = list(self._running)
+            finished_in_time = baseline - len(stragglers)
+        for e in stragglers:
+            e.control.cancel(
+                f"{e.label} drained: ran past the drain deadline "
+                f"({deadline_s:.1f}s); resubmit against a sibling",
+                drain=True)
+        # the cooperative cancel lands at the next batch boundary; give
+        # the unwinds a bounded window, then join every worker thread
+        with self._cv:
+            grace = _pc() + max(2.0, deadline_s * 0.25)
+            while self._running and _pc() < grace:
+                self._cv.wait(timeout=0.1)
+            leftover = list(self._running)
+        for e in stragglers + leftover:
+            th = e.thread
+            if th is not None and th is not threading.current_thread():
+                th.join(timeout=2.0)
+        return {"already_draining": int(already),
+                "shed_queued": shed,
+                "finished_in_time": finished_in_time,
+                "cancelled_as_resubmittable": len(stragglers),
+                "still_running": len(leftover)}
+
+    def resume(self) -> None:
+        """Re-open admission after :meth:`drain` — the in-place restart
+        half of a rolling restart (and what keeps a module-scoped test
+        scheduler reusable after a drain test)."""
+        with self._cv:
+            self._draining = False
+            self._cv.notify_all()
 
     def close(self, cancel_running: bool = True) -> None:
         """Shut down: shed the queue, optionally cancel in-flight

@@ -89,6 +89,7 @@ class QueryWatchdog:
 
     def close(self) -> None:
         self._stop.set()
+        self._thread.join(timeout=2.0)
 
     # -- the scan -----------------------------------------------------------------
     def _loop(self) -> None:

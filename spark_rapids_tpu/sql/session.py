@@ -438,6 +438,11 @@ class Session:
             # FAILURE (the scheduler finishes it faulted/resubmittable),
             # so the trace says faulted, not cancelled
             tr.set_status("faulted")
+        elif isinstance(exc, cancel.QueryDrained):
+            # graceful drain: the query was healthy, the service is
+            # leaving — the trace says so, and the scheduler surfaces a
+            # typed resubmittable failure the caller re-routes
+            tr.set_status("drained")
         elif isinstance(exc, cancel.QueryDeadlineExceeded):
             tr.set_status("deadline")
         elif isinstance(exc, cancel.QueryCancelled):

@@ -123,6 +123,11 @@ class QueryStats:
         self.fragments_recomputed_remote = 0
         self.partitions_reowned = 0
         self.queries_resubmitted = 0
+        # coordinator failovers this rank performed (re-dialed the
+        # deterministic successor after coordinator loss; the successor
+        # itself also counts its self-promotion) — epoch continuity plus
+        # this counter make a survived coordinator death attributable
+        self.coordinator_failovers = 0
         # gray-failure survival (faults/integrity.py, service/watchdog
         # .py, parallel/dcn.py hedging): checksum verifications that
         # FAILED (each one a silent-corruption event caught and routed

@@ -35,6 +35,12 @@ def main() -> None:
     ap.add_argument("--kill-after", type=int, default=1)
     ap.add_argument("--kill-mode", default="silent",
                     choices=["silent", "hard"])
+    ap.add_argument("--kill-point", default="peer",
+                    choices=["peer", "coordinator"],
+                    help="peer = dcn.peer_kill (the rank dies); "
+                         "coordinator = dcn.coordinator_kill (the rank "
+                         "AND the coordinator it hosts die — survivors "
+                         "must fail over to the standby)")
     ap.add_argument("--hb-interval", type=float, default=2.0)
     ap.add_argument("--hb-timeout", type=float, default=None)
     ap.add_argument("--wait-timeout", type=float, default=None)
@@ -69,11 +75,16 @@ def main() -> None:
     try:
         sess = srt.Session.get_or_create()
         if args.kill_rank == args.rank:
-            # deterministic peer kill: THIS rank dies at its Nth
-            # reduce-side shuffle op (the dcn.peer_kill injection point;
-            # re-armed from conf at every ExecContext like any schedule)
+            # deterministic kill: THIS rank dies at its Nth reduce-side
+            # shuffle op (the dcn.peer_kill / dcn.coordinator_kill
+            # injection point; re-armed from conf at every ExecContext
+            # like any schedule).  The coordinator point additionally
+            # takes the coordinator this rank hosts down with it.
+            point = ("dcn.coordinator_kill"
+                     if args.kill_point == "coordinator"
+                     else "dcn.peer_kill")
             sess.conf.set("spark.rapids.tpu.faults.inject.schedule",
-                          f"dcn.peer_kill:{args.kill_after}")
+                          f"{point}:{args.kill_after}")
             sess.conf.set("spark.rapids.tpu.dcn.kill.mode", args.kill_mode)
         df = sess.read_parquet(
             os.path.join(args.data, f"part-{args.rank}.parquet"))
@@ -131,10 +142,16 @@ def main() -> None:
         from spark_rapids_tpu.utils.metrics import QueryStats
         snap = QueryStats.process().snapshot()
         with open(f"{args.out}.stats.{args.rank}", "w") as f:
-            json.dump({k: snap[k] for k in
-                       ("peers_lost", "fragments_recomputed",
-                        "fragments_recomputed_remote",
-                        "partitions_reowned", "transient_retries")}, f)
+            json.dump({**{k: snap[k] for k in
+                          ("peers_lost", "fragments_recomputed",
+                           "fragments_recomputed_remote",
+                           "partitions_reowned", "transient_retries",
+                           "coordinator_failovers")},
+                       # epoch continuity is part of the failover
+                       # acceptance: survivors must agree on a bumped
+                       # epoch after the takeover
+                       "final_epoch": pg.epoch,
+                       "coord_rank": pg.coord_rank}, f)
         try:
             pg.barrier(allow_shrunk=True)  # outputs durable before exit
         except (PeerLostError, CoordinatorLostError):

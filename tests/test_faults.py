@@ -808,6 +808,21 @@ class TestChaosDifferential:
         finally:
             INJECTOR.arm()
             coord.close()
+        # dcn.coordinator_kill: the hosting rank's note_op kills the
+        # coordinator with the rank (silent mode: both freeze; the
+        # rank's own query unwinds typed — failover is the SURVIVORS'
+        # story, covered by tests/test_dcn_failures.py)
+        INJECTOR.arm(schedule="dcn.coordinator_kill:1")
+        coord2 = Coordinator(1)
+        try:
+            pg2 = ProcessGroup(0, 1, ("127.0.0.1", coord2.port),
+                               coordinator=coord2)
+            with pytest.raises(PeerLostError, match="coordinator"):
+                pg2.note_op()
+            pg2.close()
+        finally:
+            INJECTOR.arm()
+            coord2.close()
         # server.conn leg: the network front door's client drops
         # mid-result-stream (injected at the BATCH send) — the wire
         # query cancels cooperatively, the permit and the wire-query

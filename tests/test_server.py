@@ -719,3 +719,190 @@ class TestLoadgenSmall:
             report["p95_ms"] >= report["p50_ms"]
         assert report["prepared"]["hits"] > 0
         assert set(report["serial_ab"]) == set(loadgen.templates())
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain (ISSUE 10): zero-leak rolling-restart machinery
+# ---------------------------------------------------------------------------
+
+def _spool_files(s):
+    import os
+    conf = s._tpu_conf()
+    d = conf["spark.rapids.tpu.server.spool.dir"] or os.path.join(
+        conf["spark.rapids.tpu.memory.spill.dir"], "server_spool")
+    try:
+        return [n for n in os.listdir(d) if n.startswith("spool-")]
+    except OSError:
+        return []
+
+
+class TestDrainCleanup:
+    """PR 8's TestDisconnectCleanup discipline applied to PLANNED
+    shutdown: drain under active connections/queries leaks zero
+    permits, quota slots, spool files, or spill handles, and traces
+    finish with a ``drained`` status."""
+
+    def _door(self, s, tables, **settings):
+        door = SqlFrontDoor(s, settings=settings or None).start()
+        for name, f in tables.items():
+            door.register_table(name, f)
+        return door
+
+    @pytest.mark.parametrize("mode", ["quiesce", "straggler"])
+    def test_drain_releases_everything(self, wire, mode):
+        s, _shared, tables = wire
+        door = self._door(s, tables)
+        c = None
+        try:
+            if mode == "quiesce":
+                # in-flight queries finish inside the deadline; the
+                # still-open connection's NEXT request gets GOAWAY (no
+                # siblings advertised -> the typed DRAINING surfaces
+                # after the client's failover attempts find nobody)
+                c = WireClient("127.0.0.1", door.port)
+                assert c.query(AGG_SPEC, params=[500.0]).stats[
+                    "status"] == "done"
+                door.begin_drain()
+                with pytest.raises(WireError) as ei:
+                    c.query(AGG_SPEC, params=[500.0])
+                assert ei.value.code == "DRAINING"
+                rep = door.drain(deadline_s=10.0)
+                assert rep["in_flight_cancelled"] == 0
+            else:
+                # a query wedged mid-execution outlives the deadline:
+                # drain cancels it AS-RESUBMITTABLE (typed DRAINING on
+                # the wire; the trace finishes 'drained')
+                s.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                           "device.hang:1")
+                s.conf.set("spark.rapids.tpu.faults.watchdog.enabled",
+                           False)
+                s.conf.set("spark.rapids.tpu.sql.trace.enabled", True)
+                try:
+                    c = WireClient("127.0.0.1", door.port)
+                    it = c.query_stream(SCAN_SPEC)
+                    assert next(it)[0] == "meta"
+                    rep = door.drain(deadline_s=1.0)
+                    assert rep["in_flight_cancelled"] == 1
+                    with pytest.raises(WireError) as ei:
+                        for _ in it:
+                            pass
+                    assert ei.value.code == "DRAINING"
+                finally:
+                    s.conf.unset(
+                        "spark.rapids.tpu.faults.inject.schedule")
+                    s.conf.unset(
+                        "spark.rapids.tpu.faults.watchdog.enabled")
+                # the drained query's trace FINISHED, status 'drained'
+                deadline = time.monotonic() + 10
+                tr = None
+                while time.monotonic() < deadline:
+                    tr = s.last_trace()
+                    if tr is not None and tr.status == "drained" \
+                            and tr.t_end is not None:
+                        break
+                    time.sleep(0.05)
+                s.conf.unset("spark.rapids.tpu.sql.trace.enabled")
+                assert tr is not None and tr.status == "drained"
+                assert tr.t_end is not None
+            # the leak audit: permits, quota slots, wire registry,
+            # spool files, spill handles — all back
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline \
+                    and s.scheduler().running():
+                time.sleep(0.05)
+            assert s.scheduler().running() == 0
+            assert door.snapshot()["queries_inflight"] == 0
+            assert door.quotas.inflight() == 0
+            assert _spool_files(s) == []
+            get_catalog().assert_no_leaks()
+        finally:
+            if c is not None:
+                c.close()
+            door.close()
+
+    def test_goaway_failover_to_sibling(self, wire):
+        """The rolling-restart client contract: a GOAWAY names the
+        sibling; the SAME WireClient fails over, re-prepares from the
+        remembered spec (fingerprint-stable statement id), and returns
+        identical results."""
+        s, _shared, tables = wire
+        a = self._door(s, tables)
+        b = self._door(s, tables)
+        c = None
+        try:
+            c = WireClient("127.0.0.1", a.port)
+            sid = c.prepare(AGG_SPEC)["statement_id"]
+            expected = _norm(c.execute(sid, [500.0]).rows())
+            a.begin_drain(siblings=[("127.0.0.1", b.port)])
+            # prepared EXECUTE through the GOAWAY: fail over, re-prepare
+            r2 = c.execute(sid, [500.0])
+            assert _norm(r2.rows()) == expected
+            assert c.goaways_survived == 1
+            assert c.addr == ("127.0.0.1", b.port)
+            # ad-hoc SUBMIT keeps flowing on the sibling
+            assert _norm(c.query(AGG_SPEC, params=[500.0]).rows()) \
+                == expected
+            # finish the drain: nothing in flight on A, zero leaks
+            rep = a.drain(deadline_s=2.0,
+                          siblings=[("127.0.0.1", b.port)])
+            assert rep["in_flight_cancelled"] == 0
+            assert rep["goaways_sent"] >= 1
+            assert _await_clean(s, b)
+            assert a.quotas.inflight() == 0
+            assert b.quotas.inflight() == 0
+            get_catalog().assert_no_leaks()
+        finally:
+            if c is not None:
+                c.close()
+            b.close()
+            a.close()
+
+    def test_scheduler_drain_statuses_and_resume(self, wire):
+        """QueryScheduler.drain: queued entries shed 'drained' typed +
+        resubmittable, running stragglers cancelled-as-resubmittable,
+        and resume() re-admits (the in-place restart half)."""
+        from spark_rapids_tpu.faults import QueryFaulted
+        from spark_rapids_tpu.service import cancel as _cancel
+        from spark_rapids_tpu.service.scheduler import (QueryRejected,
+                                                        QueryScheduler)
+        s, _shared, _tables = wire
+        sched = QueryScheduler(
+            s, settings={"spark.rapids.tpu.sql.scheduler.maxConcurrent": 1})
+        try:
+            started = threading.Event()
+            release = threading.Event()
+
+            def straggler():
+                started.set()
+                # cooperative: wakes on the drain cancel, raises typed
+                ctl = _cancel.current()
+                ctl.cancelled.wait(timeout=60)
+                ctl.check()
+                return "finished"
+
+            h_run = sched.submit(straggler, label="drain-straggler")
+            assert started.wait(timeout=30)
+            h_q = sched.submit(lambda: "queued", label="drain-queued")
+            rep = sched.drain(deadline_s=0.5)
+            assert rep["shed_queued"] == 1
+            assert rep["cancelled_as_resubmittable"] == 1
+            assert rep["still_running"] == 0
+            with pytest.raises(QueryFaulted) as e_q:
+                h_q.result(timeout=10)
+            assert e_q.value.resubmittable
+            assert h_q.status == "drained"
+            with pytest.raises(QueryFaulted) as e_r:
+                h_run.result(timeout=10)
+            assert e_r.value.resubmittable
+            assert h_run.status == "drained"
+            # draining sheds typed at submit()
+            with pytest.raises(QueryRejected, match="draining"):
+                sched.submit(lambda: 1, label="after-drain")
+            assert sched.snapshot()["drained"] == 2
+            # resume: the in-place restart — admission flows again
+            sched.resume()
+            assert sched.submit(lambda: 41 + 1,
+                                label="resumed").result(timeout=30) == 42
+            release.set()
+        finally:
+            sched.close()

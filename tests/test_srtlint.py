@@ -421,11 +421,12 @@ class TestEngine:
         assert "lock-acquisition graph" in capsys.readouterr().out
         assert engine.main(["--explain", "nope"]) == 2
 
-    def test_explain_covers_all_eight_rules(self):
+    def test_explain_covers_all_nine_rules(self):
         rules = engine.available_rules()
         assert rules == ["blocking-fetch", "span-timing", "ctx-threads",
                          "cache-keys", "fault-paths", "release-paths",
-                         "lock-discipline", "conf-registry"]
+                         "lock-discipline", "shutdown-paths",
+                         "conf-registry"]
         for r in rules:
             assert r in engine.explain_rule(r)
 
@@ -437,7 +438,7 @@ class TestEngine:
 
 class TestRealTree:
     def test_full_tree_clean_and_within_wall_budget(self):
-        """Acceptance: all eight passes over the real tree, zero
+        """Acceptance: all nine passes over the real tree, zero
         unsuppressed findings, every suppression reasoned, inside a
         collection-time wall budget."""
         t0 = time.perf_counter()
@@ -471,3 +472,93 @@ class TestRealTree:
             doc = f.read()
         for line in TpuConf.help().splitlines():
             assert line in doc
+
+
+class TestShutdownPaths:
+    def test_unjoined_attr_thread_detected(self, tmp_path):
+        report = _lint(tmp_path, {"service/bad.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def start(self):\n"
+            "        self._th = threading.Thread(target=self._loop)\n"
+            "        self._th.start()\n"
+            "    def close(self):\n"
+            "        pass\n")}, ["shutdown-paths"])
+        assert [f.line for f in report.failing] == [4]
+        assert "never joined" in report.failing[0].message
+
+    def test_join_without_timeout_still_flagged(self, tmp_path):
+        """An unbounded join hangs the shutdown a wedged thread was
+        supposed to be bounded by."""
+        report = _lint(tmp_path, {"server/bad.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def start(self):\n"
+            "        self._th = threading.Thread(target=self._loop)\n"
+            "        self._th.start()\n"
+            "    def close(self):\n"
+            "        self._th.join()\n")}, ["shutdown-paths"])
+        assert [f.line for f in report.failing] == [4]
+
+    def test_no_handle_escape_detected_and_suppressed(self, tmp_path):
+        report = _lint(tmp_path, {"parallel/bad.py": (
+            "import threading\n"
+            "def fire(fn):\n"
+            "    threading.Thread(target=fn).start()\n"
+            "def ok(fn):\n"
+            "    threading.Thread(target=fn).start()  # srtlint: ignore[shutdown-paths] (hedge loser; socket timeout bounds it)\n")},
+            ["shutdown-paths"])
+        assert [f.line for f in report.failing] == [3]
+        assert "no handle escapes" in report.failing[0].message
+        assert len(report.suppressed) == 1
+
+    def test_container_append_joined_in_close_clean(self, tmp_path):
+        report = _lint(tmp_path, {"parallel/ok.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def start(self):\n"
+            "        t = threading.Thread(target=self._loop)\n"
+            "        t.start()\n"
+            "        self._threads.append(t)\n"
+            "    def close(self):\n"
+            "        for t in self._threads:\n"
+            "            t.join(timeout=2.0)\n")}, ["shutdown-paths"])
+        assert report.failing == []
+
+    def test_dict_store_and_aliased_values_loop_clean(self, tmp_path):
+        """The endpoint idiom: store into a dict, join through
+        ``list(self._conn_threads.values())`` — two levels of local
+        aliasing between the container and the join."""
+        report = _lint(tmp_path, {"server/ok.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def accept(self, cid):\n"
+            "        th = threading.Thread(target=self._conn)\n"
+            "        self._conn_threads[cid] = th\n"
+            "        th.start()\n"
+            "    def close(self):\n"
+            "        threads = list(self._conn_threads.values())\n"
+            "        for th in threads:\n"
+            "            th.join(timeout=2.0)\n")}, ["shutdown-paths"])
+        assert report.failing == []
+
+    def test_same_function_join_clean(self, tmp_path):
+        report = _lint(tmp_path, {"parallel/scatter.py": (
+            "import threading\n"
+            "def fan_out(fns):\n"
+            "    ts = []\n"
+            "    for fn in fns:\n"
+            "        t = threading.Thread(target=fn)\n"
+            "        ts.append(t)\n"
+            "        t.start()\n"
+            "    for t in ts:\n"
+            "        t.join(timeout=30)\n")}, ["shutdown-paths"])
+        assert report.failing == []
+
+    def test_outside_serving_layers_ignored(self, tmp_path):
+        report = _lint(tmp_path, {"runtime/bg.py": (
+            "import threading\n"
+            "def fire(fn):\n"
+            "    threading.Thread(target=fn).start()\n")},
+            ["shutdown-paths"])
+        assert report.failing == []

@@ -6,12 +6,22 @@ against an in-process :class:`spark_rapids_tpu.server.SqlFrontDoor`,
 exercising admission control, tenant quotas, the prepared-statement plan
 cache, result spooling, seeded ``server.conn`` connection faults, and
 cancellation TOGETHER — with every result checked against the in-process
-oracle and every latency recorded.
+oracle and every latency recorded (per-tenant p50/p95/p99 + log-bucket
+histograms in the report).
 
-Reports (JSON line + human summary): p50/p95/p99 latency, throughput,
-SLO violations, prepared-vs-fresh latency (the plan-cache win), prepared
-hit rate, shed/retry counts — and FAILS (exit 1) on any result mismatch
-or leaked permit/handle/quota.
+``--soak`` is the ZERO-DOWNTIME drill (ISSUE 10): a duration-bounded
+run against a FLEET of front doors with scripted rolling restarts
+(graceful drain + GOAWAY sibling advertisement + same-port restart),
+one coordinator kill + failover mid-run (thread-rank world=3, silent
+freeze — the worst case), and quota churn under live traffic.  Every
+result stays oracle-verified, a drain leak audit runs between phases,
+and the run FAILS on any mismatch, leak, unsurvived restart, or missing
+coordinator failover.
+
+Reports (JSON line + human summary): p50/p95/p99 latency (global and
+per tenant), throughput, SLO violations, prepared-vs-fresh latency (the
+plan-cache win), prepared hit rate, shed/retry/GOAWAY counts — and
+FAILS (exit 1) on any result mismatch or leaked permit/handle/quota.
 
 Usage::
 
@@ -19,9 +29,11 @@ Usage::
         [--tenants 8] [--rows 200000] [--prepared-frac 0.5]
         [--fault-rate 0.02] [--slow-frac 0.05] [--slo-ms 2000]
         [--seed 42] [--json PATH]
+    python tools/loadgen.py --soak [--soak-duration-s 60] [--doors 2]
 
-Environment fallbacks (the bench hook): SRT_LOADGEN_QUERIES,
-SRT_LOADGEN_CONNECTIONS, SRT_LOADGEN_FAULT_RATE, SRT_LOADGEN_SEED.
+Environment fallbacks (the bench hooks): SRT_LOADGEN_QUERIES,
+SRT_LOADGEN_CONNECTIONS, SRT_LOADGEN_FAULT_RATE, SRT_LOADGEN_SEED,
+SRT_SOAK_DURATION_S.
 """
 
 from __future__ import annotations
@@ -165,16 +177,19 @@ class Oracle:
 class Counters:
     def __init__(self):
         self.lock = threading.Lock()
-        self.latencies: List[Tuple[str, bool, float]] = []  # (tmpl, prepared, ms)
+        # (tmpl, prepared, ms, tenant)
+        self.latencies: List[Tuple[str, bool, float, str]] = []
         self.mismatches = 0
         self.errors: Dict[str, int] = {}
         self.conn_drops = 0
         self.retries = 0
         self.slow_streams = 0
+        self.goaways = 0
 
-    def record(self, tmpl: str, prepared: bool, ms: float) -> None:
+    def record(self, tmpl: str, prepared: bool, ms: float,
+               tenant: str) -> None:
         with self.lock:
-            self.latencies.append((tmpl, prepared, ms))
+            self.latencies.append((tmpl, prepared, ms, tenant))
 
     def error(self, kind: str) -> None:
         with self.lock:
@@ -189,10 +204,45 @@ def _pct(vals: List[float], q: float) -> float:
     return s[i]
 
 
-def _worker(wid: int, host: str, port: int, tenant: str, n_queries: int,
-            seed: int, prepared_frac: float, slow: bool, ctr: Counters,
-            oracle: Optional[Oracle], next_q, stop: threading.Event
-            ) -> None:
+# per-tenant latency histogram bucket upper bounds (ms, log-spaced)
+_HIST_BOUNDS = (5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+                2500.0, 5000.0)
+
+
+def tenant_histograms(latencies) -> Dict[str, dict]:
+    """Per-tenant p50/p95/p99 plus a log-bucket latency histogram —
+    the per-tenant brownout detector the soak mode reads (a restart
+    that starves ONE tenant shows up here even when the global
+    percentiles look healthy)."""
+    out: Dict[str, dict] = {}
+    for tenant in sorted({e[3] for e in latencies}):
+        vals = [e[2] for e in latencies if e[3] == tenant]
+        hist: Dict[str, int] = {}
+        for bound in _HIST_BOUNDS:
+            hist[f"<={bound:g}ms"] = sum(1 for v in vals if v <= bound)
+        hist[f">{_HIST_BOUNDS[-1]:g}ms"] = sum(
+            1 for v in vals if v > _HIST_BOUNDS[-1])
+        out[tenant] = {
+            "n": len(vals),
+            "p50_ms": round(_pct(vals, 0.5), 2),
+            "p95_ms": round(_pct(vals, 0.95), 2),
+            "p99_ms": round(_pct(vals, 0.99), 2),
+            "histogram": hist,
+        }
+    return out
+
+
+def print_tenant_report(per_tenant: Dict[str, dict]) -> None:
+    for tenant, h in sorted(per_tenant.items()):
+        print(f"[loadgen]   tenant {tenant}: n={h['n']} "
+              f"p50={h['p50_ms']}ms p95={h['p95_ms']}ms "
+              f"p99={h['p99_ms']}ms", file=sys.stderr)
+
+
+def _worker(wid: int, addrs: List[Tuple[str, int]], tenant: str,
+            n_queries: int, seed: int, prepared_frac: float, slow: bool,
+            ctr: Counters, oracle: Optional[Oracle], next_q,
+            stop: threading.Event) -> None:
     import numpy as np
 
     from spark_rapids_tpu.server import WireClient, WireError
@@ -201,11 +251,33 @@ def _worker(wid: int, host: str, port: int, tenant: str, n_queries: int,
     names = sorted(tmpls)
     client = None
     prepared_ids: Dict[str, str] = {}
+    primary = addrs[wid % len(addrs)]
 
     def connect():
+        """Fleet-aware dial: this worker's primary door first, then its
+        siblings (a door mid-restart is briefly down — the fleet keeps
+        serving), with a short backoff between sweeps."""
         nonlocal client, prepared_ids
-        client = WireClient(host, port, tenant=tenant, timeout=120.0)
-        prepared_ids = {}
+        if client is not None:
+            with ctr.lock:
+                ctr.goaways += client.goaways_survived
+            client = None
+        last = None
+        order = [primary] + [a for a in addrs if a != primary]
+        for sweep in range(30):
+            for addr in order:
+                if stop.is_set():
+                    raise ConnectionError("loadgen stopping")
+                try:
+                    client = WireClient(
+                        addr[0], addr[1], tenant=tenant, timeout=120.0,
+                        siblings=[a for a in addrs if a != addr])
+                    prepared_ids = {}
+                    return
+                except (OSError, WireError) as e:
+                    last = e
+            time.sleep(0.05 * (sweep + 1))  # fault-ok (paced fleet re-dial while a door restarts, not an exception-swallowing retry loop)
+        raise ConnectionError(f"no front door reachable: {last}")
 
     def attempt(name: str, spec: dict, params: list, use_prepared: bool):
         """One wire execution; returns (normalized rows, prepared_run,
@@ -235,7 +307,11 @@ def _worker(wid: int, host: str, port: int, tenant: str, n_queries: int,
             rs = client.query(spec, params=params)
         return _norm_rows(rs.rows()), rs.prepared, (_pc() - t0) * 1e3
 
-    connect()
+    try:
+        connect()
+    except (ConnectionError, OSError):
+        ctr.error("CONNECT_FAILED")
+        return
     while not stop.is_set():
         qi = next_q()
         if qi is None:
@@ -251,7 +327,7 @@ def _worker(wid: int, host: str, port: int, tenant: str, n_queries: int,
             try:
                 res_rows, prepared_run, ms = attempt(
                     name, spec, params, use_prepared)
-                ctr.record(name, prepared_run, ms)
+                ctr.record(name, prepared_run, ms, tenant)
                 if oracle is not None:
                     exp = oracle.expected(name, spec, params)
                     if exp != res_rows:
@@ -264,6 +340,18 @@ def _worker(wid: int, host: str, port: int, tenant: str, n_queries: int,
                 break
             except WireError as e:
                 ctr.error(e.code)
+                if e.code == "DRAINING":
+                    # drained mid-flight (or every failover candidate
+                    # was draining): reconnect — the fleet sweep lands
+                    # on a live sibling — and retry the SAME query
+                    with ctr.lock:
+                        ctr.retries += 1
+                    try:
+                        connect()
+                    except (ConnectionError, OSError):
+                        ctr.error("RECONNECT_FAILED")
+                        return
+                    continue
                 if e.code not in ("REJECTED", "QUOTA_EXCEEDED"):
                     break  # typed query failure: counted, not retried
                 with ctr.lock:
@@ -284,10 +372,13 @@ def _worker(wid: int, host: str, port: int, tenant: str, n_queries: int,
                 except OSError:
                     ctr.error("RECONNECT_FAILED")
                     return
-    try:
-        client.close()
-    except Exception:  # fault-ok (best-effort goodbye at drain)
-        pass
+    if client is not None:
+        with ctr.lock:
+            ctr.goaways += client.goaways_survived
+        try:
+            client.close()
+        except Exception:  # fault-ok (best-effort goodbye at drain)
+            pass
 
 
 def _collect_rows(tables) -> List[tuple]:
@@ -357,9 +448,9 @@ def run(args) -> dict:
     for i in range(args.connections):
         th = threading.Thread(
             target=_worker,
-            args=(i, "127.0.0.1", door.port, tenants[i], args.queries,
-                  args.seed, args.prepared_frac, i < n_slow, ctr, oracle,
-                  next_q, stop),
+            args=(i, [("127.0.0.1", door.port)], tenants[i],
+                  args.queries, args.seed, args.prepared_frac,
+                  i < n_slow, ctr, oracle, next_q, stop),
             daemon=True, name=f"loadgen-{i}")
         th.start()
         threads.append(th)
@@ -422,7 +513,7 @@ def run(args) -> dict:
     except AssertionError as e:
         leaks.append(f"spill handles: {e}")
 
-    lats = [ms for _, _, ms in ctr.latencies]
+    lats = [ms for _, _, ms, _ in ctr.latencies]
 
     def _warm(vals: List[float]) -> List[float]:
         # drop each group's cold head (first XLA compiles of a fresh
@@ -434,9 +525,10 @@ def run(args) -> dict:
     fresh, prep = [], []
     per_tmpl = {}
     for name in sorted(templates()):
-        f = _warm([ms for t, p, ms in ctr.latencies
+        f = _warm([ms for t, p, ms, _ in ctr.latencies
                    if t == name and not p])
-        pr = _warm([ms for t, p, ms in ctr.latencies if t == name and p])
+        pr = _warm([ms for t, p, ms, _ in ctr.latencies
+                    if t == name and p])
         fresh += f
         prep += pr
         per_tmpl[name] = {
@@ -459,6 +551,7 @@ def run(args) -> dict:
         "fresh_p50_ms": round(_pct(fresh, 0.5), 2),
         "prepared_p50_ms": round(_pct(prep, 0.5), 2),
         "per_template": per_tmpl,
+        "per_tenant": tenant_histograms(ctr.latencies),
         "serial_ab": serial_ab,
         "prepared": snap["prepared"],
         "mismatches": ctr.mismatches,
@@ -470,6 +563,290 @@ def run(args) -> dict:
         "spooled_bytes": snap["spooled_bytes"],
         "streamed_bytes": snap["streamed_bytes"],
         "scheduler": snap["scheduler"],
+        "leaks": leaks,
+        "verified": oracle is not None,
+    }
+    return report
+
+
+# ---------------------------------------------------------------------------------
+# Soak mode: rolling restarts + coordinator failover + quota churn (ISSUE 10)
+# ---------------------------------------------------------------------------------
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _coordinator_failover_drill(leaks: List[str]) -> dict:
+    """The soak's control-plane leg: a thread-rank world=3 DcnShuffle
+    whose COORDINATOR HOST dies silently (coordinator + peer server
+    frozen) mid-reduce.  Survivors must fail over to the standby,
+    re-pull the dead rank's fragments durably, adopt its partitions,
+    and produce the complete row set — verified against the exact
+    expected count, with the failover attributable in stats."""
+    import tempfile
+    import threading as _th
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.parallel.dcn import (Coordinator, DcnShuffle,
+                                               ProcessGroup)
+    from spark_rapids_tpu.utils.metrics import QueryStats
+    TpuConf.set_session("spark.rapids.tpu.dcn.heartbeatTimeout", 1.0)
+    world, n_parts, rows_per = 3, 6, 32
+    tmp = tempfile.mkdtemp(prefix="srt_soak_coord_")
+    coord = Coordinator(world, heartbeat_timeout=1.0, wait_timeout=60.0)
+    pgs = [None] * world
+    t0 = _pc()
+    try:
+        def mk(r):
+            pgs[r] = ProcessGroup(
+                r, world, ("127.0.0.1", coord.port),
+                coordinator=coord if r == 0 else None,
+                heartbeat_interval=0.1)
+
+        ts = [_th.Thread(target=mk, args=(r,)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        shuffles = [DcnShuffle(pg, n_parts,
+                               os.path.join(tmp, f"r{pg.rank}"))
+                    for pg in pgs]
+        for rank, sh in enumerate(shuffles):
+            for p in range(n_parts):
+                sh.write_partition(p, pa.table(
+                    {"r": [rank] * rows_per, "p": [p] * rows_per}))
+        ts = [_th.Thread(target=sh.commit) for sh in shuffles]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        before = QueryStats.get().snapshot()
+        # the coordinator host dies SILENTLY mid-reduce: worst case —
+        # detection is purely liveness timeouts
+        pgs[0]._closed = True
+        pgs[0]._server.freeze()
+        coord.freeze()
+        rows = 0
+        survivors = [1, 2]
+        results = {}
+
+        def reduce_rank(r):
+            n = 0
+            for p in shuffles[r].my_parts():
+                n += sum(t_.num_rows
+                         for t_ in shuffles[r].read_partition(p))
+            for p in shuffles[r].adopt_orphans():
+                n += sum(t_.num_rows
+                         for t_ in shuffles[r].read_partition(p))
+            results[r] = n
+            # close is a COLLECTIVE (barrier over the alive membership):
+            # every survivor closes from its own rank thread
+            shuffles[r].close()
+
+        ts = [_th.Thread(target=reduce_rank, args=(r,))
+              for r in survivors]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        rows = sum(results.get(r, 0) for r in survivors)
+        d = QueryStats.delta_since(before)
+        complete = rows == world * n_parts * rows_per
+        if not complete:
+            leaks.append(f"coordinator drill incomplete: {rows} rows")
+        if d.get("coordinator_failovers", 0) < 1:
+            leaks.append("coordinator drill: no failover recorded")
+        return {"coordinator_failovers":
+                d.get("coordinator_failovers", 0),
+                "drill_rows_complete": complete,
+                "drill_recovery_s": round(_pc() - t0, 3),
+                "fragments_recomputed_remote":
+                d.get("fragments_recomputed_remote", 0),
+                "partitions_reowned": d.get("partitions_reowned", 0)}
+    finally:
+        for pg in pgs:
+            if pg is not None:
+                try:
+                    pg.close()
+                except Exception:  # fault-ok (chaos drill teardown of a frozen rank)
+                    pass
+        TpuConf.unset_session("spark.rapids.tpu.dcn.heartbeatTimeout")
+
+
+def run_soak(args) -> dict:
+    """Duration-bounded zero-downtime soak: a fleet of front doors on
+    FIXED ports under sustained zipf load, each door rolling-restarted
+    once (graceful drain -> GOAWAY naming siblings -> same-port
+    restart), one coordinator kill + failover mid-run, and quota churn
+    — every result oracle-verified, a drain leak audit between phases.
+    """
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.memory.spill import get_catalog
+    from spark_rapids_tpu.server import SqlFrontDoor
+
+    sess = srt.Session.get_or_create()
+    sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 50_000)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.maxConcurrent", 4)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.queueDepth", 256)
+    sess.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+
+    orders, customers = build_tables(args.rows, args.seed)
+    tables = {"orders": lambda: sess.create_dataframe(orders),
+              "customers": lambda: sess.create_dataframe(customers)}
+    oracle = Oracle(sess, tables) if not args.no_verify else None
+    ctr = Counters()
+    leaks: List[str] = []
+
+    n_doors = max(2, args.doors)
+    ports = [_free_port() for _ in range(n_doors)]
+    addrs = [("127.0.0.1", p) for p in ports]
+
+    def start_door(port: int) -> "SqlFrontDoor":
+        door = SqlFrontDoor(sess, settings={
+            "spark.rapids.tpu.server.port": port,
+            "spark.rapids.tpu.server.tenantQuotas": args.tenant_quotas,
+            "spark.rapids.tpu.server.spool.memoryBytes": 1 << 20,
+        }).start()
+        for name, factory in tables.items():
+            door.register_table(name, factory)
+        return door
+
+    doors = [start_door(p) for p in ports]
+
+    def restart_door(i: int) -> dict:
+        """One rolling restart: drain (GOAWAY names the siblings),
+        audit the DRAINED door for leaks — live siblings legitimately
+        hold in-flight quota, so the between-phases audit scopes to
+        what just shut down — then restart on the same port."""
+        old = doors[i]
+        siblings = [a for j, a in enumerate(addrs) if j != i]
+        rep = old.drain(deadline_s=args.drain_deadline_s,
+                        siblings=siblings, linger_s=0.5)
+        if rep["in_flight_leftover"]:
+            leaks.append(f"restart {i}: {rep['in_flight_leftover']} "
+                         f"wire queries survived the drain")
+        if old.quotas.inflight() != 0:
+            leaks.append(f"restart {i}: drained door leaked "
+                         f"{old.quotas.inflight()} quota slots")
+        if old.snapshot()["queries_inflight"] != 0:
+            leaks.append(f"restart {i}: drained door leaked wire "
+                         f"queries")
+        doors[i] = start_door(ports[i])
+        return rep
+
+    # zipf-skewed tenant assignment, duration-bounded issue counter
+    rng = np.random.default_rng(args.seed)
+    z = np.clip(rng.zipf(1.5, args.connections), 1, args.tenants)
+    tenants = [f"tenant-{int(v)}" for v in z]
+    deadline = _pc() + args.soak_duration_s
+    issued = [0]
+    iss_lock = threading.Lock()
+
+    def next_q():
+        if _pc() >= deadline:
+            return None
+        with iss_lock:
+            issued[0] += 1
+            return issued[0]
+
+    stop = threading.Event()
+    n_slow = max(0, int(round(args.slow_frac * args.connections)))
+    threads = []
+    t_start = _pc()
+    for i in range(args.connections):
+        th = threading.Thread(
+            target=_worker,
+            args=(i, addrs, tenants[i], 0, args.seed,
+                  args.prepared_frac, i < n_slow, ctr, oracle, next_q,
+                  stop),
+            daemon=True, name=f"soak-{i}")
+        th.start()
+        threads.append(th)
+
+    # the scripted timeline, as fractions of the soak duration: one
+    # rolling restart per door, quota churn around the middle, the
+    # coordinator kill + failover in the back half
+    dur = args.soak_duration_s
+    restarts = 0
+    quota_churns = 0
+    drill = {}
+
+    def sleep_until(frac: float) -> None:
+        t = t_start + dur * frac
+        while _pc() < t and not stop.is_set():
+            time.sleep(min(0.2, max(0.01, t - _pc())))
+
+    sleep_until(0.20)
+    restart_door(0)
+    restarts += 1
+    sleep_until(0.40)
+    # quota churn: tighten every door's caps in place under live
+    # traffic (workers absorb typed QUOTA_EXCEEDED sheds and retry)
+    for door in doors:
+        door.quotas.reconfigure("*=1")
+    quota_churns += 1
+    sleep_until(0.55)
+    for door in doors:
+        door.quotas.reconfigure(args.tenant_quotas)
+    quota_churns += 1
+    if n_doors > 1:
+        restart_door(1)
+        restarts += 1
+    sleep_until(0.75)
+    drill = _coordinator_failover_drill(leaks)
+
+    for th in threads:
+        th.join(timeout=args.timeout)
+    stop.set()
+    wall_s = _pc() - t_start
+
+    # final drain of the whole fleet + leak audit
+    deadline2 = time.time() + 30
+    while time.time() < deadline2 and (
+            sess.scheduler().running()
+            or any(d.snapshot()["queries_inflight"] for d in doors)):
+        time.sleep(0.1)
+    if sess.scheduler().running() != 0:
+        leaks.append(f"scheduler running={sess.scheduler().running()}")
+    for i, door in enumerate(doors):
+        if door.quotas.inflight() != 0:
+            leaks.append(f"final: door {i} quota inflight="
+                         f"{door.quotas.inflight()}")
+    for door in doors:
+        door.drain(deadline_s=5.0, siblings=[])
+    try:
+        get_catalog().assert_no_leaks()
+    except AssertionError as e:
+        leaks.append(f"final: spill handles: {e}")
+    lats = [ms for _, _, ms, _ in ctr.latencies]
+    report = {
+        "soak_rolling_restart": 1,
+        "soak_duration_s": args.soak_duration_s,
+        "queries_completed": len(lats),
+        "connections": args.connections,
+        "doors": n_doors,
+        "wall_s": round(wall_s, 2),
+        "throughput_qps": round(len(lats) / wall_s, 2) if wall_s else 0,
+        "p50_ms": round(_pct(lats, 0.5), 2),
+        "p95_ms": round(_pct(lats, 0.95), 2),
+        "p99_ms": round(_pct(lats, 0.99), 2),
+        "per_tenant": tenant_histograms(ctr.latencies),
+        "restarts_survived": restarts,
+        "quota_churns": quota_churns,
+        **drill,
+        "goaways_survived": ctr.goaways,
+        "conn_drops_client": ctr.conn_drops,
+        "retries": ctr.retries,
+        "typed_errors": ctr.errors,
+        "mismatches": ctr.mismatches,
         "leaks": leaks,
         "verified": oracle is not None,
     }
@@ -498,7 +875,39 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument("--no-verify", action="store_true")
     ap.add_argument("--json", default="")
+    # soak mode (ISSUE 10): rolling restarts + coordinator failover +
+    # quota churn under duration-bounded sustained load
+    ap.add_argument("--soak", action="store_true")
+    ap.add_argument("--soak-duration-s", type=float,
+                    default=float(env.get("SRT_SOAK_DURATION_S", "60")))
+    ap.add_argument("--doors", type=int, default=2)
+    ap.add_argument("--drain-deadline-s", type=float, default=10.0)
     args = ap.parse_args(argv)
+
+    if args.soak:
+        report = run_soak(args)
+        line = json.dumps(report, sort_keys=True)
+        print(line)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(line + "\n")
+        ok = (not report["leaks"] and report["mismatches"] == 0
+              and report["restarts_survived"] >= 2
+              and report.get("coordinator_failovers", 0) >= 1
+              and report["queries_completed"] > 0)
+        print(f"[loadgen] SOAK {report['queries_completed']} queries / "
+              f"{report['wall_s']}s ({report['throughput_qps']} qps)  "
+              f"restarts={report['restarts_survived']} "
+              f"coordinator_failovers="
+              f"{report.get('coordinator_failovers', 0)} "
+              f"quota_churns={report['quota_churns']}  "
+              f"goaways={report['goaways_survived']} "
+              f"drops={report['conn_drops_client']} "
+              f"retries={report['retries']}  "
+              f"mismatches={report['mismatches']}  "
+              f"leaks={report['leaks'] or 'none'}", file=sys.stderr)
+        print_tenant_report(report["per_tenant"])
+        return 0 if ok else 1
 
     report = run(args)
     line = json.dumps(report, sort_keys=True)
@@ -529,6 +938,7 @@ def main(argv=None) -> int:
         print(f"[loadgen]   serial A/B {name}: prepared "
               f"{ab['prepared_p50_ms']}ms vs fresh {ab['fresh_p50_ms']}ms"
               f" ({ab['speedup']:.2f}x)", file=sys.stderr)
+    print_tenant_report(report["per_tenant"])
     return 0 if ok else 1
 
 

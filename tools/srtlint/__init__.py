@@ -4,7 +4,7 @@ Replaces the five standalone line-regex scanners (``tools/check_*.py``,
 removed) with ONE engine that parses ``spark_rapids_tpu/`` + ``tools/``
 once into ASTs — import/alias resolution, per-line comment maps, and a
 per-function CFG-lite (:mod:`.cfg`) ride on the shared parse — and runs
-all eight passes over the shared tree:
+all nine passes over the shared tree:
 
   ================  ==============================================
   rule              invariant
@@ -18,6 +18,9 @@ all eight passes over the shared tree:
                     released via finally/with on all exit edges
   lock-discipline   no blocking call under a lock; no acquisition-
                     order cycles in the lock graph
+  shutdown-paths    threads started in server/, service/, parallel/
+                    are joined (with a timeout) on a close()/drain()
+                    exit edge
   conf-registry     every spark.rapids.tpu.* literal resolves through
                     config.py registration and docs/configs.md
   ================  ==============================================

@@ -33,7 +33,7 @@ DEFAULT_ROOTS = ("spark_rapids_tpu", "tools")
 
 # engine version participates in the disk-cache key: a pass change
 # invalidates cached verdicts even when the tree itself is untouched
-ENGINE_VERSION = "1.0"
+ENGINE_VERSION = "1.1"
 
 _IGNORE = re.compile(
     r"#\s*srtlint:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(\(([^)]*)\))?")
@@ -297,9 +297,10 @@ class LintTree:
 def _load_passes():
     from .passes import (blocking_fetch, cache_keys, conf_registry,
                          ctx_threads, fault_paths, lock_discipline,
-                         release_paths, span_timing)
+                         release_paths, shutdown_paths, span_timing)
     return [blocking_fetch, span_timing, ctx_threads, cache_keys,
-            fault_paths, release_paths, lock_discipline, conf_registry]
+            fault_paths, release_paths, lock_discipline,
+            shutdown_paths, conf_registry]
 
 
 def available_rules() -> List[str]:

@@ -1,0 +1,198 @@
+"""shutdown-paths: threads started in the serving layers are joined
+(with a timeout) on a close()/drain() exit edge."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+RULE = "shutdown-paths"
+TITLE = ("threads started in server/, service/, and parallel/ are "
+         "joined (with a timeout) on a close()/drain() exit edge")
+EXPLAIN = """
+Graceful drain and rolling restarts (ISSUE 10) promise "no execution
+left behind": every ``threading.Thread`` the serving layers start —
+accept loops, connection handlers, heartbeats, dispatchers, journal
+pushers, per-query workers — must be ``join``ed (WITH a timeout, so a
+wedged thread bounds the shutdown instead of hanging it) somewhere on
+a ``close()`` / ``drain()`` / ``stop()`` / ``shutdown()`` exit edge.
+A daemon thread that nobody joins can still be mid-write to a socket,
+a spool file, or the membership journal when the process is torn down
+— exactly the shutdown race a zero-downtime restart cannot afford.
+
+The pass tracks where each created thread's HANDLE goes:
+
+  * ``self.x = threading.Thread(...)`` — joined as ``self.x.join(
+    timeout=...)``;
+  * appended/stored into a container (``self.xs.append(t)``,
+    ``self.xs[k] = t``, ``other.attr = t``) — joined by iterating that
+    container (``for t in self.xs: t.join(timeout=...)``, including
+    through one level of local aliasing like ``ts = list(
+    self.xs.values())``);
+  * a local joined in the SAME function (scatter/gather helpers) is
+    fine wherever it lives;
+  * a thread constructed and ``.start()``ed without any handle can
+    never be joined — flagged outright.
+
+Suppress deliberately-abandoned threads (a hedge loser, a zombie the
+watchdog reclaimed around) with ``# srtlint: ignore[shutdown-paths]
+(<who bounds this thread's lifetime instead>)``.
+"""
+
+_DIRS = ("server", "service", "parallel")
+_EXIT_WORDS = ("close", "drain", "stop", "shutdown", "__exit__",
+               "__del__", "join")
+_UNWRAP_CALLS = {"list", "tuple", "sorted", "set", "reversed"}
+_CONTAINER_METHODS = {"values", "keys", "items", "copy", "get"}
+
+
+def _expr_basis(node: ast.AST) -> Optional[str]:
+    """The attribute/name a handle expression is rooted in:
+    ``self._conn_threads.values()`` -> ``_conn_threads``,
+    ``list(self._threads)`` -> ``_threads``, ``t`` -> ``t``."""
+    while True:
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _UNWRAP_CALLS and node.args:
+                node = node.args[0]
+                continue
+            node = node.func
+            continue
+        if isinstance(node, ast.Attribute):
+            if node.attr in _CONTAINER_METHODS:
+                node = node.value
+                continue
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+
+def _local_resolver(func: ast.AST):
+    """name -> basis resolution from simple assignments and for-loops
+    in ``func``, with chain resolution (``th`` <- ``threads`` <-
+    ``self._conn_threads``).  A name bound BOTH ways (the scatter/
+    gather idiom reuses ``t`` as creation var and join-loop var)
+    resolves through the FOR binding first — a ``t.join()`` inside
+    ``for t in ts:`` is about the container, not the constructor."""
+    for_map: Dict[str, str] = {}
+    assign_map: Dict[str, str] = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            basis = _expr_basis(node.value)
+            if basis and basis != node.targets[0].id:
+                assign_map[node.targets[0].id] = basis
+        elif isinstance(node, ast.For) \
+                and isinstance(node.target, ast.Name):
+            basis = _expr_basis(node.iter)
+            if basis and basis != node.target.id:
+                for_map[node.target.id] = basis
+
+    def resolve(name: Optional[str]) -> Optional[str]:
+        seen = set()
+        while name not in seen:
+            seen.add(name)
+            if name in for_map:
+                name = for_map[name]
+            elif name in assign_map:
+                name = assign_map[name]
+            else:
+                break
+        return name
+
+    return resolve
+
+
+def _join_has_timeout(call: ast.Call) -> bool:
+    return bool(call.args) or any(kw.arg == "timeout"
+                                  for kw in call.keywords)
+
+
+def _joins_in(func: ast.AST) -> Set[str]:
+    """Basis names joined WITH a timeout inside ``func``."""
+    resolve = _local_resolver(func)
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" \
+                and _join_has_timeout(node):
+            basis = resolve(_expr_basis(node.func.value))
+            if basis:
+                out.add(basis)
+    return out
+
+
+def _creation_handle(sf, call: ast.Call) -> Optional[str]:
+    """Where the created thread's handle ends up: an attribute name, a
+    container attribute, or None (no handle escapes)."""
+    stmt = sf.statement_of(call)
+    local: Optional[str] = None
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        target = stmt.targets[0]
+        if isinstance(target, ast.Attribute):
+            return target.attr
+        if isinstance(target, ast.Name):
+            local = target.id
+    if local is None:
+        return None
+    func = sf.enclosing_function(call)
+    if func is None:
+        return local
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("append", "add") \
+                and any(isinstance(a, ast.Name) and a.id == local
+                        for a in node.args):
+            return _expr_basis(node.func.value)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == local:
+            t2 = node.targets[0]
+            if isinstance(t2, ast.Attribute):
+                return t2.attr
+            if isinstance(t2, ast.Subscript):
+                return _expr_basis(t2.value)
+    return local
+
+
+def run(tree) -> List:
+    findings = []
+    for sf in tree.files:
+        if not tree.in_dirs(sf, _DIRS):
+            continue
+        # module-wide join evidence: joins (with timeout) inside any
+        # shutdown-shaped function
+        joined: Set[str] = set()
+        funcs = [n for n in ast.walk(sf.tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        for fn in funcs:
+            if any(w in fn.name for w in _EXIT_WORDS):
+                joined |= _joins_in(fn)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if sf.call_qualname(node) != "threading.Thread":
+                continue
+            handle = _creation_handle(sf, node)
+            enclosing = sf.enclosing_function(node)
+            if handle is not None and enclosing is not None \
+                    and handle in _joins_in(enclosing):
+                continue  # started and joined in the same function
+            if handle is not None and handle in joined:
+                continue  # joined on a close()/drain() exit edge
+            what = (f"handle {handle!r} is never joined"
+                    if handle is not None
+                    else "no handle escapes the creation — it can "
+                         "never be joined")
+            findings.append(tree.finding(
+                sf, node, RULE,
+                f"thread started in the serving layers but {what} "
+                f"with a timeout on a close()/drain() exit edge — "
+                f"join it during shutdown, or mark a deliberately "
+                f"abandoned thread '# srtlint: "
+                f"ignore[shutdown-paths] (<reason>)'"))
+    return findings
