@@ -63,6 +63,15 @@ result oracle-verified, drain leak audits between phases, emitted as a
 soak_rolling_restart JSON line ahead of the suite numbers;
 SRT_SOAK_DURATION_S caps the duration at <=120 s, SRT_BENCH_QUERIES=""
 makes the run soak-only),
+SRT_BENCH_OVERLOAD=1 (overload-survival drill via tools/loadgen.py
+--overload: closed-loop capacity probe, then an open-loop offered-load
+ramp to ~5x capacity with per-query deadlines — the admission layer's
+cost-model packing, doomed/overload shedding, and AIMD concurrency
+control must hold goodput >= 0.85x capacity with every shed typed
+(reason + retry_after_ms); emitted as an overload_survival JSON line
+next to the soak line; SRT_OVERLOAD_DURATION_S caps the ramp,
+SRT_OVERLOAD_ADMISSION_OFF=1 runs the static-permit A/B,
+SRT_BENCH_QUERIES="" makes the run overload-only),
 SRT_BENCH_KILL_PEER=1 (killed-peer drill: a world=2 DcnShuffle over
 thread ranks commits on both sides, then rank 1 dies SILENTLY
 mid-reduce — the drill prints a dcn_killed_peer_recovery JSON line with
@@ -555,6 +564,15 @@ def main() -> None:
         print(json.dumps(_soak_drill()), flush=True)
         if os.environ.get("SRT_BENCH_QUERIES", None) == "":
             return  # soak-only invocation
+    if os.environ.get("SRT_BENCH_OVERLOAD", "0") == "1":
+        # overload-survival drill: offered-load ramp to ~5x measured
+        # capacity through the front door — goodput plateau ratio,
+        # typed shed taxonomy, admitted p99 (tools/loadgen.py
+        # --overload) — emitted as an overload_survival JSON line
+        # next to the soak line
+        print(json.dumps(_overload_drill()), flush=True)
+        if os.environ.get("SRT_BENCH_QUERIES", None) == "":
+            return  # overload-only invocation
     if os.environ.get("SRT_BENCH_LOADGEN", "0") == "1":
         # serving-traffic proxy: drive the sustained-load harness
         # (tools/loadgen.py — wire queries over TCP through the network
@@ -623,6 +641,7 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         env.pop("SRT_BENCH_KILL_PEER", None)  # drill ran once, up top
         env.pop("SRT_BENCH_LOADGEN", None)    # ditto the loadgen drill
         env.pop("SRT_BENCH_SOAK", None)       # ditto the soak drill
+        env.pop("SRT_BENCH_OVERLOAD", None)   # ditto the overload drill
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
@@ -672,6 +691,38 @@ def _soak_drill() -> dict:
     try:
         rep = _lg.run_soak(args)
         rep["metric"] = "soak_rolling_restart"
+        return rep
+    finally:
+        import spark_rapids_tpu as _srt
+        _srt.Session.reset()
+
+
+def _overload_drill() -> dict:
+    """SRT_BENCH_OVERLOAD=1: the overload-survival drill via
+    tools/loadgen.py --overload — capacity probe, then an open-loop
+    offered-load ramp to ~5x capacity with per-query deadlines;
+    emitted as an ``overload_survival`` JSON line (goodput plateau
+    ratio, shed counts by typed reason, admitted p99, spill events,
+    AIMD target) so the trajectory file tracks overload behavior."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import argparse
+
+    import loadgen as _lg
+    args = argparse.Namespace(
+        connections=8, tenants=8, rows=60_000,
+        seed=int(os.environ.get("SRT_LOADGEN_SEED", "42")),
+        timeout=600.0,
+        overload=True,
+        overload_duration_s=min(60.0, float(
+            os.environ.get("SRT_OVERLOAD_DURATION_S", "24"))),
+        capacity_probe_s=6.0, overload_steps="1,2,3.5,5",
+        overload_deadline_ms=800, plateau_min=0.85,
+        admission_off=os.environ.get("SRT_OVERLOAD_ADMISSION_OFF",
+                                     "0") == "1")
+    try:
+        rep = _lg.run_overload(args)
+        rep["metric"] = "overload_survival"
         return rep
     finally:
         import spark_rapids_tpu as _srt

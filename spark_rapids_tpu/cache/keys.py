@@ -128,8 +128,12 @@ def statement_fingerprint(spec) -> str:
     structurally identical specs share one plan-cache entry, and
     parameter slots (``["param", i, type]``) are structural — the bound
     values never enter the key (they bind at execution, exprs.ParamExpr).
-    The server's prepared-statement cache (server/prepared.py) is the
-    only consumer."""
+    Two consumers share the rule: the server's prepared-statement cache
+    (server/prepared.py), and the predictive-admission cost model
+    (service/admission.py) — the front door derives the SAME
+    fingerprint for ad-hoc SUBMIT specs, so a recurring statement
+    converges on one EWMA cost profile whether or not it was PREPAREd,
+    and an EXECUTE and an equivalent SUBMIT feed the same profile."""
     import hashlib
     import json
     canon = json.dumps(spec, sort_keys=True, separators=(",", ":"),

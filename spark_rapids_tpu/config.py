@@ -167,6 +167,116 @@ SCHED_DEADLINE_MS = register(
     "slots, and spill handles.",
     check=lambda v: None if v >= 0 else "must be >= 0")
 
+ADMISSION_ENABLED = register(
+    "spark.rapids.tpu.sql.scheduler.admission.enabled", True,
+    "Predictive admission control (service/admission.py): the scheduler "
+    "keeps an EWMA cost profile per statement fingerprint (runtime, "
+    "device-byte footprint, spill events, fed from QueryStats at query "
+    "completion) and packs concurrency against ESTIMATED memory instead "
+    "of counting permits — a heavy recurring statement consumes more "
+    "admission budget than a point lookup. Also enables deadline-aware "
+    "queue shedding (entries whose remaining deadline is below their "
+    "predicted runtime are shed typed 'doomed' instead of burning "
+    "device time they cannot use) and the AIMD adaptive-concurrency "
+    "controller. Queries without a fingerprint — in-process DataFrame "
+    "submissions — and unknown fingerprints fall back to the static "
+    "permit behavior exactly; false is the A/B kill switch restoring "
+    "pre-admission behavior everywhere.")
+
+ADMISSION_EWMA_ALPHA = register(
+    "spark.rapids.tpu.sql.scheduler.admission.ewmaAlpha", 0.3,
+    "EWMA smoothing factor for the per-fingerprint cost profiles "
+    "(runtime, device bytes, spill events): profile = alpha * observed "
+    "+ (1 - alpha) * profile. Higher adapts faster to drifting "
+    "statement costs; lower resists one-off outliers.", conv=float,
+    check=lambda v: None if 0.0 < v <= 1.0 else "must be in (0, 1]")
+
+ADMISSION_DEVICE_BUDGET = register(
+    "spark.rapids.tpu.sql.scheduler.admission.deviceBudgetBytes", 0,
+    "Device-byte budget the predictive admission layer packs predicted "
+    "query footprints into (0 = derive from the spill catalog's device "
+    "budget). A query whose fingerprint predicts a footprint that does "
+    "not fit beside the already-reserved in-flight predictions WAITS in "
+    "the queue even when a semaphore permit is free — fewer concurrent "
+    "heavy queries means fewer spill-degrades at equal maxConcurrent. "
+    "At least one query is always admitted (no deadlock on a "
+    "single over-budget statement).", conv=int,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+ADMISSION_MAX_QUEUE_DELAY_MS = register(
+    "spark.rapids.tpu.sql.scheduler.admission.maxQueueDelayMs", 0.0,
+    "Submit-time overload shed: when the estimated queue drain time "
+    "(queued entries x EWMA runtime / effective concurrency) exceeds "
+    "this bound, submit() sheds immediately with a typed QueryRejected "
+    "(reason 'overload') carrying a retry_after_ms hint, instead of "
+    "queueing work that will rot past its deadline. 0 disables (the "
+    "queueDepth bound still applies). The overload loadgen sets this "
+    "to keep the queue honest at 5x offered load.", conv=float,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+ADMISSION_AIMD_FLOOR = register(
+    "spark.rapids.tpu.sql.scheduler.admission.aimd.floor", 1,
+    "Lower bound on the AIMD controller's effective concurrency "
+    "target. The controller never raises the target above "
+    "scheduler.maxConcurrent nor lowers it below this floor.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+ADMISSION_AIMD_WINDOW = register(
+    "spark.rapids.tpu.sql.scheduler.admission.aimd.window", 16,
+    "Completions per AIMD adjustment window. Each window the "
+    "controller inspects the observed spill-degrade rate (and p95 "
+    "latency when aimd.latencyTargetMs is set): a bad window halves "
+    "the effective concurrency target (multiplicative decrease, "
+    "admission.aimd.backoff); a clean window raises it by one "
+    "(additive increase) up to maxConcurrent — sustained overload "
+    "converges to the goodput plateau instead of collapsing into "
+    "spill thrash.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+ADMISSION_AIMD_BACKOFF = register(
+    "spark.rapids.tpu.sql.scheduler.admission.aimd.backoff", 0.5,
+    "Multiplicative-decrease factor applied to the AIMD concurrency "
+    "target on a bad window (spill-degrade rate over "
+    "aimd.spillDegradeThreshold, or p95 over aimd.latencyTargetMs).",
+    conv=float,
+    check=lambda v: None if 0.0 < v < 1.0 else "must be in (0, 1)")
+
+ADMISSION_AIMD_SPILL_THRESHOLD = register(
+    "spark.rapids.tpu.sql.scheduler.admission.aimd.spillDegradeThreshold",
+    0.05,
+    "Fraction of a window's completed queries that spilled device "
+    "state above which the window counts as BAD and the AIMD target "
+    "decreases multiplicatively. Spilling is the engine's graceful "
+    "degradation, but a sustained spill rate means concurrency is "
+    "packed past the device's working set — backing off restores the "
+    "goodput plateau.", conv=float,
+    check=lambda v: None if 0.0 <= v <= 1.0 else "must be in [0, 1]")
+
+ADMISSION_AIMD_LATENCY_TARGET_MS = register(
+    "spark.rapids.tpu.sql.scheduler.admission.aimd.latencyTargetMs", 0.0,
+    "Optional p95 service-latency target for the AIMD controller: a "
+    "window whose completed-query p95 exceeds it counts as bad "
+    "(multiplicative decrease) even without spills. 0 disables the "
+    "latency criterion (the spill-degrade criterion always applies).",
+    conv=float, check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVER_RETRY_AFTER_MIN_MS = register(
+    "spark.rapids.tpu.server.retryAfter.minMs", 50.0,
+    "Floor on the server-computed retry_after_ms hint carried by "
+    "typed overload sheds (REJECTED / QUOTA_EXCEEDED / DRAINING wire "
+    "errors and GOAWAY frames). The hint is queue depth x predicted "
+    "drain rate from the admission cost model, clamped to "
+    "[minMs, maxMs]; clients back off at least this long so an empty "
+    "queue cannot invite an instant-retry storm.", conv=float,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVER_RETRY_AFTER_MAX_MS = register(
+    "spark.rapids.tpu.server.retryAfter.maxMs", 5000.0,
+    "Ceiling on the server-computed retry_after_ms hint: even a deep "
+    "queue of slow statements never tells a client to go away longer "
+    "than this (the client's own jittered backoff layers on top).",
+    conv=float, check=lambda v: None if v > 0 else "must be > 0")
+
 DCN_HEARTBEAT_TIMEOUT = register(
     "spark.rapids.tpu.dcn.heartbeatTimeout", 15.0,
     "Seconds without a heartbeat before the DCN coordinator declares a "

@@ -357,9 +357,13 @@ class SpillCatalog:
         if freed:
             self.spilled_device_bytes += freed
             self.spill_count += 1
-            from ..utils.metrics import TaskMetrics
+            from ..utils.metrics import QueryStats, TaskMetrics
             TaskMetrics.get().spill_to_host_bytes += freed
             TaskMetrics.get().spill_count += 1
+            # query-scoped: the running query whose pressure forced the
+            # demotion carries the spill-degrade signal the admission
+            # layer's AIMD controller and cost model consume
+            QueryStats.get().spill_events += 1
         return freed > 0
 
     def _spill_one_host(self) -> bool:

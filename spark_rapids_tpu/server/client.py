@@ -20,17 +20,66 @@ the sibling hands back the very same statement id."""
 
 from __future__ import annotations
 
+import random
 import socket
+import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from . import protocol as P
 from .protocol import ServerDraining, WireError
 
-__all__ = ["WireClient", "ResultSet"]
+__all__ = ["WireClient", "ResultSet", "RetryBudget"]
 
 # attempts across GOAWAYs per request: initial + one per fleet hop is
 # plenty (a whole fleet draining at once is an outage, not a restart)
 _GOAWAY_RETRIES = 3
+
+# bound on overload retries (REJECTED / QUOTA_EXCEEDED) per request —
+# the retry-token budget below is the cross-request storm brake; this
+# caps a single call's patience
+_OVERLOAD_RETRIES = 4
+
+# fallback backoff when a shed carries no server hint (older doors)
+_BACKOFF_BASE_S = 0.025
+_BACKOFF_MAX_S = 2.0
+
+
+class RetryBudget:
+    """Client-side retry token budget (the gRPC retry-throttle shape).
+
+    A fleet of clients all retrying their sheds at full rate is a
+    self-sustaining storm: the retries ARE the overload.  The budget
+    makes retries a scarce resource replenished by SUCCESS: each retry
+    withdraws one token, each successful request deposits ``ratio``
+    back (capped at ``tokens``).  While the service sheds faster than
+    it serves, the budget drains and the client stops retrying — the
+    typed error surfaces to the caller instead of feeding the storm.
+    Thread-safe (loadgen shares one client per worker thread)."""
+
+    def __init__(self, tokens: float = 8.0, ratio: float = 0.5):
+        self._max = float(tokens)
+        self._tokens = float(tokens)
+        self._ratio = float(ratio)
+        self._lock = threading.Lock()
+        self.throttled = 0  # retries the budget refused
+
+    def allow(self) -> bool:
+        """Withdraw one retry token; False (and counted) when broke."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            self.throttled += 1
+            return False
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._tokens = min(self._max, self._tokens + self._ratio)
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
 
 
 class ResultSet:
@@ -66,7 +115,8 @@ class WireClient:
     def __init__(self, host: str, port: int, tenant: str = "default",
                  token: str = "", weight: float = 1.0,
                  timeout: float = 120.0,
-                 siblings: Optional[list] = None):
+                 siblings: Optional[list] = None,
+                 retry_budget: float = 8.0):
         self._hello = {"token": token, "tenant": tenant, "weight": weight}
         self._timeout = timeout
         self._addrs: List[Tuple[str, int]] = [(host, int(port))] + [
@@ -77,6 +127,18 @@ class WireClient:
         # fingerprint guarantees the same id comes back)
         self._stmts: Dict[str, Dict[str, Any]] = {}
         self.goaways_survived = 0
+        # retry-storm control: typed overload sheds (REJECTED /
+        # QUOTA_EXCEEDED) are retried with jittered backoff honoring
+        # the server's retry_after_ms hint, gated by a token budget
+        # replenished only by success.  retry_budget=0 disables client
+        # retries entirely (the shed surfaces typed to the caller —
+        # loadgen's overload mode measures the server that way).
+        self.retry_budget: Optional[RetryBudget] = \
+            RetryBudget(retry_budget) if retry_budget > 0 else None
+        self.sheds_retried = 0
+        # per-client jitter stream: seeded from the PRNG pool, NOT
+        # shared — a fleet of clients must not march one backoff curve
+        self._jitter = random.Random()
         self.session_id: Optional[str] = None
         self._sock: Optional[socket.socket] = None
         self._connect(self.addr)
@@ -96,7 +158,10 @@ class WireClient:
         """GOAWAY handling: reconnect to a live endpoint — the siblings
         the GOAWAY advertised first, then any configured fallbacks, the
         drained endpoint itself LAST (it may be back after the
-        restart) — and let the caller retry idempotently."""
+        restart) — and let the caller retry idempotently.  Sweeps are
+        JITTERED per client: after a restart every parked client wakes
+        at once, and identical re-dial curves would hammer the fresh
+        door in lockstep."""
         try:
             self._sock.close()
         except OSError:
@@ -109,15 +174,41 @@ class WireClient:
             if a not in candidates:
                 candidates.append(a)
         last: BaseException = exc
-        for addr in candidates:
-            try:
-                self._connect(addr)
-                self.goaways_survived += 1
-                return
-            except (ServerDraining, WireError, P.ProtocolError,
-                    OSError) as e:
-                last = e
+        for sweep in range(3):
+            if sweep:
+                # jittered, hint-aware pause between sweeps — never the
+                # same curve on two clients
+                base = max(exc.retry_after_ms / 1e3, 0.05 * sweep)
+                time.sleep(min(_BACKOFF_MAX_S, base)
+                           * (0.5 + self._jitter.random()))  # fault-ok (paced jittered re-dial between failover sweeps, not an exception-swallowing loop)
+            for addr in candidates:
+                try:
+                    self._connect(addr)
+                    self.goaways_survived += 1
+                    return
+                except (ServerDraining, WireError, P.ProtocolError,
+                        OSError) as e:
+                    last = e
         raise exc from last
+
+    # -- retry-storm control ------------------------------------------------------
+    def _shed_pause(self, e: WireError, attempt: int) -> bool:
+        """Decide-and-pace one overload retry: honors the server's
+        ``retry_after_ms`` hint (floor) with multiplicative client
+        backoff and ±50% jitter on top, gated by the token budget.
+        False = do not retry (budget empty or retries disabled)."""
+        if self.retry_budget is None or not self.retry_budget.allow():
+            return False
+        base = max(e.retry_after_ms / 1e3,
+                   _BACKOFF_BASE_S * (2 ** attempt))
+        time.sleep(min(_BACKOFF_MAX_S, base)
+                   * (0.5 + self._jitter.random()))
+        self.sheds_retried += 1
+        return True
+
+    def _note_success(self) -> None:
+        if self.retry_budget is not None:
+            self.retry_budget.on_success()
 
     # -- statements ---------------------------------------------------------------
     def prepare(self, spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -131,6 +222,7 @@ class WireClient:
                                           expect=(P.RSP_PREPARED,))
                 info = P.unpack_json(payload)
                 self._stmts[info["statement_id"]] = spec
+                self._note_success()
                 return info
             except ServerDraining as e:
                 self._failover(e)
@@ -140,16 +232,28 @@ class WireClient:
     def execute(self, statement_id: str, params: Optional[list] = None,
                 **kw) -> ResultSet:
         """EXECUTE a prepared statement with bound parameter values.
-        Survives a draining endpoint: reconnects to a sibling,
-        re-prepares from the remembered spec (same structural
-        fingerprint → same id), retries."""
+        Survives a draining endpoint (reconnects to a sibling,
+        re-prepares from the remembered spec — same structural
+        fingerprint → same id — and retries) and typed overload sheds
+        (REJECTED / QUOTA_EXCEEDED retried with jittered backoff
+        honoring the server's retry_after_ms, gated by the retry token
+        budget)."""
         req = {"statement_id": statement_id, "params": params or []}
         req.update(kw)
-        for _ in range(_GOAWAY_RETRIES):
+        goaways = overloads = 0
+        while True:
             try:
                 P.send_frame(self._sock, P.REQ_EXECUTE, P.pack_json(req))
-                return self._collect_result()
+                rs = self._collect_result()
+                self._note_success()
+                return rs
             except ServerDraining as e:
+                goaways += 1
+                if goaways >= _GOAWAY_RETRIES:
+                    raise WireError(
+                        "DRAINING", "execute kept landing on draining "
+                        "endpoints", retry_after_ms=e.retry_after_ms,
+                        reason="draining")
                 self._failover(e)
                 spec = self._stmts.get(statement_id)
                 if spec is not None:
@@ -158,6 +262,12 @@ class WireClient:
                     # caller holds keeps working)
                     self.prepare(spec)
             except WireError as e:
+                if e.code in ("REJECTED", "QUOTA_EXCEEDED"):
+                    if overloads < _OVERLOAD_RETRIES \
+                            and self._shed_pause(e, overloads):
+                        overloads += 1
+                        continue
+                    raise
                 # a restarted (or different) door with a fresh prepared
                 # cache answers NOT_FOUND for a statement this client
                 # prepared in the door's previous life: re-prepare from
@@ -167,23 +277,36 @@ class WireClient:
                         or statement_id not in self._stmts:
                     raise
                 self.prepare(self._stmts[statement_id])
-        raise WireError("DRAINING", "execute kept landing on draining "
-                                    "endpoints")
 
     def query(self, spec: Dict[str, Any], params: Optional[list] = None,
               **kw) -> ResultSet:
         """Ad-hoc SUBMIT (plans server-side per execution).  Retries
-        idempotently through a GOAWAY."""
+        idempotently through a GOAWAY, and through typed overload sheds
+        under the retry token budget."""
         req = {"spec": spec, "params": params or []}
         req.update(kw)
-        for _ in range(_GOAWAY_RETRIES):
+        goaways = overloads = 0
+        while True:
             try:
                 P.send_frame(self._sock, P.REQ_SUBMIT, P.pack_json(req))
-                return self._collect_result()
+                rs = self._collect_result()
+                self._note_success()
+                return rs
             except ServerDraining as e:
+                goaways += 1
+                if goaways >= _GOAWAY_RETRIES:
+                    raise WireError(
+                        "DRAINING", "query kept landing on draining "
+                        "endpoints", retry_after_ms=e.retry_after_ms,
+                        reason="draining")
                 self._failover(e)
-        raise WireError("DRAINING", "query kept landing on draining "
-                                    "endpoints")
+            except WireError as e:
+                if e.code in ("REJECTED", "QUOTA_EXCEEDED") \
+                        and overloads < _OVERLOAD_RETRIES \
+                        and self._shed_pause(e, overloads):
+                    overloads += 1
+                    continue
+                raise
 
     def query_stream(self, spec: Dict[str, Any],
                      params: Optional[list] = None, **kw

@@ -374,8 +374,13 @@ class SqlFrontDoor:
         with self._lock:
             siblings = list(self._siblings)
         try:
+            hint = self._retry_hint()
+        except Exception:  # fault-ok (scheduler may already be tearing down mid-drain; the frame still goes out)
+            hint = 0
+        try:
             P.send_frame(conn, P.RSP_GOAWAY, P.goaway_payload(
-                "server draining for planned restart", siblings))
+                "server draining for planned restart", siblings,
+                retry_after_ms=hint))
             self.goaways_sent += 1
         except OSError:
             pass
@@ -416,8 +421,10 @@ class SqlFrontDoor:
         params = req.get("params") or []
         prepared_run = False
         plan_saved_ms = 0.0
+        fingerprint = None  # admission cost-model key (prepared or not)
         if ftype == P.REQ_EXECUTE:
             fp = req.get("statement_id", "")
+            fingerprint = fp or None
             stmt = self.prepared.get(fp)
             if stmt is not None \
                     and conf["spark.rapids.tpu.server.preparedCache.enabled"]:
@@ -443,6 +450,12 @@ class SqlFrontDoor:
             spec = req.get("spec")
             if not isinstance(spec, dict):
                 raise WireError("BAD_REQUEST", "submit needs a spec object")
+            # ad-hoc SUBMITs share the prepared path's identity rule
+            # (cache/keys.statement_fingerprint over the canonical
+            # spec): a recurring non-prepared statement still converges
+            # on an admission cost profile
+            from ..cache.keys import statement_fingerprint
+            fingerprint = statement_fingerprint(spec)
             df, ptypes = compile_spec(spec, self._tables)
             values = coerce_params(params, ptypes)
             schema = df._plan.schema()
@@ -455,7 +468,10 @@ class SqlFrontDoor:
                               conf["spark.rapids.tpu.server.spool.memoryBytes"],
                               self._spool_dir(conf))
 
-        self.quotas.acquire(csess.tenant)  # typed QUOTA_EXCEEDED
+        # typed QUOTA_EXCEEDED, carrying the scheduler's drain-rate
+        # retry hint so capped tenants back off instead of hammering
+        self.quotas.acquire(csess.tenant,
+                            retry_after_ms=self._retry_hint(conf))
         # one finally covers every exit edge from here on: a failed
         # submit, a client drop mid-stream, and the ordinary end all
         # release the quota slot and close the stream exactly once
@@ -463,7 +479,7 @@ class SqlFrontDoor:
         wq = None
         try:
             wq = self._submit(csess, label, query_id, run, stream,
-                              req, deadline_ms)
+                              req, deadline_ms, fingerprint)
             try:
                 self._stream_result(conn, wq, schema, prepared_run,
                                     plan_saved_ms)
@@ -528,8 +544,17 @@ class SqlFrontDoor:
         cancel.check()  # prefer the control's typed reason when set
         raise QueryCancelled("client disconnected mid-stream")
 
+    def _retry_hint(self, conf=None) -> int:
+        """The scheduler admission layer's server-computed
+        retry_after_ms (queue depth × predicted drain rate, clamped to
+        server.retryAfter.*) — stamped on every typed shed this door
+        answers."""
+        if conf is None:
+            conf = self._conf()
+        return self._session.scheduler().admission.retry_after_ms(conf)
+
     def _submit(self, csess, label, query_id, run, stream, req,
-                deadline_ms) -> _WireQuery:
+                deadline_ms, fingerprint=None) -> _WireQuery:
         from ..service.scheduler import QueryRejected
 
         def work():
@@ -552,9 +577,13 @@ class SqlFrontDoor:
                 work,
                 priority=req.get("priority"),
                 deadline_s=(deadline_ms / 1e3) if deadline_ms else None,
-                tenant=csess.tenant, weight=csess.weight, label=label)
+                tenant=csess.tenant, weight=csess.weight, label=label,
+                fingerprint=fingerprint)
         except QueryRejected as e:
-            raise WireError("REJECTED", str(e))
+            # the shed taxonomy + retry hint cross the wire intact
+            raise WireError("REJECTED", str(e), detail=e.reason,
+                            retry_after_ms=e.retry_after_ms,
+                            reason=e.reason)
         handle._entry.control.server_attrs = {
             "connection": csess.session_id, "peer": csess.peer,
             "wire_query": query_id,
@@ -618,6 +647,15 @@ class SqlFrontDoor:
                               P.ProtocolError)):
                 raise
             from ..service.cancel import QueryDrained
+            from ..service.scheduler import QueryRejected
+            if isinstance(e, QueryRejected):
+                # shed AFTER submission (doomed-in-queue / drain
+                # eviction): the typed reason + retry hint reach the
+                # client exactly like a submit-time shed
+                self._try_error(conn, WireError(
+                    "REJECTED", str(e), detail=e.reason,
+                    retry_after_ms=e.retry_after_ms, reason=e.reason))
+                return
             if isinstance(e, QueryFaulted):
                 code = ("DRAINING" if getattr(e, "point", "") == "drain"
                         else "FAULTED")

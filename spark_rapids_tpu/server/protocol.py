@@ -101,40 +101,63 @@ class ProtocolError(RuntimeError):
 
 
 class WireError(RuntimeError):
-    """A typed application-level error frame (either direction)."""
+    """A typed application-level error frame (either direction).
 
-    def __init__(self, code: str, message: str, detail: str = ""):
+    ``reason`` refines overload sheds (``REJECTED`` carries the
+    scheduler's shed taxonomy: ``queue_full`` | ``doomed`` |
+    ``overload`` | ``draining`` | ``closed``) so a drain shed and a
+    full-queue shed stop being indistinguishable on the wire.
+    ``retry_after_ms`` is the server-computed backoff hint (queue depth
+    × predicted drain rate) every shed — REJECTED, QUOTA_EXCEEDED,
+    DRAINING — carries; clients MUST NOT retry sooner (the retry-storm
+    contract, enforced client-side by :class:`.client.RetryBudget`)."""
+
+    def __init__(self, code: str, message: str, detail: str = "",
+                 retry_after_ms: int = 0, reason: str = ""):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.message = message
         self.detail = detail
+        self.retry_after_ms = int(retry_after_ms)
+        self.reason = reason
 
     def to_payload(self) -> bytes:
         return pack_json({"code": self.code, "message": self.message,
-                          "detail": self.detail})
+                          "detail": self.detail,
+                          "retry_after_ms": self.retry_after_ms,
+                          "reason": self.reason})
 
     @classmethod
     def from_payload(cls, payload: bytes) -> "WireError":
         d = unpack_json(payload)
         return cls(d.get("code", "INTERNAL"), d.get("message", ""),
-                   d.get("detail", ""))
+                   d.get("detail", ""),
+                   retry_after_ms=d.get("retry_after_ms", 0) or 0,
+                   reason=d.get("reason", ""))
 
 
 class ServerDraining(WireError):
     """A GOAWAY frame: the server is draining for a planned restart.
     Carries the sibling endpoints it advertised — ``[[host, port],
-    ...]`` — so the client can reconnect and retry idempotently.  A
-    :class:`WireError` (code ``DRAINING``) so generic typed-error
-    handlers treat an un-retried GOAWAY like any other shed."""
+    ...]`` — so the client can reconnect and retry idempotently, plus a
+    ``retry_after_ms`` hint for clients with no live sibling to land
+    on.  A :class:`WireError` (code ``DRAINING``, reason ``draining``)
+    so generic typed-error handlers treat an un-retried GOAWAY like any
+    other shed."""
 
-    def __init__(self, message: str, siblings=None):
-        super().__init__("DRAINING", message)
+    def __init__(self, message: str, siblings=None,
+                 retry_after_ms: int = 0):
+        super().__init__("DRAINING", message,
+                         retry_after_ms=retry_after_ms,
+                         reason="draining")
         self.siblings = [(str(h), int(p)) for h, p in (siblings or [])]
 
 
-def goaway_payload(reason: str, siblings) -> bytes:
+def goaway_payload(reason: str, siblings, retry_after_ms: int = 0
+                   ) -> bytes:
     return pack_json({"reason": reason,
-                      "siblings": [[h, int(p)] for h, p in siblings]})
+                      "siblings": [[h, int(p)] for h, p in siblings],
+                      "retry_after_ms": int(retry_after_ms)})
 
 
 def pack_json(obj: Dict[str, Any]) -> bytes:
@@ -199,7 +222,9 @@ def recv_frame(sock: socket.socket,
     if ftype == RSP_GOAWAY:
         d = unpack_json(payload)
         raise ServerDraining(d.get("reason", "server draining"),
-                             siblings=d.get("siblings") or [])
+                             siblings=d.get("siblings") or [],
+                             retry_after_ms=d.get("retry_after_ms", 0)
+                             or 0)
     if expect is not None and ftype not in expect:
         raise ProtocolError(
             f"unexpected frame {ftype!r} (wanted one of {expect})")

@@ -80,7 +80,11 @@ class TenantQuotas:
     def cap_for(self, tenant: str) -> int:
         return self._caps.get(tenant, self._default)
 
-    def acquire(self, tenant: str) -> None:
+    def acquire(self, tenant: str, retry_after_ms: int = 0) -> None:
+        """Claim an in-flight slot or shed typed.  ``retry_after_ms``
+        (the scheduler admission layer's drain-rate hint, passed by the
+        endpoint) rides the QUOTA_EXCEEDED error so a capped tenant's
+        fleet backs off instead of hammering the cap."""
         with self._lock:
             cap = self.cap_for(tenant)
             cur = self._inflight.get(tenant, 0)
@@ -89,7 +93,9 @@ class TenantQuotas:
                     "QUOTA_EXCEEDED",
                     f"tenant {tenant!r} at its in-flight cap ({cap}); "
                     f"retry after a query completes",
-                    detail=f"inflight={cur}")
+                    detail=f"inflight={cur}",
+                    retry_after_ms=retry_after_ms,
+                    reason="quota")
             self._inflight[tenant] = cur + 1
 
     def release(self, tenant: str) -> None:

@@ -18,6 +18,8 @@ from .cancel import (QueryCancelled, QueryControl,  # noqa: F401
 __all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryStalled",
            "QueryControl", "QueryRejected", "QueryScheduler",
            "QueryHandle", "QueryWatchdog",
+           "AdmissionController", "CostModel", "AimdController",
+           "SHED_REASONS",
            "QueryFaulted", "PermanentFault", "check", "current", "scope",
            "cancel"]
 
@@ -26,6 +28,12 @@ def __getattr__(name):
     if name in ("QueryRejected", "QueryScheduler", "QueryHandle"):
         from . import scheduler
         return getattr(scheduler, name)
+    if name in ("AdmissionController", "CostModel", "AimdController",
+                "SHED_REASONS"):
+        # predictive admission + overload survival (cost model, AIMD
+        # concurrency target, typed shed taxonomy, retry hints)
+        from . import admission
+        return getattr(admission, name)
     if name == "QueryWatchdog":
         from . import watchdog
         return watchdog.QueryWatchdog

@@ -1,0 +1,461 @@
+"""Predictive admission + overload survival for the query scheduler.
+
+The scheduler's original admission inputs were STATIC: a permit count
+(``scheduler.maxConcurrent``) and a queue bound
+(``scheduler.queueDepth``).  Neither knows what a query will cost, so
+under a zipf-skewed mix a burst of heavy statements packs the device
+into spill-degrades while doomed queries rot in the queue past their
+deadlines — the classic metastable-overload shape.  This module closes
+the loop with the inputs the engine already produces:
+
+  * **Cost model** (:class:`CostModel`) — an EWMA profile per statement
+    fingerprint (runtime, device-byte footprint, spill events), fed
+    from each completed query's ``QueryStats`` snapshot.  Fingerprints
+    come from the prepared-statement cache
+    (``cache/keys.statement_fingerprint``); the front door derives one
+    for ad-hoc SUBMITs from the same spec canonicalization, so a
+    recurring statement converges on a profile whether or not it was
+    PREPAREd.  Unknown fingerprints predict nothing — admission falls
+    back to the static permit behavior exactly.
+  * **Memory packing** (:meth:`AdmissionController.try_reserve`) — a
+    dispatch reserves the query's PREDICTED device bytes against the
+    admission budget (the spill catalog's device budget by default);
+    a heavy statement that would not fit beside the in-flight
+    reservations waits even when a permit is free.  Fewer concurrent
+    heavy queries at equal ``maxConcurrent`` means fewer
+    spill-degrades — the A/B the overload loadgen measures.
+  * **Deadline-aware shedding** (:meth:`AdmissionController.doomed`) —
+    an entry whose remaining deadline is below its predicted runtime
+    is shed IN THE QUEUE with a typed reason (``doomed``) instead of
+    dispatched to burn device time it cannot use; under queue pressure
+    doomed-oldest entries are evicted first to make room for live work.
+  * **Adaptive concurrency** (:class:`AimdController`) — additive
+    increase / multiplicative decrease on the effective concurrency
+    target between ``admission.aimd.floor`` and ``maxConcurrent``,
+    driven by the observed spill-degrade rate (and optionally p95), so
+    sustained overload converges to the goodput plateau instead of
+    collapsing into spill thrash.
+  * **Retry hints** (:meth:`AdmissionController.retry_after_ms`) —
+    every typed shed carries a server-computed ``retry_after_ms``
+    (queue depth × predicted drain rate, clamped to
+    ``server.retryAfter.{minMs,maxMs}``) so a fleet of shed clients
+    spreads its retries instead of synchronizing into a storm.
+
+``spark.rapids.tpu.sql.scheduler.admission.enabled=false`` is the kill
+switch: every method degrades to the pre-admission behavior exactly
+(permits only, no shedding beyond queueDepth, target = maxConcurrent).
+
+Stdlib-only by design (threading + math): the scheduler imports this on
+its hot dispatch path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils import tracing
+
+__all__ = ["CostModel", "StatementProfile", "AimdController",
+           "AdmissionController", "SHED_REASONS"]
+
+_pc = time.perf_counter
+
+# the complete shed taxonomy — QueryRejected.reason is always one of
+# these, and the loadgen overload report buckets by them
+SHED_REASONS = ("queue_full", "doomed", "overload", "draining", "closed")
+
+
+class StatementProfile:
+    """EWMA cost profile of one statement fingerprint."""
+
+    __slots__ = ("runtime_s", "device_bytes", "spill_events", "samples")
+
+    def __init__(self):
+        self.runtime_s = 0.0
+        self.device_bytes = 0.0
+        self.spill_events = 0.0
+        self.samples = 0
+
+    def observe(self, runtime_s: float, device_bytes: int,
+                spill_events: int, alpha: float) -> None:
+        if self.samples == 0:
+            self.runtime_s = runtime_s
+            self.device_bytes = float(device_bytes)
+            self.spill_events = float(spill_events)
+        else:
+            self.runtime_s += alpha * (runtime_s - self.runtime_s)
+            self.device_bytes += alpha * (device_bytes - self.device_bytes)
+            self.spill_events += alpha * (spill_events - self.spill_events)
+        self.samples += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"runtime_s": round(self.runtime_s, 6),
+                "device_bytes": round(self.device_bytes, 1),
+                "spill_events": round(self.spill_events, 3),
+                "samples": self.samples}
+
+
+class CostModel:
+    """Per-fingerprint EWMA profiles, persisted for the session (the
+    scheduler owns one; it survives drain/resume).  Thread-safe."""
+
+    # bound on tracked fingerprints: beyond it the least-recently
+    # observed profile is dropped (a profile rebuilds in one sample)
+    MAX_PROFILES = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._profiles: Dict[str, StatementProfile] = {}
+        # EWMA of runtime across ALL completed queries (fingerprinted or
+        # not): the drain-rate estimate behind retry_after_ms
+        self.mean_runtime_s = 0.0
+        self._runtime_samples = 0
+
+    def observe(self, fingerprint: Optional[str], runtime_s: float,
+                device_bytes: int, spill_events: int,
+                alpha: float) -> None:
+        with self._lock:
+            if self._runtime_samples == 0:
+                self.mean_runtime_s = runtime_s
+            else:
+                self.mean_runtime_s += alpha * (runtime_s
+                                                - self.mean_runtime_s)
+            self._runtime_samples += 1
+            if not fingerprint:
+                return
+            prof = self._profiles.pop(fingerprint, None)
+            if prof is None:
+                prof = StatementProfile()
+                while len(self._profiles) >= self.MAX_PROFILES:
+                    # dict preserves insertion order; re-insertion on
+                    # observe makes the first key the least recent
+                    self._profiles.pop(next(iter(self._profiles)))
+            prof.observe(runtime_s, device_bytes, spill_events, alpha)
+            self._profiles[fingerprint] = prof  # move to MRU position
+
+    def predict(self, fingerprint: Optional[str]
+                ) -> Optional[StatementProfile]:
+        """The fingerprint's profile, or None (unknown → the caller
+        falls back to permit behavior)."""
+        if not fingerprint:
+            return None
+        with self._lock:
+            return self._profiles.get(fingerprint)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"fingerprints": len(self._profiles),
+                    "mean_runtime_s": round(self.mean_runtime_s, 6),
+                    "runtime_samples": self._runtime_samples}
+
+
+def _p95(vals: List[float]) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(round(0.95 * (len(s) - 1))))]
+
+
+class AimdController:
+    """Additive-increase / multiplicative-decrease concurrency target.
+
+    Fed one ``(latency_s, spilled)`` observation per completed query;
+    every ``admission.aimd.window`` completions it adjusts the target:
+    a window whose spill-degrade rate exceeds
+    ``admission.aimd.spillDegradeThreshold`` (or whose p95 exceeds
+    ``admission.aimd.latencyTargetMs`` when that is set) halves the
+    target (``admission.aimd.backoff``); a clean window adds one.  The
+    target is clamped to ``[aimd.floor, maxConcurrent]`` at read time,
+    so runtime conf changes apply immediately.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._target: Optional[int] = None  # None = never decreased/set
+        self._lat: List[float] = []
+        self._n = 0
+        self._spilled = 0
+        self.decreases = 0
+        self.increases = 0
+
+    def on_complete(self, latency_s: float, spilled: bool, conf,
+                    conf_max: int) -> None:
+        window = conf[
+            "spark.rapids.tpu.sql.scheduler.admission.aimd.window"]
+        floor = conf[
+            "spark.rapids.tpu.sql.scheduler.admission.aimd.floor"]
+        backoff = conf[
+            "spark.rapids.tpu.sql.scheduler.admission.aimd.backoff"]
+        spill_thresh = conf[
+            "spark.rapids.tpu.sql.scheduler.admission.aimd"
+            ".spillDegradeThreshold"]
+        lat_target_ms = conf[
+            "spark.rapids.tpu.sql.scheduler.admission.aimd"
+            ".latencyTargetMs"]
+        with self._lock:
+            self._n += 1
+            self._spilled += int(bool(spilled))
+            self._lat.append(latency_s)
+            if self._n < max(1, window):
+                return
+            spill_rate = self._spilled / self._n
+            p95_ms = _p95(self._lat) * 1e3
+            self._n = 0
+            self._spilled = 0
+            self._lat = []
+            cur = self._target if self._target is not None else conf_max
+            cur = max(floor, min(conf_max, cur))
+            bad = spill_rate > spill_thresh or (
+                lat_target_ms > 0 and p95_ms > lat_target_ms)
+            if bad:
+                new = max(floor, int(cur * backoff))
+                self.decreases += 1
+            else:
+                new = min(conf_max, cur + 1)
+                if new != cur:
+                    self.increases += 1
+            self._target = new
+        if new != cur:
+            tracing.mark(None, "admission:aimd", "scheduler",
+                         target=new, previous=cur,
+                         spill_rate=round(spill_rate, 4),
+                         p95_ms=round(p95_ms, 2))
+
+    def target(self, conf_max: int, floor: int) -> int:
+        with self._lock:
+            t = self._target
+        if t is None:
+            return conf_max
+        return max(min(floor, conf_max), min(conf_max, t))
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"target": self._target if self._target is not None
+                    else -1,
+                    "decreases": self.decreases,
+                    "increases": self.increases}
+
+
+class AdmissionController:
+    """The scheduler's predictive-admission brain: cost model + AIMD +
+    byte-packing reservations + retry hints, behind the
+    ``admission.enabled`` kill switch.  Owned by one
+    :class:`..service.scheduler.QueryScheduler`; all state is
+    per-session and survives drain/resume.
+    """
+
+    def __init__(self, scheduler=None):
+        self._sched = scheduler
+        self.cost_model = CostModel()
+        self.aimd = AimdController()
+        self._lock = threading.Lock()
+        # entry -> reserved predicted device bytes (dispatch reserves,
+        # completion releases; idempotent on the watchdog-reclaim path)
+        self._reserved: Dict[object, float] = {}
+        self.sheds: Dict[str, int] = {r: 0 for r in SHED_REASONS}
+
+    # -- conf ---------------------------------------------------------------------
+    @staticmethod
+    def enabled(conf) -> bool:
+        return conf["spark.rapids.tpu.sql.scheduler.admission.enabled"]
+
+    @staticmethod
+    def _alpha(conf) -> float:
+        return conf["spark.rapids.tpu.sql.scheduler.admission.ewmaAlpha"]
+
+    def _budget_bytes(self, conf) -> int:
+        b = conf[
+            "spark.rapids.tpu.sql.scheduler.admission.deviceBudgetBytes"]
+        if b > 0:
+            return b
+        try:
+            from ..memory.spill import get_catalog
+            return int(get_catalog(conf).device_budget)
+        except Exception:  # fault-ok (no backend in pure-callable schedulers: packing disabled, permits rule)
+            return 0
+
+    # -- concurrency target -------------------------------------------------------
+    def target_concurrent(self, conf, conf_max: int) -> int:
+        """The effective concurrency target: ``maxConcurrent`` clamped
+        by the AIMD controller when admission is enabled."""
+        if not self.enabled(conf):
+            return conf_max
+        floor = conf[
+            "spark.rapids.tpu.sql.scheduler.admission.aimd.floor"]
+        return self.aimd.target(conf_max, floor)
+
+    # -- cost-model feed ----------------------------------------------------------
+    def on_query_done(self, entry, status: str, stats: Optional[dict],
+                      served_s: float, conf) -> None:
+        """Completion hook (every terminal path): release the entry's
+        byte reservation; on a successful run, feed the cost model and
+        the AIMD controller from the query-scoped stats snapshot."""
+        self.release(entry)
+        if not self.enabled(conf):
+            return
+        if status != "done" or stats is None:
+            return
+        spills = int(stats.get("spill_events", 0))
+        # footprint proxy: bytes this query materialized on device
+        # (uploads + cache hits served from HBM + shuffle staging) — the
+        # working set its admission should have budgeted for
+        footprint = int(stats.get("upload_bytes", 0)
+                        + stats.get("cache_hit_bytes", 0)
+                        + stats.get("shuffle_bytes", 0))
+        # predictions describe the WARM cost: XLA compile seconds are
+        # excluded, or one cold first run would inflate the profile
+        # past every deadline and doom-shed the statement forever (the
+        # shed queries never complete, so nothing would ever correct
+        # the estimate — a self-fulfilling doom loop)
+        runtime_s = max(1e-4, served_s - stats.get("compile_s", 0.0))
+        self.cost_model.observe(getattr(entry, "fingerprint", None),
+                                runtime_s, footprint, spills,
+                                self._alpha(conf))
+        conf_max = max(1, conf[
+            "spark.rapids.tpu.sql.scheduler.maxConcurrent"])
+        self.aimd.on_complete(served_s, spills > 0, conf, conf_max)
+
+    # -- byte packing -------------------------------------------------------------
+    def try_reserve(self, entry, conf) -> bool:
+        """Reserve the entry's predicted device footprint against the
+        admission budget; True admits.  Unknown fingerprints, disabled
+        admission, and an unresolvable budget all reserve 0 bytes
+        (permit behavior).  The FIRST in-flight query always fits — a
+        single over-budget statement must run (and spill), not
+        deadlock."""
+        if not self.enabled(conf):
+            return True
+        prof = self.cost_model.predict(getattr(entry, "fingerprint",
+                                               None))
+        if prof is None or prof.device_bytes <= 0:
+            with self._lock:
+                self._reserved[entry] = 0.0
+            return True
+        budget = self._budget_bytes(conf)
+        if budget <= 0:
+            with self._lock:
+                self._reserved[entry] = 0.0
+            return True
+        with self._lock:
+            in_use = sum(self._reserved.values())
+            if self._reserved and in_use + prof.device_bytes > budget:
+                return False
+            self._reserved[entry] = prof.device_bytes
+            return True
+
+    def release(self, entry) -> None:
+        with self._lock:
+            self._reserved.pop(entry, None)
+
+    def reserved_bytes(self) -> float:
+        with self._lock:
+            return sum(self._reserved.values())
+
+    # -- deadline-aware shedding --------------------------------------------------
+
+    # observations a profile needs before its runtime DOOMS deadlines:
+    # one sample may be an outlier (a cold cache, a contended run) and
+    # a doomed shed produces no completion to correct it with
+    MIN_DOOM_SAMPLES = 2
+
+    def predicted_runtime(self, fingerprint: Optional[str]
+                          ) -> Optional[float]:
+        """The fingerprint's predicted (warm) runtime, or None when the
+        profile is missing or too thin to doom anything."""
+        prof = self.cost_model.predict(fingerprint)
+        if prof is None or prof.samples < self.MIN_DOOM_SAMPLES:
+            return None
+        return prof.runtime_s
+
+    def doomed(self, control, fingerprint: Optional[str],
+               now: Optional[float] = None) -> bool:
+        """True when the entry cannot possibly meet its deadline: the
+        deadline already passed, or the remaining window is below the
+        fingerprint's predicted runtime.  Deadline-less entries are
+        never doomed."""
+        deadline = getattr(control, "deadline", None)
+        if deadline is None:
+            return False
+        remaining = deadline - (now if now is not None else _pc())
+        if remaining <= 0:
+            return True
+        rt = self.predicted_runtime(fingerprint)
+        return rt is not None and remaining < rt
+
+    # -- overload estimation + retry hints ----------------------------------------
+    def queue_delay_s(self, queue_len: int, conf) -> float:
+        """Estimated wait for a NEW arrival: queued entries ahead of it
+        divided by the drain rate (effective concurrency / EWMA
+        runtime).  0 when the model has no runtime data yet."""
+        mean = self.cost_model.mean_runtime_s
+        if mean <= 0:
+            return 0.0
+        conf_max = max(1, conf[
+            "spark.rapids.tpu.sql.scheduler.maxConcurrent"])
+        target = max(1, self.target_concurrent(conf, conf_max))
+        return (queue_len + 1) * mean / target
+
+    def backlog_s(self, queued_fingerprints, conf) -> float:
+        """Predicted drain time of the CURRENT backlog: each queued
+        entry contributes its fingerprint's predicted runtime (the
+        global EWMA mean for unknowns), divided by the effective
+        concurrency.  This is what makes a queue of heavy statements
+        overloaded long before a same-length queue of point lookups —
+        the per-query cost decision the static queueDepth cannot
+        make."""
+        mean = max(0.0, self.cost_model.mean_runtime_s)
+        total = 0.0
+        for fp in queued_fingerprints:
+            prof = self.cost_model.predict(fp)
+            total += prof.runtime_s if prof is not None \
+                and prof.samples > 0 else mean
+        if total <= 0:
+            return 0.0
+        conf_max = max(1, conf[
+            "spark.rapids.tpu.sql.scheduler.maxConcurrent"])
+        return total / max(1, self.target_concurrent(conf, conf_max))
+
+    def overloaded(self, queued_fingerprints, conf) -> bool:
+        """Submit-time overload check: the BACKLOG's predicted drain
+        time beyond ``admission.maxQueueDelayMs`` (0 = disabled).  An
+        empty queue is never overloaded — a new arrival dispatches as
+        soon as a slot frees, whatever the mean runtime says."""
+        if not self.enabled(conf) or not queued_fingerprints:
+            return False
+        cap_ms = conf["spark.rapids.tpu.sql.scheduler.admission"
+                      ".maxQueueDelayMs"]
+        if cap_ms <= 0:
+            return False
+        return self.backlog_s(queued_fingerprints, conf) * 1e3 > cap_ms
+
+    def retry_after_ms(self, conf, queue_len: Optional[int] = None) -> int:
+        """Server-computed backoff hint for a typed shed: the estimated
+        queue drain time clamped to ``server.retryAfter.{minMs,maxMs}``.
+        Always positive — every shed carries a usable hint even before
+        the model has data."""
+        lo = conf["spark.rapids.tpu.server.retryAfter.minMs"]
+        hi = conf["spark.rapids.tpu.server.retryAfter.maxMs"]
+        if queue_len is None:
+            queue_len = self._sched.queued() if self._sched is not None \
+                else 0
+        est_ms = self.queue_delay_s(queue_len, conf) * 1e3
+        return int(max(lo, min(hi, max(est_ms, lo))))
+
+    # -- accounting ---------------------------------------------------------------
+    def note_shed(self, reason: str, label: str = "",
+                  retry_after_ms: int = 0) -> None:
+        with self._lock:
+            self.sheds[reason] = self.sheds.get(reason, 0) + 1
+        tracing.mark(None, "admission:shed", "scheduler", reason=reason,
+                     label=label, retry_after_ms=retry_after_ms)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            sheds = dict(self.sheds)
+            reserved = sum(self._reserved.values())
+        return {"sheds": sheds,
+                "reserved_bytes": int(reserved),
+                "aimd": self.aimd.snapshot(),
+                "cost_model": self.cost_model.snapshot()}

@@ -107,6 +107,11 @@ class QueryControl:
         # legitimately run long before the first batch exists).
         self.progress_t = _pc()
         self.progress_seen = False
+        # when the scheduler DISPATCHED the query (None while queued):
+        # the watchdog's stall clock starts HERE, not at submit — a
+        # query that waited long in a deep admission queue must not
+        # trip the stall window before its first batch
+        self.dispatched_t: Optional[float] = None
         self._wakers: Dict[int, Callable[[], None]] = {}
         self._n_wakers = 0
         self._lock = threading.Lock()
@@ -217,6 +222,15 @@ class QueryControl:
             self.cancel(f"deadline exceeded for {self.label}",
                         deadline=True)
             self.raise_()
+
+    def note_dispatch(self) -> None:
+        """Stamp the dispatch moment (scheduler worker startup): resets
+        the progress clock so the watchdog's stall window counts from
+        when the query started RUNNING, never from submit — queue wait
+        is the scheduler's business, not a hang.  The 4x cold-start
+        grace (``progress_seen`` still False) applies from here."""
+        self.dispatched_t = _pc()
+        self.progress_t = self.dispatched_t
 
     def note_progress(self) -> None:
         """Stamp a progress checkpoint (the watchdog's liveness

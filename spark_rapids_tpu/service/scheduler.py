@@ -49,6 +49,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..faults.recovery import QueryFaulted
+from .admission import AdmissionController
 from .cancel import (QueryCancelled, QueryControl, QueryDeadlineExceeded,
                      QueryDrained, QueryStalled, scope as control_scope)
 
@@ -58,22 +59,46 @@ _pc = time.perf_counter
 
 
 class QueryRejected(RuntimeError):
-    """Admission queue full — the scheduler shed this query at submit().
+    """The scheduler shed this query with a TYPED reason — the
+    service-overload contract: callers see an immediate, typed error
+    (retry with backoff / route elsewhere) instead of unbounded
+    queueing.
 
-    The service-overload contract: callers see a typed, immediate error
-    (retry with backoff / route elsewhere) instead of unbounded queueing.
+    ``reason`` is one of :data:`..service.admission.SHED_REASONS`:
+
+      ==========  =====================================================
+      queue_full  the admission queue is at ``queueDepth``
+      doomed      remaining deadline below the fingerprint's predicted
+                  runtime (or already expired) — shed in the queue
+                  rather than dispatched to burn device time
+      overload    estimated queue drain time beyond
+                  ``admission.maxQueueDelayMs``
+      draining    graceful drain in progress (resubmit on a sibling)
+      closed      the scheduler was shut down
+      ==========  =====================================================
+
+    ``retry_after_ms`` is the server-computed backoff hint (queue depth
+    × predicted drain rate, clamped to ``server.retryAfter.*``) the
+    wire layer forwards so shed clients spread their retries.
     """
+
+    def __init__(self, message: str, *, reason: str = "queue_full",
+                 retry_after_ms: int = 0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_ms = int(retry_after_ms)
 
 
 class _Entry:
     __slots__ = ("seq", "label", "fn", "control", "future", "cctx",
                  "status", "stats", "submitted_t", "started_t",
                  "finished_t", "deadline_s", "resubmits", "attempts",
-                 "worker_ident", "thread")
+                 "worker_ident", "thread", "fingerprint")
 
     def __init__(self, seq: int, label: str, fn: Callable,
                  control: QueryControl,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 fingerprint: Optional[str] = None):
         self.seq = seq
         self.label = label
         self.fn = fn
@@ -100,6 +125,10 @@ class _Entry:
         # object itself is what drain()/close() join (with a timeout)
         self.worker_ident: Optional[int] = None
         self.thread: Optional[threading.Thread] = None
+        # statement fingerprint (cache/keys.statement_fingerprint via
+        # the front door; None for in-process submissions): the
+        # admission cost model's key — predictions in, observations out
+        self.fingerprint = fingerprint
 
 
 class QueryHandle:
@@ -142,7 +171,10 @@ class QueryHandle:
     @property
     def status(self) -> str:
         """queued | running | resubmitted | done | failed | faulted |
-        cancelled | deadline | drained (``faulted`` = transient-fault
+        cancelled | deadline | drained | shed (``shed`` = the admission
+        layer removed this entry from the queue with a typed
+        :class:`QueryRejected` — doomed deadline or overload eviction —
+        before it ever ran; ``faulted`` = transient-fault
         recovery exhausted — the :class:`..faults.recovery.QueryFaulted`
         from :meth:`result` carries the fault history; ``resubmitted`` =
         a permanent-at-this-placement failure was requeued and a fresh
@@ -219,6 +251,11 @@ class QueryScheduler:
         self.cancelled = 0
         self.resubmitted = 0
         self.drained = 0
+        # predictive admission (service/admission.py): per-fingerprint
+        # cost model, AIMD concurrency target, byte-packing
+        # reservations, typed shed taxonomy, retry_after hints — all
+        # behind scheduler.admission.enabled
+        self.admission = AdmissionController(self)
         self._sem_listener_installed = False
         # dispatcher: pops admissible entries and starts worker threads;
         # queries themselves run in per-query copied contexts
@@ -247,13 +284,25 @@ class QueryScheduler:
     # -- submission ---------------------------------------------------------------
     def submit(self, query, *, priority: Optional[int] = None,
                deadline_s: Optional[float] = None, tenant: str = "default",
-               weight: float = 1.0, label: Optional[str] = None
-               ) -> QueryHandle:
+               weight: float = 1.0, label: Optional[str] = None,
+               fingerprint: Optional[str] = None) -> QueryHandle:
         """Enqueue ``query`` — a DataFrame (its ``collect()`` runs) or a
         zero-arg callable — and return a :class:`QueryHandle`.
 
-        Raises :class:`QueryRejected` when the scheduler is closed or
-        the admission queue is at ``queueDepth`` (overload shedding).
+        ``fingerprint`` (the statement fingerprint from
+        ``cache/keys.statement_fingerprint``, supplied by the front
+        door for wire queries) keys the admission cost model: recurring
+        statements are admitted against their PREDICTED runtime and
+        device footprint; ``None`` / unknown fingerprints get the
+        static permit behavior.
+
+        Raises :class:`QueryRejected` — always with a typed ``reason``
+        and a ``retry_after_ms`` hint — when the scheduler is closed or
+        draining, the admission queue is at ``queueDepth`` with no
+        doomed entry to evict, the estimated queue delay exceeds
+        ``admission.maxQueueDelayMs`` (reason ``overload``), or the
+        query's deadline is already below its predicted runtime
+        (reason ``doomed``).
         """
         conf = self._conf()
         if priority is None:
@@ -270,36 +319,113 @@ class QueryScheduler:
             raise TypeError(
                 f"submit() takes a DataFrame or a zero-arg callable, "
                 f"not {type(query).__name__}")
-        with self._cv:
-            if self._closed:
-                raise QueryRejected("scheduler is closed")
-            if self._draining:
-                # admission stops FIRST during a graceful drain: the
-                # shed is typed so callers re-route to a sibling (or
-                # retry after the restart) instead of queueing behind a
-                # service that is leaving
-                self.rejected += 1
-                raise QueryRejected(
-                    "scheduler is draining (planned shutdown); "
-                    "resubmit against a sibling or retry after restart")
-            if len(self._queue) >= max(0, depth):
-                self.rejected += 1
-                raise QueryRejected(
-                    f"admission queue full ({len(self._queue)} queued >= "
-                    f"queueDepth={depth}); retry later or raise "
-                    f"spark.rapids.tpu.sql.scheduler.queueDepth")
-            self._seq += 1
-            label = label or f"submit-{self._seq:04d}"
-            control = QueryControl(label=label, deadline_s=deadline_s,
-                                   priority=priority, tenant=tenant,
-                                   weight=weight)
-            control.enqueued_t = _pc()
-            entry = _Entry(self._seq, label, fn, control,
-                           deadline_s=deadline_s)
-            self._queue.append(entry)
-            self.submitted += 1
-            self._cv.notify_all()
+        adm = self.admission
+        evicted: List[_Entry] = []
+        try:
+            with self._cv:
+                if self._closed:
+                    raise QueryRejected("scheduler is closed",
+                                        reason="closed")
+                if self._draining:
+                    # admission stops FIRST during a graceful drain: the
+                    # shed is typed so callers re-route to a sibling (or
+                    # retry after the restart) instead of queueing behind
+                    # a service that is leaving
+                    self.rejected += 1
+                    raise QueryRejected(
+                        "scheduler is draining (planned shutdown); "
+                        "resubmit against a sibling or retry after "
+                        "restart", reason="draining",
+                        retry_after_ms=adm.retry_after_ms(
+                            conf, len(self._queue)))
+                qlen = len(self._queue)
+                if adm.enabled(conf):
+                    # doomed-on-arrival: a deadline the prediction says
+                    # cannot be met is shed NOW, before it costs a slot
+                    if deadline_s is not None:
+                        rt = adm.predicted_runtime(fingerprint)
+                        if rt is not None and deadline_s < rt:
+                            self.rejected += 1
+                            raise QueryRejected(
+                                f"doomed: deadline {deadline_s:.3f}s < "
+                                f"predicted runtime {rt:.3f}s for "
+                                f"{fingerprint[:12]}", reason="doomed",
+                                retry_after_ms=adm.retry_after_ms(
+                                    conf, qlen))
+                    queued_fps = [e.fingerprint for e in self._queue]
+                    if adm.overloaded(queued_fps, conf):
+                        self.rejected += 1
+                        raise QueryRejected(
+                            f"overload: predicted backlog drain "
+                            f"{adm.backlog_s(queued_fps, conf) * 1e3:.0f}"
+                            f"ms > admission.maxQueueDelayMs; back off "
+                            f"and retry", reason="overload",
+                            retry_after_ms=adm.retry_after_ms(
+                                conf, qlen))
+                if len(self._queue) >= max(0, depth):
+                    # queue pressure: evict doomed-OLDEST entries first —
+                    # work that cannot meet its deadline yields its slot
+                    # to work that still can
+                    if adm.enabled(conf):
+                        now = _pc()
+                        for e in sorted(self._queue,
+                                        key=lambda e: e.seq):
+                            if adm.doomed(e.control, e.fingerprint, now):
+                                self._queue.remove(e)
+                                evicted.append(e)
+                                if len(self._queue) < max(0, depth):
+                                    break
+                    if len(self._queue) >= max(0, depth):
+                        self.rejected += 1
+                        raise QueryRejected(
+                            f"admission queue full ({len(self._queue)} "
+                            f"queued >= queueDepth={depth}); retry "
+                            f"later or raise "
+                            f"spark.rapids.tpu.sql.scheduler.queueDepth",
+                            reason="queue_full",
+                            retry_after_ms=adm.retry_after_ms(
+                                conf, len(self._queue)))
+                self._seq += 1
+                label = label or f"submit-{self._seq:04d}"
+                control = QueryControl(label=label, deadline_s=deadline_s,
+                                       priority=priority, tenant=tenant,
+                                       weight=weight)
+                control.enqueued_t = _pc()
+                entry = _Entry(self._seq, label, fn, control,
+                               deadline_s=deadline_s,
+                               fingerprint=fingerprint)
+                self._queue.append(entry)
+                self.submitted += 1
+                self._cv.notify_all()
+        except QueryRejected as exc:
+            adm.note_shed(exc.reason, label=label or "",
+                          retry_after_ms=exc.retry_after_ms)
+            raise
+        finally:
+            # typed futures resolve OUTSIDE the scheduler lock (done
+            # callbacks may take other locks); shed accounting rides
+            # along on every exit path
+            for e in evicted:
+                self._shed_queued(e, "doomed", conf)
         return QueryHandle(self, entry)
+
+    def _shed_queued(self, e: _Entry, reason: str, conf) -> None:
+        """Fail an entry removed from the QUEUE with a typed
+        :class:`QueryRejected` (it never ran; there is nothing to
+        unwind).  Caller must NOT hold the scheduler lock."""
+        e.status = "shed"
+        e.finished_t = _pc()
+        hint = self.admission.retry_after_ms(conf)
+        with self._cv:
+            self.rejected += 1
+        self.admission.note_shed(reason, label=e.label,
+                                 retry_after_ms=hint)
+        msg = f"{e.label} shed in queue: {reason}"
+        if reason == "doomed":
+            msg += (" (remaining deadline below predicted runtime);"
+                    " retry with a longer deadline")
+        e.future.set_exception(QueryRejected(
+            msg, reason=reason, retry_after_ms=hint))
 
     # -- ordering -----------------------------------------------------------------
     def _key(self, e: _Entry):
@@ -314,6 +440,29 @@ class QueryScheduler:
         e = min(self._queue, key=self._key)
         self._queue.remove(e)
         return e
+
+    def _select_locked(self, conf):
+        """Admission-aware pop: sweep DOOMED entries out of the queue
+        (returned for typed shedding outside the lock), then pick the
+        best entry — priority + weighted-fair order — whose predicted
+        device footprint fits the admission budget beside the in-flight
+        reservations.  A successful pick has its bytes RESERVED; the
+        reservation releases at completion.  With admission disabled
+        this degrades to :meth:`_pop_locked` exactly."""
+        adm = self.admission
+        if not adm.enabled(conf):
+            return [], self._pop_locked()
+        doomed: List[_Entry] = []
+        now = _pc()
+        for e in list(self._queue):
+            if adm.doomed(e.control, e.fingerprint, now):
+                self._queue.remove(e)
+                doomed.append(e)
+        for e in sorted(self._queue, key=self._key):
+            if adm.try_reserve(e, conf):
+                self._queue.remove(e)
+                return doomed, e
+        return doomed, None
 
     # -- admission ----------------------------------------------------------------
     def _admissible(self, conf) -> bool:
@@ -374,17 +523,31 @@ class QueryScheduler:
                     # timeout is only a backstop against missed wakeups
                     self._cv.wait(timeout=0.25)
                 continue
+            doomed: List[_Entry] = []
             with self._cv:
                 if self._closed:
                     return
                 if not self._queue \
                         or len(self._running) >= self._max_concurrent():
                     continue
-                entry = self._pop_locked()
-                if entry is None:
-                    continue
-                self._running.add(entry)
-                entry.status = "running"
+                doomed, entry = self._select_locked(conf)
+                if entry is not None:
+                    self._running.add(entry)
+                    entry.status = "running"
+            for d in doomed:
+                # shed IN THE QUEUE, typed: a query whose remaining
+                # deadline is below its predicted runtime never reaches
+                # the device (futures resolve outside the lock)
+                self._shed_queued(d, "doomed", conf)
+            if entry is None:
+                # queue non-empty but nothing fits the admission budget
+                # right now: wait for a completion (release listener /
+                # _finish notify) with a bounded backstop
+                with self._cv:
+                    if self._closed:
+                        return
+                    self._cv.wait(timeout=0.25)
+                continue
             th = threading.Thread(target=entry.cctx.run,
                                   args=(self._run_entry, entry),
                                   daemon=True,
@@ -393,8 +556,14 @@ class QueryScheduler:
             th.start()
 
     def _max_concurrent(self) -> int:
-        return max(1, self._conf()[
+        conf = self._conf()
+        conf_max = max(1, conf[
             "spark.rapids.tpu.sql.scheduler.maxConcurrent"])
+        # the AIMD controller (admission enabled) nudges the effective
+        # target between admission.aimd.floor and maxConcurrent from
+        # observed spill-degrade rate / p95 — sustained overload
+        # converges to the goodput plateau instead of spill thrash
+        return self.admission.target_concurrent(conf, conf_max)
 
     # -- execution ----------------------------------------------------------------
     def _run_entry(self, e: _Entry) -> None:
@@ -403,6 +572,7 @@ class QueryScheduler:
         e.started_t = _pc()
         e.worker_ident = threading.get_ident()
         ctl = e.control
+        ctl.note_dispatch()  # the watchdog's stall clock starts HERE
         ctl.admitted_t = e.started_t
         ctl.queue_wait_s = max(0.0, e.started_t - (ctl.enqueued_t
                                                    or e.started_t))
@@ -444,6 +614,15 @@ class QueryScheduler:
             except BaseException as exc:
                 status, error = "failed", exc
             e.stats = stats.snapshot()
+        # admission completion hook: release the byte reservation on
+        # EVERY terminal path; successful runs feed the cost model
+        # (EWMA runtime/footprint/spills per fingerprint) and the AIMD
+        # concurrency controller
+        try:
+            self.admission.on_query_done(
+                e, status, e.stats, _pc() - e.started_t, self._conf())
+        except Exception:  # fault-ok (accounting must never fail the query's resolution)
+            pass
         if status == "faulted" and self._maybe_resubmit(e, error):
             return  # the future stays pending; a fresh attempt is queued
         self._finish(e, status, result, error)
@@ -551,6 +730,10 @@ class QueryScheduler:
             e.finished_t = _pc()
             self.completed += 1
             self._cv.notify_all()
+        # the wedged worker will not reach its own completion hook:
+        # release its admission byte reservation here (idempotent — the
+        # zombie's eventual late release is a no-op)
+        self.admission.release(e)
         e.future.set_exception(error)
 
     # -- cancellation -------------------------------------------------------------
@@ -581,7 +764,7 @@ class QueryScheduler:
 
     def snapshot(self) -> Dict[str, float]:
         with self._cv:
-            return {"queued": len(self._queue),
+            snap = {"queued": len(self._queue),
                     "running": len(self._running),
                     "submitted": self.submitted,
                     "completed": self.completed,
@@ -589,7 +772,10 @@ class QueryScheduler:
                     "cancelled": self.cancelled,
                     "resubmitted": self.resubmitted,
                     "drained": self.drained,
-                    "draining": self._draining}
+                    "draining": self._draining,
+                    "max_concurrent_effective": self._max_concurrent()}
+        snap["admission"] = self.admission.snapshot()
+        return snap
 
     # -- graceful drain ------------------------------------------------------------
     def drain(self, deadline_s: Optional[float] = None) -> Dict[str, int]:

@@ -124,7 +124,15 @@ class QueryWatchdog:
                 if t0 is not None and now - t0 > stall_s:
                     self._reclaim(e)
                 continue
-            idle = now - max(ctl.progress_t, e.started_t or now)
+            # the stall clock starts at DISPATCH (QueryControl.
+            # note_dispatch stamps progress_t when the worker starts),
+            # never at submit: a query that waited past stallMs in a
+            # deep admission queue is the scheduler's business, not a
+            # hang.  An entry whose worker has not stamped yet is not
+            # yet running — skip it.
+            if ctl.dispatched_t is None:
+                continue
+            idle = now - max(ctl.progress_t, ctl.dispatched_t)
             window = stall_s if ctl.progress_seen \
                 else stall_s * _COLD_GRACE
             if idle <= window:

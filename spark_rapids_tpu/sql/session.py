@@ -326,15 +326,18 @@ class Session:
 
     def submit(self, df, *, priority: Optional[int] = None,
                deadline_s: Optional[float] = None, tenant: str = "default",
-               weight: float = 1.0, label: Optional[str] = None):
+               weight: float = 1.0, label: Optional[str] = None,
+               fingerprint: Optional[str] = None):
         """Submit a query for ASYNC execution through the session's
         scheduler; returns a :class:`..service.scheduler.QueryHandle`
-        (future + cancel + per-query stats).  Sheds with
-        :class:`..service.scheduler.QueryRejected` when the admission
-        queue is full."""
+        (future + cancel + per-query stats).  ``fingerprint`` (a
+        ``cache/keys.statement_fingerprint``; the front door supplies
+        it for wire queries) keys the predictive-admission cost model.
+        Sheds with a typed :class:`..service.scheduler.QueryRejected`
+        (reason + retry_after_ms) under overload."""
         return self.scheduler().submit(
             df, priority=priority, deadline_s=deadline_s, tenant=tenant,
-            weight=weight, label=label)
+            weight=weight, label=label, fingerprint=fingerprint)
 
     @contextlib.contextmanager
     def _control_scope(self, conf):

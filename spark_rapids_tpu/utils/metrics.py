@@ -150,6 +150,12 @@ class QueryStats:
         self.server_spooled_bytes = 0
         self.prepared_hits = 0
         self.prepared_misses = 0
+        # overload survival (service/admission.py): device spill events
+        # attributed to THIS query's scope (the spill catalog stamps
+        # the active scope at each device->host demotion) — the
+        # spill-degrade signal the admission cost model and the AIMD
+        # concurrency controller both consume
+        self.spill_events = 0
 
     # -- accessors ----------------------------------------------------------
     @classmethod

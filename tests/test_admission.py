@@ -1,0 +1,683 @@
+"""Overload survival: predictive admission, deadline-aware shedding,
+AIMD concurrency control, retry-storm control (ISSUE 11).
+
+The contracts under test:
+  (a) the cost model learns EWMA profiles per statement fingerprint and
+      unknown fingerprints fall back to static permit behavior;
+  (b) deadline-aware shedding: doomed queries (remaining deadline below
+      predicted runtime, or already expired) are shed TYPED — at
+      submit, in the queue, and as doomed-oldest eviction under queue
+      pressure — never dispatched to burn device time;
+  (c) memory packing: concurrent heavy-fingerprint queries are limited
+      by the admission byte budget at equal maxConcurrent, and
+      ``admission.enabled=false`` restores static permits exactly;
+  (d) the AIMD controller decreases multiplicatively on spill-degrade
+      windows and recovers additively on clean ones;
+  (e) every shed path is typed end-to-end (reason + retry_after_ms on
+      the wire) and leaks nothing — permits, quota slots, spool files,
+      spill handles (the PR 8/10 leak-hygiene discipline);
+  (f) the watchdog stall clock starts at DISPATCH, so deep queue wait
+      never trips a false stall;
+  (g) the WireClient retry token budget brakes retry storms while the
+      jittered backoff honors the server's retry_after_ms hint.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import ALL_ENTRIES, TpuConf
+from spark_rapids_tpu.memory.spill import get_catalog
+from spark_rapids_tpu.server import SqlFrontDoor, WireClient, WireError
+from spark_rapids_tpu.server.client import RetryBudget
+from spark_rapids_tpu.service import QueryRejected, QueryScheduler
+from spark_rapids_tpu.service.admission import (AimdController, CostModel,
+                                                SHED_REASONS)
+
+_pc = time.perf_counter
+
+
+def _mk_sched(**extra):
+    settings = {"spark.rapids.tpu.sql.scheduler.maxConcurrent": 1,
+                "spark.rapids.tpu.sql.scheduler.queueDepth": 8}
+    settings.update(extra)
+    return QueryScheduler(settings=settings)
+
+
+# ---------------------------------------------------------------------------
+# (a) cost model
+# ---------------------------------------------------------------------------
+
+class TestCostModel:
+    def test_ewma_and_unknown_fallback(self):
+        cm = CostModel()
+        assert cm.predict("fp") is None  # unknown -> permit behavior
+        assert cm.predict(None) is None
+        cm.observe("fp", 1.0, 1000, 2, alpha=0.5)
+        p = cm.predict("fp")
+        assert p.samples == 1
+        assert p.runtime_s == 1.0 and p.device_bytes == 1000.0
+        cm.observe("fp", 3.0, 3000, 0, alpha=0.5)
+        p = cm.predict("fp")
+        assert p.samples == 2
+        assert p.runtime_s == pytest.approx(2.0)
+        assert p.device_bytes == pytest.approx(2000.0)
+        assert p.spill_events == pytest.approx(1.0)
+        # the global drain-rate EWMA tracks fingerprint-less runs too
+        cm.observe(None, 5.0, 0, 0, alpha=0.5)
+        assert cm.mean_runtime_s > 2.0
+
+    def test_profile_cap_evicts_least_recent(self):
+        cm = CostModel()
+        cm.MAX_PROFILES = 4
+        for i in range(4):
+            cm.observe(f"fp{i}", 1.0, 1, 0, alpha=0.5)
+        cm.observe("fp0", 1.0, 1, 0, alpha=0.5)  # refresh fp0
+        cm.observe("fp9", 1.0, 1, 0, alpha=0.5)  # evicts fp1 (LRU)
+        assert cm.predict("fp0") is not None
+        assert cm.predict("fp1") is None
+        assert cm.predict("fp9") is not None
+
+
+# ---------------------------------------------------------------------------
+# (b) deadline-aware shedding
+# ---------------------------------------------------------------------------
+
+class TestDoomedShedding:
+    def test_doomed_on_arrival_typed(self):
+        sched = _mk_sched()
+        try:
+            conf = sched._conf()
+            alpha = conf["spark.rapids.tpu.sql.scheduler.admission"
+                         ".ewmaAlpha"]
+            # two samples: one cold outlier must never doom a statement
+            sched.admission.cost_model.observe("heavy", 5.0, 0, 0,
+                                               alpha=alpha)
+            assert sched.admission.predicted_runtime("heavy") is None
+            sched.admission.cost_model.observe("heavy", 5.0, 0, 0,
+                                               alpha=alpha)
+            with pytest.raises(QueryRejected) as ei:
+                sched.submit(lambda: 1, deadline_s=0.05,
+                             fingerprint="heavy")
+            assert ei.value.reason == "doomed"
+            assert ei.value.retry_after_ms > 0
+            assert sched.admission.sheds["doomed"] == 1
+            # same fingerprint with an achievable deadline admits fine
+            h = sched.submit(lambda: 2, deadline_s=30.0,
+                             fingerprint="heavy")
+            assert h.result(10) == 2
+        finally:
+            sched.close()
+
+    def test_doomed_in_queue_shed_at_dispatch(self):
+        """An entry whose deadline expires WHILE QUEUED is shed typed
+        at the next dispatch opportunity, never dispatched."""
+        sched = _mk_sched()
+        try:
+            gate = threading.Event()
+            ran = []
+            blocker = sched.submit(lambda: gate.wait(10), label="blk")
+            while sched.running() == 0:
+                time.sleep(0.005)
+            doomed = sched.submit(lambda: ran.append(1),
+                                  deadline_s=0.15, label="doomed")
+            time.sleep(0.3)  # deadline expires in the queue
+            gate.set()
+            blocker.result(10)
+            with pytest.raises(QueryRejected) as ei:
+                doomed.result(10)
+            assert ei.value.reason == "doomed"
+            assert ei.value.retry_after_ms > 0
+            assert doomed.status == "shed"
+            assert ran == [], "doomed entry must never dispatch"
+        finally:
+            sched.close()
+
+    def test_queue_pressure_evicts_doomed_oldest_first(self):
+        sched = _mk_sched(**{
+            "spark.rapids.tpu.sql.scheduler.queueDepth": 1})
+        try:
+            gate = threading.Event()
+            blocker = sched.submit(lambda: gate.wait(10), label="blk")
+            while sched.running() == 0:
+                time.sleep(0.005)
+            stale = sched.submit(lambda: "stale", deadline_s=0.05,
+                                 label="stale")
+            time.sleep(0.15)  # stale's deadline expires in the queue
+            # the queue is full, but the doomed entry yields its slot
+            fresh = sched.submit(lambda: "fresh", label="fresh")
+            with pytest.raises(QueryRejected) as ei:
+                stale.result(10)
+            assert ei.value.reason == "doomed"
+            gate.set()
+            blocker.result(10)
+            assert fresh.result(10) == "fresh"
+        finally:
+            sched.close()
+
+    def test_kill_switch_restores_static_behavior(self):
+        """admission.enabled=false: a doomed submission queues exactly
+        as before (and dies at its own deadline when dispatched)."""
+        sched = _mk_sched(**{
+            "spark.rapids.tpu.sql.scheduler.admission.enabled": False})
+        try:
+            sched.admission.cost_model.observe("heavy", 5.0, 0, 0,
+                                               alpha=0.3)
+            from spark_rapids_tpu.service import (QueryDeadlineExceeded,
+                                                  cancel)
+
+            def work():
+                # a cooperative callable: sleeps past its deadline and
+                # hits a batch-boundary checkpoint (what real queries do)
+                time.sleep(0.3)
+                cancel.check()
+
+            h = sched.submit(work, deadline_s=0.05,
+                             fingerprint="heavy")
+            with pytest.raises(QueryDeadlineExceeded):
+                h.result(10)
+            snap = sched.snapshot()
+            assert snap["admission"]["sheds"]["doomed"] == 0
+            # static permits: the effective target IS maxConcurrent
+            assert snap["max_concurrent_effective"] == 1
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# (c) memory packing A/B
+# ---------------------------------------------------------------------------
+
+class TestMemoryPacking:
+    def _run_heavy(self, admission_on: bool) -> int:
+        sched = _mk_sched(**{
+            "spark.rapids.tpu.sql.scheduler.maxConcurrent": 4,
+            "spark.rapids.tpu.sql.scheduler.admission.enabled":
+                admission_on,
+            "spark.rapids.tpu.sql.scheduler.admission"
+            ".deviceBudgetBytes": 1000})
+        try:
+            # a learned heavy profile: ~80% of the admission budget
+            sched.admission.cost_model.observe("heavy", 0.05, 800, 0,
+                                               alpha=0.3)
+            lock = threading.Lock()
+            cur = [0]
+            peak = [0]
+
+            def work():
+                with lock:
+                    cur[0] += 1
+                    peak[0] = max(peak[0], cur[0])
+                time.sleep(0.15)
+                with lock:
+                    cur[0] -= 1
+
+            handles = [sched.submit(work, fingerprint="heavy",
+                                    label=f"h{i}") for i in range(3)]
+            for h in handles:
+                h.result(20)
+            return peak[0]
+        finally:
+            sched.close()
+
+    def test_packing_limits_heavy_concurrency_and_ab(self):
+        # admission ON: two 800-byte predictions cannot share a
+        # 1000-byte budget -> heavy queries serialize
+        assert self._run_heavy(True) == 1
+        # kill switch OFF: static permits run them together
+        assert self._run_heavy(False) >= 2
+
+    def test_unknown_fingerprint_not_packed(self):
+        """No profile -> permit behavior even with a tiny budget."""
+        sched = _mk_sched(**{
+            "spark.rapids.tpu.sql.scheduler.maxConcurrent": 3,
+            "spark.rapids.tpu.sql.scheduler.admission"
+            ".deviceBudgetBytes": 1})
+        try:
+            lock = threading.Lock()
+            cur, peak = [0], [0]
+
+            def work():
+                with lock:
+                    cur[0] += 1
+                    peak[0] = max(peak[0], cur[0])
+                time.sleep(0.15)
+                with lock:
+                    cur[0] -= 1
+
+            hs = [sched.submit(work, label=f"u{i}") for i in range(3)]
+            for h in hs:
+                h.result(20)
+            assert peak[0] >= 2
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# (d) AIMD controller
+# ---------------------------------------------------------------------------
+
+class TestAimd:
+    def _conf(self, **kv):
+        base = {"spark.rapids.tpu.sql.scheduler.admission.aimd.window": 4}
+        base.update(kv)
+        return TpuConf(base)
+
+    def test_multiplicative_decrease_additive_increase(self):
+        conf = self._conf()
+        a = AimdController()
+        assert a.target(8, 1) == 8  # untouched -> conf max
+        for _ in range(4):  # one bad window (spills)
+            a.on_complete(0.1, True, conf, 8)
+        assert a.target(8, 1) == 4
+        for _ in range(4):
+            a.on_complete(0.1, True, conf, 8)
+        assert a.target(8, 1) == 2
+        for _ in range(8):  # two clean windows
+            a.on_complete(0.1, False, conf, 8)
+        assert a.target(8, 1) == 4
+        assert a.snapshot()["decreases"] == 2
+        assert a.snapshot()["increases"] == 2
+
+    def test_floor_and_latency_criterion(self):
+        conf = self._conf(**{
+            "spark.rapids.tpu.sql.scheduler.admission.aimd"
+            ".latencyTargetMs": 50.0})
+        a = AimdController()
+        for _ in range(16):  # p95 over target, no spills
+            a.on_complete(0.2, False, conf, 2)
+        assert a.target(2, 1) == 1  # clamped at the floor
+
+    def test_scheduler_effective_target_follows_aimd(self):
+        sched = _mk_sched(**{
+            "spark.rapids.tpu.sql.scheduler.maxConcurrent": 4,
+            "spark.rapids.tpu.sql.scheduler.admission.aimd.window": 2})
+        try:
+            conf = sched._conf()
+            for _ in range(2):
+                sched.admission.aimd.on_complete(0.1, True, conf, 4)
+            assert sched.snapshot()["max_concurrent_effective"] == 2
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# retry hints
+# ---------------------------------------------------------------------------
+
+class TestRetryAfter:
+    def test_clamped_to_conf_bounds(self):
+        sched = _mk_sched()
+        try:
+            conf = sched._conf()
+            lo = conf["spark.rapids.tpu.server.retryAfter.minMs"]
+            hi = conf["spark.rapids.tpu.server.retryAfter.maxMs"]
+            # no data yet: the floor
+            assert sched.admission.retry_after_ms(conf, 0) == int(lo)
+            # a deep queue of slow statements: the ceiling
+            sched.admission.cost_model.observe("s", 10.0, 0, 0,
+                                               alpha=0.3)
+            assert sched.admission.retry_after_ms(conf, 500) == int(hi)
+        finally:
+            sched.close()
+
+    def test_every_submit_shed_reason_typed(self):
+        """closed/draining/queue_full all carry reason + hint."""
+        sched = _mk_sched(**{
+            "spark.rapids.tpu.sql.scheduler.queueDepth": 0})
+        with pytest.raises(QueryRejected) as ei:
+            sched.submit(lambda: 1)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_ms > 0
+        sched.drain(deadline_s=0.1)
+        with pytest.raises(QueryRejected) as ei:
+            sched.submit(lambda: 1)
+        assert ei.value.reason == "draining"
+        sched.close()
+        with pytest.raises(QueryRejected) as ei:
+            sched.submit(lambda: 1)
+        assert ei.value.reason == "closed"
+        for r in ("queue_full", "draining", "closed"):
+            assert sched.admission.sheds[r] >= 1
+
+    def test_overload_shed_on_estimated_queue_delay(self):
+        sched = _mk_sched(**{
+            "spark.rapids.tpu.sql.scheduler.admission"
+            ".maxQueueDelayMs": 1.0})
+        try:
+            # mean runtime 2s at concurrency 1 -> any backlog is
+            # overload (an EMPTY queue never sheds)
+            sched.admission.cost_model.observe("s", 2.0, 0, 0,
+                                               alpha=0.3)
+            gate = threading.Event()
+            blocker = sched.submit(lambda: gate.wait(10))
+            while sched.running() == 0:
+                time.sleep(0.005)
+            filler = sched.submit(lambda: 1)  # empty queue: admitted
+            with pytest.raises(QueryRejected) as ei:
+                sched.submit(lambda: 2)
+            assert ei.value.reason == "overload"
+            assert ei.value.retry_after_ms > 0
+            gate.set()
+            blocker.result(10)
+            assert filler.result(10) == 1
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# (f) watchdog: stall clock starts at dispatch
+# ---------------------------------------------------------------------------
+
+class TestWatchdogDispatchClock:
+    def test_deep_queue_wait_is_not_a_stall(self):
+        """A query that waits in the queue LONGER than stallMs must not
+        be declared stalled — the stall clock starts at dispatch."""
+        sched = _mk_sched(**{
+            "spark.rapids.tpu.faults.watchdog.stallMs": 250.0})
+        try:
+            gate = threading.Event()
+            blocker = sched.submit(lambda: gate.wait(10), label="blk")
+            while sched.running() == 0:
+                time.sleep(0.005)
+            queued = sched.submit(lambda: "ok", label="waits-long")
+            # queue wait (0.6 s) is far beyond stallMs (0.25 s)
+            time.sleep(0.6)
+            assert queued.status == "queued"
+            gate.set()
+            assert blocker.result(10) is True
+            assert queued.result(10) == "ok"
+            assert queued.status == "done"
+            assert sched._watchdog.stalls == 0, \
+                "queue wait tripped the watchdog"
+            # the dispatch stamp existed for the ran query
+            assert queued._entry.control.dispatched_t is not None
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# (g) client-side retry-storm control
+# ---------------------------------------------------------------------------
+
+class TestRetryBudget:
+    def test_tokens_drain_and_refill_on_success(self):
+        b = RetryBudget(tokens=2.0, ratio=0.5)
+        assert b.allow() and b.allow()
+        assert not b.allow()  # broke
+        assert b.throttled == 1
+        b.on_success()
+        assert not b.allow()  # 0.5 token is not a whole retry
+        b.on_success()
+        assert b.allow()
+
+    def test_budget_never_exceeds_cap(self):
+        b = RetryBudget(tokens=1.0, ratio=0.5)
+        for _ in range(10):
+            b.on_success()
+        assert b.tokens() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# wire-level: typed sheds end-to-end + leak hygiene per shed flavor
+# ---------------------------------------------------------------------------
+
+N_ROWS = 12_000
+
+
+@pytest.fixture(scope="module")
+def overload_wire(session):
+    """A front door whose scheduler we can push into every shed flavor."""
+    s = session
+    rng = np.random.default_rng(20260805)
+    t = pa.table({
+        "k": rng.integers(0, 32, N_ROWS).astype("int64"),
+        "v": rng.random(N_ROWS) * 100.0,
+    })
+    s.conf.set("spark.rapids.tpu.sql.batchSizeRows", 3_000)
+    door = SqlFrontDoor(s).start()
+    door.register_table("t", lambda: s.create_dataframe(t))
+    yield s, door
+    door.close()
+    s.conf.unset("spark.rapids.tpu.sql.batchSizeRows")
+
+
+SPEC = {"table": "t",
+        "ops": [
+            {"op": "filter",
+             "expr": [">", ["col", "v"], ["param", 0, "double"]]},
+            {"op": "agg", "group": ["k"],
+             "aggs": [["n", "count", "*"],
+                      ["s", "sum", ["col", "v"]]]},
+            {"op": "sort", "keys": [["k", True]]}]}
+
+
+def _await_clean(s, door, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if s.scheduler().running() == 0 \
+                and door.snapshot()["queries_inflight"] == 0:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _assert_no_shed_leaks(s, door):
+    assert _await_clean(s, door), "shed left in-flight state behind"
+    assert door.quotas.inflight() == 0
+    get_catalog().assert_no_leaks()
+    # and the service still serves
+    with WireClient("127.0.0.1", door.port, retry_budget=0.0) as c:
+        assert c.query(SPEC, params=[50.0]).stats["status"] == "done"
+
+
+class TestWireShedTaxonomy:
+    @pytest.mark.parametrize(
+        "flavor", ["queue_full", "doomed", "overload", "quota",
+                   "draining"])
+    def test_shed_typed_and_leak_free(self, overload_wire, flavor):
+        s, door = overload_wire
+        sched = s.scheduler()
+        client = WireClient("127.0.0.1", door.port, retry_budget=0.0)
+        try:
+            if flavor == "queue_full":
+                s.conf.set("spark.rapids.tpu.sql.scheduler.queueDepth",
+                           0)
+                try:
+                    with pytest.raises(WireError) as ei:
+                        client.query(SPEC, params=[10.0])
+                finally:
+                    s.conf.unset(
+                        "spark.rapids.tpu.sql.scheduler.queueDepth")
+                assert ei.value.code == "REJECTED"
+                assert ei.value.reason == "queue_full"
+            elif flavor == "doomed":
+                # learn the statement's runtime (two samples — one cold
+                # outlier never dooms), then demand 1 ms
+                info = client.prepare(SPEC)
+                client.execute(info["statement_id"], [10.0])
+                client.execute(info["statement_id"], [10.0])
+                with pytest.raises(WireError) as ei:
+                    client.execute(info["statement_id"], [10.0],
+                                   deadline_ms=1)
+                assert ei.value.code == "REJECTED"
+                assert ei.value.reason == "doomed"
+            elif flavor == "overload":
+                client.query(SPEC, params=[10.0])  # seed mean runtime
+                gate = threading.Event()
+                s.conf.set(
+                    "spark.rapids.tpu.sql.scheduler.maxConcurrent", 1)
+                s.conf.set("spark.rapids.tpu.sql.scheduler.admission"
+                           ".maxQueueDelayMs", 0.001)
+                try:
+                    blocker = sched.submit(lambda: gate.wait(10),
+                                           label="ovl-blocker")
+                    while sched.running() == 0:
+                        time.sleep(0.005)
+                    filler = sched.submit(lambda: 1,
+                                          label="ovl-filler")
+                    with pytest.raises(WireError) as ei:
+                        client.query(SPEC, params=[10.0])
+                finally:
+                    gate.set()
+                    s.conf.unset(
+                        "spark.rapids.tpu.sql.scheduler.admission"
+                        ".maxQueueDelayMs")
+                    s.conf.unset(
+                        "spark.rapids.tpu.sql.scheduler.maxConcurrent")
+                blocker.result(10)
+                filler.result(10)
+                assert ei.value.code == "REJECTED"
+                assert ei.value.reason == "overload"
+            elif flavor == "quota":
+                door.quotas.reconfigure("*=1")
+                try:
+                    other = WireClient("127.0.0.1", door.port,
+                                       retry_budget=0.0)
+                    it = other.query_stream(SPEC, params=[10.0])
+                    assert next(it)[0] == "meta"  # holds its quota slot
+                    with pytest.raises(WireError) as ei:
+                        client.query(SPEC, params=[10.0])
+                    assert ei.value.code == "QUOTA_EXCEEDED"
+                    assert ei.value.reason == "quota"
+                    for _ in it:  # drain the holder cleanly
+                        pass
+                    other.close()
+                finally:
+                    door.quotas.reconfigure("")
+            else:  # draining
+                sched.drain(deadline_s=0.5)
+                try:
+                    with pytest.raises(WireError) as ei:
+                        client.query(SPEC, params=[10.0])
+                finally:
+                    sched.resume()
+                assert ei.value.code == "REJECTED"
+                assert ei.value.reason == "draining"
+            # EVERY shed flavor carries a usable retry hint
+            assert ei.value.retry_after_ms > 0, \
+                f"{flavor} shed carried no retry_after_ms"
+            client.close()
+            _assert_no_shed_leaks(s, door)
+        finally:
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    def test_submit_fingerprint_feeds_cost_model(self, overload_wire):
+        """Ad-hoc SUBMITs reuse the prepared-statement fingerprint
+        derivation, so recurring non-prepared statements learn a
+        profile too (the cache/keys satellite)."""
+        from spark_rapids_tpu.cache.keys import statement_fingerprint
+        s, door = overload_wire
+        with WireClient("127.0.0.1", door.port, retry_budget=0.0) as c:
+            c.query(SPEC, params=[25.0])
+        fp = statement_fingerprint(SPEC)
+        prof = s.scheduler().admission.cost_model.predict(fp)
+        assert prof is not None and prof.samples >= 1
+        assert prof.runtime_s > 0
+
+    def test_client_retry_budget_brakes_the_storm(self, overload_wire):
+        s, door = overload_wire
+        s.conf.set("spark.rapids.tpu.sql.scheduler.queueDepth", 0)
+        s.conf.set("spark.rapids.tpu.server.retryAfter.minMs", 1.0)
+        try:
+            c = WireClient("127.0.0.1", door.port, retry_budget=2.0)
+            with pytest.raises(WireError) as ei:
+                c.query(SPEC, params=[10.0])
+            assert ei.value.code == "REJECTED"
+            # exactly the budget's worth of retries, then surface typed
+            assert c.sheds_retried == 2
+            assert c.retry_budget.throttled >= 1
+            c.close()
+        finally:
+            s.conf.unset("spark.rapids.tpu.sql.scheduler.queueDepth")
+            s.conf.unset("spark.rapids.tpu.server.retryAfter.minMs")
+        _assert_no_shed_leaks(s, door)
+
+    def test_goaway_carries_retry_hint(self, overload_wire):
+        from spark_rapids_tpu.server.protocol import ServerDraining
+        s, door = overload_wire
+        c = WireClient("127.0.0.1", door.port, retry_budget=0.0)
+        door.begin_drain(siblings=[])
+        try:
+            import spark_rapids_tpu.server.protocol as P
+            with pytest.raises(ServerDraining) as ei:
+                P.send_frame(c._sock, P.REQ_SUBMIT,
+                             P.pack_json({"spec": SPEC,
+                                          "params": [10.0]}))
+                P.recv_frame(c._sock)
+            assert ei.value.retry_after_ms > 0
+            assert ei.value.reason == "draining"
+        finally:
+            with door._lock:
+                door._draining = False
+                door._siblings = []
+            try:
+                c._sock.close()
+            except OSError:
+                pass
+        _assert_no_shed_leaks(s, door)
+
+
+# ---------------------------------------------------------------------------
+# satellites: conf registration + docs
+# ---------------------------------------------------------------------------
+
+class TestSatellites:
+    ADMISSION_CONFS = [
+        "spark.rapids.tpu.sql.scheduler.admission.enabled",
+        "spark.rapids.tpu.sql.scheduler.admission.ewmaAlpha",
+        "spark.rapids.tpu.sql.scheduler.admission.deviceBudgetBytes",
+        "spark.rapids.tpu.sql.scheduler.admission.maxQueueDelayMs",
+        "spark.rapids.tpu.sql.scheduler.admission.aimd.floor",
+        "spark.rapids.tpu.sql.scheduler.admission.aimd.window",
+        "spark.rapids.tpu.sql.scheduler.admission.aimd.backoff",
+        "spark.rapids.tpu.sql.scheduler.admission.aimd"
+        ".spillDegradeThreshold",
+        "spark.rapids.tpu.sql.scheduler.admission.aimd.latencyTargetMs",
+        "spark.rapids.tpu.server.retryAfter.minMs",
+        "spark.rapids.tpu.server.retryAfter.maxMs",
+    ]
+
+    def test_admission_confs_registered_and_documented(self):
+        import os
+        docs = open(os.path.join(os.path.dirname(__file__), "..",
+                                 "docs", "configs.md")).read()
+        for key in self.ADMISSION_CONFS:
+            assert key in ALL_ENTRIES, f"{key} not registered"
+            assert key in docs, f"{key} missing from docs/configs.md"
+
+    def test_shed_reasons_complete(self):
+        assert set(SHED_REASONS) == {"queue_full", "doomed", "overload",
+                                     "draining", "closed"}
+
+    def test_wire_error_payload_roundtrip(self):
+        from spark_rapids_tpu.server.protocol import WireError as WE
+        e = WE("REJECTED", "queue full", detail="queue_full",
+               retry_after_ms=123, reason="queue_full")
+        e2 = WE.from_payload(e.to_payload())
+        assert e2.retry_after_ms == 123
+        assert e2.reason == "queue_full"
+        assert e2.code == "REJECTED"
+
+    def test_docs_linked(self):
+        import os
+        root = os.path.join(os.path.dirname(__file__), "..", "docs")
+        rob = open(os.path.join(root, "robustness.md")).read()
+        assert "Overload survival" in rob
+        assert "retry_after_ms" in rob
+        for doc in ("concurrency.md", "serving.md"):
+            txt = open(os.path.join(root, doc)).read()
+            assert "admission" in txt.lower()
+            assert "overload" in txt.lower()
+
+    def test_spill_events_query_scoped(self):
+        from spark_rapids_tpu.utils.metrics import QueryStats
+        with QueryStats.scoped() as st:
+            assert st.spill_events == 0
+        assert "spill_events" in QueryStats.process().snapshot()

@@ -23,6 +23,15 @@ per tenant), throughput, SLO violations, prepared-vs-fresh latency (the
 plan-cache win), prepared hit rate, shed/retry/GOAWAY counts — and
 FAILS (exit 1) on any result mismatch or leaked permit/handle/quota.
 
+``--overload`` is the OVERLOAD-SURVIVAL proof (ISSUE 11): measure
+single-load capacity closed-loop, then ramp OFFERED load (open loop,
+fixed issue schedule) to ~5x capacity with per-query deadlines.  The
+admission layer's cost-model packing, doomed shedding, overload
+shedding, and AIMD concurrency control must hold the goodput curve
+FLAT (no metastable dip): acceptance is goodput >= 0.85x capacity at
+every overloaded step, every shed typed (reason + retry_after_ms),
+zero leaks.  ``--admission-off`` is the A/B kill switch.
+
 Usage::
 
     python tools/loadgen.py [--queries 1000] [--connections 8]
@@ -30,6 +39,8 @@ Usage::
         [--fault-rate 0.02] [--slow-frac 0.05] [--slo-ms 2000]
         [--seed 42] [--json PATH]
     python tools/loadgen.py --soak [--soak-duration-s 60] [--doors 2]
+    python tools/loadgen.py --overload [--overload-duration-s 24]
+        [--overload-steps 1,2,3.5,5] [--admission-off]
 
 Environment fallbacks (the bench hooks): SRT_LOADGEN_QUERIES,
 SRT_LOADGEN_CONNECTIONS, SRT_LOADGEN_FAULT_RATE, SRT_LOADGEN_SEED,
@@ -256,11 +267,14 @@ def _worker(wid: int, addrs: List[Tuple[str, int]], tenant: str,
     def connect():
         """Fleet-aware dial: this worker's primary door first, then its
         siblings (a door mid-restart is briefly down — the fleet keeps
-        serving), with a short backoff between sweeps."""
+        serving), with a JITTERED backoff between sweeps — a restarted
+        door must not see every worker re-dial on the same curve at the
+        same instant (the reconnect herd)."""
         nonlocal client, prepared_ids
         if client is not None:
             with ctr.lock:
                 ctr.goaways += client.goaways_survived
+                ctr.retries += client.sheds_retried
             client = None
         last = None
         order = [primary] + [a for a in addrs if a != primary]
@@ -276,7 +290,7 @@ def _worker(wid: int, addrs: List[Tuple[str, int]], tenant: str,
                     return
                 except (OSError, WireError) as e:
                     last = e
-            time.sleep(0.05 * (sweep + 1))  # fault-ok (paced fleet re-dial while a door restarts, not an exception-swallowing retry loop)
+            time.sleep(0.05 * (sweep + 1) * (0.5 + rng.random()))  # fault-ok (paced jittered fleet re-dial while a door restarts, not an exception-swallowing retry loop)
         raise ConnectionError(f"no front door reachable: {last}")
 
     def attempt(name: str, spec: dict, params: list, use_prepared: bool):
@@ -356,7 +370,11 @@ def _worker(wid: int, addrs: List[Tuple[str, int]], tenant: str,
                     break  # typed query failure: counted, not retried
                 with ctr.lock:
                     ctr.retries += 1
-                time.sleep(0.02 * (attempt_i + 1))  # fault-ok (paced retry after a TYPED shed reply, not an exception-swallowing loop)
+                # honor the server's retry_after_ms hint (floor) with
+                # jitter on top — shed workers spread their retries
+                time.sleep(max(e.retry_after_ms / 1e3,
+                               0.02 * (attempt_i + 1))
+                           * (0.5 + rng.random()))  # fault-ok (paced hint-aware retry after a TYPED shed reply, not an exception-swallowing loop)
             except (ConnectionError, OSError):
                 # dropped connection (seeded server.conn fault or a real
                 # break): reconnect and retry — the fleet behavior
@@ -375,6 +393,7 @@ def _worker(wid: int, addrs: List[Tuple[str, int]], tenant: str,
     if client is not None:
         with ctr.lock:
             ctr.goaways += client.goaways_survived
+            ctr.retries += client.sheds_retried
         try:
             client.close()
         except Exception:  # fault-ok (best-effort goodbye at drain)
@@ -853,6 +872,309 @@ def run_soak(args) -> dict:
     return report
 
 
+# ---------------------------------------------------------------------------------
+# Overload mode: offered-load ramp to ~5x capacity (ISSUE 11)
+# ---------------------------------------------------------------------------------
+
+def run_overload(args) -> dict:
+    """Overload-survival proof: ramp OFFERED load (open loop) to ~5x
+    measured capacity and report the goodput curve, the typed shed
+    taxonomy, and admitted-query p99.
+
+    Phase A measures single-load capacity closed-loop (and warms the
+    admission cost model's per-fingerprint profiles — the workers run
+    prepared statements, so every query carries a statement
+    fingerprint).  Phase B issues queries on a fixed open-loop schedule
+    at ``--overload-steps`` multiples of that capacity; every query
+    carries a deadline, so the admission layer's doomed shedding,
+    overload shedding (``admission.maxQueueDelayMs``), queue bound, and
+    AIMD controller all engage.  Acceptance: goodput at 5x stays >=
+    ``--plateau-min`` (0.85) of capacity — a flat plateau, not the
+    metastable dip — every shed is TYPED with a positive
+    ``retry_after_ms``, and the drain leak audit is clean.
+    ``--admission-off`` is the A/B kill switch (static permits).
+    """
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.memory.spill import get_catalog
+    from spark_rapids_tpu.server import SqlFrontDoor, WireClient, WireError
+    from spark_rapids_tpu.service.admission import SHED_REASONS
+    from spark_rapids_tpu.utils.metrics import QueryStats
+
+    admission_on = not args.admission_off
+    sess = srt.Session.get_or_create()
+    sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 50_000)
+    # a deliberately tight service: 2 device slots + a short queue, so
+    # 5x offered load actually SATURATES it (the overload being proven)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.maxConcurrent", 2)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.queueDepth", 32)
+    sess.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.admission.enabled",
+                  admission_on)
+    if admission_on:
+        sess.conf.set(
+            "spark.rapids.tpu.sql.scheduler.admission.maxQueueDelayMs",
+            1000.0)
+
+    orders, customers = build_tables(args.rows, args.seed)
+    tables = {"orders": lambda: sess.create_dataframe(orders),
+              "customers": lambda: sess.create_dataframe(customers)}
+    door = SqlFrontDoor(sess, settings={
+        "spark.rapids.tpu.server.spool.memoryBytes": 1 << 20,
+        # offered load rides one connection per worker; the connection
+        # cap must not be the thing shedding (that taxonomy is REJECTED
+        # without an admission reason)
+        "spark.rapids.tpu.server.maxConnections": 256,
+    }).start()
+    for name, factory in tables.items():
+        door.register_table(name, factory)
+
+    tmpls = templates()
+    # the heavy/light fingerprint mix the cost model packs against:
+    # half the traffic is the join+rollup (the q21 shape), half the
+    # point lookup — the drain rate is heavy-dominated, which is
+    # exactly what the backlog predictor must get right
+    mix = [("seg_rollup", 0.5), ("point_lookup", 0.5)]
+    stats0 = QueryStats.process().snapshot()
+
+    class _Step:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.lat_ms: List[float] = []
+            self.sheds: Dict[str, int] = {}
+            self.deadline_exceeded = 0
+            self.untyped = 0
+            self.errors: Dict[str, int] = {}
+            self.issued = 0
+
+        def shed(self, reason: str, typed: bool) -> None:
+            with self.lock:
+                self.sheds[reason] = self.sheds.get(reason, 0) + 1
+                if not typed:
+                    self.untyped += 1
+
+    # offered load is only real if enough in-flight requests exist to
+    # overflow running + queue: size the worker pool well past
+    # maxConcurrent + queueDepth (sheds answer in ~1 ms, so shed
+    # workers recycle onto the schedule fast)
+    n_workers = max(48, args.connections)
+    # retry_budget=0: overload workers surface every shed typed instead
+    # of absorbing it — the harness measures the SERVER's behavior; the
+    # client-side retry-budget contract has its own tests
+    clients: List[Optional[WireClient]] = [None] * n_workers
+
+    def client_for(wid: int) -> WireClient:
+        c = clients[wid]
+        if c is None:
+            c = WireClient("127.0.0.1", door.port,
+                           tenant=f"tenant-{1 + wid % args.tenants}",
+                           timeout=120.0, retry_budget=0.0)
+            clients[wid] = c
+        return c
+
+    prepared: Dict[int, Dict[str, str]] = {}
+
+    def one_query(wid: int, rng, step: _Step,
+                  deadline_ms: int) -> None:
+        name = "seg_rollup" if rng.random() < mix[0][1] \
+            else "point_lookup"
+        spec, pools = tmpls[name]
+        params = list(pools[int(rng.integers(len(pools)))])
+        try:
+            c = client_for(wid)
+            ids = prepared.setdefault(wid, {})
+            sid = ids.get(name)
+            if sid is None:
+                sid = c.prepare(spec)["statement_id"]
+                ids[name] = sid
+            t0 = _pc()
+            c.execute(sid, params, deadline_ms=deadline_ms)
+            with step.lock:
+                step.lat_ms.append((_pc() - t0) * 1e3)
+        except WireError as e:
+            if e.code == "REJECTED":
+                step.shed(e.reason or e.detail or "rejected",
+                          typed=bool(e.reason) and e.retry_after_ms > 0)
+            elif e.code == "QUOTA_EXCEEDED":
+                step.shed("quota", typed=e.retry_after_ms > 0)
+            elif e.code == "DEADLINE":
+                with step.lock:
+                    step.deadline_exceeded += 1
+            else:
+                with step.lock:
+                    step.errors[e.code] = step.errors.get(e.code, 0) + 1
+        except (ConnectionError, OSError):
+            clients[wid] = None  # re-dial on the next slot
+            with step.lock:
+                step.errors["CONN"] = step.errors.get("CONN", 0) + 1
+
+    def closed_loop(duration_s: float, step: _Step) -> float:
+        """Phase A: back-to-back issue from every worker (capacity)."""
+        t_end = _pc() + duration_s
+        def w(wid):
+            rng = np.random.default_rng(args.seed + 1000 + wid)
+            while _pc() < t_end:
+                one_query(wid, rng, step, args.overload_deadline_ms)
+        ths = [threading.Thread(target=w, args=(i,), daemon=True)
+               for i in range(args.connections)]
+        t0 = _pc()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=args.timeout)
+        return _pc() - t0
+
+    def open_loop(offered_qps: float, duration_s: float,
+                  step: _Step) -> float:
+        """Phase B: queries issued on a fixed schedule regardless of
+        completion (the offered-load shape; a shed answers fast, so the
+        schedule holds even at 5x)."""
+        interval = 1.0 / max(0.1, offered_qps)
+        slot = [0]
+        slot_lock = threading.Lock()
+        t0 = _pc()
+        t_end = t0 + duration_s
+        def w(wid):
+            rng = np.random.default_rng(args.seed + 2000 + wid)
+            while True:
+                now = _pc()
+                if now >= t_end:
+                    return  # the step ends on the WALL clock: slots
+                            # the pool fell behind on are dropped, not
+                            # replayed past the window
+                with slot_lock:
+                    i = slot[0]
+                    slot[0] += 1
+                t_issue = t0 + i * interval
+                if t_issue >= t_end:
+                    return
+                if t_issue > now:
+                    time.sleep(t_issue - now)
+                with step.lock:
+                    step.issued += 1
+                one_query(wid, rng, step, args.overload_deadline_ms)
+        ths = [threading.Thread(target=w, args=(i,), daemon=True)
+               for i in range(n_workers)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=args.timeout)
+        return _pc() - t0
+
+    def settle(timeout_s: float = 20.0) -> None:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and (
+                sess.scheduler().running()
+                or door.snapshot()["queries_inflight"]):
+            time.sleep(0.05)
+
+    # warmup (XLA compiles + cost-model seed), then the capacity probe
+    warm = _Step()
+    for wid in range(min(2, args.connections)):
+        rng = np.random.default_rng(args.seed + wid)
+        for _ in range(4):
+            one_query(wid, rng, warm, 0)
+    cap_step = _Step()
+    cap_wall = closed_loop(args.capacity_probe_s, cap_step)
+    settle()
+    capacity_qps = len(cap_step.lat_ms) / cap_wall if cap_wall else 0.0
+
+    steps_out = []
+    step_multiples = [float(m) for m in
+                      args.overload_steps.split(",") if m.strip()]
+    step_s = args.overload_duration_s / max(1, len(step_multiples))
+    sheds_total: Dict[str, int] = {}
+    untyped_total = 0
+    for m in step_multiples:
+        st = _Step()
+        offered = max(1.0, m * capacity_qps)
+        wall = open_loop(offered, step_s, st)
+        settle()
+        goodput = len(st.lat_ms) / wall if wall else 0.0
+        for k, v in st.sheds.items():
+            sheds_total[k] = sheds_total.get(k, 0) + v
+        untyped_total += st.untyped
+        steps_out.append({
+            "offered_x": m,
+            "offered_qps": round(offered, 2),
+            "issued": st.issued,
+            "goodput_qps": round(goodput, 2),
+            "admitted_p50_ms": round(_pct(st.lat_ms, 0.5), 2),
+            "admitted_p99_ms": round(_pct(st.lat_ms, 0.99), 2),
+            "deadline_exceeded": st.deadline_exceeded,
+            "sheds": dict(sorted(st.sheds.items())),
+            "errors": st.errors,
+        })
+        print(f"[loadgen] overload {m:g}x: offered "
+              f"{offered:.1f}qps goodput {goodput:.1f}qps "
+              f"p99={_pct(st.lat_ms, 0.99):.0f}ms sheds={st.sheds}",
+              file=sys.stderr)
+
+    # single-load capacity = the 1x step's goodput (same open-loop
+    # harness, same worker pool — the probe's closed-loop number is
+    # reported but has different queueing dynamics); the plateau is
+    # what the OVERLOADED steps hold relative to it
+    base_steps = [s for s, m in zip(steps_out, step_multiples)
+                  if m <= 1.0]
+    over_steps = [s for s, m in zip(steps_out, step_multiples)
+                  if m > 1.0]
+    baseline_qps = max((s["goodput_qps"] for s in base_steps),
+                       default=capacity_qps)
+    plateau_ratio = (min(s["goodput_qps"] for s in over_steps)
+                     / baseline_qps) if over_steps and baseline_qps \
+        else 0.0
+
+    # drain + leak audit (the same discipline as run()/run_soak())
+    for c in clients:
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # fault-ok (best-effort goodbye at drain)
+                pass
+    settle(30.0)
+    snap = door.snapshot()
+    leaks: List[str] = []
+    if sess.scheduler().running() != 0:
+        leaks.append(f"scheduler running={sess.scheduler().running()}")
+    if snap["queries_inflight"] != 0:
+        leaks.append(f"wire queries inflight={snap['queries_inflight']}")
+    if door.quotas.inflight() != 0:
+        leaks.append(f"tenant quota inflight={door.quotas.inflight()}")
+    door.close()
+    try:
+        get_catalog().assert_no_leaks()
+    except AssertionError as e:
+        leaks.append(f"spill handles: {e}")
+    delta = QueryStats.delta_since(stats0)
+    # server-side taxonomy must agree that every shed carried a reason
+    sched_sheds = snap["scheduler"]["admission"]["sheds"]
+    unknown_reasons = sorted(set(sheds_total)
+                             - set(SHED_REASONS) - {"quota"})
+
+    report = {
+        "overload_survival": 1,
+        "admission_enabled": admission_on,
+        "capacity_qps": round(capacity_qps, 2),
+        "baseline_goodput_qps": round(baseline_qps, 2),
+        "capacity_queries": len(cap_step.lat_ms),
+        "steps": steps_out,
+        "plateau_ratio": round(plateau_ratio, 3),
+        "plateau_min": args.plateau_min,
+        "sheds_total": dict(sorted(sheds_total.items())),
+        "sheds_scheduler": sched_sheds,
+        "untyped_sheds": untyped_total,
+        "unknown_shed_reasons": unknown_reasons,
+        "spill_events": delta.get("spill_events", 0),
+        "aimd": snap["scheduler"]["admission"]["aimd"],
+        "cost_model": snap["scheduler"]["admission"]["cost_model"],
+        "max_concurrent_effective":
+            snap["scheduler"]["max_concurrent_effective"],
+        "leaks": leaks,
+    }
+    return report
+
+
 def main(argv=None) -> int:
     env = os.environ
     ap = argparse.ArgumentParser(description=__doc__)
@@ -882,7 +1204,42 @@ def main(argv=None) -> int:
                     default=float(env.get("SRT_SOAK_DURATION_S", "60")))
     ap.add_argument("--doors", type=int, default=2)
     ap.add_argument("--drain-deadline-s", type=float, default=10.0)
+    # overload mode (ISSUE 11): offered-load ramp to ~5x measured
+    # capacity — goodput plateau, typed shed taxonomy, admitted p99
+    ap.add_argument("--overload", action="store_true")
+    ap.add_argument("--overload-duration-s", type=float,
+                    default=float(env.get("SRT_OVERLOAD_DURATION_S",
+                                          "24")))
+    ap.add_argument("--capacity-probe-s", type=float, default=6.0)
+    ap.add_argument("--overload-steps", default="1,2,3.5,5")
+    ap.add_argument("--overload-deadline-ms", type=int, default=800)
+    ap.add_argument("--plateau-min", type=float, default=0.85)
+    ap.add_argument("--admission-off", action="store_true",
+                    help="A/B kill switch: run the overload ramp with "
+                         "admission.enabled=false (static permits)")
     args = ap.parse_args(argv)
+
+    if args.overload:
+        report = run_overload(args)
+        line = json.dumps(report, sort_keys=True)
+        print(line)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(line + "\n")
+        ok = (not report["leaks"]
+              and report["untyped_sheds"] == 0
+              and not report["unknown_shed_reasons"]
+              and report["plateau_ratio"] >= args.plateau_min
+              and report["capacity_qps"] > 0)
+        print(f"[loadgen] OVERLOAD capacity={report['capacity_qps']}qps "
+              f"plateau_ratio={report['plateau_ratio']} "
+              f"(min {args.plateau_min})  "
+              f"sheds={report['sheds_total']}  "
+              f"untyped={report['untyped_sheds']}  "
+              f"spill_events={report['spill_events']}  "
+              f"admission={'on' if report['admission_enabled'] else 'off'}"
+              f"  leaks={report['leaks'] or 'none'}", file=sys.stderr)
+        return 0 if ok else 1
 
     if args.soak:
         report = run_soak(args)

@@ -443,6 +443,14 @@ _memo: Dict[str, LintReport] = {}
 def _tree_fingerprint(repo: str, roots: Iterable[str]) -> str:
     h = hashlib.sha1(ENGINE_VERSION.encode())
     own = os.path.dirname(os.path.abspath(__file__))
+    # docs/configs.md is an INPUT of the conf-registry pass (two-way
+    # registry<->doc sync) but lives outside the scanned roots: a
+    # regenerated doc must invalidate a cached failing report
+    try:
+        st = os.stat(os.path.join(repo, "docs", "configs.md"))
+        h.update(f"configs.md|{st.st_mtime_ns}|{st.st_size}".encode())
+    except OSError:
+        pass
     for base in [os.path.join(repo, r) for r in roots] + [own]:
         for dirpath, dirnames, filenames in os.walk(base):
             dirnames.sort()

@@ -206,6 +206,10 @@ def analyze(data: dict) -> dict:
     # network-front-door events (cat "server")
     server_events = [e for e in xs if e.get("cat") == "server"]
 
+    # scheduler/admission events (cat "scheduler": queue-wait spans,
+    # admission:shed / admission:aimd marks)
+    sched_events = [e for e in xs if e.get("cat") == "scheduler"]
+
     def _fname_cat(evs, n):
         return sum(1 for e in evs if e.get("name") == n)
 
@@ -299,6 +303,13 @@ def analyze(data: dict) -> dict:
                                        _fname_cat(server_events,
                                                   "server:prepared_hit"))),
         "prepared_misses": int(qargs.get("prepared_misses", 0)),
+        # overload survival (cat "scheduler": admission:shed /
+        # admission:aimd marks land in whatever trace was active at the
+        # shed/adjustment; spill_events from the QueryStats snapshot is
+        # the per-query spill-degrade signal the AIMD controller eats)
+        "spill_events": int(qargs.get("spill_events", 0)),
+        "admission_sheds": _fname_cat(sched_events, "admission:shed"),
+        "aimd_changes": _fname_cat(sched_events, "admission:aimd"),
     }
 
 
@@ -377,6 +388,15 @@ def format_report(a: dict) -> str:
         lines.append(
             f"stalls: detected={a['stalls_detected']} "
             f"reclaims={a['watchdog_reclaims']} (watchdog)")
+    # admission summary only when the overload machinery acted (spill
+    # demotions charged to this query, typed sheds, AIMD adjustments)
+    adm = (a.get("spill_events", 0) + a.get("admission_sheds", 0)
+           + a.get("aimd_changes", 0))
+    if adm:
+        lines.append(
+            f"admission: spill_events={a['spill_events']} "
+            f"sheds={a['admission_sheds']} "
+            f"aimd_changes={a['aimd_changes']}")
     # server summary only when the query arrived over the wire (stream
     # writes / spool / prepared-cache traffic)
     srv = (a.get("server_stream_bytes", 0) + a.get("server_writes", 0)
