@@ -40,7 +40,8 @@ class TpuSemaphore:
 
     @property
     def permits(self) -> int:
-        return self._permits
+        with self._cv:  # resize() runs concurrently with probes
+            return self._permits
 
     def available(self) -> int:
         """Free permits right now (scheduler admission probe)."""

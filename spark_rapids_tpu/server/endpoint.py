@@ -91,7 +91,10 @@ class SqlFrontDoor:
         # naming the sibling endpoints; in-flight streams finish first
         self._draining = False
         self._siblings: list = []
-        # lifetime counters (STATUS + the loadgen report read these)
+        # lifetime counters (STATUS + the loadgen report read these).
+        # Bumped under self._lock: the accept loop and N connection
+        # handlers all write them, and an unguarded += is a lost update
+        # (srtlint shared-state-races found exactly that here)
         self.connections_total = 0
         self.connections_rejected = 0
         self.queries_total = 0
@@ -200,11 +203,12 @@ class SqlFrontDoor:
             # the GOAWAY window: clients parked between requests learn
             # about the restart from a typed frame, not a dead socket
             time.sleep(linger_s)
-        report = {"drained": True,
-                  "in_flight_cancelled": len(stragglers),
-                  "in_flight_leftover": leftover,
-                  "goaways_sent": self.goaways_sent,
-                  "siblings": list(self._siblings)}
+        with self._lock:
+            report = {"drained": True,
+                      "in_flight_cancelled": len(stragglers),
+                      "in_flight_leftover": leftover,
+                      "goaways_sent": self.goaways_sent,
+                      "siblings": list(self._siblings)}
         self.close()
         return report
 
@@ -246,8 +250,8 @@ class SqlFrontDoor:
                 continue
             except OSError:
                 return  # closed
-            self.connections_total += 1
             with self._lock:
+                self.connections_total += 1
                 draining = self._draining
                 if self._closed or draining \
                         or len(self._conns) >= max_conns:
@@ -267,7 +271,8 @@ class SqlFrontDoor:
                     pass
                 continue
             if over:
-                self.connections_rejected += 1
+                with self._lock:
+                    self.connections_rejected += 1
                 try:
                     P.send_frame(conn, P.RSP_ERROR, WireError(
                         "REJECTED",
@@ -381,7 +386,8 @@ class SqlFrontDoor:
             P.send_frame(conn, P.RSP_GOAWAY, P.goaway_payload(
                 "server draining for planned restart", siblings,
                 retry_after_ms=hint))
-            self.goaways_sent += 1
+            with self._lock:
+                self.goaways_sent += 1
         except OSError:
             pass
 
@@ -594,9 +600,9 @@ class SqlFrontDoor:
         handle.future.add_done_callback(
             lambda fut: (fut.exception() is not None
                          and stream.fail_if_open(fut.exception())))
-        self.queries_total += 1
         wq = _WireQuery(query_id, handle, stream, csess.tenant, label)
         with self._lock:
+            self.queries_total += 1
             self._queries[query_id] = wq
         return wq
 
@@ -632,7 +638,8 @@ class SqlFrontDoor:
                 if t_first is None:
                     t_first = _pc()
                 sent += n
-                self.streamed_bytes += n
+                with self._lock:
+                    self.streamed_bytes += n
                 tr = wq.handle.trace()
                 if tr is not None:
                     tr.add_event(None, "server:stream_write", "server",
@@ -673,7 +680,8 @@ class SqlFrontDoor:
                 code, detail = "INTERNAL", type(e).__name__
             self._try_error(conn, WireError(code, str(e), detail=detail))
             return
-        self.spooled_bytes += wq.stream.spooled_bytes
+        with self._lock:
+            self.spooled_bytes += wq.stream.spooled_bytes
         # the producer finished; the handle resolves imminently
         try:
             wq.handle.result(timeout=30.0)
@@ -699,7 +707,8 @@ class SqlFrontDoor:
         release the spool.  Quota release is in _finish_query's caller
         path; permits/slots/handles release through the ordinary
         scheduler unwind — the leak-hygiene tests assert all of it."""
-        self.conn_lost += 1
+        with self._lock:
+            self.conn_lost += 1
         wq.handle.cancel("client disconnected")
         wq.stream.close()
 
@@ -720,19 +729,20 @@ class SqlFrontDoor:
     def snapshot(self) -> Dict[str, Any]:
         sched = self._session.scheduler()
         with self._lock:
-            running = len(self._queries)
-            conns = len(self._conns)
+            counters = {
+                "connections": len(self._conns),
+                "connections_total": self.connections_total,
+                "connections_rejected": self.connections_rejected,
+                "queries_total": self.queries_total,
+                "queries_inflight": len(self._queries),
+                "conn_lost": self.conn_lost,
+                "draining": self._draining,
+                "goaways_sent": self.goaways_sent,
+                "streamed_bytes": self.streamed_bytes,
+                "spooled_bytes": self.spooled_bytes,
+            }
         return {
-            "connections": conns,
-            "connections_total": self.connections_total,
-            "connections_rejected": self.connections_rejected,
-            "queries_total": self.queries_total,
-            "queries_inflight": running,
-            "conn_lost": self.conn_lost,
-            "draining": self._draining,
-            "goaways_sent": self.goaways_sent,
-            "streamed_bytes": self.streamed_bytes,
-            "spooled_bytes": self.spooled_bytes,
+            **counters,
             "scheduler": sched.snapshot(),
             "prepared": self.prepared.snapshot(),
         }

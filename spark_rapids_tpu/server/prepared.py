@@ -121,7 +121,10 @@ class PreparedCache:
         stmt = PreparedStatement(fp, spec, param_types, phys,
                                  df._plan.schema(), plan_s)
         QueryStats.get().prepared_misses += 1
-        self.misses += 1
+        # under the lock: N connection handlers miss concurrently, and
+        # an unguarded += loses updates (srtlint shared-state-races)
+        with self._lock:
+            self.misses += 1
         if not enabled:
             return stmt, False
         cap = conf["spark.rapids.tpu.server.preparedCache.maxEntries"]

@@ -41,7 +41,7 @@ from typing import Any, Dict, Optional, Tuple
 
 __all__ = [
     "FRAME", "MAX_FRAME_BYTES", "WireError", "ProtocolError",
-    "ServerDraining",
+    "ServerDraining", "ERROR_CODES",
     "send_frame", "recv_frame", "pack_json", "unpack_json",
     "goaway_payload",
     # request frame types
@@ -92,6 +92,17 @@ _REQUEST_TYPES = (REQ_HELLO, REQ_SUBMIT, REQ_PREPARE, REQ_EXECUTE,
 _RESPONSE_TYPES = (RSP_WELCOME, RSP_META, RSP_BATCH, RSP_END, RSP_ERROR,
                    RSP_PREPARED, RSP_CANCELLED, RSP_STATUS, RSP_BYE,
                    RSP_GOAWAY)
+
+# THE canonical error-code vocabulary (the table above, plus DRAINING —
+# the GOAWAY shed).  srtlint's protocol-conformance pass holds every
+# WireError construction and client-side ``.code`` dispatch to this
+# list, both ways: an unregistered code and a registered-but-never-
+# constructed code are both findings.
+ERROR_CODES = (
+    "UNAUTHENTICATED", "BAD_REQUEST", "REJECTED", "QUOTA_EXCEEDED",
+    "CANCELLED", "DEADLINE", "FAULTED", "NOT_FOUND", "INTERNAL",
+    "DRAINING",
+)
 
 
 class ProtocolError(RuntimeError):

@@ -91,7 +91,7 @@ class StatementProfile:
         self.samples += 1
 
     def snapshot(self) -> Dict[str, float]:
-        return {"runtime_s": round(self.runtime_s, 6),
+        return {"runtime_s": round(self.runtime_s, 6),  # srtlint: ignore[shared-state-races] (introspection read of EWMA floats: writers serialize under CostModel._lock; a stale read yields a slightly stale estimate, never a torn value)
                 "device_bytes": round(self.device_bytes, 1),
                 "spill_events": round(self.spill_events, 3),
                 "samples": self.samples}

@@ -53,13 +53,14 @@ def rng():
 
 def pytest_collection_modifyitems(config, items):
     """Collection-time static analysis: ONE cached srtlint scan
-    (tools/srtlint — AST engine, eight passes over a single shared
+    (tools/srtlint — AST engine, twelve passes over a single shared
     parse) replaces the five regex lints that each re-read the whole
-    tree here.  The scan is memoized on an mtime+size snapshot of the
-    tree, so an unchanged tree re-verifies in milliseconds; any
-    unsuppressed finding fails the run before a single test executes.
-    Rule docs: python -m tools.srtlint --explain <rule>, or
-    docs/static_analysis.md."""
+    tree here.  The scan is keyed by per-file CONTENT hashes: an
+    unchanged tree re-verifies in milliseconds, and a changed tree
+    re-verifies incrementally (only edited files + passes whose scope
+    the edit touches re-run); any unsuppressed finding fails the run
+    before a single test executes.  Rule docs: python -m tools.srtlint
+    --explain <rule>, or docs/static_analysis.md."""
     from tools.srtlint import run_for_pytest
     report = run_for_pytest()
     if report.failing:

@@ -421,12 +421,13 @@ class TestEngine:
         assert "lock-acquisition graph" in capsys.readouterr().out
         assert engine.main(["--explain", "nope"]) == 2
 
-    def test_explain_covers_all_nine_rules(self):
+    def test_explain_covers_all_twelve_rules(self):
         rules = engine.available_rules()
         assert rules == ["blocking-fetch", "span-timing", "ctx-threads",
                          "cache-keys", "fault-paths", "release-paths",
                          "lock-discipline", "shutdown-paths",
-                         "conf-registry"]
+                         "shared-state-races", "typestate",
+                         "protocol-conformance", "conf-registry"]
         for r in rules:
             assert r in engine.explain_rule(r)
 
@@ -436,9 +437,484 @@ class TestEngine:
         assert [f.rule for f in report.failing] == ["parse-error"]
 
 
+# ---------------------------------------------------------------------------
+# PR 12 passes: races, typestate, protocol conformance
+# ---------------------------------------------------------------------------
+
+# the seeded unguarded-counter race: one accept loop spawning handler
+# threads in a while loop (a MULTI-instance root), both bumping a
+# counter the snapshot reads — no lock anywhere
+_RACE_BAD = (
+    "import threading\n"
+    "class Door:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.queries_total = 0\n"
+    "    def start(self):\n"
+    "        self._th = threading.Thread(target=self._accept_loop)\n"
+    "        self._th.start()\n"
+    "    def _accept_loop(self):\n"
+    "        while True:\n"
+    "            th = threading.Thread(target=self._handle)\n"
+    "            th.start()\n"
+    "    def _handle(self):\n"
+    "        self.queries_total += 1\n"
+    "    def close(self):\n"
+    "        self._th.join(timeout=2.0)\n")
+
+
+class TestSharedStateRaces:
+    def test_unguarded_counter_across_handler_threads(self, tmp_path):
+        report = _lint(tmp_path, {"server/bad.py": _RACE_BAD},
+                       ["shared-state-races"])
+        assert len(report.failing) == 1
+        f = report.failing[0]
+        assert "queries_total" in f.message and f.line == 14
+        assert "[xN]" in f.message  # the multi-instance handler root
+
+    def test_lock_guarded_counter_clean(self, tmp_path):
+        src = _RACE_BAD.replace(
+            "        self.queries_total += 1\n",
+            "        with self._lock:\n"
+            "            self.queries_total += 1\n")
+        report = _lint(tmp_path, {"server/ok.py": src},
+                       ["shared-state-races"])
+        # the write is guarded; no OTHER access exists to pair with it
+        assert report.failing == []
+
+    def test_guarded_write_vs_bare_read_flagged_at_read(self, tmp_path):
+        src = _RACE_BAD.replace(
+            "        self.queries_total += 1\n",
+            "        with self._lock:\n"
+            "            self.queries_total += 1\n").replace(
+            "    def close(self):\n",
+            "    def snapshot(self):\n"
+            "        return self.queries_total\n"
+            "    def close(self):\n")
+        report = _lint(tmp_path, {"server/bad.py": src},
+                       ["shared-state-races"])
+        assert len(report.failing) == 1
+        assert report.failing[0].line == 17  # the bare read site
+
+    def test_immutable_after_publish_and_single_writer_clean(
+            self, tmp_path):
+        report = _lint(tmp_path, {"server/ok.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self.addr = ('h', 1)\n"       # init-only write
+            "        self.count = 0\n"
+            "    def start(self):\n"
+            "        self._th = threading.Thread(target=self._loop)\n"
+            "        self._th.start()\n"
+            "    def _loop(self):\n"
+            "        self.count += 1\n"            # single-writer root
+            "    def peer(self):\n"
+            "        return self.addr\n"
+            "    def close(self):\n"
+            "        self._th.join(timeout=2.0)\n")},
+            ["shared-state-races"])
+        assert report.failing == []
+
+    def test_reasoned_suppression(self, tmp_path):
+        src = _RACE_BAD.replace(
+            "        self.queries_total += 1\n",
+            "        self.queries_total += 1  # srtlint: ignore[shared-state-races] (GIL-atomic telemetry bump; a lost update skews a counter, never correctness)\n")
+        report = _lint(tmp_path, {"server/ok.py": src},
+                       ["shared-state-races"])
+        assert report.failing == []
+        assert len(report.suppressed) == 1
+        assert "telemetry" in report.suppressed[0].suppress_reason
+
+    def test_regression_endpoint_counter_guards(self, tmp_path):
+        """PR 12 true positive: the front door's lifetime counters were
+        bumped by N connection handlers with no lock.  Un-guarding the
+        REAL endpoint.py must re-fire the pass — the fix cannot
+        silently regress."""
+        real = open(os.path.join(
+            REPO, "spark_rapids_tpu", "server", "endpoint.py")).read()
+        bad = real.replace(
+            "                with self._lock:\n"
+            "                    self.streamed_bytes += n\n",
+            "                self.streamed_bytes += n\n")
+        assert bad != real  # the guarded shape exists to revert
+        report = _lint(tmp_path, {"server/endpoint.py": bad},
+                       ["shared-state-races"])
+        assert any("streamed_bytes" in f.message
+                   for f in report.failing), \
+            [f.message for f in report.failing]
+        # and the guarded original is clean
+        clean = _lint(tmp_path / "c", {"server/endpoint.py": real},
+                      ["shared-state-races"])
+        assert clean.failing == []
+
+    def test_regression_prepared_cache_miss_guard(self, tmp_path):
+        """PR 12 true positive: PreparedCache.misses bumped between the
+        two lock blocks.  Reverting the guard (with the real endpoint
+        supplying the connection-handler thread roots) re-fires."""
+        real_ep = open(os.path.join(
+            REPO, "spark_rapids_tpu", "server", "endpoint.py")).read()
+        real_pc = open(os.path.join(
+            REPO, "spark_rapids_tpu", "server", "prepared.py")).read()
+        bad = real_pc.replace(
+            "        with self._lock:\n"
+            "            self.misses += 1\n",
+            "        self.misses += 1\n")
+        assert bad != real_pc
+        report = _lint(tmp_path, {"server/endpoint.py": real_ep,
+                                  "server/prepared.py": bad},
+                       ["shared-state-races"])
+        assert any("misses" in f.message for f in report.failing), \
+            [f.message for f in report.failing]
+        clean = _lint(tmp_path / "c", {"server/endpoint.py": real_ep,
+                                       "server/prepared.py": real_pc},
+                      ["shared-state-races"])
+        assert clean.failing == []
+
+
+class TestTypestate:
+    def test_use_after_close_on_spooled_stream(self, tmp_path):
+        report = _lint(tmp_path, {"server/bad.py": (
+            "def f(mem, d):\n"
+            "    s = ResultStream('q', mem, d)\n"
+            "    s.put(b'x')\n"
+            "    s.close()\n"
+            "    s.put(b'y')\n")}, ["typestate"])
+        assert len(report.failing) == 1
+        assert "use-after-close" in report.failing[0].message
+        assert report.failing[0].line == 5
+
+    def test_double_release_on_cached_build_handle(self, tmp_path):
+        report = _lint(tmp_path, {"plan/bad.py": (
+            "def f(cache, key):\n"
+            "    h = cache.lookup_broadcast(key)\n"
+            "    h.close()\n"
+            "    h.close()\n")}, ["typestate"])
+        assert len(report.failing) == 1
+        assert "double-release" in report.failing[0].message
+
+    def test_maybe_closed_branch_not_flagged(self, tmp_path):
+        """A finding needs the op invalid in EVERY possible state —
+        close on one branch only is a maybe, not a definite bug."""
+        report = _lint(tmp_path, {"plan/ok.py": (
+            "def f(cache, key, flag):\n"
+            "    h = cache.lookup_broadcast(key)\n"
+            "    if flag:\n"
+            "        h.close()\n"
+            "        return None\n"
+            "    out = h.get()\n"
+            "    h.close()\n"
+            "    return out\n")}, ["typestate"])
+        assert report.failing == []
+
+    def test_finally_close_then_no_touch_clean(self, tmp_path):
+        report = _lint(tmp_path, {"memory/ok.py": (
+            "def f(catalog, b):\n"
+            "    h = catalog.register(b)\n"
+            "    try:\n"
+            "        return h.get()\n"
+            "    finally:\n"
+            "        h.close()\n")}, ["typestate"])
+        assert report.failing == []
+
+    def test_escape_of_closed_handle_flagged(self, tmp_path):
+        report = _lint(tmp_path, {"memory/bad.py": (
+            "def f(catalog, b, out):\n"
+            "    h = catalog.register(b)\n"
+            "    h.close()\n"
+            "    out.adopt(h)\n")}, ["typestate"])
+        assert len(report.failing) == 1
+        assert "escapes" in report.failing[0].message
+
+    def test_use_before_init_two_phase(self, tmp_path):
+        report = _lint(tmp_path, {"server/bad.py": (
+            "def f(session):\n"
+            "    d = SqlFrontDoor(session)\n"
+            "    d.begin_drain()\n"
+            "def ok(session):\n"
+            "    d = SqlFrontDoor(session)\n"
+            "    d.start()\n"
+            "    d.begin_drain()\n")}, ["typestate"])
+        assert [f.line for f in report.failing] == [3]
+        assert "use-before-init" in report.failing[0].message
+
+    def test_reasoned_suppression(self, tmp_path):
+        report = _lint(tmp_path, {"server/ok.py": (
+            "def f(mem, d):\n"
+            "    s = ResultStream('q', mem, d)\n"
+            "    s.close()\n"
+            "    s.put(b'y')  # srtlint: ignore[typestate] (put on a closed stream is the producer's documented stop signal in this probe)\n")},
+            ["typestate"])
+        assert report.failing == []
+        assert len(report.suppressed) == 1
+
+
+_PROTO_FIXTURE = {
+    "server/protocol.py": (
+        'REQ_HELLO = b"h"\n'
+        'RSP_WELCOME = b"W"\n'
+        'RSP_GOAWAY = b"G"\n'     # sent below, never decoded
+        'RSP_UNUSED = b"U"\n'     # defined, never sent
+        'ERROR_CODES = ("BAD_REQUEST", "DEAD_CODE")\n'
+        "class WireError(RuntimeError):\n"
+        "    def __init__(self, code, msg):\n"
+        "        self.code = code\n"),
+    "server/endpoint.py": (
+        "from . import protocol as P\n"
+        "from .protocol import WireError\n"
+        "def serve(conn, bad):\n"
+        "    ftype, payload = P.recv_frame(conn, expect=(P.REQ_HELLO,))\n"
+        "    P.send_frame(conn, P.RSP_WELCOME)\n"
+        "    P.send_frame(conn, P.RSP_GOAWAY)\n"
+        "    if bad:\n"
+        "        raise WireError('BAD_REQUEST', 'malformed')\n"
+        "    raise WireError('NOT_IN_REGISTRY', 'oops')\n"),
+    "server/client.py": (
+        "from . import protocol as P\n"
+        "def hello(sock):\n"
+        "    P.send_frame(sock, P.REQ_HELLO)\n"
+        "    ftype, payload = P.recv_frame(sock,\n"
+        "                                  expect=(P.RSP_WELCOME,))\n"
+        "    return ftype\n"
+        "def dispatch(e):\n"
+        "    return e.code == 'TYPO_CODE'\n"),
+}
+
+
+class TestProtocolConformance:
+    def test_wire_drift_classes(self, tmp_path):
+        report = _lint(tmp_path, _PROTO_FIXTURE,
+                       ["protocol-conformance"])
+        msgs = sorted(f.message for f in report.failing)
+        # sent but no decoder handles it (the GOAWAY drift class)
+        assert any("RSP_GOAWAY is sent here but no decoder" in m
+                   for m in msgs)
+        # defined but nobody sends it
+        assert any("dead frame type: RSP_UNUSED" in m for m in msgs)
+        # constructed code missing from the registry
+        assert any("'NOT_IN_REGISTRY' is constructed here" in m
+                   for m in msgs)
+        # registered code nobody constructs
+        assert any("dead error code: 'DEAD_CODE'" in m for m in msgs)
+        # dispatch comparison against an unregistered code
+        assert any("'TYPO_CODE'" in m and "never match" in m
+                   for m in msgs)
+        assert len(report.failing) == 5
+
+    def test_unhandled_error_code_fixed_by_registration(self, tmp_path):
+        files = dict(_PROTO_FIXTURE)
+        files["server/protocol.py"] = files["server/protocol.py"] \
+            .replace('("BAD_REQUEST", "DEAD_CODE")',
+                     '("NOT_IN_REGISTRY", "TYPO_CODE", "BAD_REQUEST")')
+        files["server/endpoint.py"] = files["server/endpoint.py"] \
+            .replace("    P.send_frame(conn, P.RSP_GOAWAY)\n", "") \
+            .replace("raise WireError('NOT_IN_REGISTRY', 'oops')",
+                     "raise WireError('BAD_REQUEST', 'oops')")
+        files["server/client.py"] = files["server/client.py"] \
+            .replace("'TYPO_CODE'", "'BAD_REQUEST'")
+        report = _lint(tmp_path, files, ["protocol-conformance"])
+        msgs = sorted(f.message for f in report.failing)
+        # only the dead vocabulary remains
+        assert all("dead" in m for m in msgs), msgs
+
+    def test_dcn_op_vocabulary(self, tmp_path):
+        report = _lint(tmp_path, {"parallel/dcn.py": (
+            'DCN_OPS = ("fetch", "journal", "ghost")\n'
+            "def client(sock):\n"
+            "    _send(sock, {'op': 'fetch'})\n"
+            "    _send(sock, {'op': 'journal'})\n"
+            "    _send(sock, {'op': 'mystery'})\n"
+            "def serve(msg):\n"
+            "    op = msg.get('op')\n"
+            "    if op == 'fetch':\n"
+            "        return 1\n"
+            "    if op != 'journal':\n"
+            "        return 0\n")}, ["protocol-conformance"])
+        msgs = sorted(f.message for f in report.failing)
+        assert any("'mystery' is sent here but no dispatch" in m
+                   for m in msgs)
+        assert any("'mystery' is sent here but missing from DCN_OPS"
+                   in m for m in msgs)
+        assert any("dead DCN op: 'ghost'" in m for m in msgs)
+
+    def test_reasoned_suppression(self, tmp_path):
+        files = dict(_PROTO_FIXTURE)
+        files["server/endpoint.py"] = files["server/endpoint.py"] \
+            .replace(
+                "    P.send_frame(conn, P.RSP_GOAWAY)\n",
+                "    P.send_frame(conn, P.RSP_GOAWAY)  # srtlint: ignore[protocol-conformance] (decoded by the out-of-tree ops client)\n")
+        report = _lint(tmp_path, files, ["protocol-conformance"])
+        assert not any("RSP_GOAWAY" in f.message for f in report.failing)
+        assert any("RSP_GOAWAY" in f.message for f in report.suppressed)
+
+    def test_real_registries_exist(self):
+        """The canonical vocabularies the pass checks against."""
+        from spark_rapids_tpu.server import protocol as P
+        from spark_rapids_tpu.parallel import dcn
+        assert "DRAINING" in P.ERROR_CODES
+        assert set(dcn._COORD_OPS) < set(dcn.DCN_OPS)
+        assert "fetch" in dcn.DCN_OPS and "journal" in dcn.DCN_OPS
+
+
+class TestBaselineDrift:
+    def test_rewrap_keeps_baseline_entry(self, tmp_path):
+        """A pure reformat (re-indent + re-wrap across lines) of a
+        baselined statement keeps its entry alive — the key hashes the
+        whole statement with whitespace stripped, not the first line."""
+        files = {"plan/bad.py": (
+            "import jax\n"
+            "a = jax.device_get(make_value(1, 2))\n")}
+        root = _tree(tmp_path, files)
+        bl = str(tmp_path / "baseline.json")
+        report = lint_run(root, roots=("spark_rapids_tpu",),
+                          rules=["blocking-fetch"], baseline_path=bl)
+        engine.write_baseline(report.failing, bl)
+        (tmp_path / "spark_rapids_tpu" / "plan" / "bad.py").write_text(
+            "import jax\n"
+            "a = jax.device_get(\n"
+            "        make_value(1,\n"
+            "                   2))\n")
+        moved = lint_run(root, roots=("spark_rapids_tpu",),
+                         rules=["blocking-fetch"], baseline_path=bl)
+        assert moved.failing == []
+        assert len(moved.baselined) == 1
+
+
+class TestIncremental:
+    def _seed(self, tmp_path):
+        files = {
+            "plan/a.py": "import jax\ndef f(x):\n    return x\n",
+            "plan/b.py": ("from .a import f\n"
+                          "def g(x):\n    return f(x)\n"),
+            "ops/c.py": ("import numpy as np\n"
+                         "def h(col):\n"
+                         "    return np.asarray(col.data)  # choke-point-ok (host column; fixture)\n"),
+        }
+        return _tree(tmp_path, files)
+
+    def test_cold_then_noop_then_edit(self, tmp_path):
+        from tools.srtlint.incremental import run_incremental
+        root = self._seed(tmp_path)
+        cold = run_incremental(root, roots=("spark_rapids_tpu",))
+        assert cold.failing == []
+        assert len(cold.suppressed) == 1   # the choke-point-ok marker
+        assert cold.incremental["cone"] == 3
+        # unchanged tree: nothing re-analyzed, cache carries reasons
+        noop = run_incremental(root, roots=("spark_rapids_tpu",))
+        assert noop.incremental["cone"] == 0
+        assert noop.incremental["parsed"] == 0
+        assert noop.failing == []
+        assert len(noop.suppressed) == 1
+        assert noop.suppressed[0].suppress_reason
+        # a one-file edit introducing a finding re-verifies without a
+        # full re-analysis: only the edited file (plus its reverse-
+        # dependency cone) is re-parsed
+        (tmp_path / "spark_rapids_tpu" / "plan" / "a.py").write_text(
+            "import jax\ndef f(x):\n    return jax.device_get(x)\n")
+        edit = run_incremental(root, roots=("spark_rapids_tpu",))
+        assert [f.path for f in edit.failing] == ["spark_rapids_tpu/plan/a.py"]
+        assert edit.incremental["changed"] == 1
+        assert edit.incremental["cone"] == 2      # a.py + dependent b.py
+        # c.py is parsed only because the package-scoped global passes
+        # re-run; its per-file verdict (the suppression) comes from the
+        # cache, not a re-analysis
+        assert len(edit.suppressed) == 1
+        assert edit.suppressed[0].suppress_reason
+
+    def test_reverse_dependency_cone_gates_global_passes(self, tmp_path):
+        from tools.srtlint import incremental as incr
+        root = self._seed(tmp_path)
+        incr.run_incremental(root, roots=("spark_rapids_tpu",))
+        # an edit outside every global scope... plan/ is inside the
+        # races scope (whole package), so races re-runs; but protocol
+        # and lock-discipline scopes are untouched and stay cached
+        (tmp_path / "spark_rapids_tpu" / "plan" / "a.py").write_text(
+            "import jax\ndef f(x):\n    return x + 1\n")
+        edit = incr.run_incremental(root, roots=("spark_rapids_tpu",))
+        rerun = set(edit.incremental["global_rerun"])
+        assert "shared-state-races" in rerun
+        assert "protocol-conformance" not in rerun
+        assert "lock-discipline" not in rerun
+
+    def test_single_file_edit_faster_than_cold(self):
+        """Acceptance: on the REAL tree, a one-file edit re-verifies
+        incrementally in well under a full cold scan (no full re-parse
+        of the unchanged files' local verdicts)."""
+        import shutil
+        import tempfile
+        import time as _t
+        from tools.srtlint.incremental import run_incremental
+        with tempfile.TemporaryDirectory() as tmp:
+            for root in ("spark_rapids_tpu", "tools"):
+                shutil.copytree(os.path.join(REPO, root),
+                                os.path.join(tmp, root))
+            os.makedirs(os.path.join(tmp, "docs"), exist_ok=True)
+            shutil.copy(os.path.join(REPO, "docs", "configs.md"),
+                        os.path.join(tmp, "docs", "configs.md"))
+            t0 = _t.perf_counter()
+            cold = run_incremental(tmp)
+            cold_s = _t.perf_counter() - t0
+            assert cold.failing == []
+            target = os.path.join(tmp, "spark_rapids_tpu", "ops",
+                                  "cast.py")
+            with open(target, "a") as f:
+                f.write("\n# an innocuous trailing comment\n")
+            t0 = _t.perf_counter()
+            warm = run_incremental(tmp)
+            warm_s = _t.perf_counter() - t0
+            assert warm.failing == []
+            assert warm.incremental["changed"] == 1
+            # the bar: a one-file edit must not pay the cold scan again
+            assert warm_s < 0.8 * cold_s, (warm_s, cold_s)
+
+
+class TestSarifAndChanged:
+    def test_sarif_output(self, tmp_path, capsys):
+        root = _tree(tmp_path, {"plan/bad.py": (
+            "import jax\n"
+            "a = jax.device_get(1)\n"
+            "b = jax.device_get(2)  # choke-point-ok (fixture seed)\n")})
+        out = str(tmp_path / "out.sarif")
+        rc = engine.main(["--repo", root, "--full", "--sarif", out])
+        capsys.readouterr()
+        assert rc == 1
+        with open(out) as f:
+            sarif = json.load(f)
+        assert sarif["version"] == "2.1.0"
+        run0 = sarif["runs"][0]
+        assert run0["tool"]["driver"]["name"] == "srtlint"
+        rules = {r["id"] for r in run0["tool"]["driver"]["rules"]}
+        assert "shared-state-races" in rules and "typestate" in rules
+        levels = {r["level"] for r in run0["results"]}
+        assert levels == {"error", "note"}  # failing + suppressed
+        sup = [r for r in run0["results"] if r["level"] == "note"]
+        assert sup[0]["suppressions"][0]["justification"]
+
+    def test_changed_scopes_findings(self, tmp_path, capsys):
+        import subprocess
+        root = _tree(tmp_path, {
+            "plan/bad.py": "import jax\na = jax.device_get(1)\n",
+            "plan/worse.py": "import jax\nb = jax.device_get(2)\n"})
+        subprocess.run(["git", "init", "-q"], cwd=root, check=True)
+        subprocess.run(["git", "add", "-A"], cwd=root, check=True)
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", "commit", "-qm", "seed"],
+                       cwd=root, check=True)
+        # modify ONE of the two offending files
+        (tmp_path / "spark_rapids_tpu" / "plan" / "bad.py").write_text(
+            "import jax\na = jax.device_get(11)\n")
+        rc = engine.main(["--repo", root, "--full", "--changed"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "plan/bad.py" in out
+        # the unchanged offender is excluded from the scoped listing
+        assert "plan/worse.py" not in out.split("srtlint:")[0]
+        assert "1 in changed files" in out
+
+
 class TestRealTree:
     def test_full_tree_clean_and_within_wall_budget(self):
-        """Acceptance: all nine passes over the real tree, zero
+        """Acceptance: all twelve passes over the real tree, zero
         unsuppressed findings, every suppression reasoned, inside a
         collection-time wall budget."""
         t0 = time.perf_counter()

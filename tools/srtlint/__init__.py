@@ -2,28 +2,40 @@
 
 Replaces the five standalone line-regex scanners (``tools/check_*.py``,
 removed) with ONE engine that parses ``spark_rapids_tpu/`` + ``tools/``
-once into ASTs — import/alias resolution, per-line comment maps, and a
-per-function CFG-lite (:mod:`.cfg`) ride on the shared parse — and runs
-all nine passes over the shared tree:
+once into ASTs — import/alias resolution, lazy per-line comment maps,
+a per-function CFG-lite (:mod:`.cfg`), and an interprocedural dataflow
+layer (:mod:`.dataflow`: whole-tree call graph, thread-root
+enumeration, must-hold lockset fixpoint) — and runs all twelve passes
+over the shared tree:
 
-  ================  ==============================================
-  rule              invariant
-  ================  ==============================================
-  blocking-fetch    D2H transfers route through utils.metrics.fetch
-  span-timing       exec-node timing goes through the span API
-  ctx-threads       worker threads join the query's contextvars
-  cache-keys        cache keys derive from cache/keys.py only
-  fault-paths       no swallowed faults / ad-hoc retries / unbounded waits
-  release-paths     every permit/handle/quota/spool acquisition is
-                    released via finally/with on all exit edges
-  lock-discipline   no blocking call under a lock; no acquisition-
-                    order cycles in the lock graph
-  shutdown-paths    threads started in server/, service/, parallel/
-                    are joined (with a timeout) on a close()/drain()
-                    exit edge
-  conf-registry     every spark.rapids.tpu.* literal resolves through
-                    config.py registration and docs/configs.md
-  ================  ==============================================
+  ====================  ==============================================
+  rule                  invariant
+  ====================  ==============================================
+  blocking-fetch        D2H transfers route through utils.metrics.fetch
+  span-timing           exec-node timing goes through the span API
+  ctx-threads           worker threads join the query's contextvars
+  cache-keys            cache keys derive from cache/keys.py only
+  fault-paths           no swallowed faults / ad-hoc retries / unbounded
+                        waits
+  release-paths         every permit/handle/quota/spool acquisition is
+                        released via finally/with on all exit edges
+  lock-discipline       no blocking call under a lock; no acquisition-
+                        order cycles in the lock graph
+  shutdown-paths        threads started in server/, service/, parallel/
+                        are joined (with a timeout) on a close()/drain()
+                        exit edge
+  shared-state-races    instance attributes written by two thread roots
+                        are consistently lock-guarded (interprocedural
+                        locksets over the call graph)
+  typestate             handles follow their declared lifecycle machine:
+                        no use-after-close / double-release /
+                        use-before-init
+  protocol-conformance  wire frame types, protocol.ERROR_CODES, and
+                        dcn.DCN_OPS stay two-way exhaustive against
+                        every send/decode/dispatch site
+  conf-registry         every spark.rapids.tpu.* literal resolves through
+                        config.py registration and docs/configs.md
+  ====================  ==============================================
 
 Suppression is ``# srtlint: ignore[rule] (<reason>)`` on any line the
 flagged statement spans; the legacy ``# fault-ok`` / ``# wait-ok`` /
@@ -31,11 +43,14 @@ flagged statement spans; the legacy ``# fault-ok`` / ``# wait-ok`` /
 ``# cache-key-ok`` markers keep working.  EVERY suppression must carry
 a parenthesised reason — a bare marker does not suppress.  Accepted
 legacy findings can also live in ``tools/srtlint/baseline.json``
-(checked in; ``--update-baseline`` regenerates it).
+(checked in; ``--update-baseline`` regenerates it; keys are reformat-
+stable — the whole statement, whitespace-stripped).
 
-Entry points: ``python -m tools.srtlint`` (CLI, exit 1 on findings,
-``--json`` / ``--explain RULE``), :func:`run` (programmatic), and
-:func:`run_for_pytest` — the single mtime-keyed cached scan
+Entry points: ``python -m tools.srtlint`` (CLI: incremental by
+default, exit 1 on findings, ``--json`` / ``--sarif`` / ``--changed``
+/ ``--explain RULE``), :func:`run` (programmatic full scan),
+:func:`.incremental.run_incremental` (content-hash-keyed incremental
+scan), and :func:`run_for_pytest` — the cached scan
 tests/conftest.py invokes at collection time.
 """
 

@@ -1,8 +1,10 @@
 """CLI: ``python -m tools.srtlint`` — exit 1 on unsuppressed findings.
 
-See ``--help`` for flags (``--json``, ``--explain RULE``, ``--rules``,
-``--update-baseline``, ``--verbose``) and docs/static_analysis.md for
-the rule catalog and suppression/baseline workflow.
+Incremental by default (content-hash-keyed; ``--full`` forces a cold
+scan).  See ``--help`` for flags (``--json``, ``--sarif OUT``,
+``--changed``, ``--explain RULE``, ``--rules``, ``--update-baseline``,
+``--verbose``) and docs/static_analysis.md for the rule catalog and
+suppression/baseline workflow.
 """
 
 import sys

@@ -1,0 +1,575 @@
+"""The interprocedural dataflow layer: whole-tree call graph, fixpoint
+per-function summaries, and thread-root enumeration.
+
+PR 9's passes were either single-file or (lock-discipline) same-module.
+The invariants PRs 6-11 added — lockset discipline across the
+scheduler/watchdog/admission/DCN-failover threads, resource lifecycles
+spanning helpers, a wire protocol decoded in three modules — are
+*cross-module* properties.  This module generalizes lock-discipline's
+same-module summaries into one shared :class:`CallGraph` every
+dataflow-hungry pass builds once per run:
+
+  * **function index** — every ``def`` in the package keyed
+    ``(module rel, class, name)``;
+  * **call resolution** — ``self.m()`` / ``cls.m()``, module-local
+    ``f()``, imported ``mod.f()`` / ``from m import f``, constructor
+    calls, and one level of attribute-type inference
+    (``self._cache = QueryCache(...)`` makes ``self._cache.release()``
+    resolve to ``QueryCache.release``), plus local-variable types from
+    constructor assignments;
+  * **thread roots** — every place a second thread starts executing
+    package code: ``threading.Thread(target=...)`` (plain methods,
+    lambdas wrapping ``cctx.run(fn)``, and the scheduler's
+    ``target=entry.cctx.run, args=(self._run_entry, e)`` shape),
+    executor ``pool.submit(cctx.run, fn, ...)``, and the accept/handler
+    loops those targets contain.  A root created inside a loop (one
+    accept loop spawning N connection handlers) is *multi-instance*:
+    two copies of the same root race each other;
+  * **reachability** — which functions each thread root (and MAIN — the
+    public API surface) can execute;
+  * **lock index + entry locksets** — lock identities from
+    ``threading.Lock/RLock/Condition`` assignments anywhere in the
+    package, and a must-hold fixpoint: the lockset a function is
+    *guaranteed* to hold on entry is the intersection over all resolved
+    call sites of (caller's entry lockset ∪ locks lexically held at the
+    site).  Public functions and thread roots start at ∅ — anything
+    callable from outside can be entered bare.
+
+Everything here is deliberately a MAY/MUST split: call resolution and
+reachability over-approximate (MAY execute), entry locksets
+under-approximate (MUST hold) — the combination race detection needs to
+avoid both missed races and phantom ones.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from . import cfg
+
+FuncId = Tuple[str, Optional[str], str]     # (module rel, class, name)
+ClassId = Tuple[str, str]                   # (module rel, class name)
+
+MAIN = "<main>"                             # the calling-API pseudo-root
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock",
+               "threading.Condition"}
+
+
+class ThreadRoot:
+    """One site where a new thread starts running package code."""
+
+    __slots__ = ("func", "site_sf", "site", "multi", "kind")
+
+    def __init__(self, func: FuncId, site_sf, site: ast.AST,
+                 multi: bool, kind: str):
+        self.func = func        # the body the thread executes
+        self.site_sf = site_sf  # SourceFile of the creation site
+        self.site = site        # the creating Call node
+        self.multi = multi      # created in a loop: instances race
+        self.kind = kind        # "thread" | "executor"
+
+    @property
+    def label(self) -> str:
+        mod = self.func[0].rsplit("/", 1)[-1].removesuffix(".py")
+        qual = f"{self.func[1]}.{self.func[2]}" if self.func[1] \
+            else self.func[2]
+        return f"{mod}.{qual}" + ("[xN]" if self.multi else "")
+
+
+class CallGraph:
+    """The shared interprocedural index for one :class:`..engine.LintTree`."""
+
+    def __init__(self, tree):
+        self.tree = tree
+        self.funcs: Dict[FuncId, Tuple[object, ast.AST]] = {}
+        self.classes: Dict[str, List[ClassId]] = {}     # name -> defs
+        self.class_bases: Dict[ClassId, List[str]] = {}
+        self.attr_types: Dict[Tuple[ClassId, str], ClassId] = {}
+        self.module_of: Dict[str, str] = {}             # dotted -> rel
+        self.locks: Set[str] = set()                    # lock ids
+        self._lock_attrs: Dict[Tuple[Optional[ClassId], str], str] = {}
+        self.calls: Dict[FuncId, List[Tuple[FuncId, ast.Call]]] = {}
+        self.callers: Dict[FuncId, List[FuncId]] = {}
+        # one held-lock walk per function fills both of these: resolved
+        # call sites with the lexical lockset held there, and every
+        # self-attribute access with its lockset (the races pass's raw
+        # material — computed here so the walk happens ONCE)
+        self.fn_sites: Dict[FuncId, List[
+            Tuple[FuncId, ast.Call, FrozenSet[str]]]] = {}
+        self.fn_accesses: Dict[FuncId, List[
+            Tuple[ast.AST, str, bool, FrozenSet[str]]]] = {}
+        self._ltypes: Dict[FuncId, Dict[str, ClassId]] = {}
+        self.thread_roots: List[ThreadRoot] = []
+        self._root_candidates: List[Tuple[object, ast.Call]] = []
+        self._reach: Dict[object, Set[FuncId]] = {}
+        self.entry_locks: Dict[FuncId, FrozenSet[str]] = {}
+        self._index()
+        self._find_thread_roots()
+        self._analyze_functions()
+        self._fixpoint_entry_locks()
+
+    # -- indexing -----------------------------------------------------------------
+    def _index(self) -> None:
+        """ONE walk per file: function/class index, ctor/lock
+        assignment candidates, and thread-creation candidates (resolved
+        after the whole index exists)."""
+        assigns: List[Tuple[object, ast.Assign, Optional[str]]] = []
+        for sf in self.tree.package_files():
+            dotted = sf.rel[:-3].replace("/", ".")
+            self.module_of[dotted] = sf.rel
+            if dotted.endswith(".__init__"):
+                self.module_of[dotted[:-len(".__init__")]] = sf.rel
+            for node in ast.walk(sf.tree):
+                if isinstance(node, cfg.FuncNode):
+                    klass = cfg.enclosing_class(sf, node)
+                    cname = klass.name if klass else None
+                    self.funcs.setdefault((sf.rel, cname, node.name),
+                                          (sf, node))
+                elif isinstance(node, ast.ClassDef):
+                    cid = (sf.rel, node.name)
+                    self.classes.setdefault(node.name, []).append(cid)
+                    bases = []
+                    for b in node.bases:
+                        q = sf.qualname(b)
+                        if q:
+                            bases.append(q.rsplit(".", 1)[-1])
+                    self.class_bases[cid] = bases
+                elif isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    q = sf.call_qualname(node.value)
+                    if q:
+                        assigns.append((sf, node, q))
+                elif isinstance(node, ast.Call):
+                    q = sf.call_qualname(node)
+                    is_submit = isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "submit"
+                    if q == "threading.Thread" or is_submit:
+                        self._root_candidates.append((sf, node))
+        # attribute + lock identities (needs the full class index)
+        for sf, node, q in assigns:
+            ctor = self._class_of_qualname(sf, q)
+            is_lock = q in _LOCK_CTORS
+            if ctor is None and not is_lock:
+                continue
+            klass = cfg.enclosing_class(sf, node)
+            cid = (sf.rel, klass.name) if klass else None
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id in ("self", "cls") \
+                        and cid is not None:
+                    if is_lock:
+                        lid = f"{cid[0]}::{cid[1]}.{tgt.attr}"
+                        self.locks.add(lid)
+                        self._lock_attrs[(cid, tgt.attr)] = lid
+                    else:
+                        self.attr_types[(cid, tgt.attr)] = ctor
+                elif isinstance(tgt, ast.Name) and is_lock \
+                        and cid is None:
+                    lid = f"{sf.rel}::{tgt.id}"
+                    self.locks.add(lid)
+                    self._lock_attrs[(None, tgt.id)] = lid
+
+    def _class_of_qualname(self, sf, q: str) -> Optional[ClassId]:
+        """Resolve a call qualname to a package class definition."""
+        last = q.rsplit(".", 1)[-1]
+        cands = self.classes.get(last)
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+        # prefer the definition the dotted path names, else same module
+        mod = q.rsplit(".", 1)[0] if "." in q else ""
+        rel = self.module_of.get(mod)
+        for cid in cands:
+            if cid[0] == rel:
+                return cid
+        for cid in cands:
+            if cid[0] == sf.rel:
+                return cid
+        return cands[0]
+
+    # -- local var types -----------------------------------------------------------
+    def local_types(self, sf, fn: ast.AST) -> Dict[str, ClassId]:
+        out: Dict[str, ClassId] = {}
+        for node in cfg.walk_scope(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                q = sf.call_qualname(node.value)
+                cid = self._class_of_qualname(sf, q) if q else None
+                if cid is not None:
+                    out[node.targets[0].id] = cid
+        return out
+
+    # -- call resolution -----------------------------------------------------------
+    def method_on(self, cid: Optional[ClassId], name: str
+                  ) -> Optional[FuncId]:
+        """``cid``'s method, walking package base classes."""
+        seen: Set[ClassId] = set()
+        while cid is not None and cid not in seen:
+            seen.add(cid)
+            fid = (cid[0], cid[1], name)
+            if fid in self.funcs:
+                return fid
+            nxt = None
+            for base in self.class_bases.get(cid, ()):  # single chain
+                for cand in self.classes.get(base, ()):
+                    nxt = cand
+                    break
+                if nxt:
+                    break
+            cid = nxt
+        return None
+
+    def resolve_call(self, sf, klass: Optional[str], call: ast.Call,
+                     local_types: Optional[Dict[str, ClassId]] = None
+                     ) -> Optional[FuncId]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            fid = (sf.rel, None, func.id)
+            if fid in self.funcs:
+                return fid
+            dotted = sf.imports.get(func.id)
+            if dotted:
+                cid = self._class_of_qualname(sf, dotted)
+                if cid is not None:
+                    return self.method_on(cid, "__init__")
+                if "." in dotted:
+                    mod, name = dotted.rsplit(".", 1)
+                    rel = self.module_of.get(mod)
+                    if rel and (rel, None, name) in self.funcs:
+                        return (rel, None, name)
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id in ("self", "cls") and klass is not None:
+                return self.method_on((sf.rel, klass), func.attr)
+            if local_types and recv.id in local_types:
+                return self.method_on(local_types[recv.id], func.attr)
+            dotted = sf.imports.get(recv.id)
+            if dotted:
+                rel = self.module_of.get(dotted)
+                if rel and (rel, None, func.attr) in self.funcs:
+                    return (rel, None, func.attr)
+                cid = self._class_of_qualname(sf, dotted)
+                if cid is not None:  # Class.method / classmethod call
+                    return self.method_on(cid, func.attr)
+        elif isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id in ("self", "cls") and klass:
+            cid = self.attr_types.get(((sf.rel, klass), recv.attr))
+            if cid is not None:
+                return self.method_on(cid, func.attr)
+        return None
+
+    # -- thread roots --------------------------------------------------------------
+    def _target_func(self, sf, fn_scope, klass, node: ast.AST
+                     ) -> Optional[FuncId]:
+        """Resolve a thread-target expression to the body it runs."""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in ("self", "cls") and klass:
+            return self.method_on((sf.rel, klass), node.attr)
+        if isinstance(node, ast.Name):
+            # a locally defined worker/producer def, else module level
+            if fn_scope is not None:
+                for n in ast.walk(fn_scope):
+                    if isinstance(n, cfg.FuncNode) and n.name == node.id:
+                        kls = cfg.enclosing_class(sf, n)
+                        return (sf.rel, kls.name if kls else None,
+                                n.name)
+            fid = (sf.rel, None, node.id)
+            return fid if fid in self.funcs else None
+        if isinstance(node, ast.Lambda):
+            # the `lambda: cctx.run(worker)` shape: the payload is what
+            # actually runs on the thread
+            for n in ast.walk(node.body):
+                if isinstance(n, ast.Call):
+                    if isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "run" and n.args:
+                        return self._target_func(sf, fn_scope, klass,
+                                                 n.args[0])
+                    return self._target_func(sf, fn_scope, klass,
+                                             n.func)
+        return None
+
+    def _find_thread_roots(self) -> None:
+        for sf, node in self._root_candidates:
+            fn_scope = sf.enclosing_function(node)
+            kls = cfg.enclosing_class(sf, node)
+            klass = kls.name if kls else None
+            target: Optional[ast.AST] = None
+            extra_args: List[ast.AST] = []
+            kind = "thread"
+            if sf.call_qualname(node) == "threading.Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                        elif kw.arg == "args" \
+                                and isinstance(kw.value, ast.Tuple):
+                            extra_args = list(kw.value.elts)
+                    if node.args:
+                        target = target or node.args[0]
+            elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "submit" \
+                        and isinstance(node.func.value,
+                                       (ast.Name, ast.Attribute)):
+                    basis = sf.qualname(node.func.value) or ""
+                    if not any(w in basis.lower()
+                               for w in ("pool", "executor")):
+                        continue
+                    kind = "executor"
+                    target = node.args[0] if node.args else None
+                    extra_args = list(node.args[1:])
+            else:
+                    continue
+            if target is None:
+                    continue
+            # `target=entry.cctx.run, args=(fn, ...)`: the payload
+            # fn is the real body
+            if isinstance(target, ast.Attribute) \
+                        and target.attr == "run" and extra_args:
+                    target = extra_args[0]
+            fid = self._target_func(sf, fn_scope, klass, target)
+            if fid is None:
+                    continue
+            multi = any(isinstance(a, (ast.For, ast.While))
+                            for a in cfg.ancestors(sf, node)
+                            if fn_scope is None
+                            or self._within(sf, a, fn_scope))
+            self.thread_roots.append(
+                    ThreadRoot(fid, sf, node, multi, kind))
+
+    @staticmethod
+    def _within(sf, node: ast.AST, fn_scope: ast.AST) -> bool:
+        return any(a is fn_scope for a in cfg.ancestors(sf, node)) \
+            or node is fn_scope
+
+    # -- the per-function walk: edges, locksets, attribute accesses ------------------
+    def _analyze_functions(self) -> None:
+        for fid, (sf, fn) in self.funcs.items():
+            ltypes = self.local_types(sf, fn)
+            self._ltypes[fid] = ltypes
+            sites: List[Tuple[FuncId, ast.Call, FrozenSet[str]]] = []
+            accesses: List[Tuple[ast.AST, str, bool, FrozenSet[str]]] = []
+            klass = fid[1]
+
+            def note_attr(node: ast.AST, name: str, write: bool,
+                          held: List[str]) -> None:
+                accesses.append((node, name, write, frozenset(held)))
+
+            def walk(node: ast.AST, held: List[str]) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, cfg._SCOPE_BARRIERS):
+                        continue
+                    pushed = 0
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        for item in child.items:
+                            lid = self.lock_of(sf, klass,
+                                               item.context_expr, ltypes)
+                            if lid:
+                                held.append(lid)
+                                pushed += 1
+                    elif isinstance(child, ast.Call):
+                        callee = self.resolve_call(sf, klass, child,
+                                                   ltypes)
+                        if callee is not None and callee in self.funcs:
+                            sites.append((callee, child,
+                                          frozenset(held)))
+                            self.callers.setdefault(callee, []) \
+                                .append(fid)
+                    elif isinstance(child, (ast.Assign, ast.AugAssign,
+                                            ast.AnnAssign)):
+                        targets = child.targets \
+                            if isinstance(child, ast.Assign) \
+                            else [child.target]
+                        for t in targets:
+                            for leaf in (t.elts if isinstance(
+                                    t, (ast.Tuple, ast.List)) else [t]):
+                                if isinstance(leaf, ast.Attribute) \
+                                        and isinstance(leaf.value,
+                                                       ast.Name) \
+                                        and leaf.value.id == "self":
+                                    note_attr(leaf, leaf.attr, True,
+                                              held)
+                                    if isinstance(child, ast.AugAssign):
+                                        # += is a read-modify-write:
+                                        # the read half races sibling
+                                        # instances of the same root
+                                        note_attr(leaf, leaf.attr,
+                                                  False, held)
+                    elif isinstance(child, ast.Attribute) \
+                            and isinstance(child.ctx, ast.Load) \
+                            and isinstance(child.value, ast.Name) \
+                            and child.value.id == "self":
+                        parent = sf.parents.get(child)
+                        # skip the receiver of self.m(...) and lock
+                        # expressions themselves (with self._lock:)
+                        if not ((isinstance(parent, ast.Call)
+                                 and parent.func is child)
+                                or isinstance(parent, ast.withitem)):
+                            note_attr(child, child.attr, False, held)
+                    walk(child, held)
+                    for _ in range(pushed):
+                        held.pop()
+
+            walk(fn, [])
+            self.fn_sites[fid] = sites
+            self.fn_accesses[fid] = accesses
+            self.calls[fid] = [(c, n) for c, n, _ in sites]
+
+    def reachable_from(self, entries: Iterable[FuncId]) -> Set[FuncId]:
+        seen: Set[FuncId] = set()
+        stack = [e for e in entries if e in self.funcs]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for callee, _ in self.calls.get(cur, ()):
+                if callee not in seen:
+                    stack.append(callee)
+        return seen
+
+    def root_reach(self, root: ThreadRoot) -> Set[FuncId]:
+        got = self._reach.get(root)
+        if got is None:
+            got = self.reachable_from([root.func])
+            self._reach[root] = got
+        return got
+
+    def main_reach(self) -> Set[FuncId]:
+        """Functions the calling thread (the public API surface) can
+        execute: everything reachable from a public-named def that is
+        not itself a thread-root body."""
+        got = self._reach.get(MAIN)
+        if got is None:
+            bodies = {r.func for r in self.thread_roots}
+            entries = [fid for fid in self.funcs
+                       if fid not in bodies
+                       and (not fid[2].startswith("_")
+                            or fid[2].startswith("__"))]
+            got = self.reachable_from(entries)
+            self._reach[MAIN] = got
+        return got
+
+    # -- locks ---------------------------------------------------------------------
+    def lock_of(self, sf, klass: Optional[str], expr: ast.AST,
+                local_types: Optional[Dict[str, ClassId]] = None
+                ) -> Optional[str]:
+        """Lock id for a with-item / receiver expression: ``self._lock``,
+        a module-level lock name, ``self._cache._lock`` through the
+        attribute-type index, or ``entry._lock`` through local types."""
+        if isinstance(expr, ast.Attribute):
+            recv = expr.value
+            if isinstance(recv, ast.Name):
+                if recv.id in ("self", "cls") and klass is not None:
+                    cid: Optional[ClassId] = (sf.rel, klass)
+                    while cid is not None:
+                        lid = self._lock_attrs.get((cid, expr.attr))
+                        if lid:
+                            return lid
+                        nxt = None
+                        for base in self.class_bases.get(cid, ()):
+                            for cand in self.classes.get(base, ()):
+                                nxt = cand
+                                break
+                            if nxt:
+                                break
+                        cid = nxt if cid != nxt else None
+                    return None
+                if local_types and recv.id in local_types:
+                    return self._lock_attrs.get(
+                        (local_types[recv.id], expr.attr))
+            elif isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id in ("self", "cls") and klass:
+                cid = self.attr_types.get(((sf.rel, klass), recv.attr))
+                if cid is not None:
+                    return self._lock_attrs.get((cid, expr.attr))
+        elif isinstance(expr, ast.Name):
+            return self._lock_attrs.get((None, expr.id))
+        return None
+
+    def lexical_locks(self, sf, klass: Optional[str], node: ast.AST,
+                      local_types: Optional[Dict[str, ClassId]] = None
+                      ) -> FrozenSet[str]:
+        """Locks held at ``node`` by enclosing ``with`` statements."""
+        held: Set[str] = set()
+        for anc in cfg.ancestors(sf, node):
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    lid = self.lock_of(sf, klass, item.context_expr,
+                                       local_types)
+                    if lid:
+                        held.add(lid)
+        return frozenset(held)
+
+    def _fixpoint_entry_locks(self) -> None:
+        """Must-hold entry locksets: ∩ over resolved call sites of
+        (caller entry ∪ lexical locks at the site).  Public functions
+        and thread-root bodies meet with ∅ — they are enterable bare."""
+        bare: Set[FuncId] = {r.func for r in self.thread_roots}
+        for fid in self.funcs:
+            # no resolved caller: anything (tests, callbacks, the API
+            # surface) may enter it with nothing held.  A function whose
+            # every RESOLVED call site holds a lock keeps that lock even
+            # if public-named — within the package the call sites are
+            # the truth.
+            if not self.callers.get(fid):
+                bare.add(fid)
+        # per-call-site lexical locksets, from the shared function walk
+        site_locks: Dict[FuncId, List[Tuple[FuncId, FrozenSet[str]]]] = {}
+        for caller, sites in self.fn_sites.items():
+            for callee, _call, held in sites:
+                site_locks.setdefault(callee, []).append((caller, held))
+        entry: Dict[FuncId, Optional[FrozenSet[str]]] = {
+            fid: (frozenset() if fid in bare else None)
+            for fid in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for fid in self.funcs:
+                if fid in bare:
+                    continue
+                acc: Optional[FrozenSet[str]] = None
+                for caller, held in site_locks.get(fid, ()):
+                    ce = entry.get(caller)
+                    if ce is None:
+                        continue  # caller still unknown: skip this site
+                    site = ce | held
+                    acc = site if acc is None else (acc & site)
+                if acc is not None and acc != entry[fid]:
+                    entry[fid] = acc
+                    changed = True
+        self.entry_locks = {fid: (ls if ls is not None else frozenset())
+                            for fid, ls in entry.items()}
+
+    def locks_at(self, sf, fid: FuncId, node: ast.AST,
+                 local_types: Optional[Dict[str, ClassId]] = None
+                 ) -> FrozenSet[str]:
+        """Must-hold lockset at ``node`` inside function ``fid``."""
+        return self.entry_locks.get(fid, frozenset()) \
+            | self.lexical_locks(sf, fid[1], node, local_types)
+
+
+def build(tree) -> CallGraph:
+    """The per-run CallGraph, memoized on the LintTree (every dataflow
+    pass shares one build)."""
+    got = getattr(tree, "_callgraph", None)
+    if got is None:
+        got = CallGraph(tree)
+        tree._callgraph = got
+    return got
+
+
+def pretty_lock(lock_id: str) -> str:
+    rel, name = lock_id.split("::", 1)
+    mod = rel.rsplit("/", 1)[-1].removesuffix(".py")
+    return f"{mod}.{name}"

@@ -33,7 +33,9 @@ DEFAULT_ROOTS = ("spark_rapids_tpu", "tools")
 
 # engine version participates in the disk-cache key: a pass change
 # invalidates cached verdicts even when the tree itself is untouched
-ENGINE_VERSION = "1.1"
+# (srtlint's own sources are inside the scanned roots, so edits to the
+# engine/passes also change the content fingerprint directly)
+ENGINE_VERSION = "2.0"
 
 _IGNORE = re.compile(
     r"#\s*srtlint:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(\(([^)]*)\))?")
@@ -63,19 +65,35 @@ class Finding:
     suppressed: bool = False
     suppress_reason: str = ""
     baselined: bool = False
+    norm: str = ""         # whole flagged STATEMENT, whitespace-collapsed
 
     def key(self) -> str:
-        """Stable identity for the baseline: rule + path + normalized
-        snippet (NOT the line number, so unrelated edits above the
-        finding don't invalidate the baseline entry)."""
-        basis = f"{self.rule}|{self.path}|{' '.join(self.snippet.split())}"
+        """Stable identity for the baseline: rule + path + the
+        whitespace-collapsed FULL statement text (``norm``).  Neither
+        the line number nor the line layout participates, so edits
+        above the finding AND pure reformatting (re-indent, re-wrap
+        across lines) both keep the baseline entry alive — a rewrap
+        used to orphan it when the key hashed only the first line."""
+        basis_text = self.norm or self.snippet
+        basis = f"{self.rule}|{self.path}|{' '.join(basis_text.split())}"
         return hashlib.sha1(basis.encode()).hexdigest()[:16]
 
     def to_json(self) -> dict:
         return {"rule": self.rule, "path": self.path, "line": self.line,
                 "message": self.message, "snippet": self.snippet,
-                "key": self.key(), "suppressed": self.suppressed,
+                "norm": self.norm, "key": self.key(),
+                "suppressed": self.suppressed,
+                "suppress_reason": self.suppress_reason,
                 "baselined": self.baselined}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Finding":
+        return cls(d["rule"], d["path"], d["line"], d["message"],
+                   d.get("snippet", ""),
+                   suppressed=d.get("suppressed", False),
+                   suppress_reason=d.get("suppress_reason", ""),
+                   baselined=d.get("baselined", False),
+                   norm=d.get("norm", ""))
 
 
 class SourceFile:
@@ -87,14 +105,24 @@ class SourceFile:
         self.rel = rel.replace(os.sep, "/")
         with open(path, encoding="utf-8") as f:
             self.text = f.read()
+        self.content_hash = hashlib.sha1(self.text.encode()).hexdigest()
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=path)
-        self.comments: Dict[int, str] = self._comment_map()
+        self._comments: Optional[Dict[int, str]] = None
         self.imports: Dict[str, str] = self._import_table(package)
         self.parents: Dict[ast.AST, ast.AST] = {}
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
+
+    @property
+    def comments(self) -> Dict[int, str]:
+        """Per-line comment map, tokenized LAZILY on first access:
+        suppression checks touch only files that have findings, and
+        tokenize was ~a third of the old eager parse cost."""
+        if self._comments is None:
+            self._comments = self._comment_map()
+        return self._comments
 
     # -- construction helpers -----------------------------------------------------
     def _comment_map(self) -> Dict[int, str]:
@@ -230,14 +258,18 @@ class SourceFile:
 
 
 class LintTree:
-    """The shared parse every pass walks."""
+    """The shared parse every pass walks.  ``only`` restricts parsing
+    to a subset of repo-relative paths — the incremental runner's way
+    of skipping files whose cached verdicts are still valid."""
 
-    def __init__(self, repo: str, roots: Iterable[str] = DEFAULT_ROOTS):
+    def __init__(self, repo: str, roots: Iterable[str] = DEFAULT_ROOTS,
+                 only: Optional[Iterable[str]] = None):
         self.repo = repo
         self.roots = tuple(roots)
         self.files: List[SourceFile] = []
         self.errors: List[Finding] = []
         self.parse_s = 0.0
+        wanted = None if only is None else set(only)
         t0 = time.perf_counter()
         for root in self.roots:
             base = os.path.join(repo, root)
@@ -249,13 +281,16 @@ class LintTree:
                     if not fname.endswith(".py"):
                         continue
                     path = os.path.join(dirpath, fname)
-                    rel = os.path.relpath(path, repo)
+                    rel = os.path.relpath(path, repo) \
+                        .replace(os.sep, "/")
+                    if wanted is not None and rel not in wanted:
+                        continue
                     pkg = self._package_of(rel)
                     try:
                         self.files.append(SourceFile(path, rel, pkg))
                     except SyntaxError as ex:
                         self.errors.append(Finding(
-                            "parse-error", rel.replace(os.sep, "/"),
+                            "parse-error", rel,
                             ex.lineno or 0, f"syntax error: {ex.msg}"))
         self.parse_s = time.perf_counter() - t0
 
@@ -280,7 +315,15 @@ class LintTree:
         line = getattr(node, "lineno", 0)
         snippet = sf.lines[line - 1].strip() if 0 < line <= len(sf.lines) \
             else ""
-        f = Finding(rule, sf.rel, line, message, snippet)
+        # baseline identity: the flagged statement's FULL text with ALL
+        # whitespace stripped — a pure reformat (re-indent, re-wrap)
+        # introduces/moves whitespace at token boundaries and nothing
+        # else, so this is exactly the reformat-stable key
+        lo, hi = sf.span(node)
+        norm = "".join(" ".join(
+            sf.lines[lo - 1:min(hi, len(sf.lines))]).split()) \
+            if 0 < lo <= len(sf.lines) else snippet
+        f = Finding(rule, sf.rel, line, message, snippet, norm=norm)
         sup, reason = sf.suppression(node, rule, extra_nodes)
         if sup:
             f.suppressed = True
@@ -297,10 +340,13 @@ class LintTree:
 def _load_passes():
     from .passes import (blocking_fetch, cache_keys, conf_registry,
                          ctx_threads, fault_paths, lock_discipline,
-                         release_paths, shutdown_paths, span_timing)
+                         protocol_conformance, release_paths,
+                         shared_state_races, shutdown_paths,
+                         span_timing, typestate)
     return [blocking_fetch, span_timing, ctx_threads, cache_keys,
             fault_paths, release_paths, lock_discipline,
-            shutdown_paths, conf_registry]
+            shutdown_paths, shared_state_races, typestate,
+            protocol_conformance, conf_registry]
 
 
 def available_rules() -> List[str]:
@@ -335,7 +381,7 @@ def load_baseline(path: str = BASELINE_PATH) -> Dict[str, dict]:
 def write_baseline(findings: List[Finding],
                    path: str = BASELINE_PATH) -> int:
     entries = [{"key": f.key(), "rule": f.rule, "path": f.path,
-                "snippet": f.snippet} for f in findings
+                "snippet": f.snippet, "norm": f.norm} for f in findings
                if not f.suppressed]
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"comment": "accepted legacy findings; regenerate "
@@ -358,6 +404,9 @@ class LintReport:
     files: int = 0
     pass_timings: Dict[str, float] = field(default_factory=dict)
     from_cache: bool = False
+    # set by the incremental runner: {"changed", "cone", "parsed",
+    # "global_rerun", "total_s"}
+    incremental: Optional[dict] = None
 
     @property
     def failing(self) -> List[Finding]:
@@ -379,6 +428,7 @@ class LintReport:
             "parse_s": round(self.parse_s, 4),
             "run_s": round(self.run_s, 4),
             "from_cache": self.from_cache,
+            "incremental": self.incremental,
             "pass_timings_s": {k: round(v, 4)
                                for k, v in self.pass_timings.items()},
             "counts": {"failing": len(self.failing),
@@ -440,31 +490,56 @@ def run(repo: str = REPO, roots: Iterable[str] = DEFAULT_ROOTS,
 _memo: Dict[str, LintReport] = {}
 
 
-def _tree_fingerprint(repo: str, roots: Iterable[str]) -> str:
-    h = hashlib.sha1(ENGINE_VERSION.encode())
-    own = os.path.dirname(os.path.abspath(__file__))
-    # docs/configs.md is an INPUT of the conf-registry pass (two-way
-    # registry<->doc sync) but lives outside the scanned roots: a
-    # regenerated doc must invalidate a cached failing report
-    try:
-        st = os.stat(os.path.join(repo, "docs", "configs.md"))
-        h.update(f"configs.md|{st.st_mtime_ns}|{st.st_size}".encode())
-    except OSError:
-        pass
-    for base in [os.path.join(repo, r) for r in roots] + [own]:
+def file_hashes(repo: str, roots: Iterable[str] = DEFAULT_ROOTS
+                ) -> Dict[str, str]:
+    """Per-file CONTENT hashes (sha1) for every ``.py`` under the
+    scanned roots — the cache key unit.  mtime+size keyed caching (the
+    PR 9 scheme) invalidated on ``touch`` and survived content-
+    preserving mtime tricks; content hashes do exactly the opposite."""
+    out: Dict[str, str] = {}
+    for root in roots:
+        base = os.path.join(repo, root)
         for dirpath, dirnames, filenames in os.walk(base):
             dirnames.sort()
             dirnames[:] = [d for d in dirnames if d != "__pycache__"]
             for fname in sorted(filenames):
-                if not fname.endswith((".py", ".json", ".md")):
+                if not fname.endswith(".py"):
                     continue
                 path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, repo).replace(os.sep, "/")
                 try:
-                    st = os.stat(path)
+                    with open(path, "rb") as f:
+                        out[rel] = hashlib.sha1(f.read()).hexdigest()
                 except OSError:
                     continue
-                h.update(f"{path}|{st.st_mtime_ns}|{st.st_size}"
-                         .encode())
+    return out
+
+
+def configs_md_hash(repo: str) -> str:
+    """docs/configs.md is an INPUT of the conf-registry pass (two-way
+    registry<->doc sync) but lives outside the scanned roots: a
+    regenerated doc must invalidate cached conf-registry verdicts."""
+    try:
+        with open(os.path.join(repo, "docs", "configs.md"), "rb") as f:
+            return hashlib.sha1(f.read()).hexdigest()
+    except OSError:
+        return ""
+
+
+def _tree_fingerprint(repo: str, roots: Iterable[str],
+                      hashes: Optional[Dict[str, str]] = None) -> str:
+    if hashes is None:
+        hashes = file_hashes(repo, roots)
+    h = hashlib.sha1(ENGINE_VERSION.encode())
+    h.update(f"configs.md|{configs_md_hash(repo)}".encode())
+    # baseline.json is .json (not hashed by file_hashes): include it
+    try:
+        with open(BASELINE_PATH, "rb") as f:
+            h.update(hashlib.sha1(f.read()).digest())
+    except OSError:
+        pass
+    for rel in sorted(hashes):
+        h.update(f"{rel}|{hashes[rel]}".encode())
     return h.hexdigest()
 
 
@@ -476,11 +551,15 @@ def _disk_cache_path(repo: str) -> str:
 
 def run_for_pytest(repo: str = REPO) -> LintReport:
     """The conftest entry point: ONE cached scan replaces the five
-    regex lints' five collection-time tree walks.  Keyed by an
-    mtime+size snapshot of the scanned roots (and of srtlint itself),
-    memoized in-process and mirrored to a temp-dir JSON sidecar so an
-    unchanged tree re-verifies in milliseconds across pytest runs."""
-    fp = _tree_fingerprint(repo, DEFAULT_ROOTS)
+    regex lints' five collection-time tree walks.  Keyed by per-file
+    CONTENT hashes, memoized in-process and mirrored to a temp-dir JSON
+    sidecar so an unchanged tree re-verifies in milliseconds across
+    pytest runs; a CHANGED tree re-verifies incrementally
+    (:func:`.incremental.run_incremental`) — only edited files and
+    their reverse-dependency cone are re-analyzed, global passes re-run
+    only when their declared scope was touched."""
+    hashes = file_hashes(repo, DEFAULT_ROOTS)
+    fp = _tree_fingerprint(repo, DEFAULT_ROOTS, hashes)
     hit = _memo.get(fp)
     if hit is not None:
         return hit
@@ -494,16 +573,13 @@ def run_for_pytest(repo: str = REPO) -> LintReport:
                 run_s=cached["report"]["run_s"],
                 files=cached["report"]["files"], from_cache=True)
             for fj in cached["report"]["findings"]:
-                fnd = Finding(fj["rule"], fj["path"], fj["line"],
-                              fj["message"], fj["snippet"],
-                              suppressed=fj["suppressed"],
-                              baselined=fj["baselined"])
-                report.findings.append(fnd)
+                report.findings.append(Finding.from_json(fj))
             _memo[fp] = report
             return report
     except (OSError, ValueError, KeyError):
         pass
-    report = run(repo)
+    from .incremental import run_incremental
+    report = run_incremental(repo, DEFAULT_ROOTS, hashes=hashes)
     _memo[fp] = report
     try:
         with open(cache_path, "w", encoding="utf-8") as f:
@@ -513,18 +589,90 @@ def run_for_pytest(repo: str = REPO) -> LintReport:
     return report
 
 
+def to_sarif(report: LintReport, repo: str = REPO) -> dict:
+    """SARIF 2.1.0 — the interchange shape code-review UIs and CI
+    annotators ingest.  Failing findings become ``results``; reasoned
+    suppressions ride along with SARIF ``suppressions`` entries so a
+    SARIF viewer shows the why without failing the run."""
+    rules_meta = [{"id": p.RULE,
+                   "shortDescription": {"text": p.TITLE}}
+                  for p in _load_passes()]
+    rules_meta.append({"id": "parse-error",
+                       "shortDescription":
+                           {"text": "file failed to parse"}})
+    results = []
+    for f in report.findings:
+        if f.baselined:
+            continue
+        entry = {
+            "ruleId": f.rule,
+            "level": "error" if not f.suppressed else "note",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "snippet": {"text": f.snippet}},
+                }}],
+            "partialFingerprints": {"srtlint/key": f.key()},
+        }
+        if f.suppressed:
+            entry["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.suppress_reason}]
+        results.append(entry)
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "srtlint",
+                                "version": ENGINE_VERSION,
+                                "informationUri":
+                                    "docs/static_analysis.md",
+                                "rules": rules_meta}},
+            "results": results,
+        }],
+    }
+
+
+def changed_files(repo: str = REPO) -> Optional[List[str]]:
+    """Repo-relative paths modified vs HEAD (staged + unstaged), via
+    ``git diff --name-only HEAD`` — the pre-push hook's scoping set.
+    None when git is unavailable (caller falls back to the full set)."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=repo, capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return [ln.strip().replace(os.sep, "/")
+            for ln in out.stdout.splitlines() if ln.strip()]
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     ap = argparse.ArgumentParser(
         prog="python -m tools.srtlint",
         description="unified AST static analysis for spark_rapids_tpu "
-                    "(eight passes over one shared parse)")
+                    "(twelve passes over one shared parse)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable report")
+    ap.add_argument("--sarif", metavar="OUT.sarif",
+                    help="also write a SARIF 2.1.0 report to this path")
+    ap.add_argument("--changed", action="store_true",
+                    help="scope FAILING findings to files modified vs "
+                         "git HEAD (pre-push hook mode); the scan "
+                         "itself still covers the tree")
     ap.add_argument("--explain", metavar="RULE",
                     help="print a rule's full documentation and exit")
     ap.add_argument("--rules", metavar="R1,R2",
-                    help="run only these rules")
+                    help="run only these rules (forces a full scan)")
+    ap.add_argument("--full", action="store_true",
+                    help="force a full non-incremental scan")
     ap.add_argument("--update-baseline", action="store_true",
                     help="accept all current findings into "
                          "tools/srtlint/baseline.json")
@@ -544,14 +692,50 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     rules = [r.strip() for r in args.rules.split(",")] if args.rules \
         else None
-    report = run(args.repo, rules=rules, baseline_path=args.baseline)
+    if rules is None and not args.full \
+            and args.baseline == BASELINE_PATH:
+        from .incremental import run_incremental
+        report = run_incremental(args.repo, baseline_path=args.baseline)
+    else:
+        report = run(args.repo, rules=rules,
+                     baseline_path=args.baseline)
     if args.update_baseline:
         n = write_baseline(report.failing + report.baselined,
                            args.baseline)
         print(f"srtlint: baseline updated ({n} accepted findings)")
         return 0
+    failing = report.failing
+    if args.changed:
+        scope = changed_files(args.repo)
+        if scope is not None:
+            scope_set = set(scope)
+            failing = [f for f in failing if f.path in scope_set]
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(to_sarif(report, args.repo), f, indent=1)
     if args.json:
         print(json.dumps(report.to_json(), indent=1))
     else:
-        print(report.render(verbose=args.verbose))
-    return 1 if report.failing else 0
+        out: List[str] = []
+        for f in sorted(failing, key=lambda f: (f.rule, f.path, f.line)):
+            out.append(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+            if f.snippet:
+                out.append(f"    {f.snippet}")
+        if args.verbose:
+            for f in report.suppressed:
+                out.append(f"{f.path}:{f.line}: [{f.rule}] suppressed "
+                           f"({f.suppress_reason})")
+        scoped = f", {len(failing)} in changed files" if args.changed \
+            else ""
+        out.append(
+            f"srtlint: {len(report.failing)} finding(s){scoped}, "
+            f"{len(report.suppressed)} suppressed, "
+            f"{len(report.baselined)} baselined across "
+            f"{report.files} files "
+            f"(parse {report.parse_s * 1e3:.0f} ms, passes "
+            f"{report.run_s * 1e3:.0f} ms"
+            + (", cached" if report.from_cache else "")
+            + (f", incremental cone {report.incremental['cone']}"
+               if report.incremental else "") + ")")
+        print("\n".join(out))
+    return 1 if failing else 0

@@ -1,0 +1,271 @@
+"""Incremental scanning: only changed files and their reverse-
+dependency cone are re-analyzed.
+
+The PR 9 cache was all-or-nothing: an mtime-matched tree replayed the
+whole verdict, but ANY edit paid the full cold scan (parse every file,
+run every pass).  This runner makes the cold path proportional to the
+edit instead, using two cache granularities keyed by CONTENT hashes:
+
+  * **per-file** — passes marked ``PER_FILE`` (blocking-fetch,
+    span-timing, ctx-threads, cache-keys, fault-paths, release-paths,
+    shutdown-paths, typestate) produce findings that depend only on
+    one file's text.  Their findings (and parse errors) are cached per
+    ``(file content hash, engine)`` and re-computed only for files in
+    the CHANGED CONE — the edited files plus every file whose imports
+    reach one (transitive reverse-dependency closure, from each file's
+    resolved import table);
+  * **per-scope** — global passes declare ``SCOPE`` path prefixes
+    (lock-discipline: the lock dirs; shared-state-races: the whole
+    package — call chains can carry a thread root anywhere;
+    protocol-conformance: the protocol modules; conf-registry: the
+    tree + docs/configs.md).  Each caches its full finding list keyed
+    by a hash over its scope files' content hashes and re-runs only
+    when the cone intersects its scope.
+
+Only files in the cone or in a re-running global pass's scope are
+PARSED at all — a one-file edit outside the serving layers re-verifies
+in a fraction of the full cold scan (the acceptance test pins this).
+
+State lives in a temp-dir JSON sidecar per repo; a corrupt/absent
+sidecar (or an engine change) degrades to one full scan that reseeds
+it.  The assembled :class:`..engine.LintReport` is byte-equivalent to
+a full :func:`..engine.run` — suppressions, reasons, and baseline
+handling ride the cached JSON round-trip.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Set
+
+from . import engine as _e
+
+STATE_VERSION = 2
+
+
+def _state_path(repo: str) -> str:
+    import tempfile
+    tag = hashlib.sha1(repo.encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(), f"srtlint-incr-{tag}.json")
+
+
+def _load_state(repo: str) -> dict:
+    try:
+        with open(_state_path(repo), encoding="utf-8") as f:
+            state = json.load(f)
+        if state.get("version") == STATE_VERSION \
+                and state.get("engine") == _e.ENGINE_VERSION:
+            return state
+    except (OSError, ValueError):
+        pass
+    return {"version": STATE_VERSION, "engine": _e.ENGINE_VERSION,
+            "hashes": {}, "deps": {}, "local": {}, "global": {}}
+
+
+def _module_map(rels: Iterable[str]) -> Dict[str, str]:
+    """dotted module name -> repo-relative path, for dep resolution."""
+    out: Dict[str, str] = {}
+    for rel in rels:
+        dotted = rel[:-3].replace("/", ".")
+        out[dotted] = rel
+        if dotted.endswith(".__init__"):
+            out[dotted[: -len(".__init__")]] = rel
+    return out
+
+
+def _deps_of(sf, modmap: Dict[str, str]) -> List[str]:
+    """In-tree files this module's verdict may depend on, from its
+    resolved import table (``from ..cache.keys import CacheKey`` makes
+    this file a dependent of cache/keys.py)."""
+    deps: Set[str] = set()
+    for dotted in sf.imports.values():
+        probe = dotted
+        while probe:
+            rel = modmap.get(probe)
+            if rel and rel != sf.rel:
+                deps.add(rel)
+                break
+            probe = probe.rpartition(".")[0]
+    return sorted(deps)
+
+
+def _changed_cone(changed: Set[str], deps: Dict[str, List[str]],
+                  alive: Set[str]) -> Set[str]:
+    """changed ∪ its transitive reverse-dependency closure."""
+    rdeps: Dict[str, Set[str]] = {}
+    for rel, ds in deps.items():
+        for d in ds:
+            rdeps.setdefault(d, set()).add(rel)
+    cone = set(changed)
+    frontier = list(changed)
+    while frontier:
+        cur = frontier.pop()
+        for dep in rdeps.get(cur, ()):
+            if dep not in cone:
+                cone.add(dep)
+                frontier.append(dep)
+    return cone & alive
+
+
+def _scope_rels(mod, hashes: Dict[str, str]) -> List[str]:
+    prefixes = getattr(mod, "SCOPE", ("",))
+    return sorted(rel for rel in hashes
+                  if any(rel == p or rel.startswith(p)
+                         for p in prefixes))
+
+
+def _scope_hash(mod, hashes: Dict[str, str], repo: str) -> str:
+    h = hashlib.sha1(_e.ENGINE_VERSION.encode())
+    for rel in _scope_rels(mod, hashes):
+        h.update(f"{rel}|{hashes[rel]}".encode())
+    if mod.RULE == "conf-registry":
+        h.update(_e.configs_md_hash(repo).encode())
+    return h.hexdigest()
+
+
+class _TreeView:
+    """A LintTree facade exposing only a subset of files — how the
+    per-file passes are re-run on just the changed cone."""
+
+    def __init__(self, tree, include: Set[str]):
+        self._tree = tree
+        self.files = [sf for sf in tree.files if sf.rel in include]
+        self.repo = tree.repo
+
+    def package_files(self):
+        return [sf for sf in self.files
+                if sf.rel.startswith("spark_rapids_tpu/")]
+
+    def in_dirs(self, sf, subdirs, package: str = "spark_rapids_tpu"):
+        return self._tree.in_dirs(sf, subdirs, package)
+
+    def finding(self, *a, **kw):
+        return self._tree.finding(*a, **kw)
+
+
+def run_incremental(repo: str = _e.REPO,
+                    roots: Iterable[str] = _e.DEFAULT_ROOTS,
+                    baseline_path: str = _e.BASELINE_PATH,
+                    hashes: Optional[Dict[str, str]] = None
+                    ) -> _e.LintReport:
+    t_start = time.perf_counter()
+    if hashes is None:
+        hashes = _e.file_hashes(repo, roots)
+    state = _load_state(repo)
+    alive = set(hashes)
+    changed = {rel for rel in alive
+               if state["hashes"].get(rel) != hashes[rel]}
+    removed = set(state["hashes"]) - alive
+    # files with no cached local verdict are effectively changed
+    changed |= {rel for rel in alive if rel not in state["local"]}
+    # the CONE: changed files + their transitive reverse-dependency
+    # closure.  Per-file passes resolve everything from each file's own
+    # text, so only CHANGED files re-run them; the cone is the
+    # summary-invalidation unit — a global pass re-runs when the cone
+    # touches its scope (an edit to a module its scope files import
+    # counts, not just direct scope edits)
+    cone = _changed_cone(changed | removed, state["deps"], alive)
+
+    passes = _e._load_passes()
+    local_passes = [p for p in passes if getattr(p, "PER_FILE", False)]
+    global_passes = [p for p in passes
+                     if not getattr(p, "PER_FILE", False)]
+    rerun_global = []
+    global_findings: Dict[str, List[_e.Finding]] = {}
+    for mod in global_passes:
+        basis = _scope_hash(mod, hashes, repo)
+        cached = state["global"].get(mod.RULE)
+        scope_touched = any(
+            any(rel == p or rel.startswith(p)
+                for p in getattr(mod, "SCOPE", ("",)))
+            for rel in cone)
+        if cached is not None and cached.get("scope") == basis \
+                and not scope_touched:
+            global_findings[mod.RULE] = [
+                _e.Finding.from_json(d) for d in cached["findings"]]
+        else:
+            rerun_global.append((mod, basis))
+
+    to_parse = set(changed)
+    for mod, _basis in rerun_global:
+        to_parse.update(_scope_rels(mod, hashes))
+    tree = _e.LintTree(repo, roots, only=to_parse)
+    report = _e.LintReport(parse_s=tree.parse_s, files=len(alive))
+
+    # parse errors: fresh for cone files, cached for everything else
+    parsed_rels = {sf.rel for sf in tree.files}
+    fresh_errors: Dict[str, List[_e.Finding]] = {}
+    for f in tree.errors:
+        fresh_errors.setdefault(f.path, []).append(f)
+
+    t0 = time.perf_counter()
+    view = _TreeView(tree, changed)
+    fresh_local: Dict[str, List[_e.Finding]] = {rel: []
+                                                for rel in changed}
+    for mod in local_passes:
+        p0 = time.perf_counter()
+        for f in mod.run(view):
+            fresh_local.setdefault(f.path, []).append(f)
+        report.pass_timings[mod.RULE] = time.perf_counter() - p0
+    for mod, basis in rerun_global:
+        p0 = time.perf_counter()
+        found = list(mod.run(tree))
+        global_findings[mod.RULE] = found
+        state["global"][mod.RULE] = {
+            "scope": basis, "findings": [f.to_json() for f in found]}
+        report.pass_timings[mod.RULE] = time.perf_counter() - p0
+    for mod in global_passes:
+        report.pass_timings.setdefault(mod.RULE, 0.0)
+    for mod in local_passes:
+        report.pass_timings.setdefault(mod.RULE, 0.0)
+
+    # assemble: cached local findings for untouched files, fresh for
+    # the cone, global passes from their (possibly cached) runs
+    baseline = _e.load_baseline(baseline_path)
+
+    def _admit(f: _e.Finding) -> None:
+        # recompute against the CURRENT baseline — cached findings
+        # carry whatever the baseline said when they were cached
+        f.baselined = bool(not f.suppressed and f.key() in baseline)
+        report.findings.append(f)
+
+    for rel in sorted(alive):
+        if rel in changed:
+            for f in fresh_errors.get(rel, []):
+                _admit(f)
+            for f in fresh_local.get(rel, []):
+                _admit(f)
+            state["local"][rel] = [
+                f.to_json()
+                for f in (fresh_errors.get(rel, [])
+                          + fresh_local.get(rel, []))]
+        else:
+            for d in state["local"].get(rel, []):
+                _admit(_e.Finding.from_json(d))
+    for mod in global_passes:
+        for f in global_findings.get(mod.RULE, []):
+            _admit(f)
+
+    # dependency table: recompute for parsed files, keep the rest
+    modmap = _module_map(alive)
+    for sf in tree.files:
+        state["deps"][sf.rel] = _deps_of(sf, modmap)
+    for rel in removed:
+        state["deps"].pop(rel, None)
+        state["local"].pop(rel, None)
+    state["hashes"] = dict(hashes)
+    try:
+        with open(_state_path(repo), "w", encoding="utf-8") as f:
+            json.dump(state, f)
+    except OSError:
+        pass
+    report.run_s = time.perf_counter() - t0
+    report.incremental = {
+        "changed": len(changed), "cone": len(cone),
+        "parsed": len(parsed_rels),
+        "global_rerun": [m.RULE for m, _ in rerun_global],
+        "total_s": round(time.perf_counter() - t_start, 4)}
+    return report

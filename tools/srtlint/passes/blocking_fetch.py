@@ -7,6 +7,7 @@ import ast
 from typing import List
 
 RULE = "blocking-fetch"
+PER_FILE = True   # findings depend only on each file itself (incremental cache unit)
 TITLE = ("no raw device->host transfers outside utils.metrics.fetch/"
          "fetch_async in the operator layer")
 EXPLAIN = """

@@ -7,6 +7,7 @@ import ast
 from typing import List
 
 RULE = "cache-keys"
+PER_FILE = True   # findings depend only on each file itself (incremental cache unit)
 TITLE = "cache keys are constructed only in cache/keys.py"
 EXPLAIN = """
 The cross-query cache's correctness hangs on ONE identity rule — two

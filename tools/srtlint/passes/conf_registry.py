@@ -9,6 +9,10 @@ import re
 from typing import Dict, List, Set
 
 RULE = "conf-registry"
+PER_FILE = False
+# incremental scan scope: conf literals appear anywhere in the tree
+# (docs/configs.md is hashed into the scope separately by the engine)
+SCOPE = ("spark_rapids_tpu/", "tools/")
 TITLE = ("spark.rapids.tpu.* literals are registered, documented, and "
          "none are orphaned")
 EXPLAIN = """

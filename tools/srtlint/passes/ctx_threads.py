@@ -9,6 +9,7 @@ from typing import List, Optional
 from .. import cfg
 
 RULE = "ctx-threads"
+PER_FILE = True   # findings depend only on each file itself (incremental cache unit)
 TITLE = "threads/pools must run work through a copied query context"
 EXPLAIN = """
 Per-query accounting (``QueryStats.scoped``), tracing, and cooperative

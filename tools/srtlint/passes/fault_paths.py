@@ -9,6 +9,7 @@ from typing import List
 from .. import cfg
 
 RULE = "fault-paths"
+PER_FILE = True   # findings depend only on each file itself (incremental cache unit)
 TITLE = ("no swallowed faults, ad-hoc transient retries, or unbounded "
          "blocking waits")
 EXPLAIN = """

@@ -9,6 +9,12 @@ from typing import Dict, List, Optional, Set, Tuple
 from .. import cfg
 
 RULE = "lock-discipline"
+PER_FILE = False
+# incremental scan scope: the lock graph spans these prefixes — an edit
+# outside them cannot change this pass's verdict
+SCOPE = ("spark_rapids_tpu/service/", "spark_rapids_tpu/runtime/",
+         "spark_rapids_tpu/cache/", "spark_rapids_tpu/parallel/",
+         "spark_rapids_tpu/server/", "spark_rapids_tpu/memory/")
 TITLE = ("no blocking call while a lock is held; the lock-acquisition "
          "graph is acyclic")
 EXPLAIN = """

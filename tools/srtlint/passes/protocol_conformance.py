@@ -1,0 +1,332 @@
+"""protocol-conformance: the wire/collective vocabularies and their
+decode/dispatch sites stay two-way exhaustive."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import cfg
+
+RULE = "protocol-conformance"
+PER_FILE = False
+# incremental scan scope: the protocol registries and every module that
+# speaks them
+SCOPE = ("spark_rapids_tpu/server/", "spark_rapids_tpu/parallel/dcn.py",
+         "tools/loadgen.py")
+TITLE = ("every frame type / error code / DCN op is registered, sent "
+         "somewhere, and handled at its decoders")
+EXPLAIN = """
+The wire protocol grew GOAWAY, retry_after, and journal-replay frames
+across three PRs — each a chance for a constant to be minted at one end
+and never dispatched at the other (or for a dead code to linger after
+its sender was refactored away).  This pass cross-references the
+protocol VOCABULARIES against every send/decode site:
+
+  * **wire frames** (``server/protocol.py`` ``REQ_*`` / ``RSP_*``
+    byte constants) — a constant that is sent (``send_frame(sock,
+    CONST, ...)`` anywhere in ``server/`` or ``tools/loadgen.py``) must
+    be handled at a decoder: a ``recv_frame(..., expect=(...))``
+    tuple, or an ``ftype == CONST`` / ``ftype in (C1, C2)`` dispatch
+    comparison.  A constant nobody sends is dead vocabulary;
+  * **wire error codes** — the canonical list is
+    ``protocol.ERROR_CODES``.  Every ``WireError("CODE", ...)``
+    construction (including codes bound through a local like
+    ``code, detail = "DEADLINE", ""`` and subclass ``super().__init__``
+    calls) must use a registered code; every registered code must be
+    constructed somewhere; every client-side dispatch comparison
+    (``e.code == "X"`` / ``e.code in (...)``) must name registered
+    codes — a typo'd comparison silently never matches;
+  * **DCN collective ops** (``parallel/dcn.py`` ``DCN_OPS``) — every
+    ``{"op": "x", ...}`` frame built must be dispatched at a server
+    (``op == "x"`` / ``op != "x"`` / ``op in _COORD_OPS``) and
+    registered in ``DCN_OPS``; registered ops nobody sends are dead.
+
+Findings anchor where the fix goes: unhandled constants at their send
+site, dead vocabulary at the registry entry, unregistered codes at the
+construction/comparison.  Suppress with ``# srtlint:
+ignore[protocol-conformance] (<who decodes this, or why it stays>)``.
+"""
+
+_PROTO_REL = "spark_rapids_tpu/server/protocol.py"
+_DCN_REL = "spark_rapids_tpu/parallel/dcn.py"
+_WIRE_SCOPE = ("spark_rapids_tpu/server/", "tools/loadgen.py")
+
+
+def _last(name: Optional[str]) -> str:
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _const_name(sf, node: ast.AST) -> Optional[str]:
+    """REQ_/RSP_ constant referenced as NAME or alias.NAME."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _str_elts(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+        return out
+    if isinstance(node, ast.IfExp):  # code = "A" if cond else "B"
+        return _str_elts(node.body) + _str_elts(node.orelse)
+    return []
+
+
+def _local_str_bindings(sf, fn, name: str) -> List[str]:
+    """Literal strings a local ``name`` can hold in ``fn`` (the
+    ``code, detail = "DEADLINE", ""`` shape included)."""
+    out: List[str] = []
+    if fn is None:
+        return out
+    for node in cfg.walk_scope(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == name:
+                out.extend(_str_elts(node.value))
+            elif isinstance(tgt, (ast.Tuple, ast.List)) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)) \
+                    and len(tgt.elts) == len(node.value.elts):
+                for t, v in zip(tgt.elts, node.value.elts):
+                    if isinstance(t, ast.Name) and t.id == name:
+                        out.extend(_str_elts(v))
+    return out
+
+
+# ---------------------------------------------------------------------------------
+# wire frames + error codes
+# ---------------------------------------------------------------------------------
+
+def _check_wire(tree, findings: List) -> None:
+    proto = next((sf for sf in tree.files if sf.rel == _PROTO_REL), None)
+    if proto is None:
+        return
+    frame_defs: Dict[str, ast.AST] = {}
+    registry: Dict[str, ast.AST] = {}
+    registry_node: Optional[ast.AST] = None
+    wire_error_classes = {"WireError"}
+    for node in proto.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name.startswith(("REQ_", "RSP_")) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, bytes):
+                frame_defs[name] = node
+            elif name == "ERROR_CODES":
+                registry_node = node
+                for code in _str_elts(node.value):
+                    registry[code] = node
+        elif isinstance(node, ast.ClassDef):
+            if any(_last(proto.qualname(b)) in wire_error_classes
+                   for b in node.bases):
+                wire_error_classes.add(node.name)
+    if registry_node is None:
+        findings.append(tree.finding(
+            proto, proto.tree.body[0] if proto.tree.body else proto.tree,
+            RULE, "server/protocol.py declares no ERROR_CODES registry "
+                  "— the error-code vocabulary has no canonical list "
+                  "to check decoders against"))
+
+    sent: Dict[str, Tuple] = {}          # frame const -> first send site
+    decoded: Set[str] = set()
+    constructed: Dict[str, Tuple] = {}   # code -> first ctor site
+    compared: List[Tuple[str, object, ast.AST]] = []  # (code, sf, node)
+
+    scope = [sf for sf in tree.files
+             if sf.rel.startswith(_WIRE_SCOPE[0])
+             or sf.rel == _WIRE_SCOPE[1]]
+    for sf in scope:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                fname = _last(sf.call_qualname(node)) \
+                    or (_last(node.func.attr)
+                        if isinstance(node.func, ast.Attribute) else "")
+                if fname == "send_frame" and len(node.args) >= 2:
+                    cname = _const_name(sf, node.args[1])
+                    if cname in frame_defs:
+                        sent.setdefault(cname, (sf, node))
+                elif fname == "recv_frame":
+                    exp = None
+                    for kw in node.keywords:
+                        if kw.arg == "expect":
+                            exp = kw.value
+                    if exp is None and len(node.args) >= 2:
+                        exp = node.args[1]
+                    if isinstance(exp, (ast.Tuple, ast.List)):
+                        for e in exp.elts:
+                            cname = _const_name(sf, e)
+                            if cname in frame_defs:
+                                decoded.add(cname)
+                elif fname in wire_error_classes and node.args:
+                    arg0 = node.args[0]
+                    codes = _str_elts(arg0)
+                    if not codes and isinstance(arg0, ast.Name):
+                        codes = _local_str_bindings(
+                            sf, sf.enclosing_function(node), arg0.id)
+                    for code in codes:
+                        constructed.setdefault(code, (sf, node))
+                elif fname == "__init__" \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Call) \
+                        and _last(sf.call_qualname(node.func.value)) \
+                        == "super" and node.args:
+                    klass = cfg.enclosing_class(sf, node)
+                    if klass is not None \
+                            and (klass.name in wire_error_classes
+                                 or any(_last(sf.qualname(b))
+                                        in wire_error_classes
+                                        for b in klass.bases)):
+                        for code in _str_elts(node.args[0]):
+                            constructed.setdefault(code, (sf, node))
+            elif isinstance(node, ast.Compare) \
+                    and len(node.comparators) == 1:
+                left, right = node.left, node.comparators[0]
+                for a, b in ((left, right), (right, left)):
+                    # frame dispatch: CONST vs expr, or a tuple of
+                    # CONSTs as the membership right-hand side
+                    cname = _const_name(sf, a)
+                    if cname in frame_defs:
+                        decoded.add(cname)
+                    if isinstance(b, (ast.Tuple, ast.List, ast.Set)):
+                        for e in b.elts:
+                            en = _const_name(sf, e)
+                            if en in frame_defs:
+                                decoded.add(en)
+                    # error-code dispatch: e.code == "X" / in (...)
+                    if isinstance(a, ast.Attribute) and a.attr == "code":
+                        for code in _str_elts(b):
+                            compared.append((code, sf, node))
+
+    for cname, (sf, node) in sorted(sent.items()):
+        if cname not in decoded:
+            findings.append(tree.finding(
+                sf, node, RULE,
+                f"frame type {cname} is sent here but no decoder "
+                f"handles it (no expect= tuple or ftype dispatch "
+                f"names it) — the receiver will treat it as a "
+                f"protocol error"))
+    for cname, node in sorted(frame_defs.items()):
+        if cname not in sent:
+            findings.append(tree.finding(
+                proto, node, RULE,
+                f"dead frame type: {cname} is defined but nobody "
+                f"sends it — retire it or wire up the sender"))
+    for code, (sf, node) in sorted(constructed.items()):
+        if registry and code not in registry:
+            findings.append(tree.finding(
+                sf, node, RULE,
+                f"error code {code!r} is constructed here but missing "
+                f"from protocol.ERROR_CODES — register it so clients "
+                f"can dispatch on it"))
+    for code, node in sorted(registry.items()):
+        if code not in constructed:
+            findings.append(tree.finding(
+                proto, node, RULE,
+                f"dead error code: {code!r} is registered in "
+                f"ERROR_CODES but never constructed — retire it"))
+    seen_cmp: Set[Tuple[str, int]] = set()
+    for code, sf, node in compared:
+        if registry and code not in registry:
+            key = (code, node.lineno)
+            if key in seen_cmp:
+                continue
+            seen_cmp.add(key)
+            findings.append(tree.finding(
+                sf, node, RULE,
+                f"dispatch compares .code against {code!r}, which is "
+                f"not in protocol.ERROR_CODES — this branch can never "
+                f"match"))
+
+
+# ---------------------------------------------------------------------------------
+# DCN collective ops
+# ---------------------------------------------------------------------------------
+
+def _check_dcn(tree, findings: List) -> None:
+    dcn = next((sf for sf in tree.files if sf.rel == _DCN_REL), None)
+    if dcn is None:
+        return
+    registry: Dict[str, ast.AST] = {}
+    registry_node = None
+    tuples: Dict[str, List[str]] = {}    # module-level str tuples
+    for node in dcn.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            elts = _str_elts(node.value)
+            if elts:
+                tuples[name] = elts
+            if name == "DCN_OPS":
+                registry_node = node
+                for op in elts:
+                    registry[op] = node
+    if registry_node is None:
+        findings.append(tree.finding(
+            dcn, dcn.tree.body[0] if dcn.tree.body else dcn.tree, RULE,
+            "parallel/dcn.py declares no DCN_OPS registry — the "
+            "collective op vocabulary has no canonical list"))
+
+    sent: Dict[str, Tuple] = {}
+    handled: Set[str] = set()
+    for node in ast.walk(dcn.tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "op":
+                    for op in _str_elts(v):
+                        sent.setdefault(op, (dcn, node))
+        elif isinstance(node, ast.Compare) \
+                and len(node.comparators) == 1:
+            left, right = node.left, node.comparators[0]
+            involves_op = any(
+                (isinstance(n, ast.Name) and n.id == "op")
+                or (isinstance(n, ast.Constant) and n.value == "op")
+                for side in (left, right) for n in ast.walk(side))
+            if not involves_op:
+                continue
+            for side in (left, right):
+                for op in _str_elts(side):
+                    handled.add(op)
+                if isinstance(side, ast.Name) and side.id in tuples \
+                        and side.id != "DCN_OPS":
+                    handled.update(tuples[side.id])
+
+    for op, (sf, node) in sorted(sent.items()):
+        if op not in handled:
+            findings.append(tree.finding(
+                sf, node, RULE,
+                f"DCN op {op!r} is sent here but no dispatch site "
+                f"(op == / op in ...) handles it — the server will "
+                f"answer 'unknown op'"))
+        if registry and op not in registry:
+            findings.append(tree.finding(
+                sf, node, RULE,
+                f"DCN op {op!r} is sent here but missing from DCN_OPS "
+                f"— register it"))
+    for op in sorted(handled):
+        if registry and op not in registry:
+            findings.append(tree.finding(
+                dcn, registry_node, RULE,
+                f"a dispatch site handles DCN op {op!r}, which is not "
+                f"in DCN_OPS — dead branch or missing registration"))
+    for op, node in sorted(registry.items()):
+        if op not in sent:
+            findings.append(tree.finding(
+                dcn, node, RULE,
+                f"dead DCN op: {op!r} is registered in DCN_OPS but "
+                f"never sent — retire it"))
+
+
+def run(tree) -> List:
+    findings: List = []
+    _check_wire(tree, findings)
+    _check_dcn(tree, findings)
+    return findings

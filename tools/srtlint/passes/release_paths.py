@@ -9,6 +9,7 @@ from typing import Dict, List, Optional, Set
 from .. import cfg
 
 RULE = "release-paths"
+PER_FILE = True   # findings depend only on each file itself (incremental cache unit)
 TITLE = ("permits, spill handles, cached-build refs, quota slots, and "
          "spool streams release on every exit edge")
 EXPLAIN = """

@@ -1,0 +1,173 @@
+"""shared-state-races: instance attributes written by two threads must
+share a lock on every access."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .. import cfg, dataflow
+
+RULE = "shared-state-races"
+PER_FILE = False
+# incremental scan scope: call chains from any package module can carry
+# a thread root into the serving layers, so the whole package is input
+SCOPE = ("spark_rapids_tpu/",)
+TITLE = ("every instance attribute written from two thread roots is "
+         "consistently lock-guarded")
+EXPLAIN = """
+The serving layers run one object on many threads: the accept loop, N
+connection handlers, the dispatcher, per-query workers, the watchdog,
+heartbeats, and the DCN failover machinery all mutate shared instance
+state.  This pass walks the interprocedural dataflow layer
+(tools/srtlint/dataflow.py):
+
+  * **thread roots** are enumerated — ``threading.Thread`` targets
+    (including ``lambda: cctx.run(fn)`` and the scheduler's
+    ``target=entry.cctx.run, args=(fn, e)`` shapes) and executor
+    ``pool.submit(cctx.run, fn)`` bodies.  A root created inside a loop
+    (one accept loop, N handlers) is multi-instance: two copies of the
+    same root race each other.  MAIN — the public API surface — is a
+    root too;
+  * every ``self.attr`` access in ``service/``, ``server/``,
+    ``runtime/``, ``cache/``, ``parallel/``, and ``memory/`` classes is
+    attributed to the roots whose call-graph reachability covers its
+    function, with the MUST-hold lockset at the access (lexically held
+    ``with`` locks ∪ the function's fixpoint entry lockset);
+  * an attribute qualifies when it is WRITTEN outside ``__init__`` by
+    two distinct roots, or by one multi-instance root.  For qualifying
+    attributes every write/access pair from different thread identities
+    whose locksets are DISJOINT is a race; the finding lands on the
+    unguarded site so the fix (or the suppression) sits where the code
+    is.
+
+Safe idioms recognized automatically: **immutable-after-publish**
+(written only in ``__init__`` — never flagged), **lock/Condition
+guarded** (a ``with self._lock:`` / ``with self._cv:`` anywhere up the
+call chain enters the must-hold set — an "atomic counter" bumped only
+under its owning lock is simply consistently guarded), and
+**single-writer** attributes (one single-instance root does all the
+writing).  Deliberately unguarded state — monotonic progress stamps the
+watchdog reads sloppily, GIL-atomic snapshots — carries
+``# srtlint: ignore[shared-state-races] (<why a torn/stale read is
+safe>)`` at the write (or racing read) site.
+"""
+
+RACE_DIRS = ("service", "server", "parallel", "runtime", "cache",
+             "memory")
+
+AttrId = Tuple[str, str, str]   # (module rel, class, attr)
+
+
+class _Access:
+    __slots__ = ("sf", "node", "fid", "write", "locks", "in_init")
+
+    def __init__(self, sf, node, fid, write, locks, in_init):
+        self.sf = sf
+        self.node = node
+        self.fid = fid
+        self.write = write
+        self.locks: FrozenSet[str] = locks
+        self.in_init = in_init
+
+
+def _collect_accesses(graph, tree) -> Dict[AttrId, List[_Access]]:
+    out: Dict[AttrId, List[_Access]] = {}
+    for fid, accs in graph.fn_accesses.items():
+        if fid[1] is None:
+            continue
+        sf, _fn = graph.funcs[fid]
+        if not tree.in_dirs(sf, RACE_DIRS):
+            continue
+        entry = graph.entry_locks.get(fid, frozenset())
+        in_init = fid[2] == "__init__"
+        for node, name, write, held in accs:
+            # an attribute holding a lock/cv is the guard, not the state
+            if graph._lock_attrs.get(((sf.rel, fid[1]), name)):
+                continue
+            out.setdefault((sf.rel, fid[1], name), []).append(
+                _Access(sf, node, fid, write, entry | held, in_init))
+    return out
+
+
+def _roots_of(graph, fid) -> List[Tuple[str, bool]]:
+    """(identity, multi) thread identities that may execute ``fid``."""
+    out: List[Tuple[str, bool]] = []
+    for root in graph.thread_roots:
+        if fid in graph.root_reach(root):
+            out.append((root.label, root.multi))
+    if fid in graph.main_reach():
+        out.append((dataflow.MAIN, False))
+    return out
+
+
+def run(tree) -> List:
+    findings: List = []
+    graph = dataflow.build(tree)
+    accesses = _collect_accesses(graph, tree)
+    root_cache: Dict[Tuple, List[Tuple[str, bool]]] = {}
+
+    def roots(fid):
+        got = root_cache.get(fid)
+        if got is None:
+            got = _roots_of(graph, fid)
+            root_cache[fid] = got
+        return got
+
+    for (rel, klass, attr), accs in sorted(accesses.items()):
+        writes = [a for a in accs if a.write and not a.in_init]
+        if not writes:
+            continue  # immutable-after-publish (or init-only)
+        writer_ids: Set[str] = set()
+        multi_writer = False
+        for w in writes:
+            for ident, multi in roots(w.fid):
+                writer_ids.add(ident)
+                multi_writer = multi_writer or multi
+        if len(writer_ids) < 2 and not multi_writer:
+            continue  # single-writer: reads may be stale, not torn
+        n_writers = len(writer_ids) + (1 if multi_writer else 0)
+        # racy pairs: write vs (any access) on different thread
+        # identities (or one shared multi root) with disjoint locksets
+        flagged: Set[int] = set()
+        for w in writes:
+            wroots = roots(w.fid)
+            for a in accs:
+                if a is w or a.in_init:
+                    continue
+                if w.locks & a.locks:
+                    continue  # a common lock serializes the pair
+                aroots = roots(a.fid)
+                # the pair can run on two threads at once: distinct
+                # root identities, or one shared MULTI-instance root
+                # (two connection handlers racing each other)
+                w_ids = {i for i, _ in wroots}
+                a_ids = {i for i, _ in aroots}
+                concurrent = bool(w_ids and a_ids) and (
+                    len(w_ids | a_ids) > 1
+                    or any(m for (_, m) in set(wroots) & set(aroots)))
+                if not concurrent:
+                    continue
+                # report at the unguarded write (suppress/fix there);
+                # when the write IS guarded, the bare racing access is
+                # the defect site
+                site = w if not w.locks else a
+                if id(site.node) in flagged:
+                    continue
+                flagged.add(id(site.node))
+                other = a if site is w else w
+                held = ", ".join(sorted(map(dataflow.pretty_lock,
+                                            other.locks))) or "no lock"
+                findings.append(tree.finding(
+                    site.sf, site.node, RULE,
+                    f"'{klass}.{attr}' is written by "
+                    f"{n_writers} thread root(s) "
+                    f"({', '.join(sorted(writer_ids))}) but this "
+                    f"{'write' if site.write else 'read'} holds "
+                    f"{'no lock' if not site.locks else 'a disjoint lockset'}"
+                    f" while line {other.node.lineno} "
+                    f"({'write' if other.write else 'read'}) holds "
+                    f"{held} — guard every access with one lock, or "
+                    f"suppress with the reason the race is benign"))
+                break  # one finding per site is enough
+    return findings

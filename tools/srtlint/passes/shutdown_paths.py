@@ -7,6 +7,7 @@ import ast
 from typing import Dict, List, Optional, Set
 
 RULE = "shutdown-paths"
+PER_FILE = True   # findings depend only on each file itself (incremental cache unit)
 TITLE = ("threads started in server/, service/, and parallel/ are "
          "joined (with a timeout) on a close()/drain() exit edge")
 EXPLAIN = """

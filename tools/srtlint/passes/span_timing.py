@@ -7,6 +7,7 @@ import ast
 from typing import List
 
 RULE = "span-timing"
+PER_FILE = True   # findings depend only on each file itself (incremental cache unit)
 TITLE = "no raw clock reads in the exec-node layer (plan/, parallel/)"
 EXPLAIN = """
 The query trace (utils/tracing.py) is the engine's single attribution

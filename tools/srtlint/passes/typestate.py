@@ -1,0 +1,346 @@
+"""typestate: declared lifecycle state machines for the repo's resource
+handles — use-after-close, double-release, use-before-init."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .. import cfg
+
+RULE = "typestate"
+PER_FILE = True   # findings depend only on each file itself (incremental cache unit)
+TITLE = ("handles follow their declared lifecycle: no use-after-close, "
+         "double-release, or use/escape-before-init")
+EXPLAIN = """
+``release-paths`` proves a handle IS released; this pass proves nothing
+touches it afterwards (and nothing touches a two-phase object before
+its init ran).  Each tracked type declares a lifecycle machine in
+:data:`MACHINES` — acquisition (a constructor name or an acquiring
+method), ``release`` methods (CLOSED afterwards; ``idempotent`` marks
+close()s documented as repeat-safe), ``use`` methods invalid in CLOSED,
+and optionally ``init`` methods a NEW object needs before its ``use``
+surface is legal.  Declared machines:
+
+  * ``ResultStream`` (server/spool.py) — ctor→OPEN; ``close`` is the
+    consumer's idempotent teardown; ``put``/``finish``/``fail``/
+    ``frames`` after close is use-after-close;
+  * ``CachedBuildHandle`` (cache/device_cache.py, via
+    ``lookup_broadcast``/``insert_broadcast``) — ``close`` releases the
+    refcount exactly once: a second close on any path is a
+    double-release (the runtime guard makes it a no-op, but statically
+    it means two sites both think they own the reference);
+  * spill handles (``SpillCatalog.register`` → ``SpillableBatch``) —
+    ``get``/``spill_to_host``/``spill_to_disk`` after ``close`` raise
+    at runtime; ``close`` is single-shot;
+  * ``WireClient`` (server/client.py) — ``query``/``execute``/
+    ``prepare``/``cancel``/``status`` after ``close`` write a dead
+    socket; close is idempotent;
+  * ``QueryHandle`` (``submit(...)``) — ``cancel`` moves to CANCELLED;
+    ``result``/``status`` stay legal (the handle outlives the query);
+  * ``SqlFrontDoor`` (server/endpoint.py) — two-phase: ctor→NEW,
+    ``start``→OPEN; ``drain``/``begin_drain`` before start is
+    use-before-init.
+
+The checker is a forward abstract interpretation over each function:
+a tracked local's possible state set flows through suites, branches
+join by union, and a finding fires only when an operation is invalid
+in EVERY possible state (definite bug, not a maybe).  Ownership escape
+(return/yield/store/pass-on — ``release-paths``' machinery) ends
+tracking, except that escaping a handle whose state is definitely
+CLOSED is itself flagged: publishing a dead handle just moves the
+use-after-close to the new owner.
+
+Suppress with ``# srtlint: ignore[typestate] (<why this op is legal
+here>)``.
+"""
+
+NEW, OPEN, CLOSED = "NEW", "OPEN", "CLOSED"
+
+# The declaration format (docs/static_analysis.md "Typestate
+# declarations"): one entry per tracked type, keyed by how the handle
+# is ACQUIRED —
+#   kind: "ctor" (a constructor call by name) or "method" (an acquiring
+#         method call on any receiver, release-paths style)
+#   init: methods that move NEW→OPEN (absent: acquisition yields OPEN)
+#   release: methods that move →CLOSED
+#   idempotent_release: a repeat close is documented repeat-safe
+#   use: methods legal only in OPEN (and NEW when no init is declared)
+MACHINES: List[dict] = [
+    {"type": "ResultStream", "kind": "ctor", "name": "ResultStream",
+     "release": {"close"}, "idempotent_release": True,
+     "use": {"put", "finish", "fail", "frames", "fail_if_open"}},
+    {"type": "CachedBuildHandle", "kind": "method",
+     "name": {"lookup_broadcast", "insert_broadcast"},
+     "release": {"close"}, "idempotent_release": False,
+     "use": {"get"}},
+    {"type": "SpillableBatch", "kind": "method", "name": {"register"},
+     "recv_not": {"atexit", "weakref"},
+     "release": {"close"}, "idempotent_release": False,
+     "use": {"get", "spill_to_host", "spill_to_disk"}},
+    {"type": "WireClient", "kind": "ctor", "name": "WireClient",
+     "release": {"close"}, "idempotent_release": True,
+     "use": {"query", "execute", "prepare", "query_stream", "cancel",
+             "status"}},
+    {"type": "QueryHandle", "kind": "method", "name": {"submit"},
+     "recv_not": {"pool", "executor"},
+     "release": set(), "idempotent_release": True,
+     "use": set()},   # result/cancel/status legal for the handle's life
+    {"type": "SqlFrontDoor", "kind": "ctor", "name": "SqlFrontDoor",
+     "init": {"start"},
+     "release": {"close"}, "idempotent_release": True,
+     "use": {"drain", "begin_drain"}},
+]
+
+
+def _machine_for(sf, call: ast.Call) -> Optional[dict]:
+    func = call.func
+    if isinstance(func, ast.Name):
+        q = sf.qualname(func) or func.id
+        last = q.rsplit(".", 1)[-1]
+        for m in MACHINES:
+            if m["kind"] == "ctor" and m["name"] == last:
+                return m
+        return None
+    if isinstance(func, ast.Attribute):
+        recv = (sf.qualname(func.value) or "").split(".")[0].lower()
+        for m in MACHINES:
+            if m["kind"] == "method" and func.attr in m["name"]:
+                if any(w in recv for w in m.get("recv_not", ())):
+                    return None
+                return m
+        # aliased ctor through a module attribute (spool.ResultStream)
+        for m in MACHINES:
+            if m["kind"] == "ctor" and m["name"] == func.attr:
+                return m
+    return None
+
+
+class _Tracked:
+    __slots__ = ("machine", "states", "acquire_node", "escaped")
+
+    def __init__(self, machine: dict, acquire_node: ast.Call):
+        self.machine = machine
+        self.states: Set[str] = {NEW} if machine.get("init") else {OPEN}
+        self.acquire_node = acquire_node
+        self.escaped = False
+
+
+def _uses_name(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+class _FuncChecker:
+    def __init__(self, tree, sf, fn):
+        self.tree = tree
+        self.sf = sf
+        self.fn = fn
+        self.vars: Dict[str, _Tracked] = {}
+        self.findings: List = []
+
+    # -- entry ---------------------------------------------------------------------
+    def check(self) -> List:
+        self._suite(self.fn.body)
+        return self.findings
+
+    # -- abstract interpretation ----------------------------------------------------
+    def _suite(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _snapshot(self) -> Dict[str, FrozenSet[str]]:
+        return {v: frozenset(t.states) for v, t in self.vars.items()}
+
+    def _join(self, *snaps: Dict[str, FrozenSet[str]]) -> None:
+        for v, t in self.vars.items():
+            merged: Set[str] = set()
+            for s in snaps:
+                merged |= set(s.get(v, t.states))
+            t.states = merged
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Call):
+            m = _machine_for(self.sf, stmt.value)
+            self._expr(stmt.value, skip=stmt.value if m else None)
+            if m is not None:
+                self.vars[stmt.targets[0].id] = _Tracked(m, stmt.value)
+                return
+            # rebinding a tracked name to something else ends tracking
+            self.vars.pop(stmt.targets[0].id, None)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            if any(not isinstance(t, ast.Name) for t in stmt.targets):
+                # stored into an attribute/container: ownership escapes
+                self._escape_names(stmt.value, "stored")
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.vars.pop(t.id, None)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+                self._escape_names(stmt.value, "returned")
+            return
+        if isinstance(stmt, (ast.Expr, ast.AugAssign,
+                             ast.AnnAssign, ast.Raise, ast.Assert,
+                             ast.Delete)):
+            for v in ast.iter_child_nodes(stmt):
+                self._expr(v)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test)
+            pre = self._snapshot()
+            self._suite(stmt.body)
+            after_body = self._snapshot()
+            self._restore(pre)
+            self._suite(stmt.orelse)
+            self._join(after_body, self._snapshot())
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            if isinstance(stmt.target, ast.Name):
+                self.vars.pop(stmt.target.id, None)
+            pre = self._snapshot()
+            self._suite(stmt.body)          # body joined with 0-trip
+            self._join(pre, self._snapshot())
+            self._suite(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test)
+            pre = self._snapshot()
+            self._suite(stmt.body)
+            self._join(pre, self._snapshot())
+            self._suite(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            self._suite(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            pre = self._snapshot()
+            self._suite(stmt.body)
+            after_body = self._snapshot()
+            for handler in stmt.handlers:
+                # the handler may run from anywhere in the body: meet
+                # over pre- and post-body states
+                self._join(pre, after_body)
+                self._suite(handler.body)
+                after_body = self._snapshot()
+            self._suite(stmt.orelse)
+            self._suite(stmt.finalbody)
+            return
+        if isinstance(stmt, cfg.FuncNode) \
+                or isinstance(stmt, (ast.ClassDef, ast.Lambda)):
+            return  # nested scope: different lifetime, not tracked
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    # -- expressions ----------------------------------------------------------------
+    def _expr(self, node: Optional[ast.AST],
+              skip: Optional[ast.AST] = None) -> None:
+        if node is None or node is skip or isinstance(
+                node, (ast.Lambda,) + cfg.FuncNode):
+            return
+        if isinstance(node, ast.Call):
+            handled = self._call(node)
+            for child in ast.iter_child_nodes(node):
+                self._expr(child, skip)
+            if not handled:
+                self._escape_check(node)
+            return
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = getattr(node, "value", None)
+            if v is not None:
+                self._expr(v, skip)
+                self._escape_names(v, "returned/yielded")
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, skip)
+
+    def _call(self, call: ast.Call) -> bool:
+        """Transition tracked receivers; True when this call WAS a
+        tracked-method call (so args are not treated as an escape)."""
+        func = call.func
+        if not (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)):
+            return False
+        t = self.vars.get(func.value.id)
+        if t is None or t.escaped:
+            return False
+        m, name = t.machine, func.value.id
+        meth = func.attr
+        if meth in m["release"]:
+            if t.states == {CLOSED} and not m["idempotent_release"]:
+                self.findings.append(self.tree.finding(
+                    self.sf, call, RULE,
+                    f"double-release: '{name}' "
+                    f"({m['type']}) is already closed on every path "
+                    f"reaching this {meth}() — two sites both think "
+                    f"they own the reference"))
+            t.states = {CLOSED}
+            return True
+        if meth in m["use"]:
+            if t.states == {CLOSED}:
+                self.findings.append(self.tree.finding(
+                    self.sf, call, RULE,
+                    f"use-after-close: '{name}' ({m['type']}) is "
+                    f"closed on every path reaching this {meth}()"))
+            elif t.states == {NEW} and m.get("init"):
+                self.findings.append(self.tree.finding(
+                    self.sf, call, RULE,
+                    f"use-before-init: '{name}' ({m['type']}) has not "
+                    f"had {'/'.join(sorted(m['init']))}() called on "
+                    f"any path reaching this {meth}()"))
+            return True
+        if meth in m.get("init", ()):
+            t.states = {OPEN}
+            return True
+        return True  # other methods on the handle: not an escape
+
+    def _escape_check(self, call: ast.Call) -> None:
+        """A tracked handle passed to another call transfers ownership
+        — legal from OPEN/NEW, a smuggled corpse from CLOSED."""
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for v, t in list(self.vars.items()):
+                if t.escaped or not _uses_name(arg, v):
+                    continue
+                if t.states == {CLOSED}:
+                    self.findings.append(self.tree.finding(
+                        self.sf, call, RULE,
+                        f"'{v}' ({t.machine['type']}) escapes here but "
+                        f"is closed on every path — the new owner "
+                        f"inherits a use-after-close"))
+                t.escaped = True
+
+    def _escape_names(self, value: ast.AST, how: str) -> None:
+        for v, t in list(self.vars.items()):
+            if t.escaped or not _uses_name(value, v):
+                continue
+            if t.states == {CLOSED} and t.machine["release"]:
+                self.findings.append(self.tree.finding(
+                    self.sf, self.sf.statement_of(value), RULE,
+                    f"'{v}' ({t.machine['type']}) is {how} but closed "
+                    f"on every path — the receiver inherits a "
+                    f"use-after-close"))
+            t.escaped = True
+
+    def _restore(self, snap: Dict[str, FrozenSet[str]]) -> None:
+        for v, t in self.vars.items():
+            if v in snap:
+                t.states = set(snap[v])
+
+
+def run(tree) -> List:
+    findings: List = []
+    for sf in tree.package_files():
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, cfg.FuncNode):
+                continue
+            findings.extend(_FuncChecker(tree, sf, fn).check())
+    return findings
