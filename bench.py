@@ -72,6 +72,14 @@ control must hold goodput >= 0.85x capacity with every shed typed
 next to the soak line; SRT_OVERLOAD_DURATION_S caps the ramp,
 SRT_OVERLOAD_ADMISSION_OFF=1 runs the static-permit A/B,
 SRT_BENCH_QUERIES="" makes the run overload-only),
+SRT_BENCH_POISON=1 (blast-radius containment drill via
+tools/loadgen.py --poison: a seeded fingerprint-conditioned poison
+statement inside a healthy zipf mix must be QUARANTINED within two
+chargeable strikes with healthy goodput >= 0.9x the no-poison
+baseline, every shed typed, zero worker deaths after quarantine, zero
+leaks; emitted as a poison_containment JSON line beside the
+overload/soak lines; SRT_POISON_PHASE_S sets the per-phase duration,
+SRT_BENCH_QUERIES="" makes the run poison-only),
 SRT_BENCH_KILL_PEER=1 (killed-peer drill: a world=2 DcnShuffle over
 thread ranks commits on both sides, then rank 1 dies SILENTLY
 mid-reduce — the drill prints a dcn_killed_peer_recovery JSON line with
@@ -573,6 +581,15 @@ def main() -> None:
         print(json.dumps(_overload_drill()), flush=True)
         if os.environ.get("SRT_BENCH_QUERIES", None) == "":
             return  # overload-only invocation
+    if os.environ.get("SRT_BENCH_POISON", "0") == "1":
+        # blast-radius containment drill: a seeded poison statement in
+        # a healthy zipf mix must be quarantined within two strikes
+        # with healthy goodput held (tools/loadgen.py --poison) —
+        # emitted as a poison_containment JSON line beside the
+        # overload/soak lines
+        print(json.dumps(_poison_drill()), flush=True)
+        if os.environ.get("SRT_BENCH_QUERIES", None) == "":
+            return  # poison-only invocation
     if os.environ.get("SRT_BENCH_LOADGEN", "0") == "1":
         # serving-traffic proxy: drive the sustained-load harness
         # (tools/loadgen.py — wire queries over TCP through the network
@@ -642,6 +659,7 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         env.pop("SRT_BENCH_LOADGEN", None)    # ditto the loadgen drill
         env.pop("SRT_BENCH_SOAK", None)       # ditto the soak drill
         env.pop("SRT_BENCH_OVERLOAD", None)   # ditto the overload drill
+        env.pop("SRT_BENCH_POISON", None)     # ditto the poison drill
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
@@ -691,6 +709,36 @@ def _soak_drill() -> dict:
     try:
         rep = _lg.run_soak(args)
         rep["metric"] = "soak_rolling_restart"
+        return rep
+    finally:
+        import spark_rapids_tpu as _srt
+        _srt.Session.reset()
+
+
+def _poison_drill() -> dict:
+    """SRT_BENCH_POISON=1: the blast-radius containment drill via
+    tools/loadgen.py --poison — a seeded poison statement inside a
+    healthy zipf mix; emitted as a ``poison_containment`` JSON line
+    (strikes-to-quarantine, healthy goodput ratio, post-quarantine
+    worker deaths, typed QUARANTINED shed counts, diagnosis-bundle id,
+    leaks) beside the overload/soak lines so the trajectory file
+    tracks containment behavior."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import argparse
+
+    import loadgen as _lg
+    args = argparse.Namespace(
+        connections=6, tenants=8, rows=60_000, prepared_frac=0.5,
+        seed=int(os.environ.get("SRT_LOADGEN_SEED", "42")),
+        tenant_quotas="*=16", timeout=600.0, no_verify=False,
+        poison=True,
+        poison_phase_s=min(60.0, float(
+            os.environ.get("SRT_POISON_PHASE_S", "10"))),
+        poison_goodput_min=0.9)
+    try:
+        rep = _lg.run_poison(args)
+        rep["metric"] = "poison_containment"
         return rep
     finally:
         import spark_rapids_tpu as _srt

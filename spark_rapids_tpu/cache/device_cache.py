@@ -42,7 +42,26 @@ from .keys import CacheKey, path_covers
 
 __all__ = ["QueryCache", "CacheEntry", "CachedBuildHandle",
            "get_query_cache", "clear_query_cache", "invalidate_path",
-           "batch_bytes"]
+           "batch_bytes", "set_serve_only", "serve_only"]
+
+# Brownout serve-only mode (service/admission.BrownoutController):
+# while set, the cache SERVES hits but adopts no new fills — during a
+# degraded-capacity episode recovery traffic must not evict the
+# survivors' hot working set from HBM.  A one-way-per-episode flag
+# toggled on brownout enter/exit; fills skipped while set are counted
+# (``fills_paused`` in the snapshot).
+_SERVE_ONLY = threading.Event()
+
+
+def set_serve_only(flag: bool) -> None:
+    if flag:
+        _SERVE_ONLY.set()
+    else:
+        _SERVE_ONLY.clear()
+
+
+def serve_only() -> bool:
+    return _SERVE_ONLY.is_set()
 
 # spill priority of cached batches: BELOW every live-query registration
 # (memory/spill.py priority classes), so SpillCatalog.ensure_budget
@@ -158,6 +177,7 @@ class QueryCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.fills_paused = 0  # fills skipped while brownout serve-only
 
     # -- configuration ------------------------------------------------------------
     def configure(self, max_bytes: int, ttl_ms: int) -> None:
@@ -228,6 +248,12 @@ class QueryCache:
         self.misses += 1
         QueryStats.get().cache_misses += 1
         tracing.mark(op_id, "cache:miss", "cache", tier=tier)
+
+    def _note_fill_paused(self, op_id, tier: str) -> None:
+        with self._lock:
+            self.fills_paused += 1
+        tracing.mark(op_id, "cache:fill-paused", "cache", tier=tier,
+                     reason="brownout")
 
     def _check_faults(self, op_id, tier: str) -> bool:
         """``cache.lookup`` injection point.  A transient fault in the
@@ -332,6 +358,9 @@ class QueryCache:
         None) when the value alone exceeds the budget."""
         from ..faults.recovery import TransientFault
         from ..memory.spill import get_catalog
+        if _SERVE_ONLY.is_set():
+            self._note_fill_paused(op_id, "scan")
+            return None
         nbytes = sum(batch_bytes(b) for b in batches)
         if nbytes > self.max_bytes or not batches:
             return None
@@ -411,6 +440,9 @@ class QueryCache:
         the query owns it exactly as before the cache existed."""
         from ..faults.injector import INJECTOR
         from ..faults.recovery import TransientFault
+        if _SERVE_ONLY.is_set():
+            self._note_fill_paused(op_id, "broadcast")
+            return handle
         nbytes = getattr(handle, "device_bytes", 0)
         if nbytes > self.max_bytes:
             return handle
@@ -491,6 +523,8 @@ class QueryCache:
             return {"entries": len(self._entries), "bytes": self._bytes,
                     "hits": self.hits, "misses": self.misses,
                     "evictions": self.evictions,
+                    "fills_paused": self.fills_paused,
+                    "serve_only": _SERVE_ONLY.is_set(),
                     "max_bytes": self.max_bytes}
 
 

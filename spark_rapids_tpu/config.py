@@ -260,6 +260,35 @@ ADMISSION_AIMD_LATENCY_TARGET_MS = register(
     "latency criterion (the spill-degrade criterion always applies).",
     conv=float, check=lambda v: None if v >= 0 else "must be >= 0")
 
+BROWNOUT_ENABLED = register(
+    "spark.rapids.tpu.sql.scheduler.brownout.enabled", True,
+    "Brownout serving: when ALIVE cluster capacity (membership epoch "
+    "events from parallel/dcn.py, or an explicit "
+    "scheduler.on_membership call) falls below "
+    "scheduler.brownout.enterFraction of the world, the scheduler "
+    "enters a typed degraded mode — effective concurrency and tenant "
+    "quotas scale to the surviving fraction, submissions below "
+    "scheduler.brownout.shedBelowPriority shed typed (reason "
+    "'brownout' + retry_after), and device-cache fills pause "
+    "(serve-only) to preserve HBM headroom. Entered/exited with trace "
+    "marks and snapshot visibility.")
+
+BROWNOUT_ENTER_FRACTION = register(
+    "spark.rapids.tpu.sql.scheduler.brownout.enterFraction", 0.75,
+    "Alive-capacity fraction below which the scheduler enters "
+    "brownout (and at-or-above which it exits): alive_ranks / "
+    "world_size from the last membership event.",
+    conv=float,
+    check=lambda v: None if 0.0 < v <= 1.0 else "must be in (0, 1]")
+
+BROWNOUT_SHED_BELOW_PRIORITY = register(
+    "spark.rapids.tpu.sql.scheduler.brownout.shedBelowPriority", 0,
+    "During brownout, submissions with priority strictly below this "
+    "value shed immediately with the typed reason 'brownout' and a "
+    "retry_after hint — surviving capacity serves the work that "
+    "matters. The default (0, with defaultPriority 0) sheds only "
+    "work explicitly submitted as low-priority.")
+
 SERVER_RETRY_AFTER_MIN_MS = register(
     "spark.rapids.tpu.server.retryAfter.minMs", 50.0,
     "Floor on the server-computed retry_after_ms hint carried by "
@@ -745,6 +774,16 @@ FAULTS_INJECT_SEED = register(
     "Seed for the injection RNG (probabilistic rate draws AND the "
     "retry backoff jitter), making chaos runs reproducible.")
 
+FAULTS_INJECT_FINGERPRINT = register(
+    "spark.rapids.tpu.faults.inject.fingerprint", "",
+    "Statement fingerprint (cache/keys.statement_fingerprint) that "
+    "SCOPES injection: when set, schedule and rate injection fire — "
+    "and deterministic invocation counters advance — only inside "
+    "queries carrying this fingerprint, so a poison-query scenario "
+    "(tools/loadgen.py --poison, the containment tests) targets one "
+    "statement in a mixed workload without touching healthy queries. "
+    "Empty = inject everywhere (the pre-existing behavior).")
+
 FAULTS_INTEGRITY_ENABLED = register(
     "spark.rapids.tpu.faults.integrity.enabled", True,
     "Verify the checksum stamped on every durable byte path — spill "
@@ -815,6 +854,64 @@ FAULTS_RESUBMIT_MAX = register(
     "permanent failure).",
     check=lambda v: None if v >= 0 else "must be >= 0")
 
+FAULTS_BREAKER_ENABLED = register(
+    "spark.rapids.tpu.faults.breaker.enabled", True,
+    "Per-fingerprint circuit breakers (service/breaker.py): CHARGEABLE "
+    "completion outcomes (watchdog stall/force-reclaim, device-guard "
+    "exhaustion, OOM past spill) trip a statement fingerprint's breaker "
+    "after faults.breaker.strikes strikes; an open breaker sheds that "
+    "statement at admission with the typed wire code QUARANTINED + "
+    "retry_after, blocks further resubmission, and half-opens into one "
+    "sandboxed canary after faults.breaker.openMs. VICTIM outcomes "
+    "(peer loss, coordinator failover, drain, integrity re-pull) never "
+    "count. Disabling restores the contain-nothing behavior (every "
+    "poison attempt re-runs at full cost).")
+
+FAULTS_BREAKER_STRIKES = register(
+    "spark.rapids.tpu.faults.breaker.strikes", 2,
+    "Chargeable strikes before a statement fingerprint's breaker opens "
+    "(the two-strike culprit rule: a poison query stops being "
+    "resubmitted after it kills its second worker). A successful run "
+    "resets the count — poison is deterministic failure, not a bad "
+    "day.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+FAULTS_BREAKER_OPEN_MS = register(
+    "spark.rapids.tpu.faults.breaker.openMs", 10000.0,
+    "Quarantine window after a breaker opens: admissions of the "
+    "fingerprint shed typed (QUARANTINED, retry_after = the remaining "
+    "window) until it elapses, then ONE canary runs under the sandbox "
+    "profile. Each re-trip doubles the window up to "
+    "faults.breaker.openMaxMs.")
+
+FAULTS_BREAKER_OPEN_MAX_MS = register(
+    "spark.rapids.tpu.faults.breaker.openMaxMs", 300000.0,
+    "Cap on the doubling quarantine window of a repeatedly re-tripped "
+    "breaker (a statement that fails its canary every time stays "
+    "quarantined, re-probed at most this often).")
+
+FAULTS_BREAKER_CANARY_DEADLINE_MS = register(
+    "spark.rapids.tpu.faults.breaker.canary.deadlineMs", 10000.0,
+    "Tightened deadline for the half-open canary run (the sandbox "
+    "profile also forces pipeline depth 0 and allows cpu/ "
+    "degradation): the probe must prove health cheaply, not burn "
+    "another full watchdog window. 0 = the canary keeps the "
+    "caller's deadline.")
+
+FAULTS_BREAKER_BUNDLE_DIR = register(
+    "spark.rapids.tpu.faults.breaker.bundle.dir", "",
+    "Directory for quarantine diagnosis bundles (breaker state, typed "
+    "fault lineage, the finished trace with watchdog stall stacks, the "
+    "wire spec, conf overrides — rendered by tools/diagnose.py). "
+    "Empty = <memory.spill.dir>/diagnosis.")
+
+FAULTS_BREAKER_BUNDLE_MAX = register(
+    "spark.rapids.tpu.faults.breaker.bundle.max", 16,
+    "Bounded retention for diagnosis bundles: beyond this many bundle "
+    "directories the oldest are deleted (a crash-looping statement "
+    "must not fill the disk with postmortems).",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
 DCN_EPOCH_FENCING = register(
     "spark.rapids.tpu.dcn.epoch.fencing", True,
     "Fence DCN control frames and peer fetches with the cluster epoch: "
@@ -853,6 +950,33 @@ DCN_KILL_MODE = register(
     "entry armed in faults.inject.schedule.",
     check=lambda v: None if v in ("silent", "hard")
     else "must be 'silent' or 'hard'")
+
+DCN_FLAP_THRESHOLD = register(
+    "spark.rapids.tpu.dcn.flap.threshold", 3,
+    "Re-registrations of one rank within dcn.flap.windowS before the "
+    "coordinator starts DAMPING it: further rejoin attempts get a "
+    "typed deferral reply (deferred=true + retry_after_ms on an "
+    "exponential curve) instead of an epoch bump, so a crash-looping "
+    "host cannot drag the fleet through an epoch-churn/orphan-adoption "
+    "storm per lap. 0 disables damping.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+DCN_FLAP_WINDOW_S = register(
+    "spark.rapids.tpu.dcn.flap.windowS", 60.0,
+    "Rolling window for the flap counter: a rank whose last "
+    "re-registration is older than this rejoins with a clean history "
+    "(an occasional planned restart is not a flap).")
+
+DCN_FLAP_BASE_MS = register(
+    "spark.rapids.tpu.dcn.flap.baseMs", 1000.0,
+    "First rejoin-deferral delay once a rank crosses "
+    "dcn.flap.threshold; each further flap doubles it up to "
+    "dcn.flap.maxMs. The deferral state rides the membership journal, "
+    "so damping survives a coordinator failover.")
+
+DCN_FLAP_MAX_MS = register(
+    "spark.rapids.tpu.dcn.flap.maxMs", 60000.0,
+    "Cap on the exponential rejoin-deferral delay of a flapping rank.")
 
 
 SERVER_HOST = register(

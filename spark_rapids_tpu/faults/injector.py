@@ -122,6 +122,12 @@ class FaultInjector:
         self._rng = random.Random(0)
         self._armed_args = None  # last arm() arguments (see arm())
         self._counts: Dict[str, int] = {}
+        # fingerprint conditioning (faults.inject.fingerprint): when
+        # set, injection fires — and deterministic counters advance —
+        # only inside queries whose control carries this statement
+        # fingerprint, so a poison scenario targets ONE statement in a
+        # mixed workload without touching healthy queries
+        self._fingerprint = ""
         # cumulative per-point injections: survives re-arming (chaos
         # suites assert coverage across several queries), reset only by
         # reset_totals()
@@ -129,7 +135,8 @@ class FaultInjector:
 
     # -- arming -------------------------------------------------------------------
     def arm(self, schedule: str = "", rate: float = 0.0,
-            points: str = "", seed: int = 0) -> None:
+            points: str = "", seed: int = 0,
+            fingerprint: str = "") -> None:
         sched = _parse_schedule(schedule)
         sel = tuple(p.strip() for p in points.split(",") if p.strip()) \
             if points else POINTS
@@ -137,11 +144,12 @@ class FaultInjector:
             if p not in POINTS:
                 raise ValueError(
                     f"unknown injection point {p!r}; registered: {POINTS}")
-        args = (schedule, float(rate), sel, seed)
+        args = (schedule, float(rate), sel, seed, fingerprint)
         with self._lock:
             self._sched = sched
             self._rate = max(0.0, float(rate))
             self._rate_points = sel
+            self._fingerprint = fingerprint or ""
             # Re-arming with IDENTICAL arguments (every ExecContext of a
             # chaos run re-arms from the same confs) preserves the RNG
             # stream: rate mode stays a true seeded rate across queries.
@@ -159,7 +167,9 @@ class FaultInjector:
             schedule=conf["spark.rapids.tpu.faults.inject.schedule"],
             rate=conf["spark.rapids.tpu.faults.inject.rate"],
             points=conf["spark.rapids.tpu.faults.inject.points"],
-            seed=conf["spark.rapids.tpu.faults.inject.seed"])
+            seed=conf["spark.rapids.tpu.faults.inject.seed"],
+            fingerprint=conf[
+                "spark.rapids.tpu.faults.inject.fingerprint"])
 
     # -- state --------------------------------------------------------------------
     def armed(self) -> bool:
@@ -183,14 +193,31 @@ class FaultInjector:
             return 0.5 + 0.5 * self._rng.random()
 
     # -- the injection check --------------------------------------------------------
+    @staticmethod
+    def _current_fingerprint() -> str:
+        """The RUNNING query's statement fingerprint (set by the
+        scheduler on its control), '' when none/unknown."""
+        from ..service import cancel
+        ctl = cancel.current()
+        return getattr(ctl, "fingerprint", None) or "" \
+            if ctl is not None else ""
+
     def _select(self, point: str) -> int:
         """Count one invocation at ``point``; return the (1-based)
         invocation number when the schedule or chaos rate selects it,
         else 0.  Accounting (stats + trace mark) is the caller's —
-        through :meth:`maybe_raise` or :meth:`maybe_fire`."""
+        through :meth:`maybe_raise` or :meth:`maybe_fire`.
+
+        With fingerprint conditioning armed, invocations from OTHER
+        queries neither count nor fire: "the Nth op at P" means the
+        Nth op of the targeted statement."""
         with self._lock:
             if not self._sched and self._rate <= 0.0:
                 return 0
+            fp = self._fingerprint
+        if fp and self._current_fingerprint() != fp:
+            return 0
+        with self._lock:
             n = self._counts.get(point, 0) + 1
             self._counts[point] = n
             fire = any(first <= n < first + count
@@ -236,6 +263,7 @@ class FaultInjector:
         with self._lock:
             return {"schedule": {p: list(v) for p, v in self._sched.items()},
                     "rate": self._rate,
+                    "fingerprint": self._fingerprint,
                     "counts": dict(self._counts),
                     "injected_total": dict(self.injected_total)}
 

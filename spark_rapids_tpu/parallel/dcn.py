@@ -86,7 +86,9 @@ from ..faults.recovery import PermanentFault, TransientFault, \
 
 __all__ = ["Coordinator", "ProcessGroup", "DcnShuffle", "PeerFailedError",
            "PeerLostError", "CoordinatorLostError",
-           "CoordinatorUnrecoverableError", "host_partition_ids",
+           "CoordinatorUnrecoverableError", "RejoinDeferredError",
+           "add_membership_listener", "remove_membership_listener",
+           "host_partition_ids",
            "run_distributed_agg", "run_distributed_query"]
 
 _LEN = struct.Struct("<II")  # json length, binary payload length
@@ -122,6 +124,58 @@ class CoordinatorLostError(TransientFault):
     request's bounded re-dial window expired — the retry vocabulary
     applies.  When NO successor can exist, the permanent subclass
     :class:`CoordinatorUnrecoverableError` is raised instead."""
+
+
+class RejoinDeferredError(PeerFailedError):
+    """The coordinator DAMPED this rank's re-registration: it has
+    died and rejoined too often within ``dcn.flap.windowS`` (membership
+    flap damping — each lap of a crash-looping host otherwise drags the
+    fleet through an epoch-bump/orphan-adoption storm).  Carries the
+    coordinator's ``retry_after_ms``: re-register after the deferral
+    window (the delay grows exponentially per flap, riding
+    ``dcn.flap.{baseMs,maxMs}``).  Still a
+    :class:`..faults.recovery.TransientFault` — a deferred rank is
+    delayed, not dead."""
+
+    def __init__(self, message: str, retry_after_ms: int = 0):
+        super().__init__(message)
+        self.retry_after_ms = int(retry_after_ms)
+
+
+# ---------------------------------------------------------------------------------
+# Membership listeners: epoch events fan out to subscribers (the query
+# scheduler's brownout controller enters/exits degraded-capacity serving
+# on these — service/admission.BrownoutController).
+# ---------------------------------------------------------------------------------
+
+_MEMBERSHIP_LISTENERS: List = []
+_LISTENERS_LOCK = threading.Lock()
+
+
+def add_membership_listener(fn) -> None:
+    """Subscribe ``fn(alive, world, epoch)`` to membership epoch events
+    observed by any ProcessGroup in this process."""
+    with _LISTENERS_LOCK:
+        if fn not in _MEMBERSHIP_LISTENERS:
+            _MEMBERSHIP_LISTENERS.append(fn)
+
+
+def remove_membership_listener(fn) -> None:
+    with _LISTENERS_LOCK:
+        try:
+            _MEMBERSHIP_LISTENERS.remove(fn)
+        except ValueError:
+            pass
+
+
+def _notify_membership(alive: int, world: int, epoch: int) -> None:
+    with _LISTENERS_LOCK:
+        listeners = list(_MEMBERSHIP_LISTENERS)
+    for fn in listeners:
+        try:
+            fn(alive, world, epoch)
+        except Exception:  # fault-ok (a listener bug must never break membership absorption)
+            pass
 
 
 class CoordinatorUnrecoverableError(CoordinatorLostError, PermanentFault):
@@ -246,6 +300,21 @@ class Coordinator:
         self._declared: Dict[int, int] = {}
         self._inc: Dict[int, int] = {}
         self._meta: Dict[str, dict] = {}
+        # membership flap damping (dcn.flap.*): per-rank re-register
+        # count within the rolling window, last re-register time, and
+        # the deferral deadline a flapping rank must serve before its
+        # next rejoin is admitted.  Journaled (re-based on restore) so
+        # damping survives a coordinator failover.
+        self._flap_threshold = int(conf["spark.rapids.tpu.dcn.flap"
+                                        ".threshold"])
+        self._flap_window_s = conf["spark.rapids.tpu.dcn.flap"
+                                   ".windowS"]
+        self._flap_base_ms = conf["spark.rapids.tpu.dcn.flap.baseMs"]
+        self._flap_max_ms = conf["spark.rapids.tpu.dcn.flap.maxMs"]
+        self._flap_count: Dict[int, int] = {}
+        self._flap_last: Dict[int, float] = {}
+        self._flap_until: Dict[int, float] = {}
+        self.rejoins_deferred = 0
         # the membership journal: bounded buffer of completed-collective
         # records (tag -> replayable reply) plus a version/pushed pair
         # driving the write-ahead replication to the standby
@@ -414,6 +483,75 @@ class Coordinator:
         self._cv.notify_all()  # wake the journal pusher
         return rec
 
+    def _flap_check_locked(self, rank: int) -> Optional[dict]:
+        """Membership flap damping: decide whether this RE-registration
+        is admitted or deferred.  Returns the typed deferral reply
+        (``deferred`` + ``retry_after_ms`` on the exponential curve),
+        or None to admit.
+
+        The first ``dcn.flap.threshold`` re-registers within the
+        rolling window are free (planned restarts are not flaps); past
+        the threshold each rejoin must serve an exponentially growing
+        deferral first — during it the coordinator does ZERO epoch
+        bumps for the rank, capping the churn a crash-looping host can
+        inflict per unit time."""
+        if self._flap_threshold <= 0:
+            return None
+        now = time.monotonic()  # span-api-ok (liveness window, not timing)
+        last = self._flap_last.get(rank)
+        if last is not None and now - last > self._flap_window_s:
+            # stable past the window: history expires, rejoin clean
+            self._flap_count.pop(rank, None)
+            self._flap_until.pop(rank, None)
+        self._flap_last[rank] = now
+        until = self._flap_until.get(rank, 0.0)
+        if until:
+            if now < until:
+                # still parked: same typed deferral, remaining delay —
+                # and still no epoch bump
+                self.rejoins_deferred += 1
+                return {"error": f"rank {rank} rejoin deferred "
+                                 f"(flapping): retry after the "
+                                 f"deferral window",
+                        "deferred": True,
+                        "retry_after_ms": int((until - now) * 1e3) + 1,
+                        "flaps": self._flap_count.get(rank, 0),
+                        "epoch": self._epoch}
+            # penalty served: this rejoin is admitted
+            self._flap_until.pop(rank, None)
+            self._flap_count[rank] = self._flap_count.get(rank, 0) + 1
+            return None
+        count = self._flap_count.get(rank, 0) + 1
+        self._flap_count[rank] = count
+        if count <= self._flap_threshold:
+            return None
+        lap = count - self._flap_threshold
+        delay_ms = min(self._flap_max_ms,
+                       self._flap_base_ms * (2.0 ** min(32, lap - 1)))
+        self._flap_until[rank] = now + delay_ms / 1e3
+        self._version += 1  # damping state rides the journal
+        self.rejoins_deferred += 1
+        self._cv.notify_all()  # wake the journal pusher
+        return {"error": f"rank {rank} rejoin deferred: {count} "
+                         f"re-registrations within "
+                         f"{self._flap_window_s:g}s (threshold "
+                         f"{self._flap_threshold}); retry after the "
+                         f"deferral window",
+                "deferred": True,
+                "retry_after_ms": int(delay_ms),
+                "flaps": count,
+                "epoch": self._epoch}
+
+    def flap_snapshot(self) -> Dict[str, object]:
+        """Damping state for introspection/tests."""
+        with self._cv:
+            now = time.monotonic()  # span-api-ok (liveness window, not timing)
+            return {"counts": dict(self._flap_count),
+                    "deferred_remaining_s": {
+                        r: round(max(0.0, u - now), 3)
+                        for r, u in self._flap_until.items()},
+                    "rejoins_deferred": self.rejoins_deferred}
+
     def _standby_locked(self) -> Optional[int]:
         """The journal's destination AND the deterministic successor:
         the next-lowest alive rank that is not hosting this
@@ -423,6 +561,18 @@ class Coordinator:
         return alive[0] if alive else None
 
     def _journal_locked(self) -> dict:
+        # flap-damping state ships RELATIVE (remaining deferral, age of
+        # the last flap): monotonic clocks differ across hosts, so the
+        # successor re-bases onto its own clock at restore
+        now = time.monotonic()  # span-api-ok (liveness window, not timing)
+        flaps = {str(r): {"count": c,
+                          "age_s": round(max(0.0, now
+                                         - self._flap_last.get(r, now)),
+                                         3),
+                          "deferred_s": round(max(
+                              0.0, self._flap_until.get(r, 0.0) - now)
+                              if self._flap_until.get(r) else 0.0, 3)}
+                 for r, c in self._flap_count.items()}
         return {
             "epoch": self._epoch,
             "declared": {str(r): e for r, e in self._declared.items()},
@@ -430,6 +580,7 @@ class Coordinator:
             "peers": {str(r): list(hp) for r, hp in self._peers.items()},
             "completed": [self._completed[t] for t in self._completed_order
                           if t in self._completed],
+            "flaps": flaps,
             "coord_rank": self.rank,
             "heartbeat_timeout": self.heartbeat_timeout,
             "wait_timeout": self.wait_timeout,
@@ -537,6 +688,17 @@ class Coordinator:
                 self.heartbeat_timeout = float(j["heartbeat_timeout"])
             if j.get("wait_timeout") is not None:
                 self.wait_timeout = float(j["wait_timeout"])
+            # flap damping survives the failover: counts come back and
+            # a rank mid-deferral stays deferred for its REMAINING
+            # window, re-based onto this host's monotonic clock
+            now = time.monotonic()  # span-api-ok (liveness window, not timing)
+            for r, d in (j.get("flaps") or {}).items():
+                r = int(r)
+                self._flap_count[r] = int(d.get("count", 0))
+                self._flap_last[r] = now - float(d.get("age_s", 0.0))
+                rem = float(d.get("deferred_s", 0.0))
+                if rem > 0:
+                    self._flap_until[r] = now + rem
             for r in presume_dead:
                 if r not in self._declared:
                     self._epoch += 1
@@ -584,6 +746,12 @@ class Coordinator:
             self._declare_locked()
             if op == "register":
                 if rank in self._declared or rank in self._peers:
+                    # flap damping FIRST: a crash-looping rank gets a
+                    # typed deferral (no epoch bump, no peer-map
+                    # change) instead of another lap of churn
+                    deferred = self._flap_check_locked(rank)
+                    if deferred is not None:
+                        return deferred, b""
                     # a restarted rank rejoins under a FRESH identity:
                     # new incarnation + epoch bump, so frames from its
                     # previous life are rejected as stale instead of
@@ -971,6 +1139,19 @@ class ProcessGroup:
             "host": advertise_host or listen_host,
             "port": self._server.port})
         if "error" in msg:
+            # a refused register must not leak the peer server and the
+            # two control sockets this constructor already opened
+            self._server.close()
+            _shutdown_close(self._ctrl)
+            _shutdown_close(self._hb_sock)
+            if msg.get("deferred"):
+                # membership flap damping: this rank rejoined too often
+                # — typed, with the coordinator's exponential
+                # retry_after so the restart loop backs off instead of
+                # hammering another lap of epoch churn
+                raise RejoinDeferredError(
+                    f"register deferred: {msg['error']}",
+                    retry_after_ms=int(msg.get("retry_after_ms", 0)))
             raise PeerFailedError(f"register failed: {msg['error']}")
         self.inc = int(msg.get("inc", 0))
         self.peers: Dict[int, Tuple[str, int]] = {
@@ -1000,14 +1181,20 @@ class ProcessGroup:
     def _absorb_membership(self, msg: dict) -> None:
         """Fold a coordinator reply's membership view into this rank's:
         the epoch is monotonic, and declared-dead ranks stay dead until
-        a re-register bumps the epoch past our view."""
+        a re-register bumps the epoch past our view.  An epoch ADVANCE
+        is a membership event: subscribers (the scheduler's brownout
+        controller) learn the new alive/world shape."""
         e = int(msg.get("epoch", 0))
-        if e > self.epoch:
+        advanced = e > self.epoch
+        if advanced:
             self.epoch = e  # srtlint: ignore[shared-state-races] (monotonic absorb: a racy interleave can only transiently regress the epoch, and every stale frame is fenced server-side into a resync that re-absorbs)
             self._server.epoch = e
         if "dead" in msg:
             self._dead = sorted(set(self._dead)  # srtlint: ignore[shared-state-races] (advisory merge: a lost union re-converges on the next heartbeat/membership reply, and fetches to a missed-dead peer fail typed into the durable re-pull anyway)
                                 | {int(r) for r in msg["dead"]})
+        if advanced:
+            _notify_membership(self.world_size - len(self._dead),
+                               self.world_size, e)
 
     def _request(self, obj: dict, blob: bytes = b"",
                  _retried: bool = False) -> Tuple[dict, bytes]:

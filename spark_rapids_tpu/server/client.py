@@ -40,6 +40,13 @@ _GOAWAY_RETRIES = 3
 # caps a single call's patience
 _OVERLOAD_RETRIES = 4
 
+# typed sheds the client may retry after the server's retry_after hint,
+# gated by the token budget.  QUARANTINED belongs here deliberately:
+# its retry_after is the remaining quarantine window, so an honoring
+# client's retry lands exactly when the breaker half-opens — retrying
+# sooner is the poison-statement storm the breaker exists to stop.
+_RETRYABLE_SHEDS = ("REJECTED", "QUOTA_EXCEEDED", "QUARANTINED")
+
 # fallback backoff when a shed carries no server hint (older doors)
 _BACKOFF_BASE_S = 0.025
 _BACKOFF_MAX_S = 2.0
@@ -262,7 +269,7 @@ class WireClient:
                     # caller holds keeps working)
                     self.prepare(spec)
             except WireError as e:
-                if e.code in ("REJECTED", "QUOTA_EXCEEDED"):
+                if e.code in _RETRYABLE_SHEDS:
                     if overloads < _OVERLOAD_RETRIES \
                             and self._shed_pause(e, overloads):
                         overloads += 1
@@ -301,7 +308,7 @@ class WireClient:
                         reason="draining")
                 self._failover(e)
             except WireError as e:
-                if e.code in ("REJECTED", "QUOTA_EXCEEDED") \
+                if e.code in _RETRYABLE_SHEDS \
                         and overloads < _OVERLOAD_RETRIES \
                         and self._shed_pause(e, overloads):
                     overloads += 1

@@ -475,9 +475,11 @@ class SqlFrontDoor:
                               self._spool_dir(conf))
 
         # typed QUOTA_EXCEEDED, carrying the scheduler's drain-rate
-        # retry hint so capped tenants back off instead of hammering
-        self.quotas.acquire(csess.tenant,
-                            retry_after_ms=self._retry_hint(conf))
+        # retry hint so capped tenants back off instead of hammering;
+        # during a brownout every cap scales to surviving capacity
+        self.quotas.acquire(
+            csess.tenant, retry_after_ms=self._retry_hint(conf),
+            scale=self._session.scheduler().brownout.quota_scale())
         # one finally covers every exit edge from here on: a failed
         # submit, a client drop mid-stream, and the ordinary end all
         # release the quota slot and close the stream exactly once
@@ -586,14 +588,20 @@ class SqlFrontDoor:
                 tenant=csess.tenant, weight=csess.weight, label=label,
                 fingerprint=fingerprint)
         except QueryRejected as e:
-            # the shed taxonomy + retry hint cross the wire intact
-            raise WireError("REJECTED", str(e), detail=e.reason,
-                            retry_after_ms=e.retry_after_ms,
-                            reason=e.reason)
+            # the shed taxonomy + retry hint cross the wire intact; a
+            # quarantine shed gets its OWN code (the client must learn
+            # the STATEMENT is the problem, not the service) with the
+            # diagnosis-bundle id riding info
+            raise _rejected_wire_error(e)
         handle._entry.control.server_attrs = {
             "connection": csess.session_id, "peer": csess.peer,
             "wire_query": query_id,
-            "prepared": bool(req.get("statement_id"))}
+            "prepared": bool(req.get("statement_id")),
+            # the statement itself (spec, or the prepared id whose spec
+            # the cache holds): a quarantine diagnosis bundle carries
+            # it so the operator can replay the plan offline
+            "statement_id": req.get("statement_id") or "",
+            "spec": req.get("spec")}
         # a query shed before its worker ever runs (drain/close) would
         # otherwise leave the connection thread polling a stream nobody
         # finishes: resolve-with-exception fails the stream too
@@ -653,32 +661,60 @@ class SqlFrontDoor:
             if isinstance(e, (ConnectionError, socket.timeout, OSError,
                               P.ProtocolError)):
                 raise
-            from ..service.cancel import QueryDrained
+            from ..service.cancel import QueryDrained, QueryStalled
             from ..service.scheduler import QueryRejected
             if isinstance(e, QueryRejected):
                 # shed AFTER submission (doomed-in-queue / drain
-                # eviction): the typed reason + retry hint reach the
-                # client exactly like a submit-time shed
-                self._try_error(conn, WireError(
-                    "REJECTED", str(e), detail=e.reason,
-                    retry_after_ms=e.retry_after_ms, reason=e.reason))
+                # eviction / quarantine): the typed reason + retry hint
+                # reach the client exactly like a submit-time shed
+                self._try_error(conn, _rejected_wire_error(e))
                 return
+            info = {}
             if isinstance(e, QueryFaulted):
                 code = ("DRAINING" if getattr(e, "point", "") == "drain"
                         else "FAULTED")
                 detail = getattr(e, "point", "") or ""
+                # the WHY payload: typed fault class, attempt/resubmit
+                # lineage, and the diagnosis-bundle id when quarantine
+                # wrote one — clients assert on cause, not just effect
+                info = {
+                    "fault_class": type(e).__name__,
+                    "point": detail,
+                    "resubmittable": bool(getattr(e, "resubmittable",
+                                                  False)),
+                    "fault_records": len(getattr(e, "history", []) or []),
+                    "resubmits": wq.handle.resubmits,
+                    "lineage": [a.get("label")
+                                for a in wq.handle.attempts],
+                }
+                bundle = getattr(e, "diagnosis_bundle", None)
+                if bundle:
+                    info["bundle_id"] = bundle
             elif isinstance(e, QueryDrained):
                 # drained mid-stream: typed so the client re-routes the
                 # SAME query to a sibling instead of treating it as a
                 # user cancel
                 code, detail = "DRAINING", "resubmit against a sibling"
+            elif isinstance(e, QueryStalled):
+                # the watchdog's cooperative cancel landed in the
+                # producer: a hang is a gray FAILURE, not a user cancel
+                # — the scheduler types the handle faulted(watchdog);
+                # the wire answer matches, with the lineage so far
+                code, detail = "FAULTED", "watchdog"
+                info = {"fault_class": "QueryStalled",
+                        "point": "watchdog",
+                        "resubmittable": True,
+                        "resubmits": wq.handle.resubmits,
+                        "lineage": [a.get("label")
+                                    for a in wq.handle.attempts]}
             elif isinstance(e, QueryDeadlineExceeded):
                 code, detail = "DEADLINE", ""
             elif isinstance(e, QueryCancelled):
                 code, detail = "CANCELLED", ""
             else:
                 code, detail = "INTERNAL", type(e).__name__
-            self._try_error(conn, WireError(code, str(e), detail=detail))
+            self._try_error(conn, WireError(code, str(e), detail=detail,
+                                            info=info))
             return
         with self._lock:
             self.spooled_bytes += wq.stream.spooled_bytes
@@ -746,6 +782,25 @@ class SqlFrontDoor:
             "scheduler": sched.snapshot(),
             "prepared": self.prepared.snapshot(),
         }
+
+
+def _rejected_wire_error(e) -> WireError:
+    """Map a typed scheduler shed (:class:`..service.scheduler.
+    QueryRejected`) onto the wire: ``quarantined`` gets its own code —
+    the STATEMENT is the fault, so the client must not treat it as
+    service overload — with the diagnosis-bundle id in ``info``; every
+    other reason rides ``REJECTED`` with the shed taxonomy in
+    ``reason``."""
+    if e.reason == "quarantined":
+        info = {}
+        bundle = getattr(e, "bundle_id", None)
+        if bundle:
+            info["bundle_id"] = bundle
+        return WireError("QUARANTINED", str(e), detail=e.reason,
+                         retry_after_ms=e.retry_after_ms,
+                         reason=e.reason, info=info)
+    return WireError("REJECTED", str(e), detail=e.reason,
+                     retry_after_ms=e.retry_after_ms, reason=e.reason)
 
 
 def _parse_siblings(spec: str) -> list:

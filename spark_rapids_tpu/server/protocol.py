@@ -26,7 +26,14 @@ dispatch on (the overload answer is an error, never a hang):
   CANCELLED         query cancelled (caller, or client disconnect)
   DEADLINE          per-query deadline expired
   FAULTED           fault recovery exhausted (QueryFaulted — typed, with
-                    the fault point in ``detail``)
+                    the fault point in ``detail`` and the typed fault
+                    class / attempt lineage / diagnosis-bundle id in
+                    ``info``)
+  QUARANTINED       the statement fingerprint's circuit breaker is open
+                    (service/breaker.py): the statement itself is the
+                    fault — retry a DIFFERENT statement now, this one
+                    after ``retry_after_ms``; ``info.bundle_id`` names
+                    the diagnosis bundle
   NOT_FOUND         unknown statement/query id
   INTERNAL          anything else (the server's bug, not the client's)
   ================  =====================================================
@@ -101,7 +108,7 @@ _RESPONSE_TYPES = (RSP_WELCOME, RSP_META, RSP_BATCH, RSP_END, RSP_ERROR,
 ERROR_CODES = (
     "UNAUTHENTICATED", "BAD_REQUEST", "REJECTED", "QUOTA_EXCEEDED",
     "CANCELLED", "DEADLINE", "FAULTED", "NOT_FOUND", "INTERNAL",
-    "DRAINING",
+    "DRAINING", "QUARANTINED",
 )
 
 
@@ -119,24 +126,36 @@ class WireError(RuntimeError):
     ``overload`` | ``draining`` | ``closed``) so a drain shed and a
     full-queue shed stop being indistinguishable on the wire.
     ``retry_after_ms`` is the server-computed backoff hint (queue depth
-    × predicted drain rate) every shed — REJECTED, QUOTA_EXCEEDED,
-    DRAINING — carries; clients MUST NOT retry sooner (the retry-storm
-    contract, enforced client-side by :class:`.client.RetryBudget`)."""
+    × predicted drain rate — or the remaining quarantine window) every
+    shed — REJECTED, QUOTA_EXCEEDED, DRAINING, QUARANTINED — carries;
+    clients MUST NOT retry sooner (the retry-storm contract, enforced
+    client-side by :class:`.client.RetryBudget`).
+
+    ``info`` is an optional structured payload for errors whose WHY
+    matters beyond the message: a ``FAULTED`` frame carries the typed
+    fault class, point, FaultRecord count, the resubmit lineage
+    (attempt labels) and — when one exists — the diagnosis-bundle id,
+    so clients and loadgen assert on *why*, not just *that*."""
 
     def __init__(self, code: str, message: str, detail: str = "",
-                 retry_after_ms: int = 0, reason: str = ""):
+                 retry_after_ms: int = 0, reason: str = "",
+                 info: Optional[Dict[str, Any]] = None):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.message = message
         self.detail = detail
         self.retry_after_ms = int(retry_after_ms)
         self.reason = reason
+        self.info: Dict[str, Any] = dict(info or {})
 
     def to_payload(self) -> bytes:
-        return pack_json({"code": self.code, "message": self.message,
-                          "detail": self.detail,
-                          "retry_after_ms": self.retry_after_ms,
-                          "reason": self.reason})
+        d = {"code": self.code, "message": self.message,
+             "detail": self.detail,
+             "retry_after_ms": self.retry_after_ms,
+             "reason": self.reason}
+        if self.info:
+            d["info"] = self.info
+        return pack_json(d)
 
     @classmethod
     def from_payload(cls, payload: bytes) -> "WireError":
@@ -144,7 +163,8 @@ class WireError(RuntimeError):
         return cls(d.get("code", "INTERNAL"), d.get("message", ""),
                    d.get("detail", ""),
                    retry_after_ms=d.get("retry_after_ms", 0) or 0,
-                   reason=d.get("reason", ""))
+                   reason=d.get("reason", ""),
+                   info=d.get("info") or {})
 
 
 class ServerDraining(WireError):

@@ -80,18 +80,26 @@ class TenantQuotas:
     def cap_for(self, tenant: str) -> int:
         return self._caps.get(tenant, self._default)
 
-    def acquire(self, tenant: str, retry_after_ms: int = 0) -> None:
+    def acquire(self, tenant: str, retry_after_ms: int = 0,
+                scale: float = 1.0) -> None:
         """Claim an in-flight slot or shed typed.  ``retry_after_ms``
         (the scheduler admission layer's drain-rate hint, passed by the
         endpoint) rides the QUOTA_EXCEEDED error so a capped tenant's
-        fleet backs off instead of hammering the cap."""
+        fleet backs off instead of hammering the cap.  ``scale`` < 1
+        (the scheduler's brownout quota multiplier) shrinks every cap
+        to surviving capacity — never below one slot, so a browned-out
+        tenant still serves."""
         with self._lock:
             cap = self.cap_for(tenant)
+            if cap > 0 and scale < 1.0:
+                cap = max(1, int(cap * max(0.0, scale)))
             cur = self._inflight.get(tenant, 0)
             if cap > 0 and cur >= cap:
                 raise WireError(
                     "QUOTA_EXCEEDED",
-                    f"tenant {tenant!r} at its in-flight cap ({cap}); "
+                    f"tenant {tenant!r} at its in-flight cap ({cap}"
+                    + (f", brownout-scaled x{scale:.2f}"
+                       if scale < 1.0 else "") + "); "
                     f"retry after a query completes",
                     detail=f"inflight={cur}",
                     retry_after_ms=retry_after_ms,

@@ -19,7 +19,8 @@ __all__ = ["QueryCancelled", "QueryDeadlineExceeded", "QueryStalled",
            "QueryControl", "QueryRejected", "QueryScheduler",
            "QueryHandle", "QueryWatchdog",
            "AdmissionController", "CostModel", "AimdController",
-           "SHED_REASONS",
+           "BrownoutController", "SHED_REASONS",
+           "BreakerRegistry", "FingerprintBreaker", "classify_outcome",
            "QueryFaulted", "PermanentFault", "check", "current", "scope",
            "cancel"]
 
@@ -29,11 +30,17 @@ def __getattr__(name):
         from . import scheduler
         return getattr(scheduler, name)
     if name in ("AdmissionController", "CostModel", "AimdController",
-                "SHED_REASONS"):
+                "BrownoutController", "SHED_REASONS"):
         # predictive admission + overload survival (cost model, AIMD
-        # concurrency target, typed shed taxonomy, retry hints)
+        # concurrency target, typed shed taxonomy, retry hints) plus
+        # the brownout degraded-capacity controller
         from . import admission
         return getattr(admission, name)
+    if name in ("BreakerRegistry", "FingerprintBreaker",
+                "classify_outcome"):
+        # blast-radius containment: per-fingerprint circuit breakers
+        from . import breaker
+        return getattr(breaker, name)
     if name == "QueryWatchdog":
         from . import watchdog
         return watchdog.QueryWatchdog

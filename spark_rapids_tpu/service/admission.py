@@ -58,13 +58,18 @@ from typing import Dict, List, Optional
 from ..utils import tracing
 
 __all__ = ["CostModel", "StatementProfile", "AimdController",
-           "AdmissionController", "SHED_REASONS"]
+           "AdmissionController", "BrownoutController", "SHED_REASONS"]
 
 _pc = time.perf_counter
 
 # the complete shed taxonomy — QueryRejected.reason is always one of
-# these, and the loadgen overload report buckets by them
-SHED_REASONS = ("queue_full", "doomed", "overload", "draining", "closed")
+# these, and the loadgen overload report buckets by them.
+# ``quarantined`` = the statement fingerprint's circuit breaker is open
+# (service/breaker.py — the statement itself is the fault);
+# ``brownout`` = the scheduler is in degraded-capacity mode and this
+# submission's priority is below the brownout floor.
+SHED_REASONS = ("queue_full", "doomed", "overload", "draining", "closed",
+                "quarantined", "brownout")
 
 
 class StatementProfile:
@@ -236,6 +241,127 @@ class AimdController:
                     else -1,
                     "decreases": self.decreases,
                     "increases": self.increases}
+
+
+class BrownoutController:
+    """Typed degraded-capacity mode, entered/exited on membership epoch
+    events (docs/robustness.md "Blast-radius containment: brownout
+    serving").
+
+    When ALIVE capacity falls below
+    ``scheduler.brownout.enterFraction`` of the world, surviving
+    capacity must serve the work that matters instead of thrashing at
+    full-fleet settings: the effective concurrency target and tenant
+    quotas scale to the alive fraction, submissions below
+    ``scheduler.brownout.shedBelowPriority`` shed typed (reason
+    ``brownout`` + retry_after), and device-cache fills pause
+    (serve-only) so recovery traffic cannot evict the hot working set
+    from the survivors' HBM.  Entry and exit land trace marks and are
+    visible in the scheduler snapshot.
+
+    Fed by :func:`..parallel.dcn.add_membership_listener` wiring (the
+    scheduler's ``watch_membership``) or directly via
+    ``QueryScheduler.on_membership``.
+    """
+
+    def __init__(self, scheduler=None):
+        self._sched = scheduler
+        self._lock = threading.Lock()
+        self.active = False
+        self.alive = 0
+        self.world = 0
+        self.epoch = 0
+        self.entered_t: Optional[float] = None
+        self.entries = 0
+        self.exits = 0
+        self.sheds = 0
+
+    @staticmethod
+    def enabled(conf) -> bool:
+        return conf["spark.rapids.tpu.sql.scheduler.brownout.enabled"]
+
+    def update_membership(self, alive: int, world: int, conf,
+                          epoch: int = 0) -> None:
+        """One membership event: enter brownout when the alive fraction
+        drops below the conf threshold, exit when it recovers."""
+        if world <= 0:
+            return
+        frac = alive / world
+        threshold = conf[
+            "spark.rapids.tpu.sql.scheduler.brownout.enterFraction"]
+        want = self.enabled(conf) and frac < threshold
+        transition = None
+        with self._lock:
+            self.alive, self.world = int(alive), int(world)
+            self.epoch = max(self.epoch, int(epoch))
+            if want and not self.active:
+                self.active = True
+                self.entered_t = _pc()
+                self.entries += 1
+                transition = "enter"
+            elif not want and self.active:
+                self.active = False
+                self.entered_t = None
+                self.exits += 1
+                transition = "exit"
+        if transition is None:
+            return
+        # cache fills pause while browned out (serve-only): recovery
+        # traffic must not evict the survivors' hot working set
+        try:
+            from ..cache import device_cache
+            device_cache.set_serve_only(transition == "enter")
+        except Exception:  # fault-ok (no cache module in pure-callable schedulers)
+            pass
+        tracing.mark(None, f"scheduler:brownout:{transition}",
+                     "scheduler", alive=int(alive), world=int(world),
+                     epoch=int(epoch),
+                     fraction=round(frac, 3))
+
+    def fraction(self) -> float:
+        with self._lock:
+            if not self.active or self.world <= 0:
+                return 1.0
+            return max(0.0, min(1.0, self.alive / self.world))
+
+    def scale_concurrent(self, target: int) -> int:
+        """Effective concurrency scaled to surviving capacity (never
+        below 1: a browned-out service still serves)."""
+        frac = self.fraction()
+        if frac >= 1.0:
+            return target
+        return max(1, int(target * frac))
+
+    def quota_scale(self) -> float:
+        """Tenant-quota multiplier the front door applies at acquire
+        time (1.0 outside brownout)."""
+        return self.fraction()
+
+    def should_shed(self, priority: int, conf) -> bool:
+        """True when this submission sheds with reason ``brownout``:
+        the mode is active and the priority is below the floor."""
+        with self._lock:
+            if not self.active:
+                return False
+        floor = conf[
+            "spark.rapids.tpu.sql.scheduler.brownout.shedBelowPriority"]
+        if priority >= floor:
+            return False
+        with self._lock:
+            self.sheds += 1
+        return True
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"active": self.active,
+                    "alive": self.alive,
+                    "world": self.world,
+                    "epoch": self.epoch,
+                    "entries": self.entries,
+                    "exits": self.exits,
+                    "sheds": self.sheds,
+                    "active_s": (round(_pc() - self.entered_t, 3)
+                                 if self.entered_t is not None else 0.0)}
 
 
 class AdmissionController:

@@ -1,0 +1,593 @@
+"""Per-fingerprint circuit breakers: quarantine the query that is the
+fault.
+
+Every recovery layer so far treats failure as something that happens TO
+a query — transient faults retry, killed peers re-pull, stalls
+resubmit, overload sheds.  None of them distinguishes a query that is
+itself the CAUSE: a deterministically poisonous statement (always hangs
+the device, always OOMs past spill, always exhausts the device guard)
+is resubmitted at full cost, burns a watchdog window and a
+force-reclaimed permit per attempt, and under the zipf-skewed serving
+mix one bad hot statement degrades every tenant.  This module is the
+blast-radius containment layer (docs/robustness.md "Blast-radius
+containment"):
+
+  * **attribution by typed fault class** — the scheduler feeds every
+    terminal outcome here beside the admission EWMA feed;
+    :func:`classify_outcome` buckets it **chargeable** (the query's own
+    fault: watchdog stall / force-reclaim, device-guard exhaustion,
+    OOM-past-spill) or **victim** (the environment's fault: peer loss,
+    coordinator failover, drain, integrity re-pull, cancellation) using
+    the ``point`` the typed :class:`..faults.recovery.QueryFaulted` /
+    :class:`FaultRecord` vocabulary already carries.  Victim outcomes
+    NEVER count toward a breaker — a query killed by its neighbor's
+    dead rank is not poisonous;
+  * **closed → open after K strikes**
+    (``spark.rapids.tpu.faults.breaker.strikes``, default 2 — the
+    two-strike culprit rule): an open breaker sheds the fingerprint at
+    admission with the typed wire code ``QUARANTINED`` carrying
+    ``retry_after_ms``, and ``_maybe_resubmit`` / the watchdog consult
+    it so a poison query stops being resubmitted after it kills its
+    second worker;
+  * **half-open canary** — after the open window
+    (``breaker.openMs``, doubling per re-trip up to
+    ``breaker.openMaxMs``) ONE canary admission runs under a sandbox
+    profile: tightened deadline (``breaker.canary.deadlineMs``),
+    pipeline depth 0, cpu/ degradation allowed (the contextvar
+    :func:`sandbox_overrides` merged by ``Session._tpu_conf``).  A
+    clean canary closes the breaker; a chargeable canary re-opens it
+    with a doubled window;
+  * **diagnosis bundles** — the closed→open transition writes a
+    bounded postmortem directory (breaker state, fault lineage, the
+    finished trace with its watchdog stall stacks, the wire spec when
+    one exists, the conf overrides) rendered by ``tools/diagnose.py``,
+    so an operator answers "why is this statement quarantined" without
+    reproducing it.  Retention is bounded
+    (``breaker.bundle.max``: oldest bundles are deleted).
+
+Stdlib-only by design (threading + json): the scheduler imports this on
+its submit path.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import tracing
+
+__all__ = ["classify_outcome", "FingerprintBreaker", "BreakerRegistry",
+           "sandbox_overrides", "CHARGEABLE_POINTS", "VICTIM_POINTS"]
+
+_pc = time.perf_counter
+
+# ---------------------------------------------------------------------------------
+# Outcome classification: chargeable vs victim, by typed fault class.
+# ---------------------------------------------------------------------------------
+
+# fault points whose exhaustion is the QUERY's own doing — the statement
+# deterministically wedges the device (watchdog), exhausts the device
+# guard's re-dispatch budget, or OOMs past what spilling can absorb
+CHARGEABLE_POINTS = ("watchdog", "device.op", "memory.oom")
+
+# fault points where the query is a VICTIM of its environment: a peer
+# the coordinator declared dead, a lost coordinator, a planned drain,
+# corrupted bytes the integrity layer re-pulled, a full disk.  These
+# never count toward a breaker — resubmitting them against surviving
+# membership is exactly the right behavior.
+VICTIM_POINTS = ("drain", "shuffle.fragment", "dcn.heartbeat", "io.read",
+                 "io.write", "cache.lookup", "integrity", "spill")
+
+
+def _is_oom(error: BaseException) -> bool:
+    name = type(error).__name__
+    if name in ("RetryOOM", "SplitAndRetryOOM"):
+        return True
+    msg = str(error)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+
+
+def classify_outcome(status: str, error: Optional[BaseException]
+                     ) -> Optional[str]:
+    """Bucket one terminal query outcome: ``"chargeable"`` (counts a
+    strike against the fingerprint), ``"victim"`` (never counts), or
+    ``None`` (not a failure — ``done``).
+
+    Attribution rides the typed vocabulary the fault framework already
+    carries: ``QueryFaulted.point`` for faulted queries, the exception
+    type for everything else.  Unknown failure shapes default to
+    VICTIM — a breaker must never quarantine on unattributed evidence
+    (the false-positive cost is shedding a healthy hot statement for
+    every tenant).
+    """
+    if status == "done":
+        return None
+    if status in ("cancelled", "deadline", "drained", "shed",
+                  "resubmitted"):
+        # user cancels, expired deadlines, planned drains, and admission
+        # sheds are never the statement's fault
+        return "victim"
+    if error is None:
+        return "victim"
+    point = getattr(error, "point", None)
+    if point in CHARGEABLE_POINTS:
+        return "chargeable"
+    if point in VICTIM_POINTS:
+        return "victim"
+    if _is_oom(error):
+        # OOM past the spill protocol (RetryOOM/SplitAndRetryOOM
+        # escaped memory/retry.py): the statement's working set does
+        # not fit this device no matter how often it retries
+        return "chargeable"
+    return "victim"
+
+
+# ---------------------------------------------------------------------------------
+# The canary sandbox: per-query conf overrides via a contextvar the
+# scheduler worker installs (the worker runs in a copied context, so the
+# override is invisible to every other query).
+# ---------------------------------------------------------------------------------
+
+_SANDBOX: "contextvars.ContextVar[Optional[dict]]" = \
+    contextvars.ContextVar("srt_breaker_sandbox", default=None)
+
+# the sandbox profile: serial pipeline (a hang cannot wedge prefetched
+# batches too) and cpu/ degradation allowed (a deterministic device
+# fault gets its one chance to complete degraded)
+_SANDBOX_SETTINGS = {
+    "spark.rapids.tpu.sql.pipeline.depth": 0,
+    "spark.rapids.tpu.faults.degrade.enabled": True,
+}
+
+
+def sandbox_overrides() -> Optional[dict]:
+    """The canary sandbox's conf overrides for the CURRENT context, or
+    None outside a canary worker (``Session._tpu_conf`` merges them)."""
+    return _SANDBOX.get()
+
+
+def install_sandbox() -> None:
+    """Install the sandbox profile in the current (copied) context —
+    called by the scheduler worker before running a canary entry."""
+    _SANDBOX.set(dict(_SANDBOX_SETTINGS))
+
+
+# ---------------------------------------------------------------------------------
+# One fingerprint's breaker.
+# ---------------------------------------------------------------------------------
+
+class FingerprintBreaker:
+    """State machine for one statement fingerprint: ``closed`` →
+    (K chargeable strikes) → ``open`` → (open window elapses) →
+    ``half_open`` (one canary) → ``closed`` | ``open`` again."""
+
+    __slots__ = ("fingerprint", "state", "strikes", "strikes_at_trip",
+                 "trips", "opened_t", "open_until", "canary_inflight",
+                 "canary_started_t", "last_error", "last_point",
+                 "bundle_id", "chargeable_total", "victim_total")
+
+    def __init__(self, fingerprint: str):
+        self.fingerprint = fingerprint
+        self.state = "closed"
+        self.strikes = 0
+        # strike count at the moment the breaker LAST opened (strikes
+        # keeps counting for in-flight attempts that land after the
+        # trip; containment proofs assert on this value)
+        self.strikes_at_trip = 0
+        self.trips = 0  # closed->open transitions (doubles the window)
+        self.opened_t: Optional[float] = None
+        self.open_until: Optional[float] = None
+        self.canary_inflight = False
+        self.canary_started_t: Optional[float] = None
+        self.last_error = ""
+        self.last_point = ""
+        self.bundle_id: Optional[str] = None
+        self.chargeable_total = 0
+        self.victim_total = 0
+
+    def snapshot(self) -> Dict[str, object]:
+        now = _pc()
+        return {"fingerprint": self.fingerprint,
+                "state": self.state,
+                "strikes": self.strikes,
+                "strikes_at_trip": self.strikes_at_trip,
+                "trips": self.trips,
+                "chargeable_total": self.chargeable_total,
+                "victim_total": self.victim_total,
+                "open_remaining_ms": (
+                    max(0, round((self.open_until - now) * 1e3))
+                    if self.open_until is not None
+                    and self.state == "open" else 0),
+                "canary_inflight": self.canary_inflight,
+                "last_error": self.last_error,
+                "last_point": self.last_point,
+                "bundle_id": self.bundle_id}
+
+
+class BreakerRegistry:
+    """All fingerprint breakers of one scheduler, plus the diagnosis
+    bundle writer.  Thread-safe; owned by one
+    :class:`..service.scheduler.QueryScheduler` (state survives
+    drain/resume — and, being scheduler-local, a coordinator failover
+    cannot touch it: :meth:`snapshot_state` / :meth:`restore_state`
+    exist for operators who move quarantine decisions between hosts).
+    """
+
+    # bound on tracked fingerprints (mirrors CostModel.MAX_PROFILES):
+    # beyond it the least-recently-touched CLOSED breaker is dropped
+    MAX_BREAKERS = 4096
+
+    def __init__(self, scheduler=None):
+        self._sched = scheduler
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, FingerprintBreaker] = {}
+        self._bundle_seq = 0
+        self.quarantines = 0  # closed->open transitions, lifetime
+        self.canaries = 0
+        self.sheds = 0  # admissions refused while open
+
+    # -- conf ---------------------------------------------------------------------
+    @staticmethod
+    def enabled(conf) -> bool:
+        return conf["spark.rapids.tpu.faults.breaker.enabled"]
+
+    @staticmethod
+    def _strikes_limit(conf) -> int:
+        return max(1, conf["spark.rapids.tpu.faults.breaker.strikes"])
+
+    @staticmethod
+    def _open_window_s(conf, trips: int) -> float:
+        base = conf["spark.rapids.tpu.faults.breaker.openMs"] / 1000.0
+        cap = conf["spark.rapids.tpu.faults.breaker.openMaxMs"] / 1000.0
+        # each re-trip doubles the quarantine window (exponent clamped,
+        # mirroring the backoff curve's overflow guard)
+        return min(cap, base * (2.0 ** min(32, max(0, trips - 1))))
+
+    @staticmethod
+    def canary_deadline_s(conf) -> Optional[float]:
+        ms = conf["spark.rapids.tpu.faults.breaker.canary.deadlineMs"]
+        return ms / 1000.0 if ms > 0 else None
+
+    # -- lookups ------------------------------------------------------------------
+    def _get_locked(self, fingerprint: str,
+                    create: bool) -> Optional[FingerprintBreaker]:
+        b = self._breakers.pop(fingerprint, None)
+        if b is None:
+            if not create:
+                return None
+            b = FingerprintBreaker(fingerprint)
+            while len(self._breakers) >= self.MAX_BREAKERS:
+                # drop the least-recently-touched CLOSED breaker; an
+                # OPEN one is live containment state and must survive
+                for k in list(self._breakers):
+                    if self._breakers[k].state == "closed":
+                        self._breakers.pop(k)
+                        break
+                else:
+                    break  # everything open: let the map grow
+        self._breakers[fingerprint] = b  # move to MRU position
+        return b
+
+    # -- admission ----------------------------------------------------------------
+    def check_admit(self, fingerprint: Optional[str], conf
+                    ) -> Tuple[str, int]:
+        """Consult the fingerprint's breaker at submit time.
+
+        Returns ``("admit", 0)`` (no breaker / closed),
+        ``("canary", 0)`` (half-open: THIS submission is the one
+        sandboxed canary), or ``("quarantined", retry_after_ms)``
+        (open: shed typed, retry after the window)."""
+        if not fingerprint or not self.enabled(conf):
+            return "admit", 0
+        now = _pc()
+        with self._lock:
+            b = self._get_locked(fingerprint, create=False)
+            if b is None or b.state == "closed":
+                return "admit", 0
+            if b.state == "open":
+                if b.open_until is not None and now < b.open_until:
+                    self.sheds += 1
+                    return ("quarantined",
+                            int((b.open_until - now) * 1e3) + 1)
+                # window elapsed: half-open, admit ONE canary
+                b.state = "half_open"
+                b.canary_inflight = True
+                b.canary_started_t = now
+                self.canaries += 1
+                return "canary", 0
+            # half_open: one canary at a time.  A canary that vanished
+            # without reporting (shed in queue during a drain/close)
+            # would wedge the breaker half-open forever — a stale canary
+            # (4x the open window old) yields its slot.
+            window = self._open_window_s(conf, max(1, b.trips))
+            if b.canary_inflight and b.canary_started_t is not None \
+                    and now - b.canary_started_t > 4 * max(1.0, window):
+                b.canary_inflight = False
+            if not b.canary_inflight:
+                b.canary_inflight = True
+                b.canary_started_t = now
+                self.canaries += 1
+                return "canary", 0
+            self.sheds += 1
+            return ("quarantined",
+                    int(self._open_window_s(conf, b.trips) * 1e3))
+
+    def release_canary(self, fingerprint: Optional[str]) -> None:
+        """Free the half-open canary slot without an outcome (the
+        canary submission shed before it ever queued)."""
+        if not fingerprint:
+            return
+        with self._lock:
+            b = self._breakers.get(fingerprint)
+            if b is not None:
+                b.canary_inflight = False
+
+    def blocks_resubmit(self, fingerprint: Optional[str],
+                        error: Optional[BaseException], conf) -> bool:
+        """The two-strike culprit rule for ``_maybe_resubmit``: True
+        when the failure is CHARGEABLE and the fingerprint has struck
+        out (breaker no longer closed) — the poison query must not be
+        handed a third worker.  Victim failures never block."""
+        if not fingerprint or not self.enabled(conf):
+            return False
+        if classify_outcome("faulted", error) != "chargeable":
+            return False
+        with self._lock:
+            b = self._breakers.get(fingerprint)
+            return b is not None and b.state != "closed"
+
+    # -- the outcome feed ---------------------------------------------------------
+    def on_outcome(self, entry, status: str,
+                   error: Optional[BaseException], conf) -> None:
+        """Completion hook (every terminal path, fed by the scheduler
+        beside the admission EWMA feed).  Classifies the outcome and
+        advances the fingerprint's state machine; a closed→open
+        transition writes the diagnosis bundle and stamps
+        ``error.diagnosis_bundle`` so the typed wire error carries the
+        bundle id."""
+        fingerprint = getattr(entry, "fingerprint", None)
+        if not fingerprint or not self.enabled(conf):
+            return
+        kind = classify_outcome(status, error)
+        canary = bool(getattr(entry, "canary", False))
+        transition = None
+        with self._lock:
+            b = self._get_locked(fingerprint, create=kind == "chargeable")
+            if b is None:
+                return
+            if canary:
+                b.canary_inflight = False
+            if kind is None:
+                # success: a clean canary closes the breaker; a clean
+                # ordinary run clears accumulated strikes (poison is
+                # DETERMINISTIC failure, not a bad day)
+                b.strikes = 0
+                if b.state in ("half_open", "open"):
+                    b.state = "closed"
+                    b.open_until = None
+                    transition = "closed"
+            elif kind == "victim":
+                # victim outcomes NEVER count (peer loss, drain,
+                # failover): a victim canary is merely inconclusive —
+                # stay half-open, the next admission runs a fresh one
+                b.victim_total += 1
+            else:  # chargeable
+                b.chargeable_total += 1
+                b.strikes += 1
+                b.last_error = f"{type(error).__name__}: {error}" \
+                    if error is not None else status
+                b.last_point = getattr(error, "point", "") or ""
+                limit = self._strikes_limit(conf)
+                if b.state == "half_open" or (b.state == "closed"
+                                              and b.strikes >= limit):
+                    b.state = "open"
+                    b.strikes_at_trip = b.strikes
+                    b.trips += 1
+                    b.opened_t = _pc()
+                    b.open_until = b.opened_t \
+                        + self._open_window_s(conf, b.trips)
+                    self.quarantines += 1
+                    transition = "open"
+        # bundle write + trace mark run OUTSIDE the lock (file IO, and
+        # tracing may take other locks)
+        if transition == "open":
+            bundle_id = self._write_bundle(entry, error, conf)
+            with self._lock:
+                bb = self._breakers.get(fingerprint)
+                if bb is not None:
+                    bb.bundle_id = bundle_id
+            if error is not None and bundle_id:
+                error.diagnosis_bundle = bundle_id
+        if transition is not None:
+            tracing.mark(None, f"breaker:{transition}", "fault",
+                         fingerprint=fingerprint[:12])
+
+    def bundle_for(self, fingerprint: Optional[str]) -> Optional[str]:
+        """The fingerprint's current diagnosis-bundle id (stamped on
+        QUARANTINED sheds so a shed client can name the postmortem)."""
+        if not fingerprint:
+            return None
+        with self._lock:
+            b = self._breakers.get(fingerprint)
+            return b.bundle_id if b is not None else None
+
+    # -- diagnosis bundles --------------------------------------------------------
+    def bundle_dir(self, conf) -> str:
+        d = conf["spark.rapids.tpu.faults.breaker.bundle.dir"]
+        if not d:
+            d = os.path.join(conf["spark.rapids.tpu.memory.spill.dir"],
+                             "diagnosis")
+        return os.path.expanduser(d)
+
+    def _write_bundle(self, entry, error: Optional[BaseException],
+                      conf) -> Optional[str]:
+        """The quarantine postmortem: a bounded directory an operator
+        (or ``tools/diagnose.py``) reads to answer WHY without
+        reproducing the poison.  Best-effort — a full disk must not
+        turn containment into a crash."""
+        try:
+            return self._write_bundle_inner(entry, error, conf)
+        except Exception:  # fault-ok (diagnosis is best-effort; quarantine itself already happened)
+            return None
+
+    def _write_bundle_inner(self, entry, error, conf) -> str:
+        from ..config import TpuConf
+        fingerprint = getattr(entry, "fingerprint", "") or "unknown"
+        with self._lock:
+            self._bundle_seq += 1
+            seq = self._bundle_seq
+        bundle_id = f"{fingerprint[:12]}-{seq:04d}"
+        root = self.bundle_dir(conf)
+        path = os.path.join(root, bundle_id)
+        os.makedirs(path, exist_ok=True)
+        ctl = getattr(entry, "control", None)
+        # breaker + query state: the quarantine decision itself
+        with self._lock:
+            b = self._breakers.get(fingerprint)
+            state = b.snapshot() if b is not None else {}
+        _dump(path, "breaker.json", {
+            "bundle_id": bundle_id,
+            "wall_time": time.time(),
+            "label": getattr(entry, "label", ""),
+            "fingerprint": fingerprint,
+            "breaker": state,
+            "strikes_limit": self._strikes_limit(conf),
+        })
+        # fault lineage: the typed error, its FaultRecord history, and
+        # the resubmit chain (attempt labels)
+        history = [{"point": r.point, "attempt": r.attempt,
+                    "error": r.error,
+                    "backoff_s": round(r.backoff_s, 4)}
+                   for r in getattr(error, "history", []) or []]
+        _dump(path, "faults.json", {
+            "error_class": type(error).__name__ if error else None,
+            "error": str(error) if error else None,
+            "point": getattr(error, "point", None),
+            "resubmittable": bool(getattr(error, "resubmittable",
+                                          False)),
+            "history": history,
+            "resubmits": getattr(entry, "resubmits", 0),
+            "lineage": [a.get("label")
+                        for a in getattr(entry, "attempts", [])],
+            # the watchdog's live stack of the wedged worker (stamped
+            # on the control at stage-1 escalation): the hang's only
+            # post-mortem even when tracing is off
+            "stall_stack": getattr(ctl, "last_stall_stack", None)
+            if ctl is not None else None,
+        })
+        # the finished trace (watchdog stall stacks live in its events)
+        tr = getattr(ctl, "trace", None) if ctl is not None else None
+        if tr is not None:
+            _dump(path, "trace.json", {
+                "label": tr.label, "status": tr.status,
+                "duration_s": round(tr.duration_s, 4),
+                "attrs": _jsonable(tr.attrs),
+                "events": [
+                    {"op": ev[0], "name": ev[1], "cat": ev[2],
+                     "t": round(ev[3], 4), "dur": round(ev[4], 6),
+                     "args": _jsonable(ev[6])}
+                    for ev in tr.events
+                    if ev[2] in ("fault", "scheduler", "server")
+                ][-200:],
+            })
+        # the wire spec when one exists (the plan an operator replays)
+        attrs = getattr(ctl, "server_attrs", None) if ctl is not None \
+            else None
+        if attrs:
+            _dump(path, "plan.json", _jsonable(attrs))
+        # conf snapshot: session overrides (what differs from defaults)
+        _dump(path, "conf.json",
+              {k: _jsonable(v)
+               for k, v in sorted(TpuConf._session_overrides.items())})
+        self._prune_bundles(root, conf)
+        return bundle_id
+
+    def _prune_bundles(self, root: str, conf) -> None:
+        keep = max(1, conf["spark.rapids.tpu.faults.breaker.bundle.max"])
+        try:
+            entries = sorted(
+                (e for e in os.listdir(root)
+                 if os.path.isdir(os.path.join(root, e))),
+                key=lambda e: os.path.getmtime(os.path.join(root, e)))
+        except OSError:
+            return
+        for e in entries[:-keep] if len(entries) > keep else []:
+            shutil.rmtree(os.path.join(root, e), ignore_errors=True)
+
+    # -- state portability / introspection ----------------------------------------
+    def snapshot_state(self) -> Dict[str, object]:
+        """Serializable breaker state (open/half-open breakers with
+        REMAINING window seconds): survives a scheduler drain/resume by
+        construction (same object), and lets an operator carry
+        quarantine decisions across a host or coordinator failover."""
+        now = _pc()
+        with self._lock:
+            out = {}
+            for fp, b in self._breakers.items():
+                if b.state == "closed" and b.strikes == 0:
+                    continue
+                out[fp] = {"state": b.state, "strikes": b.strikes,
+                           "strikes_at_trip": b.strikes_at_trip,
+                           "trips": b.trips,
+                           "open_remaining_s": (
+                               max(0.0, b.open_until - now)
+                               if b.open_until is not None else 0.0),
+                           "last_error": b.last_error,
+                           "last_point": b.last_point,
+                           "bundle_id": b.bundle_id}
+            return {"breakers": out, "quarantines": self.quarantines}
+
+    def restore_state(self, state: Dict[str, object]) -> None:
+        """Adopt a :meth:`snapshot_state` blob (re-based onto the local
+        clock — remaining windows stay remaining)."""
+        now = _pc()
+        with self._lock:
+            for fp, d in (state.get("breakers") or {}).items():
+                b = self._get_locked(fp, create=True)
+                b.state = str(d.get("state", "closed"))
+                b.strikes = int(d.get("strikes", 0))
+                b.strikes_at_trip = int(d.get("strikes_at_trip", 0))
+                b.trips = int(d.get("trips", 0))
+                rem = float(d.get("open_remaining_s", 0.0))
+                b.open_until = now + rem if b.state == "open" else None
+                b.opened_t = now if b.state == "open" else None
+                b.canary_inflight = False
+                b.last_error = str(d.get("last_error", ""))
+                b.last_point = str(d.get("last_point", ""))
+                b.bundle_id = d.get("bundle_id")
+
+    def state_of(self, fingerprint: str) -> str:
+        with self._lock:
+            b = self._breakers.get(fingerprint)
+            return b.state if b is not None else "closed"
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            open_fps = [b.snapshot() for b in self._breakers.values()
+                        if b.state != "closed"]
+            return {"tracked": len(self._breakers),
+                    "open": len(open_fps),
+                    "quarantines": self.quarantines,
+                    "canaries": self.canaries,
+                    "sheds": self.sheds,
+                    "open_breakers": open_fps[:16]}
+
+
+def _dump(path: str, name: str, obj) -> None:
+    with open(os.path.join(path, name), "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True, default=str)
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {str(k): _jsonable(v) for k, v in obj.items()}
+        return str(obj)

@@ -49,7 +49,8 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..faults.recovery import QueryFaulted
-from .admission import AdmissionController
+from .admission import AdmissionController, BrownoutController
+from .breaker import BreakerRegistry, install_sandbox
 from .cancel import (QueryCancelled, QueryControl, QueryDeadlineExceeded,
                      QueryDrained, QueryStalled, scope as control_scope)
 
@@ -66,16 +67,22 @@ class QueryRejected(RuntimeError):
 
     ``reason`` is one of :data:`..service.admission.SHED_REASONS`:
 
-      ==========  =====================================================
-      queue_full  the admission queue is at ``queueDepth``
-      doomed      remaining deadline below the fingerprint's predicted
-                  runtime (or already expired) — shed in the queue
-                  rather than dispatched to burn device time
-      overload    estimated queue drain time beyond
-                  ``admission.maxQueueDelayMs``
-      draining    graceful drain in progress (resubmit on a sibling)
-      closed      the scheduler was shut down
-      ==========  =====================================================
+      ===========  ====================================================
+      queue_full   the admission queue is at ``queueDepth``
+      doomed       remaining deadline below the fingerprint's predicted
+                   runtime (or already expired) — shed in the queue
+                   rather than dispatched to burn device time
+      overload     estimated queue drain time beyond
+                   ``admission.maxQueueDelayMs``
+      draining     graceful drain in progress (resubmit on a sibling)
+      closed       the scheduler was shut down
+      quarantined  the statement fingerprint's circuit breaker is OPEN
+                   (service/breaker.py: K chargeable strikes — the
+                   statement itself is the fault); retry_after_ms is
+                   the remaining quarantine window
+      brownout     degraded-capacity mode and this submission's
+                   priority is below ``brownout.shedBelowPriority``
+      ===========  ====================================================
 
     ``retry_after_ms`` is the server-computed backoff hint (queue depth
     × predicted drain rate, clamped to ``server.retryAfter.*``) the
@@ -93,7 +100,7 @@ class _Entry:
     __slots__ = ("seq", "label", "fn", "control", "future", "cctx",
                  "status", "stats", "submitted_t", "started_t",
                  "finished_t", "deadline_s", "resubmits", "attempts",
-                 "worker_ident", "thread", "fingerprint")
+                 "worker_ident", "thread", "fingerprint", "canary")
 
     def __init__(self, seq: int, label: str, fn: Callable,
                  control: QueryControl,
@@ -129,6 +136,9 @@ class _Entry:
         # the front door; None for in-process submissions): the
         # admission cost model's key — predictions in, observations out
         self.fingerprint = fingerprint
+        # half-open circuit-breaker canary: this entry is the one probe
+        # of a quarantined fingerprint, run under the sandbox profile
+        self.canary = False
 
 
 class QueryHandle:
@@ -256,6 +266,14 @@ class QueryScheduler:
         # reservations, typed shed taxonomy, retry_after hints — all
         # behind scheduler.admission.enabled
         self.admission = AdmissionController(self)
+        # blast-radius containment (service/breaker.py): per-fingerprint
+        # circuit breakers fed by the typed completion outcomes below —
+        # a poison statement is quarantined after K chargeable strikes
+        self.breaker = BreakerRegistry(self)
+        # brownout serving (service/admission.py): degraded-capacity
+        # mode driven by membership epoch events (on_membership /
+        # watch_membership)
+        self.brownout = BrownoutController(self)
         self._sem_listener_installed = False
         # dispatcher: pops admissible entries and starts worker threads;
         # queries themselves run in per-query copied contexts
@@ -321,6 +339,7 @@ class QueryScheduler:
                 f"not {type(query).__name__}")
         adm = self.admission
         evicted: List[_Entry] = []
+        canary = False
         try:
             with self._cv:
                 if self._closed:
@@ -336,6 +355,38 @@ class QueryScheduler:
                         "scheduler is draining (planned shutdown); "
                         "resubmit against a sibling or retry after "
                         "restart", reason="draining",
+                        retry_after_ms=adm.retry_after_ms(
+                            conf, len(self._queue)))
+                # blast-radius containment: an OPEN breaker sheds the
+                # poisoned fingerprint before it costs anything;
+                # HALF_OPEN admits THIS submission as the one sandboxed
+                # canary (tightened deadline below)
+                verdict, quarantine_ms = self.breaker.check_admit(
+                    fingerprint, conf)
+                if verdict == "quarantined":
+                    self.rejected += 1
+                    exc = QueryRejected(
+                        f"statement {str(fingerprint)[:12]} is "
+                        f"quarantined (circuit breaker open after "
+                        f"repeated chargeable faults); retry after the "
+                        f"quarantine window", reason="quarantined",
+                        retry_after_ms=quarantine_ms)
+                    exc.bundle_id = self.breaker.bundle_for(fingerprint)
+                    raise exc
+                canary = verdict == "canary"
+                if canary:
+                    cd = self.breaker.canary_deadline_s(conf)
+                    if cd is not None:
+                        deadline_s = cd if deadline_s is None \
+                            else min(deadline_s, cd)
+                # brownout: degraded capacity serves the work that
+                # matters — below-floor priorities shed typed
+                if self.brownout.should_shed(priority, conf):
+                    self.rejected += 1
+                    raise QueryRejected(
+                        "brownout: alive capacity below the serving "
+                        "floor; low-priority work sheds until the "
+                        "membership recovers", reason="brownout",
                         retry_after_ms=adm.retry_after_ms(
                             conf, len(self._queue)))
                 qlen = len(self._queue)
@@ -391,13 +442,21 @@ class QueryScheduler:
                                        priority=priority, tenant=tenant,
                                        weight=weight)
                 control.enqueued_t = _pc()
+                # the injector's fingerprint conditioning reads this off
+                # the running query's control (faults.inject.fingerprint)
+                control.fingerprint = fingerprint
                 entry = _Entry(self._seq, label, fn, control,
                                deadline_s=deadline_s,
                                fingerprint=fingerprint)
+                entry.canary = canary
                 self._queue.append(entry)
                 self.submitted += 1
                 self._cv.notify_all()
         except QueryRejected as exc:
+            if canary:
+                # this submission held the one half-open canary slot but
+                # shed before queueing: free the slot for the next probe
+                self.breaker.release_canary(fingerprint)
             adm.note_shed(exc.reason, label=label or "",
                           retry_after_ms=exc.retry_after_ms)
             raise
@@ -420,6 +479,12 @@ class QueryScheduler:
             self.rejected += 1
         self.admission.note_shed(reason, label=e.label,
                                  retry_after_ms=hint)
+        try:
+            # a shed is a VICTIM outcome (never a strike); for a canary
+            # entry this also frees the half-open slot
+            self.breaker.on_outcome(e, "shed", None, conf)
+        except Exception:  # fault-ok (containment accounting must never fail a shed)
+            pass
         msg = f"{e.label} shed in queue: {reason}"
         if reason == "doomed":
             msg += (" (remaining deadline below predicted runtime);"
@@ -562,8 +627,10 @@ class QueryScheduler:
         # the AIMD controller (admission enabled) nudges the effective
         # target between admission.aimd.floor and maxConcurrent from
         # observed spill-degrade rate / p95 — sustained overload
-        # converges to the goodput plateau instead of spill thrash
-        return self.admission.target_concurrent(conf, conf_max)
+        # converges to the goodput plateau instead of spill thrash;
+        # brownout scales the result to surviving capacity
+        return self.brownout.scale_concurrent(
+            self.admission.target_concurrent(conf, conf_max))
 
     # -- execution ----------------------------------------------------------------
     def _run_entry(self, e: _Entry) -> None:
@@ -572,6 +639,15 @@ class QueryScheduler:
         e.started_t = _pc()
         e.worker_ident = threading.get_ident()
         ctl = e.control
+        if e.canary:
+            # the half-open probe runs sandboxed: serial pipeline + cpu
+            # degradation allowed (Session._tpu_conf merges these for
+            # every conf read inside this copied context); the deadline
+            # was already tightened at submit
+            install_sandbox()
+            from ..utils import tracing
+            tracing.mark(None, "breaker:canary", "fault", label=e.label,
+                         fingerprint=str(e.fingerprint)[:12])
         ctl.note_dispatch()  # the watchdog's stall clock starts HERE
         ctl.admitted_t = e.started_t
         ctl.queue_wait_s = max(0.0, e.started_t - (ctl.enqueued_t
@@ -623,6 +699,13 @@ class QueryScheduler:
                 e, status, e.stats, _pc() - e.started_t, self._conf())
         except Exception:  # fault-ok (accounting must never fail the query's resolution)
             pass
+        # containment feed BEFORE the resubmission decision: the strike
+        # this outcome charges is exactly what _maybe_resubmit consults
+        # (a poison query is denied its third worker)
+        try:
+            self.breaker.on_outcome(e, status, error, self._conf())
+        except Exception:  # fault-ok (containment accounting must never fail the query's resolution)
+            pass
         if status == "faulted" and self._maybe_resubmit(e, error):
             return  # the future stays pending; a fresh attempt is queued
         self._finish(e, status, result, error)
@@ -646,6 +729,15 @@ class QueryScheduler:
         from ..utils import tracing
         from ..utils.metrics import QueryStats
         if not self._resubmittable(exc):
+            return False
+        if self.breaker.blocks_resubmit(e.fingerprint, exc, self._conf()):
+            # the two-strike culprit rule: this fingerprint's breaker is
+            # no longer closed and the failure is CHARGEABLE — the
+            # poison query does not get a third worker; the typed
+            # QueryFaulted (bundle id attached) surfaces to the caller
+            tracing.mark(None, "breaker:resubmit-blocked", "fault",
+                         label=e.label,
+                         fingerprint=str(e.fingerprint)[:12])
             return False
         if self._draining:
             # a draining scheduler must not requeue work into itself —
@@ -682,6 +774,7 @@ class QueryScheduler:
                 priority=ctl.priority, tenant=ctl.tenant,
                 weight=ctl.weight)
             e.control.resubmit_of = ctl.label
+            e.control.fingerprint = e.fingerprint
             e.control.enqueued_t = _pc()
             e.status = "resubmitted"
             self._queue.append(e)
@@ -775,7 +868,27 @@ class QueryScheduler:
                     "draining": self._draining,
                     "max_concurrent_effective": self._max_concurrent()}
         snap["admission"] = self.admission.snapshot()
+        snap["breaker"] = self.breaker.snapshot()
+        snap["brownout"] = self.brownout.snapshot()
         return snap
+
+    # -- membership-driven degradation --------------------------------------------
+    def on_membership(self, alive: int, world: int,
+                      epoch: int = 0) -> None:
+        """One membership epoch event (alive ranks / world size): the
+        brownout controller enters/exits degraded-capacity serving.
+        Called by the :func:`..parallel.dcn.add_membership_listener`
+        wiring (:meth:`watch_membership`) or directly by an operator."""
+        self.brownout.update_membership(alive, world, self._conf(),
+                                        epoch=epoch)
+
+    def watch_membership(self) -> None:
+        """Subscribe this scheduler to DCN membership epoch events so
+        brownout entry/exit tracks the live fleet (idempotent)."""
+        from ..parallel import dcn
+        if not getattr(self, "_membership_watched", False):
+            dcn.add_membership_listener(self.on_membership)
+            self._membership_watched = True
 
     # -- graceful drain ------------------------------------------------------------
     def drain(self, deadline_s: Optional[float] = None) -> Dict[str, int]:
@@ -817,6 +930,12 @@ class QueryScheduler:
                 "drain", f"{e.label} shed before starting: scheduler "
                 f"draining; resubmit against a sibling",
                 resubmittable=True))
+            try:
+                # drain is a VICTIM outcome; a queued canary frees its
+                # half-open slot here
+                self.breaker.on_outcome(e, "drained", None, self._conf())
+            except Exception:  # fault-ok (containment accounting must never fail a drain)
+                pass
             shed += 1
         deadline = _pc() + max(0.0, deadline_s)
         finished_in_time = 0

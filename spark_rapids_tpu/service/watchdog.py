@@ -144,6 +144,10 @@ class QueryWatchdog:
         from ..utils.metrics import QueryStats
         ctl = e.control
         stack = self._worker_stack(e)
+        # keep the newest stall stack on the control too: a quarantine
+        # diagnosis bundle (service/breaker.py) includes it even when
+        # tracing is off for the query
+        ctl.last_stall_stack = stack
         tr = ctl.trace
         if tr is not None:
             # the stack-dump mark is the hung query's only post-mortem:
@@ -194,6 +198,15 @@ class QueryWatchdog:
             tr.set_status("faulted")
             tr.finish()
         self._sched._force_finish(e, err)
+        # a force-reclaim is a CHARGEABLE containment strike the wedged
+        # worker can never report itself (its completion hook will never
+        # run): feed the breaker here so the fingerprint's quarantine
+        # counts the worker this query just killed
+        try:
+            self._sched.breaker.on_outcome(e, "faulted", err,
+                                           self._sched._conf())
+        except Exception:  # fault-ok (containment accounting must never fail the reclaim)
+            pass
         try:
             from ..runtime.semaphore import get_semaphore
             get_semaphore(self._sched._conf()).forfeit()

@@ -86,6 +86,16 @@ class Session:
         return get_query_cache(self._tpu_conf())
 
     def _tpu_conf(self) -> TpuConf:
+        # a circuit-breaker canary worker (service/breaker.py) carries
+        # sandbox overrides in its copied context: serial pipeline, cpu
+        # degradation allowed — every conf read inside the probe sees
+        # them, no other query does
+        from ..service.breaker import sandbox_overrides
+        sandbox = sandbox_overrides()
+        if sandbox:
+            merged = dict(self._settings)
+            merged.update(sandbox)
+            return TpuConf(merged)
         return TpuConf(self._settings)
 
     def _clamp_reader_rows(self, src):

@@ -653,8 +653,12 @@ class TestSatellites:
             assert key in docs, f"{key} missing from docs/configs.md"
 
     def test_shed_reasons_complete(self):
+        # PR 13 extended the taxonomy with the containment sheds:
+        # quarantined (open circuit breaker) and brownout (degraded
+        # alive capacity)
         assert set(SHED_REASONS) == {"queue_full", "doomed", "overload",
-                                     "draining", "closed"}
+                                     "draining", "closed",
+                                     "quarantined", "brownout"}
 
     def test_wire_error_payload_roundtrip(self):
         from spark_rapids_tpu.server.protocol import WireError as WE

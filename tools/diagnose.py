@@ -1,0 +1,185 @@
+"""Render quarantine diagnosis bundles: why is this statement poisoned?
+
+A circuit-breaker trip (service/breaker.py) writes a bounded postmortem
+directory — breaker state, typed fault lineage, the finished trace with
+its watchdog stall stacks, the wire spec, and the conf overrides in
+force.  This tool renders one (or lists them all) so an operator
+answers "why is this statement quarantined" from the bundle instead of
+reproducing the poison against a live fleet.
+
+Usage::
+
+    python tools/diagnose.py [--dir DIR]               # list bundles
+    python tools/diagnose.py [--dir DIR] BUNDLE_ID     # render one
+    python tools/diagnose.py [--dir DIR] --latest      # render newest
+    python tools/diagnose.py ... --json                # machine output
+
+``--dir`` defaults to the conf resolution the breaker writes to:
+``spark.rapids.tpu.faults.breaker.bundle.dir``, falling back to
+``<spark.rapids.tpu.memory.spill.dir>/diagnosis``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_bundle_dir() -> str:
+    from spark_rapids_tpu.config import TpuConf
+    conf = TpuConf()
+    d = conf["spark.rapids.tpu.faults.breaker.bundle.dir"]
+    if not d:
+        d = os.path.join(conf["spark.rapids.tpu.memory.spill.dir"],
+                         "diagnosis")
+    return os.path.expanduser(d)
+
+
+def _load(path: str, name: str) -> Optional[dict]:
+    p = os.path.join(path, name)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def list_bundles(root: str) -> List[Dict]:
+    out = []
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return out
+    for e in sorted(entries):
+        path = os.path.join(root, e)
+        if not os.path.isdir(path):
+            continue
+        head = _load(path, "breaker.json") or {}
+        faults = _load(path, "faults.json") or {}
+        out.append({"bundle_id": e,
+                    "label": head.get("label", ""),
+                    "fingerprint": head.get("fingerprint", "")[:12],
+                    "error_class": faults.get("error_class"),
+                    "point": faults.get("point"),
+                    "mtime": os.path.getmtime(path)})
+    out.sort(key=lambda d: d["mtime"])
+    return out
+
+
+def load_bundle(root: str, bundle_id: str) -> Dict:
+    path = os.path.join(root, bundle_id)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no bundle {bundle_id!r} under {root}")
+    return {"bundle_id": bundle_id,
+            "breaker": _load(path, "breaker.json") or {},
+            "faults": _load(path, "faults.json") or {},
+            "trace": _load(path, "trace.json"),
+            "plan": _load(path, "plan.json"),
+            "conf": _load(path, "conf.json") or {}}
+
+
+def render(b: Dict, out=sys.stdout) -> None:
+    head = b["breaker"]
+    faults = b["faults"]
+    state = head.get("breaker", {})
+    w = out.write
+    w(f"=== diagnosis bundle {b['bundle_id']} ===\n")
+    w(f"query label:   {head.get('label', '?')}\n")
+    w(f"fingerprint:   {head.get('fingerprint', '?')}\n")
+    w(f"breaker state: {state.get('state', '?')} "
+      f"(strikes {state.get('strikes', '?')}/"
+      f"{head.get('strikes_limit', '?')}, "
+      f"trips {state.get('trips', '?')})\n")
+    w(f"last fault:    {state.get('last_error', faults.get('error'))}\n")
+    w(f"fault point:   {faults.get('point')} "
+      f"[{faults.get('error_class')}"
+      + (", resubmittable" if faults.get("resubmittable") else "")
+      + "]\n")
+    lineage = faults.get("lineage") or []
+    if lineage or faults.get("resubmits"):
+        w(f"resubmit lineage ({faults.get('resubmits', 0)} resubmits): "
+          + " -> ".join(str(x) for x in lineage) + "\n")
+    history = faults.get("history") or []
+    if history:
+        w(f"fault records ({len(history)}):\n")
+        for r in history[-20:]:
+            w(f"  attempt {r.get('attempt')}: {r.get('point')} — "
+              f"{r.get('error')} (backoff {r.get('backoff_s')}s)\n")
+    stack = faults.get("stall_stack")
+    if stack:
+        w("stall stack (the wedged worker, captured live by the "
+          "watchdog):\n")
+        for line in str(stack).splitlines():
+            w(f"  {line}\n")
+    tr = b.get("trace")
+    if tr:
+        w(f"trace: {tr.get('label')} status={tr.get('status')} "
+          f"{tr.get('duration_s')}s\n")
+        for ev in tr.get("events") or []:
+            if ev.get("name") == "watchdog:stall":
+                args = ev.get("args") or {}
+                w(f"  STALL at t+{ev.get('t')}s "
+                  f"(idle {args.get('idle_ms')}ms):\n")
+                for line in str(args.get("stack", "")).splitlines():
+                    w(f"    {line}\n")
+            elif ev.get("cat") == "fault":
+                w(f"  fault event t+{ev.get('t')}s: {ev.get('name')} "
+                  f"{ev.get('args')}\n")
+    plan = b.get("plan")
+    if plan:
+        w("wire context / spec:\n")
+        w("  " + json.dumps(plan, sort_keys=True)[:2000] + "\n")
+    conf = b.get("conf")
+    if conf:
+        w("session conf overrides:\n")
+        for k, v in sorted(conf.items()):
+            w(f"  {k} = {v}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle_id", nargs="?", default="")
+    ap.add_argument("--dir", default="")
+    ap.add_argument("--latest", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    root = args.dir or default_bundle_dir()
+    if not args.bundle_id and not args.latest:
+        bundles = list_bundles(root)
+        if args.json:
+            print(json.dumps(bundles, sort_keys=True))
+        elif not bundles:
+            print(f"no diagnosis bundles under {root}")
+        else:
+            for b in bundles:
+                print(f"{b['bundle_id']}  label={b['label']}  "
+                      f"point={b['point']}  {b['error_class']}")
+        return 0
+    bundle_id = args.bundle_id
+    if args.latest:
+        bundles = list_bundles(root)
+        if not bundles:
+            print(f"no diagnosis bundles under {root}", file=sys.stderr)
+            return 1
+        bundle_id = bundles[-1]["bundle_id"]
+    try:
+        b = load_bundle(root, bundle_id)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(b, sort_keys=True, default=str))
+    else:
+        render(b)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,15 @@ per tenant), throughput, SLO violations, prepared-vs-fresh latency (the
 plan-cache win), prepared hit rate, shed/retry/GOAWAY counts — and
 FAILS (exit 1) on any result mismatch or leaked permit/handle/quota.
 
+``--poison`` is the BLAST-RADIUS CONTAINMENT proof (ISSUE 13): a
+seeded deterministically poisonous statement (fingerprint-conditioned
+``device.hang`` — it always wedges) rides inside a healthy zipf mix.
+The per-fingerprint circuit breaker must QUARANTINE it within two
+chargeable strikes (typed ``QUARANTINED`` sheds + retry_after + the
+diagnosis-bundle id), healthy goodput must hold >= 0.9x the no-poison
+baseline, no worker dies after quarantine, no healthy fingerprint
+accrues a strike, zero leaks.
+
 ``--overload`` is the OVERLOAD-SURVIVAL proof (ISSUE 11): measure
 single-load capacity closed-loop, then ramp OFFERED load (open loop,
 fixed issue schedule) to ~5x capacity with per-query deadlines.  The
@@ -873,6 +882,278 @@ def run_soak(args) -> dict:
 
 
 # ---------------------------------------------------------------------------------
+# Poison mode: blast-radius containment proof (ISSUE 13)
+# ---------------------------------------------------------------------------------
+
+# THE poison statement: structurally distinct from every healthy
+# template, so its fingerprint is its own — the injector's
+# fingerprint-conditioned schedule targets exactly this statement in
+# the mixed workload.  A pure filter scan: the ``device.hang`` gray
+# point fires inside its fused-stage dispatch (the watchdog's prey).
+POISON_SPEC = {
+    "table": "orders",
+    "ops": [
+        {"op": "filter",
+         "expr": [">=", ["col", "o_qty"], ["param", 0, "long"]]}]}
+
+
+def run_poison(args) -> dict:
+    """Poison-query containment proof: a seeded deterministically
+    poisonous statement (fingerprint-conditioned ``device.hang`` — it
+    ALWAYS wedges, the watchdog's prey) inside a healthy zipf mix.
+
+    Phase A measures healthy-only goodput (chaos armed identically but
+    no poison traffic, so the phases are apples-to-apples).  Phase B
+    runs the same healthy load plus one poison client hammering the
+    poison statement.  Acceptance: the statement is QUARANTINED within
+    ``faults.breaker.strikes`` (2) chargeable strikes, healthy goodput
+    stays >= ``--poison-goodput-min`` (0.9) of the no-poison baseline,
+    every poison shed is typed (``QUARANTINED`` + retry_after, the
+    diagnosis-bundle id in ``info``), ZERO additional worker deaths
+    (watchdog stalls/reclaims) after quarantine, zero mismatches, zero
+    leaks — and no healthy fingerprint accrues a single strike (the
+    victim/chargeable attribution proof at serving scale)."""
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.cache.keys import statement_fingerprint
+    from spark_rapids_tpu.memory.spill import get_catalog
+    from spark_rapids_tpu.server import SqlFrontDoor, WireClient, WireError
+
+    sess = srt.Session.get_or_create()
+    poison_fp = statement_fingerprint(POISON_SPEC)
+    sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 50_000)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.maxConcurrent", 4)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.queueDepth", 256)
+    sess.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+
+    orders, customers = build_tables(args.rows, args.seed)
+    tables = {"orders": lambda: sess.create_dataframe(orders),
+              "customers": lambda: sess.create_dataframe(customers)}
+    door = SqlFrontDoor(sess, settings={
+        "spark.rapids.tpu.server.tenantQuotas": args.tenant_quotas,
+        "spark.rapids.tpu.server.spool.memoryBytes": 1 << 20,
+    }).start()
+    for name, factory in tables.items():
+        door.register_table(name, factory)
+    oracle = Oracle(sess, tables) if not args.no_verify else None
+    sched = sess.scheduler()
+
+    # warm every healthy template's XLA programs UNDER THE DEFAULT
+    # stall window, so the tightened window below cannot mistake a cold
+    # compile for a hang (a false chargeable strike on a healthy
+    # fingerprint is exactly what this scenario must prove cannot
+    # happen) — and CALIBRATE: the strike window scales to the host's
+    # measured warm latency, so a slow/contended machine does not
+    # watchdog its own healthy queries
+    warm = WireClient("127.0.0.1", door.port, tenant="warmup")
+    warm_s = 0.0
+    for name, (spec, pools) in sorted(templates().items()):
+        try:
+            warm.query(spec, params=list(pools[0]))  # cold (compiles)
+            t0 = _pc()
+            warm.query(spec, params=list(pools[0]))  # warm (measured)
+            warm_s = max(warm_s, _pc() - t0)
+        except WireError:
+            pass  # fault-ok (warmup best-effort; the phases verify results)
+    warm.close()
+
+    # fast strike detection: the poison wedges, the watchdog reclaims
+    # within stallMs (x cold grace before the first batch).  Floor
+    # 400ms, 8x the slowest warm template (headroom for phase-B
+    # contention), capped so the two strikes still fit the phase.
+    stall_ms = min(2500.0, max(400.0, 8000.0 * warm_s))
+    phase_s = max(args.poison_phase_s, 8.0 * stall_ms / 1e3)
+    sess.conf.set("spark.rapids.tpu.faults.watchdog.stallMs", stall_ms)
+    # two-strike quarantine, and a window long enough that no canary
+    # runs inside the measurement (the canary lifecycle has its own
+    # tests; this scenario proves CONTAINMENT)
+    sess.conf.set("spark.rapids.tpu.faults.breaker.strikes", 2)
+    sess.conf.set("spark.rapids.tpu.faults.breaker.openMs", 600000.0)
+    # the fingerprint-conditioned poison: device.hang fires on every
+    # dispatch of THIS statement and no other
+    sess.conf.set("spark.rapids.tpu.faults.inject.schedule",
+                  "device.hang:1:999")
+    sess.conf.set("spark.rapids.tpu.faults.inject.fingerprint",
+                  poison_fp)
+    sess.conf.set("spark.rapids.tpu.faults.inject.seed", args.seed)
+
+    def healthy_phase(duration_s: float, ctr: Counters) -> float:
+        """Duration-bounded healthy zipf mix (the _worker fleet)."""
+        rng = np.random.default_rng(args.seed)
+        z = np.clip(rng.zipf(1.5, args.connections), 1, args.tenants)
+        tenants = [f"tenant-{int(v)}" for v in z]
+        deadline = _pc() + duration_s
+        issued = [0]
+        lock = threading.Lock()
+
+        def next_q():
+            if _pc() >= deadline:
+                return None
+            with lock:
+                issued[0] += 1
+                return issued[0]
+
+        stop = threading.Event()
+        threads = []
+        t0 = _pc()
+        for i in range(args.connections):
+            th = threading.Thread(
+                target=_worker,
+                args=(i, [("127.0.0.1", door.port)], tenants[i], 0,
+                      args.seed, args.prepared_frac, False, ctr,
+                      oracle, next_q, stop),
+                daemon=True, name=f"poison-healthy-{i}")
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=args.timeout)
+        stop.set()
+        return _pc() - t0
+
+    # phase A: no-poison baseline (identical arming, no poison traffic)
+    base_ctr = Counters()
+    base_wall = healthy_phase(phase_s, base_ctr)
+    baseline_qps = len(base_ctr.latencies) / base_wall if base_wall \
+        else 0.0
+
+    # phase B: the same healthy load + one poison client
+    poison_events = {"faulted": 0, "quarantined": 0, "other": {},
+                     "untyped": 0, "infos": [], "bundle_id": None,
+                     "deaths_at_quarantine": None}
+    stop_poison = threading.Event()
+
+    def worker_deaths() -> int:
+        wd = sched._watchdog
+        return int(wd.stalls + wd.reclaims)
+
+    def poison_client():
+        c = WireClient("127.0.0.1", door.port, tenant="poison",
+                       timeout=120.0, retry_budget=0.0)
+        try:
+            while not stop_poison.is_set():
+                try:
+                    c.query(POISON_SPEC, params=[1])
+                except WireError as e:
+                    if e.code == "FAULTED":
+                        poison_events["faulted"] += 1
+                        if e.info:
+                            poison_events["infos"].append(e.info)
+                    elif e.code == "QUARANTINED":
+                        if poison_events["quarantined"] == 0:
+                            # containment moment.  In-flight strikes
+                            # (the quarantining attempt's own resubmit)
+                            # may still be draining: let them land,
+                            # THEN freeze the worker-death baseline —
+                            # everything after it is post-quarantine
+                            time.sleep(
+                                5.0 * stall_ms / 1e3)  # fault-ok (bounded settle for in-flight stall windows at the containment moment, not a retry loop)
+                            poison_events["deaths_at_quarantine"] = \
+                                worker_deaths()
+                        bid = (e.info or {}).get("bundle_id")
+                        if bid and not poison_events["bundle_id"]:
+                            poison_events["bundle_id"] = bid
+                        poison_events["quarantined"] += 1
+                        if e.retry_after_ms <= 0:
+                            poison_events["untyped"] += 1
+                        time.sleep(0.05)  # fault-ok (paced re-probe of a typed quarantine shed; honoring the full retry_after would end the measurement)
+                    else:
+                        k = e.code
+                        poison_events["other"][k] = \
+                            poison_events["other"].get(k, 0) + 1
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            try:
+                c.close()
+            except Exception:  # fault-ok (best-effort goodbye)
+                pass
+
+    pt = threading.Thread(target=poison_client, daemon=True,
+                          name="poison-client")
+    pt.start()
+    mix_ctr = Counters()
+    mix_wall = healthy_phase(phase_s, mix_ctr)
+    stop_poison.set()
+    pt.join(timeout=30)
+    poison_qps = len(mix_ctr.latencies) / mix_wall if mix_wall else 0.0
+    deaths_total = worker_deaths()
+
+    # settle + leak audit (the run()/run_soak() discipline; generous —
+    # on a contended host a straggler may ride out a full un-wedge
+    # window before its unwind)
+    deadline = time.time() + 60
+    while time.time() < deadline and (
+            sched.running() or door.snapshot()["queries_inflight"]):
+        time.sleep(0.1)
+    snap = door.snapshot()
+    leaks: List[str] = []
+    if sched.running() != 0:
+        leaks.append(f"scheduler running={sched.running()}")
+    if snap["queries_inflight"] != 0:
+        leaks.append(f"wire queries inflight={snap['queries_inflight']}")
+    if door.quotas.inflight() != 0:
+        leaks.append(f"tenant quota inflight={door.quotas.inflight()}")
+    door.close()
+    try:
+        get_catalog().assert_no_leaks()
+    except AssertionError as e:
+        leaks.append(f"spill handles: {e}")
+
+    # attribution proof: ONLY the poison fingerprint carries strikes
+    bstate = sched.breaker.snapshot_state()["breakers"]
+    struck = {fp: d for fp, d in bstate.items() if d.get("strikes", 0)
+              or d.get("state") != "closed"}
+    victim_strikes = {fp: d for fp, d in struck.items()
+                      if fp != poison_fp}
+    # strikes AT THE TRIP: attempts already in flight when the breaker
+    # opened may land late strikes; containment is judged by what it
+    # took to open
+    strikes_to_q = (struck.get(poison_fp)
+                    or {}).get("strikes_at_trip", 0)
+    post_q_deaths = (deaths_total
+                     - poison_events["deaths_at_quarantine"]
+                     if poison_events["deaths_at_quarantine"] is not None
+                     else -1)
+    ratio = poison_qps / baseline_qps if baseline_qps else 0.0
+
+    for key in ("spark.rapids.tpu.faults.inject.schedule",
+                "spark.rapids.tpu.faults.inject.fingerprint",
+                "spark.rapids.tpu.faults.inject.seed",
+                "spark.rapids.tpu.faults.watchdog.stallMs",
+                "spark.rapids.tpu.faults.breaker.strikes",
+                "spark.rapids.tpu.faults.breaker.openMs"):
+        sess.conf.unset(key)
+
+    report = {
+        "poison_containment": 1,
+        "poison_fingerprint": poison_fp[:12],
+        "stall_ms_calibrated": round(stall_ms, 1),
+        "phase_s": round(phase_s, 1),
+        "baseline_qps": round(baseline_qps, 2),
+        "poison_phase_qps": round(poison_qps, 2),
+        "healthy_goodput_ratio": round(ratio, 3),
+        "goodput_min": args.poison_goodput_min,
+        "strikes_to_quarantine": strikes_to_q,
+        "poison_faulted": poison_events["faulted"],
+        "quarantined_sheds": poison_events["quarantined"],
+        "untyped_sheds": poison_events["untyped"],
+        "other_poison_errors": poison_events["other"],
+        "fault_info_sample": poison_events["infos"][:2],
+        "bundle_id": poison_events["bundle_id"],
+        "worker_deaths_total": deaths_total,
+        "post_quarantine_worker_deaths": post_q_deaths,
+        "victim_fingerprints_struck": sorted(victim_strikes),
+        "breaker": snap["scheduler"]["breaker"],
+        "healthy_mismatches": base_ctr.mismatches + mix_ctr.mismatches,
+        "healthy_errors": {**base_ctr.errors, **mix_ctr.errors},
+        "leaks": leaks,
+        "verified": oracle is not None,
+    }
+    return report
+
+
+# ---------------------------------------------------------------------------------
 # Overload mode: offered-load ramp to ~5x capacity (ISSUE 11)
 # ---------------------------------------------------------------------------------
 
@@ -1204,6 +1485,13 @@ def main(argv=None) -> int:
                     default=float(env.get("SRT_SOAK_DURATION_S", "60")))
     ap.add_argument("--doors", type=int, default=2)
     ap.add_argument("--drain-deadline-s", type=float, default=10.0)
+    # poison mode (ISSUE 13): a seeded poison statement in a healthy
+    # zipf mix — quarantined within 2 strikes, healthy goodput held,
+    # all sheds typed, zero worker deaths after quarantine, zero leaks
+    ap.add_argument("--poison", action="store_true")
+    ap.add_argument("--poison-phase-s", type=float,
+                    default=float(env.get("SRT_POISON_PHASE_S", "10")))
+    ap.add_argument("--poison-goodput-min", type=float, default=0.9)
     # overload mode (ISSUE 11): offered-load ramp to ~5x measured
     # capacity — goodput plateau, typed shed taxonomy, admitted p99
     ap.add_argument("--overload", action="store_true")
@@ -1218,6 +1506,35 @@ def main(argv=None) -> int:
                     help="A/B kill switch: run the overload ramp with "
                          "admission.enabled=false (static permits)")
     args = ap.parse_args(argv)
+
+    if args.poison:
+        report = run_poison(args)
+        line = json.dumps(report, sort_keys=True)
+        print(line)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(line + "\n")
+        ok = (not report["leaks"]
+              and report["healthy_mismatches"] == 0
+              and 0 < report["strikes_to_quarantine"] <= 2
+              and report["quarantined_sheds"] > 0
+              and report["untyped_sheds"] == 0
+              and report["post_quarantine_worker_deaths"] == 0
+              and not report["victim_fingerprints_struck"]
+              and report["healthy_goodput_ratio"]
+              >= args.poison_goodput_min)
+        print(f"[loadgen] POISON contained in "
+              f"{report['strikes_to_quarantine']} strike(s)  "
+              f"goodput_ratio={report['healthy_goodput_ratio']} "
+              f"(min {args.poison_goodput_min})  "
+              f"quarantined={report['quarantined_sheds']} "
+              f"untyped={report['untyped_sheds']}  "
+              f"post_quarantine_deaths="
+              f"{report['post_quarantine_worker_deaths']}  "
+              f"bundle={report['bundle_id']}  "
+              f"victim_strikes={report['victim_fingerprints_struck'] or 'none'}  "
+              f"leaks={report['leaks'] or 'none'}", file=sys.stderr)
+        return 0 if ok else 1
 
     if args.overload:
         report = run_overload(args)
