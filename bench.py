@@ -80,6 +80,15 @@ baseline, every shed typed, zero worker deaths after quarantine, zero
 leaks; emitted as a poison_containment JSON line beside the
 overload/soak lines; SRT_POISON_PHASE_S sets the per-phase duration,
 SRT_BENCH_QUERIES="" makes the run poison-only),
+SRT_BENCH_PARTITION=1 (network-partition survival drill: a world=3
+thread-rank DcnShuffle whose minority rank is cut off by the link-fault
+fabric mid-reduce — the majority must complete the exact row count
+under the original coordinator generation, the minority must park
+TYPED (QuorumLostError) with zero epoch bumps while parked, and after
+the fabric heals the parked rank must rejoin through flap damping with
+exactly one epoch bump; emitted as a partition_survival JSON line
+beside the other drills, SRT_BENCH_QUERIES="" makes the run
+partition-only),
 SRT_BENCH_KILL_PEER=1 (killed-peer drill: a world=2 DcnShuffle over
 thread ranks commits on both sides, then rank 1 dies SILENTLY
 mid-reduce — the drill prints a dcn_killed_peer_recovery JSON line with
@@ -581,6 +590,14 @@ def main() -> None:
         print(json.dumps(_overload_drill()), flush=True)
         if os.environ.get("SRT_BENCH_QUERIES", None) == "":
             return  # overload-only invocation
+    if os.environ.get("SRT_BENCH_PARTITION", "0") == "1":
+        # partition-survival drill: cut a minority off mid-shuffle —
+        # majority rows complete, minority parks typed, zero epoch
+        # churn while parked, heal-and-rejoin — emitted as a
+        # partition_survival JSON line beside the other drills
+        print(json.dumps(_partition_survival_drill()), flush=True)
+        if os.environ.get("SRT_BENCH_QUERIES", None) == "":
+            return  # partition-only invocation
     if os.environ.get("SRT_BENCH_POISON", "0") == "1":
         # blast-radius containment drill: a seeded poison statement in
         # a healthy zipf mix must be quarantined within two strikes
@@ -660,6 +677,7 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         env.pop("SRT_BENCH_SOAK", None)       # ditto the soak drill
         env.pop("SRT_BENCH_OVERLOAD", None)   # ditto the overload drill
         env.pop("SRT_BENCH_POISON", None)     # ditto the poison drill
+        env.pop("SRT_BENCH_PARTITION", None)  # ditto the partition drill
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
@@ -713,6 +731,24 @@ def _soak_drill() -> dict:
     finally:
         import spark_rapids_tpu as _srt
         _srt.Session.reset()
+
+
+def _partition_survival_drill() -> dict:
+    """SRT_BENCH_PARTITION=1: the network-partition survival drill via
+    tools/loadgen.py's ``_partition_drill`` — a world=3 thread-rank
+    shuffle whose minority rank is cut off by the link-fault fabric
+    mid-reduce; emitted as a ``partition_survival`` JSON line (rows
+    complete on the majority, typed minority park, epoch bumps while
+    parked — must be zero — rejoin after heal, quorum losses) so the
+    trajectory file tracks partition behavior."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import loadgen as _lg
+    leaks: list = []
+    rep = _lg._partition_drill(leaks)
+    rep["metric"] = "partition_survival"
+    rep["leaks"] = leaks
+    return rep
 
 
 def _poison_drill() -> dict:

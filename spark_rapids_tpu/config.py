@@ -747,7 +747,10 @@ FAULTS_INJECT_SCHEDULE = register(
     "dcn.heartbeat, device.op, cache.lookup, dcn.peer_kill, plus the "
     "gray points shuffle.corrupt, spill.corrupt, cache.corrupt, "
     "device.hang, dcn.slow_peer — gray points corrupt/wedge/delay "
-    "instead of raising). Counters "
+    "instead of raising — and the network points dcn.partition "
+    "(drop the Nth fabric-checked DCN send), dcn.net.dup and "
+    "dcn.net.reorder (duplicate / stale-replay the Nth delivery at a "
+    "DCN serve loop)). Counters "
     "reset per query. Empty disables. The chaos differential suite "
     "proves results under a schedule equal the fault-free run; "
     "dcn.peer_kill:N kills THIS rank at its Nth shuffle op "
@@ -977,6 +980,90 @@ DCN_FLAP_BASE_MS = register(
 DCN_FLAP_MAX_MS = register(
     "spark.rapids.tpu.dcn.flap.maxMs", 60000.0,
     "Cap on the exponential rejoin-deferral delay of a flapping rank.")
+
+DCN_SUSPECT_STRIKES = register(
+    "spark.rapids.tpu.dcn.suspect.strikes", 2,
+    "Consecutive missed heartbeat windows (each dcn.heartbeatTimeout "
+    "long) before the coordinator DECLARES a silent rank dead. The "
+    "first miss only SUSPECTS the rank (peer:suspected mark, visible "
+    "in Coordinator.suspected()); any contact within the next window "
+    "clears the suspicion — so injected link delay and real congestion "
+    "stop causing spurious death declarations and the epoch churn that "
+    "follows them. 1 restores declare-on-first-timeout.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+DCN_QUORUM_ENABLED = register(
+    "spark.rapids.tpu.dcn.quorum.enabled", True,
+    "Quorum-fence membership decisions against network partitions "
+    "(world >= 3; parallel/dcn.py): a rank may only promote/adopt a "
+    "successor coordinator after connectivity votes (the 'vote' DCN "
+    "op, served by every peer server) from a strict majority of the "
+    "last-agreed alive set confirm the coordinator is unreachable — "
+    "minority-side ranks park with a typed QuorumLostError "
+    "(resubmittable) instead of electing a second coordinator; and the "
+    "coordinator itself stops declaring deaths (zero epoch bumps) "
+    "while the ranks still heartbeating it are a minority. Generation "
+    "fencing makes a healed stale coordinator abdicate to the higher "
+    "generation. Disabling restores the fail-stop-biased failover "
+    "(debugging escape hatch; 2-rank groups are always fail-stop — no "
+    "quorum exists at world 2).")
+
+DCN_QUORUM_WINDOW_MS = register(
+    "spark.rapids.tpu.dcn.quorum.windowMs", 4000.0,
+    "How long a rank polls connectivity votes for a strict majority "
+    "before deciding it is on the minority side of a partition and "
+    "parking typed (QuorumLostError). Voters answer from their own "
+    "recent coordinator-contact age, so the window must cover at least "
+    "one heartbeat interval plus the liveness horizon of the slowest "
+    "voter.")
+
+FAULTS_NET_PARTITION = register(
+    "spark.rapids.tpu.faults.net.partition", "",
+    "Standing link cuts for the DCN fault fabric "
+    "(faults/netfabric.py), comma list: 'a>b' drops frames from rank a "
+    "to rank b (asymmetric — b>a still flows), 'a-b' cuts both "
+    "directions, '0+1|2' cuts every link between rank groups {0,1} and "
+    "{2} ('*' = every other rank). A cut link refuses sends with a "
+    "typed LinkPartitionedError so retry/failover/durable-re-pull "
+    "machinery engages as for a real dead link. Empty disables.")
+
+FAULTS_NET_DELAY_MS = register(
+    "spark.rapids.tpu.faults.net.delayMs", "",
+    "Added one-way link latency for the DCN fault fabric, comma list: "
+    "'a>b:ms', 'a-b:ms', or '*:ms'. Composes with dcn.suspect.strikes "
+    "— delay under the strike horizon must not cause death "
+    "declarations. Empty disables.")
+
+FAULTS_NET_DUP_RATE = register(
+    "spark.rapids.tpu.faults.net.dup.rate", 0.0,
+    "Probability a frame arriving at a DCN serve loop (coordinator or "
+    "peer server) is DELIVERED TWICE, drawn from a generator seeded by "
+    "faults.net.seed. The per-request dedup journal must make the "
+    "second delivery a byte-identical replay (no double-applied "
+    "registers, no double-counted stats).",
+    check=lambda v: None if 0.0 <= v <= 1.0 else "must be in [0, 1]")
+
+FAULTS_NET_REORDER_RATE = register(
+    "spark.rapids.tpu.faults.net.reorder.rate", 0.0,
+    "Probability a DCN serve loop re-delivers the connection's "
+    "PREVIOUS frame ahead of the current one (the stale-duplicate-"
+    "arrives-late reordering shape), seeded by faults.net.seed; the "
+    "dedup journal must absorb the stale replay.",
+    check=lambda v: None if 0.0 <= v <= 1.0 else "must be in [0, 1]")
+
+FAULTS_NET_SEED = register(
+    "spark.rapids.tpu.faults.net.seed", 0,
+    "Seed for the fabric's dup/reorder draws, so network chaos runs "
+    "replay exactly (identical re-arms preserve the RNG stream, like "
+    "faults.inject.seed).")
+
+FAULTS_NET_AFTER_OPS = register(
+    "spark.rapids.tpu.faults.net.afterOps", 0,
+    "Engage the standing faults.net.* program only after this rank has "
+    "counted this many shuffle ops (the deterministic mid-query "
+    "trigger, mirroring dcn.peer_kill's 'after N ops' shape). 0 "
+    "engages immediately.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
 
 
 SERVER_HOST = register(

@@ -15,6 +15,12 @@ work.  This package is that missing resilience layer, split in two:
     ``device.hang``, ``dcn.slow_peer``), supporting deterministic
     schedules ("fail the Nth op at point P") and probabilistic rates
     for chaos runs;
+  * :mod:`.netfabric` — the link layer network faults act through: a
+    seeded per-(src, dst)-rank fault fabric (standing partitions,
+    asymmetric one-way loss, added delay, duplicated/reordered
+    delivery) interposed in the DCN socket helpers and serve loops,
+    with the ``dcn.partition`` / ``dcn.net.dup`` / ``dcn.net.reorder``
+    points folding the same faults into the schedule/rate vocabulary;
   * :mod:`.integrity` — checksums stamped on every durable byte path
     (spill files, shuffle frames, DCN fragments, writer output) with
     verification failures converted into the recovery vocabulary below;
@@ -32,6 +38,7 @@ ad-hoc sleeps and swallowed exceptions cannot silently reappear.
 
 from .injector import INJECTOR, FaultInjector, InjectedFault, POINTS
 from .integrity import IntegrityFault, checksum, verify
+from .netfabric import FABRIC, LinkPartitionedError, NetFabric
 from .recovery import (FaultRecord, PermanentFault, QueryFaulted,
                        TransientFault, backoff_delays, budget_scope,
                        check_disk_full, device_guard, recovery_enabled,
@@ -39,6 +46,7 @@ from .recovery import (FaultRecord, PermanentFault, QueryFaulted,
 
 __all__ = [
     "INJECTOR", "FaultInjector", "InjectedFault", "POINTS",
+    "FABRIC", "NetFabric", "LinkPartitionedError",
     "TransientFault", "PermanentFault", "QueryFaulted", "FaultRecord",
     "IntegrityFault", "checksum", "verify",
     "transient_retry", "device_guard", "budget_scope",

@@ -68,11 +68,24 @@ __all__ = ["POINTS", "InjectedFault", "FaultInjector", "INJECTOR"]
 #     coordinator too (control requests are received and never
 #     answered), driving the coordinator-failover chaos differential;
 #     hard mode exits the hosting process.
+#
+# The NETWORK points (ISSUE 14) ride the link-fault fabric
+# (faults/netfabric.py) — the fault is a property of a LINK between two
+# healthy ranks, not of a host:
+#   * ``dcn.partition`` — drop the Nth fabric-checked DCN send (a
+#     one-message link blip: the sender sees a typed
+#     LinkPartitionedError and recovers by re-dial/retry; standing
+#     partitions come from the faults.net.partition program instead);
+#   * ``dcn.net.dup`` / ``dcn.net.reorder`` — gray delivery faults at
+#     the RECEIVING serve loop (maybe_fire): a frame is delivered
+#     twice, or the connection's previous frame is re-delivered late —
+#     the per-request dedup journal must make both idempotent.
 POINTS = ("io.read", "io.write", "shuffle.fragment", "dcn.heartbeat",
           "device.op", "cache.lookup", "dcn.peer_kill",
           "shuffle.corrupt", "spill.corrupt", "cache.corrupt",
           "device.hang", "dcn.slow_peer", "server.conn",
-          "dcn.coordinator_kill")
+          "dcn.coordinator_kill",
+          "dcn.partition", "dcn.net.dup", "dcn.net.reorder")
 
 
 class InjectedFault(TransientFault):

@@ -1,0 +1,353 @@
+"""Seeded, conf-driven per-LINK network fault fabric for the DCN.
+
+The chaos suite's fail-stop and gray points kill *hosts* (frozen peers,
+dropped heartbeats, corrupt frames).  Real multi-host meshes mostly lose
+the *network between* healthy hosts: full partitions, asymmetric one-way
+link loss, added delay, and duplicated/reordered delivery.  This module
+is the link layer those faults act through — a process-global
+:class:`NetFabric` (``FABRIC``) interposed in the DCN socket helpers
+(``ProcessGroup._request`` / ``fetch`` / heartbeats) and in the
+coordinator / peer-server serve loops, keyed by **(src rank, dst
+rank)** so every program is directional:
+
+  * **partition** (``spark.rapids.tpu.faults.net.partition``) — a
+    standing cut.  Grammar (comma list): ``"a>b"`` drops frames from
+    rank a to rank b (ASYMMETRIC: b→a still flows), ``"a-b"`` cuts both
+    directions, ``"0+1|2"`` cuts every cross-group link between ranks
+    {0,1} and {2} (``*`` = every other rank, so ``"2|*"`` isolates
+    rank 2).  A cut link refuses sends with a typed
+    :class:`LinkPartitionedError` (IS-A ``ConnectionError``, so every
+    existing failure path — transient retry, durable re-pull, quorum
+    failover — engages without new plumbing);
+  * **delay** (``faults.net.delayMs``) — added one-way latency:
+    ``"a>b:ms"`` / ``"a-b:ms"`` / ``"*:ms"`` comma list.  Composes with
+    the coordinator's suspicion strikes (``dcn.suspect.strikes``):
+    delay under the strike horizon must NOT cause death declarations;
+  * **duplication / reordering** (``faults.net.dup.rate`` /
+    ``faults.net.reorder.rate``, seeded by ``faults.net.seed``) — act
+    at the RECEIVING serve loop via :meth:`NetFabric.deliveries`: a
+    duplicated frame is processed twice (the request-id dedup journal
+    must make the second delivery a byte-identical replay), a reordered
+    frame re-delivers the connection's PREVIOUS frame first (the
+    classic stale-duplicate-arrives-late shape).
+
+Three injection points fold the same faults into the deterministic
+schedule/rate vocabulary of :mod:`.injector` (``faults.inject.*``):
+``dcn.partition`` (drop the Nth fabric-checked send — a one-message
+link blip, recovered by re-dial/retry, distinct from a standing cut),
+``dcn.net.dup`` and ``dcn.net.reorder`` (force a duplicate / stale
+replay at the Nth delivery).
+
+``faults.net.afterOps`` arms the standing program LAZILY: the cut
+engages only after this rank has counted that many shuffle ops
+(:meth:`note_op`), so a multi-process chaos run can partition the mesh
+deterministically MID-QUERY (after map outputs committed), mirroring
+``dcn.peer_kill``'s "kill rank R after N ops" shape.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["LinkPartitionedError", "NetFabric", "FABRIC"]
+
+
+class LinkPartitionedError(ConnectionError):
+    """A send refused by the link-fault fabric: the (src, dst) link is
+    cut (standing ``faults.net.partition`` program, or the Nth send
+    dropped by a ``dcn.partition`` schedule).  A ``ConnectionError`` so
+    every existing detection path — transient retry, coordinator
+    re-dial, quorum-fenced failover, durable fragment re-pull — engages
+    exactly as it would for a real dead link."""
+
+
+def _parse_ranks(tok: str) -> Tuple[str, ...]:
+    return tuple(t.strip() for t in tok.split("+") if t.strip())
+
+
+def _parse_partition(spec: str) -> Set[Tuple[str, str]]:
+    """``"a>b,c-d,0+1|2"`` -> set of directed (src, dst) string pairs
+    (``"*"`` wildcards kept symbolic)."""
+    cuts: Set[Tuple[str, str]] = set()
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "|" in item:
+            a, b = item.split("|", 1)
+            for s in _parse_ranks(a):
+                for d in _parse_ranks(b):
+                    cuts.add((s, d))
+                    cuts.add((d, s))
+        elif ">" in item:
+            s, d = item.split(">", 1)
+            cuts.add((s.strip(), d.strip()))
+        elif "-" in item:
+            s, d = item.split("-", 1)
+            cuts.add((s.strip(), d.strip()))
+            cuts.add((d.strip(), s.strip()))
+        else:
+            raise ValueError(
+                f"bad net partition entry {item!r} (want a>b, a-b, or "
+                f"A+B|C+D)")
+    return cuts
+
+
+def _parse_delay(spec: str) -> List[Tuple[str, str, float]]:
+    """``"a>b:ms,a-b:ms,*:ms"`` -> [(src, dst, seconds)]."""
+    out: List[Tuple[str, str, float]] = []
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        link, _, ms = item.rpartition(":")
+        if not link:
+            raise ValueError(
+                f"bad net delay entry {item!r} (want link:ms)")
+        s = float(ms) / 1000.0
+        if ">" in link:
+            a, b = link.split(">", 1)
+            out.append((a.strip(), b.strip(), s))
+        elif "-" in link:
+            a, b = link.split("-", 1)
+            out.append((a.strip(), b.strip(), s))
+            out.append((b.strip(), a.strip(), s))
+        elif link.strip() == "*":
+            out.append(("*", "*", s))
+        else:
+            raise ValueError(
+                f"bad net delay entry {item!r} (want a>b:ms, a-b:ms or "
+                f"*:ms)")
+    return out
+
+
+def _match(pair: Tuple[str, str], src: int, dst: int) -> bool:
+    s, d = pair
+    return (s == "*" or s == str(src)) and (d == "*" or d == str(dst)) \
+        and src != dst  # a rank's loopback link is never faulted
+
+
+class NetFabric:
+    """Process-global link-fault fabric consulted by every DCN send and
+    serve loop.  Armed from the ``spark.rapids.tpu.faults.net.*`` confs
+    at each ExecContext (identical re-arms preserve the dup/reorder RNG
+    stream, mirroring :class:`.injector.FaultInjector`), or directly by
+    chaos harnesses (:meth:`arm` / :meth:`cut` / :meth:`heal`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cuts: Set[Tuple[str, str]] = set()
+        # runtime cuts (chaos drills partitioning a LIVE mesh via
+        # cut()) live beside the conf program: every ExecContext
+        # re-arms from conf, and that re-arm must not wipe a drill's
+        # standing partition mid-run
+        self._rt_cuts: Set[Tuple[str, str]] = set()
+        self._delays: List[Tuple[str, str, float]] = []
+        self._dup_rate = 0.0
+        self._reorder_rate = 0.0
+        self._rng = random.Random(0)
+        self._armed_args = None
+        self._after_ops = 0
+        self._ops_seen = 0
+        self._healed = False
+        # cumulative accounting (chaos asserts read these; survive
+        # re-arming like the injector's totals)
+        self.sends_dropped = 0
+        self.frames_duplicated = 0
+        self.frames_reordered = 0
+
+    # -- arming -------------------------------------------------------------------
+    def arm(self, partition: str = "", delay: str = "",
+            dup_rate: float = 0.0, reorder_rate: float = 0.0,
+            seed: int = 0, after_ops: int = 0) -> None:
+        cuts = _parse_partition(partition)
+        delays = _parse_delay(delay)
+        args = (partition, delay, float(dup_rate), float(reorder_rate),
+                seed, int(after_ops))
+        with self._lock:
+            self._cuts = cuts
+            self._delays = delays
+            self._dup_rate = max(0.0, float(dup_rate))
+            self._reorder_rate = max(0.0, float(reorder_rate))
+            self._after_ops = max(0, int(after_ops))
+            # identical re-arms (every ExecContext of a chaos run) keep
+            # the RNG stream AND the engage/heal state: "rate" stays a
+            # true seeded rate and a healed fabric stays healed across
+            # queries of one run
+            if args != self._armed_args:
+                self._rng = random.Random(seed or 0)
+                self._armed_args = args
+                self._ops_seen = 0
+                self._healed = False
+
+    def arm_from_conf(self, conf) -> None:
+        self.arm(
+            partition=conf["spark.rapids.tpu.faults.net.partition"],
+            delay=conf["spark.rapids.tpu.faults.net.delayMs"],
+            dup_rate=conf["spark.rapids.tpu.faults.net.dup.rate"],
+            reorder_rate=conf["spark.rapids.tpu.faults.net.reorder.rate"],
+            seed=conf["spark.rapids.tpu.faults.net.seed"],
+            after_ops=conf["spark.rapids.tpu.faults.net.afterOps"])
+
+    def cut(self, partition: str) -> None:
+        """Add a standing cut at runtime (chaos drills partition a
+        LIVE mesh mid-run).  Engages immediately (ignores afterOps)
+        and SURVIVES conf re-arms — a live query's ExecContext arming
+        from an empty conf must not heal a drill's partition."""
+        cuts = _parse_partition(partition)
+        with self._lock:
+            self._rt_cuts |= cuts
+            self._healed = False
+            self._ops_seen = max(self._ops_seen, self._after_ops)
+
+    def heal(self) -> None:
+        """Clear every standing cut and delay (the partition heals;
+        dup/reorder rates keep running — healing a link does not stop
+        packet-level weirdness elsewhere).  Sticky across identical
+        re-arms so a healed chaos run stays healed; runtime cuts are
+        dropped outright (a drill re-cuts explicitly if it wants a
+        second partition)."""
+        with self._lock:
+            self._healed = True
+            self._rt_cuts.clear()
+
+    def reset(self) -> None:
+        """Full harness reset: conf program, runtime cuts, heal state,
+        op counters, RNG — the between-tests cleanup."""
+        with self._lock:
+            self._cuts = set()
+            self._rt_cuts = set()
+            self._delays = []
+            self._dup_rate = self._reorder_rate = 0.0
+            self._rng = random.Random(0)
+            self._armed_args = None
+            self._after_ops = self._ops_seen = 0
+            self._healed = False
+
+    def note_op(self) -> None:
+        """Count one shuffle op on this rank toward ``faults.net
+        .afterOps`` (the deterministic mid-query engage trigger)."""
+        with self._lock:
+            if self._ops_seen < self._after_ops:
+                self._ops_seen += 1
+
+    # -- state --------------------------------------------------------------------
+    def _engaged_locked(self) -> bool:
+        return not self._healed and self._ops_seen >= self._after_ops
+
+    def active(self) -> bool:
+        with self._lock:
+            return bool(self._cuts or self._rt_cuts or self._delays
+                        or self._dup_rate > 0.0
+                        or self._reorder_rate > 0.0)
+
+    def partitioned(self, src: int, dst: int) -> bool:
+        """True when the standing program currently cuts src -> dst."""
+        with self._lock:
+            if self._healed:
+                return False
+            cuts = self._cuts if self._engaged_locked() else set()
+            return any(_match(c, src, dst)
+                       for c in (cuts | self._rt_cuts))
+
+    # -- the send-side check --------------------------------------------------------
+    def check_send(self, src: int, dst: int, what: str = "") -> None:
+        """Gate one frame from rank ``src`` to rank ``dst``: raises
+        :class:`LinkPartitionedError` on a cut link (standing program,
+        or the ``dcn.partition`` schedule/rate selecting this send),
+        sleeps any programmed one-way delay.  Call BEFORE the socket
+        send, and outside any lock (the delay sleeps)."""
+        from .injector import INJECTOR
+        if src < 0 or dst < 0 or src == dst:
+            return
+        delay = 0.0
+        cut = False
+        with self._lock:
+            if not self._healed:
+                cuts = set(self._rt_cuts)
+                if self._engaged_locked():
+                    cuts |= self._cuts
+                cut = any(_match(c, src, dst) for c in cuts)
+                if not cut and self._engaged_locked():
+                    for s, d, sec in self._delays:
+                        if _match((s, d), src, dst):
+                            delay = max(delay, sec)
+            if cut:
+                self.sends_dropped += 1
+        if cut:
+            raise LinkPartitionedError(
+                f"link {src}->{dst} partitioned"
+                + (f" ({what})" if what else ""))
+        # the schedule/rate vocabulary: a one-message drop at this link
+        if INJECTOR.maybe_fire("dcn.partition",
+                               desc=what or f"{src}->{dst}"):
+            with self._lock:
+                self.sends_dropped += 1
+            raise LinkPartitionedError(
+                f"link {src}->{dst} dropped frame (injected)"
+                + (f" ({what})" if what else ""))
+        if delay > 0:
+            time.sleep(delay)  # fault-ok (the programmed link latency itself, not a retry loop)
+
+    def check_connect(self, src: int, dst: int, what: str = "") -> None:
+        """Connection-establishment flavor of :meth:`check_send`: a cut
+        link refuses the dial the way an unroutable host would."""
+        self.check_send(src, dst, what=what or "connect")
+
+    # -- the delivery-side transform ------------------------------------------------
+    def deliveries(self, src: int, dst: int, msg: dict, blob: bytes,
+                   prev: Optional[Tuple[dict, bytes]] = None
+                   ) -> List[Tuple[dict, bytes, bool]]:
+        """Expand one received frame into its delivery list for the
+        serve loop: ``[(msg, blob, send_reply)]``.  Duplication
+        processes the frame twice (dedup journal replays the second);
+        reordering re-delivers the connection's previous frame first (a
+        stale duplicate arriving late).  Exactly ONE entry carries
+        ``send_reply=True`` — the current frame — so request/response
+        framing stays intact."""
+        from .injector import INJECTOR
+        dup = reorder = False
+        if src >= 0 and dst >= 0 and src != dst:
+            with self._lock:
+                if self._engaged_locked():
+                    if self._dup_rate > 0.0 \
+                            and self._rng.random() < self._dup_rate:
+                        dup = True
+                    if not dup and self._reorder_rate > 0.0 \
+                            and self._rng.random() < self._reorder_rate:
+                        reorder = True
+            if INJECTOR.maybe_fire("dcn.net.dup",
+                                   desc=f"{src}->{dst}"):
+                dup = True
+            if not dup and INJECTOR.maybe_fire("dcn.net.reorder",
+                                               desc=f"{src}->{dst}"):
+                reorder = True
+        if dup:
+            with self._lock:
+                self.frames_duplicated += 1
+            return [(msg, blob, False), (msg, blob, True)]
+        if reorder and prev is not None:
+            with self._lock:
+                self.frames_reordered += 1
+            pm, pb = prev
+            return [(pm, pb, False), (msg, blob, True)]
+        return [(msg, blob, True)]
+
+    # -- introspection --------------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"cuts": sorted(self._cuts | self._rt_cuts),
+                    "delays": list(self._delays),
+                    "dup_rate": self._dup_rate,
+                    "reorder_rate": self._reorder_rate,
+                    "healed": self._healed,
+                    "engaged": self._engaged_locked(),
+                    "sends_dropped": self.sends_dropped,
+                    "frames_duplicated": self.frames_duplicated,
+                    "frames_reordered": self.frames_reordered}
+
+
+FABRIC = NetFabric()

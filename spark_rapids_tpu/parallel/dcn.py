@@ -87,6 +87,7 @@ from ..faults.recovery import PermanentFault, TransientFault, \
 __all__ = ["Coordinator", "ProcessGroup", "DcnShuffle", "PeerFailedError",
            "PeerLostError", "CoordinatorLostError",
            "CoordinatorUnrecoverableError", "RejoinDeferredError",
+           "QuorumLostError",
            "add_membership_listener", "remove_membership_listener",
            "host_partition_ids",
            "run_distributed_agg", "run_distributed_query"]
@@ -188,6 +189,23 @@ class CoordinatorUnrecoverableError(CoordinatorLostError, PermanentFault):
     forms."""
 
 
+class QuorumLostError(CoordinatorLostError, PermanentFault):
+    """This rank is on the MINORITY side of a network partition: it
+    cannot reach the coordinator, and connectivity votes from a strict
+    majority of the last-agreed alive set did not confirm the
+    coordinator dead (either the voters are unreachable too — we are
+    cut off — or they can still reach it — OUR link is the fault).
+    Promoting a successor here would elect a second coordinator, so the
+    rank PARKS instead: queries fail typed and resubmittable (the
+    :class:`..faults.recovery.PermanentFault` classification fast-fails
+    the retry budget), the membership listeners learn the shrunken
+    alive view (brownout), and the heartbeat thread switches to the
+    heal loop — probing peers for the current coordinator generation
+    and re-registering (under flap damping) once the partition heals.
+    Still a :class:`..faults.recovery.TransientFault` by lineage: a
+    parked rank is partitioned, not dead."""
+
+
 # ---------------------------------------------------------------------------------
 # Message framing: length-prefixed JSON control header + optional raw payload.
 # ---------------------------------------------------------------------------------
@@ -266,7 +284,8 @@ class Coordinator:
                  bind_host: str = "127.0.0.1",
                  heartbeat_timeout: Optional[float] = None,
                  wait_timeout: Optional[float] = None,
-                 rank: int = 0, listen: bool = True):
+                 rank: int = 0, listen: bool = True,
+                 generation: int = 1):
         # None = resolve from the registered confs (session overrides
         # apply), so service deployments tune liveness without code:
         # spark.rapids.tpu.dcn.{heartbeatTimeout,waitTimeout}
@@ -287,6 +306,32 @@ class Coordinator:
         self.rank = rank  # the rank HOSTING this coordinator
         self.heartbeat_timeout = heartbeat_timeout
         self.wait_timeout = wait_timeout
+        # GENERATION FENCING: every promotion mints generation+1 (rides
+        # the journal); a coordinator observing a HIGHER generation in
+        # any frame is provably stale and ABDICATES — at most one
+        # coordinator generation is ever active, partition or not
+        self.generation = int(generation)
+        self._abdicated = False
+        # suspicion-before-declaration (dcn.suspect.strikes): a rank
+        # missing one heartbeat window is SUSPECTED (recoverable — any
+        # contact clears it); only `strikes` consecutive missed windows
+        # declare it dead, so link delay/congestion stops causing
+        # spurious death declarations + epoch churn
+        self._suspect_strikes = max(1, int(
+            conf["spark.rapids.tpu.dcn.suspect.strikes"]))
+        self._suspect: Dict[int, int] = {}
+        # coordinator-side quorum fence (dcn.quorum.*, world >= 3): when
+        # the ranks still heartbeating this coordinator are a MINORITY
+        # of the last-agreed alive set, this coordinator is on the
+        # small side of a partition — it PARKS (no declarations, no
+        # epoch bumps, collectives answered typed quorum_lost) instead
+        # of diverging, and un-parks with ZERO churn when contact
+        # resumes
+        self._quorum_enabled = conf["spark.rapids.tpu.dcn.quorum.enabled"]
+        self.quorum_lost = False
+        # delivery hardening: duplicated/reordered frames replay their
+        # recorded reply instead of re-applying effects
+        self._reqj = _ReqJournal()
         self._cv = threading.Condition()
         self._peers: Dict[int, Tuple[str, int]] = {}
         self._last_seen: Dict[int, float] = {}
@@ -375,7 +420,9 @@ class Coordinator:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
+        from ..faults.netfabric import FABRIC
         keep_open = False
+        prev: Optional[Tuple[dict, bytes]] = None
         try:
             while True:
                 msg, blob = _recv(conn)
@@ -386,11 +433,25 @@ class Coordinator:
                     # case the chaos suite drives)
                     keep_open = True
                     return
-                try:
-                    reply, rblob = self._handle(msg, blob)
-                except Exception as e:  # surface to the peer, keep serving
-                    reply, rblob = {"error": str(e)}, b""
-                _send(conn, reply, rblob)
+                src = int(msg.get("rank", -1))
+                # the fabric may DUPLICATE this frame or re-deliver the
+                # connection's previous one first (stale reorder); the
+                # dedup journal inside handle() makes both idempotent
+                for m, b, send_reply in FABRIC.deliveries(
+                        src, self.rank, msg, blob, prev=prev):
+                    try:
+                        reply, rblob = self.handle(m, b)
+                    except Exception as e:  # surface to peer, keep serving
+                        reply, rblob = {"error": str(e)}, b""
+                    if not send_reply:
+                        continue
+                    # the reply direction is its OWN link: an asymmetric
+                    # cut drops the answer even though the request
+                    # arrived (the requester sees a dead connection)
+                    FABRIC.check_send(self.rank, src,
+                                      what=f"reply {m.get('op')!r}")
+                    _send(conn, reply, rblob)
+                prev = (msg, blob)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -416,6 +477,11 @@ class Coordinator:
             if self._closed:
                 raise PeerFailedError(
                     f"coordinator closed while waiting at {what}")
+            if self._abdicated:
+                raise PeerFailedError(
+                    f"coordinator (gen {self.generation}) abdicated "
+                    f"while waiting at {what}: re-dial the current "
+                    f"coordinator")
             left = deadline - time.monotonic()  # span-api-ok (timeout, not timing)
             if left <= 0:
                 raise PeerFailedError(
@@ -431,22 +497,95 @@ class Coordinator:
                 self._last_seen[rank] = time.monotonic()  # span-api-ok (timeout, not timing)
 
     def _declare_locked(self) -> None:
-        """Declare ranks whose heartbeats stopped: each new death bumps
-        the cluster epoch.  A declared rank stays dead — resuming
-        heartbeats does not resurrect it; only re-registering (under a
-        fresh incarnation) does."""
+        """Suspect, then declare, ranks whose heartbeats stopped.
+
+        A rank missing ONE heartbeat window is only SUSPECTED
+        (``peer:suspected`` mark; any contact clears it — delay is not
+        death); ``dcn.suspect.strikes`` consecutive missed windows
+        DECLARE it, each new death bumping the cluster epoch.  A
+        declared rank stays dead — resuming heartbeats does not
+        resurrect it; only re-registering (under a fresh incarnation)
+        does.
+
+        QUORUM FENCE (world >= 3): when declaring the current suspects
+        would leave fewer than a strict majority of the last-agreed
+        alive set, this coordinator is the minority side of a partition
+        — it PARKS (``quorum_lost``) with ZERO declarations and ZERO
+        epoch bumps instead of diverging; contact resuming un-parks it
+        with zero churn."""
         if len(self._peers) < self.world_size:
             return  # rendezvous grace: nobody is late before discovery
         now = time.monotonic()  # span-api-ok (timeout, not timing)
-        newly = [r for r, ts in self._last_seen.items()
-                 if now - ts > self.heartbeat_timeout
-                 and r not in self._declared]
-        for r in sorted(newly):
+        suspects: Dict[int, int] = {}
+        for r, ts in self._last_seen.items():
+            if r in self._declared:
+                continue
+            misses = int((now - ts) / self.heartbeat_timeout)
+            if misses > 0:
+                suspects[r] = misses
+        for r, m in suspects.items():
+            if self._suspect.get(r, 0) < 1 <= m:
+                from ..utils import tracing
+                tracing.mark(None, "peer:suspected", "fault", rank=r,
+                             misses=m, strikes=self._suspect_strikes)
+        self._suspect = suspects
+        newly = sorted(r for r, m in suspects.items()
+                       if m >= self._suspect_strikes)
+        electorate = self.world_size - len(self._declared)
+        if self._quorum_enabled and self.world_size >= 3:
+            remaining = electorate - len(newly)
+            lost = bool(newly) and remaining < electorate // 2 + 1
+            if lost != self.quorum_lost:
+                from ..utils import tracing
+                self.quorum_lost = lost
+                tracing.mark(None,
+                             "quorum:lost" if lost else "quorum:restored",
+                             "fault", rank=self.rank, remaining=remaining,
+                             electorate=electorate, gen=self.generation)
+                self._cv.notify_all()
+            if lost:
+                return  # parked: no declarations, no epoch bumps
+        for r in newly:
             self._epoch += 1
             self._declared[r] = self._epoch
+            self._suspect.pop(r, None)
         if newly:
             self._version += 1  # membership change: journal the new view
             self._cv.notify_all()
+
+    def suspected(self) -> List[int]:
+        """Ranks currently past >=1 missed heartbeat window but not yet
+        declared (the recoverable pre-death state)."""
+        with self._cv:
+            self._declare_locked()
+            return sorted(r for r in self._suspect
+                          if r not in self._declared)
+
+    def is_active(self) -> bool:
+        """True while this coordinator may legitimately serve collective
+        decisions: not closed/frozen, not abdicated to a higher
+        generation, and not parked on the minority side of a partition.
+        The partition chaos suite asserts AT MOST ONE active coordinator
+        generation exists at any time."""
+        with self._cv:
+            return not (self._closed or self._frozen or self._abdicated
+                        or self.quorum_lost)
+
+    def abdicate(self, new_generation: int) -> None:
+        """A higher coordinator generation exists (observed in a frame,
+        a vote, or a heal probe): this coordinator is stale — stop
+        serving (every op answers ``not_coordinator``/``abdicated``) so
+        its host and any lingering minority rank re-dial the real
+        coordinator and rejoin through the flap-damping path."""
+        from ..utils import tracing
+        with self._cv:
+            if self._abdicated:
+                return
+            self._abdicated = True
+            self._cv.notify_all()
+        tracing.mark(None, "coordinator:abdicated", "fault",
+                     rank=self.rank, gen=self.generation,  # srtlint: ignore[shared-state-races] (diagnostic read for the trace mark: generation is monotonic and this races nothing correctness-bearing)
+                     newer_gen=int(new_generation))
 
     def _alive_needed_locked(self) -> int:
         return max(1, self.world_size - len(self._declared))
@@ -465,7 +604,8 @@ class Coordinator:
         import base64
         meta = self._meta.get(tag)
         if meta is None:
-            meta = {"epoch": self._epoch, "dead": sorted(self._declared)}
+            meta = {"epoch": self._epoch, "dead": sorted(self._declared),
+                    "gen": self.generation}
             self._meta[tag] = meta
         rec = {"tag": tag, "kind": kind, "meta": meta}
         if kind == "allgather":
@@ -575,6 +715,7 @@ class Coordinator:
                  for r, c in self._flap_count.items()}
         return {
             "epoch": self._epoch,
+            "gen": self.generation,
             "declared": {str(r): e for r, e in self._declared.items()},
             "inc": {str(r): i for r, i in self._inc.items()},
             "peers": {str(r): list(hp) for r, hp in self._peers.items()},
@@ -609,10 +750,14 @@ class Coordinator:
         while True:
             with self._cv:
                 while not self._closed and not self._frozen \
+                        and not self._abdicated \
                         and (self._pushed >= self._version
                              or not self._standby_enabled):
                     self._cv.wait(timeout=0.5)
-                if self._closed or self._frozen:
+                if self._closed or self._frozen or self._abdicated:
+                    # an abdicated coordinator must not keep streaming
+                    # its STALE journal over the active generation's
+                    # standby copy
                     return
                 ver = self._version
                 standby = self._standby_locked()
@@ -630,9 +775,13 @@ class Coordinator:
         connection; one fresh re-dial).  Failure is tolerated — the
         standby may itself be dying; the next version retries, and
         `_await_push_locked` bounds how long replies can wait on it."""
+        from ..faults.netfabric import FABRIC
         for fresh in (False, True):
             sock = self._push_sock
             try:
+                # the journal stream rides a real link: a partition
+                # between coordinator and standby cuts replication too
+                FABRIC.check_send(self.rank, standby, what="journal push")
                 if sock is None or self._push_rank != standby or fresh:
                     if sock is not None:
                         try:
@@ -645,7 +794,7 @@ class Coordinator:
                                                     timeout=2.0)
                     sock.settimeout(2.0)
                     self._push_sock, self._push_rank = sock, standby
-                _send(sock, {"op": "journal"}, blob)
+                _send(sock, {"op": "journal", "rank": self.rank}, blob)
                 msg, _ = _recv(sock)
                 if msg.get("ok"):
                     return True
@@ -670,6 +819,7 @@ class Coordinator:
         with self._cv:
             j = journal or {}
             self._epoch = max(self._epoch, int(j.get("epoch", 0)))
+            self.generation = max(self.generation, int(j.get("gen", 1)))
             self._declared = {int(r): int(e)
                               for r, e in (j.get("declared") or {}).items()}
             self._inc = {int(r): int(i)
@@ -739,11 +889,57 @@ class Coordinator:
                     "dead": sorted(self._declared)}
         return None
 
+    def handle(self, msg: dict, blob: bytes) -> Tuple[dict, bytes]:
+        """Dedup-wrapped dispatch — the entry every serve loop uses.
+        A frame whose (rank, inc, req) was already answered replays the
+        recorded reply byte-identically: duplicated and reordered
+        delivery is idempotent by construction."""
+        rank = int(msg.get("rank", -1))
+        boot = str(msg.get("boot", ""))
+        req = msg.get("req")
+        hit = self._reqj.replay(rank, boot, req)
+        if hit is not None:
+            from ..utils.metrics import QueryStats
+            QueryStats.get().frames_deduped += 1
+            return hit
+        reply, rblob = self._handle(msg, blob)
+        self._reqj.record(rank, boot, req, reply, rblob)
+        return reply, rblob
+
     def _handle(self, msg: dict, blob: bytes) -> Tuple[dict, bytes]:
         op = msg["op"]
         rank = int(msg.get("rank", -1))
+        # generation fence: a frame stamped with a HIGHER coordinator
+        # generation proves a successor was promoted while we were
+        # partitioned away — this coordinator is stale and must stop
+        # serving, not answer with divergent epochs
+        peer_gen = int(msg.get("gen", 0))
+        if peer_gen > self.generation:
+            self.abdicate(peer_gen)
         with self._cv:
+            if self._abdicated:
+                return {"error": f"coordinator generation "
+                                 f"{self.generation} abdicated (a newer "
+                                 f"generation exists): re-dial the "
+                                 f"current coordinator",
+                        "not_coordinator": True, "abdicated": True,
+                        "gen": self.generation}, b""
             self._declare_locked()
+            if self.quorum_lost and (
+                    op in ("barrier", "allgather")
+                    or (op == "register"
+                        and (rank in self._declared
+                             or rank in self._peers))):
+                # parked minority coordinator: collectives (and
+                # re-registers, which would bump the epoch) answer
+                # typed instead of serving divergent membership — zero
+                # epoch churn while parked
+                return {"error": f"coordinator parked: only a minority "
+                                 f"of the last-agreed alive set is "
+                                 f"reachable (suspected: "
+                                 f"{sorted(self._suspect)})",
+                        "quorum_lost": True, "epoch": self._epoch,
+                        "gen": self.generation}, b""
             if op == "register":
                 if rank in self._declared or rank in self._peers:
                     # flap damping FIRST: a crash-looping rank gets a
@@ -770,6 +966,7 @@ class Coordinator:
                                   for r, hp in self._peers.items()},
                         "inc": self._inc.get(rank, 0),
                         "epoch": self._epoch,
+                        "gen": self.generation,
                         "dead": sorted(self._declared)}, b""
             rejected = self._fence_locked(op, rank, msg)
             if rejected is not None:
@@ -813,10 +1010,14 @@ class Coordinator:
                         **rec["meta"]}, b"".join(parts)
             if op == "heartbeat":
                 return {"dead": sorted(self._declared),
-                        "epoch": self._epoch}, b""
+                        "epoch": self._epoch,
+                        "gen": self.generation,
+                        "quorum_lost": self.quorum_lost}, b""
             if op == "members":
                 return {"dead": sorted(self._declared),
                         "epoch": self._epoch,
+                        "gen": self.generation,
+                        "quorum_lost": self.quorum_lost,
                         "peers": sorted(self._peers)}, b""
             raise ValueError(f"unknown coordinator op {op!r}")
 
@@ -860,11 +1061,64 @@ _COORD_OPS = ("register", "barrier", "allgather", "heartbeat", "members")
 # THE canonical collective-op vocabulary: the coordinator control ops
 # above, plus the peer-server data-plane ops (``fetch`` pulls shuffle
 # partition frames, ``journal`` streams the membership journal to the
-# failover standby).  srtlint's protocol-conformance pass keeps every
-# ``{"op": ...}`` frame built and every dispatch site two-way
-# exhaustive against this list (kept a literal so the pass can read it).
+# failover standby, ``vote`` answers a connectivity poll during
+# quorum-fenced failover and heal probing).  srtlint's
+# protocol-conformance pass keeps every ``{"op": ...}`` frame built and
+# every dispatch site two-way exhaustive against this list (kept a
+# literal so the pass can read it).
 DCN_OPS = ("register", "barrier", "allgather", "heartbeat", "members",
-           "journal", "fetch")
+           "journal", "fetch", "vote")
+
+
+class _ReqJournal:
+    """Per-(rank, incarnation) replay journal of recent request replies
+    — the dedup layer that makes duplicated and reordered frame
+    delivery idempotent.  Every DCN frame carries a monotonic per-rank
+    ``req`` id; a frame whose id was already answered REPLAYS the
+    recorded reply byte-identically instead of re-applying effects (a
+    duplicated ``register`` must not bump the incarnation twice or
+    count as a membership flap).  Bounded to the last ``keep`` replies
+    per sender — re-processing an EVICTED old id is only reachable for
+    idempotent ops (fetch re-reads a file, barrier tags replay from the
+    coordinator's completed-tag journal).  Keyed by (rank, BOOT nonce):
+    the nonce is minted per ProcessGroup instance, so a restarted
+    rank's fresh id stream can never collide with its previous life's
+    journal entries (its very first register must re-apply, not
+    replay)."""
+
+    KEEP = 8
+
+    def __init__(self, keep: int = KEEP):
+        self._lock = threading.Lock()
+        self._keep = keep
+        # (rank, boot) -> {req: (reply, blob)} + insertion order
+        self._journal: Dict[Tuple[int, str], Dict[int, tuple]] = {}
+        self._order: Dict[Tuple[int, str], List[int]] = {}
+        self.replayed = 0
+
+    def replay(self, rank: int, boot: str,
+               req: Optional[int]) -> Optional[tuple]:
+        if req is None or rank < 0 or not boot:
+            return None
+        with self._lock:
+            hit = self._journal.get((rank, boot), {}).get(int(req))
+            if hit is not None:
+                self.replayed += 1
+            return hit
+
+    def record(self, rank: int, boot: str, req: Optional[int],
+               reply: dict, blob: bytes) -> None:
+        if req is None or rank < 0 or not boot:
+            return
+        with self._lock:
+            key = (rank, boot)
+            j = self._journal.setdefault(key, {})
+            order = self._order.setdefault(key, [])
+            if int(req) not in j:
+                order.append(int(req))
+            j[int(req)] = (reply, blob)
+            while len(order) > self._keep:
+                j.pop(order.pop(0), None)
 
 
 class _PeerServer:
@@ -895,6 +1149,14 @@ class _PeerServer:
         self._held: List[socket.socket] = []  # frozen conns, kept open
         self.epoch = 0
         self.fencing = True
+        # identity + back-reference set by the owning ProcessGroup: the
+        # link-fault fabric keys on (src rank, dst rank), and the
+        # ``vote`` op answers from the owner's coordinator-contact view
+        self.rank = -1
+        self.owner: Optional["ProcessGroup"] = None
+        # delivery hardening: duplicated/reordered fetches replay their
+        # recorded reply (payload included) instead of re-reading
+        self._reqj = _ReqJournal(keep=4)
         # coordinator-failover state: the journal the coordinator pushed
         # here (this rank is the standby) and, after promotion, the
         # coordinator this server fronts
@@ -958,7 +1220,9 @@ class _PeerServer:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
+        from ..faults.netfabric import FABRIC
         keep_open = False
+        prev: Optional[Tuple[dict, bytes]] = None
         try:
             while True:
                 msg, blob = _recv(conn)
@@ -969,69 +1233,117 @@ class _PeerServer:
                         self._held.append(conn)
                         keep_open = True
                         return
-                    d = self._registry.get(msg.get("shuffle"))
-                    coord = self.coordinator
-                op = msg.get("op")
-                if op == "journal":
-                    # the coordinator streaming its membership journal
-                    # to this rank (the standby): hold the latest copy
-                    # for a possible promotion
-                    try:
-                        j = json.loads(blob.decode()) if blob else None
-                    except ValueError as e:
-                        _send(conn, {"error": f"bad journal: {e}"})
+                src = int(msg.get("rank", -1))
+                # fabric delivery expansion: a duplicated frame is
+                # processed twice, a reordered one re-delivers the
+                # connection's previous frame first — the dedup
+                # journals make both idempotent
+                for m, b, send_reply in FABRIC.deliveries(
+                        src, self.rank, msg, blob, prev=prev):
+                    reply, rblob = self._handle_one(m, b)
+                    if not send_reply:
                         continue
-                    with self._lock:
-                        self.journal = j
-                    _send(conn, {"ok": True})
-                    continue
-                if op in _COORD_OPS:
-                    if coord is None:
-                        _send(conn, {"error":
-                                     f"this rank is not the coordinator "
-                                     f"(op {op!r})",
-                                     "not_coordinator": True})
-                        continue
-                    # control ops may PARK (barrier waits) — each
-                    # requester holds its own connection/thread, exactly
-                    # like the standalone coordinator server
-                    try:
-                        reply, rblob = coord._handle(msg, blob)
-                    except Exception as e:
-                        reply, rblob = {"error": str(e)}, b""
+                    # asymmetric cut: the reply direction is its own
+                    # link — the request arrived, the answer may not
+                    FABRIC.check_send(self.rank, src,
+                                      what=f"reply {m.get('op')!r}")
                     _send(conn, reply, rblob)
-                    continue
-                if op != "fetch":
-                    _send(conn, {"error": f"unknown op {msg['op']!r}"})
-                    continue
-                from ..faults.injector import INJECTOR
-                if INJECTOR.maybe_fire("dcn.slow_peer",
-                                       desc=f"part-{msg.get('part')}"):
-                    # gray straggler: answer, but late — detection is
-                    # the requester's hedging problem, not a heartbeat
-                    # timeout (this rank is alive and will reply)
-                    time.sleep(self.slow_inject_s)
-                if self.fencing \
-                        and int(msg.get("epoch", self.epoch)) < self.epoch:
-                    _send(conn, {"error":
-                                 f"stale epoch {msg.get('epoch')} < "
-                                 f"{self.epoch}", "stale_epoch": True})
-                    continue
-                if d is None:
-                    _send(conn, {"error":
-                                 f"unknown shuffle {msg['shuffle']!r}"})
-                    continue
-                path = os.path.join(d, f"part-{int(msg['part']):05d}.bin")
-                payload = b""
-                if os.path.exists(path):
-                    with open(path, "rb") as f:
-                        payload = f.read()
-                _send(conn, {"ok": True}, payload)
+                prev = (msg, blob)
         except (ConnectionError, OSError):
             pass
         finally:
             if not keep_open:
                 conn.close()
+
+    def _vote_reply(self, msg: dict) -> dict:
+        """The quorum-failover connectivity poll: report this rank's
+        view of the coordinator — who it is (rank + generation) and
+        whether this rank reached it within the liveness horizon.  A
+        requester stamped with a NEWER generation proves any
+        coordinator attached here stale (abdicate)."""
+        with self._lock:
+            coord = self.coordinator
+        o = self.owner
+        if o is None:
+            return {"error": "peer server not attached to a rank yet",
+                    "not_coordinator": True}
+        peer_gen = int(msg.get("gen", 0))
+        if coord is not None and peer_gen > coord.generation:
+            coord.abdicate(peer_gen)
+        return {"rank": self.rank,
+                "coord_rank": o.coord_rank,
+                "gen": o.coord_gen,
+                "epoch": o.epoch,
+                "coord_ok": o.coord_reachable(),
+                "quorum_lost": o.quorum_lost}
+
+    def _handle_one(self, msg: dict, blob: bytes) -> Tuple[dict, bytes]:
+        with self._lock:
+            d = self._registry.get(msg.get("shuffle"))
+            coord = self.coordinator
+        op = msg.get("op")
+        if op == "journal":
+            # the coordinator streaming its membership journal to this
+            # rank (the standby): hold the latest copy for a possible
+            # promotion
+            try:
+                j = json.loads(blob.decode()) if blob else None
+            except ValueError as e:
+                return {"error": f"bad journal: {e}"}, b""
+            with self._lock:
+                self.journal = j
+            return {"ok": True}, b""
+        if op == "vote":
+            return self._vote_reply(msg), b""
+        if op in _COORD_OPS:
+            if coord is None:
+                return {"error": f"this rank is not the coordinator "
+                                 f"(op {op!r})",
+                        "not_coordinator": True}, b""
+            # control ops may PARK (barrier waits) — each requester
+            # holds its own connection/thread, exactly like the
+            # standalone coordinator server
+            try:
+                return coord.handle(msg, blob)
+            except Exception as e:
+                return {"error": str(e)}, b""
+        if op != "fetch":
+            return {"error": f"unknown op {msg['op']!r}"}, b""
+        # fetch: replay a duplicated request's recorded reply (payload
+        # included) so dup delivery neither re-reads nor re-fires the
+        # slow-peer injection
+        rank = int(msg.get("rank", -1))
+        boot = str(msg.get("boot", ""))
+        req = msg.get("req")
+        hit = self._reqj.replay(rank, boot, req)
+        if hit is not None:
+            from ..utils.metrics import QueryStats
+            QueryStats.get().frames_deduped += 1
+            return hit
+        from ..faults.injector import INJECTOR
+        if INJECTOR.maybe_fire("dcn.slow_peer",
+                               desc=f"part-{msg.get('part')}"):
+            # gray straggler: answer, but late — detection is the
+            # requester's hedging problem, not a heartbeat timeout
+            # (this rank is alive and will reply)
+            time.sleep(self.slow_inject_s)
+        if self.fencing \
+                and int(msg.get("epoch", self.epoch)) < self.epoch:
+            reply: Tuple[dict, bytes] = (
+                {"error": f"stale epoch {msg.get('epoch')} < "
+                          f"{self.epoch}", "stale_epoch": True}, b"")
+        elif d is None:
+            reply = ({"error": f"unknown shuffle {msg['shuffle']!r}"},
+                     b"")
+        else:
+            path = os.path.join(d, f"part-{int(msg['part']):05d}.bin")
+            payload = b""
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    payload = f.read()
+            reply = ({"ok": True}, payload)
+        self._reqj.record(rank, boot, req, reply[0], reply[1])
+        return reply
 
     def close(self) -> None:
         self._closed = True
@@ -1078,6 +1390,11 @@ class ProcessGroup:
         self.coordinator_addr = coordinator_addr
         self._server = _PeerServer(bind_host=listen_host)
         self._server.fencing = conf["spark.rapids.tpu.dcn.epoch.fencing"]
+        # identity for the link-fault fabric (keyed on (src, dst) rank)
+        # and the back-reference the ``vote`` op answers from
+        self._server.rank = rank
+        self._server.owner = self
+        self._advertise = advertise_host or listen_host
         self._tag_n = 0
         self._shuffle_n = 0
         self._dead: List[int] = []
@@ -1122,6 +1439,28 @@ class ProcessGroup:
             "spark.rapids.tpu.dcn.coordinator.standby"]
         self._fo_lock = threading.Lock()
         self._fo_gen = 0
+        # quorum-fenced failover (dcn.quorum.*): the COORDINATOR
+        # generation this rank is attached to (monotonic, absorbed from
+        # replies; promotions mint gen+1), whether this rank is parked
+        # on the minority side of a partition, when its last successful
+        # coordinator contact happened (the observation `vote` replies
+        # answer from), and the deferral the heal loop serves when a
+        # rejoin was flap-damped
+        self.coord_gen = 0
+        self.quorum_lost = False
+        self._quorum_enabled = conf["spark.rapids.tpu.dcn.quorum.enabled"]
+        self._quorum_window_s = conf[
+            "spark.rapids.tpu.dcn.quorum.windowMs"] / 1000.0
+        self._last_coord_ok = time.monotonic()  # span-api-ok (liveness observation, not timing)
+        self._heal_defer_until = 0.0
+        # monotonic per-request ids: every frame this rank sends carries
+        # one, keying the receivers' dedup journals (duplicated and
+        # reordered delivery replays instead of re-applying).  The boot
+        # nonce scopes the id stream to THIS instance — a restarted
+        # rank must never replay its previous life's journal entries
+        self._req_lock = threading.Lock()
+        self._req_n = 0
+        self._boot = uuid.uuid4().hex[:12]
         # heartbeat replies are always prompt, so the hb socket carries
         # a recv timeout — a FROZEN (silently dead) coordinator surfaces
         # as a liveness failure here instead of hanging forever
@@ -1154,6 +1493,7 @@ class ProcessGroup:
                     retry_after_ms=int(msg.get("retry_after_ms", 0)))
             raise PeerFailedError(f"register failed: {msg['error']}")
         self.inc = int(msg.get("inc", 0))
+        self.coord_gen = max(self.coord_gen, int(msg.get("gen", 1)))
         self.peers: Dict[int, Tuple[str, int]] = {
             int(r): (h, int(p)) for r, (h, p) in msg["peers"].items()}
         self._hb = threading.Thread(target=self._heartbeat_loop,  # ctx-ok (rank-lifetime liveness thread)
@@ -1180,40 +1520,104 @@ class ProcessGroup:
 
     def _absorb_membership(self, msg: dict) -> None:
         """Fold a coordinator reply's membership view into this rank's:
-        the epoch is monotonic, and declared-dead ranks stay dead until
-        a re-register bumps the epoch past our view.  An epoch ADVANCE
-        is a membership event: subscribers (the scheduler's brownout
-        controller) learn the new alive/world shape."""
+        the epoch and coordinator generation are monotonic, and
+        declared-dead ranks stay dead until a re-register bumps the
+        epoch past our view.  An epoch ADVANCE is a membership event:
+        subscribers (the scheduler's brownout controller) learn the new
+        alive/world shape.  Every absorbed reply stamps the
+        coordinator-contact clock `vote` replies answer from."""
         e = int(msg.get("epoch", 0))
         advanced = e > self.epoch
         if advanced:
             self.epoch = e  # srtlint: ignore[shared-state-races] (monotonic absorb: a racy interleave can only transiently regress the epoch, and every stale frame is fenced server-side into a resync that re-absorbs)
             self._server.epoch = e
+        g = int(msg.get("gen", 0))
+        if g > self.coord_gen:  # srtlint: ignore[shared-state-races] (monotonic absorb observe: a racy interleave can only transiently regress, and the generation fence re-teaches on the next reply)
+            self.coord_gen = g  # srtlint: ignore[shared-state-races] (monotonic absorb, same contract as the epoch above)
         if "dead" in msg:
-            self._dead = sorted(set(self._dead)  # srtlint: ignore[shared-state-races] (advisory merge: a lost union re-converges on the next heartbeat/membership reply, and fetches to a missed-dead peer fail typed into the durable re-pull anyway)
-                                | {int(r) for r in msg["dead"]})
+            if advanced:
+                # a strictly newer epoch is an AUTHORITATIVE view:
+                # replace, so a declared-then-rejoined rank comes back
+                # from the dead here too (fetches resume against it)
+                self._dead = sorted({int(r) for r in msg["dead"]})  # srtlint: ignore[shared-state-races] (authoritative replace at an epoch advance; a racing union re-converges on the next reply)
+            else:
+                self._dead = sorted(set(self._dead)  # srtlint: ignore[shared-state-races] (advisory merge: a lost union re-converges on the next heartbeat/membership reply, and fetches to a missed-dead peer fail typed into the durable re-pull anyway)
+                                    | {int(r) for r in msg["dead"]})
+        self._last_coord_ok = time.monotonic()  # span-api-ok (liveness observation, not timing)  # srtlint: ignore[shared-state-races] (monotonic stamp: any writer moves it forward; a stale read only makes a vote conservatively pessimistic for one poll)
         if advanced:
             _notify_membership(self.world_size - len(self._dead),
                                self.world_size, e)
 
+    def _next_req(self) -> int:
+        with self._req_lock:
+            self._req_n += 1
+            return self._req_n
+
+    def coord_reachable(self) -> bool:
+        """This rank's vote: did it reach the coordinator within the
+        liveness horizon?  Purely observational (no probe) so answering
+        a vote is cheap even mid-partition."""
+        if self.quorum_lost or self.coordinator_lost or self.fenced or self._closed:  # srtlint: ignore[shared-state-races] (observational latch reads for a VOTE reply: a stale value only makes one poll's vote conservatively wrong, and the voter re-polls on its backoff curve)
+            return False
+        age = time.monotonic() - self._last_coord_ok  # span-api-ok (liveness observation, not timing)
+        return age <= max(self._hb_timeout, 2.0 * self._hb_recv_timeout)
+
+    def _enter_quorum_lost(self, reason: str, reached: int = 1) -> None:
+        """Park this rank: it is on the minority side of a partition
+        (its own vote poll failed quorum, or its coordinator reported
+        itself parked).  Queries fail typed + resubmittable; the
+        membership listeners learn the shrunken view (brownout); the
+        heartbeat thread switches to the heal loop."""
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        if self.quorum_lost:
+            return
+        self.quorum_lost = True  # srtlint: ignore[shared-state-races] (one-way latch until the heal loop clears it under _fo_lock; a stale False just delays the typed park by one call)
+        QueryStats.get().quorum_losses += 1
+        tracing.mark(None, "quorum:lost", "fault", rank=self.rank,
+                     reason=reason, reached=reached, epoch=self.epoch,
+                     gen=self.coord_gen)  # srtlint: ignore[shared-state-races] (diagnostic read for the trace mark; monotonic value, nothing correctness-bearing races on it)
+        _notify_membership(max(1, reached), self.world_size, self.epoch)
+
     def _request(self, obj: dict, blob: bytes = b"",
                  _retried: bool = False) -> Tuple[dict, bytes]:
-        failovers = 0
+        from ..faults.netfabric import FABRIC
+        if self.quorum_lost:
+            # parked minority rank: fail fast and typed — resubmittable
+            # after the heal loop rejoins, never a hang
+            raise QuorumLostError(
+                f"rank {self.rank} parked on the minority side of a "
+                f"partition (op {obj.get('op')!r}); resubmit after the "
+                f"partition heals")
+        failovers = redials = 0
         while True:
             framed = {**obj, "rank": self.rank, "epoch": self.epoch,
-                      "inc": self.inc}
+                      "inc": self.inc, "gen": self.coord_gen,
+                      "req": self._next_req(), "boot": self._boot}
             gen = self._fo_gen  # srtlint: ignore[shared-state-races] (the observe half of observe-then-recheck: _failover re-validates the generation under _fo_lock, so a stale observation just retries)
             try:
+                # the link-fault fabric gates the send OUTSIDE the ctrl
+                # lock (a cut raises typed; a programmed delay sleeps)
+                FABRIC.check_send(self.rank, self.coord_rank,  # srtlint: ignore[shared-state-races] (a stale coord_rank only keys one fabric check at the just-replaced link; the send then fails or succeeds on the REAL socket and the loop re-reads)
+                                  what=f"ctrl {obj.get('op')!r}")
                 with self._ctrl_lock:
                     _send(self._ctrl, framed, blob)  # srtlint: ignore[lock-discipline, shared-state-races] (the ctrl lock IS this socket's request/reply serializer and nothing nests under it; failover swaps self._ctrl then shutdown-closes the old socket, so a stale read fails typed and re-enters _failover)
-                    msg, payload = _recv(self._ctrl)  # srtlint: ignore[lock-discipline] (reply waits are bounded by the coordinator's waitTimeout replies and close()-on-death, never another lock)
+                    msg, payload = _recv(self._ctrl)  # srtlint: ignore[lock-discipline, shared-state-races] (reply waits are bounded by the coordinator's waitTimeout replies and close()-on-death, never another lock; failover swaps the socket then shutdown-closes the old one, so a stale read fails typed and re-enters the failover path)
             except (ConnectionError, OSError) as e:
-                # coordinator gone: fail over to the deterministic
-                # successor (raises CoordinatorUnrecoverableError —
-                # typed, permanent — when no standby can exist) and
-                # re-send this same frame there; completed collectives
-                # replay from the successor's journal, in-flight ones
-                # re-form as every survivor re-sends
+                if self._fo_gen != gen:  # srtlint: ignore[shared-state-races] (observe-then-recheck: a concurrent failover already swapped the socket — re-send on the new one)
+                    continue
+                # one dropped frame / TCP reset is NOT coordinator
+                # death: re-dial the SAME coordinator first — a
+                # transient link blip (the dcn.partition point)
+                # recovers here without electing anybody.  Only a
+                # coordinator that cannot be re-dialed enters the
+                # QUORUM-FENCED failover below: promotion needs
+                # connectivity votes from a strict majority of the
+                # last-agreed alive set, and a minority-side rank parks
+                # typed (QuorumLostError) instead of promoting.
+                redials += 1
+                if redials <= 2 and self._redial_ctrl():
+                    continue
                 failovers += 1
                 if failovers > self.world_size + 1:
                     raise CoordinatorLostError(
@@ -1237,6 +1641,14 @@ class ProcessGroup:
                     f"coordinator"))
                 continue
             self._absorb_membership(msg)
+            if msg.get("quorum_lost"):
+                # the coordinator itself is parked on the minority side
+                # of a partition: this rank parks with it, typed
+                self._enter_quorum_lost(
+                    "coordinator parked (minority side)")
+                raise QuorumLostError(
+                    f"{obj.get('op')}: coordinator parked quorum-lost: "
+                    f"{msg.get('error', '')}")
             if msg.get("stale_epoch") and not _retried:
                 # our epoch lagged a membership change: resync (absorbed
                 # above) and re-send the same frame once at the new epoch
@@ -1249,6 +1661,155 @@ class ProcessGroup:
             return msg, payload
 
     # -- coordinator failover ------------------------------------------------------
+    def _redial_ctrl(self) -> bool:
+        """One bounded attempt to re-dial the CURRENT coordinator after
+        a connection-level failure: probes with a time-limited
+        ``members`` request (a frozen coordinator accepts but never
+        answers — the recv timeout converts that into failure) and, on
+        success, swaps the ctrl socket in.  True = the coordinator is
+        fine (it was a link blip / TCP reset); False = enter failover."""
+        from ..faults.netfabric import FABRIC
+        sock = None
+        dialed = tuple(self.coordinator_addr)  # srtlint: ignore[shared-state-races] (the observe half of observe-then-recheck: the swap below re-validates the address under _fo_lock and discards this dial when a failover moved the coordinator)
+        try:
+            FABRIC.check_connect(self.rank, self.coord_rank,  # srtlint: ignore[shared-state-races] (a stale coord_rank only keys one fabric check; the address re-validates under _fo_lock before the swap)
+                                 what="ctrl re-dial")
+            sock = socket.create_connection(
+                dialed, timeout=min(2.0, self._fetch_timeout))
+            sock.settimeout(self._hb_recv_timeout)
+            _send(sock, {"op": "members", "rank": self.rank,
+                         "epoch": self.epoch, "inc": self.inc,
+                         "gen": self.coord_gen, "req": self._next_req(), "boot": self._boot})
+            msg, _ = _recv(sock)
+        except (ConnectionError, socket.timeout, OSError):
+            _shutdown_close(sock)
+            return False
+        if msg.get("not_coordinator") or msg.get("abdicated"):
+            _shutdown_close(sock)
+            return False
+        if msg.get("fenced"):
+            self.fenced = True  # srtlint: ignore[shared-state-races] (one-way latch, same contract as the other fenced sites)
+            _shutdown_close(sock)
+            raise PeerLostError(
+                f"rank {self.rank} fenced during ctrl re-dial: "
+                f"{msg.get('error')}")
+        self._absorb_membership(msg)
+        sock.settimeout(None)  # collective parks are legitimate
+        with self._fo_lock:
+            if tuple(self.coordinator_addr) != dialed:
+                # a concurrent failover moved the coordinator while we
+                # probed the old one: keep ITS sockets, drop ours
+                _shutdown_close(sock)
+                return True
+            old = self._ctrl
+            self._ctrl = sock
+        _shutdown_close(old)
+        return True
+
+    def _poll_vote(self, r: int) -> Optional[dict]:
+        """One connectivity-vote poll of rank ``r``'s peer server.
+        None when the peer is unreachable (which is itself evidence —
+        the tally counts it as neither a reach nor an unreach vote, so
+        a cut-off rank cannot manufacture quorum)."""
+        from ..faults.netfabric import FABRIC
+        addr = self.peers.get(r)
+        if addr is None:
+            return None
+        try:
+            FABRIC.check_connect(self.rank, r, what="vote")
+            with socket.create_connection(
+                    tuple(addr),
+                    timeout=min(1.5, self._fetch_timeout)) as s:
+                s.settimeout(min(1.5, self._fetch_timeout))
+                _send(s, {"op": "vote", "rank": self.rank,
+                          "inc": self.inc, "epoch": self.epoch,
+                          "gen": self.coord_gen,
+                          "req": self._next_req(), "boot": self._boot})
+                v, _ = _recv(s)
+        except (ConnectionError, socket.timeout, OSError):
+            return None
+        return None if "error" in v else v
+
+    def _quorum_gate_locked(self, old_coord: int,
+                            cause: BaseException
+                            ) -> Optional[Tuple[int, int]]:
+        """The quorum fence in front of successor promotion: poll
+        connectivity votes (the ``vote`` op) from the last-agreed alive
+        set minus the presumed-dead coordinator host, until a strict
+        majority agrees the coordinator is unreachable.
+
+        Returns None to PROCEED with the deterministic-successor
+        failover, or ``(coord_rank, gen)`` when a vote reveals a
+        coordinator of a HIGHER generation already exists (a raced
+        failover — adopt it instead of promoting a third).  Raises
+        :class:`QuorumLostError` when the window expires without quorum
+        (we cannot reach a majority — we ARE the minority) or a
+        majority reports the coordinator fine (OUR link is the fault).
+
+        A 2-rank electorate degenerates to self-vote-only — no quorum
+        exists at world 2, so those groups stay fail-stop-biased
+        (documented)."""
+        from ..utils import tracing
+        if not self._quorum_enabled:
+            return None
+        electorate = [r for r in range(self.world_size)
+                      if r not in self._dead and r != old_coord]
+        need = len(electorate) // 2 + 1
+        if need <= 1:
+            return None  # nobody else to ask: fail-stop semantics
+        deadline = time.monotonic() + self._quorum_window_s  # span-api-ok (timeout, not timing)
+        delays = backoff_delays(None)
+        reach = unreach = reached_peers = 0
+        while True:
+            reach, unreach, reached_peers = 0, 1, 0  # self votes unreachable
+            for r in electorate:
+                if r == self.rank:
+                    continue
+                v = self._poll_vote(r)  # srtlint: ignore[lock-discipline] (the failover lock IS the takeover serializer — every observer of the dead coordinator parks here until the quorum verdict, exactly like the successor dial below)
+                if v is None:
+                    continue
+                reached_peers += 1
+                v_gen = int(v.get("gen", 0))
+                v_coord = int(v.get("coord_rank", -1))
+                if v_gen > self.coord_gen and v_coord != old_coord \
+                        and v_coord != self.rank and v_coord >= 0:
+                    # a newer coordinator generation already exists:
+                    # adopt it instead of promoting a competitor
+                    return v_coord, v_gen
+                if v.get("coord_ok"):
+                    reach += 1
+                else:
+                    unreach += 1
+            if unreach >= need:
+                tracing.mark(None, "quorum:granted", "fault",
+                             rank=self.rank, unreachable_votes=unreach,
+                             electorate=len(electorate),
+                             old_coord=old_coord)
+                return None
+            if reach >= need:
+                # a strict majority can still reach the coordinator:
+                # the fault is OUR link, not the coordinator — park
+                self._enter_quorum_lost(
+                    "majority reports coordinator reachable (local "
+                    "link partitioned)", reached=1 + reached_peers)
+                raise QuorumLostError(
+                    f"rank {self.rank}: {reach}/{len(electorate)} voters "
+                    f"still reach the coordinator — local link "
+                    f"partitioned, parking instead of promoting"
+                ) from cause
+            if time.monotonic() > deadline:  # span-api-ok (timeout, not timing)
+                self._enter_quorum_lost(
+                    "no connectivity quorum within dcn.quorum.windowMs",
+                    reached=1 + reached_peers)
+                raise QuorumLostError(
+                    f"rank {self.rank}: no quorum for coordinator "
+                    f"failover ({unreach}/{need} unreachable votes, "
+                    f"{reached_peers} peers reachable of "
+                    f"{len(electorate) - 1}) — minority side of a "
+                    f"partition, parking instead of promoting"
+                ) from cause
+            time.sleep(min(0.3, next(delays)))  # fault-ok (bounded vote-poll cadence inside the failover driver itself)
+
     def _successor_locked(self) -> Optional[int]:
         """The deterministic successor: the next-lowest alive rank —
         excluding every declared-dead rank AND the rank hosting the
@@ -1267,9 +1828,16 @@ class ProcessGroup:
         Exactly one observer of a coordinator failure performs the
         takeover switch (the generation counter dedups concurrent
         observers — a heartbeat thread and a parked collective both see
-        the dead socket).  When the successor is THIS rank, it promotes
-        first: a Coordinator restored from the journal the old one
-        streamed here attaches to the peer server.  Raises
+        the dead socket).  QUORUM-FENCED: promotion happens only after
+        connectivity votes from a strict majority of the last-agreed
+        alive set confirm the coordinator unreachable
+        (:meth:`_quorum_gate_locked`) — a minority-side rank parks with
+        :class:`QuorumLostError` instead of electing a second
+        coordinator, and a vote revealing a HIGHER coordinator
+        generation is adopted instead of promoted over.  When the
+        successor is THIS rank, it promotes first: a Coordinator
+        restored from the journal the old one streamed here attaches to
+        the peer server, minting generation+1.  Raises
         :class:`CoordinatorUnrecoverableError` (typed, permanent,
         resubmittable) when no successor can exist — world <= 1
         survivor, standby disabled — or takeover never completes within
@@ -1279,6 +1847,12 @@ class ProcessGroup:
         with self._fo_lock:
             if self._fo_gen != observed_gen:
                 return  # another observer already switched; just retry
+            if self.quorum_lost:
+                # already parked: a second observer must not serve
+                # another full vote window — fail typed immediately
+                raise QuorumLostError(
+                    f"rank {self.rank} parked on the minority side of "
+                    f"a partition") from cause
             if self._closed or self.fenced:
                 raise CoordinatorUnrecoverableError(
                     f"rank {self.rank} closed/fenced during coordinator "
@@ -1299,7 +1873,13 @@ class ProcessGroup:
                     f"(world <= 1 survivor; dead={self._dead}): "
                     f"{type(cause).__name__}: {cause}") from cause
             old_coord = self.coord_rank
-            if succ == self.rank:
+            # the quorum fence: proceed (None), adopt a discovered
+            # newer-generation coordinator, or raise QuorumLostError
+            # (minority side — park, do not promote)
+            adopted = self._quorum_gate_locked(old_coord, cause)  # srtlint: ignore[lock-discipline] (the failover lock IS the takeover serializer: every observer of the dead coordinator parks here until the quorum verdict + successor dial complete; nothing else ever nests under it)
+            if adopted is not None:
+                succ = adopted[0]
+            elif succ == self.rank:
                 self._promote_locked(old_coord)
             addr = tuple(self.peers[succ])
             ctrl = self._dial_successor(addr, succ, cause)  # srtlint: ignore[lock-discipline] (the failover lock IS the takeover serializer: every other observer of the dead coordinator must park until the successor dial completes; nothing else ever nests under it)
@@ -1320,13 +1900,19 @@ class ProcessGroup:
             self._ctrl, self._hb_sock = ctrl, hb
             self.coordinator_addr = addr
             self.coord_rank = succ
-            # the old coordinator's rank is gone with it: treat its data
-            # plane as dead so fetches fast-fail to durable re-pulls
-            self._dead = sorted(set(self._dead) | {old_coord})
+            if adopted is not None:
+                self.coord_gen = max(self.coord_gen, adopted[1])
+            if adopted is None:
+                # the old coordinator's rank is gone with it: treat its
+                # data plane as dead so fetches fast-fail to durable
+                # re-pulls.  (The ADOPT path skips this — the newer
+                # coordinator's authoritative dead list absorbs in.)
+                self._dead = sorted(set(self._dead) | {old_coord})
             self._fo_gen += 1
         QueryStats.get().coordinator_failovers += 1
         tracing.mark(None, "coordinator:failover", "fault",
                      successor=succ, old=old_coord, epoch=self.epoch,
+                     gen=self.coord_gen, adopted=adopted is not None,
                      promoted=succ == self.rank)
         # shutdown+close wakes any thread still parked in recv on the
         # OLD sockets; it re-enters _failover, sees the advanced
@@ -1339,16 +1925,20 @@ class ProcessGroup:
         """Dial + probe the successor until it serves coordinator ops
         (it may not have detected the death yet), bounded by the
         promote window; absorbs the probe reply's membership view."""
+        from ..faults.netfabric import FABRIC
         deadline = time.monotonic() + max(5.0, 4 * self._fetch_timeout)  # span-api-ok (timeout, not timing)
         delays = backoff_delays(None)
         while True:
             ctrl = None
             try:
+                FABRIC.check_connect(self.rank, succ, what="successor")
                 ctrl = socket.create_connection(
                     addr, timeout=self._fetch_timeout)
                 ctrl.settimeout(self._fetch_timeout)
                 _send(ctrl, {"op": "members", "rank": self.rank,
-                             "epoch": self.epoch, "inc": self.inc})
+                             "epoch": self.epoch, "inc": self.inc,
+                             "gen": self.coord_gen,
+                             "req": self._next_req(), "boot": self._boot})
                 msg, _ = _recv(ctrl)
                 if msg.get("not_coordinator"):
                     raise ConnectionError(
@@ -1391,10 +1981,16 @@ class ProcessGroup:
                             heartbeat_timeout=self._hb_timeout)
         coord.restore(journal or self._own_journal(),
                       presume_dead=(old_coord,))
+        # generation fencing: the promotion MINTS a new coordinator
+        # generation — a healed old coordinator observing it in any
+        # frame abdicates instead of serving stale epochs
+        coord.generation = max(coord.generation, self.coord_gen) + 1
+        self.coord_gen = coord.generation
         self._server.attach_coordinator(coord)
         self.coordinator = coord  # close() tears it down with the rank
         tracing.mark(None, "coordinator:promoted", "fault",
                      rank=self.rank, old=old_coord, epoch=coord.epoch,
+                     gen=coord.generation,
                      from_journal=journal is not None)
 
     def _own_journal(self) -> dict:
@@ -1403,6 +1999,7 @@ class ProcessGroup:
         replay buffer, incarnations default to 0 — honest degradation,
         documented in docs/robustness.md."""
         return {"epoch": self.epoch,
+                "gen": self.coord_gen,
                 "declared": {str(r): self.epoch for r in self._dead},
                 "inc": {str(self.rank): self.inc},
                 "peers": {str(r): list(hp)
@@ -1472,14 +2069,25 @@ class ProcessGroup:
 
     # -- failure detection ---------------------------------------------------------
     def _heartbeat_once(self) -> dict:
+        from ..faults.netfabric import FABRIC
+        FABRIC.check_send(self.rank, self.coord_rank, what="heartbeat")
         with self._hb_lock:
             _send(self._hb_sock, {"op": "heartbeat", "rank": self.rank,  # srtlint: ignore[lock-discipline, shared-state-races] (the hb lock serializes this rank's dedicated heartbeat socket and nothing nests under it; failover swaps self._hb_sock then shutdown-closes the old one, so a stale read fails typed into _failover)
-                                  "epoch": self.epoch, "inc": self.inc})
-            msg, _ = _recv(self._hb_sock)  # srtlint: ignore[lock-discipline] (heartbeat replies are immediate coordinator responses; the socket dies with close() on rank death)
+                                  "epoch": self.epoch, "inc": self.inc,
+                                  "gen": self.coord_gen,
+                                  "req": self._next_req(), "boot": self._boot})
+            msg, _ = _recv(self._hb_sock)  # srtlint: ignore[lock-discipline, shared-state-races] (heartbeat replies are immediate coordinator responses; the socket dies with close() on rank death, and a failover/heal swap shutdown-closes the old one so a stale read fails typed)
         if msg.get("fenced"):
             self.fenced = True  # srtlint: ignore[shared-state-races] (one-way latch: only ever flips False→True; stale readers re-learn it on their next fenced reply)
             raise PeerLostError(
                 f"rank {self.rank} fenced: {msg.get('error')}")
+        if msg.get("quorum_lost"):
+            # the coordinator itself reports it is parked on the
+            # minority side: park with it (typed; the heal loop below
+            # takes over)
+            self._enter_quorum_lost("coordinator parked (minority side)")
+            raise QuorumLostError(
+                f"rank {self.rank}: coordinator parked quorum-lost")
         self._absorb_membership(msg)
         return msg
 
@@ -1489,6 +2097,12 @@ class ProcessGroup:
             time.sleep(interval)
             if self._closed:
                 return
+            if self.quorum_lost:
+                # parked: this thread IS the heal loop — probe for the
+                # current coordinator generation, re-register under
+                # flap damping once the partition heals
+                self._heal_once()
+                continue
             gen = self._fo_gen
             try:
                 # dcn.heartbeat injection/recovery point: a dropped
@@ -1508,6 +2122,8 @@ class ProcessGroup:
                                            ConnectionError,
                                            InterruptedError))
             except QueryFaulted as qf:
+                if self.quorum_lost:
+                    continue  # parked: heal mode takes over next tick
                 if getattr(qf, "resubmittable", False):
                     return  # fenced: this rank is out of the group
                 # transient retries exhausted against a socket that
@@ -1524,16 +2140,190 @@ class ProcessGroup:
 
     def _failover_quiet(self, gen: int, cause: BaseException) -> bool:
         """Heartbeat-thread failover driver: True when the group has a
-        live coordinator again (keep heartbeating), False when this
+        live coordinator again (keep heartbeating) OR this rank parked
+        quorum-lost (the loop becomes the heal loop), False when this
         rank is done (no successor, fenced, or closed)."""
         try:
             self._failover(gen, cause)
             return True
+        except QuorumLostError:
+            return True  # parked, not dead: heal mode takes over
         except CoordinatorLostError:
             self.coordinator_lost = True  # srtlint: ignore[shared-state-races] (one-way latch set on failover exhaustion; a stale False just means one more typed-failing request before check_peers raises)
             return False
         except (PeerFailedError, ConnectionError, OSError):
             return False
+
+    # -- heal and rejoin -----------------------------------------------------------
+    def _heal_once(self) -> bool:
+        """One heal probe of a PARKED (quorum-lost) rank, run from the
+        heartbeat thread on its interval: (1) try the coordinator we
+        last knew — if the partition healed and it still holds quorum
+        we resume with ZERO churn; if it fenced us (declared dead in
+        the interim) we re-register, riding flap damping; (2) otherwise
+        poll peers' ``vote`` replies for a HIGHER coordinator
+        generation — a successor was promoted while we were cut off:
+        abdicate any stale coordinator this rank hosts, then rejoin the
+        new one."""
+        now = time.monotonic()  # span-api-ok (deferral pacing, not timing)
+        if now < self._heal_defer_until:
+            return False  # serving a flap-damping deferral: stay parked
+        if self._closed or self.fenced:
+            return False
+        if self._heal_probe(tuple(self.coordinator_addr),
+                            self.coord_rank):
+            return True
+        best: Optional[Tuple[int, int]] = None  # (gen, coord_rank)
+        for r in sorted(self.peers):
+            if r == self.rank:
+                continue
+            v = self._poll_vote(r)
+            if v is None:
+                continue
+            v_gen, v_coord = int(v.get("gen", 0)), \
+                int(v.get("coord_rank", -1))
+            if v_gen > self.coord_gen and v_coord >= 0 \
+                    and v_coord != self.rank \
+                    and (best is None or v_gen > best[0]):
+                best = (v_gen, v_coord)
+        if best is None:
+            return False  # still cut off: stay parked, probe next tick
+        gen, coord_rank = best
+        if self.coordinator is not None and self.coordinator.generation < gen:  # srtlint: ignore[shared-state-races] (set once at construction/promotion and never cleared; abdicate() is idempotent, so racing a promotion at worst abdicates on the next heal tick)
+            # this rank hosts the STALE coordinator: abdicate it before
+            # rejoining under the real one — at most one active
+            # coordinator generation, partition healed or not
+            self.coordinator.abdicate(gen)
+        addr = self.peers.get(coord_rank)
+        if addr is None:
+            return False
+        return self._heal_probe(tuple(addr), coord_rank)
+
+    def _heal_probe(self, addr: Tuple[str, int], rank: int) -> bool:
+        """Probe one candidate coordinator address: resume directly on
+        a clean ``members`` reply, re-register on a ``fenced`` one."""
+        from ..faults.netfabric import FABRIC
+        sock = None
+        try:
+            FABRIC.check_connect(self.rank, rank, what="heal probe")
+            sock = socket.create_connection(
+                addr, timeout=min(2.0, self._fetch_timeout))
+            sock.settimeout(self._hb_recv_timeout)
+            _send(sock, {"op": "members", "rank": self.rank,
+                         "epoch": self.epoch, "inc": self.inc,
+                         "gen": self.coord_gen, "req": self._next_req(), "boot": self._boot})
+            msg, _ = _recv(sock)
+        except (ConnectionError, socket.timeout, OSError):
+            _shutdown_close(sock)
+            return False
+        if msg.get("not_coordinator") or msg.get("abdicated") \
+                or msg.get("quorum_lost"):
+            _shutdown_close(sock)
+            return False
+        if msg.get("fenced"):
+            # declared dead while partitioned away: rejoin under a
+            # fresh incarnation (flap damping applies — a deferral
+            # parks the heal loop for retry_after, with ZERO epoch
+            # bumps while parked)
+            _shutdown_close(sock)
+            return self._rejoin(addr, rank)
+        sock.settimeout(None)
+        return self._resume(sock, addr, rank, msg, rejoined=False)
+
+    def _rejoin(self, addr: Tuple[str, int], rank: int) -> bool:
+        """Re-register with the (possibly new) coordinator: fresh
+        incarnation, epoch resync, flap damping honored.  Shuffle state
+        needs no special reconciliation — this rank's durable map
+        output stayed on disk for survivors to re-pull, and its next
+        query starts from the resynced epoch.
+
+        Both sockets are dialed BEFORE the register is sent: an
+        admitted registration followed by a failed heartbeat dial would
+        otherwise retry next tick and burn a membership-flap credit per
+        lap."""
+        from ..faults.netfabric import FABRIC
+        from ..utils import tracing
+        sock = hb = None
+        try:
+            FABRIC.check_connect(self.rank, rank, what="rejoin")
+            sock = socket.create_connection(
+                addr, timeout=min(2.0, self._fetch_timeout))
+            sock.settimeout(self._fetch_timeout)
+            hb = socket.create_connection(
+                addr, timeout=min(2.0, self._fetch_timeout))
+            hb.settimeout(self._hb_recv_timeout)
+            _send(sock, {"op": "register", "rank": self.rank,
+                         "host": self._advertise,
+                         "port": self._server.port,
+                         "epoch": self.epoch, "inc": self.inc,
+                         "gen": self.coord_gen,
+                         "req": self._next_req(), "boot": self._boot})
+            msg, _ = _recv(sock)
+        except (ConnectionError, socket.timeout, OSError):
+            _shutdown_close(sock)
+            _shutdown_close(hb)
+            return False
+        if msg.get("deferred"):
+            # membership flap damping: park the heal loop for the
+            # coordinator's retry_after — zero epoch bumps while parked
+            # is the coordinator's side of the contract
+            _shutdown_close(sock)
+            _shutdown_close(hb)
+            delay_s = max(0.05, int(msg.get("retry_after_ms", 0)) / 1e3)
+            self._heal_defer_until = time.monotonic() + delay_s  # span-api-ok (deferral pacing, not timing)
+            tracing.mark(None, "rejoin:deferred", "fault",
+                         rank=self.rank, retry_after_ms=int(
+                             msg.get("retry_after_ms", 0)))
+            return False
+        if "error" in msg or msg.get("not_coordinator"):
+            _shutdown_close(sock)
+            _shutdown_close(hb)
+            return False
+        self.inc = int(msg.get("inc", self.inc))
+        self.peers = {int(r): (h, int(p))
+                      for r, (h, p) in msg.get("peers", {}).items()} \
+            or self.peers
+        # the new view is authoritative: REPLACE the stale dead list
+        # (absorb only unions — a resurrected peer must come back)
+        self._dead = sorted(int(r) for r in msg.get("dead", [])  # srtlint: ignore[shared-state-races] (rejoin-time replace runs while the rank is PARKED — no collectives in flight — and any racing absorb merge re-converges on the next heartbeat reply)
+                            if int(r) != self.rank)
+        sock.settimeout(None)
+        return self._resume(sock, addr, rank, msg, rejoined=True, hb=hb)
+
+    def _resume(self, ctrl: socket.socket, addr: Tuple[str, int],
+                rank: int, msg: dict, rejoined: bool,
+                hb: Optional[socket.socket] = None) -> bool:
+        """Swap the healed control sockets in and un-park this rank."""
+        from ..utils import tracing
+        from ..utils.metrics import QueryStats
+        if hb is None:
+            try:
+                hb = socket.create_connection(
+                    addr, timeout=self._fetch_timeout)
+                hb.settimeout(self._hb_recv_timeout)
+            except OSError:
+                _shutdown_close(ctrl)
+                return False
+        with self._fo_lock:
+            old_ctrl, old_hb = self._ctrl, self._hb_sock
+            self._ctrl, self._hb_sock = ctrl, hb
+            self.coordinator_addr = tuple(addr)
+            self.coord_rank = rank
+            self._fo_gen += 1
+            self.quorum_lost = False
+            self._heal_defer_until = 0.0
+        self._absorb_membership(msg)
+        for s in (old_ctrl, old_hb):
+            _shutdown_close(s)
+        if rejoined:
+            QueryStats.get().rank_rejoins += 1
+        tracing.mark(None,
+                     "rank:rejoined" if rejoined else "quorum:healed",
+                     "fault", rank=self.rank, coord_rank=rank,
+                     epoch=self.epoch, gen=self.coord_gen, inc=self.inc)
+        _notify_membership(self.world_size - len(self._dead),
+                           self.world_size, self.epoch)
+        return True
 
     @property
     def dead_peers(self) -> List[int]:
@@ -1543,9 +2333,17 @@ class ProcessGroup:
         return [r for r in range(self.world_size) if r not in self._dead]
 
     def is_alive(self) -> bool:
-        return not (self._closed or self.coordinator_lost or self.fenced)  # srtlint: ignore[shared-state-races] (liveness probe over one-way latches: a stale False is re-asked next poll; no decision is irreversible on it)
+        # a quorum-lost rank is PARKED, not dead — but it must not join
+        # collectives (shuffle close etc.) until the heal loop rejoins
+        return not (self._closed or self.coordinator_lost or self.fenced  # srtlint: ignore[shared-state-races] (liveness probe over one-way latches: a stale False is re-asked next poll; no decision is irreversible on it)
+                    or self.quorum_lost)
 
     def check_peers(self) -> None:
+        if self.quorum_lost:  # srtlint: ignore[shared-state-races] (latch read: a stale False defers the typed raise by one call; the heal loop is the only clearer)
+            raise QuorumLostError(
+                f"rank {self.rank} parked on the minority side of a "
+                f"partition; resubmit after the partition heals (see "
+                f"docs/robustness.md)")
         if self.coordinator_lost:  # srtlint: ignore[shared-state-races] (one-way latch read: a stale False defers the typed raise by one call)
             # set only when failover already failed: no successor
             # existed (or takeover never completed) — permanent here
@@ -1570,6 +2368,10 @@ class ProcessGroup:
         control requests hang instead of failing fast — the worst-case
         shape coordinator failover must survive)."""
         from ..faults.injector import INJECTOR, InjectedFault
+        from ..faults.netfabric import FABRIC
+        # the net fabric's deterministic mid-query trigger
+        # (faults.net.afterOps) counts the same op stream
+        FABRIC.note_op()
         try:
             INJECTOR.maybe_raise("dcn.peer_kill",
                                  desc=desc or f"rank-{self.rank}")
@@ -1677,13 +2479,21 @@ class ProcessGroup:
             raise PeerLostError(
                 f"fetch {shuffle_id}[{part}]: rank {rank} declared dead "
                 f"(epoch {self.epoch}); re-pull from durable map output")
+        from ..faults.netfabric import FABRIC
+        # a cut data-plane link raises typed here, INSIDE the caller's
+        # retry scope: transient drops re-fetch, a standing partition
+        # exhausts into the durable re-pull
+        FABRIC.check_send(self.rank, rank,
+                          what=f"fetch {shuffle_id}[{part}]")
         host, port = self.peers[rank]
         t0 = time.monotonic()  # span-api-ok (straggler detection, not span timing)
         try:
             with socket.create_connection(
                     (host, port), timeout=self._fetch_timeout) as s:
                 _send(s, {"op": "fetch", "shuffle": shuffle_id,
-                          "part": part, "epoch": self.epoch})
+                          "part": part, "epoch": self.epoch,
+                          "rank": self.rank, "inc": self.inc,
+                          "req": self._next_req(), "boot": self._boot})
                 msg, payload = _recv(s)
         except (ConnectionError, OSError) as e:
             self.check_peers()  # prefer the heartbeat diagnosis if present

@@ -54,6 +54,10 @@ class ExecContext:
         # unarmed conf clears the previous query's schedule/rate
         from ..faults.injector import INJECTOR as FAULT_INJECTOR
         FAULT_INJECTOR.arm_from_conf(self.conf)
+        # the network link-fault fabric arms from conf on the same
+        # contract (identical re-arms preserve its RNG + engage state)
+        from ..faults.netfabric import FABRIC as NET_FABRIC
+        NET_FABRIC.arm_from_conf(self.conf)
 
     def metric_set(self, op_id: str) -> MetricSet:
         if op_id not in self.metrics:

@@ -146,20 +146,66 @@ class WireClient:
         # per-client jitter stream: seeded from the PRNG pool, NOT
         # shared — a fleet of clients must not march one backoff curve
         self._jitter = random.Random()
+        # per-endpoint health for the failover sweep: an endpoint that
+        # refused a dial is DEMOTED behind an exponential backoff
+        # window instead of being re-dialed in fixed order every sweep
+        # — under a half-partitioned fleet the dark side must not burn
+        # the client's retry budget first.  addr -> [failures,
+        # retry_at_monotonic]; cleared on any successful connect.
+        self._down: Dict[Tuple[str, int], list] = {}
+        self.endpoints_demoted = 0
         self.session_id: Optional[str] = None
         self._sock: Optional[socket.socket] = None
         self._connect(self.addr)
 
     def _connect(self, addr: Tuple[str, int]) -> None:
-        sock = socket.create_connection(addr, timeout=self._timeout)
+        try:
+            sock = socket.create_connection(addr, timeout=self._timeout)
+        except OSError:
+            self._note_endpoint_down(addr)
+            raise
         # small request frames answered promptly: Nagle + delayed-ACK
         # would add ~40ms to every round trip
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        P.send_frame(sock, P.REQ_HELLO, P.pack_json(self._hello))
-        _, payload = P.recv_frame(sock, expect=(P.RSP_WELCOME,))
+        try:
+            P.send_frame(sock, P.REQ_HELLO, P.pack_json(self._hello))
+            _, payload = P.recv_frame(sock, expect=(P.RSP_WELCOME,))
+        except (OSError, WireError, P.ProtocolError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._note_endpoint_down(addr)
+            raise
+        self._down.pop(addr, None)  # healthy again: full standing back
         self._sock = sock
         self.addr = addr
         self.session_id = P.unpack_json(payload)["session_id"]
+
+    # -- endpoint health ----------------------------------------------------------
+    def _note_endpoint_down(self, addr: Tuple[str, int]) -> None:
+        """Demote an endpoint that refused a dial: exponential backoff
+        window (jittered) before the sweep dials it again."""
+        fails = self._down.get(addr, [0, 0.0])[0] + 1
+        window = min(_BACKOFF_MAX_S,
+                     _BACKOFF_BASE_S * 4 * (2 ** min(8, fails - 1)))
+        self._down[addr] = [
+            fails,
+            time.monotonic() + window * (0.5 + self._jitter.random())]
+        self.endpoints_demoted += 1
+
+    def _sweep_order(self, candidates):
+        """Order one failover sweep: endpoints NOT serving a demotion
+        window first (original priority preserved), demoted ones last,
+        ordered by soonest retry — so a dark half of the fleet stops
+        eating the sweep's dials ahead of the live half."""
+        now = time.monotonic()
+        up = [a for a in candidates
+              if self._down.get(a, [0, 0.0])[1] <= now]
+        down = sorted((a for a in candidates
+                       if self._down.get(a, [0, 0.0])[1] > now),
+                      key=lambda a: self._down[a][1])
+        return up + down
 
     def _failover(self, exc: ServerDraining) -> None:
         """GOAWAY handling: reconnect to a live endpoint — the siblings
@@ -188,7 +234,10 @@ class WireClient:
                 base = max(exc.retry_after_ms / 1e3, 0.05 * sweep)
                 time.sleep(min(_BACKOFF_MAX_S, base)
                            * (0.5 + self._jitter.random()))  # fault-ok (paced jittered re-dial between failover sweeps, not an exception-swallowing loop)
-            for addr in candidates:
+            # demoted (recently-refusing) endpoints sort behind healthy
+            # ones on every sweep — the dark half of a partitioned
+            # fleet stops burning the early dials
+            for addr in self._sweep_order(candidates):
                 try:
                     self._connect(addr)
                     self.goaways_survived += 1
@@ -338,12 +387,16 @@ class WireClient:
                                     "draining endpoints")
                 self._failover(e)
         yield "meta", P.unpack_json(payload)
+        batches = 0
         while True:
             ftype, payload = P.recv_frame(
                 self._sock, expect=(P.RSP_BATCH, P.RSP_END))
             if ftype == P.RSP_END:
-                yield "end", P.unpack_json(payload)
+                end = P.unpack_json(payload)
+                _check_batch_count(end, batches)
+                yield "end", end
                 return
+            batches += 1
             yield "batch", _read_ipc(payload)
 
     def _collect_result(self) -> ResultSet:
@@ -355,6 +408,7 @@ class WireClient:
                 self._sock, expect=(P.RSP_BATCH, P.RSP_END))
             if ftype == P.RSP_END:
                 end = P.unpack_json(payload)
+                _check_batch_count(end, len(tables))
                 return ResultSet(meta["query_id"], meta["schema"],
                                  tables, end, end.get("prepared", False))
             tables.append(_read_ipc(payload))
@@ -387,6 +441,20 @@ class WireClient:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def _check_batch_count(end: dict, received: int) -> None:
+    """Delivery hardening at the result decoder: the END frame carries
+    the server's BATCH-frame count — a duplicated or lost batch frame
+    (broken middlebox, buggy proxy) surfaces as a typed
+    :class:`.protocol.ProtocolError` instead of silently wrong or
+    double-counted rows."""
+    expected = end.get("batches")
+    if expected is not None and int(expected) != received:
+        raise P.ProtocolError(
+            f"result stream delivered {received} batch frame(s) but "
+            f"the END frame counted {int(expected)} — duplicated or "
+            f"lost delivery")
 
 
 def _read_ipc(payload: bytes):

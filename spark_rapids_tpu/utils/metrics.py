@@ -123,6 +123,18 @@ class QueryStats:
         self.fragments_recomputed_remote = 0
         self.partitions_reowned = 0
         self.queries_resubmitted = 0
+        # network partition survival (parallel/dcn.py + faults/
+        # netfabric.py): duplicated/reordered frames whose recorded
+        # reply replayed from a dedup journal instead of re-applying,
+        # ranks that parked typed (QuorumLostError) on the minority
+        # side of a partition instead of promoting a second
+        # coordinator, and parked ranks that healed + re-registered
+        # (under flap damping) after the partition healed — the
+        # partition chaos differential and loadgen's partition drill
+        # read these
+        self.frames_deduped = 0
+        self.quorum_losses = 0
+        self.rank_rejoins = 0
         # coordinator failovers this rank performed (re-dialed the
         # deterministic successor after coordinator loss; the successor
         # itself also counts its self-promotion) — epoch continuity plus

@@ -44,6 +44,26 @@ def main() -> None:
     ap.add_argument("--hb-interval", type=float, default=2.0)
     ap.add_argument("--hb-timeout", type=float, default=None)
     ap.add_argument("--wait-timeout", type=float, default=None)
+    ap.add_argument("--net-partition", default="",
+                    help="faults.net.partition program (e.g. '0+1|2') "
+                         "armed on EVERY rank; engages after "
+                         "--net-after shuffle ops on each rank")
+    ap.add_argument("--net-after", type=int, default=0)
+    ap.add_argument("--net-heal-s", type=float, default=0.0,
+                    help="heal the fabric this many seconds after the "
+                         "run starts; a parked minority rank then "
+                         "waits for its heal loop to rejoin and "
+                         "records the outcome")
+    ap.add_argument("--net-dup-rate", type=float, default=0.0)
+    ap.add_argument("--net-reorder-rate", type=float, default=0.0)
+    ap.add_argument("--net-seed", type=int, default=0)
+    ap.add_argument("--quorum-window-ms", type=float, default=None)
+    ap.add_argument("--await-parked", default="",
+                    help="comma rank list: after finishing, keep this "
+                         "rank (and any coordinator it hosts) alive "
+                         "until every listed rank wrote its parked "
+                         "marker — the minority's heal-and-rejoin "
+                         "needs a living coordinator to rejoin to")
     args = ap.parse_args()
 
     # force the CPU platform the same way tests/conftest.py does — a TPU
@@ -65,6 +85,32 @@ def main() -> None:
     if args.wait_timeout is not None:
         TpuConf.set_session("spark.rapids.tpu.dcn.waitTimeout",
                             args.wait_timeout)
+    if args.quorum_window_ms is not None:
+        TpuConf.set_session("spark.rapids.tpu.dcn.quorum.windowMs",
+                            args.quorum_window_ms)
+    if args.net_partition or args.net_dup_rate or args.net_reorder_rate:
+        # every rank arms the SAME link-fault program (each enforces
+        # its own side); afterOps makes a cut engage mid-query,
+        # deterministically, once this rank has counted N shuffle ops
+        TpuConf.set_session("spark.rapids.tpu.faults.net.partition",
+                            args.net_partition)
+        TpuConf.set_session("spark.rapids.tpu.faults.net.afterOps",
+                            args.net_after)
+        TpuConf.set_session("spark.rapids.tpu.faults.net.dup.rate",
+                            args.net_dup_rate)
+        TpuConf.set_session("spark.rapids.tpu.faults.net.reorder.rate",
+                            args.net_reorder_rate)
+        TpuConf.set_session("spark.rapids.tpu.faults.net.seed",
+                            args.net_seed)
+        from spark_rapids_tpu.faults.netfabric import FABRIC
+        FABRIC.arm(partition=args.net_partition,
+                   after_ops=args.net_after,
+                   dup_rate=args.net_dup_rate,
+                   reorder_rate=args.net_reorder_rate,
+                   seed=args.net_seed)
+        if args.net_heal_s > 0:
+            import threading
+            threading.Timer(args.net_heal_s, FABRIC.heal).start()  # ctx-ok (chaos-harness timer, not per-query work)
 
     coord = None
     if args.rank == 0:
@@ -134,6 +180,33 @@ def main() -> None:
                 time.sleep(300)  # fault-ok (simulated wedged rank, not a retry)
                 os._exit(143)
             raise
+        except Exception as e:
+            from spark_rapids_tpu.faults.recovery import QueryFaulted
+            from spark_rapids_tpu.parallel.dcn import QuorumLostError
+            quorum_park = isinstance(e, QuorumLostError) or (
+                isinstance(e, QueryFaulted)
+                and ("Quorum" in str(e)
+                     or any("QuorumLostError" in r.error
+                            for r in e.history)))
+            if not (args.net_partition and quorum_park):
+                raise
+            # minority side of the partition: the park must be TYPED
+            # (never a hang, never wrong rows).  Record it; with a heal
+            # scheduled, wait for the heal loop to re-register and
+            # record the rejoin too.
+            marker = {"rank": args.rank, "error": type(e).__name__,
+                      "parked": True, "rejoined": False}
+            if args.net_heal_s > 0:
+                deadline = time.monotonic() + 120
+                while pg.quorum_lost and time.monotonic() < deadline:
+                    time.sleep(0.1)  # fault-ok (harness poll for the heal loop's rejoin, not a retry)
+                marker["rejoined"] = not pg.quorum_lost
+                marker["epoch"] = pg.epoch
+                marker["inc"] = pg.inc
+                marker["coord_rank"] = pg.coord_rank
+            with open(f"{args.out}.parked.{args.rank}", "w") as f:
+                json.dump(marker, f)
+            return
         with open(f"{args.out}.{args.rank}", "w") as f:
             json.dump(rows, f, default=str)
         # recovery accounting rides a sidecar so the chaos suite can
@@ -146,12 +219,25 @@ def main() -> None:
                           ("peers_lost", "fragments_recomputed",
                            "fragments_recomputed_remote",
                            "partitions_reowned", "transient_retries",
-                           "coordinator_failovers")},
+                           "coordinator_failovers", "frames_deduped",
+                           "quorum_losses", "rank_rejoins")},
                        # epoch continuity is part of the failover
                        # acceptance: survivors must agree on a bumped
                        # epoch after the takeover
                        "final_epoch": pg.epoch,
                        "coord_rank": pg.coord_rank}, f)
+        if args.net_dup_rate or args.net_reorder_rate:
+            # the dup/reorder differential's zero-leak gate: every
+            # spill handle released despite duplicated deliveries
+            from spark_rapids_tpu.memory.spill import get_catalog
+            get_catalog().assert_no_leaks()
+        if args.await_parked:
+            ranks = [int(x) for x in args.await_parked.split(",") if x]
+            deadline = time.monotonic() + 150
+            while time.monotonic() < deadline and not all(
+                    os.path.exists(f"{args.out}.parked.{r}")
+                    for r in ranks):
+                time.sleep(0.2)  # fault-ok (harness wait for the parked peers' heal outcome, not a retry)
         try:
             pg.barrier(allow_shrunk=True)  # outputs durable before exit
         except (PeerLostError, CoordinatorLostError):

@@ -823,6 +823,53 @@ class TestChaosDifferential:
         finally:
             INJECTOR.arm()
             coord2.close()
+        # the NETWORK legs (dcn.partition / dcn.net.dup /
+        # dcn.net.reorder) need a REAL link, so a world=2 mini group:
+        # rank 1's frames to the rank-0 coordinator ride the fabric.
+        # A dropped control frame recovers by re-dialing the SAME
+        # coordinator (no failover, no election); duplicated and
+        # stale-reordered deliveries replay byte-identically from the
+        # dedup journal (frames_deduped) instead of re-applying.
+        import threading as _th
+
+        from spark_rapids_tpu.utils.metrics import QueryStats as _QS
+        coord3 = Coordinator(2, heartbeat_timeout=30.0)
+        pgs3 = [None, None]
+
+        def _mk(r):
+            pgs3[r] = ProcessGroup(
+                r, 2, ("127.0.0.1", coord3.port),
+                coordinator=coord3 if r == 0 else None,
+                heartbeat_interval=60.0)
+
+        ts3 = [_th.Thread(target=_mk, args=(r,)) for r in range(2)]
+        for t in ts3:
+            t.start()
+        for t in ts3:
+            t.join(timeout=30)
+        try:
+            assert pgs3[0] is not None and pgs3[1] is not None
+            INJECTOR.arm(schedule="dcn.partition:1")
+            msg, _ = pgs3[1]._request({"op": "members"})
+            assert 1 in [int(r) for r in msg["peers"]]
+            assert INJECTOR.snapshot()[
+                "injected_total"]["dcn.partition"] >= 1
+            dedup_before = _QS.process().frames_deduped
+            INJECTOR.arm(schedule="dcn.net.dup:1")
+            msg, _ = pgs3[1]._request({"op": "members"})
+            assert "epoch" in msg
+            INJECTOR.arm(schedule="dcn.net.reorder:1")
+            msg, _ = pgs3[1]._request({"op": "members"})
+            assert "epoch" in msg
+            INJECTOR.arm()
+            assert _QS.process().frames_deduped > dedup_before
+        finally:
+            INJECTOR.arm()
+            for pg3 in pgs3:
+                if pg3 is not None:
+                    pg3.close()
+            coord3.close()
+
         # server.conn leg: the network front door's client drops
         # mid-result-stream (injected at the BATCH send) — the wire
         # query cancels cooperatively, the permit and the wire-query

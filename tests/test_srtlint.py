@@ -857,15 +857,27 @@ class TestIncremental:
             assert cold.failing == []
             target = os.path.join(tmp, "spark_rapids_tpu", "ops",
                                   "cast.py")
-            with open(target, "a") as f:
-                f.write("\n# an innocuous trailing comment\n")
-            t0 = _t.perf_counter()
-            warm = run_incremental(tmp)
-            warm_s = _t.perf_counter() - t0
-            assert warm.failing == []
-            assert warm.incremental["changed"] == 1
-            # the bar: a one-file edit must not pay the cold scan again
-            assert warm_s < 0.8 * cold_s, (warm_s, cold_s)
+            # the bar: a one-file edit must not pay the cold scan
+            # again.  Each attempt appends a FRESH comment line (new
+            # content hash -> a genuine changed=1 warm scan), so a
+            # CPU-contention spike on one measurement cannot flake the
+            # acceptance — the ratio just re-measures.
+            timings = []
+            for attempt in range(3):
+                with open(target, "a") as f:
+                    f.write(f"\n# innocuous trailing comment {attempt}\n")
+                t0 = _t.perf_counter()
+                warm = run_incremental(tmp)
+                warm_s = _t.perf_counter() - t0
+                assert warm.failing == []
+                assert warm.incremental["changed"] == 1
+                timings.append(warm_s)
+                if warm_s < 0.8 * cold_s:
+                    break
+            else:
+                raise AssertionError(
+                    f"one-file edits kept paying the cold scan: warm "
+                    f"{timings} vs cold {cold_s}")
 
 
 class TestSarifAndChanged:

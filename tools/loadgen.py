@@ -707,6 +707,139 @@ def _coordinator_failover_drill(leaks: List[str]) -> dict:
         TpuConf.unset_session("spark.rapids.tpu.dcn.heartbeatTimeout")
 
 
+def _partition_drill(leaks: List[str]) -> dict:
+    """The soak's PARTITION leg (ISSUE 14): a thread-rank world=3
+    DcnShuffle whose minority rank {2} is cut off mid-reduce by the
+    link-fault fabric.  The majority must complete the EXACT row count
+    (durable re-pull + orphan adoption) under the ORIGINAL coordinator
+    generation; the minority must park TYPED (QuorumLostError — never
+    a second coordinator, never wrong rows); and after ``FABRIC.heal()``
+    the parked rank must rejoin through flap damping with ZERO epoch
+    bumps while parked and exactly ONE for the rejoin."""
+    import tempfile
+    import threading as _th
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.faults.netfabric import FABRIC
+    from spark_rapids_tpu.faults.recovery import QueryFaulted
+    from spark_rapids_tpu.parallel.dcn import (Coordinator, DcnShuffle,
+                                               ProcessGroup,
+                                               QuorumLostError)
+    from spark_rapids_tpu.utils.metrics import QueryStats
+    confs = {"spark.rapids.tpu.dcn.heartbeatTimeout": 0.8,
+             "spark.rapids.tpu.dcn.quorum.windowMs": 3500.0,
+             "spark.rapids.tpu.faults.backoff.baseMs": 5.0,
+             "spark.rapids.tpu.faults.backoff.maxMs": 50.0}
+    for k, v in confs.items():
+        TpuConf.set_session(k, v)
+    world, n_parts, rows_per = 3, 6, 32
+    tmp = tempfile.mkdtemp(prefix="srt_soak_part_")
+    coord = Coordinator(world, heartbeat_timeout=0.8, wait_timeout=60.0)
+    pgs = [None] * world
+    t0 = _pc()
+    try:
+        def mk(r):
+            pgs[r] = ProcessGroup(
+                r, world, ("127.0.0.1", coord.port),
+                coordinator=coord if r == 0 else None,
+                heartbeat_interval=0.1)
+
+        ts = [_th.Thread(target=mk, args=(r,)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        shuffles = [DcnShuffle(pg, n_parts,
+                               os.path.join(tmp, f"r{pg.rank}"))
+                    for pg in pgs]
+        for rank, sh in enumerate(shuffles):
+            for p in range(n_parts):
+                sh.write_partition(p, pa.table(
+                    {"r": [rank] * rows_per, "p": [p] * rows_per}))
+        ts = [_th.Thread(target=sh.commit) for sh in shuffles]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        before = QueryStats.process().snapshot()
+        FABRIC.cut("2|0+1")  # the minority loses every majority link
+        results = {}
+        parked = {}
+
+        def reduce_rank(r):
+            try:
+                n = 0
+                for p in shuffles[r].my_parts():
+                    n += sum(t_.num_rows
+                             for t_ in shuffles[r].read_partition(p))
+                for p in shuffles[r].adopt_orphans():
+                    n += sum(t_.num_rows
+                             for t_ in shuffles[r].read_partition(p))
+                results[r] = n
+                shuffles[r].close()
+            except Exception as e:
+                parked[r] = e
+                shuffles[r].close()
+
+        ts = [_th.Thread(target=reduce_rank, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        rows = results.get(0, 0) + results.get(1, 0)
+        complete = rows == world * n_parts * rows_per
+        if not complete:
+            leaks.append(f"partition drill incomplete: {rows} rows")
+        typed = isinstance(parked.get(2),
+                           (QuorumLostError, QueryFaulted))
+        if not typed:
+            leaks.append(f"partition drill: minority park not typed "
+                         f"({type(parked.get(2)).__name__})")
+        if coord.generation != 1 or coord.quorum_lost:
+            leaks.append("partition drill: majority coordinator "
+                         "disturbed by a minority partition")
+        death_epoch = coord.epoch
+        time.sleep(0.5)
+        parked_bumps = coord.epoch - death_epoch  # must be ZERO
+        if parked_bumps:
+            leaks.append(f"partition drill: {parked_bumps} epoch "
+                         f"bump(s) while the minority was parked")
+        FABRIC.heal()
+        deadline = _pc() + 30
+        while _pc() < deadline and pgs[2].quorum_lost:
+            time.sleep(0.1)
+        rejoined = not pgs[2].quorum_lost
+        if not rejoined:
+            leaks.append("partition drill: minority never rejoined "
+                         "after heal")
+        rejoin_epoch = coord.epoch
+        if rejoined and rejoin_epoch != death_epoch + 1:
+            leaks.append(f"partition drill: rejoin epoch churn "
+                         f"({death_epoch} -> {rejoin_epoch}, want one "
+                         f"bump)")
+        d = QueryStats.delta_since(before)
+        return {"partition_rows_complete": complete,
+                "partition_parked_typed": typed,
+                "partition_rejoined": rejoined,
+                "partition_epoch_bumps_while_parked": parked_bumps,
+                "partition_quorum_losses": d.get("quorum_losses", 0),
+                "partition_rank_rejoins": d.get("rank_rejoins", 0),
+                "partition_drill_s": round(_pc() - t0, 3)}
+    finally:
+        FABRIC.reset()
+        for pg in pgs:
+            if pg is not None:
+                try:
+                    pg.close()
+                except Exception:  # fault-ok (chaos drill teardown of partitioned ranks)
+                    pass
+        for k in confs:
+            TpuConf.unset_session(k)
+
+
 def run_soak(args) -> dict:
     """Duration-bounded zero-downtime soak: a fleet of front doors on
     FIXED ports under sustained zipf load, each door rolling-restarted
@@ -830,6 +963,10 @@ def run_soak(args) -> dict:
         restarts += 1
     sleep_until(0.75)
     drill = _coordinator_failover_drill(leaks)
+    # the partition leg rides the back half too: minority cut mid-run,
+    # typed parks + zero mismatches, heal, rejoin with zero epoch churn
+    # beyond the flap-damping contract
+    drill.update(_partition_drill(leaks))
 
     for th in threads:
         th.join(timeout=args.timeout)
