@@ -89,6 +89,12 @@ the fabric heals the parked rank must rejoin through flap damping with
 exactly one epoch bump; emitted as a partition_survival JSON line
 beside the other drills, SRT_BENCH_QUERIES="" makes the run
 partition-only),
+SRT_BENCH_TELEMETRY=1 (telemetry-tax drill: the live metrics registry
+on vs off over a serial in-memory mini-suite — alternating passes, min
+wall per side, overhead_pct against the <=2% bound — plus scrape
+latency p95 while 4 threads hammer /metrics + /snapshot during a
+concurrent burst; emitted as a telemetry_overhead JSON line ahead of
+the suite numbers, SRT_BENCH_QUERIES="" makes the run telemetry-only),
 SRT_BENCH_KILL_PEER=1 (killed-peer drill: a world=2 DcnShuffle over
 thread ranks commits on both sides, then rank 1 dies SILENTLY
 mid-reduce — the drill prints a dcn_killed_peer_recovery JSON line with
@@ -566,10 +572,116 @@ def _killed_peer_drill() -> dict:
         TpuConf.unset_session("spark.rapids.tpu.dcn.heartbeatTimeout")
 
 
+def _telemetry_overhead_drill() -> dict:
+    """SRT_BENCH_TELEMETRY=1: pin the telemetry tax with numbers.
+
+    (1) on-vs-off wall delta over a serial in-memory mini-suite
+    (scan->filter->agg / join / sort shapes, alternating passes so
+    drift cancels) — the <=2% acceptance bound; (2) scrape latency p95
+    while 4 scraper threads hammer /metrics + /snapshot during a
+    concurrent burst — the scrape-storm-never-blocks-queries check."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql import functions as F
+
+    sess = srt.Session.get_or_create()
+    rng = np.random.default_rng(7)
+    n = 60_000
+    df = sess.create_dataframe({
+        "k": rng.integers(0, 64, n),
+        "v": rng.random(n).round(4),
+        "w": (rng.random(n) * 1e4).round(2)})
+    dim = sess.create_dataframe({
+        "dk": list(range(64)), "name": [f"g{i:02d}" for i in range(64)]})
+
+    def queries():
+        return [
+            (df.where(F.col("v") >= 0.25)
+             .group_by("k").agg(F.sum(F.col("w")).alias("sw"),
+                                F.count_star().alias("c"))),
+            (df.join(dim, on=[("k", "dk")]).group_by("name")
+             .agg(F.avg(F.col("v")).alias("av"))),
+            df.sort(F.col("w").desc()).limit(50),
+        ]
+
+    def one_pass() -> float:
+        t0 = time.perf_counter()
+        for q in queries():
+            q.collect()
+        return time.perf_counter() - t0
+
+    key = "spark.rapids.tpu.telemetry.enabled"
+    for _ in range(2):  # warm compiles out of the measurement
+        one_pass()
+    on_s, off_s = [], []
+    for i in range(6):  # alternate so drift lands on both sides
+        sess.conf.set(key, i % 2 == 0)
+        (on_s if i % 2 == 0 else off_s).append(one_pass())
+    sess.conf.unset(key)
+    on_w, off_w = min(on_s), min(off_s)
+    overhead_pct = (on_w - off_w) / off_w * 100.0 if off_w else 0.0
+
+    # scrape storm beside a concurrent burst through the scheduler
+    from spark_rapids_tpu.server import SqlFrontDoor
+    door = SqlFrontDoor(sess).start()
+    lat_ms, lat_lock = [], threading.Lock()
+    stop = threading.Event()
+
+    def scraper():
+        base = f"http://127.0.0.1:{door.ops_port}"
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                for path in ("/metrics", "/snapshot"):
+                    with urllib.request.urlopen(base + path,
+                                                timeout=5) as r:
+                        r.read()
+                with lat_lock:
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+            except OSError:
+                pass
+    ts = [threading.Thread(target=scraper, daemon=True)
+          for _ in range(4)]
+    for t in ts:
+        t.start()
+    handles = [sess.submit(q, label=f"tmb-{i}")
+               for i in range(3) for q in queries()]
+    for h in handles:
+        h.result(timeout=120)
+    time.sleep(0.3)
+    stop.set()
+    for t in ts:
+        t.join(timeout=5)
+    door.close()
+    lat_ms.sort()
+    p95 = lat_ms[int(0.95 * (len(lat_ms) - 1))] if lat_ms else 0.0
+    return {
+        "metric": "telemetry_overhead",
+        "mini_suite_queries": 3,
+        "wall_on_s": round(on_w, 4),
+        "wall_off_s": round(off_w, 4),
+        "overhead_pct": round(overhead_pct, 2),
+        "scrapes": len(lat_ms),
+        "scrape_p95_ms": round(p95, 2),
+        "bound_pct": 2.0,
+    }
+
+
 def main() -> None:
     sf = float(os.environ.get("SRT_BENCH_SF", "1.0"))
     iters = int(os.environ.get("SRT_BENCH_ITERS", "3"))
     conc = int(os.environ.get("SRT_BENCH_CONCURRENCY", "0") or 0)
+    if os.environ.get("SRT_BENCH_TELEMETRY", "0") == "1":
+        # telemetry tax drill: on-vs-off mini-suite wall delta (the
+        # <=2% bound) + scrape latency p95 under a scrape storm —
+        # emitted as a telemetry_overhead JSON line beside the others
+        print(json.dumps(_telemetry_overhead_drill()), flush=True)
+        if os.environ.get("SRT_BENCH_QUERIES", None) == "":
+            return  # telemetry-only invocation
     if os.environ.get("SRT_BENCH_KILL_PEER", "0") == "1":
         # killed-peer recovery columns ride their own JSON line ahead of
         # the suite numbers (and are NOT re-run by per-query subprocesses)

@@ -1157,6 +1157,58 @@ SERVER_DRAIN_DEADLINE_MS = register(
     "restart.", conv=float,
     check=lambda v: None if v >= 0 else "must be >= 0")
 
+TELEMETRY_ENABLED = register(
+    "spark.rapids.tpu.telemetry.enabled", True,
+    "Master switch for the live metrics registry (utils/telemetry.py): "
+    "labeled counters/gauges/log-bucket histograms fed from the "
+    "engine's instrumentation choke points (QueryStats fold-in, "
+    "scheduler/admission/breaker/brownout transitions, front-door "
+    "stream/spool/shed paths, DCN membership events), scraped through "
+    "the ops endpoint (/metrics Prometheus exposition, /snapshot "
+    "JSON) and shipped as compact deltas on DCN heartbeats for the "
+    "coordinator's fleet rollup. Disabled, every emit point is a "
+    "single attribute read (the measured overhead bound is the "
+    "telemetry_overhead bench line).")
+
+SERVER_OPS_ENABLED = register(
+    "spark.rapids.tpu.server.ops.enabled", True,
+    "Start the plaintext HTTP ops listener beside each front door "
+    "(server/ops.py): GET /metrics (Prometheus exposition), /healthz "
+    "(drain/brownout/quarantine-aware liveness), and /snapshot (the "
+    "unified scheduler/admission/breaker/quota/cache/telemetry/SLO "
+    "JSON the srtop console and loadgen's reconciliation read). The "
+    "same payloads are also served over the wire protocol's typed OPS "
+    "op, so a fleet scraper may use either surface.")
+
+SERVER_OPS_PORT = register(
+    "spark.rapids.tpu.server.ops.port", 0,
+    "TCP port for the HTTP ops listener (0 picks an ephemeral port; "
+    "SqlFrontDoor.ops_port reports it). Binds server.host.",
+    check=lambda v: None if 0 <= v < 65536 else "must be in [0, 65536)")
+
+SERVER_SLO_LATENCY_MS = register(
+    "spark.rapids.tpu.server.slo.latencyMs", 2000.0,
+    "Per-tenant latency objective: a completed query slower than this "
+    "(or one that failed) is an SLO-bad event in the burn-rate "
+    "tracker. Feeds the slo_good_total/slo_bad_total counters and the "
+    "multi-window slo_burn_rate gauges tools/srtop.py renders.",
+    conv=float, check=lambda v: None if v > 0 else "must be > 0")
+
+SERVER_SLO_TARGET = register(
+    "spark.rapids.tpu.server.slo.target", 0.99,
+    "SLO success-ratio objective (e.g. 0.99 = 1% error budget): the "
+    "burn rate is observed_error_rate / (1 - target), so 1.0 means "
+    "the budget burns exactly at its sustainable rate and >1 "
+    "exhausts it early.", conv=float,
+    check=lambda v: None if 0.0 < v < 1.0 else "must be in (0, 1)")
+
+SERVER_SLO_WINDOWS = register(
+    "spark.rapids.tpu.server.slo.windows", "60,600",
+    "Comma list of trailing window lengths in SECONDS over which the "
+    "burn-rate gauges are computed (the classic multi-window "
+    "fast-burn/slow-burn alerting pair). Each window exports one "
+    "slo_burn_rate{tenant,window} gauge.")
+
 SERVER_DRAIN_SIBLINGS = register(
     "spark.rapids.tpu.server.drain.siblings", "",
     "Comma list of 'host:port' sibling front doors advertised in the "

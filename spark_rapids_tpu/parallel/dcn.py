@@ -170,6 +170,9 @@ def remove_membership_listener(fn) -> None:
 
 
 def _notify_membership(alive: int, world: int, epoch: int) -> None:
+    from ..utils import telemetry
+    telemetry.gauge_set("dcn_epoch", float(epoch))
+    telemetry.gauge_set("dcn_alive_ranks", float(alive))
     with _LISTENERS_LOCK:
         listeners = list(_MEMBERSHIP_LISTENERS)
     for fn in listeners:
@@ -332,6 +335,14 @@ class Coordinator:
         # delivery hardening: duplicated/reordered frames replay their
         # recorded reply instead of re-applying effects
         self._reqj = _ReqJournal()
+        # fleet telemetry: each rank piggybacks a compact cumulative
+        # metrics delta on its heartbeats; the coordinator merges them
+        # into a per-rank view (replacement per series — duplicate
+        # delivery cannot double-count) and ships the rollup back on
+        # heartbeat replies whose sender lags the current version.
+        # Rides the membership journal so aggregates survive failover.
+        self._tm_ranks: Dict[int, Dict[str, float]] = {}
+        self._tm_version = 0
         self._cv = threading.Condition()
         self._peers: Dict[int, Tuple[str, int]] = {}
         self._last_seen: Dict[int, float] = {}
@@ -725,6 +736,11 @@ class Coordinator:
             "coord_rank": self.rank,
             "heartbeat_timeout": self.heartbeat_timeout,
             "wait_timeout": self.wait_timeout,
+            # fleet telemetry rides the journal: the standby restores
+            # the per-rank metric views, so fleet rollups survive a
+            # coordinator failover instead of resetting to zero
+            "tm_ranks": {str(r): d for r, d in self._tm_ranks.items()},
+            "tm_version": self._tm_version,
         }
 
     def _await_push_locked(self, rec: dict) -> None:
@@ -834,6 +850,9 @@ class Coordinator:
                     rec["ver"] = 0  # replicated once already: replayable now
                     self._completed[tag] = rec
                     self._completed_order.append(tag)
+            self._tm_ranks = {int(r): dict(d) for r, d
+                              in (j.get("tm_ranks") or {}).items()}
+            self._tm_version = int(j.get("tm_version", 0))
             if j.get("heartbeat_timeout") is not None:
                 self.heartbeat_timeout = float(j["heartbeat_timeout"])
             if j.get("wait_timeout") is not None:
@@ -1009,10 +1028,27 @@ class Coordinator:
                         "ranks": rec["ranks"],
                         **rec["meta"]}, b"".join(parts)
             if op == "heartbeat":
-                return {"dead": sorted(self._declared),
-                        "epoch": self._epoch,
-                        "gen": self.generation,
-                        "quorum_lost": self.quorum_lost}, b""
+                from ..utils import telemetry
+                tm = msg.get("tm")
+                if tm:
+                    telemetry.merge_rank(self._tm_ranks, rank, tm)
+                    self._tm_version += 1
+                reply = {"dead": sorted(self._declared),
+                         "epoch": self._epoch,
+                         "gen": self.generation,
+                         "quorum_lost": self.quorum_lost,
+                         "tmv": self._tm_version}
+                if self._tm_ranks \
+                        and int(msg.get("tmv", -1)) < self._tm_version:
+                    # the sender lags the fleet view: ship the per-rank
+                    # merge + rollup so ANY door on that rank can serve
+                    # the fleet-wide scrape
+                    reply["tm_fleet"] = {
+                        "version": self._tm_version,
+                        "ranks": {str(r): d
+                                  for r, d in self._tm_ranks.items()},
+                        "rollup": telemetry.rollup(self._tm_ranks)}
+                return reply, b""
             if op == "members":
                 return {"dead": sorted(self._declared),
                         "epoch": self._epoch,
@@ -1321,6 +1357,7 @@ class _PeerServer:
             QueryStats.get().frames_deduped += 1
             return hit
         from ..faults.injector import INJECTOR
+        t_serve = time.time()  # span-api-ok (wall-epoch shard timestamp for cross-rank stitching, recorded via tracing.shard_record)
         if INJECTOR.maybe_fire("dcn.slow_peer",
                                desc=f"part-{msg.get('part')}"):
             # gray straggler: answer, but late — detection is the
@@ -1342,6 +1379,18 @@ class _PeerServer:
                 with open(path, "rb") as f:
                     payload = f.read()
             reply = ({"ok": True}, payload)
+        tctx = msg.get("trace")
+        if tctx:
+            # the requester's query is traced: this serve lands in OUR
+            # rank's trace shard under its trace id — the stitch tool
+            # parents it below the query root, attributed to this rank
+            from ..utils import tracing
+            tracing.shard_record(
+                str(tctx[0]), self.rank, "dcn:serve_fetch", "shuffle",
+                t_serve, time.time() - t_serve,  # span-api-ok (wall-epoch shard duration for cross-rank stitching)
+                shuffle=str(msg.get("shuffle")),
+                part=int(msg.get("part", -1)), to_rank=rank,
+                bytes=len(reply[1]))
         self._reqj.record(rank, boot, req, reply[0], reply[1])
         return reply
 
@@ -1461,6 +1510,12 @@ class ProcessGroup:
         self._req_lock = threading.Lock()
         self._req_n = 0
         self._boot = uuid.uuid4().hex[:12]
+        # fleet telemetry piggyback: the flat series view this rank
+        # already shipped (heartbeats send only what changed since) and
+        # the fleet-view version it last absorbed from a reply.  Only
+        # the heartbeat thread touches either.
+        self._tm_sent: Dict[str, float] = {}
+        self._tm_fleet_ver = -1
         # heartbeat replies are always prompt, so the hb socket carries
         # a recv timeout — a FROZEN (silently dead) coordinator surfaces
         # as a liveness failure here instead of hanging forever
@@ -2070,13 +2125,28 @@ class ProcessGroup:
     # -- failure detection ---------------------------------------------------------
     def _heartbeat_once(self) -> dict:
         from ..faults.netfabric import FABRIC
+        from ..utils import telemetry
         FABRIC.check_send(self.rank, self.coord_rank, what="heartbeat")
+        # fleet telemetry piggyback: ship only the series that changed
+        # since the last acked beat (cumulative values — the merge is
+        # replacement, so duplicated delivery cannot double-count)
+        tm = telemetry.wire_delta(self._tm_sent) \
+            if telemetry.enabled() else {}
+        frame = {"op": "heartbeat", "rank": self.rank,
+                 "epoch": self.epoch, "inc": self.inc,
+                 "gen": self.coord_gen, "tmv": self._tm_fleet_ver,
+                 "req": self._next_req(), "boot": self._boot}
+        if tm:
+            frame["tm"] = tm
         with self._hb_lock:
-            _send(self._hb_sock, {"op": "heartbeat", "rank": self.rank,  # srtlint: ignore[lock-discipline, shared-state-races] (the hb lock serializes this rank's dedicated heartbeat socket and nothing nests under it; failover swaps self._hb_sock then shutdown-closes the old one, so a stale read fails typed into _failover)
-                                  "epoch": self.epoch, "inc": self.inc,
-                                  "gen": self.coord_gen,
-                                  "req": self._next_req(), "boot": self._boot})
+            _send(self._hb_sock, frame)  # srtlint: ignore[lock-discipline, shared-state-races] (the hb lock serializes this rank's dedicated heartbeat socket and nothing nests under it; failover swaps self._hb_sock then shutdown-closes the old one, so a stale read fails typed into _failover)
             msg, _ = _recv(self._hb_sock)  # srtlint: ignore[lock-discipline, shared-state-races] (heartbeat replies are immediate coordinator responses; the socket dies with close() on rank death, and a failover/heal swap shutdown-closes the old one so a stale read fails typed)
+        if tm:
+            self._tm_sent.update(tm)
+        fleet = msg.get("tm_fleet")
+        if fleet:
+            telemetry.set_fleet(fleet)
+            self._tm_fleet_ver = int(fleet.get("version", 0))
         if msg.get("fenced"):
             self.fenced = True  # srtlint: ignore[shared-state-races] (one-way latch: only ever flips False→True; stale readers re-learn it on their next fenced reply)
             raise PeerLostError(
@@ -2486,14 +2556,24 @@ class ProcessGroup:
         FABRIC.check_send(self.rank, rank,
                           what=f"fetch {shuffle_id}[{part}]")
         host, port = self.peers[rank]
+        from ..utils import tracing
+        # cross-rank trace stitching: the request frame carries the
+        # active trace's (id, label) so the serving rank's work lands
+        # in a per-rank trace shard parented under this query's root
+        tctx = tracing.trace_context()
+        frame = {"op": "fetch", "shuffle": shuffle_id,
+                 "part": part, "epoch": self.epoch,
+                 "rank": self.rank, "inc": self.inc,
+                 "req": self._next_req(), "boot": self._boot}
+        if tctx is not None:
+            frame["trace"] = tctx
+        sp = tracing.span(None, "dcn:fetch", "shuffle")
+        sp.set(rank=rank, part=part, shuffle=shuffle_id)
         t0 = time.monotonic()  # span-api-ok (straggler detection, not span timing)
         try:
-            with socket.create_connection(
+            with sp, socket.create_connection(
                     (host, port), timeout=self._fetch_timeout) as s:
-                _send(s, {"op": "fetch", "shuffle": shuffle_id,
-                          "part": part, "epoch": self.epoch,
-                          "rank": self.rank, "inc": self.inc,
-                          "req": self._next_req(), "boot": self._boot})
+                _send(s, frame)
                 msg, payload = _recv(s)
         except (ConnectionError, OSError) as e:
             self.check_peers()  # prefer the heartbeat diagnosis if present

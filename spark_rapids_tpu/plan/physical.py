@@ -58,6 +58,10 @@ class ExecContext:
         # contract (identical re-arms preserve its RNG + engage state)
         from ..faults.netfabric import FABRIC as NET_FABRIC
         NET_FABRIC.arm_from_conf(self.conf)
+        # the live metrics registry arms/disarms on the same per-query
+        # contract (telemetry.enabled + the server.slo.* objectives)
+        from ..utils import telemetry
+        telemetry.configure(self.conf)
 
     def metric_set(self, op_id: str) -> MetricSet:
         if op_id not in self.metrics:

@@ -90,16 +90,24 @@ class RetryBudget:
 
 
 class ResultSet:
-    """A collected wire result: schema, pyarrow tables, END stats."""
+    """A collected wire result: schema, pyarrow tables, END stats.
 
-    __slots__ = ("query_id", "schema", "tables", "stats", "prepared")
+    ``wire_bytes`` counts the BATCH frames as received (header +
+    payload) — the client side of the telemetry reconciliation:
+    summed across a run it must equal the server's
+    ``server_stream_bytes_total`` exactly."""
 
-    def __init__(self, query_id, schema, tables, stats, prepared):
+    __slots__ = ("query_id", "schema", "tables", "stats", "prepared",
+                 "wire_bytes")
+
+    def __init__(self, query_id, schema, tables, stats, prepared,
+                 wire_bytes: int = 0):
         self.query_id = query_id
         self.schema = schema
         self.tables = tables
         self.stats = stats
         self.prepared = prepared
+        self.wire_bytes = int(wire_bytes)
 
     def table(self):
         """One concatenated pyarrow table (None for an empty result)."""
@@ -154,6 +162,16 @@ class WireClient:
         # retry_at_monotonic]; cleared on any successful connect.
         self._down: Dict[Tuple[str, int], list] = {}
         self.endpoints_demoted = 0
+        # BATCH-frame bytes received through query_stream (collected
+        # results carry theirs on ResultSet.wire_bytes) — the client
+        # half of the stream-byte reconciliation
+        self.stream_wire_bytes = 0
+        # typed ERROR frames RECEIVED, by code (internal shed retries
+        # included — one entry per frame off the wire), plus the shed
+        # taxonomy by server reason: the client half of the
+        # server_wire_errors_total / queries_shed_total reconciliation
+        self.error_frames: Dict[str, int] = {}
+        self.shed_reasons: Dict[str, int] = {}
         self.session_id: Optional[str] = None
         self._sock: Optional[socket.socket] = None
         self._connect(self.addr)
@@ -247,6 +265,26 @@ class WireClient:
                     last = e
         raise exc from last
 
+    # -- frame accounting ---------------------------------------------------------
+    def recv_frame(self, expect) -> Tuple[bytes, bytes]:
+        """One choke point over ``recv_frame`` counting every typed
+        ERROR frame this client receives (GOAWAYs excluded — the
+        server tallies those separately), so client-observed error
+        totals reconcile EXACTLY with the server's
+        ``server_wire_errors_total`` counter."""
+        try:
+            return P.recv_frame(self._sock, expect=expect)
+        except ServerDraining:
+            raise
+        except WireError as e:
+            self.error_frames[e.code] = \
+                self.error_frames.get(e.code, 0) + 1
+            if e.reason and e.code in ("REJECTED", "QUOTA_EXCEEDED",
+                                       "QUARANTINED"):
+                self.shed_reasons[e.reason] = \
+                    self.shed_reasons.get(e.reason, 0) + 1
+            raise
+
     # -- retry-storm control ------------------------------------------------------
     def _shed_pause(self, e: WireError, attempt: int) -> bool:
         """Decide-and-pace one overload retry: honors the server's
@@ -274,8 +312,7 @@ class WireClient:
             try:
                 P.send_frame(self._sock, P.REQ_PREPARE,
                              P.pack_json({"spec": spec}))
-                _, payload = P.recv_frame(self._sock,
-                                          expect=(P.RSP_PREPARED,))
+                _, payload = self.recv_frame(expect=(P.RSP_PREPARED,))
                 info = P.unpack_json(payload)
                 self._stmts[info["statement_id"]] = spec
                 self._note_success()
@@ -377,8 +414,7 @@ class WireClient:
         for attempt in range(_GOAWAY_RETRIES):
             try:
                 P.send_frame(self._sock, P.REQ_SUBMIT, P.pack_json(req))
-                ftype, payload = P.recv_frame(self._sock,
-                                              expect=(P.RSP_META,))
+                ftype, payload = self.recv_frame(expect=(P.RSP_META,))
                 break
             except ServerDraining as e:
                 if attempt == _GOAWAY_RETRIES - 1:
@@ -389,46 +425,56 @@ class WireClient:
         yield "meta", P.unpack_json(payload)
         batches = 0
         while True:
-            ftype, payload = P.recv_frame(
-                self._sock, expect=(P.RSP_BATCH, P.RSP_END))
+            ftype, payload = self.recv_frame(expect=(P.RSP_BATCH, P.RSP_END))
             if ftype == P.RSP_END:
                 end = P.unpack_json(payload)
                 _check_batch_count(end, batches)
                 yield "end", end
                 return
             batches += 1
+            self.stream_wire_bytes += P.FRAME.size + len(payload)
             yield "batch", _read_ipc(payload)
 
     def _collect_result(self) -> ResultSet:
-        ftype, payload = P.recv_frame(self._sock, expect=(P.RSP_META,))
+        ftype, payload = self.recv_frame(expect=(P.RSP_META,))
         meta = P.unpack_json(payload)
         tables = []
+        wire_bytes = 0
         while True:
-            ftype, payload = P.recv_frame(
-                self._sock, expect=(P.RSP_BATCH, P.RSP_END))
+            ftype, payload = self.recv_frame(expect=(P.RSP_BATCH, P.RSP_END))
             if ftype == P.RSP_END:
                 end = P.unpack_json(payload)
                 _check_batch_count(end, len(tables))
                 return ResultSet(meta["query_id"], meta["schema"],
-                                 tables, end, end.get("prepared", False))
+                                 tables, end, end.get("prepared", False),
+                                 wire_bytes=wire_bytes)
+            wire_bytes += P.FRAME.size + len(payload)
             tables.append(_read_ipc(payload))
 
     # -- control ------------------------------------------------------------------
     def cancel(self, query_id: str) -> bool:
         P.send_frame(self._sock, P.REQ_CANCEL,
                      P.pack_json({"query_id": query_id}))
-        _, payload = P.recv_frame(self._sock, expect=(P.RSP_CANCELLED,))
+        _, payload = self.recv_frame(expect=(P.RSP_CANCELLED,))
         return bool(P.unpack_json(payload)["cancelled"])
 
     def status(self) -> Dict[str, Any]:
         P.send_frame(self._sock, P.REQ_STATUS)
-        _, payload = P.recv_frame(self._sock, expect=(P.RSP_STATUS,))
+        _, payload = self.recv_frame(expect=(P.RSP_STATUS,))
+        return P.unpack_json(payload)
+
+    def ops(self) -> Dict[str, Any]:
+        """The typed OPS op: the unified ops snapshot (same payload as
+        the HTTP listener's /snapshot) over this connection — served
+        even while the door drains."""
+        P.send_frame(self._sock, P.REQ_OPS)
+        _, payload = self.recv_frame(expect=(P.RSP_OPS,))
         return P.unpack_json(payload)
 
     def close(self) -> None:
         try:
             P.send_frame(self._sock, P.REQ_BYE)
-            P.recv_frame(self._sock, expect=(P.RSP_BYE,))
+            self.recv_frame(expect=(P.RSP_BYE,))
         except (OSError, WireError, P.ProtocolError):
             pass  # fault-ok (best-effort goodbye; the server reaps dead connections either way)
         try:

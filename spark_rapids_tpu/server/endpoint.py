@@ -28,6 +28,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from . import protocol as P
+from ..utils import telemetry
 from .prepared import PreparedCache
 from .protocol import WireError
 from .session import ClientSession, TenantQuotas, authenticate
@@ -85,6 +86,7 @@ class SqlFrontDoor:
         self._conn_ids = itertools.count(1)
         self._srv: Optional[socket.socket] = None
         self._accept_th: Optional[threading.Thread] = None
+        self._ops = None  # the HTTP ops listener (server/ops.py)
         self._closed = False
         # graceful drain (planned restart): once set, new connections
         # and new query requests are answered with a GOAWAY frame
@@ -134,12 +136,25 @@ class SqlFrontDoor:
             target=self._accept_loop, daemon=True,
             name="srt-server-accept")
         self._accept_th.start()
+        # the ops scrape surface rides beside the door: HTTP /metrics,
+        # /healthz, /snapshot (telemetry armed from the same conf)
+        telemetry.configure(conf)
+        if conf["spark.rapids.tpu.server.ops.enabled"]:
+            from .ops import OpsServer
+            self._ops = OpsServer(
+                self, host,
+                conf["spark.rapids.tpu.server.ops.port"]).start()
         return self
 
     @property
     def port(self) -> int:
         assert self._srv is not None, "start() first"
         return self._srv.getsockname()[1]
+
+    @property
+    def ops_port(self) -> Optional[int]:
+        """The HTTP ops listener's bound port (None when disabled)."""
+        return self._ops.port if self._ops is not None else None
 
     def begin_drain(self, siblings: Optional[list] = None) -> None:
         """Phase 1 of a graceful drain: flip into DRAINING — new
@@ -233,6 +248,8 @@ class SqlFrontDoor:
                 self._srv.close()
             except OSError:
                 pass
+        if self._ops is not None:
+            self._ops.close()
         if self._accept_th is not None:
             self._accept_th.join(timeout=2.0)
         for th in threads:
@@ -260,6 +277,7 @@ class SqlFrontDoor:
                     over = False
                     cid = next(self._conn_ids)
                     self._conns[cid] = conn
+            telemetry.count("server_connections_total")
             if draining:
                 # a draining door refuses new connections with GOAWAY —
                 # the reply NAMES the live siblings, so the client's
@@ -273,12 +291,15 @@ class SqlFrontDoor:
             if over:
                 with self._lock:
                     self.connections_rejected += 1
+                telemetry.count("server_connections_rejected_total")
                 try:
                     P.send_frame(conn, P.RSP_ERROR, WireError(
                         "REJECTED",
                         f"connection cap reached "
                         f"(maxConnections={max_conns}); retry later"
                     ).to_payload())
+                    telemetry.count("server_wire_errors_total",
+                                    code="REJECTED")
                 except OSError:
                     pass
                 try:
@@ -320,6 +341,14 @@ class SqlFrontDoor:
                 if ftype == P.REQ_STATUS:
                     P.send_frame(conn, P.RSP_STATUS,
                                  P.pack_json(self.snapshot()))
+                    continue
+                if ftype == P.REQ_OPS:
+                    # the typed ops surface over the wire — served even
+                    # while DRAINING (observability outlives admission;
+                    # this branch sits above the drain gate on purpose)
+                    telemetry.count("ops_scrapes_total", endpoint="wire")
+                    P.send_frame(conn, P.RSP_OPS,
+                                 P.pack_json(self.ops_snapshot()))
                     continue
                 if ftype == P.REQ_CANCEL:
                     req = P.unpack_json(payload)
@@ -388,12 +417,16 @@ class SqlFrontDoor:
                 retry_after_ms=hint))
             with self._lock:
                 self.goaways_sent += 1
+            telemetry.count("server_goaways_total")
         except OSError:
             pass
 
     def _try_error(self, conn, err: WireError) -> None:
         try:
             P.send_frame(conn, P.RSP_ERROR, err.to_payload())
+            # counted only when the frame actually left: the client-
+            # observed typed-error tally reconciles against this
+            telemetry.count("server_wire_errors_total", code=err.code)
         except OSError:
             pass
 
@@ -612,6 +645,7 @@ class SqlFrontDoor:
         with self._lock:
             self.queries_total += 1
             self._queries[query_id] = wq
+        telemetry.count("server_queries_total")
         return wq
 
     def _stream_result(self, conn, wq: _WireQuery, schema,
@@ -648,6 +682,7 @@ class SqlFrontDoor:
                 sent += n
                 with self._lock:
                     self.streamed_bytes += n
+                telemetry.count("server_stream_bytes_total", n)
                 tr = wq.handle.trace()
                 if tr is not None:
                     tr.add_event(None, "server:stream_write", "server",
@@ -718,6 +753,8 @@ class SqlFrontDoor:
             return
         with self._lock:
             self.spooled_bytes += wq.stream.spooled_bytes
+        telemetry.count("server_spool_bytes_total",
+                        wq.stream.spooled_bytes)
         # the producer finished; the handle resolves imminently
         try:
             wq.handle.result(timeout=30.0)
@@ -735,6 +772,9 @@ class SqlFrontDoor:
              "queue_wait_ms": round(wq.handle.queue_wait_s * 1e3, 3),
              "latency_ms": round((wq.handle.latency_s or 0.0) * 1e3, 3),
              "stats": wq.handle.stats or {}}))
+        # counted only after the END frame left the socket, so the
+        # client-observed success tally reconciles exactly against it
+        telemetry.count("server_queries_streamed_total")
 
     # -- cleanup ------------------------------------------------------------------
     def _client_gone(self, wq: _WireQuery) -> None:
@@ -745,6 +785,7 @@ class SqlFrontDoor:
         scheduler unwind — the leak-hygiene tests assert all of it."""
         with self._lock:
             self.conn_lost += 1
+        telemetry.count("server_conn_lost_total")
         wq.handle.cancel("client disconnected")
         wq.stream.close()
 
@@ -781,6 +822,59 @@ class SqlFrontDoor:
             **counters,
             "scheduler": sched.snapshot(),
             "prepared": self.prepared.snapshot(),
+        }
+
+    def health(self) -> Dict[str, Any]:
+        """Drain/brownout/quarantine-aware liveness for ``/healthz``:
+        ``serving`` is False (HTTP 503) while draining or closed — a
+        balancer must stop routing here; brownout keeps serving (200)
+        but says ``degraded``; the open-breaker count rides along
+        either way."""
+        with self._lock:
+            draining, closed = self._draining, self._closed
+        brownout = False
+        quarantined = 0
+        try:
+            sched = self._session.scheduler()
+            brownout = bool(sched.brownout.snapshot().get("active"))
+            quarantined = int(sched.breaker.snapshot().get("open", 0))
+        except Exception:  # fault-ok (a torn-down scheduler mid-close must not fail liveness)
+            pass
+        status = ("closed" if closed else "draining" if draining
+                  else "degraded" if brownout else "ok")
+        return {"status": status,
+                "serving": not (draining or closed),
+                "draining": draining,
+                "brownout": brownout,
+                "quarantined": quarantined}
+
+    def ops_snapshot(self) -> Dict[str, Any]:
+        """The unified ops view: front-door counters + the scheduler's
+        snapshot (admission/breaker/brownout included) + tenant quotas
+        + prepared and device caches + the live metrics registry + SLO
+        burn + the DCN fleet rollup — one JSON document any door can
+        serve (``/snapshot`` and the wire OPS op)."""
+        from ..utils import telemetry as _tm
+        snap = self.snapshot()
+        quotas = {
+            "inflight_total": self.quotas.inflight(),
+        }
+        cache = {}
+        try:
+            cache = self._session.query_cache().snapshot()
+        except Exception:  # fault-ok (no initialized device backend in pure-protocol tests)
+            pass
+        return {
+            "health": self.health(),
+            "server": {k: v for k, v in snap.items()
+                       if k not in ("scheduler", "prepared")},
+            "scheduler": snap["scheduler"],
+            "prepared": snap["prepared"],
+            "quotas": quotas,
+            "cache": cache,
+            "telemetry": _tm.snapshot(),
+            "slo": _tm.slo_snapshot(),
+            "fleet": _tm.fleet(),
         }
 
 

@@ -1,0 +1,125 @@
+"""HTTP ops listener: the scrape surface beside each front door.
+
+A deliberately tiny plaintext HTTP server (stdlib ``http.server``, one
+accept thread, per-request handler threads) bound from
+``spark.rapids.tpu.server.ops.port`` when ``server.ops.enabled``:
+
+  * ``GET /metrics`` — Prometheus exposition of the live registry
+    (:mod:`..utils.telemetry`), the fleet scraper's entry point;
+  * ``GET /healthz`` — liveness that tells the TRUTH about serving
+    state: 503 while draining or closed (a load balancer must stop
+    routing here), 200 with a ``degraded`` body during brownout, and
+    the count of quarantined statement fingerprints either way;
+  * ``GET /snapshot`` — the unified JSON view (front-door counters +
+    scheduler/admission/breaker/brownout + tenant quotas + prepared
+    and device caches + telemetry + SLO burn + the DCN fleet rollup)
+    that ``tools/srtop.py`` polls and ``tools/loadgen.py`` reconciles
+    against client-observed truth.
+
+The same ``/snapshot`` payload is served over the wire protocol's
+typed ``OPS`` op (:data:`..server.protocol.REQ_OPS`), so a scraper
+that already speaks the protocol needs no second port.  Scrapes read
+copies of the registry — a scrape storm never blocks the query path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils import telemetry
+
+__all__ = ["OpsServer"]
+
+
+class OpsServer:
+    """One front door's HTTP ops listener.  ``start()`` binds and
+    serves on a daemon thread; ``close()`` shuts down and joins it."""
+
+    def __init__(self, door, host: str, port: int):
+        self._door = door
+        self._host = host
+        self._port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "OpsServer":
+        door = self._door
+
+        class _Handler(BaseHTTPRequestHandler):
+            # bounded per-request socket ops: a wedged scraper cannot
+            # pin a handler thread forever
+            timeout = 10.0
+
+            def log_message(self, fmt, *args):  # silence stdlib logging
+                pass
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        telemetry.count("ops_scrapes_total",
+                                        endpoint="metrics")
+                        self._reply(
+                            200,
+                            telemetry.render_prometheus().encode(),
+                            "text/plain; version=0.0.4")
+                    elif path == "/healthz":
+                        telemetry.count("ops_scrapes_total",
+                                        endpoint="healthz")
+                        health = door.health()
+                        code = 200 if health.get("serving") else 503
+                        self._reply(code,
+                                    json.dumps(health).encode(),
+                                    "application/json")
+                    elif path == "/snapshot":
+                        telemetry.count("ops_scrapes_total",
+                                        endpoint="snapshot")
+                        self._reply(
+                            200,
+                            json.dumps(door.ops_snapshot()).encode(),
+                            "application/json")
+                    else:
+                        self._reply(404, b"not found\n", "text/plain")
+                except (BrokenPipeError, ConnectionError):
+                    pass  # fault-ok (scraper went away mid-reply; nothing to clean up)
+                except Exception as e:  # the scrape surface must not die with one bad read
+                    try:
+                        self._reply(500, f"{type(e).__name__}: {e}\n"
+                                    .encode(), "text/plain")
+                    except OSError:
+                        pass  # fault-ok (reply socket already gone)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(  # ctx-ok (process-lifetime scrape listener, not per-query work)
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name="srt-ops-http")
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "start() first"
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            try:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

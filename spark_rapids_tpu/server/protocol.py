@@ -53,10 +53,10 @@ __all__ = [
     "goaway_payload",
     # request frame types
     "REQ_HELLO", "REQ_SUBMIT", "REQ_PREPARE", "REQ_EXECUTE", "REQ_CANCEL",
-    "REQ_STATUS", "REQ_BYE",
+    "REQ_STATUS", "REQ_OPS", "REQ_BYE",
     # response frame types
     "RSP_WELCOME", "RSP_META", "RSP_BATCH", "RSP_END", "RSP_ERROR",
-    "RSP_PREPARED", "RSP_CANCELLED", "RSP_STATUS", "RSP_BYE",
+    "RSP_PREPARED", "RSP_CANCELLED", "RSP_STATUS", "RSP_OPS", "RSP_BYE",
     "RSP_GOAWAY",
 ]
 
@@ -76,6 +76,12 @@ REQ_PREPARE = b"p"
 REQ_EXECUTE = b"e"
 REQ_CANCEL = b"c"
 REQ_STATUS = b"s"
+# the typed OPS op: the fleet-telemetry surface over the wire protocol
+# itself — same payload as the HTTP ops listener's /snapshot (unified
+# scheduler/admission/breaker/quota/cache/telemetry/SLO/fleet view), so
+# a scraper that already speaks the protocol needs no second port.
+# Served during a drain (observability must outlive admission).
+REQ_OPS = b"o"
 REQ_BYE = b"x"
 
 RSP_WELCOME = b"W"
@@ -86,6 +92,7 @@ RSP_ERROR = b"E"
 RSP_PREPARED = b"P"
 RSP_CANCELLED = b"C"
 RSP_STATUS = b"S"
+RSP_OPS = b"O"
 RSP_BYE = b"X"
 # GOAWAY (the HTTP/2 shape): the server is DRAINING for a planned
 # restart — it names sibling endpoints and will accept no new queries
@@ -95,10 +102,10 @@ RSP_BYE = b"X"
 RSP_GOAWAY = b"G"
 
 _REQUEST_TYPES = (REQ_HELLO, REQ_SUBMIT, REQ_PREPARE, REQ_EXECUTE,
-                  REQ_CANCEL, REQ_STATUS, REQ_BYE)
+                  REQ_CANCEL, REQ_STATUS, REQ_OPS, REQ_BYE)
 _RESPONSE_TYPES = (RSP_WELCOME, RSP_META, RSP_BATCH, RSP_END, RSP_ERROR,
-                   RSP_PREPARED, RSP_CANCELLED, RSP_STATUS, RSP_BYE,
-                   RSP_GOAWAY)
+                   RSP_PREPARED, RSP_CANCELLED, RSP_STATUS, RSP_OPS,
+                   RSP_BYE, RSP_GOAWAY)
 
 # THE canonical error-code vocabulary (the table above, plus DRAINING —
 # the GOAWAY shed).  srtlint's protocol-conformance pass holds every

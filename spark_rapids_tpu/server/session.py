@@ -95,6 +95,8 @@ class TenantQuotas:
                 cap = max(1, int(cap * max(0.0, scale)))
             cur = self._inflight.get(tenant, 0)
             if cap > 0 and cur >= cap:
+                from ..utils import telemetry
+                telemetry.count("queries_shed_total", reason="quota")
                 raise WireError(
                     "QUOTA_EXCEEDED",
                     f"tenant {tenant!r} at its in-flight cap ({cap}"

@@ -317,6 +317,9 @@ class BrownoutController:
                      "scheduler", alive=int(alive), world=int(world),
                      epoch=int(epoch),
                      fraction=round(frac, 3))
+        from ..utils import telemetry
+        telemetry.gauge_set("brownout_active",
+                            1.0 if transition == "enter" else 0.0)
 
     def fraction(self) -> float:
         with self._lock:
@@ -576,6 +579,8 @@ class AdmissionController:
             self.sheds[reason] = self.sheds.get(reason, 0) + 1
         tracing.mark(None, "admission:shed", "scheduler", reason=reason,
                      label=label, retry_after_ms=retry_after_ms)
+        from ..utils import telemetry
+        telemetry.count("queries_shed_total", reason=reason)
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:

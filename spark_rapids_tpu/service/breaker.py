@@ -299,6 +299,9 @@ class BreakerRegistry:
                 b.canary_inflight = True
                 b.canary_started_t = now
                 self.canaries += 1
+                from ..utils import telemetry
+                telemetry.count("breaker_transitions_total",
+                                state="half_open")
                 return "canary", 0
             # half_open: one canary at a time.  A canary that vanished
             # without reporting (shed in queue during a drain/close)
@@ -406,6 +409,13 @@ class BreakerRegistry:
         if transition is not None:
             tracing.mark(None, f"breaker:{transition}", "fault",
                          fingerprint=fingerprint[:12])
+            from ..utils import telemetry
+            telemetry.count("breaker_transitions_total",
+                            state=transition)
+            with self._lock:
+                n_open = sum(1 for b in self._breakers.values()
+                             if b.state != "closed")
+            telemetry.gauge_set("breakers_open", float(n_open))
 
     def bundle_for(self, fingerprint: Optional[str]) -> Optional[str]:
         """The fingerprint's current diagnosis-bundle id (stamped on

@@ -451,7 +451,11 @@ class QueryScheduler:
                 entry.canary = canary
                 self._queue.append(entry)
                 self.submitted += 1
+                depth_now = len(self._queue)
                 self._cv.notify_all()
+            from ..utils import telemetry
+            telemetry.count("queries_submitted_total", tenant=tenant)
+            telemetry.gauge_set("queue_depth", float(depth_now))
         except QueryRejected as exc:
             if canary:
                 # this submission held the one half-open canary slot but
@@ -803,7 +807,20 @@ class QueryScheduler:
                 self.cancelled += 1
             if status == "drained":
                 self.drained += 1
+            running_now, depth_now = len(self._running), len(self._queue)
             self._cv.notify_all()
+        # live telemetry + SLO burn feed (outside the scheduler lock):
+        # the completion is the choke point every consumer shares —
+        # counters by status/tenant, the latency histogram, and the
+        # per-tenant good/bad event behind the burn-rate gauges
+        from ..utils import telemetry
+        latency = e.finished_t - e.submitted_t
+        telemetry.count("queries_completed_total", status=status,
+                        tenant=t)
+        telemetry.observe("query_latency_seconds", latency, tenant=t)
+        telemetry.slo_observe(t, latency, ok=(status == "done"))
+        telemetry.gauge_set("queries_running", float(running_now))
+        telemetry.gauge_set("queue_depth", float(depth_now))
         if error is not None:
             e.future.set_exception(error)
         else:

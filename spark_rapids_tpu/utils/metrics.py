@@ -207,6 +207,13 @@ class QueryStats:
                 _STATS_STACK.set(tuple(
                     x for x in _STATS_STACK.get() if x is not s))
             cls.get()._absorb(s)
+            if not _STATS_STACK.get():
+                # the scope exited to the PROCESS aggregate: mirror the
+                # query's counts into the live metrics registry — THE
+                # fold-in choke point (nested scopes fold outward and
+                # reach here exactly once, so nothing double-counts)
+                from . import telemetry
+                telemetry.fold_query_stats(s)
 
     def _absorb(self, other: "QueryStats") -> None:
         for k, v in other.__dict__.items():
@@ -278,6 +285,18 @@ _SYNC_TRACE_DROPPED = [0]
 def sync_trace_dropped() -> int:
     """Entries dropped from SYNC_TRACE after it hit SYNC_TRACE_MAX."""
     return _SYNC_TRACE_DROPPED[0]
+
+
+def _export_sync_trace_drops() -> None:
+    """Scrape-time provider: the SYNC_TRACE debug list's drop count is
+    visible on the ops surface instead of silently lost."""
+    from . import telemetry
+    telemetry.gauge_set("sync_trace_dropped", float(sync_trace_dropped()))
+
+
+from . import telemetry as _telemetry  # noqa: E402 (after the state it exports)
+
+_telemetry.register_provider(_export_sync_trace_drops)
 
 
 def _sync_trace_append(entry) -> None:

@@ -43,7 +43,8 @@ from ..service import cancel as _cancel
 
 __all__ = ["QueryTrace", "active", "query_trace", "span", "record", "mark",
            "instrument_batches", "render_profiled", "NULL_SPAN",
-           "merge_chrome", "write_merged"]
+           "merge_chrome", "write_merged", "trace_context",
+           "shard_record", "shard_paths"]
 
 _pc = time.perf_counter
 
@@ -112,6 +113,12 @@ class QueryTrace:
 
     def __init__(self, label: str, max_events: int = DEFAULT_MAX_EVENTS):
         self.label = label
+        # cross-rank identity: DCN request frames carry it so remote
+        # serve-side work (fetches, re-pulls) lands in per-rank trace
+        # SHARDS beside this trace, stitched back into one Perfetto
+        # tree by ``tools/trace_report.py --stitch``
+        import uuid as _uuid
+        self.trace_id = _uuid.uuid4().hex[:16]
         self.t0 = _pc()
         self.wall_start = time.time()
         self.t_end: Optional[float] = None
@@ -173,6 +180,19 @@ class QueryTrace:
 
     def add_event(self, op_id, name, cat, t0, dur, args=None) -> None:
         if len(self.events) >= self.max_events:
+            if self.dropped == 0:
+                # a truncated trace must be VISIBLY truncated on the
+                # timeline, not just in otherData: the first overflow
+                # appends a single forced trace:events_dropped mark
+                # (the only event allowed past the cap)
+                with self._lock:
+                    if self.dropped == 0:
+                        self.dropped = 1
+                        self.events.append((
+                            None, "trace:events_dropped", "mark",
+                            max(0.0, t0 - self.t0), 0.0, self._tid(),
+                            {"max_events": self.max_events}))
+                        return
             self.dropped += 1
             return
         self.events.append((op_id, name, cat, max(0.0, t0 - self.t0),
@@ -193,6 +213,11 @@ class QueryTrace:
         query-scoped QueryStats snapshot becomes root attributes."""
         if self.t_end is None:
             self.t_end = _pc()
+        if self.dropped:
+            # drop accounting reaches the live metrics registry too, so
+            # a scraper sees truncation without opening the trace file
+            from . import telemetry
+            telemetry.count("trace_events_dropped_total", self.dropped)
         if stats:
             self.attrs.update(stats)
         for op_id, mset in (metrics or {}).items():
@@ -237,9 +262,10 @@ class QueryTrace:
             "displayTimeUnit": "ms",
             "otherData": {"label": self.label,
                           "status": self.status,
+                          "trace_id": self.trace_id,
                           "dropped_events": self.dropped,
                           "wall_s": round(self.duration_s, 6),
-                          "wall_start_epoch_s": round(self.wall_start, 3)},
+                          "wall_start_epoch_s": round(self.wall_start, 6)},
             "spanTree": self.roots,
         }
 
@@ -349,6 +375,70 @@ def mark(op_id: Optional[str], name: str, cat: str = "mark",
     tr = _ACTIVE.get()
     if tr is not None:
         tr.add_event(op_id, name, cat, _pc(), 0.0, args or None)
+
+
+# ---------------------------------------------------------------------------------
+# Cross-rank trace shards: remote work done ON BEHALF of another rank's
+# traced query (a peer server streaming shuffle fragments to it) lands
+# in a per-rank shard file beside the query trace, keyed by the
+# requester's trace id — ``tools/trace_report.py --stitch`` merges the
+# shards into ONE Perfetto tree parented under the query root.
+# ---------------------------------------------------------------------------------
+
+_SHARD_LOCK = threading.Lock()
+
+
+def trace_context() -> Optional[list]:
+    """The active trace's cross-rank context — ``[trace_id, label]`` —
+    for stamping onto DCN request frames; None when untraced (remote
+    sides then record nothing)."""
+    tr = _ACTIVE.get()
+    if tr is None:
+        return None
+    return [tr.trace_id, tr.label]
+
+
+def _shard_dir() -> str:
+    from ..config import TpuConf
+    return TpuConf()["spark.rapids.tpu.sql.trace.dir"]
+
+
+def shard_path(trace_id: str, rank: int, directory: str) -> str:
+    import os
+    return os.path.join(directory, f"{trace_id}.rank{rank}.shard.jsonl")
+
+
+def shard_record(trace_id: str, rank: int, name: str, cat: str,
+                 t_wall: float, dur_s: float, **args) -> None:
+    """Append one serve-side span to this rank's shard for the remote
+    query ``trace_id``.  Timestamps are WALL epoch seconds (the only
+    clock two hosts share well enough for a merged timeline); no-op
+    when ``sql.trace.dir`` is unset — shards only exist where traces
+    are being dumped."""
+    directory = _shard_dir()
+    if not directory or not trace_id:
+        return
+    import os
+    rec = {"trace_id": trace_id, "rank": int(rank), "name": name,
+           "cat": cat, "t_wall": round(t_wall, 6),
+           "dur_s": round(max(0.0, dur_s), 6)}
+    if args:
+        rec["args"] = args
+    line = json.dumps(rec, sort_keys=True)
+    path = shard_path(trace_id, rank, directory)
+    with _SHARD_LOCK:
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(line + "\n")
+
+
+def shard_paths(trace_id: str, directory: str) -> List[str]:
+    """Every rank shard written for ``trace_id`` under ``directory``
+    (the stitch tool's discovery step)."""
+    import glob
+    import os
+    return sorted(glob.glob(os.path.join(
+        directory, f"{trace_id}.rank*.shard.jsonl")))
 
 
 # ---------------------------------------------------------------------------------

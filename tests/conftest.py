@@ -53,7 +53,7 @@ def rng():
 
 def pytest_collection_modifyitems(config, items):
     """Collection-time static analysis: ONE cached srtlint scan
-    (tools/srtlint — AST engine, twelve passes over a single shared
+    (tools/srtlint — AST engine, thirteen passes over a single shared
     parse) replaces the five regex lints that each re-read the whole
     tree here.  The scan is keyed by per-file CONTENT hashes: an
     unchanged tree re-verifies in milliseconds, and a changed tree

@@ -421,13 +421,14 @@ class TestEngine:
         assert "lock-acquisition graph" in capsys.readouterr().out
         assert engine.main(["--explain", "nope"]) == 2
 
-    def test_explain_covers_all_twelve_rules(self):
+    def test_explain_covers_all_thirteen_rules(self):
         rules = engine.available_rules()
         assert rules == ["blocking-fetch", "span-timing", "ctx-threads",
                          "cache-keys", "fault-paths", "release-paths",
                          "lock-discipline", "shutdown-paths",
                          "shared-state-races", "typestate",
-                         "protocol-conformance", "conf-registry"]
+                         "protocol-conformance", "metrics-registry",
+                         "conf-registry"]
         for r in rules:
             assert r in engine.explain_rule(r)
 
@@ -754,6 +755,92 @@ class TestProtocolConformance:
         assert "DRAINING" in P.ERROR_CODES
         assert set(dcn._COORD_OPS) < set(dcn.DCN_OPS)
         assert "fetch" in dcn.DCN_OPS and "journal" in dcn.DCN_OPS
+
+
+_METRICS_FIXTURE = {
+    "utils/telemetry.py": (
+        "METRICS = (\n"
+        '    ("hits_total", "counter", "", "hits"),\n'
+        '    ("dead_gauge", "gauge", "", "nobody emits this"),\n'
+        '    ("folded_total", "counter", "", "fold target"),\n'
+        ")\n"
+        "_QS_FOLD = (\n"
+        '    ("hits", "folded_total"),\n'
+        ")\n"
+        "def count(name, amount=1, **labels):\n"
+        "    pass\n"
+        "def gauge_set(name, value, **labels):\n"
+        "    pass\n"
+        "def observe(name, value, **labels):\n"
+        "    pass\n"),
+    "service/user.py": (
+        "from ..utils import telemetry\n"
+        "def f(kind):\n"
+        "    telemetry.count('hits_total')\n"
+        "    telemetry.count('unregistered_total')\n"
+        "    telemetry.gauge_set('made_' + kind, 1.0)\n"),
+}
+
+
+class TestMetricsRegistry:
+    def test_two_way_vocabulary(self, tmp_path):
+        report = _lint(tmp_path, _METRICS_FIXTURE, ["metrics-registry"])
+        msgs = sorted(f.message for f in report.failing)
+        # unregistered at a call site
+        assert any("'unregistered_total' is emitted here but not "
+                   "registered" in m for m in msgs)
+        # runtime-assembled name
+        assert any("assembled at runtime" in m for m in msgs)
+        # registered but never emitted (fold targets count as emitted)
+        assert any("dead metric vocabulary: 'dead_gauge'" in m
+                   for m in msgs)
+        assert not any("folded_total" in m for m in msgs)
+        assert not any("'hits_total'" in m for m in msgs)
+        assert len(report.failing) == 3
+
+    def test_registration_fixes_use_and_emitter_fixes_dead(
+            self, tmp_path):
+        files = dict(_METRICS_FIXTURE)
+        files["utils/telemetry.py"] = files["utils/telemetry.py"] \
+            .replace('    ("dead_gauge", "gauge", "", "nobody emits '
+                     'this"),\n',
+                     '    ("unregistered_total", "counter", "", '
+                     '"now registered"),\n')
+        files["service/user.py"] = (
+            "from ..utils import telemetry\n"
+            "def f():\n"
+            "    telemetry.count('hits_total')\n"
+            "    telemetry.count('unregistered_total')\n")
+        report = _lint(tmp_path, files, ["metrics-registry"])
+        assert report.failing == [], [f.message for f in report.failing]
+
+    def test_reasoned_suppression(self, tmp_path):
+        files = dict(_METRICS_FIXTURE)
+        files["service/user.py"] = files["service/user.py"] \
+            .replace(
+                "    telemetry.count('unregistered_total')\n",
+                "    telemetry.count('unregistered_total')  # srtlint: ignore[metrics-registry] (emitted for an out-of-tree dashboard)\n") \
+            .replace(
+                "    telemetry.gauge_set('made_' + kind, 1.0)\n", "")
+        files["utils/telemetry.py"] = files["utils/telemetry.py"] \
+            .replace('    ("dead_gauge", "gauge", "", "nobody emits '
+                     'this"),\n', "")
+        report = _lint(tmp_path, files, ["metrics-registry"])
+        assert report.failing == [], [f.message for f in report.failing]
+        assert any("unregistered_total" in f.message
+                   for f in report.suppressed)
+
+    def test_real_registry_exists(self):
+        """The canonical table the pass checks against, and its
+        runtime enforcement."""
+        from spark_rapids_tpu.utils import telemetry
+        names = {m[0] for m in telemetry.METRICS}
+        assert "queries_shed_total" in names
+        assert "slo_burn_rate" in names
+        for _field, metric in telemetry._QS_FOLD:
+            assert metric in names, metric
+        with pytest.raises(KeyError):
+            telemetry.count("never_registered_total")
 
 
 class TestBaselineDrift:

@@ -246,8 +246,14 @@ def test_trace_event_cap_drops_not_grows(sess):
         sess.conf.unset(TRACE_KEY)
         sess.conf.unset("spark.rapids.tpu.sql.trace.maxEvents")
     tr = sess.last_trace()
-    assert len(tr.events) <= 5
+    # at most maxEvents stored + the ONE forced trace:events_dropped
+    # mark (the only event allowed past the cap): a truncated trace is
+    # visibly truncated on the timeline, not just in otherData
+    assert len(tr.events) <= 5 + 1
     assert tr.dropped > 0
+    marks = [e for e in tr.events if e[1] == "trace:events_dropped"]
+    assert len(marks) == 1
+    assert marks[0][6]["max_events"] == 5
     assert tr.to_chrome()["otherData"]["dropped_events"] == tr.dropped
 
 

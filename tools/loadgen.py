@@ -191,6 +191,125 @@ class Oracle:
 
 
 # ---------------------------------------------------------------------------------
+# Ops-endpoint scraping + telemetry reconciliation
+# ---------------------------------------------------------------------------------
+
+def _http_get(url: str, timeout: float = 5.0) -> str:
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def scrape_snapshot(ops_port: int, host: str = "127.0.0.1") -> dict:
+    return json.loads(_http_get(f"http://{host}:{ops_port}/snapshot"))
+
+
+def _tm_sum(tm: dict, metric: str) -> float:
+    return sum(v for v in (tm.get(metric) or {}).values()
+               if isinstance(v, (int, float)))
+
+
+def _tm_by_label(tm: dict, metric: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for lbl, v in (tm.get(metric) or {}).items():
+        if not isinstance(v, (int, float)):
+            continue
+        key = lbl.split("=", 1)[1] if "=" in lbl else lbl
+        out[key] = out.get(key, 0) + v
+    return out
+
+
+def reconcile_telemetry(tm0: dict, tm1: dict, ctr: Counters,
+                        successes: int) -> dict:
+    """The observability correctness differential: server-side counters
+    (scraped from the ops endpoint, as DELTAS over the run) must
+    reconcile EXACTLY with what the clients observed — a lying metric
+    is a failing run.  Covers completed-query count, stream bytes,
+    typed error frames by code, and the shed taxonomy by reason."""
+    mismatches: List[str] = []
+
+    def delta(metric: str) -> float:
+        return _tm_sum(tm1, metric) - _tm_sum(tm0, metric)
+
+    def delta_by(metric: str) -> Dict[str, float]:
+        a, b = _tm_by_label(tm0, metric), _tm_by_label(tm1, metric)
+        return {k: b.get(k, 0) - a.get(k, 0)
+                for k in set(a) | set(b)
+                if b.get(k, 0) != a.get(k, 0)}
+
+    checks = {
+        "queries_streamed": [delta("server_queries_streamed_total"),
+                             successes],
+        "queries_submitted_wire": [delta("server_queries_total"),
+                                   successes],
+        "stream_bytes": [delta("server_stream_bytes_total"),
+                         ctr.wire_bytes],
+    }
+    for name, (server, client) in checks.items():
+        if int(server) != int(client):
+            mismatches.append(f"{name}: server={int(server)} "
+                              f"client={int(client)}")
+    srv_errors = {k: int(v)
+                  for k, v in delta_by("server_wire_errors_total").items()
+                  if k != "DRAINING"}
+    cli_errors = {k: int(v) for k, v in ctr.error_frames.items() if v}
+    if srv_errors != cli_errors:
+        mismatches.append(f"error_frames: server={srv_errors} "
+                          f"client={cli_errors}")
+    srv_sheds = {k: int(v)
+                 for k, v in delta_by("queries_shed_total").items()}
+    cli_sheds = {k: int(v) for k, v in ctr.shed_reasons.items() if v}
+    if srv_sheds != cli_sheds:
+        mismatches.append(f"shed_taxonomy: server={srv_sheds} "
+                          f"client={cli_sheds}")
+    return {"mismatches": mismatches,
+            "checks": {k: [int(s), int(c)] for k, (s, c)
+                       in checks.items()},
+            "error_frames": cli_errors,
+            "shed_taxonomy": cli_sheds}
+
+
+class _OpsScraper:
+    """Mid-run scrape storm: polls /metrics and /snapshot on a loop
+    while the workers drive load — the ops endpoint must stay
+    responsive and never block the query path."""
+
+    def __init__(self, ops_port: int, interval_s: float = 0.25):
+        self._port = ops_port
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="loadgen-ops-scraper")
+        self.ok = 0
+        self.failed = 0
+        self.latencies_ms: List[float] = []
+
+    def start(self) -> "_OpsScraper":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        base = f"http://127.0.0.1:{self._port}"
+        while not self._stop.is_set():
+            t0 = _pc()
+            try:
+                _http_get(base + "/metrics")
+                _http_get(base + "/snapshot")
+                _http_get(base + "/healthz")
+                self.ok += 1
+                self.latencies_ms.append((_pc() - t0) * 1e3)
+            except (OSError, ValueError):
+                self.failed += 1
+            self._stop.wait(self._interval)
+
+    def stop(self) -> dict:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        return {"scrapes_ok": self.ok, "scrapes_failed": self.failed,
+                "scrape_p95_ms": round(_pct(self.latencies_ms, 0.95), 2)}
+
+
+# ---------------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------------
 
@@ -205,6 +324,32 @@ class Counters:
         self.retries = 0
         self.slow_streams = 0
         self.goaways = 0
+        # client-observed truth for the telemetry reconciliation:
+        # BATCH-frame bytes received (header included), typed ERROR
+        # frames by code (client-internal shed retries included), and
+        # the shed taxonomy by server reason
+        self.wire_bytes = 0
+        self.error_frames: Dict[str, int] = {}
+        self.shed_reasons: Dict[str, int] = {}
+
+    def fold_client(self, client) -> None:
+        """Absorb a WireClient's frame accounting (call before the
+        client is replaced or closed)."""
+        with self.lock:
+            self.goaways += client.goaways_survived
+            self.retries += client.sheds_retried
+            self.wire_bytes += client.stream_wire_bytes
+            for code, n in client.error_frames.items():
+                self.error_frames[code] = \
+                    self.error_frames.get(code, 0) + n
+            for reason, n in client.shed_reasons.items():
+                self.shed_reasons[reason] = \
+                    self.shed_reasons.get(reason, 0) + n
+        client.goaways_survived = 0
+        client.sheds_retried = 0
+        client.stream_wire_bytes = 0
+        client.error_frames = {}
+        client.shed_reasons = {}
 
     def record(self, tmpl: str, prepared: bool, ms: float,
                tenant: str) -> None:
@@ -281,9 +426,7 @@ def _worker(wid: int, addrs: List[Tuple[str, int]], tenant: str,
         same instant (the reconnect herd)."""
         nonlocal client, prepared_ids
         if client is not None:
-            with ctr.lock:
-                ctr.goaways += client.goaways_survived
-                ctr.retries += client.sheds_retried
+            ctr.fold_client(client)
             client = None
         last = None
         order = [primary] + [a for a in addrs if a != primary]
@@ -328,6 +471,8 @@ def _worker(wid: int, addrs: List[Tuple[str, int]], tenant: str,
         else:
             t0 = _pc()
             rs = client.query(spec, params=params)
+        with ctr.lock:
+            ctr.wire_bytes += rs.wire_bytes
         return _norm_rows(rs.rows()), rs.prepared, (_pc() - t0) * 1e3
 
     try:
@@ -400,9 +545,7 @@ def _worker(wid: int, addrs: List[Tuple[str, int]], tenant: str,
                     ctr.error("RECONNECT_FAILED")
                     return
     if client is not None:
-        with ctr.lock:
-            ctr.goaways += client.goaways_survived
-            ctr.retries += client.sheds_retried
+        ctr.fold_client(client)
         try:
             client.close()
         except Exception:  # fault-ok (best-effort goodbye at drain)
@@ -472,6 +615,17 @@ def run(args) -> dict:
     stop = threading.Event()
     n_slow = max(0, int(round(args.slow_frac * args.connections)))
     threads = []
+    # observability correctness differential: scrape the ops endpoint
+    # BEFORE the run (the telemetry registry is process-global, so the
+    # reconciliation works on deltas), hammer it mid-run from a scraper
+    # thread, and reconcile the deltas against client-observed truth at
+    # drain.  Chaos runs (fault_rate > 0) drop frames mid-stream, so
+    # exact reconciliation only applies to clean runs.
+    scraper = None
+    tm0 = None
+    if door.ops_port is not None:
+        tm0 = scrape_snapshot(door.ops_port)["telemetry"]
+        scraper = _OpsScraper(door.ops_port).start()
     t_start = _pc()
     for i in range(args.connections):
         th = threading.Thread(
@@ -486,6 +640,19 @@ def run(args) -> dict:
         th.join(timeout=args.timeout)
     stop.set()
     wall_s = _pc() - t_start
+    telemetry_report: dict = {}
+    if scraper is not None:
+        telemetry_report.update(scraper.stop())
+        tm1 = scrape_snapshot(door.ops_port)["telemetry"]
+        if args.fault_rate == 0:
+            with ctr.lock:
+                successes = len(ctr.latencies)
+            telemetry_report.update(reconcile_telemetry(
+                tm0, tm1, ctr, successes))
+            telemetry_report["reconciled"] = True
+        else:
+            telemetry_report["reconciled"] = False
+            telemetry_report["mismatches"] = []
 
     # serial prepared-vs-fresh A/B: one quiet connection, alternating
     # EXECUTE and SUBMIT per template after warmup — the clean
@@ -591,6 +758,7 @@ def run(args) -> dict:
         "spooled_bytes": snap["spooled_bytes"],
         "streamed_bytes": snap["streamed_bytes"],
         "scheduler": snap["scheduler"],
+        "telemetry": telemetry_report,
         "leaks": leaks,
         "verified": oracle is not None,
     }
@@ -921,6 +1089,36 @@ def run_soak(args) -> dict:
     stop = threading.Event()
     n_slow = max(0, int(round(args.slow_frac * args.connections)))
     threads = []
+    # fleet scrape loop: through every rolling restart and the
+    # failover drill, at least one live door's ops endpoint must
+    # answer each tick — the "stays scrapeable" soak guarantee
+    tm0 = scrape_snapshot(doors[0].ops_port)["telemetry"] \
+        if doors[0].ops_port is not None else None
+    scrape_stats = {"ticks_ok": 0, "ticks_dark": 0, "doors_ok": 0}
+    scrape_stop = threading.Event()
+
+    def _fleet_scraper():
+        while not scrape_stop.is_set():
+            any_ok = False
+            for d in list(doors):
+                port = d.ops_port
+                if port is None:
+                    continue
+                try:
+                    _http_get(f"http://127.0.0.1:{port}/metrics",
+                              timeout=2.0)
+                    _http_get(f"http://127.0.0.1:{port}/snapshot",
+                              timeout=2.0)
+                    any_ok = True
+                    scrape_stats["doors_ok"] += 1
+                except (OSError, ValueError):
+                    pass  # fault-ok (a door mid-restart is briefly dark; the tick passes if any sibling answers)
+            scrape_stats["ticks_ok" if any_ok else "ticks_dark"] += 1
+            scrape_stop.wait(0.3)
+
+    scrape_th = threading.Thread(target=_fleet_scraper, daemon=True,
+                                 name="soak-ops-scraper")
+    scrape_th.start()
     t_start = _pc()
     for i in range(args.connections):
         th = threading.Thread(
@@ -972,6 +1170,30 @@ def run_soak(args) -> dict:
         th.join(timeout=args.timeout)
     stop.set()
     wall_s = _pc() - t_start
+    scrape_stop.set()
+    scrape_th.join(timeout=5.0)
+    # soak reconciliation: the registry is process-global, so the
+    # streamed-END delta must equal client successes EXACTLY across
+    # restarts and the failover (bytes are not compared here — a
+    # drain-cancelled stream loses the client's partial byte tally)
+    telemetry_report = dict(scrape_stats)
+    if tm0 is not None:
+        live = next((d for d in doors if d.ops_port is not None), None)
+        if live is not None:
+            tm1 = scrape_snapshot(live.ops_port)["telemetry"]
+            streamed = int(_tm_sum(tm1, "server_queries_streamed_total")
+                           - _tm_sum(tm0, "server_queries_streamed_total"))
+            with ctr.lock:
+                successes = len(ctr.latencies)
+            telemetry_report["streamed_delta"] = streamed
+            telemetry_report["client_successes"] = successes
+            if streamed != successes:
+                leaks.append(f"telemetry: streamed END frames "
+                             f"{streamed} != client successes "
+                             f"{successes}")
+        if scrape_stats["ticks_dark"]:
+            leaks.append(f"telemetry: {scrape_stats['ticks_dark']} "
+                         f"scrape tick(s) found NO live ops endpoint")
 
     # final drain of the whole fleet + leak audit
     deadline2 = time.time() + 30
@@ -1012,6 +1234,7 @@ def run_soak(args) -> dict:
         "retries": ctr.retries,
         "typed_errors": ctr.errors,
         "mismatches": ctr.mismatches,
+        "telemetry": telemetry_report,
         "leaks": leaks,
         "verified": oracle is not None,
     }
@@ -1726,8 +1949,17 @@ def main(argv=None) -> int:
     if args.json:
         with open(args.json, "w") as f:
             f.write(line + "\n")
+    tm = report.get("telemetry") or {}
     ok = (not report["leaks"] and report["mismatches"] == 0
+          and not tm.get("mismatches")
           and report["queries_completed"] >= args.queries)
+    if tm:
+        print(f"[loadgen] telemetry: scrapes={tm.get('scrapes_ok', 0)} "
+              f"(failed {tm.get('scrapes_failed', 0)}, "
+              f"p95={tm.get('scrape_p95_ms', 0)}ms)  "
+              f"reconciled={tm.get('reconciled')}  "
+              f"mismatches={tm.get('mismatches') or 'none'}",
+              file=sys.stderr)
     speedup = (report["fresh_p50_ms"] / report["prepared_p50_ms"]
                if report["prepared_p50_ms"] else 0.0)
     print(f"[loadgen] {report['queries_completed']} queries over "

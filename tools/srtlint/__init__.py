@@ -5,7 +5,7 @@ removed) with ONE engine that parses ``spark_rapids_tpu/`` + ``tools/``
 once into ASTs — import/alias resolution, lazy per-line comment maps,
 a per-function CFG-lite (:mod:`.cfg`), and an interprocedural dataflow
 layer (:mod:`.dataflow`: whole-tree call graph, thread-root
-enumeration, must-hold lockset fixpoint) — and runs all twelve passes
+enumeration, must-hold lockset fixpoint) — and runs all thirteen passes
 over the shared tree:
 
   ====================  ==============================================

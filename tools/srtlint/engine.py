@@ -35,7 +35,7 @@ DEFAULT_ROOTS = ("spark_rapids_tpu", "tools")
 # invalidates cached verdicts even when the tree itself is untouched
 # (srtlint's own sources are inside the scanned roots, so edits to the
 # engine/passes also change the content fingerprint directly)
-ENGINE_VERSION = "2.0"
+ENGINE_VERSION = "2.1"
 
 _IGNORE = re.compile(
     r"#\s*srtlint:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(\(([^)]*)\))?")
@@ -340,13 +340,13 @@ class LintTree:
 def _load_passes():
     from .passes import (blocking_fetch, cache_keys, conf_registry,
                          ctx_threads, fault_paths, lock_discipline,
-                         protocol_conformance, release_paths,
-                         shared_state_races, shutdown_paths,
-                         span_timing, typestate)
+                         metrics_registry, protocol_conformance,
+                         release_paths, shared_state_races,
+                         shutdown_paths, span_timing, typestate)
     return [blocking_fetch, span_timing, ctx_threads, cache_keys,
             fault_paths, release_paths, lock_discipline,
             shutdown_paths, shared_state_races, typestate,
-            protocol_conformance, conf_registry]
+            protocol_conformance, metrics_registry, conf_registry]
 
 
 def available_rules() -> List[str]:
@@ -658,7 +658,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.srtlint",
         description="unified AST static analysis for spark_rapids_tpu "
-                    "(twelve passes over one shared parse)")
+                    "(thirteen passes over one shared parse)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable report")
     ap.add_argument("--sarif", metavar="OUT.sarif",

@@ -1,0 +1,137 @@
+"""metrics-registry: telemetry metric names stay two-way exhaustive
+against the canonical METRICS table."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+RULE = "metrics-registry"
+PER_FILE = False
+# incremental scan scope: telemetry call sites can appear anywhere in
+# the package or the tooling
+SCOPE = ("spark_rapids_tpu/", "tools/")
+TITLE = ("every telemetry counter/gauge/histogram name is registered "
+         "in telemetry.METRICS, emitted somewhere, and literal")
+EXPLAIN = """
+The live metrics registry (utils/telemetry.py) is the fleet's scrape
+vocabulary: dashboards, alerts, and the loadgen reconciliation all
+dispatch on metric NAMES.  A name minted at a call site but missing
+from the canonical ``METRICS`` table would scrape as a runtime
+KeyError; a registered name nobody emits is dead vocabulary that
+dashboards wait on forever.  Same discipline as protocol-conformance,
+applied to metric names:
+
+  * **unregistered-at-use** — every ``telemetry.count(...)`` /
+    ``telemetry.gauge_set(...)`` / ``telemetry.observe(...)`` call
+    site's first argument must be a name declared in
+    ``telemetry.METRICS``;
+  * **dynamic name** — the first argument must be a string LITERAL
+    (an ``a if c else b`` of literals counts); a name assembled at
+    runtime is unresolvable against the registry.  The registry
+    module itself is exempt (its fold loop iterates the literal
+    ``_QS_FOLD`` table, which the pass reads directly);
+  * **dead vocabulary** — a ``METRICS`` entry that no literal call
+    site emits and no ``_QS_FOLD`` mapping targets is dead — retire
+    it or wire up the emitter.
+
+Suppress with ``# srtlint: ignore[metrics-registry] (<why>)``.
+"""
+
+TEL_REL = "spark_rapids_tpu/utils/telemetry.py"
+_TEL_MOD = "spark_rapids_tpu.utils.telemetry"
+_API = ("count", "gauge_set", "observe")
+_API_QUALS = {f"{_TEL_MOD}.{fn}" for fn in _API}
+
+
+def _str_elts(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):  # "a" if cond else "b"
+        return _str_elts(node.body) + _str_elts(node.orelse)
+    return []
+
+
+def _collect_registry(tel) -> Tuple[Dict[str, ast.AST], Set[str],
+                                    Optional[ast.AST]]:
+    """(registered name -> entry node, fold-target names, METRICS
+    node) from the telemetry module's literals."""
+    registered: Dict[str, ast.AST] = {}
+    fold_targets: Set[str] = set()
+    metrics_node: Optional[ast.AST] = None
+    for node in tel.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name == "METRICS" and isinstance(node.value,
+                                            (ast.Tuple, ast.List)):
+            metrics_node = node
+            for entry in node.value.elts:
+                if isinstance(entry, (ast.Tuple, ast.List)) \
+                        and entry.elts:
+                    for metric in _str_elts(entry.elts[0]):
+                        registered[metric] = entry
+        elif name == "_QS_FOLD" and isinstance(node.value,
+                                               (ast.Tuple, ast.List)):
+            for entry in node.value.elts:
+                if isinstance(entry, (ast.Tuple, ast.List)) \
+                        and len(entry.elts) == 2:
+                    for metric in _str_elts(entry.elts[1]):
+                        fold_targets.add(metric)
+    return registered, fold_targets, metrics_node
+
+
+def run(tree) -> List:
+    findings: List = []
+    tel = next((sf for sf in tree.files if sf.rel == TEL_REL), None)
+    if tel is None:
+        return findings
+    registered, fold_targets, metrics_node = _collect_registry(tel)
+    if metrics_node is None:
+        findings.append(tree.finding(
+            tel, tel.tree.body[0] if tel.tree.body else tel.tree, RULE,
+            "utils/telemetry.py declares no METRICS registry — the "
+            "metric vocabulary has no canonical table to check call "
+            "sites against"))
+        return findings
+
+    used: Set[str] = set(fold_targets)
+    for sf in tree.files:
+        in_tel = sf.rel == TEL_REL
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            qn = sf.call_qualname(node)
+            is_api = qn in _API_QUALS or (
+                in_tel and isinstance(node.func, ast.Name)
+                and node.func.id in _API)
+            if not is_api:
+                continue
+            names = _str_elts(node.args[0])
+            if not names:
+                if in_tel:
+                    continue  # the registry module's own fold loop
+                findings.append(tree.finding(
+                    sf, node, RULE,
+                    "telemetry metric name assembled at runtime — "
+                    "unresolvable against telemetry.METRICS; spell "
+                    "the literal name per branch"))
+                continue
+            for metric in names:
+                used.add(metric)
+                if metric not in registered:
+                    findings.append(tree.finding(
+                        sf, node, RULE,
+                        f"metric {metric!r} is emitted here but not "
+                        f"registered in telemetry.METRICS — register "
+                        f"it (or fix the typo)"))
+
+    for metric, entry in sorted(registered.items()):
+        if metric not in used:
+            findings.append(tree.finding(
+                tel, entry, RULE,
+                f"dead metric vocabulary: {metric!r} is registered in "
+                f"telemetry.METRICS but nothing emits it — retire it "
+                f"or wire up the emitter"))
+    return findings

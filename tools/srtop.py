@@ -1,0 +1,175 @@
+"""srtop: live terminal console over a front door's ops endpoint.
+
+Polls ``GET /snapshot`` on the HTTP ops listener (server/ops.py) and
+renders the serving picture an operator actually watches: qps and p95
+by tenant (derived from the ``query_latency_seconds`` histogram and
+successive completed-counter deltas), the typed shed taxonomy, breaker
+and brownout state, SLO burn rates per window, and — when the process
+is part of a DCN group — per-rank fleet health from the coordinator's
+rollup.
+
+Usage::
+
+    python tools/srtop.py --url http://127.0.0.1:PORT [--interval 2]
+    python tools/srtop.py --url ... --once          # one frame (tests)
+
+Plain stdlib only (urllib + ANSI clear); exits 0 on Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+# keep in sync with utils/telemetry.HIST_BOUNDS (log-2 seconds)
+_BOUNDS = tuple(2.0 ** e for e in range(-10, 6))
+
+
+def fetch_snapshot(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/snapshot",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _hist_p(buckets: List[int], q: float) -> float:
+    """Approximate quantile from log-bucket counts (upper-bound of the
+    bucket the quantile falls in)."""
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= target:
+            return _BOUNDS[i] if i < len(_BOUNDS) else _BOUNDS[-1] * 2
+    return _BOUNDS[-1] * 2
+
+
+def tenant_latency(tm: dict) -> Dict[str, Tuple[int, float]]:
+    """{tenant: (count, p95_s)} from the latency histogram series."""
+    out: Dict[str, Tuple[int, float]] = {}
+    for lbl, h in (tm.get("query_latency_seconds") or {}).items():
+        tenant = lbl.split("=", 1)[1] if "=" in lbl else lbl or "?"
+        buckets = h.get("buckets") or []
+        out[tenant] = (int(h.get("count", 0)),
+                       _hist_p(buckets, 0.95))
+    return out
+
+
+def completed_total(tm: dict) -> int:
+    return int(sum((tm.get("queries_completed_total") or {}).values()))
+
+
+def render(snap: dict, qps: Optional[float]) -> str:
+    tm = snap.get("telemetry") or {}
+    sched = snap.get("scheduler") or {}
+    server = snap.get("server") or {}
+    health = snap.get("health") or {}
+    slo = snap.get("slo") or {}
+    fleet = snap.get("fleet") or {}
+    lines: List[str] = []
+    qps_s = f"{qps:.1f}" if qps is not None else "?"
+    lines.append(
+        f"srtop — status={health.get('status', '?')} "
+        f"qps={qps_s} queued={sched.get('queued', 0)} "
+        f"running={sched.get('running', 0)} "
+        f"completed={sched.get('completed', 0)} "
+        f"inflight_wire={server.get('queries_inflight', 0)}")
+    lines.append(
+        f"  server: conns={server.get('connections', 0)} "
+        f"queries={server.get('queries_total', 0)} "
+        f"streamed={server.get('streamed_bytes', 0) / 1e6:.1f}MB "
+        f"spooled={server.get('spooled_bytes', 0) / 1e6:.1f}MB "
+        f"goaways={server.get('goaways_sent', 0)} "
+        f"conn_lost={server.get('conn_lost', 0)}")
+    # shed taxonomy (live counters, by typed reason)
+    sheds = tm.get("queries_shed_total") or {}
+    if sheds:
+        parts = " ".join(
+            f"{lbl.split('=', 1)[-1]}={int(v)}"
+            for lbl, v in sorted(sheds.items()))
+        lines.append(f"  sheds: {parts}")
+    # containment + brownout state
+    brk = (sched.get("breaker") or {})
+    bro = (sched.get("brownout") or {})
+    lines.append(
+        f"  containment: breakers_open={brk.get('open', 0)} "
+        f"quarantines={brk.get('quarantines', 0)} "
+        f"brownout={'ACTIVE' if bro.get('active') else 'off'} "
+        f"(alive {bro.get('alive', '?')}/{bro.get('world', '?')})")
+    # per-tenant p95
+    lat = tenant_latency(tm)
+    if lat:
+        lines.append("  tenants (n / p95):")
+        for tenant in sorted(lat):
+            n, p95 = lat[tenant]
+            burn = ""
+            windows = ((slo.get("tenants") or {}).get(tenant) or {})
+            if windows:
+                burn = "  burn " + " ".join(
+                    f"{w}={d.get('burn_rate', 0):.2f}"
+                    for w, d in sorted(windows.items()))
+            lines.append(f"    {tenant:<12} {n:>6}  "
+                         f"p95<={p95 * 1e3:.0f}ms{burn}")
+    # fleet rollup (DCN): per-rank health from the coordinator's merge
+    ranks = fleet.get("ranks") or {}
+    if ranks:
+        lines.append(f"  fleet (v{fleet.get('version', '?')}): "
+                     f"{len(ranks)} rank(s) reporting")
+        for r in sorted(ranks, key=lambda x: int(x)):
+            series = ranks[r]
+            fetches = sum(v for k, v in series.items()
+                          if k.startswith("query_blocking_fetches_total"))
+            lines.append(f"    rank {r}: {len(series)} series, "
+                         f"blocking_fetches={int(fetches)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", required=True,
+                    help="ops endpoint base url (http://host:opsport)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (test mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: dump the raw snapshot JSON")
+    args = ap.parse_args(argv)
+    prev: Optional[Tuple[float, int]] = None
+    try:
+        while True:
+            t = time.monotonic()
+            try:
+                snap = fetch_snapshot(args.url)
+            except (OSError, ValueError) as e:
+                print(f"srtop: scrape failed: {e}", file=sys.stderr)
+                if args.once:
+                    return 1
+                time.sleep(args.interval)  # fault-ok (paced re-poll of an ops endpoint mid-restart, not an exception-swallowing retry loop)
+                continue
+            done = completed_total(snap.get("telemetry") or {})
+            qps = None
+            if prev is not None and t > prev[0]:
+                qps = max(0.0, (done - prev[1]) / (t - prev[0]))
+            prev = (t, done)
+            if args.once:
+                if args.json:
+                    print(json.dumps(snap, indent=1, sort_keys=True))
+                else:
+                    print(render(snap, qps))
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty()
+                             else "")
+            print(render(snap, qps))
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

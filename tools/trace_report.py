@@ -19,12 +19,23 @@ A trace containing several overlapping query span trees (the merged
 plus a **contention summary**: the span of the whole batch, per-query
 concurrency overlap, peak concurrency, and aggregate throughput.
 
-Usage: ``python tools/trace_report.py TRACE.json [TRACE2.json ...]``
+Cross-rank stitching (``--stitch``): a distributed query's DCN request
+frames carry its trace id, so remote serve-side work (peer fetches,
+durable re-pulls) lands in per-rank SHARD files
+(``<trace_id>.rank<k>.shard.jsonl``) beside the trace.  ``--stitch``
+discovers every shard for the trace's id, merges them into ONE
+Perfetto-loadable tree — each rank its own pid, every remote span
+parented under the query root in the ``spanTree``, attributable to its
+owning rank — writes ``<trace>.stitched.json``, and reports per-rank
+span counts.
+
+Usage: ``python tools/trace_report.py [--stitch] TRACE.json [...]``
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import Dict, List
 
@@ -316,9 +327,13 @@ def analyze(data: dict) -> dict:
 def format_report(a: dict) -> str:
     status = f"  status={a['status']}" if a.get("status", "ok") != "ok" \
         else ""
+    # a truncated trace is VISIBLY truncated: the one-time
+    # trace:events_dropped mark rides the timeline, and the header
+    # says so in capitals
+    trunc = "  TRUNCATED" if a.get("dropped", 0) else ""
     lines = [
         f"query {a['label']}: wall={a['wall_s'] * 1e3:.1f}ms  "
-        f"events={a['n_events']} (dropped={a['dropped']}){status}",
+        f"events={a['n_events']} (dropped={a['dropped']}){trunc}{status}",
         "",
         "hot operators (self time):",
         f"  {'self_ms':>9} {'total_ms':>9} {'rows':>10} "
@@ -431,6 +446,127 @@ def format_contention(c: dict) -> str:
     ])
 
 
+# ---------------------------------------------------------------------------------
+# Cross-rank trace stitching
+# ---------------------------------------------------------------------------------
+
+def discover_shards(trace_path: str, data: dict) -> Dict[int, List[dict]]:
+    """Find and load every per-rank shard written for this trace's id
+    in the trace file's directory: {rank: [shard events]}."""
+    import re
+    tid = data.get("otherData", {}).get("trace_id", "")
+    if not tid:
+        return {}
+    directory = os.path.dirname(os.path.abspath(trace_path))
+    out: Dict[int, List[dict]] = {}
+    import glob
+    for path in sorted(glob.glob(os.path.join(
+            directory, f"{tid}.rank*.shard.jsonl"))):
+        m = re.search(r"\.rank(\d+)\.shard\.jsonl$", path)
+        if not m:
+            continue
+        rank = int(m.group(1))
+        events = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue  # a torn tail write is not fatal
+        if events:
+            out[rank] = events
+    return out
+
+
+def stitch(data: dict, shards: Dict[int, List[dict]]) -> dict:
+    """Merge per-rank shards into ONE Perfetto tree.
+
+    The query trace stays pid 1; each remote rank becomes its own pid
+    (``100 + rank``) with its serve-side spans placed on the shared
+    wall-clock timeline; the ``spanTree`` gains one ``rank-<k>`` node
+    PER RANK, parented under the query root, whose children are that
+    rank's remote spans — every fetch/re-pull is attributable to its
+    owning rank."""
+    other = dict(data.get("otherData", {}))
+    epoch = float(other.get("wall_start_epoch_s", 0.0))
+    evs = [dict(e) for e in data.get("traceEvents", [])]
+    roots = [dict(r) for r in data.get("spanTree", [])]
+    root_node = {
+        "op_id": "query-root",
+        "name": other.get("label", "query"),
+        "desc": f"query root ({other.get('label', '?')})",
+        "children": roots,
+        "metrics": {},
+    }
+    rank_counts: Dict[int, int] = {}
+    for rank in sorted(shards):
+        pid = 100 + rank
+        evs.append({"ph": "M", "pid": pid, "tid": 0,
+                    "name": "process_name",
+                    "args": {"name": f"rank {rank} (remote)"}})
+        rank_node = {"op_id": f"rank-{rank}",
+                     "name": f"rank-{rank}",
+                     "desc": f"remote spans served by rank {rank}",
+                     "children": [], "metrics": {}}
+        for i, ev in enumerate(shards[rank]):
+            ts = max(0.0, (float(ev.get("t_wall", epoch)) - epoch)) * 1e6
+            dur = float(ev.get("dur_s", 0.0)) * 1e6
+            args = dict(ev.get("args") or {})
+            args["rank"] = rank
+            evs.append({"ph": "X", "pid": pid, "tid": 1,
+                        "name": ev.get("name", "remote"),
+                        "cat": ev.get("cat", "shuffle"),
+                        "ts": round(ts, 1), "dur": round(dur, 1),
+                        "args": args})
+            child = {"op_id": f"rank-{rank}/{i}",
+                     "name": ev.get("name", "remote"),
+                     "desc": " ".join(f"{k}={v}" for k, v
+                                      in sorted(args.items())),
+                     "children": [],
+                     "metrics": {"durS": round(float(
+                         ev.get("dur_s", 0.0)), 6)}}
+            rank_node["children"].append(child)
+        rank_node["metrics"]["spans"] = len(rank_node["children"])
+        rank_counts[rank] = len(rank_node["children"])
+        root_node["children"].append(rank_node)
+    other["stitched_ranks"] = sorted(rank_counts)
+    other["stitched_spans"] = rank_counts and {
+        str(r): n for r, n in sorted(rank_counts.items())} or {}
+    return {"traceEvents": evs, "displayTimeUnit": "ms",
+            "otherData": other, "spanTree": [root_node]}
+
+
+def stitch_file(path: str, out: str = "") -> str:
+    """Stitch one trace file with its shards; writes (and returns the
+    path of) ``<trace>.stitched.json``."""
+    data = load(path)
+    shards = discover_shards(path, data)
+    merged = stitch(data, shards)
+    out = out or (path[:-5] if path.endswith(".json") else path) \
+        + ".stitched.json"
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    return out
+
+
+def format_stitched(merged: dict) -> str:
+    other = merged.get("otherData", {})
+    spans = other.get("stitched_spans") or {}
+    lines = [f"stitched trace {other.get('label', '?')} "
+             f"(trace_id={other.get('trace_id', '?')}): "
+             f"{len(spans)} remote rank shard(s)"]
+    for rank, n in sorted(spans.items(), key=lambda kv: int(kv[0])):
+        lines.append(f"  rank {rank}: {n} remote span(s) parented "
+                     f"under the query root")
+    if not spans:
+        lines.append("  (no shards found beside the trace — was "
+                     "sql.trace.dir set on the serving ranks?)")
+    return "\n".join(lines)
+
+
 def report_file(data: dict) -> str:
     """Render one trace file: a single-query report, or per-query
     sections + a contention summary for a merged multi-query trace."""
@@ -442,12 +578,26 @@ def report_file(data: dict) -> str:
 
 
 def main(argv: List[str]) -> int:
-    if not argv:
+    do_stitch = False
+    paths: List[str] = []
+    for a in argv:
+        if a == "--stitch":
+            do_stitch = True
+        else:
+            paths.append(a)
+    if not paths:
         print(__doc__, file=sys.stderr)
         return 2
-    for path in argv:
-        print(report_file(load(path)))
-        if len(argv) > 1:
+    for path in paths:
+        if do_stitch:
+            out = stitch_file(path)
+            merged = load(out)
+            print(format_stitched(merged))
+            print(f"wrote {out}")
+            print(report_file(merged))
+        else:
+            print(report_file(load(path)))
+        if len(paths) > 1:
             print("-" * 72)
     return 0
 
