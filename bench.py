@@ -281,7 +281,15 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
         "rows": len(engine_rows),
         # sync/compile profile (VERDICT r4 item 2): warm = per-iteration
         "syncs_warm": warm_stats["blocking_fetches"],
+        "syncs_cold": cold_stats["blocking_fetches"],
         "asyncs_warm": warm_stats["async_fetches"],
+        # region-fusion profile: regions formed + the prologue fetches
+        # they paid (region_fetches ⊆ syncs; 0s under sql.fusion.enabled
+        # =false — the printed A/B evidence for the fused data path)
+        "fused_regions_warm": warm_stats["fused_regions"],
+        "fused_regions_cold": cold_stats["fused_regions"],
+        "region_fetches_warm": warm_stats["region_fetches"],
+        "region_fetches_cold": cold_stats["region_fetches"],
         "fetch_mb_warm": round(warm_stats["fetch_bytes"] / 1e6, 3),
         # pipeline profile (round 6): time the pull loop blocked on a
         # staged batch vs the staging work overlapped behind dispatch,

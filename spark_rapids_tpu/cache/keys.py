@@ -116,6 +116,16 @@ def plan_fingerprint(node):
         if child is None:
             return None
         return ("coalesce", node.node_desc(), child[0]), child[1]
+    from ..plan.fusion import FusedRegionExec
+    if isinstance(node, FusedRegionExec):
+        # a fused region is SEE-THROUGH: its data identity is exactly its
+        # member chain's (the wrapper adds scheduling — one pipeline
+        # stage, one batched stats prologue — not semantics), so a
+        # region-fused subtree and its fusion-off equivalent key the same
+        # cached data and the fusion-on/off differential shares one cache
+        # population.  The fused-PROGRAM identity (the member fingerprint
+        # chain) is plan/fusion.region_fingerprint, not this.
+        return plan_fingerprint(node.children[0])
     return None
 
 

@@ -322,6 +322,28 @@ DCN_WAIT_TIMEOUT = register(
     "compile); bounds how long a lost peer can hang the world.",
     conv=float, check=lambda v: None if v > 0 else "must be > 0")
 
+FUSION_ENABLED = register(
+    "spark.rapids.tpu.sql.fusion.enabled", True,
+    "Whole-query data-path fusion (plan/fusion.py): group chains of "
+    "fusible operators between exchanges/sorts into regions that run "
+    "as single pipeline stages, merge adjacent fused project/filter "
+    "stages into ONE composed XLA program, and batch each region's "
+    "size/stats host syncs (join build stats, dense-agg key stats, "
+    "candidate-pair counts) into a single prologue fetch. false "
+    "restores the exact per-operator dispatch-plus-materialize path — "
+    "the byte-identical escape hatch the fusion-on/off differential "
+    "tests pin.")
+
+FUSION_MAX_OPS = register(
+    "spark.rapids.tpu.sql.fusion.maxOps", 8,
+    "Upper bound on operators grouped into one fused region. Oversized "
+    "chains split at the member with the smallest observed self-time "
+    "(the tracing spine's per-op profile) so the expensive ops stay "
+    "co-resident in one region. Lower it when debugging to shrink the "
+    "blast radius of a fused program; 1 keeps region accounting but "
+    "never groups operators.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
 PIPELINE_DEPTH = register(
     "spark.rapids.tpu.sql.pipeline.depth", 2,
     "Bounded depth of the async execution pipeline: scans and fused "

@@ -3271,11 +3271,17 @@ def _rewrite_exchanges(node, pg: ProcessGroup, n_parts: int):
             node.children[i] = DcnBroadcastExchangeExec(child, pg)
             continue
         if isinstance(child, ShuffleExchangeExec):
+            from ..plan.fusion import FusedRegionExec
             from ..plan.join_exec import SortMergeJoinExec
             below = child.children[0]
-            decoder = _make_key_decoder(below) \
-                if isinstance(below, AggregateExec) \
-                and below.mode == "partial" else None
+            # the partial aggregate may sit under a region wrapper —
+            # the decoder needs the real exec (its string_dicts)
+            inner = below
+            while isinstance(inner, FusedRegionExec):
+                inner = inner.children[0]
+            decoder = _make_key_decoder(inner) \
+                if isinstance(inner, AggregateExec) \
+                and inner.mode == "partial" else None
             node.children[i] = DcnExchangeExec(
                 below, child.key_exprs, n_parts, pg,
                 decode_batch=decoder,

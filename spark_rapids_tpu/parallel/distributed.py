@@ -25,20 +25,29 @@ import numpy as np
 __all__ = ["plan_distributed_agg", "distributed_agg_collect"]
 
 
+def _unwrap_region(node):
+    """See through FusedRegionExec: the region wrapper groups execution,
+    the member subtree below it is the real plan shape."""
+    from ..plan.fusion import FusedRegionExec
+    while isinstance(node, FusedRegionExec):
+        node = node.children[0]
+    return node
+
+
 def _find_agg_tree(phys):
     """Locate final-agg → exchange → partial-agg in a planned query."""
     from ..plan.exchange_exec import ShuffleExchangeExec
     from ..plan.physical import AggregateExec
-    node = phys
+    node = _unwrap_region(phys)
     while node is not None:
         if isinstance(node, AggregateExec) and node.mode == "final":
-            exch = node.children[0]
+            exch = _unwrap_region(node.children[0])
             if isinstance(exch, ShuffleExchangeExec):
-                partial = exch.children[0]
+                partial = _unwrap_region(exch.children[0])
                 if isinstance(partial, AggregateExec) \
                         and partial.mode == "partial":
                     return node, exch, partial
-        node = node.children[0] if node.children else None
+        node = _unwrap_region(node.children[0]) if node.children else None
     raise ValueError(
         "plan has no partial->exchange->final aggregate "
         "(is spark.rapids.tpu.sql.exchange.enabled on?)")

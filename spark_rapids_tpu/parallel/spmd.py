@@ -411,10 +411,14 @@ def _lower(node, leaves: List[_Leaf], conf, n_dev: int, axis: str,
     (a schema no device representation exists for)."""
     from ..plan.coalesce import CoalesceBatchesExec
     from ..plan.exchange_exec import ShuffleExchangeExec
+    from ..plan.fusion import FusedRegionExec
     from ..plan.join_exec import SortMergeJoinExec
     from ..plan.physical import AggregateExec, StageExec
 
-    while isinstance(node, CoalesceBatchesExec):
+    # region wrappers are an execution grouping for the streaming engine;
+    # under shard_map the whole fragment is ONE jitted program already,
+    # so lower the member subtree directly
+    while isinstance(node, (CoalesceBatchesExec, FusedRegionExec)):
         node = node.children[0]
 
     if isinstance(node, ShuffleExchangeExec):

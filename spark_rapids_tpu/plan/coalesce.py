@@ -81,6 +81,8 @@ class CoalesceBatchesExec(TpuExec):
     Empty input yields nothing (sources own empty-result semantics).
     """
 
+    region_fusible = True
+
     def __init__(self, child: TpuExec, goal: CoalesceGoal):
         super().__init__([child])
         self.goal = goal
@@ -114,8 +116,10 @@ class CoalesceBatchesExec(TpuExec):
             idx = [i for i, v in enumerate(lives)
                    if not isinstance(v, int)]
             if idx:
-                from ..utils.metrics import fetch
-                vals = fetch([lives[i] for i in idx])
+                # region-batched when fused (rides the prologue with any
+                # staged stats); plain one-batched-fetch look otherwise
+                from ..utils.metrics import region_fetch
+                vals = region_fetch([lives[i] for i in idx])
                 for i, v in zip(idx, vals):
                     lives[i] = int(v)
             state["known"] = sum(lives)

@@ -37,6 +37,10 @@ class SortExec(TpuExec):
     spill catalog keeps resident.  Output batches emit in global order.
     """
 
+    # a sort consumes ALL input before emitting — a pipeline breaker, and
+    # therefore a region boundary for the fusion planner (plan/fusion.py)
+    region_fusible = False
+
     def __init__(self, child: TpuExec,
                  orders: List[Tuple[Expression, bool, bool]]):
         super().__init__([child])
@@ -382,6 +386,11 @@ class LimitExec(TpuExec):
 
 
 class UnionExec(TpuExec):
+    # multi-input streaming: no single streaming spine for a region to
+    # follow, so the union itself stays a boundary (its branches fuse
+    # independently below it)
+    region_fusible = False
+
     def __init__(self, children: List[TpuExec]):
         super().__init__(children)
 
@@ -395,6 +404,9 @@ class UnionExec(TpuExec):
 
 
 class RangeExec(TpuExec):
+    # leaf device source with no host syncs: fuses like ScanExec
+    region_fusible = True
+
     def __init__(self, start: int, end: int, step: int, batch_rows: int):
         super().__init__()
         self.start, self.end, self.step = start, end, step
@@ -575,6 +587,9 @@ class GenerateExec(TpuExec):
 class ExpandExec(TpuExec):
     """Emit one projected batch per projection per input batch
     (grouping sets — GpuExpandExec.scala)."""
+
+    # pure-device batch-in/batches-out streaming: region-safe
+    region_fusible = True
 
     def __init__(self, child: TpuExec, projections, out_schema: Schema):
         super().__init__([child])

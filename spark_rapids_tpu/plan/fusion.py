@@ -1,0 +1,333 @@
+"""Whole-query data-path fusion: region planning over the physical tree.
+
+A *region* is a maximal chain of fusible operators between pipeline
+breakers (exchanges, sorts, windows, CPU fallbacks).  The planner
+(:func:`plan_regions`, invoked at the tail of ``apply_overrides``)
+walks the physical tree and
+
+  * merges directly-adjacent fused project/filter stages into ONE
+    ``StageExec`` — their step lists concatenate into a single XLA
+    program, keyed through ``_cached_program`` by the concatenated
+    member fingerprint chain (one compile where there were two);
+  * wraps each remaining fusible chain in a :class:`FusedRegionExec`.
+
+At execute time a region is ONE pipeline stage (members pull serially
+inside it; the region's consumer stages its output at the configured
+depth — ``runtime/pipeline.effective_depth`` resolves to 0 for member
+operators) and carries ONE batched stats prologue
+(``utils/metrics.RegionPrologue``): member operators stage their small
+device stat vectors (join build stats, dense-agg key stats) as they
+dispatch, and the first demanded value resolves every staged vector in
+a single blocking fetch.  A ``fusion:region`` trace span wraps the
+member-op spans, so profiled EXPLAIN and trace_report keep per-op
+attribution while gaining the region summary.
+
+``spark.rapids.tpu.sql.fusion.enabled=false`` skips all of this — the
+tree is returned untouched and every operator runs the per-op
+dispatch-plus-materialize path byte-identically (the escape hatch the
+fusion-on/off differential tests pin).
+
+Chains longer than ``spark.rapids.tpu.sql.fusion.maxOps`` split at the
+boundary adjacent to the member with the smallest observed self-time
+(the tracing spine's per-op profile, folded in at region close), so
+expensive operators stay co-resident in one region.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Iterator, List
+
+from ..batch import ColumnBatch, Schema
+from ..utils.metrics import QueryStats, RegionPrologue, region_enter, \
+    region_exit
+from .physical import ExecContext, StageExec, TpuExec
+
+__all__ = ["plan_regions", "FusedRegionExec", "region_fingerprint",
+           "note_self_time"]
+
+
+# ---------------------------------------------------------------------------------
+# Per-op self-time profile: fed from executed regions' member metrics
+# (the tracing spine's per-op timers), consumed by the maxOps splitter.
+# Process-wide EMA keyed by the member's structural identity — bounded
+# LRU for the same reason as the program cache.
+# ---------------------------------------------------------------------------------
+
+_SELF_TIME: "OrderedDict[str, float]" = OrderedDict()
+_SELF_TIME_LOCK = threading.Lock()
+_SELF_TIME_MAX = 1024
+_EMA = 0.5
+
+
+def _member_key(node: TpuExec) -> str:
+    fp = getattr(node, "fingerprint", None)
+    try:
+        tail = fp() if callable(fp) else ""
+    except Exception:  # fault-ok (profile key only; identity degrades to the type)
+        tail = ""
+    return f"{type(node).__name__}|{tail[:200]}"
+
+
+def note_self_time(key: str, seconds: float) -> None:
+    """Fold one observed member self-time into the profile (EMA)."""
+    with _SELF_TIME_LOCK:
+        prev = _SELF_TIME.get(key)
+        _SELF_TIME[key] = seconds if prev is None \
+            else (_EMA * seconds + (1 - _EMA) * prev)
+        _SELF_TIME.move_to_end(key)
+        while len(_SELF_TIME) > _SELF_TIME_MAX:
+            _SELF_TIME.popitem(last=False)
+
+
+def _self_time(key: str) -> float:
+    with _SELF_TIME_LOCK:
+        return _SELF_TIME.get(key, 0.0)
+
+
+# ---------------------------------------------------------------------------------
+# The fused-region wrapper node.
+# ---------------------------------------------------------------------------------
+
+class FusedRegionExec(TpuExec):
+    """A chain of fusible operators executing as one pipeline stage
+    with one batched stats prologue.
+
+    ``children[0]`` is the chain's top member — the member subtree stays
+    intact underneath, so ``QueryTrace.register_plan`` and profiled
+    EXPLAIN keep every member op in the span tree.  The region scope is
+    entered around each batch PULL (not held across yields): sibling
+    regions interleaved by a consumer never see each other's prologue.
+    """
+
+    def __init__(self, head: TpuExec, members: List[TpuExec]):
+        super().__init__([head])
+        self.members = members  # top-down (head first)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    @property
+    def outputs_partitions(self) -> bool:
+        return self.children[0].outputs_partitions
+
+    def node_desc(self) -> str:
+        kinds = "+".join(type(m).__name__.replace("Exec", "")
+                         for m in self.members)
+        return f"TpuFusedRegion [{kinds}] -> {self.output_schema.names()}"
+
+    def fingerprint(self) -> str:
+        return region_fingerprint(self)
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
+        from ..runtime.pipeline import effective_depth, pipeline_batches
+        from ..utils import tracing
+        s = QueryStats.get()
+        s.fused_regions += 1
+        region = RegionPrologue(self.op_id)
+        args = {"members": len(self.members),
+                "ops": [type(m).__name__ for m in self.members]}
+        compiles0 = s.compiles
+        # the region is ONE pipeline stage: compute the consumer-facing
+        # depth BEFORE entering the scope (inside it, members see 0)
+        depth = effective_depth(ctx)
+        inner = self.children[0].execute(ctx)
+
+        def pulls():
+            # scope active only while a member runs — on the pipeline
+            # worker's copied context when depth > 0 — so the prologue
+            # never leaks into the consumer (or a sibling region)
+            while True:
+                tok = region_enter(region)
+                try:
+                    batch = next(inner)
+                except StopIteration:
+                    return
+                finally:
+                    region_exit(tok, region)
+                yield batch
+
+        t_members0 = self._members_self_time(ctx)
+        try:
+            with tracing.region_span(self.op_id, args):
+                try:
+                    for batch in pipeline_batches(pulls(), depth,
+                                                  label=self.op_id):
+                        yield batch
+                finally:
+                    args["syncs"] = region.fetches
+                    args["staged"] = region.staged
+                    args["batched"] = region.batched
+                    args["compiles"] = max(
+                        0, QueryStats.get().compiles - compiles0)
+                    self._fold_self_times(ctx, t_members0)
+        finally:
+            inner.close()
+
+    # -- self-time profile feed ---------------------------------------------------
+    def _members_self_time(self, ctx: ExecContext) -> List[float]:
+        out = []
+        for m in self.members:
+            ms = ctx.metrics.get(m.op_id)
+            v = 0.0
+            if ms is not None:
+                v = ms.values.get("opTime", 0.0) \
+                    + ms.values.get("scanTime", 0.0)
+            out.append(v)
+        return out
+
+    def _fold_self_times(self, ctx: ExecContext, before: List[float]
+                         ) -> None:
+        after = self._members_self_time(ctx)
+        for m, t0, t1 in zip(self.members, before, after):
+            note_self_time(_member_key(m), max(0.0, t1 - t0))
+
+
+def region_fingerprint(region: "FusedRegionExec") -> str:
+    """Member-op fingerprint chain — the fused program / plan cache
+    identity of a region.  Members without a stable fingerprint
+    contribute their structural description instead."""
+    parts = []
+    for m in region.members:
+        fp = getattr(m, "fingerprint", None)
+        if callable(fp):
+            try:
+                parts.append(fp())
+                continue
+            except Exception:  # fault-ok (identity degrades to the description)
+                pass
+        parts.append(m.node_desc())
+    return "region[" + ";".join(parts) + "]"
+
+
+# ---------------------------------------------------------------------------------
+# Region formation.
+# ---------------------------------------------------------------------------------
+
+def _is_fusible(node: TpuExec) -> bool:
+    return bool(getattr(node, "region_fusible", False))
+
+
+def _stream_child(node: TpuExec):
+    """The child the fusible chain continues through: the streaming
+    input.  A broadcast join streams its PROBE side — the build side
+    (a BroadcastExchangeExec) materializes eagerly and is a region
+    boundary (its subtree also keys the broadcast cache, so it stays
+    structurally untouched)."""
+    from .join_exec import BroadcastJoinExec
+    if isinstance(node, BroadcastJoinExec):
+        return node.children[1 - node.build_side]
+    if len(node.children) == 1:
+        return node.children[0]
+    return None
+
+
+def _merge_stages(top: StageExec, bottom: StageExec) -> StageExec:
+    """Concatenate two adjacent fused stages into ONE (one XLA program,
+    one compile).  Steps are bound against the running intermediate
+    schema, so ``bottom.steps + top.steps`` over bottom's input is
+    exactly the composed program; the fingerprint chain concatenates
+    the member fingerprints, keying the composed jit through
+    ``_cached_program``.  Only pure-device stages merge — host-lowered
+    string predicates carry per-stage extras indexing."""
+    merged = StageExec.__new__(StageExec)
+    TpuExec.__init__(merged, [bottom.children[0]])
+    merged.steps = list(bottom.steps) + list(top.steps)
+    merged.host_exprs = []
+    merged._schema = top._schema
+    return merged
+
+
+def _split_chain(chain: List[TpuExec], max_ops: int) -> List[List[TpuExec]]:
+    """Split an oversized chain into <= max_ops segments, cutting at
+    the boundary whose adjacent members have the smallest observed
+    self-time (ties break toward the middle, so a cold profile splits
+    evenly)."""
+    if len(chain) <= max_ops:
+        return [chain]
+    times = [_self_time(_member_key(m)) for m in chain]
+    mid = len(chain) / 2.0
+    cut = min(range(1, len(chain)),
+              key=lambda i: (min(times[i - 1], times[i]), abs(i - mid)))
+    return _split_chain(chain[:cut], max_ops) \
+        + _split_chain(chain[cut:], max_ops)
+
+
+def _rewrite(node: TpuExec, conf, allow: bool) -> TpuExec:
+    """Bottom-up rewrite: collect the fusible chain hanging off
+    ``node``, recurse into everything below/beside it, then wrap."""
+    from .join_exec import BroadcastExchangeExec, BroadcastJoinExec
+
+    if not _is_fusible(node) or not allow:
+        # recurse into children; regions never form under a broadcast
+        # exchange (its subtree fingerprints key the broadcast cache)
+        sub_allow = allow and not isinstance(node, BroadcastExchangeExec)
+        node.children = [_rewrite(c, conf, sub_allow)
+                         for c in node.children]
+        return node
+
+    # walk down the streaming spine collecting the chain
+    chain: List[TpuExec] = []
+    cur = node
+    while _is_fusible(cur):
+        chain.append(cur)
+        nxt = _stream_child(cur)
+        if nxt is None:
+            break
+        cur = nxt
+
+    # recurse below the chain and into non-spine children (join build
+    # sides, union branches) — no regions under broadcast exchanges
+    for m in chain:
+        spine = _stream_child(m)
+        m.children = [
+            (c if c is spine and _is_fusible(c)
+             else _rewrite(c, conf,
+                           allow and not isinstance(
+                               c, BroadcastExchangeExec)))
+            for c in m.children]
+
+    # merge adjacent pure-device stages (bottom-up along the chain)
+    i = 0
+    while i < len(chain) - 1:
+        a, b = chain[i], chain[i + 1]
+        if isinstance(a, StageExec) and isinstance(b, StageExec) \
+                and not a.host_exprs and not b.host_exprs \
+                and a.children[0] is b:
+            merged = _merge_stages(a, b)
+            if i > 0:
+                parent = chain[i - 1]
+                parent.children = [merged if c is a else c
+                                   for c in parent.children]
+            chain[i:i + 2] = [merged]
+        else:
+            i += 1
+
+    max_ops = conf["spark.rapids.tpu.sql.fusion.maxOps"]
+    segments = _split_chain(chain, max_ops)
+
+    out = None
+    prev_tail = None
+    for seg in segments:
+        worthwhile = len(seg) >= 2 or any(
+            isinstance(m, BroadcastJoinExec) for m in seg)
+        wrapped = FusedRegionExec(seg[0], list(seg)) if worthwhile \
+            else seg[0]
+        if out is None:
+            out = wrapped
+        else:
+            prev_tail.children = [wrapped if c is seg[0] else c
+                                  for c in prev_tail.children]
+        prev_tail = seg[-1]
+    return out
+
+
+def plan_regions(root: TpuExec, conf) -> TpuExec:
+    """Group fusible operator chains of a physical tree into fused
+    regions.  Identity when ``spark.rapids.tpu.sql.fusion.enabled`` is
+    false — the per-op escape hatch."""
+    if not conf["spark.rapids.tpu.sql.fusion.enabled"]:
+        return root
+    return _rewrite(root, conf, True)

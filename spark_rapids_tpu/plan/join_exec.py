@@ -35,7 +35,8 @@ from ..batch import (ColumnBatch, DeviceColumn, DictStringColumn, Field,
 from ..exprs import EvalContext, Expression, promote_physical
 from ..ops import batch_utils
 from ..ops.groupby import group_sort_indices, _segment_starts
-from ..utils.metrics import fetch, fetch_scalars
+from ..utils.metrics import current_region, fetch, region_scalars, \
+    stage_scalars
 from .physical import ExecContext, TpuExec, _cached_program
 
 __all__ = ["SortMergeJoinExec"]
@@ -213,7 +214,7 @@ class SortMergeJoinExec(TpuExec):
         b_arrays = _dev_arrays(build)
         b_arrays = encode_key_arrays(b_arrays, build, lk, self.string_dicts)
         fn = _cached_program("smj-filter-stats|" + fp, build_stats)
-        kmin, kmax, n_valid, n_distinct = fetch_scalars(
+        kmin, kmax, n_valid, n_distinct = region_scalars(
             fn(b_arrays, np.int32(build.num_rows)))
         max_in = conf["spark.rapids.tpu.sql.dpp.maxInKeys"]
         cap = bucket_capacity(max_in)
@@ -237,7 +238,7 @@ class SortMergeJoinExec(TpuExec):
 
             gfn = _cached_program(f"smj-filter-vals|{fp}|{cap}",
                                   build_vals)
-            vals = fetch(gfn(b_arrays, np.int32(build.num_rows)))
+            vals = fetch(gfn(b_arrays, np.int32(build.num_rows)))  # fusion-ok (lazy DPP values: demanded by the scan, outside the region's member pulls)
             return vals[vals != np.iinfo(np.int64).max].tolist()
 
         scan.runtime_predicates = _runtime_key_preds(
@@ -460,7 +461,9 @@ class SortMergeJoinExec(TpuExec):
             active = active & left.sel
         counts = jnp.where(active, matches, 0)
         offsets = jnp.cumsum(counts)
-        total = fetch_scalars(offsets[-1])[0]  # one host sync: candidate-pair count
+        # one host sync: candidate-pair count (batched with any staged
+        # region stats when a fused region is active)
+        total = region_scalars(offsets[-1])[0]
         out_cap = bucket_capacity(max(total, 1))
 
         fp = self._fingerprint() + "|condexpand"
@@ -701,14 +704,18 @@ class SortMergeJoinExec(TpuExec):
         active = jnp.arange(probe.capacity, dtype=jnp.int32) < probe.num_rows
         counts = jnp.where(active, counts, 0)
         offsets = jnp.cumsum(counts)
-        total = fetch_scalars(offsets[-1])[0]  # the one host sync (output size)
         extra = 0
         b_unmatched = None
         if how == "full":
-            # build-side rows with no probe match are appended afterwards
+            # build-side rows with no probe match are appended afterwards;
+            # output size + unmatched count ride ONE sync together
             b_unmatched = self._unmatched_build_mask(probe, build, lo, matches,
                                                      b_perm)
-            extra = fetch_scalars(jnp.sum(b_unmatched))[0]
+            total, extra = region_scalars(
+                (offsets[-1], jnp.sum(b_unmatched)))
+        else:
+            # the one host sync (output size; region-batched when fused)
+            total = region_scalars(offsets[-1])[0]
         out_cap = bucket_capacity(max(total + extra, 1))
 
         fp = self._fingerprint() + f"|expand{probe_side}"
@@ -769,7 +776,7 @@ class SortMergeJoinExec(TpuExec):
         # destination slots total..total+extra-1 (host-side index math; the
         # unmatched count is already synced)
         # ONE batched fetch for the mask and both index arrays
-        un_mask, pi_full, bi_full = fetch(
+        un_mask, pi_full, bi_full = fetch(  # fusion-ok (full-row index arrays, data-dependent size: not a stats vector the prologue can pre-stage)
             (b_unmatched, p_cols["idx"], b_cols["idx"]))
         un_idx = np.flatnonzero(un_mask)
         dest = np.arange(total, total + len(un_idx))
@@ -902,6 +909,10 @@ class BroadcastJoinExec(SortMergeJoinExec):
     the resident build batch independently.  ``build_side`` must be the
     kernel's natural build for the join type (right, except left for
     how=right): the planner guarantees it (plan_broadcast_join)."""
+
+    # probe side streams: the region planner may chain through it.  The
+    # build side (BroadcastExchangeExec) is a region boundary.
+    region_fusible = True
 
     def __init__(self, plan, left: TpuExec, right: TpuExec, conf,
                  build_side: int, string_dicts: Optional[dict] = None):
@@ -1225,6 +1236,20 @@ class BroadcastJoinExec(SortMergeJoinExec):
         # (zero blocking fetches on the hit path)
         skey = ("dense-stats", fp, vcap)
         self._dense_stats_key = skey
+        # query-scoped dedupe: a second join node INSTANCE with the same
+        # stats program identity over the same materialized build (the
+        # same dim table joined twice in one query) shares the first
+        # instance's dispatched stats array AND its resolved host copy —
+        # the shared pending list means the sync is paid at most once
+        # per (program, build) per query, not once per join node
+        ctx = getattr(self, "_exec_ctx", None)
+        memo = getattr(ctx, "stats_memo", None)
+        mkey = (skey, id(build))
+        if memo is not None:
+            shared = memo.get(mkey)
+            if shared is not None:
+                self._dense_pending = shared
+                return
         ent = getattr(self, "_cache_entry", None)
         if ent is not None:
             host = ent.get_stat(skey)
@@ -1233,6 +1258,8 @@ class BroadcastJoinExec(SortMergeJoinExec):
                                              bk, self.string_dicts)
                 self._dense_pending = [id(build), build, None, b_arrays,
                                        host]
+                if memo is not None:
+                    memo[mkey] = self._dense_pending
                 return
 
         def build_stats():
@@ -1266,19 +1293,29 @@ class BroadcastJoinExec(SortMergeJoinExec):
         b_arrays = encode_key_arrays(b_arrays, build, bk, self.string_dicts)
         fn = _cached_program(f"bjoin-dense-stats|{vcap}|" + fp, build_stats)
         stats = fn(b_arrays, build.sel, np.int32(build.num_rows))
-        try:
-            stats.copy_to_host_async()
-        except AttributeError:
-            pass
+        # inside a fused region this STAGES the vector for the region's
+        # single batched prologue fetch; outside (fusion off) it is the
+        # same copy_to_host_async overlap the per-op path always had
+        stage_scalars((skey, id(build)), stats)
         # the batch rides in the list so its id cannot be recycled while
         # the prefetch is outstanding (same discipline as _bfast_cache);
         # slot 4 memoizes the host copy so stats + DPP values cost ONE
         # round trip between them
         self._dense_pending = [id(build), build, stats, b_arrays, None]
+        if memo is not None:
+            memo[mkey] = self._dense_pending
 
     def _pending_host(self, pending):
         if pending[4] is None:
-            pending[4] = fetch(pending[2])
+            r = current_region()
+            skey = getattr(self, "_dense_stats_key", None)
+            if r is not None and skey is not None:
+                # region path: the batched prologue fetch resolves EVERY
+                # staged stats vector in one sync; this join's is keyed
+                # by (program identity, build identity)
+                pending[4] = r.resolve((skey, pending[0]), pending[2])
+            else:
+                pending[4] = fetch(pending[2])  # fusion-ok (per-op path: the one stats sync this join pays)
             # a cache-resident build remembers its probed stats: the
             # NEXT query reusing this build skips the dispatch and this
             # blocking fetch entirely (see _dense_prefetch)
@@ -1511,21 +1548,34 @@ class BroadcastJoinExec(SortMergeJoinExec):
         if target is None:
             return
         scan, scol = target
-        host = self._pending_host(pending)
-        kmin, kmax, n_valid, dup = [int(x) for x in host[:4]]
         max_in = conf["spark.rapids.tpu.sql.dpp.maxInKeys"]
 
-        def values_fn():
-            big = np.iinfo(np.int64).max
-            vals = host[4:]
-            vals = vals[vals != big]
-            return vals.tolist() if len(vals) <= max_in else None
+        def preds_fn():
+            # deferred to the scan's first read (_effective_source): by
+            # then every join above the scan has staged its build stats,
+            # so inside a fused region this resolution rides ONE batched
+            # prologue fetch for the whole chain
+            host = self._pending_host(pending)
+            kmin, kmax, n_valid, dup = [int(x) for x in host[:4]]
 
-        scan.runtime_predicates = _runtime_key_preds(
-            scol, ct, kmin, kmax, n_valid, n_valid - dup, conf, values_fn)
+            def values_fn():
+                big = np.iinfo(np.int64).max
+                vals = host[4:]
+                vals = vals[vals != big]
+                return vals.tolist() if len(vals) <= max_in else None
+
+            return _runtime_key_preds(scol, ct, kmin, kmax, n_valid,
+                                      n_valid - dup, conf, values_fn)
+
+        scan.runtime_predicates = preds_fn
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
         m = ctx.metric_set(self.op_id)
+        # the dense-stats helpers run deep below execute with only conf
+        # in hand; the context rides on the node for the query-scoped
+        # stats memo (cleared in the finally — prepared-statement clones
+        # are per-run, so this never leaks across executions)
+        self._exec_ctx = ctx
         probe_side = 1 - self.build_side
         dense_ok = self._dense_static_ok(ctx.conf)
         # dense builds keep the selection mask (the build programs fold
@@ -1593,6 +1643,7 @@ class BroadcastJoinExec(SortMergeJoinExec):
             self._csr_cache = None
             self._dense_stats_host = None
             self._cache_entry = None
+            self._exec_ctx = None
 
 
 def _expand_rows(offsets, counts, out_cap: int):
@@ -1682,7 +1733,8 @@ def _scan_origin(node, out_name: str):
     from ..exprs import BoundReference
     name = out_name
     while True:
-        if isinstance(node, CoalesceBatchesExec):
+        from .fusion import FusedRegionExec
+        if isinstance(node, (CoalesceBatchesExec, FusedRegionExec)):
             node = node.children[0]
             continue
         if isinstance(node, StageExec):

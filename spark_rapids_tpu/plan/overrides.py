@@ -512,7 +512,11 @@ def apply_overrides(plan: L.LogicalPlan, conf: Optional[TpuConf] = None
             return CpuOpExec(p, [all_cpu(c) for c in m.children])
         return all_cpu(meta)
     from .coalesce import insert_coalesce
-    return insert_coalesce(_convert(meta, conf), conf)
+    from .fusion import plan_regions
+    # region fusion runs LAST: it groups the final operator chains (incl.
+    # the coalesce nodes insert_coalesce just placed) into fused regions.
+    # Identity under sql.fusion.enabled=false — the per-op escape hatch.
+    return plan_regions(insert_coalesce(_convert(meta, conf), conf), conf)
 
 
 def explain_plan(plan: L.LogicalPlan, conf: Optional[TpuConf] = None) -> str:

@@ -28,7 +28,8 @@ from ..config import TpuConf
 from ..exprs import (AggregateExpression, Alias, BoundReference, EvalContext,
                      Expression)
 from ..ops import batch_utils, groupby
-from ..utils.metrics import MetricSet, fetch, fetch_scalars, prestage
+from ..utils.metrics import MetricSet, fetch, fetch_scalars, prestage, \
+    region_fetch, region_scalars
 
 __all__ = ["ExecContext", "TpuExec", "ScanExec", "StageExec", "AggregateExec",
            "CollectExec"]
@@ -41,6 +42,11 @@ class ExecContext:
         self.conf = conf or TpuConf()
         self.device = device
         self.metrics: Dict[str, MetricSet] = {}
+        # query-scoped dedupe of identical stats programs across operator
+        # INSTANCES (join_exec._dense_prefetch): maps (program identity,
+        # build identity) -> the shared pending list, so the same dim
+        # table joined N times pays its stats dispatch + sync once
+        self.stats_memo: Dict[tuple, list] = {}
         # arm the OOM injector from the test configs (inject_oom marker /
         # spark.rapids.sql.test.injectRetryOOM analog)
         n_retry = self.conf["spark.rapids.tpu.test.injectRetryOOM"]
@@ -100,6 +106,13 @@ class TpuExec:
     # aggregates and shuffled joins)
     outputs_partitions = False
 
+    # True for operators the region planner (plan/fusion.py) may group
+    # into a fused region: streaming device operators whose host syncs
+    # route through the region's batched prologue.  Pipeline breakers
+    # (exchanges, sorts, windows, CPU fallbacks) stay False — they are
+    # the region boundaries.
+    region_fusible = False
+
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)
         fn = cls.__dict__.get("execute")
@@ -143,6 +156,8 @@ class ScanExec(TpuExec):
     io/ produce the source).  Mirrors GpuFileSourceScanExec: host-side parse,
     then upload at the device boundary (GpuParquetScan.scala readToTable)."""
 
+    region_fusible = True
+
     def __init__(self, schema: Schema, source_factory: Callable[[], Iterator],
                  desc: str = "source"):
         super().__init__()
@@ -163,8 +178,17 @@ class ScanExec(TpuExec):
 
     def _effective_source(self):
         src = self._source_factory
-        if self.runtime_predicates and hasattr(src, "with_pushdown"):
-            src = src.with_pushdown(None, self.runtime_predicates)
+        preds = self.runtime_predicates
+        if callable(preds):
+            # DPP hands over a THUNK: predicate materialization (which
+            # blocks on the join's build stats) defers to the first scan
+            # read.  Inside a fused region that ordering is the whole
+            # point — every join in the chain has STAGED its stats by
+            # the time the scan reads, so one prologue fetch covers all
+            # of them instead of one eager sync per join at build time.
+            preds = self.runtime_predicates = preds()
+        if preds and hasattr(src, "with_pushdown"):
+            src = src.with_pushdown(None, preds)
         return src
 
     def execute(self, ctx: ExecContext) -> Iterator[ColumnBatch]:
@@ -331,6 +355,8 @@ class StageExec(TpuExec):
     string column passed through by reference.  The whole list compiles to
     ONE XLA computation.
     """
+
+    region_fusible = True
 
     def __init__(self, child: TpuExec, steps: List[Tuple[str, object]],
                  output_schema: Schema):
@@ -666,6 +692,8 @@ class AggregateExec(TpuExec):
     Buffer layout (partial output schema): [key0..kN, buf0..bufM] where each
     aggregate contributes len(buffers()) buffer columns.
     """
+
+    region_fusible = True
 
     def __init__(self, child: TpuExec, group_exprs: List[Tuple[str, Expression]],
                  agg_exprs: List[Tuple[str, AggregateExpression]],
@@ -1036,7 +1064,9 @@ class AggregateExec(TpuExec):
                          else None for c in b.columns)
 
         sfn = _cached_program(fp + "|stats", build_stats)
-        kmin, kmax, n_valid = fetch_scalars(
+        # region-batched when fused: rides the region's prologue fetch
+        # alongside any join build stats staged during this same pull
+        kmin, kmax, n_valid = region_scalars(
             sfn(arrays_of(first), first.sel, np.int32(first.num_rows)))
         if n_valid == 0:
             return None
@@ -1133,7 +1163,7 @@ class AggregateExec(TpuExec):
                 if not leftovers:
                     return
                 # ONE batched fetch resolves which batches diverted rows
-                counts = fetch([c for _, c in leftovers])
+                counts = fetch([c for _, c in leftovers])  # fusion-ok (bounded-pin drain: data-dependent mid-stream, already batched across all leftovers)
                 for (b, _), cnt in zip(leftovers, counts):
                     if int(cnt):
                         left_parts.append(sort_part_fn(
@@ -1170,7 +1200,7 @@ class AggregateExec(TpuExec):
             # sparse domain (D >> groups) doesn't inflate every
             # downstream operator to D capacity
             n_groups_dev = jnp.sum((present > 0).astype(jnp.int64))
-            left_counts, n_groups = fetch(
+            left_counts, n_groups = fetch(  # fusion-ok (end-of-stream tail: one batched fetch by construction)
                 ([c for _, c in leftovers], n_groups_dev))
             for (b, _), cnt in zip(leftovers, left_counts):
                 if int(cnt):
@@ -1317,8 +1347,8 @@ class AggregateExec(TpuExec):
             # above, so this is a host-carried nested/decimal): sort path
             return None
         sfn = _cached_program(fp + "|stats", build_stats)
-        stats, nd = fetch(sfn(arrays_of(first), first.sel,
-                              np.int32(first.num_rows)))
+        stats, nd = region_fetch(sfn(arrays_of(first), first.sel,
+                                     np.int32(first.num_rows)))
         nd_all = int(nd[0])
         nd_by_cand = {i: int(nd[1 + k]) for k, i in enumerate(cand)}
         cap_conf = ctx.conf["spark.rapids.tpu.join.denseDomainCap"]
@@ -1488,7 +1518,7 @@ class AggregateExec(TpuExec):
             def flush_leftovers():
                 if not leftovers:
                     return
-                counts = fetch([c for _, c in leftovers])
+                counts = fetch([c for _, c in leftovers])  # fusion-ok (bounded-pin drain: data-dependent mid-stream, already batched across all leftovers)
                 for (b, _), cnt in zip(leftovers, counts):
                     if int(cnt):
                         left_parts.append(sort_part_fn(
@@ -1531,7 +1561,7 @@ class AggregateExec(TpuExec):
             # ONE end-of-stream fetch: violation flag + per-batch
             # leftover counts + group count together
             n_groups_dev = jnp.sum((present > 0).astype(jnp.int64))
-            tail = fetch((vfn(tuple(res), present),
+            tail = fetch((vfn(tuple(res), present),  # fusion-ok (end-of-stream tail: one batched fetch by construction)
                           [c for _, c in leftovers], n_groups_dev))
             violated, left_counts, n_groups = tail
             if bool(violated):
@@ -1961,7 +1991,7 @@ class AggregateExec(TpuExec):
             if isinstance(c, DeviceColumn) else None
             for c in batch.columns)
         sel = batch.sel[:scap] if batch.sel is not None else None
-        n_distinct, n_live = fetch_scalars(
+        n_distinct, n_live = region_scalars(
             fn(arrays, sel, np.int32(min(srows, scap))))
         if n_live == 0:
             return 0.0

@@ -12,7 +12,36 @@ from typing import List, Tuple
 from ..batch import Field, Schema
 from ..exprs import BoundReference, Expression, bind
 
-__all__ = ["strip_alias"]
+__all__ = ["strip_alias", "plan_query_regions", "explain_regions"]
+
+
+def plan_query_regions(root, conf):
+    """Public entry to the region-fusion planner (plan/fusion.py): group
+    fusible operator chains of an already-converted physical tree into
+    fused regions.  ``apply_overrides`` calls this implicitly at the end
+    of planning; tests and tooling that build physical trees by hand
+    (bench harnesses, mini-plan fixtures) call it directly to get the
+    same region formation the SQL path gets."""
+    from .fusion import plan_regions
+    return plan_regions(root, conf)
+
+
+def explain_regions(root) -> List[str]:
+    """One line per fused region of a planned physical tree — operator
+    kinds and member count, in plan order.  Empty when fusion formed no
+    regions (or is disabled)."""
+    from .fusion import FusedRegionExec
+    lines: List[str] = []
+
+    def walk(n):
+        if isinstance(n, FusedRegionExec):
+            lines.append(f"region[{len(n.members)}]: " + " -> ".join(
+                type(m).__name__ for m in n.members))
+        for c in n.children:
+            walk(c)
+
+    walk(root)
+    return lines
 
 
 def strip_alias(e: Expression) -> Expression:

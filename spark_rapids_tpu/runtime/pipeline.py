@@ -113,6 +113,14 @@ def effective_depth(ctx) -> int:
     from ..faults.injector import INJECTOR as FAULT_INJECTOR
     if FAULT_INJECTOR.deterministic_armed():
         return 0
+    # inside a fused region (plan/fusion.py) the REGION is the pipeline
+    # stage: member operators pull serially so the whole chain runs as
+    # one staged unit; the region's consumer stages region output at the
+    # configured depth.  Without this, every member would spawn its own
+    # stage workers and the "one dispatch per region" property dissolves.
+    from ..utils.metrics import current_region
+    if current_region() is not None:
+        return 0
     if not conf.is_set(_DEPTH_KEY):
         import jax
         if jax.default_backend() == "cpu":

@@ -21,7 +21,9 @@ from . import tracing
 
 __all__ = ["MetricSet", "TaskMetrics", "QueryStats", "trace_range",
            "fetch", "fetch_async", "fetch_scalars", "prestage",
-           "sync_budget", "FetchFuture"]
+           "sync_budget", "FetchFuture", "RegionPrologue", "region_scope",
+           "region_enter", "region_exit", "current_region",
+           "stage_scalars", "region_scalars", "region_fetch"]
 
 
 # the stack of query-scoped QueryStats instances for this context;
@@ -162,6 +164,13 @@ class QueryStats:
         self.server_spooled_bytes = 0
         self.prepared_hits = 0
         self.prepared_misses = 0
+        # whole-query data-path fusion (plan/fusion.py): regions the
+        # planner formed and executed, and the blocking fetches those
+        # regions paid through their batched prologue (a subset of
+        # blocking_fetches) — bench's fused_regions columns and the
+        # trace_report fusion: line read these
+        self.fused_regions = 0
+        self.region_fetches = 0
         # overload survival (service/admission.py): device spill events
         # attributed to THIS query's scope (the spill catalog stamps
         # the active scope at each device->host demotion) — the
@@ -422,6 +431,173 @@ def fetch_scalars(x) -> list:
     """Fetch a small device array of scalars as a list of Python ints."""
     import numpy as np
     return [int(v) for v in np.ravel(fetch(x))]
+
+
+# ---------------------------------------------------------------------------------
+# Region prologue: the batched stats-fetch contract of fused plan regions
+# (plan/fusion.py).  Every member operator STAGES its small device stat
+# vectors (join build stats, dense-agg key stats) as soon as they are
+# dispatched; the first member that needs a VALUE resolves every staged
+# vector in ONE blocking fetch — the region's prologue fetch.  Later
+# demands hit the host copy with zero syncs.  With no region active the
+# helpers degrade to plain prestage/fetch_scalars, byte-identically —
+# that is the sql.fusion.enabled=false escape hatch.
+# ---------------------------------------------------------------------------------
+
+_REGION_STACK: "contextvars.ContextVar[tuple]" = \
+    contextvars.ContextVar("srt_fusion_region", default=())
+
+
+class RegionPrologue:
+    """Per-region batching of blocking scalar fetches.
+
+    Keys identify a staged vector for later lookup (a join instance's
+    build-stats key); anonymous resolves ride the same batched fetch but
+    are not retained.  Thread-safe: member operators may stage from
+    pipeline workers running in a copied context.
+    """
+
+    __slots__ = ("label", "_lock", "_pending", "_host", "_trees", "_seq",
+                 "fetches", "staged", "batched")
+
+    def __init__(self, label: str = ""):
+        import threading
+        self.label = label
+        self._lock = threading.Lock()
+        self._pending: dict = {}   # key -> device tree (copy in flight)
+        self._host: dict = {}      # key -> host tree
+        self._trees: list = []     # pins staged device trees (id-stable keys)
+        self._seq = 0              # anonymous-resolve key counter
+        self.fetches = 0           # blocking prologue fetches this region paid
+        self.staged = 0            # vectors staged into the prologue
+        self.batched = 0           # values that rode a batch they didn't pay for
+
+    def stage(self, key, tree) -> None:
+        """Start the async D2H copy of ``tree`` and remember it under
+        ``key``.  Idempotent per key — re-staging an already staged or
+        resolved key is a no-op (the first dispatch wins)."""
+        with self._lock:
+            if key in self._host or key in self._pending:
+                return
+            self._pending[key] = tree
+            self._trees.append(tree)
+            self.staged += 1
+        _start_copies(tree)
+
+    def resolve(self, key, tree=None):
+        """Host value for ``key``.  A staged-and-resolved key costs zero
+        fetches; otherwise ALL currently pending vectors (plus ``tree``,
+        when given) resolve in one blocking fetch."""
+        with self._lock:
+            hit = self._host.get(key)
+            if hit is None and key not in self._pending:
+                if tree is None:
+                    raise KeyError(
+                        f"region prologue: {key!r} was never staged")
+                self._pending[key] = tree
+                self._trees.append(tree)
+                self.staged += 1
+        if hit is not None:
+            return hit
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        if pending:
+            self.fetches += 1
+            QueryStats.get().region_fetches += 1
+            # fetch over a key-ordered LIST, not the dict: jax pytrees
+            # sort dict keys, and prologue keys mix strings with tuples
+            # (join-stats (program, build-id) pairs, anonymous counters)
+            # which Python cannot order
+            ks = list(pending)
+            vals = fetch([pending[k] for k in ks])  # fusion-ok (THE region prologue fetch: one batched sync for every staged vector)
+            with self._lock:
+                self._host.update(zip(ks, vals))
+                self.batched += max(0, len(ks) - 1)
+        with self._lock:
+            return self._host[key]
+
+    def scalars(self, key, tree=None) -> list:
+        import numpy as np
+        return [int(v) for v in np.ravel(self.resolve(key, tree))]
+
+
+def current_region():
+    """The innermost active region prologue, or None outside any fused
+    region (the per-op fallback path)."""
+    stack = _REGION_STACK.get()
+    return stack[-1] if stack else None
+
+
+def region_enter(r: RegionPrologue):
+    """Push a region prologue onto the scope stack (low-level form of
+    :func:`region_scope`, for callers that must open/close the scope
+    around individual pulls of a generator rather than a ``with``
+    block — a scope held across a yield would leak to the consumer)."""
+    return _REGION_STACK.set(_REGION_STACK.get() + (r,))
+
+
+def region_exit(tok, r: RegionPrologue) -> None:
+    """Pop the region pushed by :func:`region_enter`."""
+    try:
+        _REGION_STACK.reset(tok)
+    except ValueError:
+        # generator-held scopes can violate token LIFO (interleaved
+        # streaming executions): drop just this entry
+        _REGION_STACK.set(tuple(
+            x for x in _REGION_STACK.get() if x is not r))
+
+
+@contextlib.contextmanager
+def region_scope(label: str = ""):
+    """Open a region prologue for the scope (contextvar-carried, so
+    pipeline workers spawned inside join it)."""
+    r = RegionPrologue(label)
+    tok = region_enter(r)
+    try:
+        yield r
+    finally:
+        region_exit(tok, r)
+
+
+def stage_scalars(key, tree) -> None:
+    """Stage a small device stat vector for the enclosing region's
+    batched prologue fetch; outside a region this is :func:`prestage`
+    (async copy hint only), byte-identically."""
+    r = current_region()
+    if r is None:
+        prestage(tree)
+        return
+    r.stage(key, tree)
+
+
+def region_fetch(tree, key=None):
+    """:func:`fetch` that routes through the enclosing region's batched
+    prologue (structure-preserving: returns the host tree); outside a
+    region it IS fetch — the escape-hatch path."""
+    r = current_region()
+    if r is None:
+        return fetch(tree)
+    if key is None:
+        with r._lock:
+            r._seq += 1
+            key = ("anon", r._seq)
+    return r.resolve(key, tree)
+
+
+def region_scalars(tree, key=None) -> list:
+    """:func:`fetch_scalars` that routes through the enclosing region's
+    prologue: inside a region the value resolves via the batched
+    prologue fetch (one blocking sync covers every staged vector);
+    outside a region it IS fetch_scalars — the escape-hatch path."""
+    r = current_region()
+    if r is None:
+        return fetch_scalars(tree)
+    if key is None:
+        # anonymous one-shot: ride the batched fetch without retention
+        with r._lock:
+            r._seq += 1
+            key = ("anon", r._seq)
+    return r.scalars(key, tree)
 
 
 class _SyncBudget:

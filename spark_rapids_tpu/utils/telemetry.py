@@ -160,6 +160,11 @@ METRICS = (
     ("query_donated_batches_total", "counter", "",
      "Input batches whose device buffers were donated to fused stage "
      "programs."),
+    ("query_fused_regions_total", "counter", "",
+     "Fused plan regions executed (plan/fusion.py region planner)."),
+    ("query_region_fetches_total", "counter", "",
+     "Blocking fetches paid through fused regions' batched prologues "
+     "(a subset of query_blocking_fetches_total)."),
     ("query_spill_events_total", "counter", "",
      "Device-to-host spill demotions charged to query scopes."),
     ("cache_hits_total", "counter", "",
@@ -235,6 +240,8 @@ _QS_FOLD = (
     ("shuffle_bytes", "query_shuffle_bytes_total"),
     ("h2d_wait_s", "query_h2d_wait_seconds_total"),
     ("donated_batches", "query_donated_batches_total"),
+    ("fused_regions", "query_fused_regions_total"),
+    ("region_fetches", "query_region_fetches_total"),
     ("spill_events", "query_spill_events_total"),
     ("cache_hits", "cache_hits_total"),
     ("cache_misses", "cache_misses_total"),

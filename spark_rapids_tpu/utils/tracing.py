@@ -377,6 +377,24 @@ def mark(op_id: Optional[str], name: str, cat: str = "mark",
         tr.add_event(op_id, name, cat, _pc(), 0.0, args or None)
 
 
+@contextlib.contextmanager
+def region_span(op_id: Optional[str], args_out: Optional[dict] = None):
+    """A ``fusion:region`` span wrapping a fused region's whole
+    execution (plan/fusion.FusedRegionExec).  Member-op spans recorded
+    inside keep their own attribution — profiled EXPLAIN and
+    trace_report still see per-op time — while this span carries the
+    region's summary attributes.  ``args_out`` is filled IN by the
+    caller before the scope closes (member count, prologue syncs,
+    compiles); it lands as the span's args.  The clock lives here so
+    the exec-node layer stays inside the span API."""
+    t0 = _pc()
+    try:
+        yield
+    finally:
+        record(op_id, "fusion:region", "fusion", t0, _pc() - t0,
+               **(args_out or {}))
+
+
 # ---------------------------------------------------------------------------------
 # Cross-rank trace shards: remote work done ON BEHALF of another rank's
 # traced query (a peer server streaming shuffle fragments to it) lands

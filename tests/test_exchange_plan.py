@@ -36,6 +36,15 @@ def _plan(df):
     return apply_overrides(df._plan, df.session._tpu_conf())
 
 
+def _unfused(node):
+    """See through the region wrapper: fusion groups execution, the
+    member subtree is the plan shape these tests assert on."""
+    from spark_rapids_tpu.plan.fusion import FusedRegionExec
+    while isinstance(node, FusedRegionExec):
+        node = node.children[0]
+    return node
+
+
 class TestExchangeInPlan:
     def test_grouped_agg_is_two_phase(self, session):
         df = session.create_dataframe({"k": [1, 2], "v": [1.0, 2.0]})
@@ -44,7 +53,7 @@ class TestExchangeInPlan:
         assert isinstance(phys, AggregateExec) and phys.mode == "final"
         exch = phys.children[0]
         assert isinstance(exch, ShuffleExchangeExec)
-        partial = exch.children[0]
+        partial = _unfused(exch.children[0])
         assert isinstance(partial, AggregateExec) and partial.mode == "partial"
         assert "TpuShuffleExchange" in phys.tree_string()
 
@@ -70,7 +79,7 @@ class TestExchangeInPlan:
         fresh_session.conf.set("spark.rapids.tpu.sql.exchange.enabled", False)
         df = fresh_session.create_dataframe({"k": [1, 2], "v": [1.0, 2.0]})
         q = df.group_by("k").agg(F.sum(F.col("v")).alias("s"))
-        phys = _plan(q)
+        phys = _unfused(_plan(q))
         assert isinstance(phys, AggregateExec) and phys.mode == "complete"
         got = q.collect()
         assert_rows_equal(got, [(1, 1.0), (2, 2.0)])

@@ -1,0 +1,284 @@
+"""Whole-query data-path fusion (plan/fusion.py).
+
+Region formation over the streaming spine, the maxOps splitter, stage
+merging, the fingerprint contract (region programs keyed by the member
+chain; cached DATA keyed see-through so fusion on/off share entries),
+and the RegionPrologue batching object behind the single prologue
+fetch.  End-to-end sync-budget differentials live in
+tests/test_sync_budget.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.cache.keys import plan_fingerprint
+from spark_rapids_tpu.plan.coalesce import CoalesceBatchesExec
+from spark_rapids_tpu.plan.fusion import (FusedRegionExec, _merge_stages,
+                                          _split_chain, note_self_time,
+                                          plan_regions, region_fingerprint)
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.plan.physical import ScanExec, StageExec, TpuExec
+from spark_rapids_tpu.plan.planner import explain_regions, plan_query_regions
+from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils.metrics import (QueryStats, RegionPrologue,
+                                            current_region, region_fetch,
+                                            region_scalars, region_scope,
+                                            stage_scalars)
+
+F = srt.functions
+
+
+@pytest.fixture()
+def sess():
+    return srt.Session.get_or_create()
+
+
+def _find(phys, cls):
+    out, stack = [], [phys]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, cls):
+            out.append(n)
+        stack.extend(n.children)
+    return out
+
+
+def _plan(sess, q, **conf):
+    for k, v in conf.items():
+        sess.conf.set(k, v)
+    try:
+        return apply_overrides(q._plan, sess._tpu_conf())
+    finally:
+        for k in conf:
+            sess.conf.unset(k)
+
+
+def _chain_query(sess, n=4096):
+    rng = np.random.default_rng(5)
+    df = sess.create_dataframe({
+        "a": rng.integers(0, 100, n).astype(np.int64),
+        "b": rng.random(n)})
+    return (df.filter(F.col("a") < 50)
+              .with_column("c", F.col("b") * 2)
+              .agg(F.sum(F.col("c")).alias("s")))
+
+
+def _join_query(sess, n=8192):
+    rng = np.random.default_rng(6)
+    fact = sess.create_dataframe({
+        "k": rng.integers(0, 256, n).astype(np.int64),
+        "j": rng.integers(0, 64, n).astype(np.int64),
+        "v": rng.random(n)})
+    d1 = sess.create_dataframe({"k": np.arange(256, dtype=np.int64),
+                                "w": rng.random(256)})
+    d2 = sess.create_dataframe({"j": np.arange(64, dtype=np.int64),
+                                "u": rng.random(64)})
+    return (fact.filter(F.col("k") < 200)
+                .join(d1, "k", "inner").join(d2, "j", "inner")
+                .group_by(F.col("k")).agg(F.sum(F.col("v")).alias("s")))
+
+
+class TestRegionPlanner:
+    def test_chain_forms_one_region(self, sess):
+        phys = _plan(sess, _chain_query(sess))
+        regions = _find(phys, FusedRegionExec)
+        assert len(regions) == 1
+        names = [type(m).__name__ for m in regions[0].members]
+        assert names[0] == "AggregateExec" and names[-1] == "ScanExec"
+        # the member subtree stays intact under the wrapper (EXPLAIN /
+        # trace attribution): children[0] IS the chain head
+        assert regions[0].children[0] is regions[0].members[0]
+
+    def test_escape_hatch_produces_identical_plan(self, sess):
+        from spark_rapids_tpu.config import TpuConf
+        q = _chain_query(sess)
+        off = _plan(sess, q, **{"spark.rapids.tpu.sql.fusion.enabled": False})
+        assert _find(off, FusedRegionExec) == []
+        # plan_regions with fusion disabled is the identity function:
+        # the escape hatch returns the very same tree object
+        conf_off = TpuConf({"spark.rapids.tpu.sql.fusion.enabled": False})
+        assert plan_regions(off, conf_off) is off
+
+    def test_join_spine_keeps_build_side_out(self, sess):
+        """The region follows the streaming (probe) spine; the broadcast
+        build side stays outside so its exchange/materialize semantics
+        are untouched."""
+        phys = _plan(sess, _join_query(sess))
+        regions = _find(phys, FusedRegionExec)
+        assert regions, "join chain should fuse"
+        names = [type(m).__name__ for m in regions[0].members]
+        assert names.count("BroadcastJoinExec") == 2
+        # both dim-table scans live OUTSIDE the region members
+        member_ids = {id(m) for r in regions for m in r.members}
+        scans = _find(phys, ScanExec)
+        outside = [s for s in scans if id(s) not in member_ids]
+        assert len(outside) >= 2
+
+    def test_max_ops_splits_regions(self, sess):
+        phys = _plan(sess, _join_query(sess),
+                     **{"spark.rapids.tpu.sql.fusion.maxOps": 2})
+        regions = _find(phys, FusedRegionExec)
+        assert regions
+        assert all(len(r.members) <= 2 for r in regions)
+
+    def test_split_chain_cuts_at_cheapest_boundary(self):
+        """The splitter cuts where adjacent observed self-times are
+        smallest (least dispatch overhead saved by keeping them fused)."""
+        class _N:
+            region_fusible = True
+
+            def __init__(self, tag):
+                self.tag = tag
+
+            def fingerprint(self):
+                return f"split-test-{self.tag}"
+
+        nodes = [_N(i) for i in range(4)]
+        for n, t in zip(nodes, (5.0, 5.0, 0.001, 0.001)):
+            from spark_rapids_tpu.plan.fusion import _member_key
+            note_self_time(_member_key(n), t)
+        segs = _split_chain(nodes, 3)
+        assert [len(s) for s in segs] == [2, 2]
+
+    def test_explain_regions_lines(self, sess):
+        phys = _plan(sess, _chain_query(sess))
+        lines = explain_regions(phys)
+        assert len(lines) == 1
+        assert lines[0].startswith("region[")
+        assert "ScanExec" in lines[0]
+        assert explain_regions(
+            _plan(sess, _chain_query(sess),
+                  **{"spark.rapids.tpu.sql.fusion.enabled": False})) == []
+
+    def test_plan_query_regions_delegates(self, sess):
+        off = _plan(sess, _chain_query(sess),
+                    **{"spark.rapids.tpu.sql.fusion.enabled": False})
+        on = plan_query_regions(off, sess._tpu_conf())
+        assert _find(on, FusedRegionExec)
+
+
+class TestStageMerge:
+    def test_merge_stages_concatenates_programs(self, sess):
+        """Splitting a planned stage in two and merging back yields the
+        same steps, child, and traced-program fingerprint."""
+        off = _plan(sess, _chain_query(sess),
+                    **{"spark.rapids.tpu.sql.fusion.enabled": False})
+        st = _find(off, StageExec)[0]
+        assert len(st.steps) >= 2 and not st.host_exprs
+        scan = st.children[0]
+        # cut after the leading filter: the intermediate schema there is
+        # still the scan schema, so both halves bind correctly
+        assert st.steps[0][0] == "filter"
+
+        def mk(child, steps, schema):
+            s = StageExec.__new__(StageExec)
+            TpuExec.__init__(s, [child])
+            s.steps, s.host_exprs, s._schema = list(steps), [], schema
+            return s
+
+        bottom = mk(scan, st.steps[:1], scan.output_schema)
+        top = mk(bottom, st.steps[1:], st.output_schema)
+        merged = _merge_stages(top, bottom)
+        assert merged.steps == st.steps
+        assert merged.children[0] is scan
+        assert merged.output_schema is st.output_schema
+        assert merged.fingerprint() == st.fingerprint()
+
+
+class TestFingerprints:
+    def test_region_fingerprint_chains_members(self, sess):
+        phys = _plan(sess, _chain_query(sess))
+        r = _find(phys, FusedRegionExec)[0]
+        fp = region_fingerprint(r)
+        assert fp != region_fingerprint(
+            _find(_plan(sess, _join_query(sess)), FusedRegionExec)[0])
+
+    def test_plan_fingerprint_sees_through_regions(self, sess):
+        """Cached DATA is keyed by what was computed, not by how it was
+        grouped: fusion on/off must share query-cache entries."""
+        phys = _plan(sess, _chain_query(sess))
+        r = _find(phys, FusedRegionExec)[0]
+        assert plan_fingerprint(r) == plan_fingerprint(r.children[0])
+
+
+class TestRegionPrologue:
+    def _stats(self):
+        st = QueryStats()
+        tok = M._STATS_STACK.set(M._STATS_STACK.get() + (st,))
+        return st, tok
+
+    def test_resolve_batches_staged_vectors(self):
+        """N staged stat vectors resolve in ONE blocking fetch."""
+        st, tok = self._stats()
+        try:
+            r = RegionPrologue("region@test")
+            r.stage("a", jnp.arange(4))
+            r.stage("b", jnp.arange(8) * 2)
+            before = st.blocking_fetches
+            va = r.scalars("a", jnp.arange(4))
+            vb = r.scalars("b", jnp.arange(8) * 2)
+            assert va == [0, 1, 2, 3]
+            assert vb[:2] == [0, 2]
+            assert st.blocking_fetches == before + 1
+            assert st.region_fetches == 1
+        finally:
+            M._STATS_STACK.reset(tok)
+
+    def test_region_scope_and_fallbacks(self):
+        assert current_region() is None
+        # outside any region the helpers degrade to plain fetches
+        assert region_scalars(jnp.asarray([7]))[0] == 7
+        assert int(np.asarray(region_fetch(jnp.asarray([9])))[0]) == 9
+        with region_scope("region@scope") as r:
+            assert current_region() is r
+            stage_scalars("k", jnp.asarray([1, 2]))
+            assert region_scalars(jnp.asarray([1, 2]), key="k") == [1, 2]
+        assert current_region() is None
+
+    def test_anonymous_keys_are_distinct(self):
+        with region_scope("region@anon"):
+            a = region_fetch(jnp.asarray([1]))
+            b = region_fetch(jnp.asarray([2]))
+        assert int(np.asarray(a)[0]) == 1
+        assert int(np.asarray(b)[0]) == 2
+
+
+class TestExecution:
+    def test_region_is_single_pipeline_stage(self, sess):
+        """effective_depth collapses to 0 inside a region: members pull
+        serially; only the region's consumer keeps configured depth."""
+        from spark_rapids_tpu.plan.physical import ExecContext
+        from spark_rapids_tpu.runtime.pipeline import effective_depth
+        ctx = ExecContext(sess._tpu_conf().with_settings(
+            **{"spark.rapids.tpu.sql.pipeline.depth": 2}),
+            device=sess.device)
+        assert effective_depth(ctx) == 2
+        with region_scope("region@depth"):
+            assert effective_depth(ctx) == 0
+        assert effective_depth(ctx) == 2
+
+    def test_fused_execution_matches_unfused(self, sess):
+        q = _join_query(sess)
+
+        def run(fusion):
+            sess.conf.set("spark.rapids.tpu.sql.fusion.enabled", fusion)
+            st = QueryStats()
+            tok = M._STATS_STACK.set(M._STATS_STACK.get() + (st,))
+            try:
+                return q.collect(), st
+            finally:
+                M._STATS_STACK.reset(tok)
+                sess.conf.unset("spark.rapids.tpu.sql.fusion.enabled")
+
+        on, s_on = run(True)
+        off, s_off = run(False)
+        assert s_on.fused_regions >= 1
+        assert s_off.fused_regions == 0
+
+        def norm(rows):
+            return sorted(tuple(r.values()) if isinstance(r, dict)
+                          else tuple(r) for r in rows)
+
+        assert norm(on) == norm(off)

@@ -70,6 +70,40 @@ class TestBlockingFetch:
             "    return jax.device_get(x)\n")}, ["blocking-fetch"])
         assert report.failing == []
 
+    def test_region_fusible_raw_sync_detected(self, tmp_path):
+        """A raw fetch/fetch_scalars inside a ``region_fusible = True``
+        operator body breaks the one-prologue-fetch-per-region
+        contract; the same call in a non-fusible class is fine."""
+        report = _lint(tmp_path, {"plan/bad.py": (
+            "from spark_rapids_tpu.utils.metrics import fetch, fetch_scalars\n"
+            "class FooExec:\n"
+            "    region_fusible = True\n"
+            "    def execute(self, ctx):\n"
+            "        n = fetch_scalars(ctx.counts)[0]\n"
+            "        return fetch(ctx.batch)\n"
+            "class BarExec:\n"
+            "    region_fusible = False\n"
+            "    def execute(self, ctx):\n"
+            "        return fetch(ctx.batch)\n")}, ["blocking-fetch"])
+        assert sorted(f.line for f in report.failing) == [5, 6]
+        assert all("region prologue" in f.message for f in report.failing)
+
+    def test_region_fusible_fusion_ok_suppresses(self, tmp_path):
+        """``# fusion-ok (<why>)`` exempts a sync that genuinely cannot
+        ride the prologue; the prologue APIs themselves never flag."""
+        report = _lint(tmp_path, {"plan/ok.py": (
+            "from spark_rapids_tpu.utils.metrics import (\n"
+            "    fetch, region_scalars, stage_scalars)\n"
+            "class FooExec:\n"
+            "    region_fusible = True\n"
+            "    def execute(self, ctx):\n"
+            "        stage_scalars('k', ctx.counts)\n"
+            "        n = region_scalars(ctx.counts)[0]\n"
+            "        tail = fetch(ctx.tail)  # fusion-ok (end-of-stream tail: one batched fetch by construction)\n"
+            "        return n, tail\n")}, ["blocking-fetch"])
+        assert report.failing == []
+        assert len(report.suppressed) == 1
+
 
 class TestSpanTiming:
     def test_aliased_clock_import(self, tmp_path):

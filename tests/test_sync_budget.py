@@ -154,6 +154,141 @@ def test_warm_cache_scan_agg_budget(sess, tmp_path):
         clear_query_cache()
 
 
+def _dense_join_query(sess, n=8192, seed=1):
+    """Scan→filter→join→join→agg chain whose join build stats ride the
+    dense path (unique arange build keys; denseMinProbeRows lowered by
+    the caller) — the shape the region prologue batches."""
+    f = srt.functions
+    rng = np.random.default_rng(seed)
+    fact = sess.create_dataframe({
+        "k": rng.integers(0, 512, n).astype(np.int64),
+        "j": rng.integers(0, 128, n).astype(np.int64),
+        "v": rng.random(n)})
+    d1 = sess.create_dataframe({"k": np.arange(512, dtype=np.int64),
+                                "w": rng.random(512)})
+    d2 = sess.create_dataframe({"j": np.arange(128, dtype=np.int64),
+                                "u": rng.random(128)})
+    return (fact.filter(f.col("k") < 400)
+                .join(d1, "k", "inner").join(d2, "j", "inner")
+                .group_by(f.col("k")).agg(f.sum(f.col("v")).alias("s")))
+
+
+def _norm(rows):
+    return sorted(tuple(r.values()) if isinstance(r, dict) else tuple(r)
+                  for r in rows)
+
+
+def _collect_with_stats(sess, q, **conf):
+    for k, v in conf.items():
+        sess.conf.set(k, v)
+    st = QueryStats()
+    tok = M._STATS_STACK.set(M._STATS_STACK.get() + (st,))
+    try:
+        return q.collect(), st
+    finally:
+        M._STATS_STACK.reset(tok)
+        for k in conf:
+            sess.conf.unset(k)
+
+
+def test_fused_region_prologue_budget(sess):
+    """The tentpole contract: a fused scan→filter→join→join→agg region
+    batches its member stats syncs into the region prologue, so the
+    two joins' build-stats fetches cost ONE prologue fetch — fusion-on
+    pays strictly fewer blocking fetches than the per-operator path,
+    and the fusion-off oracle stays exact."""
+    from spark_rapids_tpu.memory.spill import get_catalog
+    q = _dense_join_query(sess)
+    sess.conf.set("spark.rapids.tpu.join.denseMinProbeRows", 1024)
+    try:
+        on, s_on = _collect_with_stats(
+            sess, q, **{"spark.rapids.tpu.sql.fusion.enabled": True})
+        off, s_off = _collect_with_stats(
+            sess, q, **{"spark.rapids.tpu.sql.fusion.enabled": False})
+    finally:
+        sess.conf.unset("spark.rapids.tpu.join.denseMinProbeRows")
+    assert s_on.fused_regions >= 1
+    assert s_off.fused_regions == 0
+    # both join-stat syncs collapsed into one batched prologue fetch:
+    # at least one blocking round trip saved outright
+    assert s_on.blocking_fetches <= s_off.blocking_fetches - 1
+    # each region pays at most 2 batched resolves on this shape (the
+    # join-stats prologue + the agg candidate-stats pull), never the
+    # per-operator fetch count
+    assert s_on.region_fetches <= 2 * s_on.fused_regions
+    assert _norm(on) == _norm(off)
+    get_catalog().assert_no_leaks()
+
+
+def test_fusion_on_off_share_cache_entries(sess, tmp_path):
+    """plan_fingerprint sees THROUGH FusedRegionExec: data cached by a
+    fusion-on run must hit for the same query with fusion off (and vice
+    versa) — the region is an execution grouping, not a different query."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.cache import clear_query_cache, get_query_cache
+    f = srt.functions
+    rng = np.random.default_rng(23)
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+        "a": rng.integers(0, 100, 4096).astype(np.int64),
+        "b": rng.random(4096)}), preserve_index=False), path)
+    sess.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+    clear_query_cache()
+    try:
+        df = sess.read_parquet(path)
+        q = df.filter(f.col("a") < 50).agg(f.sum(f.col("b")).alias("s"))
+        on, _ = _collect_with_stats(
+            sess, q, **{"spark.rapids.tpu.sql.fusion.enabled": True})
+        hits0 = get_query_cache().hits
+        off, _ = _collect_with_stats(
+            sess, q, **{"spark.rapids.tpu.sql.fusion.enabled": False})
+        assert get_query_cache().hits > hits0
+        assert _norm(on) == _norm(off)
+    finally:
+        sess.conf.unset("spark.rapids.tpu.sql.cache.enabled")
+        clear_query_cache()
+
+
+def test_fusion_concurrent_queries_stay_scoped(sess):
+    """Two queries running fused regions concurrently (the scheduler
+    path): the contextvar-carried region scope must not leak across
+    threads — each query batches only its own stats, results exact."""
+    import threading
+
+    from spark_rapids_tpu.memory.spill import get_catalog
+    qs = [_dense_join_query(sess, seed=s) for s in (11, 12)]
+    oracle = []
+    for q in qs:
+        out, _ = _collect_with_stats(
+            sess, q, **{"spark.rapids.tpu.sql.fusion.enabled": False})
+        oracle.append(_norm(out))
+    sess.conf.set("spark.rapids.tpu.sql.fusion.enabled", True)
+    results = [None, None]
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = _norm(qs[i].collect())
+        except Exception as e:  # pragma: no cover - surfaced by assert
+            errors.append(e)
+
+    try:
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        sess.conf.unset("spark.rapids.tpu.sql.fusion.enabled")
+    assert not errors
+    assert results[0] == oracle[0]
+    assert results[1] == oracle[1]
+    get_catalog().assert_no_leaks()
+
+
 def test_deferred_metrics_do_not_block(sess):
     """Deferred operator metrics resolve via the async path: reading
     them after a query adds no blocking fetch."""

@@ -12,7 +12,8 @@ Accepted file shapes (auto-detected):
 
 Usage:
   python tools/bench_compare.py OLD.json NEW.json \
-      [--max-query-regress-pct 20] [--max-agg-regress-pct 5]
+      [--max-query-regress-pct 20] [--max-agg-regress-pct 5] \
+      [--max-sync-increase 0]
 
 Exit codes: 0 = no regression, 1 = regression found, 2 = usage/parse
 error.  A query that completed in OLD but errored/vanished in NEW is a
@@ -64,11 +65,35 @@ def query_times(agg: dict) -> Dict[str, Optional[float]]:
     return out
 
 
+def query_syncs(agg: dict) -> Dict[str, Optional[float]]:
+    """{query: warm blocking-sync count} where the aggregate has one."""
+    out: Dict[str, Optional[float]] = {}
+    for k, v in agg.items():
+        if isinstance(v, dict) and "syncs_warm" in v:
+            out[k] = float(v["syncs_warm"])
+    return out
+
+
 def compare(old: dict, new: dict, max_query_pct: float,
-            max_agg_pct: float) -> Tuple[list, list]:
+            max_agg_pct: float, max_sync_increase: float = 0.0
+            ) -> Tuple[list, list]:
     """Return (regressions, notes) as printable strings."""
     regressions, notes = [], []
     old_q, new_q = query_times(old), query_times(new)
+
+    # sync-count guard (region fusion's latency contract): each blocking
+    # device→host fetch costs a full round trip on the tunneled chip, so
+    # a warm sync-count increase beyond the tolerance is a regression
+    # even when wall-clock noise hides it
+    old_s, new_s = query_syncs(old), query_syncs(new)
+    for q in sorted(set(old_s) & set(new_s)):
+        o, n = old_s[q], new_s[q]
+        if n > o + max_sync_increase:
+            regressions.append(
+                f"{q}: syncs_warm {o:g} -> {n:g}  "
+                f"[> +{max_sync_increase:g} blocking fetches]")
+        elif n < o:
+            notes.append(f"{q}: syncs_warm {o:g} -> {n:g}  [improved]")
 
     old_v = float(old.get("value") or 0.0)
     new_v = float(new.get("value") or 0.0)
@@ -115,6 +140,9 @@ def main(argv=None) -> int:
                    help="per-query engine_s slowdown tolerated (%%)")
     p.add_argument("--max-agg-regress-pct", type=float, default=5.0,
                    help="aggregate geomean drop tolerated (%%)")
+    p.add_argument("--max-sync-increase", type=float, default=0.0,
+                   help="per-query warm blocking-sync count increase "
+                        "tolerated (absolute fetches; default 0)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="print regressions only")
     args = p.parse_args(argv)
@@ -125,7 +153,8 @@ def main(argv=None) -> int:
         print(f"bench_compare: {e}", file=sys.stderr)
         return 2
     regressions, notes = compare(old, new, args.max_query_regress_pct,
-                                 args.max_agg_regress_pct)
+                                 args.max_agg_regress_pct,
+                                 args.max_sync_increase)
     if not args.quiet:
         for line in notes:
             print("  " + line)

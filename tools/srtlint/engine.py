@@ -35,7 +35,7 @@ DEFAULT_ROOTS = ("spark_rapids_tpu", "tools")
 # invalidates cached verdicts even when the tree itself is untouched
 # (srtlint's own sources are inside the scanned roots, so edits to the
 # engine/passes also change the content fingerprint directly)
-ENGINE_VERSION = "2.1"
+ENGINE_VERSION = "2.2"
 
 _IGNORE = re.compile(
     r"#\s*srtlint:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*(\(([^)]*)\))?")
@@ -49,10 +49,11 @@ LEGACY_MARKERS = {
     "# cache-key-ok": "cache-keys",
     "# fault-ok": "fault-paths",
     "# wait-ok": "fault-paths",
+    "# fusion-ok": "blocking-fetch",
 }
 _LEGACY = re.compile(
     r"#\s*(choke-point-ok|span-api-ok|ctx-ok|cache-key-ok|fault-ok|"
-    r"wait-ok)\b\s*(\(([^)]*)\))?")
+    r"wait-ok|fusion-ok)\b\s*(\(([^)]*)\))?")
 
 
 @dataclass

@@ -22,14 +22,42 @@ choke point:
     the old regex scanner missed) is caught too;
   * ``np.asarray(<col>.data / .valid / .codes)`` — an implicit D2H of
     a DeviceColumn's arrays, however numpy was imported and however
-    many lines the call spans.
+    many lines the call spans;
+  * raw ``utils.metrics.fetch`` / ``fetch_scalars`` inside the body of
+    a REGION-FUSIBLE operator class (``region_fusible = True``): those
+    syncs must route through the region prologue API
+    (``stage_scalars`` / ``region_scalars`` / ``region_fetch``) so a
+    fused region keeps its one-batched-prologue-fetch contract, or
+    carry ``# fusion-ok (<why this sync cannot ride the prologue>)``.
 
 Suppress with ``# choke-point-ok (<why this is not a device
-transfer>)`` or ``# srtlint: ignore[blocking-fetch] (<why>)``.
+transfer>)``, ``# fusion-ok (<why>)`` for the region-prologue shape,
+or ``# srtlint: ignore[blocking-fetch] (<why>)``.
 """
 
 OPERATOR_DIRS = ("plan", "ops", "parallel")
 _COL_ATTRS = {"data", "valid", "codes"}
+_RAW_SYNCS = ("spark_rapids_tpu.utils.metrics.fetch",
+              "spark_rapids_tpu.utils.metrics.fetch_scalars",
+              "utils.metrics.fetch", "utils.metrics.fetch_scalars")
+
+
+def _fusible_classes(sf):
+    """ClassDef nodes whose body sets ``region_fusible = True``."""
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and stmt.value.value is True \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "region_fusible"
+                            for t in stmt.targets):
+                out.append(node)
+                break
+    return out
 
 
 def run(tree) -> List:
@@ -55,4 +83,19 @@ def run(tree) -> List:
                         f"np.asarray(...{arg.attr}) is an implicit "
                         "blocking D2H transfer the sync profile never "
                         "sees — use utils.metrics.fetch"))
+        # region-prologue contract: raw blocking syncs inside fusible
+        # operator bodies break the one-fetch-per-region guarantee
+        for cls in _fusible_classes(sf):
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = sf.call_qualname(node)
+                if q in _RAW_SYNCS:
+                    findings.append(tree.finding(
+                        sf, node, RULE,
+                        f"raw {q.rsplit('.', 1)[-1]} inside region-"
+                        f"fusible operator {cls.name} bypasses the "
+                        "region prologue — use stage_scalars/"
+                        "region_scalars/region_fetch, or mark "
+                        "# fusion-ok (<why>)"))
     return findings

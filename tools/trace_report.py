@@ -224,6 +224,11 @@ def analyze(data: dict) -> dict:
     def _fname_cat(evs, n):
         return sum(1 for e in evs if e.get("name") == n)
 
+    # region-fusion spans (cat "fusion": one fusion:region span per
+    # executed region, args = member count / prologue syncs / compiles)
+    fusion_events = [e for e in xs if e.get("cat") == "fusion"
+                     and e.get("name") == "fusion:region"]
+
     fetch_events = [e for e in xs if e.get("cat") == "fetch"]
     blocking = [e for e in fetch_events
                 if e.get("args", {}).get("blocking")]
@@ -263,6 +268,13 @@ def analyze(data: dict) -> dict:
         "cache_bytes_saved": int(qargs.get("cache_hit_bytes", sum(
             e.get("args", {}).get("bytes", 0) for e in cache_events
             if e.get("name") == "cache:hit"))),
+        "fused_regions": len(fusion_events),
+        "fusion_members": [int(e.get("args", {}).get("members", 0))
+                           for e in fusion_events],
+        "fusion_syncs": [int(e.get("args", {}).get("syncs", 0))
+                         for e in fusion_events],
+        "fusion_compiles": sum(int(e.get("args", {}).get("compiles", 0))
+                               for e in fusion_events),
         "faults_injected": int(qargs.get("faults_injected",
                                          _fname("fault:injected"))),
         "transient_retries": int(qargs.get("transient_retries",
@@ -366,6 +378,14 @@ def format_report(a: dict) -> str:
             f"cache: hits={a['cache_hits']} misses={a['cache_misses']} "
             f"evictions={a['cache_evictions']} hit_ratio={ratio:.2f} "
             f"saved={a['cache_bytes_saved'] / 1e6:.1f}MB")
+    # fusion summary only when the region planner formed fused regions
+    if a.get("fused_regions"):
+        members = ",".join(str(m) for m in a.get("fusion_members", []))
+        syncs = ",".join(str(s) for s in a.get("fusion_syncs", []))
+        lines.append(
+            f"fusion: regions={a['fused_regions']} "
+            f"members/region=[{members}] syncs/region=[{syncs}] "
+            f"fused_compiles={a['fusion_compiles']}")
     # fault summary only when the query saw the fault framework act
     touched = (a.get("faults_injected", 0) + a.get("transient_retries", 0)
                + a.get("fragments_recomputed", 0)
