@@ -699,6 +699,31 @@ TRACE_MAX_EVENTS = register(
     "counted (otherData.dropped_events in the export) but not stored, "
     "bounding trace memory for long streaming queries.", conv=int)
 
+RECORDER_ENABLED = register(
+    "spark.rapids.tpu.recorder.enabled", True,
+    "Performance flight recorder: run tracing always-on and offer "
+    "every completed query's span tree to a bounded per-process ring "
+    "(utils/recorder.py). Retention keeps the interesting tail — SLO "
+    "violations, non-ok outcomes, top-k slowest per statement "
+    "fingerprint, first-seen fingerprints — and drops the boring "
+    "median (counted in recorder_dropped_total). Retained traces are "
+    "listed in /snapshot and /debug/slow and dump to sql.trace.dir "
+    "when set. Span overhead is the same <2.5% the tracer already "
+    "pays; the ring bounds the memory.")
+
+RECORDER_MAX_QUERIES = register(
+    "spark.rapids.tpu.recorder.maxQueries", 48,
+    "Retained query traces the flight-recorder ring holds before "
+    "evicting oldest-first (recorder_dropped_total{reason=evicted}).",
+    conv=int, check=lambda v: None if v >= 1 else "must be >= 1")
+
+RECORDER_MAX_BYTES = register(
+    "spark.rapids.tpu.recorder.maxBytes", 32 << 20,
+    "Approximate byte budget for the flight-recorder ring (estimated "
+    "per-event, not deep-measured); oldest captures evict until under "
+    "budget, though the newest capture always survives.",
+    conv=int, check=lambda v: None if v >= 1 else "must be >= 1")
+
 TEST_VALIDATE_EXECS = register(
     "spark.rapids.tpu.test.validateExecsOnTpu", False,
     "Test-only: fail if any operator in the plan falls back to CPU.",

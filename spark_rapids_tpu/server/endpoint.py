@@ -854,6 +854,7 @@ class SqlFrontDoor:
         + prepared and device caches + the live metrics registry + SLO
         burn + the DCN fleet rollup — one JSON document any door can
         serve (``/snapshot`` and the wire OPS op)."""
+        from ..utils import recorder as _recorder
         from ..utils import telemetry as _tm
         snap = self.snapshot()
         quotas = {
@@ -875,6 +876,7 @@ class SqlFrontDoor:
             "telemetry": _tm.snapshot(),
             "slo": _tm.slo_snapshot(),
             "fleet": _tm.fleet(),
+            "recorder": _recorder.snapshot(),
         }
 
 

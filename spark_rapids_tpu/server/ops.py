@@ -12,9 +12,14 @@ accept thread, per-request handler threads) bound from
     the count of quarantined statement fingerprints either way;
   * ``GET /snapshot`` — the unified JSON view (front-door counters +
     scheduler/admission/breaker/brownout + tenant quotas + prepared
-    and device caches + telemetry + SLO burn + the DCN fleet rollup)
-    that ``tools/srtop.py`` polls and ``tools/loadgen.py`` reconciles
-    against client-observed truth.
+    and device caches + telemetry + SLO burn + the DCN fleet rollup +
+    the flight recorder's capture list) that ``tools/srtop.py`` polls
+    and ``tools/loadgen.py`` reconciles against client-observed truth;
+  * ``GET /debug/slow`` — the flight recorder's retained slow-query
+    captures rendered human-first (fingerprint, wall, retention
+    reason, dominant-term verdict, capture id) plus the compile
+    ledger's hottest fingerprints — the "why is it slow RIGHT NOW"
+    page (``tools/explain_slow.py`` gives the per-query deep dive).
 
 The same ``/snapshot`` payload is served over the wire protocol's
 typed ``OPS`` op (:data:`..server.protocol.REQ_OPS`), so a scraper
@@ -29,9 +34,55 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from ..utils import telemetry
+from ..utils import recorder, telemetry
 
-__all__ = ["OpsServer"]
+__all__ = ["OpsServer", "render_debug_slow"]
+
+
+def render_debug_slow() -> str:
+    """The ``/debug/slow`` page body: retained captures newest-first
+    plus the compile ledger's hottest fingerprints, as plain text (the
+    page is for a human mid-incident; the same data rides ``/snapshot``
+    as JSON for tools)."""
+    snap = recorder.snapshot()
+    lines = [
+        "flight recorder: "
+        f"{snap['queries']}/{snap['max_queries']} captures, "
+        f"{snap['bytes']}/{snap['max_bytes']} bytes, "
+        f"sealed={snap['sealed']} boring={snap['dropped_boring']} "
+        f"evicted={snap['evicted']} missed={snap['missed']} "
+        f"pending_seals={snap['pending_seals']}",
+        "",
+        f"{'CAPTURE':16s} {'FINGERPRINT':16s} {'WALL':>9s} "
+        f"{'STATUS':10s} {'REASON':10s} {'VERDICT':12s} LABEL",
+    ]
+    for cap in snap["captures"]:
+        lines.append(
+            f"{cap['capture_id']:16s} {cap['fingerprint']:16s} "
+            f"{cap['wall_ms']:>7.1f}ms {cap['status']:10s} "
+            f"{cap['reason']:10s} {(cap['verdict'] or '-'):12s} "
+            f"{cap['label']}")
+    if not snap["captures"]:
+        lines.append("  (no retained captures)")
+    ledger = snap["compile_ledger"]
+    lines += [
+        "",
+        f"compile ledger: {ledger['compiles']} compiles / "
+        f"{ledger['compile_s']}s across {ledger['fingerprints']} "
+        f"fingerprints"
+        + ("  ** RECOMPILE STORM **" if ledger["storming"] else ""),
+        f"{'FINGERPRINT':16s} {'COUNT':>6s} {'TOTAL':>9s} "
+        f"{'LAST':>9s} TRIGGERS",
+    ]
+    for e in ledger["top"]:
+        trig = " ".join(f"{k}={v}"
+                        for k, v in sorted(e["triggers"].items()))
+        lines.append(
+            f"{e['fingerprint']:16s} {e['count']:>6d} "
+            f"{e['total_s']:>8.3f}s {e['last_s']:>8.3f}s {trig}")
+    if not ledger["top"]:
+        lines.append("  (no compiles observed)")
+    return "\n".join(lines) + "\n"
 
 
 class OpsServer:
@@ -89,6 +140,12 @@ class OpsServer:
                             200,
                             json.dumps(door.ops_snapshot()).encode(),
                             "application/json")
+                    elif path == "/debug/slow":
+                        telemetry.count("ops_scrapes_total",
+                                        endpoint="debug_slow")
+                        self._reply(200,
+                                    render_debug_slow().encode(),
+                                    "text/plain")
                     else:
                         self._reply(404, b"not found\n", "text/plain")
                 except (BrokenPipeError, ConnectionError):

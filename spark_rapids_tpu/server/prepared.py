@@ -128,6 +128,7 @@ class PreparedCache:
         if not enabled:
             return stmt, False
         cap = conf["spark.rapids.tpu.server.preparedCache.maxEntries"]
+        evicted = []
         with self._lock:
             self._stmts[fp] = stmt
             while len(self._stmts) > max(1, cap):
@@ -135,6 +136,14 @@ class PreparedCache:
                               key=lambda s: s.last_used_t)
                 del self._stmts[coldest.fingerprint]
                 self.evictions += 1
+                evicted.append(coldest.fingerprint)
+        if evicted:
+            # the compile ledger attributes these fingerprints' NEXT
+            # compiles to the eviction (trigger=cache_evict), not to a
+            # shape change — capacity churn becomes visible as itself
+            from ..utils import recorder
+            for old_fp in evicted:
+                recorder.compile_evicted(old_fp)
         return stmt, False
 
     def get(self, fingerprint: str) -> Optional[PreparedStatement]:

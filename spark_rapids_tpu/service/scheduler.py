@@ -787,6 +787,12 @@ class QueryScheduler:
         tracing.mark(None, "query:resubmitted", "fault",
                      label=e.label, retry=retry_label,
                      attempt=e.resubmits, reason=type(exc).__name__)
+        # seal the faulted attempt's capture under the OLD control (its
+        # trace ends 'resubmitted'); the retry's fresh control seals on
+        # its own completion.  Not SLO-eligible: slo_observe only sees
+        # terminal resolutions, and a resubmitted attempt isn't one
+        from ..utils import recorder
+        recorder.outcome(ctl, None, ok=False, slo_eligible=False)
         return True
 
     def _finish(self, e: _Entry, status: str, result, error) -> None:
@@ -819,6 +825,11 @@ class QueryScheduler:
                         tenant=t)
         telemetry.observe("query_latency_seconds", latency, tenant=t)
         telemetry.slo_observe(t, latency, ok=(status == "done"))
+        # flight-recorder seal: the capture decision shares slo_observe's
+        # exact verdict, so recorder_captures_total{reason=slo}
+        # reconciles with slo_bad_total query for query
+        from ..utils import recorder
+        recorder.outcome(e.control, latency, ok=(status == "done"))
         telemetry.gauge_set("queries_running", float(running_now))
         telemetry.gauge_set("queue_depth", float(depth_now))
         if error is not None:
@@ -844,6 +855,12 @@ class QueryScheduler:
         # release its admission byte reservation here (idempotent — the
         # zombie's eventual late release is a no-op)
         self.admission.release(e)
+        # park the verdict for the flight recorder: the zombie's trace
+        # (if its unwind ever runs) seals 'faulted' against it.  Not
+        # SLO-eligible — _force_finish never feeds slo_observe either
+        from ..utils import recorder
+        recorder.outcome(e.control, e.finished_t - e.submitted_t,
+                         ok=False, slo_eligible=False)
         e.future.set_exception(error)
 
     # -- cancellation -------------------------------------------------------------

@@ -384,8 +384,11 @@ class Session:
 
     def _trace_scope(self, conf):
         """The per-query observability scope: query-scoped QueryStats
-        (contextvars — concurrent queries never cross-account) plus, when
-        ``sql.trace.enabled``, an active QueryTrace for the span tree."""
+        (contextvars — concurrent queries never cross-account) plus an
+        active QueryTrace for the span tree when ``sql.trace.enabled``
+        OR the flight recorder is armed (``recorder.enabled``, default
+        on — the recorder decides at COMPLETION whether the trace is
+        worth retaining; see utils/recorder.py)."""
         from ..service import cancel
         from ..utils import tracing
         with Session._lock:
@@ -396,7 +399,8 @@ class Session:
             label = f"{label}[{ctl.label}]"
         return tracing.query_trace(
             label,
-            enabled=conf["spark.rapids.tpu.sql.trace.enabled"],
+            enabled=(conf["spark.rapids.tpu.sql.trace.enabled"]
+                     or conf["spark.rapids.tpu.recorder.enabled"]),
             max_events=conf["spark.rapids.tpu.sql.trace.maxEvents"])
 
     def _note_scheduler(self, tr) -> None:
@@ -476,14 +480,20 @@ class Session:
         self._last_trace = tr
         conf = ctx.conf
         trace_dir = conf["spark.rapids.tpu.sql.trace.dir"]
-        if trace_dir:
+        if trace_dir and conf["spark.rapids.tpu.sql.trace.enabled"]:
+            # the every-query dump stays opt-in via sql.trace.enabled;
+            # the recorder (below) dumps only what retention keeps
             import os
             os.makedirs(trace_dir, exist_ok=True)
             tr.write(os.path.join(trace_dir, f"{tr.label}.trace.json"))
+        from ..utils import recorder
+        recorder.offer(tr, conf)
 
     def last_trace(self):
-        """The QueryTrace of the most recent traced execution (None until
-        a query runs with spark.rapids.tpu.sql.trace.enabled=true)."""
+        """The QueryTrace of the most recent traced execution (None
+        until a query runs with sql.trace.enabled=true or the flight
+        recorder armed — recorder.enabled defaults true, so ordinarily
+        every query's trace lands here)."""
         return getattr(self, "_last_trace", None)
 
     def profiled_explain(self) -> str:

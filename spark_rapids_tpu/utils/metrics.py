@@ -259,6 +259,15 @@ class QueryStats:
                 ctl = _cancel.current()
                 if ctl is not None:
                     ctl.note_progress()
+                # feed the compile ledger: per-statement-fingerprint
+                # count/duration with trigger classification (first-seen
+                # vs shape-change vs post-restart vs cache-evict) — the
+                # traffic×compile profile behind precompile priority
+                from . import recorder as _recorder
+                _recorder.compile_note(
+                    duration,
+                    getattr(ctl, "fingerprint", None)
+                    if ctl is not None else None)
 
         jax.monitoring.register_event_duration_secs_listener(on_duration)
 

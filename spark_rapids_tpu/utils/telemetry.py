@@ -51,6 +51,7 @@ __all__ = ["METRICS", "count", "gauge_set", "observe", "configure",
            "enabled", "snapshot", "render_prometheus", "catalog_md",
            "wire_delta", "merge_rank", "fleet", "set_fleet",
            "fold_query_stats", "slo_observe", "slo_snapshot",
+           "slo_latency_s",
            "register_provider", "reset_for_tests", "HIST_BOUNDS"]
 
 # ---------------------------------------------------------------------------------
@@ -110,8 +111,8 @@ METRICS = (
      "ERROR frames sent, by protocol.ERROR_CODES code — reconciles "
      "exactly with client-observed typed errors."),
     ("ops_scrapes_total", "counter", "endpoint",
-     "Ops-surface reads served (/metrics, /healthz, /snapshot, and "
-     "the OPS wire op)."),
+     "Ops-surface reads served (/metrics, /healthz, /snapshot, "
+     "/debug/slow, and the OPS wire op)."),
     # -- DCN / fleet ---------------------------------------------------------------
     ("dcn_epoch", "gauge", "",
      "This rank's view of the cluster membership epoch."),
@@ -220,6 +221,35 @@ METRICS = (
      "Prepared-statement plan-cache hits."),
     ("prepared_misses_total", "counter", "",
      "Prepared-statement plan-cache misses."),
+    # -- performance flight recorder (utils/recorder.py) ---------------------------
+    ("recorder_captures_total", "counter", "reason",
+     "Query traces the flight recorder retained, by retention reason "
+     "(slo / outcome / first_seen / top_k)."),
+    ("recorder_dropped_total", "counter", "reason",
+     "Query traces the flight recorder let go: the boring median "
+     "(reason=boring) and ring evictions past maxQueries/maxBytes "
+     "(reason=evicted)."),
+    ("recorder_missed_total", "counter", "",
+     "SLO-violating queries that resolved with NO trace to retain — "
+     "should stay 0; tools/loadgen.py audits it against "
+     "slo_bad_total."),
+    ("recorder_queries", "gauge", "",
+     "Traces currently held in the flight-recorder ring."),
+    ("recorder_bytes", "gauge", "",
+     "Approximate bytes held by the flight-recorder ring (the "
+     "recorder.maxBytes bound is on this estimate)."),
+    ("compiles_by_trigger_total", "counter", "trigger",
+     "Backend compiles classified by the compile ledger's trigger "
+     "taxonomy (first_seen / shape_change / post_restart / "
+     "cache_evict, plus unattributed for session-direct compiles "
+     "with no statement fingerprint)."),
+    ("compile_storm_active", "gauge", "",
+     "1 while the recompile-storm detector is tripped (recompiles in "
+     "the trailing window above the storm threshold), else 0."),
+    ("perf_anomalies_total", "counter", "term",
+     "Root-cause verdicts issued at capture seal, by dominant "
+     "anomalous wait term (queue_wait / compile / h2d / dispatch / "
+     "fetch_wait / shuffle / spill / stream_spool)."),
 )
 
 # QueryStats field -> registered counter: the ONE fold-in choke point.
@@ -521,6 +551,13 @@ def slo_observe(tenant: str, latency_s: float, ok: bool) -> None:
 
 def slo_snapshot() -> Dict[str, object]:
     return _REG._slo.snapshot()
+
+
+def slo_latency_s() -> float:
+    """The configured SLO latency threshold in seconds — exposed so the
+    flight recorder's capture decision uses EXACTLY the verdict
+    ``slo_observe`` applies (the two ledgers must reconcile)."""
+    return _REG._slo.latency_s
 
 
 # ---------------------------------------------------------------------------------

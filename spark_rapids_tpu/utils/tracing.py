@@ -53,6 +53,30 @@ _ACTIVE: "contextvars.ContextVar[Optional[QueryTrace]]" = \
 
 DEFAULT_MAX_EVENTS = 100_000
 
+# ---------------------------------------------------------------------------------
+# Governed mark vocabulary.  Marks in the ``perf:`` / ``compile:``
+# namespaces are DISPATCH TARGETS: tools/explain_slow.py, trace_report
+# --why, and srtop key behavior off these exact names, so they get the
+# telemetry.METRICS treatment — declared once in a pure literal, held
+# two-way by srtlint's metrics-registry pass (an unregistered governed
+# name at an emit site and a registered name nobody emits are both
+# findings).  Other mark namespaces (breaker:, query:, trace:, ...)
+# stay free-form; only the prefixes below are governed.
+# ---------------------------------------------------------------------------------
+
+MARK_PREFIXES = ("perf:", "compile:")
+
+MARKS = (
+    ("compile:storm",
+     "Recompile-storm detector tripped: non-first-seen compiles in the "
+     "trailing window crossed the storm threshold (utils/recorder.py "
+     "CompileLedger; compile_storm_active gauge mirrors it)."),
+    ("perf:anomaly",
+     "Root-cause verdict sealed onto a captured query: the named wait "
+     "term ran anomalously over its fingerprint's EWMA baseline "
+     "(utils/recorder.py; perf_anomalies_total{term} mirrors it)."),
+)
+
 
 class _NullSpan:
     """No-op span: the tracing-off fast path allocates nothing."""

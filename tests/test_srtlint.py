@@ -877,6 +877,90 @@ class TestMetricsRegistry:
             telemetry.count("never_registered_total")
 
 
+_MARKS_FIXTURE = {
+    "utils/telemetry.py": (
+        "METRICS = (\n"
+        '    ("hits_total", "counter", "", "hits"),\n'
+        ")\n"
+        "_QS_FOLD = ()\n"
+        "def count(name, amount=1, **labels):\n"
+        "    pass\n"),
+    "utils/tracing.py": (
+        'MARK_PREFIXES = ("perf:", "compile:")\n'
+        "MARKS = (\n"
+        '    ("perf:anomaly", "root-cause verdict"),\n'
+        '    ("compile:storm", "storm detector"),\n'
+        '    ("compile:dead", "nobody emits this"),\n'
+        ")\n"
+        "def mark(op_id, name, cat='mark', **args):\n"
+        "    pass\n"
+        "def record(op_id, name, cat, t0, dur, **args):\n"
+        "    pass\n"),
+    "utils/user.py": (
+        "from . import telemetry, tracing\n"
+        "def f(tr):\n"
+        "    telemetry.count('hits_total')\n"
+        "    tracing.mark(None, 'perf:anomaly', 'mark')\n"
+        "    tr.add_event(None, 'compile:storm', 'compile', 0.0, 0.0)\n"
+        "    tr.add_event(None, 'perf:bogus', 'mark', 0.0, 0.0)\n"
+        "    tracing.mark(None, 'query:free_form')\n"),
+}
+
+
+class TestMarkVocabulary:
+    """The metrics-registry pass's governed trace-mark leg (the
+    flight recorder's ``perf:`` / ``compile:`` namespaces)."""
+
+    def test_two_way_mark_vocabulary(self, tmp_path):
+        report = _lint(tmp_path, _MARKS_FIXTURE, ["metrics-registry"])
+        msgs = sorted(f.message for f in report.failing)
+        # a governed-prefix mark minted at an emit site (add_event
+        # form) without a MARKS entry
+        assert any("'perf:bogus' is emitted here but not registered"
+                   in m for m in msgs)
+        # a MARKS entry nobody emits
+        assert any("dead mark vocabulary: 'compile:dead'" in m
+                   for m in msgs)
+        # registered marks emitted via tracing.mark AND .add_event
+        # both count as used; ungoverned namespaces stay free-form
+        assert not any("perf:anomaly" in m for m in msgs)
+        assert not any("compile:storm" in m for m in msgs)
+        assert not any("query:free_form" in m for m in msgs)
+        assert len(report.failing) == 2, msgs
+
+    def test_registration_and_suppression(self, tmp_path):
+        files = dict(_MARKS_FIXTURE)
+        files["utils/tracing.py"] = files["utils/tracing.py"].replace(
+            '    ("compile:dead", "nobody emits this"),\n', "")
+        files["utils/user.py"] = files["utils/user.py"].replace(
+            "    tr.add_event(None, 'perf:bogus', 'mark', 0.0, 0.0)\n",
+            "    tr.add_event(None, 'perf:bogus', 'mark', 0.0, 0.0)"
+            "  # srtlint: ignore[metrics-registry] (prototyped mark "
+            "for an out-of-tree consumer)\n")
+        report = _lint(tmp_path, files, ["metrics-registry"])
+        assert report.failing == [], [f.message for f in report.failing]
+        assert any("perf:bogus" in f.message
+                   for f in report.suppressed)
+
+    def test_fixture_trees_without_tracing_stay_exempt(self, tmp_path):
+        """A tree with no utils/tracing.py (older trees, other lint
+        fixtures) gets no mark findings at all."""
+        files = {k: v for k, v in _MARKS_FIXTURE.items()
+                 if k != "utils/tracing.py"}
+        report = _lint(tmp_path, files, ["metrics-registry"])
+        assert report.failing == [], [f.message for f in report.failing]
+
+    def test_real_mark_vocabulary(self):
+        """The canonical MARKS table governs exactly the recorder's
+        namespaces, and every entry is under a governed prefix."""
+        from spark_rapids_tpu.utils import tracing
+        names = {m[0] for m in tracing.MARKS}
+        assert "perf:anomaly" in names
+        assert "compile:storm" in names
+        for name in names:
+            assert name.startswith(tracing.MARK_PREFIXES), name
+
+
 class TestBaselineDrift:
     def test_rewrap_keeps_baseline_entry(self, tmp_path):
         """A pure reformat (re-indent + re-wrap across lines) of a

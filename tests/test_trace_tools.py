@@ -231,3 +231,301 @@ def test_span_timing_lint_clean_and_detects(tmp_path):
     assert "no reason" in [f for f in report.failing
                            if f.line == 4][0].message
     assert [f.line for f in report.suppressed] == [3]
+
+
+# ---------------------------------------------------------------------------------
+# explain_slow + trace_report --why
+# ---------------------------------------------------------------------------------
+
+from spark_rapids_tpu.utils import recorder, telemetry  # noqa: E402
+from tools import explain_slow, perfwatch  # noqa: E402
+
+
+@pytest.fixture()
+def fresh_recorder():
+    recorder.reset_for_tests()
+    telemetry.reset_for_tests()
+    yield recorder.recorder()
+    recorder.reset_for_tests()
+    telemetry.reset_for_tests()
+
+
+def _sealed_capture(rec, tmp_path, term="compile", excess=1.5):
+    """A recorder-retained capture whose verdict names ``term``."""
+    from spark_rapids_tpu.utils.tracing import QueryTrace
+    rec.configure({
+        "spark.rapids.tpu.recorder.enabled": True,
+        "spark.rapids.tpu.recorder.maxQueries": 48,
+        "spark.rapids.tpu.recorder.maxBytes": 32 << 20,
+        "spark.rapids.tpu.sql.trace.dir": str(tmp_path),
+    })
+
+    def seal(wall, attrs):
+        tr = QueryTrace(f"q[{term}]")
+        tr.attrs.update(attrs)
+        tr.t_end = tr.t0 + wall
+        tr.status = "ok"
+        rec.seal(tr, None, 0.01, True, False)
+
+    for _ in range(3):
+        seal(0.05, {f"{term}_s" if term != "h2d"
+                    else "h2d_wait_s": 0.005})
+    seal(2.0, {f"{term}_s" if term != "h2d"
+               else "h2d_wait_s": excess})
+    cap = rec.captures()[-1]
+    assert cap.verdict == term
+    return cap
+
+
+class TestExplainSlow:
+    def test_sealed_capture_is_authoritative(self, fresh_recorder,
+                                             tmp_path):
+        cap = _sealed_capture(fresh_recorder, tmp_path)
+        res = explain_slow.analyze_path(cap.path)
+        assert res["sealed"] is True
+        assert res["verdict"] == "compile"
+        assert res["capture_reason"] == "top_k"
+        assert res["excess_s"] == pytest.approx(1.5, abs=0.1)
+        out = explain_slow.format_why(res)
+        assert "<-- dominant" in out
+        assert "verdict: compile" in out
+        assert "EWMA baseline" in out
+
+    def test_unsealed_trace_recomputes_without_verdict(self, sess,
+                                                       tmp_path):
+        # a trace dumped with the recorder off predates the seal:
+        # terms are recomputed offline, no baseline verdict is invented
+        sess.conf.set("spark.rapids.tpu.recorder.enabled", False)
+        try:
+            path = _trace_file(sess, tmp_path)
+        finally:
+            sess.conf.unset("spark.rapids.tpu.recorder.enabled")
+        res = explain_slow.analyze_path(path)
+        assert res["sealed"] is False
+        assert res["verdict"] is None
+        assert res["terms"]["dispatch"] > 0
+        out = explain_slow.format_why(res)
+        assert "n/a" in out and "recomputed" in out
+
+    def test_main_json_and_exit_codes(self, fresh_recorder, tmp_path,
+                                      capsys):
+        cap = _sealed_capture(fresh_recorder, tmp_path,
+                              term="fetch_wait")
+        assert explain_slow.main([cap.path, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out.strip())
+        assert doc["verdict"] == "fetch_wait"
+        bad = tmp_path / "nope.json"
+        bad.write_text("{")
+        assert explain_slow.main([str(bad)]) == 2
+
+    def test_trace_report_why_section(self, fresh_recorder, tmp_path,
+                                      capsys):
+        cap = _sealed_capture(fresh_recorder, tmp_path,
+                              term="queue_wait")
+        assert trace_report.main([cap.path, "--why"]) == 0
+        out = capsys.readouterr().out
+        assert "why (root-cause attribution):" in out
+        assert "verdict: queue_wait" in out
+
+    def test_trace_report_why_on_plain_trace(self, sess, tmp_path,
+                                             capsys):
+        path = _trace_file(sess, tmp_path)
+        assert trace_report.main([path, "--why"]) == 0
+        out = capsys.readouterr().out
+        assert "hot operators" in out  # the timing report still leads
+        assert "why (root-cause attribution):" in out
+
+
+# ---------------------------------------------------------------------------------
+# bench_compare compile gate
+# ---------------------------------------------------------------------------------
+
+class TestCompileGate:
+    def test_warm_recompile_is_a_regression(self, tmp_path, capsys):
+        old = _write(tmp_path, "old.json", _bench(
+            4.0, q1={"engine_s": 1.0, "compiles_warm": 0}))
+        new = _write(tmp_path, "new.json", _bench(
+            4.0, q1={"engine_s": 1.0, "compiles_warm": 2}))
+        assert bench_compare.main([old, new]) == 1
+        err = capsys.readouterr().err
+        assert "compiles_warm 0 -> 2" in err
+        # an explicit allowance admits it
+        assert bench_compare.main(
+            [old, new, "--max-compile-increase", "2"]) == 0
+
+    def test_compile_improvement_is_a_note(self, tmp_path, capsys):
+        old = _write(tmp_path, "old.json", _bench(
+            4.0, q1={"engine_s": 1.0, "compiles_warm": 3}))
+        new = _write(tmp_path, "new.json", _bench(
+            4.0, q1={"engine_s": 1.0, "compiles_warm": 0}))
+        assert bench_compare.main([old, new]) == 0
+        assert "improved" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------------
+# perfwatch: the append-only regression sentinel
+# ---------------------------------------------------------------------------------
+
+class TestPerfwatch:
+    def _ledger(self, tmp_path):
+        return str(tmp_path / "perf.jsonl")
+
+    def test_bench_record_then_clean_check(self, tmp_path, capsys):
+        led = self._ledger(tmp_path)
+        base = _write(tmp_path, "b0.json", _bench(
+            4.0, q1={"engine_s": 1.0, "syncs_warm": 2,
+                     "compiles_warm": 0}))
+        assert perfwatch.main(["record", led, base]) == 0
+        run = _write(tmp_path, "b1.json", _bench(
+            4.05, q1={"engine_s": 1.02, "syncs_warm": 2,
+                      "compiles_warm": 0}))
+        assert perfwatch.main(["check", led, run]) == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_bench_compile_and_sync_regressions_gate(self, tmp_path,
+                                                     capsys):
+        led = self._ledger(tmp_path)
+        base = _write(tmp_path, "b0.json", _bench(
+            4.0, q1={"engine_s": 1.0, "syncs_warm": 2,
+                     "compiles_warm": 0}))
+        assert perfwatch.main(["record", led, base]) == 0
+        run = _write(tmp_path, "b1.json", _bench(
+            4.0, q1={"engine_s": 1.0, "syncs_warm": 3,
+                     "compiles_warm": 1}))
+        assert perfwatch.main(["check", led, run]) == 1
+        err = capsys.readouterr().err
+        assert "compiles_warm 0 -> 1" in err
+        assert "syncs_warm 2 -> 3" in err
+        # the tolerances admit the same run
+        assert perfwatch.main(
+            ["check", led, run, "--max-sync-increase", "1",
+             "--max-compile-increase", "1"]) == 0
+
+    def _loadgen_report(self, tmp_path, name, p95, slo=0):
+        return _write(tmp_path, name, {
+            "loadgen": 1, "p50_ms": 10.0, "p95_ms": p95,
+            "p99_ms": p95 * 1.4, "throughput_qps": 50.0,
+            "typed_errors": 0, "mismatches": 0,
+            "slo_violations": slo, "queries_completed": 100})
+
+    def test_loadgen_latency_and_slo_gates(self, tmp_path, capsys):
+        led = self._ledger(tmp_path)
+        base = self._loadgen_report(tmp_path, "l0.json", p95=20.0)
+        assert perfwatch.main(["record", led, base]) == 0
+        ok = self._loadgen_report(tmp_path, "l1.json", p95=22.0)
+        assert perfwatch.main(["check", led, ok]) == 0
+        slow = self._loadgen_report(tmp_path, "l2.json", p95=40.0)
+        assert perfwatch.main(["check", led, slow]) == 1
+        assert "p95_ms" in capsys.readouterr().err
+        burned = self._loadgen_report(tmp_path, "l3.json", p95=20.0,
+                                      slo=3)
+        assert perfwatch.main(["check", led, burned]) == 1
+        assert "slo_violations 0 -> 3" in capsys.readouterr().err
+
+    def test_check_record_appends_and_baseline_modes(self, tmp_path,
+                                                     capsys):
+        led = self._ledger(tmp_path)
+        run = _write(tmp_path, "b.json", _bench(
+            4.0, q1={"engine_s": 1.0}))
+        # first check of a stream: no baseline, still exit 0
+        assert perfwatch.main(["check", led, run, "--record"]) == 0
+        assert "no baseline" in capsys.readouterr().out
+        assert len(perfwatch.read_ledger(led)) == 1
+        for mode in ("last", "best", "median"):
+            assert perfwatch.main(
+                ["check", led, run, "--baseline", mode]) == 0
+        assert perfwatch.main(["show", led]) == 0
+        assert "1 run(s)" in capsys.readouterr().out
+
+    def test_labels_partition_streams(self, tmp_path, capsys):
+        led = self._ledger(tmp_path)
+        a = _write(tmp_path, "a.json", _bench(4.0, q1={"engine_s": 1.0}))
+        assert perfwatch.main(["record", led, a, "--label", "tpch"]) == 0
+        slow = _write(tmp_path, "s.json", _bench(
+            4.0, q1={"engine_s": 9.0}))
+        # a different label never gates against the tpch stream
+        assert perfwatch.main(
+            ["check", led, slow, "--label", "tpcds"]) == 0
+        assert perfwatch.main(
+            ["check", led, slow, "--label", "tpch"]) == 1
+        capsys.readouterr()
+
+    def test_usage_and_parse_errors(self, tmp_path, capsys):
+        led = self._ledger(tmp_path)
+        assert perfwatch.main(["check", led]) == 2
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        assert perfwatch.main(["record", led, str(bad)]) == 2
+        capsys.readouterr()
+        # a torn ledger line is skipped, not fatal
+        run = _write(tmp_path, "ok.json", _bench(
+            4.0, q1={"engine_s": 1.0}))
+        assert perfwatch.main(["record", led, run]) == 0
+        with open(led, "a") as f:
+            f.write("{torn json\n")
+        assert perfwatch.main(["check", led, run]) == 0
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------------
+# /debug/slow + srtop slow-queries panel
+# ---------------------------------------------------------------------------------
+
+class TestDebugSlowSurfaces:
+    def test_render_debug_slow_lists_captures_and_ledger(
+            self, fresh_recorder, tmp_path):
+        from spark_rapids_tpu.server.ops import render_debug_slow
+        cap = _sealed_capture(fresh_recorder, tmp_path)
+        recorder.compile_note(0.2, "stmt:hot")
+        page = render_debug_slow()
+        assert "flight recorder:" in page
+        assert cap.capture_id in page
+        assert "compile" in page  # the verdict column
+        assert "compile ledger:" in page
+        assert "stmt:hot" in page
+        assert "first_seen=1" in page
+
+    def test_http_route_and_snapshot_section(self, sess,
+                                             fresh_recorder, tmp_path):
+        import urllib.request
+
+        from spark_rapids_tpu.server import SqlFrontDoor
+        cap = _sealed_capture(fresh_recorder, tmp_path)
+        door = SqlFrontDoor(sess).start()
+        try:
+            base = f"http://127.0.0.1:{door.ops_port}"
+            with urllib.request.urlopen(base + "/debug/slow",
+                                        timeout=5) as r:
+                assert r.status == 200
+                body = r.read().decode()
+            assert cap.capture_id in body
+            with urllib.request.urlopen(base + "/snapshot",
+                                        timeout=5) as r:
+                snap = json.loads(r.read().decode())
+            rec = snap["recorder"]
+            assert rec["queries"] >= 1
+            assert rec["captures"][0]["capture_id"] == cap.capture_id
+            assert "compile_ledger" in rec
+        finally:
+            door.close()
+
+    def test_srtop_slow_queries_panel(self, sess, fresh_recorder,
+                                      tmp_path, capsys):
+        from spark_rapids_tpu.server import SqlFrontDoor
+
+        import tools.srtop as srtop
+        cap = _sealed_capture(fresh_recorder, tmp_path)
+        door = SqlFrontDoor(sess).start()
+        try:
+            rc = srtop.main(["--url",
+                             f"http://127.0.0.1:{door.ops_port}",
+                             "--once"])
+        finally:
+            door.close()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "recorder:" in out
+        assert "slow queries (fingerprint / wall / why / capture):" \
+            in out
+        assert cap.capture_id in out
+        assert "compile" in out

@@ -18,6 +18,7 @@ from spark_rapids_tpu.sql import functions as F
 
 TRACE_KEY = "spark.rapids.tpu.sql.trace.enabled"
 DIR_KEY = "spark.rapids.tpu.sql.trace.dir"
+RECORDER_KEY = "spark.rapids.tpu.recorder.enabled"
 
 
 @pytest.fixture()
@@ -26,6 +27,7 @@ def sess():
     yield s
     s.conf.unset(TRACE_KEY)
     s.conf.unset(DIR_KEY)
+    s.conf.unset(RECORDER_KEY)
 
 
 def _tpch_slice(sess, n=20000, seed=11):
@@ -141,7 +143,11 @@ def test_trace_dir_writes_one_file_per_query(sess, tmp_path):
     finally:
         sess.conf.unset(TRACE_KEY)
         sess.conf.unset(DIR_KEY)
-    files = sorted(tmp_path.glob("*.trace.json"))
+    # the every-query dump writes query-*.trace.json; the flight
+    # recorder dumps what retention keeps as capture-*.trace.json
+    # into the same dir (tested in test_recorder.py)
+    files = sorted(p for p in tmp_path.glob("*.trace.json")
+                   if not p.name.startswith("capture-"))
     assert len(files) == 2
     for f in files:
         data = json.loads(f.read_text())
@@ -193,6 +199,9 @@ def test_profiled_explain_without_query(fresh_session):
 
 def test_tracing_off_stays_on_fast_path(fresh_session):
     from spark_rapids_tpu.utils import tracing
+    # the flight recorder (default on) arms tracing for every query;
+    # this test is about the FULLY-off fast path, so disarm it too
+    fresh_session.conf.set(RECORDER_KEY, False)
     q = _tpch_slice(fresh_session)
     assert tracing.active() is None
     q.collect()
@@ -206,6 +215,9 @@ def test_tracing_off_stays_on_fast_path(fresh_session):
 
 
 def test_trace_scope_does_not_leak_across_queries(sess):
+    # disarm the recorder: with it on, every query is traced (by
+    # design) and last_trace legitimately moves on
+    sess.conf.set(RECORDER_KEY, False)
     tr1 = _run_traced(sess, _tpch_slice(sess))
     # an untraced query afterwards must not disturb the captured trace
     _tpch_slice(sess, seed=13).collect()

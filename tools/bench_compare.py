@@ -13,7 +13,7 @@ Accepted file shapes (auto-detected):
 Usage:
   python tools/bench_compare.py OLD.json NEW.json \
       [--max-query-regress-pct 20] [--max-agg-regress-pct 5] \
-      [--max-sync-increase 0]
+      [--max-sync-increase 0] [--max-compile-increase 0]
 
 Exit codes: 0 = no regression, 1 = regression found, 2 = usage/parse
 error.  A query that completed in OLD but errored/vanished in NEW is a
@@ -74,9 +74,18 @@ def query_syncs(agg: dict) -> Dict[str, Optional[float]]:
     return out
 
 
+def query_compiles(agg: dict) -> Dict[str, Optional[float]]:
+    """{query: warm compile count} where the aggregate has one."""
+    out: Dict[str, Optional[float]] = {}
+    for k, v in agg.items():
+        if isinstance(v, dict) and "compiles_warm" in v:
+            out[k] = float(v["compiles_warm"])
+    return out
+
+
 def compare(old: dict, new: dict, max_query_pct: float,
-            max_agg_pct: float, max_sync_increase: float = 0.0
-            ) -> Tuple[list, list]:
+            max_agg_pct: float, max_sync_increase: float = 0.0,
+            max_compile_increase: float = 0.0) -> Tuple[list, list]:
     """Return (regressions, notes) as printable strings."""
     regressions, notes = [], []
     old_q, new_q = query_times(old), query_times(new)
@@ -94,6 +103,21 @@ def compare(old: dict, new: dict, max_query_pct: float,
                 f"[> +{max_sync_increase:g} blocking fetches]")
         elif n < o:
             notes.append(f"{q}: syncs_warm {o:g} -> {n:g}  [improved]")
+
+    # compile-count guard (the compile ledger's CI teeth): a warm-path
+    # recompile costs whole seconds on a real TPU even when the CPU
+    # test mesh hides it in wall-clock noise, so a warm compile-count
+    # increase beyond the tolerance is a regression in its own right
+    old_c, new_c = query_compiles(old), query_compiles(new)
+    for q in sorted(set(old_c) & set(new_c)):
+        o, n = old_c[q], new_c[q]
+        if n > o + max_compile_increase:
+            regressions.append(
+                f"{q}: compiles_warm {o:g} -> {n:g}  "
+                f"[> +{max_compile_increase:g} warm compiles]")
+        elif n < o:
+            notes.append(
+                f"{q}: compiles_warm {o:g} -> {n:g}  [improved]")
 
     old_v = float(old.get("value") or 0.0)
     new_v = float(new.get("value") or 0.0)
@@ -143,6 +167,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-sync-increase", type=float, default=0.0,
                    help="per-query warm blocking-sync count increase "
                         "tolerated (absolute fetches; default 0)")
+    p.add_argument("--max-compile-increase", type=float, default=0.0,
+                   help="per-query warm compile count increase "
+                        "tolerated (absolute compiles; default 0)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="print regressions only")
     args = p.parse_args(argv)
@@ -154,7 +181,8 @@ def main(argv=None) -> int:
         return 2
     regressions, notes = compare(old, new, args.max_query_regress_pct,
                                  args.max_agg_regress_pct,
-                                 args.max_sync_increase)
+                                 args.max_sync_increase,
+                                 args.max_compile_increase)
     if not args.quiet:
         for line in notes:
             print("  " + line)

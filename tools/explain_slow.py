@@ -1,0 +1,161 @@
+"""Root-cause attribution for captured query traces: WHY was it slow.
+
+Decomposes a dumped query trace (``sql.trace.dir`` dumps, flight
+recorder ``capture-*.trace.json`` files, or any Chrome-trace export
+from utils/tracing.py) into the canonical wait terms the recorder
+judges — queue/admission wait, compile, H2D staging, dispatch,
+fetch wait, shuffle, spill, stream/spool — compares each against the
+statement fingerprint's EWMA baseline, and names the dominant
+anomalous term.
+
+Traces sealed by the flight recorder carry the verdict already
+(``perf_terms`` / ``perf_baseline`` / ``perf_verdict`` root attrs
+stamped at seal time by utils/recorder.py); those are authoritative
+and reported as-is.  Older or foreign traces are decomposed here with
+the same code (recorder.decompose_chrome), reported without a baseline
+verdict when no baseline is stamped.
+
+Usage:
+  python tools/explain_slow.py TRACE.json [TRACE2.json ...] [--json]
+
+Exit codes: 0 = analyzed, 2 = no readable trace.  ``trace_report.py
+--why`` renders the same analysis inline after its timing report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spark_rapids_tpu.utils import recorder  # noqa: E402
+
+
+def _qargs(doc: dict) -> Dict[str, object]:
+    for e in doc.get("traceEvents", ()):
+        if e.get("ph") == "X" and e.get("cat") == "query":
+            return dict(e.get("args") or {})
+    return {}
+
+
+def analyze_doc(doc: dict) -> dict:
+    """One trace document -> the attribution record.
+
+    Returns {label, status, wall_s, fingerprint, terms, baseline,
+    verdict, excess_s, sealed} where ``sealed`` says whether the
+    verdict came stamped from the recorder's seal (authoritative) or
+    was recomputed here."""
+    other = doc.get("otherData") or {}
+    qargs = _qargs(doc)
+    sealed = isinstance(qargs.get("perf_terms"), dict)
+    if sealed:
+        terms = {k: float(v)
+                 for k, v in qargs["perf_terms"].items()}
+        baseline = {k: float(v)
+                    for k, v in (qargs.get("perf_baseline")
+                                 or {}).items()}
+        verdict: Optional[str] = qargs.get("perf_verdict") or None
+    else:
+        terms = recorder.decompose_chrome(doc)
+        baseline = {}
+        verdict = None
+    excess = (terms.get(verdict, 0.0) - baseline.get(verdict, 0.0)
+              if verdict else 0.0)
+    wall = float(other.get("wall_s")
+                 or sum(terms.values()) or 0.0)
+    return {
+        "label": other.get("label", "?"),
+        "trace_id": other.get("trace_id", ""),
+        "status": other.get("status", qargs.get("status", "?")),
+        "wall_s": wall,
+        "fingerprint": str(qargs.get("fingerprint", "")),
+        "capture_reason": qargs.get("capture_reason", ""),
+        "terms": terms,
+        "baseline": baseline,
+        "verdict": verdict,
+        "excess_s": round(float(excess), 6),
+        "sealed": sealed,
+    }
+
+
+def analyze_path(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    out = analyze_doc(doc)
+    out["path"] = path
+    return out
+
+
+def format_why(res: dict) -> str:
+    """Human rendering of one attribution record."""
+    lines: List[str] = []
+    head = (f"{res['label']}  status={res['status']}  "
+            f"wall={res['wall_s'] * 1e3:.1f}ms")
+    if res.get("fingerprint"):
+        head += f"  fingerprint={res['fingerprint'][:16]}"
+    if res.get("capture_reason"):
+        head += f"  captured={res['capture_reason']}"
+    lines.append(head)
+    lines.append(f"  {'TERM':<14s} {'ACTUAL':>10s} {'BASELINE':>10s} "
+                 f"{'EXCESS':>10s}")
+    baseline = res["baseline"]
+    for term in recorder.TERMS:
+        v = res["terms"].get(term, 0.0)
+        if v <= 0.0 and term not in baseline:
+            continue
+        b = baseline.get(term)
+        ex = v - b if b is not None else None
+        lines.append(
+            f"  {term:<14s} {v * 1e3:>8.1f}ms "
+            + (f"{b * 1e3:>8.1f}ms " if b is not None
+               else f"{'-':>10s} ")
+            + (f"{ex * 1e3:>+8.1f}ms" if ex is not None
+               else f"{'-':>10s}")
+            + ("   <-- dominant" if term == res["verdict"] else ""))
+    if res["verdict"]:
+        lines.append(
+            f"  verdict: {res['verdict']} "
+            f"(+{res['excess_s'] * 1e3:.1f}ms over the fingerprint's "
+            f"EWMA baseline)")
+    elif res["sealed"]:
+        lines.append("  verdict: none — every term within its "
+                     "baseline envelope (or baseline too young)")
+    else:
+        lines.append("  verdict: n/a — trace predates the recorder "
+                     "seal; terms recomputed without a baseline")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="root-cause attribution for captured query traces")
+    p.add_argument("traces", nargs="+",
+                   help="trace JSON files (sql.trace.dir dumps or "
+                        "flight-recorder capture-*.trace.json)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (one JSON object per "
+                        "trace)")
+    args = p.parse_args(argv)
+    results = []
+    for path in args.traces:
+        try:
+            results.append(analyze_path(path))
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(f"explain_slow: {path}: {e}", file=sys.stderr)
+    if not results:
+        return 2
+    if args.json:
+        for res in results:
+            print(json.dumps(res, sort_keys=True))
+    else:
+        print("\n\n".join(format_why(res) for res in results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

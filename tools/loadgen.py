@@ -269,6 +269,35 @@ def reconcile_telemetry(tm0: dict, tm1: dict, ctr: Counters,
             "shed_taxonomy": cli_sheds}
 
 
+def reconcile_recorder(tm0: dict, tm2: dict) -> dict:
+    """The flight-recorder differential: every SLO-violating query the
+    burn tracker counted must be retained by the recorder as a
+    ``reason=slo`` capture — EXACT as deltas over the run, because the
+    scheduler feeds both sides (``slo_observe`` and ``recorder.outcome``)
+    the very same latency/ok verdict.  An explicit ``missed`` count
+    keeps the equation closed but is itself a failure on a clean run:
+    it means an SLO-bad query resolved with no retained trace."""
+    def delta(metric: str) -> int:
+        return int(_tm_sum(tm2, metric) - _tm_sum(tm0, metric))
+
+    def delta_lbl(metric: str, key: str) -> int:
+        a, b = _tm_by_label(tm0, metric), _tm_by_label(tm2, metric)
+        return int(b.get(key, 0) - a.get(key, 0))
+
+    viol = delta("slo_bad_total")
+    caps = delta_lbl("recorder_captures_total", "slo")
+    missed = delta("recorder_missed_total")
+    mismatches: List[str] = []
+    if viol != caps + missed:
+        mismatches.append(f"recorder_slo: slo_bad={viol} "
+                          f"captures_slo={caps} missed={missed}")
+    if missed:
+        mismatches.append(f"recorder_missed: {missed} SLO-bad "
+                          f"resolution(s) without a retained trace")
+    return {"slo_violations_server": viol, "captures_slo": caps,
+            "missed": missed, "mismatches": mismatches}
+
+
 class _OpsScraper:
     """Mid-run scrape storm: polls /metrics and /snapshot on a loop
     while the workers drive load — the ops endpoint must stay
@@ -702,6 +731,21 @@ def run(args) -> dict:
         leaks.append(f"wire queries inflight={snap['queries_inflight']}")
     if door.quotas.inflight() != 0:
         leaks.append(f"tenant quota inflight={door.quotas.inflight()}")
+    # flight-recorder audit (post-drain, so every seal had both halves
+    # of its handshake): a half-open seal is a leak like any other, and
+    # the SLO capture ledger must reconcile exactly with the burn
+    # tracker — server-internal counters, so it holds under chaos too
+    from spark_rapids_tpu.utils import recorder as _recorder
+    if _recorder.pending_seals():
+        leaks.append(f"recorder seals pending="
+                     f"{_recorder.pending_seals()}")
+    if tm0 is not None and door.ops_port is not None:
+        tm2 = scrape_snapshot(door.ops_port)["telemetry"]
+        rec_rep = reconcile_recorder(tm0, tm2)
+        telemetry_report["recorder"] = rec_rep
+        telemetry_report["mismatches"] = (
+            list(telemetry_report.get("mismatches") or [])
+            + rec_rep["mismatches"])
     door.close()
     try:
         get_catalog().assert_no_leaks()
@@ -1207,6 +1251,22 @@ def run_soak(args) -> dict:
         if door.quotas.inflight() != 0:
             leaks.append(f"final: door {i} quota inflight="
                          f"{door.quotas.inflight()}")
+    # flight-recorder audit across the whole soak (restarts, failover,
+    # quota churn included): every seal must have closed, and the SLO
+    # capture ledger must reconcile exactly with the burn tracker —
+    # the registry is process-global, so the delta spans all doors
+    from spark_rapids_tpu.utils import recorder as _recorder
+    if _recorder.pending_seals():
+        leaks.append(f"final: recorder seals pending="
+                     f"{_recorder.pending_seals()}")
+    if tm0 is not None:
+        live = next((d for d in doors if d.ops_port is not None), None)
+        if live is not None:
+            tm2 = scrape_snapshot(live.ops_port)["telemetry"]
+            rec_rep = reconcile_recorder(tm0, tm2)
+            telemetry_report["recorder"] = rec_rep
+            leaks.extend("recorder: " + m
+                         for m in rec_rep["mismatches"])
     for door in doors:
         door.drain(deadline_s=5.0, siblings=[])
     try:
@@ -1940,6 +2000,14 @@ def main(argv=None) -> int:
               f"retries={report['retries']}  "
               f"mismatches={report['mismatches']}  "
               f"leaks={report['leaks'] or 'none'}", file=sys.stderr)
+        rec = (report.get("telemetry") or {}).get("recorder") or {}
+        if rec:
+            print(f"[loadgen] recorder: server slo_bad="
+                  f"{rec['slo_violations_server']} "
+                  f"captures_slo={rec['captures_slo']} "
+                  f"missed={rec['missed']}  "
+                  f"reconciled={'yes' if not rec['mismatches'] else 'NO'}",
+                  file=sys.stderr)
         print_tenant_report(report["per_tenant"])
         return 0 if ok else 1
 
@@ -1960,6 +2028,14 @@ def main(argv=None) -> int:
               f"reconciled={tm.get('reconciled')}  "
               f"mismatches={tm.get('mismatches') or 'none'}",
               file=sys.stderr)
+        rec = tm.get("recorder") or {}
+        if rec:
+            print(f"[loadgen] recorder: server slo_bad="
+                  f"{rec['slo_violations_server']} "
+                  f"captures_slo={rec['captures_slo']} "
+                  f"missed={rec['missed']}  "
+                  f"reconciled={'yes' if not rec['mismatches'] else 'NO'}",
+                  file=sys.stderr)
     speedup = (report["fresh_p50_ms"] / report["prepared_p50_ms"]
                if report["prepared_p50_ms"] else 0.0)
     print(f"[loadgen] {report['queries_completed']} queries over "

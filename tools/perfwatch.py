@@ -1,0 +1,264 @@
+"""Perf regression sentinel: an append-only ledger of bench/loadgen
+runs with latency, sync-count, and compile-count gates.
+
+``bench_compare.py`` diffs exactly two aggregate files someone chose;
+this tool holds the LINE: every bench or loadgen run is recorded into
+a JSONL ledger, and ``check`` gates a new run against the ledger's
+baseline — exit non-zero on regression, so CI and the soak can refuse
+a warm-path recompile or a sync-count creep the same way BENCH_r04's
+thresholds refused a wall-clock one.
+
+Record shapes (auto-detected from the run file):
+  * a ``bench.py`` aggregate (or driver ``{"parsed"|"tail"}`` capture,
+    the shapes ``bench_compare.load_aggregate`` accepts): per-query
+    ``engine_s`` / ``syncs_warm`` / ``compiles_warm`` plus the
+    aggregate geomean land in the ledger entry;
+  * a ``loadgen.py`` report (``"loadgen": 1``): p50/p95/p99, qps,
+    typed errors, and SLO violations land in the ledger entry.
+
+Usage:
+  python tools/perfwatch.py record LEDGER.jsonl RUN.json [--label L]
+  python tools/perfwatch.py check  LEDGER.jsonl RUN.json [--label L]
+      [--baseline last|best|median]
+      [--max-query-regress-pct 20] [--max-agg-regress-pct 5]
+      [--max-sync-increase 0] [--max-compile-increase 0]
+      [--max-latency-regress-pct 25] [--record]
+  python tools/perfwatch.py show LEDGER.jsonl [--label L]
+
+``check --record`` appends the run after gating (pass or fail), so
+the ledger stays the full history.  Exit codes: 0 = no regression,
+1 = regression found, 2 = usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools import bench_compare  # noqa: E402
+
+
+# ---------------------------------------------------------------------------------
+# Ledger I/O (append-only JSONL)
+# ---------------------------------------------------------------------------------
+
+def read_ledger(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # a torn tail write must not poison history
+    return out
+
+
+def append_ledger(path: str, entry: dict) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+# ---------------------------------------------------------------------------------
+# Run-file normalization
+# ---------------------------------------------------------------------------------
+
+def load_run(path: str, label: str = "") -> dict:
+    """Normalize one run file into a ledger entry."""
+    with open(path) as f:
+        raw = json.load(f)
+    if isinstance(raw, dict) and raw.get("loadgen") == 1:
+        return {
+            "kind": "loadgen",
+            "label": label,
+            "t_wall": time.time(),
+            "source": path,
+            "p50_ms": float(raw.get("p50_ms", 0.0)),
+            "p95_ms": float(raw.get("p95_ms", 0.0)),
+            "p99_ms": float(raw.get("p99_ms", 0.0)),
+            "throughput_qps": float(raw.get("throughput_qps", 0.0)),
+            "typed_errors": int(raw.get("typed_errors", 0)),
+            "mismatches": int(raw.get("mismatches", 0)),
+            "slo_violations": int(raw.get("slo_violations", 0)),
+            "queries_completed": int(raw.get("queries_completed", 0)),
+        }
+    agg = bench_compare.load_aggregate(path)
+    return {
+        "kind": "bench",
+        "label": label,
+        "t_wall": time.time(),
+        "source": path,
+        "agg_value": float(agg.get("value") or 0.0),
+        "queries": {
+            q: {k: v for k, v in (
+                ("engine_s", bench_compare.query_times(agg).get(q)),
+                ("syncs_warm", bench_compare.query_syncs(agg).get(q)),
+                ("compiles_warm",
+                 bench_compare.query_compiles(agg).get(q)))
+                if v is not None}
+            for q in bench_compare.query_times(agg)},
+    }
+
+
+def _entry_aggregate(entry: dict) -> dict:
+    """Rebuild a bench_compare-shaped aggregate from a ledger entry so
+    the comparison logic (and its gates) is shared, not re-derived."""
+    agg: Dict[str, object] = {"metric": "perfwatch",
+                              "value": entry.get("agg_value", 0.0)}
+    for q, rec in (entry.get("queries") or {}).items():
+        agg[q] = dict(rec)
+    return agg
+
+
+# ---------------------------------------------------------------------------------
+# Baseline selection + gating
+# ---------------------------------------------------------------------------------
+
+def pick_baseline(history: List[dict], kind: str, label: str,
+                  mode: str) -> Optional[dict]:
+    cands = [e for e in history
+             if e.get("kind") == kind and e.get("label", "") == label]
+    if not cands:
+        return None
+    if mode == "last":
+        return cands[-1]
+    if kind == "loadgen":
+        key = lambda e: e.get("p95_ms", 0.0)  # noqa: E731
+    else:
+        key = lambda e: -e.get("agg_value", 0.0)  # noqa: E731
+    ranked = sorted(cands, key=key)
+    if mode == "best":
+        return ranked[0]
+    return ranked[len(ranked) // 2]  # median
+
+
+def gate(entry: dict, base: dict, args) -> List[str]:
+    """Return regression strings (empty = clean)."""
+    if entry["kind"] == "bench":
+        regressions, _notes = bench_compare.compare(
+            _entry_aggregate(base), _entry_aggregate(entry),
+            args.max_query_regress_pct, args.max_agg_regress_pct,
+            args.max_sync_increase, args.max_compile_increase)
+        return regressions
+    regressions = []
+    for pct_key in ("p95_ms", "p99_ms"):
+        o, n = base.get(pct_key, 0.0), entry.get(pct_key, 0.0)
+        if o > 0 and (n - o) / o * 100 > args.max_latency_regress_pct:
+            regressions.append(
+                f"{pct_key} {o:g} -> {n:g}  "
+                f"[> {args.max_latency_regress_pct:g}% slower]")
+    for count_key in ("typed_errors", "mismatches"):
+        if entry.get(count_key, 0) > base.get(count_key, 0):
+            regressions.append(
+                f"{count_key} {base.get(count_key, 0)} -> "
+                f"{entry.get(count_key, 0)}")
+    o, n = base.get("slo_violations", 0), entry.get("slo_violations", 0)
+    if n > o + args.max_slo_violation_increase:
+        regressions.append(
+            f"slo_violations {o} -> {n}  "
+            f"[> +{args.max_slo_violation_increase:g}]")
+    return regressions
+
+
+# ---------------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="append-only perf ledger with regression gates")
+    p.add_argument("command", choices=("record", "check", "show"))
+    p.add_argument("ledger")
+    p.add_argument("run", nargs="?",
+                   help="bench aggregate or loadgen report JSON")
+    p.add_argument("--label", default="",
+                   help="ledger stream label (compare like with like)")
+    p.add_argument("--baseline", default="median",
+                   choices=("last", "best", "median"))
+    p.add_argument("--max-query-regress-pct", type=float, default=20.0)
+    p.add_argument("--max-agg-regress-pct", type=float, default=5.0)
+    p.add_argument("--max-sync-increase", type=float, default=0.0)
+    p.add_argument("--max-compile-increase", type=float, default=0.0)
+    p.add_argument("--max-latency-regress-pct", type=float,
+                   default=25.0)
+    p.add_argument("--max-slo-violation-increase", type=float,
+                   default=0.0)
+    p.add_argument("--record", action="store_true",
+                   help="with check: append the run after gating")
+    args = p.parse_args(argv)
+
+    if args.command == "show":
+        history = read_ledger(args.ledger)
+        if args.label:
+            history = [e for e in history
+                       if e.get("label", "") == args.label]
+        for e in history:
+            if e.get("kind") == "loadgen":
+                print(f"loadgen {e.get('label', '')} "
+                      f"p95={e.get('p95_ms')}ms "
+                      f"qps={e.get('throughput_qps')} "
+                      f"slo_violations={e.get('slo_violations')} "
+                      f"({e.get('source', '')})")
+            else:
+                print(f"bench {e.get('label', '')} "
+                      f"geomean={e.get('agg_value')}x "
+                      f"queries={len(e.get('queries') or {})} "
+                      f"({e.get('source', '')})")
+        print(f"perfwatch: {len(history)} run(s) in {args.ledger}")
+        return 0
+
+    if not args.run:
+        print("perfwatch: record/check need a RUN file",
+              file=sys.stderr)
+        return 2
+    try:
+        entry = load_run(args.run, args.label)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perfwatch: {e}", file=sys.stderr)
+        return 2
+
+    if args.command == "record":
+        append_ledger(args.ledger, entry)
+        print(f"perfwatch: recorded {entry['kind']} run into "
+              f"{args.ledger}")
+        return 0
+
+    history = read_ledger(args.ledger)
+    base = pick_baseline(history, entry["kind"], args.label,
+                         args.baseline)
+    if args.record:
+        append_ledger(args.ledger, entry)
+    if base is None:
+        print("perfwatch: no baseline in the ledger yet — recorded "
+              "run accepted as the first of its stream"
+              if args.record else
+              "perfwatch: no baseline in the ledger yet (use record)")
+        return 0
+    regressions = gate(entry, base, args)
+    if regressions:
+        print(f"perfwatch: {len(regressions)} regression(s) vs "
+              f"{args.baseline} baseline ({base.get('source', '?')}):",
+              file=sys.stderr)
+        for line in regressions:
+            print("  REGRESSION " + line, file=sys.stderr)
+        return 1
+    print(f"perfwatch: OK vs {args.baseline} baseline "
+          f"({len(history)} run(s) in ledger)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

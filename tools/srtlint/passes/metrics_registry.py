@@ -11,8 +11,8 @@ PER_FILE = False
 # incremental scan scope: telemetry call sites can appear anywhere in
 # the package or the tooling
 SCOPE = ("spark_rapids_tpu/", "tools/")
-TITLE = ("every telemetry counter/gauge/histogram name is registered "
-         "in telemetry.METRICS, emitted somewhere, and literal")
+TITLE = ("every telemetry metric name — and every governed-prefix "
+         "trace mark — is registered, emitted somewhere, and literal")
 EXPLAIN = """
 The live metrics registry (utils/telemetry.py) is the fleet's scrape
 vocabulary: dashboards, alerts, and the loadgen reconciliation all
@@ -35,6 +35,16 @@ applied to metric names:
     site emits and no ``_QS_FOLD`` mapping targets is dead — retire
     it or wire up the emitter.
 
+The same two-way discipline covers the GOVERNED trace-mark
+vocabulary (utils/tracing.py ``MARKS`` / ``MARK_PREFIXES``): tools
+like explain_slow and srtop dispatch on mark names the way dashboards
+dispatch on metric names.  A literal mark name under a governed
+prefix (``perf:``, ``compile:``) emitted via ``tracing.mark`` /
+``tracing.record`` / ``.add_event(...)`` must appear in ``MARKS``
+(**unregistered-at-use**), and every ``MARKS`` entry must have an
+emitter (**dead vocabulary**).  Ungoverned namespaces (``query:``,
+``breaker:``, ...) stay free-form.
+
 Suppress with ``# srtlint: ignore[metrics-registry] (<why>)``.
 """
 
@@ -42,6 +52,13 @@ TEL_REL = "spark_rapids_tpu/utils/telemetry.py"
 _TEL_MOD = "spark_rapids_tpu.utils.telemetry"
 _API = ("count", "gauge_set", "observe")
 _API_QUALS = {f"{_TEL_MOD}.{fn}" for fn in _API}
+
+TRACING_REL = "spark_rapids_tpu/utils/tracing.py"
+_TRACING_MOD = "spark_rapids_tpu.utils.tracing"
+# emit forms whose SECOND positional argument is the mark/event name:
+# tracing.mark(op_id, name, ...), tracing.record(op_id, name, ...),
+# and any <trace>.add_event(op_id, name, ...) method call
+_MARK_QUALS = {f"{_TRACING_MOD}.mark", f"{_TRACING_MOD}.record"}
 
 
 def _str_elts(node: ast.AST) -> List[str]:
@@ -82,6 +99,45 @@ def _collect_registry(tel) -> Tuple[Dict[str, ast.AST], Set[str],
     return registered, fold_targets, metrics_node
 
 
+def _collect_marks(trc) -> Tuple[Dict[str, ast.AST], Tuple[str, ...]]:
+    """(registered mark name -> MARKS entry node, governed prefixes)
+    from the tracing module's literals.  Both empty when the module
+    declares no vocabulary (older trees, lint fixtures)."""
+    marks: Dict[str, ast.AST] = {}
+    prefixes: Tuple[str, ...] = ()
+    for node in trc.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name == "MARKS" and isinstance(node.value,
+                                          (ast.Tuple, ast.List)):
+            for entry in node.value.elts:
+                if isinstance(entry, (ast.Tuple, ast.List)) \
+                        and entry.elts:
+                    for mark in _str_elts(entry.elts[0]):
+                        marks[mark] = entry
+        elif name == "MARK_PREFIXES" and isinstance(
+                node.value, (ast.Tuple, ast.List)):
+            prefixes = tuple(
+                p for elt in node.value.elts for p in _str_elts(elt))
+    return marks, prefixes
+
+
+def _mark_name_node(sf, node: ast.Call) -> Optional[ast.AST]:
+    """The mark-name argument node when ``node`` is a mark-emitting
+    call (tracing.mark / tracing.record / any .add_event method),
+    else None."""
+    if len(node.args) < 2:
+        return None
+    if sf.call_qualname(node) in _MARK_QUALS:
+        return node.args[1]
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "add_event":
+        return node.args[1]
+    return None
+
+
 def run(tree) -> List:
     findings: List = []
     tel = next((sf for sf in tree.files if sf.rel == TEL_REL), None)
@@ -96,12 +152,36 @@ def run(tree) -> List:
             "sites against"))
         return findings
 
+    # mark vocabulary (skip entirely when the tree has no tracing
+    # module — lint fixtures and older trees stay ungoverned)
+    trc = next((sf for sf in tree.files if sf.rel == TRACING_REL),
+               None)
+    marks: Dict[str, ast.AST] = {}
+    prefixes: Tuple[str, ...] = ()
+    if trc is not None:
+        marks, prefixes = _collect_marks(trc)
+    marks_used: Set[str] = set()
+
     used: Set[str] = set(fold_targets)
     for sf in tree.files:
         in_tel = sf.rel == TEL_REL
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
+            if prefixes:
+                name_node = _mark_name_node(sf, node)
+                if name_node is not None:
+                    for mark in _str_elts(name_node):
+                        if not mark.startswith(prefixes):
+                            continue  # ungoverned namespace: free-form
+                        marks_used.add(mark)
+                        if mark not in marks:
+                            findings.append(tree.finding(
+                                sf, node, RULE,
+                                f"governed trace mark {mark!r} is "
+                                f"emitted here but not registered in "
+                                f"tracing.MARKS — register it (or fix "
+                                f"the typo)"))
             qn = sf.call_qualname(node)
             is_api = qn in _API_QUALS or (
                 in_tel and isinstance(node.func, ast.Name)
@@ -134,4 +214,11 @@ def run(tree) -> List:
                 f"dead metric vocabulary: {metric!r} is registered in "
                 f"telemetry.METRICS but nothing emits it — retire it "
                 f"or wire up the emitter"))
+    for mark, entry in sorted(marks.items()):
+        if mark not in marks_used:
+            findings.append(tree.finding(
+                trc, entry, RULE,
+                f"dead mark vocabulary: {mark!r} is registered in "
+                f"tracing.MARKS but nothing emits it — retire it or "
+                f"wire up the emitter"))
     return findings

@@ -4,7 +4,9 @@ Polls ``GET /snapshot`` on the HTTP ops listener (server/ops.py) and
 renders the serving picture an operator actually watches: qps and p95
 by tenant (derived from the ``query_latency_seconds`` histogram and
 successive completed-counter deltas), the typed shed taxonomy, breaker
-and brownout state, SLO burn rates per window, and — when the process
+and brownout state, SLO burn rates per window, the flight recorder's
+slow-query panel (fingerprint, wall, dominant-term verdict, capture
+id — the ``/snapshot`` ``recorder`` section), and — when the process
 is part of a DCN group — per-rank fleet health from the coordinator's
 rollup.
 
@@ -116,6 +118,29 @@ def render(snap: dict, qps: Optional[float]) -> str:
                     for w, d in sorted(windows.items()))
             lines.append(f"    {tenant:<12} {n:>6}  "
                          f"p95<={p95 * 1e3:.0f}ms{burn}")
+    # slow queries: the flight recorder's retained tail (newest first;
+    # /debug/slow and tools/explain_slow.py give the deep dive)
+    rec = snap.get("recorder") or {}
+    caps = rec.get("captures") or []
+    if caps or rec:
+        ledger = rec.get("compile_ledger") or {}
+        storm = "  RECOMPILE-STORM" if ledger.get("storming") else ""
+        lines.append(
+            f"  recorder: {rec.get('queries', 0)}/"
+            f"{rec.get('max_queries', '?')} captures "
+            f"boring={rec.get('dropped_boring', 0)} "
+            f"evicted={rec.get('evicted', 0)} "
+            f"missed={rec.get('missed', 0)} "
+            f"pending={rec.get('pending_seals', 0)}{storm}")
+    if caps:
+        lines.append("  slow queries (fingerprint / wall / why / "
+                     "capture):")
+        for cap in caps[:8]:
+            why = cap.get("verdict") or cap.get("reason") or "?"
+            lines.append(
+                f"    {cap.get('fingerprint', '?'):<16} "
+                f"{cap.get('wall_ms', 0):>8.1f}ms "
+                f"{why:<12} {cap.get('capture_id', '?')}")
     # fleet rollup (DCN): per-rank health from the coordinator's merge
     ranks = fleet.get("ranks") or {}
     if ranks:

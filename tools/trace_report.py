@@ -29,7 +29,14 @@ parented under the query root in the ``spanTree``, attributable to its
 owning rank — writes ``<trace>.stitched.json``, and reports per-rank
 span counts.
 
-Usage: ``python tools/trace_report.py [--stitch] TRACE.json [...]``
+Root-cause attribution (``--why``): append the flight recorder's wait
+decomposition for each query — canonical terms (queue wait, compile,
+H2D, dispatch, fetch wait, shuffle, spill, stream/spool) against the
+statement fingerprint's EWMA baseline, the dominant anomalous term
+named — the same analysis ``tools/explain_slow.py`` runs standalone
+(traces sealed by ``utils/recorder.py`` carry it pre-stamped).
+
+Usage: ``python tools/trace_report.py [--stitch] [--why] TRACE.json [...]``
 """
 
 from __future__ import annotations
@@ -597,12 +604,30 @@ def report_file(data: dict) -> str:
     return ("\n" + "- " * 36 + "\n").join(parts)
 
 
+def why_file(data: dict) -> str:
+    """Root-cause attribution section (``--why``): each query in the
+    trace decomposed into canonical wait terms vs its fingerprint's
+    EWMA baseline, dominant anomalous term named — shared verbatim
+    with tools/explain_slow.py."""
+    try:
+        from tools import explain_slow
+    except ImportError:  # run as a script from tools/
+        import explain_slow
+    subs, _ = split_queries(data)
+    return "\n\n".join(
+        explain_slow.format_why(explain_slow.analyze_doc(sub))
+        for sub in subs)
+
+
 def main(argv: List[str]) -> int:
     do_stitch = False
+    do_why = False
     paths: List[str] = []
     for a in argv:
         if a == "--stitch":
             do_stitch = True
+        elif a == "--why":
+            do_why = True
         else:
             paths.append(a)
     if not paths:
@@ -617,6 +642,10 @@ def main(argv: List[str]) -> int:
             print(report_file(merged))
         else:
             print(report_file(load(path)))
+        if do_why:
+            print()
+            print("why (root-cause attribution):")
+            print(why_file(load(path)))
         if len(paths) > 1:
             print("-" * 72)
     return 0
