@@ -180,15 +180,22 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
     import pyarrow.parquet as pq
     pds = {t: pq.read_table(paths[t]).to_pandas() for t in tables}
 
+    from spark_rapids_tpu.plan import physical
     from spark_rapids_tpu.utils.metrics import QueryStats
     stats0 = QueryStats.get().snapshot()
+    progs0 = physical.program_cache_size()
     t0 = time.perf_counter()
     engine_rows = runner(dfs)
     cold_s = time.perf_counter() - t0
     cold_stats = QueryStats.delta_since(stats0)
+    progs_cold = physical.program_cache_size() - progs0
     warm0 = QueryStats.get().snapshot()
     engine_s = _time(lambda: runner(dfs), iters)
     warm_stats = QueryStats.delta_since(warm0)
+    # bucketed-execution evidence: warm iterations (whatever their
+    # cardinalities) must land in ALREADY-COMPILED bucket programs —
+    # programs_warm > 0 means a shape escaped its bucket
+    progs_warm = physical.program_cache_size() - progs0 - progs_cold
     if trace_dir:
         # one trace per query: the last warm iteration's span tree
         os.makedirs(trace_dir, exist_ok=True)
@@ -314,6 +321,11 @@ def _run_one(name: str, sf: float, iters: int) -> dict:
         "compiles_cold": cold_stats["compiles"],
         "compile_s_cold": cold_stats["compile_s"],
         "compiles_warm": warm_stats["compiles"],
+        # stage-program cache growth: cold = programs this query
+        # compiled, warm = programs the warm iterations ADDED (0 when
+        # shape bucketing holds every cardinality in a compiled bucket)
+        "programs_cold": progs_cold,
+        "programs_warm": progs_warm,
         "shuffle_mb_warm": round(warm_stats["shuffle_bytes"] / 1e6, 3),
         "shuffle_gbps_warm": round(
             warm_stats["shuffle_bytes"] / 1e9 / engine_s, 4),

@@ -100,12 +100,26 @@ def estimated_row_bytes(schema) -> int:
     return sum(w(f) for f in schema) or 8
 
 
-def bucket_capacity(n_rows: int, min_capacity: int = 1024) -> int:
-    """Smallest power-of-two >= max(n_rows, min_capacity).
+# Armed by plan/bucketing.install() when the conf picks a non-default
+# ladder; None means the classic power-of-two ladder below (the import
+# points this way, not batch->bucketing, to keep the plan package free
+# to import batch at module scope).
+_ladder_hook = None
 
-    Power-of-two buckets are multiples of the TPU lane width (128) and keep
-    the XLA executable cache small: one compile per (stage, bucket).
+
+def bucket_capacity(n_rows: int, min_capacity: int = 1024,
+                    has_strings: bool = False) -> int:
+    """Smallest ladder rung >= max(n_rows, min_capacity).
+
+    Default ladder: powers of two — multiples of the TPU lane width (128)
+    that keep the XLA executable cache small: one compile per
+    (stage, bucket).  ``spark.rapids.tpu.warmstore.bucket.*`` swaps in a
+    geometric ladder (see plan/bucketing.py); ``has_strings`` lets the
+    ladder apply its per-dtype minimum for host-string batches.
     """
+    hook = _ladder_hook
+    if hook is not None:
+        return hook.capacity_for(n_rows, min_capacity, has_strings)
     cap = max(int(min_capacity), 1)
     n = max(int(n_rows), 1)
     while cap < n:
@@ -416,7 +430,9 @@ def from_arrow(table, min_capacity: int = 1024, device=None) -> ColumnBatch:
     """Build a ColumnBatch from a pyarrow Table (one upload per column)."""
     import pyarrow as pa
     n = table.num_rows
-    cap = bucket_capacity(n, min_capacity)
+    has_strings = any(_arrow_to_logical(t).is_string
+                      for t in table.schema.types)
+    cap = bucket_capacity(n, min_capacity, has_strings=has_strings)
     fields: List[Field] = []
     cols: List[Column] = []
     for name, col in zip(table.column_names, table.columns):

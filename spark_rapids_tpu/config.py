@@ -1340,6 +1340,78 @@ XLA_CACHE_DIR = register(
     "process restarts, fixing minutes-long cold starts on remote-tunneled "
     "backends. Empty disables.", startup_only=True)
 
+# -- warm-start subsystem (runtime/warmstore.py, plan/bucketing.py) -----------
+
+WARMSTORE_ENABLED = register(
+    "spark.rapids.tpu.warmstore.enabled", True,
+    "Warm-start subsystem: persist a content-addressed index of compiled "
+    "statements (fingerprint x bucket x topology) over the XLA compilation "
+    "cache, ship hot entries to drain siblings, and prewarm them after "
+    "restart (docs/warmstart.md).")
+
+WARMSTORE_DIR = register(
+    "spark.rapids.tpu.warmstore.dir", "~/.cache/spark_rapids_tpu/warmstore",
+    "Directory for the warm-start store's index manifest. Unwritable paths "
+    "degrade to an in-memory store (warmstore_errors_total{kind=store_dir}) "
+    "instead of failing startup. Empty keeps the store in-memory only.",
+    startup_only=True)
+
+WARMSTORE_MAX_ENTRIES = register(
+    "spark.rapids.tpu.warmstore.maxEntries", 256,
+    "LRU bound on warm-start index entries; the coldest entry is evicted "
+    "past this.", conv=int,
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+WARMSTORE_MAX_BYTES = register(
+    "spark.rapids.tpu.warmstore.maxBytes", 4 * 1024 * 1024,
+    "LRU bound on the serialized warm-start index size (bytes); evicts "
+    "coldest-first until under.", conv=int,
+    check=lambda v: None if v >= 4096 else "must be >= 4096")
+
+WARMSTORE_SHIP_TOP_N = register(
+    "spark.rapids.tpu.warmstore.ship.topN", 32,
+    "How many of the hottest warm-start entries a draining door ships to "
+    "each GOAWAY sibling before exit. 0 disables shipping.", conv=int,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+WARMSTORE_PREWARM_ENABLED = register(
+    "spark.rapids.tpu.warmstore.prewarm.enabled", True,
+    "Background-compile the store's hottest statement fingerprints at door "
+    "startup (and on shipped imports), prioritized by the admission cost "
+    "model's traffic profiles.")
+
+WARMSTORE_PREWARM_MAX_STATEMENTS = register(
+    "spark.rapids.tpu.warmstore.prewarm.maxStatements", 16,
+    "Upper bound on statements one prewarm pass compiles.", conv=int,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+WARMSTORE_PREWARM_BUDGET_S = register(
+    "spark.rapids.tpu.warmstore.prewarm.budgetS", 30.0,
+    "Wall-clock budget (seconds) for one prewarm pass; the pass stops at "
+    "the first entry boundary past it so prewarm can never monopolize the "
+    "device semaphore.", conv=float,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+WARMSTORE_BUCKET_GROWTH = register(
+    "spark.rapids.tpu.warmstore.bucket.growth", 2.0,
+    "Geometric step between capacity-bucket rungs. 2.0 is the classic "
+    "power-of-two ladder; smaller steps (>= 1.05, e.g. 1.25) trade more "
+    "compiled programs for less padding waste per batch.", conv=float,
+    check=lambda v: None if v >= 1.05 else "must be >= 1.05")
+
+WARMSTORE_BUCKET_ALIGN = register(
+    "spark.rapids.tpu.warmstore.bucket.align", 1,
+    "Round every bucket rung up to a multiple of this (set 128, the TPU "
+    "lane width, with non-power-of-two growth so padded shapes stay "
+    "lane-aligned).", conv=int,
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+WARMSTORE_BUCKET_MIN_ROWS_STRING = register(
+    "spark.rapids.tpu.warmstore.bucket.minRowsString", 0,
+    "Per-dtype bucket minimum: batches carrying host string columns get at "
+    "least this capacity (string uploads amortize worse). 0 disables.",
+    conv=int, check=lambda v: None if v >= 0 else "must be >= 0")
+
 CBO_ENABLED = register(
     "spark.rapids.tpu.sql.cbo.enabled", False,
     "Cost-based optimizer: revert device placement for plan sections whose "

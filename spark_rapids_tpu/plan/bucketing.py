@@ -1,0 +1,138 @@
+"""Shape bucketing: the capacity ladder device batches are padded to.
+
+Every device batch pads its row dimension to a *capacity bucket* so XLA
+executables compile once per (program, bucket) and serve a range of
+cardinalities (batch.py's design note).  The seed engine hard-coded the
+classic power-of-two ladder; this module makes the ladder a configured
+object so the warm-start subsystem (:mod:`..runtime.warmstore`) can key
+persisted programs by bucket, and deployments whose padding waste
+matters more than their program count can pick denser rungs:
+
+  * ``spark.rapids.tpu.warmstore.bucket.growth`` — the geometric step
+    between rungs.  2.0 (the default) reproduces the seed's
+    power-of-two ladder **byte-identically**: rungs are
+    ``min_capacity * 2^k``, exactly what ``bucket_capacity`` always
+    computed.  Smaller steps (e.g. 1.25) trade more compiled programs
+    for less padding waste per batch.
+  * ``spark.rapids.tpu.warmstore.bucket.align`` — every rung rounds up
+    to a multiple of this (set 128 — the TPU lane width — when using a
+    non-power-of-two growth so padded shapes stay lane-aligned).
+  * ``spark.rapids.tpu.warmstore.bucket.minRowsString`` — a per-dtype
+    minimum: batches carrying host string columns get at least this
+    capacity (string uploads amortize worse, so they favor fewer,
+    larger buckets).  0 disables.
+
+Correctness never depends on the ladder: padding rows sit behind the
+validity/active-row masks every kernel already applies, so any ladder
+yields oracle-exact results (tests/test_bucketing.py pins this at the
+bucket boundaries).  The ladder is process-global — it shapes a
+process-wide executable cache — and is armed per query from the conf by
+:class:`.physical.ExecContext` (identical re-arms are free).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["BucketLadder", "configure", "ladder", "ladder_signature",
+           "bucket_signature", "install", "reset_for_tests"]
+
+# a rung past this is a config error, not a batch (2^34 rows)
+_MAX_CAPACITY = 1 << 34
+
+
+class BucketLadder:
+    """A geometric capacity ladder: rungs grow from ``min_capacity`` by
+    ``growth`` per step, each rounded up to a multiple of ``align``."""
+
+    __slots__ = ("growth", "align", "min_rows_string")
+
+    def __init__(self, growth: float = 2.0, align: int = 1,
+                 min_rows_string: int = 0):
+        self.growth = max(1.05, float(growth))
+        self.align = max(1, int(align))
+        self.min_rows_string = max(0, int(min_rows_string))
+
+    def is_legacy(self) -> bool:
+        """True when this ladder IS the seed's power-of-two ladder (the
+        fast path in ``batch.bucket_capacity`` stays byte-identical)."""
+        return self.growth == 2.0 and self.align == 1 \
+            and self.min_rows_string == 0
+
+    def _align_up(self, n: int) -> int:
+        a = self.align
+        return ((n + a - 1) // a) * a
+
+    def capacity_for(self, n_rows: int, min_capacity: int = 1024,
+                     has_strings: bool = False) -> int:
+        """Smallest rung >= max(n_rows, 1), starting the ladder at
+        ``min_capacity`` (per-call: scans, joins, and aggs run
+        different floors)."""
+        floor = max(int(min_capacity), 1)
+        if has_strings and self.min_rows_string:
+            floor = max(floor, self.min_rows_string)
+        n = max(int(n_rows), 1)
+        cap = self._align_up(floor)
+        while cap < n and cap < _MAX_CAPACITY:
+            # growth first, THEN alignment: with growth=2.0/align=1 this
+            # is exactly the seed's `cap <<= 1` (int math is exact here)
+            cap = self._align_up(max(cap + 1, int(cap * self.growth)))
+        return cap
+
+    def signature(self) -> str:
+        """The ladder's identity: folded into region fingerprints and
+        warmstore manifests so programs persisted under one ladder are
+        never warm-started under another."""
+        return f"g{self.growth:g}:a{self.align}:s{self.min_rows_string}"
+
+    def __repr__(self):
+        return f"BucketLadder({self.signature()})"
+
+
+_LOCK = threading.Lock()
+_LADDER = BucketLadder()  # the seed ladder (pow2)
+
+
+def ladder() -> BucketLadder:
+    return _LADDER
+
+
+def ladder_signature() -> str:
+    return _LADDER.signature()
+
+
+def bucket_signature(capacity: int) -> str:
+    """One bucket's identity within the active ladder — the middle term
+    of the warmstore's (statement x bucket x topology) content
+    address."""
+    return f"{_LADDER.signature()}|c{int(capacity)}"
+
+
+def install(l: Optional[BucketLadder]) -> None:
+    """Swap the process ladder (None restores the seed pow2 ladder) and
+    point ``batch.bucket_capacity`` at it.  The legacy ladder keeps the
+    hook DISARMED so the seed fast path stays byte-identical."""
+    import spark_rapids_tpu.batch as batch
+    global _LADDER
+    with _LOCK:
+        _LADDER = l if l is not None else BucketLadder()
+        batch._ladder_hook = None if _LADDER.is_legacy() else _LADDER
+
+
+def configure(conf) -> None:
+    """Arm the ladder from a conf (per-query via ExecContext; identical
+    re-arms are free)."""
+    growth = conf["spark.rapids.tpu.warmstore.bucket.growth"]
+    align = conf["spark.rapids.tpu.warmstore.bucket.align"]
+    min_s = conf["spark.rapids.tpu.warmstore.bucket.minRowsString"]
+    cur = _LADDER
+    if cur.growth == max(1.05, float(growth)) \
+            and cur.align == max(1, int(align)) \
+            and cur.min_rows_string == max(0, int(min_s)):
+        return
+    install(BucketLadder(growth, align, min_s))
+
+
+def reset_for_tests() -> None:
+    install(None)

@@ -188,7 +188,12 @@ class FusedRegionExec(TpuExec):
 def region_fingerprint(region: "FusedRegionExec") -> str:
     """Member-op fingerprint chain — the fused program / plan cache
     identity of a region.  Members without a stable fingerprint
-    contribute their structural description instead."""
+    contribute their structural description instead.  The active
+    capacity-bucket ladder signature is folded in: a region program's
+    padded shapes are the ladder's choice, so two ladders must never
+    share a region identity (the warmstore's content address and the
+    compile ledger both key off this)."""
+    from . import bucketing
     parts = []
     for m in region.members:
         fp = getattr(m, "fingerprint", None)
@@ -199,7 +204,8 @@ def region_fingerprint(region: "FusedRegionExec") -> str:
             except Exception:  # fault-ok (identity degrades to the description)
                 pass
         parts.append(m.node_desc())
-    return "region[" + ";".join(parts) + "]"
+    return "region[" + ";".join(parts) + "]@" \
+        + bucketing.ladder_signature()
 
 
 # ---------------------------------------------------------------------------------

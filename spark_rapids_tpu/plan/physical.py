@@ -68,6 +68,10 @@ class ExecContext:
         # contract (telemetry.enabled + the server.slo.* objectives)
         from ..utils import telemetry
         telemetry.configure(self.conf)
+        # the capacity-bucket ladder arms on the same contract (the
+        # warmstore.bucket.* confs; identical re-arms are free)
+        from . import bucketing
+        bucketing.configure(self.conf)
 
     def metric_set(self, op_id: str) -> MetricSet:
         if op_id not in self.metrics:
@@ -346,6 +350,43 @@ def _cached_program(fp: str, build: Callable[[], Callable]) -> Callable:
         return fn
 
 
+def install_program(fp: str, fn: Callable) -> None:
+    """Pre-install a program under a cache key (the warm-start prewarm
+    lane's entry point: an AOT-compiled executable takes the slot the
+    live path would otherwise fill with a cold jit).  First-writer
+    wins — a live query that already compiled keeps its program."""
+    with _STAGE_CACHE_LOCK:
+        if fp in _STAGE_CACHE:
+            return
+        _STAGE_CACHE[fp] = fn
+        while len(_STAGE_CACHE) > _STAGE_CACHE_MAX:
+            _STAGE_CACHE.popitem(last=False)
+
+
+def has_program(fp: str) -> bool:
+    with _STAGE_CACHE_LOCK:
+        return fp in _STAGE_CACHE
+
+
+def program_cache_size() -> int:
+    """Distinct compiled stage programs resident right now — the
+    program-count metric bench.py reports per query (bucketing's win
+    is fewer programs, not just fewer compile seconds)."""
+    with _STAGE_CACHE_LOCK:
+        return len(_STAGE_CACHE)
+
+
+def clear_program_cache() -> List[str]:
+    """Drop every resident program and return the evicted cache keys —
+    the restart simulation used by the warm-start differential (loadgen
+    --restart-probe, tests): a process restart loses exactly this state,
+    and the returned keys are what the old life would have persisted."""
+    with _STAGE_CACHE_LOCK:
+        keys = list(_STAGE_CACHE)
+        _STAGE_CACHE.clear()
+    return keys
+
+
 class StageExec(TpuExec):
     """A fused pipeline of project and filter steps over one input.
 
@@ -572,6 +613,14 @@ class StageExec(TpuExec):
                 donated = True
                 from ..utils.metrics import QueryStats
                 QueryStats.get().donated_batches += 1
+
+            from ..runtime import warmstore
+            if warmstore.is_active():
+                # record this program call's pytree signature under the
+                # statement's warm-start entry (deduped after batch 1)
+                warmstore.note_program(
+                    ("stage-donate|" if donated else "stage|") + fp,
+                    arrays, extras, b.sel, ansi, donated)
 
             def _device_result():
                 outs = use_fn(tuple(arrays), tuple(extras),

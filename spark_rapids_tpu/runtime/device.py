@@ -46,19 +46,14 @@ class DeviceManager:
             if cls._info is not None:
                 return cls._info
             import jax
-            cache_dir = conf["spark.rapids.tpu.xla.cacheDir"]
-            if cache_dir:
-                # persistent executable cache: compiled programs survive
-                # restarts (cold compiles on tunneled backends run minutes)
-                import os
-                path = os.path.expanduser(cache_dir)
-                try:
-                    os.makedirs(path, exist_ok=True)
-                    jax.config.update("jax_compilation_cache_dir", path)
-                    jax.config.update(
-                        "jax_persistent_cache_min_compile_time_secs", 0.5)
-                except Exception as e:  # never fail init over a cache
-                    log.warning("compilation cache unavailable: %s", e)
+            # persistent executable cache: compiled programs survive
+            # restarts (cold compiles on tunneled backends run minutes).
+            # Routed through the warm-start subsystem: the dir is probed
+            # for writability, and an unusable path emits
+            # warmstore_errors_total{kind=cache_dir} instead of the
+            # fleet silently proceeding cold
+            from .warmstore import setup_jax_cache
+            setup_jax_cache(conf)
             requested = conf["spark.rapids.tpu.device.platform"]
             dev = cls._select_device(jax, requested)
             cls._check_environment(jax)

@@ -471,6 +471,24 @@ class WireClient:
         _, payload = self.recv_frame(expect=(P.RSP_OPS,))
         return P.unpack_json(payload)
 
+    def ship_warm(self, entries: list) -> int:
+        """Push warm-start index entries to this door (REQ_WARM — the
+        drain-time hand-off a draining door makes to its siblings).
+        Returns the count the receiver imported.  Served on the far
+        side even while it drains; a GOAWAY in reply still fails over
+        like any other request."""
+        for _ in range(_GOAWAY_RETRIES):
+            try:
+                P.send_frame(self._sock, P.REQ_WARM,
+                             P.pack_json({"entries": list(entries)}))
+                _, payload = self.recv_frame(expect=(P.RSP_WARM,))
+                self._note_success()
+                return int(P.unpack_json(payload).get("imported", 0))
+            except ServerDraining as e:
+                self._failover(e)
+        raise WireError("DRAINING", "ship_warm kept landing on draining "
+                                    "endpoints")
+
     def close(self) -> None:
         try:
             P.send_frame(self._sock, P.REQ_BYE)

@@ -87,6 +87,10 @@ class SqlFrontDoor:
         self._srv: Optional[socket.socket] = None
         self._accept_th: Optional[threading.Thread] = None
         self._ops = None  # the HTTP ops listener (server/ops.py)
+        # the warm-start prewarm lane: a background thread compiling
+        # the store's hot head at startup / after a shipped import
+        self._prewarm_th: Optional[threading.Thread] = None
+        self._prewarm_stop = threading.Event()
         self._closed = False
         # graceful drain (planned restart): once set, new connections
         # and new query requests are answered with a GOAWAY frame
@@ -124,6 +128,11 @@ class SqlFrontDoor:
         """Expose a DataFrame (or zero-arg factory) to wire clients
         under ``name`` — the server-side catalog (Flight SQL shape)."""
         self._tables[name] = df_or_factory
+        # a store entry whose spec references this table becomes
+        # prewarmable the moment the table exists — re-kick (no-op
+        # before start(), or while a pass is already running)
+        if self._srv is not None:
+            self._kick_prewarm()
 
     def start(self) -> "SqlFrontDoor":
         conf = self._conf()
@@ -144,6 +153,13 @@ class SqlFrontDoor:
             self._ops = OpsServer(
                 self, host,
                 conf["spark.rapids.tpu.server.ops.port"]).start()
+        # the warm-start subsystem: load the persistent index and kick
+        # a budgeted background prewarm of its hot head (restart
+        # warmth — the index a prior life persisted compiles before
+        # the parked clients arrive)
+        from ..runtime import warmstore
+        warmstore.initialize(conf)
+        self._kick_prewarm(conf)
         return self
 
     @property
@@ -155,6 +171,82 @@ class SqlFrontDoor:
     def ops_port(self) -> Optional[int]:
         """The HTTP ops listener's bound port (None when disabled)."""
         return self._ops.port if self._ops is not None else None
+
+    # -- warm-start lane ----------------------------------------------------------
+    def _kick_prewarm(self, conf=None) -> None:
+        """Start (or restart) the background prewarm pass: the store's
+        hot statements compile off the live path, yielding to real
+        queries between entries.  Idempotent while a pass runs."""
+        from ..runtime import warmstore
+        if conf is None:
+            conf = self._conf()
+        if not warmstore.is_active() \
+                or not conf["spark.rapids.tpu.warmstore.prewarm.enabled"]:
+            return
+        with self._lock:
+            if self._closed or (self._prewarm_th is not None
+                                and self._prewarm_th.is_alive()):
+                return
+            th = threading.Thread(  # ctx-ok (prewarm lane; per-query contexts are the scheduler's)
+                target=self._prewarm_run, daemon=True,
+                name="srt-warmstore-prewarm")
+            self._prewarm_th = th
+        th.start()
+
+    def _prewarm_run(self) -> None:
+        from ..runtime import warmstore
+        # grace window: callers register tables right after start()
+        # returns — starting the pass a beat later turns "unknown
+        # table" churn into a clean first pass (register_table also
+        # re-kicks, so a slow caller only defers, never loses, prewarm)
+        if self._prewarm_stop.wait(0.5):  # wait-ok (bounded grace delay; stop short-circuits it)
+            return
+        try:
+            warmstore.prewarm(
+                self._session, self.prepared, self._tables,
+                self._conf(), scheduler=self._session.scheduler(),
+                stop=self._prewarm_stop)
+        except Exception as e:  # fault-ok (prewarm is best-effort; a failing pass must never take the door down)
+            import logging
+            logging.getLogger("spark_rapids_tpu").warning(
+                "warmstore prewarm pass failed: %s", e)
+
+    def _ship_warm_entries(self, conf) -> int:
+        """Drain-time shipping: push the store's hottest entries to
+        each sibling over REQ_WARM (recipes — specs + program
+        signatures — not executables; the sibling's prewarm lane
+        recompiles them for its own topology).  Best-effort per
+        sibling; failures count warmstore_errors_total{kind=ship}."""
+        from ..runtime import warmstore
+        st = warmstore.store()
+        top_n = conf["spark.rapids.tpu.warmstore.ship.topN"]
+        if st is None or top_n <= 0:
+            return 0
+        entries = st.export_hot(top_n)
+        if not entries:
+            return 0
+        with self._lock:
+            siblings = list(self._siblings)
+        token = conf["spark.rapids.tpu.server.authToken"]
+        shipped = 0
+        for host, port in siblings:
+            try:
+                from .client import WireClient
+                with WireClient(host, port, token=token,
+                                timeout=10.0, retry_budget=0) as wc:
+                    wc.ship_warm(entries)
+                shipped += len(entries)
+                for _ in entries:
+                    telemetry.count("warmstore_shipped_total",
+                                    direction="sent")
+            except Exception as e:  # fault-ok (a dark sibling must not block the drain; its clients re-warm the slow way)
+                telemetry.count("warmstore_errors_total", kind="ship")
+                import logging
+                logging.getLogger("spark_rapids_tpu").warning(
+                    "warmstore ship to %s:%s failed: %s", host, port, e)
+        with st._lock:
+            st.shipped_out += shipped
+        return shipped
 
     def begin_drain(self, siblings: Optional[list] = None) -> None:
         """Phase 1 of a graceful drain: flip into DRAINING — new
@@ -218,11 +310,23 @@ class SqlFrontDoor:
             # the GOAWAY window: clients parked between requests learn
             # about the restart from a typed frame, not a dead socket
             time.sleep(linger_s)
+        # warm-start hand-off: ship the store's hot entries to the
+        # GOAWAY siblings BEFORE close (they prewarm while this door's
+        # clients fail over), and flush the index for the next life
+        try:
+            shipped = self._ship_warm_entries(conf)
+        except Exception:  # fault-ok (shipping is best-effort; the drain's leak-hygiene contract comes first)
+            shipped = 0
+        from ..runtime import warmstore
+        st = warmstore.store()
+        if st is not None:
+            st.flush()
         with self._lock:
             report = {"drained": True,
                       "in_flight_cancelled": len(stragglers),
                       "in_flight_leftover": leftover,
                       "goaways_sent": self.goaways_sent,
+                      "warm_entries_shipped": shipped,
                       "siblings": list(self._siblings)}
         self.close()
         return report
@@ -235,6 +339,10 @@ class SqlFrontDoor:
             conns = list(self._conns.values())
             queries = list(self._queries.values())
             threads = list(self._conn_threads.values())
+            prewarm_th = self._prewarm_th
+        # stop the prewarm lane first: it holds no locks the teardown
+        # needs, but its compiles must not race device shutdown
+        self._prewarm_stop.set()
         for q in queries:
             q.handle.cancel("server closing")
             q.stream.close()
@@ -252,6 +360,9 @@ class SqlFrontDoor:
             self._ops.close()
         if self._accept_th is not None:
             self._accept_th.join(timeout=2.0)
+        if prewarm_th is not None \
+                and prewarm_th is not threading.current_thread():
+            prewarm_th.join(timeout=2.0)
         for th in threads:
             if th is not threading.current_thread():
                 th.join(timeout=2.0)
@@ -350,6 +461,23 @@ class SqlFrontDoor:
                     P.send_frame(conn, P.RSP_OPS,
                                  P.pack_json(self.ops_snapshot()))
                     continue
+                if ftype == P.REQ_WARM:
+                    # warm-start shipping from a draining sibling:
+                    # import the entries and kick a prewarm pass.
+                    # Served while THIS door drains too (a sibling may
+                    # be mid-rollout; the entries persist for the next
+                    # life either way) — above the drain gate with
+                    # REQ_OPS
+                    from ..runtime import warmstore
+                    req = P.unpack_json(payload)
+                    st = warmstore.store()
+                    n = st.import_shipped(req.get("entries") or []) \
+                        if st is not None else 0
+                    P.send_frame(conn, P.RSP_WARM,
+                                 P.pack_json({"imported": n}))
+                    if n:
+                        self._kick_prewarm()
+                    continue
                 if ftype == P.REQ_CANCEL:
                     req = P.unpack_json(payload)
                     ok = self._cancel_query(req.get("query_id", ""))
@@ -443,6 +571,8 @@ class SqlFrontDoor:
         except BadSpec as e:
             raise WireError("BAD_REQUEST", str(e))
         conn_stmts[stmt.fingerprint] = spec
+        from ..runtime import warmstore
+        warmstore.note_statement(stmt.fingerprint, spec)
         P.send_frame(conn, P.RSP_PREPARED, P.pack_json(
             {"statement_id": stmt.fingerprint,
              "param_types": stmt.param_types,
@@ -461,6 +591,7 @@ class SqlFrontDoor:
         prepared_run = False
         plan_saved_ms = 0.0
         fingerprint = None  # admission cost-model key (prepared or not)
+        from ..runtime import warmstore
         if ftype == P.REQ_EXECUTE:
             fp = req.get("statement_id", "")
             fingerprint = fp or None
@@ -474,6 +605,7 @@ class SqlFrontDoor:
                 prepared_run = True
                 plan_saved_ms = stmt.plan_s * 1e3
                 run = self._planned_runner(phys, values)
+                warmstore.note_statement(fingerprint, stmt.spec)
             else:
                 spec = conn_stmts.get(fp)
                 if spec is None:
@@ -485,6 +617,7 @@ class SqlFrontDoor:
                 values = coerce_params(params, ptypes)
                 schema = df._plan.schema()
                 run = self._plan_runner(df, values)
+                warmstore.note_statement(fingerprint, spec)
         else:
             spec = req.get("spec")
             if not isinstance(spec, dict):
@@ -495,6 +628,7 @@ class SqlFrontDoor:
             # on an admission cost profile
             from ..cache.keys import statement_fingerprint
             fingerprint = statement_fingerprint(spec)
+            warmstore.note_statement(fingerprint, spec)
             df, ptypes = compile_spec(spec, self._tables)
             values = coerce_params(params, ptypes)
             schema = df._plan.schema()
@@ -877,7 +1011,14 @@ class SqlFrontDoor:
             "slo": _tm.slo_snapshot(),
             "fleet": _tm.fleet(),
             "recorder": _recorder.snapshot(),
+            "warmstore": _warmstore_snapshot(),
         }
+
+
+def _warmstore_snapshot() -> Dict[str, Any]:
+    from ..runtime import warmstore
+    snap = warmstore.snapshot()
+    return snap if snap is not None else {"enabled": False}
 
 
 def _rejected_wire_error(e) -> WireError:

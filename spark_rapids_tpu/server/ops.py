@@ -19,7 +19,11 @@ accept thread, per-request handler threads) bound from
     captures rendered human-first (fingerprint, wall, retention
     reason, dominant-term verdict, capture id) plus the compile
     ledger's hottest fingerprints — the "why is it slow RIGHT NOW"
-    page (``tools/explain_slow.py`` gives the per-query deep dive).
+    page (``tools/explain_slow.py`` gives the per-query deep dive);
+  * ``GET /debug/warmstore`` — the warm-start compile store's index
+    (:mod:`..runtime.warmstore`): hit/miss/eviction/ship counters and
+    the hottest entries (fingerprint, hits, compiled-program count,
+    warm-from-disk flag) — the "will a restart be cold" page.
 
 The same ``/snapshot`` payload is served over the wire protocol's
 typed ``OPS`` op (:data:`..server.protocol.REQ_OPS`), so a scraper
@@ -36,7 +40,7 @@ from typing import Optional
 
 from ..utils import recorder, telemetry
 
-__all__ = ["OpsServer", "render_debug_slow"]
+__all__ = ["OpsServer", "render_debug_slow", "render_debug_warmstore"]
 
 
 def render_debug_slow() -> str:
@@ -82,6 +86,40 @@ def render_debug_slow() -> str:
             f"{e['total_s']:>8.3f}s {e['last_s']:>8.3f}s {trig}")
     if not ledger["top"]:
         lines.append("  (no compiles observed)")
+    return "\n".join(lines) + "\n"
+
+
+def render_debug_warmstore() -> str:
+    """The ``/debug/warmstore`` page body: the compile store's index
+    rendered human-first (counters, then hottest entries), as plain
+    text — the same data rides ``/snapshot`` as JSON for tools."""
+    from ..runtime import warmstore
+    snap = warmstore.snapshot()
+    if snap is None:
+        return "warmstore: disabled\n"
+    lines = [
+        "warmstore: "
+        f"{snap['entries']}/{snap['max_entries']} entries, "
+        f"{snap['bytes']}/{snap['max_bytes']} bytes, "
+        f"topology={snap['topology']} "
+        f"dir={snap['dir'] or '(in-memory)'}",
+        f"hits={snap['hits']} misses={snap['misses']} "
+        f"evictions={snap['evictions']} "
+        f"shipped_in={snap['shipped_in']} "
+        f"shipped_out={snap['shipped_out']} "
+        f"prewarmed={snap['prewarmed']} corrupt={snap['corrupt']}",
+        "",
+        f"{'KEY':24s} {'FINGERPRINT':16s} {'HITS':>6s} "
+        f"{'PROGRAMS':>8s} {'WARM':>5s} {'SPEC':>5s}",
+    ]
+    for e in snap["top"]:
+        lines.append(
+            f"{e['key']:24s} {e['fingerprint']:16s} {e['hits']:>6d} "
+            f"{e['programs']:>8d} "
+            f"{'yes' if e['warm'] else 'no':>5s} "
+            f"{'yes' if e['has_spec'] else 'no':>5s}")
+    if not snap["top"]:
+        lines.append("  (no entries)")
     return "\n".join(lines) + "\n"
 
 
@@ -145,6 +183,12 @@ class OpsServer:
                                         endpoint="debug_slow")
                         self._reply(200,
                                     render_debug_slow().encode(),
+                                    "text/plain")
+                    elif path == "/debug/warmstore":
+                        telemetry.count("ops_scrapes_total",
+                                        endpoint="debug_warmstore")
+                        self._reply(200,
+                                    render_debug_warmstore().encode(),
                                     "text/plain")
                     else:
                         self._reply(404, b"not found\n", "text/plain")

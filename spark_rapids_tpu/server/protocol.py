@@ -53,11 +53,11 @@ __all__ = [
     "goaway_payload",
     # request frame types
     "REQ_HELLO", "REQ_SUBMIT", "REQ_PREPARE", "REQ_EXECUTE", "REQ_CANCEL",
-    "REQ_STATUS", "REQ_OPS", "REQ_BYE",
+    "REQ_STATUS", "REQ_OPS", "REQ_WARM", "REQ_BYE",
     # response frame types
     "RSP_WELCOME", "RSP_META", "RSP_BATCH", "RSP_END", "RSP_ERROR",
-    "RSP_PREPARED", "RSP_CANCELLED", "RSP_STATUS", "RSP_OPS", "RSP_BYE",
-    "RSP_GOAWAY",
+    "RSP_PREPARED", "RSP_CANCELLED", "RSP_STATUS", "RSP_OPS", "RSP_WARM",
+    "RSP_BYE", "RSP_GOAWAY",
 ]
 
 # type byte, payload length, crc32 of the payload — stamped at send,
@@ -82,6 +82,13 @@ REQ_STATUS = b"s"
 # a scraper that already speaks the protocol needs no second port.
 # Served during a drain (observability must outlive admission).
 REQ_OPS = b"o"
+# warm-start shipping: a draining door pushes its hottest warmstore
+# index entries (statement specs + program signatures — recipes, not
+# executables) to each GOAWAY sibling so the failover target prewarms
+# before the parked clients arrive.  Served during a drain on the
+# RECEIVING side (a sibling may itself be mid-rollout) — sits beside
+# REQ_OPS above the drain gate.
+REQ_WARM = b"w"
 REQ_BYE = b"x"
 
 RSP_WELCOME = b"W"
@@ -93,6 +100,7 @@ RSP_PREPARED = b"P"
 RSP_CANCELLED = b"C"
 RSP_STATUS = b"S"
 RSP_OPS = b"O"
+RSP_WARM = b"V"
 RSP_BYE = b"X"
 # GOAWAY (the HTTP/2 shape): the server is DRAINING for a planned
 # restart — it names sibling endpoints and will accept no new queries
@@ -102,10 +110,10 @@ RSP_BYE = b"X"
 RSP_GOAWAY = b"G"
 
 _REQUEST_TYPES = (REQ_HELLO, REQ_SUBMIT, REQ_PREPARE, REQ_EXECUTE,
-                  REQ_CANCEL, REQ_STATUS, REQ_OPS, REQ_BYE)
+                  REQ_CANCEL, REQ_STATUS, REQ_OPS, REQ_WARM, REQ_BYE)
 _RESPONSE_TYPES = (RSP_WELCOME, RSP_META, RSP_BATCH, RSP_END, RSP_ERROR,
                    RSP_PREPARED, RSP_CANCELLED, RSP_STATUS, RSP_OPS,
-                   RSP_BYE, RSP_GOAWAY)
+                   RSP_WARM, RSP_BYE, RSP_GOAWAY)
 
 # THE canonical error-code vocabulary (the table above, plus DRAINING —
 # the GOAWAY shed).  srtlint's protocol-conformance pass holds every

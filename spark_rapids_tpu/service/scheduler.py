@@ -889,6 +889,27 @@ class QueryScheduler:
         with self._cv:
             return len(self._running)
 
+    def idle(self) -> bool:
+        """No live query queued or running right now."""
+        with self._cv:
+            return not self._queue and not self._running
+
+    def await_idle(self, timeout: float = 0.0) -> bool:
+        """Block until the scheduler is idle, up to ``timeout`` seconds
+        (False on expiry).  The warm-start prewarm lane yields on this
+        between background compiles so a live query burst always wins
+        the device semaphore — the waiter polls on the completion
+        condvar (``_finish`` notifies it), with a bounded re-check so a
+        missed transition can't park it forever."""
+        deadline = _pc() + max(0.0, timeout)
+        with self._cv:
+            while self._queue or self._running:
+                remaining = deadline - _pc()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 0.25))  # wait-ok (bounded re-check; _finish notifies the condvar)
+            return True
+
     def snapshot(self) -> Dict[str, float]:
         with self._cv:
             snap = {"queued": len(self._queue),

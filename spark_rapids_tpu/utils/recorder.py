@@ -25,7 +25,8 @@ makes retention, not capture, the decision:
   * **compile ledger** — the ``jax.monitoring`` compile listener
     (utils/metrics.py) feeds a per-statement-fingerprint ledger
     (count, seconds, trigger: first_seen / shape_change /
-    post_restart / cache_evict) with a recompile-storm detector
+    post_restart / cache_evict / store_hit / prewarm) with a
+    recompile-storm detector
     (``compile_storm_active`` gauge + ``compile:storm`` mark).  This
     is the traffic×compile profile the ROADMAP's persistent compile
     cache needs to prioritize precompilation;
@@ -53,6 +54,7 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["TERMS", "FlightRecorder", "CompileLedger", "offer",
            "outcome", "configure", "snapshot", "pending_seals",
            "compile_note", "compile_evicted", "compile_prime",
+           "compile_store_known", "compile_prewarm_scope",
            "decompose", "decompose_chrome", "judge",
            "recorder", "compile_ledger", "reset_for_tests"]
 
@@ -487,6 +489,7 @@ class CompileLedger:
         self._entries: Dict[str, dict] = {}
         self._evicted: set = set()
         self._primed: set = set()
+        self._store: set = set()
         self._recent: deque = deque()  # monotonic t of recompiles
         self.storming = False
         self.total_compiles = 0
@@ -501,11 +504,25 @@ class CompileLedger:
         from . import tracing
         attributed = bool(fingerprint)
         fp = str(fingerprint) if fingerprint else "<anon>"
+        prewarming = getattr(_PREWARM_TLS, "depth", 0) > 0
+        if prewarming and not attributed:
+            # the prewarm lane compiles outside any live query control,
+            # so the listener has no fingerprint — the scope carries it
+            scope_fp = getattr(_PREWARM_TLS, "fp", None)
+            if scope_fp:
+                fp, attributed = scope_fp, True
         now = time.monotonic()  # span-api-ok (storm window bookkeeping)
         storm_args = None
         with self._lock:
             ent = self._entries.get(fp)
-            if not attributed:
+            if prewarming:
+                # a deliberate background compile, never recompile
+                # pressure; consume the warm-start markers so the LIVE
+                # path's later compiles (if any) classify honestly
+                trigger = "prewarm"
+                self._store.discard(fp)
+                self._primed.discard(fp)
+            elif not attributed:
                 # a session-direct query compiles MANY distinct
                 # programs under no statement identity; calling those
                 # "shape changes" of one phantom statement would trip
@@ -515,6 +532,15 @@ class CompileLedger:
             elif fp in self._evicted:
                 self._evicted.discard(fp)
                 trigger = "cache_evict"
+            elif fp in self._store:
+                # known to the persistent warm store: this "compile" is
+                # a disk deserialization of a prior program, not the
+                # post-restart storm the primed set would call it
+                # (checked before _primed — a store-backed restart is
+                # the warm path working)
+                self._store.discard(fp)
+                self._primed.discard(fp)
+                trigger = "store_hit"
             elif fp in self._primed:
                 self._primed.discard(fp)
                 trigger = "post_restart"
@@ -535,10 +561,13 @@ class CompileLedger:
                                                            0) + 1
             self.total_compiles += 1
             self.total_s += duration_s
-            if trigger not in ("first_seen", "unattributed"):
+            if trigger not in ("first_seen", "unattributed",
+                               "prewarm", "store_hit"):
                 # a storm is RE-compilation pressure on identified
-                # statements: steady first-seen warmup and anonymous
-                # session compiles are expected and must not trip it
+                # statements: steady first-seen warmup, anonymous
+                # session compiles, deliberate prewarm bursts, and
+                # store-served deserializations are expected and must
+                # not trip it
                 self._recent.append(now)
             while self._recent and now - self._recent[0] \
                     > STORM_WINDOW_S:
@@ -573,6 +602,15 @@ class CompileLedger:
             for fp in fingerprints:
                 if fp:
                     self._primed.add(str(fp))
+
+    def note_store_known(self, fingerprints) -> None:
+        """Mark fingerprints the persistent warm store holds programs
+        for (a loaded manifest, a shipped payload): their next compile
+        classifies store_hit — a disk deserialization, not a storm."""
+        with self._lock:
+            for fp in fingerprints:
+                if fp:
+                    self._store.add(str(fp))
 
     def export_gauges(self) -> None:
         from . import telemetry
@@ -711,6 +749,36 @@ def compile_evicted(fingerprint) -> None:
 
 def compile_prime(fingerprints) -> None:
     _LEDGER.prime(fingerprints)
+
+
+def compile_store_known(fingerprints) -> None:
+    _LEDGER.note_store_known(fingerprints)
+
+
+# thread-local prewarm scope: compiles issued on a thread inside the
+# scope classify as trigger=prewarm (and inherit the scope's statement
+# fingerprint when the listener has none)
+_PREWARM_TLS = threading.local()
+
+
+class compile_prewarm_scope:
+    """``with compile_prewarm_scope(fp):`` — every backend compile this
+    thread issues inside the block is the prewarm lane's doing."""
+
+    def __init__(self, fingerprint=None):
+        self._fp = str(fingerprint) if fingerprint else None
+
+    def __enter__(self):
+        _PREWARM_TLS.depth = getattr(_PREWARM_TLS, "depth", 0) + 1
+        self._prev_fp = getattr(_PREWARM_TLS, "fp", None)
+        if self._fp:
+            _PREWARM_TLS.fp = self._fp
+        return self
+
+    def __exit__(self, *exc):
+        _PREWARM_TLS.depth -= 1
+        _PREWARM_TLS.fp = self._prev_fp
+        return False
 
 
 def reset_for_tests() -> None:

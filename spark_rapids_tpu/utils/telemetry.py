@@ -241,8 +241,9 @@ METRICS = (
     ("compiles_by_trigger_total", "counter", "trigger",
      "Backend compiles classified by the compile ledger's trigger "
      "taxonomy (first_seen / shape_change / post_restart / "
-     "cache_evict, plus unattributed for session-direct compiles "
-     "with no statement fingerprint)."),
+     "cache_evict / store_hit for warm-store-served deserializations "
+     "/ prewarm for the background warm-up lane, plus unattributed "
+     "for session-direct compiles with no statement fingerprint)."),
     ("compile_storm_active", "gauge", "",
      "1 while the recompile-storm detector is tripped (recompiles in "
      "the trailing window above the storm threshold), else 0."),
@@ -250,6 +251,38 @@ METRICS = (
      "Root-cause verdicts issued at capture seal, by dominant "
      "anomalous wait term (queue_wait / compile / h2d / dispatch / "
      "fetch_wait / shuffle / spill / stream_spool)."),
+    ("warmstore_hits_total", "counter", "",
+     "Statements that arrived already covered by a warm-start store "
+     "entry (a persisted or shipped program served instead of a cold "
+     "compile)."),
+    ("warmstore_misses_total", "counter", "",
+     "Statements the warm-start store had no entry for (the cold "
+     "path; seeds a new entry)."),
+    ("warmstore_evictions_total", "counter", "",
+     "Warm-start store entries evicted by the LRU bounds "
+     "(warmstore.maxEntries / warmstore.maxBytes)."),
+    ("warmstore_shipped_total", "counter", "direction",
+     "Warm-start entries shipped between doors at drain time "
+     "(direction=sent by the draining door, direction=received by "
+     "its GOAWAY sibling)."),
+    ("warmstore_prewarmed_total", "counter", "",
+     "Statements the background prewarm lane compiled ahead of "
+     "traffic (trigger=prewarm in the compile ledger)."),
+    ("warmstore_corrupt_total", "counter", "",
+     "Warm-start store loads that hit a corrupt/unreadable manifest "
+     "or entry and were dropped (the store degrades, never fails the "
+     "door)."),
+    ("warmstore_errors_total", "counter", "kind",
+     "Warm-start subsystem degradations: kind=cache_dir (XLA "
+     "compilation cache dir unwritable — proceeding cold), "
+     "kind=store_dir (store dir unwritable — in-memory only), "
+     "kind=ship (sibling shipping failed), kind=prewarm (a prewarm "
+     "compile failed)."),
+    ("warmstore_entries", "gauge", "",
+     "Entries currently in the warm-start store index."),
+    ("warmstore_bytes", "gauge", "",
+     "Approximate serialized size of the warm-start store index "
+     "(the warmstore.maxBytes bound is on this estimate)."),
 )
 
 # QueryStats field -> registered counter: the ONE fold-in choke point.

@@ -1,0 +1,232 @@
+"""Shape bucketing (plan/bucketing.py): ladder unit invariants (legacy
+parity with the seed pow2 ladder, monotone geometric rungs, alignment,
+string minimums), oracle-exact differentials at adjacent bucket
+boundaries (exact fit / +1 row / bucket max / empty) over
+project/filter/join/agg with nulls, and the full TPC-H suite vs the
+pandas oracle under a dense geometric ladder."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.batch as batch
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.plan import bucketing
+from spark_rapids_tpu.plan.bucketing import BucketLadder
+from spark_rapids_tpu.sql import functions as F
+
+GEO = {"spark.rapids.tpu.warmstore.bucket.growth": 1.3,
+       "spark.rapids.tpu.warmstore.bucket.align": 8}
+
+
+@pytest.fixture(autouse=True)
+def _restore_ladder():
+    yield
+    for k in GEO:
+        TpuConf.unset_session(k)
+    bucketing.reset_for_tests()
+
+
+@pytest.fixture()
+def geo_ladder():
+    """Arm the dense geometric ladder the way a deployment would: via
+    conf (ExecContext re-arms per query, so a direct install() would
+    not survive the first query)."""
+    for k, v in GEO.items():
+        TpuConf.set_session(k, v)
+    yield BucketLadder(GEO["spark.rapids.tpu.warmstore.bucket.growth"],
+                       GEO["spark.rapids.tpu.warmstore.bucket.align"])
+
+
+def _seed_capacity(n_rows, min_capacity=1024):
+    """The seed engine's hard-coded pow2 ladder, verbatim."""
+    cap = max(int(min_capacity), 1)
+    n = max(int(n_rows), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+class TestLadder:
+    def test_legacy_parity_randomized(self):
+        """growth=2.0/align=1 must be byte-identical to the seed loop —
+        the invariant that makes the default ladder safe to leave on."""
+        lad = BucketLadder()
+        assert lad.is_legacy()
+        rng = np.random.default_rng(20260807)
+        for _ in range(5000):
+            n = int(rng.integers(0, 1 << 22))
+            mc = int(rng.choice([1, 7, 128, 1024, 4096]))
+            assert lad.capacity_for(n, mc) == _seed_capacity(n, mc), \
+                (n, mc)
+
+    def test_legacy_keeps_hook_disarmed(self):
+        bucketing.install(BucketLadder())
+        assert batch._ladder_hook is None
+        bucketing.install(BucketLadder(1.3, 8))
+        assert batch._ladder_hook is not None
+        bucketing.reset_for_tests()
+        assert batch._ladder_hook is None
+
+    def test_rungs_monotone_and_covering(self):
+        lad = BucketLadder(1.25, 1)
+        prev = 0
+        for n in range(1, 50_000, 997):
+            cap = lad.capacity_for(n)
+            assert cap >= n
+            assert cap >= prev or n <= prev  # rungs never shrink
+            # a rung is a fixed point: capacity_for(rung) == rung
+            assert lad.capacity_for(cap) == cap
+            prev = cap
+
+    def test_align_rounds_every_rung(self):
+        lad = BucketLadder(1.3, 128)
+        for n in (1, 1000, 1025, 5000, 100_000):
+            assert lad.capacity_for(n) % 128 == 0
+
+    def test_min_rows_string_floor(self):
+        lad = BucketLadder(1.3, 8, min_rows_string=4096)
+        assert lad.capacity_for(10, has_strings=True) >= 4096
+        assert lad.capacity_for(10, has_strings=False) < 4096
+
+    def test_growth_clamps_and_terminates(self):
+        lad = BucketLadder(0.5)  # nonsense growth clamps to 1.05
+        assert lad.growth == 1.05
+        assert lad.capacity_for(1_000_000) >= 1_000_000
+
+    def test_signature_distinguishes_ladders(self):
+        sigs = {BucketLadder().signature(),
+                BucketLadder(1.3).signature(),
+                BucketLadder(1.3, 8).signature(),
+                BucketLadder(1.3, 8, 4096).signature()}
+        assert len(sigs) == 4
+
+    def test_configure_from_conf_and_rearm_free(self):
+        conf = TpuConf(dict(GEO))
+        bucketing.configure(conf)
+        armed = bucketing.ladder()
+        assert armed.growth == 1.3 and armed.align == 8
+        bucketing.configure(conf)  # identical re-arm keeps the object
+        assert bucketing.ladder() is armed
+
+    def test_same_bucket_shares_capacity(self, geo_ladder):
+        """Distinct cardinalities inside one rung pad to ONE capacity —
+        the shape XLA keys the executable by (bench's
+        programs_cold/programs_warm columns measure the same thing
+        end-to-end)."""
+        conf = TpuConf(dict(GEO))
+        bucketing.configure(conf)
+        c1 = batch.bucket_capacity(1500)
+        c2 = batch.bucket_capacity(1600)
+        assert c1 == c2
+
+
+# ---------------------------------------------------------------------------
+# Boundary differentials: geometric ladder vs the legacy ladder must be
+# oracle-exact at the rungs where padding changes.
+# ---------------------------------------------------------------------------
+
+def _table(n):
+    """Deterministic test table with nullable ints, floats, strings."""
+    rng = np.random.default_rng(1000 + n)
+    k = rng.integers(0, 23, n).astype("int64")
+    v = (rng.random(n) * 100.0).round(6)
+    q = rng.integers(-50, 50, n).astype("int32")
+    null_mask = rng.random(n) < 0.15
+    return pa.table({
+        "k": pa.array(k),
+        "q": pa.array(q, mask=null_mask),
+        "v": pa.array(v),
+        "s": pa.array([f"g{int(x) % 7}" for x in k]),
+    })
+
+
+def _run_pipeline(session, t, small):
+    df = session.create_dataframe(t)
+    dim = session.create_dataframe(small)
+    out = (df.where(F.col("v") > F.lit(5.0))
+             .join(dim, on="k", how="inner")
+             .group_by("s")
+             .agg(F.count_star().alias("n"),
+                  F.sum(F.col("q")).alias("sq"),
+                  F.sum(F.col("v") * F.col("w")).alias("sv"))
+             .sort("s"))
+    return out.collect()
+
+
+def _rows_match(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert len(g) == len(w)
+        for a, b in zip(g, w):
+            if a is None or b is None:
+                assert a is b, (g, w)  # null masks byte-identical
+            elif isinstance(a, float):
+                assert a == pytest.approx(b, rel=1e-12), (g, w)
+            else:
+                assert a == b, (g, w)
+
+
+class TestBoundaryDifferential:
+    @pytest.fixture(scope="class")
+    def small(self):
+        return pa.table({"k": np.arange(23, dtype="int64"),
+                         "w": np.linspace(0.5, 2.0, 23)})
+
+    def _boundaries(self):
+        """Row counts straddling 3 adjacent geometric rungs: exact fit,
+        one past (spills to the next rung), and rung max."""
+        lad = BucketLadder(GEO["spark.rapids.tpu.warmstore.bucket.growth"],
+                           GEO["spark.rapids.tpu.warmstore.bucket.align"])
+        r1 = lad.capacity_for(1025)          # first rung past the floor
+        r2 = lad.capacity_for(r1 + 1)
+        r3 = lad.capacity_for(r2 + 1)
+        assert r1 < r2 < r3
+        return [r1, r1 + 1, r2, r2 + 1, r3]
+
+    def test_boundary_rows_oracle_exact(self, session, small, geo_ladder):
+        for n in self._boundaries():
+            t = _table(n)
+            for k in GEO:
+                TpuConf.unset_session(k)
+            want = _run_pipeline(session, t, small)  # legacy ladder
+            for k, v in GEO.items():
+                TpuConf.set_session(k, v)
+            got = _run_pipeline(session, t, small)   # geometric ladder
+            _rows_match(got, want)
+
+    def test_empty_result_oracle_exact(self, session, small, geo_ladder):
+        t = _table(1337)
+        df = session.create_dataframe(t)
+        out = (df.where(F.col("v") > F.lit(1e9))
+                 .group_by("s").agg(F.count_star().alias("n"))
+                 .collect())
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# The full TPC-H suite under the dense ladder: every query stays within
+# oracle tolerance (padding is invisible behind the validity masks).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_db(session, tmp_path_factory):
+    from spark_rapids_tpu.models import tpch_suite
+    out = str(tmp_path_factory.mktemp("tpch_bucketed"))
+    dfs = tpch_suite.load_db(session, 0.002, out)
+    pds = tpch_suite.load_pdb(0.002, out)
+    return dfs, pds
+
+
+@pytest.mark.parametrize("name", [f"q{i}" for i in range(1, 23)])
+def test_tpch_geometric_ladder_differential(tpch_db, name):
+    from spark_rapids_tpu.models import tpch_suite
+    dfs, pds = tpch_db
+    for k, v in GEO.items():
+        TpuConf.set_session(k, v)
+    runner, oracle = tpch_suite.QUERIES[name]
+    got = runner(dfs)
+    want = oracle(pds)
+    err = tpch_suite.rows_rel_err(got, want)
+    assert err < 1e-6, f"{name}: rel_err={err} ({len(got)} rows)"

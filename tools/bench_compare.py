@@ -13,7 +13,8 @@ Accepted file shapes (auto-detected):
 Usage:
   python tools/bench_compare.py OLD.json NEW.json \
       [--max-query-regress-pct 20] [--max-agg-regress-pct 5] \
-      [--max-sync-increase 0] [--max-compile-increase 0]
+      [--max-sync-increase 0] [--max-compile-increase 0] \
+      [--max-cold-seconds 0]
 
 Exit codes: 0 = no regression, 1 = regression found, 2 = usage/parse
 error.  A query that completed in OLD but errored/vanished in NEW is a
@@ -83,12 +84,41 @@ def query_compiles(agg: dict) -> Dict[str, Optional[float]]:
     return out
 
 
+def query_cold_compile_s(agg: dict) -> Dict[str, Optional[float]]:
+    """{query: cold compile seconds} where the aggregate has one."""
+    out: Dict[str, Optional[float]] = {}
+    for k, v in agg.items():
+        if isinstance(v, dict) and "compile_s_cold" in v:
+            out[k] = float(v["compile_s_cold"])
+    return out
+
+
 def compare(old: dict, new: dict, max_query_pct: float,
             max_agg_pct: float, max_sync_increase: float = 0.0,
-            max_compile_increase: float = 0.0) -> Tuple[list, list]:
+            max_compile_increase: float = 0.0,
+            max_cold_seconds: float = 0.0) -> Tuple[list, list]:
     """Return (regressions, notes) as printable strings."""
     regressions, notes = [], []
     old_q, new_q = query_times(old), query_times(new)
+
+    # cold-vs-warm compile seconds (the warm-start subsystem's CI
+    # teeth): the cold pass is where a restart pays — a per-query
+    # cold-compile budget turns "the fleet restarts cold" from a pager
+    # into a failed gate.  First-class column either way; a gate only
+    # when --max-cold-seconds is set
+    old_k, new_k = query_cold_compile_s(old), query_cold_compile_s(new)
+    for q in sorted(set(old_k) | set(new_k)):
+        o, n = old_k.get(q), new_k.get(q)
+        if o is not None and n is not None:
+            notes.append(
+                f"{q}: compile_s_cold {o:.3f} -> {n:.3f}"
+                + (f"  (warm compiles {query_compiles(new).get(q, 0):g})"
+                   if q in query_compiles(new) else ""))
+        if max_cold_seconds > 0 and n is not None \
+                and n > max_cold_seconds:
+            regressions.append(
+                f"{q}: compile_s_cold {n:.3f}s  "
+                f"[> --max-cold-seconds {max_cold_seconds:g}]")
 
     # sync-count guard (region fusion's latency contract): each blocking
     # device→host fetch costs a full round trip on the tunneled chip, so
@@ -170,6 +200,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-compile-increase", type=float, default=0.0,
                    help="per-query warm compile count increase "
                         "tolerated (absolute compiles; default 0)")
+    p.add_argument("--max-cold-seconds", type=float, default=0.0,
+                   help="per-query COLD compile-seconds budget in NEW "
+                        "(0 = report only, no gate)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="print regressions only")
     args = p.parse_args(argv)
@@ -182,7 +215,8 @@ def main(argv=None) -> int:
     regressions, notes = compare(old, new, args.max_query_regress_pct,
                                  args.max_agg_regress_pct,
                                  args.max_sync_increase,
-                                 args.max_compile_increase)
+                                 args.max_compile_increase,
+                                 args.max_cold_seconds)
     if not args.quiet:
         for line in notes:
             print("  " + line)

@@ -50,6 +50,8 @@ Usage::
     python tools/loadgen.py --soak [--soak-duration-s 60] [--doors 2]
     python tools/loadgen.py --overload [--overload-duration-s 24]
         [--overload-steps 1,2,3.5,5] [--admission-off]
+    python tools/loadgen.py --restart-probe [--max-restart-p95-ratio 1.2]
+        [--prewarm-wait-s 15] [--no-warmstore]
 
 Environment fallbacks (the bench hooks): SRT_LOADGEN_QUERIES,
 SRT_LOADGEN_CONNECTIONS, SRT_LOADGEN_FAULT_RATE, SRT_LOADGEN_SEED,
@@ -1876,6 +1878,170 @@ def run_overload(args) -> dict:
     return report
 
 
+def run_restart_probe(args) -> dict:
+    """Warm-restart differential (``--restart-probe``): the CI shape of
+    the warm-start subsystem's acceptance.
+
+    Two doors, one workload.  Pre phase: sustained load, p95 recorded.
+    Then door 0 gracefully drains (shipping its warmstore index to the
+    sibling over REQ_WARM) and "restarts": the probe drops every
+    compiled stage program and re-loads the store from disk exactly as
+    a fresh process would, primes the compile ledger with the old
+    life's fingerprints, and waits for the new door's prewarm lane.
+    Post phase: the same load again.
+
+    The gate: post-restart p95 <= --max-restart-p95-ratio x pre p95,
+    and ZERO post-phase compiles classified ``unattributed`` or
+    ``post_restart`` (every one must be the warm path working:
+    ``store_hit`` / ``prewarm`` / an honestly-new ``first_seen``).
+    """
+    import tempfile
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.plan import physical
+    from spark_rapids_tpu.runtime import warmstore
+    from spark_rapids_tpu.server import SqlFrontDoor
+    from spark_rapids_tpu.utils import recorder as rec
+    from spark_rapids_tpu.utils import telemetry
+
+    sess = srt.Session.get_or_create()
+    sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 50_000)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.maxConcurrent", 4)
+    sess.conf.set("spark.rapids.tpu.sql.scheduler.queueDepth", 256)
+    sess.conf.set("spark.rapids.tpu.sql.cache.enabled", True)
+    store_dir = args.warmstore_dir or tempfile.mkdtemp(
+        prefix="srt_restart_probe_")
+    sess.conf.set("spark.rapids.tpu.warmstore.enabled",
+                  not args.no_warmstore)
+    sess.conf.set("spark.rapids.tpu.warmstore.dir", store_dir)
+
+    orders, customers = build_tables(args.rows, args.seed)
+    tables = {"orders": lambda: sess.create_dataframe(orders),
+              "customers": lambda: sess.create_dataframe(customers)}
+    oracle = Oracle(sess, tables) if not args.no_verify else None
+
+    ports = [_free_port(), _free_port()]
+    addrs = [("127.0.0.1", p) for p in ports]
+
+    def start_door(port: int) -> "SqlFrontDoor":
+        door = SqlFrontDoor(sess, settings={
+            "spark.rapids.tpu.server.port": port,
+            "spark.rapids.tpu.server.tenantQuotas": args.tenant_quotas,
+            "spark.rapids.tpu.server.spool.memoryBytes": 1 << 20,
+        }).start()
+        for name, factory in tables.items():
+            door.register_table(name, factory)
+        return door
+
+    doors = [start_door(p) for p in ports]
+
+    def phase(n_queries: int) -> Counters:
+        ctr = Counters()
+        remaining = [n_queries]
+        lock = threading.Lock()
+
+        def next_q():
+            with lock:
+                if remaining[0] <= 0:
+                    return None
+                remaining[0] -= 1
+                return remaining[0]
+
+        stop = threading.Event()
+        threads = []
+        for i in range(args.connections):
+            th = threading.Thread(
+                target=_worker,
+                args=(i, addrs, f"tenant-{1 + i % args.tenants}",
+                      n_queries, args.seed, args.prepared_frac, False,
+                      ctr, oracle, next_q, stop),
+                daemon=True, name=f"probe-{i}")
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=args.timeout)
+        stop.set()
+        return ctr
+
+    def trigger_totals() -> Dict[str, float]:
+        return _tm_by_label(telemetry.snapshot(),
+                            "compiles_by_trigger_total")
+
+    n_phase = max(args.connections, args.queries // 2)
+    t_start = _pc()
+    pre = phase(n_phase)
+    with pre.lock:
+        pre_vals = [e[2] for e in pre.latencies]
+        pre_mism = pre.mismatches
+
+    # -- the restart ----------------------------------------------------------
+    conf = sess._tpu_conf()
+    old_fps = []
+    st = warmstore.store()
+    if st is not None:
+        old_fps = st.fingerprints()
+    drain_rep = doors[0].drain(deadline_s=args.drain_deadline_s,
+                               siblings=[addrs[1]], linger_s=0.5)
+    shipped = drain_rep.get("warm_entries_shipped", 0)
+    # simulate process death: compiled programs gone, ledger primed
+    # with the old life's fingerprints (without the store these would
+    # classify post_restart — the storm), store re-loaded from disk
+    physical.clear_program_cache()
+    rec.compile_prime(old_fps)
+    warmstore.simulate_restart(conf)
+    doors[0] = start_door(ports[0])
+    # let the new door's prewarm lane run (bounded — prewarm must not
+    # need longer than its own budget)
+    deadline = _pc() + args.prewarm_wait_s
+    last = -1
+    while _pc() < deadline:
+        snap = warmstore.snapshot() or {}
+        n = snap.get("prewarmed", 0)
+        if n == last and n > 0:
+            break
+        last = n
+        time.sleep(0.2)
+    trig0 = trigger_totals()
+
+    post = phase(n_phase)
+    with post.lock:
+        post_vals = [e[2] for e in post.latencies]
+        post_mism = post.mismatches
+    trig1 = trigger_totals()
+    post_trig = {k: trig1.get(k, 0) - trig0.get(k, 0)
+                 for k in set(trig0) | set(trig1)
+                 if trig1.get(k, 0) - trig0.get(k, 0) > 0}
+
+    for d in doors:
+        d.close()
+
+    pre_p95 = _pct(pre_vals, 0.95)
+    post_p95 = _pct(post_vals, 0.95)
+    ratio = post_p95 / pre_p95 if pre_p95 > 0 else 0.0
+    ws = warmstore.snapshot() or {}
+    return {
+        "restart_probe": 1,
+        "warmstore_enabled": not args.no_warmstore,
+        "wall_s": round(_pc() - t_start, 2),
+        "queries_pre": len(pre_vals),
+        "queries_post": len(post_vals),
+        "mismatches": pre_mism + post_mism,
+        "pre_p95_ms": round(pre_p95, 2),
+        "post_p95_ms": round(post_p95, 2),
+        "p95_ratio": round(ratio, 3),
+        "max_restart_p95_ratio": args.max_restart_p95_ratio,
+        "warm_entries_shipped": shipped,
+        "prewarmed": ws.get("prewarmed", 0),
+        "store_entries": ws.get("entries", 0),
+        "post_triggers": {k: round(v, 1)
+                          for k, v in sorted(post_trig.items())},
+        "post_restart_compiles": round(post_trig.get("post_restart", 0),
+                                       1),
+        "unattributed_compiles": round(post_trig.get("unattributed", 0),
+                                       1),
+    }
+
+
 def main(argv=None) -> int:
     env = os.environ
     ap = argparse.ArgumentParser(description=__doc__)
@@ -1925,7 +2091,39 @@ def main(argv=None) -> int:
     ap.add_argument("--admission-off", action="store_true",
                     help="A/B kill switch: run the overload ramp with "
                          "admission.enabled=false (static permits)")
+    # restart-probe mode (warm-start subsystem): drain+restart one
+    # door mid-run, gate on post-restart p95 and compile attribution
+    ap.add_argument("--restart-probe", action="store_true")
+    ap.add_argument("--max-restart-p95-ratio", type=float, default=1.2)
+    ap.add_argument("--prewarm-wait-s", type=float, default=15.0)
+    ap.add_argument("--warmstore-dir", default="")
+    ap.add_argument("--no-warmstore", action="store_true",
+                    help="A/B kill switch: run the restart probe with "
+                         "the compile store disabled (the cold path)")
     args = ap.parse_args(argv)
+
+    if args.restart_probe:
+        report = run_restart_probe(args)
+        line = json.dumps(report, sort_keys=True)
+        print(line)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(line + "\n")
+        ok = (report["mismatches"] == 0
+              and report["p95_ratio"] <= args.max_restart_p95_ratio
+              and report["post_restart_compiles"] == 0
+              and report["unattributed_compiles"] == 0)
+        print(f"[loadgen] RESTART-PROBE p95 {report['pre_p95_ms']}ms -> "
+              f"{report['post_p95_ms']}ms "
+              f"(ratio {report['p95_ratio']}, max "
+              f"{args.max_restart_p95_ratio})  "
+              f"shipped={report['warm_entries_shipped']} "
+              f"prewarmed={report['prewarmed']}  "
+              f"post_triggers={report['post_triggers'] or 'none'}  "
+              f"post_restart={report['post_restart_compiles']} "
+              f"unattributed={report['unattributed_compiles']}  "
+              f"mismatches={report['mismatches']}", file=sys.stderr)
+        return 0 if ok else 1
 
     if args.poison:
         report = run_poison(args)

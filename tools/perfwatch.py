@@ -14,7 +14,15 @@ Record shapes (auto-detected from the run file):
     ``engine_s`` / ``syncs_warm`` / ``compiles_warm`` plus the
     aggregate geomean land in the ledger entry;
   * a ``loadgen.py`` report (``"loadgen": 1``): p50/p95/p99, qps,
-    typed errors, and SLO violations land in the ledger entry.
+    typed errors, and SLO violations land in the ledger entry;
+  * a ``loadgen.py --restart-probe`` report (``"restart_probe": 1``):
+    pre/post-restart p95, the p95 ratio, shipped/prewarmed counts, and
+    the post-phase compile-trigger attribution.  The restart-warmth
+    gate is ABSOLUTE (it runs even with no baseline): the run fails
+    when post-restart p95 exceeds ``--max-restart-p95-ratio`` x the
+    pre-restart p95, or when any post-restart compile classified
+    ``post_restart`` / ``unattributed`` (warmth must be attributable —
+    ``store_hit`` / ``prewarm`` / honestly-new ``first_seen``).
 
 Usage:
   python tools/perfwatch.py record LEDGER.jsonl RUN.json [--label L]
@@ -80,6 +88,26 @@ def load_run(path: str, label: str = "") -> dict:
     """Normalize one run file into a ledger entry."""
     with open(path) as f:
         raw = json.load(f)
+    if isinstance(raw, dict) and raw.get("restart_probe") == 1:
+        return {
+            "kind": "restart_probe",
+            "label": label,
+            "t_wall": time.time(),
+            "source": path,
+            "pre_p95_ms": float(raw.get("pre_p95_ms", 0.0)),
+            "post_p95_ms": float(raw.get("post_p95_ms", 0.0)),
+            "p95_ratio": float(raw.get("p95_ratio", 0.0)),
+            "warm_entries_shipped": int(
+                raw.get("warm_entries_shipped", 0)),
+            "prewarmed": int(raw.get("prewarmed", 0)),
+            "post_restart_compiles": float(
+                raw.get("post_restart_compiles", 0)),
+            "unattributed_compiles": float(
+                raw.get("unattributed_compiles", 0)),
+            "mismatches": int(raw.get("mismatches", 0)),
+            "warmstore_enabled": bool(raw.get("warmstore_enabled",
+                                              True)),
+        }
     if isinstance(raw, dict) and raw.get("loadgen") == 1:
         return {
             "kind": "loadgen",
@@ -135,7 +163,9 @@ def pick_baseline(history: List[dict], kind: str, label: str,
         return None
     if mode == "last":
         return cands[-1]
-    if kind == "loadgen":
+    if kind == "restart_probe":
+        key = lambda e: e.get("p95_ratio", 0.0)  # noqa: E731
+    elif kind == "loadgen":
         key = lambda e: e.get("p95_ms", 0.0)  # noqa: E731
     else:
         key = lambda e: -e.get("agg_value", 0.0)  # noqa: E731
@@ -145,8 +175,37 @@ def pick_baseline(history: List[dict], kind: str, label: str,
     return ranked[len(ranked) // 2]  # median
 
 
+def gate_restart_probe(entry: dict, args) -> List[str]:
+    """The restart-warmth gate — absolute, baseline-free: a restart
+    must come back warm on its own terms, not merely no colder than
+    the last cold restart."""
+    regressions = []
+    ratio = entry.get("p95_ratio", 0.0)
+    if ratio > args.max_restart_p95_ratio:
+        regressions.append(
+            f"restart p95 ratio {ratio:g} "
+            f"(pre {entry.get('pre_p95_ms'):g}ms -> post "
+            f"{entry.get('post_p95_ms'):g}ms)  "
+            f"[> {args.max_restart_p95_ratio:g}x]")
+    if entry.get("post_restart_compiles", 0) > 0:
+        regressions.append(
+            f"{entry['post_restart_compiles']:g} post-restart "
+            f"compile(s) classified post_restart "
+            f"[the store/prewarm path missed them]")
+    if entry.get("unattributed_compiles", 0) > 0:
+        regressions.append(
+            f"{entry['unattributed_compiles']:g} post-restart "
+            f"compile(s) unattributed [no statement identity]")
+    if entry.get("mismatches", 0) > 0:
+        regressions.append(
+            f"{entry['mismatches']} result mismatch(es) in the probe")
+    return regressions
+
+
 def gate(entry: dict, base: dict, args) -> List[str]:
     """Return regression strings (empty = clean)."""
+    if entry["kind"] == "restart_probe":
+        return gate_restart_probe(entry, args)
     if entry["kind"] == "bench":
         regressions, _notes = bench_compare.compare(
             _entry_aggregate(base), _entry_aggregate(entry),
@@ -196,6 +255,9 @@ def main(argv=None) -> int:
                    default=25.0)
     p.add_argument("--max-slo-violation-increase", type=float,
                    default=0.0)
+    p.add_argument("--max-restart-p95-ratio", type=float, default=1.2,
+                   help="restart probe: post/pre p95 ceiling "
+                        "(absolute gate, no baseline needed)")
     p.add_argument("--record", action="store_true",
                    help="with check: append the run after gating")
     args = p.parse_args(argv)
@@ -206,7 +268,14 @@ def main(argv=None) -> int:
             history = [e for e in history
                        if e.get("label", "") == args.label]
         for e in history:
-            if e.get("kind") == "loadgen":
+            if e.get("kind") == "restart_probe":
+                print(f"restart_probe {e.get('label', '')} "
+                      f"ratio={e.get('p95_ratio')} "
+                      f"shipped={e.get('warm_entries_shipped')} "
+                      f"prewarmed={e.get('prewarmed')} "
+                      f"post_restart={e.get('post_restart_compiles')} "
+                      f"({e.get('source', '')})")
+            elif e.get("kind") == "loadgen":
                 print(f"loadgen {e.get('label', '')} "
                       f"p95={e.get('p95_ms')}ms "
                       f"qps={e.get('throughput_qps')} "
@@ -241,13 +310,16 @@ def main(argv=None) -> int:
                          args.baseline)
     if args.record:
         append_ledger(args.ledger, entry)
-    if base is None:
+    if base is None and entry["kind"] != "restart_probe":
+        # restart_probe gates are absolute — they run even on an
+        # empty ledger; everything else needs a prior run to diff.
         print("perfwatch: no baseline in the ledger yet — recorded "
               "run accepted as the first of its stream"
               if args.record else
               "perfwatch: no baseline in the ledger yet (use record)")
         return 0
-    regressions = gate(entry, base, args)
+    regressions = gate(entry, base if base is not None else entry,
+                       args)
     if regressions:
         print(f"perfwatch: {len(regressions)} regression(s) vs "
               f"{args.baseline} baseline ({base.get('source', '?')}):",
