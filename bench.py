@@ -54,6 +54,13 @@ server.conn connection drops, disk spooling, oracle verification, and
 p50/p95/p99 + SLO-violation reporting; SRT_LOADGEN_QUERIES /
 SRT_LOADGEN_CONNECTIONS / SRT_LOADGEN_FAULT_RATE / SRT_LOADGEN_SEED
 parameterize it, and SRT_BENCH_QUERIES="" makes the run loadgen-only),
+SRT_BENCH_FUZZ=1 (hostile-input survival drill: the seeded wire/spec
+fuzzer — tools/fuzzwire.py — against a live door with an oracle-verified
+healthy-traffic sidecar, emitted as a fuzz_survival JSON line gated
+absolutely by tools/perfwatch.py: zero crashes/hangs/untyped
+rejections/leaks and sidecar goodput >= 0.9x the fuzz-free baseline;
+SRT_FUZZ_CASES / SRT_FUZZ_SEED parameterize it, and
+SRT_BENCH_QUERIES="" makes the run fuzz-only),
 SRT_BENCH_SOAK=1 (zero-downtime drill: a short scripted rolling-restart
 soak via tools/loadgen.py --soak — a 2-door front-door fleet under
 sustained zipf load, each door gracefully drained (GOAWAY naming its
@@ -834,6 +841,16 @@ def main() -> None:
         print(json.dumps(_loadgen_drill()), flush=True)
         if os.environ.get("SRT_BENCH_QUERIES", None) == "":
             return  # loadgen-only invocation
+    if os.environ.get("SRT_BENCH_FUZZ", "0") == "1":
+        # hostile-input survival drill: the seeded wire/spec fuzzer
+        # (tools/fuzzwire.py) against a live door with a healthy-
+        # traffic sidecar — emitted as a fuzz_survival JSON line whose
+        # absolute perfwatch gate needs no baseline (zero crashes /
+        # hangs / untyped rejections / leaks, goodput >= 0.9x).
+        # SRT_FUZZ_CASES / SRT_FUZZ_SEED parameterize it.
+        print(json.dumps(_fuzz_drill()), flush=True)
+        if os.environ.get("SRT_BENCH_QUERIES", None) == "":
+            return  # fuzz-only invocation
     if conc > 1:
         # concurrency mode defaults to the TPC-H suite (the service
         # replay the scheduler was built for); SRT_BENCH_QUERIES narrows
@@ -895,6 +912,7 @@ def _run_isolated(sf: float, iters: int, which) -> None:
         env.pop("SRT_BENCH_OVERLOAD", None)   # ditto the overload drill
         env.pop("SRT_BENCH_POISON", None)     # ditto the poison drill
         env.pop("SRT_BENCH_PARTITION", None)  # ditto the partition drill
+        env.pop("SRT_BENCH_FUZZ", None)       # ditto the fuzz drill
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
@@ -1054,6 +1072,32 @@ def _loadgen_drill() -> dict:
     finally:
         # loadgen tuned session confs (batch size, cache) for the wire
         # workload: a fresh session keeps the suite numbers untainted
+        import spark_rapids_tpu as _srt
+        _srt.Session.reset()
+
+
+def _fuzz_drill() -> dict:
+    """Run the hostile-input fuzzer in-process and return its
+    ``fuzz_survival`` report (frames + specs against a live door, with
+    the oracle-verified healthy-traffic sidecar measuring goodput)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import argparse
+
+    import fuzzwire as _fw
+    args = argparse.Namespace(
+        cases=int(os.environ.get("SRT_FUZZ_CASES", "600")),
+        seed=int(os.environ.get("SRT_FUZZ_SEED", "20260807")),
+        rows=20_000, attackers=4, case_timeout=6.0,
+        sidecar_connections=2, baseline_s=3.0,
+        corpus_dir=None, replay=None, out=None)
+    try:
+        rep = _fw.run_fuzz(args)
+        rep["metric"] = "fuzz_survival"
+        return rep
+    finally:
+        # the fuzz door tuned session confs for the wire workload: a
+        # fresh session keeps the suite numbers untainted
         import spark_rapids_tpu as _srt
         _srt.Session.reset()
 

@@ -1256,6 +1256,138 @@ SERVER_SLO_WINDOWS = register(
     "fast-burn/slow-burn alerting pair). Each window exports one "
     "slo_burn_rate{tenant,window} gauge.")
 
+SERVER_MAX_FRAME_BYTES = register(
+    "spark.rapids.tpu.server.maxFrameBytes", 256 << 20,
+    "Largest BATCH (Arrow IPC result) frame the wire protocol will "
+    "accept, enforced against the length prefix BEFORE any payload "
+    "allocation — a lying 2 GB length header is answered with a typed "
+    "BAD_REQUEST and the connection closes without ever allocating. "
+    "Result batches are device-batch sized, far below this.", conv=int,
+    check=lambda v: None if 1 <= v <= (1 << 31)
+    else "must be in [1, 2^31]")
+
+SERVER_MAX_CONTROL_FRAME_BYTES = register(
+    "spark.rapids.tpu.server.maxControlFrameBytes", 4 << 20,
+    "Largest JSON control frame (HELLO/SUBMIT/PREPARE/EXECUTE/...) the "
+    "wire protocol will accept — much smaller than maxFrameBytes, "
+    "because control payloads are small canonical JSON and a huge one "
+    "is an attack, not a query. Enforced before allocation; the "
+    "server's inbound side applies THIS cap to every frame (a client "
+    "never legitimately sends batch frames).", conv=int,
+    check=lambda v: None if 1 <= v <= (1 << 31)
+    else "must be in [1, 2^31]")
+
+SERVER_HANDSHAKE_TIMEOUT_MS = register(
+    "spark.rapids.tpu.server.handshakeTimeoutMs", 5000.0,
+    "Deadline (ms) for a fresh connection's FIRST complete frame (the "
+    "HELLO): a dialer that connects and trickles — or sends nothing — "
+    "is reaped with a typed BAD_REQUEST at this deadline instead of "
+    "holding a connection slot for idleTimeout. Distinct from (and "
+    "much shorter than) idleTimeout, which governs authenticated "
+    "connections between requests.", conv=float,
+    check=lambda v: None if v > 0 else "must be > 0")
+
+SERVER_FRAME_TIMEOUT_MS = register(
+    "spark.rapids.tpu.server.frameTimeoutMs", 10000.0,
+    "Per-frame read-progress deadline (ms): once a frame's first byte "
+    "arrives, the WHOLE frame (header + payload) must complete within "
+    "this window. The slowloris defense — a client trickling one byte "
+    "per idleTimeout makes steady per-recv progress but never finishes "
+    "a frame; this deadline reaps it typed. 0 disables (the client "
+    "side runs without it; its request timeout bounds the exchange).",
+    conv=float, check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVER_MAX_DECODE_ERRORS = register(
+    "spark.rapids.tpu.server.maxDecodeErrors", 3,
+    "Per-connection strike budget for malformed frames: each decode "
+    "failure the stream can resync past (unknown frame type, crc "
+    "mismatch) is answered with a typed BAD_REQUEST and counted; a "
+    "connection burning the budget is disconnected and its peer "
+    "address enters the dial-refusal penalty box "
+    "(server.penaltyBoxMs). Non-resyncable failures (an oversized "
+    "length prefix, a mid-frame stall) disconnect on the first "
+    "strike — the declared payload boundary cannot be trusted.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_PENALTY_BOX_MS = register(
+    "spark.rapids.tpu.server.penaltyBoxMs", 2000.0,
+    "Dial-refusal window (ms) for a peer address whose connection "
+    "burned its decode-error strike budget: new dials from that "
+    "address are answered with a typed REJECTED (reason penalty_box, "
+    "retry_after_ms = the remaining window) and closed before a "
+    "handler thread is spent on them. Deliberately SHORT — on a "
+    "loopback dev fleet every client shares one address, so the box "
+    "is a storm brake, not a ban. 0 disables.", conv=float,
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVER_MAX_INFLIGHT_PER_CONN = register(
+    "spark.rapids.tpu.server.maxInflightPerConn", 8,
+    "Cap on wire queries one connection may hold in the in-flight "
+    "registry at once, shed typed REJECTED (reason conn_inflight) "
+    "beyond it. The protocol is sequential request->response today, "
+    "so a well-formed client never sees this; it bounds the blast "
+    "radius of any future pipelining bug or a hostile client racing "
+    "the registry.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_SPEC_MAX_DEPTH = register(
+    "spark.rapids.tpu.server.spec.maxDepth", 32,
+    "Deepest nesting (expression trees included) a wire query spec may "
+    "carry. Validated ITERATIVELY ahead of compile (server/spec.py "
+    "validate_spec), so a recursion-bomb spec is answered with a typed "
+    "BAD_REQUEST and the planner never recurses past the cap.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_SPEC_MAX_NODES = register(
+    "spark.rapids.tpu.server.spec.maxNodes", 10000,
+    "Total JSON nodes (objects, lists, scalars) a wire query spec may "
+    "carry — the width-bomb bound paired with spec.maxDepth's depth "
+    "bound. Typed BAD_REQUEST beyond it.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_SPEC_MAX_OPS = register(
+    "spark.rapids.tpu.server.spec.maxOps", 64,
+    "Longest op pipeline a wire query spec may carry. Typed "
+    "BAD_REQUEST beyond it.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_SPEC_MAX_PARAMS = register(
+    "spark.rapids.tpu.server.spec.maxParams", 64,
+    "Most parameter slots a wire query spec may declare; param INDICES "
+    "are bounded by the same cap (indices must be contiguous from 0), "
+    "so a spec declaring ['param', 10^9, ...] is rejected typed "
+    "instead of driving a billion-element contiguity check.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_SPEC_MAX_STRING_BYTES = register(
+    "spark.rapids.tpu.server.spec.maxStringBytes", 65536,
+    "Total UTF-8 bytes of string values (literals, names, op fields) a "
+    "wire query spec may carry. Typed BAD_REQUEST beyond it.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_SPEC_MAX_JOINS = register(
+    "spark.rapids.tpu.server.spec.maxJoins", 8,
+    "Most join ops one wire query spec may carry (join fan-in): each "
+    "join multiplies planning and execution cost, so the resource-bomb "
+    "bound is separate from — and much smaller than — spec.maxOps.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVER_OPS_MAX_REQUEST_BYTES = register(
+    "spark.rapids.tpu.server.ops.maxRequestBytes", 16384,
+    "Byte cap on an ops-listener HTTP request head (request line + "
+    "headers): a scrape request larger than this is dropped and the "
+    "connection closed (ops_requests_rejected_total{reason=oversize}) "
+    "— the ops surface serves tiny GETs, anything bigger is hostile.",
+    conv=int, check=lambda v: None if v >= 256 else "must be >= 256")
+
+SERVER_OPS_REQUEST_TIMEOUT_MS = register(
+    "spark.rapids.tpu.server.ops.requestTimeoutMs", 10000.0,
+    "Wall deadline (ms) for reading one ops-listener HTTP request head "
+    "AND the per-recv socket timeout on its connection: a scraper "
+    "trickling header bytes is reaped here instead of pinning an ops "
+    "handler thread (ops_requests_rejected_total{reason=slow}).",
+    conv=float, check=lambda v: None if v > 0 else "must be > 0")
+
 SERVER_DRAIN_SIBLINGS = register(
     "spark.rapids.tpu.server.drain.siblings", "",
     "Comma list of 'host:port' sibling front doors advertised in the "

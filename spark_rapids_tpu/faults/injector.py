@@ -63,6 +63,13 @@ __all__ = ["POINTS", "InjectedFault", "FaultInjector", "INJECTOR"]
 #     unwinds through the real disconnect path — cooperative cancel,
 #     permit + quota + spool release; the leak-hygiene and loadgen
 #     suites assert zero residue).
+#   * ``server.malformed`` — hostile input at the front door's recv
+#     path (server/endpoint.py consults maybe_fire after each request
+#     frame decodes and ACTS the corruption out: the frame is treated
+#     as a resyncable decode failure, driving the strike-budget
+#     machinery — typed BAD_REQUEST, strike counted, connection
+#     disconnected when the budget burns — so hostile input composes
+#     with peer kills and partitions in the chaos differential);
 #   * ``dcn.coordinator_kill`` — like ``dcn.peer_kill`` but the rank
 #     that dies is HOSTING the coordinator: silent mode freezes the
 #     coordinator too (control requests are received and never
@@ -84,7 +91,7 @@ POINTS = ("io.read", "io.write", "shuffle.fragment", "dcn.heartbeat",
           "device.op", "cache.lookup", "dcn.peer_kill",
           "shuffle.corrupt", "spill.corrupt", "cache.corrupt",
           "device.hang", "dcn.slow_peer", "server.conn",
-          "dcn.coordinator_kill",
+          "server.malformed", "dcn.coordinator_kill",
           "dcn.partition", "dcn.net.dup", "dcn.net.reorder")
 
 

@@ -127,6 +127,11 @@ class ResultSet:
 class WireClient:
     """One connection to a :class:`..server.endpoint.SqlFrontDoor`."""
 
+    # class-level default: harness code that hand-builds a client
+    # around a crafted frame source (object.__new__) skips __init__;
+    # None means the legacy unbounded recv path
+    _limits: Optional[P.FrameLimits] = None
+
     def __init__(self, host: str, port: int, tenant: str = "default",
                  token: str = "", weight: float = 1.0,
                  timeout: float = 120.0,
@@ -173,6 +178,13 @@ class WireClient:
         self.error_frames: Dict[str, int] = {}
         self.shed_reasons: Dict[str, int] = {}
         self.session_id: Optional[str] = None
+        # receive-side frame bounds: BATCH frames (real results) keep
+        # the protocol-wide cap, control frames get a small one — a
+        # lying server length prefix cannot make THIS side allocate
+        # gigabytes either.  No frame deadline: the socket timeout
+        # bounds the whole exchange client-side.
+        self._limits = P.FrameLimits(max_control_bytes=64 << 20,
+                                     batch_types=(P.RSP_BATCH,))
         self._sock: Optional[socket.socket] = None
         self._connect(self.addr)
 
@@ -187,7 +199,8 @@ class WireClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             P.send_frame(sock, P.REQ_HELLO, P.pack_json(self._hello))
-            _, payload = P.recv_frame(sock, expect=(P.RSP_WELCOME,))
+            _, payload = P.recv_frame(sock, expect=(P.RSP_WELCOME,),
+                                      limits=self._limits)
         except (OSError, WireError, P.ProtocolError):
             try:
                 sock.close()
@@ -273,7 +286,8 @@ class WireClient:
         totals reconcile EXACTLY with the server's
         ``server_wire_errors_total`` counter."""
         try:
-            return P.recv_frame(self._sock, expect=expect)
+            return P.recv_frame(self._sock, expect=expect,
+                                limits=self._limits)
         except ServerDraining:
             raise
         except WireError as e:

@@ -31,8 +31,10 @@ from . import protocol as P
 from ..utils import telemetry
 from .prepared import PreparedCache
 from .protocol import WireError
-from .session import ClientSession, TenantQuotas, authenticate
-from .spec import BadSpec, coerce_params, compile_spec
+from .session import (ClientSession, PenaltyBox, TenantQuotas,
+                      authenticate)
+from .spec import (BadSpec, SpecLimits, coerce_params, compile_spec,
+                   validate_spec)
 from .spool import ResultStream, gc_orphan_spools
 
 __all__ = ["SqlFrontDoor"]
@@ -79,6 +81,8 @@ class SqlFrontDoor:
         self.prepared = PreparedCache()
         self.quotas = TenantQuotas(
             conf["spark.rapids.tpu.server.tenantQuotas"])
+        self.penalty_box = PenaltyBox(
+            conf["spark.rapids.tpu.server.penaltyBoxMs"] / 1000.0)
         self._lock = threading.Lock()
         self._queries: Dict[str, _WireQuery] = {}
         self._conns: Dict[int, socket.socket] = {}
@@ -108,6 +112,12 @@ class SqlFrontDoor:
         self.streamed_bytes = 0
         self.spooled_bytes = 0
         self.goaways_sent = 0
+        # hostile-input accounting (ISSUE 20): frames that failed to
+        # decode, connections torn down for it, dials refused while the
+        # peer address sat in the penalty box
+        self.decode_errors = 0
+        self.hostile_disconnects = 0
+        self.penalty_refusals = 0
 
     # -- lifecycle ----------------------------------------------------------------
     def _conf(self):
@@ -378,6 +388,32 @@ class SqlFrontDoor:
                 continue
             except OSError:
                 return  # closed
+            boxed_s = self.penalty_box.check(addr[0])
+            if boxed_s > 0:
+                # the peer address burned a strike budget moments ago:
+                # refuse the dial typed BEFORE spending a handler
+                # thread, auth, or a session id on it
+                with self._lock:
+                    self.connections_total += 1
+                    self.penalty_refusals += 1
+                telemetry.count("server_connections_total")
+                telemetry.count("server_penalty_refusals_total")
+                try:
+                    P.send_frame(conn, P.RSP_ERROR, WireError(
+                        "REJECTED",
+                        f"address {addr[0]} in the strike-budget "
+                        f"penalty box; retry after it expires",
+                        retry_after_ms=int(boxed_s * 1000) + 1,
+                        reason="penalty_box").to_payload())
+                    telemetry.count("server_wire_errors_total",
+                                    code="REJECTED")
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             with self._lock:
                 self.connections_total += 1
                 draining = self._draining
@@ -428,14 +464,50 @@ class SqlFrontDoor:
     # -- connection handler -------------------------------------------------------
     def _handle_conn(self, cid: int, conn: socket.socket, addr) -> None:
         conf = self._conf()
-        conn.settimeout(conf["spark.rapids.tpu.server.idleTimeout"])
+        idle_s = conf["spark.rapids.tpu.server.idleTimeout"]
+        handshake_s = conf[
+            "spark.rapids.tpu.server.handshakeTimeoutMs"] / 1000.0
+        # the server's inbound caps: batch_types=() — a client never
+        # legitimately sends batch frames, so EVERY inbound frame gets
+        # the small control cap and a hostile "BATCH" request cannot
+        # shop for the big one
+        limits = P.FrameLimits.from_conf(conf)
+        max_strikes = conf["spark.rapids.tpu.server.maxDecodeErrors"]
+        strikes = 0
+        # handshake deadline: the FIRST complete frame must land within
+        # handshakeTimeoutMs — idleTimeout (much longer) only governs
+        # authenticated connections between requests
+        conn.settimeout(handshake_s)
         # request/response over small frames: Nagle + delayed-ACK turns
         # every META→BATCH→END sequence into ~40ms stalls — disable it
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         csess: Optional[ClientSession] = None
         conn_stmts: Dict[str, dict] = {}  # fingerprint -> spec (re-plan fallback)
         try:
-            ftype, payload = P.recv_frame(conn, expect=(P.REQ_HELLO,))
+            try:
+                # direct recv (not _recv_request): the server.malformed
+                # gray point only fires on authenticated traffic — the
+                # handshake path has its own no-budget teardown below
+                ftype, payload = P.recv_frame(
+                    conn, expect=(P.REQ_HELLO,), limits=limits)
+            except P.FrameDecodeError as e:
+                # any decode failure BEFORE auth tears the connection
+                # down (no strike budget for strangers) — typed, so
+                # even a fuzzer learns why
+                self._note_decode_error(e.kind)
+                self._hostile_disconnect(
+                    conn, addr[0], "slow" if e.kind == "slow"
+                    else "handshake", str(e))
+                return
+            except socket.timeout:
+                # no frame even BEGAN within the handshake deadline —
+                # the classic slowloris dial: connect and say nothing
+                self._note_decode_error("handshake")
+                self._hostile_disconnect(
+                    conn, addr[0], "handshake",
+                    f"no HELLO within handshakeTimeoutMs "
+                    f"({conf['spark.rapids.tpu.server.handshakeTimeoutMs']:g}ms)")
+                return
             hello = P.unpack_json(payload)
             authenticate(conf, hello.get("token", ""))
             csess = ClientSession(tenant=hello.get("tenant", "default"),
@@ -444,8 +516,41 @@ class SqlFrontDoor:
             P.send_frame(conn, P.RSP_WELCOME, P.pack_json(
                 {"session_id": csess.session_id, "tenant": csess.tenant,
                  "protocol": 1}))
+            conn.settimeout(idle_s)  # handshake done: ambient is idle
             while not self._closed:
-                ftype, payload = P.recv_frame(conn)
+                try:
+                    ftype, payload = self._recv_request(conn, limits)
+                except P.FrameDecodeError as e:
+                    self._note_decode_error(e.kind)
+                    strikes += 1
+                    if not e.resumable:
+                        # the declared payload boundary cannot be
+                        # trusted (oversize length prefix, mid-frame
+                        # stall): no resync is possible — typed answer,
+                        # then disconnect
+                        self._hostile_disconnect(
+                            conn, addr[0],
+                            "slow" if e.kind == "slow" else "oversize",
+                            str(e))
+                        return
+                    if strikes >= max_strikes:
+                        # budget burned: disconnect AND penalty-box the
+                        # address so the immediate re-dial meets a
+                        # typed refusal at accept
+                        self._hostile_disconnect(
+                            conn, addr[0], "strikes",
+                            f"{strikes} malformed frames "
+                            f"(maxDecodeErrors={max_strikes}): {e}",
+                            box=True)
+                        return
+                    # in-budget malformed frame: typed BAD_REQUEST,
+                    # connection survives (the stream resynced at a
+                    # frame boundary)
+                    self._try_error(conn, WireError(
+                        "BAD_REQUEST", str(e),
+                        detail=f"strike {strikes}/{max_strikes}",
+                        reason="malformed"))
+                    continue
                 if ftype == P.REQ_BYE:
                     P.send_frame(conn, P.RSP_BYE)
                     return
@@ -558,6 +663,53 @@ class SqlFrontDoor:
         except OSError:
             pass
 
+    def _recv_request(self, conn, limits):
+        """One request frame under the hostile-input contract
+        (:class:`.protocol.FrameLimits`), with the ``server.malformed``
+        gray injection point at the decode boundary: a fired point
+        turns the (well-formed) frame into a resyncable decode failure,
+        driving the REAL strike-budget machinery — typed BAD_REQUEST,
+        strike counted, disconnect + penalty box when the budget burns
+        — so hostile input composes with every other chaos point."""
+        ftype, payload = P.recv_frame(conn, limits=limits)
+        if ftype not in P._REQUEST_TYPES:
+            # type confusion: a RESPONSE frame arriving at the server
+            # is hostile input, not a protocol state error — it burns
+            # a strike like any other malformed frame
+            raise P.FrameDecodeError(
+                "type_confusion",
+                f"response frame {ftype!r} sent to server",
+                resumable=True)
+        from ..faults.injector import INJECTOR
+        if INJECTOR.maybe_fire("server.malformed",
+                               desc=f"frame {ftype!r}"):
+            raise P.FrameDecodeError(
+                "injected",
+                "server.malformed fault injected: frame corrupt on "
+                "arrival", resumable=True)
+        return ftype, payload
+
+    def _note_decode_error(self, kind: str) -> None:
+        with self._lock:
+            self.decode_errors += 1
+        telemetry.count("server_decode_errors_total", kind=kind)
+
+    def _hostile_disconnect(self, conn, host: str, reason: str,
+                            message: str, box: bool = False) -> None:
+        """Tear a connection down for hostile input: best-effort typed
+        BAD_REQUEST (every rejection carries a wire code — even the
+        slowloris reaped mid-trickle gets one on the way out), count
+        the disconnect, optionally penalty-box the peer address.  The
+        caller returns; _handle_conn's finally closes the socket."""
+        with self._lock:
+            self.hostile_disconnects += 1
+        telemetry.count("server_hostile_disconnects_total",
+                        reason=reason)
+        if box:
+            self.penalty_box.box(host)
+        self._try_error(conn, WireError("BAD_REQUEST", message,
+                                        reason=reason))
+
     # -- prepare ------------------------------------------------------------------
     def _do_prepare(self, conn, req: dict, conn_stmts: Dict[str, dict]
                     ) -> None:
@@ -565,6 +717,10 @@ class SqlFrontDoor:
         if not isinstance(spec, dict):
             raise WireError("BAD_REQUEST", "prepare needs a spec object")
         conf = self._conf()
+        # typed resource limits BEFORE the recursive compiler sees the
+        # spec: a depth/width/param/string bomb is BAD_REQUEST here,
+        # never a planner stack blowout escaping as INTERNAL
+        validate_spec(spec, SpecLimits.from_conf(conf))
         try:
             stmt, cached = self.prepared.prepare(
                 self._session, spec, self._tables, conf)
@@ -622,6 +778,9 @@ class SqlFrontDoor:
             spec = req.get("spec")
             if not isinstance(spec, dict):
                 raise WireError("BAD_REQUEST", "submit needs a spec object")
+            # same pre-compile armor as PREPARE: the resource-limit
+            # pass runs before the recursive compiler ever recurses
+            validate_spec(spec, SpecLimits.from_conf(conf))
             # ad-hoc SUBMITs share the prepared path's identity rule
             # (cache/keys.statement_fingerprint over the canonical
             # spec): a recurring non-prepared statement still converges
@@ -637,6 +796,22 @@ class SqlFrontDoor:
         label = req.get("label") or f"wire-{next(_query_ids):06d}"
         query_id = f"{csess.session_id}/{label}"
         deadline_ms = req.get("deadline_ms") or 0
+        # per-connection in-flight cap: the protocol is sequential
+        # request→response, so a well-formed client never trips this —
+        # it bounds the blast radius of a hostile client racing the
+        # registry (or a future pipelining bug)
+        max_mine = conf["spark.rapids.tpu.server.maxInflightPerConn"]
+        prefix = csess.session_id + "/"
+        with self._lock:
+            mine = sum(1 for qid in self._queries
+                       if qid.startswith(prefix))
+        if mine >= max_mine:
+            raise WireError(
+                "REJECTED",
+                f"connection has {mine} queries in flight "
+                f"(maxInflightPerConn={max_mine})",
+                retry_after_ms=self._retry_hint(conf),
+                reason="conn_inflight")
         stream = ResultStream(query_id,
                               conf["spark.rapids.tpu.server.spool.memoryBytes"],
                               self._spool_dir(conf))
@@ -951,6 +1126,9 @@ class SqlFrontDoor:
                 "goaways_sent": self.goaways_sent,
                 "streamed_bytes": self.streamed_bytes,
                 "spooled_bytes": self.spooled_bytes,
+                "decode_errors": self.decode_errors,
+                "hostile_disconnects": self.hostile_disconnects,
+                "penalty_refusals": self.penalty_refusals,
             }
         return {
             **counters,
@@ -1006,6 +1184,7 @@ class SqlFrontDoor:
             "scheduler": snap["scheduler"],
             "prepared": snap["prepared"],
             "quotas": quotas,
+            "penalty_box": self.penalty_box.snapshot(),
             "cache": cache,
             "telemetry": _tm.snapshot(),
             "slo": _tm.slo_snapshot(),

@@ -35,12 +35,90 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..utils import recorder, telemetry
 
 __all__ = ["OpsServer", "render_debug_slow", "render_debug_warmstore"]
+
+
+class _CappedReader:
+    """Byte-capped, wall-bounded wrapper over a request's ``rfile``.
+
+    The ops surface serves tiny GETs; a request head larger than
+    ``server.ops.maxRequestBytes`` or still incomplete after
+    ``server.ops.requestTimeoutMs`` is hostile, not a scraper.  On
+    either trip the reader starts returning EOF (``b""``), records the
+    reason, and counts ``ops_requests_rejected_total`` — the handler's
+    ``parse_request`` override turns a tripped head into a closed
+    connection instead of a served request."""
+
+    def __init__(self, raw, max_bytes: int, timeout_s: float):
+        self._raw = raw
+        self._max = int(max_bytes)
+        self._deadline = time.monotonic() + float(timeout_s)
+        self._count = 0
+        self.tripped = ""  # "" | "oversize" | "slow"
+
+    def _trip(self, reason: str) -> bytes:
+        if not self.tripped:
+            self.tripped = reason
+            telemetry.count("ops_requests_rejected_total",
+                            reason=reason)
+        return b""
+
+    def readline(self, limit: int = -1) -> bytes:
+        # byte-at-a-time on purpose: a buffered readline blocks one
+        # CALL until newline, so a one-byte-per-socket-timeout trickle
+        # would make "progress" forever inside it — per-byte reads put
+        # the wall deadline between every byte (a scrape head is ~100
+        # bytes; this path is not hot)
+        if self.tripped:
+            return b""
+        out = bytearray()
+        cap = limit if limit is not None and limit >= 0 \
+            else self._max + 1
+        while len(out) < cap:
+            if time.monotonic() > self._deadline:
+                return self._trip("slow")
+            try:
+                b = self._raw.read(1)
+            except (TimeoutError, OSError):
+                return self._trip("slow")
+            if not b:
+                break
+            self._count += 1
+            if self._count > self._max:
+                return self._trip("oversize")
+            out += b
+            if b == b"\n":
+                break
+        return bytes(out)
+
+    def read(self, n: int = -1) -> bytes:
+        if self.tripped:
+            return b""
+        out = bytearray()
+        want = n if n is not None and n >= 0 else self._max + 1
+        while len(out) < want:
+            if time.monotonic() > self._deadline:
+                return self._trip("slow")
+            try:
+                chunk = self._raw.read(min(1024, want - len(out)))
+            except (TimeoutError, OSError):
+                return self._trip("slow")
+            if not chunk:
+                break
+            self._count += len(chunk)
+            if self._count > self._max:
+                return self._trip("oversize")
+            out += chunk
+        return bytes(out)
+
+    def close(self) -> None:
+        self._raw.close()
 
 
 def render_debug_slow() -> str:
@@ -136,14 +214,39 @@ class OpsServer:
 
     def start(self) -> "OpsServer":
         door = self._door
+        conf = door._conf()
+        max_head = conf["spark.rapids.tpu.server.ops.maxRequestBytes"]
+        req_timeout_s = conf[
+            "spark.rapids.tpu.server.ops.requestTimeoutMs"] / 1000.0
 
         class _Handler(BaseHTTPRequestHandler):
-            # bounded per-request socket ops: a wedged scraper cannot
+            # bounded per-recv socket ops: a wedged scraper cannot
             # pin a handler thread forever
-            timeout = 10.0
+            timeout = req_timeout_s
 
             def log_message(self, fmt, *args):  # silence stdlib logging
                 pass
+
+            def setup(self):
+                # request-head armor: byte cap + wall deadline on the
+                # request line and headers (HTTP/1.0 here — one request
+                # per connection, so per-connection IS per-request)
+                super().setup()
+                self.rfile = _CappedReader(self.rfile, max_head,
+                                           req_timeout_s)
+
+            def parse_request(self):
+                ok = super().parse_request()
+                tripped = getattr(self.rfile, "tripped", "")
+                if tripped:
+                    try:
+                        self.send_error(
+                            431 if tripped == "oversize" else 408)
+                    except (OSError, ValueError):
+                        pass  # fault-ok (best-effort refusal; the peer is hostile or gone)
+                    self.close_connection = True
+                    return False
+                return ok
 
             def _reply(self, code: int, body: bytes,
                        ctype: str) -> None:

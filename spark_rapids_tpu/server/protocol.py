@@ -44,10 +44,12 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import time
 from typing import Any, Dict, Optional, Tuple
 
 __all__ = [
     "FRAME", "MAX_FRAME_BYTES", "WireError", "ProtocolError",
+    "FrameDecodeError", "SlowFrameError", "FrameLimits",
     "ServerDraining", "ERROR_CODES",
     "send_frame", "recv_frame", "pack_json", "unpack_json",
     "goaway_payload",
@@ -131,6 +133,75 @@ class ProtocolError(RuntimeError):
     """The byte stream itself is broken (bad magic, crc mismatch,
     oversized frame, truncated header) — the connection is unusable and
     both sides close it."""
+
+
+class FrameDecodeError(ProtocolError):
+    """One frame failed to decode under a :class:`FrameLimits` contract.
+
+    Unlike a bare :class:`ProtocolError`, this carries enough structure
+    for the receiver to answer TYPED instead of just hanging up:
+    ``kind`` names the failure for telemetry
+    (``oversize`` | ``unknown_type`` | ``crc`` | ``unexpected`` |
+    ``slow`` | ``injected``) and ``resumable`` says whether the stream
+    was consumed up to a frame boundary — when True the connection can
+    survive the strike (the next frame is readable); when False the
+    declared payload boundary cannot be trusted and the only safe
+    answer is a typed error followed by disconnect."""
+
+    def __init__(self, kind: str, message: str, resumable: bool):
+        super().__init__(message)
+        self.kind = kind
+        self.resumable = resumable
+
+
+class SlowFrameError(FrameDecodeError):
+    """A frame's first byte arrived but the whole frame did not complete
+    within ``FrameLimits.frame_timeout_s`` — the slowloris signature.
+    Never resumable: an unknown number of payload bytes are in flight."""
+
+    def __init__(self, message: str):
+        super().__init__("slow", message, resumable=False)
+
+
+class FrameLimits:
+    """Receive-side frame bounds, enforced BEFORE payload allocation.
+
+    ``max_control_bytes`` caps every frame type except those listed in
+    ``batch_types``, which get the larger ``max_frame_bytes``.  The
+    server's inbound side passes ``batch_types=()`` — a client never
+    legitimately sends batch frames, so a hostile "BATCH" request
+    cannot shop for the big cap.  ``frame_timeout_s`` arms the
+    per-frame read-progress deadline: it starts at the frame's FIRST
+    byte (so an idle connection is governed by the socket's ambient
+    timeout, not this), and the entire header + payload must land
+    before it expires.  0 disables the deadline."""
+
+    __slots__ = ("max_frame_bytes", "max_control_bytes",
+                 "frame_timeout_s", "batch_types")
+
+    def __init__(self, max_frame_bytes: int = MAX_FRAME_BYTES,
+                 max_control_bytes: int = MAX_FRAME_BYTES,
+                 frame_timeout_s: float = 0.0,
+                 batch_types: Tuple[bytes, ...] = (RSP_BATCH,)):
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.max_control_bytes = int(max_control_bytes)
+        self.frame_timeout_s = float(frame_timeout_s)
+        self.batch_types = tuple(batch_types)
+
+    @classmethod
+    def from_conf(cls, conf, *, batch_types: Tuple[bytes, ...] = ()
+                  ) -> "FrameLimits":
+        return cls(
+            max_frame_bytes=conf["spark.rapids.tpu.server.maxFrameBytes"],
+            max_control_bytes=conf[
+                "spark.rapids.tpu.server.maxControlFrameBytes"],
+            frame_timeout_s=conf[
+                "spark.rapids.tpu.server.frameTimeoutMs"] / 1000.0,
+            batch_types=batch_types)
+
+    def cap_for(self, ftype: bytes) -> int:
+        return (self.max_frame_bytes if ftype in self.batch_types
+                else self.max_control_bytes)
 
 
 class WireError(RuntimeError):
@@ -217,6 +288,11 @@ def unpack_json(payload: bytes) -> Dict[str, Any]:
         obj = json.loads(payload.decode("utf-8"))
     except (ValueError, UnicodeDecodeError) as e:
         raise WireError("BAD_REQUEST", f"malformed JSON payload: {e}")
+    except RecursionError:
+        # a ~1000-deep nesting bomb blows the parser's stack — that is
+        # the CLIENT's malformed payload, not the server's bug
+        raise WireError("BAD_REQUEST",
+                        "JSON payload nesting exceeds parser depth")
     if not isinstance(obj, dict):
         raise WireError("BAD_REQUEST", "control payload must be an object")
     return obj
@@ -232,10 +308,27 @@ def send_frame(sock: socket.socket, ftype: bytes, payload: bytes = b""
     return len(header) + len(payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> bytes:
+    """Read exactly ``n`` bytes.  With ``deadline`` (a monotonic
+    timestamp) armed, each recv waits at most the REMAINING window —
+    steady one-byte-per-idleTimeout trickling makes per-recv progress
+    but can never outlive the frame deadline."""
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))  # wait-ok (every front-door socket carries a settimeout: idleTimeout server-side, client request timeout client-side)
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise SlowFrameError(
+                    f"frame stalled mid-read ({len(buf)}/{n} bytes)")
+            sock.settimeout(remaining)
+        try:
+            chunk = sock.recv(min(1 << 20, n - len(buf)))  # wait-ok (every front-door socket carries a settimeout: idleTimeout server-side, client request timeout client-side; with a frame deadline armed the timeout is the remaining window)
+        except socket.timeout:
+            if deadline is None:
+                raise
+            raise SlowFrameError(
+                f"frame stalled mid-read ({len(buf)}/{n} bytes)")
         if not chunk:
             raise ConnectionError(
                 f"peer closed mid-frame ({len(buf)}/{n} bytes)")
@@ -244,23 +337,79 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def recv_frame(sock: socket.socket,
-               expect: Optional[Tuple[bytes, ...]] = None
+               expect: Optional[Tuple[bytes, ...]] = None,
+               limits: Optional[FrameLimits] = None
                ) -> Tuple[bytes, bytes]:
     """Receive one frame, verifying length sanity and the payload crc.
 
     ``expect`` optionally restricts acceptable frame types; an ERROR
     frame is ALWAYS accepted and raised as its typed :class:`WireError`
     so callers dispatch on one exception shape.
+
+    With ``limits``, the hostile-input contract applies: per-type size
+    caps are enforced against the length prefix BEFORE any payload
+    allocation, the per-frame read-progress deadline is armed at the
+    frame's first byte, and every failure raises
+    :class:`FrameDecodeError` (``resumable`` says whether the stream
+    survived to a frame boundary) instead of a bare
+    :class:`ProtocolError`.  Without ``limits`` the legacy behavior is
+    unchanged.
     """
-    header = _recv_exact(sock, FRAME.size)
+    if limits is None or not limits.frame_timeout_s:
+        header = _recv_exact(sock, FRAME.size)
+        return _decode_frame(sock, header, expect, limits, None)
+    # the deadline starts at the frame's FIRST byte: waiting for a
+    # frame to BEGIN is the ambient socket timeout's job (idleTimeout /
+    # handshakeTimeout), finishing one that began is this deadline's
+    first = _recv_exact(sock, 1)
+    deadline = time.monotonic() + limits.frame_timeout_s
+    ambient = sock.gettimeout()
+    try:
+        header = first + _recv_exact(sock, FRAME.size - 1, deadline)
+        return _decode_frame(sock, header, expect, limits, deadline)
+    finally:
+        sock.settimeout(ambient)
+
+
+def _decode_frame(sock: socket.socket, header: bytes,
+                  expect: Optional[Tuple[bytes, ...]],
+                  limits: Optional[FrameLimits],
+                  deadline: Optional[float]) -> Tuple[bytes, bytes]:
     ftype, length, crc = FRAME.unpack(header)
-    if ftype not in _REQUEST_TYPES and ftype not in _RESPONSE_TYPES:
-        raise ProtocolError(f"unknown frame type {ftype!r}")
-    if length > MAX_FRAME_BYTES:
+    known = ftype in _REQUEST_TYPES or ftype in _RESPONSE_TYPES
+    cap = limits.cap_for(ftype) if limits is not None else MAX_FRAME_BYTES
+    if length > cap:
+        # checked FIRST and against the length PREFIX — a lying 2 GB
+        # header is refused without allocating a byte of payload
+        if limits is not None:
+            conf_name = ("server.maxFrameBytes"
+                         if ftype in limits.batch_types
+                         else "server.maxControlFrameBytes")
+            raise FrameDecodeError(
+                "oversize",
+                f"frame length {length} exceeds cap {cap} "
+                f"({conf_name})"
+                + ("" if known else f" (unknown type {ftype!r})"),
+                resumable=False)
         raise ProtocolError(f"frame length {length} exceeds cap")
-    payload = _recv_exact(sock, length) if length else b""
+    if not known:
+        if limits is not None:
+            # the length prefix is in-cap, so consume the payload to
+            # resync at the next frame boundary — the strike budget,
+            # not the connection, absorbs the garbage
+            _recv_exact(sock, length, deadline)
+            raise FrameDecodeError("unknown_type",
+                                   f"unknown frame type {ftype!r}",
+                                   resumable=True)
+        raise ProtocolError(f"unknown frame type {ftype!r}")
+    payload = _recv_exact(sock, length, deadline) if length else b""
     from ..faults import integrity
     if integrity.checksum(payload) != crc:
+        if limits is not None:
+            raise FrameDecodeError(
+                "crc",
+                f"crc mismatch on {ftype!r} frame ({length} bytes)",
+                resumable=True)
         raise ProtocolError(
             f"crc mismatch on {ftype!r} frame ({length} bytes)")
     if ftype == RSP_ERROR:
@@ -272,6 +421,11 @@ def recv_frame(sock: socket.socket,
                              retry_after_ms=d.get("retry_after_ms", 0)
                              or 0)
     if expect is not None and ftype not in expect:
+        if limits is not None:
+            raise FrameDecodeError(
+                "unexpected",
+                f"unexpected frame {ftype!r} (wanted one of {expect})",
+                resumable=True)
         raise ProtocolError(
             f"unexpected frame {ftype!r} (wanted one of {expect})")
     return ftype, payload

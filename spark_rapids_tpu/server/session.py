@@ -14,11 +14,12 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Dict, Optional
 
 from .protocol import WireError
 
-__all__ = ["ClientSession", "TenantQuotas", "authenticate"]
+__all__ = ["ClientSession", "TenantQuotas", "PenaltyBox", "authenticate"]
 
 _session_ids = itertools.count(1)
 
@@ -119,6 +120,48 @@ class TenantQuotas:
             if tenant is not None:
                 return self._inflight.get(tenant, 0)
             return sum(self._inflight.values())
+
+
+class PenaltyBox:
+    """Short dial-refusal windows for peer addresses that burned their
+    decode-error strike budget (``server.maxDecodeErrors``).
+
+    Keyed by HOST, not connection: the attacker that reconnects after a
+    strike-budget disconnect meets a typed REJECTED at accept — before
+    a handler thread, auth, or a session id is spent on it.  The window
+    (``server.penaltyBoxMs``) is deliberately short; on a loopback dev
+    fleet every client shares one address, so this is a storm brake,
+    not a ban.  ``window_s <= 0`` disables boxing entirely."""
+
+    def __init__(self, window_s: float = 2.0):
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._until: Dict[str, float] = {}
+
+    def box(self, host: str) -> None:
+        if self.window_s <= 0 or not host:
+            return
+        with self._lock:
+            self._until[host] = time.monotonic() + self.window_s
+
+    def check(self, host: str) -> float:
+        """Remaining boxed seconds for ``host`` (0.0 = not boxed).
+        Expired entries are pruned on the way through."""
+        if self.window_s <= 0 or not host:
+            return 0.0
+        now = time.monotonic()
+        with self._lock:
+            expired = [h for h, t in self._until.items() if t <= now]
+            for h in expired:
+                del self._until[h]
+            until = self._until.get(host)
+            return max(0.0, until - now) if until is not None else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        now = time.monotonic()
+        with self._lock:
+            return {h: round(t - now, 3)
+                    for h, t in self._until.items() if t > now}
 
 
 class ClientSession:

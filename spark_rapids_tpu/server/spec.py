@@ -40,12 +40,110 @@ from typing import Any, Dict, List, Tuple
 from .. import exprs as E
 from .. import types as T
 
-__all__ = ["BadSpec", "compile_spec", "param_types_of", "coerce_params",
-           "TYPE_NAMES"]
+__all__ = ["BadSpec", "SpecLimits", "validate_spec", "compile_spec",
+           "param_types_of", "coerce_params", "TYPE_NAMES"]
 
 
 class BadSpec(ValueError):
     """Malformed query spec — surfaces as a BAD_REQUEST wire error."""
+
+
+class SpecLimits:
+    """Typed resource bounds a wire spec must satisfy BEFORE compile.
+
+    The compiler (:func:`compile_spec`, :func:`param_types_of`) walks
+    expressions recursively and checks param-index contiguity with a
+    ``range(max(params) + 1)`` sweep — correct for well-formed specs,
+    a stack bomb / CPU bomb for hostile ones.  :func:`validate_spec`
+    enforces these limits ITERATIVELY first, so the recursive compiler
+    only ever sees bounded input."""
+
+    __slots__ = ("max_depth", "max_nodes", "max_ops", "max_params",
+                 "max_string_bytes", "max_joins")
+
+    def __init__(self, max_depth: int = 32, max_nodes: int = 10000,
+                 max_ops: int = 64, max_params: int = 64,
+                 max_string_bytes: int = 65536, max_joins: int = 8):
+        self.max_depth = int(max_depth)
+        self.max_nodes = int(max_nodes)
+        self.max_ops = int(max_ops)
+        self.max_params = int(max_params)
+        self.max_string_bytes = int(max_string_bytes)
+        self.max_joins = int(max_joins)
+
+    @classmethod
+    def from_conf(cls, conf) -> "SpecLimits":
+        return cls(
+            max_depth=conf["spark.rapids.tpu.server.spec.maxDepth"],
+            max_nodes=conf["spark.rapids.tpu.server.spec.maxNodes"],
+            max_ops=conf["spark.rapids.tpu.server.spec.maxOps"],
+            max_params=conf["spark.rapids.tpu.server.spec.maxParams"],
+            max_string_bytes=conf[
+                "spark.rapids.tpu.server.spec.maxStringBytes"],
+            max_joins=conf["spark.rapids.tpu.server.spec.maxJoins"])
+
+
+def validate_spec(spec: Any, limits: SpecLimits) -> None:
+    """Reject resource-bomb specs with a typed :class:`BadSpec` before
+    any recursive compilation.
+
+    Walks the raw JSON value with an explicit stack (never the Python
+    call stack — "the planner never recurses past the cap" is literal),
+    bounding nesting depth, total node count, op-list length, join
+    fan-in, parameter indices, and cumulative string bytes.  Every
+    violation names the conf that bounds it."""
+    if not isinstance(spec, dict):
+        raise BadSpec("spec must be a JSON object")
+    ops = spec.get("ops", []) or []
+    if not isinstance(ops, (list, tuple)):
+        raise BadSpec("spec ops must be a list")
+    if len(ops) > limits.max_ops:
+        raise BadSpec(f"spec has {len(ops)} ops, cap is "
+                      f"{limits.max_ops} (server.spec.maxOps)")
+    joins = sum(1 for op in ops
+                if isinstance(op, dict) and op.get("op") == "join")
+    if joins > limits.max_joins:
+        raise BadSpec(f"spec has {joins} joins, cap is "
+                      f"{limits.max_joins} (server.spec.maxJoins)")
+    nodes = 0
+    str_bytes = 0
+    stack: List[Tuple[Any, int]] = [(spec, 1)]
+    while stack:
+        node, depth = stack.pop()
+        if depth > limits.max_depth:
+            raise BadSpec(f"spec nesting exceeds depth cap "
+                          f"{limits.max_depth} (server.spec.maxDepth)")
+        nodes += 1
+        if nodes > limits.max_nodes:
+            raise BadSpec(f"spec exceeds node cap {limits.max_nodes} "
+                          f"(server.spec.maxNodes)")
+        if isinstance(node, str):
+            try:
+                str_bytes += len(node.encode("utf-8"))
+            except UnicodeEncodeError:
+                raise BadSpec("spec string is not valid UTF-8")
+            if str_bytes > limits.max_string_bytes:
+                raise BadSpec(
+                    f"spec string bytes exceed cap "
+                    f"{limits.max_string_bytes} "
+                    f"(server.spec.maxStringBytes)")
+        elif isinstance(node, dict):
+            for k, v in node.items():
+                stack.append((k, depth + 1))
+                stack.append((v, depth + 1))
+        elif isinstance(node, (list, tuple)):
+            if (len(node) >= 2 and node[0] == "param"
+                    and isinstance(node[1], int)
+                    and not isinstance(node[1], bool)
+                    and not 0 <= node[1] < limits.max_params):
+                # bounds BOTH the param count and the contiguity
+                # sweep in compile_spec (range(max(params) + 1) over
+                # index 10^9 is a CPU bomb)
+                raise BadSpec(
+                    f"param index {node[1]} outside [0, "
+                    f"{limits.max_params}) (server.spec.maxParams)")
+            for v in node:
+                stack.append((v, depth + 1))
 
 
 TYPE_NAMES: Dict[str, "T.DataType"] = {

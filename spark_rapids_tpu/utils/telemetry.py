@@ -113,6 +113,22 @@ METRICS = (
     ("ops_scrapes_total", "counter", "endpoint",
      "Ops-surface reads served (/metrics, /healthz, /snapshot, "
      "/debug/slow, and the OPS wire op)."),
+    ("server_decode_errors_total", "counter", "kind",
+     "Frames that failed to decode at the front door, by failure kind "
+     "(oversize/unknown_type/crc/unexpected/slow/handshake/injected) — "
+     "each costs the connection a strike against "
+     "server.maxDecodeErrors."),
+    ("server_hostile_disconnects_total", "counter", "reason",
+     "Connections the front door disconnected for hostile input, by "
+     "reason (strikes = budget burned, oversize = untrusted frame "
+     "boundary, slow = frame deadline, handshake = no HELLO in time)."),
+    ("server_penalty_refusals_total", "counter", "",
+     "Dials refused at accept because the peer address was in the "
+     "strike-budget penalty box (typed REJECTED, reason penalty_box)."),
+    ("ops_requests_rejected_total", "counter", "reason",
+     "Ops-listener HTTP requests dropped at the read guard, by reason "
+     "(oversize = request head over ops.maxRequestBytes, slow = head "
+     "not complete within ops.requestTimeoutMs)."),
     # -- DCN / fleet ---------------------------------------------------------------
     ("dcn_epoch", "gauge", "",
      "This rank's view of the cluster membership epoch."),

@@ -898,6 +898,33 @@ class TestChaosDifferential:
             door.close()
             s.conf.unset("spark.rapids.tpu.faults.inject.schedule")
 
+        # server.malformed leg: a frame synthetically corrupt on
+        # arrival (injected at the recv path AFTER a clean decode, so
+        # the REAL strike machinery is the recovery path) — typed
+        # BAD_REQUEST with a strike, the connection survives, and the
+        # SAME connection then serves the exact rows
+        from spark_rapids_tpu.server import WireError
+        door2 = SqlFrontDoor(s).start()
+        door2.register_table("t", lambda: s.read_parquet(path))
+        try:
+            # connect BEFORE arming: the HELLO flows through the same
+            # injection point and must not eat the scheduled firing
+            c = WireClient("127.0.0.1", door2.port)
+            INJECTOR.arm(schedule="server.malformed:1")
+            with pytest.raises(WireError) as ei:
+                c.query({"table": "t", "ops": []})
+            assert ei.value.code == "BAD_REQUEST"
+            assert ei.value.reason == "malformed"
+            assert "strike 1/" in (ei.value.detail or "")
+            INJECTOR.arm()
+            assert c.query({"table": "t", "ops": []}).rows()
+            c.close()
+            assert door2.snapshot()["queries_inflight"] == 0
+            assert door2.snapshot()["decode_errors"] >= 1
+        finally:
+            INJECTOR.arm()
+            door2.close()
+
         # >=1 injected fault at EVERY registered point
         totals = INJECTOR.snapshot()["injected_total"]
         for p in POINTS:

@@ -1,0 +1,592 @@
+"""Hostile-input survival (ISSUE 20): front-door armor + the fuzzer.
+
+Covers the acceptance surface: the three named attacks each rejected
+typed in bounded time and memory (the 2 GB lying length prefix, the
+slowloris handshake, the expression depth bomb), conf-bounded frame
+and spec limits, the per-connection decode-error strike budget and its
+penalty box, leak-free teardown after every attack class, the
+checked-in fuzz corpus replaying clean at tier-1, and the satellite
+wiring (ops read caps, the ``server.malformed`` injector point, the
+``fuzz_survival`` perfwatch record, docs).
+"""
+
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from spark_rapids_tpu.config import ALL_ENTRIES
+from spark_rapids_tpu.memory.spill import get_catalog
+from spark_rapids_tpu.server import SqlFrontDoor, WireClient, WireError
+from spark_rapids_tpu.server import protocol as P
+from spark_rapids_tpu.server.spec import BadSpec, SpecLimits, validate_spec
+from tools import fuzzwire as FW
+from tools import loadgen as LG
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tests", "fuzz_corpus")
+
+# tight hostile-input windows so every reap lands in test time; the
+# penalty box stays SHORT because every test shares 127.0.0.1
+HOSTILE_SETTINGS = {
+    "spark.rapids.tpu.server.handshakeTimeoutMs": 800.0,
+    "spark.rapids.tpu.server.frameTimeoutMs": 800.0,
+    "spark.rapids.tpu.server.maxControlFrameBytes": 64 << 10,
+    "spark.rapids.tpu.server.maxDecodeErrors": 3,
+    "spark.rapids.tpu.server.penaltyBoxMs": 300.0,
+    "spark.rapids.tpu.server.ops.maxRequestBytes": 1024,
+    "spark.rapids.tpu.server.ops.requestTimeoutMs": 800.0,
+}
+
+
+@pytest.fixture(scope="module")
+def hostile(session):
+    """One armored door over the loadgen tables (the corpus spec cases
+    speak the loadgen template schema)."""
+    s = session
+    orders, customers = LG.build_tables(4000, 20260807)
+    s.conf.set("spark.rapids.tpu.sql.batchSizeRows", 2000)
+    door = SqlFrontDoor(s, settings=dict(HOSTILE_SETTINGS)).start()
+    tables = {"orders": lambda: s.create_dataframe(orders),
+              "customers": lambda: s.create_dataframe(customers)}
+    for name, f in tables.items():
+        door.register_table(name, f)
+    oracle = LG.Oracle(s, tables)
+    yield s, door, oracle
+    door.close()
+    s.conf.unset("spark.rapids.tpu.sql.batchSizeRows")
+
+
+AGG = LG.templates()["seg_rollup"][0]
+
+
+def _await_clean(s, door, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if s.scheduler().running() == 0 \
+                and door.snapshot()["queries_inflight"] == 0:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _sit_out_penalty_box():
+    time.sleep(HOSTILE_SETTINGS[
+        "spark.rapids.tpu.server.penaltyBoxMs"] / 1000.0 + 0.1)
+
+
+def _door_still_serves(door, oracle):
+    with WireClient("127.0.0.1", door.port, tenant="after") as c:
+        spec, pools = LG.templates()["seg_rollup"]
+        r = c.query(spec, params=list(pools[0]))
+        assert r.stats["status"] == "done"
+        assert LG._norm_rows(r.rows()) == oracle.expected(
+            "seg_rollup", spec, list(pools[0]))
+
+
+def _authed(door, timeout=6.0):
+    sock = FW._dial("127.0.0.1", door.port, timeout)
+    sock.sendall(FW._frame_bytes(*FW._base_frame("hello")))
+    P.recv_frame(sock, expect=(P.RSP_WELCOME,))
+    return sock
+
+
+# ---------------------------------------------------------------------------------
+# The named attacks
+# ---------------------------------------------------------------------------------
+
+class TestFrameArmor:
+    def test_2g_lying_length_typed_without_allocation(self, hostile):
+        """THE header attack: a length prefix claiming 2 GB must be
+        answered typed BEFORE any allocation — bounded time is the
+        observable (an allocate-then-read door would stall for the
+        frame deadline or OOM, not answer in milliseconds)."""
+        s, door, oracle = hostile
+        before = door.snapshot()
+        sock = FW._dial("127.0.0.1", door.port, 6.0)
+        try:
+            t0 = time.monotonic()
+            sock.sendall(P.FRAME.pack(P.REQ_SUBMIT, 2 << 30, 0))
+            with pytest.raises(WireError) as ei:
+                while True:
+                    P.recv_frame(sock)
+            elapsed = time.monotonic() - t0
+        finally:
+            sock.close()
+        assert ei.value.code == "BAD_REQUEST"
+        assert "maxControlFrameBytes" in str(ei.value)
+        assert elapsed < 0.5, f"oversize answer took {elapsed:.2f}s"
+        after = door.snapshot()
+        assert after["decode_errors"] > before["decode_errors"]
+        assert after["hostile_disconnects"] > before["hostile_disconnects"]
+        _door_still_serves(door, oracle)
+
+    def test_batch_type_cannot_shop_for_the_big_cap(self, hostile):
+        """Inbound frames ALL get the control cap — claiming to be a
+        BATCH does not unlock ``maxFrameBytes``."""
+        s, door, oracle = hostile
+        sock = FW._dial("127.0.0.1", door.port, 6.0)
+        try:
+            sock.sendall(P.FRAME.pack(P.RSP_BATCH, 100 << 20, 0))
+            with pytest.raises(WireError) as ei:
+                while True:
+                    P.recv_frame(sock)
+        finally:
+            sock.close()
+        assert ei.value.code == "BAD_REQUEST"
+
+    def test_resumable_strike_keeps_the_connection(self, hostile):
+        """A malformed frame with its payload on the wire costs a
+        strike, answered typed — and the SAME connection then serves a
+        well-formed request (the stream was consumed to a boundary)."""
+        s, door, oracle = hostile
+        sock = _authed(door)
+        try:
+            payload = b"junk"
+            from spark_rapids_tpu.faults import integrity
+            sock.sendall(P.FRAME.pack(b"Z", len(payload),
+                                      integrity.checksum(payload))
+                         + payload)
+            with pytest.raises(WireError) as ei:
+                P.recv_frame(sock)
+            assert ei.value.code == "BAD_REQUEST"
+            assert ei.value.reason == "malformed"
+            assert "strike 1/3" in (ei.value.detail or "")
+            sock.sendall(FW._frame_bytes(P.REQ_STATUS, b""))
+            ftype, _ = P.recv_frame(sock, expect=(P.RSP_STATUS,))
+            assert ftype == P.RSP_STATUS
+        finally:
+            sock.close()
+
+    def test_strike_budget_burn_disconnects_and_boxes(self, hostile):
+        s, door, oracle = hostile
+        before = door.snapshot()
+        sock = _authed(door)
+        codes = []
+        try:
+            from spark_rapids_tpu.faults import integrity
+            bad = P.FRAME.pack(b"Z", 1, integrity.checksum(b"x")) + b"x"
+            for _ in range(3):
+                sock.sendall(bad)
+                with pytest.raises(WireError) as ei:
+                    P.recv_frame(sock)
+                codes.append(ei.value.code)
+            # the budget is burned: the door hung up after the third
+            with pytest.raises((ConnectionError, OSError, WireError)):
+                sock.sendall(bad)
+                P.recv_frame(sock)
+        finally:
+            sock.close()
+        assert codes == ["BAD_REQUEST"] * 3
+        # the immediate re-dial meets the penalty box, typed + hinted
+        s2 = FW._dial("127.0.0.1", door.port, 6.0)
+        try:
+            with pytest.raises(WireError) as ei:
+                P.recv_frame(s2)
+            assert ei.value.code == "REJECTED"
+            assert ei.value.reason == "penalty_box"
+            assert ei.value.retry_after_ms > 0
+        finally:
+            s2.close()
+        after = door.snapshot()
+        assert after["hostile_disconnects"] > before["hostile_disconnects"]
+        assert after["penalty_refusals"] > before["penalty_refusals"]
+        # the box EXPIRES: this is a brake, not a ban
+        _sit_out_penalty_box()
+        _door_still_serves(door, oracle)
+
+    def test_preauth_garbage_is_one_typed_disconnect(self, hostile):
+        """Strangers get no strike budget: garbage before HELLO is one
+        typed answer and a closed socket."""
+        s, door, oracle = hostile
+        sock = FW._dial("127.0.0.1", door.port, 6.0)
+        try:
+            sock.sendall(b"\xde\xad\xbe\xef" * 8)
+            out = FW._read_outcome(sock, 6.0)
+        finally:
+            sock.close()
+        assert out.startswith("typed:")
+
+
+class TestSlowloris:
+    def test_silent_handshake_reaped_at_deadline(self, hostile):
+        """Dial and say nothing: the handshake deadline reaps the
+        connection, typed, near ``handshakeTimeoutMs`` — not at the
+        (much longer) idle timeout, not never."""
+        s, door, oracle = hostile
+        sock = FW._dial("127.0.0.1", door.port, 10.0)
+        t0 = time.monotonic()
+        try:
+            out = FW._read_outcome(sock, 6.0)
+            elapsed = time.monotonic() - t0
+        finally:
+            sock.close()
+        assert out == "typed:BAD_REQUEST"
+        assert 0.5 <= elapsed < 3.0, f"reaped after {elapsed:.2f}s"
+
+    def test_trickled_frame_reaped_at_frame_deadline(self, hostile):
+        """Per-recv progress forever, whole-frame progress never: the
+        per-frame read deadline (distinct from idleTimeout) reaps it."""
+        s, door, oracle = hostile
+        sock = _authed(door)
+        try:
+            sock.sendall(P.FRAME.pack(P.REQ_STATUS, 256, 0))
+            t0 = time.monotonic()
+            deadline = t0 + 5.0
+            out = "hang"
+            while time.monotonic() < deadline:
+                try:
+                    sock.sendall(b"\x00")
+                except OSError:
+                    break
+                out = FW._read_outcome(sock, 0.1)
+                if out != "hang":
+                    break
+            if out == "hang":
+                out = FW._read_outcome(sock, 2.0)
+            elapsed = time.monotonic() - t0
+        finally:
+            sock.close()
+        assert out == "typed:BAD_REQUEST"
+        assert elapsed < 3.0, f"trickle survived {elapsed:.2f}s"
+
+
+class TestSpecArmor:
+    BOMBS = {
+        "depth_bomb": {"fuzzer": "spec", "kind": "depth_bomb",
+                       "depth": 120},
+        "depth_bomb_past_parser": {"fuzzer": "spec",
+                                   "kind": "depth_bomb", "depth": 5000},
+        "node_bomb": {"fuzzer": "spec", "kind": "node_bomb",
+                      "width": 12000},
+        "wide_ops": {"fuzzer": "spec", "kind": "wide_ops", "ops": 100},
+        "param_bomb": {"fuzzer": "spec", "kind": "param_bomb",
+                       "index": 10 ** 9},
+        "big_string": {"fuzzer": "spec", "kind": "big_string",
+                       "bytes": 70_000},
+        "join_bomb": {"fuzzer": "spec", "kind": "join_bomb",
+                      "joins": 16},
+    }
+
+    @pytest.mark.parametrize("name", sorted(BOMBS))
+    def test_resource_bomb_rejected_typed_and_fast(self, hostile, name):
+        """Every resource bomb answers BAD_REQUEST in bounded time —
+        the planner never recurses past the cap, the evaluator never
+        materializes the bomb."""
+        s, door, oracle = hostile
+        sock = _authed(door)
+        try:
+            t0 = time.monotonic()
+            sock.sendall(FW._frame_bytes(
+                P.REQ_SUBMIT, FW._spec_payload(self.BOMBS[name])))
+            out = FW._read_outcome(sock, 6.0)
+            elapsed = time.monotonic() - t0
+        finally:
+            sock.close()
+        assert out == "typed:BAD_REQUEST", f"{name}: {out}"
+        assert elapsed < 2.0, f"{name} took {elapsed:.2f}s"
+
+    def test_validator_names_the_bounding_conf(self):
+        limits = SpecLimits()
+        deep = ["col", "x"]
+        for _ in range(40):
+            deep = ["not", deep]
+        with pytest.raises(BadSpec, match="spec.maxDepth"):
+            validate_spec({"table": "t", "ops": [
+                {"op": "filter", "expr": deep}]}, limits)
+        with pytest.raises(BadSpec, match="spec.maxOps"):
+            validate_spec({"table": "t",
+                           "ops": [{"op": "limit", "n": 1}] * 65},
+                          limits)
+        with pytest.raises(BadSpec, match="spec.maxJoins"):
+            validate_spec({"table": "t", "ops": [
+                {"op": "join", "table": "u", "on": [["a", "b"]]}] * 9},
+                limits)
+        with pytest.raises(BadSpec, match="spec.maxParams"):
+            validate_spec({"table": "t", "ops": [
+                {"op": "filter",
+                 "expr": [">", ["col", "x"],
+                          ["param", 10 ** 9, "int"]]}]}, limits)
+        with pytest.raises(BadSpec, match="spec.maxStringBytes"):
+            validate_spec({"table": "t", "ops": [
+                {"op": "filter",
+                 "expr": ["==", ["col", "x"],
+                          ["lit", "x" * 70_000]]}]}, limits)
+
+    def test_validator_passes_the_real_templates(self):
+        """The armor must not reject healthy traffic: every loadgen
+        template clears the default limits untouched."""
+        limits = SpecLimits()
+        for name, (spec, _pools) in LG.templates().items():
+            validate_spec(spec, limits)
+
+    def test_bomb_never_escapes_to_internal(self, hostile):
+        """A depth bomb through the REAL client surfaces BAD_REQUEST —
+        never INTERNAL, never a closed socket."""
+        s, door, oracle = hostile
+        deep = json.loads(
+            '["not",' * 100 + '["col","o_amt"]' + "]" * 100)
+        with WireClient("127.0.0.1", door.port) as c:
+            with pytest.raises(WireError) as ei:
+                c.query({"table": "orders", "ops": [
+                    {"op": "filter", "expr": deep}]})
+        assert ei.value.code == "BAD_REQUEST"
+        assert "maxDepth" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------------
+# Leak audits per attack class (the PR 7 discipline, hostile edition)
+# ---------------------------------------------------------------------------------
+
+def _attack_oversized_frame(door):
+    sock = FW._dial("127.0.0.1", door.port, 6.0)
+    try:
+        sock.sendall(P.FRAME.pack(P.REQ_SUBMIT, 2 << 30, 0))
+        FW._read_outcome(sock, 6.0)
+    finally:
+        sock.close()
+
+
+def _attack_strike_budget(door):
+    FW.run_frame_case({"case": 0, "fuzzer": "frame",
+                       "kind": "strike_burn"},
+                      "127.0.0.1", door.port, 6.0)
+    _sit_out_penalty_box()
+
+
+def _attack_slowloris(door):
+    sock = FW._dial("127.0.0.1", door.port, 10.0)
+    try:
+        FW._read_outcome(sock, 6.0)  # reaped at the handshake deadline
+    finally:
+        sock.close()
+
+
+def _attack_spec_bomb(door):
+    sock = FW._dial("127.0.0.1", door.port, 6.0)
+    try:
+        sock.sendall(FW._frame_bytes(*FW._base_frame("hello")))
+        P.recv_frame(sock, expect=(P.RSP_WELCOME,))
+        sock.sendall(FW._frame_bytes(P.REQ_SUBMIT, FW._spec_payload(
+            {"fuzzer": "spec", "kind": "depth_bomb", "depth": 2000})))
+        FW._read_outcome(sock, 6.0)
+    finally:
+        sock.close()
+
+
+class TestHostileCleanup:
+    ATTACKS = {"oversized_frame": _attack_oversized_frame,
+               "strike_budget": _attack_strike_budget,
+               "slowloris": _attack_slowloris,
+               "spec_bomb": _attack_spec_bomb}
+
+    @pytest.mark.parametrize("attack", sorted(ATTACKS))
+    def test_attack_leaves_no_residue(self, hostile, attack):
+        """After each attack class: zero in-flight queries, zero quota
+        permits, zero spill leaks — and the door still serves exact
+        results."""
+        s, door, oracle = hostile
+        self.ATTACKS[attack](door)
+        assert _await_clean(s, door), f"{attack}: residue"
+        assert door.quotas.inflight() == 0
+        get_catalog().assert_no_leaks()
+        _door_still_serves(door, oracle)
+
+
+# ---------------------------------------------------------------------------------
+# The checked-in corpus replays clean at tier-1
+# ---------------------------------------------------------------------------------
+
+class TestCorpusReplay:
+    def test_corpus_covers_every_attack_class(self):
+        cases = FW.load_corpus(CORPUS)
+        kinds = {(c["fuzzer"], c["kind"]) for c in cases}
+        for kind, _w in FW.FRAME_KINDS:
+            assert ("frame", kind) in kinds, f"corpus misses {kind}"
+        for kind, _w in FW.SPEC_KINDS:
+            assert ("spec", kind) in kinds, f"corpus misses {kind}"
+
+    def test_corpus_replays_clean(self, hostile):
+        """Every checked-in case answered typed (or benign/self-
+        closing) — zero hangs, crashes, mismatches, or untyped
+        rejections against a live door."""
+        s, door, oracle = hostile
+        spec_conn = FW.SpecAttacker("127.0.0.1", door.port, 6.0)
+        bad = {}
+        try:
+            for case in FW.load_corpus(CORPUS):
+                if case["fuzzer"] == "frame":
+                    out = FW.run_frame_case(case, "127.0.0.1",
+                                            door.port, 6.0)
+                else:
+                    out = spec_conn.run_case(case, LG.templates,
+                                             LG._norm_rows, oracle)
+                if not (out == "ok" or out.startswith("typed:")):
+                    bad[f"{case['kind']}#{case['case']}"] = out
+                if case["kind"] == "strike_burn":
+                    _sit_out_penalty_box()
+        finally:
+            spec_conn.close()
+        assert not bad, f"corpus survivors: {bad}"
+        assert _await_clean(s, door)
+        get_catalog().assert_no_leaks()
+        _door_still_serves(door, oracle)
+
+
+# ---------------------------------------------------------------------------------
+# Satellites: ops caps, injector point, perfwatch record, docs, confs
+# ---------------------------------------------------------------------------------
+
+class TestOpsArmor:
+    def test_oversized_request_head_rejected(self, hostile):
+        """A request head past ``ops.maxRequestBytes`` answers 431 and
+        closes — the scrape surface never buffers a hostile head."""
+        s, door, oracle = hostile
+        sock = socket.create_connection(("127.0.0.1", door.ops_port),
+                                        timeout=6.0)
+        try:
+            sock.sendall(b"GET /metrics HTTP/1.1\r\nX-Junk: "
+                         + b"a" * 4096 + b"\r\n\r\n")
+            data = sock.recv(4096)
+        finally:
+            sock.close()
+        assert b"431" in data.split(b"\r\n", 1)[0] or data == b""
+
+    def test_slow_request_reaped(self, hostile):
+        """A trickled request head is reaped near the ops deadline."""
+        s, door, oracle = hostile
+        sock = socket.create_connection(("127.0.0.1", door.ops_port),
+                                        timeout=6.0)
+        t0 = time.monotonic()
+        try:
+            sock.sendall(b"GET /metr")  # ...and never finish the line
+            sock.settimeout(5.0)
+            try:
+                data = sock.recv(4096)
+            except socket.timeout:
+                data = b"HUNG"
+            elapsed = time.monotonic() - t0
+        finally:
+            sock.close()
+        assert data != b"HUNG", "ops socket survived a slowloris head"
+        assert elapsed < 4.0
+        # the surface still scrapes
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{door.ops_port}/metrics",
+                timeout=5.0) as r:
+            assert r.status == 200
+
+
+class TestSatellites:
+    NEW_CONFS = (
+        "spark.rapids.tpu.server.maxFrameBytes",
+        "spark.rapids.tpu.server.maxControlFrameBytes",
+        "spark.rapids.tpu.server.handshakeTimeoutMs",
+        "spark.rapids.tpu.server.frameTimeoutMs",
+        "spark.rapids.tpu.server.maxDecodeErrors",
+        "spark.rapids.tpu.server.penaltyBoxMs",
+        "spark.rapids.tpu.server.maxInflightPerConn",
+        "spark.rapids.tpu.server.spec.maxDepth",
+        "spark.rapids.tpu.server.spec.maxNodes",
+        "spark.rapids.tpu.server.spec.maxOps",
+        "spark.rapids.tpu.server.spec.maxParams",
+        "spark.rapids.tpu.server.spec.maxStringBytes",
+        "spark.rapids.tpu.server.spec.maxJoins",
+        "spark.rapids.tpu.server.ops.maxRequestBytes",
+        "spark.rapids.tpu.server.ops.requestTimeoutMs",
+    )
+
+    def test_confs_registered_and_documented(self):
+        keys = set(ALL_ENTRIES)
+        with open(os.path.join(REPO, "docs", "configs.md")) as f:
+            docs = f.read()
+        for key in self.NEW_CONFS:
+            assert key in keys, f"{key} not registered"
+            assert key in docs, f"{key} not in docs/configs.md"
+
+    def test_injector_point_registered(self):
+        from spark_rapids_tpu.faults.injector import POINTS
+        assert "server.malformed" in POINTS
+
+    def test_hostile_metrics_registered(self):
+        from spark_rapids_tpu.utils.telemetry import METRICS
+        names = {m[0] for m in METRICS}
+        for n in ("server_decode_errors_total",
+                  "server_hostile_disconnects_total",
+                  "server_penalty_refusals_total",
+                  "ops_requests_rejected_total"):
+            assert n in names, f"{n} not registered"
+
+    def test_docs_sections_present(self):
+        with open(os.path.join(REPO, "docs", "serving.md")) as f:
+            serving = f.read()
+        assert "Hostile input" in serving
+        assert "penalty box" in serving.lower()
+        with open(os.path.join(REPO, "docs", "robustness.md")) as f:
+            robust = f.read()
+        assert "server.malformed" in robust
+        assert "fuzzwire" in robust
+
+    def test_bench_exposes_the_fuzz_drill(self):
+        with open(os.path.join(REPO, "bench.py")) as f:
+            src = f.read()
+        assert "SRT_BENCH_FUZZ" in src
+        assert "fuzz_survival" in src
+
+    def test_perfwatch_gates_fuzz_survival(self, tmp_path):
+        """The ``fuzz_survival`` record kind gates ABSOLUTE — it
+        passes/fails on an empty ledger, no baseline needed."""
+        from tools import perfwatch
+        good = {"fuzz_survival": 1, "cases": 200, "crashes": 0,
+                "hangs": 0, "untyped_rejections": 0, "leaks": 0,
+                "sidecar_mismatches": 0, "goodput_ratio": 1.4,
+                "corpus_new": 0}
+        run = tmp_path / "fuzz.json"
+        ledger = tmp_path / "ledger.jsonl"
+        run.write_text(json.dumps(good) + "\n")
+        entry = perfwatch.load_run(str(run))
+        assert entry["kind"] == "fuzz_survival"
+        assert perfwatch.main(["check", str(ledger), str(run)]) == 0
+        for field, val in (("crashes", 1), ("hangs", 2),
+                           ("untyped_rejections", 3), ("leaks", 1),
+                           ("sidecar_mismatches", 1),
+                           ("goodput_ratio", 0.5),
+                           ("corpus_new", 1), ("cases", 0)):
+            bad = dict(good)
+            bad[field] = val
+            run.write_text(json.dumps(bad) + "\n")
+            rc = perfwatch.main(["check", str(ledger), str(run)])
+            assert rc == 1, f"{field}={val} passed the gate"
+
+    def test_mini_fuzz_run_survives(self, hostile):
+        """A seeded 40-case fuzz leg end-to-end through ``run_fuzz``'s
+        case engine against the live door (the full harness with its
+        own door + sidecar is the bench drill / acceptance run)."""
+        s, door, oracle = hostile
+        cases = FW.gen_cases(seed=7, n=40)
+        # skip the slow legs here: tier-1 already proves them above
+        cases = [c for c in cases if c["kind"] not in (
+            "slowloris_handshake", "slowloris_frame", "strike_burn")]
+        spec_conn = FW.SpecAttacker("127.0.0.1", door.port, 6.0)
+        outcomes = {}
+        try:
+            for c in cases:
+                if c["fuzzer"] == "frame":
+                    out = FW.run_frame_case(c, "127.0.0.1", door.port,
+                                            6.0)
+                else:
+                    out = spec_conn.run_case(c, LG.templates,
+                                             LG._norm_rows, oracle)
+                outcomes[f"{c['kind']}#{c['case']}"] = out
+        finally:
+            spec_conn.close()
+        survivors = {k: v for k, v in outcomes.items()
+                     if v in ("hang", "crash", "mismatch")
+                     or v.startswith("harness_error")}
+        assert not survivors, survivors
+        assert _await_clean(s, door)
+        get_catalog().assert_no_leaks()
+        _door_still_serves(door, oracle)

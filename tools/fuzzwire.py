@@ -1,0 +1,945 @@
+#!/usr/bin/env python3
+"""fuzzwire: a seeded hostile-input fuzzer for the SQL front door.
+
+Two fuzzers against ONE live door, with a healthy-traffic sidecar
+verifying goodput and oracle-exact results the whole time:
+
+  * the FRAME fuzzer speaks raw bytes at the wire protocol — random
+    garbage, bit flips on valid frames, lying length prefixes (the
+    2 GB header), short length prefixes, type confusion (response
+    types, unknown types), crc corruption, truncation + mid-frame
+    disconnect, and slowloris pacing (silent dial, trickled frame);
+  * the SPEC fuzzer speaks well-formed frames carrying hostile query
+    specs — expression depth bombs (past the JSON parser's own stack),
+    node-count bombs, op/join/param/string resource bombs, junk types,
+    and unknown tables.
+
+Every case records a typed outcome: ``typed:<CODE>`` (the door
+answered with a wire error code — the PASS for hostile input),
+``ok`` (the case was benign or self-closing), ``conn_closed`` (the
+door hung up without a typed answer — counted as an UNTYPED
+rejection), ``hang`` (no answer within the case deadline), or
+``crash`` (the door stopped accepting).  A clean run has zero crashes,
+zero hangs, zero untyped rejections where a typed answer was due,
+zero leaks at drain, and sidecar goodput >= 0.9x of the fuzz-free
+baseline phase.
+
+Surviving crash/hang case descriptors land in a replayable corpus
+(``--corpus-dir``); ``--replay DIR`` reruns every ``*.json`` case in a
+directory against a fresh door (the checked-in ``tests/fuzz_corpus/``
+regression corpus replays at tier-1 via tests/test_hostile.py).
+
+Deterministic under ``--seed``: all case content derives from one
+seeded PRNG, generated up front.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_pc = time.perf_counter
+
+# the door settings every fuzz run uses: tight hostile-input windows so
+# slowloris legs finish in test time, a small control cap so oversize
+# legs are cheap, a SHORT penalty box so the loopback sidecar (same
+# address as the attacker!) is braked, not starved
+FUZZ_DOOR_SETTINGS = {
+    "spark.rapids.tpu.server.handshakeTimeoutMs": 1000.0,
+    "spark.rapids.tpu.server.frameTimeoutMs": 1000.0,
+    "spark.rapids.tpu.server.maxControlFrameBytes": 256 << 10,
+    "spark.rapids.tpu.server.maxDecodeErrors": 3,
+    "spark.rapids.tpu.server.penaltyBoxMs": 200.0,
+    "spark.rapids.tpu.server.spool.memoryBytes": 1 << 20,
+}
+
+# frame-fuzzer case kinds and their relative weights; SLOW kinds (each
+# case holds a socket for ~a frame deadline) are deliberately rare so
+# a 10k-case run stays minutes, not hours
+FRAME_KINDS = [
+    ("garbage", 14), ("bitflip", 14), ("lying_length", 10),
+    ("short_length", 6), ("type_confusion", 8), ("bad_crc", 8),
+    ("truncate", 6), ("midframe_disconnect", 6), ("oversize_real", 3),
+    ("slowloris_handshake", 1), ("slowloris_frame", 1),
+    ("strike_burn", 1),
+]
+
+SPEC_KINDS = [
+    ("depth_bomb", 6), ("node_bomb", 4), ("wide_ops", 4),
+    ("param_bomb", 4), ("big_string", 4), ("join_bomb", 4),
+    ("junk_types", 6), ("unknown_table", 4), ("valid", 4),
+]
+
+
+# ---------------------------------------------------------------------------------
+# Case generation (pure: seeded PRNG -> JSON-serializable descriptors)
+# ---------------------------------------------------------------------------------
+
+def _weighted(rng, kinds):
+    total = sum(w for _, w in kinds)
+    pick = rng.randrange(total)
+    for name, w in kinds:
+        pick -= w
+        if pick < 0:
+            return name
+    return kinds[-1][0]
+
+
+def gen_cases(seed: int, n: int) -> List[dict]:
+    """All case descriptors up front from one seeded PRNG — execution
+    order never changes case content, so a threaded run replays."""
+    import random
+    rng = random.Random(seed)
+    cases: List[dict] = []
+    for i in range(n):
+        if rng.random() < 0.55:
+            kind = _weighted(rng, FRAME_KINDS)
+            cases.append(_gen_frame_case(rng, i, kind))
+        else:
+            kind = _weighted(rng, SPEC_KINDS)
+            cases.append(_gen_spec_case(rng, i, kind))
+    return cases
+
+
+def _gen_frame_case(rng, i: int, kind: str) -> dict:
+    c = {"case": i, "fuzzer": "frame", "kind": kind}
+    if kind == "garbage":
+        c["hex"] = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(13, 96))).hex()
+    elif kind == "bitflip":
+        c["base"] = rng.choice(["hello", "submit", "status"])
+        c["flips"] = sorted(rng.sample(range(13 * 8),
+                                       rng.randrange(1, 4)))
+    elif kind == "lying_length":
+        c["length"] = rng.choice([1 << 31, (1 << 31) - 1, 1 << 40,
+                                  (1 << 64) - 1, 300 << 20, 5 << 20])
+    elif kind == "short_length":
+        c["declared"] = rng.randrange(0, 8)
+        c["actual"] = rng.randrange(16, 64)
+    elif kind == "type_confusion":
+        c["type"] = rng.choice(["B", "E", "G", "W", "Z", "M", "?", "\x00",
+                                "\x7f"])
+    elif kind == "bad_crc":
+        c["base"] = rng.choice(["hello", "submit", "status"])
+    elif kind in ("truncate", "midframe_disconnect"):
+        c["base"] = rng.choice(["hello", "submit"])
+        c["keep_frac"] = round(rng.uniform(0.1, 0.9), 3)
+    elif kind == "oversize_real":
+        c["payload_bytes"] = rng.choice([300 << 10, 512 << 10])
+    elif kind == "slowloris_handshake":
+        c["send_bytes"] = rng.randrange(0, 4)
+    elif kind == "slowloris_frame":
+        c["declared"] = rng.randrange(64, 512)
+        c["trickle"] = rng.randrange(1, 4)
+    # strike_burn needs no extra fields (the door's conf drives it)
+    return c
+
+
+def _gen_spec_case(rng, i: int, kind: str) -> dict:
+    c = {"case": i, "fuzzer": "spec", "kind": kind}
+    if kind == "depth_bomb":
+        # straddle the JSON parser's own recursion limit on purpose:
+        # below it the validator's depth cap answers, above it the
+        # parser's RecursionError maps to BAD_REQUEST — both typed
+        c["depth"] = rng.choice([40, 120, 500, 1500, 5000])
+    elif kind == "node_bomb":
+        c["width"] = rng.choice([12000, 20000, 50000])
+    elif kind == "wide_ops":
+        c["ops"] = rng.choice([65, 100, 500])
+    elif kind == "param_bomb":
+        c["index"] = rng.choice([64, 4096, 10 ** 6, 10 ** 9, 2 ** 40])
+    elif kind == "big_string":
+        c["bytes"] = rng.choice([70_000, 120_000, 200_000])
+    elif kind == "join_bomb":
+        c["joins"] = rng.choice([9, 16, 40])
+    elif kind == "junk_types":
+        c["variant"] = rng.randrange(6)
+    elif kind == "valid":
+        c["template"] = rng.choice(["seg_rollup", "hot_orders",
+                                    "scan_band", "point_lookup"])
+        c["pool"] = rng.randrange(3)
+    return c
+
+
+# ---------------------------------------------------------------------------------
+# Case execution (raw sockets; every outcome typed)
+# ---------------------------------------------------------------------------------
+
+def _base_frame(base: str):
+    from spark_rapids_tpu.server import protocol as P
+    if base == "hello":
+        return P.REQ_HELLO, P.pack_json(
+            {"token": "", "tenant": "fuzz", "weight": 1.0})
+    if base == "status":
+        return P.REQ_STATUS, b""
+    return P.REQ_SUBMIT, P.pack_json(
+        {"spec": {"table": "orders", "ops": []}, "params": []})
+
+
+def _frame_bytes(ftype: bytes, payload: bytes) -> bytes:
+    from spark_rapids_tpu.faults import integrity
+    from spark_rapids_tpu.server import protocol as P
+    return P.FRAME.pack(ftype, len(payload),
+                        integrity.checksum(payload)) + payload
+
+
+def _dial(host: str, port: int, timeout: float) -> socket.socket:
+    s = socket.create_connection((host, port), timeout=timeout)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _read_outcome(sock: socket.socket, timeout: float) -> str:
+    """Drain responses until a typed ERROR, close, or the deadline:
+    the attacker's view of how the door answered."""
+    from spark_rapids_tpu.server import protocol as P
+    sock.settimeout(timeout)
+    try:
+        while True:
+            P.recv_frame(sock)
+    except P.WireError as e:  # ServerDraining included (typed DRAINING)
+        return f"typed:{e.code}"
+    except socket.timeout:
+        return "hang"
+    except (ConnectionError, OSError):
+        return "conn_closed"
+    except P.ProtocolError:
+        return "garbled"
+
+
+def run_frame_case(case: dict, host: str, port: int,
+                   timeout: float) -> str:
+    try:
+        sock = _dial(host, port, timeout)
+    except ConnectionRefusedError:
+        return "crash"  # the accept loop is gone
+    except OSError:
+        return "conn_closed"
+    try:
+        return _run_frame_case(case, sock, host, port, timeout)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _run_frame_case(case: dict, sock: socket.socket, host: str,
+                    port: int, timeout: float) -> str:
+    from spark_rapids_tpu.server import protocol as P
+    kind = case["kind"]
+    try:
+        if kind == "garbage":
+            sock.sendall(bytes.fromhex(case["hex"]))
+        elif kind == "bitflip":
+            raw = bytearray(_frame_bytes(*_base_frame(case["base"])))
+            for bit in case["flips"]:
+                if bit // 8 < len(raw):
+                    raw[bit // 8] ^= 1 << (bit % 8)
+            sock.sendall(bytes(raw))
+        elif kind == "lying_length":
+            # THE named attack: a header whose length prefix claims up
+            # to 2^64 bytes, with no payload behind it — the door must
+            # answer typed without allocating
+            sock.sendall(P.FRAME.pack(
+                P.REQ_SUBMIT, case["length"] & ((1 << 64) - 1), 0))
+        elif kind == "short_length":
+            ftype, payload = _base_frame("submit")
+            actual = os.urandom(case["actual"])
+            from spark_rapids_tpu.faults import integrity
+            sock.sendall(P.FRAME.pack(ftype, case["declared"],
+                                      integrity.checksum(payload))
+                         + actual)
+        elif kind == "type_confusion":
+            payload = P.pack_json({"code": "CANCELLED", "message": "lie"})
+            sock.sendall(_frame_bytes(
+                case["type"].encode("latin-1")[:1], payload))
+        elif kind == "bad_crc":
+            ftype, payload = _base_frame(case["base"])
+            from spark_rapids_tpu.faults import integrity
+            sock.sendall(P.FRAME.pack(
+                ftype, len(payload),
+                integrity.checksum(payload) ^ 0xFFFFFFFF) + payload)
+        elif kind in ("truncate", "midframe_disconnect"):
+            raw = _frame_bytes(*_base_frame(case["base"]))
+            keep = max(1, int(len(raw) * case["keep_frac"]))
+            sock.sendall(raw[:keep])
+            # hang up mid-frame: nothing to read — the door's job is
+            # leak-free teardown, audited at drain
+            return "ok"
+        elif kind == "oversize_real":
+            payload = b"\x00" * case["payload_bytes"]
+            sock.sendall(_frame_bytes(P.REQ_SUBMIT, payload))
+        elif kind == "slowloris_handshake":
+            # dial and say (almost) nothing: the handshake deadline
+            # must reap this — typed on the way out
+            raw = _frame_bytes(*_base_frame("hello"))
+            if case["send_bytes"]:
+                sock.sendall(raw[:case["send_bytes"]])
+            return _read_outcome(sock, timeout)
+        elif kind == "slowloris_frame":
+            # HELLO cleanly, then trickle a declared frame one byte per
+            # pause: per-recv progress forever, whole-frame progress
+            # never — the frame deadline must reap it typed
+            sock.sendall(_frame_bytes(*_base_frame("hello")))
+            P.recv_frame(sock, expect=(P.RSP_WELCOME,))
+            sock.sendall(P.FRAME.pack(P.REQ_STATUS,
+                                      case["declared"], 0))
+            deadline = _pc() + timeout
+            while _pc() < deadline:
+                try:
+                    sock.sendall(b"\x00" * case["trickle"])
+                except OSError:
+                    break  # the door hung up on us: reaped
+                out = _read_outcome(sock, 0.12)
+                if out != "hang":  # "hang" here = no answer YET
+                    return out
+            return _read_outcome(sock, timeout)
+        elif kind == "strike_burn":
+            # burn the whole decode-error budget on one connection,
+            # then prove the penalty box: the immediate re-dial meets a
+            # typed refusal at accept
+            sock.sendall(_frame_bytes(*_base_frame("hello")))
+            P.recv_frame(sock, expect=(P.RSP_WELCOME,))
+            from spark_rapids_tpu.faults import integrity
+            ftype, payload = _base_frame("status")
+            bad = P.FRAME.pack(ftype, len(payload),
+                               integrity.checksum(payload) ^ 1) + payload
+            last = "conn_closed"
+            for _ in range(4):
+                try:
+                    sock.sendall(bad)
+                except OSError:
+                    break
+                last = _read_outcome(sock, timeout)
+                if last != "typed:BAD_REQUEST":
+                    break
+            if last not in ("typed:BAD_REQUEST", "conn_closed"):
+                return last
+            # the re-dial: penalty-boxed (typed REJECTED at accept,
+            # before our HELLO is even read) or, if the box already
+            # expired under load, a clean WELCOME
+            try:
+                s2 = _dial(host, port, timeout)
+            except ConnectionRefusedError:
+                return "crash"
+            except OSError:
+                return "conn_closed"
+            try:
+                try:
+                    s2.sendall(_frame_bytes(*_base_frame("hello")))
+                except OSError:
+                    pass  # refusal already sent; still readable below
+                s2.settimeout(timeout)
+                try:
+                    ftype2, _ = P.recv_frame(s2)
+                    return ("ok" if ftype2 == P.RSP_WELCOME
+                            else "conn_closed")
+                except P.WireError as e2:
+                    return f"typed:{e2.code}"
+                except socket.timeout:
+                    return "hang"
+                except (ConnectionError, OSError):
+                    return "conn_closed"
+            finally:
+                try:
+                    s2.close()
+                except OSError:
+                    pass
+        return _read_outcome(sock, timeout)
+    except P.WireError as e:
+        # a typed refusal before the attack even ran — the shared
+        # loopback address was penalty-boxed by an earlier case and the
+        # HELLO drew REJECTED; that is still a typed rejection
+        return f"typed:{e.code}"
+    except (ConnectionError, OSError):
+        # the door closed on us mid-send (it already answered or gave
+        # up) — try to collect the typed answer that may be buffered
+        try:
+            return _read_outcome(sock, 0.5)
+        except Exception:
+            return "conn_closed"
+
+
+def _spec_payload(case: dict) -> bytes:
+    """Build the SUBMIT payload for a spec case — by STRING
+    construction for the bombs, so the attacker side never recurses
+    either."""
+    kind = case["kind"]
+    if kind == "depth_bomb":
+        d = case["depth"]
+        expr = '["not",' * d + '["col","o_amt"]' + "]" * d
+        return (
+            '{"spec":{"table":"orders","ops":[{"op":"filter","expr":'
+            + expr + ']}]},"params":[]}').encode()
+    if kind == "node_bomb":
+        w = case["width"]
+        return (
+            '{"spec":{"table":"orders","ops":[{"op":"filter","expr":'
+            '["in",["col","o_qty"],[' + "1," * (w - 1) + '1]]}]},'
+            '"params":[]}').encode()
+    if kind == "wide_ops":
+        op = '{"op":"limit","n":10}'
+        return ('{"spec":{"table":"orders","ops":['
+                + ",".join([op] * case["ops"])
+                + ']},"params":[]}').encode()
+    if kind == "param_bomb":
+        spec = {"table": "orders", "ops": [
+            {"op": "filter",
+             "expr": [">", ["col", "o_qty"],
+                      ["param", case["index"], "int"]]}]}
+        return json.dumps({"spec": spec,
+                           "params": []}).encode()
+    if kind == "big_string":
+        spec = {"table": "orders", "ops": [
+            {"op": "filter",
+             "expr": ["==", ["col", "o_qty"],
+                      ["lit", "x" * case["bytes"], "string"]]}]}
+        return json.dumps({"spec": spec, "params": []}).encode()
+    if kind == "join_bomb":
+        join = {"op": "join", "table": "customers",
+                "on": [["o_cust", "c_id"]], "how": "inner"}
+        spec = {"table": "orders", "ops": [dict(join)
+                                           for _ in range(case["joins"])]}
+        return json.dumps({"spec": spec, "params": []}).encode()
+    if kind == "junk_types":
+        variants = [
+            {"spec": [1, 2, 3], "params": []},
+            {"spec": {"table": 5}, "params": []},
+            {"spec": {"table": "orders", "ops": 7}, "params": []},
+            {"spec": {"table": "orders",
+                      "ops": [{"op": "filter",
+                               "expr": ["frobnicate", 1]}]},
+             "params": []},
+            {"spec": {"table": "orders", "ops": [{"nope": 1}]},
+             "params": []},
+            {"spec": {"table": "orders",
+                      "ops": [{"op": "limit", "n": -5}]},
+             "params": []},
+        ]
+        return json.dumps(variants[case["variant"]
+                                   % len(variants)]).encode()
+    if kind == "unknown_table":
+        return json.dumps({"spec": {"table": "no_such_table"},
+                           "params": []}).encode()
+    raise ValueError(f"unknown spec kind {kind!r}")
+
+
+class SpecAttacker:
+    """One authenticated connection the spec fuzzer reuses: resource
+    bombs are answered typed and the connection SURVIVES (well-formed
+    frames never cost strikes), so the attacker only re-dials after a
+    real disconnect."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+
+    def _ensure(self) -> socket.socket:
+        from spark_rapids_tpu.server import protocol as P
+        if self._sock is not None:
+            return self._sock
+        deadline = _pc() + self._timeout
+        last: Optional[BaseException] = None
+        while _pc() < deadline:
+            try:
+                s = _dial(self._host, self._port, self._timeout)
+                s.sendall(_frame_bytes(*_base_frame("hello")))
+                P.recv_frame(s, expect=(P.RSP_WELCOME,))
+                self._sock = s
+                return s
+            except P.WireError as e:
+                # penalty-boxed (another case burned the budget on our
+                # shared loopback address): honor the hint and re-dial
+                last = e
+                time.sleep(min(0.3, max(0.05,
+                                        e.retry_after_ms / 1e3)))  # fault-ok (paced re-dial while the shared address sits in the penalty box)
+            except OSError as e:
+                last = e
+                time.sleep(0.05)  # fault-ok (paced re-dial; the door may be mid-teardown of a hostile conn)
+        raise ConnectionError(f"spec attacker could not connect: {last}")
+
+    def run_case(self, case: dict, templates_fn, norm_rows,
+                 oracle) -> str:
+        # A previous case may have drawn a typed answer AND a
+        # disconnect (non-resumable decode errors — e.g. an oversize
+        # spec — answer typed, then the server hangs up).  The attacker
+        # can't see the close behind the typed frame, so a REUSED
+        # socket that turns out dead gets one retry on a fresh dial —
+        # standard connection-pool semantics, not a survival waiver.
+        for attempt in range(2):
+            reused = self._sock is not None
+            out = self._run_case_once(case, templates_fn, norm_rows,
+                                      oracle)
+            if out == "conn_closed" and reused and attempt == 0:
+                self._drop()
+                continue
+            return out
+        return out
+
+    def _run_case_once(self, case: dict, templates_fn, norm_rows,
+                       oracle) -> str:
+        from spark_rapids_tpu.server import protocol as P
+        try:
+            sock = self._ensure()
+        except ConnectionRefusedError:
+            return "crash"
+        except (ConnectionError, OSError):
+            return "conn_closed"
+        try:
+            if case["kind"] == "valid":
+                return self._run_valid(sock, case, templates_fn,
+                                       norm_rows, oracle)
+            sock.sendall(_frame_bytes(P.REQ_SUBMIT,
+                                      _spec_payload(case)))
+            out = _read_outcome(sock, self._timeout)
+            if out in ("conn_closed", "crash", "garbled", "hang"):
+                self._drop()
+            return out
+        except (ConnectionError, OSError):
+            self._drop()
+            return "conn_closed"
+
+    def _run_valid(self, sock, case, templates_fn, norm_rows,
+                   oracle) -> str:
+        """A healthy query on the ATTACKER connection, oracle-checked:
+        the door must keep answering exactly, interleaved with bombs
+        on the same connection."""
+        from spark_rapids_tpu.server import protocol as P
+        name = case["template"]
+        spec, pools = templates_fn()[name]
+        params = pools[case["pool"] % len(pools)]
+        sock.sendall(_frame_bytes(P.REQ_SUBMIT, json.dumps(
+            {"spec": spec, "params": params}).encode()))
+        # compile-tolerant deadline: the first query per template may
+        # pay a cold XLA compile while the storm is raging — that is
+        # slow, not hung (responsiveness is gated by sidecar goodput,
+        # not by this read)
+        sock.settimeout(max(self._timeout, 30.0))
+        tables = []
+        try:
+            while True:
+                ftype, payload = P.recv_frame(sock)
+                if ftype == P.RSP_END:
+                    break
+                if ftype == P.RSP_BATCH:
+                    import io
+
+                    import pyarrow as pa
+                    with pa.ipc.open_stream(io.BytesIO(payload)) as r:
+                        tables.append(r.read_all())
+        except P.WireError as e:
+            return f"typed:{e.code}"
+        except socket.timeout:
+            return "hang"
+        except (ConnectionError, OSError):
+            self._drop()
+            return "conn_closed"
+        if oracle is not None:
+            import pyarrow as pa
+            rows: List[tuple] = []
+            if tables:
+                t = pa.concat_tables(tables)
+                cols = [t.column(j).to_pylist()
+                        for j in range(t.num_columns)]
+                rows = [tuple(c[j] for c in cols)
+                        for j in range(t.num_rows)]
+            got = norm_rows(rows)
+            want = oracle.expected(name, spec, list(params))
+            if got != want:
+                return "mismatch"
+        return "ok"
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        self._drop()
+
+
+# ---------------------------------------------------------------------------------
+# Healthy-traffic sidecar
+# ---------------------------------------------------------------------------------
+
+class Sidecar:
+    """Well-formed traffic beside the storm: N WireClient workers
+    looping the loadgen templates with oracle verification.  Phase
+    boundaries (baseline vs storm) come from :meth:`mark`; goodput is
+    queries/second per phase."""
+
+    def __init__(self, host: str, port: int, n: int, oracle,
+                 templates_fn, norm_rows, seed: int):
+        self._host = host
+        self._port = port
+        self._n = n
+        self._oracle = oracle
+        self._templates = templates_fn()
+        self._norm = norm_rows
+        self._seed = seed
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {}  # phase -> completed queries
+        self.mismatches = 0
+        self.errors = 0
+        self._phase = "baseline"
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> "Sidecar":
+        for i in range(self._n):
+            th = threading.Thread(target=self._worker, args=(i,),
+                                  daemon=True, name=f"fuzz-sidecar-{i}")
+            th.start()
+            self._threads.append(th)
+        return self
+
+    def mark(self, phase: str) -> None:
+        with self._lock:
+            self._phase = phase
+
+    def _worker(self, i: int) -> None:
+        import random
+
+        from spark_rapids_tpu.server import protocol as P
+        from spark_rapids_tpu.server.client import WireClient
+        rng = random.Random(self._seed * 1000 + i)
+        names = sorted(self._templates)
+        wc = None
+        while not self._stop.is_set():
+            try:
+                if wc is None:
+                    wc = WireClient(self._host, self._port,
+                                    tenant="sidecar", timeout=10.0)
+                name = names[rng.randrange(len(names))]
+                spec, pools = self._templates[name]
+                params = pools[rng.randrange(len(pools))]
+                rs = wc.query(spec, params=list(params))
+                got = self._norm(rs.rows())
+                want = self._oracle.expected(name, spec, list(params))
+                with self._lock:
+                    if got != want:
+                        self.mismatches += 1
+                    self.counts[self._phase] = \
+                        self.counts.get(self._phase, 0) + 1
+            except (P.WireError, P.ProtocolError, ConnectionError,
+                    OSError) as e:
+                # sheds/boxes/drops beside a fuzz storm are expected;
+                # goodput (not error-freedom) is the sidecar's metric
+                with self._lock:
+                    self.errors += 1
+                if wc is not None:
+                    try:
+                        wc.close()
+                    except Exception:
+                        pass
+                    wc = None
+                time.sleep(0.05)  # fault-ok (paced reconnect beside the storm; errors are counted, goodput is the assertion)
+        if wc is not None:
+            try:
+                wc.close()
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------------
+# The run harness
+# ---------------------------------------------------------------------------------
+
+def _drain_and_audit(door, sess) -> Dict[str, int]:
+    """Zero-leak drain: every wire query finished, every quota slot
+    released, every spool file gone, every handler thread joined."""
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with door._lock:
+            if not door._queries:
+                break
+        time.sleep(0.05)
+    door.close()
+    leaks = 0
+    details: List[str] = []
+    if door.quotas.inflight() != 0:
+        leaks += 1
+        details.append(f"quota_inflight={door.quotas.inflight()}")
+    with door._lock:
+        if door._queries:
+            leaks += 1
+            details.append(f"wire_queries={len(door._queries)}")
+    spool_dir = door._spool_dir(door._conf())
+    if os.path.isdir(spool_dir) and os.listdir(spool_dir):
+        leaks += 1
+        details.append(f"spool_files={len(os.listdir(spool_dir))}")
+    try:
+        from spark_rapids_tpu.memory.spill import get_catalog
+        get_catalog().assert_no_leaks()
+    except AssertionError as e:
+        leaks += 1
+        details.append(f"spill={e}")
+    hung = [t.name for t in threading.enumerate()
+            if t.name.startswith("srt-server-conn-") and t.is_alive()]
+    if hung:
+        leaks += 1
+        details.append(f"hung_threads={hung}")
+    return {"leaks": leaks, "leak_details": details,
+            "hung_threads": len(hung)}
+
+
+def run_fuzz(args, session=None) -> dict:
+    """The full harness: door + sidecar baseline -> fuzz storm ->
+    drain + leak audit -> report.  Importable (bench's SRT_BENCH_FUZZ
+    drill and tests/test_hostile.py both call it)."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.server import SqlFrontDoor
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import loadgen as _lg
+
+    sess = session or srt.Session.get_or_create()
+    sess.conf.set("spark.rapids.tpu.sql.batchSizeRows", 50_000)
+    orders, customers = _lg.build_tables(args.rows, args.seed)
+    tables = {"orders": lambda: sess.create_dataframe(orders),
+              "customers": lambda: sess.create_dataframe(customers)}
+    door = SqlFrontDoor(sess, settings=dict(FUZZ_DOOR_SETTINGS)).start()
+    for name, factory in tables.items():
+        door.register_table(name, factory)
+    host = "127.0.0.1"
+    oracle = _lg.Oracle(sess, tables)
+
+    t_start = _pc()
+    report: dict = {"fuzz_survival": 1, "seed": args.seed,
+                    "cases": 0}
+    sidecar = None
+    baseline_qps = storm_qps = 0.0
+    try:
+        if args.sidecar_connections > 0:
+            # warm every template through the door FIRST: cold XLA
+            # compiles land in neither phase, so baseline vs storm
+            # compares steady-state goodput, not compile luck
+            from spark_rapids_tpu.server import WireClient
+            warm = WireClient(host, door.port, tenant="fuzz-warm")
+            for name, (spec, pools) in sorted(_lg.templates().items()):
+                warm.query(spec, params=list(pools[0])).rows()
+            warm.close()
+            sidecar = Sidecar(host, door.port, args.sidecar_connections,
+                              oracle, _lg.templates, _lg._norm_rows,
+                              args.seed).start()
+            t0 = _pc()
+            time.sleep(args.baseline_s)
+            with sidecar._lock:
+                base_n = sidecar.counts.get("baseline", 0)
+            baseline_qps = base_n / max(1e-9, _pc() - t0)
+            sidecar.mark("storm")
+
+        if args.replay:
+            cases = load_corpus(args.replay)
+        else:
+            cases = gen_cases(args.seed, args.cases)
+        outcomes = _run_cases(cases, host, door.port, args, oracle)
+        report["cases"] = len(cases)
+
+        if sidecar is not None:
+            t1 = _pc()
+            # let the sidecar breathe after the storm so the storm
+            # phase is bounded by case execution, not by this window
+            with sidecar._lock:
+                storm_n = sidecar.counts.get("storm", 0)
+            storm_span = t1 - t0 - args.baseline_s
+            storm_qps = storm_n / max(1e-9, storm_span)
+            sidecar.stop()
+
+        taxonomy: Dict[str, int] = {}
+        by_kind: Dict[str, Dict[str, int]] = {}
+        survivors: List[dict] = []
+        for case, out in zip(cases, outcomes):
+            taxonomy[out] = taxonomy.get(out, 0) + 1
+            k = f"{case['fuzzer']}:{case['kind']}"
+            by_kind.setdefault(k, {})
+            by_kind[k][out] = by_kind[k].get(out, 0) + 1
+            if out in ("hang", "crash", "mismatch"):
+                survivors.append(dict(case, outcome=out))
+        # a close with no typed answer is only legitimate for cases
+        # where the ATTACKER hung up first
+        untyped = sum(
+            1 for case, out in zip(cases, outcomes)
+            if out in ("conn_closed", "garbled")
+            and case["kind"] not in ("truncate", "midframe_disconnect"))
+        corpus_new = 0
+        if survivors and args.corpus_dir and not args.replay:
+            corpus_new = write_corpus(args.corpus_dir, args.seed,
+                                      survivors)
+        report.update({
+            "crashes": taxonomy.get("crash", 0),
+            "hangs": taxonomy.get("hang", 0),
+            "untyped_rejections": untyped,
+            "outcomes": dict(sorted(taxonomy.items())),
+            "by_kind": {k: dict(sorted(v.items()))
+                        for k, v in sorted(by_kind.items())},
+            "typed_total": sum(v for k, v in taxonomy.items()
+                               if k.startswith("typed:")),
+            "corpus_new": corpus_new,
+        })
+    finally:
+        if sidecar is not None and sidecar._threads \
+                and not sidecar._stop.is_set():
+            sidecar.stop()
+        audit = _drain_and_audit(door, sess)
+    report.update(audit)
+    if sidecar is not None:
+        report.update({
+            "baseline_qps": round(baseline_qps, 2),
+            "storm_qps": round(storm_qps, 2),
+            "goodput_ratio": round(storm_qps / max(1e-9, baseline_qps),
+                                   3),
+            "sidecar_queries": sum(sidecar.counts.values()),
+            "sidecar_mismatches": sidecar.mismatches,
+            "sidecar_errors": sidecar.errors,
+        })
+    else:
+        report.update({"goodput_ratio": None, "sidecar_queries": 0,
+                       "sidecar_mismatches": 0})
+    report["wall_s"] = round(_pc() - t_start, 2)
+    snap = door.snapshot()
+    report["server"] = {
+        k: snap[k] for k in ("decode_errors", "hostile_disconnects",
+                             "penalty_refusals", "connections_total",
+                             "queries_total")}
+    return report
+
+
+def _run_cases(cases: List[dict], host: str, port: int,
+               args, oracle=None) -> List[str]:
+    """Execute every case on a small attacker pool (case CONTENT is
+    already fixed, so threading only affects wall time)."""
+    outcomes: List[Optional[str]] = [None] * len(cases)
+    idx = [0]
+    lock = threading.Lock()
+    n_threads = max(1, args.attackers)
+
+    def worker():
+        spec_conn = SpecAttacker(host, port, args.case_timeout)
+        import loadgen as _lg
+        try:
+            while True:
+                with lock:
+                    i = idx[0]
+                    if i >= len(cases):
+                        return
+                    idx[0] += 1
+                case = cases[i]
+                try:
+                    if case["fuzzer"] == "frame":
+                        out = run_frame_case(case, host, port,
+                                             args.case_timeout)
+                    else:
+                        out = spec_conn.run_case(
+                            case, _lg.templates, _lg._norm_rows,
+                            oracle)
+                except Exception as e:  # fault-ok (a crashed CASE is a recorded outcome, never a crashed harness)
+                    out = f"harness_error:{type(e).__name__}"
+                outcomes[i] = out
+        finally:
+            spec_conn.close()
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"fuzz-attacker-{i}")
+               for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return [o or "hang" for o in outcomes]
+
+
+# ---------------------------------------------------------------------------------
+# Corpus
+# ---------------------------------------------------------------------------------
+
+def load_corpus(path: str) -> List[dict]:
+    cases = []
+    for name in sorted(os.listdir(path)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(path, name)) as f:
+            c = json.load(f)
+        c.setdefault("case", len(cases))
+        cases.append(c)
+    return cases
+
+
+def write_corpus(path: str, seed: int, survivors: List[dict]) -> int:
+    os.makedirs(path, exist_ok=True)
+    n = 0
+    for s in survivors:
+        name = f"survivor_s{seed}_c{s['case']}_{s['kind']}.json"
+        with open(os.path.join(path, name), "w") as f:
+            json.dump(s, f, indent=1, sort_keys=True)
+        n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--cases", type=int, default=2000)
+    ap.add_argument("--seed", type=int, default=20260807)
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--attackers", type=int, default=4,
+                    help="attacker threads (case content is fixed by "
+                    "the seed; this only affects wall time)")
+    ap.add_argument("--case-timeout", type=float, default=6.0)
+    ap.add_argument("--sidecar-connections", type=int, default=2)
+    ap.add_argument("--baseline-s", type=float, default=3.0,
+                    help="fuzz-free sidecar warmup measured as the "
+                    "goodput baseline")
+    ap.add_argument("--corpus-dir", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "fuzz_corpus"),
+        help="where surviving crash/hang cases are written")
+    ap.add_argument("--replay", default=None, metavar="DIR",
+                    help="replay every *.json case in DIR instead of "
+                    "generating cases")
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON here")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    rep = run_fuzz(args)
+    line = json.dumps(rep, sort_keys=True)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    ok = (rep.get("crashes", 1) == 0 and rep.get("hangs", 1) == 0
+          and rep.get("untyped_rejections", 1) == 0
+          and rep.get("leaks", 1) == 0
+          and rep.get("sidecar_mismatches", 1) == 0
+          and (rep.get("goodput_ratio") is None
+               or rep["goodput_ratio"] >= 0.9))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,13 @@ Record shapes (auto-detected from the run file):
     when post-restart p95 exceeds ``--max-restart-p95-ratio`` x the
     pre-restart p95, or when any post-restart compile classified
     ``post_restart`` / ``unattributed`` (warmth must be attributable —
-    ``store_hit`` / ``prewarm`` / honestly-new ``first_seen``).
+    ``store_hit`` / ``prewarm`` / honestly-new ``first_seen``);
+  * a ``fuzzwire.py`` report (``"fuzz_survival": 1``): case count,
+    crash/hang/untyped-rejection/leak counts, sidecar goodput ratio
+    and mismatches.  The survival gate is also ABSOLUTE: zero crashes,
+    hangs, untyped rejections, leaks, mismatches, and new surviving
+    corpus cases, with sidecar goodput >= ``--min-fuzz-goodput-ratio``
+    x the fuzz-free baseline phase.
 
 Usage:
   python tools/perfwatch.py record LEDGER.jsonl RUN.json [--label L]
@@ -108,6 +114,25 @@ def load_run(path: str, label: str = "") -> dict:
             "warmstore_enabled": bool(raw.get("warmstore_enabled",
                                               True)),
         }
+    if isinstance(raw, dict) and raw.get("fuzz_survival") == 1:
+        return {
+            "kind": "fuzz_survival",
+            "label": label,
+            "t_wall": time.time(),
+            "source": path,
+            "cases": int(raw.get("cases", 0)),
+            "crashes": int(raw.get("crashes", 0)),
+            "hangs": int(raw.get("hangs", 0)),
+            "untyped_rejections": int(raw.get("untyped_rejections",
+                                              0)),
+            "leaks": int(raw.get("leaks", 0)),
+            "sidecar_mismatches": int(raw.get("sidecar_mismatches",
+                                              0)),
+            "goodput_ratio": (
+                None if raw.get("goodput_ratio") is None
+                else float(raw["goodput_ratio"])),
+            "corpus_new": int(raw.get("corpus_new", 0)),
+        }
     if isinstance(raw, dict) and raw.get("loadgen") == 1:
         return {
             "kind": "loadgen",
@@ -165,6 +190,8 @@ def pick_baseline(history: List[dict], kind: str, label: str,
         return cands[-1]
     if kind == "restart_probe":
         key = lambda e: e.get("p95_ratio", 0.0)  # noqa: E731
+    elif kind == "fuzz_survival":
+        key = lambda e: -(e.get("goodput_ratio") or 0.0)  # noqa: E731
     elif kind == "loadgen":
         key = lambda e: e.get("p95_ms", 0.0)  # noqa: E731
     else:
@@ -202,10 +229,36 @@ def gate_restart_probe(entry: dict, args) -> List[str]:
     return regressions
 
 
+def gate_fuzz_survival(entry: dict, args) -> List[str]:
+    """The hostile-input survival gate — absolute, baseline-free:
+    survival is binary, not relative to the last fuzz run."""
+    regressions = []
+    if entry.get("cases", 0) <= 0:
+        regressions.append("0 fuzz cases executed [empty run]")
+    for key in ("crashes", "hangs", "untyped_rejections", "leaks",
+                "sidecar_mismatches"):
+        if entry.get(key, 0) > 0:
+            regressions.append(
+                f"{entry[key]} {key.replace('_', ' ')} under fuzz "
+                f"[must be 0]")
+    ratio = entry.get("goodput_ratio")
+    if ratio is not None and ratio < args.min_fuzz_goodput_ratio:
+        regressions.append(
+            f"sidecar goodput {ratio:g}x of the fuzz-free baseline "
+            f"[< {args.min_fuzz_goodput_ratio:g}x]")
+    if entry.get("corpus_new", 0) > 0:
+        regressions.append(
+            f"{entry['corpus_new']} new surviving corpus case(s) "
+            f"written [fix the door, keep the file]")
+    return regressions
+
+
 def gate(entry: dict, base: dict, args) -> List[str]:
     """Return regression strings (empty = clean)."""
     if entry["kind"] == "restart_probe":
         return gate_restart_probe(entry, args)
+    if entry["kind"] == "fuzz_survival":
+        return gate_fuzz_survival(entry, args)
     if entry["kind"] == "bench":
         regressions, _notes = bench_compare.compare(
             _entry_aggregate(base), _entry_aggregate(entry),
@@ -258,6 +311,10 @@ def main(argv=None) -> int:
     p.add_argument("--max-restart-p95-ratio", type=float, default=1.2,
                    help="restart probe: post/pre p95 ceiling "
                         "(absolute gate, no baseline needed)")
+    p.add_argument("--min-fuzz-goodput-ratio", type=float, default=0.9,
+                   help="fuzz survival: sidecar goodput floor vs the "
+                        "fuzz-free baseline phase (absolute gate, no "
+                        "baseline needed)")
     p.add_argument("--record", action="store_true",
                    help="with check: append the run after gating")
     args = p.parse_args(argv)
@@ -274,6 +331,14 @@ def main(argv=None) -> int:
                       f"shipped={e.get('warm_entries_shipped')} "
                       f"prewarmed={e.get('prewarmed')} "
                       f"post_restart={e.get('post_restart_compiles')} "
+                      f"({e.get('source', '')})")
+            elif e.get("kind") == "fuzz_survival":
+                print(f"fuzz_survival {e.get('label', '')} "
+                      f"cases={e.get('cases')} "
+                      f"crashes={e.get('crashes')} "
+                      f"hangs={e.get('hangs')} "
+                      f"untyped={e.get('untyped_rejections')} "
+                      f"goodput={e.get('goodput_ratio')} "
                       f"({e.get('source', '')})")
             elif e.get("kind") == "loadgen":
                 print(f"loadgen {e.get('label', '')} "
@@ -310,9 +375,11 @@ def main(argv=None) -> int:
                          args.baseline)
     if args.record:
         append_ledger(args.ledger, entry)
-    if base is None and entry["kind"] != "restart_probe":
-        # restart_probe gates are absolute — they run even on an
-        # empty ledger; everything else needs a prior run to diff.
+    if base is None and entry["kind"] not in ("restart_probe",
+                                              "fuzz_survival"):
+        # restart_probe / fuzz_survival gates are absolute — they run
+        # even on an empty ledger; everything else needs a prior run
+        # to diff.
         print("perfwatch: no baseline in the ledger yet — recorded "
               "run accepted as the first of its stream"
               if args.record else
@@ -321,8 +388,9 @@ def main(argv=None) -> int:
     regressions = gate(entry, base if base is not None else entry,
                        args)
     if regressions:
-        print(f"perfwatch: {len(regressions)} regression(s) vs "
-              f"{args.baseline} baseline ({base.get('source', '?')}):",
+        vs = (f"{args.baseline} baseline ({base.get('source', '?')})"
+              if base is not None else "absolute gate")
+        print(f"perfwatch: {len(regressions)} regression(s) vs {vs}:",
               file=sys.stderr)
         for line in regressions:
             print("  REGRESSION " + line, file=sys.stderr)
